@@ -1,0 +1,143 @@
+"""Foundation tests: Status, flags, metrics, trace, HybridTime, partitioning.
+
+Modeled on the reference's colocated unit tests (util/metrics-test.cc,
+common/hybrid_time-test? etc.) per SURVEY.md section 4 tier 1.
+"""
+
+import pytest
+
+from yugabyte_tpu.utils.status import Status, StatusError, Code
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.metrics import MetricRegistry
+from yugabyte_tpu.utils.trace import Trace, TRACE
+from yugabyte_tpu.common.hybrid_time import HybridTime, DocHybridTime, HybridClock
+from yugabyte_tpu.common.partition import (
+    PartitionSchema, partition_for_key, hash_column_compound_value, kMaxHashCode)
+
+
+class TestStatus:
+    def test_ok(self):
+        s = Status.OK()
+        assert s.ok
+        s.raise_if_error()
+
+    def test_error_raises(self):
+        s = Status.NotFound("missing tablet")
+        assert not s.ok
+        assert s.code == Code.NOT_FOUND
+        with pytest.raises(StatusError):
+            s.raise_if_error()
+
+
+class TestFlags:
+    def test_define_get_set(self):
+        flags.define_flag("test_flag_x", 42, "test", [flags.FlagTag.RUNTIME])
+        assert flags.get_flag("test_flag_x") == 42
+        flags.set_flag("test_flag_x", 7)
+        assert flags.get_flag("test_flag_x") == 7
+        flags.reset_flag("test_flag_x")
+        assert flags.get_flag("test_flag_x") == 42
+
+    def test_validator(self):
+        flags.define_flag("test_flag_pos", 1, "", [], validator=lambda v: v > 0)
+        with pytest.raises(ValueError):
+            flags.set_flag("test_flag_pos", -5)
+
+
+class TestMetrics:
+    def test_counter_histogram_prometheus(self):
+        reg = MetricRegistry()
+        ent = reg.entity("tablet", "t1", {"table_name": "foo"})
+        c = ent.counter("rows_inserted")
+        c.increment(10)
+        h = ent.histogram("write_latency_us")
+        for v in [100, 200, 300, 1000]:
+            h.increment(v)
+        assert c.value() == 10
+        assert h.count() == 4
+        assert 250 < h.percentile(99) <= 1100
+        prom = reg.to_prometheus()
+        assert 'rows_inserted{metric_type="tablet",metric_id="t1",table_name="foo"} 10' in prom
+        assert "write_latency_us_count" in prom
+
+
+class TestTrace:
+    def test_trace_collects(self):
+        with Trace() as t:
+            TRACE("step %d", 1)
+            TRACE("step 2")
+        dump = t.dump()
+        assert "step 1" in dump and "step 2" in dump
+
+    def test_no_trace_is_noop(self):
+        TRACE("ignored")  # must not raise
+
+
+class TestHybridTime:
+    def test_components(self):
+        ht = HybridTime.from_micros(123456789, 42)
+        assert ht.physical_micros == 123456789
+        assert ht.logical == 42
+
+    def test_ordering(self):
+        a = HybridTime.from_micros(100)
+        b = HybridTime.from_micros(100, 1)
+        c = HybridTime.from_micros(101)
+        assert a < b < c
+
+    def test_clock_monotonic(self):
+        fake = [1000]
+        clock = HybridClock(time_source=lambda: fake[0])
+        t1 = clock.now()
+        t2 = clock.now()  # same wall time -> logical bump
+        assert t2 > t1
+        fake[0] = 2000
+        t3 = clock.now()
+        assert t3 > t2 and t3.physical_micros == 2000
+
+    def test_clock_update(self):
+        clock = HybridClock(time_source=lambda: 1000)
+        remote = HybridTime.from_micros(99999)
+        clock.update(remote)
+        assert clock.now() > remote
+
+
+class TestDocHybridTime:
+    def test_encode_decode_roundtrip(self):
+        dht = DocHybridTime(HybridTime.from_micros(1234567, 89), 7)
+        assert DocHybridTime.decode(dht.encoded()) == dht
+
+    def test_descending_encoding(self):
+        # Later hybrid times must encode to SMALLER byte strings (sort first).
+        early = DocHybridTime(HybridTime.from_micros(100), 0)
+        late = DocHybridTime(HybridTime.from_micros(200), 0)
+        assert late.encoded() < early.encoded()
+        same_ht_w0 = DocHybridTime(HybridTime.from_micros(100), 0)
+        same_ht_w1 = DocHybridTime(HybridTime.from_micros(100), 1)
+        assert same_ht_w1.encoded() < same_ht_w0.encoded()
+
+
+class TestPartitioning:
+    def test_hash_is_16bit_and_stable(self):
+        h = hash_column_compound_value(b"hello")
+        assert 0 <= h <= kMaxHashCode
+        assert h == hash_column_compound_value(b"hello")
+        assert h != hash_column_compound_value(b"hellp")
+
+    def test_hash_partitions_cover_space(self):
+        ps = PartitionSchema(hash_partitioning=True)
+        parts = ps.create_partitions(16)
+        assert len(parts) == 16
+        assert parts[0].start == b""
+        assert parts[-1].end == b""
+        for key_hash in [0, 1, 4095, 65535]:
+            pk = ps.partition_key(key_hash, b"")
+            idx = partition_for_key(parts, pk)
+            assert parts[idx].contains(pk)
+
+    def test_range_partitions(self):
+        ps = PartitionSchema(hash_partitioning=False)
+        parts = ps.create_partitions(3, split_keys=[b"m", b"t"])
+        assert partition_for_key(parts, b"a") == 0
+        assert partition_for_key(parts, b"n") == 1
+        assert partition_for_key(parts, b"z") == 2

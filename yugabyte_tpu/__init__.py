@@ -1,0 +1,30 @@
+"""yugabyte_tpu: a TPU-native distributed document store.
+
+A brand-new framework with the capabilities of YugabyteDB (reference:
+/root/reference, see SURVEY.md): a sharded, Raft-replicated, MVCC document
+store over an LSM storage engine, with distributed ACID transactions and
+CQL/SQL/Redis query layers.
+
+TPU-first design: the LSM hot path (compaction k-way merge, MVCC garbage
+collection, scan/filter) runs as batched JAX sort/segment-reduce kernels on
+TPU (`yugabyte_tpu.ops`), sharded across device meshes
+(`yugabyte_tpu.parallel`), with a CPU fallback that produces byte-identical
+SSTs.
+
+Layer map (mirrors SURVEY.md section 1):
+  utils/     - foundation: Status, flags, metrics, trace  (ref: src/yb/util)
+  common/    - HybridTime, schema, partitioning           (ref: src/yb/common)
+  docdb/     - doc key/value encoding, MVCC semantics     (ref: src/yb/docdb)
+  storage/   - LSM engine: memtable, SST, compaction      (ref: src/yb/rocksdb)
+  ops/       - TPU kernels: merge, GC, scan, bloom        (the new hot path)
+  parallel/  - mesh sharding, distributed compaction      (ref: NCCL-less rpc)
+  consensus/ - Raft, WAL                                  (ref: src/yb/consensus)
+  tablet/    - tablet, MVCC manager, write pipeline       (ref: src/yb/tablet)
+  server/    - tserver, master, heartbeats                (ref: src/yb/tserver, master)
+  client/    - client, meta-cache, batcher                (ref: src/yb/client)
+  yql/       - CQL-subset / Redis-subset / SQL frontends  (ref: src/yb/yql)
+  models/    - workload models (YCSB) and the flagship
+               compaction-pipeline "model" used for benchmarking
+"""
+
+__version__ = "0.1.0"

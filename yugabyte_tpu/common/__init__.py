@@ -1,0 +1,3 @@
+from yugabyte_tpu.common.hybrid_time import HybridTime, DocHybridTime, HybridClock
+from yugabyte_tpu.common.schema import Schema, ColumnSchema, DataType
+from yugabyte_tpu.common.partition import Partition, PartitionSchema

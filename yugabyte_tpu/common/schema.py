@@ -1,0 +1,88 @@
+"""Table schema: typed columns, hash/range key split.
+
+Capability parity with yb::Schema / ColumnSchema (ref: src/yb/common/schema.h)
+and the QL type system (ref: src/yb/common/ql_type.h), trimmed to the types the
+doc store supports in round 1.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class DataType(enum.Enum):
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT = "float"
+    DOUBLE = "double"
+    STRING = "string"
+    BINARY = "binary"
+    BOOL = "bool"
+    TIMESTAMP = "timestamp"
+
+
+class SortingType(enum.Enum):
+    ASC = "asc"
+    DESC = "desc"
+
+
+@dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    type: DataType
+    nullable: bool = True
+    sorting: SortingType = SortingType.ASC
+
+
+@dataclass
+class Schema:
+    """Columns split into hash-key, range-key and value columns.
+
+    Mirrors the reference's key layout: a 16-bit hash over the hashed columns
+    prefixes the key, then hashed columns, then range columns, then value
+    columns addressed by column id (ref: docdb/doc_key.h:42-82).
+    """
+
+    columns: List[ColumnSchema]
+    num_hash_key_columns: int = 0
+    num_range_key_columns: int = 0
+
+    def __post_init__(self):
+        names = [c.name for c in self.columns]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate column names")
+        # Column ids: stable small ints, value columns only (keys are positional).
+        nk = self.num_key_columns
+        self._column_ids: Dict[str, int] = {
+            c.name: i - nk for i, c in enumerate(self.columns) if i >= nk
+        }
+
+    @property
+    def num_key_columns(self) -> int:
+        return self.num_hash_key_columns + self.num_range_key_columns
+
+    @property
+    def hash_columns(self) -> List[ColumnSchema]:
+        return self.columns[: self.num_hash_key_columns]
+
+    @property
+    def range_columns(self) -> List[ColumnSchema]:
+        return self.columns[self.num_hash_key_columns: self.num_key_columns]
+
+    @property
+    def value_columns(self) -> List[ColumnSchema]:
+        return self.columns[self.num_key_columns:]
+
+    def column_id(self, name: str) -> int:
+        return self._column_ids[name]
+
+    def column_by_id(self, cid: int) -> ColumnSchema:
+        return self.columns[self.num_key_columns + cid]
+
+    def column(self, name: str) -> ColumnSchema:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)

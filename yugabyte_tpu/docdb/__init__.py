@@ -1,0 +1,3 @@
+from yugabyte_tpu.docdb.value_type import ValueType
+from yugabyte_tpu.docdb.doc_key import DocKey, SubDocKey, PrimitiveValue
+from yugabyte_tpu.docdb.value import Value

@@ -1,0 +1,3 @@
+from yugabyte_tpu.utils.status import Status, StatusError, Result
+from yugabyte_tpu.utils.flags import define_flag, get_flag, set_flag, FlagTag
+from yugabyte_tpu.utils.metrics import MetricRegistry, Counter, Gauge, Histogram

@@ -1,0 +1,183 @@
+"""Metrics: counters, gauges, histograms, with JSON + Prometheus exposition.
+
+Capability parity with the reference metric system (ref: src/yb/util/metrics.h:
+Counter, AtomicGauge :713, Histogram; WriteForPrometheus :449-518). Entities
+(server/table/tablet) each own a registry; registries aggregate into a root
+MetricRegistry for the /metrics endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+class Counter:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, by: int = 1) -> None:
+        with self._lock:
+            self._value += by
+
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = "", initial: float = 0.0):
+        self.name = name
+        self.help = help
+        self._value = initial
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def increment(self, by: float = 1.0) -> None:
+        with self._lock:
+            self._value += by
+
+    def decrement(self, by: float = 1.0) -> None:
+        self.increment(-by)
+
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Log-bucketed histogram (2% default precision), like the reference's HdrHistogram."""
+
+    __slots__ = ("name", "help", "_counts", "_lock", "_total_sum", "_total_count",
+                 "_min", "_max", "_growth")
+
+    def __init__(self, name: str, help: str = "", growth: float = 1.02):
+        self.name = name
+        self.help = help
+        self._growth = math.log(growth)
+        self._counts: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._total_sum = 0.0
+        self._total_count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def _bucket(self, v: float) -> int:
+        if v <= 0:
+            return -1
+        return int(math.log(v) / self._growth)
+
+    def increment(self, v: float) -> None:
+        b = self._bucket(v)
+        with self._lock:
+            self._counts[b] = self._counts.get(b, 0) + 1
+            self._total_sum += v
+            self._total_count += 1
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if self._total_count == 0:
+                return 0.0
+            target = p / 100.0 * self._total_count
+            seen = 0
+            for b in sorted(self._counts):
+                seen += self._counts[b]
+                if seen >= target:
+                    return math.exp((b + 0.5) * self._growth) if b >= 0 else 0.0
+            return self._max
+
+    def mean(self) -> float:
+        return self._total_sum / self._total_count if self._total_count else 0.0
+
+    def count(self) -> int:
+        return self._total_count
+
+
+class MetricEntity:
+    """One metric-owning entity: a server, table, or tablet (ref: metrics.h entities)."""
+
+    def __init__(self, entity_type: str, entity_id: str, attributes: Optional[Dict[str, str]] = None):
+        self.entity_type = entity_type
+        self.entity_id = entity_id
+        self.attributes = attributes or {}
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "", initial: float = 0.0) -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help, initial))
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help))
+
+    def _get_or_create(self, name, factory):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._entities: Dict[str, MetricEntity] = {}
+        self._lock = threading.Lock()
+
+    def entity(self, entity_type: str, entity_id: str,
+               attributes: Optional[Dict[str, str]] = None) -> MetricEntity:
+        key = f"{entity_type}:{entity_id}"
+        with self._lock:
+            if key not in self._entities:
+                self._entities[key] = MetricEntity(entity_type, entity_id, attributes)
+            return self._entities[key]
+
+    def to_json(self) -> str:
+        out = []
+        for ent in self._entities.values():
+            metrics = []
+            for m in ent._metrics.values():
+                if isinstance(m, Histogram):
+                    metrics.append({
+                        "name": m.name, "total_count": m.count(), "mean": m.mean(),
+                        "percentile_95": m.percentile(95), "percentile_99": m.percentile(99),
+                    })
+                else:
+                    metrics.append({"name": m.name, "value": m.value()})
+            out.append({"type": ent.entity_type, "id": ent.entity_id,
+                        "attributes": ent.attributes, "metrics": metrics})
+        return json.dumps(out, indent=1)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (ref: metrics.h WriteForPrometheus :449-518)."""
+        lines: List[str] = []
+        for ent in self._entities.values():
+            labels = {"metric_type": ent.entity_type, "metric_id": ent.entity_id}
+            labels.update(ent.attributes)
+            label_str = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            for m in ent._metrics.values():
+                if isinstance(m, Histogram):
+                    lines.append(f"{m.name}_count{{{label_str}}} {m.count()}")
+                    lines.append(f"{m.name}_sum{{{label_str}}} {m._total_sum}")
+                    for p in (50, 95, 99):
+                        lines.append(f'{m.name}{{{label_str},quantile="0.{p}"}} {m.percentile(p)}')
+                else:
+                    lines.append(f"{m.name}{{{label_str}}} {m.value()}")
+        return "\n".join(lines) + "\n"
+
+
+ROOT_REGISTRY = MetricRegistry()

@@ -1,0 +1,71 @@
+"""Per-request tracing.
+
+Capability parity with yb::Trace (ref: src/yb/util/trace.h:62-137): a Trace
+collects timestamped messages for one request; traces dump on slow operations
+(ref: LongOperationTracker usage, tserver/read_query.cc:500). A contextvar
+carries the current trace, so deep call stacks need no plumbing.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import List, Optional, Tuple
+
+_current_trace: contextvars.ContextVar[Optional["Trace"]] = contextvars.ContextVar(
+    "ybtpu_trace", default=None)
+
+
+class Trace:
+    __slots__ = ("entries", "start", "children", "_token")
+
+    def __init__(self):
+        self.entries: List[Tuple[float, str]] = []
+        self.start = time.monotonic()
+        self.children: List["Trace"] = []
+
+    def message(self, msg: str) -> None:
+        self.entries.append((time.monotonic() - self.start, msg))
+
+    def dump(self) -> str:
+        lines = [f"{dt * 1e3:10.3f}ms {msg}" for dt, msg in self.entries]
+        for child in self.children:
+            lines.append("  [child trace]")
+            lines.extend("  " + l for l in child.dump().splitlines())
+        return "\n".join(lines)
+
+    def __enter__(self) -> "Trace":
+        self._token = _current_trace.set(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _current_trace.reset(self._token)
+
+
+def TRACE(msg: str, *args) -> None:
+    """Append to the current request trace, if any (ref: TRACE() macro, trace.h)."""
+    t = _current_trace.get()
+    if t is not None:
+        t.message(msg % args if args else msg)
+
+
+def current_trace() -> Optional[Trace]:
+    return _current_trace.get()
+
+
+class LongOperationTracker:
+    """Warns (collects) when an operation exceeds a threshold (ref: util/long_operation_tracker.h)."""
+
+    def __init__(self, name: str, threshold_ms: float = 1000.0):
+        self.name = name
+        self.threshold_ms = threshold_ms
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        elapsed_ms = (time.monotonic() - self._start) * 1e3
+        if elapsed_ms > self.threshold_ms:
+            TRACE("LongOperation %s took %.1fms (threshold %.1fms)",
+                  self.name, elapsed_ms, self.threshold_ms)
