@@ -1,0 +1,84 @@
+"""Reference semantic model of compaction MVCC GC — the differential oracle.
+
+An intentionally simple, loop-based implementation of the same rules the TPU
+kernel (ops/merge_gc.py) implements with segmented ops. Used by randomized
+differential tests, mirroring the reference's model-check strategy
+(ref: docdb/randomized_docdb-test.cc + docdb/in_mem_docdb.h) against the real
+filter semantics (ref: docdb/docdb_compaction_filter.cc:74-320).
+
+Entries: (key_prefix: bytes, doc_key_len: int, dht: DocHybridTime,
+          is_tombstone, is_object_init, ttl_ms or None, payload_id)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+
+
+@dataclass(frozen=True)
+class ModelEntry:
+    key: bytes
+    doc_key_len: int
+    dht: DocHybridTime
+    is_tombstone: bool = False
+    is_object_init: bool = False
+    ttl_ms: Optional[int] = None
+    payload_id: int = 0
+
+
+@dataclass(frozen=True)
+class ModelResult:
+    entry: ModelEntry
+    as_tombstone: bool = False  # value rewritten to tombstone (TTL expiry)
+
+
+def sort_key(e: ModelEntry):
+    """Internal key order: key asc, then DocHybridTime DESC."""
+    return (e.key, -e.dht.ht.value, -e.dht.write_id)
+
+
+def compact_model(entries: List[ModelEntry], history_cutoff_ht: int,
+                  is_major: bool, retain_deletes: bool = False) -> List[ModelResult]:
+    ordered = sorted(entries, key=sort_key)
+    cutoff_phys_us = history_cutoff_ht >> 12
+
+    def expired(e: ModelEntry) -> bool:
+        if e.ttl_ms is None:
+            return False
+        return (e.dht.ht.physical_micros + e.ttl_ms * 1000) <= cutoff_phys_us
+
+    # Pass 1: per-doc root overwrite DocHybridTime = the root-level version
+    # visible at the cutoff (if any).
+    root_ov: dict = {}
+    seen_visible: dict = {}
+    for e in ordered:
+        doc = e.key[: e.doc_key_len]
+        is_root = len(e.key) == e.doc_key_len
+        below = e.dht.ht.value <= history_cutoff_ht
+        if is_root and below and e.key not in seen_visible:
+            seen_visible[e.key] = e.dht
+            root_ov.setdefault(doc, e.dht)
+
+    # Pass 2: keep/drop per entry.
+    out: List[ModelResult] = []
+    visible_taken: dict = {}
+    for e in ordered:
+        below = e.dht.ht.value <= history_cutoff_ht
+        if below:
+            if e.key in visible_taken:
+                continue  # an earlier (newer) <=cutoff version shadows it
+            visible_taken[e.key] = True
+        is_root = len(e.key) == e.doc_key_len
+        if not is_root:
+            ov = root_ov.get(e.key[: e.doc_key_len])
+            if ov is not None and (e.dht.ht.value, e.dht.write_id) <= (ov.ht.value, ov.write_id):
+                continue  # overwritten by a root write visible at cutoff
+        tomb = e.is_tombstone or (expired(e) and below)
+        if below and tomb and is_major and not retain_deletes:
+            continue  # visible tombstone at bottommost level: gone for good
+        out.append(ModelResult(e, as_tombstone=(expired(e) and below
+                                                and not e.is_tombstone and not is_major)))
+    return out

@@ -53,6 +53,8 @@ def zero_decode(data: bytes, pos: int) -> Tuple[bytes, int]:
     while pos < n:
         b = data[pos]
         if b == 0:
+            if pos + 1 >= n:
+                raise ValueError("truncated zero-encoded string")
             nxt = data[pos + 1]
             if nxt == 0:
                 return bytes(out), pos + 2
@@ -78,12 +80,11 @@ class PrimitiveValue:
         elif v is False:
             buf.append(ValueType.kFalse)
         elif isinstance(v, int):
-            if -_I32_OFF <= v < _I32_OFF:
-                buf.append(ValueType.kInt32)
-                buf += struct.pack(">I", v + _I32_OFF)
-            else:
-                buf.append(ValueType.kInt64)
-                buf += struct.pack(">Q", v + _I64_OFF)
+            # Always kInt64: a single tag keeps memcmp order == numeric order
+            # for ALL ints in a column. (Tagging by magnitude would order any
+            # int64-range value after every int32-range value.)
+            buf.append(ValueType.kInt64)
+            buf += struct.pack(">Q", v + _I64_OFF)
         elif isinstance(v, float):
             buf.append(ValueType.kDouble)
             bits = struct.unpack(">Q", struct.pack(">d", v))[0]
@@ -95,7 +96,10 @@ class PrimitiveValue:
             buf.append(ValueType.kString)
             buf += zero_encode(v.encode("utf-8"))
         elif isinstance(v, bytes):
-            buf.append(ValueType.kString)
+            # Distinct tag so round-trips are type-stable (str stays str,
+            # bytes stay bytes). BINARY and STRING are distinct schema types,
+            # so they never share a column and relative order is irrelevant.
+            buf.append(ValueType.kBinary)
             buf += zero_encode(v)
         else:
             raise TypeError(f"unsupported key component type: {type(v)}")
@@ -131,10 +135,10 @@ class PrimitiveValue:
             return struct.unpack(">d", struct.pack(">Q", bits))[0], pos + 8
         if tag == ValueType.kString:
             raw, pos = zero_decode(data, pos)
-            try:
-                return raw.decode("utf-8"), pos
-            except UnicodeDecodeError:
-                return raw, pos
+            return raw.decode("utf-8"), pos
+        if tag == ValueType.kBinary:
+            raw, pos = zero_decode(data, pos)
+            return raw, pos
         if tag == ValueType.kColumnId:
             (cid,) = struct.unpack_from(">H", data, pos)
             return ("col", cid), pos + 2
@@ -185,13 +189,17 @@ class DocKey:
         if pos < len(data) and data[pos] == ValueType.kUInt16Hash:
             had_hash = True
             pos += 3  # tag + 2-byte hash (recomputable from components)
-            while data[pos] != ValueType.kGroupEnd:
+            while pos < len(data) and data[pos] != ValueType.kGroupEnd:
                 v, pos = PrimitiveValue.decode(data, pos)
                 hash_components.append(v)
+            if pos >= len(data):
+                raise ValueError("truncated DocKey: unterminated hashed group")
             pos += 1
         while pos < len(data) and data[pos] != ValueType.kGroupEnd:
             v, pos = PrimitiveValue.decode(data, pos)
             range_components.append(v)
+        if pos >= len(data):
+            raise ValueError("truncated DocKey: unterminated range group")
         pos += 1  # range kGroupEnd
         return DocKey(tuple(hash_components), tuple(range_components), had_hash), pos
 

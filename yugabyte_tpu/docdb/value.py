@@ -48,15 +48,7 @@ class Value:
 
     @staticmethod
     def decode(data: bytes) -> "Value":
-        pos = 0
-        merge_flags = 0
-        ttl_ms = None
-        if pos < len(data) and data[pos] == ValueType.kMergeFlags:
-            (merge_flags,) = struct.unpack_from(">I", data, pos + 1)
-            pos += 5
-        if pos < len(data) and data[pos] == ValueType.kTTL:
-            (ttl_ms,) = struct.unpack_from(">q", data, pos + 1)
-            pos += 9
+        merge_flags, ttl_ms, pos = decode_control_fields(data)
         if pos >= len(data):
             raise ValueError("empty value payload")
         tag = data[pos]

@@ -34,6 +34,7 @@ class ValueType(enum.IntEnum):
     kFloat = ord("C")           # 67
     kString = ord("S")          # 83
     kTrue = ord("T")            # 84
+    kBinary = ord("Y")          # 89: raw-bytes component (type-stable vs kString)
     kTombstone = ord("X")       # 88
     kArrayIndex = ord("[")      # 91
     kObject = ord("{")          # 123: subdocument container value

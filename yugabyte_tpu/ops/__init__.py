@@ -1,0 +1,2 @@
+from yugabyte_tpu.ops.slabs import KVSlab, pack_kvs, unpack_keys
+from yugabyte_tpu.ops.merge_gc import merge_and_gc_device, GCParams

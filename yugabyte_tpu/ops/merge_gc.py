@@ -1,0 +1,201 @@
+"""TPU merge + MVCC-GC kernel: the north-star compaction hot path.
+
+Replaces the reference's three sequential hot loops (SURVEY.md section 3.4):
+ 1. k-way MergingIterator min-heap merge   (ref: rocksdb/table/merger.cc:51)
+ 2. CompactionIterator seqno/version dedup (ref: rocksdb/db/compaction_iterator.cc:97)
+ 3. DocDBCompactionFilter MVCC GC          (ref: docdb/docdb_compaction_filter.cc:74-320)
+
+with ONE fused data-parallel program:
+ - merge: multi-operand `lax.sort` over (key words, key_len, ~ht, ~write_id)
+   — sorted-run union via a single large sort that XLA tiles efficiently,
+   instead of a pointer-chasing heap. Keys sort in exact memcmp order
+   (see ops/slabs.py).
+ - version GC: segmented prefix ops. Within each full-key segment (versions
+   sorted HT-descending), every version with ht > history_cutoff is retained
+   history; among versions with ht <= cutoff only the FIRST (the version
+   visible at the cutoff) survives — the overwrite rule of
+   docdb_compaction_filter.cc:166.
+ - subtree overwrite: a root-level (DocKey, no subkeys) write at ht_r <=
+   cutoff overwrites every deeper entry with ht <= ht_r (the overwrite-stack
+   truncation of docdb_compaction_filter.cc:104-123, restricted to depth-2
+   documents: row + column entries, which covers the relational data model;
+   deeper docs take the CPU semantic path).
+ - TTL expiry: entries whose (write_time + ttl) <= cutoff become tombstones,
+   dropped entirely at major compactions (docdb_compaction_filter.cc:260-279).
+ - tombstone GC: visible-at-cutoff tombstones are dropped at major
+   compactions (docdb_compaction_filter.cc:316-319).
+
+All control flow is static; shapes are static per (N, W); no data-dependent
+Python inside jit. int64 is avoided (TPU-unfriendly): hybrid times travel as
+two uint32 limbs and TTL arithmetic is two-limb 20/32-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yugabyte_tpu.ops.slabs import (
+    FLAG_HAS_TTL, FLAG_OBJECT_INIT, FLAG_TOMBSTONE, KVSlab)
+
+
+@dataclass(frozen=True)
+class GCParams:
+    history_cutoff_ht: int      # HybridTime.value; versions above stay
+    is_major_compaction: bool   # bottommost level: tombstones can vanish
+    retain_deletes: bool = False  # e.g. during index backfill (ref :288)
+
+
+def _le_u64(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
+
+
+def _seg_propagate_last(vals, is_set, new_seg):
+    """Within segments (new_seg marks starts), propagate forward the most
+    recent tuple of values where is_set, else zeros.
+
+    Monoid of functions f(x) = v if has else (bottom if blocked else x);
+    composition is associative, so lax.associative_scan applies.
+    """
+    def combine(a, b):
+        *a_vals, a_set, a_bound = a
+        *b_vals, b_set, b_bound = b
+        out_vals = tuple(
+            jnp.where(b_set, bv, jnp.where(b_bound, jnp.zeros_like(av), av))
+            for av, bv in zip(a_vals, b_vals))
+        out_set = b_set | (a_set & ~b_bound)
+        out_bound = a_bound | b_bound
+        return (*out_vals, out_set, out_bound)
+
+    init = tuple(jnp.where(is_set, v, 0) for v in vals) + (is_set, new_seg)
+    res = jax.lax.associative_scan(combine, init)
+    return res[: len(vals)]
+
+
+@functools.partial(jax.jit, static_argnames=("is_major", "retain_deletes"))
+def _merge_gc_impl(key_words, key_len, doc_key_len, ht_hi, ht_lo, write_id,
+                   flags, ttl_hi, ttl_lo, idx,
+                   cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+                   is_major: bool, retain_deletes: bool):
+    n, w = key_words.shape
+    u32max = jnp.uint32(0xFFFFFFFF)
+
+    # ---- 1. the merge: one big lexicographic sort -------------------------
+    operands = [key_words[:, j] for j in range(w)]
+    operands += [key_len.astype(jnp.int32), ht_hi ^ u32max, ht_lo ^ u32max,
+                 write_id ^ u32max, idx.astype(jnp.int32)]
+    sorted_ops = jax.lax.sort(operands, num_keys=len(operands))
+    s_words = jnp.stack(sorted_ops[:w], axis=1)
+    s_len = sorted_ops[w]
+    perm = sorted_ops[w + 4]
+    s_ht_hi = sorted_ops[w + 1] ^ u32max
+    s_ht_lo = sorted_ops[w + 2] ^ u32max
+    s_wid = sorted_ops[w + 3] ^ u32max
+    s_dkl = doc_key_len[perm]
+    s_flags = flags[perm]
+    s_ttl_hi = ttl_hi[perm]
+    s_ttl_lo = ttl_lo[perm]
+
+    # ---- 2. segment structure --------------------------------------------
+    prev_words = jnp.concatenate([jnp.zeros((1, w), s_words.dtype), s_words[:-1]], axis=0)
+    prev_len = jnp.concatenate([jnp.full((1,), -1, s_len.dtype), s_len[:-1]])
+    same_key = jnp.all(s_words == prev_words, axis=1) & (s_len == prev_len)
+    same_key = same_key.at[0].set(False)
+    new_seg = ~same_key
+
+    # doc segments: equality of the DocKey prefix (masked word compare)
+    word_idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    nbytes = jnp.clip(s_dkl[:, None] - word_idx * 4, 0, 4)
+    mask = jnp.where(nbytes >= 4, u32max,
+                     jnp.where(nbytes == 0, jnp.uint32(0),
+                               (u32max << ((4 - nbytes).astype(jnp.uint32) * 8)) & u32max))
+    doc_words = s_words & mask
+    prev_doc_words = jnp.concatenate([jnp.zeros((1, w), s_words.dtype), doc_words[:-1]], axis=0)
+    prev_dkl = jnp.concatenate([jnp.full((1,), -1, s_dkl.dtype), s_dkl[:-1]])
+    same_doc = jnp.all(doc_words == prev_doc_words, axis=1) & (s_dkl == prev_dkl)
+    same_doc = same_doc.at[0].set(False)
+    new_doc = ~same_doc
+
+    # ---- 3. version visibility within full-key segments -------------------
+    c = _le_u64(s_ht_hi, s_ht_lo, cutoff_hi, cutoff_lo)  # at-or-below history cutoff
+    c_i = c.astype(jnp.int32)
+    total = jnp.cumsum(c_i)
+    base = jax.lax.cummax(jnp.where(new_seg, total - c_i, 0))
+    within_c = total - base                      # rank among <=cutoff versions in segment
+    visible_slot = c & (within_c == 1)           # the version readable at cutoff
+    keep_version = ~c | visible_slot
+
+    # ---- 4. TTL expiry (two-limb add/compare; phys time = ht >> 12) -------
+    has_ttl = (s_flags & FLAG_HAS_TTL) != 0
+    phys_hi = s_ht_hi                            # bits 20..51 of phys micros
+    phys_lo = (s_ht_lo >> 12)                    # low 20 bits
+    sum_lo = phys_lo + s_ttl_lo
+    carry = sum_lo >> 20
+    sum_hi = phys_hi + s_ttl_hi + carry
+    sum_lo = sum_lo & jnp.uint32(0xFFFFF)
+    expired = has_ttl & ((sum_hi < cutoff_phys_hi) |
+                         ((sum_hi == cutoff_phys_hi) & (sum_lo <= cutoff_phys_lo)))
+    is_tomb = ((s_flags & FLAG_TOMBSTONE) != 0) | (expired & c)
+
+    # ---- 5. root-subtree overwrite ---------------------------------------
+    # Compare FULL DocHybridTime (ht, write_id): columns written in the same
+    # batch as a row init marker share its HT but have larger write_ids, and
+    # must NOT count as overwritten.
+    is_root = s_len == s_dkl
+    ov_flag = is_root & visible_slot
+    ov_hi, ov_lo, ov_wid = _seg_propagate_last(
+        (s_ht_hi, s_ht_lo, s_wid), ov_flag, new_doc)
+    has_ov = (ov_hi != 0) | (ov_lo != 0)
+    dht_le = (s_ht_hi < ov_hi) | ((s_ht_hi == ov_hi) & (
+        (s_ht_lo < ov_lo) | ((s_ht_lo == ov_lo) & (s_wid <= ov_wid))))
+    covered = (~is_root) & has_ov & dht_le
+
+    # ---- 6. tombstone GC at major compactions ----------------------------
+    drop_tomb = (visible_slot & is_tomb & jnp.bool_(is_major)
+                 & jnp.bool_(not retain_deletes))
+
+    keep = keep_version & ~covered & ~drop_tomb
+    already_tomb = (s_flags & FLAG_TOMBSTONE) != 0
+    make_tombstone = expired & keep & c & ~already_tomb & jnp.bool_(not is_major)
+    return perm, keep, make_tombstone
+
+
+def merge_and_gc_device(slab: KVSlab, params: GCParams, device=None
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Run the fused merge+GC program on `device` (default: JAX default device).
+
+    Returns (perm, keep, make_tombstone) as host numpy arrays:
+      perm[i]  = input index of the i-th entry in merged order
+      keep[i]  = survives compaction
+      make_tombstone[i] = value must be rewritten as a tombstone (TTL expiry
+                          at a non-major compaction)
+    """
+    if slab.n == 0:
+        empty_i = np.zeros(0, dtype=np.int32)
+        empty_b = np.zeros(0, dtype=bool)
+        return empty_i, empty_b, empty_b
+    cutoff = params.history_cutoff_ht
+    cutoff_phys = cutoff >> 12
+    ttl_us = slab.ttl_ms * 1000
+    args = (
+        jnp.asarray(slab.key_words), jnp.asarray(slab.key_len),
+        jnp.asarray(slab.doc_key_len),
+        jnp.asarray(slab.ht_hi), jnp.asarray(slab.ht_lo),
+        jnp.asarray(slab.write_id),
+        jnp.asarray(slab.flags),
+        jnp.asarray((ttl_us >> 20).astype(np.uint32)),
+        jnp.asarray((ttl_us & 0xFFFFF).astype(np.uint32)),
+        jnp.arange(slab.n, dtype=jnp.int32),
+        jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
+        jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
+    )
+    if device is not None:
+        args = jax.device_put(args, device)
+    perm, keep, mk = _merge_gc_impl(*args, is_major=params.is_major_compaction,
+                                    retain_deletes=params.retain_deletes)
+    return np.asarray(perm), np.asarray(keep), np.asarray(mk)

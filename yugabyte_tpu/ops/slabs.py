@@ -1,0 +1,194 @@
+"""KV slabs: the TPU-native columnar representation of sorted-run entries.
+
+This is the central TPU-first design decision of the storage engine
+(SURVEY.md section 7 stage 4): instead of the reference's delta-encoded,
+byte-granular SST entries (ref: src/yb/rocksdb/table/block_builder.cc), a
+batch of KV entries is a structure-of-arrays "slab":
+
+  key_words : uint32[N, W]  big-endian words of the key prefix (no HT suffix),
+                            zero-padded to W*4 bytes. Because DocDB key
+                            encoding is order-preserving bytewise
+                            (docdb/doc_key.py), lexicographic order over
+                            (key_words, key_len) == memcmp order over keys.
+  key_len   : int32[N]      true byte length of the key prefix
+  doc_key_len: int32[N]     byte length of the embedded DocKey (root prefix)
+  ht_hi/ht_lo: uint32[N]    DocHybridTime.ht split into high/low words
+  write_id  : uint32[N]
+  flags     : uint32[N]     bit0 tombstone, bit1 object-init, bit2 has-TTL
+  ttl_ms    : int64[N]      TTL in ms (0 = none)
+  value_idx : int32[N]      index into the out-of-band value array
+
+Values stay out-of-band (host memory / HBM byte buffer) because merge + GC
+only permute and drop entries — value bytes move once, at output-write time.
+
+Sorting a slab by (key_words..., key_len, ht_hi_desc, ht_lo_desc,
+write_id_desc) reproduces exactly the reference's internal key order:
+user key ascending, hybrid time descending (ref:
+src/yb/rocksdb/db/dbformat.h internal key ordering + descending HT suffix,
+common/doc_hybrid_time.cc:50).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+from yugabyte_tpu.docdb.value import decode_control_fields
+from yugabyte_tpu.docdb.value_type import ValueType
+
+FLAG_TOMBSTONE = 1
+FLAG_OBJECT_INIT = 2
+FLAG_HAS_TTL = 4
+
+
+@dataclass
+class KVSlab:
+    key_words: np.ndarray   # uint32 [N, W]
+    key_len: np.ndarray     # int32  [N]
+    doc_key_len: np.ndarray  # int32 [N]
+    ht_hi: np.ndarray       # uint32 [N]
+    ht_lo: np.ndarray       # uint32 [N]
+    write_id: np.ndarray    # uint32 [N]
+    flags: np.ndarray       # uint32 [N]
+    ttl_ms: np.ndarray      # int64  [N]
+    value_idx: np.ndarray   # int32  [N]
+    values: List[bytes]     # out-of-band value payloads (indexed by value_idx)
+
+    @property
+    def n(self) -> int:
+        return int(self.key_len.shape[0])
+
+    @property
+    def width_words(self) -> int:
+        return int(self.key_words.shape[1])
+
+    def key_bytes(self, i: int) -> bytes:
+        return self.key_words[i].astype(">u4").tobytes()[: int(self.key_len[i])]
+
+    def doc_ht(self, i: int) -> DocHybridTime:
+        ht = (int(self.ht_hi[i]) << 32) | int(self.ht_lo[i])
+        return DocHybridTime(HybridTime(ht), int(self.write_id[i]))
+
+
+def _pad_keys_to_words(keys: Sequence[bytes], width_words: Optional[int] = None
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized pack of variable-length key bytes into a zero-padded u32 word
+    matrix. Avoids per-key Python in the inner loop (single-core host)."""
+    n = len(keys)
+    lens = np.fromiter((len(k) for k in keys), dtype=np.int64, count=n)
+    w = width_words if width_words is not None else max(1, int(-(-int(lens.max(initial=1)) // 4)))
+    stride = w * 4
+    if lens.max(initial=0) > stride:
+        raise ValueError(f"key longer than slab stride {stride}")
+    out = np.zeros((n, stride), dtype=np.uint8)
+    flat = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    starts = np.concatenate(([0], np.cumsum(lens)))[:-1]  # works for n == 0 too
+    # target flat positions: row*stride + offset-within-key
+    within = np.arange(lens.sum(), dtype=np.int64) - np.repeat(starts, lens)
+    rows = np.repeat(np.arange(n, dtype=np.int64), lens)
+    out.reshape(-1)[rows * stride + within] = flat
+    words = out.reshape(n, w, 4)
+    words = (words[:, :, 0].astype(np.uint32) << 24) | (words[:, :, 1].astype(np.uint32) << 16) \
+        | (words[:, :, 2].astype(np.uint32) << 8) | words[:, :, 3].astype(np.uint32)
+    return words, lens.astype(np.int32)
+
+
+def pack_kvs(entries: Sequence[Tuple[bytes, int, bytes]],
+             doc_key_lens: Optional[Sequence[int]] = None,
+             width_words: Optional[int] = None) -> KVSlab:
+    """Build a slab from (key_prefix_bytes, packed_doc_ht, value_bytes) triples.
+
+    packed_doc_ht = (ht.value << 32) | write_id as a 96-bit concept; we pass
+    (ht_value, write_id) packed as a single int for convenience:
+    int = ht_value * 2^32 + write_id.
+    """
+    n = len(entries)
+    keys = [e[0] for e in entries]
+    key_words, key_len = _pad_keys_to_words(keys, width_words)
+    ht_hi = np.empty(n, dtype=np.uint32)
+    ht_lo = np.empty(n, dtype=np.uint32)
+    write_id = np.empty(n, dtype=np.uint32)
+    flags = np.zeros(n, dtype=np.uint32)
+    ttl_ms = np.zeros(n, dtype=np.int64)
+    value_idx = np.arange(n, dtype=np.int32)
+    values: List[bytes] = []
+    for i, (_, packed, val) in enumerate(entries):
+        wid = packed & 0xFFFFFFFF
+        ht = packed >> 32
+        ht_hi[i] = ht >> 32
+        ht_lo[i] = ht & 0xFFFFFFFF
+        write_id[i] = wid
+        mf, ttl, off = decode_control_fields(val)
+        tag = val[off]
+        if tag == ValueType.kTombstone:
+            flags[i] |= FLAG_TOMBSTONE
+        elif tag == ValueType.kObject:
+            flags[i] |= FLAG_OBJECT_INIT
+        if ttl is not None:
+            flags[i] |= FLAG_HAS_TTL
+            ttl_ms[i] = ttl
+        values.append(val)
+    if doc_key_lens is None:
+        dkl = np.array([_doc_key_len(k) for k in keys], dtype=np.int32)
+    else:
+        dkl = np.asarray(doc_key_lens, dtype=np.int32)
+    return KVSlab(key_words, key_len, dkl, ht_hi, ht_lo, write_id, flags,
+                  ttl_ms, value_idx, values)
+
+
+def _doc_key_len(key_prefix: bytes) -> int:
+    """Byte length of the DocKey portion (through the range-group kGroupEnd).
+
+    Scans tag-structure: skips the hashed group's kGroupEnd if a hash prefix
+    is present, then finds the range group's terminator. kGroupEnd bytes
+    cannot appear inside components: every component encoding either escapes
+    low bytes (strings escape only 0x00 — but '!' is 0x21; however string
+    *content* can contain 0x21!). So we must parse, not scan.
+    """
+    from yugabyte_tpu.docdb.doc_key import DocKey
+    _, pos = DocKey.decode(key_prefix, 0)
+    return pos
+
+
+def pack_doc_ht(dht: DocHybridTime) -> int:
+    return (dht.ht.value << 32) | dht.write_id
+
+
+def unpack_keys(slab: KVSlab) -> List[bytes]:
+    """Materialize key byte strings from a slab (host-side, for SST writing)."""
+    raw = slab.key_words.astype(">u4").tobytes()
+    stride = slab.width_words * 4
+    return [raw[i * stride: i * stride + int(slab.key_len[i])] for i in range(slab.n)]
+
+
+def concat_slabs(slabs: Sequence[KVSlab]) -> KVSlab:
+    """Concatenate runs into one slab (inputs keep their own value arrays)."""
+    w = max(s.width_words for s in slabs)
+    parts_words = []
+    value_offsets = []
+    values: List[bytes] = []
+    off = 0
+    for s in slabs:
+        kw = s.key_words
+        if s.width_words < w:
+            kw = np.pad(kw, ((0, 0), (0, w - s.width_words)))
+        parts_words.append(kw)
+        value_offsets.append(off)
+        values.extend(s.values)
+        off += len(s.values)
+    return KVSlab(
+        key_words=np.concatenate(parts_words, axis=0),
+        key_len=np.concatenate([s.key_len for s in slabs]),
+        doc_key_len=np.concatenate([s.doc_key_len for s in slabs]),
+        ht_hi=np.concatenate([s.ht_hi for s in slabs]),
+        ht_lo=np.concatenate([s.ht_lo for s in slabs]),
+        write_id=np.concatenate([s.write_id for s in slabs]),
+        flags=np.concatenate([s.flags for s in slabs]),
+        ttl_ms=np.concatenate([s.ttl_ms for s in slabs]),
+        value_idx=np.concatenate(
+            [s.value_idx + o for s, o in zip(slabs, value_offsets)]).astype(np.int32),
+        values=values,
+    )

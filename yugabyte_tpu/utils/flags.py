@@ -53,6 +53,9 @@ def define_flag(name: str, default: Any, help: str = "", tags: List[FlagTag] = (
         env = os.environ.get(f"YBTPU_{name.upper()}")
         if env is not None:
             value = _parse(env, type(default))
+            if validator and not validator(value):
+                raise ValueError(
+                    f"invalid env value for flag {name}: YBTPU_{name.upper()}={env!r}")
         _REGISTRY[name] = _Flag(name, default, help, type(default), list(tags), value, validator)
 
 

@@ -146,11 +146,20 @@ class MetricRegistry:
                 self._entities[key] = MetricEntity(entity_type, entity_id, attributes)
             return self._entities[key]
 
+    def _snapshot(self):
+        with self._lock:
+            ents = list(self._entities.values())
+        out = []
+        for ent in ents:
+            with ent._lock:
+                out.append((ent, list(ent._metrics.values())))
+        return out
+
     def to_json(self) -> str:
         out = []
-        for ent in self._entities.values():
+        for ent, ent_metrics in self._snapshot():
             metrics = []
-            for m in ent._metrics.values():
+            for m in ent_metrics:
                 if isinstance(m, Histogram):
                     metrics.append({
                         "name": m.name, "total_count": m.count(), "mean": m.mean(),
@@ -165,11 +174,11 @@ class MetricRegistry:
     def to_prometheus(self) -> str:
         """Prometheus text exposition (ref: metrics.h WriteForPrometheus :449-518)."""
         lines: List[str] = []
-        for ent in self._entities.values():
+        for ent, ent_metrics in self._snapshot():
             labels = {"metric_type": ent.entity_type, "metric_id": ent.entity_id}
             labels.update(ent.attributes)
             label_str = ",".join(f'{k}="{v}"' for k, v in labels.items())
-            for m in ent._metrics.values():
+            for m in ent_metrics:
                 if isinstance(m, Histogram):
                     lines.append(f"{m.name}_count{{{label_str}}} {m.count()}")
                     lines.append(f"{m.name}_sum{{{label_str}}} {m._total_sum}")
