@@ -1,0 +1,265 @@
+"""LSM storage engine tests: blocks, SSTs, memtable, DB, compaction, recovery.
+
+Modeled on the reference's rocksdb/db/db_test.cc + compaction_job_test.cc
+tiers (SURVEY.md section 4).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+from yugabyte_tpu.docdb.doc_key import DocKey, SubDocKey
+from yugabyte_tpu.docdb.value import Value
+from yugabyte_tpu.ops.slabs import pack_doc_ht, pack_kvs
+from yugabyte_tpu.storage import block_format
+from yugabyte_tpu.storage.bloom import BloomFilter, BloomFilterBuilder, fnv64_masked
+from yugabyte_tpu.storage.db import DB, DBOptions
+from yugabyte_tpu.storage.memtable import MemTable
+from yugabyte_tpu.storage.sst import Frontier, SSTReader, SSTWriter, data_file_name
+
+
+def ht(us, w=0):
+    return DocHybridTime(HybridTime.from_micros(us), w)
+
+
+def key_for(row, col=None):
+    dk = DocKey(range_components=(f"row{row:05d}",))
+    if col is None:
+        return dk.encode()
+    return SubDocKey(dk, (("col", col),)).encode(include_ht=False)
+
+
+def make_slab(n_rows, t0=100):
+    entries = []
+    for r in range(n_rows):
+        entries.append((key_for(r), pack_doc_ht(ht(t0 + r)),
+                        Value(primitive=f"val{r}").encode()))
+    entries.sort(key=lambda e: (e[0], -e[1]))
+    return pack_kvs(entries)
+
+
+class TestBlockFormat:
+    def test_roundtrip(self):
+        slab = make_slab(100)
+        blk = block_format.encode_block(slab, 10, 60)
+        out = block_format.decode_block(blk)
+        assert out.n == 50
+        assert out.key_bytes(0) == slab.key_bytes(10)
+        assert out.values[0] == slab.values[int(slab.value_idx[10])]
+        np.testing.assert_array_equal(out.ht_lo, slab.ht_lo[10:60])
+
+    def test_compression(self):
+        slab = make_slab(200)
+        raw = block_format.encode_block(slab, 0, 200, compress=False)
+        comp = block_format.encode_block(slab, 0, 200, compress=True)
+        assert len(comp) < len(raw)
+        assert block_format.decode_block(comp).values == block_format.decode_block(raw).values
+
+    def test_corruption_detected(self):
+        slab = make_slab(10)
+        blk = bytearray(block_format.encode_block(slab, 0, 10))
+        blk[40] ^= 0xFF
+        from yugabyte_tpu.utils.status import StatusError
+        with pytest.raises(StatusError):
+            block_format.decode_block(bytes(blk))
+
+
+class TestBloom:
+    def test_no_false_negatives(self):
+        keys = [key_for(i) for i in range(1000)]
+        arrs = np.zeros((1000, 64), dtype=np.uint8)
+        lens = np.zeros(1000, dtype=np.int64)
+        for i, k in enumerate(keys):
+            arrs[i, :len(k)] = np.frombuffer(k, dtype=np.uint8)
+            lens[i] = len(k)
+        b = BloomFilterBuilder(1000)
+        b.add_hashes(fnv64_masked(arrs, lens))
+        f = BloomFilter(b.finish())
+        for k in keys:
+            assert f.may_contain(k)
+
+    def test_low_false_positive_rate(self):
+        keys = [key_for(i) for i in range(1000)]
+        b = BloomFilterBuilder(1000)
+        arrs = np.zeros((1000, 64), dtype=np.uint8)
+        lens = np.zeros(1000, dtype=np.int64)
+        for i, k in enumerate(keys):
+            arrs[i, :len(k)] = np.frombuffer(k, dtype=np.uint8)
+            lens[i] = len(k)
+        b.add_hashes(fnv64_masked(arrs, lens))
+        f = BloomFilter(b.finish())
+        fp = sum(f.may_contain(key_for(i)) for i in range(5000, 7000))
+        assert fp < 2000 * 0.05  # ~1% expected at 10 bits/key
+
+
+class TestSST:
+    def test_write_read_roundtrip(self, tmp_path):
+        slab = make_slab(10_000)
+        path = str(tmp_path / "000001.sst")
+        props = SSTWriter(path, block_entries=512).write(
+            slab, Frontier(op_id_max=(1, 42), ht_max=123))
+        assert os.path.exists(path) and os.path.exists(data_file_name(path))
+        r = SSTReader(path)
+        assert r.props.n_entries == 10_000
+        assert r.props.frontier.op_id_max == (1, 42)
+        assert r.n_blocks == 10_000 // 512 + 1
+        got = list(r.iter_entries())
+        assert len(got) == 10_000
+        assert got[0][0] == slab.key_bytes(0)
+        assert got[-1][2] == slab.values[int(slab.value_idx[slab.n - 1])]
+        r.close()
+
+    def test_seek_block(self, tmp_path):
+        slab = make_slab(5000)
+        path = str(tmp_path / "000001.sst")
+        SSTWriter(path, block_entries=100).write(slab)
+        r = SSTReader(path)
+        target = slab.key_bytes(2345)
+        b = r.seek_block(target)
+        blk = r.read_block(b)
+        keys = [blk.key_bytes(i) for i in range(blk.n)]
+        assert keys[0] <= target <= keys[-1]
+        r.close()
+
+    def test_bloom_on_reader(self, tmp_path):
+        slab = make_slab(500)
+        path = str(tmp_path / "000001.sst")
+        SSTWriter(path).write(slab)
+        r = SSTReader(path)
+        assert r.may_contain_doc(key_for(42))
+        missing = sum(r.may_contain_doc(key_for(i)) for i in range(1000, 2000))
+        assert missing < 50
+        r.close()
+
+
+class TestMemTable:
+    def test_sorted_iteration(self):
+        m = MemTable()
+        rng = random.Random(7)
+        rows = list(range(50)) * 2
+        rng.shuffle(rows)
+        for i, r in enumerate(rows):
+            m.add(key_for(r), ht(100 + i), Value(primitive=i).encode())
+        out = [k for k, _ in m.iter_from()]
+        assert out == sorted(out)
+        assert m.n_entries == 100
+
+    def test_to_slab_sorted(self):
+        m = MemTable()
+        for r in [5, 1, 3]:
+            m.add(key_for(r), ht(100), Value(primitive=r).encode())
+        slab = m.to_slab()
+        keys = [slab.key_bytes(i) for i in range(slab.n)]
+        assert keys == sorted(keys)
+
+
+class TestDB:
+    def _mk_db(self, tmp_path, **kw):
+        opts = DBOptions(block_entries=128, auto_compact=False, **kw)
+        return DB(str(tmp_path / "db"), opts)
+
+    def test_put_get(self, tmp_path):
+        db = self._mk_db(tmp_path)
+        db.write_batch([(key_for(1), ht(100), Value(primitive="a").encode())])
+        db.write_batch([(key_for(1), ht(200), Value(primitive="b").encode())])
+        dht, val = db.get(key_for(1))
+        assert Value.decode(val).primitive == "b"
+        # read at earlier time sees earlier version (MVCC)
+        dht, val = db.get(key_for(1), HybridTime.from_micros(150))
+        assert Value.decode(val).primitive == "a"
+        assert db.get(key_for(2)) is None
+        db.close()
+
+    def test_get_after_flush(self, tmp_path):
+        db = self._mk_db(tmp_path)
+        for r in range(300):
+            db.write_batch([(key_for(r), ht(100 + r), Value(primitive=r).encode())])
+        db.flush()
+        assert db.n_live_files == 1
+        dht, val = db.get(key_for(250))
+        assert Value.decode(val).primitive == 250
+        db.close()
+
+    def test_recovery_from_manifest(self, tmp_path):
+        db = self._mk_db(tmp_path)
+        for r in range(100):
+            db.write_batch([(key_for(r), ht(100 + r), Value(primitive=r).encode())])
+        db.flush()
+        for r in range(100, 150):
+            db.write_batch([(key_for(r), ht(100 + r), Value(primitive=r).encode())])
+        db.flush()
+        db.close()
+        db2 = self._mk_db(tmp_path)
+        assert db2.n_live_files == 2
+        assert Value.decode(db2.get(key_for(120))[1]).primitive == 120
+        db2.close()
+
+    def test_compaction_merges_files(self, tmp_path):
+        db = self._mk_db(tmp_path, retention_policy=lambda: HybridTime.from_micros(10**9).value)
+        for gen in range(4):
+            for r in range(50):
+                db.write_batch([(key_for(r), ht(1000 * (gen + 1) + r),
+                                 Value(primitive=f"g{gen}r{r}").encode())])
+            db.flush()
+        assert db.n_live_files == 4
+        db.compact_all()
+        assert db.n_live_files == 1
+        # only newest versions survive (cutoff far in future, major compaction)
+        dht, val = db.get(key_for(10))
+        assert Value.decode(val).primitive == "g3r10"
+        total = sum(1 for _ in db.iter_from())
+        assert total == 50
+        db.close()
+
+    def test_tombstones_gone_after_major(self, tmp_path):
+        db = self._mk_db(tmp_path, retention_policy=lambda: HybridTime.kMax.value)
+        db.write_batch([(key_for(1), ht(100), Value(primitive="x").encode())])
+        db.flush()
+        db.write_batch([(key_for(1), ht(200), Value.tombstone().encode())])
+        db.flush()
+        db.compact_all()
+        assert db.get(key_for(1)) is None
+        assert sum(1 for _ in db.iter_from()) == 0
+        db.close()
+
+    def test_history_retention(self, tmp_path):
+        """Versions above history cutoff survive compaction (MVCC reads work)."""
+        db = self._mk_db(tmp_path, retention_policy=lambda: HybridTime.from_micros(150).value)
+        db.write_batch([(key_for(1), ht(100), Value(primitive="old").encode())])
+        db.flush()
+        db.write_batch([(key_for(1), ht(200), Value(primitive="new").encode())])
+        db.flush()
+        db.compact_all()
+        # both survive: 200 is above cutoff; 100 is the visible-at-cutoff version
+        assert sum(1 for _ in db.iter_from()) == 2
+        _, val = db.get(key_for(1), HybridTime.from_micros(120))
+        assert Value.decode(val).primitive == "old"
+        db.close()
+
+    def test_checkpoint(self, tmp_path):
+        db = self._mk_db(tmp_path)
+        for r in range(100):
+            db.write_batch([(key_for(r), ht(100), Value(primitive=r).encode())])
+        db.flush()
+        ckpt = str(tmp_path / "ckpt")
+        db.checkpoint(ckpt)
+        db.close()
+        db2 = DB(ckpt, DBOptions(auto_compact=False))
+        assert Value.decode(db2.get(key_for(50))[1]).primitive == 50
+        db2.close()
+
+    def test_auto_compaction_trigger(self, tmp_path):
+        opts = DBOptions(block_entries=128, auto_compact=True,
+                         retention_policy=lambda: HybridTime.kMax.value)
+        db = DB(str(tmp_path / "db"), opts)
+        for gen in range(5):
+            for r in range(30):
+                db.write_batch([(key_for(r), ht(1000 * (gen + 1)),
+                                 Value(primitive=gen).encode())])
+            db.flush()
+        # trigger is 4 runs; auto compaction should have fired synchronously
+        assert db.n_live_files < 5
+        db.close()

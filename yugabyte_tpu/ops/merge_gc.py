@@ -5,29 +5,38 @@ Replaces the reference's three sequential hot loops (SURVEY.md section 3.4):
  2. CompactionIterator seqno/version dedup (ref: rocksdb/db/compaction_iterator.cc:97)
  3. DocDBCompactionFilter MVCC GC          (ref: docdb/docdb_compaction_filter.cc:74-320)
 
-with ONE fused data-parallel program:
- - merge: multi-operand `lax.sort` over (key words, key_len, ~ht, ~write_id)
-   — sorted-run union via a single large sort that XLA tiles efficiently,
-   instead of a pointer-chasing heap. Keys sort in exact memcmp order
-   (see ops/slabs.py).
- - version GC: segmented prefix ops. Within each full-key segment (versions
-   sorted HT-descending), every version with ht > history_cutoff is retained
-   history; among versions with ht <= cutoff only the FIRST (the version
-   visible at the cutoff) survives — the overwrite rule of
-   docdb_compaction_filter.cc:166.
- - subtree overwrite: a root-level (DocKey, no subkeys) write at ht_r <=
-   cutoff overwrites every deeper entry with ht <= ht_r (the overwrite-stack
-   truncation of docdb_compaction_filter.cc:104-123, restricted to depth-2
-   documents: row + column entries, which covers the relational data model;
-   deeper docs take the CPU semantic path).
- - TTL expiry: entries whose (write_time + ttl) <= cutoff become tombstones,
-   dropped entirely at major compactions (docdb_compaction_filter.cc:260-279).
- - tombstone GC: visible-at-cutoff tombstones are dropped at major
-   compactions (docdb_compaction_filter.cc:316-319).
+with ONE fused device program per call:
 
-All control flow is static; shapes are static per (N, W); no data-dependent
-Python inside jit. int64 is avoided (TPU-unfriendly): hybrid times travel as
-two uint32 limbs and TTL arithmetic is two-limb 20/32-bit.
+ - merge: LSD radix sort over key columns — a `lax.fori_loop` whose body is a
+   single 2-operand STABLE `lax.sort` pass over a dynamically-selected column.
+   One sort op in the HLO (fast compile; a W+5-operand lexicographic sort
+   costs minutes of XLA compile on TPU), one device dispatch total (the axon
+   transport charges ~25ms per dispatch). Keys sort in exact memcmp order
+   (see ops/slabs.py).
+ - version GC: segmented prefix ops (cumsum/cummax). Within each full-key
+   segment (versions sorted HT-descending), every version with
+   ht > history_cutoff is retained history; among versions <= cutoff only the
+   FIRST (visible at cutoff) survives (docdb_compaction_filter.cc:166).
+ - subtree overwrite: a root-level (DocKey, no subkeys) write visible at the
+   cutoff overwrites every deeper entry with DocHybridTime <= its own
+   (overwrite-stack truncation, docdb_compaction_filter.cc:104-123,
+   restricted to depth-2 documents: row + column entries; deeper docs take
+   the CPU semantic path). At most one such root version exists per doc
+   segment, so propagation is cummax over flagged positions + gathers.
+ - TTL expiry -> tombstone conversion / drop at major compactions
+   (docdb_compaction_filter.cc:260-279); visible tombstones dropped at major
+   compactions (:316-319).
+
+I/O is transfer-optimized for the tunnel-attached TPU: all inputs ship as ONE
+contiguous uint32 matrix `cols[R, n_pad]`; outputs are the permutation plus
+keep/make-tombstone as packed bitmasks. Shapes bucket to powers of two so XLA
+compiles once per bucket; the persistent compilation cache
+(utils/jax_setup.py) amortizes across processes. int64 is avoided: hybrid
+times travel as two uint32 limbs, TTL arithmetic is two-limb 20/32-bit.
+
+Fixed row layout of `cols` (rows R = 8 + W):
+    0 key_len | 1 doc_key_len | 2 ht_hi | 3 ht_lo | 4 write_id
+    5 entry_flags | 6 ttl_hi | 7 ttl_lo | 8.. key words 0..W-1
 """
 
 from __future__ import annotations
@@ -42,6 +51,10 @@ import numpy as np
 
 from yugabyte_tpu.ops.slabs import (
     FLAG_HAS_TTL, FLAG_OBJECT_INIT, FLAG_TOMBSTONE, KVSlab)
+from yugabyte_tpu.utils import jax_setup  # noqa: F401  (compilation cache)
+
+_ROW_KEY_LEN, _ROW_DKL, _ROW_HT_HI, _ROW_HT_LO, _ROW_WID = 0, 1, 2, 3, 4
+_ROW_FLAGS, _ROW_TTL_HI, _ROW_TTL_LO, _ROW_WORDS = 5, 6, 7, 8
 
 
 @dataclass(frozen=True)
@@ -55,147 +68,169 @@ def _le_u64(a_hi, a_lo, b_hi, b_lo):
     return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
 
 
-def _seg_propagate_last(vals, is_set, new_seg):
-    """Within segments (new_seg marks starts), propagate forward the most
-    recent tuple of values where is_set, else zeros.
-
-    Monoid of functions f(x) = v if has else (bottom if blocked else x);
-    composition is associative, so lax.associative_scan applies.
-    """
-    def combine(a, b):
-        *a_vals, a_set, a_bound = a
-        *b_vals, b_set, b_bound = b
-        out_vals = tuple(
-            jnp.where(b_set, bv, jnp.where(b_bound, jnp.zeros_like(av), av))
-            for av, bv in zip(a_vals, b_vals))
-        out_set = b_set | (a_set & ~b_bound)
-        out_bound = a_bound | b_bound
-        return (*out_vals, out_set, out_bound)
-
-    init = tuple(jnp.where(is_set, v, 0) for v in vals) + (is_set, new_seg)
-    res = jax.lax.associative_scan(combine, init)
-    return res[: len(vals)]
-
-
-@functools.partial(jax.jit, static_argnames=("is_major", "retain_deletes"))
-def _merge_gc_impl(key_words, key_len, doc_key_len, ht_hi, ht_lo, write_id,
-                   flags, ttl_hi, ttl_lo, idx,
-                   cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
-                   is_major: bool, retain_deletes: bool):
-    n, w = key_words.shape
+@functools.partial(jax.jit, static_argnames=("w", "is_major", "retain_deletes"))
+def _merge_gc_fused(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+                    w: int, is_major: bool, retain_deletes: bool):
+    n = cols.shape[1]
     u32max = jnp.uint32(0xFFFFFFFF)
 
-    # ---- 1. the merge: one big lexicographic sort -------------------------
-    operands = [key_words[:, j] for j in range(w)]
-    operands += [key_len.astype(jnp.int32), ht_hi ^ u32max, ht_lo ^ u32max,
-                 write_id ^ u32max, idx.astype(jnp.int32)]
-    sorted_ops = jax.lax.sort(operands, num_keys=len(operands))
-    s_words = jnp.stack(sorted_ops[:w], axis=1)
-    s_len = sorted_ops[w]
-    perm = sorted_ops[w + 4]
-    s_ht_hi = sorted_ops[w + 1] ^ u32max
-    s_ht_lo = sorted_ops[w + 2] ^ u32max
-    s_wid = sorted_ops[w + 3] ^ u32max
-    s_dkl = doc_key_len[perm]
-    s_flags = flags[perm]
-    s_ttl_hi = ttl_hi[perm]
-    s_ttl_lo = ttl_lo[perm]
+    # ---- merge: LSD radix passes, least-significant column first ----------
+    # sequence: wid desc, ht_lo desc, ht_hi desc, key_len asc, words W-1..0 asc
+    k_sort = 4 + w
+    sort_rows = jnp.asarray(
+        [_ROW_WID, _ROW_HT_LO, _ROW_HT_HI, _ROW_KEY_LEN]
+        + [_ROW_WORDS + j for j in range(w - 1, -1, -1)], dtype=jnp.int32)
+    inverts = jnp.asarray([u32max, u32max, u32max, 0] + [0] * w, dtype=jnp.uint32)
 
-    # ---- 2. segment structure --------------------------------------------
-    prev_words = jnp.concatenate([jnp.zeros((1, w), s_words.dtype), s_words[:-1]], axis=0)
+    def body(k, perm):
+        col = jax.lax.dynamic_index_in_dim(cols, sort_rows[k], axis=0,
+                                           keepdims=False) ^ inverts[k]
+        _, new_perm = jax.lax.sort([col[perm], perm], num_keys=1, is_stable=True)
+        return new_perm
+
+    perm = jax.lax.fori_loop(0, k_sort, body, jnp.arange(n, dtype=jnp.int32))
+
+    s = cols[:, perm]                        # gather all rows once
+    s_len = s[_ROW_KEY_LEN].astype(jnp.int32)
+    s_dkl = s[_ROW_DKL].astype(jnp.int32)
+    s_ht_hi, s_ht_lo, s_wid = s[_ROW_HT_HI], s[_ROW_HT_LO], s[_ROW_WID]
+    s_flags = s[_ROW_FLAGS]
+    s_ttl_hi, s_ttl_lo = s[_ROW_TTL_HI], s[_ROW_TTL_LO]
+    s_words = s[_ROW_WORDS:]                 # [w, n]
+
+    # ---- segment structure ------------------------------------------------
+    prev_words = jnp.concatenate([jnp.zeros((w, 1), s_words.dtype), s_words[:, :-1]], axis=1)
     prev_len = jnp.concatenate([jnp.full((1,), -1, s_len.dtype), s_len[:-1]])
-    same_key = jnp.all(s_words == prev_words, axis=1) & (s_len == prev_len)
-    same_key = same_key.at[0].set(False)
-    new_seg = ~same_key
+    same_key = jnp.all(s_words == prev_words, axis=0) & (s_len == prev_len)
+    new_seg = ~same_key.at[0].set(False)
 
-    # doc segments: equality of the DocKey prefix (masked word compare)
-    word_idx = jnp.arange(w, dtype=jnp.int32)[None, :]
-    nbytes = jnp.clip(s_dkl[:, None] - word_idx * 4, 0, 4)
+    word_idx = jnp.arange(w, dtype=jnp.int32)[:, None]
+    nbytes = jnp.clip(s_dkl[None, :] - word_idx * 4, 0, 4)
     mask = jnp.where(nbytes >= 4, u32max,
                      jnp.where(nbytes == 0, jnp.uint32(0),
                                (u32max << ((4 - nbytes).astype(jnp.uint32) * 8)) & u32max))
     doc_words = s_words & mask
-    prev_doc_words = jnp.concatenate([jnp.zeros((1, w), s_words.dtype), doc_words[:-1]], axis=0)
+    prev_doc_words = jnp.concatenate([jnp.zeros((w, 1), s_words.dtype), doc_words[:, :-1]], axis=1)
     prev_dkl = jnp.concatenate([jnp.full((1,), -1, s_dkl.dtype), s_dkl[:-1]])
-    same_doc = jnp.all(doc_words == prev_doc_words, axis=1) & (s_dkl == prev_dkl)
-    same_doc = same_doc.at[0].set(False)
-    new_doc = ~same_doc
+    same_doc = jnp.all(doc_words == prev_doc_words, axis=0) & (s_dkl == prev_dkl)
+    new_doc = ~same_doc.at[0].set(False)
+    doc_seg_id = jnp.cumsum(new_doc.astype(jnp.int32))
 
-    # ---- 3. version visibility within full-key segments -------------------
-    c = _le_u64(s_ht_hi, s_ht_lo, cutoff_hi, cutoff_lo)  # at-or-below history cutoff
+    # ---- version visibility within full-key segments ----------------------
+    c = _le_u64(s_ht_hi, s_ht_lo, cutoff_hi, cutoff_lo)
     c_i = c.astype(jnp.int32)
     total = jnp.cumsum(c_i)
     base = jax.lax.cummax(jnp.where(new_seg, total - c_i, 0))
-    within_c = total - base                      # rank among <=cutoff versions in segment
-    visible_slot = c & (within_c == 1)           # the version readable at cutoff
+    within_c = total - base
+    visible_slot = c & (within_c == 1)
     keep_version = ~c | visible_slot
 
-    # ---- 4. TTL expiry (two-limb add/compare; phys time = ht >> 12) -------
+    # ---- TTL expiry -------------------------------------------------------
     has_ttl = (s_flags & FLAG_HAS_TTL) != 0
-    phys_hi = s_ht_hi                            # bits 20..51 of phys micros
-    phys_lo = (s_ht_lo >> 12)                    # low 20 bits
-    sum_lo = phys_lo + s_ttl_lo
+    sum_lo = (s_ht_lo >> 12) + s_ttl_lo
     carry = sum_lo >> 20
-    sum_hi = phys_hi + s_ttl_hi + carry
+    sum_hi = s_ht_hi + s_ttl_hi + carry
     sum_lo = sum_lo & jnp.uint32(0xFFFFF)
     expired = has_ttl & ((sum_hi < cutoff_phys_hi) |
                          ((sum_hi == cutoff_phys_hi) & (sum_lo <= cutoff_phys_lo)))
-    is_tomb = ((s_flags & FLAG_TOMBSTONE) != 0) | (expired & c)
+    already_tomb = (s_flags & FLAG_TOMBSTONE) != 0
+    is_tomb = already_tomb | (expired & c)
 
-    # ---- 5. root-subtree overwrite ---------------------------------------
-    # Compare FULL DocHybridTime (ht, write_id): columns written in the same
-    # batch as a row init marker share its HT but have larger write_ids, and
-    # must NOT count as overwritten.
+    # ---- root-subtree overwrite ------------------------------------------
     is_root = s_len == s_dkl
     ov_flag = is_root & visible_slot
-    ov_hi, ov_lo, ov_wid = _seg_propagate_last(
-        (s_ht_hi, s_ht_lo, s_wid), ov_flag, new_doc)
-    has_ov = (ov_hi != 0) | (ov_lo != 0)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ov_pos = jax.lax.cummax(jnp.where(ov_flag, idx, -1))
+    safe_pos = jnp.maximum(ov_pos, 0)
+    in_same_doc = (ov_pos >= 0) & (doc_seg_id[safe_pos] == doc_seg_id)
+    ov_hi, ov_lo, ov_wid = s_ht_hi[safe_pos], s_ht_lo[safe_pos], s_wid[safe_pos]
     dht_le = (s_ht_hi < ov_hi) | ((s_ht_hi == ov_hi) & (
         (s_ht_lo < ov_lo) | ((s_ht_lo == ov_lo) & (s_wid <= ov_wid))))
-    covered = (~is_root) & has_ov & dht_le
+    covered = (~is_root) & in_same_doc & dht_le
 
-    # ---- 6. tombstone GC at major compactions ----------------------------
+    # ---- tombstone GC + result -------------------------------------------
     drop_tomb = (visible_slot & is_tomb & jnp.bool_(is_major)
                  & jnp.bool_(not retain_deletes))
-
     keep = keep_version & ~covered & ~drop_tomb
-    already_tomb = (s_flags & FLAG_TOMBSTONE) != 0
     make_tombstone = expired & keep & c & ~already_tomb & jnp.bool_(not is_major)
-    return perm, keep, make_tombstone
+
+    # pack masks 32 bits/word to shrink the (slow) device->host fetch
+    def pack_bits(b):
+        b32 = b.reshape(n // 32, 32).astype(jnp.uint32)
+        return (b32 << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+            axis=1, dtype=jnp.uint32)
+
+    return perm, pack_bits(keep), pack_bits(make_tombstone)
 
 
-def merge_and_gc_device(slab: KVSlab, params: GCParams, device=None
-                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Run the fused merge+GC program on `device` (default: JAX default device).
+def _unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(packed.view(np.uint8), bitorder="little")[:n].astype(bool)
 
-    Returns (perm, keep, make_tombstone) as host numpy arrays:
+
+def merge_and_gc_device(slab: KVSlab, params: GCParams, device=None,
+                        cols_override=None) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Run the fused merge+GC program on `device`.
+
+    Returns (perm, keep, make_tombstone) as host numpy arrays (padded length
+    n_pad; padding rows sort after all real rows and have keep=False):
       perm[i]  = input index of the i-th entry in merged order
       keep[i]  = survives compaction
       make_tombstone[i] = value must be rewritten as a tombstone (TTL expiry
                           at a non-major compaction)
+
+    cols_override: a pre-staged device cols matrix (device-resident slab
+    cache path) — skips the host pack + upload entirely.
     """
-    if slab.n == 0:
-        empty_i = np.zeros(0, dtype=np.int32)
-        empty_b = np.zeros(0, dtype=bool)
-        return empty_i, empty_b, empty_b
+    if slab.n == 0 and cols_override is None:
+        z = np.zeros(0, dtype=np.int32)
+        zb = np.zeros(0, dtype=bool)
+        return z, zb, zb
+    if cols_override is not None:
+        cols_dev = cols_override
+        n = slab.n
+        n_pad = cols_dev.shape[1]
+        w = cols_dev.shape[0] - _ROW_WORDS
+    else:
+        cols, n, n_pad, w = pack_cols(slab)
+        cols_dev = jax.device_put(cols, device) if device is not None else jnp.asarray(cols)
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
-    ttl_us = slab.ttl_ms * 1000
-    args = (
-        jnp.asarray(slab.key_words), jnp.asarray(slab.key_len),
-        jnp.asarray(slab.doc_key_len),
-        jnp.asarray(slab.ht_hi), jnp.asarray(slab.ht_lo),
-        jnp.asarray(slab.write_id),
-        jnp.asarray(slab.flags),
-        jnp.asarray((ttl_us >> 20).astype(np.uint32)),
-        jnp.asarray((ttl_us & 0xFFFFF).astype(np.uint32)),
-        jnp.arange(slab.n, dtype=jnp.int32),
+    perm, keep_p, mk_p = _merge_gc_fused(
+        cols_dev,
         jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
-    )
-    if device is not None:
-        args = jax.device_put(args, device)
-    perm, keep, mk = _merge_gc_impl(*args, is_major=params.is_major_compaction,
-                                    retain_deletes=params.retain_deletes)
-    return np.asarray(perm), np.asarray(keep), np.asarray(mk)
+        w=w, is_major=params.is_major_compaction,
+        retain_deletes=params.retain_deletes)
+    perm = np.asarray(perm)
+    keep = _unpack_bits(np.asarray(keep_p), n_pad) & (perm < n)
+    mk = _unpack_bits(np.asarray(mk_p), n_pad)
+    return perm, keep, mk
+
+
+def pack_cols(slab: KVSlab) -> Tuple[np.ndarray, int, int, int]:
+    """Pack a slab into the kernel's contiguous cols matrix (host side).
+
+    Padding rows carry all-0xFF keys (greater than any real key: real keys
+    zero-pad their final word) so they sort to the tail.
+    """
+    n = slab.n
+    n_pad = 1 << max(8, (n - 1).bit_length() if n > 1 else 1)
+    w = slab.width_words
+    w_pad = 1 << max(2, (w - 1).bit_length() if w > 1 else 1)
+    ttl_us = slab.ttl_ms * 1000
+    cols = np.empty((_ROW_WORDS + w_pad, n_pad), dtype=np.uint32)
+    cols[:, n:] = 0
+    cols[_ROW_KEY_LEN, :n] = slab.key_len
+    cols[_ROW_KEY_LEN, n:] = w_pad * 4
+    cols[_ROW_DKL, :n] = slab.doc_key_len
+    cols[_ROW_DKL, n:] = w_pad * 4
+    cols[_ROW_HT_HI, :n] = slab.ht_hi
+    cols[_ROW_HT_LO, :n] = slab.ht_lo
+    cols[_ROW_WID, :n] = slab.write_id
+    cols[_ROW_FLAGS, :n] = slab.flags
+    cols[_ROW_TTL_HI, :n] = (ttl_us >> 20).astype(np.uint32)
+    cols[_ROW_TTL_LO, :n] = (ttl_us & 0xFFFFF).astype(np.uint32)
+    cols[_ROW_WORDS: _ROW_WORDS + w, :n] = slab.key_words.T
+    cols[_ROW_WORDS + w:, :n] = 0
+    cols[_ROW_WORDS:, n:] = 0xFFFFFFFF
+    return cols, n, n_pad, w_pad

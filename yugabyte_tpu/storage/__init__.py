@@ -1,0 +1,3 @@
+from yugabyte_tpu.storage.db import DB, DBOptions
+from yugabyte_tpu.storage.sst import SSTWriter, SSTReader
+from yugabyte_tpu.storage.memtable import MemTable

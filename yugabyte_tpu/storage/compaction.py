@@ -1,0 +1,143 @@
+"""Compaction: universal picker + the TPU-offloaded compaction job.
+
+Picker parity with the reference's universal compaction (ref:
+src/yb/rocksdb/db/compaction_picker.cc UniversalCompactionPicker; YB default
+for DocDB, docdb/docdb_rocksdb_util.cc:637-658): sorted runs newest-first,
+merge adjacent runs chosen by size-ratio / run-count triggers; a full
+compaction (all runs) is "major" and may drop tombstones.
+
+Job parity with CompactionJob::Run (ref: rocksdb/db/compaction_job.cc:442):
+but the three hot loops (merge / dedup+filter / encode) become:
+    read blocks -> concat slabs -> ops.merge_and_gc_device -> write SSTs
+The merge+GC runs on TPU (or any JAX backend) and the keep/perm decisions are
+byte-identical across backends, so the CPU fallback produces identical SSTs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from yugabyte_tpu.ops.merge_gc import GCParams, merge_and_gc_device
+from yugabyte_tpu.ops.slabs import KVSlab, concat_slabs
+from yugabyte_tpu.storage.sst import Frontier, SSTProps, SSTReader, SSTWriter
+from yugabyte_tpu.storage.version_set import FileMeta
+from yugabyte_tpu.docdb.value import Value
+from yugabyte_tpu.utils import flags
+
+flags.define_flag("universal_compaction_min_merge_width", 4,
+                  "min sorted runs to trigger a compaction")
+flags.define_flag("universal_compaction_size_ratio_pct", 20,
+                  "merge run into candidate set while its size <= (1+ratio) * accumulated")
+flags.define_flag("compaction_max_output_entries_per_sst", 2_000_000,
+                  "split compaction output files at this row count")
+
+
+@dataclass
+class CompactionPick:
+    inputs: List[FileMeta]
+    is_major: bool
+
+
+def pick_universal(files: List[FileMeta]) -> Optional[CompactionPick]:
+    """files must be newest-first. Returns runs to merge, or None."""
+    min_width = flags.get_flag("universal_compaction_min_merge_width")
+    ratio = flags.get_flag("universal_compaction_size_ratio_pct")
+    candidates = [f for f in files if not f.being_compacted]
+    if len(candidates) < min_width:
+        return None
+    # Accumulate newest-first while sizes stay within ratio (universal rule:
+    # stop at the first run that dwarfs the accumulated candidates — never
+    # force-include it, or every few flushes rewrites the whole base run).
+    acc = candidates[0].total_size
+    picked = [candidates[0]]
+    for f in candidates[1:]:
+        if f.total_size * 100 <= (100 + ratio) * acc:
+            picked.append(f)
+            acc += f.total_size
+        else:
+            break
+    if len(picked) < min_width:
+        return None
+    is_major = len(picked) == len(files)  # all live runs -> bottommost
+    return CompactionPick(picked, is_major)
+
+
+@dataclass
+class CompactionResult:
+    outputs: List[Tuple[int, str, SSTProps]]  # (file_id, base_path, props)
+    rows_in: int
+    rows_out: int
+
+
+def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
+                       new_file_id, history_cutoff_ht: int, is_major: bool,
+                       retain_deletes: bool = False, device=None,
+                       block_entries: int = 4096) -> CompactionResult:
+    """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
+
+    new_file_id: callable returning the next file id (VersionSet.new_file_id).
+    """
+    slabs = [r.read_all() for r in inputs]
+    slabs = [s for s in slabs if s.n]
+    if not slabs:
+        return CompactionResult([], 0, 0)
+    merged = concat_slabs(slabs)
+    perm, keep, make_tomb = merge_and_gc_device(
+        merged, GCParams(history_cutoff_ht, is_major, retain_deletes), device=device)
+    surv = perm[keep]                      # input indices, merged order
+    tomb_flags = make_tomb[keep]
+    rows_out = int(surv.shape[0])
+
+    # Frontier for outputs: union of input frontiers + this cutoff
+    # (ref: compaction_job.cc:683-692, 929-931).
+    fr = _merge_frontiers([r.props.frontier for r in inputs], history_cutoff_ht)
+
+    outputs: List[Tuple[int, str, SSTProps]] = []
+    max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
+    tombstone_value = Value.tombstone().encode()
+    for start in range(0, rows_out, max_rows):
+        end = min(start + max_rows, rows_out)
+        sel = surv[start:end]
+        out_slab = _gather_slab(merged, sel, tomb_flags[start:end], tombstone_value)
+        fid = new_file_id()
+        base_path = os.path.join(out_dir, f"{fid:06d}.sst")
+        props = SSTWriter(base_path, block_entries=block_entries).write(out_slab, fr)
+        outputs.append((fid, base_path, props))
+    return CompactionResult(outputs, merged.n, rows_out)
+
+
+def _gather_slab(slab: KVSlab, sel: np.ndarray, make_tomb: np.ndarray,
+                 tombstone_value: bytes) -> KVSlab:
+    from yugabyte_tpu.ops.slabs import FLAG_TOMBSTONE
+    values = []
+    vidx = np.empty(len(sel), dtype=np.int32)
+    for j, i in enumerate(sel):
+        if make_tomb[j]:
+            values.append(tombstone_value)
+        else:
+            values.append(slab.values[int(slab.value_idx[i])])
+        vidx[j] = j
+    flags_out = slab.flags[sel].copy()
+    flags_out[make_tomb] |= FLAG_TOMBSTONE
+    return KVSlab(
+        key_words=slab.key_words[sel], key_len=slab.key_len[sel],
+        doc_key_len=slab.doc_key_len[sel], ht_hi=slab.ht_hi[sel],
+        ht_lo=slab.ht_lo[sel], write_id=slab.write_id[sel],
+        flags=flags_out, ttl_ms=slab.ttl_ms[sel], value_idx=vidx, values=values)
+
+
+def _merge_frontiers(frontiers: Sequence[Frontier], history_cutoff: int) -> Frontier:
+    live = [f for f in frontiers if f is not None]
+    if not live:
+        return Frontier(history_cutoff=history_cutoff)
+    return Frontier(
+        op_id_min=min(f.op_id_min for f in live),
+        op_id_max=max(f.op_id_max for f in live),
+        ht_min=min(f.ht_min for f in live),
+        ht_max=max(f.ht_max for f in live),
+        history_cutoff=max(history_cutoff, max(f.history_cutoff for f in live)),
+    )

@@ -1,0 +1,262 @@
+"""DB: the LSM storage engine facade.
+
+Capability parity with the reference's DBImpl as YB uses it (ref:
+src/yb/rocksdb/db/db_impl.cc): WAL-less writes (the Raft log is the WAL and
+the Raft index becomes the sequence/frontier — ref: tablet/tablet.cc:1247-1260),
+memtable -> flush -> universal compaction, manifest recovery, checkpoints.
+Reads merge memtable + SSTs (ref: MergingIterator table/merger.cc:51 — here a
+heapq.merge over sorted sources, since point/short reads stay on CPU; large
+scans go through the TPU scan kernel in ops/scan.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+from yugabyte_tpu.docdb.doc_key import split_key_and_ht
+from yugabyte_tpu.docdb.value_type import ValueType
+from yugabyte_tpu.ops.slabs import pack_doc_ht
+from yugabyte_tpu.storage import compaction as compaction_mod
+from yugabyte_tpu.storage.memtable import MemTable, make_internal_key
+from yugabyte_tpu.storage.sst import (
+    BlockCache, Frontier, SSTReader, SSTWriter, data_file_name)
+from yugabyte_tpu.storage.version_set import VersionSet
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.threadpool import PriorityThreadPool
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag("memstore_size_bytes", 128 * 1024 * 1024,
+                  "flush memtable at this size (ref docdb_rocksdb_util.cc:113)")
+
+
+@dataclass
+class DBOptions:
+    block_entries: int = 4096
+    block_cache: Optional[BlockCache] = None
+    compaction_pool: Optional[PriorityThreadPool] = None
+    device: object = None  # JAX device for compaction kernels
+    # returns current history cutoff HT value (ref: tablet_retention_policy.h:29)
+    retention_policy: Callable[[], int] = lambda: 0
+    memstore_size_bytes: Optional[int] = None
+    auto_compact: bool = True
+
+
+class DB:
+    def __init__(self, db_dir: str, options: Optional[DBOptions] = None):
+        self.db_dir = db_dir
+        self.opts = options or DBOptions()
+        os.makedirs(db_dir, exist_ok=True)
+        self.versions = VersionSet(db_dir)
+        self.versions.recover()
+        self.mem = MemTable()
+        self._imm: Optional[MemTable] = None   # memtable being flushed
+        self._readers: dict = {}
+        self._lock = threading.RLock()
+        self._compacting = False
+        self._closed = False
+        for fm in self.versions.live_files():
+            self._readers[fm.file_id] = SSTReader(fm.path, self.opts.block_cache)
+
+    # ------------------------------------------------------------------ write
+    def write_batch(self, items: List[Tuple[bytes, DocHybridTime, bytes]],
+                    op_id: Tuple[int, int] = (0, 0)) -> None:
+        """Apply a batch (already carrying DocHybridTimes). WAL-less: durability
+        comes from the Raft log above (ref: tablet.cc:1247 WriteToRocksDB)."""
+        with self._lock:
+            for key_prefix, dht, value in items:
+                self.mem.add(key_prefix, dht, value)
+            self._last_op_id = max(getattr(self, "_last_op_id", (0, 0)), op_id)
+            limit = self.opts.memstore_size_bytes or flags.get_flag("memstore_size_bytes")
+            if self.mem.approximate_bytes >= limit:
+                self.flush()
+
+    # ------------------------------------------------------------------ read
+    def get(self, key_prefix: bytes, read_ht: Optional[HybridTime] = None
+            ) -> Optional[Tuple[DocHybridTime, bytes]]:
+        """Latest version of key_prefix visible at read_ht (raw KV semantics;
+        document semantics layer above in docdb)."""
+        read_ht = read_ht or HybridTime.kMax
+        seek = make_internal_key(key_prefix, DocHybridTime(read_ht, 0xFFFFFFFF))
+        boundary = key_prefix + bytes([ValueType.kHybridTime])
+        # Bloom filters hold DOC key prefixes (storage/bloom.py): probe with
+        # the DocKey portion, not the full subdoc key.
+        from yugabyte_tpu.ops.slabs import _doc_key_len
+        try:
+            bloom_key = key_prefix[: _doc_key_len(key_prefix)]
+        except Exception:
+            bloom_key = None
+        for ikey, value in self.iter_from(seek, check_bloom_doc=bloom_key):
+            if not ikey.startswith(boundary):
+                return None
+            prefix, dht = split_key_and_ht(ikey)
+            if prefix == key_prefix and dht.ht.value <= read_ht.value:
+                return dht, value
+            return None
+        return None
+
+    def iter_from(self, seek_internal_key: bytes = b"",
+                  check_bloom_doc: Optional[bytes] = None
+                  ) -> Iterator[Tuple[bytes, bytes]]:
+        """Merged (internal_key, value) stream in memcmp order (the
+        MergingIterator equivalent)."""
+        with self._lock:
+            sources = []
+            sources.append(self.mem.iter_from(seek_internal_key))
+            if self._imm is not None:
+                sources.append(self._imm.iter_from(seek_internal_key))
+            readers = list(self._readers.values())
+        for r in readers:
+            if check_bloom_doc is not None and not r.may_contain_doc(check_bloom_doc):
+                continue
+            sources.append(_sst_iter_from(r, seek_internal_key))
+        return heapq.merge(*sources)
+
+    # ----------------------------------------------------------------- flush
+    def flush(self) -> Optional[int]:
+        """Memtable -> L0 SST (ref: db/flush_job.cc).
+
+        The lock is held only to swap the memtable and to install the result;
+        slab packing + SST write + fsync run unlocked while reads serve from
+        the immutable memtable (self._imm).
+        """
+        with self._lock:
+            if self._imm is not None:
+                return None  # a flush is already in progress
+            if self.mem.empty:
+                return None
+            self._imm, self.mem = self.mem, MemTable()
+            imm = self._imm
+            last_op = getattr(self, "_last_op_id", (0, 0))
+        try:
+            slab = imm.to_slab()
+            fid = self.versions.new_file_id()
+            path = os.path.join(self.db_dir, f"{fid:06d}.sst")
+            ht = slab.ht_hi.astype("u8") << 32 | slab.ht_lo
+            frontier = Frontier(op_id_min=last_op, op_id_max=last_op,
+                                ht_min=int(ht.min()) if slab.n else 0,
+                                ht_max=int(ht.max()) if slab.n else 0,
+                                history_cutoff=0)
+            props = SSTWriter(path, block_entries=self.opts.block_entries).write(slab, frontier)
+            with self._lock:
+                self.versions.add_file(fid, path, props)
+                self.versions.set_flushed_frontier(frontier)
+                self._readers[fid] = SSTReader(path, self.opts.block_cache)
+                self._imm = None
+            TRACE("flushed %d entries to %s", slab.n, path)
+        except BaseException:
+            with self._lock:
+                # restore un-flushed entries into the live memtable
+                for k, v in imm.iter_from():
+                    prefix, dht = split_key_and_ht(k)
+                    self.mem.add(prefix, dht, v)
+                self._imm = None
+            raise
+        if self.opts.auto_compact:
+            self.maybe_schedule_compaction()
+        return fid
+
+    # ------------------------------------------------------------ compaction
+    def maybe_schedule_compaction(self) -> bool:
+        """(ref: DBImpl::MaybeScheduleFlushOrCompaction db_impl.cc:2127)."""
+        with self._lock:
+            if self._compacting or self._closed:
+                return False
+            pick = compaction_mod.pick_universal(self.versions.live_files())
+            if pick is None:
+                return False
+            self._compacting = True
+            for fm in pick.inputs:
+                fm.being_compacted = True
+        if self.opts.compaction_pool is not None:
+            self.opts.compaction_pool.submit(lambda: self._run_compaction(pick),
+                                             priority=0)
+        else:
+            self._run_compaction(pick)
+        return True
+
+    def _run_compaction(self, pick) -> None:
+        try:
+            inputs = [self._readers[fm.file_id] for fm in pick.inputs]
+            cutoff = self.opts.retention_policy()
+            result = compaction_mod.run_compaction_job(
+                inputs, self.db_dir, self.versions.new_file_id, cutoff,
+                pick.is_major, device=self.opts.device,
+                block_entries=self.opts.block_entries)
+            with self._lock:
+                removed = [fm.file_id for fm in pick.inputs]
+                self.versions.install_compaction(
+                    removed, [(fid, p, props) for fid, p, props in result.outputs])
+                for fid, path, props in result.outputs:
+                    self._readers[fid] = SSTReader(path, self.opts.block_cache)
+                for fid in removed:
+                    r = self._readers.pop(fid, None)
+                    if r:
+                        r.close()
+                        _delete_sst_files(r.base_path)
+            TRACE("compaction: %d files -> %d rows (%d in)",
+                  len(pick.inputs), result.rows_out, result.rows_in)
+        finally:
+            with self._lock:
+                self._compacting = False
+                # On failure the inputs stay live: make them pickable again.
+                for fm in pick.inputs:
+                    fm.being_compacted = False
+        # cascade if still over trigger
+        if self.opts.auto_compact:
+            self.maybe_schedule_compaction()
+
+    def compact_all(self) -> None:
+        """Force a full (major) compaction of all live files."""
+        with self._lock:
+            files = [f for f in self.versions.live_files() if not f.being_compacted]
+            if len(files) < 2:
+                return
+            for fm in files:
+                fm.being_compacted = True
+            pick = compaction_mod.CompactionPick(files, is_major=True)
+            self._compacting = True
+        self._run_compaction(pick)
+
+    # ------------------------------------------------------------ checkpoint
+    def checkpoint(self, out_dir: str) -> None:
+        """Hard-link snapshot (ref: utilities/checkpoint/checkpoint.cc:56)."""
+        os.makedirs(out_dir, exist_ok=True)
+        with self._lock:
+            for fm in self.versions.live_files():
+                for p in (fm.path, data_file_name(fm.path)):
+                    os.link(p, os.path.join(out_dir, os.path.basename(p)))
+            import shutil
+            shutil.copy(self.versions.manifest_path, os.path.join(out_dir, "MANIFEST"))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for r in self._readers.values():
+                r.close()
+            self._readers.clear()
+
+    @property
+    def n_live_files(self) -> int:
+        return len(self.versions.files)
+
+
+def _sst_iter_from(reader: SSTReader, seek: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    prefix_seek, _ = split_key_and_ht(seek)
+    start_block = reader.seek_block(prefix_seek if prefix_seek else seek)
+    for key_prefix, dht, value, _fl in reader.iter_entries(start_block):
+        ikey = make_internal_key(key_prefix, dht)
+        if ikey >= seek:
+            yield ikey, value
+
+
+def _delete_sst_files(base_path: str) -> None:
+    for p in (base_path, data_file_name(base_path)):
+        try:
+            os.remove(p)
+        except FileNotFoundError:
+            pass

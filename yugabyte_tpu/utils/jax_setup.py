@@ -1,0 +1,22 @@
+"""Process-wide JAX configuration: persistent compilation cache.
+
+TPU compiles through the axon tunnel cost seconds-to-minutes; the storage
+engine's kernels use shape bucketing (ops/merge_gc.py) so a small set of
+executables covers all workloads, and this persistent cache makes them a
+one-time cost per MACHINE rather than per process.
+"""
+
+import os
+
+import jax
+
+_CACHE_DIR = os.environ.get(
+    "YBTPU_JAX_CACHE_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "ybtpu_jax_cache"))
+
+try:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # cache is an optimization; never fail import over it
+    pass
