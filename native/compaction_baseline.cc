@@ -1,0 +1,177 @@
+// CPU compaction baseline: the reference architecture, faithfully.
+//
+// Implements the stock CompactionJob hot path the way the reference does it
+// (ref: src/yb/rocksdb/db/compaction_job.cc:442 CompactionJob::Run):
+//   - k-way merge via a binary min-heap over pre-sorted runs
+//     (ref: table/merger.cc:51 MergingIterator)
+//   - sequential per-entry MVCC GC filter with the overwrite / TTL /
+//     tombstone rules (ref: docdb/docdb_compaction_filter.cc:74-320)
+// Single thread = one subcompaction, exactly like the reference
+// (compaction_job.cc:456-468 runs one thread per key range).
+//
+// Exposed as a C ABI for ctypes; used by bench.py as the vs_baseline
+// denominator and by tests as a third differential implementation.
+//
+// Build: g++ -O3 -shared -fPIC -o libcompaction_baseline.so compaction_baseline.cc
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Ctx {
+  const uint8_t* keys;
+  const int32_t* key_len;
+  int32_t stride;
+  const uint64_t* ht;
+  const uint32_t* wid;
+};
+
+// internal-key comparator: key memcmp asc, then ht desc, then wid desc
+inline int cmp_entries(const Ctx& c, int64_t a, int64_t b) {
+  const uint8_t* ka = c.keys + a * c.stride;
+  const uint8_t* kb = c.keys + b * c.stride;
+  int32_t la = c.key_len[a], lb = c.key_len[b];
+  int32_t m = la < lb ? la : lb;
+  int r = memcmp(ka, kb, m);
+  if (r) return r;
+  if (la != lb) return la < lb ? -1 : 1;
+  if (c.ht[a] != c.ht[b]) return c.ht[a] > c.ht[b] ? -1 : 1;  // desc
+  if (c.wid[a] != c.wid[b]) return c.wid[a] > c.wid[b] ? -1 : 1;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns number of kept entries. order_out receives the merged order
+// (indices into the flat arrays); keep_out/mk_out are per merged position.
+int64_t compact_baseline(
+    int32_t n_runs, const int64_t* run_offsets,  // [n_runs+1]
+    int64_t n, int32_t stride,
+    const uint8_t* keys, const int32_t* key_len, const int32_t* dkl,
+    const uint64_t* ht, const uint32_t* wid,
+    const uint8_t* flags,  // bit0 tombstone, bit1 obj init, bit2 has-ttl
+    const int64_t* ttl_ms,
+    uint64_t cutoff_ht, int32_t is_major, int32_t retain_deletes,
+    uint8_t* keep_out, uint8_t* mk_out, int64_t* order_out) {
+  Ctx c{keys, key_len, stride, ht, wid};
+
+  // ---- binary min-heap of run heads (MergingIterator) --------------------
+  std::vector<int64_t> heap;      // entry index
+  std::vector<int32_t> heap_run;  // owning run
+  std::vector<int64_t> pos(n_runs);
+  heap.reserve(n_runs);
+  auto heap_less = [&](size_t i, size_t j) {
+    return cmp_entries(c, heap[i], heap[j]) < 0;
+  };
+  auto sift_up = [&](size_t i) {
+    while (i > 0) {
+      size_t p = (i - 1) / 2;
+      if (heap_less(i, p)) {
+        std::swap(heap[i], heap[p]);
+        std::swap(heap_run[i], heap_run[p]);
+        i = p;
+      } else break;
+    }
+  };
+  auto sift_down = [&](size_t i) {
+    size_t sz = heap.size();
+    for (;;) {
+      size_t l = 2 * i + 1, r = l + 1, s = i;
+      if (l < sz && heap_less(l, s)) s = l;
+      if (r < sz && heap_less(r, s)) s = r;
+      if (s == i) break;
+      std::swap(heap[i], heap[s]);
+      std::swap(heap_run[i], heap_run[s]);
+      i = s;
+    }
+  };
+  for (int32_t r = 0; r < n_runs; ++r) {
+    pos[r] = run_offsets[r];
+    if (pos[r] < run_offsets[r + 1]) {
+      heap.push_back(pos[r]);
+      heap_run.push_back(r);
+      sift_up(heap.size() - 1);
+    }
+  }
+
+  // ---- sequential GC filter state ---------------------------------------
+  const uint64_t cutoff_phys = cutoff_ht >> 12;
+  int64_t prev = -1;           // previous merged entry
+  bool seen_visible = false;   // a <=cutoff version already kept for cur key
+  int64_t cur_doc = -1;        // entry whose doc prefix defines current doc
+  bool ov_set = false;
+  uint64_t ov_ht = 0;
+  uint32_t ov_wid = 0;
+
+  int64_t out = 0, kept = 0;
+  while (!heap.empty()) {
+    int64_t e = heap[0];
+    int32_t run = heap_run[0];
+    // advance the winning run (pop + push next = replace top + sift)
+    if (++pos[run] < run_offsets[run + 1]) {
+      heap[0] = pos[run];
+      sift_down(0);
+    } else {
+      heap[0] = heap.back();
+      heap_run[0] = heap_run.back();
+      heap.pop_back();
+      if (!heap.empty()) sift_down(0);
+    }
+
+    const uint8_t* k = keys + e * stride;
+    int32_t len = key_len[e], d = dkl[e];
+    bool same_key = prev >= 0 && key_len[prev] == len &&
+                    memcmp(keys + prev * stride, k, len) == 0;
+    if (!same_key) seen_visible = false;
+    bool same_doc = cur_doc >= 0 && dkl[cur_doc] == d &&
+                    memcmp(keys + cur_doc * stride, k, d) == 0;
+    if (!same_doc) {
+      cur_doc = e;
+      ov_set = false;
+    }
+    prev = e;
+
+    bool below = ht[e] <= cutoff_ht;
+    bool visible = false;
+    if (below) {
+      if (seen_visible) {
+        order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
+        continue;  // shadowed old version (docdb_compaction_filter.cc:166)
+      }
+      seen_visible = true;
+      visible = true;
+    }
+    bool is_root = len == d;
+    if (is_root && visible && !ov_set) {
+      ov_set = true;           // root version visible at cutoff: overwrites subtree
+      ov_ht = ht[e];
+      ov_wid = wid[e];
+    }
+    if (!is_root && ov_set &&
+        (ht[e] < ov_ht || (ht[e] == ov_ht && wid[e] <= ov_wid))) {
+      order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
+      continue;  // covered by root overwrite (overwrite-stack truncation)
+    }
+    bool has_ttl = flags[e] & 4;
+    bool expired = has_ttl &&
+        ((ht[e] >> 12) + (uint64_t)ttl_ms[e] * 1000 <= cutoff_phys);
+    bool already_tomb = flags[e] & 1;
+    bool tomb = already_tomb || (expired && below);
+    if (below && visible && tomb && is_major && !retain_deletes) {
+      order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
+      continue;  // visible tombstone at bottommost level (ref :316-319)
+    }
+    order_out[out] = e;
+    keep_out[out] = 1;
+    mk_out[out] = (expired && below && !already_tomb && !is_major) ? 1 : 0;
+    ++out;
+    ++kept;
+  }
+  return kept;
+}
+
+}  // extern "C"

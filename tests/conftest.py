@@ -1,15 +1,20 @@
-"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is unavailable in CI; sharding tests run on a virtual
 8-device CPU mesh exactly as the driver's dryrun does.
+
+NOTE: the axon sitecustomize (PYTHONPATH=/root/.axon_site) force-registers
+the tunnel TPU at interpreter start and overrides JAX_PLATFORMS from the
+environment — but `jax.config.update` after import still wins, so the
+platform is pinned here, before any backend initialization.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

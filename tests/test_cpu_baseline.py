@@ -1,0 +1,56 @@
+"""The native C++ baseline must agree with the Python oracle AND the kernel."""
+
+import random
+
+import numpy as np
+import pytest
+
+from yugabyte_tpu.docdb.compaction_model import ModelEntry, compact_model, sort_key
+from yugabyte_tpu.ops.merge_gc import GCParams, merge_and_gc_device
+from yugabyte_tpu.storage.cpu_baseline import compact_cpu_baseline
+from tests.test_merge_gc_kernel import slab_from_model, mk_key, ht, CUTOFF
+
+
+def _sorted_runs(entries, n_runs=4):
+    """Split entries into n_runs, each sorted in internal-key order."""
+    rng = random.Random(0)
+    runs = [[] for _ in range(n_runs)]
+    for e in entries:
+        runs[rng.randrange(n_runs)].append(e)
+    ordered = []
+    offsets = [0]
+    for r in runs:
+        r.sort(key=sort_key)
+        ordered.extend(r)
+        offsets.append(len(ordered))
+    return ordered, offsets
+
+
+@pytest.mark.parametrize("is_major", [False, True])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_baseline_matches_kernel_and_model(seed, is_major):
+    rng = random.Random(seed)
+    entries, seen = [], set()
+    for _ in range(500):
+        key, dkl = mk_key(rng.randint(0, 30), rng.choice([None, 0, 1, 2]))
+        e = ModelEntry(key, dkl, ht(rng.randint(1, 2000), rng.randint(0, 3)),
+                       is_tombstone=rng.random() < 0.15,
+                       is_object_init=rng.random() < 0.05,
+                       ttl_ms=rng.choice([None] * 4 + [0, 10**9]))
+        if (e.key, e.dht) in seen or (e.is_object_init and len(e.key) != e.doc_key_len):
+            continue
+        seen.add((e.key, e.dht))
+        entries.append(e)
+    ordered, offsets = _sorted_runs(entries)
+    slab = slab_from_model(ordered)
+    order, keep, mk = compact_cpu_baseline(slab, offsets, CUTOFF, is_major)
+    got = sorted((sort_key(ordered[int(order[i])]), bool(mk[i]))
+                 for i in range(len(ordered)) if keep[i])
+    want = sorted((sort_key(r.entry), r.as_tombstone)
+                  for r in compact_model(entries, CUTOFF, is_major))
+    assert got == want
+    # and the device kernel agrees too
+    perm, kkeep, kmk = merge_and_gc_device(slab, GCParams(CUTOFF, is_major))
+    kernel = sorted((sort_key(ordered[int(perm[p])]), bool(kmk[p]))
+                    for p in np.nonzero(kkeep)[0])
+    assert kernel == want

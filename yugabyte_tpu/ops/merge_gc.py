@@ -68,27 +68,45 @@ def _le_u64(a_hi, a_lo, b_hi, b_lo):
     return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
 
 
-@functools.partial(jax.jit, static_argnames=("w", "is_major", "retain_deletes"))
-def _merge_gc_fused(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
-                    w: int, is_major: bool, retain_deletes: bool):
+def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+                w: int, is_major: bool, retain_deletes: bool,
+                sort_rows=None, n_sort=None):
+    """Traceable core: radix merge + GC over one cols matrix.
+
+    Reused by the single-chip jit wrapper below and by the distributed
+    per-shard path (parallel/dist_compact.py) inside shard_map.
+    Returns (perm, keep, make_tombstone) as unpacked device arrays.
+
+    sort_rows/n_sort: optional column-pruned radix schedule (see
+    build_sort_schedule) — constant columns carry no ordering information,
+    so the host drops their passes. Row indices >= _ROW_WORDS sort
+    ascending; the ht/wid rows sort descending (complemented in the body).
+    """
     n = cols.shape[1]
     u32max = jnp.uint32(0xFFFFFFFF)
 
     # ---- merge: LSD radix passes, least-significant column first ----------
-    # sequence: wid desc, ht_lo desc, ht_hi desc, key_len asc, words W-1..0 asc
-    k_sort = 4 + w
-    sort_rows = jnp.asarray(
-        [_ROW_WID, _ROW_HT_LO, _ROW_HT_HI, _ROW_KEY_LEN]
-        + [_ROW_WORDS + j for j in range(w - 1, -1, -1)], dtype=jnp.int32)
-    inverts = jnp.asarray([u32max, u32max, u32max, 0] + [0] * w, dtype=jnp.uint32)
+    # full sequence: wid desc, ht_lo desc, ht_hi desc, key_len asc, words
+    # W-1..0 asc; pruned schedules drop constant columns.
+    if sort_rows is None:
+        sort_rows = jnp.asarray(
+            [_ROW_WID, _ROW_HT_LO, _ROW_HT_HI, _ROW_KEY_LEN]
+            + [_ROW_WORDS + j for j in range(w - 1, -1, -1)], dtype=jnp.int32)
+        n_sort = 4 + w
 
     def body(k, perm):
-        col = jax.lax.dynamic_index_in_dim(cols, sort_rows[k], axis=0,
-                                           keepdims=False) ^ inverts[k]
+        row = sort_rows[k]
+        invert = jnp.where((row >= _ROW_HT_HI) & (row <= _ROW_WID),
+                           u32max, jnp.uint32(0))
+        col = jax.lax.dynamic_index_in_dim(cols, row, axis=0,
+                                           keepdims=False) ^ invert
         _, new_perm = jax.lax.sort([col[perm], perm], num_keys=1, is_stable=True)
         return new_perm
 
-    perm = jax.lax.fori_loop(0, k_sort, body, jnp.arange(n, dtype=jnp.int32))
+    # (the `cols[0,:1]*0` term imprints cols' varying-axes type on the carry,
+    # required when tracing inside shard_map)
+    perm0 = jnp.arange(n, dtype=jnp.int32) + cols[0, :1].astype(jnp.int32) * 0
+    perm = jax.lax.fori_loop(0, n_sort, body, perm0)
 
     s = cols[:, perm]                        # gather all rows once
     s_len = s[_ROW_KEY_LEN].astype(jnp.int32)
@@ -153,6 +171,66 @@ def _merge_gc_fused(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
                  & jnp.bool_(not retain_deletes))
     keep = keep_version & ~covered & ~drop_tomb
     make_tombstone = expired & keep & c & ~already_tomb & jnp.bool_(not is_major)
+    return perm, keep, make_tombstone
+
+
+PAD_SENTINEL = 0xFFFFFFFF  # key_len/dkl value marking padding rows
+
+
+def bucket_size(n: int) -> int:
+    """Power-of-two shape bucket (one XLA compile per bucket)."""
+    return 1 << max(8, (n - 1).bit_length() if n > 1 else 1)
+
+
+def pad_template(r: int) -> np.ndarray:
+    """One padding column for a cols matrix with r rows: all-0xFF key words
+    (sort after every real key — real keys zero-pad their final word),
+    PAD_SENTINEL lens, zero ht/wid/flags/ttl."""
+    col = np.zeros(r, dtype=np.uint32)
+    col[_ROW_KEY_LEN] = PAD_SENTINEL
+    col[_ROW_DKL] = PAD_SENTINEL
+    col[_ROW_WORDS:] = 0xFFFFFFFF
+    return col
+
+
+def full_sort_sequence(w: int) -> list:
+    """The complete LSD radix schedule for key width w (least-sig first)."""
+    return [_ROW_WID, _ROW_HT_LO, _ROW_HT_HI, _ROW_KEY_LEN] + \
+        [_ROW_WORDS + j for j in range(w - 1, -1, -1)]
+
+
+def column_stats(cols: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(is_const[R], first_val[R]) over the real rows of a cols matrix."""
+    r = cols.shape[0]
+    if n == 0:
+        return np.ones(r, bool), np.zeros(r, np.uint32)
+    first = cols[:, 0].copy()
+    is_const = (cols[:, :n] == first[:, None]).all(axis=1)
+    return is_const, first
+
+
+def build_sort_schedule(w: int, is_const: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Prune constant columns from the radix schedule (host side).
+
+    A column whose value is identical across all real rows contributes no
+    ordering information; skipping its pass saves a full sort+gather on
+    device. Returns (sort_rows padded to 4+w, n_sort)."""
+    full = full_sort_sequence(w)
+    used = [row for row in full if not is_const[row]]
+    n_sort = len(used)
+    padded = np.asarray(used + [0] * (len(full) - n_sort), dtype=np.int32)
+    return padded, n_sort
+
+
+@functools.partial(jax.jit, static_argnames=("w", "is_major", "retain_deletes"))
+def _merge_gc_fused(cols, sort_rows, n_sort,
+                    cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+                    w: int, is_major: bool, retain_deletes: bool):
+    n = cols.shape[1]
+    perm, keep, make_tombstone = sort_and_gc(
+        cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+        w=w, is_major=is_major, retain_deletes=retain_deletes,
+        sort_rows=sort_rows, n_sort=n_sort)
 
     # pack masks 32 bits/word to shrink the (slow) device->host fetch
     def pack_bits(b):
@@ -167,8 +245,35 @@ def _unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
     return np.unpackbits(packed.view(np.uint8), bitorder="little")[:n].astype(bool)
 
 
-def merge_and_gc_device(slab: KVSlab, params: GCParams, device=None,
-                        cols_override=None) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+@dataclass
+class StagedCols:
+    """A slab staged on device: the device-resident block-cache unit."""
+    cols_dev: object
+    sort_rows: np.ndarray
+    n_sort: int
+    n: int
+    n_pad: int
+    w: int
+    col_const: Optional[np.ndarray] = None   # is_const per row (real rows)
+    col_first: Optional[np.ndarray] = None   # first value per row
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.cols_dev.size) * 4
+
+
+def stage_slab(slab: KVSlab, device=None) -> StagedCols:
+    """Pack + upload a slab once; reuse across compactions (HBM block cache)."""
+    cols, n, n_pad, w = pack_cols(slab)
+    is_const, first = column_stats(cols, n)
+    sort_rows, n_sort = build_sort_schedule(w, is_const)
+    cols_dev = jax.device_put(cols, device) if device is not None else jnp.asarray(cols)
+    return StagedCols(cols_dev, sort_rows, n_sort, n, n_pad, w, is_const, first)
+
+
+def merge_and_gc_device(slab: Optional[KVSlab], params: GCParams, device=None,
+                        staged: Optional[StagedCols] = None
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run the fused merge+GC program on `device`.
 
     Returns (perm, keep, make_tombstone) as host numpy arrays (padded length
@@ -178,25 +283,21 @@ def merge_and_gc_device(slab: KVSlab, params: GCParams, device=None,
       make_tombstone[i] = value must be rewritten as a tombstone (TTL expiry
                           at a non-major compaction)
 
-    cols_override: a pre-staged device cols matrix (device-resident slab
-    cache path) — skips the host pack + upload entirely.
+    staged: pre-staged device cols (device-resident slab cache path) —
+    skips the host pack + upload entirely.
     """
-    if slab.n == 0 and cols_override is None:
-        z = np.zeros(0, dtype=np.int32)
-        zb = np.zeros(0, dtype=bool)
-        return z, zb, zb
-    if cols_override is not None:
-        cols_dev = cols_override
-        n = slab.n
-        n_pad = cols_dev.shape[1]
-        w = cols_dev.shape[0] - _ROW_WORDS
-    else:
-        cols, n, n_pad, w = pack_cols(slab)
-        cols_dev = jax.device_put(cols, device) if device is not None else jnp.asarray(cols)
+    if staged is None:
+        if slab.n == 0:
+            z = np.zeros(0, dtype=np.int32)
+            zb = np.zeros(0, dtype=bool)
+            return z, zb, zb
+        staged = stage_slab(slab, device)
+    cols_dev, sort_rows, n_sort = staged.cols_dev, staged.sort_rows, staged.n_sort
+    n, n_pad, w = staged.n, staged.n_pad, staged.w
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
     perm, keep_p, mk_p = _merge_gc_fused(
-        cols_dev,
+        cols_dev, jnp.asarray(sort_rows), jnp.int32(n_sort),
         jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
         w=w, is_major=params.is_major_compaction,
@@ -214,16 +315,14 @@ def pack_cols(slab: KVSlab) -> Tuple[np.ndarray, int, int, int]:
     zero-pad their final word) so they sort to the tail.
     """
     n = slab.n
-    n_pad = 1 << max(8, (n - 1).bit_length() if n > 1 else 1)
+    n_pad = bucket_size(n)
     w = slab.width_words
     w_pad = 1 << max(2, (w - 1).bit_length() if w > 1 else 1)
     ttl_us = slab.ttl_ms * 1000
     cols = np.empty((_ROW_WORDS + w_pad, n_pad), dtype=np.uint32)
-    cols[:, n:] = 0
+    cols[:, n:] = pad_template(_ROW_WORDS + w_pad)[:, None]
     cols[_ROW_KEY_LEN, :n] = slab.key_len
-    cols[_ROW_KEY_LEN, n:] = w_pad * 4
     cols[_ROW_DKL, :n] = slab.doc_key_len
-    cols[_ROW_DKL, n:] = w_pad * 4
     cols[_ROW_HT_HI, :n] = slab.ht_hi
     cols[_ROW_HT_LO, :n] = slab.ht_lo
     cols[_ROW_WID, :n] = slab.write_id
@@ -232,5 +331,4 @@ def pack_cols(slab: KVSlab) -> Tuple[np.ndarray, int, int, int]:
     cols[_ROW_TTL_LO, :n] = (ttl_us & 0xFFFFF).astype(np.uint32)
     cols[_ROW_WORDS: _ROW_WORDS + w, :n] = slab.key_words.T
     cols[_ROW_WORDS + w:, :n] = 0
-    cols[_ROW_WORDS:, n:] = 0xFFFFFFFF
     return cols, n, n_pad, w_pad

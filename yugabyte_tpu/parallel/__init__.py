@@ -1,0 +1,2 @@
+from yugabyte_tpu.parallel.mesh import make_mesh
+from yugabyte_tpu.parallel.dist_compact import distributed_compact, dist_compact_fn

@@ -1,0 +1,145 @@
+"""Distributed compaction: range-repartition + per-shard merge/GC over a mesh.
+
+The multi-chip form of the north-star kernel. The reference parallelizes a
+big compaction into key-range subcompactions, one THREAD each
+(ref: rocksdb/db/compaction_job.cc:330 GenSubcompactionBoundaries, :456-468);
+here each key range is one DEVICE of a `jax.sharding.Mesh`, and the data
+movement that the reference does with per-thread file iterators happens as
+XLA collectives over ICI:
+
+  1. each shard samples its local route keys (first key word)
+  2. all_gather the samples -> identical global splitters on every shard
+  3. bucket rows by destination shard; all_to_all exchanges the buckets
+     (fixed per-destination capacity with all-0xFF padding rows, which sort
+     to the tail and are dropped by the GC keep-mask like all padding)
+  4. per-shard fused radix merge + MVCC GC (ops/merge_gc.sort_and_gc)
+
+Routing is by the first 32-bit key word, which keeps every version of a key
+AND every subkey of a document on one shard (a document's entries share
+their first 4 key bytes), so GC segment logic never straddles shards.
+
+Returns per-shard sorted cols + keep/make-tombstone masks + an overflow flag
+(a bucket exceeding capacity means splitters were too skewed: the caller
+retries with higher capacity — compaction correctness is never silently
+sacrificed).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from yugabyte_tpu.ops import merge_gc
+from yugabyte_tpu.ops.merge_gc import (
+    _ROW_KEY_LEN, _ROW_WORDS, GCParams, PAD_SENTINEL, pack_cols, pad_template,
+    sort_and_gc)
+
+
+def dist_compact_fn(mesh: Mesh, w: int, capacity: int, is_major: bool,
+                    retain_deletes: bool = False, axis: str = "shard"):
+    """Build the jitted distributed compaction step for a mesh.
+
+    Input cols: [R, n_total] sharded along dim 1; n_total = n_shards * n_local.
+    Output: (cols_out [R, n_shards*capacity] sharded, keep, make_tombstone,
+             overflow flag per shard).
+    """
+    n_shards = mesh.devices.size
+
+    def per_shard(cols_local, n_real_total, cutoff_hi, cutoff_lo, cph, cpl):
+        r, n_local = cols_local.shape
+        route = cols_local[_ROW_WORDS]                      # first key word
+        is_pad_in = cols_local[_ROW_KEY_LEN] == jnp.uint32(PAD_SENTINEL)
+        # -- 1/2: sample + all_gather + splitters --------------------------
+        # padding samples carry 0xFFFFFFFF route words and sort to the tail;
+        # quantiles are taken over the expected REAL sample count so padding
+        # never skews splitters toward empty high shards.
+        step = max(1, n_local // 64)
+        samples = route[::step][:64] if n_local >= 64 else route
+        n_samp = samples.shape[0]
+        all_samples = jax.lax.all_gather(samples, axis).reshape(-1)
+        (sorted_samples,) = jax.lax.sort([all_samples], num_keys=1)
+        total_rows = n_shards * n_local
+        n_real_samples = (all_samples.shape[0] * n_real_total) // total_rows
+        n_real_samples = jnp.maximum(n_real_samples, 1)
+        qs = (jnp.arange(1, n_shards) * n_real_samples) // n_shards
+        splitters = sorted_samples[qs]                      # [n_shards-1]
+        # -- 3: bucket + exchange ------------------------------------------
+        # input padding rows route to the LAST shard (route word 0xFF..) but
+        # are excluded from counts so they can't trigger a spurious overflow
+        dest = jnp.sum(route[:, None] >= splitters[None, :], axis=1)  # [n_local]
+        order = jnp.argsort(dest)                           # stable
+        real_dest = jnp.where(is_pad_in, n_shards, dest)    # bin n_shards: pad
+        counts = jnp.bincount(real_dest, length=n_shards + 1)[:n_shards]
+        all_counts = jnp.bincount(dest, length=n_shards)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, all_counts.dtype), jnp.cumsum(all_counts)[:-1]])
+        overflow = jnp.any(counts > capacity)
+        pos_in_group = jnp.arange(n_local) - offsets[dest[order]]
+        valid = pos_in_group < capacity
+        # rows past capacity go to a dump column that is sliced off before
+        # the exchange — they can never clobber a real slot
+        slot = jnp.where(valid, dest[order] * capacity + pos_in_group,
+                         n_shards * capacity)
+        pad_col = jnp.asarray(pad_template(r))
+        send = jnp.tile(pad_col[:, None], (1, n_shards * capacity + 1))
+        send = send.at[:, slot].set(cols_local[:, order])
+        send3 = send[:, :-1].reshape(r, n_shards, capacity)
+        recv = jax.lax.all_to_all(send3, axis, split_axis=1, concat_axis=1,
+                                  tiled=False)
+        cols_shard = recv.reshape(r, n_shards * capacity)
+        # -- 4: local fused merge + GC -------------------------------------
+        perm, keep, mk = sort_and_gc(cols_shard, cutoff_hi, cutoff_lo, cph, cpl,
+                                     w=r - _ROW_WORDS, is_major=is_major,
+                                     retain_deletes=retain_deletes)
+        out = cols_shard[:, perm]
+        # padding rows are identified explicitly by the key_len sentinel
+        is_pad = out[_ROW_KEY_LEN] == jnp.uint32(PAD_SENTINEL)
+        keep = keep & ~is_pad
+        return out, keep, mk, overflow[None]
+
+    spec = P(None, axis)
+    fn = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(spec, P(), P(), P(), P(), P()),
+        out_specs=(spec, P(axis), P(axis), P(axis)))
+    return jax.jit(fn)
+
+
+def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
+                        capacity_factor: float = 2.0):
+    """Host wrapper: pack a slab, shard it over the mesh, run the step.
+
+    Returns (cols_out, keep, make_tombstone) as host arrays; cols_out rows
+    follow ops/merge_gc layout, in globally range-partitioned sorted order
+    (shard s holds keys <= shard s+1's)."""
+    n_shards = mesh.devices.size
+    cols, n, n_pad, w = pack_cols(slab)
+    # pad n_pad to a multiple of shards (pack_cols gives powers of two; mesh
+    # sizes are powers of two on TPU pods)
+    if n_pad % n_shards:
+        extra = n_shards - (n_pad % n_shards)
+        pad_block = np.tile(pad_template(cols.shape[0])[:, None], (1, extra))
+        cols = np.concatenate([cols, pad_block], axis=1)
+    n_local = cols.shape[1] // n_shards
+    # each source sends ~n_local/n_shards rows to each destination; the
+    # factor absorbs skew, with the overflow retry as the hard guard
+    capacity = max(64, int(n_local / n_shards * capacity_factor))
+    cutoff = params.history_cutoff_ht
+    cutoff_phys = cutoff >> 12
+    fn = dist_compact_fn(mesh, w, capacity, params.is_major_compaction,
+                         params.retain_deletes, axis)
+    out, keep, mk, overflow = fn(
+        cols, jnp.int32(n), jnp.uint32(cutoff >> 32),
+        jnp.uint32(cutoff & 0xFFFFFFFF),
+        jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF))
+    if bool(np.any(np.asarray(overflow))):
+        if capacity_factor >= 64:
+            raise RuntimeError("distributed compaction bucket overflow at 64x")
+        return distributed_compact(slab, params, mesh, axis, capacity_factor * 2)
+    return np.asarray(out), np.asarray(keep), np.asarray(mk)

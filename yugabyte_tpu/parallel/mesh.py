@@ -1,0 +1,34 @@
+"""Device mesh construction for the distributed storage fabric.
+
+The TPU-native replacement for the reference's cluster topology: where
+YugabyteDB spreads tablets across tservers connected by its RPC fabric
+(SURVEY.md section 2.7), this framework spreads tablet shards across TPU
+devices connected by ICI/DCN, with XLA collectives doing the data movement
+(all_gather for splitter exchange, all_to_all for range repartitioning,
+psum for checksums/consistency probes).
+
+Axes:
+  "shard"  - range-sharding of key space within one logical tablet group
+             (the subcompaction axis; ref: compaction_job.cc:330
+             GenSubcompactionBoundaries, one thread per key range -> here
+             one DEVICE per key range)
+A second "replica" axis arrives with the consensus layer: replica groups
+mirror writes across failure domains the way per-tablet Raft groups span
+tservers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_shards: Optional[int] = None, devices: Optional[Sequence] = None,
+              axis: str = "shard") -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_shards is not None:
+        devs = devs[:n_shards]
+    return Mesh(np.array(devs), (axis,))

@@ -76,18 +76,36 @@ class CompactionResult:
 def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                        new_file_id, history_cutoff_ht: int, is_major: bool,
                        retain_deletes: bool = False, device=None,
-                       block_entries: int = 4096) -> CompactionResult:
+                       block_entries: int = 4096, device_cache=None,
+                       input_ids: Optional[Sequence[int]] = None
+                       ) -> CompactionResult:
     """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
 
     new_file_id: callable returning the next file id (VersionSet.new_file_id).
+    device_cache + input_ids: when set, input key columns come from (or are
+    written through to) the HBM-resident slab cache — host->device upload is
+    skipped for cache hits; values always stream from disk on the host side.
     """
     slabs = [r.read_all() for r in inputs]
-    slabs = [s for s in slabs if s.n]
+    keep_idx = [i for i, s in enumerate(slabs) if s.n]
+    slabs = [slabs[i] for i in keep_idx]
     if not slabs:
         return CompactionResult([], 0, 0)
     merged = concat_slabs(slabs)
-    perm, keep, make_tomb = merge_and_gc_device(
-        merged, GCParams(history_cutoff_ht, is_major, retain_deletes), device=device)
+    params = GCParams(history_cutoff_ht, is_major, retain_deletes)
+    staged = None
+    if device_cache is not None and input_ids is not None:
+        from yugabyte_tpu.storage.device_cache import concat_staged
+        ids = [input_ids[i] for i in keep_idx]
+        staged_list = []
+        for fid, slab in zip(ids, slabs):
+            st = device_cache.get(fid)
+            if st is None:
+                st = device_cache.stage(fid, slab)
+            staged_list.append(st)
+        staged = concat_staged(staged_list)
+    perm, keep, make_tomb = merge_and_gc_device(merged, params, device=device,
+                                                staged=staged)
     surv = perm[keep]                      # input indices, merged order
     tomb_flags = make_tomb[keep]
     rows_out = int(surv.shape[0])
@@ -107,6 +125,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         base_path = os.path.join(out_dir, f"{fid:06d}.sst")
         props = SSTWriter(base_path, block_entries=block_entries).write(out_slab, fr)
         outputs.append((fid, base_path, props))
+        if device_cache is not None:
+            device_cache.stage(fid, out_slab)  # write-through for the next pick
     return CompactionResult(outputs, merged.n, rows_out)
 
 

@@ -40,6 +40,9 @@ class DBOptions:
     block_cache: Optional[BlockCache] = None
     compaction_pool: Optional[PriorityThreadPool] = None
     device: object = None  # JAX device for compaction kernels
+    # HBM-resident slab cache (storage/device_cache.py); shared across
+    # tablets like the reference's server-wide block cache
+    device_cache: object = None
     # returns current history cutoff HT value (ref: tablet_retention_policy.h:29)
     retention_policy: Callable[[], int] = lambda: 0
     memstore_size_bytes: Optional[int] = None
@@ -50,6 +53,16 @@ class DB:
     def __init__(self, db_dir: str, options: Optional[DBOptions] = None):
         self.db_dir = db_dir
         self.opts = options or DBOptions()
+        self._device_cache = None
+        if self.opts.device_cache is not None:
+            from yugabyte_tpu.storage.device_cache import (
+                DeviceSlabCache, NamespacedSlabCache)
+            # namespace file ids per DB under the shared server-wide cache
+            # (kept off self.opts: DBOptions may be shared between DBs)
+            self._device_cache = (
+                NamespacedSlabCache(self.opts.device_cache, os.path.abspath(db_dir))
+                if isinstance(self.opts.device_cache, DeviceSlabCache)
+                else self.opts.device_cache)
         os.makedirs(db_dir, exist_ok=True)
         self.versions = VersionSet(db_dir)
         self.versions.recover()
@@ -142,6 +155,8 @@ class DB:
                                 ht_max=int(ht.max()) if slab.n else 0,
                                 history_cutoff=0)
             props = SSTWriter(path, block_entries=self.opts.block_entries).write(slab, frontier)
+            if self._device_cache is not None:
+                self._device_cache.stage(fid, slab)  # write-through to HBM
             with self._lock:
                 self.versions.add_file(fid, path, props)
                 self.versions.set_flushed_frontier(frontier)
@@ -186,7 +201,9 @@ class DB:
             result = compaction_mod.run_compaction_job(
                 inputs, self.db_dir, self.versions.new_file_id, cutoff,
                 pick.is_major, device=self.opts.device,
-                block_entries=self.opts.block_entries)
+                block_entries=self.opts.block_entries,
+                device_cache=self._device_cache,
+                input_ids=[fm.file_id for fm in pick.inputs])
             with self._lock:
                 removed = [fm.file_id for fm in pick.inputs]
                 self.versions.install_compaction(
@@ -198,6 +215,8 @@ class DB:
                     if r:
                         r.close()
                         _delete_sst_files(r.base_path)
+                    if self._device_cache is not None:
+                        self._device_cache.drop(fid)
             TRACE("compaction: %d files -> %d rows (%d in)",
                   len(pick.inputs), result.rows_out, result.rows_in)
         finally:

@@ -1,0 +1,155 @@
+"""Device-resident slab cache: SST key columns pinned in TPU HBM.
+
+The TPU-native analog of the reference's block cache (ref:
+rocksdb/util/lru_cache.cc) — but where the reference caches decoded blocks in
+host RAM to avoid disk reads, this caches *staged key-column matrices* in
+device HBM to avoid host->device transfers, which dominate compaction cost on
+a transfer-limited interconnect. Flush and compaction write-through: every
+new SST's key columns are staged once, so steady-state compaction finds all
+inputs already resident and only ships back the (bit-packed) keep masks.
+
+Values stay host-side: merge+GC only permutes and drops entries, so value
+bytes never need to cross to the device at all (the original sidecar
+insight, SURVEY.md section 2.7).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from yugabyte_tpu.ops.merge_gc import (
+    _ROW_WORDS, StagedCols, bucket_size, build_sort_schedule, pack_cols,
+    pad_template, stage_slab)
+from yugabyte_tpu.ops.slabs import KVSlab
+
+CacheKey = Tuple[str, int]  # (namespace, file_id) — file ids are per-DB
+
+
+class DeviceSlabCache:
+    """Server-wide cache; keys are namespaced per DB because VersionSet file
+    ids are only unique within one DB (like the reference's per-DB file
+    numbers under a shared block cache)."""
+
+    def __init__(self, device=None, capacity_bytes: int = 4 << 30):
+        self.device = device
+        self.capacity = capacity_bytes
+        self._map: "OrderedDict[CacheKey, StagedCols]" = OrderedDict()
+        self._used = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: CacheKey) -> Optional[StagedCols]:
+        with self._lock:
+            staged = self._map.get(key)
+            if staged is None:
+                self.misses += 1
+                return None
+            self._map.move_to_end(key)
+            self.hits += 1
+            return staged
+
+    def put(self, key: CacheKey, staged: StagedCols) -> None:
+        with self._lock:
+            if key in self._map:
+                return
+            self._map[key] = staged
+            self._used += staged.nbytes
+            while self._used > self.capacity and len(self._map) > 1:
+                _, old = self._map.popitem(last=False)
+                self._used -= old.nbytes
+
+    def drop(self, key: CacheKey) -> None:
+        with self._lock:
+            staged = self._map.pop(key, None)
+            if staged is not None:
+                self._used -= staged.nbytes
+
+    def stage(self, key: CacheKey, slab: KVSlab) -> StagedCols:
+        staged = stage_slab(slab, self.device)
+        self.put(key, staged)
+        return staged
+
+
+class NamespacedSlabCache:
+    """Per-DB view over a shared DeviceSlabCache: callers use bare file ids."""
+
+    def __init__(self, shared: DeviceSlabCache, namespace: str):
+        self._shared = shared
+        self.namespace = namespace
+
+    @property
+    def device(self):
+        return self._shared.device
+
+    @property
+    def hits(self):
+        return self._shared.hits
+
+    @property
+    def misses(self):
+        return self._shared.misses
+
+    def get(self, file_id: int):
+        return self._shared.get((self.namespace, file_id))
+
+    def put(self, file_id: int, staged: StagedCols) -> None:
+        self._shared.put((self.namespace, file_id), staged)
+
+    def drop(self, file_id: int) -> None:
+        self._shared.drop((self.namespace, file_id))
+
+    def stage(self, file_id: int, slab: KVSlab) -> StagedCols:
+        return self._shared.stage((self.namespace, file_id), slab)
+
+
+def concat_staged(staged_list: Sequence[StagedCols]) -> StagedCols:
+    """Concatenate staged inputs ON DEVICE into one padded cols matrix.
+
+    All transfers avoided: pad each input's width to the max, concatenate
+    along entries, pad entry count to the bucket size — all jnp ops on the
+    cached arrays' device (placement follows the cache's device).
+    """
+    import jax.numpy as jnp
+
+    w = max(s.w for s in staged_list)
+    n = sum(s.n for s in staged_list)
+    n_pad = bucket_size(n)
+    parts = []
+    for s in staged_list:
+        cols = s.cols_dev[:, :s.n]  # strip per-input padding
+        if s.w < w:
+            pad_words = jnp.zeros((w - s.w, s.n), dtype=jnp.uint32)
+            cols = jnp.concatenate([cols, pad_words], axis=0)
+        parts.append(cols)
+    cat = jnp.concatenate(parts, axis=1)
+    tail = n_pad - n
+    if tail:
+        pad = jnp.asarray(pad_template(cat.shape[0]))[:, None]
+        cat = jnp.concatenate([cat, jnp.tile(pad, (1, tail))], axis=1)
+    # Merged schedule: a column is skippable only if CONSTANT WITH THE SAME
+    # VALUE across every input (constant-per-input with differing values
+    # still orders the merge). Inputs narrower than w expose the extra word
+    # rows as constant zero.
+    r_total = _ROW_WORDS + w
+    is_const = np.ones(r_total, bool)
+    first_vals: List[Optional[int]] = [None] * r_total
+    for s in staged_list:
+        for row in range(r_total):
+            if row >= _ROW_WORDS + s.w:
+                c, v = True, 0  # implicit zero-pad word rows
+            else:
+                c = bool(s.col_const[row]) if s.col_const is not None else False
+                v = int(s.col_first[row]) if s.col_first is not None else 0
+            if not c:
+                is_const[row] = False
+            elif first_vals[row] is None:
+                first_vals[row] = v
+            elif first_vals[row] != v:
+                is_const[row] = False
+    sort_rows, n_sort = build_sort_schedule(w, is_const)
+    return StagedCols(cat, sort_rows, n_sort, n, n_pad, w)
