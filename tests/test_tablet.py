@@ -1,0 +1,336 @@
+"""Tablet layer tests: MVCC, locks, write pipeline, rowwise reads.
+
+Modeled on the reference's tablet/docdb unit tests (ref:
+src/yb/tablet/tablet-test.cc, src/yb/docdb/docdb-test.cc,
+src/yb/tablet/mvcc-test.cc).
+"""
+
+import threading
+import time
+
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import HybridClock, HybridTime
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.docdb.lock_manager import (
+    IntentType, LockBatch, SharedLockManager, intents_conflict)
+from yugabyte_tpu.tablet.mvcc import MvccManager
+from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+
+
+SCHEMA = Schema(
+    columns=[
+        ColumnSchema("h", DataType.STRING),
+        ColumnSchema("r", DataType.INT64),
+        ColumnSchema("v1", DataType.STRING),
+        ColumnSchema("v2", DataType.INT64),
+    ],
+    num_hash_key_columns=1,
+    num_range_key_columns=1,
+)
+
+
+def make_tablet(tmp_path, **kw):
+    opts = TabletOptions(auto_compact=False, **kw)
+    return Tablet("t-test", str(tmp_path), SCHEMA, options=opts)
+
+
+def dk(h, r):
+    return DocKey(hash_components=(h,), range_components=(r,))
+
+
+def insert(tablet, h, r, v1=None, v2=None, ttl_ms=None):
+    vals = {}
+    if v1 is not None:
+        vals["v1"] = v1
+    if v2 is not None:
+        vals["v2"] = v2
+    return tablet.write([QLWriteOp(WriteOpKind.INSERT, dk(h, r), vals,
+                                   ttl_ms=ttl_ms)])
+
+
+# ---------------------------------------------------------------------- mvcc
+class TestMvcc:
+    def test_safe_time_advances_with_clock_when_idle(self):
+        clock = HybridClock()
+        m = MvccManager(clock)
+        st1 = m.safe_time()
+        st2 = m.safe_time()
+        assert st2.value >= st1.value
+
+    def test_pending_write_holds_back_safe_time(self):
+        clock = HybridClock()
+        m = MvccManager(clock)
+        ht = clock.now()
+        m.add_pending(ht)
+        assert m._safe_time_unlocked().value == ht.value - 1
+        m.replicated(ht)
+        assert m.safe_time().value >= ht.value
+
+    def test_out_of_order_registration_rejected(self):
+        clock = HybridClock()
+        m = MvccManager(clock)
+        ht = clock.now()
+        m.add_pending(ht)
+        with pytest.raises(ValueError):
+            m.add_pending(HybridTime(ht.value - 5))
+        m.replicated(ht)
+
+    def test_safe_time_blocks_until_replicated(self):
+        clock = HybridClock()
+        m = MvccManager(clock)
+        ht = clock.now()
+        m.add_pending(ht)
+        result = {}
+
+        def reader():
+            result["st"] = m.safe_time(min_allowed=ht)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.05)
+        assert "st" not in result
+        m.replicated(ht)
+        t.join(timeout=5)
+        assert result["st"].value >= ht.value
+
+    def test_follower_uses_propagated_safe_time(self):
+        clock = HybridClock()
+        m = MvccManager(clock)
+        m.set_leader_mode(False)
+        ht = HybridTime.from_micros(12345)
+        m.set_propagated_safe_time(ht)
+        assert m.safe_time_for_follower().value == ht.value
+        # propagated safe time never regresses
+        m.set_propagated_safe_time(HybridTime.from_micros(12))
+        assert m.safe_time_for_follower().value == ht.value
+
+
+# --------------------------------------------------------------------- locks
+class TestLockManager:
+    def test_conflict_matrix(self):
+        W, S = IntentType, IntentType
+        # read/read never conflicts
+        assert not intents_conflict(S.kStrongRead, S.kStrongRead)
+        assert not intents_conflict(S.kWeakRead, S.kStrongRead)
+        # weak/weak never conflicts
+        assert not intents_conflict(W.kWeakWrite, W.kWeakWrite)
+        # strong + write conflicts
+        assert intents_conflict(S.kStrongWrite, S.kStrongWrite)
+        assert intents_conflict(S.kStrongRead, S.kStrongWrite)
+        assert intents_conflict(W.kWeakRead, S.kStrongWrite)
+        assert intents_conflict(W.kWeakWrite, S.kStrongRead)
+
+    def test_weak_locks_share_prefix(self):
+        lm = SharedLockManager()
+        b1 = lm.lock(LockBatch([(b"doc", IntentType.kWeakWrite),
+                                (b"doc/c1", IntentType.kStrongWrite)]))
+        # disjoint column of the same doc: weak+weak on the prefix is fine
+        b2 = lm.lock(LockBatch([(b"doc", IntentType.kWeakWrite),
+                                (b"doc/c2", IntentType.kStrongWrite)]))
+        b1.release()
+        b2.release()
+        assert lm.held_count() == 0
+
+    def test_strong_blocks_weak(self):
+        lm = SharedLockManager()
+        b1 = lm.lock(LockBatch([(b"doc", IntentType.kStrongWrite)]))
+        assert not lm.try_lock(LockBatch([(b"doc", IntentType.kWeakWrite)]))
+        b1.release()
+        assert lm.try_lock(LockBatch([(b"doc", IntentType.kWeakWrite)]))
+
+    def test_blocked_lock_acquires_after_release(self):
+        lm = SharedLockManager()
+        b1 = lm.lock(LockBatch([(b"k", IntentType.kStrongWrite)]))
+        acquired = threading.Event()
+
+        def taker():
+            b = lm.lock(LockBatch([(b"k", IntentType.kStrongWrite)]))
+            acquired.set()
+            b.release()
+
+        t = threading.Thread(target=taker)
+        t.start()
+        time.sleep(0.05)
+        assert not acquired.is_set()
+        b1.release()
+        t.join(timeout=5)
+        assert acquired.is_set()
+
+
+# -------------------------------------------------------------------- tablet
+class TestTabletWrites:
+    def test_insert_and_point_read(self, tmp_path):
+        t = make_tablet(tmp_path)
+        insert(t, "alice", 1, v1="hello", v2=42)
+        row = t.read_row(dk("alice", 1))
+        assert row is not None
+        d = row.to_dict(SCHEMA)
+        assert d == {"h": "alice", "r": 1, "v1": "hello", "v2": 42}
+        assert t.read_row(dk("bob", 1)) is None
+        t.close()
+
+    def test_update_overwrites_only_touched_columns(self, tmp_path):
+        t = make_tablet(tmp_path)
+        insert(t, "a", 1, v1="x", v2=1)
+        t.write([QLWriteOp(WriteOpKind.UPDATE, dk("a", 1), {"v2": 2})])
+        d = t.read_row(dk("a", 1)).to_dict(SCHEMA)
+        assert d["v1"] == "x" and d["v2"] == 2
+        t.close()
+
+    def test_update_to_null_deletes_column(self, tmp_path):
+        t = make_tablet(tmp_path)
+        insert(t, "a", 1, v1="x", v2=1)
+        t.write([QLWriteOp(WriteOpKind.UPDATE, dk("a", 1), {"v1": None})])
+        d = t.read_row(dk("a", 1)).to_dict(SCHEMA)
+        assert d["v1"] is None and d["v2"] == 1
+        t.close()
+
+    def test_delete_row_then_reinsert(self, tmp_path):
+        t = make_tablet(tmp_path)
+        insert(t, "a", 1, v1="x")
+        t.write([QLWriteOp(WriteOpKind.DELETE_ROW, dk("a", 1))])
+        assert t.read_row(dk("a", 1)) is None
+        insert(t, "a", 1, v2=7)
+        d = t.read_row(dk("a", 1)).to_dict(SCHEMA)
+        # v1 from before the row tombstone must NOT resurface
+        assert d["v1"] is None and d["v2"] == 7
+        t.close()
+
+    def test_update_alone_does_not_create_row(self, tmp_path):
+        # CQL semantics: UPDATE writes columns without liveness; the row is
+        # visible because a column exists — but after deleting that column
+        # the row vanishes (no liveness marker).
+        t = make_tablet(tmp_path)
+        t.write([QLWriteOp(WriteOpKind.UPDATE, dk("u", 1), {"v1": "only"})])
+        assert t.read_row(dk("u", 1)) is not None
+        t.write([QLWriteOp(WriteOpKind.DELETE_COLS, dk("u", 1),
+                           columns_to_delete=("v1",))])
+        assert t.read_row(dk("u", 1)) is None
+        t.close()
+
+    def test_insert_survives_deleting_all_columns(self, tmp_path):
+        # INSERT writes liveness: row exists even with all columns deleted.
+        t = make_tablet(tmp_path)
+        insert(t, "a", 1, v1="x")
+        t.write([QLWriteOp(WriteOpKind.DELETE_COLS, dk("a", 1),
+                           columns_to_delete=("v1",))])
+        row = t.read_row(dk("a", 1))
+        assert row is not None
+        assert row.to_dict(SCHEMA)["v1"] is None
+        t.close()
+
+    def test_snapshot_read_at_past_ht(self, tmp_path):
+        t = make_tablet(tmp_path)
+        ht1 = insert(t, "a", 1, v1="old")
+        t.write([QLWriteOp(WriteOpKind.UPDATE, dk("a", 1), {"v1": "new"})])
+        assert t.read_row(dk("a", 1)).to_dict(SCHEMA)["v1"] == "new"
+        assert t.read_row(dk("a", 1), read_ht=ht1).to_dict(SCHEMA)["v1"] == "old"
+        t.close()
+
+    def test_read_after_flush_and_compact(self, tmp_path):
+        t = make_tablet(tmp_path)
+        for i in range(20):
+            insert(t, "u", i, v1=f"val{i}", v2=i)
+        t.flush()
+        for i in range(0, 20, 2):
+            t.write([QLWriteOp(WriteOpKind.UPDATE, dk("u", i),
+                               {"v1": f"upd{i}"})])
+        t.flush()
+        t.compact()
+        for i in range(20):
+            d = t.read_row(dk("u", i)).to_dict(SCHEMA)
+            expect = f"upd{i}" if i % 2 == 0 else f"val{i}"
+            assert d["v1"] == expect, (i, d)
+        t.close()
+
+    def test_ttl_expiry(self, tmp_path):
+        t = make_tablet(tmp_path)
+        insert(t, "a", 1, v1="ephemeral", ttl_ms=1)
+        insert(t, "a", 2, v1="persistent")
+        time.sleep(0.01)
+        assert t.read_row(dk("a", 1)) is None
+        assert t.read_row(dk("a", 2)) is not None
+        t.close()
+
+    def test_scan_returns_rows_in_key_order(self, tmp_path):
+        t = make_tablet(tmp_path)
+        for i in range(10):
+            insert(t, "scan", i, v2=i * 10)
+        rows = [r.to_dict(SCHEMA) for r in t.scan()]
+        assert [r["r"] for r in rows] == sorted(r["r"] for r in rows)
+        assert len(rows) == 10
+        assert all(r["v2"] == r["r"] * 10 for r in rows)
+        t.close()
+
+    def test_scan_with_limit_pages(self, tmp_path):
+        t = make_tablet(tmp_path)
+        for i in range(10):
+            insert(t, "p", i, v2=i)
+        it = t.scan()
+        first = [r.to_dict(SCHEMA)["r"] for r in it.rows(limit=4)]
+        assert len(first) == 4
+        resume = it.next_doc_key
+        assert resume is not None
+        it2 = t.scan(lower_doc_key=resume)
+        rest = [r.to_dict(SCHEMA)["r"] for r in it2]
+        assert sorted(first + rest) == list(range(10))
+        t.close()
+
+    def test_concurrent_writers_same_row_serialize(self, tmp_path):
+        t = make_tablet(tmp_path)
+        n_threads, n_iters = 4, 25
+        errors = []
+
+        def writer(tid):
+            try:
+                for i in range(n_iters):
+                    t.write([QLWriteOp(WriteOpKind.UPDATE, dk("hot", 0),
+                                       {"v2": tid * 1000 + i})])
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
+        row = t.read_row(dk("hot", 0))
+        assert row is not None and row.to_dict(SCHEMA)["v2"] is not None
+        t.close()
+
+    def test_write_visible_at_returned_ht(self, tmp_path):
+        t = make_tablet(tmp_path)
+        ht = insert(t, "vis", 1, v1="x")
+        assert t.read_row(dk("vis", 1), read_ht=ht) is not None
+        assert t.read_row(dk("vis", 1),
+                          read_ht=HybridTime(ht.value - 1)) is None
+        t.close()
+
+    def test_split_key_is_median_doc(self, tmp_path):
+        t = make_tablet(tmp_path)
+        for i in range(9):
+            insert(t, "s", i, v2=i)
+        sk = t.split_key()
+        assert sk is not None
+        lower = [r.to_dict(SCHEMA)["r"] for r in t.scan(upper_doc_key=sk)]
+        upper = [r.to_dict(SCHEMA)["r"] for r in t.scan(lower_doc_key=sk)]
+        assert sorted(lower + upper) == list(range(9))
+        assert 3 <= len(lower) <= 6
+        t.close()
+
+    def test_checkpoint_restores(self, tmp_path):
+        t = make_tablet(tmp_path / "src")
+        for i in range(5):
+            insert(t, "c", i, v1=f"v{i}")
+        t.checkpoint(str(tmp_path / "ckpt"))
+        t.close()
+        t2 = Tablet("t-restored", str(tmp_path / "ckpt"), SCHEMA,
+                    options=TabletOptions(auto_compact=False))
+        rows = [r.to_dict(SCHEMA) for r in t2.scan()]
+        assert len(rows) == 5
+        t2.close()

@@ -1,0 +1,175 @@
+"""DocRowwiseIterator: assemble rows from flattened MVCC KV pairs.
+
+Capability parity with the reference's read path (ref:
+src/yb/docdb/doc_rowwise_iterator.cc:1036 Init, src/yb/docdb/doc_reader.h:73
+DocDBTableReader, src/yb/docdb/subdoc_reader.h:80). Walks the merged
+(internal_key, value) stream of a DB in memcmp order — key ascending, then
+DocHybridTime DESCENDING — so for each distinct doc path the FIRST version
+with ht <= read_ht is the visible one.
+
+Visibility rules implemented (matching docdb semantics):
+  - a row-level tombstone at the bare DocKey shadows every column write with
+    an older DocHybridTime (init-marker overwrite semantics);
+  - a column whose visible version is a tombstone is absent;
+  - TTL: a value written at `t` with ttl expires at t + ttl — reads at or
+    after the expiry treat it as absent (ref: docdb_compaction_filter.cc
+    expiry rules :260-279 applied here at read time);
+  - a row exists iff its liveness system column or any value column is
+    visible (ref: doc_reader.cc row existence via liveness).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+from yugabyte_tpu.common.schema import Schema
+from yugabyte_tpu.docdb.doc_key import DocKey, SubDocKey, split_key_and_ht
+from yugabyte_tpu.docdb.doc_operations import kLivenessColumnId
+from yugabyte_tpu.docdb.value import Value
+from yugabyte_tpu.docdb.value_type import ValueType
+from yugabyte_tpu.ops.slabs import _doc_key_len
+
+
+def _is_expired(value: Value, write_dht: DocHybridTime,
+                read_ht: HybridTime) -> bool:
+    if value.ttl_ms is None:
+        return False
+    expiry_micros = write_dht.ht.physical_micros + value.ttl_ms * 1000
+    return read_ht.physical_micros >= expiry_micros
+
+
+@dataclass
+class Row:
+    doc_key: DocKey
+    columns: Dict[int, object]      # column id -> decoded primitive
+    write_ht: HybridTime            # max HT contributing to this row
+
+    def to_dict(self, schema: Schema) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for c, v in zip(schema.hash_columns, self.doc_key.hash_components):
+            out[c.name] = v
+        for c, v in zip(schema.range_columns, self.doc_key.range_components):
+            out[c.name] = v
+        for c in schema.value_columns:
+            cid = schema.column_id(c.name)
+            out[c.name] = self.columns.get(cid)
+        return out
+
+
+class DocRowwiseIterator:
+    """Iterate rows of one table between doc-key bounds at a read time."""
+
+    def __init__(self, db, schema: Schema, read_ht: HybridTime,
+                 lower_doc_key: bytes = b"",
+                 upper_doc_key: Optional[bytes] = None,
+                 projection: Optional[Sequence[int]] = None):
+        self._db = db
+        self._schema = schema
+        self._read_ht = read_ht
+        self._lower = lower_doc_key
+        self._upper = upper_doc_key
+        self._projection = set(projection) if projection is not None else None
+        # resume point for paging: encoded doc key to seek past
+        self.next_doc_key: Optional[bytes] = None
+
+    # The read_ht as a DocHybridTime upper bound: everything with
+    # (ht, write_id) <= (read_ht, max) is visible.
+    def _visible(self, dht: DocHybridTime) -> bool:
+        return dht.ht.value <= self._read_ht.value
+
+    def __iter__(self) -> Iterator[Row]:
+        return self.rows()
+
+    def rows(self, limit: Optional[int] = None) -> Iterator[Row]:
+        stream = self._db.iter_from(self._lower)
+        cur_doc: Optional[bytes] = None
+        # per doc state. doc_overwrite is the DocHybridTime of the latest
+        # visible bare-DocKey entry: BOTH a tombstone and an object init
+        # marker replace the whole older subdocument (ref: docdb/doc.md
+        # init-marker overwrite semantics), so either shadows older columns.
+        doc_overwrite: Optional[DocHybridTime] = None
+        columns: Dict[int, object] = {}
+        seen_paths: set = set()
+        liveness = False
+        max_ht = HybridTime.kMin
+        emitted = 0
+
+        def finish() -> Optional[Row]:
+            if cur_doc is None or (not liveness and not columns):
+                return None
+            dk, _ = DocKey.decode(cur_doc)
+            return Row(dk, dict(columns), max_ht)
+
+        for ikey, raw_value in stream:
+            prefix, dht = split_key_and_ht(ikey)
+            if dht is None:
+                continue
+            dk_len = _doc_key_len(prefix)
+            doc = prefix[:dk_len]
+            if self._upper is not None and doc >= self._upper:
+                break
+            if doc != cur_doc:
+                row = finish()
+                if row is not None:
+                    yield row
+                    emitted += 1
+                    if limit is not None and emitted >= limit:
+                        self.next_doc_key = doc
+                        return
+                cur_doc = doc
+                doc_overwrite = None
+                columns = {}
+                seen_paths = set()
+                liveness = False
+                max_ht = HybridTime.kMin
+            if not self._visible(dht):
+                continue
+            subpath = prefix[dk_len:]
+            if subpath in seen_paths:
+                continue  # older version of an already-resolved path
+            seen_paths.add(subpath)
+            value = Value.decode(raw_value)
+            shadowed = doc_overwrite is not None and dht < doc_overwrite
+            if not subpath:
+                # bare DocKey: row tombstone or object init marker — the
+                # latest visible one shadows all older subdocument content
+                doc_overwrite = dht
+                if not value.is_tombstone and \
+                        not _is_expired(value, dht, self._read_ht):
+                    liveness = True
+                    max_ht = max(max_ht, dht.ht, key=lambda h: h.value)
+                continue
+            if shadowed or value.is_tombstone or \
+                    _is_expired(value, dht, self._read_ht):
+                continue
+            # decode the subkey path: (("col", cid),) for relational rows
+            sdk = SubDocKey.decode(ikey)
+            if len(sdk.subkeys) != 1 or not (
+                    isinstance(sdk.subkeys[0], tuple) and sdk.subkeys[0][0] == "col"):
+                continue  # deeper subdocument paths: not part of a flat row
+            cid = sdk.subkeys[0][1]
+            max_ht = max(max_ht, dht.ht, key=lambda h: h.value)
+            if cid == kLivenessColumnId:
+                liveness = True
+                continue
+            if self._projection is not None and cid not in self._projection:
+                continue
+            columns[cid] = value.primitive
+        row = finish()
+        if row is not None:
+            yield row
+        self.next_doc_key = None
+
+
+def read_row(db, schema: Schema, doc_key: DocKey, read_ht: HybridTime,
+             projection: Optional[Sequence[int]] = None) -> Optional[Row]:
+    """Point row lookup (the QL read-one path)."""
+    encoded = doc_key.encode()
+    it = DocRowwiseIterator(db, schema, read_ht, lower_doc_key=encoded,
+                            upper_doc_key=encoded + bytes([ValueType.kMaxByte]),
+                            projection=projection)
+    for row in it:
+        return row
+    return None

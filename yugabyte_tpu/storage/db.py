@@ -250,7 +250,9 @@ class DB:
                 for p in (fm.path, data_file_name(fm.path)):
                     os.link(p, os.path.join(out_dir, os.path.basename(p)))
             import shutil
-            shutil.copy(self.versions.manifest_path, os.path.join(out_dir, "MANIFEST"))
+            if os.path.exists(self.versions.manifest_path):
+                shutil.copy(self.versions.manifest_path,
+                            os.path.join(out_dir, "MANIFEST"))
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,7 @@
+"""Replicated tablet layer: Tablet, MvccManager, WriteQuery pipeline.
+
+Capability parity with src/yb/tablet (ref: tablet/tablet.h:124,
+tablet/write_query.cc, tablet/mvcc.h). One Tablet = one shard, holding TWO
+LSM instances — regular and intents (ref: tablet/tablet.h:856-857) — plus
+the MVCC safe-time machinery that makes snapshot reads consistent.
+"""

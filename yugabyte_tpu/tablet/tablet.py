@@ -1,0 +1,220 @@
+"""Tablet: one shard = two LSM instances + MVCC + locks + write pipeline.
+
+Capability parity with the reference (ref: src/yb/tablet/tablet.h:124;
+regular_db_/intents_db_ pair :856-857; apply path tablet.cc:1116
+ApplyRowOperations -> :1198 ApplyKeyValueRowOperations -> :1247 WriteToRocksDB
+where the Raft index becomes the storage frontier; read handlers :1290+).
+
+The write pipeline here is WriteQuery (ref: tablet/write_query.cc): acquire
+doc-path locks -> (txn conflict resolution, stage 8) -> pick hybrid time and
+register with MVCC -> submit through the consensus seam -> apply -> release.
+Round-1 consensus seam is LocalConsensusContext (applies immediately,
+monotonically numbering ops); RaftConsensus replaces it in stage 6 behind the
+same `submit()` interface.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from yugabyte_tpu.common.hybrid_time import (
+    DocHybridTime, HybridClock, HybridTime)
+from yugabyte_tpu.common.schema import Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import (
+    QLWriteOp, assemble_doc_write_batch, prepare_doc_write_operation)
+from yugabyte_tpu.docdb.doc_rowwise_iterator import (
+    DocRowwiseIterator, Row, read_row)
+from yugabyte_tpu.docdb.lock_manager import SharedLockManager
+from yugabyte_tpu.docdb.value_type import ValueType
+from yugabyte_tpu.ops.slabs import _doc_key_len
+from yugabyte_tpu.storage.db import DB, DBOptions
+from yugabyte_tpu.tablet.mvcc import MvccManager
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.metrics import Counter, Histogram, MetricRegistry
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag(
+    "timestamp_history_retention_interval_sec", 900,
+    "how far back in time reads are repeatable; compaction keeps overwritten "
+    "values younger than this (ref tablet_retention_policy.h:29)")
+
+
+class TabletRetentionPolicy:
+    """history_cutoff = now - retention interval (ref tablet_retention_policy.h)."""
+
+    def __init__(self, clock: HybridClock):
+        self._clock = clock
+
+    def history_cutoff(self) -> int:
+        retention_us = flags.get_flag(
+            "timestamp_history_retention_interval_sec") * 1_000_000
+        now = self._clock.now()
+        return max(0, HybridTime.from_micros(
+            now.physical_micros - retention_us).value)
+
+
+class LocalConsensusContext:
+    """Round-1 consensus seam: no replication, ops numbered monotonically.
+    Same submit() surface RaftConsensus implements in stage 6."""
+
+    def __init__(self, tablet: "Tablet"):
+        self._tablet = tablet
+        self._index = 0
+        self._lock = threading.Lock()
+
+    def submit(self, kv_pairs, ht: HybridTime) -> Tuple[int, int]:
+        with self._lock:
+            self._index += 1
+            op_id = (1, self._index)  # (term, index)
+        self._tablet.apply_write_batch(kv_pairs, ht, op_id)
+        return op_id
+
+
+@dataclass
+class TabletOptions:
+    block_entries: int = 4096
+    device: object = None
+    device_cache: object = None
+    compaction_pool: object = None
+    auto_compact: bool = True
+    memstore_size_bytes: Optional[int] = None
+
+
+class Tablet:
+    def __init__(self, tablet_id: str, data_dir: str, schema: Schema,
+                 clock: Optional[HybridClock] = None,
+                 options: Optional[TabletOptions] = None,
+                 metrics: Optional[MetricRegistry] = None):
+        self.tablet_id = tablet_id
+        self.schema = schema
+        self.clock = clock or HybridClock()
+        self.opts = options or TabletOptions()
+        self.retention_policy = TabletRetentionPolicy(self.clock)
+        db_opts = DBOptions(
+            block_entries=self.opts.block_entries,
+            device=self.opts.device,
+            device_cache=self.opts.device_cache,
+            compaction_pool=self.opts.compaction_pool,
+            retention_policy=self.retention_policy.history_cutoff,
+            memstore_size_bytes=self.opts.memstore_size_bytes,
+            auto_compact=self.opts.auto_compact)
+        # Two DB instances, exactly like the reference (tablet.h:856-857):
+        # committed data in regular_db, provisional records in intents_db.
+        self.regular_db = DB(os.path.join(data_dir, "regular"), db_opts)
+        intents_opts = DBOptions(
+            block_entries=self.opts.block_entries,
+            device=self.opts.device,
+            compaction_pool=self.opts.compaction_pool,
+            auto_compact=self.opts.auto_compact)
+        self.intents_db = DB(os.path.join(data_dir, "intents"), intents_opts)
+        self.mvcc = MvccManager(self.clock)
+        self.lock_manager = SharedLockManager()
+        self.consensus = LocalConsensusContext(self)
+        # serializes (clock read -> mvcc.add_pending) so HTs register in order
+        self._submit_lock = threading.Lock()
+        metrics = metrics or MetricRegistry()
+        entity = metrics.entity("tablet", tablet_id)
+        self.metric_rows_inserted = entity.counter(
+            "rows_inserted", "rows written via QL write ops")
+        self.metric_write_latency = entity.histogram(
+            "ql_write_latency_us", "end-to-end WriteQuery latency (us)")
+        self.metric_reads = entity.counter("ql_reads", "row reads served")
+
+    # ------------------------------------------------------------------ write
+    def write(self, ops: Sequence[QLWriteOp],
+              timeout_s: float = 10.0) -> HybridTime:
+        """The WriteQuery pipeline (ref write_query.cc:211-566). Returns the
+        hybrid time at which the batch became visible."""
+        t0 = time.monotonic()
+        lock_batch = prepare_doc_write_operation(
+            ops, self.schema, self.lock_manager, timeout_s=timeout_s)
+        try:
+            kv_pairs = assemble_doc_write_batch(ops, self.schema)
+            with self._submit_lock:
+                ht = self.clock.now()
+                self.mvcc.add_pending(ht)
+            try:
+                self.consensus.submit(kv_pairs, ht)
+            except BaseException:
+                self.mvcc.aborted(ht)
+                raise
+            self.mvcc.replicated(ht)
+        finally:
+            lock_batch.release()
+        self.metric_rows_inserted.increment(len(ops))
+        self.metric_write_latency.increment((time.monotonic() - t0) * 1e6)
+        return ht
+
+    def apply_write_batch(self, kv_pairs: Sequence[Tuple[bytes, bytes]],
+                          ht: HybridTime, op_id: Tuple[int, int]) -> None:
+        """Apply an already-replicated batch to regular_db. Position within
+        the batch becomes the DocHybridTime write_id (ref tablet.cc:1198)."""
+        items = [(key, DocHybridTime(ht, write_id), value)
+                 for write_id, (key, value) in enumerate(kv_pairs)]
+        self.regular_db.write_batch(items, op_id=op_id)
+        TRACE("tablet %s applied %d kvs at %s", self.tablet_id, len(items), ht)
+
+    # ------------------------------------------------------------------- read
+    def read_time(self, read_ht: Optional[HybridTime] = None,
+                  timeout_s: float = 10.0) -> HybridTime:
+        """Pick/validate a read point: wait until SafeTime >= read_ht (ref:
+        read_query.cc:521 ScopedReadOperation + mvcc.h:135)."""
+        if read_ht is None:
+            return self.mvcc.safe_time(timeout_s=timeout_s)
+        self.mvcc.safe_time(min_allowed=read_ht, timeout_s=timeout_s)
+        return read_ht
+
+    def read_row(self, doc_key: DocKey, read_ht: Optional[HybridTime] = None,
+                 projection=None) -> Optional[Row]:
+        ht = self.read_time(read_ht)
+        self.metric_reads.increment()
+        return read_row(self.regular_db, self.schema, doc_key, ht,
+                        projection=projection)
+
+    def scan(self, read_ht: Optional[HybridTime] = None,
+             lower_doc_key: bytes = b"", upper_doc_key: Optional[bytes] = None,
+             projection=None) -> DocRowwiseIterator:
+        ht = self.read_time(read_ht)
+        return DocRowwiseIterator(self.regular_db, self.schema, ht,
+                                  lower_doc_key=lower_doc_key,
+                                  upper_doc_key=upper_doc_key,
+                                  projection=projection)
+
+    # ------------------------------------------------------------ maintenance
+    def flush(self) -> None:
+        self.regular_db.flush()
+        self.intents_db.flush()
+
+    def compact(self) -> None:
+        self.regular_db.compact_all()
+
+    def checkpoint(self, out_dir: str) -> None:
+        """Hard-link snapshot of both DBs (remote bootstrap / backup input)."""
+        self.flush()
+        self.regular_db.checkpoint(os.path.join(out_dir, "regular"))
+        self.intents_db.checkpoint(os.path.join(out_dir, "intents"))
+
+    def split_key(self) -> Optional[bytes]:
+        """Encoded middle DocKey for tablet splitting (ref tablet.cc:3427
+        GetEncodedMiddleSplitKey): median doc key of the live data."""
+        docs: List[bytes] = []
+        last = None
+        for ikey, _v in self.regular_db.iter_from(b""):
+            from yugabyte_tpu.docdb.doc_key import split_key_and_ht
+            prefix, _ = split_key_and_ht(ikey)
+            doc = prefix[:_doc_key_len(prefix)]
+            if doc != last:
+                docs.append(doc)
+                last = doc
+        if len(docs) < 2:
+            return None
+        return docs[len(docs) // 2]
+
+    def close(self) -> None:
+        self.regular_db.close()
+        self.intents_db.close()
