@@ -64,6 +64,53 @@ def test_dist_matches_single(seed, is_major):
     assert dist == single
 
 
+def test_dist_actually_distributes_common_prefix_keys():
+    """Real DocDB keyspaces share leading bytes (value-type tags etc.);
+    routing must still spread documents across shards, and a document's
+    root + column entries must land on ONE shard (GC straddle hazard)."""
+    n_shards = 8
+    entries = []
+    for r in range(256):
+        # two column entries per document
+        for col in (0, 1):
+            key, dkl = mk_key(r, col)
+            entries.append(ModelEntry(key, dkl, ht(100 + r)))
+    slab = slab_from_model(entries)
+    mesh = make_mesh(n_shards)
+    cols, keep, mk = distributed_compact(slab, GCParams(CUTOFF, False), mesh)
+    per_shard = keep.reshape(n_shards, -1).sum(axis=1)
+    # all entries survive, and no shard holds more than half of them
+    assert per_shard.sum() == len(entries)
+    assert (per_shard > 0).sum() >= 4, per_shard
+    assert per_shard.max() <= len(entries) // 2, per_shard
+    # each document's entries are contiguous within one shard slice
+    shard_width = cols.shape[1] // n_shards
+    doc_to_shard = {}
+    for pos in np.nonzero(keep)[0]:
+        dkl_v = int(cols[1, pos])
+        doc = cols[_ROW_WORDS:, pos].astype(">u4").tobytes()[:dkl_v]
+        shard = int(pos) // shard_width
+        assert doc_to_shard.setdefault(doc, shard) == shard, doc
+    assert len(doc_to_shard) == 256
+
+
+def test_dist_short_doc_keys_stay_with_document():
+    """Doc keys shorter than one route word (4 bytes) must not split a
+    document across shards: a root tombstone has to keep covering its
+    subkey entries during major compaction."""
+    entries = []
+    for r in range(64):
+        # 2-byte doc keys: kInt-ish tag + 1 byte; subkey extends past it
+        doc = bytes([0x48, r])
+        entries.append(ModelEntry(doc, 2, ht(500), is_tombstone=True))
+        entries.append(ModelEntry(doc + bytes([0x4B, 0, 1]), 2, ht(400)))
+    single = _kept_set_single(entries, True)
+    dist = _kept_set_dist(entries, True)
+    assert dist == single
+    # the tombstone (visible, major) and the covered subkey both vanish
+    assert len(dist) == 0
+
+
 def test_dist_output_globally_ordered():
     entries = []
     for r in range(100):

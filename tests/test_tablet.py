@@ -303,6 +303,72 @@ class TestTabletWrites:
         assert row is not None and row.to_dict(SCHEMA)["v2"] is not None
         t.close()
 
+    def test_concurrent_disjoint_writers(self, tmp_path):
+        # regression: MVCC requires FIFO completion in HT order; disjoint-key
+        # writers used to complete out of order and crash replicated()
+        t = make_tablet(tmp_path)
+        errors = []
+
+        def writer(tid):
+            try:
+                for i in range(30):
+                    insert(t, f"w{tid}", i, v2=i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+        assert len(list(t.scan())) == 240
+        t.close()
+
+    def test_concurrent_reads_during_writes(self, tmp_path):
+        # regression: safe_time() between a writer's clock read and its MVCC
+        # registration used to fence the writer's hybrid time out
+        t = make_tablet(tmp_path)
+        errors = []
+        stop = threading.Event()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    list(t.scan())
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def writer():
+            try:
+                for i in range(200):
+                    insert(t, "rw", i, v2=i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        rt = threading.Thread(target=reader)
+        wts = [threading.Thread(target=writer) for _ in range(2)]
+        rt.start()
+        for w in wts:
+            w.start()
+        for w in wts:
+            w.join()
+        stop.set()
+        rt.join(timeout=10)
+        assert not errors, errors
+        t.close()
+
+    def test_projection_read_of_updateonly_row(self, tmp_path):
+        # regression: projection used to hide row existence when the only
+        # visible column was outside the projection
+        t = make_tablet(tmp_path)
+        t.write([QLWriteOp(WriteOpKind.UPDATE, dk("pj", 1), {"v1": "only"})])
+        cid_v2 = SCHEMA.column_id("v2")
+        row = t.read_row(dk("pj", 1), projection=[cid_v2])
+        assert row is not None
+        assert row.columns == {}
+        t.close()
+
     def test_write_visible_at_returned_ht(self, tmp_path):
         t = make_tablet(tmp_path)
         ht = insert(t, "vis", 1, v1="x")

@@ -79,31 +79,31 @@ class QLWriteOp:
         return out
 
     # ---------------------------------------------------------------- locks
-    def lock_entries(self, schema: Schema) -> List[Tuple[bytes, IntentType]]:
+    def lock_entries(self, schema: Schema,
+                     kv_pairs: Optional[List[Tuple[bytes, bytes]]] = None
+                     ) -> List[Tuple[bytes, IntentType]]:
         dk_encoded = self.doc_key.encode()
+        if kv_pairs is None:
+            kv_pairs = self.to_kv_pairs(schema)
         entries: List[Tuple[bytes, IntentType]] = []
-        for full_key, _v in self.to_kv_pairs(schema):
+        for full_key, _v in kv_pairs:
             prefixes = [dk_encoded] if full_key != dk_encoded else []
             entries.extend(doc_path_lock_entries(full_key, prefixes, is_write=True))
         return entries
 
 
-def prepare_doc_write_operation(ops: Sequence[QLWriteOp], schema: Schema,
-                                lock_manager, timeout_s: float = 10.0) -> LockBatch:
-    """Build + acquire the lock batch for a set of write ops (ref:
-    docdb/docdb.h:109 PrepareDocWriteOperation)."""
+def prepare_and_assemble(ops: Sequence[QLWriteOp], schema: Schema,
+                         lock_manager, timeout_s: float = 10.0
+                         ) -> Tuple[LockBatch, List[Tuple[bytes, bytes]]]:
+    """Encode each op ONCE; derive both the lock batch and the flattened
+    write batch from the same KV pairs (ref: docdb.h:109
+    PrepareDocWriteOperation + :127 AssembleDocWriteBatch). The index in the
+    returned list becomes the intra-batch write_id."""
     entries: List[Tuple[bytes, IntentType]] = []
+    all_pairs: List[Tuple[bytes, bytes]] = []
     for op in ops:
-        entries.extend(op.lock_entries(schema))
-    return lock_manager.lock(LockBatch(entries), timeout_s=timeout_s)
-
-
-def assemble_doc_write_batch(ops: Sequence[QLWriteOp], schema: Schema
-                             ) -> List[Tuple[bytes, bytes]]:
-    """Flatten all ops into one ordered KV list; index in this list becomes
-    the intra-batch write_id (ref: docdb.h:127 AssembleDocWriteBatch +
-    PrepareNonTransactionWriteBatch assigning IntraTxnWriteId)."""
-    out: List[Tuple[bytes, bytes]] = []
-    for op in ops:
-        out.extend(op.to_kv_pairs(schema))
-    return out
+        pairs = op.to_kv_pairs(schema)
+        entries.extend(op.lock_entries(schema, pairs))
+        all_pairs.extend(pairs)
+    batch = lock_manager.lock(LockBatch(entries), timeout_s=timeout_s)
+    return batch, all_pairs

@@ -92,12 +92,13 @@ class DocRowwiseIterator:
         doc_overwrite: Optional[DocHybridTime] = None
         columns: Dict[int, object] = {}
         seen_paths: set = set()
-        liveness = False
+        liveness = False  # row exists: liveness marker OR any visible column,
+        #                   tracked independently of the projection
         max_ht = HybridTime.kMin
         emitted = 0
 
         def finish() -> Optional[Row]:
-            if cur_doc is None or (not liveness and not columns):
+            if cur_doc is None or not liveness:
                 return None
             dk, _ = DocKey.decode(cur_doc)
             return Row(dk, dict(columns), max_ht)
@@ -151,8 +152,8 @@ class DocRowwiseIterator:
                 continue  # deeper subdocument paths: not part of a flat row
             cid = sdk.subkeys[0][1]
             max_ht = max(max_ht, dht.ht, key=lambda h: h.value)
+            liveness = True  # any visible column proves the row exists
             if cid == kLivenessColumnId:
-                liveness = True
                 continue
             if self._projection is not None and cid not in self._projection:
                 continue

@@ -47,14 +47,11 @@ _CONFLICTS: Dict[IntentType, Tuple[IntentType, ...]] = {
 
 class LockBatch:
     """A set of (key, intent_type) entries acquired and released atomically
-    (ref lock_batch.h:61). Entries are deduplicated keeping the strongest."""
+    (ref lock_batch.h:61). Duplicate (key, intent_type) pairs collapse to one
+    entry; distinct intent types on one key are all kept."""
 
     def __init__(self, entries: Iterable[Tuple[bytes, IntentType]] = ()):
-        merged: Dict[Tuple[bytes, IntentType], int] = {}
-        for key, it in entries:
-            merged[(key, it)] = merged.get((key, it), 0) + 1
-        self.entries: List[Tuple[bytes, IntentType]] = sorted(merged)
-        self._counts = merged
+        self.entries: List[Tuple[bytes, IntentType]] = sorted(set(entries))
         self._manager = None
 
     def __len__(self) -> int:

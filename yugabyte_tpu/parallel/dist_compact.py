@@ -7,16 +7,21 @@ here each key range is one DEVICE of a `jax.sharding.Mesh`, and the data
 movement that the reference does with per-thread file iterators happens as
 XLA collectives over ICI:
 
-  1. each shard samples its local route keys (first key word)
+  1. each shard samples its local route keys
   2. all_gather the samples -> identical global splitters on every shard
   3. bucket rows by destination shard; all_to_all exchanges the buckets
      (fixed per-destination capacity with all-0xFF padding rows, which sort
      to the tail and are dropped by the GC keep-mask like all padding)
   4. per-shard fused radix merge + MVCC GC (ops/merge_gc.sort_and_gc)
 
-Routing is by the first 32-bit key word, which keeps every version of a key
-AND every subkey of a document on one shard (a document's entries share
-their first 4 key bytes), so GC segment logic never straddles shards.
+Routing is by the first `_W_ROUTE` 32-bit words of the DOC KEY portion of
+each key (words masked to doc_key_len, zero beyond it), compared
+lexicographically. Every entry of one document has identical doc-key bytes
+and doc_key_len, hence an identical route key — so a document's root + column
+entries and all versions of a key always land on one shard and the GC segment
+logic never straddles shards. Because routing is an order-preserving prefix
+of the key, shards remain globally range-partitioned: shard s's keys all
+sort <= shard s+1's.
 
 Returns per-shard sorted cols + keep/make-tombstone masks + an overflow flag
 (a bucket exceeding capacity means splitters were too skewed: the caller
@@ -37,13 +42,25 @@ from jax import shard_map
 
 from yugabyte_tpu.ops import merge_gc
 from yugabyte_tpu.ops.merge_gc import (
-    _ROW_KEY_LEN, _ROW_WORDS, GCParams, PAD_SENTINEL, pack_cols, pad_template,
-    sort_and_gc)
+    _ROW_DKL, _ROW_KEY_LEN, _ROW_WORDS, GCParams, PAD_SENTINEL, pack_cols,
+    pad_template, sort_and_gc)
+
+# Route on up to this many leading doc-key words (16 bytes). Documents whose
+# doc keys share all 16 bytes route to the same bucket; the overflow retry
+# absorbs the resulting skew, so this is a perf knob, not correctness.
+_W_ROUTE = 4
+
+_SAMPLES_PER_SHARD = 64
 
 
-def dist_compact_fn(mesh: Mesh, w: int, capacity: int, is_major: bool,
+@functools.lru_cache(maxsize=64)
+def dist_compact_fn(mesh: Mesh, capacity: int, is_major: bool,
                     retain_deletes: bool = False, axis: str = "shard"):
-    """Build the jitted distributed compaction step for a mesh.
+    """Build (and cache) the jitted distributed compaction step for a mesh.
+
+    Cached per (mesh, capacity, is_major, retain_deletes, axis): rebuilding
+    the shard_map closure per call would defeat the jit trace cache and
+    re-lower the whole multi-collective program every compaction.
 
     Input cols: [R, n_total] sharded along dim 1; n_total = n_shards * n_local.
     Output: (cols_out [R, n_shards*capacity] sharded, keep, make_tombstone,
@@ -51,30 +68,53 @@ def dist_compact_fn(mesh: Mesh, w: int, capacity: int, is_major: bool,
     """
     n_shards = mesh.devices.size
 
-    def per_shard(cols_local, n_real_total, cutoff_hi, cutoff_lo, cph, cpl):
+    def per_shard(cols_local, cutoff_hi, cutoff_lo, cph, cpl):
         r, n_local = cols_local.shape
-        route = cols_local[_ROW_WORDS]                      # first key word
+        w_route = min(_W_ROUTE, r - _ROW_WORDS)
+        u32max = jnp.uint32(0xFFFFFFFF)
         is_pad_in = cols_local[_ROW_KEY_LEN] == jnp.uint32(PAD_SENTINEL)
+        # -- route key: doc-key words masked to doc_key_len ----------------
+        # (identical across every entry/version of one document; padding
+        # rows get all-0xFF route words so they route to the last shard)
+        dkl = cols_local[_ROW_DKL].astype(jnp.int32)      # pad rows: -1
+        words = cols_local[_ROW_WORDS:_ROW_WORDS + w_route]
+        widx = jnp.arange(w_route, dtype=jnp.int32)[:, None]
+        nbytes = jnp.clip(dkl[None, :] - widx * 4, 0, 4)
+        mask = jnp.where(
+            nbytes >= 4, u32max,
+            jnp.where(nbytes == 0, jnp.uint32(0),
+                      (u32max << ((4 - nbytes).astype(jnp.uint32) * 8)) & u32max))
+        route = jnp.where(is_pad_in[None, :], u32max, words & mask)
         # -- 1/2: sample + all_gather + splitters --------------------------
-        # padding samples carry 0xFFFFFFFF route words and sort to the tail;
-        # quantiles are taken over the expected REAL sample count so padding
-        # never skews splitters toward empty high shards.
-        step = max(1, n_local // 64)
-        samples = route[::step][:64] if n_local >= 64 else route
-        n_samp = samples.shape[0]
-        all_samples = jax.lax.all_gather(samples, axis).reshape(-1)
-        (sorted_samples,) = jax.lax.sort([all_samples], num_keys=1)
-        total_rows = n_shards * n_local
-        n_real_samples = (all_samples.shape[0] * n_real_total) // total_rows
-        n_real_samples = jnp.maximum(n_real_samples, 1)
+        step = max(1, n_local // _SAMPLES_PER_SHARD)
+        samples = route[:, ::step][:, :_SAMPLES_PER_SHARD]  # [w_route, s_loc]
+        samp_pad = is_pad_in[::step][:_SAMPLES_PER_SHARD]
+        g_samp = jax.lax.all_gather(samples, axis)          # [shards, w, s_loc]
+        g_samp = jnp.moveaxis(g_samp, 1, 0).reshape(w_route, -1)
+        g_pad = jax.lax.all_gather(samp_pad, axis).reshape(-1)
+        # lex sort on the route words with the pad flag as final tiebreak,
+        # so padding samples sort strictly after real ones even on 0xFF ties
+        sorted_ops = jax.lax.sort(
+            [g_samp[i] for i in range(w_route)] + [g_pad.astype(jnp.uint32)],
+            num_keys=w_route + 1)
+        # exact real-sample count (no row-count arithmetic -> no overflow)
+        n_real_samples = jnp.maximum(
+            g_pad.shape[0] - jnp.sum(g_pad.astype(jnp.int32)), 1)
         qs = (jnp.arange(1, n_shards) * n_real_samples) // n_shards
-        splitters = sorted_samples[qs]                      # [n_shards-1]
+        splitters = [sorted_ops[i][qs] for i in range(w_route)]  # each [S-1]
         # -- 3: bucket + exchange ------------------------------------------
-        # input padding rows route to the LAST shard (route word 0xFF..) but
-        # are excluded from counts so they can't trigger a spurious overflow
-        dest = jnp.sum(route[:, None] >= splitters[None, :], axis=1)  # [n_local]
-        order = jnp.argsort(dest)                           # stable
-        real_dest = jnp.where(is_pad_in, n_shards, dest)    # bin n_shards: pad
+        # dest = number of splitters lexicographically <= route key
+        lt = jnp.zeros((n_local, n_shards - 1), bool)
+        eq = jnp.ones((n_local, n_shards - 1), bool)
+        for i in range(w_route):
+            rw, sw = route[i][:, None], splitters[i][None, :]
+            lt = lt | (eq & (rw < sw))
+            eq = eq & (rw == sw)
+        dest = jnp.sum(~lt, axis=1)                          # [n_local]
+        order = jnp.argsort(dest)                            # stable
+        # input padding rows route to the LAST shard but are excluded from
+        # counts so they can't trigger a spurious overflow
+        real_dest = jnp.where(is_pad_in, n_shards, dest)     # bin n_shards: pad
         counts = jnp.bincount(real_dest, length=n_shards + 1)[:n_shards]
         all_counts = jnp.bincount(dest, length=n_shards)
         offsets = jnp.concatenate(
@@ -106,7 +146,7 @@ def dist_compact_fn(mesh: Mesh, w: int, capacity: int, is_major: bool,
     spec = P(None, axis)
     fn = shard_map(
         per_shard, mesh=mesh,
-        in_specs=(spec, P(), P(), P(), P(), P()),
+        in_specs=(spec, P(), P(), P(), P()),
         out_specs=(spec, P(axis), P(axis), P(axis)))
     return jax.jit(fn)
 
@@ -119,11 +159,11 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
     follow ops/merge_gc layout, in globally range-partitioned sorted order
     (shard s holds keys <= shard s+1's)."""
     n_shards = mesh.devices.size
-    cols, n, n_pad, w = pack_cols(slab)
-    # pad n_pad to a multiple of shards (pack_cols gives powers of two; mesh
-    # sizes are powers of two on TPU pods)
-    if n_pad % n_shards:
-        extra = n_shards - (n_pad % n_shards)
+    cols = pack_cols(slab)[0]
+    # pad the column count to a multiple of shards (pack_cols gives powers
+    # of two; mesh sizes are powers of two on TPU pods)
+    if cols.shape[1] % n_shards:
+        extra = n_shards - (cols.shape[1] % n_shards)
         pad_block = np.tile(pad_template(cols.shape[0])[:, None], (1, extra))
         cols = np.concatenate([cols, pad_block], axis=1)
     n_local = cols.shape[1] // n_shards
@@ -132,11 +172,10 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
     capacity = max(64, int(n_local / n_shards * capacity_factor))
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
-    fn = dist_compact_fn(mesh, w, capacity, params.is_major_compaction,
+    fn = dist_compact_fn(mesh, capacity, params.is_major_compaction,
                          params.retain_deletes, axis)
     out, keep, mk, overflow = fn(
-        cols, jnp.int32(n), jnp.uint32(cutoff >> 32),
-        jnp.uint32(cutoff & 0xFFFFFFFF),
+        cols, jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF))
     if bool(np.any(np.asarray(overflow))):
         if capacity_factor >= 64:

@@ -29,8 +29,10 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
+    # `<=`, not `<`: a fresh checkout gives source and any stale binary the
+    # SAME mtime, and a foreign-machine -march=native .so must never run here
     if (not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            or os.path.getmtime(_LIB) <= os.path.getmtime(_SRC)):
         os.makedirs(_BUILD_DIR, exist_ok=True)
         subprocess.run(["g++", "-O3", "-march=native", "-shared", "-fPIC",
                         "-o", _LIB, _SRC], check=True)

@@ -85,8 +85,11 @@ class DB:
                 self.mem.add(key_prefix, dht, value)
             self._last_op_id = max(getattr(self, "_last_op_id", (0, 0)), op_id)
             limit = self.opts.memstore_size_bytes or flags.get_flag("memstore_size_bytes")
-            if self.mem.approximate_bytes >= limit:
-                self.flush()
+            need_flush = self.mem.approximate_bytes >= limit
+        # flush outside the lock: concurrent writers keep inserting into the
+        # fresh memtable while the immutable one packs + writes its SST
+        if need_flush:
+            self.flush()
 
     # ------------------------------------------------------------------ read
     def get(self, key_prefix: bytes, read_ht: Optional[HybridTime] = None
@@ -260,6 +263,9 @@ class DB:
             for r in self._readers.values():
                 r.close()
             self._readers.clear()
+            if self._device_cache is not None and \
+                    hasattr(self._device_cache, "drop_all"):
+                self._device_cache.drop_all()  # free this DB's HBM residency
 
     @property
     def n_live_files(self) -> int:

@@ -17,12 +17,12 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from yugabyte_tpu.ops.merge_gc import (
-    _ROW_WORDS, StagedCols, bucket_size, build_sort_schedule, pack_cols,
+    _ROW_WORDS, StagedCols, bucket_size, build_sort_schedule,
     pad_template, stage_slab)
 from yugabyte_tpu.ops.slabs import KVSlab
 
@@ -69,6 +69,13 @@ class DeviceSlabCache:
             if staged is not None:
                 self._used -= staged.nbytes
 
+    def drop_namespace(self, namespace: str) -> None:
+        """Evict everything a closed DB staged, freeing its HBM residency."""
+        with self._lock:
+            dead = [k for k in self._map if k[0] == namespace]
+            for k in dead:
+                self._used -= self._map.pop(k).nbytes
+
     def stage(self, key: CacheKey, slab: KVSlab) -> StagedCols:
         staged = stage_slab(slab, self.device)
         self.put(key, staged)
@@ -102,6 +109,9 @@ class NamespacedSlabCache:
 
     def drop(self, file_id: int) -> None:
         self._shared.drop((self.namespace, file_id))
+
+    def drop_all(self) -> None:
+        self._shared.drop_namespace(self.namespace)
 
     def stage(self, file_id: int, slab: KVSlab) -> StagedCols:
         return self._shared.stage((self.namespace, file_id), slab)

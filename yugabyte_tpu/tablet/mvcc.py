@@ -30,6 +30,7 @@ class MvccManager:
         self._clock = clock
         self._cv = threading.Condition()
         self._queue: deque = deque()          # in-flight HTs, non-decreasing
+        self._done: set = set()               # completed but not yet drained
         self._last_replicated = HybridTime.kMin
         self._max_safe_time_returned = HybridTime.kMin
         self._propagated_safe_time: Optional[HybridTime] = None  # follower mode
@@ -47,20 +48,56 @@ class MvccManager:
                     f"write at {ht} would violate safe time {self._max_safe_time_returned}")
             self._queue.append(ht)
 
-    def replicated(self, ht: HybridTime) -> None:
-        """The write at `ht` is durably replicated + applied."""
+    def add_pending_now(self) -> HybridTime:
+        """Atomically pick a hybrid time from the clock AND register it.
+
+        The clock read must happen under the MVCC lock: a reader calling
+        safe_time() between a writer's clock read and its registration would
+        otherwise fence the writer's (already-drawn, lower) hybrid time out
+        (the reference ties AddPending to the clock the same way)."""
         with self._cv:
-            if not self._queue or self._queue[0].value != ht.value:
-                raise ValueError(f"Replicated({ht}) does not match head of queue")
-            self._queue.popleft()
-            self._last_replicated = ht
-            self._cv.notify_all()
+            # Safe time can run ahead of the local clock when seeded from an
+            # external source (bootstrap frontier, propagated leader safe
+            # time): fold that bound into the clock so the drawn ht always
+            # lands above every previously returned safe time.
+            floor = self._max_safe_time_returned
+            if self._last_replicated.value > floor.value:
+                floor = self._last_replicated
+            if self._queue and self._queue[-1].value > floor.value:
+                floor = self._queue[-1]
+            if floor.value > 0:
+                self._clock.update(floor)
+            ht = self._clock.now()
+            assert ht.value > self._max_safe_time_returned.value and (
+                not self._queue or ht.value >= self._queue[-1].value)
+            self._queue.append(ht)
+            return ht
+
+    def replicated(self, ht: HybridTime) -> None:
+        """The write at `ht` is durably replicated + applied.
+
+        Completions may arrive out of order (concurrent appliers): they are
+        buffered and the queue drains strictly in hybrid-time order, so safe
+        time never jumps over a still-pending earlier write."""
+        with self._cv:
+            if ht not in self._queue:
+                raise ValueError(f"Replicated({ht}) was never registered")
+            self._done.add(ht.value)
+            self._drain_done()
 
     def aborted(self, ht: HybridTime) -> None:
         """The write at `ht` was aborted before applying (leader change)."""
         with self._cv:
             self._queue.remove(ht)
-            self._cv.notify_all()
+            self._drain_done()
+
+    def _drain_done(self) -> None:
+        while self._queue and self._queue[0].value in self._done:
+            head = self._queue.popleft()
+            self._done.remove(head.value)
+            if head.value > self._last_replicated.value:
+                self._last_replicated = head
+        self._cv.notify_all()
 
     # ------------------------------------------------------------- safe time
     def safe_time(self, min_allowed: Optional[HybridTime] = None,

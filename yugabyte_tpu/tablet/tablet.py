@@ -25,8 +25,7 @@ from yugabyte_tpu.common.hybrid_time import (
     DocHybridTime, HybridClock, HybridTime)
 from yugabyte_tpu.common.schema import Schema
 from yugabyte_tpu.docdb.doc_key import DocKey
-from yugabyte_tpu.docdb.doc_operations import (
-    QLWriteOp, assemble_doc_write_batch, prepare_doc_write_operation)
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, prepare_and_assemble
 from yugabyte_tpu.docdb.doc_rowwise_iterator import (
     DocRowwiseIterator, Row, read_row)
 from yugabyte_tpu.docdb.lock_manager import SharedLockManager
@@ -115,8 +114,6 @@ class Tablet:
         self.mvcc = MvccManager(self.clock)
         self.lock_manager = SharedLockManager()
         self.consensus = LocalConsensusContext(self)
-        # serializes (clock read -> mvcc.add_pending) so HTs register in order
-        self._submit_lock = threading.Lock()
         metrics = metrics or MetricRegistry()
         entity = metrics.entity("tablet", tablet_id)
         self.metric_rows_inserted = entity.counter(
@@ -131,13 +128,14 @@ class Tablet:
         """The WriteQuery pipeline (ref write_query.cc:211-566). Returns the
         hybrid time at which the batch became visible."""
         t0 = time.monotonic()
-        lock_batch = prepare_doc_write_operation(
+        lock_batch, kv_pairs = prepare_and_assemble(
             ops, self.schema, self.lock_manager, timeout_s=timeout_s)
         try:
-            kv_pairs = assemble_doc_write_batch(ops, self.schema)
-            with self._submit_lock:
-                ht = self.clock.now()
-                self.mvcc.add_pending(ht)
+            # Hybrid-time draw + registration is atomic inside MvccManager;
+            # the apply itself runs concurrently across writers (each KV
+            # carries its own DocHybridTime, so apply order is irrelevant)
+            # and MvccManager drains completions in hybrid-time order.
+            ht = self.mvcc.add_pending_now()
             try:
                 self.consensus.submit(kv_pairs, ht)
             except BaseException:
