@@ -148,6 +148,15 @@ def main():
     log(f"  TPU device-resident: {res_s:.2f}s = {n_total/res_s/1e6:.2f}M rows/s "
         f"({staged.n_sort} sort passes)")
 
+    # ---- TPU scan kernel (device-resident, read_ht = cutoff) --------------
+    from yugabyte_tpu.ops.scan import scan_visible
+    scan_visible(staged, cutoff)  # compile
+    t0 = time.time()
+    _, keep_scan = scan_visible(staged, cutoff)
+    scan_s = time.time() - t0
+    log(f"  TPU snapshot scan: {scan_s:.2f}s = {n_total/scan_s/1e6:.2f}M rows/s "
+        f"({int(keep_scan.sum())} visible)")
+
     print(json.dumps({
         "metric": "l0_compaction_merge_gc_rows_per_sec",
         "value": round(tpu_rate, 1),

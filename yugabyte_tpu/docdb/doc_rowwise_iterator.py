@@ -2,14 +2,23 @@
 
 Capability parity with the reference's read path (ref:
 src/yb/docdb/doc_rowwise_iterator.cc:1036 Init, src/yb/docdb/doc_reader.h:73
-DocDBTableReader, src/yb/docdb/subdoc_reader.h:80). Walks the merged
-(internal_key, value) stream of a DB in memcmp order — key ascending, then
-DocHybridTime DESCENDING — so for each distinct doc path the FIRST version
-with ht <= read_ht is the visible one.
+DocDBTableReader, src/yb/docdb/subdoc_reader.h:80). Two stages, shared by the
+CPU and TPU paths:
+
+  RESOLVE — reduce the raw (internal_key, value) stream to exactly the
+  visible version of each doc path at read_ht:
+    * CPU: `DocRowwiseIterator._resolve_visible` walks the merged stream of
+      a DB in memcmp order — key ascending, DocHybridTime DESCENDING — so
+      for each distinct doc path the FIRST version with ht <= read_ht wins;
+    * TPU: the fused scan kernel (ops/scan.py) computes the same set on
+      device for a whole key range at once.
+
+  ASSEMBLE — `VisibleEntryRowAssembler` groups the resolved entries into
+  rows (pure grouping; all visibility logic already happened).
 
 Visibility rules implemented (matching docdb semantics):
-  - a row-level tombstone at the bare DocKey shadows every column write with
-    an older DocHybridTime (init-marker overwrite semantics);
+  - a bare-DocKey entry (row tombstone OR object init marker) shadows every
+    older subdocument write (init-marker overwrite semantics);
   - a column whose visible version is a tombstone is absent;
   - TTL: a value written at `t` with ttl expires at t + ttl — reads at or
     after the expiry treat it as absent (ref: docdb_compaction_filter.cc
@@ -58,40 +67,28 @@ class Row:
         return out
 
 
-class DocRowwiseIterator:
-    """Iterate rows of one table between doc-key bounds at a read time."""
+class VisibleEntryRowAssembler:
+    """Group an already-MVCC-resolved entry stream into rows.
 
-    def __init__(self, db, schema: Schema, read_ht: HybridTime,
-                 lower_doc_key: bytes = b"",
-                 upper_doc_key: Optional[bytes] = None,
+    Input entries are (key_prefix, value_bytes, ht_value) in key order with
+    exactly one visible version per doc path — no tombstones, no shadowed
+    history (see module docstring). Paging interface: rows(limit) +
+    next_doc_key (the resume key when a limit was hit).
+    """
+
+    def __init__(self, entries, schema: Schema,
                  projection: Optional[Sequence[int]] = None):
-        self._db = db
+        self._entries = entries
         self._schema = schema
-        self._read_ht = read_ht
-        self._lower = lower_doc_key
-        self._upper = upper_doc_key
         self._projection = set(projection) if projection is not None else None
-        # resume point for paging: encoded doc key to seek past
         self.next_doc_key: Optional[bytes] = None
-
-    # The read_ht as a DocHybridTime upper bound: everything with
-    # (ht, write_id) <= (read_ht, max) is visible.
-    def _visible(self, dht: DocHybridTime) -> bool:
-        return dht.ht.value <= self._read_ht.value
 
     def __iter__(self) -> Iterator[Row]:
         return self.rows()
 
     def rows(self, limit: Optional[int] = None) -> Iterator[Row]:
-        stream = self._db.iter_from(self._lower)
         cur_doc: Optional[bytes] = None
-        # per doc state. doc_overwrite is the DocHybridTime of the latest
-        # visible bare-DocKey entry: BOTH a tombstone and an object init
-        # marker replace the whole older subdocument (ref: docdb/doc.md
-        # init-marker overwrite semantics), so either shadows older columns.
-        doc_overwrite: Optional[DocHybridTime] = None
         columns: Dict[int, object] = {}
-        seen_paths: set = set()
         liveness = False  # row exists: liveness marker OR any visible column,
         #                   tracked independently of the projection
         max_ht = HybridTime.kMin
@@ -103,14 +100,9 @@ class DocRowwiseIterator:
             dk, _ = DocKey.decode(cur_doc)
             return Row(dk, dict(columns), max_ht)
 
-        for ikey, raw_value in stream:
-            prefix, dht = split_key_and_ht(ikey)
-            if dht is None:
-                continue
-            dk_len = _doc_key_len(prefix)
-            doc = prefix[:dk_len]
-            if self._upper is not None and doc >= self._upper:
-                break
+        for key, raw_value, ht_value in self._entries:
+            dk_len = _doc_key_len(key)
+            doc = key[:dk_len]
             if doc != cur_doc:
                 row = finish()
                 if row is not None:
@@ -120,48 +112,98 @@ class DocRowwiseIterator:
                         self.next_doc_key = doc
                         return
                 cur_doc = doc
-                doc_overwrite = None
                 columns = {}
-                seen_paths = set()
                 liveness = False
                 max_ht = HybridTime.kMin
-            if not self._visible(dht):
+            ht = HybridTime(ht_value)
+            if ht.value > max_ht.value:
+                max_ht = ht
+            if not key[dk_len:]:
+                liveness = True  # visible init marker
                 continue
+            sdk = SubDocKey.decode(key)
+            if len(sdk.subkeys) != 1 or not (
+                    isinstance(sdk.subkeys[0], tuple) and sdk.subkeys[0][0] == "col"):
+                continue  # deeper subdocument paths: not part of a flat row
+            cid = sdk.subkeys[0][1]
+            liveness = True  # any visible column proves the row exists
+            if cid == kLivenessColumnId:
+                continue
+            if self._projection is not None and cid not in self._projection:
+                continue
+            columns[cid] = Value.decode(raw_value).primitive
+        row = finish()
+        if row is not None:
+            yield row
+        self.next_doc_key = None
+
+
+class DocRowwiseIterator:
+    """CPU scan path: resolve MVCC inline while walking the merged stream,
+    then assemble through the shared VisibleEntryRowAssembler."""
+
+    def __init__(self, db, schema: Schema, read_ht: HybridTime,
+                 lower_doc_key: bytes = b"",
+                 upper_doc_key: Optional[bytes] = None,
+                 projection: Optional[Sequence[int]] = None):
+        self._db = db
+        self._schema = schema
+        self._read_ht = read_ht
+        self._lower = lower_doc_key
+        self._upper = upper_doc_key
+        self._assembler = VisibleEntryRowAssembler(
+            self._resolve_visible(), schema, projection=projection)
+
+    @property
+    def next_doc_key(self) -> Optional[bytes]:
+        return self._assembler.next_doc_key
+
+    def __iter__(self) -> Iterator[Row]:
+        return self.rows()
+
+    def rows(self, limit: Optional[int] = None) -> Iterator[Row]:
+        return self._assembler.rows(limit)
+
+    def _resolve_visible(self) -> Iterator[Tuple[bytes, bytes, int]]:
+        """Yield (key, value_bytes, ht_value) of exactly the visible version
+        of each doc path at read_ht (the stream the TPU kernel produces on
+        device for the whole range at once)."""
+        read_ht = self._read_ht
+        cur_doc: Optional[bytes] = None
+        # doc_overwrite: DocHybridTime of the latest visible bare-DocKey
+        # entry — BOTH a tombstone and an object init marker replace the
+        # whole older subdocument, so either shadows older columns.
+        doc_overwrite: Optional[DocHybridTime] = None
+        seen_paths: set = set()
+        for ikey, raw_value in self._db.iter_from(self._lower):
+            prefix, dht = split_key_and_ht(ikey)
+            if dht is None:
+                continue
+            dk_len = _doc_key_len(prefix)
+            doc = prefix[:dk_len]
+            if self._upper is not None and doc >= self._upper:
+                break
+            if doc != cur_doc:
+                cur_doc = doc
+                doc_overwrite = None
+                seen_paths = set()
+            if dht.ht.value > read_ht.value:
+                continue  # newer than the snapshot
             subpath = prefix[dk_len:]
             if subpath in seen_paths:
                 continue  # older version of an already-resolved path
             seen_paths.add(subpath)
             value = Value.decode(raw_value)
             shadowed = doc_overwrite is not None and dht < doc_overwrite
+            dead = (value.is_tombstone or shadowed
+                    or _is_expired(value, dht, read_ht))
             if not subpath:
-                # bare DocKey: row tombstone or object init marker — the
-                # latest visible one shadows all older subdocument content
                 doc_overwrite = dht
-                if not value.is_tombstone and \
-                        not _is_expired(value, dht, self._read_ht):
-                    liveness = True
-                    max_ht = max(max_ht, dht.ht, key=lambda h: h.value)
+                if not dead:
+                    yield prefix, raw_value, dht.ht.value
                 continue
-            if shadowed or value.is_tombstone or \
-                    _is_expired(value, dht, self._read_ht):
-                continue
-            # decode the subkey path: (("col", cid),) for relational rows
-            sdk = SubDocKey.decode(ikey)
-            if len(sdk.subkeys) != 1 or not (
-                    isinstance(sdk.subkeys[0], tuple) and sdk.subkeys[0][0] == "col"):
-                continue  # deeper subdocument paths: not part of a flat row
-            cid = sdk.subkeys[0][1]
-            max_ht = max(max_ht, dht.ht, key=lambda h: h.value)
-            liveness = True  # any visible column proves the row exists
-            if cid == kLivenessColumnId:
-                continue
-            if self._projection is not None and cid not in self._projection:
-                continue
-            columns[cid] = value.primitive
-        row = finish()
-        if row is not None:
-            yield row
-        self.next_doc_key = None
+            if not dead:
+                yield prefix, raw_value, dht.ht.value
 
 
 def read_row(db, schema: Schema, doc_key: DocKey, read_ht: HybridTime,

@@ -70,7 +70,7 @@ def _le_u64(a_hi, a_lo, b_hi, b_lo):
 
 def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
                 w: int, is_major: bool, retain_deletes: bool,
-                sort_rows=None, n_sort=None):
+                sort_rows=None, n_sort=None, snapshot: bool = False):
     """Traceable core: radix merge + GC over one cols matrix.
 
     Reused by the single-chip jit wrapper below and by the distributed
@@ -81,6 +81,15 @@ def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
     build_sort_schedule) — constant columns carry no ordering information,
     so the host drops their passes. Row indices >= _ROW_WORDS sort
     ascending; the ht/wid rows sort descending (complemented in the body).
+
+    snapshot: SCAN mode — the cutoff is a read time and keep marks exactly
+    the version set visible AT that time: one version per key (the first
+    with dht <= read_ht), minus tombstones, TTL-expired values and
+    root-overwrite-covered entries; versions above the read time are
+    excluded rather than retained as history. This turns the same fused
+    program into the MVCC-resolution half of the scan path (ref: the
+    visibility logic of docdb/intent_aware_iterator.cc +
+    doc_rowwise_iterator.cc done per-iterator-step in the reference).
     """
     n = cols.shape[1]
     u32max = jnp.uint32(0xFFFFFFFF)
@@ -167,6 +176,9 @@ def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
     covered = (~is_root) & in_same_doc & dht_le
 
     # ---- tombstone GC + result -------------------------------------------
+    if snapshot:
+        keep = visible_slot & ~covered & ~is_tomb
+        return perm, keep, jnp.zeros_like(keep)
     drop_tomb = (visible_slot & is_tomb & jnp.bool_(is_major)
                  & jnp.bool_(not retain_deletes))
     keep = keep_version & ~covered & ~drop_tomb

@@ -1,0 +1,163 @@
+"""TPU scan/filter kernel: batched MVCC snapshot resolution + range filter.
+
+The scan-path half of the north star (SURVEY.md section 7 stage 4): where the
+reference resolves MVCC visibility one iterator step at a time — min-heap
+MergingIterator (ref: rocksdb/table/merger.cc:51) over block iterators
+(ref: rocksdb/table/block_based_table_reader.cc:1168) with per-key seeks in
+DocRowwiseIterator — this kernel resolves an ENTIRE key range in one fused
+device program:
+
+  1. radix merge of all input runs (memtable + SSTs), reusing the compaction
+     sort (ops/merge_gc.sort_and_gc)
+  2. snapshot GC with cutoff = read_ht: exactly one surviving version per
+     key — the one visible at the read time — with tombstones, TTL-expired
+     values and root-overwrite-covered entries dropped (snapshot=True mode)
+  3. lexicographic range mask over the sorted key words (the block-index +
+     seek equivalent, done as a vectorized compare)
+
+The output is a bit-packed keep mask over the merged order; the host gathers
+surviving (key, value) pairs — values never cross to the device (slabs.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yugabyte_tpu.ops import merge_gc
+from yugabyte_tpu.ops.merge_gc import (
+    _ROW_KEY_LEN, _ROW_WORDS, StagedCols, sort_and_gc)
+from yugabyte_tpu.ops.slabs import KVSlab, _pad_keys_to_words
+
+
+def _pack_bound(key: Optional[bytes], w: int) -> Tuple[np.ndarray, int]:
+    if not key:
+        return np.zeros(w, dtype=np.uint32), 0
+    words, lens = _pad_keys_to_words([key], width_words=w)
+    return words[0], int(lens[0])
+
+
+@functools.partial(jax.jit, static_argnames=("w", "has_lower", "has_upper"))
+def _scan_fused(cols, sort_rows, n_sort, cutoff_hi, cutoff_lo, cph, cpl,
+                lo_words, lo_len, hi_words, hi_len,
+                w: int, has_lower: bool, has_upper: bool):
+    n = cols.shape[1]
+    perm, keep, _ = sort_and_gc(
+        cols, cutoff_hi, cutoff_lo, cph, cpl,
+        w=w, is_major=True, retain_deletes=False,
+        sort_rows=sort_rows, n_sort=n_sort, snapshot=True)
+    s_words = cols[_ROW_WORDS:, :][:, perm]
+    s_len = cols[_ROW_KEY_LEN][perm].astype(jnp.int32)
+
+    # lexicographic (words, byte-length) compare == memcmp on the raw keys:
+    # zero-padded words tie exactly when one key is a prefix of the other,
+    # and then the shorter key sorts first
+    def cmp_bound(b_words, b_len):
+        lt = jnp.zeros(n, bool)
+        eq = jnp.ones(n, bool)
+        for i in range(w):
+            bw = b_words[i]
+            lt = lt | (eq & (s_words[i] < bw))
+            eq = eq & (s_words[i] == bw)
+        lt = lt | (eq & (s_len < b_len))
+        eq = eq & (s_len == b_len)
+        return lt, eq  # key < bound, key == bound
+
+    if has_lower:
+        lt, _ = cmp_bound(lo_words, lo_len)
+        keep = keep & ~lt
+    if has_upper:
+        lt, _ = cmp_bound(hi_words, hi_len)
+        keep = keep & lt
+
+    def pack_bits(b):
+        b32 = b.reshape(n // 32, 32).astype(jnp.uint32)
+        return (b32 << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+            axis=1, dtype=jnp.uint32)
+
+    return perm, pack_bits(keep)
+
+
+def scan_visible(staged: StagedCols, read_ht_value: int,
+                 lower_key: Optional[bytes] = None,
+                 upper_key: Optional[bytes] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Run the scan kernel over a staged cols matrix.
+
+    Returns (perm, keep) as host arrays over the merged order: entry
+    perm[i] of the staged input survives iff keep[i]; surviving entries are
+    exactly the versions visible at read_ht within [lower_key, upper_key).
+    """
+    w_bytes_cap = staged.w  # key words available
+    lo_w, lo_l = _pack_bound(lower_key, w_bytes_cap)
+    hi_w, hi_l = _pack_bound(upper_key, w_bytes_cap)
+    cutoff = read_ht_value
+    cutoff_phys = cutoff >> 12
+    perm, keep_p = _scan_fused(
+        staged.cols_dev, jnp.asarray(staged.sort_rows), jnp.int32(staged.n_sort),
+        jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
+        jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
+        jnp.asarray(lo_w), jnp.int32(lo_l), jnp.asarray(hi_w), jnp.int32(hi_l),
+        w=staged.w, has_lower=lower_key is not None,
+        has_upper=upper_key is not None)
+    perm = np.asarray(perm)
+    keep = merge_gc._unpack_bits(np.asarray(keep_p), staged.n_pad)
+    keep = keep & (perm < staged.n)
+    return perm, keep
+
+
+def visible_entries(slabs: Sequence[KVSlab], read_ht_value: int,
+                    lower_key: Optional[bytes] = None,
+                    upper_key: Optional[bytes] = None,
+                    device=None,
+                    staged_inputs: Optional[Sequence[StagedCols]] = None,
+                    ) -> Iterator[Tuple[bytes, bytes, int]]:
+    """Yield (key_prefix, value_bytes, ht_value) for every entry visible at
+    read_ht in [lower_key, upper_key), in key order — the merged+resolved
+    scan stream.
+
+    slabs: the host-side runs (for key/value materialization).
+    staged_inputs: matching pre-staged device cols, one per slab, if the
+    caller holds them in the HBM slab cache; missing ones are staged here.
+    """
+    from yugabyte_tpu.ops.merge_gc import stage_slab
+    from yugabyte_tpu.storage.device_cache import concat_staged
+
+    if staged_inputs is not None:
+        pairs = [(sl, st) for sl, st in zip(slabs, staged_inputs) if sl.n]
+        slabs = [sl for sl, _ in pairs]
+        staged_list = [st if st is not None else stage_slab(sl, device)
+                       for sl, st in pairs]
+    else:
+        slabs = [s for s in slabs if s.n]
+        staged_list = [stage_slab(sl, device) for sl in slabs]
+    if not slabs:
+        return
+    staged = staged_list[0] if len(staged_list) == 1 else concat_staged(staged_list)
+    # the device compare sees only the first w*4 key bytes; longer bounds are
+    # truncated there and enforced exactly on the host below
+    stride = staged.w * 4
+    lo_exact = lower_key if lower_key and len(lower_key) > stride else None
+    hi_exact = upper_key if upper_key and len(upper_key) > stride else None
+    perm, keep = scan_visible(staged, read_ht_value,
+                              lower_key[:stride] if lower_key else None,
+                              upper_key[:stride] if upper_key else None)
+    # map merged indices back to (slab, local index)
+    offsets = np.cumsum([0] + [s.n for s in slabs])
+    sel = perm[keep]
+    slab_idx = np.searchsorted(offsets, sel, side="right") - 1
+    local_idx = sel - offsets[slab_idx]
+    for j, li in zip(slab_idx, local_idx):
+        sl = slabs[int(j)]
+        i = int(li)
+        key = sl.key_bytes(i)
+        if lo_exact is not None and key < lo_exact:
+            continue
+        if hi_exact is not None and key >= hi_exact:
+            continue
+        ht = (int(sl.ht_hi[i]) << 32) | int(sl.ht_lo[i])
+        yield key, sl.values[int(sl.value_idx[i])], ht

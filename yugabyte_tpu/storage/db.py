@@ -72,6 +72,8 @@ class DB:
         self._lock = threading.RLock()
         self._compacting = False
         self._closed = False
+        self._pins: dict = {}       # file_id -> active scan count
+        self._obsolete: dict = {}   # file_id -> reader awaiting unpin+delete
         for fm in self.versions.live_files():
             self._readers[fm.file_id] = SSTReader(fm.path, self.opts.block_cache)
 
@@ -131,6 +133,50 @@ class DB:
                 continue
             sources.append(_sst_iter_from(r, seek_internal_key))
         return heapq.merge(*sources)
+
+    def scan_visible(self, read_ht_value: int,
+                     lower_key: Optional[bytes] = None,
+                     upper_key: Optional[bytes] = None):
+        """TPU scan path: yield (key_prefix, value_bytes, ht_value) of every
+        entry visible at read_ht in [lower_key, upper_key), in key order.
+
+        One fused device program resolves merge + MVCC visibility + range
+        filter for the whole range (ops/scan.py), instead of the per-step
+        Python heap merge of iter_from. SST key columns come from the HBM
+        slab cache (write-through on miss); input SSTs are PINNED for the
+        scan's lifetime so a concurrent compaction cannot delete them
+        (the reference's Version refcounting, ref: db/version_set.cc).
+        """
+        from yugabyte_tpu.ops.scan import visible_entries
+        with self._lock:
+            slabs = [self.mem.to_slab()]
+            if self._imm is not None:
+                slabs.append(self._imm.to_slab())
+            readers = list(self._readers.items())
+            for fid, _ in readers:
+                self._pins[fid] = self._pins.get(fid, 0) + 1
+        try:
+            staged = [None] * len(slabs)
+            for fid, r in readers:
+                sl = r.read_all()
+                slabs.append(sl)
+                if self._device_cache is not None:
+                    st = self._device_cache.get(fid)
+                    if st is None:
+                        st = self._device_cache.stage(fid, sl)  # write-through
+                    staged.append(st)
+                else:
+                    staged.append(None)
+            yield from visible_entries(slabs, read_ht_value, lower_key,
+                                       upper_key, device=self.opts.device,
+                                       staged_inputs=staged)
+        finally:
+            with self._lock:
+                for fid, _ in readers:
+                    self._pins[fid] -= 1
+                    if not self._pins[fid]:
+                        del self._pins[fid]
+                self._purge_obsolete_unlocked()
 
     # ----------------------------------------------------------------- flush
     def flush(self) -> Optional[int]:
@@ -216,8 +262,13 @@ class DB:
                 for fid in removed:
                     r = self._readers.pop(fid, None)
                     if r:
-                        r.close()
-                        _delete_sst_files(r.base_path)
+                        if self._pins.get(fid):
+                            # an active scan still reads this SST: defer the
+                            # close+delete until its pin drops
+                            self._obsolete[fid] = r
+                        else:
+                            r.close()
+                            _delete_sst_files(r.base_path)
                     if self._device_cache is not None:
                         self._device_cache.drop(fid)
             TRACE("compaction: %d files -> %d rows (%d in)",
@@ -243,6 +294,12 @@ class DB:
             pick = compaction_mod.CompactionPick(files, is_major=True)
             self._compacting = True
         self._run_compaction(pick)
+
+    def _purge_obsolete_unlocked(self) -> None:
+        for fid in [f for f in self._obsolete if not self._pins.get(f)]:
+            r = self._obsolete.pop(fid)
+            r.close()
+            _delete_sst_files(r.base_path)
 
     # ------------------------------------------------------------ checkpoint
     def checkpoint(self, out_dir: str) -> None:

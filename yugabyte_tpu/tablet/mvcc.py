@@ -68,8 +68,11 @@ class MvccManager:
             if floor.value > 0:
                 self._clock.update(floor)
             ht = self._clock.now()
-            assert ht.value > self._max_safe_time_returned.value and (
-                not self._queue or ht.value >= self._queue[-1].value)
+            if ht.value <= self._max_safe_time_returned.value or (
+                    self._queue and ht.value < self._queue[-1].value):
+                raise RuntimeError(
+                    f"clock produced non-monotonic hybrid time {ht} "
+                    f"(safe time {self._max_safe_time_returned})")
             self._queue.append(ht)
             return ht
 

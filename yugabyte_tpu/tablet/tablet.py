@@ -27,7 +27,7 @@ from yugabyte_tpu.common.schema import Schema
 from yugabyte_tpu.docdb.doc_key import DocKey
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp, prepare_and_assemble
 from yugabyte_tpu.docdb.doc_rowwise_iterator import (
-    DocRowwiseIterator, Row, read_row)
+    DocRowwiseIterator, Row, VisibleEntryRowAssembler, read_row)
 from yugabyte_tpu.docdb.lock_manager import SharedLockManager
 from yugabyte_tpu.docdb.value_type import ValueType
 from yugabyte_tpu.ops.slabs import _doc_key_len
@@ -176,8 +176,22 @@ class Tablet:
 
     def scan(self, read_ht: Optional[HybridTime] = None,
              lower_doc_key: bytes = b"", upper_doc_key: Optional[bytes] = None,
-             projection=None) -> DocRowwiseIterator:
+             projection=None, use_device: Optional[bool] = None):
+        """Range scan. use_device: True forces the TPU scan kernel, False the
+        CPU iterator, None auto-picks: device path only for FULL-table scans
+        on a device-configured tablet — the kernel resolves the whole DB in
+        one fused program (great for big scans), while bounded scans seek
+        directly to their range on the CPU iterator (ref: the reference
+        always walks DocRowwiseIterator; here ops/scan.py)."""
         ht = self.read_time(read_ht)
+        if use_device is None:
+            use_device = (self.opts.device is not None
+                          and not lower_doc_key and upper_doc_key is None)
+        if use_device:
+            entries = self.regular_db.scan_visible(
+                ht.value, lower_doc_key or None, upper_doc_key)
+            return VisibleEntryRowAssembler(entries, self.schema,
+                                            projection=projection)
         return DocRowwiseIterator(self.regular_db, self.schema, ht,
                                   lower_doc_key=lower_doc_key,
                                   upper_doc_key=upper_doc_key,
