@@ -171,3 +171,30 @@ def test_scan_kernel_projection(tablet):
     cid_b = SCHEMA.column_id("b")
     rows = list(tablet.scan(projection=[cid_b], use_device=True))
     assert len(rows) == 1 and rows[0].columns == {}
+
+
+def test_truncated_upper_bound_keeps_equal_prefix_key():
+    """A key whose bytes equal the device-truncated upper bound must survive
+    when its full bytes are still below the full bound: the device keeps the
+    eq case and the host enforces the exact bound (regression: the kernel
+    used key < truncated_bound only, silently dropping such keys)."""
+    from yugabyte_tpu.common.hybrid_time import DocHybridTime
+    from yugabyte_tpu.ops.scan import visible_entries
+    from yugabyte_tpu.ops.slabs import pack_doc_ht, pack_kvs
+
+    dht = pack_doc_ht(DocHybridTime(HybridTime.from_micros(1000), 0))
+    keys = [b"aaaa0000", b"aaaa0001", b"aaaa0002"]  # 8 bytes -> stride 8 (w=2)
+    slab = pack_kvs([(k, dht, b"v-" + k) for k in keys],
+                    doc_key_lens=[len(k) for k in keys])
+    read_ht = HybridTime.from_micros(2000).value
+
+    # upper bound longer than the stride, truncating to exactly keys[1]
+    upper = keys[1] + b"\xff"
+    got = [k for k, _v, _ht in visible_entries([slab], read_ht,
+                                               upper_key=upper)]
+    assert got == [b"aaaa0000", b"aaaa0001"]
+
+    # exact-length bound still excludes the equal key (half-open interval)
+    got = [k for k, _v, _ht in visible_entries([slab], read_ht,
+                                               upper_key=keys[1])]
+    assert got == [b"aaaa0000"]

@@ -1,0 +1,13 @@
+"""Consensus layer: segmented WAL + Raft replication + leader leases.
+
+TPU-native re-expression of src/yb/consensus (RaftConsensus, Log,
+PeerMessageQueue, LeaderElection). The WAL doubles as Raft storage exactly
+like the reference (ref: consensus/log.h:104-113) — there is no separate
+RocksDB WAL; the Raft index becomes the storage frontier.
+"""
+
+from yugabyte_tpu.consensus.log import Log, LogEntry, LogReader
+from yugabyte_tpu.consensus.raft import (
+    NotLeader, OperationOutcomeUnknown, OpId, RaftConsensus, RaftConfig,
+    ReplicationAborted, ReplicationTimedOut, Role)
+from yugabyte_tpu.consensus.transport import LocalTransport

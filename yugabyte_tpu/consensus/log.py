@@ -1,0 +1,290 @@
+"""Segmented write-ahead log with group commit.
+
+Capability parity with the reference WAL (ref: src/yb/consensus/log.cc —
+`Log::AsyncAppendReplicates` :739, background `Appender` group-commit thread
+:328-432, segment allocation/rollover, `LogReader` for bootstrap replay,
+GC of fully-consumed segments). Design notes carried over:
+
+- The WAL *is* the Raft log (ref log.h:104-113): entries are
+  (term, index, payload) where payload is opaque to this layer (the Raft
+  module serializes write batches into it).
+- Group commit: producers enqueue batches; one appender thread drains the
+  queue, writes everything pending, issues ONE fsync, then fires all the
+  callbacks (ref log.cc:392-432).
+- Segments are named by the index of their first entry; a segment rolls
+  when it exceeds `log_segment_size_bytes`. GC drops whole segments whose
+  max index < the anchor (ref log_reader.cc / log_anchor_registry).
+
+Record framing: [u32 crc][u32 payload_len][u64 term][u64 index][payload],
+crc32 over everything after the crc field. A torn tail (crash mid-write)
+fails the crc / length check and replay stops there, matching the
+reference's tolerance of a truncated final record.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag("log_segment_size_bytes", 64 * 1024 * 1024,
+                  "roll the WAL segment after it exceeds this size "
+                  "(ref log_segment_size_mb)")
+flags.define_flag("durable_wal_write", True,
+                  "fsync WAL batches (ref durable_wal_write)")
+
+_HEADER = struct.Struct("<IIQQ")  # crc, payload_len, term, index
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    term: int
+    index: int
+    payload: bytes
+
+    @property
+    def op_id(self) -> Tuple[int, int]:
+        return (self.term, self.index)
+
+
+def _segment_name(first_index: int) -> str:
+    return f"wal-{first_index:012d}"
+
+
+def _encode_entry(e: LogEntry) -> bytes:
+    body = struct.pack("<QQ", e.term, e.index) + e.payload
+    crc = zlib.crc32(body)
+    return struct.pack("<II", crc, len(e.payload)) + body
+
+
+def _read_segment(path: str) -> Iterator[LogEntry]:
+    """Yield entries; stop silently at a torn/corrupt tail."""
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    while off + _HEADER.size <= len(data):
+        crc, plen, term, index = _HEADER.unpack_from(data, off)
+        end = off + _HEADER.size + plen
+        if end > len(data):
+            break  # torn tail
+        body = data[off + 8:end]
+        if zlib.crc32(body) != crc:
+            break  # corrupt tail
+        yield LogEntry(term, index, data[off + _HEADER.size:end])
+        off = end
+
+
+class LogReader:
+    """Reads a WAL directory in index order (ref: consensus/log_reader.cc)."""
+
+    def __init__(self, wal_dir: str):
+        self.wal_dir = wal_dir
+
+    def segments(self) -> List[str]:
+        if not os.path.isdir(self.wal_dir):
+            return []
+        names = sorted(n for n in os.listdir(self.wal_dir)
+                       if n.startswith("wal-"))
+        return [os.path.join(self.wal_dir, n) for n in names]
+
+    def read_all(self, min_index: int = 0) -> Iterator[LogEntry]:
+        """All entries with index >= min_index, in order. Overwritten
+        (truncated-then-rewritten) indexes yield only the latest record
+        because truncation rewrites the tail segment in place. Segments are
+        named by their first index, so ones entirely below min_index are
+        skipped without reading them."""
+        segs = self.segments()
+        first_indexes = [int(os.path.basename(s)[4:]) for s in segs]
+        for i, seg in enumerate(segs):
+            nxt_first = (first_indexes[i + 1] if i + 1 < len(segs) else None)
+            if nxt_first is not None and nxt_first <= min_index:
+                continue  # every entry in this segment is < min_index
+            for e in _read_segment(seg):
+                if e.index >= min_index:
+                    yield e
+
+
+class Log:
+    """Appendable segmented WAL with a group-commit appender thread."""
+
+    def __init__(self, wal_dir: str):
+        self.wal_dir = wal_dir
+        os.makedirs(wal_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[Tuple[List[LogEntry], Optional[Callable]]] = []
+        self._inflight = False  # appender is mid-write on a popped batch
+        self._stopped = False
+        self._file = None
+        self._file_size = 0
+        self._file_first_index = None
+        self._last_op_id = (0, 0)
+        self._recover()
+        self._appender = threading.Thread(
+            target=self._appender_loop, name=f"wal-appender", daemon=True)
+        self._appender.start()
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self) -> None:
+        reader = LogReader(self.wal_dir)
+        segs = reader.segments()
+        last = None
+        for seg in segs:
+            for e in _read_segment(seg):
+                last = e
+        if last is not None:
+            self._last_op_id = last.op_id
+        if segs:
+            # Re-open the final segment for append; rewrite it first so a
+            # torn tail never precedes new records.
+            tail = segs[-1]
+            entries = list(_read_segment(tail))
+            with open(tail + ".tmp", "wb") as f:
+                for e in entries:
+                    f.write(_encode_entry(e))
+            os.replace(tail + ".tmp", tail)
+            self._file = open(tail, "ab")
+            self._file_size = self._file.tell()
+            self._file_first_index = int(os.path.basename(tail)[4:])
+
+    # --------------------------------------------------------------- append
+    @property
+    def last_op_id(self) -> Tuple[int, int]:
+        with self._lock:
+            return self._last_op_id
+
+    def append_async(self, entries: Sequence[LogEntry],
+                     callback: Optional[Callable[[], None]] = None) -> None:
+        """Queue entries for the appender thread (ref log.cc:739
+        AsyncAppendReplicates). Callback fires after fsync."""
+        if not entries:
+            if callback:
+                callback()
+            return
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("log is closed")
+            self._queue.append((list(entries), callback))
+            self._cv.notify()
+
+    def append_sync(self, entries: Sequence[LogEntry]) -> None:
+        done = threading.Event()
+        self.append_async(entries, done.set)
+        done.wait()
+
+    def _appender_loop(self) -> None:
+        while True:
+            with self._cv:
+                self._cv.wait_for(lambda: self._queue or self._stopped)
+                if self._stopped and not self._queue:
+                    return
+                batch, self._queue = self._queue, []
+                self._inflight = True
+            try:
+                self._write_batch(batch)
+            finally:
+                with self._cv:
+                    self._inflight = False
+                    self._cv.notify_all()
+
+    def _write_batch(self, batch) -> None:
+        files_to_sync = set()
+        for entries, _cb in batch:
+            for e in entries:
+                self._ensure_segment(e.index)
+                rec = _encode_entry(e)
+                self._file.write(rec)
+                self._file_size += len(rec)
+                self._last_op_id = e.op_id
+            files_to_sync.add(self._file)
+        for f in files_to_sync:
+            f.flush()
+            if flags.get_flag("durable_wal_write"):
+                os.fsync(f.fileno())
+        for _entries, cb in batch:
+            if cb:
+                cb()
+
+    def _ensure_segment(self, first_index: int) -> None:
+        if (self._file is None or
+                self._file_size >= flags.get_flag("log_segment_size_bytes")):
+            if self._file:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+            path = os.path.join(self.wal_dir, _segment_name(first_index))
+            self._file = open(path, "ab")
+            self._file_size = self._file.tell()
+            self._file_first_index = first_index
+            TRACE("wal: rolled to segment %s", path)
+
+    # ----------------------------------------------------- truncate (raft)
+    def truncate_after(self, index: int) -> None:
+        """Drop all entries with index > `index` (follower conflict
+        resolution, ref raft_consensus.cc follower Update path). Rewrites
+        the tail segment(s) synchronously, after waiting for any in-flight
+        appender batch to drain (callbacks never block on this lock)."""
+        with self._cv:
+            self._cv.wait_for(lambda: not self._queue and not self._inflight)
+            segs = LogReader(self.wal_dir).segments()
+            if self._file:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None
+            for seg in reversed(segs):
+                entries = list(_read_segment(seg))
+                if entries and entries[0].index > index:
+                    os.remove(seg)
+                    continue
+                kept = [e for e in entries if e.index <= index]
+                with open(seg + ".tmp", "wb") as f:
+                    for e in kept:
+                        f.write(_encode_entry(e))
+                os.replace(seg + ".tmp", seg)
+                break
+            segs = LogReader(self.wal_dir).segments()
+            last = None
+            for seg in segs:
+                for e in _read_segment(seg):
+                    last = e
+            if segs:
+                self._file = open(segs[-1], "ab")
+                self._file_size = self._file.tell()
+                self._file_first_index = int(os.path.basename(segs[-1])[4:])
+            self._last_op_id = last.op_id if last else (0, 0)
+
+    # ------------------------------------------------------------------- gc
+    def gc_up_to(self, anchor_index: int) -> int:
+        """Delete whole segments whose entries are ALL < anchor_index (the
+        minimum of flushed frontiers / peer watermarks, ref
+        log_anchor_registry). Never deletes the active segment. Returns
+        number of segments removed."""
+        with self._cv:
+            segs = LogReader(self.wal_dir).segments()
+            removed = 0
+            for i, seg in enumerate(segs[:-1]):  # keep active segment
+                nxt_first = int(os.path.basename(segs[i + 1])[4:])
+                if nxt_first <= anchor_index:
+                    os.remove(seg)
+                    removed += 1
+                else:
+                    break
+            return removed
+
+    def close(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self._appender.join(timeout=10)
+        if self._file:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            self._file.close()
+            self._file = None

@@ -1,0 +1,844 @@
+"""Raft consensus with leader leases, re-expressed for the TPU framework.
+
+Capability parity with the reference (ref: src/yb/consensus/raft_consensus.cc
+— elections :546 `DoStartElection`, :1038 `BecomeLeaderUnlocked`, replication
+:1140 `ReplicateBatch`, follower path :1473 `Update`; per-peer watermark
+tracking ref consensus_queue.h:110 `PeerMessageQueue`; vote withholding for
+leader leases ref leader_lease.h). Differences from the C++ design are
+deliberate simplifications, not omissions:
+
+- The WAL (consensus/log.py) is the only persistent log, exactly like the
+  reference. Entry (term, index) pairs live in an in-memory cache (the
+  reference's LogCache) that is reloaded from the WAL at startup.
+- Votes/terms persist in a small fsynced metadata file (the reference's
+  ConsensusMetadata, consensus_meta.cc). The committed index is persisted
+  as a non-fsynced floor so bootstrap knows how far it may safely apply.
+- Replication fan-out: one worker thread per peer doubling as the
+  heartbeat timer (the reference's Peer + PeerMessageQueue).
+- Leader leases: each AppendEntries carries a lease duration; followers
+  withhold votes until it expires, and the leader serves reads only while
+  a majority acked a request sent within the lease window.
+- Propagated safe time for follower reads piggybacks on AppendEntries
+  (ref mvcc.h:93), capped at the hybrid time of the first entry NOT yet
+  sent to that peer so a follower never advances past data it lacks.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import random
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from yugabyte_tpu.consensus.log import Log, LogEntry
+from yugabyte_tpu.consensus.transport import PeerUnreachable
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag("raft_heartbeat_interval_ms", 50,
+                  "leader heartbeat period (ref raft_heartbeat_interval_ms)")
+flags.define_flag("leader_failure_max_missed_heartbeat_periods", 6,
+                  "election timeout = this many heartbeat periods "
+                  "(randomized up to 2x, ref same-named flag)")
+flags.define_flag("ht_lease_duration_ms", 2000,
+                  "leader lease length (ref ht_lease_duration_ms)")
+flags.define_flag("consensus_max_batch_size_entries", 256,
+                  "max entries per AppendEntries request "
+                  "(ref consensus_max_batch_size_bytes)")
+
+OpId = Tuple[int, int]
+
+OP_NOOP = 0
+OP_WRITE = 1
+OP_CHANGE_METADATA = 2
+OP_SPLIT = 3
+OP_UPDATE_TXN = 4
+OP_SNAPSHOT = 5
+OP_TRUNCATE = 6
+
+_MSG_HEADER = struct.Struct("<BQ")  # op_type, ht_value
+
+
+class NotLeader(Exception):
+    def __init__(self, leader_hint: Optional[str]):
+        super().__init__(f"not the leader (leader hint: {leader_hint})")
+        self.leader_hint = leader_hint
+
+
+class ReplicationAborted(Exception):
+    """Entry was overwritten by a new leader before committing."""
+
+
+class ReplicationTimedOut(Exception):
+    """The entry's fate (commit vs overwrite) is still unknown — it remains
+    in the log and MAY commit later. Callers must NOT treat this as an
+    abort; use watch_fate() to resolve bookkeeping when the fate settles."""
+
+    def __init__(self, op_id: "OpId"):
+        super().__init__(f"op {op_id} outcome unknown (timeout)")
+        self.op_id = op_id
+
+
+class OperationOutcomeUnknown(Exception):
+    """Surfaced to clients when a write timed out without a known fate
+    (the reference returns a timeout status for the same situation)."""
+
+
+class Role(enum.Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+@dataclass(frozen=True)
+class ReplicateMsg:
+    term: int
+    index: int
+    op_type: int
+    ht_value: int
+    payload: bytes
+
+    @property
+    def op_id(self) -> OpId:
+        return (self.term, self.index)
+
+    def to_log_entry(self) -> LogEntry:
+        return LogEntry(self.term, self.index,
+                        _MSG_HEADER.pack(self.op_type, self.ht_value)
+                        + self.payload)
+
+    @staticmethod
+    def from_log_entry(e: LogEntry) -> "ReplicateMsg":
+        op_type, ht = _MSG_HEADER.unpack_from(e.payload)
+        return ReplicateMsg(e.term, e.index, op_type, ht,
+                            e.payload[_MSG_HEADER.size:])
+
+
+@dataclass(frozen=True)
+class AppendEntriesReq:
+    term: int
+    leader_id: str
+    preceding_term: int
+    preceding_index: int
+    entries: Tuple[ReplicateMsg, ...]
+    committed_index: int
+    propagated_safe_time: int
+    lease_duration_s: float
+
+
+@dataclass(frozen=True)
+class AppendEntriesResp:
+    responder_id: str
+    term: int
+    success: bool
+    last_received_index: int
+
+
+@dataclass(frozen=True)
+class VoteReq:
+    term: int
+    candidate_id: str
+    last_log_term: int
+    last_log_index: int
+    ignore_lease: bool = False
+
+
+@dataclass(frozen=True)
+class VoteResp:
+    responder_id: str
+    term: int
+    granted: bool
+
+
+@dataclass
+class RaftConfig:
+    peer_id: str
+    peer_ids: Tuple[str, ...]  # full voter set, including self
+
+    @property
+    def majority(self) -> int:
+        return len(self.peer_ids) // 2 + 1
+
+    @property
+    def remote_peers(self) -> List[str]:
+        return [p for p in self.peer_ids if p != self.peer_id]
+
+
+class _ConsensusMetadata:
+    """Durable (term, voted_for) + advisory committed floor
+    (ref consensus/consensus_meta.cc)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.committed_floor = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                d = json.load(f)
+            self.term = d["term"]
+            self.voted_for = d.get("voted_for")
+            self.committed_floor = d.get("committed_floor", 0)
+
+    def save(self, fsync: bool = True) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for,
+                       "committed_floor": self.committed_floor}, f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+
+class RaftConsensus:
+    """One Raft participant. apply_cb(msg) is invoked exactly once per
+    committed entry, in index order, possibly from internal threads."""
+
+    def __init__(self, config: RaftConfig, log: Log, transport,
+                 apply_cb: Callable[[ReplicateMsg], None],
+                 meta_path: str,
+                 safe_time_provider: Optional[Callable[[], int]] = None,
+                 on_propagated_safe_time: Optional[Callable[[int], None]] = None,
+                 on_role_change: Optional[Callable[[Role], None]] = None,
+                 clock=None,
+                 seed: Optional[int] = None):
+        self.config = config
+        self.log = log
+        self.transport = transport
+        self.apply_cb = apply_cb
+        self.safe_time_provider = safe_time_provider or (lambda: 0)
+        self.on_propagated_safe_time = on_propagated_safe_time or (lambda ht: None)
+        self.on_role_change = on_role_change or (lambda r: None)
+        self.clock = clock
+        self._meta = _ConsensusMetadata(meta_path)
+        self._rng = random.Random(seed if seed is not None
+                                  else hash(config.peer_id) & 0xFFFF)
+
+        self._lock = threading.Lock()
+        self._commit_cv = threading.Condition(self._lock)
+        self._apply_lock = threading.Lock()
+
+        self.role = Role.FOLLOWER
+        self.leader_id: Optional[str] = None
+        self._entries: Dict[int, ReplicateMsg] = {}
+        self._last_index = 0
+        self._last_term = 0
+        self._local_durable_index = 0
+        self.commit_index = 0
+        self.last_applied = 0
+        # Durability watermark handshake: WAL-appender callbacks touch ONLY
+        # this small lock + event (never self._lock), so a thread holding
+        # self._lock may safely block on WAL durability (e.g. handle_update's
+        # append_sync) without deadlocking against pending async callbacks.
+        self._durable_lock = threading.Lock()
+        self._durable_watermark = 0
+        self._durable_event = threading.Event()
+        self._withhold_votes_until = 0.0
+        self._last_leader_contact = time.monotonic()
+
+        # leader state
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._last_ack_send_time: Dict[str, float] = {}
+        self._peer_events: Dict[str, threading.Event] = {}
+        self._peer_threads: List[threading.Thread] = []
+        self._leader_epoch = 0
+
+        self._stopped = False
+        self._load_log()
+        self._election_thread: Optional[threading.Thread] = None
+        self._commit_worker = threading.Thread(
+            target=self._commit_worker_loop,
+            name=f"raft-commit-{config.peer_id}", daemon=True)
+        self._commit_worker.start()
+
+    # -------------------------------------------------------------- startup
+    def _load_log(self) -> None:
+        from yugabyte_tpu.consensus.log import LogReader
+        reader = LogReader(self.log.wal_dir)
+        for e in reader.read_all():
+            msg = ReplicateMsg.from_log_entry(e)
+            self._entries[msg.index] = msg
+            self._last_index = msg.index
+            self._last_term = msg.term
+        self._local_durable_index = self._last_index
+        # Committed floor: entries at/below it are safe to apply at
+        # bootstrap; entries above it stay pending until a leader commits
+        # or overwrites them.
+        self.commit_index = min(self._meta.committed_floor, self._last_index)
+
+    def start(self, election_timer: bool = True) -> None:
+        if election_timer:
+            self._election_thread = threading.Thread(
+                target=self._election_timer_loop,
+                name=f"raft-timer-{self.config.peer_id}", daemon=True)
+            self._election_thread.start()
+
+    def set_bootstrap_state(self, committed_index: int) -> None:
+        """Bootstrap: the tablet replayed/persisted through
+        `committed_index`; treat it as committed+applied so apply_cb is not
+        re-invoked (ref TabletBootstrap skipping flushed entries). Flushed
+        storage implies the entries were committed, so this may raise the
+        non-fsynced committed floor recovered from metadata."""
+        with self._lock:
+            self.commit_index = max(self.commit_index,
+                                    min(committed_index, self._last_index))
+            self.last_applied = max(self.last_applied, self.commit_index)
+
+    # ----------------------------------------------------------- properties
+    @property
+    def current_term(self) -> int:
+        return self._meta.term
+
+    @property
+    def last_op_id(self) -> OpId:
+        with self._lock:
+            return (self._last_term, self._last_index)
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == Role.LEADER
+
+    def leader_hint(self) -> Optional[str]:
+        with self._lock:
+            return self.leader_id
+
+    # ------------------------------------------------------------ elections
+    def _election_timeout_s(self) -> float:
+        hb = flags.get_flag("raft_heartbeat_interval_ms") / 1000.0
+        periods = flags.get_flag("leader_failure_max_missed_heartbeat_periods")
+        base = hb * periods
+        return base * (1.0 + self._rng.random())
+
+    def _election_timer_loop(self) -> None:
+        timeout = self._election_timeout_s()
+        while not self._stopped:
+            time.sleep(flags.get_flag("raft_heartbeat_interval_ms") / 1000.0)
+            with self._lock:
+                if self._stopped or self.role == Role.LEADER:
+                    self._last_leader_contact = time.monotonic()
+                    continue
+                expired = (time.monotonic() - self._last_leader_contact
+                           > timeout)
+            if expired:
+                self.start_election()
+                timeout = self._election_timeout_s()
+
+    def start_election(self, ignore_lease: bool = False) -> None:
+        """Become candidate, solicit votes (ref raft_consensus.cc:546)."""
+        with self._lock:
+            if self._stopped or self.role == Role.LEADER:
+                return
+            self._meta.term += 1
+            self._meta.voted_for = self.config.peer_id
+            self._meta.save()
+            term = self._meta.term
+            self.role = Role.CANDIDATE
+            self.leader_id = None
+            self._last_leader_contact = time.monotonic()
+            req = VoteReq(term, self.config.peer_id,
+                          self._last_term, self._last_index, ignore_lease)
+            votes = {self.config.peer_id}
+        TRACE("raft %s: starting election for term %d", self.config.peer_id, term)
+        if len(self.config.peer_ids) == 1:
+            self._maybe_win(term, votes)
+            return
+        for peer in self.config.remote_peers:
+            threading.Thread(target=self._solicit_vote,
+                             args=(peer, req, votes),
+                             daemon=True).start()
+
+    def _solicit_vote(self, peer: str, req: VoteReq, votes: set) -> None:
+        try:
+            resp = self.transport.request_vote(self.config.peer_id, peer, req)
+        except PeerUnreachable:
+            return
+        with self._lock:
+            if resp.term > self._meta.term:
+                self._step_down_unlocked(resp.term)
+                return
+        if resp.granted:
+            votes.add(peer)
+            self._maybe_win(req.term, votes)
+
+    def _maybe_win(self, term: int, votes: set) -> None:
+        with self._lock:
+            if (self.role != Role.CANDIDATE or self._meta.term != term
+                    or len(votes) < self.config.majority):
+                return
+            self._become_leader_unlocked()
+
+    def _become_leader_unlocked(self) -> None:
+        """ref raft_consensus.cc:1038 BecomeLeaderUnlocked."""
+        self.role = Role.LEADER
+        self.leader_id = self.config.peer_id
+        self._leader_epoch += 1
+        epoch = self._leader_epoch
+        now = time.monotonic()
+        for p in self.config.remote_peers:
+            self._next_index[p] = self._last_index + 1
+            self._match_index[p] = 0
+            self._last_ack_send_time[p] = 0.0
+            self._peer_events[p] = threading.Event()
+        # NO_OP at the new term: commits everything from prior terms
+        # (Raft can only count replicas for current-term entries).
+        ht = self.clock.now().value if self.clock else 0
+        noop = self._append_unlocked(OP_NOOP, ht, b"")
+        self._leader_noop_index = noop.index
+        for p in self.config.remote_peers:
+            t = threading.Thread(target=self._peer_loop, args=(p, epoch),
+                                 name=f"raft-peer-{self.config.peer_id}-{p}",
+                                 daemon=True)
+            self._peer_threads.append(t)
+            t.start()
+        TRACE("raft %s: leader for term %d", self.config.peer_id, self._meta.term)
+        threading.Thread(target=self.on_role_change, args=(Role.LEADER,),
+                         daemon=True).start()
+
+    def _step_down_unlocked(self, new_term: int) -> None:
+        if new_term > self._meta.term:
+            self._meta.term = new_term
+            self._meta.voted_for = None
+            self._meta.save()
+        was_leader = self.role == Role.LEADER
+        self.role = Role.FOLLOWER
+        self._leader_epoch += 1  # stops peer loops
+        self._last_leader_contact = time.monotonic()
+        for ev in self._peer_events.values():
+            ev.set()
+        self._commit_cv.notify_all()
+        if was_leader:
+            threading.Thread(target=self.on_role_change, args=(Role.FOLLOWER,),
+                             daemon=True).start()
+
+    # ---------------------------------------------------------- vote handler
+    def handle_vote_request(self, req: VoteReq) -> VoteResp:
+        with self._lock:
+            # Leader-lease vote withholding (ref leader_lease.h): a follower
+            # that recently heard from a live leader refuses to elect a new
+            # one until the lease expires.
+            if (not req.ignore_lease
+                    and time.monotonic() < self._withhold_votes_until
+                    and req.candidate_id != self.leader_id):
+                return VoteResp(self.config.peer_id, self._meta.term, False)
+            if req.term > self._meta.term:
+                self._step_down_unlocked(req.term)
+            if req.term < self._meta.term:
+                return VoteResp(self.config.peer_id, self._meta.term, False)
+            log_ok = (req.last_log_term, req.last_log_index) >= \
+                (self._last_term, self._last_index)
+            if log_ok and self._meta.voted_for in (None, req.candidate_id):
+                self._meta.voted_for = req.candidate_id
+                self._meta.save()
+                self._last_leader_contact = time.monotonic()
+                return VoteResp(self.config.peer_id, self._meta.term, True)
+            return VoteResp(self.config.peer_id, self._meta.term, False)
+
+    # ---------------------------------------------------------- replication
+    def replicate(self, op_type: int, ht_value: int, payload: bytes,
+                  timeout_s: float = 30.0) -> OpId:
+        """Leader: append + replicate + wait for commit AND local apply
+        (ref raft_consensus.cc:1140 ReplicateBatch)."""
+        with self._lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            msg = self._append_unlocked(op_type, ht_value, payload)
+        for ev in self._peer_events.values():
+            ev.set()
+        deadline = time.monotonic() + timeout_s
+        with self._commit_cv:
+            while True:
+                cur = self._entries.get(msg.index)
+                if cur is None or cur.term != msg.term:
+                    raise ReplicationAborted(f"op {msg.op_id} overwritten")
+                if self.last_applied >= msg.index:
+                    return msg.op_id
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # NOT an abort: the entry stays in the log and may yet
+                    # commit. Callers resolve bookkeeping via watch_fate().
+                    raise ReplicationTimedOut(msg.op_id)
+                self._commit_cv.wait(timeout=remaining)
+
+    def _append_unlocked(self, op_type: int, ht_value: int,
+                         payload: bytes) -> ReplicateMsg:
+        index = self._last_index + 1
+        msg = ReplicateMsg(self._meta.term, index, op_type, ht_value, payload)
+        self._entries[index] = msg
+        self._last_index = index
+        self._last_term = msg.term
+        self.log.append_async([msg.to_log_entry()],
+                              callback=lambda: self._on_local_durable(index))
+        return msg
+
+    def _on_local_durable(self, index: int) -> None:
+        """WAL appender callback. MUST NOT touch self._lock (see the
+        durability-watermark comment in __init__)."""
+        with self._durable_lock:
+            if index > self._durable_watermark:
+                self._durable_watermark = index
+        self._durable_event.set()
+
+    def _commit_worker_loop(self) -> None:
+        """Folds the durability watermark into consensus state and advances
+        commit, off the WAL appender thread."""
+        while True:
+            self._durable_event.wait(timeout=0.05)
+            self._durable_event.clear()
+            if self._stopped:
+                return
+            should_apply = False
+            with self._lock:
+                with self._durable_lock:
+                    w = self._durable_watermark
+                # Cap at the current log tail: after a follower truncation
+                # the stale pre-truncation watermark must not resurrect
+                # durability for rewritten indexes (handle_update re-marks
+                # them after its own synchronous append).
+                w = min(w, self._last_index)
+                if w > self._local_durable_index:
+                    self._local_durable_index = w
+                if self.role == Role.LEADER:
+                    self._advance_commit_unlocked()
+                    should_apply = self.last_applied < self.commit_index
+                self._maybe_evict_cache_unlocked()
+            if should_apply:
+                self._apply_committed()
+
+    # Keep a tail of recent entries in memory for term lookups and lagging
+    # peers; everything older falls back to (segment-skipping) WAL reads.
+    _CACHE_HIGH_WATER = 4096
+    _CACHE_TAIL = 1024
+
+    def _maybe_evict_cache_unlocked(self) -> None:
+        """Bound the in-memory entry cache (ref consensus/log_cache.cc):
+        applied entries below every peer's match index are reloadable from
+        the WAL on demand."""
+        if len(self._entries) <= self._CACHE_HIGH_WATER:
+            return
+        floor = min([self.last_applied - self._CACHE_TAIL]
+                    + [self._match_index.get(p, 0)
+                       for p in self.config.remote_peers])
+        for i in list(self._entries):
+            if i < floor:
+                del self._entries[i]
+
+    # ------------------------------------------------------ fate resolution
+    def op_fate(self, op_id: OpId) -> str:
+        """'committed' | 'aborted' | 'pending' for a previously appended
+        entry. 'aborted' means it was overwritten/truncated away."""
+        term, index = op_id
+        with self._lock:
+            if index > self._last_index:
+                return "aborted"  # truncated off the log tail
+            try:
+                local_term = self._term_at_unlocked(index)
+            except KeyError:
+                # GC'd from WAL+cache: only applied entries get evicted, and
+                # an overwrite would still be in the cache — treat as the
+                # surviving (committed) record.
+                return "committed" if index <= self.last_applied else "aborted"
+            if local_term != term:
+                return "aborted"
+            return "committed" if index <= self.last_applied else "pending"
+
+    def watch_fate(self, op_id: OpId, on_committed: Callable[[], None],
+                   on_aborted: Callable[[], None]) -> None:
+        """Resolve a timed-out op's bookkeeping once its fate settles
+        (commit vs overwrite). Runs on a daemon thread."""
+        def loop():
+            while not self._stopped:
+                f = self.op_fate(op_id)
+                if f == "committed":
+                    on_committed()
+                    return
+                if f == "aborted":
+                    on_aborted()
+                    return
+                time.sleep(0.05)
+        threading.Thread(target=loop, daemon=True,
+                         name=f"raft-fate-{op_id}").start()
+
+    # ------------------------------------------------------ peer replication
+    def _peer_loop(self, peer: str, epoch: int) -> None:
+        """Per-peer replication worker, doubles as heartbeat timer
+        (ref consensus_peers.h:183 SendNextRequest)."""
+        ev = self._peer_events[peer]
+        while True:
+            hb = flags.get_flag("raft_heartbeat_interval_ms") / 1000.0
+            ev.wait(timeout=hb)
+            ev.clear()
+            try:
+                with self._lock:
+                    if (self._stopped or self.role != Role.LEADER
+                            or self._leader_epoch != epoch):
+                        return
+                    req, sent_up_to = self._build_request_unlocked(peer)
+                    send_time = time.monotonic()
+                try:
+                    resp = self.transport.update_consensus(
+                        self.config.peer_id, peer, req)
+                except PeerUnreachable:
+                    continue
+                self._process_peer_response(peer, epoch, resp, send_time,
+                                            sent_up_to)
+            except Exception as e:  # noqa: BLE001 — a single bad exchange
+                # (KeyError from a GC'd log, follower-side assertion, ...)
+                # must not silently kill replication to this peer forever.
+                TRACE("raft %s: peer %s exchange failed: %r",
+                      self.config.peer_id, peer, e)
+                time.sleep(hb)
+                continue
+            with self._lock:
+                more = (self.role == Role.LEADER
+                        and self._leader_epoch == epoch
+                        and self._next_index.get(peer, 1) <= self._last_index)
+            if more:
+                ev.set()
+
+    def _build_request_unlocked(self, peer: str):
+        next_idx = self._next_index[peer]
+        max_batch = flags.get_flag("consensus_max_batch_size_entries")
+        entries = []
+        idx = next_idx
+        while idx <= self._last_index and len(entries) < max_batch:
+            e = self._entries.get(idx)
+            if e is None:  # trimmed from cache; reload from WAL
+                e = self._reload_from_wal_unlocked(idx)
+            entries.append(e)
+            idx += 1
+        preceding = next_idx - 1
+        preceding_term = self._term_at_unlocked(preceding)
+        sent_up_to = next_idx + len(entries) - 1
+        # Propagated safe time: never past any entry this peer is still
+        # missing (it would expose follower reads to missing data). Raft
+        # index order need not match hybrid-time order across concurrent
+        # writers, so take the min HT over the whole unsent tail.
+        safe = self.safe_time_provider()
+        unsent = (self._entries[i].ht_value
+                  for i in range(sent_up_to + 1, self._last_index + 1)
+                  if i in self._entries and self._entries[i].ht_value > 0)
+        unsent_min = min(unsent, default=0)
+        if unsent_min:
+            safe = min(safe, unsent_min - 1)
+        lease_s = flags.get_flag("ht_lease_duration_ms") / 1000.0
+        return AppendEntriesReq(
+            term=self._meta.term, leader_id=self.config.peer_id,
+            preceding_term=preceding_term, preceding_index=preceding,
+            entries=tuple(entries),
+            committed_index=min(self.commit_index, sent_up_to),
+            propagated_safe_time=safe,
+            lease_duration_s=lease_s), sent_up_to
+
+    def _reload_from_wal_unlocked(self, idx: int) -> ReplicateMsg:
+        from yugabyte_tpu.consensus.log import LogReader
+        for e in LogReader(self.log.wal_dir).read_all(min_index=idx):
+            msg = ReplicateMsg.from_log_entry(e)
+            if msg.index == idx:
+                return msg
+        raise KeyError(f"log index {idx} not found in WAL")
+
+    def _term_at_unlocked(self, index: int) -> int:
+        if index == 0:
+            return 0
+        e = self._entries.get(index)
+        if e is not None:
+            return e.term
+        return self._reload_from_wal_unlocked(index).term
+
+    def _process_peer_response(self, peer: str, epoch: int,
+                               resp: AppendEntriesResp, send_time: float,
+                               sent_up_to: int) -> None:
+        should_apply = False
+        with self._lock:
+            if self.role != Role.LEADER or self._leader_epoch != epoch:
+                return
+            if resp.term > self._meta.term:
+                self._step_down_unlocked(resp.term)
+                return
+            if resp.success:
+                self._match_index[peer] = max(self._match_index[peer],
+                                              min(sent_up_to,
+                                                  resp.last_received_index))
+                self._next_index[peer] = self._match_index[peer] + 1
+                self._last_ack_send_time[peer] = max(
+                    self._last_ack_send_time[peer], send_time)
+                self._advance_commit_unlocked()
+                should_apply = self.last_applied < self.commit_index
+            else:
+                # Log mismatch: back off to the follower's tail
+                # (ref consensus_queue.cc response handling).
+                self._next_index[peer] = min(self._next_index[peer] - 1,
+                                             resp.last_received_index + 1)
+                self._next_index[peer] = max(1, self._next_index[peer])
+        if should_apply:
+            self._apply_committed()
+
+    def _advance_commit_unlocked(self) -> None:
+        """Majority-match rule; only current-term entries count directly
+        (Raft §5.4.2; ref UpdateMajorityReplicated raft_consensus.cc:1319)."""
+        matches = sorted(
+            [self._local_durable_index]
+            + [self._match_index.get(p, 0) for p in self.config.remote_peers],
+            reverse=True)
+        candidate = matches[self.config.majority - 1]
+        while candidate > self.commit_index:
+            if self._term_at_unlocked(candidate) == self._meta.term:
+                self._set_commit_index_unlocked(candidate)
+                break
+            candidate -= 1
+
+    # Persist the advisory committed floor only every N entries: it is a
+    # bootstrap optimization (flushed frontiers + leader re-commit cover the
+    # gap), so putting a file rename on every commit would be pure overhead.
+    _FLOOR_PERSIST_STRIDE = 64
+
+    def _set_commit_index_unlocked(self, index: int) -> None:
+        self.commit_index = index
+        if index - self._meta.committed_floor >= self._FLOOR_PERSIST_STRIDE:
+            self._meta.committed_floor = index
+            self._meta.save(fsync=False)
+        self._commit_cv.notify_all()
+
+    # ----------------------------------------------------------------- apply
+    def _apply_committed(self) -> None:
+        """Apply entries (last_applied, commit_index] in order. Serialized
+        by _apply_lock; callable from any thread."""
+        with self._apply_lock:
+            while True:
+                with self._lock:
+                    if self.last_applied >= self.commit_index:
+                        return
+                    idx = self.last_applied + 1
+                    msg = self._entries.get(idx)
+                if msg is None:
+                    with self._lock:
+                        msg = self._reload_from_wal_unlocked(idx)
+                if msg.op_type != OP_NOOP:
+                    self.apply_cb(msg)
+                with self._lock:
+                    self.last_applied = idx
+                    self._commit_cv.notify_all()
+
+    # -------------------------------------------------------- follower path
+    def handle_update(self, req: AppendEntriesReq) -> AppendEntriesResp:
+        """AppendEntries handler (ref raft_consensus.cc:1473 Update)."""
+        me = self.config.peer_id
+        with self._lock:
+            if req.term < self._meta.term:
+                return AppendEntriesResp(me, self._meta.term, False,
+                                         self._last_index)
+            if req.term > self._meta.term or self.role != Role.FOLLOWER:
+                self._step_down_unlocked(req.term)
+            self.leader_id = req.leader_id
+            self._last_leader_contact = time.monotonic()
+            self._withhold_votes_until = (time.monotonic()
+                                          + req.lease_duration_s)
+            # Log-matching check
+            if req.preceding_index > 0:
+                if req.preceding_index > self._last_index:
+                    return AppendEntriesResp(me, self._meta.term, False,
+                                             self._last_index)
+                try:
+                    local_term = self._term_at_unlocked(req.preceding_index)
+                except KeyError:
+                    local_term = -1
+                if local_term != req.preceding_term:
+                    # Conflict at/before preceding: force full backoff by
+                    # hinting one below the conflict point.
+                    return AppendEntriesResp(me, self._meta.term, False,
+                                             req.preceding_index - 1)
+            to_append: List[ReplicateMsg] = []
+            for msg in req.entries:
+                if msg.index <= self._last_index:
+                    if self._term_at_unlocked(msg.index) == msg.term:
+                        continue  # already have it
+                    # Conflict: truncate our log from msg.index on.
+                    if msg.index <= self.commit_index:
+                        raise AssertionError(
+                            "attempt to truncate committed entries")
+                    for i in range(msg.index, self._last_index + 1):
+                        self._entries.pop(i, None)
+                    self.log.truncate_after(msg.index - 1)
+                    self._last_index = msg.index - 1
+                    self._last_term = self._term_at_unlocked(self._last_index)
+                    self._local_durable_index = min(
+                        self._local_durable_index, self._last_index)
+                to_append.append(msg)
+                self._entries[msg.index] = msg
+                self._last_index = msg.index
+                self._last_term = msg.term
+            if to_append:
+                # Durable before ack: the leader counts this follower
+                # toward majority once we respond.
+                self.log.append_sync([m.to_log_entry() for m in to_append])
+                self._local_durable_index = self._last_index
+            new_commit = min(req.committed_index, self._last_index)
+            if new_commit > self.commit_index:
+                self._set_commit_index_unlocked(new_commit)
+            should_apply = self.last_applied < self.commit_index
+            last = self._last_index
+        if should_apply:
+            self._apply_committed()
+        if req.propagated_safe_time > 0:
+            self.on_propagated_safe_time(req.propagated_safe_time)
+        return AppendEntriesResp(me, self._meta.term, True, last)
+
+    # -------------------------------------------------------- leader leases
+    def leader_ready(self) -> bool:
+        """The current term's NO_OP has been applied — every entry from
+        prior terms is committed and applied locally, so reads see all
+        previously acknowledged writes (ref: YB requires the leader-side
+        noop commit before serving consistent reads)."""
+        with self._lock:
+            return (self.role == Role.LEADER
+                    and self.last_applied >= getattr(
+                        self, "_leader_noop_index", 0))
+
+    def has_leader_lease(self) -> bool:
+        """A majority acked a request sent within the lease window
+        (ref leader_lease.h majority-replicated lease)."""
+        with self._lock:
+            if self.role != Role.LEADER:
+                return False
+            if len(self.config.peer_ids) == 1:
+                return True
+            times = sorted(
+                [time.monotonic()]
+                + [self._last_ack_send_time.get(p, 0.0)
+                   for p in self.config.remote_peers],
+                reverse=True)
+            majority_time = times[self.config.majority - 1]
+            lease_s = flags.get_flag("ht_lease_duration_ms") / 1000.0
+            return time.monotonic() < majority_time + lease_s
+
+
+    def wal_gc_anchor(self) -> int:
+        """Lowest index the WAL must retain for replication purposes. A
+        leader keeps everything a lagging peer still needs; elsewhere the
+        committed prefix is safe. (Until remote bootstrap lands — SURVEY §7
+        stage 7 — a peer lagging behind a GC'd log cannot catch up, so the
+        leader-side cap is load-bearing.)"""
+        with self._lock:
+            if self.role == Role.LEADER and self.config.remote_peers:
+                return min(self._match_index.get(p, 0)
+                           for p in self.config.remote_peers) + 1
+            return self.commit_index + 1
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._leader_epoch += 1
+            if self.commit_index > self._meta.committed_floor:
+                self._meta.committed_floor = self.commit_index
+                self._meta.save(fsync=False)
+            for ev in self._peer_events.values():
+                ev.set()
+            self._commit_cv.notify_all()
+        self._durable_event.set()

@@ -1,0 +1,79 @@
+"""Consensus transport seam.
+
+The reference sends consensus traffic through its custom RPC framework
+(ref: src/yb/consensus/consensus_peers.h:131 `Peer::SendNextRequest` over a
+`PeerProxy`). Here the seam is `PeerProxyIf` with two calls — UpdateConsensus
+(AppendEntries) and RequestVote — so the same RaftConsensus runs over:
+
+- `LocalTransport`: in-process dispatch between peers in one interpreter
+  (the MiniCluster path, ref rpc/local_call.h bypass), with fault injection
+  (partitions, drops) for failure tests, and
+- the host RPC layer (yugabyte_tpu/rpc) for real multi-process clusters.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict, Optional, Set, Tuple
+
+
+class PeerUnreachable(Exception):
+    pass
+
+
+class LocalTransport:
+    """In-process message fabric between named consensus instances."""
+
+    def __init__(self, seed: int = 0):
+        self._peers: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._partitions: Set[Tuple[str, str]] = set()
+        self._down: Set[str] = set()
+        self._drop_probability = 0.0
+        self._rng = random.Random(seed)
+
+    def register(self, peer_id: str, consensus: object) -> None:
+        with self._lock:
+            self._peers[peer_id] = consensus
+
+    # ------------------------------------------------------ fault injection
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._partitions.add((a, b))
+            self._partitions.add((b, a))
+
+    def isolate(self, peer_id: str) -> None:
+        """Cut peer_id off from everyone (crash-failure emulation)."""
+        with self._lock:
+            self._down.add(peer_id)
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitions.clear()
+            self._down.clear()
+
+    def set_drop_probability(self, p: float) -> None:
+        with self._lock:
+            self._drop_probability = p
+
+    def _check_link(self, src: str, dst: str) -> object:
+        with self._lock:
+            if src in self._down or dst in self._down:
+                raise PeerUnreachable(f"{src}->{dst}: peer down")
+            if (src, dst) in self._partitions:
+                raise PeerUnreachable(f"{src}->{dst}: partitioned")
+            if self._drop_probability and \
+                    self._rng.random() < self._drop_probability:
+                raise PeerUnreachable(f"{src}->{dst}: dropped")
+            peer = self._peers.get(dst)
+        if peer is None:
+            raise PeerUnreachable(f"{src}->{dst}: unknown peer")
+        return peer
+
+    # ------------------------------------------------------------ dispatch
+    def update_consensus(self, src: str, dst: str, request):
+        return self._check_link(src, dst).handle_update(request)
+
+    def request_vote(self, src: str, dst: str, request):
+        return self._check_link(src, dst).handle_vote_request(request)

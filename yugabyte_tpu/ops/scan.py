@@ -41,10 +41,12 @@ def _pack_bound(key: Optional[bytes], w: int) -> Tuple[np.ndarray, int]:
     return words[0], int(lens[0])
 
 
-@functools.partial(jax.jit, static_argnames=("w", "has_lower", "has_upper"))
+@functools.partial(jax.jit, static_argnames=(
+    "w", "has_lower", "has_upper", "upper_truncated"))
 def _scan_fused(cols, sort_rows, n_sort, cutoff_hi, cutoff_lo, cph, cpl,
                 lo_words, lo_len, hi_words, hi_len,
-                w: int, has_lower: bool, has_upper: bool):
+                w: int, has_lower: bool, has_upper: bool,
+                upper_truncated: bool = False):
     n = cols.shape[1]
     perm, keep, _ = sort_and_gc(
         cols, cutoff_hi, cutoff_lo, cph, cpl,
@@ -71,8 +73,11 @@ def _scan_fused(cols, sort_rows, n_sort, cutoff_hi, cutoff_lo, cph, cpl,
         lt, _ = cmp_bound(lo_words, lo_len)
         keep = keep & ~lt
     if has_upper:
-        lt, _ = cmp_bound(hi_words, hi_len)
-        keep = keep & lt
+        lt, eq = cmp_bound(hi_words, hi_len)
+        # A truncated bound (full upper longer than the key stride) must
+        # keep keys EQUAL to the truncated prefix: their full bytes can
+        # still be < the full bound; the host re-checks them exactly.
+        keep = keep & ((lt | eq) if upper_truncated else lt)
 
     def pack_bits(b):
         b32 = b.reshape(n // 32, 32).astype(jnp.uint32)
@@ -84,7 +89,8 @@ def _scan_fused(cols, sort_rows, n_sort, cutoff_hi, cutoff_lo, cph, cpl,
 
 def scan_visible(staged: StagedCols, read_ht_value: int,
                  lower_key: Optional[bytes] = None,
-                 upper_key: Optional[bytes] = None
+                 upper_key: Optional[bytes] = None,
+                 upper_truncated: bool = False
                  ) -> Tuple[np.ndarray, np.ndarray]:
     """Run the scan kernel over a staged cols matrix.
 
@@ -103,7 +109,7 @@ def scan_visible(staged: StagedCols, read_ht_value: int,
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
         jnp.asarray(lo_w), jnp.int32(lo_l), jnp.asarray(hi_w), jnp.int32(hi_l),
         w=staged.w, has_lower=lower_key is not None,
-        has_upper=upper_key is not None)
+        has_upper=upper_key is not None, upper_truncated=upper_truncated)
     perm = np.asarray(perm)
     keep = merge_gc._unpack_bits(np.asarray(keep_p), staged.n_pad)
     keep = keep & (perm < staged.n)
@@ -145,7 +151,8 @@ def visible_entries(slabs: Sequence[KVSlab], read_ht_value: int,
     hi_exact = upper_key if upper_key and len(upper_key) > stride else None
     perm, keep = scan_visible(staged, read_ht_value,
                               lower_key[:stride] if lower_key else None,
-                              upper_key[:stride] if upper_key else None)
+                              upper_key[:stride] if upper_key else None,
+                              upper_truncated=hi_exact is not None)
     # map merged indices back to (slab, local index)
     offsets = np.cumsum([0] + [s.n for s in slabs])
     sel = perm[keep]

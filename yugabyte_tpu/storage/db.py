@@ -279,6 +279,10 @@ class DB:
                 # On failure the inputs stay live: make them pickable again.
                 for fm in pick.inputs:
                     fm.being_compacted = False
+                # Reap deferred readers whose pinning scans finished while
+                # this compaction ran (scans also purge on exit; this covers
+                # the case where no further scan ever happens).
+                self._purge_obsolete_unlocked()
         # cascade if still over trigger
         if self.opts.auto_compact:
             self.maybe_schedule_compaction()
@@ -317,6 +321,10 @@ class DB:
     def close(self) -> None:
         with self._lock:
             self._closed = True
+            self._purge_obsolete_unlocked()
+            for r in self._obsolete.values():
+                r.close()  # still pinned: close the handle, leave the files
+            self._obsolete.clear()
             for r in self._readers.values():
                 r.close()
             self._readers.clear()

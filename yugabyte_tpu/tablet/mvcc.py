@@ -124,13 +124,27 @@ class MvccManager:
         if self._queue:
             return self._queue[0].decremented()
         if not self._is_leader:
-            return self._propagated_safe_time or self._last_replicated
+            # ONLY the leader-propagated value is safe: last_replicated is
+            # the max applied HT, but Raft index order can diverge from
+            # hybrid-time order across concurrent writers, so a pending
+            # lower-HT entry may still arrive below it.
+            return self._propagated_safe_time or HybridTime.kMin
         now = self._clock.now()
         return now if now.value > self._last_replicated.value else self._last_replicated
 
+    def peek_safe_time(self) -> HybridTime:
+        """Non-blocking safe-time read for propagation to followers. The
+        value is recorded as returned (a follower may serve a read at it),
+        so later writes are fenced above it — same invariant as safe_time()."""
+        with self._cv:
+            st = self._safe_time_unlocked()
+            if st.value > self._max_safe_time_returned.value:
+                self._max_safe_time_returned = st
+            return st
+
     def safe_time_for_follower(self) -> HybridTime:
         with self._cv:
-            return (self._propagated_safe_time or self._last_replicated)
+            return self._propagated_safe_time or HybridTime.kMin
 
     def set_propagated_safe_time(self, ht: HybridTime) -> None:
         """Follower: adopt the leader's safe time (ref mvcc.h:93)."""

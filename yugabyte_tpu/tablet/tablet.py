@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from yugabyte_tpu.common.hybrid_time import (
     DocHybridTime, HybridClock, HybridTime)
+from yugabyte_tpu.consensus.raft import OperationOutcomeUnknown
 from yugabyte_tpu.common.schema import Schema
 from yugabyte_tpu.docdb.doc_key import DocKey
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp, prepare_and_assemble
@@ -66,7 +67,8 @@ class LocalConsensusContext:
         self._index = 0
         self._lock = threading.Lock()
 
-    def submit(self, kv_pairs, ht: HybridTime) -> Tuple[int, int]:
+    def submit(self, kv_pairs, ht: HybridTime,
+               timeout_s: float = 10.0) -> Tuple[int, int]:
         with self._lock:
             self._index += 1
             op_id = (1, self._index)  # (term, index)
@@ -137,7 +139,13 @@ class Tablet:
             # and MvccManager drains completions in hybrid-time order.
             ht = self.mvcc.add_pending_now()
             try:
-                self.consensus.submit(kv_pairs, ht)
+                self.consensus.submit(kv_pairs, ht, timeout_s=timeout_s)
+            except OperationOutcomeUnknown:
+                # Fate unknown: the consensus seam registered a fate watcher
+                # that resolves the MVCC registration when the entry commits
+                # or is overwritten. Aborting here would let safe time
+                # advance past a write that may yet land.
+                raise
             except BaseException:
                 self.mvcc.aborted(ht)
                 raise

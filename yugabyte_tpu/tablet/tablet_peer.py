@@ -1,0 +1,235 @@
+"""TabletPeer: a replicated tablet = Tablet + RaftConsensus + WAL.
+
+Capability parity with the reference (ref: src/yb/tablet/tablet_peer.h:129 —
+glue between Tablet, RaftConsensus and the Log; write submission
+tablet_peer.cc:638 `WriteAsync`/:655 `Submit`; bootstrap = WAL replay,
+ref tablet/tablet_bootstrap.cc:195 `ReplayState` and
+`Tablet::MaxPersistentOpId` tablet.cc:2931).
+
+Key flows:
+- Leader write: Tablet.write -> RaftWriteContext.submit -> raft.replicate
+  (WAL append + majority ack + in-order apply) -> returns op id. The apply
+  callback feeds Tablet.apply_write_batch on every replica.
+- Follower safety: writes are rejected with NotLeader; reads serve at the
+  leader's propagated safe time (ref mvcc.h:93).
+- Bootstrap: storage frontiers tell how far the DBs persisted; WAL entries
+  above that (up to the durable committed floor) replay into the tablet,
+  the rest stay pending in Raft until a leader commits or truncates them.
+- Transport addressing: each peer of each tablet's Raft group registers as
+  "<server_id>/<tablet_id>" so one fabric serves many tablets per server
+  (the reference routes consensus RPCs by tablet id the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from yugabyte_tpu.common.hybrid_time import HybridClock, HybridTime
+from yugabyte_tpu.common.schema import Schema
+from yugabyte_tpu.consensus.log import Log, LogReader
+from yugabyte_tpu.consensus.raft import (
+    OP_WRITE, NotLeader, OperationOutcomeUnknown, RaftConfig, RaftConsensus,
+    ReplicateMsg, ReplicationTimedOut, Role)
+from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+
+
+def encode_write_batch(kv_pairs: Sequence[Tuple[bytes, bytes]]) -> bytes:
+    out = [struct.pack("<I", len(kv_pairs))]
+    for k, v in kv_pairs:
+        out.append(struct.pack("<I", len(k)))
+        out.append(k)
+        out.append(struct.pack("<I", len(v)))
+        out.append(v)
+    return b"".join(out)
+
+
+def decode_write_batch(payload: bytes) -> List[Tuple[bytes, bytes]]:
+    (n,) = struct.unpack_from("<I", payload)
+    off = 4
+    pairs = []
+    for _ in range(n):
+        (kl,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        k = payload[off:off + kl]
+        off += kl
+        (vl,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        pairs.append((k, payload[off:off + vl]))
+        off += vl
+    return pairs
+
+
+class RaftWriteContext:
+    """The consensus seam Tablet.write submits through (replaces
+    LocalConsensusContext once a TabletPeer owns the tablet)."""
+
+    def __init__(self, peer: "TabletPeer"):
+        self._peer = peer
+
+    def submit(self, kv_pairs, ht: HybridTime,
+               timeout_s: float = 30.0) -> Tuple[int, int]:
+        payload = encode_write_batch(kv_pairs)
+        try:
+            return self._peer.raft.replicate(OP_WRITE, ht.value, payload,
+                                             timeout_s=timeout_s)
+        except ReplicationTimedOut as e:
+            # The entry may still commit: MVCC must keep holding safe time
+            # at ht until the fate settles, then resolve the registration.
+            mvcc = self._peer.tablet.mvcc
+            self._peer.raft.watch_fate(
+                e.op_id,
+                on_committed=lambda: mvcc.replicated(ht),
+                on_aborted=lambda: mvcc.aborted(ht))
+            raise OperationOutcomeUnknown(str(e)) from e
+
+
+def peer_address(server_id: str, tablet_id: str) -> str:
+    return f"{server_id}/{tablet_id}"
+
+
+class TabletPeer:
+    def __init__(self, tablet_id: str, data_dir: str, schema: Schema,
+                 server_id: str, server_ids: Sequence[str], transport,
+                 clock: Optional[HybridClock] = None,
+                 options: Optional[TabletOptions] = None,
+                 metrics=None):
+        self.tablet_id = tablet_id
+        self.server_id = server_id
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.clock = clock or HybridClock()
+        self.tablet = Tablet(tablet_id, data_dir, schema, clock=self.clock,
+                             options=options, metrics=metrics)
+        self.log = Log(os.path.join(data_dir, "wal"))
+        config = RaftConfig(
+            peer_id=peer_address(server_id, tablet_id),
+            peer_ids=tuple(peer_address(s, tablet_id) for s in server_ids))
+        self.raft = RaftConsensus(
+            config, self.log, transport,
+            apply_cb=self._apply_replicated,
+            meta_path=os.path.join(data_dir, "cmeta.json"),
+            safe_time_provider=lambda: self.tablet.mvcc.peek_safe_time().value,
+            on_propagated_safe_time=self._on_propagated_safe_time,
+            on_role_change=self._on_role_change,
+            clock=self.clock)
+        transport.register(config.peer_id, self.raft)
+        self.tablet.consensus = RaftWriteContext(self)
+        self.tablet.mvcc.set_leader_mode(False)
+
+    # ------------------------------------------------------------ bootstrap
+    def bootstrap(self) -> int:
+        """Replay WAL into the tablet (ref tablet_bootstrap.cc). Returns the
+        number of entries replayed."""
+        frontiers = [db.versions.flushed_frontier.op_id_max[1]
+                     for db in (self.tablet.regular_db, self.tablet.intents_db)
+                     if db.versions.flushed_frontier is not None]
+        flushed_min = min(frontiers) if frontiers else 0
+        replay_from = flushed_min + 1
+        replayed = 0
+        max_ht = 0
+        # Flushed storage implies those entries were committed; the floor
+        # may exceed the (non-fsynced) one recovered from metadata.
+        committed_floor = max(self.raft.commit_index, flushed_min)
+        for entry in LogReader(self.log.wal_dir).read_all(
+                min_index=replay_from):
+            msg = ReplicateMsg.from_log_entry(entry)
+            if msg.index > committed_floor:
+                break  # pending tail: Raft decides its fate later
+            self._apply_replicated(msg)
+            replayed += 1
+            max_ht = max(max_ht, msg.ht_value)
+        self.raft.set_bootstrap_state(committed_floor)
+        if max_ht:
+            ht = HybridTime(max_ht)
+            self.clock.update(ht)
+            self.tablet.mvcc.set_last_replicated(ht)
+        TRACE("bootstrap %s: replayed %d ops from index %d",
+              self.tablet_id, replayed, replay_from)
+        return replayed
+
+    def start(self, election_timer: bool = True) -> "TabletPeer":
+        self.bootstrap()
+        self.raft.start(election_timer=election_timer)
+        return self
+
+    # ---------------------------------------------------------------- apply
+    def _apply_replicated(self, msg: ReplicateMsg) -> None:
+        if msg.op_type == OP_WRITE:
+            kv_pairs = decode_write_batch(msg.payload)
+            ht = HybridTime(msg.ht_value)
+            self.tablet.apply_write_batch(kv_pairs, ht, msg.op_id)
+            if not self.raft.is_leader():
+                # Followers advance replication watermark directly; the
+                # leader's MvccManager drains via replicated() in write().
+                self.clock.update(ht)
+                self.tablet.mvcc.set_last_replicated(ht)
+
+    def _on_propagated_safe_time(self, ht_value: int) -> None:
+        ht = HybridTime(ht_value)
+        self.clock.update(ht)
+        self.tablet.mvcc.set_propagated_safe_time(ht)
+
+    def _on_role_change(self, role: Role) -> None:
+        self.tablet.mvcc.set_leader_mode(role == Role.LEADER)
+
+    # ---------------------------------------------------------------- reads
+    def check_leader_lease(self, timeout_s: float = 5.0) -> None:
+        """Wait for a majority-acked lease before serving a consistent read
+        (the reference blocks on the ht lease the same way, ref
+        raft_consensus WaitForLeaderLeaseImprecise)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if not self.raft.is_leader():
+                raise NotLeader(self.raft.leader_hint())
+            if self.raft.has_leader_lease() and self.raft.leader_ready():
+                return
+            if time.monotonic() >= deadline:
+                raise NotLeader(self.raft.leader_hint())
+            time.sleep(0.002)
+
+    def read_row(self, doc_key, read_ht: Optional[HybridTime] = None,
+                 projection=None, allow_follower: bool = False):
+        if self.raft.is_leader():
+            self.check_leader_lease()
+            return self.tablet.read_row(doc_key, read_ht, projection)
+        if not allow_follower:
+            raise NotLeader(self.raft.leader_hint())
+        if read_ht is not None:
+            # Wait until the propagated safe time covers the requested read
+            # point — same repeatable-read guarantee as the leader path.
+            self.tablet.mvcc.safe_time(min_allowed=read_ht)
+            ht = read_ht
+        else:
+            ht = self.tablet.mvcc.safe_time_for_follower()
+        from yugabyte_tpu.docdb.doc_rowwise_iterator import read_row
+        return read_row(self.tablet.regular_db, self.tablet.schema, doc_key,
+                        ht, projection=projection)
+
+    def write(self, ops, timeout_s: float = 30.0) -> HybridTime:
+        if not self.raft.is_leader():
+            raise NotLeader(self.raft.leader_hint())
+        return self.tablet.write(ops, timeout_s=timeout_s)
+
+    # ----------------------------------------------------------- background
+    def flush_and_gc_wal(self) -> int:
+        """Flush both DBs, then drop WAL segments fully below the persisted
+        frontier (ref log GC driven by flushed OpId anchors)."""
+        self.tablet.flush()
+        frontiers = [db.versions.flushed_frontier.op_id_max[1]
+                     for db in (self.tablet.regular_db, self.tablet.intents_db)
+                     if db.versions.flushed_frontier is not None]
+        anchor = (min(frontiers) + 1) if frontiers else 0
+        # Never GC entries a lagging peer still needs (there is no remote
+        # bootstrap yet to rebuild it from a snapshot).
+        anchor = min(anchor, self.raft.wal_gc_anchor())
+        return self.log.gc_up_to(anchor)
+
+    def shutdown(self) -> None:
+        self.raft.shutdown()
+        self.log.close()
+        self.tablet.close()
