@@ -1,0 +1,215 @@
+"""RPC layer tests: codec round-trips, messenger calls, error mapping,
+concurrency, and a 3-peer Raft group replicating over real loopback sockets
+(the reference exercises the same path in rpc/rpc-test.cc and
+consensus/raft_consensus-itest)."""
+
+import threading
+import time
+
+import pytest
+
+from yugabyte_tpu.rpc.codec import dumps, loads
+from yugabyte_tpu.rpc.messenger import (
+    Messenger, Proxy, RemoteError, RpcTimeout, ServiceUnavailable)
+from yugabyte_tpu.utils.status import Code, Status, StatusError
+
+
+@pytest.mark.parametrize("obj", [
+    None, True, False, 0, 1, -1, 2**64, -(2**70), 3.5, b"", b"\x00\xff" * 10,
+    "", "héllo", [], [1, [2, [3]]], {}, {"a": 1, "b": [b"x", None]},
+    {1: "int-key", b"b": "bytes-key"},
+    {"nested": {"deep": {"deeper": [1.5, True, b"\x80"]}}},
+])
+def test_codec_roundtrip(obj):
+    assert loads(dumps(obj)) == obj
+
+
+def test_codec_tuple_becomes_list():
+    assert loads(dumps((1, 2))) == [1, 2]
+
+
+def test_codec_rejects_unknown_type():
+    with pytest.raises(TypeError):
+        dumps(object())
+
+
+class EchoService:
+    def echo(self, x):
+        return x
+
+    def add(self, a, b):
+        return a + b
+
+    def fail_status(self):
+        raise StatusError(Status.NotFound("no such thing"))
+
+    def fail_raise(self):
+        raise ValueError("boom")
+
+    def slow(self, delay_s):
+        time.sleep(delay_s)
+        return "done"
+
+
+@pytest.fixture
+def pair():
+    server = Messenger("server")
+    server.register_service("echo", EchoService())
+    client = Messenger("client")
+    yield server, client
+    client.shutdown()
+    server.shutdown()
+
+
+def test_basic_call(pair):
+    server, client = pair
+    assert client.call(server.address, "echo", "add", a=2, b=3) == 5
+    assert client.call(server.address, "echo", "echo",
+                       x={"k": [b"v", 1]}) == {"k": [b"v", 1]}
+
+
+def test_proxy(pair):
+    server, client = pair
+    proxy = Proxy(client, server.address, "echo")
+    assert proxy.add(a=10, b=20) == 30
+
+
+def test_local_bypass(pair):
+    server, _ = pair
+    # A call addressed to the messenger itself never touches a socket.
+    assert server.call(server.address, "echo", "add", a=1, b=1) == 2
+
+
+def test_status_error_crosses_wire(pair):
+    server, client = pair
+    with pytest.raises(RemoteError) as ei:
+        client.call(server.address, "echo", "fail_status")
+    assert ei.value.status.code == Code.NOT_FOUND
+
+
+def test_exception_maps_to_remote_error(pair):
+    server, client = pair
+    with pytest.raises(RemoteError) as ei:
+        client.call(server.address, "echo", "fail_raise")
+    assert ei.value.status.code == Code.REMOTE_ERROR
+    assert "boom" in ei.value.status.message
+
+
+def test_unknown_service_and_method(pair):
+    server, client = pair
+    with pytest.raises(RemoteError) as ei:
+        client.call(server.address, "nope", "x")
+    assert ei.value.status.code == Code.SERVICE_UNAVAILABLE
+    with pytest.raises(RemoteError) as ei:
+        client.call(server.address, "echo", "nope")
+    assert ei.value.status.code == Code.NOT_SUPPORTED
+
+
+def test_timeout_and_connection_survives(pair):
+    server, client = pair
+    with pytest.raises(RpcTimeout):
+        client.call(server.address, "echo", "slow", timeout_s=0.2, delay_s=5)
+    # The connection keeps working for later calls.
+    assert client.call(server.address, "echo", "add", a=1, b=2) == 3
+
+
+def test_unreachable_server():
+    client = Messenger("client")
+    try:
+        with pytest.raises(ServiceUnavailable):
+            client.call("127.0.0.1:1", "echo", "echo", x=1)
+    finally:
+        client.shutdown()
+
+
+def test_concurrent_calls_multiplex(pair):
+    server, client = pair
+    results = []
+    errors = []
+
+    def worker(i):
+        try:
+            results.append(client.call(server.address, "echo", "add",
+                                       a=i, b=i))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert sorted(results) == [2 * i for i in range(32)]
+
+
+def test_server_shutdown_fails_pending(pair):
+    server, client = pair
+    done = threading.Event()
+    caught = []
+
+    def worker():
+        try:
+            client.call(server.address, "echo", "slow", timeout_s=10,
+                        delay_s=30)
+        except (ServiceUnavailable, RpcTimeout) as e:
+            caught.append(e)
+        done.set()
+
+    threading.Thread(target=worker, daemon=True).start()
+    time.sleep(0.2)
+    server.shutdown()
+    assert done.wait(timeout=5)
+    assert caught
+
+
+# --------------------------------------------------------------- Raft on RPC
+
+def test_raft_over_rpc(tmp_path):
+    from yugabyte_tpu.consensus.log import Log
+    from yugabyte_tpu.consensus.raft import (
+        OP_WRITE, RaftConfig, RaftConsensus)
+    from yugabyte_tpu.rpc.consensus_service import RpcTransport
+
+    peers = ["a", "b", "c"]
+    messengers = {p: Messenger(p) for p in peers}
+    addr_map = {f"{p}/t1": messengers[p].address for p in peers}
+    transports = {p: RpcTransport(messengers[p], addr_map.get)
+                  for p in peers}
+
+    applied = {p: [] for p in peers}
+    nodes = {}
+    for p in peers:
+        d = tmp_path / p
+        d.mkdir()
+        cfg = RaftConfig(peer_id=f"{p}/t1",
+                         peer_ids=tuple(f"{q}/t1" for q in peers))
+        node = RaftConsensus(
+            cfg, Log(str(d / "wal")), transports[p],
+            apply_cb=lambda m, p=p: applied[p].append(m.payload),
+            meta_path=str(d / "meta.json"))
+        transports[p].register(cfg.peer_id, node)
+        nodes[p] = node
+
+    try:
+        nodes["a"].start(election_timer=False)
+        nodes["a"].start_election(ignore_lease=True)
+        deadline = time.monotonic() + 10
+        while not nodes["a"].is_leader():
+            assert time.monotonic() < deadline, "leader election stalled"
+            time.sleep(0.01)
+        for i in range(20):
+            nodes["a"].replicate(OP_WRITE, i + 1, b"payload-%d" % i,
+                                 timeout_s=10)
+        deadline = time.monotonic() + 10
+        while any(len(applied[p]) < 20 for p in peers):
+            assert time.monotonic() < deadline, \
+                f"replication stalled: { {p: len(applied[p]) for p in peers} }"
+            time.sleep(0.01)
+        for p in peers:
+            assert applied[p] == [b"payload-%d" % i for i in range(20)]
+    finally:
+        for node in nodes.values():
+            node.shutdown()
+        for m in messengers.values():
+            m.shutdown()

@@ -171,10 +171,16 @@ class RaftConfig:
 
 class _ConsensusMetadata:
     """Durable (term, voted_for) + advisory committed floor
-    (ref consensus/consensus_meta.cc)."""
+    (ref consensus/consensus_meta.cc).
+
+    The floor lives in its OWN file, written without fsync: it is a pure
+    bootstrap optimization, and letting its frequent non-fsynced rewrites
+    touch the file holding the Raft-critical (term, voted_for) record could
+    corrupt the vote on power loss. A torn floor file degrades to floor 0."""
 
     def __init__(self, path: str):
         self.path = path
+        self.floor_path = path + ".floor"
         self.term = 0
         self.voted_for: Optional[str] = None
         self.committed_floor = 0
@@ -183,17 +189,29 @@ class _ConsensusMetadata:
                 d = json.load(f)
             self.term = d["term"]
             self.voted_for = d.get("voted_for")
+            # Legacy layout kept the floor inline; prefer the newer file.
             self.committed_floor = d.get("committed_floor", 0)
+        if os.path.exists(self.floor_path):
+            try:
+                with open(self.floor_path) as f:
+                    self.committed_floor = max(self.committed_floor,
+                                               int(f.read().strip() or 0))
+            except (ValueError, OSError):
+                pass  # advisory only
 
-    def save(self, fsync: bool = True) -> None:
+    def save(self) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for,
-                       "committed_floor": self.committed_floor}, f)
-            if fsync:
-                f.flush()
-                os.fsync(f.fileno())
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.path)
+
+    def save_floor(self) -> None:
+        tmp = self.floor_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self.committed_floor))
+        os.replace(tmp, self.floor_path)
 
 
 class RaftConsensus:
@@ -454,11 +472,23 @@ class RaftConsensus:
         deadline = time.monotonic() + timeout_s
         with self._commit_cv:
             while True:
+                # Applied first: a committed+applied entry may already be
+                # evicted from the cache — reporting it aborted would double-
+                # apply on client retry.
+                if self.last_applied >= msg.index:
+                    try:
+                        applied_term = self._term_at_unlocked(msg.index)
+                    except KeyError:
+                        # Evicted from cache AND WAL-GC'd: only applied
+                        # entries are evicted, and an overwrite would still
+                        # be cached — the survivor is ours.
+                        applied_term = msg.term
+                    if applied_term != msg.term:
+                        raise ReplicationAborted(f"op {msg.op_id} overwritten")
+                    return msg.op_id
                 cur = self._entries.get(msg.index)
                 if cur is None or cur.term != msg.term:
                     raise ReplicationAborted(f"op {msg.op_id} overwritten")
-                if self.last_applied >= msg.index:
-                    return msg.op_id
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     # NOT an abort: the entry stays in the log and may yet
@@ -519,12 +549,15 @@ class RaftConsensus:
     def _maybe_evict_cache_unlocked(self) -> None:
         """Bound the in-memory entry cache (ref consensus/log_cache.cc):
         applied entries below every peer's match index are reloadable from
-        the WAL on demand."""
+        the WAL on demand. Only a LEADER gates eviction on peer match
+        indexes — a follower has no peers to serve, and its empty
+        _match_index map must not pin the floor at 0 forever."""
         if len(self._entries) <= self._CACHE_HIGH_WATER:
             return
-        floor = min([self.last_applied - self._CACHE_TAIL]
-                    + [self._match_index.get(p, 0)
-                       for p in self.config.remote_peers])
+        floor = self.last_applied - self._CACHE_TAIL
+        if self.role == Role.LEADER:
+            floor = min([floor] + [self._match_index.get(p, 0)
+                                   for p in self.config.remote_peers])
         for i in list(self._entries):
             if i < floor:
                 del self._entries[i]
@@ -607,10 +640,18 @@ class RaftConsensus:
         max_batch = flags.get_flag("consensus_max_batch_size_entries")
         entries = []
         idx = next_idx
+        reloaded: Dict[int, ReplicateMsg] = {}
         while idx <= self._last_index and len(entries) < max_batch:
-            e = self._entries.get(idx)
-            if e is None:  # trimmed from cache; reload from WAL
-                e = self._reload_from_wal_unlocked(idx)
+            e = self._entries.get(idx) or reloaded.get(idx)
+            if e is None:
+                # Trimmed from cache: reload the whole remaining batch range
+                # in ONE WAL pass (per-index scans would make catch-up of a
+                # lagging peer O(batch * WAL-size)).
+                hi = min(self._last_index, next_idx + max_batch - 1)
+                reloaded = self._reload_range_from_wal_unlocked(idx, hi)
+                e = reloaded.get(idx)
+                if e is None:
+                    raise KeyError(f"log index {idx} not found in WAL")
             entries.append(e)
             idx += 1
         preceding = next_idx - 1
@@ -643,6 +684,17 @@ class RaftConsensus:
             if msg.index == idx:
                 return msg
         raise KeyError(f"log index {idx} not found in WAL")
+
+    def _reload_range_from_wal_unlocked(
+            self, lo: int, hi: int) -> Dict[int, ReplicateMsg]:
+        """One contiguous WAL pass covering [lo, hi]."""
+        from yugabyte_tpu.consensus.log import LogReader
+        out: Dict[int, ReplicateMsg] = {}
+        for e in LogReader(self.log.wal_dir).read_all(min_index=lo):
+            if e.index > hi:
+                break
+            out[e.index] = ReplicateMsg.from_log_entry(e)
+        return out
 
     def _term_at_unlocked(self, index: int) -> int:
         if index == 0:
@@ -703,7 +755,7 @@ class RaftConsensus:
         self.commit_index = index
         if index - self._meta.committed_floor >= self._FLOOR_PERSIST_STRIDE:
             self._meta.committed_floor = index
-            self._meta.save(fsync=False)
+            self._meta.save_floor()
         self._commit_cv.notify_all()
 
     # ----------------------------------------------------------------- apply
@@ -770,6 +822,15 @@ class RaftConsensus:
                     self._last_term = self._term_at_unlocked(self._last_index)
                     self._local_durable_index = min(
                         self._local_durable_index, self._last_index)
+                    # Also roll back the async-appender watermark: indexes at
+                    # or below the old watermark are being REWRITTEN, and the
+                    # stale value must not resurrect durability for them if
+                    # this node later becomes leader (the min(w, _last_index)
+                    # cap in the commit worker only guards indexes above the
+                    # new tail).
+                    with self._durable_lock:
+                        self._durable_watermark = min(
+                            self._durable_watermark, self._last_index)
                 to_append.append(msg)
                 self._entries[msg.index] = msg
                 self._last_index = msg.index
@@ -837,7 +898,7 @@ class RaftConsensus:
             self._leader_epoch += 1
             if self.commit_index > self._meta.committed_floor:
                 self._meta.committed_floor = self.commit_index
-                self._meta.save(fsync=False)
+                self._meta.save_floor()
             for ev in self._peer_events.values():
                 ev.set()
             self._commit_cv.notify_all()

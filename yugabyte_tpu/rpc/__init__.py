@@ -1,0 +1,17 @@
+"""Host-level RPC: the control-plane transport of the TPU framework.
+
+The reference moves ALL traffic (data + control) through a custom epoll/libev
+reactor RPC stack (ref: src/yb/rpc/README:16-79, Messenger messenger.h, Proxy
+proxy.h, ServiceIf service_if.h). In the TPU re-design, bulk data movement
+between chips rides XLA collectives over ICI/DCN (yugabyte_tpu/parallel), so
+this package only carries host-side control traffic: consensus messages,
+heartbeats, DDL, tablet reads/writes between processes. It is deliberately a
+threaded (not reactor) design — Python's socket layer is not the hot path.
+"""
+
+from yugabyte_tpu.rpc.codec import dumps, loads
+from yugabyte_tpu.rpc.messenger import (
+    Messenger, Proxy, RemoteError, RpcTimeout, ServiceUnavailable)
+
+__all__ = ["dumps", "loads", "Messenger", "Proxy", "RemoteError",
+           "RpcTimeout", "ServiceUnavailable"]

@@ -1,0 +1,134 @@
+"""Self-describing binary wire codec for RPC payloads.
+
+The reference serializes RPC bodies as protobuf with a small binary header
+(ref: src/yb/rpc/binary_call_parser.cc framing, gen_yrpc codegen for message
+classes). Here the message set is small and Python-native, so instead of a
+codegen step we use one compact tagged codec covering the closed type set
+{None, bool, int, float, bytes, str, list, dict}; services exchange plain
+dicts. Ints are arbitrary-precision (hybrid times are u64-sized), encoded
+as length-prefixed big-endian two's complement.
+
+Framing on the socket is [u32 little-endian length][payload] — the same
+length-prefix scheme as the reference's binary call parser.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List, Tuple
+
+_F64 = struct.Struct("<d")
+
+
+def _write_varint(out: List[bytes], n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bytes((b | 0x80,)))
+        else:
+            out.append(bytes((b,)))
+            return
+
+
+def _read_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    shift = 0
+    n = 0
+    while True:
+        b = buf[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+
+
+def _dump(obj: Any, out: List[bytes]) -> None:
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, int):
+        raw = obj.to_bytes((obj.bit_length() + 8) // 8 or 1, "big", signed=True)
+        out.append(b"i")
+        _write_varint(out, len(raw))
+        out.append(raw)
+    elif isinstance(obj, float):
+        out.append(b"f")
+        out.append(_F64.pack(obj))
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        b = bytes(obj)
+        out.append(b"b")
+        _write_varint(out, len(b))
+        out.append(b)
+    elif isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        out.append(b"s")
+        _write_varint(out, len(raw))
+        out.append(raw)
+    elif isinstance(obj, (list, tuple)):
+        out.append(b"l")
+        _write_varint(out, len(obj))
+        for item in obj:
+            _dump(item, out)
+    elif isinstance(obj, dict):
+        out.append(b"d")
+        _write_varint(out, len(obj))
+        for k, v in obj.items():
+            _dump(k, out)
+            _dump(v, out)
+    else:
+        raise TypeError(f"not wire-encodable: {type(obj)!r}")
+
+
+def dumps(obj: Any) -> bytes:
+    out: List[bytes] = []
+    _dump(obj, out)
+    return b"".join(out)
+
+
+def _load(buf: bytes, off: int) -> Tuple[Any, int]:
+    tag = buf[off:off + 1]
+    off += 1
+    if tag == b"N":
+        return None, off
+    if tag == b"T":
+        return True, off
+    if tag == b"F":
+        return False, off
+    if tag == b"i":
+        n, off = _read_varint(buf, off)
+        return int.from_bytes(buf[off:off + n], "big", signed=True), off + n
+    if tag == b"f":
+        return _F64.unpack_from(buf, off)[0], off + _F64.size
+    if tag == b"b":
+        n, off = _read_varint(buf, off)
+        return buf[off:off + n], off + n
+    if tag == b"s":
+        n, off = _read_varint(buf, off)
+        return buf[off:off + n].decode("utf-8"), off + n
+    if tag == b"l":
+        n, off = _read_varint(buf, off)
+        items = []
+        for _ in range(n):
+            item, off = _load(buf, off)
+            items.append(item)
+        return items, off
+    if tag == b"d":
+        n, off = _read_varint(buf, off)
+        d = {}
+        for _ in range(n):
+            k, off = _load(buf, off)
+            v, off = _load(buf, off)
+            d[k] = v
+        return d, off
+    raise ValueError(f"bad wire tag {tag!r} at offset {off - 1}")
+
+
+def loads(buf: bytes) -> Any:
+    obj, off = _load(buf, 0)
+    if off != len(buf):
+        raise ValueError(f"trailing garbage: {len(buf) - off} bytes")
+    return obj
