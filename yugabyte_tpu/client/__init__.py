@@ -1,0 +1,10 @@
+"""Client library: partition-aware routing, op batching, DDL.
+
+Capability parity with src/yb/client (ref: client.h:264 YBClient,
+meta_cache.h:484, session.h:96 / batcher.h:148).
+"""
+
+from yugabyte_tpu.client.client import YBClient, YBTable
+from yugabyte_tpu.client.session import YBSession
+
+__all__ = ["YBClient", "YBTable", "YBSession"]

@@ -1,0 +1,97 @@
+"""MetaCache: tablet-location cache keyed by partition key.
+
+Capability parity with the reference (ref: src/yb/client/meta_cache.h:484 —
+per-table partition->RemoteTablet map filled from master
+GetTableLocations, leader marking from follower NOT_THE_LEADER retries,
+invalidation on stale lookups).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from yugabyte_tpu.common.partition import Partition, partition_for_key
+from yugabyte_tpu.common.wire import partition_from_wire
+
+
+@dataclass
+class RemoteReplica:
+    server_id: str
+    addr: Optional[str]
+
+
+class RemoteTablet:
+    """ref meta_cache.h RemoteTablet"""
+
+    def __init__(self, tablet_id: str, partition: Partition,
+                 replicas: List[RemoteReplica], leader: Optional[str]):
+        self.tablet_id = tablet_id
+        self.partition = partition
+        self.replicas = replicas
+        self.leader = leader  # server_id
+
+    def leader_addr(self) -> Optional[str]:
+        for r in self.replicas:
+            if r.server_id == self.leader:
+                return r.addr
+        return None
+
+    def mark_leader(self, server_id: str) -> None:
+        self.leader = server_id
+
+    def candidate_addrs(self) -> List[str]:
+        """Leader first, then the rest (the reference walks replicas the
+        same way when the leader is unknown)."""
+        out = []
+        la = self.leader_addr()
+        if la:
+            out.append(la)
+        for r in self.replicas:
+            if r.addr and r.addr not in out:
+                out.append(r.addr)
+        return out
+
+
+class MetaCache:
+    def __init__(self, lookup_locations):
+        """lookup_locations(table_id) -> wire locations from the master."""
+        self._lookup = lookup_locations
+        self._lock = threading.Lock()
+        self._tables: Dict[str, List[RemoteTablet]] = {}
+
+    def _refresh(self, table_id: str) -> List[RemoteTablet]:
+        locs = self._lookup(table_id)
+        tablets = [
+            RemoteTablet(
+                loc["tablet_id"], partition_from_wire(loc["partition"]),
+                [RemoteReplica(r["server_id"], r["addr"])
+                 for r in loc["replicas"]],
+                loc["leader"])
+            for loc in locs]
+        with self._lock:
+            self._tables[table_id] = tablets
+        return tablets
+
+    def lookup_tablet(self, table_id: str, partition_key: bytes,
+                      refresh: bool = False) -> RemoteTablet:
+        with self._lock:
+            tablets = self._tables.get(table_id)
+        if tablets is None or refresh:
+            tablets = self._refresh(table_id)
+        idx = partition_for_key([t.partition for t in tablets],
+                                partition_key)
+        return tablets[idx]
+
+    def tablets(self, table_id: str,
+                refresh: bool = False) -> List[RemoteTablet]:
+        with self._lock:
+            tablets = self._tables.get(table_id)
+        if tablets is None or refresh:
+            tablets = self._refresh(table_id)
+        return list(tablets)
+
+    def invalidate(self, table_id: str) -> None:
+        with self._lock:
+            self._tables.pop(table_id, None)

@@ -1,0 +1,62 @@
+"""YBSession + Batcher: buffered writes grouped per tablet.
+
+Capability parity with the reference (ref: src/yb/client/session.h:96 —
+Apply buffers ops, Flush groups them per tablet and sends one WriteRpc per
+tablet in parallel; batcher.h:148). Parallelism here is a thread per tablet
+batch — the control-plane RPC layer is threaded end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from yugabyte_tpu.client.client import YBClient, YBTable
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp
+from yugabyte_tpu.utils.status import Status, StatusError
+
+
+class YBSession:
+    def __init__(self, client: YBClient):
+        self._client = client
+        self._pending: List[Tuple[YBTable, QLWriteOp]] = []
+        self._lock = threading.Lock()
+
+    def apply(self, table: YBTable, op: QLWriteOp) -> None:
+        with self._lock:
+            self._pending.append((table, op))
+
+    def flush(self) -> int:
+        """Send all buffered ops, one write RPC per destination tablet, in
+        parallel. Returns ops flushed; raises the first error after all
+        batches settle (ref batcher.cc CheckForFinishedFlush)."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return 0
+        # group by (table_id, tablet_id)
+        groups: Dict[str, Tuple[YBTable, object, List[QLWriteOp]]] = {}
+        for table, op in pending:
+            pk = table.partition_key_for(op.doc_key)
+            tablet = self._client.meta_cache.lookup_tablet(table.table_id, pk)
+            key = f"{table.table_id}/{tablet.tablet_id}"
+            if key not in groups:
+                groups[key] = (table, tablet, [])
+            groups[key][2].append(op)
+        errors: List[Exception] = []
+
+        def send(table: YBTable, tablet, ops: List[QLWriteOp]) -> None:
+            try:
+                self._client.write(table, ops, tablet=tablet)
+            except Exception as e:  # noqa: BLE001 — collected below
+                errors.append(e)
+
+        threads = [threading.Thread(target=send, args=g, daemon=True)
+                   for g in groups.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return len(pending)

@@ -1,0 +1,103 @@
+"""Wire representations of common value objects (schema, doc keys, QL ops,
+rows) shared by client, tserver and master.
+
+The reference defines these as protobuf messages (ref: src/yb/common/
+common.proto `SchemaPB`/`PartitionSchemaPB`, ql_protocol.proto
+`QLWriteRequestPB`/`QLRowBlock`); here they are plain dicts over the RPC
+codec's closed type set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from yugabyte_tpu.common.partition import Partition, PartitionSchema
+from yugabyte_tpu.common.schema import (
+    ColumnSchema, DataType, Schema, SortingType)
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+
+
+# ------------------------------------------------------------------ schema
+def schema_to_wire(schema: Schema) -> dict:
+    return {
+        "columns": [[c.name, c.type.value, c.nullable, c.sorting.value]
+                    for c in schema.columns],
+        "num_hash": schema.num_hash_key_columns,
+        "num_range": schema.num_range_key_columns,
+    }
+
+
+def schema_from_wire(w: dict) -> Schema:
+    return Schema(
+        columns=[ColumnSchema(n, DataType(t), nullable, SortingType(s))
+                 for n, t, nullable, s in w["columns"]],
+        num_hash_key_columns=w["num_hash"],
+        num_range_key_columns=w["num_range"])
+
+
+def partition_schema_to_wire(ps: PartitionSchema) -> dict:
+    return {"hash_partitioning": ps.hash_partitioning}
+
+
+def partition_schema_from_wire(w: dict) -> PartitionSchema:
+    return PartitionSchema(hash_partitioning=w["hash_partitioning"])
+
+
+def partition_to_wire(p: Partition) -> dict:
+    return {"start": p.start, "end": p.end}
+
+
+def partition_from_wire(w: dict) -> Partition:
+    return Partition(start=w["start"], end=w["end"])
+
+
+# ----------------------------------------------------------------- doc keys
+def doc_key_to_wire(dk: DocKey) -> dict:
+    return {"hash": list(dk.hash_components),
+            "range": list(dk.range_components)}
+
+
+def doc_key_from_wire(w: dict) -> DocKey:
+    return DocKey(hash_components=tuple(w["hash"]),
+                  range_components=tuple(w["range"]))
+
+
+# ---------------------------------------------------------------- write ops
+def write_op_to_wire(op: QLWriteOp) -> dict:
+    return {
+        "kind": op.kind.value,
+        "doc_key": doc_key_to_wire(op.doc_key),
+        "values": dict(op.values),
+        "ttl_ms": op.ttl_ms,
+        "cols_to_delete": list(op.columns_to_delete),
+    }
+
+
+def write_op_from_wire(w: dict) -> QLWriteOp:
+    return QLWriteOp(
+        kind=WriteOpKind(w["kind"]),
+        doc_key=doc_key_from_wire(w["doc_key"]),
+        values=dict(w["values"]),
+        ttl_ms=w["ttl_ms"],
+        columns_to_delete=tuple(w["cols_to_delete"]))
+
+
+# --------------------------------------------------------------------- rows
+def row_to_wire(row) -> dict:
+    """Row (docdb/doc_rowwise_iterator.Row) -> wire dict."""
+    return {
+        "doc_key": doc_key_to_wire(row.doc_key),
+        "columns": {int(cid): v for cid, v in row.columns.items()},
+        "write_ht": row.write_ht.value,
+    }
+
+
+def row_from_wire(w: Optional[dict]):
+    if w is None:
+        return None
+    from yugabyte_tpu.common.hybrid_time import HybridTime
+    from yugabyte_tpu.docdb.doc_rowwise_iterator import Row
+    return Row(doc_key=doc_key_from_wire(w["doc_key"]),
+               columns={int(c): v for c, v in w["columns"].items()},
+               write_ht=HybridTime(w["write_ht"]))

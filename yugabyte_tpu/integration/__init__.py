@@ -1,0 +1,3 @@
+from yugabyte_tpu.integration.mini_cluster import MiniCluster, MiniClusterOptions
+
+__all__ = ["MiniCluster", "MiniClusterOptions"]

@@ -1,0 +1,9 @@
+"""Master: cluster catalog + tablet placement + tserver liveness.
+
+Capability parity with src/yb/master (ref: master.h:69, catalog_manager.h:141,
+sys_catalog.h:77-95, cluster_balance.cc).
+"""
+
+from yugabyte_tpu.master.master import Master, MasterOptions
+
+__all__ = ["Master", "MasterOptions"]

@@ -1,0 +1,341 @@
+"""CatalogManager + TSManager: DDL, tablet placement, tserver liveness.
+
+Capability parity with the reference (ref: src/yb/master/catalog_manager.h:141
+— namespace/table/tablet lifecycle; ts_manager.h — TSDescriptor registry from
+heartbeats; catalog_loaders.cc — in-memory state rebuilt from the sys catalog
+on master failover; catalog_manager_bg_tasks.cc — background reconciliation
+re-sending unacknowledged tablet-creation work).
+
+All durable state lives in the SysCatalog; everything here is a cache keyed
+off it, rebuilt by `ensure_loaded()` whenever this master (re)gains
+sys-catalog leadership.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from yugabyte_tpu.common.partition import PartitionSchema
+from yugabyte_tpu.common.wire import (
+    partition_from_wire, partition_schema_from_wire, partition_to_wire)
+from yugabyte_tpu.master.sys_catalog import SysCatalog
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.status import Status, StatusError
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag("tserver_unresponsive_timeout_ms", 3000,
+                  "a tserver missing heartbeats this long is treated as dead "
+                  "(ref tserver_unresponsive_timeout_ms)")
+flags.define_flag("replication_factor", 3,
+                  "default table replication factor (ref replication_factor)")
+
+
+class TSDescriptor:
+    def __init__(self, server_id: str, addr: str):
+        self.server_id = server_id
+        self.addr = addr
+        self.last_heartbeat = time.monotonic()
+        self.num_tablets = 0
+        self.reported_tablets: Set[str] = set()
+
+    def alive(self) -> bool:
+        timeout = flags.get_flag("tserver_unresponsive_timeout_ms") / 1000.0
+        return time.monotonic() - self.last_heartbeat < timeout
+
+
+class TSManager:
+    """ref src/yb/master/ts_manager.h"""
+
+    def __init__(self):
+        self._descs: Dict[str, TSDescriptor] = {}
+        self._lock = threading.Lock()
+
+    def heartbeat(self, server_id: str, addr: str,
+                  report: List[dict]) -> TSDescriptor:
+        with self._lock:
+            desc = self._descs.get(server_id)
+            if desc is None or desc.addr != addr:
+                desc = TSDescriptor(server_id, addr)
+                self._descs[server_id] = desc
+            desc.last_heartbeat = time.monotonic()
+            desc.num_tablets = len(report)
+            desc.reported_tablets = {t["tablet_id"] for t in report}
+            return desc
+
+    def live_descriptors(self) -> List[TSDescriptor]:
+        with self._lock:
+            return [d for d in self._descs.values() if d.alive()]
+
+    def all_descriptors(self) -> List[TSDescriptor]:
+        with self._lock:
+            return list(self._descs.values())
+
+    def addr_map(self) -> Dict[str, str]:
+        with self._lock:
+            return {sid: d.addr for sid, d in self._descs.items()}
+
+    def get(self, server_id: str) -> Optional[TSDescriptor]:
+        with self._lock:
+            return self._descs.get(server_id)
+
+
+class CatalogManager:
+    def __init__(self, sys_catalog: SysCatalog, messenger):
+        self.sys = sys_catalog
+        self.messenger = messenger
+        self.ts_manager = TSManager()
+        self._lock = threading.RLock()
+        self._loaded_term = -1
+        self.namespaces: Dict[str, dict] = {}
+        self.tables: Dict[str, dict] = {}
+        self.tablets: Dict[str, dict] = {}
+        # volatile: tablet_id -> (leader server_id, term); replica acks
+        self.tablet_leaders: Dict[str, Tuple[str, int]] = {}
+        self._confirmed: Set[Tuple[str, str]] = set()  # (tablet_id, server)
+
+    # ------------------------------------------------------------ leadership
+    def is_leader(self) -> bool:
+        return (self.sys.peer.raft.is_leader()
+                and self.sys.peer.raft.leader_ready())
+
+    def ensure_loaded(self) -> None:
+        """Rebuild caches from the sys catalog after (re)gaining leadership
+        (ref catalog_loaders.cc)."""
+        term = self.sys.peer.raft.current_term
+        with self._lock:
+            if self._loaded_term == term:
+                return
+            namespaces: Dict[str, dict] = {}
+            tables: Dict[str, dict] = {}
+            tablets: Dict[str, dict] = {}
+            for etype, eid, meta in self.sys.scan_all():
+                if etype == "namespace":
+                    namespaces[eid] = meta
+                elif etype == "table":
+                    tables[eid] = meta
+                elif etype == "tablet":
+                    tablets[eid] = meta
+            self.namespaces = namespaces
+            self.tables = tables
+            self.tablets = tablets
+            self._confirmed.clear()
+            self._loaded_term = term
+            TRACE("catalog loaded at term %d: %d namespaces, %d tables, "
+                  "%d tablets", term, len(namespaces), len(tables),
+                  len(tablets))
+
+    # ------------------------------------------------------------------- DDL
+    def create_namespace(self, name: str) -> None:
+        with self._lock:
+            if name in self.namespaces:
+                raise StatusError(Status.AlreadyPresent(
+                    f"namespace {name!r} exists"))
+            meta = {"name": name}
+            self.sys.upsert("namespace", name, meta)
+            self.namespaces[name] = meta
+
+    def _find_table(self, namespace: str, name: str) -> Optional[str]:
+        for tid, t in self.tables.items():
+            if t["namespace"] == namespace and t["name"] == name:
+                return tid
+        return None
+
+    def create_table(self, namespace: str, name: str, schema_wire: dict,
+                     partition_schema_wire: dict, num_tablets: int,
+                     replication_factor: Optional[int] = None) -> dict:
+        rf = replication_factor or flags.get_flag("replication_factor")
+        with self._lock:
+            if namespace not in self.namespaces:
+                raise StatusError(Status.NotFound(
+                    f"namespace {namespace!r} not found"))
+            if self._find_table(namespace, name) is not None:
+                raise StatusError(Status.AlreadyPresent(
+                    f"table {namespace}.{name} exists"))
+            live = self.ts_manager.live_descriptors()
+            if len(live) < rf:
+                raise StatusError(Status.ServiceUnavailable(
+                    f"need {rf} live tservers for RF={rf}, have {len(live)}"))
+            table_id = uuid.uuid4().hex[:16]
+            ps = partition_schema_from_wire(partition_schema_wire)
+            partitions = ps.create_partitions(num_tablets)
+            tablet_metas: List[dict] = []
+            for i, part in enumerate(partitions):
+                tablet_id = f"{table_id}.t{i:04d}"
+                # Reuse the snapshot validated above — re-listing here could
+                # see fewer than rf live tservers (TOCTOU).
+                replicas = self._pick_replicas(live, rf, seed_index=i)
+                tablet_metas.append({
+                    "tablet_id": tablet_id, "table_id": table_id,
+                    "partition": partition_to_wire(part),
+                    "replicas": replicas})
+            table_meta = {
+                "table_id": table_id, "name": name, "namespace": namespace,
+                "schema": schema_wire,
+                "partition_schema": partition_schema_wire,
+                "tablet_ids": [t["tablet_id"] for t in tablet_metas]}
+            # Persist FIRST so a crash never leaves orphan replicas the
+            # heartbeat cleanup would misread as live state (see
+            # tablets_to_delete below); replica creation is re-driven by the
+            # reconciler until every ack lands.
+            self.sys.upsert("table", table_id, table_meta)
+            for tm in tablet_metas:
+                self.sys.upsert("tablet", tm["tablet_id"], tm)
+            self.tables[table_id] = table_meta
+            for tm in tablet_metas:
+                self.tablets[tm["tablet_id"]] = tm
+        self.reconcile_tablets()
+        return table_meta
+
+    def _pick_replicas(self, live: List[TSDescriptor], rf: int,
+                       seed_index: int) -> List[str]:
+        """Least-loaded placement over live tservers (ref
+        CatalogManager::SelectReplicasForTablet round-robin by load)."""
+        live = sorted(live, key=lambda d: (d.num_tablets, d.server_id))
+        picked = [live[(seed_index + j) % len(live)] for j in range(rf)]
+        # rotation can alias on small clusters; dedup preserving order
+        seen, out = set(), []
+        for d in picked:
+            if d.server_id not in seen:
+                seen.add(d.server_id)
+                out.append(d)
+        for d in live:
+            if len(out) >= rf:
+                break
+            if d.server_id not in seen:
+                seen.add(d.server_id)
+                out.append(d)
+        for d in out:
+            d.num_tablets += 1  # keeps subsequent picks spreading
+        return [d.server_id for d in out]
+
+    def delete_table(self, namespace: str, name: str) -> None:
+        with self._lock:
+            table_id = self._find_table(namespace, name)
+            if table_id is None:
+                raise StatusError(Status.NotFound(
+                    f"table {namespace}.{name} not found"))
+            meta = self.tables[table_id]
+            for tablet_id in meta["tablet_ids"]:
+                self.sys.delete("tablet", tablet_id)
+                self.tablets.pop(tablet_id, None)
+                self.tablet_leaders.pop(tablet_id, None)
+            self.sys.delete("table", table_id)
+            self.tables.pop(table_id, None)
+        # Actual replica teardown rides the next heartbeat response
+        # (tablets_to_delete), mirroring the reference's deferred deletes.
+
+    # --------------------------------------------------------------- lookups
+    def get_table(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            table_id = self._find_table(namespace, name)
+            if table_id is None:
+                raise StatusError(Status.NotFound(
+                    f"table {namespace}.{name} not found"))
+            return dict(self.tables[table_id])
+
+    def list_tables(self, namespace: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            return [dict(t) for t in self.tables.values()
+                    if namespace is None or t["namespace"] == namespace]
+
+    def get_table_locations(self, table_id: str) -> List[dict]:
+        addr_map = self.ts_manager.addr_map()
+        with self._lock:
+            table = self.tables.get(table_id)
+            if table is None:
+                raise StatusError(Status.NotFound(f"table {table_id}"))
+            out = []
+            for tablet_id in table["tablet_ids"]:
+                tm = self.tablets[tablet_id]
+                leader = self.tablet_leaders.get(tablet_id)
+                out.append({
+                    "tablet_id": tablet_id,
+                    "partition": tm["partition"],
+                    "replicas": [{"server_id": s,
+                                  "addr": addr_map.get(s)}
+                                 for s in tm["replicas"]],
+                    "leader": leader[0] if leader else None})
+            out.sort(key=lambda t: t["partition"]["start"])
+            return out
+
+    # ------------------------------------------------------------ heartbeats
+    def process_heartbeat(self, server_id: str, addr: str,
+                          report: List[dict]) -> dict:
+        desc = self.ts_manager.heartbeat(server_id, addr, report)
+        to_delete = []
+        reported_ids = {t["tablet_id"] for t in report}
+        with self._lock:
+            # Confirmation tracks what the tserver REPORTS, not what was
+            # ever acked: a wiped/re-provisioned tserver stops reporting a
+            # tablet and the reconciler must re-drive its creation.
+            self._confirmed = {(tid, sid) for (tid, sid) in self._confirmed
+                               if sid != server_id or tid in reported_ids}
+            for t in report:
+                tablet_id = t["tablet_id"]
+                if tablet_id not in self.tablets:
+                    # Not in the catalog => table dropped (or orphan of a
+                    # failed create persisted-first): tear it down.
+                    to_delete.append(tablet_id)
+                    continue
+                self._confirmed.add((tablet_id, server_id))
+                if t["role"] == "leader" and t.get("leader_ready"):
+                    cur = self.tablet_leaders.get(tablet_id)
+                    if cur is None or t["term"] >= cur[1]:
+                        self.tablet_leaders[tablet_id] = (server_id,
+                                                          t["term"])
+        return {
+            "addr_map": self.ts_manager.addr_map(),
+            "tablets_to_delete": to_delete,
+        }
+
+    # -------------------------------------------------------- reconciliation
+    def reconcile_tablets(self) -> int:
+        """Issue (idempotent) create_tablet RPCs for replicas that have not
+        yet reported the tablet (ref catalog_manager_bg_tasks.cc resending
+        unacked CreateTablet work). Returns RPCs issued."""
+        addr_map = self.ts_manager.addr_map()
+        with self._lock:
+            work = []
+            for tablet_id, tm in self.tablets.items():
+                table = self.tables.get(tm["table_id"])
+                if table is None:
+                    continue
+                for server_id in tm["replicas"]:
+                    if (tablet_id, server_id) in self._confirmed:
+                        continue
+                    work.append((tablet_id, tm, table, server_id))
+        issued = [0]
+        lock = threading.Lock()
+
+        def send(tablet_id, tm, table, server_id, addr):
+            try:
+                self.messenger.call(
+                    addr, "tserver", "create_tablet", timeout_s=5.0,
+                    tablet_id=tablet_id, table_id=tm["table_id"],
+                    schema=table["schema"],
+                    peer_server_ids=tm["replicas"],
+                    partition=tm["partition"], addr_map=addr_map)
+                with lock:
+                    issued[0] += 1
+            except StatusError as e:
+                TRACE("reconcile: create %s on %s failed: %s",
+                      tablet_id, server_id, e)
+
+        # Parallel fan-out: one blackholed tserver must not head-of-line
+        # block creation on healthy ones (acks arrive via heartbeats, so a
+        # straggler thread finishing late is harmless and idempotent).
+        threads = []
+        for tablet_id, tm, table, server_id in work:
+            addr = addr_map.get(server_id)
+            if addr is None:
+                continue
+            t = threading.Thread(target=send, daemon=True,
+                                 args=(tablet_id, tm, table, server_id, addr))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=6.0)
+        return issued[0]

@@ -1,0 +1,176 @@
+"""Master process object + its RPC service.
+
+Capability parity with the reference (ref: src/yb/master/master.h:69 — owns
+Messenger, SysCatalog, CatalogManager; master_service.cc dispatching DDL,
+heartbeat and location RPCs; multiple masters form one Raft group over the
+sys catalog tablet, and every non-leader master redirects with a leader
+hint exactly like tservers do for tablets).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from yugabyte_tpu.common.hybrid_time import HybridClock
+from yugabyte_tpu.master.catalog_manager import CatalogManager
+from yugabyte_tpu.master.sys_catalog import SysCatalog
+from yugabyte_tpu.rpc.consensus_service import RpcTransport
+from yugabyte_tpu.rpc.messenger import Messenger
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.status import Code, Status, StatusError
+
+flags.define_flag("catalog_reconcile_interval_ms", 500,
+                  "master background loop period for re-driving unacked "
+                  "tablet creation (ref catalog_manager_bg_task_wait_ms)")
+
+MASTER_SERVICE = "master"
+
+
+class MasterNotLeaderError(StatusError):
+    def __init__(self, leader_hint: Optional[str]):
+        super().__init__(Status(Code.ILLEGAL_STATE, "master is not leader"))
+        self.extra = {"not_leader": True, "leader_hint": leader_hint}
+
+
+@dataclass
+class MasterOptions:
+    master_id: str
+    fs_root: str
+    bind_host: str = "127.0.0.1"
+    port: int = 0
+    # multi-master: all master ids incl. self (single-master by default)
+    master_ids: List[str] = field(default_factory=list)
+
+
+class MasterService:
+    """Wire-facing handlers; every mutating/reading call goes through a
+    leader check + catalog load (ref master_service.cc leader guards)."""
+
+    def __init__(self, master: "Master"):
+        self._master = master
+
+    def _leader_catalog(self) -> CatalogManager:
+        return self._master.leader_catalog()
+
+    # ----------------------------------------------------------- heartbeats
+    def heartbeat(self, server_id: str, server_addr: str,
+                  tablet_report: List[dict]) -> dict:
+        return self._leader_catalog().process_heartbeat(
+            server_id, server_addr, tablet_report)
+
+    # ------------------------------------------------------------------ DDL
+    def create_namespace(self, name: str) -> bool:
+        self._leader_catalog().create_namespace(name)
+        return True
+
+    def create_table(self, namespace: str, name: str, schema: dict,
+                     partition_schema: dict, num_tablets: int,
+                     replication_factor: Optional[int] = None) -> dict:
+        return self._leader_catalog().create_table(
+            namespace, name, schema, partition_schema, num_tablets,
+            replication_factor)
+
+    def delete_table(self, namespace: str, name: str) -> bool:
+        self._leader_catalog().delete_table(namespace, name)
+        return True
+
+    # -------------------------------------------------------------- lookups
+    def get_table(self, namespace: str, name: str) -> dict:
+        return self._leader_catalog().get_table(namespace, name)
+
+    def list_tables(self, namespace: Optional[str] = None) -> List[dict]:
+        return self._leader_catalog().list_tables(namespace)
+
+    def get_table_locations(self, table_id: str) -> List[dict]:
+        return self._leader_catalog().get_table_locations(table_id)
+
+    def list_tservers(self) -> List[dict]:
+        cm = self._leader_catalog()
+        return [{"server_id": d.server_id, "addr": d.addr,
+                 "alive": d.alive(), "num_tablets": d.num_tablets}
+                for d in cm.ts_manager.all_descriptors()]
+
+
+class Master:
+    def __init__(self, opts: MasterOptions):
+        self.opts = opts
+        self.master_id = opts.master_id
+        os.makedirs(opts.fs_root, exist_ok=True)
+        self.clock = HybridClock()
+        self.messenger = Messenger(f"master-{opts.master_id}",
+                                   bind_host=opts.bind_host, port=opts.port)
+        self._master_addr_map: Dict[str, str] = {
+            opts.master_id: self.messenger.address}
+        self._addr_lock = threading.Lock()
+        self.transport = RpcTransport(self.messenger, self._resolve_peer)
+        master_ids = opts.master_ids or [opts.master_id]
+        self.sys_catalog = SysCatalog(
+            os.path.join(opts.fs_root, "sys_catalog"), opts.master_id,
+            master_ids, self.transport, clock=self.clock)
+        self.catalog = CatalogManager(self.sys_catalog, self.messenger)
+        self.service = MasterService(self)
+        self.messenger.register_service(MASTER_SERVICE, self.service)
+        self._stop = threading.Event()
+        self._bg_thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return self.messenger.address
+
+    def _resolve_peer(self, peer_id: str) -> Optional[str]:
+        master_id = peer_id.split("/", 1)[0]
+        with self._addr_lock:
+            return self._master_addr_map.get(master_id)
+
+    def set_master_addrs(self, addr_map: Dict[str, str]) -> None:
+        """Multi-master wiring: master_id -> host:port for all peers."""
+        with self._addr_lock:
+            self._master_addr_map.update(addr_map)
+
+    def leader_catalog(self) -> CatalogManager:
+        """Leader guard used by every service handler."""
+        if not self.catalog.is_leader():
+            hint = self.sys_catalog.peer.raft.leader_hint()
+            leader_addr = None
+            if hint:
+                leader_addr = self._resolve_peer(hint)
+            raise MasterNotLeaderError(leader_addr)
+        self.catalog.ensure_loaded()
+        return self.catalog
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "Master":
+        self.sys_catalog.start()
+        self._bg_thread = threading.Thread(
+            target=self._bg_loop, daemon=True,
+            name=f"master-bg-{self.master_id}")
+        self._bg_thread.start()
+        return self
+
+    def _bg_loop(self) -> None:
+        """ref catalog_manager_bg_tasks.cc"""
+        while not self._stop.wait(
+                flags.get_flag("catalog_reconcile_interval_ms") / 1000.0):
+            try:
+                if self.catalog.is_leader():
+                    self.catalog.ensure_loaded()
+                    self.catalog.reconcile_tablets()
+            except Exception:  # noqa: BLE001 — bg loop must survive
+                pass
+
+    def wait_until_leader(self, timeout_s: float = 15.0) -> bool:
+        import time
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.catalog.is_leader():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.sys_catalog.shutdown()
+        self.messenger.shutdown()

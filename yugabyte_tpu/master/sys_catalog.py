@@ -1,0 +1,77 @@
+"""SysCatalog: the master's own catalog table.
+
+Capability parity with the reference (ref: src/yb/master/sys_catalog.h:77-95
+— "the sys catalog is a single-tablet DocDB table replicated across all
+masters via Raft"). Entries are (entry_type, entry_id) -> JSON metadata,
+written through the exact same TabletPeer/WriteQuery/Raft/LSM stack user
+tablets use — master failover replays the sys catalog WAL like any tablet.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+from yugabyte_tpu.common.hybrid_time import HybridClock
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.tablet.tablet_peer import TabletPeer
+from yugabyte_tpu.utils import jsonutil
+
+SYS_CATALOG_TABLET_ID = "sys.catalog"
+
+SYS_SCHEMA = Schema(
+    columns=[
+        ColumnSchema("entry_type", DataType.STRING),
+        ColumnSchema("entry_id", DataType.STRING),
+        ColumnSchema("metadata", DataType.STRING),
+    ],
+    num_hash_key_columns=2)
+
+
+class SysCatalog:
+    """Typed wrapper over the sys catalog TabletPeer."""
+
+    def __init__(self, data_dir: str, master_id: str,
+                 master_ids, transport, clock: Optional[HybridClock] = None):
+        self.peer = TabletPeer(
+            SYS_CATALOG_TABLET_ID, data_dir, SYS_SCHEMA,
+            server_id=master_id, server_ids=list(master_ids),
+            transport=transport, clock=clock)
+
+    def start(self) -> "SysCatalog":
+        self.peer.start(election_timer=True)
+        return self
+
+    @staticmethod
+    def _key(entry_type: str, entry_id: str) -> DocKey:
+        return DocKey(hash_components=(entry_type, entry_id))
+
+    # ------------------------------------------------------------- mutations
+    def upsert(self, entry_type: str, entry_id: str, metadata: dict) -> None:
+        self.peer.write([QLWriteOp(
+            WriteOpKind.INSERT, self._key(entry_type, entry_id),
+            {"metadata": jsonutil.dumps(metadata, sort_keys=True)})])
+
+    def delete(self, entry_type: str, entry_id: str) -> None:
+        self.peer.write([QLWriteOp(
+            WriteOpKind.DELETE_ROW, self._key(entry_type, entry_id))])
+
+    # ----------------------------------------------------------------- reads
+    def get(self, entry_type: str, entry_id: str) -> Optional[dict]:
+        row = self.peer.tablet.read_row(self._key(entry_type, entry_id))
+        if row is None:
+            return None
+        return jsonutil.loads(
+            row.columns[SYS_SCHEMA.column_id("metadata")])
+
+    def scan_all(self) -> Iterator[Tuple[str, str, dict]]:
+        """(entry_type, entry_id, metadata) for every live entry — the
+        catalog-loader path on master failover (ref catalog_loaders.cc)."""
+        for row in self.peer.tablet.scan(use_device=False):
+            etype, eid = row.doc_key.hash_components
+            yield etype, eid, jsonutil.loads(
+                row.columns[SYS_SCHEMA.column_id("metadata")])
+
+    def shutdown(self) -> None:
+        self.peer.shutdown()

@@ -1,0 +1,9 @@
+"""Tablet server: hosts many replicated tablets, heartbeats to the master.
+
+Capability parity with src/yb/tserver (ref: tablet_server.h:71,
+ts_tablet_manager.h:126, tablet_service.cc, heartbeater.cc).
+"""
+
+from yugabyte_tpu.tserver.tablet_server import TabletServer, TabletServerOptions
+
+__all__ = ["TabletServer", "TabletServerOptions"]

@@ -1,0 +1,97 @@
+"""TabletServer: process object tying messenger, tablet manager, heartbeater.
+
+Capability parity with the reference bringup (ref: src/yb/tserver/
+tablet_server.h:71, tablet_server_main.cc:310 — Messenger + RpcServer start,
+TSTabletManager::Init reopening local tablets, Heartbeater::Start). One
+TabletServer per process in production; MiniCluster runs several in-process
+on loopback ports (ref integration-tests/mini_cluster.h).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from yugabyte_tpu.common.hybrid_time import HybridClock
+from yugabyte_tpu.rpc.consensus_service import RpcTransport
+from yugabyte_tpu.rpc.messenger import Messenger
+from yugabyte_tpu.tablet.tablet import TabletOptions
+from yugabyte_tpu.tserver.heartbeater import Heartbeater
+from yugabyte_tpu.tserver.tablet_service import TabletServiceImpl
+from yugabyte_tpu.tserver.ts_tablet_manager import TSTabletManager
+from yugabyte_tpu.utils.metrics import MetricRegistry
+
+TABLET_SERVICE = "tserver"
+
+
+@dataclass
+class TabletServerOptions:
+    server_id: str
+    fs_root: str
+    master_addrs: List[str] = field(default_factory=list)
+    bind_host: str = "127.0.0.1"
+    port: int = 0
+    tablet_options_factory: Optional[Callable[[], TabletOptions]] = None
+
+
+class TabletServer:
+    def __init__(self, opts: TabletServerOptions):
+        self.opts = opts
+        self.server_id = opts.server_id
+        os.makedirs(opts.fs_root, exist_ok=True)
+        self.clock = HybridClock()
+        self.metrics = MetricRegistry()
+        self.messenger = Messenger(f"ts-{opts.server_id}",
+                                   bind_host=opts.bind_host, port=opts.port)
+        # server_id -> host:port map for consensus peer resolution; seeded
+        # with ourselves, refreshed by every heartbeat response.
+        self._addr_map: Dict[str, str] = {opts.server_id: self.address}
+        self._addr_lock = threading.Lock()
+        self.transport = RpcTransport(self.messenger, self._resolve_peer)
+        self.tablet_manager = TSTabletManager(
+            opts.server_id, opts.fs_root, self.transport, clock=self.clock,
+            tablet_options_factory=opts.tablet_options_factory,
+            metrics=self.metrics)
+        self.service = TabletServiceImpl(self.tablet_manager,
+                                         addr_updater=self.update_addr_map)
+        self.messenger.register_service(TABLET_SERVICE, self.service)
+        self.heartbeater = Heartbeater(
+            self.messenger, opts.master_addrs, opts.server_id, self.address,
+            report_provider=self.tablet_manager.generate_report,
+            on_response=self._handle_heartbeat_response)
+
+    @property
+    def address(self) -> str:
+        return self.messenger.address
+
+    def _resolve_peer(self, peer_id: str) -> Optional[str]:
+        server_id = peer_id.split("/", 1)[0]
+        with self._addr_lock:
+            return self._addr_map.get(server_id)
+
+    def _handle_heartbeat_response(self, resp: dict) -> None:
+        with self._addr_lock:
+            self._addr_map.update(resp.get("addr_map") or {})
+        for tablet_id in resp.get("tablets_to_delete") or []:
+            self.tablet_manager.delete_tablet(tablet_id)
+
+    def update_addr_map(self, addr_map: Dict[str, str]) -> None:
+        with self._addr_lock:
+            self._addr_map.update(addr_map)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "TabletServer":
+        self.tablet_manager.open_existing()
+        if self.opts.master_addrs:
+            # Register before serving so the master knows our address by the
+            # time it places tablets here.
+            self.heartbeater.heartbeat_now()
+            self.heartbeater.start()
+        return self
+
+    def shutdown(self) -> None:
+        self.heartbeater.stop()
+        self.tablet_manager.shutdown()
+        self.messenger.shutdown()

@@ -1,0 +1,134 @@
+"""TabletServiceImpl: the RPC surface of one tablet server.
+
+Capability parity with the reference (ref: src/yb/tserver/tablet_service.cc —
+Write :1491, Read :1612, leader lookup + NOT_THE_LEADER error with hint; admin
+ops CreateTablet/DeleteTablet live in TabletServerAdminService, merged here).
+NotLeader errors carry the leader hint in the RPC error `extra` payload the
+way the reference embeds TabletServerErrorPB::NOT_THE_LEADER + leader host.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from yugabyte_tpu.common.hybrid_time import HybridTime
+from yugabyte_tpu.common.wire import (
+    doc_key_from_wire, row_to_wire, write_op_from_wire)
+from yugabyte_tpu.consensus.raft import NotLeader, OperationOutcomeUnknown
+from yugabyte_tpu.tserver.ts_tablet_manager import TSTabletManager
+from yugabyte_tpu.utils.status import Code, Status, StatusError
+
+
+class NotLeaderError(StatusError):
+    def __init__(self, leader_hint: Optional[str]):
+        super().__init__(Status(Code.ILLEGAL_STATE, "not the leader"))
+        self.extra = {"not_leader": True, "leader_hint": leader_hint}
+
+
+def _leader_server_hint(e: NotLeader) -> Optional[str]:
+    """Raft leader hints are peer addresses '<server>/<tablet>'."""
+    if e.leader_hint is None:
+        return None
+    return e.leader_hint.split("/", 1)[0]
+
+
+class TabletServiceImpl:
+    def __init__(self, tablet_manager: TSTabletManager, addr_updater=None):
+        self._tablets = tablet_manager
+        self._addr_updater = addr_updater or (lambda m: None)
+
+    # ---------------------------------------------------------------- writes
+    def write(self, tablet_id: str, ops: List[dict],
+              timeout_s: float = 15.0) -> dict:
+        peer = self._tablets.get_tablet(tablet_id)
+        decoded = [write_op_from_wire(w) for w in ops]
+        try:
+            ht = peer.write(decoded, timeout_s=timeout_s)
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
+        except OperationOutcomeUnknown as e:
+            raise StatusError(Status.TimedOut(str(e))) from e
+        return {"propagated_ht": ht.value}
+
+    # ----------------------------------------------------------------- reads
+    def read_row(self, tablet_id: str, doc_key: dict,
+                 read_ht: Optional[int] = None,
+                 projection: Optional[List[str]] = None,
+                 allow_follower: bool = False) -> Optional[dict]:
+        peer = self._tablets.get_tablet(tablet_id)
+        try:
+            row = peer.read_row(
+                doc_key_from_wire(doc_key),
+                HybridTime(read_ht) if read_ht else None,
+                projection=tuple(projection) if projection else None,
+                allow_follower=allow_follower)
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
+        return None if row is None else row_to_wire(row)
+
+    def scan(self, tablet_id: str, lower_doc_key: bytes = b"",
+             upper_doc_key: Optional[bytes] = None,
+             read_ht: Optional[int] = None,
+             projection: Optional[List[str]] = None,
+             limit: int = 10_000) -> dict:
+        """Bounded range scan; returns rows + a resume key when `limit` is
+        hit (the reference pages exactly this way, ref
+        pgsql_operation.cc:1040 paging state)."""
+        peer = self._tablets.get_tablet(tablet_id)
+        if not peer.raft.is_leader():
+            raise NotLeaderError(_leader_server_hint(
+                NotLeader(peer.raft.leader_hint())))
+        try:
+            peer.check_leader_lease()
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
+        # Pin the snapshot: resolve the read point ONCE and return it so the
+        # client re-sends it for later pages and other tablets — otherwise a
+        # multi-page scan is torn across concurrent writes (the reference
+        # pins used_read_time in the paging state).
+        ht = peer.tablet.read_time(HybridTime(read_ht) if read_ht else None)
+        it = peer.tablet.scan(
+            ht, lower_doc_key=lower_doc_key, upper_doc_key=upper_doc_key,
+            projection=tuple(projection) if projection else None,
+            use_device=False)
+        rows = []
+        resume_key = None
+        for row in it:
+            rows.append(row_to_wire(row))
+            if len(rows) >= limit:
+                resume_key = row.doc_key.encode() + b"\xff"
+                break
+        return {"rows": rows, "resume_key": resume_key, "read_ht": ht.value}
+
+    # ----------------------------------------------------------- admin + ops
+    def create_tablet(self, tablet_id: str, table_id: str, schema: dict,
+                      peer_server_ids: List[str],
+                      partition: Optional[dict] = None,
+                      addr_map: Optional[dict] = None) -> bool:
+        # The master ships the current address map with the request so the
+        # new replica can reach its consensus peers before the first
+        # heartbeat response refreshes it.
+        if addr_map:
+            self._addr_updater(addr_map)
+        self._tablets.create_tablet(tablet_id, table_id, schema,
+                                    peer_server_ids, partition)
+        return True
+
+    def delete_tablet(self, tablet_id: str) -> bool:
+        self._tablets.delete_tablet(tablet_id)
+        return True
+
+    def flush_tablet(self, tablet_id: str) -> bool:
+        self._tablets.get_tablet(tablet_id).tablet.flush()
+        return True
+
+    def compact_tablet(self, tablet_id: str) -> bool:
+        self._tablets.get_tablet(tablet_id).tablet.compact()
+        return True
+
+    def list_tablets(self) -> List[str]:
+        return self._tablets.tablet_ids()
+
+    def status(self) -> dict:
+        return {"server_id": self._tablets.server_id,
+                "tablets": self._tablets.generate_report()}
