@@ -1,0 +1,118 @@
+"""Remote bootstrap + membership change + load-balancer repair
+(ref: integration-tests/remote_bootstrap-itest, ts_tablet_manager-itest;
+cluster_balance.cc behavior)."""
+
+import time
+
+import pytest
+
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING)],
+    num_hash_key_columns=1)
+
+
+def dk(k: str) -> DocKey:
+    return DocKey(hash_components=(k,))
+
+
+def wait_for(cond, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timeout: {msg}"
+        time.sleep(0.05)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    flags.set_flag("replication_factor", 3)
+    flags.set_flag("load_balancer_dead_grace_ms", 1200)
+    flags.set_flag("tserver_unresponsive_timeout_ms", 1500)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path / "cluster"))).start()
+    yield c
+    flags.reset_flag("load_balancer_dead_grace_ms")
+    flags.reset_flag("tserver_unresponsive_timeout_ms")
+    c.shutdown()
+
+
+def test_manual_remote_bootstrap_and_config_change(cluster):
+    client = cluster.new_client()
+    client.create_namespace("db")
+    table = client.create_table("db", "t", SCHEMA, num_tablets=1)
+    cluster.wait_all_replicas_running(table.table_id)
+    for i in range(30):
+        client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i}"),
+                                       {"v": f"v{i}"})])
+    # flush so the snapshot carries SSTs, not just WAL
+    tablet = client.meta_cache.tablets(table.table_id)[0]
+    leader_ts = next(ts for ts in cluster.tservers
+                     if ts.server_id == tablet.leader)
+    leader_ts.tablet_manager.get_tablet(tablet.tablet_id).tablet.flush()
+
+    ts3 = cluster.add_tablet_server()
+    wait_for(lambda: any(t["server_id"] == "ts3"
+                         for t in client.list_tservers()), msg="ts3 joins")
+    m = cluster.masters[0].messenger
+    m.call(ts3.address, "tserver", "start_remote_bootstrap",
+           tablet_id=tablet.tablet_id, source_addr=leader_ts.address)
+    assert tablet.tablet_id in ts3.tablet_manager.tablet_ids()
+    # Snapshot data landed in the new replica's LSM (reads via MVCC need
+    # leader contact, which only starts once it joins the config below).
+    peer3 = ts3.tablet_manager.get_tablet(tablet.tablet_id)
+    assert sum(1 for _ in peer3.tablet.regular_db.iter_from(b"")) > 0
+    # Promote to voter, then drop one old replica => still RF3.
+    m.call(leader_ts.address, "tserver", "change_config",
+           tablet_id=tablet.tablet_id, add=["ts3"])
+    victim = next(r.server_id for r in tablet.replicas
+                  if r.server_id != tablet.leader)
+    m.call(leader_ts.address, "tserver", "change_config",
+           tablet_id=tablet.tablet_id, remove=[victim])
+    cfg = leader_ts.tablet_manager.get_tablet(
+        tablet.tablet_id).raft.config.peer_ids
+    servers = sorted(p.split("/", 1)[0] for p in cfg)
+    assert "ts3" in servers and victim not in servers and len(servers) == 3
+    # New voter participates: writes still commit and reach ts3.
+    client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk("after-move"),
+                                   {"v": "yes"})])
+    wait_for(lambda: peer3.tablet.read_row(dk("after-move")) is not None,
+             msg="replicated to ts3")
+
+
+def test_load_balancer_repairs_dead_tserver(cluster):
+    client = cluster.new_client()
+    client.create_namespace("db2")
+    table = client.create_table("db2", "t", SCHEMA, num_tablets=2)
+    cluster.wait_all_replicas_running(table.table_id)
+    for i in range(20):
+        client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i}"),
+                                       {"v": f"v{i}"})])
+    # Spare server for the balancer to move onto.
+    cluster.add_tablet_server()
+    wait_for(lambda: any(t["server_id"] == "ts3"
+                         for t in client.list_tservers()), msg="ts3 joins")
+    victim = cluster.tservers[0]
+    victim_id = victim.server_id
+    victim.shutdown()
+
+    def repaired():
+        locs = cluster.leader_master().catalog.get_table_locations(
+            table.table_id)
+        return all(victim_id not in [r["server_id"] for r in l["replicas"]]
+                   and len(l["replicas"]) == 3
+                   for l in locs)
+
+    wait_for(repaired, timeout=60, msg="balancer replaces dead replicas")
+    # Data still fully readable after the move.
+    for i in range(20):
+        row = client.read_row(table, dk(f"k{i}"))
+        assert row is not None and \
+            row.columns[SCHEMA.column_id("v")] == f"v{i}"

@@ -1,0 +1,120 @@
+"""Automatic tablet splitting through the full stack (ref:
+integration-tests/tablet-split-itest.cc; master tablet_split_manager.cc;
+tablet/operations/split_operation.cc)."""
+
+import time
+
+import pytest
+
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING)],
+    num_hash_key_columns=1)
+
+
+def dk(k: str) -> DocKey:
+    return DocKey(hash_components=(k,))
+
+
+def wait_for(cond, timeout=40, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timeout: {msg}"
+        time.sleep(0.05)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    flags.set_flag("replication_factor", 3)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path / "cluster"))).start()
+    yield c
+    c.shutdown()
+
+
+N_ROWS = 80
+
+
+def test_split_end_to_end(cluster):
+    client = cluster.new_client()
+    client.create_namespace("db")
+    table = client.create_table("db", "t", SCHEMA, num_tablets=1)
+    cluster.wait_all_replicas_running(table.table_id)
+    for i in range(N_ROWS):
+        client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i:03d}"),
+                                       {"v": f"v{i}"})])
+    parent = client.meta_cache.tablets(table.table_id)[0]
+    master = cluster.leader_master()
+    children = master.catalog.split_tablet(parent.tablet_id)
+    assert len(children) == 2
+
+    # Master adopts the children and retires the parent.
+    def split_settled():
+        locs = master.catalog.get_table_locations(table.table_id)
+        ids = [l["tablet_id"] for l in locs]
+        return (sorted(ids) == sorted(children)
+                and all(l["leader"] for l in locs)
+                and parent.tablet_id not in master.catalog.tablets)
+
+    wait_for(split_settled, msg="children adopted + parent retired")
+
+    # Children partitions tile the parent's range.
+    locs = master.catalog.get_table_locations(table.table_id)
+    assert locs[0]["partition"]["start"] == b""
+    assert locs[0]["partition"]["end"] == locs[1]["partition"]["start"]
+    assert locs[1]["partition"]["end"] == b""
+
+    # Every row readable after the split (routing through children).
+    client.meta_cache.invalidate(table.table_id)
+    for i in range(N_ROWS):
+        row = client.read_row(table, dk(f"k{i:03d}"))
+        assert row is not None, f"k{i:03d} lost by split"
+        assert row.columns[SCHEMA.column_id("v")] == f"v{i}"
+
+    # Scans see each row exactly once (bounds clamp the shared files).
+    rows = list(client.scan(table, page_size=16))
+    keys = sorted(r.doc_key.hash_components[0] for r in rows)
+    assert keys == sorted(f"k{i:03d}" for i in range(N_ROWS))
+
+    # Writes keep working, now routed to the children.
+    for i in range(N_ROWS, N_ROWS + 10):
+        client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i:03d}"),
+                                       {"v": f"v{i}"})])
+    rows = list(client.scan(table, page_size=64))
+    assert len(rows) == N_ROWS + 10
+
+    # Parent replicas are torn down on the tservers.
+    def parent_gone():
+        return all(parent.tablet_id not in ts.tablet_manager.tablet_ids()
+                   for ts in cluster.tservers)
+    wait_for(parent_gone, msg="parent replicas deleted")
+
+
+def test_write_during_split_is_rerouted(cluster):
+    client = cluster.new_client()
+    client.create_namespace("db2")
+    table = client.create_table("db2", "t", SCHEMA, num_tablets=1)
+    cluster.wait_all_replicas_running(table.table_id)
+    session_keys = [f"a{i:03d}" for i in range(40)]
+    for k in session_keys:
+        client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(k),
+                                       {"v": "pre"})])
+    parent = client.meta_cache.tablets(table.table_id)[0]
+    cluster.leader_master().catalog.split_tablet(parent.tablet_id)
+    # Immediately write through the STALE meta cache: the client must chase
+    # the split (regroup by child) without surfacing an error.
+    for k in session_keys:
+        client.write(table, [QLWriteOp(WriteOpKind.UPDATE, dk(k),
+                                       {"v": "post"})])
+    for k in session_keys:
+        row = client.read_row(table, dk(k))
+        assert row is not None and \
+            row.columns[SCHEMA.column_id("v")] == "post"

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import struct
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from yugabyte_tpu.common.hybrid_time import HybridTime
 from yugabyte_tpu.common.partition import PartitionSchema
@@ -151,9 +151,13 @@ class YBClient:
 
     # ------------------------------------------------------- tablet-side ops
     def _tablet_call(self, table: YBTable, tablet: RemoteTablet, mth: str,
-                     **args):
+                     refresh_key: Optional[bytes] = None, **args):
         """Call a tablet's leader, retrying through replicas and refreshing
-        locations on failure (ref batcher.cc + meta_cache.cc retry logic)."""
+        locations on failure (ref batcher.cc + meta_cache.cc retry logic).
+        Split markers propagate up immediately — the caller must re-route
+        by key (a split parent's replacement differs per key)."""
+        if refresh_key is None:
+            refresh_key = tablet.partition.start
         last_err: Optional[Exception] = None
         for attempt in range(flags.get_flag("client_rpc_retries")):
             for addr in tablet.candidate_addrs():
@@ -162,6 +166,9 @@ class YBClient:
                         addr, TABLET_SERVICE, mth,
                         tablet_id=tablet.tablet_id, **args)
                 except RemoteError as e:
+                    if e.extra.get("tablet_split") or \
+                            e.extra.get("wrong_tablet"):
+                        raise
                     if e.extra.get("not_leader"):
                         hint = e.extra.get("leader_hint")
                         if hint:
@@ -179,21 +186,44 @@ class YBClient:
             # All replicas failed: refresh locations and back off.
             time.sleep(min(0.05 * (2 ** attempt), 1.0))
             tablet = self.meta_cache.lookup_tablet(
-                table.table_id, tablet.partition.start, refresh=True)
+                table.table_id, refresh_key, refresh=True)
         raise StatusError(Status.ServiceUnavailable(
             f"{mth} on tablet {tablet.tablet_id} exhausted retries "
             f"(last: {last_err})"))
 
     def write(self, table: YBTable, ops: Sequence[QLWriteOp],
-              tablet: Optional[RemoteTablet] = None) -> HybridTime:
+              tablet: Optional[RemoteTablet] = None,
+              _depth: int = 0) -> HybridTime:
         """Write a batch that must all land in ONE tablet (the session
-        batcher groups ops per tablet before calling this)."""
+        batcher groups ops per tablet before calling this). If the tablet
+        split underneath us, re-group the ops by key over the fresh
+        locations — the batch may now span both children."""
+        pk = table.partition_key_for(ops[0].doc_key)
         if tablet is None:
-            pk = table.partition_key_for(ops[0].doc_key)
             tablet = self.meta_cache.lookup_tablet(table.table_id, pk)
-        resp = self._tablet_call(table, tablet, "write",
-                                 ops=[write_op_to_wire(op) for op in ops])
-        return HybridTime(resp["propagated_ht"])
+        try:
+            resp = self._tablet_call(
+                table, tablet, "write", refresh_key=pk,
+                ops=[write_op_to_wire(op) for op in ops])
+            return HybridTime(resp["propagated_ht"])
+        except RemoteError as e:
+            if not (e.extra.get("tablet_split")
+                    or e.extra.get("wrong_tablet")) or _depth >= 8:
+                raise
+        # Give the master a beat to adopt the children, then re-route.
+        time.sleep(0.15 * (_depth + 1))
+        self.meta_cache.invalidate(table.table_id)
+        groups: Dict[str, Tuple[RemoteTablet, List[QLWriteOp]]] = {}
+        for op in ops:
+            opk = table.partition_key_for(op.doc_key)
+            t = self.meta_cache.lookup_tablet(table.table_id, opk)
+            groups.setdefault(t.tablet_id, (t, []))[1].append(op)
+        ht = HybridTime(0)
+        for t, group in groups.values():
+            ht = max(ht, self.write(table, group, tablet=t,
+                                    _depth=_depth + 1),
+                     key=lambda h: h.value)
+        return ht
 
     def read_row(self, table: YBTable, doc_key: DocKey,
                  read_ht: Optional[HybridTime] = None,
@@ -201,7 +231,8 @@ class YBClient:
         pk = table.partition_key_for(doc_key)
         tablet = self.meta_cache.lookup_tablet(table.table_id, pk)
         w = self._tablet_call(
-            table, tablet, "read_row", doc_key=doc_key_to_wire(doc_key),
+            table, tablet, "read_row", refresh_key=pk,
+            doc_key=doc_key_to_wire(doc_key),
             read_ht=read_ht.value if read_ht else None,
             projection=list(projection) if projection else None)
         return row_from_wire(w)
@@ -209,26 +240,45 @@ class YBClient:
     def scan(self, table: YBTable, read_ht: Optional[HybridTime] = None,
              projection: Optional[Sequence[str]] = None,
              page_size: int = 4096):
-        """Full-table scan across all tablets in partition order, paging
-        within each tablet (ref pg_doc_op.h:399 fan-out + paging). The read
-        point the first page resolves is pinned for every later page and
-        tablet, so the whole scan is one consistent snapshot."""
+        """Full-table scan in partition-key order, paging within each
+        tablet (ref pg_doc_op.h:399 fan-out + paging). The read point the
+        first page resolves is pinned for every later page and tablet, so
+        the whole scan is one consistent snapshot. A partition-key cursor
+        + a global doc-key lower bound make the scan robust to tablets
+        splitting or moving mid-scan: doc keys order the same way as
+        partition keys, so re-looking up the cursor can never re-yield or
+        skip rows."""
         pinned = read_ht.value if read_ht else None
-        for tablet in self.meta_cache.tablets(table.table_id):
-            lower = b""
-            while True:
+        cursor = b""   # partition-key-space position
+        lower = b""    # doc-key-space resume bound (global, monotonic)
+        failures = 0
+        while True:
+            tablet = self.meta_cache.lookup_tablet(table.table_id, cursor)
+            try:
                 resp = self._tablet_call(
-                    table, tablet, "scan", lower_doc_key=lower,
-                    read_ht=pinned,
+                    table, tablet, "scan", refresh_key=cursor,
+                    lower_doc_key=lower, read_ht=pinned,
                     projection=list(projection) if projection else None,
                     limit=page_size)
-                if pinned is None:
-                    pinned = resp.get("read_ht")
-                for w in resp["rows"]:
-                    yield row_from_wire(w)
-                if not resp.get("resume_key"):
-                    break
+            except StatusError:
+                # Split/moved underneath the scan: re-route the cursor.
+                failures += 1
+                if failures > 8:
+                    raise
+                time.sleep(0.2)
+                self.meta_cache.invalidate(table.table_id)
+                continue
+            failures = 0
+            if pinned is None:
+                pinned = resp.get("read_ht")
+            for w in resp["rows"]:
+                yield row_from_wire(w)
+            if resp.get("resume_key"):
                 lower = resp["resume_key"]
+                continue
+            if not tablet.partition.end:
+                return
+            cursor = tablet.partition.end
 
     def close(self) -> None:
         if self._owns_messenger:

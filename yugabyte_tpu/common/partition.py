@@ -75,6 +75,22 @@ class PartitionSchema:
         return [Partition(s, e) for s, e in zip(starts, ends)]
 
 
+def doc_key_bounds(partition: Partition,
+                   hash_partitioning: bool) -> "tuple[bytes, bytes | None]":
+    """(lower_doc_key, upper_doc_key) clamping a tablet's scans to its
+    partition. Hash partition keys are the 2-byte hash bucket, which appears
+    in every encoded DocKey right after the kUInt16Hash tag byte, so the
+    bound prefixes are directly comparable to encoded keys (ref: the
+    reference derives the same bounds in Tablet::DocDbScanSpec)."""
+    if not hash_partitioning:
+        return partition.start, partition.end or None
+    from yugabyte_tpu.docdb.value_type import ValueType
+    tag = bytes([ValueType.kUInt16Hash])
+    lower = tag + partition.start if partition.start else b""
+    upper = tag + partition.end if partition.end else None
+    return lower, upper
+
+
 def partition_for_key(partitions: Sequence[Partition], partition_key: bytes) -> int:
     """Index of the partition containing partition_key (meta-cache lookup)."""
     lo, hi = 0, len(partitions) - 1

@@ -60,6 +60,7 @@ OP_SPLIT = 3
 OP_UPDATE_TXN = 4
 OP_SNAPSHOT = 5
 OP_TRUNCATE = 6
+OP_CHANGE_CONFIG = 7
 
 _MSG_HEADER = struct.Struct("<BQ")  # op_type, ht_value
 
@@ -87,6 +88,15 @@ class ReplicationTimedOut(Exception):
 class OperationOutcomeUnknown(Exception):
     """Surfaced to clients when a write timed out without a known fate
     (the reference returns a timeout status for the same situation)."""
+
+
+class ConfigChangeInProgress(Exception):
+    """A previous membership change has not committed yet."""
+
+
+class ConfigAlreadyApplied(Exception):
+    """The requested add/remove is already reflected in the active config
+    (idempotent retries hit this; callers treat it as success)."""
 
 
 class Role(enum.Enum):
@@ -157,6 +167,10 @@ class VoteResp:
 
 @dataclass
 class RaftConfig:
+    """ACTIVE config: `peer_ids` is mutated (under the consensus lock) by
+    membership changes (ref consensus/raft_consensus.cc ChangeConfig;
+    single-server-at-a-time rule avoids joint consensus)."""
+
     peer_id: str
     peer_ids: Tuple[str, ...]  # full voter set, including self
 
@@ -184,11 +198,17 @@ class _ConsensusMetadata:
         self.term = 0
         self.voted_for: Optional[str] = None
         self.committed_floor = 0
+        # Durable active config (ref ConsensusMetadata::active_config):
+        # None until the first membership change.
+        self.peer_ids: Optional[List[str]] = None
+        self.config_index = 0
         if os.path.exists(path):
             with open(path) as f:
                 d = json.load(f)
             self.term = d["term"]
             self.voted_for = d.get("voted_for")
+            self.peer_ids = d.get("peer_ids")
+            self.config_index = d.get("config_index", 0)
             # Legacy layout kept the floor inline; prefer the newer file.
             self.committed_floor = d.get("committed_floor", 0)
         if os.path.exists(self.floor_path):
@@ -202,7 +222,9 @@ class _ConsensusMetadata:
     def save(self) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            json.dump({"term": self.term, "voted_for": self.voted_for,
+                       "peer_ids": self.peer_ids,
+                       "config_index": self.config_index}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
@@ -227,6 +249,13 @@ class RaftConsensus:
                  clock=None,
                  seed: Optional[int] = None):
         self.config = config
+        self._initial_peer_ids = tuple(config.peer_ids)
+        # index -> peer_ids active FROM that log index (config history for
+        # truncation revert; index 0 = the bootstrap config)
+        self._config_history: Dict[int, Tuple[str, ...]] = {
+            0: tuple(config.peer_ids)}
+        self.on_config_change: Callable[[Tuple[str, ...]], None] = \
+            lambda ids: None
         self.log = log
         self.transport = transport
         self.apply_cb = apply_cb
@@ -279,12 +308,22 @@ class RaftConsensus:
     # -------------------------------------------------------------- startup
     def _load_log(self) -> None:
         from yugabyte_tpu.consensus.log import LogReader
+        # Durable config from metadata first (a committed config entry may
+        # have been GC'd from the WAL).
+        if self._meta.peer_ids is not None:
+            self._config_history[self._meta.config_index] = tuple(
+                self._meta.peer_ids)
         reader = LogReader(self.log.wal_dir)
         for e in reader.read_all():
             msg = ReplicateMsg.from_log_entry(e)
             self._entries[msg.index] = msg
             self._last_index = msg.index
             self._last_term = msg.term
+            if msg.op_type == OP_CHANGE_CONFIG:
+                self._config_history[msg.index] = tuple(
+                    json.loads(msg.payload)["peer_ids"])
+        self.config.peer_ids = self._config_history[
+            max(self._config_history)]
         self._local_durable_index = self._last_index
         # Committed floor: entries at/below it are safe to apply at
         # bootstrap; entries above it stay pending until a leader commits
@@ -458,6 +497,104 @@ class RaftConsensus:
                 return VoteResp(self.config.peer_id, self._meta.term, True)
             return VoteResp(self.config.peer_id, self._meta.term, False)
 
+    # -------------------------------------------------------- config change
+    def change_config(self, add: Sequence[str] = (),
+                      remove: Sequence[str] = (),
+                      timeout_s: float = 30.0) -> OpId:
+        """Single-server membership change (ref raft_consensus.cc
+        ChangeConfig; one-at-a-time keeps old/new majorities overlapping so
+        joint consensus is unnecessary). The new config takes effect ON
+        APPEND at every replica; commit makes it durable in cmeta. Removing
+        the leader itself is allowed — it steps down after commit."""
+        if len(add) + len(remove) != 1:
+            raise ValueError("exactly one server may be added or removed")
+        with self._lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            # Only one pending (uncommitted) change at a time.
+            for i in range(self.commit_index + 1, self._last_index + 1):
+                e = self._entries.get(i)
+                if e is not None and e.op_type == OP_CHANGE_CONFIG:
+                    raise ConfigChangeInProgress(
+                        f"config change at index {i} still pending")
+            cur = set(self.config.peer_ids)
+            for p in add:
+                if p in cur:
+                    raise ConfigAlreadyApplied(f"{p} already a voter")
+            for p in remove:
+                if p not in cur:
+                    raise ConfigAlreadyApplied(f"{p} not a voter")
+            new_ids = tuple(sorted((cur | set(add)) - set(remove)))
+            payload = json.dumps({"peer_ids": list(new_ids)}).encode()
+            ht = self.clock.now().value if self.clock else 0
+            msg = self._append_unlocked(OP_CHANGE_CONFIG, ht, payload)
+            self._activate_config_unlocked(msg.index, new_ids)
+        for ev in self._peer_events.values():
+            ev.set()
+        deadline = time.monotonic() + timeout_s
+        with self._commit_cv:
+            while True:
+                if self.commit_index >= msg.index:
+                    return msg.op_id
+                cur_e = self._entries.get(msg.index)
+                if cur_e is None or cur_e.term != msg.term:
+                    raise ReplicationAborted(
+                        f"config change {msg.op_id} overwritten")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ReplicationTimedOut(msg.op_id)
+                self._commit_cv.wait(timeout=remaining)
+
+    def _activate_config_unlocked(self, index: int,
+                                  peer_ids: Tuple[str, ...]) -> None:
+        """Adopt a config the moment its entry exists in our log (standard
+        effect-on-append semantics)."""
+        self._config_history[index] = peer_ids
+        self.config.peer_ids = peer_ids
+        self._meta.peer_ids = list(peer_ids)
+        self._meta.config_index = index
+        self._meta.save()  # config is Raft-critical: fsynced
+        if self.role == Role.LEADER:
+            self._ensure_peer_state_unlocked()
+        # Synchronous delivery: back-to-back changes must reach the
+        # listener in order, or a stale peer set could overwrite a newer
+        # one in the tablet superblock.
+        self.on_config_change(peer_ids)
+        TRACE("raft %s: config @%d -> %s", self.config.peer_id, index,
+              peer_ids)
+
+    def _revert_config_unlocked(self, new_tail: int) -> None:
+        """After truncation, reactivate the latest config at/below the new
+        log tail."""
+        for i in list(self._config_history):
+            if i > new_tail:
+                del self._config_history[i]
+        best = max(self._config_history)
+        peer_ids = self._config_history[best]
+        if peer_ids != self.config.peer_ids:
+            self.config.peer_ids = peer_ids
+            self._meta.peer_ids = list(peer_ids)
+            self._meta.config_index = best
+            self._meta.save()
+            self.on_config_change(peer_ids)
+
+    def _ensure_peer_state_unlocked(self) -> None:
+        """Start replication workers for newly added peers; workers for
+        removed peers exit on their next wakeup."""
+        epoch = self._leader_epoch
+        for p in self.config.remote_peers:
+            if p not in self._peer_events:
+                self._next_index[p] = self._last_index + 1
+                self._match_index[p] = 0
+                self._last_ack_send_time[p] = 0.0
+                self._peer_events[p] = threading.Event()
+                t = threading.Thread(
+                    target=self._peer_loop, args=(p, epoch),
+                    name=f"raft-peer-{self.config.peer_id}-{p}",
+                    daemon=True)
+                self._peer_threads.append(t)
+                t.start()
+
     # ---------------------------------------------------------- replication
     def replicate(self, op_type: int, ht_value: int, payload: bytes,
                   timeout_s: float = 30.0) -> OpId:
@@ -610,7 +747,8 @@ class RaftConsensus:
             try:
                 with self._lock:
                     if (self._stopped or self.role != Role.LEADER
-                            or self._leader_epoch != epoch):
+                            or self._leader_epoch != epoch
+                            or peer not in self.config.peer_ids):
                         return
                     req, sent_up_to = self._build_request_unlocked(peer)
                     send_time = time.monotonic()
@@ -734,11 +872,16 @@ class RaftConsensus:
 
     def _advance_commit_unlocked(self) -> None:
         """Majority-match rule; only current-term entries count directly
-        (Raft §5.4.2; ref UpdateMajorityReplicated raft_consensus.cc:1319)."""
-        matches = sorted(
-            [self._local_durable_index]
-            + [self._match_index.get(p, 0) for p in self.config.remote_peers],
-            reverse=True)
+        (Raft §5.4.2; ref UpdateMajorityReplicated raft_consensus.cc:1319).
+        Self counts only while still a voter (a leader that appended its own
+        removal keeps committing with the remaining majority)."""
+        vals = [self._match_index.get(p, 0)
+                for p in self.config.remote_peers]
+        if self.config.peer_id in self.config.peer_ids:
+            vals.append(self._local_durable_index)
+        matches = sorted(vals, reverse=True)
+        if len(matches) < self.config.majority:
+            return
         candidate = matches[self.config.majority - 1]
         while candidate > self.commit_index:
             if self._term_at_unlocked(candidate) == self._meta.term:
@@ -772,11 +915,23 @@ class RaftConsensus:
                 if msg is None:
                     with self._lock:
                         msg = self._reload_from_wal_unlocked(idx)
-                if msg.op_type != OP_NOOP:
+                if msg.op_type == OP_CHANGE_CONFIG:
+                    # Consensus-internal; committed config may remove us.
+                    self._on_config_committed(msg)
+                elif msg.op_type != OP_NOOP:
                     self.apply_cb(msg)
                 with self._lock:
                     self.last_applied = idx
                     self._commit_cv.notify_all()
+
+    def _on_config_committed(self, msg: ReplicateMsg) -> None:
+        peer_ids = tuple(json.loads(msg.payload)["peer_ids"])
+        with self._lock:
+            if (self.config.peer_id not in peer_ids
+                    and self.role == Role.LEADER):
+                # We were removed: step down once the removal is committed
+                # (ref raft_consensus.cc leader removal step-down).
+                self._step_down_unlocked(self._meta.term)
 
     # -------------------------------------------------------- follower path
     def handle_update(self, req: AppendEntriesReq) -> AppendEntriesResp:
@@ -831,10 +986,15 @@ class RaftConsensus:
                     with self._durable_lock:
                         self._durable_watermark = min(
                             self._durable_watermark, self._last_index)
+                    self._revert_config_unlocked(self._last_index)
                 to_append.append(msg)
                 self._entries[msg.index] = msg
                 self._last_index = msg.index
                 self._last_term = msg.term
+                if msg.op_type == OP_CHANGE_CONFIG:
+                    self._activate_config_unlocked(
+                        msg.index,
+                        tuple(json.loads(msg.payload)["peer_ids"]))
             if to_append:
                 # Durable before ack: the leader counts this follower
                 # toward majority once we respond.

@@ -95,6 +95,9 @@ class CatalogManager:
         # volatile: tablet_id -> (leader server_id, term); replica acks
         self.tablet_leaders: Dict[str, Tuple[str, int]] = {}
         self._confirmed: Set[Tuple[str, str]] = set()  # (tablet_id, server)
+        # volatile: authoritative Raft config index per tablet (from leader
+        # reports); used to recognize evicted stale replicas.
+        self._config_indexes: Dict[str, int] = {}
 
     # ------------------------------------------------------------ leadership
     def is_leader(self) -> bool:
@@ -170,6 +173,7 @@ class CatalogManager:
                 tablet_metas.append({
                     "tablet_id": tablet_id, "table_id": table_id,
                     "partition": partition_to_wire(part),
+                    "hash_partitioning": ps.hash_partitioning,
                     "replicas": replicas})
             table_meta = {
                 "table_id": table_id, "name": name, "namespace": namespace,
@@ -249,6 +253,8 @@ class CatalogManager:
                 raise StatusError(Status.NotFound(f"table {table_id}"))
             out = []
             for tablet_id in table["tablet_ids"]:
+                if len(self._split_children_in_catalog(tablet_id)) == 2:
+                    continue  # split parent: clients route to the children
                 tm = self.tablets[tablet_id]
                 leader = self.tablet_leaders.get(tablet_id)
                 out.append({
@@ -276,8 +282,23 @@ class CatalogManager:
             for t in report:
                 tablet_id = t["tablet_id"]
                 if tablet_id not in self.tablets:
-                    # Not in the catalog => table dropped (or orphan of a
-                    # failed create persisted-first): tear it down.
+                    if t.get("split_parent") in self.tablets:
+                        # ADOPT a freshly split child the tservers created
+                        # (ref CatalogManager::RegisterNewTabletForSplit).
+                        self._adopt_split_child_locked(t)
+                    else:
+                        # Not in the catalog => table dropped (or orphan of
+                        # a failed create persisted-first): tear it down.
+                        to_delete.append(tablet_id)
+                        continue
+                # Evicted stale replica (ref master-driven tombstoning of
+                # not-in-config replicas): this server is not in the
+                # tablet's replica set AND its config predates the
+                # authoritative one — its data was moved elsewhere.
+                auth_index = self._config_indexes.get(tablet_id)
+                if (server_id not in self.tablets[tablet_id]["replicas"]
+                        and auth_index is not None
+                        and t.get("config_index", 0) < auth_index):
                     to_delete.append(tablet_id)
                     continue
                 self._confirmed.add((tablet_id, server_id))
@@ -286,10 +307,104 @@ class CatalogManager:
                     if cur is None or t["term"] >= cur[1]:
                         self.tablet_leaders[tablet_id] = (server_id,
                                                           t["term"])
+                        self._config_indexes[tablet_id] = max(
+                            self._config_indexes.get(tablet_id, 0),
+                            t.get("config_index", 0))
+                        # The leader's ACTIVE consensus config is the truth
+                        # for replica membership; the catalog follows it
+                        # (a crash between ChangeConfig and catalog persist
+                        # heals here).
+                        reported = t.get("replica_servers")
+                        if (reported and sorted(reported)
+                                != sorted(self.tablets[tablet_id]
+                                          ["replicas"])):
+                            self._persist_tablet_replicas_locked(
+                                tablet_id, list(reported))
         return {
             "addr_map": self.ts_manager.addr_map(),
             "tablets_to_delete": to_delete,
         }
+
+    def _adopt_split_child_locked(self, t: dict) -> None:
+        parent_id = t["split_parent"]
+        parent_tm = self.tablets[parent_id]
+        child_id = t["tablet_id"]
+        tm = {"tablet_id": child_id, "table_id": t["table_id"],
+              "partition": t["partition"],
+              "hash_partitioning": parent_tm.get("hash_partitioning", True),
+              "replicas": list(parent_tm["replicas"]),
+              "split_parent": parent_id}
+        self.sys.upsert("tablet", child_id, tm)
+        self.tablets[child_id] = tm
+        table = self.tables.get(t["table_id"])
+        if table is not None and child_id not in table["tablet_ids"]:
+            table = dict(table)
+            table["tablet_ids"] = table["tablet_ids"] + [child_id]
+            self.sys.upsert("table", table["table_id"], table)
+            self.tables[table["table_id"]] = table
+        TRACE("catalog: adopted split child %s of %s", child_id, parent_id)
+
+    def _split_children_in_catalog(self, tablet_id: str) -> List[str]:
+        return [c for c in (f"{tablet_id}.s0", f"{tablet_id}.s1")
+                if c in self.tablets]
+
+    def retire_split_parents(self) -> int:
+        """Drop split parents whose children are adopted and fully
+        replicated; their hosts then tear the parent replicas down via the
+        heartbeat to_delete path (ref deferred parent deletion in
+        tablet_split_manager.cc)."""
+        retired = 0
+        with self._lock:
+            for tablet_id, tm in list(self.tablets.items()):
+                children = self._split_children_in_catalog(tablet_id)
+                if len(children) != 2:
+                    continue
+                if not all((c, s) in self._confirmed
+                           for c in children
+                           for s in self.tablets[c]["replicas"]):
+                    continue
+                if not all(c in self.tablet_leaders for c in children):
+                    continue
+                table = self.tables.get(tm["table_id"])
+                self.sys.delete("tablet", tablet_id)
+                self.tablets.pop(tablet_id, None)
+                self.tablet_leaders.pop(tablet_id, None)
+                if table is not None and tablet_id in table["tablet_ids"]:
+                    table = dict(table)
+                    table["tablet_ids"] = [
+                        x for x in table["tablet_ids"] if x != tablet_id]
+                    self.sys.upsert("table", table["table_id"], table)
+                    self.tables[table["table_id"]] = table
+                retired += 1
+                TRACE("catalog: retired split parent %s", tablet_id)
+        return retired
+
+    def split_tablet(self, tablet_id: str) -> List[str]:
+        """Drive a split through the tablet's leader (ref master
+        TabletSplitManager)."""
+        addr_map = self.ts_manager.addr_map()
+        with self._lock:
+            if tablet_id not in self.tablets:
+                raise StatusError(Status.NotFound(f"tablet {tablet_id}"))
+            leader = self.tablet_leaders.get(tablet_id)
+        if leader is None or addr_map.get(leader[0]) is None:
+            raise StatusError(Status.ServiceUnavailable(
+                f"no known leader for {tablet_id}"))
+        return self.messenger.call(addr_map[leader[0]], "tserver",
+                                   "split_tablet", tablet_id=tablet_id)
+
+    def _persist_tablet_replicas_locked(self, tablet_id: str,
+                                        replicas: List[str]) -> None:
+        tm = dict(self.tablets[tablet_id])
+        tm["replicas"] = replicas
+        self.sys.upsert("tablet", tablet_id, tm)
+        self.tablets[tablet_id] = tm
+
+    def update_tablet_replicas(self, tablet_id: str,
+                               replicas: List[str]) -> None:
+        with self._lock:
+            if tablet_id in self.tablets:
+                self._persist_tablet_replicas_locked(tablet_id, replicas)
 
     # -------------------------------------------------------- reconciliation
     def reconcile_tablets(self) -> int:
@@ -303,21 +418,43 @@ class CatalogManager:
                 table = self.tables.get(tm["table_id"])
                 if table is None:
                     continue
+                if tm.get("split_parent") in self.tablets:
+                    # Split still propagating: every replica creates this
+                    # child from its own parent snapshot when the SPLIT op
+                    # applies — creating it empty here would diverge it.
+                    continue
+                # If live replicas already hold data, a missing one must be
+                # REBUILT from them (remote bootstrap), not created empty —
+                # an empty voter would need the whole log, which may be GC'd.
+                leader = self.tablet_leaders.get(tablet_id)
+                confirmed_any = any((tablet_id, s) in self._confirmed
+                                    for s in tm["replicas"])
+                source_addr = (addr_map.get(leader[0])
+                               if confirmed_any and leader else None)
                 for server_id in tm["replicas"]:
                     if (tablet_id, server_id) in self._confirmed:
                         continue
-                    work.append((tablet_id, tm, table, server_id))
+                    work.append((tablet_id, tm, table, server_id,
+                                 source_addr))
         issued = [0]
         lock = threading.Lock()
 
-        def send(tablet_id, tm, table, server_id, addr):
+        def send(tablet_id, tm, table, server_id, addr, source_addr):
             try:
-                self.messenger.call(
-                    addr, "tserver", "create_tablet", timeout_s=5.0,
-                    tablet_id=tablet_id, table_id=tm["table_id"],
-                    schema=table["schema"],
-                    peer_server_ids=tm["replicas"],
-                    partition=tm["partition"], addr_map=addr_map)
+                if source_addr is not None and source_addr != addr:
+                    self.messenger.call(
+                        addr, "tserver", "start_remote_bootstrap",
+                        timeout_s=60.0, tablet_id=tablet_id,
+                        source_addr=source_addr)
+                else:
+                    self.messenger.call(
+                        addr, "tserver", "create_tablet", timeout_s=5.0,
+                        tablet_id=tablet_id, table_id=tm["table_id"],
+                        schema=table["schema"],
+                        peer_server_ids=tm["replicas"],
+                        partition=tm["partition"],
+                        hash_partitioning=tm.get("hash_partitioning", True),
+                        addr_map=addr_map)
                 with lock:
                     issued[0] += 1
             except StatusError as e:
@@ -328,12 +465,13 @@ class CatalogManager:
         # block creation on healthy ones (acks arrive via heartbeats, so a
         # straggler thread finishing late is harmless and idempotent).
         threads = []
-        for tablet_id, tm, table, server_id in work:
+        for tablet_id, tm, table, server_id, source_addr in work:
             addr = addr_map.get(server_id)
             if addr is None:
                 continue
             t = threading.Thread(target=send, daemon=True,
-                                 args=(tablet_id, tm, table, server_id, addr))
+                                 args=(tablet_id, tm, table, server_id,
+                                       addr, source_addr))
             t.start()
             threads.append(t)
         for t in threads:

@@ -1,0 +1,122 @@
+"""ClusterLoadBalancer: replica repair + balancing.
+
+Capability parity with the reference (ref: src/yb/master/cluster_balance.h
+:63-78 — the balancer walks the tablet list, finds under-replicated /
+misplaced replicas, and drives one bounded batch of moves per pass:
+remote-bootstrap the new replica, ChangeConfig ADD, ChangeConfig REMOVE the
+dead one; catalog state follows the consensus config reported by tablet
+leaders, not the other way around).
+
+Safety rails mirrored from the reference: a grace period before a silent
+tserver is declared dead, a cap on concurrent moves per pass, and an
+initial delay after master leadership change (heartbeats must repopulate
+the TS registry before anything is judged dead).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.status import StatusError
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag("load_balancer_dead_grace_ms", 5000,
+                  "how long a tserver must be silent before its replicas "
+                  "are moved (ref follower_unavailable_considered_failed_sec)")
+flags.define_flag("load_balancer_max_moves_per_pass", 2,
+                  "bound on replica moves started per balancer pass "
+                  "(ref load_balancer_max_concurrent_moves)")
+
+
+class ClusterLoadBalancer:
+    def __init__(self, catalog, messenger):
+        self.catalog = catalog
+        self.messenger = messenger
+        self._leader_since: Optional[float] = None
+
+    # ---------------------------------------------------------------- pass
+    def run_pass(self) -> int:
+        """One balancing pass on the master leader; returns moves started."""
+        cm = self.catalog
+        now = time.monotonic()
+        if self._leader_since is None:
+            self._leader_since = now
+        grace_s = flags.get_flag("load_balancer_dead_grace_ms") / 1000.0
+        if now - self._leader_since < 2 * grace_s:
+            return 0  # let heartbeats repopulate the registry first
+        live = {d.server_id: d for d in cm.ts_manager.live_descriptors()}
+        addr_map = cm.ts_manager.addr_map()
+        moves = 0
+        max_moves = flags.get_flag("load_balancer_max_moves_per_pass")
+        for tablet_id, tm in list(cm.tablets.items()):
+            if moves >= max_moves:
+                break
+            dead = [s for s in tm["replicas"]
+                    if self._dead_for(s) > grace_s]
+            if not dead:
+                continue
+            leader = cm.tablet_leaders.get(tablet_id)
+            if leader is None or leader[0] not in live:
+                continue  # no live leader to drive the change through
+            spare = self._pick_spare(live, tm["replicas"])
+            if spare is None:
+                continue
+            if self._move_replica(tablet_id, addr_map[leader[0]],
+                                  dead[0], spare):
+                moves += 1
+        return moves
+
+    def on_leadership_change(self) -> None:
+        self._leader_since = None
+
+    def _dead_for(self, server_id: str) -> float:
+        desc = self.catalog.ts_manager.get(server_id)
+        if desc is None:
+            # Unknown since this master became leader: counts as dead only
+            # after the initial-delay gate above has passed.
+            return float("inf")
+        return time.monotonic() - desc.last_heartbeat
+
+    def _pick_spare(self, live: Dict[str, object],
+                    replicas: List[str]) -> Optional[str]:
+        candidates = [d for sid, d in live.items() if sid not in replicas]
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda d: (d.num_tablets, d.server_id)).server_id
+
+    # ---------------------------------------------------------------- move
+    def _move_replica(self, tablet_id: str, leader_addr: str,
+                      dead_server: str, new_server: str) -> bool:
+        """dead -> new replica move. Every step is idempotent, so a crash
+        mid-move is finished by a later pass (consensus config reported by
+        the leader resyncs the catalog)."""
+        cm = self.catalog
+        addr_map = cm.ts_manager.addr_map()
+        new_addr = addr_map.get(new_server)
+        if new_addr is None:
+            return False
+        TRACE("lb: moving %s replica %s -> %s", tablet_id, dead_server,
+              new_server)
+        try:
+            self.messenger.call(new_addr, "tserver",
+                                "start_remote_bootstrap", timeout_s=60.0,
+                                tablet_id=tablet_id,
+                                source_addr=leader_addr)
+            self.messenger.call(leader_addr, "tserver", "change_config",
+                                timeout_s=30.0, tablet_id=tablet_id,
+                                add=[new_server])
+            self.messenger.call(leader_addr, "tserver", "change_config",
+                                timeout_s=30.0, tablet_id=tablet_id,
+                                remove=[dead_server])
+        except StatusError as e:
+            TRACE("lb: move of %s failed midway (retried next pass): %s",
+                  tablet_id, e)
+            return False
+        cm.update_tablet_replicas(
+            tablet_id,
+            [new_server if s == dead_server else s
+             for s in cm.tablets[tablet_id]["replicas"]])
+        return True

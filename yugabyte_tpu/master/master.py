@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 from yugabyte_tpu.common.hybrid_time import HybridClock
 from yugabyte_tpu.master.catalog_manager import CatalogManager
+from yugabyte_tpu.master.load_balancer import ClusterLoadBalancer
 from yugabyte_tpu.master.sys_catalog import SysCatalog
 from yugabyte_tpu.rpc.consensus_service import RpcTransport
 from yugabyte_tpu.rpc.messenger import Messenger
@@ -87,6 +88,9 @@ class MasterService:
     def get_table_locations(self, table_id: str) -> List[dict]:
         return self._leader_catalog().get_table_locations(table_id)
 
+    def split_tablet(self, tablet_id: str) -> List[str]:
+        return self._leader_catalog().split_tablet(tablet_id)
+
     def list_tservers(self) -> List[dict]:
         cm = self._leader_catalog()
         return [{"server_id": d.server_id, "addr": d.addr,
@@ -111,6 +115,8 @@ class Master:
             os.path.join(opts.fs_root, "sys_catalog"), opts.master_id,
             master_ids, self.transport, clock=self.clock)
         self.catalog = CatalogManager(self.sys_catalog, self.messenger)
+        self.load_balancer = ClusterLoadBalancer(self.catalog,
+                                                 self.messenger)
         self.service = MasterService(self)
         self.messenger.register_service(MASTER_SERVICE, self.service)
         self._stop = threading.Event()
@@ -152,12 +158,20 @@ class Master:
 
     def _bg_loop(self) -> None:
         """ref catalog_manager_bg_tasks.cc"""
+        was_leader = False
         while not self._stop.wait(
                 flags.get_flag("catalog_reconcile_interval_ms") / 1000.0):
             try:
                 if self.catalog.is_leader():
+                    if not was_leader:
+                        self.load_balancer.on_leadership_change()
+                        was_leader = True
                     self.catalog.ensure_loaded()
                     self.catalog.reconcile_tablets()
+                    self.catalog.retire_split_parents()
+                    self.load_balancer.run_pass()
+                else:
+                    was_leader = False
             except Exception:  # noqa: BLE001 — bg loop must survive
                 pass
 

@@ -58,6 +58,15 @@ class TabletRetentionPolicy:
             now.physical_micros - retention_us).value)
 
 
+class TabletHasBeenSplit(Exception):
+    """Writes to a split parent are rejected; the client re-routes to the
+    children (ref tablet/operations/split_operation.h)."""
+
+    def __init__(self, children):
+        super().__init__(f"tablet split into {children}")
+        self.children = children
+
+
 class LocalConsensusContext:
     """Round-1 consensus seam: no replication, ops numbered monotonically.
     Same submit() surface RaftConsensus implements in stage 6."""
@@ -84,6 +93,11 @@ class TabletOptions:
     compaction_pool: object = None
     auto_compact: bool = True
     memstore_size_bytes: Optional[int] = None
+    # Doc-key-space clamp for split children, whose LSM initially holds the
+    # whole parent key range (ref: post-split key-bounds filtering,
+    # docdb/doc_db.h KeyBounds).
+    lower_bound_key: bytes = b""
+    upper_bound_key: Optional[bytes] = None
 
 
 class Tablet:
@@ -116,6 +130,15 @@ class Tablet:
         self.mvcc = MvccManager(self.clock)
         self.lock_manager = SharedLockManager()
         self.consensus = LocalConsensusContext(self)
+        self.split_children = None  # (child0, child1) once split
+        # Write gate for splitting: the SPLIT op must be the last write-ish
+        # entry the parent ever appends, so block_writes() drains in-flight
+        # writes BEFORE the split appends (an acked write appended after the
+        # SPLIT entry would apply to the parent after the children snapshot
+        # it — silently lost when the parent retires).
+        self._write_gate = threading.Condition()
+        self._inflight_writes = 0
+        self._writes_blocked = False
         metrics = metrics or MetricRegistry()
         entity = metrics.entity("tablet", tablet_id)
         self.metric_rows_inserted = entity.counter(
@@ -129,6 +152,30 @@ class Tablet:
               timeout_s: float = 10.0) -> HybridTime:
         """The WriteQuery pipeline (ref write_query.cc:211-566). Returns the
         hybrid time at which the batch became visible."""
+        with self._write_gate:
+            if self._writes_blocked or self.split_children is not None:
+                raise TabletHasBeenSplit(self.split_children or ())
+            self._inflight_writes += 1
+        try:
+            return self._write_locked(ops, timeout_s)
+        finally:
+            with self._write_gate:
+                self._inflight_writes -= 1
+                self._write_gate.notify_all()
+
+    def block_writes(self) -> None:
+        """Reject new writes and drain in-flight ones (split prelude)."""
+        with self._write_gate:
+            self._writes_blocked = True
+            while self._inflight_writes:
+                self._write_gate.wait()
+
+    def unblock_writes(self) -> None:
+        with self._write_gate:
+            self._writes_blocked = False
+
+    def _write_locked(self, ops: Sequence[QLWriteOp],
+                      timeout_s: float) -> HybridTime:
         t0 = time.monotonic()
         lock_batch, kv_pairs = prepare_and_assemble(
             ops, self.schema, self.lock_manager, timeout_s=timeout_s)
@@ -192,6 +239,15 @@ class Tablet:
         directly to their range on the CPU iterator (ref: the reference
         always walks DocRowwiseIterator; here ops/scan.py)."""
         ht = self.read_time(read_ht)
+        # Clamp to this tablet's key bounds (split children share the
+        # parent's LSM files until post-split compaction).
+        if self.opts.lower_bound_key:
+            lower_doc_key = max(lower_doc_key, self.opts.lower_bound_key)
+        if self.opts.upper_bound_key is not None:
+            upper_doc_key = (self.opts.upper_bound_key
+                             if upper_doc_key is None
+                             else min(upper_doc_key,
+                                      self.opts.upper_bound_key))
         if use_device is None:
             use_device = (self.opts.device is not None
                           and not lower_doc_key and upper_doc_key is None)
@@ -218,6 +274,17 @@ class Tablet:
         self.flush()
         self.regular_db.checkpoint(os.path.join(out_dir, "regular"))
         self.intents_db.checkpoint(os.path.join(out_dir, "intents"))
+
+    def split_partition_key(self, hash_partitioning: bool) -> Optional[bytes]:
+        """Partition-key-space split point derived from the median doc key
+        (hash partitioning: the 2-byte bucket right after the kUInt16Hash
+        tag; range partitioning: the encoded doc key itself)."""
+        median = self.split_key()
+        if median is None:
+            return None
+        if hash_partitioning:
+            return median[1:3] if len(median) >= 3 else None
+        return median
 
     def split_key(self) -> Optional[bytes]:
         """Encoded middle DocKey for tablet splitting (ref tablet.cc:3427

@@ -32,8 +32,8 @@ from yugabyte_tpu.common.hybrid_time import HybridClock, HybridTime
 from yugabyte_tpu.common.schema import Schema
 from yugabyte_tpu.consensus.log import Log, LogReader
 from yugabyte_tpu.consensus.raft import (
-    OP_WRITE, NotLeader, OperationOutcomeUnknown, RaftConfig, RaftConsensus,
-    ReplicateMsg, ReplicationTimedOut, Role)
+    OP_SPLIT, OP_WRITE, NotLeader, OperationOutcomeUnknown, RaftConfig,
+    RaftConsensus, ReplicateMsg, ReplicationTimedOut, Role)
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
 
@@ -120,6 +120,10 @@ class TabletPeer:
         transport.register(config.peer_id, self.raft)
         self.tablet.consensus = RaftWriteContext(self)
         self.tablet.mvcc.set_leader_mode(False)
+        # Split hook: the tablet manager creates the child tablets when the
+        # SPLIT op applies (deterministically on every replica, including
+        # WAL replay after restart — child creation is idempotent).
+        self.on_split = lambda info: None
 
     # ------------------------------------------------------------ bootstrap
     def bootstrap(self) -> int:
@@ -168,6 +172,37 @@ class TabletPeer:
                 # leader's MvccManager drains via replicated() in write().
                 self.clock.update(ht)
                 self.tablet.mvcc.set_last_replicated(ht)
+        elif msg.op_type == OP_SPLIT:
+            # Applied at the same log position on every replica, after all
+            # preceding writes and before nothing (the parent rejects writes
+            # once the split is appended) — so the parent state each replica
+            # snapshots into the children is identical (ref
+            # tablet/operations/split_operation.cc).
+            import json as _json
+            info = _json.loads(msg.payload)
+            self.tablet.split_children = tuple(info["children"])
+            self.on_split(info)
+
+    def submit_split(self, child_ids, split_partition_key: bytes,
+                     timeout_s: float = 30.0):
+        """Leader: replicate the split point + child ids through Raft
+        (ref tablet/operations/split_operation.h:38). Writes are gated and
+        drained FIRST so the SPLIT entry is the last write-affecting entry
+        in the parent's log."""
+        import json as _json
+        payload = _json.dumps({
+            "children": list(child_ids),
+            "split_partition_key": split_partition_key.hex(),
+        }).encode()
+        self.tablet.block_writes()
+        try:
+            return self.raft.replicate(OP_SPLIT, self.clock.now().value,
+                                       payload, timeout_s=timeout_s)
+        except BaseException:
+            # Split did not take: let writes flow again (followers only
+            # block via split_children, set at apply).
+            self.tablet.unblock_writes()
+            raise
 
     def _on_propagated_safe_time(self, ht_value: int) -> None:
         ht = HybridTime(ht_value)

@@ -53,7 +53,7 @@ class TabletServer:
         self.tablet_manager = TSTabletManager(
             opts.server_id, opts.fs_root, self.transport, clock=self.clock,
             tablet_options_factory=opts.tablet_options_factory,
-            metrics=self.metrics)
+            metrics=self.metrics, messenger=self.messenger)
         self.service = TabletServiceImpl(self.tablet_manager,
                                          addr_updater=self.update_addr_map)
         self.messenger.register_service(TABLET_SERVICE, self.service)

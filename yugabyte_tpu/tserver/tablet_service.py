@@ -40,12 +40,31 @@ class TabletServiceImpl:
     # ---------------------------------------------------------------- writes
     def write(self, tablet_id: str, ops: List[dict],
               timeout_s: float = 15.0) -> dict:
+        from yugabyte_tpu.tablet.tablet import TabletHasBeenSplit
         peer = self._tablets.get_tablet(tablet_id)
         decoded = [write_op_from_wire(w) for w in ops]
+        # Key-bounds guard: after a split, a stale client batch may span
+        # both children; accepting out-of-range keys would strand data in a
+        # tablet that never serves them (ref CheckOperationAllowed key
+        # bounds validation in the reference write path).
+        lo = peer.tablet.opts.lower_bound_key
+        hi = peer.tablet.opts.upper_bound_key
+        if lo or hi is not None:
+            for op in decoded:
+                enc = op.doc_key.encode()
+                if (lo and enc < lo) or (hi is not None and enc >= hi):
+                    err = StatusError(Status.IllegalState(
+                        f"key outside tablet range of {tablet_id}"))
+                    err.extra = {"wrong_tablet": True}
+                    raise err
         try:
             ht = peer.write(decoded, timeout_s=timeout_s)
         except NotLeader as e:
             raise NotLeaderError(_leader_server_hint(e)) from e
+        except TabletHasBeenSplit as e:
+            err = StatusError(Status.IllegalState(str(e)))
+            err.extra = {"tablet_split": True}
+            raise err from e
         except OperationOutcomeUnknown as e:
             raise StatusError(Status.TimedOut(str(e))) from e
         return {"propagated_ht": ht.value}
@@ -104,6 +123,7 @@ class TabletServiceImpl:
     def create_tablet(self, tablet_id: str, table_id: str, schema: dict,
                       peer_server_ids: List[str],
                       partition: Optional[dict] = None,
+                      hash_partitioning: bool = True,
                       addr_map: Optional[dict] = None) -> bool:
         # The master ships the current address map with the request so the
         # new replica can reach its consensus peers before the first
@@ -111,12 +131,59 @@ class TabletServiceImpl:
         if addr_map:
             self._addr_updater(addr_map)
         self._tablets.create_tablet(tablet_id, table_id, schema,
-                                    peer_server_ids, partition)
+                                    peer_server_ids, partition,
+                                    hash_partitioning)
         return True
 
     def delete_tablet(self, tablet_id: str) -> bool:
         self._tablets.delete_tablet(tablet_id)
         return True
+
+    # ---------------------------------------------- replica movement (LB)
+    def begin_remote_bootstrap(self, tablet_id: str) -> dict:
+        peer = self._tablets.get_tablet(tablet_id)
+        return self._tablets.rb_sessions.begin(
+            peer, self._tablets.tablet_meta(tablet_id))
+
+    def fetch_remote_bootstrap(self, session_id: str, relpath: str,
+                               offset: int, length: int) -> bytes:
+        return self._tablets.rb_sessions.fetch(session_id, relpath,
+                                               offset, length)
+
+    def end_remote_bootstrap(self, session_id: str) -> bool:
+        self._tablets.rb_sessions.end(session_id)
+        return True
+
+    def start_remote_bootstrap(self, tablet_id: str,
+                               source_addr: str) -> bool:
+        self._tablets.start_remote_bootstrap(tablet_id, source_addr)
+        return True
+
+    def change_config(self, tablet_id: str, add: List[str] = (),
+                      remove: List[str] = ()) -> bool:
+        """Add/remove one replica server on this tablet's Raft group
+        (leader-only; ref consensus ChangeConfig RPC)."""
+        from yugabyte_tpu.consensus.raft import (
+            ConfigAlreadyApplied, ConfigChangeInProgress)
+        from yugabyte_tpu.tablet.tablet_peer import peer_address
+        peer = self._tablets.get_tablet(tablet_id)
+        try:
+            peer.raft.change_config(
+                add=[peer_address(s, tablet_id) for s in add],
+                remove=[peer_address(s, tablet_id) for s in remove])
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
+        except ConfigAlreadyApplied:
+            return True  # idempotent retry
+        except ConfigChangeInProgress as e:
+            raise StatusError(Status.TryAgain(str(e))) from e
+        return True
+
+    def split_tablet(self, tablet_id: str) -> List[str]:
+        try:
+            return self._tablets.split_tablet(tablet_id)
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
 
     def flush_tablet(self, tablet_id: str) -> bool:
         self._tablets.get_tablet(tablet_id).tablet.flush()

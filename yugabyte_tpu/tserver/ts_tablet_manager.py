@@ -27,20 +27,28 @@ from yugabyte_tpu.utils.trace import TRACE
 class TSTabletManager:
     def __init__(self, server_id: str, fs_root: str, transport,
                  clock: Optional[HybridClock] = None,
-                 tablet_options_factory=None, metrics=None):
+                 tablet_options_factory=None, metrics=None,
+                 messenger=None):
         self.server_id = server_id
         self.fs_root = fs_root
         self.transport = transport
         self.clock = clock or HybridClock()
         self.metrics = metrics
+        self.messenger = messenger
+        from yugabyte_tpu.tserver.remote_bootstrap import (
+            RemoteBootstrapSessions)
+        self.rb_sessions = RemoteBootstrapSessions(fs_root)
         self._tablet_options_factory = tablet_options_factory or TabletOptions
         self._tablets: Dict[str, TabletPeer] = {}
         self._meta: Dict[str, dict] = {}  # tablet_id -> superblock dict
+        self._rb_in_progress: set = set()
         self._lock = threading.Lock()
         # Serializes whole tablet creations: two concurrent (retried /
         # reconciler-raced) create_tablet RPCs must never both open a
-        # TabletPeer over the same WAL directory.
-        self._create_lock = threading.Lock()
+        # TabletPeer over the same WAL directory. Reentrant: opening a
+        # tablet can replay a SPLIT op, which creates children while the
+        # lock is already held.
+        self._create_lock = threading.RLock()
         os.makedirs(self._tablets_root, exist_ok=True)
 
     @property
@@ -53,21 +61,34 @@ class TSTabletManager:
     # ------------------------------------------------------------- lifecycle
     def open_existing(self) -> int:
         """Reopen every tablet found on disk (restart path; ref
-        TSTabletManager::Init replaying each superblock)."""
+        TSTabletManager::Init replaying each superblock). A parent's SPLIT
+        replay may open its children before the loop reaches their dirs, so
+        re-check under the create lock; dot-dirs are crash leftovers of
+        interrupted bootstraps/splits and are swept."""
         opened = 0
         for tablet_id in sorted(os.listdir(self._tablets_root)):
-            meta_path = os.path.join(self._tablet_dir(tablet_id), "meta.json")
-            if not os.path.exists(meta_path):
+            if tablet_id.startswith("."):
+                shutil.rmtree(os.path.join(self._tablets_root, tablet_id),
+                              ignore_errors=True)
                 continue
-            with open(meta_path) as f:
-                meta = jsonutil.loads(f.read())
-            self._open_tablet(tablet_id, meta)
+            with self._create_lock:
+                with self._lock:
+                    if tablet_id in self._tablets:
+                        continue
+                meta_path = os.path.join(self._tablet_dir(tablet_id),
+                                         "meta.json")
+                if not os.path.exists(meta_path):
+                    continue
+                with open(meta_path) as f:
+                    meta = jsonutil.loads(f.read())
+                self._open_tablet(tablet_id, meta)
             opened += 1
         return opened
 
     def create_tablet(self, tablet_id: str, table_id: str, schema_wire: dict,
                       peer_server_ids: Sequence[str],
-                      partition_wire: Optional[dict] = None) -> None:
+                      partition_wire: Optional[dict] = None,
+                      hash_partitioning: bool = True) -> None:
         """Create a brand-new tablet replica on this server (ref
         TSTabletManager::CreateNewTablet). Idempotent for retried RPCs."""
         with self._create_lock:
@@ -83,7 +104,8 @@ class TSTabletManager:
             meta = {"tablet_id": tablet_id, "table_id": table_id,
                     "schema": schema_wire,
                     "peer_server_ids": list(peer_server_ids),
-                    "partition": partition_wire}
+                    "partition": partition_wire,
+                    "hash_partitioning": hash_partitioning}
             os.makedirs(tdir, exist_ok=True)
             tmp = meta_path + ".tmp"
             with open(tmp, "w") as f:
@@ -96,18 +118,201 @@ class TSTabletManager:
               self.server_id, tablet_id, table_id)
 
     def _open_tablet(self, tablet_id: str, meta: dict) -> None:
+        import dataclasses
+
+        from yugabyte_tpu.common.partition import (
+            Partition, doc_key_bounds)
         schema = schema_from_wire(meta["schema"])
+        options = self._tablet_options_factory()
+        part_wire = meta.get("partition")
+        if part_wire is not None:
+            lower, upper = doc_key_bounds(
+                Partition(part_wire["start"], part_wire["end"]),
+                meta.get("hash_partitioning", True))
+            options = dataclasses.replace(
+                options, lower_bound_key=lower, upper_bound_key=upper)
         peer = TabletPeer(
             tablet_id, self._tablet_dir(tablet_id), schema,
             server_id=self.server_id,
             server_ids=meta["peer_server_ids"],
             transport=self.transport, clock=self.clock,
-            options=self._tablet_options_factory(),
+            options=options,
             metrics=self.metrics)
+        # Closure over peer+meta: during bootstrap replay the parent is not
+        # yet in self._tablets, so the hook must not look it up.
+        peer.on_split = (
+            lambda info, p=peer, m=meta: self._create_split_children(
+                p, m, info))
+        # Membership changes must survive restarts: mirror the active Raft
+        # config into the superblock (ref RaftGroupMetadata config update).
+        peer.raft.on_config_change = (
+            lambda ids, tid=tablet_id: self._update_peers_in_meta(tid, ids))
         peer.start(election_timer=True)
         with self._lock:
             self._tablets[tablet_id] = peer
             self._meta[tablet_id] = meta
+        active = sorted(p.split("/", 1)[0]
+                        for p in peer.raft.config.peer_ids)
+        if active != sorted(meta["peer_server_ids"]):
+            self._update_peers_in_meta(
+                tablet_id, tuple(peer.raft.config.peer_ids))
+
+    def _update_peers_in_meta(self, tablet_id: str,
+                              peer_ids: tuple) -> None:
+        server_ids = [p.split("/", 1)[0] for p in peer_ids]
+        with self._lock:
+            meta = self._meta.get(tablet_id)
+            if meta is None:
+                return
+            meta["peer_server_ids"] = server_ids
+            snapshot = dict(meta)
+        meta_path = os.path.join(self._tablet_dir(tablet_id), "meta.json")
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(jsonutil.dumps(snapshot))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, meta_path)
+
+    # ----------------------------------------------------------- splitting
+    def _create_split_children(self, parent, parent_meta: dict,
+                               info: dict) -> None:
+        """SPLIT-op apply hook: snapshot the parent into two child tablets
+        (hard links) with halved partitions. Idempotent — re-invoked on WAL
+        replay after restart (ref tablet.cc:3338 CreateSubtablet)."""
+        from yugabyte_tpu.tserver.remote_bootstrap import _snapshot_tree
+        parent_id = parent.tablet_id
+        split_pk = bytes.fromhex(info["split_partition_key"])
+        part = parent_meta.get("partition") or {"start": b"", "end": b""}
+        child_parts = [{"start": part["start"], "end": split_pk},
+                       {"start": split_pk, "end": part["end"]}]
+        parent.tablet.flush()
+        for child_id, child_part in zip(info["children"], child_parts):
+            with self._create_lock:
+                with self._lock:
+                    if child_id in self._tablets:
+                        continue
+                cdir = self._tablet_dir(child_id)
+                if os.path.exists(os.path.join(cdir, "meta.json")):
+                    with open(os.path.join(cdir, "meta.json")) as f:
+                        self._open_tablet(child_id, jsonutil.loads(f.read()))
+                    continue
+                tmp_dir = os.path.join(self._tablets_root,
+                                       f".split-{child_id}")
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+                _snapshot_tree(os.path.join(parent.data_dir, "regular"),
+                               os.path.join(tmp_dir, "regular"))
+                _snapshot_tree(os.path.join(parent.data_dir, "intents"),
+                               os.path.join(tmp_dir, "intents"))
+                meta = {"tablet_id": child_id,
+                        "table_id": parent_meta["table_id"],
+                        "schema": parent_meta["schema"],
+                        "peer_server_ids": [
+                            p.split("/", 1)[0]
+                            for p in parent.raft.config.peer_ids],
+                        "partition": child_part,
+                        "hash_partitioning": parent_meta.get(
+                            "hash_partitioning", True),
+                        "split_parent": parent_id}
+                with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
+                    f.write(jsonutil.dumps(meta))
+                    f.flush()
+                    os.fsync(f.fileno())
+                shutil.rmtree(cdir, ignore_errors=True)
+                os.rename(tmp_dir, cdir)
+                self._open_tablet(child_id, meta)
+        TRACE("ts %s: split %s -> %s", self.server_id, parent_id,
+              info["children"])
+
+    def split_tablet(self, tablet_id: str) -> List[str]:
+        """Leader-side split entry: compute the split point and replicate
+        the SPLIT op (ref master's TabletSplitManager driving
+        tserver SplitTablet RPCs)."""
+        peer = self.get_tablet(tablet_id)
+        meta = self.tablet_meta(tablet_id)
+        if peer.tablet.split_children is not None:
+            return list(peer.tablet.split_children)
+        split_pk = peer.tablet.split_partition_key(
+            meta.get("hash_partitioning", True))
+        if split_pk is None:
+            raise StatusError(Status.IllegalState(
+                f"tablet {tablet_id} has too little data to split"))
+        part = meta.get("partition") or {"start": b"", "end": b""}
+        if not (part["start"] < split_pk
+                and (not part["end"] or split_pk < part["end"])):
+            raise StatusError(Status.IllegalState(
+                f"median key outside partition; cannot split {tablet_id}"))
+        children = [f"{tablet_id}.s0", f"{tablet_id}.s1"]
+        peer.submit_split(children, split_pk)
+        return children
+
+    # ------------------------------------------------------ remote bootstrap
+    def start_remote_bootstrap(self, tablet_id: str,
+                               source_addr: str) -> None:
+        """Destination path: download a snapshot from source_addr and open
+        the replica (ref remote_bootstrap_client.cc). Idempotent: a replica
+        that already exists locally is left alone."""
+        from yugabyte_tpu.tserver.remote_bootstrap import download_tablet
+        with self._create_lock:
+            with self._lock:
+                if tablet_id in self._tablets:
+                    return
+            tdir = self._tablet_dir(tablet_id)
+            if os.path.exists(os.path.join(tdir, "meta.json")):
+                with open(os.path.join(tdir, "meta.json")) as f:
+                    self._open_tablet(tablet_id, jsonutil.loads(f.read()))
+                return
+            with self._lock:
+                if tablet_id in self._rb_in_progress:
+                    return  # another thread is already downloading it
+                self._rb_in_progress.add(tablet_id)
+        # Download OUTSIDE the create lock: a multi-GB transfer must not
+        # head-of-line-block every other tablet creation on this server.
+        tmp_dir = os.path.join(self._tablets_root, f".rb-{tablet_id}")
+        try:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            os.makedirs(tmp_dir, exist_ok=True)
+            resp = download_tablet(self.messenger, source_addr, tablet_id,
+                                   tmp_dir)
+        except BaseException:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            with self._lock:
+                self._rb_in_progress.discard(tablet_id)
+            raise
+        with self._create_lock:
+            with self._lock:
+                self._rb_in_progress.discard(tablet_id)
+                if tablet_id in self._tablets:
+                    shutil.rmtree(tmp_dir, ignore_errors=True)
+                    return
+            src_meta = resp["tablet_meta"]
+            meta = {"tablet_id": tablet_id,
+                    "table_id": src_meta["table_id"],
+                    "schema": src_meta["schema"],
+                    "peer_server_ids": [p.split("/", 1)[0]
+                                        for p in resp["peer_ids"]],
+                    "partition": src_meta.get("partition"),
+                    "hash_partitioning": src_meta.get("hash_partitioning",
+                                                      True),
+                    "split_parent": src_meta.get("split_parent")}
+            # Fresh vote record at the source's term; adopting the source's
+            # votes could double-vote in an in-flight election.
+            with open(os.path.join(tmp_dir, "cmeta.json"), "w") as f:
+                f.write(jsonutil.dumps({
+                    "term": resp["term"], "voted_for": None,
+                    "peer_ids": resp["peer_ids"],
+                    "config_index": resp["config_index"]}))
+                f.flush()
+                os.fsync(f.fileno())
+            with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
+                f.write(jsonutil.dumps(meta))
+                f.flush()
+                os.fsync(f.fileno())
+            shutil.rmtree(tdir, ignore_errors=True)
+            os.rename(tmp_dir, tdir)
+            self._open_tablet(tablet_id, meta)
+        TRACE("ts %s: remote-bootstrapped tablet %s from %s",
+              self.server_id, tablet_id, source_addr)
 
     def delete_tablet(self, tablet_id: str) -> None:
         """ref TSTabletManager::DeleteTablet — shut down + remove data."""
@@ -144,13 +349,30 @@ class TSTabletManager:
             peers = list(self._tablets.items())
         report = []
         for tablet_id, peer in peers:
-            report.append({
+            entry = {
                 "tablet_id": tablet_id,
                 "role": peer.raft.role.value,
                 "term": peer.raft.current_term,
                 "leader_ready": peer.raft.is_leader() and
                 peer.raft.leader_ready(),
-            })
+                "replica_servers": [p.split("/", 1)[0]
+                                    for p in peer.raft.config.peer_ids],
+                # For stale-replica detection: a replica whose config is
+                # older than the authoritative one AND that is no longer a
+                # voter gets torn down by the master.
+                "config_index": peer.raft._meta.config_index,
+            }
+            meta = self.tablet_meta(tablet_id)
+            if meta.get("split_parent"):
+                # Enough context for the master to ADOPT a split child it
+                # has never heard of (ref tablet reports carrying
+                # split_parent_tablet_id in master_heartbeat.proto).
+                entry["split_parent"] = meta["split_parent"]
+                entry["table_id"] = meta["table_id"]
+                entry["partition"] = meta.get("partition")
+            if peer.tablet.split_children is not None:
+                entry["split_children"] = list(peer.tablet.split_children)
+            report.append(entry)
         return report
 
     def shutdown(self) -> None:
