@@ -1,0 +1,173 @@
+"""Distributed transactions over MiniCluster: cross-tablet atomicity,
+snapshot isolation, conflicts, aborts, expiry (ref: client/
+ql-transaction-test.cc over mini_cluster)."""
+
+import time
+
+import pytest
+
+from yugabyte_tpu.client.transaction import (
+    TransactionError, TransactionManager)
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING),
+             ColumnSchema("n", DataType.INT64)],
+    num_hash_key_columns=1)
+
+
+def dk(k: str) -> DocKey:
+    return DocKey(hash_components=(k,))
+
+
+def ins(k: str, v: str, n: int = 0) -> QLWriteOp:
+    return QLWriteOp(WriteOpKind.INSERT, dk(k), {"v": v, "n": n})
+
+
+def wait_for(cond, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timeout: {msg}"
+        time.sleep(0.05)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    flags.set_flag("replication_factor", 3)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path_factory.mktemp("txncluster")))).start()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def env(cluster):
+    client = cluster.new_client()
+    client.create_namespace("bank")
+    table = client.create_table("bank", "accounts", SCHEMA, num_tablets=4)
+    cluster.wait_all_replicas_running(table.table_id)
+    manager = TransactionManager(client)
+    manager.status_table()  # force creation up front
+    return cluster, client, table, manager
+
+
+def test_cross_tablet_atomic_commit(env):
+    cluster, client, table, manager = env
+    # Writes spanning multiple tablets commit atomically.
+    txn = manager.begin()
+    for i in range(8):
+        txn.write(table, [ins(f"acct{i}", "opened", 100)])
+    # Invisible to outside readers pre-commit.
+    assert client.read_row(table, dk("acct0")) is None
+    txn.commit()
+    for i in range(8):
+        row = client.read_row(table, dk(f"acct{i}"))
+        assert row is not None
+        assert row.columns[SCHEMA.column_id("v")] == "opened"
+
+
+def test_read_your_writes_and_snapshot(env):
+    cluster, client, table, manager = env
+    client.write(table, [ins("snap", "before", 1)])
+    txn = manager.begin()
+    txn.write(table, [ins("rytw", "mine", 7)])
+    row = txn.read_row(table, dk("rytw"))
+    assert row is not None and row.columns[SCHEMA.column_id("v")] == "mine"
+    # Writes committed AFTER the txn began are outside its snapshot.
+    client.write(table, [ins("snap", "after", 2)])
+    row = txn.read_row(table, dk("snap"))
+    assert row.columns[SCHEMA.column_id("v")] == "before"
+    txn.commit()
+
+
+def test_abort_discards_everything(env):
+    cluster, client, table, manager = env
+    txn = manager.begin()
+    txn.write(table, [ins("ghost1", "x")])
+    txn.write(table, [ins("ghost2", "y")])
+    txn.abort()
+    assert client.read_row(table, dk("ghost1")) is None
+    assert client.read_row(table, dk("ghost2")) is None
+    # Non-transactional writes to those keys work (intents cleaned/ignored).
+    client.write(table, [ins("ghost1", "real")])
+    assert client.read_row(
+        table, dk("ghost1")).columns[SCHEMA.column_id("v")] == "real"
+
+
+def test_write_write_conflict(env):
+    cluster, client, table, manager = env
+    t1 = manager.begin()
+    t2 = manager.begin()
+    t1.write(table, [ins("contested", "t1")])
+    with pytest.raises(TransactionError):
+        t2.write(table, [ins("contested", "t2")])
+    t1.commit()
+    t2.abort()
+    row = client.read_row(table, dk("contested"))
+    assert row.columns[SCHEMA.column_id("v")] == "t1"
+
+
+def test_snapshot_write_conflict_after_commit(env):
+    cluster, client, table, manager = env
+    t1 = manager.begin()
+    time.sleep(0.01)
+    client.write(table, [ins("si", "newer")])  # commits after t1's snapshot
+    with pytest.raises(TransactionError):
+        t1.write(table, [ins("si", "stale")])
+    t1.abort()
+
+
+def test_commit_then_intents_applied(env):
+    cluster, client, table, manager = env
+    txn = manager.begin()
+    txn.write(table, [ins("applied", "val", 3)])
+    participant = list(txn._participants)[0]
+    txn.commit()
+
+    def intents_resolved():
+        from yugabyte_tpu.docdb.intents import txn_intents
+        for ts in cluster.tservers:
+            try:
+                peer = ts.tablet_manager.get_tablet(participant)
+            except Exception:  # noqa: BLE001
+                continue
+            if txn_intents(peer.tablet.intents_db, txn.txn_id):
+                return False
+        return True
+
+    wait_for(intents_resolved, msg="intent apply fanout")
+    row = client.read_row(table, dk("applied"))
+    assert row is not None and row.columns[SCHEMA.column_id("v")] == "val"
+
+
+def test_expired_txn_aborts(env):
+    cluster, client, table, manager = env
+    flags.set_flag("transaction_timeout_ms", 300)
+    try:
+        txn = manager.begin()
+        txn._hb_stop.set()  # silence heartbeats: txn will expire
+        txn.write(table, [ins("expired", "never")])
+        time.sleep(0.6)
+        # Another writer hitting the stale intent forces status resolution,
+        # which lazily aborts the expired txn and lets the write through.
+        deadline = time.monotonic() + 20
+        while True:
+            try:
+                client.write(table, [ins("expired", "winner")])
+                break
+            except Exception:  # noqa: BLE001 — conflict until expiry seen
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+        row = client.read_row(table, dk("expired"))
+        assert row.columns[SCHEMA.column_id("v")] == "winner"
+        with pytest.raises(TransactionError):
+            txn.commit()
+    finally:
+        flags.reset_flag("transaction_timeout_ms")

@@ -1,0 +1,157 @@
+"""Tablet-level transaction mechanics: intents, conflict detection,
+read-your-writes, commit apply, abort cleanup (ref: docdb/docdb-test.cc
+transactional cases, conflict_resolution-test, randomized_docdb-test)."""
+
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import HybridTime
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.conflict_resolution import TransactionConflict
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.docdb.intents import TransactionMetadata, txn_intents
+from yugabyte_tpu.tablet.tablet import Tablet
+
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING)],
+    num_hash_key_columns=1)
+
+
+def dk(k: str) -> DocKey:
+    return DocKey(hash_components=(k,))
+
+
+def ins(k: str, v: str) -> QLWriteOp:
+    return QLWriteOp(WriteOpKind.INSERT, dk(k), {"v": v})
+
+
+@pytest.fixture
+def tablet(tmp_path):
+    statuses = {}
+    t = Tablet("t-txn", str(tmp_path / "t"), SCHEMA)
+    t.status_resolver = lambda st_tablet, txn_id, read_ht=None: statuses.get(
+        txn_id, {"status": "pending", "commit_ht": None})
+    yield t, statuses
+    t.close()
+
+
+def commit(tablet: Tablet, statuses, meta) -> HybridTime:
+    commit_ht = tablet.clock.now()
+    statuses[meta.txn_id] = {"status": "committed",
+                             "commit_ht": commit_ht.value}
+    return commit_ht
+
+
+def test_txn_write_invisible_until_commit(tablet):
+    t, statuses = tablet
+    meta = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("a", "txn-val")], meta)
+    # Plain snapshot read: pending intent is invisible.
+    assert t.read_row(dk("a")) is None
+    # Read-your-writes: the owning txn sees it.
+    row = t.read_row(dk("a"), txn_id=meta.txn_id)
+    assert row is not None and row.columns[0] == "txn-val"
+    # Commit (status only): data visible through the overlay BEFORE the
+    # intents are physically applied.
+    commit(t, statuses, meta)
+    row = t.read_row(dk("a"))
+    assert row is not None and row.columns[0] == "txn-val"
+
+
+def test_apply_moves_intents_to_regular(tablet):
+    t, statuses = tablet
+    meta = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("a", "v1"), ins("b", "v2")], meta)
+    commit_ht = commit(t, statuses, meta)
+    t.apply_txn_update("apply", meta.txn_id, commit_ht.value,
+                       t.clock.now().value, (1, 100))
+    assert txn_intents(t.intents_db, meta.txn_id) == []
+    for k, v in (("a", "v1"), ("b", "v2")):
+        row = t.read_row(dk(k))
+        assert row is not None and row.columns[0] == v
+        assert row.write_ht.value == commit_ht.value
+    # Scan sees both rows exactly once.
+    rows = list(t.scan(use_device=False))
+    assert sorted(r.doc_key.hash_components[0] for r in rows) == ["a", "b"]
+
+
+def test_abort_cleanup(tablet):
+    t, statuses = tablet
+    meta = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("a", "doomed")], meta)
+    statuses[meta.txn_id] = {"status": "aborted", "commit_ht": None}
+    t.apply_txn_update("cleanup", meta.txn_id, 0,
+                       t.clock.now().value, (1, 101))
+    assert txn_intents(t.intents_db, meta.txn_id) == []
+    assert t.read_row(dk("a")) is None
+    assert t.read_row(dk("a"), txn_id=meta.txn_id) is None
+
+
+def test_txn_conflict_with_pending_txn(tablet):
+    t, statuses = tablet
+    m1 = TransactionMetadata.new("status-tab")
+    m2 = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("hot", "one")], m1)
+    with pytest.raises(TransactionConflict):
+        t.write_transactional([ins("hot", "two")], m2)
+    # Plain writes also refuse to stomp on live intents.
+    with pytest.raises(TransactionConflict):
+        t.write([ins("hot", "plain")])
+    # Disjoint keys never conflict.
+    t.write_transactional([ins("cold", "fine")], m2)
+
+
+def test_conflict_clears_after_abort(tablet):
+    t, statuses = tablet
+    m1 = TransactionMetadata.new("status-tab")
+    m2 = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("hot", "one")], m1)
+    statuses[m1.txn_id] = {"status": "aborted", "commit_ht": None}
+    t.write_transactional([ins("hot", "two")], m2)  # no conflict now
+    commit(t, statuses, m2)
+    row = t.read_row(dk("hot"))
+    assert row is not None and row.columns[0] == "two"
+
+
+def test_snapshot_write_conflict(tablet):
+    t, statuses = tablet
+    read_ht = t.clock.now()
+    t.write([ins("k", "newer-committed")])
+    meta = TransactionMetadata.new("status-tab", read_ht=read_ht.value)
+    with pytest.raises(TransactionConflict):
+        t.write_transactional([ins("k", "stale")], meta)
+
+
+def test_same_txn_multiple_batches(tablet):
+    t, statuses = tablet
+    meta = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("x", "1")], meta)
+    t.write_transactional([ins("y", "2")], meta)   # no self-conflict
+    t.write_transactional([ins("x", "3")], meta)   # overwrite own intent
+    commit_ht = commit(t, statuses, meta)
+    t.apply_txn_update("apply", meta.txn_id, commit_ht.value,
+                       t.clock.now().value, (1, 102))
+    row = t.read_row(dk("x"))
+    assert row is not None and row.columns[0] == "3"
+    assert t.read_row(dk("y")).columns[0] == "2"
+
+
+def test_restart_preserves_unresolved_intents(tmp_path):
+    statuses = {}
+    t = Tablet("t-r", str(tmp_path / "t"), SCHEMA)
+    meta = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("a", "pending")], meta)
+    t.flush()
+    t.close()
+    t2 = Tablet("t-r", str(tmp_path / "t"), SCHEMA)
+    t2.status_resolver = lambda st, txn, read_ht=None: statuses.get(
+        txn, {"status": "pending", "commit_ht": None})
+    # 2 strong intents (liveness + value column) + 1 weak doc-key intent.
+    assert len(txn_intents(t2.intents_db, meta.txn_id)) == 3
+    assert t2.read_row(dk("a")) is None
+    commit_ht = commit(t2, statuses, meta)
+    t2.apply_txn_update("apply", meta.txn_id, commit_ht.value,
+                        t2.clock.now().value, (1, 103))
+    assert t2.read_row(dk("a")).columns[0] == "pending"
+    t2.close()

@@ -260,10 +260,14 @@ class YBClient:
                     lower_doc_key=lower, read_ht=pinned,
                     projection=list(projection) if projection else None,
                     limit=page_size)
-            except StatusError:
-                # Split/moved underneath the scan: re-route the cursor.
+            except RemoteError as e:
+                # Only split/moved/not-found are worth re-routing; other
+                # errors are deterministic and must surface immediately.
+                retryable = (e.extra.get("tablet_split")
+                             or e.extra.get("wrong_tablet")
+                             or e.status.code == Code.NOT_FOUND)
                 failures += 1
-                if failures > 8:
+                if not retryable or failures > 8:
                     raise
                 time.sleep(0.2)
                 self.meta_cache.invalidate(table.table_id)

@@ -1,0 +1,202 @@
+"""YBTransaction + TransactionManager: the client side of distributed
+transactions.
+
+Capability parity with the reference (ref: src/yb/client/transaction.h:59 —
+a transaction picks a status tablet, registers, heartbeats while live,
+attaches its metadata to every data op, tracks touched tablets, and commits
+or aborts through the coordinator; transaction_manager.h:36 — lazily
+ensures the `system.transactions` status table exists and load-balances
+transactions across its tablets).
+
+Isolation: snapshot isolation. The coordinator assigns the read point at
+transaction start; every read snapshots there and every write conflict-
+checks against it, so the transaction sees one consistent snapshot and
+fails (TransactionError, retryable) on write-write races.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+from yugabyte_tpu.client.client import YBClient, YBTable
+from yugabyte_tpu.common.hybrid_time import HybridTime
+from yugabyte_tpu.common.wire import doc_key_to_wire, row_from_wire, \
+    write_op_to_wire
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp
+from yugabyte_tpu.docdb.intents import TransactionMetadata
+from yugabyte_tpu.rpc.messenger import RemoteError
+from yugabyte_tpu.tserver.transaction_coordinator import (
+    SYSTEM_NAMESPACE, TRANSACTIONS_TABLE, TXN_STATUS_SCHEMA)
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.status import Code, Status, StatusError
+
+flags.define_flag("txn_client_heartbeat_ms", 2000,
+                  "client-side transaction heartbeat period")
+
+
+class TransactionError(StatusError):
+    """Conflict or expiry; the whole transaction should be retried."""
+
+    def __init__(self, msg: str):
+        super().__init__(Status.TryAgain(msg))
+
+
+class TransactionManager:
+    """ref client/transaction_manager.h:36"""
+
+    def __init__(self, client: YBClient, num_status_tablets: int = 2):
+        self._client = client
+        self._num_status_tablets = num_status_tablets
+        self._status_table: Optional[YBTable] = None
+        self._lock = threading.Lock()
+
+    def status_table(self) -> YBTable:
+        with self._lock:
+            if self._status_table is not None:
+                return self._status_table
+            try:
+                self._client.create_namespace(SYSTEM_NAMESPACE)
+            except RemoteError as e:
+                if e.status.code != Code.ALREADY_PRESENT:
+                    raise
+            try:
+                table = self._client.create_table(
+                    SYSTEM_NAMESPACE, TRANSACTIONS_TABLE, TXN_STATUS_SCHEMA,
+                    num_tablets=self._num_status_tablets)
+            except RemoteError as e:
+                if e.status.code != Code.ALREADY_PRESENT:
+                    raise
+                table = self._client.open_table(SYSTEM_NAMESPACE,
+                                                TRANSACTIONS_TABLE)
+            self._status_table = table
+            return table
+
+    def begin(self) -> "YBTransaction":
+        return YBTransaction(self._client, self)
+
+
+class YBTransaction:
+    """ref client/transaction.h:59"""
+
+    def __init__(self, client: YBClient, manager: TransactionManager):
+        self._client = client
+        self._manager = manager
+        self.txn_id = uuid.uuid4().bytes
+        status_table = manager.status_table()
+        dk = DocKey(hash_components=(self.txn_id,))
+        pk = status_table.partition_key_for(dk)
+        self._status_tablet = client.meta_cache.lookup_tablet(
+            status_table.table_id, pk)
+        self._status_table = status_table
+        resp = self._status_call("txn_create")
+        self.read_ht: int = resp["read_ht"]
+        self._participants: Dict[str, str] = {}  # tablet_id -> addr hint
+        self._state = "pending"
+        self._lock = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"txn-hb-{self.txn_id.hex()[:8]}")
+        self._hb_thread.start()
+
+    # ------------------------------------------------------------- plumbing
+    def _status_call(self, mth: str, **args):
+        return self._client._tablet_call(
+            self._status_table, self._status_tablet, mth,
+            txn_id=self.txn_id, **args)
+
+    def _heartbeat_loop(self) -> None:
+        period = flags.get_flag("txn_client_heartbeat_ms") / 1000.0
+        while not self._hb_stop.wait(period):
+            try:
+                self._status_call("txn_heartbeat")
+            except StatusError:
+                return  # expired/resolved; ops will surface the state
+
+    def _meta(self) -> TransactionMetadata:
+        return TransactionMetadata(self.txn_id,
+                                   self._status_tablet.tablet_id,
+                                   read_ht=self.read_ht)
+
+    def _check_pending(self) -> None:
+        with self._lock:
+            if self._state != "pending":
+                raise TransactionError(f"transaction is {self._state}")
+
+    # -------------------------------------------------------------- data ops
+    def write(self, table: YBTable, ops: Sequence[QLWriteOp]) -> None:
+        """Write provisional records; all ops must route to one tablet per
+        call (group by key like the session batcher for multi-key)."""
+        self._check_pending()
+        pk = table.partition_key_for(ops[0].doc_key)
+        tablet = self._client.meta_cache.lookup_tablet(table.table_id, pk)
+        try:
+            self._client._tablet_call(
+                table, tablet, "write", refresh_key=pk,
+                ops=[write_op_to_wire(op) for op in ops],
+                txn=self._meta().to_wire())
+        except RemoteError as e:
+            if e.extra.get("txn_conflict"):
+                raise TransactionError(e.status.message) from e
+            raise
+        self._participants.setdefault(tablet.tablet_id,
+                                      tablet.leader_addr() or "")
+
+    def read_row(self, table: YBTable, doc_key: DocKey,
+                 projection: Optional[Sequence[str]] = None):
+        """Snapshot read at the transaction's read point, seeing its own
+        provisional writes."""
+        self._check_pending()
+        pk = table.partition_key_for(doc_key)
+        tablet = self._client.meta_cache.lookup_tablet(table.table_id, pk)
+        w = self._client._tablet_call(
+            table, tablet, "read_row", refresh_key=pk,
+            doc_key=doc_key_to_wire(doc_key), read_ht=self.read_ht,
+            projection=list(projection) if projection else None,
+            txn_id=self.txn_id)
+        return row_from_wire(w)
+
+    # ------------------------------------------------------------ resolution
+    def commit(self) -> HybridTime:
+        self._check_pending()
+        self._hb_stop.set()
+        participants = [[tid, addr]
+                        for tid, addr in self._participants.items()]
+        try:
+            resp = self._status_call("txn_commit",
+                                     participants=participants)
+        except RemoteError as e:
+            with self._lock:
+                self._state = "aborted"
+            if e.status.code in (Code.EXPIRED, Code.ABORTED):
+                raise TransactionError(e.status.message) from e
+            raise
+        with self._lock:
+            self._state = "committed"
+        return HybridTime(resp["commit_ht"])
+
+    def abort(self) -> None:
+        self._hb_stop.set()
+        with self._lock:
+            if self._state != "pending":
+                return
+            self._state = "aborted"
+        participants = [[tid, addr]
+                        for tid, addr in self._participants.items()]
+        try:
+            self._status_call("txn_abort", participants=participants)
+        except StatusError:
+            pass  # expiry will clean up
+
+    def __enter__(self) -> "YBTransaction":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.commit()
+        else:
+            self.abort()

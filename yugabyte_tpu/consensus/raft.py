@@ -1040,6 +1040,15 @@ class RaftConsensus:
             return time.monotonic() < majority_time + lease_s
 
 
+    def committed_config_index(self) -> int:
+        """Index of the newest COMMITTED config entry. Stale-replica
+        eviction must key off committed configs only — an active-but-
+        uncommitted removal can still be overwritten."""
+        with self._lock:
+            eligible = [i for i in self._config_history
+                        if i <= self.commit_index]
+            return max(eligible) if eligible else 0
+
     def wal_gc_anchor(self) -> int:
         """Lowest index the WAL must retain for replication purposes. A
         leader keeps everything a lagging peer still needs; elsewhere the

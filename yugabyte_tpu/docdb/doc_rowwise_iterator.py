@@ -145,12 +145,17 @@ class DocRowwiseIterator:
     def __init__(self, db, schema: Schema, read_ht: HybridTime,
                  lower_doc_key: bytes = b"",
                  upper_doc_key: Optional[bytes] = None,
-                 projection: Optional[Sequence[int]] = None):
+                 projection: Optional[Sequence[int]] = None,
+                 entry_stream=None):
+        """entry_stream: optional pre-merged (internal_key, value) iterator
+        replacing the plain DB stream — the IntentAwareIterator overlays
+        provisional records this way (ref intent_aware_iterator.h)."""
         self._db = db
         self._schema = schema
         self._read_ht = read_ht
         self._lower = lower_doc_key
         self._upper = upper_doc_key
+        self._entry_stream = entry_stream
         self._assembler = VisibleEntryRowAssembler(
             self._resolve_visible(), schema, projection=projection)
 
@@ -175,7 +180,9 @@ class DocRowwiseIterator:
         # whole older subdocument, so either shadows older columns.
         doc_overwrite: Optional[DocHybridTime] = None
         seen_paths: set = set()
-        for ikey, raw_value in self._db.iter_from(self._lower):
+        stream = (self._entry_stream if self._entry_stream is not None
+                  else self._db.iter_from(self._lower))
+        for ikey, raw_value in stream:
             prefix, dht = split_key_and_ht(ikey)
             if dht is None:
                 continue
@@ -207,12 +214,14 @@ class DocRowwiseIterator:
 
 
 def read_row(db, schema: Schema, doc_key: DocKey, read_ht: HybridTime,
-             projection: Optional[Sequence[int]] = None) -> Optional[Row]:
+             projection: Optional[Sequence[int]] = None,
+             entry_stream=None) -> Optional[Row]:
     """Point row lookup (the QL read-one path)."""
     encoded = doc_key.encode()
     it = DocRowwiseIterator(db, schema, read_ht, lower_doc_key=encoded,
                             upper_doc_key=encoded + bytes([ValueType.kMaxByte]),
-                            projection=projection)
+                            projection=projection,
+                            entry_stream=entry_stream)
     for row in it:
         return row
     return None

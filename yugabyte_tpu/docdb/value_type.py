@@ -42,6 +42,7 @@ class ValueType(enum.IntEnum):
     kTTL = ord("t")             # 116: value control field: TTL follows
     kTransactionId = ord("x")   # 120: intent value: transaction id follows
     kWriteId = ord("w")         # 119: intent value control field
+    kIntentTypeSet = ord("O")   # 79: intent key: intent type byte follows
     kMaxByte = 0xFF
 
     @property
@@ -49,4 +50,4 @@ class ValueType(enum.IntEnum):
         return self not in (ValueType.kGroupEnd, ValueType.kHybridTime,
                             ValueType.kMergeFlags, ValueType.kTTL,
                             ValueType.kTransactionId, ValueType.kWriteId,
-                            ValueType.kMaxByte)
+                            ValueType.kIntentTypeSet, ValueType.kMaxByte)

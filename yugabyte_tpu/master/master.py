@@ -91,6 +91,15 @@ class MasterService:
     def split_tablet(self, tablet_id: str) -> List[str]:
         return self._leader_catalog().split_tablet(tablet_id)
 
+    def get_tablet_leader(self, tablet_id: str) -> Optional[str]:
+        """host:port of a tablet's current leader (transaction status
+        routing; ref master GetTabletLocations)."""
+        cm = self._leader_catalog()
+        leader = cm.tablet_leaders.get(tablet_id)
+        if leader is None:
+            return None
+        return cm.ts_manager.addr_map().get(leader[0])
+
     def list_tservers(self) -> List[dict]:
         cm = self._leader_catalog()
         return [{"server_id": d.server_id, "addr": d.addr,

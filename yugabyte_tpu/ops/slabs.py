@@ -30,6 +30,8 @@ common/doc_hybrid_time.cc:50).
 
 from __future__ import annotations
 
+import struct
+
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -147,9 +149,16 @@ def _doc_key_len(key_prefix: bytes) -> int:
     cannot appear inside components: every component encoding either escapes
     low bytes (strings escape only 0x00 — but '!' is 0x21; however string
     *content* can contain 0x21!). So we must parse, not scan.
+
+    Keys that are NOT doc keys — intent reverse-index records and other
+    system keys in the intents DB — count as one whole-key "document":
+    they never share overwrite semantics with doc paths.
     """
     from yugabyte_tpu.docdb.doc_key import DocKey
-    _, pos = DocKey.decode(key_prefix, 0)
+    try:
+        _, pos = DocKey.decode(key_prefix, 0)
+    except (ValueError, IndexError, struct.error):
+        return len(key_prefix)
     return pos
 
 

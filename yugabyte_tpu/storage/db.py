@@ -77,6 +77,14 @@ class DB:
         for fm in self.versions.live_files():
             self._readers[fm.file_id] = SSTReader(fm.path, self.opts.block_cache)
 
+    def approx_entry_count(self) -> int:
+        """Cheap emptiness probe (used to skip the intent overlay on
+        intent-free tablets). Zero means definitely empty."""
+        with self._lock:
+            if self.mem.approximate_bytes or self._imm is not None:
+                return 1
+            return len(self._readers)
+
     # ------------------------------------------------------------------ write
     def write_batch(self, items: List[Tuple[bytes, DocHybridTime, bytes]],
                     op_id: Tuple[int, int] = (0, 0)) -> None:

@@ -76,12 +76,15 @@ class LocalConsensusContext:
         self._index = 0
         self._lock = threading.Lock()
 
-    def submit(self, kv_pairs, ht: HybridTime,
-               timeout_s: float = 10.0) -> Tuple[int, int]:
+    def submit(self, kv_pairs, ht: HybridTime, timeout_s: float = 10.0,
+               target_intents: bool = False) -> Tuple[int, int]:
         with self._lock:
             self._index += 1
             op_id = (1, self._index)  # (term, index)
-        self._tablet.apply_write_batch(kv_pairs, ht, op_id)
+        if target_intents:
+            self._tablet.apply_intent_batch(kv_pairs, ht, op_id)
+        else:
+            self._tablet.apply_write_batch(kv_pairs, ht, op_id)
         return op_id
 
 
@@ -131,6 +134,10 @@ class Tablet:
         self.lock_manager = SharedLockManager()
         self.consensus = LocalConsensusContext(self)
         self.split_children = None  # (child0, child1) once split
+        # status_resolver(status_tablet, txn_id) -> {"status", "commit_ht"}
+        # — wired by the tserver to the transaction coordinator; None means
+        # conservative resolution (pending).
+        self.status_resolver = None
         # Write gate for splitting: the SPLIT op must be the last write-ish
         # entry the parent ever appends, so block_writes() drains in-flight
         # writes BEFORE the split appends (an acked write appended after the
@@ -180,6 +187,16 @@ class Tablet:
         lock_batch, kv_pairs = prepare_and_assemble(
             ops, self.schema, self.lock_manager, timeout_s=timeout_s)
         try:
+            # Even single-shard writes must not stomp on live provisional
+            # records (ref write_query.cc:429 conflict resolution for
+            # non-transactional writes). Skipped entirely while the intents
+            # DB is empty — the overwhelmingly common case.
+            if self.intents_db.approx_entry_count():
+                from yugabyte_tpu.docdb.conflict_resolution import (
+                    resolve_write_conflicts)
+                resolve_write_conflicts(self.intents_db, self.regular_db,
+                                        lock_batch.entries, None,
+                                        self.status_resolver)
             # Hybrid-time draw + registration is atomic inside MvccManager;
             # the apply itself runs concurrently across writers (each KV
             # carries its own DocHybridTime, so apply order is irrelevant)
@@ -212,6 +229,111 @@ class Tablet:
         self.regular_db.write_batch(items, op_id=op_id)
         TRACE("tablet %s applied %d kvs at %s", self.tablet_id, len(items), ht)
 
+    # ------------------------------------------------------- transactions
+    def write_transactional(self, ops: Sequence[QLWriteOp], txn_meta,
+                            timeout_s: float = 10.0) -> HybridTime:
+        """Transactional write: conflict-check, then replicate provisional
+        records into the intents DB (ref write_query.cc:464 +
+        docdb.h PrepareTransactionWriteBatch). Data becomes visible only
+        when the coordinator commits and intents apply."""
+        from yugabyte_tpu.docdb.conflict_resolution import (
+            resolve_write_conflicts)
+        from yugabyte_tpu.docdb.intents import make_intent_batch
+        with self._write_gate:
+            if self._writes_blocked or self.split_children is not None:
+                raise TabletHasBeenSplit(self.split_children or ())
+            self._inflight_writes += 1
+        try:
+            lock_batch, kv_pairs = prepare_and_assemble(
+                ops, self.schema, self.lock_manager, timeout_s=timeout_s)
+            try:
+                resolve_write_conflicts(self.intents_db, self.regular_db,
+                                        lock_batch.entries, txn_meta,
+                                        self.status_resolver)
+                intent_items = make_intent_batch(txn_meta, kv_pairs,
+                                                 lock_batch.entries)
+                ht = self.mvcc.add_pending_now()
+                try:
+                    self.consensus.submit(intent_items, ht,
+                                          timeout_s=timeout_s,
+                                          target_intents=True)
+                except OperationOutcomeUnknown:
+                    raise
+                except BaseException:
+                    self.mvcc.aborted(ht)
+                    raise
+                self.mvcc.replicated(ht)
+                return ht
+            finally:
+                lock_batch.release()
+        finally:
+            with self._write_gate:
+                self._inflight_writes -= 1
+                self._write_gate.notify_all()
+
+    def apply_intent_batch(self, kv_pairs: Sequence[Tuple[bytes, bytes]],
+                           ht: HybridTime, op_id: Tuple[int, int]) -> None:
+        """Replicated-apply of provisional records into intents_db."""
+        items = [(key, DocHybridTime(ht, write_id), value)
+                 for write_id, (key, value) in enumerate(kv_pairs)]
+        self.intents_db.write_batch(items, op_id=op_id)
+
+    def apply_txn_update(self, action: str, txn_id: bytes,
+                         commit_ht_value: int, resolution_ht_value: int,
+                         op_id: Tuple[int, int]) -> None:
+        """Replicated-apply of a transaction resolution (ref
+        tablet.cc:1670 ApplyIntents / :1735 RemoveIntents). `apply` moves
+        committed intents into regular_db at the commit hybrid time;
+        `cleanup` just tombstones them. Deterministic across replicas: all
+        hybrid times come from the raft entry."""
+        from yugabyte_tpu.docdb.intents import (
+            decode_intent_key, decode_intent_value, reverse_index_prefix,
+            txn_intents)
+        from yugabyte_tpu.docdb.lock_manager import IntentType
+        from yugabyte_tpu.docdb.value import Value
+        intents = txn_intents(self.intents_db, txn_id)
+        regular_items = []
+        tombstones = []
+        tomb = Value.tombstone().encode()
+        seq = 0
+        for intent_key, _dht, raw in intents:
+            decoded = decode_intent_key(intent_key)
+            if decoded is None:
+                continue
+            subdoc_key, itype = decoded
+            if action == "apply" and itype == IntentType.kStrongWrite:
+                _txn, _st, write_id, value_bytes = decode_intent_value(raw)
+                regular_items.append(
+                    (subdoc_key,
+                     DocHybridTime(HybridTime(commit_ht_value), write_id),
+                     value_bytes))
+            tombstones.append(
+                (intent_key,
+                 DocHybridTime(HybridTime(resolution_ht_value), seq), tomb))
+            seq += 1
+        # Reverse-index records get tombstoned too.
+        prefix = reverse_index_prefix(txn_id)
+        seen = set()
+        for ikey, raw in self.intents_db.iter_from(prefix):
+            from yugabyte_tpu.docdb.doc_key import split_key_and_ht
+            rkey, dht = split_key_and_ht(ikey)
+            if dht is None or not rkey.startswith(prefix):
+                break
+            if rkey in seen:
+                continue
+            seen.add(rkey)
+            tombstones.append(
+                (rkey, DocHybridTime(HybridTime(resolution_ht_value), seq),
+                 tomb))
+            seq += 1
+        if regular_items:
+            self.regular_db.write_batch(regular_items, op_id=op_id)
+        if tombstones:
+            self.intents_db.write_batch(tombstones, op_id=op_id)
+        TRACE("tablet %s: txn %s %s — %d applied, %d intents resolved",
+              self.tablet_id, txn_id.hex()[:8], action, len(regular_items),
+              len(tombstones))
+
     # ------------------------------------------------------------------- read
     def read_time(self, read_ht: Optional[HybridTime] = None,
                   timeout_s: float = 10.0) -> HybridTime:
@@ -223,15 +345,36 @@ class Tablet:
         return read_ht
 
     def read_row(self, doc_key: DocKey, read_ht: Optional[HybridTime] = None,
-                 projection=None) -> Optional[Row]:
+                 projection=None, txn_id: Optional[bytes] = None
+                 ) -> Optional[Row]:
         ht = self.read_time(read_ht)
         self.metric_reads.increment()
+        encoded = doc_key.encode()
+        stream = self._entry_stream(ht, encoded,
+                                    encoded + bytes([ValueType.kMaxByte]),
+                                    txn_id)
         return read_row(self.regular_db, self.schema, doc_key, ht,
-                        projection=projection)
+                        projection=projection, entry_stream=stream)
+
+    def _entry_stream(self, ht: HybridTime, lower: bytes,
+                      upper: Optional[bytes], txn_id: Optional[bytes]):
+        """Intent-aware merged stream, or None for the plain fast path when
+        no provisional records can exist (ref intent_aware_iterator.h)."""
+        from yugabyte_tpu.docdb.intent_aware_iterator import (
+            intent_overlay_entries, merged_entry_stream)
+        if txn_id is None and self.intents_db.approx_entry_count() == 0:
+            return None
+        overlay = intent_overlay_entries(
+            self.intents_db, ht, txn_id, self.status_resolver,
+            lower=lower, upper=upper)
+        if not overlay and txn_id is None:
+            return None
+        return merged_entry_stream(self.regular_db, overlay, lower=lower)
 
     def scan(self, read_ht: Optional[HybridTime] = None,
              lower_doc_key: bytes = b"", upper_doc_key: Optional[bytes] = None,
-             projection=None, use_device: Optional[bool] = None):
+             projection=None, use_device: Optional[bool] = None,
+             txn_id: Optional[bytes] = None):
         """Range scan. use_device: True forces the TPU scan kernel, False the
         CPU iterator, None auto-picks: device path only for FULL-table scans
         on a device-configured tablet — the kernel resolves the whole DB in
@@ -248,10 +391,13 @@ class Tablet:
                              if upper_doc_key is None
                              else min(upper_doc_key,
                                       self.opts.upper_bound_key))
+        stream = self._entry_stream(ht, lower_doc_key, upper_doc_key,
+                                    txn_id)
         if use_device is None:
             use_device = (self.opts.device is not None
-                          and not lower_doc_key and upper_doc_key is None)
-        if use_device:
+                          and not lower_doc_key and upper_doc_key is None
+                          and stream is None)
+        if use_device and stream is None:
             entries = self.regular_db.scan_visible(
                 ht.value, lower_doc_key or None, upper_doc_key)
             return VisibleEntryRowAssembler(entries, self.schema,
@@ -259,7 +405,8 @@ class Tablet:
         return DocRowwiseIterator(self.regular_db, self.schema, ht,
                                   lower_doc_key=lower_doc_key,
                                   upper_doc_key=upper_doc_key,
-                                  projection=projection)
+                                  projection=projection,
+                                  entry_stream=stream)
 
     # ------------------------------------------------------------ maintenance
     def flush(self) -> None:

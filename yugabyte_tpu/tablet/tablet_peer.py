@@ -32,14 +32,19 @@ from yugabyte_tpu.common.hybrid_time import HybridClock, HybridTime
 from yugabyte_tpu.common.schema import Schema
 from yugabyte_tpu.consensus.log import Log, LogReader
 from yugabyte_tpu.consensus.raft import (
-    OP_SPLIT, OP_WRITE, NotLeader, OperationOutcomeUnknown, RaftConfig,
-    RaftConsensus, ReplicateMsg, ReplicationTimedOut, Role)
+    OP_SPLIT, OP_UPDATE_TXN, OP_WRITE, NotLeader, OperationOutcomeUnknown,
+    RaftConfig, RaftConsensus, ReplicateMsg, ReplicationTimedOut, Role)
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
 
 
-def encode_write_batch(kv_pairs: Sequence[Tuple[bytes, bytes]]) -> bytes:
-    out = [struct.pack("<I", len(kv_pairs))]
+def encode_write_batch(kv_pairs: Sequence[Tuple[bytes, bytes]],
+                       target_intents: bool = False) -> bytes:
+    """Leading flag byte routes the batch: 0 -> regular DB, 1 -> intents DB
+    (the reference splits these into separate WriteBatch sections,
+    ref tablet.cc:1198 ApplyKeyValueRowOperations)."""
+    out = [b"\x01" if target_intents else b"\x00",
+           struct.pack("<I", len(kv_pairs))]
     for k, v in kv_pairs:
         out.append(struct.pack("<I", len(k)))
         out.append(k)
@@ -48,9 +53,11 @@ def encode_write_batch(kv_pairs: Sequence[Tuple[bytes, bytes]]) -> bytes:
     return b"".join(out)
 
 
-def decode_write_batch(payload: bytes) -> List[Tuple[bytes, bytes]]:
-    (n,) = struct.unpack_from("<I", payload)
-    off = 4
+def decode_write_batch(payload: bytes
+                       ) -> Tuple[List[Tuple[bytes, bytes]], bool]:
+    target_intents = payload[0] == 1
+    (n,) = struct.unpack_from("<I", payload, 1)
+    off = 5
     pairs = []
     for _ in range(n):
         (kl,) = struct.unpack_from("<I", payload, off)
@@ -61,7 +68,7 @@ def decode_write_batch(payload: bytes) -> List[Tuple[bytes, bytes]]:
         off += 4
         pairs.append((k, payload[off:off + vl]))
         off += vl
-    return pairs
+    return pairs, target_intents
 
 
 class RaftWriteContext:
@@ -71,9 +78,9 @@ class RaftWriteContext:
     def __init__(self, peer: "TabletPeer"):
         self._peer = peer
 
-    def submit(self, kv_pairs, ht: HybridTime,
-               timeout_s: float = 30.0) -> Tuple[int, int]:
-        payload = encode_write_batch(kv_pairs)
+    def submit(self, kv_pairs, ht: HybridTime, timeout_s: float = 30.0,
+               target_intents: bool = False) -> Tuple[int, int]:
+        payload = encode_write_batch(kv_pairs, target_intents)
         try:
             return self._peer.raft.replicate(OP_WRITE, ht.value, payload,
                                              timeout_s=timeout_s)
@@ -164,14 +171,23 @@ class TabletPeer:
     # ---------------------------------------------------------------- apply
     def _apply_replicated(self, msg: ReplicateMsg) -> None:
         if msg.op_type == OP_WRITE:
-            kv_pairs = decode_write_batch(msg.payload)
+            kv_pairs, target_intents = decode_write_batch(msg.payload)
             ht = HybridTime(msg.ht_value)
-            self.tablet.apply_write_batch(kv_pairs, ht, msg.op_id)
+            if target_intents:
+                self.tablet.apply_intent_batch(kv_pairs, ht, msg.op_id)
+            else:
+                self.tablet.apply_write_batch(kv_pairs, ht, msg.op_id)
             if not self.raft.is_leader():
                 # Followers advance replication watermark directly; the
                 # leader's MvccManager drains via replicated() in write().
                 self.clock.update(ht)
                 self.tablet.mvcc.set_last_replicated(ht)
+        elif msg.op_type == OP_UPDATE_TXN:
+            import json as _json
+            info = _json.loads(msg.payload)
+            self.tablet.apply_txn_update(
+                info["action"], bytes.fromhex(info["txn_id"]),
+                info.get("commit_ht") or 0, msg.ht_value, msg.op_id)
         elif msg.op_type == OP_SPLIT:
             # Applied at the same log position on every replica, after all
             # preceding writes and before nothing (the parent rejects writes
@@ -198,9 +214,19 @@ class TabletPeer:
         try:
             return self.raft.replicate(OP_SPLIT, self.clock.now().value,
                                        payload, timeout_s=timeout_s)
+        except ReplicationTimedOut as e:
+            # Fate unknown: the SPLIT may still commit, so writes MUST stay
+            # blocked (an acked write appended after a committing SPLIT
+            # would exist only in the soon-retired parent). Unblock only if
+            # the entry is eventually overwritten.
+            self.raft.watch_fate(
+                e.op_id,
+                on_committed=lambda: None,  # apply sets split_children
+                on_aborted=self.tablet.unblock_writes)
+            raise
         except BaseException:
-            # Split did not take: let writes flow again (followers only
-            # block via split_children, set at apply).
+            # Entry definitively not in the log (NotLeader before append)
+            # or overwritten (ReplicationAborted): safe to resume writes.
             self.tablet.unblock_writes()
             raise
 
@@ -228,10 +254,12 @@ class TabletPeer:
             time.sleep(0.002)
 
     def read_row(self, doc_key, read_ht: Optional[HybridTime] = None,
-                 projection=None, allow_follower: bool = False):
+                 projection=None, allow_follower: bool = False,
+                 txn_id: Optional[bytes] = None):
         if self.raft.is_leader():
             self.check_leader_lease()
-            return self.tablet.read_row(doc_key, read_ht, projection)
+            return self.tablet.read_row(doc_key, read_ht, projection,
+                                        txn_id=txn_id)
         if not allow_follower:
             raise NotLeader(self.raft.leader_hint())
         if read_ht is not None:
@@ -249,6 +277,27 @@ class TabletPeer:
         if not self.raft.is_leader():
             raise NotLeader(self.raft.leader_hint())
         return self.tablet.write(ops, timeout_s=timeout_s)
+
+    def write_transactional(self, ops, txn_meta,
+                            timeout_s: float = 30.0) -> HybridTime:
+        if not self.raft.is_leader():
+            raise NotLeader(self.raft.leader_hint())
+        return self.tablet.write_transactional(ops, txn_meta,
+                                               timeout_s=timeout_s)
+
+    def submit_txn_update(self, action: str, txn_id: bytes,
+                          commit_ht_value: int = 0,
+                          timeout_s: float = 30.0):
+        """Replicate a transaction resolution through this tablet's Raft
+        group (ref transaction_participant.cc apply/cleanup tasks riding
+        UpdateTransaction operations)."""
+        import json as _json
+        if not self.raft.is_leader():
+            raise NotLeader(self.raft.leader_hint())
+        payload = _json.dumps({"action": action, "txn_id": txn_id.hex(),
+                               "commit_ht": commit_ht_value}).encode()
+        return self.raft.replicate(OP_UPDATE_TXN, self.clock.now().value,
+                                   payload, timeout_s=timeout_s)
 
     # ----------------------------------------------------------- background
     def flush_and_gc_wal(self) -> int:

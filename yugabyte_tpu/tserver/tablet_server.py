@@ -54,8 +54,15 @@ class TabletServer:
             opts.server_id, opts.fs_root, self.transport, clock=self.clock,
             tablet_options_factory=opts.tablet_options_factory,
             metrics=self.metrics, messenger=self.messenger)
+        from yugabyte_tpu.tserver.transaction_coordinator import (
+            TransactionCoordinator)
+        self.coordinator = TransactionCoordinator(
+            leader_resolver=self.lookup_tablet_leader,
+            messenger=self.messenger)
+        self.tablet_manager.status_resolver = self.resolve_txn_status
         self.service = TabletServiceImpl(self.tablet_manager,
-                                         addr_updater=self.update_addr_map)
+                                         addr_updater=self.update_addr_map,
+                                         coordinator=self.coordinator)
         self.messenger.register_service(TABLET_SERVICE, self.service)
         self.heartbeater = Heartbeater(
             self.messenger, opts.master_addrs, opts.server_id, self.address,
@@ -80,6 +87,58 @@ class TabletServer:
     def update_addr_map(self, addr_map: Dict[str, str]) -> None:
         with self._addr_lock:
             self._addr_map.update(addr_map)
+
+    # ------------------------------------------------ transaction plumbing
+    def lookup_tablet_leader(self, tablet_id: str) -> Optional[str]:
+        """Best-effort leader address for any tablet in the cluster: local
+        raft state first, then the master's leader map."""
+        from yugabyte_tpu.utils.status import StatusError
+        try:
+            peer = self.tablet_manager.get_tablet(tablet_id)
+            if peer.raft.is_leader():
+                return self.address
+            hint = peer.raft.leader_hint()
+            if hint:
+                addr = self._resolve_peer(hint)
+                if addr:
+                    return addr
+        except StatusError:
+            pass
+        for maddr in self.opts.master_addrs:
+            try:
+                return self.messenger.call(maddr, "master",
+                                           "get_tablet_leader",
+                                           timeout_s=3.0,
+                                           tablet_id=tablet_id)
+            except StatusError:
+                continue
+        return None
+
+    def resolve_txn_status(self, status_tablet: str, txn_id: bytes,
+                           read_ht: Optional[int] = None) -> dict:
+        """Status resolver wired into every hosted data tablet (ref
+        TransactionStatusResolver). Conservative on any failure: a pending
+        answer never exposes uncommitted data. read_ht (the reader's
+        snapshot) floors any later commit above it via the coordinator's
+        clock."""
+        from yugabyte_tpu.utils.status import StatusError
+        try:
+            peer = self.tablet_manager.get_tablet(status_tablet)
+            if peer.raft.is_leader():
+                return self.coordinator.status(peer, txn_id, read_ht)
+        except StatusError:
+            pass
+        addr = self.lookup_tablet_leader(status_tablet)
+        if addr is None:
+            return {"status": "pending", "commit_ht": None}
+        try:
+            return self.messenger.call(addr, "tserver", "txn_status",
+                                       timeout_s=5.0,
+                                       tablet_id=status_tablet,
+                                       txn_id=txn_id,
+                                       observing_read_ht=read_ht)
+        except StatusError:
+            return {"status": "pending", "commit_ht": None}
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "TabletServer":

@@ -33,13 +33,25 @@ def _leader_server_hint(e: NotLeader) -> Optional[str]:
 
 
 class TabletServiceImpl:
-    def __init__(self, tablet_manager: TSTabletManager, addr_updater=None):
+    def __init__(self, tablet_manager: TSTabletManager, addr_updater=None,
+                 coordinator=None):
         self._tablets = tablet_manager
         self._addr_updater = addr_updater or (lambda m: None)
+        self.coordinator = coordinator
+
+    def _leader_peer(self, tablet_id: str):
+        peer = self._tablets.get_tablet(tablet_id)
+        if not peer.raft.is_leader():
+            raise NotLeaderError(_leader_server_hint(
+                NotLeader(peer.raft.leader_hint())))
+        return peer
 
     # ---------------------------------------------------------------- writes
     def write(self, tablet_id: str, ops: List[dict],
-              timeout_s: float = 15.0) -> dict:
+              timeout_s: float = 15.0, txn: Optional[dict] = None) -> dict:
+        from yugabyte_tpu.docdb.conflict_resolution import (
+            TransactionConflict)
+        from yugabyte_tpu.docdb.intents import TransactionMetadata
         from yugabyte_tpu.tablet.tablet import TabletHasBeenSplit
         peer = self._tablets.get_tablet(tablet_id)
         decoded = [write_op_from_wire(w) for w in ops]
@@ -58,7 +70,16 @@ class TabletServiceImpl:
                     err.extra = {"wrong_tablet": True}
                     raise err
         try:
-            ht = peer.write(decoded, timeout_s=timeout_s)
+            if txn is not None:
+                ht = peer.write_transactional(
+                    decoded, TransactionMetadata.from_wire(txn),
+                    timeout_s=timeout_s)
+            else:
+                ht = peer.write(decoded, timeout_s=timeout_s)
+        except TransactionConflict as e:
+            err = StatusError(Status.TryAgain(str(e)))
+            err.extra = {"txn_conflict": True}
+            raise err from e
         except NotLeader as e:
             raise NotLeaderError(_leader_server_hint(e)) from e
         except TabletHasBeenSplit as e:
@@ -73,14 +94,15 @@ class TabletServiceImpl:
     def read_row(self, tablet_id: str, doc_key: dict,
                  read_ht: Optional[int] = None,
                  projection: Optional[List[str]] = None,
-                 allow_follower: bool = False) -> Optional[dict]:
+                 allow_follower: bool = False,
+                 txn_id: Optional[bytes] = None) -> Optional[dict]:
         peer = self._tablets.get_tablet(tablet_id)
         try:
             row = peer.read_row(
                 doc_key_from_wire(doc_key),
                 HybridTime(read_ht) if read_ht else None,
                 projection=tuple(projection) if projection else None,
-                allow_follower=allow_follower)
+                allow_follower=allow_follower, txn_id=txn_id)
         except NotLeader as e:
             raise NotLeaderError(_leader_server_hint(e)) from e
         return None if row is None else row_to_wire(row)
@@ -177,6 +199,54 @@ class TabletServiceImpl:
             return True  # idempotent retry
         except ConfigChangeInProgress as e:
             raise StatusError(Status.TryAgain(str(e))) from e
+        return True
+
+    # ------------------------------------------- transaction coordinator
+    # (status-tablet ops; ref transaction_coordinator.h. The RPC layer
+    # leader-checks, the coordinator serializes check-and-set per txn.)
+    def txn_create(self, tablet_id: str, txn_id: bytes) -> dict:
+        return self.coordinator.create(self._leader_peer(tablet_id), txn_id)
+
+    def txn_heartbeat(self, tablet_id: str, txn_id: bytes) -> bool:
+        return self.coordinator.heartbeat(self._leader_peer(tablet_id),
+                                          txn_id)
+
+    def txn_status(self, tablet_id: str, txn_id: bytes,
+                   observing_read_ht: Optional[int] = None) -> dict:
+        return self.coordinator.status(self._leader_peer(tablet_id), txn_id,
+                                       observing_read_ht)
+
+    def txn_commit(self, tablet_id: str, txn_id: bytes,
+                   participants: List[List]) -> dict:
+        return self.coordinator.commit(self._leader_peer(tablet_id), txn_id,
+                                       participants)
+
+    def txn_abort(self, tablet_id: str, txn_id: bytes,
+                  participants: List[List]) -> bool:
+        return self.coordinator.abort(self._leader_peer(tablet_id), txn_id,
+                                      participants)
+
+    # ----------------------------------------- transaction participant
+    def apply_transaction(self, tablet_id: str, txn_id: bytes,
+                          commit_ht: int) -> bool:
+        """Move committed intents into the regular DB (ref
+        tablet.cc:1670 ApplyIntents, raft-replicated)."""
+        from yugabyte_tpu.consensus.raft import NotLeader as NL
+        try:
+            self._leader_peer(tablet_id).submit_txn_update(
+                "apply", txn_id, commit_ht)
+        except NL as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
+        return True
+
+    def cleanup_transaction(self, tablet_id: str, txn_id: bytes,
+                            commit_ht: int = 0) -> bool:
+        from yugabyte_tpu.consensus.raft import NotLeader as NL
+        try:
+            self._leader_peer(tablet_id).submit_txn_update(
+                "cleanup", txn_id, 0)
+        except NL as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
         return True
 
     def split_tablet(self, tablet_id: str) -> List[str]:

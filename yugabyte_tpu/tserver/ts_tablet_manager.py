@@ -42,6 +42,9 @@ class TSTabletManager:
         self._tablets: Dict[str, TabletPeer] = {}
         self._meta: Dict[str, dict] = {}  # tablet_id -> superblock dict
         self._rb_in_progress: set = set()
+        # Wired by the TabletServer after construction; tablets call it to
+        # resolve foreign transaction statuses at read time.
+        self.status_resolver = None
         self._lock = threading.Lock()
         # Serializes whole tablet creations: two concurrent (retried /
         # reconciler-raced) create_tablet RPCs must never both open a
@@ -107,12 +110,7 @@ class TSTabletManager:
                     "partition": partition_wire,
                     "hash_partitioning": hash_partitioning}
             os.makedirs(tdir, exist_ok=True)
-            tmp = meta_path + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(jsonutil.dumps(meta))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, meta_path)
+            jsonutil.write_atomic(meta_path, meta)
             self._open_tablet(tablet_id, meta)
         TRACE("ts %s: created tablet %s (table %s)",
               self.server_id, tablet_id, table_id)
@@ -138,6 +136,13 @@ class TSTabletManager:
             transport=self.transport, clock=self.clock,
             options=options,
             metrics=self.metrics)
+        # Late-bound status resolver (assigned on the manager after
+        # construction): conservative pending when unset.
+        peer.tablet.status_resolver = (
+            lambda st, txn, read_ht=None:
+            self.status_resolver(st, txn, read_ht)
+            if self.status_resolver is not None
+            else {"status": "pending", "commit_ht": None})
         # Closure over peer+meta: during bootstrap replay the parent is not
         # yet in self._tablets, so the hook must not look it up.
         peer.on_split = (
@@ -166,13 +171,8 @@ class TSTabletManager:
                 return
             meta["peer_server_ids"] = server_ids
             snapshot = dict(meta)
-        meta_path = os.path.join(self._tablet_dir(tablet_id), "meta.json")
-        tmp = meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(jsonutil.dumps(snapshot))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, meta_path)
+        jsonutil.write_atomic(
+            os.path.join(self._tablet_dir(tablet_id), "meta.json"), snapshot)
 
     # ----------------------------------------------------------- splitting
     def _create_split_children(self, parent, parent_meta: dict,
@@ -214,10 +214,8 @@ class TSTabletManager:
                         "hash_partitioning": parent_meta.get(
                             "hash_partitioning", True),
                         "split_parent": parent_id}
-                with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
-                    f.write(jsonutil.dumps(meta))
-                    f.flush()
-                    os.fsync(f.fileno())
+                jsonutil.write_atomic(os.path.join(tmp_dir, "meta.json"),
+                                      meta)
                 shutil.rmtree(cdir, ignore_errors=True)
                 os.rename(tmp_dir, cdir)
                 self._open_tablet(child_id, meta)
@@ -297,17 +295,12 @@ class TSTabletManager:
                     "split_parent": src_meta.get("split_parent")}
             # Fresh vote record at the source's term; adopting the source's
             # votes could double-vote in an in-flight election.
-            with open(os.path.join(tmp_dir, "cmeta.json"), "w") as f:
-                f.write(jsonutil.dumps({
-                    "term": resp["term"], "voted_for": None,
-                    "peer_ids": resp["peer_ids"],
-                    "config_index": resp["config_index"]}))
-                f.flush()
-                os.fsync(f.fileno())
-            with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
-                f.write(jsonutil.dumps(meta))
-                f.flush()
-                os.fsync(f.fileno())
+            jsonutil.write_atomic(
+                os.path.join(tmp_dir, "cmeta.json"),
+                {"term": resp["term"], "voted_for": None,
+                 "peer_ids": resp["peer_ids"],
+                 "config_index": resp["config_index"]})
+            jsonutil.write_atomic(os.path.join(tmp_dir, "meta.json"), meta)
             shutil.rmtree(tdir, ignore_errors=True)
             os.rename(tmp_dir, tdir)
             self._open_tablet(tablet_id, meta)
@@ -359,8 +352,9 @@ class TSTabletManager:
                                     for p in peer.raft.config.peer_ids],
                 # For stale-replica detection: a replica whose config is
                 # older than the authoritative one AND that is no longer a
-                # voter gets torn down by the master.
-                "config_index": peer.raft._meta.config_index,
+                # voter gets torn down by the master. COMMITTED configs
+                # only — an uncommitted removal may yet be overwritten.
+                "config_index": peer.raft.committed_config_index(),
             }
             meta = self.tablet_meta(tablet_id)
             if meta.get("split_parent"):

@@ -36,3 +36,20 @@ def dumps(obj, **kw) -> str:
 
 def loads(s: str):
     return unjsonable(json.loads(s))
+
+
+def write_atomic(path: str, obj) -> None:
+    """Write-fsync-rename of a JSON document (superblocks, consensus
+    metadata sidecars)."""
+    import os
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(dumps(obj))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_file(path: str):
+    with open(path) as f:
+        return loads(f.read())
