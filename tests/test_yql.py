@@ -1,0 +1,267 @@
+"""Query-layer tests: YCQL parser/executor/server + Redis RESP server over
+a MiniCluster (ref: cql_test_base.cc suites; redisserver-test.cc)."""
+
+import socket
+import time
+
+import pytest
+
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.yql.cql import parser as P
+from yugabyte_tpu.yql.cql.executor import QLProcessor
+from yugabyte_tpu.yql.cql.server import CQLServer
+from yugabyte_tpu.yql.redis.server import RedisServer
+
+
+# ---------------------------------------------------------------- parser
+def test_parser_create_table():
+    s = P.parse("CREATE TABLE ks.users (id TEXT, age BIGINT, name TEXT, "
+                "PRIMARY KEY ((id), age)) WITH tablets = 8")
+    assert s.keyspace == "ks" and s.name == "users"
+    assert s.hash_keys == ["id"] and s.range_keys == ["age"]
+    assert s.num_tablets == 8
+
+
+def test_parser_inline_pk_and_literals():
+    s = P.parse("CREATE TABLE t (k TEXT PRIMARY KEY, v BIGINT)")
+    assert s.hash_keys == ["k"] and s.range_keys == []
+    i = P.parse("INSERT INTO t (k, v) VALUES ('it''s', -42) USING TTL 5")
+    assert i.values == ["it's", -42] and i.ttl_seconds == 5
+    sel = P.parse("SELECT v FROM t WHERE k = ? AND v >= 3 LIMIT 10")
+    assert sel.where[0][2] is P.MARKER and sel.limit == 10
+
+
+def test_parser_transaction_block():
+    t = P.parse("BEGIN TRANSACTION "
+                "INSERT INTO t (k, v) VALUES ('a', 1); "
+                "UPDATE t SET v = 2 WHERE k = 'b'; "
+                "END TRANSACTION")
+    assert len(t.statements) == 2
+
+
+def test_parser_errors():
+    with pytest.raises(P.ParseError):
+        P.parse("CREATE TABLE t (v BIGINT)")  # no primary key
+    with pytest.raises(P.ParseError):
+        P.parse("SELEC * FROM t")
+
+
+# ----------------------------------------------------------- integration
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    flags.set_flag("replication_factor", 3)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path_factory.mktemp("yqlcluster")))).start()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ql(cluster):
+    client = cluster.new_client()
+    p = QLProcessor(client)
+    p.execute("CREATE KEYSPACE store")
+    p.execute("USE store")
+    p.execute("CREATE TABLE items (cat TEXT, sku TEXT, price BIGINT, "
+              "name TEXT, PRIMARY KEY ((cat), sku)) WITH tablets = 2")
+    return p
+
+
+def test_cql_insert_select_point(ql):
+    ql.execute("INSERT INTO items (cat, sku, price, name) "
+               "VALUES ('fruit', 'a1', 150, 'apple')")
+    ql.execute("INSERT INTO items (cat, sku, price, name) "
+               "VALUES ('fruit', 'b2', 300, 'berry')")
+    rs = ql.execute("SELECT name, price FROM items "
+                    "WHERE cat = 'fruit' AND sku = 'a1'")
+    assert rs.rows == [["apple", 150]]
+
+
+def test_cql_bind_params(ql):
+    ql.execute("INSERT INTO items (cat, sku, price, name) "
+               "VALUES (?, ?, ?, ?)", ["veg", "c3", 80, "carrot"])
+    rs = ql.execute("SELECT name FROM items WHERE cat = ? AND sku = ?",
+                    ["veg", "c3"])
+    assert rs.rows == [["carrot"]]
+
+
+def test_cql_partition_and_filter_select(ql):
+    rs = ql.execute("SELECT sku FROM items WHERE cat = 'fruit'")
+    assert sorted(r[0] for r in rs.rows) == ["a1", "b2"]
+    rs = ql.execute("SELECT name FROM items WHERE price > 100")
+    assert sorted(r[0] for r in rs.rows) == ["apple", "berry"]
+
+
+def test_cql_update_bind_order(ql):
+    ql.execute("INSERT INTO items (cat, sku, price, name) "
+               "VALUES ('bind', 'z9', 1, 'thing')")
+    # Markers bind in statement-text order: SET first, then WHERE.
+    ql.execute("UPDATE items SET price = ? WHERE cat = ? AND sku = ?",
+               [777, "bind", "z9"])
+    rs = ql.execute("SELECT price FROM items WHERE cat = 'bind' "
+                    "AND sku = 'z9'")
+    assert rs.rows == [[777]]
+
+
+def test_cql_blob_literal(ql):
+    ql.execute("CREATE TABLE blobs (k TEXT PRIMARY KEY, data BLOB)")
+    ql.execute("INSERT INTO blobs (k, data) VALUES ('b', 0xDEADBEEF)")
+    rs = ql.execute("SELECT data FROM blobs WHERE k = 'b'")
+    assert rs.rows == [[bytes.fromhex("deadbeef")]]
+
+
+def test_redis_hash_key_visibility(redis):
+    redis.cmd("HSET", "hexists", "f", "v")
+    assert redis.cmd("EXISTS", "hexists") == 1
+    assert b"hexists" in redis.cmd("KEYS", "*")
+    # Arity errors return a RESP error and must not kill the connection.
+    with pytest.raises(RuntimeError, match="wrong number of arguments"):
+        redis.cmd("GET")
+    assert redis.cmd("PING") == "PONG"  # connection still alive
+
+
+def test_cql_update_delete(ql):
+    ql.execute("UPDATE items SET price = 200 "
+               "WHERE cat = 'fruit' AND sku = 'a1'")
+    rs = ql.execute("SELECT price FROM items "
+                    "WHERE cat = 'fruit' AND sku = 'a1'")
+    assert rs.rows == [[200]]
+    ql.execute("DELETE FROM items WHERE cat = 'veg' AND sku = 'c3'")
+    rs = ql.execute("SELECT * FROM items WHERE cat = 'veg' AND sku = 'c3'")
+    assert rs.rows == []
+
+
+def test_cql_transaction_block(ql):
+    ql.execute("BEGIN TRANSACTION "
+               "INSERT INTO items (cat, sku, price, name) "
+               "VALUES ('txn', 't1', 1, 'one'); "
+               "INSERT INTO items (cat, sku, price, name) "
+               "VALUES ('txn', 't2', 2, 'two'); "
+               "END TRANSACTION")
+    rs = ql.execute("SELECT sku FROM items WHERE cat = 'txn'")
+    assert sorted(r[0] for r in rs.rows) == ["t1", "t2"]
+
+
+def test_cql_server_rpc(cluster):
+    server = CQLServer(cluster.master_addrs())
+    try:
+        client_m = cluster.new_client()._messenger
+        call = lambda mth, **kw: client_m.call(  # noqa: E731
+            server.address, "cql", mth, **kw)
+        call("execute", stmt="CREATE KEYSPACE IF NOT EXISTS wire")
+        call("execute", stmt="USE wire", session="s1")
+        call("execute", session="s1",
+             stmt="CREATE TABLE kv (k TEXT PRIMARY KEY, v TEXT)")
+        call("execute", session="s1",
+             stmt="INSERT INTO kv (k, v) VALUES ('hello', 'world')")
+        out = call("execute", session="s1",
+                   stmt="SELECT v FROM kv WHERE k = 'hello'")
+        assert out["rows"] == [["world"]]
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------- redis
+class RedisCli:
+    """Minimal RESP client for tests."""
+
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port))
+        self.f = self.sock.makefile("rb")
+
+    def cmd(self, *args):
+        parts = [b"*%d\r\n" % len(args)]
+        for a in args:
+            if isinstance(a, str):
+                a = a.encode()
+            parts.append(b"$%d\r\n%s\r\n" % (len(a), a))
+        self.sock.sendall(b"".join(parts))
+        return self._read()
+
+    def _read(self):
+        line = self.f.readline()[:-2]
+        t, body = line[:1], line[1:]
+        if t == b"+":
+            return body.decode()
+        if t == b"-":
+            raise RuntimeError(body.decode())
+        if t == b":":
+            return int(body)
+        if t == b"$":
+            n = int(body)
+            if n < 0:
+                return None
+            data = self.f.read(n + 2)[:-2]
+            return data
+        if t == b"*":
+            n = int(body)
+            if n < 0:
+                return None
+            return [self._read() for _ in range(n)]
+        raise RuntimeError(f"bad RESP type {t!r}")
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def redis(cluster):
+    server = RedisServer(cluster.new_client(), num_tablets=2)
+    cli = RedisCli(server.host, server.port)
+    yield cli
+    cli.close()
+    server.shutdown()
+
+
+def test_redis_ping_echo(redis):
+    assert redis.cmd("PING") == "PONG"
+    assert redis.cmd("ECHO", "hey") == b"hey"
+
+
+def test_redis_set_get_del(redis):
+    assert redis.cmd("SET", "k1", "v1") == "OK"
+    assert redis.cmd("GET", "k1") == b"v1"
+    assert redis.cmd("GET", "nope") is None
+    assert redis.cmd("EXISTS", "k1", "nope") == 1
+    assert redis.cmd("DEL", "k1") == 1
+    assert redis.cmd("GET", "k1") is None
+
+
+def test_redis_mset_mget(redis):
+    assert redis.cmd("MSET", "a", "1", "b", "2") == "OK"
+    assert redis.cmd("MGET", "a", "b", "missing") == [b"1", b"2", None]
+
+
+def test_redis_incr(redis):
+    assert redis.cmd("INCR", "counter") == 1
+    assert redis.cmd("INCRBY", "counter", "10") == 11
+    assert redis.cmd("DECR", "counter") == 10
+
+
+def test_redis_hashes(redis):
+    assert redis.cmd("HSET", "user:1", "name", "ada", "age", "36") == 2
+    assert redis.cmd("HGET", "user:1", "name") == b"ada"
+    assert redis.cmd("HMGET", "user:1", "age", "ghost") == [b"36", None]
+    all_kv = redis.cmd("HGETALL", "user:1")
+    assert dict(zip(all_kv[::2], all_kv[1::2])) == \
+        {b"name": b"ada", b"age": b"36"}
+    assert redis.cmd("HLEN", "user:1") == 2
+    assert redis.cmd("HDEL", "user:1", "age") == 1
+    assert redis.cmd("HGET", "user:1", "age") is None
+
+
+def test_redis_binary_safety(redis):
+    blob = bytes(range(256))
+    assert redis.cmd("SET", b"bin\x00key", blob) == "OK"
+    assert redis.cmd("GET", b"bin\x00key") == blob
+
+
+def test_redis_keys(redis):
+    redis.cmd("FLUSHALL")
+    redis.cmd("MSET", "x", "1", "y", "2")
+    keys = redis.cmd("KEYS", "*")
+    assert sorted(keys) == [b"x", b"y"]
+    assert redis.cmd("DBSIZE") == 2

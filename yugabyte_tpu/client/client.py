@@ -284,6 +284,46 @@ class YBClient:
                 return
             cursor = tablet.partition.end
 
+    def scan_key_range(self, table: YBTable, partition_key: bytes,
+                       lower_doc_key: bytes,
+                       upper_doc_key: Optional[bytes] = None,
+                       read_ht: Optional[HybridTime] = None,
+                       page_size: int = 4096):
+        """Paged scan of one doc-key range within the tablet owning
+        partition_key (prefix reads: all fields of one document family,
+        e.g. a redis hash's subkeys)."""
+        pinned = read_ht.value if read_ht else None
+        lower = lower_doc_key
+        failures = 0
+        while True:
+            tablet = self.meta_cache.lookup_tablet(table.table_id,
+                                                   partition_key)
+            try:
+                resp = self._tablet_call(
+                    table, tablet, "scan", refresh_key=partition_key,
+                    lower_doc_key=lower, upper_doc_key=upper_doc_key,
+                    read_ht=pinned, limit=page_size)
+            except RemoteError as e:
+                # Same split/moved re-route as scan(): resume from the
+                # current doc-key bound after a refresh.
+                retryable = (e.extra.get("tablet_split")
+                             or e.extra.get("wrong_tablet")
+                             or e.status.code == Code.NOT_FOUND)
+                failures += 1
+                if not retryable or failures > 8:
+                    raise
+                time.sleep(0.2)
+                self.meta_cache.invalidate(table.table_id)
+                continue
+            failures = 0
+            if pinned is None:
+                pinned = resp.get("read_ht")
+            for w in resp["rows"]:
+                yield row_from_wire(w)
+            if not resp.get("resume_key"):
+                return
+            lower = resp["resume_key"]
+
     def close(self) -> None:
         if self._owns_messenger:
             self._messenger.shutdown()

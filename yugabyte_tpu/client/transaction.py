@@ -114,8 +114,13 @@ class YBTransaction:
         while not self._hb_stop.wait(period):
             try:
                 self._status_call("txn_heartbeat")
+            except RemoteError as e:
+                if e.status.code in (Code.EXPIRED, Code.ABORTED,
+                                     Code.ILLEGAL_STATE):
+                    return  # txn resolved; ops will surface the state
+                # transient (leader move etc.): keep beating
             except StatusError:
-                return  # expired/resolved; ops will surface the state
+                continue  # retry-exhaustion during failover: keep beating
 
     def _meta(self) -> TransactionMetadata:
         return TransactionMetadata(self.txn_id,
@@ -144,7 +149,7 @@ class YBTransaction:
                 raise TransactionError(e.status.message) from e
             raise
         self._participants.setdefault(tablet.tablet_id,
-                                      tablet.leader_addr() or "")
+                                      tablet.leader_addr())
 
     def read_row(self, table: YBTable, doc_key: DocKey,
                  projection: Optional[Sequence[str]] = None):

@@ -61,6 +61,14 @@ def resolve_write_conflicts(
             st = status_of(txn_id, status_tablet)
             if st["status"] == "aborted":
                 continue  # dead intent awaiting cleanup
+            if st["status"] == "committed":
+                # Committed data, just not applied yet: overwriting is fine
+                # unless it commits AFTER our snapshot (same rule as the
+                # regular newer-committed-write check below).
+                cht = st.get("commit_ht")
+                if meta is None or meta.read_ht is None or \
+                        (cht is not None and cht <= meta.read_ht):
+                    continue
             raise TransactionConflict(
                 f"conflicts with txn {txn_id.hex()[:8]} "
                 f"({st['status']}) at {subdoc_key.hex()[:24]}")

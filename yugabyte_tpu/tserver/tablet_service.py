@@ -41,9 +41,13 @@ class TabletServiceImpl:
 
     def _leader_peer(self, tablet_id: str):
         peer = self._tablets.get_tablet(tablet_id)
-        if not peer.raft.is_leader():
-            raise NotLeaderError(_leader_server_hint(
-                NotLeader(peer.raft.leader_hint())))
+        try:
+            # Lease-checked, not just is_leader(): a deposed leader behind a
+            # partition must not serve (stale txn statuses would tear
+            # snapshots; ref leader_lease.h).
+            peer.check_leader_lease()
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
         return peer
 
     # ---------------------------------------------------------------- writes

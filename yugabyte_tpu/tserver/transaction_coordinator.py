@@ -72,6 +72,12 @@ class TransactionCoordinator:
         with self._mutexes_lock:
             return self._mutexes.setdefault(txn_id, threading.Lock())
 
+    def _drop_mutex(self, txn_id: bytes) -> None:
+        """Terminal states prune the per-txn mutex (unbounded otherwise);
+        a racing late op simply recreates it."""
+        with self._mutexes_lock:
+            self._mutexes.pop(txn_id, None)
+
     @staticmethod
     def _key(txn_id: bytes) -> DocKey:
         return DocKey(hash_components=(txn_id,))
@@ -127,13 +133,17 @@ class TransactionCoordinator:
         if rec["status"] == "pending":
             timeout = flags.get_flag("transaction_timeout_ms")
             if _now_ms() - (rec["heartbeat_ms"] or 0) > timeout:
-                try:
-                    self.abort(peer, txn_id, [])
-                except StatusError:
+                # Expiry check + abort under the txn mutex: a concurrent
+                # heartbeat renewal must not be stomped by a stale-read
+                # abort decision.
+                with self._txn_mutex(txn_id):
                     rec = self._read(peer, txn_id) or rec
-                    return {"status": rec["status"],
-                            "commit_ht": rec["commit_ht"]}
-                return {"status": "aborted", "commit_ht": None}
+                    if (rec["status"] == "pending"
+                            and _now_ms() - (rec["heartbeat_ms"] or 0)
+                            > timeout):
+                        self._abort_locked(peer, txn_id, [], rec)
+                        self._drop_mutex(txn_id)
+                        return {"status": "aborted", "commit_ht": None}
         return {"status": rec["status"], "commit_ht": rec["commit_ht"]}
 
     def commit(self, peer, txn_id: bytes,
@@ -159,25 +169,32 @@ class TransactionCoordinator:
                  "participants": json.dumps(participants)})])
         self._notify_async(txn_id, "apply_transaction", participants,
                            commit_ht.value)
+        self._drop_mutex(txn_id)
         return {"commit_ht": commit_ht.value}
 
     def abort(self, peer, txn_id: bytes,
               participants: List[List]) -> bool:
-        import json
         with self._txn_mutex(txn_id):
             rec = self._read(peer, txn_id)
-            if rec is not None and rec["status"] == "committed":
-                raise StatusError(Status.IllegalState(
-                    f"txn {txn_id.hex()[:8]} already committed"))
-            if rec is not None and not participants and \
-                    rec.get("participants"):
-                participants = json.loads(rec["participants"])
-            peer.write([QLWriteOp(
-                WriteOpKind.INSERT, self._key(txn_id),
-                {"status": "aborted",
-                 "participants": json.dumps(participants or [])})])
-        self._notify_async(txn_id, "cleanup_transaction", participants, 0)
+            self._abort_locked(peer, txn_id, participants, rec)
+        self._drop_mutex(txn_id)
         return True
+
+    def _abort_locked(self, peer, txn_id: bytes,
+                      participants: List[List],
+                      rec: Optional[dict]) -> None:
+        import json
+        if rec is not None and rec["status"] == "committed":
+            raise StatusError(Status.IllegalState(
+                f"txn {txn_id.hex()[:8]} already committed"))
+        if rec is not None and not participants and \
+                rec.get("participants"):
+            participants = json.loads(rec["participants"])
+        peer.write([QLWriteOp(
+            WriteOpKind.INSERT, self._key(txn_id),
+            {"status": "aborted",
+             "participants": json.dumps(participants or [])})])
+        self._notify_async(txn_id, "cleanup_transaction", participants, 0)
 
     # -------------------------------------------------- participant fanout
     def _notify_async(self, txn_id: bytes, mth: str,

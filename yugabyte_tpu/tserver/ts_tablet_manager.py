@@ -82,9 +82,7 @@ class TSTabletManager:
                                          "meta.json")
                 if not os.path.exists(meta_path):
                     continue
-                with open(meta_path) as f:
-                    meta = jsonutil.loads(f.read())
-                self._open_tablet(tablet_id, meta)
+                self._open_tablet(tablet_id, jsonutil.read_file(meta_path))
             opened += 1
         return opened
 
@@ -101,8 +99,7 @@ class TSTabletManager:
             tdir = self._tablet_dir(tablet_id)
             meta_path = os.path.join(tdir, "meta.json")
             if os.path.exists(meta_path):
-                with open(meta_path) as f:
-                    self._open_tablet(tablet_id, jsonutil.loads(f.read()))
+                self._open_tablet(tablet_id, jsonutil.read_file(meta_path))
                 return
             meta = {"tablet_id": tablet_id, "table_id": table_id,
                     "schema": schema_wire,
@@ -194,8 +191,8 @@ class TSTabletManager:
                         continue
                 cdir = self._tablet_dir(child_id)
                 if os.path.exists(os.path.join(cdir, "meta.json")):
-                    with open(os.path.join(cdir, "meta.json")) as f:
-                        self._open_tablet(child_id, jsonutil.loads(f.read()))
+                    self._open_tablet(child_id, jsonutil.read_file(
+                        os.path.join(cdir, "meta.json")))
                     continue
                 tmp_dir = os.path.join(self._tablets_root,
                                        f".split-{child_id}")
@@ -257,8 +254,9 @@ class TSTabletManager:
                     return
             tdir = self._tablet_dir(tablet_id)
             if os.path.exists(os.path.join(tdir, "meta.json")):
-                with open(os.path.join(tdir, "meta.json")) as f:
-                    self._open_tablet(tablet_id, jsonutil.loads(f.read()))
+                self._open_tablet(
+                    tablet_id,
+                    jsonutil.read_file(os.path.join(tdir, "meta.json")))
                 return
             with self._lock:
                 if tablet_id in self._rb_in_progress:

@@ -1,0 +1,2 @@
+"""Query layers: YCQL-subset SQL and Redis-compatible servers
+(ref: src/yb/yql — cql/ and redis/ trees)."""

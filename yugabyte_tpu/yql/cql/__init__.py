@@ -1,0 +1,3 @@
+from yugabyte_tpu.yql.cql.executor import QLProcessor, ResultSet
+
+__all__ = ["QLProcessor", "ResultSet"]

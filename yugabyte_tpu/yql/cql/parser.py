@@ -1,0 +1,378 @@
+"""YCQL-subset parser: hand-written tokenizer + recursive descent.
+
+Capability parity with the reference's CQL frontend (ref: src/yb/yql/cql/ql/
+parser/ — a bison grammar over the full CQL dialect; ptree/ analyzer). This
+covers the core DML/DDL surface (the YCSB / kv-workload subset plus
+multi-statement transactions): CREATE KEYSPACE / CREATE TABLE with
+hash+range primary keys / DROP TABLE / INSERT (USING TTL) / SELECT with
+WHERE + LIMIT / UPDATE / DELETE / BEGIN TRANSACTION ... END TRANSACTION.
+Bind markers (?) fill from an ordered params list, like the reference's
+prepared statements.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+from yugabyte_tpu.utils.status import Status, StatusError
+
+
+class ParseError(StatusError):
+    def __init__(self, msg: str):
+        super().__init__(Status.InvalidArgument(f"syntax error: {msg}"))
+
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<string>'(?:[^']|'')*')
+    | (?P<blob>0[xX][0-9a-fA-F]+)
+    | (?P<number>-?\d+\.\d+|-?\d+)
+    | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<op><=|>=|!=|[=<>(),;*?.])
+    )""", re.VERBOSE)
+
+
+def tokenize(text: str) -> List[Tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise ParseError(f"unexpected character {text[pos]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        out.append((kind, m.group(kind)))
+    return out
+
+
+# --------------------------------------------------------------- statements
+@dataclass
+class CreateKeyspace:
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class CreateTable:
+    keyspace: Optional[str]
+    name: str
+    columns: List[Tuple[str, str]]            # (name, cql type)
+    hash_keys: List[str]
+    range_keys: List[str]
+    num_tablets: int = 4
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable:
+    keyspace: Optional[str]
+    name: str
+
+
+@dataclass
+class Insert:
+    keyspace: Optional[str]
+    table: str
+    columns: List[str]
+    values: List[object]
+    ttl_seconds: Optional[int] = None
+
+
+@dataclass
+class Select:
+    keyspace: Optional[str]
+    table: str
+    columns: Optional[List[str]]              # None = *
+    where: List[Tuple[str, str, object]] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class Update:
+    keyspace: Optional[str]
+    table: str
+    assignments: List[Tuple[str, object]]
+    where: List[Tuple[str, str, object]]
+    ttl_seconds: Optional[int] = None
+
+
+@dataclass
+class Delete:
+    keyspace: Optional[str]
+    table: str
+    where: List[Tuple[str, str, object]]
+    columns: Optional[List[str]] = None       # DELETE col FROM ...
+
+
+@dataclass
+class Transaction:
+    statements: List[Union[Insert, Update, Delete]]
+
+
+@dataclass
+class UseKeyspace:
+    name: str
+
+
+Statement = Union[CreateKeyspace, CreateTable, DropTable, Insert, Select,
+                  Update, Delete, Transaction, UseKeyspace]
+
+
+class _Marker:
+    """A `?` bind marker awaiting a parameter."""
+
+
+MARKER = _Marker()
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.toks = tokenize(text)
+        self.pos = 0
+
+    # ------------------------------------------------------------- helpers
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.toks[self.pos] if self.pos < len(self.toks) else None
+
+    def next(self) -> Tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise ParseError("unexpected end of statement")
+        self.pos += 1
+        return tok
+
+    def accept_kw(self, *words: str) -> bool:
+        tok = self.peek()
+        if tok and tok[0] == "name" and tok[1].upper() == words[0]:
+            save = self.pos
+            for i, w in enumerate(words):
+                tok = self.peek()
+                if not (tok and tok[0] == "name" and tok[1].upper() == w):
+                    self.pos = save
+                    return False
+                self.pos += 1
+            return True
+        return False
+
+    def expect_kw(self, *words: str) -> None:
+        if not self.accept_kw(*words):
+            raise ParseError(f"expected {' '.join(words)}, got {self.peek()}")
+
+    def accept_op(self, op: str) -> bool:
+        tok = self.peek()
+        if tok and tok[0] == "op" and tok[1] == op:
+            self.pos += 1
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r}, got {self.peek()}")
+
+    def name(self) -> str:
+        tok = self.next()
+        if tok[0] != "name":
+            raise ParseError(f"expected identifier, got {tok[1]!r}")
+        return tok[1]
+
+    def qualified_name(self) -> Tuple[Optional[str], str]:
+        first = self.name()
+        if self.accept_op("."):
+            return first, self.name()
+        return None, first
+
+    def literal(self):
+        tok = self.next()
+        kind, text = tok
+        if kind == "string":
+            return text[1:-1].replace("''", "'")
+        if kind == "number":
+            return float(text) if "." in text else int(text)
+        if kind == "blob":
+            return bytes.fromhex(text[2:])
+        if kind == "op" and text == "?":
+            return MARKER
+        if kind == "name":
+            u = text.upper()
+            if u == "TRUE":
+                return True
+            if u == "FALSE":
+                return False
+            if u == "NULL":
+                return None
+        raise ParseError(f"expected literal, got {text!r}")
+
+    # ----------------------------------------------------------- statements
+    def parse(self) -> Statement:
+        if self.accept_kw("CREATE", "KEYSPACE"):
+            ine = self.accept_kw("IF", "NOT", "EXISTS")
+            return CreateKeyspace(self.name(), ine)
+        if self.accept_kw("CREATE", "TABLE"):
+            return self._create_table()
+        if self.accept_kw("DROP", "TABLE"):
+            ks, name = self.qualified_name()
+            return DropTable(ks, name)
+        if self.accept_kw("USE"):
+            return UseKeyspace(self.name())
+        if self.accept_kw("INSERT", "INTO"):
+            return self._insert()
+        if self.accept_kw("SELECT"):
+            return self._select()
+        if self.accept_kw("UPDATE"):
+            return self._update()
+        if self.accept_kw("DELETE"):
+            return self._delete()
+        if self.accept_kw("BEGIN", "TRANSACTION"):
+            return self._transaction()
+        raise ParseError(f"unrecognized statement start: {self.peek()}")
+
+    def _create_table(self) -> CreateTable:
+        ine = self.accept_kw("IF", "NOT", "EXISTS")
+        ks, name = self.qualified_name()
+        self.expect_op("(")
+        columns: List[Tuple[str, str]] = []
+        hash_keys: List[str] = []
+        range_keys: List[str] = []
+        while True:
+            if self.accept_kw("PRIMARY", "KEY"):
+                self.expect_op("(")
+                if self.accept_op("("):   # ((h1, h2), r1, ...)
+                    hash_keys.append(self.name())
+                    while self.accept_op(","):
+                        hash_keys.append(self.name())
+                    self.expect_op(")")
+                else:
+                    hash_keys.append(self.name())
+                while self.accept_op(","):
+                    range_keys.append(self.name())
+                self.expect_op(")")
+            else:
+                cname = self.name()
+                ctype = self.name()
+                columns.append((cname, ctype))
+                if self.accept_kw("PRIMARY", "KEY"):
+                    hash_keys.append(cname)
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        num_tablets = 4
+        if self.accept_kw("WITH"):
+            while True:
+                prop = self.name().lower()
+                self.expect_op("=")
+                val = self.literal()
+                if prop == "tablets":
+                    num_tablets = int(val)
+                if not self.accept_kw("AND"):
+                    break
+        if not hash_keys:
+            raise ParseError("no PRIMARY KEY defined")
+        return CreateTable(ks, name, columns, hash_keys, range_keys,
+                           num_tablets, ine)
+
+    def _insert(self) -> Insert:
+        ks, table = self.qualified_name()
+        self.expect_op("(")
+        cols = [self.name()]
+        while self.accept_op(","):
+            cols.append(self.name())
+        self.expect_op(")")
+        self.expect_kw("VALUES")
+        self.expect_op("(")
+        vals = [self.literal()]
+        while self.accept_op(","):
+            vals.append(self.literal())
+        self.expect_op(")")
+        ttl = None
+        if self.accept_kw("USING", "TTL"):
+            ttl = int(self.literal())
+        if len(cols) != len(vals):
+            raise ParseError(f"{len(cols)} columns but {len(vals)} values")
+        return Insert(ks, table, cols, vals, ttl)
+
+    def _select(self) -> Select:
+        if self.accept_op("*"):
+            cols = None
+        else:
+            cols = [self.name()]
+            while self.accept_op(","):
+                cols.append(self.name())
+        self.expect_kw("FROM")
+        ks, table = self.qualified_name()
+        where = self._where() if self.accept_kw("WHERE") else []
+        limit = None
+        if self.accept_kw("LIMIT"):
+            limit = int(self.literal())
+        self.accept_kw("ALLOW", "FILTERING")
+        return Select(ks, table, cols, where, limit)
+
+    def _where(self) -> List[Tuple[str, str, object]]:
+        conds = []
+        while True:
+            col = self.name()
+            tok = self.next()
+            if tok[0] != "op" or tok[1] not in ("=", "<", ">", "<=", ">=",
+                                                "!="):
+                raise ParseError(f"expected comparison, got {tok[1]!r}")
+            conds.append((col, tok[1], self.literal()))
+            if not self.accept_kw("AND"):
+                return conds
+
+    def _update(self) -> Update:
+        ks, table = self.qualified_name()
+        ttl = None
+        if self.accept_kw("USING", "TTL"):
+            ttl = int(self.literal())
+        self.expect_kw("SET")
+        assignments = []
+        while True:
+            col = self.name()
+            self.expect_op("=")
+            assignments.append((col, self.literal()))
+            if not self.accept_op(","):
+                break
+        self.expect_kw("WHERE")
+        return Update(ks, table, assignments, self._where(), ttl)
+
+    def _delete(self) -> Delete:
+        cols = None
+        if not (self.peek() and self.peek()[0] == "name"
+                and self.peek()[1].upper() == "FROM"):
+            cols = [self.name()]
+            while self.accept_op(","):
+                cols.append(self.name())
+        self.expect_kw("FROM")
+        ks, table = self.qualified_name()
+        self.expect_kw("WHERE")
+        return Delete(ks, table, self._where(), cols)
+
+    def _transaction(self) -> Transaction:
+        stmts: List[Union[Insert, Update, Delete]] = []
+        while True:
+            if self.accept_kw("END", "TRANSACTION"):
+                break
+            if self.accept_op(";"):
+                continue
+            if self.accept_kw("INSERT", "INTO"):
+                stmts.append(self._insert())
+            elif self.accept_kw("UPDATE"):
+                stmts.append(self._update())
+            elif self.accept_kw("DELETE"):
+                stmts.append(self._delete())
+            else:
+                raise ParseError(
+                    f"only DML allowed in transactions, got {self.peek()}")
+        return Transaction(stmts)
+
+
+def parse(text: str) -> Statement:
+    p = Parser(text)
+    stmt = p.parse()
+    p.accept_op(";")
+    if p.peek() is not None:
+        raise ParseError(f"trailing tokens: {p.peek()}")
+    return stmt

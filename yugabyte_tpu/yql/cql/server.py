@@ -1,0 +1,79 @@
+"""CQLServer: the network face of the YCQL layer.
+
+The reference speaks the CQL binary protocol v4 (ref: src/yb/yql/cql/
+cqlserver/ — CQLServer cql_server.h:58, CQLProcessor cql_processor.h:63,
+prepared-statement cache in cql_service.cc). Here the wire is the
+framework's own RPC codec — service "cql" with execute/batch calls carrying
+statement text + bind params — because every in-framework client already
+speaks it; the statement surface and execution semantics are the parser/
+executor's (yql/cql/parser.py, executor.py), shared with any future binary
+protocol front end. Per-session keyspace state keys off a client-supplied
+session id, like the reference's per-connection processors.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from yugabyte_tpu.client.client import YBClient
+from yugabyte_tpu.client.transaction import TransactionManager
+from yugabyte_tpu.rpc.messenger import Messenger
+from yugabyte_tpu.yql.cql.executor import QLProcessor
+
+CQL_SERVICE = "cql"
+
+
+class CQLServiceImpl:
+    def __init__(self, client: YBClient):
+        self._client = client
+        self._txns = TransactionManager(client)
+        self._processors: Dict[str, QLProcessor] = {}
+        self._lock = threading.Lock()
+
+    def _processor(self, session: str) -> QLProcessor:
+        with self._lock:
+            p = self._processors.get(session)
+            if p is None:
+                p = QLProcessor(self._client, self._txns)
+                self._processors[session] = p
+            return p
+
+    def execute(self, stmt: str, params: Optional[List] = None,
+                session: str = "") -> dict:
+        rs = self._processor(session).execute(stmt, params or [])
+        return {"columns": rs.columns, "rows": rs.rows}
+
+    def batch(self, stmts: List[str], session: str = "") -> int:
+        p = self._processor(session)
+        for s in stmts:
+            p.execute(s)
+        return len(stmts)
+
+
+class CQLServer:
+    """Standalone CQL endpoint: own messenger + a YBClient to the cluster
+    (the reference runs the cqlserver inside the tserver process; here it
+    can also ride a tserver's messenger via `attach`)."""
+
+    def __init__(self, master_addrs: List[str],
+                 bind_host: str = "127.0.0.1", port: int = 0):
+        self.messenger = Messenger("cqlserver", bind_host=bind_host,
+                                   port=port)
+        self.client = YBClient(master_addrs, messenger=self.messenger)
+        self.service = CQLServiceImpl(self.client)
+        self.messenger.register_service(CQL_SERVICE, self.service)
+
+    @property
+    def address(self) -> str:
+        return self.messenger.address
+
+    @staticmethod
+    def attach(messenger: Messenger, client: YBClient) -> CQLServiceImpl:
+        """Register the CQL service on an existing server's messenger."""
+        svc = CQLServiceImpl(client)
+        messenger.register_service(CQL_SERVICE, svc)
+        return svc
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()

@@ -1,0 +1,3 @@
+from yugabyte_tpu.yql.redis.server import RedisServer
+
+__all__ = ["RedisServer"]

@@ -1,0 +1,82 @@
+"""RESP2 wire protocol: the real Redis framing.
+
+Capability parity with the reference's parser (ref: src/yb/yql/redis/
+redisserver/redis_parser.cc — inline and multi-bulk command forms;
+responses as simple strings, errors, integers, bulk and arrays). Any
+redis-cli / standard client library speaks this.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class ProtocolError(Exception):
+    pass
+
+
+class Reader:
+    """Incremental command reader over a socket file object."""
+
+    def __init__(self, sock):
+        self._f = sock.makefile("rb")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def _line(self) -> bytes:
+        line = self._f.readline()
+        if not line:
+            raise ConnectionError("client closed")
+        if not line.endswith(b"\r\n"):
+            raise ProtocolError("line without CRLF")
+        return line[:-2]
+
+    def read_command(self) -> Optional[List[bytes]]:
+        """One command as a list of byte arguments; None on clean EOF."""
+        try:
+            line = self._line()
+        except ConnectionError:
+            return None
+        if not line:
+            return []
+        if line[0:1] == b"*":
+            n = int(line[1:])
+            args = []
+            for _ in range(n):
+                hdr = self._line()
+                if hdr[0:1] != b"$":
+                    raise ProtocolError(f"expected bulk, got {hdr!r}")
+                size = int(hdr[1:])
+                data = self._f.read(size + 2)
+                if len(data) != size + 2:
+                    raise ConnectionError("short read")
+                args.append(data[:-2])
+            return args
+        # Inline command form (ref redis_parser.cc inline support).
+        return line.split()
+
+
+def simple(s: str) -> bytes:
+    return b"+" + s.encode() + b"\r\n"
+
+
+def error(msg: str) -> bytes:
+    return b"-ERR " + msg.encode() + b"\r\n"
+
+
+def integer(n: int) -> bytes:
+    return b":" + str(n).encode() + b"\r\n"
+
+
+def bulk(data: Optional[bytes]) -> bytes:
+    if data is None:
+        return b"$-1\r\n"
+    return b"$" + str(len(data)).encode() + b"\r\n" + data + b"\r\n"
+
+
+def array(items: Optional[List[bytes]]) -> bytes:
+    """items are already-encoded RESP values."""
+    if items is None:
+        return b"*-1\r\n"
+    return b"*" + str(len(items)).encode() + b"\r\n" + b"".join(items)

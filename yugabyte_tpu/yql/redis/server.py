@@ -1,0 +1,391 @@
+"""Redis-compatible server over the doc store.
+
+Capability parity with the reference (ref: src/yb/yql/redis/redisserver/ —
+redis_service.cc command dispatch, redis_commands.cc command table,
+redis_rpc.cc RESP framing; data modeled in DocDB via redis_operation.cc).
+Data model here:
+
+- strings: table `redis.strings` — key BINARY (hash pk) -> value BINARY
+- hashes:  table `redis.hashes`  — (key BINARY hash pk, field BINARY range)
+           -> value BINARY; one redis hash = one document family sharing a
+           hash bucket, so HGETALL is a single-tablet prefix scan (the same
+           layout trick as the reference's subdocument encoding).
+
+Counters (INCR/DECR) run as snapshot-isolated transactions with conflict
+retry, giving the reference's per-key atomicity. TTLs ride the doc store's
+native value TTLs (SET ... EX / SETEX / EXPIRE-as-rewrite).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional
+
+from yugabyte_tpu.client.client import YBClient, YBTable
+from yugabyte_tpu.client.transaction import (
+    TransactionError, TransactionManager)
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.rpc.messenger import RemoteError
+from yugabyte_tpu.utils.status import Code, StatusError
+from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.yql.redis import resp
+
+REDIS_KEYSPACE = "redis"
+
+STR_SCHEMA = Schema(
+    columns=[ColumnSchema("key", DataType.BINARY),
+             ColumnSchema("value", DataType.BINARY)],
+    num_hash_key_columns=1)
+
+HASH_SCHEMA = Schema(
+    columns=[ColumnSchema("key", DataType.BINARY),
+             ColumnSchema("field", DataType.BINARY),
+             ColumnSchema("value", DataType.BINARY)],
+    num_hash_key_columns=1, num_range_key_columns=1)
+
+
+class RedisServer:
+    def __init__(self, client: YBClient, bind_host: str = "127.0.0.1",
+                 port: int = 0, num_tablets: int = 4):
+        self._client = client
+        self._txns = TransactionManager(client)
+        self._ensure_tables(num_tablets)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind_host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._shutdown = False
+        self._conns: List[socket.socket] = []
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="redis-accept").start()
+
+    def _ensure_tables(self, num_tablets: int) -> None:
+        try:
+            self._client.create_namespace(REDIS_KEYSPACE)
+        except (StatusError, RemoteError) as e:
+            if getattr(e, "status", None) and \
+                    e.status.code != Code.ALREADY_PRESENT:
+                raise
+        for name, schema in (("strings", STR_SCHEMA),
+                             ("hashes", HASH_SCHEMA)):
+            try:
+                self._client.create_table(REDIS_KEYSPACE, name, schema,
+                                          num_tablets=num_tablets)
+            except (StatusError, RemoteError) as e:
+                if getattr(e, "status", None) and \
+                        e.status.code != Code.ALREADY_PRESENT:
+                    raise
+        self._strings = self._client.open_table(REDIS_KEYSPACE, "strings")
+        self._hashes = self._client.open_table(REDIS_KEYSPACE, "hashes")
+        self._val_str = STR_SCHEMA.column_id("value")
+        self._val_hash = HASH_SCHEMA.column_id("value")
+
+    # --------------------------------------------------------------- serving
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn, _peer = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            threading.Thread(target=self._serve, args=(conn,), daemon=True,
+                             name="redis-conn").start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        reader = resp.Reader(conn)
+        try:
+            while True:
+                cmd = reader.read_command()
+                if cmd is None:
+                    return
+                if not cmd:
+                    continue
+                name = cmd[0].decode("utf-8", "replace").upper()
+                handler = getattr(self, f"cmd_{name.lower()}", None)
+                try:
+                    if handler is None:
+                        out = resp.error(f"unknown command '{name}'")
+                    else:
+                        out = handler(cmd[1:])
+                    if name == "QUIT":
+                        conn.sendall(out)
+                        return
+                except (StatusError, RemoteError) as e:
+                    out = resp.error(str(e))
+                except IndexError:
+                    out = resp.error(
+                        f"wrong number of arguments for '{name.lower()}'")
+                except (ValueError, TypeError) as e:
+                    out = resp.error(str(e))
+                conn.sendall(out)
+        except (ConnectionError, resp.ProtocolError, OSError):
+            pass
+        finally:
+            reader.close()
+            conn.close()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._listener.close()
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -------------------------------------------------------------- helpers
+    @staticmethod
+    def _str_key(key: bytes) -> DocKey:
+        return DocKey(hash_components=(key,))
+
+    @staticmethod
+    def _hash_key(key: bytes, field: bytes) -> DocKey:
+        return DocKey(hash_components=(key,), range_components=(field,))
+
+    def _get(self, key: bytes) -> Optional[bytes]:
+        row = self._client.read_row(self._strings, self._str_key(key))
+        return None if row is None else row.columns.get(self._val_str)
+
+    def _set(self, key: bytes, value: bytes,
+             ttl_ms: Optional[int] = None) -> None:
+        self._client.write(self._strings, [QLWriteOp(
+            WriteOpKind.INSERT, self._str_key(key), {"value": value},
+            ttl_ms=ttl_ms)])
+
+    def _hash_fields(self, key: bytes):
+        """All (field, value) of one redis hash: single-tablet prefix scan
+        over the shared hash bucket."""
+        dk = DocKey(hash_components=(key,))
+        encoded = dk.encode()
+        prefix = encoded[:-1]  # open the range group: all fields follow
+        pk = self._hashes.partition_key_for(dk)
+        for row in self._client.scan_key_range(
+                self._hashes, pk, prefix, prefix + b"\xff"):
+            if row.doc_key.hash_components != (key,):
+                continue
+            yield (row.doc_key.range_components[0],
+                   row.columns.get(self._val_hash))
+
+    # ------------------------------------------------------------- commands
+    def cmd_ping(self, args):
+        return resp.bulk(args[0]) if args else resp.simple("PONG")
+
+    def cmd_echo(self, args):
+        return resp.bulk(args[0])
+
+    def cmd_quit(self, args):
+        return resp.simple("OK")
+
+    def cmd_select(self, args):
+        return resp.simple("OK")
+
+    def cmd_command(self, args):
+        return resp.array([])
+
+    def cmd_config(self, args):
+        return resp.array([])
+
+    def cmd_set(self, args):
+        if len(args) < 2:
+            return resp.error("wrong number of arguments for 'set'")
+        key, value = args[0], args[1]
+        ttl_ms = None
+        i = 2
+        while i < len(args):
+            opt = args[i].upper()
+            if opt == b"EX":
+                ttl_ms = int(args[i + 1]) * 1000
+                i += 2
+            elif opt == b"PX":
+                ttl_ms = int(args[i + 1])
+                i += 2
+            else:
+                return resp.error(f"unsupported SET option {opt!r}")
+        self._set(key, value, ttl_ms)
+        return resp.simple("OK")
+
+    def cmd_setex(self, args):
+        self._set(args[0], args[2], int(args[1]) * 1000)
+        return resp.simple("OK")
+
+    def cmd_get(self, args):
+        return resp.bulk(self._get(args[0]))
+
+    def cmd_mset(self, args):
+        if len(args) % 2:
+            return resp.error("wrong number of arguments for 'mset'")
+        for i in range(0, len(args), 2):
+            self._set(args[i], args[i + 1])
+        return resp.simple("OK")
+
+    def cmd_mget(self, args):
+        return resp.array([resp.bulk(self._get(k)) for k in args])
+
+    def _key_exists(self, key: bytes) -> bool:
+        if self._get(key) is not None:
+            return True
+        return next(iter(self._hash_fields(key)), None) is not None
+
+    def cmd_exists(self, args):
+        return resp.integer(sum(1 for k in args if self._key_exists(k)))
+
+    def cmd_del(self, args):
+        n = 0
+        for key in args:
+            if self._get(key) is not None:
+                self._client.write(self._strings, [QLWriteOp(
+                    WriteOpKind.DELETE_ROW, self._str_key(key))])
+                n += 1
+            fields = list(self._hash_fields(key))
+            if fields:
+                self._client.write(self._hashes, [
+                    QLWriteOp(WriteOpKind.DELETE_ROW,
+                              self._hash_key(key, f))
+                    for f, _v in fields])
+                n += 1
+        return resp.integer(n)
+
+    cmd_unlink = cmd_del
+
+    def cmd_expire(self, args):
+        value = self._get(args[0])
+        if value is None:
+            return resp.integer(0)
+        self._set(args[0], value, int(args[1]) * 1000)
+        return resp.integer(1)
+
+    def cmd_ttl(self, args):
+        # TTLs are enforced by the doc store; remaining time is not
+        # surfaced through the row API (reference returns it from the
+        # value's control fields) — report "no expiry info".
+        return resp.integer(-1 if self._get(args[0]) is not None else -2)
+
+    def _incr_by(self, key: bytes, delta: int):
+        for _ in range(16):
+            txn = self._txns.begin()
+            try:
+                row = txn.read_row(self._strings, self._str_key(key))
+                cur = 0
+                if row is not None:
+                    raw = row.columns.get(self._val_str) or b"0"
+                    cur = int(raw)
+                new = cur + delta
+                txn.write(self._strings, [QLWriteOp(
+                    WriteOpKind.INSERT, self._str_key(key),
+                    {"value": str(new).encode()})])
+                txn.commit()
+                return resp.integer(new)
+            except TransactionError:
+                txn.abort()
+            except BaseException:
+                # e.g. non-integer value: abort, or the heartbeating txn
+                # would pin its intents.
+                txn.abort()
+                raise
+        return resp.error("INCR conflict retries exhausted")
+
+    def cmd_incr(self, args):
+        return self._incr_by(args[0], 1)
+
+    def cmd_incrby(self, args):
+        return self._incr_by(args[0], int(args[1]))
+
+    def cmd_decr(self, args):
+        return self._incr_by(args[0], -1)
+
+    def cmd_decrby(self, args):
+        return self._incr_by(args[0], -int(args[1]))
+
+    # --------------------------------------------------------------- hashes
+    def cmd_hset(self, args):
+        if len(args) < 3 or len(args) % 2 == 0:
+            return resp.error("wrong number of arguments for 'hset'")
+        key = args[0]
+        added = 0
+        ops = []
+        for i in range(1, len(args), 2):
+            field, value = args[i], args[i + 1]
+            if self._client.read_row(self._hashes,
+                                     self._hash_key(key, field)) is None:
+                added += 1
+            ops.append(QLWriteOp(WriteOpKind.INSERT,
+                                 self._hash_key(key, field),
+                                 {"value": value}))
+        self._client.write(self._hashes, ops)
+        return resp.integer(added)
+
+    cmd_hmset = cmd_hset
+
+    def cmd_hget(self, args):
+        row = self._client.read_row(self._hashes,
+                                    self._hash_key(args[0], args[1]))
+        return resp.bulk(None if row is None
+                         else row.columns.get(self._val_hash))
+
+    def cmd_hmget(self, args):
+        key = args[0]
+        out = []
+        for field in args[1:]:
+            row = self._client.read_row(self._hashes,
+                                        self._hash_key(key, field))
+            out.append(resp.bulk(None if row is None
+                                 else row.columns.get(self._val_hash)))
+        return resp.array(out)
+
+    def cmd_hdel(self, args):
+        key = args[0]
+        n = 0
+        for field in args[1:]:
+            if self._client.read_row(self._hashes,
+                                     self._hash_key(key, field)) is not None:
+                self._client.write(self._hashes, [QLWriteOp(
+                    WriteOpKind.DELETE_ROW, self._hash_key(key, field))])
+                n += 1
+        return resp.integer(n)
+
+    def cmd_hgetall(self, args):
+        out = []
+        for field, value in self._hash_fields(args[0]):
+            out.append(resp.bulk(field))
+            out.append(resp.bulk(value))
+        return resp.array(out)
+
+    def cmd_hlen(self, args):
+        return resp.integer(sum(1 for _ in self._hash_fields(args[0])))
+
+    # ----------------------------------------------------------------- misc
+    def _all_keys(self):
+        keys = {row.doc_key.hash_components[0]
+                for row in self._client.scan(self._strings)}
+        keys.update(row.doc_key.hash_components[0]
+                    for row in self._client.scan(self._hashes))
+        return keys
+
+    def cmd_keys(self, args):
+        if args and args[0] not in (b"*",):
+            return resp.error("only KEYS * is supported")
+        return resp.array([resp.bulk(k) for k in sorted(self._all_keys())])
+
+    def cmd_dbsize(self, args):
+        return resp.integer(len(self._all_keys()))
+
+    def cmd_flushall(self, args):
+        for row in self._client.scan(self._strings):
+            self._client.write(self._strings, [QLWriteOp(
+                WriteOpKind.DELETE_ROW,
+                DocKey(hash_components=row.doc_key.hash_components))])
+        for row in self._client.scan(self._hashes):
+            self._client.write(self._hashes, [QLWriteOp(
+                WriteOpKind.DELETE_ROW, row.doc_key)])
+        return resp.simple("OK")
+
+    cmd_flushdb = cmd_flushall
