@@ -1,0 +1,170 @@
+"""Aux subsystems: webserver/metrics endpoints, raft-replicated snapshots,
+export/import backup-restore, yugabyted launcher (ref: metrics endpoints
+util/metrics.h:449; snapshot flow ent backup_service; bin/yugabyted)."""
+
+import json
+import shutil
+import time
+import urllib.request
+
+import pytest
+
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.tools.yb_admin import AdminClient
+from yugabyte_tpu.utils import flags
+
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING),
+             ColumnSchema("n", DataType.INT64)],
+    num_hash_key_columns=1)
+
+
+def dk(k: str) -> DocKey:
+    return DocKey(hash_components=(k,))
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    flags.set_flag("replication_factor", 3)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path_factory.mktemp("auxcluster")))).start()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def table(cluster):
+    client = cluster.new_client()
+    client.create_namespace("db")
+    t = client.create_table("db", "t", SCHEMA, num_tablets=2)
+    cluster.wait_all_replicas_running(t.table_id)
+    for i in range(50):
+        client.write(t, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i:03d}"),
+                                   {"v": f"v{i}", "n": i})])
+    return t
+
+
+def _get(addr: str, path: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_webserver_endpoints(cluster, table):
+    mws = cluster.masters[0].webserver
+    assert mws is not None
+    assert _get(mws.address, "/healthz").strip() == "ok"
+    status = json.loads(_get(mws.address, "/status"))
+    assert status["is_leader"] is True
+    assert status["num_tablets"] >= 2
+    assert len(status["tservers"]) == 3
+    tws = cluster.tservers[0].webserver
+    prom = _get(tws.address, "/prometheus-metrics")
+    assert "rows_inserted" in prom
+    tablets = json.loads(_get(tws.address, "/tablets"))
+    assert any(t["role"] == "leader" or t["role"] == "follower"
+               for t in tablets)
+
+
+def test_snapshot_on_all_replicas(cluster, table):
+    master = cluster.leader_master()
+    meta = master.catalog.create_table_snapshot("db", "t")
+    sid = meta["snapshot_id"]
+    # Raft-replicated: EVERY replica of every tablet holds the snapshot.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        counts = []
+        for tablet_id in meta["tablet_ids"]:
+            for ts in cluster.tservers:
+                try:
+                    peer = ts.tablet_manager.get_tablet(tablet_id)
+                except Exception:  # noqa: BLE001
+                    continue
+                counts.append(sid in peer.tablet.list_snapshots())
+        if counts and all(counts):
+            break
+        time.sleep(0.1)
+    assert counts and all(counts), "snapshot missing on some replica"
+    snaps = master.catalog.list_snapshots()
+    assert any(s["snapshot_id"] == sid for s in snaps)
+
+
+def test_export_import_restore(cluster, table, tmp_path):
+    admin = AdminClient(cluster.master_addrs())
+    try:
+        meta = cluster.leader_master().catalog.create_table_snapshot(
+            "db", "t")
+        out = str(tmp_path / "backup")
+        admin.export_snapshot(meta["snapshot_id"], out)
+        admin.import_snapshot(out, "db", "t_restored")
+        client = cluster.new_client()
+        restored = client.open_table("db", "t_restored")
+        for i in (0, 25, 49):
+            row = client.read_row(restored, dk(f"k{i:03d}"))
+            assert row is not None
+            assert row.columns[SCHEMA.column_id("v")] == f"v{i}"
+        rows = list(client.scan(restored))
+        assert len(rows) == 50
+    finally:
+        admin.client.close()
+
+
+def test_snapshot_is_point_in_time(cluster, table, tmp_path):
+    client = cluster.new_client()
+    master = cluster.leader_master()
+    meta = master.catalog.create_table_snapshot("db", "t")
+    # Mutations after the snapshot must not appear in a restore of it.
+    client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk("post-snap"),
+                                   {"v": "late", "n": 999})])
+    admin = AdminClient(cluster.master_addrs())
+    try:
+        out = str(tmp_path / "pit")
+        admin.export_snapshot(meta["snapshot_id"], out)
+        admin.import_snapshot(out, "db", "t_pit")
+        restored = client.open_table("db", "t_pit")
+        assert client.read_row(restored, dk("post-snap")) is None
+        assert client.read_row(restored, dk("k001")) is not None
+    finally:
+        admin.client.close()
+
+
+def test_delete_snapshot(cluster, table):
+    master = cluster.leader_master()
+    meta = master.catalog.create_table_snapshot("db", "t")
+    sid = meta["snapshot_id"]
+    master.catalog.delete_snapshot(sid)
+    assert not any(s["snapshot_id"] == sid
+                   for s in master.catalog.list_snapshots())
+    for ts in cluster.tservers:
+        for tid in ts.tablet_manager.tablet_ids():
+            peer = ts.tablet_manager.get_tablet(tid)
+            assert sid not in peer.tablet.list_snapshots()
+
+
+def test_yugabyted_single_node(tmp_path):
+    from yugabyte_tpu.tools.yugabyted import YugabytedNode
+    from yugabyte_tpu.yql.cql.executor import QLProcessor
+    from yugabyte_tpu.client.client import YBClient
+    flags.set_flag("replication_factor", 1)
+    node = YugabytedNode(str(tmp_path / "node"))
+    try:
+        eps = node.endpoints()
+        assert "master_rpc" in eps and "tserver_rpc" in eps
+        client = YBClient(node.master_addrs)
+        ql = QLProcessor(client)
+        ql.execute("CREATE KEYSPACE app")
+        ql.execute("USE app")
+        ql.execute("CREATE TABLE kv (k TEXT PRIMARY KEY, v TEXT) "
+                   "WITH tablets = 1")
+        ql.execute("INSERT INTO kv (k, v) VALUES ('one', '1')")
+        rs = ql.execute("SELECT v FROM kv WHERE k = 'one'")
+        assert rs.rows == [["1"]]
+        client.close()
+    finally:
+        flags.reset_flag("replication_factor")
+        node.shutdown()

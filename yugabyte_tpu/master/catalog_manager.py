@@ -379,6 +379,66 @@ class CatalogManager:
                 TRACE("catalog: retired split parent %s", tablet_id)
         return retired
 
+    # ------------------------------------------------------------ snapshots
+    def create_table_snapshot(self, namespace: str, name: str) -> dict:
+        """Coordinate a consistent table snapshot: a raft-replicated
+        snapshot barrier on every tablet (ref master SnapshotCoordinator,
+        ent/src/yb/master/async_snapshot_tasks.cc); metadata persists in
+        the sys catalog so restores survive master failover."""
+        table = self.get_table(namespace, name)
+        snapshot_id = uuid.uuid4().hex[:16]
+        addr_map = self.ts_manager.addr_map()
+        with self._lock:
+            tablet_ids = [t for t in table["tablet_ids"]
+                          if t in self.tablets]
+            leaders = {t: self.tablet_leaders.get(t) for t in tablet_ids}
+        for tablet_id in tablet_ids:
+            leader = leaders.get(tablet_id)
+            if leader is None or addr_map.get(leader[0]) is None:
+                raise StatusError(Status.ServiceUnavailable(
+                    f"no leader for {tablet_id}; snapshot aborted"))
+            self.messenger.call(addr_map[leader[0]], "tserver",
+                                "snapshot_tablet", timeout_s=60.0,
+                                tablet_id=tablet_id,
+                                snapshot_id=snapshot_id)
+        meta = {"snapshot_id": snapshot_id, "namespace": namespace,
+                "table": name, "table_id": table["table_id"],
+                "schema": table["schema"],
+                "partition_schema": table["partition_schema"],
+                "tablet_ids": tablet_ids}
+        with self._lock:
+            self.sys.upsert("snapshot", snapshot_id, meta)
+        return meta
+
+    def list_snapshots(self) -> List[dict]:
+        return [m for _t, _id, m in self.sys.scan_all()
+                if _t == "snapshot"]
+
+    def get_snapshot(self, snapshot_id: str) -> dict:
+        meta = self.sys.get("snapshot", snapshot_id)
+        if meta is None:
+            raise StatusError(Status.NotFound(f"snapshot {snapshot_id}"))
+        return meta
+
+    def delete_snapshot(self, snapshot_id: str) -> None:
+        meta = self.get_snapshot(snapshot_id)
+        addr_map = self.ts_manager.addr_map()
+        for tablet_id in meta["tablet_ids"]:
+            for desc in self.ts_manager.all_descriptors():
+                addr = addr_map.get(desc.server_id)
+                if addr is None:
+                    continue
+                try:
+                    self.messenger.call(addr, "tserver",
+                                        "delete_tablet_snapshot",
+                                        timeout_s=10.0,
+                                        tablet_id=tablet_id,
+                                        snapshot_id=snapshot_id)
+                except StatusError:
+                    pass  # replica gone / not hosting: fine
+        with self._lock:
+            self.sys.delete("snapshot", snapshot_id)
+
     def split_tablet(self, tablet_id: str) -> List[str]:
         """Drive a split through the tablet's leader (ref master
         TabletSplitManager)."""

@@ -44,6 +44,7 @@ class MasterOptions:
     port: int = 0
     # multi-master: all master ids incl. self (single-master by default)
     master_ids: List[str] = field(default_factory=list)
+    webserver_port: Optional[int] = 0  # None disables; 0 = ephemeral
 
 
 class MasterService:
@@ -91,6 +92,19 @@ class MasterService:
     def split_tablet(self, tablet_id: str) -> List[str]:
         return self._leader_catalog().split_tablet(tablet_id)
 
+    def create_table_snapshot(self, namespace: str, name: str) -> dict:
+        return self._leader_catalog().create_table_snapshot(namespace, name)
+
+    def list_snapshots(self) -> List[dict]:
+        return self._leader_catalog().list_snapshots()
+
+    def get_snapshot(self, snapshot_id: str) -> dict:
+        return self._leader_catalog().get_snapshot(snapshot_id)
+
+    def delete_snapshot(self, snapshot_id: str) -> bool:
+        self._leader_catalog().delete_snapshot(snapshot_id)
+        return True
+
     def get_tablet_leader(self, tablet_id: str) -> Optional[str]:
         """host:port of a tablet's current leader (transaction status
         routing; ref master GetTabletLocations)."""
@@ -130,6 +144,30 @@ class Master:
         self.messenger.register_service(MASTER_SERVICE, self.service)
         self._stop = threading.Event()
         self._bg_thread: Optional[threading.Thread] = None
+        self.webserver = None
+        if opts.webserver_port is not None:
+            from yugabyte_tpu.utils.metrics import MetricRegistry
+            from yugabyte_tpu.server.webserver import Webserver
+            self._metrics = MetricRegistry()
+            self.webserver = Webserver(self._metrics, opts.bind_host,
+                                       opts.webserver_port)
+            self.webserver.register_json("/status", self._status_page)
+            self.webserver.register_json(
+                "/tables", lambda: self.catalog.list_tables()
+                if self.catalog.is_leader() else [])
+
+    def _status_page(self) -> dict:
+        return {
+            "master_id": self.master_id,
+            "rpc_address": self.address,
+            "is_leader": self.catalog.is_leader(),
+            "num_tables": len(self.catalog.tables),
+            "num_tablets": len(self.catalog.tablets),
+            "tservers": [
+                {"server_id": d.server_id, "addr": d.addr,
+                 "alive": d.alive(), "tablets": d.num_tablets}
+                for d in self.catalog.ts_manager.all_descriptors()],
+        }
 
     @property
     def address(self) -> str:
@@ -195,5 +233,7 @@ class Master:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.webserver is not None:
+            self.webserver.shutdown()
         self.sys_catalog.shutdown()
         self.messenger.shutdown()

@@ -1,0 +1,6 @@
+"""Shared server-process infrastructure (ref: src/yb/server —
+RpcAndWebServerBase, webserver, path handlers)."""
+
+from yugabyte_tpu.server.webserver import Webserver
+
+__all__ = ["Webserver"]

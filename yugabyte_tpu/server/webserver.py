@@ -1,0 +1,83 @@
+"""Embedded status/metrics webserver.
+
+Capability parity with the reference (ref: src/yb/server/webserver.cc +
+per-server path handlers master-path-handlers.cc / tserver-path-handlers.cc;
+metric endpoints util/metrics.h:449-518 — JSON `/metrics` and Prometheus
+`/prometheus-metrics`). Handlers are plain callables returning
+(content_type, body); every server registers its own status pages.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Tuple
+
+from yugabyte_tpu.utils.metrics import MetricRegistry
+
+Handler = Callable[[], Tuple[str, str]]
+
+
+class Webserver:
+    def __init__(self, metrics: MetricRegistry,
+                 bind_host: str = "127.0.0.1", port: int = 0):
+        self._metrics = metrics
+        self._handlers: Dict[str, Handler] = {}
+        outer = self
+
+        class _Req(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 — stdlib name
+                pass
+
+            def do_GET(self):  # noqa: N802 — stdlib name
+                path = self.path.split("?", 1)[0]
+                try:
+                    ctype, body = outer._dispatch(path)
+                    code = 200
+                except KeyError:
+                    ctype, body = "text/plain", f"no handler for {path}\n"
+                    code = 404
+                except Exception as e:  # noqa: BLE001 — surface as 500
+                    ctype, body = "text/plain", f"error: {e}\n"
+                    code = 500
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((bind_host, port), _Req)
+        self.host, self.port = self._httpd.server_address
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="webserver")
+        self._thread.start()
+        self.register("/healthz", lambda: ("text/plain", "ok\n"))
+        self.register("/metrics", self._json_metrics)
+        self.register("/prometheus-metrics", self._prom_metrics)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register(self, path: str, handler: Handler) -> None:
+        self._handlers[path] = handler
+
+    def register_json(self, path: str, provider: Callable[[], object]) -> None:
+        self._handlers[path] = lambda: (
+            "application/json", json.dumps(provider(), indent=2,
+                                           default=str) + "\n")
+
+    def _dispatch(self, path: str) -> Tuple[str, str]:
+        return self._handlers[path]()
+
+    def _json_metrics(self) -> Tuple[str, str]:
+        return "application/json", self._metrics.to_json()
+
+    def _prom_metrics(self) -> Tuple[str, str]:
+        return "text/plain; version=0.0.4", self._metrics.to_prometheus()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -422,6 +422,40 @@ class Tablet:
         self.regular_db.checkpoint(os.path.join(out_dir, "regular"))
         self.intents_db.checkpoint(os.path.join(out_dir, "intents"))
 
+    # -------------------------------------------------------------- snapshots
+    def snapshots_dir(self) -> str:
+        return os.path.join(
+            os.path.dirname(self.regular_db.db_dir), "snapshots")
+
+    def create_snapshot(self, snapshot_id: str) -> str:
+        """Raft-applied snapshot: every replica checkpoints the identical
+        applied state under snapshots/<id> (ref tablet/
+        snapshot_coordinator.h + ent tserver/backup_service.cc). Idempotent
+        for replay."""
+        sdir = os.path.join(self.snapshots_dir(), snapshot_id)
+        if os.path.exists(sdir):
+            return sdir
+        tmp = sdir + ".tmp"
+        import shutil as _sh
+        _sh.rmtree(tmp, ignore_errors=True)
+        self.flush()
+        self.regular_db.checkpoint(os.path.join(tmp, "regular"))
+        self.intents_db.checkpoint(os.path.join(tmp, "intents"))
+        os.rename(tmp, sdir)
+        TRACE("tablet %s: snapshot %s created", self.tablet_id, snapshot_id)
+        return sdir
+
+    def delete_snapshot(self, snapshot_id: str) -> None:
+        import shutil as _sh
+        _sh.rmtree(os.path.join(self.snapshots_dir(), snapshot_id),
+                   ignore_errors=True)
+
+    def list_snapshots(self) -> List[str]:
+        d = self.snapshots_dir()
+        if not os.path.isdir(d):
+            return []
+        return sorted(s for s in os.listdir(d) if not s.endswith(".tmp"))
+
     def split_partition_key(self, hash_partitioning: bool) -> Optional[bytes]:
         """Partition-key-space split point derived from the median doc key
         (hash partitioning: the 2-byte bucket right after the kUInt16Hash

@@ -32,8 +32,9 @@ from yugabyte_tpu.common.hybrid_time import HybridClock, HybridTime
 from yugabyte_tpu.common.schema import Schema
 from yugabyte_tpu.consensus.log import Log, LogReader
 from yugabyte_tpu.consensus.raft import (
-    OP_SPLIT, OP_UPDATE_TXN, OP_WRITE, NotLeader, OperationOutcomeUnknown,
-    RaftConfig, RaftConsensus, ReplicateMsg, ReplicationTimedOut, Role)
+    OP_SNAPSHOT, OP_SPLIT, OP_UPDATE_TXN, OP_WRITE, NotLeader,
+    OperationOutcomeUnknown, RaftConfig, RaftConsensus, ReplicateMsg,
+    ReplicationTimedOut, Role)
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
 
@@ -188,6 +189,12 @@ class TabletPeer:
             self.tablet.apply_txn_update(
                 info["action"], bytes.fromhex(info["txn_id"]),
                 info.get("commit_ht") or 0, msg.ht_value, msg.op_id)
+        elif msg.op_type == OP_SNAPSHOT:
+            # Deterministic: every replica checkpoints the same applied
+            # prefix (ref snapshot_coordinator raft-driven snapshots).
+            import json as _json
+            self.tablet.create_snapshot(
+                _json.loads(msg.payload)["snapshot_id"])
         elif msg.op_type == OP_SPLIT:
             # Applied at the same log position on every replica, after all
             # preceding writes and before nothing (the parent rejects writes
@@ -198,6 +205,28 @@ class TabletPeer:
             info = _json.loads(msg.payload)
             self.tablet.split_children = tuple(info["children"])
             self.on_split(info)
+
+    def submit_snapshot(self, snapshot_id: str,
+                        snapshot_ht_value: int = 0,
+                        timeout_s: float = 60.0):
+        """Leader: replicate a snapshot barrier. When the master supplies a
+        cluster-wide snapshot hybrid time, the leader first waits for
+        SafeTime >= snapshot_ht so every write visible at that time is in
+        the log BEFORE the barrier — all tablets then restore consistently
+        at the same point in time (ref snapshot_coordinator anchoring
+        snapshots to one hybrid time)."""
+        import json as _json
+        if not self.raft.is_leader():
+            raise NotLeader(self.raft.leader_hint())
+        if snapshot_ht_value:
+            self.clock.update(HybridTime(snapshot_ht_value))
+            self.tablet.mvcc.safe_time(
+                min_allowed=HybridTime(snapshot_ht_value),
+                timeout_s=timeout_s)
+        payload = _json.dumps({"snapshot_id": snapshot_id,
+                               "snapshot_ht": snapshot_ht_value}).encode()
+        return self.raft.replicate(OP_SNAPSHOT, self.clock.now().value,
+                                   payload, timeout_s=timeout_s)
 
     def submit_split(self, child_ids, split_partition_key: bytes,
                      timeout_s: float = 30.0):

@@ -1,0 +1,1 @@
+"""Operator tooling (ref: src/yb/tools — yb-admin, ysck, ldb; bin/yugabyted)."""

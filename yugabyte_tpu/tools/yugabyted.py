@@ -1,0 +1,101 @@
+"""yugabyted: single-command node launcher.
+
+Capability parity with the reference (ref: bin/yugabyted — starts a master
++ tserver pair with sensible defaults, prints connection endpoints, joins
+an existing cluster via --join). One process runs both server objects,
+exactly like `yugabyted start` does for a single node.
+
+Usage:
+  python -m yugabyte_tpu.tools.yugabyted start --base-dir DIR
+      [--master-port N] [--tserver-port N] [--join HOST:PORT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from yugabyte_tpu.master.master import Master, MasterOptions
+from yugabyte_tpu.tserver.tablet_server import (
+    TabletServer, TabletServerOptions)
+
+
+class YugabytedNode:
+    def __init__(self, base_dir: str, master_port: int = 0,
+                 tserver_port: int = 0, join: Optional[str] = None,
+                 server_id: Optional[str] = None,
+                 replication_factor: Optional[int] = None):
+        os.makedirs(base_dir, exist_ok=True)
+        if join is None:
+            # Single-node bringup defaults to RF1 (ref yugabyted defaults);
+            # joining nodes inherit the existing master's setting.
+            from yugabyte_tpu.utils import flags
+            flags.set_flag("replication_factor", replication_factor or 1)
+        self.master: Optional[Master] = None
+        if join is None:
+            self.master = Master(MasterOptions(
+                master_id="m0",
+                fs_root=os.path.join(base_dir, "master"),
+                port=master_port)).start()
+            master_addrs = [self.master.address]
+        else:
+            master_addrs = [join]
+        sid = server_id or f"ts-{os.path.basename(base_dir)}"
+        self.tserver = TabletServer(TabletServerOptions(
+            server_id=sid,
+            fs_root=os.path.join(base_dir, "tserver"),
+            master_addrs=master_addrs,
+            port=tserver_port)).start()
+        self.master_addrs = master_addrs
+
+    def endpoints(self) -> dict:
+        out = {"tserver_rpc": self.tserver.address,
+               "masters": self.master_addrs}
+        if self.tserver.webserver:
+            out["tserver_web"] = self.tserver.webserver.address
+        if self.master is not None:
+            out["master_rpc"] = self.master.address
+            if self.master.webserver:
+                out["master_web"] = self.master.webserver.address
+        return out
+
+    def shutdown(self) -> None:
+        self.tserver.shutdown()
+        if self.master is not None:
+            self.master.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="yugabyted")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("start")
+    p.add_argument("--base-dir", required=True)
+    p.add_argument("--master-port", type=int, default=7100)
+    p.add_argument("--tserver-port", type=int, default=9100)
+    p.add_argument("--join", default=None,
+                   help="master address of an existing cluster to join")
+    p.add_argument("--server-id", default=None)
+    p.add_argument("--rf", type=int, default=None,
+                   help="replication factor for new tables (default 1)")
+    args = ap.parse_args(argv)
+    node = YugabytedNode(args.base_dir, args.master_port,
+                         args.tserver_port, args.join, args.server_id,
+                         replication_factor=args.rf)
+    for k, v in node.endpoints().items():
+        print(f"{k}: {v}", flush=True)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    print("node running; Ctrl-C to stop", flush=True)
+    while not stop:
+        time.sleep(0.2)
+    node.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ class TabletServerOptions:
     bind_host: str = "127.0.0.1"
     port: int = 0
     tablet_options_factory: Optional[Callable[[], TabletOptions]] = None
+    webserver_port: Optional[int] = 0  # None disables; 0 = ephemeral
 
 
 class TabletServer:
@@ -68,6 +69,18 @@ class TabletServer:
             self.messenger, opts.master_addrs, opts.server_id, self.address,
             report_provider=self.tablet_manager.generate_report,
             on_response=self._handle_heartbeat_response)
+        self.webserver = None
+        if opts.webserver_port is not None:
+            from yugabyte_tpu.server.webserver import Webserver
+            self.webserver = Webserver(self.metrics, opts.bind_host,
+                                       opts.webserver_port)
+            self.webserver.register_json("/status", self._status_page)
+            self.webserver.register_json(
+                "/tablets", self.tablet_manager.generate_report)
+
+    def _status_page(self) -> dict:
+        return {"server_id": self.server_id, "rpc_address": self.address,
+                "num_tablets": len(self.tablet_manager.tablet_ids())}
 
     @property
     def address(self) -> str:
@@ -152,5 +165,7 @@ class TabletServer:
 
     def shutdown(self) -> None:
         self.heartbeater.stop()
+        if self.webserver is not None:
+            self.webserver.shutdown()
         self.tablet_manager.shutdown()
         self.messenger.shutdown()

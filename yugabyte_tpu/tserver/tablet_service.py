@@ -259,6 +259,55 @@ class TabletServiceImpl:
         except NotLeader as e:
             raise NotLeaderError(_leader_server_hint(e)) from e
 
+    # -------------------------------------------------- snapshots / backup
+    def snapshot_tablet(self, tablet_id: str, snapshot_id: str) -> bool:
+        """Raft-replicated snapshot barrier (ref backup_service.cc
+        TabletSnapshotOp)."""
+        try:
+            self._leader_peer(tablet_id).submit_snapshot(snapshot_id)
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
+        return True
+
+    def list_tablet_snapshots(self, tablet_id: str) -> List[str]:
+        return self._tablets.get_tablet(tablet_id).tablet.list_snapshots()
+
+    def delete_tablet_snapshot(self, tablet_id: str,
+                               snapshot_id: str) -> bool:
+        self._tablets.get_tablet(tablet_id).tablet.delete_snapshot(
+            snapshot_id)
+        return True
+
+    def snapshot_manifest(self, tablet_id: str,
+                          snapshot_id: str) -> List[List]:
+        """[(relpath, size)] of a snapshot's files, for export."""
+        import os
+        peer = self._tablets.get_tablet(tablet_id)
+        sdir = os.path.join(peer.tablet.snapshots_dir(), snapshot_id)
+        if not os.path.isdir(sdir):
+            raise StatusError(Status.NotFound(
+                f"snapshot {snapshot_id} of {tablet_id}"))
+        out = []
+        for dirpath, _d, filenames in os.walk(sdir):
+            for fn in filenames:
+                p = os.path.join(dirpath, fn)
+                out.append([os.path.relpath(p, sdir), os.path.getsize(p)])
+        return out
+
+    def fetch_snapshot_file(self, tablet_id: str, snapshot_id: str,
+                            relpath: str, offset: int,
+                            length: int) -> bytes:
+        import os
+        peer = self._tablets.get_tablet(tablet_id)
+        sdir = os.path.join(peer.tablet.snapshots_dir(), snapshot_id)
+        p = os.path.normpath(os.path.join(sdir, relpath))
+        if not p.startswith(os.path.normpath(sdir) + os.sep):
+            raise StatusError(Status.InvalidArgument(
+                f"path escape: {relpath!r}"))
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(min(length, 1 << 20))
+
     def flush_tablet(self, tablet_id: str) -> bool:
         self._tablets.get_tablet(tablet_id).tablet.flush()
         return True
