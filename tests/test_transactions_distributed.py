@@ -171,3 +171,26 @@ def test_expired_txn_aborts(env):
             txn.commit()
     finally:
         flags.reset_flag("transaction_timeout_ms")
+
+
+def test_participant_recorded_before_write(env):
+    """ADVICE r1 #4: a write whose outcome is unknown (timeout) may have
+    left intents on the tablet — commit/abort must still notify it, so the
+    participant is recorded BEFORE the RPC goes out."""
+    from yugabyte_tpu.utils.status import Status, StatusError
+    cluster, client, table, manager = env
+    txn = manager.begin()
+    orig = client._tablet_call
+    def failing(table_, tablet, mth, **kw):
+        if mth == "write":
+            raise StatusError(Status.TimedOut("injected outcome-unknown"))
+        return orig(table_, tablet, mth, **kw)
+    client._tablet_call = failing
+    try:
+        with pytest.raises(StatusError):
+            txn.write(table, [ins("orphan-key", "x")])
+    finally:
+        client._tablet_call = orig
+    assert len(txn._participants) == 1, (
+        "tablet that may hold orphaned intents was not recorded")
+    txn.abort()

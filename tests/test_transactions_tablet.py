@@ -155,3 +155,65 @@ def test_restart_preserves_unresolved_intents(tmp_path):
                         t2.clock.now().value, (1, 103))
     assert t2.read_row(dk("a")).columns[0] == "pending"
     t2.close()
+
+
+def test_late_cleanup_skips_foreign_intent(tablet):
+    """ADVICE r1 #1: after txn A's intent at a key is resolved and txn B
+    legally writes its own intent there, a LATE duplicate cleanup
+    notification for A must not tombstone B's live intent."""
+    t, statuses = tablet
+    ma = TransactionMetadata.new("status-tab")
+    mb = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("hot", "a-val")], ma)
+    statuses[ma.txn_id] = {"status": "aborted", "commit_ht": None}
+    t.apply_txn_update("cleanup", ma.txn_id, 0, t.clock.now().value, (1, 200))
+    # B takes over the key (conflict resolution permits overwriting a
+    # resolved intent).
+    t.write_transactional([ins("hot", "b-val")], mb)
+    assert len(txn_intents(t.intents_db, mb.txn_id)) == 3
+    # Duplicate/late cleanup for A arrives again: must be a no-op for B.
+    t.apply_txn_update("cleanup", ma.txn_id, 0, t.clock.now().value, (1, 201))
+    assert len(txn_intents(t.intents_db, mb.txn_id)) == 3
+    commit_ht = commit(t, statuses, mb)
+    t.apply_txn_update("apply", mb.txn_id, commit_ht.value,
+                       t.clock.now().value, (1, 202))
+    row = t.read_row(dk("hot"))
+    assert row is not None and row.columns[0] == "b-val"
+
+
+def test_late_apply_does_not_publish_foreign_intent(tablet):
+    """ADVICE r1 #1 (apply side): a late duplicate APPLY for txn A must not
+    publish txn B's uncommitted value at A's commit time."""
+    t, statuses = tablet
+    ma = TransactionMetadata.new("status-tab")
+    mb = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("hot", "a-val")], ma)
+    commit_ht = commit(t, statuses, ma)
+    t.apply_txn_update("apply", ma.txn_id, commit_ht.value,
+                       t.clock.now().value, (1, 210))
+    t.write_transactional([ins("hot", "b-uncommitted")], mb)
+    # Late duplicate apply for A: B's pending intent must stay provisional.
+    t.apply_txn_update("apply", ma.txn_id, commit_ht.value,
+                       t.clock.now().value, (1, 211))
+    row = t.read_row(dk("hot"))
+    assert row is not None and row.columns[0] == "a-val"
+    assert len(txn_intents(t.intents_db, mb.txn_id)) == 3
+
+
+def test_intents_flush_persists_regular_first(tablet):
+    """ADVICE r1 #3: the intents DB's flushed frontier must never advance
+    past the regular DB's, or a crash between the two flushes replays
+    OP_UPDATE_TXN against already-tombstoned intents and loses rows."""
+    t, statuses = tablet
+    meta = TransactionMetadata.new("status-tab")
+    t.write_transactional([ins("a", "v1")], meta)
+    commit_ht = commit(t, statuses, meta)
+    t.apply_txn_update("apply", meta.txn_id, commit_ht.value,
+                       t.clock.now().value, (5, 500))
+    # Flush ONLY the intents DB: the pre-flush hook must persist the
+    # regular DB first so its frontier covers the apply op.
+    t.intents_db.flush()
+    reg_f = t.regular_db.versions.flushed_frontier
+    int_f = t.intents_db.versions.flushed_frontier
+    assert int_f is not None and reg_f is not None
+    assert reg_f.op_id_max >= int_f.op_id_max

@@ -1,0 +1,113 @@
+"""TransactionCoordinator unit tests with a local status tablet.
+
+Regression coverage for ADVICE r1 #2: a status request must not be able to
+read 'pending' inside commit()'s window between picking commit_ht and the
+replicated write applying — that would tear a snapshot (two reads at the
+same read_ht seeing different data). status() now serializes with commit()
+on the per-txn mutex (ref: the reference floors commit time above
+outstanding status-request times, transaction_coordinator.cc)."""
+
+import threading
+import time
+import uuid
+
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import HybridTime
+from yugabyte_tpu.tablet.tablet import Tablet
+from yugabyte_tpu.tserver.transaction_coordinator import (
+    TXN_STATUS_SCHEMA, TransactionCoordinator)
+
+
+class LocalPeer:
+    """Minimal TabletPeer stand-in: a real (non-replicated) status tablet
+    plus a write hook for injecting delays."""
+
+    def __init__(self, path):
+        self.tablet = Tablet("status-t", path, TXN_STATUS_SCHEMA)
+        self.clock = self.tablet.clock
+        self.write_hook = None
+
+    def write(self, ops):
+        if self.write_hook is not None:
+            self.write_hook(ops)
+        return self.tablet.write(ops)
+
+
+@pytest.fixture
+def peer(tmp_path):
+    p = LocalPeer(str(tmp_path / "status"))
+    yield p
+    p.tablet.close()
+
+
+def test_create_heartbeat_commit(peer):
+    coord = TransactionCoordinator()
+    txn = uuid.uuid4().bytes
+    resp = coord.create(peer, txn)
+    assert resp["read_ht"] > 0
+    assert coord.heartbeat(peer, txn)
+    assert coord.status(peer, txn)["status"] == "pending"
+    commit = coord.commit(peer, txn, [])
+    assert commit["commit_ht"] > resp["read_ht"]
+    st = coord.status(peer, txn)
+    assert st == {"status": "committed", "commit_ht": commit["commit_ht"]}
+
+
+def test_status_cannot_interleave_with_commit(peer):
+    """ADVICE r1 #2: status() arriving while commit() has picked commit_ht
+    but not yet applied its replicated write must WAIT and answer
+    'committed' — never 'pending' with a smaller commit_ht racing in."""
+    coord = TransactionCoordinator()
+    txn = uuid.uuid4().bytes
+    coord.create(peer, txn)
+
+    in_commit_write = threading.Event()
+    release_commit = threading.Event()
+
+    def hook(ops):
+        if ops and ops[0].values.get("status") == "committed":
+            in_commit_write.set()
+            assert release_commit.wait(10)
+
+    peer.write_hook = hook
+    commit_result = {}
+    ct = threading.Thread(
+        target=lambda: commit_result.update(coord.commit(peer, txn, [])))
+    ct.start()
+    assert in_commit_write.wait(10)
+    # commit_ht is chosen and the status-row write is in flight. A reader
+    # at a snapshot >= commit_ht asks for status now.
+    observing = peer.clock.now().value
+    status_result = {}
+    st = threading.Thread(
+        target=lambda: status_result.update(
+            coord.status(peer, txn, observing_read_ht=observing)))
+    st.start()
+    # status must block on the txn mutex, not answer early.
+    time.sleep(0.15)
+    assert not status_result, (
+        f"status answered {status_result} inside the commit window")
+    release_commit.set()
+    ct.join(10)
+    st.join(10)
+    assert status_result["status"] == "committed"
+    assert status_result["commit_ht"] == commit_result["commit_ht"]
+
+
+def test_expired_pending_txn_lazily_aborted(peer):
+    from yugabyte_tpu.utils import flags
+    coord = TransactionCoordinator()
+    txn = uuid.uuid4().bytes
+    coord.create(peer, txn)
+    old = flags.get_flag("transaction_timeout_ms")
+    flags.set_flag("transaction_timeout_ms", 0)
+    try:
+        time.sleep(0.002)
+        assert coord.status(peer, txn)["status"] == "aborted"
+    finally:
+        flags.set_flag("transaction_timeout_ms", old)
+    # commit after lazy abort must fail
+    from yugabyte_tpu.utils.status import StatusError
+    with pytest.raises(StatusError):
+        coord.commit(peer, txn, [])

@@ -139,6 +139,14 @@ class YBTransaction:
         self._check_pending()
         pk = table.partition_key_for(ops[0].doc_key)
         tablet = self._client.meta_cache.lookup_tablet(table.table_id, pk)
+        # Record the participant BEFORE issuing the write: on a timeout or
+        # unknown outcome the intents may exist on the tablet anyway, and
+        # commit/abort must notify every tablet that may hold them —
+        # otherwise orphaned intents are never applied or cleaned up. A
+        # spurious participant (write never landed) costs one no-op
+        # notification.
+        self._participants.setdefault(tablet.tablet_id,
+                                      tablet.leader_addr())
         try:
             self._client._tablet_call(
                 table, tablet, "write", refresh_key=pk,
@@ -148,8 +156,6 @@ class YBTransaction:
             if e.extra.get("txn_conflict"):
                 raise TransactionError(e.status.message) from e
             raise
-        self._participants.setdefault(tablet.tablet_id,
-                                      tablet.leader_addr())
 
     def read_row(self, table: YBTable, doc_key: DocKey,
                  projection: Optional[Sequence[str]] = None):

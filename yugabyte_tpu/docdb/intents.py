@@ -203,5 +203,14 @@ def txn_intents(intents_db, txn_id: bytes
         int_dht, int_raw = got
         if int_raw[:1] == bytes([ValueType.kTombstone]):
             continue
+        # Ownership check: after this txn's intent at the key was resolved,
+        # another txn may have legally written its own intent there
+        # (conflict resolution permits overwriting aborted/committed
+        # intents). Resolving that foreign intent as ours would tombstone
+        # live data or publish uncommitted values — skip any record whose
+        # embedded txn id is not ours.
+        if (int_raw[:1] != bytes([ValueType.kTransactionId])
+                or int_raw[1:17] != txn_id):
+            continue
         out.append((intent_key, int_dht, int_raw))
     return out

@@ -74,6 +74,13 @@ class DB:
         self._closed = False
         self._pins: dict = {}       # file_id -> active scan count
         self._obsolete: dict = {}   # file_id -> reader awaiting unpin+delete
+        # Runs after the memtable swap, before this DB's SST installs. The
+        # tablet points the intents DB's hook at regular_db.flush so the
+        # intents flushed frontier never persists ahead of the regular DB
+        # for ops spanning both (bootstrap replays from the min frontier;
+        # an OP_UPDATE_TXN whose intent tombstones persisted but whose
+        # regular-DB rows didn't would replay as a no-op and lose data).
+        self.pre_flush_hook: Optional[Callable[[], None]] = None
         for fm in self.versions.live_files():
             self._readers[fm.file_id] = SSTReader(fm.path, self.opts.block_cache)
 
@@ -203,6 +210,8 @@ class DB:
             imm = self._imm
             last_op = getattr(self, "_last_op_id", (0, 0))
         try:
+            if self.pre_flush_hook is not None:
+                self.pre_flush_hook()
             slab = imm.to_slab()
             fid = self.versions.new_file_id()
             path = os.path.join(self.db_dir, f"{fid:06d}.sst")

@@ -130,6 +130,13 @@ class Tablet:
             compaction_pool=self.opts.compaction_pool,
             auto_compact=self.opts.auto_compact)
         self.intents_db = DB(os.path.join(data_dir, "intents"), intents_opts)
+        # Flush-ordering invariant (ref: the reference flushes regular
+        # before intents so intents cleanup never outlives the applied
+        # rows): an intents flush first persists the regular DB, keeping
+        # intents' flushed frontier <= regular's for txn-apply ops whose
+        # effects span both DBs. Bootstrap replays from the min frontier,
+        # so OP_UPDATE_TXN re-derivation always sees live intents.
+        self.intents_db.pre_flush_hook = self.regular_db.flush
         self.mvcc = MvccManager(self.clock)
         self.lock_manager = SharedLockManager()
         self.consensus = LocalConsensusContext(self)

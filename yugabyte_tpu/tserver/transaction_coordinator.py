@@ -125,26 +125,27 @@ class TransactionCoordinator:
         floors commit time above outstanding status-request times)."""
         if observing_read_ht:
             peer.clock.update(HybridTime(observing_read_ht))
-        rec = self._read(peer, txn_id)
-        if rec is None:
-            # Never created here or already GC'd: treat as aborted
-            # (the reference returns ABORTED for unknown transactions).
-            return {"status": "aborted", "commit_ht": None}
-        if rec["status"] == "pending":
-            timeout = flags.get_flag("transaction_timeout_ms")
-            if _now_ms() - (rec["heartbeat_ms"] or 0) > timeout:
-                # Expiry check + abort under the txn mutex: a concurrent
-                # heartbeat renewal must not be stomped by a stale-read
-                # abort decision.
-                with self._txn_mutex(txn_id):
-                    rec = self._read(peer, txn_id) or rec
-                    if (rec["status"] == "pending"
-                            and _now_ms() - (rec["heartbeat_ms"] or 0)
-                            > timeout):
-                        self._abort_locked(peer, txn_id, [], rec)
-                        self._drop_mutex(txn_id)
-                        return {"status": "aborted", "commit_ht": None}
-        return {"status": rec["status"], "commit_ht": rec["commit_ht"]}
+        # The whole read runs under the per-txn mutex: commit() holds it
+        # from picking commit_ht until the replicated write applies, so a
+        # status read can never land inside that window and answer
+        # 'pending' for a transaction about to commit at
+        # commit_ht <= observing_read_ht (which would tear the snapshot —
+        # the clock folding above only covers commits that START after us).
+        with self._txn_mutex(txn_id):
+            rec = self._read(peer, txn_id)
+            if rec is None:
+                # Never created here or already GC'd: treat as aborted
+                # (the reference returns ABORTED for unknown transactions).
+                return {"status": "aborted", "commit_ht": None}
+            if rec["status"] == "pending":
+                timeout = flags.get_flag("transaction_timeout_ms")
+                if _now_ms() - (rec["heartbeat_ms"] or 0) > timeout:
+                    # Lazy expiry: a concurrent heartbeat renewal can't be
+                    # stomped by a stale-read abort — we hold the mutex.
+                    self._abort_locked(peer, txn_id, [], rec)
+                    self._drop_mutex(txn_id)
+                    return {"status": "aborted", "commit_ht": None}
+            return {"status": rec["status"], "commit_ht": rec["commit_ht"]}
 
     def commit(self, peer, txn_id: bytes,
                participants: List[List]) -> dict:
