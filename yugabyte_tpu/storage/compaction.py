@@ -93,19 +93,30 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         return CompactionResult([], 0, 0)
     merged = concat_slabs(slabs)
     params = GCParams(history_cutoff_ht, is_major, retain_deletes)
-    staged = None
-    if device_cache is not None and input_ids is not None:
-        from yugabyte_tpu.storage.device_cache import concat_staged
-        ids = [input_ids[i] for i in keep_idx]
-        staged_list = []
-        for fid, slab in zip(ids, slabs):
-            st = device_cache.get(fid)
-            if st is None:
-                st = device_cache.stage(fid, slab)
-            staged_list.append(st)
-        staged = concat_staged(staged_list)
-    perm, keep, make_tomb = merge_and_gc_device(merged, params, device=device,
-                                                staged=staged)
+    if device == "native":
+        # No JAX device available (e.g. TPU init failed at server start):
+        # the native C++ baseline implements identical merge+GC semantics
+        # (differential-tested vs the kernel) on the host.
+        from yugabyte_tpu.storage.cpu_baseline import compact_cpu_baseline
+        offsets = np.concatenate(
+            ([0], np.cumsum([s.n for s in slabs]))).tolist()
+        perm, keep, make_tomb = compact_cpu_baseline(
+            merged, offsets, history_cutoff_ht, is_major, retain_deletes)
+    else:
+        staged = None
+        if device_cache is not None and input_ids is not None:
+            from yugabyte_tpu.storage.device_cache import concat_staged
+            ids = [input_ids[i] for i in keep_idx]
+            staged_list = []
+            for fid, slab in zip(ids, slabs):
+                st = device_cache.get(fid)
+                if st is None:
+                    st = device_cache.stage(fid, slab)
+                staged_list.append(st)
+            staged = concat_staged(staged_list)
+        perm, keep, make_tomb = merge_and_gc_device(merged, params,
+                                                    device=device,
+                                                    staged=staged)
     surv = perm[keep]                      # input indices, merged order
     tomb_flags = make_tomb[keep]
     rows_out = int(surv.shape[0])

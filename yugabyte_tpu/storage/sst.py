@@ -255,29 +255,36 @@ class SSTReader:
 
 
 class BlockCache:
-    """LRU cache of decoded blocks (ref: util/lru_cache.cc, db/table_cache.cc)."""
+    """LRU cache of decoded blocks (ref: util/lru_cache.cc,
+    db/table_cache.cc). Shared server-wide across all tablets' DBs (keys
+    embed the SST path, so file-id collisions between DBs are impossible);
+    locked because every tablet's read and compaction threads hit it."""
 
     def __init__(self, capacity_bytes: int = 256 * 1024 * 1024):
+        import threading
         from collections import OrderedDict
         self.capacity = capacity_bytes
         self.used = 0
         self._map: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
 
     def get(self, key):
-        item = self._map.get(key)
-        if item is None:
-            return None
-        self._map.move_to_end(key)
-        return item[0]
+        with self._lock:
+            item = self._map.get(key)
+            if item is None:
+                return None
+            self._map.move_to_end(key)
+            return item[0]
 
     def put(self, key, slab: KVSlab, size: int) -> None:
-        if key in self._map:
-            return
-        self._map[key] = (slab, size)
-        self.used += size
-        while self.used > self.capacity and self._map:
-            _, (_, sz) = self._map.popitem(last=False)
-            self.used -= sz
+        with self._lock:
+            if key in self._map:
+                return
+            self._map[key] = (slab, size)
+            self.used += size
+            while self.used > self.capacity and self._map:
+                _, (_, sz) = self._map.popitem(last=False)
+                self.used -= sz
 
 
 def _empty_slab() -> KVSlab:

@@ -37,6 +37,7 @@ class VersionSet:
         self.files: Dict[int, FileMeta] = {}
         self.next_file_id = 1
         self.flushed_frontier: Optional[Frontier] = None
+        self.compactions_installed = 0  # in-memory stat (not persisted)
         self._lock = threading.Lock()
 
     # -- durability ---------------------------------------------------------
@@ -100,6 +101,7 @@ class VersionSet:
                 os.fsync(f.fileno())
             for e in edits:
                 self._apply(e, log=False)
+            self.compactions_installed += 1
 
     def set_flushed_frontier(self, frontier: Frontier) -> None:
         with self._lock:

@@ -94,6 +94,8 @@ class TabletOptions:
     device: object = None
     device_cache: object = None
     compaction_pool: object = None
+    # shared decoded-block cache (ref: db/table_cache.cc — one per server)
+    block_cache: object = None
     auto_compact: bool = True
     memstore_size_bytes: Optional[int] = None
     # Doc-key-space clamp for split children, whose LSM initially holds the
@@ -118,6 +120,7 @@ class Tablet:
             device=self.opts.device,
             device_cache=self.opts.device_cache,
             compaction_pool=self.opts.compaction_pool,
+            block_cache=self.opts.block_cache,
             retention_policy=self.retention_policy.history_cutoff,
             memstore_size_bytes=self.opts.memstore_size_bytes,
             auto_compact=self.opts.auto_compact)
@@ -128,6 +131,7 @@ class Tablet:
             block_entries=self.opts.block_entries,
             device=self.opts.device,
             compaction_pool=self.opts.compaction_pool,
+            block_cache=self.opts.block_cache,
             auto_compact=self.opts.auto_compact)
         self.intents_db = DB(os.path.join(data_dir, "intents"), intents_opts)
         # Flush-ordering invariant (ref: the reference flushes regular
@@ -402,6 +406,7 @@ class Tablet:
                                     txn_id)
         if use_device is None:
             use_device = (self.opts.device is not None
+                          and self.opts.device != "native"
                           and not lower_doc_key and upper_doc_key is None
                           and stream is None)
         if use_device and stream is None:

@@ -1,0 +1,127 @@
+"""ServerExecutionContext: the server-wide TPU dispatch seam.
+
+Capability parity with the reference's shared background-work machinery:
+every tablet's compactions run as tasks on ONE server-wide priority pool
+(ref: rocksdb/db/db_impl.cc:201-440 CompactionTask/FlushTask on
+yb::PriorityThreadPool; util/priority_thread_pool.h:61; pool sizing flag
+`priority_thread_pool_size`, docdb/docdb_rocksdb_util.cc:137), and all
+tablets share one block cache (ref: db/table_cache.cc).
+
+The TPU-native context additionally owns the shared JAX device handle and
+the HBM-resident DeviceSlabCache, so every tablet's compaction rides one
+device queue and one staged-slab working set. Device resolution is
+watchdogged: if the TPU backend cannot initialize within
+`device_init_timeout_s`, compactions fall back to the native C++ merge+GC
+baseline ("native" device sentinel, storage/compaction.py) — the server
+never hangs on a dead accelerator tunnel.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from yugabyte_tpu.storage.device_cache import DeviceSlabCache
+from yugabyte_tpu.storage.sst import BlockCache
+from yugabyte_tpu.tablet.tablet import TabletOptions
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.metrics import MetricRegistry
+from yugabyte_tpu.utils.threadpool import PriorityThreadPool
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag("tserver_compaction_pool_size", 2,
+                  "worker threads in the shared server-wide compaction pool "
+                  "(ref priority_thread_pool_size, "
+                  "docdb_rocksdb_util.cc:137)")
+flags.define_flag("tserver_device", "auto",
+                  "JAX device for the compaction/scan kernels: 'auto' "
+                  "(first visible device, watchdogged), 'none' (native C++ "
+                  "merge+GC only)")
+flags.define_flag("device_init_timeout_s", 30,
+                  "give up on JAX backend initialization after this long "
+                  "and fall back to the native C++ compaction path")
+flags.define_flag("device_slab_cache_bytes", 4 << 30,
+                  "HBM budget for the server-wide staged-slab cache")
+flags.define_flag("block_cache_bytes", 256 << 20,
+                  "host RAM budget for the shared decoded-block cache "
+                  "(ref block cache sizing, docdb_rocksdb_util.cc)")
+
+
+def resolve_device(mode: str, timeout_s: float):
+    """Resolve the shared JAX device, or the 'native' sentinel.
+
+    jax.devices() may hang indefinitely when a TPU tunnel is down, so the
+    touch runs on a daemon thread under a deadline (same failure mode
+    bench.py guards against with a subprocess watchdog)."""
+    if mode == "none":
+        return "native"
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["device"] = jax.devices()[0]
+        except Exception as e:  # backend init failure
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True, name="device-init")
+    t.start()
+    t.join(timeout_s)
+    if "device" in result:
+        TRACE("server device: %s", result["device"])
+        return result["device"]
+    TRACE("JAX device unavailable (%s) — compactions use the native C++ "
+          "merge+GC baseline",
+          result.get("error", f"init exceeded {timeout_s}s"))
+    return "native"
+
+
+class ServerExecutionContext:
+    """One per TabletServer process; every hosted tablet's TabletOptions
+    come from here so compaction pool, device, HBM slab cache and block
+    cache are shared server-wide."""
+
+    def __init__(self, metrics: Optional[MetricRegistry] = None,
+                 device=None):
+        self.pool = PriorityThreadPool(
+            max_threads=flags.get_flag("tserver_compaction_pool_size"),
+            name="compact")
+        self.device = device if device is not None else resolve_device(
+            flags.get_flag("tserver_device"),
+            flags.get_flag("device_init_timeout_s"))
+        self.device_cache = None
+        if self.device != "native":
+            self.device_cache = DeviceSlabCache(
+                self.device,
+                capacity_bytes=flags.get_flag("device_slab_cache_bytes"))
+        self.block_cache = BlockCache(flags.get_flag("block_cache_bytes"))
+        self._entity = None
+        if metrics is not None:
+            e = metrics.entity("server", "execution")
+            self._g_queue = e.gauge("compaction_pool_queue_depth",
+                                    "queued background compactions")
+            self._g_active = e.gauge("compaction_pool_active",
+                                     "running background compactions")
+            self._g_hits = e.gauge("device_cache_hits",
+                                   "HBM slab cache hits")
+            self._g_misses = e.gauge("device_cache_misses",
+                                     "HBM slab cache misses")
+            self._entity = e
+
+    def tablet_options(self) -> TabletOptions:
+        return TabletOptions(device=self.device,
+                             device_cache=self.device_cache,
+                             compaction_pool=self.pool,
+                             block_cache=self.block_cache)
+
+    def refresh_metrics(self) -> None:
+        if self._entity is None:
+            return
+        self._g_queue.set(self.pool.queue_depth())
+        self._g_active.set(self.pool.active_count())
+        if self.device_cache is not None:
+            self._g_hits.set(self.device_cache.hits)
+            self._g_misses.set(self.device_cache.misses)
+
+    def shutdown(self) -> None:
+        self.pool.shutdown(wait=False)

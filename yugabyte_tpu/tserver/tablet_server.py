@@ -51,9 +51,20 @@ class TabletServer:
         self._addr_map: Dict[str, str] = {opts.server_id: self.address}
         self._addr_lock = threading.Lock()
         self.transport = RpcTransport(self.messenger, self._resolve_peer)
+        # The server-wide execution context is the DEFAULT tablet-options
+        # source: every hosted tablet shares one compaction pool, device
+        # handle, HBM slab cache and block cache (ref: db_impl.cc:201-440
+        # shared PriorityThreadPool; a custom factory overrides for tests).
+        self.exec_context = None
+        tablet_options_factory = opts.tablet_options_factory
+        if tablet_options_factory is None:
+            from yugabyte_tpu.tserver.server_context import (
+                ServerExecutionContext)
+            self.exec_context = ServerExecutionContext(metrics=self.metrics)
+            tablet_options_factory = self.exec_context.tablet_options
         self.tablet_manager = TSTabletManager(
             opts.server_id, opts.fs_root, self.transport, clock=self.clock,
-            tablet_options_factory=opts.tablet_options_factory,
+            tablet_options_factory=tablet_options_factory,
             metrics=self.metrics, messenger=self.messenger)
         from yugabyte_tpu.tserver.transaction_coordinator import (
             TransactionCoordinator)
@@ -79,6 +90,8 @@ class TabletServer:
                 "/tablets", self.tablet_manager.generate_report)
 
     def _status_page(self) -> dict:
+        if self.exec_context is not None:
+            self.exec_context.refresh_metrics()
         return {"server_id": self.server_id, "rpc_address": self.address,
                 "num_tablets": len(self.tablet_manager.tablet_ids())}
 
@@ -168,4 +181,6 @@ class TabletServer:
         if self.webserver is not None:
             self.webserver.shutdown()
         self.tablet_manager.shutdown()
+        if self.exec_context is not None:
+            self.exec_context.shutdown()
         self.messenger.shutdown()

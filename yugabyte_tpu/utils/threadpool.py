@@ -56,6 +56,16 @@ class PriorityThreadPool:
                     self._active -= 1
                     self._cv.notify_all()
 
+    def queue_depth(self) -> int:
+        """Queued (not yet running) tasks — the backlog metric the
+        reference exposes for its priority pool."""
+        with self._lock:
+            return len(self._heap)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return self._active
+
     def wait_idle(self) -> None:
         with self._cv:
             while self._heap or self._active:
