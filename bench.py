@@ -49,7 +49,7 @@ def synth_ycsb_runs(n_total: int, n_runs: int, key_space: int, seed: int = 42,
     Key layout (DocDB encoding, docdb/doc_key.py): root = 'S' 'user%08d'
     00 00 '!' (16B); column write = root + 'K' + 2B col id (19B).
     """
-    from yugabyte_tpu.ops.slabs import KVSlab, FLAG_TOMBSTONE
+    from yugabyte_tpu.ops.slabs import KVSlab, FLAG_TOMBSTONE, ValueArray
 
     rng = np.random.default_rng(seed)
     per_run = n_total // n_runs
@@ -100,7 +100,7 @@ def synth_ycsb_runs(n_total: int, n_runs: int, key_space: int, seed: int = 42,
         flags=np.concatenate([p[4] for p in all_parts]),
         ttl_ms=np.zeros(n, dtype=np.int64),
         value_idx=np.arange(n, dtype=np.int32),
-        values=[b""] * n,
+        values=ValueArray.empty_rows(n),
     )
     return slab, offsets
 
@@ -115,6 +115,66 @@ def _workload():
     slab, offsets = synth_ycsb_runs(n_total, n_runs, key_space)
     log(f"  gen: {time.time()-t0:.1f}s")
     return slab, offsets, n_total, cutoff
+
+
+def _attach_values(slab, value_bytes: int):
+    """Give every row a value payload (uniform stride — one big buffer)."""
+    from yugabyte_tpu.ops.slabs import ValueArray
+    n = slab.n
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=n * value_bytes, dtype=np.uint8)
+    offsets = (np.arange(n + 1, dtype=np.int64) * value_bytes)
+    slab.values = ValueArray(data, offsets)
+    return slab
+
+
+def _write_input_ssts(slab, offsets, workdir: str):
+    """Materialize the L0 input runs as real split-SST files on disk."""
+    from yugabyte_tpu.storage.sst import Frontier, SSTWriter
+    in_dir = os.path.join(workdir, "in")
+    os.makedirs(in_dir, exist_ok=True)
+    paths = []
+    for r in range(len(offsets) - 1):
+        sub = _slice_slab(slab, offsets[r], offsets[r + 1])
+        path = os.path.join(in_dir, f"{r:06d}.sst")
+        SSTWriter(path).write(sub, Frontier())
+        paths.append(path)
+    return paths
+
+
+def _e2e_compaction(paths, n_total, cutoff, device, out_dir: str):
+    """End-to-end L0->L1 compaction: SSTs on disk -> read -> merge+GC ->
+    output SSTs on disk (the FULL CompactionJob, ref compaction_job.cc:442,
+    including hot loop ③ block encode). device='native' is the stock
+    CPU architecture doing the same full job over the same files."""
+    import shutil
+    from yugabyte_tpu.storage.compaction import run_compaction_job
+    from yugabyte_tpu.storage.sst import SSTReader
+
+    shutil.rmtree(out_dir, ignore_errors=True)
+    os.makedirs(out_dir)
+    ids = iter(range(1, 1 << 30))
+    readers = [SSTReader(p) for p in paths]
+    t0 = time.time()
+    result = run_compaction_job(readers, out_dir, lambda: next(ids),
+                                cutoff, True, device=device)
+    dt = time.time() - t0
+    for r in readers:
+        r.close()
+    return n_total / dt, result.rows_out
+
+
+def _slice_slab(slab, lo, hi):
+    from yugabyte_tpu.ops.slabs import KVSlab, ValueArray
+    va = slab.values
+    sel = slab.value_idx[lo:hi]
+    return KVSlab(
+        key_words=slab.key_words[lo:hi], key_len=slab.key_len[lo:hi],
+        doc_key_len=slab.doc_key_len[lo:hi], ht_hi=slab.ht_hi[lo:hi],
+        ht_lo=slab.ht_lo[lo:hi], write_id=slab.write_id[lo:hi],
+        flags=slab.flags[lo:hi], ttl_ms=slab.ttl_ms[lo:hi],
+        value_idx=np.arange(hi - lo, dtype=np.int32),
+        values=va.gather(sel))
 
 
 def _cpu_cxx_baseline(slab, offsets, cutoff, n_total):
@@ -139,14 +199,14 @@ def _save_workload(path, slab, offsets, n_total, cutoff, cpu_rate, cpu_kept):
 
 
 def _load_workload(path):
-    from yugabyte_tpu.ops.slabs import KVSlab
+    from yugabyte_tpu.ops.slabs import KVSlab, ValueArray
     z = np.load(path)
     n_total, cutoff, cpu_kept = (int(x) for x in z["meta"])
     slab = KVSlab(key_words=z["key_words"], key_len=z["key_len"],
                   doc_key_len=z["doc_key_len"], ht_hi=z["ht_hi"],
                   ht_lo=z["ht_lo"], write_id=z["write_id"], flags=z["flags"],
                   ttl_ms=z["ttl_ms"], value_idx=z["value_idx"],
-                  values=[b""] * n_total)
+                  values=ValueArray.empty_rows(n_total))
     return slab, list(z["offsets"]), n_total, cutoff, float(z["cpu_rate"][0]), cpu_kept
 
 
@@ -212,6 +272,33 @@ def run_device_child(platform: str, workload_path: str) -> None:
     log(f"  snapshot scan: {scan_s:.2f}s = {n_total/scan_s/1e6:.2f}M rows/s "
         f"({int(keep_scan.sum())} visible)")
 
+    # ---- end-to-end: SSTs on disk -> merge+GC -> SSTs on disk ------------
+    # (VERDICT r1 #3 done-criterion: the FULL job incl. value gather and
+    # block encode, vs the stock CPU architecture doing the same full job)
+    import tempfile
+    e2e_n = int(os.environ.get("YBTPU_BENCH_E2E_N", min(n_total, 1 << 20)))
+    e2e_slab, e2e_offsets = synth_ycsb_runs(e2e_n, 4, max(1, e2e_n // 2))
+    _attach_values(e2e_slab, 64)
+    workdir = tempfile.mkdtemp(prefix="ybtpu-bench-")
+    try:
+        paths = _write_input_ssts(e2e_slab, e2e_offsets, workdir)
+        # warm-up (compile) then measure
+        _e2e_compaction(paths, e2e_n, cutoff, dev,
+                        os.path.join(workdir, "warm"))
+        e2e_rate, e2e_rows = _e2e_compaction(paths, e2e_n, cutoff, dev,
+                                             os.path.join(workdir, "dev"))
+        log(f"  e2e ({platform}): {e2e_rate/1e6:.2f}M rows/s "
+            f"({e2e_rows} rows out)")
+        native_rate, native_rows = _e2e_compaction(
+            paths, e2e_n, cutoff, "native", os.path.join(workdir, "nat"))
+        log(f"  e2e (native C++ merge+GC): {native_rate/1e6:.2f}M rows/s "
+            f"({native_rows} rows out)")
+        assert e2e_rows == native_rows, (
+            f"e2e survivor mismatch: {e2e_rows} vs {native_rows}")
+    finally:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+
     print(json.dumps({
         "metric": "l0_compaction_merge_gc_rows_per_sec",
         "value": round(dev_rate, 1),
@@ -222,6 +309,10 @@ def run_device_child(platform: str, workload_path: str) -> None:
         "cpu_cxx_baseline_rows_per_sec": round(cpu_rate, 1),
         "device_resident_rows_per_sec": round(n_total / res_s, 1),
         "scan_rows_per_sec": round(n_total / scan_s, 1),
+        "e2e_rows_per_sec": round(e2e_rate, 1),
+        "e2e_native_rows_per_sec": round(native_rate, 1),
+        "e2e_vs_native": round(e2e_rate / native_rate, 3),
+        "e2e_n_rows": e2e_n,
         "n_rows": n_total,
     }), flush=True)
 

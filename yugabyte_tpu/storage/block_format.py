@@ -49,9 +49,17 @@ def encode_block(slab: KVSlab, start: int, end: int, compress: bool = False) -> 
     kw = slab.key_words[start:end]
     stride = kw.shape[1] * 4
     key_bytes = kw.astype(">u4").tobytes()
-    vals = [slab.values[int(i)] for i in slab.value_idx[start:end]]
-    val_offsets = np.zeros(n + 1, dtype=np.uint32)
-    np.cumsum([len(v) for v in vals], out=val_offsets[1:])
+    # values: one vectorized gather into (blob, offsets) — the disk layout.
+    # Contiguous value_idx (the normal case after _gather_slab/pack_kvs
+    # normalization) is a zero-copy slice.
+    from yugabyte_tpu.ops.slabs import ValueArray
+    va = ValueArray.from_list(slab.values)
+    vi = slab.value_idx[start:end]
+    if n and int(vi[-1]) - int(vi[0]) == n - 1 \
+            and np.array_equal(vi, np.arange(vi[0], vi[0] + n, dtype=vi.dtype)):
+        vals = va.slice_rows(int(vi[0]), int(vi[0]) + n)
+    else:
+        vals = va.gather(vi)
     body = b"".join([
         key_bytes,
         slab.key_len[start:end].astype(np.uint16).tobytes(),
@@ -61,8 +69,8 @@ def encode_block(slab: KVSlab, start: int, end: int, compress: bool = False) -> 
         slab.write_id[start:end].astype(np.uint32).tobytes(),
         slab.flags[start:end].astype(np.uint8).tobytes(),
         slab.ttl_ms[start:end].astype(np.int64).tobytes(),
-        val_offsets.tobytes(),
-        b"".join(vals),
+        vals.offsets.astype(np.uint32).tobytes(),
+        vals.blob(),
     ])
     raw_len = len(body)
     flags = 0
@@ -110,8 +118,8 @@ def decode_block(data: bytes) -> KVSlab:
     p += 8 * n
     val_offsets = np.frombuffer(body, dtype=np.uint32, count=n + 1, offset=p)
     p += 4 * (n + 1)
-    val_blob = body[p:]
-    values = [val_blob[val_offsets[i]: val_offsets[i + 1]] for i in range(n)]
+    from yugabyte_tpu.ops.slabs import ValueArray
+    values = ValueArray.from_blob(body[p:], val_offsets)  # zero-copy
     return KVSlab(key_words, key_len, doc_key_len, ht_hi, ht_lo, write_id,
                   entry_flags, ttl_ms, np.arange(n, dtype=np.int32), values)
 
