@@ -42,6 +42,52 @@ inline int cmp_entries(const Ctx& c, int64_t a, int64_t b) {
   return 0;
 }
 
+// Component end offsets of a SubDocKey: [dkl, end_of_subkey_1, ...] — the
+// reference's sub_key_ends_ (ref: SubDocKey::DecodeDocKeyAndSubKeyEnds).
+// Tag bytes per docdb/doc_key.py PrimitiveValue: fixed-width payloads or
+// zero-encoded strings terminated by 00 00 (00 01 escapes interior zeros).
+// Returns false when the subkey tail is undecodable (system keys).
+inline bool sub_key_ends(const uint8_t* k, int32_t len, int32_t d,
+                         std::vector<int32_t>* ends) {
+  ends->clear();
+  ends->push_back(d);
+  int32_t pos = d;
+  while (pos < len) {
+    uint8_t tag = k[pos++];
+    switch (tag) {
+      case '$': case 'F': case 'T': break;           // null / false / true
+      case 'H': pos += 4; break;                     // int32
+      case 'I': case 'D': pos += 8; break;           // int64 / double
+      case 'J': case 'K': pos += 2; break;           // system / column id
+      case 'S': case 'Y':                            // zero-encoded bytes
+        for (;;) {
+          if (pos + 1 > len) return false;
+          if (k[pos] != 0) { ++pos; continue; }
+          if (pos + 2 > len) return false;
+          if (k[pos + 1] == 0) { pos += 2; break; }
+          if (k[pos + 1] == 1) { pos += 2; continue; }
+          return false;
+        }
+        break;
+      default:
+        return false;
+    }
+    if (pos > len) return false;
+    ends->push_back(pos);
+  }
+  return true;
+}
+
+// DocHybridTime as an ordered pair; {0,0} doubles as the kMin sentinel
+// (real hybrid times are > 0, so nothing is strictly below it).
+struct Ov {
+  uint64_t ht;
+  uint32_t wid;
+};
+inline bool ov_less(uint64_t ht, uint32_t wid, const Ov& o) {
+  return ht < o.ht || (ht == o.ht && wid < o.wid);
+}
+
 }  // namespace
 
 extern "C" {
@@ -99,13 +145,16 @@ int64_t compact_baseline(
   }
 
   // ---- sequential GC filter state ---------------------------------------
+  // Full overwrite-STACK semantics, mirroring the reference filter (ref:
+  // docdb/docdb_compaction_filter.cc:104-198): one overwrite hybrid time
+  // per key component; a kept at-or-below-cutoff entry pushes
+  // max(parent_ov, own dht) for its subtree; the obsolete check is strict.
   const uint64_t cutoff_phys = cutoff_ht >> 12;
-  int64_t prev = -1;           // previous merged entry
-  bool seen_visible = false;   // a <=cutoff version already kept for cur key
-  int64_t cur_doc = -1;        // entry whose doc prefix defines current doc
-  bool ov_set = false;
-  uint64_t ov_ht = 0;
-  uint32_t ov_wid = 0;
+  std::vector<int32_t> ends;        // current key component ends
+  std::vector<int32_t> prev_ends;   // sub_key_ends_ (updated every entry)
+  std::vector<Ov> overwrite;        // overwrite_ stack
+  std::vector<uint8_t> prev_key;    // prev_subdoc_key_ (kept entries only)
+  int32_t prev_len = 0;
 
   int64_t out = 0, kept = 0;
   while (!heap.empty()) {
@@ -124,50 +173,58 @@ int64_t compact_baseline(
 
     const uint8_t* k = keys + e * stride;
     int32_t len = key_len[e], d = dkl[e];
-    bool same_key = prev >= 0 && key_len[prev] == len &&
-                    memcmp(keys + prev * stride, k, len) == 0;
-    if (!same_key) seen_visible = false;
-    bool same_doc = cur_doc >= 0 && dkl[cur_doc] == d &&
-                    memcmp(keys + cur_doc * stride, k, d) == 0;
-    if (!same_doc) {
-      cur_doc = e;
-      ov_set = false;
+    // bytes shared with prev_subdoc_key_, then truncate the stacks to the
+    // components fully inside the shared prefix
+    int32_t m = len < prev_len ? len : prev_len;
+    int32_t same = 0;
+    while (same < m && k[same] == prev_key[same]) ++same;
+    size_t ns = prev_ends.size();
+    while (ns > 0 && prev_ends[ns - 1] > same) --ns;
+    if (!sub_key_ends(k, len, d, &ends)) {
+      // undecodable subkey tail (system keys): one trailing component
+      ends.clear();
+      ends.push_back(d < len ? d : len);
+      if (d < len) ends.push_back(len);
     }
-    prev = e;
+    size_t new_size = ends.size();
+    if (overwrite.size() > ns) overwrite.resize(ns);
+    Ov prev_ov = overwrite.empty() ? Ov{0, 0} : overwrite.back();
+
+    if (ov_less(ht[e], wid[e], prev_ov)) {
+      // fully overwritten at/before the cutoff by an ancestor or a newer
+      // version of the same key (strict <, ref :166)
+      prev_ends = ends;
+      order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
+      continue;
+    }
+    if (overwrite.size() + 1 < new_size)
+      overwrite.resize(new_size - 1, prev_ov);
+    if (overwrite.size() == new_size) overwrite.pop_back();
 
     bool below = ht[e] <= cutoff_ht;
-    bool visible = false;
-    if (below) {
-      if (seen_visible) {
-        order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
-        continue;  // shadowed old version (docdb_compaction_filter.cc:166)
-      }
-      seen_visible = true;
-      visible = true;
+    prev_ends = ends;
+    prev_key.assign(k, k + len);
+    prev_len = len;
+    if (!below) {
+      overwrite.push_back(prev_ov);  // retained history above the cutoff
+      order_out[out] = e; keep_out[out] = 1; mk_out[out] = 0; ++out; ++kept;
+      continue;
     }
-    bool is_root = len == d;
-    if (is_root && visible && !ov_set) {
-      ov_set = true;           // root version visible at cutoff: overwrites subtree
-      ov_ht = ht[e];
-      ov_wid = wid[e];
-    }
-    if (!is_root && ov_set &&
-        (ht[e] < ov_ht || (ht[e] == ov_ht && wid[e] <= ov_wid))) {
-      order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
-      continue;  // covered by root overwrite (overwrite-stack truncation)
-    }
+    Ov own{ht[e], wid[e]};
+    overwrite.push_back(ov_less(own.ht, own.wid, prev_ov) ? prev_ov : own);
+
     bool has_ttl = flags[e] & 4;
     bool expired = has_ttl &&
         ((ht[e] >> 12) + (uint64_t)ttl_ms[e] * 1000 <= cutoff_phys);
     bool already_tomb = flags[e] & 1;
-    bool tomb = already_tomb || (expired && below);
-    if (below && visible && tomb && is_major && !retain_deletes) {
+    bool tomb = already_tomb || expired;
+    if (tomb && is_major && !retain_deletes) {
       order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
       continue;  // visible tombstone at bottommost level (ref :316-319)
     }
     order_out[out] = e;
     keep_out[out] = 1;
-    mk_out[out] = (expired && below && !already_tomb && !is_major) ? 1 : 0;
+    mk_out[out] = (expired && !already_tomb && !is_major) ? 1 : 0;
     ++out;
     ++kept;
   }

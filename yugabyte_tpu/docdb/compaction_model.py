@@ -12,6 +12,7 @@ Entries: (key_prefix: bytes, doc_key_len: int, dht: DocHybridTime,
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -40,8 +41,33 @@ def sort_key(e: ModelEntry):
     return (e.key, -e.dht.ht.value, -e.dht.write_id)
 
 
+def _common_bytes(a: bytes, b: bytes) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
 def compact_model(entries: List[ModelEntry], history_cutoff_ht: int,
                   is_major: bool, retain_deletes: bool = False) -> List[ModelResult]:
+    """Full overwrite-STACK semantics, mirroring the reference filter
+    exactly (ref: docdb/docdb_compaction_filter.cc:104-198):
+
+    - per-component overwrite hybrid-time stack (sub_key_ends_/overwrite_);
+      a kept entry at or below the cutoff pushes max(parent_ov, own dht) for
+      its subtree, so an overwrite/delete at ANY level covers strictly-older
+      entries at every deeper level
+    - obsolete check is strict (`ht < prev_overwrite_ht`); it also subsumes
+      same-key shadowing (the stack top for a repeated key is its own newer
+      version's overwrite entry)
+    - entries above the cutoff are retained history and push their parent's
+      overwrite unchanged
+    - visible tombstones (incl. TTL-expired) drop at major compactions;
+      at minor compactions expired values rewrite to tombstones
+    """
+    from yugabyte_tpu.ops.slabs import subkey_bounds
+
     ordered = sorted(entries, key=sort_key)
     cutoff_phys_us = history_cutoff_ht >> 12
 
@@ -50,35 +76,48 @@ def compact_model(entries: List[ModelEntry], history_cutoff_ht: int,
             return False
         return (e.dht.ht.physical_micros + e.ttl_ms * 1000) <= cutoff_phys_us
 
-    # Pass 1: per-doc root overwrite DocHybridTime = the root-level version
-    # visible at the cutoff (if any).
-    root_ov: dict = {}
-    seen_visible: dict = {}
-    for e in ordered:
-        doc = e.key[: e.doc_key_len]
-        is_root = len(e.key) == e.doc_key_len
-        below = e.dht.ht.value <= history_cutoff_ht
-        if is_root and below and e.key not in seen_visible:
-            seen_visible[e.key] = e.dht
-            root_ov.setdefault(doc, e.dht)
-
-    # Pass 2: keep/drop per entry.
+    MIN_OV = (-1, -1)
     out: List[ModelResult] = []
-    visible_taken: dict = {}
+    sub_key_ends: List[int] = []
+    overwrite: List[tuple] = []
+    prev_key = b""
     for e in ordered:
+        same = _common_bytes(e.key, prev_key)
+        ns = len(sub_key_ends)
+        while ns > 0 and sub_key_ends[ns - 1] > same:
+            ns -= 1
+        # Re-derive component ends for the current key (the reference
+        # resumes decoding from the shared prefix; bounds depend only on
+        # the key bytes, so a full parse is equivalent).
+        try:
+            sub_key_ends = subkey_bounds(e.key, e.doc_key_len)
+        except (ValueError, IndexError, struct.error):
+            # undecodable subkey tail (system keys): one trailing component
+            sub_key_ends = ([e.doc_key_len, len(e.key)]
+                            if e.doc_key_len < len(e.key)
+                            else [len(e.key)])
+        new_size = len(sub_key_ends)
+        del overwrite[min(len(overwrite), ns):]
+        prev_ov = overwrite[-1] if overwrite else MIN_OV
+        dht_t = (e.dht.ht.value, e.dht.write_id)
+        if dht_t < prev_ov:
+            continue  # fully overwritten at/before the cutoff (strict <)
+        if len(overwrite) < new_size - 1:
+            overwrite.extend([prev_ov] * (new_size - 1 - len(overwrite)))
+        if len(overwrite) == new_size:
+            overwrite.pop()  # same key as previous: replace the stack top
         below = e.dht.ht.value <= history_cutoff_ht
-        if below:
-            if e.key in visible_taken:
-                continue  # an earlier (newer) <=cutoff version shadows it
-            visible_taken[e.key] = True
-        is_root = len(e.key) == e.doc_key_len
-        if not is_root:
-            ov = root_ov.get(e.key[: e.doc_key_len])
-            if ov is not None and (e.dht.ht.value, e.dht.write_id) <= (ov.ht.value, ov.write_id):
-                continue  # overwritten by a root write visible at cutoff
-        tomb = e.is_tombstone or (expired(e) and below)
-        if below and tomb and is_major and not retain_deletes:
+        if not below:
+            overwrite.append(prev_ov)
+            prev_key = e.key
+            out.append(ModelResult(e))  # retained history above the cutoff
+            continue
+        overwrite.append(max(prev_ov, dht_t))
+        prev_key = e.key
+        tomb = e.is_tombstone or expired(e)
+        if tomb and is_major and not retain_deletes:
             continue  # visible tombstone at bottommost level: gone for good
-        out.append(ModelResult(e, as_tombstone=(expired(e) and below
-                                                and not e.is_tombstone and not is_major)))
+        out.append(ModelResult(e, as_tombstone=(expired(e)
+                                                and not e.is_tombstone
+                                                and not is_major)))
     return out

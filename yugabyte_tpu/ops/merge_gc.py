@@ -171,9 +171,11 @@ def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
     safe_pos = jnp.maximum(ov_pos, 0)
     in_same_doc = (ov_pos >= 0) & (doc_seg_id[safe_pos] == doc_seg_id)
     ov_hi, ov_lo, ov_wid = s_ht_hi[safe_pos], s_ht_lo[safe_pos], s_wid[safe_pos]
-    dht_le = (s_ht_hi < ov_hi) | ((s_ht_hi == ov_hi) & (
-        (s_ht_lo < ov_lo) | ((s_ht_lo == ov_lo) & (s_wid <= ov_wid))))
-    covered = (~is_root) & in_same_doc & dht_le
+    # strict <, matching the reference's obsolete check (ref :166 `ht <
+    # prev_overwrite_ht`): an exact DocHybridTime tie is NOT covered
+    dht_lt = (s_ht_hi < ov_hi) | ((s_ht_hi == ov_hi) & (
+        (s_ht_lo < ov_lo) | ((s_ht_lo == ov_lo) & (s_wid < ov_wid))))
+    covered = (~is_root) & in_same_doc & dht_lt
 
     # ---- tombstone GC + result -------------------------------------------
     if snapshot:

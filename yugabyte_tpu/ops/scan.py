@@ -131,15 +131,23 @@ def visible_entries(slabs: Sequence[KVSlab], read_ht_value: int,
     caller holds them in the HBM slab cache; missing ones are staged here.
     """
     from yugabyte_tpu.ops.merge_gc import stage_slab
+    from yugabyte_tpu.ops.slabs import FLAG_DEEP
     from yugabyte_tpu.storage.device_cache import concat_staged
 
+    live = [s for s in slabs if s.n]
+    if any(bool((s.flags & FLAG_DEEP).any()) for s in live):
+        # Deep documents: the kernel's snapshot mode is depth-2 only —
+        # resolve visibility on the host with the full overwrite stack.
+        yield from _visible_entries_host(live, read_ht_value, lower_key,
+                                         upper_key)
+        return
     if staged_inputs is not None:
         pairs = [(sl, st) for sl, st in zip(slabs, staged_inputs) if sl.n]
         slabs = [sl for sl, _ in pairs]
         staged_list = [st if st is not None else stage_slab(sl, device)
                        for sl, st in pairs]
     else:
-        slabs = [s for s in slabs if s.n]
+        slabs = live
         staged_list = [stage_slab(sl, device) for sl in slabs]
     if not slabs:
         return
@@ -168,3 +176,35 @@ def visible_entries(slabs: Sequence[KVSlab], read_ht_value: int,
             continue
         ht = (int(sl.ht_hi[i]) << 32) | int(sl.ht_lo[i])
         yield key, sl.values[int(sl.value_idx[i])], ht
+
+
+def _visible_entries_host(slabs: Sequence[KVSlab], read_ht_value: int,
+                          lower_key: Optional[bytes],
+                          upper_key: Optional[bytes]
+                          ) -> Iterator[Tuple[bytes, bytes, int]]:
+    """Host-side snapshot resolution with FULL overwrite-stack semantics
+    (deep documents). Uses the native merge+GC in snapshot shape: a major
+    compaction at cutoff=read_ht keeps exactly one surviving version per
+    visible key (plus retained history above the read time, filtered
+    here), with tombstones dropped and subtree overwrites applied."""
+    from yugabyte_tpu.ops.slabs import concat_slabs
+    from yugabyte_tpu.storage.cpu_baseline import compact_cpu_baseline
+
+    merged = concat_slabs(slabs)
+    offsets = np.cumsum([0] + [s.n for s in slabs]).tolist()
+    order, keep, _ = compact_cpu_baseline(merged, offsets, read_ht_value,
+                                          True)
+    read_ht = np.uint64(read_ht_value)
+    for i, k in zip(order, keep):
+        if not k:
+            continue
+        i = int(i)
+        ht = (int(merged.ht_hi[i]) << 32) | int(merged.ht_lo[i])
+        if ht > int(read_ht):
+            continue  # history above the read time is not visible
+        key = merged.key_bytes(i)
+        if lower_key is not None and key < lower_key:
+            continue
+        if upper_key is not None and key >= upper_key:
+            break
+        yield key, merged.values[int(merged.value_idx[i])], ht

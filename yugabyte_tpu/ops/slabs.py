@@ -44,6 +44,12 @@ from yugabyte_tpu.docdb.value_type import ValueType
 FLAG_TOMBSTONE = 1
 FLAG_OBJECT_INIT = 2
 FLAG_HAS_TTL = 4
+# Key addresses a document deeper than row+column (2+ subkey levels below
+# the DocKey). The fused device kernel implements only depth-2 overwrite
+# truncation; slabs containing deep entries are routed to the full
+# overwrite-STACK semantic path (native C++ / host model, ref:
+# docdb/docdb_compaction_filter.cc:104-123) by the compaction job and scan.
+FLAG_DEEP = 8
 
 
 class ValueArray:
@@ -272,8 +278,40 @@ def pack_kvs(entries: Sequence[Tuple[bytes, int, bytes]],
         dkl = np.array([_doc_key_len(k) for k in keys], dtype=np.int32)
     else:
         dkl = np.asarray(doc_key_lens, dtype=np.int32)
+    for i, k in enumerate(keys):
+        if len(k) > dkl[i] and subkey_depth(k, int(dkl[i])) > 1:
+            flags[i] |= FLAG_DEEP
     return KVSlab(key_words, key_len, dkl, ht_hi, ht_lo, write_id, flags,
                   ttl_ms, value_idx, ValueArray.from_list(values))
+
+
+def subkey_depth(key_prefix: bytes, doc_key_len: int) -> int:
+    """Number of subkey components below the DocKey (1 = row column,
+    2+ = deep document: collections/jsonb paths)."""
+    from yugabyte_tpu.docdb.doc_key import PrimitiveValue
+    pos = doc_key_len
+    depth = 0
+    n = len(key_prefix)
+    try:
+        while pos < n:
+            _, pos = PrimitiveValue.decode(key_prefix, pos)
+            depth += 1
+    except (ValueError, IndexError, struct.error):
+        return depth + 1  # undecodable tail: treat as deep (conservative)
+    return depth
+
+
+def subkey_bounds(key_prefix: bytes, doc_key_len: int) -> List[int]:
+    """Component end offsets: [doc_key_len, end_of_subkey_1, ...] — the
+    reference's sub_key_ends_ (ref: SubDocKey::DecodeDocKeyAndSubKeyEnds)."""
+    from yugabyte_tpu.docdb.doc_key import PrimitiveValue
+    bounds = [doc_key_len]
+    pos = doc_key_len
+    n = len(key_prefix)
+    while pos < n:
+        _, pos = PrimitiveValue.decode(key_prefix, pos)
+        bounds.append(pos)
+    return bounds
 
 
 def _doc_key_len(key_prefix: bytes) -> int:

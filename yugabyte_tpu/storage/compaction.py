@@ -93,6 +93,13 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         return CompactionResult([], 0, 0)
     merged = concat_slabs(slabs)
     params = GCParams(history_cutoff_ht, is_major, retain_deletes)
+    from yugabyte_tpu.ops.slabs import FLAG_DEEP
+    if device != "native" and bool((merged.flags & FLAG_DEEP).any()):
+        # Documents deeper than row+column: the fused kernel implements
+        # only depth-2 overwrite truncation, so route to the native path,
+        # which carries the full per-component overwrite STACK (ref:
+        # docdb_compaction_filter.cc:104-123).
+        device = "native"
     if device == "native":
         # No JAX device available (e.g. TPU init failed at server start):
         # the native C++ baseline implements identical merge+GC semantics
