@@ -1,0 +1,139 @@
+"""Minimal PostgreSQL v3 wire-protocol client, used by tests to prove the
+YSQL server speaks the real protocol (startup handshake, simple query,
+RowDescription/DataRow parsing, ErrorResponse, ReadyForQuery status)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+
+class PgWireError(Exception):
+    def __init__(self, sqlstate: str, message: str):
+        super().__init__(f"{sqlstate}: {message}")
+        self.sqlstate = sqlstate
+        self.message = message
+
+
+class QueryResult:
+    def __init__(self):
+        self.columns: Optional[List[Tuple[str, int]]] = None
+        self.rows: List[List[Optional[str]]] = []
+        self.tag: Optional[str] = None
+
+
+class PgWireClient:
+    def __init__(self, host: str, port: int, database: str = "postgres",
+                 user: str = "tester", try_ssl: bool = False):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.params = {}
+        self.txn_status = None
+        if try_ssl:
+            self.sock.sendall(struct.pack(">II", 8, 80877103))
+            assert self._recv_exact(1) == b"N", "expected SSL refusal"
+        body = struct.pack(">I", 196608)
+        for k, v in (("user", user), ("database", database)):
+            body += k.encode() + b"\x00" + v.encode() + b"\x00"
+        body += b"\x00"
+        self.sock.sendall(struct.pack(">I", len(body) + 4) + body)
+        # consume until ReadyForQuery
+        while True:
+            t, payload = self._recv_msg()
+            if t == b"R":
+                (code,) = struct.unpack_from(">I", payload, 0)
+                assert code == 0, f"unexpected auth code {code}"
+            elif t == b"S":
+                k, v = payload.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+            elif t == b"K":
+                pass
+            elif t == b"Z":
+                self.txn_status = payload.decode()
+                return
+            elif t == b"E":
+                raise PgWireError(*self._parse_error(payload))
+            else:
+                raise AssertionError(f"unexpected startup message {t!r}")
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("server closed connection")
+            buf += chunk
+        return buf
+
+    def _recv_msg(self):
+        t = self._recv_exact(1)
+        (length,) = struct.unpack(">I", self._recv_exact(4))
+        return t, self._recv_exact(length - 4)
+
+    @staticmethod
+    def _parse_error(payload: bytes):
+        fields = {}
+        pos = 0
+        while pos < len(payload) and payload[pos] != 0:
+            code = chr(payload[pos])
+            end = payload.index(b"\x00", pos + 1)
+            fields[code] = payload[pos + 1: end].decode()
+            pos = end + 1
+        return fields.get("C", "?????"), fields.get("M", "")
+
+    def query(self, sql: str) -> List[QueryResult]:
+        """Simple-query protocol: returns one QueryResult per statement.
+        Raises PgWireError on ErrorResponse (after draining to ready)."""
+        self.sock.sendall(b"Q" + struct.pack(">I", len(sql.encode()) + 5)
+                          + sql.encode() + b"\x00")
+        results = []
+        cur = QueryResult()
+        error = None
+        while True:
+            t, payload = self._recv_msg()
+            if t == b"T":
+                cur.columns = []
+                (n,) = struct.unpack_from(">H", payload, 0)
+                pos = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", pos)
+                    name = payload[pos:end].decode()
+                    (oid,) = struct.unpack_from(">I", payload, end + 7)
+                    cur.columns.append((name, oid))
+                    pos = end + 19
+            elif t == b"D":
+                (n,) = struct.unpack_from(">H", payload, 0)
+                pos = 2
+                row: List[Optional[str]] = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from(">i", payload, pos)
+                    pos += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[pos:pos + ln].decode())
+                        pos += ln
+                cur.rows.append(row)
+            elif t == b"C":
+                cur.tag = payload[:-1].decode()
+                results.append(cur)
+                cur = QueryResult()
+            elif t == b"I":
+                results.append(cur)
+                cur = QueryResult()
+            elif t == b"E":
+                error = PgWireError(*self._parse_error(payload))
+            elif t == b"Z":
+                self.txn_status = payload.decode()
+                if error is not None:
+                    raise error
+                return results
+            else:
+                raise AssertionError(f"unexpected message {t!r}")
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(b"X" + struct.pack(">I", 4))
+        except OSError:
+            pass
+        self.sock.close()

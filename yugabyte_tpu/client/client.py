@@ -146,6 +146,9 @@ class YBClient:
     def list_tables(self, namespace: Optional[str] = None) -> List[dict]:
         return self._master_call("list_tables", namespace=namespace)
 
+    def list_namespaces(self) -> List[str]:
+        return self._master_call("list_namespaces")
+
     def list_tservers(self) -> List[dict]:
         return self._master_call("list_tservers")
 
@@ -239,7 +242,9 @@ class YBClient:
 
     def scan(self, table: YBTable, read_ht: Optional[HybridTime] = None,
              projection: Optional[Sequence[str]] = None,
-             page_size: int = 4096):
+             page_size: int = 4096,
+             filters: Optional[Sequence[Sequence]] = None,
+             txn_id: Optional[bytes] = None):
         """Full-table scan in partition-key order, paging within each
         tablet (ref pg_doc_op.h:399 fan-out + paging). The read point the
         first page resolves is pinned for every later page and tablet, so
@@ -259,7 +264,9 @@ class YBClient:
                     table, tablet, "scan", refresh_key=cursor,
                     lower_doc_key=lower, read_ht=pinned,
                     projection=list(projection) if projection else None,
-                    limit=page_size)
+                    limit=page_size,
+                    filters=[list(f) for f in filters] if filters else None,
+                    txn_id=txn_id)
             except RemoteError as e:
                 # Only split/moved/not-found are worth re-routing; other
                 # errors are deterministic and must surface immediately.

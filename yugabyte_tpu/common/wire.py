@@ -101,3 +101,29 @@ def row_from_wire(w: Optional[dict]):
     return Row(doc_key=doc_key_from_wire(w["doc_key"]),
                columns={int(c): v for c, v in w["columns"].items()},
                write_ht=HybridTime(w["write_ht"]))
+
+
+# ------------------------------------------------------------------ filters
+# Pushed-down WHERE predicates travel the wire as [col, op, value] triples;
+# the SAME comparison semantics (incl. NULL handling: NULL matches nothing
+# except !=) apply tserver-side (pushdown eval) and client-side (residual
+# re-check), so the two can never diverge.
+FILTER_OPS = {
+    "=": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a is not None and a < b,
+    "<=": lambda a, b: a is not None and a <= b,
+    ">": lambda a, b: a is not None and a > b,
+    ">=": lambda a, b: a is not None and a >= b,
+}
+
+
+def row_matches(row_dict: dict, filters) -> bool:
+    """Conjunction of [col, op, value] triples over a name->value dict."""
+    for col, op, value in filters:
+        fn = FILTER_OPS.get(op)
+        if fn is None:
+            raise ValueError(f"unsupported filter op {op!r}")
+        if not fn(row_dict.get(col), value):
+            return False
+    return True

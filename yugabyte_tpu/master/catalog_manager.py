@@ -140,6 +140,10 @@ class CatalogManager:
             self.sys.upsert("namespace", name, meta)
             self.namespaces[name] = meta
 
+    def list_namespaces(self) -> List[str]:
+        with self._lock:
+            return sorted(self.namespaces)
+
     def _find_table(self, namespace: str, name: str) -> Optional[str]:
         for tid, t in self.tables.items():
             if t["namespace"] == namespace and t["name"] == name:

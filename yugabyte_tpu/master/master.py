@@ -86,6 +86,9 @@ class MasterService:
     def list_tables(self, namespace: Optional[str] = None) -> List[dict]:
         return self._leader_catalog().list_tables(namespace)
 
+    def list_namespaces(self) -> List[str]:
+        return self._leader_catalog().list_namespaces()
+
     def get_table_locations(self, table_id: str) -> List[dict]:
         return self._leader_catalog().get_table_locations(table_id)
 

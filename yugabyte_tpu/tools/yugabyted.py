@@ -28,7 +28,8 @@ class YugabytedNode:
     def __init__(self, base_dir: str, master_port: int = 0,
                  tserver_port: int = 0, join: Optional[str] = None,
                  server_id: Optional[str] = None,
-                 replication_factor: Optional[int] = None):
+                 replication_factor: Optional[int] = None,
+                 pg_port: int = 0):
         os.makedirs(base_dir, exist_ok=True)
         if join is None:
             # Single-node bringup defaults to RF1 (ref yugabyted defaults);
@@ -51,9 +52,16 @@ class YugabytedNode:
             master_addrs=master_addrs,
             port=tserver_port)).start()
         self.master_addrs = master_addrs
+        # Query-layer frontends (the reference tserver hosts the postgres
+        # child + CQL/redis servers the same way; ref pg_wrapper.cc)
+        from yugabyte_tpu.client.client import YBClient
+        from yugabyte_tpu.yql.pgsql import PgServer
+        self._pg_client = YBClient(master_addrs)
+        self.pg_server = PgServer(self._pg_client, port=pg_port)
 
     def endpoints(self) -> dict:
         out = {"tserver_rpc": self.tserver.address,
+               "ysql": self.pg_server.address,
                "masters": self.master_addrs}
         if self.tserver.webserver:
             out["tserver_web"] = self.tserver.webserver.address
@@ -64,6 +72,8 @@ class YugabytedNode:
         return out
 
     def shutdown(self) -> None:
+        self.pg_server.shutdown()
+        self._pg_client.close()
         self.tserver.shutdown()
         if self.master is not None:
             self.master.shutdown()
@@ -81,10 +91,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--server-id", default=None)
     p.add_argument("--rf", type=int, default=None,
                    help="replication factor for new tables (default 1)")
+    p.add_argument("--ysql-port", type=int, default=0,
+                   help="YSQL (PG wire) port; 0 = ephemeral (printed at "
+                   "startup), pass 5433 for the PG convention")
     args = ap.parse_args(argv)
     node = YugabytedNode(args.base_dir, args.master_port,
                          args.tserver_port, args.join, args.server_id,
-                         replication_factor=args.rf)
+                         replication_factor=args.rf,
+                         pg_port=args.ysql_port)
     for k, v in node.endpoints().items():
         print(f"{k}: {v}", flush=True)
     stop = []

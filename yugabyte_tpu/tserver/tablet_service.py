@@ -32,6 +32,14 @@ def _leader_server_hint(e: NotLeader) -> Optional[str]:
     return e.leader_hint.split("/", 1)[0]
 
 
+def _row_matches(row_dict: dict, filters: List[List]) -> bool:
+    from yugabyte_tpu.common.wire import row_matches
+    try:
+        return row_matches(row_dict, filters)
+    except ValueError as e:
+        raise StatusError(Status.NotSupported(str(e))) from e
+
+
 class TabletServiceImpl:
     def __init__(self, tablet_manager: TSTabletManager, addr_updater=None,
                  coordinator=None):
@@ -115,10 +123,17 @@ class TabletServiceImpl:
              upper_doc_key: Optional[bytes] = None,
              read_ht: Optional[int] = None,
              projection: Optional[List[str]] = None,
-             limit: int = 10_000) -> dict:
+             limit: int = 10_000,
+             filters: Optional[List[List]] = None,
+             txn_id: Optional[bytes] = None) -> dict:
         """Bounded range scan; returns rows + a resume key when `limit` is
         hit (the reference pages exactly this way, ref
-        pgsql_operation.cc:1040 paging state)."""
+        pgsql_operation.cc:1040 paging state).
+
+        filters: optional [[col, op, value], ...] conjunction evaluated
+        HERE, before rows cross the wire — the pushed-down WHERE clause
+        (ref: ybgate expression pushdown, pgsql_operation.cc:1088
+        per-row filter eval on the tserver)."""
         peer = self._tablets.get_tablet(tablet_id)
         if not peer.raft.is_leader():
             raise NotLeaderError(_leader_server_hint(
@@ -135,10 +150,20 @@ class TabletServiceImpl:
         it = peer.tablet.scan(
             ht, lower_doc_key=lower_doc_key, upper_doc_key=upper_doc_key,
             projection=tuple(projection) if projection else None,
-            use_device=False)
+            use_device=False, txn_id=txn_id)
+        schema = peer.tablet.schema
         rows = []
         resume_key = None
+        scanned = 0
         for row in it:
+            scanned += 1
+            if filters and not _row_matches(row.to_dict(schema), filters):
+                # a filtered-out row still advances the paging cursor so a
+                # highly-selective predicate can't pin the scan in place
+                if scanned >= limit * 4:
+                    resume_key = row.doc_key.encode() + b"\xff"
+                    break
+                continue
             rows.append(row_to_wire(row))
             if len(rows) >= limit:
                 resume_key = row.doc_key.encode() + b"\xff"

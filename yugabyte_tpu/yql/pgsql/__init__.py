@@ -1,0 +1,13 @@
+"""YSQL: the SQL query layer, served over the PostgreSQL wire protocol.
+
+The reference's flagship API is a PostgreSQL 11 fork whose executor calls
+into DocDB through pggate (ref: src/postgres + src/yb/yql/pggate,
+ybc_pggate.h:430 YBCPgExecSelect, pg_doc_op.h:399 fan-out/paging). This
+framework replaces the forked-Postgres approach with a self-contained
+TPU-native SQL layer: a PG-wire v3 server (server.py), a SQL-subset parser
+(parser.py), and an executor playing the pggate role (executor.py) —
+statement -> document operations over the client library, with WHERE
+pushdown to the tservers and paged multi-tablet scans.
+"""
+
+from yugabyte_tpu.yql.pgsql.server import PgServer  # noqa: F401

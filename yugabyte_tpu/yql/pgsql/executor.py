@@ -1,0 +1,387 @@
+"""YSQL executor: SQL statements -> document operations (the pggate role).
+
+Capability parity with the reference's pggate + pgsql doc operations
+(ref: yql/pggate/pggate.h:84 PgApiImpl, pg_doc_op.h:399 PgDocReadOp
+request fan-out/paging, pg_session.h:113 op buffering,
+docdb/pgsql_operation.cc:729/:366 read/write ops). Per-connection state
+(current database, open interactive transaction) lives in PgSession; reads
+push WHERE conjunctions down to the tservers (tablet_service.scan filters,
+the ybgate-pushdown role) and page across tablets via the client library.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from yugabyte_tpu.client.client import YBClient, YBTable
+from yugabyte_tpu.client.transaction import TransactionError, \
+    TransactionManager
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.common.wire import row_matches
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.utils.status import Code, Status, StatusError
+from yugabyte_tpu.yql.pgsql import parser as P
+
+# framework DataType -> PostgreSQL type OID (pg_type.h)
+PG_OIDS = {
+    DataType.INT64: 20, DataType.INT32: 23, DataType.DOUBLE: 701,
+    DataType.FLOAT: 700, DataType.STRING: 25, DataType.BOOL: 16,
+    DataType.BINARY: 17, DataType.TIMESTAMP: 1184,
+}
+
+
+class PgResult:
+    def __init__(self, tag: str, columns: Optional[List[Tuple[str, int]]] = None,
+                 rows: Optional[List[List[object]]] = None):
+        self.tag = tag                       # CommandComplete tag
+        self.columns = columns               # [(name, type_oid)] or None
+        self.rows = rows or []
+
+
+class PgError(StatusError):
+    def __init__(self, status: Status, sqlstate: str = "XX000"):
+        super().__init__(status)
+        self.sqlstate = sqlstate
+
+
+_SQLSTATE = {
+    Code.INVALID_ARGUMENT: "42601",   # syntax_error
+    Code.NOT_FOUND: "42P01",          # undefined_table
+    Code.ALREADY_PRESENT: "42P07",    # duplicate_table
+    Code.NOT_SUPPORTED: "0A000",      # feature_not_supported
+    Code.TRY_AGAIN: "40001",          # serialization_failure
+}
+
+
+def _pg_error(e: StatusError) -> PgError:
+    return PgError(e.status, _SQLSTATE.get(e.status.code, "XX000"))
+
+
+class PgSession:
+    """One connection's executor state (ref pg_session.h:113)."""
+
+    def __init__(self, client: YBClient, txn_manager: TransactionManager,
+                 database: str = "postgres"):
+        self._client = client
+        self._txn_manager = txn_manager
+        self.database = database
+        self._tables: Dict[str, YBTable] = {}
+        self._txn = None
+        self.txn_failed = False
+        # PG connects to an EXISTING database; only the default one is
+        # auto-created (the initdb role). Unknown names fail with 3D000
+        # instead of silently materializing a typo'd namespace.
+        if database == "postgres":
+            try:
+                client.create_namespace(database)
+            except StatusError as e:
+                if e.status.code != Code.ALREADY_PRESENT:
+                    raise
+        elif database not in client.list_namespaces():
+            raise PgError(Status.NotFound(
+                f'database "{database}" does not exist'), "3D000")
+
+    # -------------------------------------------------------------- status
+    @property
+    def in_txn(self) -> bool:
+        return self._txn is not None
+
+    def transaction_status(self) -> str:
+        if self.txn_failed:
+            return "E"
+        return "T" if self._txn is not None else "I"
+
+    # ------------------------------------------------------------- execute
+    def execute(self, sql: str) -> List[PgResult]:
+        try:
+            stmts = P.parse_script(sql)
+        except StatusError as e:
+            raise _pg_error(e) from e
+        out = []
+        for stmt in stmts:
+            if self.txn_failed and not (
+                    isinstance(stmt, P.TxnControl)
+                    and stmt.kind in ("commit", "rollback")):
+                raise PgError(Status.IllegalState(
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block"), "25P02")
+            try:
+                out.append(self._execute_stmt(stmt))
+            except PgError:
+                self._fail_txn()
+                raise
+            except TransactionError as e:
+                self._fail_txn()
+                raise PgError(e.status, "40001") from e
+            except StatusError as e:
+                self._fail_txn()
+                raise _pg_error(e) from e
+        return out
+
+    def _fail_txn(self) -> None:
+        if self._txn is not None:
+            self.txn_failed = True
+
+    def close(self) -> None:
+        if self._txn is not None:
+            try:
+                self._txn.abort()
+            except StatusError:
+                pass
+            self._txn = None
+
+    # ----------------------------------------------------------- dispatch
+    def _execute_stmt(self, stmt: P.Statement) -> PgResult:
+        if isinstance(stmt, P.CreateDatabase):
+            self._client.create_namespace(stmt.name)
+            return PgResult("CREATE DATABASE")
+        if isinstance(stmt, P.DropDatabase):
+            raise PgError(Status.NotSupported("DROP DATABASE"), "0A000")
+        if isinstance(stmt, P.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, P.DropTable):
+            try:
+                self._client.delete_table(self.database, stmt.name)
+            except StatusError as e:
+                if not (stmt.if_exists
+                        and e.status.code == Code.NOT_FOUND):
+                    raise
+            self._tables.pop(stmt.name, None)
+            return PgResult("DROP TABLE")
+        if isinstance(stmt, P.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, P.Select):
+            return self._select(stmt)
+        if isinstance(stmt, P.Update):
+            return self._update(stmt)
+        if isinstance(stmt, P.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, P.TxnControl):
+            return self._txn_control(stmt)
+        if isinstance(stmt, P.Show):
+            value = {"server_version": "11.2 (yugabyte-tpu)",
+                     "transaction_isolation": "repeatable read"}.get(
+                         stmt.name.lower(), "")
+            return PgResult("SHOW", [(stmt.name, 25)], [[value]])
+        raise PgError(Status.NotSupported(str(type(stmt))), "0A000")
+
+    # ---------------------------------------------------------------- DDL
+    def _create_table(self, stmt: P.CreateTable) -> PgResult:
+        cols_by_name = dict(stmt.columns)
+        unknown = [k for k in stmt.pk if k not in cols_by_name]
+        if unknown:
+            raise PgError(Status.InvalidArgument(
+                f"primary key columns not defined: {unknown}"), "42703")
+        # YSQL default: first PK column hash-partitions, the rest are
+        # range components (ref: YSQL PRIMARY KEY (a HASH, b ASC) default)
+        ordered = stmt.pk + [n for n, _t in stmt.columns if n not in stmt.pk]
+        columns = [ColumnSchema(n, DataType[cols_by_name[n]])
+                   for n in ordered]
+        schema = Schema(columns=columns, num_hash_key_columns=1,
+                        num_range_key_columns=len(stmt.pk) - 1)
+        try:
+            self._client.create_table(self.database, stmt.name, schema,
+                                      num_tablets=stmt.num_tablets)
+        except StatusError as e:
+            if not (stmt.if_not_exists
+                    and e.status.code == Code.ALREADY_PRESENT):
+                raise
+        return PgResult("CREATE TABLE")
+
+    def _table(self, name: str) -> YBTable:
+        t = self._tables.get(name)
+        if t is None:
+            t = self._client.open_table(self.database, name)
+            self._tables[name] = t
+        return t
+
+    # ---------------------------------------------------------------- DML
+    def _write(self, table: YBTable, ops: List[QLWriteOp]) -> None:
+        if self._txn is not None:
+            self._txn.write(table, ops)
+        else:
+            self._client.write(table, ops)
+
+    def _insert(self, stmt: P.Insert) -> PgResult:
+        table = self._table(stmt.table)
+        schema = table.schema
+        columns = stmt.columns or [c.name for c in schema.columns]
+        key_names = [c.name for c in schema.hash_columns] + \
+            [c.name for c in schema.range_columns]
+        ops = []
+        for row in stmt.rows:
+            if len(row) != len(columns):
+                raise PgError(Status.InvalidArgument(
+                    "INSERT has more expressions than target columns"),
+                    "42601")
+            bound = dict(zip(columns, row))
+            missing = [k for k in key_names if k not in bound]
+            if missing:
+                raise PgError(Status.InvalidArgument(
+                    f"null value in primary key columns {missing}"),
+                    "23502")
+            dk = DocKey(
+                hash_components=tuple(bound[c.name]
+                                      for c in schema.hash_columns),
+                range_components=tuple(bound[c.name]
+                                       for c in schema.range_columns))
+            values = {c: v for c, v in bound.items() if c not in key_names}
+            ops.append(QLWriteOp(WriteOpKind.INSERT, dk, values))
+        # batch per destination tablet: one write RPC per tablet touched
+        # (ref pg_session.h:222 RunAsync buffering + batcher grouping)
+        groups: Dict[str, List[QLWriteOp]] = {}
+        for op in ops:
+            pk = table.partition_key_for(op.doc_key)
+            tid = self._client.meta_cache.lookup_tablet(
+                table.table_id, pk).tablet_id
+            groups.setdefault(tid, []).append(op)
+        for group in groups.values():
+            self._write(table, group)
+        return PgResult(f"INSERT 0 {len(ops)}")
+
+    # ------------------------------------------------------------- SELECT
+    def _split_where(self, table: YBTable,
+                     where: List[Tuple[str, str, object]]):
+        """-> (doc_key or None, pushdown filters). A full primary key
+        (all components bound by equality) becomes a point read; anything
+        else is pushed down to the tserver scan (ref ybgate pushdown).
+
+        Exactly ONE equality predicate per key column is consumed into the
+        doc key; duplicates (e.g. `id = 1 AND id = 2`) stay in the residual
+        and are re-checked against the fetched row, so contradictory
+        conjunctions correctly return nothing."""
+        schema = table.schema
+        key_names = [c.name for c in schema.hash_columns] + \
+            [c.name for c in schema.range_columns]
+        eq: Dict[str, object] = {}
+        consumed: set = set()
+        for i, (c, op, v) in enumerate(where):
+            if op == "=" and c in key_names and c not in eq:
+                eq[c] = v
+                consumed.add(i)
+        if all(k in eq for k in key_names):
+            dk = DocKey(
+                hash_components=tuple(eq[c.name]
+                                      for c in schema.hash_columns),
+                range_components=tuple(eq[c.name]
+                                       for c in schema.range_columns))
+            residual = [f for i, f in enumerate(where) if i not in consumed]
+            return dk, residual
+        return None, list(where)
+
+    def _select(self, stmt: P.Select) -> PgResult:
+        table = self._table(stmt.table)
+        schema = table.schema
+        known = {c.name for c in schema.columns}
+        out_cols = stmt.columns or [c.name for c in schema.columns]
+        for c in out_cols + [f[0] for f in stmt.where]:
+            if c not in known:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
+        col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
+        dk, filters = self._split_where(table, stmt.where)
+        rows_out: List[List[object]] = []
+        if dk is not None:
+            if self._txn is not None:
+                row = self._txn.read_row(table, dk)
+            else:
+                row = self._client.read_row(table, dk)
+            it = [] if row is None else [row]
+            for row in it:
+                d = row.to_dict(schema)
+                if row_matches(d, filters):
+                    rows_out.append([d.get(c) for c in out_cols])
+        else:
+            count = 0
+            for row in self._scan(table, filters):
+                d = row.to_dict(schema)
+                rows_out.append([d.get(c) for c in out_cols])
+                count += 1
+                if stmt.limit is not None and count >= stmt.limit:
+                    break
+        if stmt.count_star:
+            return PgResult("SELECT 1", [("count", 20)], [[len(rows_out)]])
+        if stmt.limit is not None:
+            rows_out = rows_out[: stmt.limit]
+        return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+
+    # ------------------------------------------------------ UPDATE/DELETE
+    def _scan(self, table: YBTable, filters):
+        """Paged multi-tablet scan; inside a transaction it pins the txn
+        snapshot AND passes the txn id so the scan sees the transaction's
+        own provisional writes (same overlay point reads use)."""
+        read_ht = None
+        txn_id = None
+        if self._txn is not None:
+            from yugabyte_tpu.common.hybrid_time import HybridTime
+            read_ht = HybridTime(self._txn.read_ht)
+            txn_id = self._txn.txn_id
+        return self._client.scan(table, read_ht=read_ht,
+                                 filters=filters or None, txn_id=txn_id)
+
+    def _target_keys(self, table: YBTable,
+                     where: List[Tuple[str, str, object]]):
+        """Doc keys matching WHERE: point lookup for a full key, pushed-
+        down scan otherwise (PG semantics: UPDATE/DELETE take any WHERE)."""
+        schema = table.schema
+        dk, filters = self._split_where(table, where)
+        if dk is not None and not filters:
+            return [dk]
+        if dk is not None:
+            row = (self._txn.read_row(table, dk) if self._txn
+                   else self._client.read_row(table, dk))
+            if row is None:
+                return []
+            d = row.to_dict(schema)
+            return [dk] if row_matches(d, filters) else []
+        return [row.doc_key for row in self._scan(table, filters)]
+
+    def _update(self, stmt: P.Update) -> PgResult:
+        table = self._table(stmt.table)
+        schema = table.schema
+        key_names = {c.name for c in schema.hash_columns} | \
+            {c.name for c in schema.range_columns}
+        bad = [c for c, _v in stmt.assignments if c in key_names]
+        if bad:
+            # a PK update is a row move (delete+insert); not supported
+            raise PgError(Status.NotSupported(
+                f"cannot update primary key column(s) {bad}"), "0A000")
+        keys = self._target_keys(table, stmt.where)
+        for dk in keys:
+            self._write(table, [QLWriteOp(WriteOpKind.UPDATE, dk,
+                                          dict(stmt.assignments))])
+        return PgResult(f"UPDATE {len(keys)}")
+
+    def _delete(self, stmt: P.Delete) -> PgResult:
+        table = self._table(stmt.table)
+        keys = self._target_keys(table, stmt.where)
+        for dk in keys:
+            self._write(table, [QLWriteOp(WriteOpKind.DELETE_ROW, dk)])
+        return PgResult(f"DELETE {len(keys)}")
+
+    # ------------------------------------------------------- transactions
+    def _txn_control(self, stmt: P.TxnControl) -> PgResult:
+        if stmt.kind == "begin":
+            if self._txn is None:
+                self._txn = self._txn_manager.begin()
+            return PgResult("BEGIN")
+        if stmt.kind == "commit":
+            txn, self._txn = self._txn, None
+            failed, self.txn_failed = self.txn_failed, False
+            if txn is None:
+                return PgResult("COMMIT")
+            if failed:
+                txn.abort()
+                return PgResult("ROLLBACK")
+            txn.commit()
+            return PgResult("COMMIT")
+        txn, self._txn = self._txn, None
+        self.txn_failed = False
+        if txn is not None:
+            txn.abort()
+        return PgResult("ROLLBACK")
+
+

@@ -1,0 +1,281 @@
+"""SQL-subset parser for the YSQL layer (PostgreSQL dialect).
+
+Replaces the role of the PG11 parser for the supported surface (ref:
+src/postgres/src/backend/parser; the supported subset mirrors what the
+round's pggate-equivalent executes): CREATE/DROP DATABASE, CREATE/DROP
+TABLE, INSERT (multi-row), SELECT with WHERE conjunctions / LIMIT /
+COUNT(*), UPDATE, DELETE, BEGIN/COMMIT/ROLLBACK.
+
+Reuses the token machinery of the CQL frontend (yql/cql/parser.py) — the
+lexical grammar of the two dialects is identical for this subset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+from yugabyte_tpu.yql.cql.parser import ParseError, Parser as _BaseParser
+
+# PG type name -> framework DataType name (common/schema.py)
+PG_TYPES = {
+    "SMALLINT": "INT64", "INT2": "INT64",
+    "INT": "INT64", "INTEGER": "INT64", "INT4": "INT64",
+    "BIGINT": "INT64", "INT8": "INT64",
+    "TEXT": "STRING", "VARCHAR": "STRING", "CHAR": "STRING",
+    "REAL": "DOUBLE", "FLOAT4": "DOUBLE", "FLOAT8": "DOUBLE",
+    "FLOAT": "DOUBLE",
+    "BOOLEAN": "BOOL", "BOOL": "BOOL",
+    "BYTEA": "BINARY",
+}
+
+
+@dataclass
+class CreateDatabase:
+    name: str
+
+
+@dataclass
+class DropDatabase:
+    name: str
+
+
+@dataclass
+class CreateTable:
+    name: str
+    columns: List[Tuple[str, str]]     # (name, DataType name)
+    pk: List[str]                      # primary key columns, order matters
+    num_tablets: int = 4
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Insert:
+    table: str
+    columns: Optional[List[str]]
+    rows: List[List[object]]
+
+
+@dataclass
+class Select:
+    table: str
+    columns: Optional[List[str]]       # None = *
+    where: List[Tuple[str, str, object]] = field(default_factory=list)
+    limit: Optional[int] = None
+    count_star: bool = False
+
+
+@dataclass
+class Update:
+    table: str
+    assignments: List[Tuple[str, object]]
+    where: List[Tuple[str, str, object]]
+
+
+@dataclass
+class Delete:
+    table: str
+    where: List[Tuple[str, str, object]]
+
+
+@dataclass
+class TxnControl:
+    kind: str                          # begin | commit | rollback
+
+
+@dataclass
+class Show:
+    name: str
+
+
+Statement = Union[CreateDatabase, DropDatabase, CreateTable, DropTable,
+                  Insert, Select, Update, Delete, TxnControl, Show]
+
+
+class PgParser(_BaseParser):
+    def parse_one(self) -> Optional[Statement]:
+        if self.peek() is None:
+            return None
+        if self.accept_kw("CREATE", "DATABASE"):
+            return CreateDatabase(self.name())
+        if self.accept_kw("DROP", "DATABASE"):
+            return DropDatabase(self.name())
+        if self.accept_kw("CREATE", "TABLE"):
+            return self._create_table()
+        if self.accept_kw("DROP", "TABLE"):
+            if_exists = self.accept_kw("IF", "EXISTS")
+            return DropTable(self._table_name(), if_exists)
+        if self.accept_kw("INSERT", "INTO"):
+            return self._insert()
+        if self.accept_kw("SELECT"):
+            return self._select()
+        if self.accept_kw("UPDATE"):
+            return self._update()
+        if self.accept_kw("DELETE", "FROM"):
+            return self._delete()
+        if self.accept_kw("BEGIN") or self.accept_kw("START", "TRANSACTION"):
+            # consume optional BEGIN modifiers (ISOLATION LEVEL ... etc.)
+            while self.peek() and not self._at_semicolon():
+                self.next()
+            return TxnControl("begin")
+        if self.accept_kw("COMMIT") or self.accept_kw("END"):
+            return TxnControl("commit")
+        if self.accept_kw("ROLLBACK") or self.accept_kw("ABORT"):
+            return TxnControl("rollback")
+        if self.accept_kw("SHOW"):
+            return Show(self.name())
+        raise ParseError(f"unsupported statement near {self.peek()!r}")
+
+    def parse_script(self) -> List[Statement]:
+        out = []
+        while True:
+            while self.accept_op(";"):
+                pass
+            stmt = self.parse_one()
+            if stmt is None:
+                return out
+            out.append(stmt)
+            if self.peek() is not None:
+                self.expect_op(";")
+
+    # ----------------------------------------------------------- helpers
+    def _at_semicolon(self) -> bool:
+        tok = self.peek()
+        return tok is not None and tok == ("op", ";")
+
+    def _table_name(self) -> str:
+        # accept (and ignore) a schema qualifier: public.t -> t
+        _, name = self.qualified_name()
+        return name
+
+    def _type_name(self) -> str:
+        t = self.name().upper()
+        if t == "DOUBLE":
+            self.expect_kw("PRECISION")
+            t = "FLOAT8"
+        if t in ("VARCHAR", "CHAR") and self.accept_op("("):
+            self.literal()
+            self.expect_op(")")
+        if t not in PG_TYPES:
+            raise ParseError(f"unsupported type {t}")
+        return PG_TYPES[t]
+
+    def _create_table(self) -> CreateTable:
+        if_not_exists = self.accept_kw("IF", "NOT", "EXISTS")
+        name = self._table_name()
+        self.expect_op("(")
+        columns: List[Tuple[str, str]] = []
+        pk: List[str] = []
+        while True:
+            if self.accept_kw("PRIMARY", "KEY"):
+                self.expect_op("(")
+                while True:
+                    pk.append(self.name())
+                    self.accept_kw("HASH") or self.accept_kw("ASC") \
+                        or self.accept_kw("DESC")
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                col = self.name()
+                columns.append((col, self._type_name()))
+                if self.accept_kw("PRIMARY", "KEY"):
+                    pk.append(col)
+                self.accept_kw("NOT", "NULL")
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        num_tablets = 4
+        if self.accept_kw("SPLIT", "INTO"):
+            num_tablets = int(self.literal())
+            self.expect_kw("TABLETS")
+        if not pk:
+            raise ParseError("CREATE TABLE requires a PRIMARY KEY")
+        return CreateTable(name, columns, pk, num_tablets, if_not_exists)
+
+    def _insert(self) -> Insert:
+        name = self._table_name()
+        columns = None
+        if self.accept_op("("):
+            columns = [self.name()]
+            while self.accept_op(","):
+                columns.append(self.name())
+            self.expect_op(")")
+        self.expect_kw("VALUES")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.literal()]
+            while self.accept_op(","):
+                row.append(self.literal())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return Insert(name, columns, rows)
+
+    def _select(self) -> Select:
+        columns: Optional[List[str]] = None
+        count_star = False
+        if self.accept_op("*"):
+            pass
+        elif self.accept_kw("COUNT"):
+            self.expect_op("(")
+            self.expect_op("*")
+            self.expect_op(")")
+            count_star = True
+        else:
+            columns = [self.name()]
+            while self.accept_op(","):
+                columns.append(self.name())
+        self.expect_kw("FROM")
+        name = self._table_name()
+        where = self._pg_where()
+        limit = None
+        if self.accept_kw("LIMIT"):
+            limit = int(self.literal())
+        return Select(name, columns, where, limit, count_star)
+
+    def _pg_where(self) -> List[Tuple[str, str, object]]:
+        if not self.accept_kw("WHERE"):
+            return []
+        out = []
+        while True:
+            col = self.name()
+            tok = self.next()
+            if tok[0] != "op":
+                raise ParseError(f"expected operator, got {tok[1]!r}")
+            op = tok[1]
+            if op == "<" and self.accept_op(">"):
+                op = "!="  # <> tokenizes as two ops
+            if op not in ("=", "!=", "<", "<=", ">", ">="):
+                raise ParseError(f"unsupported operator {op!r}")
+            out.append((col, op, self.literal()))
+            if not self.accept_kw("AND"):
+                break
+        return out
+
+    def _update(self) -> Update:
+        name = self._table_name()
+        self.expect_kw("SET")
+        assignments = [(self.name(), self._assigned_value())]
+        while self.accept_op(","):
+            assignments.append((self.name(), self._assigned_value()))
+        return Update(name, assignments, self._pg_where())
+
+    def _assigned_value(self):
+        self.expect_op("=")
+        return self.literal()
+
+    def _delete(self) -> Delete:
+        return Delete(self._table_name(), self._pg_where())
+
+
+def parse_script(text: str) -> List[Statement]:
+    return PgParser(text).parse_script()
