@@ -168,6 +168,9 @@ int64_t compact_baseline(
       heap[0] = heap.back();
       heap_run[0] = heap_run.back();
       heap.pop_back();
+      heap_run.pop_back();  // keep the entry<->run pairing aligned: a stale
+                            // heap_run tail mis-advances pos[] after the
+                            // SECOND run exhausts (>=3 unequal runs)
       if (!heap.empty()) sift_down(0);
     }
 

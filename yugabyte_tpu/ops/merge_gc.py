@@ -68,56 +68,27 @@ def _le_u64(a_hi, a_lo, b_hi, b_lo):
     return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
 
 
-def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
-                w: int, is_major: bool, retain_deletes: bool,
-                sort_rows=None, n_sort=None, snapshot: bool = False):
-    """Traceable core: radix merge + GC over one cols matrix.
+def gc_over_sorted(s, w: int, cutoff_hi, cutoff_lo,
+                   cutoff_phys_hi, cutoff_phys_lo,
+                   is_major: bool, retain_deletes: bool,
+                   snapshot: bool = False):
+    """MVCC-GC decisions over an ALREADY-MERGED cols matrix `s` [R, n].
 
-    Reused by the single-chip jit wrapper below and by the distributed
-    per-shard path (parallel/dist_compact.py) inside shard_map.
-    Returns (perm, keep, make_tombstone) as unpacked device arrays.
+    The traceable GC half shared by every merge strategy: the radix path
+    below (sort_and_gc), the pre-sorted-run bitonic merge (ops/run_merge.py)
+    and the distributed per-shard path all produce a key-sorted `s` and then
+    apply this identical filter, so keep/make-tombstone decisions are
+    byte-identical across paths (differential-tested).
 
-    sort_rows/n_sort: optional column-pruned radix schedule (see
-    build_sort_schedule) — constant columns carry no ordering information,
-    so the host drops their passes. Row indices >= _ROW_WORDS sort
-    ascending; the ht/wid rows sort descending (complemented in the body).
-
-    snapshot: SCAN mode — the cutoff is a read time and keep marks exactly
-    the version set visible AT that time: one version per key (the first
-    with dht <= read_ht), minus tombstones, TTL-expired values and
-    root-overwrite-covered entries; versions above the read time are
-    excluded rather than retained as history. This turns the same fused
-    program into the MVCC-resolution half of the scan path (ref: the
-    visibility logic of docdb/intent_aware_iterator.cc +
-    doc_rowwise_iterator.cc done per-iterator-step in the reference).
+    Semantics (ref: docdb/docdb_compaction_filter.cc):
+      - version visibility within full-key segments (:166)
+      - TTL expiry -> tombstone conversion / drop at major (:260-279)
+      - root-subtree overwrite truncation, depth-2 (:104-123)
+      - visible-tombstone drop at major compactions (:316-319)
+    Returns (keep, make_tombstone) bool arrays [n].
     """
-    n = cols.shape[1]
+    n = s.shape[1]
     u32max = jnp.uint32(0xFFFFFFFF)
-
-    # ---- merge: LSD radix passes, least-significant column first ----------
-    # full sequence: wid desc, ht_lo desc, ht_hi desc, key_len asc, words
-    # W-1..0 asc; pruned schedules drop constant columns.
-    if sort_rows is None:
-        sort_rows = jnp.asarray(
-            [_ROW_WID, _ROW_HT_LO, _ROW_HT_HI, _ROW_KEY_LEN]
-            + [_ROW_WORDS + j for j in range(w - 1, -1, -1)], dtype=jnp.int32)
-        n_sort = 4 + w
-
-    def body(k, perm):
-        row = sort_rows[k]
-        invert = jnp.where((row >= _ROW_HT_HI) & (row <= _ROW_WID),
-                           u32max, jnp.uint32(0))
-        col = jax.lax.dynamic_index_in_dim(cols, row, axis=0,
-                                           keepdims=False) ^ invert
-        _, new_perm = jax.lax.sort([col[perm], perm], num_keys=1, is_stable=True)
-        return new_perm
-
-    # (the `cols[0,:1]*0` term imprints cols' varying-axes type on the carry,
-    # required when tracing inside shard_map)
-    perm0 = jnp.arange(n, dtype=jnp.int32) + cols[0, :1].astype(jnp.int32) * 0
-    perm = jax.lax.fori_loop(0, n_sort, body, perm0)
-
-    s = cols[:, perm]                        # gather all rows once
     s_len = s[_ROW_KEY_LEN].astype(jnp.int32)
     s_dkl = s[_ROW_DKL].astype(jnp.int32)
     s_ht_hi, s_ht_lo, s_wid = s[_ROW_HT_HI], s[_ROW_HT_LO], s[_ROW_WID]
@@ -180,11 +151,67 @@ def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
     # ---- tombstone GC + result -------------------------------------------
     if snapshot:
         keep = visible_slot & ~covered & ~is_tomb
-        return perm, keep, jnp.zeros_like(keep)
+        return keep, jnp.zeros_like(keep)
     drop_tomb = (visible_slot & is_tomb & jnp.bool_(is_major)
                  & jnp.bool_(not retain_deletes))
     keep = keep_version & ~covered & ~drop_tomb
     make_tombstone = expired & keep & c & ~already_tomb & jnp.bool_(not is_major)
+    return keep, make_tombstone
+
+
+def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+                w: int, is_major: bool, retain_deletes: bool,
+                sort_rows=None, n_sort=None, snapshot: bool = False):
+    """Traceable core: radix merge + GC over one cols matrix.
+
+    Reused by the single-chip jit wrapper below and by the distributed
+    per-shard path (parallel/dist_compact.py) inside shard_map.
+    Returns (perm, keep, make_tombstone) as unpacked device arrays.
+
+    sort_rows/n_sort: optional column-pruned radix schedule (see
+    build_sort_schedule) — constant columns carry no ordering information,
+    so the host drops their passes. Row indices >= _ROW_WORDS sort
+    ascending; the ht/wid rows sort descending (complemented in the body).
+
+    snapshot: SCAN mode — the cutoff is a read time and keep marks exactly
+    the version set visible AT that time: one version per key (the first
+    with dht <= read_ht), minus tombstones, TTL-expired values and
+    root-overwrite-covered entries; versions above the read time are
+    excluded rather than retained as history. This turns the same fused
+    program into the MVCC-resolution half of the scan path (ref: the
+    visibility logic of docdb/intent_aware_iterator.cc +
+    doc_rowwise_iterator.cc done per-iterator-step in the reference).
+    """
+    n = cols.shape[1]
+    u32max = jnp.uint32(0xFFFFFFFF)
+
+    # ---- merge: LSD radix passes, least-significant column first ----------
+    # full sequence: wid desc, ht_lo desc, ht_hi desc, key_len asc, words
+    # W-1..0 asc; pruned schedules drop constant columns.
+    if sort_rows is None:
+        sort_rows = jnp.asarray(
+            [_ROW_WID, _ROW_HT_LO, _ROW_HT_HI, _ROW_KEY_LEN]
+            + [_ROW_WORDS + j for j in range(w - 1, -1, -1)], dtype=jnp.int32)
+        n_sort = 4 + w
+
+    def body(k, perm):
+        row = sort_rows[k]
+        invert = jnp.where((row >= _ROW_HT_HI) & (row <= _ROW_WID),
+                           u32max, jnp.uint32(0))
+        col = jax.lax.dynamic_index_in_dim(cols, row, axis=0,
+                                           keepdims=False) ^ invert
+        _, new_perm = jax.lax.sort([col[perm], perm], num_keys=1, is_stable=True)
+        return new_perm
+
+    # (the `cols[0,:1]*0` term imprints cols' varying-axes type on the carry,
+    # required when tracing inside shard_map)
+    perm0 = jnp.arange(n, dtype=jnp.int32) + cols[0, :1].astype(jnp.int32) * 0
+    perm = jax.lax.fori_loop(0, n_sort, body, perm0)
+
+    s = cols[:, perm]                        # gather all rows once
+    keep, make_tombstone = gc_over_sorted(
+        s, w, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+        is_major=is_major, retain_deletes=retain_deletes, snapshot=snapshot)
     return perm, keep, make_tombstone
 
 
@@ -236,6 +263,15 @@ def build_sort_schedule(w: int, is_const: np.ndarray) -> Tuple[np.ndarray, int]:
     return padded, n_sort
 
 
+def pack_bits_u32(bits, n: int):
+    """bool [n] -> uint32 [n//32], little-endian lanes (np.unpackbits'
+    bitorder='little' inverse). Shared by every kernel that ships decision
+    masks over the (slow) device->host link."""
+    b32 = bits.reshape(n // 32, 32).astype(jnp.uint32)
+    return (b32 << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+        axis=1, dtype=jnp.uint32)
+
+
 @functools.partial(jax.jit, static_argnames=("w", "is_major", "retain_deletes"))
 def _merge_gc_fused(cols, sort_rows, n_sort,
                     cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
@@ -245,14 +281,7 @@ def _merge_gc_fused(cols, sort_rows, n_sort,
         cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
         w=w, is_major=is_major, retain_deletes=retain_deletes,
         sort_rows=sort_rows, n_sort=n_sort)
-
-    # pack masks 32 bits/word to shrink the (slow) device->host fetch
-    def pack_bits(b):
-        b32 = b.reshape(n // 32, 32).astype(jnp.uint32)
-        return (b32 << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
-            axis=1, dtype=jnp.uint32)
-
-    return perm, pack_bits(keep), pack_bits(make_tombstone)
+    return perm, pack_bits_u32(keep, n), pack_bits_u32(make_tombstone, n)
 
 
 def _unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
@@ -322,16 +351,24 @@ def merge_and_gc_device(slab: Optional[KVSlab], params: GCParams, device=None,
     return perm, keep, mk
 
 
-def pack_cols(slab: KVSlab) -> Tuple[np.ndarray, int, int, int]:
+def pack_cols(slab: KVSlab, n_pad_override: Optional[int] = None,
+              w_pad_override: Optional[int] = None
+              ) -> Tuple[np.ndarray, int, int, int]:
     """Pack a slab into the kernel's contiguous cols matrix (host side).
 
     Padding rows carry all-0xFF keys (greater than any real key: real keys
     zero-pad their final word) so they sort to the tail.
+
+    n_pad_override / w_pad_override: callers building a composite layout
+    (ops/run_merge.py run-major packing) pick their own padded dimensions.
     """
     n = slab.n
-    n_pad = bucket_size(n)
+    n_pad = n_pad_override if n_pad_override is not None else bucket_size(n)
     w = slab.width_words
-    w_pad = 1 << max(2, (w - 1).bit_length() if w > 1 else 1)
+    if w_pad_override is not None:
+        w_pad = w_pad_override
+    else:
+        w_pad = 1 << max(2, (w - 1).bit_length() if w > 1 else 1)
     ttl_us = slab.ttl_ms * 1000
     cols = np.empty((_ROW_WORDS + w_pad, n_pad), dtype=np.uint32)
     cols[:, n:] = pad_template(_ROW_WORDS + w_pad)[:, None]

@@ -110,9 +110,12 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         perm, keep, make_tomb = compact_cpu_baseline(
             merged, offsets, history_cutoff_ht, is_major, retain_deletes)
     else:
-        staged = None
+        # Run-aware device path (ops/run_merge.py): the inputs are sorted
+        # runs, so the kernel merges them with a bitonic network instead of
+        # re-sorting, and ships back packed decisions instead of a full perm.
+        from yugabyte_tpu.ops import run_merge
+        skewed = run_merge.run_layout_inflation([s.n for s in slabs]) > 2.0
         if device_cache is not None and input_ids is not None:
-            from yugabyte_tpu.storage.device_cache import concat_staged
             ids = [input_ids[i] for i in keep_idx]
             staged_list = []
             for fid, slab in zip(ids, slabs):
@@ -120,10 +123,23 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                 if st is None:
                     st = device_cache.stage(fid, slab)
                 staged_list.append(st)
-            staged = concat_staged(staged_list)
-        perm, keep, make_tomb = merge_and_gc_device(merged, params,
-                                                    device=device,
-                                                    staged=staged)
+            if skewed:
+                # one huge run + tiny ones: padding every run to the largest
+                # bucket would inflate HBM/work ~K x; the radix re-sort over
+                # a single bucket is cheaper there
+                from yugabyte_tpu.storage.device_cache import concat_staged
+                perm, keep, make_tomb = merge_and_gc_device(
+                    merged, params, device=device,
+                    staged=concat_staged(staged_list))
+            else:
+                staged_runs = run_merge.stage_runs_from_staged(staged_list)
+                perm, keep, make_tomb = run_merge.merge_and_gc_runs(
+                    slabs, params, device=device, staged=staged_runs)
+        else:
+            # merge_and_gc_runs falls back to the radix kernel itself when
+            # the run layout would inflate
+            perm, keep, make_tomb = run_merge.merge_and_gc_runs(
+                slabs, params, device=device)
     surv = perm[keep]                      # input indices, merged order
     tomb_flags = make_tomb[keep]
     rows_out = int(surv.shape[0])
