@@ -1,0 +1,477 @@
+// Native compaction shell: SST block decode -> merge+GC -> block encode.
+//
+// The production CPU side of the compaction job (ref: CompactionJob::Run,
+// src/yb/rocksdb/db/compaction_job.cc:442, incl. hot loop #3 block building
+// at :958-1024). Round 2 measured ~88% of the full disk-to-disk job spent in
+// the Python shell (block codec, value gather, file plumbing); this engine
+// moves the entire byte path native while Python keeps the metadata
+// authority (index/bloom/props assembly, VersionSet wiring).
+//
+// Used two ways:
+//   - device="native": ce_job_merge runs the shared heap-merge + GC filter
+//     (merge_gc_core.h) — the full reference architecture end to end.
+//   - TPU path: the device kernel computes the merge+GC decisions
+//     (ops/run_merge.py packed decision buffer) and Python injects them via
+//     ce_job_set_survivors; the engine only materializes output bytes.
+//
+// Block format: storage/block_format.py layout, byte-identical.
+// Build: g++ -O3 -shared -fPIC -o libcompaction_engine.so compaction_engine.cc -lz -lpthread
+
+#include <zlib.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "merge_gc_core.h"
+
+namespace {
+
+constexpr uint32_t kBlockMagic = 0x53425459;  // "YTBS"
+constexpr int kHeaderLen = 24;                // 6 x u32
+
+inline uint32_t rd_u32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;  // x86: little-endian, matching struct.pack("<I")
+}
+inline void wr_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+
+struct BlockHandle {
+  int64_t off;
+  int32_t size;
+  int32_t count;
+};
+
+struct InputFile {
+  const uint8_t* data;
+  int64_t size;
+  std::vector<BlockHandle> handles;
+};
+
+struct OutBlockMeta {
+  int64_t off;
+  int32_t size;
+  int32_t count;
+  std::vector<uint8_t> last_key;
+};
+
+struct OutputMeta {
+  std::vector<OutBlockMeta> blocks;
+  std::vector<uint64_t> bloom_hashes;  // one per output row
+  std::vector<uint8_t> first_key, last_key;
+  int64_t data_size = 0;
+};
+
+// FNV-1a over the first len bytes — must match storage/bloom.py fnv64_masked.
+inline uint64_t fnv1a(const uint8_t* p, int32_t len) {
+  uint64_t h = 0xCBF29CE484222325ULL;
+  for (int32_t i = 0; i < len; ++i) h = (h ^ p[i]) * 0x100000001B3ULL;
+  return h;
+}
+
+template <class F>
+void pfor(int64_t n, int n_threads, F&& body) {
+  if (n_threads <= 1 || n <= 1) {
+    for (int64_t i = 0; i < n; ++i) body(i);
+    return;
+  }
+  std::atomic<int64_t> next{0};
+  auto worker = [&] {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      body(i);
+    }
+  };
+  std::vector<std::thread> ts;
+  int t = n_threads < n ? n_threads : (int)n;
+  ts.reserve(t - 1);
+  for (int i = 1; i < t; ++i) ts.emplace_back(worker);
+  worker();
+  for (auto& th : ts) th.join();
+}
+
+struct Job {
+  std::vector<InputFile> inputs;
+  int n_threads = 4;
+  std::string error;
+
+  // decoded SoA (normalized to max stride)
+  int64_t n = 0;
+  int32_t stride = 0;
+  std::vector<uint8_t> keys;
+  std::vector<int32_t> key_len, dkl;
+  std::vector<uint64_t> ht;
+  std::vector<uint32_t> wid;
+  std::vector<uint8_t> flags;
+  std::vector<int64_t> ttl_ms;
+  std::vector<const uint8_t*> val_ptr;
+  std::vector<uint32_t> val_len;
+  std::vector<std::unique_ptr<std::vector<uint8_t>>> decomp;  // owned bodies
+  std::vector<int64_t> run_offsets;
+
+  // merge results
+  std::vector<int64_t> order;
+  std::vector<uint8_t> keep, mk;
+  std::vector<int64_t> surv;      // kept input rows, merged order
+  std::vector<uint8_t> surv_mk;   // rewrite-as-tombstone per survivor
+
+  OutputMeta out;                  // meta of the last written output file
+};
+
+bool decode_block(Job* j, const uint8_t* p, int32_t size, int64_t row0,
+                  int32_t expect_n, const uint8_t** vbase_out) {
+  if (size < kHeaderLen + 4) return false;
+  uint32_t magic = rd_u32(p), n = rd_u32(p + 4), bstride = rd_u32(p + 8);
+  // arrays were sized from the base-file handle counts; a data file paired
+  // with a stale base would otherwise write out of bounds
+  if ((int32_t)n != expect_n) return false;
+  uint32_t bflags = rd_u32(p + 12), body_len = rd_u32(p + 16),
+           raw_len = rd_u32(p + 20);
+  if (magic != kBlockMagic) return false;
+  if ((int64_t)kHeaderLen + body_len + 4 > size) return false;
+  const uint8_t* stored = p + kHeaderLen;
+  uint32_t crc = rd_u32(stored + body_len);
+  uint32_t want = crc32(0, p + 4, kHeaderLen - 4);
+  want = crc32(want, stored, body_len);
+  if (crc != want) return false;
+  const uint8_t* body = stored;
+  if (bflags & 1) {  // zlib
+    auto buf = std::make_unique<std::vector<uint8_t>>(raw_len);
+    uLongf dlen = raw_len;
+    if (uncompress(buf->data(), &dlen, stored, body_len) != Z_OK ||
+        dlen != raw_len)
+      return false;
+    body = buf->data();
+    static std::mutex mu;
+    std::lock_guard<std::mutex> lock(mu);
+    j->decomp.push_back(std::move(buf));
+  }
+  // body layout: keys | key_len u16 | dkl u16 | ht_hi u32 | ht_lo u32 |
+  //              wid u32 | flags u8 | ttl i64 | val_off u32[n+1] | val bytes
+  const uint8_t* q = body;
+  const uint8_t* kq = q;                 q += (int64_t)n * bstride;
+  const uint8_t* klq = q;                q += 2 * (int64_t)n;
+  const uint8_t* dklq = q;               q += 2 * (int64_t)n;
+  const uint8_t* hthq = q;               q += 4 * (int64_t)n;
+  const uint8_t* htlq = q;               q += 4 * (int64_t)n;
+  const uint8_t* widq = q;               q += 4 * (int64_t)n;
+  const uint8_t* flq = q;                q += (int64_t)n;
+  const uint8_t* ttlq = q;               q += 8 * (int64_t)n;
+  const uint8_t* voq = q;                q += 4 * ((int64_t)n + 1);
+  const uint8_t* vb = q;
+  if (q - body > raw_len) return false;
+  for (uint32_t i = 0; i < n; ++i) {
+    int64_t r = row0 + i;
+    memcpy(&j->keys[r * j->stride], kq + (int64_t)i * bstride, bstride);
+    uint16_t kl, dk;
+    memcpy(&kl, klq + 2 * i, 2);
+    memcpy(&dk, dklq + 2 * i, 2);
+    j->key_len[r] = kl;
+    j->dkl[r] = dk;
+    uint32_t hi, lo, w;
+    memcpy(&hi, hthq + 4 * i, 4);
+    memcpy(&lo, htlq + 4 * i, 4);
+    memcpy(&w, widq + 4 * i, 4);
+    j->ht[r] = ((uint64_t)hi << 32) | lo;
+    j->wid[r] = w;
+    j->flags[r] = flq[i];
+    int64_t t;
+    memcpy(&t, ttlq + 8 * i, 8);
+    j->ttl_ms[r] = t;
+    uint32_t v0, v1;
+    memcpy(&v0, voq + 4 * i, 4);
+    memcpy(&v1, voq + 4 * (i + 1), 4);
+    j->val_ptr[r] = vb + v0;
+    j->val_len[r] = v1 - v0;
+  }
+  *vbase_out = vb;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ce_job_new(int n_threads) {
+  Job* j = new Job();
+  j->n_threads = n_threads > 0 ? n_threads : 1;
+  return j;
+}
+
+void ce_job_free(void* jp) { delete (Job*)jp; }
+
+const char* ce_job_error(void* jp) { return ((Job*)jp)->error.c_str(); }
+
+// data must stay valid (Python holds the bytes) until ce_job_free.
+void ce_job_add_input(void* jp, const uint8_t* data, int64_t size,
+                      const int64_t* offs, const int32_t* sizes,
+                      const int32_t* counts, int32_t n_blocks) {
+  Job* j = (Job*)jp;
+  InputFile f{data, size, {}};
+  f.handles.reserve(n_blocks);
+  for (int32_t b = 0; b < n_blocks; ++b)
+    f.handles.push_back({offs[b], sizes[b], counts[b]});
+  j->inputs.push_back(std::move(f));
+}
+
+// Decode every block of every input (parallel). Returns total rows, -1 on
+// corruption.
+int64_t ce_job_prepare(void* jp) {
+  Job* j = (Job*)jp;
+  // pass 1: strides + counts + per-block target row offsets
+  int64_t n = 0;
+  int32_t stride = 4;
+  struct Task { int fi; int bi; int64_t row0; };
+  std::vector<Task> tasks;
+  j->run_offsets.push_back(0);
+  for (size_t fi = 0; fi < j->inputs.size(); ++fi) {
+    InputFile& f = j->inputs[fi];
+    for (size_t bi = 0; bi < f.handles.size(); ++bi) {
+      BlockHandle& h = f.handles[bi];
+      if (h.off + kHeaderLen > f.size) { j->error = "handle oob"; return -1; }
+      uint32_t bstride = rd_u32(f.data + h.off + 8);
+      if ((int32_t)bstride > stride) stride = bstride;
+      tasks.push_back({(int)fi, (int)bi, n});
+      n += h.count;
+    }
+    j->run_offsets.push_back(n);
+  }
+  j->n = n;
+  j->stride = stride;
+  j->keys.assign((size_t)n * stride, 0);
+  j->key_len.resize(n);
+  j->dkl.resize(n);
+  j->ht.resize(n);
+  j->wid.resize(n);
+  j->flags.resize(n);
+  j->ttl_ms.resize(n);
+  j->val_ptr.resize(n);
+  j->val_len.resize(n);
+  std::atomic<bool> ok{true};
+  pfor((int64_t)tasks.size(), j->n_threads, [&](int64_t t) {
+    const Task& task = tasks[t];
+    InputFile& f = j->inputs[task.fi];
+    const BlockHandle& h = f.handles[task.bi];
+    const uint8_t* vb;
+    if (!decode_block(j, f.data + h.off, h.size, task.row0, h.count, &vb))
+      ok.store(false);
+  });
+  if (!ok.load()) { j->error = "block decode/crc failure"; return -1; }
+  return n;
+}
+
+// Merge + GC natively (the reference architecture). Returns survivor count.
+int64_t ce_job_merge(void* jp, uint64_t cutoff_ht, int32_t is_major,
+                     int32_t retain_deletes) {
+  Job* j = (Job*)jp;
+  int64_t n = j->n;
+  j->order.resize(n);
+  j->keep.resize(n);
+  j->mk.resize(n);
+  ybtpu::Ctx c{j->keys.data(), j->key_len.data(), j->stride, j->ht.data(),
+               j->wid.data()};
+  ybtpu::merge_and_filter(c, (int32_t)j->inputs.size(),
+                          j->run_offsets.data(), j->dkl.data(),
+                          j->flags.data(), j->ttl_ms.data(), cutoff_ht,
+                          is_major, retain_deletes, j->keep.data(),
+                          j->mk.data(), j->order.data());
+  j->surv.clear();
+  j->surv_mk.clear();
+  for (int64_t i = 0; i < n; ++i) {
+    if (j->keep[i]) {
+      j->surv.push_back(j->order[i]);
+      j->surv_mk.push_back(j->mk[i]);
+    }
+  }
+  return (int64_t)j->surv.size();
+}
+
+// TPU path: decisions computed on device, injected here.
+void ce_job_set_survivors(void* jp, const int64_t* surv, const uint8_t* mk,
+                          int64_t n_out) {
+  Job* j = (Job*)jp;
+  j->surv.assign(surv, surv + n_out);
+  j->surv_mk.assign(mk, mk + n_out);
+}
+
+int64_t ce_job_rows(void* jp) { return ((Job*)jp)->n; }
+int64_t ce_job_n_survivors(void* jp) { return (int64_t)((Job*)jp)->surv.size(); }
+
+// Write one output data file from survivor range [start, end). Returns the
+// file byte size, or -1 on error. Block encode is parallel; writes are
+// sequential appends.
+int64_t ce_job_write_output(void* jp, int64_t start, int64_t end,
+                            const char* path, int32_t block_entries,
+                            int32_t compress, const uint8_t* tomb_value,
+                            int32_t tomb_len) {
+  Job* j = (Job*)jp;
+  int64_t n_rows = end - start;
+  int64_t n_blocks = block_entries > 0
+                         ? (n_rows + block_entries - 1) / block_entries
+                         : 0;
+  std::vector<std::vector<uint8_t>> bufs(n_blocks);
+  OutputMeta& out = j->out;
+  out.blocks.assign(n_blocks, {});
+  out.bloom_hashes.resize(n_rows);
+  pfor(n_blocks, j->n_threads, [&](int64_t b) {
+    int64_t s0 = start + b * block_entries;
+    int64_t s1 = s0 + block_entries < end ? s0 + block_entries : end;
+    uint32_t bn = (uint32_t)(s1 - s0);
+    // sizes
+    int64_t vtotal = 0;
+    for (int64_t i = s0; i < s1; ++i)
+      vtotal += j->surv_mk[i] ? tomb_len : j->val_len[j->surv[i]];
+    int64_t raw_len = (int64_t)bn * j->stride + 2 * bn + 2 * bn + 4 * bn +
+                      4 * bn + 4 * bn + bn + 8 * bn + 4 * (bn + 1) + vtotal;
+    std::vector<uint8_t> body(raw_len);
+    uint8_t* q = body.data();
+    uint8_t* kq = q;    q += (int64_t)bn * j->stride;
+    uint8_t* klq = q;   q += 2 * (int64_t)bn;
+    uint8_t* dklq = q;  q += 2 * (int64_t)bn;
+    uint8_t* hthq = q;  q += 4 * (int64_t)bn;
+    uint8_t* htlq = q;  q += 4 * (int64_t)bn;
+    uint8_t* widq = q;  q += 4 * (int64_t)bn;
+    uint8_t* flq = q;   q += (int64_t)bn;
+    uint8_t* ttlq = q;  q += 8 * (int64_t)bn;
+    uint8_t* voq = q;   q += 4 * ((int64_t)bn + 1);
+    uint8_t* vb = q;
+    uint32_t voff = 0;
+    for (uint32_t i = 0; i < bn; ++i) {
+      int64_t si = s0 + i;             // survivor slot
+      int64_t r = j->surv[si];         // input row
+      bool as_tomb = j->surv_mk[si] != 0;  // surv_mk is survivor-absolute,
+                                           // like surv (NOT file-relative)
+      memcpy(kq + (int64_t)i * j->stride, &j->keys[r * j->stride], j->stride);
+      uint16_t kl = (uint16_t)j->key_len[r], dk = (uint16_t)j->dkl[r];
+      memcpy(klq + 2 * i, &kl, 2);
+      memcpy(dklq + 2 * i, &dk, 2);
+      uint32_t hi = (uint32_t)(j->ht[r] >> 32), lo = (uint32_t)j->ht[r];
+      memcpy(hthq + 4 * i, &hi, 4);
+      memcpy(htlq + 4 * i, &lo, 4);
+      memcpy(widq + 4 * i, &j->wid[r], 4);
+      uint8_t fl = j->flags[r];
+      int64_t ttl = j->ttl_ms[r];
+      if (as_tomb) { fl |= 1; }
+      flq[i] = fl;
+      memcpy(ttlq + 8 * i, &ttl, 8);
+      memcpy(voq + 4 * i, &voff, 4);
+      if (as_tomb) {
+        memcpy(vb + voff, tomb_value, tomb_len);
+        voff += tomb_len;
+      } else {
+        memcpy(vb + voff, j->val_ptr[r], j->val_len[r]);
+        voff += j->val_len[r];
+      }
+      out.bloom_hashes[si - start] = fnv1a(&j->keys[r * j->stride], dk);
+    }
+    memcpy(voq + 4 * (int64_t)bn, &voff, 4);
+    // header + optional compression + crc
+    std::vector<uint8_t>& blk = bufs[b];
+    std::vector<uint8_t> comp;
+    const uint8_t* stored = body.data();
+    int64_t stored_len = raw_len;
+    uint32_t bflags = 0;
+    if (compress) {
+      uLongf clen = compressBound(raw_len);
+      comp.resize(clen);
+      if (compress2(comp.data(), &clen, body.data(), raw_len, 1) == Z_OK &&
+          (int64_t)clen < raw_len) {
+        stored = comp.data();
+        stored_len = clen;
+        bflags = 1;
+      }
+    }
+    blk.resize(kHeaderLen + stored_len + 4);
+    wr_u32(&blk[0], kBlockMagic);
+    wr_u32(&blk[4], bn);
+    wr_u32(&blk[8], (uint32_t)j->stride);
+    wr_u32(&blk[12], bflags);
+    wr_u32(&blk[16], (uint32_t)stored_len);
+    wr_u32(&blk[20], (uint32_t)raw_len);
+    memcpy(&blk[kHeaderLen], stored, stored_len);
+    uint32_t crc = crc32(0, &blk[4], kHeaderLen - 4);
+    crc = crc32(crc, stored, stored_len);
+    wr_u32(&blk[kHeaderLen + stored_len], crc);
+    // block meta
+    OutBlockMeta& bm = out.blocks[b];
+    bm.count = bn;
+    int64_t last = j->surv[s1 - 1];
+    bm.last_key.assign(&j->keys[last * j->stride],
+                       &j->keys[last * j->stride] + j->key_len[last]);
+  });
+  FILE* fp = fopen(path, "wb");
+  if (!fp) { j->error = "cannot open output"; return -1; }
+  int64_t off = 0;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    out.blocks[b].off = off;
+    out.blocks[b].size = (int32_t)bufs[b].size();
+    if (fwrite(bufs[b].data(), 1, bufs[b].size(), fp) != bufs[b].size()) {
+      fclose(fp);
+      j->error = "short write";
+      return -1;
+    }
+    off += bufs[b].size();
+  }
+  fclose(fp);
+  out.data_size = off;
+  if (n_rows > 0) {
+    int64_t f = j->surv[start], l = j->surv[end - 1];
+    out.first_key.assign(&j->keys[f * j->stride],
+                         &j->keys[f * j->stride] + j->key_len[f]);
+    out.last_key.assign(&j->keys[l * j->stride],
+                        &j->keys[l * j->stride] + j->key_len[l]);
+  } else {
+    out.first_key.clear();
+    out.last_key.clear();
+  }
+  return off;
+}
+
+// --- accessors for the last written output ------------------------------
+int32_t ce_out_n_blocks(void* jp) {
+  return (int32_t)((Job*)jp)->out.blocks.size();
+}
+void ce_out_block_meta(void* jp, int64_t* offs, int32_t* sizes,
+                       int32_t* counts, int32_t* last_key_lens) {
+  Job* j = (Job*)jp;
+  for (size_t b = 0; b < j->out.blocks.size(); ++b) {
+    offs[b] = j->out.blocks[b].off;
+    sizes[b] = j->out.blocks[b].size;
+    counts[b] = j->out.blocks[b].count;
+    last_key_lens[b] = (int32_t)j->out.blocks[b].last_key.size();
+  }
+}
+void ce_out_last_keys(void* jp, uint8_t* buf) {
+  Job* j = (Job*)jp;
+  for (auto& bm : j->out.blocks) {
+    memcpy(buf, bm.last_key.data(), bm.last_key.size());
+    buf += bm.last_key.size();
+  }
+}
+void ce_out_bloom_hashes(void* jp, uint64_t* buf) {
+  Job* j = (Job*)jp;
+  memcpy(buf, j->out.bloom_hashes.data(),
+         j->out.bloom_hashes.size() * sizeof(uint64_t));
+}
+int32_t ce_out_first_key(void* jp, uint8_t* buf, int32_t cap) {
+  Job* j = (Job*)jp;
+  int32_t n = (int32_t)j->out.first_key.size();
+  memcpy(buf, j->out.first_key.data(), n < cap ? n : cap);
+  return n;  // caller re-calls with a bigger buffer if n > cap
+}
+int32_t ce_out_last_key(void* jp, uint8_t* buf, int32_t cap) {
+  Job* j = (Job*)jp;
+  int32_t n = (int32_t)j->out.last_key.size();
+  memcpy(buf, j->out.last_key.data(), n < cap ? n : cap);
+  return n;
+}
+
+}  // extern "C"

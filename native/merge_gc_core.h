@@ -1,0 +1,228 @@
+// Shared native merge + MVCC-GC core.
+//
+// One implementation of the internal-key comparator, the k-way heap merge
+// (ref: src/yb/rocksdb/table/merger.cc:51 MergingIterator) and the
+// sequential overwrite-stack GC filter
+// (ref: src/yb/docdb/docdb_compaction_filter.cc:74-320), used by
+//   - compaction_baseline.cc  (the vs_baseline denominator + differential
+//     test oracle, operating on Python-packed arrays), and
+//   - compaction_engine.cc    (the production native shell: SST block
+//     decode -> merge+GC -> block encode, operating on decoded columns).
+// Keeping the GC semantics in exactly one place is what lets three
+// implementations (TPU kernel, Python model, native) stay byte-identical.
+
+#ifndef YBTPU_MERGE_GC_CORE_H_
+#define YBTPU_MERGE_GC_CORE_H_
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace ybtpu {
+
+struct Ctx {
+  const uint8_t* keys;     // row i at keys + i*stride (raw memcmp bytes)
+  const int32_t* key_len;
+  int32_t stride;
+  const uint64_t* ht;
+  const uint32_t* wid;
+};
+
+// internal-key comparator: key memcmp asc, then ht desc, then wid desc
+inline int cmp_entries(const Ctx& c, int64_t a, int64_t b) {
+  const uint8_t* ka = c.keys + a * c.stride;
+  const uint8_t* kb = c.keys + b * c.stride;
+  int32_t la = c.key_len[a], lb = c.key_len[b];
+  int32_t m = la < lb ? la : lb;
+  int r = memcmp(ka, kb, m);
+  if (r) return r;
+  if (la != lb) return la < lb ? -1 : 1;
+  if (c.ht[a] != c.ht[b]) return c.ht[a] > c.ht[b] ? -1 : 1;  // desc
+  if (c.wid[a] != c.wid[b]) return c.wid[a] > c.wid[b] ? -1 : 1;
+  return 0;
+}
+
+// Component end offsets of a SubDocKey: [dkl, end_of_subkey_1, ...] — the
+// reference's sub_key_ends_ (ref: SubDocKey::DecodeDocKeyAndSubKeyEnds).
+// Tag bytes per docdb/doc_key.py PrimitiveValue: fixed-width payloads or
+// zero-encoded strings terminated by 00 00 (00 01 escapes interior zeros).
+// Returns false when the subkey tail is undecodable (system keys).
+inline bool sub_key_ends(const uint8_t* k, int32_t len, int32_t d,
+                         std::vector<int32_t>* ends) {
+  ends->clear();
+  ends->push_back(d);
+  int32_t pos = d;
+  while (pos < len) {
+    uint8_t tag = k[pos++];
+    switch (tag) {
+      case '$': case 'F': case 'T': break;           // null / false / true
+      case 'H': pos += 4; break;                     // int32
+      case 'I': case 'D': pos += 8; break;           // int64 / double
+      case 'J': case 'K': pos += 2; break;           // system / column id
+      case 'S': case 'Y':                            // zero-encoded bytes
+        for (;;) {
+          if (pos + 1 > len) return false;
+          if (k[pos] != 0) { ++pos; continue; }
+          if (pos + 2 > len) return false;
+          if (k[pos + 1] == 0) { pos += 2; break; }
+          if (k[pos + 1] == 1) { pos += 2; continue; }
+          return false;
+        }
+        break;
+      default:
+        return false;
+    }
+    if (pos > len) return false;
+    ends->push_back(pos);
+  }
+  return true;
+}
+
+// DocHybridTime as an ordered pair; {0,0} doubles as the kMin sentinel
+// (real hybrid times are > 0, so nothing is strictly below it).
+struct Ov {
+  uint64_t ht;
+  uint32_t wid;
+};
+inline bool ov_less(uint64_t ht, uint32_t wid, const Ov& o) {
+  return ht < o.ht || (ht == o.ht && wid < o.wid);
+}
+
+// The full merge + filter loop. Writes the merged order into order_out and
+// per-merged-position keep/make-tombstone into keep_out/mk_out (all length
+// n). Returns the number of kept entries.
+inline int64_t merge_and_filter(
+    const Ctx& c, int32_t n_runs, const int64_t* run_offsets,
+    const int32_t* dkl, const uint8_t* flags, const int64_t* ttl_ms,
+    uint64_t cutoff_ht, int32_t is_major, int32_t retain_deletes,
+    uint8_t* keep_out, uint8_t* mk_out, int64_t* order_out) {
+  // ---- binary min-heap of run heads (MergingIterator) --------------------
+  std::vector<int64_t> heap;      // entry index
+  std::vector<int32_t> heap_run;  // owning run
+  std::vector<int64_t> pos(n_runs);
+  heap.reserve(n_runs);
+  auto heap_less = [&](size_t i, size_t j) {
+    return cmp_entries(c, heap[i], heap[j]) < 0;
+  };
+  auto sift_up = [&](size_t i) {
+    while (i > 0) {
+      size_t p = (i - 1) / 2;
+      if (heap_less(i, p)) {
+        std::swap(heap[i], heap[p]);
+        std::swap(heap_run[i], heap_run[p]);
+        i = p;
+      } else break;
+    }
+  };
+  auto sift_down = [&](size_t i) {
+    size_t sz = heap.size();
+    for (;;) {
+      size_t l = 2 * i + 1, r = l + 1, s = i;
+      if (l < sz && heap_less(l, s)) s = l;
+      if (r < sz && heap_less(r, s)) s = r;
+      if (s == i) break;
+      std::swap(heap[i], heap[s]);
+      std::swap(heap_run[i], heap_run[s]);
+      i = s;
+    }
+  };
+  for (int32_t r = 0; r < n_runs; ++r) {
+    pos[r] = run_offsets[r];
+    if (pos[r] < run_offsets[r + 1]) {
+      heap.push_back(pos[r]);
+      heap_run.push_back(r);
+      sift_up(heap.size() - 1);
+    }
+  }
+
+  // ---- sequential GC filter state ---------------------------------------
+  // Full overwrite-STACK semantics, mirroring the reference filter (ref:
+  // docdb/docdb_compaction_filter.cc:104-198): one overwrite hybrid time
+  // per key component; a kept at-or-below-cutoff entry pushes
+  // max(parent_ov, own dht) for its subtree; the obsolete check is strict.
+  const uint64_t cutoff_phys = cutoff_ht >> 12;
+  std::vector<int32_t> ends;        // current key component ends
+  std::vector<int32_t> prev_ends;   // sub_key_ends_ (updated every entry)
+  std::vector<Ov> overwrite;        // overwrite_ stack
+  std::vector<uint8_t> prev_key;    // prev_subdoc_key_ (kept entries only)
+  int32_t prev_len = 0;
+
+  int64_t out = 0, kept = 0;
+  while (!heap.empty()) {
+    int64_t e = heap[0];
+    int32_t run = heap_run[0];
+    // advance the winning run (pop + push next = replace top + sift)
+    if (++pos[run] < run_offsets[run + 1]) {
+      heap[0] = pos[run];
+      sift_down(0);
+    } else {
+      heap[0] = heap.back();
+      heap_run[0] = heap_run.back();
+      heap.pop_back();
+      heap_run.pop_back();  // keep the entry<->run pairing aligned
+      if (!heap.empty()) sift_down(0);
+    }
+
+    const uint8_t* k = c.keys + e * c.stride;
+    int32_t len = c.key_len[e], d = dkl[e];
+    // bytes shared with prev_subdoc_key_, then truncate the stacks to the
+    // components fully inside the shared prefix
+    int32_t m = len < prev_len ? len : prev_len;
+    int32_t same = 0;
+    while (same < m && k[same] == prev_key[same]) ++same;
+    size_t ns = prev_ends.size();
+    while (ns > 0 && prev_ends[ns - 1] > same) --ns;
+    if (!sub_key_ends(k, len, d, &ends)) {
+      // undecodable subkey tail (system keys): one trailing component
+      ends.clear();
+      ends.push_back(d < len ? d : len);
+      if (d < len) ends.push_back(len);
+    }
+    size_t new_size = ends.size();
+    if (overwrite.size() > ns) overwrite.resize(ns);
+    Ov prev_ov = overwrite.empty() ? Ov{0, 0} : overwrite.back();
+
+    if (ov_less(c.ht[e], c.wid[e], prev_ov)) {
+      // fully overwritten at/before the cutoff by an ancestor or a newer
+      // version of the same key (strict <, ref :166)
+      prev_ends = ends;
+      order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
+      continue;
+    }
+    if (overwrite.size() + 1 < new_size)
+      overwrite.resize(new_size - 1, prev_ov);
+    if (overwrite.size() == new_size) overwrite.pop_back();
+
+    bool below = c.ht[e] <= cutoff_ht;
+    prev_ends = ends;
+    prev_key.assign(k, k + len);
+    prev_len = len;
+    if (!below) {
+      overwrite.push_back(prev_ov);  // retained history above the cutoff
+      order_out[out] = e; keep_out[out] = 1; mk_out[out] = 0; ++out; ++kept;
+      continue;
+    }
+    Ov own{c.ht[e], c.wid[e]};
+    overwrite.push_back(ov_less(own.ht, own.wid, prev_ov) ? prev_ov : own);
+
+    bool has_ttl = flags[e] & 4;
+    bool expired = has_ttl &&
+        ((c.ht[e] >> 12) + (uint64_t)ttl_ms[e] * 1000 <= cutoff_phys);
+    bool already_tomb = flags[e] & 1;
+    bool tomb = already_tomb || expired;
+    if (tomb && is_major && !retain_deletes) {
+      order_out[out] = e; keep_out[out] = 0; mk_out[out] = 0; ++out;
+      continue;  // visible tombstone at bottommost level (ref :316-319)
+    }
+    order_out[out] = e;
+    keep_out[out] = 1;
+    mk_out[out] = (expired && !already_tomb && !is_major) ? 1 : 0;
+    ++out;
+    ++kept;
+  }
+  return kept;
+}
+
+}  // namespace ybtpu
+
+#endif  // YBTPU_MERGE_GC_CORE_H_

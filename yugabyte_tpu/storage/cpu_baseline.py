@@ -29,14 +29,9 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    # `<=`, not `<`: a fresh checkout gives source and any stale binary the
-    # SAME mtime, and a foreign-machine -march=native .so must never run here
-    if (not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) <= os.path.getmtime(_SRC)):
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        subprocess.run(["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                        "-o", _LIB, _SRC], check=True)
-    _lib = ctypes.CDLL(_LIB)
+    from yugabyte_tpu.utils.native_build import build_native_lib
+    _lib = ctypes.CDLL(build_native_lib("compaction_baseline.cc",
+                                        "libcompaction_baseline.so"))
     _lib.compact_baseline.restype = ctypes.c_int64
     return _lib
 

@@ -103,8 +103,7 @@ class SSTWriter:
     def write(self, slab: KVSlab, frontier: Optional[Frontier] = None) -> SSTProps:
         n = slab.n
         data_path = data_file_name(self.base_path)
-        index_keys: List[bytes] = []
-        index_vals: List[bytes] = []
+        index_items: List[Tuple[bytes, int, int, int]] = []
         data_off = 0
         key_raw = slab.key_words.astype(">u4").tobytes()
         stride = slab.width_words * 4
@@ -117,40 +116,60 @@ class SSTWriter:
                 end = min(start + self.block_entries, n)
                 blk = block_format.encode_block(slab, start, end, self.compress)
                 df.write(blk)
-                index_keys.append(key_at(end - 1))
-                index_vals.append(struct.pack("<QII", data_off, len(blk), end - start))
+                index_items.append((key_at(end - 1), data_off, len(blk),
+                                    end - start))
                 data_off += len(blk)
-            if n == 0:
-                pass
-        # bloom over doc-key prefixes
-        bloom = BloomFilterBuilder(max(n, 1), self.bits_per_key)
         if n:
             u8 = np.frombuffer(key_raw, dtype=np.uint8).reshape(n, stride)
-            bloom.add_hashes(fnv64_masked(u8, slab.doc_key_len.astype(np.int64)))
-        bloom_bytes = bloom.finish()
-        # index block: a mini-slab of (last_key -> block handle)
-        index_bytes = _encode_index(index_keys, index_vals)
-        props = SSTProps(
-            n_entries=n,
-            first_key=key_at(0) if n else b"",
-            last_key=key_at(n - 1) if n else b"",
-            frontier=frontier or Frontier(),
-            data_size=data_off,
-        )
-        props_bytes = json.dumps(props.to_json()).encode()
-        with open(self.base_path, "wb") as bf:
-            index_off = 0
-            bf.write(index_bytes)
-            bloom_off = bf.tell()
-            bf.write(bloom_bytes)
-            props_off = bf.tell()
-            bf.write(props_bytes)
-            crc = zlib.crc32(index_bytes) ^ zlib.crc32(bloom_bytes) ^ zlib.crc32(props_bytes)
-            bf.write(_FOOTER.pack(index_off, len(index_bytes), bloom_off,
-                                  len(bloom_bytes), props_off, len(props_bytes),
-                                  data_off, crc, SST_MAGIC))
-            props.base_size = bf.tell()
-        return props
+            hashes = fnv64_masked(u8, slab.doc_key_len.astype(np.int64))
+        else:
+            hashes = np.zeros(0, dtype=np.uint64)
+        return write_base_file(
+            self.base_path, index_items, n, hashes,
+            key_at(0) if n else b"", key_at(n - 1) if n else b"",
+            frontier, data_off, self.bits_per_key)
+
+
+def write_base_file(base_path: str,
+                    index_items: List[Tuple[bytes, int, int, int]],
+                    n_entries: int, bloom_hashes: np.ndarray,
+                    first_key: bytes, last_key: bytes,
+                    frontier: Optional[Frontier], data_size: int,
+                    bits_per_key: int = 10) -> SSTProps:
+    """Assemble the base (metadata) file from precomputed parts.
+
+    index_items: (last_key, data_offset, block_size, n_entries) per data
+    block. Shared by the Python SSTWriter and the native compaction shell
+    (storage/native_engine.py), which produces the parts in C++.
+    """
+    bloom = BloomFilterBuilder(max(n_entries, 1), bits_per_key)
+    if n_entries:
+        bloom.add_hashes(np.asarray(bloom_hashes, dtype=np.uint64))
+    bloom_bytes = bloom.finish()
+    index_bytes = _encode_index(
+        [it[0] for it in index_items],
+        [struct.pack("<QII", it[1], it[2], it[3]) for it in index_items])
+    props = SSTProps(
+        n_entries=n_entries,
+        first_key=first_key,
+        last_key=last_key,
+        frontier=frontier or Frontier(),
+        data_size=data_size,
+    )
+    props_bytes = json.dumps(props.to_json()).encode()
+    with open(base_path, "wb") as bf:
+        index_off = 0
+        bf.write(index_bytes)
+        bloom_off = bf.tell()
+        bf.write(bloom_bytes)
+        props_off = bf.tell()
+        bf.write(props_bytes)
+        crc = zlib.crc32(index_bytes) ^ zlib.crc32(bloom_bytes) ^ zlib.crc32(props_bytes)
+        bf.write(_FOOTER.pack(index_off, len(index_bytes), bloom_off,
+                              len(bloom_bytes), props_off, len(props_bytes),
+                              data_size, crc, SST_MAGIC))
+        props.base_size = bf.tell()
+    return props
 
 
 def _encode_index(keys: List[bytes], vals: List[bytes]) -> bytes:

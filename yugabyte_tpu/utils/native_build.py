@@ -1,0 +1,44 @@
+"""Build-on-first-use for the native (C++) components.
+
+One place owns the compile-if-stale rule so every .so rebuilds under the
+same conditions: rebuild when missing, or when mtime <= the NEWEST of the
+source and its header deps. `<=`, not `<`: a fresh checkout gives sources
+and any stale binary the SAME mtime, and a foreign-machine -march=native
+binary must never run here.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+_lock = threading.Lock()
+
+
+def build_native_lib(src_name: str, lib_name: str,
+                     deps: Sequence[str] = ("merge_gc_core.h",),
+                     extra_args: Sequence[str] = ()) -> str:
+    """Compile native/<src_name> into native/build/<lib_name> if stale.
+
+    Returns the .so path; raises CalledProcessError on compile failure.
+    """
+    src = os.path.join(NATIVE_DIR, src_name)
+    lib = os.path.join(BUILD_DIR, lib_name)
+    with _lock:
+        src_mtime = os.path.getmtime(src)
+        for d in deps:
+            p = os.path.join(NATIVE_DIR, d)
+            if os.path.exists(p):
+                src_mtime = max(src_mtime, os.path.getmtime(p))
+        if not os.path.exists(lib) or os.path.getmtime(lib) <= src_mtime:
+            os.makedirs(BUILD_DIR, exist_ok=True)
+            subprocess.run(["g++", "-O3", "-march=native", "-shared",
+                            "-fPIC", "-o", lib, src, *extra_args],
+                           check=True)
+    return lib
