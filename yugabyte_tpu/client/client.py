@@ -45,6 +45,9 @@ class YBTable:
         self.schema: Schema = schema_from_wire(meta["schema"])
         self.partition_schema: PartitionSchema = partition_schema_from_wire(
             meta["partition_schema"])
+        # secondary indexes attached to this table (common/index.IndexInfo
+        # wire dicts); maintained by the query layers on DML
+        self.indexes: List[dict] = list(meta.get("indexes", []))
 
     def partition_key_for(self, doc_key: DocKey) -> bytes:
         return self.partition_schema.partition_key(
@@ -138,6 +141,14 @@ class YBClient:
 
     def delete_table(self, namespace: str, name: str) -> None:
         self._master_call("delete_table", namespace=namespace, name=name)
+
+    def create_index(self, namespace: str, table: str, index_name: str,
+                     column: str, num_tablets: int = 2) -> dict:
+        """Create a secondary index and run its online backfill; returns
+        the IndexInfo wire dict with state 'readable' on success."""
+        return self._master_call(
+            "create_index", namespace=namespace, table=table,
+            index_name=index_name, column=column, num_tablets=num_tablets)
 
     def open_table(self, namespace: str, name: str) -> YBTable:
         return YBTable(self._master_call("get_table", namespace=namespace,

@@ -1,0 +1,131 @@
+"""Secondary index metadata + entry construction, shared by the master
+(DDL + backfill orchestration), tservers (tablet-side backfill) and the
+query layers (transactional index maintenance + index-accelerated reads).
+
+Design follows the reference's YSQL index architecture: the index is a
+REGULAR table whose hash key is the indexed column and whose range keys are
+the indexed table's primary key columns (ref: src/yb/master/
+catalog_manager.cc index-table creation; src/yb/common/index.h IndexInfo).
+Maintenance happens in the query layer inside the statement's distributed
+transaction — the same placement as the reference's YSQL path, where the
+postgres layer (pggate) issues index writes as separate ops in one
+transaction (ref: src/yb/yql/pggate/pg_dml_write.cc) — rather than inside
+the tablet write path.
+
+States (ref index permissions, common/index.h:51): a freshly created index
+is 'backfilling' — writers maintain it (write-and-delete mode) but readers
+must not use it; after the master-orchestrated backfill completes it turns
+'readable'.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from yugabyte_tpu.common.schema import ColumnSchema, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.utils import flags
+
+flags.define_flag("table_cache_ttl_ms", 500,
+                  "query-layer table-handle cache TTL — the schema/index "
+                  "propagation window (the reference propagates schema "
+                  "versions via heartbeats and rejects stale-version ops); "
+                  "the master's index-backfill grace is derived from it")
+
+STATE_BACKFILLING = "backfilling"
+STATE_READABLE = "readable"
+
+
+@dataclass
+class IndexInfo:
+    index_name: str
+    index_table_id: str
+    column: str
+    state: str = STATE_BACKFILLING
+
+    def to_wire(self) -> dict:
+        return {"index_name": self.index_name,
+                "index_table_id": self.index_table_id,
+                "column": self.column, "state": self.state}
+
+    @staticmethod
+    def from_wire(w: dict) -> "IndexInfo":
+        return IndexInfo(w["index_name"], w["index_table_id"], w["column"],
+                         w.get("state", STATE_BACKFILLING))
+
+
+def indexes_from_meta(table_meta: dict) -> List[IndexInfo]:
+    return [IndexInfo.from_wire(w) for w in table_meta.get("indexes", [])]
+
+
+def index_table_schema(main_schema: Schema, column: str) -> Schema:
+    """Schema of the index table: indexed column hashes, main PK ranges."""
+    col = main_schema.column(column)
+    key_cols = (main_schema.hash_columns + main_schema.range_columns)
+    if column in {c.name for c in key_cols}:
+        raise ValueError(f"column {column!r} is already a key column")
+    columns = [ColumnSchema(col.name, col.type, nullable=False)]
+    for kc in key_cols:
+        columns.append(ColumnSchema(f"pk_{kc.name}", kc.type,
+                                    nullable=False))
+    return Schema(columns=columns, num_hash_key_columns=1,
+                  num_range_key_columns=len(key_cols))
+
+
+def index_doc_key(value, main_doc_key: DocKey) -> DocKey:
+    """Index entry key: (indexed value) -> (main table primary key)."""
+    return DocKey(
+        hash_components=(value,),
+        range_components=tuple(main_doc_key.hash_components)
+        + tuple(main_doc_key.range_components))
+
+
+def main_doc_key_from_index_row(row_dict: dict, main_schema: Schema,
+                                index_schema: Schema) -> DocKey:
+    """Recover the main-table DocKey from a decoded index row."""
+    vals = [row_dict[c.name] for c in index_schema.range_columns]
+    nh = main_schema.num_hash_key_columns
+    return DocKey(hash_components=tuple(vals[:nh]),
+                  range_components=tuple(vals[nh:]))
+
+
+def index_insert_op(value, main_doc_key: DocKey,
+                    backfill_ht: Optional[int] = None) -> QLWriteOp:
+    return QLWriteOp(WriteOpKind.INSERT, index_doc_key(value, main_doc_key),
+                     {}, backfill_ht=backfill_ht)
+
+
+def index_delete_op(value, main_doc_key: DocKey) -> QLWriteOp:
+    return QLWriteOp(WriteOpKind.DELETE_ROW,
+                     index_doc_key(value, main_doc_key))
+
+
+def maintenance_ops(index: IndexInfo, op: QLWriteOp, old_value
+                    ) -> List[QLWriteOp]:
+    """Index writes implied by one main-table DML op.
+
+    old_value: the row's current indexed value (None if absent) — the
+    caller reads it inside the statement transaction (read-modify-write,
+    ref pg_dml_write.cc building delete+insert index requests).
+    """
+    out: List[QLWriteOp] = []
+    if op.kind in (WriteOpKind.INSERT, WriteOpKind.UPDATE):
+        touches = index.column in op.values
+        if not touches:
+            return out
+        new_value = op.values.get(index.column)
+        if old_value == new_value:
+            return out
+        if old_value is not None:
+            out.append(index_delete_op(old_value, op.doc_key))
+        if new_value is not None:
+            out.append(index_insert_op(new_value, op.doc_key))
+    elif op.kind == WriteOpKind.DELETE_ROW:
+        if old_value is not None:
+            out.append(index_delete_op(old_value, op.doc_key))
+    elif op.kind == WriteOpKind.DELETE_COLS:
+        if index.column in op.columns_to_delete and old_value is not None:
+            out.append(index_delete_op(old_value, op.doc_key))
+    return out

@@ -65,13 +65,16 @@ def doc_key_from_wire(w: dict) -> DocKey:
 
 # ---------------------------------------------------------------- write ops
 def write_op_to_wire(op: QLWriteOp) -> dict:
-    return {
+    w = {
         "kind": op.kind.value,
         "doc_key": doc_key_to_wire(op.doc_key),
         "values": dict(op.values),
         "ttl_ms": op.ttl_ms,
         "cols_to_delete": list(op.columns_to_delete),
     }
+    if op.backfill_ht:
+        w["backfill_ht"] = op.backfill_ht
+    return w
 
 
 def write_op_from_wire(w: dict) -> QLWriteOp:
@@ -80,7 +83,8 @@ def write_op_from_wire(w: dict) -> QLWriteOp:
         doc_key=doc_key_from_wire(w["doc_key"]),
         values=dict(w["values"]),
         ttl_ms=w["ttl_ms"],
-        columns_to_delete=tuple(w["cols_to_delete"]))
+        columns_to_delete=tuple(w["cols_to_delete"]),
+        backfill_ht=w.get("backfill_ht"))
 
 
 # --------------------------------------------------------------------- rows

@@ -46,6 +46,11 @@ class QLWriteOp:
     values: Dict[str, PrimitiveType] = field(default_factory=dict)
     ttl_ms: Optional[int] = None
     columns_to_delete: Tuple[str, ...] = ()
+    # Index backfill only (ref: tablet.cc:2088 BackfillIndexes writing at
+    # the backfill read time): entries are stamped with THIS hybrid time
+    # instead of the op's, so concurrent index maintenance — which writes at
+    # now() — always supersedes backfilled entries.
+    backfill_ht: Optional[int] = None
 
     # ------------------------------------------------------------- KV pairs
     def to_kv_pairs(self, schema: Schema) -> List[Tuple[bytes, bytes]]:
@@ -104,6 +109,9 @@ def prepare_and_assemble(ops: Sequence[QLWriteOp], schema: Schema,
     for op in ops:
         pairs = op.to_kv_pairs(schema)
         entries.extend(op.lock_entries(schema, pairs))
-        all_pairs.extend(pairs)
+        if op.backfill_ht:
+            all_pairs.extend((k, v, op.backfill_ht) for k, v in pairs)
+        else:
+            all_pairs.extend(pairs)
     batch = lock_manager.lock(LockBatch(entries), timeout_s=timeout_s)
     return batch, all_pairs

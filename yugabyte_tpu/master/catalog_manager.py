@@ -31,6 +31,11 @@ flags.define_flag("tserver_unresponsive_timeout_ms", 3000,
                   "(ref tserver_unresponsive_timeout_ms)")
 flags.define_flag("replication_factor", 3,
                   "default table replication factor (ref replication_factor)")
+flags.define_flag("index_backfill_grace_ms", 500,
+                  "wait between index creation and the backfill snapshot so "
+                  "every writer observes the index in write mode first (the "
+                  "reference waits for schema-version acks from all "
+                  "tservers, ref backfill_index.cc WaitForSchemaVersion)")
 
 
 class TSDescriptor:
@@ -218,6 +223,119 @@ class CatalogManager:
         for d in out:
             d.num_tablets += 1  # keeps subsequent picks spreading
         return [d.server_id for d in out]
+
+    # --------------------------------------------------------------- indexes
+    def create_index(self, namespace: str, table_name: str, index_name: str,
+                     column: str, num_tablets: int = 2) -> dict:
+        """CREATE INDEX: create the index table, attach IndexInfo to the
+        indexed table (write-and-delete mode), wait out the schema
+        propagation grace, run the tablet-side backfill, then flip the
+        index readable (ref: src/yb/master/backfill_index.cc
+        MultiStageAlterTable + BackfillTable state machine, compressed to
+        WRITE_AND_DELETE -> backfill -> READABLE)."""
+        from yugabyte_tpu.common.index import (
+            STATE_BACKFILLING, STATE_READABLE, IndexInfo,
+            index_table_schema)
+        from yugabyte_tpu.common.schema import Schema
+        from yugabyte_tpu.common.wire import schema_from_wire, schema_to_wire
+
+        with self._lock:
+            table_id = self._find_table(namespace, table_name)
+            if table_id is None:
+                raise StatusError(Status.NotFound(
+                    f"table {namespace}.{table_name} not found"))
+            table_meta = self.tables[table_id]
+            for w in table_meta.get("indexes", []):
+                if w["index_name"] == index_name:
+                    raise StatusError(Status.AlreadyPresent(
+                        f"index {index_name!r} exists"))
+            main_schema = schema_from_wire(table_meta["schema"])
+        try:
+            idx_schema = index_table_schema(main_schema, column)
+        except (ValueError, KeyError) as e:
+            raise StatusError(Status.InvalidArgument(str(e)))
+        idx_meta = self.create_table(
+            namespace, index_name, schema_to_wire(idx_schema),
+            {"hash_partitioning": True}, num_tablets)
+        info = IndexInfo(index_name, idx_meta["table_id"], column,
+                         STATE_BACKFILLING)
+        self._set_index_state(table_id, info)
+        # Schema propagation grace: every writer must observe the index in
+        # write mode before the backfill snapshot is taken, or a write
+        # racing the backfill scan would leave the index missing its entry
+        # (the reference waits for all tservers to ack the schema version;
+        # our clients refresh table metadata on a TTL instead). The grace
+        # must comfortably exceed that TTL — a handle cached just before
+        # the index persisted stays stale for a full TTL.
+        grace_ms = max(flags.get_flag("index_backfill_grace_ms"),
+                       3 * flags.get_flag("table_cache_ttl_ms"))
+        time.sleep(grace_ms / 1000.0)
+        try:
+            self._backfill_index(namespace, table_id, info)
+        except BaseException:
+            # failure-atomic DDL: detach the half-built index and drop its
+            # table so CREATE INDEX can be retried (a permanently
+            # 'backfilling' index would tax every DML and serve no reads)
+            with self._lock:
+                table = dict(self.tables[table_id])
+                table["indexes"] = [w for w in table.get("indexes", [])
+                                    if w["index_name"] != index_name]
+                self.sys.upsert("table", table_id, table)
+                self.tables[table_id] = table
+            try:
+                self.delete_table(namespace, index_name)
+            except StatusError:
+                pass
+            raise
+        info.state = STATE_READABLE
+        self._set_index_state(table_id, info)
+        return info.to_wire()
+
+    def _set_index_state(self, table_id: str, info) -> None:
+        with self._lock:
+            table = dict(self.tables[table_id])
+            idxs = [w for w in table.get("indexes", [])
+                    if w["index_name"] != info.index_name]
+            idxs.append(info.to_wire())
+            table["indexes"] = idxs
+            self.sys.upsert("table", table_id, table)
+            self.tables[table_id] = table
+
+    def _backfill_index(self, namespace: str, table_id: str, info) -> None:
+        """Drive one backfill_index_tablet RPC per main-table tablet (ref
+        backfill_index.cc BackfillChunk; the tserver scans its local tablet
+        at a snapshot and writes index entries at that read time)."""
+        with self._lock:
+            tablet_ids = [t for t in self.tables[table_id]["tablet_ids"]
+                          if t in self.tablets
+                          and len(self._split_children_in_catalog(t)) != 2]
+        deadline = time.monotonic() + 60.0
+        for tablet_id in tablet_ids:
+            while True:
+                # leaders arrive via heartbeats; a freshly created table's
+                # tablets may still be electing — wait, don't abort
+                addr_map = self.ts_manager.addr_map()
+                with self._lock:
+                    leader = self.tablet_leaders.get(tablet_id)
+                addr = addr_map.get(leader[0]) if leader else None
+                if addr is not None:
+                    try:
+                        self.messenger.call(
+                            addr, "tserver", "backfill_index_tablet",
+                            timeout_s=300.0, tablet_id=tablet_id,
+                            namespace=namespace,
+                            index_table=info.index_name, column=info.column)
+                        break
+                    except StatusError as e:
+                        if time.monotonic() > deadline:
+                            raise
+                        TRACE("index backfill of %s retrying: %s",
+                              tablet_id, e)
+                elif time.monotonic() > deadline:
+                    raise StatusError(Status.ServiceUnavailable(
+                        f"no leader for {tablet_id}; index backfill "
+                        f"aborted"))
+                time.sleep(0.1)
 
     def delete_table(self, namespace: str, name: str) -> None:
         with self._lock:

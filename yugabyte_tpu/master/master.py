@@ -79,6 +79,11 @@ class MasterService:
         self._leader_catalog().delete_table(namespace, name)
         return True
 
+    def create_index(self, namespace: str, table: str, index_name: str,
+                     column: str, num_tablets: int = 2) -> dict:
+        return self._leader_catalog().create_index(
+            namespace, table, index_name, column, num_tablets)
+
     # -------------------------------------------------------------- lookups
     def get_table(self, namespace: str, name: str) -> dict:
         return self._leader_catalog().get_table(namespace, name)

@@ -87,12 +87,20 @@ def _load():
         return lib
 
 
+_available: Optional[bool] = None
+
+
 def available() -> bool:
-    try:
-        _load()
-        return True
-    except Exception:
-        return False
+    """Build-once probe; a failed compile is cached so the hot path does
+    not re-spawn a doomed g++ per compaction pick."""
+    global _available
+    if _available is None:
+        try:
+            _load()
+            _available = True
+        except Exception:
+            _available = False
+    return _available
 
 
 class NativeCompactionJob:

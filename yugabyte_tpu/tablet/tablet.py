@@ -231,12 +231,16 @@ class Tablet:
         self.metric_write_latency.increment((time.monotonic() - t0) * 1e6)
         return ht
 
-    def apply_write_batch(self, kv_pairs: Sequence[Tuple[bytes, bytes]],
+    def apply_write_batch(self, kv_pairs: Sequence[Tuple],
                           ht: HybridTime, op_id: Tuple[int, int]) -> None:
         """Apply an already-replicated batch to regular_db. Position within
-        the batch becomes the DocHybridTime write_id (ref tablet.cc:1198)."""
-        items = [(key, DocHybridTime(ht, write_id), value)
-                 for write_id, (key, value) in enumerate(kv_pairs)]
+        the batch becomes the DocHybridTime write_id (ref tablet.cc:1198).
+        An item may carry a per-entry hybrid-time override as a third
+        element (index backfill, ref tablet.cc:2088)."""
+        items = []
+        for write_id, it in enumerate(kv_pairs):
+            ht_i = HybridTime(it[2]) if len(it) == 3 and it[2] else ht
+            items.append((it[0], DocHybridTime(ht_i, write_id), it[1]))
         self.regular_db.write_batch(items, op_id=op_id)
         TRACE("tablet %s applied %d kvs at %s", self.tablet_id, len(items), ht)
 
@@ -257,6 +261,9 @@ class Tablet:
         try:
             lock_batch, kv_pairs = prepare_and_assemble(
                 ops, self.schema, self.lock_manager, timeout_s=timeout_s)
+            # backfill-ht overrides apply only to regular (non-transactional)
+            # writes; intents are always stamped at commit time
+            kv_pairs = [(p[0], p[1]) for p in kv_pairs]
             try:
                 resolve_write_conflicts(self.intents_db, self.regular_db,
                                         lock_batch.entries, txn_meta,

@@ -39,24 +39,34 @@ from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
 
 
-def encode_write_batch(kv_pairs: Sequence[Tuple[bytes, bytes]],
+def encode_write_batch(kv_items: Sequence[Tuple],
                        target_intents: bool = False) -> bytes:
-    """Leading flag byte routes the batch: 0 -> regular DB, 1 -> intents DB
-    (the reference splits these into separate WriteBatch sections,
-    ref tablet.cc:1198 ApplyKeyValueRowOperations)."""
-    out = [b"\x01" if target_intents else b"\x00",
-           struct.pack("<I", len(kv_pairs))]
-    for k, v in kv_pairs:
+    """Leading flag byte routes the batch: bit0 -> intents DB (the reference
+    splits these into separate WriteBatch sections, ref tablet.cc:1198
+    ApplyKeyValueRowOperations); bit1 -> every entry carries a u64 hybrid
+    time override (0 = none; index backfill writes at the backfill read
+    time, ref tablet.cc:2088). Items are (key, value) or (key, value, ht)."""
+    has_ht = any(len(it) == 3 and it[2] for it in kv_items)
+    flag = (1 if target_intents else 0) | (2 if has_ht else 0)
+    out = [bytes([flag]), struct.pack("<I", len(kv_items))]
+    for it in kv_items:
+        k, v = it[0], it[1]
         out.append(struct.pack("<I", len(k)))
         out.append(k)
         out.append(struct.pack("<I", len(v)))
         out.append(v)
+        if has_ht:
+            out.append(struct.pack(
+                "<Q", it[2] if len(it) == 3 and it[2] else 0))
     return b"".join(out)
 
 
-def decode_write_batch(payload: bytes
-                       ) -> Tuple[List[Tuple[bytes, bytes]], bool]:
-    target_intents = payload[0] == 1
+def decode_write_batch(payload: bytes) -> Tuple[List[Tuple], bool]:
+    """Inverse of encode_write_batch; items come back as (key, value) or
+    (key, value, ht_override)."""
+    flag = payload[0]
+    target_intents = bool(flag & 1)
+    has_ht = bool(flag & 2)
     (n,) = struct.unpack_from("<I", payload, 1)
     off = 5
     pairs = []
@@ -67,8 +77,14 @@ def decode_write_batch(payload: bytes
         off += kl
         (vl,) = struct.unpack_from("<I", payload, off)
         off += 4
-        pairs.append((k, payload[off:off + vl]))
+        v = payload[off:off + vl]
         off += vl
+        if has_ht:
+            (ht,) = struct.unpack_from("<Q", payload, off)
+            off += 8
+            pairs.append((k, v, ht) if ht else (k, v))
+        else:
+            pairs.append((k, v))
     return pairs, target_intents
 
 

@@ -74,7 +74,8 @@ class TabletServer:
         self.tablet_manager.status_resolver = self.resolve_txn_status
         self.service = TabletServiceImpl(self.tablet_manager,
                                          addr_updater=self.update_addr_map,
-                                         coordinator=self.coordinator)
+                                         coordinator=self.coordinator,
+                                         client_provider=self.local_client)
         self.messenger.register_service(TABLET_SERVICE, self.service)
         self.heartbeater = Heartbeater(
             self.messenger, opts.master_addrs, opts.server_id, self.address,
@@ -113,6 +114,20 @@ class TabletServer:
     def update_addr_map(self, addr_map: Dict[str, str]) -> None:
         with self._addr_lock:
             self._addr_map.update(addr_map)
+
+    def local_client(self):
+        """Lazily built YBClient for tserver-initiated cluster ops (index
+        backfill writes; the reference's tservers likewise embed a client,
+        ref tserver/tablet_server.cc client_future). Shares this server's
+        messenger."""
+        with self._addr_lock:
+            client = getattr(self, "_local_client", None)
+            if client is None and self.opts.master_addrs:
+                from yugabyte_tpu.client.client import YBClient
+                client = YBClient(self.opts.master_addrs,
+                                  messenger=self.messenger)
+                self._local_client = client
+            return client
 
     # ------------------------------------------------ transaction plumbing
     def lookup_tablet_leader(self, tablet_id: str) -> Optional[str]:

@@ -42,10 +42,11 @@ def _row_matches(row_dict: dict, filters: List[List]) -> bool:
 
 class TabletServiceImpl:
     def __init__(self, tablet_manager: TSTabletManager, addr_updater=None,
-                 coordinator=None):
+                 coordinator=None, client_provider=None):
         self._tablets = tablet_manager
         self._addr_updater = addr_updater or (lambda m: None)
         self.coordinator = coordinator
+        self._client_provider = client_provider or (lambda: None)
 
     def _leader_peer(self, tablet_id: str):
         peer = self._tablets.get_tablet(tablet_id)
@@ -169,6 +170,57 @@ class TabletServiceImpl:
                 resume_key = row.doc_key.encode() + b"\xff"
                 break
         return {"rows": rows, "resume_key": resume_key, "read_ht": ht.value}
+
+    # --------------------------------------------------------- index backfill
+    def backfill_index_tablet(self, tablet_id: str, namespace: str,
+                              index_table: str, column: str,
+                              batch_rows: int = 1024) -> dict:
+        """Scan this tablet at a snapshot and write index entries stamped
+        at that read time (tablet-side backfill, ref tablet.cc:2088
+        BackfillIndexes; chunked like backfill_index.cc BackfillChunk).
+        Concurrent maintenance writes — stamped at now() — supersede these
+        backfilled entries by MVCC."""
+        from yugabyte_tpu.common.index import index_insert_op
+
+        client = self._client_provider()
+        if client is None:
+            raise StatusError(Status.IllegalState(
+                "tserver has no embedded client for backfill"))
+        peer = self._leader_peer(tablet_id)
+        schema = peer.tablet.schema
+        if column not in {c.name for c in schema.value_columns}:
+            raise StatusError(Status.InvalidArgument(
+                f"column {column!r} is not a value column"))
+        idx_tbl = client.open_table(namespace, index_table)
+        read_ht = peer.tablet.read_time(None)
+        n_written = 0
+        pending = []
+
+        def flush_pending():
+            nonlocal n_written
+            # group per index tablet (client.write is single-tablet)
+            groups = {}
+            for op in pending:
+                pk = idx_tbl.partition_key_for(op.doc_key)
+                t = client.meta_cache.lookup_tablet(idx_tbl.table_id, pk)
+                groups.setdefault(t.tablet_id, []).append(op)
+            for ops in groups.values():
+                client.write(idx_tbl, ops)
+            n_written += len(pending)
+            pending.clear()
+
+        for row in peer.tablet.scan(read_ht, use_device=False):
+            d = row.to_dict(schema)
+            value = d.get(column)
+            if value is None:
+                continue
+            pending.append(index_insert_op(value, row.doc_key,
+                                           backfill_ht=read_ht.value))
+            if len(pending) >= batch_rows:
+                flush_pending()
+        if pending:
+            flush_pending()
+        return {"rows_backfilled": n_written, "read_ht": read_ht.value}
 
     # ----------------------------------------------------------- admin + ops
     def create_tablet(self, tablet_id: str, table_id: str, schema: dict,

@@ -27,6 +27,7 @@ from yugabyte_tpu.common.schema import (
 from yugabyte_tpu.docdb.doc_key import DocKey
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 from yugabyte_tpu.utils.status import Status, StatusError
+from yugabyte_tpu.yql import index_maintenance as IM
 from yugabyte_tpu.yql.cql import parser as P
 
 _CQL_TYPES = {
@@ -70,13 +71,21 @@ class QLProcessor:
         return ks
 
     def _table(self, ks: Optional[str], name: str) -> YBTable:
+        """Table-handle cache with a TTL: index DDL elsewhere must become
+        visible to this session's writes within the TTL (the schema-version
+        propagation window; the reference invalidates on version-mismatch
+        errors from the tserver, ref table_schema_version checks)."""
+        from yugabyte_tpu.utils import flags as _flags
         ks = self._resolve_ks(ks)
+        ttl = _flags.get_flag("table_cache_ttl_ms") / 1000.0
+        now = time.monotonic()
         with self._lock:
-            t = self._tables.get((ks, name))
-        if t is None:
-            t = self._client.open_table(ks, name)
-            with self._lock:
-                self._tables[(ks, name)] = t
+            entry = self._tables.get((ks, name))
+            if entry is not None and now - entry[1] < ttl:
+                return entry[0]
+        t = self._client.open_table(ks, name)
+        with self._lock:
+            self._tables[(ks, name)] = (t, now)
         return t
 
     @staticmethod
@@ -170,15 +179,34 @@ class QLProcessor:
             with self._lock:
                 self._tables.pop((ks, stmt.name), None)
             return ResultSet()
+        if isinstance(stmt, P.CreateIndex):
+            return self._create_index(stmt)
         if isinstance(stmt, P.Select):
             return self._select(stmt, params, cursor)
         if isinstance(stmt, (P.Insert, P.Update, P.Delete)):
             table, op = self._dml_to_op(stmt, params, cursor)
-            self._client.write(table, [op])
+            ks = self._resolve_ks(getattr(stmt, "keyspace", None))
+            IM.write_with_indexes(
+                self._client, self._txn_manager, table, op,
+                lambda name, _ks=ks: self._table(_ks, name))
             return ResultSet()
         if isinstance(stmt, P.Transaction):
             return self._run_transaction(stmt, params)
         raise StatusError(Status.NotSupported(f"statement {type(stmt)}"))
+
+    def _create_index(self, stmt: P.CreateIndex) -> ResultSet:
+        ks = self._resolve_ks(stmt.keyspace)
+        index_name = stmt.index_name or f"{stmt.table}_{stmt.column}_idx"
+        try:
+            self._client.create_index(ks, stmt.table, index_name,
+                                      stmt.column)
+        except StatusError as e:
+            if not (stmt.if_not_exists
+                    and e.status.code.name == "ALREADY_PRESENT"):
+                raise
+        with self._lock:
+            self._tables.pop((ks, stmt.table), None)  # refresh index list
+        return ResultSet()
 
     def _create_table(self, stmt: P.CreateTable) -> ResultSet:
         ks = self._resolve_ks(stmt.keyspace)
@@ -287,7 +315,17 @@ class QLProcessor:
                 table, table.partition_key_for(dk), prefix,
                 prefix + b"\xff")
         else:
-            rows = self._client.scan(table)
+            # No key prefix: try a readable secondary index on an equality
+            # predicate before falling back to the full scan.
+            picked = IM.choose_index(table, residual)
+            if picked is not None:
+                idx, value, residual = picked
+                ks = self._resolve_ks(stmt.keyspace)
+                idx_table = self._table(ks, idx.index_name)
+                rows = IM.index_lookup(self._client, table, idx_table,
+                                       idx, value)
+            else:
+                rows = self._client.scan(table)
         count = 0
         for row in rows:
             d = row.to_dict(schema)
@@ -314,7 +352,10 @@ class QLProcessor:
             txn = self._txn_manager.begin()
             try:
                 for table, op in decoded:
-                    txn.write(table, [op])
+                    IM.txn_write_with_indexes(
+                        txn, table, op,
+                        lambda name, _t=table: self._table(
+                            _t.namespace, name))
                 txn.commit()
                 return ResultSet()
             except TransactionError:

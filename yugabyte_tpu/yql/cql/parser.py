@@ -74,6 +74,15 @@ class DropTable:
 
 
 @dataclass
+class CreateIndex:
+    index_name: Optional[str]
+    keyspace: Optional[str]
+    table: str
+    column: str
+    if_not_exists: bool = False
+
+
+@dataclass
 class Insert:
     keyspace: Optional[str]
     table: str
@@ -213,6 +222,8 @@ class Parser:
             return CreateKeyspace(self.name(), ine)
         if self.accept_kw("CREATE", "TABLE"):
             return self._create_table()
+        if self.accept_kw("CREATE", "INDEX"):
+            return self._create_index()
         if self.accept_kw("DROP", "TABLE"):
             ks, name = self.qualified_name()
             return DropTable(ks, name)
@@ -229,6 +240,20 @@ class Parser:
         if self.accept_kw("BEGIN", "TRANSACTION"):
             return self._transaction()
         raise ParseError(f"unrecognized statement start: {self.peek()}")
+
+    def _create_index(self) -> CreateIndex:
+        """CREATE INDEX [IF NOT EXISTS] [name] ON [ks.]table (column)
+        (ref: the YCQL grammar's index_stmt, ql/ptree/pt_create_index.h)."""
+        ine = self.accept_kw("IF", "NOT", "EXISTS")
+        index_name = None
+        if not self.accept_kw("ON"):
+            index_name = self.name()
+            self.expect_kw("ON")
+        ks, table = self.qualified_name()
+        self.expect_op("(")
+        column = self.name()
+        self.expect_op(")")
+        return CreateIndex(index_name, ks, table, column, ine)
 
     def _create_table(self) -> CreateTable:
         ine = self.accept_kw("IF", "NOT", "EXISTS")

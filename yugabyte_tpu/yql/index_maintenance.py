@@ -1,0 +1,133 @@
+"""Query-layer secondary-index maintenance + index-accelerated lookups.
+
+Shared by the YCQL and YSQL executors. Placement mirrors the reference's
+YSQL architecture: the query layer issues the index writes as separate ops
+inside the statement's distributed transaction (ref:
+src/yb/yql/pggate/pg_dml_write.cc building delete+insert index requests;
+src/yb/docdb/pgsql_operation.cc applying them), with a read of the old row
+first (read-modify-write) to compute which index entries change.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+from yugabyte_tpu.client.client import YBClient, YBTable
+from yugabyte_tpu.client.transaction import (
+    TransactionError, TransactionManager, YBTransaction)
+from yugabyte_tpu.common.index import (
+    STATE_READABLE, IndexInfo, main_doc_key_from_index_row,
+    maintenance_ops)
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp
+
+
+def table_indexes(table: YBTable) -> List[IndexInfo]:
+    return [IndexInfo.from_wire(w) for w in table.indexes]
+
+
+def txn_write_with_indexes(txn: YBTransaction, table: YBTable,
+                           op: QLWriteOp,
+                           open_table: Callable[[str], YBTable]) -> None:
+    """Apply one main-table DML op inside `txn`, maintaining every index
+    attached to the table (write-and-delete mode applies from creation)."""
+    idxs = table_indexes(table)
+    old_values = {}
+    if idxs:
+        proj = [i.column for i in idxs]
+        old = txn.read_row(table, op.doc_key, projection=proj)
+        if old is not None:
+            d = old.to_dict(table.schema)
+            old_values = {i.column: d.get(i.column) for i in idxs}
+    txn.write(table, [op])
+    for idx in idxs:
+        for mop in maintenance_ops(idx, op, old_values.get(idx.column)):
+            txn.write(open_table(idx.index_name), [mop])
+
+
+def run_in_implicit_txn(txn_manager: TransactionManager, existing_txn,
+                        body: Callable, deadline_s: float = 30.0):
+    """Statement-level transaction wrapper shared by the query layers.
+
+    Inside an open transaction block, joins it (the block commits later);
+    otherwise wraps `body(txn)` in an implicit transaction with the
+    standard conflict-retry loop (ref: the reference routes all DML
+    through one WriteQuery pipeline with conflict resolution,
+    tablet/write_query.cc:412-464)."""
+    if existing_txn is not None:
+        return body(existing_txn)
+    deadline = time.monotonic() + deadline_s
+    while True:
+        txn = txn_manager.begin()
+        try:
+            r = body(txn)
+            txn.commit()
+            return r
+        except TransactionError:
+            txn.abort()
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
+        except BaseException:
+            txn.abort()
+            raise
+
+
+def write_with_indexes(client: YBClient, txn_manager: TransactionManager,
+                       table: YBTable, op: QLWriteOp,
+                       open_table: Callable[[str], YBTable],
+                       deadline_s: float = 30.0) -> None:
+    """Autocommit DML against an indexed table: wrap in an implicit
+    distributed transaction (read old row -> main write -> index writes)
+    with the standard conflict-retry loop. Tables without indexes take the
+    plain single-shard write path."""
+    if not table.indexes:
+        client.write(table, [op])
+        return
+    run_in_implicit_txn(
+        txn_manager, None,
+        lambda txn: txn_write_with_indexes(txn, table, op, open_table),
+        deadline_s)
+
+
+def choose_index(table: YBTable, where: Sequence[Tuple[str, str, object]]
+                 ) -> Optional[Tuple[IndexInfo, object, List[Tuple]]]:
+    """Pick a readable index matching an equality predicate.
+
+    Returns (index, value, residual_filters) or None. Only '=' predicates
+    use the index (the index hash-partitions on the value)."""
+    readable = {i.column: i for i in table_indexes(table)
+                if i.state == STATE_READABLE}
+    for k, (col, op, val) in enumerate(where):
+        if op == "=" and col in readable:
+            residual = [w for j, w in enumerate(where) if j != k]
+            return readable[col], val, residual
+    return None
+
+
+def index_lookup(client: YBClient, table: YBTable, index_table: YBTable,
+                 idx: IndexInfo, value, read_ht=None) -> Iterator:
+    """Yield main-table rows whose indexed column equals `value`, via the
+    index: one single-partition scan of the index table, then point reads
+    of the main rows (ref: the reference's index-scan path,
+    pg_select.cc secondary-index request + docdb lookups).
+
+    Re-checks the indexed value on the main row: with concurrent writers an
+    index entry can be momentarily stale (the reference re-checks row
+    versions the same way)."""
+    idx_schema = index_table.schema
+    probe = DocKey(hash_components=(value,))
+    prefix = probe.encode()[:-1]  # open the range group
+    rows = client.scan_key_range(
+        index_table, index_table.partition_key_for(probe), prefix,
+        prefix + b"\xff", read_ht=read_ht)
+    for irow in rows:
+        d = irow.to_dict(idx_schema)
+        main_dk = main_doc_key_from_index_row(d, table.schema, idx_schema)
+        row = client.read_row(table, main_dk, read_ht=read_ht)
+        if row is None:
+            continue  # row deleted after the index entry was read
+        if row.to_dict(table.schema).get(idx.column) != value:
+            continue  # stale entry: the row's value moved on
+        yield row

@@ -23,6 +23,7 @@ from yugabyte_tpu.common.wire import row_matches
 from yugabyte_tpu.docdb.doc_key import DocKey
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 from yugabyte_tpu.utils.status import Code, Status, StatusError
+from yugabyte_tpu.yql import index_maintenance as IM
 from yugabyte_tpu.yql.pgsql import parser as P
 
 # framework DataType -> PostgreSQL type OID (pg_type.h)
@@ -142,6 +143,8 @@ class PgSession:
             raise PgError(Status.NotSupported("DROP DATABASE"), "0A000")
         if isinstance(stmt, P.CreateTable):
             return self._create_table(stmt)
+        if isinstance(stmt, P.CreateIndex):
+            return self._create_index(stmt)
         if isinstance(stmt, P.DropTable):
             try:
                 self._client.delete_table(self.database, stmt.name)
@@ -191,11 +194,31 @@ class PgSession:
                 raise
         return PgResult("CREATE TABLE")
 
+    def _create_index(self, stmt: P.CreateIndex) -> PgResult:
+        index_name = stmt.index_name or f"{stmt.table}_{stmt.column}_idx"
+        try:
+            self._client.create_index(self.database, stmt.table, index_name,
+                                      stmt.column)
+        except StatusError as e:
+            if not (stmt.if_not_exists
+                    and e.status.code == Code.ALREADY_PRESENT):
+                raise
+        self._tables.pop(stmt.table, None)  # refresh the index list
+        return PgResult("CREATE INDEX")
+
     def _table(self, name: str) -> YBTable:
-        t = self._tables.get(name)
-        if t is None:
-            t = self._client.open_table(self.database, name)
-            self._tables[name] = t
+        """TTL'd table-handle cache: index DDL from other sessions becomes
+        visible within the schema-propagation window (see
+        yql/cql/executor.py _table)."""
+        import time as _time
+        from yugabyte_tpu.utils import flags as _flags
+        ttl = _flags.get_flag("table_cache_ttl_ms") / 1000.0
+        now = _time.monotonic()
+        entry = self._tables.get(name)
+        if entry is not None and now - entry[1] < ttl:
+            return entry[0]
+        t = self._client.open_table(self.database, name)
+        self._tables[name] = (t, now)
         return t
 
     # ---------------------------------------------------------------- DML
@@ -204,6 +227,13 @@ class PgSession:
             self._txn.write(table, ops)
         else:
             self._client.write(table, ops)
+
+    def _run_statement_txn(self, body, deadline_s: float = 30.0):
+        """Statement-level atomicity: a multi-row UPDATE/DELETE can neither
+        partially apply nor clobber a concurrent writer between its scan
+        and its writes (see index_maintenance.run_in_implicit_txn)."""
+        return IM.run_in_implicit_txn(self._txn_manager, self._txn, body,
+                                      deadline_s)
 
     def _insert(self, stmt: P.Insert) -> PgResult:
         table = self._table(stmt.table)
@@ -230,6 +260,14 @@ class PgSession:
                                        for c in schema.range_columns))
             values = {c: v for c, v in bound.items() if c not in key_names}
             ops.append(QLWriteOp(WriteOpKind.INSERT, dk, values))
+        if table.indexes:
+            # indexed table: route through a (possibly implicit) transaction
+            # maintaining every index (yql/index_maintenance.py)
+            def body(txn):
+                for op in ops:
+                    IM.txn_write_with_indexes(txn, table, op, self._table)
+            self._run_statement_txn(body)
+            return PgResult(f"INSERT 0 {len(ops)}")
         # batch per destination tablet: one write RPC per tablet touched
         # (ref pg_session.h:222 RunAsync buffering + batcher grouping)
         groups: Dict[str, List[QLWriteOp]] = {}
@@ -295,9 +333,25 @@ class PgSession:
                 if row_matches(d, filters):
                     rows_out.append([d.get(c) for c in out_cols])
         else:
+            # Index-accelerated path: a readable secondary index on an
+            # equality predicate replaces the full scan. Skipped inside a
+            # transaction block: index_lookup's reads would escape the txn
+            # snapshot/overlay (the scan path pins both).
+            residual: List = []
+            picked = (IM.choose_index(table, [tuple(f) for f in filters])
+                      if self._txn is None else None)
+            if picked is not None:
+                idx, value, residual = picked
+                idx_table = self._table(idx.index_name)
+                rows = IM.index_lookup(self._client, table, idx_table,
+                                       idx, value)
+            else:
+                rows = self._scan(table, filters)
             count = 0
-            for row in self._scan(table, filters):
+            for row in rows:
                 d = row.to_dict(schema)
+                if residual and not row_matches(d, residual):
+                    continue
                 rows_out.append([d.get(c) for c in out_cols])
                 count += 1
                 if stmt.limit is not None and count >= stmt.limit:
@@ -323,21 +377,30 @@ class PgSession:
                                  filters=filters or None, txn_id=txn_id)
 
     def _target_keys(self, table: YBTable,
-                     where: List[Tuple[str, str, object]]):
+                     where: List[Tuple[str, str, object]], txn=None):
         """Doc keys matching WHERE: point lookup for a full key, pushed-
-        down scan otherwise (PG semantics: UPDATE/DELETE take any WHERE)."""
+        down scan otherwise (PG semantics: UPDATE/DELETE take any WHERE).
+        With `txn`, reads pin that transaction's snapshot + overlay."""
+        from yugabyte_tpu.common.hybrid_time import HybridTime
         schema = table.schema
+        txn = txn or self._txn
         dk, filters = self._split_where(table, where)
         if dk is not None and not filters:
             return [dk]
         if dk is not None:
-            row = (self._txn.read_row(table, dk) if self._txn
+            row = (txn.read_row(table, dk) if txn
                    else self._client.read_row(table, dk))
             if row is None:
                 return []
             d = row.to_dict(schema)
             return [dk] if row_matches(d, filters) else []
-        return [row.doc_key for row in self._scan(table, filters)]
+        if txn is not None:
+            rows = self._client.scan(table, read_ht=HybridTime(txn.read_ht),
+                                     filters=filters or None,
+                                     txn_id=txn.txn_id)
+        else:
+            rows = self._scan(table, filters)
+        return [row.doc_key for row in rows]
 
     def _update(self, stmt: P.Update) -> PgResult:
         table = self._table(stmt.table)
@@ -349,18 +412,45 @@ class PgSession:
             # a PK update is a row move (delete+insert); not supported
             raise PgError(Status.NotSupported(
                 f"cannot update primary key column(s) {bad}"), "0A000")
-        keys = self._target_keys(table, stmt.where)
-        for dk in keys:
+        dk, filters = self._split_where(table, stmt.where)
+        if (dk is not None and not filters and not table.indexes
+                and self._txn is None):
+            # point update, no indexes: the single-shard fast path is
+            # already atomic
             self._write(table, [QLWriteOp(WriteOpKind.UPDATE, dk,
                                           dict(stmt.assignments))])
-        return PgResult(f"UPDATE {len(keys)}")
+            return PgResult("UPDATE 1")
+
+        def body(txn):
+            keys = self._target_keys(table, stmt.where, txn)
+            for k in keys:
+                IM.txn_write_with_indexes(
+                    txn, table,
+                    QLWriteOp(WriteOpKind.UPDATE, k,
+                              dict(stmt.assignments)), self._table)
+            return len(keys)
+
+        n = self._run_statement_txn(body)
+        return PgResult(f"UPDATE {n}")
 
     def _delete(self, stmt: P.Delete) -> PgResult:
         table = self._table(stmt.table)
-        keys = self._target_keys(table, stmt.where)
-        for dk in keys:
+        dk, filters = self._split_where(table, stmt.where)
+        if (dk is not None and not filters and not table.indexes
+                and self._txn is None):
             self._write(table, [QLWriteOp(WriteOpKind.DELETE_ROW, dk)])
-        return PgResult(f"DELETE {len(keys)}")
+            return PgResult("DELETE 1")
+
+        def body(txn):
+            keys = self._target_keys(table, stmt.where, txn)
+            for k in keys:
+                IM.txn_write_with_indexes(
+                    txn, table, QLWriteOp(WriteOpKind.DELETE_ROW, k),
+                    self._table)
+            return len(keys)
+
+        n = self._run_statement_txn(body)
+        return PgResult(f"DELETE {n}")
 
     # ------------------------------------------------------- transactions
     def _txn_control(self, stmt: P.TxnControl) -> PgResult:

@@ -56,6 +56,14 @@ class DropTable:
 
 
 @dataclass
+class CreateIndex:
+    index_name: Optional[str]
+    table: str
+    column: str
+    if_not_exists: bool = False
+
+
+@dataclass
 class Insert:
     table: str
     columns: Optional[List[str]]
@@ -108,6 +116,20 @@ class PgParser(_BaseParser):
             return DropDatabase(self.name())
         if self.accept_kw("CREATE", "TABLE"):
             return self._create_table()
+        if self.accept_kw("CREATE", "INDEX"):
+            # CREATE INDEX [IF NOT EXISTS] [name] ON table (column)
+            # (ref: YSQL index DDL, parsed by the PG grammar and executed
+            # through master backfill, backfill_index.cc)
+            ine = self.accept_kw("IF", "NOT", "EXISTS")
+            index_name = None
+            if not self.accept_kw("ON"):
+                index_name = self.name()
+                self.expect_kw("ON")
+            table = self._table_name()
+            self.expect_op("(")
+            column = self.name()
+            self.expect_op(")")
+            return CreateIndex(index_name, table, column, ine)
         if self.accept_kw("DROP", "TABLE"):
             if_exists = self.accept_kw("IF", "EXISTS")
             return DropTable(self._table_name(), if_exists)
