@@ -57,6 +57,8 @@ class YBTable:
 class YBClient:
     def __init__(self, master_addrs: Sequence[str],
                  messenger: Optional[Messenger] = None):
+        import threading
+        import uuid
         self._messenger = messenger or Messenger("client")
         self._owns_messenger = messenger is None
         self._master_addrs = list(master_addrs)
@@ -64,10 +66,21 @@ class YBClient:
         self.meta_cache = MetaCache(
             lambda table_id: self._master_call("get_table_locations",
                                                table_id=table_id))
+        # exactly-once identity: (client_id, per-write request id) rides
+        # every write RPC; retries REUSE the id so the server dedups them
+        # (ref consensus/retryable_requests.cc)
+        self.client_id = uuid.uuid4().bytes
+        self._request_counter = 0
+        self._request_lock = threading.Lock()
+
+    def _next_request_id(self) -> int:
+        with self._request_lock:
+            self._request_counter += 1
+            return self._request_counter
 
     # ----------------------------------------------------------- master RPCs
     def _master_call(self, mth: str, _retry_ctx: Optional[dict] = None,
-                     **args):
+                     _timeout_s: Optional[float] = None, **args):
         """Find and call the master leader, following not-leader hints
         (ref client_master_rpc.cc). `_retry_ctx`, when given, records
         whether a send may have reached the master before failing — callers
@@ -80,7 +93,7 @@ class YBClient:
             for addr in list(addrs):
                 try:
                     ret = self._messenger.call(addr, MASTER_SERVICE, mth,
-                                               **args)
+                                               timeout_s=_timeout_s, **args)
                     self._master_leader = addr
                     return ret
                 except RemoteError as e:
@@ -143,12 +156,37 @@ class YBClient:
         self._master_call("delete_table", namespace=namespace, name=name)
 
     def create_index(self, namespace: str, table: str, index_name: str,
-                     column: str, num_tablets: int = 2) -> dict:
+                     column: str, num_tablets: int = 2,
+                     timeout_s: float = 600.0) -> dict:
         """Create a secondary index and run its online backfill; returns
-        the IndexInfo wire dict with state 'readable' on success."""
-        return self._master_call(
-            "create_index", namespace=namespace, table=table,
-            index_name=index_name, column=column, num_tablets=num_tablets)
+        the IndexInfo wire dict with state 'readable' on success.
+
+        The RPC covers the whole grace + backfill, so it gets a long
+        timeout; an AlreadyPresent after our own timed-out attempt means
+        the first send is still building — poll the table meta for the
+        index to turn readable instead of failing."""
+        from yugabyte_tpu.common.index import STATE_READABLE
+        ctx: Dict[str, bool] = {}
+        try:
+            return self._master_call(
+                "create_index", _retry_ctx=ctx, _timeout_s=timeout_s,
+                namespace=namespace, table=table, index_name=index_name,
+                column=column, num_tablets=num_tablets)
+        except RemoteError as e:
+            if not (e.status.code == Code.ALREADY_PRESENT
+                    and ctx.get("maybe_applied")):
+                raise
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            meta = self._master_call("get_table", namespace=namespace,
+                                     name=table)
+            for w in meta.get("indexes", []):
+                if (w["index_name"] == index_name
+                        and w.get("state") == STATE_READABLE):
+                    return w
+            time.sleep(0.5)
+        raise StatusError(Status.TimedOut(
+            f"index {index_name} did not become readable"))
 
     def open_table(self, namespace: str, name: str) -> YBTable:
         return YBTable(self._master_call("get_table", namespace=namespace,
@@ -211,14 +249,20 @@ class YBClient:
         """Write a batch that must all land in ONE tablet (the session
         batcher groups ops per tablet before calling this). If the tablet
         split underneath us, re-group the ops by key over the fresh
-        locations — the batch may now span both children."""
+        locations — the batch may now span both children.
+
+        Every attempt of this logical write carries the same
+        (client_id, request_id), so a retry after an unknown outcome
+        (timeout mid-replication, leader change) cannot double-apply."""
         pk = table.partition_key_for(ops[0].doc_key)
         if tablet is None:
             tablet = self.meta_cache.lookup_tablet(table.table_id, pk)
+        request_id = self._next_request_id()
         try:
             resp = self._tablet_call(
                 table, tablet, "write", refresh_key=pk,
-                ops=[write_op_to_wire(op) for op in ops])
+                ops=[write_op_to_wire(op) for op in ops],
+                client_id=self.client_id, request_id=request_id)
             return HybridTime(resp["propagated_ht"])
         except RemoteError as e:
             if not (e.extra.get("tablet_split")

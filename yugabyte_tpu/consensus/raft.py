@@ -247,7 +247,9 @@ class RaftConsensus:
                  on_propagated_safe_time: Optional[Callable[[int], None]] = None,
                  on_role_change: Optional[Callable[[Role], None]] = None,
                  clock=None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 on_append_cb: Optional[Callable[["ReplicateMsg"], None]]
+                 = None):
         self.config = config
         self._initial_peer_ids = tuple(config.peer_ids)
         # index -> peer_ids active FROM that log index (config history for
@@ -259,6 +261,12 @@ class RaftConsensus:
         self.log = log
         self.transport = transport
         self.apply_cb = apply_cb
+        # invoked for every entry as it is STORED in the local log (leader
+        # append, follower append, startup recovery) — before commit/apply.
+        # Used by the tablet layer to pre-register retryable requests so a
+        # new leader's dedup covers committed-but-unapplied entries (ref
+        # consensus/retryable_requests.cc registering at replication time).
+        self.on_append_cb = on_append_cb
         self.safe_time_provider = safe_time_provider or (lambda: 0)
         self.on_propagated_safe_time = on_propagated_safe_time or (lambda ht: None)
         self.on_role_change = on_role_change or (lambda r: None)
@@ -319,6 +327,8 @@ class RaftConsensus:
             self._entries[msg.index] = msg
             self._last_index = msg.index
             self._last_term = msg.term
+            if self.on_append_cb is not None:
+                self.on_append_cb(msg)
             if msg.op_type == OP_CHANGE_CONFIG:
                 self._config_history[msg.index] = tuple(
                     json.loads(msg.payload)["peer_ids"])
@@ -640,6 +650,8 @@ class RaftConsensus:
         self._entries[index] = msg
         self._last_index = index
         self._last_term = msg.term
+        if self.on_append_cb is not None:
+            self.on_append_cb(msg)
         self.log.append_async([msg.to_log_entry()],
                               callback=lambda: self._on_local_durable(index))
         return msg
@@ -991,6 +1003,8 @@ class RaftConsensus:
                 self._entries[msg.index] = msg
                 self._last_index = msg.index
                 self._last_term = msg.term
+                if self.on_append_cb is not None:
+                    self.on_append_cb(msg)
                 if msg.op_type == OP_CHANGE_CONFIG:
                     self._activate_config_unlocked(
                         msg.index,

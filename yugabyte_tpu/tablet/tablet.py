@@ -77,7 +77,7 @@ class LocalConsensusContext:
         self._lock = threading.Lock()
 
     def submit(self, kv_pairs, ht: HybridTime, timeout_s: float = 10.0,
-               target_intents: bool = False) -> Tuple[int, int]:
+               target_intents: bool = False, request=None) -> Tuple[int, int]:
         with self._lock:
             self._index += 1
             op_id = (1, self._index)  # (term, index)
@@ -85,6 +85,9 @@ class LocalConsensusContext:
             self._tablet.apply_intent_batch(kv_pairs, ht, op_id)
         else:
             self._tablet.apply_write_batch(kv_pairs, ht, op_id)
+        if request is not None:
+            self._tablet.retryable.replicated(request[0], request[1],
+                                              ht.value)
         return op_id
 
 
@@ -115,6 +118,8 @@ class Tablet:
         self.clock = clock or HybridClock()
         self.opts = options or TabletOptions()
         self.retention_policy = TabletRetentionPolicy(self.clock)
+        from yugabyte_tpu.tablet.retryable_requests import RetryableRequests
+        self.retryable = RetryableRequests()
         db_opts = DBOptions(
             block_entries=self.opts.block_entries,
             device=self.opts.device,
@@ -166,16 +171,38 @@ class Tablet:
         self.metric_reads = entity.counter("ql_reads", "row reads served")
 
     # ------------------------------------------------------------------ write
-    def write(self, ops: Sequence[QLWriteOp],
-              timeout_s: float = 10.0) -> HybridTime:
+    def write(self, ops: Sequence[QLWriteOp], timeout_s: float = 10.0,
+              request=None) -> HybridTime:
         """The WriteQuery pipeline (ref write_query.cc:211-566). Returns the
-        hybrid time at which the batch became visible."""
+        hybrid time at which the batch became visible.
+
+        request: optional (client_id, request_id) for exactly-once dedup
+        (ref consensus/retryable_requests.cc): a duplicate of an
+        already-replicated request returns its original hybrid time without
+        re-applying; a duplicate of an in-flight one is pushed back to the
+        client retry loop until the first attempt's fate settles."""
+        if request is not None:
+            state, ht_value = self.retryable.check_or_track(*request)
+            if state == "duplicate":
+                return HybridTime(ht_value)
+            if state == "in_flight":
+                from yugabyte_tpu.utils.status import Status, StatusError
+                raise StatusError(Status.ServiceUnavailable(
+                    "duplicate request still in flight"))
         with self._write_gate:
             if self._writes_blocked or self.split_children is not None:
+                if request is not None:
+                    self.retryable.failed(*request)
                 raise TabletHasBeenSplit(self.split_children or ())
             self._inflight_writes += 1
         try:
-            return self._write_locked(ops, timeout_s)
+            return self._write_locked(ops, timeout_s, request=request)
+        except OperationOutcomeUnknown:
+            raise  # fate watcher resolves the in-flight registration
+        except BaseException:
+            if request is not None:
+                self.retryable.failed(*request)
+            raise
         finally:
             with self._write_gate:
                 self._inflight_writes -= 1
@@ -193,7 +220,7 @@ class Tablet:
             self._writes_blocked = False
 
     def _write_locked(self, ops: Sequence[QLWriteOp],
-                      timeout_s: float) -> HybridTime:
+                      timeout_s: float, request=None) -> HybridTime:
         t0 = time.monotonic()
         lock_batch, kv_pairs = prepare_and_assemble(
             ops, self.schema, self.lock_manager, timeout_s=timeout_s)
@@ -214,7 +241,8 @@ class Tablet:
             # and MvccManager drains completions in hybrid-time order.
             ht = self.mvcc.add_pending_now()
             try:
-                self.consensus.submit(kv_pairs, ht, timeout_s=timeout_s)
+                self.consensus.submit(kv_pairs, ht, timeout_s=timeout_s,
+                                      request=request)
             except OperationOutcomeUnknown:
                 # Fate unknown: the consensus seam registered a fate watcher
                 # that resolves the MVCC registration when the entry commits

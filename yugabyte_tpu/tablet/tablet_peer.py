@@ -40,14 +40,20 @@ from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
 
 
 def encode_write_batch(kv_items: Sequence[Tuple],
-                       target_intents: bool = False) -> bytes:
+                       target_intents: bool = False,
+                       request: Optional[Tuple[bytes, int]] = None) -> bytes:
     """Leading flag byte routes the batch: bit0 -> intents DB (the reference
     splits these into separate WriteBatch sections, ref tablet.cc:1198
     ApplyKeyValueRowOperations); bit1 -> every entry carries a u64 hybrid
     time override (0 = none; index backfill writes at the backfill read
-    time, ref tablet.cc:2088). Items are (key, value) or (key, value, ht)."""
+    time, ref tablet.cc:2088); bit2 -> a (client_id[16], request_id u64)
+    retryable-request tag trails the entries (exactly-once dedup, ref
+    consensus/retryable_requests.cc — replicated WITH the data so every
+    replica rebuilds the registry). Items are (key, value) or
+    (key, value, ht)."""
     has_ht = any(len(it) == 3 and it[2] for it in kv_items)
-    flag = (1 if target_intents else 0) | (2 if has_ht else 0)
+    flag = ((1 if target_intents else 0) | (2 if has_ht else 0)
+            | (4 if request is not None else 0))
     out = [bytes([flag]), struct.pack("<I", len(kv_items))]
     for it in kv_items:
         k, v = it[0], it[1]
@@ -58,12 +64,18 @@ def encode_write_batch(kv_items: Sequence[Tuple],
         if has_ht:
             out.append(struct.pack(
                 "<Q", it[2] if len(it) == 3 and it[2] else 0))
+    if request is not None:
+        cid, rid = request
+        out.append(cid[:16].ljust(16, b"\x00"))
+        out.append(struct.pack("<Q", rid))
     return b"".join(out)
 
 
-def decode_write_batch(payload: bytes) -> Tuple[List[Tuple], bool]:
+def decode_write_batch(payload: bytes
+                       ) -> Tuple[List[Tuple], bool,
+                                  Optional[Tuple[bytes, int]]]:
     """Inverse of encode_write_batch; items come back as (key, value) or
-    (key, value, ht_override)."""
+    (key, value, ht_override), plus the retryable-request tag if present."""
     flag = payload[0]
     target_intents = bool(flag & 1)
     has_ht = bool(flag & 2)
@@ -85,7 +97,12 @@ def decode_write_batch(payload: bytes) -> Tuple[List[Tuple], bool]:
             pairs.append((k, v, ht) if ht else (k, v))
         else:
             pairs.append((k, v))
-    return pairs, target_intents
+    request = None
+    if flag & 4:
+        cid = payload[off: off + 16]
+        (rid,) = struct.unpack_from("<Q", payload, off + 16)
+        request = (cid, rid)
+    return pairs, target_intents, request
 
 
 class RaftWriteContext:
@@ -96,19 +113,29 @@ class RaftWriteContext:
         self._peer = peer
 
     def submit(self, kv_pairs, ht: HybridTime, timeout_s: float = 30.0,
-               target_intents: bool = False) -> Tuple[int, int]:
-        payload = encode_write_batch(kv_pairs, target_intents)
+               target_intents: bool = False, request=None) -> Tuple[int, int]:
+        payload = encode_write_batch(kv_pairs, target_intents,
+                                     request=request)
         try:
             return self._peer.raft.replicate(OP_WRITE, ht.value, payload,
                                              timeout_s=timeout_s)
         except ReplicationTimedOut as e:
             # The entry may still commit: MVCC must keep holding safe time
             # at ht until the fate settles, then resolve the registration.
+            # The retryable-request stays in-flight until the fate settles
+            # too — a concurrent retry must not slip past the dedup check.
             mvcc = self._peer.tablet.mvcc
+            retry_reg = self._peer.tablet.retryable
+
+            def on_aborted():
+                mvcc.aborted(ht)
+                if request is not None:
+                    retry_reg.failed(*request)
+
             self._peer.raft.watch_fate(
                 e.op_id,
                 on_committed=lambda: mvcc.replicated(ht),
-                on_aborted=lambda: mvcc.aborted(ht))
+                on_aborted=on_aborted)
             raise OperationOutcomeUnknown(str(e)) from e
 
 
@@ -140,7 +167,8 @@ class TabletPeer:
             safe_time_provider=lambda: self.tablet.mvcc.peek_safe_time().value,
             on_propagated_safe_time=self._on_propagated_safe_time,
             on_role_change=self._on_role_change,
-            clock=self.clock)
+            clock=self.clock,
+            on_append_cb=self._on_entry_appended)
         transport.register(config.peer_id, self.raft)
         self.tablet.consensus = RaftWriteContext(self)
         self.tablet.mvcc.set_leader_mode(False)
@@ -185,15 +213,34 @@ class TabletPeer:
         self.raft.start(election_timer=election_timer)
         return self
 
+    def _on_entry_appended(self, msg: ReplicateMsg) -> None:
+        """Log-append hook (every replica, incl. recovery): pre-register the
+        write's retryable-request tag as in-flight, so a retry hitting a
+        new leader in the committed-but-unapplied window is pushed back
+        instead of double-applied (ref retryable_requests.cc registering
+        at replication time)."""
+        if msg.op_type != OP_WRITE or not msg.payload:
+            return
+        if msg.payload[0] & 4:
+            cid = msg.payload[-24:-8]
+            (rid,) = struct.unpack("<Q", msg.payload[-8:])
+            self.tablet.retryable.track_appended(cid, rid)
+
     # ---------------------------------------------------------------- apply
     def _apply_replicated(self, msg: ReplicateMsg) -> None:
         if msg.op_type == OP_WRITE:
-            kv_pairs, target_intents = decode_write_batch(msg.payload)
+            kv_pairs, target_intents, request = decode_write_batch(
+                msg.payload)
             ht = HybridTime(msg.ht_value)
             if target_intents:
                 self.tablet.apply_intent_batch(kv_pairs, ht, msg.op_id)
             else:
                 self.tablet.apply_write_batch(kv_pairs, ht, msg.op_id)
+            if request is not None:
+                # every replica (and WAL replay) rebuilds the dedup
+                # registry from the replicated payload
+                self.tablet.retryable.replicated(request[0], request[1],
+                                                 msg.ht_value)
             if not self.raft.is_leader():
                 # Followers advance replication watermark directly; the
                 # leader's MvccManager drains via replicated() in write().
@@ -318,10 +365,11 @@ class TabletPeer:
         return read_row(self.tablet.regular_db, self.tablet.schema, doc_key,
                         ht, projection=projection)
 
-    def write(self, ops, timeout_s: float = 30.0) -> HybridTime:
+    def write(self, ops, timeout_s: float = 30.0,
+              request=None) -> HybridTime:
         if not self.raft.is_leader():
             raise NotLeader(self.raft.leader_hint())
-        return self.tablet.write(ops, timeout_s=timeout_s)
+        return self.tablet.write(ops, timeout_s=timeout_s, request=request)
 
     def write_transactional(self, ops, txn_meta,
                             timeout_s: float = 30.0) -> HybridTime:

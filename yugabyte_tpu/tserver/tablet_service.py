@@ -61,7 +61,9 @@ class TabletServiceImpl:
 
     # ---------------------------------------------------------------- writes
     def write(self, tablet_id: str, ops: List[dict],
-              timeout_s: float = 15.0, txn: Optional[dict] = None) -> dict:
+              timeout_s: float = 15.0, txn: Optional[dict] = None,
+              client_id: Optional[bytes] = None,
+              request_id: Optional[int] = None) -> dict:
         from yugabyte_tpu.docdb.conflict_resolution import (
             TransactionConflict)
         from yugabyte_tpu.docdb.intents import TransactionMetadata
@@ -82,13 +84,17 @@ class TabletServiceImpl:
                         f"key outside tablet range of {tablet_id}"))
                     err.extra = {"wrong_tablet": True}
                     raise err
+        request = ((client_id, request_id)
+                   if client_id is not None and request_id is not None
+                   else None)
         try:
             if txn is not None:
                 ht = peer.write_transactional(
                     decoded, TransactionMetadata.from_wire(txn),
                     timeout_s=timeout_s)
             else:
-                ht = peer.write(decoded, timeout_s=timeout_s)
+                ht = peer.write(decoded, timeout_s=timeout_s,
+                                request=request)
         except TransactionConflict as e:
             err = StatusError(Status.TryAgain(str(e)))
             err.extra = {"txn_conflict": True}

@@ -216,6 +216,13 @@ class TSTabletManager:
                 shutil.rmtree(cdir, ignore_errors=True)
                 os.rename(tmp_dir, cdir)
                 self._open_tablet(child_id, meta)
+            # exactly-once dedup survives the split: children adopt the
+            # parent's retryable-request records (the data they inherited
+            # includes those writes)
+            with self._lock:
+                child = self._tablets.get(child_id)
+            if child is not None:
+                child.tablet.retryable.inherit_from(parent.tablet.retryable)
         TRACE("ts %s: split %s -> %s", self.server_id, parent_id,
               info["children"])
 

@@ -58,7 +58,9 @@ class QLProcessor:
         self._client = client
         self._txn_manager = txn_manager or TransactionManager(client)
         self._keyspace: Optional[str] = None
-        self._tables: Dict[Tuple[str, str], YBTable] = {}
+        # (keyspace, table) -> (handle, cached-at monotonic time); see
+        # the TTL logic in _table()
+        self._tables: Dict[Tuple[str, str], Tuple[YBTable, float]] = {}
         self._stmt_cache: Dict[str, P.Statement] = {}
         self._lock = threading.Lock()
 

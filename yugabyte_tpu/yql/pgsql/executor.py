@@ -69,7 +69,7 @@ class PgSession:
         self._client = client
         self._txn_manager = txn_manager
         self.database = database
-        self._tables: Dict[str, YBTable] = {}
+        self._tables: Dict[str, Tuple[YBTable, float]] = {}  # TTL'd cache
         self._txn = None
         self.txn_failed = False
         # PG connects to an EXISTING database; only the default one is
