@@ -1,0 +1,174 @@
+"""Minimal CQL native protocol v4 client — the test-side counterpart of
+yql/cql/binary_server.py, speaking the same frames a Cassandra driver
+would (STARTUP/QUERY/PREPARE/EXECUTE/BATCH with typed values)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from yugabyte_tpu.common.schema import DataType
+from yugabyte_tpu.yql.cql import wire as W
+
+
+class CqlError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"[{code:#06x}] {message}")
+        self.code = code
+
+
+class Rows:
+    def __init__(self, columns, types, rows):
+        self.columns = columns
+        self.types = types
+        self.rows = rows
+
+
+class CqlWireClient:
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._stream = 0
+        body = W.w_string_map({"CQL_VERSION": "3.4.4"})
+        op, _ = self._request(W.OP_STARTUP, body)
+        assert op == W.OP_READY, f"unexpected startup response {op:#x}"
+
+    def close(self) -> None:
+        self._sock.close()
+
+    # ------------------------------------------------------------ plumbing
+    def _request(self, opcode: int, body: bytes = b"") -> Tuple[int, bytes]:
+        self._stream = (self._stream + 1) % 32000
+        self._sock.sendall(W.frame(W.VERSION_REQUEST, self._stream, opcode,
+                                   body))
+        version, stream, op, rbody = W.read_frame(self._sock)
+        assert version == W.VERSION_RESPONSE and stream == self._stream
+        if op == W.OP_ERROR:
+            r = W.Reader(rbody)
+            raise CqlError(r.i32(), r.string())
+        return op, rbody
+
+    @staticmethod
+    def _read_metadata(r: W.Reader):
+        flags = r.i32()
+        n = r.i32()
+        if flags & 0x02:  # has_more_pages
+            r.bytes_()
+        global_spec = bool(flags & 0x01)
+        if global_spec:
+            r.string()
+            r.string()
+        cols = []
+        for _ in range(n):
+            if not global_spec:
+                r.string()
+                r.string()
+            name = r.string()
+            tid = r.u16()
+            cols.append((name, tid))
+        return cols
+
+    def _parse_result(self, body: bytes):
+        r = W.Reader(body)
+        kind = r.i32()
+        if kind == W.RESULT_VOID:
+            return None
+        if kind == W.RESULT_SET_KEYSPACE:
+            return r.string()
+        if kind == W.RESULT_SCHEMA_CHANGE:
+            return ("schema_change", r.string(), r.string())
+        if kind == W.RESULT_PREPARED:
+            pid = r.short_bytes()
+            r.i32()  # flags
+            n = r.i32()
+            pk_count = r.i32()
+            for _ in range(pk_count):
+                r.u16()
+            types = []
+            for _ in range(n):
+                r.string()
+                r.string()
+                r.string()
+                types.append(r.u16())
+            return ("prepared", pid, types)
+        if kind == W.RESULT_ROWS:
+            cols = self._read_metadata(r)
+            n_rows = r.i32()
+            by_tid = {W.TYPE_INT: DataType.INT32,
+                      W.TYPE_BIGINT: DataType.INT64,
+                      W.TYPE_BOOLEAN: DataType.BOOL,
+                      W.TYPE_DOUBLE: DataType.DOUBLE,
+                      W.TYPE_FLOAT: DataType.FLOAT,
+                      W.TYPE_BLOB: DataType.BINARY,
+                      W.TYPE_TIMESTAMP: DataType.TIMESTAMP}
+            rows = []
+            for _ in range(n_rows):
+                row = []
+                for _name, tid in cols:
+                    dt = by_tid.get(tid, DataType.STRING)
+                    row.append(W.decode_value(r.bytes_(), dt))
+                rows.append(row)
+            return Rows([c for c, _ in cols], [t for _, t in cols], rows)
+        raise AssertionError(f"unknown result kind {kind}")
+
+    # ------------------------------------------------------------- surface
+    def execute(self, query: str, params: Optional[List[Tuple[object,
+                DataType]]] = None):
+        """params: (value, DataType) pairs, encoded exactly as a driver
+        would from the prepared metadata (QUERY carries typed values)."""
+        body = [W.w_long_string(query), struct.pack(">H", 1)]  # consistency
+        if params:
+            body.append(bytes([0x01]))
+            body.append(struct.pack(">H", len(params)))
+            for v, dt in params:
+                body.append(W.w_bytes(W.encode_value(v, dt)))
+        else:
+            body.append(bytes([0x00]))
+        op, rbody = self._request(W.OP_QUERY, b"".join(body))
+        assert op == W.OP_RESULT
+        return self._parse_result(rbody)
+
+    def prepare(self, query: str):
+        op, rbody = self._request(W.OP_PREPARE, W.w_long_string(query))
+        assert op == W.OP_RESULT
+        kind, pid, types = self._parse_result(rbody)
+        assert kind == "prepared"
+        return pid, types
+
+    def execute_prepared(self, pid: bytes, values: List[Tuple[object,
+                         DataType]]):
+        body = [W.w_short_bytes(pid), struct.pack(">H", 1)]
+        if values:
+            body.append(bytes([0x01]))
+            body.append(struct.pack(">H", len(values)))
+            for v, dt in values:
+                body.append(W.w_bytes(W.encode_value(v, dt)))
+        else:
+            body.append(bytes([0x00]))
+        op, rbody = self._request(W.OP_EXECUTE, b"".join(body))
+        assert op == W.OP_RESULT
+        return self._parse_result(rbody)
+
+    def batch(self, items: List[Tuple[str, List[Tuple[object, DataType]]]]
+              ) -> None:
+        body = [bytes([0]), struct.pack(">H", len(items))]
+        for text, values in items:
+            body.append(bytes([0]))
+            body.append(W.w_long_string(text))
+            body.append(struct.pack(">H", len(values)))
+            for v, dt in values:
+                body.append(W.w_bytes(W.encode_value(v, dt)))
+        body.append(struct.pack(">H", 1))
+        op, rbody = self._request(W.OP_BATCH, b"".join(body))
+        assert op == W.OP_RESULT
+        return self._parse_result(rbody)
+
+    def options(self) -> Dict[str, List[str]]:
+        op, rbody = self._request(W.OP_OPTIONS)
+        assert op == W.OP_SUPPORTED
+        r = W.Reader(rbody)
+        out = {}
+        for _ in range(r.u16()):
+            k = r.string()
+            out[k] = [r.string() for _ in range(r.u16())]
+        return out

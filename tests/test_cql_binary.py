@@ -1,0 +1,146 @@
+"""CQL binary protocol v4 end-to-end: real frames over a real socket
+against a MiniCluster (round-2 Missing #2 — previously the YCQL layer only
+spoke the private RPC codec; ref src/yb/yql/cql/cqlserver/cql_server.h:58).
+"""
+
+import pytest
+
+from yugabyte_tpu.common.schema import DataType
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.yql.cql.binary_server import CQLBinaryServer
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(__file__))
+from cql_wire_client import CqlError, CqlWireClient  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    flags.set_flag("replication_factor", 3)
+    flags.set_flag("index_backfill_grace_ms", 200)
+    flags.set_flag("table_cache_ttl_ms", 100)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path_factory.mktemp("cqlbin")))).start()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def server(cluster):
+    srv = CQLBinaryServer(cluster.new_client())
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def schema_ready(server):
+    c = CqlWireClient(server.host, server.port)
+    c.execute("CREATE KEYSPACE IF NOT EXISTS wire_ks")
+    c.execute("USE wire_ks")
+    c.execute("CREATE TABLE IF NOT EXISTS t1 (id INT PRIMARY KEY, "
+              "name TEXT, score DOUBLE) WITH tablets = 2")
+    c.close()
+    return True
+
+
+@pytest.fixture()
+def conn(server, schema_ready):
+    c = CqlWireClient(server.host, server.port)
+    yield c
+    c.close()
+
+
+def test_startup_options_and_ddl(conn):
+    assert "CQL_VERSION" in conn.options()
+    assert conn.execute("USE wire_ks") == "wire_ks"
+
+
+def test_query_with_typed_values_roundtrip(conn):
+    conn.execute("USE wire_ks")
+    conn.execute("INSERT INTO t1 (id, name, score) VALUES (?, ?, ?)",
+                 [(1, DataType.INT32), ("alice", DataType.STRING),
+                  (9.5, DataType.DOUBLE)])
+    rows = conn.execute("SELECT id, name, score FROM t1 WHERE id = ?",
+                        [(1, DataType.INT32)])
+    assert rows.columns == ["id", "name", "score"]
+    assert rows.rows == [[1, "alice", 9.5]]
+
+
+def test_prepare_bind_execute(conn):
+    conn.execute("USE wire_ks")
+    pid, types = conn.prepare(
+        "INSERT INTO t1 (id, name, score) VALUES (?, ?, ?)")
+    # marker metadata carries real types for the driver's encoder
+    from yugabyte_tpu.yql.cql import wire as W
+    assert types == [W.TYPE_INT, W.TYPE_VARCHAR, W.TYPE_DOUBLE]
+    for i in range(5):
+        conn.execute_prepared(pid, [(100 + i, DataType.INT32),
+                                    (f"u{i}", DataType.STRING),
+                                    (float(i), DataType.DOUBLE)])
+    sel, stypes = conn.prepare("SELECT name FROM t1 WHERE id = ?")
+    assert stypes == [W.TYPE_INT]
+    rows = conn.execute_prepared(sel, [(103, DataType.INT32)])
+    assert rows.rows == [["u3"]]
+
+
+def test_null_values_and_missing_row(conn):
+    conn.execute("USE wire_ks")
+    conn.execute("INSERT INTO t1 (id, name) VALUES (?, ?)",
+                 [(200, DataType.INT32), ("noscore", DataType.STRING)])
+    rows = conn.execute("SELECT id, name, score FROM t1 WHERE id = ?",
+                        [(200, DataType.INT32)])
+    assert rows.rows == [[200, "noscore", None]]
+    rows = conn.execute("SELECT id FROM t1 WHERE id = ?",
+                        [(424242, DataType.INT32)])
+    assert rows.rows == []
+
+
+def test_batch(conn):
+    conn.execute("USE wire_ks")
+    conn.batch([
+        ("INSERT INTO t1 (id, name) VALUES (?, ?)",
+         [(301, DataType.INT32), ("b1", DataType.STRING)]),
+        ("INSERT INTO t1 (id, name) VALUES (?, ?)",
+         [(302, DataType.INT32), ("b2", DataType.STRING)]),
+    ])
+    rows = conn.execute("SELECT name FROM t1 WHERE id = ?",
+                        [(302, DataType.INT32)])
+    assert rows.rows == [["b2"]]
+
+
+def test_error_surfaces_as_cql_error(conn):
+    conn.execute("USE wire_ks")
+    with pytest.raises(CqlError):
+        conn.execute("SELECT nope FROM does_not_exist")
+    # connection stays usable after an error
+    rows = conn.execute("SELECT id FROM t1 WHERE id = ?",
+                        [(1, DataType.INT32)])
+    assert rows.rows == [[1]]
+
+
+def test_index_through_binary_protocol(conn):
+    conn.execute("USE wire_ks")
+    conn.execute("CREATE TABLE bt (id INT PRIMARY KEY, tag TEXT) "
+                 "WITH tablets = 2")
+    for i in range(12):
+        conn.execute("INSERT INTO bt (id, tag) VALUES (?, ?)",
+                     [(i, DataType.INT32), (f"g{i % 2}", DataType.STRING)])
+    conn.execute("CREATE INDEX bt_tag ON bt (tag)")
+    rows = conn.execute("SELECT id FROM bt WHERE tag = ?",
+                        [("g1", DataType.STRING)])
+    assert sorted(r[0] for r in rows.rows) == [1, 3, 5, 7, 9, 11]
+
+
+def test_unprepared_and_protocol_errors(server):
+    c = CqlWireClient(server.host, server.port)
+    try:
+        with pytest.raises(CqlError) as ei:
+            c.execute_prepared(b"\x00" * 16, [])
+        from yugabyte_tpu.yql.cql import wire as W
+        assert ei.value.code == W.ERR_UNPREPARED
+    finally:
+        c.close()

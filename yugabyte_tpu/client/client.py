@@ -228,7 +228,13 @@ class YBClient:
                         last_err = e
                         continue
                     if e.status.code in (Code.NOT_FOUND,
-                                         Code.SERVICE_UNAVAILABLE):
+                                         Code.SERVICE_UNAVAILABLE,
+                                         Code.TIMED_OUT):
+                        # TIMED_OUT is the server's OperationOutcomeUnknown:
+                        # the entry may still commit. Retrying HERE — with
+                        # the same request id — is what makes the
+                        # retryable-request dedup close the double-apply
+                        # hole (the op args carry client_id/request_id).
                         last_err = e
                         continue
                     raise

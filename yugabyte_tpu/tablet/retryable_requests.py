@@ -106,6 +106,12 @@ class RetryableRequests:
                 if now - t > ttl]
         for r in dead:
             del self._replicated[r]
+        # orphaned in-flight tags (overwritten follower entries, clients
+        # that never retried) must not accumulate forever
+        in_ttl = flags.get_flag("retryable_request_inflight_timeout_s")
+        stale = [r for r, t in self._in_flight.items() if now - t > in_ttl]
+        for r in stale:
+            del self._in_flight[r]
 
     def __len__(self) -> int:
         with self._lock:

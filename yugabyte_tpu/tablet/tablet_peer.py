@@ -191,9 +191,19 @@ class TabletPeer:
         # Flushed storage implies those entries were committed; the floor
         # may exceed the (non-fsynced) one recovered from metadata.
         committed_floor = max(self.raft.commit_index, flushed_min)
-        for entry in LogReader(self.log.wal_dir).read_all(
-                min_index=replay_from):
+        for entry in LogReader(self.log.wal_dir).read_all():
             msg = ReplicateMsg.from_log_entry(entry)
+            if msg.index < replay_from:
+                # already flushed into storage — not replayed, but its
+                # retryable-request tag must still resolve to 'replicated'
+                # or a post-restart retry would double-apply after the
+                # in-flight expiry (dedup must survive restart-after-flush)
+                if msg.op_type == OP_WRITE and msg.payload \
+                        and msg.payload[0] & 4:
+                    cid = msg.payload[-24:-8]
+                    (rid,) = struct.unpack("<Q", msg.payload[-8:])
+                    self.tablet.retryable.replicated(cid, rid, msg.ht_value)
+                continue
             if msg.index > committed_floor:
                 break  # pending tail: Raft decides its fate later
             self._apply_replicated(msg)

@@ -29,7 +29,7 @@ class YugabytedNode:
                  tserver_port: int = 0, join: Optional[str] = None,
                  server_id: Optional[str] = None,
                  replication_factor: Optional[int] = None,
-                 pg_port: int = 0):
+                 pg_port: int = 0, cql_port: int = 0):
         os.makedirs(base_dir, exist_ok=True)
         if join is None:
             # Single-node bringup defaults to RF1 (ref yugabyted defaults);
@@ -58,10 +58,14 @@ class YugabytedNode:
         from yugabyte_tpu.yql.pgsql import PgServer
         self._pg_client = YBClient(master_addrs)
         self.pg_server = PgServer(self._pg_client, port=pg_port)
+        from yugabyte_tpu.yql.cql.binary_server import CQLBinaryServer
+        self._cql_client = YBClient(master_addrs)
+        self.cql_server = CQLBinaryServer(self._cql_client, port=cql_port)
 
     def endpoints(self) -> dict:
         out = {"tserver_rpc": self.tserver.address,
                "ysql": self.pg_server.address,
+               "ycql": f"{self.cql_server.host}:{self.cql_server.port}",
                "masters": self.master_addrs}
         if self.tserver.webserver:
             out["tserver_web"] = self.tserver.webserver.address
@@ -72,6 +76,8 @@ class YugabytedNode:
         return out
 
     def shutdown(self) -> None:
+        self.cql_server.shutdown()
+        self._cql_client.close()
         self.pg_server.shutdown()
         self._pg_client.close()
         self.tserver.shutdown()

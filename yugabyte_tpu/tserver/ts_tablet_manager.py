@@ -187,12 +187,15 @@ class TSTabletManager:
         for child_id, child_part in zip(info["children"], child_parts):
             with self._create_lock:
                 with self._lock:
-                    if child_id in self._tablets:
-                        continue
+                    already = child_id in self._tablets
+                if already:
+                    self._inherit_retryable(parent, child_id)
+                    continue
                 cdir = self._tablet_dir(child_id)
                 if os.path.exists(os.path.join(cdir, "meta.json")):
                     self._open_tablet(child_id, jsonutil.read_file(
                         os.path.join(cdir, "meta.json")))
+                    self._inherit_retryable(parent, child_id)
                     continue
                 tmp_dir = os.path.join(self._tablets_root,
                                        f".split-{child_id}")
@@ -216,15 +219,19 @@ class TSTabletManager:
                 shutil.rmtree(cdir, ignore_errors=True)
                 os.rename(tmp_dir, cdir)
                 self._open_tablet(child_id, meta)
-            # exactly-once dedup survives the split: children adopt the
-            # parent's retryable-request records (the data they inherited
-            # includes those writes)
-            with self._lock:
-                child = self._tablets.get(child_id)
-            if child is not None:
-                child.tablet.retryable.inherit_from(parent.tablet.retryable)
+            # exactly-once dedup survives the split on EVERY path (fresh
+            # create, replay re-open, already-open): children adopt the
+            # parent's retryable-request records — the data they inherited
+            # includes those writes
+            self._inherit_retryable(parent, child_id)
         TRACE("ts %s: split %s -> %s", self.server_id, parent_id,
               info["children"])
+
+    def _inherit_retryable(self, parent, child_id: str) -> None:
+        with self._lock:
+            child = self._tablets.get(child_id)
+        if child is not None:
+            child.tablet.retryable.inherit_from(parent.tablet.retryable)
 
     def split_tablet(self, tablet_id: str) -> List[str]:
         """Leader-side split entry: compute the split point and replicate

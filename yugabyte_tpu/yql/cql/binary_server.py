@@ -1,0 +1,332 @@
+"""CQL binary protocol v4 server: the network face Cassandra drivers speak.
+
+Capability parity with the reference's cqlserver (ref: src/yb/yql/cql/
+cqlserver/cql_server.h:58 — socket server; cql_processor.h:63 — per
+connection processor; cql_service.cc — shared prepared-statement cache):
+STARTUP/OPTIONS/QUERY/PREPARE/EXECUTE/BATCH/REGISTER over real v4 frames,
+one thread per connection, statements executed by the shared YCQL
+parser/executor (yql/cql/parser.py, executor.py).
+
+Prepared statements: PREPARE parses once, infers each bind marker's type
+from the target table's schema (the metadata a driver uses to encode
+EXECUTE values), and caches under an MD5 id, like the reference's
+prepared-statement cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from yugabyte_tpu.client.client import YBClient
+from yugabyte_tpu.client.transaction import TransactionManager
+from yugabyte_tpu.common.schema import DataType
+from yugabyte_tpu.utils.status import StatusError
+from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.yql.cql import parser as P
+from yugabyte_tpu.yql.cql import wire as W
+from yugabyte_tpu.yql.cql.executor import QLProcessor, ResultSet
+
+
+def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
+    """Bind-marker types in statement order, from the table schema (the
+    reference's analyzer types markers the same way, ql/ptree pt_bind_var).
+    """
+    def table_schema(ks, name):
+        return processor._table(ks, name).schema
+
+    def where_types(schema, where):
+        return [schema.column(c).type for c, _op, v in where
+                if v is P.MARKER]
+
+    if isinstance(stmt, P.Insert):
+        schema = table_schema(stmt.keyspace, stmt.table)
+        return [schema.column(c).type
+                for c, v in zip(stmt.columns, stmt.values) if v is P.MARKER]
+    if isinstance(stmt, P.Update):
+        schema = table_schema(stmt.keyspace, stmt.table)
+        out = [schema.column(c).type for c, v in stmt.assignments
+               if v is P.MARKER]
+        return out + where_types(schema, stmt.where)
+    if isinstance(stmt, P.Delete):
+        schema = table_schema(stmt.keyspace, stmt.table)
+        return where_types(schema, stmt.where)
+    if isinstance(stmt, P.Select):
+        schema = table_schema(stmt.keyspace, stmt.table)
+        return where_types(schema, stmt.where)
+    if isinstance(stmt, P.Transaction):
+        out: List[DataType] = []
+        for s in stmt.statements:
+            out.extend(infer_marker_types(s, processor))
+        return out
+    return []
+
+
+class _Prepared:
+    def __init__(self, text: str, types: List[DataType],
+                 keyspace: Optional[str]):
+        self.text = text
+        self.types = types
+        # keyspace-scoped id: the same unqualified text prepared under two
+        # keyspaces must not collide (their marker types can differ)
+        self.id = hashlib.md5(
+            (keyspace or "").encode() + b"\x00" + text.encode()).digest()
+
+
+class _Connection:
+    def __init__(self, server: "CQLBinaryServer", sock: socket.socket):
+        self._server = server
+        self._sock = sock
+        self._processor = QLProcessor(server.client, server.txn_manager)
+        self._lock = threading.Lock()  # serialize writes (async streams)
+
+    # ------------------------------------------------------------- sending
+    def _send(self, stream: int, opcode: int, body: bytes = b"") -> None:
+        with self._lock:
+            self._sock.sendall(
+                W.frame(W.VERSION_RESPONSE, stream, opcode, body))
+
+    def _send_error(self, stream: int, code: int, msg: str) -> None:
+        self._send(stream, W.OP_ERROR, W.error_body(code, msg))
+
+    def _send_rows(self, stream: int, rs: ResultSet) -> None:
+        ks, tbl = rs.source
+        cols = [(ks, tbl, name, rs.types[i] if i < len(rs.types)
+                 and rs.types[i] is not None else _infer_type(rs, i))
+                for i, name in enumerate(rs.columns)]
+        out = [struct.pack(">i", W.RESULT_ROWS),
+               W.rows_metadata(cols),
+               struct.pack(">i", len(rs.rows))]
+        for row in rs.rows:
+            for i, v in enumerate(row):
+                out.append(W.w_bytes(W.encode_value(v, cols[i][3])))
+        self._send(stream, W.OP_RESULT, b"".join(out))
+
+    def _send_void(self, stream: int) -> None:
+        self._send(stream, W.OP_RESULT, struct.pack(">i", W.RESULT_VOID))
+
+    # -------------------------------------------------------------- serving
+    def serve(self) -> None:
+        try:
+            while True:
+                try:
+                    version, stream, opcode, body = W.read_frame(self._sock)
+                except (ConnectionError, OSError):
+                    return
+                if version != W.VERSION_REQUEST:
+                    self._send_error(stream, W.ERR_PROTOCOL,
+                                     f"unsupported version {version:#x}")
+                    return
+                try:
+                    self._dispatch(stream, opcode, W.Reader(body))
+                except StatusError as e:
+                    self._send_error(stream, _err_code(e), str(e))
+                except (ValueError, KeyError, struct.error) as e:
+                    self._send_error(stream, W.ERR_INVALID, str(e))
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, stream: int, opcode: int, r: W.Reader) -> None:
+        if opcode == W.OP_STARTUP:
+            r.string_map()  # CQL_VERSION etc. — any v4 dialect accepted
+            self._send(stream, W.OP_READY)
+        elif opcode == W.OP_OPTIONS:
+            self._send(stream, W.OP_SUPPORTED, W.w_string_multimap(
+                {"CQL_VERSION": ["3.4.4"], "COMPRESSION": []}))
+        elif opcode == W.OP_REGISTER:
+            r.string_list()  # event registration accepted; no events yet
+            self._send(stream, W.OP_READY)
+        elif opcode == W.OP_QUERY:
+            query = r.long_string()
+            params = self._read_query_params(
+                r, types=None, types_provider=lambda: self._marker_types(
+                    query))
+            self._run(stream, query, params)
+        elif opcode == W.OP_PREPARE:
+            text = r.long_string()
+            stmt = P.parse(text)
+            prep = _Prepared(text, infer_marker_types(stmt,
+                                                      self._processor),
+                             self._processor._keyspace)
+            self._server.prepared[prep.id] = prep
+            # v4 Prepared result: id, bind-marker metadata (flags=0,
+            # n columns, pk_count=0, per-marker ks/table/name/type),
+            # then empty result metadata
+            marker_meta = [struct.pack(">i", 0),
+                           struct.pack(">i", len(prep.types)),
+                           struct.pack(">i", 0)]
+            for i, t in enumerate(prep.types):
+                marker_meta += [W.w_string(""), W.w_string(""),
+                                W.w_string(f"p{i}"),
+                                struct.pack(">H", W.cql_type_of(t))]
+            self._send(stream, W.OP_RESULT, b"".join(
+                [struct.pack(">i", W.RESULT_PREPARED),
+                 W.w_short_bytes(prep.id)] + marker_meta
+                + [W.rows_metadata([])]))
+        elif opcode == W.OP_EXECUTE:
+            pid = r.short_bytes()
+            prep = self._server.prepared.get(pid)
+            if prep is None:
+                self._send_error(stream, W.ERR_UNPREPARED,
+                                 "unprepared statement")
+                return
+            params = self._read_query_params(r, types=prep.types)
+            self._run(stream, prep.text, params)
+        elif opcode == W.OP_BATCH:
+            self._run_batch(stream, r)
+        else:
+            self._send_error(stream, W.ERR_PROTOCOL,
+                             f"unsupported opcode {opcode:#x}")
+
+    def _marker_types(self, query: str) -> List[DataType]:
+        """Bind-marker types for an unprepared QUERY with values: parse the
+        text and type the markers against the schema (same inference the
+        PREPARE path uses; drivers send raw bytes either way)."""
+        try:
+            return infer_marker_types(P.parse(query), self._processor)
+        except (StatusError, ValueError, KeyError):
+            return []
+
+    def _read_query_params(self, r: W.Reader,
+                           types: Optional[List[DataType]],
+                           types_provider=None) -> List:
+        r.u16()  # consistency — single-partition linearizable regardless
+        flags = r.u8()
+        params: List = []
+        if flags & 0x01:  # values
+            if types is None and types_provider is not None:
+                types = types_provider()
+            if flags & 0x40:
+                # named values would need named markers to bind correctly;
+                # binding them positionally silently swaps columns, so
+                # refuse (drivers use positional values by default)
+                raise ValueError("named bind values are not supported")
+            n = r.u16()
+            for i in range(n):
+                raw = r.bytes_()
+                dt = (types[i] if types is not None and i < len(types)
+                      else DataType.STRING)
+                params.append(W.decode_value(raw, dt))
+        if flags & 0x04:
+            r.i32()   # page size (full result returned; paging TODO)
+        if flags & 0x08:
+            r.bytes_()  # paging state
+        if flags & 0x10:
+            r.u16()   # serial consistency
+        if flags & 0x20:
+            r.i64()   # default timestamp
+        return params
+
+    def _run(self, stream: int, text: str, params: List) -> None:
+        stmt_head = text.lstrip()[:6].upper()
+        rs = self._processor.execute(text, params)
+        if stmt_head.startswith("USE"):
+            self._send(stream, W.OP_RESULT,
+                       struct.pack(">i", W.RESULT_SET_KEYSPACE)
+                       + W.w_string(self._processor._keyspace or ""))
+        elif rs.columns:
+            self._send_rows(stream, rs)
+        elif stmt_head.startswith(("CREATE", "DROP", "ALTER")):
+            # SCHEMA_CHANGE result (change_type, target, options)
+            self._send(stream, W.OP_RESULT,
+                       struct.pack(">i", W.RESULT_SCHEMA_CHANGE)
+                       + W.w_string("CREATED") + W.w_string("TABLE")
+                       + W.w_string(self._processor._keyspace or "")
+                       + W.w_string(""))
+        else:
+            self._send_void(stream)
+
+    def _run_batch(self, stream: int, r: W.Reader) -> None:
+        r.u8()  # batch type (logged/unlogged/counter)
+        n = r.u16()
+        for _ in range(n):
+            kind = r.u8()
+            if kind == 0:
+                text = r.long_string()
+                types: Optional[List[DataType]] = self._marker_types(text)
+            else:
+                prep = self._server.prepared.get(r.short_bytes())
+                if prep is None:
+                    self._send_error(stream, W.ERR_UNPREPARED,
+                                     "unprepared statement in batch")
+                    return
+                text, types = prep.text, prep.types
+            nvals = r.u16()
+            params = []
+            for i in range(nvals):
+                raw = r.bytes_()
+                dt = (types[i] if types is not None and i < len(types)
+                      else DataType.STRING)
+                params.append(W.decode_value(raw, dt))
+            self._processor.execute(text, params)
+        r.u16()  # consistency
+        self._send_void(stream)
+
+
+def _infer_type(rs: ResultSet, col: int) -> DataType:
+    for row in rs.rows:
+        v = row[col]
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return DataType.BOOL
+        if isinstance(v, int):
+            return DataType.INT64
+        if isinstance(v, float):
+            return DataType.DOUBLE
+        if isinstance(v, bytes):
+            return DataType.BINARY
+        return DataType.STRING
+    return DataType.STRING
+
+
+def _err_code(e: StatusError) -> int:
+    name = e.status.code.name
+    if name == "INVALID_ARGUMENT":
+        return W.ERR_INVALID
+    if name == "ALREADY_PRESENT":
+        return W.ERR_ALREADY_EXISTS
+    if name == "NOT_SUPPORTED":
+        return W.ERR_SYNTAX
+    return W.ERR_SERVER
+
+
+class CQLBinaryServer:
+    """Thread-per-connection CQL v4 endpoint (default port 9042 in the
+    reference; ephemeral here unless given)."""
+
+    def __init__(self, client: YBClient, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.client = client
+        self.txn_manager = TransactionManager(client)
+        self.prepared: Dict[bytes, _Prepared] = {}
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._shutdown = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="cql-accept")
+        self._accept_thread.start()
+        TRACE("cql binary server listening on %s:%d", self.host, self.port)
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn = _Connection(self, sock)
+            threading.Thread(target=conn.serve, daemon=True,
+                             name="cql-conn").start()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass

@@ -45,6 +45,11 @@ _CQL_TYPES = {
 class ResultSet:
     columns: List[str] = field(default_factory=list)
     rows: List[List[object]] = field(default_factory=list)
+    # column DataTypes (parallel to columns; None where unknown) and the
+    # source (keyspace, table) — consumed by the binary protocol front end
+    # for Rows result metadata
+    types: List[Optional[DataType]] = field(default_factory=list)
+    source: Tuple[str, str] = ("", "")
 
     def dicts(self) -> List[dict]:
         return [dict(zip(self.columns, r)) for r in self.rows]
@@ -295,7 +300,10 @@ class QLProcessor:
         where = [(c, op, self._bind(v, params, cursor))
                  for c, op, v in stmt.where]
         out_cols = stmt.columns or [c.name for c in schema.columns]
-        rs = ResultSet(columns=list(out_cols))
+        known = {c.name: c.type for c in schema.columns}
+        rs = ResultSet(columns=list(out_cols),
+                       types=[known.get(c) for c in out_cols],
+                       source=(table.namespace, table.name))
         dk, residual = self._doc_key_from_where(table, where)
         full_key = (dk is not None
                     and len(dk.range_components)

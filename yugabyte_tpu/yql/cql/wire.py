@@ -1,0 +1,241 @@
+"""CQL native protocol v4: frame + type codec primitives.
+
+Implements the wire format any Cassandra v4 driver speaks (ref: the
+reference's CQL server, src/yb/yql/cql/cqlserver/cql_message.h — opcodes,
+frame header, notations [int]/[short]/[string]/[bytes]/[value]). Shared by
+the server (binary_server.py) and the in-repo test client
+(tests/cql_wire_client.py).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from yugabyte_tpu.common.schema import DataType
+
+VERSION_REQUEST = 0x04
+VERSION_RESPONSE = 0x84
+
+OP_ERROR = 0x00
+OP_STARTUP = 0x01
+OP_READY = 0x02
+OP_AUTHENTICATE = 0x03
+OP_OPTIONS = 0x05
+OP_SUPPORTED = 0x06
+OP_QUERY = 0x07
+OP_RESULT = 0x08
+OP_PREPARE = 0x09
+OP_EXECUTE = 0x0A
+OP_REGISTER = 0x0B
+OP_EVENT = 0x0C
+OP_BATCH = 0x0D
+
+RESULT_VOID = 0x0001
+RESULT_ROWS = 0x0002
+RESULT_SET_KEYSPACE = 0x0003
+RESULT_PREPARED = 0x0004
+RESULT_SCHEMA_CHANGE = 0x0005
+
+# error codes (subset; ref cql protocol spec section 9)
+ERR_SERVER = 0x0000
+ERR_PROTOCOL = 0x000A
+ERR_INVALID = 0x2200
+ERR_SYNTAX = 0x2000
+ERR_ALREADY_EXISTS = 0x2400
+ERR_UNPREPARED = 0x2500
+
+# CQL type option ids
+TYPE_CUSTOM = 0x0000
+TYPE_ASCII = 0x0001
+TYPE_BIGINT = 0x0002
+TYPE_BLOB = 0x0003
+TYPE_BOOLEAN = 0x0004
+TYPE_DOUBLE = 0x0007
+TYPE_FLOAT = 0x0008
+TYPE_INT = 0x0009
+TYPE_TIMESTAMP = 0x000B
+TYPE_VARCHAR = 0x000D
+
+_DATATYPE_TO_CQL = {
+    DataType.STRING: TYPE_VARCHAR,
+    DataType.BINARY: TYPE_BLOB,
+    DataType.INT32: TYPE_INT,
+    DataType.INT64: TYPE_BIGINT,
+    DataType.BOOL: TYPE_BOOLEAN,
+    DataType.DOUBLE: TYPE_DOUBLE,
+    DataType.FLOAT: TYPE_FLOAT,
+    DataType.TIMESTAMP: TYPE_TIMESTAMP,
+}
+
+
+def cql_type_of(dt: DataType) -> int:
+    return _DATATYPE_TO_CQL.get(dt, TYPE_VARCHAR)
+
+
+# ------------------------------------------------------------ notation: write
+def w_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def w_long_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">i", len(b)) + b
+
+
+def w_string_map(m: Dict[str, str]) -> bytes:
+    out = [struct.pack(">H", len(m))]
+    for k, v in m.items():
+        out.append(w_string(k))
+        out.append(w_string(v))
+    return b"".join(out)
+
+
+def w_string_multimap(m: Dict[str, List[str]]) -> bytes:
+    out = [struct.pack(">H", len(m))]
+    for k, vs in m.items():
+        out.append(w_string(k))
+        out.append(struct.pack(">H", len(vs)))
+        for v in vs:
+            out.append(w_string(v))
+    return b"".join(out)
+
+
+def w_bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+def w_short_bytes(b: bytes) -> bytes:
+    return struct.pack(">H", len(b)) + b
+
+
+# ------------------------------------------------------------- notation: read
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        b = self.data[self.pos: self.pos + n]
+        if len(b) != n:
+            raise ValueError("short CQL frame body")
+        self.pos += n
+        return b
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack(">H", self._take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> str:
+        return self._take(self.u16()).decode()
+
+    def long_string(self) -> str:
+        return self._take(self.i32()).decode()
+
+    def string_map(self) -> Dict[str, str]:
+        return {self.string(): self.string() for _ in range(self.u16())}
+
+    def string_list(self) -> List[str]:
+        return [self.string() for _ in range(self.u16())]
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        if n < 0:
+            return None
+        return self._take(n)
+
+    def short_bytes(self) -> bytes:
+        return self._take(self.u16())
+
+
+# ----------------------------------------------------------------- value codec
+def encode_value(v, dt: DataType) -> Optional[bytes]:
+    """Python value -> CQL [value] payload bytes (None -> null)."""
+    if v is None:
+        return None
+    t = cql_type_of(dt)
+    if t == TYPE_INT:
+        return struct.pack(">i", int(v))
+    if t == TYPE_BIGINT or t == TYPE_TIMESTAMP:
+        return struct.pack(">q", int(v))
+    if t == TYPE_BOOLEAN:
+        return b"\x01" if v else b"\x00"
+    if t == TYPE_DOUBLE:
+        return struct.pack(">d", float(v))
+    if t == TYPE_FLOAT:
+        return struct.pack(">f", float(v))
+    if t == TYPE_BLOB:
+        return bytes(v)
+    return str(v).encode()
+
+
+def decode_value(b: Optional[bytes], dt: DataType):
+    if b is None:
+        return None
+    t = cql_type_of(dt)
+    if t == TYPE_INT:
+        return struct.unpack(">i", b)[0]
+    if t == TYPE_BIGINT or t == TYPE_TIMESTAMP:
+        return struct.unpack(">q", b)[0]
+    if t == TYPE_BOOLEAN:
+        return b != b"\x00"
+    if t == TYPE_DOUBLE:
+        return struct.unpack(">d", b)[0]
+    if t == TYPE_FLOAT:
+        return struct.unpack(">f", b)[0]
+    if t == TYPE_BLOB:
+        return b
+    return b.decode()
+
+
+# ---------------------------------------------------------------------- frame
+HEADER = struct.Struct(">BBhBi")
+
+
+def frame(version: int, stream: int, opcode: int, body: bytes = b"",
+          flags: int = 0) -> bytes:
+    return HEADER.pack(version, flags, stream, opcode, len(body)) + body
+
+
+def read_frame(sock) -> Tuple[int, int, int, bytes]:
+    """-> (version, stream, opcode, body); raises ConnectionError on EOF."""
+    hdr = b""
+    while len(hdr) < HEADER.size:
+        chunk = sock.recv(HEADER.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        hdr += chunk
+    version, _flags, stream, opcode, length = HEADER.unpack(hdr)
+    body = b""
+    while len(body) < length:
+        chunk = sock.recv(length - len(body))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        body += chunk
+    return version, stream, opcode, body
+
+
+def error_body(code: int, message: str) -> bytes:
+    return struct.pack(">i", code) + w_string(message)
+
+
+def rows_metadata(columns: List[Tuple[str, str, str, DataType]]) -> bytes:
+    """columns: (keyspace, table, name, DataType). No paging state."""
+    out = [struct.pack(">i", 0x0000), struct.pack(">i", len(columns))]
+    for ks, tbl, name, dt in columns:
+        out.append(w_string(ks))
+        out.append(w_string(tbl))
+        out.append(w_string(name))
+        out.append(struct.pack(">H", cql_type_of(dt)))
+    return b"".join(out)
