@@ -131,6 +131,102 @@ class PgWireClient:
             else:
                 raise AssertionError(f"unexpected message {t!r}")
 
+    # --------------------------------------------- extended query protocol
+    def _send_msg(self, t: bytes, payload: bytes = b"") -> None:
+        self.sock.sendall(t + struct.pack(">I", len(payload) + 4) + payload)
+
+    def parse(self, name: str, sql: str,
+              param_oids: Optional[List[int]] = None) -> None:
+        oids = param_oids or []
+        payload = (name.encode() + b"\x00" + sql.encode() + b"\x00"
+                   + struct.pack(">H", len(oids))
+                   + b"".join(struct.pack(">i", o) for o in oids))
+        self._send_msg(b"P", payload)
+
+    def bind(self, portal: str, stmt: str,
+             params: Optional[List[Optional[str]]] = None) -> None:
+        """Text-format parameters, like libpq's default."""
+        params = params or []
+        payload = [portal.encode() + b"\x00" + stmt.encode() + b"\x00",
+                   struct.pack(">H", 0),                # all-text formats
+                   struct.pack(">H", len(params))]
+        for p in params:
+            if p is None:
+                payload.append(struct.pack(">i", -1))
+            else:
+                b = str(p).encode()
+                payload.append(struct.pack(">i", len(b)) + b)
+        payload.append(struct.pack(">H", 0))            # result formats
+        self._send_msg(b"B", b"".join(payload))
+
+    def describe(self, kind: str, name: str) -> None:
+        self._send_msg(b"D", kind.encode() + name.encode() + b"\x00")
+
+    def execute_portal(self, portal: str, max_rows: int = 0) -> None:
+        self._send_msg(b"E", portal.encode() + b"\x00"
+                       + struct.pack(">i", max_rows))
+
+    def sync(self) -> None:
+        self._send_msg(b"S")
+
+    def extended_query(self, sql: str,
+                       params: Optional[List[Optional[str]]] = None
+                       ) -> QueryResult:
+        """Full Parse/Bind/Describe/Execute/Sync cycle — what psycopg2 /
+        JDBC do for every parameterized execute()."""
+        self.parse("", sql)
+        self.bind("", "", params)
+        self.describe("P", "")
+        self.execute_portal("")
+        self.sync()
+        cur = QueryResult()
+        param_desc = None
+        error = None
+        while True:
+            t, payload = self._recv_msg()
+            if t in (b"1", b"2", b"3", b"n"):
+                continue
+            if t == b"t":
+                (n,) = struct.unpack_from(">H", payload, 0)
+                param_desc = list(struct.unpack_from(f">{n}I", payload, 2))
+                continue
+            if t == b"T":
+                cur.columns = []
+                (n,) = struct.unpack_from(">H", payload, 0)
+                pos = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", pos)
+                    (oid,) = struct.unpack_from(">I", payload, end + 7)
+                    cur.columns.append((payload[pos:end].decode(), oid))
+                    pos = end + 19
+            elif t == b"D":
+                (n,) = struct.unpack_from(">H", payload, 0)
+                pos = 2
+                row: List[Optional[str]] = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from(">i", payload, pos)
+                    pos += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[pos:pos + ln].decode())
+                        pos += ln
+                cur.rows.append(row)
+            elif t == b"C":
+                cur.tag = payload[:-1].decode()
+            elif t == b"I":
+                pass
+            elif t == b"E":
+                error = PgWireError(*self._parse_error(payload))
+            elif t == b"Z":
+                self.txn_status = payload.decode()
+                if error is not None:
+                    raise error
+                cur.param_oids = param_desc
+                return cur
+            else:
+                raise AssertionError(f"unexpected message {t!r}")
+
     def close(self) -> None:
         try:
             self.sock.sendall(b"X" + struct.pack(">I", 4))

@@ -1,0 +1,214 @@
+"""YSQL round 3: extended query protocol (Parse/Bind/Describe/Execute/
+Sync), pg_catalog vtables, ORDER BY / GROUP BY / aggregates — driven over
+real v3 wire frames (round-2 Missing #3; ref src/yb/yql/pggate/
+ybc_pggate.h:422-430, src/yb/master/yql_*_vtable.*).
+"""
+
+import pytest
+
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.yql.pgsql.server import PgServer
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(__file__))
+from pg_wire_client import PgWireClient, PgWireError  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    flags.set_flag("replication_factor", 3)
+    flags.set_flag("index_backfill_grace_ms", 200)
+    flags.set_flag("table_cache_ttl_ms", 100)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path_factory.mktemp("pgext")))).start()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def server(cluster):
+    srv = PgServer(cluster.new_client())
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def seeded(server):
+    c = PgWireClient("127.0.0.1", server.port)
+    c.query("CREATE TABLE sales (id INT PRIMARY KEY, region TEXT, "
+            "amount INT)")
+    for i in range(20):
+        c.query(f"INSERT INTO sales (id, region, amount) VALUES "
+                f"({i}, 'r{i % 3}', {i * 10})")
+    c.close()
+    return True
+
+
+@pytest.fixture()
+def conn(server, seeded):
+    c = PgWireClient("127.0.0.1", server.port)
+    yield c
+    c.close()
+
+
+# ------------------------------------------------ extended query protocol
+def test_parameterized_insert_and_select(conn):
+    r = conn.extended_query(
+        "INSERT INTO sales (id, region, amount) VALUES ($1, $2, $3)",
+        ["100", "rX", "777"])
+    assert r.tag == "INSERT 0 1"
+    r = conn.extended_query("SELECT region, amount FROM sales "
+                            "WHERE id = $1", ["100"])
+    assert [c[0] for c in r.columns] == ["region", "amount"]
+    assert r.rows == [["rX", "777"]]
+
+
+def test_parameter_description_types(conn):
+    conn.parse("s1", "SELECT amount FROM sales WHERE id = $1 AND "
+               "region = $2")
+    conn.describe("S", "s1")
+    conn.sync()
+    # drain: ParseComplete, ParameterDescription, RowDescription, Ready
+    got = {}
+    while True:
+        t, payload = conn._recv_msg()
+        got[t] = payload
+        if t == b"Z":
+            break
+    import struct
+    (n,) = struct.unpack_from(">H", got[b"t"], 0)
+    oids = struct.unpack_from(f">{n}I", got[b"t"], 2)
+    assert list(oids) == [20, 25]  # INT column (int64), TEXT column
+
+
+def test_extended_protocol_error_recovery(conn):
+    with pytest.raises(PgWireError):
+        conn.extended_query("SELECT nope FROM sales WHERE id = $1", ["1"])
+    # the cycle after the error must work (recovery at Sync)
+    r = conn.extended_query("SELECT amount FROM sales WHERE id = $1",
+                            ["3"])
+    assert r.rows == [["30"]]
+
+
+def test_prepared_statement_reuse(conn):
+    conn.parse("ins", "INSERT INTO sales (id, region, amount) VALUES "
+               "($1, $2, $3)")
+    for i in range(3):
+        conn.bind("", "ins", [str(200 + i), "rY", str(i)])
+        conn.execute_portal("")
+    conn.sync()
+    tags = []
+    while True:
+        t, payload = conn._recv_msg()
+        if t == b"C":
+            tags.append(payload[:-1].decode())
+        if t == b"Z":
+            break
+    assert tags == ["INSERT 0 1"] * 3
+    r = conn.extended_query("SELECT count(*) FROM sales WHERE region = $1",
+                            ["rY"])
+    assert r.rows == [["3"]]
+
+
+def test_null_parameter(conn):
+    conn.query("CREATE TABLE nt (id INT PRIMARY KEY, v TEXT)")
+    r = conn.extended_query("INSERT INTO nt (id, v) VALUES ($1, $2)",
+                            ["1", None])
+    assert r.tag == "INSERT 0 1"
+    r = conn.extended_query("SELECT v FROM nt WHERE id = $1", ["1"])
+    assert r.rows == [[None]]
+
+
+# ----------------------------------------------------- ORDER BY/aggregates
+def test_order_by_and_limit(conn):
+    (r,) = conn.query("SELECT id FROM sales WHERE region = 'r1' "
+                      "ORDER BY amount DESC LIMIT 3")
+    assert [x[0] for x in r.rows] == ["19", "16", "13"]
+    (r,) = conn.query("SELECT id, amount FROM sales WHERE id < 20 "
+                      "ORDER BY amount ASC LIMIT 2")
+    assert [x[0] for x in r.rows] == ["0", "1"]
+
+
+def test_aggregates(conn):
+    (r,) = conn.query("SELECT SUM(amount) FROM sales WHERE region = 'r0' "
+                      "AND id < 20")
+    want = sum(i * 10 for i in range(20) if i % 3 == 0)
+    assert r.rows == [[str(want)]]
+    (r,) = conn.query("SELECT MIN(amount), MAX(amount), COUNT(amount) "
+                      "FROM sales WHERE region = 'r2'")
+    vals = [i * 10 for i in range(20) if i % 3 == 2]
+    assert r.rows == [[str(min(vals)), str(max(vals)), str(len(vals))]]
+    (r,) = conn.query("SELECT AVG(amount) FROM sales WHERE region = 'r2'")
+    assert float(r.rows[0][0]) == pytest.approx(sum(vals) / len(vals))
+
+
+def test_group_by(conn):
+    (r,) = conn.query("SELECT region, COUNT(*), SUM(amount) FROM sales "
+                      "WHERE id < 20 GROUP BY region ORDER BY region")
+    # ORDER BY on aggregate output falls back to group-key order (sorted)
+    by_region = {row[0]: (row[1], row[2]) for row in r.rows}
+    for k in ("r0", "r1", "r2"):
+        ids = [i for i in range(20) if f"r{i % 3}" == k]
+        assert by_region[k] == (str(len(ids)),
+                                str(sum(i * 10 for i in ids)))
+
+
+def test_limit_parameter(conn):
+    r = conn.extended_query("SELECT id FROM sales WHERE region = $1 "
+                            "ORDER BY id LIMIT $2", ["r0", "2"])
+    assert [x[0] for x in r.rows] == ["0", "3"]
+
+
+def test_count_star_group_by(conn):
+    (r,) = conn.query("SELECT region, COUNT(*) FROM sales WHERE id < 20 "
+                      "GROUP BY region")
+    counts = {row[0]: row[1] for row in r.rows}
+    assert counts["r0"] == "7" and counts["r1"] == "7" \
+        and counts["r2"] == "6"
+
+
+def test_group_by_without_aggregate_is_distinct(conn):
+    (r,) = conn.query("SELECT region FROM sales WHERE id < 20 "
+                      "GROUP BY region")
+    assert sorted(x[0] for x in r.rows) == ["r0", "r1", "r2"]
+
+
+def test_positional_params_multirow_insert(conn):
+    conn.query("CREATE TABLE pp (id INT PRIMARY KEY, n INT)")
+    r = conn.extended_query("INSERT INTO pp VALUES ($1, $2), ($3, $4)",
+                            ["1", "10", "2", "20"])
+    assert r.tag == "INSERT 0 2"
+    (r,) = conn.query("SELECT SUM(n) FROM pp")
+    assert r.rows == [["30"]]  # ints, not concatenated strings
+
+
+# ------------------------------------------------------------- pg_catalog
+def test_pg_tables_and_indexes(conn):
+    (r,) = conn.query("SELECT tablename FROM pg_tables ORDER BY tablename")
+    names = [x[0] for x in r.rows]
+    assert "sales" in names
+    conn.query("CREATE INDEX sales_region ON sales (region)")
+    (r,) = conn.query("SELECT indexname, tablename FROM pg_indexes "
+                      "WHERE tablename = 'sales'")
+    assert ["sales_region", "sales"] in r.rows
+
+
+def test_information_schema(conn):
+    (r,) = conn.query("SELECT table_name FROM information_schema.tables")
+    assert ["sales"] in [[x[0]] for x in r.rows]
+    (r,) = conn.query("SELECT column_name, data_type FROM "
+                      "information_schema.columns WHERE table_name = "
+                      "'sales' ORDER BY ordinal_position")
+    assert [x[0] for x in r.rows] == ["id", "region", "amount"]
+
+
+def test_pg_class_attribute_join_free_probe(conn):
+    (r,) = conn.query("SELECT relname FROM pg_class WHERE relkind = 'r'")
+    assert ["sales"] in r.rows
+    (r,) = conn.query("SELECT attname FROM pg_attribute ORDER BY attnum "
+                      "LIMIT 3")
+    assert len(r.rows) == 3

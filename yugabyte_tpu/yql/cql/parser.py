@@ -30,6 +30,7 @@ _TOKEN_RE = re.compile(r"""
     | (?P<blob>0[xX][0-9a-fA-F]+)
     | (?P<number>-?\d+\.\d+|-?\d+)
     | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<param>\$\d+)
     | (?P<op><=|>=|!=|[=<>(),;*?.])
     )""", re.VERBOSE)
 

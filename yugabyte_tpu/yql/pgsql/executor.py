@@ -134,6 +134,92 @@ class PgSession:
                 pass
             self._txn = None
 
+    def execute_bound(self, stmt: P.Statement,
+                      params: List[object]) -> PgResult:
+        """Extended-query-protocol execution: one pre-parsed statement with
+        $n placeholders bound to `params` (ref: the reference's PG backend
+        exec_bind_message/exec_execute_message path)."""
+        bound = P.bind_params(stmt, params)
+        if self.txn_failed and not (
+                isinstance(bound, P.TxnControl)
+                and bound.kind in ("commit", "rollback")):
+            raise PgError(Status.IllegalState(
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block"), "25P02")
+        try:
+            return self._execute_stmt(bound)
+        except PgError:
+            self._fail_txn()
+            raise
+        except TransactionError as e:
+            self._fail_txn()
+            raise PgError(e.status, "40001") from e
+        except StatusError as e:
+            self._fail_txn()
+            raise _pg_error(e) from e
+
+    def param_types(self, stmt: P.Statement) -> List[Optional[DataType]]:
+        """DataType per $n placeholder (1-based, None where unknown):
+        the analysis that types bind variables against the schema."""
+        pairs = P.collect_param_columns(stmt)
+        if not pairs:
+            return []
+        n = max(i for i, _c in pairs)
+        out: List[Optional[DataType]] = [None] * n
+        table_name = getattr(stmt, "table", None)
+        schema = None
+        if table_name:
+            try:
+                schema = self._table(table_name).schema
+            except StatusError:
+                schema = None
+        for idx, col in pairs:
+            if col == "__limit__":
+                out[idx - 1] = DataType.INT64
+            elif schema is None:
+                continue
+            elif isinstance(col, tuple) and col[0] == "pos":
+                # INSERT without a column list: the placeholder's position
+                # WITHIN ITS ROW picks the target column
+                if col[1] < len(schema.columns):
+                    out[idx - 1] = schema.columns[col[1]].type
+            elif isinstance(col, str):
+                try:
+                    out[idx - 1] = schema.column(col).type
+                except KeyError:
+                    pass
+        return out
+
+    def describe_columns(self, stmt: P.Statement
+                         ) -> Optional[List[Tuple[str, int]]]:
+        """RowDescription for a statement BEFORE execution (the extended
+        protocol's Describe), or None for row-less statements."""
+        if not isinstance(stmt, (P.Select, P.Show)):
+            return None
+        if isinstance(stmt, P.Show):
+            return [(stmt.name, 25)]
+        vt = self._virtual_table_rows(stmt.table)
+        if vt is not None:
+            cols, _rows = vt
+            by_name = dict(cols)
+            if stmt.count_star:
+                return [("count", 20)]
+            if stmt.aggregates or stmt.group_by:
+                desc, _ = self._aggregate(stmt,
+                                          lambda c: by_name.get(c, 25), [])
+                return desc
+            out_cols = stmt.columns or [c for c, _o in cols]
+            return [(c, by_name.get(c, 25)) for c in out_cols]
+        if stmt.count_star:
+            return [("count", 20)]
+        schema = self._table(stmt.table).schema
+        if stmt.aggregates or stmt.group_by:
+            desc, _ = self._aggregate(
+                stmt, lambda c: PG_OIDS[schema.column(c).type], [])
+            return desc
+        out_cols = stmt.columns or [c.name for c in schema.columns]
+        return [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
+
     # ----------------------------------------------------------- dispatch
     def _execute_stmt(self, stmt: P.Statement) -> PgResult:
         if isinstance(stmt, P.CreateDatabase):
@@ -280,6 +366,116 @@ class PgSession:
             self._write(table, group)
         return PgResult(f"INSERT 0 {len(ops)}")
 
+    # ------------------------------------------------- system virtual tables
+    def _virtual_table_rows(self, name: str):
+        """pg_catalog / information_schema vtables computed from the master
+        catalog (ref: src/yb/master/yql_*_vtable.* building system tables
+        from catalog state). Returns (columns [(name, oid)], row dicts) or
+        None for regular tables. Names accept an optional schema prefix
+        (the parser collapses pg_catalog.pg_tables to its last component).
+        """
+        key = name.lower()
+        if key.startswith("pg_catalog."):
+            key = key[len("pg_catalog."):]
+        if key == "information_schema.tables":
+            key = "tables"
+        elif key == "information_schema.columns":
+            key = "columns"
+        elif key in ("tables", "columns"):
+            # unqualified: PG search_path does NOT include
+            # information_schema — resolve as a user table
+            return None
+        if key not in ("pg_tables", "tables", "pg_class", "pg_namespace",
+                       "pg_attribute", "columns", "pg_type", "pg_indexes"):
+            return None
+        tables = self._client.list_tables(self.database)
+        if key == "pg_tables":
+            cols = [("schemaname", 25), ("tablename", 25),
+                    ("tableowner", 25)]
+            rows = [{"schemaname": "public", "tablename": t["name"],
+                     "tableowner": "yugabyte"} for t in tables]
+        elif key == "tables":
+            cols = [("table_catalog", 25), ("table_schema", 25),
+                    ("table_name", 25), ("table_type", 25)]
+            rows = [{"table_catalog": self.database,
+                     "table_schema": "public", "table_name": t["name"],
+                     "table_type": "BASE TABLE"} for t in tables]
+        elif key == "pg_class":
+            cols = [("oid", 20), ("relname", 25), ("relkind", 25),
+                    ("relnamespace", 20)]
+            rows = [{"oid": i + 16384, "relname": t["name"],
+                     "relkind": "r", "relnamespace": 2200}
+                    for i, t in enumerate(tables)]
+        elif key == "pg_namespace":
+            cols = [("oid", 20), ("nspname", 25)]
+            rows = [{"oid": 11, "nspname": "pg_catalog"},
+                    {"oid": 2200, "nspname": "public"}]
+        elif key == "pg_type":
+            cols = [("oid", 20), ("typname", 25)]
+            rows = [{"oid": o, "typname": n}
+                    for o, n in ((16, "bool"), (20, "int8"), (23, "int4"),
+                                 (25, "text"), (701, "float8"),
+                                 (17, "bytea"), (1114, "timestamp"))]
+        elif key == "pg_indexes":
+            cols = [("schemaname", 25), ("tablename", 25),
+                    ("indexname", 25), ("indexdef", 25)]
+            rows = []
+            for t in tables:
+                for w in t.get("indexes", []):
+                    rows.append({
+                        "schemaname": "public", "tablename": t["name"],
+                        "indexname": w["index_name"],
+                        "indexdef": f"CREATE INDEX {w['index_name']} ON "
+                                    f"{t['name']} ({w['column']})"})
+        else:  # pg_attribute / information_schema columns
+            from yugabyte_tpu.common.wire import schema_from_wire
+            if key == "pg_attribute":
+                cols = [("attrelid", 20), ("attname", 25),
+                        ("atttypid", 20), ("attnum", 20)]
+            else:
+                cols = [("table_name", 25), ("column_name", 25),
+                        ("data_type", 25), ("ordinal_position", 20)]
+            rows = []
+            for i, t in enumerate(tables):
+                schema = schema_from_wire(t["schema"])
+                for j, c in enumerate(schema.columns):
+                    if key == "pg_attribute":
+                        rows.append({"attrelid": i + 16384,
+                                     "attname": c.name,
+                                     "atttypid": PG_OIDS[c.type],
+                                     "attnum": j + 1})
+                    else:
+                        rows.append({"table_name": t["name"],
+                                     "column_name": c.name,
+                                     "data_type": c.type.value,
+                                     "ordinal_position": j + 1})
+        return cols, rows
+
+    def _select_virtual(self, stmt: P.Select, cols, rows) -> PgResult:
+        by_name = dict(cols)
+        known = set(by_name)
+        out_cols = stmt.columns or [c for c, _o in cols]
+        for c in out_cols + [f[0] for f in stmt.where]:
+            if c not in known:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
+        dicts = [d for d in rows
+                 if row_matches(d, [list(f) for f in stmt.where])]
+        if stmt.count_star:
+            return PgResult("SELECT 1", [("count", 20)], [[len(dicts)]])
+        if stmt.aggregates or stmt.group_by:
+            col_desc, rows_out = self._aggregate(
+                stmt, lambda c: by_name.get(c, 25), dicts)
+            if stmt.limit is not None:
+                rows_out = rows_out[: stmt.limit]
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+        dicts = self._order_rows(dicts, stmt.order_by)
+        rows_out = [[d.get(c) for c in out_cols] for d in dicts]
+        if stmt.limit is not None:
+            rows_out = rows_out[: stmt.limit]
+        return PgResult(f"SELECT {len(rows_out)}",
+                        [(c, by_name[c]) for c in out_cols], rows_out)
+
     # ------------------------------------------------------------- SELECT
     def _split_where(self, table: YBTable,
                      where: List[Tuple[str, str, object]]):
@@ -310,54 +506,150 @@ class PgSession:
             return dk, residual
         return None, list(where)
 
-    def _select(self, stmt: P.Select) -> PgResult:
-        table = self._table(stmt.table)
+    def _select_row_dicts(self, stmt: P.Select, table) -> List[dict]:
+        """Materialize the matching rows as dicts (all columns): the
+        shared retrieval half of SELECT — point read / index lookup /
+        pushed-down scan — before projection/aggregation/ordering."""
         schema = table.schema
-        known = {c.name for c in schema.columns}
-        out_cols = stmt.columns or [c.name for c in schema.columns]
-        for c in out_cols + [f[0] for f in stmt.where]:
-            if c not in known:
-                raise PgError(Status.InvalidArgument(
-                    f'column "{c}" does not exist'), "42703")
-        col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
         dk, filters = self._split_where(table, stmt.where)
-        rows_out: List[List[object]] = []
+        out: List[dict] = []
+        # ORDER BY / GROUP BY / aggregates need the full match set; only a
+        # bare SELECT can stop at LIMIT rows early
+        early_limit = (stmt.limit if not stmt.order_by and not stmt.group_by
+                       and not stmt.aggregates and not stmt.count_star
+                       else None)
         if dk is not None:
             if self._txn is not None:
                 row = self._txn.read_row(table, dk)
             else:
                 row = self._client.read_row(table, dk)
-            it = [] if row is None else [row]
-            for row in it:
+            if row is not None:
                 d = row.to_dict(schema)
                 if row_matches(d, filters):
-                    rows_out.append([d.get(c) for c in out_cols])
+                    out.append(d)
+            return out
+        # Index-accelerated path: a readable secondary index on an
+        # equality predicate replaces the full scan. Skipped inside a
+        # transaction block: index_lookup's reads would escape the txn
+        # snapshot/overlay (the scan path pins both).
+        residual: List = []
+        picked = (IM.choose_index(table, [tuple(f) for f in filters])
+                  if self._txn is None else None)
+        if picked is not None:
+            idx, value, residual = picked
+            idx_table = self._table(idx.index_name)
+            rows = IM.index_lookup(self._client, table, idx_table,
+                                   idx, value)
         else:
-            # Index-accelerated path: a readable secondary index on an
-            # equality predicate replaces the full scan. Skipped inside a
-            # transaction block: index_lookup's reads would escape the txn
-            # snapshot/overlay (the scan path pins both).
-            residual: List = []
-            picked = (IM.choose_index(table, [tuple(f) for f in filters])
-                      if self._txn is None else None)
-            if picked is not None:
-                idx, value, residual = picked
-                idx_table = self._table(idx.index_name)
-                rows = IM.index_lookup(self._client, table, idx_table,
-                                       idx, value)
-            else:
-                rows = self._scan(table, filters)
-            count = 0
-            for row in rows:
-                d = row.to_dict(schema)
-                if residual and not row_matches(d, residual):
-                    continue
-                rows_out.append([d.get(c) for c in out_cols])
-                count += 1
-                if stmt.limit is not None and count >= stmt.limit:
-                    break
+            rows = self._scan(table, filters)
+        for row in rows:
+            d = row.to_dict(schema)
+            if residual and not row_matches(d, residual):
+                continue
+            out.append(d)
+            if early_limit is not None and len(out) >= early_limit:
+                break
+        return out
+
+    _AGG_OUT_NAMES = {"COUNT": "count", "SUM": "sum", "AVG": "avg",
+                      "MIN": "min", "MAX": "max"}
+
+    def _aggregate(self, stmt: P.Select, col_oid, dicts: List[dict]
+                   ) -> Tuple[List[Tuple[str, int]], List[List[object]]]:
+        """GROUP BY + aggregate evaluation (in-memory over the pushed-down
+        match set; the reference pushes these into DocDB for YCQL and
+        evaluates in PG for YSQL — ref pgsql aggregate paths).
+        col_oid: column name -> PG type oid (table schema or vtable)."""
+        def agg_oid(func: str, col: Optional[str]) -> int:
+            if func == "COUNT":
+                return 20
+            if func == "AVG":
+                return 701
+            base = col_oid(col)
+            return 701 if (func == "SUM" and base == 701) else \
+                (20 if func == "SUM" else base)
+
+        group_col = stmt.group_by
+        groups: Dict[object, List[dict]] = {}
+        for d in dicts:
+            groups.setdefault(d.get(group_col) if group_col else None,
+                              []).append(d)
+        if not dicts and group_col is None:
+            groups[None] = []
+        col_desc: List[Tuple[str, int]] = []
+        if group_col is not None:
+            col_desc.append((group_col, col_oid(group_col)))
+        for func, col in stmt.aggregates:
+            col_desc.append((self._AGG_OUT_NAMES[func], agg_oid(func, col)))
+        rows_out = []
+        for key in sorted(groups, key=lambda k: (k is None, k)):
+            members = groups[key]
+            row: List[object] = [key] if group_col is not None else []
+            for func, col in stmt.aggregates:
+                vals = ([1 for _ in members] if col is None
+                        else [m[col] for m in members
+                              if m.get(col) is not None])
+                if func == "COUNT":
+                    row.append(len(vals))
+                elif not vals:
+                    row.append(None)
+                elif func == "SUM":
+                    row.append(sum(vals))
+                elif func == "AVG":
+                    row.append(sum(vals) / len(vals))
+                elif func == "MIN":
+                    row.append(min(vals))
+                elif func == "MAX":
+                    row.append(max(vals))
+            rows_out.append(row)
+        return col_desc, rows_out
+
+    @staticmethod
+    def _order_rows(dicts: List[dict],
+                    order_by: List[Tuple[str, bool]]) -> List[dict]:
+        """Stable multi-key sort (last key first). PG default null
+        placement falls out of one key shape: is_none sorts nulls last
+        ASC and — under reverse — first DESC."""
+        out = list(dicts)
+        for col, desc in reversed(order_by):
+            out.sort(key=lambda d: (d.get(col) is None,
+                                    0 if d.get(col) is None else d.get(col)),
+                     reverse=desc)
+        return out
+
+    def _select(self, stmt: P.Select) -> PgResult:
+        vt = self._virtual_table_rows(stmt.table)
+        if vt is not None:
+            return self._select_virtual(stmt, *vt)
+        table = self._table(stmt.table)
+        schema = table.schema
+        known = {c.name for c in schema.columns}
+        check_cols = list(stmt.columns or []) + [f[0] for f in stmt.where] \
+            + [c for c, _d in stmt.order_by] \
+            + ([stmt.group_by] if stmt.group_by else []) \
+            + [c for _f, c in stmt.aggregates if c is not None]
+        for c in check_cols:
+            if c not in known:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
+        dicts = self._select_row_dicts(stmt, table)
         if stmt.count_star:
-            return PgResult("SELECT 1", [("count", 20)], [[len(rows_out)]])
+            return PgResult("SELECT 1", [("count", 20)], [[len(dicts)]])
+        if stmt.aggregates or stmt.group_by:
+            if stmt.columns and (len(stmt.columns) != 1
+                                 or stmt.columns[0] != stmt.group_by):
+                raise PgError(Status.InvalidArgument(
+                    "non-aggregated columns must appear in GROUP BY"),
+                    "42803")
+            col_desc, rows_out = self._aggregate(
+                stmt, lambda c: PG_OIDS[schema.column(c).type], dicts)
+            if stmt.limit is not None:
+                rows_out = rows_out[: stmt.limit]
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+        dicts = self._order_rows(dicts, stmt.order_by)
+        out_cols = stmt.columns or [c.name for c in schema.columns]
+        col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
+        rows_out = [[d.get(c) for c in out_cols] for d in dicts]
         if stmt.limit is not None:
             rows_out = rows_out[: stmt.limit]
         return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)

@@ -70,6 +70,24 @@ class Insert:
     rows: List[List[object]]
 
 
+class Param:
+    """A $n bind placeholder (PG extended query protocol, 1-based)."""
+
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+    def __repr__(self):
+        return f"${self.idx}"
+
+    def __eq__(self, other):
+        return isinstance(other, Param) and other.idx == self.idx
+
+    def __hash__(self):
+        return hash(("$param", self.idx))
+
+
 @dataclass
 class Select:
     table: str
@@ -77,6 +95,11 @@ class Select:
     where: List[Tuple[str, str, object]] = field(default_factory=list)
     limit: Optional[int] = None
     count_star: bool = False
+    # aggregate select list: (func, column or None for COUNT(*)); when
+    # non-empty the output is one row per group (group_by) or one row
+    aggregates: List[Tuple[str, Optional[str]]] = field(default_factory=list)
+    group_by: Optional[str] = None
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)  # (col, desc)
 
 
 @dataclass
@@ -107,6 +130,13 @@ Statement = Union[CreateDatabase, DropDatabase, CreateTable, DropTable,
 
 
 class PgParser(_BaseParser):
+    def literal(self):
+        tok = self.peek()
+        if tok is not None and tok[0] == "param":
+            self.next()
+            return Param(int(tok[1][1:]))
+        return super().literal()
+
     def parse_one(self) -> Optional[Statement]:
         if self.peek() is None:
             return None
@@ -172,8 +202,13 @@ class PgParser(_BaseParser):
         return tok is not None and tok == ("op", ";")
 
     def _table_name(self) -> str:
-        # accept (and ignore) a schema qualifier: public.t -> t
-        _, name = self.qualified_name()
+        # accept a schema qualifier; 'public' is dropped (the default
+        # search_path), catalog schemas stay qualified so their vtables
+        # can never shadow a user table named e.g. 'tables'
+        schema, name = self.qualified_name()
+        if schema and schema.lower() in ("pg_catalog",
+                                         "information_schema"):
+            return f"{schema.lower()}.{name}"
         return name
 
     def _type_name(self) -> str:
@@ -242,27 +277,75 @@ class PgParser(_BaseParser):
                 break
         return Insert(name, columns, rows)
 
+    _AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
+
+    def _select_item(self):
+        """-> ("col", name) | ("agg", func, col_or_None)"""
+        tok = self.peek()
+        if tok is not None and tok[0] == "name" \
+                and tok[1].upper() in self._AGG_FUNCS:
+            nxt = self.toks[self.pos + 1] if self.pos + 1 < len(
+                self.toks) else None
+            if nxt == ("op", "("):
+                func = self.name().upper()
+                self.expect_op("(")
+                if self.accept_op("*"):
+                    if func != "COUNT":
+                        raise ParseError(f"{func}(*) is not valid")
+                    col = None
+                else:
+                    col = self.name()
+                self.expect_op(")")
+                return ("agg", func, col)
+        return ("col", self.name())
+
     def _select(self) -> Select:
         columns: Optional[List[str]] = None
         count_star = False
+        aggregates: List[Tuple[str, Optional[str]]] = []
         if self.accept_op("*"):
             pass
-        elif self.accept_kw("COUNT"):
-            self.expect_op("(")
-            self.expect_op("*")
-            self.expect_op(")")
-            count_star = True
         else:
-            columns = [self.name()]
+            items = [self._select_item()]
             while self.accept_op(","):
-                columns.append(self.name())
+                items.append(self._select_item())
+            aggs = [i for i in items if i[0] == "agg"]
+            cols = [i[1] for i in items if i[0] == "col"]
+            if aggs:
+                aggregates = [(f, c) for _k, f, c in aggs]
+                columns = cols or None   # group-by columns, if any
+            else:
+                columns = cols
         self.expect_kw("FROM")
         name = self._table_name()
         where = self._pg_where()
+        group_by = None
+        if self.accept_kw("GROUP", "BY"):
+            group_by = self.name()
+        order_by: List[Tuple[str, bool]] = []
+        if self.accept_kw("ORDER", "BY"):
+            while True:
+                col = self.name()
+                desc = bool(self.accept_kw("DESC"))
+                if not desc:
+                    self.accept_kw("ASC")
+                order_by.append((col, desc))
+                if not self.accept_op(","):
+                    break
         limit = None
         if self.accept_kw("LIMIT"):
-            limit = int(self.literal())
-        return Select(name, columns, where, limit, count_star)
+            limit = self.literal()   # int literal or $n placeholder
+            if not isinstance(limit, Param):
+                limit = int(limit)
+        # a lone COUNT(*) with no grouping is the classic count-star fast
+        # path; COUNT(*) under GROUP BY must stay an aggregate per group
+        if (aggregates == [("COUNT", None)] and columns is None
+                and group_by is None):
+            count_star = True
+            aggregates = []
+        return Select(name, columns, where, limit, count_star,
+                      aggregates=aggregates, group_by=group_by,
+                      order_by=order_by)
 
     def _pg_where(self) -> List[Tuple[str, str, object]]:
         if not self.accept_kw("WHERE"):
@@ -297,6 +380,71 @@ class PgParser(_BaseParser):
 
     def _delete(self) -> Delete:
         return Delete(self._table_name(), self._pg_where())
+
+
+def bind_params(stmt: Statement, params: List[object]) -> Statement:
+    """Substitute $n placeholders with values (1-based), returning a new
+    statement — the Bind step of the extended query protocol."""
+    from dataclasses import replace
+
+    def sub(v):
+        if isinstance(v, Param):
+            if not 1 <= v.idx <= len(params):
+                raise ParseError(f"no parameter ${v.idx}")
+            return params[v.idx - 1]
+        return v
+
+    if isinstance(stmt, Insert):
+        return replace(stmt, rows=[[sub(v) for v in row]
+                                   for row in stmt.rows])
+    if isinstance(stmt, Select):
+        limit = sub(stmt.limit)
+        if limit is not None:
+            limit = int(limit)
+        return replace(stmt, where=[(c, op, sub(v))
+                                    for c, op, v in stmt.where],
+                       limit=limit)
+    if isinstance(stmt, Update):
+        return replace(stmt,
+                       assignments=[(c, sub(v))
+                                    for c, v in stmt.assignments],
+                       where=[(c, op, sub(v)) for c, op, v in stmt.where])
+    if isinstance(stmt, Delete):
+        return replace(stmt, where=[(c, op, sub(v))
+                                    for c, op, v in stmt.where])
+    return stmt
+
+
+def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
+    """(param index, column ref) for every $n placeholder — the schema
+    lookup that types bind parameters (like the reference's parse
+    analysis typing bind variables). The column ref is a name, a
+    ("pos", i) positional target (INSERT without a column list), or
+    "__limit__"."""
+    out: List[Tuple[int, object]] = []
+
+    def visit(col, v):
+        if isinstance(v, Param):
+            out.append((v.idx, col))
+
+    if isinstance(stmt, Insert):
+        cols = stmt.columns
+        for row in stmt.rows:
+            for j, v in enumerate(row):
+                visit(cols[j] if cols and j < len(cols) else ("pos", j), v)
+    elif isinstance(stmt, Select):
+        for c, _op, v in stmt.where:
+            visit(c, v)
+        visit("__limit__", stmt.limit)
+    elif isinstance(stmt, Update):
+        for c, v in stmt.assignments:
+            visit(c, v)
+        for c, _op, v in stmt.where:
+            visit(c, v)
+    elif isinstance(stmt, Delete):
+        for c, _op, v in stmt.where:
+            visit(c, v)
+    return out
 
 
 def parse_script(text: str) -> List[Statement]:

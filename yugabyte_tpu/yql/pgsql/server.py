@@ -21,7 +21,8 @@ from typing import List, Optional, Tuple
 
 from yugabyte_tpu.client.client import YBClient
 from yugabyte_tpu.client.transaction import TransactionManager
-from yugabyte_tpu.utils.status import StatusError
+from yugabyte_tpu.common.schema import DataType
+from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.yql.pgsql.executor import PgError, PgResult, PgSession
 
@@ -33,6 +34,69 @@ GSS_REQUEST_CODE = 80877104
 
 def _cstr(s: str) -> bytes:
     return s.encode("utf-8") + b"\x00"
+
+
+def _read_cstr(buf: bytes, off: int):
+    end = buf.index(b"\x00", off)
+    return buf[off:end].decode("utf-8"), end + 1
+
+
+# bind-parameter typing: PG type oid <-> framework DataType
+PG_OID_TYPES = {16: DataType.BOOL, 20: DataType.INT64, 21: DataType.INT32,
+                23: DataType.INT32, 25: DataType.STRING,
+                1043: DataType.STRING, 700: DataType.FLOAT,
+                701: DataType.DOUBLE, 17: DataType.BINARY,
+                1114: DataType.TIMESTAMP, 1184: DataType.TIMESTAMP}
+
+
+def _type_oid(dt: Optional[DataType]) -> int:
+    # one authority for type->oid: the executor's RowDescription map, so
+    # ParameterDescription and RowDescription always agree
+    from yugabyte_tpu.yql.pgsql.executor import PG_OIDS
+    return PG_OIDS.get(dt, 25)
+
+
+def _decode_param(raw: Optional[bytes], fmt: int,
+                  dt: Optional[DataType]) -> object:
+    """Bind-parameter decode: text (fmt 0) or binary (fmt 1), converted
+    per the statement's inferred marker type (exec_bind_message)."""
+    if raw is None:
+        return None
+    if fmt == 1:  # binary format
+        if dt in (DataType.INT32,):
+            return struct.unpack(">i", raw)[0] if len(raw) == 4 else \
+                struct.unpack(">q", raw)[0]
+        if dt in (DataType.INT64, DataType.TIMESTAMP):
+            return struct.unpack(">q", raw)[0] if len(raw) == 8 else \
+                struct.unpack(">i", raw)[0]
+        if dt == DataType.BOOL:
+            return raw != b"\x00"
+        if dt == DataType.DOUBLE:
+            return struct.unpack(">d", raw)[0]
+        if dt == DataType.FLOAT:
+            return struct.unpack(">f", raw)[0]
+        if dt == DataType.BINARY:
+            return raw
+        return raw.decode("utf-8")
+    text = raw.decode("utf-8")
+    if dt in (DataType.INT32, DataType.INT64):
+        return int(text)
+    if dt == DataType.TIMESTAMP:
+        # drivers send timestamps as text ('2026-07-30 12:00:00') OR as
+        # epoch integers; store whichever arrived
+        try:
+            return int(text)
+        except ValueError:
+            return text
+    if dt in (DataType.DOUBLE, DataType.FLOAT):
+        return float(text)
+    if dt == DataType.BOOL:
+        return text in ("t", "true", "TRUE", "1", "on")
+    if dt == DataType.BINARY:
+        if text.startswith("\\x"):
+            return bytes.fromhex(text[2:])
+        return text.encode()
+    return text
 
 
 def _encode_text(v: object) -> Optional[bytes]:
@@ -53,6 +117,11 @@ class _Conn:
         self.sock = sock
         self.server = server
         self.session: Optional[PgSession] = None
+        # extended query protocol state (ref: PG backend's prepared
+        # statements + portals; exec_parse_message/exec_bind_message)
+        self._prepared: dict = {}   # name -> (stmt, param DataTypes)
+        self._portals: dict = {}    # name -> (stmt, bound params)
+        self._ext_error = False     # error sent; discard until Sync
 
     # ------------------------------------------------------------- framing
     def _recv_exact(self, n: int) -> bytes:
@@ -126,6 +195,17 @@ class _Conn:
                   + b"\x00")
         self._send(b"E", fields)
 
+    def _send_data_rows(self, r: PgResult) -> None:
+        for row in r.rows:
+            body = struct.pack(">H", len(row))
+            for v in row:
+                enc = _encode_text(v)
+                if enc is None:
+                    body += struct.pack(">i", -1)
+                else:
+                    body += struct.pack(">I", len(enc)) + enc
+            self._send(b"D", body)
+
     def _send_result(self, r: PgResult) -> None:
         if r.columns is not None:
             desc = struct.pack(">H", len(r.columns))
@@ -133,15 +213,7 @@ class _Conn:
                 desc += (_cstr(name) + struct.pack(">IHIhih", 0, 0, oid,
                                                    -1, -1, 0))
             self._send(b"T", desc)
-            for row in r.rows:
-                body = struct.pack(">H", len(row))
-                for v in row:
-                    enc = _encode_text(v)
-                    if enc is None:
-                        body += struct.pack(">i", -1)
-                    else:
-                        body += struct.pack(">I", len(enc)) + enc
-                self._send(b"D", body)
+            self._send_data_rows(r)
         self._send(b"C", _cstr(r.tag))
 
     # ---------------------------------------------------------------- loop
@@ -156,21 +228,29 @@ class _Conn:
                 if t == b"X":
                     return
                 if t == b"Q":
-                    self._ext_error_sent = False
+                    self._ext_error = False
                     self._simple_query(payload[:-1].decode("utf-8"))
-                elif t in (b"P", b"B", b"D", b"E", b"C", b"F"):
-                    # extended protocol: error ONCE, then discard every
-                    # message until the client's Sync (per-protocol error
-                    # recovery), so the driver's accounting stays in step
-                    if not getattr(self, "_ext_error_sent", False):
-                        self._send_error(
-                            "0A000", "extended query protocol not "
-                            "supported; use simple query mode")
-                        self._ext_error_sent = True
+                elif t in (b"P", b"B", b"D", b"E", b"C"):
+                    # extended query protocol; after an error, discard
+                    # until the client's Sync (per-protocol recovery)
+                    if self._ext_error:
+                        continue
+                    try:
+                        self._extended(t, payload)
+                    except PgError as e:
+                        self._send_error(e.sqlstate, e.status.message)
+                        self._ext_error = True
+                    except StatusError as e:
+                        self._send_error("XX000", e.status.message)
+                        self._ext_error = True
+                    except (ValueError, KeyError, TypeError,
+                            struct.error) as e:
+                        self._send_error("08P01", str(e))
+                        self._ext_error = True
                 elif t == b"S":  # Sync: ends an extended-protocol cycle
-                    self._ext_error_sent = False
+                    self._ext_error = False
                     self._send_ready()
-                elif t == b"H":  # Flush
+                elif t == b"H":  # Flush: responses are unbuffered already
                     pass
                 else:
                     self._send_error("08P01",
@@ -185,6 +265,108 @@ class _Conn:
                 self.sock.close()
             except OSError:
                 pass
+
+    # ------------------------------------------- extended query protocol
+    _OID_TO_TYPE = {16: "bool", 20: "int", 21: "int", 23: "int",
+                    25: "text", 1043: "text", 700: "float", 701: "float",
+                    17: "bytea"}
+
+    def _extended(self, t: bytes, payload: bytes) -> None:
+        from yugabyte_tpu.yql.pgsql import parser as P
+        if t == b"P":     # Parse
+            name, off = _read_cstr(payload, 0)
+            sql, off = _read_cstr(payload, off)
+            (n_oids,) = struct.unpack_from(">H", payload, off)
+            off += 2
+            oids = list(struct.unpack_from(f">{n_oids}i", payload, off)) \
+                if n_oids else []
+            stmts = P.parse_script(sql)
+            if len(stmts) > 1:
+                raise PgError(Status.InvalidArgument(
+                    "cannot insert multiple commands into a prepared "
+                    "statement"), "42601")
+            stmt = stmts[0] if stmts else None
+            types = (self.session.param_types(stmt)
+                     if stmt is not None else [])
+            # explicit Parse oids override inferred types
+            for i, oid in enumerate(oids):
+                if oid and i < len(types):
+                    types[i] = None if oid not in PG_OID_TYPES \
+                        else PG_OID_TYPES[oid]
+            self._prepared[name] = (stmt, types)
+            self._send(b"1")  # ParseComplete
+        elif t == b"B":   # Bind
+            portal, off = _read_cstr(payload, 0)
+            sname, off = _read_cstr(payload, off)
+            if sname not in self._prepared:
+                raise PgError(Status.InvalidArgument(
+                    f'prepared statement "{sname}" does not exist'),
+                    "26000")
+            stmt, types = self._prepared[sname]
+            (n_fmt,) = struct.unpack_from(">H", payload, off)
+            off += 2
+            fmts = list(struct.unpack_from(f">{n_fmt}H", payload, off))
+            off += 2 * n_fmt
+            (n_params,) = struct.unpack_from(">H", payload, off)
+            off += 2
+            params = []
+            for i in range(n_params):
+                (ln,) = struct.unpack_from(">i", payload, off)
+                off += 4
+                raw: Optional[bytes] = None
+                if ln >= 0:
+                    raw = payload[off: off + ln]
+                    off += ln
+                fmt = (fmts[i] if i < len(fmts)
+                       else (fmts[0] if len(fmts) == 1 else 0))
+                dt = types[i] if i < len(types) else None
+                params.append(_decode_param(raw, fmt, dt))
+            # result format codes are read but text is always sent
+            self._portals[portal] = (stmt, params)
+            self._send(b"2")  # BindComplete
+        elif t == b"D":   # Describe
+            kind = payload[:1]
+            name, _ = _read_cstr(payload, 1)
+            if kind == b"S":
+                stmt, types = self._prepared.get(name, (None, []))
+                self._send(b"t", struct.pack(">H", len(types)) + b"".join(
+                    struct.pack(">I", _type_oid(dt)) for dt in types))
+                self._describe_stmt(stmt)
+            else:
+                stmt, _params = self._portals.get(name, (None, None))
+                self._describe_stmt(stmt)
+        elif t == b"E":   # Execute
+            portal, off = _read_cstr(payload, 0)
+            if portal not in self._portals:
+                raise PgError(Status.InvalidArgument(
+                    f'portal "{portal}" does not exist'), "34000")
+            stmt, params = self._portals[portal]
+            if stmt is None:
+                self._send(b"I")
+                return
+            result = self.session.execute_bound(stmt, params)
+            # rows WITHOUT RowDescription (Describe supplied it)
+            if result.columns is not None:
+                self._send_data_rows(result)
+            self._send(b"C", _cstr(result.tag))
+        elif t == b"C":   # Close
+            kind = payload[:1]
+            name, _ = _read_cstr(payload, 1)
+            (self._prepared if kind == b"S" else self._portals).pop(
+                name, None)
+            self._send(b"3")  # CloseComplete
+
+    def _describe_stmt(self, stmt) -> None:
+        cols = (self.session.describe_columns(stmt)
+                if stmt is not None else None)
+        if cols is None:
+            self._send(b"n")  # NoData
+            return
+        desc = struct.pack(">H", len(cols))
+        for name, oid in cols:
+            desc += _cstr(name) + struct.pack(">IHIhih", 0, 0, oid, -1,
+                                              -1, 0)
+        self._send(b"T", desc)
 
     def _simple_query(self, sql: str) -> None:
         if not sql.strip():
