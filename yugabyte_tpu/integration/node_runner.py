@@ -1,0 +1,76 @@
+"""Subprocess entry for the ExternalMiniCluster: run one real master or
+tserver process until killed.
+
+The crash-fault harness (integration/external_mini_cluster.py) spawns
+these with `python -m yugabyte_tpu.integration.node_runner ...`, then
+kill -9s them mid-operation (ref: the reference's ExternalMiniCluster
+running real yb-master/yb-tserver binaries,
+src/yb/integration-tests/external_mini_cluster.h).
+
+Crash points are armed via YBTPU_CRASH_POINT (utils/sync_point.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    # CPU-pinned JAX: the crash harness tests durability, not kernels
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("role", choices=["master", "tserver"])
+    ap.add_argument("--fs-root", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--server-id", default=None)
+    ap.add_argument("--master-addrs", default="")
+    ap.add_argument("--rf", type=int, default=3)
+    ap.add_argument("--flag", action="append", default=[],
+                    help="runtime flag override, name=value (repeatable)")
+    ap.add_argument("--crash-point", default=None,
+                    help="arm a sync-point crash AFTER startup completes "
+                    "(bootstrap-time hits don't count)")
+    args = ap.parse_args(argv)
+
+    from yugabyte_tpu.utils import flags
+    flags.set_flag("replication_factor", args.rf)
+    # force flag registration before overriding (db/server modules define
+    # their flags at import)
+    import yugabyte_tpu.storage.db  # noqa: F401
+    import yugabyte_tpu.tserver.server_context  # noqa: F401
+    for kv in args.flag:
+        name, _, value = kv.partition("=")
+        cur = flags.get_flag(name)
+        flags.set_flag(name, type(cur)(value) if cur is not None
+                       else value)
+
+    if args.role == "master":
+        from yugabyte_tpu.master.master import Master, MasterOptions
+        node = Master(MasterOptions(
+            master_id=args.server_id or "m0", fs_root=args.fs_root,
+            port=args.port, webserver_port=None)).start()
+    else:
+        from yugabyte_tpu.tserver.tablet_server import (
+            TabletServer, TabletServerOptions)
+        node = TabletServer(TabletServerOptions(
+            server_id=args.server_id, fs_root=args.fs_root,
+            port=args.port, webserver_port=None,
+            master_addrs=args.master_addrs.split(","))).start()
+
+    if args.crash_point:
+        from yugabyte_tpu.utils import sync_point
+        sync_point.arm_crash(args.crash_point)
+    print(f"READY {node.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -221,6 +221,8 @@ class DB:
                                 ht_max=int(ht.max()) if slab.n else 0,
                                 history_cutoff=0)
             props = SSTWriter(path, block_entries=self.opts.block_entries).write(slab, frontier)
+            from yugabyte_tpu.utils import sync_point
+            sync_point.hit("db.flush:before_manifest")
             if self._device_cache is not None:
                 self._device_cache.stage(fid, slab)  # write-through to HBM
             with self._lock:
@@ -270,6 +272,8 @@ class DB:
                 block_entries=self.opts.block_entries,
                 device_cache=self._device_cache,
                 input_ids=[fm.file_id for fm in pick.inputs])
+            from yugabyte_tpu.utils import sync_point
+            sync_point.hit("db.compaction:before_install")
             with self._lock:
                 removed = [fm.file_id for fm in pick.inputs]
                 self.versions.install_compaction(

@@ -177,6 +177,32 @@ class TabletServiceImpl:
                 break
         return {"rows": rows, "resume_key": resume_key, "read_ht": ht.value}
 
+    def checksum_tablet(self, tablet_id: str, read_ht: int) -> dict:
+        """Order-independent digest of the VISIBILITY-RESOLVED rows at
+        read_ht on THIS replica (leader or follower) — the cross-replica
+        consistency probe of the crash-fault harness (ref:
+        integration-tests/cluster_verifier.h checksumming all replicas).
+
+        Resolved rows, not raw entries: replicas at different compaction
+        progress hold different physical version sets for identical
+        logical state, and the normal scan path also pins SSTs against a
+        concurrent compaction's file deletion. Waits until the propagated
+        safe time covers read_ht so lagging followers converge."""
+        import hashlib
+
+        peer = self._tablets.get_tablet(tablet_id)
+        peer.tablet.mvcc.safe_time(min_allowed=HybridTime(read_ht))
+        total = 0
+        digest = 0
+        for row in peer.tablet.scan(HybridTime(read_ht), use_device=False):
+            body = (row.doc_key.encode() + b"\x00"
+                    + repr((sorted(row.columns.items()),
+                            row.write_ht.value)).encode())
+            h = hashlib.blake2b(body, digest_size=8).digest()
+            digest ^= int.from_bytes(h, "little")  # order-independent
+            total += 1
+        return {"checksum": digest, "entries": total}
+
     # --------------------------------------------------------- index backfill
     def backfill_index_tablet(self, tablet_id: str, namespace: str,
                               index_table: str, column: str,

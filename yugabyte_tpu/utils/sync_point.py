@@ -1,0 +1,76 @@
+"""Sync/crash points: deterministic fault injection hooks.
+
+Capability parity with the reference's test hooks (ref:
+src/yb/rocksdb/util/sync_point.h — named points that tests arm with
+callbacks; yb_test_util fault flags). Two arming modes:
+
+- in-process: tests register a callback per point
+  (`arm("db.flush:before_manifest", cb)`);
+- cross-process: a child process armed via the environment
+  (`YBTPU_CRASH_POINT="db.flush:before_manifest"` or `"<point>@<hits>"`)
+  dies with os._exit(137) when it reaches the point for the hits-th time —
+  the kill -9 simulator driving the external-cluster crash tests.
+
+Points are free in production: one dict lookup on an (almost always)
+empty dict, and the env mode only activates when the variable is set.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+_arms: Dict[str, Callable[[], None]] = {}
+_lock = threading.Lock()
+_env_point: Optional[str] = None
+_env_hits = 1
+_env_count = 0
+
+def arm_crash(spec: str) -> None:
+    """Arm the crash-exit point from a "<point>" or "<point>@<hits>" spec.
+    Called by node_runner AFTER server startup, so bootstrap-time hits of
+    the same point don't kill the process before it is even READY."""
+    global _env_point, _env_hits, _env_count
+    with _lock:
+        if "@" in spec:
+            _env_point, h = spec.rsplit("@", 1)
+            _env_hits = int(h)
+        else:
+            _env_point, _env_hits = spec, 1
+        _env_count = 0
+
+
+_spec = os.environ.get("YBTPU_CRASH_POINT")
+if _spec:
+    arm_crash(_spec)
+
+
+def hit(name: str) -> None:
+    """Mark reaching a named point; fires any armed action."""
+    global _env_count
+    if _env_point is not None and name == _env_point:
+        with _lock:
+            _env_count += 1
+            count = _env_count
+        if count >= _env_hits:
+            # crash like kill -9: no atexit, no flushes, no goodbyes
+            os._exit(137)
+    cb = _arms.get(name)
+    if cb is not None:
+        cb()
+
+
+def arm(name: str, cb: Callable[[], None]) -> None:
+    with _lock:
+        _arms[name] = cb
+
+
+def disarm(name: str) -> None:
+    with _lock:
+        _arms.pop(name, None)
+
+
+def clear() -> None:
+    with _lock:
+        _arms.clear()
