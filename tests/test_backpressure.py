@@ -1,0 +1,222 @@
+"""Write backpressure, compaction rate limiting, whole-SST TTL drop
+(round-2 Missing #6/#9; ref tserver/tablet_service.cc:1510,
+rocksdb/util/rate_limiter.cc, docdb/compaction_file_filter.h:60)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.storage.db import DB, DBOptions
+from yugabyte_tpu.storage.sst import Frontier, SSTReader, SSTWriter
+from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.rate_limiter import RateLimiter
+from yugabyte_tpu.utils.status import StatusError
+
+
+def _schema():
+    return Schema([ColumnSchema("k", DataType.STRING),
+                   ColumnSchema("v", DataType.INT64)],
+                  num_hash_key_columns=0, num_range_key_columns=1)
+
+
+def _op(k, v=1, ttl_ms=None):
+    return QLWriteOp(WriteOpKind.INSERT, DocKey(range_components=(k,)),
+                     {"v": v}, ttl_ms=ttl_ms)
+
+
+class _FlagScope:
+    def __init__(self, **kv):
+        self.kv = kv
+        self.old = {}
+
+    def __enter__(self):
+        for k, v in self.kv.items():
+            self.old[k] = flags.get_flag(k)
+            flags.set_flag(k, v)
+
+    def __exit__(self, *a):
+        for k, v in self.old.items():
+            flags.set_flag(k, v)
+
+
+def test_write_backpressure_delays_then_rejects(tmp_path):
+    t = Tablet("bp", str(tmp_path), _schema(),
+               options=TabletOptions(auto_compact=False))
+    with _FlagScope(sst_files_soft_limit=3, sst_files_hard_limit=6,
+                    write_backpressure_max_delay_ms=120):
+        # under the soft limit: no delay
+        t.write([_op("a")])
+        t0 = time.monotonic()
+        t.write([_op("b")])
+        assert time.monotonic() - t0 < 0.1
+        # push files past soft: delays kick in, growing with pressure
+        for i in range(4):
+            t.write([_op(f"f{i}")])
+            t.regular_db.flush()
+        t0 = time.monotonic()
+        t.write([_op("slow")])
+        assert time.monotonic() - t0 >= 0.05  # scored delay
+        # at the hard limit: retryable rejection (files grow with each
+        # flush until the limit trips)
+        rejected = None
+        for i in range(6):
+            try:
+                t.write([_op(f"g{i}")])
+            except StatusError as e:
+                rejected = e
+                break
+            t.regular_db.flush()
+        assert rejected is not None and "retry later" in str(rejected)
+        assert t.metric_write_rejections.value() >= 1
+        # compaction relieves the pressure and writes flow again
+        t.regular_db.compact_all()
+        t.write([_op("ok-again")])
+    t.close()
+
+
+def test_backpressure_keeps_l0_bounded_under_sustained_load(tmp_path):
+    """The systemic property: with auto-compaction on and backpressure
+    gating writes, a sustained write-heavy load cannot pile up unbounded
+    L0 files."""
+    t = Tablet("bp2", str(tmp_path), _schema(),
+               options=TabletOptions(auto_compact=True))
+    max_seen = 0
+    with _FlagScope(sst_files_soft_limit=4, sst_files_hard_limit=10,
+                    write_backpressure_max_delay_ms=30):
+        for i in range(400):
+            while True:
+                try:
+                    t.write([_op(f"k{i:05d}", i)])
+                    break
+                except StatusError:
+                    time.sleep(0.02)  # the client retry loop
+            if i % 10 == 0:
+                t.regular_db.flush()
+            max_seen = max(max_seen, t.regular_db.n_live_files)
+        assert max_seen <= 10, f"L0 unbounded: {max_seen}"
+    t.close()
+
+
+def test_rate_limiter_paces_bytes():
+    rl = RateLimiter(1_000_000)  # 1MB/s
+    t0 = time.monotonic()
+    for _ in range(4):
+        rl.acquire(250_000)
+    dt = time.monotonic() - t0
+    # 1MB through a 1MB/s bucket with 0.5s burst: >= ~0.4s of pacing
+    assert dt >= 0.3, dt
+    assert rl.total_through == 1_000_000
+
+
+def test_compaction_rate_limit_flag(tmp_path):
+    old = flags.get_flag("compaction_rate_bytes_per_sec")
+    flags.set_flag("compaction_rate_bytes_per_sec", 200_000)
+    try:
+        db = DB(str(tmp_path / "db"), DBOptions(auto_compact=False))
+        ht = 1
+        for batch in range(4):
+            items = []
+            for i in range(300):
+                key = DocKey(range_components=(f"r{i:04d}",)).encode()
+                items.append((key, DocHybridTime(HybridTime(ht << 12), 0),
+                              b"v" * 40))
+                ht += 1
+            db.write_batch(items)
+            db.flush()
+        old_split = flags.get_flag("compaction_max_output_entries_per_sst")
+        flags.set_flag("compaction_max_output_entries_per_sst", 300)
+        try:
+            t0 = time.monotonic()
+            db.compact_all()
+            dt = time.monotonic() - t0
+            assert dt >= 0.1, f"compaction unthrottled: {dt}"
+        finally:
+            flags.set_flag("compaction_max_output_entries_per_sst",
+                           old_split)
+        db.close()
+    finally:
+        flags.set_flag("compaction_rate_bytes_per_sec", old)
+
+
+def test_whole_file_ttl_drop(tmp_path):
+    """An input SST whose every entry expired before the cutoff is dropped
+    without being read; files with any non-TTL entry are not."""
+    from yugabyte_tpu.ops.slabs import pack_kvs
+    from yugabyte_tpu.storage import compaction as C
+    from yugabyte_tpu.docdb.value import Value
+
+    def build(path, ttl_all, prefix):
+        ops = []
+        for i in range(50):
+            v = Value(b"x", ttl_ms=1 if ttl_all else None).encode()
+            key = DocKey(range_components=(f"{prefix}{i:03d}",)).encode()
+            ops.append((key, ((i + 1) << 12) << 32, v))
+        slab = pack_kvs(ops)
+        SSTWriter(str(path)).write(slab, Frontier())
+        return SSTReader(str(path))
+
+    # DISJOINT key ranges: droppability requires that the expired file
+    # cannot shadow anything in the other inputs
+    expired = build(tmp_path / "exp.sst", ttl_all=True, prefix="a")
+    live = build(tmp_path / "live.sst", ttl_all=False, prefix="k")
+    assert expired.props.max_expire_us > 0
+    assert live.props.max_expire_us == 0
+    cutoff = (10_000_000_000 << 12)  # far future: everything TTL'd expired
+    kept, dropped = C.filter_expired_inputs(
+        [expired, live], cutoff, is_major=True, retain_deletes=False)
+    assert dropped == [expired] and kept == [live]
+    # not at minor compactions (expired values must survive as history)
+    kept, dropped = C.filter_expired_inputs(
+        [expired, live], cutoff, is_major=False, retain_deletes=False)
+    assert dropped == []
+    # end-to-end: the job runs with the expired file dropped and its
+    # output matches the per-entry filter's (expired rows gone either way)
+    ids = iter(range(1, 100))
+    out = tmp_path / "out"
+    out.mkdir()
+    res = C.run_compaction_job([expired, live], str(out),
+                               lambda: next(ids), cutoff, True,
+                               device=None)
+    assert res.rows_in == 100          # dropped file still counted
+    assert res.rows_out == 50          # only the non-TTL file's rows
+    expired.close()
+    live.close()
+
+
+def test_whole_file_ttl_drop_blocked_by_overlap(tmp_path):
+    """Regression (round-3 review): an expired file whose key range
+    overlaps another input still SHADOWS older versions there — dropping
+    it would resurrect them, so it must take the per-entry path."""
+    from yugabyte_tpu.ops.slabs import pack_kvs
+    from yugabyte_tpu.storage import compaction as C
+    from yugabyte_tpu.docdb.value import Value
+
+    # old non-TTL version of k000 in one file...
+    old = pack_kvs([(DocKey(range_components=("k000",)).encode(),
+                     (1 << 12) << 32, Value(b"old").encode())])
+    SSTWriter(str(tmp_path / "old.sst")).write(old, Frontier())
+    # ...overwritten by an expired-TTL version in an all-TTL file
+    new = pack_kvs([(DocKey(range_components=("k000",)).encode(),
+                     (9 << 12) << 32, Value(b"new", ttl_ms=1).encode())])
+    SSTWriter(str(tmp_path / "new.sst")).write(new, Frontier())
+    r_old = SSTReader(str(tmp_path / "old.sst"))
+    r_new = SSTReader(str(tmp_path / "new.sst"))
+    cutoff = (10_000_000_000 << 12)
+    kept, dropped = C.filter_expired_inputs(
+        [r_new, r_old], cutoff, is_major=True, retain_deletes=False)
+    assert dropped == []   # overlap forces the per-entry path
+    ids = iter(range(1, 10))
+    out = tmp_path / "out2"
+    out.mkdir()
+    res = C.run_compaction_job([r_new, r_old], str(out),
+                               lambda: next(ids), cutoff, True,
+                               device=None)
+    assert res.rows_out == 0   # expired k000 shadows AND kills the old one
+    r_old.close()
+    r_new.close()

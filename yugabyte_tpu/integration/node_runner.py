@@ -44,9 +44,9 @@ def main(argv=None) -> int:
     import yugabyte_tpu.tserver.server_context  # noqa: F401
     for kv in args.flag:
         name, _, value = kv.partition("=")
-        cur = flags.get_flag(name)
-        flags.set_flag(name, type(cur)(value) if cur is not None
-                       else value)
+        # set_flag parses string values itself (bool-aware; bool("False")
+        # would invert the meaning)
+        flags.set_flag(name, value)
 
     if args.role == "master":
         from yugabyte_tpu.master.master import Master, MasterOptions

@@ -34,6 +34,68 @@ flags.define_flag("universal_compaction_size_ratio_pct", 20,
                   "merge run into candidate set while its size <= (1+ratio) * accumulated")
 flags.define_flag("compaction_max_output_entries_per_sst", 2_000_000,
                   "split compaction output files at this row count")
+flags.define_flag("compaction_rate_bytes_per_sec", 0,
+                  "token-bucket cap on compaction output bytes/sec; "
+                  "0 = unlimited (ref rocksdb/util/rate_limiter.cc)")
+
+_rate_limiter = None
+_rate_limiter_rate = 0
+_rate_limiter_lock = __import__("threading").Lock()
+
+
+def compaction_rate_limiter():
+    """Process-wide limiter paced by the flag (rebuilt when it changes);
+    one shared bucket across all compaction threads."""
+    global _rate_limiter, _rate_limiter_rate
+    rate = flags.get_flag("compaction_rate_bytes_per_sec")
+    if rate <= 0:
+        return None
+    with _rate_limiter_lock:
+        if _rate_limiter is None or _rate_limiter_rate != rate:
+            from yugabyte_tpu.utils.rate_limiter import RateLimiter
+            _rate_limiter = RateLimiter(rate)
+            _rate_limiter_rate = rate
+        return _rate_limiter
+
+
+def filter_expired_inputs(inputs: Sequence[SSTReader],
+                          history_cutoff_ht: int, is_major: bool,
+                          retain_deletes: bool):
+    """Whole-file TTL drop (ref: docdb/compaction_file_filter.h:60
+    ExpirationFilter): an input SST whose every entry carries a TTL that
+    expired before the history cutoff contributes nothing to the output —
+    skip reading it entirely. Only at major compactions without
+    retain-deletes, where expired values are eligible to vanish (same
+    gate as the per-entry filter's drop path).
+
+    A fully expired file is droppable only if its KEY RANGE is disjoint
+    from every other input's: an expired entry still shadows older
+    versions of its key in other files, and the per-entry filter drops
+    both — dropping just the file would resurrect the shadowed version
+    (the reference gates file expiration on TTL-uniform tables for the
+    same reason).
+
+    Returns (kept_inputs, dropped_inputs)."""
+    if not is_major or retain_deletes:
+        return list(inputs), []
+    cutoff_phys_us = history_cutoff_ht >> 12
+    inputs = list(inputs)
+    kept, dropped = [], []
+    for i, r in enumerate(inputs):
+        exp = getattr(r.props, "max_expire_us", 0)
+        if not exp or exp > cutoff_phys_us:
+            kept.append(r)
+            continue
+        overlaps = any(
+            o is not r and o.props.n_entries
+            and not (r.props.last_key < o.props.first_key
+                     or o.props.last_key < r.props.first_key)
+            for o in inputs)
+        if overlaps:
+            kept.append(r)   # shadowing possible: take the per-entry path
+        else:
+            dropped.append(r)
+    return kept, dropped
 
 
 @dataclass
@@ -86,12 +148,21 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     written through to) the HBM-resident slab cache — host->device upload is
     skipped for cache hits; values always stream from disk on the host side.
     """
+    all_inputs = list(inputs)
+    inputs, dropped = filter_expired_inputs(
+        inputs, history_cutoff_ht, is_major, retain_deletes)
+    dropped_rows = sum(r.props.n_entries for r in dropped)
+    if not inputs:
+        return CompactionResult([], dropped_rows, 0)
     if device == "native":
         from yugabyte_tpu.storage import native_engine
         if native_engine.available():
-            return _run_native_job(inputs, out_dir, new_file_id,
-                                   history_cutoff_ht, is_major,
-                                   retain_deletes, block_entries)
+            result = _run_native_job(inputs, out_dir, new_file_id,
+                                     history_cutoff_ht, is_major,
+                                     retain_deletes, block_entries,
+                                     frontier_inputs=all_inputs)
+            result.rows_in += dropped_rows
+            return result
     slabs = [r.read_all() for r in inputs]
     keep_idx = [i for i, s in enumerate(slabs) if s.n]
     slabs = [slabs[i] for i in keep_idx]
@@ -151,9 +222,12 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     rows_out = int(surv.shape[0])
 
     # Frontier for outputs: union of input frontiers + this cutoff
-    # (ref: compaction_job.cc:683-692, 929-931).
-    fr = _merge_frontiers([r.props.frontier for r in inputs], history_cutoff_ht)
+    # (ref: compaction_job.cc:683-692, 929-931) — INCLUDING whole-file-
+    # dropped inputs, whose op-id progress must not regress.
+    fr = _merge_frontiers([r.props.frontier for r in all_inputs],
+                          history_cutoff_ht)
 
+    limiter = compaction_rate_limiter()
     outputs: List[Tuple[int, str, SSTProps]] = []
     max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
     tombstone_value = Value.tombstone().encode()
@@ -165,14 +239,19 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         base_path = os.path.join(out_dir, f"{fid:06d}.sst")
         props = SSTWriter(base_path, block_entries=block_entries).write(out_slab, fr)
         outputs.append((fid, base_path, props))
+        if limiter is not None and end < rows_out:
+            # pace between files; no debt-sleep after the last one (it
+            # would only delay install while writing nothing)
+            limiter.acquire(props.data_size + props.base_size)
         if device_cache is not None:
             device_cache.stage(fid, out_slab)  # write-through for the next pick
-    return CompactionResult(outputs, merged.n, rows_out)
+    return CompactionResult(outputs, merged.n + dropped_rows, rows_out)
 
 
 def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
                     history_cutoff_ht: int, is_major: bool,
-                    retain_deletes: bool, block_entries: int
+                    retain_deletes: bool, block_entries: int,
+                    frontier_inputs: Optional[Sequence[SSTReader]] = None
                     ) -> CompactionResult:
     """Full-native compaction: the byte path (decode/merge/encode) runs in
     C++ (native/compaction_engine.cc); Python assembles base files and
@@ -181,14 +260,16 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
     from yugabyte_tpu.storage.sst import data_file_name, write_base_file
 
     tombstone_value = Value.tombstone().encode()
+    limiter = compaction_rate_limiter()
     with native_engine.NativeCompactionJob() as job:
         for r in inputs:
             with open(r.data_path, "rb") as f:
                 job.add_input(f.read(), r.block_handles)
         rows_in = job.prepare()
         rows_out = job.merge(history_cutoff_ht, is_major, retain_deletes)
-        fr = _merge_frontiers([r.props.frontier for r in inputs],
-                              history_cutoff_ht)
+        fr = _merge_frontiers(
+            [r.props.frontier for r in (frontier_inputs or inputs)],
+            history_cutoff_ht)
         outputs: List[Tuple[int, str, SSTProps]] = []
         max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
         for start in range(0, rows_out, max_rows):
@@ -201,6 +282,8 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
             props = write_base_file(base_path, index, end - start, hashes,
                                     fk, lk, fr, size)
             outputs.append((fid, base_path, props))
+            if limiter is not None and end < rows_out:
+                limiter.acquire(props.data_size + props.base_size)
     return CompactionResult(outputs, rows_in, rows_out)
 
 

@@ -72,17 +72,23 @@ class SSTProps:
     frontier: Frontier = field(default_factory=Frontier)
     data_size: int = 0
     base_size: int = 0
+    # Whole-file TTL drop metadata (ref: docdb/compaction_file_filter.h:60):
+    # microseconds-physical time at which the LAST entry expires, or 0 when
+    # any entry lacks a TTL (file never fully expires).
+    max_expire_us: int = 0
 
     def to_json(self) -> dict:
         return {"n_entries": self.n_entries, "first_key": self.first_key.hex(),
                 "last_key": self.last_key.hex(), "frontier": self.frontier.to_json(),
-                "data_size": self.data_size, "base_size": self.base_size}
+                "data_size": self.data_size, "base_size": self.base_size,
+                "max_expire_us": self.max_expire_us}
 
     @staticmethod
     def from_json(d: dict) -> "SSTProps":
         return SSTProps(d["n_entries"], bytes.fromhex(d["first_key"]),
                         bytes.fromhex(d["last_key"]), Frontier.from_json(d["frontier"]),
-                        d["data_size"], d["base_size"])
+                        d["data_size"], d["base_size"],
+                        d.get("max_expire_us", 0))
 
 
 class SSTWriter:
@@ -124,10 +130,19 @@ class SSTWriter:
             hashes = fnv64_masked(u8, slab.doc_key_len.astype(np.int64))
         else:
             hashes = np.zeros(0, dtype=np.uint64)
+        # whole-file expiry: meaningful only if EVERY entry carries a TTL
+        from yugabyte_tpu.ops.slabs import FLAG_HAS_TTL
+        max_expire_us = 0
+        if n and bool(((slab.flags & FLAG_HAS_TTL) != 0).all()):
+            ht_phys = ((slab.ht_hi.astype(np.uint64) << 32)
+                       | slab.ht_lo.astype(np.uint64)) >> 12
+            max_expire_us = int(
+                (ht_phys + slab.ttl_ms.astype(np.uint64) * 1000).max())
         return write_base_file(
             self.base_path, index_items, n, hashes,
             key_at(0) if n else b"", key_at(n - 1) if n else b"",
-            frontier, data_off, self.bits_per_key)
+            frontier, data_off, self.bits_per_key,
+            max_expire_us=max_expire_us)
 
 
 def write_base_file(base_path: str,
@@ -135,7 +150,8 @@ def write_base_file(base_path: str,
                     n_entries: int, bloom_hashes: np.ndarray,
                     first_key: bytes, last_key: bytes,
                     frontier: Optional[Frontier], data_size: int,
-                    bits_per_key: int = 10) -> SSTProps:
+                    bits_per_key: int = 10,
+                    max_expire_us: int = 0) -> SSTProps:
     """Assemble the base (metadata) file from precomputed parts.
 
     index_items: (last_key, data_offset, block_size, n_entries) per data
@@ -155,6 +171,7 @@ def write_base_file(base_path: str,
         last_key=last_key,
         frontier=frontier or Frontier(),
         data_size=data_size,
+        max_expire_us=max_expire_us,
     )
     props_bytes = json.dumps(props.to_json()).encode()
     with open(base_path, "wb") as bf:

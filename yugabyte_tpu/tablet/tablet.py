@@ -42,6 +42,15 @@ flags.define_flag(
     "timestamp_history_retention_interval_sec", 900,
     "how far back in time reads are repeatable; compaction keeps overwritten "
     "values younger than this (ref tablet_retention_policy.h:29)")
+flags.define_flag("sst_files_soft_limit", 24,
+                  "writes start delaying at this many live SST files "
+                  "(ref sst_files_soft_limit)")
+flags.define_flag("sst_files_hard_limit", 48,
+                  "writes are rejected (retryably) at this many live SST "
+                  "files (ref sst_files_hard_limit)")
+flags.define_flag("write_backpressure_max_delay_ms", 100,
+                  "max per-write delay as file pressure approaches the "
+                  "hard limit (ref tablet_service.cc:1510 rejection score)")
 
 
 class TabletRetentionPolicy:
@@ -169,6 +178,8 @@ class Tablet:
         self.metric_write_latency = entity.histogram(
             "ql_write_latency_us", "end-to-end WriteQuery latency (us)")
         self.metric_reads = entity.counter("ql_reads", "row reads served")
+        self.metric_write_rejections = entity.counter(
+            "write_rejections", "writes rejected by SST-file backpressure")
 
     # ------------------------------------------------------------------ write
     def write(self, ops: Sequence[QLWriteOp], timeout_s: float = 10.0,
@@ -181,6 +192,9 @@ class Tablet:
         already-replicated request returns its original hybrid time without
         re-applying; a duplicate of an in-flight one is pushed back to the
         client retry loop until the first attempt's fate settles."""
+        # dedup BEFORE backpressure: a retry of an already-replicated write
+        # must return its stored result even under file pressure (else a
+        # long stall could outlive the dedup record and double-apply)
         if request is not None:
             state, ht_value = self.retryable.check_or_track(*request)
             if state == "duplicate":
@@ -189,6 +203,12 @@ class Tablet:
                 from yugabyte_tpu.utils.status import Status, StatusError
                 raise StatusError(Status.ServiceUnavailable(
                     "duplicate request still in flight"))
+        try:
+            self._check_write_backpressure()
+        except BaseException:
+            if request is not None:
+                self.retryable.failed(*request)
+            raise
         with self._write_gate:
             if self._writes_blocked or self.split_children is not None:
                 if request is not None:
@@ -207,6 +227,29 @@ class Tablet:
             with self._write_gate:
                 self._inflight_writes -= 1
                 self._write_gate.notify_all()
+
+    def _check_write_backpressure(self) -> None:
+        """Score-based write throttling on SST-file pressure (ref:
+        tserver/tablet_service.cc:1510 write-rejection score +
+        sst_files_soft/hard_limit): between the soft and hard limits each
+        write is delayed proportionally, giving compactions bandwidth to
+        catch up; at the hard limit writes are rejected retryably."""
+        from yugabyte_tpu.utils import flags as _flags
+        soft = _flags.get_flag("sst_files_soft_limit")
+        hard = _flags.get_flag("sst_files_hard_limit")
+        files = self.regular_db.n_live_files
+        if files < soft:
+            return
+        if files >= hard:
+            from yugabyte_tpu.utils.status import Status, StatusError
+            self.metric_write_rejections.increment()
+            raise StatusError(Status.ServiceUnavailable(
+                f"too many SST files ({files} >= {hard}); retry later"))
+        score = (files - soft + 1) / max(1, hard - soft)
+        delay = score * _flags.get_flag(
+            "write_backpressure_max_delay_ms") / 1000.0
+        if delay > 0:
+            time.sleep(delay)
 
     def block_writes(self) -> None:
         """Reject new writes and drain in-flight ones (split prelude)."""
@@ -282,6 +325,7 @@ class Tablet:
         from yugabyte_tpu.docdb.conflict_resolution import (
             resolve_write_conflicts)
         from yugabyte_tpu.docdb.intents import make_intent_batch
+        self._check_write_backpressure()  # both write entry points throttle
         with self._write_gate:
             if self._writes_blocked or self.split_children is not None:
                 raise TabletHasBeenSplit(self.split_children or ())
