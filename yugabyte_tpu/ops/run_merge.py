@@ -355,13 +355,16 @@ def merge_and_gc_runs(slabs: Sequence[KVSlab], params: GCParams, device=None,
     bucket would inflate device work/memory beyond 2x the radix path's
     single bucket) falls back to the radix kernel.
     """
+    import os as _os
     if staged is None:
         live = [s for s in slabs if s.n]
         if not live:
             z = np.zeros(0, dtype=np.int64)
             zb = np.zeros(0, dtype=bool)
             return z, zb, zb
-        if run_layout_inflation([s.n for s in live]) > 2.0:
+        if (run_layout_inflation([s.n for s in live]) > 2.0
+                or _os.environ.get("YBTPU_FORCE_RADIX", "").lower()
+                not in ("", "0", "false")):
             from yugabyte_tpu.ops.merge_gc import merge_and_gc_device
             from yugabyte_tpu.ops.slabs import concat_slabs
             merged = concat_slabs(live)

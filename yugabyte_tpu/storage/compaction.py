@@ -152,6 +152,12 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     inputs, dropped = filter_expired_inputs(
         inputs, history_cutoff_ht, is_major, retain_deletes)
     dropped_rows = sum(r.props.n_entries for r in dropped)
+    if input_ids is not None:
+        # keep the cache-id pairing aligned with the FILTERED input list —
+        # a whole-file drop earlier in the list must not shift every
+        # later reader onto its neighbor's staged columns
+        id_of = {id(r): fid for r, fid in zip(all_inputs, input_ids)}
+        input_ids = [id_of[id(r)] for r in inputs]
     if not inputs:
         return CompactionResult([], dropped_rows, 0)
     if device == "native":
@@ -191,7 +197,9 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         # runs, so the kernel merges them with a bitonic network instead of
         # re-sorting, and ships back packed decisions instead of a full perm.
         from yugabyte_tpu.ops import run_merge
-        skewed = run_merge.run_layout_inflation([s.n for s in slabs]) > 2.0
+        skewed = (run_merge.run_layout_inflation([s.n for s in slabs]) > 2.0
+                  or os.environ.get("YBTPU_FORCE_RADIX", "").lower()
+                  not in ("", "0", "false"))
         if device_cache is not None and input_ids is not None:
             ids = [input_ids[i] for i in keep_idx]
             staged_list = []
@@ -285,6 +293,90 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
             if limiter is not None and end < rows_out:
                 limiter.acquire(props.data_size + props.base_size)
     return CompactionResult(outputs, rows_in, rows_out)
+
+
+def run_compaction_job_device_native(
+        inputs: Sequence[SSTReader], out_dir: str, new_file_id,
+        history_cutoff_ht: int, is_major: bool,
+        retain_deletes: bool = False, device=None,
+        block_entries: int = 4096, device_cache=None,
+        input_ids: Optional[Sequence[int]] = None) -> CompactionResult:
+    """The production hot path: TPU decisions + native byte shell.
+
+    The device kernel (ops/run_merge.py) computes merge+GC decisions from
+    HBM-cached key columns — launched FIRST so its compute and the packed
+    decision download overlap the C++ shell's block decode of the same
+    inputs (native/compaction_engine.cc); the shell then materializes the
+    output SSTs from the injected survivors. Steady state does zero
+    host->device upload (flush/compaction write-through staged the
+    inputs) and ~0.5 byte/row download.
+
+    Caller contract: inputs must not contain deep documents (FLAG_DEEP —
+    depth > row+column); run_compaction_job routes those to the native
+    merge, which carries the full overwrite stack."""
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.ops.merge_gc import stage_slab
+    from yugabyte_tpu.storage import native_engine
+    from yugabyte_tpu.storage.sst import data_file_name, write_base_file
+
+    all_inputs = list(inputs)
+    id_of = ({id(r): fid for r, fid in zip(all_inputs, input_ids)}
+             if input_ids is not None else None)
+    inputs, dropped = filter_expired_inputs(
+        inputs, history_cutoff_ht, is_major, retain_deletes)
+    dropped_rows = sum(r.props.n_entries for r in dropped)
+    inputs = [r for r in inputs if r.props.n_entries]
+    if not inputs:
+        return CompactionResult([], dropped_rows, 0)
+    # cache ids re-aligned to the filtered list (see run_compaction_job)
+    input_ids = ([id_of[id(r)] for r in inputs]
+                 if id_of is not None else None)
+
+    # 1) launch the device decisions from the HBM slab cache
+    staged_list = []
+    for r, fid in zip(inputs, input_ids or [None] * len(inputs)):
+        st = device_cache.get(fid) if (device_cache is not None
+                                       and fid is not None) else None
+        if st is None:
+            slab = r.read_all()
+            st = (device_cache.stage(fid, slab)
+                  if device_cache is not None and fid is not None
+                  else stage_slab(slab, device))
+        staged_list.append(st)
+    staged_runs = run_merge.stage_runs_from_staged(staged_list)
+    params = GCParams(history_cutoff_ht, is_major, retain_deletes)
+    handle = run_merge.launch_merge_gc(staged_runs, params)
+
+    # 2) native shell decodes the same inputs while the device works
+    tombstone_value = Value.tombstone().encode()
+    limiter = compaction_rate_limiter()
+    with native_engine.NativeCompactionJob() as job:
+        for r in inputs:
+            with open(r.data_path, "rb") as f:
+                job.add_input(f.read(), r.block_handles)
+        rows_in = job.prepare()
+
+        # 3) inject the decisions; the shell writes the outputs
+        perm, keep, mk = handle.result()
+        job.set_survivors(perm[keep], mk[keep])
+        rows_out = job.n_survivors
+        fr = _merge_frontiers([r.props.frontier for r in all_inputs],
+                              history_cutoff_ht)
+        outputs: List[Tuple[int, str, SSTProps]] = []
+        max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
+        for start in range(0, rows_out, max_rows):
+            end = min(start + max_rows, rows_out)
+            fid = new_file_id()
+            base_path = os.path.join(out_dir, f"{fid:06d}.sst")
+            size, index, hashes, fk, lk = job.write_output(
+                start, end, data_file_name(base_path), block_entries,
+                compress=False, tombstone_value=tombstone_value)
+            props = write_base_file(base_path, index, end - start, hashes,
+                                    fk, lk, fr, size)
+            outputs.append((fid, base_path, props))
+            if limiter is not None and end < rows_out:
+                limiter.acquire(props.data_size + props.base_size)
+    return CompactionResult(outputs, rows_in + dropped_rows, rows_out)
 
 
 def _gather_slab(slab: KVSlab, sel: np.ndarray, make_tomb: np.ndarray,
