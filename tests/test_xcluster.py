@@ -1,0 +1,123 @@
+"""xCluster async replication: CDC producer + consumer pollers between two
+live clusters (round-2 Missing #5; ref ent/src/yb/cdc/cdc_producer.cc,
+ent/src/yb/tserver/cdc_poller.cc, twodc_output_client.cc)."""
+
+import time
+
+import pytest
+
+from yugabyte_tpu.client.transaction import TransactionManager
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.cdc import poller as _poller  # registers xcluster flags
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+
+
+def _schema():
+    return Schema([ColumnSchema("k", DataType.STRING),
+                   ColumnSchema("v", DataType.INT64)],
+                  num_hash_key_columns=1, num_range_key_columns=0)
+
+
+def _op(k, v):
+    return QLWriteOp(WriteOpKind.INSERT, DocKey(hash_components=(k,)),
+                     {"v": v})
+
+
+@pytest.fixture(scope="module")
+def clusters(tmp_path_factory):
+    flags.set_flag("replication_factor", 3)
+    flags.set_flag("xcluster_poll_interval_ms", 50)
+    src = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path_factory.mktemp("xc-src")))).start()
+    dst = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path_factory.mktemp("xc-dst")))).start()
+    yield src, dst
+    dst.shutdown()
+    src.shutdown()
+
+
+def _wait(pred, timeout_s=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_xcluster_replicates_writes_deletes_and_txns(clusters):
+    src, dst = clusters
+    s_client, d_client = src.new_client(), dst.new_client()
+    s_client.create_namespace("app")
+    d_client.create_namespace("app")
+    s_table = s_client.create_table("app", "orders", _schema(),
+                                    num_tablets=2)
+    d_table = d_client.create_table("app", "orders", _schema(),
+                                    num_tablets=2)
+    for i in range(20):
+        s_client.write(s_table, [_op(f"o{i:03d}", i)])
+
+    d_client.setup_universe_replication(
+        "repl1", [src.masters[0].address],
+        [["app", "orders", "app", "orders"]])
+
+    def row_on_target(k):
+        row = d_client.read_row(d_table, DocKey(hash_components=(k,)))
+        return row.to_dict(d_table.schema) if row is not None else None
+
+    # pre-existing rows arrive (stream starts from index 0)
+    _wait(lambda: row_on_target("o013") is not None, msg="backlog row")
+    assert row_on_target("o013")["v"] == 13
+    # new writes stream continuously
+    s_client.write(s_table, [_op("live1", 101)])
+    _wait(lambda: row_on_target("live1") is not None, msg="live row")
+    # source hybrid times are preserved (external HT application)
+    s_row = s_client.read_row(s_table, DocKey(hash_components=("live1",)))
+    d_row = d_client.read_row(d_table, DocKey(hash_components=("live1",)))
+    assert s_row.write_ht.value == d_row.write_ht.value
+    # deletes replicate as tombstones
+    s_client.write(s_table, [QLWriteOp(WriteOpKind.DELETE_ROW,
+                                       DocKey(hash_components=("o005",)))])
+    _wait(lambda: row_on_target("o005") is None, msg="delete")
+    # distributed transactions replicate atomically at the commit time
+    mgr = TransactionManager(s_client)
+    txn = mgr.begin()
+    txn.write(s_table, [_op("t1", 1000)])
+    txn.write(s_table, [_op("t2", 2000)])
+    txn.commit()
+    _wait(lambda: row_on_target("t1") is not None
+          and row_on_target("t2") is not None, msg="txn rows")
+    assert row_on_target("t1")["v"] == 1000
+    assert row_on_target("t2")["v"] == 2000
+    # checkpoints persist in the target master's sys catalog
+    def checkpoint_advanced():
+        metas = [m for t, _i, m in
+                 dst.masters[0].catalog.sys.scan_all()
+                 if t == "replication"]
+        return metas and any(v > 0 for v in
+                             metas[0].get("checkpoints", {}).values())
+    _wait(checkpoint_advanced, msg="checkpoint persistence")
+    s_client.close()
+    d_client.close()
+
+
+def test_xcluster_delete_replication_stops_stream(clusters):
+    src, dst = clusters
+    s_client, d_client = src.new_client(), dst.new_client()
+    s_table = s_client.open_table("app", "orders")
+    d_table = d_client.open_table("app", "orders")
+    d_client.delete_universe_replication("repl1")
+    time.sleep(0.5)  # heartbeat reconciles pollers away
+    s_client.write(s_table, [_op("after-stop", 7)])
+    time.sleep(1.0)
+    row = d_client.read_row(d_table,
+                            DocKey(hash_components=("after-stop",)))
+    assert row is None
+    s_client.close()
+    d_client.close()

@@ -1,0 +1,2 @@
+"""CDC + xCluster async replication (ref: ent/src/yb/cdc/,
+ent/src/yb/tserver/cdc_poller.cc)."""

@@ -188,6 +188,20 @@ class YBClient:
         raise StatusError(Status.TimedOut(
             f"index {index_name} did not become readable"))
 
+    def setup_universe_replication(self, replication_id: str,
+                                   source_master_addrs: Sequence[str],
+                                   tables: Sequence[Sequence[str]]) -> dict:
+        """Async xCluster replication: tables is a list of
+        [src_namespace, src_table, dst_namespace, dst_table]."""
+        return self._master_call(
+            "setup_universe_replication", replication_id=replication_id,
+            source_master_addrs=list(source_master_addrs),
+            tables=[list(t) for t in tables])
+
+    def delete_universe_replication(self, replication_id: str) -> None:
+        self._master_call("delete_universe_replication",
+                          replication_id=replication_id)
+
     def open_table(self, namespace: str, name: str) -> YBTable:
         return YBTable(self._master_call("get_table", namespace=namespace,
                                          name=name))

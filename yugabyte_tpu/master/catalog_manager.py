@@ -130,6 +130,7 @@ class CatalogManager:
             self.tables = tables
             self.tablets = tablets
             self._confirmed.clear()
+            self._replication_cache = None
             self._loaded_term = term
             TRACE("catalog loaded at term %d: %d namespaces, %d tables, "
                   "%d tablets", term, len(namespaces), len(tables),
@@ -442,10 +443,18 @@ class CatalogManager:
                                           ["replicas"])):
                             self._persist_tablet_replicas_locked(
                                 tablet_id, list(reported))
-        return {
+        resp = {
             "addr_map": self.ts_manager.addr_map(),
             "tablets_to_delete": to_delete,
         }
+        try:
+            with self._lock:
+                repl = self._replication_work_for(reported_ids)
+            if repl:
+                resp["replication"] = repl
+        except Exception:  # noqa: BLE001 — must never fail heartbeats
+            pass
+        return resp
 
     def _adopt_split_child_locked(self, t: dict) -> None:
         parent_id = t["split_parent"]
@@ -500,6 +509,91 @@ class CatalogManager:
                 retired += 1
                 TRACE("catalog: retired split parent %s", tablet_id)
         return retired
+
+    # ---------------------------------------------------- xCluster streams
+    def setup_universe_replication(self, replication_id: str,
+                                   source_master_addrs: List[str],
+                                   tables: List[List[str]]) -> dict:
+        """Register async replication from a source universe (ref:
+        ent/src/yb/master/catalog_manager_ent.cc SetupUniverseReplication).
+        tables: [src_namespace, src_table, dst_namespace, dst_table] rows;
+        each target table's tablet leaders then run CDC pollers delivered
+        via heartbeats. Partition counts must match — the pollers map
+        source tablets by partition start."""
+        entries = []
+        for src_ns, src_table, dst_ns, dst_table in tables:
+            with self._lock:
+                dst_id = self._find_table(dst_ns, dst_table)
+                if dst_id is None:
+                    raise StatusError(Status.NotFound(
+                        f"target table {dst_ns}.{dst_table} not found"))
+                n_dst = len(self.tables[dst_id]["tablet_ids"])
+            entries.append({"src_namespace": src_ns,
+                            "src_table": src_table,
+                            "dst_table_id": dst_id,
+                            "n_tablets": n_dst})
+        meta = {"replication_id": replication_id,
+                "source_master_addrs": list(source_master_addrs),
+                "tables": entries, "checkpoints": {}}
+        with self._lock:
+            if self.sys.get("replication", replication_id) is not None:
+                raise StatusError(Status.AlreadyPresent(
+                    f"replication {replication_id!r} exists"))
+            self.sys.upsert("replication", replication_id, meta)
+            self._replication_cache = None
+        return meta
+
+    def delete_universe_replication(self, replication_id: str) -> None:
+        with self._lock:
+            self.sys.delete("replication", replication_id)
+            self._replication_cache = None
+
+    def _replications(self) -> List[dict]:
+        """In-memory cache, invalidated by setup/delete/checkpoint writes
+        — heartbeats (the hottest master path) must not scan the whole
+        sys catalog when no replication is configured."""
+        cache = getattr(self, "_replication_cache", None)
+        if cache is None:
+            cache = [m for t, _i, m in self.sys.scan_all()
+                     if t == "replication"]
+            self._replication_cache = cache
+        return cache
+
+    def update_replication_checkpoint(self, replication_id: str,
+                                      tablet_id: str, index: int) -> None:
+        with self._lock:
+            meta = self.sys.get("replication", replication_id)
+            if meta is None:
+                return
+            cp = meta.get("checkpoints", {})
+            if cp.get(tablet_id, -1) >= index:
+                return
+            cp[tablet_id] = index
+            meta["checkpoints"] = cp
+            self.sys.upsert("replication", replication_id, meta)
+            self._replication_cache = None
+
+    def _replication_work_for(self, reported_ids) -> List[dict]:
+        """Heartbeat piggyback: poller specs for replicated target tablets
+        this tserver reports (its leadership is checked tserver-side)."""
+        out = []
+        for meta in self._replications():
+            for t in meta["tables"]:
+                table = self.tables.get(t["dst_table_id"])
+                if table is None:
+                    continue
+                for tablet_id in table["tablet_ids"]:
+                    if tablet_id not in reported_ids:
+                        continue
+                    out.append({
+                        "replication_id": meta["replication_id"],
+                        "tablet_id": tablet_id,
+                        "source_master_addrs": meta["source_master_addrs"],
+                        "src_namespace": t["src_namespace"],
+                        "src_table": t["src_table"],
+                        "checkpoint": meta.get("checkpoints", {}).get(
+                            tablet_id, 0)})
+        return out
 
     # ------------------------------------------------------------ snapshots
     def create_table_snapshot(self, namespace: str, name: str) -> dict:

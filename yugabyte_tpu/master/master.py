@@ -84,6 +84,22 @@ class MasterService:
         return self._leader_catalog().create_index(
             namespace, table, index_name, column, num_tablets)
 
+    def setup_universe_replication(self, replication_id: str,
+                                   source_master_addrs: List[str],
+                                   tables: List[List[str]]) -> dict:
+        return self._leader_catalog().setup_universe_replication(
+            replication_id, source_master_addrs, tables)
+
+    def delete_universe_replication(self, replication_id: str) -> bool:
+        self._leader_catalog().delete_universe_replication(replication_id)
+        return True
+
+    def update_replication_checkpoint(self, replication_id: str,
+                                      tablet_id: str, index: int) -> bool:
+        self._leader_catalog().update_replication_checkpoint(
+            replication_id, tablet_id, index)
+        return True
+
     # -------------------------------------------------------------- lookups
     def get_table(self, namespace: str, name: str) -> dict:
         return self._leader_catalog().get_table(namespace, name)

@@ -256,6 +256,34 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     return CompactionResult(outputs, merged.n + dropped_rows, rows_out)
 
 
+def _write_native_outputs(job, out_dir: str, new_file_id, fr,
+                          block_entries: int
+                          ) -> List[Tuple[int, str, SSTProps]]:
+    """Write the native job's survivors as (possibly split) output SSTs,
+    pacing between files (shared by the pure-native and device+native
+    paths — the pacing/tombstone/base-assembly rules live once)."""
+    from yugabyte_tpu.storage.sst import data_file_name, write_base_file
+
+    tombstone_value = Value.tombstone().encode()
+    limiter = compaction_rate_limiter()
+    rows_out = job.n_survivors
+    outputs: List[Tuple[int, str, SSTProps]] = []
+    max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
+    for start in range(0, rows_out, max_rows):
+        end = min(start + max_rows, rows_out)
+        fid = new_file_id()
+        base_path = os.path.join(out_dir, f"{fid:06d}.sst")
+        size, index, hashes, fk, lk = job.write_output(
+            start, end, data_file_name(base_path), block_entries,
+            compress=False, tombstone_value=tombstone_value)
+        props = write_base_file(base_path, index, end - start, hashes,
+                                fk, lk, fr, size)
+        outputs.append((fid, base_path, props))
+        if limiter is not None and end < rows_out:
+            limiter.acquire(props.data_size + props.base_size)
+    return outputs
+
+
 def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
                     history_cutoff_ht: int, is_major: bool,
                     retain_deletes: bool, block_entries: int,
@@ -265,10 +293,7 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
     C++ (native/compaction_engine.cc); Python assembles base files and
     frontiers. Same outputs as the Python shell, ~10x less wall."""
     from yugabyte_tpu.storage import native_engine
-    from yugabyte_tpu.storage.sst import data_file_name, write_base_file
 
-    tombstone_value = Value.tombstone().encode()
-    limiter = compaction_rate_limiter()
     with native_engine.NativeCompactionJob() as job:
         for r in inputs:
             with open(r.data_path, "rb") as f:
@@ -278,20 +303,8 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
         fr = _merge_frontiers(
             [r.props.frontier for r in (frontier_inputs or inputs)],
             history_cutoff_ht)
-        outputs: List[Tuple[int, str, SSTProps]] = []
-        max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
-        for start in range(0, rows_out, max_rows):
-            end = min(start + max_rows, rows_out)
-            fid = new_file_id()
-            base_path = os.path.join(out_dir, f"{fid:06d}.sst")
-            size, index, hashes, fk, lk = job.write_output(
-                start, end, data_file_name(base_path), block_entries,
-                compress=False, tombstone_value=tombstone_value)
-            props = write_base_file(base_path, index, end - start, hashes,
-                                    fk, lk, fr, size)
-            outputs.append((fid, base_path, props))
-            if limiter is not None and end < rows_out:
-                limiter.acquire(props.data_size + props.base_size)
+        outputs = _write_native_outputs(job, out_dir, new_file_id, fr,
+                                        block_entries)
     return CompactionResult(outputs, rows_in, rows_out)
 
 
@@ -320,6 +333,7 @@ def run_compaction_job_device_native(
     from yugabyte_tpu.storage.sst import data_file_name, write_base_file
 
     all_inputs = list(inputs)
+    orig_input_ids = list(input_ids) if input_ids is not None else None
     id_of = ({id(r): fid for r, fid in zip(all_inputs, input_ids)}
              if input_ids is not None else None)
     inputs, dropped = filter_expired_inputs(
@@ -331,6 +345,17 @@ def run_compaction_job_device_native(
     # cache ids re-aligned to the filtered list (see run_compaction_job)
     input_ids = ([id_of[id(r)] for r in inputs]
                  if id_of is not None else None)
+    if run_merge.run_layout_inflation(
+            [r.props.n_entries for r in inputs]) > 2.0:
+        # skewed run sizes would pad every run to the largest bucket on
+        # device — take the radix-kernel job instead (same outputs;
+        # original input list with its ORIGINAL id pairing)
+        return run_compaction_job(all_inputs, out_dir, new_file_id,
+                                  history_cutoff_ht, is_major,
+                                  retain_deletes, device=device,
+                                  block_entries=block_entries,
+                                  device_cache=device_cache,
+                                  input_ids=orig_input_ids)
 
     # 1) launch the device decisions from the HBM slab cache
     staged_list = []
@@ -348,8 +373,6 @@ def run_compaction_job_device_native(
     handle = run_merge.launch_merge_gc(staged_runs, params)
 
     # 2) native shell decodes the same inputs while the device works
-    tombstone_value = Value.tombstone().encode()
-    limiter = compaction_rate_limiter()
     with native_engine.NativeCompactionJob() as job:
         for r in inputs:
             with open(r.data_path, "rb") as f:
@@ -362,20 +385,16 @@ def run_compaction_job_device_native(
         rows_out = job.n_survivors
         fr = _merge_frontiers([r.props.frontier for r in all_inputs],
                               history_cutoff_ht)
-        outputs: List[Tuple[int, str, SSTProps]] = []
-        max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
-        for start in range(0, rows_out, max_rows):
-            end = min(start + max_rows, rows_out)
-            fid = new_file_id()
-            base_path = os.path.join(out_dir, f"{fid:06d}.sst")
-            size, index, hashes, fk, lk = job.write_output(
-                start, end, data_file_name(base_path), block_entries,
-                compress=False, tombstone_value=tombstone_value)
-            props = write_base_file(base_path, index, end - start, hashes,
-                                    fk, lk, fr, size)
-            outputs.append((fid, base_path, props))
-            if limiter is not None and end < rows_out:
-                limiter.acquire(props.data_size + props.base_size)
+        outputs = _write_native_outputs(job, out_dir, new_file_id, fr,
+                                        block_entries)
+    if device_cache is not None:
+        # write-through: the outputs are the next compaction's inputs
+        for fid, base_path, _props in outputs:
+            rdr = SSTReader(base_path)
+            try:
+                device_cache.stage(fid, rdr.read_all())
+            finally:
+                rdr.close()
     return CompactionResult(outputs, rows_in + dropped_rows, rows_out)
 
 

@@ -302,6 +302,42 @@ class Tablet:
         self.metric_write_latency.increment((time.monotonic() - t0) * 1e6)
         return ht
 
+    def apply_external_batch(self, kvs: Sequence[Sequence],
+                             default_ht_value: int,
+                             timeout_s: float = 30.0) -> HybridTime:
+        """xCluster consumer apply: raw DocDB (key, value, ht_override)
+        triples from a source cluster, replicated through THIS tablet's
+        Raft with the source hybrid times preserved as per-entry overrides
+        (ref: twodc_output_client.cc external hybrid times). Bypasses the
+        QL write pipeline: entries are already DocDB-encoded and the
+        target is passive for replicated ranges."""
+        self.clock.update(HybridTime(default_ht_value))
+        triples = [(bytes(k), bytes(v),
+                    int(o) if o else default_ht_value)
+                   for k, v, o in kvs]
+        # same gate as every other write path: an apply racing a split's
+        # write drain would land in the retiring parent and never reach
+        # the children
+        with self._write_gate:
+            if self._writes_blocked or self.split_children is not None:
+                raise TabletHasBeenSplit(self.split_children or ())
+            self._inflight_writes += 1
+        try:
+            ht = self.mvcc.add_pending_now()
+            try:
+                self.consensus.submit(triples, ht, timeout_s=timeout_s)
+            except OperationOutcomeUnknown:
+                raise
+            except BaseException:
+                self.mvcc.aborted(ht)
+                raise
+            self.mvcc.replicated(ht)
+            return ht
+        finally:
+            with self._write_gate:
+                self._inflight_writes -= 1
+                self._write_gate.notify_all()
+
     def apply_write_batch(self, kv_pairs: Sequence[Tuple],
                           ht: HybridTime, op_id: Tuple[int, int]) -> None:
         """Apply an already-replicated batch to regular_db. Position within

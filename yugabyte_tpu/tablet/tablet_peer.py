@@ -381,6 +381,11 @@ class TabletPeer:
             raise NotLeader(self.raft.leader_hint())
         return self.tablet.write(ops, timeout_s=timeout_s, request=request)
 
+    def apply_external_batch(self, kvs, default_ht_value: int) -> HybridTime:
+        if not self.raft.is_leader():
+            raise NotLeader(self.raft.leader_hint())
+        return self.tablet.apply_external_batch(kvs, default_ht_value)
+
     def write_transactional(self, ops, txn_meta,
                             timeout_s: float = 30.0) -> HybridTime:
         if not self.raft.is_leader():

@@ -19,6 +19,7 @@ from yugabyte_tpu.rpc.consensus_service import RpcTransport
 from yugabyte_tpu.rpc.messenger import Messenger
 from yugabyte_tpu.tablet.tablet import TabletOptions
 from yugabyte_tpu.tserver.heartbeater import Heartbeater
+from yugabyte_tpu.utils.status import StatusError
 from yugabyte_tpu.tserver.tablet_service import TabletServiceImpl
 from yugabyte_tpu.tserver.ts_tablet_manager import TSTabletManager
 from yugabyte_tpu.utils.metrics import MetricRegistry
@@ -110,6 +111,40 @@ class TabletServer:
             self._addr_map.update(resp.get("addr_map") or {})
         for tablet_id in resp.get("tablets_to_delete") or []:
             self.tablet_manager.delete_tablet(tablet_id)
+        self._reconcile_pollers(resp.get("replication") or [])
+
+    # ------------------------------------------------------------- xCluster
+    def _reconcile_pollers(self, specs) -> None:
+        """Start/stop xCluster pollers per the master's heartbeat piggyback
+        (ref: cdc_consumer.cc reconciling pollers from the consumer
+        registry)."""
+        from yugabyte_tpu.cdc.poller import XClusterPoller
+        if not hasattr(self, "_pollers"):
+            self._pollers = {}
+        want = {(s["replication_id"], s["tablet_id"]): s for s in specs}
+        with self._addr_lock:
+            if getattr(self, "_shutting_down", False):
+                return  # a late heartbeat must not resurrect pollers
+            for key in list(self._pollers):
+                if key not in want:
+                    self._pollers.pop(key).stop()
+            for key, s in want.items():
+                if key not in self._pollers:
+                    self._pollers[key] = XClusterPoller(
+                        self, s["replication_id"], s["tablet_id"],
+                        s["source_master_addrs"], s["src_table"],
+                        s["src_namespace"], s["checkpoint"]).start()
+
+    def report_replication_checkpoint(self, replication_id: str,
+                                      tablet_id: str, index: int) -> None:
+        client = self.local_client()
+        if client is not None:
+            try:
+                client._master_call("update_replication_checkpoint",
+                                    replication_id=replication_id,
+                                    tablet_id=tablet_id, index=index)
+            except StatusError:
+                pass  # retried on the next progress report
 
     def update_addr_map(self, addr_map: Dict[str, str]) -> None:
         with self._addr_lock:
@@ -192,6 +227,11 @@ class TabletServer:
         return self
 
     def shutdown(self) -> None:
+        with self._addr_lock:
+            self._shutting_down = True
+            pollers = list(getattr(self, "_pollers", {}).values())
+        for p in pollers:
+            p.stop()
         self.heartbeater.stop()
         if self.webserver is not None:
             self.webserver.shutdown()

@@ -203,6 +203,16 @@ class TabletServiceImpl:
             total += 1
         return {"checksum": digest, "entries": total}
 
+    # ------------------------------------------------------------------ CDC
+    def cdc_get_changes(self, tablet_id: str, from_index: int,
+                        max_records: int = 1000) -> dict:
+        """Change stream for xCluster consumers (ref:
+        ent/src/yb/cdc/cdc_service.cc GetChanges)."""
+        from yugabyte_tpu.cdc.producer import get_changes
+        peer = self._leader_peer(tablet_id)
+        records, checkpoint = get_changes(peer, from_index, max_records)
+        return {"records": records, "checkpoint": checkpoint}
+
     # --------------------------------------------------------- index backfill
     def backfill_index_tablet(self, tablet_id: str, namespace: str,
                               index_table: str, column: str,
