@@ -1,0 +1,96 @@
+"""Inspection tooling: sst_dump, ldb, ysck (round-2 Missing #10; ref
+rocksdb/tools/sst_dump_tool.cc, ldb_cmd.cc, src/yb/tools/ysck.cc)."""
+
+import io
+
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey, SubDocKey
+from yugabyte_tpu.docdb.value import Value
+from yugabyte_tpu.storage.db import DB, DBOptions
+from yugabyte_tpu.tools import ldb, sst_dump, ysck
+
+
+@pytest.fixture()
+def populated_db(tmp_path):
+    db = DB(str(tmp_path / "db"), DBOptions(auto_compact=False))
+    ht = 1
+    for i in range(50):
+        key = SubDocKey(DocKey(range_components=(f"row{i:03d}",)),
+                        (("col", 0),)).encode(include_ht=False)
+        db.write_batch([(key, DocHybridTime(HybridTime(ht << 12), 0),
+                         Value(primitive=i * 10).encode())])
+        ht += 1
+    db.flush()
+    yield db, str(tmp_path / "db")
+    db.close()
+
+
+def test_sst_dump(populated_db):
+    db, db_dir = populated_db
+    sst = next(iter(db._readers.values())).base_path
+    out = io.StringIO()
+    rc = sst_dump.dump(sst, entries=5, blocks=True, out=out)
+    text = out.getvalue()
+    assert rc == 0
+    assert "entries:     50" in text
+    assert "row000" in text      # decoded doc key
+    assert "-> 0" in text        # decoded value
+    assert "block 0:" in text
+
+
+def test_ldb_manifest_scan_get(populated_db):
+    db, db_dir = populated_db
+    out = io.StringIO()
+    assert ldb.cmd_manifest(db_dir, out) == 0
+    assert "live files:       1" in out.getvalue()
+    out = io.StringIO()
+    assert ldb.cmd_scan(db_dir, limit=7, out=out) == 0
+    assert out.getvalue().count("row0") == 7
+    key = SubDocKey(DocKey(range_components=("row003",)),
+                    (("col", 0),)).encode(include_ht=False)
+    out = io.StringIO()
+    assert ldb.cmd_get(db_dir, key.hex(), out) == 0
+    assert "1 version(s)" in out.getvalue()
+    out = io.StringIO()
+    assert ldb.cmd_get(db_dir, (key + b"zz").hex(), out) == 1
+
+
+def test_ysck_healthy_cluster(tmp_path):
+    import jax
+    from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+    from yugabyte_tpu.integration.mini_cluster import (
+        MiniCluster, MiniClusterOptions)
+    from yugabyte_tpu.utils import flags
+
+    flags.set_flag("replication_factor", 3)
+    mc = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=3,
+        fs_root=str(tmp_path / "ysck"))).start()
+    try:
+        client = mc.new_client()
+        client.create_namespace("ck")
+        schema = Schema([ColumnSchema("k", DataType.STRING),
+                         ColumnSchema("v", DataType.INT64)], 1, 0)
+        t = client.create_table("ck", "t", schema, num_tablets=2)
+        for i in range(30):
+            client.write(t, [QLWriteOp(
+                WriteOpKind.INSERT, DocKey(hash_components=(f"k{i}",)),
+                {"v": i})])
+        import time
+        deadline = time.monotonic() + 20
+        while True:
+            out = io.StringIO()
+            rc = ysck.check_cluster([mc.masters[0].address], out=out)
+            text = out.getvalue()
+            if rc == 0 or time.monotonic() > deadline:
+                break
+            time.sleep(0.5)  # leadership reports settle via heartbeats
+        assert rc == 0, text
+        assert "ysck: OK" in text
+        assert "ck.t: 2 tablets" in text
+        client.close()
+    finally:
+        mc.shutdown()

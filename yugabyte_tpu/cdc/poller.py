@@ -87,7 +87,6 @@ class XClusterPoller:
                 self._source_tablet_id = loc["tablet_id"]
                 self._source_replicas = [
                     r["addr"] for r in loc["replicas"] if r["addr"]]
-                self._source_leader = loc.get("leader")
                 return True
         TRACE("xcluster %s: no source tablet matches range [%r, %r) — "
               "replication paused", self.target_tablet_id, my_start, my_end)
@@ -96,14 +95,21 @@ class XClusterPoller:
     def _poll_source(self):
         """cdc_get_changes against the source tablet's leader."""
         last = None
-        for addr in list(self._source_replicas):
+        # try the known leader first; followers only on failover
+        leader_addr = getattr(self, "_leader_addr", None)
+        ordered = ([leader_addr] if leader_addr else []) + [
+            a for a in self._source_replicas if a != leader_addr]
+        for addr in ordered:
             try:
-                return self._source_client._messenger.call(
+                resp = self._source_client._messenger.call(
                     addr, "tserver", "cdc_get_changes",
                     tablet_id=self._source_tablet_id,
                     from_index=self.checkpoint,
+                    emit_after=self._applied_through,
                     max_records=flags.get_flag(
                         "xcluster_max_records_per_poll"))
+                self._leader_addr = addr
+                return resp
             except StatusError as e:
                 last = e
         raise last if last else StatusError.__new__(StatusError)

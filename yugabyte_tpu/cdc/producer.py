@@ -27,15 +27,24 @@ from yugabyte_tpu.docdb.lock_manager import IntentType
 from yugabyte_tpu.tablet.tablet_peer import decode_write_batch
 
 
-def get_changes(peer, from_index: int, max_records: int = 1000
+def get_changes(peer, from_index: int, max_records: int = 1000,
+                emit_after: Optional[int] = None
                 ) -> Tuple[List[dict], int]:
     """Change records after `from_index` (exclusive), up to the commit
     point. Returns (records, checkpoint): re-calling with checkpoint
     resumes without loss or duplication of RESOLVED work.
 
+    emit_after: suppress records at/below this index while still SCANNING
+    from from_index (intent re-buffering). A consumer whose durable
+    checkpoint is pinned behind a long-open transaction passes its
+    applied-through watermark here, so new commits keep streaming instead
+    of the same already-applied prefix filling every poll.
+
     Record shape: {"index", "ht", "kvs": [(key, value, ht_override)]} —
     ht_override 0 means "use ht".
     """
+    if emit_after is None:
+        emit_after = from_index
     committed = min(peer.raft.last_applied, peer.raft.commit_index)
     records: List[dict] = []
     # pending transactional intents seen this scan: txn -> [(idx, key, val, wid)]
@@ -53,6 +62,8 @@ def get_changes(peer, from_index: int, max_records: int = 1000
         if msg.op_type == OP_WRITE:
             kv_items, target_intents, _req = decode_write_batch(msg.payload)
             if not target_intents:
+                if msg.index <= emit_after:
+                    continue  # already applied by this consumer
                 kvs = []
                 for it in kv_items:
                     ht_override = it[2] if len(it) == 3 else 0
@@ -77,7 +88,8 @@ def get_changes(peer, from_index: int, max_records: int = 1000
             txn_id = bytes.fromhex(info["txn_id"])
             intents = pending.pop(txn_id, None)
             pending_first.pop(txn_id, None)
-            if info["action"] == "apply" and intents:
+            if (info["action"] == "apply" and intents
+                    and msg.index > emit_after):
                 commit_ht = info.get("commit_ht") or msg.ht_value
                 # write_id orders the entries within the commit
                 intents.sort(key=lambda t: t[3])

@@ -528,6 +528,30 @@ class CatalogManager:
                     raise StatusError(Status.NotFound(
                         f"target table {dst_ns}.{dst_table} not found"))
                 n_dst = len(self.tables[dst_id]["tablet_ids"])
+            # validate against the SOURCE universe now: a tablet-count
+            # mismatch would otherwise "succeed" and replicate nothing
+            # (pollers match exact partition ranges)
+            src_meta = None
+            for addr in source_master_addrs:
+                try:
+                    src_meta = self.messenger.call(
+                        addr, "master", "get_table", timeout_s=10.0,
+                        namespace=src_ns, name=src_table)
+                    break
+                except StatusError as e:
+                    if getattr(e, "extra", {}).get("not_leader"):
+                        continue
+                    raise StatusError(Status.InvalidArgument(
+                        f"source table {src_ns}.{src_table}: "
+                        f"{e.status.message}"))
+            if src_meta is None:
+                raise StatusError(Status.ServiceUnavailable(
+                    "no reachable source master"))
+            n_src = len(src_meta["tablet_ids"])
+            if n_src != n_dst:
+                raise StatusError(Status.InvalidArgument(
+                    f"tablet count mismatch for {src_ns}.{src_table}: "
+                    f"source {n_src} vs target {n_dst}"))
             entries.append({"src_namespace": src_ns,
                             "src_table": src_table,
                             "dst_table_id": dst_id,
@@ -571,7 +595,14 @@ class CatalogManager:
             cp[tablet_id] = index
             meta["checkpoints"] = cp
             self.sys.upsert("replication", replication_id, meta)
-            self._replication_cache = None
+            # update the heartbeat cache IN PLACE: invalidating here would
+            # force a full sys-catalog rescan per checkpoint report
+            cache = getattr(self, "_replication_cache", None)
+            if cache is not None:
+                for i, m in enumerate(cache):
+                    if m.get("replication_id") == replication_id:
+                        cache[i] = meta
+                        break
 
     def _replication_work_for(self, reported_ids) -> List[dict]:
         """Heartbeat piggyback: poller specs for replicated target tablets

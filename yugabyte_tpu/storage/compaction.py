@@ -330,7 +330,6 @@ def run_compaction_job_device_native(
     from yugabyte_tpu.ops import run_merge
     from yugabyte_tpu.ops.merge_gc import stage_slab
     from yugabyte_tpu.storage import native_engine
-    from yugabyte_tpu.storage.sst import data_file_name, write_base_file
 
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None

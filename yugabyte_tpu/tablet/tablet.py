@@ -311,6 +311,8 @@ class Tablet:
         (ref: twodc_output_client.cc external hybrid times). Bypasses the
         QL write pipeline: entries are already DocDB-encoded and the
         target is passive for replicated ranges."""
+        self._check_write_backpressure()  # replication also yields to
+        # compactions — an unthrottled source would grow target L0 forever
         self.clock.update(HybridTime(default_ht_value))
         triples = [(bytes(k), bytes(v),
                     int(o) if o else default_ht_value)

@@ -419,6 +419,12 @@ class TabletPeer:
         # Never GC entries a lagging peer still needs (there is no remote
         # bootstrap yet to rebuild it from a snapshot).
         anchor = min(anchor, self.raft.wal_gc_anchor())
+        # CDC retention: a consumer's checkpoint pins the WAL — GC'ing
+        # unstreamed changes would silently tear the replication stream
+        # (ref cdc_min_replicated_index-driven retention)
+        cdc_idx = getattr(self, "cdc_retention_index", None)
+        if cdc_idx is not None:
+            anchor = min(anchor, cdc_idx + 1)
         return self.log.gc_up_to(anchor)
 
     def shutdown(self) -> None:

@@ -1,0 +1,113 @@
+"""ldb: inspect one DB directory (ref: rocksdb/tools/ldb_cmd.cc).
+
+    python -m yugabyte_tpu.tools.ldb scan     --db <dir> [--limit N]
+    python -m yugabyte_tpu.tools.ldb get      --db <dir> --key <hex>
+    python -m yugabyte_tpu.tools.ldb manifest --db <dir>
+
+Read-only: opens the manifest + SSTs in place (a live DB's files are
+immutable once written, so inspecting a running tablet's dir is safe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _open_readers(db_dir: str):
+    import os
+
+    from yugabyte_tpu.storage.sst import SSTReader
+    from yugabyte_tpu.storage.version_set import VersionSet
+    versions = VersionSet(db_dir)
+    versions.recover()
+    readers = []
+    for fm in versions.live_files():
+        path = os.path.join(db_dir, f"{fm.file_id:06d}.sst")
+        readers.append((fm, SSTReader(path)))
+    return versions, readers
+
+
+def cmd_manifest(db_dir: str, out) -> int:
+    versions, readers = _open_readers(db_dir)
+    fr = versions.flushed_frontier
+    print(f"db:               {db_dir}", file=out)
+    print(f"next_file_id:     {versions.next_file_id}", file=out)
+    if fr is not None:
+        print(f"flushed_frontier: op_id={fr.op_id_max} "
+              f"ht_max={fr.ht_max}", file=out)
+    print(f"live files:       {len(readers)}", file=out)
+    for fm, r in readers:
+        print(f"  {fm.file_id:06d}.sst entries={r.props.n_entries} "
+              f"bytes={r.props.data_size}", file=out)
+        r.close()
+    return 0
+
+
+def cmd_scan(db_dir: str, limit: int, out) -> int:
+    from yugabyte_tpu.tools.sst_dump import describe_entry
+    _versions, readers = _open_readers(db_dir)
+    shown = 0
+    try:
+        streams = []
+        for _fm, r in readers:
+            streams.append(r.iter_entries())
+        # merged view is for inspection: show per-file streams in file
+        # order (ldb scan shows raw, unresolved entries the same way)
+        for (fm, _r), stream in zip(readers, streams):
+            for key_prefix, dht, value, flags in stream:
+                if shown >= limit:
+                    return 0
+                print(f"[{fm.file_id:06d}] "
+                      f"{describe_entry(key_prefix, dht, value, flags)}",
+                      file=out)
+                shown += 1
+        return 0
+    finally:
+        for _fm, r in readers:
+            r.close()
+
+
+def cmd_get(db_dir: str, key_hex: str, out) -> int:
+    from yugabyte_tpu.tools.sst_dump import describe_entry
+    want = bytes.fromhex(key_hex)
+    _versions, readers = _open_readers(db_dir)
+    found = 0
+    try:
+        for fm, r in readers:
+            if not r.may_contain_doc(want[: len(want)]):
+                pass  # bloom is doc-key based; still scan to be exact
+            for key_prefix, dht, value, flags in r.iter_entries():
+                if key_prefix == want:
+                    print(f"[{fm.file_id:06d}] "
+                          f"{describe_entry(key_prefix, dht, value, flags)}",
+                          file=out)
+                    found += 1
+        print(f"{found} version(s)", file=out)
+        return 0 if found else 1
+    finally:
+        for _fm, r in readers:
+            r.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="ldb")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("scan", "get", "manifest"):
+        p = sub.add_parser(name)
+        p.add_argument("--db", required=True)
+        if name == "scan":
+            p.add_argument("--limit", type=int, default=100)
+        if name == "get":
+            p.add_argument("--key", required=True, help="full subdoc key, hex")
+    args = ap.parse_args(argv)
+    if args.cmd == "manifest":
+        return cmd_manifest(args.db, sys.stdout)
+    if args.cmd == "scan":
+        return cmd_scan(args.db, args.limit, sys.stdout)
+    return cmd_get(args.db, args.key, sys.stdout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""sst_dump: inspect one SST file (ref: rocksdb/tools/sst_dump_tool.cc).
+
+    python -m yugabyte_tpu.tools.sst_dump <base.sst> [--entries N] [--blocks]
+
+Prints props + frontier (+ block index and sample entries), decoding DocDB
+keys into doc-key / subkey / hybrid-time components.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def describe_entry(key_prefix: bytes, dht, value: bytes, flags: int) -> str:
+    from yugabyte_tpu.docdb.doc_key import DocKey
+    from yugabyte_tpu.docdb.value import Value
+    try:
+        dk, pos = DocKey.decode(key_prefix)
+        sub = f" sub={key_prefix[pos:].hex()}" if pos < len(key_prefix) else ""
+        keystr = f"{dk!r}{sub}"
+    except Exception:  # noqa: BLE001 — raw fallback for system keys
+        keystr = key_prefix.hex()
+    try:
+        v = Value.decode(value)
+        if v.is_tombstone:
+            vstr = "<tombstone>"
+        elif v.is_object:
+            vstr = "<object>"
+        else:
+            vstr = repr(v.primitive)
+        if v.ttl_ms:
+            vstr += f" ttl={v.ttl_ms}ms"
+    except Exception:  # noqa: BLE001
+        vstr = value.hex()
+    return (f"{keystr} @ ht={dht.ht.value} wid={dht.write_id} "
+            f"flags={flags:#x} -> {vstr}")
+
+
+def dump(base_path: str, entries: int = 10, blocks: bool = False,
+         out=None) -> int:
+    from yugabyte_tpu.storage.sst import SSTReader
+    out = out or sys.stdout
+    r = SSTReader(base_path)
+    try:
+        p = r.props
+        print(f"file:        {base_path}", file=out)
+        print(f"entries:     {p.n_entries}", file=out)
+        print(f"data_size:   {p.data_size}  base_size: {p.base_size}",
+              file=out)
+        print(f"first_key:   {p.first_key.hex()}", file=out)
+        print(f"last_key:    {p.last_key.hex()}", file=out)
+        print(f"frontier:    op_id={p.frontier.op_id_min}-"
+              f"{p.frontier.op_id_max} ht=[{p.frontier.ht_min}, "
+              f"{p.frontier.ht_max}] cutoff={p.frontier.history_cutoff}",
+              file=out)
+        if p.max_expire_us:
+            print(f"max_expire:  {p.max_expire_us}us (whole-file TTL "
+                  f"droppable)", file=out)
+        print(f"blocks:      {r.n_blocks}", file=out)
+        if blocks:
+            for i, (off, size, n) in enumerate(r.block_handles):
+                print(f"  block {i}: off={off} size={size} n={n} "
+                      f"last={r.index_keys[i].hex()}", file=out)
+        shown = 0
+        for key_prefix, dht, value, flags in r.iter_entries():
+            if shown >= entries:
+                break
+            print(f"  {describe_entry(key_prefix, dht, value, flags)}",
+                  file=out)
+            shown += 1
+        return 0
+    finally:
+        r.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="sst_dump")
+    ap.add_argument("base_path")
+    ap.add_argument("--entries", type=int, default=10)
+    ap.add_argument("--blocks", action="store_true")
+    args = ap.parse_args(argv)
+    return dump(args.base_path, args.entries, args.blocks)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""ysck: cluster consistency checker (ref: src/yb/tools/ysck.cc +
+cluster_verifier.h).
+
+    python -m yugabyte_tpu.tools.ysck --masters host:port[,host:port]
+
+Walks every table: checks tserver liveness, per-tablet leadership, and
+cross-replica checksums at one read time per tablet (the same
+visibility-resolved digest the crash-fault harness asserts on). Exit 0 =
+healthy, 1 = problems found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def check_cluster(master_addrs: List[str], out=None) -> int:
+    from yugabyte_tpu.client.client import YBClient
+    from yugabyte_tpu.utils.status import StatusError
+    out = out or sys.stdout
+    problems = 0
+    client = YBClient(master_addrs)
+    try:
+        tservers = client.list_tservers()
+        dead = [t for t in tservers if not t.get("alive")]
+        print(f"tservers: {len(tservers)} ({len(dead)} dead)", file=out)
+        for t in dead:
+            problems += 1
+            print(f"  DEAD: {t['server_id']} @ {t['addr']}", file=out)
+        for table in client.list_tables():
+            tid = table["table_id"]
+            name = f"{table['namespace']}.{table['name']}"
+            locs = client._master_call("get_table_locations", table_id=tid)
+            bad = 0
+            total_rows = 0
+            for loc in locs:
+                if loc.get("leader") is None:
+                    problems += 1
+                    bad += 1
+                    print(f"  {name}/{loc['tablet_id']}: NO LEADER",
+                          file=out)
+                    continue
+                addrs = [r["addr"] for r in loc["replicas"] if r["addr"]]
+                read_ht = None
+                sums = {}
+                for addr in addrs:
+                    try:
+                        if read_ht is None:
+                            read_ht = client._messenger.call(
+                                addr, "tserver", "scan",
+                                tablet_id=loc["tablet_id"],
+                                limit=1)["read_ht"]
+                        resp = client._messenger.call(
+                            addr, "tserver", "checksum_tablet",
+                            timeout_s=30.0, tablet_id=loc["tablet_id"],
+                            read_ht=read_ht)
+                        sums[addr] = (resp["checksum"], resp["entries"])
+                    except StatusError:
+                        continue  # not the leader for the pin; follower ok
+                if len({c for c, _n in sums.values()}) > 1:
+                    problems += 1
+                    bad += 1
+                    print(f"  {name}/{loc['tablet_id']}: REPLICA "
+                          f"DIVERGENCE {sums}", file=out)
+                elif sums:
+                    total_rows += next(iter(sums.values()))[1]
+            status = "OK" if bad == 0 else f"{bad} bad tablets"
+            print(f"table {name}: {len(locs)} tablets, ~{total_rows} "
+                  f"rows: {status}", file=out)
+        print("ysck: " + ("OK" if problems == 0
+                          else f"{problems} problem(s)"), file=out)
+        return 0 if problems == 0 else 1
+    finally:
+        client.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="ysck")
+    ap.add_argument("--masters", required=True,
+                    help="comma-separated master addresses")
+    args = ap.parse_args(argv)
+    return check_cluster(args.masters.split(","))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

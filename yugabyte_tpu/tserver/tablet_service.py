@@ -205,12 +205,19 @@ class TabletServiceImpl:
 
     # ------------------------------------------------------------------ CDC
     def cdc_get_changes(self, tablet_id: str, from_index: int,
-                        max_records: int = 1000) -> dict:
+                        max_records: int = 1000,
+                        emit_after: Optional[int] = None) -> dict:
         """Change stream for xCluster consumers (ref:
-        ent/src/yb/cdc/cdc_service.cc GetChanges)."""
+        ent/src/yb/cdc/cdc_service.cc GetChanges). The consumer's polled
+        checkpoint anchors WAL retention (cdc_min_replicated_index)."""
         from yugabyte_tpu.cdc.producer import get_changes
         peer = self._leader_peer(tablet_id)
-        records, checkpoint = get_changes(peer, from_index, max_records)
+        cur = getattr(peer, "cdc_retention_index", None)
+        # checkpoints never regress (master-persisted), so max() is safe
+        peer.cdc_retention_index = max(cur if cur is not None else 0,
+                                       from_index)
+        records, checkpoint = get_changes(peer, from_index, max_records,
+                                          emit_after=emit_after)
         return {"records": records, "checkpoint": checkpoint}
 
     # --------------------------------------------------------- index backfill
