@@ -106,6 +106,7 @@ class XClusterPoller:
                     tablet_id=self._source_tablet_id,
                     from_index=self.checkpoint,
                     emit_after=self._applied_through,
+                    stream_id=self.replication_id,
                     max_records=flags.get_flag(
                         "xcluster_max_records_per_poll"))
                 self._leader_addr = addr

@@ -64,9 +64,10 @@ def _encode_entry(e: LogEntry) -> bytes:
 
 
 def _read_segment(path: str) -> Iterator[LogEntry]:
-    """Yield entries; stop silently at a torn/corrupt tail."""
-    with open(path, "rb") as f:
-        data = f.read()
+    """Yield entries; stop silently at a torn/corrupt tail. Reads go
+    through the process Env (transparent decryption at rest)."""
+    from yugabyte_tpu.utils.env import get_env
+    data = get_env().read_file(path)
     off = 0
     while off + _HEADER.size <= len(data):
         crc, plen, term, index = _HEADER.unpack_from(data, off)
@@ -143,14 +144,21 @@ class Log:
         if segs:
             # Re-open the final segment for append; rewrite it first so a
             # torn tail never precedes new records.
+            from yugabyte_tpu.utils.env import get_env, looks_encrypted
             tail = segs[-1]
+            if looks_encrypted(tail) and not get_env().encrypted:
+                # FAIL CLOSED: without keys this segment reads as empty
+                # and the torn-tail rewrite would destroy committed data
+                raise RuntimeError(
+                    f"WAL segment {tail} is encrypted but no universe "
+                    f"keys are loaded; refusing to open")
             entries = list(_read_segment(tail))
-            with open(tail + ".tmp", "wb") as f:
-                for e in entries:
-                    f.write(_encode_entry(e))
+            get_env().write_file(
+                tail + ".tmp",
+                b"".join(_encode_entry(e) for e in entries))
             os.replace(tail + ".tmp", tail)
-            self._file = open(tail, "ab")
-            self._file_size = self._file.tell()
+            self._file = get_env().open_append(tail)
+            self._file_size = self._file.offset
             self._file_first_index = int(os.path.basename(tail)[4:])
 
     # --------------------------------------------------------------- append
@@ -199,14 +207,12 @@ class Log:
             for e in entries:
                 self._ensure_segment(e.index)
                 rec = _encode_entry(e)
-                self._file.write(rec)
+                self._file.append(rec)
                 self._file_size += len(rec)
                 self._last_op_id = e.op_id
             files_to_sync.add(self._file)
         for f in files_to_sync:
-            f.flush()
-            if flags.get_flag("durable_wal_write"):
-                os.fsync(f.fileno())
+            f.flush(fsync=bool(flags.get_flag("durable_wal_write")))
         for _entries, cb in batch:
             if cb:
                 cb()
@@ -214,13 +220,13 @@ class Log:
     def _ensure_segment(self, first_index: int) -> None:
         if (self._file is None or
                 self._file_size >= flags.get_flag("log_segment_size_bytes")):
+            from yugabyte_tpu.utils.env import get_env
             if self._file:
-                self._file.flush()
-                os.fsync(self._file.fileno())
+                self._file.flush(fsync=True)
                 self._file.close()
             path = os.path.join(self.wal_dir, _segment_name(first_index))
-            self._file = open(path, "ab")
-            self._file_size = self._file.tell()
+            self._file = get_env().open_append(path)
+            self._file_size = self._file.offset
             self._file_first_index = first_index
             TRACE("wal: rolled to segment %s", path)
 
@@ -232,21 +238,25 @@ class Log:
         appender batch to drain (callbacks never block on this lock)."""
         with self._cv:
             self._cv.wait_for(lambda: not self._queue and not self._inflight)
+            from yugabyte_tpu.utils.env import get_env, looks_encrypted
             segs = LogReader(self.wal_dir).segments()
             if self._file:
-                self._file.flush()
-                os.fsync(self._file.fileno())
+                self._file.flush(fsync=True)
                 self._file.close()
                 self._file = None
             for seg in reversed(segs):
+                if looks_encrypted(seg) and not get_env().encrypted:
+                    raise RuntimeError(
+                        f"WAL segment {seg} is encrypted but no universe "
+                        f"keys are loaded; refusing to truncate")
                 entries = list(_read_segment(seg))
                 if entries and entries[0].index > index:
                     os.remove(seg)
                     continue
                 kept = [e for e in entries if e.index <= index]
-                with open(seg + ".tmp", "wb") as f:
-                    for e in kept:
-                        f.write(_encode_entry(e))
+                get_env().write_file(
+                    seg + ".tmp",
+                    b"".join(_encode_entry(e) for e in kept))
                 os.replace(seg + ".tmp", seg)
                 break
             segs = LogReader(self.wal_dir).segments()
@@ -255,8 +265,8 @@ class Log:
                 for e in _read_segment(seg):
                     last = e
             if segs:
-                self._file = open(segs[-1], "ab")
-                self._file_size = self._file.tell()
+                self._file = get_env().open_append(segs[-1])
+                self._file_size = self._file.offset
                 self._file_first_index = int(os.path.basename(segs[-1])[4:])
             self._last_op_id = last.op_id if last else (0, 0)
 
@@ -284,7 +294,6 @@ class Log:
             self._cv.notify()
         self._appender.join(timeout=10)
         if self._file:
-            self._file.flush()
-            os.fsync(self._file.fileno())
+            self._file.flush(fsync=True)
             self._file.close()
             self._file = None

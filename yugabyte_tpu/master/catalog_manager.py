@@ -454,6 +454,12 @@ class CatalogManager:
                 resp["replication"] = repl
         except Exception:  # noqa: BLE001 — must never fail heartbeats
             pass
+        try:
+            keys = self.universe_keys_provider()
+            if keys:
+                resp["universe_keys"] = keys
+        except Exception:  # noqa: BLE001 — must never fail heartbeats
+            pass
         return resp
 
     def _adopt_split_child_locked(self, t: dict) -> None:
@@ -509,6 +515,15 @@ class CatalogManager:
                 retired += 1
                 TRACE("catalog: retired split parent %s", tablet_id)
         return retired
+
+    # ------------------------------------------------- encryption at rest
+    # The key material itself lives OUTSIDE the data it encrypts (a
+    # plaintext sidecar on the master, the stand-in for an external KMS —
+    # ref ent/src/yb/master/universe_key_registry_service.cc sourcing keys
+    # out-of-band): storing keys in the sys catalog would be circular on
+    # restart. The Master owns the registry; this provider hook feeds the
+    # heartbeat responses.
+    universe_keys_provider = staticmethod(lambda: [])
 
     # ---------------------------------------------------- xCluster streams
     def setup_universe_replication(self, replication_id: str,

@@ -100,6 +100,15 @@ class MasterService:
             replication_id, tablet_id, index)
         return True
 
+    def rotate_universe_key(self) -> dict:
+        self._leader_catalog()  # leader guard
+        return self._master.rotate_universe_key()
+
+    def get_universe_keys(self) -> List[dict]:
+        # served WITHOUT a leader guard: a restarting tserver must fetch
+        # keys before opening tablets even while the master elects
+        return self._master.universe_keys()
+
     # -------------------------------------------------------------- lookups
     def get_table(self, namespace: str, name: str) -> dict:
         return self._leader_catalog().get_table(namespace, name)
@@ -150,6 +159,11 @@ class Master:
         self.opts = opts
         self.master_id = opts.master_id
         os.makedirs(opts.fs_root, exist_ok=True)
+        # Encryption-at-rest keys load BEFORE any storage opens (the sys
+        # catalog itself may be encrypted); the sidecar file is the KMS
+        # stand-in — key material never lives inside encrypted data.
+        self._keys_path = os.path.join(opts.fs_root, "universe_keys.json")
+        self._universe_keys: List[dict] = self._load_universe_keys()
         self.clock = HybridClock()
         self.messenger = Messenger(f"master-{opts.master_id}",
                                    bind_host=opts.bind_host, port=opts.port)
@@ -162,6 +176,7 @@ class Master:
             os.path.join(opts.fs_root, "sys_catalog"), opts.master_id,
             master_ids, self.transport, clock=self.clock)
         self.catalog = CatalogManager(self.sys_catalog, self.messenger)
+        self.catalog.universe_keys_provider = lambda: self._universe_keys
         self.load_balancer = ClusterLoadBalancer(self.catalog,
                                                  self.messenger)
         self.service = MasterService(self)
@@ -206,6 +221,61 @@ class Master:
         """Multi-master wiring: master_id -> host:port for all peers."""
         with self._addr_lock:
             self._master_addr_map.update(addr_map)
+
+    # ------------------------------------------------- encryption at rest
+    def _load_universe_keys(self) -> List[dict]:
+        import json as _json
+
+        from yugabyte_tpu.utils import env as env_mod
+        if not os.path.exists(self._keys_path):
+            return []
+        with open(self._keys_path) as f:
+            keys = _json.load(f)
+        self._enable_env(keys, env_mod)
+        return keys
+
+    @staticmethod
+    def _enable_env(keys, env_mod) -> None:
+        if not keys:
+            return
+        reg = env_mod.UniverseKeys()
+        for m in keys:
+            reg.add(m["key_id"], bytes.fromhex(m["key"]),
+                    make_latest=bool(m.get("latest")))
+        env_mod.enable_encryption(reg)
+
+    def rotate_universe_key(self) -> dict:
+        """Generate a new universe key, make it latest, persist the sidecar
+        and enable encryption for every NEW storage file; tservers receive
+        the registry via get_universe_keys / heartbeats (ref: the
+        reference's universe key registry, keys sourced out-of-band).
+
+        Key ids are RANDOM so a rotation after losing the sidecar (e.g.
+        master failover without shared storage) can never silently reuse an
+        id with different key material. Multi-master deployments should
+        place the sidecar on shared storage or an external KMS — it is this
+        framework's KMS stand-in and is not replicated by the sys catalog
+        (which it may itself encrypt)."""
+        import json as _json
+        import secrets
+
+        from yugabyte_tpu.utils import env as env_mod
+        key_id = f"uk-{secrets.token_hex(6)}"
+        for m in self._universe_keys:
+            m["latest"] = False
+        self._universe_keys.append({
+            "key_id": key_id, "key": secrets.token_bytes(32).hex(),
+            "latest": True})
+        tmp = self._keys_path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(self._universe_keys, f)
+        os.replace(tmp, self._keys_path)
+        os.chmod(self._keys_path, 0o600)
+        self._enable_env(self._universe_keys, env_mod)
+        return {"key_id": key_id}
+
+    def universe_keys(self) -> List[dict]:
+        return list(self._universe_keys)
 
     def leader_catalog(self) -> CatalogManager:
         """Leader guard used by every service handler."""

@@ -162,7 +162,10 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         return CompactionResult([], dropped_rows, 0)
     if device == "native":
         from yugabyte_tpu.storage import native_engine
-        if native_engine.available():
+        from yugabyte_tpu.utils.env import get_env
+        if native_engine.available() and not get_env().encrypted:
+            # the C++ shell reads/writes raw files; under encryption at
+            # rest the Python shell (which goes through the Env) runs
             result = _run_native_job(inputs, out_dir, new_file_id,
                                      history_cutoff_ht, is_major,
                                      retain_deletes, block_entries,
@@ -330,6 +333,17 @@ def run_compaction_job_device_native(
     from yugabyte_tpu.ops import run_merge
     from yugabyte_tpu.ops.merge_gc import stage_slab
     from yugabyte_tpu.storage import native_engine
+    from yugabyte_tpu.utils.env import get_env
+
+    if get_env().encrypted:
+        # C++ shell bypasses the Env: under encryption take the Env-aware
+        # device path instead
+        return run_compaction_job(inputs, out_dir, new_file_id,
+                                  history_cutoff_ht, is_major,
+                                  retain_deletes, device=device,
+                                  block_entries=block_entries,
+                                  device_cache=device_cache,
+                                  input_ids=input_ids)
 
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None

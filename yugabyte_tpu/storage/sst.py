@@ -117,14 +117,21 @@ class SSTWriter:
         def key_at(i: int) -> bytes:
             return key_raw[i * stride: i * stride + int(slab.key_len[i])]
 
-        with open(data_path, "wb") as df:
+        from yugabyte_tpu.utils.env import get_env
+        if os.path.exists(data_path):
+            os.remove(data_path)  # never append to a stale data file
+        df = get_env().open_append(data_path)
+        try:
             for start in range(0, n, self.block_entries):
                 end = min(start + self.block_entries, n)
                 blk = block_format.encode_block(slab, start, end, self.compress)
-                df.write(blk)
+                df.append(blk)
                 index_items.append((key_at(end - 1), data_off, len(blk),
                                     end - start))
                 data_off += len(blk)
+            df.flush(fsync=True)
+        finally:
+            df.close()
         if n:
             u8 = np.frombuffer(key_raw, dtype=np.uint8).reshape(n, stride)
             hashes = fnv64_masked(u8, slab.doc_key_len.astype(np.int64))
@@ -174,18 +181,17 @@ def write_base_file(base_path: str,
         max_expire_us=max_expire_us,
     )
     props_bytes = json.dumps(props.to_json()).encode()
-    with open(base_path, "wb") as bf:
-        index_off = 0
-        bf.write(index_bytes)
-        bloom_off = bf.tell()
-        bf.write(bloom_bytes)
-        props_off = bf.tell()
-        bf.write(props_bytes)
-        crc = zlib.crc32(index_bytes) ^ zlib.crc32(bloom_bytes) ^ zlib.crc32(props_bytes)
-        bf.write(_FOOTER.pack(index_off, len(index_bytes), bloom_off,
-                              len(bloom_bytes), props_off, len(props_bytes),
-                              data_size, crc, SST_MAGIC))
-        props.base_size = bf.tell()
+    from yugabyte_tpu.utils.env import get_env
+    index_off = 0
+    bloom_off = len(index_bytes)
+    props_off = bloom_off + len(bloom_bytes)
+    crc = zlib.crc32(index_bytes) ^ zlib.crc32(bloom_bytes) ^ zlib.crc32(props_bytes)
+    blob = (index_bytes + bloom_bytes + props_bytes
+            + _FOOTER.pack(index_off, len(index_bytes), bloom_off,
+                           len(bloom_bytes), props_off, len(props_bytes),
+                           data_size, crc, SST_MAGIC))
+    get_env().write_file(base_path, blob)
+    props.base_size = len(blob)
     return props
 
 
@@ -216,11 +222,11 @@ class SSTReader:
     """Random and sequential access to one SST (ref: BlockBasedTable::Open)."""
 
     def __init__(self, base_path: str, block_cache: Optional["BlockCache"] = None):
+        from yugabyte_tpu.utils.env import get_env
         self.base_path = base_path
         self.data_path = data_file_name(base_path)
         self.block_cache = block_cache
-        with open(base_path, "rb") as bf:
-            raw = bf.read()
+        raw = get_env().read_file(base_path)
         if len(raw) < _FOOTER.size:
             raise StatusError(Status.Corruption(f"SST base file too small: {base_path}"))
         (index_off, index_len, bloom_off, bloom_len, props_off, props_len,
@@ -235,14 +241,14 @@ class SSTReader:
         self.index_keys, self.block_handles = _decode_index(index_bytes)
         self.bloom = BloomFilter(bloom_bytes)
         self.props = SSTProps.from_json(json.loads(props_bytes))
-        # Raw fd + os.pread: position-less reads are safe under concurrent
-        # readers (foreground gets race background compaction reads).
-        self._data_fd = os.open(self.data_path, os.O_RDONLY)
+        # Env random-access handle (position-less preads are safe under
+        # concurrent readers; decrypts transparently at rest).
+        self._data = get_env().open_random(self.data_path)
 
     def close(self) -> None:
-        if self._data_fd >= 0:
-            os.close(self._data_fd)
-            self._data_fd = -1
+        if self._data is not None:
+            self._data.close()
+            self._data = None
 
     @property
     def n_blocks(self) -> int:
@@ -254,7 +260,7 @@ class SSTReader:
             if cached is not None:
                 return cached
         off, size, _ = self.block_handles[block_idx]
-        slab = block_format.decode_block(os.pread(self._data_fd, size, off))
+        slab = block_format.decode_block(self._data.pread(size, off))
         if self.block_cache is not None:
             self.block_cache.put((self.base_path, block_idx), slab, size)
         return slab

@@ -70,14 +70,19 @@ def cmd_scan(db_dir: str, limit: int, out) -> int:
 
 
 def cmd_get(db_dir: str, key_hex: str, out) -> int:
+    from yugabyte_tpu.ops.slabs import _doc_key_len
     from yugabyte_tpu.tools.sst_dump import describe_entry
     want = bytes.fromhex(key_hex)
+    try:
+        doc_key = want[: _doc_key_len(want)]
+    except Exception:  # noqa: BLE001 — undecodable key: no bloom skip
+        doc_key = None
     _versions, readers = _open_readers(db_dir)
     found = 0
     try:
         for fm, r in readers:
-            if not r.may_contain_doc(want[: len(want)]):
-                pass  # bloom is doc-key based; still scan to be exact
+            if doc_key is not None and not r.may_contain_doc(doc_key):
+                continue  # bloom proves the doc key is absent here
             for key_prefix, dht, value, flags in r.iter_entries():
                 if key_prefix == want:
                     print(f"[{fm.file_id:06d}] "

@@ -112,6 +112,25 @@ class TabletServer:
         for tablet_id in resp.get("tablets_to_delete") or []:
             self.tablet_manager.delete_tablet(tablet_id)
         self._reconcile_pollers(resp.get("replication") or [])
+        keys = resp.get("universe_keys")
+        if keys:
+            self._apply_universe_keys(keys)
+
+    def _apply_universe_keys(self, keys) -> None:
+        """Encryption at rest: the master ships the key registry via
+        heartbeats; once keys exist, every NEW storage file this process
+        writes is encrypted (old plaintext files stay readable)."""
+        from yugabyte_tpu.utils import env as env_mod
+        known = getattr(self, "_universe_key_ids", set())
+        ids = {m["key_id"] for m in keys}
+        if ids == known:
+            return
+        reg = env_mod.UniverseKeys()
+        for m in keys:
+            reg.add(m["key_id"], bytes.fromhex(m["key"]),
+                    make_latest=bool(m.get("latest")))
+        env_mod.enable_encryption(reg)
+        self._universe_key_ids = ids
 
     # ------------------------------------------------------------- xCluster
     def _reconcile_pollers(self, specs) -> None:
@@ -218,6 +237,11 @@ class TabletServer:
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "TabletServer":
+        # Encryption-at-rest keys must be available BEFORE bootstrap reads
+        # any (possibly encrypted) WAL/SST: fetch the registry from a
+        # master first (unavailable masters: proceed; heartbeats retrofit
+        # the keys, and encrypted tablets simply cannot serve until then).
+        self._fetch_universe_keys()
         self.tablet_manager.open_existing()
         if self.opts.master_addrs:
             # Register before serving so the master knows our address by the
@@ -225,6 +249,46 @@ class TabletServer:
             self.heartbeater.heartbeat_now()
             self.heartbeater.start()
         return self
+
+    def _fetch_universe_keys(self, deadline_s: float = 10.0) -> None:
+        import time as _time
+        if not self.opts.master_addrs:
+            return
+        # only insist on keys when local files actually need them
+        need = self._has_encrypted_files()
+        deadline = _time.monotonic() + deadline_s
+        while _time.monotonic() < deadline:
+            for addr in self.opts.master_addrs:
+                try:
+                    keys = self.messenger.call(addr, "master",
+                                               "get_universe_keys",
+                                               timeout_s=3.0)
+                except Exception:  # noqa: BLE001 — master still starting
+                    continue
+                if keys:
+                    self._apply_universe_keys(keys)
+                    return
+                if not need:
+                    # a keyless universe answered: nothing to wait for
+                    return
+                # an empty reply in an encrypted universe (e.g. a master
+                # without the sidecar): keep asking — bootstrap without
+                # keys cannot read the local data
+            _time.sleep(0.3)
+        if need:
+            from yugabyte_tpu.utils.trace import TRACE
+            TRACE("ts %s: encrypted files present but no universe keys "
+                  "obtained; encrypted tablets will fail closed",
+                  self.server_id)
+
+    def _has_encrypted_files(self) -> bool:
+        from yugabyte_tpu.utils.env import looks_encrypted
+        for dirpath, _dirs, files in os.walk(self.opts.fs_root):
+            for f in files:
+                if f.startswith("wal-") or ".sst" in f:
+                    if looks_encrypted(os.path.join(dirpath, f)):
+                        return True
+        return False
 
     def shutdown(self) -> None:
         with self._addr_lock:

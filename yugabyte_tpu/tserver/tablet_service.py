@@ -206,16 +206,20 @@ class TabletServiceImpl:
     # ------------------------------------------------------------------ CDC
     def cdc_get_changes(self, tablet_id: str, from_index: int,
                         max_records: int = 1000,
-                        emit_after: Optional[int] = None) -> dict:
+                        emit_after: Optional[int] = None,
+                        stream_id: str = "default") -> dict:
         """Change stream for xCluster consumers (ref:
-        ent/src/yb/cdc/cdc_service.cc GetChanges). The consumer's polled
-        checkpoint anchors WAL retention (cdc_min_replicated_index)."""
+        ent/src/yb/cdc/cdc_service.cc GetChanges). WAL retention anchors
+        at the MIN checkpoint across streams (cdc_min_replicated_index):
+        one fast consumer must not let GC eat a slower one's backlog."""
         from yugabyte_tpu.cdc.producer import get_changes
         peer = self._leader_peer(tablet_id)
-        cur = getattr(peer, "cdc_retention_index", None)
-        # checkpoints never regress (master-persisted), so max() is safe
-        peer.cdc_retention_index = max(cur if cur is not None else 0,
-                                       from_index)
+        streams = getattr(peer, "cdc_stream_indexes", None)
+        if streams is None:
+            streams = peer.cdc_stream_indexes = {}
+        # per-stream checkpoints never regress (master-persisted)
+        streams[stream_id] = max(streams.get(stream_id, 0), from_index)
+        peer.cdc_retention_index = min(streams.values())
         records, checkpoint = get_changes(peer, from_index, max_records,
                                           emit_after=emit_after)
         return {"records": records, "checkpoint": checkpoint}

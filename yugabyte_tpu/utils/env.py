@@ -1,0 +1,272 @@
+"""Env: the storage-file abstraction, with transparent encryption at rest.
+
+Capability parity with the reference's Env + encrypted file layer (ref:
+src/yb/util/env.h; src/yb/encryption/encrypted_file.cc — every data file
+gets a random DATA KEY, wrapped by the cluster-wide UNIVERSE KEY and
+stored in a file header; AES-CTR keyed per file allows random-access
+reads). The storage engine's byte paths (SST data/base files, WAL
+segments) go through the process Env; the plaintext Env is a thin passthru
+and the encrypted Env wraps the same operations.
+
+Header layout of an encrypted file:
+    b"YBENCv1\\0" | u16 key_id_len | key_id | 16B nonce | 32B wrapped key
+Body bytes at logical offset L live at physical offset header_len + L,
+encrypted with AES-CTR(data_key, nonce) at counter position L — so pread
+at any offset decrypts exactly the requested range.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+_MAGIC = b"YBENCv1\x00"
+
+
+def _ctr_cipher(key: bytes, nonce: bytes, byte_offset: int = 0):
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher, algorithms, modes)
+    # advance the 128-bit counter to the block containing byte_offset
+    blocks = byte_offset // 16
+    ctr = (int.from_bytes(nonce, "big") + blocks) % (1 << 128)
+    c = Cipher(algorithms.AES(key),
+               modes.CTR(ctr.to_bytes(16, "big"))).encryptor()
+    skip = byte_offset % 16
+    if skip:
+        c.update(b"\x00" * skip)  # discard partial leading block
+    return c
+
+
+class Env:
+    """Plaintext passthru (the default)."""
+
+    encrypted = False
+
+    # ---------------------------------------------------------- whole file
+    def read_file(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_file(self, path: str, data: bytes) -> None:
+        with open(path, "wb") as f:
+            f.write(data)
+
+    # ------------------------------------------------------- random access
+    def open_random(self, path: str) -> "RandomAccessFile":
+        return RandomAccessFile(path)
+
+    # -------------------------------------------------------------- append
+    def open_append(self, path: str) -> "AppendFile":
+        return AppendFile(path)
+
+
+class RandomAccessFile:
+    def __init__(self, path: str):
+        self._fd = os.open(path, os.O_RDONLY)
+
+    def pread(self, size: int, offset: int) -> bytes:
+        return os.pread(self._fd, size, offset)
+
+    def size(self) -> int:
+        return os.fstat(self._fd).st_size
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+
+class AppendFile:
+    def __init__(self, path: str):
+        self._f = open(path, "ab")
+
+    @property
+    def offset(self) -> int:
+        return self._f.tell()
+
+    def append(self, data: bytes) -> None:
+        self._f.write(data)
+
+    def flush(self, fsync: bool = True) -> None:
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# ---------------------------------------------------------------- encrypted
+class UniverseKeys:
+    """In-process registry of universe keys (master-distributed;
+    ref ent/src/yb/master/universe_key_registry_service.cc)."""
+
+    def __init__(self):
+        self._keys: Dict[str, bytes] = {}
+        self._latest: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def add(self, key_id: str, key: bytes, make_latest: bool = True) -> None:
+        assert len(key) == 32, "universe keys are AES-256"
+        with self._lock:
+            self._keys[key_id] = key
+            if make_latest or self._latest is None:
+                self._latest = key_id
+
+    def get(self, key_id: str) -> bytes:
+        with self._lock:
+            key = self._keys.get(key_id)
+        if key is None:
+            raise KeyError(f"universe key {key_id!r} not available")
+        return key
+
+    def latest(self) -> Tuple[str, bytes]:
+        with self._lock:
+            if self._latest is None:
+                raise KeyError("no universe key configured")
+            return self._latest, self._keys[self._latest]
+
+
+class EncryptedEnv(Env):
+    encrypted = True
+
+    def __init__(self, keys: UniverseKeys):
+        self.keys = keys
+
+    # ------------------------------------------------------------- header
+    def _new_header(self) -> Tuple[bytes, bytes]:
+        key_id, ukey = self.keys.latest()
+        nonce = secrets.token_bytes(16)
+        data_key = secrets.token_bytes(32)
+        wrapped = _ctr_cipher(ukey, nonce).update(data_key)
+        kid = key_id.encode()
+        header = (_MAGIC + struct.pack("<H", len(kid)) + kid + nonce
+                  + wrapped)
+        return header, (data_key, nonce)
+
+    def _read_header(self, blob: bytes) -> Tuple[int, bytes, bytes]:
+        """-> (header_len, data_key, nonce)."""
+        if blob[: len(_MAGIC)] != _MAGIC:
+            raise ValueError("not an encrypted file")
+        (kid_len,) = struct.unpack_from("<H", blob, len(_MAGIC))
+        p = len(_MAGIC) + 2
+        key_id = blob[p: p + kid_len].decode()
+        p += kid_len
+        nonce = blob[p: p + 16]
+        wrapped = blob[p + 16: p + 48]
+        ukey = self.keys.get(key_id)
+        data_key = _ctr_cipher(ukey, nonce).update(wrapped)
+        return p + 48, data_key, nonce
+
+    # ---------------------------------------------------------- whole file
+    def read_file(self, path: str) -> bytes:
+        blob = super().read_file(path)
+        if blob[: len(_MAGIC)] != _MAGIC:
+            return blob  # legacy plaintext file (pre-encryption enable)
+        hlen, data_key, nonce = self._read_header(blob)
+        return _ctr_cipher(data_key, nonce).update(blob[hlen:])
+
+    def write_file(self, path: str, data: bytes) -> None:
+        header, (data_key, nonce) = self._new_header()
+        super().write_file(
+            path, header + _ctr_cipher(data_key, nonce).update(data))
+
+    # ------------------------------------------------------- random access
+    def open_random(self, path: str):
+        raw = RandomAccessFile(path)
+        head = raw.pread(len(_MAGIC), 0)
+        if head != _MAGIC:
+            return raw  # legacy plaintext file
+        raw.close()
+        return EncryptedRandomAccessFile(self, path)
+
+    def open_append(self, path: str):
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            with open(path, "rb") as f:
+                if f.read(len(_MAGIC)) != _MAGIC:
+                    return AppendFile(path)  # continue a legacy file
+        return EncryptedAppendFile(self, path)
+
+
+class EncryptedRandomAccessFile:
+    def __init__(self, env: EncryptedEnv, path: str):
+        self._raw = RandomAccessFile(path)
+        head = self._raw.pread(4096, 0)
+        self._hlen, self._key, self._nonce = env._read_header(head)
+
+    def pread(self, size: int, offset: int) -> bytes:
+        enc = self._raw.pread(size, self._hlen + offset)
+        return _ctr_cipher(self._key, self._nonce, offset).update(enc)
+
+    def size(self) -> int:
+        return self._raw.size() - self._hlen
+
+    def close(self) -> None:
+        self._raw.close()
+
+
+class EncryptedAppendFile:
+    def __init__(self, env: EncryptedEnv, path: str):
+        exists = os.path.exists(path) and os.path.getsize(path) > 0
+        if exists:
+            with open(path, "rb") as f:
+                head = f.read(4096)
+            self._hlen, key, nonce = env._read_header(head)
+            self._f = open(path, "ab")
+            start = self._f.tell() - self._hlen
+        else:
+            header, (key, nonce) = env._new_header()
+            self._hlen = len(header)
+            self._f = open(path, "wb")
+            self._f.write(header)
+            start = 0
+        self._key, self._nonce = key, nonce
+        self._cipher = _ctr_cipher(key, nonce, start)
+
+    @property
+    def offset(self) -> int:
+        return self._f.tell() - self._hlen
+
+    def append(self, data: bytes) -> None:
+        self._f.write(self._cipher.update(data))
+
+    def flush(self, fsync: bool = True) -> None:
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def looks_encrypted(path: str) -> bool:
+    """True if the file carries the encrypted-file header."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(_MAGIC)) == _MAGIC
+    except OSError:
+        return False
+
+
+# ------------------------------------------------------------ process env
+_env: Env = Env()
+
+
+def get_env() -> Env:
+    return _env
+
+
+def set_env(env: Env) -> None:
+    global _env
+    _env = env
+
+
+def enable_encryption(keys: UniverseKeys) -> None:
+    set_env(EncryptedEnv(keys))
+
+
+def disable_encryption() -> None:
+    set_env(Env())
