@@ -1,0 +1,222 @@
+"""MemTracker tree + TabletMemoryManager arbitration.
+
+Covers the reference semantics: consumption propagates to ancestors
+(mem_tracker.h:87-98), TryConsume enforces every limit on the chain and
+invokes GarbageCollectors before rejecting (mem_tracker.cc LimitExceeded),
+soft-limit backpressure (mem_tracker.cc:557), and the global-memstore
+arbiter flushing the tablet with the oldest mutable write
+(tablet_memory_manager.cc:214-283).
+"""
+
+import time
+
+import pytest
+
+from yugabyte_tpu.utils.mem_tracker import (
+    MemTracker, ScopedTrackedConsumption, reset_root_for_tests, root_tracker)
+from yugabyte_tpu.tserver.tablet_memory_manager import TabletMemoryManager
+from yugabyte_tpu.utils import flags
+
+
+# --------------------------------------------------------------- MemTracker
+
+def test_consumption_propagates_to_ancestors():
+    root = MemTracker(0, "r")
+    mid = MemTracker(0, "m", parent=root)
+    leaf = MemTracker(0, "l", parent=mid)
+    leaf.consume(100)
+    mid.consume(50)
+    assert leaf.consumption() == 100
+    assert mid.consumption() == 150
+    assert root.consumption() == 150
+    leaf.release(40)
+    assert root.consumption() == 110
+    assert leaf.peak_consumption() == 100
+
+
+def test_try_consume_enforces_chain_limits():
+    root = MemTracker(1000, "r")
+    child = MemTracker(0, "c", parent=root)   # unlimited child
+    assert child.try_consume(900)
+    # child has no limit, but the parent's 1000 still binds
+    assert not child.try_consume(200)
+    assert child.consumption() == 900
+    assert child.try_consume(100)
+    assert root.consumption() == 1000
+
+
+def test_gc_invoked_before_rejection():
+    cache = {"used": 800}
+    tracker = MemTracker(1000, "cache",
+                         consumption_fn=lambda: cache["used"])
+
+    def gc(required):
+        cache["used"] = max(0, cache["used"] - max(required, 500))
+
+    tracker.add_gc_function(gc)
+    # 800 + 300 > 1000 -> GC frees, then fits
+    assert tracker.try_consume(300)
+    assert cache["used"] <= 700
+
+
+def test_soft_limit():
+    flags.set_flag("memory_limit_soft_percentage", 85)
+    t = MemTracker(1000, "t")
+    t.consume(800)
+    r = t.soft_limit_exceeded()
+    assert not r.exceeded and r.current_capacity_pct == pytest.approx(0.8)
+    t.consume(100)
+    assert t.soft_limit_exceeded().exceeded
+
+
+def test_scoped_consumption_and_unregister():
+    root = MemTracker(0, "r")
+    child = root.find_or_create_child("c")
+    with ScopedTrackedConsumption(child, 64):
+        assert root.consumption() == 64
+    assert root.consumption() == 0
+    child.consume(10)
+    child.unregister_from_parent()
+    assert root.consumption() == 0          # subtree tally released
+    assert root.find_child("c") is None
+    # a new same-id child may now be created (ref mem_tracker.h:100-105)
+    again = root.find_or_create_child("c")
+    assert again is not child
+
+
+def test_root_tracker_reads_rss():
+    reset_root_for_tests()
+    r = root_tracker()
+    assert r.consumption() > 0              # live process RSS
+    assert r.limit > 0
+    assert root_tracker() is r
+    sub = r.find_or_create_child("x")
+    assert "x" in r.log_usage()
+    j = r.tree_json()
+    assert any(c["id"] == "x" for c in j["children"])
+    sub.unregister_from_parent()
+
+
+# ------------------------------------------------------ TabletMemoryManager
+
+class FakeTablet:
+    def __init__(self, tablet_id, nbytes, first_write_s):
+        self.tablet_id = tablet_id
+        self._bytes = nbytes
+        self._first = first_write_s
+        self.flushes = 0
+
+    def memstore_bytes(self):
+        return self._bytes
+
+    def oldest_memstore_write_s(self):
+        return self._first if self._bytes else None
+
+    def flush(self):
+        self.flushes += 1
+        self._bytes = 0
+        self._first = None
+
+
+class FakePeer:
+    def __init__(self, tablet):
+        self.tablet = tablet
+
+
+def _mgr(peers, **kw):
+    root = MemTracker(1 << 40, "test_root")
+    return TabletMemoryManager(lambda: peers, server_tracker=root,
+                               server_id="t0", **kw)
+
+
+def test_arbiter_flushes_oldest_first():
+    now = time.monotonic()
+    old = FakeTablet("old", 600, now - 10)
+    new = FakeTablet("new", 600, now)
+    peers = [FakePeer(new), FakePeer(old)]
+    flags.set_flag("global_memstore_limit_bytes", 1000)
+    try:
+        m = _mgr(peers)
+        seen = []
+        m.flush_listeners.append(seen.append)
+        flushed = m.flush_tablet_if_limit_exceeded()
+        # 1200 > 1000: one flush (the OLDEST) brings it to 600 <= 1000
+        assert flushed == 1
+        assert old.flushes == 1 and new.flushes == 0
+        assert seen == ["old"]
+    finally:
+        flags.set_flag("global_memstore_limit_bytes", 0)
+
+
+def test_arbiter_noop_under_limit():
+    t = FakeTablet("a", 10, time.monotonic())
+    flags.set_flag("global_memstore_limit_bytes", 1000)
+    try:
+        m = _mgr([FakePeer(t)])
+        assert m.flush_tablet_if_limit_exceeded() == 0
+        assert t.flushes == 0
+    finally:
+        flags.set_flag("global_memstore_limit_bytes", 0)
+
+
+def test_arbiter_flushes_until_under_limit():
+    now = time.monotonic()
+    tablets = [FakeTablet(f"t{i}", 500, now + i) for i in range(4)]
+    flags.set_flag("global_memstore_limit_bytes", 900)
+    try:
+        m = _mgr([FakePeer(t) for t in tablets])
+        flushed = m.flush_tablet_if_limit_exceeded()
+        # 2000 -> flush t0 (1500) -> t1 (1000) -> t2 (500 <= 900): 3 flushes
+        assert flushed == 3
+        assert [t.flushes for t in tablets] == [1, 1, 1, 0]
+    finally:
+        flags.set_flag("global_memstore_limit_bytes", 0)
+
+
+def test_block_cache_gc_registered():
+    from yugabyte_tpu.storage.sst import BlockCache
+    bc = BlockCache(capacity_bytes=1000)
+
+    class Slab:
+        pass
+
+    bc.put("a", Slab(), 400)
+    bc.put("b", Slab(), 400)
+    m = _mgr([], block_cache=bc)
+    assert m.block_cache_tracker.consumption() == 800
+    # driving the tracker over its limit evicts LRU entries
+    m.block_cache_tracker._gc(500)
+    assert bc.used <= 400
+
+
+def test_memtable_and_db_report_oldest_write(tmp_path):
+    from yugabyte_tpu.storage.db import DB
+    from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+    db = DB(str(tmp_path / "db"))
+    assert db.memstore_bytes() == 0
+    assert db.oldest_memstore_write_s() is None
+    db.write_batch([(b"k1", DocHybridTime(HybridTime(100), 0), b"v1")])
+    t0 = db.oldest_memstore_write_s()
+    assert db.memstore_bytes() > 0 and t0 is not None
+    db.write_batch([(b"k2", DocHybridTime(HybridTime(101), 0), b"v2")])
+    assert db.oldest_memstore_write_s() == t0   # first write wins
+    db.flush()
+    assert db.memstore_bytes() == 0
+    assert db.oldest_memstore_write_s() is None
+    db.close()
+
+
+def test_tablet_server_owns_memory_manager(tmp_path):
+    """The live TabletServer wires the arbiter + /memz tracker tree."""
+    from yugabyte_tpu.tserver.tablet_server import (
+        TabletServer, TabletServerOptions)
+    ts = TabletServer(TabletServerOptions(
+        server_id="ts-mm", fs_root=str(tmp_path / "fs"), port=0,
+        master_addrs=[],
+        tablet_options_factory=lambda: None))
+    try:
+        assert ts.memory_manager is not None
+        assert ts.memory_manager.memstore_tracker.limit > 0
+        assert ts.memory_manager.flush_tablet_if_limit_exceeded() == 0
+    finally:
+        ts.shutdown()

@@ -84,6 +84,22 @@ class DB:
         for fm in self.versions.live_files():
             self._readers[fm.file_id] = SSTReader(fm.path, self.opts.block_cache)
 
+    def memstore_bytes(self) -> int:
+        """Mutable + flushing memtable bytes (global-memstore arbitration)."""
+        with self._lock:
+            total = self.mem.approximate_bytes
+            if self._imm is not None:
+                total += self._imm.approximate_bytes
+            return total
+
+    def oldest_memstore_write_s(self) -> Optional[float]:
+        with self._lock:
+            times = [self.mem.oldest_write_s]
+            if self._imm is not None:
+                times.append(self._imm.oldest_write_s)
+        times = [t for t in times if t is not None]
+        return min(times) if times else None
+
     def approx_entry_count(self) -> int:
         """Cheap emptiness probe (used to skip the intent overlay on
         intent-free tablets). Zero means definitely empty."""

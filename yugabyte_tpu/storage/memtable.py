@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from yugabyte_tpu.common.hybrid_time import DocHybridTime
@@ -32,6 +33,10 @@ class MemTable:
         self._sorted_upto = 0
         self._bytes = 0
         self._lock = threading.Lock()
+        # monotonic time of the first write — the global-memstore arbiter
+        # flushes the tablet holding the OLDEST mutable data first
+        # (ref: tserver/tablet_memory_manager.cc TabletToFlush)
+        self._first_write_s: Optional[float] = None
 
     def add(self, key_prefix: bytes, dht: DocHybridTime, value: bytes) -> None:
         ikey = make_internal_key(key_prefix, dht)
@@ -40,6 +45,12 @@ class MemTable:
                 self._keys.append(ikey)
             self._data[ikey] = value
             self._bytes += len(ikey) + len(value)
+            if self._first_write_s is None:
+                self._first_write_s = time.monotonic()
+
+    @property
+    def oldest_write_s(self) -> Optional[float]:
+        return self._first_write_s
 
     @property
     def n_entries(self) -> int:

@@ -538,6 +538,16 @@ class Tablet:
                                   entry_stream=stream)
 
     # ------------------------------------------------------------ maintenance
+    def memstore_bytes(self) -> int:
+        return (self.regular_db.memstore_bytes()
+                + self.intents_db.memstore_bytes())
+
+    def oldest_memstore_write_s(self):
+        times = [self.regular_db.oldest_memstore_write_s(),
+                 self.intents_db.oldest_memstore_write_s()]
+        times = [t for t in times if t is not None]
+        return min(times) if times else None
+
     def flush(self) -> None:
         self.regular_db.flush()
         self.intents_db.flush()

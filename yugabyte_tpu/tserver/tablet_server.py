@@ -82,6 +82,17 @@ class TabletServer:
             self.messenger, opts.master_addrs, opts.server_id, self.address,
             report_provider=self.tablet_manager.generate_report,
             on_response=self._handle_heartbeat_response)
+        # Server-wide memory arbitration: global memstore limit + cache GC
+        # under one tracker tree (ref: tserver/tablet_memory_manager.h:39).
+        from yugabyte_tpu.tserver.tablet_memory_manager import (
+            TabletMemoryManager)
+        from yugabyte_tpu.utils.mem_tracker import root_tracker
+        self.memory_manager = TabletMemoryManager(
+            peers_fn=self._tablet_peers,
+            block_cache=(self.exec_context.block_cache
+                         if self.exec_context is not None else None),
+            metric_entity=self.metrics.entity("server", "memory"),
+            server_id=opts.server_id)
         self.webserver = None
         if opts.webserver_port is not None:
             from yugabyte_tpu.server.webserver import Webserver
@@ -90,6 +101,11 @@ class TabletServer:
             self.webserver.register_json("/status", self._status_page)
             self.webserver.register_json(
                 "/tablets", self.tablet_manager.generate_report)
+            self.webserver.register_json(
+                "/memz", lambda: root_tracker().tree_json())
+
+    def _tablet_peers(self):
+        return self.tablet_manager.peers()
 
     def _status_page(self) -> dict:
         if self.exec_context is not None:
@@ -243,6 +259,7 @@ class TabletServer:
         # the keys, and encrypted tablets simply cannot serve until then).
         self._fetch_universe_keys()
         self.tablet_manager.open_existing()
+        self.memory_manager.init()
         if self.opts.master_addrs:
             # Register before serving so the master knows our address by the
             # time it places tablets here.
@@ -297,6 +314,7 @@ class TabletServer:
         for p in pollers:
             p.stop()
         self.heartbeater.stop()
+        self.memory_manager.shutdown()
         if self.webserver is not None:
             self.webserver.shutdown()
         self.tablet_manager.shutdown()

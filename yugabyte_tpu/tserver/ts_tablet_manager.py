@@ -339,6 +339,11 @@ class TSTabletManager:
                 f"tablet {tablet_id} not hosted on {self.server_id}"))
         return peer
 
+    def peers(self) -> List[TabletPeer]:
+        """Atomic snapshot of all hosted peers (memory arbiter, reports)."""
+        with self._lock:
+            return list(self._tablets.values())
+
     def tablet_ids(self) -> List[str]:
         with self._lock:
             return list(self._tablets)
