@@ -1,0 +1,199 @@
+"""Device-resident write-through: compaction outputs staged from HBM.
+
+run_compaction_job_device_native's write-through must stage the output
+files by gathering the surviving columns ON DEVICE (ops/run_merge.py
+_gather_staged_output) — the staged entries must be indistinguishable from
+host restaging (stage_slab over SSTReader.read_all()) for everything a
+later merge reads, and a chained second compaction consuming the cache
+entries must keep exactly what a from-disk compaction keeps.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from yugabyte_tpu.ops.merge_gc import _ROW_WORDS, stage_slab
+from yugabyte_tpu.ops.slabs import ValueArray
+from yugabyte_tpu.storage import compaction as compaction_mod
+from yugabyte_tpu.storage import native_engine
+from yugabyte_tpu.storage.device_cache import DeviceSlabCache
+from yugabyte_tpu.storage.sst import Frontier, SSTReader, SSTWriter
+from yugabyte_tpu.utils import flags
+
+pytestmark = pytest.mark.skipif(not native_engine.available(),
+                                reason="native engine unavailable")
+
+
+def _mk_run(rng, n, key_space, value_bytes=16, ttl_frac=0.0):
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_run_merge import _make_run
+    slab = _make_run(rng, n, key_space, ttl_frac=ttl_frac)
+    data = rng.integers(0, 256, size=n * value_bytes, dtype=np.uint8)
+    offs = np.arange(n + 1, dtype=np.int64) * value_bytes
+    slab.values = ValueArray(data, offs)
+    return slab
+
+
+def _write_runs(workdir, runs):
+    readers = []
+    for i, slab in enumerate(runs):
+        p = os.path.join(workdir, f"in{i:03d}.sst")
+        SSTWriter(p).write(slab, Frontier())
+        readers.append(SSTReader(p))
+    return readers
+
+
+def _device():
+    import jax
+    return jax.devices()[0]
+
+
+def _run_device_native(readers, out_dir, cutoff, cache, input_ids,
+                       first_id=100):
+    os.makedirs(out_dir, exist_ok=True)
+    ids = iter(range(first_id, first_id + 500))
+    return compaction_mod.run_compaction_job_device_native(
+        readers, out_dir, lambda: next(ids), cutoff, True,
+        device=_device(), device_cache=cache, input_ids=input_ids)
+
+
+CUTOFF = (10_000_000 << 12)
+
+
+def test_staged_output_matches_host_restage(tmp_path):
+    rng = np.random.default_rng(11)
+    runs = [_mk_run(rng, 800, 500) for _ in range(3)]
+    readers = _write_runs(str(tmp_path), runs)
+    cache = DeviceSlabCache(device=_device())
+    ids = list(range(len(readers)))
+    for fid, r in zip(ids, readers):
+        cache.stage(fid, r.read_all())
+    res = _run_device_native(readers, str(tmp_path / "out"), CUTOFF,
+                            cache, ids)
+    assert res.outputs, "compaction produced no outputs"
+    for fid, base_path, _props in res.outputs:
+        dev_staged = cache.get(fid)
+        assert dev_staged is not None, "write-through missed the cache"
+        rdr = SSTReader(base_path)
+        host_staged = stage_slab(rdr.read_all())
+        rdr.close()
+        assert dev_staged.n == host_staged.n
+        dev_cols = np.asarray(dev_staged.cols_dev)
+        host_cols = np.asarray(host_staged.cols_dev)
+        n = host_staged.n
+        r_common = min(dev_cols.shape[0], host_cols.shape[0])
+        np.testing.assert_array_equal(
+            dev_cols[:r_common, :n], host_cols[:r_common, :n],
+            err_msg="device-staged columns differ from host restage")
+        # any extra device rows are key-word padding and must be zero
+        if dev_cols.shape[0] > r_common:
+            assert (dev_cols[r_common:, :n] == 0).all()
+        # padding columns must carry the pad template (sort to tail)
+        from yugabyte_tpu.ops.merge_gc import pad_template
+        if dev_staged.n_pad > n:
+            pt = pad_template(dev_cols.shape[0])
+            np.testing.assert_array_equal(
+                dev_cols[:, n:], np.tile(pt[:, None], (1, dev_staged.n_pad - n)))
+
+
+def test_ttl_rewrite_flag_mirrored(tmp_path):
+    """TTL-expired survivors written as tombstones must carry the
+    tombstone flag in the device-staged entry too (non-major keeps them)."""
+    rng = np.random.default_rng(12)
+    runs = [_mk_run(rng, 600, 400, ttl_frac=0.5) for _ in range(2)]
+    readers = _write_runs(str(tmp_path), runs)
+    cache = DeviceSlabCache(device=_device())
+    ids = list(range(len(readers)))
+    for fid, r in zip(ids, readers):
+        cache.stage(fid, r.read_all())
+    os.makedirs(str(tmp_path / "out"), exist_ok=True)
+    idgen = iter(range(10, 500))
+    res = compaction_mod.run_compaction_job_device_native(
+        readers, str(tmp_path / "out"), lambda: next(idgen), CUTOFF,
+        False,  # non-major: TTL expiry rewrites values as tombstones
+        device=_device(), device_cache=cache, input_ids=ids)
+    for fid, base_path, _props in res.outputs:
+        dev_cols = np.asarray(cache.get(fid).cols_dev)
+        rdr = SSTReader(base_path)
+        host_staged = stage_slab(rdr.read_all())
+        rdr.close()
+        host_cols = np.asarray(host_staged.cols_dev)
+        r_common = min(dev_cols.shape[0], host_cols.shape[0])
+        np.testing.assert_array_equal(dev_cols[:r_common, :host_staged.n],
+                                      host_cols[:r_common, :host_staged.n])
+
+
+def test_chained_compaction_from_cache(tmp_path):
+    """Second compaction consuming device-staged outputs == from-disk."""
+    rng = np.random.default_rng(13)
+    runs_a = [_mk_run(rng, 700, 450) for _ in range(2)]
+    runs_b = [_mk_run(rng, 700, 450) for _ in range(2)]
+    cache = DeviceSlabCache(device=_device())
+
+    readers_a = _write_runs(str(tmp_path / "a"), runs_a) \
+        if os.makedirs(str(tmp_path / "a")) is None else None
+    readers_b = _write_runs(str(tmp_path / "b"), runs_b) \
+        if os.makedirs(str(tmp_path / "b")) is None else None
+    for fid, r in zip((0, 1), readers_a):
+        cache.stage(fid, r.read_all())
+    for fid, r in zip((2, 3), readers_b):
+        cache.stage(fid, r.read_all())
+
+    res_a = _run_device_native(readers_a, str(tmp_path / "oa"), CUTOFF,
+                               cache, [0, 1], first_id=100)
+    res_b = _run_device_native(readers_b, str(tmp_path / "ob"), CUTOFF,
+                               cache, [2, 3], first_id=200)
+
+    # L1: compact the two outputs together, inputs from the cache
+    l1_readers = [SSTReader(p) for _, p, _ in res_a.outputs + res_b.outputs]
+    l1_ids = [fid for fid, _, _ in res_a.outputs + res_b.outputs]
+    res_l1 = _run_device_native(l1_readers, str(tmp_path / "l1"), CUTOFF,
+                                cache, l1_ids, first_id=300)
+
+    # reference: same L1 compaction fully from disk, no cache
+    os.makedirs(str(tmp_path / "l1ref"))
+    ids = iter(range(400, 500))
+    ref = compaction_mod.run_compaction_job(
+        l1_readers, str(tmp_path / "l1ref"), lambda: next(ids), CUTOFF,
+        True, device="native")
+    assert res_l1.rows_out == ref.rows_out
+    # outputs must be byte-identical
+    for (_, b1, _), (_, b2, _) in zip(res_l1.outputs, ref.outputs):
+        with open(b1 + ".sblock.0", "rb") as f1, \
+                open(b2 + ".sblock.0", "rb") as f2:
+            assert f1.read() == f2.read()
+    for r in l1_readers + readers_a + readers_b:
+        r.close()
+
+
+def test_multi_file_split_ranges(tmp_path):
+    """File splits: each cache entry covers exactly its file's rows."""
+    rng = np.random.default_rng(14)
+    runs = [_mk_run(rng, 900, 4000) for _ in range(2)]  # few dups: big out
+    readers = _write_runs(str(tmp_path), runs)
+    cache = DeviceSlabCache(device=_device())
+    ids = [0, 1]
+    for fid, r in zip(ids, readers):
+        cache.stage(fid, r.read_all())
+    old = flags.get_flag("compaction_max_output_entries_per_sst")
+    flags.set_flag("compaction_max_output_entries_per_sst", 500)
+    try:
+        res = _run_device_native(readers, str(tmp_path / "out"), CUTOFF,
+                                 cache, ids)
+        assert len(res.outputs) >= 2, "expected a multi-file split"
+        for fid, base_path, props in res.outputs:
+            dev_staged = cache.get(fid)
+            rdr = SSTReader(base_path)
+            host_staged = stage_slab(rdr.read_all())
+            rdr.close()
+            assert dev_staged.n == host_staged.n == props.n_entries
+            dev_cols = np.asarray(dev_staged.cols_dev)
+            host_cols = np.asarray(host_staged.cols_dev)
+            r_common = min(dev_cols.shape[0], host_cols.shape[0])
+            np.testing.assert_array_equal(
+                dev_cols[:r_common, :host_staged.n],
+                host_cols[:r_common, :host_staged.n])
+    finally:
+        flags.set_flag("compaction_max_output_entries_per_sst", old)

@@ -60,13 +60,17 @@ def test_gc_invoked_before_rejection():
 
 
 def test_soft_limit():
+    old = flags.get_flag("memory_limit_soft_percentage")
     flags.set_flag("memory_limit_soft_percentage", 85)
-    t = MemTracker(1000, "t")
-    t.consume(800)
-    r = t.soft_limit_exceeded()
-    assert not r.exceeded and r.current_capacity_pct == pytest.approx(0.8)
-    t.consume(100)
-    assert t.soft_limit_exceeded().exceeded
+    try:
+        t = MemTracker(1000, "t")
+        t.consume(800)
+        r = t.soft_limit_exceeded()
+        assert not r.exceeded and r.current_capacity_pct == pytest.approx(0.8)
+        t.consume(100)
+        assert t.soft_limit_exceeded().exceeded
+    finally:
+        flags.set_flag("memory_limit_soft_percentage", old)
 
 
 def test_scoped_consumption_and_unregister():

@@ -146,7 +146,10 @@ def _merge_gc_runs_fused(cols, cmp_rows,
         zeros = jnp.zeros_like(groups[0])
         for _ in range(b):
             groups.append(zeros)
-    return jnp.stack(groups, axis=1)  # [n//32, 2+b]
+    # perm/keep/make_tomb stay DEVICE-resident: only `packed` is ever
+    # downloaded; the others feed the zero-transfer output staging gather
+    # (_gather_staged_output) so write-through never re-uploads columns
+    return jnp.stack(groups, axis=1), perm, keep, make_tomb
 
 
 @dataclass
@@ -281,9 +284,14 @@ class MergeGCHandle:
     max(compute, transfer), not their sum.
     """
 
-    def __init__(self, packed_dev, staged: StagedRuns):
+    def __init__(self, packed_dev, staged: StagedRuns,
+                 perm_dev=None, keep_dev=None, mk_dev=None):
         self._packed_dev = packed_dev
         self._staged = staged
+        # device-resident merge products for zero-transfer output staging
+        self._perm_dev = perm_dev
+        self._keep_dev = keep_dev
+        self._mk_dev = mk_dev
         try:
             packed_dev.copy_to_host_async()
         except (AttributeError, NotImplementedError):
@@ -328,18 +336,93 @@ def _unpack_words(words: np.ndarray, n: int) -> np.ndarray:
     return _unpack_bits(np.ascontiguousarray(words), n)
 
 
+@jax.jit
+def _survivor_positions(keep):
+    """Merged positions of all survivors, padded with n_pad-1 (a padding
+    row: padding sorts to the tail and is never kept, so n_pad-1 is only a
+    real row when NOTHING was padded AND it survived — in which case it is
+    a valid filler that sits beyond every real survivor index anyway)."""
+    n_pad = keep.shape[0]
+    return jnp.nonzero(keep, size=n_pad, fill_value=n_pad - 1)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("n_out_pad",))
+def _gather_staged_output(cols, perm, pos_all, mk, start, end,
+                          n_out_pad: int):
+    """Gather survivors [start, end) of the merged order into a padded
+    StagedCols matrix — entirely on device.
+
+    This is the write-through path for the HBM slab cache: compaction
+    outputs become the next compaction's inputs WITHOUT ever leaving HBM
+    (the tunnel-attached TPU moves ~14 MB/s host<->device — measured round
+    3 — so re-uploading ~130 MB of packed output columns per job would
+    cost more than the whole native byte shell).
+
+    start/end are traced scalars (no recompile per file split); n_out_pad
+    is the static power-of-two bucket. Padding columns are rewritten with
+    the pad template so future merges sort them to the tail.
+    """
+    from yugabyte_tpu.ops.slabs import FLAG_TOMBSTONE
+    n_pad = cols.shape[1]
+    idx = start + jnp.arange(n_out_pad, dtype=jnp.int32)
+    valid = idx < end
+    pos = pos_all[jnp.clip(idx, 0, n_pad - 1)]
+    src = perm[pos]
+    sub = cols[:, src]
+    # TTL-expired survivors are rewritten as tombstones by the byte shell;
+    # mirror the flag bit the shell sets (native/compaction_engine.cc
+    # write_output: fl |= 1) so the staged entry matches the file
+    fl = sub[_ROW_FLAGS] | jnp.where(mk[pos] & valid,
+                                     jnp.uint32(FLAG_TOMBSTONE),
+                                     jnp.uint32(0))
+    sub = sub.at[_ROW_FLAGS].set(fl)
+    pad_col = jnp.asarray(pad_template(cols.shape[0]))
+    return jnp.where(valid[None, :], sub, pad_col[:, None])
+
+
+def gather_staged_outputs(handle: MergeGCHandle,
+                          ranges: Sequence[Tuple[int, int]]
+                          ) -> List[StagedCols]:
+    """Stage the output files of a finished merge directly from HBM.
+
+    ranges: per-output-file [start, end) positions in survivor order —
+    exactly the spans the byte shell wrote (returned by
+    storage/compaction.py _write_native_outputs). Returns one StagedCols
+    per file, device-resident, suitable for DeviceSlabCache.put. The
+    survivor-position scan and sort schedule are computed once for all
+    files. Column stats are conservatively absent (every column treated
+    as non-constant) to avoid any device->host fetch.
+    """
+    from yugabyte_tpu.ops.merge_gc import (bucket_size as _bucket,
+                                           build_sort_schedule)
+    staged = handle._staged
+    outs: List[StagedCols] = []
+    r = _ROW_WORDS + staged.w
+    pos_all = _survivor_positions(handle._keep_dev)
+    sort_rows, n_sort = build_sort_schedule(staged.w, np.zeros(r, dtype=bool))
+    for start, end in ranges:
+        n_out = end - start
+        n_out_pad = _bucket(n_out)
+        cols_out = _gather_staged_output(
+            staged.cols_dev, handle._perm_dev, pos_all,
+            handle._mk_dev, jnp.int32(start), jnp.int32(end), n_out_pad)
+        outs.append(StagedCols(cols_out, sort_rows, n_sort, n_out,
+                               n_out_pad, staged.w, None, None))
+    return outs
+
+
 def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot: bool = False) -> MergeGCHandle:
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
-    packed = _merge_gc_runs_fused(
+    packed, perm, keep, mk = _merge_gc_runs_fused(
         staged.cols_dev, jnp.asarray(staged.cmp_rows),
         jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
         k_pad=staged.k_pad, m=staged.m, w=staged.w, n_cmp=staged.n_cmp,
         is_major=params.is_major_compaction,
         retain_deletes=params.retain_deletes, snapshot=snapshot)
-    return MergeGCHandle(packed, staged)
+    return MergeGCHandle(packed, staged, perm, keep, mk)
 
 
 def merge_and_gc_runs(slabs: Sequence[KVSlab], params: GCParams, device=None,

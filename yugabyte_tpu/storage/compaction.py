@@ -261,16 +261,23 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
 
 def _write_native_outputs(job, out_dir: str, new_file_id, fr,
                           block_entries: int
-                          ) -> List[Tuple[int, str, SSTProps]]:
+                          ) -> Tuple[List[Tuple[int, str, SSTProps]],
+                                     List[Tuple[int, int]]]:
     """Write the native job's survivors as (possibly split) output SSTs,
     pacing between files (shared by the pure-native and device+native
-    paths — the pacing/tombstone/base-assembly rules live once)."""
+    paths — the pacing/tombstone/base-assembly rules live once).
+
+    Returns (outputs, ranges): ranges[i] is the [start, end) survivor span
+    written to outputs[i] — the single authority for file splits (the
+    device write-through gathers exactly these spans; re-deriving them
+    from the flag would silently desync if the flag changes mid-job)."""
     from yugabyte_tpu.storage.sst import data_file_name, write_base_file
 
     tombstone_value = Value.tombstone().encode()
     limiter = compaction_rate_limiter()
     rows_out = job.n_survivors
     outputs: List[Tuple[int, str, SSTProps]] = []
+    ranges: List[Tuple[int, int]] = []
     max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
     for start in range(0, rows_out, max_rows):
         end = min(start + max_rows, rows_out)
@@ -282,9 +289,10 @@ def _write_native_outputs(job, out_dir: str, new_file_id, fr,
         props = write_base_file(base_path, index, end - start, hashes,
                                 fk, lk, fr, size)
         outputs.append((fid, base_path, props))
+        ranges.append((start, end))
         if limiter is not None and end < rows_out:
             limiter.acquire(props.data_size + props.base_size)
-    return outputs
+    return outputs, ranges
 
 
 def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
@@ -306,8 +314,8 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
         fr = _merge_frontiers(
             [r.props.frontier for r in (frontier_inputs or inputs)],
             history_cutoff_ht)
-        outputs = _write_native_outputs(job, out_dir, new_file_id, fr,
-                                        block_entries)
+        outputs, _ranges = _write_native_outputs(job, out_dir, new_file_id,
+                                                 fr, block_entries)
     return CompactionResult(outputs, rows_in, rows_out)
 
 
@@ -398,16 +406,17 @@ def run_compaction_job_device_native(
         rows_out = job.n_survivors
         fr = _merge_frontiers([r.props.frontier for r in all_inputs],
                               history_cutoff_ht)
-        outputs = _write_native_outputs(job, out_dir, new_file_id, fr,
-                                        block_entries)
-    if device_cache is not None:
-        # write-through: the outputs are the next compaction's inputs
-        for fid, base_path, _props in outputs:
-            rdr = SSTReader(base_path)
-            try:
-                device_cache.stage(fid, rdr.read_all())
-            finally:
-                rdr.close()
+        outputs, ranges = _write_native_outputs(job, out_dir, new_file_id,
+                                                fr, block_entries)
+    if device_cache is not None and outputs:
+        # write-through: the outputs are the next compaction's inputs.
+        # Staged ON DEVICE by gathering the surviving columns in HBM —
+        # zero host->device transfer (re-uploading the packed output
+        # columns through the ~14 MB/s tunnel costs more than the whole
+        # byte shell). `ranges` are the spans the shell actually wrote.
+        staged_outs = run_merge.gather_staged_outputs(handle, ranges)
+        for (fid, _base, _props), st in zip(outputs, staged_outs):
+            device_cache.put(fid, st)
     return CompactionResult(outputs, rows_in + dropped_rows, rows_out)
 
 

@@ -55,8 +55,11 @@ class DeviceSlabCache:
 
     def put(self, key: CacheKey, staged: StagedCols) -> None:
         with self._lock:
-            if key in self._map:
-                return
+            prior = self._map.pop(key, None)
+            if prior is not None:
+                # replace, not refuse: a stale entry under a reused id must
+                # never shadow fresh data (correctness, not just freshness)
+                self._used -= prior.nbytes
             self._map[key] = staged
             self._used += staged.nbytes
             while self._used > self.capacity and len(self._map) > 1:
