@@ -132,10 +132,10 @@ def test_chained_compaction_from_cache(tmp_path):
     runs_b = [_mk_run(rng, 700, 450) for _ in range(2)]
     cache = DeviceSlabCache(device=_device())
 
-    readers_a = _write_runs(str(tmp_path / "a"), runs_a) \
-        if os.makedirs(str(tmp_path / "a")) is None else None
-    readers_b = _write_runs(str(tmp_path / "b"), runs_b) \
-        if os.makedirs(str(tmp_path / "b")) is None else None
+    os.makedirs(str(tmp_path / "a"))
+    os.makedirs(str(tmp_path / "b"))
+    readers_a = _write_runs(str(tmp_path / "a"), runs_a)
+    readers_b = _write_runs(str(tmp_path / "b"), runs_b)
     for fid, r in zip((0, 1), readers_a):
         cache.stage(fid, r.read_all())
     for fid, r in zip((2, 3), readers_b):

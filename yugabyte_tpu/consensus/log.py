@@ -271,22 +271,53 @@ class Log:
             self._last_op_id = last.op_id if last else (0, 0)
 
     # ------------------------------------------------------------------- gc
+    def _gcable_segments(self, anchor_index: float) -> List[str]:
+        """Closed segments whose entries are ALL < anchor_index, in order
+        (the single authority for the GC rule: deletion, scoring and the
+        closed-bytes report all walk this list). Caller holds _cv. The
+        active segment is never eligible."""
+        segs = LogReader(self.wal_dir).segments()
+        out = []
+        for i, seg in enumerate(segs[:-1]):
+            nxt_first = int(os.path.basename(segs[i + 1])[4:])
+            if nxt_first <= anchor_index:
+                out.append(seg)
+            else:
+                break
+        return out
+
+    @staticmethod
+    def _sizes(paths: List[str]) -> int:
+        total = 0
+        for p in paths:
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+        return total
+
+    def gc_candidate_bytes(self, anchor_index: int) -> int:
+        """Bytes gc_up_to(anchor_index) would free right now (maintenance
+        scoring, ref MaintenanceOpStats::logs_retained_bytes)."""
+        with self._cv:
+            return self._sizes(self._gcable_segments(anchor_index))
+
+    def closed_segment_bytes(self) -> int:
+        """Bytes in all non-active segments (the WAL replay burden a flush
+        could eventually release)."""
+        with self._cv:
+            return self._sizes(self._gcable_segments(float("inf")))
+
     def gc_up_to(self, anchor_index: int) -> int:
         """Delete whole segments whose entries are ALL < anchor_index (the
         minimum of flushed frontiers / peer watermarks, ref
         log_anchor_registry). Never deletes the active segment. Returns
         number of segments removed."""
         with self._cv:
-            segs = LogReader(self.wal_dir).segments()
-            removed = 0
-            for i, seg in enumerate(segs[:-1]):  # keep active segment
-                nxt_first = int(os.path.basename(segs[i + 1])[4:])
-                if nxt_first <= anchor_index:
-                    os.remove(seg)
-                    removed += 1
-                else:
-                    break
-            return removed
+            victims = self._gcable_segments(anchor_index)
+            for seg in victims:
+                os.remove(seg)
+            return len(victims)
 
     def close(self) -> None:
         with self._cv:

@@ -408,14 +408,22 @@ class TabletPeer:
                                    payload, timeout_s=timeout_s)
 
     # ----------------------------------------------------------- background
-    def flush_and_gc_wal(self) -> int:
-        """Flush both DBs, then drop WAL segments fully below the persisted
-        frontier (ref log GC driven by flushed OpId anchors)."""
-        self.tablet.flush()
-        frontiers = [db.versions.flushed_frontier.op_id_max[1]
-                     for db in (self.tablet.regular_db, self.tablet.intents_db)
-                     if db.versions.flushed_frontier is not None]
-        anchor = (min(frontiers) + 1) if frontiers else 0
+    def wal_anchor(self, assume_flushed: bool = False) -> int:
+        """Index below which WAL entries are no longer needed: min of the
+        flushed frontiers, lagging-peer watermarks, and CDC retention
+        (ref log_anchor_registry).
+
+        assume_flushed: score 'what could a flush release' — skip the
+        flushed-frontier component (a flush advances it) but KEEP the
+        raft/CDC pins, which a flush cannot move."""
+        if assume_flushed:
+            anchor = self.raft.commit_index + 1
+        else:
+            frontiers = [db.versions.flushed_frontier.op_id_max[1]
+                         for db in (self.tablet.regular_db,
+                                    self.tablet.intents_db)
+                         if db.versions.flushed_frontier is not None]
+            anchor = (min(frontiers) + 1) if frontiers else 0
         # Never GC entries a lagging peer still needs (there is no remote
         # bootstrap yet to rebuild it from a snapshot).
         anchor = min(anchor, self.raft.wal_gc_anchor())
@@ -425,7 +433,17 @@ class TabletPeer:
         cdc_idx = getattr(self, "cdc_retention_index", None)
         if cdc_idx is not None:
             anchor = min(anchor, cdc_idx + 1)
-        return self.log.gc_up_to(anchor)
+        return anchor
+
+    def gc_wal(self) -> int:
+        """Drop WAL segments fully below the current anchor (no flush)."""
+        return self.log.gc_up_to(self.wal_anchor())
+
+    def flush_and_gc_wal(self) -> int:
+        """Flush both DBs, then drop WAL segments fully below the persisted
+        frontier (ref log GC driven by flushed OpId anchors)."""
+        self.tablet.flush()
+        return self.gc_wal()
 
     def shutdown(self) -> None:
         self.raft.shutdown()

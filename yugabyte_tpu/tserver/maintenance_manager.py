@@ -1,0 +1,230 @@
+"""MaintenanceManager: scored background-op scheduling.
+
+Capability parity with the reference (ref:
+src/yb/tablet/maintenance_manager.h:154 MaintenanceOp with UpdateStats/
+Prepare/Perform; maintenance_manager.cc FindBestOp): every candidate op
+reports (ram_anchored, logs_retained_bytes, perf_improvement) and the
+scheduler picks, in priority order,
+  1. under memory pressure - the op anchoring the most RAM,
+  2. with WAL replay debt above log_target_replay_size - the op
+     releasing the most log bytes,
+  3. otherwise - the op with the highest perf_improvement.
+
+Built-in per-tablet ops (generated dynamically from the live peer list,
+like the memory arbiter, rather than registered/unregistered on tablet
+open/close): FlushOp (memstore -> SST, releases RAM and WAL),
+LogGCOp (drops fully-flushed WAL segments; the only automatic WAL GC
+trigger in the server), CompactOp (kicks the compaction picker for
+tablets that went idle mid-backlog). External subsystems can register
+custom MaintenanceOps through register_op().
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.mem_tracker import root_tracker
+from yugabyte_tpu.utils.trace import TRACE
+
+flags.define_flag("maintenance_manager_polling_interval_s", 0.25,
+                  "how often the maintenance scheduler scores ops "
+                  "(ref maintenance_manager_polling_interval_ms)")
+flags.define_flag("log_target_replay_size_mb", 64,
+                  "closed-WAL bytes per tablet above which log-releasing "
+                  "ops take priority (ref log_target_replay_size_mb)")
+
+
+class MaintenanceOpStats:
+    """One op's current utility (ref maintenance_manager.h:62)."""
+
+    __slots__ = ("runnable", "ram_anchored", "logs_retained_bytes",
+                 "perf_improvement")
+
+    def __init__(self):
+        self.runnable = False
+        self.ram_anchored = 0
+        self.logs_retained_bytes = 0
+        self.perf_improvement = 0.0
+
+
+class MaintenanceOp:
+    """Base class for registered ops (ref maintenance_manager.h:154)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def update_stats(self, stats: MaintenanceOpStats) -> None:
+        raise NotImplementedError
+
+    def perform(self) -> None:
+        raise NotImplementedError
+
+
+class _FlushOp(MaintenanceOp):
+    def __init__(self, peer):
+        super().__init__(f"flush:{peer.tablet_id}")
+        self._peer = peer
+
+    def update_stats(self, stats: MaintenanceOpStats) -> None:
+        t = self._peer.tablet
+        ram = t.memstore_bytes()
+        stats.runnable = ram > 0
+        stats.ram_anchored = ram
+        # only the bytes a flush can ACTUALLY release: the raft lagging-
+        # peer watermark and CDC retention still pin the WAL after a
+        # flush, so scoring all closed segments would flush near-empty
+        # memstores forever while freeing nothing
+        stats.logs_retained_bytes = self._peer.log.gc_candidate_bytes(
+            self._peer.wal_anchor(assume_flushed=True))
+
+    def perform(self) -> None:
+        self._peer.flush_and_gc_wal()
+
+
+class _LogGCOp(MaintenanceOp):
+    def __init__(self, peer):
+        super().__init__(f"log_gc:{peer.tablet_id}")
+        self._peer = peer
+
+    def update_stats(self, stats: MaintenanceOpStats) -> None:
+        freeable = self._peer.log.gc_candidate_bytes(self._peer.wal_anchor())
+        stats.runnable = freeable > 0
+        stats.logs_retained_bytes = freeable
+
+    def perform(self) -> None:
+        self._peer.gc_wal()
+
+
+class _CompactOp(MaintenanceOp):
+    def __init__(self, peer):
+        super().__init__(f"compact:{peer.tablet_id}")
+        self._peer = peer
+
+    def update_stats(self, stats: MaintenanceOpStats) -> None:
+        # L0 backlog beyond the picker's merge width = perf debt: reads
+        # touch every overlapping run (ref: read amplification scoring)
+        t = self._peer.tablet
+        trigger = flags.get_flag("universal_compaction_min_merge_width")
+        backlog = 0
+        for db in (t.regular_db, t.intents_db):
+            backlog = max(backlog, db.n_live_files - trigger)
+        stats.runnable = backlog > 0
+        stats.perf_improvement = float(backlog)
+
+    def perform(self) -> None:
+        t = self._peer.tablet
+        for db in (t.regular_db, t.intents_db):
+            db.maybe_schedule_compaction()
+
+
+class MaintenanceManager:
+    """One per TabletServer (ref maintenance_manager.cc)."""
+
+    def __init__(self, peers_fn: Callable[[], List], metric_entity=None,
+                 memory_pressure_fn: Optional[Callable[[], bool]] = None):
+        self._peers_fn = peers_fn
+        self._registered: List[MaintenanceOp] = []
+        self._reg_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._memory_pressure = (memory_pressure_fn or
+                                 (lambda: root_tracker()
+                                  .soft_limit_exceeded().exceeded))
+        self._c_ops = self._h_dur = None
+        if metric_entity is not None:
+            self._c_ops = metric_entity.counter(
+                "maintenance_ops_performed", "background maintenance ops run")
+            self._h_dur = metric_entity.histogram(
+                "maintenance_op_duration_ms", "maintenance op wall time")
+        self.last_op_name: Optional[str] = None   # observability/tests
+
+    # ------------------------------------------------------------ lifecycle
+    def init(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="maintenance-mgr")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def register_op(self, op: MaintenanceOp) -> None:
+        with self._reg_lock:
+            self._registered.append(op)
+
+    def unregister_op(self, op: MaintenanceOp) -> None:
+        with self._reg_lock:
+            if op in self._registered:
+                self._registered.remove(op)
+
+    # ------------------------------------------------------------ scheduling
+    def _candidate_ops(self) -> List[MaintenanceOp]:
+        ops: List[MaintenanceOp] = []
+        for peer in self._peers_fn():
+            ops.append(_FlushOp(peer))
+            ops.append(_LogGCOp(peer))
+            ops.append(_CompactOp(peer))
+        with self._reg_lock:
+            ops.extend(self._registered)
+        return ops
+
+    def find_best_op(self) -> Optional[MaintenanceOp]:
+        """The reference's FindBestOp policy (maintenance_manager.cc):
+        memory pressure -> max ram_anchored; log debt above target ->
+        max logs_retained; else max perf_improvement."""
+        scored = []
+        for op in self._candidate_ops():
+            stats = MaintenanceOpStats()
+            try:
+                op.update_stats(stats)
+            except Exception:
+                continue
+            if stats.runnable:
+                scored.append((op, stats))
+        if not scored:
+            return None
+        if self._memory_pressure():
+            best = max(scored, key=lambda s: s[1].ram_anchored)
+            if best[1].ram_anchored > 0:
+                return best[0]
+        log_target = flags.get_flag("log_target_replay_size_mb") << 20
+        loggy = max(scored, key=lambda s: s[1].logs_retained_bytes)
+        if loggy[1].logs_retained_bytes > log_target:
+            return loggy[0]
+        perf = max(scored, key=lambda s: s[1].perf_improvement)
+        if perf[1].perf_improvement > 0:
+            return perf[0]
+        # fall back to any freeable log bytes (cheap housekeeping)
+        if loggy[1].logs_retained_bytes > 0:
+            return loggy[0]
+        return None
+
+    def run_once(self) -> Optional[str]:
+        """Score + perform at most one op; returns its name (tests drive
+        this synchronously; the background loop calls it repeatedly)."""
+        op = self.find_best_op()
+        if op is None:
+            return None
+        t0 = time.monotonic()
+        try:
+            op.perform()
+        except Exception as e:
+            TRACE("maintenance op %s failed: %s", op.name, e)
+            return None
+        self.last_op_name = op.name
+        if self._c_ops is not None:
+            self._c_ops.increment()
+            self._h_dur.increment((time.monotonic() - t0) * 1e3)
+        return op.name
+
+    def _loop(self) -> None:
+        period = flags.get_flag("maintenance_manager_polling_interval_s")
+        while not self._stop.wait(period):
+            try:
+                self.run_once()
+            except Exception as e:
+                TRACE("maintenance loop error: %s", e)

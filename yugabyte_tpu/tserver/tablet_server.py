@@ -93,6 +93,14 @@ class TabletServer:
                          if self.exec_context is not None else None),
             metric_entity=self.metrics.entity("server", "memory"),
             server_id=opts.server_id)
+        # Scored background-op scheduling: flush/log-GC/compact ranked by
+        # (ram anchored, log bytes retained, perf debt) — the automatic
+        # WAL-GC trigger (ref tablet/maintenance_manager.cc FindBestOp).
+        from yugabyte_tpu.tserver.maintenance_manager import (
+            MaintenanceManager)
+        self.maintenance_manager = MaintenanceManager(
+            peers_fn=self._tablet_peers,
+            metric_entity=self.metrics.entity("server", "maintenance"))
         self.webserver = None
         if opts.webserver_port is not None:
             from yugabyte_tpu.server.webserver import Webserver
@@ -260,6 +268,7 @@ class TabletServer:
         self._fetch_universe_keys()
         self.tablet_manager.open_existing()
         self.memory_manager.init()
+        self.maintenance_manager.init()
         if self.opts.master_addrs:
             # Register before serving so the master knows our address by the
             # time it places tablets here.
@@ -315,6 +324,7 @@ class TabletServer:
             p.stop()
         self.heartbeater.stop()
         self.memory_manager.shutdown()
+        self.maintenance_manager.shutdown()
         if self.webserver is not None:
             self.webserver.shutdown()
         self.tablet_manager.shutdown()
