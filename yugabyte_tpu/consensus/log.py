@@ -302,12 +302,6 @@ class Log:
         with self._cv:
             return self._sizes(self._gcable_segments(anchor_index))
 
-    def closed_segment_bytes(self) -> int:
-        """Bytes in all non-active segments (the WAL replay burden a flush
-        could eventually release)."""
-        with self._cv:
-            return self._sizes(self._gcable_segments(float("inf")))
-
     def gc_up_to(self, anchor_index: int) -> int:
         """Delete whole segments whose entries are ALL < anchor_index (the
         minimum of flushed frontiers / peer watermarks, ref

@@ -24,10 +24,13 @@ import numpy as np
 
 from yugabyte_tpu.utils import flags
 
-flags.define_flag("compaction_native_threads", 4,
+flags.define_flag("compaction_native_threads",
+                  min(4, os.cpu_count() or 1),
                   "worker threads for native block decode/encode "
                   "(the reference runs multiple subcompaction threads, "
-                  "compaction_job.cc:456-468)")
+                  "compaction_job.cc:456-468); capped at the core count — "
+                  "oversubscribing memory-bound encode threads on a "
+                  "1-core box only adds contention")
 
 _lib = None
 _lib_lock = threading.Lock()

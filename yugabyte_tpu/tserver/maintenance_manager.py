@@ -64,35 +64,35 @@ class MaintenanceOp:
 
 
 class _FlushOp(MaintenanceOp):
-    def __init__(self, peer):
+    def __init__(self, peer, flush_releasable: int):
         super().__init__(f"flush:{peer.tablet_id}")
         self._peer = peer
+        self._flush_releasable = flush_releasable
 
     def update_stats(self, stats: MaintenanceOpStats) -> None:
-        t = self._peer.tablet
-        ram = t.memstore_bytes()
+        ram = self._peer.tablet.memstore_bytes()
         stats.runnable = ram > 0
         stats.ram_anchored = ram
         # only the bytes a flush can ACTUALLY release: the raft lagging-
         # peer watermark and CDC retention still pin the WAL after a
         # flush, so scoring all closed segments would flush near-empty
-        # memstores forever while freeing nothing
-        stats.logs_retained_bytes = self._peer.log.gc_candidate_bytes(
-            self._peer.wal_anchor(assume_flushed=True))
+        # memstores forever while freeing nothing (snapshotted once per
+        # poll round by _candidate_ops — one WAL scan serves both ops)
+        stats.logs_retained_bytes = self._flush_releasable
 
     def perform(self) -> None:
         self._peer.flush_and_gc_wal()
 
 
 class _LogGCOp(MaintenanceOp):
-    def __init__(self, peer):
+    def __init__(self, peer, freeable: int):
         super().__init__(f"log_gc:{peer.tablet_id}")
         self._peer = peer
+        self._freeable = freeable
 
     def update_stats(self, stats: MaintenanceOpStats) -> None:
-        freeable = self._peer.log.gc_candidate_bytes(self._peer.wal_anchor())
-        stats.runnable = freeable > 0
-        stats.logs_retained_bytes = freeable
+        stats.runnable = self._freeable > 0
+        stats.logs_retained_bytes = self._freeable
 
     def perform(self) -> None:
         self._peer.gc_wal()
@@ -165,8 +165,17 @@ class MaintenanceManager:
     def _candidate_ops(self) -> List[MaintenanceOp]:
         ops: List[MaintenanceOp] = []
         for peer in self._peers_fn():
-            ops.append(_FlushOp(peer))
-            ops.append(_LogGCOp(peer))
+            # one WAL-directory scan per peer per round, shared by both
+            # log-scoring ops (listdir+stat per op per poll would hammer
+            # the Log lock on servers with many idle tablets)
+            try:
+                freeable = peer.log.gc_candidate_bytes(peer.wal_anchor())
+                flush_releasable = peer.log.gc_candidate_bytes(
+                    peer.wal_anchor(assume_flushed=True))
+            except Exception:
+                freeable = flush_releasable = 0
+            ops.append(_FlushOp(peer, flush_releasable))
+            ops.append(_LogGCOp(peer, freeable))
             ops.append(_CompactOp(peer))
         with self._reg_lock:
             ops.extend(self._registered)
@@ -181,7 +190,11 @@ class MaintenanceManager:
             stats = MaintenanceOpStats()
             try:
                 op.update_stats(stats)
-            except Exception:
+            except Exception as e:
+                # never silently disable a broken op: a tablet whose flush
+                # scoring always throws would pile up debt with no signal
+                TRACE("maintenance op %s update_stats failed: %s",
+                      op.name, e)
                 continue
             if stats.runnable:
                 scored.append((op, stats))
@@ -222,8 +235,9 @@ class MaintenanceManager:
         return op.name
 
     def _loop(self) -> None:
-        period = flags.get_flag("maintenance_manager_polling_interval_s")
-        while not self._stop.wait(period):
+        # interval re-read each round: the flag is runtime-tunable
+        while not self._stop.wait(
+                flags.get_flag("maintenance_manager_polling_interval_s")):
             try:
                 self.run_once()
             except Exception as e:
