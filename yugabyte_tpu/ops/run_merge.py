@@ -68,32 +68,43 @@ def _lex_gt(lo, hi, n_rows: int):
 def merge_network(x, k_pad: int, m: int):
     """Bitonic merge tree over [C, k_pad, m] (each run ascending).
 
-    Returns the fully merged [C, k_pad*m]. The last row must be a unique
-    tiebreak (the global index) so the comparator is a total order.
+    Returns the fully merged [C, k_pad*m]. All C rows form the comparator;
+    the LAST row must be a unique tiebreak (the global index) so the
+    order is total.
+
+    Stage formulation (profiled on v5e): every half-cleaner runs on the
+    FLAT [C, n] array — the partner of position i at stride s is i^s,
+    fetched with two lane rotations (jnp.roll) and a parity select
+    instead of reshape(..., 2, s) slicing. The reshape form forced a
+    tiled-layout copy per stage (~half the merge wall time); rolls keep
+    one fixed layout for the whole network. Only the per-level reverse of
+    the B runs still reshapes.
     """
     c = x.shape[0]
+    n_cmp = c
+    n = k_pad * m
+    pos = jnp.arange(n, dtype=jnp.int32)
+    z = x.reshape(c, n)
     k, length = k_pad, m
-    y = x
     while k > 1:
-        y = y.reshape(c, k // 2, 2, length)
-        a = y[:, :, 0, :]
-        b = y[:, :, 1, ::-1]
-        z = jnp.concatenate([a, b], axis=-1)        # bitonic per pair
+        # reverse every odd run: concat(A, reverse(B)) is bitonic
+        y = z.reshape(c, k // 2, 2, length)
+        z = jnp.concatenate([y[:, :, 0, :], y[:, :, 1, ::-1]],
+                            axis=-1).reshape(c, n)
         s = length
         while s >= 1:
-            z = z.reshape(c, k // 2, (2 * length) // (2 * s), 2, s)
-            lo = z[:, :, :, 0, :]
-            hi = z[:, :, :, 1, :]
-            swap = _lex_gt(lo, hi, c)
-            nlo = jnp.where(swap[None], hi, lo)
-            nhi = jnp.where(swap[None], lo, hi)
-            z = jnp.concatenate([nlo[:, :, :, None, :], nhi[:, :, :, None, :]],
-                                axis=3)
+            hi_half = (pos & s) != 0
+            # partner = z[i ^ s]; XOR never crosses a 2s block, so the
+            # roll's wrap-around values are never selected
+            p = jnp.where(hi_half[None], jnp.roll(z, s, axis=1),
+                          jnp.roll(z, -s, axis=1))
+            gt = _lex_gt(z[:n_cmp], p[:n_cmp], n_cmp)   # strict, total
+            take_p = jnp.where(hi_half, ~gt, gt)        # lo keeps min
+            z = jnp.where(take_p[None], p, z)
             s //= 2
-        y = z.reshape(c, k // 2, 2 * length)
         k //= 2
         length *= 2
-    return y.reshape(c, k_pad * m)
+    return z
 
 
 

@@ -172,7 +172,9 @@ class MaintenanceManager:
                 freeable = peer.log.gc_candidate_bytes(peer.wal_anchor())
                 flush_releasable = peer.log.gc_candidate_bytes(
                     peer.wal_anchor(assume_flushed=True))
-            except Exception:
+            except Exception as e:
+                TRACE("maintenance: WAL scoring for %s failed: %s",
+                      getattr(peer, "tablet_id", "?"), e)
                 freeable = flush_releasable = 0
             ops.append(_FlushOp(peer, flush_releasable))
             ops.append(_LogGCOp(peer, freeable))
