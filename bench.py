@@ -265,34 +265,45 @@ def run_device_child(platform: str, workload_path: str) -> None:
         f"rows/s (kept {int(keep.sum())})")
 
     # ---- device-resident: HBM slab cache steady state --------------------
+    # A production server compacts CONTINUOUSLY: decisions for job i
+    # download while job i+1 computes. The sustained per-job cost is the
+    # slope of a pipelined stream (k=8 minus k=2 over 6 jobs), which
+    # removes the fixed per-call tunnel round-trip that a single timed
+    # call would charge to the device (block_until_ready does not
+    # actually block on this backend, so single-call timings are
+    # unreliable anyway — measured round 3).
     staged_list = [stage_slab(r, dev) for r in runs]
     staged = run_merge.stage_runs_from_staged(staged_list)
     jax.block_until_ready(staged.cols_dev)
-    run_merge.launch_merge_gc(staged, params).result()  # warm
+
+    def run_stream(k: int) -> float:
+        t0 = time.time()
+        hs = [run_merge.launch_merge_gc(staged, params)]
+        for i in range(1, k):
+            hs.append(run_merge.launch_merge_gc(staged, params))
+            hs[i - 1].result()
+        hs[-1].result()
+        return time.time() - t0
+
+    run_stream(2)                      # warm
     t0 = time.time()
     run_merge.launch_merge_gc(staged, params).result()
-    res_s = time.time() - t0
-    log(f"  device-resident: {res_s:.3f}s = {n_total/res_s/1e6:.2f}M rows/s")
-
-    # kernel-only: device compute incl. packing, excluding the fetch
-    h = run_merge.launch_merge_gc(staged, params)
-    jax.block_until_ready(h._packed_dev)
-    t0 = time.time()
-    h = run_merge.launch_merge_gc(staged, params)
-    jax.block_until_ready(h._packed_dev)
-    kern_s = time.time() - t0
-    log(f"  kernel-only: {kern_s:.3f}s = {n_total/kern_s/1e6:.2f}M rows/s")
-
-    # pipelined: a stream of compactions, decision downloads overlapping
-    # the next job's compute (the sustained steady-state rate)
-    iters = 6
-    t0 = time.time()
-    handles = [run_merge.launch_merge_gc(staged, params)]
-    for i in range(1, iters):
-        handles.append(run_merge.launch_merge_gc(staged, params))
-        handles[i - 1].result()
-    handles[-1].result()
-    pipe_s = (time.time() - t0) / iters
+    single_s = time.time() - t0        # one launch+fetch incl. link RTT
+    t2 = run_stream(2)
+    t8 = run_stream(8)
+    if t8 > t2:
+        sustained_s = (t8 - t2) / 6
+    else:
+        # jitter/recompile made the slope meaningless — fall back to the
+        # conservative mean rather than emitting an absurd rate
+        log(f"  WARNING: stream slope invalid (t2={t2:.3f}s t8={t8:.3f}s); "
+            f"using mean")
+        sustained_s = t8 / 8
+    res_s = sustained_s
+    log(f"  device-resident sustained: {sustained_s:.3f}s/job = "
+        f"{n_total/sustained_s/1e6:.2f}M rows/s "
+        f"(single call incl. link latency: {single_s:.3f}s)")
+    pipe_s = t8 / 8
     log(f"  pipelined: {pipe_s:.3f}s/job = {n_total/pipe_s/1e6:.2f}M rows/s")
 
     from yugabyte_tpu.ops.scan import scan_visible
@@ -368,16 +379,25 @@ def run_device_child(platform: str, workload_path: str) -> None:
         "metric": "l0_compaction_merge_gc_rows_per_sec",
         "value": round(headline, 1),
         "unit": "rows/s",
+        # the parent overwrites vs_baseline + vs_baseline_basis with the
+        # like-for-like disk-to-disk comparison (value / e2e_native) when
+        # the native shell is available; until then the basis label below
+        # keeps this number honestly described
         "vs_baseline": round(headline / cpu_rate, 3),
+        "vs_baseline_basis": "single-core IN-MEMORY C++ merge+GC "
+                             "(native e2e unavailable in child)",
         "platform": platform,
         "device": str(dev),
         "note": "value = steady-state disk-to-disk compaction (device "
                 "decisions from HBM slab cache + native C++ byte shell); "
-                "vs_baseline vs the single-core in-memory C++ merge+GC",
+                "vs_baseline basis is vs_baseline_basis; "
+                "kernel_vs_cpu_core = sustained device merge+GC / "
+                "single-core IN-MEMORY C++ merge+GC",
         "cpu_cxx_baseline_rows_per_sec": round(cpu_rate, 1),
+        "kernel_vs_cpu_core": round((n_total / res_s) / cpu_rate, 3),
         "cold_rows_per_sec": round(n_total / cold_s, 1),
         "device_resident_rows_per_sec": round(n_total / res_s, 1),
-        "kernel_only_rows_per_sec": round(n_total / kern_s, 1),
+        "device_single_call_rows_per_sec": round(n_total / single_s, 1),
         "pipelined_rows_per_sec": round(n_total / pipe_s, 1),
         "scan_rows_per_sec": round(n_total / scan_s, 1),
         "e2e_steady_rows_per_sec": round(e2e_steady, 1),
@@ -501,6 +521,14 @@ def main():
         steady = result.get("e2e_steady_rows_per_sec") or 0
         if steady:
             result["e2e_vs_native"] = round(steady / native_rate, 3)
+            # the headline comparison: OUR full job vs the stock-CPU-
+            # architecture full job over the same files on the same disk
+            # (BASELINE.md: ">=3x rows/sec on L0->L1 compaction ... vs the
+            # stock CPU CompactionJob" — which also pays disk I/O)
+            result["vs_baseline"] = round(steady / native_rate, 3)
+            result["vs_baseline_basis"] = (
+                "stock-architecture C++ CompactionJob, full disk-to-disk "
+                "job over the same files on the same machine")
     print(json.dumps(result), flush=True)
 
 

@@ -65,7 +65,7 @@ def _lex_gt(lo, hi, n_rows: int):
     return gt
 
 
-def merge_network(x, k_pad: int, m: int):
+def merge_network(x, k_pad: int, m: int, pos=None):
     """Bitonic merge tree over [C, k_pad, m] (each run ascending).
 
     Returns the fully merged [C, k_pad*m]. All C rows form the comparator;
@@ -79,11 +79,17 @@ def merge_network(x, k_pad: int, m: int):
     tiled-layout copy per stage (~half the merge wall time); rolls keep
     one fixed layout for the whole network. Only the per-level reverse of
     the B runs still reshapes.
+
+    pos must be a RUNTIME int32 iota [k_pad*m] (the caller's jit takes it
+    as an operand): written as jnp.arange inside the trace, every stage's
+    `pos & s` parity mask is a compile-time constant and XLA folds ~40
+    multi-MB literals — at 4M rows that blew the compile past 10 minutes.
     """
     c = x.shape[0]
     n_cmp = c
     n = k_pad * m
-    pos = jnp.arange(n, dtype=jnp.int32)
+    if pos is None:   # convenience for tests; production passes it in
+        pos = jnp.arange(n, dtype=jnp.int32)
     z = x.reshape(c, n)
     k, length = k_pad, m
     while k > 1:
@@ -111,7 +117,7 @@ def merge_network(x, k_pad: int, m: int):
 
 @functools.partial(jax.jit, static_argnames=(
     "k_pad", "m", "w", "n_cmp", "is_major", "retain_deletes", "snapshot"))
-def _merge_gc_runs_fused(cols, cmp_rows,
+def _merge_gc_runs_fused(cols, cmp_rows, pos,
                          cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
                          k_pad: int, m: int, w: int, n_cmp: int,
                          is_major: bool, retain_deletes: bool,
@@ -131,15 +137,16 @@ def _merge_gc_runs_fused(cols, cmp_rows,
     # (ht_hi/ht_lo/write_id), append the global index as total-order tiebreak
     invert = ((cmp_rows >= _ROW_HT_HI) & (cmp_rows <= _ROW_WID))
     cmp = cols[cmp_rows, :] ^ jnp.where(invert, u32max, jnp.uint32(0))[:, None]
-    idx = jnp.arange(n, dtype=jnp.uint32)
+    idx = pos.astype(jnp.uint32)
     x = jnp.concatenate([cmp, idx[None]], axis=0)
 
     if k_pad > 1:
-        merged = merge_network(x.reshape(n_cmp + 1, k_pad, m), k_pad, m)
+        merged = merge_network(x.reshape(n_cmp + 1, k_pad, m), k_pad, m,
+                               pos=pos)
         perm = merged[-1].astype(jnp.int32)
         s = cols[:, perm]
     else:
-        perm = idx.astype(jnp.int32)
+        perm = pos
         s = cols
 
     keep, make_tomb = gc_over_sorted(
@@ -426,8 +433,11 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot: bool = False) -> MergeGCHandle:
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
+    # runtime iota operand: see merge_network's pos docstring (compile-
+    # time constant folding of per-stage parity masks)
+    pos = jnp.arange(staged.n_pad, dtype=jnp.int32)
     packed, perm, keep, mk = _merge_gc_runs_fused(
-        staged.cols_dev, jnp.asarray(staged.cmp_rows),
+        staged.cols_dev, jnp.asarray(staged.cmp_rows), pos,
         jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
         k_pad=staged.k_pad, m=staged.m, w=staged.w, n_cmp=staged.n_cmp,
