@@ -1,0 +1,209 @@
+"""Builtin function registry (yql/bfunc.py) — the bfql/bfpg equivalent.
+
+Covers resolution with exact + implicit-widening signatures (ref
+bfql/bfql.cc FindOpcodeByType), the conversion families from
+bfql/directory.cc, and the YCQL wiring: builtins in SELECT lists,
+INSERT value expressions, and the writetime() metadata marker.
+"""
+
+import struct
+
+import pytest
+
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.yql import bfunc
+
+
+# ------------------------------------------------------------- registry
+
+def test_resolution_exact_and_widening():
+    d = bfunc.resolve("length", [DataType.STRING])
+    assert d.ret_type == DataType.INT32
+    # abs has INT64 and DOUBLE overloads: exact first
+    assert bfunc.resolve("abs", [DataType.INT64]).ret_type == DataType.INT64
+    assert bfunc.resolve("abs", [DataType.DOUBLE]).ret_type == DataType.DOUBLE
+    # INT32 widens into the INT64 overload
+    assert bfunc.resolve("abs", [DataType.INT32]).ret_type == DataType.INT64
+    # FLOAT widens into DOUBLE
+    assert bfunc.resolve("abs", [DataType.FLOAT]).ret_type == DataType.DOUBLE
+    with pytest.raises(bfunc.NoSuchFunction):
+        bfunc.resolve("nope", [])
+    with pytest.raises(bfunc.NoSuchFunction):
+        bfunc.resolve("length", [DataType.INT64])
+
+
+def test_scalar_functions():
+    assert bfunc.evaluate("upper", ["abc"])[0] == "ABC"
+    assert bfunc.evaluate("lower", ["AbC"])[0] == "abc"
+    assert bfunc.evaluate("length", ["hello"])[0] == 5
+    assert bfunc.evaluate("substr", ["hello", 2, 3])[0] == "ell"
+    assert bfunc.evaluate("abs", [-7])[0] == 7
+    assert bfunc.evaluate("ceil", [1.2])[0] == 2.0
+    assert bfunc.evaluate("coalesce", [None, None, 3, 4])[0] == 3
+    assert bfunc.evaluate("nullif", [5, 5])[0] is None
+    assert bfunc.evaluate("nullif", [5, 6])[0] == 5
+    assert bfunc.evaluate("greatest", [1, 9, 4])[0] == 9
+    assert bfunc.evaluate("least", [None, 9, 4])[0] == 4
+    # null propagation
+    assert bfunc.evaluate("upper", [None], [DataType.STRING])[0] is None
+
+
+def test_blob_conversions_roundtrip():
+    b, t = bfunc.evaluate("intasblob", [7], [DataType.INT32])
+    assert t == DataType.BINARY and b == struct.pack(">i", 7)
+    assert bfunc.evaluate("blobasint", [b])[0] == 7
+    b, _ = bfunc.evaluate("textasblob", ["hi"])
+    assert bfunc.evaluate("blobastext", [b])[0] == "hi"
+    b, _ = bfunc.evaluate("doubleasblob", [2.5])
+    assert bfunc.evaluate("blobasdouble", [b])[0] == 2.5
+    b, _ = bfunc.evaluate("booleanasblob", [True], [DataType.BOOL])
+    assert bfunc.evaluate("blobasboolean", [b])[0] is True
+
+
+def test_volatile_time_functions():
+    v1, t = bfunc.evaluate("now", [])
+    assert t == DataType.TIMESTAMP and v1 > 1_500_000_000 * 10**6
+    u1, _ = bfunc.evaluate("uuid", [])
+    u2, _ = bfunc.evaluate("uuid", [])
+    assert u1 != u2
+    assert bfunc.evaluate("tounixtimestamp", [v1],
+                          [DataType.TIMESTAMP])[0] == v1 // 1000
+
+
+def test_arithmetic_operators():
+    assert bfunc.evaluate("+", [2, 3], [DataType.INT64, DataType.INT64])[0] == 5
+    assert bfunc.evaluate("*", [2.5, 4.0],
+                          [DataType.DOUBLE, DataType.DOUBLE])[0] == 10.0
+    v, t = bfunc.evaluate("/", [7, 2], [DataType.INT64, DataType.INT64])
+    assert v == 3.5 and t == DataType.DOUBLE
+    assert bfunc.evaluate("||", ["a", "b"],
+                          [DataType.STRING, DataType.STRING])[0] == "ab"
+
+
+def test_marker_functions_refuse_direct_eval():
+    with pytest.raises(bfunc.NoSuchFunction):
+        bfunc.evaluate("writetime", ["x"], [bfunc.ANY])
+
+
+def test_cast():
+    assert bfunc.evaluate("cast", [7, None],
+                          [DataType.INT32, DataType.INT64])[0] == 7
+    v, t = bfunc.evaluate("cast", [7, None],
+                          [DataType.INT32, DataType.DOUBLE])
+    assert v == 7.0 and t == DataType.DOUBLE
+
+
+# ----------------------------------------------------------- CQL wiring
+
+@pytest.fixture(scope="module")
+def ql(tmp_path_factory):
+    import jax  # noqa: F401 — conftest pins the CPU platform
+    from yugabyte_tpu.integration.mini_cluster import (
+        MiniCluster, MiniClusterOptions)
+    from yugabyte_tpu.yql.cql.executor import QLProcessor
+    from yugabyte_tpu.utils import flags
+    flags.set_flag("replication_factor", 1)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=1,
+        fs_root=str(tmp_path_factory.mktemp("bfunc_cluster")))).start()
+    proc = QLProcessor(c.new_client())
+    proc.execute("CREATE KEYSPACE ks")
+    proc.execute("USE ks")
+    proc.execute("CREATE TABLE t (k text, v text, n bigint, "
+                 "PRIMARY KEY ((k)))")
+    yield proc
+    c.shutdown()
+
+
+def test_cql_builtin_in_select(ql):
+    ql.execute("INSERT INTO t (k, v, n) VALUES ('a', 'Hello', -4)")
+    rs = ql.execute("SELECT upper(v), length(v), abs(n) FROM t WHERE k = 'a'")
+    assert rs.columns == ["upper(v)", "length(v)", "abs(n)"]
+    assert rs.rows == [["HELLO", 5, 4]]
+
+
+def test_cql_builtin_in_insert_values(ql):
+    ql.execute("INSERT INTO t (k, v) VALUES ('u', uuid())")
+    rs = ql.execute("SELECT v, length(v) FROM t WHERE k = 'u'")
+    assert rs.rows[0][1] == 36   # canonical uuid text length
+
+
+def test_cql_writetime(ql):
+    ql.execute("INSERT INTO t (k, v) VALUES ('w', 'x')")
+    rs = ql.execute("SELECT writetime(v) FROM t WHERE k = 'w'")
+    assert rs.columns == ["writetime(v)"]
+    wt = rs.rows[0][0]
+    assert isinstance(wt, int) and wt > 1_500_000_000 * 10**6
+
+
+def test_cql_nested_call(ql):
+    ql.execute("INSERT INTO t (k, v) VALUES ('n', 'abc')")
+    rs = ql.execute("SELECT upper(substr(v, 1, 2)) FROM t WHERE k = 'n'")
+    assert rs.rows == [["AB"]]
+
+
+def test_cql_unknown_function_rejected(ql):
+    from yugabyte_tpu.utils.status import StatusError
+    with pytest.raises(StatusError):
+        ql.execute("SELECT frobnicate(v) FROM t WHERE k = 'a'")
+
+
+# ------------------------------------------------------------ PG wiring
+
+def test_pg_scalar_functions(tmp_path):
+    """SELECT upper(name), length(name) through the real PG wire server."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from pg_wire_client import PgWireClient
+    from yugabyte_tpu.integration.mini_cluster import (
+        MiniCluster, MiniClusterOptions)
+    from yugabyte_tpu.yql.pgsql.server import PgServer
+    from yugabyte_tpu.utils import flags
+    flags.set_flag("replication_factor", 1)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=1,
+        fs_root=str(tmp_path / "fs"))).start()
+    try:
+        server = PgServer(c.new_client())
+        conn = PgWireClient(server.host, server.port, database="postgres")
+        conn.query("CREATE TABLE people (id bigint PRIMARY KEY, "
+                   "name text, score double precision)")
+        conn.query("INSERT INTO people (id, name, score) "
+                   "VALUES (1, 'Ada', -2.5)")
+        res = conn.query(
+            "SELECT upper(name), length(name), abs(score) FROM people "
+            "WHERE id = 1")[0]
+        assert [c0 for c0, _o in res.columns] == ["upper", "length", "abs"]
+        assert res.rows == [["ADA", "3", "2.5"]]
+        # nested + coalesce over a NULL column
+        conn.query("INSERT INTO people (id, name) VALUES (2, NULL)")
+        res2 = conn.query(
+            "SELECT coalesce(name, 'unknown') FROM people WHERE id = 2")[0]
+        assert res2.rows == [["unknown"]]
+        conn.close()
+        server.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_literal_reachable_conversions():
+    """Plain literals (INT64/DOUBLE inferred) reach the narrow decls."""
+    assert bfunc.evaluate("intasblob", [7])[0] == struct.pack(">i", 7)
+    assert bfunc.evaluate("floatasblob", [1.5])[0] == struct.pack(">f", 1.5)
+    with pytest.raises(bfunc.EvalError):
+        bfunc.evaluate("intasblob", [1 << 40])
+
+
+def test_eval_error_wrapped():
+    with pytest.raises(bfunc.EvalError):
+        bfunc.evaluate("blobasint", [b"xx"])   # wrong length -> struct.error
+
+
+def test_cql_runtime_error_is_status_not_crash(ql):
+    from yugabyte_tpu.utils.status import StatusError
+    ql.execute("INSERT INTO t (k, v, n) VALUES ('e', 'z', 1)")
+    with pytest.raises(StatusError):
+        ql.execute("SELECT greatest(v, n) FROM t WHERE k = 'e'")
+    # the processor is still usable afterwards
+    rs = ql.execute("SELECT v FROM t WHERE k = 'e'")
+    assert rs.rows == [["z"]]

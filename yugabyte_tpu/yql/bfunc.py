@@ -1,0 +1,318 @@
+"""Builtin function registry shared by the YCQL and YSQL front ends.
+
+Capability parity with the reference's bfql/bfpg libraries (ref:
+src/yb/bfql/directory.cc kBFDirectory — a declarative table of
+{cpp_name, ql_name, return_type, argument_types}; resolution walks the
+table matching name + signature with implicit numeric widening, ref
+bfql/bfql.cc FindOpcodeByType / IsImplicitlyConvertible). The reference
+generates stable OPCODEs from table order for wire compatibility; this
+registry is in-process (both query layers run in the same server), so
+decls are resolved by name+signature and called directly.
+
+Declared families (ref bfql/directory.cc + bfpg/directory.cc):
+  - numeric casts (the ConvertXToY matrix)
+  - CQL blob conversions (typeasblob / blobastype)
+  - time functions (now, currenttimestamp, totimestamp, tounixtimestamp,
+    dateof, uuid)
+  - arithmetic operators (+ - * / %) and string concatenation (||)
+  - scalar SQL functions (length, upper, lower, substr, abs, ceil,
+    floor, round, coalesce, nullif, greatest, least)
+  - server-side markers writetime/ttl (evaluated by the executor from
+    row metadata, like the reference's TSOpcode routing)
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+import time
+import uuid as _uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from yugabyte_tpu.common.schema import DataType
+
+# Sentinel types (ref directory.cc ANYTYPE / TYPEARGS)
+ANY = "ANY"
+
+_NUMERIC = (DataType.INT32, DataType.INT64, DataType.FLOAT, DataType.DOUBLE)
+# implicit widening order (ref IsImplicitlyConvertible's numeric chain)
+_WIDEN_RANK = {DataType.INT32: 0, DataType.INT64: 1,
+               DataType.FLOAT: 2, DataType.DOUBLE: 3}
+
+
+class BFError(Exception):
+    """Base for builtin-function failures; front ends catch this and
+    answer with a protocol error instead of dropping the connection."""
+
+
+class NoSuchFunction(BFError):
+    pass
+
+
+class EvalError(BFError):
+    pass
+
+
+@dataclass(frozen=True)
+class BFDecl:
+    """One builtin declaration (ref bfql/bfdecl.h BFDecl)."""
+    cpp_name: str
+    ql_name: str
+    ret_type: object                     # DataType or ANY
+    arg_types: Tuple[object, ...]        # DataTypes / ANY; last may be ...
+    fn: Optional[Callable]               # None = executor-evaluated marker
+    variadic: bool = False
+    volatile: bool = False               # re-evaluate per call (now, uuid)
+
+
+_REGISTRY: Dict[str, List[BFDecl]] = {}
+
+
+def declare(cpp_name: str, ql_name: str, ret_type, arg_types,
+            fn, variadic: bool = False, volatile: bool = False) -> None:
+    decl = BFDecl(cpp_name, ql_name.lower(), ret_type, tuple(arg_types),
+                  fn, variadic, volatile)
+    _REGISTRY.setdefault(decl.ql_name, []).append(decl)
+
+
+def is_builtin(ql_name: str) -> bool:
+    return ql_name.lower() in _REGISTRY
+
+
+def _convertible(have, want) -> bool:
+    if want is ANY or have is None or have == want:
+        return True
+    if have in _WIDEN_RANK and want in _WIDEN_RANK:
+        return _WIDEN_RANK[have] <= _WIDEN_RANK[want]
+    return False
+
+
+def resolve(ql_name: str, arg_types: Sequence[object]) -> BFDecl:
+    """Find the declaration for name+signature (ref FindOpcodeByType):
+    exact match wins; otherwise the first overload every argument is
+    implicitly convertible to."""
+    cands = _REGISTRY.get(ql_name.lower())
+    if not cands:
+        raise NoSuchFunction(f"unknown function {ql_name!r}")
+
+    def sig_ok(d: BFDecl, exact: bool) -> bool:
+        want = list(d.arg_types)
+        if d.variadic:
+            if len(arg_types) < len(want) - 1:
+                return False
+            want = want[:-1] + [want[-1]] * (len(arg_types) - len(want) + 1)
+        elif len(want) != len(arg_types):
+            return False
+        for have, w in zip(arg_types, want):
+            if exact:
+                if not (w is ANY or have is None or have == w):
+                    return False
+            elif not _convertible(have, w):
+                return False
+        return True
+
+    for d in cands:
+        if sig_ok(d, exact=True):
+            return d
+
+    def cost(d: BFDecl) -> int:
+        # minimal total widening distance wins (INT32 prefers the INT64
+        # overload of abs over DOUBLE); ANY slots cost more than any
+        # concrete conversion so typed overloads take priority
+        want = list(d.arg_types)
+        if d.variadic:
+            want = want[:-1] + [want[-1]] * (len(arg_types) - len(want) + 1)
+        total = 0
+        for have, w in zip(arg_types, want):
+            if w is ANY or have is None:
+                total += 10
+            elif have != w:
+                total += _WIDEN_RANK[w] - _WIDEN_RANK[have]
+        return total
+
+    viable = [d for d in cands if sig_ok(d, exact=False)]
+    if viable:
+        return min(viable, key=cost)
+    raise NoSuchFunction(
+        f"no overload of {ql_name!r} accepts "
+        f"({', '.join(getattr(t, 'value', str(t)) for t in arg_types)})")
+
+
+def evaluate(ql_name: str, args: Sequence[object],
+             arg_types: Optional[Sequence[object]] = None):
+    """Resolve + call. Returns (value, ret_type). Marker decls (fn=None,
+    e.g. writetime/ttl) must be handled by the executor and raise here."""
+    if arg_types is None:
+        arg_types = [infer_type(a) for a in args]
+    d = resolve(ql_name, arg_types)
+    if d.fn is None:
+        raise NoSuchFunction(
+            f"{ql_name} requires row metadata (executor-evaluated)")
+    try:
+        return d.fn(*args), d.ret_type
+    except BFError:
+        raise
+    except Exception as e:
+        # a raw TypeError/struct.error escaping here would kill the wire
+        # connection thread instead of producing a protocol error
+        raise EvalError(f"{ql_name}: {e}")
+
+
+def infer_type(v) -> Optional[object]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return DataType.BOOL
+    if isinstance(v, int):
+        return DataType.INT64
+    if isinstance(v, float):
+        return DataType.DOUBLE
+    if isinstance(v, str):
+        return DataType.STRING
+    if isinstance(v, (bytes, bytearray)):
+        return DataType.BINARY
+    return ANY
+
+
+# ---------------------------------------------------------------- casts
+def _num_cast(target):
+    if target in (DataType.INT32, DataType.INT64):
+        return lambda x, _t=None: None if x is None else int(x)
+    return lambda x, _t=None: None if x is None else float(x)
+
+
+for _src in _NUMERIC:
+    for _dst in _NUMERIC:
+        if _src != _dst:
+            # second argument is the target-type witness, exactly like the
+            # reference's {"ConvertI8ToI16", "cast", "", INT16,
+            # {INT8, INT16}} rows (directory.cc:74)
+            declare(f"Convert{_src.name}To{_dst.name}", "cast", _dst,
+                    (_src, _dst), _num_cast(_dst))
+
+# ---------------------------------------------- CQL blob conversions
+_BLOB_PACK = {
+    ("varcharasblob", DataType.STRING): lambda s: s.encode(),
+    ("textasblob", DataType.STRING): lambda s: s.encode(),
+    ("booleanasblob", DataType.BOOL): lambda b: bytes([1 if b else 0]),
+    ("intasblob", DataType.INT32): lambda v: struct.pack(">i", int(v)),
+    ("bigintasblob", DataType.INT64): lambda v: struct.pack(">q", int(v)),
+    ("floatasblob", DataType.FLOAT): lambda v: struct.pack(">f", float(v)),
+    ("doubleasblob", DataType.DOUBLE): lambda v: struct.pack(">d", float(v)),
+    ("timestampasblob", DataType.TIMESTAMP):
+        lambda v: struct.pack(">q", int(v)),
+}
+for (_name, _src), _f in _BLOB_PACK.items():
+    declare(f"Convert_{_name}", _name, DataType.BINARY, (_src,),
+            (lambda f: lambda x: None if x is None else f(x))(_f))
+# literal reachability: infer_type maps every int literal to INT64 and
+# every float to DOUBLE, and resolution only WIDENS — so the INT32/FLOAT/
+# TIMESTAMP-arg rows above would never match a plain literal. Companion
+# overloads (with range checks where narrowing) keep intasblob(7) legal.
+
+
+def _checked_i32(v):
+    v = int(v)
+    if not -(1 << 31) <= v < (1 << 31):
+        raise EvalError(f"intasblob: {v} out of int32 range")
+    return struct.pack(">i", v)
+
+
+declare("ConvertI64ToBlobAsI32", "intasblob", DataType.BINARY,
+        (DataType.INT64,), lambda v: None if v is None else _checked_i32(v))
+declare("ConvertDoubleToBlobAsFloat", "floatasblob", DataType.BINARY,
+        (DataType.DOUBLE,),
+        lambda v: None if v is None else struct.pack(">f", float(v)))
+declare("ConvertI64ToBlobAsTimestamp", "timestampasblob", DataType.BINARY,
+        (DataType.INT64,),
+        lambda v: None if v is None else struct.pack(">q", int(v)))
+
+_BLOB_UNPACK = {
+    ("blobasvarchar", DataType.STRING): lambda b: b.decode(),
+    ("blobastext", DataType.STRING): lambda b: b.decode(),
+    ("blobasboolean", DataType.BOOL): lambda b: b != b"\x00",
+    ("blobasint", DataType.INT32): lambda b: struct.unpack(">i", b)[0],
+    ("blobasbigint", DataType.INT64): lambda b: struct.unpack(">q", b)[0],
+    ("blobasfloat", DataType.FLOAT): lambda b: struct.unpack(">f", b)[0],
+    ("blobasdouble", DataType.DOUBLE): lambda b: struct.unpack(">d", b)[0],
+    ("blobastimestamp", DataType.TIMESTAMP):
+        lambda b: struct.unpack(">q", b)[0],
+}
+for (_name, _dst), _f in _BLOB_UNPACK.items():
+    declare(f"Convert_{_name}", _name, _dst, (DataType.BINARY,),
+            (lambda f: lambda x: None if x is None else f(x))(_f))
+
+# ------------------------------------------------------- time / uuid
+declare("NowTimeUuid", "now", DataType.TIMESTAMP, (),
+        lambda: int(time.time() * 1e6), volatile=True)
+declare("GetCurrentTimestamp", "currenttimestamp", DataType.TIMESTAMP, (),
+        lambda: int(time.time() * 1e6), volatile=True)
+declare("GetUuid", "uuid", DataType.STRING, (),
+        lambda: str(_uuid.uuid4()), volatile=True)
+declare("ConvertToTimestamp", "totimestamp", DataType.TIMESTAMP,
+        (DataType.TIMESTAMP,), lambda x: x)
+declare("ConvertToUnixTimestamp", "tounixtimestamp", DataType.INT64,
+        (DataType.TIMESTAMP,),
+        lambda x: None if x is None else int(x) // 1000)
+declare("ConvertTimeuuidToTimestamp", "dateof", DataType.TIMESTAMP,
+        (DataType.TIMESTAMP,), lambda x: x)
+
+# ----------------------------------------------- arithmetic operators
+_ARITH = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
+          "*": lambda a, b: a * b, "%": lambda a, b: a % b}
+for _op, _f in _ARITH.items():
+    declare(f"Op{_op}", _op, DataType.INT64,
+            (DataType.INT64, DataType.INT64),
+            (lambda f: lambda a, b: None if a is None or b is None
+             else f(int(a), int(b)))(_f))
+    declare(f"Op{_op}D", _op, DataType.DOUBLE,
+            (DataType.DOUBLE, DataType.DOUBLE),
+            (lambda f: lambda a, b: None if a is None or b is None
+             else f(float(a), float(b)))(_f))
+declare("OpDivide", "/", DataType.DOUBLE, (DataType.DOUBLE, DataType.DOUBLE),
+        lambda a, b: None if a is None or b is None else float(a) / float(b))
+declare("ConcatStrStr", "||", DataType.STRING,
+        (DataType.STRING, DataType.STRING),
+        lambda a, b: None if a is None or b is None else str(a) + str(b))
+declare("OpPlusStr", "+", DataType.STRING,
+        (DataType.STRING, DataType.STRING),
+        lambda a, b: None if a is None or b is None else str(a) + str(b))
+
+# -------------------------------------------------- scalar functions
+declare("StringLength", "length", DataType.INT32, (DataType.STRING,),
+        lambda s: None if s is None else len(s))
+declare("StringLower", "lower", DataType.STRING, (DataType.STRING,),
+        lambda s: None if s is None else s.lower())
+declare("StringUpper", "upper", DataType.STRING, (DataType.STRING,),
+        lambda s: None if s is None else s.upper())
+declare("StringTrim", "trim", DataType.STRING, (DataType.STRING,),
+        lambda s: None if s is None else s.strip())
+declare("SubStr", "substr", DataType.STRING,
+        (DataType.STRING, DataType.INT64, DataType.INT64),
+        lambda s, start, n: None if s is None
+        else s[max(0, int(start) - 1): max(0, int(start) - 1) + int(n)])
+declare("Abs", "abs", DataType.DOUBLE, (DataType.DOUBLE,),
+        lambda x: None if x is None else abs(x))
+declare("AbsI", "abs", DataType.INT64, (DataType.INT64,),
+        lambda x: None if x is None else abs(int(x)))
+declare("Ceil", "ceil", DataType.DOUBLE, (DataType.DOUBLE,),
+        lambda x: None if x is None else float(math.ceil(x)))
+declare("Floor", "floor", DataType.DOUBLE, (DataType.DOUBLE,),
+        lambda x: None if x is None else float(math.floor(x)))
+declare("Round", "round", DataType.DOUBLE, (DataType.DOUBLE,),
+        lambda x: None if x is None else float(round(x)))
+declare("Coalesce", "coalesce", ANY, (ANY, ANY), variadic=True,
+        fn=lambda *xs: next((x for x in xs if x is not None), None))
+declare("NullIf", "nullif", ANY, (ANY, ANY),
+        lambda a, b: None if a == b else a)
+declare("Greatest", "greatest", ANY, (ANY, ANY), variadic=True,
+        fn=lambda *xs: max((x for x in xs if x is not None), default=None))
+declare("Least", "least", ANY, (ANY, ANY), variadic=True,
+        fn=lambda *xs: min((x for x in xs if x is not None), default=None))
+
+# --------------------------------------- executor-evaluated markers
+# (ref bfql TSOpcode::kWriteTime / kTtl: the tserver fills these from
+# the entry's DocHybridTime / TTL — our executors read Row metadata)
+declare("WriteTime", "writetime", DataType.INT64, (ANY,), None)
+declare("TTL", "ttl", DataType.INT32, (ANY,), None)

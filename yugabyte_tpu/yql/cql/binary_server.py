@@ -42,10 +42,24 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
         return [schema.column(c).type for c, _op, v in where
                 if v is P.MARKER]
 
+    def value_marker_types(col_type, v):
+        """Markers in a value position, including ones nested inside
+        builtin calls — INSERT ... VALUES (?, textasblob(?)) binds two."""
+        if v is P.MARKER:
+            return [col_type]
+        if isinstance(v, P.FuncCall):
+            out = []
+            for a in v.args:
+                out.extend(value_marker_types(col_type, a))
+            return out
+        return []
+
     if isinstance(stmt, P.Insert):
         schema = table_schema(stmt.keyspace, stmt.table)
-        return [schema.column(c).type
-                for c, v in zip(stmt.columns, stmt.values) if v is P.MARKER]
+        out = []
+        for c, v in zip(stmt.columns, stmt.values):
+            out.extend(value_marker_types(schema.column(c).type, v))
+        return out
     if isinstance(stmt, P.Update):
         schema = table_schema(stmt.keyspace, stmt.table)
         out = [schema.column(c).type for c, v in stmt.assignments

@@ -27,6 +27,7 @@ from yugabyte_tpu.common.schema import (
 from yugabyte_tpu.docdb.doc_key import DocKey
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 from yugabyte_tpu.utils.status import Status, StatusError
+from yugabyte_tpu.yql import bfunc
 from yugabyte_tpu.yql import index_maintenance as IM
 from yugabyte_tpu.yql.cql import parser as P
 
@@ -104,7 +105,84 @@ class QLProcessor:
             v = params[cursor[0]]
             cursor[0] += 1
             return v
+        if isinstance(value, P.FuncCall):
+            # constant builtin in a value position: now(), uuid(),
+            # intasblob(7)... (ref bfql standard functions)
+            args = [QLProcessor._bind(a, params, cursor)
+                    for a in value.args]
+            if any(isinstance(a, P.ColumnRef) for a in args):
+                raise StatusError(Status.InvalidArgument(
+                    f"{value.name}: column references are not allowed "
+                    f"in value expressions"))
+            try:
+                v, _t = bfunc.evaluate(value.name, args)
+            except bfunc.BFError as e:
+                raise StatusError(Status.InvalidArgument(str(e)))
+            return v
         return value
+
+    # ------------------------------------------------- select-item builtins
+    def _item_label(self, item) -> str:
+        if isinstance(item, P.FuncCall):
+            inner = ", ".join(self._item_label(a) for a in item.args)
+            return f"{item.name.lower()}({inner})"
+        if isinstance(item, P.ColumnRef):
+            return item.name
+        return str(item)
+
+    def _item_type(self, item, known):
+        if isinstance(item, P.FuncCall):
+            try:
+                d = bfunc.resolve(item.name,
+                                  [self._item_type(a, known)
+                                   for a in item.args])
+            except bfunc.BFError as e:
+                raise StatusError(Status.InvalidArgument(str(e)))
+            return d.ret_type if d.ret_type is not bfunc.ANY else None
+        if isinstance(item, P.ColumnRef):
+            return known.get(item.name)
+        if isinstance(item, str):
+            return known.get(item)
+        return bfunc.infer_type(item)
+
+    def _compile_item(self, item, known):
+        """Compile one select item to fn(row_dict, row) -> value.
+
+        Builtin signatures resolve ONCE per statement (types are fixed),
+        not per row (ref: the analyzer binds PTExpr opcodes at prepare
+        time). writetime/ttl read Row metadata like the reference's
+        TSOpcode path."""
+        if isinstance(item, str):
+            return lambda d, row, _c=item: d.get(_c)
+        if isinstance(item, P.ColumnRef):
+            return lambda d, row, _c=item.name: d.get(_c)
+        if isinstance(item, P.FuncCall):
+            name = item.name.lower()
+            if name == "writetime":
+                return lambda d, row: (row.write_ht.physical_micros
+                                       if row is not None else None)
+            if name == "ttl":
+                # per-cell TTL is not retained on the read path
+                return lambda d, row: None
+            arg_fns = [self._compile_item(a, known) for a in item.args]
+            types = [self._item_type(a, known) for a in item.args]
+            try:
+                decl = bfunc.resolve(item.name, types)
+            except bfunc.BFError as e:
+                raise StatusError(Status.InvalidArgument(str(e)))
+            if decl.fn is None:
+                raise StatusError(Status.InvalidArgument(
+                    f"{name} is not valid here"))
+
+            def ev(d, row, _decl=decl, _fns=arg_fns, _n=name):
+                try:
+                    return _decl.fn(*[f(d, row) for f in _fns])
+                except bfunc.BFError as e:
+                    raise StatusError(Status.InvalidArgument(str(e)))
+                except Exception as e:
+                    raise StatusError(Status.InvalidArgument(f"{_n}: {e}"))
+            return ev
+        return lambda d, row, _v=item: _v
 
     def _doc_key_from_where(self, table: YBTable,
                             where: List[Tuple[str, str, object]]
@@ -299,11 +377,12 @@ class QLProcessor:
         schema = table.schema
         where = [(c, op, self._bind(v, params, cursor))
                  for c, op, v in stmt.where]
-        out_cols = stmt.columns or [c.name for c in schema.columns]
+        out_items = stmt.columns or [c.name for c in schema.columns]
         known = {c.name: c.type for c in schema.columns}
-        rs = ResultSet(columns=list(out_cols),
-                       types=[known.get(c) for c in out_cols],
+        rs = ResultSet(columns=[self._item_label(i) for i in out_items],
+                       types=[self._item_type(i, known) for i in out_items],
                        source=(table.namespace, table.name))
+        item_fns = [self._compile_item(i, known) for i in out_items]
         dk, residual = self._doc_key_from_where(table, where)
         full_key = (dk is not None
                     and len(dk.range_components)
@@ -313,7 +392,7 @@ class QLProcessor:
             if row is not None:
                 d = row.to_dict(schema)
                 if self._match(d, residual):
-                    rs.rows.append([d.get(c) for c in out_cols])
+                    rs.rows.append([f(d, row) for f in item_fns])
             return rs
         if dk is not None:
             # Full hash key: single-partition prefix scan on the owning
@@ -345,7 +424,7 @@ class QLProcessor:
                 continue
             if not self._match(d, residual):
                 continue
-            rs.rows.append([d.get(c) for c in out_cols])
+            rs.rows.append([f(d, row) for f in item_fns])
             count += 1
             if stmt.limit is not None and count >= stmt.limit:
                 break

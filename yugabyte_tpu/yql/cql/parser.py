@@ -84,11 +84,25 @@ class CreateIndex:
 
 
 @dataclass
+class FuncCall:
+    """Builtin invocation in a select list or value expression (ref: the
+    grammar's function_call; resolved against yql/bfunc.py's registry,
+    the bfql/directory.cc equivalent)."""
+    name: str
+    args: List[object]                        # ColumnRef | FuncCall | literal
+
+
+@dataclass(frozen=True)
+class ColumnRef:
+    name: str
+
+
+@dataclass
 class Insert:
     keyspace: Optional[str]
     table: str
     columns: List[str]
-    values: List[object]
+    values: List[object]                      # literal | FuncCall
     ttl_seconds: Optional[int] = None
 
 
@@ -300,6 +314,39 @@ class Parser:
         return CreateTable(ks, name, columns, hash_keys, range_keys,
                            num_tablets, ine)
 
+    def _peek2(self):
+        return self.toks[self.pos + 1] if self.pos + 1 < len(self.toks) \
+            else None
+
+    def _func_call(self) -> FuncCall:
+        fname = self.name()
+        self.expect_op("(")
+        args: List[object] = []
+        if not self.accept_op(")"):
+            args.append(self._func_arg())
+            while self.accept_op(","):
+                args.append(self._func_arg())
+            self.expect_op(")")
+        return FuncCall(fname, args)
+
+    def _func_arg(self):
+        tok = self.peek()
+        if tok and tok[0] == "name" and \
+                tok[1].upper() not in ("TRUE", "FALSE", "NULL"):
+            if self._peek2() == ("op", "("):
+                return self._func_call()
+            return ColumnRef(self.name())
+        return self.literal()
+
+    def _value_expr(self):
+        """literal, or a builtin call over literals — INSERT ... VALUES
+        (now(), uuid(), intasblob(7), ...)."""
+        tok = self.peek()
+        if tok and tok[0] == "name" and self._peek2() == ("op", "(") \
+                and tok[1].upper() not in ("TRUE", "FALSE", "NULL"):
+            return self._func_call()
+        return self.literal()
+
     def _insert(self) -> Insert:
         ks, table = self.qualified_name()
         self.expect_op("(")
@@ -309,9 +356,9 @@ class Parser:
         self.expect_op(")")
         self.expect_kw("VALUES")
         self.expect_op("(")
-        vals = [self.literal()]
+        vals = [self._value_expr()]
         while self.accept_op(","):
-            vals.append(self.literal())
+            vals.append(self._value_expr())
         self.expect_op(")")
         ttl = None
         if self.accept_kw("USING", "TTL"):
@@ -320,13 +367,19 @@ class Parser:
             raise ParseError(f"{len(cols)} columns but {len(vals)} values")
         return Insert(ks, table, cols, vals, ttl)
 
+    def _select_item(self):
+        tok = self.peek()
+        if tok and tok[0] == "name" and self._peek2() == ("op", "("):
+            return self._func_call()
+        return self.name()
+
     def _select(self) -> Select:
         if self.accept_op("*"):
             cols = None
         else:
-            cols = [self.name()]
+            cols = [self._select_item()]
             while self.accept_op(","):
-                cols.append(self.name())
+                cols.append(self._select_item())
         self.expect_kw("FROM")
         ks, table = self.qualified_name()
         where = self._where() if self.accept_kw("WHERE") else []

@@ -647,12 +647,64 @@ class PgSession:
                 rows_out = rows_out[: stmt.limit]
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         dicts = self._order_rows(dicts, stmt.order_by)
+        if stmt.scalar_items:
+            col_desc, rows_out = self._project_scalar(stmt.scalar_items,
+                                                      schema, dicts)
+            if stmt.limit is not None:
+                rows_out = rows_out[: stmt.limit]
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         out_cols = stmt.columns or [c.name for c in schema.columns]
         col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
         rows_out = [[d.get(c) for c in out_cols] for d in dicts]
         if stmt.limit is not None:
             rows_out = rows_out[: stmt.limit]
         return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+
+    def _project_scalar(self, items, schema, dicts):
+        """Scalar-builtin select list (yql/bfunc.py, the bfpg registry
+        equivalent). Each item compiles ONCE per statement — signature
+        resolution is type-driven and row-invariant — to a closure run
+        per row. Labels follow PG (function outputs are labeled by the
+        function name)."""
+        from yugabyte_tpu.yql import bfunc
+
+        def compile_item(it):
+            """-> (result DataType or None, fn(row_dict) -> value)"""
+            if it[0] == "col":
+                name = it[1]
+                return schema.column(name).type, \
+                    (lambda d, _c=name: d.get(_c))
+            if it[0] == "lit":
+                v = it[1]
+                return bfunc.infer_type(v), (lambda d, _v=v: _v)
+            sub = [compile_item(a) for a in it[2]]
+            try:
+                decl = bfunc.resolve(it[1], [t for t, _f in sub])
+            except bfunc.BFError as e:
+                raise PgError(Status.InvalidArgument(str(e)), "42883")
+            if decl.fn is None:
+                raise PgError(Status.InvalidArgument(
+                    f"{it[1]} is not valid here"), "42883")
+
+            def ev(d, _decl=decl, _fns=[f for _t, f in sub], _n=it[1]):
+                try:
+                    return _decl.fn(*[f(d) for f in _fns])
+                except bfunc.BFError as e:
+                    raise PgError(Status.InvalidArgument(str(e)), "22000")
+                except Exception as e:
+                    raise PgError(Status.InvalidArgument(f"{_n}: {e}"),
+                                  "22000")
+            return (None if decl.ret_type is bfunc.ANY else decl.ret_type), ev
+
+        col_desc = []
+        fns = []
+        for it in items:
+            label = it[1].lower() if it[0] == "func" else it[1]
+            t, fn = compile_item(it)
+            col_desc.append((label, PG_OIDS.get(t, 25)))
+            fns.append(fn)
+        rows_out = [[fn(d) for fn in fns] for d in dicts]
+        return col_desc, rows_out
 
     # ------------------------------------------------------ UPDATE/DELETE
     def _scan(self, table: YBTable, filters):

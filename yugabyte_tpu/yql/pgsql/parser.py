@@ -98,6 +98,10 @@ class Select:
     # aggregate select list: (func, column or None for COUNT(*)); when
     # non-empty the output is one row per group (group_by) or one row
     aggregates: List[Tuple[str, Optional[str]]] = field(default_factory=list)
+    # scalar-builtin select list (yql/bfunc.py registry): when non-empty,
+    # the ORDERED output items — ("col", name) | ("func", fname, args),
+    # args being ("col", name) | ("lit", value) | nested ("func", ...)
+    scalar_items: List = field(default_factory=list)
     group_by: Optional[str] = None
     order_by: List[Tuple[str, bool]] = field(default_factory=list)  # (col, desc)
 
@@ -280,12 +284,13 @@ class PgParser(_BaseParser):
     _AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
 
     def _select_item(self):
-        """-> ("col", name) | ("agg", func, col_or_None)"""
+        """-> ("col", name) | ("agg", func, col_or_None) |
+        ("func", name, args) for scalar builtins (yql/bfunc.py)"""
         tok = self.peek()
+        nxt = self.toks[self.pos + 1] if self.pos + 1 < len(
+            self.toks) else None
         if tok is not None and tok[0] == "name" \
                 and tok[1].upper() in self._AGG_FUNCS:
-            nxt = self.toks[self.pos + 1] if self.pos + 1 < len(
-                self.toks) else None
             if nxt == ("op", "("):
                 func = self.name().upper()
                 self.expect_op("(")
@@ -297,12 +302,37 @@ class PgParser(_BaseParser):
                     col = self.name()
                 self.expect_op(")")
                 return ("agg", func, col)
+        if tok is not None and tok[0] == "name" and nxt == ("op", "("):
+            return self._scalar_func()
         return ("col", self.name())
+
+    def _scalar_func(self):
+        fname = self.name()
+        self.expect_op("(")
+        args: List = []
+        if not self.accept_op(")"):
+            while True:
+                tok = self.peek()
+                nxt = self.toks[self.pos + 1] if self.pos + 1 < len(
+                    self.toks) else None
+                if tok is not None and tok[0] == "name" \
+                        and nxt == ("op", "("):
+                    args.append(self._scalar_func())
+                elif tok is not None and tok[0] == "name" \
+                        and tok[1].upper() not in ("TRUE", "FALSE", "NULL"):
+                    args.append(("col", self.name()))
+                else:
+                    args.append(("lit", self.literal()))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return ("func", fname, args)
 
     def _select(self) -> Select:
         columns: Optional[List[str]] = None
         count_star = False
         aggregates: List[Tuple[str, Optional[str]]] = []
+        scalar_items: List = []
         if self.accept_op("*"):
             pass
         else:
@@ -311,9 +341,32 @@ class PgParser(_BaseParser):
                 items.append(self._select_item())
             aggs = [i for i in items if i[0] == "agg"]
             cols = [i[1] for i in items if i[0] == "col"]
+            funcs = [i for i in items if i[0] == "func"]
+            if aggs and funcs:
+                raise ParseError(
+                    "mixing aggregates and scalar functions in one "
+                    "select list is not supported")
             if aggs:
                 aggregates = [(f, c) for _k, f, c in aggs]
                 columns = cols or None   # group-by columns, if any
+            elif funcs:
+                scalar_items = items
+                # base columns the evaluation needs (validated + fetched)
+                def _refs(it):
+                    if it[0] == "col":
+                        return [it[1]]
+                    if it[0] == "func":
+                        out = []
+                        for a in it[2]:
+                            out.extend(_refs(a) if a[0] != "lit" else [])
+                        return out
+                    return []
+                seen = []
+                for it in items:
+                    for r in _refs(it):
+                        if r not in seen:
+                            seen.append(r)
+                columns = seen or None
             else:
                 columns = cols
         self.expect_kw("FROM")
@@ -345,7 +398,7 @@ class PgParser(_BaseParser):
             aggregates = []
         return Select(name, columns, where, limit, count_star,
                       aggregates=aggregates, group_by=group_by,
-                      order_by=order_by)
+                      order_by=order_by, scalar_items=scalar_items)
 
     def _pg_where(self) -> List[Tuple[str, str, object]]:
         if not self.accept_kw("WHERE"):
@@ -401,9 +454,18 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
         limit = sub(stmt.limit)
         if limit is not None:
             limit = int(limit)
+
+        def sub_item(it):
+            if it[0] == "lit":
+                return ("lit", sub(it[1]))
+            if it[0] == "func":
+                return ("func", it[1], [sub_item(a) for a in it[2]])
+            return it
         return replace(stmt, where=[(c, op, sub(v))
                                     for c, op, v in stmt.where],
-                       limit=limit)
+                       limit=limit,
+                       scalar_items=[sub_item(i)
+                                     for i in stmt.scalar_items])
     if isinstance(stmt, Update):
         return replace(stmt,
                        assignments=[(c, sub(v))
