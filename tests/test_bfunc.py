@@ -102,6 +102,7 @@ def ql(tmp_path_factory):
         MiniCluster, MiniClusterOptions)
     from yugabyte_tpu.yql.cql.executor import QLProcessor
     from yugabyte_tpu.utils import flags
+    old_rf = flags.get_flag("replication_factor")
     flags.set_flag("replication_factor", 1)
     c = MiniCluster(MiniClusterOptions(
         num_masters=1, num_tservers=1,
@@ -113,6 +114,7 @@ def ql(tmp_path_factory):
                  "PRIMARY KEY ((k)))")
     yield proc
     c.shutdown()
+    flags.set_flag("replication_factor", old_rf)
 
 
 def test_cql_builtin_in_select(ql):
@@ -159,6 +161,7 @@ def test_pg_scalar_functions(tmp_path):
         MiniCluster, MiniClusterOptions)
     from yugabyte_tpu.yql.pgsql.server import PgServer
     from yugabyte_tpu.utils import flags
+    old_rf = flags.get_flag("replication_factor")
     flags.set_flag("replication_factor", 1)
     c = MiniCluster(MiniClusterOptions(
         num_masters=1, num_tservers=1,
@@ -184,6 +187,7 @@ def test_pg_scalar_functions(tmp_path):
         server.shutdown()
     finally:
         c.shutdown()
+        flags.set_flag("replication_factor", old_rf)
 
 
 def test_literal_reachable_conversions():
@@ -207,3 +211,29 @@ def test_cql_runtime_error_is_status_not_crash(ql):
     # the processor is still usable afterwards
     rs = ql.execute("SELECT v FROM t WHERE k = 'e'")
     assert rs.rows == [["z"]]
+
+
+def test_cql_select_list_marker_binds(ql):
+    """'?' inside a select-list builtin binds positionally (before WHERE
+    markers, matching statement-text order)."""
+    ql.execute("INSERT INTO t (k, v) VALUES ('m', NULL)")
+    rs = ql.execute("SELECT coalesce(v, ?) FROM t WHERE k = ?",
+                    ("dflt", "m"))
+    assert rs.rows == [["dflt"]]
+
+
+def test_prepared_marker_types_inside_func_args(ql):
+    """Markers that are function ARGUMENTS are typed by the function's
+    parameter, not the target column (textasblob(?) binds a STRING even
+    into a BLOB column)."""
+    from yugabyte_tpu.yql.cql import parser as P
+    from yugabyte_tpu.yql.cql.binary_server import infer_marker_types
+    ql.execute("CREATE TABLE tb (k text, b blob, PRIMARY KEY ((k)))")
+    stmt = P.parse("INSERT INTO tb (k, b) VALUES (?, textasblob(?))")
+    types = infer_marker_types(stmt, ql)
+    assert types == [DataType.STRING, DataType.STRING]
+    # and executing with the string param produces the encoded blob
+    ql.execute("INSERT INTO tb (k, b) VALUES (?, textasblob(?))",
+               ("x", "payload"))
+    rs = ql.execute("SELECT b FROM tb WHERE k = 'x'")
+    assert rs.rows == [[b"payload"]]

@@ -30,7 +30,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from yugabyte_tpu.rpc.codec import dumps, loads
 from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Code, Status, StatusError
-from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils.trace import TRACE, Trace
 
 flags.define_flag("rpc_default_timeout_s", 15.0,
                   "default outbound call deadline")
@@ -165,6 +165,13 @@ class Messenger:
         self._conns_lock = threading.Lock()
         self._inbound: list = []
         self._shutdown = False
+        # /rpcz bookkeeping (ref rpc/rpcz_store.cc): in-flight inbound
+        # calls + a ring of recently completed ones
+        self._rpcz_lock = threading.Lock()
+        self._rpcz_seq = 0
+        self._rpcz_inflight: Dict[int, dict] = {}
+        from collections import deque
+        self._rpcz_recent: deque = deque(maxlen=100)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=f"rpc-accept-{name}")
         self._accept_thread.start()
@@ -201,7 +208,7 @@ class Messenger:
                 # not head-of-line-block the connection (the reference runs
                 # handlers on a ServicePool for the same reason).
                 threading.Thread(
-                    target=self._dispatch, args=(conn, write_lock, req),
+                    target=self._dispatch, args=(conn, write_lock, req, peer),
                     daemon=True, name=f"rpc-handler-{self.name}").start()
         except (ConnectionError, OSError):
             pass
@@ -209,15 +216,51 @@ class Messenger:
             conn.close()
 
     def _dispatch(self, conn: socket.socket, write_lock: threading.Lock,
-                  req: dict) -> None:
-        resp = self._invoke(req["svc"], req["mth"], req["args"])
+                  req: dict, peer=None) -> None:
+        resp = self._invoke(req["svc"], req["mth"], req["args"], peer=peer)
         resp["id"] = req["id"]
         try:
             _send_frame(conn, write_lock, dumps(resp))
         except OSError:
             pass  # caller gone; response dropped like an expired call
 
-    def _invoke(self, svc: str, mth: str, args: dict) -> dict:
+    def _invoke(self, svc: str, mth: str, args: dict, peer=None) -> dict:
+        entry = {"svc": svc, "mth": mth, "start": time.time(),
+                 "peer": f"{peer[0]}:{peer[1]}" if peer else "local"}
+        with self._rpcz_lock:
+            self._rpcz_seq += 1
+            rid = self._rpcz_seq
+            self._rpcz_inflight[rid] = entry
+        resp = None
+        try:
+            # request-scoped trace: handler TRACE() calls land in /tracez
+            with Trace(f"{svc}.{mth}"):
+                resp = self._invoke_inner(svc, mth, args)
+        finally:
+            # entry is fully populated BEFORE it is published — rpcz()
+            # hands out references, so late mutation would race the
+            # webserver's serialization
+            done = dict(entry)
+            done["duration_ms"] = round(
+                (time.time() - entry["start"]) * 1e3, 2)
+            done["code"] = resp["code"] if resp is not None else None
+            with self._rpcz_lock:
+                self._rpcz_inflight.pop(rid, None)
+                self._rpcz_recent.append(done)
+        return resp
+
+    def rpcz(self) -> dict:
+        """In-flight + recently completed inbound RPCs (ref /rpcz,
+        rpc/rpcz_store.cc)."""
+        now = time.time()
+        with self._rpcz_lock:
+            inflight = [dict(e, elapsed_ms=round((now - e["start"]) * 1e3, 2))
+                        for e in self._rpcz_inflight.values()]
+            recent = list(self._rpcz_recent)
+        return {"inbound_in_flight": inflight,
+                "inbound_recent": recent}
+
+    def _invoke_inner(self, svc: str, mth: str, args: dict) -> dict:
         handler = self._services.get(svc)
         if handler is None:
             return {"code": Code.SERVICE_UNAVAILABLE.value,

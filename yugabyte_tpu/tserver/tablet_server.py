@@ -111,6 +111,12 @@ class TabletServer:
                 "/tablets", self.tablet_manager.generate_report)
             self.webserver.register_json(
                 "/memz", lambda: root_tracker().tree_json())
+            # observability endpoints (ref /rpcz rpc/rpcz_store.cc,
+            # /tracez + /threadz from util/debug-util.cc)
+            from yugabyte_tpu.utils import trace as trace_mod
+            self.webserver.register_json("/rpcz", self.messenger.rpcz)
+            self.webserver.register_json("/tracez", trace_mod.tracez)
+            self.webserver.register_json("/threadz", trace_mod.threadz)
 
     def _tablet_peers(self):
         return self.tablet_manager.peers()

@@ -17,12 +17,13 @@ _current_trace: contextvars.ContextVar[Optional["Trace"]] = contextvars.ContextV
 
 
 class Trace:
-    __slots__ = ("entries", "start", "children", "_token")
+    __slots__ = ("entries", "start", "children", "name", "_token")
 
-    def __init__(self):
+    def __init__(self, name: str = ""):
         self.entries: List[Tuple[float, str]] = []
         self.start = time.monotonic()
         self.children: List["Trace"] = []
+        self.name = name
 
     def message(self, msg: str) -> None:
         self.entries.append((time.monotonic() - self.start, msg))
@@ -40,6 +41,8 @@ class Trace:
 
     def __exit__(self, *exc) -> None:
         _current_trace.reset(self._token)
+        if self.entries:
+            _record_tracez(self)
 
 
 def TRACE(msg: str, *args) -> None:
@@ -51,6 +54,50 @@ def TRACE(msg: str, *args) -> None:
 
 def current_trace() -> Optional[Trace]:
     return _current_trace.get()
+
+
+# ------------------------------------------------------------- /tracez
+# Ring of recently completed traces (ref: the reference's /tracez page
+# over yb::Trace sampling). Completed scoped Traces with any entries
+# land here; the webserver serves them as JSON.
+_tracez_lock = __import__("threading").Lock()
+_TRACEZ: List[dict] = []
+_TRACEZ_CAP = 64
+
+
+def _record_tracez(t: Trace) -> None:
+    entry = {"name": t.name or "request",
+             "wall_ts": time.time(),
+             "duration_ms": round((time.monotonic() - t.start) * 1e3, 3),
+             "dump": t.dump()}
+    with _tracez_lock:
+        _TRACEZ.append(entry)
+        if len(_TRACEZ) > _TRACEZ_CAP:
+            del _TRACEZ[: len(_TRACEZ) - _TRACEZ_CAP]
+
+
+def tracez() -> List[dict]:
+    with _tracez_lock:
+        return list(reversed(_TRACEZ))
+
+
+def threadz() -> List[dict]:
+    """Live thread stack dump (the reference exposes /pprof + /threadz
+    from the stack-trace collector, util/debug-util.cc)."""
+    import sys
+    import threading as _t
+    import traceback
+    frames = sys._current_frames()
+    out = []
+    for th in _t.enumerate():
+        fr = frames.get(th.ident)
+        out.append({
+            "name": th.name,
+            "ident": th.ident,
+            "daemon": th.daemon,
+            "stack": traceback.format_stack(fr) if fr is not None else [],
+        })
+    return out
 
 
 class LongOperationTracker:

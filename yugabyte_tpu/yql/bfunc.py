@@ -80,6 +80,26 @@ def is_builtin(ql_name: str) -> bool:
     return ql_name.lower() in _REGISTRY
 
 
+def marker_arg_type(ql_name: str, arg_index: int):
+    """Type of a bind marker sitting at arg_index of a builtin call, if
+    every overload agrees on it (prepared-statement metadata for
+    INSERT ... VALUES (textasblob(?))). None = ambiguous/unknown."""
+    types = set()
+    for d in _REGISTRY.get(ql_name.lower(), []):
+        want = d.arg_types
+        if d.variadic and arg_index >= len(want):
+            t = want[-1]
+        elif arg_index < len(want):
+            t = want[arg_index]
+        else:
+            continue
+        types.add(t)
+    if len(types) == 1:
+        t = types.pop()
+        return None if t is ANY else t
+    return None
+
+
 def _convertible(have, want) -> bool:
     if want is ANY or have is None or have == want:
         return True
@@ -257,6 +277,14 @@ declare("ConvertToUnixTimestamp", "tounixtimestamp", DataType.INT64,
         lambda x: None if x is None else int(x) // 1000)
 declare("ConvertTimeuuidToTimestamp", "dateof", DataType.TIMESTAMP,
         (DataType.TIMESTAMP,), lambda x: x)
+# literal-reachability companions (int literals infer INT64, which does
+# not widen into TIMESTAMP)
+declare("ConvertI64ToTimestamp", "totimestamp", DataType.TIMESTAMP,
+        (DataType.INT64,), lambda x: None if x is None else int(x))
+declare("ConvertI64ToUnixTimestamp", "tounixtimestamp", DataType.INT64,
+        (DataType.INT64,), lambda x: None if x is None else int(x) // 1000)
+declare("DateOfI64", "dateof", DataType.TIMESTAMP,
+        (DataType.INT64,), lambda x: None if x is None else int(x))
 
 # ----------------------------------------------- arithmetic operators
 _ARITH = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
@@ -301,7 +329,9 @@ declare("Ceil", "ceil", DataType.DOUBLE, (DataType.DOUBLE,),
 declare("Floor", "floor", DataType.DOUBLE, (DataType.DOUBLE,),
         lambda x: None if x is None else float(math.floor(x)))
 declare("Round", "round", DataType.DOUBLE, (DataType.DOUBLE,),
-        lambda x: None if x is None else float(round(x)))
+        # half-away-from-zero like PG/CQL, not Python's banker's rounding
+        lambda x: None if x is None
+        else float(math.floor(x + 0.5) if x >= 0 else math.ceil(x - 0.5)))
 declare("Coalesce", "coalesce", ANY, (ANY, ANY), variadic=True,
         fn=lambda *xs: next((x for x in xs if x is not None), None))
 declare("NullIf", "nullif", ANY, (ANY, ANY),

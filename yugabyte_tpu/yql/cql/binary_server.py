@@ -44,15 +44,35 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
 
     def value_marker_types(col_type, v):
         """Markers in a value position, including ones nested inside
-        builtin calls — INSERT ... VALUES (?, textasblob(?)) binds two."""
+        builtin calls — INSERT ... VALUES (?, textasblob(?)) binds two.
+        A marker that is a FUNCTION ARGUMENT is typed by the function's
+        parameter (textasblob takes STRING even into a BLOB column),
+        falling back to the column type only when overloads disagree."""
+        from yugabyte_tpu.yql import bfunc
         if v is P.MARKER:
             return [col_type]
         if isinstance(v, P.FuncCall):
             out = []
-            for a in v.args:
-                out.extend(value_marker_types(col_type, a))
+            for i, a in enumerate(v.args):
+                if a is P.MARKER:
+                    out.append(bfunc.marker_arg_type(v.name, i) or col_type)
+                else:
+                    out.extend(value_marker_types(col_type, a))
             return out
         return []
+
+    def select_item_types(items):
+        from yugabyte_tpu.yql import bfunc
+        out: List[DataType] = []
+        for it in (items or []):
+            if isinstance(it, P.FuncCall):
+                for i, a in enumerate(it.args):
+                    if a is P.MARKER:
+                        out.append(bfunc.marker_arg_type(it.name, i)
+                                   or DataType.STRING)
+                    elif isinstance(a, P.FuncCall):
+                        out.extend(select_item_types([a]))
+        return out
 
     if isinstance(stmt, P.Insert):
         schema = table_schema(stmt.keyspace, stmt.table)
@@ -70,7 +90,9 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
         return where_types(schema, stmt.where)
     if isinstance(stmt, P.Select):
         schema = table_schema(stmt.keyspace, stmt.table)
-        return where_types(schema, stmt.where)
+        # select-list markers precede WHERE markers in statement order
+        return select_item_types(stmt.columns) + \
+            where_types(schema, stmt.where)
     if isinstance(stmt, P.Transaction):
         out: List[DataType] = []
         for s in stmt.statements:

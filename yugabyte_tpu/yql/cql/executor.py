@@ -130,29 +130,32 @@ class QLProcessor:
             return item.name
         return str(item)
 
-    def _item_type(self, item, known):
+    def _item_type(self, item, known, as_column: bool = True):
+        """as_column: a bare str is a column name only at the TOP of a
+        select item; inside function ARGUMENTS plain strings are string
+        literals (columns there are P.ColumnRef)."""
         if isinstance(item, P.FuncCall):
             try:
                 d = bfunc.resolve(item.name,
-                                  [self._item_type(a, known)
+                                  [self._item_type(a, known, False)
                                    for a in item.args])
             except bfunc.BFError as e:
                 raise StatusError(Status.InvalidArgument(str(e)))
             return d.ret_type if d.ret_type is not bfunc.ANY else None
         if isinstance(item, P.ColumnRef):
             return known.get(item.name)
-        if isinstance(item, str):
+        if isinstance(item, str) and as_column:
             return known.get(item)
         return bfunc.infer_type(item)
 
-    def _compile_item(self, item, known):
+    def _compile_item(self, item, known, as_column: bool = True):
         """Compile one select item to fn(row_dict, row) -> value.
 
         Builtin signatures resolve ONCE per statement (types are fixed),
         not per row (ref: the analyzer binds PTExpr opcodes at prepare
         time). writetime/ttl read Row metadata like the reference's
-        TSOpcode path."""
-        if isinstance(item, str):
+        TSOpcode path. as_column: see _item_type."""
+        if isinstance(item, str) and as_column:
             return lambda d, row, _c=item: d.get(_c)
         if isinstance(item, P.ColumnRef):
             return lambda d, row, _c=item.name: d.get(_c)
@@ -164,8 +167,9 @@ class QLProcessor:
             if name == "ttl":
                 # per-cell TTL is not retained on the read path
                 return lambda d, row: None
-            arg_fns = [self._compile_item(a, known) for a in item.args]
-            types = [self._item_type(a, known) for a in item.args]
+            arg_fns = [self._compile_item(a, known, False)
+                       for a in item.args]
+            types = [self._item_type(a, known, False) for a in item.args]
             try:
                 decl = bfunc.resolve(item.name, types)
             except bfunc.BFError as e:
@@ -375,9 +379,22 @@ class QLProcessor:
                 cursor: List[int]) -> ResultSet:
         table = self._table(stmt.keyspace, stmt.table)
         schema = table.schema
+
+        def bind_item(it):
+            """Bind '?' markers inside select-list builtin calls. Select
+            items are bound BEFORE the WHERE clause: positional params
+            arrive in statement-text order."""
+            if isinstance(it, P.FuncCall):
+                return P.FuncCall(it.name, [bind_item(a) for a in it.args])
+            if it is P.MARKER:
+                return self._bind(it, params, cursor)
+            return it
+
+        out_items = [bind_item(i)
+                     for i in (stmt.columns
+                               or [c.name for c in schema.columns])]
         where = [(c, op, self._bind(v, params, cursor))
                  for c, op, v in stmt.where]
-        out_items = stmt.columns or [c.name for c in schema.columns]
         known = {c.name: c.type for c in schema.columns}
         rs = ResultSet(columns=[self._item_label(i) for i in out_items],
                        types=[self._item_type(i, known) for i in out_items],

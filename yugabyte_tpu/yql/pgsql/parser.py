@@ -283,12 +283,15 @@ class PgParser(_BaseParser):
 
     _AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
 
+    def _peek2(self):
+        return self.toks[self.pos + 1] if self.pos + 1 < len(self.toks) \
+            else None
+
     def _select_item(self):
         """-> ("col", name) | ("agg", func, col_or_None) |
         ("func", name, args) for scalar builtins (yql/bfunc.py)"""
         tok = self.peek()
-        nxt = self.toks[self.pos + 1] if self.pos + 1 < len(
-            self.toks) else None
+        nxt = self._peek2()
         if tok is not None and tok[0] == "name" \
                 and tok[1].upper() in self._AGG_FUNCS:
             if nxt == ("op", "("):
@@ -313,8 +316,7 @@ class PgParser(_BaseParser):
         if not self.accept_op(")"):
             while True:
                 tok = self.peek()
-                nxt = self.toks[self.pos + 1] if self.pos + 1 < len(
-                    self.toks) else None
+                nxt = self._peek2()
                 if tok is not None and tok[0] == "name" \
                         and nxt == ("op", "("):
                     args.append(self._scalar_func())
