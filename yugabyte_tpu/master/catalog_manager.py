@@ -642,13 +642,28 @@ class CatalogManager:
         return out
 
     # ------------------------------------------------------------ snapshots
-    def create_table_snapshot(self, namespace: str, name: str) -> dict:
+    def create_table_snapshot(self, namespace: str, name: str,
+                              schedule_id: Optional[str] = None) -> dict:
         """Coordinate a consistent table snapshot: a raft-replicated
         snapshot barrier on every tablet (ref master SnapshotCoordinator,
         ent/src/yb/master/async_snapshot_tasks.cc); metadata persists in
-        the sys catalog so restores survive master failover."""
+        the sys catalog so restores survive master failover.
+
+        snapshot_ht (master clock AFTER every barrier replicated) bounds
+        the snapshot's coverage: all writes with HT <= any T <=
+        snapshot_ht are contained — per tablet, a write with a smaller HT
+        precedes the barrier in raft order — which is what PITR's
+        restore-to-time selection relies on."""
+        import time as _time
         table = self.get_table(namespace, name)
         snapshot_id = uuid.uuid4().hex[:16]
+        # coverage bound sampled BEFORE the first barrier: a write with
+        # HT <= this time precedes every barrier in per-tablet order, so
+        # the snapshot provably contains all state up to snapshot_micros.
+        # (Stamping after the barriers would claim coverage for writes
+        # that landed between a tablet's barrier and the stamp — a PITR
+        # restore would silently miss them.)
+        snapshot_micros = int(_time.time() * 1e6)
         addr_map = self.ts_manager.addr_map()
         with self._lock:
             tablet_ids = [t for t in table["tablet_ids"]
@@ -667,10 +682,89 @@ class CatalogManager:
                 "table": name, "table_id": table["table_id"],
                 "schema": table["schema"],
                 "partition_schema": table["partition_schema"],
-                "tablet_ids": tablet_ids}
+                "tablet_ids": tablet_ids,
+                "snapshot_micros": snapshot_micros,
+                "schedule_id": schedule_id}
         with self._lock:
             self.sys.upsert("snapshot", snapshot_id, meta)
         return meta
+
+    # ----------------------------------------------- PITR snapshot schedules
+    def create_snapshot_schedule(self, namespace: str, name: str,
+                                 interval_s: float,
+                                 retention_s: float) -> dict:
+        """Periodic snapshots with retention — the PITR substrate (ref
+        ent master SnapshotCoordinator schedules,
+        master_snapshot_coordinator.cc). The master bg loop takes a
+        snapshot every interval and prunes ones past retention; any time
+        within retention is restorable (restore reads the earliest
+        snapshot taken at-or-after the target time AT that time — MVCC
+        history inside the snapshot files carries the exact state)."""
+        self.get_table(namespace, name)   # validates existence
+        sched = {"schedule_id": uuid.uuid4().hex[:16],
+                 "namespace": namespace, "table": name,
+                 "interval_s": float(interval_s),
+                 "retention_s": float(retention_s),
+                 "last_snapshot_unix": 0.0}
+        with self._lock:
+            self.sys.upsert("snapshot_schedule", sched["schedule_id"], sched)
+        return sched
+
+    def list_snapshot_schedules(self) -> List[dict]:
+        return [m for t, _id, m in self.sys.scan_all()
+                if t == "snapshot_schedule"]
+
+    def delete_snapshot_schedule(self, schedule_id: str) -> None:
+        with self._lock:
+            self.sys.delete("snapshot_schedule", schedule_id)
+
+    def run_snapshot_schedules(self) -> int:
+        """One bg-loop tick: take due snapshots, prune expired ones.
+        Returns snapshots taken."""
+        import time as _time
+        now = _time.time()
+        taken = 0
+        snapshots = self.list_snapshots()   # one catalog scan per tick
+        for sched in self.list_snapshot_schedules():
+            if now - sched["last_snapshot_unix"] >= sched["interval_s"]:
+                try:
+                    snapshots.append(self.create_table_snapshot(
+                        sched["namespace"], sched["table"],
+                        schedule_id=sched["schedule_id"]))
+                    taken += 1
+                    sched = dict(sched, last_snapshot_unix=now)
+                    with self._lock:
+                        self.sys.upsert("snapshot_schedule",
+                                        sched["schedule_id"], sched)
+                except StatusError:
+                    pass  # table gone / no leader: retried next tick;
+                    # retention pruning below must still run (a dropped
+                    # table's expired snapshots would otherwise leak
+                    # forever)
+            horizon = (now - sched["retention_s"]) * 1e6
+            for snap in snapshots:
+                if snap.get("schedule_id") == sched["schedule_id"] and \
+                        snap.get("snapshot_micros", 0) < horizon:
+                    try:
+                        self.delete_snapshot(snap["snapshot_id"])
+                    except StatusError:
+                        pass
+        return taken
+
+    def pick_restore_snapshot(self, namespace: str, name: str,
+                              restore_micros: int) -> dict:
+        """The PITR selection rule: the EARLIEST snapshot whose
+        snapshot_micros >= the restore time contains the target state in
+        its MVCC history (a snapshot taken before the target time lacks
+        the writes between its barrier and the target)."""
+        cands = [s for s in self.list_snapshots()
+                 if s["namespace"] == namespace and s["table"] == name
+                 and s.get("snapshot_micros", 0) >= restore_micros]
+        if not cands:
+            raise StatusError(Status.NotFound(
+                f"no snapshot of {namespace}.{name} covers time "
+                f"{restore_micros} — outside the retention window?"))
+        return min(cands, key=lambda s: s["snapshot_micros"])
 
     def list_snapshots(self) -> List[dict]:
         return [m for _t, _id, m in self.sys.scan_all()

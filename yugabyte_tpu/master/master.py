@@ -138,6 +138,24 @@ class MasterService:
         self._leader_catalog().delete_snapshot(snapshot_id)
         return True
 
+    def create_snapshot_schedule(self, namespace: str, name: str,
+                                 interval_s: float,
+                                 retention_s: float) -> dict:
+        return self._leader_catalog().create_snapshot_schedule(
+            namespace, name, interval_s, retention_s)
+
+    def list_snapshot_schedules(self) -> List[dict]:
+        return self._leader_catalog().list_snapshot_schedules()
+
+    def delete_snapshot_schedule(self, schedule_id: str) -> bool:
+        self._leader_catalog().delete_snapshot_schedule(schedule_id)
+        return True
+
+    def pick_restore_snapshot(self, namespace: str, name: str,
+                              restore_micros: int) -> dict:
+        return self._leader_catalog().pick_restore_snapshot(
+            namespace, name, restore_micros)
+
     def get_tablet_leader(self, tablet_id: str) -> Optional[str]:
         """host:port of a tablet's current leader (transaction status
         routing; ref master GetTabletLocations)."""
@@ -314,6 +332,7 @@ class Master:
                     self.catalog.ensure_loaded()
                     self.catalog.reconcile_tablets()
                     self.catalog.retire_split_parents()
+                    self.catalog.run_snapshot_schedules()
                     self.load_balancer.run_pass()
                 else:
                     was_leader = False

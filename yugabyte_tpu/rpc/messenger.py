@@ -291,7 +291,16 @@ class Messenger:
         timeout_s = timeout_s if timeout_s is not None else \
             flags.get_flag("rpc_default_timeout_s")
         if addr == self.address:
-            resp = self._invoke(svc, mth, args)
+            # local bypass is NOT an inbound RPC: skip /rpcz accounting,
+            # and attach its trace as a CHILD of the caller's request
+            # trace so slow-op dumps keep the nested-call section
+            from yugabyte_tpu.utils.trace import current_trace
+            parent = current_trace()
+            child = Trace(f"local:{svc}.{mth}", record=parent is None)
+            if parent is not None:
+                parent.children.append(child)
+            with child:
+                resp = self._invoke_inner(svc, mth, args)
         else:
             host, port_s = addr.rsplit(":", 1)
             conn = self._get_conn((host, int(port_s)))

@@ -17,13 +17,17 @@ _current_trace: contextvars.ContextVar[Optional["Trace"]] = contextvars.ContextV
 
 
 class Trace:
-    __slots__ = ("entries", "start", "children", "name", "_token")
+    __slots__ = ("entries", "start", "children", "name", "record",
+                 "_token")
 
-    def __init__(self, name: str = ""):
+    def __init__(self, name: str = "", record: bool = True):
         self.entries: List[Tuple[float, str]] = []
         self.start = time.monotonic()
         self.children: List["Trace"] = []
         self.name = name
+        # record=False: a child attached to a parent trace — it renders
+        # inside the parent's /tracez entry, not as its own
+        self.record = record
 
     def message(self, msg: str) -> None:
         self.entries.append((time.monotonic() - self.start, msg))
@@ -41,7 +45,7 @@ class Trace:
 
     def __exit__(self, *exc) -> None:
         _current_trace.reset(self._token)
-        if self.entries:
+        if self.record and self.entries:
             _record_tracez(self)
 
 

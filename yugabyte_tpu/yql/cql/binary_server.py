@@ -61,17 +61,28 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
             return out
         return []
 
-    def select_item_types(items):
+    def select_item_types(schema, items):
         from yugabyte_tpu.yql import bfunc
         out: List[DataType] = []
         for it in (items or []):
             if isinstance(it, P.FuncCall):
+                # ambiguous markers (ANY-typed params, e.g. coalesce)
+                # fall back to a sibling COLUMN argument's type — the
+                # marker almost always stands in for that column's value
+                sibling = None
+                for a in it.args:
+                    if isinstance(a, P.ColumnRef):
+                        try:
+                            sibling = schema.column(a.name).type
+                        except Exception:
+                            sibling = None
+                        break
                 for i, a in enumerate(it.args):
                     if a is P.MARKER:
                         out.append(bfunc.marker_arg_type(it.name, i)
-                                   or DataType.STRING)
+                                   or sibling or DataType.STRING)
                     elif isinstance(a, P.FuncCall):
-                        out.extend(select_item_types([a]))
+                        out.extend(select_item_types(schema, [a]))
         return out
 
     if isinstance(stmt, P.Insert):
@@ -91,7 +102,7 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
     if isinstance(stmt, P.Select):
         schema = table_schema(stmt.keyspace, stmt.table)
         # select-list markers precede WHERE markers in statement order
-        return select_item_types(stmt.columns) + \
+        return select_item_types(schema, stmt.columns) + \
             where_types(schema, stmt.where)
     if isinstance(stmt, P.Transaction):
         out: List[DataType] = []

@@ -283,10 +283,6 @@ class PgParser(_BaseParser):
 
     _AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
 
-    def _peek2(self):
-        return self.toks[self.pos + 1] if self.pos + 1 < len(self.toks) \
-            else None
-
     def _select_item(self):
         """-> ("col", name) | ("agg", func, col_or_None) |
         ("func", name, args) for scalar builtins (yql/bfunc.py)"""
