@@ -715,6 +715,14 @@ class CatalogManager:
                 if t == "snapshot_schedule"]
 
     def delete_snapshot_schedule(self, schedule_id: str) -> None:
+        # the schedule's snapshots go with it — with no schedule there is
+        # no retention horizon left to ever prune them
+        for snap in self.list_snapshots():
+            if snap.get("schedule_id") == schedule_id:
+                try:
+                    self.delete_snapshot(snap["snapshot_id"])
+                except StatusError:
+                    pass
         with self._lock:
             self.sys.delete("snapshot_schedule", schedule_id)
 
@@ -734,8 +742,13 @@ class CatalogManager:
                     taken += 1
                     sched = dict(sched, last_snapshot_unix=now)
                     with self._lock:
-                        self.sys.upsert("snapshot_schedule",
-                                        sched["schedule_id"], sched)
+                        # re-check under the lock: a concurrent
+                        # delete_snapshot_schedule must not be undone by
+                        # upserting our stale copy back
+                        if self.sys.get("snapshot_schedule",
+                                        sched["schedule_id"]) is not None:
+                            self.sys.upsert("snapshot_schedule",
+                                            sched["schedule_id"], sched)
                 except StatusError:
                     pass  # table gone / no leader: retried next tick;
                     # retention pruning below must still run (a dropped

@@ -375,12 +375,46 @@ class DB:
 
 
 def _sst_iter_from(reader: SSTReader, seek: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    """Merged-stream source over one SST from `seek` (internal-key order).
+
+    The first block is entered by BINARY SEARCH on the reconstructed
+    internal keys — the old linear skip from the block start cost ~half a
+    block (~2K entry decodes) per point read and dominated YCSB-C wall
+    time (ref: the reference's block restart-point binary seek,
+    rocksdb/table/block.cc Seek)."""
     prefix_seek, _ = split_key_and_ht(seek)
-    start_block = reader.seek_block(prefix_seek if prefix_seek else seek)
-    for key_prefix, dht, value, _fl in reader.iter_entries(start_block):
-        ikey = make_internal_key(key_prefix, dht)
-        if ikey >= seek:
-            yield ikey, value
+    b = reader.seek_block(prefix_seek if prefix_seek else seek)
+    # Search phase: binary-search each block until one holds an entry
+    # >= seek. The block index is on key PREFIXES while seek carries the
+    # HT suffix, so a version chain spilling across blocks can leave the
+    # first (or several) candidate blocks entirely below seek — stopping
+    # the search after one block would emit too-new versions unfiltered.
+    while b < reader.n_blocks:
+        slab = reader.read_block(b)
+        raw = slab.key_words.astype(">u4").tobytes()
+        stride = slab.width_words * 4
+
+        def ikey(i: int) -> bytes:
+            kp = raw[i * stride: i * stride + int(slab.key_len[i])]
+            return make_internal_key(kp, slab.doc_ht(i))
+
+        lo, hi = 0, slab.n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if ikey(mid) < seek:
+                lo = mid + 1
+            else:
+                hi = mid
+        b += 1
+        if lo < slab.n:
+            for i in range(lo, slab.n):
+                yield ikey(i), slab.values[int(slab.value_idx[i])]
+            break
+        # whole block < seek: search the next one
+    # Stream phase: every later block is entirely >= seek — reuse the
+    # reader's own decode loop rather than duplicating it here.
+    for kp, dht, value, _fl in reader.iter_entries(b):
+        yield make_internal_key(kp, dht), value
 
 
 def _delete_sst_files(base_path: str) -> None:

@@ -45,7 +45,9 @@ class Trace:
 
     def __exit__(self, *exc) -> None:
         _current_trace.reset(self._token)
-        if self.record and self.entries:
+        # children count as content: a request whose only activity is a
+        # nested local-bypass call must still appear in /tracez
+        if self.record and (self.entries or self.children):
             _record_tracez(self)
 
 
