@@ -105,12 +105,21 @@ def test_snapshot_schedule_takes_and_prunes(cluster):
     _write(client, table, [("s", "v")])
     master = cluster.leader_master()
     cat = master.catalog
+    # long interval: exactly ONE snapshot is due (taken by our explicit
+    # call OR by the master bg loop, whichever runs first — interval 0
+    # would race the bg loop into extra snapshots)
     sched = cat.create_snapshot_schedule("db", "sched",
-                                         interval_s=0.0, retention_s=3600)
+                                         interval_s=3600, retention_s=3600)
     try:
-        assert cat.run_snapshot_schedules() >= 1
-        snaps = [s for s in cat.list_snapshots()
-                 if s.get("schedule_id") == sched["schedule_id"]]
+        cat.run_snapshot_schedules()
+        deadline = time.time() + 10
+        snaps = []
+        while time.time() < deadline:
+            snaps = [s for s in cat.list_snapshots()
+                     if s.get("schedule_id") == sched["schedule_id"]]
+            if snaps:
+                break
+            time.sleep(0.1)
         assert len(snaps) == 1
         assert snaps[0]["snapshot_micros"] > 0
         # shrink retention to zero: next tick prunes it

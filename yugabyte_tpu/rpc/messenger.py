@@ -32,6 +32,15 @@ from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE, Trace
 
+flags.define_flag("rpc_use_tls", False,
+                  "mutual TLS on every RPC connection (ref "
+                  "use_node_to_node_encryption; rpc/secure_stream.cc)")
+flags.define_flag("rpc_tls_cert_file", "",
+                  "PEM certificate presented by both sides")
+flags.define_flag("rpc_tls_key_file", "",
+                  "PEM private key for rpc_tls_cert_file")
+flags.define_flag("rpc_tls_ca_file", "",
+                  "PEM trust anchor peers are verified against")
 flags.define_flag("rpc_service_pool_threads", 64,
                   "service-pool workers per messenger (ref "
                   "rpc/service_pool.cc); bounded to cap runaway "
@@ -66,6 +75,91 @@ class RemoteError(StatusError):
         self.extra = extra or {}
 
 
+def _tls_contexts():
+    """(server_ctx, client_ctx) per the TLS flags, or (None, None).
+
+    Mutual TLS: both sides present rpc_tls_cert_file and verify the peer
+    against rpc_tls_ca_file (the reference's node-to-node encryption,
+    secure_stream.cc). Hostname checks are off — cluster membership is
+    carried by possession of a CA-signed cert, not by names (nodes move)."""
+    if not flags.get_flag("rpc_use_tls"):
+        return None, None
+    import ssl
+    cert = flags.get_flag("rpc_tls_cert_file")
+    key = flags.get_flag("rpc_tls_key_file")
+    ca = flags.get_flag("rpc_tls_ca_file")
+    server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server.load_cert_chain(cert, key)
+    server.load_verify_locations(ca)
+    server.verify_mode = ssl.CERT_REQUIRED
+    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client.load_cert_chain(cert, key)
+    client.load_verify_locations(ca)
+    client.check_hostname = False
+    client.verify_mode = ssl.CERT_REQUIRED
+    return server, client
+
+
+class _TlsSocket:
+    """Full-duplex-safe wrapper around an SSLSocket.
+
+    OpenSSL forbids concurrent SSL_read/SSL_write on one SSL* (the GIL is
+    released around both), but the messenger's design is full-duplex: a
+    reader thread blocks in recv while callers send. This adapter makes
+    the socket non-blocking and serializes every SSL call under one lock
+    WITHOUT ever holding it across a blocking wait — select() runs
+    outside the lock — so reads and writes interleave with no deadlock
+    and no added latency. Presents the socket surface _recv_exact /
+    _send_frame / shutdown() use."""
+
+    def __init__(self, ssl_sock):
+        self._s = ssl_sock
+        self._s.setblocking(False)
+        self._lock = threading.Lock()
+
+    def recv(self, n: int) -> bytes:
+        import select
+        import ssl as _ssl
+        while True:
+            with self._lock:
+                try:
+                    return self._s.recv(n)
+                except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+                    pass
+                except BlockingIOError:
+                    pass
+            select.select([self._s], [], [], 0.5)
+
+    def sendall(self, data) -> None:
+        import select
+        import ssl as _ssl
+        view = memoryview(data)
+        while len(view):
+            sent = 0
+            with self._lock:
+                try:
+                    sent = self._s.send(view)
+                except (_ssl.SSLWantWriteError, _ssl.SSLWantReadError,
+                        BlockingIOError):
+                    pass
+            if sent:
+                view = view[sent:]
+            else:
+                select.select([], [self._s], [], 0.5)
+
+    def setsockopt(self, *a) -> None:
+        self._s.setsockopt(*a)
+
+    def settimeout(self, t) -> None:
+        pass  # non-blocking + select manage timing
+
+    def shutdown(self, how) -> None:
+        self._s.shutdown(how)
+
+    def close(self) -> None:
+        self._s.close()
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     while n:
@@ -86,10 +180,12 @@ def _send_frame(sock: socket.socket, lock: threading.Lock,
 class _ClientConnection:
     """One outbound TCP connection; demuxes responses by call id."""
 
-    def __init__(self, addr: Tuple[str, int]):
+    def __init__(self, addr: Tuple[str, int], ssl_ctx=None):
         self.addr = addr
         self.sock = socket.create_connection(
             addr, timeout=flags.get_flag("rpc_connect_timeout_s"))
+        if ssl_ctx is not None:
+            self.sock = _TlsSocket(ssl_ctx.wrap_socket(self.sock))
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.write_lock = threading.Lock()
@@ -177,6 +273,8 @@ class Messenger:
         self._service_pool = ThreadPoolExecutor(
             max_workers=flags.get_flag("rpc_service_pool_threads"),
             thread_name_prefix=f"rpc-worker-{name}")
+        # TLS contexts resolved once per messenger (flag + cert flags)
+        self._tls_server_ctx, self._tls_client_ctx = _tls_contexts()
         # /rpcz bookkeeping (ref rpc/rpcz_store.cc): in-flight inbound
         # calls + a ring of recently completed ones
         self._rpcz_lock = threading.Lock()
@@ -212,6 +310,25 @@ class Messenger:
 
     def _serve_conn(self, conn: socket.socket, peer) -> None:
         write_lock = threading.Lock()
+        if self._tls_server_ctx is not None:
+            # handshake on the connection's own thread — a stalling or
+            # certless client must not block the accept loop
+            raw = conn
+            try:
+                conn = _TlsSocket(self._tls_server_ctx.wrap_socket(
+                    raw, server_side=True))
+            except Exception as e:  # noqa: BLE001 — reject bad handshakes
+                TRACE("rpc %s: TLS handshake from %s failed: %s",
+                      self.name, peer, e)
+                raw.close()
+                return
+            # wrap_socket DETACHES the raw fd: shutdown() must operate on
+            # the live wrapped socket, not the dead raw one
+            try:
+                self._inbound.remove(raw)
+            except ValueError:
+                pass
+            self._inbound.append(conn)
         try:
             while True:
                 (n,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
@@ -336,7 +453,7 @@ class Messenger:
                 return conn
         # Connect outside the lock; racing creators keep the one registered.
         try:
-            fresh = _ClientConnection(addr)
+            fresh = _ClientConnection(addr, ssl_ctx=self._tls_client_ctx)
         except OSError as e:
             raise ServiceUnavailable(f"{addr}: {e}") from e
         with self._conns_lock:
