@@ -237,3 +237,36 @@ def test_prepared_marker_types_inside_func_args(ql):
                ("x", "payload"))
     rs = ql.execute("SELECT b FROM tb WHERE k = 'x'")
     assert rs.rows == [[b"payload"]]
+
+
+# --------------------------------------------------- system vtables (YCQL)
+
+def test_system_local_and_peers(ql):
+    rs = ql.execute("SELECT * FROM system.local")
+    assert rs.rows and dict(zip(rs.columns, rs.rows[0]))["key"] == "local"
+    rs = ql.execute("SELECT peer, data_center FROM system.peers")
+    assert rs.columns == ["peer", "data_center"]   # RF1: no peers rows
+
+
+def test_system_schema_tables_and_columns(ql):
+    rs = ql.execute("SELECT keyspace_name, table_name FROM "
+                    "system_schema.tables WHERE keyspace_name = 'ks'")
+    names = [r[1] for r in rs.rows]
+    assert "t" in names
+    rs = ql.execute("SELECT column_name, kind, type FROM "
+                    "system_schema.columns WHERE table_name = 't'")
+    cols = {r[0]: (r[1], r[2]) for r in rs.rows}
+    assert cols["k"][0] == "partition_key"
+    assert cols["v"] == ("regular", "string")
+    rs = ql.execute("SELECT keyspace_name FROM system_schema.keyspaces")
+    assert ["ks"] in rs.rows
+
+
+def test_system_select_star_empty_still_has_columns(ql):
+    rs = ql.execute("SELECT * FROM system.peers")
+    assert rs.columns == ["peer", "rpc_address", "data_center", "rack",
+                          "tokens"]
+    assert rs.rows == []
+    rs = ql.execute("SELECT * FROM system_schema.tables "
+                    "WHERE keyspace_name = 'does_not_exist'")
+    assert rs.columns and rs.rows == []

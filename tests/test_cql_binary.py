@@ -144,3 +144,32 @@ def test_unprepared_and_protocol_errors(server):
         assert ei.value.code == W.ERR_UNPREPARED
     finally:
         c.close()
+
+
+def test_system_tables_over_wire(server, schema_ready):
+    """Driver-startup queries (system.local / system_schema) over real
+    CQL binary frames (what cassandra-driver issues on connect)."""
+    c = CqlWireClient(server.host, server.port)
+    try:
+        res = c.execute("SELECT key, cluster_name FROM system.local")
+        assert res.rows and res.rows[0][0] == "local"
+        res = c.execute(
+            "SELECT keyspace_name, table_name FROM system_schema.tables")
+        assert any(r[0] not in ("system", "system_schema")
+                   for r in res.rows)
+    finally:
+        c.close()
+
+
+def test_prepare_system_query(server, schema_ready):
+    """Drivers PREPARE system queries during connect-time introspection."""
+    c = CqlWireClient(server.host, server.port)
+    try:
+        pid, types = c.prepare("SELECT table_name FROM "
+                               "system_schema.tables "
+                               "WHERE keyspace_name = ?")
+        assert types == [13]   # CQL type id: varchar
+        res = c.execute_prepared(pid, [("cql", DataType.STRING)])
+        assert hasattr(res, "rows")   # a Rows result, not an error
+    finally:
+        c.close()

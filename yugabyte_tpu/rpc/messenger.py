@@ -128,7 +128,11 @@ class _TlsSocket:
                     pass
                 except BlockingIOError:
                     pass
-            select.select([self._s], [], [], 0.5)
+            try:
+                select.select([self._s], [], [], 0.5)
+            except (ValueError, OSError):
+                # closed concurrently by shutdown(): fd is gone
+                raise ConnectionError("socket closed during recv")
 
     def sendall(self, data) -> None:
         import select
@@ -136,16 +140,27 @@ class _TlsSocket:
         view = memoryview(data)
         while len(view):
             sent = 0
+            want_read = False
             with self._lock:
                 try:
                     sent = self._s.send(view)
-                except (_ssl.SSLWantWriteError, _ssl.SSLWantReadError,
-                        BlockingIOError):
+                except _ssl.SSLWantReadError:
+                    # renegotiation/KeyUpdate mid-write: progress needs
+                    # INBOUND bytes — selecting for writability would
+                    # return instantly and busy-spin a core
+                    want_read = True
+                except (_ssl.SSLWantWriteError, BlockingIOError):
                     pass
             if sent:
                 view = view[sent:]
-            else:
-                select.select([], [self._s], [], 0.5)
+                continue
+            try:
+                if want_read:
+                    select.select([self._s], [], [], 0.5)
+                else:
+                    select.select([], [self._s], [], 0.5)
+            except (ValueError, OSError):
+                raise ConnectionError("socket closed during send")
 
     def setsockopt(self, *a) -> None:
         self._s.setsockopt(*a)
@@ -265,6 +280,7 @@ class Messenger:
         self._conns: Dict[Tuple[str, int], _ClientConnection] = {}
         self._conns_lock = threading.Lock()
         self._inbound: list = []
+        self._inbound_lock = threading.Lock()
         self._shutdown = False
         # persistent service pool (ref rpc/service_pool.cc): handlers run
         # on reused workers — a fresh thread per request cost ~0.4ms of
@@ -303,7 +319,8 @@ class Messenger:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._inbound.append(conn)
+            with self._inbound_lock:
+                self._inbound.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn, peer),
                              daemon=True,
                              name=f"rpc-serve-{self.name}-{peer}").start()
@@ -323,12 +340,20 @@ class Messenger:
                 raw.close()
                 return
             # wrap_socket DETACHES the raw fd: shutdown() must operate on
-            # the live wrapped socket, not the dead raw one
-            try:
-                self._inbound.remove(raw)
-            except ValueError:
-                pass
-            self._inbound.append(conn)
+            # the live wrapped socket, not the dead raw one. Swap under
+            # the lock (shutdown iterates this list), and if shutdown
+            # already ran, close the fresh wrapped socket ourselves.
+            with self._inbound_lock:
+                closing = self._shutdown
+                try:
+                    self._inbound.remove(raw)
+                except ValueError:
+                    pass
+                if not closing:
+                    self._inbound.append(conn)
+            if closing:
+                conn.close()
+                return
         try:
             while True:
                 (n,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
@@ -483,7 +508,9 @@ class Messenger:
             self._conns.clear()
         for c in conns:
             c.close()
-        for c in self._inbound:
+        with self._inbound_lock:
+            inbound = list(self._inbound)
+        for c in inbound:
             try:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:

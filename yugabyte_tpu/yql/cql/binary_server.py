@@ -100,6 +100,12 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
         schema = table_schema(stmt.keyspace, stmt.table)
         return where_types(schema, stmt.where)
     if isinstance(stmt, P.Select):
+        ks = stmt.keyspace or processor._keyspace
+        if ks in ("system", "system_schema"):
+            # vtables have no client-side schema object; their WHERE
+            # predicates are all text-typed (keyspace_name/table_name/...)
+            return [DataType.STRING for _c, _op, v in stmt.where
+                    if v is P.MARKER]
         schema = table_schema(stmt.keyspace, stmt.table)
         # select-list markers precede WHERE markers in statement order
         return select_item_types(schema, stmt.columns) + \
@@ -127,7 +133,8 @@ class _Connection:
     def __init__(self, server: "CQLBinaryServer", sock: socket.socket):
         self._server = server
         self._sock = sock
-        self._processor = QLProcessor(server.client, server.txn_manager)
+        self._processor = QLProcessor(server.client, server.txn_manager,
+                                      local_addr=(server.host, server.port))
         self._lock = threading.Lock()  # serialize writes (async streams)
 
     # ------------------------------------------------------------- sending

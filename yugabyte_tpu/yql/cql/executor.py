@@ -60,10 +60,14 @@ class QLProcessor:
     """One per CQL connection in the reference; safe to share here."""
 
     def __init__(self, client: YBClient,
-                 txn_manager: Optional[TransactionManager] = None):
+                 txn_manager: Optional[TransactionManager] = None,
+                 local_addr: Optional[Tuple[str, int]] = None):
         self._client = client
         self._txn_manager = txn_manager or TransactionManager(client)
         self._keyspace: Optional[str] = None
+        # (host, port) of the CQL endpoint this processor serves —
+        # reported by the system.local vtable
+        self.local_addr = local_addr
         # (keyspace, table) -> (handle, cached-at monotonic time); see
         # the TTL logic in _table()
         self._tables: Dict[Tuple[str, str], Tuple[YBTable, float]] = {}
@@ -271,6 +275,9 @@ class QLProcessor:
         if isinstance(stmt, P.CreateIndex):
             return self._create_index(stmt)
         if isinstance(stmt, P.Select):
+            ks = stmt.keyspace or self._keyspace
+            if ks in ("system", "system_schema"):
+                return self._select_system(ks, stmt, params, cursor)
             return self._select(stmt, params, cursor)
         if isinstance(stmt, (P.Insert, P.Update, P.Delete)):
             table, op = self._dml_to_op(stmt, params, cursor)
@@ -444,6 +451,119 @@ class QLProcessor:
             rs.rows.append([f(d, row) for f in item_fns])
             count += 1
             if stmt.limit is not None and count >= stmt.limit:
+                break
+        return rs
+
+    # -------------------------------------------------------- system vtables
+    # Canonical column orders — the metadata contract is FIXED, not
+    # derived from whichever rows happen to match (a zero-row
+    # "SELECT * FROM system.peers" must still describe its columns).
+    SYSTEM_VTABLES: Dict[Tuple[str, str], List[str]] = {
+        ("system", "local"): ["key", "rpc_address", "rpc_port",
+                              "data_center", "rack", "cluster_name",
+                              "partitioner", "release_version",
+                              "cql_version", "tokens"],
+        ("system", "peers"): ["peer", "rpc_address", "data_center",
+                              "rack", "tokens"],
+        ("system_schema", "keyspaces"): ["keyspace_name", "durable_writes"],
+        ("system_schema", "tables"): ["keyspace_name", "table_name", "id"],
+        ("system_schema", "columns"): ["keyspace_name", "table_name",
+                                       "column_name", "kind", "position",
+                                       "type"],
+    }
+
+    def _system_rows(self, ks: str, table: str,
+                     eq: Dict[str, object]) -> List[dict]:
+        """Synthesized rows of the system/system_schema virtual tables —
+        what every Cassandra driver queries on connect (ref: the master's
+        YQLVirtualTable family, master/yql_local_vtable.cc,
+        yql_peers_vtable.cc, yql_keyspaces_vtable.cc ...).
+
+        eq: equality predicates pushed into generation — metadata
+        refreshes filter by keyspace_name/table_name, and opening every
+        table in the cluster to answer them would cost O(tables) master
+        round-trips per query.
+
+        This processor IS the CQL endpoint (the reference runs one per
+        tserver; this architecture runs one standalone server embedding
+        the client), so system.local describes THIS server and
+        system.peers is empty — there are no other CQL endpoints."""
+        if (ks, table) == ("system", "local"):
+            host, port = (self.local_addr if self.local_addr
+                          else ("127.0.0.1", 0))
+            return [{"key": "local", "rpc_address": host,
+                     "rpc_port": int(port),
+                     "data_center": "datacenter1", "rack": "rack1",
+                     "cluster_name": "ybtpu", "partitioner": "multi-hash",
+                     "release_version": "3.9-SNAPSHOT",
+                     "cql_version": "3.4.4", "tokens": ["0"]}]
+        if (ks, table) == ("system", "peers"):
+            return []
+        want_ks = eq.get("keyspace_name")
+        want_table = eq.get("table_name")
+        namespaces = ([want_ks] if want_ks is not None
+                      else self._client.list_namespaces())
+        if (ks, table) == ("system_schema", "keyspaces"):
+            return [{"keyspace_name": n, "durable_writes": True}
+                    for n in namespaces]
+        if (ks, table) == ("system_schema", "tables"):
+            rows = []
+            for n in namespaces:
+                for t in self._client.list_tables(n):
+                    if want_table is not None and t["name"] != want_table:
+                        continue
+                    rows.append({"keyspace_name": n,
+                                 "table_name": t["name"],
+                                 "id": t.get("table_id", "")})
+            return rows
+        if (ks, table) == ("system_schema", "columns"):
+            rows = []
+            for n in namespaces:
+                for t in self._client.list_tables(n):
+                    if want_table is not None and t["name"] != want_table:
+                        continue
+                    try:
+                        schema = self._client.open_table(n, t["name"]).schema
+                    except StatusError:
+                        continue
+                    hash_names = [c.name for c in schema.hash_columns]
+                    range_names = [c.name for c in schema.range_columns]
+                    for c in schema.columns:
+                        kind = ("partition_key" if c.name in hash_names
+                                else "clustering" if c.name in range_names
+                                else "regular")
+                        rows.append({"keyspace_name": n,
+                                     "table_name": t["name"],
+                                     "column_name": c.name,
+                                     "kind": kind,
+                                     "position": (
+                                         hash_names.index(c.name)
+                                         if kind == "partition_key"
+                                         else range_names.index(c.name)
+                                         if kind == "clustering" else -1),
+                                     "type": c.type.value})
+            return rows
+        raise StatusError(Status.NotFound(f"table {ks}.{table}"))
+
+    def _select_system(self, ks: str, stmt: P.Select, params: List[object],
+                       cursor: List[int]) -> ResultSet:
+        if (ks, stmt.table) not in self.SYSTEM_VTABLES:
+            raise StatusError(Status.NotFound(f"table {ks}.{stmt.table}"))
+        where = [(c, op, self._bind(v, params, cursor))
+                 for c, op, v in stmt.where]
+        eq = {c: v for c, op, v in where if op == "="}
+        rows = [r for r in self._system_rows(ks, stmt.table, eq)
+                if self._match(r, where)]
+        items = stmt.columns or self.SYSTEM_VTABLES[(ks, stmt.table)]
+        out_cols = [c if isinstance(c, str) else self._item_label(c)
+                    for c in items]
+        rs = ResultSet(columns=out_cols, types=[None] * len(out_cols),
+                       source=(ks, stmt.table))
+        limit = stmt.limit
+        for r in rows:
+            rs.rows.append([r.get(c) if isinstance(c, str) else None
+                            for c in items])
+            if limit is not None and len(rs.rows) >= limit:
                 break
         return rs
 
