@@ -320,6 +320,11 @@ class Messenger:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._inbound_lock:
+                if self._shutdown:
+                    # accepted in the closing window: shutdown() already
+                    # snapshotted _inbound and would never close this one
+                    conn.close()
+                    return
                 self._inbound.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn, peer),
                              daemon=True,

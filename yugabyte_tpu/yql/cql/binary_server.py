@@ -39,8 +39,14 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
         return processor._table(ks, name).schema
 
     def where_types(schema, where):
-        return [schema.column(c).type for c, _op, v in where
-                if v is P.MARKER]
+        out = []
+        for c, _op, v in where:
+            if isinstance(v, list):       # col IN (?, 'x', ?) markers
+                out.extend(schema.column(c).type for x in v
+                           if x is P.MARKER)
+            elif v is P.MARKER:
+                out.append(schema.column(c).type)
+        return out
 
     def value_marker_types(col_type, v):
         """Markers in a value position, including ones nested inside

@@ -100,6 +100,19 @@ class QLProcessor:
             self._tables[(ks, name)] = (t, now)
         return t
 
+    def _bind_where(self, where, params: List[object],
+                    cursor: List[int]):
+        """Bind a WHERE conjunction, descending into IN lists (their
+        elements may each be a '?' marker)."""
+        out = []
+        for c, op, v in where:
+            if isinstance(v, list):
+                out.append((c, op, [self._bind(x, params, cursor)
+                                    for x in v]))
+            else:
+                out.append((c, op, self._bind(v, params, cursor)))
+        return out
+
     @staticmethod
     def _bind(value, params: List[object], cursor: List[int]):
         if value is P.MARKER:
@@ -228,7 +241,12 @@ class QLProcessor:
                ">": operator.gt, "<=": operator.le, ">=": operator.ge}
         for col, op, val in residual:
             have = row_dict.get(col)
-            if have is None or not ops[op](have, val):
+            if have is None:
+                return False
+            if op == "in":
+                if have not in val:
+                    return False
+            elif not ops[op](have, val):
                 return False
         return True
 
@@ -360,8 +378,7 @@ class QLProcessor:
             # Bind in statement-text order: SET comes before WHERE.
             assignments = [(c, self._bind(v, params, cursor))
                            for c, v in stmt.assignments]
-            where = [(c, op, self._bind(v, params, cursor))
-                     for c, op, v in stmt.where]
+            where = self._bind_where(stmt.where, params, cursor)
             dk, residual = self._doc_key_from_where(table, where)
             if dk is None or residual:
                 raise StatusError(Status.InvalidArgument(
@@ -371,8 +388,7 @@ class QLProcessor:
                 ttl_ms=stmt.ttl_seconds * 1000 if stmt.ttl_seconds else None)
         # Delete
         table = self._table(stmt.keyspace, stmt.table)
-        where = [(c, op, self._bind(v, params, cursor))
-                 for c, op, v in stmt.where]
+        where = self._bind_where(stmt.where, params, cursor)
         dk, residual = self._doc_key_from_where(table, where)
         if dk is None or residual:
             raise StatusError(Status.InvalidArgument(
@@ -400,9 +416,42 @@ class QLProcessor:
         out_items = [bind_item(i)
                      for i in (stmt.columns
                                or [c.name for c in schema.columns])]
-        where = [(c, op, self._bind(v, params, cursor))
-                 for c, op, v in stmt.where]
+        where = self._bind_where(stmt.where, params, cursor)
         known = {c.name: c.type for c in schema.columns}
+
+        # ---- discrete ScanChoices: col IN (...) on a KEY column runs one
+        # sub-select per option (ref docdb/scan_choices.cc option seeks)
+        key_names = {c.name for c in schema.hash_columns} | \
+            {c.name for c in schema.range_columns}
+        range_names = {c.name for c in schema.range_columns}
+        for i, (c, op, v) in enumerate(where):
+            if op == "in" and c in key_names:
+                merged = ResultSet(columns=[], types=[], source=None)
+                limit = stmt.limit
+                options = v
+                if c in range_names:
+                    # rows come back in clustering order — option order
+                    # must follow it or LIMIT keeps the wrong rows
+                    try:
+                        options = sorted(v)
+                    except TypeError:
+                        pass
+                for option in options:
+                    # sub-select built from ALREADY-BOUND pieces (markers
+                    # were consumed above; re-binding would misalign)
+                    sub = P.Select(stmt.keyspace, stmt.table, out_items,
+                                   where=[w for j, w in enumerate(where)
+                                          if j != i] + [(c, "=", option)],
+                                   limit=limit)
+                    rs = self._select(sub, (), [0])
+                    merged.columns, merged.types = rs.columns, rs.types
+                    merged.source = rs.source
+                    merged.rows.extend(rs.rows)
+                    if limit is not None:
+                        limit -= len(rs.rows)
+                        if limit <= 0:
+                            break
+                return merged
         rs = ResultSet(columns=[self._item_label(i) for i in out_items],
                        types=[self._item_type(i, known) for i in out_items],
                        source=(table.namespace, table.name))
@@ -424,9 +473,9 @@ class QLProcessor:
             prefix = DocKey(hash_components=dk.hash_components,
                             range_components=dk.range_components).encode()
             prefix = prefix[:-1]  # open the range group
+            lo, hi = self._range_scan_bounds(schema, dk, prefix, residual)
             rows = self._client.scan_key_range(
-                table, table.partition_key_for(dk), prefix,
-                prefix + b"\xff")
+                table, table.partition_key_for(dk), lo, hi)
         else:
             # No key prefix: try a readable secondary index on an equality
             # predicate before falling back to the full scan.
@@ -453,6 +502,59 @@ class QLProcessor:
             if stmt.limit is not None and count >= stmt.limit:
                 break
         return rs
+
+    # predicate value classes whose doc-key encoding shares the column's
+    # type tag — cross-tag bounds would compare different tag bytes and
+    # silently exclude every row (e.g. a float literal on a bigint column)
+    _BOUND_TYPES = {
+        DataType.INT32: int, DataType.INT64: int,
+        DataType.FLOAT: float, DataType.DOUBLE: float,
+        DataType.STRING: str, DataType.BINARY: bytes,
+        DataType.TIMESTAMP: int,
+    }
+
+    @classmethod
+    def _bound_type_ok(cls, col_type, v) -> bool:
+        want = cls._BOUND_TYPES.get(col_type)
+        return want is not None and isinstance(v, want) \
+            and not isinstance(v, bool)
+
+    @staticmethod
+    def _range_scan_bounds(schema, dk, prefix: bytes, residual) -> tuple:
+        """Hybrid ScanChoices: inequality predicates on the first UNBOUND
+        clustering column tighten the partition scan's byte range instead
+        of filtering after a full-partition read (ref
+        docdb/scan_choices.cc range bounds). Component encoding is
+        order-preserving, so prefix+encode(v) bounds are exact; the
+        predicates stay in the residual (bounds prune, the filter
+        decides), so edge inclusivity cannot produce wrong rows."""
+        from yugabyte_tpu.docdb.doc_key import PrimitiveValue
+        lo, hi = prefix, prefix + b"\xff"
+        bound_n = len(dk.range_components)
+        if bound_n >= len(schema.range_columns):
+            return lo, hi
+        nxt_col = schema.range_columns[bound_n]
+        nxt = nxt_col.name
+        for c, op, v in residual:
+            if c != nxt or op not in ("<", "<=", ">", ">="):
+                continue
+            if not QLProcessor._bound_type_ok(nxt_col.type, v):
+                continue  # cross-type predicate: residual filter decides
+            buf = bytearray()
+            try:
+                PrimitiveValue.encode(v, buf)
+            except TypeError:
+                continue
+            enc = prefix + bytes(buf)
+            if op in (">", ">="):
+                cand = enc + (b"\xff" if op == ">" else b"")
+                if cand > lo:
+                    lo = cand
+            else:
+                cand = enc + (b"\xff" if op == "<=" else b"")
+                if cand < hi:
+                    hi = cand
+        return lo, hi
 
     # -------------------------------------------------------- system vtables
     # Canonical column orders — the metadata contract is FIXED, not
@@ -523,7 +625,7 @@ class QLProcessor:
                     if want_table is not None and t["name"] != want_table:
                         continue
                     try:
-                        schema = self._client.open_table(n, t["name"]).schema
+                        schema = self._table(n, t["name"]).schema
                     except StatusError:
                         continue
                     hash_names = [c.name for c in schema.hash_columns]
@@ -549,8 +651,7 @@ class QLProcessor:
                        cursor: List[int]) -> ResultSet:
         if (ks, stmt.table) not in self.SYSTEM_VTABLES:
             raise StatusError(Status.NotFound(f"table {ks}.{stmt.table}"))
-        where = [(c, op, self._bind(v, params, cursor))
-                 for c, op, v in stmt.where]
+        where = self._bind_where(stmt.where, params, cursor)
         eq = {c: v for c, op, v in where if op == "="}
         rows = [r for r in self._system_rows(ks, stmt.table, eq)
                 if self._match(r, where)]

@@ -393,11 +393,21 @@ class Parser:
         conds = []
         while True:
             col = self.name()
-            tok = self.next()
-            if tok[0] != "op" or tok[1] not in ("=", "<", ">", "<=", ">=",
-                                                "!="):
-                raise ParseError(f"expected comparison, got {tok[1]!r}")
-            conds.append((col, tok[1], self.literal()))
+            if self.accept_kw("IN"):
+                # col IN (v1, v2, ...) — drives the discrete ScanChoices
+                # strategy (ref docdb/scan_choices.cc option iteration)
+                self.expect_op("(")
+                vals = [self.literal()]
+                while self.accept_op(","):
+                    vals.append(self.literal())
+                self.expect_op(")")
+                conds.append((col, "in", vals))
+            else:
+                tok = self.next()
+                if tok[0] != "op" or tok[1] not in ("=", "<", ">", "<=",
+                                                    ">=", "!="):
+                    raise ParseError(f"expected comparison, got {tok[1]!r}")
+                conds.append((col, tok[1], self.literal()))
             if not self.accept_kw("AND"):
                 return conds
 
