@@ -346,3 +346,11 @@ class TestScanChoices:
         rs = tql.execute("SELECT r FROM ts WHERE h = 'a' AND r IN (9, 1) "
                          "LIMIT 1")
         assert [r[0] for r in rs.rows] == [1]
+
+    def test_in_duplicates_deduped(self, tql):
+        rs = tql.execute("SELECT v FROM ts WHERE h = 'a' AND r IN (1, 1)")
+        assert [r[0] for r in rs.rows] == ["a1"]
+
+    def test_in_without_hash_key_single_scan(self, tql):
+        rs = tql.execute("SELECT v FROM ts WHERE r IN (2, 5)")
+        assert sorted(r[0] for r in rs.rows) == ["a2", "a5", "b2", "b5"]

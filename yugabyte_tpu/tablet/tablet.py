@@ -538,6 +538,32 @@ class Tablet:
                                   entry_stream=stream)
 
     # ------------------------------------------------------------ maintenance
+    def write_subdocument(self, doc_key: DocKey, path, doc,
+                          timeout_s: float = 10.0):
+        """Replicated arbitrary-depth subdocument write (ref
+        doc_write_batch.cc InsertSubDocument): a dict becomes an object
+        init marker + leaves; the marker overwrites the older subtree."""
+        from yugabyte_tpu.docdb.subdocument import subdocument_writes
+        ht = self.clock.now()
+        kvs = subdocument_writes(doc_key, tuple(path), doc)
+        return self.consensus.submit(kvs, ht, timeout_s=timeout_s)
+
+    def delete_subdocument(self, doc_key: DocKey, path,
+                           timeout_s: float = 10.0):
+        from yugabyte_tpu.docdb.subdocument import delete_subdocument
+        ht = self.clock.now()
+        return self.consensus.submit(delete_subdocument(doc_key,
+                                                        tuple(path)),
+                                     ht, timeout_s=timeout_s)
+
+    def read_subdocument(self, doc_key: DocKey, path=(),
+                         read_ht=None):
+        """Visible subdocument at read_ht (nested dict / primitive /
+        None), honoring the ancestor overwrite stack."""
+        from yugabyte_tpu.docdb.subdocument import read_subdocument
+        ht = self.read_time(read_ht)
+        return read_subdocument(self.regular_db, doc_key, tuple(path), ht)
+
     def memstore_bytes(self) -> int:
         return (self.regular_db.memstore_bytes()
                 + self.intents_db.memstore_bytes())

@@ -110,8 +110,13 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
         if ks in ("system", "system_schema"):
             # vtables have no client-side schema object; their WHERE
             # predicates are all text-typed (keyspace_name/table_name/...)
-            return [DataType.STRING for _c, _op, v in stmt.where
-                    if v is P.MARKER]
+            out = []
+            for _c, _op, v in stmt.where:
+                if isinstance(v, list):
+                    out.extend(DataType.STRING for x in v if x is P.MARKER)
+                elif v is P.MARKER:
+                    out.append(DataType.STRING)
+            return out
         schema = table_schema(stmt.keyspace, stmt.table)
         # select-list markers precede WHERE markers in statement order
         return select_item_types(schema, stmt.columns) + \

@@ -424,16 +424,25 @@ class QLProcessor:
         key_names = {c.name for c in schema.hash_columns} | \
             {c.name for c in schema.range_columns}
         range_names = {c.name for c in schema.range_columns}
+        hash_names = {c.name for c in schema.hash_columns}
+        eq_cols = {c for c, op, _v in where if op == "="}
         for i, (c, op, v) in enumerate(where):
             if op == "in" and c in key_names:
+                # only worthwhile when every sub-select still reaches a
+                # key prefix — with the hash key unbound, N sub-selects
+                # would be N full scans where ONE scan with the IN as a
+                # residual filter suffices
+                if not hash_names <= (eq_cols | {c}):
+                    continue
                 merged = ResultSet(columns=[], types=[], source=None)
                 limit = stmt.limit
-                options = v
+                # IN is a SET: duplicates must not duplicate rows
+                options = list(dict.fromkeys(v))
                 if c in range_names:
                     # rows come back in clustering order — option order
                     # must follow it or LIMIT keeps the wrong rows
                     try:
-                        options = sorted(v)
+                        options = sorted(options)
                     except TypeError:
                         pass
                 for option in options:
