@@ -293,3 +293,78 @@ class TestSeekAcrossBlocks:
         # a read BELOW the oldest version finds nothing
         assert db.get(key_for(7), HybridTime.from_micros(500)) is None
         db.close()
+
+
+class TestLSMOptionSurface:
+    def test_block_entries_flag(self, tmp_path):
+        from yugabyte_tpu.utils import flags
+        old = flags.get_flag("sst_block_entries")
+        flags.set_flag("sst_block_entries", 8)
+        try:
+            db = DB(str(tmp_path / "db"), DBOptions(auto_compact=False))
+            for r in range(40):
+                db.write_batch([(key_for(r), ht(100 + r),
+                                 Value(primitive=r).encode())])
+            db.flush()
+            rdr = next(iter(db._readers.values()))
+            assert rdr.n_blocks == 5   # 40 rows / 8 per block
+            db.close()
+        finally:
+            flags.set_flag("sst_block_entries", old)
+
+    def test_compression_flag_round_trips(self, tmp_path):
+        from yugabyte_tpu.utils import flags
+        old = flags.get_flag("sst_compression")
+        flags.set_flag("sst_compression", "zlib")
+        try:
+            db = DB(str(tmp_path / "dbz"), DBOptions(auto_compact=False))
+            val = Value(primitive="x" * 500).encode()
+            for r in range(50):
+                db.write_batch([(key_for(r), ht(100 + r), val)])
+            db.flush()
+            assert Value.decode(db.get(key_for(25))[1]).primitive == "x" * 500
+            db.close()
+        finally:
+            flags.set_flag("sst_compression", old)
+
+    def test_max_merge_width_caps_pick(self):
+        from yugabyte_tpu.storage.compaction import pick_universal
+        from yugabyte_tpu.storage.version_set import FileMeta
+        from yugabyte_tpu.utils import flags
+        from yugabyte_tpu.storage.sst import SSTProps
+        files = [FileMeta(file_id=i, path=f"f{i}",
+                          props=SSTProps(n_entries=10, data_size=1000))
+                 for i in range(20)]
+        old = flags.get_flag("universal_compaction_max_merge_width")
+        flags.set_flag("universal_compaction_max_merge_width", 6)
+        try:
+            pick = pick_universal(files)
+            assert pick is not None and len(pick.inputs) == 6
+        finally:
+            flags.set_flag("universal_compaction_max_merge_width", old)
+
+    def test_always_include_small_runs(self):
+        from yugabyte_tpu.storage.compaction import pick_universal
+        from yugabyte_tpu.storage.version_set import FileMeta
+        # a big base run would normally stop accumulation; a tiny file
+        # below the always-include threshold still joins
+        from yugabyte_tpu.storage.sst import SSTProps
+
+        def fm(i, size):
+            return FileMeta(file_id=i, path=f"f{i}",
+                            props=SSTProps(n_entries=10, data_size=size))
+        # a 32KB run fails the size-ratio test against a 100B
+        # accumulation but sits under the always-include threshold, so
+        # accumulation continues and the 4-run trigger is reached —
+        # without always-include this layout never compacts
+        files = [fm(1, 100), fm(2, 32 << 10), fm(3, 100), fm(4, 100)]
+        pick = pick_universal(files)
+        assert pick is not None and len(pick.inputs) == 4
+        from yugabyte_tpu.utils import flags as _f
+        old = _f.get_flag("universal_compaction_always_include_size_bytes")
+        _f.set_flag("universal_compaction_always_include_size_bytes", 0)
+        try:
+            assert pick_universal(files) is None   # ratio rule alone stops
+        finally:
+            _f.set_flag(
+                "universal_compaction_always_include_size_bytes", old)

@@ -151,3 +151,16 @@ def test_deep_path_read_sees_ancestor_overwrites(db):
     # a NEWER write below resurrects
     write(db, dk(), ("a", "x"), 9, 4000)
     assert read_subdocument(db, dk(), ("a", "x")) == 9
+
+
+def test_root_read_sees_resurrected_subtree(db):
+    """A root-level read and a rooted read must agree on resurrection."""
+    write(db, dk(), (), {"a": {"x": 1}, "b": 2}, 1000)
+    db.write_batch([(k, DocHybridTime(HybridTime.from_micros(2000), 0), v)
+                    for k, v in delete_subdocument(dk(), ("a",))])
+    write(db, dk(), ("a", "x"), 5, 3000)
+    assert read_subdocument(db, dk(), ("a",)) == {"x": 5}
+    assert read_subdocument(db, dk()) == {"a": {"x": 5}, "b": 2}
+    # primitive-at-ancestor shadows OLDER descendants even on root reads
+    write(db, dk(), ("a",), 42, 4000)
+    assert read_subdocument(db, dk()) == {"a": 42, "b": 2}

@@ -114,9 +114,11 @@ def read_subdocument(db, doc_key: DocKey, path: PathType = (),
             stack.pop()
         shadowed = any(dht < ov for _p, ov in stack)
         value = Value.decode(raw_value)
-        if value.is_tombstone or value.is_object:
-            # both replace the older subtree at this path
-            stack.append((kp, dht))
+        # EVERY visible entry — tombstone, object marker, or primitive —
+        # replaces the older subtree at its path, so each becomes an
+        # overwrite point (a primitive at 'a' obsoletes an older 'a.x';
+        # a NEWER 'a.x' resurrects 'a' as an object)
+        stack.append((kp, dht))
         if shadowed or value.is_tombstone:
             continue
         subpath = SubDocKey.decode(kp).subkeys
@@ -140,15 +142,17 @@ def read_subdocument(db, doc_key: DocKey, path: PathType = (),
                 root_set[1] = v
             continue
         node = root
-        ok = True
         for comp in rel[:-1]:
             nxt = node.get(comp)
             if not isinstance(nxt, dict):
-                ok = False   # parent was overwritten by a primitive
-                break
+                # a surviving child is provably NEWER than any visible
+                # non-dict value at this level (the overwrite stack
+                # filtered older ones): the subtree resurrects as an
+                # object containing the child
+                nxt = {}
+                node[comp] = nxt
             node = nxt
-        if ok:
-            node[rel[-1]] = {} if isinstance(v, dict) else v
+        node[rel[-1]] = {} if isinstance(v, dict) else v
     if root_set[1] is not None:
         return root_set[1]          # the path itself is a primitive
     if not root and not root_set[0]:

@@ -23,11 +23,20 @@ import numpy as np
 
 from yugabyte_tpu.ops.merge_gc import GCParams, merge_and_gc_device
 from yugabyte_tpu.ops.slabs import KVSlab, concat_slabs
-from yugabyte_tpu.storage.sst import Frontier, SSTProps, SSTReader, SSTWriter
+from yugabyte_tpu.storage.sst import (Frontier, SSTProps, SSTReader,
+                                      SSTWriter, sst_compression_enabled)
 from yugabyte_tpu.storage.version_set import FileMeta
 from yugabyte_tpu.docdb.value import Value
 from yugabyte_tpu.utils import flags
 
+flags.define_flag("universal_compaction_max_merge_width", 16,
+                  "cap on runs merged in one universal pick "
+                  "(ref max_merge_width, docdb_rocksdb_util.cc)")
+flags.define_flag("universal_compaction_always_include_size_bytes",
+                  64 << 10,
+                  "runs at or below this size join a pick regardless of "
+                  "the size-ratio rule (ref "
+                  "universal_compaction_always_include_size_threshold)")
 flags.define_flag("universal_compaction_min_merge_width", 4,
                   "min sorted runs to trigger a compaction")
 flags.define_flag("universal_compaction_size_ratio_pct", 20,
@@ -107,17 +116,25 @@ class CompactionPick:
 def pick_universal(files: List[FileMeta]) -> Optional[CompactionPick]:
     """files must be newest-first. Returns runs to merge, or None."""
     min_width = flags.get_flag("universal_compaction_min_merge_width")
+    max_width = flags.get_flag("universal_compaction_max_merge_width")
     ratio = flags.get_flag("universal_compaction_size_ratio_pct")
+    always_sz = flags.get_flag(
+        "universal_compaction_always_include_size_bytes")
     candidates = [f for f in files if not f.being_compacted]
     if len(candidates) < min_width:
         return None
     # Accumulate newest-first while sizes stay within ratio (universal rule:
     # stop at the first run that dwarfs the accumulated candidates — never
     # force-include it, or every few flushes rewrites the whole base run).
+    # Files under the always-include threshold join regardless of ratio
+    # (ref always_include_size_threshold, docdb_rocksdb_util.cc).
     acc = candidates[0].total_size
     picked = [candidates[0]]
     for f in candidates[1:]:
-        if f.total_size * 100 <= (100 + ratio) * acc:
+        if len(picked) >= max_width:
+            break
+        if (f.total_size <= always_sz
+                or f.total_size * 100 <= (100 + ratio) * acc):
             picked.append(f)
             acc += f.total_size
         else:
@@ -138,7 +155,7 @@ class CompactionResult:
 def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                        new_file_id, history_cutoff_ht: int, is_major: bool,
                        retain_deletes: bool = False, device=None,
-                       block_entries: int = 4096, device_cache=None,
+                       block_entries: Optional[int] = None, device_cache=None,
                        input_ids: Optional[Sequence[int]] = None
                        ) -> CompactionResult:
     """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
@@ -260,7 +277,7 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
 
 
 def _write_native_outputs(job, out_dir: str, new_file_id, fr,
-                          block_entries: int
+                          block_entries: Optional[int]
                           ) -> Tuple[List[Tuple[int, str, SSTProps]],
                                      List[Tuple[int, int]]]:
     """Write the native job's survivors as (possibly split) output SSTs,
@@ -275,6 +292,8 @@ def _write_native_outputs(job, out_dir: str, new_file_id, fr,
 
     tombstone_value = Value.tombstone().encode()
     limiter = compaction_rate_limiter()
+    if block_entries is None:
+        block_entries = flags.get_flag("sst_block_entries")
     rows_out = job.n_survivors
     outputs: List[Tuple[int, str, SSTProps]] = []
     ranges: List[Tuple[int, int]] = []
@@ -285,7 +304,8 @@ def _write_native_outputs(job, out_dir: str, new_file_id, fr,
         base_path = os.path.join(out_dir, f"{fid:06d}.sst")
         size, index, hashes, fk, lk = job.write_output(
             start, end, data_file_name(base_path), block_entries,
-            compress=False, tombstone_value=tombstone_value)
+            compress=sst_compression_enabled(),
+            tombstone_value=tombstone_value)
         props = write_base_file(base_path, index, end - start, hashes,
                                 fk, lk, fr, size)
         outputs.append((fid, base_path, props))
@@ -297,7 +317,7 @@ def _write_native_outputs(job, out_dir: str, new_file_id, fr,
 
 def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
                     history_cutoff_ht: int, is_major: bool,
-                    retain_deletes: bool, block_entries: int,
+                    retain_deletes: bool, block_entries: Optional[int],
                     frontier_inputs: Optional[Sequence[SSTReader]] = None
                     ) -> CompactionResult:
     """Full-native compaction: the byte path (decode/merge/encode) runs in
@@ -323,7 +343,7 @@ def run_compaction_job_device_native(
         inputs: Sequence[SSTReader], out_dir: str, new_file_id,
         history_cutoff_ht: int, is_major: bool,
         retain_deletes: bool = False, device=None,
-        block_entries: int = 4096, device_cache=None,
+        block_entries: Optional[int] = None, device_cache=None,
         input_ids: Optional[Sequence[int]] = None) -> CompactionResult:
     """The production hot path: TPU decisions + native byte shell.
 

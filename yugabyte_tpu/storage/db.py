@@ -36,7 +36,7 @@ flags.define_flag("memstore_size_bytes", 128 * 1024 * 1024,
 
 @dataclass
 class DBOptions:
-    block_entries: int = 4096
+    block_entries: Optional[int] = None
     block_cache: Optional[BlockCache] = None
     compaction_pool: Optional[PriorityThreadPool] = None
     device: object = None  # JAX device for compaction kernels

@@ -33,7 +33,30 @@ from yugabyte_tpu.common.hybrid_time import DocHybridTime
 from yugabyte_tpu.ops.slabs import KVSlab, concat_slabs
 from yugabyte_tpu.storage import block_format
 from yugabyte_tpu.storage.bloom import BloomFilter, BloomFilterBuilder, fnv64_masked
+from yugabyte_tpu.utils import flags as _sst_flags
 from yugabyte_tpu.utils.status import Status, StatusError
+
+_sst_flags.define_flag("sst_block_entries", 4096,
+                       "rows per SST block (fixed row count, not byte "
+                       "size: device transfers like uniform shapes; ref "
+                       "block_size docdb_rocksdb_util.cc)")
+_sst_flags.define_flag("sst_compression", "none",
+                       "SST block compression: 'none' or 'zlib' (ref "
+                       "compression_type)")
+
+
+def sst_compression_enabled() -> bool:
+    """Single authority for the compression flag (three writer paths
+    share it); unknown codec names fail loudly instead of silently
+    writing uncompressed files."""
+    v = _sst_flags.get_flag("sst_compression")
+    if v not in ("none", "zlib"):
+        raise StatusError(Status.InvalidArgument(
+            f"sst_compression: unknown codec {v!r} (none|zlib)"))
+    return v == "zlib"
+_sst_flags.define_flag("sst_bloom_bits_per_key", 10,
+                       "doc-key bloom filter density (ref "
+                       "BlockBasedTableOptions::filter_policy)")
 
 SST_MAGIC = 0x59425453535431  # "YBTSST1"
 _FOOTER = struct.Struct("<QIQIQIQIQ")
@@ -99,12 +122,19 @@ class SSTWriter:
     shapes; 4096 rows * ~20B keys ~ 100-200KB blocks).
     """
 
-    def __init__(self, base_path: str, block_entries: int = 4096,
-                 compress: bool = False, bits_per_key: int = 10):
+    def __init__(self, base_path: str, block_entries: Optional[int] = None,
+                 compress: Optional[bool] = None,
+                 bits_per_key: Optional[int] = None):
+        from yugabyte_tpu.utils import flags as _flags
         self.base_path = base_path
-        self.block_entries = block_entries
-        self.compress = compress
-        self.bits_per_key = bits_per_key
+        # None = take the server-wide tuning flags (the reference's LSM
+        # option surface, docdb_rocksdb_util.cc:62-140)
+        self.block_entries = (block_entries if block_entries is not None
+                              else _flags.get_flag("sst_block_entries"))
+        self.compress = (compress if compress is not None
+                         else sst_compression_enabled())
+        self.bits_per_key = (bits_per_key if bits_per_key is not None
+                             else _flags.get_flag("sst_bloom_bits_per_key"))
 
     def write(self, slab: KVSlab, frontier: Optional[Frontier] = None) -> SSTProps:
         n = slab.n
@@ -157,7 +187,7 @@ def write_base_file(base_path: str,
                     n_entries: int, bloom_hashes: np.ndarray,
                     first_key: bytes, last_key: bytes,
                     frontier: Optional[Frontier], data_size: int,
-                    bits_per_key: int = 10,
+                    bits_per_key: Optional[int] = None,
                     max_expire_us: int = 0) -> SSTProps:
     """Assemble the base (metadata) file from precomputed parts.
 
@@ -165,6 +195,8 @@ def write_base_file(base_path: str,
     block. Shared by the Python SSTWriter and the native compaction shell
     (storage/native_engine.py), which produces the parts in C++.
     """
+    if bits_per_key is None:
+        bits_per_key = _sst_flags.get_flag("sst_bloom_bits_per_key")
     bloom = BloomFilterBuilder(max(n_entries, 1), bits_per_key)
     if n_entries:
         bloom.add_hashes(np.asarray(bloom_hashes, dtype=np.uint64))

@@ -102,7 +102,7 @@ class LocalConsensusContext:
 
 @dataclass
 class TabletOptions:
-    block_entries: int = 4096
+    block_entries: Optional[int] = None  # None = sst_block_entries flag
     device: object = None
     device_cache: object = None
     compaction_pool: object = None
