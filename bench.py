@@ -335,7 +335,11 @@ def run_device_child(platform: str, workload_path: str) -> None:
         ids = iter(range(1, 1 << 20))
         if native_engine.available():
             cache = DeviceSlabCache(device=dev)
-            input_ids = list(range(len(readers)))
+            # id space disjoint from output file ids (the write-through
+            # REPLACES cache entries — an output landing on an input's id
+            # would silently corrupt the next run's decisions; production
+            # ids are VersionSet-unique per namespaced DB)
+            input_ids = [10**9 + i for i in range(len(readers))]
             # steady state: inputs staged by flush write-through
             for fid, r in zip(input_ids, readers):
                 cache.stage(fid, r.read_all())
@@ -452,7 +456,11 @@ def main():
         run_device_child(sys.argv[2], sys.argv[3])
         return
 
-    tpu_timeout = float(os.environ.get("YBTPU_BENCH_TIMEOUT", 300))
+    # 480s: the 4M-row merge-network compile through the tunnel can take
+    # minutes COLD; the persistent compilation cache keeps whatever
+    # finished, so a timed-out first attempt leaves attempt 2 to resume
+    # from cached executables
+    tpu_timeout = float(os.environ.get("YBTPU_BENCH_TIMEOUT", 480))
     attempts = int(os.environ.get("YBTPU_BENCH_TPU_ATTEMPTS", 2))
 
     # workload + C++ baseline are JAX-free: compute ONCE in the parent and

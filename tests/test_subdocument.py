@@ -164,3 +164,17 @@ def test_root_read_sees_resurrected_subtree(db):
     # primitive-at-ancestor shadows OLDER descendants even on root reads
     write(db, dk(), ("a",), 42, 4000)
     assert read_subdocument(db, dk()) == {"a": 42, "b": 2}
+
+
+def test_rooted_read_sees_resurrection_over_stale_primitive(db):
+    """Rooted and root reads agree in BOTH directions: a newer descendant
+    resurrects the path as an object even when the path's own visible
+    entry is an older primitive."""
+    write(db, dk(), (), {"b": 2}, 500)
+    write(db, dk(), ("a",), 42, 2000)
+    write(db, dk(), ("a", "x"), 5, 3000)
+    assert read_subdocument(db, dk(), ("a",)) == {"x": 5}
+    assert read_subdocument(db, dk()) == {"a": {"x": 5}, "b": 2}
+    # and the primitive-newer direction still wins
+    write(db, dk(), ("a",), 43, 4000)
+    assert read_subdocument(db, dk(), ("a",)) == 43

@@ -153,8 +153,11 @@ def read_subdocument(db, doc_key: DocKey, path: PathType = (),
                 node[comp] = nxt
             node = nxt
         node[rel[-1]] = {} if isinstance(v, dict) else v
-    if root_set[1] is not None:
-        return root_set[1]          # the path itself is a primitive
+    if root_set[1] is not None and not root:
+        # the path itself is a primitive AND nothing newer resurrected it
+        # as an object (surviving descendants are provably newer than the
+        # visible primitive — a newer primitive would have shadowed them)
+        return root_set[1]
     if not root and not root_set[0]:
         return None
     return root

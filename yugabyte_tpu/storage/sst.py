@@ -42,21 +42,17 @@ _sst_flags.define_flag("sst_block_entries", 4096,
                        "block_size docdb_rocksdb_util.cc)")
 _sst_flags.define_flag("sst_compression", "none",
                        "SST block compression: 'none' or 'zlib' (ref "
-                       "compression_type)")
-
-
-def sst_compression_enabled() -> bool:
-    """Single authority for the compression flag (three writer paths
-    share it); unknown codec names fail loudly instead of silently
-    writing uncompressed files."""
-    v = _sst_flags.get_flag("sst_compression")
-    if v not in ("none", "zlib"):
-        raise StatusError(Status.InvalidArgument(
-            f"sst_compression: unknown codec {v!r} (none|zlib)"))
-    return v == "zlib"
+                       "compression_type)",
+                       validator=lambda v: v in ("none", "zlib"))
 _sst_flags.define_flag("sst_bloom_bits_per_key", 10,
                        "doc-key bloom filter density (ref "
                        "BlockBasedTableOptions::filter_policy)")
+
+
+def sst_compression_enabled() -> bool:
+    """Single authority for the compression-flag read (three writer
+    paths share it); the codec name validates at set time."""
+    return _sst_flags.get_flag("sst_compression") == "zlib"
 
 SST_MAGIC = 0x59425453535431  # "YBTSST1"
 _FOOTER = struct.Struct("<QIQIQIQIQ")
@@ -125,16 +121,16 @@ class SSTWriter:
     def __init__(self, base_path: str, block_entries: Optional[int] = None,
                  compress: Optional[bool] = None,
                  bits_per_key: Optional[int] = None):
-        from yugabyte_tpu.utils import flags as _flags
         self.base_path = base_path
         # None = take the server-wide tuning flags (the reference's LSM
         # option surface, docdb_rocksdb_util.cc:62-140)
         self.block_entries = (block_entries if block_entries is not None
-                              else _flags.get_flag("sst_block_entries"))
+                              else _sst_flags.get_flag("sst_block_entries"))
         self.compress = (compress if compress is not None
                          else sst_compression_enabled())
         self.bits_per_key = (bits_per_key if bits_per_key is not None
-                             else _flags.get_flag("sst_bloom_bits_per_key"))
+                             else _sst_flags.get_flag(
+                                 "sst_bloom_bits_per_key"))
 
     def write(self, slab: KVSlab, frontier: Optional[Frontier] = None) -> SSTProps:
         n = slab.n
