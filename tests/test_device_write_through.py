@@ -197,3 +197,57 @@ def test_multi_file_split_ranges(tmp_path):
                 host_cols[:r_common, :host_staged.n])
     finally:
         flags.set_flag("compaction_max_output_entries_per_sst", old)
+
+
+def test_production_db_routes_to_combined_path(tmp_path, monkeypatch):
+    """DB background compaction on a JAX device takes the flagship
+    device-decisions + native-shell path (the configuration the bench
+    measures), and deep-document inputs do NOT."""
+    import jax
+    from yugabyte_tpu.storage import compaction as comp
+    from yugabyte_tpu.storage.db import DB, DBOptions
+    from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+    from yugabyte_tpu.docdb.value import Value
+
+    calls = []
+    orig = comp.run_compaction_job_device_native
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+    monkeypatch.setattr(comp, "run_compaction_job_device_native", spy)
+
+    from yugabyte_tpu.docdb.doc_key import DocKey, SubDocKey
+    db = DB(str(tmp_path / "db"),
+            DBOptions(auto_compact=False, device=jax.devices()[0]))
+    for batch in range(4):
+        kvs = []
+        for i in range(50):
+            dk = DocKey(range_components=(f"r{i:04d}",))
+            key = SubDocKey(dk, (("col", 0),)).encode(include_ht=False)
+            kvs.append((key, DocHybridTime(
+                HybridTime.from_micros(1000 + batch * 100 + i), 0),
+                Value(primitive=batch).encode()))
+        db.write_batch(kvs)
+        db.flush()
+    assert db.n_live_files == 4
+    db.compact_all()
+    assert calls, "combined device+native path was not taken"
+    assert db.n_live_files == 1
+    db.close()
+
+    # deep inputs: props.has_deep gates the combined path off
+    calls.clear()
+    from yugabyte_tpu.docdb.subdocument import subdocument_writes
+    db2 = DB(str(tmp_path / "db2"),
+             DBOptions(auto_compact=False, device=jax.devices()[0]))
+    for batch in range(4):
+        kvs = [(k, DocHybridTime(HybridTime.from_micros(1000 + batch), i), v)
+               for i, (k, v) in enumerate(subdocument_writes(
+                   DocKey(range_components=(f"d{batch}",)), (),
+                   {"a": {"b": {"c": batch}}}))]
+        db2.write_batch(kvs)
+        db2.flush()
+    db2.compact_all()
+    assert not calls, "deep inputs must not take the depth-2 device path"
+    db2.close()

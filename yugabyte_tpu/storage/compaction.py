@@ -156,8 +156,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                        new_file_id, history_cutoff_ht: int, is_major: bool,
                        retain_deletes: bool = False, device=None,
                        block_entries: Optional[int] = None, device_cache=None,
-                       input_ids: Optional[Sequence[int]] = None
-                       ) -> CompactionResult:
+                       input_ids: Optional[Sequence[int]] = None,
+                       _no_combined: bool = False) -> CompactionResult:
     """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
 
     new_file_id: callable returning the next file id (VersionSet.new_file_id).
@@ -166,6 +166,29 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     skipped for cache hits; values always stream from disk on the host side.
     """
     all_inputs = list(inputs)
+    orig_input_ids = list(input_ids) if input_ids is not None else None
+    if device is not None and device != "native" and not _no_combined:
+        # The flagship production path: device merge+GC decisions + the
+        # C++ byte shell + device-side write-through (the configuration
+        # bench.py measures). Gated BEFORE the expiry filtering below —
+        # the combined path re-runs identical filtering itself. Taken
+        # when the native shell can run the bytes (unencrypted), every
+        # input is depth-2 (the SST props record deep-ness so no decode
+        # is needed to decide), and the radix debug override is off; the
+        # combined path falls back here for skewed run layouts —
+        # _no_combined breaks that recursion.
+        from yugabyte_tpu.storage import native_engine
+        from yugabyte_tpu.utils.env import get_env
+        force_radix = os.environ.get("YBTPU_FORCE_RADIX", "").lower() \
+            not in ("", "0", "false")
+        if (native_engine.available() and not get_env().encrypted
+                and not force_radix
+                and not any(r.props.has_deep for r in all_inputs)):
+            return run_compaction_job_device_native(
+                all_inputs, out_dir, new_file_id, history_cutoff_ht,
+                is_major, retain_deletes, device=device,
+                block_entries=block_entries, device_cache=device_cache,
+                input_ids=orig_input_ids)
     inputs, dropped = filter_expired_inputs(
         inputs, history_cutoff_ht, is_major, retain_deletes)
     dropped_rows = sum(r.props.n_entries for r in dropped)
@@ -277,7 +300,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
 
 
 def _write_native_outputs(job, out_dir: str, new_file_id, fr,
-                          block_entries: Optional[int]
+                          block_entries: Optional[int],
+                          has_deep: bool = False
                           ) -> Tuple[List[Tuple[int, str, SSTProps]],
                                      List[Tuple[int, int]]]:
     """Write the native job's survivors as (possibly split) output SSTs,
@@ -307,7 +331,7 @@ def _write_native_outputs(job, out_dir: str, new_file_id, fr,
             compress=sst_compression_enabled(),
             tombstone_value=tombstone_value)
         props = write_base_file(base_path, index, end - start, hashes,
-                                fk, lk, fr, size)
+                                fk, lk, fr, size, has_deep=has_deep)
         outputs.append((fid, base_path, props))
         ranges.append((start, end))
         if limiter is not None and end < rows_out:
@@ -334,8 +358,9 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
         fr = _merge_frontiers(
             [r.props.frontier for r in (frontier_inputs or inputs)],
             history_cutoff_ht)
-        outputs, _ranges = _write_native_outputs(job, out_dir, new_file_id,
-                                                 fr, block_entries)
+        outputs, _ranges = _write_native_outputs(
+            job, out_dir, new_file_id, fr, block_entries,
+            has_deep=any(r.props.has_deep for r in inputs))
     return CompactionResult(outputs, rows_in, rows_out)
 
 
@@ -371,7 +396,8 @@ def run_compaction_job_device_native(
                                   retain_deletes, device=device,
                                   block_entries=block_entries,
                                   device_cache=device_cache,
-                                  input_ids=input_ids)
+                                  input_ids=input_ids,
+                                  _no_combined=True)
 
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None
@@ -396,7 +422,8 @@ def run_compaction_job_device_native(
                                   retain_deletes, device=device,
                                   block_entries=block_entries,
                                   device_cache=device_cache,
-                                  input_ids=orig_input_ids)
+                                  input_ids=orig_input_ids,
+                                  _no_combined=True)
 
     # 1) launch the device decisions from the HBM slab cache
     staged_list = []
@@ -426,8 +453,9 @@ def run_compaction_job_device_native(
         rows_out = job.n_survivors
         fr = _merge_frontiers([r.props.frontier for r in all_inputs],
                               history_cutoff_ht)
-        outputs, ranges = _write_native_outputs(job, out_dir, new_file_id,
-                                                fr, block_entries)
+        outputs, ranges = _write_native_outputs(
+            job, out_dir, new_file_id, fr, block_entries,
+            has_deep=any(r.props.has_deep for r in inputs))
     if device_cache is not None and outputs:
         # write-through: the outputs are the next compaction's inputs.
         # Staged ON DEVICE by gathering the surviving columns in HBM —

@@ -95,19 +95,27 @@ class SSTProps:
     # microseconds-physical time at which the LAST entry expires, or 0 when
     # any entry lacks a TTL (file never fully expires).
     max_expire_us: int = 0
+    # any entry addresses a document deeper than row+column (FLAG_DEEP):
+    # lets the compaction dispatcher decide device routing WITHOUT
+    # decoding the file (the fused kernel handles depth-2 only)
+    has_deep: bool = False
 
     def to_json(self) -> dict:
         return {"n_entries": self.n_entries, "first_key": self.first_key.hex(),
                 "last_key": self.last_key.hex(), "frontier": self.frontier.to_json(),
                 "data_size": self.data_size, "base_size": self.base_size,
-                "max_expire_us": self.max_expire_us}
+                "max_expire_us": self.max_expire_us,
+                "has_deep": self.has_deep}
 
     @staticmethod
     def from_json(d: dict) -> "SSTProps":
         return SSTProps(d["n_entries"], bytes.fromhex(d["first_key"]),
                         bytes.fromhex(d["last_key"]), Frontier.from_json(d["frontier"]),
                         d["data_size"], d["base_size"],
-                        d.get("max_expire_us", 0))
+                        d.get("max_expire_us", 0),
+                        # files from before this field conservatively count
+                        # as deep (native routing is always correct)
+                        bool(d.get("has_deep", True)))
 
 
 class SSTWriter:
@@ -171,11 +179,13 @@ class SSTWriter:
                        | slab.ht_lo.astype(np.uint64)) >> 12
             max_expire_us = int(
                 (ht_phys + slab.ttl_ms.astype(np.uint64) * 1000).max())
+        from yugabyte_tpu.ops.slabs import FLAG_DEEP
         return write_base_file(
             self.base_path, index_items, n, hashes,
             key_at(0) if n else b"", key_at(n - 1) if n else b"",
             frontier, data_off, self.bits_per_key,
-            max_expire_us=max_expire_us)
+            max_expire_us=max_expire_us,
+            has_deep=bool(n) and bool(((slab.flags & FLAG_DEEP) != 0).any()))
 
 
 def write_base_file(base_path: str,
@@ -184,7 +194,8 @@ def write_base_file(base_path: str,
                     first_key: bytes, last_key: bytes,
                     frontier: Optional[Frontier], data_size: int,
                     bits_per_key: Optional[int] = None,
-                    max_expire_us: int = 0) -> SSTProps:
+                    max_expire_us: int = 0,
+                    has_deep: bool = False) -> SSTProps:
     """Assemble the base (metadata) file from precomputed parts.
 
     index_items: (last_key, data_offset, block_size, n_entries) per data
@@ -207,6 +218,7 @@ def write_base_file(base_path: str,
         frontier=frontier or Frontier(),
         data_size=data_size,
         max_expire_us=max_expire_us,
+        has_deep=has_deep,
     )
     props_bytes = json.dumps(props.to_json()).encode()
     from yugabyte_tpu.utils.env import get_env
