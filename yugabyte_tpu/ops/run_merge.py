@@ -39,6 +39,7 @@ between the TPU path losing and beating the CPU baseline end-to-end.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -429,8 +430,39 @@ def gather_staged_outputs(handle: MergeGCHandle,
     return outs
 
 
+def _pick_impl(staged: StagedRuns) -> str:
+    """Merge strategy: YBTPU_MERGE_IMPL = auto|pallas|network.
+
+    auto: the pallas merge-path tournament (ops/pallas_merge.py) on TPU
+    backends where its preconditions hold — it replaces ~log^2 full-array
+    compare-exchange stages + a giant lane gather with log2(K) streaming
+    level passes; the jnp network elsewhere (pallas interpret mode is far
+    too slow for the production CPU fallback path).
+    """
+    impl = os.environ.get("YBTPU_MERGE_IMPL", "auto")
+    if impl == "network" or staged.k_pad < 2:
+        return "network"
+    from yugabyte_tpu.ops import pallas_merge
+    if not pallas_merge.supported(staged):
+        if impl == "pallas":
+            import sys as _sys
+            print(f"[run_merge] YBTPU_MERGE_IMPL=pallas requested but "
+                  f"preconditions fail (k_pad={staged.k_pad} m={staged.m} "
+                  f"w={staged.w}) — using the jnp network instead",
+                  file=_sys.stderr, flush=True)
+        return "network"
+    if impl == "pallas":
+        return "pallas"
+    import jax as _jax
+    return "pallas" if _jax.default_backend() == "tpu" else "network"
+
+
 def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot: bool = False) -> MergeGCHandle:
+    if _pick_impl(staged) == "pallas":
+        from yugabyte_tpu.ops import pallas_merge
+        return pallas_merge.launch_merge_gc_pallas(staged, params,
+                                                   snapshot=snapshot)
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
     # runtime iota operand: see merge_network's pos docstring (compile-
