@@ -270,3 +270,15 @@ def test_system_select_star_empty_still_has_columns(ql):
     rs = ql.execute("SELECT * FROM system_schema.tables "
                     "WHERE keyspace_name = 'does_not_exist'")
     assert rs.columns and rs.rows == []
+
+
+def test_i32_cast_overflow_raises():
+    """ADVICE r3: narrowing casts must use the same overflow policy as the
+    checked intasblob companion — no silent truncation."""
+    import pytest as _pytest
+    from yugabyte_tpu.yql.bfunc import EvalError, resolve
+    from yugabyte_tpu.common.schema import DataType
+    fn = resolve("cast", [DataType.INT64, DataType.INT32])
+    assert fn.fn(5, None) == 5
+    with _pytest.raises(EvalError):
+        fn.fn(1 << 40, None)

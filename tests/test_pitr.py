@@ -147,3 +147,68 @@ def test_schedule_survives_in_sys_catalog(cluster):
         cat.delete_snapshot_schedule(sched["schedule_id"])
     assert all(s["schedule_id"] != sched["schedule_id"]
                for s in cat.list_snapshot_schedules())
+
+
+def test_schedule_retention_reaches_tablets(cluster):
+    """PITR history protection: a schedule whose interval exceeds the
+    history retention flag must hold tablet history cutoffs back, or
+    compaction collapses the MVCC versions a restore needs (ADVICE r3;
+    ref tablet_retention_policy.cc AllowedHistoryCutoff)."""
+    client = cluster.new_client()
+    table = client.create_table("db", "held", SCHEMA, num_tablets=1)
+    cluster.wait_all_replicas_running(table.table_id)
+    master = cluster.leader_master()
+    cat = master.catalog
+    sched = cat.create_snapshot_schedule("db", "held",
+                                         interval_s=7200, retention_s=86400)
+    covered = set(cat.get_table("db", "held")["tablet_ids"])
+    try:
+        deadline = time.time() + 10
+        held = False
+        while time.time() < deadline and not held:
+            for ts in cluster.tservers:
+                for peer in ts.tablet_manager.peers():
+                    t = peer.tablet
+                    if (t is not None and peer.tablet_id in covered
+                            and t.retention_policy.override_s >= 7200):
+                        held = True
+            time.sleep(0.1)
+        assert held, "retention override never reached the tablet"
+        # the held-back cutoff is at least interval_s deep
+        cutoff = t.retention_policy.history_cutoff()
+        now_us = int(time.time() * 1e6)
+        assert cutoff <= (now_us - 7200 * 1_000_000 + 2_000_000) << 12
+    finally:
+        cat.delete_snapshot_schedule(sched["schedule_id"])
+    # deleting the schedule must RELEASE the deep retention (review r4):
+    # the next heartbeat's complete map resets uncovered tablets to zero
+    deadline = time.time() + 10
+    released = False
+    while time.time() < deadline and not released:
+        released = all(
+            peer.tablet.retention_policy.override_s == 0.0
+            for ts in cluster.tservers
+            for peer in ts.tablet_manager.peers()
+            if peer.tablet is not None and peer.tablet_id in covered)
+        time.sleep(0.1)
+    assert released, "retention override not cleared after schedule delete"
+
+
+def test_restore_below_history_floor_rejected(cluster):
+    """A restore target older than the snapshot's guaranteed MVCC history
+    floor must fail loudly instead of returning silently-wrong data."""
+    client = cluster.new_client()
+    try:
+        client.create_namespace("db")
+    except StatusError:
+        pass  # created by an earlier test in the module-scoped cluster
+    table = client.create_table("db", "floorcheck", SCHEMA, num_tablets=1)
+    cluster.wait_all_replicas_running(table.table_id)
+    master = cluster.leader_master()
+    cat = master.catalog
+    snap = cat.create_table_snapshot("db", "floorcheck")
+    assert "history_floor_micros" in snap
+    too_old = snap["history_floor_micros"] - 10_000_000
+    with pytest.raises(StatusError) as ei:
+        cat.pick_restore_snapshot("db", "floorcheck", too_old)
+    assert "history floor" in str(ei.value)

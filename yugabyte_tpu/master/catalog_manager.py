@@ -31,6 +31,23 @@ flags.define_flag("tserver_unresponsive_timeout_ms", 3000,
                   "(ref tserver_unresponsive_timeout_ms)")
 flags.define_flag("replication_factor", 3,
                   "default table replication factor (ref replication_factor)")
+# extra MVCC history beyond a PITR schedule's interval, covering snapshot
+# timing jitter + heartbeat propagation of the retention override
+_SCHEDULE_RETENTION_SLACK_S = 60.0
+
+# Same definition as tablet.py (define_flag is idempotent for identical
+# defaults and raises loudly on drift): a master-only process needs the
+# value for snapshot history floors without importing the tablet stack.
+flags.define_flag(
+    "timestamp_history_retention_interval_sec", 900,
+    "how far back in time reads are repeatable; compaction keeps overwritten "
+    "values younger than this (ref tablet_retention_policy.h:29)")
+
+
+def _base_history_retention_s() -> float:
+    return float(flags.get_flag("timestamp_history_retention_interval_sec"))
+
+
 flags.define_flag("index_backfill_grace_ms", 500,
                   "wait between index creation and the backfill snapshot so "
                   "every writer observes the index in write mode first (the "
@@ -103,6 +120,9 @@ class CatalogManager:
         # volatile: authoritative Raft config index per tablet (from leader
         # reports); used to recognize evicted stale replicas.
         self._config_indexes: Dict[str, int] = {}
+        # memoized table_id -> required history retention (PITR schedules);
+        # None = rebuild on next heartbeat (see _history_retention_for)
+        self._retention_by_table: Optional[Dict[str, float]] = None
 
     # ------------------------------------------------------------ leadership
     def is_leader(self) -> bool:
@@ -460,7 +480,49 @@ class CatalogManager:
                 resp["universe_keys"] = keys
         except Exception:  # noqa: BLE001 — must never fail heartbeats
             pass
+        try:
+            # always present (possibly {}): the tserver resets tablets NOT
+            # in the map to zero, so deleting a schedule releases the deep
+            # retention instead of pinning it until restart
+            resp["history_retention"] = self._history_retention_for(
+                reported_ids)
+        except Exception:  # noqa: BLE001 — must never fail heartbeats
+            pass
         return resp
+
+    def _history_retention_for(self, tablet_ids) -> dict:
+        """Per-tablet minimum MVCC history retention implied by active PITR
+        snapshot schedules: a restore target can be up to interval_s older
+        than its covering snapshot, so tablets under a schedule must retain
+        at least interval_s (+slack) of history or compaction collapses the
+        versions the restore needs (ref tablet_retention_policy.cc
+        AllowedHistoryCutoff fed by the snapshot coordinator).
+
+        The per-table map is cached — heartbeats arrive ~1/s per tserver
+        and must not pay a full sys-catalog scan each; schedule create/
+        delete invalidates."""
+        per_table = self._retention_by_table
+        if per_table is None:
+            per_table = {}
+            for sched in self.list_snapshot_schedules():
+                try:
+                    table = self.get_table(sched["namespace"],
+                                           sched["table"])
+                except StatusError:
+                    continue
+                need = sched["interval_s"] + _SCHEDULE_RETENTION_SLACK_S
+                tid = table["table_id"]
+                per_table[tid] = max(per_table.get(tid, 0.0), need)
+            self._retention_by_table = per_table
+        if not per_table:
+            return {}
+        out = {}
+        with self._lock:
+            for tablet_id in tablet_ids:
+                tm = self.tablets.get(tablet_id)
+                if tm and tm["table_id"] in per_table:
+                    out[tablet_id] = per_table[tm["table_id"]]
+        return out
 
     def _adopt_split_child_locked(self, t: dict) -> None:
         parent_id = t["split_parent"]
@@ -678,12 +740,29 @@ class CatalogManager:
                                 "snapshot_tablet", timeout_s=60.0,
                                 tablet_id=tablet_id,
                                 snapshot_id=snapshot_id)
+        # Guaranteed MVCC history floor inside this snapshot's files: the
+        # base retention flag always applies; a schedule's deeper override
+        # only counts for as long as the schedule has existed (the override
+        # rides heartbeats, so versions older than the schedule may already
+        # be compacted away).  Restores below the floor are rejected rather
+        # than silently returning post-compaction state.
+        effective_s = _base_history_retention_s()
+        if schedule_id is not None:
+            sched = self.sys.get("snapshot_schedule", schedule_id)
+            if sched is not None:
+                need = sched["interval_s"] + _SCHEDULE_RETENTION_SLACK_S
+                age = max(0.0, _time.time()
+                          - sched.get("created_unix", _time.time()))
+                effective_s = max(effective_s,
+                                  min(need, effective_s + age))
         meta = {"snapshot_id": snapshot_id, "namespace": namespace,
                 "table": name, "table_id": table["table_id"],
                 "schema": table["schema"],
                 "partition_schema": table["partition_schema"],
                 "tablet_ids": tablet_ids,
                 "snapshot_micros": snapshot_micros,
+                "history_floor_micros": int(snapshot_micros
+                                            - effective_s * 1e6),
                 "schedule_id": schedule_id}
         with self._lock:
             self.sys.upsert("snapshot", snapshot_id, meta)
@@ -705,9 +784,11 @@ class CatalogManager:
                  "namespace": namespace, "table": name,
                  "interval_s": float(interval_s),
                  "retention_s": float(retention_s),
+                 "created_unix": time.time(),
                  "last_snapshot_unix": 0.0}
         with self._lock:
             self.sys.upsert("snapshot_schedule", sched["schedule_id"], sched)
+        self._retention_by_table = None
         return sched
 
     def list_snapshot_schedules(self) -> List[dict]:
@@ -725,6 +806,7 @@ class CatalogManager:
                     pass
         with self._lock:
             self.sys.delete("snapshot_schedule", schedule_id)
+        self._retention_by_table = None
 
     def run_snapshot_schedules(self) -> int:
         """One bg-loop tick: take due snapshots, prune expired ones.
@@ -777,7 +859,16 @@ class CatalogManager:
             raise StatusError(Status.NotFound(
                 f"no snapshot of {namespace}.{name} covers time "
                 f"{restore_micros} — outside the retention window?"))
-        return min(cands, key=lambda s: s["snapshot_micros"])
+        best = min(cands, key=lambda s: s["snapshot_micros"])
+        floor = best.get("history_floor_micros")
+        if floor is not None and restore_micros < floor:
+            raise StatusError(Status.InvalidArgument(
+                f"restore time {restore_micros} predates snapshot "
+                f"{best['snapshot_id']}'s guaranteed MVCC history floor "
+                f"{floor}: compaction may have collapsed the needed "
+                f"versions (raise timestamp_history_retention_interval_sec "
+                f"or shorten the schedule interval)"))
+        return best
 
     def list_snapshots(self) -> List[dict]:
         return [m for _t, _id, m in self.sys.scan_all()

@@ -54,17 +54,31 @@ flags.define_flag("write_backpressure_max_delay_ms", 100,
 
 
 class TabletRetentionPolicy:
-    """history_cutoff = now - retention interval (ref tablet_retention_policy.h)."""
+    """history_cutoff = now - retention interval (ref tablet_retention_policy.h).
+
+    override_s: PITR snapshot schedules need MVCC history at least as deep
+    as their snapshot interval — otherwise a compaction between the restore
+    target time and the covering snapshot's barrier collapses the versions
+    the restore must read, and import_snapshot silently reconstructs newer
+    state.  The master computes the requirement from active schedules and
+    ships it via heartbeat responses (ref: the snapshot coordinator feeding
+    allowed history cutoff, master_snapshot_coordinator.cc /
+    tablet_retention_policy.cc AllowedHistoryCutoff)."""
 
     def __init__(self, clock: HybridClock):
         self._clock = clock
+        self.override_s: float = 0.0
+
+    def set_override(self, seconds: float) -> None:
+        self.override_s = float(seconds)
 
     def history_cutoff(self) -> int:
-        retention_us = flags.get_flag(
-            "timestamp_history_retention_interval_sec") * 1_000_000
+        retention_s = max(
+            flags.get_flag("timestamp_history_retention_interval_sec"),
+            self.override_s)
         now = self._clock.now()
         return max(0, HybridTime.from_micros(
-            now.physical_micros - retention_us).value)
+            now.physical_micros - int(retention_s * 1_000_000)).value)
 
 
 class TabletHasBeenSplit(Exception):

@@ -142,6 +142,8 @@ class TabletServer:
         for tablet_id in resp.get("tablets_to_delete") or []:
             self.tablet_manager.delete_tablet(tablet_id)
         self._reconcile_pollers(resp.get("replication") or [])
+        self.tablet_manager.apply_history_retention(
+            resp.get("history_retention"))
         keys = resp.get("universe_keys")
         if keys:
             self._apply_universe_keys(keys)

@@ -344,6 +344,20 @@ class TSTabletManager:
         with self._lock:
             return list(self._tablets.values())
 
+    def apply_history_retention(self, overrides) -> None:
+        """Heartbeat piggyback: per-tablet minimum MVCC history retention
+        required by the master's active snapshot schedules (PITR).
+
+        None (older master / probe path) is a no-op; a dict is the complete
+        view — hosted tablets absent from it reset to zero so a deleted
+        schedule releases its deep retention."""
+        if overrides is None:
+            return
+        for peer in self.peers():
+            if peer.tablet is not None:
+                peer.tablet.retention_policy.set_override(
+                    overrides.get(peer.tablet_id, 0.0))
+
     def tablet_ids(self) -> List[str]:
         with self._lock:
             return list(self._tablets)

@@ -196,8 +196,19 @@ def infer_type(v) -> Optional[object]:
 
 
 # ---------------------------------------------------------------- casts
+def _to_i32(x):
+    v = int(x)
+    if not -(1 << 31) <= v < (1 << 31):
+        # same overflow policy as the checked intasblob companion below —
+        # a silent narrow here would store a different number than written
+        raise EvalError(f"cast: {v} out of int32 range")
+    return v
+
+
 def _num_cast(target):
-    if target in (DataType.INT32, DataType.INT64):
+    if target == DataType.INT32:
+        return lambda x, _t=None: None if x is None else _to_i32(x)
+    if target == DataType.INT64:
         return lambda x, _t=None: None if x is None else int(x)
     return lambda x, _t=None: None if x is None else float(x)
 
@@ -264,6 +275,11 @@ for (_name, _dst), _f in _BLOB_UNPACK.items():
             (lambda f: lambda x: None if x is None else f(x))(_f))
 
 # ------------------------------------------------------- time / uuid
+# DIVERGENCE from Cassandra: now() returns a TIMESTAMP (micros since
+# epoch), not a version-1 timeuuid — this framework has no TIMEUUID wire
+# type, so schemas using now() for timeuuid columns must declare them as
+# timestamp.  dateof()/tounixtimestamp() below are consistent with this
+# (they accept the timestamp directly).
 declare("NowTimeUuid", "now", DataType.TIMESTAMP, (),
         lambda: int(time.time() * 1e6), volatile=True)
 declare("GetCurrentTimestamp", "currenttimestamp", DataType.TIMESTAMP, (),
