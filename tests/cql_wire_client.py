@@ -19,10 +19,11 @@ class CqlError(Exception):
 
 
 class Rows:
-    def __init__(self, columns, types, rows):
+    def __init__(self, columns, types, rows, paging_state=None):
         self.columns = columns
         self.types = types
         self.rows = rows
+        self.paging_state = paging_state
 
 
 class CqlWireClient:
@@ -52,8 +53,7 @@ class CqlWireClient:
     def _read_metadata(r: W.Reader):
         flags = r.i32()
         n = r.i32()
-        if flags & 0x02:  # has_more_pages
-            r.bytes_()
+        paging_state = r.bytes_() if flags & 0x02 else None
         global_spec = bool(flags & 0x01)
         if global_spec:
             r.string()
@@ -66,7 +66,7 @@ class CqlWireClient:
             name = r.string()
             tid = r.u16()
             cols.append((name, tid))
-        return cols
+        return cols, paging_state
 
     def _parse_result(self, body: bytes):
         r = W.Reader(body)
@@ -92,7 +92,7 @@ class CqlWireClient:
                 types.append(r.u16())
             return ("prepared", pid, types)
         if kind == W.RESULT_ROWS:
-            cols = self._read_metadata(r)
+            cols, paging_state = self._read_metadata(r)
             n_rows = r.i32()
             by_tid = {W.TYPE_INT: DataType.INT32,
                       W.TYPE_BIGINT: DataType.INT64,
@@ -108,22 +108,30 @@ class CqlWireClient:
                     dt = by_tid.get(tid, DataType.STRING)
                     row.append(W.decode_value(r.bytes_(), dt))
                 rows.append(row)
-            return Rows([c for c, _ in cols], [t for _, t in cols], rows)
+            return Rows([c for c, _ in cols], [t for _, t in cols], rows,
+                        paging_state)
         raise AssertionError(f"unknown result kind {kind}")
 
     # ------------------------------------------------------------- surface
     def execute(self, query: str, params: Optional[List[Tuple[object,
-                DataType]]] = None):
+                DataType]]] = None, page_size: Optional[int] = None,
+                paging_state: Optional[bytes] = None):
         """params: (value, DataType) pairs, encoded exactly as a driver
-        would from the prepared metadata (QUERY carries typed values)."""
-        body = [W.w_long_string(query), struct.pack(">H", 1)]  # consistency
+        would from the prepared metadata (QUERY carries typed values).
+        page_size/paging_state drive the v4 paging protocol."""
+        flags = (0x01 if params else 0) | \
+            (0x04 if page_size is not None else 0) | \
+            (0x08 if paging_state is not None else 0)
+        body = [W.w_long_string(query), struct.pack(">H", 1),  # consistency
+                bytes([flags])]
         if params:
-            body.append(bytes([0x01]))
             body.append(struct.pack(">H", len(params)))
             for v, dt in params:
                 body.append(W.w_bytes(W.encode_value(v, dt)))
-        else:
-            body.append(bytes([0x00]))
+        if page_size is not None:
+            body.append(struct.pack(">i", page_size))
+        if paging_state is not None:
+            body.append(W.w_bytes(paging_state))
         op, rbody = self._request(W.OP_QUERY, b"".join(body))
         assert op == W.OP_RESULT
         return self._parse_result(rbody)

@@ -169,6 +169,63 @@ class PgWireClient:
     def sync(self) -> None:
         self._send_msg(b"S")
 
+    def fetch_paged(self, sql: str,
+                    params: Optional[List[Optional[str]]] = None,
+                    max_rows: int = 10):
+        """Portal-suspension paging: Parse/Bind once, then repeated
+        Execute(max_rows) until CommandComplete.  Returns (rows, executes,
+        tag)."""
+        self.parse("", sql)
+        self.bind("", "", params)
+        self.describe("P", "")
+        rows: List[List[Optional[str]]] = []
+        executes = 0
+        tag = None
+        error = None
+        while tag is None and error is None:
+            self.execute_portal("", max_rows)
+            executes += 1
+            page = 0
+            while True:
+                t, payload = self._recv_msg()
+                if t in (b"1", b"2", b"n", b"T"):
+                    continue
+                if t == b"D":
+                    (n,) = struct.unpack_from(">H", payload, 0)
+                    pos = 2
+                    row: List[Optional[str]] = []
+                    for _ in range(n):
+                        (ln,) = struct.unpack_from(">i", payload, pos)
+                        pos += 4
+                        if ln == -1:
+                            row.append(None)
+                        else:
+                            row.append(payload[pos:pos + ln].decode())
+                            pos += ln
+                    rows.append(row)
+                    page += 1
+                    assert max_rows <= 0 or page <= max_rows, \
+                        "server exceeded max_rows"
+                elif t == b"s":       # PortalSuspended: Execute again
+                    break
+                elif t == b"C":
+                    tag = payload[:-1].decode()
+                    break
+                elif t == b"E":
+                    error = PgWireError(*self._parse_error(payload))
+                    break
+                else:
+                    raise AssertionError(f"unexpected message {t!r}")
+        self.sync()
+        while True:
+            t, payload = self._recv_msg()
+            if t == b"Z":
+                self.txn_status = payload.decode()
+                break
+        if error is not None:
+            raise error
+        return rows, executes, tag
+
     def extended_query(self, sql: str,
                        params: Optional[List[Optional[str]]] = None
                        ) -> QueryResult:

@@ -354,3 +354,36 @@ class TestScanChoices:
     def test_in_without_hash_key_single_scan(self, tql):
         rs = tql.execute("SELECT v FROM ts WHERE r IN (2, 5)")
         assert sorted(r[0] for r in rs.rows) == ["a2", "a5", "b2", "b5"]
+
+
+class TestInListClusteringGuard:
+    """ADVICE r3: `r2 IN (...)` with an earlier clustering column unbound
+    must NOT take the per-option path — the per-option concatenation would
+    order by (r2, r1) instead of clustering order, so LIMIT keeps the
+    wrong rows.  It must fall back to one scan with IN as residual."""
+
+    @pytest.fixture(scope="class")
+    def tql2(self, cluster):
+        from yugabyte_tpu.yql.cql.executor import QLProcessor
+        proc = QLProcessor(cluster.new_client())
+        proc.execute("CREATE KEYSPACE inks")
+        proc.execute("USE inks")
+        proc.execute("CREATE TABLE t2 (h text, r1 bigint, r2 bigint, "
+                     "v text, PRIMARY KEY ((h), r1, r2))")
+        for r1 in range(3):
+            for r2 in range(3):
+                proc.execute(f"INSERT INTO t2 (h, r1, r2, v) VALUES "
+                             f"('a', {r1}, {r2}, 'v{r1}{r2}')")
+        return proc
+
+    def test_limit_respects_clustering_order(self, tql2):
+        # clustering order: (r1, r2) = 00,01,02,10,11,12,20,21,22
+        # rows with r2 IN (0, 2): 00,02,10,12,20,22 -> LIMIT 3 = 00,02,10
+        rs = tql2.execute("SELECT r1, r2 FROM t2 WHERE h = 'a' "
+                          "AND r2 IN (0, 2) LIMIT 3")
+        assert [(r[0], r[1]) for r in rs.rows] == [(0, 0), (0, 2), (1, 0)]
+
+    def test_bound_prefix_still_uses_options(self, tql2):
+        rs = tql2.execute("SELECT r2 FROM t2 WHERE h = 'a' AND r1 = 1 "
+                          "AND r2 IN (2, 0) LIMIT 1")
+        assert [r[0] for r in rs.rows] == [0]

@@ -319,7 +319,9 @@ class YBClient:
              projection: Optional[Sequence[str]] = None,
              page_size: int = 4096,
              filters: Optional[Sequence[Sequence]] = None,
-             txn_id: Optional[bytes] = None):
+             txn_id: Optional[bytes] = None,
+             start_cursor: bytes = b"", start_lower: bytes = b"",
+             scan_state: Optional[dict] = None):
         """Full-table scan in partition-key order, paging within each
         tablet (ref pg_doc_op.h:399 fan-out + paging). The read point the
         first page resolves is pinned for every later page and tablet, so
@@ -327,10 +329,15 @@ class YBClient:
         + a global doc-key lower bound make the scan robust to tablets
         splitting or moving mid-scan: doc keys order the same way as
         partition keys, so re-looking up the cursor can never re-yield or
-        skip rows."""
+        skip rows.
+
+        start_cursor/start_lower resume a previous scan (a query layer's
+        paging-state continuation); scan_state, when given, is updated
+        with the pinned {'read_ht': ...} so the caller can embed it in a
+        continuation token."""
         pinned = read_ht.value if read_ht else None
-        cursor = b""   # partition-key-space position
-        lower = b""    # doc-key-space resume bound (global, monotonic)
+        cursor = start_cursor   # partition-key-space position
+        lower = start_lower     # doc-key resume bound (global, monotonic)
         failures = 0
         while True:
             tablet = self.meta_cache.lookup_tablet(table.table_id, cursor)
@@ -357,6 +364,8 @@ class YBClient:
             failures = 0
             if pinned is None:
                 pinned = resp.get("read_ht")
+            if scan_state is not None:
+                scan_state["read_ht"] = pinned
             for w in resp["rows"]:
                 yield row_from_wire(w)
             if resp.get("resume_key"):
@@ -370,10 +379,14 @@ class YBClient:
                        lower_doc_key: bytes,
                        upper_doc_key: Optional[bytes] = None,
                        read_ht: Optional[HybridTime] = None,
-                       page_size: int = 4096):
+                       page_size: int = 4096,
+                       scan_state: Optional[dict] = None):
         """Paged scan of one doc-key range within the tablet owning
         partition_key (prefix reads: all fields of one document family,
-        e.g. a redis hash's subkeys)."""
+        e.g. a redis hash's subkeys).
+
+        scan_state, when given, receives the pinned {'read_ht': ...} for
+        query-layer paging-state continuation tokens."""
         pinned = read_ht.value if read_ht else None
         lower = lower_doc_key
         failures = 0
@@ -400,6 +413,8 @@ class YBClient:
             failures = 0
             if pinned is None:
                 pinned = resp.get("read_ht")
+            if scan_state is not None:
+                scan_state["read_ht"] = pinned
             for w in resp["rows"]:
                 yield row_from_wire(w)
             if not resp.get("resume_key"):

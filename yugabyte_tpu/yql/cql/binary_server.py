@@ -163,7 +163,7 @@ class _Connection:
                  and rs.types[i] is not None else _infer_type(rs, i))
                 for i, name in enumerate(rs.columns)]
         out = [struct.pack(">i", W.RESULT_ROWS),
-               W.rows_metadata(cols),
+               W.rows_metadata(cols, paging_state=rs.paging_state),
                struct.pack(">i", len(rs.rows))]
         for row in rs.rows:
             for i, v in enumerate(row):
@@ -209,10 +209,10 @@ class _Connection:
             self._send(stream, W.OP_READY)
         elif opcode == W.OP_QUERY:
             query = r.long_string()
-            params = self._read_query_params(
+            params, page_size, paging_state = self._read_query_params(
                 r, types=None, types_provider=lambda: self._marker_types(
                     query))
-            self._run(stream, query, params)
+            self._run(stream, query, params, page_size, paging_state)
         elif opcode == W.OP_PREPARE:
             text = r.long_string()
             stmt = P.parse(text)
@@ -241,8 +241,9 @@ class _Connection:
                 self._send_error(stream, W.ERR_UNPREPARED,
                                  "unprepared statement")
                 return
-            params = self._read_query_params(r, types=prep.types)
-            self._run(stream, prep.text, params)
+            params, page_size, paging_state = self._read_query_params(
+                r, types=prep.types)
+            self._run(stream, prep.text, params, page_size, paging_state)
         elif opcode == W.OP_BATCH:
             self._run_batch(stream, r)
         else:
@@ -260,10 +261,13 @@ class _Connection:
 
     def _read_query_params(self, r: W.Reader,
                            types: Optional[List[DataType]],
-                           types_provider=None) -> List:
+                           types_provider=None):
+        """Returns (bind values, page_size, paging_state)."""
         r.u16()  # consistency — single-partition linearizable regardless
         flags = r.u8()
         params: List = []
+        page_size = None
+        paging_state = None
         if flags & 0x01:  # values
             if types is None and types_provider is not None:
                 types = types_provider()
@@ -279,18 +283,23 @@ class _Connection:
                       else DataType.STRING)
                 params.append(W.decode_value(raw, dt))
         if flags & 0x04:
-            r.i32()   # page size (full result returned; paging TODO)
+            page_size = r.i32()
+            if page_size is not None and page_size <= 0:
+                page_size = None
         if flags & 0x08:
-            r.bytes_()  # paging state
+            paging_state = r.bytes_()
         if flags & 0x10:
             r.u16()   # serial consistency
         if flags & 0x20:
             r.i64()   # default timestamp
-        return params
+        return params, page_size, paging_state
 
-    def _run(self, stream: int, text: str, params: List) -> None:
+    def _run(self, stream: int, text: str, params: List,
+             page_size: Optional[int] = None,
+             paging_state: Optional[bytes] = None) -> None:
         stmt_head = text.lstrip()[:6].upper()
-        rs = self._processor.execute(text, params)
+        rs = self._processor.execute(text, params, page_size=page_size,
+                                     paging_state=paging_state)
         if stmt_head.startswith("USE"):
             self._send(stream, W.OP_RESULT,
                        struct.pack(">i", W.RESULT_SET_KEYSPACE)

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from yugabyte_tpu.client.client import YBClient, YBTable
 from yugabyte_tpu.client.transaction import (
     TransactionError, TransactionManager)
+from yugabyte_tpu.common.hybrid_time import HybridTime
 from yugabyte_tpu.common.schema import (
     ColumnSchema, DataType, Schema, SortingType)
 from yugabyte_tpu.docdb.doc_key import DocKey
@@ -51,9 +52,30 @@ class ResultSet:
     # for Rows result metadata
     types: List[Optional[DataType]] = field(default_factory=list)
     source: Tuple[str, str] = ("", "")
+    # opaque continuation token: more rows may remain; resume by re-running
+    # the same statement with paging_state=this (ref CQL paging protocol)
+    paging_state: Optional[bytes] = None
 
     def dicts(self) -> List[dict]:
         return [dict(zip(self.columns, r)) for r in self.rows]
+
+
+def _encode_page_state(lower: bytes, cursor: bytes, read_ht: int,
+                       remaining: Optional[int]) -> bytes:
+    """Opaque SELECT continuation: resume doc-key bound, partition cursor,
+    pinned snapshot read time and LIMIT budget left."""
+    import struct as _s
+    rem = -1 if remaining is None else remaining
+    return (_s.pack(">QqII", read_ht, rem, len(lower), len(cursor))
+            + lower + cursor)
+
+
+def _decode_page_state(tok: bytes):
+    import struct as _s
+    read_ht, rem, nl, nc = _s.unpack(">QqII", tok[:24])
+    lower = tok[24:24 + nl]
+    cursor = tok[24 + nl:24 + nl + nc]
+    return lower, cursor, read_ht, (None if rem < 0 else rem)
 
 
 class QLProcessor:
@@ -251,9 +273,17 @@ class QLProcessor:
         return True
 
     # -------------------------------------------------------------- execute
-    def execute(self, text: str, params: Sequence[object] = ()) -> ResultSet:
+    def execute(self, text: str, params: Sequence[object] = (),
+                page_size: Optional[int] = None,
+                paging_state: Optional[bytes] = None) -> ResultSet:
         """Parse (with statement-cache, ref QLProcessor prepared stmts) and
-        run one statement."""
+        run one statement.
+
+        page_size/paging_state: result paging for SELECT (ref the CQL
+        paging protocol + pgsql_operation.cc:1040 paging state) — at most
+        page_size rows return, with ResultSet.paging_state set when more
+        may remain; resuming with that opaque token continues the scan at
+        the pinned snapshot read time."""
         with self._lock:
             stmt = self._stmt_cache.get(text)
         if stmt is None:
@@ -266,10 +296,12 @@ class QLProcessor:
                     if len(self._stmt_cache) > 4096:
                         self._stmt_cache.clear()
                     self._stmt_cache[text] = stmt
-        return self._execute_stmt(stmt, list(params))
+        return self._execute_stmt(stmt, list(params), page_size=page_size,
+                                  paging_state=paging_state)
 
-    def _execute_stmt(self, stmt: P.Statement,
-                      params: List[object]) -> ResultSet:
+    def _execute_stmt(self, stmt: P.Statement, params: List[object],
+                      page_size: Optional[int] = None,
+                      paging_state: Optional[bytes] = None) -> ResultSet:
         cursor = [0]
         if isinstance(stmt, P.CreateKeyspace):
             try:
@@ -296,7 +328,8 @@ class QLProcessor:
             ks = stmt.keyspace or self._keyspace
             if ks in ("system", "system_schema"):
                 return self._select_system(ks, stmt, params, cursor)
-            return self._select(stmt, params, cursor)
+            return self._select(stmt, params, cursor, page_size=page_size,
+                                page_state=paging_state)
         if isinstance(stmt, (P.Insert, P.Update, P.Delete)):
             table, op = self._dml_to_op(stmt, params, cursor)
             ks = self._resolve_ks(getattr(stmt, "keyspace", None))
@@ -399,7 +432,8 @@ class QLProcessor:
         return table, QLWriteOp(WriteOpKind.DELETE_ROW, dk)
 
     def _select(self, stmt: P.Select, params: List[object],
-                cursor: List[int]) -> ResultSet:
+                cursor: List[int], page_size: Optional[int] = None,
+                page_state: Optional[bytes] = None) -> ResultSet:
         table = self._table(stmt.keyspace, stmt.table)
         schema = table.schema
 
@@ -426,6 +460,7 @@ class QLProcessor:
         range_names = {c.name for c in schema.range_columns}
         hash_names = {c.name for c in schema.hash_columns}
         eq_cols = {c for c, op, _v in where if op == "="}
+        range_order = [c.name for c in schema.range_columns]
         for i, (c, op, v) in enumerate(where):
             if op == "in" and c in key_names:
                 # only worthwhile when every sub-select still reaches a
@@ -434,17 +469,27 @@ class QLProcessor:
                 # residual filter suffices
                 if not hash_names <= (eq_cols | {c}):
                     continue
-                merged = ResultSet(columns=[], types=[], source=None)
-                limit = stmt.limit
                 # IN is a SET: duplicates must not duplicate rows
                 options = list(dict.fromkeys(v))
                 if c in range_names:
                     # rows come back in clustering order — option order
-                    # must follow it or LIMIT keeps the wrong rows
+                    # must follow it or LIMIT keeps the wrong rows.  That
+                    # only holds when every clustering column BEFORE the
+                    # IN column is equality-bound: otherwise the per-
+                    # option concatenation orders by (c, earlier cols)
+                    # instead of clustering order (real CQL rejects such
+                    # restrictions outright).  Unsortable option types
+                    # fall back to a single residual-filter scan for the
+                    # same reason (ADVICE r3).
+                    if any(rc not in eq_cols
+                           for rc in range_order[:range_order.index(c)]):
+                        continue
                     try:
                         options = sorted(options)
                     except TypeError:
-                        pass
+                        continue
+                merged = ResultSet(columns=[], types=[], source=None)
+                limit = stmt.limit
                 for option in options:
                     # sub-select built from ALREADY-BOUND pieces (markers
                     # were consumed above; re-binding would misalign)
@@ -476,6 +521,9 @@ class QLProcessor:
                 if self._match(d, residual):
                     rs.rows.append([f(d, row) for f in item_fns])
             return rs
+        ps = _decode_page_state(page_state) if page_state else None
+        scan_state: dict = {}
+        pageable = False
         if dk is not None:
             # Full hash key: single-partition prefix scan on the owning
             # tablet (ref ScanChoices hashed-key scan), not a table scan.
@@ -483,12 +531,21 @@ class QLProcessor:
                             range_components=dk.range_components).encode()
             prefix = prefix[:-1]  # open the range group
             lo, hi = self._range_scan_bounds(schema, dk, prefix, residual)
+            if ps:
+                lo = max(lo, ps[0])
             rows = self._client.scan_key_range(
-                table, table.partition_key_for(dk), lo, hi)
+                table, table.partition_key_for(dk), lo, hi,
+                read_ht=HybridTime(ps[2]) if ps else None,
+                scan_state=scan_state)
+            pageable = True
         else:
             # No key prefix: try a readable secondary index on an equality
-            # predicate before falling back to the full scan.
-            picked = IM.choose_index(table, residual)
+            # predicate before falling back to the full scan.  A resume
+            # token forces the scan path: the first page came from a scan
+            # (tokens are only issued on pageable paths), and switching to
+            # an index that became readable between pages would restart
+            # the result set (duplicates) and ignore the pinned snapshot.
+            picked = None if ps else IM.choose_index(table, residual)
             if picked is not None:
                 idx, value, residual = picked
                 ks = self._resolve_ks(stmt.keyspace)
@@ -496,7 +553,14 @@ class QLProcessor:
                 rows = IM.index_lookup(self._client, table, idx_table,
                                        idx, value)
             else:
-                rows = self._client.scan(table)
+                rows = self._client.scan(
+                    table, read_ht=HybridTime(ps[2]) if ps else None,
+                    start_cursor=ps[1] if ps else b"",
+                    start_lower=ps[0] if ps else b"",
+                    scan_state=scan_state)
+                pageable = True
+        # LIMIT budget spans pages: the token carries what is still owed
+        remaining = ps[3] if ps else stmt.limit
         count = 0
         for row in rows:
             d = row.to_dict(schema)
@@ -508,7 +572,14 @@ class QLProcessor:
                 continue
             rs.rows.append([f(d, row) for f in item_fns])
             count += 1
-            if stmt.limit is not None and count >= stmt.limit:
+            if remaining is not None and count >= remaining:
+                break
+            if pageable and page_size is not None and count >= page_size:
+                rs.paging_state = _encode_page_state(
+                    row.doc_key.encode() + b"\xff",
+                    table.partition_key_for(row.doc_key),
+                    scan_state.get("read_ht", 0),
+                    None if remaining is None else remaining - count)
                 break
         return rs
 

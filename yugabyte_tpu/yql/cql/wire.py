@@ -230,9 +230,15 @@ def error_body(code: int, message: str) -> bytes:
     return struct.pack(">i", code) + w_string(message)
 
 
-def rows_metadata(columns: List[Tuple[str, str, str, DataType]]) -> bytes:
-    """columns: (keyspace, table, name, DataType). No paging state."""
-    out = [struct.pack(">i", 0x0000), struct.pack(">i", len(columns))]
+def rows_metadata(columns: List[Tuple[str, str, str, DataType]],
+                  paging_state: Optional[bytes] = None) -> bytes:
+    """columns: (keyspace, table, name, DataType); paging_state sets the
+    HAS_MORE_PAGES flag (0x0002) with the opaque token the client echoes
+    back to fetch the next page (native protocol v4 §4.2.5.2)."""
+    flags = 0x0002 if paging_state is not None else 0x0000
+    out = [struct.pack(">i", flags), struct.pack(">i", len(columns))]
+    if paging_state is not None:
+        out.append(w_bytes(paging_state))
     for ks, tbl, name, dt in columns:
         out.append(w_string(ks))
         out.append(w_string(tbl))

@@ -36,10 +36,17 @@ PG_OIDS = {
 
 class PgResult:
     def __init__(self, tag: str, columns: Optional[List[Tuple[str, int]]] = None,
-                 rows: Optional[List[List[object]]] = None):
+                 rows: Optional[List[List[object]]] = None,
+                 row_iter=None):
         self.tag = tag                       # CommandComplete tag
         self.columns = columns               # [(name, type_oid)] or None
         self.rows = rows or []
+        # Lazy alternative to `rows` for portal execution: an iterator the
+        # server pulls max_rows at a time (Execute row limit + Portal-
+        # Suspended; ref the PG backend's ExecutorRun count semantics).
+        # When set, `rows` is empty and the tag is composed by the server
+        # as "SELECT <total>" on portal completion.
+        self.row_iter = row_iter
 
 
 class PgError(StatusError):
@@ -72,6 +79,9 @@ class PgSession:
         self._tables: Dict[str, Tuple[YBTable, float]] = {}  # TTL'd cache
         self._txn = None
         self.txn_failed = False
+        # bumped at every transaction boundary; suspended portals created
+        # under an older epoch are invalid (see server._execute_portal)
+        self.txn_epoch = 0
         # PG connects to an EXISTING database; only the default one is
         # auto-created (the initdb role). Unknown names fail with 3D000
         # instead of silently materializing a typo'd namespace.
@@ -134,11 +144,17 @@ class PgSession:
                 pass
             self._txn = None
 
-    def execute_bound(self, stmt: P.Statement,
-                      params: List[object]) -> PgResult:
+    def execute_bound(self, stmt: P.Statement, params: List[object],
+                      stream: bool = False) -> PgResult:
         """Extended-query-protocol execution: one pre-parsed statement with
         $n placeholders bound to `params` (ref: the reference's PG backend
-        exec_bind_message/exec_execute_message path)."""
+        exec_bind_message/exec_execute_message path).
+
+        stream=True (portal execution): an eligible SELECT — no
+        aggregation/ordering, which need the full match set — returns a
+        PgResult with row_iter instead of rows, so Execute row limits pull
+        incrementally and a suspended portal holds no materialized
+        result."""
         bound = P.bind_params(stmt, params)
         if self.txn_failed and not (
                 isinstance(bound, P.TxnControl)
@@ -146,6 +162,17 @@ class PgSession:
             raise PgError(Status.IllegalState(
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block"), "25P02")
+        if stream and isinstance(bound, P.Select):
+            try:
+                streamed = self._select_stream(bound)
+            except PgError:
+                self._fail_txn()
+                raise
+            except StatusError as e:
+                self._fail_txn()
+                raise _pg_error(e) from e
+            if streamed is not None:
+                return streamed
         try:
             return self._execute_stmt(bound)
         except PgError:
@@ -507,12 +534,17 @@ class PgSession:
         return None, list(where)
 
     def _select_row_dicts(self, stmt: P.Select, table) -> List[dict]:
-        """Materialize the matching rows as dicts (all columns): the
+        """Materialize the matching rows as dicts (all columns)."""
+        return list(self._iter_row_dicts(stmt, table))
+
+    def _iter_row_dicts(self, stmt: P.Select, table):
+        """Lazily yield the matching rows as dicts (all columns): the
         shared retrieval half of SELECT — point read / index lookup /
-        pushed-down scan — before projection/aggregation/ordering."""
+        pushed-down scan — before projection/aggregation/ordering.  The
+        scan path streams from client.scan's paged generator, so a
+        suspended portal holds no materialized result (bounded memory)."""
         schema = table.schema
         dk, filters = self._split_where(table, stmt.where)
-        out: List[dict] = []
         # ORDER BY / GROUP BY / aggregates need the full match set; only a
         # bare SELECT can stop at LIMIT rows early
         early_limit = (stmt.limit if not stmt.order_by and not stmt.group_by
@@ -526,8 +558,8 @@ class PgSession:
             if row is not None:
                 d = row.to_dict(schema)
                 if row_matches(d, filters):
-                    out.append(d)
-            return out
+                    yield d
+            return
         # Index-accelerated path: a readable secondary index on an
         # equality predicate replaces the full scan. Skipped inside a
         # transaction block: index_lookup's reads would escape the txn
@@ -542,14 +574,15 @@ class PgSession:
                                    idx, value)
         else:
             rows = self._scan(table, filters)
+        n = 0
         for row in rows:
             d = row.to_dict(schema)
             if residual and not row_matches(d, residual):
                 continue
-            out.append(d)
-            if early_limit is not None and len(out) >= early_limit:
-                break
-        return out
+            yield d
+            n += 1
+            if early_limit is not None and n >= early_limit:
+                return
 
     _AGG_OUT_NAMES = {"COUNT": "count", "SUM": "sum", "AVG": "avg",
                       "MIN": "min", "MAX": "max"}
@@ -616,6 +649,30 @@ class PgSession:
                                     0 if d.get(col) is None else d.get(col)),
                      reverse=desc)
         return out
+
+    def _select_stream(self, stmt: P.Select) -> Optional[PgResult]:
+        """Streaming plan for portal execution, or None when the statement
+        needs the full match set (aggregates/ORDER BY/virtual tables) —
+        those fall back to the materialized _select."""
+        if (stmt.count_star or stmt.aggregates or stmt.group_by
+                or stmt.order_by or stmt.scalar_items
+                or self._virtual_table_rows(stmt.table) is not None):
+            return None
+        table = self._table(stmt.table)
+        schema = table.schema
+        known = {c.name for c in schema.columns}
+        for c in list(stmt.columns or []) + [f[0] for f in stmt.where]:
+            if c not in known:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
+        out_cols = stmt.columns or [c.name for c in schema.columns]
+        col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
+
+        def gen():
+            for d in self._iter_row_dicts(stmt, table):
+                yield [d.get(c) for c in out_cols]
+
+        return PgResult("SELECT 0", col_desc, row_iter=gen())
 
     def _select(self, stmt: P.Select) -> PgResult:
         vt = self._virtual_table_rows(stmt.table)
@@ -798,6 +855,10 @@ class PgSession:
 
     # ------------------------------------------------------- transactions
     def _txn_control(self, stmt: P.TxnControl) -> PgResult:
+        # any transaction boundary invalidates open portals (PG destroys
+        # non-holdable portals at txn end; a suspended portal's iterator
+        # is pinned to the old txn's snapshot/overlay)
+        self.txn_epoch += 1
         if stmt.kind == "begin":
             if self._txn is None:
                 self._txn = self._txn_manager.begin()

@@ -20,11 +20,13 @@ import threading
 from typing import List, Optional, Tuple
 
 from yugabyte_tpu.client.client import YBClient
-from yugabyte_tpu.client.transaction import TransactionManager
+from yugabyte_tpu.client.transaction import (TransactionError,
+                                             TransactionManager)
 from yugabyte_tpu.common.schema import DataType
 from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
-from yugabyte_tpu.yql.pgsql.executor import PgError, PgResult, PgSession
+from yugabyte_tpu.yql.pgsql.executor import (PgError, PgResult, PgSession,
+                                             _pg_error)
 
 PROTOCOL_V3 = 196608          # 3.0
 SSL_REQUEST_CODE = 80877103
@@ -195,16 +197,19 @@ class _Conn:
                   + b"\x00")
         self._send(b"E", fields)
 
+    def _send_one_row(self, row) -> None:
+        body = struct.pack(">H", len(row))
+        for v in row:
+            enc = _encode_text(v)
+            if enc is None:
+                body += struct.pack(">i", -1)
+            else:
+                body += struct.pack(">I", len(enc)) + enc
+        self._send(b"D", body)
+
     def _send_data_rows(self, r: PgResult) -> None:
         for row in r.rows:
-            body = struct.pack(">H", len(row))
-            for v in row:
-                enc = _encode_text(v)
-                if enc is None:
-                    body += struct.pack(">i", -1)
-                else:
-                    body += struct.pack(">I", len(enc)) + enc
-            self._send(b"D", body)
+            self._send_one_row(row)
 
     def _send_result(self, r: PgResult) -> None:
         if r.columns is not None:
@@ -322,7 +327,8 @@ class _Conn:
                 dt = types[i] if i < len(types) else None
                 params.append(_decode_param(raw, fmt, dt))
             # result format codes are read but text is always sent
-            self._portals[portal] = (stmt, params)
+            self._portals[portal] = {"stmt": stmt, "params": params,
+                                     "iter": None, "count": 0}
             self._send(b"2")  # BindComplete
         elif t == b"D":   # Describe
             kind = payload[:1]
@@ -333,28 +339,84 @@ class _Conn:
                     struct.pack(">I", _type_oid(dt)) for dt in types))
                 self._describe_stmt(stmt)
             else:
-                stmt, _params = self._portals.get(name, (None, None))
-                self._describe_stmt(stmt)
+                state = self._portals.get(name) or {"stmt": None}
+                self._describe_stmt(state["stmt"])
         elif t == b"E":   # Execute
             portal, off = _read_cstr(payload, 0)
             if portal not in self._portals:
                 raise PgError(Status.InvalidArgument(
                     f'portal "{portal}" does not exist'), "34000")
-            stmt, params = self._portals[portal]
-            if stmt is None:
-                self._send(b"I")
-                return
-            result = self.session.execute_bound(stmt, params)
-            # rows WITHOUT RowDescription (Describe supplied it)
-            if result.columns is not None:
-                self._send_data_rows(result)
-            self._send(b"C", _cstr(result.tag))
+            state = self._portals[portal]
+            (max_rows,) = struct.unpack_from(">i", payload, off)
+            self._execute_portal(portal, state, max_rows)
         elif t == b"C":   # Close
             kind = payload[:1]
             name, _ = _read_cstr(payload, 1)
             (self._prepared if kind == b"S" else self._portals).pop(
                 name, None)
             self._send(b"3")  # CloseComplete
+
+    def _execute_portal(self, name: str, state: dict, max_rows: int) -> None:
+        """Execute with a row limit: send up to max_rows DataRows, then
+        PortalSuspended if the portal has more (the client re-Executes to
+        continue) or CommandComplete when drained (PG protocol §55.2.3;
+        a suspended portal holds only a lazy iterator — bounded memory)."""
+        stmt = state["stmt"]
+        if stmt is None:
+            self._send(b"I")
+            return
+        it = state["iter"]
+        if it is not None and state.get("epoch") != self.session.txn_epoch:
+            # the portal's iterator is pinned to a finished transaction's
+            # snapshot/overlay — PG destroys such portals at txn end
+            self._portals.pop(name, None)
+            raise PgError(Status.InvalidArgument(
+                f'portal "{name}" does not exist'), "34000")
+        if it is None:
+            result = self.session.execute_bound(stmt, state["params"],
+                                                stream=True)
+            if result.columns is None:
+                # row-less statement (DML/DDL): no portal iteration
+                self._send(b"C", _cstr(result.tag))
+                return
+            it = result.row_iter if result.row_iter is not None \
+                else iter(result.rows)
+            state["iter"] = it
+            state["count"] = 0
+            state["select"] = result.tag.startswith("SELECT")
+            state["tag"] = result.tag
+            state["epoch"] = self.session.txn_epoch
+        sent = 0
+        done = False
+        try:
+            while max_rows <= 0 or sent < max_rows:
+                try:
+                    row = next(it)
+                except StopIteration:
+                    done = True
+                    break
+                self._send_one_row(row)
+                sent += 1
+        except PgError:
+            state["iter"] = None
+            self.session._fail_txn()
+            raise
+        except TransactionError as e:
+            state["iter"] = None
+            self.session._fail_txn()
+            raise PgError(e.status, "40001") from e
+        except StatusError as e:
+            state["iter"] = None
+            self.session._fail_txn()
+            raise _pg_error(e) from e
+        state["count"] += sent
+        if done:
+            state["iter"] = None
+            tag = (f"SELECT {state['count']}" if state.get("select")
+                   else state.get("tag", "SELECT 0"))
+            self._send(b"C", _cstr(tag))
+        else:
+            self._send(b"s")  # PortalSuspended
 
     def _describe_stmt(self, stmt) -> None:
         cols = (self.session.describe_columns(stmt)
