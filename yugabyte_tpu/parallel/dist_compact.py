@@ -126,13 +126,24 @@ def dist_compact_fn(mesh: Mesh, capacity: int, is_major: bool,
         # the exchange — they can never clobber a real slot
         slot = jnp.where(valid, dest[order] * capacity + pos_in_group,
                          n_shards * capacity)
-        pad_col = jnp.asarray(pad_template(r))
+        # the global input index rides the exchange as one extra u32 row so
+        # the host can map every surviving (shuffled, merged) row back to
+        # its source slab row — output VALUES are gathered host-side from
+        # exactly these indices (values never cross the mesh)
+        idx_local = (jax.lax.axis_index(axis).astype(jnp.uint32)
+                     * jnp.uint32(n_local)
+                     + jnp.arange(n_local, dtype=jnp.uint32))
+        ship = jnp.concatenate([cols_local, idx_local[None, :]], axis=0)
+        pad_col = jnp.concatenate(
+            [jnp.asarray(pad_template(r)), jnp.full(1, 0xFFFFFFFF,
+                                                    jnp.uint32)])
         send = jnp.tile(pad_col[:, None], (1, n_shards * capacity + 1))
-        send = send.at[:, slot].set(cols_local[:, order])
-        send3 = send[:, :-1].reshape(r, n_shards, capacity)
+        send = send.at[:, slot].set(ship[:, order])
+        send3 = send[:, :-1].reshape(r + 1, n_shards, capacity)
         recv = jax.lax.all_to_all(send3, axis, split_axis=1, concat_axis=1,
                                   tiled=False)
-        cols_shard = recv.reshape(r, n_shards * capacity)
+        recv = recv.reshape(r + 1, n_shards * capacity)
+        cols_shard, idx_shard = recv[:r], recv[r]
         # -- 4: local fused merge + GC -------------------------------------
         perm, keep, mk = sort_and_gc(cols_shard, cutoff_hi, cutoff_lo, cph, cpl,
                                      w=r - _ROW_WORDS, is_major=is_major,
@@ -141,13 +152,13 @@ def dist_compact_fn(mesh: Mesh, capacity: int, is_major: bool,
         # padding rows are identified explicitly by the key_len sentinel
         is_pad = out[_ROW_KEY_LEN] == jnp.uint32(PAD_SENTINEL)
         keep = keep & ~is_pad
-        return out, keep, mk, overflow[None]
+        return out, keep, mk, overflow[None], idx_shard[perm]
 
     spec = P(None, axis)
     fn = shard_map(
         per_shard, mesh=mesh,
         in_specs=(spec, P(), P(), P(), P()),
-        out_specs=(spec, P(axis), P(axis), P(axis)))
+        out_specs=(spec, P(axis), P(axis), P(axis), P(axis)))
     return jax.jit(fn)
 
 
@@ -155,9 +166,11 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
                         capacity_factor: float = 2.0):
     """Host wrapper: pack a slab, shard it over the mesh, run the step.
 
-    Returns (cols_out, keep, make_tombstone) as host arrays; cols_out rows
-    follow ops/merge_gc layout, in globally range-partitioned sorted order
-    (shard s holds keys <= shard s+1's)."""
+    Returns (cols_out, keep, make_tombstone, src_idx) as host arrays;
+    cols_out rows follow ops/merge_gc layout, in globally range-partitioned
+    sorted order (shard s holds keys <= shard s+1's); src_idx[i] is the
+    input slab row that produced merged position i (valid where keep/mk
+    apply — padding positions carry sentinel indices and keep=False)."""
     n_shards = mesh.devices.size
     cols = pack_cols(slab)[0]
     # pad the column count to a multiple of shards (pack_cols gives powers
@@ -174,11 +187,12 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
     cutoff_phys = cutoff >> 12
     fn = dist_compact_fn(mesh, capacity, params.is_major_compaction,
                          params.retain_deletes, axis)
-    out, keep, mk, overflow = fn(
+    out, keep, mk, overflow, src_idx = fn(
         cols, jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF))
     if bool(np.any(np.asarray(overflow))):
         if capacity_factor >= 64:
             raise RuntimeError("distributed compaction bucket overflow at 64x")
         return distributed_compact(slab, params, mesh, axis, capacity_factor * 2)
-    return np.asarray(out), np.asarray(keep), np.asarray(mk)
+    return (np.asarray(out), np.asarray(keep), np.asarray(mk),
+            np.asarray(src_idx).astype(np.int64))

@@ -46,6 +46,11 @@ flags.define_flag("compaction_max_output_entries_per_sst", 2_000_000,
 flags.define_flag("compaction_rate_bytes_per_sec", 0,
                   "token-bucket cap on compaction output bytes/sec; "
                   "0 = unlimited (ref rocksdb/util/rate_limiter.cc)")
+flags.define_flag("distributed_compaction_min_rows", 1 << 20,
+                  "jobs at or above this many input rows fan their "
+                  "subcompactions across the device mesh when one is "
+                  "available (ref: subcompaction sizing, "
+                  "compaction_job.cc:330 GenSubcompactionBoundaries)")
 
 _rate_limiter = None
 _rate_limiter_rate = 0
@@ -157,6 +162,7 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                        retain_deletes: bool = False, device=None,
                        block_entries: Optional[int] = None, device_cache=None,
                        input_ids: Optional[Sequence[int]] = None,
+                       mesh=None,
                        _no_combined: bool = False) -> CompactionResult:
     """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
 
@@ -164,6 +170,10 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     device_cache + input_ids: when set, input key columns come from (or are
     written through to) the HBM-resident slab cache — host->device upload is
     skipped for cache hits; values always stream from disk on the host side.
+    mesh: a jax.sharding.Mesh over >1 device — jobs at or above
+    distributed_compaction_min_rows fan their subcompactions across it
+    (parallel/dist_compact.py), the mesh analog of the reference's
+    subcompaction threads (compaction_job.cc:456-468).
     """
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None
@@ -181,8 +191,13 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         from yugabyte_tpu.utils.env import get_env
         force_radix = os.environ.get("YBTPU_FORCE_RADIX", "").lower() \
             not in ("", "0", "false")
+        wants_dist = (
+            mesh is not None
+            and getattr(mesh, "devices", np.empty(0)).size > 1
+            and sum(r.props.n_entries for r in all_inputs)
+            >= flags.get_flag("distributed_compaction_min_rows"))
         if (native_engine.available() and not get_env().encrypted
-                and not force_radix
+                and not force_radix and not wants_dist
                 and not any(r.props.has_deep for r in all_inputs)):
             return run_compaction_job_device_native(
                 all_inputs, out_dir, new_file_id, history_cutoff_ht,
@@ -226,7 +241,22 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         # which carries the full per-component overwrite STACK (ref:
         # docdb_compaction_filter.cc:104-123).
         device = "native"
-    if device == "native":
+    surv = tomb_flags = None
+    if (mesh is not None and device != "native"
+            and getattr(mesh, "devices", np.empty(0)).size > 1
+            and merged.n >= flags.get_flag(
+                "distributed_compaction_min_rows")):
+        # Large job + multi-device mesh: fan the subcompactions across the
+        # devices (parallel/dist_compact.py) — the mesh analog of the
+        # reference's per-thread subcompactions. Decisions are identical
+        # to the single-device kernel (differential-tested); outputs come
+        # back globally range-partitioned, so survivor order matches.
+        from yugabyte_tpu.parallel.dist_compact import distributed_compact
+        _cols, keep_d, mk_d, src_idx = distributed_compact(
+            merged, params, mesh)
+        surv = src_idx[keep_d]
+        tomb_flags = mk_d[keep_d]
+    elif device == "native":
         # No JAX device available (e.g. TPU init failed at server start):
         # the native C++ baseline implements identical merge+GC semantics
         # (differential-tested vs the kernel) on the host.
@@ -268,8 +298,9 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
             # the run layout would inflate
             perm, keep, make_tomb = run_merge.merge_and_gc_runs(
                 slabs, params, device=device)
-    surv = perm[keep]                      # input indices, merged order
-    tomb_flags = make_tomb[keep]
+    if surv is None:
+        surv = perm[keep]                  # input indices, merged order
+        tomb_flags = make_tomb[keep]
     rows_out = int(surv.shape[0])
 
     # Frontier for outputs: union of input frontiers + this cutoff

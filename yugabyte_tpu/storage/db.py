@@ -40,6 +40,10 @@ class DBOptions:
     block_cache: Optional[BlockCache] = None
     compaction_pool: Optional[PriorityThreadPool] = None
     device: object = None  # JAX device for compaction kernels
+    # jax.sharding.Mesh over >1 device: large compactions fan their
+    # subcompactions across it (parallel/dist_compact.py); None = single
+    # device (ref: subcompaction threads, compaction_job.cc:456-468)
+    mesh: object = None
     # HBM-resident slab cache (storage/device_cache.py); shared across
     # tablets like the reference's server-wide block cache
     device_cache: object = None
@@ -287,7 +291,8 @@ class DB:
                 pick.is_major, device=self.opts.device,
                 block_entries=self.opts.block_entries,
                 device_cache=self._device_cache,
-                input_ids=[fm.file_id for fm in pick.inputs])
+                input_ids=[fm.file_id for fm in pick.inputs],
+                mesh=self.opts.mesh)
             from yugabyte_tpu.utils import sync_point
             sync_point.hit("db.compaction:before_install")
             with self._lock:

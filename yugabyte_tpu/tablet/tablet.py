@@ -118,6 +118,7 @@ class LocalConsensusContext:
 class TabletOptions:
     block_entries: Optional[int] = None  # None = sst_block_entries flag
     device: object = None
+    mesh: object = None      # >1-device mesh for distributed compaction
     device_cache: object = None
     compaction_pool: object = None
     # shared decoded-block cache (ref: db/table_cache.cc — one per server)
@@ -146,6 +147,7 @@ class Tablet:
         db_opts = DBOptions(
             block_entries=self.opts.block_entries,
             device=self.opts.device,
+            mesh=self.opts.mesh,
             device_cache=self.opts.device_cache,
             compaction_pool=self.opts.compaction_pool,
             block_cache=self.opts.block_cache,

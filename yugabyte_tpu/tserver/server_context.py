@@ -48,32 +48,45 @@ flags.define_flag("block_cache_bytes", 256 << 20,
 
 
 def resolve_device(mode: str, timeout_s: float):
-    """Resolve the shared JAX device, or the 'native' sentinel.
+    """Resolve (shared JAX device, mesh-or-None), or ('native', None).
 
     jax.devices() may hang indefinitely when a TPU tunnel is down, so the
     touch runs on a daemon thread under a deadline (same failure mode
-    bench.py guards against with a subprocess watchdog)."""
+    bench.py guards against with a subprocess watchdog).  With more than
+    one visible device, a 1-D Mesh over all of them is returned too:
+    large compactions fan subcompactions across it
+    (parallel/dist_compact.py)."""
     if mode == "none":
-        return "native"
+        return "native", None
     result = {}
 
     def probe():
         try:
             import jax
-            result["device"] = jax.devices()[0]
+            result["devices"] = jax.devices()
         except Exception as e:  # backend init failure
             result["error"] = e
 
     t = threading.Thread(target=probe, daemon=True, name="device-init")
     t.start()
     t.join(timeout_s)
-    if "device" in result:
-        TRACE("server device: %s", result["device"])
-        return result["device"]
+    devices = result.get("devices")
+    if devices:
+        mesh = None
+        mesh_n = 1
+        if len(devices) > 1:
+            import numpy as _np
+            from jax.sharding import Mesh
+            # power-of-two shard count: run-padding and the all_to_all
+            # capacity math assume it (and TPU slices come that way)
+            mesh_n = 1 << (len(devices).bit_length() - 1)
+            mesh = Mesh(_np.asarray(devices[:mesh_n]), ("shard",))
+        TRACE("server device: %s (mesh devices: %d)", devices[0], mesh_n)
+        return devices[0], mesh
     TRACE("JAX device unavailable (%s) — compactions use the native C++ "
           "merge+GC baseline",
           result.get("error", f"init exceeded {timeout_s}s"))
-    return "native"
+    return "native", None
 
 
 class ServerExecutionContext:
@@ -86,9 +99,12 @@ class ServerExecutionContext:
         self.pool = PriorityThreadPool(
             max_threads=flags.get_flag("tserver_compaction_pool_size"),
             name="compact")
-        self.device = device if device is not None else resolve_device(
-            flags.get_flag("tserver_device"),
-            flags.get_flag("device_init_timeout_s"))
+        if device is not None:
+            self.device, self.mesh = device, None
+        else:
+            self.device, self.mesh = resolve_device(
+                flags.get_flag("tserver_device"),
+                flags.get_flag("device_init_timeout_s"))
         self.device_cache = None
         if self.device != "native":
             self.device_cache = DeviceSlabCache(
@@ -110,6 +126,7 @@ class ServerExecutionContext:
 
     def tablet_options(self) -> TabletOptions:
         return TabletOptions(device=self.device,
+                             mesh=self.mesh,
                              device_cache=self.device_cache,
                              compaction_pool=self.pool,
                              block_cache=self.block_cache)
