@@ -1,0 +1,222 @@
+"""pg_regress-style YSQL suite over real v3 wire frames (VERDICT r3 #5):
+a fixed script of queries with golden results — 2-table and 3-table joins
+(hash and index nested-loop), LEFT JOIN semantics, ALTER TABLE ADD/DROP
+COLUMN riding the versioned online schema change, cursors, aggregates.
+
+ref: src/postgres/src/test/regress (the harness shape), executor join
+paths at src/postgres/src/backend/executor/, pggate scan fan-out at
+src/yb/yql/pggate/pg_doc_op.h:399.
+"""
+
+import pytest
+
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.yql.pgsql.server import PgServer
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(__file__))
+from pg_wire_client import PgWireClient, PgWireError  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    flags.set_flag("replication_factor", 1)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=1,
+        fs_root=str(tmp_path_factory.mktemp("pgregress")))).start()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def conn(cluster):
+    srv = PgServer(cluster.new_client())
+    c = PgWireClient("127.0.0.1", srv.port)
+    # northwind-ish fixture: customers / orders / products
+    c.query("CREATE TABLE customers (cid INT PRIMARY KEY, name TEXT, "
+            "city TEXT)")
+    c.query("CREATE TABLE orders (oid INT PRIMARY KEY, cid INT, "
+            "pid INT, qty INT)")
+    c.query("CREATE TABLE products (pid INT PRIMARY KEY, pname TEXT, "
+            "price INT)")
+    c.query("INSERT INTO customers (cid, name, city) VALUES "
+            "(1, 'ada', 'london'), (2, 'bob', 'paris'), "
+            "(3, 'cyd', 'london'), (4, 'dee', 'oslo')")
+    c.query("INSERT INTO products (pid, pname, price) VALUES "
+            "(10, 'anvil', 100), (11, 'rope', 15), (12, 'glue', 5)")
+    c.query("INSERT INTO orders (oid, cid, pid, qty) VALUES "
+            "(100, 1, 10, 2), (101, 1, 11, 1), (102, 2, 11, 3), "
+            "(103, 3, 12, 7), (104, 9, 10, 1)")
+    yield c
+    c.close()
+    srv.shutdown()
+
+
+def rows(conn, sql):
+    return [tuple(r) for r in conn.query(sql)[0].rows]
+
+
+# --- (sql, expected sorted rows as text tuples) -----------------------------
+REGRESS = [
+    # inner join via PK (index nested-loop: products.pid is the PK)
+    ("SELECT o.oid, p.pname FROM orders o JOIN products p ON o.pid = p.pid "
+     "WHERE o.cid = 1 ORDER BY o.oid",
+     [("100", "anvil"), ("101", "rope")]),
+    # hash join on non-PK column
+    ("SELECT c.name, o.oid FROM customers c JOIN orders o ON c.cid = o.cid "
+     "ORDER BY o.oid",
+     [("ada", "100"), ("ada", "101"), ("bob", "102"), ("cyd", "103")]),
+    # LEFT JOIN keeps unmatched left rows with NULLs
+    ("SELECT c.name, o.oid FROM customers c LEFT JOIN orders o "
+     "ON c.cid = o.cid WHERE c.city = 'oslo'",
+     [("dee", None)]),
+    # WHERE on a LEFT-joined table filters AFTER the join (PG semantics:
+    # the NULL-extended row is dropped by the filter)
+    ("SELECT c.name FROM customers c LEFT JOIN orders o ON c.cid = o.cid "
+     "WHERE o.qty > 2 ORDER BY c.name",
+     [("bob",), ("cyd",)]),
+    # 3-table join
+    ("SELECT c.name, p.pname, o.qty FROM orders o "
+     "JOIN customers c ON o.cid = c.cid "
+     "JOIN products p ON o.pid = p.pid "
+     "WHERE p.price < 50 ORDER BY o.oid",
+     [("ada", "rope", "1"), ("bob", "rope", "3"), ("cyd", "glue", "7")]),
+    # COUNT(*) over a join
+    ("SELECT COUNT(*) FROM orders o JOIN customers c ON o.cid = c.cid",
+     [("4",)]),
+    # join + LIMIT
+    ("SELECT o.oid FROM orders o JOIN customers c ON o.cid = c.cid "
+     "ORDER BY o.oid DESC LIMIT 2",
+     [("103",), ("102",)]),
+    # unqualified column resolution across joined tables
+    ("SELECT name FROM customers c JOIN orders o ON c.cid = o.cid "
+     "WHERE qty = 7", [("cyd",)]),
+    # base-table alias qualification without a join
+    ("SELECT t.name FROM customers t WHERE t.city = 'paris'", [("bob",)]),
+    # plain single-table checks keep working alongside
+    ("SELECT name FROM customers WHERE city = 'london' ORDER BY name",
+     [("ada",), ("cyd",)]),
+    ("SELECT city, COUNT(*) FROM customers GROUP BY city ORDER BY city",
+     [("london", "2"), ("oslo", "1"), ("paris", "1")]),
+]
+
+
+@pytest.mark.parametrize("sql,expected",
+                         REGRESS, ids=range(len(REGRESS)))
+def test_regress(conn, sql, expected):
+    assert rows(conn, sql) == expected
+
+
+class TestAlterTable:
+    def test_add_column_online(self, conn, cluster):
+        conn.query("CREATE TABLE alt (k INT PRIMARY KEY, v TEXT)")
+        conn.query("INSERT INTO alt (k, v) VALUES (1, 'old')")
+        conn.query("ALTER TABLE alt ADD COLUMN extra INT")
+        conn.query("INSERT INTO alt (k, v, extra) VALUES (2, 'new', 42)")
+        got = rows(conn, "SELECT k, v, extra FROM alt ORDER BY k")
+        assert got == [("1", "old", None), ("2", "new", "42")]
+
+    def test_drop_column_keeps_later_ids(self, conn):
+        conn.query("CREATE TABLE alt2 (k INT PRIMARY KEY, a TEXT, "
+                   "b TEXT, c TEXT)")
+        conn.query("INSERT INTO alt2 (k, a, b, c) VALUES "
+                   "(1, 'a1', 'b1', 'c1')")
+        conn.query("ALTER TABLE alt2 DROP COLUMN b")
+        # column c must still read ITS data, not b's (stable slot ids)
+        assert rows(conn, "SELECT a, c FROM alt2") == [("a1", "c1")]
+        with pytest.raises(PgWireError):
+            conn.query("SELECT b FROM alt2")
+        # the dropped name is reusable and starts empty
+        conn.query("ALTER TABLE alt2 ADD COLUMN b INT")
+        assert rows(conn, "SELECT b, c FROM alt2") == [(None, "c1")]
+
+    def test_alter_errors(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("ALTER TABLE alt2 DROP COLUMN k")     # key column
+        with pytest.raises(PgWireError):
+            conn.query("ALTER TABLE alt2 ADD COLUMN a TEXT")  # duplicate
+        with pytest.raises(PgWireError):
+            conn.query("ALTER TABLE nosuch ADD COLUMN x INT")
+
+    def test_schema_version_reaches_tservers(self, conn, cluster):
+        import time
+        conn.query("CREATE TABLE alt3 (k INT PRIMARY KEY, v TEXT)")
+        conn.query("ALTER TABLE alt3 ADD COLUMN w INT")
+        cat = cluster.leader_master().catalog
+        table = cat.get_table("postgres", "alt3")
+        want = table["schema_version"]
+        assert want == 1
+        deadline = time.time() + 10
+        done = False
+        while time.time() < deadline and not done:
+            done = all(
+                ts.tablet_manager.tablet_meta(tid).get("schema_version", 0)
+                == want
+                for ts in cluster.tservers
+                for tid in table["tablet_ids"]
+                if tid in ts.tablet_manager.tablet_ids())
+            time.sleep(0.1)
+        assert done, "schema version never reached the tservers"
+
+
+class TestCursors:
+    def test_declare_fetch_close(self, conn):
+        conn.query("BEGIN")
+        conn.query("DECLARE cur CURSOR FOR SELECT cid, name "
+                   "FROM customers ORDER BY cid")
+        got = rows(conn, "FETCH 2 FROM cur")
+        assert got == [("1", "ada"), ("2", "bob")]
+        got = rows(conn, "FETCH 1 FROM cur")
+        assert got == [("3", "cyd")]
+        got = rows(conn, "FETCH ALL FROM cur")
+        assert got == [("4", "dee")]
+        assert rows(conn, "FETCH 5 FROM cur") == []   # drained
+        conn.query("CLOSE cur")
+        with pytest.raises(PgWireError):
+            conn.query("FETCH 1 FROM cur")
+        conn.query("COMMIT")
+
+    def test_cursor_streams_without_order(self, conn):
+        conn.query("DECLARE c2 CURSOR FOR SELECT oid FROM orders")
+        first = rows(conn, "FETCH 3 FROM c2")
+        rest = rows(conn, "FETCH ALL FROM c2")
+        assert len(first) + len(rest) == 5
+        conn.query("CLOSE c2")
+
+    def test_cursor_dies_at_txn_end(self, conn):
+        conn.query("BEGIN")
+        conn.query("DECLARE c3 CURSOR FOR SELECT cid FROM customers")
+        rows(conn, "FETCH 1 FROM c3")
+        conn.query("COMMIT")
+        with pytest.raises(PgWireError):
+            conn.query("FETCH 1 FROM c3")
+
+    def test_cursor_over_join(self, conn):
+        conn.query("DECLARE cj CURSOR FOR SELECT c.name, o.oid "
+                   "FROM customers c JOIN orders o ON c.cid = o.cid "
+                   "ORDER BY o.oid")
+        assert rows(conn, "FETCH 2 FROM cj") == [("ada", "100"),
+                                                 ("ada", "101")]
+        conn.query("CLOSE cj")
+
+    def test_with_hold_cursor_survives_commit(self, conn):
+        conn.query("BEGIN")
+        conn.query("DECLARE ch CURSOR WITH HOLD FOR SELECT cid "
+                   "FROM customers ORDER BY cid")
+        assert rows(conn, "FETCH 1 FROM ch") == [("1",)]
+        conn.query("COMMIT")
+        assert rows(conn, "FETCH 1 FROM ch") == [("2",)]   # survives
+        conn.query("CLOSE ch")
+
+
+class TestDroppedColumnStar:
+    def test_select_star_skips_dropped(self, conn):
+        conn.query("CREATE TABLE star (k INT PRIMARY KEY, a TEXT, b TEXT)")
+        conn.query("INSERT INTO star (k, a, b) VALUES (1, 'x', 'y')")
+        conn.query("ALTER TABLE star DROP COLUMN a")
+        r = conn.query("SELECT * FROM star")[0]
+        assert [c[0] for c in r.columns] == ["k", "b"]
+        assert r.rows == [["1", "y"]]

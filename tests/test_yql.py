@@ -387,3 +387,24 @@ class TestInListClusteringGuard:
         rs = tql2.execute("SELECT r2 FROM t2 WHERE h = 'a' AND r1 = 1 "
                           "AND r2 IN (2, 0) LIMIT 1")
         assert [r[0] for r in rs.rows] == [0]
+
+
+def test_cql_alter_table(cluster):
+    from yugabyte_tpu.yql.cql.executor import QLProcessor
+    ql = QLProcessor(cluster.new_client())
+    ql.execute("CREATE KEYSPACE altks")
+    ql.execute("USE altks")
+    ql.execute("CREATE TABLE at (k text, v text, PRIMARY KEY ((k)))")
+    ql.execute("INSERT INTO at (k, v) VALUES ('a', '1')")
+    ql.execute("ALTER TABLE at ADD extra int")
+    ql.execute("INSERT INTO at (k, v, extra) VALUES ('b', '2', 42)")
+    rs = ql.execute("SELECT k, v, extra FROM at")
+    got = {tuple(r) for r in rs.rows}
+    assert got == {("a", "1", None), ("b", "2", 42)}
+    ql.execute("ALTER TABLE at DROP v")
+    rs = ql.execute("SELECT k, extra FROM at")
+    assert {tuple(r) for r in rs.rows} == {("a", None), ("b", 42)}
+    # the dropped column's data is unreachable (CQL's permissive select
+    # surfaces absent columns as nulls rather than erroring)
+    rs = ql.execute("SELECT v FROM at")
+    assert all(r == [None] for r in rs.rows)

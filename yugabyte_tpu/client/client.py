@@ -42,6 +42,10 @@ class YBTable:
         self.table_id = meta["table_id"]
         self.name = meta["name"]
         self.namespace = meta["namespace"]
+        # bumped by ALTER TABLE; writes/reads carry it so a tserver whose
+        # tablet still runs the older schema rejects retryably instead of
+        # misencoding the new columns (ref tablet schema version checks)
+        self.schema_version = meta.get("schema_version", 0)
         self.schema: Schema = schema_from_wire(meta["schema"])
         self.partition_schema: PartitionSchema = partition_schema_from_wire(
             meta["partition_schema"])
@@ -154,6 +158,17 @@ class YBClient:
 
     def delete_table(self, namespace: str, name: str) -> None:
         self._master_call("delete_table", namespace=namespace, name=name)
+
+    def alter_table(self, namespace: str, name: str,
+                    add_columns: Sequence[Tuple[str, str]] = (),
+                    drop_columns: Sequence[str] = ()) -> YBTable:
+        """Online ALTER TABLE ADD/DROP COLUMN (ref client.h AlterTable):
+        returns the table handle at the NEW schema version."""
+        meta = self._master_call(
+            "alter_table", namespace=namespace, name=name,
+            add_columns=[list(c) for c in add_columns],
+            drop_columns=list(drop_columns))
+        return YBTable(meta)
 
     def create_index(self, namespace: str, table: str, index_name: str,
                      column: str, num_tablets: int = 2,
@@ -282,7 +297,8 @@ class YBClient:
             resp = self._tablet_call(
                 table, tablet, "write", refresh_key=pk,
                 ops=[write_op_to_wire(op) for op in ops],
-                client_id=self.client_id, request_id=request_id)
+                client_id=self.client_id, request_id=request_id,
+                schema_version=table.schema_version)
             return HybridTime(resp["propagated_ht"])
         except RemoteError as e:
             if not (e.extra.get("tablet_split")
@@ -312,7 +328,8 @@ class YBClient:
             table, tablet, "read_row", refresh_key=pk,
             doc_key=doc_key_to_wire(doc_key),
             read_ht=read_ht.value if read_ht else None,
-            projection=list(projection) if projection else None)
+            projection=list(projection) if projection else None,
+            schema_version=table.schema_version)
         return row_from_wire(w)
 
     def scan(self, table: YBTable, read_ht: Optional[HybridTime] = None,

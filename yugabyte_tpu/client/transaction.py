@@ -151,7 +151,8 @@ class YBTransaction:
             self._client._tablet_call(
                 table, tablet, "write", refresh_key=pk,
                 ops=[write_op_to_wire(op) for op in ops],
-                txn=self._meta().to_wire())
+                txn=self._meta().to_wire(),
+                schema_version=table.schema_version)
         except RemoteError as e:
             if e.extra.get("txn_conflict"):
                 raise TransactionError(e.status.message) from e
@@ -168,7 +169,7 @@ class YBTransaction:
             table, tablet, "read_row", refresh_key=pk,
             doc_key=doc_key_to_wire(doc_key), read_ht=self.read_ht,
             projection=list(projection) if projection else None,
-            txn_id=self.txn_id)
+            txn_id=self.txn_id, schema_version=table.schema_version)
         return row_from_wire(w)
 
     # ------------------------------------------------------------ resolution

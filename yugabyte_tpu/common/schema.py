@@ -34,6 +34,10 @@ class ColumnSchema:
     type: DataType
     nullable: bool = True
     sorting: SortingType = SortingType.ASC
+    # ALTER TABLE DROP COLUMN keeps the slot (PG's attisdropped): value
+    # columns are addressed by POSITION-derived ids, so removing the slot
+    # would shift every later column onto its neighbor's stored data
+    dropped: bool = False
 
 
 @dataclass
@@ -53,10 +57,13 @@ class Schema:
         names = [c.name for c in self.columns]
         if len(set(names)) != len(names):
             raise ValueError("duplicate column names")
-        # Column ids: stable small ints, value columns only (keys are positional).
+        # Column ids: stable small ints, value columns only (keys are
+        # positional). Dropped slots keep their position (so ids of later
+        # columns never shift) but are not addressable by name.
         nk = self.num_key_columns
         self._column_ids: Dict[str, int] = {
-            c.name: i - nk for i, c in enumerate(self.columns) if i >= nk
+            c.name: i - nk for i, c in enumerate(self.columns)
+            if i >= nk and not c.dropped
         }
 
     @property
@@ -73,7 +80,8 @@ class Schema:
 
     @property
     def value_columns(self) -> List[ColumnSchema]:
-        return self.columns[self.num_key_columns:]
+        return [c for c in self.columns[self.num_key_columns:]
+                if not c.dropped]
 
     def column_id(self, name: str) -> int:
         return self._column_ids[name]
@@ -83,6 +91,38 @@ class Schema:
 
     def column(self, name: str) -> ColumnSchema:
         for c in self.columns:
-            if c.name == name:
+            if c.name == name and not c.dropped:
                 return c
+        raise KeyError(name)
+
+    # ------------------------------------------------- schema evolution
+    def with_added_column(self, name: str, type: DataType,
+                          nullable: bool = True) -> "Schema":
+        """ALTER TABLE ADD COLUMN: appended at the end — existing
+        position-derived column ids are untouched, so no data rewrite
+        (ref: the reference's online schema change, catalog_manager
+        AlterTable + per-tablet schema version)."""
+        if any(c.name == name and not c.dropped for c in self.columns):
+            raise ValueError(f'column "{name}" already exists')
+        return Schema(columns=self.columns + [ColumnSchema(name, type,
+                                                           nullable)],
+                      num_hash_key_columns=self.num_hash_key_columns,
+                      num_range_key_columns=self.num_range_key_columns)
+
+    def with_dropped_column(self, name: str) -> "Schema":
+        """ALTER TABLE DROP COLUMN: the slot stays, tombstoned under a
+        mangled unique name (PG attisdropped), so later columns keep their
+        ids and a future ADD COLUMN may reuse the visible name."""
+        from dataclasses import replace as _replace
+        nk = self.num_key_columns
+        out = list(self.columns)
+        for i, c in enumerate(out):
+            if c.name == name and not c.dropped:
+                if i < nk:
+                    raise ValueError(f'cannot drop key column "{name}"')
+                out[i] = _replace(c, name=f"!dropped!{i}!{name}",
+                                  dropped=True)
+                return Schema(columns=out,
+                              num_hash_key_columns=self.num_hash_key_columns,
+                              num_range_key_columns=self.num_range_key_columns)
         raise KeyError(name)

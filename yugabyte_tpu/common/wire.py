@@ -21,7 +21,8 @@ from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 # ------------------------------------------------------------------ schema
 def schema_to_wire(schema: Schema) -> dict:
     return {
-        "columns": [[c.name, c.type.value, c.nullable, c.sorting.value]
+        "columns": [[c.name, c.type.value, c.nullable, c.sorting.value,
+                     c.dropped]
                     for c in schema.columns],
         "num_hash": schema.num_hash_key_columns,
         "num_range": schema.num_range_key_columns,
@@ -29,9 +30,12 @@ def schema_to_wire(schema: Schema) -> dict:
 
 
 def schema_from_wire(w: dict) -> Schema:
+    # 5th element (dropped) is optional for wire/sys-catalog back-compat
     return Schema(
-        columns=[ColumnSchema(n, DataType(t), nullable, SortingType(s))
-                 for n, t, nullable, s in w["columns"]],
+        columns=[ColumnSchema(col[0], DataType(col[1]), col[2],
+                              SortingType(col[3]),
+                              bool(col[4]) if len(col) > 4 else False)
+                 for col in w["columns"]],
         num_hash_key_columns=w["num_hash"],
         num_range_key_columns=w["num_range"])
 

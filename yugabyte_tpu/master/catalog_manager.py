@@ -20,7 +20,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from yugabyte_tpu.common.partition import PartitionSchema
 from yugabyte_tpu.common.wire import (
-    partition_from_wire, partition_schema_from_wire, partition_to_wire)
+    partition_from_wire, partition_schema_from_wire, partition_to_wire,
+    schema_from_wire, schema_to_wire)
 from yugabyte_tpu.master.sys_catalog import SysCatalog
 from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Status, StatusError
@@ -244,6 +245,89 @@ class CatalogManager:
         for d in out:
             d.num_tablets += 1  # keeps subsequent picks spreading
         return [d.server_id for d in out]
+
+    # ---------------------------------------------------------------- alter
+    def alter_table(self, namespace: str, name: str,
+                    add_columns: Sequence[Tuple[str, str]] = (),
+                    drop_columns: Sequence[str] = ()) -> dict:
+        """Online ALTER TABLE ADD/DROP COLUMN (ref CatalogManager::
+        AlterTable + async AlterTable tasks, catalog_manager.cc): the new
+        schema persists with a bumped version, then propagates to every
+        hosted replica — directly here for latency, and via heartbeat
+        reconciliation for replicas that miss the push (see
+        process_heartbeat schema piggyback). ADD appends a slot (ids
+        stable, no data rewrite); DROP tombstones the slot in place."""
+        from yugabyte_tpu.common.schema import DataType
+        with self._lock:
+            # read-modify-write under the catalog lock: concurrent ALTERs
+            # must serialize or one silently loses its column AND collides
+            # on schema_version (tservers already at the winning version
+            # would never be repaired by heartbeat reconciliation)
+            table = next((t for t in self.tables.values()
+                          if t["namespace"] == namespace
+                          and t["name"] == name), None)
+            if table is None:
+                raise StatusError(Status.NotFound(
+                    f"table {namespace}.{name}"))
+            schema = schema_from_wire(table["schema"])
+            try:
+                for col, type_name in add_columns:
+                    schema = schema.with_added_column(col,
+                                                      DataType(type_name))
+                for col in drop_columns:
+                    schema = schema.with_dropped_column(col)
+            except (ValueError, KeyError) as e:
+                raise StatusError(Status.InvalidArgument(str(e))) from e
+            version = table.get("schema_version", 0) + 1
+            table = dict(table, schema=schema_to_wire(schema),
+                         schema_version=version)
+            self.sys.upsert("table", table["table_id"], table)
+            self.tables[table["table_id"]] = table
+            tablet_ids = [t for t in table["tablet_ids"]
+                          if t in self.tablets]
+            targets = [(t, s) for t in tablet_ids
+                       for s in self.tablets[t]["replicas"]]
+        addr_map = self.ts_manager.addr_map()
+
+        def push():
+            # fire-and-forget latency optimization (the reference's async
+            # AlterTable tasks); heartbeat reconciliation is the guarantee
+            for tablet_id, server_id in targets:
+                addr = addr_map.get(server_id)
+                if addr is None:
+                    continue
+                try:
+                    self.messenger.call(addr, "tserver",
+                                        "alter_tablet_schema",
+                                        timeout_s=2.0, tablet_id=tablet_id,
+                                        schema=table["schema"],
+                                        version=version)
+                except StatusError:
+                    pass
+        threading.Thread(target=push, daemon=True,
+                         name="alter-push").start()
+        return table
+
+    def _schema_updates_for(self, report: List[dict]) -> List[dict]:
+        """Heartbeat piggyback: alter orders for reported tablets whose
+        schema version lags the catalog's (the reconciliation half of
+        alter_table — a replica that missed the direct push, or was
+        bootstrapped from an old snapshot, converges here)."""
+        out = []
+        with self._lock:
+            for t in report:
+                tm = self.tablets.get(t.get("tablet_id"))
+                if tm is None:
+                    continue
+                table = self.tables.get(tm["table_id"])
+                if table is None:
+                    continue
+                want = table.get("schema_version", 0)
+                if t.get("schema_version", 0) < want:
+                    out.append({"tablet_id": t["tablet_id"],
+                                "schema": table["schema"],
+                                "version": want})
+        return out
 
     # --------------------------------------------------------------- indexes
     def create_index(self, namespace: str, table_name: str, index_name: str,
@@ -486,6 +570,12 @@ class CatalogManager:
             # retention instead of pinning it until restart
             resp["history_retention"] = self._history_retention_for(
                 reported_ids)
+        except Exception:  # noqa: BLE001 — must never fail heartbeats
+            pass
+        try:
+            updates = self._schema_updates_for(report)
+            if updates:
+                resp["schema_updates"] = updates
         except Exception:  # noqa: BLE001 — must never fail heartbeats
             pass
         return resp

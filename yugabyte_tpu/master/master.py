@@ -79,6 +79,12 @@ class MasterService:
         self._leader_catalog().delete_table(namespace, name)
         return True
 
+    def alter_table(self, namespace: str, name: str,
+                    add_columns=(), drop_columns=()) -> dict:
+        return self._leader_catalog().alter_table(
+            namespace, name, [tuple(c) for c in add_columns],
+            list(drop_columns))
+
     def create_index(self, namespace: str, table: str, index_name: str,
                      column: str, num_tablets: int = 2) -> dict:
         return self._leader_catalog().create_index(

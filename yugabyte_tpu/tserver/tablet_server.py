@@ -144,6 +144,12 @@ class TabletServer:
         self._reconcile_pollers(resp.get("replication") or [])
         self.tablet_manager.apply_history_retention(
             resp.get("history_retention"))
+        for upd in resp.get("schema_updates") or []:
+            try:
+                self.tablet_manager.alter_tablet_schema(
+                    upd["tablet_id"], upd["schema"], upd["version"])
+            except StatusError:
+                pass  # tablet moved/deleted since the report
         keys = resp.get("universe_keys")
         if keys:
             self._apply_universe_keys(keys)

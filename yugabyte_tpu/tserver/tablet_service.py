@@ -60,14 +60,33 @@ class TabletServiceImpl:
         return peer
 
     # ---------------------------------------------------------------- writes
+    def _check_schema_version(self, tablet_id: str,
+                              client_version: Optional[int]) -> None:
+        """Write/read ops encode columns by name against the TABLET's
+        schema; a client ahead of this replica (its ALTER TABLE has not
+        propagated here yet) must be rejected retryably — the client's
+        backoff outlives the heartbeat that delivers the new schema (ref
+        the tablet schema version mismatch error in the reference write
+        path)."""
+        if not client_version:
+            return
+        local = self._tablets.tablet_meta(tablet_id).get(
+            "schema_version", 0)
+        if client_version > local:
+            raise StatusError(Status.ServiceUnavailable(
+                f"tablet {tablet_id} schema version {local} behind "
+                f"client {client_version}; retry"))
+
     def write(self, tablet_id: str, ops: List[dict],
               timeout_s: float = 15.0, txn: Optional[dict] = None,
               client_id: Optional[bytes] = None,
-              request_id: Optional[int] = None) -> dict:
+              request_id: Optional[int] = None,
+              schema_version: Optional[int] = None) -> dict:
         from yugabyte_tpu.docdb.conflict_resolution import (
             TransactionConflict)
         from yugabyte_tpu.docdb.intents import TransactionMetadata
         from yugabyte_tpu.tablet.tablet import TabletHasBeenSplit
+        self._check_schema_version(tablet_id, schema_version)
         peer = self._tablets.get_tablet(tablet_id)
         decoded = [write_op_from_wire(w) for w in ops]
         # Key-bounds guard: after a split, a stale client batch may span
@@ -114,7 +133,9 @@ class TabletServiceImpl:
                  read_ht: Optional[int] = None,
                  projection: Optional[List[str]] = None,
                  allow_follower: bool = False,
-                 txn_id: Optional[bytes] = None) -> Optional[dict]:
+                 txn_id: Optional[bytes] = None,
+                 schema_version: Optional[int] = None) -> Optional[dict]:
+        self._check_schema_version(tablet_id, schema_version)
         peer = self._tablets.get_tablet(tablet_id)
         try:
             row = peer.read_row(
@@ -294,6 +315,11 @@ class TabletServiceImpl:
     def delete_tablet(self, tablet_id: str) -> bool:
         self._tablets.delete_tablet(tablet_id)
         return True
+
+    def alter_tablet_schema(self, tablet_id: str, schema: dict,
+                            version: int) -> bool:
+        return self._tablets.alter_tablet_schema(tablet_id, schema,
+                                                 version)
 
     # ---------------------------------------------- replica movement (LB)
     def begin_remote_bootstrap(self, tablet_id: str) -> dict:

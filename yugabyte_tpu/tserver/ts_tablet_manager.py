@@ -344,6 +344,40 @@ class TSTabletManager:
         with self._lock:
             return list(self._tablets.values())
 
+    def alter_tablet_schema(self, tablet_id: str, schema_wire: dict,
+                            version: int) -> bool:
+        """Apply an online schema change to a hosted tablet (ref
+        TSTabletManager + tablet AlterSchema; versions are monotonic and
+        retries idempotent).  Returns True when applied or already at
+        `version`."""
+        with self._lock:
+            peer = self._tablets.get(tablet_id)
+        if peer is None:
+            raise StatusError(Status.NotFound(
+                f"tablet {tablet_id} not hosted on {self.server_id}"))
+        with self._create_lock:
+            # re-read under the serializing lock: a concurrent NEWER alter
+            # (direct push racing a heartbeat piggyback) must not be
+            # overwritten by this older one
+            with self._lock:
+                meta = self._meta.get(tablet_id)
+            if meta is None:
+                raise StatusError(Status.NotFound(
+                    f"tablet {tablet_id} not hosted on {self.server_id}"))
+            if meta.get("schema_version", 0) >= version:
+                return True
+            meta = dict(meta, schema=schema_wire, schema_version=version)
+            jsonutil.write_atomic(
+                os.path.join(self._tablet_dir(tablet_id), "meta.json"),
+                meta)
+            with self._lock:
+                self._meta[tablet_id] = meta
+            if peer.tablet is not None:
+                peer.tablet.schema = schema_from_wire(schema_wire)
+        TRACE("ts %s: tablet %s schema -> v%d", self.server_id, tablet_id,
+              version)
+        return True
+
     def apply_history_retention(self, overrides) -> None:
         """Heartbeat piggyback: per-tablet minimum MVCC history retention
         required by the master's active snapshot schedules (PITR).
@@ -388,6 +422,7 @@ class TSTabletManager:
                 "config_index": peer.raft.committed_config_index(),
             }
             meta = self.tablet_meta(tablet_id)
+            entry["schema_version"] = meta.get("schema_version", 0)
             if meta.get("split_parent"):
                 # Enough context for the master to ADOPT a split child it
                 # has never heard of (ref tablet reports carrying

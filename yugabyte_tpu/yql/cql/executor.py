@@ -322,6 +322,8 @@ class QLProcessor:
             with self._lock:
                 self._tables.pop((ks, stmt.name), None)
             return ResultSet()
+        if isinstance(stmt, P.AlterTable):
+            return self._alter_table(stmt)
         if isinstance(stmt, P.CreateIndex):
             return self._create_index(stmt)
         if isinstance(stmt, P.Select):
@@ -340,6 +342,22 @@ class QLProcessor:
         if isinstance(stmt, P.Transaction):
             return self._run_transaction(stmt, params)
         raise StatusError(Status.NotSupported(f"statement {type(stmt)}"))
+
+    def _alter_table(self, stmt: P.AlterTable) -> ResultSet:
+        """ALTER TABLE ADD/DROP column riding the master's versioned
+        online schema change (ref ql/ptree/pt_alter_table.h)."""
+        ks = self._resolve_ks(stmt.keyspace)
+        add = []
+        for col, cql_t in stmt.add_columns:
+            t = cql_t.upper()
+            if t not in _CQL_TYPES:
+                raise StatusError(Status.NotSupported(f"type {t}"))
+            add.append((col, _CQL_TYPES[t].value))
+        self._client.alter_table(ks, stmt.name, add_columns=add,
+                                 drop_columns=stmt.drop_columns)
+        with self._lock:
+            self._tables.pop((ks, stmt.name), None)
+        return ResultSet()
 
     def _create_index(self, stmt: P.CreateIndex) -> ResultSet:
         ks = self._resolve_ks(stmt.keyspace)
@@ -449,7 +467,8 @@ class QLProcessor:
 
         out_items = [bind_item(i)
                      for i in (stmt.columns
-                               or [c.name for c in schema.columns])]
+                               or [c.name for c in schema.columns
+                                   if not c.dropped])]
         where = self._bind_where(stmt.where, params, cursor)
         known = {c.name: c.type for c in schema.columns}
 

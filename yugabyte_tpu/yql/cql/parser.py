@@ -75,6 +75,14 @@ class DropTable:
 
 
 @dataclass
+class AlterTable:
+    keyspace: Optional[str]
+    name: str
+    add_columns: List[Tuple[str, str]]   # (name, cql type)
+    drop_columns: List[str]
+
+
+@dataclass
 class CreateIndex:
     index_name: Optional[str]
     keyspace: Optional[str]
@@ -242,6 +250,21 @@ class Parser:
         if self.accept_kw("DROP", "TABLE"):
             ks, name = self.qualified_name()
             return DropTable(ks, name)
+        if self.accept_kw("ALTER", "TABLE"):
+            ks, name = self.qualified_name()
+            add, drop = [], []
+            while True:
+                if self.accept_kw("ADD"):
+                    col = self.name()
+                    add.append((col, self.name()))
+                elif self.accept_kw("DROP"):
+                    drop.append(self.name())
+                else:
+                    raise ParseError(
+                        f"expected ADD or DROP, got {self.peek()}")
+                if not self.accept_op(","):
+                    break
+            return AlterTable(ks, name, add, drop)
         if self.accept_kw("USE"):
             return UseKeyspace(self.name())
         if self.accept_kw("INSERT", "INTO"):

@@ -82,6 +82,9 @@ class PgSession:
         # bumped at every transaction boundary; suspended portals created
         # under an older epoch are invalid (see server._execute_portal)
         self.txn_epoch = 0
+        # DECLARE'd cursors: name -> (columns, lazy row iterator, hold);
+        # non-hold cursors die at transaction end, WITH HOLD survive
+        self._cursors: Dict[str, Tuple[list, object, bool]] = {}
         # PG connects to an EXISTING database; only the default one is
         # auto-created (the initdb role). Unknown names fail with 3D000
         # instead of silently materializing a typo'd namespace.
@@ -244,7 +247,8 @@ class PgSession:
             desc, _ = self._aggregate(
                 stmt, lambda c: PG_OIDS[schema.column(c).type], [])
             return desc
-        out_cols = stmt.columns or [c.name for c in schema.columns]
+        out_cols = stmt.columns or [c.name for c in schema.columns
+                                    if not c.dropped]
         return [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
 
     # ----------------------------------------------------------- dispatch
@@ -282,7 +286,68 @@ class PgSession:
                      "transaction_isolation": "repeatable read"}.get(
                          stmt.name.lower(), "")
             return PgResult("SHOW", [(stmt.name, 25)], [[value]])
+        if isinstance(stmt, P.AlterTable):
+            return self._alter_table(stmt)
+        if isinstance(stmt, P.DeclareCursor):
+            return self._declare_cursor(stmt)
+        if isinstance(stmt, P.FetchCursor):
+            return self._fetch_cursor(stmt)
+        if isinstance(stmt, P.CloseCursor):
+            if stmt.name not in self._cursors:
+                raise PgError(Status.InvalidArgument(
+                    f'cursor "{stmt.name}" does not exist'), "34000")
+            del self._cursors[stmt.name]
+            return PgResult("CLOSE CURSOR")
         raise PgError(Status.NotSupported(str(type(stmt))), "0A000")
+
+    # -------------------------------------------------------------- ALTER
+    def _alter_table(self, stmt: P.AlterTable) -> PgResult:
+        """Online ADD/DROP COLUMN riding the master's versioned schema
+        change (catalog_manager.alter_table; ref the PG ALTER TABLE path
+        landing in CatalogManager::AlterTable)."""
+        try:
+            # parser carries DataType NAMES ("INT32"); the master's wire
+            # takes enum values ("int32")
+            self._client.alter_table(
+                self.database, stmt.table,
+                add_columns=[(c, DataType[t].value)
+                             for c, t in stmt.add_columns],
+                drop_columns=stmt.drop_columns)
+        except StatusError as e:
+            raise _pg_error(e) from e
+        self._tables.pop(stmt.table, None)   # next use sees the new schema
+        return PgResult("ALTER TABLE")
+
+    # ------------------------------------------------------------ cursors
+    def _declare_cursor(self, stmt: P.DeclareCursor) -> PgResult:
+        """DECLARE ... CURSOR FOR SELECT: the cursor holds a lazy iterator
+        (streaming plan where eligible), pulled by FETCH in page-sized
+        bites (ref the PG portal machinery these map onto)."""
+        if stmt.name in self._cursors:
+            raise PgError(Status.InvalidArgument(
+                f'cursor "{stmt.name}" already exists'), "42P03")
+        streamed = self._select_stream(stmt.select)
+        if streamed is None:
+            materialized = self._select(stmt.select)
+            streamed = PgResult(materialized.tag, materialized.columns,
+                                row_iter=iter(materialized.rows))
+        self._cursors[stmt.name] = (streamed.columns, streamed.row_iter,
+                                    stmt.hold)
+        return PgResult("DECLARE CURSOR")
+
+    def _fetch_cursor(self, stmt: P.FetchCursor) -> PgResult:
+        cur = self._cursors.get(stmt.name)
+        if cur is None:
+            raise PgError(Status.InvalidArgument(
+                f'cursor "{stmt.name}" does not exist'), "34000")
+        cols, it, _hold = cur
+        rows = []
+        while stmt.count is None or len(rows) < stmt.count:
+            try:
+                rows.append(next(it))
+            except StopIteration:
+                break
+        return PgResult(f"FETCH {len(rows)}", cols, rows)
 
     # ---------------------------------------------------------------- DDL
     def _create_table(self, stmt: P.CreateTable) -> PgResult:
@@ -652,12 +717,13 @@ class PgSession:
 
     def _select_stream(self, stmt: P.Select) -> Optional[PgResult]:
         """Streaming plan for portal execution, or None when the statement
-        needs the full match set (aggregates/ORDER BY/virtual tables) —
-        those fall back to the materialized _select."""
+        needs the full match set (aggregates/ORDER BY/joins/virtual
+        tables) — those fall back to the materialized _select."""
         if (stmt.count_star or stmt.aggregates or stmt.group_by
-                or stmt.order_by or stmt.scalar_items
+                or stmt.order_by or stmt.scalar_items or stmt.joins
                 or self._virtual_table_rows(stmt.table) is not None):
             return None
+        stmt = self._strip_base_qualifiers(stmt)
         table = self._table(stmt.table)
         schema = table.schema
         known = {c.name for c in schema.columns}
@@ -665,7 +731,8 @@ class PgSession:
             if c not in known:
                 raise PgError(Status.InvalidArgument(
                     f'column "{c}" does not exist'), "42703")
-        out_cols = stmt.columns or [c.name for c in schema.columns]
+        out_cols = stmt.columns or [c.name for c in schema.columns
+                                    if not c.dropped]
         col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
 
         def gen():
@@ -674,7 +741,196 @@ class PgSession:
 
         return PgResult("SELECT 0", col_desc, row_iter=gen())
 
+    # -------------------------------------------------------------- JOIN
+    def _select_join(self, stmt: P.Select) -> PgResult:
+        """Left-deep join pipeline over doc scans (ref: the PG executor's
+        join nodes as used through pggate scans, pg_doc_op.h):
+
+          - HASH JOIN by default: the joined table's filtered scan builds
+            an equality map probed by the rows joined so far.
+          - INDEX NESTED-LOOP when the joined table's join column is its
+            single-column primary key: batched point reads replace the
+            build-side scan (the doc store IS the index).
+
+        Single-table WHERE predicates push into each table's scan, except
+        predicates on a LEFT-joined table, which must filter AFTER the
+        join (pushing them into the build side would keep null-extended
+        rows PG drops)."""
+        base_alias = stmt.alias or stmt.table
+        tables: List[Tuple[str, YBTable]] = [(base_alias,
+                                              self._table(stmt.table))]
+        for j in stmt.joins:
+            tables.append((j.alias or j.table, self._table(j.table)))
+        by_alias = dict(tables)
+        if len(by_alias) != len(tables):
+            raise PgError(Status.InvalidArgument(
+                "duplicate table alias in FROM"), "42712")
+
+        def has_col(t: YBTable, col: str) -> bool:
+            try:
+                t.schema.column(col)
+                return True
+            except KeyError:
+                return False
+
+        def resolve(ref: str) -> Tuple[str, str]:
+            if "." in ref:
+                a, c = ref.split(".", 1)
+                if a not in by_alias:
+                    raise PgError(Status.InvalidArgument(
+                        f'missing FROM-clause entry for table "{a}"'),
+                        "42P01")
+                if not has_col(by_alias[a], c):
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{ref}" does not exist'), "42703")
+                return a, c
+            owners = [a for a, t in tables if has_col(t, ref)]
+            if not owners:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{ref}" does not exist'), "42703")
+            if len(owners) > 1:
+                raise PgError(Status.InvalidArgument(
+                    f'column reference "{ref}" is ambiguous'), "42702")
+            return owners[0], ref
+
+        left_joined = {j.alias or j.table for j in stmt.joins
+                       if j.kind == "left"}
+        pushdown: Dict[str, List] = {a: [] for a, _t in tables}
+        residual: List[Tuple[str, str, object]] = []
+        for c, op, v in stmt.where:
+            a, col = resolve(c)
+            if a in left_joined:
+                residual.append((f"{a}.{col}", op, v))
+            else:
+                pushdown[a].append((col, op, v))
+
+        base_table = by_alias[base_alias]
+        rows = [{f"{base_alias}.{k}": v for k, v in d.items()}
+                for d in self._iter_row_dicts(
+                    P.Select(stmt.table, None, pushdown[base_alias]),
+                    base_table)]
+
+        joined = {base_alias}
+        for j in stmt.joins:
+            alias = j.alias or j.table
+            table = by_alias[alias]
+            sch = table.schema
+            la, lc = resolve(j.on[0])
+            ra, rc = resolve(j.on[1])
+            if ra == alias and la in joined:
+                pa, pc, jc = la, lc, rc
+            elif la == alias and ra in joined:
+                pa, pc, jc = ra, rc, lc
+            else:
+                raise PgError(Status.InvalidArgument(
+                    "JOIN ON must equate a joined-table column with a "
+                    "column of an earlier FROM entry"), "42P01")
+            probe_key = f"{pa}.{pc}"
+            # left-joined tables' predicates were already diverted to the
+            # post-join `residual` above, so pushdown[alias] is exactly
+            # the safe build-side filter set either way
+            filters = pushdown[alias]
+
+            use_point = (j.kind == "inner" and not filters
+                         and len(sch.hash_columns) == 1
+                         and sch.num_range_key_columns == 0
+                         and sch.hash_columns[0].name == jc)
+            if use_point:
+                # index nested-loop: the join column is the PK — point
+                # reads on distinct probe values beat a full build scan
+                cache: Dict[object, List[dict]] = {}
+
+                def matches_for(v, _t=table, _s=sch, _c=cache):
+                    if v not in _c:
+                        row = (self._txn.read_row(_t, DocKey(
+                            hash_components=(v,))) if self._txn is not None
+                            else self._client.read_row(_t, DocKey(
+                                hash_components=(v,))))
+                        _c[v] = [] if row is None else [row.to_dict(_s)]
+                    return _c[v]
+            else:
+                build: Dict[object, List[dict]] = {}
+                for d in self._iter_row_dicts(
+                        P.Select(j.table, None, filters), table):
+                    build.setdefault(d.get(jc), []).append(d)
+
+                def matches_for(v, _b=build):
+                    return _b.get(v, [])
+
+            null_row = {f"{alias}.{c.name}": None
+                        for c in sch.columns if not c.dropped}
+            out = []
+            for left in rows:
+                v = left.get(probe_key)
+                ms = matches_for(v) if v is not None else []
+                if ms:
+                    for d in ms:
+                        nr = dict(left)
+                        nr.update({f"{alias}.{k}": val
+                                   for k, val in d.items()})
+                        out.append(nr)
+                elif j.kind == "left":
+                    out.append({**left, **null_row})
+            rows = out
+            joined.add(alias)
+
+        if residual:
+            rows = [r for r in rows if row_matches(r, residual)]
+
+        if stmt.count_star:
+            return PgResult("SELECT 1", [("count", 20)], [[len(rows)]])
+        if stmt.aggregates or stmt.group_by or stmt.scalar_items:
+            raise PgError(Status.NotSupported(
+                "aggregates over joins are not supported"), "0A000")
+        if stmt.columns:
+            proj = [resolve(c) for c in stmt.columns]
+        else:
+            proj = [(a, c.name) for a, t in tables
+                    for c in t.schema.columns if not c.dropped]
+        col_desc = [(c, PG_OIDS[by_alias[a].schema.column(c).type])
+                    for a, c in proj]
+        if stmt.order_by:
+            qorder = [("%s.%s" % resolve(c), d) for c, d in stmt.order_by]
+            rows = self._order_rows(rows, qorder)
+        rows_out = [[r.get(f"{a}.{c}") for a, c in proj] for r in rows]
+        if stmt.limit is not None:
+            rows_out = rows_out[: stmt.limit]
+        return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+
+    @staticmethod
+    def _strip_base_qualifiers(stmt: P.Select) -> P.Select:
+        """`SELECT t.x FROM t [t_alias]` without joins: drop the table
+        qualifier so the single-table machinery sees bare columns."""
+        from dataclasses import replace
+        pref = {stmt.table, stmt.alias or stmt.table}
+
+        def fix(c):
+            if isinstance(c, str) and "." in c:
+                a, col = c.split(".", 1)
+                if a in pref:
+                    return col
+            return c
+        def fix_item(it):
+            if it[0] == "col":
+                return ("col", fix(it[1]))
+            if it[0] == "func":
+                return ("func", it[1], [fix_item(a) for a in it[2]])
+            return it
+
+        return replace(
+            stmt,
+            columns=[fix(c) for c in stmt.columns] if stmt.columns else None,
+            where=[(fix(c), op, v) for c, op, v in stmt.where],
+            order_by=[(fix(c), d) for c, d in stmt.order_by],
+            scalar_items=[fix_item(i) for i in stmt.scalar_items],
+            group_by=fix(stmt.group_by) if stmt.group_by else None,
+            aggregates=[(f, fix(c) if c else c)
+                        for f, c in stmt.aggregates])
+
     def _select(self, stmt: P.Select) -> PgResult:
+        if stmt.joins:
+            return self._select_join(stmt)
+        stmt = self._strip_base_qualifiers(stmt)
         vt = self._virtual_table_rows(stmt.table)
         if vt is not None:
             return self._select_virtual(stmt, *vt)
@@ -710,7 +966,8 @@ class PgSession:
             if stmt.limit is not None:
                 rows_out = rows_out[: stmt.limit]
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
-        out_cols = stmt.columns or [c.name for c in schema.columns]
+        out_cols = stmt.columns or [c.name for c in schema.columns
+                                    if not c.dropped]
         col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
         rows_out = [[d.get(c) for c in out_cols] for d in dicts]
         if stmt.limit is not None:
@@ -857,8 +1114,13 @@ class PgSession:
     def _txn_control(self, stmt: P.TxnControl) -> PgResult:
         # any transaction boundary invalidates open portals (PG destroys
         # non-holdable portals at txn end; a suspended portal's iterator
-        # is pinned to the old txn's snapshot/overlay)
+        # is pinned to the old txn's snapshot/overlay) — and cursors, for
+        # the same reason
         self.txn_epoch += 1
+        if stmt.kind != "begin":
+            # WITH HOLD cursors survive transaction end (PG DECLARE docs)
+            self._cursors = {n: c for n, c in self._cursors.items()
+                             if c[2]}
         if stmt.kind == "begin":
             if self._txn is None:
                 self._txn = self._txn_manager.begin()

@@ -89,12 +89,22 @@ class Param:
 
 
 @dataclass
+class Join:
+    table: str
+    alias: Optional[str]
+    kind: str                          # "inner" | "left"
+    on: Tuple[str, str]                # (left_ref, right_ref), maybe "a.c"
+
+
+@dataclass
 class Select:
     table: str
     columns: Optional[List[str]]       # None = *
     where: List[Tuple[str, str, object]] = field(default_factory=list)
     limit: Optional[int] = None
     count_star: bool = False
+    alias: Optional[str] = None        # FROM <table> [alias]
+    joins: List[Join] = field(default_factory=list)
     # aggregate select list: (func, column or None for COUNT(*)); when
     # non-empty the output is one row per group (group_by) or one row
     aggregates: List[Tuple[str, Optional[str]]] = field(default_factory=list)
@@ -129,8 +139,34 @@ class Show:
     name: str
 
 
+@dataclass
+class AlterTable:
+    table: str
+    add_columns: List[Tuple[str, str]]  # (name, DataType name)
+    drop_columns: List[str]
+
+
+@dataclass
+class DeclareCursor:
+    name: str
+    select: "Select"
+    hold: bool = False       # WITH HOLD: survives COMMIT (PG semantics)
+
+
+@dataclass
+class FetchCursor:
+    name: str
+    count: Optional[int]               # None = ALL
+
+
+@dataclass
+class CloseCursor:
+    name: str
+
+
 Statement = Union[CreateDatabase, DropDatabase, CreateTable, DropTable,
-                  Insert, Select, Update, Delete, TxnControl, Show]
+                  Insert, Select, Update, Delete, TxnControl, Show,
+                  AlterTable, DeclareCursor, FetchCursor, CloseCursor]
 
 
 class PgParser(_BaseParser):
@@ -186,7 +222,49 @@ class PgParser(_BaseParser):
             return TxnControl("rollback")
         if self.accept_kw("SHOW"):
             return Show(self.name())
+        if self.accept_kw("ALTER", "TABLE"):
+            return self._alter_table()
+        if self.accept_kw("DECLARE"):
+            name = self.name()
+            self.expect_kw("CURSOR")
+            hold = bool(self.accept_kw("WITH", "HOLD"))
+            self.accept_kw("WITHOUT", "HOLD")
+            self.expect_kw("FOR")
+            self.expect_kw("SELECT")
+            return DeclareCursor(name, self._select(), hold)
+        if self.accept_kw("FETCH"):
+            count: Optional[int] = 1
+            tok = self.peek()
+            if self.accept_kw("ALL"):
+                count = None
+            elif self.accept_kw("FORWARD"):
+                count = None if self.accept_kw("ALL") else int(self.literal())
+            elif tok is not None and tok[0] == "number":
+                count = int(self.literal())
+            self.accept_kw("FROM") or self.accept_kw("IN")
+            return FetchCursor(self.name(), count)
+        if self.accept_kw("CLOSE"):
+            return CloseCursor(self.name())
         raise ParseError(f"unsupported statement near {self.peek()!r}")
+
+    def _alter_table(self) -> AlterTable:
+        table = self._table_name()
+        add: List[Tuple[str, str]] = []
+        drop: List[str] = []
+        while True:
+            if self.accept_kw("ADD"):
+                self.accept_kw("COLUMN")
+                col = self.name()
+                add.append((col, self._type_name()))
+            elif self.accept_kw("DROP"):
+                self.accept_kw("COLUMN")
+                drop.append(self.name())
+            else:
+                raise ParseError(
+                    f"expected ADD or DROP, got {self.peek()!r}")
+            if not self.accept_op(","):
+                break
+        return AlterTable(table, add, drop)
 
     def parse_script(self) -> List[Statement]:
         out = []
@@ -201,6 +279,26 @@ class PgParser(_BaseParser):
                 self.expect_op(";")
 
     # ----------------------------------------------------------- helpers
+    _RESERVED = {"JOIN", "INNER", "LEFT", "OUTER", "ON", "WHERE", "GROUP",
+                 "ORDER", "LIMIT", "AND", "FROM", "AS", "FETCH", "FOR",
+                 "UNION", "HAVING"}
+
+    def _maybe_alias(self) -> Optional[str]:
+        if self.accept_kw("AS"):
+            return self.name()
+        tok = self.peek()
+        if (tok is not None and tok[0] == "name"
+                and tok[1].upper() not in self._RESERVED):
+            return self.name()
+        return None
+
+    def _col_ref(self) -> str:
+        """A possibly table-qualified column reference: 'col' or 'a.col'."""
+        first = self.name()
+        if self.accept_op("."):
+            return f"{first}.{self.name()}"
+        return first
+
     def _at_semicolon(self) -> bool:
         tok = self.peek()
         return tok is not None and tok == ("op", ";")
@@ -303,7 +401,7 @@ class PgParser(_BaseParser):
                 return ("agg", func, col)
         if tok is not None and tok[0] == "name" and nxt == ("op", "("):
             return self._scalar_func()
-        return ("col", self.name())
+        return ("col", self._col_ref())
 
     def _scalar_func(self):
         fname = self.name()
@@ -369,6 +467,24 @@ class PgParser(_BaseParser):
                 columns = cols
         self.expect_kw("FROM")
         name = self._table_name()
+        alias = self._maybe_alias()
+        joins: List[Join] = []
+        while True:
+            kind = None
+            if self.accept_kw("JOIN") or self.accept_kw("INNER", "JOIN"):
+                kind = "inner"
+            elif self.accept_kw("LEFT", "OUTER", "JOIN") \
+                    or self.accept_kw("LEFT", "JOIN"):
+                kind = "left"
+            if kind is None:
+                break
+            jt = self._table_name()
+            jalias = self._maybe_alias()
+            self.expect_kw("ON")
+            lref = self._col_ref()
+            self.expect_op("=")
+            rref = self._col_ref()
+            joins.append(Join(jt, jalias, kind, (lref, rref)))
         where = self._pg_where()
         group_by = None
         if self.accept_kw("GROUP", "BY"):
@@ -376,7 +492,7 @@ class PgParser(_BaseParser):
         order_by: List[Tuple[str, bool]] = []
         if self.accept_kw("ORDER", "BY"):
             while True:
-                col = self.name()
+                col = self._col_ref()
                 desc = bool(self.accept_kw("DESC"))
                 if not desc:
                     self.accept_kw("ASC")
@@ -395,6 +511,7 @@ class PgParser(_BaseParser):
             count_star = True
             aggregates = []
         return Select(name, columns, where, limit, count_star,
+                      alias=alias, joins=joins,
                       aggregates=aggregates, group_by=group_by,
                       order_by=order_by, scalar_items=scalar_items)
 
@@ -403,7 +520,7 @@ class PgParser(_BaseParser):
             return []
         out = []
         while True:
-            col = self.name()
+            col = self._col_ref()
             tok = self.next()
             if tok[0] != "op":
                 raise ParseError(f"expected operator, got {tok[1]!r}")

@@ -365,6 +365,12 @@ class _Conn:
         if stmt is None:
             self._send(b"I")
             return
+        if state.get("done"):
+            # a portal runs AT MOST once (PG §55.2.3): Execute after
+            # completion re-reports CommandComplete without re-running —
+            # re-Executing an INSERT portal must not insert twice
+            self._send(b"C", _cstr(state.get("done_tag", "SELECT 0")))
+            return
         it = state["iter"]
         if it is not None and state.get("epoch") != self.session.txn_epoch:
             # the portal's iterator is pinned to a finished transaction's
@@ -376,7 +382,9 @@ class _Conn:
             result = self.session.execute_bound(stmt, state["params"],
                                                 stream=True)
             if result.columns is None:
-                # row-less statement (DML/DDL): no portal iteration
+                # row-less statement (DML/DDL): ran once, portal complete
+                state["done"] = True
+                state["done_tag"] = result.tag
                 self._send(b"C", _cstr(result.tag))
                 return
             it = result.row_iter if result.row_iter is not None \
@@ -414,6 +422,8 @@ class _Conn:
             state["iter"] = None
             tag = (f"SELECT {state['count']}" if state.get("select")
                    else state.get("tag", "SELECT 0"))
+            state["done"] = True
+            state["done_tag"] = tag
             self._send(b"C", _cstr(tag))
         else:
             self._send(b"s")  # PortalSuspended
