@@ -1,0 +1,137 @@
+"""Offload policy (VERDICT r3 #2): production compactions route device vs
+native from MEASURED calibration, never into a known pessimization."""
+
+import json
+
+import numpy as np
+import pytest
+
+from yugabyte_tpu.storage.offload_policy import (CalibrationPoint,
+                                                 OffloadPolicy)
+from yugabyte_tpu.utils import flags
+
+
+def pt(n, cached, dev, nat, plat="cpu"):
+    return CalibrationPoint(n, cached, dev, nat, plat)
+
+
+def test_uncalibrated_is_conservative():
+    p = OffloadPolicy([])
+    assert not p.use_device(100_000, cached=False)
+    assert not p.use_device(100_000, cached=True)
+    assert not p.use_device(10 << 20, cached=False)
+    assert p.use_device(10 << 20, cached=True)  # steady-state regime only
+
+
+def test_calibrated_pessimization_stays_native():
+    # r3's measured reality: device e2e 0.088x native
+    p = OffloadPolicy([pt(1 << 22, True, 128_000, 1_450_000)],
+                      platform="cpu")
+    assert not p.use_device(1 << 22, cached=True)
+    assert not p.use_device(1 << 24, cached=True)
+
+
+def test_calibrated_win_offloads():
+    p = OffloadPolicy([pt(1 << 22, True, 5_000_000, 1_450_000)],
+                      platform="cpu")
+    assert p.use_device(1 << 22, cached=True)
+    # nearest-size rule: a small job measured slow stays native
+    p2 = OffloadPolicy([pt(1 << 14, True, 100_000, 1_000_000),
+                        pt(1 << 22, True, 5_000_000, 1_450_000)],
+                       platform="cpu")
+    assert not p2.use_device(1 << 14, cached=True)
+    assert p2.use_device(1 << 22, cached=True)
+
+
+def test_platform_mismatch_ignored():
+    # a CPU-JAX fallback number must not gate a real TPU device
+    p = OffloadPolicy([pt(1 << 22, True, 100_000, 1_450_000, "cpu")],
+                      platform="tpu")
+    assert not p.use_device(1 << 22, cached=False)   # falls back to
+    assert p.use_device(10 << 20, cached=True)       # conservative default
+
+
+def test_mode_flags_force():
+    p = OffloadPolicy([pt(1 << 22, True, 1, 10, "cpu")], platform="cpu")
+    flags.set_flag("device_offload_mode", "device")
+    try:
+        assert p.use_device(10, cached=False)
+    finally:
+        flags.set_flag("device_offload_mode", "auto")
+    flags.set_flag("device_offload_mode", "native")
+    try:
+        assert not p.use_device(10 << 20, cached=True)
+    finally:
+        flags.set_flag("device_offload_mode", "auto")
+
+
+def test_load_and_append_roundtrip(tmp_path):
+    path = str(tmp_path / "cal.json")
+    OffloadPolicy.append_calibration(path, 1 << 20, True, 2e6, 1e6, "cpu")
+    OffloadPolicy.append_calibration(path, 1 << 20, False, 5e5, 1e6, "cpu")
+    p = OffloadPolicy.load(platform="cpu", path=path)
+    assert p.use_device(1 << 20, cached=True)
+    assert not p.use_device(1 << 20, cached=False)
+    # corrupt lines are skipped
+    with open(path, "a") as f:
+        f.write("not json\n")
+    assert len(OffloadPolicy.load(platform="cpu", path=path).points) == 2
+
+
+def test_compaction_job_respects_policy(tmp_path, monkeypatch):
+    """run_compaction_job with a native-wins policy must not touch the
+    device kernel at all."""
+    import jax
+
+    from bench import _attach_values, _split_runs, synth_ycsb_runs
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.storage.compaction import run_compaction_job
+    from yugabyte_tpu.storage.sst import Frontier, SSTReader, SSTWriter
+
+    n = 4096
+    slab, offsets = synth_ycsb_runs(n, 4, n // 2, seed=3)
+    _attach_values(slab, 16)
+    paths = []
+    for i, sub in enumerate(_split_runs(slab, offsets)):
+        p = str(tmp_path / f"{i:06d}.sst")
+        SSTWriter(p).write(sub, Frontier())
+        paths.append(p)
+
+    def boom(*a, **k):
+        raise AssertionError("device kernel invoked despite native policy")
+    monkeypatch.setattr(run_merge, "merge_and_gc_runs", boom)
+    monkeypatch.setattr(run_merge, "launch_merge_gc", boom)
+
+    policy = OffloadPolicy([pt(n, False, 1.0, 100.0, "cpu")],
+                           platform="cpu")
+    readers = [SSTReader(p) for p in paths]
+    ids = iter(range(1, 100))
+    out = tmp_path / "out"
+    out.mkdir()
+    res = run_compaction_job(readers, str(out), lambda: next(ids),
+                             (10_000_000 << 12), True,
+                             device=jax.devices()[0],
+                             offload_policy=policy)
+    for r in readers:
+        r.close()
+    assert res.rows_out > 0
+
+
+def test_server_context_loads_policy(tmp_path, monkeypatch):
+    cal = tmp_path / "cal.json"
+    OffloadPolicy.append_calibration(str(cal), 1 << 20, True, 2e6, 1e6,
+                                     "cpu")
+    flags.set_flag("offload_calibration_path", str(cal))
+    try:
+        from yugabyte_tpu.tserver.server_context import (
+            ServerExecutionContext)
+        import jax
+        ctx = ServerExecutionContext(device=jax.devices()[0])
+        try:
+            opts = ctx.tablet_options()
+            assert opts.offload_policy is not None
+            assert opts.offload_policy.use_device(1 << 20, cached=True)
+        finally:
+            ctx.shutdown()
+    finally:
+        flags.set_flag("offload_calibration_path", "")

@@ -11,6 +11,7 @@ from yugabyte_tpu.docdb.doc_key import DocKey
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 from yugabyte_tpu.integration.mini_cluster import (
     MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.storage import offload_policy  # noqa: F401 (flag defs)
 from yugabyte_tpu.utils import flags
 
 SCHEMA = Schema(
@@ -23,11 +24,18 @@ SCHEMA = Schema(
 def small_memstore():
     old_mem = flags.get_flag("memstore_size_bytes")
     old_rf = flags.get_flag("replication_factor")
+    old_mode = flags.get_flag("device_offload_mode")
     flags.set_flag("memstore_size_bytes", 4096)
     flags.set_flag("replication_factor", 1)
+    # this test validates the device WIRING (shared pool + HBM slab
+    # cache); the offload policy would route these tiny uncalibrated
+    # compactions to the native path (tests/test_offload_policy.py owns
+    # the routing behavior)
+    flags.set_flag("device_offload_mode", "device")
     yield
     flags.set_flag("memstore_size_bytes", old_mem)
     flags.set_flag("replication_factor", old_rf)
+    flags.set_flag("device_offload_mode", old_mode)
 
 
 def test_server_shares_pool_and_device_cache(tmp_path, small_memstore):

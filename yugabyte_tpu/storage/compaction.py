@@ -72,6 +72,16 @@ def compaction_rate_limiter():
         return _rate_limiter
 
 
+def _wants_distributed(mesh, n_rows: int) -> bool:
+    """The single authority for the distributed-compaction gate: a >1-
+    device mesh and a job at or above the size threshold. Written once so
+    the offload-policy gate, the combined-path gate and the dispatch gate
+    cannot drift apart."""
+    return (mesh is not None
+            and getattr(mesh, "devices", np.empty(0)).size > 1
+            and n_rows >= flags.get_flag("distributed_compaction_min_rows"))
+
+
 def filter_expired_inputs(inputs: Sequence[SSTReader],
                           history_cutoff_ht: int, is_major: bool,
                           retain_deletes: bool):
@@ -162,7 +172,7 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                        retain_deletes: bool = False, device=None,
                        block_entries: Optional[int] = None, device_cache=None,
                        input_ids: Optional[Sequence[int]] = None,
-                       mesh=None,
+                       mesh=None, offload_policy=None,
                        _no_combined: bool = False) -> CompactionResult:
     """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
 
@@ -177,6 +187,18 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     """
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None
+    if (offload_policy is not None and device is not None
+            and device != "native" and not _no_combined):
+        # Measured device-vs-native routing (VERDICT r3 #2): auto-offload
+        # only where calibration says the device path wins. Distributed
+        # (mesh) jobs are gated separately by their own size threshold.
+        est_rows = sum(r.props.n_entries for r in all_inputs)
+        cached = bool(device_cache is not None and input_ids is not None
+                      and all(device_cache.contains(fid)
+                              for fid in input_ids))
+        if not _wants_distributed(mesh, est_rows) \
+                and not offload_policy.use_device(est_rows, cached):
+            device = "native"
     if device is not None and device != "native" and not _no_combined:
         # The flagship production path: device merge+GC decisions + the
         # C++ byte shell + device-side write-through (the configuration
@@ -191,11 +213,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         from yugabyte_tpu.utils.env import get_env
         force_radix = os.environ.get("YBTPU_FORCE_RADIX", "").lower() \
             not in ("", "0", "false")
-        wants_dist = (
-            mesh is not None
-            and getattr(mesh, "devices", np.empty(0)).size > 1
-            and sum(r.props.n_entries for r in all_inputs)
-            >= flags.get_flag("distributed_compaction_min_rows"))
+        wants_dist = _wants_distributed(
+            mesh, sum(r.props.n_entries for r in all_inputs))
         if (native_engine.available() and not get_env().encrypted
                 and not force_radix and not wants_dist
                 and not any(r.props.has_deep for r in all_inputs)):
@@ -242,10 +261,7 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         # docdb_compaction_filter.cc:104-123).
         device = "native"
     surv = tomb_flags = None
-    if (mesh is not None and device != "native"
-            and getattr(mesh, "devices", np.empty(0)).size > 1
-            and merged.n >= flags.get_flag(
-                "distributed_compaction_min_rows")):
+    if device != "native" and _wants_distributed(mesh, merged.n):
         # Large job + multi-device mesh: fan the subcompactions across the
         # devices (parallel/dist_compact.py) — the mesh analog of the
         # reference's per-thread subcompactions. Decisions are identical

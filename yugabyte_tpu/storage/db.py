@@ -44,6 +44,8 @@ class DBOptions:
     # subcompactions across it (parallel/dist_compact.py); None = single
     # device (ref: subcompaction threads, compaction_job.cc:456-468)
     mesh: object = None
+    # measured device-vs-native router (storage/offload_policy.py)
+    offload_policy: object = None
     # HBM-resident slab cache (storage/device_cache.py); shared across
     # tablets like the reference's server-wide block cache
     device_cache: object = None
@@ -292,7 +294,8 @@ class DB:
                 block_entries=self.opts.block_entries,
                 device_cache=self._device_cache,
                 input_ids=[fm.file_id for fm in pick.inputs],
-                mesh=self.opts.mesh)
+                mesh=self.opts.mesh,
+                offload_policy=self.opts.offload_policy)
             from yugabyte_tpu.utils import sync_point
             sync_point.hit("db.compaction:before_install")
             with self._lock:

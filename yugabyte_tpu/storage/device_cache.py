@@ -53,6 +53,11 @@ class DeviceSlabCache:
             self.hits += 1
             return staged
 
+    def contains(self, key: CacheKey) -> bool:
+        """Metrics-neutral probe (offload policy peeks without counting)."""
+        with self._lock:
+            return key in self._map
+
     def put(self, key: CacheKey, staged: StagedCols) -> None:
         with self._lock:
             prior = self._map.pop(key, None)
@@ -106,6 +111,9 @@ class NamespacedSlabCache:
 
     def get(self, file_id: int):
         return self._shared.get((self.namespace, file_id))
+
+    def contains(self, file_id: int) -> bool:
+        return self._shared.contains((self.namespace, file_id))
 
     def put(self, file_id: int, staged: StagedCols) -> None:
         self._shared.put((self.namespace, file_id), staged)

@@ -119,6 +119,7 @@ class TabletOptions:
     block_entries: Optional[int] = None  # None = sst_block_entries flag
     device: object = None
     mesh: object = None      # >1-device mesh for distributed compaction
+    offload_policy: object = None   # measured device-vs-native router
     device_cache: object = None
     compaction_pool: object = None
     # shared decoded-block cache (ref: db/table_cache.cc — one per server)
@@ -148,6 +149,7 @@ class Tablet:
             block_entries=self.opts.block_entries,
             device=self.opts.device,
             mesh=self.opts.mesh,
+            offload_policy=self.opts.offload_policy,
             device_cache=self.opts.device_cache,
             compaction_pool=self.opts.compaction_pool,
             block_cache=self.opts.block_cache,

@@ -111,6 +111,10 @@ class ServerExecutionContext:
                 self.device,
                 capacity_bytes=flags.get_flag("device_slab_cache_bytes"))
         self.block_cache = BlockCache(flags.get_flag("block_cache_bytes"))
+        from yugabyte_tpu.storage.offload_policy import OffloadPolicy
+        self.offload_policy = OffloadPolicy.load(
+            platform=(getattr(self.device, "platform", "")
+                      if self.device != "native" else ""))
         self._entity = None
         if metrics is not None:
             e = metrics.entity("server", "execution")
@@ -127,6 +131,7 @@ class ServerExecutionContext:
     def tablet_options(self) -> TabletOptions:
         return TabletOptions(device=self.device,
                              mesh=self.mesh,
+                             offload_policy=self.offload_policy,
                              device_cache=self.device_cache,
                              compaction_pool=self.pool,
                              block_cache=self.block_cache)
