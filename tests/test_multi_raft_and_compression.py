@@ -1,0 +1,161 @@
+"""MultiRaftBatcher + RPC compression (VERDICT r3 #8 / missing #4-5).
+
+- Cross-tablet consensus heartbeats to one destination server share one
+  multi_update_consensus RPC: message count per interval is O(peer
+  servers), not O(tablets x peers).
+- RPC frames above the size threshold travel zlib-compressed,
+  transparently to every caller (remote bootstrap, CDC, scans).
+"""
+
+import time
+
+import pytest
+
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.consensus.multi_raft_batcher import MultiRaftBatcher
+from yugabyte_tpu.consensus.transport import PeerUnreachable
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.rpc.messenger import Messenger
+from yugabyte_tpu.utils import flags
+
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING)],
+    num_hash_key_columns=1)
+
+
+class TestBatcherUnit:
+    def test_batches_within_window(self):
+        sent = []
+
+        def send(addr, items):
+            sent.append((addr, list(items)))
+            return [{"ok": i} for i in range(len(items))]
+
+        b = MultiRaftBatcher(send)
+        import threading
+        out = {}
+
+        def go(i):
+            out[i] = b.submit("a:1", f"s/{i}", {"n": i})
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(out) == 8
+        # all 8 heartbeats rode far fewer RPCs than 8 (same window)
+        assert 1 <= len(sent) <= 3, [len(s[1]) for s in sent]
+        assert sum(len(s[1]) for s in sent) == 8
+        b.stop()
+
+    def test_per_item_failure_isolated(self):
+        def send(addr, items):
+            return [{"err": "gone"} if d == "s/bad" else {"ok": 1}
+                    for d, _r in items]
+
+        b = MultiRaftBatcher(send)
+        import threading
+        errs, oks = [], []
+
+        def good():
+            oks.append(b.submit("a:1", "s/good", {}))
+
+        def bad():
+            try:
+                b.submit("a:1", "s/bad", {})
+            except PeerUnreachable as e:
+                errs.append(e)
+        t1, t2 = threading.Thread(target=good), threading.Thread(target=bad)
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert oks == [{"ok": 1}] and len(errs) == 1
+        b.stop()
+
+    def test_batch_send_failure_fans_out(self):
+        def send(addr, items):
+            raise PeerUnreachable("down")
+        b = MultiRaftBatcher(send)
+        with pytest.raises(PeerUnreachable):
+            b.submit("a:1", "s/x", {})
+        b.stop()
+
+
+@pytest.mark.slow
+def test_heartbeat_messages_scale_with_peers_not_tablets(tmp_path):
+    """A server leading T tablets with followers on one other server must
+    send O(1) heartbeat RPCs per interval, not O(T)."""
+    flags.set_flag("replication_factor", 2)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=2,
+        fs_root=str(tmp_path / "mrb"))).start()
+    try:
+        client = c.new_client()
+        client.create_namespace("db")
+        # 12 tablets across 2 servers
+        t = client.create_table("db", "many", SCHEMA, num_tablets=12)
+        c.wait_all_replicas_running(t.table_id)
+        time.sleep(0.5)   # settle into heartbeat-only steady state
+        b0 = c.tservers[0].transport.batcher
+        b1 = c.tservers[1].transport.batcher
+        hb0, ba0 = b0.heartbeats_in, b0.batches_out
+        hb1, ba1 = b1.heartbeats_in, b1.batches_out
+        time.sleep(2.0)
+        hbs = (b0.heartbeats_in - hb0) + (b1.heartbeats_in - hb1)
+        rpcs = (b0.batches_out - ba0) + (b1.batches_out - ba1)
+        assert hbs > 50, "expected a steady heartbeat stream"
+        # O(tablets) heartbeats collapsed into far fewer wire messages;
+        # with a 3ms window and 50ms interval the floor is ~2 RPCs per
+        # interval per direction — assert at least 3x collapse
+        assert rpcs * 3 <= hbs, (hbs, rpcs)
+    finally:
+        c.shutdown()
+        flags.set_flag("replication_factor", 3)
+
+
+class TestCompression:
+    def test_large_frames_roundtrip_compressed(self):
+        m1 = Messenger("srv")
+
+        class Echo:
+            def echo(self, blob: bytes) -> dict:
+                return {"blob": blob, "n": len(blob)}
+        m1.register_service("echo", Echo())
+        m2 = Messenger("cli")
+        try:
+            blob = b"the quick brown fox " * 8192   # ~160KB, compressible
+            resp = m2.call(m1.address, "echo", "echo", blob=blob)
+            assert resp["blob"] == blob
+            # below threshold passes untouched
+            small = b"x" * 100
+            assert m2.call(m1.address, "echo", "echo",
+                           blob=small)["blob"] == small
+            # incompressible data must still round-trip (stored raw when
+            # compression does not shrink it)
+            import os as _os
+            rnd = _os.urandom(200_000)
+            assert m2.call(m1.address, "echo", "echo",
+                           blob=rnd)["blob"] == rnd
+        finally:
+            m2.shutdown()
+            m1.shutdown()
+
+    def test_disabled_by_flag(self):
+        flags.set_flag("rpc_compression_min_bytes", 0)
+        try:
+            m1 = Messenger("srv2")
+
+            class Echo:
+                def echo(self, blob: bytes) -> dict:
+                    return {"blob": blob}
+            m1.register_service("echo", Echo())
+            m2 = Messenger("cli2")
+            try:
+                blob = b"z" * 100_000
+                assert m2.call(m1.address, "echo", "echo",
+                               blob=blob)["blob"] == blob
+            finally:
+                m2.shutdown()
+                m1.shutdown()
+        finally:
+            flags.set_flag("rpc_compression_min_bytes", 32 << 10)

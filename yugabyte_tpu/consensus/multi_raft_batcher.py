@@ -1,0 +1,124 @@
+"""MultiRaftBatcher: cross-tablet consensus heartbeat batching.
+
+A tserver hosting T tablets whose leaders share a follower server sends
+T independent AppendEntries heartbeats per interval to that server —
+O(tablets x peers) messages of ~nothing (ref:
+src/yb/consensus/multi_raft_batcher.cc, motivated by exactly this fan-out).
+
+This batcher collapses them: per DESTINATION SERVER, heartbeat-shaped
+requests (no entries) arriving within a short window ride ONE
+`multi_update_consensus` RPC carrying [(dst_peer, req), ...]; the remote
+ConsensusService dispatches each to its tablet's RaftConsensus and returns
+the responses positionally.  Data-bearing AppendEntries never wait here —
+batching them would tax write latency for no message-count win (each
+already carries a meaningful payload).
+
+The caller's thread blocks on its slot future, so per-tablet raft code is
+unchanged: the batcher is purely a transport-level coalescer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from yugabyte_tpu.consensus.transport import PeerUnreachable
+from yugabyte_tpu.utils import flags
+
+flags.define_flag("multi_raft_batch_window_ms", 3,
+                  "consensus heartbeats to one destination server within "
+                  "this window share one multi_update_consensus RPC "
+                  "(ref multi_raft_heartbeat_interval_ms); 0 disables "
+                  "batching")
+flags.define_flag("multi_raft_batch_max", 256,
+                  "max heartbeats per batched RPC")
+
+
+class _Slot:
+    __slots__ = ("event", "resp", "err")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.resp = None
+        self.err: Optional[Exception] = None
+
+
+class MultiRaftBatcher:
+    """One per server process; groups heartbeats by destination address."""
+
+    def __init__(self, send_batch: Callable[[str, List[Tuple[str, dict]]],
+                                            List[dict]]):
+        """send_batch(addr, [(dst_peer, wire_req), ...]) -> [wire_resp,...]
+        (positional; an item-level failure is a dict with key 'err')."""
+        self._send_batch = send_batch
+        self._lock = threading.Lock()
+        self._queues: Dict[str, List[Tuple[str, dict, _Slot]]] = {}
+        self._timers: Dict[str, threading.Timer] = {}
+        self._stopped = False
+        # observability: how many heartbeats rode how many RPCs
+        self.heartbeats_in = 0
+        self.batches_out = 0
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            timers = list(self._timers.values())
+            self._timers.clear()
+        for t in timers:
+            t.cancel()
+
+    def submit(self, addr: str, dst_peer: str, wire_req: dict,
+               timeout_s: float = 10.0) -> dict:
+        """Enqueue one heartbeat for addr; blocks until its response."""
+        window = flags.get_flag("multi_raft_batch_window_ms") / 1000.0
+        slot = _Slot()
+        flush_now = False
+        with self._lock:
+            if self._stopped:
+                raise PeerUnreachable(f"{dst_peer}: batcher stopped")
+            q = self._queues.setdefault(addr, [])
+            q.append((dst_peer, wire_req, slot))
+            self.heartbeats_in += 1
+            if len(q) >= flags.get_flag("multi_raft_batch_max"):
+                flush_now = True
+            elif addr not in self._timers:
+                t = threading.Timer(window, self._flush, args=(addr,))
+                t.daemon = True
+                self._timers[addr] = t
+                t.start()
+        if flush_now:
+            self._flush(addr)
+        if not slot.event.wait(timeout_s):
+            raise PeerUnreachable(f"{dst_peer}@{addr}: batched heartbeat "
+                                  f"timed out")
+        if slot.err is not None:
+            raise slot.err
+        return slot.resp
+
+    def _flush(self, addr: str) -> None:
+        with self._lock:
+            timer = self._timers.pop(addr, None)
+            batch = self._queues.pop(addr, [])
+        if timer is not None:
+            timer.cancel()
+        if not batch:
+            return
+        self.batches_out += 1
+        try:
+            resps = self._send_batch(addr, [(d, r) for d, r, _s in batch])
+            if len(resps) != len(batch):
+                raise PeerUnreachable(
+                    f"{addr}: batched response arity mismatch "
+                    f"({len(resps)} != {len(batch)})")
+        except Exception as e:  # noqa: BLE001 — fan the failure out
+            for _d, _r, slot in batch:
+                slot.err = e if isinstance(e, PeerUnreachable) \
+                    else PeerUnreachable(f"{addr}: {e}")
+                slot.event.set()
+            return
+        for (dst, _r, slot), resp in zip(batch, resps):
+            if isinstance(resp, dict) and "err" in resp:
+                slot.err = PeerUnreachable(f"{dst}@{addr}: {resp['err']}")
+            else:
+                slot.resp = resp
+            slot.event.set()

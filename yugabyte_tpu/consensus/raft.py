@@ -282,6 +282,12 @@ class RaftConsensus:
         self.role = Role.FOLLOWER
         self.leader_id: Optional[str] = None
         self._entries: Dict[int, ReplicateMsg] = {}
+        # index -> ht_value, surviving CACHE eviction (trimmed separately):
+        # the propagated-safe-time clamp must see the HT of EVERY entry a
+        # lagging peer has not received — reading a cache-evicted tail as
+        # "no constraint" let a restarted follower's safe time run ahead
+        # of its data (caught by the linked-list churn harness)
+        self._ht_by_index: Dict[int, int] = {}
         self._last_index = 0
         self._last_term = 0
         self._local_durable_index = 0
@@ -325,6 +331,7 @@ class RaftConsensus:
         for e in reader.read_all():
             msg = ReplicateMsg.from_log_entry(e)
             self._entries[msg.index] = msg
+            self._ht_by_index[msg.index] = msg.ht_value
             self._last_index = msg.index
             self._last_term = msg.term
             if self.on_append_cb is not None:
@@ -614,6 +621,8 @@ class RaftConsensus:
             if self.role != Role.LEADER:
                 raise NotLeader(self.leader_id)
             msg = self._append_unlocked(op_type, ht_value, payload)
+        from yugabyte_tpu.utils import sync_point
+        sync_point.hit("raft.replicate:after_local_append")
         for ev in self._peer_events.values():
             ev.set()
         deadline = time.monotonic() + timeout_s
@@ -648,6 +657,7 @@ class RaftConsensus:
         index = self._last_index + 1
         msg = ReplicateMsg(self._meta.term, index, op_type, ht_value, payload)
         self._entries[index] = msg
+        self._ht_by_index[index] = ht_value
         self._last_index = index
         self._last_term = msg.term
         if self.on_append_cb is not None:
@@ -694,6 +704,9 @@ class RaftConsensus:
     # peers; everything older falls back to (segment-skipping) WAL reads.
     _CACHE_HIGH_WATER = 4096
     _CACHE_TAIL = 1024
+    # beyond this lag, safe-time propagation to a peer freezes rather than
+    # scanning an unbounded tail per request
+    _SAFE_TIME_SCAN_CAP = 65536
 
     def _maybe_evict_cache_unlocked(self) -> None:
         """Bound the in-memory entry cache (ref consensus/log_cache.cc):
@@ -701,6 +714,17 @@ class RaftConsensus:
         the WAL on demand. Only a LEADER gates eviction on peer match
         indexes — a follower has no peers to serve, and its empty
         _match_index map must not pin the floor at 0 forever."""
+        if len(self._ht_by_index) > 2 * self._CACHE_HIGH_WATER:
+            # the HT sidecar map trims by the same rule but keeps a deeper
+            # tail: it still serves the safe-time clamp for lagging peers
+            floor = self.last_applied - self._SAFE_TIME_SCAN_CAP
+            if self.role == Role.LEADER:
+                floor = min([floor] + [self._match_index.get(p, 0)
+                                       for p in self.config.remote_peers])
+            if floor > 0:
+                for i in list(self._ht_by_index):
+                    if i < floor:
+                        del self._ht_by_index[i]
         if len(self._entries) <= self._CACHE_HIGH_WATER:
             return
         floor = self.last_applied - self._CACHE_TAIL
@@ -810,14 +834,31 @@ class RaftConsensus:
         # Propagated safe time: never past any entry this peer is still
         # missing (it would expose follower reads to missing data). Raft
         # index order need not match hybrid-time order across concurrent
-        # writers, so take the min HT over the whole unsent tail.
+        # writers, so take the min HT over the whole unsent tail — from
+        # _ht_by_index, which unlike the entry cache is never evicted
+        # while a peer may still need it. An unknown tail HT (or a peer
+        # more than _SAFE_TIME_SCAN_CAP behind) freezes propagation
+        # instead of guessing: a follower that far back must not serve
+        # reads anyway, and 0 leaves its safe time unchanged.
         safe = self.safe_time_provider()
-        unsent = (self._entries[i].ht_value
-                  for i in range(sent_up_to + 1, self._last_index + 1)
-                  if i in self._entries and self._entries[i].ht_value > 0)
-        unsent_min = min(unsent, default=0)
-        if unsent_min:
-            safe = min(safe, unsent_min - 1)
+        tail = self._last_index - sent_up_to
+        if tail > self._SAFE_TIME_SCAN_CAP:
+            safe = 0
+        else:
+            unsent_min = 0
+            for i in range(sent_up_to + 1, self._last_index + 1):
+                ht = self._ht_by_index.get(i)
+                if ht is None:
+                    e = self._entries.get(i)
+                    ht = e.ht_value if e is not None else None
+                if ht is None:
+                    safe = 0
+                    break
+                if ht > 0 and (unsent_min == 0 or ht < unsent_min):
+                    unsent_min = ht
+            else:
+                if unsent_min:
+                    safe = min(safe, unsent_min - 1)
         lease_s = flags.get_flag("ht_lease_duration_ms") / 1000.0
         return AppendEntriesReq(
             term=self._meta.term, leader_id=self.config.peer_id,
@@ -984,6 +1025,7 @@ class RaftConsensus:
                             "attempt to truncate committed entries")
                     for i in range(msg.index, self._last_index + 1):
                         self._entries.pop(i, None)
+                        self._ht_by_index.pop(i, None)
                     self.log.truncate_after(msg.index - 1)
                     self._last_index = msg.index - 1
                     self._last_term = self._term_at_unlocked(self._last_index)
@@ -1001,6 +1043,7 @@ class RaftConsensus:
                     self._revert_config_unlocked(self._last_index)
                 to_append.append(msg)
                 self._entries[msg.index] = msg
+                self._ht_by_index[msg.index] = msg.ht_value
                 self._last_index = msg.index
                 self._last_term = msg.term
                 if self.on_append_cb is not None:

@@ -95,6 +95,19 @@ class ConsensusService:
         return {"responder_id": resp.responder_id, "term": resp.term,
                 "granted": resp.granted}
 
+    def multi_update_consensus(self, items: list) -> dict:
+        """Batched cross-tablet heartbeats (ref multi_raft_batcher.cc):
+        [(dst_peer, wire_req), ...] -> positional responses; per-item
+        failures come back as {'err': ...} so one dead tablet cannot fail
+        its whole batch."""
+        out = []
+        for dst, req in items:
+            try:
+                out.append(self.update_consensus(dst, req))
+            except Exception as e:  # noqa: BLE001 — isolate per item
+                out.append({"err": repr(e)})
+        return {"resps": out}
+
 
 class RpcTransport:
     """Client-side consensus transport seam over the Messenger.
@@ -105,10 +118,23 @@ class RpcTransport:
 
     def __init__(self, messenger: Messenger,
                  resolver: Callable[[str], Optional[str]]):
+        from yugabyte_tpu.consensus.multi_raft_batcher import (
+            MultiRaftBatcher)
         self._messenger = messenger
         self._resolver = resolver
         self._service = ConsensusService()
         messenger.register_service(SERVICE_NAME, self._service)
+        # cross-tablet heartbeat coalescing (one per server process)
+        self.batcher = MultiRaftBatcher(self._send_batch)
+
+    def _send_batch(self, addr: str, items):
+        try:
+            w = self._messenger.call(addr, SERVICE_NAME,
+                                     "multi_update_consensus",
+                                     items=[[d, r] for d, r in items])
+        except (RpcTimeout, ServiceUnavailable, RemoteError) as e:
+            raise PeerUnreachable(f"batch@{addr}: {e}") from e
+        return w["resps"]
 
     def register(self, peer_id: str, consensus: object) -> None:
         self._service.register(peer_id, consensus)
@@ -129,7 +155,20 @@ class RpcTransport:
     # ------------------------------------------------------------- dispatch
     def update_consensus(self, src: str, dst: str,
                          request: AppendEntriesReq) -> AppendEntriesResp:
-        w = self._call(dst, "update_consensus", append_req_to_wire(request))
+        from yugabyte_tpu.utils import flags as _flags
+        if (not request.entries
+                and _flags.get_flag("multi_raft_batch_window_ms") > 0):
+            # empty AppendEntries = heartbeat: coalesce across tablets
+            # sharing this destination server (multi_raft_batcher.py);
+            # data-bearing requests never wait in the batch window
+            addr = self._resolver(dst)
+            if addr is None:
+                raise PeerUnreachable(f"{dst}: no address known")
+            w = self.batcher.submit(addr, dst,
+                                    append_req_to_wire(request))
+        else:
+            w = self._call(dst, "update_consensus",
+                           append_req_to_wire(request))
         return AppendEntriesResp(
             responder_id=w["responder_id"], term=w["term"],
             success=w["success"],

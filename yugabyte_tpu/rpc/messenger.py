@@ -48,6 +48,10 @@ flags.define_flag("rpc_service_pool_threads", 64,
                   "(consensus waits, scans) do not starve the pool")
 flags.define_flag("rpc_default_timeout_s", 15.0,
                   "default outbound call deadline")
+flags.define_flag("rpc_compression_min_bytes", 32 << 10,
+                  "zlib-compress RPC frames at or above this size "
+                  "(remote bootstrap, CDC, big scan pages; ref "
+                  "rpc/compressed_stream.cc); 0 disables")
 flags.define_flag("rpc_connect_timeout_s", 5.0,
                   "TCP connect timeout for outbound connections")
 
@@ -186,10 +190,35 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+_COMPRESS_BIT = 0x80000000
+
+
 def _send_frame(sock: socket.socket, lock: threading.Lock,
                 payload: bytes) -> None:
+    """One frame: [u32 LE length][payload]; bit 31 of the length marks a
+    zlib-compressed payload (ref rpc/compressed_stream.cc — bulk traffic
+    like remote bootstrap chunks, CDC batches and big scan pages shrinks
+    several-fold; small frames skip the codec cost)."""
+    import zlib
+    min_bytes = flags.get_flag("rpc_compression_min_bytes")
+    if min_bytes and len(payload) >= min_bytes:
+        packed = zlib.compress(payload, 1)
+        if len(packed) < len(payload):
+            with lock:
+                sock.sendall(_LEN.pack(len(packed) | _COMPRESS_BIT)
+                             + packed)
+            return
     with lock:
         sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    import zlib
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    body = _recv_exact(sock, n & ~_COMPRESS_BIT)
+    if n & _COMPRESS_BIT:
+        body = zlib.decompress(body)
+    return body
 
 
 class _ClientConnection:
@@ -215,8 +244,7 @@ class _ClientConnection:
     def _read_loop(self) -> None:
         try:
             while True:
-                (n,) = _LEN.unpack(_recv_exact(self.sock, _LEN.size))
-                resp = loads(_recv_exact(self.sock, n))
+                resp = loads(_recv_frame(self.sock))
                 with self.lock:
                     waiter = self.pending.pop(resp["id"], None)
                 if waiter is not None:
@@ -361,8 +389,7 @@ class Messenger:
                 return
         try:
             while True:
-                (n,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
-                req = loads(_recv_exact(conn, n))
+                req = loads(_recv_frame(conn))
                 # Handlers run off-connection so one slow handler does not
                 # head-of-line-block the connection; the pool reuses
                 # workers (the reference's ServicePool).

@@ -472,8 +472,11 @@ class Tablet:
                 (rkey, DocHybridTime(HybridTime(resolution_ht_value), seq),
                  tomb))
             seq += 1
+        from yugabyte_tpu.utils import sync_point
+        sync_point.hit("tablet.apply_txn:before_regular_write")
         if regular_items:
             self.regular_db.write_batch(regular_items, op_id=op_id)
+        sync_point.hit("tablet.apply_txn:between_dbs")
         if tombstones:
             self.intents_db.write_batch(tombstones, op_id=op_id)
         TRACE("tablet %s: txn %s %s — %d applied, %d intents resolved",

@@ -169,7 +169,15 @@ class TabletPeer:
             on_role_change=self._on_role_change,
             clock=self.clock,
             on_append_cb=self._on_entry_appended)
-        transport.register(config.peer_id, self.raft)
+        # Registration is DEFERRED to start(), after bootstrap: serving
+        # AppendEntries while bootstrap replays lets the leader's catch-up
+        # race the replay — set_bootstrap_state then jumps last_applied
+        # past entries the racing apply loop never applied, permanently
+        # losing a window of rows on this replica (found by the
+        # linked-list churn harness; ref: the reference only serves
+        # consensus once the tablet reaches RUNNING state,
+        # tablet_peer.cc state gating).
+        self._transport = transport
         self.tablet.consensus = RaftWriteContext(self)
         self.tablet.mvcc.set_leader_mode(False)
         # Split hook: the tablet manager creates the child tablets when the
@@ -188,6 +196,7 @@ class TabletPeer:
         replay_from = flushed_min + 1
         replayed = 0
         max_ht = 0
+        applied_up_to = flushed_min
         # Flushed storage implies those entries were committed; the floor
         # may exceed the (non-fsynced) one recovered from metadata.
         committed_floor = max(self.raft.commit_index, flushed_min)
@@ -207,9 +216,13 @@ class TabletPeer:
             if msg.index > committed_floor:
                 break  # pending tail: Raft decides its fate later
             self._apply_replicated(msg)
+            applied_up_to = msg.index
             replayed += 1
             max_ht = max(max_ht, msg.ht_value)
-        self.raft.set_bootstrap_state(committed_floor)
+        # report what was ACTUALLY applied (flushed state + replay), never
+        # the aspirational floor: claiming more would mark unapplied
+        # entries applied and lose their rows on this replica forever
+        self.raft.set_bootstrap_state(applied_up_to)
         if max_ht:
             ht = HybridTime(max_ht)
             self.clock.update(ht)
@@ -220,6 +233,9 @@ class TabletPeer:
 
     def start(self, election_timer: bool = True) -> "TabletPeer":
         self.bootstrap()
+        # only NOW serve consensus traffic (see __init__: registering
+        # before bootstrap races leader catch-up against WAL replay)
+        self._transport.register(self.raft.config.peer_id, self.raft)
         self.raft.start(election_timer=election_timer)
         return self
 

@@ -337,6 +337,7 @@ class TabletServer:
         for p in pollers:
             p.stop()
         self.heartbeater.stop()
+        self.transport.batcher.stop()
         self.memory_manager.shutdown()
         self.maintenance_manager.shutdown()
         if self.webserver is not None:

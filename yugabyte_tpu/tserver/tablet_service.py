@@ -198,6 +198,28 @@ class TabletServiceImpl:
                 break
         return {"rows": rows, "resume_key": resume_key, "read_ht": ht.value}
 
+    def dump_tablet(self, tablet_id: str, read_ht: int,
+                    limit: int = 100_000) -> dict:
+        """Resolved rows of THIS replica at read_ht (leader or follower) —
+        the row-level companion of checksum_tablet for divergence
+        debugging (ysck deep mode / cluster_verifier forensics)."""
+        peer = self._tablets.get_tablet(tablet_id)
+        peer.tablet.mvcc.safe_time(min_allowed=HybridTime(read_ht))
+        rows = []
+        for row in peer.tablet.scan(HybridTime(read_ht), use_device=False):
+            rows.append([row.doc_key.encode(),
+                         repr(sorted(row.columns.items())),
+                         row.write_ht.value])
+            if len(rows) >= limit:
+                break
+        raft = peer.raft
+        return {"rows": rows,
+                "raft": {"role": raft.role.value,
+                         "term": raft.current_term,
+                         "commit_index": raft.commit_index,
+                         "last_applied": raft.last_applied,
+                         "last_index": raft._last_index}}
+
     def checksum_tablet(self, tablet_id: str, read_ht: int) -> dict:
         """Order-independent digest of the VISIBILITY-RESOLVED rows at
         read_ht on THIS replica (leader or follower) — the cross-replica
