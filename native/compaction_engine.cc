@@ -19,6 +19,7 @@
 
 #include <zlib.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -220,6 +221,111 @@ void ce_job_add_input(void* jp, const uint8_t* data, int64_t size,
   for (int32_t b = 0; b < n_blocks; ++b)
     f.handles.push_back({offs[b], sizes[b], counts[b]});
   j->inputs.push_back(std::move(f));
+}
+
+// Ingest path: fill the job's SoA straight from one packed run (the flush
+// job / bulk load, ref: db/flush_job.cc WriteLevel0Table + memtable.cc).
+// keys_blob/key_offs hold the raw key-prefix bytes; ht/wid the
+// DocHybridTime columns; vals_blob/val_offs the value payloads. flags,
+// ttl and doc_key_len are derived NATIVELY from the value control fields
+// (docdb/value.py: optional 'k'+4B merge flags, 't'+8B TTL, then the
+// payload tag) and the DocKey structure parser below, so Python's
+// per-entry work drops to blob concatenation.
+void ce_job_add_raw(void* jp, const uint8_t* keys_blob,
+                    const int64_t* key_offs, int64_t n, const uint64_t* ht,
+                    const uint32_t* wid, const uint8_t* vals_blob,
+                    const int64_t* val_offs) {
+  Job* j = (Job*)jp;
+  int32_t stride = 4;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t kl = (int32_t)(key_offs[i + 1] - key_offs[i]);
+    if (kl > stride) stride = kl;
+  }
+  stride = (stride + 3) & ~3;
+  j->n = n;
+  j->stride = stride;
+  j->keys.assign((size_t)n * stride, 0);
+  j->key_len.resize(n);
+  j->dkl.resize(n);
+  j->ht.assign(ht, ht + n);
+  j->wid.assign(wid, wid + n);
+  j->flags.resize(n);
+  j->ttl_ms.resize(n);
+  j->val_ptr.resize(n);
+  j->val_len.resize(n);
+  j->run_offsets = {0, n};
+  pfor(n, j->n_threads, [&](int64_t i) {
+    const uint8_t* k = keys_blob + key_offs[i];
+    int32_t kl = (int32_t)(key_offs[i + 1] - key_offs[i]);
+    memcpy(&j->keys[i * stride], k, kl);
+    j->key_len[i] = kl;
+    int32_t d = ybtpu::doc_key_len(k, kl);
+    j->dkl[i] = d;
+    const uint8_t* v = vals_blob + val_offs[i];
+    int64_t vl = val_offs[i + 1] - val_offs[i];
+    j->val_ptr[i] = v;
+    j->val_len[i] = (uint32_t)vl;
+    // control fields + payload tag -> slab flags (ops/slabs.py pack_kvs)
+    uint8_t fl = 0;
+    int64_t ttl = 0;
+    int64_t pos = 0;
+    if (pos + 5 <= vl && v[pos] == 'k') pos += 5;        // kMergeFlags
+    if (pos + 9 <= vl && v[pos] == 't') {                // kTTL (ms, >q BE)
+      int64_t t = 0;
+      for (int b = 1; b <= 8; ++b) t = (t << 8) | v[pos + b];
+      ttl = t;
+      fl |= 4;
+      pos += 9;
+    }
+    if (pos < vl) {
+      uint8_t tag = v[pos];
+      if (tag == 'X') fl |= 1;          // kTombstone
+      else if (tag == '{') fl |= 2;     // kObject
+    }
+    if (kl > d && ybtpu::subkey_depth(k, kl, d) > 1) fl |= 8;  // FLAG_DEEP
+    j->flags[i] = fl;
+    j->ttl_ms[i] = ttl;
+  });
+}
+
+// Accept the run as already internal-key-ordered, or sort it (stable) by
+// (key asc, ht desc, wid desc). Flush inputs arrive sorted from the
+// memtable; bulk loads may not. Returns survivor count (= n: no GC here).
+int64_t ce_job_sort_all(void* jp) {
+  Job* j = (Job*)jp;
+  int64_t n = j->n;
+  ybtpu::Ctx c{j->keys.data(), j->key_len.data(), j->stride, j->ht.data(),
+               j->wid.data()};
+  bool sorted = true;
+  for (int64_t i = 1; i < n; ++i) {
+    if (ybtpu::cmp_entries(c, i - 1, i) > 0) { sorted = false; break; }
+  }
+  j->surv.resize(n);
+  for (int64_t i = 0; i < n; ++i) j->surv[i] = i;
+  if (!sorted) {
+    std::stable_sort(j->surv.begin(), j->surv.end(),
+                     [&](int64_t a, int64_t b) {
+                       return ybtpu::cmp_entries(c, a, b) < 0;
+                     });
+  }
+  j->surv_mk.assign(n, 0);
+  return n;
+}
+
+// Whole-file props the base file needs (valid after add_raw or prepare):
+// max_expire_us (0 unless EVERY entry has a TTL) and has_deep.
+void ce_job_props(void* jp, uint64_t* max_expire_us, int32_t* has_deep) {
+  Job* j = (Job*)jp;
+  uint64_t mx = 0;
+  bool all_ttl = j->n > 0, deep = false;
+  for (int64_t i = 0; i < j->n; ++i) {
+    if (j->flags[i] & 8) deep = true;
+    if (!(j->flags[i] & 4)) { all_ttl = false; continue; }
+    uint64_t e = (j->ht[i] >> 12) + (uint64_t)j->ttl_ms[i] * 1000;
+    if (e > mx) mx = e;
+  }
+  *max_expire_us = all_ttl ? mx : 0;
+  *has_deep = deep ? 1 : 0;
 }
 
 // Decode every block of every input (parallel). Returns total rows, -1 on

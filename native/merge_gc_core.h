@@ -42,6 +42,62 @@ inline int cmp_entries(const Ctx& c, int64_t a, int64_t b) {
   return 0;
 }
 
+// Skip one encoded key component starting at *pos (tag + payload).
+// Tags per docdb/value_type.py; zero-encoded strings per doc_kv_util.h:95.
+inline bool skip_key_component(const uint8_t* k, int32_t len, int32_t* pos) {
+  if (*pos >= len) return false;
+  uint8_t tag = k[(*pos)++];
+  switch (tag) {
+    case '$': case 'F': case 'T': return true;     // null / false / true
+    case 'H': *pos += 4; break;                    // int32
+    case 'I': case 'D': *pos += 8; break;          // int64 / double
+    case 'J': case 'K': *pos += 2; break;          // system / column id
+    case 'S': case 'Y':                            // zero-encoded bytes
+      for (;;) {
+        if (*pos + 1 > len) return false;
+        if (k[*pos] != 0) { ++*pos; continue; }
+        if (*pos + 2 > len) return false;
+        if (k[*pos + 1] == 0) { *pos += 2; return true; }
+        if (k[*pos + 1] == 1) { *pos += 2; continue; }
+        return false;
+      }
+    default:
+      return false;
+  }
+  return *pos <= len;
+}
+
+// Byte length of the DocKey portion of key_prefix (through the range-group
+// kGroupEnd '!'), or len when the prefix is not a doc key — system keys
+// count as one whole-key "document" (docdb/doc_key.py _doc_key_len).
+inline int32_t doc_key_len(const uint8_t* k, int32_t len) {
+  int32_t pos = 0;
+  if (pos < len && k[pos] == 'G') {  // kUInt16Hash + 2-byte hash
+    pos += 3;
+    while (pos < len && k[pos] != '!') {
+      if (!skip_key_component(k, len, &pos)) return len;
+    }
+    if (pos >= len) return len;
+    ++pos;  // hashed kGroupEnd
+  }
+  while (pos < len && k[pos] != '!') {
+    if (!skip_key_component(k, len, &pos)) return len;
+  }
+  if (pos >= len) return len;
+  return pos + 1;  // range kGroupEnd
+}
+
+// Number of subkey components below the DocKey (slabs.py subkey_depth);
+// undecodable tails count as deep (conservative).
+inline int32_t subkey_depth(const uint8_t* k, int32_t len, int32_t d) {
+  int32_t pos = d, depth = 0;
+  while (pos < len) {
+    if (!skip_key_component(k, len, &pos)) return depth + 1;
+    ++depth;
+  }
+  return depth;
+}
+
 // Component end offsets of a SubDocKey: [dkl, end_of_subkey_1, ...] — the
 // reference's sub_key_ends_ (ref: SubDocKey::DecodeDocKeyAndSubKeyEnds).
 // Tag bytes per docdb/doc_key.py PrimitiveValue: fixed-width payloads or

@@ -1,0 +1,659 @@
+// Native read engine: the serving-path counterpart of compaction_engine.cc.
+//
+// The reference serves reads through BlockBasedTable (ref:
+// src/yb/rocksdb/table/block_based_table_reader.cc:1144-1286 — index seek,
+// bloom gate, in-block binary search) and merges sources through
+// MergingIterator (table/merger.cc:51). Round 4 measured the Python read
+// loop at 25 MB/s seq scan / 2.4K point reads/s — two to three orders below
+// reference class — because every entry paid Python block decode + tuple
+// construction. This engine keeps the whole byte path native:
+//
+//   - rs_open: one handle per SST over the raw data-file bytes (Python
+//     owns the buffer; the env layer already decrypted it). Blocks are
+//     viewed IN PLACE — the columnar block layout (block_format.py) needs
+//     no row reassembly, so an uncompressed block costs zero copies to
+//     serve; zlib blocks decompress once into a cached owned buffer.
+//   - rs_multi_get: bloom-gated point lookup across many SSTs in ONE
+//     native call (fnv hash once, per-SST index seek + in-place binary
+//     search, newest-visible-version wins).
+//   - rs_scan_*: k-way heap merge over SST cursors plus an optional
+//     packed memtable overlay run, streaming batches of entries into
+//     caller buffers. Mode 0 emits the raw merged stream (iter_from);
+//     mode 1 resolves MVCC visibility inline (the native twin of
+//     DocRowwiseIterator._resolve_visible: first version <= read_ht per
+//     doc path wins; tombstone / TTL / overwrite shadowing applied).
+//
+// Build: g++ -O3 -shared -fPIC -o libread_engine.so read_engine.cc -lz
+#include <zlib.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "merge_gc_core.h"
+
+namespace {
+
+using ybtpu::doc_key_len;
+
+constexpr uint32_t kBlockMagic = 0x53425459;  // "YTBS"
+constexpr int kHeaderLen = 24;
+
+inline uint32_t rd_u32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+inline uint16_t rd_u16(const uint8_t* p) {
+  uint16_t v;
+  memcpy(&v, p, 2);
+  return v;
+}
+
+// FNV-1a over the first len bytes — matches storage/bloom.py fnv64_masked.
+inline uint64_t fnv1a(const uint8_t* p, int32_t len) {
+  uint64_t h = 0xCBF29CE484222325ULL;
+  for (int32_t i = 0; i < len; ++i) h = (h ^ p[i]) * 0x100000001B3ULL;
+  return h;
+}
+
+// ---- in-place block view ---------------------------------------------------
+struct View {
+  std::atomic<bool> ready{false};
+  const uint8_t* keys = nullptr;
+  const uint8_t* klq = nullptr;
+  const uint8_t* dklq = nullptr;
+  const uint8_t* hthq = nullptr;
+  const uint8_t* htlq = nullptr;
+  const uint8_t* widq = nullptr;
+  const uint8_t* flq = nullptr;
+  const uint8_t* ttlq = nullptr;
+  const uint8_t* voq = nullptr;
+  const uint8_t* vb = nullptr;
+  uint32_t n = 0;
+  uint32_t stride = 0;
+  std::unique_ptr<std::vector<uint8_t>> owned;  // decompressed body
+
+  inline const uint8_t* key_ptr(uint32_t i) const { return keys + (int64_t)i * stride; }
+  inline int32_t key_len(uint32_t i) const { return rd_u16(klq + 2 * i); }
+  inline int32_t dkl(uint32_t i) const { return rd_u16(dklq + 2 * i); }
+  inline uint64_t ht(uint32_t i) const {
+    return ((uint64_t)rd_u32(hthq + 4 * i) << 32) | rd_u32(htlq + 4 * i);
+  }
+  inline uint32_t wid(uint32_t i) const { return rd_u32(widq + 4 * i); }
+  inline uint8_t flags(uint32_t i) const { return flq[i]; }
+  inline int64_t ttl_ms(uint32_t i) const {
+    int64_t t;
+    memcpy(&t, ttlq + 8 * i, 8);
+    return t;
+  }
+  inline const uint8_t* val_ptr(uint32_t i) const { return vb + rd_u32(voq + 4 * i); }
+  inline uint32_t val_len(uint32_t i) const {
+    return rd_u32(voq + 4 * (i + 1)) - rd_u32(voq + 4 * i);
+  }
+};
+
+struct BlockHandle {
+  int64_t off;
+  int32_t size;
+  int32_t count;
+};
+
+struct Reader {
+  const uint8_t* data;
+  int64_t size;
+  std::vector<BlockHandle> handles;
+  const uint8_t* index_blob;          // concatenated per-block last keys
+  std::vector<int32_t> index_offs;    // n_blocks + 1
+  uint32_t bloom_k = 0;
+  uint64_t bloom_m = 0;
+  const uint8_t* bloom_bits = nullptr;
+  std::vector<View> views;
+  std::mutex mu;  // guards view fill
+  std::string error;
+
+  const uint8_t* index_key(int32_t b, int32_t* len) const {
+    *len = index_offs[b + 1] - index_offs[b];
+    return index_blob + index_offs[b];
+  }
+
+  bool may_contain(uint64_t h) const {
+    if (!bloom_bits || bloom_m == 0) return true;
+    uint64_t h1 = h & 0xFFFFFFFFULL;
+    uint64_t h2 = (h >> 32) | 1ULL;
+    for (uint32_t i = 0; i < bloom_k; ++i) {
+      uint64_t pos = (h1 + (uint64_t)i * h2) % bloom_m;
+      if (!((bloom_bits[pos >> 3] >> (pos & 7)) & 1)) return false;
+    }
+    return true;
+  }
+
+  // Parse + (if needed) decompress block b; idempotent and thread-safe.
+  View* view(int32_t b) {
+    View* v = &views[b];
+    if (v->ready.load(std::memory_order_acquire)) return v;
+    std::lock_guard<std::mutex> lock(mu);
+    if (v->ready.load(std::memory_order_relaxed)) return v;
+    const BlockHandle& h = handles[b];
+    if (h.off + kHeaderLen > size) { error = "handle oob"; return nullptr; }
+    const uint8_t* p = data + h.off;
+    uint32_t magic = rd_u32(p), n = rd_u32(p + 4), stride = rd_u32(p + 8);
+    uint32_t bflags = rd_u32(p + 12), body_len = rd_u32(p + 16),
+             raw_len = rd_u32(p + 20);
+    if (magic != kBlockMagic || (int32_t)n != h.count ||
+        (int64_t)kHeaderLen + body_len + 4 > h.size) {
+      error = "bad block header";
+      return nullptr;
+    }
+    const uint8_t* stored = p + kHeaderLen;
+    uint32_t crc = rd_u32(stored + body_len);
+    uint32_t want = crc32(0, p + 4, kHeaderLen - 4);
+    want = crc32(want, stored, body_len);
+    if (crc != want) { error = "block crc mismatch"; return nullptr; }
+    const uint8_t* body = stored;
+    if (bflags & 1) {  // zlib: decompress once into an owned buffer
+      v->owned = std::make_unique<std::vector<uint8_t>>(raw_len);
+      uLongf dlen = raw_len;
+      if (uncompress(v->owned->data(), &dlen, stored, body_len) != Z_OK ||
+          dlen != raw_len) {
+        error = "block decompress failure";
+        v->owned.reset();
+        return nullptr;
+      }
+      body = v->owned->data();
+    }
+    const uint8_t* q = body;
+    v->keys = q;  q += (int64_t)n * stride;
+    v->klq = q;   q += 2 * (int64_t)n;
+    v->dklq = q;  q += 2 * (int64_t)n;
+    v->hthq = q;  q += 4 * (int64_t)n;
+    v->htlq = q;  q += 4 * (int64_t)n;
+    v->widq = q;  q += 4 * (int64_t)n;
+    v->flq = q;   q += (int64_t)n;
+    v->ttlq = q;  q += 8 * (int64_t)n;
+    v->voq = q;   q += 4 * ((int64_t)n + 1);
+    v->vb = q;
+    if (q - body > raw_len) { error = "block body oob"; return nullptr; }
+    v->n = n;
+    v->stride = stride;
+    v->ready.store(true, std::memory_order_release);
+    return v;
+  }
+
+  // First block whose last_key >= key.
+  int32_t seek_block(const uint8_t* key, int32_t klen) const {
+    int32_t lo = 0, hi = (int32_t)handles.size();
+    while (lo < hi) {
+      int32_t mid = (lo + hi) / 2;
+      int32_t il;
+      const uint8_t* ik = index_key(mid, &il);
+      int32_t m = il < klen ? il : klen;
+      int r = memcmp(ik, key, m);
+      if (r < 0 || (r == 0 && il < klen)) lo = mid + 1;
+      else hi = mid;
+    }
+    return lo;
+  }
+};
+
+inline int cmp_keys(const uint8_t* a, int32_t la, const uint8_t* b, int32_t lb) {
+  int32_t m = la < lb ? la : lb;
+  int r = memcmp(a, b, m);
+  if (r) return r;
+  return la < lb ? -1 : (la > lb ? 1 : 0);
+}
+
+// Locate the newest version of `key` with ht <= read_ht in one SST.
+// Returns 1 + fills (*vp, *ip) on a match, 0 when absent, -1 on corruption.
+int reader_point_get(Reader* r, const uint8_t* key, int32_t klen,
+                     uint64_t read_ht, View** vp, uint32_t* ip) {
+  int32_t b = r->seek_block(key, klen);
+  while (b < (int32_t)r->handles.size()) {
+    View* v = r->view(b);
+    if (!v) return -1;
+    // first i with NOT (key_i < key  ||  (key_i == key && ht_i > read_ht))
+    uint32_t lo = 0, hi = v->n;
+    while (lo < hi) {
+      uint32_t mid = (lo + hi) / 2;
+      int c = cmp_keys(v->key_ptr(mid), v->key_len(mid), key, klen);
+      bool less = c < 0 || (c == 0 && v->ht(mid) > read_ht);
+      if (less) lo = mid + 1;
+      else hi = mid;
+    }
+    if (lo < v->n) {
+      if (cmp_keys(v->key_ptr(lo), v->key_len(lo), key, klen) == 0) {
+        *vp = v;
+        *ip = lo;
+        return 1;
+      }
+      return 0;  // seek landed past the key: not in this SST
+    }
+    ++b;  // whole block below the seek point (version chain spans blocks)
+  }
+  return 0;
+}
+
+// ---- scan: k-way merge + optional MVCC visibility --------------------------
+struct Cursor {
+  // SST source
+  Reader* r = nullptr;
+  int32_t b = 0;
+  uint32_t i = 0;
+  View* v = nullptr;
+  // packed overlay source (memtable)
+  const uint8_t* xkeys = nullptr;
+  const int64_t* xkoffs = nullptr;
+  const uint64_t* xht = nullptr;
+  const uint32_t* xwid = nullptr;
+  const uint8_t* xflags = nullptr;
+  const int64_t* xttl = nullptr;
+  const int32_t* xdkl = nullptr;
+  const uint8_t* xvals = nullptr;
+  const int64_t* xvoffs = nullptr;
+  int64_t xn = 0, xpos = 0;
+
+  // current entry (refreshed by load())
+  const uint8_t* k = nullptr;
+  int32_t klen = 0, dkl = 0;
+  uint64_t ht = 0;
+  uint32_t wid = 0;
+  uint8_t flags = 0;
+  int64_t ttl = 0;
+  const uint8_t* val = nullptr;
+  uint32_t vlen = 0;
+  bool done = false;
+  bool err = false;  // block corruption: surfaced, never silent EOF
+
+  bool load() {
+    if (r) {
+      while (true) {
+        if (b >= (int32_t)r->handles.size()) { done = true; return false; }
+        v = r->view(b);
+        if (!v) { done = true; err = true; return false; }
+        if (i < v->n) break;
+        ++b;
+        i = 0;
+      }
+      k = v->key_ptr(i);
+      klen = v->key_len(i);
+      dkl = v->dkl(i);
+      ht = v->ht(i);
+      wid = v->wid(i);
+      flags = v->flags(i);
+      ttl = v->ttl_ms(i);
+      val = v->val_ptr(i);
+      vlen = v->val_len(i);
+      return true;
+    }
+    if (xpos >= xn) { done = true; return false; }
+    int64_t p = xpos;
+    k = xkeys + xkoffs[p];
+    klen = (int32_t)(xkoffs[p + 1] - xkoffs[p]);
+    dkl = xdkl[p];
+    ht = xht[p];
+    wid = xwid[p];
+    flags = xflags[p];
+    ttl = xttl[p];
+    val = xvals + xvoffs[p];
+    vlen = (uint32_t)(xvoffs[p + 1] - xvoffs[p]);
+    return true;
+  }
+
+  void advance() {
+    if (r) ++i;
+    else ++xpos;
+    load();
+  }
+};
+
+// internal-key order: key asc, ht desc, wid desc
+inline bool cursor_less(const Cursor* a, const Cursor* b) {
+  int c = cmp_keys(a->k, a->klen, b->k, b->klen);
+  if (c) return c < 0;
+  if (a->ht != b->ht) return a->ht > b->ht;
+  return a->wid > b->wid;
+}
+
+struct Scan {
+  std::vector<std::unique_ptr<Cursor>> cursors;
+  std::vector<Cursor*> heap;
+  int mode = 0;  // 0 = raw merged stream, 1 = MVCC-visible entries
+  uint64_t read_ht = ~0ULL;
+  std::vector<uint8_t> upper;
+  bool has_upper = false;
+  bool done = false;
+  std::string error;
+
+  // raw modes: last emitted entry, for exact-duplicate suppression — a
+  // flush racing the Python-side overlay snapshot can surface the same
+  // (key, ht, wid) from both the memtable run and the fresh SST; legit
+  // data never repeats a full internal key (one DocHybridTime per write)
+  std::vector<uint8_t> last_key;
+  uint64_t last_ht = 0;
+  uint32_t last_wid = 0;
+  bool have_last = false;
+
+  // visibility state (mode 1) — twin of DocRowwiseIterator._resolve_visible
+  std::vector<uint8_t> cur_doc;
+  bool have_doc = false;
+  uint64_t ov_ht = 0;
+  uint32_t ov_wid = 0;
+  bool ov_set = false;
+  std::vector<std::string> seen_paths;
+
+  void heap_init() {
+    for (auto& c : cursors)
+      if (!c->done) heap.push_back(c.get());
+    for (int64_t i = (int64_t)heap.size() / 2 - 1; i >= 0; --i) sift_down(i);
+  }
+  void sift_down(int64_t i) {
+    int64_t sz = (int64_t)heap.size();
+    for (;;) {
+      int64_t l = 2 * i + 1, r = l + 1, s = i;
+      if (l < sz && cursor_less(heap[l], heap[s])) s = l;
+      if (r < sz && cursor_less(heap[r], heap[s])) s = r;
+      if (s == i) break;
+      std::swap(heap[i], heap[s]);
+      i = s;
+    }
+  }
+  // advance the top cursor and restore heap order; false on corruption
+  bool pop_advance() {
+    Cursor* c = heap[0];
+    c->advance();
+    if (c->err) {
+      error = c->r && !c->r->error.empty() ? c->r->error
+                                           : "block corruption in scan";
+      return false;
+    }
+    if (c->done) {
+      heap[0] = heap.back();
+      heap.pop_back();
+    }
+    if (!heap.empty()) sift_down(0);
+    return true;
+  }
+};
+
+// Seek one SST cursor to the first entry with key >= lower (any version).
+void cursor_seek(Cursor* c, const uint8_t* lower, int32_t llen) {
+  if (c->r) {
+    c->b = llen ? c->r->seek_block(lower, llen) : 0;
+    c->i = 0;
+    if (!c->load()) return;
+    if (!llen) return;
+    while (true) {
+      View* v = c->v;
+      uint32_t lo = 0, hi = v->n;
+      while (lo < hi) {
+        uint32_t mid = (lo + hi) / 2;
+        if (cmp_keys(v->key_ptr(mid), v->key_len(mid), lower, llen) < 0)
+          lo = mid + 1;
+        else
+          hi = mid;
+      }
+      if (lo < v->n) {
+        c->i = lo;
+        c->load();
+        return;
+      }
+      ++c->b;
+      c->i = 0;
+      if (!c->load()) return;
+    }
+  } else {
+    // packed overlay: binary search the key offsets
+    int64_t lo = 0, hi = c->xn;
+    while (lo < hi) {
+      int64_t mid = (lo + hi) / 2;
+      const uint8_t* k = c->xkeys + c->xkoffs[mid];
+      int32_t kl = (int32_t)(c->xkoffs[mid + 1] - c->xkoffs[mid]);
+      if (cmp_keys(k, kl, lower, llen) < 0) lo = mid + 1;
+      else hi = mid;
+    }
+    c->xpos = lo;
+    c->load();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// data / index_blob / bloom bytes stay Python-owned for the reader lifetime.
+void* rs_open(const uint8_t* data, int64_t size, const int64_t* offs,
+              const int32_t* sizes, const int32_t* counts, int32_t n_blocks,
+              const uint8_t* index_blob, const int32_t* index_offs,
+              const uint8_t* bloom, int64_t bloom_len) {
+  Reader* r = new Reader();
+  r->data = data;
+  r->size = size;
+  r->handles.reserve(n_blocks);
+  for (int32_t b = 0; b < n_blocks; ++b)
+    r->handles.push_back({offs[b], sizes[b], counts[b]});
+  r->index_blob = index_blob;
+  r->index_offs.assign(index_offs, index_offs + n_blocks + 1);
+  if (bloom && bloom_len >= 12) {
+    // storage/bloom.py layout: <I k><Q m_bits><bits>
+    memcpy(&r->bloom_k, bloom, 4);
+    memcpy(&r->bloom_m, bloom + 4, 8);
+    r->bloom_bits = bloom + 12;
+  }
+  r->views = std::vector<View>(n_blocks);
+  return r;
+}
+
+void rs_close(void* rp) { delete (Reader*)rp; }
+
+const char* rs_error(void* rp) { return ((Reader*)rp)->error.c_str(); }
+
+int32_t rs_doc_key_len(const uint8_t* key, int32_t len) {
+  return doc_key_len(key, len);
+}
+
+// Point lookup across SSTs: newest version with ht <= read_ht wins.
+// Returns value length (copied into out up to cap), -1 when absent, or
+// -2 on block corruption (fetch detail via rs_error on each reader).
+int64_t rs_multi_get(void** readers, int32_t n_readers, const uint8_t* key,
+                     int32_t klen, int32_t dkl, uint64_t read_ht,
+                     uint8_t* out, int64_t cap, uint64_t* out_ht,
+                     uint32_t* out_wid, uint8_t* out_flags) {
+  if (dkl <= 0 || dkl > klen) dkl = doc_key_len(key, klen);
+  uint64_t h = fnv1a(key, dkl);
+  View* best_v = nullptr;
+  uint32_t best_i = 0;
+  uint64_t best_ht = 0;
+  uint32_t best_wid = 0;
+  bool found = false;
+  for (int32_t ri = 0; ri < n_readers; ++ri) {
+    Reader* r = (Reader*)readers[ri];
+    if (!r->may_contain(h)) continue;
+    View* v;
+    uint32_t i;
+    int rc = reader_point_get(r, key, klen, read_ht, &v, &i);
+    if (rc < 0) return -2;
+    if (rc == 0) continue;
+    uint64_t ht = v->ht(i);
+    uint32_t wid = v->wid(i);
+    if (!found || ht > best_ht || (ht == best_ht && wid > best_wid)) {
+      found = true;
+      best_v = v;
+      best_i = i;
+      best_ht = ht;
+      best_wid = wid;
+    }
+  }
+  if (!found) return -1;
+  *out_ht = best_ht;
+  *out_wid = best_wid;
+  *out_flags = best_v->flags(best_i);
+  uint32_t vlen = best_v->val_len(best_i);
+  if ((int64_t)vlen <= cap) memcpy(out, best_v->val_ptr(best_i), vlen);
+  return vlen;
+}
+
+// Build a scan over n_readers SSTs plus an optional packed overlay run
+// (pass xn = 0 for none). mode 0 = raw merged stream; mode 1 = visible.
+void* rs_scan_new(void** readers, int32_t n_readers, const uint8_t* xkeys,
+                  const int64_t* xkoffs, const uint64_t* xht,
+                  const uint32_t* xwid, const uint8_t* xflags,
+                  const int64_t* xttl, const int32_t* xdkl,
+                  const uint8_t* xvals, const int64_t* xvoffs, int64_t xn,
+                  const uint8_t* lower, int32_t llen, const uint8_t* upper,
+                  int32_t ulen, uint64_t read_ht, int32_t mode) {
+  Scan* s = new Scan();
+  s->mode = mode;
+  s->read_ht = read_ht;
+  if (ulen > 0) {
+    s->upper.assign(upper, upper + ulen);
+    s->has_upper = true;
+  }
+  for (int32_t i = 0; i < n_readers; ++i) {
+    auto c = std::make_unique<Cursor>();
+    c->r = (Reader*)readers[i];
+    cursor_seek(c.get(), lower, llen);
+    if (c->err && s->error.empty())
+      s->error = !c->r->error.empty() ? c->r->error
+                                      : "block corruption at scan seek";
+    s->cursors.push_back(std::move(c));
+  }
+  if (xn > 0) {
+    auto c = std::make_unique<Cursor>();
+    c->xkeys = xkeys;
+    c->xkoffs = xkoffs;
+    c->xht = xht;
+    c->xwid = xwid;
+    c->xflags = xflags;
+    c->xttl = xttl;
+    c->xdkl = xdkl;
+    c->xvals = xvals;
+    c->xvoffs = xvoffs;
+    c->xn = xn;
+    cursor_seek(c.get(), lower, llen);
+    s->cursors.push_back(std::move(c));
+  }
+  s->heap_init();
+  return s;
+}
+
+void rs_scan_free(void* sp) { delete (Scan*)sp; }
+
+const char* rs_scan_error(void* sp) { return ((Scan*)sp)->error.c_str(); }
+
+// Fill caller buffers with up to max_rows entries. Returns rows written;
+// 0 = exhausted; -1 = error (single entry larger than the buffer caps).
+int64_t rs_scan_next(void* sp, int64_t max_rows, uint8_t* keys_out,
+                     int64_t key_cap, int32_t* key_offs, uint8_t* vals_out,
+                     int64_t val_cap, int64_t* val_offs, uint64_t* ht_out,
+                     uint32_t* wid_out, uint8_t* flags_out,
+                     int32_t* dkl_out) {
+  Scan* s = (Scan*)sp;
+  if (!s->error.empty()) return -1;
+  if (s->done) return 0;
+  int64_t n = 0, kpos = 0, vpos = 0;
+  key_offs[0] = 0;
+  val_offs[0] = 0;
+  while (n < max_rows && !s->heap.empty()) {
+    Cursor* c = s->heap[0];
+    const uint8_t* k = c->k;
+    int32_t klen = c->klen, dkl = c->dkl;
+    uint64_t ht = c->ht;
+    uint32_t wid = c->wid;
+    uint8_t fl = c->flags;
+    int64_t ttl = c->ttl;
+    const uint8_t* val = c->val;
+    uint32_t vlen = c->vlen;
+
+    bool emit = false;
+    if (s->mode != 1) {
+      if (s->has_upper &&
+          cmp_keys(k, klen, s->upper.data(), (int32_t)s->upper.size()) >= 0) {
+        s->done = true;
+        break;
+      }
+      emit = !(s->have_last && ht == s->last_ht && wid == s->last_wid &&
+               (int32_t)s->last_key.size() == klen &&
+               memcmp(s->last_key.data(), k, klen) == 0);
+      if (emit) {
+        s->last_key.assign(k, k + klen);
+        s->last_ht = ht;
+        s->last_wid = wid;
+        s->have_last = true;
+      }
+    } else {
+      // MVCC visibility (DocRowwiseIterator._resolve_visible semantics)
+      int32_t d = dkl < klen ? dkl : klen;
+      if (s->has_upper &&
+          cmp_keys(k, d, s->upper.data(), (int32_t)s->upper.size()) >= 0) {
+        s->done = true;
+        break;
+      }
+      if (ht <= s->read_ht) {
+        if (!s->have_doc || (int32_t)s->cur_doc.size() != d ||
+            memcmp(s->cur_doc.data(), k, d) != 0) {
+          s->cur_doc.assign(k, k + d);
+          s->have_doc = true;
+          s->ov_set = false;
+          s->seen_paths.clear();
+        }
+        std::string sub((const char*)k + d, (size_t)(klen - d));
+        bool seen = false;
+        for (const auto& p : s->seen_paths)
+          if (p == sub) { seen = true; break; }
+        if (!seen) {
+          s->seen_paths.push_back(std::move(sub));
+          bool shadowed =
+              s->ov_set && (ht < s->ov_ht || (ht == s->ov_ht && wid < s->ov_wid));
+          bool expired = (fl & 4) &&
+              (s->read_ht >> 12) >= (ht >> 12) + (uint64_t)ttl * 1000;
+          bool dead = (fl & 1) || shadowed || expired;
+          if (klen == d) {  // bare DocKey: tombstone or init marker
+            s->ov_ht = ht;
+            s->ov_wid = wid;
+            s->ov_set = true;
+          }
+          emit = !dead;
+        }
+      }
+    }
+
+    if (emit) {
+      int32_t ksz = s->mode == 2 ? klen + 13 : klen;
+      if (kpos + ksz > key_cap || vpos + vlen > val_cap) {
+        if (n == 0) return -3;  // transient: retry with larger buffers
+        return n;  // batch full; entry stays current for the next call
+      }
+      memcpy(keys_out + kpos, k, klen);
+      kpos += klen;
+      if (s->mode == 2) {
+        // append the internal-key suffix: kHybridTime + descending
+        // 12-byte DocHybridTime (common/hybrid_time.py encoded())
+        uint8_t* q = keys_out + kpos;
+        q[0] = '#';
+        uint64_t hc = ~ht;
+        uint32_t wc = ~wid;
+        for (int j = 0; j < 8; ++j) q[1 + j] = (uint8_t)(hc >> (56 - 8 * j));
+        for (int j = 0; j < 4; ++j) q[9 + j] = (uint8_t)(wc >> (24 - 8 * j));
+        kpos += 13;
+      }
+      key_offs[n + 1] = (int32_t)kpos;
+      memcpy(vals_out + vpos, val, vlen);
+      vpos += vlen;
+      val_offs[n + 1] = vpos;
+      ht_out[n] = ht;
+      wid_out[n] = wid;
+      flags_out[n] = fl;
+      dkl_out[n] = dkl;
+      ++n;
+    }
+    if (!s->pop_advance()) return -1;  // corruption mid-scan
+  }
+  if (s->heap.empty()) s->done = true;
+  return n;
+}
+
+}  // extern "C"

@@ -157,7 +157,22 @@ class DocRowwiseIterator:
         self._upper = upper_doc_key
         self._entry_stream = entry_stream
         self._assembler = VisibleEntryRowAssembler(
-            self._resolve_visible(), schema, projection=projection)
+            self._visible_stream(), schema, projection=projection)
+
+    def _visible_stream(self):
+        """RESOLVE stage: the native read engine computes visibility in C++
+        when available (native/read_engine.cc mode 1 — the same semantics
+        as _resolve_visible, differentially tested); Python resolves
+        otherwise or when an intent overlay stream is supplied."""
+        if self._entry_stream is None and hasattr(self._db, "scan_native"):
+            scan = self._db.scan_native(
+                lower=self._lower, upper=self._upper,
+                read_ht_value=self._read_ht.value, visible=True,
+                batch_rows=8192)
+            if scan is not None:
+                return ((k, v, ht) for k, v, ht, _w, _f, _d
+                        in scan.entries())
+        return self._resolve_visible()
 
     @property
     def next_doc_key(self) -> Optional[bytes]:

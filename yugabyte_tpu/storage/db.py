@@ -32,6 +32,11 @@ from yugabyte_tpu.utils.trace import TRACE
 
 flags.define_flag("memstore_size_bytes", 128 * 1024 * 1024,
                   "flush memtable at this size (ref docdb_rocksdb_util.cc:113)")
+flags.define_flag("read_native", True,
+                  "serve point reads and scans through the native read "
+                  "engine (native/read_engine.cc) when it builds; the "
+                  "Python merge path remains the fallback (ref: "
+                  "block_based_table_reader.cc:1144-1286)")
 
 
 @dataclass
@@ -53,6 +58,9 @@ class DBOptions:
     retention_policy: Callable[[], int] = lambda: 0
     memstore_size_bytes: Optional[int] = None
     auto_compact: bool = True
+
+
+_OVERLAY_TOO_BIG = object()  # sentinel: memtable too large to repack
 
 
 class DB:
@@ -87,6 +95,13 @@ class DB:
         # an OP_UPDATE_TXN whose intent tombstones persisted but whose
         # regular-DB rows didn't would replay as a no-op and lose data).
         self.pre_flush_hook: Optional[Callable[[], None]] = None
+        # native read engine state: per-SST native handles + a frozen
+        # ReaderSet snapshot, both rebuilt when the live-file set changes
+        self._native_readers: dict = {}
+        self._rset = None
+        self._rset_gen = 0  # bumped on every invalidation: a ReaderSet
+        #                     built against gen G installs only if still G
+        self._mem_run_cache: Optional[Tuple[int, int, object]] = None
         for fm in self.versions.live_files():
             self._readers[fm.file_id] = SSTReader(fm.path, self.opts.block_cache)
 
@@ -120,8 +135,11 @@ class DB:
         """Apply a batch (already carrying DocHybridTimes). WAL-less: durability
         comes from the Raft log above (ref: tablet.cc:1247 WriteToRocksDB)."""
         with self._lock:
-            for key_prefix, dht, value in items:
-                self.mem.add(key_prefix, dht, value)
+            if len(items) > 8:
+                self.mem.add_batch(items)
+            else:
+                for key_prefix, dht, value in items:
+                    self.mem.add(key_prefix, dht, value)
             self._last_op_id = max(getattr(self, "_last_op_id", (0, 0)), op_id)
             limit = self.opts.memstore_size_bytes or flags.get_flag("memstore_size_bytes")
             need_flush = self.mem.approximate_bytes >= limit
@@ -129,6 +147,148 @@ class DB:
         # fresh memtable while the immutable one packs + writes its SST
         if need_flush:
             self.flush()
+
+    # ---------------------------------------------------- native read engine
+    def _native_rset(self):
+        """Frozen native ReaderSet over the live SSTs, or None when the
+        native read engine is disabled/unavailable. Snapshots outlive
+        installs: in-flight scans keep the old set (and its pinned file
+        bytes) alive by reference, so no file pinning is needed."""
+        if not flags.get_flag("read_native"):
+            return None
+        from yugabyte_tpu.storage import native_read
+        if not native_read.available():
+            return None
+        with self._lock:
+            if self._rset is not None:
+                return self._rset
+            gen = self._rset_gen
+            readers = dict(self._readers)
+            existing = dict(self._native_readers)
+        built = {}
+        for fid, r in readers.items():
+            nr = existing.get(fid)
+            built[fid] = nr if nr is not None else \
+                native_read.NativeSSTReader(r)
+        rset = native_read.ReaderSet(list(built.values()))
+        with self._lock:
+            if self._rset_gen != gen:
+                # a flush/compaction installed while we built: our snapshot
+                # is already stale — serve it for THIS call only (the file
+                # set it holds was live and consistent), do not cache it
+                return rset if self._rset is None else self._rset
+            self._native_readers = built
+            self._rset = rset
+        return rset
+
+    def _memtable_run(self):
+        """Packed memtable(+imm) overlay for native scans, cached per
+        memtable version (rebuilding per scan would re-pay per-entry
+        packing on every read of a write-hot tablet)."""
+        from yugabyte_tpu.docdb.value import decode_control_fields
+        from yugabyte_tpu.docdb.value_type import ValueType as VT
+        from yugabyte_tpu.storage.native_read import PackedRun
+        with self._lock:
+            mem, imm = self.mem, self._imm
+        key = (id(mem), mem.version, id(imm),
+               imm.version if imm is not None else -1)
+        cached = self._mem_run_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        if mem.empty and (imm is None or imm.empty):
+            run = None
+        elif (mem.n_entries
+              + (imm.n_entries if imm is not None else 0)) > 200_000:
+            # write-hot tablet near the flush threshold: repacking the
+            # whole memtable per scan costs more than the Python merge it
+            # replaces — signal the caller to take the fallback path
+            return _OVERLAY_TOO_BIG
+        else:
+            sources = [mem.iter_from(b"")]
+            if imm is not None:
+                sources.append(imm.iter_from(b""))
+            entries = []
+            for ikey, value in heapq.merge(*sources):
+                prefix, dht = split_key_and_ht(ikey)
+                fl = 0
+                ttl = 0
+                try:
+                    _, ttl_ms, off = decode_control_fields(value)
+                    tag = value[off] if off < len(value) else 0
+                    if tag == VT.kTombstone:
+                        fl |= 1
+                    elif tag == VT.kObject:
+                        fl |= 2
+                    if ttl_ms is not None:
+                        fl |= 4
+                        ttl = ttl_ms
+                except (IndexError, ValueError):
+                    pass
+                entries.append((prefix, dht.ht.value, dht.write_id, fl, ttl,
+                                value))
+            run = PackedRun(entries)
+        self._mem_run_cache = (key, run)
+        return run
+
+    def scan_native(self, lower: bytes = b"", upper: Optional[bytes] = None,
+                    read_ht_value: Optional[int] = None,
+                    visible: bool = False, batch_rows: int = 65536,
+                    internal_keys: bool = False):
+        """Native streaming scan (NativeScan) over SSTs + memtable overlay,
+        or None when the native engine is unavailable. visible=True
+        resolves MVCC visibility in C++ (DocRowwiseIterator's RESOLVE
+        stage); internal_keys=True emits full internal keys (raw mode)."""
+        from yugabyte_tpu.storage.native_read import NativeScan
+        # overlay snapshot BEFORE the reader set (see get(): double
+        # coverage is safe, a hidden row is not)
+        overlay = self._memtable_run()
+        if overlay is _OVERLAY_TOO_BIG:
+            return None
+        rset = self._native_rset()
+        if rset is None:
+            return None
+        mode = 1 if visible else (2 if internal_keys else 0)
+        return NativeScan(
+            rset, lower, upper,
+            read_ht_value if read_ht_value is not None else (2**64 - 1),
+            overlay=overlay, batch_rows=batch_rows, mode=mode)
+
+    def ingest_packed(self, keys_blob: bytes, key_offs, ht, wid,
+                      vals_blob: bytes, val_offs,
+                      op_id: Tuple[int, int] = (0, 0)) -> Optional[int]:
+        """Bulk-load one packed run directly as an L0 SST, bypassing the
+        memtable (the reference's bulk-load / external-file ingestion path,
+        ref: src/yb/tools/yb_bulk_load.cc,
+        rocksdb/db/external_sst_file_ingestion_job.cc). Rows need not be
+        pre-sorted — the native encoder orders them. Returns the file id,
+        or None for an empty run. Requires the native engine (callers fall
+        back to write_batch + flush)."""
+        from yugabyte_tpu.storage import native_engine
+        from yugabyte_tpu.storage.sst import write_sst_from_packed
+        from yugabyte_tpu.utils.env import get_env
+        if not (native_engine.available() and not get_env().encrypted):
+            raise RuntimeError("ingest_packed requires the native engine")
+        n = len(key_offs) - 1
+        if n == 0:
+            return None
+        with self._lock:
+            fid = self.versions.new_file_id()
+            self._last_op_id = max(getattr(self, "_last_op_id", (0, 0)),
+                                   op_id)
+        path = os.path.join(self.db_dir, f"{fid:06d}.sst")
+        frontier = Frontier(op_id_min=op_id, op_id_max=op_id,
+                            history_cutoff=0)
+        props = write_sst_from_packed(
+            path, keys_blob, key_offs, ht, wid, vals_blob, val_offs,
+            frontier=frontier, block_entries=self.opts.block_entries)
+        with self._lock:
+            self.versions.add_file(fid, path, props)
+            self._readers[fid] = SSTReader(path, self.opts.block_cache)
+            self._rset = None
+            self._rset_gen += 1
+        if self.opts.auto_compact:
+            self.maybe_schedule_compaction()
+        return fid
 
     # ------------------------------------------------------------------ read
     def get(self, key_prefix: bytes, read_ht: Optional[HybridTime] = None
@@ -138,6 +298,34 @@ class DB:
         read_ht = read_ht or HybridTime.kMax
         seek = make_internal_key(key_prefix, DocHybridTime(read_ht, 0xFFFFFFFF))
         boundary = key_prefix + bytes([ValueType.kHybridTime])
+        # memtable snapshot BEFORE the reader set: a flush landing between
+        # the two moves entries mem -> SST, and the old MemTable object
+        # still holds them, so either ordering race at worst double-covers
+        # a row (newest version wins) — never hides one
+        with self._lock:
+            mems = [self.mem] + ([self._imm] if self._imm is not None
+                                 else [])
+        rset = self._native_rset()
+        if rset is not None:
+            # native fast path: memtable probes in Python (bisect), SSTs in
+            # one native call; newest visible version wins across sources
+            best = None  # (ht_value, wid, value)
+            for mem in mems:
+                hit = mem.point_get(seek, boundary)
+                if hit is not None:
+                    _, dht = split_key_and_ht(hit[0])
+                    cand = (dht.ht.value, dht.write_id, hit[1])
+                    if best is None or cand[:2] > best[:2]:
+                        best = cand
+            if rset.n:
+                hit = rset.multi_get(key_prefix, -1, read_ht.value)
+                if hit is not None:
+                    ht_v, wid, _fl, val = hit
+                    if best is None or (ht_v, wid) > best[:2]:
+                        best = (ht_v, wid, val)
+            if best is None:
+                return None
+            return DocHybridTime(HybridTime(best[0]), best[1]), best[2]
         # Bloom filters hold DOC key prefixes (storage/bloom.py): probe with
         # the DocKey portion, not the full subdoc key.
         from yugabyte_tpu.ops.slabs import _doc_key_len
@@ -158,7 +346,28 @@ class DB:
                   check_bloom_doc: Optional[bytes] = None
                   ) -> Iterator[Tuple[bytes, bytes]]:
         """Merged (internal_key, value) stream in memcmp order (the
-        MergingIterator equivalent)."""
+        MergingIterator equivalent). SSTs stream through the native read
+        engine (C++ k-way merge over in-place block views) when available,
+        merged lazily with the Python memtable iterators — the memtable
+        never pays a repack; the full-Python heap merge remains the
+        fallback and the oracle."""
+        if check_bloom_doc is None and flags.get_flag("read_native"):
+            from yugabyte_tpu.storage import native_read
+            if native_read.available():
+                # memtable snapshot BEFORE the reader set: a racing flush
+                # at worst double-covers rows (deduped below), never hides
+                with self._lock:
+                    mems = [self.mem] + ([self._imm]
+                                         if self._imm is not None else [])
+                rset = self._native_rset()
+                if rset is not None:
+                    prefix_seek, _ = split_key_and_ht(seek_internal_key)
+                    from yugabyte_tpu.storage.native_read import NativeScan
+                    scan = NativeScan(rset, lower=prefix_seek, mode=2)
+                    sources = [m.iter_from(seek_internal_key) for m in mems]
+                    sources.append(
+                        self._native_iter(scan, seek_internal_key))
+                    return _dedup_ikeys(heapq.merge(*sources))
         with self._lock:
             sources = []
             sources.append(self.mem.iter_from(seek_internal_key))
@@ -170,6 +379,24 @@ class DB:
                 continue
             sources.append(_sst_iter_from(r, seek_internal_key))
         return heapq.merge(*sources)
+
+    @staticmethod
+    def _native_iter(scan, seek_internal_key: bytes
+                     ) -> Iterator[Tuple[bytes, bytes]]:
+        """Adapt a mode-2 NativeScan to the iter_from contract. The native
+        seek is by key PREFIX (any version); when the seek carried an HT
+        suffix, drop the leading newer-version entries it excludes."""
+        skipping = bool(seek_internal_key)
+        for batch in scan.batches():
+            koffs, voffs = batch.key_offs, batch.val_offs
+            keys, vals = batch.keys, batch.vals
+            for i in range(batch.n):
+                ikey = keys[koffs[i]: koffs[i + 1]].tobytes()
+                if skipping:
+                    if ikey < seek_internal_key:
+                        continue
+                    skipping = False
+                yield ikey, vals[voffs[i]: voffs[i + 1]].tobytes()
 
     def scan_visible(self, read_ht_value: int,
                      lower_key: Optional[bytes] = None,
@@ -234,25 +461,46 @@ class DB:
         try:
             if self.pre_flush_hook is not None:
                 self.pre_flush_hook()
-            slab = imm.to_slab()
             fid = self.versions.new_file_id()
             path = os.path.join(self.db_dir, f"{fid:06d}.sst")
-            ht = slab.ht_hi.astype("u8") << 32 | slab.ht_lo
-            frontier = Frontier(op_id_min=last_op, op_id_max=last_op,
-                                ht_min=int(ht.min()) if slab.n else 0,
-                                ht_max=int(ht.max()) if slab.n else 0,
-                                history_cutoff=0)
-            props = SSTWriter(path, block_entries=self.opts.block_entries).write(slab, frontier)
+            slab = None
+            from yugabyte_tpu.storage import native_engine
+            from yugabyte_tpu.utils.env import get_env
+            if (native_engine.available() and not get_env().encrypted
+                    and self._device_cache is None):
+                # native flush encoder: block encode + bloom + doc-key
+                # parsing in C++ (the write-path hot loop, ref:
+                # db/flush_job.cc WriteLevel0Table)
+                packed = imm.to_packed()
+                frontier = Frontier(op_id_min=last_op, op_id_max=last_op,
+                                    history_cutoff=0)
+                from yugabyte_tpu.storage.sst import write_sst_from_packed
+                props = write_sst_from_packed(
+                    path, *packed, frontier=frontier,
+                    block_entries=self.opts.block_entries)
+                n_flushed = len(packed[1]) - 1
+            else:
+                slab = imm.to_slab()
+                ht = slab.ht_hi.astype("u8") << 32 | slab.ht_lo
+                frontier = Frontier(op_id_min=last_op, op_id_max=last_op,
+                                    ht_min=int(ht.min()) if slab.n else 0,
+                                    ht_max=int(ht.max()) if slab.n else 0,
+                                    history_cutoff=0)
+                props = SSTWriter(path, block_entries=self.opts.block_entries).write(slab, frontier)
+                n_flushed = slab.n
             from yugabyte_tpu.utils import sync_point
             sync_point.hit("db.flush:before_manifest")
-            if self._device_cache is not None:
+            if self._device_cache is not None and slab is not None:
                 self._device_cache.stage(fid, slab)  # write-through to HBM
             with self._lock:
                 self.versions.add_file(fid, path, props)
                 self.versions.set_flushed_frontier(frontier)
                 self._readers[fid] = SSTReader(path, self.opts.block_cache)
                 self._imm = None
-            TRACE("flushed %d entries to %s", slab.n, path)
+                self._rset = None  # native snapshot is stale
+                self._rset_gen += 1
+                self._mem_run_cache = None
+            TRACE("flushed %d entries to %s", n_flushed, path)
         except BaseException:
             with self._lock:
                 # restore un-flushed entries into the live memtable
@@ -302,9 +550,14 @@ class DB:
                 removed = [fm.file_id for fm in pick.inputs]
                 self.versions.install_compaction(
                     removed, [(fid, p, props) for fid, p, props in result.outputs])
+                self._rset = None  # native snapshot is stale; removed
+                self._rset_gen += 1
+                # native readers are dropped from the dict below and freed
+                # by refcount once in-flight scans release their snapshot
                 for fid, path, props in result.outputs:
                     self._readers[fid] = SSTReader(path, self.opts.block_cache)
                 for fid in removed:
+                    self._native_readers.pop(fid, None)
                     r = self._readers.pop(fid, None)
                     if r:
                         if self._pins.get(fid):
@@ -366,6 +619,12 @@ class DB:
     def close(self) -> None:
         with self._lock:
             self._closed = True
+            # native handles free via refcount (in-flight scans may still
+            # hold the snapshot)
+            self._native_readers = {}
+            self._rset = None
+            self._rset_gen += 1
+            self._mem_run_cache = None
             self._purge_obsolete_unlocked()
             for r in self._obsolete.values():
                 r.close()  # still pinned: close the handle, leave the files
@@ -380,6 +639,19 @@ class DB:
     @property
     def n_live_files(self) -> int:
         return len(self.versions.files)
+
+
+def _dedup_ikeys(stream: Iterator[Tuple[bytes, bytes]]
+                 ) -> Iterator[Tuple[bytes, bytes]]:
+    """Suppress adjacent duplicate internal keys: a flush racing the
+    memtable snapshot can surface one row from both the memtable and the
+    fresh SST; legitimate data never repeats a full internal key."""
+    prev = None
+    for kv in stream:
+        if kv[0] == prev:
+            continue
+        prev = kv[0]
+        yield kv
 
 
 def _sst_iter_from(reader: SSTReader, seek: bytes) -> Iterator[Tuple[bytes, bytes]]:

@@ -32,6 +32,7 @@ class MemTable:
         self._keys: List[bytes] = []
         self._sorted_upto = 0
         self._bytes = 0
+        self.version = 0  # bumped per mutation: packed-run cache key
         self._lock = threading.Lock()
         # monotonic time of the first write — the global-memstore arbiter
         # flushes the tablet holding the OLDEST mutable data first
@@ -45,8 +46,44 @@ class MemTable:
                 self._keys.append(ikey)
             self._data[ikey] = value
             self._bytes += len(ikey) + len(value)
+            self.version += 1
             if self._first_write_s is None:
                 self._first_write_s = time.monotonic()
+
+    def add_batch(self, items) -> None:
+        """Bulk insert of (key_prefix, dht, value) triples — one lock
+        acquisition and list-comprehension packing instead of a per-entry
+        call chain (the write-path hot loop, ref: db/memtable.cc Add)."""
+        ikeys = [make_internal_key(k, dht) for k, dht, _ in items]
+        with self._lock:
+            data = self._data
+            keys = self._keys
+            nbytes = 0
+            for ikey, (_, _, value) in zip(ikeys, items):
+                if ikey not in data:
+                    keys.append(ikey)
+                data[ikey] = value
+                nbytes += len(ikey) + len(value)
+            self._bytes += nbytes
+            self.version += 1
+            if self._first_write_s is None:
+                self._first_write_s = time.monotonic()
+
+    def point_get(self, seek: bytes, boundary: bytes
+                  ) -> Optional[Tuple[bytes, bytes]]:
+        """First (internal_key, value) at or after `seek` that still starts
+        with `boundary`, without copying the key list (the per-point-read
+        snapshot copy dominated hot gets on large memtables)."""
+        with self._lock:
+            if self._sorted_upto != len(self._keys):
+                self._keys = sorted(self._keys)
+                self._sorted_upto = len(self._keys)
+            idx = bisect.bisect_left(self._keys, seek)
+            if idx < len(self._keys):
+                k = self._keys[idx]
+                if k.startswith(boundary):
+                    return k, self._data[k]
+        return None
 
     @property
     def oldest_write_s(self) -> Optional[float]:
@@ -93,3 +130,34 @@ class MemTable:
             prefix, dht = split_key_and_ht(ikey)
             triples.append((prefix, pack_doc_ht(dht), self._data[ikey]))
         return pack_kvs(triples)
+
+    def to_packed(self):
+        """Sorted packed-run arrays for the native flush encoder
+        (native/compaction_engine.cc ce_job_add_raw): (keys_blob, key_offs,
+        ht, wid, vals_blob, val_offs). The 13-byte internal-key suffix is
+        fixed width, so the split is pure slicing and the DocHybridTime
+        columns decode in two vectorized complement passes."""
+        import numpy as np
+        from yugabyte_tpu.common.hybrid_time import ENCODED_DOC_HT_SIZE
+        snap = self._sorted_snapshot()
+        n = len(snap)
+        s = ENCODED_DOC_HT_SIZE + 1  # kHybridTime byte + 12-byte suffix
+        prefixes = [k[:-s] for k in snap]
+        keys_blob = b"".join(prefixes)
+        key_offs = np.zeros(n + 1, dtype=np.int64)
+        if n:
+            np.cumsum([len(p) for p in prefixes], out=key_offs[1:])
+        suffix = b"".join(k[-ENCODED_DOC_HT_SIZE:] for k in snap)
+        rec = (np.frombuffer(suffix, dtype=np.uint8).reshape(n, 12)
+               if n else np.zeros((0, 12), dtype=np.uint8))
+        ht = (np.ascontiguousarray(rec[:, :8]).view(">u8").ravel()
+              ^ np.uint64(0xFFFFFFFFFFFFFFFF)).astype(np.uint64)
+        wid = (np.ascontiguousarray(rec[:, 8:]).view(">u4").ravel()
+               ^ np.uint32(0xFFFFFFFF)).astype(np.uint32)
+        data = self._data
+        vals = [data[k] for k in snap]
+        vals_blob = b"".join(vals)
+        val_offs = np.zeros(n + 1, dtype=np.int64)
+        if n:
+            np.cumsum([len(v) for v in vals], out=val_offs[1:])
+        return keys_blob, key_offs, ht, wid, vals_blob, val_offs

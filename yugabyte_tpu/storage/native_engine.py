@@ -61,6 +61,13 @@ def _load():
             ctypes.c_int32]
         lib.ce_job_prepare.restype = ctypes.c_int64
         lib.ce_job_prepare.argtypes = [ctypes.c_void_p]
+        lib.ce_job_add_raw.argtypes = [
+            ctypes.c_void_p, _u8p, _i64p, ctypes.c_int64, _u64p,
+            ctypes.POINTER(ctypes.c_uint32), _u8p, _i64p]
+        lib.ce_job_sort_all.restype = ctypes.c_int64
+        lib.ce_job_sort_all.argtypes = [ctypes.c_void_p]
+        lib.ce_job_props.argtypes = [ctypes.c_void_p, _u64p,
+                                     _i32p]
         lib.ce_job_merge.restype = ctypes.c_int64
         lib.ce_job_merge.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32]
@@ -159,6 +166,42 @@ class NativeCompactionJob:
             raise RuntimeError(f"native compaction prepare: {self._err()}")
         self.rows_in = n
         return n
+
+    def add_raw(self, keys_blob: bytes, key_offs: np.ndarray,
+                ht: np.ndarray, wid: np.ndarray, vals_blob: bytes,
+                val_offs: np.ndarray) -> int:
+        """Ingest one packed run (the flush/bulk-load path): flags, TTL and
+        doc_key_len are derived natively from the value control fields and
+        key structure (ref: db/flush_job.cc WriteLevel0Table)."""
+        n = len(key_offs) - 1
+        key_offs = np.ascontiguousarray(key_offs, dtype=np.int64)
+        ht = np.ascontiguousarray(ht, dtype=np.uint64)
+        wid = np.ascontiguousarray(wid, dtype=np.uint32)
+        val_offs = np.ascontiguousarray(val_offs, dtype=np.int64)
+        self._keepalive += [keys_blob, key_offs, ht, wid, vals_blob, val_offs]
+        self._lib.ce_job_add_raw(
+            self._job, ctypes.cast(ctypes.c_char_p(keys_blob), _u8p),
+            key_offs.ctypes.data_as(_i64p), ctypes.c_int64(n),
+            ht.ctypes.data_as(_u64p),
+            wid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.cast(ctypes.c_char_p(vals_blob), _u8p),
+            val_offs.ctypes.data_as(_i64p))
+        self.rows_in = n
+        return n
+
+    def sort_all(self) -> int:
+        """Order the raw run by internal key (no-op scan when pre-sorted)
+        and mark every row a survivor — flush keeps all versions."""
+        self.n_survivors = int(self._lib.ce_job_sort_all(self._job))
+        return self.n_survivors
+
+    def props(self):
+        """(max_expire_us, has_deep) for the base-file props."""
+        mx = ctypes.c_uint64()
+        deep = ctypes.c_int32()
+        self._lib.ce_job_props(self._job, ctypes.byref(mx),
+                               ctypes.byref(deep))
+        return int(mx.value), bool(deep.value)
 
     def merge(self, cutoff_ht: int, is_major: bool,
               retain_deletes: bool = False) -> int:

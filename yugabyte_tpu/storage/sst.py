@@ -188,6 +188,44 @@ class SSTWriter:
             has_deep=bool(n) and bool(((slab.flags & FLAG_DEEP) != 0).any()))
 
 
+def write_sst_from_packed(base_path: str, keys_blob: bytes, key_offs,
+                          ht, wid, vals_blob: bytes, val_offs,
+                          frontier: Optional[Frontier] = None,
+                          block_entries: Optional[int] = None,
+                          compress: Optional[bool] = None,
+                          presorted_hint: bool = True) -> SSTProps:
+    """Native-encoded SST from one packed run (the flush / bulk-load hot
+    path, ref: db/flush_job.cc WriteLevel0Table + memtable.cc iteration).
+    Block encode, bloom hashing and doc-key parsing run in C++
+    (ce_job_add_raw → ce_job_sort_all → ce_job_write_output); Python
+    assembles the base file as usual. Caller guarantees native_engine is
+    available."""
+    import numpy as np
+    from yugabyte_tpu.storage import native_engine
+    if block_entries is None:
+        block_entries = _sst_flags.get_flag("sst_block_entries")
+    if compress is None:
+        compress = sst_compression_enabled()
+    n = len(key_offs) - 1
+    data_path = data_file_name(base_path)
+    if os.path.exists(data_path):
+        os.remove(data_path)  # never append to a stale data file
+    with native_engine.NativeCompactionJob() as job:
+        job.add_raw(keys_blob, key_offs, ht, wid, vals_blob, val_offs)
+        job.sort_all()
+        size, index, hashes, first_key, last_key = job.write_output(
+            0, n, data_path, block_entries, compress, b"X")
+        max_expire_us, has_deep = job.props()
+    ht_arr = np.asarray(ht, dtype=np.uint64)
+    fr = frontier or Frontier()
+    if n and fr.ht_min == 0 and fr.ht_max == 0:
+        fr.ht_min = int(ht_arr.min())
+        fr.ht_max = int(ht_arr.max())
+    return write_base_file(base_path, index, n, hashes, first_key, last_key,
+                           fr, size, max_expire_us=max_expire_us,
+                           has_deep=has_deep)
+
+
 def write_base_file(base_path: str,
                     index_items: List[Tuple[bytes, int, int, int]],
                     n_entries: int, bloom_hashes: np.ndarray,
@@ -279,6 +317,7 @@ class SSTReader:
         if crc != (zlib.crc32(index_bytes) ^ zlib.crc32(bloom_bytes) ^ zlib.crc32(props_bytes)):
             raise StatusError(Status.Corruption(f"SST base checksum mismatch: {base_path}"))
         self.index_keys, self.block_handles = _decode_index(index_bytes)
+        self.bloom_raw = bloom_bytes  # native read engine parses it in place
         self.bloom = BloomFilter(bloom_bytes)
         self.props = SSTProps.from_json(json.loads(props_bytes))
         # Env random-access handle (position-less preads are safe under
