@@ -23,7 +23,7 @@ from yugabyte_tpu.tablet.tablet_peer import TabletPeer, peer_address
 from yugabyte_tpu.utils import flags
 
 
-def wait_for(pred, timeout=10.0, msg="condition"):
+def wait_for(pred, timeout=20.0, msg="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():

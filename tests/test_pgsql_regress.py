@@ -209,7 +209,39 @@ class TestCursors:
         assert rows(conn, "FETCH 1 FROM ch") == [("1",)]
         conn.query("COMMIT")
         assert rows(conn, "FETCH 1 FROM ch") == [("2",)]   # survives
+        # PG materializes holdable cursors at commit (PersistHoldablePortal):
+        # rows committed afterwards must NOT leak into the held result set
+        conn.query("INSERT INTO customers (cid, name) VALUES (9, 'zed')")
+        rest = rows(conn, "FETCH ALL FROM ch")
+        assert ("9",) not in rest, "post-commit insert leaked into cursor"
+        conn.query("DELETE FROM customers WHERE cid = 9")
         conn.query("CLOSE ch")
+
+    def test_with_hold_autocommit_materializes_at_declare(self, conn):
+        # no BEGIN: the implicit txn around DECLARE ends with the
+        # statement, so the holdable portal persists immediately
+        conn.query("DECLARE ca CURSOR WITH HOLD FOR SELECT cid "
+                   "FROM customers ORDER BY cid")
+        conn.query("INSERT INTO customers (cid, name) VALUES (8, 'hal')")
+        got = rows(conn, "FETCH ALL FROM ca")
+        assert ("8",) not in got, "post-declare insert leaked into cursor"
+        conn.query("DELETE FROM customers WHERE cid = 8")
+        conn.query("CLOSE ca")
+
+    def test_with_hold_cursor_destroyed_by_rollback(self, conn):
+        # PG destroys holdable cursors created in an aborted transaction —
+        # a lazy scan surviving ROLLBACK could serve the txn's aborted
+        # writes forever
+        conn.query("BEGIN")
+        conn.query("INSERT INTO customers (cid, name) VALUES (7, 'gus')")
+        conn.query("DECLARE cr CURSOR WITH HOLD FOR SELECT cid "
+                   "FROM customers ORDER BY cid")
+        conn.query("ROLLBACK")
+        with pytest.raises(PgWireError):
+            conn.query("FETCH 1 FROM cr")
+        # and the rolled-back row is gone entirely
+        r = rows(conn, "SELECT cid FROM customers WHERE cid = 7")
+        assert r == []
 
 
 class TestDroppedColumnStar:

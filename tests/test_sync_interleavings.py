@@ -28,10 +28,15 @@ def fast_raft_and_clean_points():
     from yugabyte_tpu.utils import flags
     flags.set_flag("raft_heartbeat_interval_ms", 15)
     flags.set_flag("ht_lease_duration_ms", 1000)
+    # These tests pin exact interleavings with sync points; the heartbeat
+    # batch window serializes batched RPCs per destination and has made
+    # elections miss their window under full-suite load — disable it.
+    flags.set_flag("multi_raft_batch_window_ms", 0)
     yield
     sync_point.clear()
     flags.reset_flag("raft_heartbeat_interval_ms")
     flags.reset_flag("ht_lease_duration_ms")
+    flags.reset_flag("multi_raft_batch_window_ms")
 
 
 def test_leader_change_during_in_flight_write(tmp_path):

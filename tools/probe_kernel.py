@@ -1,0 +1,209 @@
+"""TPU kernel probe: time the Pallas merge-path kernel vs the jnp merge
+network on the real chip, at graduated sizes, persisting progressively.
+
+The flagship device kernel (ops/pallas_merge.py — the tournament
+merge-path counterpart of the reference's MergingIterator + compaction
+filter, ref: src/yb/rocksdb/table/merger.cc:51,
+src/yb/docdb/docdb_compaction_filter.cc:74) can only be validated on real
+hardware: its Mosaic lowering never executes under interpret-mode tests.
+The axon TPU tunnel is intermittent, so this tool is built to be run
+OPPORTUNISTICALLY and OFTEN:
+
+  - every intermediate result is flushed to PROBE_TPU.json (repo root)
+    the moment it exists — a wedged tunnel or a timeout still leaves
+    whatever was measured on disk, committed by the caller;
+  - a watchdog (SIGALRM, --budget seconds, default 480) bounds the run;
+  - CPU fallback is refused by default: this tool exists to capture TPU
+    numbers (--allow-cpu for plumbing tests).
+
+Usage:  python tools/probe_kernel.py [--budget 480] [--shapes 18,20]
+Writes: PROBE_TPU.json — platform, device, per-shape first-call (compile)
+        and sustained per-job seconds, rows/s, pallas-vs-network
+        agreement, and kernel_vs_native (vs the single-core in-memory C++
+        merge+GC, the same basis as BENCH kernel_vs_cpu_core).
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+OUT = os.path.join(_REPO, "PROBE_TPU.json")
+
+state = {"start": time.strftime("%Y-%m-%d %H:%M:%S"), "done": False}
+
+
+def save():
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=int, default=480,
+                    help="hard wall-clock cap in seconds (SIGALRM)")
+    ap.add_argument("--shapes", default="18,20",
+                    help="comma-separated log2 row counts to probe")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="probe even when only CPU-JAX is available")
+    args = ap.parse_args()
+
+    def on_alarm(_sig, _frm):
+        state["timeout"] = True
+        save()
+        print(json.dumps(state))
+        os._exit(2)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(args.budget)
+    # SIGALRM only fires between Python bytecodes — a wedged axon tunnel
+    # hangs INSIDE native backend init and never returns to the
+    # interpreter. A forked watchdog child kills the parent regardless.
+    parent = os.getpid()
+    watchdog = os.fork()
+    if watchdog == 0:
+        time.sleep(args.budget + 5)
+        try:
+            with open(OUT) as f:
+                st = json.load(f)
+            st["timeout"] = True
+            with open(OUT, "w") as f:
+                json.dump(st, f, indent=1)
+        except OSError:
+            pass
+        try:
+            os.kill(parent, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        os._exit(0)
+    save()
+    try:
+        return _probe(args)
+    finally:
+        try:
+            os.kill(watchdog, signal.SIGKILL)  # retire the watchdog child
+        except ProcessLookupError:
+            pass
+
+
+def _probe(args):
+    t0 = time.time()
+    if args.allow_cpu and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # plumbing-test mode: pin CPU BEFORE backend init — the axon
+        # sitecustomize force-registers the tunnel TPU and overrides the
+        # env var, and a wedged tunnel then hangs jax.devices() forever
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+    dev = jax.devices()[0]
+    platform = dev.platform
+    state["backend_init_s"] = round(time.time() - t0, 1)
+    state["device"] = str(dev)
+    state["platform"] = platform
+    save()
+    if platform != "tpu" and not args.allow_cpu:
+        state["skipped"] = "no TPU backend (platform=%s)" % platform
+        save()
+        print(json.dumps(state))
+        return 1
+
+    import numpy as np  # noqa: F401
+
+    from bench import synth_ycsb_runs, _split_runs
+    from yugabyte_tpu.ops import pallas_merge, run_merge
+    from yugabyte_tpu.ops.merge_gc import GCParams
+
+    cutoff = 10_000_000 << 12
+    params = GCParams(cutoff, True)
+
+    def stage(n):
+        slab, offsets = synth_ycsb_runs(n, 4, max(1, n // 2))
+        runs = _split_runs(slab, offsets)
+        return run_merge.stage_runs_from_slabs(runs, dev), slab, offsets
+
+    def time_impl(tag, fn, staged, n):
+        t_first = time.time()
+        h = fn(staged, params)
+        perm, keep, mk = h.result()
+        state[f"{tag}_first_s"] = round(time.time() - t_first, 2)
+        kept = int(keep.sum())
+        state[f"{tag}_kept"] = kept
+        save()
+        # sustained: pipelined stream slope (k=6 minus k=2 over 4 jobs)
+        def run_stream(k):
+            ts = time.time()
+            hs = [fn(staged, params)]
+            for i in range(1, k):
+                hs.append(fn(staged, params))
+                hs[i - 1].result()
+            hs[-1].result()
+            return time.time() - ts
+        t2 = run_stream(2)
+        t6 = run_stream(6)
+        per_job = (t6 - t2) / 4 if t6 > t2 else t6 / 6
+        state[f"{tag}_sustained_s"] = round(per_job, 3)
+        state[f"{tag}_rows_per_sec"] = round(n / per_job, 1)
+        save()
+        return kept
+
+    # native single-core in-memory merge+GC rate at the same shape — the
+    # kernel_vs_cpu_core denominator (native/compaction_baseline.cc)
+    def native_rate(slab, offsets, n):
+        try:
+            from yugabyte_tpu.storage.cpu_baseline import \
+                compact_cpu_baseline
+            t = time.time()
+            compact_cpu_baseline(slab, offsets, cutoff, True)
+            return round(n / (time.time() - t), 1)
+        except Exception as e:  # noqa: BLE001
+            state["native_error"] = repr(e)[:200]
+            return 0.0
+
+    shapes = [int(s) for s in args.shapes.split(",") if s]
+    for n_log in shapes:
+        n = 1 << n_log
+        tag = f"n{n_log}"
+        try:
+            ts = time.time()
+            staged, slab, offsets = stage(n)
+            jax.block_until_ready(staged.cols_dev)
+            state[f"{tag}_stage_s"] = round(time.time() - ts, 1)
+            save()
+            kp = time_impl(f"{tag}_pallas",
+                           pallas_merge.launch_merge_gc_pallas, staged, n)
+            os.environ["YBTPU_MERGE_IMPL"] = "network"
+            kn = time_impl(f"{tag}_network", run_merge.launch_merge_gc,
+                           staged, n)
+            os.environ["YBTPU_MERGE_IMPL"] = "auto"
+            state[f"{tag}_agree"] = (kp == kn)
+            nat = native_rate(slab, offsets, n)
+            state[f"{tag}_native_rows_per_sec"] = nat
+            if nat > 0:
+                state[f"{tag}_pallas_vs_native"] = round(
+                    state[f"{tag}_pallas_rows_per_sec"] / nat, 3)
+                state[f"{tag}_network_vs_native"] = round(
+                    state[f"{tag}_network_rows_per_sec"] / nat, 3)
+            save()
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            state[f"{tag}_error"] = repr(e)[:500]
+            state[f"{tag}_traceback"] = traceback.format_exc()[-1500:]
+            save()
+            break
+
+    state["done"] = True
+    save()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,13 +64,25 @@ class MultiRaftBatcher:
             self._stopped = True
             timers = list(self._timers.values())
             self._timers.clear()
+            # fail queued slots NOW — leaving them to ride out the full
+            # slot timeout stalls server shutdown by seconds per peer
+            pending = [s for q in self._queues.values() for _d, _r, s in q]
+            self._queues.clear()
         for t in timers:
             t.cancel()
+        for slot in pending:
+            slot.err = PeerUnreachable("batcher stopped")
+            slot.event.set()
 
     def submit(self, addr: str, dst_peer: str, wire_req: dict,
-               timeout_s: float = 10.0) -> dict:
+               timeout_s: Optional[float] = None) -> dict:
         """Enqueue one heartbeat for addr; blocks until its response."""
         window = flags.get_flag("multi_raft_batch_window_ms") / 1000.0
+        if timeout_s is None:
+            # must exceed the underlying RPC timeout plus the batch window,
+            # else a slow-but-successful batch RPC fails every coalesced
+            # heartbeat spuriously
+            timeout_s = flags.get_flag("rpc_default_timeout_s") + window + 1.0
         slot = _Slot()
         flush_now = False
         with self._lock:

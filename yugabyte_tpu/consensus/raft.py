@@ -715,12 +715,13 @@ class RaftConsensus:
         indexes — a follower has no peers to serve, and its empty
         _match_index map must not pin the floor at 0 forever."""
         if len(self._ht_by_index) > 2 * self._CACHE_HIGH_WATER:
-            # the HT sidecar map trims by the same rule but keeps a deeper
-            # tail: it still serves the safe-time clamp for lagging peers
+            # the HT sidecar trims at an ABSOLUTE floor: safe-time
+            # propagation already freezes (safe=0) for peers lagging past
+            # the scan cap, so holding entries for them buys nothing — and
+            # a permanently dead peer pinned at match_index 0 would
+            # otherwise keep this map (and the entry cache below) growing
+            # for as long as writes continue
             floor = self.last_applied - self._SAFE_TIME_SCAN_CAP
-            if self.role == Role.LEADER:
-                floor = min([floor] + [self._match_index.get(p, 0)
-                                       for p in self.config.remote_peers])
             if floor > 0:
                 for i in list(self._ht_by_index):
                     if i < floor:
@@ -729,8 +730,11 @@ class RaftConsensus:
             return
         floor = self.last_applied - self._CACHE_TAIL
         if self.role == Role.LEADER:
+            # serve lagging peers from memory — but never below the
+            # absolute cap: beyond it they re-read from the WAL anyway
             floor = min([floor] + [self._match_index.get(p, 0)
                                    for p in self.config.remote_peers])
+            floor = max(floor, self.last_applied - self._SAFE_TIME_SCAN_CAP)
         for i in list(self._entries):
             if i < floor:
                 del self._entries[i]
@@ -835,11 +839,12 @@ class RaftConsensus:
         # missing (it would expose follower reads to missing data). Raft
         # index order need not match hybrid-time order across concurrent
         # writers, so take the min HT over the whole unsent tail — from
-        # _ht_by_index, which unlike the entry cache is never evicted
-        # while a peer may still need it. An unknown tail HT (or a peer
-        # more than _SAFE_TIME_SCAN_CAP behind) freezes propagation
-        # instead of guessing: a follower that far back must not serve
-        # reads anyway, and 0 leaves its safe time unchanged.
+        # _ht_by_index, which is trimmed only below the absolute
+        # last_applied - _SAFE_TIME_SCAN_CAP floor, provably under any
+        # index this scan can touch. An unknown tail HT (or a peer more
+        # than _SAFE_TIME_SCAN_CAP behind) freezes propagation instead of
+        # guessing: a follower that far back must not serve reads anyway,
+        # and 0 leaves its safe time unchanged.
         safe = self.safe_time_provider()
         tail = self._last_index - sent_up_to
         if tail > self._SAFE_TIME_SCAN_CAP:

@@ -581,7 +581,8 @@ class QLProcessor:
         # LIMIT budget spans pages: the token carries what is still owed
         remaining = ps[3] if ps else stmt.limit
         count = 0
-        for row in rows:
+        rows_it = iter(rows)
+        for row in rows_it:
             d = row.to_dict(schema)
             if dk is not None and tuple(
                     d[c.name] for c in schema.hash_columns) != \
@@ -594,11 +595,15 @@ class QLProcessor:
             if remaining is not None and count >= remaining:
                 break
             if pageable and page_size is not None and count >= page_size:
-                rs.paging_state = _encode_page_state(
-                    row.doc_key.encode() + b"\xff",
-                    table.partition_key_for(row.doc_key),
-                    scan_state.get("read_ht", 0),
-                    None if remaining is None else remaining - count)
+                # peek before issuing a token: an exactly-exhausted scan
+                # must report "no more pages", not charge the client one
+                # extra round trip for an empty final page
+                if next(rows_it, None) is not None:
+                    rs.paging_state = _encode_page_state(
+                        row.doc_key.encode() + b"\xff",
+                        table.partition_key_for(row.doc_key),
+                        scan_state.get("read_ht", 0),
+                        None if remaining is None else remaining - count)
                 break
         return rs
 

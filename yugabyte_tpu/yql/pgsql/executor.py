@@ -68,6 +68,27 @@ def _pg_error(e: StatusError) -> PgError:
     return PgError(e.status, _SQLSTATE.get(e.status.code, "XX000"))
 
 
+class _Cursor:
+    """One DECLARE'd cursor (the PG portal): a lazy row iterator, its
+    column headers, the WITH HOLD flag, and whether the remaining rows
+    were already persisted (PG's PersistHoldablePortal at commit)."""
+
+    __slots__ = ("columns", "it", "hold", "materialized")
+
+    def __init__(self, columns, it, hold: bool):
+        self.columns = columns
+        self.it = it
+        self.hold = hold
+        self.materialized = False
+
+    def materialize(self) -> None:
+        """Drain the lazy scan into memory; idempotent. Must run while the
+        creating transaction's snapshot is still valid."""
+        if not self.materialized:
+            self.it = iter(list(self.it))
+            self.materialized = True
+
+
 class PgSession:
     """One connection's executor state (ref pg_session.h:113)."""
 
@@ -82,9 +103,9 @@ class PgSession:
         # bumped at every transaction boundary; suspended portals created
         # under an older epoch are invalid (see server._execute_portal)
         self.txn_epoch = 0
-        # DECLARE'd cursors: name -> (columns, lazy row iterator, hold);
-        # non-hold cursors die at transaction end, WITH HOLD survive
-        self._cursors: Dict[str, Tuple[list, object, bool]] = {}
+        # DECLARE'd cursors; non-hold cursors die at transaction end,
+        # WITH HOLD survive (materialized at the creating txn's commit)
+        self._cursors: Dict[str, _Cursor] = {}
         # PG connects to an EXISTING database; only the default one is
         # auto-created (the initdb role). Unknown names fail with 3D000
         # instead of silently materializing a typo'd namespace.
@@ -331,8 +352,14 @@ class PgSession:
             materialized = self._select(stmt.select)
             streamed = PgResult(materialized.tag, materialized.columns,
                                 row_iter=iter(materialized.rows))
-        self._cursors[stmt.name] = (streamed.columns, streamed.row_iter,
-                                    stmt.hold)
+        cur = _Cursor(streamed.columns, streamed.row_iter, stmt.hold)
+        if stmt.hold and self._txn is None:
+            # autocommit: the implicit transaction around DECLARE ends
+            # with the statement — persist the holdable portal NOW, as PG
+            # does at the end of the creating transaction, so later writes
+            # never leak into the held result set
+            cur.materialize()
+        self._cursors[stmt.name] = cur
         return PgResult("DECLARE CURSOR")
 
     def _fetch_cursor(self, stmt: P.FetchCursor) -> PgResult:
@@ -340,14 +367,13 @@ class PgSession:
         if cur is None:
             raise PgError(Status.InvalidArgument(
                 f'cursor "{stmt.name}" does not exist'), "34000")
-        cols, it, _hold = cur
         rows = []
         while stmt.count is None or len(rows) < stmt.count:
             try:
-                rows.append(next(it))
+                rows.append(next(cur.it))
             except StopIteration:
                 break
-        return PgResult(f"FETCH {len(rows)}", cols, rows)
+        return PgResult(f"FETCH {len(rows)}", cur.columns, rows)
 
     # ---------------------------------------------------------------- DDL
     def _create_table(self, stmt: P.CreateTable) -> PgResult:
@@ -1118,9 +1144,24 @@ class PgSession:
         # the same reason
         self.txn_epoch += 1
         if stmt.kind != "begin":
-            # WITH HOLD cursors survive transaction end (PG DECLARE docs)
-            self._cursors = {n: c for n, c in self._cursors.items()
-                             if c[2]}
+            # WITH HOLD cursors survive transaction end (PG DECLARE docs).
+            # On COMMIT an unmaterialized hold cursor is persisted (PG's
+            # PersistHoldablePortal) — drained through the still-open txn
+            # so its snapshot is honored, never re-read later. On ROLLBACK
+            # an unmaterialized hold cursor was created by the aborted
+            # transaction: PG destroys it (its lazy scan could serve the
+            # txn's rolled-back writes); already-persisted ones survive.
+            aborting = stmt.kind != "commit" or self.txn_failed
+            held = {}
+            for n, cur in self._cursors.items():
+                if not cur.hold:
+                    continue
+                if not cur.materialized:
+                    if aborting:
+                        continue  # destroyed with the aborted txn
+                    cur.materialize()
+                held[n] = cur
+            self._cursors = held
         if stmt.kind == "begin":
             if self._txn is None:
                 self._txn = self._txn_manager.begin()
