@@ -32,6 +32,21 @@ def wait_for(pred, timeout=20.0, msg="condition"):
     raise TimeoutError(f"timed out waiting for {msg}")
 
 
+def _elect_with_retry(raft_like, name, timeout=20.0):
+    """Drive one node to leadership, RE-ISSUING the election every 2s: a
+    single attempt can silently die under full-suite CPU load (vote RPCs
+    time out) and nothing retries it with election timers disabled."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        raft_like.start_election(ignore_lease=True)
+        attempt_end = min(time.monotonic() + 2.0, deadline)
+        while time.monotonic() < attempt_end:
+            if raft_like.is_leader():
+                return
+            time.sleep(0.005)
+    raise TimeoutError(f"timed out waiting for {name} leader")
+
+
 @pytest.fixture(autouse=True)
 def fast_raft():
     flags.set_flag("raft_heartbeat_interval_ms", 15)
@@ -135,8 +150,7 @@ class RaftHarness:
         return None
 
     def elect(self, pid):
-        self.nodes[pid].start_election(ignore_lease=True)
-        wait_for(lambda: self.nodes[pid].is_leader(), msg=f"{pid} leader")
+        _elect_with_retry(self.nodes[pid], pid)
         return self.nodes[pid]
 
     def shutdown(self):
@@ -283,8 +297,7 @@ class PeerHarness:
                 self.transport).start(election_timer=False)
 
     def elect(self, s):
-        self.peers[s].raft.start_election(ignore_lease=True)
-        wait_for(lambda: self.peers[s].raft.is_leader(), msg=f"{s} leader")
+        _elect_with_retry(self.peers[s].raft, s)
         return self.peers[s]
 
     def shutdown(self):

@@ -367,3 +367,74 @@ class TestTLS:
         with pytest.raises(Exception):
             a.call(b.address, "s", "echo", x=2, timeout_s=3.0)
         a.shutdown()
+
+
+class TestSidecars:
+    """Zero-copy bulk segments (ref: rpc/rpc_context.h AddRpcSidecar —
+    remote bootstrap chunks, CDC batches, big scan pages)."""
+
+    def test_codec_sidecar_roundtrip(self):
+        from yugabyte_tpu.rpc.codec import (dumps_with_sidecars,
+                                            loads_with_sidecars)
+        big = b"\x01\x02" * 40_000
+        obj = {"small": b"tiny", "big": big,
+               "nested": [b"x" * 100_000, {"k": big}], "n": 7}
+        payload, scs = dumps_with_sidecars(obj, 64 << 10)
+        assert len(scs) == 3
+        assert len(payload) < 200  # bulk never enters the tagged payload
+        back = loads_with_sidecars(payload, [bytes(s) for s in scs])
+        assert back == obj
+
+    def test_codec_below_threshold_inline(self):
+        from yugabyte_tpu.rpc.codec import dumps_with_sidecars
+        payload, scs = dumps_with_sidecars({"v": b"x" * 100}, 64 << 10)
+        assert scs == []
+        assert loads(payload) == {"v": b"x" * 100}
+
+    def test_big_payload_rides_segments(self, pair):
+        from yugabyte_tpu.rpc import messenger as M
+        server, client = pair
+        blob = bytes(range(256)) * 4096  # 1 MB
+        before = M.sidecar_frames_sent
+        got = client.call(server.address, "echo", "echo", x=blob)
+        assert got == blob
+        # request AND response each moved the blob as a segment
+        assert M.sidecar_frames_sent >= before + 2
+
+    def test_remote_bootstrap_chunks_use_segment_path(self, tmp_path):
+        """A bulk file fetch must take the sidecar path, not the tagged
+        codec (VERDICT r4 #7: bootstrap paid full serialize/copy)."""
+        import os
+        from yugabyte_tpu.rpc import messenger as M
+        from yugabyte_tpu.tserver.remote_bootstrap import FETCH_CHUNK
+
+        class FileService:
+            def fetch(self, path, offset, length):
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(min(length, FETCH_CHUNK))
+
+        server = Messenger("src")
+        server.register_service("files", FileService())
+        client = Messenger("dst")
+        try:
+            src = tmp_path / "tablet.sst"
+            src.write_bytes(os.urandom(4 << 20))  # 4 MB "tablet"
+            before_frames = M.sidecar_frames_sent
+            before_bytes = M.sidecar_bytes_sent
+            out = bytearray()
+            off = 0
+            while True:
+                chunk = client.call(server.address, "files", "fetch",
+                                    path=str(src), offset=off,
+                                    length=FETCH_CHUNK)
+                if not chunk:
+                    break
+                out += chunk
+                off += len(chunk)
+            assert bytes(out) == src.read_bytes()
+            assert M.sidecar_frames_sent > before_frames
+            assert M.sidecar_bytes_sent - before_bytes >= 4 << 20
+        finally:
+            client.shutdown()
+            server.shutdown()

@@ -31,6 +31,7 @@ def fast_raft_and_clean_points():
     # These tests pin exact interleavings with sync points; the heartbeat
     # batch window serializes batched RPCs per destination and has made
     # elections miss their window under full-suite load — disable it.
+    import yugabyte_tpu.consensus.multi_raft_batcher  # noqa: F401 (flag def)
     flags.set_flag("multi_raft_batch_window_ms", 0)
     yield
     sync_point.clear()

@@ -94,3 +94,66 @@ def test_ysck_healthy_cluster(tmp_path):
         client.close()
     finally:
         mc.shutdown()
+
+
+def test_ts_cli_and_bulk_load(tmp_path, capsys):
+    """yb-ts-cli levers + CSV bulk load (ref: src/yb/tools/yb-ts-cli.cc,
+    yb_bulk_load.cc)."""
+    from yugabyte_tpu.integration.mini_cluster import (
+        MiniCluster, MiniClusterOptions)
+    from yugabyte_tpu.tools import bulk_load, ts_cli
+    from yugabyte_tpu.utils import flags
+
+    flags.set_flag("replication_factor", 1)
+    mc = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=1,
+        fs_root=str(tmp_path / "tscli"))).start()
+    try:
+        client = mc.new_client()
+        client.create_namespace("bl")
+        schema = Schema([ColumnSchema("k", DataType.STRING),
+                         ColumnSchema("n", DataType.INT64),
+                         ColumnSchema("note", DataType.STRING)], 1, 0)
+        client.create_table("bl", "items", schema, num_tablets=2)
+
+        # bulk load a CSV through the client path
+        csv_path = tmp_path / "items.csv"
+        with open(csv_path, "w") as f:
+            f.write("k,n,note\n")
+            for i in range(200):
+                f.write(f"key{i:04d},{i},row-{i}\n")
+        rc = bulk_load.main(["--master", mc.masters[0].address,
+                             "--namespace", "bl", "--table", "items",
+                             "--csv", str(csv_path), "--batch", "64"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        import json as _json
+        stats = _json.loads(out.strip().splitlines()[-1])
+        assert stats["rows"] == 200
+
+        # spot-check a loaded row via the client
+        t = client.open_table("bl", "items")
+        row = client.read_row(t, DocKey(hash_components=("key0042",)))
+        assert row is not None
+        assert row.to_dict(t.schema)["n"] == 42
+
+        # ts-cli against the lone tserver
+        addr = mc.tservers[0].address
+        assert ts_cli.main(["--server", addr, "list_tablets"]) == 0
+        tablets = _json.loads(capsys.readouterr().out)
+        assert len(tablets) >= 2
+        assert ts_cli.main(["--server", addr, "flush_tablet",
+                            tablets[0]]) == 0
+        capsys.readouterr()
+        assert ts_cli.main(["--server", addr, "compact_tablet",
+                            tablets[0]]) == 0
+        capsys.readouterr()
+        assert ts_cli.main(["--server", addr, "flush_all_tablets"]) == 0
+        capsys.readouterr()
+        assert ts_cli.main(["--server", addr, "status"]) == 0
+        status = _json.loads(capsys.readouterr().out)
+        assert status["tablets"], "status report should list tablets"
+        assert ts_cli.main(["--server", addr, "are_tablets_running"]) == 0
+        client.close()
+    finally:
+        mc.shutdown()

@@ -22,7 +22,6 @@ from __future__ import annotations
 import struct
 import threading
 import time
-from dataclasses import dataclass
 from functools import total_ordering
 
 kBitsForLogicalComponent = 12
@@ -34,15 +33,28 @@ ENCODED_DOC_HT_SIZE = 12  # bytes: 8 (ht complement) + 4 (write_id complement)
 
 
 @total_ordering
-@dataclass(frozen=True)
 class HybridTime:
-    """64-bit hybrid timestamp: (physical_micros << 12) | logical."""
+    """64-bit hybrid timestamp: (physical_micros << 12) | logical.
 
-    value: int = 0
+    A plain __slots__ class, not a dataclass: one HybridTime is built per
+    KV on every write and read path, and frozen-dataclass __init__ was the
+    single hottest line of the ingest profile. Value-semantics (eq / hash /
+    total order) are preserved; treat instances as immutable."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: int = 0):
+        self.value = value
 
     @staticmethod
     def from_micros(micros: int, logical: int = 0) -> "HybridTime":
         return HybridTime((micros << kBitsForLogicalComponent) | logical)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, HybridTime) and self.value == other.value
+
+    def __hash__(self) -> int:
+        return hash((HybridTime, self.value))
 
     @property
     def physical_micros(self) -> int:
@@ -75,12 +87,24 @@ HybridTime.kInvalid = HybridTime(_U64)
 
 
 @total_ordering
-@dataclass(frozen=True)
 class DocHybridTime:
-    """HybridTime + write_id; sorts by (ht, write_id), encoded descending in keys."""
+    """HybridTime + write_id; sorts by (ht, write_id), encoded descending
+    in keys. __slots__ value class for the same hot-path reason as
+    HybridTime; treat instances as immutable."""
 
-    ht: HybridTime = HybridTime(0)
-    write_id: int = 0
+    __slots__ = ("ht", "write_id")
+
+    def __init__(self, ht: HybridTime = None, write_id: int = 0):
+        self.ht = ht if ht is not None else HybridTime(0)
+        self.write_id = write_id
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, DocHybridTime)
+                and self.ht.value == other.ht.value
+                and self.write_id == other.write_id)
+
+    def __hash__(self) -> int:
+        return hash((DocHybridTime, self.ht.value, self.write_id))
 
     def encoded(self) -> bytes:
         """Fixed 12-byte descending encoding (see module docstring)."""

@@ -132,3 +132,75 @@ def loads(buf: bytes) -> Any:
     if off != len(buf):
         raise ValueError(f"trailing garbage: {len(buf) - off} bytes")
     return obj
+
+
+# ---------------------------------------------------------------- sidecars
+# Bulk bytes values ride OUTSIDE the tagged payload as separate segments —
+# the reference's RPC sidecars (ref: src/yb/rpc/rpc_context.h AddRpcSidecar,
+# used by read_query.cc:598 for big scan pages, remote bootstrap chunks and
+# CDC batches). The payload holds a tag 'B' + sidecar index; the segment
+# bytes are never re-encoded, never scanned, and sent straight from the
+# caller's buffer (memoryview) by the messenger's vectored send.
+
+def _dump_sc(obj: Any, out: List[bytes], sidecars: List[memoryview],
+             min_bytes: int) -> None:
+    if isinstance(obj, (bytes, bytearray, memoryview)) \
+            and len(obj) >= min_bytes:
+        out.append(b"B")
+        _write_varint(out, len(sidecars))
+        sidecars.append(memoryview(obj))
+    elif isinstance(obj, (list, tuple)):
+        out.append(b"l")
+        _write_varint(out, len(obj))
+        for item in obj:
+            _dump_sc(item, out, sidecars, min_bytes)
+    elif isinstance(obj, dict):
+        out.append(b"d")
+        _write_varint(out, len(obj))
+        for k, v in obj.items():
+            _dump(k, out)  # keys are small scalars: never sidecar'd
+            _dump_sc(v, out, sidecars, min_bytes)
+    else:
+        _dump(obj, out)
+
+
+def dumps_with_sidecars(obj: Any, min_bytes: int
+                        ) -> Tuple[bytes, List[memoryview]]:
+    """(payload, sidecars): bytes values >= min_bytes are externalized."""
+    out: List[bytes] = []
+    sidecars: List[memoryview] = []
+    _dump_sc(obj, out, sidecars, min_bytes)
+    return b"".join(out), sidecars
+
+
+def _load_sc(buf: bytes, off: int, sidecars) -> Tuple[Any, int]:
+    tag = buf[off:off + 1]
+    if tag == b"B":
+        idx, off = _read_varint(buf, off + 1)
+        return sidecars[idx], off
+    if tag == b"l":
+        n, off = _read_varint(buf, off + 1)
+        items = []
+        for _ in range(n):
+            item, off = _load_sc(buf, off, sidecars)
+            items.append(item)
+        return items, off
+    if tag == b"d":
+        n, off = _read_varint(buf, off + 1)
+        d = {}
+        for _ in range(n):
+            k, off = _load(buf, off)
+            v, off = _load_sc(buf, off, sidecars)
+            d[k] = v
+        return d, off
+    return _load(buf, off)
+
+
+def loads_with_sidecars(buf: bytes, sidecars) -> Any:
+    """Inverse of dumps_with_sidecars; sidecar entries are spliced back in
+    as the bytes-like objects given (receive path passes exact-sized
+    buffers filled straight from the socket — no reassembly copy)."""
+    obj, off = _load_sc(buf, 0, sidecars)
+    if off != len(buf):
+        raise ValueError(f"trailing garbage: {len(buf) - off} bytes")
+    return obj

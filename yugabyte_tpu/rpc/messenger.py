@@ -54,6 +54,11 @@ flags.define_flag("rpc_compression_min_bytes", 32 << 10,
                   "rpc/compressed_stream.cc); 0 disables")
 flags.define_flag("rpc_connect_timeout_s", 5.0,
                   "TCP connect timeout for outbound connections")
+flags.define_flag("rpc_sidecar_min_bytes", 64 << 10,
+                  "bytes values at or above this size travel as zero-copy "
+                  "sidecar segments outside the tagged payload (remote "
+                  "bootstrap chunks, CDC batches, big scan pages; ref "
+                  "rpc/rpc_context.h sidecars); 0 disables")
 
 _LEN = struct.Struct("<I")
 
@@ -191,6 +196,94 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 _COMPRESS_BIT = 0x80000000
+_SIDECAR_BIT = 0x40000000
+
+# observability: sidecar frames sent / segment bytes moved (tests assert
+# the zero-copy path actually carries the bulk traffic)
+sidecar_frames_sent = 0
+sidecar_bytes_sent = 0
+
+
+def _send_message(sock: socket.socket, lock: threading.Lock, obj) -> None:
+    """Encode + send one message, externalizing bulk bytes as sidecar
+    segments (ref: rpc/rpc_context.h sidecars): the tagged payload carries
+    only references; segment bytes go to the socket STRAIGHT from the
+    caller's buffers via vectored send — no join, no re-encode, no
+    compression attempt over already-opaque bulk data.
+
+    Sidecar frame layout (length word has _SIDECAR_BIT set):
+        [u32 total|SIDECAR][u32 n_sc][u64 sc_len]*n [payload][sc bytes]*n
+    """
+    from yugabyte_tpu.rpc.codec import dumps_with_sidecars
+    min_sc = flags.get_flag("rpc_sidecar_min_bytes")
+    if not min_sc:
+        _send_frame(sock, lock, dumps(obj))
+        return
+    payload, sidecars = dumps_with_sidecars(obj, min_sc)
+    if not sidecars:
+        _send_frame(sock, lock, payload)
+        return
+    global sidecar_frames_sent, sidecar_bytes_sent
+    sidecar_frames_sent += 1
+    sidecar_bytes_sent += sum(len(s) for s in sidecars)
+    n_sc = len(sidecars)
+    header = bytearray()
+    header += struct.pack("<I", n_sc)
+    for sc in sidecars:
+        header += struct.pack("<Q", len(sc))
+    total = len(header) + len(payload) + sum(len(s) for s in sidecars)
+    bufs = [_LEN.pack(total | _SIDECAR_BIT), bytes(header), payload,
+            *sidecars]
+    with lock:
+        if hasattr(sock, "sendmsg"):
+            # vectored send; loop for short writes, and cap each call at
+            # IOV_MAX-ish buffers (Linux 1024) — a scan/CDC response with
+            # thousands of sidecar'd chunks would otherwise EMSGSIZE
+            view_left = bufs
+            while view_left:
+                sent = sock.sendmsg(view_left[:1000])
+                while view_left and sent >= len(view_left[0]):
+                    sent -= len(view_left[0])
+                    view_left = view_left[1:]
+                if sent and view_left:
+                    view_left = [memoryview(view_left[0])[sent:],
+                                 *view_left[1:]]
+        else:
+            for b in bufs:  # TLS adapter: sequential sendall
+                sock.sendall(b)
+
+
+def _recv_message(sock: socket.socket):
+    """Receive + decode one message (inverse of _send_message). Sidecar
+    segments are read with recv_into straight into exact-sized buffers —
+    one kernel->buffer copy, no reassembly join."""
+    from yugabyte_tpu.rpc.codec import loads_with_sidecars
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if not n & _SIDECAR_BIT:
+        return loads(_recv_body(sock, n))
+    total = n & ~_SIDECAR_BIT
+    (n_sc,) = struct.unpack("<I", _recv_exact(sock, 4))
+    lens = struct.unpack(f"<{n_sc}Q", _recv_exact(sock, 8 * n_sc))
+    payload_len = total - 4 - 8 * n_sc - sum(lens)
+    payload = _recv_exact(sock, payload_len)
+    sidecars = []
+    for ln in lens:
+        # exact-sized buffer filled straight from the socket; the
+        # bytearray itself is spliced into the message (bytes-like,
+        # equality-compatible) — no second copy
+        buf = bytearray(ln)
+        if hasattr(sock, "recv_into"):
+            view = memoryview(buf)
+            got = 0
+            while got < ln:
+                r = sock.recv_into(view[got:], ln - got)
+                if not r:
+                    raise ConnectionError("peer closed mid-sidecar")
+                got += r
+        else:
+            buf[:] = _recv_exact(sock, ln)
+        sidecars.append(buf)
+    return loads_with_sidecars(payload, sidecars)
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock,
@@ -212,13 +305,19 @@ def _send_frame(sock: socket.socket, lock: threading.Lock,
         sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_frame(sock: socket.socket) -> bytes:
+def _recv_body(sock: socket.socket, len_word: int) -> bytes:
+    """Read + (if flagged) decompress one plain frame body given its
+    already-read length word — shared by the sidecar and plain paths."""
     import zlib
-    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    body = _recv_exact(sock, n & ~_COMPRESS_BIT)
-    if n & _COMPRESS_BIT:
+    body = _recv_exact(sock, len_word & ~_COMPRESS_BIT)
+    if len_word & _COMPRESS_BIT:
         body = zlib.decompress(body)
     return body
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_body(sock, n)
 
 
 class _ClientConnection:
@@ -244,7 +343,7 @@ class _ClientConnection:
     def _read_loop(self) -> None:
         try:
             while True:
-                resp = loads(_recv_frame(self.sock))
+                resp = _recv_message(self.sock)
                 with self.lock:
                     waiter = self.pending.pop(resp["id"], None)
                 if waiter is not None:
@@ -266,10 +365,10 @@ class _ClientConnection:
             self.next_id += 1
             waiter = {"event": threading.Event(), "resp": None}
             self.pending[call_id] = waiter
-        payload = dumps({"id": call_id, "svc": svc, "mth": mth,
-                         "args": args, "deadline_s": timeout_s})
+        req_msg = {"id": call_id, "svc": svc, "mth": mth,
+                   "args": args, "deadline_s": timeout_s}
         try:
-            _send_frame(self.sock, self.write_lock, payload)
+            _send_message(self.sock, self.write_lock, req_msg)
         except OSError as e:
             with self.lock:
                 self.pending.pop(call_id, None)
@@ -389,7 +488,7 @@ class Messenger:
                 return
         try:
             while True:
-                req = loads(_recv_frame(conn))
+                req = _recv_message(conn)
                 # Handlers run off-connection so one slow handler does not
                 # head-of-line-block the connection; the pool reuses
                 # workers (the reference's ServicePool).
@@ -408,7 +507,7 @@ class Messenger:
         resp = self._invoke(req["svc"], req["mth"], req["args"], peer=peer)
         resp["id"] = req["id"]
         try:
-            _send_frame(conn, write_lock, dumps(resp))
+            _send_message(conn, write_lock, resp)
         except OSError:
             pass  # caller gone; response dropped like an expired call
 

@@ -1,0 +1,104 @@
+"""yb-ts-cli: per-tablet-server operations CLI.
+
+Capability parity with the reference (ref: src/yb/tools/yb-ts-cli.cc —
+status, list_tablets, flush_tablet, compact_tablet, are_tablets_running,
+dump_tablet against ONE tserver, no master involved).
+
+Usage: python -m yugabyte_tpu.tools.ts_cli --server <host:port> <cmd> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from yugabyte_tpu.rpc.messenger import Messenger
+from yugabyte_tpu.utils.status import StatusError
+
+
+def _p(obj) -> None:
+    print(json.dumps(obj, indent=2, default=lambda b: b.hex()
+                     if isinstance(b, bytes) else str(b)))
+
+
+class TsCli:
+    def __init__(self, server_addr: str):
+        self.addr = server_addr
+        self.m = Messenger("ts-cli")
+
+    def call(self, mth, **kw):
+        return self.m.call(self.addr, "tserver", mth, **kw)
+
+    def status(self) -> None:
+        _p(self.call("status"))
+
+    def list_tablets(self) -> None:
+        _p(self.call("list_tablets"))
+
+    def are_tablets_running(self) -> int:
+        """Exit 0 iff every hosted tablet reports RUNNING (the reference's
+        readiness probe for rolling restarts)."""
+        report = self.call("status")["tablets"]
+        not_running = [t for t in report
+                       if t.get("state", "RUNNING") != "RUNNING"]
+        _p({"total": len(report), "not_running": not_running})
+        return 1 if not_running else 0
+
+    def flush_tablet(self, tablet_id: str) -> None:
+        _p({"flushed": self.call("flush_tablet", tablet_id=tablet_id)})
+
+    def flush_all_tablets(self) -> None:
+        out = {}
+        for tid in self.call("list_tablets"):
+            out[tid] = self.call("flush_tablet", tablet_id=tid)
+        _p(out)
+
+    def compact_tablet(self, tablet_id: str) -> None:
+        _p({"compacted": self.call("compact_tablet", tablet_id=tablet_id)})
+
+    def compact_all_tablets(self) -> None:
+        out = {}
+        for tid in self.call("list_tablets"):
+            out[tid] = self.call("compact_tablet", tablet_id=tid)
+        _p(out)
+
+    def dump_tablet(self, tablet_id: str) -> None:
+        _p(self.call("dump_tablet", tablet_id=tablet_id,
+                     read_ht=(1 << 62)))
+
+    def delete_tablet(self, tablet_id: str) -> None:
+        _p({"deleted": self.call("delete_tablet", tablet_id=tablet_id)})
+
+    def close(self) -> None:
+        self.m.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="yb-ts-cli")
+    ap.add_argument("--server", required=True, help="tserver host:port")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    sub.add_parser("list_tablets")
+    sub.add_parser("are_tablets_running")
+    sub.add_parser("flush_all_tablets")
+    sub.add_parser("compact_all_tablets")
+    for name in ("flush_tablet", "compact_tablet", "dump_tablet",
+                 "delete_tablet"):
+        p = sub.add_parser(name)
+        p.add_argument("tablet_id")
+    args = ap.parse_args(argv)
+    cli = TsCli(args.server)
+    try:
+        fn = getattr(cli, args.cmd)
+        rc = fn(args.tablet_id) if hasattr(args, "tablet_id") else fn()
+        return int(rc or 0)
+    except StatusError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        cli.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
