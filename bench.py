@@ -625,6 +625,7 @@ def _scan_point_stages(n_rows: int) -> dict:
                 f"{out['seq_scan_rows_per_sec']/1e6:.2f}M rows/s, "
                 f"{out['seq_scan_mb_per_sec']:.0f} MB/s")
         # baseline column: the pure-Python merged iterator over the same DB
+        prior_native = _flags.get_flag("read_native")
         _flags.set_flag("read_native", False)
         try:
             t0 = time.time()
@@ -640,7 +641,7 @@ def _scan_point_stages(n_rows: int) -> dict:
             out["seq_scan_py_rows_per_sec"] = round(py_rate, 1)
             out["seq_scan_py_mb_per_sec"] = round(nbytes / dt / 1e6, 1)
         finally:
-            _flags.set_flag("read_native", True)
+            _flags.set_flag("read_native", prior_native)
         if "seq_scan_rows_per_sec" not in out:
             # no native engine: the Python number IS the scan number
             out["seq_scan_rows_per_sec"] = out["seq_scan_py_rows_per_sec"]
@@ -669,6 +670,7 @@ def _scan_point_stages(n_rows: int) -> dict:
         dt = time.time() - t0
         out["point_miss_per_sec"] = round(m / dt, 1)
         # baseline column: the Python heap-merge get over the same DB
+        prior_native = _flags.get_flag("read_native")
         _flags.set_flag("read_native", False)
         try:
             mp = 2_000
@@ -677,7 +679,7 @@ def _scan_point_stages(n_rows: int) -> dict:
                 assert db.get(b"Suser%08d\x00\x00!" % i) is not None
             out["point_reads_py_per_sec"] = round(mp / (time.time() - t0), 1)
         finally:
-            _flags.set_flag("read_native", True)
+            _flags.set_flag("read_native", prior_native)
         log(f"  point reads: {out['point_reads_per_sec']:.0f}/s hit "
             f"(python baseline {out['point_reads_py_per_sec']:.0f}/s), "
             f"{out['point_miss_per_sec']:.0f}/s bloom-gated miss")

@@ -101,6 +101,83 @@ REGRESS = [
      [("ada",), ("cyd",)]),
     ("SELECT city, COUNT(*) FROM customers GROUP BY city ORDER BY city",
      [("london", "2"), ("oslo", "1"), ("paris", "1")]),
+    # ---- IN lists (ref: PG scalar array ops) ---------------------------
+    ("SELECT name FROM customers WHERE cid IN (1, 3) ORDER BY name",
+     [("ada",), ("cyd",)]),
+    ("SELECT name FROM customers WHERE cid NOT IN (1, 2, 3)",
+     [("dee",)]),
+    ("SELECT oid FROM orders WHERE pid IN (11) AND qty > 2", [("102",)]),
+    # ---- IN / NOT IN subqueries (ref: PG SubLink hashed subplans) ------
+    ("SELECT name FROM customers WHERE cid IN "
+     "(SELECT cid FROM orders WHERE qty > 2) ORDER BY name",
+     [("bob",), ("cyd",)]),
+    ("SELECT name FROM customers WHERE cid NOT IN "
+     "(SELECT cid FROM orders WHERE pid = 11) ORDER BY name",
+     [("cyd",), ("dee",)]),
+    ("SELECT pname FROM products WHERE pid IN "
+     "(SELECT pid FROM orders WHERE cid IN "
+     "(SELECT cid FROM customers WHERE city = 'london')) ORDER BY pname",
+     [("anvil",), ("glue",), ("rope",)]),   # nested subqueries
+    ("SELECT name FROM customers WHERE cid IN "
+     "(SELECT cid FROM orders WHERE qty > 99)", []),   # empty IN set
+    # ---- EXISTS / NOT EXISTS ------------------------------------------
+    ("SELECT name FROM customers WHERE EXISTS "
+     "(SELECT oid FROM orders WHERE qty > 6) ORDER BY name",
+     [("ada",), ("bob",), ("cyd",), ("dee",)]),
+    ("SELECT name FROM customers WHERE NOT EXISTS "
+     "(SELECT oid FROM orders WHERE qty > 99) AND city = 'oslo'",
+     [("dee",)]),
+    ("SELECT name FROM customers WHERE EXISTS "
+     "(SELECT oid FROM orders WHERE qty > 99)", []),
+    # ---- scalar subqueries --------------------------------------------
+    ("SELECT pname FROM products WHERE price > "
+     "(SELECT price FROM products WHERE pname = 'rope')",
+     [("anvil",)]),
+    ("SELECT oid FROM orders WHERE qty = "
+     "(SELECT MAX(qty) FROM orders)", [("103",)]),
+    ("SELECT pname FROM products WHERE price < "
+     "(SELECT AVG(price) FROM products) ORDER BY pname",
+     [("glue",), ("rope",)]),
+    # scalar subquery returning no row compares as NULL: matches nothing
+    ("SELECT pname FROM products WHERE price = "
+     "(SELECT price FROM products WHERE pname = 'ghost')", []),
+    # ---- HAVING (ref: PG nodeAgg qual) --------------------------------
+    ("SELECT city, COUNT(*) FROM customers GROUP BY city "
+     "HAVING COUNT(*) > 1", [("london", "2")]),
+    ("SELECT cid, SUM(qty) FROM orders GROUP BY cid "
+     "HAVING SUM(qty) >= 3 ORDER BY cid",
+     [("1", "3"), ("2", "3"), ("3", "7")]),
+    ("SELECT city FROM customers GROUP BY city HAVING city != 'oslo' "
+     "ORDER BY city", [("london",), ("paris",)]),
+    ("SELECT cid, COUNT(*) FROM orders GROUP BY cid "
+     "HAVING MAX(qty) < 3 AND COUNT(*) > 1", [("1", "2")]),
+    # HAVING without GROUP BY gates the single overall group
+    ("SELECT COUNT(*) FROM orders HAVING COUNT(*) > 99", []),
+    # ---- UNION / UNION ALL (ref: PG set operations) -------------------
+    ("SELECT name FROM customers WHERE city = 'london' UNION "
+     "SELECT name FROM customers WHERE city = 'paris' ORDER BY name",
+     [("ada",), ("bob",), ("cyd",)]),
+    ("SELECT city FROM customers WHERE cid = 1 UNION "
+     "SELECT city FROM customers WHERE cid = 3",
+     [("london",)]),   # UNION dedups
+    ("SELECT city FROM customers WHERE cid = 1 UNION ALL "
+     "SELECT city FROM customers WHERE cid = 3",
+     [("london",), ("london",)]),   # UNION ALL keeps duplicates
+    ("SELECT cid FROM customers WHERE city = 'oslo' UNION "
+     "SELECT cid FROM orders WHERE qty = 1 ORDER BY cid",
+     [("1",), ("4",), ("9",)]),    # cross-table union
+    ("SELECT name FROM customers WHERE cid = 1 UNION "
+     "SELECT name FROM customers WHERE cid = 2 UNION ALL "
+     "SELECT name FROM customers WHERE cid = 1 ORDER BY name LIMIT 2",
+     [("ada",), ("ada",)]),        # mixed chain + trailing LIMIT
+    # ---- combinations --------------------------------------------------
+    ("SELECT cid, SUM(qty) FROM orders WHERE pid IN "
+     "(SELECT pid FROM products WHERE price < 50) GROUP BY cid "
+     "HAVING SUM(qty) > 1 ORDER BY cid",
+     [("2", "3"), ("3", "7")]),
+    ("SELECT name FROM customers WHERE cid IN (SELECT cid FROM orders) "
+     "UNION SELECT pname FROM products WHERE price > 50 ORDER BY name",
+     [("ada",), ("anvil",), ("bob",), ("cyd",)]),
 ]
 
 
@@ -252,3 +329,32 @@ class TestDroppedColumnStar:
         r = conn.query("SELECT * FROM star")[0]
         assert [c[0] for c in r.columns] == ["k", "b"]
         assert r.rows == [["1", "y"]]
+
+
+class TestDmlSubqueries:
+    def test_delete_with_in_subquery(self, conn):
+        conn.query("CREATE TABLE dml1 (k INT PRIMARY KEY, grp TEXT)")
+        conn.query("INSERT INTO dml1 (k, grp) VALUES (1, 'a'), (2, 'b'), "
+                   "(3, 'a'), (4, 'c')")
+        conn.query("CREATE TABLE doomed (g TEXT PRIMARY KEY)")
+        conn.query("INSERT INTO doomed (g) VALUES ('a'), ('c')")
+        conn.query("DELETE FROM dml1 WHERE grp IN (SELECT g FROM doomed)")
+        assert rows(conn, "SELECT k FROM dml1 ORDER BY k") == [("2",)]
+
+    def test_update_with_scalar_subquery_filter(self, conn):
+        conn.query("CREATE TABLE dml2 (k INT PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO dml2 (k, v) VALUES (1, 10), (2, 20), "
+                   "(3, 30)")
+        conn.query("UPDATE dml2 SET v = 99 WHERE v > "
+                   "(SELECT AVG(v) FROM dml2)")
+        assert rows(conn, "SELECT k, v FROM dml2 ORDER BY k") == \
+            [("1", "10"), ("2", "20"), ("3", "99")]
+
+    def test_in_subquery_inside_txn_block(self, conn):
+        conn.query("CREATE TABLE dml3 (k INT PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO dml3 (k, v) VALUES (1, 1), (2, 2)")
+        conn.query("BEGIN")
+        got = rows(conn, "SELECT k FROM dml3 WHERE k IN "
+                         "(SELECT k FROM dml3 WHERE v = 2)")
+        conn.query("COMMIT")
+        assert got == [("2",)]

@@ -123,6 +123,11 @@ FILTER_OPS = {
     "<=": lambda a, b: a is not None and a <= b,
     ">": lambda a, b: a is not None and a > b,
     ">=": lambda a, b: a is not None and a >= b,
+    # IN-list membership (b: sequence of literals). NULL never matches
+    # either form, and NOT IN over a list containing NULL matches nothing
+    # (PG three-valued logic; the executor pre-normalizes that case).
+    "in": lambda a, b: a is not None and a in b,
+    "not in": lambda a, b: a is not None and a not in b,
 }
 
 

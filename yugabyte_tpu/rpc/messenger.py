@@ -211,8 +211,11 @@ def _send_message(sock: socket.socket, lock: threading.Lock, obj) -> None:
     caller's buffers via vectored send — no join, no re-encode, no
     compression attempt over already-opaque bulk data.
 
-    Sidecar frame layout (length word has _SIDECAR_BIT set):
-        [u32 total|SIDECAR][u32 n_sc][u64 sc_len]*n [payload][sc bytes]*n
+    Sidecar frame layout (length word has _SIDECAR_BIT set; the length
+    word counts ONLY the small header + payload — segment sizes live in
+    the u64 table, so sidecar bytes are unbounded by the u32 framing):
+        [u32 (4+8n+payload_len)|SIDECAR][u32 n_sc][u64 sc_len]*n
+        [payload][sc bytes]*n
     """
     from yugabyte_tpu.rpc.codec import dumps_with_sidecars
     min_sc = flags.get_flag("rpc_sidecar_min_bytes")
@@ -231,8 +234,10 @@ def _send_message(sock: socket.socket, lock: threading.Lock, obj) -> None:
     header += struct.pack("<I", n_sc)
     for sc in sidecars:
         header += struct.pack("<Q", len(sc))
-    total = len(header) + len(payload) + sum(len(s) for s in sidecars)
-    bufs = [_LEN.pack(total | _SIDECAR_BIT), bytes(header), payload,
+    small = len(header) + len(payload)
+    if small >= _SIDECAR_BIT:
+        raise ValueError(f"RPC payload too large to frame: {small} bytes")
+    bufs = [_LEN.pack(small | _SIDECAR_BIT), bytes(header), payload,
             *sidecars]
     with lock:
         if hasattr(sock, "sendmsg"):
@@ -261,10 +266,10 @@ def _recv_message(sock: socket.socket):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if not n & _SIDECAR_BIT:
         return loads(_recv_body(sock, n))
-    total = n & ~_SIDECAR_BIT
+    small = n & ~_SIDECAR_BIT
     (n_sc,) = struct.unpack("<I", _recv_exact(sock, 4))
     lens = struct.unpack(f"<{n_sc}Q", _recv_exact(sock, 8 * n_sc))
-    payload_len = total - 4 - 8 * n_sc - sum(lens)
+    payload_len = small - 4 - 8 * n_sc
     payload = _recv_exact(sock, payload_len)
     sidecars = []
     for ln in lens:
@@ -293,6 +298,12 @@ def _send_frame(sock: socket.socket, lock: threading.Lock,
     like remote bootstrap chunks, CDC batches and big scan pages shrinks
     several-fold; small frames skip the codec cost)."""
     import zlib
+    if len(payload) >= _SIDECAR_BIT:
+        # bits 30/31 of the length word are flags; a >=1 GiB tagged
+        # payload cannot be framed (bulk bytes ride sidecars, whose u64
+        # length table has no such bound) — refuse loudly rather than
+        # desync the stream
+        raise ValueError(f"RPC payload too large to frame: {len(payload)}")
     min_bytes = flags.get_flag("rpc_compression_min_bytes")
     if min_bytes and len(payload) >= min_bytes:
         packed = zlib.compress(payload, 1)

@@ -294,7 +294,7 @@ class PgSession:
             return PgResult("DROP TABLE")
         if isinstance(stmt, P.Insert):
             return self._insert(stmt)
-        if isinstance(stmt, P.Select):
+        if isinstance(stmt, (P.Select, P.UnionSelect)):
             return self._select(stmt)
         if isinstance(stmt, P.Update):
             return self._update(stmt)
@@ -705,26 +705,48 @@ class PgSession:
             col_desc.append((group_col, col_oid(group_col)))
         for func, col in stmt.aggregates:
             col_desc.append((self._AGG_OUT_NAMES[func], agg_oid(func, col)))
+        def agg_value(func, col, members):
+            vals = ([1 for _ in members] if col is None
+                    else [m[col] for m in members
+                          if m.get(col) is not None])
+            if func == "COUNT":
+                return len(vals)
+            if not vals:
+                return None
+            if func == "SUM":
+                return sum(vals)
+            if func == "AVG":
+                return sum(vals) / len(vals)
+            if func == "MIN":
+                return min(vals)
+            return max(vals)  # MAX
+
+        from yugabyte_tpu.common.wire import FILTER_OPS
         rows_out = []
         for key in sorted(groups, key=lambda k: (k is None, k)):
             members = groups[key]
+            # HAVING gates the group BEFORE projection (ref: PG executor
+            # nodeAgg qual evaluation); having-only aggregates are
+            # computed here and never emitted
+            ok = True
+            for item, op, want in stmt.having:
+                if item[0] == "agg":
+                    got = agg_value(item[1], item[2], members)
+                else:
+                    if group_col is None or item[1] != group_col:
+                        raise PgError(Status.InvalidArgument(
+                            f'column "{item[1]}" must appear in GROUP BY '
+                            f'or be used in an aggregate function'),
+                            "42803")
+                    got = key
+                if got is None or not FILTER_OPS[op](got, want):
+                    ok = False
+                    break
+            if not ok:
+                continue
             row: List[object] = [key] if group_col is not None else []
             for func, col in stmt.aggregates:
-                vals = ([1 for _ in members] if col is None
-                        else [m[col] for m in members
-                              if m.get(col) is not None])
-                if func == "COUNT":
-                    row.append(len(vals))
-                elif not vals:
-                    row.append(None)
-                elif func == "SUM":
-                    row.append(sum(vals))
-                elif func == "AVG":
-                    row.append(sum(vals) / len(vals))
-                elif func == "MIN":
-                    row.append(min(vals))
-                elif func == "MAX":
-                    row.append(max(vals))
+                row.append(agg_value(func, col, members))
             rows_out.append(row)
         return col_desc, rows_out
 
@@ -747,6 +769,10 @@ class PgSession:
         tables) — those fall back to the materialized _select."""
         if (stmt.count_star or stmt.aggregates or stmt.group_by
                 or stmt.order_by or stmt.scalar_items or stmt.joins
+                or stmt.having
+                or any(op in ("exists", "not exists")
+                       or isinstance(v, P.Select)
+                       for _c, op, v in stmt.where)
                 or self._virtual_table_rows(stmt.table) is not None):
             return None
         stmt = self._strip_base_qualifiers(stmt)
@@ -943,6 +969,11 @@ class PgSession:
                 return ("func", it[1], [fix_item(a) for a in it[2]])
             return it
 
+        def fix_having(item):
+            if item[0] == "col":
+                return ("col", fix(item[1]))
+            return ("agg", item[1], fix(item[2]) if item[2] else item[2])
+
         return replace(
             stmt,
             columns=[fix(c) for c in stmt.columns] if stmt.columns else None,
@@ -951,9 +982,141 @@ class PgSession:
             scalar_items=[fix_item(i) for i in stmt.scalar_items],
             group_by=fix(stmt.group_by) if stmt.group_by else None,
             aggregates=[(f, fix(c) if c else c)
-                        for f, c in stmt.aggregates])
+                        for f, c in stmt.aggregates],
+            having=[(fix_having(i), op, v) for i, op, v in stmt.having])
 
-    def _select(self, stmt: P.Select) -> PgResult:
+    # --------------------------------------------------------- subqueries
+    def _resolve_subqueries(self, stmt: P.Select):
+        """Evaluate uncorrelated subqueries in WHERE up front (ref: PG
+        SubLink planning — hashed subplans for IN, one-shot InitPlans for
+        scalar/EXISTS). Returns (new_stmt, always_false): IN-subqueries
+        become literal tuples, scalar subqueries become literals,
+        EXISTS resolves to dropping the predicate or emptying the result.
+        A subquery referencing the outer row (correlation) fails inside
+        its own execution with a clear column error."""
+        from dataclasses import replace as _replace
+        if not any(isinstance(v, P.Select) or op in ("exists", "not exists")
+                   or (op == "not in" and isinstance(v, tuple)
+                       and any(x is None for x in v))
+                   for _c, op, v in stmt.where):
+            return stmt, False
+
+        def one_column_values(sub: P.Select) -> list:
+            res = self._select(sub)
+            rows = res.rows if res.rows is not None else \
+                list(res.row_iter or [])
+            if rows and len(rows[0]) != 1:
+                raise PgError(Status.InvalidArgument(
+                    "subquery must return only one column"), "42601")
+            return [r[0] for r in rows]
+
+        new_where = []
+        for c, op, v in stmt.where:
+            if op in ("exists", "not exists"):
+                sub = v
+                res = self._select(_replace(sub, limit=1))
+                rows = res.rows if res.rows is not None else \
+                    list(res.row_iter or [])
+                hit = bool(rows)
+                if (op == "exists") != hit:
+                    return stmt, True  # predicate constant-false
+                continue  # constant-true: drop
+            if isinstance(v, P.Select):
+                vals = one_column_values(v)
+                if op == "in":
+                    new_where.append((c, "in", tuple(vals)))
+                elif op == "not in":
+                    if any(x is None for x in vals):
+                        return stmt, True  # NOT IN with NULL: matches none
+                    new_where.append((c, "not in", tuple(vals)))
+                else:  # scalar subquery under a comparison
+                    if len(vals) > 1:
+                        raise PgError(Status.InvalidArgument(
+                            "more than one row returned by a subquery "
+                            "used as an expression"), "21000")
+                    if not vals or vals[0] is None:
+                        return stmt, True  # NULL comparison: matches none
+                    new_where.append((c, op, vals[0]))
+            elif op == "not in" and isinstance(v, tuple) \
+                    and any(x is None for x in v):
+                return stmt, True
+            else:
+                new_where.append((c, op, v))
+        return _replace(stmt, where=new_where), False
+
+    def _empty_select_result(self, stmt: P.Select) -> PgResult:
+        """Result over a constant-false WHERE. Plain selects get zero rows
+        with the right column description; UNGROUPED aggregates still
+        produce their single row over the empty set (PG: SELECT MAX(x)
+        ... WHERE false -> one NULL row, COUNT -> 0)."""
+        if stmt.count_star:
+            return PgResult("SELECT 1", [("count", 20)], [[0]])
+        table = self._table(stmt.table)
+        schema = table.schema
+        if stmt.aggregates or stmt.group_by:
+            col_desc, rows_out = self._aggregate(
+                stmt, lambda c: PG_OIDS[schema.column(c).type], [])
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+        out_cols = stmt.columns or [c.name for c in schema.columns
+                                    if not c.dropped]
+        try:
+            col_desc = [(c.split(".")[-1],
+                         PG_OIDS[schema.column(c.split(".")[-1]).type])
+                        for c in out_cols]
+        except KeyError:
+            col_desc = [(c, 25) for c in out_cols]
+        return PgResult("SELECT 0", col_desc, [])
+
+    def _select_union(self, stmt: P.UnionSelect) -> PgResult:
+        """UNION [ALL] chain: left-associative combine; any non-ALL link
+        dedups the accumulated set (PG set-operation semantics). Column
+        names come from the first member."""
+        first = self._select(stmt.selects[0])
+        if first.rows is None:
+            first = PgResult(first.tag, first.columns,
+                             list(first.row_iter or []))
+        col_desc = first.columns
+        acc = [tuple(r) for r in first.rows]
+        for sel, all_link in zip(stmt.selects[1:], stmt.alls):
+            res = self._select(sel)
+            rows = res.rows if res.rows is not None else \
+                list(res.row_iter or [])
+            if len(res.columns or []) != len(col_desc or []):
+                raise PgError(Status.InvalidArgument(
+                    "each UNION query must have the same number of "
+                    "columns"), "42601")
+            acc.extend(tuple(r) for r in rows)
+            if not all_link:
+                seen = set()
+                deduped = []
+                for r in acc:
+                    if r not in seen:
+                        seen.add(r)
+                        deduped.append(r)
+                acc = deduped
+        rows_out = [list(r) for r in acc]
+        if stmt.order_by:
+            names = [c for c, _oid in (col_desc or [])]
+            for col, desc in reversed(stmt.order_by):
+                if col not in names:
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{col}" does not exist'), "42703")
+                i = names.index(col)
+                rows_out.sort(
+                    key=lambda r: (r[i] is None,
+                                   0 if r[i] is None else r[i]),
+                    reverse=desc)
+        if stmt.limit is not None:
+            rows_out = rows_out[: stmt.limit]
+        return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+
+    def _select(self, stmt) -> PgResult:
+        if isinstance(stmt, P.UnionSelect):
+            return self._select_union(stmt)
+        resolved, always_false = self._resolve_subqueries(stmt)
+        if always_false:
+            return self._empty_select_result(stmt)
+        stmt = resolved
         if stmt.joins:
             return self._select_join(stmt)
         stmt = self._strip_base_qualifiers(stmt)
@@ -963,10 +1126,14 @@ class PgSession:
         table = self._table(stmt.table)
         schema = table.schema
         known = {c.name for c in schema.columns}
-        check_cols = list(stmt.columns or []) + [f[0] for f in stmt.where] \
+        check_cols = list(stmt.columns or []) \
+            + [f[0] for f in stmt.where if f[0]] \
             + [c for c, _d in stmt.order_by] \
             + ([stmt.group_by] if stmt.group_by else []) \
-            + [c for _f, c in stmt.aggregates if c is not None]
+            + [c for _f, c in stmt.aggregates if c is not None] \
+            + [i[1] for i, _o, _v in stmt.having if i[0] == "col"] \
+            + [i[2] for i, _o, _v in stmt.having
+               if i[0] == "agg" and i[2] is not None]
         for c in check_cols:
             if c not in known:
                 raise PgError(Status.InvalidArgument(
@@ -1086,9 +1253,20 @@ class PgSession:
             rows = self._scan(table, filters)
         return [row.doc_key for row in rows]
 
+    def _resolve_dml_where(self, table_name: str, where):
+        """Subquery support in UPDATE/DELETE predicates: resolve through
+        the SELECT machinery. Returns (where, always_false)."""
+        probe = P.Select(table_name, None, list(where))
+        resolved, always_false = self._resolve_subqueries(probe)
+        return resolved.where, always_false
+
     def _update(self, stmt: P.Update) -> PgResult:
         table = self._table(stmt.table)
         schema = table.schema
+        where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
+        if none_match:
+            return PgResult("UPDATE 0")
+        stmt = P.Update(stmt.table, stmt.assignments, where)
         key_names = {c.name for c in schema.hash_columns} | \
             {c.name for c in schema.range_columns}
         bad = [c for c, _v in stmt.assignments if c in key_names]
@@ -1118,6 +1296,10 @@ class PgSession:
         return PgResult(f"UPDATE {n}")
 
     def _delete(self, stmt: P.Delete) -> PgResult:
+        where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
+        if none_match:
+            return PgResult("DELETE 0")
+        stmt = P.Delete(stmt.table, where)
         table = self._table(stmt.table)
         dk, filters = self._split_where(table, stmt.where)
         if (dk is not None and not filters and not table.indexes

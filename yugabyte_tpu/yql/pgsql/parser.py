@@ -100,6 +100,10 @@ class Join:
 class Select:
     table: str
     columns: Optional[List[str]]       # None = *
+    # predicates: (col, op, value). op also includes "in"/"not in" (value
+    # a tuple of literals or a Select subquery) and "exists"/"not exists"
+    # (col "", value a Select). A Select as value with a comparison op is
+    # a scalar subquery. (ref: src/postgres/.../parse_expr.c SubLink)
     where: List[Tuple[str, str, object]] = field(default_factory=list)
     limit: Optional[int] = None
     count_star: bool = False
@@ -114,6 +118,22 @@ class Select:
     scalar_items: List = field(default_factory=list)
     group_by: Optional[str] = None
     order_by: List[Tuple[str, bool]] = field(default_factory=list)  # (col, desc)
+    # HAVING conjunction: (item, op, literal) where item is
+    # ("agg", FUNC, col_or_None) or ("col", name)
+    having: List[Tuple[tuple, str, object]] = field(default_factory=list)
+
+
+@dataclass
+class UnionSelect:
+    """SELECT ... UNION [ALL] SELECT ... chains (left-associative).
+    alls[i] is the ALL flag of the link between selects[i] and
+    selects[i+1]; ORDER BY / LIMIT of the final member bind to the whole
+    union, PG-style."""
+
+    selects: List[Select]
+    alls: List[bool]
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
 
 
 @dataclass
@@ -206,7 +226,7 @@ class PgParser(_BaseParser):
         if self.accept_kw("INSERT", "INTO"):
             return self._insert()
         if self.accept_kw("SELECT"):
-            return self._select()
+            return self._select_or_union()
         if self.accept_kw("UPDATE"):
             return self._update()
         if self.accept_kw("DELETE", "FROM"):
@@ -424,6 +444,34 @@ class PgParser(_BaseParser):
             self.expect_op(")")
         return ("func", fname, args)
 
+    def _select_or_union(self):
+        """One SELECT, or a UNION [ALL] chain (ref: PG set operations,
+        src/postgres/.../analyze.c transformSetOperationStmt). ORDER BY /
+        LIMIT parsed inside the LAST member bind to the whole union."""
+        first = self._select()
+        selects = [first]
+        alls: List[bool] = []
+        while self.accept_kw("UNION"):
+            if selects[-1].order_by or selects[-1].limit is not None:
+                raise ParseError(
+                    "ORDER BY/LIMIT must follow the last UNION member")
+            alls.append(bool(self.accept_kw("ALL")))
+            self.expect_kw("SELECT")
+            selects.append(self._select())
+        if len(selects) == 1:
+            return first
+        last = selects[-1]
+        order_by, limit = last.order_by, last.limit
+        last.order_by, last.limit = [], None
+        return UnionSelect(selects, alls, order_by, limit)
+
+    def _subselect(self) -> Select:
+        """'(' SELECT ... ')' (no nested unions inside predicates)."""
+        self.expect_kw("SELECT")
+        sub = self._select()
+        self.expect_op(")")
+        return sub
+
     def _select(self) -> Select:
         columns: Optional[List[str]] = None
         count_star = False
@@ -489,6 +537,14 @@ class PgParser(_BaseParser):
         group_by = None
         if self.accept_kw("GROUP", "BY"):
             group_by = self.name()
+        having: List[Tuple[tuple, str, object]] = []
+        if self.accept_kw("HAVING"):
+            while True:
+                item = self._having_item()
+                op = self._comparison_op()
+                having.append((item, op, self.literal()))
+                if not self.accept_kw("AND"):
+                    break
         order_by: List[Tuple[str, bool]] = []
         if self.accept_kw("ORDER", "BY"):
             while True:
@@ -507,29 +563,87 @@ class PgParser(_BaseParser):
         # a lone COUNT(*) with no grouping is the classic count-star fast
         # path; COUNT(*) under GROUP BY must stay an aggregate per group
         if (aggregates == [("COUNT", None)] and columns is None
-                and group_by is None):
+                and group_by is None and not having):
             count_star = True
             aggregates = []
         return Select(name, columns, where, limit, count_star,
                       alias=alias, joins=joins,
                       aggregates=aggregates, group_by=group_by,
-                      order_by=order_by, scalar_items=scalar_items)
+                      order_by=order_by, scalar_items=scalar_items,
+                      having=having)
+
+    def _having_item(self) -> tuple:
+        """("agg", FUNC, col_or_None) | ("col", name)."""
+        tok = self.peek()
+        if tok is not None and tok[0] == "name" \
+                and tok[1].upper() in self._AGG_FUNCS \
+                and self._peek2() == ("op", "("):
+            func = self.name().upper()
+            self.expect_op("(")
+            if self.accept_op("*"):
+                if func != "COUNT":
+                    raise ParseError(f"{func}(*) is not valid")
+                col = None
+            else:
+                col = self.name()
+            self.expect_op(")")
+            return ("agg", func, col)
+        return ("col", self._col_ref())
+
+    def _comparison_op(self) -> str:
+        tok = self.next()
+        if tok[0] != "op":
+            raise ParseError(f"expected operator, got {tok[1]!r}")
+        op = tok[1]
+        if op == "<" and self.accept_op(">"):
+            op = "!="  # <> tokenizes as two ops
+        if op not in ("=", "!=", "<", "<=", ">", ">="):
+            raise ParseError(f"unsupported operator {op!r}")
+        return op
 
     def _pg_where(self) -> List[Tuple[str, str, object]]:
         if not self.accept_kw("WHERE"):
             return []
         out = []
         while True:
-            col = self._col_ref()
-            tok = self.next()
-            if tok[0] != "op":
-                raise ParseError(f"expected operator, got {tok[1]!r}")
-            op = tok[1]
-            if op == "<" and self.accept_op(">"):
-                op = "!="  # <> tokenizes as two ops
-            if op not in ("=", "!=", "<", "<=", ">", ">="):
-                raise ParseError(f"unsupported operator {op!r}")
-            out.append((col, op, self.literal()))
+            # EXISTS / NOT EXISTS (SELECT ...)
+            if self.accept_kw("EXISTS"):
+                self.expect_op("(")
+                out.append(("", "exists", self._subselect()))
+            elif self.accept_kw("NOT", "EXISTS"):
+                self.expect_op("(")
+                out.append(("", "not exists", self._subselect()))
+            else:
+                col = self._col_ref()
+                in_op = None
+                if self.accept_kw("IN"):
+                    in_op = "in"
+                elif self.accept_kw("NOT", "IN"):
+                    in_op = "not in"
+                if in_op is not None:
+                    op = in_op
+                    self.expect_op("(")
+                    tok = self.peek()
+                    if tok is not None and tok[0] == "name" \
+                            and tok[1].upper() == "SELECT":
+                        out.append((col, op, self._subselect()))
+                    else:
+                        vals = [self.literal()]
+                        while self.accept_op(","):
+                            vals.append(self.literal())
+                        self.expect_op(")")
+                        out.append((col, op, tuple(vals)))
+                else:
+                    op = self._comparison_op()
+                    tok = self.peek()
+                    if tok == ("op", "(") \
+                            and self._peek2() is not None \
+                            and self._peek2()[0] == "name" \
+                            and self._peek2()[1].upper() == "SELECT":
+                        self.expect_op("(")
+                        out.append((col, op, self._subselect()))
+                    else:
+                        out.append((col, op, self.literal()))
             if not self.accept_kw("AND"):
                 break
         return out
@@ -565,6 +679,13 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
     if isinstance(stmt, Insert):
         return replace(stmt, rows=[[sub(v) for v in row]
                                    for row in stmt.rows])
+    if isinstance(stmt, UnionSelect):
+        ulimit = sub(stmt.limit)
+        if ulimit is not None:
+            ulimit = int(ulimit)
+        return replace(stmt, selects=[bind_params(s, params)
+                                      for s in stmt.selects],
+                       limit=ulimit)
     if isinstance(stmt, Select):
         limit = sub(stmt.limit)
         if limit is not None:
@@ -576,11 +697,20 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
             if it[0] == "func":
                 return ("func", it[1], [sub_item(a) for a in it[2]])
             return it
-        return replace(stmt, where=[(c, op, sub(v))
+
+        def sub_val(v):
+            if isinstance(v, Select):
+                return bind_params(v, params)  # subquery: recurse
+            if isinstance(v, tuple):
+                return tuple(sub(x) for x in v)  # IN list
+            return sub(v)
+        return replace(stmt, where=[(c, op, sub_val(v))
                                     for c, op, v in stmt.where],
                        limit=limit,
                        scalar_items=[sub_item(i)
-                                     for i in stmt.scalar_items])
+                                     for i in stmt.scalar_items],
+                       having=[(i, op, sub(v))
+                               for i, op, v in stmt.having])
     if isinstance(stmt, Update):
         return replace(stmt,
                        assignments=[(c, sub(v))
@@ -609,9 +739,22 @@ def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
         for row in stmt.rows:
             for j, v in enumerate(row):
                 visit(cols[j] if cols and j < len(cols) else ("pos", j), v)
+    elif isinstance(stmt, UnionSelect):
+        for s in stmt.selects:
+            out.extend(collect_param_columns(s))
+        visit("__limit__", stmt.limit)
     elif isinstance(stmt, Select):
         for c, _op, v in stmt.where:
-            visit(c, v)
+            if isinstance(v, Select):
+                out.extend(collect_param_columns(v))
+            elif isinstance(v, tuple):
+                for x in v:
+                    visit(c, x)
+            else:
+                visit(c, v)
+        for item, _op, v in stmt.having:
+            visit(item[2] if item[0] == "agg" and item[2] else "__having__",
+                  v)
         visit("__limit__", stmt.limit)
     elif isinstance(stmt, Update):
         for c, v in stmt.assignments:
