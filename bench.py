@@ -691,6 +691,87 @@ def _scan_point_stages(n_rows: int) -> dict:
     return out
 
 
+def _cluster_soak_stage() -> dict:
+    """BASELINE config 5 (VERDICT r4 next #6): 3-node RF=3 real-process
+    cluster, unpaced YCSB-A at the highest sustainable rate, with
+    background compaction plus one kill -9 + restart and one tablet
+    split mid-run. Records the measured ops/s and p99 — whatever they
+    are — instead of asserting a target.
+
+    ref: yb-perf-v1.0.7.md:6-8 (the 3-node YCSB-A configuration),
+    src/yb/integration-tests/linked_list-test.cc (the churn shape)."""
+    import shutil
+    import tempfile
+
+    from yugabyte_tpu.integration.external_mini_cluster import (
+        ExternalMiniCluster)
+    from yugabyte_tpu.integration.load_generator import (
+        YCSB_SCHEMA, YcsbALoadGenerator)
+
+    seconds = float(os.environ.get("YBTPU_BENCH_SOAK_SECONDS", 60))
+    root = tempfile.mkdtemp(prefix="ybtpu-bench-soak-")
+    out: dict = {}
+    c = None
+    gen = None
+    client = None
+    try:
+        c = ExternalMiniCluster(os.path.join(root, "cluster"),
+                                num_tservers=3, rf=3).start()
+        c.wait_tservers_alive(3)
+        client = c.new_client()
+        client.create_namespace("soak")
+        table = client.create_table("soak", "ycsb", YCSB_SCHEMA,
+                                    num_tablets=4)
+        gen = YcsbALoadGenerator(client, table, n_threads=8).start()
+        third = seconds / 3.0
+        time.sleep(third)
+        c.tservers[1].kill9()           # churn: node loss mid-load
+        time.sleep(third / 2)
+        c.tservers[1].start()           # recovery: bootstrap/catch-up
+        c.wait_tservers_alive(3)
+        time.sleep(third / 2)
+        locs = client._master_call("get_table_locations",
+                                   table_id=table.table_id)
+        client._master_call("split_tablet",
+                            tablet_id=locs[0]["tablet_id"])
+        time.sleep(third)
+        rep = gen.stop()
+        gen = None  # stopped cleanly; finally must not re-stop
+        out["cluster_ops_per_sec"] = rep.ops_per_sec
+        out["cluster_p50_ms"] = rep.p50_ms
+        out["cluster_p99_ms"] = rep.p99_ms
+        out["cluster_soak_seconds"] = rep.seconds
+        out["cluster_soak_errors"] = rep.errors
+        out["cluster_soak_ops"] = rep.ops
+        log(f"  cluster soak (3-node RF=3 YCSB-A + kill -9 + split): "
+            f"{rep.ops_per_sec:.0f} ops/s over {rep.seconds:.0f}s, "
+            f"p50 {rep.p50_ms}ms p99 {rep.p99_ms}ms, "
+            f"{rep.errors} errors")
+    except Exception as e:  # noqa: BLE001 — stage is best-effort
+        log(f"cluster soak stage failed: {e}")
+    finally:
+        # stop workers BEFORE tearing the cluster down — leaked unpaced
+        # threads would hammer dead sockets through retry backoff for the
+        # rest of the process (and destabilize later pytest stages)
+        if gen is not None:
+            try:
+                gen.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if client is not None:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if c is not None:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
     """Assemble a result dict from whatever stages a dead child finished."""
     recs = {}
@@ -884,6 +965,9 @@ def main():
     # independent of the device child's fate
     result.update(_scan_point_stages(
         int(result.get("n_rows") or n_top)))
+    # BASELINE config 5: the 3-node RF=3 cluster soak with churn
+    if os.environ.get("YBTPU_BENCH_SKIP_SOAK", "") != "1":
+        result.update(_cluster_soak_stage())
 
     if native_rate:
         result["e2e_native_rows_per_sec"] = round(native_rate, 1)

@@ -160,3 +160,114 @@ class LinkedListLoadGenerator:
         return {"present": sum(len(v) for v in present.values()),
                 "acked": sum(s.acked for s in self.chains),
                 "maybe": sum(len(s.maybe) for s in self.chains)}
+
+
+YCSB_SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING)],
+    num_hash_key_columns=1)
+
+
+@dataclass
+class YcsbReport:
+    ops: int
+    seconds: float
+    ops_per_sec: float
+    p50_ms: float
+    p99_ms: float
+    errors: int
+    reads: int
+    writes: int
+
+
+class YcsbALoadGenerator:
+    """Max-rate YCSB-A (50/50 read-update over a Zipf-ish hot set) —
+    the reference's perf harness workload (ref: yb-perf v1.0.7 YCSB-A on
+    a 3-node RF=3 cluster; src/yb/util/load_generator.h's multi-threaded
+    session writers). Unpaced: each thread issues its next op as soon as
+    the previous completes, so the measured rate IS the cluster's
+    sustainable throughput at this concurrency. Per-op latencies are
+    kept whole (ops counts are bounded by the run length) for exact
+    percentiles."""
+
+    def __init__(self, client: YBClient, table, n_threads: int = 8,
+                 key_space: int = 10_000, value_bytes: int = 64):
+        self._client = client
+        self._table = table
+        self._n_threads = n_threads
+        self._key_space = key_space
+        self._value = "v" * value_bytes
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lat_ms: List[List[float]] = []
+        self._counts: List[List[int]] = []  # [ops, errors, reads, writes]
+        self._t0 = 0.0
+        self._t1 = 0.0
+
+    def _worker(self, wid: int) -> None:
+        import random
+        rng = random.Random(1000 + wid)
+        session = YBSession(self._client)
+        lat = self._lat_ms[wid]
+        cnt = self._counts[wid]
+        while not self._stop.is_set():
+            # hot-set skew: 80% of ops hit 20% of the key space
+            if rng.random() < 0.8:
+                kid = rng.randrange(max(1, self._key_space // 5))
+            else:
+                kid = rng.randrange(self._key_space)
+            key = f"u{kid:08d}"
+            t0 = time.monotonic()
+            try:
+                if rng.random() < 0.5:
+                    session.apply(self._table, QLWriteOp(
+                        WriteOpKind.INSERT,
+                        DocKey(hash_components=(key,)),
+                        {"v": self._value}))
+                    session.flush()
+                    cnt[3] += 1
+                else:
+                    self._client.read_row(self._table,
+                                          DocKey(hash_components=(key,)))
+                    cnt[2] += 1
+                lat.append((time.monotonic() - t0) * 1000.0)
+                cnt[0] += 1
+            except StatusError:
+                cnt[1] += 1
+                time.sleep(0.05)
+
+    def start(self) -> "YcsbALoadGenerator":
+        self._t0 = time.monotonic()
+        for i in range(self._n_threads):
+            self._lat_ms.append([])
+            self._counts.append([0, 0, 0, 0])
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True, name=f"ycsb-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> YcsbReport:
+        # measurement window ends at stop-request time: a worker stuck in
+        # stop-unaware client retry backoff would otherwise inflate the
+        # denominator with an idle join tail and understate ops/s
+        self._t1 = time.monotonic()
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        lats = sorted(x for ls in self._lat_ms for x in ls)
+        ops = sum(c[0] for c in self._counts)
+        secs = self._t1 - self._t0
+
+        def pct(p: float) -> float:
+            if not lats:
+                return 0.0
+            return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+        return YcsbReport(
+            ops=ops, seconds=round(secs, 1),
+            ops_per_sec=round(ops / secs, 1) if secs else 0.0,
+            p50_ms=round(pct(0.50), 2), p99_ms=round(pct(0.99), 2),
+            errors=sum(c[1] for c in self._counts),
+            reads=sum(c[2] for c in self._counts),
+            writes=sum(c[3] for c in self._counts))
