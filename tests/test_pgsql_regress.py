@@ -178,6 +178,19 @@ REGRESS = [
     ("SELECT name FROM customers WHERE cid IN (SELECT cid FROM orders) "
      "UNION SELECT pname FROM products WHERE price > 50 ORDER BY name",
      [("ada",), ("anvil",), ("bob",), ("cyd",)]),
+    # ---- DISTINCT (PG unique node) ------------------------------------
+    ("SELECT DISTINCT city FROM customers ORDER BY city",
+     [("london",), ("oslo",), ("paris",)]),
+    ("SELECT DISTINCT cid FROM orders WHERE qty < 5 ORDER BY cid",
+     [("1",), ("2",), ("9",)]),
+    # ---- LIKE / NOT LIKE ----------------------------------------------
+    ("SELECT name FROM customers WHERE name LIKE '%d%' ORDER BY name",
+     [("ada",), ("cyd",), ("dee",)]),
+    ("SELECT name FROM customers WHERE city LIKE 'lon_on' ORDER BY name",
+     [("ada",), ("cyd",)]),
+    ("SELECT name FROM customers WHERE name NOT LIKE '%d%' ORDER BY name",
+     [("bob",)]),
+    ("SELECT pname FROM products WHERE pname LIKE 'a%'", [("anvil",)]),
 ]
 
 

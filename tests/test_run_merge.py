@@ -182,3 +182,36 @@ def test_write_id_tiebreak():
     _check_against_baseline([half, other], cutoff=(200 << 12),
                             is_major=True)
     _check_against_baseline([run], cutoff=(60 << 12), is_major=False)
+
+
+def test_pallas_failure_degrades_to_network(monkeypatch):
+    """A Mosaic lowering/runtime failure on the first real-TPU run must
+    degrade to the jnp network, not kill the compaction/bench process."""
+    from bench import _split_runs, synth_ycsb_runs
+    from yugabyte_tpu.ops import pallas_merge, run_merge
+    from yugabyte_tpu.ops.merge_gc import GCParams
+
+    slab, offsets = synth_ycsb_runs(1 << 12, 4, 1 << 11, seed=3)
+    staged = run_merge.stage_runs_from_slabs(_split_runs(slab, offsets))
+    params = GCParams((10_000_000 << 12), True)
+    expect = run_merge.launch_merge_gc(staged, params).result()
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("mosaic lowering exploded")
+
+    monkeypatch.setattr(pallas_merge, "launch_merge_gc_pallas", boom)
+    monkeypatch.setattr(run_merge, "_pallas_broken", False)
+    monkeypatch.setattr(run_merge, "_pick_impl", lambda s: "pallas")
+    got = run_merge.launch_merge_gc(staged, params).result()
+    assert calls["n"] == 1
+    # process-wide circuit breaker: no second pallas attempt
+    got2 = run_merge.launch_merge_gc(staged, params).result()
+    assert calls["n"] == 1
+    import numpy as np
+    for a, b in zip(expect, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(expect, got2):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

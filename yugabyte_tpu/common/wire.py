@@ -128,7 +128,35 @@ FILTER_OPS = {
     # (PG three-valued logic; the executor pre-normalizes that case).
     "in": lambda a, b: a is not None and a in b,
     "not in": lambda a, b: a is not None and a not in b,
+    # SQL LIKE (%/_ wildcards, full-string anchor); NULL never matches
+    "like": lambda a, b: isinstance(a, str) and _like_match(b, a),
+    "not like": lambda a, b: isinstance(a, str) and not _like_match(b, a),
 }
+
+
+def _like_match(pattern: str, value: str) -> bool:
+    """SQL LIKE evaluation: % = any run, _ = any one char, everything
+    else literal (regex metacharacters escaped). Compiled patterns are
+    cached — scans evaluate one pattern across many rows."""
+    import re
+    rx = _LIKE_CACHE.get(pattern)
+    if rx is None:
+        parts = []
+        for ch in pattern:
+            if ch == "%":
+                parts.append(".*")
+            elif ch == "_":
+                parts.append(".")
+            else:
+                parts.append(re.escape(ch))
+        rx = re.compile("^" + "".join(parts) + "$", re.DOTALL)
+        if len(_LIKE_CACHE) > 256:
+            _LIKE_CACHE.clear()
+        _LIKE_CACHE[pattern] = rx
+    return rx.match(value) is not None
+
+
+_LIKE_CACHE: dict = {}
 
 
 def row_matches(row_dict: dict, filters) -> bool:

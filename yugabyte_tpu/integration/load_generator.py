@@ -260,9 +260,14 @@ class YcsbALoadGenerator:
         secs = self._t1 - self._t0
 
         def pct(p: float) -> float:
+            """Nearest-rank percentile: ceil(p*n)-1, so p50 of two samples
+            is the lower one (the naive int(p*n) index reports the MAX of
+            two samples as the median)."""
             if not lats:
                 return 0.0
-            return lats[min(len(lats) - 1, int(p * len(lats)))]
+            import math
+            return lats[max(0, min(len(lats) - 1,
+                                   math.ceil(p * len(lats)) - 1))]
 
         return YcsbReport(
             ops=ops, seconds=round(secs, 1),

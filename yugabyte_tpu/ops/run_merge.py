@@ -457,12 +457,54 @@ def _pick_impl(staged: StagedRuns) -> str:
     return "pallas" if _jax.default_backend() == "tpu" else "network"
 
 
+_pallas_broken = False  # set on the first Mosaic lowering/runtime failure
+
+
+class _PallasFallbackHandle:
+    """Wraps a pallas launch so a lazy compile/runtime failure (surfacing
+    at .result()) degrades to the jnp network instead of killing the
+    caller — the first real-TPU run of the kernel must never take the
+    whole bench/compaction down with it."""
+
+    def __init__(self, inner, staged, params, snapshot):
+        self._inner = inner
+        self._args = (staged, params, snapshot)
+
+    def result(self):
+        global _pallas_broken
+        try:
+            return self._inner.result()
+        except Exception as e:  # noqa: BLE001 — lowering/launch failure
+            import sys as _sys
+            _pallas_broken = True
+            print(f"[run_merge] pallas kernel failed at result() — "
+                  f"falling back to the jnp network for this process: "
+                  f"{e!r}", file=_sys.stderr, flush=True)
+            staged, params, snapshot = self._args
+            return launch_merge_gc(staged, params,
+                                   snapshot=snapshot).result()
+
+
 def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot: bool = False) -> MergeGCHandle:
-    if _pick_impl(staged) == "pallas":
+    global _pallas_broken
+    explicit = os.environ.get("YBTPU_MERGE_IMPL", "auto") == "pallas"
+    if (not _pallas_broken or explicit) and _pick_impl(staged) == "pallas":
         from yugabyte_tpu.ops import pallas_merge
-        return pallas_merge.launch_merge_gc_pallas(staged, params,
-                                                   snapshot=snapshot)
+        try:
+            h = pallas_merge.launch_merge_gc_pallas(staged, params,
+                                                    snapshot=snapshot)
+        except Exception as e:  # noqa: BLE001 — trace/compile failure
+            if explicit:
+                raise
+            import sys as _sys
+            _pallas_broken = True
+            print(f"[run_merge] pallas kernel failed to launch — using "
+                  f"the jnp network for this process: {e!r}",
+                  file=_sys.stderr, flush=True)
+        else:
+            return h if explicit else _PallasFallbackHandle(
+                h, staged, params, snapshot)
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
     # runtime iota operand: see merge_network's pos docstring (compile-

@@ -68,6 +68,19 @@ def _pg_error(e: StatusError) -> PgError:
     return PgError(e.status, _SQLSTATE.get(e.status.code, "XX000"))
 
 
+def _dedup_rows(rows_out):
+    """First-occurrence dedup preserving order (SELECT DISTINCT applied
+    after projection, like PG's unique node over the sorted/plain path)."""
+    seen = set()
+    out = []
+    for r in rows_out:
+        key = tuple(r)
+        if key not in seen:
+            seen.add(key)
+            out.append(r)
+    return out
+
+
 class _Cursor:
     """One DECLARE'd cursor (the PG portal): a lazy row iterator, its
     column headers, the WITH HOLD flag, and whether the remaining rows
@@ -589,6 +602,8 @@ class PgSession:
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         dicts = self._order_rows(dicts, stmt.order_by)
         rows_out = [[d.get(c) for c in out_cols] for d in dicts]
+        if stmt.distinct:
+            rows_out = _dedup_rows(rows_out)
         if stmt.limit is not None:
             rows_out = rows_out[: stmt.limit]
         return PgResult(f"SELECT {len(rows_out)}",
@@ -769,7 +784,7 @@ class PgSession:
         tables) — those fall back to the materialized _select."""
         if (stmt.count_star or stmt.aggregates or stmt.group_by
                 or stmt.order_by or stmt.scalar_items or stmt.joins
-                or stmt.having
+                or stmt.having or stmt.distinct
                 or any(op in ("exists", "not exists")
                        or isinstance(v, P.Select)
                        for _c, op, v in stmt.where)
@@ -945,6 +960,8 @@ class PgSession:
             qorder = [("%s.%s" % resolve(c), d) for c, d in stmt.order_by]
             rows = self._order_rows(rows, qorder)
         rows_out = [[r.get(f"{a}.{c}") for a, c in proj] for r in rows]
+        if stmt.distinct:
+            rows_out = _dedup_rows(rows_out)
         if stmt.limit is not None:
             rows_out = rows_out[: stmt.limit]
         return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
@@ -1156,6 +1173,8 @@ class PgSession:
         if stmt.scalar_items:
             col_desc, rows_out = self._project_scalar(stmt.scalar_items,
                                                       schema, dicts)
+            if stmt.distinct:
+                rows_out = _dedup_rows(rows_out)
             if stmt.limit is not None:
                 rows_out = rows_out[: stmt.limit]
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
@@ -1163,6 +1182,8 @@ class PgSession:
                                     if not c.dropped]
         col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
         rows_out = [[d.get(c) for c in out_cols] for d in dicts]
+        if stmt.distinct:
+            rows_out = _dedup_rows(rows_out)  # after projection (PG order)
         if stmt.limit is not None:
             rows_out = rows_out[: stmt.limit]
         return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)

@@ -118,6 +118,7 @@ class Select:
     scalar_items: List = field(default_factory=list)
     group_by: Optional[str] = None
     order_by: List[Tuple[str, bool]] = field(default_factory=list)  # (col, desc)
+    distinct: bool = False             # SELECT DISTINCT
     # HAVING conjunction: (item, op, literal) where item is
     # ("agg", FUNC, col_or_None) or ("col", name)
     having: List[Tuple[tuple, str, object]] = field(default_factory=list)
@@ -473,6 +474,7 @@ class PgParser(_BaseParser):
         return sub
 
     def _select(self) -> Select:
+        distinct = bool(self.accept_kw("DISTINCT"))
         columns: Optional[List[str]] = None
         count_star = False
         aggregates: List[Tuple[str, Optional[str]]] = []
@@ -570,7 +572,7 @@ class PgParser(_BaseParser):
                       alias=alias, joins=joins,
                       aggregates=aggregates, group_by=group_by,
                       order_by=order_by, scalar_items=scalar_items,
-                      having=having)
+                      having=having, distinct=distinct)
 
     def _having_item(self) -> tuple:
         """("agg", FUNC, col_or_None) | ("col", name)."""
@@ -615,6 +617,16 @@ class PgParser(_BaseParser):
                 out.append(("", "not exists", self._subselect()))
             else:
                 col = self._col_ref()
+                if self.accept_kw("LIKE"):
+                    out.append((col, "like", self.literal()))
+                    if not self.accept_kw("AND"):
+                        break
+                    continue
+                if self.accept_kw("NOT", "LIKE"):
+                    out.append((col, "not like", self.literal()))
+                    if not self.accept_kw("AND"):
+                        break
+                    continue
                 in_op = None
                 if self.accept_kw("IN"):
                     in_op = "in"
