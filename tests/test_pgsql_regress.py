@@ -191,6 +191,26 @@ REGRESS = [
     ("SELECT name FROM customers WHERE name NOT LIKE '%d%' ORDER BY name",
      [("bob",)]),
     ("SELECT pname FROM products WHERE pname LIKE 'a%'", [("anvil",)]),
+    # ---- OR disjunctions (PG BitmapOr-shaped union of branches) --------
+    ("SELECT name FROM customers WHERE city = 'oslo' OR city = 'paris' "
+     "ORDER BY name", [("bob",), ("dee",)]),
+    ("SELECT name FROM customers WHERE cid = 1 OR cid = 3 OR cid = 4 "
+     "ORDER BY name", [("ada",), ("cyd",), ("dee",)]),
+    # AND binds tighter than OR: (city=london AND cid=1) OR cid=4
+    ("SELECT name FROM customers WHERE city = 'london' AND cid = 1 "
+     "OR cid = 4 ORDER BY name", [("ada",), ("dee",)]),
+    # overlapping branches dedup by primary key
+    ("SELECT COUNT(*) FROM customers WHERE city = 'london' OR cid = 1",
+     [("2",)]),
+    ("SELECT cid, SUM(qty) FROM orders WHERE pid = 11 OR qty > 5 "
+     "GROUP BY cid ORDER BY cid",
+     [("1", "1"), ("2", "3"), ("3", "7")]),   # aggregate over the union
+    ("SELECT name FROM customers WHERE city = 'oslo' OR name LIKE 'a%' "
+     "ORDER BY name", [("ada",), ("dee",)]),
+    # ---- IS NULL / IS NOT NULL ----------------------------------------
+    ("SELECT c.name FROM customers c LEFT JOIN orders o ON c.cid = o.cid "
+     "WHERE o.oid IS NULL", [("dee",)]),     # anti-join shape
+    ("SELECT COUNT(*) FROM orders WHERE cid IS NOT NULL", [("5",)]),
 ]
 
 

@@ -131,6 +131,9 @@ FILTER_OPS = {
     # SQL LIKE (%/_ wildcards, full-string anchor); NULL never matches
     "like": lambda a, b: isinstance(a, str) and _like_match(b, a),
     "not like": lambda a, b: isinstance(a, str) and not _like_match(b, a),
+    # IS [NOT] NULL (the filter value is ignored)
+    "is null": lambda a, b: a is None,
+    "is not null": lambda a, b: a is not None,
 }
 
 

@@ -784,7 +784,7 @@ class PgSession:
         tables) — those fall back to the materialized _select."""
         if (stmt.count_star or stmt.aggregates or stmt.group_by
                 or stmt.order_by or stmt.scalar_items or stmt.joins
-                or stmt.having or stmt.distinct
+                or stmt.having or stmt.distinct or stmt.or_where
                 or any(op in ("exists", "not exists")
                        or isinstance(v, P.Select)
                        for _c, op, v in stmt.where)
@@ -995,6 +995,8 @@ class PgSession:
             stmt,
             columns=[fix(c) for c in stmt.columns] if stmt.columns else None,
             where=[(fix(c), op, v) for c, op, v in stmt.where],
+            or_where=[[(fix(c), op, v) for c, op, v in br]
+                      for br in stmt.or_where],
             order_by=[(fix(c), d) for c, d in stmt.order_by],
             scalar_items=[fix_item(i) for i in stmt.scalar_items],
             group_by=fix(stmt.group_by) if stmt.group_by else None,
@@ -1084,6 +1086,78 @@ class PgSession:
             col_desc = [(c, 25) for c in out_cols]
         return PgResult("SELECT 0", col_desc, [])
 
+    def _select_or(self, stmt: P.Select) -> PgResult:
+        """OR disjunction (ref: PG BitmapOr over index/seq paths): fetch
+        each conjunction branch through the normal pushdown machinery,
+        deduplicate rows by primary key, then run the usual
+        aggregate/order/project pipeline over the union."""
+        from dataclasses import replace as _replace
+        if stmt.joins:
+            raise PgError(Status.NotSupported(
+                "OR combined with JOIN is not supported"), "0A000")
+        stripped = self._strip_base_qualifiers(stmt)
+        base = _replace(stripped, or_where=[])
+        if self._virtual_table_rows(base.table) is not None:
+            raise PgError(Status.NotSupported(
+                "OR over system tables is not supported"), "0A000")
+        table = self._table(base.table)
+        schema = table.schema
+        key_names = [c.name for c in schema.hash_columns] + \
+            [c.name for c in schema.range_columns]
+        self._validate_select_cols(stripped, schema)
+        merged: Dict[tuple, dict] = {}
+        for branch in stripped.or_where:
+            b_sel = _replace(base, where=list(branch), limit=None,
+                             order_by=[], distinct=False)
+            resolved, always_false = self._resolve_subqueries(b_sel)
+            if always_false:
+                continue
+            # fetch ALL columns per branch: projection happens after merge
+            fetch = _replace(resolved, columns=None, aggregates=[],
+                             group_by=None, scalar_items=[], having=[],
+                             count_star=False)
+            for d in self._iter_row_dicts(fetch, table):
+                merged.setdefault(tuple(d.get(k) for k in key_names), d)
+        dicts = list(merged.values())
+        # re-enter the normal pipeline with the merged row set
+        return self._project_dicts(base, table, dicts)
+
+    def _project_dicts(self, stmt: P.Select, table, dicts) -> PgResult:
+        """The post-fetch half of _select: aggregates / HAVING / ORDER BY
+        / DISTINCT / projection over an already-fetched row set."""
+        schema = table.schema
+        if stmt.count_star:
+            return PgResult("SELECT 1", [("count", 20)], [[len(dicts)]])
+        if stmt.aggregates or stmt.group_by:
+            if stmt.columns and (len(stmt.columns) != 1
+                                 or stmt.columns[0] != stmt.group_by):
+                raise PgError(Status.InvalidArgument(
+                    "non-aggregated columns must appear in GROUP BY"),
+                    "42803")
+            col_desc, rows_out = self._aggregate(
+                stmt, lambda c: PG_OIDS[schema.column(c).type], dicts)
+            if stmt.limit is not None:
+                rows_out = rows_out[: stmt.limit]
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+        dicts = self._order_rows(dicts, stmt.order_by)
+        if stmt.scalar_items:
+            col_desc, rows_out = self._project_scalar(stmt.scalar_items,
+                                                      schema, dicts)
+            if stmt.distinct:
+                rows_out = _dedup_rows(rows_out)
+            if stmt.limit is not None:
+                rows_out = rows_out[: stmt.limit]
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+        out_cols = stmt.columns or [c.name for c in schema.columns
+                                    if not c.dropped]
+        col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
+        rows_out = [[d.get(c) for c in out_cols] for d in dicts]
+        if stmt.distinct:
+            rows_out = _dedup_rows(rows_out)
+        if stmt.limit is not None:
+            rows_out = rows_out[: stmt.limit]
+        return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+
     def _select_union(self, stmt: P.UnionSelect) -> PgResult:
         """UNION [ALL] chain: left-associative combine; any non-ALL link
         dedups the accumulated set (PG set-operation semantics). Column
@@ -1130,6 +1204,8 @@ class PgSession:
     def _select(self, stmt) -> PgResult:
         if isinstance(stmt, P.UnionSelect):
             return self._select_union(stmt)
+        if stmt.or_where:
+            return self._select_or(stmt)
         resolved, always_false = self._resolve_subqueries(stmt)
         if always_false:
             return self._empty_select_result(stmt)
@@ -1141,10 +1217,18 @@ class PgSession:
         if vt is not None:
             return self._select_virtual(stmt, *vt)
         table = self._table(stmt.table)
-        schema = table.schema
+        self._validate_select_cols(stmt, table.schema)
+        dicts = self._select_row_dicts(stmt, table)
+        return self._project_dicts(stmt, table, dicts)
+
+    def _validate_select_cols(self, stmt: P.Select, schema) -> None:
+        """Every column reference (select list, WHERE incl. OR branches,
+        ORDER BY, GROUP BY, aggregates, HAVING) must exist — one shared
+        check so the OR path cannot diverge from the plain path."""
         known = {c.name for c in schema.columns}
         check_cols = list(stmt.columns or []) \
             + [f[0] for f in stmt.where if f[0]] \
+            + [f[0] for br in stmt.or_where for f in br if f[0]] \
             + [c for c, _d in stmt.order_by] \
             + ([stmt.group_by] if stmt.group_by else []) \
             + [c for _f, c in stmt.aggregates if c is not None] \
@@ -1155,38 +1239,6 @@ class PgSession:
             if c not in known:
                 raise PgError(Status.InvalidArgument(
                     f'column "{c}" does not exist'), "42703")
-        dicts = self._select_row_dicts(stmt, table)
-        if stmt.count_star:
-            return PgResult("SELECT 1", [("count", 20)], [[len(dicts)]])
-        if stmt.aggregates or stmt.group_by:
-            if stmt.columns and (len(stmt.columns) != 1
-                                 or stmt.columns[0] != stmt.group_by):
-                raise PgError(Status.InvalidArgument(
-                    "non-aggregated columns must appear in GROUP BY"),
-                    "42803")
-            col_desc, rows_out = self._aggregate(
-                stmt, lambda c: PG_OIDS[schema.column(c).type], dicts)
-            if stmt.limit is not None:
-                rows_out = rows_out[: stmt.limit]
-            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
-        dicts = self._order_rows(dicts, stmt.order_by)
-        if stmt.scalar_items:
-            col_desc, rows_out = self._project_scalar(stmt.scalar_items,
-                                                      schema, dicts)
-            if stmt.distinct:
-                rows_out = _dedup_rows(rows_out)
-            if stmt.limit is not None:
-                rows_out = rows_out[: stmt.limit]
-            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
-        out_cols = stmt.columns or [c.name for c in schema.columns
-                                    if not c.dropped]
-        col_desc = [(c, PG_OIDS[schema.column(c).type]) for c in out_cols]
-        rows_out = [[d.get(c) for c in out_cols] for d in dicts]
-        if stmt.distinct:
-            rows_out = _dedup_rows(rows_out)  # after projection (PG order)
-        if stmt.limit is not None:
-            rows_out = rows_out[: stmt.limit]
-        return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
 
     def _project_scalar(self, items, schema, dicts):
         """Scalar-builtin select list (yql/bfunc.py, the bfpg registry

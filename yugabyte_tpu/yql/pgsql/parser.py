@@ -119,6 +119,10 @@ class Select:
     group_by: Optional[str] = None
     order_by: List[Tuple[str, bool]] = field(default_factory=list)  # (col, desc)
     distinct: bool = False             # SELECT DISTINCT
+    # OR disjunction: when non-empty, `where` is [] and the predicate is
+    # the union of these conjunction branches (PG: a AND b OR c)
+    or_where: List[List[Tuple[str, str, object]]] = \
+        field(default_factory=list)
     # HAVING conjunction: (item, op, literal) where item is
     # ("agg", FUNC, col_or_None) or ("col", name)
     having: List[Tuple[tuple, str, object]] = field(default_factory=list)
@@ -535,7 +539,7 @@ class PgParser(_BaseParser):
             self.expect_op("=")
             rref = self._col_ref()
             joins.append(Join(jt, jalias, kind, (lref, rref)))
-        where = self._pg_where()
+        where, or_where = self._pg_where_full()
         group_by = None
         if self.accept_kw("GROUP", "BY"):
             group_by = self.name()
@@ -572,7 +576,8 @@ class PgParser(_BaseParser):
                       alias=alias, joins=joins,
                       aggregates=aggregates, group_by=group_by,
                       order_by=order_by, scalar_items=scalar_items,
-                      having=having, distinct=distinct)
+                      having=having, distinct=distinct,
+                      or_where=or_where)
 
     def _having_item(self) -> tuple:
         """("agg", FUNC, col_or_None) | ("col", name)."""
@@ -603,62 +608,74 @@ class PgParser(_BaseParser):
             raise ParseError(f"unsupported operator {op!r}")
         return op
 
-    def _pg_where(self) -> List[Tuple[str, str, object]]:
+    def _one_predicate(self) -> Tuple[str, str, object]:
+        # EXISTS / NOT EXISTS (SELECT ...)
+        if self.accept_kw("EXISTS"):
+            self.expect_op("(")
+            return ("", "exists", self._subselect())
+        if self.accept_kw("NOT", "EXISTS"):
+            self.expect_op("(")
+            return ("", "not exists", self._subselect())
+        col = self._col_ref()
+        if self.accept_kw("IS"):
+            neg = bool(self.accept_kw("NOT"))
+            self.expect_kw("NULL")
+            return (col, "is not null" if neg else "is null", None)
+        if self.accept_kw("LIKE"):
+            return (col, "like", self.literal())
+        if self.accept_kw("NOT", "LIKE"):
+            return (col, "not like", self.literal())
+        in_op = None
+        if self.accept_kw("IN"):
+            in_op = "in"
+        elif self.accept_kw("NOT", "IN"):
+            in_op = "not in"
+        if in_op is not None:
+            self.expect_op("(")
+            tok = self.peek()
+            if tok is not None and tok[0] == "name" \
+                    and tok[1].upper() == "SELECT":
+                return (col, in_op, self._subselect())
+            vals = [self.literal()]
+            while self.accept_op(","):
+                vals.append(self.literal())
+            self.expect_op(")")
+            return (col, in_op, tuple(vals))
+        op = self._comparison_op()
+        tok = self.peek()
+        if tok == ("op", "(") \
+                and self._peek2() is not None \
+                and self._peek2()[0] == "name" \
+                and self._peek2()[1].upper() == "SELECT":
+            self.expect_op("(")
+            return (col, op, self._subselect())
+        return (col, op, self.literal())
+
+    def _pg_where_full(self):
+        """-> (conjunction, or_branches): OR binds loosest (a AND b OR c
+        = (a AND b) OR c, PG precedence; no parenthesized grouping). A
+        plain conjunction returns ([triples], []); a disjunction returns
+        ([], [branch0, branch1, ...])."""
         if not self.accept_kw("WHERE"):
-            return []
-        out = []
+            return [], []
+        branches: List[List[Tuple[str, str, object]]] = [[]]
         while True:
-            # EXISTS / NOT EXISTS (SELECT ...)
-            if self.accept_kw("EXISTS"):
-                self.expect_op("(")
-                out.append(("", "exists", self._subselect()))
-            elif self.accept_kw("NOT", "EXISTS"):
-                self.expect_op("(")
-                out.append(("", "not exists", self._subselect()))
-            else:
-                col = self._col_ref()
-                if self.accept_kw("LIKE"):
-                    out.append((col, "like", self.literal()))
-                    if not self.accept_kw("AND"):
-                        break
-                    continue
-                if self.accept_kw("NOT", "LIKE"):
-                    out.append((col, "not like", self.literal()))
-                    if not self.accept_kw("AND"):
-                        break
-                    continue
-                in_op = None
-                if self.accept_kw("IN"):
-                    in_op = "in"
-                elif self.accept_kw("NOT", "IN"):
-                    in_op = "not in"
-                if in_op is not None:
-                    op = in_op
-                    self.expect_op("(")
-                    tok = self.peek()
-                    if tok is not None and tok[0] == "name" \
-                            and tok[1].upper() == "SELECT":
-                        out.append((col, op, self._subselect()))
-                    else:
-                        vals = [self.literal()]
-                        while self.accept_op(","):
-                            vals.append(self.literal())
-                        self.expect_op(")")
-                        out.append((col, op, tuple(vals)))
-                else:
-                    op = self._comparison_op()
-                    tok = self.peek()
-                    if tok == ("op", "(") \
-                            and self._peek2() is not None \
-                            and self._peek2()[0] == "name" \
-                            and self._peek2()[1].upper() == "SELECT":
-                        self.expect_op("(")
-                        out.append((col, op, self._subselect()))
-                    else:
-                        out.append((col, op, self.literal()))
-            if not self.accept_kw("AND"):
-                break
-        return out
+            branches[-1].append(self._one_predicate())
+            if self.accept_kw("AND"):
+                continue
+            if self.accept_kw("OR"):
+                branches.append([])
+                continue
+            break
+        if len(branches) == 1:
+            return branches[0], []
+        return [], branches
+
+    def _pg_where(self) -> List[Tuple[str, str, object]]:
+        where, or_branches = self._pg_where_full()
+        if or_branches:
+            raise ParseError("OR is not supported in this statement")
+        return where
 
     def _update(self) -> Update:
         name = self._table_name()
@@ -718,6 +735,8 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
             return sub(v)
         return replace(stmt, where=[(c, op, sub_val(v))
                                     for c, op, v in stmt.where],
+                       or_where=[[(c, op, sub_val(v)) for c, op, v in br]
+                                 for br in stmt.or_where],
                        limit=limit,
                        scalar_items=[sub_item(i)
                                      for i in stmt.scalar_items],
@@ -756,7 +775,8 @@ def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
             out.extend(collect_param_columns(s))
         visit("__limit__", stmt.limit)
     elif isinstance(stmt, Select):
-        for c, _op, v in stmt.where:
+        for c, _op, v in stmt.where + [t for br in stmt.or_where
+                                       for t in br]:
             if isinstance(v, Select):
                 out.extend(collect_param_columns(v))
             elif isinstance(v, tuple):
