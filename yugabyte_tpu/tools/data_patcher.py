@@ -1,0 +1,151 @@
+"""yb-data-patcher: shift hybrid times across a tablet's durable state.
+
+Capability parity with the reference (ref: src/yb/tools/data-patcher.cc
+— the add-time/sub-time recovery tool for clock-skew incidents: a node
+that ran with a wildly wrong clock stamped writes with future hybrid
+times, and every later read/compaction misorders against them; the fix
+is an offline uniform shift of the stored times).
+
+Patches, per tablet directory (server stopped):
+  - every SST in regular/ and intents/: per-row DocHybridTime columns
+    (the slab layout keeps HT OUT of the key bytes, so index keys and
+    bloom filters are untouched — the file is decoded, shifted and
+    rewritten through the ordinary writer), plus the frontier's
+    ht_min/ht_max;
+  - every WAL segment: each ReplicateMsg's ht_value and any per-item
+    hybrid-time overrides inside write batches, plus commit_ht inside
+    transaction-update records.
+
+Usage:
+  python -m yugabyte_tpu.tools.data_patcher --delta-us <signed int> \
+      <tablet_dir_or_fs_root>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from yugabyte_tpu.common.hybrid_time import kBitsForLogicalComponent
+
+
+def _shift_ht(value: int, delta_ht: int) -> int:
+    return max(0, value + delta_ht) if value else value
+
+
+def patch_sst(base_path: str, delta_ht: int) -> int:
+    """Rewrite one SST with every row's HT shifted; returns rows."""
+    import numpy as np
+    from yugabyte_tpu.storage.sst import Frontier, SSTReader, SSTWriter
+    r = SSTReader(base_path)
+    slab = r.read_all()
+    fr = r.props.frontier
+    block_entries = max(1, r.block_handles[0][2]) if r.block_handles \
+        else None
+    r.close()
+    if slab.n:
+        ht = (slab.ht_hi.astype(np.uint64) << np.uint64(32)) \
+            | slab.ht_lo.astype(np.uint64)
+        if delta_ht >= 0:
+            ht = ht + np.uint64(delta_ht)
+        else:
+            d = np.uint64(-delta_ht)
+            ht = np.where(ht > d, ht - d, np.uint64(0))
+        slab.ht_hi = (ht >> np.uint64(32)).astype(np.uint32)
+        slab.ht_lo = (ht & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    new_fr = Frontier(op_id_min=fr.op_id_min, op_id_max=fr.op_id_max,
+                      ht_min=_shift_ht(fr.ht_min, delta_ht),
+                      ht_max=_shift_ht(fr.ht_max, delta_ht),
+                      history_cutoff=fr.history_cutoff)
+    SSTWriter(base_path, block_entries=block_entries).write(slab, new_fr)
+    return slab.n
+
+
+def patch_wal(wal_dir: str, delta_ht: int) -> int:
+    """Rewrite every WAL segment with shifted hybrid times; returns the
+    number of patched entries."""
+    from yugabyte_tpu.consensus.log import (LogEntry, _encode_entry,
+                                            _read_segment)
+    from yugabyte_tpu.consensus.raft import (OP_UPDATE_TXN, OP_WRITE,
+                                             ReplicateMsg)
+    from yugabyte_tpu.tablet.tablet_peer import (decode_write_batch,
+                                                 encode_write_batch)
+    from yugabyte_tpu.utils.env import get_env
+    n = 0
+    for name in sorted(os.listdir(wal_dir)):
+        if not name.startswith("wal-"):
+            continue
+        path = os.path.join(wal_dir, name)
+        out = []
+        for e in _read_segment(path):
+            msg = ReplicateMsg.from_log_entry(e)
+            if msg.op_type == OP_WRITE:
+                pairs, intents, request = decode_write_batch(msg.payload)
+                shifted = []
+                for it in pairs:
+                    if len(it) == 3 and it[2]:
+                        shifted.append((it[0], it[1],
+                                        _shift_ht(it[2], delta_ht)))
+                    else:
+                        shifted.append(it)
+                payload = encode_write_batch(shifted, intents,
+                                             request=request)
+            elif msg.op_type == OP_UPDATE_TXN:
+                d = json.loads(msg.payload.decode())
+                if d.get("commit_ht"):
+                    d["commit_ht"] = _shift_ht(d["commit_ht"], delta_ht)
+                payload = json.dumps(d).encode()
+            else:
+                payload = msg.payload
+            patched = ReplicateMsg(msg.term, msg.index, msg.op_type,
+                                   _shift_ht(msg.ht_value, delta_ht),
+                                   payload)
+            out.append(_encode_entry(patched.to_log_entry()))
+            n += 1
+        get_env().write_file(path, b"".join(out))
+    return n
+
+
+def patch_tablet(tablet_dir: str, delta_us: int) -> dict:
+    delta_ht = delta_us << kBitsForLogicalComponent
+    rep = {"tablet_dir": tablet_dir, "delta_us": delta_us,
+           "ssts": 0, "rows": 0, "wal_entries": 0}
+    for sub in ("regular", "intents"):
+        db_dir = os.path.join(tablet_dir, sub)
+        if not os.path.isdir(db_dir):
+            continue
+        for fname in sorted(os.listdir(db_dir)):
+            if fname.endswith(".sst"):
+                rep["rows"] += patch_sst(os.path.join(db_dir, fname),
+                                         delta_ht)
+                rep["ssts"] += 1
+    wal_dir = os.path.join(tablet_dir, "wal")
+    if os.path.isdir(wal_dir):
+        rep["wal_entries"] = patch_wal(wal_dir, delta_ht)
+    return rep
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="yb-data-patcher")
+    ap.add_argument("--delta-us", type=int, required=True,
+                    help="signed microseconds to add to every stored "
+                         "hybrid time (negative undoes a future-clock "
+                         "incident)")
+    ap.add_argument("root", help="tablet dir or fs root (server stopped)")
+    args = ap.parse_args(argv)
+    from yugabyte_tpu.tools.fs_tool import fs_report
+    reports = []
+    found = fs_report(args.root)["tablets"]
+    if not found:
+        print(f"no tablets under {args.root}", file=sys.stderr)
+        return 1
+    for t in found:
+        reports.append(patch_tablet(t["tablet_dir"], args.delta_us))
+    print(json.dumps(reports, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""yb-fs-tool: dump the on-disk layout of a server data root.
+
+Capability parity with the reference (ref: src/yb/tools/fs_tool.cc —
+dump_fs_tree / list tablets / per-tablet data files with sizes). Walks a
+tserver fs root (or a single tablet dir) and reports tablets, their
+regular/intents SSTs (base + data file sizes, entry counts from props),
+WAL segments, and superblock metadata — without opening the server.
+
+Usage: python -m yugabyte_tpu.tools.fs_tool <fs_root_or_tablet_dir>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _sst_infos(db_dir: str):
+    out = []
+    if not os.path.isdir(db_dir):
+        return out
+    from yugabyte_tpu.storage.sst import SSTReader, data_file_name
+    for name in sorted(os.listdir(db_dir)):
+        if not name.endswith(".sst"):
+            continue
+        path = os.path.join(db_dir, name)
+        info = {"file": name,
+                "base_bytes": os.path.getsize(path)}
+        data = data_file_name(path)
+        if os.path.exists(data):
+            info["data_bytes"] = os.path.getsize(data)
+        try:
+            r = SSTReader(path)
+            info["entries"] = r.props.n_entries
+            info["blocks"] = r.n_blocks
+            fr = r.props.frontier
+            info["op_id_max"] = list(fr.op_id_max)
+            info["ht_max"] = fr.ht_max
+            r.close()
+        except Exception as e:  # noqa: BLE001 — corrupt files still listed
+            info["error"] = repr(e)
+        out.append(info)
+    return out
+
+
+def _wal_infos(wal_dir: str):
+    out = []
+    if not os.path.isdir(wal_dir):
+        return out
+    for name in sorted(os.listdir(wal_dir)):
+        if name.startswith("wal-"):
+            out.append({"segment": name,
+                        "bytes": os.path.getsize(
+                            os.path.join(wal_dir, name))})
+    return out
+
+
+def tablet_report(tablet_dir: str) -> dict:
+    rep = {"tablet_dir": tablet_dir}
+    sb = os.path.join(tablet_dir, "meta.json")
+    if os.path.exists(sb):
+        try:
+            with open(sb) as f:
+                meta = json.load(f)
+            rep["superblock"] = {k: meta.get(k) for k in
+                                 ("tablet_id", "table_id", "state",
+                                  "schema_version", "peers")
+                                 if k in meta}
+        except (OSError, json.JSONDecodeError) as e:
+            rep["superblock_error"] = repr(e)
+    for sub in ("regular", "intents"):
+        infos = _sst_infos(os.path.join(tablet_dir, sub))
+        rep[sub] = {
+            "n_sst": len(infos),
+            "total_bytes": sum(i.get("base_bytes", 0)
+                               + i.get("data_bytes", 0) for i in infos),
+            "ssts": infos,
+        }
+    rep["wal"] = _wal_infos(os.path.join(tablet_dir, "wal"))
+    return rep
+
+
+def fs_report(root: str) -> dict:
+    """Walk a fs root: any directory containing tablet dirs (identified
+    by a superblock or regular/ subdir) is reported."""
+    tablets = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        if "meta.json" in filenames or (
+                "regular" in dirnames and "wal" in dirnames):
+            tablets.append(tablet_report(dirpath))
+            dirnames[:] = []  # don't descend into the tablet itself
+    return {"root": root, "n_tablets": len(tablets), "tablets": tablets}
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: fs_tool <fs_root_or_tablet_dir>", file=sys.stderr)
+        return 2
+    print(json.dumps(fs_report(argv[0]), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
