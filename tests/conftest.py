@@ -18,3 +18,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the sync-point interleaving schedules FIRST: they pin exact
+    thread timings, and by the end of a full-suite run hundreds of
+    daemon threads from earlier cluster tests are still contending for
+    the GIL on CI's single core — the dominant source of their flakes."""
+    early = [i for i in items if "test_sync_interleavings" in i.nodeid]
+    rest = [i for i in items if "test_sync_interleavings" not in i.nodeid]
+    items[:] = early + rest

@@ -32,18 +32,24 @@ def wait_for(pred, timeout=20.0, msg="condition"):
     raise TimeoutError(f"timed out waiting for {msg}")
 
 
-def _elect_with_retry(raft_like, name, timeout=20.0):
-    """Drive one node to leadership, RE-ISSUING the election every 2s: a
-    single attempt can silently die under full-suite CPU load (vote RPCs
-    time out) and nothing retries it with election timers disabled."""
+def _elect_with_retry(raft_like, name, timeout=30.0):
+    """Drive one node to leadership with EXPONENTIALLY-backed-off
+    re-elections: a single attempt can silently die under full-suite CPU
+    load (vote RPCs starve) and nothing retries it with election timers
+    disabled — but re-issuing too eagerly is worse, because every new
+    attempt bumps the term and INVALIDATES votes still in flight for the
+    previous one (a livelock when vote threads need longer than the
+    retry interval to get scheduled)."""
     deadline = time.monotonic() + timeout
+    window = 2.0
     while time.monotonic() < deadline:
         raft_like.start_election(ignore_lease=True)
-        attempt_end = min(time.monotonic() + 2.0, deadline)
+        attempt_end = min(time.monotonic() + window, deadline)
         while time.monotonic() < attempt_end:
             if raft_like.is_leader():
                 return
             time.sleep(0.005)
+        window *= 2
     raise TimeoutError(f"timed out waiting for {name} leader")
 
 

@@ -211,6 +211,18 @@ REGRESS = [
     ("SELECT c.name FROM customers c LEFT JOIN orders o ON c.cid = o.cid "
      "WHERE o.oid IS NULL", [("dee",)]),     # anti-join shape
     ("SELECT COUNT(*) FROM orders WHERE cid IS NOT NULL", [("5",)]),
+    # ---- parenthesized boolean grouping (DNF normalization) ------------
+    ("SELECT name FROM customers WHERE (city = 'london' OR city = 'oslo') "
+     "AND cid > 2 ORDER BY name", [("cyd",), ("dee",)]),
+    ("SELECT name FROM customers WHERE cid = 2 OR (city = 'london' "
+     "AND cid < 2) ORDER BY name", [("ada",), ("bob",)]),
+    ("SELECT oid FROM orders WHERE (cid = 1 OR cid = 2) AND "
+     "(pid = 11 OR qty = 2) ORDER BY oid",
+     [("100",), ("101",), ("102",)]),   # 2x2 DNF expansion
+    # grouping does not break a scalar subquery right after '('
+    ("SELECT pname FROM products WHERE (price > "
+     "(SELECT AVG(price) FROM products)) OR pname = 'glue' "
+     "ORDER BY pname", [("anvil",), ("glue",)]),
 ]
 
 

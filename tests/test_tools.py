@@ -232,3 +232,48 @@ def test_fs_tool_and_data_patcher(tmp_path, capsys):
         client.close()
     finally:
         mc2.shutdown()
+
+
+def test_data_patcher_shifts_txn_status_commit_ht(tmp_path, capsys):
+    """The transaction STATUS table stores commit hybrid times as column
+    VALUES; a recovery shift must move them too, or unresolved
+    transactions re-apply at the old (future) time after sub-time."""
+    import json as _json
+
+    from yugabyte_tpu.common.wire import schema_to_wire
+    from yugabyte_tpu.docdb.value import Value
+    from yugabyte_tpu.tools import data_patcher
+    from yugabyte_tpu.tserver.transaction_coordinator import (
+        TXN_STATUS_SCHEMA, _COL_COMMIT_HT)
+    from yugabyte_tpu.utils import jsonutil
+
+    # hand-build a status tablet dir: meta.json + one SST with a
+    # committed txn record
+    tdir = tmp_path / "txnstatus"
+    (tdir / "wal").mkdir(parents=True)
+    jsonutil.write_atomic(str(tdir / "meta.json"),
+                          {"tablet_id": "t1", "table_id": "x",
+                           "schema": schema_to_wire(TXN_STATUS_SCHEMA)})
+    db = DB(str(tdir / "regular"), DBOptions(auto_compact=False))
+    commit_ht_value = 5_000_000 << 12
+    key = SubDocKey(DocKey(hash_components=(b"\x01" * 16,)),
+                    (("col", _COL_COMMIT_HT),)).encode(include_ht=False)
+    db.write_batch([(key, DocHybridTime(HybridTime(commit_ht_value), 0),
+                     Value(primitive=commit_ht_value).encode())])
+    db.flush()
+    db.close()
+
+    delta_us = -1_000_000
+    assert data_patcher.main(["--delta-us", str(delta_us),
+                              str(tdir)]) == 0
+    rep = _json.loads(capsys.readouterr().out)
+    assert rep[0]["txn_status_table"] is True
+
+    db2 = DB(str(tdir / "regular"), DBOptions(auto_compact=False))
+    got = db2.get(key)
+    assert got is not None
+    from yugabyte_tpu.common.hybrid_time import kBitsForLogicalComponent
+    want = commit_ht_value + (delta_us << kBitsForLogicalComponent)
+    assert Value.decode(got[1]).primitive == want, "commit_ht not shifted"
+    assert got[0].ht.value == want  # the row's own HT shifted identically
+    db2.close()

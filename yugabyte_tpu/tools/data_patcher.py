@@ -35,7 +35,55 @@ def _shift_ht(value: int, delta_ht: int) -> int:
     return max(0, value + delta_ht) if value else value
 
 
-def patch_sst(base_path: str, delta_ht: int) -> int:
+def _txn_value_patcher(tablet_dir: str, delta_ht: int):
+    """For the transaction STATUS tablet (system.transactions), commit
+    hybrid times are also stored as INT64 column VALUES in the status
+    rows (tserver/transaction_coordinator.py); a recovery shift must
+    move them too or pending transactions re-apply at the old future
+    time. Returns fn(key_prefix, value_bytes) -> new value or None, or
+    None when this tablet is not the status table."""
+    import json as _json
+    import struct as _struct
+    meta_path = os.path.join(tablet_dir, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = _json.load(f)
+    except (OSError, ValueError):
+        return None
+    schema_wire = meta.get("schema") or {}
+    cols = schema_wire.get("columns") or []
+    names = [c[0] if isinstance(c, (list, tuple)) else c.get("name")
+             for c in cols]
+    # Match the FULL status-table shape (transaction_coordinator.py
+    # TXN_STATUS_SCHEMA), not just a column name — a user table that
+    # happens to have a 'commit_ht' column must never be value-patched.
+    from yugabyte_tpu.tserver.transaction_coordinator import (
+        TXN_STATUS_SCHEMA)
+    want = [c.name for c in TXN_STATUS_SCHEMA.columns]
+    if names != want:
+        return None  # not the transaction status table
+    from yugabyte_tpu.common.wire import schema_from_wire
+    from yugabyte_tpu.docdb.value import Value
+    from yugabyte_tpu.docdb.value_type import ValueType
+    schema = schema_from_wire(schema_wire)
+    cid = schema.column_id("commit_ht")
+    want_suffix = bytes([ValueType.kColumnId]) + _struct.pack(">H", cid)
+
+    def patch(key_prefix: bytes, value: bytes):
+        if not key_prefix.endswith(want_suffix):
+            return None
+        try:
+            v = Value.decode(value)
+        except (ValueError, IndexError):
+            return None
+        if not isinstance(v.primitive, int) or v.primitive <= 0:
+            return None
+        return Value(primitive=_shift_ht(v.primitive, delta_ht)).encode()
+
+    return patch
+
+
+def patch_sst(base_path: str, delta_ht: int, value_patch=None) -> int:
     """Rewrite one SST with every row's HT shifted; returns rows."""
     import numpy as np
     from yugabyte_tpu.storage.sst import Frontier, SSTReader, SSTWriter
@@ -45,6 +93,20 @@ def patch_sst(base_path: str, delta_ht: int) -> int:
     block_entries = max(1, r.block_handles[0][2]) if r.block_handles \
         else None
     r.close()
+    if slab.n and value_patch is not None:
+        from yugabyte_tpu.ops.slabs import ValueArray
+        raw = slab.key_words.astype(">u4").tobytes()
+        stride = slab.width_words * 4
+        vals = list(slab.values)
+        changed = False
+        for i in range(slab.n):
+            kp = raw[i * stride: i * stride + int(slab.key_len[i])]
+            nv = value_patch(kp, vals[int(slab.value_idx[i])])
+            if nv is not None:
+                vals[int(slab.value_idx[i])] = nv
+                changed = True
+        if changed:
+            slab.values = ValueArray.from_list(vals)
     if slab.n:
         ht = (slab.ht_hi.astype(np.uint64) << np.uint64(32)) \
             | slab.ht_lo.astype(np.uint64)
@@ -63,7 +125,7 @@ def patch_sst(base_path: str, delta_ht: int) -> int:
     return slab.n
 
 
-def patch_wal(wal_dir: str, delta_ht: int) -> int:
+def patch_wal(wal_dir: str, delta_ht: int, value_patch=None) -> int:
     """Rewrite every WAL segment with shifted hybrid times; returns the
     number of patched entries."""
     from yugabyte_tpu.consensus.log import (LogEntry, _encode_entry,
@@ -85,11 +147,15 @@ def patch_wal(wal_dir: str, delta_ht: int) -> int:
                 pairs, intents, request = decode_write_batch(msg.payload)
                 shifted = []
                 for it in pairs:
+                    k, v = it[0], it[1]
+                    if value_patch is not None:
+                        nv = value_patch(k, v)
+                        if nv is not None:
+                            v = nv
                     if len(it) == 3 and it[2]:
-                        shifted.append((it[0], it[1],
-                                        _shift_ht(it[2], delta_ht)))
+                        shifted.append((k, v, _shift_ht(it[2], delta_ht)))
                     else:
-                        shifted.append(it)
+                        shifted.append((k, v))
                 payload = encode_write_batch(shifted, intents,
                                              request=request)
             elif msg.op_type == OP_UPDATE_TXN:
@@ -110,8 +176,10 @@ def patch_wal(wal_dir: str, delta_ht: int) -> int:
 
 def patch_tablet(tablet_dir: str, delta_us: int) -> dict:
     delta_ht = delta_us << kBitsForLogicalComponent
+    value_patch = _txn_value_patcher(tablet_dir, delta_ht)
     rep = {"tablet_dir": tablet_dir, "delta_us": delta_us,
-           "ssts": 0, "rows": 0, "wal_entries": 0}
+           "ssts": 0, "rows": 0, "wal_entries": 0,
+           "txn_status_table": value_patch is not None}
     for sub in ("regular", "intents"):
         db_dir = os.path.join(tablet_dir, sub)
         if not os.path.isdir(db_dir):
@@ -119,11 +187,11 @@ def patch_tablet(tablet_dir: str, delta_us: int) -> dict:
         for fname in sorted(os.listdir(db_dir)):
             if fname.endswith(".sst"):
                 rep["rows"] += patch_sst(os.path.join(db_dir, fname),
-                                         delta_ht)
+                                         delta_ht, value_patch)
                 rep["ssts"] += 1
     wal_dir = os.path.join(tablet_dir, "wal")
     if os.path.isdir(wal_dir):
-        rep["wal_entries"] = patch_wal(wal_dir, delta_ht)
+        rep["wal_entries"] = patch_wal(wal_dir, delta_ht, value_patch)
     return rep
 
 
@@ -135,14 +203,14 @@ def main(argv=None) -> int:
                          "incident)")
     ap.add_argument("root", help="tablet dir or fs root (server stopped)")
     args = ap.parse_args(argv)
-    from yugabyte_tpu.tools.fs_tool import fs_report
+    from yugabyte_tpu.tools.fs_tool import find_tablet_dirs
     reports = []
-    found = fs_report(args.root)["tablets"]
+    found = list(find_tablet_dirs(args.root))
     if not found:
         print(f"no tablets under {args.root}", file=sys.stderr)
         return 1
-    for t in found:
-        reports.append(patch_tablet(t["tablet_dir"], args.delta_us))
+    for tdir in found:
+        reports.append(patch_tablet(tdir, args.delta_us))
     print(json.dumps(reports, indent=2))
     return 0
 

@@ -81,15 +81,19 @@ def tablet_report(tablet_dir: str) -> dict:
     return rep
 
 
-def fs_report(root: str) -> dict:
-    """Walk a fs root: any directory containing tablet dirs (identified
-    by a superblock or regular/ subdir) is reported."""
-    tablets = []
+def find_tablet_dirs(root: str):
+    """Yield tablet directories under a fs root (identified by a
+    superblock or regular/+wal/ subdirs) WITHOUT opening any data files
+    — discovery for tools that do their own per-tablet work."""
     for dirpath, dirnames, filenames in os.walk(root):
         if "meta.json" in filenames or (
                 "regular" in dirnames and "wal" in dirnames):
-            tablets.append(tablet_report(dirpath))
+            yield dirpath
             dirnames[:] = []  # don't descend into the tablet itself
+
+
+def fs_report(root: str) -> dict:
+    tablets = [tablet_report(d) for d in find_tablet_dirs(root)]
     return {"root": root, "n_tablets": len(tablets), "tablets": tablets}
 
 

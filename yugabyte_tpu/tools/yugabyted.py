@@ -52,6 +52,11 @@ class YugabytedNode:
             master_addrs=master_addrs,
             port=tserver_port)).start()
         self.master_addrs = master_addrs
+        # Readiness: wait until THIS tserver has registered with the
+        # master (ref: yugabyted's post-start wait) — DDL issued right
+        # after bringup must not race the first heartbeat and fail with
+        # "need N live tservers".
+        self._wait_registered(sid)
         # Query-layer frontends (the reference tserver hosts the postgres
         # child + CQL/redis servers the same way; ref pg_wrapper.cc)
         from yugabyte_tpu.client.client import YBClient
@@ -61,6 +66,28 @@ class YugabytedNode:
         from yugabyte_tpu.yql.cql.binary_server import CQLBinaryServer
         self._cql_client = YBClient(master_addrs)
         self.cql_server = CQLBinaryServer(self._cql_client, port=cql_port)
+
+    def _wait_registered(self, server_id: str, timeout_s: float = 20.0
+                         ) -> None:
+        import time
+        from yugabyte_tpu.client.client import YBClient
+        c = YBClient(self.master_addrs)
+        try:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    live = c.list_tservers()
+                    if any(t.get("server_id") == server_id
+                           and t.get("alive", True) for t in live):
+                        return
+                except Exception:  # noqa: BLE001 — master still warming
+                    pass
+                time.sleep(0.1)
+            raise TimeoutError(
+                f"tserver {server_id} never registered with master(s) "
+                f"{self.master_addrs} within {timeout_s:.0f}s")
+        finally:
+            c.close()
 
     def endpoints(self) -> dict:
         out = {"tserver_rpc": self.tserver.address,

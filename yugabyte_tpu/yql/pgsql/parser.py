@@ -651,22 +651,59 @@ class PgParser(_BaseParser):
             return (col, op, self._subselect())
         return (col, op, self.literal())
 
+    def _bool_factor(self) -> List[List[Tuple[str, str, object]]]:
+        """factor := predicate | '(' expr ')' — returns DNF branches.
+        A '(' followed by SELECT is NOT grouping (scalar subqueries are
+        consumed inside _one_predicate)."""
+        tok = self.peek()
+        nxt = self._peek2()
+        if tok == ("op", "(") and not (
+                nxt is not None and nxt[0] == "name"
+                and nxt[1].upper() == "SELECT"):
+            self.expect_op("(")
+            branches = self._bool_expr()
+            self.expect_op(")")
+            return branches
+        return [[self._one_predicate()]]
+
+    _MAX_DNF_BRANCHES = 64
+
+    def _bool_term(self) -> List[List[Tuple[str, str, object]]]:
+        """term := factor (AND factor)* — DNF product of the factors,
+        capped: AND-ed OR-groups multiply, and an unbounded product would
+        let one cheap query build 2^40 branch lists inside the parser."""
+        branches = self._bool_factor()
+        while self.accept_kw("AND"):
+            rhs = self._bool_factor()
+            if len(branches) * len(rhs) > self._MAX_DNF_BRANCHES:
+                raise ParseError(
+                    "WHERE clause is too complex (more than "
+                    f"{self._MAX_DNF_BRANCHES} OR branches after "
+                    "normalization)")
+            branches = [lb + rb for lb in branches for rb in rhs]
+        return branches
+
+    def _bool_expr(self) -> List[List[Tuple[str, str, object]]]:
+        """expr := term (OR term)* — DNF union of the terms."""
+        branches = self._bool_term()
+        while self.accept_kw("OR"):
+            branches = branches + self._bool_term()
+            if len(branches) > self._MAX_DNF_BRANCHES:
+                raise ParseError(
+                    "WHERE clause is too complex (more than "
+                    f"{self._MAX_DNF_BRANCHES} OR branches after "
+                    "normalization)")
+        return branches
+
     def _pg_where_full(self):
-        """-> (conjunction, or_branches): OR binds loosest (a AND b OR c
-        = (a AND b) OR c, PG precedence; no parenthesized grouping). A
-        plain conjunction returns ([triples], []); a disjunction returns
-        ([], [branch0, branch1, ...])."""
+        """-> (conjunction, or_branches): the WHERE boolean expression —
+        AND/OR with PG precedence plus parenthesized grouping — is
+        normalized to disjunctive normal form (ref: PG's planner reaches
+        the same shape via BitmapOr paths). A plain conjunction returns
+        ([triples], []); a disjunction returns ([], [branch, ...])."""
         if not self.accept_kw("WHERE"):
             return [], []
-        branches: List[List[Tuple[str, str, object]]] = [[]]
-        while True:
-            branches[-1].append(self._one_predicate())
-            if self.accept_kw("AND"):
-                continue
-            if self.accept_kw("OR"):
-                branches.append([])
-                continue
-            break
+        branches = self._bool_expr()
         if len(branches) == 1:
             return branches[0], []
         return [], branches
