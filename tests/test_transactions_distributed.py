@@ -194,3 +194,124 @@ def test_participant_recorded_before_write(env):
     assert len(txn._participants) == 1, (
         "tablet that may hold orphaned intents was not recorded")
     txn.abort()
+
+
+def test_concurrent_bank_transfers_conserve_total(env):
+    """The classic transactional invariant stress (ref: the reference's
+    snapshot-isolation bank workloads over mini_cluster): N threads move
+    random amounts between M accounts under snapshot isolation with
+    conflict retries, racing flushes — the total balance is conserved at
+    every read point and no account observes a torn transfer."""
+    import random
+    import threading
+
+    cluster, client, table, manager = env
+    n_accounts = 8
+    initial = 100
+    for a in range(n_accounts):
+        client.write(table, [QLWriteOp(
+            WriteOpKind.INSERT, dk(f"acct{a}"), {"n": initial})])
+
+    stop = threading.Event()
+    stats = {"committed": 0, "conflicts": 0}
+    lock = threading.Lock()
+    errors = []
+
+    def transfer_loop(seed: int):
+        rng = random.Random(seed)
+        while not stop.is_set():
+            src, dst = rng.sample(range(n_accounts), 2)
+            amount = rng.randrange(1, 20)
+            txn = manager.begin()
+            try:
+                rs = txn.read_row(table, dk(f"acct{src}"))
+                rd = txn.read_row(table, dk(f"acct{dst}"))
+                sbal = rs.columns[table.schema.column_id("n")]
+                dbal = rd.columns[table.schema.column_id("n")]
+                if sbal < amount:
+                    txn.abort()
+                    continue
+                txn.write(table, [
+                    QLWriteOp(WriteOpKind.UPDATE, dk(f"acct{src}"),
+                              {"n": sbal - amount}),
+                    QLWriteOp(WriteOpKind.UPDATE, dk(f"acct{dst}"),
+                              {"n": dbal + amount})])
+                txn.commit()
+                with lock:
+                    stats["committed"] += 1
+            except TransactionError:
+                with lock:
+                    stats["conflicts"] += 1
+                try:
+                    txn.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                try:
+                    txn.abort()  # never leak intents that block peers
+                except Exception:  # noqa: BLE001
+                    pass
+                return
+
+    def audit_loop():
+        cid = table.schema.column_id("n")
+        while not stop.is_set():
+            txn = manager.begin()
+            try:
+                total = 0
+                for a in range(n_accounts):
+                    row = txn.read_row(table, dk(f"acct{a}"))
+                    bal = row.columns[cid]
+                    if bal < 0:
+                        errors.append(f"negative balance acct{a}: {bal}")
+                    total += bal
+                txn.abort()  # read-only
+                if total != n_accounts * initial:
+                    errors.append(f"total drifted: {total}")
+                    return
+            except TransactionError:
+                try:
+                    txn.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+            except Exception as e:  # noqa: BLE001 — the auditor dying
+                # silently would leave the invariant unchecked mid-run
+                errors.append(f"auditor died: {e!r}")
+                try:
+                    txn.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+                return
+
+    def churn_loop():
+        while not stop.is_set():
+            for ts in cluster.tservers:
+                for tid in list(ts.tablet_manager.tablet_ids()):
+                    try:
+                        ts.tablet_manager.get_tablet(tid).tablet.flush()
+                    except Exception:  # noqa: BLE001 — tablet moving
+                        pass
+            time.sleep(0.5)
+
+    threads = [threading.Thread(target=transfer_loop, args=(i,),
+                                daemon=True) for i in range(4)]
+    threads.append(threading.Thread(target=audit_loop, daemon=True))
+    threads.append(threading.Thread(target=churn_loop, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors[:5]
+    assert stats["committed"] >= 10, stats
+
+    # final audit outside any races
+    cid = table.schema.column_id("n")
+    total = 0
+    for a in range(n_accounts):
+        row = client.read_row(table, dk(f"acct{a}"))
+        total += row.to_dict(table.schema)["n"] \
+            if hasattr(row, "to_dict") else row.columns[cid]
+    assert total == n_accounts * initial, (total, stats)

@@ -133,30 +133,49 @@ class YBTransaction:
                 raise TransactionError(f"transaction is {self._state}")
 
     # -------------------------------------------------------------- data ops
-    def write(self, table: YBTable, ops: Sequence[QLWriteOp]) -> None:
-        """Write provisional records; all ops must route to one tablet per
-        call (group by key like the session batcher for multi-key)."""
+    def write(self, table: YBTable, ops: Sequence[QLWriteOp],
+              _depth: int = 0) -> None:
+        """Write provisional records. Ops are grouped by destination
+        tablet internally (the session batcher's grouping, ref
+        client/batcher.cc) — one write RPC per tablet touched; callers
+        may pass any mix of keys. A tablet split between lookup and RPC
+        re-routes by key like YBClient.write does."""
         self._check_pending()
-        pk = table.partition_key_for(ops[0].doc_key)
-        tablet = self._client.meta_cache.lookup_tablet(table.table_id, pk)
-        # Record the participant BEFORE issuing the write: on a timeout or
-        # unknown outcome the intents may exist on the tablet anyway, and
-        # commit/abort must notify every tablet that may hold them —
-        # otherwise orphaned intents are never applied or cleaned up. A
-        # spurious participant (write never landed) costs one no-op
-        # notification.
-        self._participants.setdefault(tablet.tablet_id,
-                                      tablet.leader_addr())
-        try:
-            self._client._tablet_call(
-                table, tablet, "write", refresh_key=pk,
-                ops=[write_op_to_wire(op) for op in ops],
-                txn=self._meta().to_wire(),
-                schema_version=table.schema_version)
-        except RemoteError as e:
-            if e.extra.get("txn_conflict"):
-                raise TransactionError(e.status.message) from e
-            raise
+        groups: dict = {}
+        for op in ops:
+            pk = table.partition_key_for(op.doc_key)
+            tablet = self._client.meta_cache.lookup_tablet(
+                table.table_id, pk)
+            groups.setdefault(tablet.tablet_id, (tablet, pk, []))[2] \
+                .append(op)
+        for tablet, pk, group in groups.values():
+            # Record the participant BEFORE issuing the write: on a
+            # timeout or unknown outcome the intents may exist on the
+            # tablet anyway, and commit/abort must notify every tablet
+            # that may hold them — otherwise orphaned intents are never
+            # applied or cleaned up. A spurious participant (write never
+            # landed) costs one no-op notification.
+            self._participants.setdefault(tablet.tablet_id,
+                                          tablet.leader_addr())
+            try:
+                self._client._tablet_call(
+                    table, tablet, "write", refresh_key=pk,
+                    ops=[write_op_to_wire(op) for op in group],
+                    txn=self._meta().to_wire(),
+                    schema_version=table.schema_version)
+            except RemoteError as e:
+                if e.extra.get("txn_conflict"):
+                    raise TransactionError(e.status.message) from e
+                if (e.extra.get("tablet_split")
+                        or e.extra.get("wrong_tablet")) and _depth < 8:
+                    # stale routing (split landed between lookup and
+                    # RPC): refresh and re-group this group's ops by key
+                    import time as _time
+                    _time.sleep(0.15 * (_depth + 1))
+                    self._client.meta_cache.invalidate(table.table_id)
+                    self.write(table, group, _depth=_depth + 1)
+                    continue
+                raise
 
     def read_row(self, table: YBTable, doc_key: DocKey,
                  projection: Optional[Sequence[str]] = None):

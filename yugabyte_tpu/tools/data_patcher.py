@@ -95,13 +95,11 @@ def patch_sst(base_path: str, delta_ht: int, value_patch=None) -> int:
     r.close()
     if slab.n and value_patch is not None:
         from yugabyte_tpu.ops.slabs import ValueArray
-        raw = slab.key_words.astype(">u4").tobytes()
-        stride = slab.width_words * 4
         vals = list(slab.values)
         changed = False
         for i in range(slab.n):
-            kp = raw[i * stride: i * stride + int(slab.key_len[i])]
-            nv = value_patch(kp, vals[int(slab.value_idx[i])])
+            nv = value_patch(slab.key_bytes(i),
+                             vals[int(slab.value_idx[i])])
             if nv is not None:
                 vals[int(slab.value_idx[i])] = nv
                 changed = True

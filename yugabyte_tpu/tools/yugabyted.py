@@ -55,8 +55,15 @@ class YugabytedNode:
         # Readiness: wait until THIS tserver has registered with the
         # master (ref: yugabyted's post-start wait) — DDL issued right
         # after bringup must not race the first heartbeat and fail with
-        # "need N live tservers".
-        self._wait_registered(sid)
+        # "need N live tservers". On timeout, stop what we started — a
+        # failed __init__ returns no handle to shut anything down with.
+        try:
+            self._wait_registered(sid)
+        except BaseException:
+            self.tserver.shutdown()
+            if self.master is not None:
+                self.master.shutdown()
+            raise
         # Query-layer frontends (the reference tserver hosts the postgres
         # child + CQL/redis servers the same way; ref pg_wrapper.cc)
         from yugabyte_tpu.client.client import YBClient
