@@ -223,6 +223,28 @@ REGRESS = [
     ("SELECT pname FROM products WHERE (price > "
      "(SELECT AVG(price) FROM products)) OR pname = 'glue' "
      "ORDER BY pname", [("anvil",), ("glue",)]),
+    # ---- aggregates over joins (PG: Agg above the join tree) -----------
+    ("SELECT c.city, COUNT(*) FROM customers c JOIN orders o "
+     "ON c.cid = o.cid GROUP BY city",
+     [("london", "3"), ("paris", "1")]),
+    ("SELECT name, SUM(qty) FROM customers c JOIN orders o "
+     "ON c.cid = o.cid GROUP BY name HAVING SUM(qty) > 2",
+     [("ada", "3"), ("bob", "3"), ("cyd", "7")]),
+    ("SELECT MAX(price) FROM orders o JOIN products p ON o.pid = p.pid "
+     "WHERE o.qty > 2", [("15",)]),   # ungrouped aggregate over a join
+    ("SELECT c.name, COUNT(*) FROM customers c LEFT JOIN orders o "
+     "ON c.cid = o.cid GROUP BY c.name HAVING COUNT(*) > 1",
+     [("ada", "2")]),
+    # ORDER BY over aggregate output (group key desc, and output label)
+    ("SELECT c.city, COUNT(*) FROM customers c JOIN orders o "
+     "ON c.cid = o.cid GROUP BY city ORDER BY city DESC LIMIT 1",
+     [("paris", "1")]),
+    ("SELECT cid, SUM(qty) FROM orders GROUP BY cid "
+     "ORDER BY sum DESC LIMIT 2", [("3", "7"), ("1", "3")]),
+    # empty join-aggregate input still answers with the right shape
+    ("SELECT MAX(p.price) FROM orders o JOIN products p "
+     "ON o.pid = p.pid WHERE o.qty = "
+     "(SELECT qty FROM orders WHERE qty > 100)", [(None,)]),
 ]
 
 

@@ -693,6 +693,26 @@ class PgSession:
     _AGG_OUT_NAMES = {"COUNT": "count", "SUM": "sum", "AVG": "avg",
                       "MIN": "min", "MAX": "max"}
 
+    @staticmethod
+    def _order_agg_rows(col_desc, rows_out, order_by):
+        """ORDER BY over aggregate OUTPUT columns (group key or an output
+        label like `count`; PG orders the Agg node's result the same
+        way). Unknown names raise 42703 instead of silently no-op'ing."""
+        if not order_by:
+            return rows_out
+        names = [n for n, _oid in col_desc]
+        out = list(rows_out)
+        for col, desc in reversed(order_by):
+            bare = col.split(".")[-1]
+            if bare not in names:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{col}" does not exist'), "42703")
+            i = names.index(bare)
+            out.sort(key=lambda r: (r[i] is None,
+                                    0 if r[i] is None else r[i]),
+                     reverse=desc)
+        return out
+
     def _aggregate(self, stmt: P.Select, col_oid, dicts: List[dict]
                    ) -> Tuple[List[Tuple[str, int]], List[List[object]]]:
         """GROUP BY + aggregate evaluation (in-memory over the pushed-down
@@ -946,9 +966,53 @@ class PgSession:
 
         if stmt.count_star:
             return PgResult("SELECT 1", [("count", 20)], [[len(rows)]])
-        if stmt.aggregates or stmt.group_by or stmt.scalar_items:
+        if stmt.aggregates or stmt.group_by:
+            # aggregate over the joined row set: resolve references to
+            # their qualified "alias.col" form, then reuse the shared
+            # GROUP BY/HAVING machinery (ref: PG plans Agg above the
+            # join tree the same way)
+            from dataclasses import replace as _replace
+
+            def qual(c):
+                return "%s.%s" % resolve(c) if c else c
+
+            def qual_having(item):
+                if item[0] == "col":
+                    return ("col", qual(item[1]))
+                return ("agg", item[1], qual(item[2]) if item[2] else None)
+
+            agg_stmt = _replace(
+                stmt,
+                group_by=qual(stmt.group_by) if stmt.group_by else None,
+                aggregates=[(f, qual(c) if c else None)
+                            for f, c in stmt.aggregates],
+                having=[(qual_having(i), op, v)
+                        for i, op, v in stmt.having],
+                columns=[qual(c) for c in stmt.columns]
+                if stmt.columns else None)
+
+            if agg_stmt.columns and (len(agg_stmt.columns) != 1
+                                     or agg_stmt.columns[0]
+                                     != agg_stmt.group_by):
+                raise PgError(Status.InvalidArgument(
+                    "non-aggregated columns must appear in GROUP BY"),
+                    "42803")
+
+            def col_oid(qc):
+                a, c = qc.split(".", 1)
+                return PG_OIDS[by_alias[a].schema.column(c).type]
+
+            col_desc, rows_out = self._aggregate(agg_stmt, col_oid, rows)
+            # label group columns by their bare name, like PG
+            col_desc = [(n.split(".")[-1], o) for n, o in col_desc]
+            rows_out = self._order_agg_rows(col_desc, rows_out,
+                                            stmt.order_by)
+            if stmt.limit is not None:
+                rows_out = rows_out[: stmt.limit]
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
+        if stmt.scalar_items:
             raise PgError(Status.NotSupported(
-                "aggregates over joins are not supported"), "0A000")
+                "scalar functions over joins are not supported"), "0A000")
         if stmt.columns:
             proj = [resolve(c) for c in stmt.columns]
         else:
@@ -1070,11 +1134,36 @@ class PgSession:
         ... WHERE false -> one NULL row, COUNT -> 0)."""
         if stmt.count_star:
             return PgResult("SELECT 1", [("count", 20)], [[0]])
+        stmt = self._strip_base_qualifiers(stmt)
         table = self._table(stmt.table)
         schema = table.schema
         if stmt.aggregates or stmt.group_by:
-            col_desc, rows_out = self._aggregate(
-                stmt, lambda c: PG_OIDS[schema.column(c).type], [])
+            # resolve types over every FROM entry (qualified refs from a
+            # join must not KeyError against the base schema alone)
+            by_alias = {stmt.alias or stmt.table: table}
+            for j in stmt.joins:
+                by_alias[j.alias or j.table] = self._table(j.table)
+
+            def col_oid(c):
+                if "." in c:
+                    a, cc = c.split(".", 1)
+                    t = by_alias.get(a)
+                    if t is not None:
+                        try:
+                            return PG_OIDS[t.schema.column(cc).type]
+                        except KeyError:
+                            pass
+                else:
+                    for t in by_alias.values():
+                        try:
+                            return PG_OIDS[t.schema.column(c).type]
+                        except KeyError:
+                            continue
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
+
+            col_desc, rows_out = self._aggregate(stmt, col_oid, [])
+            col_desc = [(n.split(".")[-1], o) for n, o in col_desc]
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         out_cols = stmt.columns or [c.name for c in schema.columns
                                     if not c.dropped]
@@ -1136,6 +1225,8 @@ class PgSession:
                     "42803")
             col_desc, rows_out = self._aggregate(
                 stmt, lambda c: PG_OIDS[schema.column(c).type], dicts)
+            rows_out = self._order_agg_rows(col_desc, rows_out,
+                                            stmt.order_by)
             if stmt.limit is not None:
                 rows_out = rows_out[: stmt.limit]
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
@@ -1226,6 +1317,10 @@ class PgSession:
         ORDER BY, GROUP BY, aggregates, HAVING) must exist — one shared
         check so the OR path cannot diverge from the plain path."""
         known = {c.name for c in schema.columns}
+        if stmt.aggregates or stmt.group_by:
+            # ORDER BY may reference the aggregate OUTPUT labels
+            known = known | {self._AGG_OUT_NAMES[f]
+                             for f, _c in stmt.aggregates}
         check_cols = list(stmt.columns or []) \
             + [f[0] for f in stmt.where if f[0]] \
             + [f[0] for br in stmt.or_where for f in br if f[0]] \

@@ -421,7 +421,7 @@ class PgParser(_BaseParser):
                         raise ParseError(f"{func}(*) is not valid")
                     col = None
                 else:
-                    col = self.name()
+                    col = self._col_ref()
                 self.expect_op(")")
                 return ("agg", func, col)
         if tok is not None and tok[0] == "name" and nxt == ("op", "("):
@@ -542,7 +542,7 @@ class PgParser(_BaseParser):
         where, or_where = self._pg_where_full()
         group_by = None
         if self.accept_kw("GROUP", "BY"):
-            group_by = self.name()
+            group_by = self._col_ref()
         having: List[Tuple[tuple, str, object]] = []
         if self.accept_kw("HAVING"):
             while True:
@@ -592,7 +592,7 @@ class PgParser(_BaseParser):
                     raise ParseError(f"{func}(*) is not valid")
                 col = None
             else:
-                col = self.name()
+                col = self._col_ref()
             self.expect_op(")")
             return ("agg", func, col)
         return ("col", self._col_ref())
