@@ -245,6 +245,19 @@ REGRESS = [
     ("SELECT MAX(p.price) FROM orders o JOIN products p "
      "ON o.pid = p.pid WHERE o.qty = "
      "(SELECT qty FROM orders WHERE qty > 100)", [(None,)]),
+    # ---- BETWEEN / NOT BETWEEN (range desugar) -------------------------
+    ("SELECT oid FROM orders WHERE qty BETWEEN 2 AND 3 ORDER BY oid",
+     [("100",), ("102",)]),
+    ("SELECT oid FROM orders WHERE qty NOT BETWEEN 1 AND 3 ORDER BY oid",
+     [("103",)]),
+    ("SELECT name FROM customers WHERE cid BETWEEN 2 AND 3 "
+     "AND city = 'london'", [("cyd",)]),
+    # ---- DISTINCT aggregates ------------------------------------------
+    ("SELECT COUNT(DISTINCT cid) FROM orders", [("4",)]),
+    ("SELECT COUNT(DISTINCT city) FROM customers", [("3",)]),
+    ("SELECT SUM(DISTINCT qty) FROM orders", [("13",)]),   # 2+1+3+7
+    ("SELECT cid, COUNT(DISTINCT pid) FROM orders GROUP BY cid "
+     "ORDER BY cid", [("1", "2"), ("2", "1"), ("3", "1"), ("9", "1")]),
 ]
 
 
@@ -425,3 +438,9 @@ class TestDmlSubqueries:
                          "(SELECT k FROM dml3 WHERE v = 2)")
         conn.query("COMMIT")
         assert got == [("2",)]
+
+
+def test_having_distinct_aggregate(conn):
+    r = rows(conn, "SELECT cid FROM orders GROUP BY cid "
+                   "HAVING COUNT(DISTINCT pid) > 1")
+    assert r == [("1",)]

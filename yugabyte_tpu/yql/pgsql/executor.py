@@ -720,6 +720,7 @@ class PgSession:
         evaluates in PG for YSQL — ref pgsql aggregate paths).
         col_oid: column name -> PG type oid (table schema or vtable)."""
         def agg_oid(func: str, col: Optional[str]) -> int:
+            func = func.split()[0]
             if func == "COUNT":
                 return 20
             if func == "AVG":
@@ -739,11 +740,15 @@ class PgSession:
         if group_col is not None:
             col_desc.append((group_col, col_oid(group_col)))
         for func, col in stmt.aggregates:
-            col_desc.append((self._AGG_OUT_NAMES[func], agg_oid(func, col)))
+            col_desc.append((self._AGG_OUT_NAMES[func.split()[0]],
+                             agg_oid(func, col)))
         def agg_value(func, col, members):
             vals = ([1 for _ in members] if col is None
                     else [m[col] for m in members
                           if m.get(col) is not None])
+            if func.endswith(" DISTINCT"):
+                func = func.split()[0]
+                vals = list(dict.fromkeys(vals))  # O(n) ordered dedup
             if func == "COUNT":
                 return len(vals)
             if not vals:
@@ -1319,7 +1324,7 @@ class PgSession:
         known = {c.name for c in schema.columns}
         if stmt.aggregates or stmt.group_by:
             # ORDER BY may reference the aggregate OUTPUT labels
-            known = known | {self._AGG_OUT_NAMES[f]
+            known = known | {self._AGG_OUT_NAMES[f.split()[0]]
                              for f, _c in stmt.aggregates}
         check_cols = list(stmt.columns or []) \
             + [f[0] for f in stmt.where if f[0]] \

@@ -421,6 +421,8 @@ class PgParser(_BaseParser):
                         raise ParseError(f"{func}(*) is not valid")
                     col = None
                 else:
+                    if self.accept_kw("DISTINCT"):
+                        func = func + " DISTINCT"
                     col = self._col_ref()
                 self.expect_op(")")
                 return ("agg", func, col)
@@ -592,6 +594,8 @@ class PgParser(_BaseParser):
                     raise ParseError(f"{func}(*) is not valid")
                 col = None
             else:
+                if self.accept_kw("DISTINCT"):
+                    func = func + " DISTINCT"
                 col = self._col_ref()
             self.expect_op(")")
             return ("agg", func, col)
@@ -664,6 +668,28 @@ class PgParser(_BaseParser):
             branches = self._bool_expr()
             self.expect_op(")")
             return branches
+        return self._predicate_branches()
+
+    def _predicate_branches(self) -> List[List[Tuple[str, str, object]]]:
+        """One predicate as DNF branches: most are a single triple;
+        BETWEEN expands to a range conjunction, NOT BETWEEN to the
+        complementary disjunction (PG desugars identically)."""
+        tok = self.peek()
+        if tok is not None and tok[0] == "name" \
+                and tok[1].upper() not in ("EXISTS", "NOT"):
+            save = self.pos
+            col = self._col_ref()
+            if self.accept_kw("BETWEEN"):
+                lo = self.literal()
+                self.expect_kw("AND")
+                hi = self.literal()
+                return [[(col, ">=", lo), (col, "<=", hi)]]
+            if self.accept_kw("NOT", "BETWEEN"):
+                lo = self.literal()
+                self.expect_kw("AND")
+                hi = self.literal()
+                return [[(col, "<", lo)], [(col, ">", hi)]]
+            self.pos = save
         return [[self._one_predicate()]]
 
     _MAX_DNF_BRANCHES = 64
@@ -711,7 +737,9 @@ class PgParser(_BaseParser):
     def _pg_where(self) -> List[Tuple[str, str, object]]:
         where, or_branches = self._pg_where_full()
         if or_branches:
-            raise ParseError("OR is not supported in this statement")
+            raise ParseError(
+                "disjunctions (OR / NOT BETWEEN) are not supported in "
+                "this statement")
         return where
 
     def _update(self) -> Update:
