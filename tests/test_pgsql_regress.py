@@ -444,3 +444,39 @@ def test_having_distinct_aggregate(conn):
     r = rows(conn, "SELECT cid FROM orders GROUP BY cid "
                    "HAVING COUNT(DISTINCT pid) > 1")
     assert r == [("1",)]
+
+
+class TestArithmetic:
+    def test_select_list_arithmetic(self, conn):
+        assert rows(conn, "SELECT price * 2 FROM products "
+                          "WHERE pname = 'rope'") == [("30",)]
+        assert rows(conn, "SELECT qty + cid, oid FROM orders "
+                          "WHERE oid = 102") == [("5", "102")]
+        # precedence and grouping
+        assert rows(conn, "SELECT price + 10 * 2 FROM products "
+                          "WHERE pname = 'glue'") == [("25",)]
+        assert rows(conn, "SELECT (price + 10) * 2 FROM products "
+                          "WHERE pname = 'glue'") == [("30",)]
+        # PG integer division truncates; % is modulo
+        assert rows(conn, "SELECT price / 4, price % 4 FROM products "
+                          "WHERE pname = 'rope'") == [("3", "3")]
+        # mixed with scalar builtins
+        assert rows(conn, "SELECT length(pname) + 1 FROM products "
+                          "WHERE pname = 'anvil'") == [("6",)]
+
+    def test_division_by_zero_errors(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("SELECT price / 0 FROM products")
+
+    def test_arith_edge_semantics(self, conn):
+        # subtraction without whitespace (operator-vs-negative-literal lex)
+        assert rows(conn, "SELECT price-2 FROM products "
+                          "WHERE pname = 'glue'") == [("3",)]
+        # PG modulo: result sign follows the dividend
+        assert rows(conn, "SELECT (0 - 7) % 2 FROM products "
+                          "WHERE pname = 'glue'") == [("-1",)]
+        # non-numeric operand: clean error, connection survives
+        with pytest.raises(PgWireError):
+            conn.query("SELECT pname + 1 FROM products")
+        assert rows(conn, "SELECT pname FROM products "
+                          "WHERE pname = 'glue'") == [("glue",)]

@@ -31,7 +31,7 @@ _TOKEN_RE = re.compile(r"""
     | (?P<number>-?\d+\.\d+|-?\d+)
     | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
     | (?P<param>\$\d+)
-    | (?P<op><=|>=|!=|[=<>(),;*?.])
+    | (?P<op><=|>=|!=|[=<>(),;*?.+%/-])
     )""", re.VERBOSE)
 
 
@@ -46,7 +46,18 @@ def tokenize(text: str) -> List[Tuple[str, str]]:
             raise ParseError(f"unexpected character {text[pos]!r}")
         pos = m.end()
         kind = m.lastgroup
-        out.append((kind, m.group(kind)))
+        tok = m.group(kind)
+        # `price-2` / `3-1`: a '-' directly after a value-like token is
+        # the subtraction operator, not a negative-literal sign (PG lexes
+        # '-' as an operator always; we keep the sign only where a value
+        # cannot precede it, e.g. VALUES (-5))
+        if kind == "number" and tok.startswith("-") and out and (
+                out[-1][0] in ("name", "number", "blob", "param")
+                or out[-1] == ("op", ")")):
+            out.append(("op", "-"))
+            out.append(("number", tok[1:]))
+        else:
+            out.append((kind, tok))
     return out
 
 

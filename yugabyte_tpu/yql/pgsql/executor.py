@@ -1053,6 +1053,8 @@ class PgSession:
                 return ("col", fix(it[1]))
             if it[0] == "func":
                 return ("func", it[1], [fix_item(a) for a in it[2]])
+            if it[0] == "op":
+                return ("op", it[1], fix_item(it[2]), fix_item(it[3]))
             return it
 
         def fix_having(item):
@@ -1357,6 +1359,56 @@ class PgSession:
             if it[0] == "lit":
                 v = it[1]
                 return bfunc.infer_type(v), (lambda d, _v=v: _v)
+            if it[0] == "op":
+                # arithmetic with SQL NULL propagation and PG numeric
+                # typing (int op int -> int, '/' truncates; any float
+                # operand -> float; division by zero -> 22012)
+                lt, lf = compile_item(it[2])
+                rt, rf = compile_item(it[3])
+                numeric = (DataType.INT64, DataType.DOUBLE,
+                           DataType.FLOAT, DataType.INT32, None)
+                if lt not in numeric or rt not in numeric:
+                    raise PgError(Status.InvalidArgument(
+                        f"operator {it[1]} does not accept type "
+                        f"{(lt if lt not in numeric else rt)}"), "42883")
+                both_int = (lt == DataType.INT64 and rt == DataType.INT64)
+                # PG numeric typing: int op int stays int ('/' truncates
+                # toward zero); any float operand promotes to float
+                out_t = DataType.INT64 if both_int else DataType.DOUBLE
+                o = it[1]
+
+                def ev_op(d, _o=o, _lf=lf, _rf=rf, _int=both_int):
+                    a = _lf(d)
+                    b = _rf(d)
+                    if a is None or b is None:
+                        return None
+                    if not isinstance(a, (int, float)) \
+                            or not isinstance(b, (int, float)) \
+                            or isinstance(a, bool) or isinstance(b, bool):
+                        # untyped (builtin-ANY) operand turned out
+                        # non-numeric at runtime
+                        raise PgError(Status.InvalidArgument(
+                            f"operator {_o} requires numeric operands"),
+                            "42883")
+                    try:
+                        if _o == "+":
+                            return a + b
+                        if _o == "-":
+                            return a - b
+                        if _o == "*":
+                            return a * b
+                        if _o == "%":
+                            # PG %: the result sign follows the DIVIDEND
+                            r = abs(a) % abs(b)
+                            return r if a >= 0 else -r
+                        if _int:
+                            q = abs(a) // abs(b)
+                            return q if (a >= 0) == (b >= 0) else -q
+                        return a / b
+                    except ZeroDivisionError:
+                        raise PgError(Status.InvalidArgument(
+                            "division by zero"), "22012")
+                return out_t, ev_op
             sub = [compile_item(a) for a in it[2]]
             try:
                 decl = bfunc.resolve(it[1], [t for t, _f in sub])
@@ -1379,7 +1431,12 @@ class PgSession:
         col_desc = []
         fns = []
         for it in items:
-            label = it[1].lower() if it[0] == "func" else it[1]
+            if it[0] == "func":
+                label = it[1].lower()
+            elif it[0] in ("op", "lit"):
+                label = "?column?"   # PG's label for anonymous expressions
+            else:
+                label = it[1]
             t, fn = compile_item(it)
             col_desc.append((label, PG_OIDS.get(t, 25)))
             fns.append(fn)

@@ -408,27 +408,75 @@ class PgParser(_BaseParser):
 
     def _select_item(self):
         """-> ("col", name) | ("agg", func, col_or_None) |
-        ("func", name, args) for scalar builtins (yql/bfunc.py)"""
+        ("func", name, args) for scalar builtins (yql/bfunc.py) |
+        ("op", op, left, right) for arithmetic over any of these"""
         tok = self.peek()
         nxt = self._peek2()
         if tok is not None and tok[0] == "name" \
-                and tok[1].upper() in self._AGG_FUNCS:
-            if nxt == ("op", "("):
-                func = self.name().upper()
-                self.expect_op("(")
-                if self.accept_op("*"):
-                    if func != "COUNT":
-                        raise ParseError(f"{func}(*) is not valid")
-                    col = None
-                else:
-                    if self.accept_kw("DISTINCT"):
-                        func = func + " DISTINCT"
-                    col = self._col_ref()
-                self.expect_op(")")
-                return ("agg", func, col)
+                and tok[1].upper() in self._AGG_FUNCS \
+                and nxt == ("op", "("):
+            return self._agg_call()
+        return self._arith_expr()
+
+    def _agg_call(self):
+        """FUNC([DISTINCT] col | *) -> ("agg", func_name, col_or_None).
+        DISTINCT is encoded by appending " DISTINCT" to the function name
+        (consumers normalize with func.split()[0])."""
+        func = self.name().upper()
+        self.expect_op("(")
+        if self.accept_op("*"):
+            if func != "COUNT":
+                raise ParseError(f"{func}(*) is not valid")
+            col = None
+        else:
+            if self.accept_kw("DISTINCT"):
+                func = func + " DISTINCT"
+            col = self._col_ref()
+        self.expect_op(")")
+        return ("agg", func, col)
+
+    # Arithmetic over select-list primaries (ref: PG a_expr — the subset
+    # with + - * / % and standard precedence; no unary minus on columns).
+    _ADD_OPS = ("+", "-")
+    _MUL_OPS = ("*", "/", "%")
+
+    def _arith_expr(self):
+        left = self._arith_term()
+        while True:
+            tok = self.peek()
+            if tok is not None and tok[0] == "op" \
+                    and tok[1] in self._ADD_OPS:
+                self.next()
+                left = ("op", tok[1], left, self._arith_term())
+            else:
+                return left
+
+    def _arith_term(self):
+        left = self._arith_primary()
+        while True:
+            tok = self.peek()
+            if tok is not None and tok[0] == "op" \
+                    and tok[1] in self._MUL_OPS:
+                # '*' here is multiplication: a primary always precedes it
+                self.next()
+                left = ("op", tok[1], left, self._arith_primary())
+            else:
+                return left
+
+    def _arith_primary(self):
+        tok = self.peek()
+        nxt = self._peek2()
+        if tok == ("op", "("):
+            self.expect_op("(")
+            e = self._arith_expr()
+            self.expect_op(")")
+            return e
         if tok is not None and tok[0] == "name" and nxt == ("op", "("):
             return self._scalar_func()
-        return ("col", self._col_ref())
+        if tok is not None and tok[0] == "name" \
+                and tok[1].upper() not in ("TRUE", "FALSE", "NULL"):
+            return ("col", self._col_ref())
+        return ("lit", self.literal())
 
     def _scalar_func(self):
         fname = self.name()
@@ -493,15 +541,15 @@ class PgParser(_BaseParser):
                 items.append(self._select_item())
             aggs = [i for i in items if i[0] == "agg"]
             cols = [i[1] for i in items if i[0] == "col"]
-            funcs = [i for i in items if i[0] == "func"]
-            if aggs and funcs:
+            exprs = [i for i in items if i[0] in ("func", "op", "lit")]
+            if aggs and exprs:
                 raise ParseError(
-                    "mixing aggregates and scalar functions in one "
+                    "mixing aggregates and scalar expressions in one "
                     "select list is not supported")
             if aggs:
                 aggregates = [(f, c) for _k, f, c in aggs]
                 columns = cols or None   # group-by columns, if any
-            elif funcs:
+            elif exprs:
                 scalar_items = items
                 # base columns the evaluation needs (validated + fetched)
                 def _refs(it):
@@ -512,6 +560,8 @@ class PgParser(_BaseParser):
                         for a in it[2]:
                             out.extend(_refs(a) if a[0] != "lit" else [])
                         return out
+                    if it[0] == "op":
+                        return _refs(it[2]) + _refs(it[3])
                     return []
                 seen = []
                 for it in items:
@@ -587,18 +637,7 @@ class PgParser(_BaseParser):
         if tok is not None and tok[0] == "name" \
                 and tok[1].upper() in self._AGG_FUNCS \
                 and self._peek2() == ("op", "("):
-            func = self.name().upper()
-            self.expect_op("(")
-            if self.accept_op("*"):
-                if func != "COUNT":
-                    raise ParseError(f"{func}(*) is not valid")
-                col = None
-            else:
-                if self.accept_kw("DISTINCT"):
-                    func = func + " DISTINCT"
-                col = self._col_ref()
-            self.expect_op(")")
-            return ("agg", func, col)
+            return self._agg_call()
         return ("col", self._col_ref())
 
     def _comparison_op(self) -> str:
@@ -790,6 +829,8 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
                 return ("lit", sub(it[1]))
             if it[0] == "func":
                 return ("func", it[1], [sub_item(a) for a in it[2]])
+            if it[0] == "op":
+                return ("op", it[1], sub_item(it[2]), sub_item(it[3]))
             return it
 
         def sub_val(v):
