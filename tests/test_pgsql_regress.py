@@ -480,3 +480,28 @@ class TestArithmetic:
             conn.query("SELECT pname + 1 FROM products")
         assert rows(conn, "SELECT pname FROM products "
                           "WHERE pname = 'glue'") == [("glue",)]
+
+
+class TestOffset:
+    def test_limit_offset(self, conn):
+        assert rows(conn, "SELECT cid FROM customers ORDER BY cid "
+                          "LIMIT 2 OFFSET 1") == [("2",), ("3",)]
+        assert rows(conn, "SELECT cid FROM customers ORDER BY cid "
+                          "OFFSET 3") == [("4",)]
+        assert rows(conn, "SELECT cid FROM customers ORDER BY cid "
+                          "OFFSET 9") == []
+        # offset without order (no early-stop miscount)
+        assert len(rows(conn, "SELECT cid FROM customers "
+                              "LIMIT 2 OFFSET 2")) == 2
+
+    def test_offset_edge_semantics(self, conn):
+        # OFFSET applies to the whole UNION, after combination
+        assert rows(conn, "SELECT cid FROM customers WHERE cid <= 2 UNION "
+                          "SELECT cid FROM customers WHERE cid >= 3 "
+                          "ORDER BY cid OFFSET 2") == [("3",), ("4",)]
+        # COUNT(*) is one result row; OFFSET 1 skips it (PG semantics)
+        assert rows(conn, "SELECT COUNT(*) FROM customers OFFSET 1") == []
+        # DISTINCT + LIMIT must not early-stop before enough DISTINCT rows
+        assert rows(conn, "SELECT DISTINCT city FROM customers "
+                          "ORDER BY city LIMIT 2") == \
+            [("london",), ("oslo",)]

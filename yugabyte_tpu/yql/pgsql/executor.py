@@ -68,6 +68,16 @@ def _pg_error(e: StatusError) -> PgError:
     return PgError(e.status, _SQLSTATE.get(e.status.code, "XX000"))
 
 
+def _page_rows(rows_out, stmt):
+    """OFFSET before LIMIT (PG evaluation order)."""
+    off = getattr(stmt, "offset", 0) or 0
+    if off:
+        rows_out = rows_out[off:]
+    if stmt.limit is not None:
+        rows_out = rows_out[: stmt.limit]
+    return rows_out
+
+
 def _dedup_rows(rows_out):
     """First-occurrence dedup preserving order (SELECT DISTINCT applied
     after projection, like PG's unique node over the sorted/plain path)."""
@@ -593,19 +603,18 @@ class PgSession:
         dicts = [d for d in rows
                  if row_matches(d, [list(f) for f in stmt.where])]
         if stmt.count_star:
-            return PgResult("SELECT 1", [("count", 20)], [[len(dicts)]])
+            out = _page_rows([[len(dicts)]], stmt)
+            return PgResult(f"SELECT {len(out)}", [("count", 20)], out)
         if stmt.aggregates or stmt.group_by:
             col_desc, rows_out = self._aggregate(
                 stmt, lambda c: by_name.get(c, 25), dicts)
-            if stmt.limit is not None:
-                rows_out = rows_out[: stmt.limit]
+            rows_out = _page_rows(rows_out, stmt)
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         dicts = self._order_rows(dicts, stmt.order_by)
         rows_out = [[d.get(c) for c in out_cols] for d in dicts]
         if stmt.distinct:
             rows_out = _dedup_rows(rows_out)
-        if stmt.limit is not None:
-            rows_out = rows_out[: stmt.limit]
+        rows_out = _page_rows(rows_out, stmt)
         return PgResult(f"SELECT {len(rows_out)}",
                         [(c, by_name[c]) for c in out_cols], rows_out)
 
@@ -655,7 +664,10 @@ class PgSession:
         # bare SELECT can stop at LIMIT rows early
         early_limit = (stmt.limit if not stmt.order_by and not stmt.group_by
                        and not stmt.aggregates and not stmt.count_star
-                       else None)
+                       and not stmt.distinct else None)
+        if early_limit is not None and getattr(stmt, "offset", 0):
+            # the post-fetch OFFSET slice still needs those leading rows
+            early_limit += stmt.offset
         if dk is not None:
             if self._txn is not None:
                 row = self._txn.read_row(table, dk)
@@ -810,6 +822,7 @@ class PgSession:
         if (stmt.count_star or stmt.aggregates or stmt.group_by
                 or stmt.order_by or stmt.scalar_items or stmt.joins
                 or stmt.having or stmt.distinct or stmt.or_where
+                or stmt.offset
                 or any(op in ("exists", "not exists")
                        or isinstance(v, P.Select)
                        for _c, op, v in stmt.where)
@@ -970,7 +983,8 @@ class PgSession:
             rows = [r for r in rows if row_matches(r, residual)]
 
         if stmt.count_star:
-            return PgResult("SELECT 1", [("count", 20)], [[len(rows)]])
+            out = _page_rows([[len(rows)]], stmt)
+            return PgResult(f"SELECT {len(out)}", [("count", 20)], out)
         if stmt.aggregates or stmt.group_by:
             # aggregate over the joined row set: resolve references to
             # their qualified "alias.col" form, then reuse the shared
@@ -1012,8 +1026,7 @@ class PgSession:
             col_desc = [(n.split(".")[-1], o) for n, o in col_desc]
             rows_out = self._order_agg_rows(col_desc, rows_out,
                                             stmt.order_by)
-            if stmt.limit is not None:
-                rows_out = rows_out[: stmt.limit]
+            rows_out = _page_rows(rows_out, stmt)
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         if stmt.scalar_items:
             raise PgError(Status.NotSupported(
@@ -1031,8 +1044,7 @@ class PgSession:
         rows_out = [[r.get(f"{a}.{c}") for a, c in proj] for r in rows]
         if stmt.distinct:
             rows_out = _dedup_rows(rows_out)
-        if stmt.limit is not None:
-            rows_out = rows_out[: stmt.limit]
+        rows_out = _page_rows(rows_out, stmt)
         return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
 
     @staticmethod
@@ -1140,7 +1152,8 @@ class PgSession:
         produce their single row over the empty set (PG: SELECT MAX(x)
         ... WHERE false -> one NULL row, COUNT -> 0)."""
         if stmt.count_star:
-            return PgResult("SELECT 1", [("count", 20)], [[0]])
+            out = _page_rows([[0]], stmt)
+            return PgResult(f"SELECT {len(out)}", [("count", 20)], out)
         stmt = self._strip_base_qualifiers(stmt)
         table = self._table(stmt.table)
         schema = table.schema
@@ -1223,7 +1236,8 @@ class PgSession:
         / DISTINCT / projection over an already-fetched row set."""
         schema = table.schema
         if stmt.count_star:
-            return PgResult("SELECT 1", [("count", 20)], [[len(dicts)]])
+            out = _page_rows([[len(dicts)]], stmt)
+            return PgResult(f"SELECT {len(out)}", [("count", 20)], out)
         if stmt.aggregates or stmt.group_by:
             if stmt.columns and (len(stmt.columns) != 1
                                  or stmt.columns[0] != stmt.group_by):
@@ -1234,8 +1248,7 @@ class PgSession:
                 stmt, lambda c: PG_OIDS[schema.column(c).type], dicts)
             rows_out = self._order_agg_rows(col_desc, rows_out,
                                             stmt.order_by)
-            if stmt.limit is not None:
-                rows_out = rows_out[: stmt.limit]
+            rows_out = _page_rows(rows_out, stmt)
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         dicts = self._order_rows(dicts, stmt.order_by)
         if stmt.scalar_items:
@@ -1243,8 +1256,7 @@ class PgSession:
                                                       schema, dicts)
             if stmt.distinct:
                 rows_out = _dedup_rows(rows_out)
-            if stmt.limit is not None:
-                rows_out = rows_out[: stmt.limit]
+            rows_out = _page_rows(rows_out, stmt)
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         out_cols = stmt.columns or [c.name for c in schema.columns
                                     if not c.dropped]
@@ -1252,8 +1264,7 @@ class PgSession:
         rows_out = [[d.get(c) for c in out_cols] for d in dicts]
         if stmt.distinct:
             rows_out = _dedup_rows(rows_out)
-        if stmt.limit is not None:
-            rows_out = rows_out[: stmt.limit]
+        rows_out = _page_rows(rows_out, stmt)
         return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
 
     def _select_union(self, stmt: P.UnionSelect) -> PgResult:
@@ -1295,8 +1306,7 @@ class PgSession:
                     key=lambda r: (r[i] is None,
                                    0 if r[i] is None else r[i]),
                     reverse=desc)
-        if stmt.limit is not None:
-            rows_out = rows_out[: stmt.limit]
+        rows_out = _page_rows(rows_out, stmt)
         return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
 
     def _select(self, stmt) -> PgResult:

@@ -126,6 +126,7 @@ class Select:
     # HAVING conjunction: (item, op, literal) where item is
     # ("agg", FUNC, col_or_None) or ("col", name)
     having: List[Tuple[tuple, str, object]] = field(default_factory=list)
+    offset: int = 0                    # LIMIT ... OFFSET n
 
 
 @dataclass
@@ -139,6 +140,7 @@ class UnionSelect:
     alls: List[bool]
     order_by: List[Tuple[str, bool]] = field(default_factory=list)
     limit: Optional[int] = None
+    offset: int = 0
 
 
 @dataclass
@@ -305,8 +307,8 @@ class PgParser(_BaseParser):
 
     # ----------------------------------------------------------- helpers
     _RESERVED = {"JOIN", "INNER", "LEFT", "OUTER", "ON", "WHERE", "GROUP",
-                 "ORDER", "LIMIT", "AND", "FROM", "AS", "FETCH", "FOR",
-                 "UNION", "HAVING"}
+                 "ORDER", "LIMIT", "OFFSET", "AND", "OR", "FROM", "AS",
+                 "FETCH", "FOR", "UNION", "HAVING"}
 
     def _maybe_alias(self) -> Optional[str]:
         if self.accept_kw("AS"):
@@ -507,18 +509,20 @@ class PgParser(_BaseParser):
         selects = [first]
         alls: List[bool] = []
         while self.accept_kw("UNION"):
-            if selects[-1].order_by or selects[-1].limit is not None:
+            if selects[-1].order_by or selects[-1].limit is not None \
+                    or selects[-1].offset:
                 raise ParseError(
-                    "ORDER BY/LIMIT must follow the last UNION member")
+                    "ORDER BY/LIMIT/OFFSET must follow the last UNION "
+                    "member")
             alls.append(bool(self.accept_kw("ALL")))
             self.expect_kw("SELECT")
             selects.append(self._select())
         if len(selects) == 1:
             return first
         last = selects[-1]
-        order_by, limit = last.order_by, last.limit
-        last.order_by, last.limit = [], None
-        return UnionSelect(selects, alls, order_by, limit)
+        order_by, limit, offset = last.order_by, last.limit, last.offset
+        last.order_by, last.limit, last.offset = [], None, 0
+        return UnionSelect(selects, alls, order_by, limit, offset)
 
     def _subselect(self) -> Select:
         """'(' SELECT ... ')' (no nested unions inside predicates)."""
@@ -618,6 +622,11 @@ class PgParser(_BaseParser):
             limit = self.literal()   # int literal or $n placeholder
             if not isinstance(limit, Param):
                 limit = int(limit)
+        offset = 0
+        if self.accept_kw("OFFSET"):
+            offset = self.literal()   # int literal or $n placeholder
+            if not isinstance(offset, Param):
+                offset = int(offset)
         # a lone COUNT(*) with no grouping is the classic count-star fast
         # path; COUNT(*) under GROUP BY must stay an aggregate per group
         if (aggregates == [("COUNT", None)] and columns is None
@@ -629,7 +638,7 @@ class PgParser(_BaseParser):
                       aggregates=aggregates, group_by=group_by,
                       order_by=order_by, scalar_items=scalar_items,
                       having=having, distinct=distinct,
-                      or_where=or_where)
+                      or_where=or_where, offset=offset)
 
     def _having_item(self) -> tuple:
         """("agg", FUNC, col_or_None) | ("col", name)."""
@@ -818,7 +827,7 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
             ulimit = int(ulimit)
         return replace(stmt, selects=[bind_params(s, params)
                                       for s in stmt.selects],
-                       limit=ulimit)
+                       limit=ulimit, offset=int(sub(stmt.offset) or 0))
     if isinstance(stmt, Select):
         limit = sub(stmt.limit)
         if limit is not None:
@@ -839,11 +848,12 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
             if isinstance(v, tuple):
                 return tuple(sub(x) for x in v)  # IN list
             return sub(v)
+        offset = sub(stmt.offset)
         return replace(stmt, where=[(c, op, sub_val(v))
                                     for c, op, v in stmt.where],
                        or_where=[[(c, op, sub_val(v)) for c, op, v in br]
                                  for br in stmt.or_where],
-                       limit=limit,
+                       limit=limit, offset=int(offset or 0),
                        scalar_items=[sub_item(i)
                                      for i in stmt.scalar_items],
                        having=[(i, op, sub(v))
@@ -894,6 +904,7 @@ def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
             visit(item[2] if item[0] == "agg" and item[2] else "__having__",
                   v)
         visit("__limit__", stmt.limit)
+        visit("__limit__", stmt.offset)
     elif isinstance(stmt, Update):
         for c, v in stmt.assignments:
             visit(c, v)
