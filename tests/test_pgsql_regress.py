@@ -505,3 +505,52 @@ class TestOffset:
         assert rows(conn, "SELECT DISTINCT city FROM customers "
                           "ORDER BY city LIMIT 2") == \
             [("london",), ("oslo",)]
+
+
+class TestRmwUpdate:
+    def test_update_column_expression(self, conn):
+        conn.query("CREATE TABLE ctr (k INT PRIMARY KEY, n INT, m INT)")
+        conn.query("INSERT INTO ctr (k, n, m) VALUES (1, 10, 1), "
+                   "(2, 20, 2)")
+        conn.query("UPDATE ctr SET n = n + 5 WHERE k = 1")
+        assert rows(conn, "SELECT n FROM ctr WHERE k = 1") == [("15",)]
+        # multi-row RMW with cross-column expression
+        conn.query("UPDATE ctr SET n = n * 2 + m")
+        assert rows(conn, "SELECT k, n FROM ctr ORDER BY k") == \
+            [("1", "31"), ("2", "42")]
+        # mixed plain + expression assignments in one statement
+        conn.query("UPDATE ctr SET m = 9, n = n - 1 WHERE k = 2")
+        assert rows(conn, "SELECT n, m FROM ctr WHERE k = 2") == \
+            [("41", "9")]
+
+    def test_concurrent_increments_do_not_lose(self, conn, cluster):
+        import threading
+        conn.query("CREATE TABLE inc (k INT PRIMARY KEY, n INT)")
+        conn.query("INSERT INTO inc (k, n) VALUES (1, 0)")
+        errors = []
+
+        srv_host, srv_port = conn.sock.getpeername()
+
+        def worker():
+            import pg_wire_client
+            c = pg_wire_client.PgWireClient(srv_host, srv_port)
+            try:
+                done = 0
+                while done < 10:
+                    try:
+                        c.query("UPDATE inc SET n = n + 1 WHERE k = 1")
+                        done += 1
+                    except pg_wire_client.PgWireError as e:
+                        if "40001" not in str(e):
+                            errors.append(repr(e))
+                            return
+            finally:
+                c.close()
+
+        ts = [threading.Thread(target=worker) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert rows(conn, "SELECT n FROM inc") == [("30",)]

@@ -1352,20 +1352,23 @@ class PgSession:
                 raise PgError(Status.InvalidArgument(
                     f'column "{c}" does not exist'), "42703")
 
-    def _project_scalar(self, items, schema, dicts):
-        """Scalar-builtin select list (yql/bfunc.py, the bfpg registry
-        equivalent). Each item compiles ONCE per statement — signature
-        resolution is type-driven and row-invariant — to a closure run
-        per row. Labels follow PG (function outputs are labeled by the
-        function name)."""
+    def _compile_row_expr(self, it, schema):
+        """Compile one row expression — ("col", name) | ("lit", v) |
+        ("func", name, args) | ("op", op, l, r) — ONCE per statement to a
+        (result DataType or None, fn(row_dict) -> value) pair; shared by
+        the scalar select list and read-modify-write UPDATE."""
         from yugabyte_tpu.yql import bfunc
 
         def compile_item(it):
             """-> (result DataType or None, fn(row_dict) -> value)"""
             if it[0] == "col":
                 name = it[1]
-                return schema.column(name).type, \
-                    (lambda d, _c=name: d.get(_c))
+                try:
+                    t = schema.column(name).type
+                except KeyError:
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{name}" does not exist'), "42703")
+                return t, (lambda d, _c=name: d.get(_c))
             if it[0] == "lit":
                 v = it[1]
                 return bfunc.infer_type(v), (lambda d, _v=v: _v)
@@ -1438,6 +1441,14 @@ class PgSession:
                                   "22000")
             return (None if decl.ret_type is bfunc.ANY else decl.ret_type), ev
 
+        return compile_item(it)
+
+    def _project_scalar(self, items, schema, dicts):
+        """Scalar-builtin select list (yql/bfunc.py, the bfpg registry
+        equivalent). Each item compiles ONCE per statement — signature
+        resolution is type-driven and row-invariant — to a closure run
+        per row. Labels follow PG (function outputs are labeled by the
+        function name)."""
         col_desc = []
         fns = []
         for it in items:
@@ -1447,7 +1458,7 @@ class PgSession:
                 label = "?column?"   # PG's label for anonymous expressions
             else:
                 label = it[1]
-            t, fn = compile_item(it)
+            t, fn = self._compile_row_expr(it, schema)
             col_desc.append((label, PG_OIDS.get(t, 25)))
             fns.append(fn)
         rows_out = [[fn(d) for fn in fns] for d in dicts]
@@ -1467,31 +1478,39 @@ class PgSession:
         return self._client.scan(table, read_ht=read_ht,
                                  filters=filters or None, txn_id=txn_id)
 
-    def _target_keys(self, table: YBTable,
+    def _target_rows(self, table: YBTable,
                      where: List[Tuple[str, str, object]], txn=None):
-        """Doc keys matching WHERE: point lookup for a full key, pushed-
-        down scan otherwise (PG semantics: UPDATE/DELETE take any WHERE).
-        With `txn`, reads pin that transaction's snapshot + overlay."""
+        """(doc_key, row_dict) pairs matching WHERE — the read half of a
+        read-modify-write UPDATE (SET v = v + 1 must evaluate against the
+        transaction's snapshot of each row)."""
         from yugabyte_tpu.common.hybrid_time import HybridTime
         schema = table.schema
         txn = txn or self._txn
         dk, filters = self._split_where(table, where)
-        if dk is not None and not filters:
-            return [dk]
         if dk is not None:
             row = (txn.read_row(table, dk) if txn
                    else self._client.read_row(table, dk))
             if row is None:
                 return []
             d = row.to_dict(schema)
-            return [dk] if row_matches(d, filters) else []
+            return [(dk, d)] if row_matches(d, filters) else []
         if txn is not None:
             rows = self._client.scan(table, read_ht=HybridTime(txn.read_ht),
                                      filters=filters or None,
                                      txn_id=txn.txn_id)
         else:
             rows = self._scan(table, filters)
-        return [row.doc_key for row in rows]
+        return [(row.doc_key, row.to_dict(schema)) for row in rows]
+
+    def _target_keys(self, table: YBTable,
+                     where: List[Tuple[str, str, object]], txn=None):
+        """Doc keys matching WHERE: point lookup for a full key, pushed-
+        down scan otherwise (PG semantics: UPDATE/DELETE take any WHERE).
+        With `txn`, reads pin that transaction's snapshot + overlay."""
+        dk, filters = self._split_where(table, where)
+        if dk is not None and not filters:
+            return [dk]  # blind-write fast path: no row read needed
+        return [k for k, _d in self._target_rows(table, where, txn)]
 
     def _resolve_dml_where(self, table_name: str, where):
         """Subquery support in UPDATE/DELETE predicates: resolve through
@@ -1514,6 +1533,53 @@ class PgSession:
             # a PK update is a row move (delete+insert); not supported
             raise PgError(Status.NotSupported(
                 f"cannot update primary key column(s) {bad}"), "0A000")
+        names = [c for c, _v in stmt.assignments]
+        if len(set(names)) != len(names):
+            dup = next(c for c in names if names.count(c) > 1)
+            raise PgError(Status.InvalidArgument(
+                f'multiple assignments to same column "{dup}"'), "42601")
+        exprs = {c: v[1] for c, v in stmt.assignments
+                 if isinstance(v, tuple) and len(v) == 2
+                 and v[0] == "__expr__"}
+        plain = {c: v for c, v in stmt.assignments
+                 if not (isinstance(v, tuple) and len(v) == 2
+                         and v[0] == "__expr__")}
+        if exprs:
+            # SET col = <expression over the row>: read-modify-write under
+            # the statement transaction (PG evaluates the RHS against the
+            # row's snapshot; a blind write would lose concurrent deltas)
+            fns = {}
+            for c, node in exprs.items():
+                t, fn = self._compile_row_expr(node, schema)
+                try:
+                    want = schema.column(c).type
+                except KeyError:
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{c}" does not exist'), "42703")
+                ok = (t is None or t == want
+                      or (want == DataType.DOUBLE
+                          and t in (DataType.INT64, DataType.INT32,
+                                    DataType.FLOAT)))
+                if not ok:
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{c}" is of type {want.name} but '
+                        f'expression is of type {t.name}'), "42804")
+                fns[c] = fn
+
+            def body(txn):
+                pairs = self._target_rows(table, stmt.where, txn)
+                for k, d in pairs:
+                    values = dict(plain)
+                    for c, fn in fns.items():
+                        values[c] = fn(d)
+                    IM.txn_write_with_indexes(
+                        txn, table, QLWriteOp(WriteOpKind.UPDATE, k,
+                                              values), self._table)
+                return len(pairs)
+
+            n = self._run_statement_txn(body)
+            return PgResult(f"UPDATE {n}")
+
         dk, filters = self._split_where(table, stmt.where)
         if (dk is not None and not filters and not table.indexes
                 and self._txn is None):

@@ -799,11 +799,29 @@ class PgParser(_BaseParser):
         return Update(name, assignments, self._pg_where())
 
     def _assigned_value(self):
+        """RHS of SET col = ...: a plain literal (the blind-write fast
+        path) or an expression over the row, tagged ("__expr__", node)
+        for the executor's read-modify-write path."""
         self.expect_op("=")
-        return self.literal()
+        node = self._arith_expr()
+        if node[0] == "lit":
+            return node[1]
+        return ("__expr__", node)
 
     def _delete(self) -> Delete:
         return Delete(self._table_name(), self._pg_where())
+
+
+def _sub_expr_node(node, sub):
+    """Substitute Params inside a row-expression tree (lit/col/func/op)."""
+    if node[0] == "lit":
+        return ("lit", sub(node[1]))
+    if node[0] == "func":
+        return ("func", node[1], [_sub_expr_node(a, sub) for a in node[2]])
+    if node[0] == "op":
+        return ("op", node[1], _sub_expr_node(node[2], sub),
+                _sub_expr_node(node[3], sub))
+    return node
 
 
 def bind_params(stmt: Statement, params: List[object]) -> Statement:
@@ -859,8 +877,12 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
                        having=[(i, op, sub(v))
                                for i, op, v in stmt.having])
     if isinstance(stmt, Update):
+        def sub_assign(v):
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "__expr__":
+                return ("__expr__", _sub_expr_node(v[1], sub))
+            return sub(v)
         return replace(stmt,
-                       assignments=[(c, sub(v))
+                       assignments=[(c, sub_assign(v))
                                     for c, v in stmt.assignments],
                        where=[(c, op, sub(v)) for c, op, v in stmt.where])
     if isinstance(stmt, Delete):
@@ -906,8 +928,20 @@ def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
         visit("__limit__", stmt.limit)
         visit("__limit__", stmt.offset)
     elif isinstance(stmt, Update):
+        def visit_expr(node, col):
+            if node[0] == "lit":
+                visit(col, node[1])
+            elif node[0] == "func":
+                for a in node[2]:
+                    visit_expr(a, col)
+            elif node[0] == "op":
+                visit_expr(node[2], col)
+                visit_expr(node[3], col)
         for c, v in stmt.assignments:
-            visit(c, v)
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "__expr__":
+                visit_expr(v[1], c)
+            else:
+                visit(c, v)
         for c, _op, v in stmt.where:
             visit(c, v)
     elif isinstance(stmt, Delete):
