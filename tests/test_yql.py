@@ -408,3 +408,39 @@ def test_cql_alter_table(cluster):
     # surfaces absent columns as nulls rather than erroring)
     rs = ql.execute("SELECT v FROM at")
     assert all(r == [None] for r in rs.rows)
+
+
+class TestCqlOrderBy:
+    def test_order_by_clustering(self, ql):
+        ql.execute("CREATE TABLE series (dev TEXT, ts BIGINT, v BIGINT, "
+                   "PRIMARY KEY ((dev), ts))")
+        for i in range(5):
+            ql.execute(f"INSERT INTO series (dev, ts, v) "
+                       f"VALUES ('d1', {i}, {i * 10})")
+        rs = ql.execute("SELECT ts FROM series WHERE dev = 'd1' "
+                        "ORDER BY ts ASC")
+        assert [r[0] for r in rs.rows] == [0, 1, 2, 3, 4]
+        rs = ql.execute("SELECT ts FROM series WHERE dev = 'd1' "
+                        "ORDER BY ts DESC")
+        assert [r[0] for r in rs.rows] == [4, 3, 2, 1, 0]
+        rs = ql.execute("SELECT ts FROM series WHERE dev = 'd1' "
+                        "ORDER BY ts DESC LIMIT 2")
+        assert [r[0] for r in rs.rows] == [4, 3]
+        # range predicate composes with the reversed order
+        rs = ql.execute("SELECT ts FROM series WHERE dev = 'd1' "
+                        "AND ts >= 1 AND ts <= 3 ORDER BY ts DESC")
+        assert [r[0] for r in rs.rows] == [3, 2, 1]
+
+    def test_order_by_requires_partition_key(self, ql):
+        from yugabyte_tpu.utils.status import StatusError
+        import pytest as _pytest
+        with _pytest.raises(StatusError, match="partition key"):
+            ql.execute("SELECT ts FROM series ORDER BY ts DESC")
+        # non-clustering column rejected even on a point lookup
+        with _pytest.raises(StatusError, match="clustering"):
+            ql.execute("SELECT v FROM series WHERE dev = 'd1' AND ts = 3 "
+                       "ORDER BY v DESC")
+        # IN on the clustering column with DESC takes the ordered path
+        rs = ql.execute("SELECT ts FROM series WHERE dev = 'd1' "
+                        "AND ts IN (1, 2, 3) ORDER BY ts DESC")
+        assert [r[0] for r in rs.rows] == [3, 2, 1]

@@ -480,8 +480,31 @@ class QLProcessor:
         hash_names = {c.name for c in schema.hash_columns}
         eq_cols = {c for c, op, _v in where if op == "="}
         range_order = [c.name for c in schema.range_columns]
+        # ORDER BY validation happens BEFORE any execution-path branch so
+        # rejection does not depend on the WHERE shape (CQL: partition
+        # key restricted, single direction, clustering-order prefix)
+        if stmt.order_by:
+            if not hash_names <= eq_cols and not any(
+                    op == "in" and c in hash_names for c, op, _v in where):
+                raise StatusError(Status.InvalidArgument(
+                    "ORDER BY is only supported when the partition key "
+                    "is restricted"))
+            dirs = {d for _c, d in stmt.order_by}
+            if len(dirs) > 1:
+                raise StatusError(Status.InvalidArgument(
+                    "ORDER BY must use a single direction over the "
+                    "clustering order"))
+            want = [c for c, _d in stmt.order_by]
+            if want != range_order[: len(want)]:
+                raise StatusError(Status.InvalidArgument(
+                    f"ORDER BY must follow the clustering key order "
+                    f"{range_order}"))
         for i, (c, op, v) in enumerate(where):
             if op == "in" and c in key_names:
+                if stmt.order_by:
+                    # ordered results: take the scan path (IN becomes a
+                    # residual filter) so the reversal logic applies once
+                    continue
                 # only worthwhile when every sub-select still reaches a
                 # key prefix — with the hash key unbound, N sub-selects
                 # would be N full scans where ONE scan with the IN as a
@@ -578,6 +601,29 @@ class QLProcessor:
                     start_lower=ps[0] if ps else b"",
                     scan_state=scan_state)
                 pageable = True
+        # ---- ORDER BY clustering columns (CQL: only with the partition
+        # key restricted; rows already stream in clustering ASC order, so
+        # ASC is a no-op and DESC materializes the partition and
+        # reverses — ref: sem analyzer order-by checks + reverse scans)
+        if stmt.order_by:
+            if {d for _c, d in stmt.order_by} == {True}:
+                # DESC: collect the partition's matching rows, reverse;
+                # no paging token (the resume cursor is ascending-only)
+                collected = []
+                for row in rows:
+                    d = row.to_dict(schema)
+                    if tuple(d[c.name] for c in schema.hash_columns) !=                             dk.hash_components:
+                        continue
+                    if not self._match(d, residual):
+                        continue
+                    collected.append((d, row))
+                collected.reverse()
+                budget = ps[3] if ps else stmt.limit
+                for d, row in collected:
+                    rs.rows.append([f(d, row) for f in item_fns])
+                    if budget is not None and len(rs.rows) >= budget:
+                        break
+                return rs
         # LIMIT budget spans pages: the token carries what is still owed
         remaining = ps[3] if ps else stmt.limit
         count = 0

@@ -132,6 +132,9 @@ class Select:
     columns: Optional[List[str]]              # None = *
     where: List[Tuple[str, str, object]] = field(default_factory=list)
     limit: Optional[int] = None
+    # ORDER BY clustering_col [ASC|DESC] — valid only with the partition
+    # key restricted (CQL semantics; ref: sem/analyzer order-by checks)
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)
 
 
 @dataclass
@@ -417,11 +420,21 @@ class Parser:
         self.expect_kw("FROM")
         ks, table = self.qualified_name()
         where = self._where() if self.accept_kw("WHERE") else []
+        order_by: List[Tuple[str, bool]] = []
+        if self.accept_kw("ORDER", "BY"):
+            while True:
+                col = self.name()
+                desc = bool(self.accept_kw("DESC"))
+                if not desc:
+                    self.accept_kw("ASC")
+                order_by.append((col, desc))
+                if not self.accept_op(","):
+                    break
         limit = None
         if self.accept_kw("LIMIT"):
             limit = int(self.literal())
         self.accept_kw("ALLOW", "FILTERING")
-        return Select(ks, table, cols, where, limit)
+        return Select(ks, table, cols, where, limit, order_by=order_by)
 
     def _where(self) -> List[Tuple[str, str, object]]:
         conds = []

@@ -1479,14 +1479,17 @@ class PgSession:
                                  filters=filters or None, txn_id=txn_id)
 
     def _target_rows(self, table: YBTable,
-                     where: List[Tuple[str, str, object]], txn=None):
+                     where: List[Tuple[str, str, object]], txn=None,
+                     split=None):
         """(doc_key, row_dict) pairs matching WHERE — the read half of a
         read-modify-write UPDATE (SET v = v + 1 must evaluate against the
-        transaction's snapshot of each row)."""
+        transaction's snapshot of each row). `split` short-circuits the
+        WHERE decomposition when the caller already did it."""
         from yugabyte_tpu.common.hybrid_time import HybridTime
         schema = table.schema
         txn = txn or self._txn
-        dk, filters = self._split_where(table, where)
+        dk, filters = split if split is not None \
+            else self._split_where(table, where)
         if dk is not None:
             row = (txn.read_row(table, dk) if txn
                    else self._client.read_row(table, dk))
@@ -1510,7 +1513,8 @@ class PgSession:
         dk, filters = self._split_where(table, where)
         if dk is not None and not filters:
             return [dk]  # blind-write fast path: no row read needed
-        return [k for k, _d in self._target_rows(table, where, txn)]
+        return [k for k, _d in self._target_rows(table, where, txn,
+                                                 split=(dk, filters))]
 
     def _resolve_dml_where(self, table_name: str, where):
         """Subquery support in UPDATE/DELETE predicates: resolve through

@@ -932,8 +932,10 @@ def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
             if node[0] == "lit":
                 visit(col, node[1])
             elif node[0] == "func":
+                # a builtin argument's type comes from the function
+                # signature, not the assigned column — leave it untyped
                 for a in node[2]:
-                    visit_expr(a, col)
+                    visit_expr(a, "__expr__")
             elif node[0] == "op":
                 visit_expr(node[2], col)
                 visit_expr(node[3], col)
