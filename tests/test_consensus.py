@@ -50,6 +50,15 @@ def _elect_with_retry(raft_like, name, timeout=30.0):
                 return
             time.sleep(0.005)
         window *= 2
+    # dump diagnostics so a CI flake is attributable: raft state plus
+    # every thread's stack (is the vote path starved, deadlocked, ...?)
+    import faulthandler
+    import sys
+    print(f"\n=== elect({name}) diagnostics: role={raft_like.role} "
+          f"term={raft_like._meta.term} leader={raft_like.leader_id} "
+          f"load={open('/proc/loadavg').read().strip()} ===",
+          file=sys.stderr, flush=True)
+    faulthandler.dump_traceback(file=sys.stderr)
     raise TimeoutError(f"timed out waiting for {name} leader")
 
 
