@@ -554,3 +554,43 @@ class TestRmwUpdate:
             t.join(timeout=120)
         assert not errors, errors
         assert rows(conn, "SELECT n FROM inc") == [("30",)]
+
+
+class TestMultiGroupBy:
+    def test_group_by_two_columns(self, conn):
+        conn.query("CREATE TABLE sales (k INT PRIMARY KEY, region TEXT, "
+                   "item TEXT, qty INT)")
+        conn.query("INSERT INTO sales (k, region, item, qty) VALUES "
+                   "(1,'eu','a',2),(2,'eu','a',3),(3,'eu','b',1),"
+                   "(4,'us','a',7),(5,'us','b',4),(6,'us','b',6)")
+        assert rows(conn, "SELECT region, item, SUM(qty) FROM sales "
+                          "GROUP BY region, item") == \
+            [("eu", "a", "5"), ("eu", "b", "1"),
+             ("us", "a", "7"), ("us", "b", "10")]
+        # HAVING over a multi-column group (agg + group-col predicates)
+        assert rows(conn, "SELECT region, item, COUNT(*) FROM sales "
+                          "GROUP BY region, item HAVING COUNT(*) > 1 "
+                          "AND region = 'us'") == [("us", "b", "2")]
+        # select list may be a subset/reorder of the group columns (PG)
+        assert rows(conn, "SELECT item, SUM(qty) FROM sales "
+                          "GROUP BY region, item HAVING region = 'eu'") == \
+            [("a", "5"), ("b", "1")]
+        assert rows(conn, "SELECT item, region, COUNT(*) FROM sales "
+                          "GROUP BY region, item HAVING region = 'eu'") == \
+            [("a", "eu", "2"), ("b", "eu", "1")]
+        # but a non-grouped column still errors
+        with pytest.raises(PgWireError):
+            conn.query("SELECT qty, COUNT(*) FROM sales "
+                       "GROUP BY region, item")
+
+    def test_group_subset_order_and_describe(self, conn):
+        # ORDER BY a grouping column the select list projects out (PG ok)
+        assert rows(conn, "SELECT item, SUM(qty) FROM sales "
+                          "GROUP BY region, item ORDER BY region DESC, "
+                          "item ASC LIMIT 2") == [("a", "7"), ("b", "10")]
+        # extended protocol: Describe row shape matches Execute
+        r = conn.extended_query("SELECT item, SUM(qty) FROM sales "
+                                "GROUP BY region, item "
+                                "HAVING region = $1", ["eu"])
+        assert [c[0] for c in r.columns] == ["item", "sum"]
+        assert [tuple(x) for x in r.rows] == [("a", "5"), ("b", "1")]

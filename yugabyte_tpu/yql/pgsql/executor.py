@@ -78,6 +78,40 @@ def _page_rows(rows_out, stmt):
     return rows_out
 
 
+def _group_cols(group_by):
+    """GROUP BY as a list: None -> [], str -> [c], tuple -> [c1, c2...]."""
+    if not group_by:
+        return []
+    return list(group_by) if isinstance(group_by, tuple) else [group_by]
+
+
+def _map_group_by(group_by, fn):
+    """Apply fn over each group column, preserving the None/str/tuple
+    shape contract."""
+    if not group_by:
+        return group_by
+    if isinstance(group_by, tuple):
+        return tuple(fn(c) for c in group_by)
+    return fn(group_by)
+
+
+def _project_group_output(stmt, col_desc, rows_out):
+    """Reorder/subset the aggregate output to the SELECT list (PG allows
+    any subset/order of the group columns; aggregates keep their
+    positions after them). Raises 42803 for non-grouped columns."""
+    gcols = _group_cols(stmt.group_by)
+    sel = stmt.columns
+    if not sel or list(sel) == gcols:
+        return col_desc, rows_out
+    if not set(sel) <= set(gcols):
+        raise PgError(Status.InvalidArgument(
+            "non-aggregated columns must appear in GROUP BY"), "42803")
+    idx = [gcols.index(c) for c in sel] \
+        + list(range(len(gcols), len(col_desc)))
+    return ([col_desc[i] for i in idx],
+            [[r[i] for i in idx] for r in rows_out])
+
+
 def _dedup_rows(rows_out):
     """First-occurrence dedup preserving order (SELECT DISTINCT applied
     after projection, like PG's unique node over the sorted/plain path)."""
@@ -281,6 +315,7 @@ class PgSession:
             if stmt.aggregates or stmt.group_by:
                 desc, _ = self._aggregate(stmt,
                                           lambda c: by_name.get(c, 25), [])
+                desc, _rows = _project_group_output(stmt, desc, [])
                 return desc
             out_cols = stmt.columns or [c for c, _o in cols]
             return [(c, by_name.get(c, 25)) for c in out_cols]
@@ -290,6 +325,7 @@ class PgSession:
         if stmt.aggregates or stmt.group_by:
             desc, _ = self._aggregate(
                 stmt, lambda c: PG_OIDS[schema.column(c).type], [])
+            desc, _rows = _project_group_output(stmt, desc, [])
             return desc
         out_cols = stmt.columns or [c.name for c in schema.columns
                                     if not c.dropped]
@@ -608,6 +644,10 @@ class PgSession:
         if stmt.aggregates or stmt.group_by:
             col_desc, rows_out = self._aggregate(
                 stmt, lambda c: by_name.get(c, 25), dicts)
+            rows_out = self._order_agg_rows(col_desc, rows_out,
+                                            stmt.order_by)
+            col_desc, rows_out = _project_group_output(stmt, col_desc,
+                                                       rows_out)
             rows_out = _page_rows(rows_out, stmt)
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         dicts = self._order_rows(dicts, stmt.order_by)
@@ -741,16 +781,17 @@ class PgSession:
             return 701 if (func == "SUM" and base == 701) else \
                 (20 if func == "SUM" else base)
 
-        group_col = stmt.group_by
+        group_cols = _group_cols(stmt.group_by)
         groups: Dict[object, List[dict]] = {}
         for d in dicts:
-            groups.setdefault(d.get(group_col) if group_col else None,
-                              []).append(d)
-        if not dicts and group_col is None:
+            key = tuple(d.get(c) for c in group_cols) if group_cols \
+                else None
+            groups.setdefault(key, []).append(d)
+        if not dicts and not group_cols:
             groups[None] = []
         col_desc: List[Tuple[str, int]] = []
-        if group_col is not None:
-            col_desc.append((group_col, col_oid(group_col)))
+        for c in group_cols:
+            col_desc.append((c, col_oid(c)))
         for func, col in stmt.aggregates:
             col_desc.append((self._AGG_OUT_NAMES[func.split()[0]],
                              agg_oid(func, col)))
@@ -775,7 +816,14 @@ class PgSession:
 
         from yugabyte_tpu.common.wire import FILTER_OPS
         rows_out = []
-        for key in sorted(groups, key=lambda k: (k is None, k)):
+        def _gk(k):
+            if k is None:
+                return (1,)
+            if isinstance(k, tuple):
+                return (0,) + tuple((v is None, 0 if v is None else v)
+                                    for v in k)
+            return (0, k)
+        for key in sorted(groups, key=_gk):
             members = groups[key]
             # HAVING gates the group BEFORE projection (ref: PG executor
             # nodeAgg qual evaluation); having-only aggregates are
@@ -785,18 +833,18 @@ class PgSession:
                 if item[0] == "agg":
                     got = agg_value(item[1], item[2], members)
                 else:
-                    if group_col is None or item[1] != group_col:
+                    if item[1] not in group_cols:
                         raise PgError(Status.InvalidArgument(
                             f'column "{item[1]}" must appear in GROUP BY '
                             f'or be used in an aggregate function'),
                             "42803")
-                    got = key
+                    got = key[group_cols.index(item[1])]
                 if got is None or not FILTER_OPS[op](got, want):
                     ok = False
                     break
             if not ok:
                 continue
-            row: List[object] = [key] if group_col is not None else []
+            row: List[object] = list(key) if group_cols else []
             for func, col in stmt.aggregates:
                 row.append(agg_value(func, col, members))
             rows_out.append(row)
@@ -1002,7 +1050,7 @@ class PgSession:
 
             agg_stmt = _replace(
                 stmt,
-                group_by=qual(stmt.group_by) if stmt.group_by else None,
+                group_by=_map_group_by(stmt.group_by, qual),
                 aggregates=[(f, qual(c) if c else None)
                             for f, c in stmt.aggregates],
                 having=[(qual_having(i), op, v)
@@ -1010,22 +1058,20 @@ class PgSession:
                 columns=[qual(c) for c in stmt.columns]
                 if stmt.columns else None)
 
-            if agg_stmt.columns and (len(agg_stmt.columns) != 1
-                                     or agg_stmt.columns[0]
-                                     != agg_stmt.group_by):
-                raise PgError(Status.InvalidArgument(
-                    "non-aggregated columns must appear in GROUP BY"),
-                    "42803")
+
 
             def col_oid(qc):
                 a, c = qc.split(".", 1)
                 return PG_OIDS[by_alias[a].schema.column(c).type]
 
             col_desc, rows_out = self._aggregate(agg_stmt, col_oid, rows)
+            rows_out = self._order_agg_rows(
+                [(n.split(".")[-1], o) for n, o in col_desc], rows_out,
+                stmt.order_by)
+            col_desc, rows_out = _project_group_output(agg_stmt, col_desc,
+                                                       rows_out)
             # label group columns by their bare name, like PG
             col_desc = [(n.split(".")[-1], o) for n, o in col_desc]
-            rows_out = self._order_agg_rows(col_desc, rows_out,
-                                            stmt.order_by)
             rows_out = _page_rows(rows_out, stmt)
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         if stmt.scalar_items:
@@ -1082,7 +1128,7 @@ class PgSession:
                       for br in stmt.or_where],
             order_by=[(fix(c), d) for c, d in stmt.order_by],
             scalar_items=[fix_item(i) for i in stmt.scalar_items],
-            group_by=fix(stmt.group_by) if stmt.group_by else None,
+            group_by=_map_group_by(stmt.group_by, fix),
             aggregates=[(f, fix(c) if c else c)
                         for f, c in stmt.aggregates],
             having=[(fix_having(i), op, v) for i, op, v in stmt.having])
@@ -1239,15 +1285,15 @@ class PgSession:
             out = _page_rows([[len(dicts)]], stmt)
             return PgResult(f"SELECT {len(out)}", [("count", 20)], out)
         if stmt.aggregates or stmt.group_by:
-            if stmt.columns and (len(stmt.columns) != 1
-                                 or stmt.columns[0] != stmt.group_by):
-                raise PgError(Status.InvalidArgument(
-                    "non-aggregated columns must appear in GROUP BY"),
-                    "42803")
             col_desc, rows_out = self._aggregate(
                 stmt, lambda c: PG_OIDS[schema.column(c).type], dicts)
+            # order over the FULL group output (PG permits ORDER BY any
+            # grouping column, even one the SELECT list projects out),
+            # THEN project to the select list
             rows_out = self._order_agg_rows(col_desc, rows_out,
                                             stmt.order_by)
+            col_desc, rows_out = _project_group_output(stmt, col_desc,
+                                                       rows_out)
             rows_out = _page_rows(rows_out, stmt)
             return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         dicts = self._order_rows(dicts, stmt.order_by)
@@ -1342,7 +1388,7 @@ class PgSession:
             + [f[0] for f in stmt.where if f[0]] \
             + [f[0] for br in stmt.or_where for f in br if f[0]] \
             + [c for c, _d in stmt.order_by] \
-            + ([stmt.group_by] if stmt.group_by else []) \
+            + _group_cols(stmt.group_by) \
             + [c for _f, c in stmt.aggregates if c is not None] \
             + [i[1] for i, _o, _v in stmt.having if i[0] == "col"] \
             + [i[2] for i, _o, _v in stmt.having

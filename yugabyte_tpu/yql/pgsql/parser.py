@@ -598,7 +598,12 @@ class PgParser(_BaseParser):
         where, or_where = self._pg_where_full()
         group_by = None
         if self.accept_kw("GROUP", "BY"):
-            group_by = self._col_ref()
+            cols_gb = [self._col_ref()]
+            while self.accept_op(","):
+                cols_gb.append(self._col_ref())
+            # a single column stays a string (the historical shape every
+            # consumer handles); multiple columns ride as a tuple
+            group_by = cols_gb[0] if len(cols_gb) == 1 else tuple(cols_gb)
         having: List[Tuple[tuple, str, object]] = []
         if self.accept_kw("HAVING"):
             while True:
