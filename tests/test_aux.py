@@ -140,10 +140,19 @@ def test_delete_snapshot(cluster, table):
     master.catalog.delete_snapshot(sid)
     assert not any(s["snapshot_id"] == sid
                    for s in master.catalog.list_snapshots())
-    for ts in cluster.tservers:
-        for tid in ts.tablet_manager.tablet_ids():
-            peer = ts.tablet_manager.get_tablet(tid)
-            assert sid not in peer.tablet.list_snapshots()
+    # tserver-side deletion propagates asynchronously: poll, don't race
+    import time as _time
+    deadline = _time.monotonic() + 20
+
+    def _gone():
+        return all(sid not in ts.tablet_manager.get_tablet(tid)
+                   .tablet.list_snapshots()
+                   for ts in cluster.tservers
+                   for tid in ts.tablet_manager.tablet_ids())
+    while not _gone():
+        assert _time.monotonic() < deadline, (
+            f"snapshot {sid} still present on a tserver after 20s")
+        _time.sleep(0.1)
 
 
 def test_yugabyted_single_node(tmp_path):
