@@ -570,12 +570,12 @@ def _scan_point_stages(n_rows: int) -> dict:
         t0 = time.time()
         per_flush = n // 4
         for f in range(4):
-            items = []
             base = f * per_flush
-            for i in range(per_flush):
-                key = b"Suser%08d\x00\x00!" % (base + i)
-                items.append((key, DocHybridTime(
-                    HybridTime.from_micros(1000 + base + i), 0), value))
+            items = [(b"Suser%08d\x00\x00!" % (base + i),
+                      DocHybridTime(
+                          HybridTime.from_micros(1000 + base + i), 0),
+                      value)
+                     for i in range(per_flush)]
             db.write_batch(items, op_id=(1, f + 1))
             db.flush()
         load_s = time.time() - t0

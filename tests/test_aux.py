@@ -141,8 +141,7 @@ def test_delete_snapshot(cluster, table):
     assert not any(s["snapshot_id"] == sid
                    for s in master.catalog.list_snapshots())
     # tserver-side deletion propagates asynchronously: poll, don't race
-    import time as _time
-    deadline = _time.monotonic() + 20
+    deadline = time.monotonic() + 20
 
     def _gone():
         return all(sid not in ts.tablet_manager.get_tablet(tid)
@@ -150,9 +149,9 @@ def test_delete_snapshot(cluster, table):
                    for ts in cluster.tservers
                    for tid in ts.tablet_manager.tablet_ids())
     while not _gone():
-        assert _time.monotonic() < deadline, (
+        assert time.monotonic() < deadline, (
             f"snapshot {sid} still present on a tserver after 20s")
-        _time.sleep(0.1)
+        time.sleep(0.1)
 
 
 def test_yugabyted_single_node(tmp_path):

@@ -146,3 +146,17 @@ def test_server_context_loads_policy(tmp_path, monkeypatch):
             ctx.shutdown()
     finally:
         flags.set_flag("offload_calibration_path", "")
+
+
+def test_recalibration_supersedes_stale_records(tmp_path):
+    """A re-measured (n_rows, cached) class must WIN over the old line in
+    the file — the nearest-size tie-break must never resurrect a stale
+    measurement (the whole point of appending new calibration)."""
+    path = str(tmp_path / "cal.json")
+    OffloadPolicy.append_calibration(path, 1 << 18, True, 1e5, 1e6, "cpu")
+    p = OffloadPolicy.load(platform="cpu", path=path)
+    assert not p.use_device(1 << 18, cached=True)   # device loses
+    OffloadPolicy.append_calibration(path, 1 << 18, True, 5e6, 1e6, "cpu")
+    p2 = OffloadPolicy.load(platform="cpu", path=path)
+    assert p2.use_device(1 << 18, cached=True)      # new record wins
+    assert len(p2.points) == 1                      # deduped on load

@@ -88,7 +88,13 @@ class OffloadPolicy:
                         continue
         except OSError:
             pass
-        return cls(points, platform)
+        # keep only the LATEST record per (n_rows, cached, platform):
+        # re-calibration must supersede stale measurements, not lose the
+        # nearest-size tie-break to the oldest line in the file
+        latest = {}
+        for p in points:
+            latest[(p.n_rows, p.cached, p.platform)] = p
+        return cls(list(latest.values()), platform)
 
     def _applicable(self, cached: bool) -> List[CalibrationPoint]:
         """Only SAME-platform measurements count: a CPU-JAX number must
