@@ -60,6 +60,15 @@ def test_leader_change_during_in_flight_write(tmp_path):
 
         sync_point.arm("raft.replicate:after_local_append", pause_once)
         result = {}
+        # Partition ts0 BEFORE issuing the racing write: ts0's per-peer
+        # heartbeat loops wake on their own 15ms timer, and in the window
+        # between the sync-point pause and a post-write partition they
+        # could replicate the in-flight entry to ts2 but not ts1 — after
+        # which ts2's longer log denies ts1's votes FOREVER (the
+        # historical flake). With the partition first, the entry is
+        # deterministically appended-but-unreplicated.
+        h.transport.partition("ts0", "ts1")
+        h.transport.partition("ts0", "ts2")
 
         def racing_write():
             try:
@@ -74,8 +83,6 @@ def test_leader_change_during_in_flight_write(tmp_path):
         assert paused.wait(5), "write never reached the sync point"
         # while ts0's write sits appended-but-unreplicated, move the
         # leadership; the new leader's no-op enters at the same index
-        h.transport.partition("ts0", "ts1")
-        h.transport.partition("ts0", "ts2")
         # the paused leader may hold a just-granted vote from a quorum
         # peer; retry the election rather than flaking on that window
         for attempt in range(5):
