@@ -137,37 +137,51 @@ def _shift_left(buf, amt, max_shift: int):
     return buf
 
 
-def _make_tile_kernel(L: int, tile: int, tpp: int, rp: int,
+def _make_tile_kernel(L: int, tile: int, tpp: int, rp: int, n: int,
                       cmp_rows: Tuple[int, ...], idx_row: int):
-    """Kernel body for one tournament level (closure over static config)."""
+    """Kernel body for one tournament level (closure over static config).
+
+    The B window is loaded from a globally lane-REVERSED copy of the
+    payload matrix (flipped outside the kernel — Mosaic has no lowering
+    for the `rev` primitive, so `wb[:, ::-1]` inside the kernel fails on
+    real TPU).  In reversed coordinates the window is a contiguous
+    ascending slice whose keys run descending, which is exactly the
+    bitonic layout the halving network needs.
+    """
     c = len(cmp_rows)
     nblk = L // tile
+    nb_total = n // tile
     inv_consts = [_inv_word(r) for r in cmp_rows]
 
-    def kernel(sa_ref, a_lo, a_hi, b_lo, b_hi, out_ref):
+    def kernel(sa_ref, a_lo, a_hi, br_lo, br_hi, out_ref):
+        p = pl.program_id(0)
         t = pl.program_id(1)
-        base = pl.program_id(0) * (tpp + 1)
+        base = p * (tpp + 1)
         a0 = sa_ref[base + t]
         a1 = sa_ref[base + t + 1]
         la = a1 - a0
-        b0 = t * tile - a0
         da = a0 - jnp.minimum(a0 // tile, nblk - 1) * tile
-        db = b0 - jnp.minimum(b0 // tile, nblk - 1) * tile
+        # reversed-matrix start of the B window (see _rev_b0); may be
+        # negative near the array end — the roll-based shift wraps and the
+        # affected lanes are always masked
+        rb0 = n - tile - p * 2 * L - L - t * tile + a0
+        blk_lo = jnp.clip(rb0 // tile, 0, nb_total - 1)
+        dr = (rb0 - blk_lo * tile) & (2 * tile - 1)
 
-        def window(lo_ref, hi_ref, shift, length):
+        def window(lo_ref, hi_ref, shift, max_shift, valid_mask):
             buf = jnp.concatenate([lo_ref[:], hi_ref[:]], axis=1)
-            buf = _shift_left(buf, shift, tile)[:, :tile]
-            lane = jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
-            valid = lane < length
-            keys = [jnp.where(valid[0], buf[r] ^ jnp.uint32(iv), _U32_MAX)
+            buf = _shift_left(buf, shift, max_shift)[:, :tile]
+            keys = [jnp.where(valid_mask, buf[r] ^ jnp.uint32(iv), _U32_MAX)
                     for r, iv in zip(cmp_rows, inv_consts)]
-            keys.append(jnp.where(valid[0], buf[idx_row], _U32_MAX))
+            keys.append(jnp.where(valid_mask, buf[idx_row], _U32_MAX))
             return jnp.concatenate(
                 [jnp.stack(keys, axis=0), buf], axis=0)   # [c+1+rp, tile]
 
-        wa = window(a_lo, a_hi, da, la)
-        wb = window(b_lo, b_hi, db, tile - la)
-        z = jnp.concatenate([wa, wb[:, ::-1]], axis=1)    # bitonic [., 2t]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)[0]
+        wa = window(a_lo, a_hi, da, tile, lane < la)
+        # valid B lanes are the LAST tile-la: reversed window keys descend
+        wb = window(br_lo, br_hi, dr, 2 * tile, lane >= la)
+        z = jnp.concatenate([wa, wb], axis=1)             # bitonic [., 2t]
         lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * tile), 1)[0]
         s = tile
         while s >= 1:
@@ -196,6 +210,14 @@ def _merge_level(p_mat, L: int, tile: int, cmp_rows: Tuple[int, ...],
     s_t = (p_mat[jnp.asarray(cmp_rows, jnp.int32), :]
            ^ inv_vec[:, None]).T                     # [n, c]
     sa = _compute_splits(s_t, L, tile, n_pairs, tpp, c)
+    # Mosaic cannot lower `rev`, so the B windows load from a lane-flipped
+    # copy produced here in XLA (one extra HBM pass per level)
+    p_rev = jnp.flip(p_mat, axis=1)
+    nb_total = n // tile
+
+    def _rev_b0(p, t, sa_ref):
+        a0 = sa_ref[p * (tpp + 1) + t]
+        return n - tile - p * 2 * L - L - t * tile + a0
 
     def ima_lo(p, t, sa_ref):
         a0 = sa_ref[p * (tpp + 1) + t]
@@ -205,36 +227,32 @@ def _merge_level(p_mat, L: int, tile: int, cmp_rows: Tuple[int, ...],
         a0 = sa_ref[p * (tpp + 1) + t]
         return (0, p * 2 * nblk + jnp.minimum(a0 // tile + 1, nblk - 1))
 
-    def imb_lo(p, t, sa_ref):
-        b0 = t * tile - sa_ref[p * (tpp + 1) + t]
-        return (0, p * 2 * nblk + nblk + jnp.minimum(b0 // tile, nblk - 1))
+    def imbr_lo(p, t, sa_ref):
+        return (0, jnp.clip(_rev_b0(p, t, sa_ref) // tile, 0, nb_total - 1))
 
-    def imb_hi(p, t, sa_ref):
-        b0 = t * tile - sa_ref[p * (tpp + 1) + t]
-        return (0, p * 2 * nblk + nblk
-                + jnp.minimum(b0 // tile + 1, nblk - 1))
+    def imbr_hi(p, t, sa_ref):
+        return (0, jnp.clip(_rev_b0(p, t, sa_ref) // tile + 1,
+                            0, nb_total - 1))
 
     def imo(p, t, sa_ref):
         return (0, p * 2 * nblk + t)
 
-    kernel = _make_tile_kernel(L, tile, tpp, rp, cmp_rows, idx_row)
-    block = pl.BlockSpec((rp, tile))
+    kernel = _make_tile_kernel(L, tile, tpp, rp, n, cmp_rows, idx_row)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_pairs, tpp),
         in_specs=[pl.BlockSpec((rp, tile), ima_lo),
                   pl.BlockSpec((rp, tile), ima_hi),
-                  pl.BlockSpec((rp, tile), imb_lo),
-                  pl.BlockSpec((rp, tile), imb_hi)],
+                  pl.BlockSpec((rp, tile), imbr_lo),
+                  pl.BlockSpec((rp, tile), imbr_hi)],
         out_specs=pl.BlockSpec((rp, tile), imo),
     )
-    del block
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rp, n), jnp.uint32),
         interpret=interpret,
-    )(sa, p_mat, p_mat, p_mat, p_mat)
+    )(sa, p_mat, p_mat, p_rev, p_rev)
 
 
 @functools.partial(jax.jit, static_argnames=(
