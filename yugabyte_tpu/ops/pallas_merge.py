@@ -69,12 +69,16 @@ def _inv_word(row: int) -> int:
 
 
 def _lex_gt_rows(a, b, n_rows: int):
-    """Strict lexicographic > over the leading axis (row-major keys)."""
-    gt = jnp.zeros(a.shape[1:], dtype=bool)
-    eq = jnp.ones(a.shape[1:], dtype=bool)
+    """Strict lexicographic > over the leading axis (row-major keys).
+
+    Operates on [1, n] row slices, never 1-D vectors: Mosaic cannot lower
+    wide 1-D i1 vectors (arith.trunci vector<Nxi8> -> vector<Nxi1>), so
+    every mask stays 2-D.  Returns [1, n] bool."""
+    gt = jnp.zeros((1,) + a.shape[1:], dtype=bool)
+    eq = jnp.ones((1,) + a.shape[1:], dtype=bool)
     for i in range(n_rows):
-        gt = gt | (eq & (a[i] > b[i]))
-        eq = eq & (a[i] == b[i])
+        gt = gt | (eq & (a[i:i + 1] > b[i:i + 1]))
+        eq = eq & (a[i:i + 1] == b[i:i + 1])
     return gt
 
 
@@ -126,6 +130,23 @@ def _compute_splits(s_t, L: int, tile: int, n_pairs: int, tpp: int, c: int):
     return jnp.concatenate([zeros, lo, full], axis=1).reshape(-1)
 
 
+def _rev_window_start(p, t, a0, L: int, tile: int, n: int):
+    """Start of the B window in REVERSED-matrix coordinates.
+
+    Shared by the kernel body (to derive the in-tile shift) and the
+    BlockSpec index maps (to prefetch the covering blocks) — the two MUST
+    agree exactly or the kernel shifts against the wrong blocks.  May be
+    negative near the array end (wrapped lanes are always masked).
+    """
+    return n - tile - p * 2 * L - L - t * tile + a0
+
+
+def _rev_block_lo(p, t, a0, L: int, tile: int, n: int):
+    """Block index of the low prefetched block for the reversed B window."""
+    rb0 = _rev_window_start(p, t, a0, L, tile, n)
+    return jnp.clip(rb0 // tile, 0, n // tile - 1)
+
+
 def _shift_left(buf, amt, max_shift: int):
     """buf[:, i] <- buf[:, i + amt] for dynamic amt in [0, max_shift):
     log-decomposed static rolls (guaranteed Mosaic lowering; a dynamic
@@ -150,7 +171,6 @@ def _make_tile_kernel(L: int, tile: int, tpp: int, rp: int, n: int,
     """
     c = len(cmp_rows)
     nblk = L // tile
-    nb_total = n // tile
     inv_consts = [_inv_word(r) for r in cmp_rows]
 
     def kernel(sa_ref, a_lo, a_hi, br_lo, br_hi, out_ref):
@@ -161,36 +181,38 @@ def _make_tile_kernel(L: int, tile: int, tpp: int, rp: int, n: int,
         a1 = sa_ref[base + t + 1]
         la = a1 - a0
         da = a0 - jnp.minimum(a0 // tile, nblk - 1) * tile
-        # reversed-matrix start of the B window (see _rev_b0); may be
-        # negative near the array end — the roll-based shift wraps and the
-        # affected lanes are always masked
-        rb0 = n - tile - p * 2 * L - L - t * tile + a0
-        blk_lo = jnp.clip(rb0 // tile, 0, nb_total - 1)
+        rb0 = _rev_window_start(p, t, a0, L, tile, n)
+        blk_lo = _rev_block_lo(p, t, a0, L, tile, n)
         dr = (rb0 - blk_lo * tile) & (2 * tile - 1)
 
         def window(lo_ref, hi_ref, shift, max_shift, valid_mask):
+            # valid_mask is [1, tile]; all mask math stays 2-D for Mosaic
             buf = jnp.concatenate([lo_ref[:], hi_ref[:]], axis=1)
             buf = _shift_left(buf, shift, max_shift)[:, :tile]
-            keys = [jnp.where(valid_mask, buf[r] ^ jnp.uint32(iv), _U32_MAX)
+            keys = [jnp.where(valid_mask, buf[r:r + 1] ^ jnp.uint32(iv),
+                              _U32_MAX)
                     for r, iv in zip(cmp_rows, inv_consts)]
-            keys.append(jnp.where(valid_mask, buf[idx_row], _U32_MAX))
-            return jnp.concatenate(
-                [jnp.stack(keys, axis=0), buf], axis=0)   # [c+1+rp, tile]
+            keys.append(jnp.where(valid_mask, buf[idx_row:idx_row + 1],
+                                  _U32_MAX))
+            return jnp.concatenate(keys + [buf], axis=0)  # [c+1+rp, tile]
 
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)[0]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
         wa = window(a_lo, a_hi, da, tile, lane < la)
         # valid B lanes are the LAST tile-la: reversed window keys descend
         wb = window(br_lo, br_hi, dr, 2 * tile, lane >= la)
         z = jnp.concatenate([wa, wb], axis=1)             # bitonic [., 2t]
-        lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * tile), 1)[0]
+        lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * tile), 1)
         s = tile
         while s >= 1:
-            hi_half = (lane2 & s) != 0
-            partner = jnp.where(hi_half[None], jnp.roll(z, s, axis=1),
+            hi_half = (lane2 & s) != 0                    # [1, 2t]
+            partner = jnp.where(hi_half, jnp.roll(z, s, axis=1),
                                 jnp.roll(z, -s, axis=1))
             gt = _lex_gt_rows(z[:c + 1], partner[:c + 1], c + 1)
-            take = jnp.where(hi_half, ~gt, gt)
-            z = jnp.where(take[None], partner, z)
+            # hi_half XOR gt == where(hi_half, ~gt, gt) but stays an i1
+            # predicate: a select with BOOL OPERANDS materializes i8 bools
+            # and Mosaic cannot truncate i8 vectors back to i1
+            take = hi_half ^ gt                           # [1, 2t]
+            z = jnp.where(take, partner, z)
             s //= 2
         out_ref[:] = z[c + 1:, :tile]
 
@@ -215,10 +237,6 @@ def _merge_level(p_mat, L: int, tile: int, cmp_rows: Tuple[int, ...],
     p_rev = jnp.flip(p_mat, axis=1)
     nb_total = n // tile
 
-    def _rev_b0(p, t, sa_ref):
-        a0 = sa_ref[p * (tpp + 1) + t]
-        return n - tile - p * 2 * L - L - t * tile + a0
-
     def ima_lo(p, t, sa_ref):
         a0 = sa_ref[p * (tpp + 1) + t]
         return (0, p * 2 * nblk + jnp.minimum(a0 // tile, nblk - 1))
@@ -228,11 +246,13 @@ def _merge_level(p_mat, L: int, tile: int, cmp_rows: Tuple[int, ...],
         return (0, p * 2 * nblk + jnp.minimum(a0 // tile + 1, nblk - 1))
 
     def imbr_lo(p, t, sa_ref):
-        return (0, jnp.clip(_rev_b0(p, t, sa_ref) // tile, 0, nb_total - 1))
+        a0 = sa_ref[p * (tpp + 1) + t]
+        return (0, _rev_block_lo(p, t, a0, L, tile, n))
 
     def imbr_hi(p, t, sa_ref):
-        return (0, jnp.clip(_rev_b0(p, t, sa_ref) // tile + 1,
-                            0, nb_total - 1))
+        a0 = sa_ref[p * (tpp + 1) + t]
+        return (0, jnp.minimum(_rev_block_lo(p, t, a0, L, tile, n) + 1,
+                               nb_total - 1))
 
     def imo(p, t, sa_ref):
         return (0, p * 2 * nblk + t)
