@@ -430,14 +430,47 @@ def gather_staged_outputs(handle: MergeGCHandle,
     return outs
 
 
+_probe_winners = None  # lazy: {log2(n): "pallas"|"network"} from PROBE_TPU
+
+
+def _load_probe_winners() -> dict:
+    """Measured per-shape impl winners from tools/probe_kernel.py's
+    artifact (real-TPU sustained rates).  The probe showed neither impl
+    dominates across shapes, so auto routes by the nearest measured size
+    instead of by architecture faith."""
+    global _probe_winners
+    if _probe_winners is not None:
+        return _probe_winners
+    _probe_winners = {}
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "PROBE_TPU.json")
+    try:
+        import json as _json
+        with open(path) as f:
+            d = _json.load(f)
+        if d.get("platform") == "tpu":
+            for k, v in d.items():
+                if k.endswith("_pallas_rows_per_sec"):
+                    lg = int(k[1:].split("_")[0])
+                    net = d.get(f"n{lg}_network_rows_per_sec")
+                    if net:
+                        _probe_winners[lg] = \
+                            "pallas" if v > net else "network"
+    except (OSError, ValueError, KeyError):
+        pass
+    return _probe_winners
+
+
 def _pick_impl(staged: StagedRuns) -> str:
     """Merge strategy: YBTPU_MERGE_IMPL = auto|pallas|network.
 
-    auto: the pallas merge-path tournament (ops/pallas_merge.py) on TPU
-    backends where its preconditions hold — it replaces ~log^2 full-array
-    compare-exchange stages + a giant lane gather with log2(K) streaming
-    level passes; the jnp network elsewhere (pallas interpret mode is far
-    too slow for the production CPU fallback path).
+    auto on TPU: the winner measured by the real-hardware probe at the
+    nearest shape (PROBE_TPU.json), defaulting to the pallas merge-path
+    tournament (ops/pallas_merge.py) when unprobed — it replaces ~log^2
+    full-array compare-exchange stages + a giant lane gather with log2(K)
+    streaming level passes.  The jnp network on every other backend
+    (pallas interpret mode is far too slow for the production CPU
+    fallback path).
     """
     impl = os.environ.get("YBTPU_MERGE_IMPL", "auto")
     if impl == "network" or staged.k_pad < 2:
@@ -454,7 +487,14 @@ def _pick_impl(staged: StagedRuns) -> str:
     if impl == "pallas":
         return "pallas"
     import jax as _jax
-    return "pallas" if _jax.default_backend() == "tpu" else "network"
+    if _jax.default_backend() != "tpu":
+        return "network"
+    winners = _load_probe_winners()
+    if winners:
+        lg = max(1, staged.n_pad).bit_length() - 1
+        nearest = min(winners, key=lambda w: abs(w - lg))
+        return winners[nearest]
+    return "pallas"
 
 
 _pallas_broken = False  # set on the first Mosaic lowering/runtime failure
