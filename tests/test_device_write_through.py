@@ -251,3 +251,49 @@ def test_production_db_routes_to_combined_path(tmp_path, monkeypatch):
     db2.compact_all()
     assert not calls, "deep inputs must not take the depth-2 device path"
     db2.close()
+
+
+def test_chunked_write_through_matches_host(tmp_path, monkeypatch):
+    """Chunked subcompactions must still stage outputs into the HBM cache
+    (to_parent_products rebuilds the parent-domain arrays): entries match
+    a host restage of the written files byte-for-byte."""
+    from yugabyte_tpu.ops import run_merge
+
+    rng = np.random.default_rng(15)
+    runs = [_mk_run(rng, 2000, 8000) for _ in range(2)]
+    readers = _write_runs(str(tmp_path), runs)
+    cache = DeviceSlabCache(device=_device())
+    ids = [0, 1]
+    for fid, r in zip(ids, readers):
+        cache.stage(fid, r.read_all())
+
+    chunked_calls = {"n": 0}
+    real = run_merge._launch_chunked
+
+    def spy(*a, **k):
+        h = real(*a, **k)
+        if h is not None:
+            chunked_calls["n"] += 1
+        return h
+
+    monkeypatch.setattr(run_merge, "_launch_chunked", spy)
+    monkeypatch.setenv("YBTPU_MERGE_CHUNK_ROWS", "2048")
+    res = _run_device_native(readers, str(tmp_path / "out"), CUTOFF,
+                             cache, ids)
+    assert chunked_calls["n"] == 1, "chunked path did not engage"
+    assert res.outputs, "no outputs written"
+    for fid, base_path, props in res.outputs:
+        dev_staged = cache.get(fid)
+        assert dev_staged is not None, "write-through skipped"
+        rdr = SSTReader(base_path)
+        host_staged = stage_slab(rdr.read_all())
+        rdr.close()
+        assert dev_staged.n == host_staged.n == props.n_entries
+        dev_cols = np.asarray(dev_staged.cols_dev)
+        host_cols = np.asarray(host_staged.cols_dev)
+        r_common = min(dev_cols.shape[0], host_cols.shape[0])
+        np.testing.assert_array_equal(
+            dev_cols[:r_common, :host_staged.n],
+            host_cols[:r_common, :host_staged.n])
+    for r in readers:
+        r.close()

@@ -56,7 +56,10 @@ def test_leader_change_during_in_flight_write(tmp_path):
         def pause_once():
             sync_point.disarm("raft.replicate:after_local_append")
             paused.set()
-            release.wait(timeout=10)
+            # generous: under full-suite CPU load the elections below can
+            # take tens of seconds; an early return here would release the
+            # paused write MID-election and change the interleaving
+            release.wait(timeout=120)
 
         sync_point.arm("raft.replicate:after_local_append", pause_once)
         result = {}
@@ -80,27 +83,27 @@ def test_leader_change_during_in_flight_write(tmp_path):
 
         t = threading.Thread(target=racing_write)
         t.start()
-        assert paused.wait(5), "write never reached the sync point"
+        assert paused.wait(30), "write never reached the sync point"
         # while ts0's write sits appended-but-unreplicated, move the
         # leadership; the new leader's no-op enters at the same index
         # the paused leader may hold a just-granted vote from a quorum
         # peer; retry the election rather than flaking on that window
-        for attempt in range(5):
+        for attempt in range(8):
             try:
                 h.elect("ts1")
                 break
             except TimeoutError:
-                if attempt == 4:
+                if attempt == 7:
                     raise
         h.peers["ts1"].write([write_op(h.schema, "after", 7)])
         h.transport.heal()
         release.set()
-        t.join(timeout=15)
+        t.join(timeout=60)
         assert not t.is_alive(), "in-flight write never resolved"
 
         # old leader rejoins as follower; logs converge on ts1's history
         wait_for(lambda: not h.peers["ts0"].raft.is_leader(),
-                 msg="old leader stepped down")
+                 timeout=60.0, msg="old leader stepped down")
         if "err" in result:
             # aborted: the row must exist NOWHERE once logs converge
             def gone():

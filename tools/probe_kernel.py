@@ -131,14 +131,23 @@ def _probe(args):
     dev = jax.devices()[0]
     platform = dev.platform
     state["backend_init_s"] = round(time.time() - t0, 1)
-    state["device"] = str(dev)
-    state["platform"] = platform
-    save()
     if platform != "tpu" and not args.allow_cpu:
+        # Do NOT stamp platform/device: overwriting a TPU artifact's
+        # platform with "cpu" would disable probe-driven routing
+        # (run_merge._load_probe_winners gates on it) even though every
+        # preserved datapoint is still a TPU measurement
         state["skipped"] = "no TPU backend (platform=%s)" % platform
         save()
         print(json.dumps(state))
         return 1
+    state["device"] = str(dev)
+    state["platform"] = platform
+    save()
+    # like-for-like impl comparison: the chunked-subcompaction wrapper
+    # would otherwise engage for the network timing at large shapes while
+    # the direct pallas call stays monolithic, contaminating the winner
+    # data that drives production routing
+    os.environ["YBTPU_MERGE_CHUNK_ROWS"] = "0"
 
     import numpy as np  # noqa: F401
 

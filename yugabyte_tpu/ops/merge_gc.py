@@ -237,6 +237,27 @@ def sort_and_gc(cols, cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
 PAD_SENTINEL = 0xFFFFFFFF  # key_len/dkl value marking padding rows
 
 
+def route_word_mask(dkl, w_route: int, leading: bool = True):
+    """Per-word doc-key mask for route prefixes: word i keeps
+    clip(dkl - 4*i, 0, 4) leading bytes (big-endian packed keys).
+
+    THE single definition of route masking — chunk boundaries
+    (ops/run_merge), host-side splitter sampling, and mesh shard routing
+    (parallel/dist_compact) must agree bit-for-bit or documents split
+    across partitions.  dkl: int32 [...]; returns u32 mask broadcast
+    against the word index on the LEADING axis (leading=True: shape
+    [w_route, *dkl.shape]) or the TRAILING axis ([..., w_route])."""
+    u32max = jnp.uint32(0xFFFFFFFF)
+    wi = jnp.arange(w_route, dtype=jnp.int32)
+    nb = (jnp.clip(dkl[None, ...] - wi.reshape(
+              (w_route,) + (1,) * dkl.ndim) * 4, 0, 4) if leading
+          else jnp.clip(dkl[..., None] - wi * 4, 0, 4))
+    return jnp.where(
+        nb >= 4, u32max,
+        jnp.where(nb == 0, jnp.uint32(0),
+                  (u32max << ((4 - nb).astype(jnp.uint32) * 8)) & u32max))
+
+
 def bucket_size(n: int) -> int:
     """Power-of-two shape bucket (one XLA compile per bucket)."""
     return 1 << max(8, (n - 1).bit_length() if n > 1 else 1)

@@ -51,7 +51,7 @@ from yugabyte_tpu.ops.merge_gc import (
     _ROW_DKL, _ROW_FLAGS, _ROW_HT_HI, _ROW_HT_LO, _ROW_KEY_LEN, _ROW_TTL_HI,
     _ROW_TTL_LO, _ROW_WID, _ROW_WORDS, GCParams, PAD_SENTINEL, StagedCols,
     column_stats, gc_over_sorted, pack_cols, pad_template,
-    pack_bits_u32 as _pack_group_bits)
+    route_word_mask, pack_bits_u32 as _pack_group_bits)
 from yugabyte_tpu.ops.slabs import KVSlab
 from yugabyte_tpu.utils import jax_setup  # noqa: F401  (compilation cache)
 
@@ -414,6 +414,9 @@ def gather_staged_outputs(handle: MergeGCHandle,
     """
     from yugabyte_tpu.ops.merge_gc import (bucket_size as _bucket,
                                            build_sort_schedule)
+    if getattr(handle, "_perm_dev", None) is None \
+            and hasattr(handle, "to_parent_products"):
+        handle.to_parent_products()   # chunked: rebuild parent-domain arrays
     staged = handle._staged
     outs: List[StagedCols] = []
     r = _ROW_WORDS + staged.w
@@ -428,6 +431,250 @@ def gather_staged_outputs(handle: MergeGCHandle,
         outs.append(StagedCols(cols_out, sort_rows, n_sort, n_out,
                                n_out_pad, staged.w, None, None))
     return outs
+
+
+# --------------------------------------------------------------------------
+# Chunked subcompactions: bound the compiled shape of arbitrarily large jobs
+# (ref: GenSubcompactionBoundaries, rocksdb/db/compaction_job.cc:330 — the
+# reference splits one big compaction into key-range subcompactions; here
+# each chunk reuses the SAME bucketed executable, so a 4M-row job rides the
+# already-compiled 1M-row program instead of paying a fresh multi-minute
+# XLA/Mosaic compile that scales with n).
+#
+# Chunk boundaries are doc-key ROUTE prefixes (first _W_ROUTE_CHUNK words
+# masked to doc_key_len — the same order-preserving, doc-atomic routing
+# dist_compact.py uses across mesh shards): every entry/version of one
+# document shares its route, and encoded doc keys are prefix-free, so the
+# route is monotone within each sorted run and a binary search per run
+# yields slice bounds that never split a document — the GC segment logic
+# never straddles chunks, and chunk concatenation preserves global order.
+
+_W_ROUTE_CHUNK = 4
+
+
+def _chunk_target_rows() -> int:
+    """YBTPU_MERGE_CHUNK_ROWS: target padded rows per chunk launch.
+    Values below 1024 (including 0 and negatives) disable chunking — a
+    tiny target would explode into one chunk per handful of rows."""
+    try:
+        t = int(os.environ.get("YBTPU_MERGE_CHUNK_ROWS", 1 << 20))
+    except ValueError:
+        return 1 << 20
+    return t if t >= 1024 else 0
+
+
+def _mask_route_host(words: np.ndarray, dkl: np.ndarray) -> np.ndarray:
+    """words [w_route, s] u32, dkl [s] int32 -> doc-key-masked route
+    (host wrapper over the shared merge_gc.route_word_mask)."""
+    msk = np.asarray(route_word_mask(jnp.asarray(dkl, jnp.int32),
+                                     words.shape[0]))
+    return words & msk
+
+
+@functools.partial(jax.jit, static_argnames=("k_pad", "m", "w_route",
+                                             "n_iters"))
+def _chunk_split_search(cols, run_ns, splitters, k_pad: int, m: int,
+                        w_route: int, n_iters: int):
+    """First index >= splitter per (run, splitter): [k_pad, n_split].
+
+    Runs are sorted and routes are monotone within a run (see module
+    comment), so a vectorized binary search with leading-axis gathers
+    suffices; only real lanes (mid < run_n) are ever compared."""
+    dkl = cols[_ROW_DKL].astype(jnp.int32)
+    n_split = splitters.shape[0]
+    runs = jnp.arange(k_pad, dtype=jnp.int32)[:, None]
+    lo = jnp.zeros((k_pad, n_split), jnp.int32)
+    hi = jnp.broadcast_to(run_ns[:, None], (k_pad, n_split))
+    base = runs * m
+    wt = cols[_ROW_WORDS:_ROW_WORDS + w_route].T          # [n, w_route]
+
+    def body(_, lh):
+        lo, hi = lh
+        live = lo < hi
+        mid = (lo + hi) >> 1
+        idx = base + mid                                   # [k, n_split]
+        kw = wt[idx]                                       # [k, ns, w]
+        kd = dkl[idx]
+        kr = kw & route_word_mask(kd, w_route, leading=False)
+        sp = splitters[None, :, :]
+        lt = jnp.zeros(kr.shape[:-1], bool)
+        eq = jnp.ones(kr.shape[:-1], bool)
+        for i in range(w_route):
+            lt = lt | (eq & (kr[..., i] < sp[..., i]))
+            eq = eq & (kr[..., i] == sp[..., i])
+        ge = ~lt
+        hi = jnp.where(live & ge, mid, hi)
+        lo = jnp.where(live & ~ge, mid + 1, lo)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, n_iters, body, (lo, hi))
+    return lo
+
+
+@functools.partial(jax.jit, static_argnames=("m", "m_c", "k_pad"))
+def _carve_chunk(cols, starts, lens, m: int, m_c: int, k_pad: int):
+    """Slice each run's [starts[i], starts[i]+lens[i]) rows into a fresh
+    run-major [r, k_pad*m_c] matrix, padding the tails.
+
+    A window may poke into the NEXT run's region (harmless: lens masking
+    covers it, since starts[i]+lens[i] <= m).  Only the LAST slot can poke
+    past the matrix end, where dynamic_slice would clamp and silently
+    misalign lane j from starts[i]+j — that slot selects from a small
+    [r, 2*m_c] tail extension instead of copying the whole parent."""
+    r = cols.shape[0]
+    n_pad = k_pad * m
+    pad_col = jnp.asarray(pad_template(r))[:, None]
+    lane = jnp.arange(m_c, dtype=jnp.int32)[None, :]
+    parts = []
+    for i in range(k_pad):
+        st = i * m + starts[i]
+        if i < k_pad - 1:
+            seg = jax.lax.dynamic_slice(cols, (0, st), (r, m_c))
+        else:
+            seg_a = jax.lax.dynamic_slice(
+                cols, (0, jnp.minimum(st, n_pad - m_c)), (r, m_c))
+            tail_ext = jnp.concatenate(
+                [jax.lax.dynamic_slice(cols, (0, n_pad - m_c), (r, m_c)),
+                 jnp.tile(pad_col, (1, m_c))], axis=1)
+            delta = jnp.maximum(st - (n_pad - m_c), 0)
+            seg_b = jax.lax.dynamic_slice(tail_ext, (0, delta), (r, m_c))
+            seg = jnp.where(st > n_pad - m_c, seg_b, seg_a)
+        parts.append(jnp.where(lane < lens[i], seg, pad_col))
+    return jnp.concatenate(parts, axis=1)
+
+
+class _ChunkedMergeGCHandle:
+    """Concatenation of per-chunk merge+GC results in global merged order.
+
+    Chunks are range-partitioned by route, so chunk-order concatenation IS
+    the global merged order; per-chunk perms (which index the chunk's own
+    live-run concatenation) remap through the slice offsets.
+
+    HBM write-through staging (gather_staged_outputs) works through
+    `to_parent_products()`, which uploads the decoded decisions back as
+    parent-domain device arrays: ~24 MB at 4M rows, far cheaper than the
+    ~130 MB output-column re-upload that skipping write-through would
+    cost every subsequent compaction."""
+
+    def __init__(self, handles, metas, staged: StagedRuns):
+        self._handles = handles          # one per chunk, dispatch order
+        self._metas = metas              # (starts[k_live], lens[k_live])
+        self._staged = staged
+        self._result = None
+        self._perm_dev = None
+        self._keep_dev = None
+        self._mk_dev = None
+
+    def result(self):
+        if self._result is not None:
+            return self._result
+        staged = self._staged
+        k_live = len(staged.run_ns)
+        grb = np.concatenate(([0], np.cumsum(staged.run_ns)))
+        perms, keeps, mks = [], [], []
+        for h, (starts, lens) in zip(self._handles, self._metas):
+            p, keep, mk = h.result()
+            lb = np.concatenate(([0], np.cumsum(lens)))
+            run_of = np.searchsorted(lb[1:], p, side="right")
+            perms.append(p - lb[run_of] + grb[:k_live][run_of]
+                         + starts[run_of])
+            keeps.append(keep)
+            mks.append(mk)
+        self._result = (np.concatenate(perms), np.concatenate(keeps),
+                        np.concatenate(mks))
+        return self._result
+
+    def to_parent_products(self) -> None:
+        """Build the parent-domain device arrays gather_staged_outputs
+        needs (perm over the PADDED run-major layout, keep/mk padded to
+        n_pad) from the decoded host results."""
+        if self._perm_dev is not None:
+            return
+        staged = self._staged
+        perm, keep, mk = self.result()
+        grb = np.concatenate(([0], np.cumsum(staged.run_ns)))
+        run_of = np.searchsorted(grb[1:], perm, side="right")
+        perm_pad = (run_of.astype(np.int64) * staged.m
+                    + (perm - grb[run_of]))
+        n_pad = staged.n_pad
+        pp = np.zeros(n_pad, dtype=np.int32)
+        pp[:len(perm_pad)] = perm_pad
+        kp = np.zeros(n_pad, dtype=bool)
+        kp[:len(keep)] = keep
+        mp = np.zeros(n_pad, dtype=bool)
+        mp[:len(mk)] = mk
+        dev = getattr(staged.cols_dev, "device", None)
+        put = (lambda a: jax.device_put(a, dev)) if dev is not None \
+            else jnp.asarray
+        self._perm_dev = put(pp)
+        self._keep_dev = put(kp)
+        self._mk_dev = put(mp)
+
+
+def _launch_chunked(staged: StagedRuns, params: GCParams, snapshot: bool,
+                    target: int):
+    """Split one staged job into route-partitioned chunk launches.
+
+    Returns a handle, or None when chunking cannot help (chunk bucket
+    would not shrink below the parent's m) — the caller then launches the
+    single big program as before."""
+    k_live = len(staged.run_ns)
+    if k_live < 1 or staged.n == 0:
+        return None
+    m, k_pad, w = staged.m, staged.k_pad, staged.w
+    w_route = min(_W_ROUTE_CHUNK, w)
+    nc = max(2, -(-staged.n // max(1, target // 2)))
+    n_split = nc - 1
+    run_ns_arr = np.zeros(k_pad, dtype=np.int32)
+    run_ns_arr[:k_live] = staged.run_ns
+
+    # --- splitters from host-side strided samples (tiny download) -------
+    s_per = 256
+    idx = []
+    for i, rn in enumerate(staged.run_ns):
+        if rn > 0:
+            idx.append(i * m + (np.arange(s_per, dtype=np.int64) * rn)
+                       // s_per)
+    idx = np.concatenate(idx)
+    words = np.asarray(staged.cols_dev[
+        _ROW_WORDS:_ROW_WORDS + w_route][:, idx])
+    dkl = np.asarray(staged.cols_dev[_ROW_DKL][idx]).astype(np.int32)
+    routes = _mask_route_host(words, dkl).T          # [s, w_route]
+    order = np.lexsort(tuple(routes[:, i]
+                             for i in range(w_route - 1, -1, -1)))
+    routes = routes[order]
+    q = (np.arange(1, nc, dtype=np.int64) * len(routes)) // nc
+    splitters = routes[q]                            # [n_split, w_route]
+
+    bounds = np.asarray(_chunk_split_search(
+        staged.cols_dev, jnp.asarray(run_ns_arr), jnp.asarray(splitters),
+        k_pad, m, w_route, int(m).bit_length() + 1))
+    bounds = np.concatenate(
+        [np.zeros((k_pad, 1), np.int32), bounds,
+         run_ns_arr[:, None]], axis=1)               # [k_pad, nc+1]
+    bounds = np.maximum.accumulate(bounds, axis=1)
+
+    lens_all = np.diff(bounds, axis=1)               # [k_pad, nc]
+    m_c = run_bucket(int(lens_all.max()))
+    if m_c >= m:
+        return None                                  # no shape win: skew
+    handles, metas = [], []
+    for c in range(nc):
+        starts = bounds[:, c].astype(np.int32)
+        lens = lens_all[:, c].astype(np.int32)
+        if int(lens.sum()) == 0:
+            continue                                 # duplicate splitter
+        carved = _carve_chunk(staged.cols_dev, jnp.asarray(starts),
+                              jnp.asarray(lens), m, m_c, k_pad)
+        sub = StagedRuns(carved, m_c, k_pad, w,
+                         [int(x) for x in lens[:k_live]],
+                         staged.cmp_rows, staged.n_cmp)
+        handles.append(launch_merge_gc(sub, params, snapshot=snapshot))
+        metas.append((starts[:k_live].astype(np.int64),
+                      lens[:k_live].astype(np.int64)))
+    if not handles:
+        return None
+    return _ChunkedMergeGCHandle(handles, metas, staged)
 
 
 _probe_winners = None  # lazy: {log2(n): "pallas"|"network"} from PROBE_TPU
@@ -509,11 +756,14 @@ class _PallasFallbackHandle:
     def __init__(self, inner, staged, params, snapshot):
         self._inner = inner
         self._args = (staged, params, snapshot)
+        self._effective = None   # set by result(): the handle that ran
 
     def result(self):
         global _pallas_broken
         try:
-            return self._inner.result()
+            out = self._inner.result()
+            self._effective = self._inner
+            return out
         except Exception as e:  # noqa: BLE001 — lowering/launch failure
             import sys as _sys
             _pallas_broken = True
@@ -521,13 +771,30 @@ class _PallasFallbackHandle:
                   f"falling back to the jnp network for this process: "
                   f"{e!r}", file=_sys.stderr, flush=True)
             staged, params, snapshot = self._args
-            return launch_merge_gc(staged, params,
-                                   snapshot=snapshot).result()
+            self._effective = launch_merge_gc(staged, params,
+                                              snapshot=snapshot)
+            return self._effective.result()
+
+    def __getattr__(self, name):
+        # delegate device-resident merge products (_staged, _perm_dev,
+        # _keep_dev, _mk_dev) to whichever handle actually produced the
+        # result, so HBM write-through staging (gather_staged_outputs)
+        # works through the fallback wrapper
+        return getattr(self._effective if self._effective is not None
+                       else self._inner, name)
 
 
 def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot: bool = False) -> MergeGCHandle:
     global _pallas_broken
+    target = _chunk_target_rows()
+    if (target and staged.k_pad >= 2 and staged.n_pad > target
+            and staged.m >= 512):
+        # bound the compiled shape: subcompaction chunks reuse the
+        # already-compiled bucket executable (see _launch_chunked)
+        h = _launch_chunked(staged, params, snapshot, target)
+        if h is not None:
+            return h
     explicit = os.environ.get("YBTPU_MERGE_IMPL", "auto") == "pallas"
     if (not _pallas_broken or explicit) and _pick_impl(staged) == "pallas":
         from yugabyte_tpu.ops import pallas_merge

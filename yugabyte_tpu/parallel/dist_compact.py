@@ -78,12 +78,7 @@ def dist_compact_fn(mesh: Mesh, capacity: int, is_major: bool,
         # rows get all-0xFF route words so they route to the last shard)
         dkl = cols_local[_ROW_DKL].astype(jnp.int32)      # pad rows: -1
         words = cols_local[_ROW_WORDS:_ROW_WORDS + w_route]
-        widx = jnp.arange(w_route, dtype=jnp.int32)[:, None]
-        nbytes = jnp.clip(dkl[None, :] - widx * 4, 0, 4)
-        mask = jnp.where(
-            nbytes >= 4, u32max,
-            jnp.where(nbytes == 0, jnp.uint32(0),
-                      (u32max << ((4 - nbytes).astype(jnp.uint32) * 8)) & u32max))
+        mask = merge_gc.route_word_mask(dkl, w_route)     # shared defn
         route = jnp.where(is_pad_in[None, :], u32max, words & mask)
         # -- 1/2: sample + all_gather + splitters --------------------------
         step = max(1, n_local // _SAMPLES_PER_SHARD)

@@ -503,7 +503,11 @@ def run_compaction_job_device_native(
         outputs, ranges = _write_native_outputs(
             job, out_dir, new_file_id, fr, block_entries,
             has_deep=any(r.props.has_deep for r in inputs))
-    if device_cache is not None and outputs:
+    if (device_cache is not None and outputs
+            and (getattr(handle, "_perm_dev", None) is not None
+                 or hasattr(handle, "to_parent_products"))):
+        # chunked handles rebuild parent-domain device arrays on demand
+        # (run_merge._ChunkedMergeGCHandle.to_parent_products)
         # write-through: the outputs are the next compaction's inputs.
         # Staged ON DEVICE by gathering the surviving columns in HBM —
         # zero host->device transfer (re-uploading the packed output
