@@ -28,6 +28,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "merge_gc_core.h"
@@ -99,8 +100,40 @@ void pfor(int64_t n, int n_threads, F&& body) {
   for (auto& th : ts) th.join();
 }
 
+// ---- native run cache ---------------------------------------------------
+// Packed-run retention across compactions: a flush or compaction output is
+// exported ONCE as decoded SoA columns and retained in host RAM, so the
+// next compaction over it skips file read + block decode entirely (the
+// reference pays TableReader iteration per input every job even on block
+// cache hits, ref: db/compaction_job.cc:442 + table/merger.cc:51; this
+// cache is the host-side counterpart of the HBM key-column cache in
+// storage/device_cache.py). Entries are immutable after export; shared_ptr
+// keeps a run alive while a job reads it even if Python drops it mid-job.
+struct CachedRun {
+  int64_t n = 0;
+  int32_t stride = 0;
+  std::vector<uint8_t> keys;
+  std::vector<int32_t> key_len, dkl;
+  std::vector<uint64_t> ht;
+  std::vector<uint32_t> wid;
+  std::vector<uint8_t> flags;
+  std::vector<int64_t> ttl_ms;
+  std::vector<uint8_t> vals;
+  std::vector<int64_t> val_offs;  // n+1
+  int64_t bytes() const {
+    return (int64_t)keys.size() + 4 * 2 * n + 8 * n + 4 * n + n + 8 * n +
+           (int64_t)vals.size() + 8 * (n + 1);
+  }
+};
+
+std::mutex g_rc_mu;
+std::unordered_map<int64_t, std::shared_ptr<CachedRun>> g_rc;
+int64_t g_rc_next_id = 1;
+int64_t g_rc_bytes = 0;
+
 struct Job {
   std::vector<InputFile> inputs;
+  std::vector<std::shared_ptr<CachedRun>> cached;  // zero-decode inputs
   int n_threads = 4;
   std::string error;
 
@@ -384,7 +417,9 @@ int64_t ce_job_merge(void* jp, uint64_t cutoff_ht, int32_t is_major,
   j->mk.resize(n);
   ybtpu::Ctx c{j->keys.data(), j->key_len.data(), j->stride, j->ht.data(),
                j->wid.data()};
-  ybtpu::merge_and_filter(c, (int32_t)j->inputs.size(),
+  // run count from run_offsets, not inputs: cached-run and add_raw jobs
+  // have no InputFile entries
+  ybtpu::merge_and_filter(c, (int32_t)j->run_offsets.size() - 1,
                           j->run_offsets.data(), j->dkl.data(),
                           j->flags.data(), j->ttl_ms.data(), cutoff_ht,
                           is_major, retain_deletes, j->keep.data(),
@@ -592,6 +627,158 @@ int64_t ce_job_write_output(void* jp, int64_t start, int64_t end,
     out.last_key.clear();
   }
   return off;
+}
+
+// Bloom bit scatter (storage/bloom.py BloomFilterBuilder.add_hashes): the
+// numpy path is an unbuffered ufunc.at — ~100ns per scattered OR; this is
+// the same double-hash schedule at memcpy-class speed.
+void ce_bloom_build(const uint64_t* h, int64_t n, uint8_t* bits,
+                    uint64_t m_bits, int32_t k) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t h1 = h[i] & 0xFFFFFFFFull;
+    uint64_t h2 = (h[i] >> 32) | 1ull;
+    for (int32_t j = 0; j < k; ++j) {
+      uint64_t pos = (h1 + (uint64_t)j * h2) % m_bits;
+      bits[pos >> 3] |= (uint8_t)(1u << (pos & 7));
+    }
+  }
+}
+
+// --- native run cache ----------------------------------------------------
+// Export survivors [start, end) of a finished job as a cached packed run —
+// byte-equivalent to decoding the output file just written for that range
+// (same tombstone rewrite: flags |= kTombstone and the value replaced).
+// Valid after merge/set_survivors (compaction) or sort_all (flush).
+// Returns the new run id, or -1.
+int64_t ce_runcache_export(void* jp, int64_t start, int64_t end,
+                           const uint8_t* tomb_value, int32_t tomb_len) {
+  Job* j = (Job*)jp;
+  int64_t n = end - start;
+  if (n < 0 || start < 0 || end > (int64_t)j->surv.size()) return -1;
+  auto run = std::make_shared<CachedRun>();
+  run->n = n;
+  run->stride = j->stride;
+  run->keys.assign((size_t)n * j->stride, 0);
+  run->key_len.resize(n);
+  run->dkl.resize(n);
+  run->ht.resize(n);
+  run->wid.resize(n);
+  run->flags.resize(n);
+  run->ttl_ms.resize(n);
+  run->val_offs.resize(n + 1);
+  int64_t vtotal = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    run->val_offs[i] = vtotal;
+    vtotal += j->surv_mk[start + i] ? tomb_len
+                                    : j->val_len[j->surv[start + i]];
+  }
+  run->val_offs[n] = vtotal;
+  run->vals.resize(vtotal);
+  pfor(n, j->n_threads, [&](int64_t i) {
+    int64_t r = j->surv[start + i];
+    memcpy(&run->keys[i * run->stride], &j->keys[r * j->stride], j->stride);
+    run->key_len[i] = j->key_len[r];
+    run->dkl[i] = j->dkl[r];
+    run->ht[i] = j->ht[r];
+    run->wid[i] = j->wid[r];
+    run->ttl_ms[i] = j->ttl_ms[r];
+    if (j->surv_mk[start + i]) {
+      run->flags[i] = j->flags[r] | 1;  // rewritten as tombstone
+      memcpy(&run->vals[run->val_offs[i]], tomb_value, tomb_len);
+    } else {
+      run->flags[i] = j->flags[r];
+      memcpy(&run->vals[run->val_offs[i]], j->val_ptr[r], j->val_len[r]);
+    }
+  });
+  std::lock_guard<std::mutex> lock(g_rc_mu);
+  int64_t id = g_rc_next_id++;
+  g_rc_bytes += run->bytes();
+  g_rc.emplace(id, std::move(run));
+  return id;
+}
+
+int64_t ce_runcache_entry_bytes(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_rc_mu);
+  auto it = g_rc.find(id);
+  return it == g_rc.end() ? -1 : it->second->bytes();
+}
+
+void ce_runcache_drop(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_rc_mu);
+  auto it = g_rc.find(id);
+  if (it != g_rc.end()) {
+    g_rc_bytes -= it->second->bytes();
+    g_rc.erase(it);  // in-flight jobs keep their shared_ptr
+  }
+}
+
+int64_t ce_runcache_bytes() {
+  std::lock_guard<std::mutex> lock(g_rc_mu);
+  return g_rc_bytes;
+}
+
+// Append a cached run as a job input. All-cached jobs then use
+// ce_job_prepare_cached instead of add_input + prepare; run ORDER must
+// match the device staging order (run-major survivor indexes).
+int32_t ce_job_add_cached(void* jp, int64_t id) {
+  Job* j = (Job*)jp;
+  std::shared_ptr<CachedRun> run;
+  {
+    std::lock_guard<std::mutex> lock(g_rc_mu);
+    auto it = g_rc.find(id);
+    if (it == g_rc.end()) return -1;
+    run = it->second;
+  }
+  j->cached.push_back(std::move(run));
+  return 0;
+}
+
+// Fill the SoA from cached runs only — the zero-decode steady-state input
+// path (no file read, no block decode, no CRC pass; value bytes are
+// POINTED AT in the cached blobs, never copied). Returns total rows, -1 on
+// misuse (mixed with file inputs, or nothing added).
+int64_t ce_job_prepare_cached(void* jp) {
+  Job* j = (Job*)jp;
+  if (!j->inputs.empty() || j->cached.empty()) {
+    j->error = "prepare_cached: requires cached inputs only";
+    return -1;
+  }
+  int64_t n = 0;
+  int32_t stride = 4;
+  j->run_offsets.assign(1, 0);
+  for (auto& run : j->cached) {
+    n += run->n;
+    if (run->stride > stride) stride = run->stride;
+    j->run_offsets.push_back(n);
+  }
+  j->n = n;
+  j->stride = stride;
+  j->keys.assign((size_t)n * stride, 0);
+  j->key_len.resize(n);
+  j->dkl.resize(n);
+  j->ht.resize(n);
+  j->wid.resize(n);
+  j->flags.resize(n);
+  j->ttl_ms.resize(n);
+  j->val_ptr.resize(n);
+  j->val_len.resize(n);
+  for (size_t ri = 0; ri < j->cached.size(); ++ri) {
+    CachedRun& run = *j->cached[ri];
+    int64_t base = j->run_offsets[ri];
+    pfor(run.n, j->n_threads, [&](int64_t i) {
+      int64_t r = base + i;
+      memcpy(&j->keys[r * stride], &run.keys[i * run.stride], run.stride);
+      j->key_len[r] = run.key_len[i];
+      j->dkl[r] = run.dkl[i];
+      j->ht[r] = run.ht[i];
+      j->wid[r] = run.wid[i];
+      j->flags[r] = run.flags[i];
+      j->ttl_ms[r] = run.ttl_ms[i];
+      j->val_ptr[r] = run.vals.data() + run.val_offs[i];
+      j->val_len[r] = (uint32_t)(run.val_offs[i + 1] - run.val_offs[i]);
+    });
+  }
+  return n;
 }
 
 // --- accessors for the last written output ------------------------------

@@ -196,7 +196,15 @@ def _probe(args):
                 compact_cpu_baseline
             t = time.time()
             compact_cpu_baseline(slab, offsets, cutoff, True)
-            return round(n / (time.time() - t), 1)
+            best = time.time() - t
+            # best-of-3: the denominator swings 2-3x under transient host
+            # load (VERDICT r4 weak #3 — pin the baseline); the fastest
+            # run is the least-contended estimate of the machine
+            for _ in range(2):
+                t = time.time()
+                compact_cpu_baseline(slab, offsets, cutoff, True)
+                best = min(best, time.time() - t)
+            return round(n / best, 1)
         except Exception as e:  # noqa: BLE001
             state["native_error"] = repr(e)[:200]
             return 0.0

@@ -172,7 +172,7 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                        retain_deletes: bool = False, device=None,
                        block_entries: Optional[int] = None, device_cache=None,
                        input_ids: Optional[Sequence[int]] = None,
-                       mesh=None, offload_policy=None,
+                       mesh=None, offload_policy=None, run_cache=None,
                        _no_combined: bool = False) -> CompactionResult:
     """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
 
@@ -222,7 +222,7 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                 all_inputs, out_dir, new_file_id, history_cutoff_ht,
                 is_major, retain_deletes, device=device,
                 block_entries=block_entries, device_cache=device_cache,
-                input_ids=orig_input_ids)
+                input_ids=orig_input_ids, run_cache=run_cache)
     inputs, dropped = filter_expired_inputs(
         inputs, history_cutoff_ht, is_major, retain_deletes)
     dropped_rows = sum(r.props.n_entries for r in dropped)
@@ -416,7 +416,8 @@ def run_compaction_job_device_native(
         history_cutoff_ht: int, is_major: bool,
         retain_deletes: bool = False, device=None,
         block_entries: Optional[int] = None, device_cache=None,
-        input_ids: Optional[Sequence[int]] = None) -> CompactionResult:
+        input_ids: Optional[Sequence[int]] = None,
+        run_cache=None) -> CompactionResult:
     """The production hot path: TPU decisions + native byte shell.
 
     The device kernel (ops/run_merge.py) computes merge+GC decisions from
@@ -487,12 +488,43 @@ def run_compaction_job_device_native(
     params = GCParams(history_cutoff_ht, is_major, retain_deletes)
     handle = run_merge.launch_merge_gc(staged_runs, params)
 
-    # 2) native shell decodes the same inputs while the device works
+    # cached-run ids, in INPUT ORDER (the device survivor indexes are
+    # run-major over exactly this order) — all-or-nothing: a partial hit
+    # still pays the file path for every input. contains() first so a
+    # partial-hit job neither inflates hit metrics nor promotes entries
+    # it never consumes; get() only once every input is present.
+    cached_ids = None
+    if run_cache is not None and input_ids is not None \
+            and all(run_cache.contains(fid) for fid in input_ids):
+        ids = [run_cache.get(fid) for fid in input_ids]
+        if all(i is not None for i in ids):
+            cached_ids = ids
+
+    # 2) native shell ingests the same inputs while the device works:
+    #    steady state takes the zero-decode run-cache path (no file read,
+    #    no block decode/CRC — the bytes were retained when these SSTs
+    #    were produced); cold inputs pay the full decode
+    tombstone_value = Value.tombstone().encode()
     with native_engine.NativeCompactionJob() as job:
-        for r in inputs:
-            with open(r.data_path, "rb") as f:
-                job.add_input(f.read(), r.block_handles)
-        rows_in = job.prepare()
+        pinned = False
+        if cached_ids is not None:
+            try:
+                # add_cached pins each run (C++ shared_ptr) — an entry
+                # evicted between the probe above and here raises, and
+                # the job falls back to the file path (stray pinned runs
+                # are ignored by prepare() and freed at job close)
+                for rid in cached_ids:
+                    job.add_cached(rid)
+                pinned = True
+            except KeyError:
+                pinned = False
+        if pinned:
+            rows_in = job.prepare_cached()
+        else:
+            for r in inputs:
+                with open(r.data_path, "rb") as f:
+                    job.add_input(f.read(), r.block_handles)
+            rows_in = job.prepare()
 
         # 3) inject the decisions; the shell writes the outputs
         perm, keep, mk = handle.result()
@@ -503,6 +535,14 @@ def run_compaction_job_device_native(
         outputs, ranges = _write_native_outputs(
             job, out_dir, new_file_id, fr, block_entries,
             has_deep=any(r.props.has_deep for r in inputs))
+        if run_cache is not None:
+            # run-cache write-through: exported survivors are
+            # byte-equivalent to re-decoding the files just written, so
+            # the NEXT compaction over these outputs starts all-cached
+            for (fid, _base, _props), (start, end) in zip(outputs, ranges):
+                rid = job.export_run(start, end, tombstone_value)
+                run_cache.put(fid, rid,
+                              native_engine.runcache_entry_bytes(rid))
     if (device_cache is not None and outputs
             and (getattr(handle, "_perm_dev", None) is not None
                  or hasattr(handle, "to_parent_products"))):

@@ -77,6 +77,15 @@ class DB:
                 NamespacedSlabCache(self.opts.device_cache, os.path.abspath(db_dir))
                 if isinstance(self.opts.device_cache, DeviceSlabCache)
                 else self.opts.device_cache)
+        # host-side packed-run cache: flush/compaction outputs retained
+        # decoded so steady-state compactions skip read+decode entirely
+        # (storage/run_cache.py; None when disabled or no native engine)
+        self._run_cache = None
+        from yugabyte_tpu.storage.run_cache import (NamespacedRunCache,
+                                                    shared_run_cache)
+        _rc = shared_run_cache()
+        if _rc is not None:
+            self._run_cache = NamespacedRunCache(_rc, os.path.abspath(db_dir))
         os.makedirs(db_dir, exist_ok=True)
         self.versions = VersionSet(db_dir)
         self.versions.recover()
@@ -466,19 +475,25 @@ class DB:
             slab = None
             from yugabyte_tpu.storage import native_engine
             from yugabyte_tpu.utils.env import get_env
-            if (native_engine.available() and not get_env().encrypted
-                    and self._device_cache is None):
+            if native_engine.available() and not get_env().encrypted:
                 # native flush encoder: block encode + bloom + doc-key
                 # parsing in C++ (the write-path hot loop, ref:
-                # db/flush_job.cc WriteLevel0Table)
+                # db/flush_job.cc WriteLevel0Table), with run-cache
+                # write-through so the first compaction over this output
+                # skips read+decode. Device staging (below) still needs
+                # the slab form — a second memtable walk, much cheaper
+                # than the Python block encoder it replaces.
                 packed = imm.to_packed()
                 frontier = Frontier(op_id_min=last_op, op_id_max=last_op,
                                     history_cutoff=0)
                 from yugabyte_tpu.storage.sst import write_sst_from_packed
                 props = write_sst_from_packed(
                     path, *packed, frontier=frontier,
-                    block_entries=self.opts.block_entries)
+                    block_entries=self.opts.block_entries,
+                    run_cache=self._run_cache, file_id=fid)
                 n_flushed = len(packed[1]) - 1
+                if self._device_cache is not None:
+                    slab = imm.to_slab()
             else:
                 slab = imm.to_slab()
                 ht = slab.ht_hi.astype("u8") << 32 | slab.ht_lo
@@ -543,7 +558,8 @@ class DB:
                 device_cache=self._device_cache,
                 input_ids=[fm.file_id for fm in pick.inputs],
                 mesh=self.opts.mesh,
-                offload_policy=self.opts.offload_policy)
+                offload_policy=self.opts.offload_policy,
+                run_cache=self._run_cache)
             from yugabyte_tpu.utils import sync_point
             sync_point.hit("db.compaction:before_install")
             with self._lock:
@@ -569,6 +585,8 @@ class DB:
                             _delete_sst_files(r.base_path)
                     if self._device_cache is not None:
                         self._device_cache.drop(fid)
+                    if self._run_cache is not None:
+                        self._run_cache.drop(fid)
             TRACE("compaction: %d files -> %d rows (%d in)",
                   len(pick.inputs), result.rows_out, result.rows_in)
         finally:
@@ -635,6 +653,8 @@ class DB:
             if self._device_cache is not None and \
                     hasattr(self._device_cache, "drop_all"):
                 self._device_cache.drop_all()  # free this DB's HBM residency
+            if self._run_cache is not None:
+                self._run_cache.drop_all()
 
     @property
     def n_live_files(self) -> int:

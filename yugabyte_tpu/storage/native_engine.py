@@ -93,6 +93,20 @@ def _load():
         lib.ce_out_last_key.restype = ctypes.c_int32
         lib.ce_out_last_key.argtypes = [ctypes.c_void_p, _u8p,
                                         ctypes.c_int32]
+        lib.ce_bloom_build.argtypes = [
+            _u64p, ctypes.c_int64, _u8p, ctypes.c_uint64, ctypes.c_int32]
+        lib.ce_runcache_export.restype = ctypes.c_int64
+        lib.ce_runcache_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _u8p,
+            ctypes.c_int32]
+        lib.ce_runcache_entry_bytes.restype = ctypes.c_int64
+        lib.ce_runcache_entry_bytes.argtypes = [ctypes.c_int64]
+        lib.ce_runcache_drop.argtypes = [ctypes.c_int64]
+        lib.ce_runcache_bytes.restype = ctypes.c_int64
+        lib.ce_job_add_cached.restype = ctypes.c_int32
+        lib.ce_job_add_cached.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ce_job_prepare_cached.restype = ctypes.c_int64
+        lib.ce_job_prepare_cached.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -111,6 +125,32 @@ def available() -> bool:
         except Exception:
             _available = False
     return _available
+
+
+def bloom_build(hashes: np.ndarray, bits: np.ndarray,
+                m_bits: int, k: int) -> None:
+    """Scatter bloom bits natively (storage/bloom.py hot path)."""
+    lib = _load()
+    h = np.ascontiguousarray(hashes, dtype=np.uint64)
+    lib.ce_bloom_build(h.ctypes.data_as(_u64p),
+                       ctypes.c_int64(len(h)),
+                       bits.ctypes.data_as(_u8p),
+                       ctypes.c_uint64(m_bits), ctypes.c_int32(k))
+
+
+def runcache_drop(run_id: int) -> None:
+    """Drop one entry from the native run cache (jobs holding it keep a
+    reference until they free)."""
+    _load().ce_runcache_drop(ctypes.c_int64(run_id))
+
+
+def runcache_bytes() -> int:
+    """Total host RAM held by the native run cache."""
+    return int(_load().ce_runcache_bytes())
+
+
+def runcache_entry_bytes(run_id: int) -> int:
+    return int(_load().ce_runcache_entry_bytes(ctypes.c_int64(run_id)))
 
 
 class NativeCompactionJob:
@@ -218,6 +258,34 @@ class NativeCompactionJob:
             self._job, surv.ctypes.data_as(_i64p), mk.ctypes.data_as(_u8p),
             ctypes.c_int64(len(surv)))
         self.n_survivors = len(surv)
+
+    def export_run(self, start: int, end: int,
+                   tombstone_value: bytes) -> int:
+        """Export survivors [start, end) into the native run cache —
+        byte-equivalent to re-decoding the output file written for that
+        range. Returns the run id (see storage/run_cache.py)."""
+        tomb = np.ascontiguousarray(
+            np.frombuffer(tombstone_value, dtype=np.uint8))
+        rid = int(self._lib.ce_runcache_export(
+            self._job, ctypes.c_int64(start), ctypes.c_int64(end),
+            tomb.ctypes.data_as(_u8p), ctypes.c_int32(len(tombstone_value))))
+        if rid < 0:
+            raise RuntimeError(f"run cache export: {self._err()}")
+        return rid
+
+    def add_cached(self, run_id: int) -> None:
+        """Append a run-cache entry as a job input (zero-decode path)."""
+        if int(self._lib.ce_job_add_cached(
+                self._job, ctypes.c_int64(run_id))) != 0:
+            raise KeyError(f"run cache id {run_id} not present")
+
+    def prepare_cached(self) -> int:
+        """prepare() for all-cached inputs: no file read, no block decode."""
+        n = int(self._lib.ce_job_prepare_cached(self._job))
+        if n < 0:
+            raise RuntimeError(f"native prepare_cached: {self._err()}")
+        self.rows_in = n
+        return n
 
     def write_output(self, start: int, end: int, data_path: str,
                      block_entries: int, compress: bool,

@@ -193,7 +193,9 @@ def write_sst_from_packed(base_path: str, keys_blob: bytes, key_offs,
                           frontier: Optional[Frontier] = None,
                           block_entries: Optional[int] = None,
                           compress: Optional[bool] = None,
-                          presorted_hint: bool = True) -> SSTProps:
+                          presorted_hint: bool = True,
+                          run_cache=None,
+                          file_id: Optional[int] = None) -> SSTProps:
     """Native-encoded SST from one packed run (the flush / bulk-load hot
     path, ref: db/flush_job.cc WriteLevel0Table + memtable.cc iteration).
     Block encode, bloom hashing and doc-key parsing run in C++
@@ -216,6 +218,12 @@ def write_sst_from_packed(base_path: str, keys_blob: bytes, key_offs,
         size, index, hashes, first_key, last_key = job.write_output(
             0, n, data_path, block_entries, compress, b"X")
         max_expire_us, has_deep = job.props()
+        if run_cache is not None and file_id is not None and n:
+            # run-cache write-through (storage/run_cache.py): the first
+            # compaction over this flush output starts zero-decode
+            rid = job.export_run(0, n, b"X")
+            run_cache.put(file_id, rid,
+                          native_engine.runcache_entry_bytes(rid))
     ht_arr = np.asarray(ht, dtype=np.uint64)
     fr = frontier or Frontier()
     if n and fr.ht_min == 0 and fr.ht_max == 0:
