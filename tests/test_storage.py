@@ -155,6 +155,26 @@ class TestMemTable:
         keys = [slab.key_bytes(i) for i in range(slab.n)]
         assert keys == sorted(keys)
 
+    def test_add_batch_duplicate_keys_dedup(self):
+        """add_batch defers duplicate suppression to sort time; an
+        overwrite of the same (key, dht) across batches must surface
+        exactly once (latest value) in iteration, point_get and to_slab."""
+        m = MemTable()
+        batch = [(key_for(r), ht(100), Value(primitive=r).encode())
+                 for r in [2, 0, 1]]
+        m.add_batch(batch)
+        # interleave a point_get (forces a sort) between duplicate batches
+        assert m.point_get(key_for(1), key_for(1)) is not None
+        m.add_batch([(key_for(1), ht(100), Value(primitive=99).encode()),
+                     (key_for(3), ht(100), Value(primitive=3).encode())])
+        out = list(m.iter_from())
+        assert [k for k, _ in out] == sorted(set(k for k, _ in out))
+        assert len(out) == 4 and m.n_entries == 4
+        hit = m.point_get(key_for(1), key_for(1))
+        assert Value.decode(hit[1]).primitive == 99
+        slab = m.to_slab()
+        assert slab.n == 4
+
 
 class TestDB:
     def _mk_db(self, tmp_path, **kw):

@@ -258,12 +258,16 @@ class YBClient:
                         continue
                     if e.status.code in (Code.NOT_FOUND,
                                          Code.SERVICE_UNAVAILABLE,
-                                         Code.TIMED_OUT):
+                                         Code.TIMED_OUT,
+                                         Code.ABORTED):
                         # TIMED_OUT is the server's OperationOutcomeUnknown:
                         # the entry may still commit. Retrying HERE — with
                         # the same request id — is what makes the
                         # retryable-request dedup close the double-apply
                         # hole (the op args carry client_id/request_id).
+                        # ABORTED is ReplicationAborted: the entry was
+                        # overwritten by a new leader and provably did NOT
+                        # commit — retry lands on the re-resolved leader.
                         last_err = e
                         continue
                     raise

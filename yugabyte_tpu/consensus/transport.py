@@ -38,14 +38,29 @@ class LocalTransport:
             self._peers[peer_id] = consensus
 
     # ------------------------------------------------------ fault injection
+    def _known(self, name: str) -> bool:
+        return name in self._peers or \
+            any(p.startswith(name + "/") for p in self._peers)
+
     def partition(self, a: str, b: str) -> None:
         with self._lock:
+            # a silent no-op partition (name not matching any registered
+            # peer id) makes fault tests pass vacuously — fail loudly
+            for name in (a, b):
+                if self._peers and not self._known(name):
+                    raise ValueError(
+                        f"partition({name!r}): no such peer; registered: "
+                        f"{sorted(self._peers)}")
             self._partitions.add((a, b))
             self._partitions.add((b, a))
 
     def isolate(self, peer_id: str) -> None:
         """Cut peer_id off from everyone (crash-failure emulation)."""
         with self._lock:
+            if self._peers and not self._known(peer_id):
+                raise ValueError(
+                    f"isolate({peer_id!r}): no such peer; registered: "
+                    f"{sorted(self._peers)}")
             self._down.add(peer_id)
 
     def heal(self) -> None:
@@ -58,10 +73,18 @@ class LocalTransport:
             self._drop_probability = p
 
     def _check_link(self, src: str, dst: str) -> object:
+        # Faults match the full consensus id ("ts0/t1") OR the server part
+        # ("ts0"): a network partition cuts SERVERS, so tests express it
+        # per-server and it applies to every tablet channel between them.
+        src_srv = src.split("/", 1)[0]
+        dst_srv = dst.split("/", 1)[0]
         with self._lock:
-            if src in self._down or dst in self._down:
+            down = self._down
+            if (src in down or dst in down
+                    or src_srv in down or dst_srv in down):
                 raise PeerUnreachable(f"{src}->{dst}: peer down")
-            if (src, dst) in self._partitions:
+            if (src, dst) in self._partitions or \
+                    (src_srv, dst_srv) in self._partitions:
                 raise PeerUnreachable(f"{src}->{dst}: partitioned")
             if self._drop_probability and \
                     self._rng.random() < self._drop_probability:

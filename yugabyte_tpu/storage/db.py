@@ -79,13 +79,20 @@ class DB:
                 else self.opts.device_cache)
         # host-side packed-run cache: flush/compaction outputs retained
         # decoded so steady-state compactions skip read+decode entirely
-        # (storage/run_cache.py; None when disabled or no native engine)
+        # (storage/run_cache.py; None when disabled or no native engine).
+        # Only the device+native combined compaction path consumes it
+        # (compaction.py:196 needs device_cache + a device kernel), so a
+        # native-only or deviceless DB must not pay the per-flush survivor
+        # copy and pinned host RAM for a cache nothing ever reads.
         self._run_cache = None
-        from yugabyte_tpu.storage.run_cache import (NamespacedRunCache,
-                                                    shared_run_cache)
-        _rc = shared_run_cache()
-        if _rc is not None:
-            self._run_cache = NamespacedRunCache(_rc, os.path.abspath(db_dir))
+        if self._device_cache is not None and \
+                self.opts.device not in (None, "native"):
+            from yugabyte_tpu.storage.run_cache import (NamespacedRunCache,
+                                                        shared_run_cache)
+            _rc = shared_run_cache()
+            if _rc is not None:
+                self._run_cache = NamespacedRunCache(
+                    _rc, os.path.abspath(db_dir))
         os.makedirs(db_dir, exist_ok=True)
         self.versions = VersionSet(db_dir)
         self.versions.recover()
@@ -165,6 +172,9 @@ class DB:
         bytes) alive by reference, so no file pinning is needed."""
         if not flags.get_flag("read_native"):
             return None
+        rset = self._rset
+        if rset is not None:  # lock-free hot path (GIL-atomic attr read;
+            return rset       # stale snapshots are safe, see docstring)
         from yugabyte_tpu.storage import native_read
         if not native_read.available():
             return None

@@ -31,6 +31,7 @@ class MemTable:
         self._data: Dict[bytes, bytes] = {}
         self._keys: List[bytes] = []
         self._sorted_upto = 0
+        self._dups_possible = False
         self._bytes = 0
         self.version = 0  # bumped per mutation: packed-run cache key
         self._lock = threading.Lock()
@@ -52,18 +53,17 @@ class MemTable:
 
     def add_batch(self, items) -> None:
         """Bulk insert of (key_prefix, dht, value) triples — one lock
-        acquisition and list-comprehension packing instead of a per-entry
-        call chain (the write-path hot loop, ref: db/memtable.cc Add)."""
+        acquisition, C-speed dict.update, and deferred key dedup (the
+        sorted-snapshot pass dedups; the write-path hot loop, ref:
+        db/memtable.cc Add)."""
         ikeys = [make_internal_key(k, dht) for k, dht, _ in items]
+        vals = [v for _, _, v in items]
+        nbytes = sum(map(len, ikeys)) + sum(map(len, vals))
         with self._lock:
-            data = self._data
-            keys = self._keys
-            nbytes = 0
-            for ikey, (_, _, value) in zip(ikeys, items):
-                if ikey not in data:
-                    keys.append(ikey)
-                data[ikey] = value
-                nbytes += len(ikey) + len(value)
+            self._data.update(zip(ikeys, vals))
+            # may append keys already present; _sorted_snapshot dedups
+            self._keys.extend(ikeys)
+            self._dups_possible = True
             self._bytes += nbytes
             self.version += 1
             if self._first_write_s is None:
@@ -75,15 +75,22 @@ class MemTable:
         with `boundary`, without copying the key list (the per-point-read
         snapshot copy dominated hot gets on large memtables)."""
         with self._lock:
-            if self._sorted_upto != len(self._keys):
-                self._keys = sorted(self._keys)
-                self._sorted_upto = len(self._keys)
+            self._ensure_sorted_locked()
             idx = bisect.bisect_left(self._keys, seek)
             if idx < len(self._keys):
                 k = self._keys[idx]
                 if k.startswith(boundary):
                     return k, self._data[k]
         return None
+
+    def _ensure_sorted_locked(self) -> None:
+        if self._sorted_upto != len(self._keys):
+            # add_batch defers duplicate-key suppression to here: one
+            # set() pass at sort time beats a per-row `in` probe per write
+            self._keys = sorted(set(self._keys)) if self._dups_possible \
+                else sorted(self._keys)
+            self._dups_possible = False
+            self._sorted_upto = len(self._keys)
 
     @property
     def oldest_write_s(self) -> Optional[float]:
@@ -109,9 +116,7 @@ class MemTable:
         snapshot's returned length bound hides them.
         """
         with self._lock:
-            if self._sorted_upto != len(self._keys):
-                self._keys = sorted(self._keys)
-                self._sorted_upto = len(self._keys)
+            self._ensure_sorted_locked()
             return self._keys[:]  # cheap vs re-sort; isolates from appends
 
     def iter_from(self, seek_key: bytes = b"") -> Iterator[Tuple[bytes, bytes]]:

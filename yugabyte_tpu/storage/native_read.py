@@ -52,7 +52,10 @@ def _load():
         lib.rs_doc_key_len.restype = ctypes.c_int32
         lib.rs_doc_key_len.argtypes = [_u8p, ctypes.c_int32]
         lib.rs_multi_get.restype = ctypes.c_int64
-        lib.rs_multi_get.argtypes = [_vpp, ctypes.c_int32, _u8p,
+        # key as c_char_p: ctypes passes the bytes object's buffer pointer
+        # directly (length travels separately), skipping a per-call cast on
+        # the hottest serving call
+        lib.rs_multi_get.argtypes = [_vpp, ctypes.c_int32, ctypes.c_char_p,
                                      ctypes.c_int32, ctypes.c_int32,
                                      ctypes.c_uint64, _u8p, ctypes.c_int64,
                                      _u64p, _u32p, _u8p]
@@ -145,6 +148,40 @@ def doc_key_len_native(key: bytes) -> int:
     return int(lib.rs_doc_key_len(_u8ptr(key), ctypes.c_int32(len(key))))
 
 
+class _GetBufs(threading.local):
+    """Per-thread reusable out-buffers for multi_get: concurrent server
+    threads still run the GIL-releasing native lookup truly in parallel
+    (each thread owns its buffers), without paying a 64K allocation +
+    three ctypes object constructions per point read."""
+
+    def __init__(self):
+        self.cap = 65536
+        self.val = ctypes.create_string_buffer(self.cap)
+        self.vptr = ctypes.cast(self.val, _u8p)
+        self.ht = ctypes.c_uint64()
+        self.wid = ctypes.c_uint32()
+        self.fl = ctypes.c_uint8()
+        self.ht_ref = ctypes.byref(self.ht)
+        self.wid_ref = ctypes.byref(self.wid)
+        self.fl_ref = ctypes.byref(self.fl)
+
+    _DEFAULT_CAP = 65536
+
+    def grow(self, need: int) -> None:
+        self.cap = max(need, self._DEFAULT_CAP)
+        self.val = ctypes.create_string_buffer(self.cap)
+        self.vptr = ctypes.cast(self.val, _u8p)
+
+    def shrink(self) -> None:
+        """Drop back to the default scratch size after an oversized value:
+        a rare multi-MB read must not pin MBs per server thread forever."""
+        if self.cap > self._DEFAULT_CAP:
+            self.grow(self._DEFAULT_CAP)
+
+
+_get_bufs = _GetBufs()
+
+
 class ReaderSet:
     """A frozen set of native readers, pre-marshalled for per-call reuse."""
 
@@ -154,29 +191,38 @@ class ReaderSet:
         n = len(self.readers)
         self._arr = (ctypes.c_void_p * n)(*[r.handle for r in self.readers])
         self.n = n
+        self._mg = self._lib.rs_multi_get
 
-    def multi_get(self, key: bytes, dkl: int, read_ht: int,
-                  _cap: int = 65536) -> Optional[Tuple[int, int, int, bytes]]:
-        """(ht, wid, flags, value) of the newest visible version, or None.
-        Out-buffers are per-call so concurrent server threads run the
-        GIL-releasing native lookup truly in parallel."""
-        val = np.empty(_cap, dtype=np.uint8)
-        ht = ctypes.c_uint64()
-        wid = ctypes.c_uint32()
-        fl = ctypes.c_uint8()
-        n = int(self._lib.rs_multi_get(
-            self._arr, self.n, _u8ptr(key), ctypes.c_int32(len(key)),
-            ctypes.c_int32(dkl), ctypes.c_uint64(read_ht),
-            val.ctypes.data_as(_u8p), ctypes.c_int64(_cap),
-            ctypes.byref(ht), ctypes.byref(wid), ctypes.byref(fl)))
+    def multi_get(self, key: bytes, dkl: int, read_ht: int
+                  ) -> Optional[Tuple[int, int, int, bytes]]:
+        """(ht, wid, flags, value) of the newest visible version, or None."""
+        b = _get_bufs
+        n = self._mg(self._arr, self.n, key, len(key), dkl, read_ht,
+                     b.vptr, b.cap, b.ht_ref, b.wid_ref, b.fl_ref)
+        if n > b.cap:  # value larger than the buffer: grow, retry, shrink
+            b.grow(n)
+            try:
+                n = self._mg(self._arr, self.n, key, len(key), dkl, read_ht,
+                             b.vptr, b.cap, b.ht_ref, b.wid_ref, b.fl_ref)
+                if n == -2:
+                    raise RuntimeError(
+                        "native point get: block corruption: "
+                        + "; ".join(self.errors()))
+                if n < 0 or n > b.cap:
+                    # the rset is frozen: the same key cannot change size
+                    raise RuntimeError(
+                        "native point get: unstable value size")
+                return b.ht.value, b.wid.value, b.fl.value, \
+                    ctypes.string_at(b.val, n)
+            finally:
+                b.shrink()
         if n == -2:
             raise RuntimeError("native point get: block corruption: "
                                + "; ".join(self.errors()))
         if n < 0:
             return None
-        if n > _cap:  # value larger than the buffer: retry exact-sized
-            return self.multi_get(key, dkl, read_ht, _cap=n)
-        return ht.value, wid.value, fl.value, val[:n].tobytes()
+        return b.ht.value, b.wid.value, b.fl.value, \
+            ctypes.string_at(b.val, n)
 
     def errors(self) -> List[str]:
         out = []

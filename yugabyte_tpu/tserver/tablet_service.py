@@ -14,7 +14,8 @@ from typing import List, Optional
 from yugabyte_tpu.common.hybrid_time import HybridTime
 from yugabyte_tpu.common.wire import (
     doc_key_from_wire, row_to_wire, write_op_from_wire)
-from yugabyte_tpu.consensus.raft import NotLeader, OperationOutcomeUnknown
+from yugabyte_tpu.consensus.raft import (NotLeader, OperationOutcomeUnknown,
+                                         ReplicationAborted)
 from yugabyte_tpu.tserver.ts_tablet_manager import TSTabletManager
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 
@@ -126,6 +127,12 @@ class TabletServiceImpl:
             raise err from e
         except OperationOutcomeUnknown as e:
             raise StatusError(Status.TimedOut(str(e))) from e
+        except ReplicationAborted as e:
+            # The op provably did NOT commit — its entry was overwritten by
+            # a new leader's history. Safe to retry verbatim; the client's
+            # retry loop re-resolves the (changed) leader. ref: the
+            # reference maps this to a retryable Aborted in WriteQuery.
+            raise StatusError(Status.Aborted(str(e))) from e
         return {"propagated_ht": ht.value}
 
     # ----------------------------------------------------------------- reads
