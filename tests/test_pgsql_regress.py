@@ -594,3 +594,74 @@ class TestMultiGroupBy:
                                 "HAVING region = $1", ["eu"])
         assert [c[0] for c in r.columns] == ["item", "sum"]
         assert [tuple(x) for x in r.rows] == [("a", "5"), ("b", "1")]
+
+
+class TestPgTypeBreadth:
+    """TIMESTAMP/DATE/NUMERIC/UUID surface (ref: src/postgres pg_type.h,
+    timestamp_in/timestamptz_in): timestamps store epoch micros and render
+    PG text; DATE/TIME/UUID ride ISO/canonical text; NUMERIC approximates
+    as binary double (documented deviation)."""
+
+    @pytest.fixture(scope="class", autouse=True)
+    def events(self, conn):
+        conn.query("CREATE TABLE events (eid INT PRIMARY KEY, "
+                   "at TIMESTAMP, day DATE, amount NUMERIC(8,2), "
+                   "tag UUID, note VARCHAR(40))")
+        conn.query("INSERT INTO events (eid, at, day, amount, tag, note) "
+                   "VALUES (1, '2026-07-30 12:00:00', '2026-07-30', 10, "
+                   "'aaaaaaaa-bbbb-cccc-dddd-eeeeeeeeffff', 'first'), "
+                   "(2, '2026-07-31 08:30:15.25', '2026-07-31', 2.5, "
+                   "'11111111-2222-3333-4444-555555556666', 'second'), "
+                   "(3, '2025-12-31 23:59:59', '2025-12-31', 99, "
+                   "'99999999-0000-0000-0000-000000000000', NULL)")
+
+    def test_timestamp_text_round_trip(self, conn):
+        assert rows(conn, "SELECT at FROM events WHERE eid = 1") == \
+            [("2026-07-30 12:00:00",)]
+        # fractional seconds survive (micros storage, trailing zeros cut)
+        assert rows(conn, "SELECT at FROM events WHERE eid = 2") == \
+            [("2026-07-31 08:30:15.25",)]
+
+    def test_timestamp_range_predicates_and_order(self, conn):
+        assert rows(conn, "SELECT eid FROM events "
+                          "WHERE at > '2026-07-31' ORDER BY eid") == [("2",)]
+        assert rows(conn, "SELECT eid FROM events "
+                          "WHERE at BETWEEN '2026-01-01' AND "
+                          "'2026-07-30 23:00' ORDER BY eid") == [("1",)]
+        assert rows(conn, "SELECT eid FROM events ORDER BY at") == \
+            [("3",), ("1",), ("2",)]
+
+    def test_timestamp_update_and_aggregate(self, conn):
+        conn.query("UPDATE events SET at = '2027-01-01 00:00:01' "
+                   "WHERE eid = 3")
+        assert rows(conn, "SELECT at FROM events WHERE eid = 3") == \
+            [("2027-01-01 00:00:01",)]
+        assert rows(conn, "SELECT MAX(at) FROM events") == \
+            [("2027-01-01 00:00:01",)]
+        conn.query("UPDATE events SET at = '2025-12-31 23:59:59' "
+                   "WHERE eid = 3")
+
+    def test_bad_timestamp_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO events (eid, at) VALUES "
+                       "(9, 'not-a-date')")
+
+    def test_date_and_uuid_text_semantics(self, conn):
+        assert rows(conn, "SELECT eid FROM events "
+                          "WHERE day >= '2026-07-31'") == [("2",)]
+        assert rows(conn, "SELECT eid FROM events WHERE tag = "
+                          "'11111111-2222-3333-4444-555555556666'") == \
+            [("2",)]
+
+    def test_numeric_as_double(self, conn):
+        # int literal coerces to double on a NUMERIC column
+        assert rows(conn, "SELECT amount FROM events WHERE eid = 1") == \
+            [("10.0",)]
+        assert rows(conn, "SELECT SUM(amount) FROM events "
+                          "WHERE eid < 3") == [("12.5",)]
+
+    def test_extended_protocol_timestamp_param(self, conn):
+        r = conn.extended_query(
+            "SELECT eid FROM events WHERE at = $1",
+            ["2026-07-31 08:30:15.25"])
+        assert [tuple(x) for x in r.rows] == [("2",)]

@@ -83,8 +83,12 @@ class LocalTransport:
             if (src in down or dst in down
                     or src_srv in down or dst_srv in down):
                 raise PeerUnreachable(f"{src}->{dst}: peer down")
-            if (src, dst) in self._partitions or \
-                    (src_srv, dst_srv) in self._partitions:
+            parts = self._partitions
+            if ((src, dst) in parts or (src_srv, dst_srv) in parts
+                    or (src, dst_srv) in parts or (src_srv, dst) in parts):
+                # mixed-form entries (one bare server, one full id) match
+                # too — a stored pair that can never fire would silently
+                # un-partition the link
                 raise PeerUnreachable(f"{src}->{dst}: partitioned")
             if self._drop_probability and \
                     self._rng.random() < self._drop_probability:

@@ -11,6 +11,7 @@ the ybgate-pushdown role) and page across tablets via the client library.
 
 from __future__ import annotations
 
+import datetime
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -30,8 +31,57 @@ from yugabyte_tpu.yql.pgsql import parser as P
 PG_OIDS = {
     DataType.INT64: 20, DataType.INT32: 23, DataType.DOUBLE: 701,
     DataType.FLOAT: 700, DataType.STRING: 25, DataType.BOOL: 16,
-    DataType.BINARY: 17, DataType.TIMESTAMP: 1184,
+    # 1114 = timestamp WITHOUT time zone: matches the offset-less text
+    # pg_micros_text emits (1184/timestamptz clients would expect '+00')
+    DataType.BINARY: 17, DataType.TIMESTAMP: 1114,
 }
+
+_EPOCH = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+def pg_timestamp_micros(text: str) -> int:
+    """'YYYY-MM-DD[ HH:MM[:SS[.ffffff]]][+HH[:MM]]' -> epoch micros.
+    Timezone-less input is read as UTC (the session default; the reference
+    stores timestamptz normalized to UTC, ref src/postgres timestamptz_in)."""
+    try:
+        dt = datetime.datetime.fromisoformat(text.strip())
+    except ValueError:
+        raise PgError(Status.InvalidArgument(
+            f'invalid input syntax for type timestamp: "{text}"'), "22007")
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return int((dt - _EPOCH) / datetime.timedelta(microseconds=1))
+
+
+def pg_micros_text(micros: int) -> str:
+    """Epoch micros -> PG text output ('YYYY-MM-DD HH:MM:SS[.ffffff]')."""
+    dt = _EPOCH + datetime.timedelta(microseconds=micros)
+    out = dt.strftime("%Y-%m-%d %H:%M:%S")
+    if dt.microsecond:
+        out += f".{dt.microsecond:06d}".rstrip("0")
+    return out
+
+
+def pg_coerce(col_type: Optional[DataType], v: object) -> object:
+    """Coerce a literal to the column's storage type at the statement
+    boundary (the ybgate equivalent of PG's input-function coercion):
+    timestamp text -> epoch micros, int literal -> double for NUMERIC/
+    DOUBLE columns, integral float -> int for bigint columns."""
+    if v is None or col_type is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        if len(v) == 2 and v[0] == "__expr__":  # expression sentinel
+            return v
+        return type(v)(pg_coerce(col_type, x) for x in v)
+    if col_type == DataType.TIMESTAMP and isinstance(v, str):
+        return pg_timestamp_micros(v)
+    if col_type == DataType.DOUBLE and isinstance(v, int) \
+            and not isinstance(v, bool):
+        return float(v)
+    if col_type in (DataType.INT64, DataType.INT32) \
+            and isinstance(v, float) and v.is_integer():
+        return int(v)
+    return v
 
 
 class PgResult:
@@ -511,6 +561,12 @@ class PgSession:
                     "INSERT has more expressions than target columns"),
                     "42601")
             bound = dict(zip(columns, row))
+            for c in list(bound):
+                try:
+                    bound[c] = pg_coerce(schema.column(c).type, bound[c])
+                except KeyError:
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{c}" does not exist'), "42703")
             missing = [k for k in key_names if k not in bound]
             if missing:
                 raise PgError(Status.InvalidArgument(
@@ -670,6 +726,7 @@ class PgSession:
         and are re-checked against the fetched row, so contradictory
         conjunctions correctly return nothing."""
         schema = table.schema
+        where = self._coerce_where(schema, where)
         key_names = [c.name for c in schema.hash_columns] + \
             [c.name for c in schema.range_columns]
         eq: Dict[str, object] = {}
@@ -687,6 +744,19 @@ class PgSession:
             residual = [f for i, f in enumerate(where) if i not in consumed]
             return dk, residual
         return None, list(where)
+
+    @staticmethod
+    def _coerce_where(schema, where):
+        """Coerce WHERE literals to each referenced column's storage type
+        (timestamp text -> micros, ...); unknown columns pass through."""
+        out = []
+        for c, op, v in where:
+            try:
+                t = schema.column(c).type
+            except KeyError:
+                t = None
+            out.append((c, op, pg_coerce(t, v)))
+        return out
 
     def _select_row_dicts(self, stmt: P.Select, table) -> List[dict]:
         """Materialize the matching rows as dicts (all columns)."""
@@ -1594,6 +1664,12 @@ class PgSession:
         plain = {c: v for c, v in stmt.assignments
                  if not (isinstance(v, tuple) and len(v) == 2
                          and v[0] == "__expr__")}
+        for c in list(plain):
+            try:
+                plain[c] = pg_coerce(schema.column(c).type, plain[c])
+            except KeyError:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
         if exprs:
             # SET col = <expression over the row>: read-modify-write under
             # the statement transaction (PG evaluates the RHS against the
@@ -1636,7 +1712,7 @@ class PgSession:
             # point update, no indexes: the single-shard fast path is
             # already atomic
             self._write(table, [QLWriteOp(WriteOpKind.UPDATE, dk,
-                                          dict(stmt.assignments))])
+                                          dict(plain))])
             return PgResult("UPDATE 1")
 
         def body(txn):
@@ -1645,7 +1721,7 @@ class PgSession:
                 IM.txn_write_with_indexes(
                     txn, table,
                     QLWriteOp(WriteOpKind.UPDATE, k,
-                              dict(stmt.assignments)), self._table)
+                              dict(plain)), self._table)
             return len(keys)
 
         n = self._run_statement_txn(body)

@@ -27,6 +27,15 @@ PG_TYPES = {
     "FLOAT": "DOUBLE",
     "BOOLEAN": "BOOL", "BOOL": "BOOL",
     "BYTEA": "BINARY",
+    # timestamps store epoch micros (the CQL layer's convention); literal
+    # strings coerce at the executor boundary (executor.pg_coerce)
+    "TIMESTAMP": "TIMESTAMP", "TIMESTAMPTZ": "TIMESTAMP",
+    # DATE/TIME/UUID ride STRING: ISO-8601 text at fixed width sorts
+    # chronologically, so range predicates and ORDER BY behave
+    "DATE": "STRING", "TIME": "STRING", "UUID": "STRING",
+    # NUMERIC/DECIMAL approximate as binary double (documented deviation
+    # from PG's arbitrary precision; matches the framework value layer)
+    "NUMERIC": "DOUBLE", "DECIMAL": "DOUBLE",
 }
 
 
@@ -348,6 +357,16 @@ class PgParser(_BaseParser):
         if t in ("VARCHAR", "CHAR") and self.accept_op("("):
             self.literal()
             self.expect_op(")")
+        if t in ("NUMERIC", "DECIMAL") and self.accept_op("("):
+            self.literal()               # precision (ignored: -> DOUBLE)
+            if self.accept_op(","):
+                self.literal()           # scale
+            self.expect_op(")")
+        if t in ("TIMESTAMP", "TIME"):
+            # TIMESTAMP/TIME [WITH|WITHOUT TIME ZONE]
+            if self.accept_kw("WITH") or self.accept_kw("WITHOUT"):
+                self.expect_kw("TIME")
+                self.expect_kw("ZONE")
         if t not in PG_TYPES:
             raise ParseError(f"unsupported type {t}")
         return PG_TYPES[t]

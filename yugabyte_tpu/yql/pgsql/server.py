@@ -26,7 +26,7 @@ from yugabyte_tpu.common.schema import DataType
 from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.yql.pgsql.executor import (PgError, PgResult, PgSession,
-                                             _pg_error)
+                                             _pg_error, pg_micros_text)
 
 PROTOCOL_V3 = 196608          # 3.0
 SSL_REQUEST_CODE = 80877103
@@ -101,7 +101,7 @@ def _decode_param(raw: Optional[bytes], fmt: int,
     return text
 
 
-def _encode_text(v: object) -> Optional[bytes]:
+def _encode_text(v: object, oid: Optional[int] = None) -> Optional[bytes]:
     """PG text-format value encoding."""
     if v is None:
         return None
@@ -111,6 +111,9 @@ def _encode_text(v: object) -> Optional[bytes]:
         return b"\\x" + v.hex().encode()
     if isinstance(v, float):
         return repr(v).encode()
+    if oid in (1114, 1184) and isinstance(v, int):
+        # timestamp columns store epoch micros; clients read date text
+        return pg_micros_text(v).encode()
     return str(v).encode("utf-8")
 
 
@@ -197,19 +200,24 @@ class _Conn:
                   + b"\x00")
         self._send(b"E", fields)
 
-    def _send_one_row(self, row) -> None:
+    def _send_one_row(self, row, oids=None) -> None:
         body = struct.pack(">H", len(row))
-        for v in row:
-            enc = _encode_text(v)
+        for i, v in enumerate(row):
+            enc = _encode_text(v, oids[i] if oids else None)
             if enc is None:
                 body += struct.pack(">i", -1)
             else:
                 body += struct.pack(">I", len(enc)) + enc
         self._send(b"D", body)
 
+    @staticmethod
+    def _result_oids(r: PgResult):
+        return [oid for _n, oid in r.columns] if r.columns else None
+
     def _send_data_rows(self, r: PgResult) -> None:
+        oids = self._result_oids(r)
         for row in r.rows:
-            self._send_one_row(row)
+            self._send_one_row(row, oids)
 
     def _send_result(self, r: PgResult) -> None:
         if r.columns is not None:
@@ -390,6 +398,7 @@ class _Conn:
             it = result.row_iter if result.row_iter is not None \
                 else iter(result.rows)
             state["iter"] = it
+            state["oids"] = self._result_oids(result)
             state["count"] = 0
             state["select"] = result.tag.startswith("SELECT")
             state["tag"] = result.tag
@@ -403,7 +412,7 @@ class _Conn:
                 except StopIteration:
                     done = True
                     break
-                self._send_one_row(row)
+                self._send_one_row(row, state.get("oids"))
                 sent += 1
         except PgError:
             state["iter"] = None
@@ -452,6 +461,11 @@ class _Conn:
             self._send_error(e.sqlstate, e.status.message)
         except StatusError as e:
             self._send_error("XX000", e.status.message)
+        except (ConnectionError, OSError):
+            raise  # socket gone: nothing to report to the client
+        except Exception as e:  # noqa: BLE001 — a statement bug must fail
+            # THE QUERY, not the connection (PG reports XX000 and stays up)
+            self._send_error("XX000", f"{type(e).__name__}: {e}")
         self._send_ready()
 
 
