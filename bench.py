@@ -347,8 +347,23 @@ def run_device_child(platform: str, workload_path: str,
         f"(single call incl. link latency: {single_s:.3f}s)")
     pipe_s = t8 / 8
     log(f"  pipelined: {pipe_s:.3f}s/job = {n_total/pipe_s/1e6:.2f}M rows/s")
+    # host<->device link round-trip: a 4-byte transfer is pure latency.
+    # Reported so the e2e number is interpretable: every decision download
+    # pays this per round-trip on the tunnel-attached rig, a cost a
+    # co-located production TPU host would not pay.
+    rtts = []
+    for i in range(3):
+        # a FRESH device array per probe: jax caches the host copy on
+        # the array object, so re-reading one array is a cache hit
+        tiny = jax.device_put(np.full(1, i, dtype=np.uint8), dev)
+        jax.block_until_ready(tiny)
+        t0 = time.time()
+        np.asarray(tiny)
+        rtts.append(time.time() - t0)
+    link_rtt_s = sorted(rtts)[1]
+    log(f"  link round-trip (4B D2H): {link_rtt_s*1e3:.0f}ms")
     stages.put(stage="device_resident", sustained_s=res_s, single_s=single_s,
-               pipelined_s=pipe_s)
+               pipelined_s=pipe_s, link_rtt_s=link_rtt_s)
 
     # ---- e2e disk->disk: device decisions + native C++ byte shell --------
     # Runs BEFORE the snapshot-scan stage: this is the flagship number, and
@@ -470,6 +485,7 @@ def run_device_child(platform: str, workload_path: str,
         "device_resident_rows_per_sec": round(n_total / res_s, 1),
         "device_single_call_rows_per_sec": round(n_total / single_s, 1),
         "pipelined_rows_per_sec": round(n_total / pipe_s, 1),
+        "link_roundtrip_ms": round(link_rtt_s * 1e3, 1),
         "scan_rows_per_sec": round(scan_n / scan_s, 1),
         "e2e_steady_rows_per_sec": round(e2e_steady, 1),
         "e2e_cold_rows_per_sec": round(e2e_cold, 1),
@@ -825,6 +841,9 @@ def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
         "device_resident_rows_per_sec": round(n_total / res_s, 1),
         "n_rows": n_total,
     }
+    if "link_rtt_s" in recs.get("device_resident", {}):
+        out["link_roundtrip_ms"] = round(
+            recs["device_resident"]["link_rtt_s"] * 1e3, 1)
     if "cold" in recs:
         out["cold_rows_per_sec"] = round(n_total / recs["cold"]["cold_s"], 1)
         out["compile_s"] = round(recs["cold"]["compile_s"], 1)

@@ -665,3 +665,17 @@ class TestPgTypeBreadth:
             "SELECT eid FROM events WHERE at = $1",
             ["2026-07-31 08:30:15.25"])
         assert [tuple(x) for x in r.rows] == [("2",)]
+
+    def test_having_timestamp_and_precision_ddl(self, conn):
+        # HAVING against MAX of a timestamp column coerces the literal
+        assert rows(conn, "SELECT note, MAX(at) FROM events "
+                          "WHERE note IS NOT NULL GROUP BY note "
+                          "HAVING MAX(at) > '2026-07-31'") == \
+            [("second", "2026-07-31 08:30:15.25")]
+        # TIMESTAMP(p) precision DDL parses (PG/ORM-generated form)
+        conn.query("CREATE TABLE tsp (i INT PRIMARY KEY, x TIMESTAMP(6), "
+                   "y TIME(3))")
+        conn.query("INSERT INTO tsp VALUES (1, '2026-01-02 03:04:05', "
+                   "'03:04:05')")
+        assert rows(conn, "SELECT x FROM tsp WHERE i = 1") == \
+            [("2026-01-02 03:04:05",)]

@@ -343,7 +343,8 @@ def _interpret_mode() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def launch_merge_gc_pallas(staged, params: GCParams, snapshot: bool = False):
+def launch_merge_gc_pallas(staged, params: GCParams, snapshot: bool = False,
+                           host_async: bool = True):
     """Drop-in for run_merge.launch_merge_gc using the pallas tournament."""
     from yugabyte_tpu.ops.run_merge import MergeGCHandle
     cutoff = params.history_cutoff_ht
@@ -360,4 +361,5 @@ def launch_merge_gc_pallas(staged, params: GCParams, snapshot: bool = False):
         is_major=params.is_major_compaction,
         retain_deletes=params.retain_deletes, snapshot=snapshot,
         interpret=_interpret_mode())
-    return MergeGCHandle(packed, staged, perm, keep, mk)
+    return MergeGCHandle(packed, staged, perm, keep, mk,
+                         host_async=host_async)

@@ -304,17 +304,23 @@ class MergeGCHandle:
     """
 
     def __init__(self, packed_dev, staged: StagedRuns,
-                 perm_dev=None, keep_dev=None, mk_dev=None):
+                 perm_dev=None, keep_dev=None, mk_dev=None,
+                 host_async: bool = True):
         self._packed_dev = packed_dev
         self._staged = staged
+        self._result = None
         # device-resident merge products for zero-transfer output staging
         self._perm_dev = perm_dev
         self._keep_dev = keep_dev
         self._mk_dev = mk_dev
-        try:
-            packed_dev.copy_to_host_async()
-        except (AttributeError, NotImplementedError):
-            pass  # backend without async D2H; result() falls back to sync
+        if host_async:
+            try:
+                packed_dev.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass  # no async D2H; result() falls back to sync
+        # (a chunked parent fuses every chunk's packed buffer into ONE
+        # device concat + download instead of calling result() per chunk —
+        # each separate np.asarray pays a full tunnel round-trip)
 
     def result(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(perm, keep, make_tombstone) host arrays over the merged order.
@@ -323,31 +329,38 @@ class MergeGCHandle:
         (padding excluded): merged position i came from input row perm[i].
         Arrays cover exactly the real rows (length n = sum(run_ns)).
         """
-        staged = self._staged
-        packed = np.asarray(self._packed_dev)     # [n_pad//32, 2+b]
-        n, n_pad = staged.n, staged.n_pad
-        n_grp = (n + 31) // 32
-        grp = packed[:n_grp]
-        keep = _unpack_words(grp[:, 0], n)
-        mk = _unpack_words(grp[:, 1], n)
-        if staged.k_pad == 1:
-            perm = np.arange(n, dtype=np.int64)
-            return perm, keep, mk
-        b = max(1, (staged.k_pad - 1).bit_length())
-        src = np.zeros(n, dtype=np.uint32)
-        for t in range(b):
-            src |= _unpack_words(grp[:, 2 + t], n).astype(np.uint32) << t
-        # reconstruct the permutation: the merge consumes each run in order,
-        # so output position i with source run r maps to the next unconsumed
-        # row of r. Padding sorts after every real key, so positions [0, n)
-        # are exactly the real rows.
-        perm = np.zeros(n, dtype=np.int64)
-        base = np.concatenate(([0], np.cumsum(staged.run_ns)))
-        for r_i in range(len(staged.run_ns)):
-            sel = src == r_i
-            cnt = int(sel.sum())
-            perm[sel] = base[r_i] + np.arange(cnt, dtype=np.int64)
-        return perm, keep, mk
+        if self._result is None:
+            packed = np.asarray(self._packed_dev)  # [n_pad//32, 2+b]
+            self._result = _decode_packed(packed, self._staged)
+        return self._result
+
+
+def _decode_packed(packed: np.ndarray, staged: StagedRuns
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host decode of one launch's packed decision words -> (perm, keep,
+    make_tombstone) over the merged order (see MergeGCHandle.result)."""
+    n = staged.n
+    n_grp = (n + 31) // 32
+    grp = packed[:n_grp]
+    keep = _unpack_words(grp[:, 0], n)
+    mk = _unpack_words(grp[:, 1], n)
+    if staged.k_pad == 1:
+        return np.arange(n, dtype=np.int64), keep, mk
+    b = max(1, (staged.k_pad - 1).bit_length())
+    src = np.zeros(n, dtype=np.uint32)
+    for t in range(b):
+        src |= _unpack_words(grp[:, 2 + t], n).astype(np.uint32) << t
+    # reconstruct the permutation: the merge consumes each run in order,
+    # so output position i with source run r maps to the next unconsumed
+    # row of r. Padding sorts after every real key, so positions [0, n)
+    # are exactly the real rows.
+    perm = np.zeros(n, dtype=np.int64)
+    base = np.concatenate(([0], np.cumsum(staged.run_ns)))
+    for r_i in range(len(staged.run_ns)):
+        sel = src == r_i
+        cnt = int(sel.sum())
+        perm[sel] = base[r_i] + np.arange(cnt, dtype=np.int64)
+    return perm, keep, mk
 
 
 def _unpack_words(words: np.ndarray, n: int) -> np.ndarray:
@@ -565,6 +578,32 @@ class _ChunkedMergeGCHandle:
         self._keep_dev = None
         self._mk_dev = None
 
+    def _chunk_results(self):
+        """Per-chunk (perm, keep, mk) host tuples — via ONE fused device
+        concat + host transfer of every chunk's packed decisions (each
+        separate np.asarray pays a full tunnel round trip: ~0.15s x
+        chunks x jobs dominated the e2e steady profile). Any failure
+        degrades to the per-chunk path, which preserves the pallas ->
+        network fallback semantics."""
+        hs = self._handles
+        if os.environ.get("YBTPU_FUSED_DOWNLOAD", "1") == "0":
+            return [h.result() for h in hs]
+        try:
+            devs = [h._packed_dev for h in hs]
+            if len({d.shape[1] for d in devs}) == 1:
+                rows = [d.shape[0] for d in devs]
+                cat = np.asarray(jnp.concatenate(devs, axis=0))
+                out, off = [], 0
+                for h, r in zip(hs, rows):
+                    out.append(_decode_packed(cat[off:off + r], h._staged))
+                    off += r
+                return out
+        except Exception as e:  # noqa: BLE001 — degrade, never fail here
+            import sys as _sys
+            print(f"[run_merge] fused chunk download failed — using the "
+                  f"per-chunk path: {e!r}", file=_sys.stderr, flush=True)
+        return [h.result() for h in hs]
+
     def result(self):
         if self._result is not None:
             return self._result
@@ -572,8 +611,8 @@ class _ChunkedMergeGCHandle:
         k_live = len(staged.run_ns)
         grb = np.concatenate(([0], np.cumsum(staged.run_ns)))
         perms, keeps, mks = [], [], []
-        for h, (starts, lens) in zip(self._handles, self._metas):
-            p, keep, mk = h.result()
+        for (p, keep, mk), (starts, lens) in zip(self._chunk_results(),
+                                                 self._metas):
             lb = np.concatenate(([0], np.cumsum(lens)))
             run_of = np.searchsorted(lb[1:], p, side="right")
             perms.append(p - lb[run_of] + grb[:k_live][run_of]
@@ -669,7 +708,11 @@ def _launch_chunked(staged: StagedRuns, params: GCParams, snapshot: bool,
         sub = StagedRuns(carved, m_c, k_pad, w,
                          [int(x) for x in lens[:k_live]],
                          staged.cmp_rows, staged.n_cmp)
-        handles.append(launch_merge_gc(sub, params, snapshot=snapshot))
+        # host_async=False: the parent handle fuses all chunks' packed
+        # buffers into one concat + download; per-chunk async D2H would
+        # move the same bytes twice over the tunnel
+        handles.append(launch_merge_gc(sub, params, snapshot=snapshot,
+                                       host_async=False))
         metas.append((starts[:k_live].astype(np.int64),
                       lens[:k_live].astype(np.int64)))
     if not handles:
@@ -785,7 +828,8 @@ class _PallasFallbackHandle:
 
 
 def launch_merge_gc(staged: StagedRuns, params: GCParams,
-                    snapshot: bool = False) -> MergeGCHandle:
+                    snapshot: bool = False,
+                    host_async: bool = True) -> MergeGCHandle:
     global _pallas_broken
     target = _chunk_target_rows()
     if (target and staged.k_pad >= 2 and staged.n_pad > target
@@ -800,7 +844,8 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
         from yugabyte_tpu.ops import pallas_merge
         try:
             h = pallas_merge.launch_merge_gc_pallas(staged, params,
-                                                    snapshot=snapshot)
+                                                    snapshot=snapshot,
+                                                    host_async=host_async)
         except Exception as e:  # noqa: BLE001 — trace/compile failure
             if explicit:
                 raise
@@ -824,7 +869,8 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
         k_pad=staged.k_pad, m=staged.m, w=staged.w, n_cmp=staged.n_cmp,
         is_major=params.is_major_compaction,
         retain_deletes=params.retain_deletes, snapshot=snapshot)
-    return MergeGCHandle(packed, staged, perm, keep, mk)
+    return MergeGCHandle(packed, staged, perm, keep, mk,
+                         host_async=host_async)
 
 
 def merge_and_gc_runs(slabs: Sequence[KVSlab], params: GCParams, device=None,

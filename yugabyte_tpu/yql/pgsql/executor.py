@@ -893,13 +893,30 @@ class PgSession:
                 return (0,) + tuple((v is None, 0 if v is None else v)
                                     for v in k)
             return (0, k)
+        # HAVING literals coerce against the referenced column's storage
+        # type (MIN/MAX keep the column type; COUNT/SUM/AVG are numeric)
+        having = []
+        for item, op, want in stmt.having:
+            ref_col = None
+            if item[0] == "agg" and str(item[1]).upper() in ("MAX", "MIN"):
+                ref_col = item[2]
+            elif item[0] == "col":
+                ref_col = item[1]
+            t = None
+            if ref_col and ref_col != "*":
+                try:
+                    if col_oid(ref_col) in (1114, 1184):
+                        t = DataType.TIMESTAMP
+                except (KeyError, PgError):
+                    pass
+            having.append((item, op, pg_coerce(t, want)))
         for key in sorted(groups, key=_gk):
             members = groups[key]
             # HAVING gates the group BEFORE projection (ref: PG executor
             # nodeAgg qual evaluation); having-only aggregates are
             # computed here and never emitted
             ok = True
-            for item, op, want in stmt.having:
+            for item, op, want in having:
                 if item[0] == "agg":
                     got = agg_value(item[1], item[2], members)
                 else:

@@ -363,7 +363,10 @@ class PgParser(_BaseParser):
                 self.literal()           # scale
             self.expect_op(")")
         if t in ("TIMESTAMP", "TIME"):
-            # TIMESTAMP/TIME [WITH|WITHOUT TIME ZONE]
+            # TIMESTAMP/TIME [(p)] [WITH|WITHOUT TIME ZONE]
+            if self.accept_op("("):
+                self.literal()           # precision (micros regardless)
+                self.expect_op(")")
             if self.accept_kw("WITH") or self.accept_kw("WITHOUT"):
                 self.expect_kw("TIME")
                 self.expect_kw("ZONE")
