@@ -606,18 +606,36 @@ def _scan_point_stages(n_rows: int) -> dict:
         per_flush = n // 4
         for f in range(4):
             base = f * per_flush
-            items = [(b"Suser%08d\x00\x00!" % (base + i),
-                      DocHybridTime(
-                          HybridTime.from_micros(1000 + base + i), 0),
-                      value)
-                     for i in range(per_flush)]
-            db.write_batch(items, op_id=(1, f + 1))
+            # columnar bulk write: the batched-RPC apply / bulk-load shape
+            # (native memtable arena, native/memtable_arena.cc — ref
+            # db/memtable.cc Add)
+            keys = [b"Suser%08d\x00\x00!" % (base + i)
+                    for i in range(per_flush)]
+            ht = ((np.arange(per_flush, dtype=np.uint64)
+                   + np.uint64(1000 + base)) << np.uint64(12))
+            wid = np.zeros(per_flush, dtype=np.uint32)
+            db.write_batch_columns(keys, ht, wid, [value] * per_flush,
+                                   op_id=(1, f + 1))
             db.flush()
         load_s = time.time() - t0
         out["load_rows_per_sec"] = round(n / load_s, 1)
-        log(f"  scan-stage load (write_batch + native flush): {n} rows in "
-            f"{load_s:.1f}s = {n/load_s/1e3:.0f}K rows/s "
+        log(f"  scan-stage load (columnar write_batch + native flush): "
+            f"{n} rows in {load_s:.1f}s = {n/load_s/1e3:.0f}K rows/s "
             f"({len(db.versions.live_files())} SSTs)")
+        # secondary: the per-row tuple write path (replication apply shape)
+        tup_dir = os.path.join(workdir, "tup")
+        db_t = DB(tup_dir, DBOptions(device="native", auto_compact=False))
+        nt = min(n, 1 << 18)
+        t0 = time.time()
+        items = [(b"Suser%08d\x00\x00!" % i,
+                  DocHybridTime(HybridTime.from_micros(1000 + i), 0), value)
+                 for i in range(nt)]
+        db_t.write_batch(items, op_id=(1, 1))
+        db_t.flush()
+        out["load_tuple_rows_per_sec"] = round(nt / (time.time() - t0), 1)
+        log(f"  tuple write path: {out['load_tuple_rows_per_sec']/1e3:.0f}K "
+            f"rows/s")
+        db_t.close()
 
         # ---- bulk ingest (the reference's bulk-load / SST-ingestion path,
         # ref src/yb/tools/yb_bulk_load.cc): packed arrays -> native encode

@@ -1,0 +1,192 @@
+// Native memtable arena: the write-path hot structure in C++ (ref:
+// src/yb/rocksdb/db/memtable.cc — skiplist + concurrent arena; here an
+// append-only arena + sort-on-demand index, the same amortized shape the
+// Python MemTable used, at memcpy speed).
+//
+// Entries are stored as FULL internal keys (prefix + kHybridTime byte +
+// 12-byte descending-encoded DocHybridTime) so ordering is a plain
+// memcmp and export strips the fixed-width suffix. Duplicate internal
+// keys keep the LATEST insert (Python-dict overwrite semantics).
+//
+// C ABI only (ctypes binding in storage/memtable.py); one writer or
+// reader at a time — the Python wrapper holds its own lock.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kSuffix = 13;  // 0x23 separator + 12B encoded DocHybridTime
+
+struct Entry {
+  int64_t off;   // into keys arena (full internal key)
+  int32_t len;   // internal key length (incl. suffix)
+  int64_t voff;  // into vals arena
+  int32_t vlen;
+  int64_t seq;   // insertion sequence; latest wins on duplicate ikey
+};
+
+struct MT {
+  std::vector<uint8_t> keys;   // internal-key arena
+  std::vector<uint8_t> vals;   // value arena
+  std::vector<Entry> ents;     // insertion order
+  std::vector<int32_t> order;  // sorted+deduped index into ents
+  bool sorted = true;          // order valid for current ents
+  int64_t bytes = 0;           // approximate accounting (ikey+val lens)
+  std::string err;
+};
+
+inline int cmp_ikey(const MT* m, const Entry& a, const Entry& b) {
+  int32_t n = a.len < b.len ? a.len : b.len;
+  int c = memcmp(m->keys.data() + a.off, m->keys.data() + b.off, (size_t)n);
+  if (c) return c;
+  return a.len < b.len ? -1 : (a.len > b.len ? 1 : 0);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mt_new() { return new MT(); }
+
+void mt_free(void* h) { delete (MT*)h; }
+
+// keys_blob/koffs: n internal-key PREFIXES (without suffix); suffixes:
+// n * 12 bytes of encoded DocHybridTime. The arena stores
+// prefix + 0x23 + suffix contiguously per entry.
+int mt_add_batch(void* h, const uint8_t* keys_blob, const int64_t* koffs,
+                 const uint8_t* suffixes, const uint8_t* vals_blob,
+                 const int64_t* voffs, int64_t n) {
+  MT* m = (MT*)h;
+  int64_t kbytes = koffs[n] + n * (int64_t)kSuffix;
+  int64_t vbytes = voffs[n];
+  size_t k0 = m->keys.size(), v0 = m->vals.size();
+  m->keys.resize(k0 + (size_t)kbytes);
+  m->vals.resize(v0 + (size_t)vbytes);
+  memcpy(m->vals.data() + v0, vals_blob, (size_t)vbytes);
+  int64_t seq0 = (int64_t)m->ents.size();
+  m->ents.reserve(m->ents.size() + (size_t)n);
+  uint8_t* kp = m->keys.data() + k0;
+  int64_t off = (int64_t)k0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t plen = (int32_t)(koffs[i + 1] - koffs[i]);
+    memcpy(kp, keys_blob + koffs[i], (size_t)plen);
+    kp[plen] = 0x23;  // ValueType::kHybridTime
+    memcpy(kp + plen + 1, suffixes + i * 12, 12);
+    int32_t ilen = plen + kSuffix;
+    int32_t vlen = (int32_t)(voffs[i + 1] - voffs[i]);
+    m->ents.push_back({off, ilen, (int64_t)v0 + voffs[i], vlen, seq0 + i});
+    m->bytes += ilen + vlen;
+    kp += ilen;
+    off += ilen;
+  }
+  m->sorted = false;
+  return 0;
+}
+
+static void ensure_sorted(MT* m) {
+  if (m->sorted) return;
+  std::vector<int32_t>& ord = m->order;
+  ord.resize(m->ents.size());
+  for (size_t i = 0; i < ord.size(); ++i) ord[i] = (int32_t)i;
+  const MT* mc = m;
+  std::sort(ord.begin(), ord.end(), [mc](int32_t x, int32_t y) {
+    int c = cmp_ikey(mc, mc->ents[x], mc->ents[y]);
+    if (c) return c < 0;
+    // equal internal keys: latest insert first (survives the dedup)
+    return mc->ents[x].seq > mc->ents[y].seq;
+  });
+  // dedup consecutive equal ikeys, keeping the first (= latest seq)
+  size_t w = 0;
+  for (size_t r = 0; r < ord.size(); ++r) {
+    if (w && cmp_ikey(mc, mc->ents[ord[w - 1]], mc->ents[ord[r]]) == 0)
+      continue;
+    ord[w++] = ord[r];
+  }
+  ord.resize(w);
+  m->sorted = true;
+}
+
+int64_t mt_n(void* h) {  // distinct internal keys (dict semantics)
+  MT* m = (MT*)h;
+  ensure_sorted(m);
+  return (int64_t)m->order.size();
+}
+
+int64_t mt_bytes(void* h) { return ((MT*)h)->bytes; }
+
+int64_t mt_raw_n(void* h) { return (int64_t)((MT*)h)->ents.size(); }
+
+// First sorted position whose internal key >= seek. Returns index into
+// the sorted order, or mt_n if none.
+int64_t mt_lower_bound(void* h, const uint8_t* seek, int32_t seek_len) {
+  MT* m = (MT*)h;
+  ensure_sorted(m);
+  int64_t lo = 0, hi = (int64_t)m->order.size();
+  while (lo < hi) {
+    int64_t mid = (lo + hi) >> 1;
+    const Entry& e = m->ents[m->order[mid]];
+    int32_t n = e.len < seek_len ? e.len : seek_len;
+    int c = memcmp(m->keys.data() + e.off, seek, (size_t)n);
+    if (c == 0) c = e.len < seek_len ? -1 : (e.len > seek_len ? 1 : 0);
+    if (c < 0)
+      lo = mid + 1;
+    else
+      hi = mid;
+  }
+  return lo;
+}
+
+// Sizes of the export range [start, end) over the sorted order.
+// include_suffix: 1 = full internal keys (iter paths), 0 = prefixes only
+// (the to_packed / flush-encoder layout).
+void mt_range_sizes(void* h, int64_t start, int64_t end,
+                    int32_t include_suffix, int64_t* kbytes,
+                    int64_t* vbytes) {
+  MT* m = (MT*)h;
+  ensure_sorted(m);
+  int64_t kb = 0, vb = 0;
+  for (int64_t i = start; i < end; ++i) {
+    const Entry& e = m->ents[m->order[i]];
+    kb += include_suffix ? e.len : e.len - kSuffix;
+    vb += e.vlen;
+  }
+  *kbytes = kb;
+  *vbytes = vb;
+}
+
+// Export sorted entries [start, end): keys (full internal or prefix-only
+// per include_suffix) + values, plus decoded (ht, wid) columns (from the
+// descending-encoded suffix).
+void mt_export_range(void* h, int64_t start, int64_t end,
+                     int32_t include_suffix, uint8_t* keys_out,
+                     int64_t* koffs_out, uint64_t* ht_out, uint32_t* wid_out,
+                     uint8_t* vals_out, int64_t* voffs_out) {
+  MT* m = (MT*)h;
+  ensure_sorted(m);
+  int64_t ko = 0, vo = 0;
+  koffs_out[0] = 0;
+  voffs_out[0] = 0;
+  for (int64_t i = start; i < end; ++i) {
+    const Entry& e = m->ents[m->order[i]];
+    int32_t klen = include_suffix ? e.len : e.len - kSuffix;
+    memcpy(keys_out + ko, m->keys.data() + e.off, (size_t)klen);
+    memcpy(vals_out + vo, m->vals.data() + e.voff, (size_t)e.vlen);
+    const uint8_t* sfx = m->keys.data() + e.off + e.len - 12;
+    uint64_t ht_c = 0;
+    uint32_t wid_c = 0;
+    for (int b = 0; b < 8; ++b) ht_c = (ht_c << 8) | sfx[b];
+    for (int b = 8; b < 12; ++b) wid_c = (wid_c << 8) | sfx[b];
+    ht_out[i - start] = ~ht_c;
+    wid_out[i - start] = ~wid_c;
+    ko += klen;
+    vo += e.vlen;
+    koffs_out[i - start + 1] = ko;
+    voffs_out[i - start + 1] = vo;
+  }
+}
+
+}  // extern "C"

@@ -256,18 +256,20 @@ class YBClient:
                             tablet.mark_leader(hint)
                         last_err = e
                         continue
-                    if e.status.code in (Code.NOT_FOUND,
-                                         Code.SERVICE_UNAVAILABLE,
-                                         Code.TIMED_OUT,
-                                         Code.ABORTED):
+                    if (e.status.code in (Code.NOT_FOUND,
+                                          Code.SERVICE_UNAVAILABLE,
+                                          Code.TIMED_OUT)
+                            or e.extra.get("replication_aborted")):
                         # TIMED_OUT is the server's OperationOutcomeUnknown:
                         # the entry may still commit. Retrying HERE — with
                         # the same request id — is what makes the
                         # retryable-request dedup close the double-apply
                         # hole (the op args carry client_id/request_id).
-                        # ABORTED is ReplicationAborted: the entry was
-                        # overwritten by a new leader and provably did NOT
-                        # commit — retry lands on the re-resolved leader.
+                        # replication_aborted tags a raft entry overwritten
+                        # by a new leader: provably not committed, retry on
+                        # the re-resolved leader. (Bare Code.ABORTED is NOT
+                        # retried — it is also the terminal answer for an
+                        # aborted TRANSACTION, which must surface.)
                         last_err = e
                         continue
                     raise

@@ -22,7 +22,8 @@ from yugabyte_tpu.docdb.doc_key import split_key_and_ht
 from yugabyte_tpu.docdb.value_type import ValueType
 from yugabyte_tpu.ops.slabs import pack_doc_ht
 from yugabyte_tpu.storage import compaction as compaction_mod
-from yugabyte_tpu.storage.memtable import MemTable, make_internal_key
+from yugabyte_tpu.storage.memtable import (MemTable, make_internal_key,
+                                           new_memtable)
 from yugabyte_tpu.storage.sst import (
     BlockCache, Frontier, SSTReader, SSTWriter, data_file_name)
 from yugabyte_tpu.storage.version_set import VersionSet
@@ -32,6 +33,9 @@ from yugabyte_tpu.utils.trace import TRACE
 
 flags.define_flag("memstore_size_bytes", 128 * 1024 * 1024,
                   "flush memtable at this size (ref docdb_rocksdb_util.cc:113)")
+flags.define_flag("memtable_native", True,
+                  "Use the C++ memtable arena (native/memtable_arena.cc) "
+                  "when the toolchain is available")
 flags.define_flag("read_native", True,
                   "serve point reads and scans through the native read "
                   "engine (native/read_engine.cc) when it builds; the "
@@ -96,7 +100,7 @@ class DB:
         os.makedirs(db_dir, exist_ok=True)
         self.versions = VersionSet(db_dir)
         self.versions.recover()
-        self.mem = MemTable()
+        self.mem = new_memtable()
         self._imm: Optional[MemTable] = None   # memtable being flushed
         self._readers: dict = {}
         self._lock = threading.RLock()
@@ -146,21 +150,48 @@ class DB:
             return len(self._readers)
 
     # ------------------------------------------------------------------ write
+    def _post_write_locked(self, op_id: Tuple[int, int]) -> bool:
+        """Shared writer tail (lock held): op-id tracking + flush trigger."""
+        self._last_op_id = max(getattr(self, "_last_op_id", (0, 0)), op_id)
+        limit = self.opts.memstore_size_bytes or \
+            flags.get_flag("memstore_size_bytes")
+        return self.mem.approximate_bytes >= limit
+
     def write_batch(self, items: List[Tuple[bytes, DocHybridTime, bytes]],
                     op_id: Tuple[int, int] = (0, 0)) -> None:
         """Apply a batch (already carrying DocHybridTimes). WAL-less: durability
         comes from the Raft log above (ref: tablet.cc:1247 WriteToRocksDB)."""
         with self._lock:
-            if len(items) > 8:
-                self.mem.add_batch(items)
+            mem = self.mem
+            if len(items) > 8 or hasattr(mem, "add_columns"):
+                # the native arena always takes the batch call (its add()
+                # would pay a full ctypes round trip PER ROW)
+                mem.add_batch(items)
             else:
                 for key_prefix, dht, value in items:
-                    self.mem.add(key_prefix, dht, value)
-            self._last_op_id = max(getattr(self, "_last_op_id", (0, 0)), op_id)
-            limit = self.opts.memstore_size_bytes or flags.get_flag("memstore_size_bytes")
-            need_flush = self.mem.approximate_bytes >= limit
+                    mem.add(key_prefix, dht, value)
+            need_flush = self._post_write_locked(op_id)
         # flush outside the lock: concurrent writers keep inserting into the
         # fresh memtable while the immutable one packs + writes its SST
+        if need_flush:
+            self.flush()
+
+    def write_batch_columns(self, keys: List[bytes], ht, wid,
+                            values: List[bytes],
+                            op_id: Tuple[int, int] = (0, 0)) -> None:
+        """Columnar bulk write (batched-RPC apply / bulk-load shape):
+        parallel key/value lists + uint64 HT and uint32 write-id arrays —
+        one native memtable call instead of per-row tuple assembly
+        (ref: db/memtable.cc Add, write path hot loop)."""
+        with self._lock:
+            mem = self.mem
+            if hasattr(mem, "add_columns"):
+                mem.add_columns(keys, ht, wid, values)
+            else:
+                mem.add_batch([
+                    (k, DocHybridTime(HybridTime(int(h)), int(w)), v)
+                    for k, h, w, v in zip(keys, ht, wid, values)])
+            need_flush = self._post_write_locked(op_id)
         if need_flush:
             self.flush()
 
@@ -474,7 +505,7 @@ class DB:
                 return None  # a flush is already in progress
             if self.mem.empty:
                 return None
-            self._imm, self.mem = self.mem, MemTable()
+            self._imm, self.mem = self.mem, new_memtable()
             imm = self._imm
             last_op = getattr(self, "_last_op_id", (0, 0))
         try:

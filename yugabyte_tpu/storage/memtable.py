@@ -166,3 +166,265 @@ class MemTable:
         if n:
             np.cumsum([len(v) for v in vals], out=val_offs[1:])
         return keys_blob, key_offs, ht, wid, vals_blob, val_offs
+
+
+# --------------------------------------------------------------------------
+# Native memtable arena (native/memtable_arena.cc): the same interface at
+# memcpy speed — append-only C++ arena of full internal keys, sort-on-
+# demand index, latest-insert-wins dedup (ref: db/memtable.cc arena).
+
+import ctypes as _ct
+
+import numpy as _np
+
+_U64 = 0xFFFFFFFFFFFFFFFF
+_U32 = 0xFFFFFFFF
+_mt_lib = None
+_mt_lib_lock = threading.Lock()
+_i64p = _ct.POINTER(_ct.c_int64)
+_u64p = _ct.POINTER(_ct.c_uint64)
+_u32p = _ct.POINTER(_ct.c_uint32)
+_u8p = _ct.POINTER(_ct.c_uint8)
+
+
+def _load_mt_lib():
+    global _mt_lib
+    with _mt_lib_lock:
+        if _mt_lib is not None:
+            return _mt_lib
+        from yugabyte_tpu.utils.native_build import build_native_lib
+        path = build_native_lib("memtable_arena.cc", "libmemtable_arena.so",
+                                deps=())
+        lib = _ct.CDLL(path)
+        lib.mt_new.restype = _ct.c_void_p
+        lib.mt_free.argtypes = [_ct.c_void_p]
+        lib.mt_add_batch.argtypes = [_ct.c_void_p, _ct.c_char_p, _i64p,
+                                     _ct.c_char_p, _ct.c_char_p, _i64p,
+                                     _ct.c_int64]
+        lib.mt_n.restype = _ct.c_int64
+        lib.mt_n.argtypes = [_ct.c_void_p]
+        lib.mt_bytes.restype = _ct.c_int64
+        lib.mt_bytes.argtypes = [_ct.c_void_p]
+        lib.mt_raw_n.restype = _ct.c_int64
+        lib.mt_raw_n.argtypes = [_ct.c_void_p]
+        lib.mt_lower_bound.restype = _ct.c_int64
+        lib.mt_lower_bound.argtypes = [_ct.c_void_p, _ct.c_char_p,
+                                       _ct.c_int32]
+        lib.mt_range_sizes.argtypes = [_ct.c_void_p, _ct.c_int64,
+                                       _ct.c_int64, _ct.c_int32, _i64p,
+                                       _i64p]
+        lib.mt_export_range.argtypes = [_ct.c_void_p, _ct.c_int64,
+                                        _ct.c_int64, _ct.c_int32, _u8p,
+                                        _i64p, _u64p, _u32p, _u8p, _i64p]
+        _mt_lib = lib
+        return lib
+
+
+def native_memtable_available() -> bool:
+    try:
+        _load_mt_lib()
+        return True
+    except Exception:  # noqa: BLE001 — no toolchain: Python memtable
+        return False
+
+
+def _encode_suffixes(ht_vals: _np.ndarray, wids: _np.ndarray) -> bytes:
+    """Vectorized DocHybridTime.encoded() for a column: 12 bytes/row of
+    big-endian complement (desc order), concatenated."""
+    n = len(ht_vals)
+    out = _np.empty((n, 12), dtype=_np.uint8)
+    out[:, :8] = (
+        (ht_vals.astype(_np.uint64) ^ _np.uint64(_U64))
+        .astype(">u8").view(_np.uint8).reshape(n, 8))
+    out[:, 8:] = (
+        (wids.astype(_np.uint32) ^ _np.uint32(_U32))
+        .astype(">u4").view(_np.uint8).reshape(n, 4))
+    return out.tobytes()
+
+
+class NativeMemTable:
+    """Drop-in MemTable twin backed by the C++ arena."""
+
+    def __init__(self):
+        self._lib = _load_mt_lib()
+        self._h = self._lib.mt_new()
+        self._lock = threading.Lock()
+        self.version = 0
+        self._first_write_s: Optional[float] = None
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.mt_free(self._h)
+                self._h = None
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    # ------------------------------------------------------------- write
+    def add(self, key_prefix: bytes, dht: DocHybridTime, value: bytes) -> None:
+        self.add_batch([(key_prefix, dht, value)])
+
+    def add_batch(self, items) -> None:
+        keys = [k for k, _d, _v in items]
+        vals = [v for _k, _d, v in items]
+        n = len(items)
+        ht = _np.fromiter((d.ht.value for _k, d, _v in items),
+                          dtype=_np.uint64, count=n)
+        wid = _np.fromiter((d.write_id for _k, d, _v in items),
+                           dtype=_np.uint32, count=n)
+        self._add_packed(keys, ht, wid, vals)
+
+    def add_columns(self, keys: List[bytes], ht: _np.ndarray,
+                    wid: _np.ndarray, values: List[bytes]) -> None:
+        """Columnar bulk write (the batched-RPC apply / bulk-load shape):
+        parallel lists/arrays, one native call."""
+        self._add_packed(keys, _np.asarray(ht, dtype=_np.uint64),
+                         _np.asarray(wid, dtype=_np.uint32), values)
+
+    def _add_packed(self, keys, ht, wid, vals) -> None:
+        n = len(keys)
+        if n == 0:
+            return
+        if not (len(ht) == len(wid) == len(vals) == n):
+            # the C side trusts n: a mismatch would read past the suffix
+            # buffer and store garbage MVCC timestamps
+            raise ValueError(
+                f"column length mismatch: keys={n} ht={len(ht)} "
+                f"wid={len(wid)} values={len(vals)}")
+        keys_blob = b"".join(keys)
+        koffs = _np.zeros(n + 1, dtype=_np.int64)
+        _np.cumsum([len(k) for k in keys], out=koffs[1:])
+        vals_blob = b"".join(vals)
+        voffs = _np.zeros(n + 1, dtype=_np.int64)
+        _np.cumsum([len(v) for v in vals], out=voffs[1:])
+        sfx = _encode_suffixes(ht, wid)
+        with self._lock:
+            self._lib.mt_add_batch(
+                self._h, keys_blob, koffs.ctypes.data_as(_i64p), sfx,
+                vals_blob, voffs.ctypes.data_as(_i64p), _ct.c_int64(n))
+            self.version += 1
+            if self._first_write_s is None:
+                self._first_write_s = time.monotonic()
+
+    # -------------------------------------------------------------- read
+    def _export(self, start: int, end: int, include_suffix: bool):
+        kb = _ct.c_int64()
+        vb = _ct.c_int64()
+        inc = _ct.c_int32(1 if include_suffix else 0)
+        self._lib.mt_range_sizes(self._h, start, end, inc,
+                                 _ct.byref(kb), _ct.byref(vb))
+        n = end - start
+        keys = _np.empty(max(1, kb.value), dtype=_np.uint8)
+        koffs = _np.zeros(n + 1, dtype=_np.int64)
+        ht = _np.empty(max(1, n), dtype=_np.uint64)
+        wid = _np.empty(max(1, n), dtype=_np.uint32)
+        vals = _np.empty(max(1, vb.value), dtype=_np.uint8)
+        voffs = _np.zeros(n + 1, dtype=_np.int64)
+        self._lib.mt_export_range(
+            self._h, start, end, inc, keys.ctypes.data_as(_u8p),
+            koffs.ctypes.data_as(_i64p), ht.ctypes.data_as(_u64p),
+            wid.ctypes.data_as(_u32p), vals.ctypes.data_as(_u8p),
+            voffs.ctypes.data_as(_i64p))
+        return keys, koffs, ht, wid, vals, voffs
+
+    def point_get(self, seek: bytes, boundary: bytes
+                  ) -> Optional[Tuple[bytes, bytes]]:
+        with self._lock:
+            idx = int(self._lib.mt_lower_bound(self._h, seek, len(seek)))
+            if idx >= int(self._lib.mt_n(self._h)):
+                return None
+            keys, koffs, _ht, _wid, vals, voffs = \
+                self._export(idx, idx + 1, True)
+        ikey = keys[: koffs[1]].tobytes()
+        if not ikey.startswith(boundary):
+            return None
+        return ikey, vals[: voffs[1]].tobytes()
+
+    def iter_from(self, seek_key: bytes = b""
+                  ) -> Iterator[Tuple[bytes, bytes]]:
+        """(internal_key, value) in memcmp order from seek_key; batched
+        exports re-seek by last key, so concurrent adds never tear."""
+        batch = 4096
+        seek = seek_key
+        strict = False
+        while True:
+            with self._lock:
+                idx = int(self._lib.mt_lower_bound(self._h, seek, len(seek)))
+                total = int(self._lib.mt_n(self._h))
+                end = min(idx + batch, total)
+                if idx >= end:
+                    return
+                keys, koffs, _ht, _wid, vals, voffs = \
+                    self._export(idx, end, True)
+            last = None
+            for i in range(end - idx):
+                ikey = keys[koffs[i]: koffs[i + 1]].tobytes()
+                if strict and ikey == seek:
+                    continue
+                yield ikey, vals[voffs[i]: voffs[i + 1]].tobytes()
+                last = ikey
+            if end >= total and last is None:
+                return
+            if last is not None:
+                seek = last
+                strict = True
+            if end >= total:
+                # may have grown concurrently; one more probe past `last`
+                with self._lock:
+                    if int(self._lib.mt_lower_bound(
+                            self._h, seek, len(seek))) + 1 >= \
+                            int(self._lib.mt_n(self._h)):
+                        return
+
+    # ------------------------------------------------------------- stats
+    @property
+    def oldest_write_s(self) -> Optional[float]:
+        return self._first_write_s
+
+    @property
+    def n_entries(self) -> int:
+        with self._lock:
+            return int(self._lib.mt_n(self._h))
+
+    @property
+    def approximate_bytes(self) -> int:
+        with self._lock:
+            return int(self._lib.mt_bytes(self._h))
+
+    @property
+    def empty(self) -> bool:
+        with self._lock:
+            return int(self._lib.mt_raw_n(self._h)) == 0
+
+    # ------------------------------------------------------------- flush
+    def to_packed(self):
+        """Sorted packed-run columns for the native flush encoder — one
+        C++ export, no Python joins (ref: db/flush_job.cc)."""
+        with self._lock:
+            n = int(self._lib.mt_n(self._h))
+            keys, koffs, ht, wid, vals, voffs = self._export(0, n, False)
+        return keys.tobytes(), koffs, ht, wid, vals.tobytes(), voffs
+
+    def to_slab(self) -> KVSlab:
+        with self._lock:
+            n = int(self._lib.mt_n(self._h))
+            keys, koffs, ht, wid, vals, voffs = self._export(0, n, False)
+        triples = []
+        for i in range(n):
+            packed = (int(ht[i]) << 32) | int(wid[i])
+            triples.append((keys[koffs[i]: koffs[i + 1]].tobytes(), packed,
+                            vals[voffs[i]: voffs[i + 1]].tobytes()))
+        return pack_kvs(triples)
+
+
+def new_memtable():
+    """Factory: the native arena when the toolchain is available and the
+    flag allows, else the Python MemTable."""
+    from yugabyte_tpu.utils import flags as _flags
+    try:
+        use_native = _flags.get_flag("memtable_native")
+    except KeyError:
+        use_native = True
+    if use_native and native_memtable_available():
+        return NativeMemTable()
+    return MemTable()

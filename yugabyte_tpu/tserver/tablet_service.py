@@ -130,9 +130,13 @@ class TabletServiceImpl:
         except ReplicationAborted as e:
             # The op provably did NOT commit — its entry was overwritten by
             # a new leader's history. Safe to retry verbatim; the client's
-            # retry loop re-resolves the (changed) leader. ref: the
-            # reference maps this to a retryable Aborted in WriteQuery.
-            raise StatusError(Status.Aborted(str(e))) from e
+            # retry loop re-resolves the (changed) leader. Tagged via extra
+            # rather than bare Code.ABORTED: aborted is ALSO a terminal
+            # transaction answer (txn_commit of an expired txn), which must
+            # surface, not retry. ref: WriteQuery's retryable abort.
+            err = StatusError(Status.Aborted(str(e)))
+            err.extra = {"replication_aborted": True}
+            raise err from e
         return {"propagated_ht": ht.value}
 
     # ----------------------------------------------------------------- reads
