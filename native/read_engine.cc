@@ -340,9 +340,15 @@ struct Scan {
   // visibility state (mode 1) — twin of DocRowwiseIterator._resolve_visible
   std::vector<uint8_t> cur_doc;
   bool have_doc = false;
-  uint64_t ov_ht = 0;
-  uint32_t ov_wid = 0;
-  bool ov_set = false;
+  // overwrite-point stack over subpath prefixes: every newest-visible
+  // entry replaces the older subtree at its path (collection replace
+  // markers / column tombstones shadow older elements)
+  struct OvPoint {
+    std::string sub;
+    uint64_t ht;
+    uint32_t wid;
+  };
+  std::vector<OvPoint> ov_stack;
   std::vector<std::string> seen_paths;
 
   void heap_init() {
@@ -597,7 +603,7 @@ int64_t rs_scan_next(void* sp, int64_t max_rows, uint8_t* keys_out,
             memcmp(s->cur_doc.data(), k, d) != 0) {
           s->cur_doc.assign(k, k + d);
           s->have_doc = true;
-          s->ov_set = false;
+          s->ov_stack.clear();
           s->seen_paths.clear();
         }
         std::string sub((const char*)k + d, (size_t)(klen - d));
@@ -605,17 +611,28 @@ int64_t rs_scan_next(void* sp, int64_t max_rows, uint8_t* keys_out,
         for (const auto& p : s->seen_paths)
           if (p == sub) { seen = true; break; }
         if (!seen) {
-          s->seen_paths.push_back(std::move(sub));
-          bool shadowed =
-              s->ov_set && (ht < s->ov_ht || (ht == s->ov_ht && wid < s->ov_wid));
+          // pop overwrite points that are not a prefix of this path
+          while (!s->ov_stack.empty()) {
+            const std::string& anc = s->ov_stack.back().sub;
+            if (sub.size() >= anc.size() &&
+                memcmp(sub.data(), anc.data(), anc.size()) == 0)
+              break;
+            s->ov_stack.pop_back();
+          }
+          bool shadowed = false;
+          for (const auto& o : s->ov_stack) {
+            if (ht < o.ht || (ht == o.ht && wid < o.wid)) {
+              shadowed = true;
+              break;
+            }
+          }
           bool expired = (fl & 4) &&
               (s->read_ht >> 12) >= (ht >> 12) + (uint64_t)ttl * 1000;
           bool dead = (fl & 1) || shadowed || expired;
-          if (klen == d) {  // bare DocKey: tombstone or init marker
-            s->ov_ht = ht;
-            s->ov_wid = wid;
-            s->ov_set = true;
-          }
+          // EVERY newest-visible entry is an overwrite point for its
+          // subtree (matches _resolve_visible / read_subdocument)
+          s->ov_stack.push_back({sub, ht, wid});
+          s->seen_paths.push_back(std::move(sub));
           emit = !dead;
         }
       }

@@ -96,12 +96,18 @@ class YBTransaction:
         self.read_ht: int = resp["read_ht"]
         self._participants: Dict[str, str] = {}  # tablet_id -> addr hint
         self._state = "pending"
+        self._stmt_seq = 0  # IntraTxnWriteId statement slots (see write())
         self._lock = threading.Lock()
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name=f"txn-hb-{self.txn_id.hex()[:8]}")
         self._hb_thread.start()
+
+    def _next_stmt_seq(self) -> int:
+        with self._lock:
+            self._stmt_seq += 1
+            return self._stmt_seq
 
     # ------------------------------------------------------------- plumbing
     def _status_call(self, mth: str, **args):
@@ -141,6 +147,14 @@ class YBTransaction:
         may pass any mix of keys. A tablet split between lookup and RPC
         re-routes by key like YBClient.write does."""
         self._check_pending()
+        # IntraTxnWriteId base: each write CALL gets the next statement
+        # slot (65536 kv pairs per statement), so a later statement's
+        # intents sort ABOVE an earlier one's at the shared commit hybrid
+        # time (ref docdb/intent.h IntraTxnWriteId; the collection-marker
+        # shadowing bug this fixes: INSERT marker wid > UPDATE element
+        # wid made the element invisible). Stable across retries of this
+        # call.
+        write_id_base = self._next_stmt_seq() << 16
         groups: dict = {}
         for op in ops:
             pk = table.partition_key_for(op.doc_key)
@@ -162,6 +176,7 @@ class YBTransaction:
                     table, tablet, "write", refresh_key=pk,
                     ops=[write_op_to_wire(op) for op in group],
                     txn=self._meta().to_wire(),
+                    txn_write_id_base=write_id_base,
                     schema_version=table.schema_version)
             except RemoteError as e:
                 if e.extra.get("txn_conflict"):

@@ -38,6 +38,11 @@ class ColumnSchema:
     # columns are addressed by POSITION-derived ids, so removing the slot
     # would shift every later column onto its neighbor's stored data
     dropped: bool = False
+    # YCQL collection columns (LIST<T>/SET<T>/MAP<K,V>): ("list", "INT"),
+    # ("set", "TEXT"), ("map", "TEXT", "INT"). Storage rides subdocuments
+    # (docdb/subdocument.py); `type` stays the element-agnostic BINARY
+    # (ref: common/ql_type.h collection types)
+    collection: Optional[Tuple[str, ...]] = None
 
 
 @dataclass

@@ -22,7 +22,7 @@ from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 def schema_to_wire(schema: Schema) -> dict:
     return {
         "columns": [[c.name, c.type.value, c.nullable, c.sorting.value,
-                     c.dropped]
+                     c.dropped, list(c.collection) if c.collection else None]
                     for c in schema.columns],
         "num_hash": schema.num_hash_key_columns,
         "num_range": schema.num_range_key_columns,
@@ -30,11 +30,14 @@ def schema_to_wire(schema: Schema) -> dict:
 
 
 def schema_from_wire(w: dict) -> Schema:
-    # 5th element (dropped) is optional for wire/sys-catalog back-compat
+    # elements 5 (dropped) and 6 (collection) are optional for wire /
+    # sys-catalog back-compat
     return Schema(
         columns=[ColumnSchema(col[0], DataType(col[1]), col[2],
                               SortingType(col[3]),
-                              bool(col[4]) if len(col) > 4 else False)
+                              bool(col[4]) if len(col) > 4 else False,
+                              tuple(col[5]) if len(col) > 5 and col[5]
+                              else None)
                  for col in w["columns"]],
         num_hash_key_columns=w["num_hash"],
         num_range_key_columns=w["num_range"])
@@ -78,17 +81,29 @@ def write_op_to_wire(op: QLWriteOp) -> dict:
     }
     if op.backfill_ht:
         w["backfill_ht"] = op.backfill_ht
+    if op.collection_ops:
+        # per column: ORDERED op list; ("replace"/"merge", {k: v}) ->
+        # item list; ("del_keys", [k..])
+        w["collection_ops"] = {
+            c: [[o, sorted(p.items()) if isinstance(p, dict) else list(p)]
+                for o, p in ops]
+            for c, ops in op.collection_ops.items()}
     return w
 
 
 def write_op_from_wire(w: dict) -> QLWriteOp:
+    coll = {}
+    for c, ops in (w.get("collection_ops") or {}).items():
+        coll[c] = [(o, dict(p) if o in ("replace", "merge")
+                    else [k for k in p]) for o, p in ops]
     return QLWriteOp(
         kind=WriteOpKind(w["kind"]),
         doc_key=doc_key_from_wire(w["doc_key"]),
         values=dict(w["values"]),
         ttl_ms=w["ttl_ms"],
         columns_to_delete=tuple(w["cols_to_delete"]),
-        backfill_ht=w.get("backfill_ht"))
+        backfill_ht=w.get("backfill_ht"),
+        collection_ops=coll)
 
 
 # --------------------------------------------------------------------- rows

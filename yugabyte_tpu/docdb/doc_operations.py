@@ -46,6 +46,16 @@ class QLWriteOp:
     values: Dict[str, PrimitiveType] = field(default_factory=dict)
     ttl_ms: Optional[int] = None
     columns_to_delete: Tuple[str, ...] = ()
+    # YCQL collection ops per column, applied IN ORDER (storage rides
+    # subdocuments — docdb/subdocument.py; ref doc_write_batch.cc
+    # InsertSubDocument / ExtendSubDocument):
+    #   ("replace", {k: v})  SET m = {...}  — init marker + entries
+    #   ("merge",   {k: v})  SET m = m + {...} / m['k'] = v — no marker
+    #   ("del_keys", [k..])  DELETE m['k'] / SET m = m - {...}
+    # Value: a LIST of such ops per column (one UPDATE may mix element
+    # writes and element deletes on the same column).
+    collection_ops: Dict[str, List[Tuple[str, object]]] = field(
+        default_factory=dict)
     # Index backfill only (ref: tablet.cc:2088 BackfillIndexes writing at
     # the backfill read time): entries are stamped with THIS hybrid time
     # instead of the op's, so concurrent index maintenance — which writes at
@@ -70,6 +80,7 @@ class QLWriteOp:
             for name in self.columns_to_delete:
                 out.append((col_key(schema.column_id(name)),
                             Value.tombstone().encode()))
+            self._collection_kv_pairs(schema, out)
             return out
         if self.kind == WriteOpKind.INSERT:
             out.append((col_key(kLivenessColumnId),
@@ -81,7 +92,32 @@ class QLWriteOp:
             else:
                 out.append((col_key(cid),
                             Value(primitive=v, ttl_ms=self.ttl_ms).encode()))
+        self._collection_kv_pairs(schema, out)
         return out
+
+    def _collection_kv_pairs(self, schema: Schema,
+                             out: List[Tuple[bytes, bytes]]) -> None:
+        dk = self.doc_key
+        for name, ops in self.collection_ops.items():
+            cid = schema.column_id(name)
+            from yugabyte_tpu.docdb.subdocument import subdocument_writes
+            path = (("col", cid),)
+            for op, payload in ops:
+                if op == "replace":
+                    out.extend(subdocument_writes(dk, path, dict(payload),
+                                                  ttl_ms=self.ttl_ms))
+                elif op == "merge":
+                    # element writes WITHOUT the init marker: older
+                    # entries at other keys survive (ExtendSubDocument)
+                    for k, v in dict(payload).items():
+                        out.extend(subdocument_writes(dk, path + (k,), v,
+                                                      ttl_ms=self.ttl_ms))
+                elif op == "del_keys":
+                    for k in payload:
+                        out.append((SubDocKey(dk, path + (k,)).encode(
+                            include_ht=False), Value.tombstone().encode()))
+                else:
+                    raise ValueError(f"unknown collection op {op!r}")
 
     # ---------------------------------------------------------------- locks
     def lock_entries(self, schema: Schema,

@@ -100,6 +100,8 @@ class VisibleEntryRowAssembler:
             dk, _ = DocKey.decode(cur_doc)
             return Row(dk, dict(columns), max_ht)
 
+        col_marker: Dict[int, int] = {}  # cid -> overwrite point ht
+
         for key, raw_value, ht_value in self._entries:
             dk_len = _doc_key_len(key)
             doc = key[:dk_len]
@@ -113,6 +115,7 @@ class VisibleEntryRowAssembler:
                         return
                 cur_doc = doc
                 columns = {}
+                col_marker = {}
                 liveness = False
                 max_ht = HybridTime.kMin
             ht = HybridTime(ht_value)
@@ -122,16 +125,49 @@ class VisibleEntryRowAssembler:
                 liveness = True  # visible init marker
                 continue
             sdk = SubDocKey.decode(key)
-            if len(sdk.subkeys) != 1 or not (
-                    isinstance(sdk.subkeys[0], tuple) and sdk.subkeys[0][0] == "col"):
-                continue  # deeper subdocument paths: not part of a flat row
+            if not (sdk.subkeys
+                    and isinstance(sdk.subkeys[0], tuple)
+                    and sdk.subkeys[0][0] == "col"):
+                continue  # non-column subdocument paths: not a row part
             cid = sdk.subkeys[0][1]
             liveness = True  # any visible column proves the row exists
             if cid == kLivenessColumnId:
                 continue
             if self._projection is not None and cid not in self._projection:
                 continue
-            columns[cid] = Value.decode(raw_value).primitive
+            if len(sdk.subkeys) == 1:
+                value = Value.decode(raw_value)
+                col_marker[cid] = ht_value
+                if value.is_object:
+                    # collection init marker: an (empty) container that
+                    # OVERWRITES the older subtree at this column
+                    columns[cid] = {}
+                else:
+                    columns[cid] = value.primitive
+                continue
+            # collection element ((col,cid), k, ...) — the resolve stage
+            # already picked the newest visible version per exact path;
+            # cross-path shadowing by the column's overwrite point
+            # (replace marker or primitive) applies here
+            # (ref: subdoc_reader.cc overwrite stack)
+            if cid in col_marker and ht_value < col_marker[cid]:
+                continue  # older than the column's replace/overwrite
+            container = columns.get(cid)
+            if not isinstance(container, dict):
+                # no marker (merge-without-marker) or a resurrected
+                # collection over an older primitive
+                container = {}
+                columns[cid] = container
+            node = container
+            for comp in sdk.subkeys[1:-1]:
+                nxt = node.get(comp)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    node[comp] = nxt
+                node = nxt
+            value = Value.decode(raw_value)
+            node[sdk.subkeys[-1]] = {} if value.is_object \
+                else value.primitive
         row = finish()
         if row is not None:
             yield row
@@ -190,10 +226,12 @@ class DocRowwiseIterator:
         device for the whole range at once)."""
         read_ht = self._read_ht
         cur_doc: Optional[bytes] = None
-        # doc_overwrite: DocHybridTime of the latest visible bare-DocKey
-        # entry — BOTH a tombstone and an object init marker replace the
-        # whole older subdocument, so either shadows older columns.
-        doc_overwrite: Optional[DocHybridTime] = None
+        # Overwrite-point STACK over subpath prefixes (the same rule
+        # read_subdocument and the compaction model apply): EVERY newest-
+        # visible entry — bare-DocKey marker/tombstone, column value or
+        # tombstone, collection replace marker — replaces the older
+        # subtree at its path, so strictly-older descendants are shadowed.
+        ov_stack: list = []   # [(subpath, DocHybridTime)] prefix-nested
         seen_paths: set = set()
         stream = (self._entry_stream if self._entry_stream is not None
                   else self._db.iter_from(self._lower))
@@ -207,7 +245,7 @@ class DocRowwiseIterator:
                 break
             if doc != cur_doc:
                 cur_doc = doc
-                doc_overwrite = None
+                ov_stack = []
                 seen_paths = set()
             if dht.ht.value > read_ht.value:
                 continue  # newer than the snapshot
@@ -215,15 +253,13 @@ class DocRowwiseIterator:
             if subpath in seen_paths:
                 continue  # older version of an already-resolved path
             seen_paths.add(subpath)
+            while ov_stack and not subpath.startswith(ov_stack[-1][0]):
+                ov_stack.pop()
             value = Value.decode(raw_value)
-            shadowed = doc_overwrite is not None and dht < doc_overwrite
+            shadowed = any(dht < ov for _p, ov in ov_stack)
             dead = (value.is_tombstone or shadowed
                     or _is_expired(value, dht, read_ht))
-            if not subpath:
-                doc_overwrite = dht
-                if not dead:
-                    yield prefix, raw_value, dht.ht.value
-                continue
+            ov_stack.append((subpath, dht))
             if not dead:
                 yield prefix, raw_value, dht.ht.value
 

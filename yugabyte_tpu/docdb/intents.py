@@ -125,16 +125,22 @@ def reverse_index_prefix(txn_id: bytes) -> bytes:
 
 def make_intent_batch(meta: TransactionMetadata,
                       kv_pairs: List[Tuple[bytes, bytes]],
-                      lock_entries: List[Tuple[bytes, IntentType]]
+                      lock_entries: List[Tuple[bytes, IntentType]],
+                      write_id_base: int = 0
                       ) -> List[Tuple[bytes, bytes]]:
     """Flattened (key_prefix, value) pairs for the intents DB: one strong
     primary intent per written KV (carrying the provisional value), weak
     intents on the prefixes (empty payload), and a reverse-index record per
-    primary intent. The intra-batch index becomes the write_id, matching
-    the regular write path's semantics."""
+    primary intent. write_id_base + intra-batch index becomes the
+    write_id: the base carries the transaction's STATEMENT sequence (the
+    reference's IntraTxnWriteId), so a later statement's writes sort
+    ABOVE an earlier statement's at the shared commit hybrid time — an
+    UPDATE element must not be shadowed by the INSERT's collection
+    marker (ref: docdb/intent.h IntraTxnWriteId)."""
     out: List[Tuple[bytes, bytes]] = []
     seq = 0
-    for write_id, (subdoc_key, value_bytes) in enumerate(kv_pairs):
+    for i, (subdoc_key, value_bytes) in enumerate(kv_pairs):
+        write_id = write_id_base + i
         pk = encode_intent_key(subdoc_key, IntentType.kStrongWrite)
         out.append((pk, encode_intent_value(meta, write_id, value_bytes)))
         out.append((reverse_index_key(meta.txn_id, seq), pk))

@@ -373,7 +373,8 @@ class Tablet:
 
     # ------------------------------------------------------- transactions
     def write_transactional(self, ops: Sequence[QLWriteOp], txn_meta,
-                            timeout_s: float = 10.0) -> HybridTime:
+                            timeout_s: float = 10.0,
+                            write_id_base: int = 0) -> HybridTime:
         """Transactional write: conflict-check, then replicate provisional
         records into the intents DB (ref write_query.cc:464 +
         docdb.h PrepareTransactionWriteBatch). Data becomes visible only
@@ -392,12 +393,22 @@ class Tablet:
             # backfill-ht overrides apply only to regular (non-transactional)
             # writes; intents are always stamped at commit time
             kv_pairs = [(p[0], p[1]) for p in kv_pairs]
+            from yugabyte_tpu.utils.status import Status, StatusError
+            if write_id_base and len(kv_pairs) > (1 << 16):
+                # each statement owns a 2^16 IntraTxnWriteId slot
+                # (client/transaction.py); overflowing into the next
+                # statement's slot would silently re-introduce the
+                # same-commit-ht shadowing bug the slots prevent
+                raise StatusError(Status.InvalidArgument(
+                    f"transaction statement writes {len(kv_pairs)} "
+                    f"sub-writes (max {1 << 16}); split the batch"))
             try:
                 resolve_write_conflicts(self.intents_db, self.regular_db,
                                         lock_batch.entries, txn_meta,
                                         self.status_resolver)
                 intent_items = make_intent_batch(txn_meta, kv_pairs,
-                                                 lock_batch.entries)
+                                                 lock_batch.entries,
+                                                 write_id_base=write_id_base)
                 ht = self.mvcc.add_pending_now()
                 try:
                     self.consensus.submit(intent_items, ht,

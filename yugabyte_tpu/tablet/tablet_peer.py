@@ -403,11 +403,13 @@ class TabletPeer:
         return self.tablet.apply_external_batch(kvs, default_ht_value)
 
     def write_transactional(self, ops, txn_meta,
-                            timeout_s: float = 30.0) -> HybridTime:
+                            timeout_s: float = 30.0,
+                            write_id_base: int = 0) -> HybridTime:
         if not self.raft.is_leader():
             raise NotLeader(self.raft.leader_hint())
         return self.tablet.write_transactional(ops, txn_meta,
-                                               timeout_s=timeout_s)
+                                               timeout_s=timeout_s,
+                                               write_id_base=write_id_base)
 
     def submit_txn_update(self, action: str, txn_id: bytes,
                           commit_ht_value: int = 0,

@@ -82,7 +82,8 @@ class TabletServiceImpl:
               timeout_s: float = 15.0, txn: Optional[dict] = None,
               client_id: Optional[bytes] = None,
               request_id: Optional[int] = None,
-              schema_version: Optional[int] = None) -> dict:
+              schema_version: Optional[int] = None,
+              txn_write_id_base: int = 0) -> dict:
         from yugabyte_tpu.docdb.conflict_resolution import (
             TransactionConflict)
         from yugabyte_tpu.docdb.intents import TransactionMetadata
@@ -111,7 +112,8 @@ class TabletServiceImpl:
             if txn is not None:
                 ht = peer.write_transactional(
                     decoded, TransactionMetadata.from_wire(txn),
-                    timeout_s=timeout_s)
+                    timeout_s=timeout_s,
+                    write_id_base=txn_write_id_base)
             else:
                 ht = peer.write(decoded, timeout_s=timeout_s,
                                 request=request)

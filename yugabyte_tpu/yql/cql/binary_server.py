@@ -91,19 +91,51 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
                         out.extend(select_item_types(schema, [a]))
         return out
 
+    def _marker_in_collection(v) -> bool:
+        if v is P.MARKER:
+            return True
+        if isinstance(v, (list, tuple, set, frozenset)):
+            return any(x is P.MARKER for x in v)
+        if isinstance(v, dict):
+            return any(k is P.MARKER or x is P.MARKER
+                       for k, x in v.items())
+        return False
+
     if isinstance(stmt, P.Insert):
         schema = table_schema(stmt.keyspace, stmt.table)
         out = []
         for c, v in zip(stmt.columns, stmt.values):
+            if schema.column(c).collection is not None \
+                    and _marker_in_collection(v):
+                raise StatusError(Status.NotSupported(
+                    "bind markers in collection values: inline the "
+                    "literal"))
             out.extend(value_marker_types(schema.column(c).type, v))
         return out
     if isinstance(stmt, P.Update):
         schema = table_schema(stmt.keyspace, stmt.table)
-        out = [schema.column(c).type for c, v in stmt.assignments
-               if v is P.MARKER]
+        out = []
+        for c, v in stmt.assignments:
+            base = c[0] if isinstance(c, tuple) else c
+            is_coll = schema.column(base).collection is not None
+            in_rhs = (v[1] if isinstance(v, tuple) and len(v) == 2
+                      and v[0] in ("__append__", "__remove__") else v)
+            if is_coll and (_marker_in_collection(in_rhs)
+                            or (isinstance(c, tuple)
+                                and c[1] is P.MARKER)):
+                raise StatusError(Status.NotSupported(
+                    "bind markers in collection values: inline the "
+                    "literal"))
+            if v is P.MARKER:
+                out.append(schema.column(base).type)
         return out + where_types(schema, stmt.where)
     if isinstance(stmt, P.Delete):
         schema = table_schema(stmt.keyspace, stmt.table)
+        for c in stmt.columns or ():
+            if isinstance(c, tuple) and c[1] is P.MARKER:
+                raise StatusError(Status.NotSupported(
+                    "bind markers in collection element deletes: inline "
+                    "the literal"))
         return where_types(schema, stmt.where)
     if isinstance(stmt, P.Select):
         ks = stmt.keyspace or processor._keyspace

@@ -43,6 +43,67 @@ _CQL_TYPES = {
 }
 
 
+def _parse_collection_type(t: str):
+    """'MAP<TEXT,INT>' -> ("map","TEXT","INT"); 'FROZEN<...>' unwraps.
+    None for scalar types (ref: common/ql_type.h)."""
+    if t.startswith("FROZEN<") and t.endswith(">"):
+        t = t[7:-1]
+    for kind in ("LIST", "SET", "MAP"):
+        if t.startswith(kind + "<") and t.endswith(">"):
+            inner = t[len(kind) + 1:-1].split(",")
+            return (kind.lower(),) + tuple(x.strip() for x in inner)
+    return None
+
+
+def _collection_to_storage(coll: tuple, v):
+    """CQL literal -> the subdocument dict stored under the column
+    (set elements -> {elem: True}; list -> {index: elem})."""
+    if v is P.MARKER or (isinstance(v, (list, tuple, set, frozenset))
+                         and any(x is P.MARKER for x in v)) \
+            or (isinstance(v, dict)
+                and any(k is P.MARKER or x is P.MARKER
+                        for k, x in v.items())):
+        # bind markers inside collection values are not plumbed through
+        # the typed prepared-statement path — fail loudly, not with a
+        # sentinel stored as data
+        raise StatusError(Status.NotSupported(
+            "bind markers in collection values: inline the literal"))
+    kind = coll[0]
+    if kind == "map":
+        if not isinstance(v, dict):
+            raise StatusError(Status.InvalidArgument(
+                f"expected a map literal, got {type(v).__name__}"))
+        return dict(v)
+    if kind == "set":
+        if isinstance(v, dict) and not v:
+            v = set()  # '{}' parses as an empty map literal
+        if not isinstance(v, (set, frozenset, list, tuple)):
+            raise StatusError(Status.InvalidArgument(
+                f"expected a set literal, got {type(v).__name__}"))
+        return {e: True for e in v}
+    if not isinstance(v, (list, tuple)):
+        raise StatusError(Status.InvalidArgument(
+            f"expected a list literal, got {type(v).__name__}"))
+    return {i: e for i, e in enumerate(v)}
+
+
+def _collection_from_storage(coll: tuple, d):
+    """Stored subdocument dict -> the CQL-shaped value (map dict,
+    sorted-element set-as-list, index-ordered list)."""
+    if not isinstance(d, dict):
+        return d
+    kind = coll[0]
+    if kind == "map":
+        return d
+    if kind == "set":
+        try:
+            return sorted(d.keys())
+        except TypeError:
+            return list(d.keys())
+    return [d[k] for k in sorted(d.keys(),
+                                 key=lambda x: (not isinstance(x, int), x))]
+
+
 @dataclass
 class ResultSet:
     columns: List[str] = field(default_factory=list)
@@ -386,6 +447,14 @@ class QLProcessor:
         columns = []
         for n in ordered:
             cql_t = cols_by_name[n].upper()
+            coll = _parse_collection_type(cql_t)
+            if coll is not None:
+                if n in key_order and not cql_t.startswith("FROZEN"):
+                    raise StatusError(Status.InvalidArgument(
+                        f"non-frozen collection {n} cannot be a key"))
+                columns.append(ColumnSchema(n, DataType.BINARY,
+                                            collection=coll))
+                continue
             if cql_t not in _CQL_TYPES:
                 raise StatusError(Status.NotSupported(f"type {cql_t}"))
             columns.append(ColumnSchema(n, _CQL_TYPES[cql_t]))
@@ -421,11 +490,19 @@ class QLProcessor:
                                        for c in schema.range_columns))
             values = {c: v for c, v in bound.items()
                       if c not in key_names}
+            coll_ops = {}
+            for c in list(values):
+                coll = self._collection_of(schema, c)
+                if coll is not None and values[c] is not None:
+                    coll_ops[c] = [("replace",
+                                    _collection_to_storage(coll,
+                                                           values.pop(c)))]
             return table, QLWriteOp(
-                WriteOpKind.INSERT, dk, values,
+                WriteOpKind.INSERT, dk, values, collection_ops=coll_ops,
                 ttl_ms=stmt.ttl_seconds * 1000 if stmt.ttl_seconds else None)
         if isinstance(stmt, P.Update):
             table = self._table(stmt.keyspace, stmt.table)
+            schema = table.schema
             # Bind in statement-text order: SET comes before WHERE.
             assignments = [(c, self._bind(v, params, cursor))
                            for c, v in stmt.assignments]
@@ -434,8 +511,52 @@ class QLProcessor:
             if dk is None or residual:
                 raise StatusError(Status.InvalidArgument(
                     "UPDATE requires the full primary key"))
+            values = {}
+            # ORDERED op list per column: mixed element writes and deletes
+            # in one UPDATE apply in statement order (later wins at the
+            # same path via ascending intra-batch write ids)
+            coll_ops: Dict[str, List[Tuple[str, object]]] = {}
+
+            for c, v in assignments:
+                if isinstance(c, tuple):        # m['k'] = v  /  l[i] = v
+                    col, sub = c
+                    coll = self._collection_of(schema, col)
+                    if coll is None:
+                        raise StatusError(Status.InvalidArgument(
+                            f"{col} is not a collection"))
+                    ops = coll_ops.setdefault(col, [])
+                    if v is None:
+                        ops.append(("del_keys", [sub]))
+                    else:
+                        ops.append(("merge", {sub: v}))
+                    continue
+                coll = self._collection_of(schema, c)
+                if coll is None:
+                    values[c] = v
+                    continue
+                if isinstance(v, tuple) and len(v) == 2 \
+                        and v[0] in ("__append__", "__remove__"):
+                    lit = v[1]
+                    if coll[0] == "list":
+                        # lists store {index: elem}; value-based +/- would
+                        # need read-modify-write — be explicit, not wrong
+                        raise StatusError(Status.NotSupported(
+                            "list +/-: assign the full list"))
+                    if v[0] == "__append__":
+                        coll_ops.setdefault(c, []).append(
+                            ("merge", _collection_to_storage(coll, lit)))
+                    else:
+                        elems = list(lit.keys()) if isinstance(lit, dict) \
+                            else list(lit)
+                        coll_ops.setdefault(c, []).append(
+                            ("del_keys", elems))
+                elif v is None:
+                    values[c] = None  # whole-collection delete (tombstone)
+                else:
+                    coll_ops.setdefault(c, []).append(
+                        ("replace", _collection_to_storage(coll, v)))
             return table, QLWriteOp(
-                WriteOpKind.UPDATE, dk, dict(assignments),
+                WriteOpKind.UPDATE, dk, values, collection_ops=coll_ops,
                 ttl_ms=stmt.ttl_seconds * 1000 if stmt.ttl_seconds else None)
         # Delete
         table = self._table(stmt.keyspace, stmt.table)
@@ -445,9 +566,37 @@ class QLProcessor:
             raise StatusError(Status.InvalidArgument(
                 "DELETE requires the full primary key"))
         if stmt.columns:
+            plain = [c for c in stmt.columns if not isinstance(c, tuple)]
+            coll_ops: Dict[str, List[Tuple[str, object]]] = {}
+            for c in stmt.columns:
+                if isinstance(c, tuple):        # DELETE m['k'] FROM ...
+                    col, sub = c
+                    if self._collection_of(table.schema, col) is None:
+                        raise StatusError(Status.InvalidArgument(
+                            f"{col} is not a collection"))
+                    coll_ops.setdefault(col, []).append(("del_keys",
+                                                         [sub]))
             return table, QLWriteOp(WriteOpKind.DELETE_COLS, dk,
-                                    columns_to_delete=tuple(stmt.columns))
+                                    columns_to_delete=tuple(plain),
+                                    collection_ops=coll_ops)
         return table, QLWriteOp(WriteOpKind.DELETE_ROW, dk)
+
+    @staticmethod
+    def _collection_of(schema, name: str):
+        try:
+            return schema.column(name).collection
+        except KeyError:
+            return None
+
+    def _row_dict(self, schema, row):
+        """Row -> dict with collection columns converted from their
+        subdocument storage form to CQL shapes (map/set/list)."""
+        d = row.to_dict(schema)
+        for c in schema.value_columns:
+            if c.collection is not None and d.get(c.name) is not None:
+                d[c.name] = _collection_from_storage(c.collection,
+                                                     d[c.name])
+        return d
 
     def _select(self, stmt: P.Select, params: List[object],
                 cursor: List[int], page_size: Optional[int] = None,
@@ -559,7 +708,7 @@ class QLProcessor:
         if full_key:
             row = self._client.read_row(table, dk)
             if row is not None:
-                d = row.to_dict(schema)
+                d = self._row_dict(schema, row)
                 if self._match(d, residual):
                     rs.rows.append([f(d, row) for f in item_fns])
             return rs
@@ -611,7 +760,7 @@ class QLProcessor:
                 # no paging token (the resume cursor is ascending-only)
                 collected = []
                 for row in rows:
-                    d = row.to_dict(schema)
+                    d = self._row_dict(schema, row)
                     if tuple(d[c.name] for c in schema.hash_columns) !=                             dk.hash_components:
                         continue
                     if not self._match(d, residual):
@@ -629,7 +778,7 @@ class QLProcessor:
         count = 0
         rows_it = iter(rows)
         for row in rows_it:
-            d = row.to_dict(schema)
+            d = self._row_dict(schema, row)
             if dk is not None and tuple(
                     d[c.name] for c in schema.hash_columns) != \
                     dk.hash_components:

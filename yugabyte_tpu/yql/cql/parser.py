@@ -31,7 +31,7 @@ _TOKEN_RE = re.compile(r"""
     | (?P<number>-?\d+\.\d+|-?\d+)
     | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
     | (?P<param>\$\d+)
-    | (?P<op><=|>=|!=|[=<>(),;*?.+%/-])
+    | (?P<op><=|>=|!=|[=<>(),;*?.+%/\[\]{}:-])
     )""", re.VERBOSE)
 
 
@@ -231,7 +231,53 @@ class Parser:
             return first, self.name()
         return None, first
 
+    def _column_type(self) -> str:
+        """Type name, including collections: LIST<T>, SET<T>, MAP<K,V>,
+        FROZEN<...> (ref: common/ql_type.h). Returned as the canonical
+        text form, e.g. 'MAP<TEXT,INT>'."""
+        t = self.name().upper()
+        if t == "FROZEN" and self.accept_op("<"):
+            inner = self._column_type()
+            self.expect_op(">")
+            return f"FROZEN<{inner}>"
+        if t in ("LIST", "SET", "MAP") and self.accept_op("<"):
+            inner = [self._column_type()]
+            while self.accept_op(","):
+                inner.append(self._column_type())
+            self.expect_op(">")
+            return f"{t}<{','.join(inner)}>"
+        return t
+
     def literal(self):
+        # collection literals: [e, ...] list, {e, ...} set, {k: v, ...} map
+        nxt = self.peek()
+        if nxt == ("op", "["):
+            self.next()
+            out = []
+            if not self.accept_op("]"):
+                out.append(self.literal())
+                while self.accept_op(","):
+                    out.append(self.literal())
+                self.expect_op("]")
+            return out
+        if nxt == ("op", "{"):
+            self.next()
+            if self.accept_op("}"):
+                return {}
+            first = self.literal()
+            if self.accept_op(":"):        # map
+                m = {first: self.literal()}
+                while self.accept_op(","):
+                    k = self.literal()
+                    self.expect_op(":")
+                    m[k] = self.literal()
+                self.expect_op("}")
+                return m
+            s = {first}                    # set
+            while self.accept_op(","):
+                s.add(self.literal())
+            self.expect_op("}")
+            return s
         tok = self.next()
         kind, text = tok
         if kind == "string":
@@ -329,7 +375,7 @@ class Parser:
                 self.expect_op(")")
             else:
                 cname = self.name()
-                ctype = self.name()
+                ctype = self._column_type()
                 columns.append((cname, ctype))
                 if self.accept_kw("PRIMARY", "KEY"):
                     hash_keys.append(cname)
@@ -467,20 +513,46 @@ class Parser:
         assignments = []
         while True:
             col = self.name()
-            self.expect_op("=")
-            assignments.append((col, self.literal()))
+            if self.accept_op("["):
+                # element assignment: m['k'] = v / l[i] = v
+                sub = self.literal()
+                self.expect_op("]")
+                self.expect_op("=")
+                assignments.append(((col, sub), self.literal()))
+            else:
+                self.expect_op("=")
+                nxt = self.peek()
+                if nxt == ("name", col):
+                    # col = col + X (append/merge) | col = col - X (remove)
+                    self.next()
+                    tok = self.next()
+                    if tok[0] != "op" or tok[1] not in ("+", "-"):
+                        raise ParseError(
+                            f"expected + or - after '{col} = {col}'")
+                    tag = "__append__" if tok[1] == "+" else "__remove__"
+                    assignments.append((col, (tag, self.literal())))
+                else:
+                    assignments.append((col, self.literal()))
             if not self.accept_op(","):
                 break
         self.expect_kw("WHERE")
         return Update(ks, table, assignments, self._where(), ttl)
 
+    def _delete_target(self):
+        col = self.name()
+        if self.accept_op("["):
+            sub = self.literal()
+            self.expect_op("]")
+            return (col, sub)
+        return col
+
     def _delete(self) -> Delete:
         cols = None
         if not (self.peek() and self.peek()[0] == "name"
                 and self.peek()[1].upper() == "FROM"):
-            cols = [self.name()]
+            cols = [self._delete_target()]
             while self.accept_op(","):
-                cols.append(self.name())
+                cols.append(self._delete_target())
         self.expect_kw("FROM")
         ks, table = self.qualified_name()
         self.expect_kw("WHERE")

@@ -164,6 +164,13 @@ def encode_value(v, dt: DataType) -> Optional[bytes]:
     """Python value -> CQL [value] payload bytes (None -> null)."""
     if v is None:
         return None
+    if isinstance(v, (dict, list, set, tuple)):
+        # collection columns (v1): JSON text on the wire — readable by
+        # any driver as text; full typed list/set/map encoding is TODO
+        import json as _json
+        if isinstance(v, (set, frozenset)):
+            v = sorted(v, key=repr)
+        return _json.dumps(v, sort_keys=True, default=repr).encode()
     t = cql_type_of(dt)
     if t == TYPE_INT:
         return struct.pack(">i", int(v))
