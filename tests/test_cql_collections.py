@@ -154,3 +154,15 @@ def test_list_plus_minus_rejected(ql):
         ql.execute("UPDATE profiles SET events = events + [3] "
                    "WHERE id = 'u9'")
     assert row(ql, "u9")["events"] == [1, 2]
+
+
+def test_scalar_plus_rejected_and_no_collection_keys(ql):
+    from yugabyte_tpu.utils.status import StatusError
+    ql.execute("INSERT INTO profiles (id, plain) VALUES ('s1', 5)")
+    with pytest.raises(StatusError):
+        ql.execute("UPDATE profiles SET plain = plain + 1 WHERE id = 's1'")
+    with pytest.raises(StatusError):
+        ql.execute("CREATE TABLE badkey (k FROZEN<SET<TEXT>> PRIMARY KEY, "
+                   "v INT)")
+    with pytest.raises(StatusError):
+        ql.execute("INSERT INTO profiles (id, tags) VALUES ('s2', {[1]})")

@@ -679,3 +679,88 @@ class TestPgTypeBreadth:
                    "'03:04:05')")
         assert rows(conn, "SELECT x FROM tsp WHERE i = 1") == \
             [("2026-01-02 03:04:05",)]
+
+
+class TestCaseExpression:
+    """CASE WHEN (searched + simple), ELSE, NULL semantics, nesting with
+    arithmetic and functions (ref: src/postgres ExecEvalCase)."""
+
+    def test_searched_case(self, conn):
+        assert rows(conn, "SELECT pname, CASE WHEN price >= 100 THEN 'big' "
+                          "WHEN price >= 10 THEN 'mid' ELSE 'small' END "
+                          "FROM products ORDER BY pid") == \
+            [("anvil", "big"), ("rope", "mid"), ("glue", "small")]
+
+    def test_simple_case_and_no_else(self, conn):
+        assert rows(conn, "SELECT CASE city WHEN 'london' THEN 1 "
+                          "WHEN 'paris' THEN 2 END FROM customers "
+                          "ORDER BY cid") == \
+            [("1",), ("2",), ("1",), (None,)]
+
+    def test_case_with_arithmetic_and_conditions(self, conn):
+        assert rows(conn, "SELECT CASE WHEN price * 2 > 50 AND pid <> 12 "
+                          "THEN price + 1 ELSE 0 END FROM products "
+                          "ORDER BY pid") == [("101",), ("0",), ("0",)]
+
+    def test_case_null_condition_never_matches(self, conn):
+        conn.query("CREATE TABLE casetest (i INT PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO casetest VALUES (1, NULL), (2, 5)")
+        assert rows(conn, "SELECT CASE WHEN v > 0 THEN 'pos' "
+                          "WHEN v IS NULL THEN 'none' END "
+                          "FROM casetest ORDER BY i") == \
+            [("none",), ("pos",)]
+
+
+class TestSequences:
+    """CREATE SEQUENCE / nextval / SERIAL columns over the master-backed
+    counter (ref: src/postgres/src/backend/commands/sequence.c; YSQL's
+    sequences ride a master-side table)."""
+
+    def test_create_and_nextval(self, conn):
+        conn.query("CREATE SEQUENCE s1 START WITH 10")
+        assert rows(conn, "SELECT nextval('s1')") == [("10",)]
+        assert rows(conn, "SELECT nextval('s1')") == [("11",)]
+        with pytest.raises(PgWireError):
+            conn.query("CREATE SEQUENCE s1")
+        conn.query("CREATE SEQUENCE IF NOT EXISTS s1")  # no error
+        with pytest.raises(PgWireError):
+            conn.query("SELECT nextval('missing_seq')")
+
+    def test_serial_column_autofills(self, conn):
+        conn.query("CREATE TABLE sertab (id SERIAL PRIMARY KEY, "
+                   "name TEXT)")
+        conn.query("INSERT INTO sertab (name) VALUES ('a'), ('b')")
+        conn.query("INSERT INTO sertab (id, name) VALUES (100, 'c')")
+        conn.query("INSERT INTO sertab (name) VALUES ('d')")
+        got = rows(conn, "SELECT id, name FROM sertab ORDER BY id")
+        names = [n for _i, n in got]
+        ids = [int(i) for i, _n in got]
+        assert names == ["a", "b", "d", "c"]
+        assert ids[:3] == [1, 2, 3] and ids[3] == 100
+
+    def test_nextval_in_insert_values(self, conn):
+        conn.query("CREATE SEQUENCE s2")
+        conn.query("CREATE TABLE sv (k INT PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO sv VALUES (nextval('s2'), 7), "
+                   "(nextval('s2'), 8)")
+        assert rows(conn, "SELECT k, v FROM sv ORDER BY k") == \
+            [("1", "7"), ("2", "8")]
+
+    def test_drop_sequence(self, conn):
+        conn.query("CREATE SEQUENCE s3")
+        conn.query("DROP SEQUENCE s3")
+        with pytest.raises(PgWireError):
+            conn.query("SELECT nextval('s3')")
+        with pytest.raises(PgWireError):
+            conn.query("DROP SEQUENCE s3")
+        conn.query("DROP SEQUENCE IF EXISTS s3")
+
+    def test_drop_table_drops_owned_sequence(self, conn):
+        conn.query("CREATE TABLE ot (id SERIAL PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO ot (v) VALUES (1), (2), (3)")
+        conn.query("DROP TABLE ot")
+        conn.query("CREATE TABLE ot (id SERIAL PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO ot (v) VALUES (9)")
+        # PG owned-sequence semantics: the recreated table restarts at 1
+        assert rows(conn, "SELECT id FROM ot") == [("1",)]
+        conn.query("DROP TABLE ot")

@@ -134,6 +134,32 @@ class YBClient:
                     and ctx.get("maybe_applied")):
                 raise
 
+    # ------------------------------------------------------------ sequences
+    # ref: src/postgres sequence.c via the master-backed counter
+    def create_sequence(self, namespace: str, name: str, start: int = 1,
+                        if_not_exists: bool = False) -> None:
+        ctx: Dict[str, bool] = {}
+        try:
+            self._master_call("create_sequence", _retry_ctx=ctx,
+                              namespace=namespace, name=name, start=start,
+                              if_not_exists=if_not_exists)
+        except RemoteError as e:
+            if not (e.status.code == Code.ALREADY_PRESENT
+                    and ctx.get("maybe_applied")):
+                raise
+
+    def drop_sequence(self, namespace: str, name: str,
+                      if_exists: bool = False) -> None:
+        self._master_call("drop_sequence", namespace=namespace, name=name,
+                          if_exists=if_exists)
+
+    def sequence_next(self, namespace: str, name: str,
+                      cache: int = 1) -> int:
+        # NOT idempotent-retried through _retry_ctx: a duplicate allocate
+        # only skips values, which PG sequences explicitly permit
+        return int(self._master_call("sequence_next", namespace=namespace,
+                                     name=name, cache=cache))
+
     def create_table(self, namespace: str, name: str, schema: Schema,
                      num_tablets: int = 4,
                      partition_schema: Optional[PartitionSchema] = None,

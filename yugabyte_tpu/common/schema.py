@@ -43,6 +43,10 @@ class ColumnSchema:
     # (docdb/subdocument.py); `type` stays the element-agnostic BINARY
     # (ref: common/ql_type.h collection types)
     collection: Optional[Tuple[str, ...]] = None
+    # SERIAL columns: name of the master-backed sequence supplying the
+    # default when an INSERT omits the column (ref: PG pg_attrdef +
+    # sequence.c; YSQL's serial -> nextval default)
+    default_seq: Optional[str] = None
 
 
 @dataclass

@@ -22,7 +22,8 @@ from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 def schema_to_wire(schema: Schema) -> dict:
     return {
         "columns": [[c.name, c.type.value, c.nullable, c.sorting.value,
-                     c.dropped, list(c.collection) if c.collection else None]
+                     c.dropped, list(c.collection) if c.collection else None,
+                     c.default_seq]
                     for c in schema.columns],
         "num_hash": schema.num_hash_key_columns,
         "num_range": schema.num_range_key_columns,
@@ -37,7 +38,8 @@ def schema_from_wire(w: dict) -> Schema:
                               SortingType(col[3]),
                               bool(col[4]) if len(col) > 4 else False,
                               tuple(col[5]) if len(col) > 5 and col[5]
-                              else None)
+                              else None,
+                              col[6] if len(col) > 6 else None)
                  for col in w["columns"]],
         num_hash_key_columns=w["num_hash"],
         num_range_key_columns=w["num_range"])

@@ -115,6 +115,7 @@ class CatalogManager:
         self.namespaces: Dict[str, dict] = {}
         self.tables: Dict[str, dict] = {}
         self.tablets: Dict[str, dict] = {}
+        self.sequences: Dict[str, dict] = {}  # "ns.name" -> {next, ...}
         # volatile: tablet_id -> (leader server_id, term); replica acks
         self.tablet_leaders: Dict[str, Tuple[str, int]] = {}
         self._confirmed: Set[Tuple[str, str]] = set()  # (tablet_id, server)
@@ -140,6 +141,7 @@ class CatalogManager:
             namespaces: Dict[str, dict] = {}
             tables: Dict[str, dict] = {}
             tablets: Dict[str, dict] = {}
+            sequences: Dict[str, dict] = {}
             for etype, eid, meta in self.sys.scan_all():
                 if etype == "namespace":
                     namespaces[eid] = meta
@@ -147,9 +149,12 @@ class CatalogManager:
                     tables[eid] = meta
                 elif etype == "tablet":
                     tablets[eid] = meta
+                elif etype == "sequence":
+                    sequences[eid] = meta
             self.namespaces = namespaces
             self.tables = tables
             self.tablets = tablets
+            self.sequences = sequences
             self._confirmed.clear()
             self._replication_cache = None
             self._loaded_term = term
@@ -170,6 +175,54 @@ class CatalogManager:
     def list_namespaces(self) -> List[str]:
         with self._lock:
             return sorted(self.namespaces)
+
+    # ------------------------------------------------------------ sequences
+    # PG sequences (ref: src/postgres/src/backend/commands/sequence.c;
+    # YSQL routes them through the master-side sequences table,
+    # src/yb/yql/pggate pg_sequence_cache). Allocation persists through
+    # the sys catalog BEFORE returning, so a master restart never hands
+    # out a duplicate block.
+    def create_sequence(self, namespace: str, name: str, start: int = 1,
+                        if_not_exists: bool = False) -> None:
+        key = f"{namespace}.{name}"
+        with self._lock:
+            if key in self.sequences:
+                if if_not_exists:
+                    return
+                raise StatusError(Status.AlreadyPresent(
+                    f"sequence {name!r} exists"))
+            meta = {"namespace": namespace, "name": name,
+                    "next": int(start)}
+            self.sys.upsert("sequence", key, meta)
+            self.sequences[key] = meta
+
+    def drop_sequence(self, namespace: str, name: str,
+                      if_exists: bool = False) -> None:
+        key = f"{namespace}.{name}"
+        with self._lock:
+            if key not in self.sequences:
+                if if_exists:
+                    return
+                raise StatusError(Status.NotFound(
+                    f"sequence {name!r} does not exist"))
+            self.sys.delete("sequence", key)
+            del self.sequences[key]
+
+    def sequence_next(self, namespace: str, name: str,
+                      cache: int = 1) -> int:
+        """Allocate [returned, returned+cache) and persist the advance."""
+        key = f"{namespace}.{name}"
+        cache = max(1, int(cache))
+        with self._lock:
+            meta = self.sequences.get(key)
+            if meta is None:
+                raise StatusError(Status.NotFound(
+                    f"sequence {name!r} does not exist"))
+            val = int(meta["next"])
+            meta = dict(meta, next=val + cache)
+            self.sys.upsert("sequence", key, meta)
+            self.sequences[key] = meta
+            return val
 
     def _find_table(self, namespace: str, name: str) -> Optional[str]:
         for tid, t in self.tables.items():

@@ -68,6 +68,21 @@ class MasterService:
         self._leader_catalog().create_namespace(name)
         return True
 
+    def create_sequence(self, namespace: str, name: str, start: int = 1,
+                        if_not_exists: bool = False) -> bool:
+        self._leader_catalog().create_sequence(namespace, name, start,
+                                               if_not_exists)
+        return True
+
+    def drop_sequence(self, namespace: str, name: str,
+                      if_exists: bool = False) -> bool:
+        self._leader_catalog().drop_sequence(namespace, name, if_exists)
+        return True
+
+    def sequence_next(self, namespace: str, name: str,
+                      cache: int = 1) -> int:
+        return self._leader_catalog().sequence_next(namespace, name, cache)
+
     def create_table(self, namespace: str, name: str, schema: dict,
                      partition_schema: dict, num_tablets: int,
                      replication_factor: Optional[int] = None) -> dict:

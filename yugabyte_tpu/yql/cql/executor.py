@@ -449,9 +449,11 @@ class QLProcessor:
             cql_t = cols_by_name[n].upper()
             coll = _parse_collection_type(cql_t)
             if coll is not None:
-                if n in key_order and not cql_t.startswith("FROZEN"):
-                    raise StatusError(Status.InvalidArgument(
-                        f"non-frozen collection {n} cannot be a key"))
+                if n in key_order:
+                    # FROZEN keys would need a canonical bytes encoding of
+                    # the collection as a DocKey component — unsupported
+                    raise StatusError(Status.NotSupported(
+                        f"collection column {n} cannot be a key"))
                 columns.append(ColumnSchema(n, DataType.BINARY,
                                             collection=coll))
                 continue
@@ -532,6 +534,11 @@ class QLProcessor:
                     continue
                 coll = self._collection_of(schema, c)
                 if coll is None:
+                    if isinstance(v, tuple) and len(v) == 2 \
+                            and v[0] in ("__append__", "__remove__"):
+                        raise StatusError(Status.InvalidArgument(
+                            f"{c} is not a collection: col = col +/- X "
+                            f"applies to collections only"))
                     values[c] = v
                     continue
                 if isinstance(v, tuple) and len(v) == 2 \

@@ -264,20 +264,24 @@ class Parser:
             self.next()
             if self.accept_op("}"):
                 return {}
-            first = self.literal()
-            if self.accept_op(":"):        # map
-                m = {first: self.literal()}
+            try:
+                first = self.literal()
+                if self.accept_op(":"):        # map
+                    m = {first: self.literal()}
+                    while self.accept_op(","):
+                        k = self.literal()
+                        self.expect_op(":")
+                        m[k] = self.literal()
+                    self.expect_op("}")
+                    return m
+                s = {first}                    # set
                 while self.accept_op(","):
-                    k = self.literal()
-                    self.expect_op(":")
-                    m[k] = self.literal()
+                    s.add(self.literal())
                 self.expect_op("}")
-                return m
-            s = {first}                    # set
-            while self.accept_op(","):
-                s.add(self.literal())
-            self.expect_op("}")
-            return s
+                return s
+            except TypeError:
+                raise ParseError(
+                    "set/map literal elements must be hashable scalars")
         tok = self.next()
         kind, text = tok
         if kind == "string":

@@ -390,15 +390,50 @@ class PgSession:
             raise PgError(Status.NotSupported("DROP DATABASE"), "0A000")
         if isinstance(stmt, P.CreateTable):
             return self._create_table(stmt)
+        if isinstance(stmt, P.CreateSequence):
+            try:
+                self._client.create_sequence(
+                    self.database, stmt.name, start=stmt.start,
+                    if_not_exists=stmt.if_not_exists)
+            except StatusError as e:
+                if e.status.code != Code.ALREADY_PRESENT:
+                    raise _pg_error(e) from e
+                if not stmt.if_not_exists:
+                    raise PgError(Status.AlreadyPresent(
+                        f'sequence "{stmt.name}" already exists'),
+                        "42P07") from e
+            return PgResult("CREATE SEQUENCE")
+        if isinstance(stmt, P.DropSequence):
+            try:
+                self._client.drop_sequence(self.database, stmt.name,
+                                           if_exists=stmt.if_exists)
+            except StatusError as e:
+                if e.status.code != Code.NOT_FOUND:
+                    raise _pg_error(e) from e
+                if not stmt.if_exists:
+                    raise PgError(Status.NotFound(
+                        f'sequence "{stmt.name}" does not exist'),
+                        "42P01") from e
+            return PgResult("DROP SEQUENCE")
         if isinstance(stmt, P.CreateIndex):
             return self._create_index(stmt)
         if isinstance(stmt, P.DropTable):
+            owned_seqs = []
+            try:
+                t = self._table(stmt.name)
+                owned_seqs = [c.default_seq for c in t.schema.columns
+                              if c.default_seq]
+            except StatusError:
+                pass
             try:
                 self._client.delete_table(self.database, stmt.name)
             except StatusError as e:
                 if not (stmt.if_exists
                         and e.status.code == Code.NOT_FOUND):
                     raise
+            for seq in owned_seqs:  # PG drops owned sequences with the table
+                self._client.drop_sequence(self.database, seq,
+                                           if_exists=True)
             self._tables.pop(stmt.name, None)
             return PgResult("DROP TABLE")
         if isinstance(stmt, P.Insert):
@@ -438,6 +473,10 @@ class PgSession:
         try:
             # parser carries DataType NAMES ("INT32"); the master's wire
             # takes enum values ("int32")
+            for _c, t in stmt.add_columns:
+                if t == "SERIAL":
+                    raise PgError(Status.NotSupported(
+                        "ALTER TABLE ADD COLUMN ... SERIAL"), "0A000")
             self._client.alter_table(
                 self.database, stmt.table,
                 add_columns=[(c, DataType[t].value)
@@ -494,8 +533,19 @@ class PgSession:
         # YSQL default: first PK column hash-partitions, the rest are
         # range components (ref: YSQL PRIMARY KEY (a HASH, b ASC) default)
         ordered = stmt.pk + [n for n, _t in stmt.columns if n not in stmt.pk]
-        columns = [ColumnSchema(n, DataType[cols_by_name[n]])
-                   for n in ordered]
+        columns = []
+        serial_seqs = []
+        for n in ordered:
+            t = cols_by_name[n]
+            if t == "SERIAL":
+                # SERIAL = INT64 + implicit sequence default (ref: PG
+                # pg_attrdef nextval('<table>_<col>_seq'))
+                seq = f"{stmt.name}_{n}_seq"
+                serial_seqs.append(seq)
+                columns.append(ColumnSchema(n, DataType.INT64,
+                                            default_seq=seq))
+            else:
+                columns.append(ColumnSchema(n, DataType[t]))
         schema = Schema(columns=columns, num_hash_key_columns=1,
                         num_range_key_columns=len(stmt.pk) - 1)
         try:
@@ -505,6 +555,13 @@ class PgSession:
             if not (stmt.if_not_exists
                     and e.status.code == Code.ALREADY_PRESENT):
                 raise
+            return PgResult("CREATE TABLE")
+        # owned sequences AFTER a successful create (a failed table
+        # create must not leave orphans); DROP TABLE drops them, so a
+        # recreated table restarts at 1 (PG owned-sequence semantics)
+        for seq in serial_seqs:
+            self._client.create_sequence(self.database, seq,
+                                         if_not_exists=True)
         return PgResult("CREATE TABLE")
 
     def _create_index(self, stmt: P.CreateIndex) -> PgResult:
@@ -555,6 +612,17 @@ class PgSession:
         key_names = [c.name for c in schema.hash_columns] + \
             [c.name for c in schema.range_columns]
         ops = []
+        # one sequence_next(cache=N) per SERIAL column for the WHOLE
+        # multi-row INSERT (one master RPC, not one per row; PG caches
+        # sequence blocks the same way)
+        serial_fill: Dict[str, List[int]] = {}
+        for c in schema.columns:
+            if c.default_seq is None or c.name in columns:
+                continue  # column bound explicitly: no default draw
+            n_missing = len(stmt.rows)
+            base = self._client.sequence_next(
+                self.database, c.default_seq, cache=n_missing)
+            serial_fill[c.name] = list(range(base, base + n_missing))
         for row in stmt.rows:
             if len(row) != len(columns):
                 raise PgError(Status.InvalidArgument(
@@ -562,11 +630,23 @@ class PgSession:
                     "42601")
             bound = dict(zip(columns, row))
             for c in list(bound):
+                v = bound[c]
+                if isinstance(v, tuple) and len(v) == 2 \
+                        and v[0] == "__nextval__":
+                    v = self._client.sequence_next(self.database, v[1])
                 try:
-                    bound[c] = pg_coerce(schema.column(c).type, bound[c])
+                    bound[c] = pg_coerce(schema.column(c).type, v)
                 except KeyError:
                     raise PgError(Status.InvalidArgument(
                         f'column "{c}" does not exist'), "42703")
+            # SERIAL defaults: omitted columns draw from the statement's
+            # pre-allocated block (ref: PG ExecEvalNextValueExpr)
+            for c in schema.columns:
+                if c.default_seq is not None and c.name not in bound:
+                    fill = serial_fill.get(c.name)
+                    bound[c.name] = (fill.pop(0) if fill else
+                                     self._client.sequence_next(
+                                         self.database, c.default_seq))
             missing = [k for k in key_names if k not in bound]
             if missing:
                 raise PgError(Status.InvalidArgument(
@@ -1445,6 +1525,11 @@ class PgSession:
     def _select(self, stmt) -> PgResult:
         if isinstance(stmt, P.UnionSelect):
             return self._select_union(stmt)
+        if getattr(stmt, "table", None) is None and stmt.scalar_items:
+            # FROM-less scalar SELECT: one row over an empty binding
+            col_desc, rows_out = self._project_scalar(
+                stmt.scalar_items, Schema(columns=[]), [{}])
+            return PgResult(f"SELECT {len(rows_out)}", col_desc, rows_out)
         if stmt.or_where:
             return self._select_or(stmt)
         resolved, always_false = self._resolve_subqueries(stmt)
@@ -1505,6 +1590,62 @@ class PgSession:
             if it[0] == "lit":
                 v = it[1]
                 return bfunc.infer_type(v), (lambda d, _v=v: _v)
+            if it[0] == "case":
+                # CASE: first matching WHEN wins; no match and no ELSE ->
+                # NULL (PG ExecEvalCase). Conditions use SQL three-valued
+                # logic: a NULL comparison never matches.
+                def compile_cond(c):
+                    if c[0] == "cmp":
+                        _t1, lf = compile_item(c[2])
+                        _t2, rf = compile_item(c[3])
+                        o = c[1]
+
+                        def ev(d, _lf=lf, _rf=rf, _o=o):
+                            a, b = _lf(d), _rf(d)
+                            if a is None or b is None:
+                                return False
+                            try:
+                                return {"=": a == b, "!=": a != b,
+                                        "<": a < b, "<=": a <= b,
+                                        ">": a > b, ">=": a >= b}[_o]
+                            except TypeError:
+                                raise PgError(Status.InvalidArgument(
+                                    f"CASE comparison between "
+                                    f"{type(a).__name__} and "
+                                    f"{type(b).__name__}"), "42883")
+                        return ev
+                    if c[0] == "isnull":
+                        _t, f = compile_item(c[1])
+                        neg = c[2]
+                        return lambda d, _f=f, _n=neg: \
+                            (_f(d) is not None) if _n else (_f(d) is None)
+                    subs = [compile_cond(x) for x in c[1]]
+                    if c[0] == "and":
+                        return lambda d, _s=subs: all(f(d) for f in _s)
+                    return lambda d, _s=subs: any(f(d) for f in _s)
+
+                branches = [(compile_cond(cond), compile_item(res))
+                            for cond, res in it[1]]
+                els = compile_item(it[2]) if it[2] is not None else None
+                types = [t for _c, (t, _f) in branches if t is not None]
+                if els is not None and els[0] is not None:
+                    types.append(els[0])
+                out_t = None
+                if types:
+                    out_t = (DataType.DOUBLE
+                             if any(t in (DataType.DOUBLE, DataType.FLOAT)
+                                    for t in types)
+                             and all(t in (DataType.DOUBLE, DataType.FLOAT,
+                                           DataType.INT64, DataType.INT32)
+                                     for t in types)
+                             else types[0])
+
+                def ev_case(d, _b=branches, _e=els):
+                    for cf, (_t, rf) in _b:
+                        if cf(d):
+                            return rf(d)
+                    return _e[1](d) if _e is not None else None
+                return out_t, ev_case
             if it[0] == "op":
                 # arithmetic with SQL NULL propagation and PG numeric
                 # typing (int op int -> int, '/' truncates; any float
@@ -1555,6 +1696,17 @@ class PgSession:
                         raise PgError(Status.InvalidArgument(
                             "division by zero"), "22012")
                 return out_t, ev_op
+            if str(it[1]).lower() == "nextval":
+                # sequence allocation is a CLIENT call, not a pure builtin
+                # (ref: PG ExecEvalNextValueExpr -> nextval_internal)
+                if len(it[2]) != 1 or it[2][0][0] != "lit":
+                    raise PgError(Status.InvalidArgument(
+                        "nextval takes one literal sequence name"),
+                        "42883")
+                seq = it[2][0][1]
+                return DataType.INT64, (
+                    lambda d, _s=seq: self._client.sequence_next(
+                        self.database, _s))
             sub = [compile_item(a) for a in it[2]]
             try:
                 decl = bfunc.resolve(it[1], [t for t, _f in sub])
@@ -1587,6 +1739,8 @@ class PgSession:
         for it in items:
             if it[0] == "func":
                 label = it[1].lower()
+            elif it[0] == "case":
+                label = "case"       # PG's label for CASE expressions
             elif it[0] in ("op", "lit"):
                 label = "?column?"   # PG's label for anonymous expressions
             else:

@@ -36,6 +36,9 @@ PG_TYPES = {
     # NUMERIC/DECIMAL approximate as binary double (documented deviation
     # from PG's arbitrary precision; matches the framework value layer)
     "NUMERIC": "DOUBLE", "DECIMAL": "DOUBLE",
+    # SERIAL/BIGSERIAL: INT64 + an implicit sequence default; the marker
+    # survives to the executor which creates <table>_<col>_seq
+    "SERIAL": "SERIAL", "BIGSERIAL": "SERIAL", "SMALLSERIAL": "SERIAL",
 }
 
 
@@ -166,6 +169,19 @@ class Delete:
 
 
 @dataclass
+class CreateSequence:
+    name: str
+    start: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class TxnControl:
     kind: str                          # begin | commit | rollback
 
@@ -202,7 +218,8 @@ class CloseCursor:
 
 Statement = Union[CreateDatabase, DropDatabase, CreateTable, DropTable,
                   Insert, Select, Update, Delete, TxnControl, Show,
-                  AlterTable, DeclareCursor, FetchCursor, CloseCursor]
+                  AlterTable, DeclareCursor, FetchCursor, CloseCursor,
+                  CreateSequence, DropSequence]
 
 
 class PgParser(_BaseParser):
@@ -211,6 +228,14 @@ class PgParser(_BaseParser):
         if tok is not None and tok[0] == "param":
             self.next()
             return Param(int(tok[1][1:]))
+        if tok is not None and tok[0] == "name" \
+                and tok[1].lower() == "nextval" \
+                and self._peek2() == ("op", "("):
+            self.next()
+            self.expect_op("(")
+            seq = super().literal()
+            self.expect_op(")")
+            return ("__nextval__", seq)
         return super().literal()
 
     def parse_one(self) -> Optional[Statement]:
@@ -222,6 +247,18 @@ class PgParser(_BaseParser):
             return DropDatabase(self.name())
         if self.accept_kw("CREATE", "TABLE"):
             return self._create_table()
+        if self.accept_kw("CREATE", "SEQUENCE"):
+            # ref: src/postgres sequence.c DefineSequence
+            ine = bool(self.accept_kw("IF", "NOT", "EXISTS"))
+            name = self.name()
+            start = 1
+            if self.accept_kw("START"):
+                self.accept_kw("WITH")
+                start = int(self.literal())
+            return CreateSequence(name, start, ine)
+        if self.accept_kw("DROP", "SEQUENCE"):
+            ife = bool(self.accept_kw("IF", "EXISTS"))
+            return DropSequence(self.name(), ife)
         if self.accept_kw("CREATE", "INDEX"):
             # CREATE INDEX [IF NOT EXISTS] [name] ON table (column)
             # (ref: YSQL index DDL, parsed by the PG grammar and executed
@@ -495,12 +532,70 @@ class PgParser(_BaseParser):
             e = self._arith_expr()
             self.expect_op(")")
             return e
+        if tok is not None and tok[0] == "name" \
+                and tok[1].upper() == "CASE":
+            return self._case_expr()
         if tok is not None and tok[0] == "name" and nxt == ("op", "("):
             return self._scalar_func()
         if tok is not None and tok[0] == "name" \
                 and tok[1].upper() not in ("TRUE", "FALSE", "NULL"):
             return ("col", self._col_ref())
         return ("lit", self.literal())
+
+    # CASE (ref: PG a_expr CaseExpr, src/postgres gram.y case_expr):
+    # searched  CASE WHEN cond THEN expr ... [ELSE expr] END
+    # simple    CASE expr WHEN val THEN expr ... [ELSE expr] END
+    # -> ("case", [(cond, result_expr)...], else_expr_or_None) with cond
+    # one of ("cmp", op, l, r) | ("isnull", expr, negated) |
+    # ("and"|"or", [conds])
+    def _case_expr(self):
+        self.expect_kw("CASE")
+        base = None
+        if not (self.peek() is not None and self.peek()[0] == "name"
+                and self.peek()[1].upper() == "WHEN"):
+            base = self._arith_expr()
+        whens = []
+        while self.accept_kw("WHEN"):
+            if base is not None:
+                cond = ("cmp", "=", base, self._arith_expr())
+            else:
+                cond = self._case_cond()
+            self.expect_kw("THEN")
+            whens.append((cond, self._arith_expr()))
+        if not whens:
+            raise ParseError("CASE requires at least one WHEN")
+        els = self._arith_expr() if self.accept_kw("ELSE") else None
+        self.expect_kw("END")
+        return ("case", whens, els)
+
+    _CMP_OPS = ("=", "!=", "<>", "<", "<=", ">", ">=")
+
+    def _case_cond(self):
+        conds = [self._case_cond_and()]
+        while self.accept_kw("OR"):
+            conds.append(self._case_cond_and())
+        return conds[0] if len(conds) == 1 else ("or", conds)
+
+    def _case_cond_and(self):
+        conds = [self._case_cond_one()]
+        while self.accept_kw("AND"):
+            conds.append(self._case_cond_one())
+        return conds[0] if len(conds) == 1 else ("and", conds)
+
+    def _case_cond_one(self):
+        left = self._arith_expr()
+        if self.accept_kw("IS"):
+            neg = bool(self.accept_kw("NOT"))
+            self.expect_kw("NULL")
+            return ("isnull", left, neg)
+        tok = self.next()
+        if tok[0] != "op" or tok[1] not in self._CMP_OPS:
+            raise ParseError(
+                f"expected comparison in CASE WHEN, got {tok[1]!r}")
+        op = tok[1]
+        if op == "<" and self.accept_op(">"):
+            op = "!="  # '<>' lexes as two tokens
+        return ("cmp", op, left, self._arith_expr())
 
     def _scalar_func(self):
         fname = self.name()
@@ -567,7 +662,8 @@ class PgParser(_BaseParser):
                 items.append(self._select_item())
             aggs = [i for i in items if i[0] == "agg"]
             cols = [i[1] for i in items if i[0] == "col"]
-            exprs = [i for i in items if i[0] in ("func", "op", "lit")]
+            exprs = [i for i in items
+                     if i[0] in ("func", "op", "lit", "case")]
             if aggs and exprs:
                 raise ParseError(
                     "mixing aggregates and scalar expressions in one "
@@ -588,6 +684,21 @@ class PgParser(_BaseParser):
                         return out
                     if it[0] == "op":
                         return _refs(it[2]) + _refs(it[3])
+                    if it[0] == "case":
+                        out = []
+
+                        def _cond_refs(c):
+                            if c[0] == "cmp":
+                                return _refs(c[2]) + _refs(c[3])
+                            if c[0] == "isnull":
+                                return _refs(c[1])
+                            return [r for x in c[1] for r in _cond_refs(x)]
+                        for cond, res in it[1]:
+                            out.extend(_cond_refs(cond))
+                            out.extend(_refs(res))
+                        if it[2] is not None:
+                            out.extend(_refs(it[2]))
+                        return out
                     return []
                 seen = []
                 for it in items:
@@ -597,6 +708,12 @@ class PgParser(_BaseParser):
                 columns = seen or None
             else:
                 columns = cols
+        if scalar_items and not (self.peek() is not None
+                                 and self.peek()[0] == "name"
+                                 and self.peek()[1].upper() == "FROM"):
+            # FROM-less scalar SELECT (PG: SELECT nextval('s'), 1 + 2)
+            return Select(table=None, columns=None,
+                          scalar_items=scalar_items)
         self.expect_kw("FROM")
         name = self._table_name()
         alias = self._maybe_alias()
