@@ -764,3 +764,91 @@ class TestSequences:
         # PG owned-sequence semantics: the recreated table restarts at 1
         assert rows(conn, "SELECT id FROM ot") == [("1",)]
         conn.query("DROP TABLE ot")
+
+
+class TestJsonb:
+    """YSQL jsonb columns + -> / ->> over the real wire (ref: PG jsonb
+    operators src/postgres jsonfuncs.c; YB stores jsonb as sorted binary,
+    common/jsonb.h — our canonical sorted-key text keeps the same
+    deterministic-comparison property). Predicates push down to the
+    tserver scan as ("jsonb", col, path, as_text) filter lhs."""
+
+    @pytest.fixture(scope="class", autouse=True)
+    def jevents(self, conn):
+        conn.query("CREATE TABLE jevents (eid INT PRIMARY KEY, "
+                   "meta JSONB, note TEXT)")
+        conn.query('INSERT INTO jevents (eid, meta, note) VALUES '
+                   '(1, \'{"kind": "click", "pos": {"x": 3, "y": 9}}\', '
+                   "'first'), "
+                   '(2, \'{"kind": "scroll", "delta": [1, 2, 5]}\', '
+                   "'second'), "
+                   "(3, NULL, 'third')")
+        yield
+        conn.query("DROP TABLE jevents")
+
+    def test_roundtrip_canonical(self, conn):
+        assert rows(conn, "SELECT meta FROM jevents WHERE eid = 1") == \
+            [('{"kind":"click","pos":{"x":3,"y":9}}',)]
+
+    def test_arrow_chain_and_text(self, conn):
+        assert rows(conn, "SELECT meta->'pos'->>'x' FROM jevents "
+                    "WHERE eid = 1") == [("3",)]
+        assert rows(conn, "SELECT meta->'pos' FROM jevents "
+                    "WHERE eid = 1") == [('{"x":3,"y":9}',)]
+        assert rows(conn, "SELECT meta->'delta'->1 FROM jevents "
+                    "WHERE eid = 2") == [("2",)]
+
+    def test_oid_is_jsonb(self, conn):
+        res = conn.query("SELECT meta FROM jevents WHERE eid = 1")[0]
+        assert res.columns == [("meta", 3802)]
+        # extracted text is type text
+        res = conn.query("SELECT meta->>'kind' FROM jevents "
+                         "WHERE eid = 1")[0]
+        assert res.columns[0][1] == 25
+
+    def test_pushdown_predicate(self, conn):
+        assert rows(conn, "SELECT eid FROM jevents "
+                    "WHERE meta->>'kind' = 'scroll'") == [("2",)]
+        assert rows(conn, "SELECT note FROM jevents "
+                    "WHERE meta->'pos'->>'y' = '9'") == [("first",)]
+
+    def test_missing_path_and_null_doc(self, conn):
+        assert rows(conn, "SELECT meta->'nope' FROM jevents "
+                    "WHERE eid = 1") == [(None,)]
+        assert rows(conn, "SELECT meta->'kind' FROM jevents "
+                    "WHERE eid = 3") == [(None,)]
+
+    def test_whole_doc_equality_canonicalizes(self, conn):
+        # literal with different key order / spacing still matches
+        assert rows(conn, "SELECT eid FROM jevents WHERE meta = "
+                    '\'{"pos": {"y": 9, "x": 3}, "kind": "click"}\'') \
+            == [("1",)]
+
+    def test_invalid_json_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO jevents (eid, meta) VALUES "
+                       "(9, '{broken')")
+
+    def test_jsonb_pk_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("CREATE TABLE bad (j JSONB PRIMARY KEY)")
+
+    def test_update_jsonb(self, conn):
+        conn.query('UPDATE jevents SET meta = \'{"kind": "drag"}\' '
+                   "WHERE eid = 2")
+        assert rows(conn, "SELECT meta->>'kind' FROM jevents "
+                    "WHERE eid = 2") == [("drag",)]
+
+    def test_where_json_equality_canonicalizes(self, conn):
+        # -> output comparisons match across key order / spacing
+        assert rows(conn, "SELECT eid FROM jevents WHERE meta->'pos' = "
+                    '\'{"y": 9,  "x": 3}\'') == [("1",)]
+
+    def test_where_arrow_on_text_column_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("SELECT eid FROM jevents WHERE note->>'a' = '1'")
+
+    def test_nan_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO jevents (eid, meta) VALUES "
+                       "(9, 'NaN')")

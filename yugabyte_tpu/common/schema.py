@@ -21,6 +21,12 @@ class DataType(enum.Enum):
     BINARY = "binary"
     BOOL = "bool"
     TIMESTAMP = "timestamp"
+    # JSONB documents: stored as canonical compact JSON text (object keys
+    # sorted) — the functional equivalent of the reference's binary jsonb
+    # serialization, which also sorts object keys for searchability
+    # (ref: src/yb/common/jsonb.h:40-44). Path navigation happens in the
+    # query layer (-> / ->> operators).
+    JSONB = "jsonb"
 
 
 class SortingType(enum.Enum):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from yugabyte_tpu.common import jsonb
 from yugabyte_tpu.common.partition import Partition, PartitionSchema
 from yugabyte_tpu.common.schema import (
     ColumnSchema, DataType, Schema, SortingType)
@@ -180,11 +181,21 @@ _LIKE_CACHE: dict = {}
 
 
 def row_matches(row_dict: dict, filters) -> bool:
-    """Conjunction of [col, op, value] triples over a name->value dict."""
+    """Conjunction of [col, op, value] triples over a name->value dict.
+
+    col is normally a column name; a ["jsonb", column, path, as_text]
+    list applies a jsonb -> / ->> chain before comparing — the pushdown
+    form of jsonb predicates (ref: pggate pushes jsonb operators to the
+    tserver scan in PgDocOp; common/jsonb.cc evaluates them there)."""
     for col, op, value in filters:
         fn = FILTER_OPS.get(op)
         if fn is None:
             raise ValueError(f"unsupported filter op {op!r}")
-        if not fn(row_dict.get(col), value):
+        if isinstance(col, (list, tuple)) and len(col) == 4 \
+                and col[0] == "jsonb":
+            have = jsonb.navigate(row_dict.get(col[1]), col[2], col[3])
+        else:
+            have = row_dict.get(col)
+        if not fn(have, value):
             return False
     return True

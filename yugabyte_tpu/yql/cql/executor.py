@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from yugabyte_tpu.client.client import YBClient, YBTable
 from yugabyte_tpu.client.transaction import (
     TransactionError, TransactionManager)
+from yugabyte_tpu.common import jsonb
 from yugabyte_tpu.common.hybrid_time import HybridTime
 from yugabyte_tpu.common.schema import (
     ColumnSchema, DataType, Schema, SortingType)
@@ -40,7 +41,19 @@ _CQL_TYPES = {
     "BOOLEAN": DataType.BOOL, "BLOB": DataType.BINARY,
     "TIMESTAMP": DataType.TIMESTAMP, "UUID": DataType.STRING,
     "TIMEUUID": DataType.STRING, "VARINT": DataType.INT64,
+    "JSONB": DataType.JSONB,
 }
+
+
+def _jsonb_canonical(v) -> str:
+    """Canonicalize a JSONB literal (common/jsonb.py) with CQL errors."""
+    try:
+        return jsonb.canonicalize(v)
+    except ValueError as e:
+        raise StatusError(Status.InvalidArgument(f"invalid json: {e}"))
+
+
+_jsonb_navigate = jsonb.navigate
 
 
 def _parse_collection_type(t: str):
@@ -228,6 +241,14 @@ class QLProcessor:
             return f"{item.name.lower()}({inner})"
         if isinstance(item, P.ColumnRef):
             return item.name
+        if isinstance(item, P.JsonOp):
+            out = item.column
+            for i, step in enumerate(item.path):
+                arrow = "->>" if (item.as_text
+                                  and i == len(item.path) - 1) else "->"
+                out += f"{arrow}{step!r}" if isinstance(step, int) \
+                    else f"{arrow}'{step}'"
+            return out
         return str(item)
 
     def _item_type(self, item, known, as_column: bool = True):
@@ -244,6 +265,11 @@ class QLProcessor:
             return d.ret_type if d.ret_type is not bfunc.ANY else None
         if isinstance(item, P.ColumnRef):
             return known.get(item.name)
+        if isinstance(item, P.JsonOp):
+            if known.get(item.column) is not DataType.JSONB:
+                raise StatusError(Status.InvalidArgument(
+                    f"{item.column} is not a jsonb column"))
+            return DataType.STRING if item.as_text else DataType.JSONB
         if isinstance(item, str) and as_column:
             return known.get(item)
         return bfunc.infer_type(item)
@@ -259,6 +285,9 @@ class QLProcessor:
             return lambda d, row, _c=item: d.get(_c)
         if isinstance(item, P.ColumnRef):
             return lambda d, row, _c=item.name: d.get(_c)
+        if isinstance(item, P.JsonOp):
+            return lambda d, row, _j=item: _jsonb_navigate(
+                d.get(_j.column), _j.path, _j.as_text)
         if isinstance(item, P.FuncCall):
             name = item.name.lower()
             if name == "writetime":
@@ -323,7 +352,11 @@ class QLProcessor:
         ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
                ">": operator.gt, "<=": operator.le, ">=": operator.ge}
         for col, op, val in residual:
-            have = row_dict.get(col)
+            if isinstance(col, P.JsonOp):
+                have = _jsonb_navigate(row_dict.get(col.column),
+                                       col.path, col.as_text)
+            else:
+                have = row_dict.get(col)
             if have is None:
                 return False
             if op == "in":
@@ -379,7 +412,12 @@ class QLProcessor:
             return self._create_table(stmt)
         if isinstance(stmt, P.DropTable):
             ks = self._resolve_ks(stmt.keyspace)
-            self._client.delete_table(ks, stmt.name)
+            try:
+                self._client.delete_table(ks, stmt.name)
+            except StatusError as e:
+                if not (stmt.if_exists
+                        and e.status.code.name == "NOT_FOUND"):
+                    raise
             with self._lock:
                 self._tables.pop((ks, stmt.name), None)
             return ResultSet()
@@ -459,6 +497,11 @@ class QLProcessor:
                 continue
             if cql_t not in _CQL_TYPES:
                 raise StatusError(Status.NotSupported(f"type {cql_t}"))
+            if _CQL_TYPES[cql_t] is DataType.JSONB and n in key_order:
+                # jsonb has no order-preserving key encoding (the
+                # reference likewise rejects jsonb primary keys)
+                raise StatusError(Status.NotSupported(
+                    f"jsonb column {n} cannot be a key"))
             columns.append(ColumnSchema(n, _CQL_TYPES[cql_t]))
         schema = Schema(columns=columns,
                         num_hash_key_columns=len(stmt.hash_keys),
@@ -499,6 +542,8 @@ class QLProcessor:
                     coll_ops[c] = [("replace",
                                     _collection_to_storage(coll,
                                                            values.pop(c)))]
+                elif values[c] is not None and self._is_jsonb(schema, c):
+                    values[c] = _jsonb_canonical(values[c])
             return table, QLWriteOp(
                 WriteOpKind.INSERT, dk, values, collection_ops=coll_ops,
                 ttl_ms=stmt.ttl_seconds * 1000 if stmt.ttl_seconds else None)
@@ -539,6 +584,8 @@ class QLProcessor:
                         raise StatusError(Status.InvalidArgument(
                             f"{c} is not a collection: col = col +/- X "
                             f"applies to collections only"))
+                    if v is not None and self._is_jsonb(schema, c):
+                        v = _jsonb_canonical(v)
                     values[c] = v
                     continue
                 if isinstance(v, tuple) and len(v) == 2 \
@@ -595,6 +642,39 @@ class QLProcessor:
         except KeyError:
             return None
 
+    @staticmethod
+    def _canon_jsonb_where(where, known):
+        """Jsonb predicates: reject -> on non-jsonb columns, and
+        canonicalize comparison values where the lhs yields json text
+        (whole-document equality, or a -> chain without ->>) so equal
+        documents match regardless of literal spelling — the stored form
+        is canonical (common/jsonb.py)."""
+        out = []
+        for c, op, v in where:
+            canon = False
+            if isinstance(c, P.JsonOp):
+                if known.get(c.column) is not DataType.JSONB:
+                    raise StatusError(Status.InvalidArgument(
+                        f"{c.column} is not a jsonb column"))
+                canon = not c.as_text
+            elif isinstance(c, str) and known.get(c) is DataType.JSONB:
+                canon = True
+            if canon and v is not None:
+                if op == "in":
+                    v = [_jsonb_canonical(x) if x is not None else None
+                         for x in v]
+                else:
+                    v = _jsonb_canonical(v)
+            out.append((c, op, v))
+        return out
+
+    @staticmethod
+    def _is_jsonb(schema, name: str) -> bool:
+        try:
+            return schema.column(name).type is DataType.JSONB
+        except KeyError:
+            return False
+
     def _row_dict(self, schema, row):
         """Row -> dict with collection columns converted from their
         subdocument storage form to CQL shapes (map/set/list)."""
@@ -627,6 +707,7 @@ class QLProcessor:
                                    if not c.dropped])]
         where = self._bind_where(stmt.where, params, cursor)
         known = {c.name: c.type for c in schema.columns}
+        where = self._canon_jsonb_where(where, known)
 
         # ---- discrete ScanChoices: col IN (...) on a KEY column runs one
         # sub-select per option (ref docdb/scan_choices.cc option seeks)

@@ -31,7 +31,7 @@ _TOKEN_RE = re.compile(r"""
     | (?P<number>-?\d+\.\d+|-?\d+)
     | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
     | (?P<param>\$\d+)
-    | (?P<op><=|>=|!=|[=<>(),;*?.+%/\[\]{}:-])
+    | (?P<op>->>|->|<=|>=|!=|[=<>(),;*?.+%/\[\]{}:-])
     )""", re.VERBOSE)
 
 
@@ -83,6 +83,7 @@ class CreateTable:
 class DropTable:
     keyspace: Optional[str]
     name: str
+    if_exists: bool = False
 
 
 @dataclass
@@ -114,6 +115,17 @@ class FuncCall:
 @dataclass(frozen=True)
 class ColumnRef:
     name: str
+
+
+@dataclass(frozen=True)
+class JsonOp:
+    """JSONB path navigation: col->'key'->2->>'leaf' (ref: the reference's
+    jsonb operators in ql — common/jsonb.cc ApplyJsonbOperators; PG's
+    jsonb -> / ->> semantics). path holds object keys (str) and array
+    indexes (int); as_text marks a trailing ->> (text extraction)."""
+    column: str
+    path: Tuple[object, ...]
+    as_text: bool = False
 
 
 @dataclass
@@ -312,8 +324,9 @@ class Parser:
         if self.accept_kw("CREATE", "INDEX"):
             return self._create_index()
         if self.accept_kw("DROP", "TABLE"):
+            ife = self.accept_kw("IF", "EXISTS")
             ks, name = self.qualified_name()
-            return DropTable(ks, name)
+            return DropTable(ks, name, ife)
         if self.accept_kw("ALTER", "TABLE"):
             ks, name = self.qualified_name()
             add, drop = [], []
@@ -454,11 +467,43 @@ class Parser:
             raise ParseError(f"{len(cols)} columns but {len(vals)} values")
         return Insert(ks, table, cols, vals, ttl)
 
+    def _json_path(self, col: str) -> JsonOp:
+        """col ->'k' ->0 ... [->>'leaf'] — ->> is terminal (it yields
+        text, which has no further json structure to navigate)."""
+        path: List[object] = []
+        as_text = False
+        while True:
+            if self.accept_op("->"):
+                terminal = False
+            elif self.accept_op("->>"):
+                terminal = True
+            else:
+                break
+            tok = self.next()
+            if tok[0] == "string":
+                path.append(tok[1][1:-1].replace("''", "'"))
+            elif tok[0] == "number" and "." not in tok[1]:
+                path.append(int(tok[1]))
+            else:
+                raise ParseError(
+                    f"json path operand must be a text key or an array "
+                    f"index, got {tok[1]!r}")
+            if terminal:
+                as_text = True
+                if self.peek() in (("op", "->"), ("op", "->>")):
+                    raise ParseError("->> returns text: no further json "
+                                     "navigation is possible")
+                break
+        return JsonOp(col, tuple(path), as_text)
+
     def _select_item(self):
         tok = self.peek()
         if tok and tok[0] == "name" and self._peek2() == ("op", "("):
             return self._func_call()
-        return self.name()
+        col = self.name()
+        if self.peek() in (("op", "->"), ("op", "->>")):
+            return self._json_path(col)
+        return col
 
     def _select(self) -> Select:
         if self.accept_op("*"):
@@ -490,6 +535,8 @@ class Parser:
         conds = []
         while True:
             col = self.name()
+            if self.peek() in (("op", "->"), ("op", "->>")):
+                col = self._json_path(col)
             if self.accept_kw("IN"):
                 # col IN (v1, v2, ...) — drives the discrete ScanChoices
                 # strategy (ref docdb/scan_choices.cc option iteration)

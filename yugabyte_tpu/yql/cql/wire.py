@@ -66,6 +66,9 @@ _DATATYPE_TO_CQL = {
     DataType.DOUBLE: TYPE_DOUBLE,
     DataType.FLOAT: TYPE_FLOAT,
     DataType.TIMESTAMP: TYPE_TIMESTAMP,
+    # jsonb rides the wire as text (drivers see varchar holding json,
+    # matching how the reference surfaces jsonb to CQL clients)
+    DataType.JSONB: TYPE_VARCHAR,
 }
 
 

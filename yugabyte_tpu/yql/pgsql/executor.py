@@ -34,6 +34,7 @@ PG_OIDS = {
     # 1114 = timestamp WITHOUT time zone: matches the offset-less text
     # pg_micros_text emits (1184/timestamptz clients would expect '+00')
     DataType.BINARY: 17, DataType.TIMESTAMP: 1114,
+    DataType.JSONB: 3802,
 }
 
 _EPOCH = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
@@ -75,6 +76,13 @@ def pg_coerce(col_type: Optional[DataType], v: object) -> object:
         return type(v)(pg_coerce(col_type, x) for x in v)
     if col_type == DataType.TIMESTAMP and isinstance(v, str):
         return pg_timestamp_micros(v)
+    if col_type == DataType.JSONB:
+        from yugabyte_tpu.common import jsonb
+        try:
+            return jsonb.canonicalize(v)
+        except ValueError as e:
+            raise PgError(Status.InvalidArgument(
+                f"invalid input syntax for type json: {e}"), "22P02")
     if col_type == DataType.DOUBLE and isinstance(v, int) \
             and not isinstance(v, bool):
         return float(v)
@@ -545,6 +553,12 @@ class PgSession:
                 columns.append(ColumnSchema(n, DataType.INT64,
                                             default_seq=seq))
             else:
+                if t == "JSONB" and n in stmt.pk:
+                    # no order-preserving key encoding for documents
+                    # (PG likewise has no jsonb btree opclass by default)
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{n}" of type jsonb cannot be a '
+                        f'primary key'), "42P16")
                 columns.append(ColumnSchema(n, DataType[t]))
         schema = Schema(columns=columns, num_hash_key_columns=1,
                         num_range_key_columns=len(stmt.pk) - 1)
@@ -831,10 +845,16 @@ class PgSession:
         (timestamp text -> micros, ...); unknown columns pass through."""
         out = []
         for c, op, v in where:
-            try:
-                t = schema.column(c).type
-            except KeyError:
-                t = None
+            if isinstance(c, tuple) and c and c[0] == "jsonb":
+                # -> yields json text: canonicalize the comparison value
+                # so semantically equal spellings match the stored form;
+                # ->> yields plain text — compare raw
+                t = None if c[3] else DataType.JSONB
+            else:
+                try:
+                    t = schema.column(c).type
+                except KeyError:
+                    t = None
             out.append((c, op, pg_coerce(t, v)))
         return out
 
@@ -1048,6 +1068,9 @@ class PgSession:
         schema = table.schema
         known = {c.name for c in schema.columns}
         for c in list(stmt.columns or []) + [f[0] for f in stmt.where]:
+            if isinstance(c, tuple) and c and c[0] == "jsonb":
+                self._check_jsonb_base(c, schema)
+                c = c[1]
             if c not in known:
                 raise PgError(Status.InvalidArgument(
                     f'column "{c}" does not exist'), "42703")
@@ -1566,9 +1589,25 @@ class PgSession:
             + [i[2] for i, _o, _v in stmt.having
                if i[0] == "agg" and i[2] is not None]
         for c in check_cols:
+            if isinstance(c, tuple) and c and c[0] == "jsonb":
+                self._check_jsonb_base(c, schema)
+                c = c[1]
             if c not in known:
                 raise PgError(Status.InvalidArgument(
                     f'column "{c}" does not exist'), "42703")
+
+    @staticmethod
+    def _check_jsonb_base(c: tuple, schema) -> None:
+        """-> / ->> applies only to jsonb columns — WHERE must reject a
+        text column exactly like the select list does (PG: 42883)."""
+        try:
+            t = schema.column(c[1]).type
+        except KeyError:
+            raise PgError(Status.InvalidArgument(
+                f'column "{c[1]}" does not exist'), "42703")
+        if t is not DataType.JSONB:
+            raise PgError(Status.InvalidArgument(
+                f"operator -> does not apply to type {t.value}"), "42883")
 
     def _compile_row_expr(self, it, schema):
         """Compile one row expression — ("col", name) | ("lit", v) |
@@ -1590,6 +1629,20 @@ class PgSession:
             if it[0] == "lit":
                 v = it[1]
                 return bfunc.infer_type(v), (lambda d, _v=v: _v)
+            if it[0] == "jsonb":
+                from yugabyte_tpu.common import jsonb as _jsonb
+                try:
+                    t = schema.column(it[1]).type
+                except KeyError:
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{it[1]}" does not exist'), "42703")
+                if t is not DataType.JSONB:
+                    raise PgError(Status.InvalidArgument(
+                        f"operator -> does not apply to type {t.value}"),
+                        "42883")
+                out_t = DataType.STRING if it[3] else DataType.JSONB
+                return out_t, (lambda d, _c=it[1], _p=it[2], _a=it[3]:
+                               _jsonb.navigate(d.get(_c), _p, _a))
             if it[0] == "case":
                 # CASE: first matching WHEN wins; no match and no ELSE ->
                 # NULL (PG ExecEvalCase). Conditions use SQL three-valued
@@ -1741,7 +1794,7 @@ class PgSession:
                 label = it[1].lower()
             elif it[0] == "case":
                 label = "case"       # PG's label for CASE expressions
-            elif it[0] in ("op", "lit"):
+            elif it[0] in ("op", "lit", "jsonb"):
                 label = "?column?"   # PG's label for anonymous expressions
             else:
                 label = it[1]

@@ -39,6 +39,10 @@ PG_TYPES = {
     # SERIAL/BIGSERIAL: INT64 + an implicit sequence default; the marker
     # survives to the executor which creates <table>_<col>_seq
     "SERIAL": "SERIAL", "BIGSERIAL": "SERIAL", "SMALLSERIAL": "SERIAL",
+    # jsonb documents (canonical sorted-key json text storage,
+    # common/jsonb.py); plain JSON maps to the same storage like the
+    # reference's ycql layer treats both spellings
+    "JSONB": "JSONB", "JSON": "JSONB",
 }
 
 
@@ -539,8 +543,18 @@ class PgParser(_BaseParser):
             return self._scalar_func()
         if tok is not None and tok[0] == "name" \
                 and tok[1].upper() not in ("TRUE", "FALSE", "NULL"):
-            return ("col", self._col_ref())
+            col = self._col_ref()
+            if self.peek() in (("op", "->"), ("op", "->>")):
+                return self._jsonb_suffix(col)
+            return ("col", col)
         return ("lit", self.literal())
+
+    def _jsonb_suffix(self, col: str):
+        """col ->'k'->0[->>'leaf'] -> ("jsonb", col, path, as_text)
+        (ref: PG jsonb -> / ->> operators, src/postgres jsonfuncs.c).
+        Rides the base parser's path grammar (cql JsonOp)."""
+        j = self._json_path(col)
+        return ("jsonb", j.column, j.path, j.as_text)
 
     # CASE (ref: PG a_expr CaseExpr, src/postgres gram.y case_expr):
     # searched  CASE WHEN cond THEN expr ... [ELSE expr] END
@@ -663,7 +677,7 @@ class PgParser(_BaseParser):
             aggs = [i for i in items if i[0] == "agg"]
             cols = [i[1] for i in items if i[0] == "col"]
             exprs = [i for i in items
-                     if i[0] in ("func", "op", "lit", "case")]
+                     if i[0] in ("func", "op", "lit", "case", "jsonb")]
             if aggs and exprs:
                 raise ParseError(
                     "mixing aggregates and scalar expressions in one "
@@ -676,6 +690,8 @@ class PgParser(_BaseParser):
                 # base columns the evaluation needs (validated + fetched)
                 def _refs(it):
                     if it[0] == "col":
+                        return [it[1]]
+                    if it[0] == "jsonb":
                         return [it[1]]
                     if it[0] == "func":
                         out = []
@@ -813,6 +829,11 @@ class PgParser(_BaseParser):
             self.expect_op("(")
             return ("", "not exists", self._subselect())
         col = self._col_ref()
+        if self.peek() in (("op", "->"), ("op", "->>")):
+            # jsonb path predicate: the lhs becomes the pushdown form
+            # ("jsonb", col, path, as_text) evaluated by
+            # common/wire.row_matches on the tserver scan
+            col = self._jsonb_suffix(col)
         if self.accept_kw("IS"):
             neg = bool(self.accept_kw("NOT"))
             self.expect_kw("NULL")
