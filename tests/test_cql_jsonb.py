@@ -183,3 +183,30 @@ def test_nan_infinity_rejected(proc):
         with pytest.raises(StatusError, match="invalid json"):
             proc.execute("INSERT INTO docs (id, body) VALUES (9, '%s')"
                          % bad)
+
+
+def test_truncate(proc):
+    for i in range(20):
+        proc.execute("INSERT INTO docs (id, tag) VALUES (%d, 't%d')"
+                     % (i, i))
+    assert len(proc.execute("SELECT id FROM docs").rows) == 20
+    proc.execute("TRUNCATE docs")
+    assert proc.execute("SELECT id FROM docs").rows == []
+    # table still usable after truncate
+    proc.execute("INSERT INTO docs (id, tag) VALUES (1, 'back')")
+    assert _rows(proc.execute("SELECT tag FROM docs WHERE id = 1")) \
+        == [["back"]]
+
+
+def test_truncate_indexed_table(proc):
+    proc.execute("DROP TABLE IF EXISTS idocs")
+    proc.execute("CREATE TABLE idocs (id INT PRIMARY KEY, tag TEXT)")
+    proc.execute("CREATE INDEX itag ON idocs (tag)")
+    for i in range(10):
+        proc.execute("INSERT INTO idocs (id, tag) VALUES (%d, 'x%d')"
+                     % (i, i % 3))
+    proc.execute("TRUNCATE idocs")
+    assert proc.execute("SELECT id FROM idocs").rows == []
+    # the index must not resurrect rows
+    assert proc.execute(
+        "SELECT id FROM idocs WHERE tag = 'x1'").rows == []

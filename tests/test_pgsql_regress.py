@@ -852,3 +852,119 @@ class TestJsonb:
         with pytest.raises(PgWireError):
             conn.query("INSERT INTO jevents (eid, meta) VALUES "
                        "(9, 'NaN')")
+
+
+class TestExplain:
+    """EXPLAIN [ANALYZE] reports the executor's real plan choice
+    (ref: src/postgres/.../commands/explain.c): point reads and index
+    lookups as Index Scan, pushed-down scans as Seq Scan + Filter,
+    joins as Nested Loop (PK inner) / Hash Join."""
+
+    def test_point_read_is_index_scan(self, conn):
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN SELECT * FROM customers WHERE cid = 1")]
+        assert plan[0] == "Index Scan using customers_pkey on customers"
+        assert "Index Cond: (cid = 1)" in plan[1]
+
+    def test_seq_scan_with_filter(self, conn):
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN SELECT * FROM customers WHERE city = 'london'")]
+        assert plan[0] == "Seq Scan on customers"
+        assert "Filter: (city = 'london')" in plan[1]
+
+    def test_join_plan_nodes(self, conn):
+        plan = "\n".join(r[0] for r in rows(conn,
+                "EXPLAIN SELECT c.name FROM orders o "
+                "JOIN customers c ON o.cid = c.cid"))
+        assert "Nested Loop" in plan           # join col is customers' PK
+        assert "Index Scan using customers_pkey" in plan
+        assert "Seq Scan on orders" in plan
+
+    def test_sort_limit_nodes(self, conn):
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN SELECT * FROM products ORDER BY price DESC "
+                "LIMIT 2")]
+        assert plan[0] == "Limit"
+        assert any("Sort" in ln for ln in plan)
+        assert any("Sort Key: price DESC" in ln for ln in plan)
+
+    def test_aggregate_node(self, conn):
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN SELECT count(*) FROM orders")]
+        assert plan[0] == "Aggregate"
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN SELECT city, count(*) FROM customers "
+                "GROUP BY city")]
+        assert plan[0] == "HashAggregate"
+        assert any("Group Key: city" in ln for ln in plan)
+
+    def test_explain_analyze_runs(self, conn):
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN ANALYZE SELECT * FROM customers WHERE cid = 2")]
+        assert any("actual rows=1" in ln for ln in plan)
+        assert any("Execution Time" in ln for ln in plan)
+
+    def test_explain_dml(self, conn):
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN UPDATE customers SET city = 'rome' "
+                "WHERE cid = 1")]
+        assert plan[0] == "Update on customers"
+        plan = [r[0] for r in rows(conn,
+                "EXPLAIN INSERT INTO customers (cid, name) "
+                "VALUES (99, 'zed')")]
+        assert plan[0] == "Insert on customers"
+        # EXPLAIN without ANALYZE must not execute
+        assert rows(conn, "SELECT name FROM customers WHERE cid = 99") \
+            == []
+
+    def test_explain_non_dml_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("EXPLAIN CREATE TABLE nope (x INT PRIMARY KEY)")
+
+
+class TestTruncate:
+    """TRUNCATE [TABLE] t [, ...] [RESTART IDENTITY] (ref: PG
+    ExecuteTruncate + ResetSequence)."""
+
+    def test_truncate_multiple(self, conn):
+        conn.query("CREATE TABLE ta (k INT PRIMARY KEY, v INT)")
+        conn.query("CREATE TABLE tb (k INT PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO ta VALUES (1, 1), (2, 2)")
+        conn.query("INSERT INTO tb VALUES (3, 3)")
+        conn.query("TRUNCATE TABLE ta, tb")
+        assert rows(conn, "SELECT * FROM ta") == []
+        assert rows(conn, "SELECT * FROM tb") == []
+        conn.query("INSERT INTO ta VALUES (5, 5)")
+        assert rows(conn, "SELECT v FROM ta") == [("5",)]
+        conn.query("DROP TABLE ta")
+        conn.query("DROP TABLE tb")
+
+    def test_truncate_restart_identity(self, conn):
+        conn.query("CREATE TABLE ts (id SERIAL PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO ts (v) VALUES (1), (2), (3)")
+        conn.query("TRUNCATE ts RESTART IDENTITY")
+        conn.query("INSERT INTO ts (v) VALUES (9)")
+        assert rows(conn, "SELECT id, v FROM ts") == [("1", "9")]
+        conn.query("DROP TABLE ts")
+
+    def test_truncate_continue_identity(self, conn):
+        conn.query("CREATE TABLE tc (id SERIAL PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO tc (v) VALUES (1), (2)")
+        conn.query("TRUNCATE tc CONTINUE IDENTITY")
+        conn.query("INSERT INTO tc (v) VALUES (9)")
+        # sequence continues: next id is 3
+        assert rows(conn, "SELECT id FROM tc") == [("3",)]
+        conn.query("DROP TABLE tc")
+
+    def test_truncate_unknown_table(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("TRUNCATE no_such_table")
+
+    def test_truncate_maintains_index(self, conn):
+        conn.query("CREATE TABLE ti (k INT PRIMARY KEY, tag TEXT)")
+        conn.query("CREATE INDEX tagidx ON ti (tag)")
+        conn.query("INSERT INTO ti VALUES (1, 'a'), (2, 'b')")
+        conn.query("TRUNCATE ti")
+        # index-accelerated path must not resurrect deleted rows
+        assert rows(conn, "SELECT k FROM ti WHERE tag = 'a'") == []
+        conn.query("DROP TABLE ti")

@@ -440,7 +440,41 @@ class QLProcessor:
             return ResultSet()
         if isinstance(stmt, P.Transaction):
             return self._run_transaction(stmt, params)
+        if isinstance(stmt, P.Truncate):
+            return self._truncate(stmt)
         raise StatusError(Status.NotSupported(f"statement {type(stmt)}"))
+
+    def _truncate(self, stmt: P.Truncate) -> ResultSet:
+        """Delete every row (and maintained index rows) from the table.
+        Functional equivalent of the reference's whole-tablet truncate
+        (tablet.cc Truncate), expressed through the row delete path so
+        secondary indexes stay consistent."""
+        ks = self._resolve_ks(stmt.keyspace)
+        table = self._table(stmt.keyspace, stmt.table)
+
+        def flush(ops: List[QLWriteOp]) -> None:
+            if not table.indexes:
+                self._client.write(table, ops)
+                return
+            # one implicit distributed txn per BATCH (not per row): the
+            # batch's main-row + index-row deletes commit atomically
+            IM.run_in_implicit_txn(
+                self._txn_manager, None,
+                lambda txn: [IM.txn_write_with_indexes(
+                    txn, table, op,
+                    lambda name, _ks=ks: self._table(_ks, name))
+                    for op in ops],
+                30.0)
+
+        batch: List[QLWriteOp] = []
+        for row in self._client.scan(table):
+            batch.append(QLWriteOp(WriteOpKind.DELETE_ROW, row.doc_key))
+            if len(batch) >= 512:
+                flush(batch)
+                batch = []
+        if batch:
+            flush(batch)
+        return ResultSet()
 
     def _alter_table(self, stmt: P.AlterTable) -> ResultSet:
         """ALTER TABLE ADD/DROP column riding the master's versioned

@@ -167,6 +167,14 @@ class Delete:
 
 
 @dataclass
+class Truncate:
+    """TRUNCATE [TABLE] ks.t (ref: the CQL truncate statement, executed
+    by the reference as a whole-tablet truncation)."""
+    keyspace: Optional[str]
+    table: str
+
+
+@dataclass
 class Transaction:
     statements: List[Union[Insert, Update, Delete]]
 
@@ -354,6 +362,10 @@ class Parser:
             return self._delete()
         if self.accept_kw("BEGIN", "TRANSACTION"):
             return self._transaction()
+        if self.accept_kw("TRUNCATE"):
+            self.accept_kw("TABLE")
+            ks, name = self.qualified_name()
+            return Truncate(ks, name)
         raise ParseError(f"unrecognized statement start: {self.peek()}")
 
     def _create_index(self) -> CreateIndex:

@@ -444,6 +444,10 @@ class PgSession:
                                            if_exists=True)
             self._tables.pop(stmt.name, None)
             return PgResult("DROP TABLE")
+        if isinstance(stmt, P.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, P.Truncate):
+            return self._truncate(stmt)
         if isinstance(stmt, P.Insert):
             return self._insert(stmt)
         if isinstance(stmt, (P.Select, P.UnionSelect)):
@@ -1291,6 +1295,10 @@ class PgSession:
         pref = {stmt.table, stmt.alias or stmt.table}
 
         def fix(c):
+            if isinstance(c, tuple) and c and c[0] == "jsonb":
+                # qualified jsonb path: strip the table prefix off the
+                # BASE column (t.body->>'a' == body->>'a' here)
+                return ("jsonb", fix(c[1]), c[2], c[3])
             if isinstance(c, str) and "." in c:
                 a, col = c.split(".", 1)
                 if a in pref:
@@ -1299,6 +1307,8 @@ class PgSession:
         def fix_item(it):
             if it[0] == "col":
                 return ("col", fix(it[1]))
+            if it[0] == "jsonb":
+                return fix(it)
             if it[0] == "func":
                 return ("func", it[1], [fix_item(a) for a in it[2]])
             if it[0] == "op":
@@ -1430,6 +1440,220 @@ class PgSession:
         except KeyError:
             col_desc = [(c, 25) for c in out_cols]
         return PgResult("SELECT 0", col_desc, [])
+
+    # ----------------------------------------------------------- TRUNCATE
+    def _truncate(self, stmt: P.Truncate) -> PgResult:
+        """Remove every row from each table; RESTART IDENTITY resets
+        owned SERIAL sequences to 1 (ref: PG ExecuteTruncate +
+        ResetSequence). Row removal rides the transactional delete path
+        so secondary indexes stay consistent — the functional equivalent
+        of the reference's per-tablet truncate (tablet.cc Truncate),
+        traded for index/MVCC safety at this layer."""
+        if stmt.restart_identity and self._txn is not None:
+            # the sequence registry is not transactional: a reset inside
+            # an explicit transaction could not roll back with the row
+            # deletes, silently recycling ids after ROLLBACK
+            raise PgError(Status.NotSupported(
+                "TRUNCATE ... RESTART IDENTITY cannot run inside a "
+                "transaction block"), "0A000")
+        # resolve every name BEFORE deleting anything: a typo in the
+        # second table must not leave the first one emptied
+        # (PG's ExecuteTruncate opens all relations first)
+        tables = [self._table(name) for name in stmt.tables]
+        for table in tables:
+            def body(txn, _t=table):
+                keys = self._target_keys(_t, [], txn)
+                for k in keys:
+                    IM.txn_write_with_indexes(
+                        txn, _t, QLWriteOp(WriteOpKind.DELETE_ROW, k),
+                        self._table)
+                return len(keys)
+
+            self._run_statement_txn(body)
+        if stmt.restart_identity:
+            for table in tables:
+                for c in table.schema.columns:
+                    if c.default_seq is not None:
+                        self._client.drop_sequence(self.database,
+                                                   c.default_seq,
+                                                   if_exists=True)
+                        self._client.create_sequence(self.database,
+                                                     c.default_seq,
+                                                     start=1)
+        return PgResult("TRUNCATE TABLE")
+
+    # ------------------------------------------------------------ EXPLAIN
+    def _explain(self, stmt: P.Explain) -> PgResult:
+        """Report the plan the executor's classification would pick,
+        PG-tree-style (ref: src/postgres/.../commands/explain.c). The
+        node names mirror the actual execution paths: point reads and
+        index lookups surface as Index Scan, pushed-down scans as
+        Seq Scan (with the pushed Filter), joins as Hash Join / Nested
+        Loop exactly per _select_join's choice."""
+        lines = self._plan_lines(stmt.stmt, indent=0)
+        if stmt.analyze:
+            t0 = time.monotonic()
+            res = self._execute_stmt(stmt.stmt)
+            ms = (time.monotonic() - t0) * 1e3
+            n = len(res.rows) if res.row_iter is None \
+                else sum(1 for _ in res.row_iter)
+            lines.append(f"(actual rows={n})")
+            lines.append(f"Execution Time: {ms:.3f} ms")
+        return PgResult("EXPLAIN", [("QUERY PLAN", 25)],
+                        [[ln] for ln in lines])
+
+    @staticmethod
+    def _explain_cond_text(conds) -> str:
+        def one(c, op, v):
+            if isinstance(c, tuple) and c and c[0] == "jsonb":
+                path = "".join(
+                    ("->>" if (c[3] and i == len(c[2]) - 1) else "->")
+                    + (repr(s) if isinstance(s, int) else f"'{s}'")
+                    for i, s in enumerate(c[2]))
+                c = f"{c[1]}{path}"
+            if isinstance(v, P.Select):
+                v = "(SubPlan)"
+            elif isinstance(v, str):
+                v = f"'{v}'"
+            elif isinstance(v, (tuple, list)):
+                v = "(" + ", ".join(map(repr, v)) + ")"
+            return f"({c} {op} {v})"
+        return " AND ".join(one(*f) for f in conds)
+
+    # Plan nodes: (label, [detail lines], [child nodes]) rendered
+    # PG-tree-style by _render_plan.
+    def _plan_lines(self, stmt, indent: int = 0) -> List[str]:
+        return self._render_plan(self._plan_node(stmt))
+
+    @classmethod
+    def _render_plan(cls, node, pad: str = "",
+                     arrow: bool = False) -> List[str]:
+        """PG explain tree layout: details indent 6 under an arrowed
+        node (2 at the root), child arrows align with the details."""
+        label, details, children = node
+        out = [pad + ("->  " if arrow else "") + label]
+        body_pad = pad + ("      " if arrow else "  ")
+        out += [body_pad + d for d in details]
+        for ch in children:
+            out += cls._render_plan(ch, body_pad, True)
+        return out
+
+    def _plan_node(self, stmt):
+        """-> (label, details, children) for one DML statement."""
+        if isinstance(stmt, P.UnionSelect):
+            return ("Append", [],
+                    [self._plan_node(s) for s in stmt.selects])
+        if isinstance(stmt, P.Insert):
+            return (f"Insert on {stmt.table}", [], [("Result", [], [])])
+        if isinstance(stmt, P.Update):
+            return (f"Update on {stmt.table}", [],
+                    [self._scan_node(stmt.table, stmt.where)])
+        if isinstance(stmt, P.Delete):
+            return (f"Delete on {stmt.table}", [],
+                    [self._scan_node(stmt.table, stmt.where)])
+        # Select: Limit / Sort / Aggregate wrappers around the scan or
+        # join tree, in the executor's actual sequencing order
+        if stmt.joins:
+            node = self._join_plan_node(stmt)
+        elif stmt.or_where:
+            branches = " OR ".join(
+                "(" + self._explain_cond_text(br) + ")"
+                for br in stmt.or_where)
+            node = (f"Seq Scan on {stmt.table}",
+                    [f"Filter: {branches}"], [])
+        else:
+            node = self._scan_node(stmt.table, stmt.where)
+        if stmt.aggregates or stmt.group_by or stmt.count_star:
+            label = "HashAggregate" if stmt.group_by else "Aggregate"
+            details = []
+            gcols = _group_cols(stmt.group_by)
+            if gcols:
+                details.append("Group Key: " + ", ".join(gcols))
+            node = (label, details, [node])
+        elif stmt.order_by:
+            node = ("Sort", ["Sort Key: " + ", ".join(
+                f"{c} DESC" if d else c for c, d in stmt.order_by)],
+                [node])
+        if stmt.limit is not None:
+            node = ("Limit", [], [node])
+        return node
+
+    def _scan_node(self, table_name: str, where):
+        """Access-path node mirroring _iter_row_dicts' classification:
+        full-PK equality -> pkey Index Scan; readable secondary index on
+        an equality -> Index Scan; else pushed-down Seq Scan."""
+        if self._virtual_table_rows(table_name) is not None:
+            return (f"Seq Scan on {table_name}", [], [])
+        table = self._table(table_name)
+        try:
+            dk, filters = self._split_where(table, where)
+        except (PgError, StatusError):
+            dk, filters = None, list(where)
+        if dk is not None:
+            key_names = [c.name for c in table.schema.hash_columns] \
+                + [c.name for c in table.schema.range_columns]
+            keyf = [f for f in where if f[0] in key_names and f[1] == "="]
+            details = ["Index Cond: " + self._explain_cond_text(keyf)]
+            rest = [f for f in filters if f not in keyf]
+            if rest:
+                details.append("Filter: " + self._explain_cond_text(rest))
+            return (f"Index Scan using {table_name}_pkey on {table_name}",
+                    details, [])
+        picked = (IM.choose_index(table, [tuple(f) for f in filters
+                                          if isinstance(f[0], str)])
+                  if self._txn is None else None)
+        if picked is not None:
+            idx, value, residual = picked
+            details = ["Index Cond: "
+                       + self._explain_cond_text([(idx.column, "=",
+                                                   value)])]
+            if residual:
+                details.append("Filter: "
+                               + self._explain_cond_text(residual))
+            return (f"Index Scan using {idx.index_name} on {table_name}",
+                    details, [])
+        details = []
+        if filters:
+            details.append("Filter: " + self._explain_cond_text(filters))
+        return (f"Seq Scan on {table_name}", details, [])
+
+    def _join_plan_node(self, stmt: P.Select):
+        """Left-deep join tree mirroring _select_join's hash-vs-point
+        choice per joined table; the base scan is the deepest left
+        child."""
+        node = self._scan_node(stmt.table, [])
+        for j in stmt.joins:
+            left_ref, right_ref = j.on
+            ja = j.alias or j.table
+            if left_ref.split(".")[0] == ja \
+                    and right_ref.split(".")[0] != ja:
+                left_ref, right_ref = right_ref, left_ref
+            right_col = right_ref.split(".")[-1]
+            try:
+                sch = self._table(j.table).schema
+                use_point = (j.kind == "inner"
+                             and len(sch.hash_columns) == 1
+                             and sch.num_range_key_columns == 0
+                             and sch.hash_columns[0].name == right_col)
+            except (PgError, StatusError, KeyError):
+                use_point = False
+            details = [f"Join Cond: ({left_ref} = {right_ref})"]
+            if use_point:
+                inner = (f"Index Scan using {j.table}_pkey on {j.table}",
+                         [], [])
+                node = ("Nested Loop", details, [node, inner])
+            else:
+                label = ("Hash Join" if j.kind == "inner"
+                         else "Hash Left Join")
+                hash_node = ("Hash", [],
+                             [(f"Seq Scan on {j.table}", [], [])])
+                node = (label, details, [node, hash_node])
+        if stmt.where:
+            label, details, children = node
+            details = details + ["Filter: "
+                                 + self._explain_cond_text(stmt.where)]
+            node = (label, details, children)
+        return node
 
     def _select_or(self, stmt: P.Select) -> PgResult:
         """OR disjunction (ref: PG BitmapOr over index/seq paths): fetch

@@ -186,6 +186,23 @@ class DropSequence:
 
 
 @dataclass
+class Truncate:
+    """TRUNCATE [TABLE] t [, ...] [RESTART IDENTITY] (ref: PG's
+    ExecuteTruncate; YSQL routes it to per-tablet truncation)."""
+    tables: List[str]
+    restart_identity: bool = False
+
+
+@dataclass
+class Explain:
+    """EXPLAIN [ANALYZE] <dml> — report the plan the executor would pick
+    (ref: src/postgres/src/backend/commands/explain.c; YSQL EXPLAIN shows
+    the pggate scan shape the same way)."""
+    stmt: object
+    analyze: bool = False
+
+
+@dataclass
 class TxnControl:
     kind: str                          # begin | commit | rollback
 
@@ -280,6 +297,24 @@ class PgParser(_BaseParser):
         if self.accept_kw("DROP", "TABLE"):
             if_exists = self.accept_kw("IF", "EXISTS")
             return DropTable(self._table_name(), if_exists)
+        if self.accept_kw("TRUNCATE"):
+            self.accept_kw("TABLE")
+            tables = [self._table_name()]
+            while self.accept_op(","):
+                tables.append(self._table_name())
+            restart = bool(self.accept_kw("RESTART", "IDENTITY"))
+            if not restart:
+                self.accept_kw("CONTINUE", "IDENTITY")
+            self.accept_kw("CASCADE") or self.accept_kw("RESTRICT")
+            return Truncate(tables, restart)
+        if self.accept_kw("EXPLAIN"):
+            analyze = bool(self.accept_kw("ANALYZE"))
+            self.accept_kw("VERBOSE")
+            inner = self.parse_one()
+            if not isinstance(inner, (Select, UnionSelect, Insert,
+                                      Update, Delete)):
+                raise ParseError("EXPLAIN applies to DML statements")
+            return Explain(inner, analyze)
         if self.accept_kw("INSERT", "INTO"):
             return self._insert()
         if self.accept_kw("SELECT"):
