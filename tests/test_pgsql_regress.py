@@ -968,3 +968,102 @@ class TestTruncate:
         # index-accelerated path must not resurrect deleted rows
         assert rows(conn, "SELECT k FROM ti WHERE tag = 'a'") == []
         conn.query("DROP TABLE ti")
+
+
+class TestReturning:
+    """INSERT/UPDATE/DELETE ... RETURNING (ref: PG
+    ExecProcessReturning)."""
+
+    @pytest.fixture(autouse=True)
+    def tbl(self, conn):
+        conn.query("CREATE TABLE r (id SERIAL PRIMARY KEY, v INT, "
+                   "tag TEXT)")
+        yield
+        conn.query("DROP TABLE r")
+
+    def test_insert_returning_serial(self, conn):
+        res = conn.query("INSERT INTO r (v, tag) VALUES (10, 'a'), "
+                         "(20, 'b') RETURNING id, v")[0]
+        assert res.rows == [["1", "10"], ["2", "20"]]
+        assert [n for n, _o in res.columns] == ["id", "v"]
+
+    def test_insert_returning_star(self, conn):
+        res = conn.query("INSERT INTO r (v) VALUES (7) RETURNING *")[0]
+        assert res.rows == [["1", "7", None]]
+
+    def test_update_returning_new_values(self, conn):
+        conn.query("INSERT INTO r (v, tag) VALUES (1, 'x'), (2, 'y')")
+        res = conn.query("UPDATE r SET v = v + 100 WHERE tag = 'y' "
+                         "RETURNING id, v, tag")[0]
+        assert res.rows == [["2", "102", "y"]]
+
+    def test_delete_returning_old_rows(self, conn):
+        conn.query("INSERT INTO r (v, tag) VALUES (5, 'del')")
+        res = conn.query("DELETE FROM r WHERE tag = 'del' "
+                         "RETURNING v, tag")[0]
+        assert res.rows == [["5", "del"]]
+        assert rows(conn, "SELECT * FROM r") == []
+
+    def test_returning_qualified_ref(self, conn):
+        conn.query("INSERT INTO r (v, tag) VALUES (3, 'q')")
+        res = conn.query("DELETE FROM r WHERE tag = 'q' "
+                         "RETURNING r.v")[0]
+        assert res.rows == [["3"]]
+        assert res.columns[0][0] == "v"
+
+    def test_returning_extended_protocol_describe(self, conn):
+        # extended protocol: Describe must announce RETURNING columns
+        res = conn.extended_query("INSERT INTO r (v) VALUES ($1) "
+                                  "RETURNING id, v", ["5"])
+        assert res.rows == [["1", "5"]]
+        assert [n for n, _o in res.columns] == ["id", "v"]
+
+    def test_returning_unknown_column(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO r (v) VALUES (1) RETURNING nope")
+
+
+class TestPrepare:
+    """SQL-level PREPARE / EXECUTE / DEALLOCATE (ref: PG
+    commands/prepare.c)."""
+
+    def test_prepare_execute_roundtrip(self, conn):
+        conn.query("CREATE TABLE pq (k INT PRIMARY KEY, v TEXT)")
+        conn.query("PREPARE ins (int, text) AS "
+                   "INSERT INTO pq VALUES ($1, $2)")
+        conn.query("EXECUTE ins (1, 'one')")
+        conn.query("EXECUTE ins (2, 'two')")
+        conn.query("PREPARE sel AS SELECT v FROM pq WHERE k = $1")
+        assert rows(conn, "EXECUTE sel (2)") == [("two",)]
+        conn.query("DEALLOCATE ins")
+        with pytest.raises(PgWireError):
+            conn.query("EXECUTE ins (3, 'three')")
+        # sel still live; DEALLOCATE ALL clears it
+        conn.query("DEALLOCATE ALL")
+        with pytest.raises(PgWireError):
+            conn.query("EXECUTE sel (1)")
+        conn.query("DROP TABLE pq")
+
+    def test_duplicate_prepare_rejected(self, conn):
+        conn.query("PREPARE dup AS SELECT 1")
+        with pytest.raises(PgWireError):
+            conn.query("PREPARE dup AS SELECT 2")
+        conn.query("DEALLOCATE dup")
+
+    def test_execute_unknown(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("EXECUTE never_prepared")
+
+    def test_prepare_typmod_type_list(self, conn):
+        conn.query("PREPARE tm (numeric(10,2), varchar(20)) AS "
+                   "SELECT $1 + 0, $2")
+        assert rows(conn, "EXECUTE tm (1.5, 'x')") == [("1.5", "x")]
+        conn.query("DEALLOCATE tm")
+
+    def test_execute_wrong_param_count(self, conn):
+        conn.query("PREPARE pc AS SELECT $1 + 0")
+        with pytest.raises(PgWireError):
+            conn.query("EXECUTE pc (1, 2)")
+        with pytest.raises(PgWireError):
+            conn.query("EXECUTE pc")
+        conn.query("DEALLOCATE pc")

@@ -221,6 +221,10 @@ class PgSession:
         # DECLARE'd cursors; non-hold cursors die at transaction end,
         # WITH HOLD survive (materialized at the creating txn's commit)
         self._cursors: Dict[str, _Cursor] = {}
+        # SQL-level PREPARE registry (ref: PG commands/prepare.c) —
+        # session-scoped, separate from the wire protocol's named
+        # statements
+        self._prepared: Dict[str, object] = {}
         # PG connects to an EXISTING database; only the default one is
         # auto-created (the initdb role). Unknown names fail with 3D000
         # instead of silently materializing a typo'd namespace.
@@ -360,6 +364,23 @@ class PgSession:
                          ) -> Optional[List[Tuple[str, int]]]:
         """RowDescription for a statement BEFORE execution (the extended
         protocol's Describe), or None for row-less statements."""
+        if isinstance(stmt, (P.Insert, P.Update, P.Delete)) \
+                and stmt.returning:
+            # RETURNING produces rows: Describe must announce them or
+            # the later DataRows violate the protocol
+            schema = self._table(stmt.table).schema
+            if "*" in stmt.returning:
+                cols = [c.name for c in schema.columns if not c.dropped]
+            else:
+                cols = [c.split(".")[-1] for c in stmt.returning]
+            out = []
+            for c in cols:
+                try:
+                    out.append((c, PG_OIDS[schema.column(c).type]))
+                except KeyError:
+                    raise PgError(Status.InvalidArgument(
+                        f'column "{c}" does not exist'), "42703")
+            return out
         if not isinstance(stmt, (P.Select, P.Show)):
             return None
         if isinstance(stmt, P.Show):
@@ -448,6 +469,34 @@ class PgSession:
             return self._explain(stmt)
         if isinstance(stmt, P.Truncate):
             return self._truncate(stmt)
+        if isinstance(stmt, P.PrepareStmt):
+            if stmt.name in self._prepared:
+                raise PgError(Status.AlreadyPresent(
+                    f'prepared statement "{stmt.name}" already exists'),
+                    "42P05")
+            self._prepared[stmt.name] = stmt.stmt
+            return PgResult("PREPARE")
+        if isinstance(stmt, P.ExecuteStmt):
+            inner = self._prepared.get(stmt.name)
+            if inner is None:
+                raise PgError(Status.NotFound(
+                    f'prepared statement "{stmt.name}" does not exist'),
+                    "26000")
+            need = P.max_param_idx(inner)
+            if len(stmt.params) != need:
+                raise PgError(Status.InvalidArgument(
+                    f'wrong number of parameters for prepared statement '
+                    f'"{stmt.name}": expected {need}, '
+                    f'got {len(stmt.params)}'), "42601")
+            return self._execute_stmt(P.bind_params(inner, stmt.params))
+        if isinstance(stmt, P.DeallocateStmt):
+            if stmt.name is None:
+                self._prepared.clear()
+            elif self._prepared.pop(stmt.name, None) is None:
+                raise PgError(Status.NotFound(
+                    f'prepared statement "{stmt.name}" does not exist'),
+                    "26000")
+            return PgResult("DEALLOCATE")
         if isinstance(stmt, P.Insert):
             return self._insert(stmt)
         if isinstance(stmt, (P.Select, P.UnionSelect)):
@@ -623,6 +672,27 @@ class PgSession:
         return IM.run_in_implicit_txn(self._txn_manager, self._txn, body,
                                       deadline_s)
 
+    def _returning_result(self, tag: str, table, returning,
+                          dicts) -> PgResult:
+        """RETURNING projection over the written rows (ref: PG
+        ExecProcessReturning): '*' expands to all live columns."""
+        schema = table.schema
+        if "*" in returning:
+            cols = [c.name for c in schema.columns if not c.dropped]
+        else:
+            # table-qualified refs label and resolve by the bare name
+            # (the single-table SELECT paths strip qualifiers the same way)
+            cols = [c.split(".")[-1] for c in returning]
+        col_desc = []
+        for c in cols:
+            try:
+                col_desc.append((c, PG_OIDS[schema.column(c).type]))
+            except KeyError:
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
+        return PgResult(tag, col_desc,
+                        [[d.get(c) for c in cols] for d in dicts])
+
     def _insert(self, stmt: P.Insert) -> PgResult:
         table = self._table(stmt.table)
         schema = table.schema
@@ -634,6 +704,7 @@ class PgSession:
         # multi-row INSERT (one master RPC, not one per row; PG caches
         # sequence blocks the same way)
         serial_fill: Dict[str, List[int]] = {}
+        written: List[dict] = []
         for c in schema.columns:
             if c.default_seq is None or c.name in columns:
                 continue  # column bound explicitly: no default draw
@@ -677,6 +748,7 @@ class PgSession:
                                        for c in schema.range_columns))
             values = {c: v for c, v in bound.items() if c not in key_names}
             ops.append(QLWriteOp(WriteOpKind.INSERT, dk, values))
+            written.append(bound)
         if table.indexes:
             # indexed table: route through a (possibly implicit) transaction
             # maintaining every index (yql/index_maintenance.py)
@@ -684,6 +756,9 @@ class PgSession:
                 for op in ops:
                     IM.txn_write_with_indexes(txn, table, op, self._table)
             self._run_statement_txn(body)
+            if stmt.returning:
+                return self._returning_result(
+                    f"INSERT 0 {len(ops)}", table, stmt.returning, written)
             return PgResult(f"INSERT 0 {len(ops)}")
         # batch per destination tablet: one write RPC per tablet touched
         # (ref pg_session.h:222 RunAsync buffering + batcher grouping)
@@ -695,6 +770,9 @@ class PgSession:
             groups.setdefault(tid, []).append(op)
         for group in groups.values():
             self._write(table, group)
+        if stmt.returning:
+            return self._returning_result(
+                f"INSERT 0 {len(ops)}", table, stmt.returning, written)
         return PgResult(f"INSERT 0 {len(ops)}")
 
     # ------------------------------------------------- system virtual tables
@@ -2092,8 +2170,11 @@ class PgSession:
         schema = table.schema
         where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
         if none_match:
-            return PgResult("UPDATE 0")
-        stmt = P.Update(stmt.table, stmt.assignments, where)
+            return (self._returning_result("UPDATE 0", table,
+                                           stmt.returning, [])
+                    if stmt.returning else PgResult("UPDATE 0"))
+        stmt = P.Update(stmt.table, stmt.assignments, where,
+                        stmt.returning)
         key_names = {c.name for c in schema.hash_columns} | \
             {c.name for c in schema.range_columns}
         bad = [c for c, _v in stmt.assignments if c in key_names]
@@ -2142,6 +2223,7 @@ class PgSession:
 
             def body(txn):
                 pairs = self._target_rows(table, stmt.where, txn)
+                new_dicts = []
                 for k, d in pairs:
                     values = dict(plain)
                     for c, fn in fns.items():
@@ -2149,53 +2231,79 @@ class PgSession:
                     IM.txn_write_with_indexes(
                         txn, table, QLWriteOp(WriteOpKind.UPDATE, k,
                                               values), self._table)
-                return len(pairs)
+                    new_dicts.append({**d, **values})
+                return len(pairs), new_dicts
 
-            n = self._run_statement_txn(body)
+            n, new_dicts = self._run_statement_txn(body)
+            if stmt.returning:
+                return self._returning_result(
+                    f"UPDATE {n}", table, stmt.returning, new_dicts)
             return PgResult(f"UPDATE {n}")
 
         dk, filters = self._split_where(table, stmt.where)
         if (dk is not None and not filters and not table.indexes
-                and self._txn is None):
+                and self._txn is None and not stmt.returning):
             # point update, no indexes: the single-shard fast path is
-            # already atomic
+            # already atomic (RETURNING needs the full row — txn path)
             self._write(table, [QLWriteOp(WriteOpKind.UPDATE, dk,
                                           dict(plain))])
             return PgResult("UPDATE 1")
 
         def body(txn):
-            keys = self._target_keys(table, stmt.where, txn)
-            for k in keys:
+            if stmt.returning:
+                # RETURNING needs each row's remaining columns
+                pairs = self._target_rows(table, stmt.where, txn)
+            else:
+                pairs = [(k, None)
+                         for k in self._target_keys(table, stmt.where,
+                                                    txn)]
+            for k, _d in pairs:
                 IM.txn_write_with_indexes(
                     txn, table,
                     QLWriteOp(WriteOpKind.UPDATE, k,
                               dict(plain)), self._table)
-            return len(keys)
+            return (len(pairs),
+                    [{**d, **plain} for _k, d in pairs if d is not None])
 
-        n = self._run_statement_txn(body)
+        n, new_dicts = self._run_statement_txn(body)
+        if stmt.returning:
+            return self._returning_result(
+                f"UPDATE {n}", table, stmt.returning, new_dicts)
         return PgResult(f"UPDATE {n}")
 
     def _delete(self, stmt: P.Delete) -> PgResult:
         where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
-        if none_match:
-            return PgResult("DELETE 0")
-        stmt = P.Delete(stmt.table, where)
         table = self._table(stmt.table)
+        if none_match:
+            return (self._returning_result("DELETE 0", table,
+                                           stmt.returning, [])
+                    if stmt.returning else PgResult("DELETE 0"))
+        stmt = P.Delete(stmt.table, where, stmt.returning)
         dk, filters = self._split_where(table, stmt.where)
         if (dk is not None and not filters and not table.indexes
-                and self._txn is None):
+                and self._txn is None and not stmt.returning):
             self._write(table, [QLWriteOp(WriteOpKind.DELETE_ROW, dk)])
             return PgResult("DELETE 1")
 
         def body(txn):
-            keys = self._target_keys(table, stmt.where, txn)
-            for k in keys:
+            if stmt.returning:
+                # RETURNING projects the OLD rows (PG semantics)
+                pairs = self._target_rows(table, stmt.where, txn)
+            else:
+                pairs = [(k, None)
+                         for k in self._target_keys(table, stmt.where,
+                                                    txn)]
+            for k, _d in pairs:
                 IM.txn_write_with_indexes(
                     txn, table, QLWriteOp(WriteOpKind.DELETE_ROW, k),
                     self._table)
-            return len(keys)
+            return (len(pairs),
+                    [d for _k, d in pairs if d is not None])
 
-        n = self._run_statement_txn(body)
+        n, old_dicts = self._run_statement_txn(body)
+        if stmt.returning:
+            return self._returning_result(
+                f"DELETE {n}", table, stmt.returning, old_dicts)
         return PgResult(f"DELETE {n}")
 
     # ------------------------------------------------------- transactions

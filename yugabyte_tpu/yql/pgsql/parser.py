@@ -84,6 +84,9 @@ class Insert:
     table: str
     columns: Optional[List[str]]
     rows: List[List[object]]
+    # RETURNING * | col [, ...] (ref: PG returning_clause, gram.y;
+    # executed like PG's ExecProcessReturning over the written rows)
+    returning: Optional[List[str]] = None
 
 
 class Param:
@@ -164,12 +167,14 @@ class Update:
     table: str
     assignments: List[Tuple[str, object]]
     where: List[Tuple[str, str, object]]
+    returning: Optional[List[str]] = None
 
 
 @dataclass
 class Delete:
     table: str
     where: List[Tuple[str, str, object]]
+    returning: Optional[List[str]] = None
 
 
 @dataclass
@@ -183,6 +188,25 @@ class CreateSequence:
 class DropSequence:
     name: str
     if_exists: bool = False
+
+
+@dataclass
+class PrepareStmt:
+    """PREPARE name [(types)] AS <dml> (ref: PG PrepareQuery,
+    commands/prepare.c). Parameter types are inferred at bind time."""
+    name: str
+    stmt: object
+
+
+@dataclass
+class ExecuteStmt:
+    name: str
+    params: List[object] = field(default_factory=list)
+
+
+@dataclass
+class DeallocateStmt:
+    name: Optional[str]                # None = ALL
 
 
 @dataclass
@@ -297,6 +321,36 @@ class PgParser(_BaseParser):
         if self.accept_kw("DROP", "TABLE"):
             if_exists = self.accept_kw("IF", "EXISTS")
             return DropTable(self._table_name(), if_exists)
+        if self.accept_kw("PREPARE"):
+            name = self.name()
+            if self.accept_op("("):   # declared param types: ignored
+                depth = 1             # typmods like numeric(10,2) nest
+                while depth:
+                    tok = self.next()
+                    if tok == ("op", "("):
+                        depth += 1
+                    elif tok == ("op", ")"):
+                        depth -= 1
+            self.expect_kw("AS")
+            inner = self.parse_one()
+            if not isinstance(inner, (Select, UnionSelect, Insert,
+                                      Update, Delete)):
+                raise ParseError("PREPARE applies to DML statements")
+            return PrepareStmt(name, inner)
+        if self.accept_kw("EXECUTE"):
+            name = self.name()
+            params: List[object] = []
+            if self.accept_op("("):
+                params.append(self.literal())
+                while self.accept_op(","):
+                    params.append(self.literal())
+                self.expect_op(")")
+            return ExecuteStmt(name, params)
+        if self.accept_kw("DEALLOCATE"):
+            self.accept_kw("PREPARE")
+            if self.accept_kw("ALL"):
+                return DeallocateStmt(None)
+            return DeallocateStmt(self.name())
         if self.accept_kw("TRUNCATE"):
             self.accept_kw("TABLE")
             tables = [self._table_name()]
@@ -502,7 +556,17 @@ class PgParser(_BaseParser):
             rows.append(row)
             if not self.accept_op(","):
                 break
-        return Insert(name, columns, rows)
+        return Insert(name, columns, rows, self._returning())
+
+    def _returning(self) -> Optional[List[str]]:
+        if not self.accept_kw("RETURNING"):
+            return None
+        if self.accept_op("*"):
+            return ["*"]
+        out = [self._col_ref()]
+        while self.accept_op(","):
+            out.append(self._col_ref())
+        return out
 
     _AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
 
@@ -996,7 +1060,8 @@ class PgParser(_BaseParser):
         assignments = [(self.name(), self._assigned_value())]
         while self.accept_op(","):
             assignments.append((self.name(), self._assigned_value()))
-        return Update(name, assignments, self._pg_where())
+        return Update(name, assignments, self._pg_where(),
+                      self._returning())
 
     def _assigned_value(self):
         """RHS of SET col = ...: a plain literal (the blind-write fast
@@ -1009,7 +1074,9 @@ class PgParser(_BaseParser):
         return ("__expr__", node)
 
     def _delete(self) -> Delete:
-        return Delete(self._table_name(), self._pg_where())
+        name = self._table_name()
+        where = self._pg_where()
+        return Delete(name, where, self._returning())
 
 
 def _sub_expr_node(node, sub):
@@ -1022,6 +1089,24 @@ def _sub_expr_node(node, sub):
         return ("op", node[1], _sub_expr_node(node[2], sub),
                 _sub_expr_node(node[3], sub))
     return node
+
+
+def max_param_idx(obj) -> int:
+    """Highest $n placeholder index reachable in a parsed statement tree
+    (0 = no parameters). Walks dataclasses, sequences and dicts — used
+    by SQL-level EXECUTE to validate the argument count like PG's
+    'wrong number of parameters' check (commands/prepare.c)."""
+    import dataclasses as _dc
+    if isinstance(obj, Param):
+        return obj.idx
+    if _dc.is_dataclass(obj) and not isinstance(obj, type):
+        return max((max_param_idx(getattr(obj, f.name))
+                    for f in _dc.fields(obj)), default=0)
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return max((max_param_idx(x) for x in obj), default=0)
+    if isinstance(obj, dict):
+        return max((max_param_idx(v) for v in obj.values()), default=0)
+    return 0
 
 
 def bind_params(stmt: Statement, params: List[object]) -> Statement:
