@@ -986,6 +986,40 @@ def _workload_at(n_total: int):
     return slab, offsets, n_total, cutoff
 
 
+def _last_tpu_keys() -> dict:
+    """When the tunnel is down at capture time, surface the most recent
+    COMMITTED TPU measurements (clearly labeled last_tpu_*, with their
+    capture file) so a CPU-fallback artifact is not blind to the real
+    hardware results this round already recorded."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for name in sorted(os.listdir(here)):
+        if not (name.startswith("BENCH_SELF") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(here, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if rec.get("platform") == "tpu":
+                        best = (name, rec)
+        except Exception:  # noqa: BLE001 — artifact scan is best-effort
+            continue
+    if best is None:
+        return {}
+    name, rec = best
+    out = {"last_tpu_source": name}
+    for k in ("value", "vs_baseline", "kernel_vs_cpu_core",
+              "e2e_steady_rows_per_sec", "e2e_native_rows_per_sec",
+              "device_resident_rows_per_sec", "seq_scan_rows_per_sec",
+              "point_reads_per_sec", "compile_s", "n_rows", "device"):
+        if k in rec:
+            out[f"last_tpu_{k}"] = rec[k]
+    return out
+
+
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--probe":
         run_probe_child(sys.argv[2])
@@ -1038,6 +1072,8 @@ def main():
                 rung = _Rung(n_top)
                 rungs.append(rung)
             result = _spawn_child("cpu", measure_budget * 2, rung.wl_path)
+            if result is not None:
+                result.update(_last_tpu_keys())
         native_rate = rung.native_rate if rung else 0.0
         cpu_rate = rung.cpu_rate if rung else 0.0
     finally:

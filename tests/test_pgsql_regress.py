@@ -1018,6 +1018,18 @@ class TestReturning:
         assert res.rows == [["1", "5"]]
         assert [n for n, _o in res.columns] == ["id", "v"]
 
+
+    def test_returning_bad_column_does_not_mutate(self, conn):
+        # statement atomicity: a failing RETURNING must not persist the
+        # write (validated BEFORE execution)
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO r (v) VALUES (1) RETURNING nope")
+        assert rows(conn, "SELECT * FROM r") == []
+        conn.query("INSERT INTO r (v, tag) VALUES (5, 'keep')")
+        with pytest.raises(PgWireError):
+            conn.query("DELETE FROM r WHERE tag = 'keep' RETURNING nope")
+        assert rows(conn, "SELECT v FROM r") == [("5",)]
+
     def test_returning_unknown_column(self, conn):
         with pytest.raises(PgWireError):
             conn.query("INSERT INTO r (v) VALUES (1) RETURNING nope")
@@ -1067,3 +1079,22 @@ class TestPrepare:
         with pytest.raises(PgWireError):
             conn.query("EXECUTE pc")
         conn.query("DEALLOCATE pc")
+
+    def test_prepared_delete_with_in_list_params(self, conn):
+        conn.query("CREATE TABLE pin (k INT PRIMARY KEY)")
+        conn.query("INSERT INTO pin VALUES (1), (2), (3)")
+        conn.query("PREPARE di AS DELETE FROM pin WHERE k IN ($1, $2)")
+        conn.query("EXECUTE di (1, 3)")
+        assert rows(conn, "SELECT k FROM pin") == [("2",)]
+        conn.query("DEALLOCATE di")
+        conn.query("DROP TABLE pin")
+
+    def test_execute_extended_describe(self, conn):
+        conn.query("CREATE TABLE pe (k INT PRIMARY KEY, v TEXT)")
+        conn.query("INSERT INTO pe VALUES (1, 'one')")
+        conn.query("PREPARE pesel AS SELECT v FROM pe WHERE k = 1")
+        res = conn.extended_query("EXECUTE pesel")
+        assert res.rows == [["one"]]
+        assert res.columns is not None and res.columns[0][0] == "v"
+        conn.query("DEALLOCATE pesel")
+        conn.query("DROP TABLE pe")

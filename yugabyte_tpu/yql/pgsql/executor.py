@@ -364,23 +364,17 @@ class PgSession:
                          ) -> Optional[List[Tuple[str, int]]]:
         """RowDescription for a statement BEFORE execution (the extended
         protocol's Describe), or None for row-less statements."""
+        if isinstance(stmt, P.ExecuteStmt):
+            # Describe of EXECUTE answers for the prepared inner statement
+            inner = self._prepared.get(stmt.name)
+            return self.describe_columns(inner) if inner is not None \
+                else None
         if isinstance(stmt, (P.Insert, P.Update, P.Delete)) \
                 and stmt.returning:
             # RETURNING produces rows: Describe must announce them or
             # the later DataRows violate the protocol
             schema = self._table(stmt.table).schema
-            if "*" in stmt.returning:
-                cols = [c.name for c in schema.columns if not c.dropped]
-            else:
-                cols = [c.split(".")[-1] for c in stmt.returning]
-            out = []
-            for c in cols:
-                try:
-                    out.append((c, PG_OIDS[schema.column(c).type]))
-                except KeyError:
-                    raise PgError(Status.InvalidArgument(
-                        f'column "{c}" does not exist'), "42703")
-            return out
+            return self._returning_cols(schema, stmt.returning)[1]
         if not isinstance(stmt, (P.Select, P.Show)):
             return None
         if isinstance(stmt, P.Show):
@@ -672,16 +666,16 @@ class PgSession:
         return IM.run_in_implicit_txn(self._txn_manager, self._txn, body,
                                       deadline_s)
 
-    def _returning_result(self, tag: str, table, returning,
-                          dicts) -> PgResult:
-        """RETURNING projection over the written rows (ref: PG
-        ExecProcessReturning): '*' expands to all live columns."""
-        schema = table.schema
+    @staticmethod
+    def _returning_cols(schema, returning):
+        """Resolve a RETURNING list to (bare column names, col_desc),
+        raising 42703 for unknown refs. Called BEFORE the write so a bad
+        RETURNING clause fails the whole statement without mutating
+        anything (PG statement atomicity); '*' expands to all live
+        columns, qualified refs resolve by the bare name."""
         if "*" in returning:
             cols = [c.name for c in schema.columns if not c.dropped]
         else:
-            # table-qualified refs label and resolve by the bare name
-            # (the single-table SELECT paths strip qualifiers the same way)
             cols = [c.split(".")[-1] for c in returning]
         col_desc = []
         for c in cols:
@@ -690,12 +684,21 @@ class PgSession:
             except KeyError:
                 raise PgError(Status.InvalidArgument(
                     f'column "{c}" does not exist'), "42703")
+        return cols, col_desc
+
+    def _returning_result(self, tag: str, table, returning,
+                          dicts) -> PgResult:
+        """RETURNING projection over the written rows (ref: PG
+        ExecProcessReturning)."""
+        cols, col_desc = self._returning_cols(table.schema, returning)
         return PgResult(tag, col_desc,
                         [[d.get(c) for c in cols] for d in dicts])
 
     def _insert(self, stmt: P.Insert) -> PgResult:
         table = self._table(stmt.table)
         schema = table.schema
+        if stmt.returning:
+            self._returning_cols(schema, stmt.returning)  # fail pre-write
         columns = stmt.columns or [c.name for c in schema.columns]
         key_names = [c.name for c in schema.hash_columns] + \
             [c.name for c in schema.range_columns]
@@ -2168,6 +2171,8 @@ class PgSession:
     def _update(self, stmt: P.Update) -> PgResult:
         table = self._table(stmt.table)
         schema = table.schema
+        if stmt.returning:
+            self._returning_cols(schema, stmt.returning)  # fail pre-write
         where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
         if none_match:
             return (self._returning_result("UPDATE 0", table,
@@ -2274,6 +2279,8 @@ class PgSession:
     def _delete(self, stmt: P.Delete) -> PgResult:
         where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
         table = self._table(stmt.table)
+        if stmt.returning:
+            self._returning_cols(table.schema, stmt.returning)
         if none_match:
             return (self._returning_result("DELETE 0", table,
                                            stmt.returning, [])

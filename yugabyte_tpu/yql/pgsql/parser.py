@@ -1145,12 +1145,7 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
                 return ("op", it[1], sub_item(it[2]), sub_item(it[3]))
             return it
 
-        def sub_val(v):
-            if isinstance(v, Select):
-                return bind_params(v, params)  # subquery: recurse
-            if isinstance(v, tuple):
-                return tuple(sub(x) for x in v)  # IN list
-            return sub(v)
+        sub_val = _make_sub_val(sub, params)
         offset = sub(stmt.offset)
         return replace(stmt, where=[(c, op, sub_val(v))
                                     for c, op, v in stmt.where],
@@ -1162,6 +1157,8 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
                        having=[(i, op, sub(v))
                                for i, op, v in stmt.having])
     if isinstance(stmt, Update):
+        sub_val = _make_sub_val(sub, params)
+
         def sub_assign(v):
             if isinstance(v, tuple) and len(v) == 2 and v[0] == "__expr__":
                 return ("__expr__", _sub_expr_node(v[1], sub))
@@ -1169,11 +1166,26 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
         return replace(stmt,
                        assignments=[(c, sub_assign(v))
                                     for c, v in stmt.assignments],
-                       where=[(c, op, sub(v)) for c, op, v in stmt.where])
+                       where=[(c, op, sub_val(v))
+                              for c, op, v in stmt.where])
     if isinstance(stmt, Delete):
-        return replace(stmt, where=[(c, op, sub(v))
+        sub_val = _make_sub_val(sub, params)
+        return replace(stmt, where=[(c, op, sub_val(v))
                                     for c, op, v in stmt.where])
     return stmt
+
+
+def _make_sub_val(sub, params):
+    """WHERE-value substituter shared by Select/Update/Delete: recurses
+    into IN-list tuples and subquery Selects so $n placeholders bind
+    everywhere a predicate value can hold one."""
+    def sub_val(v):
+        if isinstance(v, Select):
+            return bind_params(v, params)  # subquery: recurse
+        if isinstance(v, tuple):
+            return tuple(sub(x) for x in v)  # IN list
+        return sub(v)
+    return sub_val
 
 
 def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
