@@ -993,7 +993,14 @@ def _last_tpu_keys() -> dict:
     hardware results this round already recorded."""
     here = os.path.dirname(os.path.abspath(__file__))
     best = None
-    for name in sorted(os.listdir(here)):
+    # recency by mtime, not filename (lexicographic breaks across digit
+    # boundaries, e.g. r99 vs r100)
+    def _mtime(n):
+        try:
+            return os.path.getmtime(os.path.join(here, n))
+        except OSError:
+            return 0.0
+    for name in sorted(os.listdir(here), key=_mtime):
         if not (name.startswith("BENCH_SELF") and name.endswith(".json")):
             continue
         try:

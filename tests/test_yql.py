@@ -444,3 +444,87 @@ class TestCqlOrderBy:
         rs = ql.execute("SELECT ts FROM series WHERE dev = 'd1' "
                         "AND ts IN (1, 2, 3) ORDER BY ts DESC")
         assert [r[0] for r in rs.rows] == [3, 2, 1]
+
+
+def test_redis_string_ops(redis):
+    # APPEND / STRLEN / SETNX / GETSET / GETDEL
+    assert redis.cmd("APPEND", "s1", "hello") == 5
+    assert redis.cmd("APPEND", "s1", " world") == 11
+    assert redis.cmd("STRLEN", "s1") == 11
+    assert redis.cmd("STRLEN", "missing") == 0
+    assert redis.cmd("SETNX", "s1", "x") == 0
+    assert redis.cmd("SETNX", "s2", "first") == 1
+    assert redis.cmd("GET", "s2") == b"first"
+    assert redis.cmd("GETSET", "s2", "second") == b"first"
+    assert redis.cmd("GETDEL", "s2") == b"second"
+    assert redis.cmd("GET", "s2") is None
+
+
+def test_redis_ranges(redis):
+    redis.cmd("SET", "r1", "Hello World")
+    assert redis.cmd("GETRANGE", "r1", "0", "4") == b"Hello"
+    assert redis.cmd("GETRANGE", "r1", "-5", "-1") == b"World"
+    assert redis.cmd("SETRANGE", "r1", "6", "Redis") == 11
+    assert redis.cmd("GET", "r1") == b"Hello Redis"
+    # SETRANGE past the end zero-pads
+    assert redis.cmd("SETRANGE", "r2", "3", "x") == 4
+    assert redis.cmd("GET", "r2") == b"\x00\x00\x00x"
+
+
+def test_redis_type_rename_persist(redis):
+    redis.cmd("SET", "t1", "v")
+    redis.cmd("HSET", "t2", "f", "v")
+    assert redis.cmd("TYPE", "t1") == "string"
+    assert redis.cmd("TYPE", "t2") == "hash"
+    assert redis.cmd("TYPE", "t3") == "none"
+    assert redis.cmd("RENAME", "t1", "t1b") == "OK"
+    assert redis.cmd("GET", "t1") is None
+    assert redis.cmd("GET", "t1b") == b"v"
+    assert redis.cmd("RENAME", "t2", "t2b") == "OK"
+    assert redis.cmd("HGET", "t2b", "f") == b"v"
+    assert redis.cmd("HGET", "t2", "f") is None
+    with pytest.raises(RuntimeError):
+        redis.cmd("RENAME", "ghost", "dst")
+    redis.cmd("SET", "p1", "v", "EX", "100")
+    assert redis.cmd("PERSIST", "p1") == 1
+    assert redis.cmd("PERSIST", "ghost") == 0
+
+
+def test_redis_hash_extras(redis):
+    redis.cmd("HSET", "h9", "a", "1", "b", "two")
+    assert redis.cmd("HEXISTS", "h9", "a") == 1
+    assert redis.cmd("HEXISTS", "h9", "z") == 0
+    assert sorted(redis.cmd("HKEYS", "h9")) == [b"a", b"b"]
+    assert sorted(redis.cmd("HVALS", "h9")) == [b"1", b"two"]
+    assert redis.cmd("HSTRLEN", "h9", "b") == 3
+    assert redis.cmd("HINCRBY", "h9", "a", "41") == 42
+    assert redis.cmd("HINCRBY", "h9", "cnt", "-5") == -5
+    assert redis.cmd("HSETNX", "h9", "a", "99") == 0
+    assert redis.cmd("HSETNX", "h9", "new", "n") == 1
+    assert redis.cmd("HGET", "h9", "new") == b"n"
+
+
+def test_redis_rename_semantics(redis):
+    # self-rename is a successful no-op
+    redis.cmd("SET", "rs", "val")
+    assert redis.cmd("RENAME", "rs", "rs") == "OK"
+    assert redis.cmd("GET", "rs") == b"val"
+    # rename fully REPLACES an existing destination (no merge)
+    redis.cmd("HSET", "rdst", "old", "1")
+    redis.cmd("HSET", "rsrc", "new", "2")
+    assert redis.cmd("RENAME", "rsrc", "rdst") == "OK"
+    assert sorted(redis.cmd("HKEYS", "rdst")) == [b"new"]
+    # string-over-hash rename clears the hash representation
+    redis.cmd("HSET", "rh", "f", "v")
+    redis.cmd("SET", "rstr", "sv")
+    assert redis.cmd("RENAME", "rstr", "rh") == "OK"
+    assert redis.cmd("TYPE", "rh") == "string"
+    assert redis.cmd("HGET", "rh", "f") is None
+
+
+def test_redis_setrange_empty_patch(redis):
+    assert redis.cmd("SETRANGE", "srm", "3", "") == 0
+    assert redis.cmd("EXISTS", "srm") == 0
+    redis.cmd("SET", "srk", "abc")
+    assert redis.cmd("SETRANGE", "srk", "10", "") == 3
+    assert redis.cmd("GET", "srk") == b"abc"

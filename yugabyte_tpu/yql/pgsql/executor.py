@@ -365,10 +365,14 @@ class PgSession:
         """RowDescription for a statement BEFORE execution (the extended
         protocol's Describe), or None for row-less statements."""
         if isinstance(stmt, P.ExecuteStmt):
-            # Describe of EXECUTE answers for the prepared inner statement
+            # Describe of EXECUTE answers for the prepared inner
+            # statement; unknown names error here, like PG (26000)
             inner = self._prepared.get(stmt.name)
-            return self.describe_columns(inner) if inner is not None \
-                else None
+            if inner is None:
+                raise PgError(Status.NotFound(
+                    f'prepared statement "{stmt.name}" does not exist'),
+                    "26000")
+            return self.describe_columns(inner)
         if isinstance(stmt, (P.Insert, P.Update, P.Delete)) \
                 and stmt.returning:
             # RETURNING produces rows: Describe must announce them or
@@ -2277,10 +2281,10 @@ class PgSession:
         return PgResult(f"UPDATE {n}")
 
     def _delete(self, stmt: P.Delete) -> PgResult:
-        where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
         table = self._table(stmt.table)
         if stmt.returning:
             self._returning_cols(table.schema, stmt.returning)
+        where, none_match = self._resolve_dml_where(stmt.table, stmt.where)
         if none_match:
             return (self._returning_result("DELETE 0", table,
                                            stmt.returning, [])

@@ -269,21 +269,17 @@ class RedisServer:
         # value's control fields) — report "no expiry info".
         return resp.integer(-1 if self._get(args[0]) is not None else -2)
 
-    def _incr_by(self, key: bytes, delta: int):
+    def _txn_rmw(self, body, cmd_name: str):
+        """Atomic read-modify-write: run body(txn) in a distributed txn
+        with conflict retries — the single-key atomicity redis commands
+        guarantee on a thread-per-connection server (ref: the reference
+        routes YEDIS RMW commands through the same write path)."""
         for _ in range(16):
             txn = self._txns.begin()
             try:
-                row = txn.read_row(self._strings, self._str_key(key))
-                cur = 0
-                if row is not None:
-                    raw = row.columns.get(self._val_str) or b"0"
-                    cur = int(raw)
-                new = cur + delta
-                txn.write(self._strings, [QLWriteOp(
-                    WriteOpKind.INSERT, self._str_key(key),
-                    {"value": str(new).encode()})])
+                out = body(txn)
                 txn.commit()
-                return resp.integer(new)
+                return out
             except TransactionError:
                 txn.abort()
             except BaseException:
@@ -291,7 +287,144 @@ class RedisServer:
                 # would pin its intents.
                 txn.abort()
                 raise
-        return resp.error("INCR conflict retries exhausted")
+        return resp.error(f"{cmd_name} conflict retries exhausted")
+
+    def _incr_by(self, key: bytes, delta: int):
+        def body(txn):
+            row = txn.read_row(self._strings, self._str_key(key))
+            cur = 0
+            if row is not None:
+                raw = row.columns.get(self._val_str) or b"0"
+                cur = int(raw)
+            new = cur + delta
+            txn.write(self._strings, [QLWriteOp(
+                WriteOpKind.INSERT, self._str_key(key),
+                {"value": str(new).encode()})])
+            return resp.integer(new)
+        return self._txn_rmw(body, "INCR")
+
+    def cmd_append(self, args):
+        def body(txn):
+            row = txn.read_row(self._strings, self._str_key(args[0]))
+            cur = b"" if row is None \
+                else (row.columns.get(self._val_str) or b"")
+            new = cur + args[1]
+            txn.write(self._strings, [QLWriteOp(
+                WriteOpKind.INSERT, self._str_key(args[0]),
+                {"value": new})])
+            return resp.integer(len(new))
+        return self._txn_rmw(body, "APPEND")
+
+    def cmd_strlen(self, args):
+        v = self._get(args[0])
+        return resp.integer(0 if v is None else len(v))
+
+    def cmd_setnx(self, args):
+        def body(txn):
+            if txn.read_row(self._strings,
+                            self._str_key(args[0])) is not None:
+                return resp.integer(0)
+            txn.write(self._strings, [QLWriteOp(
+                WriteOpKind.INSERT, self._str_key(args[0]),
+                {"value": args[1]})])
+            return resp.integer(1)
+        return self._txn_rmw(body, "SETNX")
+
+    def cmd_getset(self, args):
+        def body(txn):
+            row = txn.read_row(self._strings, self._str_key(args[0]))
+            old = None if row is None else row.columns.get(self._val_str)
+            txn.write(self._strings, [QLWriteOp(
+                WriteOpKind.INSERT, self._str_key(args[0]),
+                {"value": args[1]})])
+            return resp.bulk(old)
+        return self._txn_rmw(body, "GETSET")
+
+    def cmd_getdel(self, args):
+        def body(txn):
+            row = txn.read_row(self._strings, self._str_key(args[0]))
+            old = None if row is None else row.columns.get(self._val_str)
+            if old is not None:
+                txn.write(self._strings, [QLWriteOp(
+                    WriteOpKind.DELETE_ROW, self._str_key(args[0]))])
+            return resp.bulk(old)
+        return self._txn_rmw(body, "GETDEL")
+
+    def cmd_getrange(self, args):
+        v = self._get(args[0])
+        if v is None:
+            return resp.bulk(b"")
+        start, end = int(args[1]), int(args[2])
+        if start < 0:
+            start = max(0, len(v) + start)
+        end = len(v) + end if end < 0 else end
+        return resp.bulk(v[start:end + 1])
+
+    def cmd_setrange(self, args):
+        offset, patch = int(args[1]), args[2]
+        v = self._get(args[0])
+        if not patch:
+            # empty patch never creates a key (redis SETRANGE semantics)
+            return resp.integer(0 if v is None else len(v))
+        v = v or b""
+        if len(v) < offset:
+            v = v + b"\x00" * (offset - len(v))
+        new = v[:offset] + patch + v[offset + len(patch):]
+        self._set(args[0], new)
+        return resp.integer(len(new))
+
+    def cmd_persist(self, args):
+        v = self._get(args[0])
+        if v is None:
+            return resp.integer(0)
+        self._set(args[0], v)  # rewrite without TTL control field
+        return resp.integer(1)
+
+    def cmd_type(self, args):
+        if self._get(args[0]) is not None:
+            return resp.simple("string")
+        if next(iter(self._hash_fields(args[0])), None) is not None:
+            return resp.simple("hash")
+        return resp.simple("none")
+
+    def _clear_key(self, txn, key: bytes) -> None:
+        """Remove every representation of `key` (string row + hash
+        fields) inside txn — RENAME fully replaces the destination."""
+        if txn.read_row(self._strings, self._str_key(key)) is not None:
+            txn.write(self._strings, [QLWriteOp(
+                WriteOpKind.DELETE_ROW, self._str_key(key))])
+        for f, _v in list(self._hash_fields(key)):
+            txn.write(self._hashes, [QLWriteOp(
+                WriteOpKind.DELETE_ROW, self._hash_key(key, f))])
+
+    def cmd_rename(self, args):
+        src, dst = args[0], args[1]
+
+        def body(txn):
+            row = txn.read_row(self._strings, self._str_key(src))
+            v = None if row is None else row.columns.get(self._val_str)
+            fields = [] if v is not None else list(self._hash_fields(src))
+            if v is None and not fields:
+                return resp.error("no such key")
+            if src == dst:
+                return resp.simple("OK")  # successful no-op
+            self._clear_key(txn, dst)
+            if v is not None:
+                txn.write(self._strings, [
+                    QLWriteOp(WriteOpKind.INSERT, self._str_key(dst),
+                              {"value": v}),
+                    QLWriteOp(WriteOpKind.DELETE_ROW,
+                              self._str_key(src))])
+            else:
+                txn.write(self._hashes, [
+                    QLWriteOp(WriteOpKind.INSERT,
+                              self._hash_key(dst, f), {"value": val})
+                    for f, val in fields] + [
+                    QLWriteOp(WriteOpKind.DELETE_ROW,
+                              self._hash_key(src, f))
+                    for f, _v in fields])
+            return resp.simple("OK")
+        return self._txn_rmw(body, "RENAME")
 
     def cmd_incr(self, args):
         return self._incr_by(args[0], 1)
@@ -361,6 +494,52 @@ class RedisServer:
 
     def cmd_hlen(self, args):
         return resp.integer(sum(1 for _ in self._hash_fields(args[0])))
+
+    def cmd_hexists(self, args):
+        row = self._client.read_row(self._hashes,
+                                    self._hash_key(args[0], args[1]))
+        return resp.integer(0 if row is None else 1)
+
+    def cmd_hkeys(self, args):
+        return resp.array([resp.bulk(f)
+                           for f, _v in self._hash_fields(args[0])])
+
+    def cmd_hvals(self, args):
+        return resp.array([resp.bulk(v)
+                           for _f, v in self._hash_fields(args[0])])
+
+    def cmd_hstrlen(self, args):
+        row = self._client.read_row(self._hashes,
+                                    self._hash_key(args[0], args[1]))
+        v = None if row is None else row.columns.get(self._val_hash)
+        return resp.integer(0 if v is None else len(v))
+
+    def cmd_hincrby(self, args):
+        key, field, delta = args[0], args[1], int(args[2])
+
+        def body(txn):
+            row = txn.read_row(self._hashes, self._hash_key(key, field))
+            cur = 0
+            if row is not None:
+                cur = int(row.columns.get(self._val_hash) or b"0")
+            new = cur + delta
+            txn.write(self._hashes, [QLWriteOp(
+                WriteOpKind.INSERT, self._hash_key(key, field),
+                {"value": str(new).encode()})])
+            return resp.integer(new)
+        return self._txn_rmw(body, "HINCRBY")
+
+    def cmd_hsetnx(self, args):
+        def body(txn):
+            if txn.read_row(self._hashes,
+                            self._hash_key(args[0],
+                                           args[1])) is not None:
+                return resp.integer(0)
+            txn.write(self._hashes, [QLWriteOp(
+                WriteOpKind.INSERT, self._hash_key(args[0], args[1]),
+                {"value": args[2]})])
+            return resp.integer(1)
+        return self._txn_rmw(body, "HSETNX")
 
     # ----------------------------------------------------------------- misc
     def _all_keys(self):
