@@ -1,0 +1,160 @@
+"""YCQL lightweight transactions: INSERT ... IF NOT EXISTS, UPDATE/
+DELETE ... IF EXISTS / IF <conditions>, returning the CQL [applied]
+row (current values on CAS failure).
+
+ref: the reference's conditional DML — ql/ptree/pt_dml.h if-clause
+analysis; conditional QLWriteRequest if_expr evaluated in
+docdb/ql_operations; executed here as read-check-write distributed
+transactions with conflict retry.
+"""
+
+import threading
+
+import pytest
+
+from yugabyte_tpu.integration.mini_cluster import (
+    MiniCluster, MiniClusterOptions)
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.yql.cql.executor import QLProcessor
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    flags.set_flag("replication_factor", 1)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=1,
+        fs_root=str(tmp_path_factory.mktemp("lwtcluster")))).start()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def ql(cluster):
+    p = QLProcessor(cluster.new_client())
+    p.execute("CREATE KEYSPACE IF NOT EXISTS lwt")
+    p.execute("USE lwt")
+    p.execute("DROP TABLE IF EXISTS accounts")
+    p.execute("CREATE TABLE accounts (id TEXT PRIMARY KEY, "
+              "balance BIGINT, owner TEXT)")
+    return p
+
+
+def test_insert_if_not_exists(ql):
+    rs = ql.execute("INSERT INTO accounts (id, balance) VALUES ('a', 100) "
+                    "IF NOT EXISTS")
+    assert rs.columns[0] == "[applied]" and rs.rows == [[True]]
+    # second attempt fails and reports the existing row
+    rs = ql.execute("INSERT INTO accounts (id, balance) VALUES ('a', 999) "
+                    "IF NOT EXISTS")
+    assert rs.rows[0][0] is False
+    d = dict(zip(rs.columns, rs.rows[0]))
+    assert d["balance"] == 100
+    rs = ql.execute("SELECT balance FROM accounts WHERE id = 'a'")
+    assert rs.rows == [[100]]
+
+
+def test_update_if_condition(ql):
+    ql.execute("INSERT INTO accounts (id, balance, owner) "
+               "VALUES ('b', 50, 'bob')")
+    rs = ql.execute("UPDATE accounts SET balance = 40 WHERE id = 'b' "
+                    "IF balance = 50")
+    assert rs.rows == [[True]]
+    # CAS failure reports the condition column's current value
+    rs = ql.execute("UPDATE accounts SET balance = 0 WHERE id = 'b' "
+                    "IF balance = 50")
+    assert rs.rows[0][0] is False
+    d = dict(zip(rs.columns, rs.rows[0]))
+    assert d["balance"] == 40
+    # multi-condition
+    rs = ql.execute("UPDATE accounts SET balance = 35 WHERE id = 'b' "
+                    "IF balance = 40 AND owner = 'bob'")
+    assert rs.rows == [[True]]
+
+
+def test_update_if_exists(ql):
+    rs = ql.execute("UPDATE accounts SET balance = 1 WHERE id = 'ghost' "
+                    "IF EXISTS")
+    assert rs.rows == [[False]]
+    assert ql.execute("SELECT * FROM accounts WHERE id = 'ghost'").rows \
+        == []
+    ql.execute("INSERT INTO accounts (id, balance) VALUES ('c', 5)")
+    rs = ql.execute("UPDATE accounts SET balance = 6 WHERE id = 'c' "
+                    "IF EXISTS")
+    assert rs.rows == [[True]]
+
+
+def test_delete_if(ql):
+    ql.execute("INSERT INTO accounts (id, balance) VALUES ('d', 10)")
+    rs = ql.execute("DELETE FROM accounts WHERE id = 'd' IF balance = 99")
+    assert rs.rows[0][0] is False
+    assert ql.execute("SELECT id FROM accounts WHERE id = 'd'").rows \
+        == [["d"]]
+    rs = ql.execute("DELETE FROM accounts WHERE id = 'd' IF balance = 10")
+    assert rs.rows == [[True]]
+    assert ql.execute("SELECT id FROM accounts WHERE id = 'd'").rows == []
+    rs = ql.execute("DELETE FROM accounts WHERE id = 'd' IF EXISTS")
+    assert rs.rows == [[False]]
+
+
+def test_insert_if_not_exists_with_ttl_order(ql):
+    rs = ql.execute("INSERT INTO accounts (id, balance) VALUES ('t', 1) "
+                    "IF NOT EXISTS USING TTL 100")
+    assert rs.rows == [[True]]
+    rs = ql.execute("INSERT INTO accounts (id, balance) VALUES ('t2', 1) "
+                    "USING TTL 100 IF NOT EXISTS")
+    assert rs.rows == [[True]]
+
+
+def test_concurrent_cas_single_winner(ql, cluster):
+    ql.execute("INSERT INTO accounts (id, balance) VALUES ('race', 0)")
+    wins = []
+
+    def cas(i):
+        p = QLProcessor(cluster.new_client())
+        p.execute("USE lwt")
+        rs = p.execute("UPDATE accounts SET balance = %d "
+                       "WHERE id = 'race' IF balance = 0" % (i + 1))
+        wins.append(rs.rows[0][0])
+
+    ts = [threading.Thread(target=cas, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(1 for w in wins if w) == 1, wins
+
+
+def test_bind_markers_in_conditions(ql):
+    ql.execute("INSERT INTO accounts (id, balance) VALUES ('m', 7)")
+    rs = ql.execute("UPDATE accounts SET balance = ? WHERE id = ? "
+                    "IF balance = ?", [8, "m", 7])
+    assert rs.rows == [[True]]
+    rs = ql.execute("SELECT balance FROM accounts WHERE id = 'm'")
+    assert rs.rows == [[8]]
+
+
+def test_lwt_rejected_in_transaction_block(ql):
+    from yugabyte_tpu.utils.status import StatusError
+    with pytest.raises(StatusError, match="IF"):
+        ql.execute("BEGIN TRANSACTION "
+                   "INSERT INTO accounts (id, balance) VALUES ('x', 1) "
+                   "IF NOT EXISTS; "
+                   "END TRANSACTION")
+    assert ql.execute("SELECT id FROM accounts WHERE id = 'x'").rows == []
+
+
+def test_lwt_on_indexed_table(ql):
+    ql.execute("DROP TABLE IF EXISTS iacc")
+    ql.execute("CREATE TABLE iacc (id TEXT PRIMARY KEY, owner TEXT)")
+    ql.execute("CREATE INDEX iown ON iacc (owner)")
+    rs = ql.execute("INSERT INTO iacc (id, owner) VALUES ('1', 'ann') "
+                    "IF NOT EXISTS")
+    assert rs.rows == [[True]]
+    rs = ql.execute("UPDATE iacc SET owner = 'ben' WHERE id = '1' "
+                    "IF owner = 'ann'")
+    assert rs.rows == [[True]]
+    # index maintained through the conditional path
+    assert ql.execute("SELECT id FROM iacc WHERE owner = 'ben'").rows \
+        == [["1"]]
+    assert ql.execute("SELECT id FROM iacc WHERE owner = 'ann'").rows \
+        == []

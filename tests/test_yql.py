@@ -528,3 +528,23 @@ def test_redis_setrange_empty_patch(redis):
     redis.cmd("SET", "srk", "abc")
     assert redis.cmd("SETRANGE", "srk", "10", "") == 3
     assert redis.cmd("GET", "srk") == b"abc"
+
+
+def test_redis_range_clamping(redis):
+    redis.cmd("SET", "gc", "abc")
+    assert redis.cmd("GETRANGE", "gc", "0", "-5") == b"a"
+    assert redis.cmd("GETRANGE", "gc", "2", "1") == b""
+    assert redis.cmd("GETRANGE", "gc", "0", "99") == b"abc"
+    with pytest.raises(RuntimeError):
+        redis.cmd("SETRANGE", "gc", "-1", "x")
+    assert redis.cmd("GET", "gc") == b"abc"  # untouched on error
+
+
+def test_redis_rename_dual_representation(redis):
+    redis.cmd("SET", "dual", "sv")
+    redis.cmd("HSET", "dual", "f", "hv")
+    assert redis.cmd("RENAME", "dual", "dualdst") == "OK"
+    # BOTH representations moved; source fully gone
+    assert redis.cmd("GET", "dualdst") == b"sv"
+    assert redis.cmd("HGET", "dualdst", "f") == b"hv"
+    assert redis.cmd("EXISTS", "dual") == 0

@@ -432,6 +432,10 @@ class QLProcessor:
             return self._select(stmt, params, cursor, page_size=page_size,
                                 page_state=paging_state)
         if isinstance(stmt, (P.Insert, P.Update, P.Delete)):
+            if getattr(stmt, "if_not_exists", False) \
+                    or getattr(stmt, "if_exists", False) \
+                    or getattr(stmt, "conditions", None):
+                return self._conditional_dml(stmt, params, cursor)
             table, op = self._dml_to_op(stmt, params, cursor)
             ks = self._resolve_ks(getattr(stmt, "keyspace", None))
             IM.write_with_indexes(
@@ -443,6 +447,62 @@ class QLProcessor:
         if isinstance(stmt, P.Truncate):
             return self._truncate(stmt)
         raise StatusError(Status.NotSupported(f"statement {type(stmt)}"))
+
+    def _conditional_dml(self, stmt, params: List[object],
+                         cursor: List[int]) -> ResultSet:
+        """Lightweight transaction: INSERT ... IF NOT EXISTS, UPDATE/
+        DELETE ... IF EXISTS / IF <conds>. Runs as a read-check-write
+        distributed transaction with conflict retry, returning the CQL
+        [applied] row — with the current row's values when not applied
+        (ref: the conditional QLWriteRequest if_expr path; the analyzer's
+        if-clause handling in ql/ptree/pt_dml.h)."""
+        table, op = self._dml_to_op(stmt, params, cursor)
+        # IF conditions bind AFTER the WHERE clause (statement-text order)
+        conds = [(c, o, self._bind(v, params, cursor))
+                 for c, o, v in getattr(stmt, "conditions", [])]
+        ks = self._resolve_ks(getattr(stmt, "keyspace", None))
+        schema = table.schema
+        insert_mode = getattr(stmt, "if_not_exists", False)
+
+        def body(txn):
+            row = txn.read_row(table, op.doc_key)
+            d = self._row_dict(schema, row) if row is not None else None
+            if insert_mode:
+                applied = row is None
+            elif conds:
+                applied = d is not None and self._match(d, conds)
+            else:  # IF EXISTS
+                applied = row is not None
+            if applied:
+                IM.txn_write_with_indexes(
+                    txn, table, op,
+                    lambda name, _ks=ks: self._table(_ks, name),
+                    old_row_dict=d if d is not None else {})
+            return applied, d
+
+        applied, d = IM.run_in_implicit_txn(
+            self._txn_manager, None, body, 30.0)
+        rs = ResultSet(columns=["[applied]"], types=[DataType.BOOL])
+        if applied or d is None:
+            rs.rows.append([applied])
+        else:
+            # not applied: CQL returns the current values alongside
+            # [applied] = false so clients can see why the CAS failed
+            extra = sorted(d) if insert_mode else \
+                list(dict.fromkeys(c for c, _o, _v in conds)) or sorted(d)
+            rs.columns += extra
+            rs.types += [schema.column(c).type if self._has_col(schema, c)
+                         else None for c in extra]
+            rs.rows.append([applied] + [d.get(c) for c in extra])
+        return rs
+
+    @staticmethod
+    def _has_col(schema, name: str) -> bool:
+        try:
+            schema.column(name)
+            return True
+        except KeyError:
+            return False
 
     def _truncate(self, stmt: P.Truncate) -> ResultSet:
         """Delete every row (and maintained index rows) from the table.
@@ -1093,6 +1153,17 @@ class QLProcessor:
                          params: List[object]) -> ResultSet:
         """ref executor.cc transactional block execution + retry."""
         cursor = [0]
+        for s in stmt.statements:
+            if getattr(s, "if_not_exists", False) \
+                    or getattr(s, "if_exists", False) \
+                    or getattr(s, "conditions", None):
+                # conditional DML inside a transaction block would need
+                # per-statement [applied] results and condition reads at
+                # the block's snapshot — reject loudly rather than apply
+                # unconditionally (the reference likewise restricts LWT
+                # in batches)
+                raise StatusError(Status.NotSupported(
+                    "conditional DML (IF ...) inside BEGIN TRANSACTION"))
         decoded = [self._dml_to_op(s, params, cursor)
                    for s in stmt.statements]
         deadline = time.monotonic() + 30

@@ -135,6 +135,10 @@ class Insert:
     columns: List[str]
     values: List[object]                      # literal | FuncCall
     ttl_seconds: Optional[int] = None
+    # lightweight transaction: INSERT ... IF NOT EXISTS (ref: the CQL
+    # conditional DML surface; executed as a read-check-write txn like
+    # the reference's conditional QLWriteRequest with if_expr)
+    if_not_exists: bool = False
 
 
 @dataclass
@@ -156,6 +160,9 @@ class Update:
     assignments: List[Tuple[str, object]]
     where: List[Tuple[str, str, object]]
     ttl_seconds: Optional[int] = None
+    # IF EXISTS / IF col op val [AND ...] conditions (LWT)
+    if_exists: bool = False
+    conditions: List[Tuple[str, str, object]] = field(default_factory=list)
 
 
 @dataclass
@@ -164,6 +171,8 @@ class Delete:
     table: str
     where: List[Tuple[str, str, object]]
     columns: Optional[List[str]] = None       # DELETE col FROM ...
+    if_exists: bool = False
+    conditions: List[Tuple[str, str, object]] = field(default_factory=list)
 
 
 @dataclass
@@ -472,12 +481,34 @@ class Parser:
         while self.accept_op(","):
             vals.append(self._value_expr())
         self.expect_op(")")
+        ine = self.accept_kw("IF", "NOT", "EXISTS")
         ttl = None
         if self.accept_kw("USING", "TTL"):
             ttl = int(self.literal())
+        if not ine:
+            ine = self.accept_kw("IF", "NOT", "EXISTS")
         if len(cols) != len(vals):
             raise ParseError(f"{len(cols)} columns but {len(vals)} values")
-        return Insert(ks, table, cols, vals, ttl)
+        return Insert(ks, table, cols, vals, ttl, bool(ine))
+
+    def _if_conditions(self):
+        """Trailing IF EXISTS / IF col op literal [AND ...] of UPDATE and
+        DELETE -> (if_exists, conditions)."""
+        if not self.accept_kw("IF"):
+            return False, []
+        if self.accept_kw("EXISTS"):
+            return True, []
+        conds = []
+        while True:
+            col = self.name()
+            tok = self.next()
+            if tok[0] != "op" or tok[1] not in ("=", "<", ">", "<=",
+                                                ">=", "!="):
+                raise ParseError(
+                    f"expected comparison in IF, got {tok[1]!r}")
+            conds.append((col, tok[1], self.literal()))
+            if not self.accept_kw("AND"):
+                return False, conds
 
     def _json_path(self, col: str) -> JsonOp:
         """col ->'k' ->0 ... [->>'leaf'] — ->> is terminal (it yields
@@ -599,7 +630,10 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_kw("WHERE")
-        return Update(ks, table, assignments, self._where(), ttl)
+        where = self._where()
+        ife, conds = self._if_conditions()
+        return Update(ks, table, assignments, where, ttl,
+                      if_exists=ife, conditions=conds)
 
     def _delete_target(self):
         col = self.name()
@@ -619,7 +653,10 @@ class Parser:
         self.expect_kw("FROM")
         ks, table = self.qualified_name()
         self.expect_kw("WHERE")
-        return Delete(ks, table, self._where(), cols)
+        where = self._where()
+        ife, conds = self._if_conditions()
+        return Delete(ks, table, where, cols,
+                      if_exists=ife, conditions=conds)
 
     def _transaction(self) -> Transaction:
         stmts: List[Union[Insert, Update, Delete]] = []

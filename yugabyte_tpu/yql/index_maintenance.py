@@ -29,17 +29,26 @@ def table_indexes(table: YBTable) -> List[IndexInfo]:
 
 def txn_write_with_indexes(txn: YBTransaction, table: YBTable,
                            op: QLWriteOp,
-                           open_table: Callable[[str], YBTable]) -> None:
+                           open_table: Callable[[str], YBTable],
+                           old_row_dict=None) -> None:
     """Apply one main-table DML op inside `txn`, maintaining every index
-    attached to the table (write-and-delete mode applies from creation)."""
+    attached to the table (write-and-delete mode applies from creation).
+
+    old_row_dict: the row's current values when the caller already read
+    them in this txn (LWT condition checks) — {} for a known-absent row;
+    None means unknown, and the old values are read here."""
     idxs = table_indexes(table)
     old_values = {}
     if idxs:
-        proj = [i.column for i in idxs]
-        old = txn.read_row(table, op.doc_key, projection=proj)
-        if old is not None:
-            d = old.to_dict(table.schema)
-            old_values = {i.column: d.get(i.column) for i in idxs}
+        if old_row_dict is not None:
+            old_values = {i.column: old_row_dict.get(i.column)
+                          for i in idxs}
+        else:
+            proj = [i.column for i in idxs]
+            old = txn.read_row(table, op.doc_key, projection=proj)
+            if old is not None:
+                d = old.to_dict(table.schema)
+                old_values = {i.column: d.get(i.column) for i in idxs}
     txn.write(table, [op])
     for idx in idxs:
         for mop in maintenance_ops(idx, op, old_values.get(idx.column)):

@@ -352,33 +352,53 @@ class RedisServer:
 
     def cmd_getrange(self, args):
         v = self._get(args[0])
-        if v is None:
+        if not v:
             return resp.bulk(b"")
         start, end = int(args[1]), int(args[2])
+        # redis clamps both indexes into [0, len-1] after negative
+        # adjustment; an inverted range is empty
         if start < 0:
             start = max(0, len(v) + start)
-        end = len(v) + end if end < 0 else end
+        if end < 0:
+            end = max(0, len(v) + end)
+        end = min(end, len(v) - 1)
+        if start > end:
+            return resp.bulk(b"")
         return resp.bulk(v[start:end + 1])
 
     def cmd_setrange(self, args):
         offset, patch = int(args[1]), args[2]
-        v = self._get(args[0])
-        if not patch:
-            # empty patch never creates a key (redis SETRANGE semantics)
-            return resp.integer(0 if v is None else len(v))
-        v = v or b""
-        if len(v) < offset:
-            v = v + b"\x00" * (offset - len(v))
-        new = v[:offset] + patch + v[offset + len(patch):]
-        self._set(args[0], new)
-        return resp.integer(len(new))
+        if offset < 0:
+            return resp.error("offset is out of range")
+
+        def body(txn):
+            row = txn.read_row(self._strings, self._str_key(args[0]))
+            v = None if row is None else row.columns.get(self._val_str)
+            if not patch:
+                # empty patch never creates a key (redis semantics)
+                return resp.integer(0 if v is None else len(v))
+            v = v or b""
+            if len(v) < offset:
+                v = v + b"\x00" * (offset - len(v))
+            new = v[:offset] + patch + v[offset + len(patch):]
+            txn.write(self._strings, [QLWriteOp(
+                WriteOpKind.INSERT, self._str_key(args[0]),
+                {"value": new})])
+            return resp.integer(len(new))
+        return self._txn_rmw(body, "SETRANGE")
 
     def cmd_persist(self, args):
-        v = self._get(args[0])
-        if v is None:
-            return resp.integer(0)
-        self._set(args[0], v)  # rewrite without TTL control field
-        return resp.integer(1)
+        def body(txn):
+            row = txn.read_row(self._strings, self._str_key(args[0]))
+            v = None if row is None else row.columns.get(self._val_str)
+            if v is None:
+                return resp.integer(0)
+            # rewrite without TTL control field, atomically vs SET races
+            txn.write(self._strings, [QLWriteOp(
+                WriteOpKind.INSERT, self._str_key(args[0]),
+                {"value": v})])
+            return resp.integer(1)
+        return self._txn_rmw(body, "PERSIST")
 
     def cmd_type(self, args):
         if self._get(args[0]) is not None:
@@ -387,13 +407,28 @@ class RedisServer:
             return resp.simple("hash")
         return resp.simple("none")
 
+    def _txn_hash_fields(self, txn, key: bytes):
+        """(field, value) pairs of a hash read THROUGH the transaction:
+        the discovery scan is snapshot-only, so each found field is
+        re-read via txn.read_row to lay a read intent — a concurrent
+        write to any copied field conflicts and retries the txn. Fields
+        ADDED concurrently with the scan can still be missed (no range
+        read intents at this layer); the reference closes that with
+        weak-read intents on the whole hash bucket."""
+        out = []
+        for f, _v in list(self._hash_fields(key)):
+            row = txn.read_row(self._hashes, self._hash_key(key, f))
+            if row is not None:
+                out.append((f, row.columns.get(self._val_hash)))
+        return out
+
     def _clear_key(self, txn, key: bytes) -> None:
         """Remove every representation of `key` (string row + hash
         fields) inside txn — RENAME fully replaces the destination."""
         if txn.read_row(self._strings, self._str_key(key)) is not None:
             txn.write(self._strings, [QLWriteOp(
                 WriteOpKind.DELETE_ROW, self._str_key(key))])
-        for f, _v in list(self._hash_fields(key)):
+        for f, _v in self._txn_hash_fields(txn, key):
             txn.write(self._hashes, [QLWriteOp(
                 WriteOpKind.DELETE_ROW, self._hash_key(key, f))])
 
@@ -403,7 +438,8 @@ class RedisServer:
         def body(txn):
             row = txn.read_row(self._strings, self._str_key(src))
             v = None if row is None else row.columns.get(self._val_str)
-            fields = [] if v is not None else list(self._hash_fields(src))
+            # a key can carry BOTH representations; move them together
+            fields = self._txn_hash_fields(txn, src)
             if v is None and not fields:
                 return resp.error("no such key")
             if src == dst:
@@ -415,7 +451,7 @@ class RedisServer:
                               {"value": v}),
                     QLWriteOp(WriteOpKind.DELETE_ROW,
                               self._str_key(src))])
-            else:
+            if fields:
                 txn.write(self._hashes, [
                     QLWriteOp(WriteOpKind.INSERT,
                               self._hash_key(dst, f), {"value": val})
