@@ -1098,3 +1098,102 @@ class TestPrepare:
         assert res.columns is not None and res.columns[0][0] == "v"
         conn.query("DEALLOCATE pesel")
         conn.query("DROP TABLE pe")
+
+
+class TestOnConflict:
+    """INSERT ... ON CONFLICT upsert (ref: PG nodeModifyTable.c
+    ExecOnConflictUpdate / DO NOTHING)."""
+
+    @pytest.fixture(autouse=True)
+    def tbl(self, conn):
+        conn.query("CREATE TABLE kv (k INT PRIMARY KEY, v TEXT, n INT)")
+        yield
+        conn.query("DROP TABLE kv")
+
+    def test_do_nothing(self, conn):
+        conn.query("INSERT INTO kv VALUES (1, 'a', 1)")
+        res = conn.query("INSERT INTO kv VALUES (1, 'clobber', 9) "
+                         "ON CONFLICT DO NOTHING")[0]
+        assert res.tag == "INSERT 0 0"
+        assert rows(conn, "SELECT v FROM kv WHERE k = 1") == [("a",)]
+
+    def test_do_update_excluded(self, conn):
+        conn.query("INSERT INTO kv VALUES (1, 'a', 1)")
+        res = conn.query("INSERT INTO kv VALUES (1, 'b', 5) "
+                         "ON CONFLICT (k) DO UPDATE SET v = EXCLUDED.v")[0]
+        assert res.tag == "INSERT 0 1"
+        # v updated from the proposed row; n untouched
+        assert rows(conn, "SELECT v, n FROM kv WHERE k = 1") \
+            == [("b", "1")]
+
+    def test_mixed_insert_and_update(self, conn):
+        conn.query("INSERT INTO kv VALUES (1, 'a', 1)")
+        res = conn.query(
+            "INSERT INTO kv VALUES (1, 'upd', 0), (2, 'new', 0) "
+            "ON CONFLICT (k) DO UPDATE SET v = EXCLUDED.v "
+            "RETURNING k, v")[0]
+        assert res.tag == "INSERT 0 2"
+        assert sorted(tuple(r) for r in res.rows) \
+            == [("1", "upd"), ("2", "new")]
+
+    def test_do_nothing_returning_excludes_conflicts(self, conn):
+        conn.query("INSERT INTO kv VALUES (1, 'a', 1)")
+        res = conn.query("INSERT INTO kv VALUES (1, 'x', 0), (3, 'c', 0) "
+                         "ON CONFLICT DO NOTHING RETURNING k")[0]
+        assert [tuple(r) for r in res.rows] == [("3",)]
+
+    def test_bad_conflict_target(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO kv VALUES (1, 'a', 1) "
+                       "ON CONFLICT (v) DO NOTHING")
+
+    def test_cannot_update_key(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO kv VALUES (1, 'a', 1) "
+                       "ON CONFLICT (k) DO UPDATE SET k = 2")
+
+    def test_upsert_literal_value(self, conn):
+        conn.query("INSERT INTO kv VALUES (7, 'x', 0)")
+        conn.query("INSERT INTO kv VALUES (7, 'ign', 0) "
+                   "ON CONFLICT (k) DO UPDATE SET n = 42")
+        assert rows(conn, "SELECT v, n FROM kv WHERE k = 7") \
+            == [("x", "42")]
+
+    def test_prepared_upsert(self, conn):
+        conn.query("PREPARE ups AS INSERT INTO kv VALUES ($1, $2, 0) "
+                   "ON CONFLICT (k) DO UPDATE SET v = EXCLUDED.v")
+        conn.query("EXECUTE ups (5, 'first')")
+        conn.query("EXECUTE ups (5, 'second')")
+        assert rows(conn, "SELECT v FROM kv WHERE k = 5") == [("second",)]
+        conn.query("DEALLOCATE ups")
+
+    def test_duplicate_key_in_one_upsert_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO kv VALUES (1, 'a', 0), (1, 'b', 0) "
+                       "ON CONFLICT (k) DO UPDATE SET v = EXCLUDED.v")
+        assert rows(conn, "SELECT * FROM kv") == []  # statement rolled back
+
+    def test_excluded_unknown_column_rejected(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("INSERT INTO kv VALUES (1, 'a', 0) "
+                       "ON CONFLICT (k) DO UPDATE SET v = EXCLUDED.vv")
+
+    def test_upsert_nextval_in_set(self, conn):
+        conn.query("CREATE SEQUENCE ocs")
+        conn.query("INSERT INTO kv VALUES (3, 'x', 0)")
+        conn.query("INSERT INTO kv VALUES (3, 'x', 0) "
+                   "ON CONFLICT (k) DO UPDATE SET n = nextval('ocs')")
+        assert rows(conn, "SELECT n FROM kv WHERE k = 3") == [("1",)]
+        conn.query("DROP SEQUENCE ocs")
+
+    def test_upsert_param_in_set_described(self, conn):
+        # param in DO UPDATE SET is counted by ParameterDescription
+        res = conn.extended_query(
+            "INSERT INTO kv VALUES ($1, $2, 0) "
+            "ON CONFLICT (k) DO UPDATE SET n = $3", ["9", "v9", "77"])
+        assert res.tag.startswith("INSERT")
+        res = conn.extended_query(
+            "INSERT INTO kv VALUES ($1, $2, 0) "
+            "ON CONFLICT (k) DO UPDATE SET n = $3", ["9", "zz", "88"])
+        assert rows(conn, "SELECT v, n FROM kv WHERE k = 9") \
+            == [("v9", "88")]

@@ -756,6 +756,9 @@ class PgSession:
             values = {c: v for c, v in bound.items() if c not in key_names}
             ops.append(QLWriteOp(WriteOpKind.INSERT, dk, values))
             written.append(bound)
+        if stmt.on_conflict is not None:
+            return self._insert_on_conflict(stmt, table, ops, written,
+                                            key_names)
         if table.indexes:
             # indexed table: route through a (possibly implicit) transaction
             # maintaining every index (yql/index_maintenance.py)
@@ -781,6 +784,90 @@ class PgSession:
             return self._returning_result(
                 f"INSERT 0 {len(ops)}", table, stmt.returning, written)
         return PgResult(f"INSERT 0 {len(ops)}")
+
+    def _insert_on_conflict(self, stmt: P.Insert, table, ops, written,
+                            key_names) -> PgResult:
+        """INSERT ... ON CONFLICT upsert (ref: PG ExecOnConflictUpdate /
+        ExecOnConflictNothing, nodeModifyTable.c). Conflicts are primary-
+        key conflicts — the only uniqueness constraint this layer
+        enforces; a conflict target, when given, must name the PK. Runs
+        as a read-check-write statement transaction."""
+        schema = table.schema
+        mode, target, assigns = stmt.on_conflict
+        if target is not None and set(target) != set(key_names):
+            raise PgError(Status.InvalidArgument(
+                "there is no unique or exclusion constraint matching "
+                "the ON CONFLICT specification"), "42P10")
+        for c, v in assigns:
+            if c in key_names:
+                raise PgError(Status.NotSupported(
+                    f"ON CONFLICT DO UPDATE cannot modify key "
+                    f"column {c}"), "0A000")
+            if not self._has_column(schema, c):
+                raise PgError(Status.InvalidArgument(
+                    f'column "{c}" does not exist'), "42703")
+            if isinstance(v, tuple) and len(v) == 2 \
+                    and v[0] == "__excluded__" \
+                    and not self._has_column(schema, v[1]):
+                raise PgError(Status.InvalidArgument(
+                    f'column excluded.{v[1]} does not exist'), "42703")
+
+        def body(txn):
+            n = 0
+            touched = []
+            seen_keys = set()
+            for op, bound in zip(ops, written):
+                enc = op.doc_key.encode()
+                existing = txn.read_row(table, op.doc_key)
+                if existing is None:
+                    IM.txn_write_with_indexes(txn, table, op,
+                                              self._table,
+                                              old_row_dict={})
+                    n += 1
+                    touched.append(bound)
+                    seen_keys.add(enc)
+                    continue
+                if mode == "nothing":
+                    continue
+                if enc in seen_keys:
+                    # PG: one statement may not affect a row twice
+                    raise PgError(Status.InvalidArgument(
+                        "ON CONFLICT DO UPDATE command cannot affect "
+                        "row a second time"), "21000")
+                seen_keys.add(enc)
+                d = existing.to_dict(schema)
+                values = {}
+                for c, v in assigns:
+                    if isinstance(v, tuple) and len(v) == 2 \
+                            and v[0] == "__excluded__":
+                        v = bound.get(v[1])
+                    elif isinstance(v, tuple) and len(v) == 2 \
+                            and v[0] == "__nextval__":
+                        v = self._client.sequence_next(self.database,
+                                                       v[1])
+                    values[c] = pg_coerce(schema.column(c).type, v)
+                IM.txn_write_with_indexes(
+                    txn, table,
+                    QLWriteOp(WriteOpKind.UPDATE, op.doc_key, values),
+                    self._table, old_row_dict=d)
+                n += 1
+                touched.append({**d, **values})
+            return n, touched
+
+        n, touched = self._run_statement_txn(body)
+        if stmt.returning:
+            # PG: RETURNING yields only rows actually inserted/updated
+            return self._returning_result(f"INSERT 0 {n}", table,
+                                          stmt.returning, touched)
+        return PgResult(f"INSERT 0 {n}")
+
+    @staticmethod
+    def _has_column(schema, name: str) -> bool:
+        try:
+            schema.column(name)
+            return True
+        except KeyError:
+            return False
 
     # ------------------------------------------------- system virtual tables
     def _virtual_table_rows(self, name: str):

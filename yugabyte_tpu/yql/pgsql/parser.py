@@ -87,6 +87,10 @@ class Insert:
     # RETURNING * | col [, ...] (ref: PG returning_clause, gram.y;
     # executed like PG's ExecProcessReturning over the written rows)
     returning: Optional[List[str]] = None
+    # ON CONFLICT upsert (ref: PG ExecOnConflictUpdate, gram.y
+    # opt_on_conflict): ("nothing"|"update", target_cols_or_None,
+    # [(col, literal | ("__excluded__", col))])
+    on_conflict: Optional[tuple] = None
 
 
 class Param:
@@ -556,7 +560,37 @@ class PgParser(_BaseParser):
             rows.append(row)
             if not self.accept_op(","):
                 break
-        return Insert(name, columns, rows, self._returning())
+        return Insert(name, columns, rows, on_conflict=self._on_conflict(),
+                      returning=self._returning())
+
+    def _on_conflict(self):
+        """[ON CONFLICT [(cols)] DO NOTHING | DO UPDATE SET col =
+        literal | EXCLUDED.col [, ...]] — the upsert clause."""
+        if not self.accept_kw("ON", "CONFLICT"):
+            return None
+        target = None
+        if self.accept_op("("):
+            target = [self.name()]
+            while self.accept_op(","):
+                target.append(self.name())
+            self.expect_op(")")
+        self.expect_kw("DO")
+        if self.accept_kw("NOTHING"):
+            return ("nothing", target, [])
+        self.expect_kw("UPDATE")
+        self.expect_kw("SET")
+        assigns = []
+        while True:
+            col = self.name()
+            self.expect_op("=")
+            if self.accept_kw("EXCLUDED"):
+                self.expect_op(".")
+                assigns.append((col, ("__excluded__", self.name())))
+            else:
+                assigns.append((col, self.literal()))
+            if not self.accept_op(","):
+                break
+        return ("update", target, assigns)
 
     def _returning(self) -> Optional[List[str]]:
         if not self.accept_kw("RETURNING"):
@@ -1122,8 +1156,15 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
         return v
 
     if isinstance(stmt, Insert):
+        oc = stmt.on_conflict
+        if oc is not None and oc[0] == "update":
+            oc = (oc[0], oc[1],
+                  [(c, v if isinstance(v, tuple) and len(v) == 2
+                    and v[0] == "__excluded__" else sub(v))
+                   for c, v in oc[2]])
         return replace(stmt, rows=[[sub(v) for v in row]
-                                   for row in stmt.rows])
+                                   for row in stmt.rows],
+                       on_conflict=oc)
     if isinstance(stmt, UnionSelect):
         ulimit = sub(stmt.limit)
         if ulimit is not None:
@@ -1205,6 +1246,9 @@ def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
         for row in stmt.rows:
             for j, v in enumerate(row):
                 visit(cols[j] if cols and j < len(cols) else ("pos", j), v)
+        if stmt.on_conflict is not None:
+            for c, v in stmt.on_conflict[2]:
+                visit(c, v)
     elif isinstance(stmt, UnionSelect):
         for s in stmt.selects:
             out.extend(collect_param_columns(s))
