@@ -1197,3 +1197,101 @@ class TestOnConflict:
             "ON CONFLICT (k) DO UPDATE SET n = $3", ["9", "zz", "88"])
         assert rows(conn, "SELECT v, n FROM kv WHERE k = 9") \
             == [("v9", "88")]
+
+
+class TestViews:
+    """CREATE [OR REPLACE] VIEW / DROP VIEW — master-backed defining
+    SELECT text, expanded at query time (ref: PG DefineView +
+    rewriter expansion; view defs persist in the sys catalog)."""
+
+    @pytest.fixture(autouse=True)
+    def data(self, conn):
+        conn.query("CREATE TABLE emp (id INT PRIMARY KEY, dept TEXT, "
+                   "sal INT)")
+        conn.query("INSERT INTO emp VALUES (1,'eng',100), (2,'eng',200), "
+                   "(3,'ops',50)")
+        yield
+        conn.query("DROP TABLE emp")
+
+    def test_view_roundtrip(self, conn):
+        conn.query("CREATE VIEW eng AS SELECT id, sal FROM emp "
+                   "WHERE dept = 'eng'")
+        assert rows(conn, "SELECT id FROM eng WHERE sal > 150") \
+            == [("2",)]
+        assert rows(conn, "SELECT sum(sal) FROM eng") == [("300",)]
+        conn.query("DROP VIEW eng")
+        with pytest.raises(PgWireError):
+            conn.query("SELECT * FROM eng")
+
+    def test_or_replace(self, conn):
+        conn.query("CREATE VIEW v1 AS SELECT id FROM emp")
+        with pytest.raises(PgWireError):
+            conn.query("CREATE VIEW v1 AS SELECT sal FROM emp")
+        conn.query("CREATE OR REPLACE VIEW v1 AS SELECT sal FROM emp "
+                   "WHERE sal < 60")
+        assert rows(conn, "SELECT * FROM v1") == [("50",)]
+        conn.query("DROP VIEW v1")
+
+    def test_stacked_views(self, conn):
+        conn.query("CREATE VIEW a1 AS SELECT id, sal FROM emp "
+                   "WHERE dept = 'eng'")
+        conn.query("CREATE VIEW a2 AS SELECT id FROM a1 WHERE sal > 150")
+        assert rows(conn, "SELECT * FROM a2") == [("2",)]
+        conn.query("DROP VIEW a2")
+        conn.query("DROP VIEW a1")
+
+    def test_view_cannot_shadow_table(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("CREATE VIEW emp AS SELECT id FROM emp")
+
+    def test_drop_view_if_exists(self, conn):
+        with pytest.raises(PgWireError):
+            conn.query("DROP VIEW never_was")
+        conn.query("DROP VIEW IF EXISTS never_was")
+
+    def test_view_visible_across_sessions(self, conn, cluster):
+        conn.query("CREATE VIEW shared AS SELECT id FROM emp "
+                   "WHERE dept = 'ops'")
+        import os, sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from pg_wire_client import PgWireClient
+        from yugabyte_tpu.yql.pgsql.server import PgServer
+        srv2 = PgServer(cluster.new_client())
+        c2 = PgWireClient("127.0.0.1", srv2.port)
+        try:
+            assert [tuple(r) for r in
+                    c2.query("SELECT * FROM shared")[0].rows] == [("3",)]
+        finally:
+            c2.close()
+            srv2.shutdown()
+        conn.query("DROP VIEW shared")
+
+    def test_create_table_cannot_shadow_view(self, conn):
+        conn.query("CREATE VIEW vshadow AS SELECT id FROM emp")
+        with pytest.raises(PgWireError):
+            conn.query("CREATE TABLE vshadow (x INT PRIMARY KEY)")
+        conn.query("DROP VIEW vshadow")
+
+
+class TestUpsertExpressions:
+    """ON CONFLICT DO UPDATE SET col = <expression over the existing
+    row> — the counter-upsert idiom (ref: PG ExecOnConflictUpdate
+    evaluates the SET list against the existing tuple)."""
+
+    def test_counter_upsert(self, conn):
+        conn.query("CREATE TABLE hits (page TEXT PRIMARY KEY, n INT)")
+        for _ in range(3):
+            conn.query("INSERT INTO hits VALUES ('home', 1) "
+                       "ON CONFLICT (page) DO UPDATE SET n = n + 1")
+        assert rows(conn, "SELECT n FROM hits") == [("3",)]
+        conn.query("DROP TABLE hits")
+
+    def test_expr_upsert_with_params(self, conn):
+        conn.query("CREATE TABLE acc2 (k INT PRIMARY KEY, bal INT)")
+        conn.query("PREPARE dep AS INSERT INTO acc2 VALUES ($1, $2) "
+                   "ON CONFLICT (k) DO UPDATE SET bal = bal + $2")
+        conn.query("EXECUTE dep (1, 100)")
+        conn.query("EXECUTE dep (1, 50)")
+        assert rows(conn, "SELECT bal FROM acc2") == [("150",)]
+        conn.query("DEALLOCATE dep")
+        conn.query("DROP TABLE acc2")

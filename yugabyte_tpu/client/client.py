@@ -153,6 +153,38 @@ class YBClient:
         self._master_call("drop_sequence", namespace=namespace, name=name,
                           if_exists=if_exists)
 
+    def create_view(self, namespace: str, name: str, sql: str,
+                    or_replace: bool = False) -> None:
+        ctx: Dict[str, bool] = {}
+        try:
+            self._master_call("create_view", _retry_ctx=ctx,
+                              namespace=namespace, name=name, sql=sql,
+                              or_replace=or_replace)
+        except RemoteError as e:
+            # our own timed-out first attempt may have applied
+            if not (e.status.code == Code.ALREADY_PRESENT
+                    and ctx.get("maybe_applied")):
+                raise
+
+    def drop_view(self, namespace: str, name: str,
+                  if_exists: bool = False) -> None:
+        ctx: Dict[str, bool] = {}
+        try:
+            self._master_call("drop_view", _retry_ctx=ctx,
+                              namespace=namespace, name=name,
+                              if_exists=if_exists)
+        except RemoteError as e:
+            if not (e.status.code == Code.NOT_FOUND
+                    and ctx.get("maybe_applied")):
+                raise
+
+    def get_view(self, namespace: str, name: str):
+        return self._master_call("get_view", namespace=namespace,
+                                 name=name)
+
+    def list_views(self, namespace: str):
+        return self._master_call("list_views", namespace=namespace)
+
     def sequence_next(self, namespace: str, name: str,
                       cache: int = 1) -> int:
         # NOT idempotent-retried through _retry_ctx: a duplicate allocate

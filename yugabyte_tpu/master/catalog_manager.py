@@ -116,6 +116,7 @@ class CatalogManager:
         self.tables: Dict[str, dict] = {}
         self.tablets: Dict[str, dict] = {}
         self.sequences: Dict[str, dict] = {}  # "ns.name" -> {next, ...}
+        self.views: Dict[str, dict] = {}      # "ns.name" -> {sql, ...}
         # volatile: tablet_id -> (leader server_id, term); replica acks
         self.tablet_leaders: Dict[str, Tuple[str, int]] = {}
         self._confirmed: Set[Tuple[str, str]] = set()  # (tablet_id, server)
@@ -142,6 +143,7 @@ class CatalogManager:
             tables: Dict[str, dict] = {}
             tablets: Dict[str, dict] = {}
             sequences: Dict[str, dict] = {}
+            views: Dict[str, dict] = {}
             for etype, eid, meta in self.sys.scan_all():
                 if etype == "namespace":
                     namespaces[eid] = meta
@@ -151,10 +153,13 @@ class CatalogManager:
                     tablets[eid] = meta
                 elif etype == "sequence":
                     sequences[eid] = meta
+                elif etype == "view":
+                    views[eid] = meta
             self.namespaces = namespaces
             self.tables = tables
             self.tablets = tablets
             self.sequences = sequences
+            self.views = views
             self._confirmed.clear()
             self._replication_cache = None
             self._loaded_term = term
@@ -224,6 +229,46 @@ class CatalogManager:
             self.sequences[key] = meta
             return val
 
+    # --------------------------------------------------------------- views
+    # PG views stored as the defining SELECT text in the sys catalog
+    # (ref: PG pg_rewrite / DefineView; YSQL keeps view defs in the
+    # postgres catalog replicated through the master's sys catalog).
+    def create_view(self, namespace: str, name: str, sql: str,
+                    or_replace: bool = False) -> None:
+        key = f"{namespace}.{name}"
+        with self._lock:
+            if key in self.views and not or_replace:
+                raise StatusError(Status.AlreadyPresent(
+                    f"view {name!r} exists"))
+            if self._find_table(namespace, name) is not None:
+                raise StatusError(Status.AlreadyPresent(
+                    f"{name!r} is a table"))
+            meta = {"namespace": namespace, "name": name, "sql": sql}
+            self.sys.upsert("view", key, meta)
+            self.views[key] = meta
+
+    def drop_view(self, namespace: str, name: str,
+                  if_exists: bool = False) -> None:
+        key = f"{namespace}.{name}"
+        with self._lock:
+            if key not in self.views:
+                if if_exists:
+                    return
+                raise StatusError(Status.NotFound(
+                    f"view {name!r} does not exist"))
+            self.sys.delete("view", key)
+            del self.views[key]
+
+    def get_view(self, namespace: str, name: str) -> Optional[str]:
+        with self._lock:
+            meta = self.views.get(f"{namespace}.{name}")
+            return None if meta is None else meta["sql"]
+
+    def list_views(self, namespace: str) -> List[str]:
+        with self._lock:
+            return sorted(m["name"] for m in self.views.values()
+                          if m["namespace"] == namespace)
+
     def _find_table(self, namespace: str, name: str) -> Optional[str]:
         for tid, t in self.tables.items():
             if t["namespace"] == namespace and t["name"] == name:
@@ -238,6 +283,9 @@ class CatalogManager:
             if namespace not in self.namespaces:
                 raise StatusError(Status.NotFound(
                     f"namespace {namespace!r} not found"))
+            if f"{namespace}.{name}" in self.views:
+                raise StatusError(Status.AlreadyPresent(
+                    f"{name!r} is a view"))
             if self._find_table(namespace, name) is not None:
                 raise StatusError(Status.AlreadyPresent(
                     f"table {namespace}.{name} exists"))

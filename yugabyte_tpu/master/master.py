@@ -83,6 +83,23 @@ class MasterService:
                       cache: int = 1) -> int:
         return self._leader_catalog().sequence_next(namespace, name, cache)
 
+    def create_view(self, namespace: str, name: str, sql: str,
+                    or_replace: bool = False) -> bool:
+        self._leader_catalog().create_view(namespace, name, sql,
+                                           or_replace)
+        return True
+
+    def drop_view(self, namespace: str, name: str,
+                  if_exists: bool = False) -> bool:
+        self._leader_catalog().drop_view(namespace, name, if_exists)
+        return True
+
+    def get_view(self, namespace: str, name: str):
+        return self._leader_catalog().get_view(namespace, name)
+
+    def list_views(self, namespace: str):
+        return self._leader_catalog().list_views(namespace)
+
     def create_table(self, namespace: str, name: str, schema: dict,
                      partition_schema: dict, num_tablets: int,
                      replication_factor: Optional[int] = None) -> dict:

@@ -225,6 +225,10 @@ class PgSession:
         # session-scoped, separate from the wire protocol's named
         # statements
         self._prepared: Dict[str, object] = {}
+        self._view_depth = 0  # stacked-view recursion guard
+        # per-statement view materialization memo (cleared at each
+        # top-level execute entry)
+        self._view_memo: Dict[str, tuple] = {}
         # PG connects to an EXISTING database; only the default one is
         # auto-created (the initdb role). Unknown names fail with 3D000
         # instead of silently materializing a typo'd namespace.
@@ -256,6 +260,7 @@ class PgSession:
             raise _pg_error(e) from e
         out = []
         for stmt in stmts:
+            self._view_memo.clear()  # each statement runs views afresh
             if self.txn_failed and not (
                     isinstance(stmt, P.TxnControl)
                     and stmt.kind in ("commit", "rollback")):
@@ -298,6 +303,7 @@ class PgSession:
         PgResult with row_iter instead of rows, so Execute row limits pull
         incrementally and a suspended portal holds no materialized
         result."""
+        self._view_memo.clear()  # each statement runs views afresh
         bound = P.bind_params(stmt, params)
         if self.txn_failed and not (
                 isinstance(bound, P.TxnControl)
@@ -467,6 +473,22 @@ class PgSession:
             return self._explain(stmt)
         if isinstance(stmt, P.Truncate):
             return self._truncate(stmt)
+        if isinstance(stmt, P.CreateView):
+            # defining SELECT already validated by the parser; a view may
+            # not shadow an existing table (the catalog checks too)
+            try:
+                self._client.create_view(self.database, stmt.name,
+                                         stmt.sql, stmt.or_replace)
+            except StatusError as e:
+                raise _pg_error(e) from e
+            return PgResult("CREATE VIEW")
+        if isinstance(stmt, P.DropView):
+            try:
+                self._client.drop_view(self.database, stmt.name,
+                                       stmt.if_exists)
+            except StatusError as e:
+                raise _pg_error(e) from e
+            return PgResult("DROP VIEW")
         if isinstance(stmt, P.PrepareStmt):
             if stmt.name in self._prepared:
                 raise PgError(Status.AlreadyPresent(
@@ -641,6 +663,53 @@ class PgSession:
         self._tables.pop(stmt.table, None)  # refresh the index list
         return PgResult("CREATE INDEX")
 
+    def _view_rows(self, name: str):
+        """Resolve `name` as a view: run its stored defining SELECT and
+        surface the result like a virtual table, so the outer SELECT's
+        WHERE / ORDER BY / aggregates / LIMIT compose on top (ref: PG
+        expands views through the rewriter; here the view body executes
+        and the outer query filters). Views are NOT resolvable as JOIN
+        operands (the join planner binds base tables only).
+        Returns (columns [(name, oid)], row dicts) or None."""
+        # base tables shadow nothing: only consult the view catalog when
+        # the name is not a table (table handles are cached, so the
+        # common path stays RPC-free)
+        try:
+            self._table(name)
+            return None
+        except (PgError, StatusError):
+            pass
+        try:
+            sql = self._client.get_view(self.database, name)
+        except StatusError:
+            return None
+        if sql is None:
+            return None
+        cached = self._view_memo.get(name)
+        if cached is not None:
+            return cached
+        if self._view_depth >= 8:
+            raise PgError(Status.InvalidArgument(
+                f'infinite recursion detected in view "{name}"'),
+                "42P17")
+        from yugabyte_tpu.yql.pgsql.parser import PgParser
+        inner = PgParser(sql).parse_one()
+        self._view_depth += 1
+        try:
+            res = self._select(inner)
+        finally:
+            self._view_depth -= 1
+        rows = res.rows if res.row_iter is None else list(res.row_iter)
+        names = [n for n, _o in (res.columns or [])]
+        out = (list(res.columns or []),
+               [dict(zip(names, r)) for r in rows])
+        # memoized for the remainder of THIS statement only: the
+        # stream-check, plan and execution paths all consult
+        # _virtual_table_rows, and the view body must run once per
+        # statement (volatile functions, cost)
+        self._view_memo[name] = out
+        return out
+
     def _table(self, name: str) -> YBTable:
         """TTL'd table-handle cache: index DDL from other sessions becomes
         visible within the schema-propagation window (see
@@ -811,6 +880,11 @@ class PgSession:
                     and not self._has_column(schema, v[1]):
                 raise PgError(Status.InvalidArgument(
                     f'column excluded.{v[1]} does not exist'), "42703")
+        # SET col = <expression over the EXISTING row> compiles once
+        expr_fns = {c: self._compile_row_expr(v[1], schema)[1]
+                    for c, v in assigns
+                    if isinstance(v, tuple) and len(v) == 2
+                    and v[0] == "__expr__"}
 
         def body(txn):
             n = 0
@@ -841,6 +915,9 @@ class PgSession:
                     if isinstance(v, tuple) and len(v) == 2 \
                             and v[0] == "__excluded__":
                         v = bound.get(v[1])
+                    elif isinstance(v, tuple) and len(v) == 2 \
+                            and v[0] == "__expr__":
+                        v = expr_fns[c](d)
                     elif isinstance(v, tuple) and len(v) == 2 \
                             and v[0] == "__nextval__":
                         v = self._client.sequence_next(self.database,
@@ -890,7 +967,7 @@ class PgSession:
             return None
         if key not in ("pg_tables", "tables", "pg_class", "pg_namespace",
                        "pg_attribute", "columns", "pg_type", "pg_indexes"):
-            return None
+            return self._view_rows(name)
         tables = self._client.list_tables(self.database)
         if key == "pg_tables":
             cols = [("schemaname", 25), ("tablename", 25),

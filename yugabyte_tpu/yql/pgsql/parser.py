@@ -195,6 +195,21 @@ class DropSequence:
 
 
 @dataclass
+class CreateView:
+    """CREATE [OR REPLACE] VIEW name AS <select> — stored as the
+    defining SELECT text (ref: PG DefineView / pg_rewrite)."""
+    name: str
+    sql: str                           # the SELECT text, re-parsed on use
+    or_replace: bool = False
+
+
+@dataclass
+class DropView:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class PrepareStmt:
     """PREPARE name [(types)] AS <dml> (ref: PG PrepareQuery,
     commands/prepare.c). Parameter types are inferred at bind time."""
@@ -325,6 +340,19 @@ class PgParser(_BaseParser):
         if self.accept_kw("DROP", "TABLE"):
             if_exists = self.accept_kw("IF", "EXISTS")
             return DropTable(self._table_name(), if_exists)
+        or_replace = self.accept_kw("CREATE", "OR", "REPLACE", "VIEW")
+        if or_replace or self.accept_kw("CREATE", "VIEW"):
+            name = self.name()
+            self.expect_kw("AS")
+            start = self.pos
+            inner = self.parse_one()
+            if not isinstance(inner, (Select, UnionSelect)):
+                raise ParseError("CREATE VIEW requires a SELECT")
+            sql = " ".join(t for _k, t in self.toks[start:self.pos])
+            return CreateView(name, sql, or_replace)
+        if self.accept_kw("DROP", "VIEW"):
+            ife = self.accept_kw("IF", "EXISTS")
+            return DropView(self.name(), ife)
         if self.accept_kw("PREPARE"):
             name = self.name()
             if self.accept_op("("):   # declared param types: ignored
@@ -582,12 +610,18 @@ class PgParser(_BaseParser):
         assigns = []
         while True:
             col = self.name()
-            self.expect_op("=")
-            if self.accept_kw("EXCLUDED"):
+            nxt2 = self.toks[self.pos + 1] \
+                if self.pos + 1 < len(self.toks) else None
+            if nxt2 is not None and nxt2[0] == "name" \
+                    and nxt2[1].upper() == "EXCLUDED":
+                self.expect_op("=")
+                self.expect_kw("EXCLUDED")
                 self.expect_op(".")
                 assigns.append((col, ("__excluded__", self.name())))
             else:
-                assigns.append((col, self.literal()))
+                # literal or a row expression over the EXISTING row
+                # (rides UPDATE's _assigned_value machinery)
+                assigns.append((col, self._assigned_value()))
             if not self.accept_op(","):
                 break
         return ("update", target, assigns)
@@ -1158,10 +1192,14 @@ def bind_params(stmt: Statement, params: List[object]) -> Statement:
     if isinstance(stmt, Insert):
         oc = stmt.on_conflict
         if oc is not None and oc[0] == "update":
-            oc = (oc[0], oc[1],
-                  [(c, v if isinstance(v, tuple) and len(v) == 2
-                    and v[0] == "__excluded__" else sub(v))
-                   for c, v in oc[2]])
+            def sub_oc(v):
+                if isinstance(v, tuple) and len(v) == 2:
+                    if v[0] == "__excluded__":
+                        return v
+                    if v[0] == "__expr__":
+                        return ("__expr__", _sub_expr_node(v[1], sub))
+                return sub(v)
+            oc = (oc[0], oc[1], [(c, sub_oc(v)) for c, v in oc[2]])
         return replace(stmt, rows=[[sub(v) for v in row]
                                    for row in stmt.rows],
                        on_conflict=oc)
@@ -1247,8 +1285,22 @@ def collect_param_columns(stmt: Statement) -> List[Tuple[int, object]]:
             for j, v in enumerate(row):
                 visit(cols[j] if cols and j < len(cols) else ("pos", j), v)
         if stmt.on_conflict is not None:
+            def visit_oc_expr(node, col):
+                if node[0] == "lit":
+                    visit(col, node[1])
+                elif node[0] == "func":
+                    for a in node[2]:
+                        visit_oc_expr(a, "__expr__")
+                elif node[0] == "op":
+                    visit_oc_expr(node[2], col)
+                    visit_oc_expr(node[3], col)
             for c, v in stmt.on_conflict[2]:
-                visit(c, v)
+                if isinstance(v, tuple) and len(v) == 2 \
+                        and v[0] == "__expr__":
+                    visit_oc_expr(v[1], c)
+                elif not (isinstance(v, tuple) and len(v) == 2
+                          and v[0] == "__excluded__"):
+                    visit(c, v)
     elif isinstance(stmt, UnionSelect):
         for s in stmt.selects:
             out.extend(collect_param_columns(s))
