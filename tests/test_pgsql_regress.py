@@ -1295,3 +1295,11 @@ class TestUpsertExpressions:
         assert rows(conn, "SELECT bal FROM acc2") == [("150",)]
         conn.query("DEALLOCATE dep")
         conn.query("DROP TABLE acc2")
+
+    def test_pg_views_catalog(self, conn):
+        conn.query("CREATE VIEW vcat AS SELECT id FROM emp")
+        got = rows(conn, "SELECT viewname, definition FROM pg_views")
+        assert ("vcat", "SELECT id FROM emp") in [tuple(r) for r in got]
+        conn.query("DROP VIEW vcat")
+        assert rows(conn, "SELECT viewname FROM pg_views "
+                    "WHERE viewname = 'vcat'") == []

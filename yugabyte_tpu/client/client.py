@@ -229,7 +229,7 @@ class YBClient:
         return YBTable(meta)
 
     def create_index(self, namespace: str, table: str, index_name: str,
-                     column: str, num_tablets: int = 2,
+                     column, num_tablets: int = 2,
                      timeout_s: float = 600.0) -> dict:
         """Create a secondary index and run its online backfill; returns
         the IndexInfo wire dict with state 'readable' on success.
@@ -238,6 +238,9 @@ class YBClient:
         timeout; an AlreadyPresent after our own timed-out attempt means
         the first send is still building — poll the table meta for the
         index to turn readable instead of failing."""
+        # normalize the public entry point once: downstream layers (master
+        # catalog, tserver backfill) always see a list of column names
+        column = [column] if isinstance(column, str) else list(column)
         from yugabyte_tpu.common.index import STATE_READABLE
         ctx: Dict[str, bool] = {}
         try:

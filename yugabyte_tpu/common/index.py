@@ -40,19 +40,34 @@ STATE_READABLE = "readable"
 
 @dataclass
 class IndexInfo:
+    """columns: the indexed columns in declaration order — the first
+    hash-partitions the index table, the rest are leading range
+    components (ref: common/index.h IndexInfo hash+range columns)."""
     index_name: str
     index_table_id: str
-    column: str
+    columns: Tuple[str, ...]
     state: str = STATE_BACKFILLING
+
+    def __post_init__(self):
+        if isinstance(self.columns, str):   # legacy single-column form
+            self.columns = (self.columns,)
+        else:
+            self.columns = tuple(self.columns)
+
+    @property
+    def column(self) -> str:
+        return self.columns[0]
 
     def to_wire(self) -> dict:
         return {"index_name": self.index_name,
                 "index_table_id": self.index_table_id,
-                "column": self.column, "state": self.state}
+                "column": self.columns[0],
+                "columns": list(self.columns), "state": self.state}
 
     @staticmethod
     def from_wire(w: dict) -> "IndexInfo":
-        return IndexInfo(w["index_name"], w["index_table_id"], w["column"],
+        cols = tuple(w.get("columns") or (w["column"],))
+        return IndexInfo(w["index_name"], w["index_table_id"], cols,
                          w.get("state", STATE_BACKFILLING))
 
 
@@ -60,32 +75,52 @@ def indexes_from_meta(table_meta: dict) -> List[IndexInfo]:
     return [IndexInfo.from_wire(w) for w in table_meta.get("indexes", [])]
 
 
-def index_table_schema(main_schema: Schema, column: str) -> Schema:
-    """Schema of the index table: indexed column hashes, main PK ranges."""
-    col = main_schema.column(column)
+def index_table_schema(main_schema: Schema, columns) -> Schema:
+    """Schema of the index table: first indexed column hashes, remaining
+    indexed columns are leading range components, then the main PK."""
+    if isinstance(columns, str):
+        columns = (columns,)
+    if len(set(columns)) != len(columns):
+        raise ValueError("duplicate column in index")
     key_cols = (main_schema.hash_columns + main_schema.range_columns)
-    if column in {c.name for c in key_cols}:
-        raise ValueError(f"column {column!r} is already a key column")
-    columns = [ColumnSchema(col.name, col.type, nullable=False)]
+    key_names = {c.name for c in key_cols}
+    out = []
+    for name in columns:
+        col = main_schema.column(name)
+        if name in key_names:
+            # the main PK already rides every index entry — indexing a
+            # key column is redundant, and INSERT ops carry key values
+            # in the doc key (not op.values), which maintenance reads
+            raise ValueError(f"column {name!r} is already a key column")
+        out.append(ColumnSchema(col.name, col.type, nullable=False))
     for kc in key_cols:
-        columns.append(ColumnSchema(f"pk_{kc.name}", kc.type,
-                                    nullable=False))
-    return Schema(columns=columns, num_hash_key_columns=1,
-                  num_range_key_columns=len(key_cols))
+        out.append(ColumnSchema(f"pk_{kc.name}", kc.type,
+                                nullable=False))
+    return Schema(columns=out, num_hash_key_columns=1,
+                  num_range_key_columns=len(columns) - 1 + len(key_cols))
 
 
-def index_doc_key(value, main_doc_key: DocKey) -> DocKey:
-    """Index entry key: (indexed value) -> (main table primary key)."""
+def index_doc_key(values, main_doc_key: DocKey) -> DocKey:
+    """Index entry key: (indexed values) -> (main table primary key).
+    `values` is the tuple over the index's columns (a bare scalar is the
+    single-column form)."""
+    if not isinstance(values, tuple):
+        values = (values,)
     return DocKey(
-        hash_components=(value,),
-        range_components=tuple(main_doc_key.hash_components)
+        hash_components=(values[0],),
+        range_components=tuple(values[1:])
+        + tuple(main_doc_key.hash_components)
         + tuple(main_doc_key.range_components))
 
 
 def main_doc_key_from_index_row(row_dict: dict, main_schema: Schema,
                                 index_schema: Schema) -> DocKey:
-    """Recover the main-table DocKey from a decoded index row."""
-    vals = [row_dict[c.name] for c in index_schema.range_columns]
+    """Recover the main-table DocKey from a decoded index row: the main
+    PK rides the TRAILING pk_-prefixed range components (any leading
+    range components are extra indexed columns)."""
+    n_pk = main_schema.num_key_columns
+    pk_cols = index_schema.range_columns[-n_pk:]
+    vals = [row_dict[c.name] for c in pk_cols]
     nh = main_schema.num_hash_key_columns
     return DocKey(hash_components=tuple(vals[:nh]),
                   range_components=tuple(vals[nh:]))
@@ -102,30 +137,43 @@ def index_delete_op(value, main_doc_key: DocKey) -> QLWriteOp:
                      index_doc_key(value, main_doc_key))
 
 
-def maintenance_ops(index: IndexInfo, op: QLWriteOp, old_value
+def maintenance_ops(index: IndexInfo, op: QLWriteOp, old_vals: dict
                     ) -> List[QLWriteOp]:
     """Index writes implied by one main-table DML op.
 
-    old_value: the row's current indexed value (None if absent) — the
-    caller reads it inside the statement transaction (read-modify-write,
-    ref pg_dml_write.cc building delete+insert index requests).
-    """
+    old_vals: the row's current values for the index's columns ({} /
+    None-valued when absent) — the caller reads them inside the statement
+    transaction (read-modify-write, ref pg_dml_write.cc building
+    delete+insert index requests). An index entry exists iff the hash
+    (first) indexed value is non-null."""
+    old_vals = old_vals or {}
+    cols = index.columns
+    old_t = tuple(old_vals.get(c) for c in cols)
+    has_old = old_t[0] is not None
     out: List[QLWriteOp] = []
     if op.kind in (WriteOpKind.INSERT, WriteOpKind.UPDATE):
-        touches = index.column in op.values
-        if not touches:
+        if not any(c in op.values for c in cols):
             return out
-        new_value = op.values.get(index.column)
-        if old_value == new_value:
+        # columns the op does not touch keep their current value
+        new_t = tuple(op.values.get(c, old_vals.get(c)) for c in cols)
+        if old_t == new_t:
             return out
-        if old_value is not None:
-            out.append(index_delete_op(old_value, op.doc_key))
-        if new_value is not None:
-            out.append(index_insert_op(new_value, op.doc_key))
+        if has_old:
+            out.append(index_delete_op(old_t, op.doc_key))
+        if new_t[0] is not None:
+            out.append(index_insert_op(new_t, op.doc_key))
     elif op.kind == WriteOpKind.DELETE_ROW:
-        if old_value is not None:
-            out.append(index_delete_op(old_value, op.doc_key))
+        if has_old:
+            out.append(index_delete_op(old_t, op.doc_key))
     elif op.kind == WriteOpKind.DELETE_COLS:
-        if index.column in op.columns_to_delete and old_value is not None:
-            out.append(index_delete_op(old_value, op.doc_key))
+        if not any(c in op.columns_to_delete for c in cols):
+            return out
+        new_t = tuple(None if c in op.columns_to_delete
+                      else old_vals.get(c) for c in cols)
+        if old_t == new_t:
+            return out
+        if has_old:
+            out.append(index_delete_op(old_t, op.doc_key))
+        if new_t[0] is not None:
+            out.append(index_insert_op(new_t, op.doc_key))
     return out

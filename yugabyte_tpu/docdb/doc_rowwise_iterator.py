@@ -80,7 +80,23 @@ class VisibleEntryRowAssembler:
                  projection: Optional[Sequence[int]] = None):
         self._entries = entries
         self._schema = schema
-        self._projection = set(projection) if projection is not None else None
+        # projection entries are column IDS; column NAMES (what the RPC
+        # layer carries) translate here — ONE place, so the leader read,
+        # follower read and scan paths all agree. Unknown names are
+        # never matched (like projecting a just-dropped column).
+        if projection is not None:
+            ids = set()
+            for c in projection:
+                if isinstance(c, str):
+                    try:
+                        ids.add(schema.column_id(c))
+                    except KeyError:
+                        pass
+                else:
+                    ids.add(c)
+            self._projection = ids
+        else:
+            self._projection = None
         self.next_doc_key: Optional[bytes] = None
 
     def __iter__(self) -> Iterator[Row]:

@@ -264,10 +264,14 @@ class CatalogManager:
             meta = self.views.get(f"{namespace}.{name}")
             return None if meta is None else meta["sql"]
 
-    def list_views(self, namespace: str) -> List[str]:
+    def list_views(self, namespace: str) -> List[dict]:
+        """[{name, sql}] in name order — one call serves catalog queries
+        (pg_views) without per-view lookups."""
         with self._lock:
-            return sorted(m["name"] for m in self.views.values()
-                          if m["namespace"] == namespace)
+            return sorted(({"name": m["name"], "sql": m["sql"]}
+                           for m in self.views.values()
+                           if m["namespace"] == namespace),
+                          key=lambda m: m["name"])
 
     def _find_table(self, namespace: str, name: str) -> Optional[str]:
         for tid, t in self.tables.items():
@@ -432,7 +436,7 @@ class CatalogManager:
 
     # --------------------------------------------------------------- indexes
     def create_index(self, namespace: str, table_name: str, index_name: str,
-                     column: str, num_tablets: int = 2) -> dict:
+                     column, num_tablets: int = 2) -> dict:
         """CREATE INDEX: create the index table, attach IndexInfo to the
         indexed table (write-and-delete mode), wait out the schema
         propagation grace, run the tablet-side backfill, then flip the
@@ -456,15 +460,16 @@ class CatalogManager:
                     raise StatusError(Status.AlreadyPresent(
                         f"index {index_name!r} exists"))
             main_schema = schema_from_wire(table_meta["schema"])
+        columns = [column] if isinstance(column, str) else list(column)
         try:
-            idx_schema = index_table_schema(main_schema, column)
+            idx_schema = index_table_schema(main_schema, columns)
         except (ValueError, KeyError) as e:
             raise StatusError(Status.InvalidArgument(str(e)))
         idx_meta = self.create_table(
             namespace, index_name, schema_to_wire(idx_schema),
             {"hash_partitioning": True}, num_tablets)
-        info = IndexInfo(index_name, idx_meta["table_id"], column,
-                         STATE_BACKFILLING)
+        info = IndexInfo(index_name, idx_meta["table_id"],
+                         tuple(columns), STATE_BACKFILLING)
         self._set_index_state(table_id, info)
         # Schema propagation grace: every writer must observe the index in
         # write mode before the backfill snapshot is taken, or a write
@@ -530,7 +535,8 @@ class CatalogManager:
                             addr, "tserver", "backfill_index_tablet",
                             timeout_s=300.0, tablet_id=tablet_id,
                             namespace=namespace,
-                            index_table=info.index_name, column=info.column)
+                            index_table=info.index_name,
+                            column=list(info.columns))
                         break
                     except StatusError as e:
                         if time.monotonic() > deadline:

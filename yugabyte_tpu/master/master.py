@@ -118,7 +118,7 @@ class MasterService:
             list(drop_columns))
 
     def create_index(self, namespace: str, table: str, index_name: str,
-                     column: str, num_tablets: int = 2) -> dict:
+                     column, num_tablets: int = 2) -> dict:
         return self._leader_catalog().create_index(
             namespace, table, index_name, column, num_tablets)
 

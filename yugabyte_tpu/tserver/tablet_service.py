@@ -282,7 +282,7 @@ class TabletServiceImpl:
 
     # --------------------------------------------------------- index backfill
     def backfill_index_tablet(self, tablet_id: str, namespace: str,
-                              index_table: str, column: str,
+                              index_table: str, column,
                               batch_rows: int = 1024) -> dict:
         """Scan this tablet at a snapshot and write index entries stamped
         at that read time (tablet-side backfill, ref tablet.cc:2088
@@ -297,9 +297,12 @@ class TabletServiceImpl:
                 "tserver has no embedded client for backfill"))
         peer = self._leader_peer(tablet_id)
         schema = peer.tablet.schema
-        if column not in {c.name for c in schema.value_columns}:
-            raise StatusError(Status.InvalidArgument(
-                f"column {column!r} is not a value column"))
+        columns = [column] if isinstance(column, str) else list(column)
+        value_names = {c.name for c in schema.value_columns}
+        for c in columns:
+            if c not in value_names:
+                raise StatusError(Status.InvalidArgument(
+                    f"column {c!r} is not a value column"))
         idx_tbl = client.open_table(namespace, index_table)
         read_ht = peer.tablet.read_time(None)
         n_written = 0
@@ -320,10 +323,10 @@ class TabletServiceImpl:
 
         for row in peer.tablet.scan(read_ht, use_device=False):
             d = row.to_dict(schema)
-            value = d.get(column)
-            if value is None:
-                continue
-            pending.append(index_insert_op(value, row.doc_key,
+            values = tuple(d.get(c) for c in columns)
+            if values[0] is None:
+                continue  # no entry for a null hash value
+            pending.append(index_insert_op(values, row.doc_key,
                                            backfill_ht=read_ht.value))
             if len(pending) >= batch_rows:
                 flush_pending()

@@ -554,10 +554,11 @@ class QLProcessor:
 
     def _create_index(self, stmt: P.CreateIndex) -> ResultSet:
         ks = self._resolve_ks(stmt.keyspace)
-        index_name = stmt.index_name or f"{stmt.table}_{stmt.column}_idx"
+        index_name = stmt.index_name \
+            or f"{stmt.table}_{'_'.join(stmt.columns)}_idx"
         try:
             self._client.create_index(ks, stmt.table, index_name,
-                                      stmt.column)
+                                      list(stmt.columns))
         except StatusError as e:
             if not (stmt.if_not_exists
                     and e.status.code.name == "ALREADY_PRESENT"):

@@ -99,7 +99,7 @@ class CreateIndex:
     index_name: Optional[str]
     keyspace: Optional[str]
     table: str
-    column: str
+    columns: List[str]
     if_not_exists: bool = False
 
 
@@ -387,9 +387,11 @@ class Parser:
             self.expect_kw("ON")
         ks, table = self.qualified_name()
         self.expect_op("(")
-        column = self.name()
+        columns = [self.name()]
+        while self.accept_op(","):
+            columns.append(self.name())
         self.expect_op(")")
-        return CreateIndex(index_name, ks, table, column, ine)
+        return CreateIndex(index_name, ks, table, columns, ine)
 
     def _create_table(self) -> CreateTable:
         ine = self.accept_kw("IF", "NOT", "EXISTS")

@@ -38,20 +38,18 @@ def txn_write_with_indexes(txn: YBTransaction, table: YBTable,
     them in this txn (LWT condition checks) — {} for a known-absent row;
     None means unknown, and the old values are read here."""
     idxs = table_indexes(table)
-    old_values = {}
+    old_values: dict = {}
     if idxs:
         if old_row_dict is not None:
-            old_values = {i.column: old_row_dict.get(i.column)
-                          for i in idxs}
+            old_values = old_row_dict
         else:
-            proj = [i.column for i in idxs]
+            proj = sorted({c for i in idxs for c in i.columns})
             old = txn.read_row(table, op.doc_key, projection=proj)
             if old is not None:
-                d = old.to_dict(table.schema)
-                old_values = {i.column: d.get(i.column) for i in idxs}
+                old_values = old.to_dict(table.schema)
     txn.write(table, [op])
     for idx in idxs:
-        for mop in maintenance_ops(idx, op, old_values.get(idx.column)):
+        for mop in maintenance_ops(idx, op, old_values):
             txn.write(open_table(idx.index_name), [mop])
 
 
@@ -102,41 +100,73 @@ def write_with_indexes(client: YBClient, txn_manager: TransactionManager,
 
 def choose_index(table: YBTable, where: Sequence[Tuple[str, str, object]]
                  ) -> Optional[Tuple[IndexInfo, object, List[Tuple]]]:
-    """Pick a readable index matching an equality predicate.
+    """Pick a readable index matching equality predicates.
 
-    Returns (index, value, residual_filters) or None. Only '=' predicates
-    use the index (the index hash-partitions on the value)."""
-    readable = {i.column: i for i in table_indexes(table)
-                if i.state == STATE_READABLE}
-    for k, (col, op, val) in enumerate(where):
-        if op == "=" and col in readable:
-            residual = [w for j, w in enumerate(where) if j != k]
-            return readable[col], val, residual
-    return None
+    Returns (index, values_tuple, residual_filters) or None — the tuple
+    covers the longest equality-bound PREFIX of the index's columns
+    (which must include the first, hash-partitioning column). The
+    longest usable prefix across candidate indexes wins; unconsumed
+    predicates stay in the residual. Only '=' predicates use the index."""
+    eq = {}
+    for col, op, val in where:
+        if op == "=" and isinstance(col, str) and col not in eq:
+            eq[col] = val
+    best = None
+    for idx in table_indexes(table):
+        if idx.state != STATE_READABLE or idx.columns[0] not in eq:
+            continue
+        prefix = []
+        for c in idx.columns:
+            if c not in eq:
+                break
+            prefix.append(c)
+        if best is None or len(prefix) > len(best[1]):
+            best = (idx, prefix)
+    if best is None:
+        return None
+    idx, prefix = best
+    consumed = set()
+    for c in prefix:
+        for j, (col, op, _v) in enumerate(where):
+            if j not in consumed and op == "=" and col == c:
+                consumed.add(j)
+                break
+    residual = [w for j, w in enumerate(where) if j not in consumed]
+    return idx, tuple(eq[c] for c in prefix), residual
 
 
 def index_lookup(client: YBClient, table: YBTable, index_table: YBTable,
-                 idx: IndexInfo, value, read_ht=None) -> Iterator:
-    """Yield main-table rows whose indexed column equals `value`, via the
-    index: one single-partition scan of the index table, then point reads
-    of the main rows (ref: the reference's index-scan path,
-    pg_select.cc secondary-index request + docdb lookups).
+                 idx: IndexInfo, values, read_ht=None) -> Iterator:
+    """Yield main-table rows whose indexed columns equal `values` (a
+    tuple over an equality-bound prefix of idx.columns; a bare scalar is
+    the single-column form), via the index: one single-partition prefix
+    scan of the index table, then point reads of the main rows (ref: the
+    reference's index-scan path, pg_select.cc secondary-index request +
+    docdb lookups).
 
-    Re-checks the indexed value on the main row: with concurrent writers an
-    index entry can be momentarily stale (the reference re-checks row
+    Re-checks the indexed values on the main row: with concurrent writers
+    an index entry can be momentarily stale (the reference re-checks row
     versions the same way)."""
+    if not isinstance(values, tuple):
+        values = (values,)
     idx_schema = index_table.schema
-    probe = DocKey(hash_components=(value,))
-    prefix = probe.encode()[:-1]  # open the range group
+    probe = DocKey(hash_components=(values[0],),
+                   range_components=tuple(values[1:]))
+    # strip the trailing group-end: entries extend the bound prefix with
+    # further range components (remaining indexed cols + the main PK)
+    prefix = probe.encode()[:-1]
+    hash_probe = DocKey(hash_components=(values[0],))
     rows = client.scan_key_range(
-        index_table, index_table.partition_key_for(probe), prefix,
+        index_table, index_table.partition_key_for(hash_probe), prefix,
         prefix + b"\xff", read_ht=read_ht)
+    cols = idx.columns[:len(values)]
     for irow in rows:
         d = irow.to_dict(idx_schema)
         main_dk = main_doc_key_from_index_row(d, table.schema, idx_schema)
         row = client.read_row(table, main_dk, read_ht=read_ht)
         if row is None:
             continue  # row deleted after the index entry was read
-        if row.to_dict(table.schema).get(idx.column) != value:
-            continue  # stale entry: the row's value moved on
+        rd = row.to_dict(table.schema)
+        if tuple(rd.get(c) for c in cols) != values:
+            continue  # stale entry: the row's values moved on
         yield row

@@ -652,10 +652,11 @@ class PgSession:
         return PgResult("CREATE TABLE")
 
     def _create_index(self, stmt: P.CreateIndex) -> PgResult:
-        index_name = stmt.index_name or f"{stmt.table}_{stmt.column}_idx"
+        index_name = stmt.index_name \
+            or f"{stmt.table}_{'_'.join(stmt.columns)}_idx"
         try:
-            self._client.create_index(self.database, stmt.table, index_name,
-                                      stmt.column)
+            self._client.create_index(self.database, stmt.table,
+                                      index_name, list(stmt.columns))
         except StatusError as e:
             if not (stmt.if_not_exists
                     and e.status.code == Code.ALREADY_PRESENT):
@@ -965,6 +966,13 @@ class PgSession:
             # unqualified: PG search_path does NOT include
             # information_schema — resolve as a user table
             return None
+        if key == "pg_views":
+            cols = [("schemaname", 25), ("viewname", 25),
+                    ("definition", 25)]
+            return cols, [{"schemaname": "public",
+                           "viewname": m["name"],
+                           "definition": m["sql"]}
+                          for m in self._client.list_views(self.database)]
         if key not in ("pg_tables", "tables", "pg_class", "pg_namespace",
                        "pg_attribute", "columns", "pg_type", "pg_indexes"):
             return self._view_rows(name)
@@ -1005,8 +1013,10 @@ class PgSession:
                     rows.append({
                         "schemaname": "public", "tablename": t["name"],
                         "indexname": w["index_name"],
-                        "indexdef": f"CREATE INDEX {w['index_name']} ON "
-                                    f"{t['name']} ({w['column']})"})
+                        "indexdef": "CREATE INDEX %s ON %s (%s)" % (
+                            w["index_name"], t["name"],
+                            ", ".join(w.get("columns")
+                                      or [w["column"]]))})
         else:  # pg_attribute / information_schema columns
             from yugabyte_tpu.common.wire import schema_from_wire
             if key == "pg_attribute":
@@ -1853,9 +1863,11 @@ class PgSession:
                   if self._txn is None else None)
         if picked is not None:
             idx, value, residual = picked
+            vals = value if isinstance(value, tuple) else (value,)
             details = ["Index Cond: "
-                       + self._explain_cond_text([(idx.column, "=",
-                                                   value)])]
+                       + self._explain_cond_text(
+                           list(zip(idx.columns, ["="] * len(vals),
+                                    vals)))]
             if residual:
                 details.append("Filter: "
                                + self._explain_cond_text(residual))

@@ -75,7 +75,7 @@ class DropTable:
 class CreateIndex:
     index_name: Optional[str]
     table: str
-    column: str
+    columns: List[str]
     if_not_exists: bool = False
 
 
@@ -334,9 +334,11 @@ class PgParser(_BaseParser):
                 self.expect_kw("ON")
             table = self._table_name()
             self.expect_op("(")
-            column = self.name()
+            columns = [self.name()]
+            while self.accept_op(","):
+                columns.append(self.name())
             self.expect_op(")")
-            return CreateIndex(index_name, table, column, ine)
+            return CreateIndex(index_name, table, columns, ine)
         if self.accept_kw("DROP", "TABLE"):
             if_exists = self.accept_kw("IF", "EXISTS")
             return DropTable(self._table_name(), if_exists)
