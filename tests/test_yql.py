@@ -548,3 +548,45 @@ def test_redis_rename_dual_representation(redis):
     assert redis.cmd("GET", "dualdst") == b"sv"
     assert redis.cmd("HGET", "dualdst", "f") == b"hv"
     assert redis.cmd("EXISTS", "dual") == 0
+
+
+def test_cql_aggregates(ql):
+    ql.execute("CREATE TABLE agg (k TEXT, r INT, price BIGINT, "
+               "name TEXT, PRIMARY KEY ((k), r)) WITH tablets = 2")
+    for i in range(6):
+        ql.execute("INSERT INTO agg (k, r, price, name) VALUES "
+                   "('p', %d, %d, '%s')"
+                   % (i, (i + 1) * 10, "n" if i % 2 else "m"))
+    ql.execute("INSERT INTO agg (k, r) VALUES ('p', 99)")  # null price
+    rs = ql.execute("SELECT COUNT(*) FROM agg WHERE k = 'p'")
+    assert rs.columns == ["count(*)"] and rs.rows == [[7]]
+    rs = ql.execute("SELECT COUNT(price), SUM(price), MIN(price), "
+                    "MAX(price), AVG(price) FROM agg WHERE k = 'p'")
+    assert rs.rows == [[6, 210, 10, 60, 35]]
+    # AVG over ints is integer division (Cassandra semantics)
+    assert isinstance(rs.rows[0][4], int)
+    # filtered aggregate
+    rs = ql.execute("SELECT COUNT(*) FROM agg WHERE k = 'p' "
+                    "AND price > 30 ALLOW FILTERING")
+    assert rs.rows == [[3]]
+    # MIN over text works; SUM over text rejected
+    rs = ql.execute("SELECT MIN(name) FROM agg WHERE k = 'p'")
+    assert rs.rows == [["m"]]
+    with pytest.raises(Exception, match="numeric"):
+        ql.execute("SELECT SUM(name) FROM agg WHERE k = 'p'")
+    with pytest.raises(Exception, match="mixed"):
+        ql.execute("SELECT r, COUNT(*) FROM agg WHERE k = 'p'")
+    # empty result set
+    rs = ql.execute("SELECT COUNT(*), SUM(price), MIN(price) FROM agg "
+                    "WHERE k = 'nope'")
+    assert rs.rows == [[0, 0, None]]
+
+
+def test_cql_aggregate_edges(ql):
+    ql.execute("CREATE TABLE aggm (k TEXT PRIMARY KEY, m MAP<TEXT,INT>)")
+    ql.execute("INSERT INTO aggm (k, m) VALUES ('a', {'x': 1})")
+    ql.execute("INSERT INTO aggm (k, m) VALUES ('b', {'y': 2})")
+    with pytest.raises(Exception, match="comparable"):
+        ql.execute("SELECT MIN(m) FROM aggm")
+    with pytest.raises(Exception, match="system"):
+        ql.execute("SELECT COUNT(*) FROM system.peers")

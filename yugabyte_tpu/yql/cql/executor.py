@@ -45,6 +45,35 @@ _CQL_TYPES = {
 }
 
 
+_CQL_AGGS = ("count", "sum", "avg", "min", "max")
+
+
+def _extract_cql_aggregates(items):
+    """[(func, col_or_None)] when EVERY select item is an aggregate call
+    over a bare column (or COUNT(*)); None when no item is. Mixing
+    aggregates and plain columns is invalid in CQL (no GROUP BY)."""
+    def is_agg(i):
+        return (isinstance(i, P.FuncCall) and i.name.lower() in _CQL_AGGS
+                and len(i.args) == 1
+                and (i.args[0] == "*"
+                     or isinstance(i.args[0], P.ColumnRef)))
+    flags = [is_agg(i) for i in items]
+    if not any(flags):
+        return None
+    if not all(flags):
+        raise StatusError(Status.InvalidArgument(
+            "aggregates cannot be mixed with plain columns (no GROUP "
+            "BY in CQL)"))
+    out = []
+    for i in items:
+        col = None if i.args[0] == "*" else i.args[0].name
+        if i.name.lower() != "count" and col is None:
+            raise StatusError(Status.InvalidArgument(
+                f"{i.name.lower()}(*) is not valid"))
+        out.append((i.name.lower(), col))
+    return out
+
+
 def _jsonb_canonical(v) -> str:
     """Canonicalize a JSONB literal (common/jsonb.py) with CQL errors."""
     try:
@@ -428,6 +457,10 @@ class QLProcessor:
         if isinstance(stmt, P.Select):
             ks = stmt.keyspace or self._keyspace
             if ks in ("system", "system_schema"):
+                if stmt.columns and _extract_cql_aggregates(
+                        stmt.columns) is not None:
+                    raise StatusError(Status.NotSupported(
+                        "aggregates over system tables"))
                 return self._select_system(ks, stmt, params, cursor)
             return self._select(stmt, params, cursor, page_size=page_size,
                                 page_state=paging_state)
@@ -447,6 +480,67 @@ class QLProcessor:
         if isinstance(stmt, P.Truncate):
             return self._truncate(stmt)
         raise StatusError(Status.NotSupported(f"statement {type(stmt)}"))
+
+    def _select_aggregate(self, stmt: P.Select, aggs, params, cursor
+                          ) -> ResultSet:
+        """CQL aggregates: COUNT(*)/COUNT(col)/SUM/AVG/MIN/MAX over the
+        whole (filtered) result — YCQL has no GROUP BY, so the output is
+        exactly one row (ref: the CQL aggregate surface in the
+        reference's ql; Cassandra 2.2 aggregate semantics — AVG over an
+        int column is integer division)."""
+        table = self._table(stmt.keyspace, stmt.table)
+        cols_needed = sorted({c for _f, c in aggs if c is not None})
+        if not cols_needed:
+            # COUNT(*)-only: project one key column, not the whole row
+            cols_needed = [table.schema.hash_columns[0].name]
+        inner = P.Select(stmt.keyspace, stmt.table,
+                         cols_needed, stmt.where, stmt.limit,
+                         order_by=stmt.order_by)
+        rs = self._select(inner, params, cursor)
+        dicts = rs.dicts()
+        known = {c.name: c.type for c in table.schema.columns}
+        out_row: List[object] = []
+        out_cols: List[str] = []
+        out_types: List[Optional[DataType]] = []
+        for fname, col in aggs:
+            label = f"{fname}({'*' if col is None else col})"
+            out_cols.append(label)
+            if fname == "count":
+                if col is None:
+                    out_row.append(len(dicts))
+                else:
+                    out_row.append(sum(1 for d in dicts
+                                       if d.get(col) is not None))
+                out_types.append(DataType.INT64)
+                continue
+            vals = [d.get(col) for d in dicts if d.get(col) is not None]
+            t = known.get(col)
+            if fname in ("sum", "avg") and t not in (
+                    DataType.INT32, DataType.INT64, DataType.FLOAT,
+                    DataType.DOUBLE):
+                raise StatusError(Status.InvalidArgument(
+                    f"{fname}() requires a numeric column"))
+            if fname == "sum":
+                out_row.append(sum(vals) if vals else 0)
+                out_types.append(t)
+            elif fname == "avg":
+                if not vals:
+                    out_row.append(0)
+                elif t in (DataType.INT32, DataType.INT64):
+                    out_row.append(sum(vals) // len(vals))
+                else:
+                    out_row.append(sum(vals) / len(vals))
+                out_types.append(t)
+            else:  # min / max
+                try:
+                    out_row.append((min if fname == "min" else max)(vals)
+                                   if vals else None)
+                except TypeError:
+                    raise StatusError(Status.InvalidArgument(
+                        f"{fname}() requires a comparable column type"))
+                out_types.append(t)
+        return ResultSet(columns=out_cols, rows=[out_row],
+                         types=out_types, source=rs.source)
 
     def _conditional_dml(self, stmt, params: List[object],
                          cursor: List[int]) -> ResultSet:
@@ -800,6 +894,9 @@ class QLProcessor:
                      for i in (stmt.columns
                                or [c.name for c in schema.columns
                                    if not c.dropped])]
+        aggs = _extract_cql_aggregates(out_items)
+        if aggs is not None:
+            return self._select_aggregate(stmt, aggs, params, cursor)
         where = self._bind_where(stmt.where, params, cursor)
         known = {c.name: c.type for c in schema.columns}
         where = self._canon_jsonb_where(where, known)

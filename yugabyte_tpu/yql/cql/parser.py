@@ -444,6 +444,10 @@ class Parser:
     def _func_call(self) -> FuncCall:
         fname = self.name()
         self.expect_op("(")
+        if fname.upper() == "COUNT" and self.accept_op("*"):
+            # COUNT(*) — the star is an aggregate-only argument form
+            self.expect_op(")")
+            return FuncCall(fname, ["*"])
         args: List[object] = []
         if not self.accept_op(")"):
             args.append(self._func_arg())
