@@ -590,3 +590,13 @@ def test_cql_aggregate_edges(ql):
         ql.execute("SELECT MIN(m) FROM aggm")
     with pytest.raises(Exception, match="system"):
         ql.execute("SELECT COUNT(*) FROM system.peers")
+
+
+def test_cql_sum_int32_widens(ql):
+    ql.execute("CREATE TABLE s32 (k TEXT PRIMARY KEY, v INT)")
+    ql.execute("INSERT INTO s32 (k, v) VALUES ('a', 2000000000)")
+    ql.execute("INSERT INTO s32 (k, v) VALUES ('b', 2000000000)")
+    rs = ql.execute("SELECT SUM(v) FROM s32")
+    assert rs.rows == [[4000000000]]
+    from yugabyte_tpu.common.schema import DataType
+    assert rs.types == [DataType.INT64]

@@ -401,7 +401,15 @@ class RaftConsensus:
                 expired = (time.monotonic() - self._last_leader_contact
                            > timeout)
             if expired:
-                self.start_election()
+                try:
+                    self.start_election()
+                except RuntimeError as e:
+                    # transient thread exhaustion (big test runs): a dead
+                    # timer would freeze this peer as a non-leader forever
+                    # — back off and retry instead
+                    TRACE("raft %s: election deferred: %s",
+                          self.config.peer_id, e)
+                    time.sleep(0.2)
                 timeout = self._election_timeout_s()
 
     def start_election(self, ignore_lease: bool = False) -> None:
@@ -424,9 +432,20 @@ class RaftConsensus:
             self._maybe_win(term, votes)
             return
         for peer in self.config.remote_peers:
-            threading.Thread(target=self._solicit_vote,
-                             args=(peer, req, votes),
-                             daemon=True).start()
+            try:
+                threading.Thread(target=self._solicit_vote,
+                                 args=(peer, req, votes),
+                                 daemon=True).start()
+            except RuntimeError:
+                # out of threads: solicit this peer synchronously — a
+                # slow election beats a stuck one. Shield the caller
+                # (possibly the election timer) from the peer handler's
+                # faults like the worker-thread path naturally did.
+                try:
+                    self._solicit_vote(peer, req, votes)
+                except Exception as e:  # noqa: BLE001
+                    TRACE("raft %s: sync vote solicit of %s failed: %s",
+                          self.config.peer_id, peer, e)
 
     def _solicit_vote(self, peer: str, req: VoteReq, votes: set) -> None:
         try:
@@ -465,12 +484,24 @@ class RaftConsensus:
         ht = self.clock.now().value if self.clock else 0
         noop = self._append_unlocked(OP_NOOP, ht, b"")
         self._leader_noop_index = noop.index
-        for p in self.config.remote_peers:
-            t = threading.Thread(target=self._peer_loop, args=(p, epoch),
-                                 name=f"raft-peer-{self.config.peer_id}-{p}",
-                                 daemon=True)
-            self._peer_threads.append(t)
-            t.start()
+        try:
+            for p in self.config.remote_peers:
+                t = threading.Thread(
+                    target=self._peer_loop, args=(p, epoch),
+                    name=f"raft-peer-{self.config.peer_id}-{p}",
+                    daemon=True)
+                self._peer_threads.append(t)
+                t.start()
+        except RuntimeError as e:
+            # thread exhaustion mid-bring-up: a leader missing peer
+            # replication loops could never commit — step back to
+            # follower (same term) so a later election retries cleanly
+            TRACE("raft %s: leader bring-up aborted (%s); stepping down",
+                  self.config.peer_id, e)
+            self.role = Role.FOLLOWER
+            self.leader_id = None
+            self._leader_epoch += 1  # orphan any loops that DID start
+            return
         TRACE("raft %s: leader for term %d", self.config.peer_id, self._meta.term)
         threading.Thread(target=self.on_role_change, args=(Role.LEADER,),
                          daemon=True).start()

@@ -522,7 +522,9 @@ class QLProcessor:
                     f"{fname}() requires a numeric column"))
             if fname == "sum":
                 out_row.append(sum(vals) if vals else 0)
-                out_types.append(t)
+                # a sum of int32s overflows int32: widen on the wire
+                out_types.append(DataType.INT64
+                                 if t == DataType.INT32 else t)
             elif fname == "avg":
                 if not vals:
                     out_row.append(0)
