@@ -600,3 +600,33 @@ def test_cql_sum_int32_widens(ql):
     assert rs.rows == [[4000000000]]
     from yugabyte_tpu.common.schema import DataType
     assert rs.types == [DataType.INT64]
+
+
+def test_cql_select_distinct_partitions(ql):
+    ql.execute("CREATE TABLE dparts (k TEXT, r INT, v INT, "
+               "PRIMARY KEY ((k), r)) WITH tablets = 2")
+    for k in ("a", "b", "c"):
+        for r in range(3):
+            ql.execute("INSERT INTO dparts (k, r, v) VALUES "
+                       "('%s', %d, 1)" % (k, r))
+    rs = ql.execute("SELECT DISTINCT k FROM dparts")
+    assert sorted(r[0] for r in rs.rows) == ["a", "b", "c"]
+    rs = ql.execute("SELECT DISTINCT k FROM dparts LIMIT 2")
+    assert len(rs.rows) == 2
+    with pytest.raises(Exception, match="partition key"):
+        ql.execute("SELECT DISTINCT v FROM dparts")
+
+
+def test_cql_distinct_edges(ql):
+    with pytest.raises(Exception, match="DISTINCT \\*"):
+        ql.execute("SELECT DISTINCT * FROM dparts")
+    with pytest.raises(Exception, match="ORDER BY"):
+        ql.execute("SELECT DISTINCT k FROM dparts ORDER BY k")
+    # paging through the distinct set
+    rs = ql.execute("SELECT DISTINCT k FROM dparts", page_size=2)
+    assert len(rs.rows) == 2 and rs.paging_state is not None
+    rs2 = ql.execute("SELECT DISTINCT k FROM dparts", page_size=2,
+                     paging_state=rs.paging_state)
+    assert len(rs2.rows) == 1 and rs2.paging_state is None
+    all_keys = sorted(r[0] for r in rs.rows + rs2.rows)
+    assert all_keys == ["a", "b", "c"]

@@ -270,6 +270,9 @@ class RaftConsensus:
         self.safe_time_provider = safe_time_provider or (lambda: 0)
         self.on_propagated_safe_time = on_propagated_safe_time or (lambda ht: None)
         self.on_role_change = on_role_change or (lambda r: None)
+        # role-change deferred under thread exhaustion; fired by the
+        # election timer loop (upper layers MUST learn about leadership)
+        self._pending_role_change: Optional[Role] = None
         self.clock = clock
         self._meta = _ConsensusMetadata(meta_path)
         self._rng = random.Random(seed if seed is not None
@@ -394,6 +397,14 @@ class RaftConsensus:
         timeout = self._election_timeout_s()
         while not self._stopped:
             time.sleep(flags.get_flag("raft_heartbeat_interval_ms") / 1000.0)
+            pending = self._pending_role_change
+            if pending is not None:
+                self._pending_role_change = None
+                try:
+                    self.on_role_change(pending)
+                except Exception as e:  # noqa: BLE001 — keep the timer alive
+                    TRACE("raft %s: deferred role-change failed: %s",
+                          self.config.peer_id, e)
             with self._lock:
                 if self._stopped or self.role == Role.LEADER:
                     self._last_leader_contact = time.monotonic()
@@ -467,6 +478,17 @@ class RaftConsensus:
                 return
             self._become_leader_unlocked()
 
+    def _spawn_role_change(self, role: "Role") -> None:
+        """Notify upper layers of a role change without blocking the
+        consensus lock; under thread exhaustion the notification is
+        DEFERRED to the election timer loop, never dropped (a leader
+        whose bootstrap callback never fires wedges the tablet)."""
+        try:
+            threading.Thread(target=self.on_role_change, args=(role,),
+                             daemon=True).start()
+        except RuntimeError:
+            self._pending_role_change = role
+
     def _become_leader_unlocked(self) -> None:
         """ref raft_consensus.cc:1038 BecomeLeaderUnlocked."""
         self.role = Role.LEADER
@@ -503,8 +525,7 @@ class RaftConsensus:
             self._leader_epoch += 1  # orphan any loops that DID start
             return
         TRACE("raft %s: leader for term %d", self.config.peer_id, self._meta.term)
-        threading.Thread(target=self.on_role_change, args=(Role.LEADER,),
-                         daemon=True).start()
+        self._spawn_role_change(Role.LEADER)
 
     def _step_down_unlocked(self, new_term: int) -> None:
         if new_term > self._meta.term:
@@ -519,8 +540,7 @@ class RaftConsensus:
             ev.set()
         self._commit_cv.notify_all()
         if was_leader:
-            threading.Thread(target=self.on_role_change, args=(Role.FOLLOWER,),
-                             daemon=True).start()
+            self._spawn_role_change(Role.FOLLOWER)
 
     # ---------------------------------------------------------- vote handler
     def handle_vote_request(self, req: VoteReq) -> VoteResp:

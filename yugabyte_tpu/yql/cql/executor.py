@@ -481,6 +481,59 @@ class QLProcessor:
             return self._truncate(stmt)
         raise StatusError(Status.NotSupported(f"statement {type(stmt)}"))
 
+    def _select_distinct(self, stmt: P.Select, params, cursor,
+                         page_size=None, page_state=None) -> ResultSet:
+        """SELECT DISTINCT over the partition key: CQL restricts DISTINCT
+        to EXPLICIT partition key columns (no '*'), without ORDER BY —
+        one output row per partition (ref: the grammar's distinct
+        restriction in ql). Pages by offset into the distinct set (the
+        set is bounded by the partition count)."""
+        table = self._table(stmt.keyspace, stmt.table)
+        schema = table.schema
+        hash_names = [c.name for c in schema.hash_columns]
+        if stmt.columns is None:
+            raise StatusError(Status.InvalidArgument(
+                "SELECT DISTINCT * is not valid: name the partition "
+                f"key columns {hash_names}"))
+        if stmt.order_by:
+            raise StatusError(Status.InvalidArgument(
+                "ORDER BY is not valid with SELECT DISTINCT"))
+        want = stmt.columns
+        if [c for c in want if not isinstance(c, str)] \
+                or list(want) != hash_names:
+            raise StatusError(Status.InvalidArgument(
+                f"SELECT DISTINCT is only valid on the partition key "
+                f"columns {hash_names}"))
+        inner = P.Select(stmt.keyspace, stmt.table, list(hash_names),
+                         stmt.where, None)
+        rs = self._select(inner, params, cursor)
+        seen = []
+        seen_set = set()
+        for row in rs.rows:
+            t = tuple(row)
+            if t not in seen_set:
+                seen_set.add(t)
+                seen.append(list(row))
+                if stmt.limit is not None and len(seen) >= stmt.limit:
+                    break
+        off = 0
+        if page_state:
+            if not page_state.startswith(b"DIST:"):
+                raise StatusError(Status.InvalidArgument(
+                    "malformed paging state"))
+            off = int(page_state[5:])
+        out = ResultSet(columns=list(hash_names),
+                        types=[schema.column(c).type
+                               for c in hash_names],
+                        source=rs.source)
+        if page_size is not None:
+            out.rows = seen[off:off + page_size]
+            if off + page_size < len(seen):
+                out.paging_state = b"DIST:%d" % (off + page_size)
+        else:
+            out.rows = seen[off:]
+        return out
+
     def _select_aggregate(self, stmt: P.Select, aggs, params, cursor
                           ) -> ResultSet:
         """CQL aggregates: COUNT(*)/COUNT(col)/SUM/AVG/MIN/MAX over the
@@ -899,6 +952,9 @@ class QLProcessor:
         aggs = _extract_cql_aggregates(out_items)
         if aggs is not None:
             return self._select_aggregate(stmt, aggs, params, cursor)
+        if stmt.distinct:
+            return self._select_distinct(stmt, params, cursor,
+                                         page_size, page_state)
         where = self._bind_where(stmt.where, params, cursor)
         known = {c.name: c.type for c in schema.columns}
         where = self._canon_jsonb_where(where, known)

@@ -148,6 +148,9 @@ class Select:
     columns: Optional[List[str]]              # None = *
     where: List[Tuple[str, str, object]] = field(default_factory=list)
     limit: Optional[int] = None
+    # SELECT DISTINCT <partition key cols> (CQL restricts DISTINCT to
+    # the partition key; ref the grammar's distinct handling)
+    distinct: bool = False
     # ORDER BY clustering_col [ASC|DESC] — valid only with the partition
     # key restricted (CQL semantics; ref: sem/analyzer order-by checks)
     order_by: List[Tuple[str, bool]] = field(default_factory=list)
@@ -555,6 +558,7 @@ class Parser:
         return col
 
     def _select(self) -> Select:
+        distinct = bool(self.accept_kw("DISTINCT"))
         if self.accept_op("*"):
             cols = None
         else:
@@ -578,7 +582,8 @@ class Parser:
         if self.accept_kw("LIMIT"):
             limit = int(self.literal())
         self.accept_kw("ALLOW", "FILTERING")
-        return Select(ks, table, cols, where, limit, order_by=order_by)
+        return Select(ks, table, cols, where, limit, order_by=order_by,
+                      distinct=distinct)
 
     def _where(self) -> List[Tuple[str, str, object]]:
         conds = []
