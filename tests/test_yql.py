@@ -618,6 +618,10 @@ def test_cql_select_distinct_partitions(ql):
 
 
 def test_cql_distinct_edges(ql):
+    ql.execute("CREATE TABLE IF NOT EXISTS dparts (k TEXT, r INT, v INT, "
+               "PRIMARY KEY ((k), r)) WITH tablets = 2")
+    for k in ("a", "b", "c"):
+        ql.execute("INSERT INTO dparts (k, r, v) VALUES ('%s', 0, 1)" % k)
     with pytest.raises(Exception, match="DISTINCT \\*"):
         ql.execute("SELECT DISTINCT * FROM dparts")
     with pytest.raises(Exception, match="ORDER BY"):
@@ -630,3 +634,32 @@ def test_cql_distinct_edges(ql):
     assert len(rs2.rows) == 1 and rs2.paging_state is None
     all_keys = sorted(r[0] for r in rs.rows + rs2.rows)
     assert all_keys == ["a", "b", "c"]
+
+
+def test_cql_token_function(ql):
+    ql.execute("CREATE TABLE toks (k TEXT, r INT, v INT, "
+               "PRIMARY KEY ((k), r)) WITH tablets = 2")
+    for k in ("a", "b", "c", "d"):
+        ql.execute("INSERT INTO toks (k, r, v) VALUES ('%s', 0, 1)" % k)
+    rs = ql.execute("SELECT k, token(k) FROM toks")
+    toks = {r[0]: r[1] for r in rs.rows}
+    assert len(toks) == 4 and all(isinstance(t, int) for t in toks.values())
+    # token-range scan: the Spark/bulk-reader split pattern — ranges
+    # partition the keyspace without overlap
+    mid = sorted(toks.values())[1]
+    lo = ql.execute("SELECT k FROM toks WHERE token(k) <= %d "
+                    "ALLOW FILTERING" % mid)
+    hi = ql.execute("SELECT k FROM toks WHERE token(k) > %d "
+                    "ALLOW FILTERING" % mid)
+    got = sorted(r[0] for r in lo.rows + hi.rows)
+    assert got == ["a", "b", "c", "d"]
+    assert len(lo.rows) == 2 and len(hi.rows) == 2
+
+
+def test_cql_token_wrong_columns_rejected(ql):
+    ql.execute("CREATE TABLE tw (k TEXT, r INT, v INT, "
+               "PRIMARY KEY ((k), r))")
+    with pytest.raises(Exception, match="partition key"):
+        ql.execute("SELECT token(v) FROM tw")
+    with pytest.raises(Exception, match="partition key"):
+        ql.execute("SELECT k FROM tw WHERE token(r) > 0 ALLOW FILTERING")

@@ -397,14 +397,11 @@ class RaftConsensus:
         timeout = self._election_timeout_s()
         while not self._stopped:
             time.sleep(flags.get_flag("raft_heartbeat_interval_ms") / 1000.0)
-            pending = self._pending_role_change
-            if pending is not None:
-                self._pending_role_change = None
-                try:
-                    self.on_role_change(pending)
-                except Exception as e:  # noqa: BLE001 — keep the timer alive
-                    TRACE("raft %s: deferred role-change failed: %s",
-                          self.config.peer_id, e)
+            try:
+                self._drain_role_change()
+            except Exception as e:  # noqa: BLE001 — keep the timer alive
+                TRACE("raft %s: deferred role-change failed: %s",
+                      self.config.peer_id, e)
             with self._lock:
                 if self._stopped or self.role == Role.LEADER:
                     self._last_leader_contact = time.monotonic()
@@ -480,14 +477,26 @@ class RaftConsensus:
 
     def _spawn_role_change(self, role: "Role") -> None:
         """Notify upper layers of a role change without blocking the
-        consensus lock; under thread exhaustion the notification is
-        DEFERRED to the election timer loop, never dropped (a leader
-        whose bootstrap callback never fires wedges the tablet)."""
+        consensus lock. Latest-wins slot + drainer: the slot (written
+        under the consensus lock, which every caller holds) always
+        carries the NEWEST role, so rapid leader->follower flaps deliver
+        the terminal state and never out-of-order or dropped
+        notifications; under thread exhaustion the election timer loop
+        drains the slot instead (a leader whose bootstrap callback never
+        fires wedges the tablet)."""
+        self._pending_role_change = role
         try:
-            threading.Thread(target=self.on_role_change, args=(role,),
+            threading.Thread(target=self._drain_role_change,
                              daemon=True).start()
         except RuntimeError:
-            self._pending_role_change = role
+            pass  # the election timer loop drains the slot
+
+    def _drain_role_change(self) -> None:
+        with self._lock:
+            role = self._pending_role_change
+            self._pending_role_change = None
+        if role is not None:
+            self.on_role_change(role)
 
     def _become_leader_unlocked(self) -> None:
         """ref raft_consensus.cc:1038 BecomeLeaderUnlocked."""

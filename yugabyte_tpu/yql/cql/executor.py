@@ -74,6 +74,16 @@ def _extract_cql_aggregates(items):
     return out
 
 
+def _row_token(row_dict: dict, columns) -> Optional[int]:
+    """The row's partition token: the 16-bit hash of its hash-column
+    group (ref: token() in the CQL grammar; partition hashing in
+    common/partition.py)."""
+    vals = tuple(row_dict.get(c) for c in columns)
+    if any(v is None for v in vals):
+        return None
+    return DocKey(hash_components=vals).hash_code
+
+
 def _jsonb_canonical(v) -> str:
     """Canonicalize a JSONB literal (common/jsonb.py) with CQL errors."""
     try:
@@ -270,6 +280,8 @@ class QLProcessor:
             return f"{item.name.lower()}({inner})"
         if isinstance(item, P.ColumnRef):
             return item.name
+        if isinstance(item, P.TokenRef):
+            return f"token({', '.join(item.columns)})"
         if isinstance(item, P.JsonOp):
             out = item.column
             for i, step in enumerate(item.path):
@@ -299,6 +311,8 @@ class QLProcessor:
                 raise StatusError(Status.InvalidArgument(
                     f"{item.column} is not a jsonb column"))
             return DataType.STRING if item.as_text else DataType.JSONB
+        if isinstance(item, P.TokenRef):
+            return DataType.INT64
         if isinstance(item, str) and as_column:
             return known.get(item)
         return bfunc.infer_type(item)
@@ -317,6 +331,8 @@ class QLProcessor:
         if isinstance(item, P.JsonOp):
             return lambda d, row, _j=item: _jsonb_navigate(
                 d.get(_j.column), _j.path, _j.as_text)
+        if isinstance(item, P.TokenRef):
+            return lambda d, row, _c=item.columns: _row_token(d, _c)
         if isinstance(item, P.FuncCall):
             name = item.name.lower()
             if name == "writetime":
@@ -384,6 +400,8 @@ class QLProcessor:
             if isinstance(col, P.JsonOp):
                 have = _jsonb_navigate(row_dict.get(col.column),
                                        col.path, col.as_text)
+            elif isinstance(col, P.TokenRef):
+                have = _row_token(row_dict, col.columns)
             else:
                 have = row_dict.get(col)
             if have is None:
@@ -518,10 +536,13 @@ class QLProcessor:
                     break
         off = 0
         if page_state:
-            if not page_state.startswith(b"DIST:"):
+            try:
+                if not page_state.startswith(b"DIST:"):
+                    raise ValueError(page_state)
+                off = int(page_state[5:])
+            except ValueError:
                 raise StatusError(Status.InvalidArgument(
                     "malformed paging state"))
-            off = int(page_state[5:])
         out = ResultSet(columns=list(hash_names),
                         types=[schema.column(c).type
                                for c in hash_names],
@@ -949,6 +970,16 @@ class QLProcessor:
                      for i in (stmt.columns
                                or [c.name for c in schema.columns
                                    if not c.dropped])]
+        # token() must name the partition key columns in order — a hash
+        # over anything else matches no partition layout (real CQL
+        # rejects it the same way)
+        hash_col_names = [c.name for c in schema.hash_columns]
+        for it in list(out_items) + [f[0] for f in stmt.where]:
+            if isinstance(it, P.TokenRef) \
+                    and list(it.columns) != hash_col_names:
+                raise StatusError(Status.InvalidArgument(
+                    f"token() arguments must be the partition key "
+                    f"columns {hash_col_names} in order"))
         aggs = _extract_cql_aggregates(out_items)
         if aggs is not None:
             return self._select_aggregate(stmt, aggs, params, cursor)

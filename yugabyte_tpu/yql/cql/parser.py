@@ -118,6 +118,15 @@ class ColumnRef:
 
 
 @dataclass(frozen=True)
+class TokenRef:
+    """token(pk_cols): the row's 16-bit partition hash — the CQL token
+    function used for partition-range scans by bulk readers (ref: the
+    grammar's token function; our partition hash is
+    common/partition.hash_column_compound_value)."""
+    columns: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
 class JsonOp:
     """JSONB path navigation: col->'key'->2->>'leaf' (ref: the reference's
     jsonb operators in ql — common/jsonb.cc ApplyJsonbOperators; PG's
@@ -548,8 +557,22 @@ class Parser:
                 break
         return JsonOp(col, tuple(path), as_text)
 
+    def _token_args(self) -> TokenRef:
+        """name [, name]* ')' of a token(...) call (opening paren already
+        consumed) — shared by the select-list and WHERE grammars."""
+        cols = [self.name()]
+        while self.accept_op(","):
+            cols.append(self.name())
+        self.expect_op(")")
+        return TokenRef(tuple(cols))
+
     def _select_item(self):
         tok = self.peek()
+        if tok and tok[0] == "name" and tok[1].upper() == "TOKEN" \
+                and self._peek2() == ("op", "("):
+            self.name()
+            self.expect_op("(")
+            return self._token_args()
         if tok and tok[0] == "name" and self._peek2() == ("op", "("):
             return self._func_call()
         col = self.name()
@@ -589,7 +612,9 @@ class Parser:
         conds = []
         while True:
             col = self.name()
-            if self.peek() in (("op", "->"), ("op", "->>")):
+            if col.upper() == "TOKEN" and self.accept_op("("):
+                col = self._token_args()
+            elif self.peek() in (("op", "->"), ("op", "->>")):
                 col = self._json_path(col)
             if self.accept_kw("IN"):
                 # col IN (v1, v2, ...) — drives the discrete ScanChoices
