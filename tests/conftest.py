@@ -14,10 +14,21 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Older jax builds (< 0.4.34) spell the device-count knob as an XLA flag
+# rather than jax_num_cpu_devices; set it before the backend initializes
+# so either path yields the 8-device mesh.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # pre-0.4.34 jax: the XLA_FLAGS fallback above applies
 
 
 def pytest_collection_modifyitems(config, items):

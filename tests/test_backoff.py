@@ -1,0 +1,85 @@
+"""utils/backoff.py: decorrelated-jitter Backoff + RetrySchedule pacing,
+and the swallowed-error lint wired into tier-1."""
+
+import os
+import random
+import sys
+import time
+
+from yugabyte_tpu.utils.backoff import Backoff, RetrySchedule
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBackoff:
+    def test_delays_bounded_and_jittered(self):
+        b = Backoff(base_s=0.05, cap_s=1.0, rng=random.Random(7))
+        delays = [b.next_delay() for _ in range(50)]
+        assert all(0.0 <= d <= 1.0 for d in delays)
+        assert b.attempts == 50
+        # decorrelated jitter: values are not all identical (no lockstep)
+        assert len({round(d, 6) for d in delays}) > 10
+        # and the early delays trend upward from the base toward the cap
+        assert max(delays[:3]) < 1.0 or delays[0] < delays[-1]
+
+    def test_two_backoffs_desynchronize(self):
+        a = Backoff(base_s=0.05, cap_s=2.0, rng=random.Random(1))
+        b = Backoff(base_s=0.05, cap_s=2.0, rng=random.Random(2))
+        assert [a.next_delay() for _ in range(5)] != \
+            [b.next_delay() for _ in range(5)]
+
+    def test_deadline_clamps_and_expires(self):
+        b = Backoff(base_s=10.0, cap_s=60.0, deadline_s=0.1)
+        d = b.next_delay()
+        assert d <= 0.1  # clamped to the remaining deadline
+        time.sleep(0.12)
+        assert b.expired
+        assert not b.sleep()  # no sleep once expired
+
+    def test_sleep_returns_true_within_deadline(self):
+        b = Backoff(base_s=0.001, cap_s=0.002, deadline_s=5.0)
+        assert b.sleep()
+
+
+class TestRetrySchedule:
+    def test_exponential_spacing_capped(self):
+        rng = random.Random(3)
+        s = RetrySchedule(initial_s=0.1, max_s=1.0, rng=rng)
+        assert s.ready()
+        delays = [s.record_failure() for _ in range(8)]
+        # grows ~2x per failure until the cap (+-25% jitter)
+        assert delays[0] <= 0.1 * 1.25
+        assert delays[1] <= 0.2 * 1.25
+        assert all(d <= 1.0 * 1.25 for d in delays)
+        assert delays[-1] >= 1.0 * 0.75  # capped, not unbounded
+        assert not s.ready()
+
+    def test_reset_rearms_immediately(self):
+        s = RetrySchedule(initial_s=5.0, max_s=30.0)
+        s.record_failure()
+        assert not s.ready()
+        s.reset()
+        assert s.ready() and s.failures == 0
+
+    def test_becomes_ready_after_delay(self):
+        s = RetrySchedule(initial_s=0.01, max_s=0.02)
+        s.record_failure()
+        deadline = time.monotonic() + 2.0
+        while not s.ready():
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+
+
+def test_no_swallowed_errors_in_storage_layers():
+    """CI wiring for tools/lint_swallowed_errors.py: storage/, consensus/
+    and tablet/ must route every broadly-caught error to the
+    background-error slot or TRACE — silent swallowing is how an injected
+    disk fault becomes corruption instead of a contained FAILED tablet."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    try:
+        import lint_swallowed_errors as lint
+    finally:
+        sys.path.pop(0)
+    offenses = lint.check_paths(REPO_ROOT)
+    assert not offenses, "\n".join(
+        f"{p}:{ln}: {msg}" for p, ln, msg in offenses)

@@ -7,6 +7,10 @@ import secrets
 
 import pytest
 
+# the AES-CTR cipher lives in the optional `cryptography` package; the
+# whole encryption feature is gated on it
+pytest.importorskip("cryptography")
+
 from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
 from yugabyte_tpu.docdb.doc_key import DocKey, SubDocKey
 from yugabyte_tpu.docdb.value import Value
@@ -63,6 +67,41 @@ def test_env_legacy_plaintext_fallback(tmp_path, encrypted_env):
     r = env.open_random(p)
     assert r.pread(5, 6) == b"old b"
     r.close()
+
+
+def test_env_torn_header_fails_closed(tmp_path, encrypted_env):
+    """A file truncated mid-header (crash during create) must fail loudly
+    on every access path — never key the cipher with garbage bytes."""
+    env = encrypted_env
+    p = str(tmp_path / "full")
+    env.write_file(p, b"x" * 500)
+    raw = open(p, "rb").read()
+    # header = magic(8) + kid_len(2) + kid + nonce(16) + wrapped(32)
+    hlen = 8 + 2 + len("uk-test") + 16 + 32
+    for cut in (9, 10, 15, hlen - 1):
+        torn = str(tmp_path / f"torn{cut}")
+        with open(torn, "wb") as f:
+            f.write(raw[:cut])
+        with pytest.raises(ValueError):
+            env.read_file(torn)
+        with pytest.raises(ValueError):
+            env.open_random(torn)
+        with pytest.raises(ValueError):
+            env.open_append(torn)  # reopen-for-append parses the header too
+
+
+def test_env_truncated_header_leaves_no_fd_leak(tmp_path, encrypted_env):
+    import resource
+    env = encrypted_env
+    p = str(tmp_path / "f")
+    env.write_file(p, b"data")
+    torn = str(tmp_path / "torn")
+    with open(torn, "wb") as f:
+        f.write(open(p, "rb").read()[:20])
+    soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    for _ in range(min(soft + 10, 2000)):
+        with pytest.raises(ValueError):
+            env.open_random(torn)  # leaked fds would exhaust the limit
 
 
 def test_env_unknown_key_fails_closed(tmp_path):

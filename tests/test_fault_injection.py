@@ -1,0 +1,484 @@
+"""Disk-fault injection + background-error containment tests (ref:
+rocksdb/db/fault_injection_test.cc FaultInjectionTest; tablet FAILED
+state containment in the reference's tablet_peer.cc / ts_tablet_manager).
+
+Covers the whole containment chain: FaultInjectionEnv semantics, DB
+background-error parking (degraded read-only, clean abort, retry), WAL
+append failures failing the replicate, the tablet FAILED state with
+retryable write rejection + maintenance-manager backoff recovery, and
+dropped-fsync crash recovery yielding exactly the synced prefix.
+"""
+
+import os
+import time
+
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import DocHybridTime, HybridTime
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.consensus.log import Log, LogEntry, LogReader
+from yugabyte_tpu.consensus.transport import LocalTransport
+from yugabyte_tpu.docdb.doc_key import DocKey, SubDocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.docdb.value import Value
+from yugabyte_tpu.storage.db import DB, DBOptions
+from yugabyte_tpu.utils import env as env_mod
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.env import FaultError, FaultInjectionEnv
+from yugabyte_tpu.utils.status import Code, StatusError
+
+
+@pytest.fixture()
+def fenv():
+    fi = env_mod.enable_fault_injection(env_mod.Env())
+    yield fi
+    env_mod.set_env(env_mod.Env())
+
+
+def _key(i):
+    return SubDocKey(DocKey(range_components=(f"r{i:04d}",)),
+                     (("col", 0),)).encode(include_ht=False)
+
+
+def _items(lo, hi):
+    return [(_key(i), DocHybridTime(HybridTime((i + 1) << 12), 0),
+             Value(primitive=f"v{i}").encode()) for i in range(lo, hi)]
+
+
+def wait_for(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timeout: {msg}"
+        time.sleep(0.02)
+
+
+# ------------------------------------------------------------- env semantics
+class TestFaultInjectionEnv:
+    def test_pread_and_read_file_faults(self, fenv, tmp_path):
+        p = str(tmp_path / "f")
+        fenv.write_file(p, b"payload-bytes")
+        fenv.set_fault("read", count=1)
+        with pytest.raises(FaultError):
+            fenv.read_file(p)
+        assert fenv.read_file(p) == b"payload-bytes"  # count exhausted
+        fenv.set_fault("read", path_filter="other")
+        r = fenv.open_random(p)
+        assert r.pread(7, 0) == b"payload"  # filter does not match
+        fenv.set_fault("read", path_filter="f")
+        with pytest.raises(FaultError):
+            r.pread(7, 0)
+        r.close()
+
+    def test_enospc_and_short_append(self, fenv, tmp_path):
+        p = str(tmp_path / "a")
+        f = fenv.open_append(p)
+        f.append(b"good")
+        fenv.set_fault("enospc", count=1)
+        with pytest.raises(OSError) as ei:
+            f.append(b"never")
+        import errno
+        assert ei.value.errno == errno.ENOSPC
+        fenv.set_fault("append_short", count=1)
+        with pytest.raises(FaultError):
+            f.append(b"12345678")  # half lands: a torn write
+        f.flush()
+        f.close()
+        assert fenv.read_file(p) == b"good1234"
+
+    def test_dropped_fsync_crash_loses_exactly_unsynced_tail(
+            self, fenv, tmp_path):
+        p = str(tmp_path / "wal-000001")
+        f = fenv.open_append(p)
+        f.append(b"SYNCED")
+        f.flush(fsync=True)
+        fenv.set_drop_fsyncs(True)
+        f.append(b"-UNSYNCED")
+        f.flush(fsync=True)  # lying disk: claims success
+        f.close()
+        assert fenv.read_file(p) == b"SYNCED-UNSYNCED"  # visible pre-crash
+        fenv.simulate_crash()
+        assert open(p, "rb").read() == b"SYNCED"  # exactly the synced prefix
+
+    def test_crash_removes_never_synced_files(self, fenv, tmp_path):
+        fenv.set_drop_fsyncs(True)
+        p1 = str(tmp_path / "new-append")
+        f = fenv.open_append(p1)
+        f.append(b"x" * 100)
+        f.flush(fsync=True)
+        f.close()
+        p2 = str(tmp_path / "whole")
+        fenv.write_file(p2, b"whole-file")
+        fenv.simulate_crash()
+        assert not os.path.exists(p1)
+        assert not os.path.exists(p2)
+
+    def test_whole_file_overwrite_reverts_to_synced_content(
+            self, fenv, tmp_path):
+        p = str(tmp_path / "base.sst")
+        fenv.write_file(p, b"generation-1")
+        fenv.set_drop_fsyncs(True)
+        fenv.write_file(p, b"generation-2-unsynced")
+        fenv.simulate_crash()
+        assert open(p, "rb").read() == b"generation-1"
+
+    def test_stacks_over_encrypted_env(self, tmp_path):
+        pytest.importorskip("cryptography")
+        import secrets
+        keys = env_mod.UniverseKeys()
+        keys.add("uk", secrets.token_bytes(32))
+        fi = FaultInjectionEnv(env_mod.EncryptedEnv(keys))
+        assert fi.encrypted
+        p = str(tmp_path / "enc")
+        fi.write_file(p, b"secret-data")
+        assert open(p, "rb").read()[:8] == b"YBENCv1\x00"
+        assert fi.read_file(p) == b"secret-data"
+        fi.set_fault("read")
+        with pytest.raises(FaultError):
+            fi.read_file(p)
+
+    def test_no_faults_passthrough_sst_byte_identical(self, fenv, tmp_path):
+        """The CPU SST path through an (un-armed) FaultInjectionEnv must
+        produce byte-identical files to the plain Env — the wrapper adds
+        failure modes, never byte drift."""
+        dirs = {}
+        for name in ("via_fault", "via_plain"):
+            if name == "via_plain":
+                env_mod.set_env(env_mod.Env())
+            db = DB(str(tmp_path / name), DBOptions(auto_compact=False))
+            db.write_batch(_items(0, 50))
+            db.flush()
+            db.close()
+            dirs[name] = tmp_path / name
+        a, b = (sorted(p.name for p in dirs[n].iterdir()
+                       if ".sst" in p.name) for n in dirs)
+        assert a == b and a
+        for fn in a:
+            assert (dirs["via_fault"] / fn).read_bytes() == \
+                (dirs["via_plain"] / fn).read_bytes(), fn
+
+
+# --------------------------------------------------- DB background error slot
+class TestDBBackgroundError:
+    def test_flush_error_parks_db_readonly_then_recovers(
+            self, fenv, tmp_path):
+        db = DB(str(tmp_path / "db"), DBOptions(auto_compact=False))
+        db.write_batch(_items(0, 30))
+        db.flush()
+        assert db.n_live_files == 1
+        db.write_batch(_items(30, 60))
+        fenv.set_fault("enospc", path_filter=".sst")
+        assert db.flush() is None  # contained, not raised
+        assert db.background_error is not None
+        # version set untouched; no partial SST files on disk
+        assert db.n_live_files == 1
+        leftovers = [n for n in os.listdir(str(tmp_path / "db"))
+                     if ".sst" in n]
+        assert len(leftovers) == 2  # base + data of the installed SST only
+        # degraded READ-ONLY: reads serve (memtable restored), writes
+        # reject retryably
+        assert db.get(_key(45)) is not None
+        assert db.get(_key(10)) is not None
+        with pytest.raises(StatusError) as ei:
+            db.write_batch(_items(60, 61))
+        assert ei.value.status.code == Code.SERVICE_UNAVAILABLE
+        # flush attempts while parked are no-ops
+        assert db.flush() is None
+        # fault persists -> retry fails and re-parks
+        assert not db.retry_background_work()
+        assert db.background_error is not None
+        # fault clears -> retry recovers, parked rows flush
+        fenv.clear_faults()
+        assert db.retry_background_work()
+        assert db.background_error is None
+        assert db.n_live_files == 2
+        db.write_batch(_items(60, 70))
+        assert db.get(_key(65)) is not None
+        db.close()
+        # restart: everything readable (manifest consistent throughout)
+        db2 = DB(str(tmp_path / "db"), DBOptions(auto_compact=False))
+        for i in (0, 29, 30, 59):
+            assert db2.get(_key(i)) is not None, i
+        db2.close()
+
+    def test_compaction_error_keeps_inputs_live_then_recovers(
+            self, fenv, tmp_path):
+        db = DB(str(tmp_path / "db"), DBOptions(auto_compact=False))
+        for lo in range(0, 120, 30):
+            db.write_batch(_items(lo, lo + 30))
+            db.flush()
+        assert db.n_live_files == 4
+        fenv.set_fault("enospc", path_filter=".sst")
+        db.compact_all()  # contained
+        assert db.background_error is not None
+        assert db.n_live_files == 4  # inputs still the live version
+        for i in (0, 45, 119):
+            assert db.get(_key(i)) is not None, i
+        fenv.clear_faults()
+        assert db.retry_background_work()
+        db.compact_all()
+        assert db.n_live_files == 1
+        for i in (0, 45, 119):
+            assert db.get(_key(i)) is not None, i
+        db.close()
+
+    def test_dropped_fsync_crash_rolls_manifest_back_with_sst(
+            self, fenv, tmp_path):
+        """Acceptance (a), storage half: with fsyncs dropped, a crash after
+        a 'successful' flush must not leave a manifest that references
+        vanished SST bytes — recovery sees the pre-flush version set (the
+        synced prefix) and no phantom records."""
+        d = str(tmp_path / "db")
+        db = DB(d, DBOptions(auto_compact=False))
+        db.write_batch(_items(0, 20))
+        db.flush()  # durable generation
+        fenv.set_drop_fsyncs(True)
+        db.write_batch(_items(20, 40))
+        db.flush()  # claims success; nothing actually durable
+        assert db.n_live_files == 2
+        db.close()
+        fenv.simulate_crash()
+        db2 = DB(d, DBOptions(auto_compact=False))
+        assert db2.n_live_files == 1  # exactly the synced flush
+        for i in range(0, 20):
+            assert db2.get(_key(i)) is not None, i
+        for i in range(20, 40):
+            assert db2.get(_key(i)) is None, i  # no phantom rows
+        db2.close()
+
+
+# ------------------------------------------------------- WAL append failures
+class TestWalAppendFailure:
+    def test_append_sync_raises_and_log_seals(self, fenv, tmp_path):
+        log = Log(str(tmp_path / "wal"))
+        log.append_sync([LogEntry(1, 1, b"ok")])
+        fenv.set_fault("append", path_filter="wal-")
+        with pytest.raises(OSError):
+            log.append_sync([LogEntry(1, 2, b"fails")])
+        assert log.io_error is not None
+        # sealed: even after the fault clears, appends keep failing (the
+        # segment may hold a torn record; recovery is a re-open)
+        fenv.clear_faults()
+        with pytest.raises(OSError):
+            log.append_sync([LogEntry(1, 3, b"still fails")])
+        log.close()
+        # replay yields exactly the pre-failure prefix
+        entries = list(LogReader(str(tmp_path / "wal")).read_all())
+        assert [e.index for e in entries] == [1]
+
+    def test_torn_append_recovers_to_record_boundary(self, fenv, tmp_path):
+        log = Log(str(tmp_path / "wal"))
+        log.append_sync([LogEntry(1, i, f"p{i}".encode() * 50)
+                         for i in range(1, 6)])
+        fenv.set_fault("append_short", path_filter="wal-", count=1)
+        with pytest.raises(OSError):
+            log.append_sync([LogEntry(1, 6, b"torn" * 100)])
+        log.close()
+        fenv.clear_faults()
+        # the torn half-record is dropped by the crc rule at replay
+        entries = list(LogReader(str(tmp_path / "wal")).read_all())
+        assert [e.index for e in entries] == [1, 2, 3, 4, 5]
+        # and a fresh Log over the same dir rewrites the tail cleanly
+        log2 = Log(str(tmp_path / "wal"))
+        log2.append_sync([LogEntry(1, 6, b"retried")])
+        log2.close()
+        entries = list(LogReader(str(tmp_path / "wal")).read_all())
+        assert [e.index for e in entries] == [1, 2, 3, 4, 5, 6]
+
+
+# --------------------------------------------------- tablet FAILED state e2e
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.INT64)],
+    num_hash_key_columns=0, num_range_key_columns=1)
+
+
+def _op(k, v):
+    return QLWriteOp(WriteOpKind.INSERT, DocKey(range_components=(k,)),
+                     {"v": v})
+
+
+def _elect(peer, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    window = 2.0
+    while time.monotonic() < deadline:
+        peer.raft.start_election(ignore_lease=True)
+        attempt_end = min(time.monotonic() + window, deadline)
+        while time.monotonic() < attempt_end:
+            if peer.raft.is_leader():
+                return
+            time.sleep(0.005)
+        window *= 2
+    raise TimeoutError("no leader")
+
+
+@pytest.fixture()
+def manager(fenv, tmp_path):
+    from yugabyte_tpu.common.wire import schema_to_wire
+    from yugabyte_tpu.tserver.ts_tablet_manager import TSTabletManager
+    flags.set_flag("raft_heartbeat_interval_ms", 15)
+    mgr = TSTabletManager("ts0", str(tmp_path / "ts0"), LocalTransport())
+    mgr.create_tablet("t1", "tbl1", schema_to_wire(SCHEMA), ["ts0"])
+    peer = mgr.get_tablet("t1")
+    _elect(peer)
+    wait_for(lambda: peer.raft.leader_ready(), msg="leader ready")
+    yield mgr
+    flags.reset_flag("raft_heartbeat_interval_ms")
+    mgr.shutdown()
+
+
+class TestTabletFailedState:
+    def test_flush_fault_fails_tablet_writes_reject_reads_drain(
+            self, fenv, manager):
+        """Acceptance (b): injected flush error -> DB degraded read-only ->
+        tablet FAILED -> retryable write rejection while reads drain ->
+        heartbeat report carries the state -> backoff retry recovers ->
+        writes succeed again."""
+        from yugabyte_tpu.tablet.tablet_peer import (STATE_FAILED,
+                                                     STATE_RUNNING)
+        from yugabyte_tpu.tserver.maintenance_manager import (
+            MaintenanceManager)
+        peer = manager.get_tablet("t1")
+        for i in range(20):
+            peer.write([_op(f"k{i:03d}", i)])
+        fenv.set_fault("enospc", path_filter=".sst")
+        peer.tablet.flush()  # contained: parks the regular DB
+        assert peer.tablet.regular_db.background_error is not None
+        assert peer.state == STATE_FAILED
+        # report carries the state for the master's load balancer
+        report = {t["tablet_id"]: t for t in manager.generate_report()}
+        assert report["t1"]["state"] == STATE_FAILED
+        # writes reject retryably, tagged for the client's replica walk
+        with pytest.raises(StatusError) as ei:
+            peer.write([_op("rejected", 1)])
+        assert ei.value.status.code == Code.SERVICE_UNAVAILABLE
+        assert ei.value.extra.get("tablet_failed")
+        # reads drain
+        row = peer.read_row(DocKey(range_components=("k003",)))
+        assert row is not None and row.to_dict(SCHEMA)["v"] == 3
+        # maintenance-manager recovery with capped backoff
+        flags.set_flag("background_error_retry_initial_s", 0.02)
+        try:
+            mm = MaintenanceManager(
+                peers_fn=manager.peers,
+                recover_fn=lambda p: manager.recover_failed_tablet(
+                    p.tablet_id))
+            assert mm.run_once() == "recover:t1"  # fault still armed
+            assert peer.state == STATE_FAILED
+            sched = mm._recover_backoff["t1"]
+            assert sched.failures == 1
+            fenv.clear_faults()
+            wait_for(sched.ready, msg="backoff window")
+            assert mm.run_once() == "recover:t1"
+            assert peer.state == STATE_RUNNING
+            assert peer.tablet.regular_db.background_error is None
+        finally:
+            flags.reset_flag("background_error_retry_initial_s")
+        # parked rows flushed; writes flow again; nothing lost
+        peer.write([_op("after", 99)])
+        for k, v in [("k000", 0), ("k019", 19), ("after", 99)]:
+            row = peer.read_row(DocKey(range_components=(k,)))
+            assert row is not None and row.to_dict(SCHEMA)["v"] == v, k
+
+    def test_wal_failure_fails_replicate_and_rebootstrap_recovers(
+            self, fenv, manager):
+        """A WAL append fault fails the in-flight replicate (fate-unknown,
+        not a silent torn write), seals the log, FAILs the tablet, and
+        recover_failed_tablet re-bootstraps it back to RUNNING with every
+        acked row intact."""
+        from yugabyte_tpu.tablet.tablet_peer import (STATE_FAILED,
+                                                     STATE_RUNNING)
+        from yugabyte_tpu.consensus.raft import OperationOutcomeUnknown
+        peer = manager.get_tablet("t1")
+        for i in range(10):
+            peer.write([_op(f"w{i:02d}", i)])
+        fenv.set_fault("append", path_filter="wal-")
+        # fate-unknown, raised FAST (well under the timeout): the entry is
+        # in leader memory and a follower majority could still commit it
+        t0 = time.monotonic()
+        with pytest.raises(OperationOutcomeUnknown):
+            peer.write([_op("doomed", -1)], timeout_s=30.0)
+        assert time.monotonic() - t0 < 10.0
+        wait_for(lambda: peer.state == STATE_FAILED, msg="peer FAILED")
+        assert peer.log.io_error is not None
+        # in-place recovery cannot fix a sealed WAL...
+        assert not peer.try_recover()
+        fenv.clear_faults()
+        assert not peer.try_recover()
+        # ...but a re-bootstrap can
+        assert manager.recover_failed_tablet("t1")
+        peer2 = manager.get_tablet("t1")
+        assert peer2 is not peer and peer2.state == STATE_RUNNING
+        _elect(peer2)
+        wait_for(lambda: peer2.raft.leader_ready(), msg="leader ready")
+        for i in range(10):
+            row = peer2.read_row(DocKey(range_components=(f"w{i:02d}",)))
+            assert row is not None and row.to_dict(SCHEMA)["v"] == i, i
+        peer2.write([_op("fresh", 7)])
+        assert peer2.read_row(
+            DocKey(range_components=("fresh",))).to_dict(SCHEMA)["v"] == 7
+
+    def test_dropped_wal_fsyncs_crash_recovers_synced_prefix(
+            self, fenv, tmp_path):
+        """Acceptance (a), WAL half: acked writes whose fsyncs were
+        silently dropped vanish at the crash; recovery replays exactly the
+        synced prefix — no torn or phantom records."""
+        from yugabyte_tpu.common.wire import schema_to_wire
+        from yugabyte_tpu.tserver.ts_tablet_manager import TSTabletManager
+        flags.set_flag("raft_heartbeat_interval_ms", 15)
+        try:
+            mgr = TSTabletManager("tsA", str(tmp_path / "tsA"),
+                                  LocalTransport())
+            mgr.create_tablet("tw", "tblw", schema_to_wire(SCHEMA), ["tsA"])
+            peer = mgr.get_tablet("tw")
+            _elect(peer)
+            wait_for(lambda: peer.raft.leader_ready(), msg="leader ready")
+            for i in range(10):
+                peer.write([_op(f"s{i:02d}", i)])  # durable era
+            fenv.set_drop_fsyncs(True, path_filter="wal-")
+            for i in range(10, 20):
+                peer.write([_op(f"s{i:02d}", i)])  # acked by a lying disk
+            mgr.shutdown()
+            fenv.simulate_crash()
+            # every surviving WAL record parses cleanly (no torn tail
+            # surprises beyond the crc rule)
+            mgr2 = TSTabletManager("tsA", str(tmp_path / "tsA"),
+                                   LocalTransport())
+            assert mgr2.open_existing() == 1
+            peer2 = mgr2.get_tablet("tw")
+            _elect(peer2)
+            wait_for(lambda: peer2.raft.leader_ready(), msg="leader ready")
+            for i in range(10):
+                row = peer2.read_row(
+                    DocKey(range_components=(f"s{i:02d}",)))
+                assert row is not None, i  # synced prefix intact
+            for i in range(10, 20):
+                row = peer2.read_row(
+                    DocKey(range_components=(f"s{i:02d}",)))
+                assert row is None, i  # unsynced suffix is gone, not torn
+            mgr2.shutdown()
+        finally:
+            flags.reset_flag("raft_heartbeat_interval_ms")
+
+
+# ----------------------------------------------- master-side FAILED handling
+class TestMasterSideFailedReplicas:
+    def test_ts_manager_tracks_failed_and_lb_flags_them(self):
+        from yugabyte_tpu.master.catalog_manager import TSManager
+        from yugabyte_tpu.master.load_balancer import ClusterLoadBalancer
+        tsm = TSManager()
+        tsm.heartbeat("ts0", "h:1", [
+            {"tablet_id": "ta", "state": "RUNNING"},
+            {"tablet_id": "tb", "state": "FAILED"}])
+        assert tsm.get("ts0").failed_tablets == {"tb"}
+
+        class _Cat:
+            ts_manager = tsm
+        lb = ClusterLoadBalancer(_Cat(), messenger=None)
+        assert lb._reported_failed("ts0", "tb")
+        assert not lb._reported_failed("ts0", "ta")
+        assert not lb._reported_failed("ts-unknown", "tb")
+        # a later healthy report clears the flag
+        tsm.heartbeat("ts0", "h:1", [
+            {"tablet_id": "ta", "state": "RUNNING"},
+            {"tablet_id": "tb", "state": "RUNNING"}])
+        assert not lb._reported_failed("ts0", "tb")

@@ -163,6 +163,49 @@ def test_server_shutdown_fails_pending(pair):
     assert caught
 
 
+def test_midcall_connection_teardown_fails_calls_immediately(pair):
+    """Tearing the client connection down mid-call must fail every
+    in-flight call with ServiceUnavailable NOW — a caller must never sit
+    out its full timeout_s on a connection known to be dead."""
+    server, client = pair
+    results = {}
+    started = threading.Event()
+
+    def worker():
+        t0 = time.monotonic()
+        try:
+            started.set()
+            client.call(server.address, "echo", "slow", timeout_s=60,
+                        delay_s=60)
+            results["outcome"] = "returned"
+        except ServiceUnavailable:
+            results["outcome"] = "unavailable"
+        except RpcTimeout:
+            results["outcome"] = "timeout"
+        results["elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    started.wait()
+    # wait until the call is registered in flight on the connection
+    conn = None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and conn is None:
+        with client._conns_lock:
+            for c in client._conns.values():
+                with c.lock:
+                    if c.pending:
+                        conn = c
+        time.sleep(0.01)
+    assert conn is not None, "call never became in-flight"
+    conn.close()  # mid-call teardown
+    t.join(timeout=10)
+    assert not t.is_alive(), "caller still blocked after teardown"
+    assert results["outcome"] == "unavailable"
+    assert results["elapsed"] < 10, \
+        f"caller waited {results['elapsed']:.1f}s — should fail immediately"
+
+
 # --------------------------------------------------------------- Raft on RPC
 
 def test_raft_over_rpc(tmp_path):
@@ -222,12 +265,22 @@ class TestTLS:
         import subprocess
         cert = str(tmp_path / "node.crt")
         key = str(tmp_path / "node.key")
-        subprocess.run(
-            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-             "-keyout", key, "-out", cert, "-days", "1",
-             "-subj", "/CN=ybtpu-test",
-             "-addext", "basicConstraints=critical,CA:TRUE"],
-            check=True, capture_output=True)
+        base = ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", key, "-out", cert, "-days", "1",
+                "-subj", "/CN=ybtpu-test"]
+        # Both OpenSSL 1.1.1 and 3.x default `req -x509` to a CA:TRUE
+        # cert. Passing -addext basicConstraints on 1.1.1 DUPLICATES the
+        # extension (the default config also adds it) and chain
+        # verification then rejects the cert — so generate plain, verify
+        # it can act as its own issuer, and only add the extension
+        # explicitly if some build leaves it out.
+        subprocess.run(base, check=True, capture_output=True)
+        ok = subprocess.run(["openssl", "verify", "-CAfile", cert, cert],
+                            capture_output=True)
+        if ok.returncode != 0:
+            subprocess.run(
+                base + ["-addext", "basicConstraints=critical,CA:TRUE"],
+                check=True, capture_output=True)
         from yugabyte_tpu.utils import flags
         olds = {f: flags.get_flag(f) for f in
                 ("rpc_use_tls", "rpc_tls_cert_file", "rpc_tls_key_file",

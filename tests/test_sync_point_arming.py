@@ -1,0 +1,77 @@
+"""sync_point arming semantics: in-process callbacks and the
+cross-process "<point>@<hits>" multi-hit crash arming (the kill -9
+simulator behind the external-cluster crash tests). The crash mode calls
+os._exit(137), so it is exercised in a subprocess.
+"""
+
+import os
+import subprocess
+import sys
+
+from yugabyte_tpu.utils import sync_point
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_in_process_arm_and_disarm():
+    hits = []
+    sync_point.arm("test.point", lambda: hits.append(1))
+    try:
+        sync_point.hit("test.point")
+        sync_point.hit("other.point")
+        sync_point.hit("test.point")
+        assert len(hits) == 2
+    finally:
+        sync_point.disarm("test.point")
+    sync_point.hit("test.point")
+    assert len(hits) == 2
+
+
+def _run_child(crash_spec: str, n_hits: int) -> subprocess.CompletedProcess:
+    code = (
+        "from yugabyte_tpu.utils import sync_point\n"
+        f"for _ in range({n_hits}):\n"
+        "    sync_point.hit('crash.me')\n"
+        "print('SURVIVED')\n"
+    )
+    env = dict(os.environ, YBTPU_CRASH_POINT=crash_spec,
+               PYTHONPATH=REPO_ROOT)
+    return subprocess.run([sys.executable, "-u", "-c", code], env=env,
+                          capture_output=True, text=True, timeout=60)
+
+
+def test_crash_point_single_hit_kills_like_kill9():
+    r = _run_child("crash.me", n_hits=1)
+    assert r.returncode == 137
+    assert "SURVIVED" not in r.stdout
+
+
+def test_crash_point_multi_hit_arms_at_nth_hit():
+    """"<point>@<hits>" dies exactly on the hits-th reach: below the
+    threshold the process survives, at it the process exits 137."""
+    r = _run_child("crash.me@3", n_hits=2)
+    assert r.returncode == 0 and "SURVIVED" in r.stdout
+    r = _run_child("crash.me@3", n_hits=3)
+    assert r.returncode == 137
+    assert "SURVIVED" not in r.stdout
+
+
+def test_crash_point_rearm_resets_count():
+    """arm_crash() resets the hit counter (node_runner re-arms AFTER
+    startup so bootstrap-time hits don't count)."""
+    code = (
+        "from yugabyte_tpu.utils import sync_point\n"
+        "sync_point.hit('crash.me')\n"
+        "sync_point.arm_crash('crash.me@2')\n"  # reset mid-run
+        "sync_point.hit('crash.me')\n"
+        "print('ONE-AFTER-REARM')\n"
+        "sync_point.hit('crash.me')\n"
+        "print('NEVER')\n"
+    )
+    env = dict(os.environ, YBTPU_CRASH_POINT="crash.me@2",
+               PYTHONPATH=REPO_ROOT)
+    r = subprocess.run([sys.executable, "-u", "-c", code], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 137
+    assert "ONE-AFTER-REARM" in r.stdout
+    assert "NEVER" not in r.stdout

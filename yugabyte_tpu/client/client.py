@@ -24,6 +24,7 @@ from yugabyte_tpu.docdb.doc_operations import QLWriteOp
 from yugabyte_tpu.rpc.messenger import (
     Messenger, RemoteError, RpcTimeout, ServiceUnavailable)
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.backoff import Backoff
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
 
@@ -93,6 +94,7 @@ class YBClient:
         addrs = ([self._master_leader] if self._master_leader else []) + [
             a for a in self._master_addrs if a != self._master_leader]
         last_err: Optional[Exception] = None
+        backoff = Backoff(base_s=0.1, cap_s=1.0)
         for _ in range(flags.get_flag("client_rpc_retries")):
             for addr in list(addrs):
                 try:
@@ -118,7 +120,7 @@ class YBClient:
                     last_err = e
                     continue
             self._master_leader = None
-            time.sleep(0.2)
+            time.sleep(backoff.next_delay())  # jittered, not lockstep
         raise StatusError(Status.ServiceUnavailable(
             f"no reachable master leader for {mth} (last: {last_err})"))
 
@@ -252,15 +254,16 @@ class YBClient:
             if not (e.status.code == Code.ALREADY_PRESENT
                     and ctx.get("maybe_applied")):
                 raise
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        backoff = Backoff(base_s=0.25, cap_s=2.0, deadline_s=timeout_s)
+        while True:
             meta = self._master_call("get_table", namespace=namespace,
                                      name=table)
             for w in meta.get("indexes", []):
                 if (w["index_name"] == index_name
                         and w.get("state") == STATE_READABLE):
                     return w
-            time.sleep(0.5)
+            if not backoff.sleep():
+                break
         raise StatusError(Status.TimedOut(
             f"index {index_name} did not become readable"))
 
@@ -301,6 +304,7 @@ class YBClient:
         if refresh_key is None:
             refresh_key = tablet.partition.start
         last_err: Optional[Exception] = None
+        backoff = Backoff(base_s=0.05, cap_s=1.0)
         for attempt in range(flags.get_flag("client_rpc_retries")):
             for addr in tablet.candidate_addrs():
                 try:
@@ -311,6 +315,14 @@ class YBClient:
                     if e.extra.get("tablet_split") or \
                             e.extra.get("wrong_tablet"):
                         raise
+                    if e.extra.get("tablet_failed"):
+                        # This replica parked itself after a background
+                        # storage error: stop preferring it and walk the
+                        # other replicas now; the master re-replicates /
+                        # a new leader emerges while we retry.
+                        tablet.mark_leader(None)
+                        last_err = e
+                        continue
                     if e.extra.get("not_leader"):
                         hint = e.extra.get("leader_hint")
                         if hint:
@@ -337,8 +349,9 @@ class YBClient:
                 except (RpcTimeout, ServiceUnavailable) as e:
                     last_err = e
                     continue
-            # All replicas failed: refresh locations and back off.
-            time.sleep(min(0.05 * (2 ** attempt), 1.0))
+            # All replicas failed: refresh locations and back off
+            # (decorrelated jitter — concurrent clients desynchronize).
+            time.sleep(backoff.next_delay())
             tablet = self.meta_cache.lookup_tablet(
                 table.table_id, refresh_key, refresh=True)
         raise StatusError(Status.ServiceUnavailable(
@@ -423,6 +436,7 @@ class YBClient:
         cursor = start_cursor   # partition-key-space position
         lower = start_lower     # doc-key resume bound (global, monotonic)
         failures = 0
+        backoff = Backoff(base_s=0.1, cap_s=1.0)
         while True:
             tablet = self.meta_cache.lookup_tablet(table.table_id, cursor)
             try:
@@ -442,10 +456,11 @@ class YBClient:
                 failures += 1
                 if not retryable or failures > 8:
                     raise
-                time.sleep(0.2)
+                time.sleep(backoff.next_delay())
                 self.meta_cache.invalidate(table.table_id)
                 continue
             failures = 0
+            backoff = Backoff(base_s=0.1, cap_s=1.0)
             if pinned is None:
                 pinned = resp.get("read_ht")
             if scan_state is not None:
@@ -474,6 +489,7 @@ class YBClient:
         pinned = read_ht.value if read_ht else None
         lower = lower_doc_key
         failures = 0
+        backoff = Backoff(base_s=0.1, cap_s=1.0)
         while True:
             tablet = self.meta_cache.lookup_tablet(table.table_id,
                                                    partition_key)
@@ -491,10 +507,11 @@ class YBClient:
                 failures += 1
                 if not retryable or failures > 8:
                     raise
-                time.sleep(0.2)
+                time.sleep(backoff.next_delay())
                 self.meta_cache.invalidate(table.table_id)
                 continue
             failures = 0
+            backoff = Backoff(base_s=0.1, cap_s=1.0)
             if pinned is None:
                 pinned = resp.get("read_ht")
             if scan_state is not None:

@@ -122,6 +122,15 @@ class Log:
         self._queue: List[Tuple[List[LogEntry], Optional[Callable]]] = []
         self._inflight = False  # appender is mid-write on a popped batch
         self._stopped = False
+        # First append/fsync failure latches here: the segment may hold a
+        # torn record, so further appends are refused (they would land
+        # after the tear and be unreachable at replay) and every callback
+        # reports the error — the replicate FAILS rather than claiming
+        # durability it does not have. Recovery is a re-bootstrap (the
+        # torn-tail replay rule applies). on_io_error tells the owner
+        # (TabletPeer) to transition the tablet to FAILED.
+        self._io_error: Optional[Exception] = None
+        self.on_io_error: Optional[Callable[[Exception], None]] = None
         self._file = None
         self._file_size = 0
         self._file_first_index = None
@@ -167,24 +176,47 @@ class Log:
         with self._lock:
             return self._last_op_id
 
+    @property
+    def io_error(self) -> Optional[Exception]:
+        """The latched append failure, or None while healthy."""
+        with self._lock:
+            return self._io_error
+
     def append_async(self, entries: Sequence[LogEntry],
-                     callback: Optional[Callable[[], None]] = None) -> None:
+                     callback: Optional[Callable] = None) -> None:
         """Queue entries for the appender thread (ref log.cc:739
-        AsyncAppendReplicates). Callback fires after fsync."""
+        AsyncAppendReplicates). The callback fires after fsync as
+        callback(err): err is None on durable success, the I/O error
+        otherwise — claiming success on a failed append would count a
+        non-durable replica toward the commit majority."""
         if not entries:
             if callback:
-                callback()
+                callback(None)
             return
         with self._cv:
             if self._stopped:
                 raise RuntimeError("log is closed")
-            self._queue.append((list(entries), callback))
-            self._cv.notify()
+            if self._io_error is not None:
+                err = self._io_error
+            else:
+                self._queue.append((list(entries), callback))
+                self._cv.notify()
+                return
+        if callback:
+            callback(err)
 
     def append_sync(self, entries: Sequence[LogEntry]) -> None:
         done = threading.Event()
-        self.append_async(entries, done.set)
+        box = {"err": None}
+
+        def _cb(err):
+            box["err"] = err
+            done.set()
+
+        self.append_async(entries, _cb)
         done.wait()
+        if box["err"] is not None:
+            raise box["err"]
 
     def _appender_loop(self) -> None:
         while True:
@@ -202,20 +234,45 @@ class Log:
                     self._cv.notify_all()
 
     def _write_batch(self, batch) -> None:
-        files_to_sync = set()
-        for entries, _cb in batch:
-            for e in entries:
-                self._ensure_segment(e.index)
-                rec = _encode_entry(e)
-                self._file.append(rec)
-                self._file_size += len(rec)
-                self._last_op_id = e.op_id
-            files_to_sync.add(self._file)
-        for f in files_to_sync:
-            f.flush(fsync=bool(flags.get_flag("durable_wal_write")))
+        err = self._io_error
+        if err is None:
+            try:
+                files_to_sync = set()
+                for entries, _cb in batch:
+                    for e in entries:
+                        self._ensure_segment(e.index)
+                        rec = _encode_entry(e)
+                        self._file.append(rec)
+                        self._file_size += len(rec)
+                        self._last_op_id = e.op_id
+                    files_to_sync.add(self._file)
+                for f in files_to_sync:
+                    f.flush(fsync=bool(flags.get_flag("durable_wal_write")))
+            except OSError as exc:
+                err = exc
+                self._fail(exc)
         for _entries, cb in batch:
             if cb:
-                cb()
+                # err != None also for batches whose bytes landed before
+                # the failure: their fsync never ran, so durability is
+                # unconfirmed — conservatively failed
+                cb(err)
+
+    def _fail(self, exc: Exception) -> None:
+        with self._cv:
+            first = self._io_error is None
+            if first:
+                self._io_error = exc
+        if first:
+            TRACE("wal %s: append failed, log is sealed: %s",
+                  self.wal_dir, exc)
+            hook = self.on_io_error
+            if hook is not None:
+                try:
+                    hook(exc)
+                except Exception as e:  # noqa: BLE001 — appender must live
+                    TRACE("wal %s: on_io_error hook raised: %s",
+                          self.wal_dir, e)
 
     def _ensure_segment(self, first_index: int) -> None:
         if (self._file is None or
@@ -319,6 +376,10 @@ class Log:
             self._cv.notify()
         self._appender.join(timeout=10)
         if self._file:
-            self._file.flush(fsync=True)
-            self._file.close()
+            try:
+                self._file.flush(fsync=True)
+                self._file.close()
+            except OSError as e:
+                TRACE("wal %s: close-time flush failed: %s",
+                      self.wal_dir, e)
             self._file = None

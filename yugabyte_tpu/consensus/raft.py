@@ -303,6 +303,10 @@ class RaftConsensus:
         self._durable_lock = threading.Lock()
         self._durable_watermark = 0
         self._durable_event = threading.Event()
+        # Latched on the first WAL append failure (Log seals itself): new
+        # replicates fail fast with fate-unknown instead of waiting out
+        # their timeout on a durability ack that can never come.
+        self._log_error: Optional[Exception] = None
         self._withhold_votes_until = 0.0
         self._last_leader_contact = time.monotonic()
 
@@ -705,6 +709,11 @@ class RaftConsensus:
                 cur = self._entries.get(msg.index)
                 if cur is None or cur.term != msg.term:
                     raise ReplicationAborted(f"op {msg.op_id} overwritten")
+                if self._log_error is not None:
+                    # Local WAL is dead. The entry may still commit through
+                    # the followers, so this is fate-unknown, not an abort:
+                    # the timeout path keeps the watch_fate/dedup story.
+                    raise ReplicationTimedOut(msg.op_id)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     # NOT an abort: the entry stays in the log and may yet
@@ -722,13 +731,25 @@ class RaftConsensus:
         self._last_term = msg.term
         if self.on_append_cb is not None:
             self.on_append_cb(msg)
-        self.log.append_async([msg.to_log_entry()],
-                              callback=lambda: self._on_local_durable(index))
+        self.log.append_async(
+            [msg.to_log_entry()],
+            callback=lambda err=None: self._on_local_durable(index, err))
         return msg
 
-    def _on_local_durable(self, index: int) -> None:
+    def _on_local_durable(self, index: int, err=None) -> None:
         """WAL appender callback. MUST NOT touch self._lock (see the
-        durability-watermark comment in __init__)."""
+        durability-watermark comment in __init__). A non-None err means
+        the append failed: the watermark stays put (this replica must not
+        count toward the majority for the entry) and waiting replicates
+        are woken to fail fast."""
+        if err is not None:
+            with self._durable_lock:
+                if self._log_error is None:
+                    self._log_error = err
+            self._durable_event.set()
+            with self._commit_cv:
+                self._commit_cv.notify_all()
+            return
         with self._durable_lock:
             if index > self._durable_watermark:
                 self._durable_watermark = index
@@ -1037,7 +1058,17 @@ class RaftConsensus:
                     # Consensus-internal; committed config may remove us.
                     self._on_config_committed(msg)
                 elif msg.op_type != OP_NOOP:
-                    self.apply_cb(msg)
+                    try:
+                        self.apply_cb(msg)
+                    except Exception as e:  # noqa: BLE001 — contained
+                        # A parked storage engine (background error) rejects
+                        # the apply. last_applied MUST NOT advance past an
+                        # unapplied entry; stop here and let the commit
+                        # worker's next round retry — applies resume once
+                        # the DB recovers (ref: tablet FAILED containment).
+                        TRACE("raft %s: apply of op %s deferred: %s",
+                              self.config.peer_id, msg.op_id, e)
+                        return
                 with self._lock:
                     self.last_applied = idx
                     self._commit_cv.notify_all()

@@ -37,6 +37,10 @@ class LocalTransport:
         with self._lock:
             self._peers[peer_id] = consensus
 
+    def unregister(self, peer_id: str) -> None:
+        with self._lock:
+            self._peers.pop(peer_id, None)
+
     # ------------------------------------------------------ fault injection
     def _known(self, name: str) -> bool:
         return name in self._peers or \

@@ -63,6 +63,10 @@ class TSDescriptor:
         self.last_heartbeat = time.monotonic()
         self.num_tablets = 0
         self.reported_tablets: Set[str] = set()
+        # replicas this server reports in FAILED state (background storage
+        # error): the load balancer re-replicates them without waiting for
+        # the whole server to go silent
+        self.failed_tablets: Set[str] = set()
 
     def alive(self) -> bool:
         timeout = flags.get_flag("tserver_unresponsive_timeout_ms") / 1000.0
@@ -86,6 +90,8 @@ class TSManager:
             desc.last_heartbeat = time.monotonic()
             desc.num_tablets = len(report)
             desc.reported_tablets = {t["tablet_id"] for t in report}
+            desc.failed_tablets = {t["tablet_id"] for t in report
+                                   if t.get("state") == "FAILED"}
             return desc
 
     def live_descriptors(self) -> List[TSDescriptor]:

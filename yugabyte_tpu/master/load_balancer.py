@@ -53,8 +53,14 @@ class ClusterLoadBalancer:
         for tablet_id, tm in list(cm.tablets.items()):
             if moves >= max_moves:
                 break
+            # A replica is repair-worthy when its server has gone silent
+            # past the grace period OR the server itself reports the
+            # replica FAILED (background storage error) — an explicit
+            # report needs no grace (ref: the reference treats
+            # TABLET_DATA_TOMBSTONED/failed replicas as under-replication).
             dead = [s for s in tm["replicas"]
-                    if self._dead_for(s) > grace_s]
+                    if self._dead_for(s) > grace_s
+                    or self._reported_failed(s, tablet_id)]
             if not dead:
                 continue
             leader = cm.tablet_leaders.get(tablet_id)
@@ -70,6 +76,10 @@ class ClusterLoadBalancer:
 
     def on_leadership_change(self) -> None:
         self._leader_since = None
+
+    def _reported_failed(self, server_id: str, tablet_id: str) -> bool:
+        desc = self.catalog.ts_manager.get(server_id)
+        return desc is not None and tablet_id in desc.failed_tablets
 
     def _dead_for(self, server_id: str) -> float:
         desc = self.catalog.ts_manager.get(server_id)

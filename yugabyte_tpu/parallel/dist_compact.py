@@ -38,7 +38,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.35 jax exports it under experimental only
+    from jax.experimental.shard_map import shard_map
 
 from yugabyte_tpu.ops import merge_gc
 from yugabyte_tpu.ops.merge_gc import (

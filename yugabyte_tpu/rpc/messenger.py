@@ -395,6 +395,17 @@ class _ClientConnection:
         return waiter["resp"]
 
     def close(self) -> None:
+        # Fail in-flight calls NOW rather than waiting for the reader
+        # thread to observe the closed socket: a caller parked in
+        # event.wait() must get ServiceUnavailable immediately, never sit
+        # out its full timeout_s on a connection known to be gone.
+        with self.lock:
+            if self.dead is None:
+                self.dead = ConnectionError("connection closed")
+            waiters = list(self.pending.values())
+            self.pending.clear()
+        for w in waiters:
+            w["event"].set()
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:

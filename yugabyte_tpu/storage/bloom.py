@@ -51,8 +51,9 @@ class BloomFilterBuilder:
             if native_engine.available():
                 native_engine.bloom_build(h, self.bits, self.m_bits, self.k)
                 return
-        except Exception:  # pragma: no cover — numpy fallback stays exact
-            pass
+        except Exception as e:  # pragma: no cover — numpy fallback is exact
+            from yugabyte_tpu.utils.trace import TRACE
+            TRACE("bloom: native build failed, using numpy fallback: %s", e)
         h1 = (h & np.uint64(0xFFFFFFFF)).astype(np.uint64)
         h2 = (h >> np.uint64(32)).astype(np.uint64) | np.uint64(1)
         with np.errstate(over="ignore"):

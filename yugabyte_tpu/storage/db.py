@@ -71,6 +71,15 @@ class DB:
     def __init__(self, db_dir: str, options: Optional[DBOptions] = None):
         self.db_dir = db_dir
         self.opts = options or DBOptions()
+        # RocksDB-style background-error slot (ref: db_impl.cc
+        # error_handler_): a failed flush/compaction parks the DB in
+        # degraded read-only mode — writes reject retryably, reads keep
+        # serving the installed state — until retry_background_work()
+        # clears it. The hook tells the owner (TabletPeer) to transition
+        # the tablet to FAILED.
+        self._bg_error: Optional["Status"] = None
+        self.on_background_error: Optional[Callable[[object], None]] = None
+        self._writing: set = set()  # SST paths mid-write (orphan-sweep guard)
         self._device_cache = None
         if self.opts.device_cache is not None:
             from yugabyte_tpu.storage.device_cache import (
@@ -149,6 +158,73 @@ class DB:
                 return 1
             return len(self._readers)
 
+    # ------------------------------------------------------- background error
+    @property
+    def background_error(self):
+        """The parked Status, or None when healthy."""
+        return self._bg_error
+
+    def _require_writable(self) -> None:
+        err = self._bg_error
+        if err is not None:
+            from yugabyte_tpu.utils.status import Status, StatusError
+            raise StatusError(Status.ServiceUnavailable(
+                f"DB {self.db_dir} is read-only after a background error "
+                f"({err}); retry later"))
+
+    def _set_background_error(self, where: str, exc: BaseException) -> None:
+        from yugabyte_tpu.utils.status import Status
+        st = Status.IoError(f"{where} failed in {self.db_dir}: {exc}")
+        with self._lock:
+            if self._bg_error is not None:
+                return  # first error wins; recovery clears it
+            self._bg_error = st
+        TRACE("db %s: background error (%s): %s", self.db_dir, where, exc)
+        cb = self.on_background_error
+        if cb is not None:
+            cb(st)
+
+    def retry_background_work(self) -> bool:
+        """Clear the parked error and retry the failed work (the
+        maintenance manager drives this with capped backoff, ref
+        DBImpl::Resume). Returns True when the DB is healthy again; a
+        failing retry re-parks it."""
+        with self._lock:
+            if self._bg_error is None:
+                return True
+            self._bg_error = None
+        from yugabyte_tpu.utils.status import StatusError
+        try:
+            self.flush()
+        except (OSError, StatusError):
+            return False  # flush's failure path re-set the background error
+        if self.opts.auto_compact:
+            self.maybe_schedule_compaction()
+        return self._bg_error is None
+
+    def _sweep_orphan_outputs_unlocked(self) -> None:
+        """Remove SST files on disk that no version references and no
+        in-flight writer owns — the partial outputs of a failed
+        flush/compaction (ref: PurgeObsoleteFiles after a failed job)."""
+        try:
+            names = os.listdir(self.db_dir)
+        except OSError:
+            return
+        live = set(self.versions.files)
+        writing = {os.path.basename(p) for p in self._writing}
+        for name in names:
+            stem = name.split(".", 1)[0]
+            if not (name.endswith(".sst") or name.endswith(".sblock.0")) \
+                    or not stem.isdigit():
+                continue
+            base_name = stem + ".sst"
+            if int(stem) in live or base_name in writing:
+                continue
+            try:
+                os.remove(os.path.join(self.db_dir, name))
+            except OSError:
+                pass
+
     # ------------------------------------------------------------------ write
     def _post_write_locked(self, op_id: Tuple[int, int]) -> bool:
         """Shared writer tail (lock held): op-id tracking + flush trigger."""
@@ -161,6 +237,7 @@ class DB:
                     op_id: Tuple[int, int] = (0, 0)) -> None:
         """Apply a batch (already carrying DocHybridTimes). WAL-less: durability
         comes from the Raft log above (ref: tablet.cc:1247 WriteToRocksDB)."""
+        self._require_writable()
         with self._lock:
             mem = self.mem
             if len(items) > 8 or hasattr(mem, "add_columns"):
@@ -183,6 +260,7 @@ class DB:
         parallel key/value lists + uint64 HT and uint32 write-id arrays —
         one native memtable call instead of per-row tuple assembly
         (ref: db/memtable.cc Add, write path hot loop)."""
+        self._require_writable()
         with self._lock:
             mem = self.mem
             if hasattr(mem, "add_columns"):
@@ -318,6 +396,7 @@ class DB:
         from yugabyte_tpu.utils.env import get_env
         if not (native_engine.available() and not get_env().encrypted):
             raise RuntimeError("ingest_packed requires the native engine")
+        self._require_writable()
         n = len(key_offs) - 1
         if n == 0:
             return None
@@ -503,16 +582,21 @@ class DB:
         with self._lock:
             if self._imm is not None:
                 return None  # a flush is already in progress
+            if self._bg_error is not None:
+                return None  # parked: retry_background_work re-drives
             if self.mem.empty:
                 return None
             self._imm, self.mem = self.mem, new_memtable()
             imm = self._imm
             last_op = getattr(self, "_last_op_id", (0, 0))
+        fid = path = None
         try:
             if self.pre_flush_hook is not None:
                 self.pre_flush_hook()
             fid = self.versions.new_file_id()
             path = os.path.join(self.db_dir, f"{fid:06d}.sst")
+            with self._lock:
+                self._writing.add(path)
             slab = None
             from yugabyte_tpu.storage import native_engine
             from yugabyte_tpu.utils.env import get_env
@@ -557,14 +641,33 @@ class DB:
                 self._rset_gen += 1
                 self._mem_run_cache = None
             TRACE("flushed %d entries to %s", n_flushed, path)
-        except BaseException:
+        except BaseException as e:
             with self._lock:
                 # restore un-flushed entries into the live memtable
                 for k, v in imm.iter_from():
                     prefix, dht = split_key_and_ht(k)
                     self.mem.add(prefix, dht, v)
                 self._imm = None
+                # partial outputs of the aborted flush — but never a file
+                # the version set already adopted (an error between the
+                # manifest add and the frontier edit leaves it live)
+                installed = fid is not None and fid in self.versions.files
+            if path is not None and not installed:
+                _delete_sst_files(path)
+                if self._device_cache is not None and fid is not None:
+                    self._device_cache.drop(fid)
+            from yugabyte_tpu.utils.status import StatusError
+            if isinstance(e, (OSError, StatusError)):
+                # Contained: version set untouched (or still consistent),
+                # no rows lost (memtable restored). Park read-only; the
+                # maintenance manager retries with capped backoff.
+                self._set_background_error("flush", e)
+                return None
             raise
+        finally:
+            if path is not None:
+                with self._lock:
+                    self._writing.discard(path)
         if self.opts.auto_compact:
             self.maybe_schedule_compaction()
         return fid
@@ -573,7 +676,8 @@ class DB:
     def maybe_schedule_compaction(self) -> bool:
         """(ref: DBImpl::MaybeScheduleFlushOrCompaction db_impl.cc:2127)."""
         with self._lock:
-            if self._compacting or self._closed:
+            if self._compacting or self._closed or \
+                    self._bg_error is not None:
                 return False
             pick = compaction_mod.pick_universal(self.versions.live_files())
             if pick is None:
@@ -589,6 +693,20 @@ class DB:
         return True
 
     def _run_compaction(self, pick) -> None:
+        try:
+            self._run_compaction_inner(pick)
+        except BaseException as e:
+            from yugabyte_tpu.utils.status import StatusError
+            if not isinstance(e, (OSError, StatusError)):
+                raise
+            # Contained like a failed flush: the version set still points
+            # at the inputs (nothing installed), partial outputs are swept,
+            # and the DB parks read-only for the backoff retry.
+            with self._lock:
+                self._sweep_orphan_outputs_unlocked()
+            self._set_background_error("compaction", e)
+
+    def _run_compaction_inner(self, pick) -> None:
         try:
             inputs = [self._readers[fm.file_id] for fm in pick.inputs]
             cutoff = self.opts.retention_policy()

@@ -41,21 +41,40 @@ class VersionSet:
         self._lock = threading.Lock()
 
     # -- durability ---------------------------------------------------------
+    # Manifest bytes go through the process Env like every other storage
+    # file: encryption at rest covers the file catalog too, and the
+    # fault-injection env can drop manifest fsyncs — a crash then rolls the
+    # version set back in step with the SSTs it references (no frontier
+    # edit can outlive the flush data it describes).
     def recover(self) -> None:
+        from yugabyte_tpu.utils.env import get_env
         if not os.path.exists(self.manifest_path):
             return
-        with open(self.manifest_path) as f:
-            for line in f:
-                if not line.strip():
-                    continue
+        for line in get_env().read_file(self.manifest_path).splitlines():
+            if not line.strip():
+                continue
+            try:
                 edit = json.loads(line)
-                self._apply(edit, log=False)
+            except ValueError:
+                # torn tail: a crash mid-append left a partial edit — the
+                # prefix before it is a complete, consistent version (the
+                # WAL torn-tail rule applied to the metadata log)
+                break
+            self._apply(edit, log=False)
+
+    def _append_manifest(self, edits: List[dict]) -> None:
+        """One durable append batch of version edits (ref LogAndApply's
+        single manifest write per install)."""
+        from yugabyte_tpu.utils.env import get_env
+        f = get_env().open_append(self.manifest_path)
+        try:
+            f.append("".join(json.dumps(e) + "\n" for e in edits).encode())
+            f.flush(fsync=True)
+        finally:
+            f.close()
 
     def _log_edit(self, edit: dict) -> None:
-        with open(self.manifest_path, "a") as f:
-            f.write(json.dumps(edit) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        self._append_manifest([edit])
 
     def _apply(self, edit: dict, log: bool = True) -> None:
         kind = edit["kind"]
@@ -94,11 +113,7 @@ class VersionSet:
             edits += [{"kind": "add", "file_id": fid,
                        "path": os.path.relpath(path, self.db_dir),
                        "props": props.to_json()} for fid, path, props in added]
-            with open(self.manifest_path, "a") as f:
-                for e in edits:
-                    f.write(json.dumps(e) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
+            self._append_manifest(edits)
             for e in edits:
                 self._apply(e, log=False)
             self.compactions_installed += 1

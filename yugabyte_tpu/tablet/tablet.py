@@ -172,7 +172,7 @@ class Tablet:
         # intents' flushed frontier <= regular's for txn-apply ops whose
         # effects span both DBs. Bootstrap replays from the min frontier,
         # so OP_UPDATE_TXN re-derivation always sees live intents.
-        self.intents_db.pre_flush_hook = self.regular_db.flush
+        self.intents_db.pre_flush_hook = self._pre_intents_flush
         self.mvcc = MvccManager(self.clock)
         self.lock_manager = SharedLockManager()
         self.consensus = LocalConsensusContext(self)
@@ -198,6 +198,19 @@ class Tablet:
         self.metric_reads = entity.counter("ql_reads", "row reads served")
         self.metric_write_rejections = entity.counter(
             "write_rejections", "writes rejected by SST-file backpressure")
+
+    def _pre_intents_flush(self) -> None:
+        """Intents pre-flush hook. The regular flush contains I/O errors
+        by parking its DB (it returns None, it does not raise), so the
+        ordering invariant must be re-checked explicitly: if the regular
+        DB failed to persist, the intents flush MUST abort too — an
+        intents frontier ahead of the regular DB replays OP_UPDATE_TXN as
+        a no-op after restart and loses rows."""
+        from yugabyte_tpu.utils.status import StatusError
+        self.regular_db.flush()
+        err = self.regular_db.background_error
+        if err is not None:
+            raise StatusError(err)
 
     # ------------------------------------------------------------------ write
     def write(self, ops: Sequence[QLWriteOp], timeout_s: float = 10.0,

@@ -35,8 +35,17 @@ from yugabyte_tpu.consensus.raft import (
     OP_SNAPSHOT, OP_SPLIT, OP_UPDATE_TXN, OP_WRITE, NotLeader,
     OperationOutcomeUnknown, RaftConfig, RaftConsensus, ReplicateMsg,
     ReplicationTimedOut, Role)
+from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+
+# Tablet peer states (the reference's RaftGroupStatePB subset that matters
+# for failure containment, ref tablet/metadata.proto + tablet_peer.cc
+# state gating): RUNNING serves normally; FAILED rejects writes retryably
+# while reads drain, is reported via heartbeat so the master re-replicates,
+# and recovers via retry_background_work / re-bootstrap.
+STATE_RUNNING = "RUNNING"
+STATE_FAILED = "FAILED"
 
 
 def encode_write_batch(kv_items: Sequence[Tuple],
@@ -180,6 +189,14 @@ class TabletPeer:
         self._transport = transport
         self.tablet.consensus = RaftWriteContext(self)
         self.tablet.mvcc.set_leader_mode(False)
+        # Failure containment: a background error in either DB or a sealed
+        # WAL parks this peer in FAILED (ref tablet FAILED state,
+        # tablet.cc MarkTabletFailed).
+        self.state = STATE_RUNNING
+        self.failed_status: Optional[Status] = None
+        for db in (self.tablet.regular_db, self.tablet.intents_db):
+            db.on_background_error = self._on_storage_error
+        self.log.on_io_error = self._on_log_error
         # Split hook: the tablet manager creates the child tablets when the
         # SPLIT op applies (deterministically on every replica, including
         # WAL replay after restart — child creation is idempotent).
@@ -238,6 +255,49 @@ class TabletPeer:
         self._transport.register(self.raft.config.peer_id, self.raft)
         self.raft.start(election_timer=election_timer)
         return self
+
+    # ------------------------------------------------------ failure state
+    def _on_storage_error(self, status: Status) -> None:
+        self.mark_failed(status)
+
+    def _on_log_error(self, exc: Exception) -> None:
+        self.mark_failed(Status.IoError(
+            f"WAL append failed on {self.tablet_id}: {exc}"))
+
+    def mark_failed(self, status: Status) -> None:
+        """Transition to FAILED: writes reject retryably, reads drain, the
+        next heartbeat reports the state so the master can re-replicate."""
+        if self.state == STATE_FAILED:
+            return
+        self.state = STATE_FAILED
+        self.failed_status = status
+        TRACE("tablet %s FAILED: %s", self.tablet_id, status)
+
+    def _check_not_failed(self) -> None:
+        if self.state == STATE_FAILED:
+            err = StatusError(Status.ServiceUnavailable(
+                f"tablet {self.tablet_id} is in FAILED state "
+                f"({self.failed_status}); retry another replica"))
+            err.extra = {"tablet_failed": True}
+            raise err
+
+    def try_recover(self) -> bool:
+        """In-place recovery from DB background errors (driven by the
+        maintenance manager's capped-backoff retry). A sealed WAL cannot
+        recover in place — its torn tail needs the bootstrap replay rule —
+        so those peers wait for TSTabletManager.recover_failed_tablet.
+        Returns True when the peer is RUNNING again."""
+        if self.state != STATE_FAILED:
+            return True
+        if self.log.io_error is not None:
+            return False
+        for db in (self.tablet.regular_db, self.tablet.intents_db):
+            if not db.retry_background_work():
+                return False
+        self.state = STATE_RUNNING
+        self.failed_status = None
+        TRACE("tablet %s recovered from background error", self.tablet_id)
+        return True
 
     def _on_entry_appended(self, msg: ReplicateMsg) -> None:
         """Log-append hook (every replica, incl. recovery): pre-register the
@@ -393,11 +453,13 @@ class TabletPeer:
 
     def write(self, ops, timeout_s: float = 30.0,
               request=None) -> HybridTime:
+        self._check_not_failed()
         if not self.raft.is_leader():
             raise NotLeader(self.raft.leader_hint())
         return self.tablet.write(ops, timeout_s=timeout_s, request=request)
 
     def apply_external_batch(self, kvs, default_ht_value: int) -> HybridTime:
+        self._check_not_failed()
         if not self.raft.is_leader():
             raise NotLeader(self.raft.leader_hint())
         return self.tablet.apply_external_batch(kvs, default_ht_value)
@@ -405,6 +467,7 @@ class TabletPeer:
     def write_transactional(self, ops, txn_meta,
                             timeout_s: float = 30.0,
                             write_id_base: int = 0) -> HybridTime:
+        self._check_not_failed()
         if not self.raft.is_leader():
             raise NotLeader(self.raft.leader_hint())
         return self.tablet.write_transactional(ops, txn_meta,

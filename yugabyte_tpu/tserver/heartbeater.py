@@ -16,6 +16,7 @@ from typing import Callable, List, Optional
 from yugabyte_tpu.rpc.messenger import (
     Messenger, RemoteError, RpcTimeout, ServiceUnavailable)
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.backoff import RetrySchedule
 from yugabyte_tpu.utils.trace import TRACE
 
 flags.define_flag("heartbeat_interval_ms", 200,
@@ -80,9 +81,21 @@ class Heartbeater:
         return False
 
     def _loop(self) -> None:
-        while not self._stop.wait(
-                flags.get_flag("heartbeat_interval_ms") / 1000.0):
+        # While no master leader answers, the retry spacing grows with
+        # capped exponential backoff + jitter instead of every tserver
+        # hammering the dead master in lockstep at the heartbeat interval
+        # (ref heartbeater.cc consecutive_failed_heartbeats_ backoff).
+        interval_s = lambda: flags.get_flag("heartbeat_interval_ms") / 1000.0
+        retry = RetrySchedule(initial_s=interval_s(), max_s=2.0)
+        wait_s = interval_s()
+        while not self._stop.wait(wait_s):
             try:
-                self.heartbeat_now()
+                ok = self.heartbeat_now()
             except Exception as e:  # noqa: BLE001 — keep beating
                 TRACE("heartbeater %s: %r", self.server_id, e)
+                ok = False
+            if ok:
+                retry = RetrySchedule(initial_s=interval_s(), max_s=2.0)
+                wait_s = interval_s()
+            else:
+                wait_s = retry.record_failure()

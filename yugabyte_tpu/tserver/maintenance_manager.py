@@ -15,7 +15,10 @@ like the memory arbiter, rather than registered/unregistered on tablet
 open/close): FlushOp (memstore -> SST, releases RAM and WAL),
 LogGCOp (drops fully-flushed WAL segments; the only automatic WAL GC
 trigger in the server), CompactOp (kicks the compaction picker for
-tablets that went idle mid-backlog). External subsystems can register
+tablets that went idle mid-backlog), and RecoverOp — the capped-
+exponential-backoff retry that un-parks tablets in FAILED state after a
+background storage error (ref DBImpl::Resume driven by
+ErrorHandler::RecoverFromBGError). External subsystems can register
 custom MaintenanceOps through register_op().
 """
 
@@ -23,9 +26,10 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.backoff import RetrySchedule
 from yugabyte_tpu.utils.mem_tracker import root_tracker
 from yugabyte_tpu.utils.trace import TRACE
 
@@ -35,6 +39,11 @@ flags.define_flag("maintenance_manager_polling_interval_s", 0.25,
 flags.define_flag("log_target_replay_size_mb", 64,
                   "closed-WAL bytes per tablet above which log-releasing "
                   "ops take priority (ref log_target_replay_size_mb)")
+flags.define_flag("background_error_retry_initial_s", 0.5,
+                  "first-retry delay for a tablet parked by a background "
+                  "storage error; doubles per failure")
+flags.define_flag("background_error_retry_max_s", 30.0,
+                  "cap on the background-error retry delay")
 
 
 class MaintenanceOpStats:
@@ -120,12 +129,46 @@ class _CompactOp(MaintenanceOp):
             db.maybe_schedule_compaction()
 
 
+class _RecoverOp(MaintenanceOp):
+    """Un-park a FAILED tablet (ref ErrorHandler::RecoverFromBGError):
+    in-place retry of the parked flush/compaction via the tablet
+    manager's recover hook, paced by a per-tablet capped exponential
+    backoff so a persistently broken disk is not hammered every poll."""
+
+    # outranks every compaction-debt score: a FAILED tablet rejects writes
+    RECOVERY_SCORE = 1e9
+
+    def __init__(self, peer, schedule: RetrySchedule, recover_fn):
+        super().__init__(f"recover:{peer.tablet_id}")
+        self._peer = peer
+        self._schedule = schedule
+        self._recover_fn = recover_fn
+
+    def update_stats(self, stats: MaintenanceOpStats) -> None:
+        stats.runnable = self._schedule.ready()
+        stats.perf_improvement = self.RECOVERY_SCORE
+
+    def perform(self) -> None:
+        if self._recover_fn(self._peer):
+            self._schedule.reset()
+        else:
+            delay = self._schedule.record_failure()
+            TRACE("maintenance: recovery of %s failed; next attempt in "
+                  "%.2fs", self._peer.tablet_id, delay)
+
+
 class MaintenanceManager:
     """One per TabletServer (ref maintenance_manager.cc)."""
 
     def __init__(self, peers_fn: Callable[[], List], metric_entity=None,
-                 memory_pressure_fn: Optional[Callable[[], bool]] = None):
+                 memory_pressure_fn: Optional[Callable[[], bool]] = None,
+                 recover_fn: Optional[Callable[[object], bool]] = None):
         self._peers_fn = peers_fn
+        # recover_fn(peer) -> bool; default = the peer's in-place recovery
+        # (clears DB background errors). The tablet server passes the
+        # manager's recover_failed_tablet for full re-bootstrap coverage.
+        self._recover_fn = recover_fn or (lambda peer: peer.try_recover())
+        self._recover_backoff: Dict[str, RetrySchedule] = {}
         self._registered: List[MaintenanceOp] = []
         self._reg_lock = threading.Lock()
         self._stop = threading.Event()
@@ -162,9 +205,27 @@ class MaintenanceManager:
                 self._registered.remove(op)
 
     # ------------------------------------------------------------ scheduling
+    def _retry_schedule(self, tablet_id: str) -> RetrySchedule:
+        sched = self._recover_backoff.get(tablet_id)
+        if sched is None:
+            sched = self._recover_backoff[tablet_id] = RetrySchedule(
+                initial_s=flags.get_flag("background_error_retry_initial_s"),
+                max_s=flags.get_flag("background_error_retry_max_s"))
+        return sched
+
     def _candidate_ops(self) -> List[MaintenanceOp]:
+        from yugabyte_tpu.tablet.tablet_peer import STATE_FAILED
         ops: List[MaintenanceOp] = []
+        live_ids = set()
         for peer in self._peers_fn():
+            live_ids.add(peer.tablet_id)
+            if peer.state == STATE_FAILED:
+                # a parked tablet has nothing to flush/GC/compact — its
+                # only maintenance is the backoff-paced recovery retry
+                ops.append(_RecoverOp(peer,
+                                      self._retry_schedule(peer.tablet_id),
+                                      self._recover_fn))
+                continue
             # one WAL-directory scan per peer per round, shared by both
             # log-scoring ops (listdir+stat per op per poll would hammer
             # the Log lock on servers with many idle tablets)
@@ -179,6 +240,10 @@ class MaintenanceManager:
             ops.append(_FlushOp(peer, flush_releasable))
             ops.append(_LogGCOp(peer, freeable))
             ops.append(_CompactOp(peer))
+        # drop backoff state for tablets that went away (deleted / moved)
+        for tid in list(self._recover_backoff):
+            if tid not in live_ids:
+                del self._recover_backoff[tid]
         with self._reg_lock:
             ops.extend(self._registered)
         return ops

@@ -100,7 +100,11 @@ class TabletServer:
             MaintenanceManager)
         self.maintenance_manager = MaintenanceManager(
             peers_fn=self._tablet_peers,
-            metric_entity=self.metrics.entity("server", "maintenance"))
+            metric_entity=self.metrics.entity("server", "maintenance"),
+            # full recovery path: in-place background-error retry first,
+            # then re-bootstrap (sealed WAL) via the tablet manager
+            recover_fn=lambda peer: self.tablet_manager
+            .recover_failed_tablet(peer.tablet_id))
         self.webserver = None
         if opts.webserver_port is not None:
             from yugabyte_tpu.server.webserver import Webserver

@@ -319,6 +319,44 @@ class TSTabletManager:
         TRACE("ts %s: remote-bootstrapped tablet %s from %s",
               self.server_id, tablet_id, source_addr)
 
+    def recover_failed_tablet(self, tablet_id: str) -> bool:
+        """Bring a FAILED replica back: in-place first (clears DB
+        background errors and retries the parked flush), then — for
+        failures a live process cannot undo, like a sealed WAL with a torn
+        tail — a full re-bootstrap: shut the peer down and reopen it from
+        its on-disk state so the normal torn-tail replay + leader catch-up
+        rules apply (ref: the reference tombstones and re-bootstraps
+        failed replicas). Returns True when the replica is RUNNING."""
+        from yugabyte_tpu.tablet.tablet_peer import STATE_FAILED
+        peer = self.get_tablet(tablet_id)
+        if peer.state != STATE_FAILED:
+            return True
+        if peer.try_recover():
+            return True
+        if peer.log.io_error is None:
+            # a DB background error that STILL fails to clear means the
+            # disk is still bad — re-bootstrapping onto the same disk
+            # cannot help; stay parked and let the backoff retry again
+            return False
+        with self._create_lock:
+            with self._lock:
+                cur = self._tablets.get(tablet_id)
+                if cur is not peer:
+                    # replaced concurrently (another recovery / delete)
+                    return cur is not None and cur.state != STATE_FAILED
+                self._tablets.pop(tablet_id)
+                meta = self._meta.pop(tablet_id)
+            self.transport.unregister(peer.raft.config.peer_id)
+            try:
+                peer.shutdown()
+            except OSError as e:
+                TRACE("ts %s: shutdown of failed tablet %s raised: %s",
+                      self.server_id, tablet_id, e)
+            self._open_tablet(tablet_id, meta)
+        TRACE("ts %s: re-bootstrapped failed tablet %s", self.server_id,
+              tablet_id)
+        return True
+
     def delete_tablet(self, tablet_id: str) -> None:
         """ref TSTabletManager::DeleteTablet — shut down + remove data."""
         with self._lock:
@@ -410,6 +448,11 @@ class TSTabletManager:
             entry = {
                 "tablet_id": tablet_id,
                 "role": peer.raft.role.value,
+                # FAILED replicas are reported so the master's load
+                # balancer can re-replicate without waiting for the whole
+                # server to go silent (ref tablet reports carrying
+                # RaftGroupStatePB / tablet data state).
+                "state": peer.state,
                 "term": peer.raft.current_term,
                 "leader_ready": peer.raft.is_leader() and
                 peer.raft.leader_ready(),

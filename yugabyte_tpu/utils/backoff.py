@@ -1,0 +1,101 @@
+"""Retry pacing: capped exponential backoff with decorrelated jitter.
+
+Capability parity with the reference's retry waiters (ref:
+src/yb/util/backoff_waiter.h BackoffWaiter; rpc/rpc.cc
+RpcRetrier::DelayMillis adds jitter the same way): every retry loop in the
+stack — client master lookup, tablet-call replica walks, the heartbeater's
+master hunt, and the maintenance manager's background-error recovery —
+draws its sleeps from here instead of hard-coding a fixed interval.
+
+Two shapes:
+
+- `Backoff`: an iterator of delays for one bounded retry *attempt*
+  (deadline-aware; decorrelated jitter so a thundering herd of retriers
+  de-synchronizes: delay_n = uniform(base, prev * 3), clamped to cap).
+- `RetrySchedule`: open-ended pacing for a long-lived background retrier
+  (the maintenance manager's flush-recovery op): `ready()` gates the next
+  attempt, `record_failure()` doubles the spacing up to a cap,
+  `reset()` re-arms after success.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+__all__ = ["Backoff", "RetrySchedule"]
+
+
+class Backoff:
+    """Decorrelated-jitter delay source for one retry loop.
+
+    next_delay() never exceeds cap_s nor the remaining deadline;
+    sleep() performs the wait and returns False once the deadline is
+    exhausted (callers break their loop and surface the last error).
+    """
+
+    def __init__(self, base_s: float = 0.05, cap_s: float = 2.0,
+                 deadline_s: Optional[float] = None, rng=None):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self._prev = base_s
+        self._deadline = (None if deadline_s is None
+                          else time.monotonic() + deadline_s)
+        self._rng = rng if rng is not None else random
+        self.attempts = 0
+
+    @property
+    def expired(self) -> bool:
+        return (self._deadline is not None
+                and time.monotonic() >= self._deadline)
+
+    def next_delay(self) -> float:
+        """Draw the next delay (decorrelated jitter), deadline-clamped."""
+        self.attempts += 1
+        d = min(self.cap_s, self._rng.uniform(self.base_s, self._prev * 3))
+        self._prev = d
+        if self._deadline is not None:
+            d = min(d, max(0.0, self._deadline - time.monotonic()))
+        return d
+
+    def sleep(self) -> bool:
+        """Sleep for the next delay; False when the deadline is spent
+        (no sleep happens in that case)."""
+        if self.expired:
+            return False
+        time.sleep(self.next_delay())
+        return not self.expired
+
+
+class RetrySchedule:
+    """Open-ended capped-exponential pacing for a background retrier.
+
+    Unlike Backoff (one bounded loop), this survives across scheduler
+    polls: the maintenance manager asks ready() each round, performs the
+    recovery attempt when it fires, and records the outcome."""
+
+    def __init__(self, initial_s: float = 0.5, max_s: float = 30.0,
+                 rng=None):
+        self.initial_s = initial_s
+        self.max_s = max_s
+        self._rng = rng if rng is not None else random
+        self.failures = 0
+        self._next_attempt = 0.0  # monotonic time; 0 = immediately ready
+
+    def ready(self) -> bool:
+        return time.monotonic() >= self._next_attempt
+
+    def record_failure(self) -> float:
+        """Push the next attempt out by initial * 2^n (capped), with a
+        +-25% jitter so many parked tablets don't retry in lockstep.
+        Returns the chosen delay."""
+        delay = min(self.max_s, self.initial_s * (2 ** self.failures))
+        delay *= self._rng.uniform(0.75, 1.25)
+        self.failures += 1
+        self._next_attempt = time.monotonic() + delay
+        return delay
+
+    def reset(self) -> None:
+        self.failures = 0
+        self._next_attempt = 0.0

@@ -44,8 +44,15 @@ def pg_timestamp_micros(text: str) -> int:
     """'YYYY-MM-DD[ HH:MM[:SS[.ffffff]]][+HH[:MM]]' -> epoch micros.
     Timezone-less input is read as UTC (the session default; the reference
     stores timestamptz normalized to UTC, ref src/postgres timestamptz_in)."""
+    import re
+    text = text.strip()
+    # Python < 3.11 fromisoformat accepts only 3- or 6-digit fractional
+    # seconds while PG accepts 1-6 ('12:00:00.25'): zero-pad to 6 first.
+    m = re.match(r"^(.*[T ]\d{2}:\d{2}:\d{2})\.(\d{1,6})(.*)$", text)
+    if m:
+        text = f"{m.group(1)}.{m.group(2).ljust(6, '0')}{m.group(3)}"
     try:
-        dt = datetime.datetime.fromisoformat(text.strip())
+        dt = datetime.datetime.fromisoformat(text)
     except ValueError:
         raise PgError(Status.InvalidArgument(
             f'invalid input syntax for type timestamp: "{text}"'), "22007")
