@@ -213,8 +213,12 @@ def test_observability_endpoints(tmp_path):
         for e in rpcz["inbound_recent"]:
             assert {"svc", "mth", "duration_ms", "peer"} <= set(e)
         tz = get("/tracez")
+        # flat span ring + spans grouped by trace_id (per-hop view)
         assert any(t["name"] == "test-op" and "step one" in t["dump"]
-                   for t in tz)
+                   for t in tz["spans"])
+        assert all("trace_id" in t and "span_id" in t for t in tz["spans"])
+        assert any(g["n_spans"] >= 1 and g["spans"]
+                   for g in tz["traces"])
         th = get("/threadz")
         assert any("webserver" in t["name"] for t in th)
         assert all("stack" in t for t in th)

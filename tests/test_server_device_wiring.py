@@ -91,11 +91,15 @@ def test_server_shares_pool_and_device_cache(tmp_path, small_memstore):
                 compacted = True
         assert compacted, "no background compaction ran via the shared pool"
 
-        # Metrics exposure: queue depth + cache hit gauges.
+        # Metrics exposure: queue depth gauge (per-server registry) +
+        # cache hit counters (process ROOT_REGISTRY; the webserver merges
+        # both into one exposition).
+        from yugabyte_tpu.utils.metrics import (ROOT_REGISTRY,
+                                                registries_to_prometheus)
         ctx.refresh_metrics()
-        prom = ts.metrics.to_prometheus()
+        prom = registries_to_prometheus([ts.metrics, ROOT_REGISTRY])
         assert "compaction_pool_queue_depth" in prom
-        assert "device_cache_hits" in prom
+        assert "device_cache_hits_total" in prom
 
         # Data is intact after background compactions.
         row = client.read_row(table, DocKey(hash_components=("user0007",)))

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Lint: metric names stay snake_case with a unit suffix.
+
+The observability layer exposes every metric over /prometheus-metrics; a
+scrapeable namespace needs consistent naming (the same discipline the
+reference enforces with METRIC_DEFINE macros). Rules, checked on every
+literal first argument of `.counter(...)` / `.gauge(...)` /
+`.histogram(...)` under yugabyte_tpu/:
+
+  - snake_case: ^[a-z][a-z0-9_]*$
+  - counters end `_total`
+  - histograms end in a unit: `_ms` / `_us` / `_bytes` / `_rows`
+  - gauges end in a unit or count suffix:
+    `_ms` / `_us` / `_bytes` / `_rows` / `_total` / `_ratio` / `_depth`
+    / `_count`
+
+Dynamically built names (f-strings, concatenation) are skipped — the
+helper sites that use them (utils/metrics.record_kernel_dispatch,
+mem_tracker per-tracker gauges) append conforming suffixes to a fixed
+family prefix. A line may carry `# lint: metric-name-ok` to waive.
+
+Run as a script (exit 1 on offense) or via check_paths() from the tier-1
+test that wires this into CI (tests/test_observability.py), the same way
+tools/lint_swallowed_errors.py is wired.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Tuple
+
+DEFAULT_DIRS = ("yugabyte_tpu",)
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_UNIT = ("_ms", "_us", "_bytes", "_rows")
+_SUFFIXES = {
+    "counter": ("_total",),
+    "histogram": _UNIT,
+    "gauge": _UNIT + ("_total", "_ratio", "_depth", "_count"),
+}
+_WAIVER = "lint: metric-name-ok"
+
+
+def check_file(path: str) -> List[Tuple[str, int, str]]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"unparseable: {e.msg}")]
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f_ = node.func
+        kind = f_.attr if isinstance(f_, ast.Attribute) else None
+        if kind not in _SUFFIXES or not node.args:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            continue  # dynamic name: see module docstring
+        name = arg.value
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _WAIVER in line:
+            continue
+        if not _SNAKE.match(name):
+            out.append((path, node.lineno,
+                        f"{kind} {name!r}: not snake_case"))
+            continue
+        suffixes = _SUFFIXES[kind]
+        if not name.endswith(suffixes):
+            out.append((path, node.lineno,
+                        f"{kind} {name!r}: missing unit suffix "
+                        f"(one of {', '.join(suffixes)})"))
+    return out
+
+
+def check_paths(root: str, dirs=DEFAULT_DIRS) -> List[Tuple[str, int, str]]:
+    offenses = []
+    for d in dirs:
+        base = os.path.join(root, d)
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    offenses.extend(check_file(os.path.join(dirpath, fn)))
+    return offenses
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    offenses = check_paths(root)
+    for path, lineno, msg in offenses:
+        print(f"{os.path.relpath(path, root)}:{lineno}: {msg}")
+    if offenses:
+        print(f"{len(offenses)} metric-name offense(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,7 @@ from yugabyte_tpu.rpc.messenger import (
 from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.backoff import Backoff
 from yugabyte_tpu.utils.status import Code, Status, StatusError
-from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils.trace import TRACE, Trace
 
 flags.define_flag("client_rpc_retries", 12,
                   "per-operation retry budget (leader changes, restarts)")
@@ -95,9 +95,16 @@ class YBClient:
             a for a in self._master_addrs if a != self._master_leader]
         last_err: Optional[Exception] = None
         backoff = Backoff(base_s=0.1, cap_s=1.0)
+        with Trace(f"client.master.{mth}"):
+            return self._master_call_traced(mth, _retry_ctx, _timeout_s,
+                                            addrs, last_err, backoff, args)
+
+    def _master_call_traced(self, mth, _retry_ctx, _timeout_s, addrs,
+                            last_err, backoff, args):
         for _ in range(flags.get_flag("client_rpc_retries")):
             for addr in list(addrs):
                 try:
+                    TRACE("client: master %s at %s", mth, addr)
                     ret = self._messenger.call(addr, MASTER_SERVICE, mth,
                                                timeout_s=_timeout_s, **args)
                     self._master_leader = addr
@@ -305,9 +312,22 @@ class YBClient:
             refresh_key = tablet.partition.start
         last_err: Optional[Exception] = None
         backoff = Backoff(base_s=0.05, cap_s=1.0)
+        # Root span of the distributed trace: the messenger stamps this
+        # span's context on every attempt's wire header, so the tserver
+        # handler (and the raft fan-out under it) stitches to one
+        # trace_id. Nested calls (retries, split re-routes) inherit.
+        with Trace(f"client.{mth}"):
+            return self._tablet_call_traced(table, tablet, mth,
+                                            refresh_key, last_err,
+                                            backoff, args)
+
+    def _tablet_call_traced(self, table, tablet, mth, refresh_key,
+                            last_err, backoff, args):
         for attempt in range(flags.get_flag("client_rpc_retries")):
             for addr in tablet.candidate_addrs():
                 try:
+                    TRACE("client: %s tablet %s at %s (attempt %d)",
+                          mth, tablet.tablet_id, addr, attempt)
                     return self._messenger.call(
                         addr, TABLET_SERVICE, mth,
                         tablet_id=tablet.tablet_id, **args)

@@ -31,13 +31,30 @@ from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 from yugabyte_tpu.utils import flags
-from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+from yugabyte_tpu.utils.trace import TRACE, LongOperationTracker
 
 flags.define_flag("log_segment_size_bytes", 64 * 1024 * 1024,
                   "roll the WAL segment after it exceeds this size "
                   "(ref log_segment_size_mb)")
 flags.define_flag("durable_wal_write", True,
                   "fsync WAL batches (ref durable_wal_write)")
+flags.define_flag("wal_slow_fsync_threshold_ms", 500.0,
+                  "a WAL group-commit fsync slower than this dumps its "
+                  "trace to /tracez (ref long_fsync_threshold_ms)")
+
+
+def _wal_metrics():
+    """Process-wide WAL tier metrics (one appender thread per Log; the
+    entity aggregates across tablets like the reference's server-level
+    log_append_latency)."""
+    e = ROOT_REGISTRY.entity("server", "wal")
+    return (e.histogram("wal_append_duration_ms",
+                        "WAL group-commit batch encode+write wall time"),
+            e.histogram("wal_fsync_duration_ms",
+                        "WAL group-commit fsync wall time"),
+            e.counter("wal_group_commits_total",
+                      "WAL group-commit batches written"))
 
 _HEADER = struct.Struct("<IIQQ")  # crc, payload_len, term, index
 
@@ -234,9 +251,12 @@ class Log:
                     self._cv.notify_all()
 
     def _write_batch(self, batch) -> None:
+        import time as _time
+        h_append, h_fsync, c_commits = _wal_metrics()
         err = self._io_error
         if err is None:
             try:
+                t0 = _time.monotonic()
                 files_to_sync = set()
                 for entries, _cb in batch:
                     for e in entries:
@@ -246,8 +266,18 @@ class Log:
                         self._file_size += len(rec)
                         self._last_op_id = e.op_id
                     files_to_sync.add(self._file)
-                for f in files_to_sync:
-                    f.flush(fsync=bool(flags.get_flag("durable_wal_write")))
+                t1 = _time.monotonic()
+                h_append.increment((t1 - t0) * 1e3)
+                # a slow fsync dumps its trace (LongOperationTracker armed
+                # on the WAL durability path, ref read_query.cc:500 usage)
+                with LongOperationTracker(
+                        "wal.fsync",
+                        flags.get_flag("wal_slow_fsync_threshold_ms")):
+                    for f in files_to_sync:
+                        f.flush(fsync=bool(
+                            flags.get_flag("durable_wal_write")))
+                h_fsync.increment((_time.monotonic() - t1) * 1e3)
+                c_commits.increment()
             except OSError as exc:
                 err = exc
                 self._fail(exc)

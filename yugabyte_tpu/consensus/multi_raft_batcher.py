@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from yugabyte_tpu.consensus.transport import PeerUnreachable
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
 
 flags.define_flag("multi_raft_batch_window_ms", 3,
                   "consensus heartbeats to one destination server within "
@@ -55,9 +56,18 @@ class MultiRaftBatcher:
         self._queues: Dict[str, List[Tuple[str, dict, _Slot]]] = {}
         self._timers: Dict[str, threading.Timer] = {}
         self._stopped = False
-        # observability: how many heartbeats rode how many RPCs
+        # observability: how many heartbeats rode how many RPCs. The ints
+        # are per-batcher (tests diff them per server); the registry
+        # counters aggregate process-wide for scraping.
         self.heartbeats_in = 0
         self.batches_out = 0
+        e = ROOT_REGISTRY.entity("server", "multi_raft")
+        self._c_heartbeats = e.counter(
+            "multi_raft_heartbeats_total",
+            "consensus heartbeats submitted to the batcher")
+        self._c_batches = e.counter(
+            "multi_raft_batches_total",
+            "batched multi_update_consensus RPCs sent")
 
     def stop(self) -> None:
         with self._lock:
@@ -91,6 +101,7 @@ class MultiRaftBatcher:
             q = self._queues.setdefault(addr, [])
             q.append((dst_peer, wire_req, slot))
             self.heartbeats_in += 1
+            self._c_heartbeats.increment()
             if len(q) >= flags.get_flag("multi_raft_batch_max"):
                 flush_now = True
             elif addr not in self._timers:
@@ -116,6 +127,7 @@ class MultiRaftBatcher:
         if not batch:
             return
         self.batches_out += 1
+        self._c_batches.increment()
         try:
             resps = self._send_batch(addr, [(d, r) for d, r, _s in batch])
             if len(resps) != len(batch):

@@ -38,7 +38,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from yugabyte_tpu.consensus.log import Log, LogEntry
 from yugabyte_tpu.consensus.transport import PeerUnreachable
 from yugabyte_tpu.utils import flags
-from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+from yugabyte_tpu.utils.trace import (TRACE, LongOperationTracker, Trace,
+                                      current_trace_context)
 
 flags.define_flag("raft_heartbeat_interval_ms", 50,
                   "leader heartbeat period (ref raft_heartbeat_interval_ms)")
@@ -50,6 +52,18 @@ flags.define_flag("ht_lease_duration_ms", 2000,
 flags.define_flag("consensus_max_batch_size_entries", 256,
                   "max entries per AppendEntries request "
                   "(ref consensus_max_batch_size_bytes)")
+flags.define_flag("raft_slow_replicate_threshold_ms", 1000.0,
+                  "a leader replicate (append -> commit+apply) slower "
+                  "than this dumps its stitched trace to /tracez")
+
+
+def _consensus_metrics():
+    e = ROOT_REGISTRY.entity("server", "consensus")
+    return (e.histogram("raft_replicate_duration_ms",
+                        "leader replicate round-trip: local append to "
+                        "commit + local apply"),
+            e.histogram("raft_append_entries_rpc_duration_ms",
+                        "one AppendEntries exchange with a peer"))
 
 OpId = Tuple[int, int]
 
@@ -139,6 +153,10 @@ class AppendEntriesReq:
     committed_index: int
     propagated_safe_time: int
     lease_duration_s: float
+    # span context of the write that produced the first traced entry in
+    # this batch, carried so the peer's handler span stitches under the
+    # originating request's trace_id (None: heartbeat / untraced write)
+    trace_ctx: Optional[dict] = None
 
 
 @dataclass(frozen=True)
@@ -291,6 +309,12 @@ class RaftConsensus:
         # "no constraint" let a restarted follower's safe time run ahead
         # of its data (caught by the linked-list churn harness)
         self._ht_by_index: Dict[int, int] = {}
+        # index -> originating span context for traced writes, so the
+        # AppendEntries carrying that entry propagates the trace to peers;
+        # trimmed aggressively (entries replicate within one heartbeat in
+        # the common case) — a missing ctx only drops propagation, never
+        # correctness
+        self._trace_ctx_by_index: Dict[int, dict] = {}
         self._last_index = 0
         self._last_term = 0
         self._local_durable_index = 0
@@ -681,10 +705,28 @@ class RaftConsensus:
                   timeout_s: float = 30.0) -> OpId:
         """Leader: append + replicate + wait for commit AND local apply
         (ref raft_consensus.cc:1140 ReplicateBatch)."""
+        t0 = time.monotonic()
+        try:
+            with LongOperationTracker(
+                    "raft.replicate",
+                    flags.get_flag("raft_slow_replicate_threshold_ms")):
+                return self._replicate_inner(op_type, ht_value, payload,
+                                             timeout_s)
+        finally:
+            _consensus_metrics()[0].increment(
+                (time.monotonic() - t0) * 1e3)
+
+    def _replicate_inner(self, op_type: int, ht_value: int, payload: bytes,
+                         timeout_s: float) -> OpId:
+        ctx = current_trace_context()
         with self._lock:
             if self.role != Role.LEADER:
                 raise NotLeader(self.leader_id)
             msg = self._append_unlocked(op_type, ht_value, payload)
+            if ctx is not None:
+                self._trace_ctx_by_index[msg.index] = ctx
+        TRACE("raft %s: replicating op %s (%d bytes)",
+              self.config.peer_id, msg.op_id, len(payload))
         from yugabyte_tpu.utils import sync_point
         sync_point.hit("raft.replicate:after_local_append")
         for ev in self._peer_events.values():
@@ -807,6 +849,13 @@ class RaftConsensus:
                 for i in list(self._ht_by_index):
                     if i < floor:
                         del self._ht_by_index[i]
+        if len(self._trace_ctx_by_index) > 512:
+            # span contexts matter only while the entry is still being
+            # replicated; anything at/below last_applied has finished its
+            # fan-out (or will re-send untraced — propagation is advisory)
+            for i in list(self._trace_ctx_by_index):
+                if i <= self.last_applied:
+                    del self._trace_ctx_by_index[i]
         if len(self._entries) <= self._CACHE_HIGH_WATER:
             return
         floor = self.last_applied - self._CACHE_TAIL
@@ -874,10 +923,31 @@ class RaftConsensus:
                     req, sent_up_to = self._build_request_unlocked(peer)
                     send_time = time.monotonic()
                 try:
-                    resp = self.transport.update_consensus(
-                        self.config.peer_id, peer, req)
+                    if req.trace_ctx is not None:
+                        # per-hop span on the LEADER for the replication
+                        # RPC: adopts the originating write's context, so
+                        # the messenger stamps the same trace_id on the
+                        # wire and /tracez here shows the raft hop
+                        with Trace.from_wire_context(
+                                req.trace_ctx,
+                                f"raft.append_entries:{peer}"):
+                            TRACE("AppendEntries -> %s: %d entries, "
+                                  "commit %d", peer, len(req.entries),
+                                  req.committed_index)
+                            resp = self.transport.update_consensus(
+                                self.config.peer_id, peer, req)
+                            TRACE("AppendEntries <- %s: success=%s "
+                                  "last_received=%d", peer, resp.success,
+                                  resp.last_received_index)
+                    else:
+                        resp = self.transport.update_consensus(
+                            self.config.peer_id, peer, req)
                 except PeerUnreachable:
                     continue
+                finally:
+                    if req.entries:
+                        _consensus_metrics()[1].increment(
+                            (time.monotonic() - send_time) * 1e3)
                 self._process_peer_response(peer, epoch, resp, send_time,
                                             sent_up_to)
             except Exception as e:  # noqa: BLE001 — a single bad exchange
@@ -946,13 +1016,21 @@ class RaftConsensus:
                 if unsent_min:
                     safe = min(safe, unsent_min - 1)
         lease_s = flags.get_flag("ht_lease_duration_ms") / 1000.0
+        # propagate the originating write's span to the peer: first traced
+        # entry in the batch wins (one ctx per RPC keeps the header small)
+        trace_ctx = None
+        for e in entries:
+            trace_ctx = self._trace_ctx_by_index.get(e.index)
+            if trace_ctx is not None:
+                break
         return AppendEntriesReq(
             term=self._meta.term, leader_id=self.config.peer_id,
             preceding_term=preceding_term, preceding_index=preceding,
             entries=tuple(entries),
             committed_index=min(self.commit_index, sent_up_to),
             propagated_safe_time=safe,
-            lease_duration_s=lease_s), sent_up_to
+            lease_duration_s=lease_s,
+            trace_ctx=trace_ctx), sent_up_to
 
     def _reload_from_wal_unlocked(self, idx: int) -> ReplicateMsg:
         from yugabyte_tpu.consensus.log import LogReader
@@ -1122,6 +1200,7 @@ class RaftConsensus:
                     for i in range(msg.index, self._last_index + 1):
                         self._entries.pop(i, None)
                         self._ht_by_index.pop(i, None)
+                        self._trace_ctx_by_index.pop(i, None)
                     self.log.truncate_after(msg.index - 1)
                     self._last_index = msg.index - 1
                     self._last_term = self._term_at_unlocked(self._last_index)
@@ -1153,6 +1232,9 @@ class RaftConsensus:
                 # toward majority once we respond.
                 self.log.append_sync([m.to_log_entry() for m in to_append])
                 self._local_durable_index = self._last_index
+                TRACE("raft %s: appended %d entries from %s through %s",
+                      me, len(to_append), req.leader_id,
+                      to_append[-1].op_id)
             new_commit = min(req.committed_index, self._last_index)
             if new_commit > self.commit_index:
                 self._set_commit_index_unlocked(new_commit)

@@ -104,7 +104,16 @@ class LocalTransport:
 
     # ------------------------------------------------------------ dispatch
     def update_consensus(self, src: str, dst: str, request):
-        return self._check_link(src, dst).handle_update(request)
+        peer = self._check_link(src, dst)
+        ctx = getattr(request, "trace_ctx", None)
+        if ctx is not None:
+            # mirror the RPC path's inbound adoption: the in-process hop
+            # still produces a per-peer handler span under the same
+            # trace_id, so LocalTransport clusters trace like real ones
+            from yugabyte_tpu.utils.trace import Trace
+            with Trace.from_wire_context(ctx, f"consensus.update:{dst}"):
+                return peer.handle_update(request)
+        return peer.handle_update(request)
 
     def request_vote(self, src: str, dst: str, request):
         return self._check_link(src, dst).handle_vote_request(request)

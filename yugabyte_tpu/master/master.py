@@ -252,7 +252,7 @@ class Master:
                 if self.catalog.is_leader() else [])
             from yugabyte_tpu.utils import trace as trace_mod
             self.webserver.register_json("/rpcz", self.messenger.rpcz)
-            self.webserver.register_json("/tracez", trace_mod.tracez)
+            self.webserver.register_json("/tracez", trace_mod.tracez_page)
             self.webserver.register_json("/threadz", trace_mod.threadz)
 
     def _status_page(self) -> dict:

@@ -790,6 +790,11 @@ def _pick_impl(staged: StagedRuns) -> str:
 _pallas_broken = False  # set on the first Mosaic lowering/runtime failure
 
 
+def _fallback_counter(name: str, help: str):
+    from yugabyte_tpu.utils.metrics import kernel_metrics
+    return kernel_metrics().counter(name, help)
+
+
 class _PallasFallbackHandle:
     """Wraps a pallas launch so a lazy compile/runtime failure (surfacing
     at .result()) degrades to the jnp network instead of killing the
@@ -810,6 +815,10 @@ class _PallasFallbackHandle:
         except Exception as e:  # noqa: BLE001 — lowering/launch failure
             import sys as _sys
             _pallas_broken = True
+            _fallback_counter(
+                "kernel_pallas_fallback_total",
+                "pallas merge failures degraded to the jnp "
+                "network").increment()
             print(f"[run_merge] pallas kernel failed at result() — "
                   f"falling back to the jnp network for this process: "
                   f"{e!r}", file=_sys.stderr, flush=True)
@@ -831,6 +840,9 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot: bool = False,
                     host_async: bool = True) -> MergeGCHandle:
     global _pallas_broken
+    from yugabyte_tpu.utils.metrics import (kernel_metrics,
+                                            record_kernel_dispatch)
+    record_kernel_dispatch("kernel_run_merge", staged.n, staged.n_pad)
     target = _chunk_target_rows()
     if (target and staged.k_pad >= 2 and staged.n_pad > target
             and staged.m >= 512):
@@ -838,6 +850,10 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
         # already-compiled bucket executable (see _launch_chunked)
         h = _launch_chunked(staged, params, snapshot, target)
         if h is not None:
+            kernel_metrics().counter(
+                "kernel_chunked_launch_total",
+                "merge jobs split into route-partitioned chunk "
+                "launches").increment()
             return h
     explicit = os.environ.get("YBTPU_MERGE_IMPL", "auto") == "pallas"
     if (not _pallas_broken or explicit) and _pick_impl(staged) == "pallas":
@@ -851,12 +867,22 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
                 raise
             import sys as _sys
             _pallas_broken = True
+            _fallback_counter(
+                "kernel_pallas_fallback_total",
+                "pallas merge failures degraded to the jnp "
+                "network").increment()
             print(f"[run_merge] pallas kernel failed to launch — using "
                   f"the jnp network for this process: {e!r}",
                   file=_sys.stderr, flush=True)
         else:
+            kernel_metrics().counter(
+                "kernel_pallas_merge_total",
+                "merges launched on the pallas kernel").increment()
             return h if explicit else _PallasFallbackHandle(
                 h, staged, params, snapshot)
+    kernel_metrics().counter(
+        "kernel_network_merge_total",
+        "merges launched on the jnp bitonic network").increment()
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
     # runtime iota operand: see merge_network's pos docstring (compile-
@@ -887,6 +913,8 @@ def merge_and_gc_runs(slabs: Sequence[KVSlab], params: GCParams, device=None,
     single bucket) falls back to the radix kernel.
     """
     import os as _os
+    import time as _time
+    from yugabyte_tpu.utils.metrics import kernel_metrics
     if staged is None:
         live = [s for s in slabs if s.n]
         if not live:
@@ -898,13 +926,23 @@ def merge_and_gc_runs(slabs: Sequence[KVSlab], params: GCParams, device=None,
                 not in ("", "0", "false")):
             from yugabyte_tpu.ops.merge_gc import merge_and_gc_device
             from yugabyte_tpu.ops.slabs import concat_slabs
+            kernel_metrics().counter(
+                "kernel_radix_fallback_total",
+                "run-merges routed to the radix re-sort (skewed run "
+                "layout or forced)").increment()
             merged = concat_slabs(live)
             perm, keep, mk = merge_and_gc_device(merged, params,
                                                  device=device)
             real = perm < merged.n
             return perm[real].astype(np.int64), keep[real], mk[real]
         staged = stage_runs_from_slabs(live, device)
-    return launch_merge_gc(staged, params, snapshot=snapshot).result()
+    t0 = _time.monotonic()
+    out = launch_merge_gc(staged, params, snapshot=snapshot).result()
+    kernel_metrics().histogram(
+        "kernel_run_merge_duration_ms",
+        "run-merge launch-to-decisions wall time").increment(
+        (_time.monotonic() - t0) * 1e3)
+    return out
 
 
 def run_layout_inflation(run_ns: Sequence[int]) -> float:

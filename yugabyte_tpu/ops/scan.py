@@ -98,11 +98,14 @@ def scan_visible(staged: StagedCols, read_ht_value: int,
     perm[i] of the staged input survives iff keep[i]; surviving entries are
     exactly the versions visible at read_ht within [lower_key, upper_key).
     """
+    import time as _time
+    from yugabyte_tpu.utils.metrics import record_kernel_dispatch
     w_bytes_cap = staged.w  # key words available
     lo_w, lo_l = _pack_bound(lower_key, w_bytes_cap)
     hi_w, hi_l = _pack_bound(upper_key, w_bytes_cap)
     cutoff = read_ht_value
     cutoff_phys = cutoff >> 12
+    t0 = _time.monotonic()
     perm, keep_p = _scan_fused(
         staged.cols_dev, jnp.asarray(staged.sort_rows), jnp.int32(staged.n_sort),
         jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
@@ -113,6 +116,10 @@ def scan_visible(staged: StagedCols, read_ht_value: int,
     perm = np.asarray(perm)
     keep = merge_gc._unpack_bits(np.asarray(keep_p), staged.n_pad)
     keep = keep & (perm < staged.n)
+    # the np.asarray transfers block, so the wall time covers compute +
+    # keep-mask download
+    record_kernel_dispatch("kernel_scan", staged.n, staged.n_pad,
+                           (_time.monotonic() - t0) * 1e3)
     return perm, keep
 
 

@@ -169,6 +169,9 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
     sorted order (shard s holds keys <= shard s+1's); src_idx[i] is the
     input slab row that produced merged position i (valid where keep/mk
     apply — padding positions carry sentinel indices and keep=False)."""
+    import time as _time
+    from yugabyte_tpu.utils.metrics import record_kernel_dispatch
+    t0 = _time.monotonic()
     n_shards = mesh.devices.size
     cols = pack_cols(slab)[0]
     # pad the column count to a multiple of shards (pack_cols gives powers
@@ -192,5 +195,8 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
         if capacity_factor >= 64:
             raise RuntimeError("distributed compaction bucket overflow at 64x")
         return distributed_compact(slab, params, mesh, axis, capacity_factor * 2)
-    return (np.asarray(out), np.asarray(keep), np.asarray(mk),
-            np.asarray(src_idx).astype(np.int64))
+    result = (np.asarray(out), np.asarray(keep), np.asarray(mk),
+              np.asarray(src_idx).astype(np.int64))
+    record_kernel_dispatch("kernel_dist_compact", slab.n, cols.shape[1],
+                           (_time.monotonic() - t0) * 1e3)
+    return result

@@ -134,6 +134,34 @@ def loads(buf: bytes) -> Any:
     return obj
 
 
+# ------------------------------------------------------------ trace header
+# Distributed trace propagation (the reference's RPC header carries an
+# optional trace id the same way, ref: rpc/rpc_header.proto trace fields):
+# request messages carry an optional TRACE_HEADER_KEY entry holding the
+# caller's span context. Absent header = untraced caller (old peer) — the
+# decode side tolerates it, so the wire stays backward compatible.
+
+TRACE_HEADER_KEY = "trace"
+
+
+def trace_to_wire(ctx: Any) -> Any:
+    """Normalize a span context dict for the wire; None when untraced."""
+    if not isinstance(ctx, dict) or not ctx.get("trace_id"):
+        return None
+    return {"trace_id": str(ctx["trace_id"]),
+            "span_id": str(ctx.get("span_id") or ""),
+            "sampled": bool(ctx.get("sampled", True))}
+
+
+def trace_from_wire(wire: Any) -> Any:
+    """Inverse of trace_to_wire; tolerates absent/malformed headers."""
+    if not isinstance(wire, dict) or not wire.get("trace_id"):
+        return None
+    return {"trace_id": str(wire["trace_id"]),
+            "span_id": str(wire.get("span_id") or ""),
+            "sampled": bool(wire.get("sampled", True))}
+
+
 # ---------------------------------------------------------------- sidecars
 # Bulk bytes values ride OUTSIDE the tagged payload as separate segments —
 # the reference's RPC sidecars (ref: src/yb/rpc/rpc_context.h AddRpcSidecar,

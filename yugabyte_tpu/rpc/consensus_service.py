@@ -37,7 +37,7 @@ def _msg_from_wire(w: list) -> ReplicateMsg:
 
 
 def append_req_to_wire(req: AppendEntriesReq) -> dict:
-    return {
+    w = {
         "term": req.term, "leader_id": req.leader_id,
         "preceding_term": req.preceding_term,
         "preceding_index": req.preceding_index,
@@ -46,6 +46,9 @@ def append_req_to_wire(req: AppendEntriesReq) -> dict:
         "propagated_safe_time": req.propagated_safe_time,
         "lease_duration_s": req.lease_duration_s,
     }
+    if req.trace_ctx is not None:
+        w["trace_ctx"] = req.trace_ctx
+    return w
 
 
 def append_req_from_wire(w: dict) -> AppendEntriesReq:
@@ -56,7 +59,8 @@ def append_req_from_wire(w: dict) -> AppendEntriesReq:
         entries=tuple(_msg_from_wire(m) for m in w["entries"]),
         committed_index=w["committed_index"],
         propagated_safe_time=w["propagated_safe_time"],
-        lease_duration_s=w["lease_duration_s"])
+        lease_duration_s=w["lease_duration_s"],
+        trace_ctx=w.get("trace_ctx"))  # absent from old peers: untraced
 
 
 class ConsensusService:

@@ -27,10 +27,12 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from yugabyte_tpu.rpc.codec import dumps, loads
+from yugabyte_tpu.rpc.codec import (TRACE_HEADER_KEY, dumps, loads,
+                                    trace_from_wire, trace_to_wire)
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.metrics import ROOT_REGISTRY, MetricRegistry
 from yugabyte_tpu.utils.status import Code, Status, StatusError
-from yugabyte_tpu.utils.trace import TRACE, Trace
+from yugabyte_tpu.utils.trace import TRACE, Trace, current_trace_context
 
 flags.define_flag("rpc_use_tls", False,
                   "mutual TLS on every RPC connection (ref "
@@ -368,7 +370,8 @@ class _ClientConnection:
             for w in waiters:
                 w["event"].set()
 
-    def call(self, svc: str, mth: str, args: dict, timeout_s: float) -> dict:
+    def call(self, svc: str, mth: str, args: dict, timeout_s: float,
+             trace_ctx: Optional[dict] = None) -> dict:
         with self.lock:
             if self.dead is not None:
                 raise ServiceUnavailable(f"{self.addr}: {self.dead}")
@@ -378,6 +381,10 @@ class _ClientConnection:
             self.pending[call_id] = waiter
         req_msg = {"id": call_id, "svc": svc, "mth": mth,
                    "args": args, "deadline_s": timeout_s}
+        if trace_ctx is not None:
+            # cross-node trace propagation: the receiver adopts this span
+            # context so multi-hop requests stitch under one trace_id
+            req_msg[TRACE_HEADER_KEY] = trace_ctx
         try:
             _send_message(self.sock, self.write_lock, req_msg)
         except OSError as e:
@@ -418,9 +425,16 @@ class Messenger:
     connection cache. One per server process (and one per pure client)."""
 
     def __init__(self, name: str = "messenger",
-                 bind_host: str = "127.0.0.1", port: int = 0):
+                 bind_host: str = "127.0.0.1", port: int = 0,
+                 metrics: Optional[MetricRegistry] = None):
         self.name = name
         self._services: Dict[str, object] = {}
+        # per-service.method inbound latency histograms (ref: the
+        # reference's handler_latency_* metrics per RPC method); entity id
+        # carries the method so the family name stays fixed and scrapeable
+        self._metrics = metrics if metrics is not None else ROOT_REGISTRY
+        self._method_hists: Dict[Tuple[str, str], object] = {}
+        self._method_hists_lock = threading.Lock()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind_host, port))
@@ -526,14 +540,32 @@ class Messenger:
 
     def _dispatch(self, conn: socket.socket, write_lock: threading.Lock,
                   req: dict, peer=None) -> None:
-        resp = self._invoke(req["svc"], req["mth"], req["args"], peer=peer)
+        resp = self._invoke(req["svc"], req["mth"], req["args"], peer=peer,
+                            trace_ctx=trace_from_wire(
+                                req.get(TRACE_HEADER_KEY)))
         resp["id"] = req["id"]
         try:
             _send_message(conn, write_lock, resp)
         except OSError:
             pass  # caller gone; response dropped like an expired call
 
-    def _invoke(self, svc: str, mth: str, args: dict, peer=None) -> dict:
+    def _method_histogram(self, svc: str, mth: str):
+        key = (svc, mth)
+        h = self._method_hists.get(key)
+        if h is None:
+            with self._method_hists_lock:
+                h = self._method_hists.get(key)
+                if h is None:
+                    h = self._metrics.entity(
+                        "service", f"{svc}.{mth}",
+                        {"service": svc, "method": mth}).histogram(
+                        "rpc_inbound_call_duration_ms",
+                        "inbound RPC handler latency per service.method")
+                    self._method_hists[key] = h
+        return h
+
+    def _invoke(self, svc: str, mth: str, args: dict, peer=None,
+                trace_ctx: Optional[dict] = None) -> dict:
         entry = {"svc": svc, "mth": mth, "start": time.time(),
                  "peer": f"{peer[0]}:{peer[1]}" if peer else "local"}
         with self._rpcz_lock:
@@ -541,11 +573,18 @@ class Messenger:
             rid = self._rpcz_seq
             self._rpcz_inflight[rid] = entry
         resp = None
+        t0 = time.monotonic()
         try:
-            # request-scoped trace: handler TRACE() calls land in /tracez
-            with Trace(f"{svc}.{mth}"):
+            # request-scoped trace: handler TRACE() calls land in /tracez.
+            # An inbound trace header is ADOPTED, stitching this handler
+            # span into the caller's distributed trace.
+            with Trace.from_wire_context(trace_ctx,
+                                         f"{svc}.{mth}") as span:
+                entry["trace_id"] = span.trace_id
                 resp = self._invoke_inner(svc, mth, args)
         finally:
+            self._method_histogram(svc, mth).increment(
+                (time.monotonic() - t0) * 1e3)
             # entry is fully populated BEFORE it is published — rpcz()
             # hands out references, so late mutation would race the
             # webserver's serialization
@@ -614,7 +653,9 @@ class Messenger:
             host, port_s = addr.rsplit(":", 1)
             conn = self._get_conn((host, int(port_s)))
             try:
-                resp = conn.call(svc, mth, args, timeout_s)
+                resp = conn.call(svc, mth, args, timeout_s,
+                                 trace_ctx=trace_to_wire(
+                                     current_trace_context()))
             except ServiceUnavailable:
                 self._drop_conn(conn)
                 raise

@@ -14,9 +14,17 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Tuple
 
-from yugabyte_tpu.utils.metrics import MetricRegistry
+from yugabyte_tpu.utils.metrics import (ROOT_REGISTRY, MetricRegistry,
+                                        registries_to_json_obj,
+                                        registries_to_prometheus)
 
 Handler = Callable[[], Tuple[str, str]]
+
+
+class _NoHandler(KeyError):
+    """No route registered for the path — the ONLY condition that may 404.
+    A handler that itself raises KeyError is a handler bug and must
+    surface as a 500, not be misreported as a missing route."""
 
 
 class Webserver:
@@ -35,7 +43,7 @@ class Webserver:
                 try:
                     ctype, body = outer._dispatch(path)
                     code = 200
-                except KeyError:
+                except _NoHandler:
                     ctype, body = "text/plain", f"no handler for {path}\n"
                     code = 404
                 except Exception as e:  # noqa: BLE001 — surface as 500
@@ -70,13 +78,23 @@ class Webserver:
                                            default=str) + "\n")
 
     def _dispatch(self, path: str) -> Tuple[str, str]:
-        return self._handlers[path]()
+        try:
+            handler = self._handlers[path]
+        except KeyError:
+            raise _NoHandler(path) from None
+        return handler()
 
+    # Metric endpoints merge the server's own registry with the process
+    # ROOT_REGISTRY: kernel-dispatch histograms, cache hit counters and
+    # other process-wide instrumentation register there (ops/ code has no
+    # server registry in scope) and must still be scrapeable per server.
     def _json_metrics(self) -> Tuple[str, str]:
-        return "application/json", self._metrics.to_json()
+        return "application/json", json.dumps(
+            registries_to_json_obj([self._metrics, ROOT_REGISTRY]), indent=1)
 
     def _prom_metrics(self) -> Tuple[str, str]:
-        return "text/plain; version=0.0.4", self._metrics.to_prometheus()
+        return ("text/plain; version=0.0.4",
+                registries_to_prometheus([self._metrics, ROOT_REGISTRY]))
 
     def shutdown(self) -> None:
         self._httpd.shutdown()

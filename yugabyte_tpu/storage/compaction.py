@@ -165,6 +165,10 @@ class CompactionResult:
     outputs: List[Tuple[int, str, SSTProps]]  # (file_id, base_path, props)
     rows_in: int
     rows_out: int
+    # survivors rewritten as tombstones (TTL expiry at a non-major
+    # compaction); 0 where the path cannot cheaply count them (pure
+    # native shell) — /compactionz reports it as a lower bound
+    tombstones_written: int = 0
 
 
 def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
@@ -343,7 +347,9 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
             limiter.acquire(props.data_size + props.base_size)
         if device_cache is not None:
             device_cache.stage(fid, out_slab)  # write-through for the next pick
-    return CompactionResult(outputs, merged.n + dropped_rows, rows_out)
+    return CompactionResult(outputs, merged.n + dropped_rows, rows_out,
+                            tombstones_written=int(
+                                np.count_nonzero(tomb_flags)))
 
 
 def _write_native_outputs(job, out_dir: str, new_file_id, fr,
@@ -528,6 +534,7 @@ def run_compaction_job_device_native(
 
         # 3) inject the decisions; the shell writes the outputs
         perm, keep, mk = handle.result()
+        tombstones_written = int(np.count_nonzero(mk[keep]))
         job.set_survivors(perm[keep], mk[keep])
         rows_out = job.n_survivors
         fr = _merge_frontiers([r.props.frontier for r in all_inputs],
@@ -556,7 +563,8 @@ def run_compaction_job_device_native(
         staged_outs = run_merge.gather_staged_outputs(handle, ranges)
         for (fid, _base, _props), st in zip(outputs, staged_outs):
             device_cache.put(fid, st)
-    return CompactionResult(outputs, rows_in + dropped_rows, rows_out)
+    return CompactionResult(outputs, rows_in + dropped_rows, rows_out,
+                            tombstones_written=tombstones_written)
 
 
 def _gather_slab(slab: KVSlab, sel: np.ndarray, make_tomb: np.ndarray,

@@ -43,6 +43,82 @@ flags.define_flag("read_native", True,
                   "block_based_table_reader.cc:1144-1286)")
 
 
+def _storage_metrics():
+    """Process-wide read/scan tier histograms (ref: the reference's
+    rocksdb_db_get_micros / db_iter latency metrics)."""
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    e = ROOT_REGISTRY.entity("server", "storage")
+    return (e.histogram("db_get_duration_ms",
+                        "point-read latency through DB.get"),
+            e.histogram("db_scan_duration_ms",
+                        "full device-scan latency through DB.scan_visible"))
+
+
+class CompactionStats:
+    """Per-DB compaction/flush accounting — the `/compactionz` analogue of
+    RocksDB's GetProperty("rocksdb.stats") (ref: rocksdb/db/
+    internal_stats.cc). Running write amplification is
+    (flush bytes + compaction bytes written) / flush bytes: how many times
+    each ingested byte is rewritten by the LSM."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.flushes = 0
+        self.flush_bytes_written = 0
+        self.flush_rows = 0
+        self.compactions = 0
+        self.compaction_bytes_read = 0
+        self.compaction_bytes_written = 0
+        self.compaction_files_in = 0
+        self.compaction_files_out = 0
+        self.compaction_rows_in = 0
+        self.compaction_rows_out = 0
+        self.versions_gcd = 0          # input entries dropped by MVCC GC
+        self.tombstones_written = 0    # TTL expiries rewritten as tombstones
+
+    def record_flush(self, nbytes: int, rows: int) -> None:
+        with self._lock:
+            self.flushes += 1
+            self.flush_bytes_written += nbytes
+            self.flush_rows += rows
+
+    def record_compaction(self, bytes_read: int, bytes_written: int,
+                          files_in: int, files_out: int,
+                          rows_in: int, rows_out: int,
+                          tombstones_written: int = 0) -> None:
+        with self._lock:
+            self.compactions += 1
+            self.compaction_bytes_read += bytes_read
+            self.compaction_bytes_written += bytes_written
+            self.compaction_files_in += files_in
+            self.compaction_files_out += files_out
+            self.compaction_rows_in += rows_in
+            self.compaction_rows_out += rows_out
+            self.versions_gcd += max(0, rows_in - rows_out)
+            self.tombstones_written += tombstones_written
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            ingested = self.flush_bytes_written
+            write_amp = ((ingested + self.compaction_bytes_written)
+                         / ingested if ingested else 0.0)
+            return {
+                "flushes": self.flushes,
+                "flush_bytes_written": self.flush_bytes_written,
+                "flush_rows": self.flush_rows,
+                "compactions": self.compactions,
+                "compaction_bytes_read": self.compaction_bytes_read,
+                "compaction_bytes_written": self.compaction_bytes_written,
+                "compaction_files_in": self.compaction_files_in,
+                "compaction_files_out": self.compaction_files_out,
+                "compaction_rows_in": self.compaction_rows_in,
+                "compaction_rows_out": self.compaction_rows_out,
+                "versions_gcd": self.versions_gcd,
+                "tombstones_written": self.tombstones_written,
+                "write_amplification": round(write_amp, 3),
+            }
+
+
 @dataclass
 class DBOptions:
     block_entries: Optional[int] = None
@@ -107,6 +183,7 @@ class DB:
                 self._run_cache = NamespacedRunCache(
                     _rc, os.path.abspath(db_dir))
         os.makedirs(db_dir, exist_ok=True)
+        self.compaction_stats = CompactionStats()
         self.versions = VersionSet(db_dir)
         self.versions.recover()
         self.mem = new_memtable()
@@ -424,6 +501,17 @@ class DB:
             ) -> Optional[Tuple[DocHybridTime, bytes]]:
         """Latest version of key_prefix visible at read_ht (raw KV semantics;
         document semantics layer above in docdb)."""
+        import time as _time
+        t0 = _time.monotonic()
+        try:
+            return self._get_inner(key_prefix, read_ht)
+        finally:
+            _storage_metrics()[0].increment(
+                (_time.monotonic() - t0) * 1e3)
+
+    def _get_inner(self, key_prefix: bytes,
+                   read_ht: Optional[HybridTime] = None
+                   ) -> Optional[Tuple[DocHybridTime, bytes]]:
         read_ht = read_ht or HybridTime.kMax
         seek = make_internal_key(key_prefix, DocHybridTime(read_ht, 0xFFFFFFFF))
         boundary = key_prefix + bytes([ValueType.kHybridTime])
@@ -541,6 +629,8 @@ class DB:
         (the reference's Version refcounting, ref: db/version_set.cc).
         """
         from yugabyte_tpu.ops.scan import visible_entries
+        import time as _time
+        t0 = _time.monotonic()
         with self._lock:
             slabs = [self.mem.to_slab()]
             if self._imm is not None:
@@ -564,6 +654,8 @@ class DB:
                                        upper_key, device=self.opts.device,
                                        staged_inputs=staged)
         finally:
+            _storage_metrics()[1].increment(
+                (_time.monotonic() - t0) * 1e3)
             with self._lock:
                 for fid, _ in readers:
                     self._pins[fid] -= 1
@@ -640,6 +732,8 @@ class DB:
                 self._rset = None  # native snapshot is stale
                 self._rset_gen += 1
                 self._mem_run_cache = None
+            self.compaction_stats.record_flush(
+                props.data_size + props.base_size, n_flushed)
             TRACE("flushed %d entries to %s", n_flushed, path)
         except BaseException as e:
             with self._lock:
@@ -746,6 +840,13 @@ class DB:
                         self._device_cache.drop(fid)
                     if self._run_cache is not None:
                         self._run_cache.drop(fid)
+            self.compaction_stats.record_compaction(
+                bytes_read=sum(fm.total_size for fm in pick.inputs),
+                bytes_written=sum(p.data_size + p.base_size
+                                  for _fid, _path, p in result.outputs),
+                files_in=len(pick.inputs), files_out=len(result.outputs),
+                rows_in=result.rows_in, rows_out=result.rows_out,
+                tombstones_written=result.tombstones_written)
             TRACE("compaction: %d files -> %d rows (%d in)",
                   len(pick.inputs), result.rows_out, result.rows_in)
         finally:

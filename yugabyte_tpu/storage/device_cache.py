@@ -35,22 +35,34 @@ class DeviceSlabCache:
     numbers under a shared block cache)."""
 
     def __init__(self, device=None, capacity_bytes: int = 4 << 30):
+        from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
         self.device = device
         self.capacity = capacity_bytes
         self._map: "OrderedDict[CacheKey, StagedCols]" = OrderedDict()
         self._used = 0
         self._lock = threading.Lock()
+        # per-instance ints (tests diff fresh caches) + process-wide
+        # registry counters so the hit ratio is scrapeable
         self.hits = 0
         self.misses = 0
+        e = ROOT_REGISTRY.entity("server", "device_cache")
+        self._c_hits = e.counter("device_cache_hits_total",
+                                 "HBM slab cache hits")
+        self._c_misses = e.counter("device_cache_misses_total",
+                                   "HBM slab cache misses")
+        self._g_used = e.gauge("device_cache_used_bytes",
+                               "HBM bytes resident in the slab cache")
 
     def get(self, key: CacheKey) -> Optional[StagedCols]:
         with self._lock:
             staged = self._map.get(key)
             if staged is None:
                 self.misses += 1
+                self._c_misses.increment()
                 return None
             self._map.move_to_end(key)
             self.hits += 1
+            self._c_hits.increment()
             return staged
 
     def contains(self, key: CacheKey) -> bool:
@@ -70,6 +82,7 @@ class DeviceSlabCache:
             while self._used > self.capacity and len(self._map) > 1:
                 _, old = self._map.popitem(last=False)
                 self._used -= old.nbytes
+            self._g_used.set(self._used)
 
     def drop(self, key: CacheKey) -> None:
         with self._lock:

@@ -39,6 +39,28 @@ flags.define_flag("device_offload_mode", "auto",
 DEFAULT_CALIBRATION_FILE = "offload_calibration.json"
 
 
+def _offload_counters():
+    """Decision counters: WHICH way each compaction routed, and WHY —
+    the visibility LUDA-style offload systems attribute their wins with
+    (offloaded vs CPU-fallback, forced/uncalibrated/measured)."""
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    e = ROOT_REGISTRY.entity("server", "offload_policy")
+    return {
+        "device": e.counter("offload_decisions_device_total",
+                            "compactions routed to the device kernel"),
+        "native": e.counter("offload_decisions_native_total",
+                            "compactions routed to the native CPU path"),
+        "forced": e.counter("offload_decisions_forced_total",
+                            "decisions forced by device_offload_mode"),
+        "uncalibrated": e.counter(
+            "offload_decisions_uncalibrated_total",
+            "native routings taken for lack of same-platform calibration"),
+        "measured": e.counter(
+            "offload_decisions_measured_total",
+            "decisions made from same-platform calibration data"),
+    }
+
+
 @dataclass
 class CalibrationPoint:
     n_rows: int
@@ -107,10 +129,15 @@ class OffloadPolicy:
                 and p.device_rows_per_sec > 0 and p.native_rows_per_sec > 0]
 
     def use_device(self, n_rows: int, cached: bool) -> bool:
+        c = _offload_counters()
         mode = flags.get_flag("device_offload_mode")
         if mode == "device":
+            c["forced"].increment()
+            c["device"].increment()
             return True
         if mode == "native":
+            c["forced"].increment()
+            c["native"].increment()
             return False
         pts = self._applicable(cached) or self._applicable(not cached)
         if not pts:
@@ -119,11 +146,16 @@ class OffloadPolicy:
             # platform before any job is routed to it (VERDICT r4 weak #4:
             # the old >=1M-cached-rows default offloaded to a device path
             # last measured at 0.2x native).
+            c["uncalibrated"].increment()
+            c["native"].increment()
             return False
         # nearest measured size decides (log-scale distance)
         best = min(pts, key=lambda p: abs(p.n_rows.bit_length()
                                           - n_rows.bit_length()))
-        return best.device_rows_per_sec > best.native_rows_per_sec
+        c["measured"].increment()
+        use = best.device_rows_per_sec > best.native_rows_per_sec
+        c["device" if use else "native"].increment()
+        return use
 
     @staticmethod
     def append_calibration(path: str, n_rows: int, cached: bool,

@@ -37,12 +37,20 @@ class NativeRunCache:
     """Process-wide LRU over native run-cache ids."""
 
     def __init__(self, capacity_bytes: Optional[int] = None):
+        from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
         self._cap_override = capacity_bytes
         self._map: "OrderedDict[CacheKey, Tuple[int, int]]" = OrderedDict()
         self._used = 0
         self._lock = threading.Lock()
+        # per-instance ints (tests diff them) + registry counters for the
+        # scrapeable hit ratio
         self.hits = 0
         self.misses = 0
+        e = ROOT_REGISTRY.entity("server", "run_cache")
+        self._c_hits = e.counter("run_cache_hits_total",
+                                 "decoded-run cache hits")
+        self._c_misses = e.counter("run_cache_misses_total",
+                                   "decoded-run cache misses")
 
     @property
     def capacity(self) -> int:
@@ -55,9 +63,11 @@ class NativeRunCache:
             ent = self._map.get(key)
             if ent is None:
                 self.misses += 1
+                self._c_misses.increment()
                 return None
             self._map.move_to_end(key)
             self.hits += 1
+            self._c_hits.increment()
             return ent[0]
 
     def contains(self, key: CacheKey) -> bool:

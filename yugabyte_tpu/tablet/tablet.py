@@ -192,12 +192,14 @@ class Tablet:
         metrics = metrics or MetricRegistry()
         entity = metrics.entity("tablet", tablet_id)
         self.metric_rows_inserted = entity.counter(
-            "rows_inserted", "rows written via QL write ops")
+            "rows_inserted_total", "rows written via QL write ops")
         self.metric_write_latency = entity.histogram(
             "ql_write_latency_us", "end-to-end WriteQuery latency (us)")
-        self.metric_reads = entity.counter("ql_reads", "row reads served")
+        self.metric_reads = entity.counter("ql_reads_total",
+                                           "row reads served")
         self.metric_write_rejections = entity.counter(
-            "write_rejections", "writes rejected by SST-file backpressure")
+            "write_rejections_total",
+            "writes rejected by SST-file backpressure")
 
     def _pre_intents_flush(self) -> None:
         """Intents pre-flush hook. The regular flush contains I/O errors

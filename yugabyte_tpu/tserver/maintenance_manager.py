@@ -179,7 +179,8 @@ class MaintenanceManager:
         self._c_ops = self._h_dur = None
         if metric_entity is not None:
             self._c_ops = metric_entity.counter(
-                "maintenance_ops_performed", "background maintenance ops run")
+                "maintenance_ops_performed_total",
+                "background maintenance ops run")
             self._h_dur = metric_entity.histogram(
                 "maintenance_op_duration_ms", "maintenance op wall time")
         self.last_op_name: Optional[str] = None   # observability/tests

@@ -120,12 +120,11 @@ class ServerExecutionContext:
             e = metrics.entity("server", "execution")
             self._g_queue = e.gauge("compaction_pool_queue_depth",
                                     "queued background compactions")
-            self._g_active = e.gauge("compaction_pool_active",
+            self._g_active = e.gauge("compaction_pool_active_count",
                                      "running background compactions")
-            self._g_hits = e.gauge("device_cache_hits",
-                                   "HBM slab cache hits")
-            self._g_misses = e.gauge("device_cache_misses",
-                                     "HBM slab cache misses")
+            # cache hit/miss counters live on the caches themselves now
+            # (ROOT_REGISTRY, storage/device_cache.py) — real counters,
+            # not refresh-time gauge mirrors
             self._entity = e
 
     def tablet_options(self) -> TabletOptions:
@@ -141,9 +140,6 @@ class ServerExecutionContext:
             return
         self._g_queue.set(self.pool.queue_depth())
         self._g_active.set(self.pool.active_count())
-        if self.device_cache is not None:
-            self._g_hits.set(self.device_cache.hits)
-            self._g_misses.set(self.device_cache.misses)
 
     def shutdown(self) -> None:
         self.pool.shutdown(wait=False)

@@ -90,7 +90,7 @@ class TabletMemoryManager:
         self._c_forced = None
         if metric_entity is not None:
             self._c_forced = metric_entity.counter(
-                "global_memstore_forced_flushes",
+                "global_memstore_forced_flushes_total",
                 "tablet flushes forced by the global memstore limit")
         # observability hook mirroring TEST_listeners (ref header :65)
         self.flush_listeners: List[Callable[[str], None]] = []

@@ -46,7 +46,8 @@ class TabletServer:
         self.clock = HybridClock()
         self.metrics = MetricRegistry()
         self.messenger = Messenger(f"ts-{opts.server_id}",
-                                   bind_host=opts.bind_host, port=opts.port)
+                                   bind_host=opts.bind_host, port=opts.port,
+                                   metrics=self.metrics)
         # server_id -> host:port map for consensus peer resolution; seeded
         # with ourselves, refreshed by every heartbeat response.
         self._addr_map: Dict[str, str] = {opts.server_id: self.address}
@@ -116,14 +117,46 @@ class TabletServer:
             self.webserver.register_json(
                 "/memz", lambda: root_tracker().tree_json())
             # observability endpoints (ref /rpcz rpc/rpcz_store.cc,
-            # /tracez + /threadz from util/debug-util.cc)
+            # /tracez + /threadz from util/debug-util.cc). /tracez groups
+            # spans by trace_id so multi-hop requests read as one tree.
             from yugabyte_tpu.utils import trace as trace_mod
             self.webserver.register_json("/rpcz", self.messenger.rpcz)
-            self.webserver.register_json("/tracez", trace_mod.tracez)
+            self.webserver.register_json("/tracez", trace_mod.tracez_page)
             self.webserver.register_json("/threadz", trace_mod.threadz)
+            # /compactionz: per-DB flush/compaction stats incl. running
+            # write amplification (the GetProperty("rocksdb.stats")
+            # analogue, ref rocksdb/db/internal_stats.cc)
+            self.webserver.register_json("/compactionz", self.compactionz)
 
     def _tablet_peers(self):
         return self.tablet_manager.peers()
+
+    def compactionz(self) -> dict:
+        """Flush/compaction stats per hosted tablet DB + server totals."""
+        tablets = []
+        totals = {"flush_bytes_written": 0, "compaction_bytes_read": 0,
+                  "compaction_bytes_written": 0, "versions_gcd": 0,
+                  "tombstones_written": 0}
+        for peer in self.tablet_manager.peers():
+            tablet = getattr(peer, "tablet", None)
+            if tablet is None:
+                continue
+            entry = {"tablet_id": peer.tablet_id}
+            for part in ("regular", "intents"):
+                db = getattr(tablet, f"{part}_db", None)
+                if db is None:
+                    continue
+                stats = db.compaction_stats.to_dict()
+                entry[part] = stats
+                for k in totals:
+                    totals[k] += stats.get(k, 0)
+            tablets.append(entry)
+        ingested = totals["flush_bytes_written"]
+        totals["write_amplification"] = round(
+            (ingested + totals["compaction_bytes_written"]) / ingested,
+            3) if ingested else 0.0
+        return {"server_id": self.server_id, "totals": totals,
+                "tablets": tablets}
 
     def _status_page(self) -> dict:
         if self.exec_context is not None:

@@ -88,7 +88,8 @@ class MemTracker:
         self._gauge = None
         if metric_entity is not None:
             self._gauge = metric_entity.gauge(
-                f"mem_tracker_{tracker_id}", f"bytes tracked by {tracker_id}")
+                f"mem_tracker_{tracker_id}_bytes",
+                f"bytes tracked by {tracker_id}")
 
     # ------------------------------------------------------------ hierarchy
     def find_child(self, tracker_id: str) -> Optional["MemTracker"]:

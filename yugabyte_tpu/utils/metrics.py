@@ -4,16 +4,21 @@ Capability parity with the reference metric system (ref: src/yb/util/metrics.h:
 Counter, AtomicGauge :713, Histogram; WriteForPrometheus :449-518). Entities
 (server/table/tablet) each own a registry; registries aggregate into a root
 MetricRegistry for the /metrics endpoints.
+
+Naming convention (enforced by tools/lint_metric_names.py in tier-1):
+snake_case, with a unit suffix — counters end `_total`; histograms end
+`_ms`/`_us`/`_bytes`/`_rows`; gauges end in a unit or count suffix. This
+keeps the namespace scrapeable as the instrumented surface grows.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import threading
 import time
-from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 
 class Counter:
@@ -106,6 +111,22 @@ class Histogram:
     def count(self) -> int:
         return self._total_count
 
+    def min(self) -> float:
+        return self._min if self._total_count else 0.0
+
+    def max(self) -> float:
+        return self._max if self._total_count else 0.0
+
+
+@contextlib.contextmanager
+def timed_ms(hist: Histogram):
+    """Record the wall time of a with-block into `hist`, in milliseconds."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        hist.increment((time.monotonic() - t0) * 1e3)
+
 
 class MetricEntity:
     """One metric-owning entity: a server, table, or tablet (ref: metrics.h entities)."""
@@ -133,6 +154,23 @@ class MetricEntity:
             return self._metrics[name]
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline (tablet attributes can contain any of them today)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping: backslash and newline."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    return ",".join(f'{k}="{_escape_label_value(v)}"'
+                    for k, v in labels.items())
+
+
 class MetricRegistry:
     def __init__(self):
         self._entities: Dict[str, MetricEntity] = {}
@@ -156,37 +194,127 @@ class MetricRegistry:
         return out
 
     def to_json(self) -> str:
-        out = []
-        for ent, ent_metrics in self._snapshot():
+        return json.dumps(registries_to_json_obj([self]), indent=1)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (ref: metrics.h WriteForPrometheus :449-518)."""
+        return registries_to_prometheus([self])
+
+
+def registries_to_json_obj(registries: Iterable[MetricRegistry]) -> list:
+    seen = set()
+    out = []
+    for reg in registries:
+        if id(reg) in seen:
+            continue
+        seen.add(id(reg))
+        for ent, ent_metrics in reg._snapshot():
             metrics = []
             for m in ent_metrics:
                 if isinstance(m, Histogram):
                     metrics.append({
                         "name": m.name, "total_count": m.count(), "mean": m.mean(),
+                        "min": m.min(), "max": m.max(),
                         "percentile_95": m.percentile(95), "percentile_99": m.percentile(99),
                     })
                 else:
                     metrics.append({"name": m.name, "value": m.value()})
             out.append({"type": ent.entity_type, "id": ent.entity_id,
                         "attributes": ent.attributes, "metrics": metrics})
-        return json.dumps(out, indent=1)
+    return out
 
-    def to_prometheus(self) -> str:
-        """Prometheus text exposition (ref: metrics.h WriteForPrometheus :449-518)."""
-        lines: List[str] = []
-        for ent, ent_metrics in self._snapshot():
-            labels = {"metric_type": ent.entity_type, "metric_id": ent.entity_id}
+
+def registries_to_prometheus(registries: Iterable[MetricRegistry]) -> str:
+    """Valid Prometheus text-format exposition over one or more registries.
+
+    Grammar obligations the naive per-entity dump violated (and the
+    exposition test now enforces line-by-line):
+      - every metric FAMILY gets exactly one `# TYPE` line, emitted before
+        any of its samples, even when the same name appears under many
+        entities (or several registries);
+      - label values are escaped (quotes, backslashes, newlines);
+      - histograms expose as summaries (quantile samples + _sum/_count)
+        plus separate `<name>_min`/`<name>_max` gauge families (a summary
+        family itself may only carry the quantile/_sum/_count samples).
+    """
+    # family name -> (type, help, [sample lines])
+    families: "Dict[str, Tuple[str, str, List[str]]]" = {}
+    order: List[str] = []
+
+    def fam(name: str, mtype: str, help: str) -> List[str]:
+        if name not in families:
+            families[name] = (mtype, help, [])
+            order.append(name)
+        return families[name][2]
+
+    seen = set()
+    for reg in registries:
+        if id(reg) in seen:
+            continue  # the webserver merges the per-server registry with
+        seen.add(id(reg))  # the process ROOT_REGISTRY; never dump one twice
+        for ent, ent_metrics in reg._snapshot():
+            labels = {"metric_type": ent.entity_type,
+                      "metric_id": ent.entity_id}
             labels.update(ent.attributes)
-            label_str = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            ls = _label_str(labels)
             for m in ent_metrics:
                 if isinstance(m, Histogram):
-                    lines.append(f"{m.name}_count{{{label_str}}} {m.count()}")
-                    lines.append(f"{m.name}_sum{{{label_str}}} {m._total_sum}")
+                    lines = fam(m.name, "summary", m.help)
                     for p in (50, 95, 99):
-                        lines.append(f'{m.name}{{{label_str},quantile="0.{p}"}} {m.percentile(p)}')
+                        lines.append(f'{m.name}{{{ls},quantile="0.{p}"}} '
+                                     f'{m.percentile(p)}')
+                    lines.append(f"{m.name}_sum{{{ls}}} {m._total_sum}")
+                    lines.append(f"{m.name}_count{{{ls}}} {m.count()}")
+                    fam(f"{m.name}_min", "gauge",
+                        f"minimum observed {m.name}").append(
+                        f"{m.name}_min{{{ls}}} {m.min()}")
+                    fam(f"{m.name}_max", "gauge",
+                        f"maximum observed {m.name}").append(
+                        f"{m.name}_max{{{ls}}} {m.max()}")
                 else:
-                    lines.append(f"{m.name}{{{label_str}}} {m.value()}")
-        return "\n".join(lines) + "\n"
+                    mtype = "counter" if isinstance(m, Counter) else "gauge"
+                    prior = families.get(m.name)
+                    if prior is not None and prior[0] != mtype:
+                        mtype = "untyped"  # conflicting kinds across entities
+                        families[m.name] = (mtype, prior[1], prior[2])
+                    fam(m.name, mtype, m.help).append(
+                        f"{m.name}{{{ls}}} {m.value()}")
+    out: List[str] = []
+    for name in order:
+        mtype, help, lines = families[name]
+        if help:
+            out.append(f"# HELP {name} {_escape_help(help)}")
+        out.append(f"# TYPE {name} {mtype}")
+        out.extend(lines)
+    return "\n".join(out) + "\n"
 
 
 ROOT_REGISTRY = MetricRegistry()
+
+
+def kernel_metrics() -> MetricEntity:
+    """The process-wide entity every JAX-kernel dispatch site records into
+    (ops/ code has no server registry in scope; the webserver merges
+    ROOT_REGISTRY into each server's exposition)."""
+    return ROOT_REGISTRY.entity("server", "kernels")
+
+
+def record_kernel_dispatch(kind: str, n_rows: int, n_pad: int,
+                           duration_ms: Optional[float] = None) -> None:
+    """One JAX-kernel dispatch: invocation counter, wall-time histogram,
+    batch-size histogram, and the padding-waste gauges the shape-bucketing
+    design makes interesting (padded slots are pure device work). `kind`
+    is the kernel family, e.g. 'kernel_merge_gc' / 'kernel_scan'."""
+    e = kernel_metrics()
+    e.counter(kind + "_dispatch_total",
+              f"{kind} device dispatches").increment()
+    if duration_ms is not None:
+        e.histogram(kind + "_duration_ms",
+                    f"{kind} dispatch wall time").increment(duration_ms)
+    e.histogram(kind + "_batch_rows",
+                f"{kind} real rows per dispatch").increment(max(n_rows, 1))
+    e.gauge("kernel_batch_rows",
+            "real rows in the most recent kernel dispatch").set(n_rows)
+    e.gauge("kernel_pad_waste_rows",
+            "padded-but-dead rows in the most recent kernel dispatch "
+            "(shape-bucket overhead)").set(max(0, n_pad - n_rows))
