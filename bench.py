@@ -232,10 +232,24 @@ def run_warm_child(platform: str, workload_path: str) -> None:
     t0 = time.time()
     _, keep, _ = run_merge.merge_and_gc_runs(runs, GCParams(cutoff, True),
                                              device=dev)
-    log(f"  warm: compile+run {time.time()-t0:.1f}s on {dev} "
-        f"(kept {int(keep.sum())}, expected {cpu_kept})")
+    first_s = time.time() - t0
     assert int(keep.sum()) == cpu_kept
-    print(json.dumps({"warmed": n_total}), flush=True)
+    # isolate compile from run: the second call reuses the in-process jit
+    # cache, so first - second ~= trace + compile (or persistent-cache
+    # load). This is the cache proof the parent records as compile2_s —
+    # a FRESH process over already-cached buckets must land in seconds,
+    # not re-pay the first child's full XLA compile.
+    t0 = time.time()
+    _, keep2, _ = run_merge.merge_and_gc_runs(runs, GCParams(cutoff, True),
+                                              device=dev)
+    second_s = time.time() - t0
+    assert int(keep2.sum()) == cpu_kept
+    compile_s = max(0.0, first_s - second_s)
+    log(f"  warm: first call {first_s:.1f}s, second {second_s:.1f}s -> "
+        f"compile ~{compile_s:.1f}s on {dev} (kept {int(keep.sum())}, "
+        f"expected {cpu_kept})")
+    print(json.dumps({"warmed": n_total,
+                      "compile_s": round(compile_s, 2)}), flush=True)
 
 
 class StageLog:
@@ -383,6 +397,8 @@ def run_device_child(platform: str, workload_path: str,
     workdir = tempfile.mkdtemp(prefix="ybtpu-bench-")
     e2e_steady = e2e_steady2 = e2e_cold = 0.0
     e2e_rows = -1
+    stage_ms = {}
+    bucket_hits = bucket_misses = 0
     try:
         paths = _write_input_ssts(e2e_slab, e2e_offsets, workdir)
         readers = [SSTReader(p) for p in paths]
@@ -421,6 +437,9 @@ def run_device_child(platform: str, workload_path: str,
                 return e2e_n / (time.time() - t0), res.rows_out
 
             run_dn("warm", True)  # compile/warm
+            from yugabyte_tpu.utils.metrics import (kernel_metrics,
+                                                    pipeline_stage_totals)
+            stage_before = pipeline_stage_totals()
             e2e_steady, e2e_rows = run_dn("steady", True)
             log(f"  e2e steady ({platform}+native shell): "
                 f"{e2e_steady/1e6:.2f}M rows/s ({e2e_rows} rows out)")
@@ -454,9 +473,30 @@ def run_device_child(platform: str, workload_path: str,
                 raise errs[0]
             e2e_steady2 = e2e_n * jobs2 / (time.time() - t0)
             log(f"  e2e steady x2 workers: {e2e_steady2/1e6:.2f}M rows/s")
+            # where the pipelined jobs' wall time went (stage A host
+            # decode/pack, stage B device compute+transfer, stage C
+            # native SST write) + shape-bucket executable reuse
+            stage_after = pipeline_stage_totals()
+            stage_ms = {s: round(stage_after[s] - stage_before[s], 1)
+                        for s in stage_after}
+            ke = kernel_metrics()
+            bucket_hits = ke.counter(
+                "kernel_compile_bucket_hits_total", "").value()
+            bucket_misses = ke.counter(
+                "kernel_compile_bucket_misses_total", "").value()
+            log(f"  pipeline stages over steady jobs: "
+                f"host {stage_ms.get('host', 0):.0f}ms / device "
+                f"{stage_ms.get('device', 0):.0f}ms / write "
+                f"{stage_ms.get('write', 0):.0f}ms; compile buckets "
+                f"{bucket_hits} hits / {bucket_misses} misses")
             stages.put(stage="e2e_steady", e2e_steady=e2e_steady,
                        e2e_steady2=e2e_steady2,
-                       e2e_rows=e2e_rows, e2e_n=e2e_n)
+                       e2e_rows=e2e_rows, e2e_n=e2e_n,
+                       stage_host_ms=stage_ms.get("host", 0.0),
+                       stage_device_ms=stage_ms.get("device", 0.0),
+                       stage_write_ms=stage_ms.get("write", 0.0),
+                       compile_bucket_hits=bucket_hits,
+                       compile_bucket_misses=bucket_misses)
             e2e_cold, _ = run_dn("cold", False)
             log(f"  e2e cold ({platform}+native shell): "
                 f"{e2e_cold/1e6:.2f}M rows/s")
@@ -526,6 +566,15 @@ def run_device_child(platform: str, workload_path: str,
         "e2e_cold_rows_per_sec": round(e2e_cold, 1),
         "e2e_native_rows_per_sec": 0.0,   # parent overwrites (JAX-free)
         "compile_s": round(compile_s, 1),
+        # per-stage pipeline wall time over the steady e2e jobs (stage A
+        # host decode/pack, stage B device compute + transfer waits,
+        # stage C native SST write) — the /compactionz stall view,
+        # snapshotted into the artifact
+        "stage_host_ms": stage_ms.get("host", 0.0),
+        "stage_device_ms": stage_ms.get("device", 0.0),
+        "stage_write_ms": stage_ms.get("write", 0.0),
+        "compile_bucket_hits": bucket_hits,
+        "compile_bucket_misses": bucket_misses,
         "e2e_n_rows": e2e_n,
         "n_rows": n_total,
     }), flush=True)
@@ -909,6 +958,10 @@ def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
         out["e2e_steady2_rows_per_sec"] = round(
             recs["e2e_steady"].get("e2e_steady2", 0.0), 1)
         out["e2e_n_rows"] = recs["e2e_steady"]["e2e_n"]
+        for k in ("stage_host_ms", "stage_device_ms", "stage_write_ms",
+                  "compile_bucket_hits", "compile_bucket_misses"):
+            if k in recs["e2e_steady"]:
+                out[k] = recs["e2e_steady"][k]
         out["value"] = max(out["e2e_steady_rows_per_sec"],
                            out["e2e_steady2_rows_per_sec"])
         out["vs_baseline"] = round(out["value"] / cpu_rate, 3)
@@ -1081,6 +1134,20 @@ def main():
             result = _spawn_child("cpu", measure_budget * 2, rung.wl_path)
             if result is not None:
                 result.update(_last_tpu_keys())
+        if result is not None and rung is not None:
+            # persistent-compilation-cache proof: a FRESH process hitting
+            # the same shape buckets must compile from the cache dir in
+            # seconds, not re-pay the full XLA compile (compile_s). The
+            # measuring child above populated the cache; this second
+            # process's first-call time is compile2_s.
+            plat2 = "tpu" if result.get("platform") == "tpu" else "cpu"
+            warm2 = _spawn_child(plat2, warm_budget, rung.wl_path,
+                                 mode="--warm")
+            if warm2 and "compile_s" in warm2:
+                result["compile2_s"] = warm2["compile_s"]
+                log(f"second-process first call (persistent cache): "
+                    f"{warm2['compile_s']:.1f}s vs cold compile "
+                    f"{result.get('compile_s', '?')}s")
         native_rate = rung.native_rate if rung else 0.0
         cpu_rate = rung.cpu_rate if rung else 0.0
     finally:

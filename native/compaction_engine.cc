@@ -443,6 +443,18 @@ void ce_job_set_survivors(void* jp, const int64_t* surv, const uint8_t* mk,
   j->surv_mk.assign(mk, mk + n_out);
 }
 
+// Streaming TPU path: stage C of the pipelined compaction appends each
+// chunk's survivors as its decision download lands, so write_output on the
+// already-appended span overlaps the later chunks' device compute and D2H.
+// Chunks arrive in global merged order (route-partitioned), so appending
+// preserves the survivor order set_survivors would have produced.
+void ce_job_append_survivors(void* jp, const int64_t* surv,
+                             const uint8_t* mk, int64_t n_out) {
+  Job* j = (Job*)jp;
+  j->surv.insert(j->surv.end(), surv, surv + n_out);
+  j->surv_mk.insert(j->surv_mk.end(), mk, mk + n_out);
+}
+
 int64_t ce_job_rows(void* jp) { return ((Job*)jp)->n; }
 int64_t ce_job_n_survivors(void* jp) { return (int64_t)((Job*)jp)->surv.size(); }
 

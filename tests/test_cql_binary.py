@@ -87,6 +87,37 @@ def test_prepare_bind_execute(conn):
     assert rows.rows == [["u3"]]
 
 
+def test_prepare_lwt_if_clause_markers(conn):
+    """Prepared UPDATE/DELETE with bind markers in the LWT IF clause:
+    marker metadata must include the IF-clause types AFTER the WHERE
+    markers (ADVICE r5: they were omitted, so drivers encoded the wrong
+    arity)."""
+    from yugabyte_tpu.yql.cql import wire as W
+    conn.execute("USE wire_ks")
+    conn.execute("INSERT INTO t1 (id, name, score) VALUES (900, 'pre', 1)")
+    pid, types = conn.prepare(
+        "UPDATE t1 SET name = ? WHERE id = ? IF score = ?")
+    assert types == [W.TYPE_VARCHAR, W.TYPE_INT, W.TYPE_DOUBLE]
+    rs = conn.execute_prepared(pid, [("post", DataType.STRING),
+                                     (900, DataType.INT32),
+                                     (1.0, DataType.DOUBLE)])
+    assert rs.rows[0][0] is True  # [applied]
+    rows = conn.execute("SELECT name FROM t1 WHERE id = 900")
+    assert rows.rows == [["post"]]
+    # failed condition reports [applied]=false + current value
+    rs = conn.execute_prepared(pid, [("nope", DataType.STRING),
+                                     (900, DataType.INT32),
+                                     (9.0, DataType.DOUBLE)])
+    assert rs.rows[0][0] is False
+    did, dtypes = conn.prepare("DELETE FROM t1 WHERE id = ? IF name = ?")
+    assert dtypes == [W.TYPE_INT, W.TYPE_VARCHAR]
+    rs = conn.execute_prepared(did, [(900, DataType.INT32),
+                                     ("post", DataType.STRING)])
+    assert rs.rows[0][0] is True
+    rows = conn.execute("SELECT name FROM t1 WHERE id = 900")
+    assert rows.rows == []
+
+
 def test_null_values_and_missing_row(conn):
     conn.execute("USE wire_ks")
     conn.execute("INSERT INTO t1 (id, name) VALUES (?, ?)",

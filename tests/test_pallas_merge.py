@@ -107,7 +107,10 @@ def test_auto_selection_prefers_network_on_cpu(monkeypatch):
     monkeypatch.setenv("YBTPU_MERGE_IMPL", "auto")
     rng = np.random.default_rng(29)
     runs = [_make_run(rng, 100, key_space=20) for _ in range(2)]
-    staged = run_merge.stage_runs_from_slabs(runs)
+    # pack_runs=False: greedy run-packing would fold these two tiny runs
+    # into one slot (k_pad=1, a GC-only launch) — this test probes impl
+    # selection over a REAL 2-slot merge layout
+    staged = run_merge.stage_runs_from_slabs(runs, pack_runs=False)
     assert run_merge._pick_impl(staged) == "network"
     monkeypatch.setenv("YBTPU_MERGE_IMPL", "pallas")
     assert run_merge._pick_impl(staged) == "pallas"

@@ -85,6 +85,17 @@ def test_parameter_description_types(conn):
     assert list(oids) == [20, 25]  # INT column (int64), TEXT column
 
 
+def test_describe_fromless_scalar_select(conn):
+    """Describe of a FROM-less scalar SELECT (`SELECT 1`) must return a
+    row description instead of tripping the virtual-table lookup on a
+    None table name (ADVICE r5: AttributeError on None.lower())."""
+    r = conn.extended_query("SELECT 1")
+    assert r.rows == [["1"]]
+    assert len(r.columns) == 1
+    r = conn.extended_query("SELECT 1, 'x'")
+    assert r.rows == [["1", "x"]] and len(r.columns) == 2
+
+
 def test_extended_protocol_error_recovery(conn):
     with pytest.raises(PgWireError):
         conn.extended_query("SELECT nope FROM sales WHERE id = $1", ["1"])

@@ -582,6 +582,19 @@ def test_cql_aggregates(ql):
     assert rs.rows == [[0, 0, None]]
 
 
+def test_cql_count_limit_counts_all_rows(ql):
+    """LIMIT on an aggregate applies to the one-row RESULT, not to the
+    scan feeding it (ADVICE r5: `SELECT COUNT(*) ... LIMIT 1` truncated
+    the scan to 1 row and returned count=1)."""
+    ql.execute("CREATE TABLE cntl (k TEXT, r INT, PRIMARY KEY ((k), r))")
+    for i in range(9):
+        ql.execute("INSERT INTO cntl (k, r) VALUES ('p', %d)" % i)
+    rs = ql.execute("SELECT COUNT(*) FROM cntl WHERE k = 'p' LIMIT 1")
+    assert rs.rows == [[9]]
+    rs = ql.execute("SELECT COUNT(*) FROM cntl WHERE k = 'p' LIMIT 3")
+    assert rs.rows == [[9]]
+
+
 def test_cql_aggregate_edges(ql):
     ql.execute("CREATE TABLE aggm (k TEXT PRIMARY KEY, m MAP<TEXT,INT>)")
     ql.execute("INSERT INTO aggm (k, m) VALUES ('a', {'x': 1})")

@@ -116,20 +116,28 @@ def merge_network(x, k_pad: int, m: int, pos=None):
 
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "k_pad", "m", "w", "n_cmp", "is_major", "retain_deletes", "snapshot"))
-def _merge_gc_runs_fused(cols, cmp_rows, pos,
-                         cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
-                         k_pad: int, m: int, w: int, n_cmp: int,
-                         is_major: bool, retain_deletes: bool,
-                         snapshot: bool):
-    """One device program: bitonic run-merge + GC + packed decision buffer.
+def _merge_gc_runs_impl(cols, cmp_rows, pos,
+                        cutoff_hi, cutoff_lo, cutoff_phys_hi, cutoff_phys_lo,
+                        k_pad: int, m: int, w: int, n_cmp: int,
+                        is_major: bool, retain_deletes: bool,
+                        snapshot: bool, lexsort: bool = False):
+    """One device program: run-merge + GC + packed decision buffer.
 
     cols: [8+w, k_pad*m] run-major layout. cmp_rows: int32 [n_cmp] row ids of
     the non-constant compare columns in most-significant-first order (host
     prunes constants; WHICH rows is dynamic so the compile key is only the
     shape tuple). Output: uint32 [N//32, 2+b] packed groups (keep bits,
     make-tombstone bits, b source-code bit-planes), b = log2(k_pad).
+
+    lexsort (static): merge with ONE multi-key `lax.sort` instead of the
+    bitonic network. The comparator short-circuits per comparison, so it is
+    the clear winner everywhere a real comparison sort runs fast and
+    multi-operand sorts compile quickly — i.e. every non-TPU backend (the
+    CPU fallback path ran ~15x faster in measurement); on TPU the
+    multi-operand sort costs minutes of XLA compile and the network/pallas
+    paths stay the default. Both impls produce bit-identical decisions:
+    the comparator (pruned rows + global-index tiebreak) is the same total
+    order.
     """
     n = k_pad * m
     u32max = jnp.uint32(0xFFFFFFFF)
@@ -139,9 +147,13 @@ def _merge_gc_runs_fused(cols, cmp_rows, pos,
     invert = ((cmp_rows >= _ROW_HT_HI) & (cmp_rows <= _ROW_WID))
     cmp = cols[cmp_rows, :] ^ jnp.where(invert, u32max, jnp.uint32(0))[:, None]
     idx = pos.astype(jnp.uint32)
-    x = jnp.concatenate([cmp, idx[None]], axis=0)
 
-    if k_pad > 1:
+    if k_pad > 1 and lexsort:
+        ops = [cmp[i] for i in range(n_cmp)] + [idx]
+        perm = jax.lax.sort(ops, num_keys=n_cmp + 1)[-1].astype(jnp.int32)
+        s = cols[:, perm]
+    elif k_pad > 1:
+        x = jnp.concatenate([cmp, idx[None]], axis=0)
         merged = merge_network(x.reshape(n_cmp + 1, k_pad, m), k_pad, m,
                                pos=pos)
         perm = merged[-1].astype(jnp.int32)
@@ -171,6 +183,148 @@ def _merge_gc_runs_fused(cols, cmp_rows, pos,
     return jnp.stack(groups, axis=1), perm, keep, make_tomb
 
 
+_FUSED_STATICS = ("k_pad", "m", "w", "n_cmp", "is_major", "retain_deletes",
+                  "snapshot", "lexsort")
+
+_merge_gc_runs_fused = functools.partial(
+    jax.jit, static_argnames=_FUSED_STATICS)(_merge_gc_runs_impl)
+
+# Donated variant for TRANSIENT column buffers (carved subcompaction
+# chunks, per-chunk host uploads): XLA reuses the input's HBM for the
+# merge scratch instead of holding input + working set live together.
+# Never used on buffers that outlive the launch (HBM slab-cache entries,
+# the chunked parent matrix that write-through staging gathers from).
+_merge_gc_runs_fused_donated = functools.partial(
+    jax.jit, static_argnames=_FUSED_STATICS,
+    donate_argnums=(0,))(_merge_gc_runs_impl)
+
+
+def _donation_supported() -> bool:
+    """Buffer donation is a no-op (with a per-call warning) on the CPU
+    backend — only donate where the runtime honors it. Doubles as the
+    "H2D really copies" predicate: the CPU backend may alias host numpy
+    memory, so staging arrays are only pooled for reuse on tpu/gpu."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def _use_lexsort() -> bool:
+    """Merge-impl selector for the fused program's `lexsort` static (see
+    _merge_gc_runs_impl): YBTPU_MERGE_LEXSORT=1/0 forces it; auto uses the
+    multi-key lax.sort everywhere except TPU (where its compile takes
+    minutes and the network/pallas paths win)."""
+    env = os.environ.get("YBTPU_MERGE_LEXSORT", "auto").lower()
+    if env in ("1", "true", "on"):
+        return True
+    if env in ("0", "false", "off"):
+        return False
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------
+# Shape-bucket lattice: every static piece of the fused program's compile
+# key is quantized so one tablet's whole compaction lifetime hits a small
+# fixed set of executables (k_pad and m are powers of two by construction;
+# w and n_cmp are quantized here), and the persistent compilation cache
+# (utils/jax_setup.py) makes each bucket a one-time cost per node.
+
+_CMP_LATTICE = (2, 4, 6, 8, 12, 16, 24, 32)
+
+
+def quantize_width(w: int) -> int:
+    """Key-word width bucket: power of two, >= 4 (matches pack_cols'
+    default w_pad so slab-staged and run-staged layouts share buckets)."""
+    return 1 << max(2, (w - 1).bit_length() if w > 1 else 1)
+
+
+def _quantize_cmp(used: List[int]) -> List[int]:
+    """Pad the compare schedule to the next lattice point by repeating its
+    last row. A duplicated compare row is a no-op for the lexicographic
+    comparator (gt/eq are already resolved at the first occurrence), so
+    only the static n_cmp changes — onto ~8 values instead of any int."""
+    for q in _CMP_LATTICE:
+        if len(used) <= q:
+            return used + [used[-1]] * (q - len(used))
+    return used
+
+
+_bucket_keys_seen = set()
+_bucket_lock = __import__("threading").Lock()
+
+
+def _record_bucket(key) -> None:
+    """Executable-bucket hit/miss counters: a 'miss' is the first launch of
+    a (impl, shape, params) bucket in this process — the jit cache compiles
+    (or loads from the persistent cache); every later launch is a hit."""
+    from yugabyte_tpu.utils.metrics import kernel_metrics
+    with _bucket_lock:
+        hit = key in _bucket_keys_seen
+        if not hit:
+            _bucket_keys_seen.add(key)
+    if hit:
+        kernel_metrics().counter(
+            "kernel_compile_bucket_hits_total",
+            "kernel launches that reused an already-compiled shape "
+            "bucket").increment()
+    else:
+        kernel_metrics().counter(
+            "kernel_compile_bucket_misses_total",
+            "first launches of a shape bucket (compile or persistent-"
+            "cache load)").increment()
+
+
+# The shape buckets steady-state universal compaction actually produces:
+# 2/4-slot merges of flush-sized (64k-row) through once-compacted (256k-row)
+# runs at the default 4-word quantized key width, whose full compare
+# schedule (4 words + key_len/ht_hi/ht_lo/write_id) lands on the n_cmp=8
+# lattice point.
+_PREWARM_SHAPES = (
+    (2, 1 << 16, 4, 8),
+    (4, 1 << 16, 4, 8),
+    (2, 1 << 18, 4, 8),
+    (4, 1 << 18, 4, 8),
+)
+
+
+def prewarm_buckets(shapes: Optional[Sequence[Tuple[int, int, int, int]]]
+                    = None) -> int:
+    """Ahead-of-traffic compile of the common fused-kernel buckets.
+
+    Each (k_pad, m, w, n_cmp) bucket lowers + compiles against
+    ShapeDtypeStructs (no device memory touched), populating the
+    persistent compilation cache (utils/jax_setup.py) so the first REAL
+    compaction of each bucket loads a cached executable instead of paying
+    the full XLA compile (107s measured on the tunnel TPU). Run by the
+    tserver maintenance manager at startup (flag-gated); returns how many
+    buckets compiled."""
+    shapes = tuple(shapes) if shapes is not None else _PREWARM_SHAPES
+    lexsort = _use_lexsort()
+    donate = _donation_supported()
+    fn = _merge_gc_runs_fused_donated if donate else _merge_gc_runs_fused
+    compiled = 0
+    for (k_pad, m, w, n_cmp) in shapes:
+        r = _ROW_WORDS + w
+        n = k_pad * m
+        u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+        try:
+            fn.lower(
+                jax.ShapeDtypeStruct((r, n), jnp.uint32),
+                jax.ShapeDtypeStruct((n_cmp,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                u32, u32, u32, u32,
+                k_pad=k_pad, m=m, w=w, n_cmp=n_cmp,
+                is_major=True, retain_deletes=False, snapshot=False,
+                lexsort=lexsort).compile()
+            _record_bucket(("lexsort" if lexsort else "network", k_pad, m,
+                            w, n_cmp, True, False, False, donate))
+            compiled += 1
+        except Exception as e:  # noqa: BLE001 — prewarm must never block
+            import sys as _sys                       # server startup
+            print(f"[run_merge] prewarm of bucket (k_pad={k_pad} m={m} "
+                  f"w={w} n_cmp={n_cmp}) failed: {e!r}",
+                  file=_sys.stderr, flush=True)
+    return compiled
+
+
 @dataclass
 class StagedRuns:
     """K sorted runs laid out run-major on device: [8+w, k_pad*m]."""
@@ -181,6 +335,10 @@ class StagedRuns:
     run_ns: List[int]      # real rows per run (len = real run count)
     cmp_rows: np.ndarray   # pruned compare row ids, MSB-first, + int32
     n_cmp: int
+    # greedy run-packing (pack_runs_greedy): slot i's rows map to input
+    # rows run_maps[i][slot_position] over the concatenation of the
+    # ORIGINAL live runs; None = identity (slot == run)
+    run_maps: Optional[List[np.ndarray]] = None
 
     @property
     def n(self) -> int:
@@ -215,7 +373,8 @@ def _merge_const_stats(per_run: Sequence[Tuple[np.ndarray, np.ndarray]],
 
 
 def _cmp_schedule(w: int, is_const: np.ndarray) -> Tuple[np.ndarray, int]:
-    """Most-significant-first compare rows with constants pruned.
+    """Most-significant-first compare rows with constants pruned, padded to
+    the n_cmp lattice (see _quantize_cmp — n_cmp is a static jit arg).
 
     Order: key words 0..w-1, key_len, ht_hi, ht_lo, write_id (the merge
     comparator; complements for the descending rows are applied on device).
@@ -225,6 +384,7 @@ def _cmp_schedule(w: int, is_const: np.ndarray) -> Tuple[np.ndarray, int]:
     used = [r for r in full if not is_const[r]]
     if not used:
         used = [_ROW_KEY_LEN]  # degenerate: all constant; any row works
+    used = _quantize_cmp(used)
     return np.asarray(used, dtype=np.int32), len(used)
 
 
@@ -233,15 +393,129 @@ def run_bucket(n: int) -> int:
     return 1 << max(8, (n - 1).bit_length() if n > 1 else 1)
 
 
-def stage_runs_from_slabs(slabs: Sequence[KVSlab], device=None) -> StagedRuns:
-    """Pack K sorted slabs into the run-major layout with ONE upload."""
+def plan_run_packing(run_ns: Sequence[int]) -> Optional[List[List[int]]]:
+    """Greedy (first-fit-decreasing) packing of small runs into shared
+    m-slots: bins of combined size <= m (the largest run's bucket).
+
+    The run-major layout pads EVERY run to m; a pick of one big run plus
+    several small ones wastes most of its padded slots (the pad-waste
+    gauges record it). Packing several small runs into one slot cuts the
+    slot count — and often k_pad, halving device work. Returns the bins
+    (lists of run indices, input order preserved within a bin), or None
+    when packing would not shrink k_pad (same padded layout, extra host
+    pre-merge for nothing)."""
+    k = len(run_ns)
+    if k < 2:
+        return None
+    m = max(run_bucket(n) for n in run_ns)
+    order = sorted(range(k), key=lambda i: -run_ns[i])
+    bins: List[List[object]] = []          # [free_slots, [run indices]]
+    for i in order:
+        for b in bins:
+            if b[0] >= run_ns[i]:
+                b[0] -= run_ns[i]
+                b[1].append(i)
+                break
+        else:
+            bins.append([m - run_ns[i], [i]])
+    k_pad_orig = 1 << max(0, (k - 1).bit_length())
+    k_new = len(bins)
+    k_pad_new = 1 << max(0, (k_new - 1).bit_length()) if k_new > 1 else 1
+    if k_pad_new >= k_pad_orig:
+        return None
+    return [sorted(b[1]) for b in bins]
+
+
+def packed_run_ns(run_ns: Sequence[int]) -> List[int]:
+    """Slot sizes after greedy run-packing (the layout-inflation gates
+    score the layout that would ACTUALLY be staged)."""
+    bins = plan_run_packing(run_ns)
+    if bins is None:
+        return list(run_ns)
+    return [sum(run_ns[i] for i in b) for b in bins]
+
+
+def _slab_sort_order(slab: KVSlab) -> np.ndarray:
+    """Merged order of a concatenated slab under the kernel comparator
+    (key words asc, key_len asc, ht desc, write_id desc; stable — ties
+    keep concatenation order, matching the kernel's global-index
+    tiebreak over the slot layout)."""
+    inv = np.uint32(0xFFFFFFFF)
+    keys = [slab.write_id ^ inv, slab.ht_lo ^ inv, slab.ht_hi ^ inv,
+            slab.key_len.astype(np.uint32)]
+    for j in range(slab.width_words - 1, -1, -1):
+        keys.append(slab.key_words[:, j])
+    return np.lexsort(tuple(keys))
+
+
+def _gather_slab_keys(slab: KVSlab, order: np.ndarray) -> KVSlab:
+    """Key-column gather of a slab (values untouched: staging only reads
+    key columns; survivors gather values via the GLOBAL perm later)."""
+    from yugabyte_tpu.ops.slabs import ValueArray
+    return KVSlab(
+        key_words=slab.key_words[order], key_len=slab.key_len[order],
+        doc_key_len=slab.doc_key_len[order], ht_hi=slab.ht_hi[order],
+        ht_lo=slab.ht_lo[order], write_id=slab.write_id[order],
+        flags=slab.flags[order], ttl_ms=slab.ttl_ms[order],
+        value_idx=np.arange(len(order), dtype=np.int32),
+        values=ValueArray.empty_rows(len(order)))
+
+
+def pack_runs_greedy(live: Sequence[KVSlab]
+                     ) -> Tuple[List[KVSlab], Optional[List[np.ndarray]]]:
+    """Apply plan_run_packing to live slabs: bins with >1 run are
+    pre-merged on the host (sorted merge of sorted runs — cheap, they are
+    the SMALL runs) into one sorted slot slab, with a per-slot map from
+    slot position to global input row so the decoded permutation still
+    indexes the original input concatenation."""
+    from yugabyte_tpu.ops.slabs import concat_slabs
+    if os.environ.get("YBTPU_RUN_PACKING", "1") == "0":
+        return list(live), None
+    bins = plan_run_packing([s.n for s in live])
+    if bins is None:
+        return list(live), None
+    bases = np.concatenate(([0], np.cumsum([s.n for s in live])))
+    slot_slabs: List[KVSlab] = []
+    run_maps: List[np.ndarray] = []
+    for idxs in bins:
+        if len(idxs) == 1:
+            i = idxs[0]
+            slot_slabs.append(live[i])
+            run_maps.append(np.arange(bases[i], bases[i] + live[i].n,
+                                      dtype=np.int64))
+            continue
+        cat = concat_slabs([live[i] for i in idxs])
+        gidx = np.concatenate([np.arange(bases[i], bases[i] + live[i].n,
+                                         dtype=np.int64) for i in idxs])
+        order = _slab_sort_order(cat)
+        slot_slabs.append(_gather_slab_keys(cat, order))
+        run_maps.append(gidx[order])
+    from yugabyte_tpu.utils.metrics import kernel_metrics
+    kernel_metrics().counter(
+        "kernel_run_packing_total",
+        "staging calls that packed small runs into shared "
+        "m-slots").increment()
+    return slot_slabs, run_maps
+
+
+def stage_runs_from_slabs(slabs: Sequence[KVSlab], device=None,
+                          pack_runs: bool = True) -> StagedRuns:
+    """Pack K sorted slabs into the run-major layout with ONE upload.
+
+    pack_runs: greedily pack small runs into shared m-slots first
+    (pack_runs_greedy) — cuts the pad waste the kernel gauges expose."""
+    from yugabyte_tpu.storage.device_cache import host_staging_pool
     live = [s for s in slabs if s.n]
+    run_maps = None
+    if pack_runs:
+        live, run_maps = pack_runs_greedy(live)
     k = len(live)
     k_pad = 1 << max(0, (k - 1).bit_length()) if k > 1 else 1
     m = max(run_bucket(s.n) for s in live)
-    w = max(int(s.width_words) for s in live)
+    w = quantize_width(max(int(s.width_words) for s in live))
     r = _ROW_WORDS + w
-    cols = np.empty((r, k_pad * m), dtype=np.uint32)
+    pool = host_staging_pool()
+    cols = pool.acquire((r, k_pad * m))
     cols[:] = pad_template(r)[:, None]
     stats = []
     for i, s in enumerate(live):
@@ -251,8 +525,15 @@ def stage_runs_from_slabs(slabs: Sequence[KVSlab], device=None) -> StagedRuns:
     cmp_rows, n_cmp = _cmp_schedule(w, _merge_const_stats(stats, r))
     cols_dev = (jax.device_put(cols, device) if device is not None
                 else jnp.asarray(cols))
+    if _donation_supported():
+        # the accelerator H2D copy owns its bytes once the put completes;
+        # block for it, then recycle the staging array (the next chunk's
+        # stage-A pack reuses these pages instead of allocating). The CPU
+        # backend may alias host memory, so there the array just drops.
+        jax.block_until_ready(cols_dev)
+        pool.release(cols)
     return StagedRuns(cols_dev, m, k_pad, w, [s.n for s in live],
-                      cmp_rows, n_cmp)
+                      cmp_rows, n_cmp, run_maps=run_maps)
 
 
 def stage_runs_from_staged(staged_list: Sequence[StagedCols]) -> StagedRuns:
@@ -330,9 +611,21 @@ class MergeGCHandle:
         Arrays cover exactly the real rows (length n = sum(run_ns)).
         """
         if self._result is None:
+            from yugabyte_tpu.utils.metrics import record_pipeline_stage
+            import time as _time
+            t0 = _time.monotonic()
             packed = np.asarray(self._packed_dev)  # [n_pad//32, 2+b]
+            t1 = _time.monotonic()
             self._result = _decode_packed(packed, self._staged)
+            record_pipeline_stage("device", (t1 - t0) * 1e3)
+            record_pipeline_stage("host", (_time.monotonic() - t1) * 1e3)
         return self._result
+
+    def result_iter(self):
+        """Streaming form of result(): yields (perm, keep, make_tombstone)
+        once — the single-launch degenerate case of the chunked handle's
+        per-chunk stream, so pipeline consumers handle both uniformly."""
+        yield self.result()
 
 
 def _decode_packed(packed: np.ndarray, staged: StagedRuns
@@ -345,6 +638,8 @@ def _decode_packed(packed: np.ndarray, staged: StagedRuns
     keep = _unpack_words(grp[:, 0], n)
     mk = _unpack_words(grp[:, 1], n)
     if staged.k_pad == 1:
+        if staged.run_maps is not None:
+            return staged.run_maps[0][:n].copy(), keep, mk
         return np.arange(n, dtype=np.int64), keep, mk
     b = max(1, (staged.k_pad - 1).bit_length())
     src = np.zeros(n, dtype=np.uint32)
@@ -353,13 +648,17 @@ def _decode_packed(packed: np.ndarray, staged: StagedRuns
     # reconstruct the permutation: the merge consumes each run in order,
     # so output position i with source run r maps to the next unconsumed
     # row of r. Padding sorts after every real key, so positions [0, n)
-    # are exactly the real rows.
+    # are exactly the real rows. Packed slots (run_maps) translate slot
+    # consumption order to the original input rows.
     perm = np.zeros(n, dtype=np.int64)
     base = np.concatenate(([0], np.cumsum(staged.run_ns)))
     for r_i in range(len(staged.run_ns)):
         sel = src == r_i
         cnt = int(sel.sum())
-        perm[sel] = base[r_i] + np.arange(cnt, dtype=np.int64)
+        if staged.run_maps is not None:
+            perm[sel] = staged.run_maps[r_i][:cnt]
+        else:
+            perm[sel] = base[r_i] + np.arange(cnt, dtype=np.int64)
     return perm, keep, mk
 
 
@@ -468,11 +767,22 @@ _W_ROUTE_CHUNK = 4
 def _chunk_target_rows() -> int:
     """YBTPU_MERGE_CHUNK_ROWS: target padded rows per chunk launch.
     Values below 1024 (including 0 and negatives) disable chunking — a
-    tiny target would explode into one chunk per handful of rows."""
+    tiny target would explode into one chunk per handful of rows.
+
+    Unset, chunking is on for TPU only. It exists to bound the compiled
+    shape (the multi-minute Mosaic/XLA compile scales with n there) and
+    to stream decision downloads over the tunnel; on the CPU fallback the
+    lexsort impl compiles in seconds at ANY shape, while the chunk
+    machinery costs real work — splitter sampling is a synchronous
+    device round-trip inside launch and every carve copies the matrix —
+    so chunking LOWERED CPU steady throughput ~15% when measured."""
+    env = os.environ.get("YBTPU_MERGE_CHUNK_ROWS")
+    if env is None:
+        return (1 << 20) if jax.default_backend() == "tpu" else 0
     try:
-        t = int(os.environ.get("YBTPU_MERGE_CHUNK_ROWS", 1 << 20))
+        t = int(env)
     except ValueError:
-        return 1 << 20
+        return (1 << 20) if jax.default_backend() == "tpu" else 0
     return t if t >= 1024 else 0
 
 
@@ -589,14 +899,21 @@ class _ChunkedMergeGCHandle:
         if os.environ.get("YBTPU_FUSED_DOWNLOAD", "1") == "0":
             return [h.result() for h in hs]
         try:
+            import time as _time
+            from yugabyte_tpu.utils.metrics import record_pipeline_stage
             devs = [h._packed_dev for h in hs]
             if len({d.shape[1] for d in devs}) == 1:
                 rows = [d.shape[0] for d in devs]
+                t0 = _time.monotonic()
                 cat = np.asarray(jnp.concatenate(devs, axis=0))
+                t1 = _time.monotonic()
+                record_pipeline_stage("device", (t1 - t0) * 1e3)
                 out, off = [], 0
                 for h, r in zip(hs, rows):
                     out.append(_decode_packed(cat[off:off + r], h._staged))
                     off += r
+                record_pipeline_stage("host",
+                                      (_time.monotonic() - t1) * 1e3)
                 return out
         except Exception as e:  # noqa: BLE001 — degrade, never fail here
             import sys as _sys
@@ -604,24 +921,68 @@ class _ChunkedMergeGCHandle:
                   f"per-chunk path: {e!r}", file=_sys.stderr, flush=True)
         return [h.result() for h in hs]
 
+    def _remap_perm(self, p: np.ndarray, starts: np.ndarray,
+                    lens: np.ndarray) -> np.ndarray:
+        """Chunk-local perm (over the chunk's slot concatenation) ->
+        global input-row indices, through the slice offsets and — when the
+        slots were greedily packed — the per-slot run_maps."""
+        staged = self._staged
+        k_live = len(staged.run_ns)
+        lb = np.concatenate(([0], np.cumsum(lens)))
+        run_of = np.searchsorted(lb[1:], p, side="right")
+        slot_pos = p - lb[run_of] + starts[run_of]
+        if staged.run_maps is None:
+            grb = np.concatenate(([0], np.cumsum(staged.run_ns)))
+            return grb[:k_live][run_of] + slot_pos
+        out = np.empty(len(p), dtype=np.int64)
+        for r_i in range(k_live):
+            selr = run_of == r_i
+            if selr.any():
+                out[selr] = staged.run_maps[r_i][slot_pos[selr]]
+        return out
+
     def result(self):
         if self._result is not None:
             return self._result
-        staged = self._staged
-        k_live = len(staged.run_ns)
-        grb = np.concatenate(([0], np.cumsum(staged.run_ns)))
         perms, keeps, mks = [], [], []
         for (p, keep, mk), (starts, lens) in zip(self._chunk_results(),
                                                  self._metas):
-            lb = np.concatenate(([0], np.cumsum(lens)))
-            run_of = np.searchsorted(lb[1:], p, side="right")
-            perms.append(p - lb[run_of] + grb[:k_live][run_of]
-                         + starts[run_of])
+            perms.append(self._remap_perm(p, starts, lens))
             keeps.append(keep)
             mks.append(mk)
         self._result = (np.concatenate(perms), np.concatenate(keeps),
                         np.concatenate(mks))
         return self._result
+
+    def result_iter(self):
+        """Stream per-chunk (perm, keep, make_tombstone) — the stage-C
+        hand-off of the compaction pipeline. Chunks are range-partitioned
+        by route, so chunk-order concatenation IS the global merged order:
+        the consumer (storage/compaction.py's streaming SST writer) can
+        write chunk i's survivors while chunks i+1.. still compute or
+        ride the link. All pending packed buffers start their async D2H
+        up front; the full result is memoized so a later result() call
+        pays nothing extra."""
+        if self._result is not None:
+            yield self._result
+            return
+        for h in self._handles:
+            pd = getattr(h, "_packed_dev", None)
+            if pd is not None:
+                try:
+                    pd.copy_to_host_async()
+                except (AttributeError, NotImplementedError):
+                    pass
+        perms, keeps, mks = [], [], []
+        for h, (starts, lens) in zip(self._handles, self._metas):
+            p, keep, mk = h.result()
+            perm_g = self._remap_perm(p, starts, lens)
+            perms.append(perm_g)
+            keeps.append(keep)
+            mks.append(mk)
+            yield perm_g, keep, mk
+        self._result = (np.concatenate(perms), np.concatenate(keeps),
+                        np.concatenate(mks))
 
     def to_parent_products(self) -> None:
         """Build the parent-domain device arrays gather_staged_outputs
@@ -710,9 +1071,12 @@ def _launch_chunked(staged: StagedRuns, params: GCParams, snapshot: bool,
                          staged.cmp_rows, staged.n_cmp)
         # host_async=False: the parent handle fuses all chunks' packed
         # buffers into one concat + download; per-chunk async D2H would
-        # move the same bytes twice over the tunnel
+        # move the same bytes twice over the tunnel. donate=True: the
+        # carved matrix is transient (only this launch reads it), so XLA
+        # reuses its HBM in place instead of holding chunk input + merge
+        # working set live together
         handles.append(launch_merge_gc(sub, params, snapshot=snapshot,
-                                       host_async=False))
+                                       host_async=False, donate=True))
         metas.append((starts[:k_live].astype(np.int64),
                       lens[:k_live].astype(np.int64)))
     if not handles:
@@ -827,6 +1191,11 @@ class _PallasFallbackHandle:
                                               snapshot=snapshot)
             return self._effective.result()
 
+    def result_iter(self):
+        """Explicit (not via __getattr__): the inner handle's iterator
+        would bypass the fallback try/except around .result()."""
+        yield self.result()
+
     def __getattr__(self, name):
         # delegate device-resident merge products (_staged, _perm_dev,
         # _keep_dev, _mk_dev) to whichever handle actually produced the
@@ -838,7 +1207,13 @@ class _PallasFallbackHandle:
 
 def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot: bool = False,
-                    host_async: bool = True) -> MergeGCHandle:
+                    host_async: bool = True,
+                    donate: bool = False) -> MergeGCHandle:
+    """donate: the caller promises staged.cols_dev is TRANSIENT (a carved
+    subcompaction chunk or a per-chunk pipeline upload that nothing reads
+    after this launch) — the fused program then donates it so XLA reuses
+    its HBM for the merge scratch. Never set for slab-cache entries or a
+    chunked parent matrix (write-through staging gathers from those)."""
     global _pallas_broken
     from yugabyte_tpu.utils.metrics import (kernel_metrics,
                                             record_kernel_dispatch)
@@ -878,6 +1253,9 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
             kernel_metrics().counter(
                 "kernel_pallas_merge_total",
                 "merges launched on the pallas kernel").increment()
+            _record_bucket(("pallas", staged.k_pad, staged.m, staged.w,
+                            staged.n_cmp, params.is_major_compaction,
+                            params.retain_deletes, snapshot))
             return h if explicit else _PallasFallbackHandle(
                 h, staged, params, snapshot)
     kernel_metrics().counter(
@@ -885,16 +1263,24 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
         "merges launched on the jnp bitonic network").increment()
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
+    lexsort = _use_lexsort()
+    use_donate = donate and _donation_supported()
+    fn = _merge_gc_runs_fused_donated if use_donate else _merge_gc_runs_fused
+    _record_bucket(("lexsort" if lexsort else "network", staged.k_pad,
+                    staged.m, staged.w, staged.n_cmp,
+                    params.is_major_compaction, params.retain_deletes,
+                    snapshot, use_donate))
     # runtime iota operand: see merge_network's pos docstring (compile-
     # time constant folding of per-stage parity masks)
     pos = jnp.arange(staged.n_pad, dtype=jnp.int32)
-    packed, perm, keep, mk = _merge_gc_runs_fused(
+    packed, perm, keep, mk = fn(
         staged.cols_dev, jnp.asarray(staged.cmp_rows), pos,
         jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
         k_pad=staged.k_pad, m=staged.m, w=staged.w, n_cmp=staged.n_cmp,
         is_major=params.is_major_compaction,
-        retain_deletes=params.retain_deletes, snapshot=snapshot)
+        retain_deletes=params.retain_deletes, snapshot=snapshot,
+        lexsort=lexsort)
     return MergeGCHandle(packed, staged, perm, keep, mk,
                          host_async=host_async)
 

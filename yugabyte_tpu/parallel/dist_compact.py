@@ -170,7 +170,8 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
     input slab row that produced merged position i (valid where keep/mk
     apply — padding positions carry sentinel indices and keep=False)."""
     import time as _time
-    from yugabyte_tpu.utils.metrics import record_kernel_dispatch
+    from yugabyte_tpu.utils.metrics import (record_kernel_dispatch,
+                                            record_pipeline_stage)
     t0 = _time.monotonic()
     n_shards = mesh.devices.size
     cols = pack_cols(slab)[0]
@@ -188,15 +189,26 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
     cutoff_phys = cutoff >> 12
     fn = dist_compact_fn(mesh, capacity, params.is_major_compaction,
                          params.retain_deletes, axis)
+    t_dev = _time.monotonic()
+    record_pipeline_stage("host", (t_dev - t0) * 1e3)
     out, keep, mk, overflow, src_idx = fn(
         cols, jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
         jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF))
+    # the chunk hand-off back to the host: kick every shard output's D2H
+    # in one async wave (the overflow word decides retry first, so the
+    # big buffers ride the link while the host inspects the small one)
+    for a in (out, keep, mk, src_idx):
+        try:
+            a.copy_to_host_async()
+        except (AttributeError, NotImplementedError):
+            pass
     if bool(np.any(np.asarray(overflow))):
         if capacity_factor >= 64:
             raise RuntimeError("distributed compaction bucket overflow at 64x")
         return distributed_compact(slab, params, mesh, axis, capacity_factor * 2)
     result = (np.asarray(out), np.asarray(keep), np.asarray(mk),
               np.asarray(src_idx).astype(np.int64))
+    record_pipeline_stage("device", (_time.monotonic() - t_dev) * 1e3)
     record_kernel_dispatch("kernel_dist_compact", slab.n, cols.shape[1],
                            (_time.monotonic() - t0) * 1e3)
     return result

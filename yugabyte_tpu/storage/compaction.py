@@ -352,6 +352,84 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                                 np.count_nonzero(tomb_flags)))
 
 
+class _StreamingNativeWriter:
+    """Stage C of the compaction pipeline: write output SSTs from survivor
+    spans AS THE SPANS FILL, instead of after the whole decision download.
+
+    feed(n_available) is called each time a pipeline chunk's survivors
+    land in the shell (NativeCompactionJob.append_survivors) — it writes
+    every output file whose full [start, start+max_rows) span is already
+    covered, so the native block encode + file I/O of file i overlaps the
+    device compute / D2H of chunks i+1... finish() writes the tail.
+
+    File splits, pacing, tombstone and base-assembly rules are EXACTLY
+    those of _write_native_outputs (which delegates here), so pipelined
+    and sequential jobs produce byte-identical files over identical
+    ranges. A full span is only written from feed() while strictly more
+    survivors are known to exist — the final span (full or partial) goes
+    through finish(), which never pace-sleeps after the last file."""
+
+    def __init__(self, job, out_dir: str, new_file_id, fr,
+                 block_entries: Optional[int], has_deep: bool = False):
+        self._job = job
+        self._out_dir = out_dir
+        self._new_file_id = new_file_id
+        self._fr = fr
+        self._has_deep = has_deep
+        self._block_entries = (block_entries if block_entries is not None
+                               else flags.get_flag("sst_block_entries"))
+        self._max_rows = flags.get_flag(
+            "compaction_max_output_entries_per_sst")
+        self._limiter = compaction_rate_limiter()
+        self._tombstone_value = Value.tombstone().encode()
+        self._next_start = 0
+        self.outputs: List[Tuple[int, str, SSTProps]] = []
+        self.ranges: List[Tuple[int, int]] = []
+
+    def _write_span(self, start: int, end: int, more_coming: bool) -> None:
+        import time as _time
+        from yugabyte_tpu.storage.sst import data_file_name, write_base_file
+        from yugabyte_tpu.utils.metrics import record_pipeline_stage
+        t0 = _time.monotonic()
+        fid = self._new_file_id()
+        base_path = os.path.join(self._out_dir, f"{fid:06d}.sst")
+        size, index, hashes, fk, lk = self._job.write_output(
+            start, end, data_file_name(base_path), self._block_entries,
+            compress=sst_compression_enabled(),
+            tombstone_value=self._tombstone_value)
+        props = write_base_file(base_path, index, end - start, hashes,
+                                fk, lk, self._fr, size,
+                                has_deep=self._has_deep)
+        self.outputs.append((fid, base_path, props))
+        self.ranges.append((start, end))
+        record_pipeline_stage("write", (_time.monotonic() - t0) * 1e3)
+        if self._limiter is not None and more_coming:
+            # pace between files; no debt-sleep after the last one (it
+            # would only delay install while writing nothing)
+            self._limiter.acquire(props.data_size + props.base_size)
+
+    def feed(self, n_available: int) -> None:
+        # strictly >: an exactly-full final span must come from finish()
+        # (we cannot know here whether more survivors follow, and the
+        # sequential path never paces after the last file)
+        while n_available - self._next_start > self._max_rows:
+            self._write_span(self._next_start,
+                             self._next_start + self._max_rows,
+                             more_coming=True)
+            self._next_start += self._max_rows
+
+    def finish(self, rows_out: int
+               ) -> Tuple[List[Tuple[int, str, SSTProps]],
+                          List[Tuple[int, int]]]:
+        start = self._next_start
+        while start < rows_out:
+            end = min(start + self._max_rows, rows_out)
+            self._write_span(start, end, more_coming=end < rows_out)
+            start = end
+        self._next_start = start
+        return self.outputs, self.ranges
+
+
 def _write_native_outputs(job, out_dir: str, new_file_id, fr,
                           block_entries: Optional[int],
                           has_deep: bool = False
@@ -359,37 +437,17 @@ def _write_native_outputs(job, out_dir: str, new_file_id, fr,
                                      List[Tuple[int, int]]]:
     """Write the native job's survivors as (possibly split) output SSTs,
     pacing between files (shared by the pure-native and device+native
-    paths — the pacing/tombstone/base-assembly rules live once).
+    paths — the pacing/tombstone/base-assembly rules live once in
+    _StreamingNativeWriter; this is its everything-already-available
+    form).
 
     Returns (outputs, ranges): ranges[i] is the [start, end) survivor span
     written to outputs[i] — the single authority for file splits (the
     device write-through gathers exactly these spans; re-deriving them
     from the flag would silently desync if the flag changes mid-job)."""
-    from yugabyte_tpu.storage.sst import data_file_name, write_base_file
-
-    tombstone_value = Value.tombstone().encode()
-    limiter = compaction_rate_limiter()
-    if block_entries is None:
-        block_entries = flags.get_flag("sst_block_entries")
-    rows_out = job.n_survivors
-    outputs: List[Tuple[int, str, SSTProps]] = []
-    ranges: List[Tuple[int, int]] = []
-    max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
-    for start in range(0, rows_out, max_rows):
-        end = min(start + max_rows, rows_out)
-        fid = new_file_id()
-        base_path = os.path.join(out_dir, f"{fid:06d}.sst")
-        size, index, hashes, fk, lk = job.write_output(
-            start, end, data_file_name(base_path), block_entries,
-            compress=sst_compression_enabled(),
-            tombstone_value=tombstone_value)
-        props = write_base_file(base_path, index, end - start, hashes,
-                                fk, lk, fr, size, has_deep=has_deep)
-        outputs.append((fid, base_path, props))
-        ranges.append((start, end))
-        if limiter is not None and end < rows_out:
-            limiter.acquire(props.data_size + props.base_size)
-    return outputs, ranges
+    writer = _StreamingNativeWriter(job, out_dir, new_file_id, fr,
+                                    block_entries, has_deep=has_deep)
+    return writer.finish(job.n_survivors)
 
 
 def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
@@ -479,26 +537,20 @@ def run_compaction_job_device_native(
                                   input_ids=orig_input_ids,
                                   _no_combined=True)
 
-    # 1) launch the device decisions from the HBM slab cache
-    staged_list = []
-    for r, fid in zip(inputs, input_ids or [None] * len(inputs)):
-        st = device_cache.get(fid) if (device_cache is not None
-                                       and fid is not None) else None
-        if st is None:
-            slab = r.read_all()
-            st = (device_cache.stage(fid, slab)
-                  if device_cache is not None and fid is not None
-                  else stage_slab(slab, device))
-        staged_list.append(st)
-    staged_runs = run_merge.stage_runs_from_staged(staged_list)
-    params = GCParams(history_cutoff_ht, is_major, retain_deletes)
-    handle = run_merge.launch_merge_gc(staged_runs, params)
+    import threading
+    import time as _time
+    from yugabyte_tpu.utils.metrics import record_pipeline_stage
+
+    pipeline = os.environ.get("YBTPU_PIPELINE", "1").lower() \
+        not in ("0", "false", "off")
 
     # cached-run ids, in INPUT ORDER (the device survivor indexes are
     # run-major over exactly this order) — all-or-nothing: a partial hit
     # still pays the file path for every input. contains() first so a
     # partial-hit job neither inflates hit metrics nor promotes entries
-    # it never consumes; get() only once every input is present.
+    # it never consumes; get() only once every input is present. Probed
+    # BEFORE the ingest thread starts (the probes are cheap and the
+    # thread must not race the run-cache's LRU bookkeeping).
     cached_ids = None
     if run_cache is not None and input_ids is not None \
             and all(run_cache.contains(fid) for fid in input_ids):
@@ -506,42 +558,132 @@ def run_compaction_job_device_native(
         if all(i is not None for i in ids):
             cached_ids = ids
 
-    # 2) native shell ingests the same inputs while the device works:
-    #    steady state takes the zero-decode run-cache path (no file read,
-    #    no block decode/CRC — the bytes were retained when these SSTs
-    #    were produced); cold inputs pay the full decode
     tombstone_value = Value.tombstone().encode()
     with native_engine.NativeCompactionJob() as job:
-        pinned = False
-        if cached_ids is not None:
-            try:
-                # add_cached pins each run (C++ shared_ptr) — an entry
-                # evicted between the probe above and here raises, and
-                # the job falls back to the file path (stray pinned runs
-                # are ignored by prepare() and freed at job close)
-                for rid in cached_ids:
-                    job.add_cached(rid)
-                pinned = True
-            except KeyError:
-                pinned = False
-        if pinned:
-            rows_in = job.prepare_cached()
-        else:
-            for r in inputs:
-                with open(r.data_path, "rb") as f:
-                    job.add_input(f.read(), r.block_handles)
-            rows_in = job.prepare()
+        # -- stage A (host): the native shell ingests the input bytes on
+        # its own thread — file reads, block decode and CRC all release
+        # the GIL, so this overlaps the device staging + kernel dispatch
+        # below. Steady state takes the zero-decode run-cache path (the
+        # bytes were retained when these SSTs were produced).
+        ingest = {"rows_in": None, "err": None}
 
-        # 3) inject the decisions; the shell writes the outputs
-        perm, keep, mk = handle.result()
-        tombstones_written = int(np.count_nonzero(mk[keep]))
-        job.set_survivors(perm[keep], mk[keep])
-        rows_out = job.n_survivors
+        def _ingest_inputs():
+            t0 = _time.monotonic()
+            try:
+                pinned = False
+                if cached_ids is not None:
+                    try:
+                        # add_cached pins each run (C++ shared_ptr) — an
+                        # entry evicted between the probe above and here
+                        # raises, and the job falls back to the file path
+                        # (stray pinned runs are ignored by prepare() and
+                        # freed at job close)
+                        for rid in cached_ids:
+                            job.add_cached(rid)
+                        pinned = True
+                    except KeyError:
+                        pinned = False
+                if pinned:
+                    ingest["rows_in"] = job.prepare_cached()
+                else:
+                    for r in inputs:
+                        with open(r.data_path, "rb") as f:
+                            job.add_input(f.read(), r.block_handles)
+                    ingest["rows_in"] = job.prepare()
+            except BaseException as e:  # noqa: BLE001 — re-raised on join
+                ingest["err"] = e
+            finally:
+                record_pipeline_stage(
+                    "host", (_time.monotonic() - t0) * 1e3)
+
+        ingest_thread = None
+        if pipeline:
+            ingest_thread = threading.Thread(
+                target=_ingest_inputs, name="compaction-ingest",
+                daemon=True)
+            ingest_thread.start()
+
+        try:
+            # -- stage B: stage the key columns (HBM slab-cache hits skip
+            # the upload; misses decode on host threads) and dispatch the
+            # fused merge+GC — asynchronously, chunked and double-buffered
+            # inside launch_merge_gc, with the carved chunk buffers
+            # donated so XLA reuses their HBM in place.
+            t_stage = _time.monotonic()
+            misses = [i for i, (r, fid) in enumerate(
+                zip(inputs, input_ids or [None] * len(inputs)))
+                if not (device_cache is not None and fid is not None
+                        and device_cache.contains(fid))]
+            slabs_by_idx = {}
+            if pipeline and len(misses) > 1:
+                # cold inputs: decode SST blocks in parallel host threads
+                # (read_all is numpy + file I/O, GIL-light); uploads stay
+                # serial below — device_put ordering is the staging order
+                def _read(i):
+                    slabs_by_idx[i] = inputs[i].read_all()
+                readers = [threading.Thread(target=_read, args=(i,),
+                                            daemon=True) for i in misses]
+                for t in readers:
+                    t.start()
+                for t in readers:
+                    t.join()
+            staged_list = []
+            for i, (r, fid) in enumerate(
+                    zip(inputs, input_ids or [None] * len(inputs))):
+                st = device_cache.get(fid) if (device_cache is not None
+                                               and fid is not None) else None
+                if st is None:
+                    slab = slabs_by_idx.get(i)
+                    if slab is None:
+                        slab = r.read_all()
+                    st = (device_cache.stage(fid, slab)
+                          if device_cache is not None and fid is not None
+                          else stage_slab(slab, device))
+                staged_list.append(st)
+            staged_runs = run_merge.stage_runs_from_staged(staged_list)
+            params = GCParams(history_cutoff_ht, is_major, retain_deletes)
+            handle = run_merge.launch_merge_gc(staged_runs, params)
+            record_pipeline_stage("host",
+                                  (_time.monotonic() - t_stage) * 1e3)
+        finally:
+            # the thread calls into the C++ job; it MUST finish before any
+            # unwind can free the job (use-after-free otherwise)
+            if ingest_thread is not None:
+                ingest_thread.join()
+        if ingest_thread is None:
+            _ingest_inputs()
+        if ingest["err"] is not None:
+            raise ingest["err"]
+        rows_in = ingest["rows_in"]
+
+        # -- stage C: stream each chunk's decisions into the shell as its
+        # download lands, writing every output file whose survivor span
+        # is already complete — device compute, D2H transfer and native
+        # encode/file I/O overlap instead of serializing.
         fr = _merge_frontiers([r.props.frontier for r in all_inputs],
                               history_cutoff_ht)
-        outputs, ranges = _write_native_outputs(
-            job, out_dir, new_file_id, fr, block_entries,
-            has_deep=any(r.props.has_deep for r in inputs))
+        has_deep = any(r.props.has_deep for r in inputs)
+        tombstones_written = 0
+        if pipeline:
+            writer = _StreamingNativeWriter(job, out_dir, new_file_id, fr,
+                                            block_entries,
+                                            has_deep=has_deep)
+            for perm_c, keep_c, mk_c in handle.result_iter():
+                surv = perm_c[keep_c]
+                mk_surv = mk_c[keep_c]
+                tombstones_written += int(np.count_nonzero(mk_surv))
+                job.append_survivors(surv, mk_surv)
+                writer.feed(job.n_survivors)
+            rows_out = job.n_survivors
+            outputs, ranges = writer.finish(rows_out)
+        else:
+            perm, keep, mk = handle.result()
+            tombstones_written = int(np.count_nonzero(mk[keep]))
+            job.set_survivors(perm[keep], mk[keep])
+            rows_out = job.n_survivors
+            outputs, ranges = _write_native_outputs(
+                job, out_dir, new_file_id, fr, block_entries,
+                has_deep=has_deep)
         if run_cache is not None:
             # run-cache write-through: exported survivors are
             # byte-equivalent to re-decoding the files just written, so

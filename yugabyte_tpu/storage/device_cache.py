@@ -141,6 +141,72 @@ class NamespacedSlabCache:
         return self._shared.stage((self.namespace, file_id), slab)
 
 
+class HostStagingPool:
+    """Reusable host-side staging arrays for stage A of the compaction
+    pipeline (ops/run_merge.stage_runs_from_slabs packs column matrices
+    into these before the H2D upload).
+
+    Shape buckets make reuse effective: every chunk of a pipelined job
+    (and most jobs of a tablet's lifetime) stages the same [r, k_pad*m]
+    matrix shape, so after warmup the host never allocates — the pinned
+    pages stay hot and the allocator never fragments under a double-
+    buffered producer that holds two staging arrays in flight.
+
+    Callers must only release() an array once the upload has COPIED it
+    (true on tpu/gpu backends; the CPU backend may alias host memory, so
+    its callers skip release and the array is simply garbage-collected).
+    """
+
+    def __init__(self, max_per_shape: int = 2, max_bytes: int = 1 << 30):
+        self._free: dict = {}
+        self._bytes = 0
+        self._max_per_shape = max_per_shape
+        self._max_bytes = max_bytes
+        self._lock = threading.Lock()
+        from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+        e = ROOT_REGISTRY.entity("server", "device_cache")
+        self._c_reuse = e.counter(
+            "staging_pool_reuse_total",
+            "stage-A packings served from a pooled host array")
+        self._c_alloc = e.counter(
+            "staging_pool_alloc_total",
+            "stage-A packings that allocated a fresh host array")
+
+    def acquire(self, shape: Tuple[int, int], dtype=np.uint32) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            bucket = self._free.get(key)
+            if bucket:
+                arr = bucket.pop()
+                self._bytes -= arr.nbytes
+                self._c_reuse.increment()
+                return arr
+        self._c_alloc.increment()
+        return np.empty(shape, dtype=dtype)
+
+    def release(self, arr: np.ndarray) -> None:
+        key = (arr.shape, arr.dtype.str)
+        with self._lock:
+            bucket = self._free.setdefault(key, [])
+            if (len(bucket) < self._max_per_shape
+                    and self._bytes + arr.nbytes <= self._max_bytes):
+                bucket.append(arr)
+                self._bytes += arr.nbytes
+
+
+_staging_pool: Optional[HostStagingPool] = None
+_staging_pool_lock = threading.Lock()
+
+
+def host_staging_pool() -> HostStagingPool:
+    """Process-wide staging pool (one per process, like the slab cache)."""
+    global _staging_pool
+    with _staging_pool_lock:
+        if _staging_pool is None:
+            _staging_pool = HostStagingPool()
+        return _staging_pool
+
+
 def concat_staged(staged_list: Sequence[StagedCols]) -> StagedCols:
     """Concatenate staged inputs ON DEVICE into one padded cols matrix.
 

@@ -73,6 +73,8 @@ def _load():
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32]
         lib.ce_job_set_survivors.argtypes = [
             ctypes.c_void_p, _i64p, _u8p, ctypes.c_int64]
+        lib.ce_job_append_survivors.argtypes = [
+            ctypes.c_void_p, _i64p, _u8p, ctypes.c_int64]
         lib.ce_job_rows.restype = ctypes.c_int64
         lib.ce_job_rows.argtypes = [ctypes.c_void_p]
         lib.ce_job_n_survivors.restype = ctypes.c_int64
@@ -258,6 +260,19 @@ class NativeCompactionJob:
             self._job, surv.ctypes.data_as(_i64p), mk.ctypes.data_as(_u8p),
             ctypes.c_int64(len(surv)))
         self.n_survivors = len(surv)
+
+    def append_survivors(self, surv: np.ndarray,
+                         make_tomb: np.ndarray) -> None:
+        """Stage-C streaming injection: append one pipeline chunk's
+        survivors (already in global merged order — chunks are route-
+        partitioned) so output spans covered by appended survivors can be
+        written while later chunks still compute or transfer."""
+        surv = np.ascontiguousarray(surv, dtype=np.int64)
+        mk = np.ascontiguousarray(make_tomb, dtype=np.uint8)
+        self._lib.ce_job_append_survivors(
+            self._job, surv.ctypes.data_as(_i64p), mk.ctypes.data_as(_u8p),
+            ctypes.c_int64(len(surv)))
+        self.n_survivors += len(surv)
 
     def export_run(self, start: int, end: int,
                    tombstone_value: bytes) -> int:

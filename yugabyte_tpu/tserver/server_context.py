@@ -127,6 +127,17 @@ class ServerExecutionContext:
             # not refresh-time gauge mirrors
             self._entity = e
 
+    def prewarm_op(self):
+        """The one-shot maintenance op that compiles the common
+        compaction-kernel shape buckets at startup (flag-gated; see
+        tserver/maintenance_manager.PrewarmKernelsOp). None when this
+        server has no JAX device — the native path compiles nothing."""
+        if self.device == "native":
+            return None
+        from yugabyte_tpu.tserver.maintenance_manager import (
+            PrewarmKernelsOp)
+        return PrewarmKernelsOp()
+
     def tablet_options(self) -> TabletOptions:
         return TabletOptions(device=self.device,
                              mesh=self.mesh,

@@ -106,6 +106,12 @@ class TabletServer:
             # then re-bootstrap (sealed WAL) via the tablet manager
             recover_fn=lambda peer: self.tablet_manager
             .recover_failed_tablet(peer.tablet_id))
+        if self.exec_context is not None:
+            # one-shot startup compile of the common compaction-kernel
+            # shape buckets (flag-gated; no-op for device="native")
+            prewarm = self.exec_context.prewarm_op()
+            if prewarm is not None:
+                self.maintenance_manager.register_op(prewarm)
         self.webserver = None
         if opts.webserver_port is not None:
             from yugabyte_tpu.server.webserver import Webserver
@@ -155,8 +161,24 @@ class TabletServer:
         totals["write_amplification"] = round(
             (ingested + totals["compaction_bytes_written"]) / ingested,
             3) if ingested else 0.0
+        # where offloaded-compaction wall time went (host decode/pack vs
+        # device compute+transfer vs native output I/O) plus the shape-
+        # bucket executable reuse — the pipeline-stall view of the page
+        from yugabyte_tpu.utils.metrics import (kernel_metrics,
+                                                pipeline_stage_totals)
+        ke = kernel_metrics()
+        pipeline = {f"stage_{k}_ms": round(v, 1)
+                    for k, v in pipeline_stage_totals().items()}
+        pipeline["compile_bucket_hits"] = ke.counter(
+            "kernel_compile_bucket_hits_total",
+            "kernel launches that reused an already-compiled shape "
+            "bucket").value()
+        pipeline["compile_bucket_misses"] = ke.counter(
+            "kernel_compile_bucket_misses_total",
+            "first launches of a shape bucket (compile or persistent-"
+            "cache load)").value()
         return {"server_id": self.server_id, "totals": totals,
-                "tablets": tablets}
+                "pipeline": pipeline, "tablets": tablets}
 
     def _status_page(self) -> dict:
         if self.exec_context is not None:

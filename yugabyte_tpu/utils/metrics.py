@@ -299,6 +299,35 @@ def kernel_metrics() -> MetricEntity:
     return ROOT_REGISTRY.entity("server", "kernels")
 
 
+_PIPELINE_STAGES = ("host", "device", "write")
+
+
+def record_pipeline_stage(stage: str, ms: float) -> None:
+    """One slice of compaction-pipeline wall time: `stage` is where the
+    time went — 'host' (SST block decode + column packing + decision
+    decode), 'device' (kernel compute + H2D/D2H transfer waits) or
+    'write' (native byte-shell SST output I/O). Per-stage histograms plus
+    a cumulative-ms gauge feed /compactionz and bench.py's stage report,
+    so a stalled pipeline shows WHICH stage is the bottleneck."""
+    e = kernel_metrics()
+    e.histogram(f"compaction_pipeline_stage_{stage}_ms",
+                f"compaction pipeline {stage}-stage wall time per "
+                "slice").increment(max(ms, 0.0))
+    e.gauge(f"compaction_pipeline_stage_{stage}_total_ms",
+            f"cumulative compaction pipeline {stage}-stage wall "
+            "time").increment(max(ms, 0.0))
+
+
+def pipeline_stage_totals() -> Dict[str, float]:
+    """Cumulative per-stage pipeline milliseconds (host/device/write) —
+    the snapshot bench.py diffs around a run to report where the wall
+    time of the offloaded compactions went."""
+    e = kernel_metrics()
+    return {s: float(e.gauge(
+        f"compaction_pipeline_stage_{s}_total_ms").value())
+        for s in _PIPELINE_STAGES}
+
+
 def record_kernel_dispatch(kind: str, n_rows: int, n_pad: int,
                            duration_ms: Optional[float] = None) -> None:
     """One JAX-kernel dispatch: invocation counter, wall-time histogram,

@@ -128,7 +128,11 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
                     "literal"))
             if v is P.MARKER:
                 out.append(schema.column(base).type)
-        return out + where_types(schema, stmt.where)
+        # LWT IF-clause markers bind after the WHERE markers in statement
+        # order (UPDATE ... WHERE k = ? IF v = ?); conditions share the
+        # (col, op, value) shape where_types walks
+        return (out + where_types(schema, stmt.where)
+                + where_types(schema, stmt.conditions))
     if isinstance(stmt, P.Delete):
         schema = table_schema(stmt.keyspace, stmt.table)
         for c in stmt.columns or ():
@@ -136,7 +140,8 @@ def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
                 raise StatusError(Status.NotSupported(
                     "bind markers in collection element deletes: inline "
                     "the literal"))
-        return where_types(schema, stmt.where)
+        return (where_types(schema, stmt.where)
+                + where_types(schema, stmt.conditions))
     if isinstance(stmt, P.Select):
         ks = stmt.keyspace or processor._keyspace
         if ks in ("system", "system_schema"):

@@ -567,8 +567,11 @@ class QLProcessor:
         if not cols_needed:
             # COUNT(*)-only: project one key column, not the whole row
             cols_needed = [table.schema.hash_columns[0].name]
+        # LIMIT applies to the RESULT rows (exactly one for an aggregate),
+        # not to the scan feeding it: `SELECT COUNT(*) ... LIMIT 1` must
+        # count every matching row, so the inner scan is unlimited
         inner = P.Select(stmt.keyspace, stmt.table,
-                         cols_needed, stmt.where, stmt.limit,
+                         cols_needed, stmt.where, None,
                          order_by=stmt.order_by)
         rs = self._select(inner, params, cursor)
         dicts = rs.dicts()

@@ -396,6 +396,15 @@ class PgSession:
             return None
         if isinstance(stmt, P.Show):
             return [(stmt.name, 25)]
+        if getattr(stmt, "table", None) is None and stmt.scalar_items:
+            # FROM-less scalar SELECT (`SELECT 1`): there is no table to
+            # look up — compile the scalar items over an empty schema,
+            # exactly as _select does at execution time (this used to fall
+            # through to the virtual-table lookup and raise
+            # AttributeError on None.lower())
+            col_desc, _rows = self._project_scalar(
+                stmt.scalar_items, Schema(columns=[]), [])
+            return col_desc
         vt = self._virtual_table_rows(stmt.table)
         if vt is not None:
             cols, _rows = vt
