@@ -127,8 +127,13 @@ def test_tablet_leader_failover(cluster, table):
                                              refresh=True)
     victim_idx = next(i for i, ts in enumerate(cluster.tservers)
                       if ts.server_id == tablet.leader)
+    victim_id = cluster.tservers[victim_idx].server_id
     cluster.tservers[victim_idx].shutdown()
-    # Writes retry through replicas until the new leader emerges.
+    # Deadline-poll for the new leader instead of racing the election
+    # against the client's retry budget (the known tier-1 timing flake on
+    # loaded single-core CI: the election can outlast the retries).
+    cluster.wait_for_tablet_leader(tablet.tablet_id,
+                                   exclude={victim_id})
     client.write(table, [QLWriteOp(
         WriteOpKind.INSERT, dk("failover-probe"), {"v": "post", "n": 1})])
     row = client.read_row(table, dk("failover-probe"))

@@ -1,0 +1,560 @@
+"""yblint (tools/analysis) test suite + tier-1 CI wiring.
+
+Three layers:
+- seeded-defect fixtures proving each pass FIRES (positive cases) and
+  stays quiet on the idiomatic negatives;
+- framework behavior: baseline round-trip, inline suppression, JSON
+  output, pass selection;
+- the CI gate: `python -m tools.analysis yugabyte_tpu/` must be clean
+  against the committed baseline, and the runtime lock-order tracker
+  (utils/lock_rank.py) must have observed no acquisition cycles by the
+  time this module runs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.analysis import core  # noqa: E402
+from tools.analysis.passes import ALL_PASSES, passes_by_name  # noqa: E402
+from tools.analysis.passes.blocking_reactor import (  # noqa: E402
+    BlockingReactorPass)
+from tools.analysis.passes.jit_trace_safety import (  # noqa: E402
+    JitTraceSafetyPass)
+from tools.analysis.passes.lock_discipline import (  # noqa: E402
+    LockDisciplinePass)
+from tools.analysis.passes.metric_names import MetricNamesPass  # noqa: E402
+from tools.analysis.passes.swallowed_errors import (  # noqa: E402
+    SwallowedErrorsPass)
+from yugabyte_tpu.utils import lock_rank  # noqa: E402
+
+
+def _lint(src, passes, relpath="fixture.py"):
+    ctx = core.FileContext(relpath, relpath, textwrap.dedent(src))
+    out = []
+    for p in passes:
+        out.extend(f for f in p.run(ctx)
+                   if not core._is_suppressed(ctx, f))
+    return out
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# jit trace-safety
+# ---------------------------------------------------------------------------
+
+class TestJitTraceSafety:
+    PASS = [JitTraceSafetyPass()]
+
+    def test_host_syncs_and_branches_fire(self):
+        src = """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    y = x.item()
+                print(x)
+                z = np.asarray(x)
+                return float(x)
+        """
+        codes = _codes(_lint(src, self.PASS))
+        assert codes.count("tracer-branch") == 1
+        assert codes.count("host-sync") == 3   # .item(), np.asarray, float
+        assert codes.count("print-tracer") == 1
+
+    def test_static_args_and_metadata_are_negative(self):
+        src = """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k", "w"))
+            def f(x, k, w):
+                if k > 1 and w > 4:        # statics: fine
+                    x = x * 2
+                if x.shape[0] > 1:         # shape metadata: fine
+                    x = x + 1
+                n = int(w)                 # static int(): fine
+                if x is None:              # identity check: fine
+                    return None
+                return x
+        """
+        assert _lint(src, self.PASS) == []
+
+    def test_call_site_taint_reaches_helpers(self):
+        src = """
+            import functools
+            import jax
+
+            _STATICS = ("m",)
+
+            _fused = functools.partial(jax.jit, static_argnames=_STATICS)(
+                lambda x, m: x)
+
+            @functools.partial(jax.jit, static_argnames=("m",))
+            def root(x, m):
+                return helper(x, m)
+
+            def helper(v, m):
+                while m > 1:               # static via call site: fine
+                    m //= 2
+                while v > 1:               # tracer via call site: flagged
+                    v = v - 1
+                return v
+        """
+        fs = _lint(src, self.PASS)
+        assert _codes(fs) == ["tracer-branch"]
+        assert fs[0].symbol == "helper"
+
+    def test_module_constant_static_argnames_resolved(self):
+        src = """
+            import functools
+            import jax
+
+            _STATICS = ("k", "m")
+
+            def impl(cols, k, m):
+                if k > 1:                  # static (resolved via _STATICS)
+                    cols = cols * 2
+                return cols
+
+            fused = functools.partial(jax.jit, static_argnames=_STATICS)(impl)
+        """
+        assert _lint(src, self.PASS) == []
+
+    def test_unhashable_static_call_site(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def plain(x):
+                return x
+
+            def g(x, k):
+                return x
+
+            jg = jax.jit(g, static_argnames=("k",))
+
+            def caller(a):
+                return jg(a, k=[1, 2])
+        """
+        fs = _lint(src, self.PASS)
+        assert _codes(fs) == ["unhashable-static"]
+
+    def test_waiver(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.item()  # yblint: disable=jit-trace-safety
+        """
+        assert _lint(src, self.PASS) == []
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+# ---------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    PASS = [LockDisciplinePass()]
+
+    def test_unguarded_instance_access_fires(self):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []   # guarded-by: _lock
+
+                def good(self):
+                    with self._lock:
+                        self._items.append(1)
+
+                def bad(self):
+                    self._items.append(2)
+        """
+        fs = _lint(src, self.PASS)
+        assert len(fs) == 1 and fs[0].symbol == "C.bad"
+
+    def test_condition_alias_and_unlocked_suffix(self):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self._n = 0   # guarded-by: _cv
+
+                def via_lock(self):
+                    with self._lock:       # alias of _cv: fine
+                        self._n += 1
+
+                def _bump_unlocked(self):  # caller-holds convention
+                    self._n += 1
+        """
+        assert _lint(src, self.PASS) == []
+
+    def test_module_global(self):
+        src = """
+            import threading
+
+            _reg = {}                # guarded-by: _reg_lock
+            _reg_lock = threading.Lock()
+
+            def good():
+                with _reg_lock:
+                    _reg["x"] = 1
+
+            def bad():
+                return _reg.get("x")
+
+            def shadowed(_reg):
+                return _reg          # a parameter, not the global: fine
+        """
+        fs = _lint(src, self.PASS)
+        assert len(fs) == 1 and fs[0].symbol == "bad"
+
+    def test_def_level_caller_holds_annotation(self):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._m = {}   # guarded-by: _lock
+
+                def _peek(self):   # guarded-by: _lock
+                    return self._m.get(1)
+        """
+        assert _lint(src, self.PASS) == []
+
+    def test_multiline_assignment_annotation(self):
+        src = """
+            import threading
+            from typing import Dict
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._m: Dict[str,
+                                  int] = {}   # guarded-by: _lock
+
+                def bad(self):
+                    return self._m
+        """
+        fs = _lint(src, self.PASS)
+        assert len(fs) == 1
+
+
+# ---------------------------------------------------------------------------
+# blocking-call-in-reactor
+# ---------------------------------------------------------------------------
+
+class TestBlockingReactor:
+    PASS = [BlockingReactorPass()]
+
+    def test_rpc_reactor_seeds_and_reachability(self):
+        src = """
+            import time
+
+            class Conn:
+                def _read_loop(self):
+                    while True:
+                        self._handle()
+
+                def _handle(self):
+                    time.sleep(0.1)
+                    f = open("/tmp/x")
+                    self.done_event.wait()
+        """
+        fs = _lint(src, self.PASS, relpath="yugabyte_tpu/rpc/conn.py")
+        assert _codes(fs) == ["reactor-file-io", "reactor-sleep",
+                              "unbounded-wait"]
+
+    def test_marker_and_bounded_negatives(self):
+        src = """
+            import time
+
+            class W:
+                def loop(self):   # yblint: reactor
+                    self.work_queue.get(timeout=1)   # bounded: fine
+                    self.done_event.wait(0.5)        # bounded: fine
+
+                def not_reactor(self):
+                    time.sleep(1)                     # off-path: fine
+        """
+        assert _lint(src, self.PASS, relpath="anywhere.py") == []
+
+    def test_unbounded_queue_get(self):
+        src = """
+            class W:
+                def _read_loop(self):
+                    item = self.work_queue.get()
+        """
+        fs = _lint(src, self.PASS, relpath="yugabyte_tpu/rpc/w.py")
+        assert _codes(fs) == ["unbounded-get"]
+
+
+# ---------------------------------------------------------------------------
+# migrated passes (swallowed errors / metric names) keep their behavior
+# ---------------------------------------------------------------------------
+
+class TestMigratedPasses:
+    def test_swallowed_errors(self):
+        src = """
+            def risky():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def routed():
+                try:
+                    work()
+                except Exception as e:
+                    TRACE("failed: %s", e)
+
+            def waived():
+                try:
+                    work()
+                except Exception:  # lint: swallow-ok
+                    pass
+
+            class D:
+                def __del__(self):
+                    try:
+                        self.close()
+                    except Exception:
+                        pass
+        """
+        p = SwallowedErrorsPass()
+        assert p.applies_to("yugabyte_tpu/storage/db.py")
+        assert not p.applies_to("yugabyte_tpu/rpc/messenger.py")
+        fs = _lint(src, [p])
+        assert len(fs) == 1 and fs[0].symbol == "risky"
+
+    def test_metric_names(self):
+        src = """
+            e.counter('CamelCase')
+            e.counter('missing_suffix')
+            e.histogram('latency')
+            e.gauge('depth_ok_depth')
+            e.counter('waived')  # lint: metric-name-ok
+            e.counter(dynamic_name)
+            e.counter('fine_total')
+        """
+        fs = _lint(src, [MetricNamesPass()])
+        assert len(fs) == 3
+        assert sorted(set(_codes(fs))) == ["missing-unit-suffix",
+                                           "not-snake-case"]
+
+    def test_legacy_shims_still_answer(self, tmp_path):
+        """The standalone entry points survive as shims over the passes
+        (tests/test_backoff.py + tests/test_observability.py call them)."""
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import lint_metric_names
+            import lint_swallowed_errors
+        finally:
+            sys.path.pop(0)
+        bad = tmp_path / "bad.py"
+        bad.write_text("e.counter('Nope')\n"
+                       "try:\n    x()\nexcept Exception:\n    pass\n")
+        assert len(lint_metric_names.check_file(str(bad))) == 1
+        assert len(lint_swallowed_errors.check_file(str(bad))) == 1
+
+
+# ---------------------------------------------------------------------------
+# framework: baseline round-trip, suppression, CLI
+# ---------------------------------------------------------------------------
+
+BAD_LOCK_SRC = textwrap.dedent("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []   # guarded-by: _lock
+
+        def bad(self):
+            self._items.append(2)
+""")
+
+
+class TestFramework:
+    def test_baseline_round_trip(self, tmp_path):
+        target = tmp_path / "mod.py"
+        target.write_text(BAD_LOCK_SRC)
+        bl_path = str(tmp_path / "baseline.txt")
+
+        findings = core.analyze_paths(str(tmp_path), ["mod.py"],
+                                      [LockDisciplinePass()])
+        assert len(findings) == 1
+
+        # accept into the baseline -> clean run
+        bl = core.Baseline.load(bl_path)
+        bl.save(bl_path, findings)
+        res = core.run_analysis(str(tmp_path), ["mod.py"],
+                                [LockDisciplinePass()], bl_path)
+        assert res.exit_code == 0 and len(res.known) == 1
+
+        # a NEW defect still fails, the old one stays baselined
+        target.write_text(BAD_LOCK_SRC
+                          + "\n    def also_bad(self):\n"
+                            "        return self._items\n")
+        res = core.run_analysis(str(tmp_path), ["mod.py"],
+                                [LockDisciplinePass()], bl_path)
+        assert res.exit_code == 1
+        assert len(res.new) == 1 and len(res.known) == 1
+
+        # fingerprints are line-number-free: shifting the file by a
+        # comment block must not invalidate the baseline
+        target.write_text("# pad\n# pad\n# pad\n" + BAD_LOCK_SRC)
+        res = core.run_analysis(str(tmp_path), ["mod.py"],
+                                [LockDisciplinePass()], bl_path)
+        assert res.exit_code == 0 and len(res.known) == 1
+
+        # fixing the defect leaves a STALE entry, reported but not fatal
+        target.write_text(BAD_LOCK_SRC.replace(
+            "self._items.append(2)",
+            "with self._lock:\n            self._items.append(2)"))
+        res = core.run_analysis(str(tmp_path), ["mod.py"],
+                                [LockDisciplinePass()], bl_path)
+        assert res.exit_code == 0 and len(res.stale) == 1
+
+    def test_inline_suppression(self, tmp_path):
+        target = tmp_path / "mod.py"
+        target.write_text(BAD_LOCK_SRC.replace(
+            "self._items.append(2)",
+            "self._items.append(2)  # yblint: disable=lock-discipline"))
+        findings = core.analyze_paths(str(tmp_path), ["mod.py"],
+                                      [LockDisciplinePass()])
+        assert findings == []
+
+    def test_cli_json_and_pass_selection(self, tmp_path):
+        target = tmp_path / "mod.py"
+        target.write_text(BAD_LOCK_SRC)
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis", str(target),
+             "--no-baseline", "--json", "--passes", "lock-discipline"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert proc.returncode == 1, proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["counts"]["new"] == 1
+        assert report["new"][0]["pass"] == "lock-discipline"
+
+    def test_unknown_pass_rejected(self):
+        with pytest.raises(KeyError):
+            passes_by_name(["nope"])
+
+    def test_all_passes_have_unique_names(self):
+        names = [p.name for p in ALL_PASSES]
+        assert len(names) == len(set(names)) == 5
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order tracker
+# ---------------------------------------------------------------------------
+
+class TestLockRank:
+    def test_cycle_detection_unit(self):
+        lock_rank.reset()
+        try:
+            a = lock_rank.TrackedLock(threading.Lock(), "test.A")
+            b = lock_rank.TrackedLock(threading.Lock(), "test.B")
+            c = lock_rank.TrackedLock(threading.Lock(), "test.C")
+            with a:
+                with b:
+                    pass
+            with b:
+                with c:
+                    pass
+            assert lock_rank.find_cycle() is None
+            with c:
+                with a:   # closes A -> B -> C -> A
+                    pass
+            cycle = lock_rank.find_cycle()
+            assert cycle is not None
+            assert lock_rank.violations(), "cycle must be latched"
+            with pytest.raises(AssertionError):
+                lock_rank.assert_no_cycles()
+        finally:
+            lock_rank.reset()
+
+    def test_enabled_under_pytest_and_noop_probe(self):
+        assert lock_rank.enabled()   # pytest is in sys.modules here
+        raw = threading.Lock()
+        t = lock_rank.tracked(raw, "test.probe")
+        assert isinstance(t, lock_rank.TrackedLock)
+        # non-blocking probe failures record nothing
+        with t:
+            held_before = list(lock_rank._held_stack())
+            assert not t.acquire(blocking=False)
+            assert lock_rank._held_stack() == held_before
+
+    def test_condition_over_tracked_lock(self):
+        inner = lock_rank.tracked(threading.Lock(), "test.cv_lock")
+        cv = threading.Condition(inner)
+        done = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=2.0)
+                done.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with cv:
+            cv.notify()
+        t.join(timeout=5)
+        assert done == [1]
+
+
+# ---------------------------------------------------------------------------
+# CI gates (tier-1): repo is yblint-clean; no lock-order cycles observed
+# ---------------------------------------------------------------------------
+
+def test_repo_is_yblint_clean():
+    """The tier-1 gate: the full analyzer over yugabyte_tpu/ must report
+    no findings beyond the committed baseline (and the baseline itself
+    must not rot: stale entries are tolerated here but reported by the
+    CLI so they get pruned)."""
+    res = core.run_analysis()
+    assert not res.new, "\n".join(f.render() for f in res.new)
+
+
+def test_repo_baseline_is_empty():
+    """Acceptance: the final tree needs no suppressions — every entry
+    added to the baseline must carry a per-line justification, and today
+    there are none."""
+    bl = core.Baseline.load(core.DEFAULT_BASELINE)
+    unjustified = [fp for fp in bl.entries if fp not in bl.notes]
+    assert not unjustified, (
+        "baseline entries without a justification: "
+        + "\n".join(unjustified))
+
+
+def test_no_lock_order_cycles_observed():
+    """Every MiniCluster/raft/WAL/device-cache lock acquired anywhere in
+    this pytest process runs through utils/lock_rank.py; by the time this
+    module executes, the recorded acquisition graph must be acyclic."""
+    lock_rank.assert_no_cycles()

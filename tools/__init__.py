@@ -1,0 +1,2 @@
+# tools/ is an importable package so `python -m tools.analysis` and
+# `from tools.analysis import ...` work from the repo root.

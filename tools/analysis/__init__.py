@@ -1,0 +1,19 @@
+"""yblint: the project's unified AST analysis framework.
+
+One parse + one walk per file, shared by every registered pass; per-file
+parallel execution; a committed baseline for justified suppressions; JSON
+and human output. Run as `python -m tools.analysis` (see __main__.py) or
+from CI via `run_analysis()` / the tier-1 test in tests/test_yblint.py.
+
+Adding a pass: subclass tools.analysis.core.AnalysisPass, implement
+`run(ctx)` returning Findings, and append an instance to
+tools.analysis.passes.ALL_PASSES. See tools/analysis/passes/ for the four
+shipped passes (jit trace-safety, lock discipline, blocking-call-in-
+reactor, swallowed errors) plus metric naming.
+"""
+
+from tools.analysis.core import (AnalysisPass, Baseline, FileContext,
+                                 Finding, analyze_paths, run_analysis)
+
+__all__ = ["AnalysisPass", "Baseline", "FileContext", "Finding",
+           "analyze_paths", "run_analysis"]

@@ -1,0 +1,71 @@
+"""yblint CLI: `python -m tools.analysis [targets...]`.
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = usage error. See README "Static analysis" for the workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.analysis.core import (DEFAULT_BASELINE, DEFAULT_TARGETS,
+                                 REPO_ROOT, Baseline, format_human,
+                                 format_json, run_analysis)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="yblint: project-specific AST analysis "
+                    "(jit trace-safety, lock discipline, reactor "
+                    "blocking, swallowed errors, metric names)")
+    ap.add_argument("targets", nargs="*", default=list(DEFAULT_TARGETS),
+                    help="files or directories relative to the repo root "
+                         f"(default: {' '.join(DEFAULT_TARGETS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/analysis/"
+                         "baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings into the baseline "
+                         "and exit 0")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of passes to run")
+    ap.add_argument("--jobs", type=int, default=None,
+                    help="parallel file workers (default: cpu count, "
+                         "capped at 8)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list baselined findings")
+    args = ap.parse_args(argv)
+
+    passes = None
+    if args.passes:
+        from tools.analysis.passes import passes_by_name
+        try:
+            passes = passes_by_name(
+                [p.strip() for p in args.passes.split(",") if p.strip()])
+        except KeyError as e:
+            print(e.args[0], file=sys.stderr)
+            return 2
+
+    baseline_path = None if args.no_baseline else args.baseline
+    result = run_analysis(root=REPO_ROOT, targets=args.targets,
+                          passes=passes, baseline_path=baseline_path,
+                          jobs=args.jobs)
+    if args.write_baseline:
+        bl = Baseline.load(args.baseline)
+        bl.save(args.baseline, result.findings)
+        print(f"wrote {len(result.findings)} fingerprint(s) to "
+              f"{args.baseline}")
+        return 0
+    print(format_json(result) if args.json
+          else format_human(result, verbose=args.verbose))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

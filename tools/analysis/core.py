@@ -1,0 +1,344 @@
+"""yblint core: single-parse file contexts, the pass API, the parallel
+runner, and the baseline/suppression machinery.
+
+Design:
+
+- Each file is parsed ONCE and walked ONCE (`FileContext`): the walk
+  builds a parent map and a by-node-type index that every pass shares, so
+  adding a pass costs an index scan, not another parse of the tree.
+- A pass is a `AnalysisPass` subclass with `run(ctx) -> [Finding]`.
+  Passes self-gate via `applies_to(relpath)` (e.g. the swallowed-errors
+  pass only covers the storage-critical layers).
+- Findings are identified for baseline purposes by a line-number-free
+  fingerprint (path + pass + code + enclosing symbol + normalized source
+  line), so unrelated edits that shift line numbers do not invalidate the
+  committed baseline.
+- Suppression: `# yblint: disable=<pass-name>` on the offending line
+  waives a single finding; the committed baseline (tools/analysis/
+  baseline.txt) carries justified legacy findings — the runner fails only
+  on findings NOT in the baseline, and reports stale baseline entries so
+  the file shrinks over time.
+"""
+
+from __future__ import annotations
+
+import ast
+import concurrent.futures
+import json
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_TARGETS = ("yugabyte_tpu",)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.txt")
+
+_DISABLE_RE = re.compile(r"#\s*yblint:\s*disable=([\w,-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One defect report. `symbol` is the enclosing def/class qualname
+    (or '<module>') — part of the fingerprint so baselines survive line
+    drift."""
+
+    path: str          # repo-relative, forward slashes
+    line: int
+    pass_name: str
+    code: str          # short kebab-case defect class, e.g. "host-sync"
+    message: str
+    symbol: str = "<module>"
+    src: str = ""      # stripped source line (fingerprint component)
+
+    @property
+    def fingerprint(self) -> str:
+        return "|".join((self.path, self.pass_name, self.code, self.symbol,
+                         " ".join(self.src.split())))
+
+    def render(self, root: str = REPO_ROOT) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_name}/{self.code}] "
+                f"{self.message}")
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "pass": self.pass_name, "code": self.code,
+                "message": self.message, "symbol": self.symbol,
+                "fingerprint": self.fingerprint}
+
+
+class FileContext:
+    """Parse-once, walk-once view of one source file shared by all passes."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # --- the single walk: parent links + per-type index -------------
+        self.parents: Dict[int, ast.AST] = {}
+        self.by_type: Dict[type, List[ast.AST]] = {}
+        stack = [self.tree]
+        while stack:
+            node = stack.pop()
+            self.by_type.setdefault(type(node), []).append(node)
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+                stack.append(child)
+
+    # ------------------------------------------------------------- helpers
+    def nodes_of(self, *types: type) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self.by_type.get(t, []))
+        return out
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        parts = []
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                parts.append(a.name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            parts.insert(0, node.name)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def line_comment_has(self, lineno: int, token: str) -> bool:
+        return token in self.line_text(lineno)
+
+    def finding(self, pass_name: str, code: str, node: ast.AST,
+                message: str) -> Finding:
+        lineno = getattr(node, "lineno", 0)
+        fn = self.enclosing_function(node)
+        symbol = self.qualname(fn) if fn is not None else (
+            self.qualname(node) if isinstance(node, ast.ClassDef)
+            else "<module>")
+        return Finding(self.relpath, lineno, pass_name, code, message,
+                       symbol=symbol, src=self.line_text(lineno).strip())
+
+
+class AnalysisPass:
+    """Plugin pass API: subclass, set `name`, implement run(ctx)."""
+
+    name = "base"
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+def _is_suppressed(ctx: FileContext, f: Finding) -> bool:
+    m = _DISABLE_RE.search(ctx.line_text(f.line))
+    if not m:
+        return False
+    names = {n.strip() for n in m.group(1).split(",")}
+    return f.pass_name in names or "all" in names
+
+
+def analyze_file(path: str, relpath: str,
+                 passes: Sequence[AnalysisPass]) -> List[Finding]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        ctx = FileContext(path, relpath, src)
+    except SyntaxError as e:
+        return [Finding(relpath, e.lineno or 0, "parse", "syntax-error",
+                        f"unparseable: {e.msg}")]
+    except OSError as e:
+        return [Finding(relpath, 0, "parse", "io-error", str(e))]
+    out: List[Finding] = []
+    for p in passes:
+        if not p.applies_to(relpath):
+            continue
+        out.extend(f for f in p.run(ctx) if not _is_suppressed(ctx, f))
+    return out
+
+
+def _collect_files(root: str, targets: Sequence[str]) -> List[Tuple[str, str]]:
+    seen = set()
+    out: List[Tuple[str, str]] = []
+
+    def add(path: str) -> None:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if rel not in seen:
+            seen.add(rel)
+            out.append((path, rel))
+
+    for t in targets:
+        path = t if os.path.isabs(t) else os.path.join(root, t)
+        if os.path.isfile(path) and path.endswith(".py"):
+            add(path)
+            continue
+        for dirpath, dirnames, files in os.walk(path):
+            dirnames.sort()
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    add(os.path.join(dirpath, fn))
+    return out
+
+
+def analyze_paths(root: str = REPO_ROOT,
+                  targets: Sequence[str] = DEFAULT_TARGETS,
+                  passes: Optional[Sequence[AnalysisPass]] = None,
+                  jobs: Optional[int] = None) -> List[Finding]:
+    """Run the passes over every .py file under the targets, one file per
+    worker (per-file parallelism: contexts are independent)."""
+    if passes is None:
+        from tools.analysis.passes import ALL_PASSES
+        passes = ALL_PASSES
+    files = _collect_files(root, targets)
+    jobs = jobs or min(8, (os.cpu_count() or 2))
+    findings: List[Finding] = []
+    if jobs <= 1 or len(files) <= 1:
+        for path, rel in files:
+            findings.extend(analyze_file(path, rel, passes))
+    else:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
+            for fs in ex.map(lambda a: analyze_file(a[0], a[1], passes),
+                             files):
+                findings.extend(fs)
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name, f.code))
+    return findings
+
+
+class Baseline:
+    """Committed multiset of justified finding fingerprints.
+
+    File format: one fingerprint per line; `  # justification` after two
+    spaces is kept on rewrite; blank lines and full-line comments are
+    ignored. A fingerprint occurring N times accepts N matching findings
+    (the same defect class can legitimately appear twice in one symbol).
+    """
+
+    def __init__(self, entries: Optional[Counter] = None,
+                 notes: Optional[Dict[str, str]] = None):
+        self.entries: Counter = entries or Counter()
+        self.notes: Dict[str, str] = notes or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        entries: Counter = Counter()
+        notes: Dict[str, str] = {}
+        if not os.path.exists(path):
+            return cls(entries, notes)
+        with open(path, encoding="utf-8") as fh:
+            for raw in fh:
+                line = raw.rstrip("\n")
+                if not line.strip() or line.lstrip().startswith("#"):
+                    continue
+                fp, _, note = line.partition("  #")
+                fp = fp.strip()
+                entries[fp] += 1
+                if note.strip():
+                    notes[fp] = note.strip()
+        return cls(entries, notes)
+
+    def save(self, path: str, findings: Sequence[Finding]) -> None:
+        fps = sorted(f.fingerprint for f in findings)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write("# yblint baseline: justified findings, one "
+                     "fingerprint per line.\n"
+                     "# Regenerate with `python -m tools.analysis "
+                     "--write-baseline`; append a justification\n"
+                     "# as `  # why this is acceptable` — it survives "
+                     "regeneration for unchanged entries.\n")
+            for fp in fps:
+                note = self.notes.get(fp)
+                fh.write(f"{fp}  # {note}\n" if note else fp + "\n")
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """(new, known, stale): findings not covered by the baseline,
+        findings it covers, and baseline entries nothing matched."""
+        budget = Counter(self.entries)
+        new, known = [], []
+        for f in findings:
+            if budget.get(f.fingerprint, 0) > 0:
+                budget[f.fingerprint] -= 1
+                known.append(f)
+            else:
+                new.append(f)
+        stale = sorted(fp for fp, n in budget.items() if n > 0
+                       for _ in range(n))
+        return new, known, stale
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)
+    new: List[Finding] = field(default_factory=list)
+    known: List[Finding] = field(default_factory=list)
+    stale: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+    def to_json(self) -> dict:
+        return {
+            "new": [f.to_json() for f in self.new],
+            "baselined": [f.to_json() for f in self.known],
+            "stale_baseline_entries": self.stale,
+            "counts": {"new": len(self.new), "baselined": len(self.known),
+                       "stale": len(self.stale)},
+        }
+
+
+def run_analysis(root: str = REPO_ROOT,
+                 targets: Sequence[str] = DEFAULT_TARGETS,
+                 passes: Optional[Sequence[AnalysisPass]] = None,
+                 baseline_path: Optional[str] = DEFAULT_BASELINE,
+                 jobs: Optional[int] = None) -> AnalysisResult:
+    findings = analyze_paths(root, targets, passes, jobs)
+    if baseline_path is None:
+        return AnalysisResult(findings, list(findings), [], [])
+    bl = Baseline.load(baseline_path)
+    new, known, stale = bl.split(findings)
+    return AnalysisResult(findings, new, known, stale)
+
+
+def format_human(result: AnalysisResult, verbose: bool = False) -> str:
+    out: List[str] = []
+    for f in result.new:
+        out.append(f.render())
+    if verbose:
+        for f in result.known:
+            out.append(f"{f.render()}  [baselined]")
+    for fp in result.stale:
+        out.append(f"stale baseline entry (no longer found): {fp}")
+    n_new, n_known = len(result.new), len(result.known)
+    out.append(f"yblint: {n_new} new finding(s), {n_known} baselined, "
+               f"{len(result.stale)} stale baseline entr"
+               f"{'y' if len(result.stale) == 1 else 'ies'}")
+    return "\n".join(out)
+
+
+def format_json(result: AnalysisResult) -> str:
+    return json.dumps(result.to_json(), indent=1, sort_keys=True)

@@ -1,0 +1,30 @@
+"""yblint pass registry: one instance of each shipped pass.
+
+A new pass registers by appending an instance here; `python -m
+tools.analysis --passes a,b` selects by name.
+"""
+
+from tools.analysis.passes.blocking_reactor import BlockingReactorPass
+from tools.analysis.passes.jit_trace_safety import JitTraceSafetyPass
+from tools.analysis.passes.lock_discipline import LockDisciplinePass
+from tools.analysis.passes.metric_names import MetricNamesPass
+from tools.analysis.passes.swallowed_errors import SwallowedErrorsPass
+
+ALL_PASSES = (
+    JitTraceSafetyPass(),
+    LockDisciplinePass(),
+    BlockingReactorPass(),
+    SwallowedErrorsPass(),
+    MetricNamesPass(),
+)
+
+
+def passes_by_name(names):
+    by_name = {p.name: p for p in ALL_PASSES}
+    out = []
+    for n in names:
+        if n not in by_name:
+            raise KeyError(
+                f"unknown pass {n!r}; available: {sorted(by_name)}")
+        out.append(by_name[n])
+    return out

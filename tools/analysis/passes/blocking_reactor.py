@@ -1,0 +1,175 @@
+"""blocking-in-reactor: no sleeps / blocking file I/O / unbounded waits
+on RPC reactor threads or raft callback paths.
+
+The messenger's accept/reader threads and the WAL-appender -> raft
+durability callback chain are the system's reactors: one blocked reactor
+stalls every call (or every replicate) multiplexed behind it. The
+reference bans blocking work on reactor threads for the same reason
+(rpc/reactor.h "fast path only"); handlers run on the service pool.
+
+Reactor roots (per file):
+- any function whose def line carries `# yblint: reactor`;
+- in rpc/ modules: `_accept_loop`, `_serve_conn`, `_read_loop`;
+- in consensus/ modules: `_on_local_durable` (runs on the WAL appender
+  thread; see raft.py's durability-watermark comment).
+
+Reachability: same-module functions called from a reactor root are
+reactor-path too (call-graph BFS, bare-name resolution).
+
+Flagged inside reactor-path code:
+- `time.sleep(...)`                              -> reactor-sleep
+- `open(...)` / `os.fsync` / `io.open`           -> reactor-file-io
+- `<queue-ish>.get()` without timeout/block=False -> unbounded-get
+- `<event/cond>.wait()` without a timeout         -> unbounded-wait
+- `<thread>.join()` without a timeout             -> unbounded-join
+
+Blocking on the reactor's own socket (`recv`/`accept`/`select`) is the
+reactor's job and is not flagged. Waive deliberate cases with
+`# yblint: disable=blocking-in-reactor`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+
+PASS_NAME = "blocking-in-reactor"
+
+_RPC_SEEDS = {"_accept_loop", "_serve_conn", "_read_loop"}
+_CONSENSUS_SEEDS = {"_on_local_durable"}
+_MARKER = "# yblint: reactor"
+_QUEUEISH = ("queue", "_q")
+_WAITABLE_HINTS = ("event", "cv", "cond", "done", "ready", "stop")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _receiver_name(node: ast.AST) -> str:
+    """Lowercased name of the object a method is called on ('' if not a
+    simple name/attribute chain)."""
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Attribute):
+            return base.attr.lower()
+        if isinstance(base, ast.Name):
+            return base.id.lower()
+        if isinstance(base, ast.Subscript):
+            # waiter["event"].wait() — use the subscript key if constant
+            s = base.slice
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                return s.value.lower()
+    return ""
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(kw.arg in ("timeout", "timeout_s", "timeout_ms")
+           for kw in call.keywords):
+        return True
+    return bool(call.args)  # positional timeout (Event.wait(0.5)) / get(0)
+
+
+class BlockingReactorPass(AnalysisPass):
+    name = PASS_NAME
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        fns: Dict[str, ast.AST] = {}
+        for node in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            fns.setdefault(node.name, node)
+        roots = self._roots(ctx, fns)
+        if not roots:
+            return []
+        reachable = self._reach(fns, roots)
+        out: List[Finding] = []
+        for name in sorted(reachable):
+            out.extend(self._check(ctx, fns[name]))
+        return out
+
+    def _roots(self, ctx: FileContext,
+               fns: Dict[str, ast.AST]) -> Set[str]:
+        roots: Set[str] = set()
+        seeds: Set[str] = set()
+        if "/rpc/" in "/" + ctx.relpath:
+            seeds |= _RPC_SEEDS
+        if "/consensus/" in "/" + ctx.relpath:
+            seeds |= _CONSENSUS_SEEDS
+        for name, node in fns.items():
+            if name in seeds:
+                roots.add(name)
+            elif _MARKER in ctx.line_text(node.lineno):
+                roots.add(name)
+        return roots
+
+    def _reach(self, fns: Dict[str, ast.AST], roots: Set[str]) -> Set[str]:
+        reachable = set(roots)
+        frontier = list(roots)
+        while frontier:
+            cur = frontier.pop()
+            for call in ast.walk(fns[cur]):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee: Optional[str] = None
+                if isinstance(call.func, ast.Name):
+                    callee = call.func.id
+                elif (isinstance(call.func, ast.Attribute)
+                      and isinstance(call.func.value, ast.Name)
+                      and call.func.value.id == "self"):
+                    callee = call.func.attr
+                if callee in fns and callee not in reachable:
+                    reachable.add(callee)
+                    frontier.append(callee)
+        return reachable
+
+    def _check(self, ctx: FileContext, fn: ast.AST) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            if fname == "time.sleep" or fname == "sleep":
+                out.append(ctx.finding(
+                    self.name, "reactor-sleep", node,
+                    f"time.sleep on a reactor path ({fn.name}) stalls "
+                    "every call multiplexed behind this thread"))
+                continue
+            if fname in ("open", "io.open", "os.fsync", "os.replace"):
+                out.append(ctx.finding(
+                    self.name, "reactor-file-io", node,
+                    f"blocking file I/O ({fname}) on a reactor path "
+                    f"({fn.name}) — hand it to a worker pool"))
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            meth = node.func.attr
+            recv = _receiver_name(node.func)
+            if meth == "get" and any(h in recv for h in _QUEUEISH) \
+                    and not _has_timeout(node) \
+                    and not any(kw.arg == "block" for kw in node.keywords):
+                out.append(ctx.finding(
+                    self.name, "unbounded-get", node,
+                    f"unbounded {recv}.get() on a reactor path "
+                    f"({fn.name}) — pass a timeout"))
+            elif meth == "wait" and not _has_timeout(node) \
+                    and (any(h in recv for h in _WAITABLE_HINTS)
+                         or recv in ("self",)):
+                out.append(ctx.finding(
+                    self.name, "unbounded-wait", node,
+                    f"{recv}.wait() without a timeout on a reactor path "
+                    f"({fn.name})"))
+            elif meth == "join" and not _has_timeout(node) \
+                    and "thread" in recv:
+                out.append(ctx.finding(
+                    self.name, "unbounded-join", node,
+                    f"{recv}.join() without a timeout on a reactor path "
+                    f"({fn.name})"))
+        return out

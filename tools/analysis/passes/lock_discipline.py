@@ -1,0 +1,265 @@
+"""lock-discipline: `# guarded-by: <lock>` annotations are enforced.
+
+Convention (adopted across the threaded modules — storage/compaction.py,
+storage/device_cache.py, tserver/maintenance_manager.py,
+consensus/log.py, consensus/raft.py, rpc/):
+
+- Declare a shared attribute's lock on its initializing assignment:
+
+      self._map = OrderedDict()        # guarded-by: _lock
+      _staging_pool = None             # guarded-by: _staging_pool_lock
+
+  (instance attributes in a class body; bare names at module level).
+
+- Every later read or write of an annotated name must happen lexically
+  inside `with self.<lock>:` (or `with <lock>:` for module globals) —
+  or inside a function that declares the caller holds it:
+
+      def _advance_commit_unlocked(self):          # convention, or
+      def _gcable_segments(self):  # guarded-by: _cv
+
+  The `*_unlocked`/`*_locked` name suffix is the repo's (and the
+  reference's) caller-holds convention and is honored as such.
+
+- `threading.Condition(self._lock)` makes the condition an alias of the
+  lock: holding either satisfies a guard declared as either. Explicit
+  aliasing: `# lock-alias: <name>` on the assignment.
+
+__init__/__del__ bodies are exempt (pre-publication / teardown).
+Waive a deliberate unguarded access (e.g. a benign racy fast-path read
+whose publication happens under the lock) with
+`# yblint: disable=lock-discipline` plus a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+
+PASS_NAME = "lock-discipline"
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w]*)")
+_ALIAS_RE = re.compile(r"#\s*lock-alias:\s*([A-Za-z_][\w]*)")
+_EXEMPT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _Scope:
+    """Guard tables for one class (or the module itself)."""
+
+    def __init__(self) -> None:
+        self.guards: Dict[str, str] = {}        # attr -> lock name
+        self.aliases: Dict[str, Set[str]] = {}  # lock -> equivalence set
+
+    def alias(self, a: str, b: str) -> None:
+        group = (self.aliases.get(a, {a}) | self.aliases.get(b, {b}))
+        for name in group:
+            self.aliases[name] = group
+
+    def satisfied_by(self, guard: str, held: Set[str]) -> bool:
+        group = self.aliases.get(guard, {guard})
+        return bool(group & held)
+
+
+class LockDisciplinePass(AnalysisPass):
+    name = PASS_NAME
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        class_scopes: Dict[ast.ClassDef, _Scope] = {}
+        module_scope = _Scope()
+        self._collect(ctx, class_scopes, module_scope)
+        if not module_scope.guards and \
+                not any(s.guards for s in class_scopes.values()):
+            return []
+        findings: List[Finding] = []
+        for cls, scope in class_scopes.items():
+            if scope.guards:
+                findings.extend(self._check_class(ctx, cls, scope))
+        if module_scope.guards:
+            findings.extend(self._check_module(ctx, module_scope))
+        return findings
+
+    # --------------------------------------------------------- collection
+    def _collect(self, ctx: FileContext,
+                 class_scopes: Dict[ast.ClassDef, _Scope],
+                 module_scope: _Scope) -> None:
+        for node in ctx.nodes_of(ast.Assign, ast.AnnAssign):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            # the annotation comment may sit on any physical line of a
+            # multi-line assignment (backslash/paren continuations)
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            text = "\n".join(ctx.line_text(ln)
+                             for ln in range(node.lineno, end + 1))
+            m_guard = _GUARDED_RE.search(text)
+            m_alias = _ALIAS_RE.search(text)
+            owner = self._owning_class(ctx, node)
+            scope = class_scopes.setdefault(owner, _Scope()) \
+                if owner is not None else module_scope
+            for t in targets:
+                attr = _self_attr(t)
+                name = attr if attr is not None else (
+                    t.id if isinstance(t, ast.Name) else None)
+                if name is None:
+                    continue
+                if m_guard:
+                    scope.guards[name] = m_guard.group(1)
+                if m_alias:
+                    scope.alias(name, m_alias.group(1))
+                # auto-alias: self._cv = threading.Condition(self._lock)
+                v = node.value
+                if (isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Attribute)
+                        and v.func.attr == "Condition" and v.args):
+                    inner = _self_attr(v.args[0]) or (
+                        v.args[0].id if isinstance(v.args[0], ast.Name)
+                        else None)
+                    if inner:
+                        scope.alias(name, inner)
+
+    def _owning_class(self, ctx: FileContext,
+                      node: ast.AST) -> Optional[ast.ClassDef]:
+        for a in ctx.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a
+        return None
+
+    # ------------------------------------------------------------- checks
+    def _held_locks(self, ctx: FileContext, node: ast.AST,
+                    fn: ast.AST, self_attrs: bool) -> Set[str]:
+        """Lock names whose `with` blocks lexically enclose `node`
+        (stopping at the function boundary), plus caller-holds
+        declarations on the function itself."""
+        held: Set[str] = set()
+        for a in ctx.ancestors(node):
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                for item in a.items:
+                    e = item.context_expr
+                    name = _self_attr(e) if self_attrs else None
+                    if name is None and isinstance(e, ast.Name):
+                        name = e.id
+                    if name is None and isinstance(e, ast.Attribute):
+                        name = e.attr  # e.g. with self._shared._lock
+                    if name:
+                        held.add(name)
+            if a is fn:
+                break
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if fn.name.endswith(("_unlocked", "_locked")):
+                held.add("*")  # caller-holds convention: satisfies any
+            m = _GUARDED_RE.search(ctx.line_text(fn.lineno))
+            if m:
+                held.add(m.group(1))
+        return held
+
+    def _check_access(self, ctx: FileContext, scope: _Scope, name: str,
+                      node: ast.AST, fn: ast.AST,
+                      self_attrs: bool) -> Optional[Finding]:
+        guard = scope.guards[name]
+        held = self._held_locks(ctx, node, fn, self_attrs)
+        if "*" in held or scope.satisfied_by(guard, held):
+            return None
+        is_store = isinstance(getattr(node, "ctx", None),
+                              (ast.Store, ast.Del))
+        kind = "write" if is_store else "read"
+        return ctx.finding(
+            self.name, "unguarded-access", node,
+            f"{kind} of {name!r} (guarded-by: {guard}) outside "
+            f"`with {'self.' if self_attrs else ''}{guard}:`")
+
+    def _direct_body(self, fn: ast.AST) -> List[ast.AST]:
+        """Nodes of fn excluding nested def bodies (each def is analyzed
+        once, with its own held-lock context — an enclosing `with` does
+        not guard a nested function's later execution)."""
+        out: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef,
+                     scope: _Scope) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ast.walk(cls):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in _EXEMPT_METHODS or self._inside_exempt(ctx, fn):
+                continue
+            for node in self._direct_body(fn):
+                attr = _self_attr(node)
+                if attr is None or attr not in scope.guards:
+                    continue
+                f = self._check_access(ctx, scope, attr, node, fn, True)
+                if f is not None:
+                    out.append(f)
+        return out
+
+    def _check_module(self, ctx: FileContext,
+                      scope: _Scope) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            if fn.name in _EXEMPT_METHODS or self._inside_exempt(ctx, fn):
+                continue
+            for node in self._direct_body(fn):
+                if not isinstance(node, ast.Name) \
+                        or node.id not in scope.guards:
+                    continue
+                # only flag accesses to the module global, not shadowing
+                # locals/params of the same name
+                if self._is_local(fn, node.id):
+                    continue
+                f = self._check_access(ctx, scope, node.id, node, fn, False)
+                if f is not None:
+                    out.append(f)
+        return out
+
+    def _inside_exempt(self, ctx: FileContext, fn: ast.AST) -> bool:
+        """Nested defs inside __init__ et al share the exemption (e.g.
+        callbacks constructed pre-publication)."""
+        return any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and a.name in _EXEMPT_METHODS
+                   for a in ctx.ancestors(fn))
+
+    def _is_local(self, fn: ast.AST, name: str) -> bool:
+        """Name is a parameter of fn (assigned names declared `global`
+        still refer to the module binding)."""
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+        if name in params:
+            return True
+        declared_global = any(
+            isinstance(n, ast.Global) and name in n.names
+            for n in ast.walk(fn))
+        if declared_global:
+            return False
+        # assigned somewhere in fn without `global` -> it's a local
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                              ast.NamedExpr, ast.For)):
+                targets = getattr(n, "targets", None) or \
+                    [getattr(n, "target", None)]
+                for t in targets:
+                    if t is None:
+                        continue
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name) and leaf.id == name:
+                            return True
+        return False

@@ -1,0 +1,78 @@
+"""metric-names: metric names stay snake_case with a unit suffix
+(migrated from the standalone tools/lint_metric_names.py; the old module
+remains as a thin CLI shim over this pass).
+
+Rules, checked on every literal first argument of `.counter(...)` /
+`.gauge(...)` / `.histogram(...)` under yugabyte_tpu/:
+
+  - snake_case: ^[a-z][a-z0-9_]*$
+  - counters end `_total`
+  - histograms end in a unit: `_ms` / `_us` / `_bytes` / `_rows`
+  - gauges end in a unit or count suffix:
+    `_ms` / `_us` / `_bytes` / `_rows` / `_total` / `_ratio` / `_depth`
+    / `_count`
+
+Dynamically built names (f-strings, concatenation) are skipped — the
+helper sites that use them (utils/metrics.record_kernel_dispatch,
+mem_tracker per-tracker gauges) append conforming suffixes to a fixed
+family prefix. Waive a line with `# lint: metric-name-ok` (legacy) or
+`# yblint: disable=metric-names`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+
+PASS_NAME = "metric-names"
+
+DEFAULT_DIRS = ("yugabyte_tpu",)
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_UNIT = ("_ms", "_us", "_bytes", "_rows")
+_SUFFIXES = {
+    "counter": ("_total",),
+    "histogram": _UNIT,
+    "gauge": _UNIT + ("_total", "_ratio", "_depth", "_count"),
+}
+_WAIVER = "lint: metric-name-ok"
+
+
+class MetricNamesPass(AnalysisPass):
+    name = PASS_NAME
+
+    def __init__(self, dirs=DEFAULT_DIRS):
+        self.dirs = tuple(d.rstrip("/") + "/" for d in dirs)
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.dirs)
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes_of(ast.Call):
+            f_ = node.func
+            kind = f_.attr if isinstance(f_, ast.Attribute) else None
+            if kind not in _SUFFIXES or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue  # dynamic name: see module docstring
+            name = arg.value
+            if ctx.line_comment_has(node.lineno, _WAIVER):
+                continue
+            if not _SNAKE.match(name):
+                out.append(ctx.finding(
+                    self.name, "not-snake-case", node,
+                    f"{kind} {name!r}: not snake_case"))
+                continue
+            suffixes = _SUFFIXES[kind]
+            if not name.endswith(suffixes):
+                out.append(ctx.finding(
+                    self.name, "missing-unit-suffix", node,
+                    f"{kind} {name!r}: missing unit suffix "
+                    f"(one of {', '.join(suffixes)})"))
+        return out

@@ -1,0 +1,102 @@
+"""swallowed-errors: no silently swallowed errors in the storage-critical
+layers (migrated from the standalone tools/lint_swallowed_errors.py; the
+old module remains as a thin CLI shim over this pass).
+
+The failure-containment design routes every background I/O error to the
+DB background-error slot (storage/db.py), the WAL seal (consensus/log.py)
+or at minimum a TRACE line — an `except Exception: pass` in storage/,
+consensus/ or tablet/ is exactly the hole that turns an injected disk
+fault into silent corruption instead of a contained FAILED tablet.
+
+Flags every broad handler (bare `except:`, `except Exception`,
+`except BaseException`) whose body only discards the error, unless it
+routes the error (raise / TRACE(...) / background_error / mark_failed /
+_fail / set_background_error), sits inside `__del__`, or the except line
+carries `# lint: swallow-ok` (legacy) or
+`# yblint: disable=swallowed-errors`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+
+PASS_NAME = "swallowed-errors"
+
+DEFAULT_DIRS = ("yugabyte_tpu/storage", "yugabyte_tpu/consensus",
+                "yugabyte_tpu/tablet")
+
+_BROAD = {"Exception", "BaseException"}
+_ROUTING_NAMES = ("TRACE", "trace")
+_ROUTING_ATTRS = ("background_error", "set_background_error",
+                  "mark_failed", "_fail")
+_WAIVER = "lint: swallow-ok"
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    names = []
+    for node in ([t.elts] if isinstance(t, ast.Tuple) else [[t]])[0]:
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in _BROAD for n in names)
+
+
+def _routes_error(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name in _ROUTING_NAMES or any(a in name
+                                             for a in _ROUTING_ATTRS):
+                return True
+    return False
+
+
+def _only_discards(handler: ast.ExceptHandler) -> bool:
+    """Body is nothing but pass / continue / bare return — the error is
+    dropped on the floor with no side channel."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Return) and stmt.value is None:
+            continue
+        return False
+    return True
+
+
+class SwallowedErrorsPass(AnalysisPass):
+    name = PASS_NAME
+
+    def __init__(self, dirs=DEFAULT_DIRS):
+        self.dirs = tuple(d.rstrip("/") + "/" for d in dirs)
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.dirs)
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes_of(ast.ExceptHandler):
+            if not (_is_broad(node) and _only_discards(node)):
+                continue
+            if _routes_error(node):
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is not None and fn.name == "__del__":
+                continue  # teardown swallows are idiomatic and unroutable
+            if ctx.line_comment_has(node.lineno, _WAIVER):
+                continue
+            out.append(ctx.finding(
+                self.name, "swallowed", node,
+                "broad except swallows the error (route it to the "
+                "background-error slot or TRACE)"))
+        return out

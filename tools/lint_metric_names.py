@@ -1,86 +1,42 @@
 #!/usr/bin/env python
-"""Lint: metric names stay snake_case with a unit suffix.
+"""Thin CLI shim over yblint's metric-names pass.
 
-The observability layer exposes every metric over /prometheus-metrics; a
-scrapeable namespace needs consistent naming (the same discipline the
-reference enforces with METRIC_DEFINE macros). Rules, checked on every
-literal first argument of `.counter(...)` / `.gauge(...)` /
-`.histogram(...)` under yugabyte_tpu/:
-
-  - snake_case: ^[a-z][a-z0-9_]*$
-  - counters end `_total`
-  - histograms end in a unit: `_ms` / `_us` / `_bytes` / `_rows`
-  - gauges end in a unit or count suffix:
-    `_ms` / `_us` / `_bytes` / `_rows` / `_total` / `_ratio` / `_depth`
-    / `_count`
-
-Dynamically built names (f-strings, concatenation) are skipped — the
-helper sites that use them (utils/metrics.record_kernel_dispatch,
-mem_tracker per-tracker gauges) append conforming suffixes to a fixed
-family prefix. A line may carry `# lint: metric-name-ok` to waive.
-
-Run as a script (exit 1 on offense) or via check_paths() from the tier-1
-test that wires this into CI (tests/test_observability.py), the same way
-tools/lint_swallowed_errors.py is wired.
+The analysis itself moved to tools/analysis/passes/metric_names.py (one
+parse of each file shared by every pass — run the full analyzer with
+`python -m tools.analysis`). This module keeps the original entry point
+and the check_file/check_paths API the tier-1 wiring
+(tests/test_observability.py) uses.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 from typing import List, Tuple
 
-DEFAULT_DIRS = ("yugabyte_tpu",)
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
-_UNIT = ("_ms", "_us", "_bytes", "_rows")
-_SUFFIXES = {
-    "counter": ("_total",),
-    "histogram": _UNIT,
-    "gauge": _UNIT + ("_total", "_ratio", "_depth", "_count"),
-}
-_WAIVER = "lint: metric-name-ok"
+from tools.analysis.core import analyze_file  # noqa: E402
+from tools.analysis.passes.metric_names import (  # noqa: E402
+    DEFAULT_DIRS, MetricNamesPass)
+
+
+class _Anywhere(MetricNamesPass):
+    """check_file must lint ANY path (tests hand it tmp files)."""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
 
 
 def check_file(path: str) -> List[Tuple[str, int, str]]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"unparseable: {e.msg}")]
-    lines = src.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f_ = node.func
-        kind = f_.attr if isinstance(f_, ast.Attribute) else None
-        if kind not in _SUFFIXES or not node.args:
-            continue
-        arg = node.args[0]
-        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
-            continue  # dynamic name: see module docstring
-        name = arg.value
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if _WAIVER in line:
-            continue
-        if not _SNAKE.match(name):
-            out.append((path, node.lineno,
-                        f"{kind} {name!r}: not snake_case"))
-            continue
-        suffixes = _SUFFIXES[kind]
-        if not name.endswith(suffixes):
-            out.append((path, node.lineno,
-                        f"{kind} {name!r}: missing unit suffix "
-                        f"(one of {', '.join(suffixes)})"))
-    return out
+    fs = analyze_file(path, path, [_Anywhere()])
+    return [(f.path, f.line, f.message) for f in fs]
 
 
 def check_paths(root: str, dirs=DEFAULT_DIRS) -> List[Tuple[str, int, str]]:
-    offenses = []
+    offenses: List[Tuple[str, int, str]] = []
     for d in dirs:
         base = os.path.join(root, d)
         for dirpath, _dirs, files in os.walk(base):
@@ -91,10 +47,9 @@ def check_paths(root: str, dirs=DEFAULT_DIRS) -> List[Tuple[str, int, str]]:
 
 
 def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    offenses = check_paths(root)
+    offenses = check_paths(_ROOT)
     for path, lineno, msg in offenses:
-        print(f"{os.path.relpath(path, root)}:{lineno}: {msg}")
+        print(f"{os.path.relpath(path, _ROOT)}:{lineno}: {msg}")
     if offenses:
         print(f"{len(offenses)} metric-name offense(s)")
         return 1

@@ -1,119 +1,42 @@
 #!/usr/bin/env python
-"""Lint: no silently swallowed errors in the storage-critical layers.
+"""Thin CLI shim over yblint's swallowed-errors pass.
 
-The failure-containment design routes every background I/O error to the
-DB background-error slot (storage/db.py), the WAL seal (consensus/log.py),
-or at minimum a TRACE line — an `except Exception: pass` in storage/,
-consensus/ or tablet/ is exactly the hole that turns an injected disk
-fault into silent corruption instead of a contained FAILED tablet.
-
-Flags every broad exception handler (bare `except:`, `except Exception`,
-`except BaseException`) whose body only discards the error (pass /
-continue / bare return), unless:
-
-  - it routes the error somewhere: a raise, a TRACE(...) call, or a call
-    into the containment surface (background_error / mark_failed / _fail
-    / set_background_error);
-  - it is inside a `__del__` (interpreter-teardown swallows are
-    idiomatic and unroutable);
-  - the except line carries an explicit `# lint: swallow-ok` waiver.
-
-Run as a script (exit 1 on offense) or via check_paths() from the tier-1
-test that wires this into CI.
+The analysis itself moved to tools/analysis/passes/swallowed_errors.py
+(one parse of each file shared by every pass — run the full analyzer with
+`python -m tools.analysis`). This module keeps the original entry point
+and the check_file/check_paths API the tier-1 wiring
+(tests/test_backoff.py) uses.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
-DEFAULT_DIRS = ("yugabyte_tpu/storage", "yugabyte_tpu/consensus",
-                "yugabyte_tpu/tablet")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-_BROAD = {"Exception", "BaseException"}
-_ROUTING_NAMES = ("TRACE", "trace")
-_ROUTING_ATTRS = ("background_error", "set_background_error",
-                  "mark_failed", "_fail")
-_WAIVER = "lint: swallow-ok"
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:
-        return True  # bare except:
-    names = []
-    for node in ([t.elts] if isinstance(t, ast.Tuple) else [[t]])[0]:
-        if isinstance(node, ast.Name):
-            names.append(node.id)
-        elif isinstance(node, ast.Attribute):
-            names.append(node.attr)
-    return any(n in _BROAD for n in names)
+from tools.analysis.core import analyze_file  # noqa: E402
+from tools.analysis.passes.swallowed_errors import (  # noqa: E402
+    DEFAULT_DIRS, SwallowedErrorsPass)
 
 
-def _routes_error(handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.Call):
-            f = node.func
-            name = f.id if isinstance(f, ast.Name) else (
-                f.attr if isinstance(f, ast.Attribute) else "")
-            if name in _ROUTING_NAMES or any(a in name
-                                             for a in _ROUTING_ATTRS):
-                return True
-    return False
+class _Anywhere(SwallowedErrorsPass):
+    """check_file must lint ANY path (tests hand it tmp files)."""
 
-
-def _only_discards(handler: ast.ExceptHandler) -> bool:
-    """Body is nothing but pass / continue / bare return — the error is
-    dropped on the floor with no side channel."""
-    for stmt in handler.body:
-        if isinstance(stmt, (ast.Pass, ast.Continue)):
-            continue
-        if isinstance(stmt, ast.Return) and stmt.value is None:
-            continue
-        return False
-    return True
-
-
-def _in_del(tree: ast.AST, handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == "__del__":
-            for sub in ast.walk(node):
-                if sub is handler:
-                    return True
-    return False
+    def applies_to(self, relpath: str) -> bool:
+        return True
 
 
 def check_file(path: str) -> List[Tuple[str, int, str]]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"unparseable: {e.msg}")]
-    lines = src.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if not (_is_broad(node) and _only_discards(node)):
-            continue
-        if _routes_error(node) or _in_del(tree, node):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if _WAIVER in line:
-            continue
-        out.append((path, node.lineno,
-                    "broad except swallows the error (route it to the "
-                    "background-error slot or TRACE)"))
-    return out
+    fs = analyze_file(path, path, [_Anywhere()])
+    return [(f.path, f.line, f.message) for f in fs]
 
 
 def check_paths(root: str, dirs=DEFAULT_DIRS) -> List[Tuple[str, int, str]]:
-    offenses = []
+    offenses: List[Tuple[str, int, str]] = []
     for d in dirs:
         base = os.path.join(root, d)
         for dirpath, _dirs, files in os.walk(base):
@@ -124,10 +47,9 @@ def check_paths(root: str, dirs=DEFAULT_DIRS) -> List[Tuple[str, int, str]]:
 
 
 def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    offenses = check_paths(root)
+    offenses = check_paths(_ROOT)
     for path, lineno, msg in offenses:
-        print(f"{os.path.relpath(path, root)}:{lineno}: {msg}")
+        print(f"{os.path.relpath(path, _ROOT)}:{lineno}: {msg}")
     if offenses:
         print(f"{len(offenses)} swallowed-error offense(s)")
         return 1

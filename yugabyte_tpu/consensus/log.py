@@ -132,13 +132,15 @@ class Log:
     """Appendable segmented WAL with a group-commit appender thread."""
 
     def __init__(self, wal_dir: str):
+        from yugabyte_tpu.utils import lock_rank
         self.wal_dir = wal_dir
         os.makedirs(wal_dir, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = lock_rank.tracked(threading.Lock(), "log._lock")
         self._cv = threading.Condition(self._lock)
-        self._queue: List[Tuple[List[LogEntry], Optional[Callable]]] = []
-        self._inflight = False  # appender is mid-write on a popped batch
-        self._stopped = False
+        self._queue: List[Tuple[List[LogEntry],
+                                Optional[Callable]]] = []  # guarded-by: _cv
+        self._inflight = False  # guarded-by: _cv — appender mid-write
+        self._stopped = False   # guarded-by: _cv
         # First append/fsync failure latches here: the segment may hold a
         # torn record, so further appends are refused (they would land
         # after the tear and be unreachable at replay) and every callback
@@ -146,19 +148,25 @@ class Log:
         # durability it does not have. Recovery is a re-bootstrap (the
         # torn-tail replay rule applies). on_io_error tells the owner
         # (TabletPeer) to transition the tablet to FAILED.
-        self._io_error: Optional[Exception] = None
+        self._io_error: Optional[Exception] = None  # guarded-by: _cv
         self.on_io_error: Optional[Callable[[Exception], None]] = None
+        # _file/_file_size/_file_first_index are appender-protocol state,
+        # not lock state: only the appender thread touches them while
+        # _inflight is True, and truncate_after/close first wait (under
+        # _cv) for the queue to drain and _inflight to clear. Annotating
+        # them guarded-by _cv would demand the lock across segment file
+        # I/O, serializing producers behind fsync for no correctness win.
         self._file = None
         self._file_size = 0
         self._file_first_index = None
-        self._last_op_id = (0, 0)
+        self._last_op_id = (0, 0)  # guarded-by: _cv
         self._recover()
         self._appender = threading.Thread(
             target=self._appender_loop, name=f"wal-appender", daemon=True)
         self._appender.start()
 
     # ------------------------------------------------------------- recovery
-    def _recover(self) -> None:
+    def _recover(self) -> None:  # guarded-by: _cv (pre-publication ctor)
         reader = LogReader(self.wal_dir)
         segs = reader.segments()
         last = None
@@ -253,19 +261,26 @@ class Log:
     def _write_batch(self, batch) -> None:
         import time as _time
         h_append, h_fsync, c_commits = _wal_metrics()
-        err = self._io_error
+        with self._cv:
+            err = self._io_error
         if err is None:
             try:
                 t0 = _time.monotonic()
                 files_to_sync = set()
+                last_op_id = None
                 for entries, _cb in batch:
                     for e in entries:
                         self._ensure_segment(e.index)
                         rec = _encode_entry(e)
                         self._file.append(rec)
                         self._file_size += len(rec)
-                        self._last_op_id = e.op_id
+                        last_op_id = e.op_id
                     files_to_sync.add(self._file)
+                if last_op_id is not None:
+                    # published under the lock: last_op_id is read
+                    # concurrently (last_op_id property, raft recovery)
+                    with self._cv:
+                        self._last_op_id = last_op_id
                 t1 = _time.monotonic()
                 h_append.increment((t1 - t0) * 1e3)
                 # a slow fsync dumps its trace (LongOperationTracker armed
@@ -318,7 +333,7 @@ class Log:
             TRACE("wal: rolled to segment %s", path)
 
     # ----------------------------------------------------- truncate (raft)
-    def truncate_after(self, index: int) -> None:
+    def truncate_after(self, index: int) -> None:  # takes _cv for its body
         """Drop all entries with index > `index` (follower conflict
         resolution, ref raft_consensus.cc follower Update path). Rewrites
         the tail segment(s) synchronously, after waiting for any in-flight

@@ -51,16 +51,19 @@ class MultiRaftBatcher:
                                             List[dict]]):
         """send_batch(addr, [(dst_peer, wire_req), ...]) -> [wire_resp,...]
         (positional; an item-level failure is a dict with key 'err')."""
+        from yugabyte_tpu.utils import lock_rank
         self._send_batch = send_batch
-        self._lock = threading.Lock()
-        self._queues: Dict[str, List[Tuple[str, dict, _Slot]]] = {}
-        self._timers: Dict[str, threading.Timer] = {}
-        self._stopped = False
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "multi_raft._lock")
+        self._queues: Dict[str, List[Tuple[str, dict,
+                                           _Slot]]] = {}  # guarded-by: _lock
+        self._timers: Dict[str, threading.Timer] = {}     # guarded-by: _lock
+        self._stopped = False                             # guarded-by: _lock
         # observability: how many heartbeats rode how many RPCs. The ints
         # are per-batcher (tests diff them per server); the registry
         # counters aggregate process-wide for scraping.
-        self.heartbeats_in = 0
-        self.batches_out = 0
+        self.heartbeats_in = 0                            # guarded-by: _lock
+        self.batches_out = 0                              # guarded-by: _lock
         e = ROOT_REGISTRY.entity("server", "multi_raft")
         self._c_heartbeats = e.counter(
             "multi_raft_heartbeats_total",
@@ -126,7 +129,8 @@ class MultiRaftBatcher:
             timer.cancel()
         if not batch:
             return
-        self.batches_out += 1
+        with self._lock:
+            self.batches_out += 1
         self._c_batches.increment()
         try:
             resps = self._send_batch(addr, [(d, r) for d, r, _s in batch])

@@ -296,52 +296,58 @@ class RaftConsensus:
         self._rng = random.Random(seed if seed is not None
                                   else hash(config.peer_id) & 0xFFFF)
 
-        self._lock = threading.Lock()
+        from yugabyte_tpu.utils import lock_rank
+        self._lock = lock_rank.tracked(threading.Lock(), "raft._lock")
         self._commit_cv = threading.Condition(self._lock)
-        self._apply_lock = threading.Lock()
+        self._apply_lock = lock_rank.tracked(threading.Lock(),
+                                             "raft._apply_lock")
 
-        self.role = Role.FOLLOWER
-        self.leader_id: Optional[str] = None
-        self._entries: Dict[int, ReplicateMsg] = {}
+        self.role = Role.FOLLOWER               # guarded-by: _lock
+        self.leader_id: Optional[str] = None    # guarded-by: _lock
+        self._entries: Dict[int, ReplicateMsg] = {}  # guarded-by: _lock
         # index -> ht_value, surviving CACHE eviction (trimmed separately):
         # the propagated-safe-time clamp must see the HT of EVERY entry a
         # lagging peer has not received — reading a cache-evicted tail as
         # "no constraint" let a restarted follower's safe time run ahead
         # of its data (caught by the linked-list churn harness)
-        self._ht_by_index: Dict[int, int] = {}
+        self._ht_by_index: Dict[int, int] = {}  # guarded-by: _lock
         # index -> originating span context for traced writes, so the
         # AppendEntries carrying that entry propagates the trace to peers;
         # trimmed aggressively (entries replicate within one heartbeat in
         # the common case) — a missing ctx only drops propagation, never
         # correctness
-        self._trace_ctx_by_index: Dict[int, dict] = {}
-        self._last_index = 0
-        self._last_term = 0
-        self._local_durable_index = 0
-        self.commit_index = 0
-        self.last_applied = 0
+        self._trace_ctx_by_index: Dict[int, dict] = {}  # guarded-by: _lock
+        self._last_index = 0           # guarded-by: _lock
+        self._last_term = 0            # guarded-by: _lock
+        self._local_durable_index = 0  # guarded-by: _lock
+        self.commit_index = 0          # guarded-by: _lock
+        self.last_applied = 0          # guarded-by: _lock
         # Durability watermark handshake: WAL-appender callbacks touch ONLY
         # this small lock + event (never self._lock), so a thread holding
         # self._lock may safely block on WAL durability (e.g. handle_update's
         # append_sync) without deadlocking against pending async callbacks.
-        self._durable_lock = threading.Lock()
-        self._durable_watermark = 0
+        self._durable_lock = lock_rank.tracked(threading.Lock(),
+                                               "raft._durable_lock")
+        self._durable_watermark = 0    # guarded-by: _durable_lock
         self._durable_event = threading.Event()
         # Latched on the first WAL append failure (Log seals itself): new
         # replicates fail fast with fate-unknown instead of waiting out
         # their timeout on a durability ack that can never come.
-        self._log_error: Optional[Exception] = None
-        self._withhold_votes_until = 0.0
-        self._last_leader_contact = time.monotonic()
+        self._log_error: Optional[Exception] = None  # guarded-by: _durable_lock
+        self._withhold_votes_until = 0.0        # guarded-by: _lock
+        self._last_leader_contact = time.monotonic()  # guarded-by: _lock
 
         # leader state
-        self._next_index: Dict[str, int] = {}
-        self._match_index: Dict[str, int] = {}
-        self._last_ack_send_time: Dict[str, float] = {}
-        self._peer_events: Dict[str, threading.Event] = {}
-        self._peer_threads: List[threading.Thread] = []
-        self._leader_epoch = 0
+        self._next_index: Dict[str, int] = {}         # guarded-by: _lock
+        self._match_index: Dict[str, int] = {}        # guarded-by: _lock
+        self._last_ack_send_time: Dict[str, float] = {}  # guarded-by: _lock
+        self._peer_events: Dict[str, threading.Event] = {}  # guarded-by: _lock
+        self._peer_threads: List[threading.Thread] = []     # guarded-by: _lock
+        self._leader_epoch = 0                        # guarded-by: _lock
 
+        # deliberately unannotated latch bool: set-once under _lock in
+        # shutdown(); loop threads read it bare (torn reads impossible,
+        # one extra iteration is harmless)
         self._stopped = False
         self._load_log()
         self._election_thread: Optional[threading.Thread] = None
@@ -351,7 +357,7 @@ class RaftConsensus:
         self._commit_worker.start()
 
     # -------------------------------------------------------------- startup
-    def _load_log(self) -> None:
+    def _load_log(self) -> None:  # guarded-by: _lock (pre-publication ctor)
         from yugabyte_tpu.consensus.log import LogReader
         # Durable config from metadata first (a committed config entry may
         # have been GC'd from the WAL).
@@ -503,7 +509,7 @@ class RaftConsensus:
                 return
             self._become_leader_unlocked()
 
-    def _spawn_role_change(self, role: "Role") -> None:
+    def _spawn_role_change(self, role: "Role") -> None:  # guarded-by: _lock
         """Notify upper layers of a role change without blocking the
         consensus lock. Latest-wins slot + drainer: the slot (written
         under the consensus lock, which every caller holds) always
@@ -634,7 +640,8 @@ class RaftConsensus:
             ht = self.clock.now().value if self.clock else 0
             msg = self._append_unlocked(OP_CHANGE_CONFIG, ht, payload)
             self._activate_config_unlocked(msg.index, new_ids)
-        for ev in self._peer_events.values():
+            events = list(self._peer_events.values())
+        for ev in events:
             ev.set()
         deadline = time.monotonic() + timeout_s
         with self._commit_cv:
@@ -729,7 +736,12 @@ class RaftConsensus:
               self.config.peer_id, msg.op_id, len(payload))
         from yugabyte_tpu.utils import sync_point
         sync_point.hit("raft.replicate:after_local_append")
-        for ev in self._peer_events.values():
+        # snapshot under the lock: iterating the live dict would race
+        # _ensure_peer_state_unlocked adding a peer (RuntimeError: dict
+        # changed size during iteration) — found by the lock pass
+        with self._lock:
+            events = list(self._peer_events.values())
+        for ev in events:
             ev.set()
         deadline = time.monotonic() + timeout_s
         with self._commit_cv:
@@ -751,7 +763,9 @@ class RaftConsensus:
                 cur = self._entries.get(msg.index)
                 if cur is None or cur.term != msg.term:
                     raise ReplicationAborted(f"op {msg.op_id} overwritten")
-                if self._log_error is not None:
+                with self._durable_lock:
+                    log_error = self._log_error
+                if log_error is not None:
                     # Local WAL is dead. The entry may still commit through
                     # the followers, so this is fate-unknown, not an abort:
                     # the timeout path keeps the watch_fate/dedup story.
@@ -909,7 +923,8 @@ class RaftConsensus:
     def _peer_loop(self, peer: str, epoch: int) -> None:
         """Per-peer replication worker, doubles as heartbeat timer
         (ref consensus_peers.h:183 SendNextRequest)."""
-        ev = self._peer_events[peer]
+        with self._lock:
+            ev = self._peer_events[peer]
         while True:
             hb = flags.get_flag("raft_heartbeat_interval_ms") / 1000.0
             ev.wait(timeout=hb)

@@ -26,12 +26,14 @@ class LocalTransport:
     """In-process message fabric between named consensus instances."""
 
     def __init__(self, seed: int = 0):
-        self._peers: Dict[str, object] = {}
-        self._lock = threading.Lock()
-        self._partitions: Set[Tuple[str, str]] = set()
-        self._down: Set[str] = set()
-        self._drop_probability = 0.0
-        self._rng = random.Random(seed)
+        from yugabyte_tpu.utils import lock_rank
+        self._peers: Dict[str, object] = {}        # guarded-by: _lock
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "local_transport._lock")
+        self._partitions: Set[Tuple[str, str]] = set()  # guarded-by: _lock
+        self._down: Set[str] = set()               # guarded-by: _lock
+        self._drop_probability = 0.0               # guarded-by: _lock
+        self._rng = random.Random(seed)            # guarded-by: _lock
 
     def register(self, peer_id: str, consensus: object) -> None:
         with self._lock:
@@ -42,7 +44,7 @@ class LocalTransport:
             self._peers.pop(peer_id, None)
 
     # ------------------------------------------------------ fault injection
-    def _known(self, name: str) -> bool:
+    def _known(self, name: str) -> bool:  # guarded-by: _lock
         return name in self._peers or \
             any(p.startswith(name + "/") for p in self._peers)
 

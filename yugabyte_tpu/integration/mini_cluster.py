@@ -129,6 +129,38 @@ class MiniCluster:
         raise StatusError(Status.TimedOut(
             f"replicas of {table_id} not all running"))
 
+    def wait_for_tablet_leader(self, tablet_id: str,
+                               timeout_s: float = 30.0,
+                               exclude: Optional[set] = None) -> str:
+        """Deadline-poll the live tservers' raft state until one reports
+        READY leadership for `tablet_id`; returns its server_id.
+
+        This is the deflake primitive for leader-failover tests: on a
+        loaded single-core CI machine an election can outlast the
+        client's retry budget, so a test that kills a leader and
+        immediately writes races the election (the known tier-1 flake).
+        Polling actual leader state — instead of a fixed sleep or retry
+        exhaustion — makes the wait exactly as long as the election."""
+        exclude = exclude or set()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            for ts in self.tservers:
+                if ts.server_id in exclude:
+                    continue
+                try:
+                    if tablet_id not in ts.tablet_manager.tablet_ids():
+                        continue
+                    peer = ts.tablet_manager.get_tablet(tablet_id)
+                    if peer.raft.is_leader() and peer.raft.leader_ready():
+                        return ts.server_id
+                except Exception:
+                    continue  # server mid-shutdown/bootstrap: keep polling
+            if time.monotonic() > deadline:
+                raise StatusError(Status.TimedOut(
+                    f"no ready leader for tablet {tablet_id} within "
+                    f"{timeout_s}s"))
+            time.sleep(0.02)
+
     def shutdown(self) -> None:
         for c in self._clients:
             c.close()

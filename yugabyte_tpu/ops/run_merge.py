@@ -247,7 +247,7 @@ def _quantize_cmp(used: List[int]) -> List[int]:
     return used
 
 
-_bucket_keys_seen = set()
+_bucket_keys_seen = set()  # guarded-by: _bucket_lock
 _bucket_lock = __import__("threading").Lock()
 
 
@@ -1084,35 +1084,41 @@ def _launch_chunked(staged: StagedRuns, params: GCParams, snapshot: bool,
     return _ChunkedMergeGCHandle(handles, metas, staged)
 
 
-_probe_winners = None  # lazy: {log2(n): "pallas"|"network"} from PROBE_TPU
+_probe_winners = None  # guarded-by: _probe_lock
+_probe_lock = __import__("threading").Lock()
 
 
 def _load_probe_winners() -> dict:
     """Measured per-shape impl winners from tools/probe_kernel.py's
     artifact (real-TPU sustained rates).  The probe showed neither impl
     dominates across shapes, so auto routes by the nearest measured size
-    instead of by architecture faith."""
+    instead of by architecture faith.  Initialized once under _probe_lock
+    (concurrent compaction threads race the first launch; the unlocked
+    check-then-set here used to let two threads build it concurrently and
+    one publish a half-filled dict)."""
     global _probe_winners
-    if _probe_winners is not None:
+    with _probe_lock:
+        if _probe_winners is not None:
+            return _probe_winners
+        winners = {}
+        path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "PROBE_TPU.json")
+        try:
+            import json as _json
+            with open(path) as f:
+                d = _json.load(f)
+            if d.get("platform") == "tpu":
+                for k, v in d.items():
+                    if k.endswith("_pallas_rows_per_sec"):
+                        lg = int(k[1:].split("_")[0])
+                        net = d.get(f"n{lg}_network_rows_per_sec")
+                        if net:
+                            winners[lg] = \
+                                "pallas" if v > net else "network"
+        except (OSError, ValueError, KeyError):
+            pass
+        _probe_winners = winners
         return _probe_winners
-    _probe_winners = {}
-    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "PROBE_TPU.json")
-    try:
-        import json as _json
-        with open(path) as f:
-            d = _json.load(f)
-        if d.get("platform") == "tpu":
-            for k, v in d.items():
-                if k.endswith("_pallas_rows_per_sec"):
-                    lg = int(k[1:].split("_")[0])
-                    net = d.get(f"n{lg}_network_rows_per_sec")
-                    if net:
-                        _probe_winners[lg] = \
-                            "pallas" if v > net else "network"
-    except (OSError, ValueError, KeyError):
-        pass
-    return _probe_winners
 
 
 def _pick_impl(staged: StagedRuns) -> str:
@@ -1151,6 +1157,9 @@ def _pick_impl(staged: StagedRuns) -> str:
     return "pallas"
 
 
+# Deliberately unannotated latch bool: False->True exactly once, torn
+# reads impossible for a bool, and a racy read only costs one extra
+# pallas attempt that fails the same way.
 _pallas_broken = False  # set on the first Mosaic lowering/runtime failure
 
 

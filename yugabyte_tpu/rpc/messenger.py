@@ -201,9 +201,12 @@ _COMPRESS_BIT = 0x80000000
 _SIDECAR_BIT = 0x40000000
 
 # observability: sidecar frames sent / segment bytes moved (tests assert
-# the zero-copy path actually carries the bulk traffic)
-sidecar_frames_sent = 0
-sidecar_bytes_sent = 0
+# the zero-copy path actually carries the bulk traffic). Incremented from
+# every sender thread — the bare `+= 1` here was a textbook lost-update
+# race (found by the lock-discipline pass).
+sidecar_frames_sent = 0  # guarded-by: _sidecar_stats_lock
+sidecar_bytes_sent = 0   # guarded-by: _sidecar_stats_lock
+_sidecar_stats_lock = threading.Lock()
 
 
 def _send_message(sock: socket.socket, lock: threading.Lock, obj) -> None:
@@ -229,8 +232,9 @@ def _send_message(sock: socket.socket, lock: threading.Lock, obj) -> None:
         _send_frame(sock, lock, payload)
         return
     global sidecar_frames_sent, sidecar_bytes_sent
-    sidecar_frames_sent += 1
-    sidecar_bytes_sent += sum(len(s) for s in sidecars)
+    with _sidecar_stats_lock:
+        sidecar_frames_sent += 1
+        sidecar_bytes_sent += sum(len(s) for s in sidecars)
     n_sc = len(sidecars)
     header = bytearray()
     header += struct.pack("<I", n_sc)
@@ -344,11 +348,13 @@ class _ClientConnection:
             self.sock = _TlsSocket(ssl_ctx.wrap_socket(self.sock))
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from yugabyte_tpu.utils import lock_rank
         self.write_lock = threading.Lock()
-        self.lock = threading.Lock()
-        self.next_id = 1
-        self.pending: Dict[int, dict] = {}   # id -> {event, resp}
-        self.dead: Optional[Exception] = None
+        self.lock = lock_rank.tracked(threading.Lock(),
+                                      "messenger.client_conn.lock")
+        self.next_id = 1                     # guarded-by: lock
+        self.pending: Dict[int, dict] = {}   # guarded-by: lock
+        self.dead: Optional[Exception] = None  # guarded-by: lock
         self.reader = threading.Thread(target=self._read_loop, daemon=True,
                                        name=f"rpc-client-read-{addr}")
         self.reader.start()
@@ -397,8 +403,10 @@ class _ClientConnection:
             raise RpcTimeout(f"{svc}.{mth} to {self.addr} "
                              f"timed out after {timeout_s}s")
         if waiter["resp"] is None:
+            with self.lock:
+                dead = self.dead
             raise ServiceUnavailable(f"{self.addr}: connection failed "
-                                     f"({self.dead})")
+                                     f"({dead})")
         return waiter["resp"]
 
     def close(self) -> None:
@@ -432,18 +440,27 @@ class Messenger:
         # per-service.method inbound latency histograms (ref: the
         # reference's handler_latency_* metrics per RPC method); entity id
         # carries the method so the family name stays fixed and scrapeable
+        from yugabyte_tpu.utils import lock_rank
         self._metrics = metrics if metrics is not None else ROOT_REGISTRY
-        self._method_hists: Dict[Tuple[str, str], object] = {}
-        self._method_hists_lock = threading.Lock()
+        self._method_hists: Dict[Tuple[str, str],
+                                 object] = {}  # guarded-by: _method_hists_lock
+        self._method_hists_lock = lock_rank.tracked(
+            threading.Lock(), "messenger._method_hists_lock")
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind_host, port))
         self._listener.listen(128)
         self.host, self.port = self._listener.getsockname()
-        self._conns: Dict[Tuple[str, int], _ClientConnection] = {}
-        self._conns_lock = threading.Lock()
-        self._inbound: list = []
-        self._inbound_lock = threading.Lock()
+        self._conns: Dict[Tuple[str, int],
+                          _ClientConnection] = {}  # guarded-by: _conns_lock
+        self._conns_lock = lock_rank.tracked(threading.Lock(),
+                                             "messenger._conns_lock")
+        self._inbound: list = []  # guarded-by: _inbound_lock
+        self._inbound_lock = lock_rank.tracked(threading.Lock(),
+                                               "messenger._inbound_lock")
+        # deliberately unannotated latch bool: one-way False->True at
+        # shutdown; the accept loop's bare read only risks one extra
+        # accept, which shutdown() handles by closing late arrivals
         self._shutdown = False
         # persistent service pool (ref rpc/service_pool.cc): handlers run
         # on reused workers — a fresh thread per request cost ~0.4ms of
@@ -456,11 +473,12 @@ class Messenger:
         self._tls_server_ctx, self._tls_client_ctx = _tls_contexts()
         # /rpcz bookkeeping (ref rpc/rpcz_store.cc): in-flight inbound
         # calls + a ring of recently completed ones
-        self._rpcz_lock = threading.Lock()
-        self._rpcz_seq = 0
-        self._rpcz_inflight: Dict[int, dict] = {}
+        self._rpcz_lock = lock_rank.tracked(threading.Lock(),
+                                            "messenger._rpcz_lock")
+        self._rpcz_seq = 0                       # guarded-by: _rpcz_lock
+        self._rpcz_inflight: Dict[int, dict] = {}  # guarded-by: _rpcz_lock
         from collections import deque
-        self._rpcz_recent: deque = deque(maxlen=100)
+        self._rpcz_recent: deque = deque(maxlen=100)  # guarded-by: _rpcz_lock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=f"rpc-accept-{name}")
         self._accept_thread.start()
@@ -551,7 +569,10 @@ class Messenger:
 
     def _method_histogram(self, svc: str, mth: str):
         key = (svc, mth)
-        h = self._method_hists.get(key)
+        # benign racy fast path on the per-RPC hot loop: dict reads are
+        # atomic under the GIL and every WRITE happens under the lock
+        # below, so the worst case is taking the slow path once
+        h = self._method_hists.get(key)  # yblint: disable=lock-discipline
         if h is None:
             with self._method_hists_lock:
                 h = self._method_hists.get(key)

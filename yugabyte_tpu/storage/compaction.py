@@ -52,8 +52,8 @@ flags.define_flag("distributed_compaction_min_rows", 1 << 20,
                   "available (ref: subcompaction sizing, "
                   "compaction_job.cc:330 GenSubcompactionBoundaries)")
 
-_rate_limiter = None
-_rate_limiter_rate = 0
+_rate_limiter = None       # guarded-by: _rate_limiter_lock
+_rate_limiter_rate = 0     # guarded-by: _rate_limiter_lock
 _rate_limiter_lock = __import__("threading").Lock()
 
 

@@ -36,15 +36,18 @@ class DeviceSlabCache:
 
     def __init__(self, device=None, capacity_bytes: int = 4 << 30):
         from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+        from yugabyte_tpu.utils import lock_rank
         self.device = device
         self.capacity = capacity_bytes
-        self._map: "OrderedDict[CacheKey, StagedCols]" = OrderedDict()
-        self._used = 0
-        self._lock = threading.Lock()
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "device_cache.slab_lock")
+        self._map: "OrderedDict[CacheKey, StagedCols]" = \
+            OrderedDict()                  # guarded-by: _lock
+        self._used = 0                     # guarded-by: _lock
         # per-instance ints (tests diff fresh caches) + process-wide
         # registry counters so the hit ratio is scrapeable
-        self.hits = 0
-        self.misses = 0
+        self.hits = 0                      # guarded-by: _lock
+        self.misses = 0                    # guarded-by: _lock
         e = ROOT_REGISTRY.entity("server", "device_cache")
         self._c_hits = e.counter("device_cache_hits_total",
                                  "HBM slab cache hits")
@@ -158,11 +161,13 @@ class HostStagingPool:
     """
 
     def __init__(self, max_per_shape: int = 2, max_bytes: int = 1 << 30):
-        self._free: dict = {}
-        self._bytes = 0
+        from yugabyte_tpu.utils import lock_rank
+        self._free: dict = {}              # guarded-by: _lock
+        self._bytes = 0                    # guarded-by: _lock
         self._max_per_shape = max_per_shape
         self._max_bytes = max_bytes
-        self._lock = threading.Lock()
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "device_cache.staging_pool_lock")
         from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
         e = ROOT_REGISTRY.entity("server", "device_cache")
         self._c_reuse = e.counter(
@@ -194,7 +199,7 @@ class HostStagingPool:
                 self._bytes += arr.nbytes
 
 
-_staging_pool: Optional[HostStagingPool] = None
+_staging_pool: Optional[HostStagingPool] = None  # guarded-by: _staging_pool_lock
 _staging_pool_lock = threading.Lock()
 
 

@@ -207,9 +207,13 @@ class MaintenanceManager:
         # (clears DB background errors). The tablet server passes the
         # manager's recover_failed_tablet for full re-bootstrap coverage.
         self._recover_fn = recover_fn or (lambda peer: peer.try_recover())
+        # _recover_backoff is scheduler-thread-only state (the loop and
+        # test-driven run_once are never concurrent by contract)
         self._recover_backoff: Dict[str, RetrySchedule] = {}
-        self._registered: List[MaintenanceOp] = []
-        self._reg_lock = threading.Lock()
+        from yugabyte_tpu.utils import lock_rank
+        self._registered: List[MaintenanceOp] = []  # guarded-by: _reg_lock
+        self._reg_lock = lock_rank.tracked(threading.Lock(),
+                                           "maintenance._reg_lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._memory_pressure = (memory_pressure_fn or

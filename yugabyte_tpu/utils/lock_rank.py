@@ -1,0 +1,212 @@
+"""Runtime lock-order tracker: acquisition edges + cycle detection.
+
+The static lock-discipline pass (tools/analysis) proves accesses happen
+under the right lock; it cannot prove locks are acquired in a consistent
+ORDER. This module records the actual acquisition graph at runtime and
+fails when it contains a cycle — the classic deadlock precondition (ref:
+the reference's yb::RWC lock-rank debugging and absl's deadlock
+detector).
+
+Usage — wrap a lock at construction:
+
+    self._lock = lock_rank.tracked(threading.Lock(), "raft._lock")
+    self._durable_lock = lock_rank.tracked(threading.Lock(),
+                                           "raft._durable_lock")
+
+`tracked()` is a NO-OP passthrough in production: tracking is enabled
+only under pytest (or YBTPU_LOCK_RANK=1), so the hot paths pay nothing
+outside tests. When enabled, each acquire records edges
+(every-currently-held-lock -> acquired-lock) into a process-global
+graph; a NEW edge triggers an incremental cycle check whose result is
+latched into `violations()` (raising inside arbitrary daemon threads
+would vanish — the tier-1 test asserts `assert_no_cycles()` instead).
+
+All tracked locks sharing one `name` are one graph node: per-instance
+locks of the same class/field (e.g. every tablet's raft lock) collapse
+to their rank, which is exactly the granularity deadlock ordering is
+defined over.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_edges_lock = threading.Lock()
+_edges: Dict[str, Set[str]] = {}          # name -> set of names acquired
+                                          # while `name` was held
+_edge_sites: Dict[Tuple[str, str], str] = {}
+_violations: List[str] = []
+_held = threading.local()
+
+
+def enabled() -> bool:
+    env = os.environ.get("YBTPU_LOCK_RANK")
+    if env is not None:
+        return env not in ("", "0", "false", "off")
+    return "pytest" in sys.modules
+
+
+def tracked(lock, name: str):
+    """Wrap `lock` for order tracking; passthrough when tracking is off."""
+    if not enabled():
+        return lock
+    return TrackedLock(lock, name)
+
+
+class TrackedLock:
+    """Duck-types threading.Lock (acquire/release/context manager), so it
+    also works as the inner lock of a threading.Condition. Non-blocking
+    probe acquires (Condition._is_owned's `acquire(False)`) that fail do
+    not record edges or held state."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock, name: str):
+        self._lock = lock
+        self.name = name
+
+    # -------------------------------------------------- lock protocol
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _record_acquire(self.name)
+        return got
+
+    def release(self) -> None:
+        _record_release(self.name)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    return stack
+
+
+def _local_seen() -> Set[Tuple[str, str]]:
+    seen = getattr(_held, "seen", None)
+    if seen is None:
+        seen = _held.seen = set()
+    return seen
+
+
+def _record_acquire(name: str) -> None:
+    stack = _held_stack()
+    seen = _local_seen()
+    for holder in stack:
+        edge = (holder, name)
+        if holder == name or edge in seen:
+            continue
+        seen.add(edge)
+        with _edges_lock:
+            known = _edges.setdefault(holder, set())
+            if name in known:
+                continue
+            known.add(name)
+            _edge_sites[edge] = threading.current_thread().name
+            cycle = _find_cycle_unlocked()
+            if cycle is not None:
+                _violations.append(
+                    "lock-order cycle: " + " -> ".join(cycle)
+                    + f" (closing edge {holder} -> {name} on thread "
+                    + threading.current_thread().name + ")")
+    stack.append(name)
+
+
+def _record_release(name: str) -> None:
+    stack = _held_stack()
+    # release order may not be LIFO (rare but legal): drop the last
+    # matching entry
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == name:
+            del stack[i]
+            return
+
+
+def _find_cycle_unlocked() -> Optional[List[str]]:
+    """DFS over the edge graph; returns one cycle as a node list (first
+    node repeated at the end) or None. Caller holds _edges_lock."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    parent: Dict[str, str] = {}
+
+    def dfs(u: str) -> Optional[List[str]]:
+        color[u] = GRAY
+        for v in sorted(_edges.get(u, ())):
+            c = color.get(v, WHITE)
+            if c == GRAY:
+                path = [v, u]
+                cur = u
+                while cur != v:
+                    cur = parent[cur]
+                    path.append(cur)
+                path.reverse()
+                return path
+            if c == WHITE:
+                parent[v] = u
+                found = dfs(v)
+                if found is not None:
+                    return found
+        color[u] = BLACK
+        return None
+
+    for node in sorted(_edges):
+        if color.get(node, WHITE) == WHITE:
+            found = dfs(node)
+            if found is not None:
+                return found
+    return None
+
+
+# ------------------------------------------------------------- inspection
+def edges() -> Dict[str, Set[str]]:
+    with _edges_lock:
+        return {k: set(v) for k, v in _edges.items()}
+
+
+def find_cycle() -> Optional[List[str]]:
+    with _edges_lock:
+        return _find_cycle_unlocked()
+
+
+def violations() -> List[str]:
+    with _edges_lock:
+        return list(_violations)
+
+
+def assert_no_cycles() -> None:
+    """Fail (AssertionError) if any acquisition-order cycle was ever
+    observed in this process — wired into tier-1 via tests/test_yblint.py."""
+    with _edges_lock:
+        problems = list(_violations)
+        cycle = _find_cycle_unlocked()
+    if cycle is not None and not problems:
+        problems.append("lock-order cycle: " + " -> ".join(cycle))
+    assert not problems, "\n".join(problems)
+
+
+def reset() -> None:
+    """Clear the global graph (unit tests seeding artificial cycles)."""
+    with _edges_lock:
+        _edges.clear()
+        _edge_sites.clear()
+        _violations.clear()
+    # thread-local caches of other threads expire naturally: a stale
+    # `seen` entry only suppresses re-recording an edge that reset()
+    # just dropped, so tests use fresh lock names instead
+    _held.stack = []
+    _held.seen = set()

@@ -17,13 +17,15 @@ from typing import Callable, Optional
 
 class PriorityThreadPool:
     def __init__(self, max_threads: int = 1, name: str = "pool"):
+        from yugabyte_tpu.utils import lock_rank
         self.name = name
-        self._heap = []  # (-priority, seq, fn)
+        self._heap = []  # (-priority, seq, fn)  # guarded-by: _cv
         self._seq = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       f"threadpool.{name}._lock")
         self._cv = threading.Condition(self._lock)
-        self._shutdown = False
-        self._active = 0
+        self._shutdown = False  # guarded-by: _cv
+        self._active = 0        # guarded-by: _cv
         self._threads = [threading.Thread(target=self._worker, daemon=True,
                                           name=f"{name}-{i}")
                          for i in range(max_threads)]
