@@ -466,7 +466,539 @@ class TestFramework:
 
     def test_all_passes_have_unique_names(self):
         names = [p.name for p in ALL_PASSES]
-        assert len(names) == len(set(names)) == 5
+        assert len(names) == len(set(names)) == 9
+
+    def test_update_baseline_refuses_unjustified(self, tmp_path):
+        target = tmp_path / "mod.py"
+        target.write_text(BAD_LOCK_SRC)
+        bl_path = str(tmp_path / "baseline.txt")
+        findings = core.analyze_paths(str(tmp_path), ["mod.py"],
+                                      [LockDisciplinePass()])
+        bl = core.Baseline.load(bl_path)
+        refused = bl.update(bl_path, findings)
+        assert refused == [findings[0].fingerprint]
+        assert not os.path.exists(bl_path)  # nothing written on refusal
+        # a justified entry regenerates fine, sectioned per pass
+        bl.notes[findings[0].fingerprint] = "fixture: deliberate"
+        assert bl.update(bl_path, findings) == []
+        text = open(bl_path).read()
+        assert "# --- pass: lock-discipline ---" in text
+        assert "fixture: deliberate" in text
+        # and round-trips through load
+        assert core.Baseline.load(bl_path).entries[
+            findings[0].fingerprint] == 1
+
+    def test_changed_scope_cli(self, tmp_path):
+        """--changed with no changed files exits 0 fast."""
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis", "--changed",
+             "--no-baseline"],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env)
+        # tmp_path is not a git repo: the file set is empty either way
+        assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# whole-program index (v2 substrate)
+# ---------------------------------------------------------------------------
+
+from tools.analysis.project_index import ProjectIndex  # noqa: E402
+from tools.analysis.passes.donation_safety import (  # noqa: E402
+    DonationSafetyPass)
+from tools.analysis.passes.error_propagation import (  # noqa: E402
+    ErrorPropagationPass)
+from tools.analysis.passes.resource_lifetime import (  # noqa: E402
+    ResourceLifetimePass)
+from tools.analysis.passes.wire_drift import WireDriftPass  # noqa: E402
+
+
+def _index_files(files):
+    ctxs = [core.FileContext(rp, rp, textwrap.dedent(src))
+            for rp, src in files.items()]
+    return ctxs, ProjectIndex(ctxs)
+
+
+def _lint_idx(files, passes, only=None):
+    ctxs, idx = _index_files(files)
+    out = []
+    for ctx in ctxs:
+        if only is not None and ctx.relpath != only:
+            continue
+        for p in passes:
+            if not p.applies_to(ctx.relpath):
+                continue
+            fs = p.run(ctx, idx) if p.needs_index else p.run(ctx)
+            out.extend(f for f in fs if not core._is_suppressed(ctx, f))
+    return out
+
+
+class TestProjectIndex:
+    def test_import_aliasing(self):
+        files = {
+            "pkg/a.py": "def f():\n    return 1\n",
+            "pkg/b.py": ("from pkg.a import f as g\n"
+                         "import pkg.a as mod\n\n"
+                         "def h():\n    return g() + mod.f()\n"),
+        }
+        _, idx = _index_files(files)
+        mi = idx.by_relpath["pkg/b.py"]
+        assert idx.resolve(mi, "g") == "pkg.a.f"
+        assert idx.resolve(mi, "mod.f") == "pkg.a.f"
+        assert idx.call_graph["pkg.b.h"] == {"pkg.a.f"}
+
+    def test_relative_imports(self):
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/top.py": "def ft():\n    pass\n",
+            "pkg/sub/__init__.py": "",
+            "pkg/sub/x.py": "def fx():\n    pass\n",
+            "pkg/sub/y.py": ("from .x import fx\n"
+                             "from ..top import ft as t\n\n"
+                             "def fy():\n    fx()\n    t()\n"),
+        }
+        _, idx = _index_files(files)
+        assert idx.call_graph["pkg.sub.y.fy"] == {"pkg.sub.x.fx",
+                                                  "pkg.top.ft"}
+
+    def test_method_resolution_through_self(self):
+        files = {"pkg/c.py": """
+            class Base:
+                def shared(self):
+                    return 1
+
+            class D(Base):
+                def run(self):
+                    return self.shared() + self.local()
+
+                def local(self):
+                    return 2
+        """}
+        _, idx = _index_files(files)
+        assert idx.call_graph["pkg.c.D.run"] == {"pkg.c.Base.shared",
+                                                 "pkg.c.D.local"}
+
+    def test_attr_types_and_typed_receivers(self):
+        files = {"pkg/d.py": """
+            class Widget:
+                def spin(self):
+                    return 1
+
+            def make_widget() -> Widget:
+                return Widget()
+
+            class Owner:
+                def __init__(self, w: Widget):
+                    self.w = w
+                    self.made = make_widget()
+
+                def go(self):
+                    return self.w.spin() + self.made.spin()
+        """}
+        _, idx = _index_files(files)
+        owner = idx.classes["pkg.d.Owner"]
+        assert owner.attr_types == {"w": "pkg.d.Widget",
+                                    "made": "pkg.d.Widget"}
+        assert "pkg.d.Widget.spin" in idx.call_graph["pkg.d.Owner.go"]
+
+    def test_callback_reference_edge(self):
+        files = {"pkg/e.py": """
+            import threading
+
+            def job():
+                def worker():
+                    inner()
+                t = threading.Thread(target=worker)
+                t.start()
+
+            def inner():
+                pass
+        """}
+        _, idx = _index_files(files)
+        assert "pkg.e.job.worker" in idx.call_graph["pkg.e.job"]
+        assert "pkg.e.inner" in idx.call_graph["pkg.e.job.worker"]
+        assert idx.reachable(["pkg.e.job"]) >= {"pkg.e.job",
+                                                "pkg.e.job.worker",
+                                                "pkg.e.inner"}
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+# ---------------------------------------------------------------------------
+
+DONATION_PRELUDE = """
+    import functools
+    import jax
+
+    def _impl(cols, n):
+        return cols
+
+    fused = functools.partial(jax.jit, donate_argnums=(0,))(_impl)
+"""
+
+
+class TestDonationSafety:
+    PASS = [DonationSafetyPass()]
+
+    def _lint(self, body):
+        src = textwrap.dedent(DONATION_PRELUDE) + textwrap.dedent(body)
+        return _lint_idx({"yugabyte_tpu/fake/k.py": src}, self.PASS)
+
+    def test_use_after_donate_fires(self):
+        fs = self._lint("""
+            def bad(arr):
+                out = fused(arr, 4)
+                return arr + out
+        """)
+        assert _codes(fs) == ["use-after-donate"]
+        assert fs[0].symbol == "bad"
+
+    def test_redispatch_counts_as_use(self):
+        fs = self._lint("""
+            def bad(arr):
+                a = fused(arr, 4)
+                b = fused(arr, 4)
+                return a, b
+        """)
+        assert _codes(fs) == ["use-after-donate"]
+
+    def test_rebind_clears_and_metadata_is_fine(self):
+        fs = self._lint("""
+            def fine(arr, staged):
+                out = fused(staged.cols, 4)
+                n = staged.n           # other attrs stay legal
+                arr = fused(arr, 4)    # rebind: arr now holds the result
+                return arr, out, n
+        """)
+        assert fs == []
+
+    def test_root_escape_fires_and_conditional_poison_clears(self):
+        fs = self._lint("""
+            def escapes(staged):
+                packed = fused(staged.cols, 4)
+                return Handle(packed, staged)
+        """)
+        assert _codes(fs) == ["escape-after-donate"]
+        fs = self._lint("""
+            def guarded(staged, donate):
+                fn = fused if donate else _impl
+                packed = fn(staged.cols, 4)
+                if donate:
+                    staged = replace(staged, cols=None)
+                return Handle(packed, staged)
+        """)
+        assert fs == []
+
+    def test_helper_one_level(self):
+        fs = self._lint("""
+            def launch(staged):
+                return fused(staged.cols, 4)
+
+            def caller(s):
+                h = launch(s)
+                return s.cols
+        """)
+        assert _codes(fs) == ["use-after-donate"]
+        assert fs[0].symbol == "caller"
+
+    def test_suppression(self):
+        fs = self._lint("""
+            def waived(arr):
+                out = fused(arr, 4)
+                return arr + out  # yblint: disable=donation-safety
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# error propagation
+# ---------------------------------------------------------------------------
+
+class TestErrorPropagation:
+    PASS = [ErrorPropagationPass()]
+
+    def _lint(self, body, relpath="yugabyte_tpu/storage/fake.py"):
+        return _lint_idx({relpath: body}, self.PASS)
+
+    def test_unrouted_handler_on_flush_path_fires(self):
+        fs = self._lint("""
+            def flush_units():
+                helper()
+                try:
+                    io()
+                except ValueError:
+                    recover()
+
+            def helper():
+                try:
+                    io()
+                except OSError:
+                    fallback()
+
+            def unrelated():
+                try:
+                    io()
+                except OSError:
+                    fallback()
+        """)
+        assert _codes(fs) == ["unrouted-except", "unrouted-except"]
+        assert sorted(f.symbol for f in fs) == ["flush_units", "helper"]
+
+    def test_worker_closure_on_path_is_covered(self):
+        fs = self._lint("""
+            import threading
+
+            def run_compaction():
+                def ingest():
+                    try:
+                        io()
+                    except OSError:
+                        fallback()
+                t = threading.Thread(target=ingest)
+                t.start()
+        """)
+        assert _codes(fs) == ["unrouted-except"]
+        assert fs[0].symbol == "run_compaction.ingest"
+
+    def test_routing_raise_trace_and_marker_are_clean(self):
+        fs = self._lint("""
+            def flush_ok():
+                try:
+                    io()
+                except OSError as e:
+                    TRACE("failed: %s", e)
+                try:
+                    io()
+                except OSError:
+                    raise
+                try:
+                    io()
+                except OSError:  # yblint: contained(fixture: safe)
+                    fallback()
+                try:
+                    io()
+                except OSError as e:
+                    self._set_background_error("flush", e)
+        """)
+        assert fs == []
+
+    def test_outside_critical_dirs_not_reported(self):
+        fs = self._lint("""
+            def flush_units():
+                try:
+                    io()
+                except OSError:
+                    fallback()
+        """, relpath="yugabyte_tpu/client/fake.py")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# resource lifetime
+# ---------------------------------------------------------------------------
+
+class TestResourceLifetime:
+    PASS = [ResourceLifetimePass()]
+
+    def _lint(self, body):
+        return _lint_idx({"yugabyte_tpu/fake/r.py": body}, self.PASS)
+
+    def test_lease_unreleased_and_unsafe(self):
+        fs = self._lint("""
+            def leaky(pool):
+                arr = pool.acquire((4, 4))
+                work(arr)
+
+            def risky(pool):
+                arr = pool.acquire((4, 4))
+                work(arr)
+                pool.release(arr)
+        """)
+        assert _codes(fs) == ["leak-on-exception", "unreleased"]
+
+    def test_lease_exception_safe_forms(self):
+        fs = self._lint("""
+            def fin(pool):
+                arr = pool.acquire((4, 4))
+                try:
+                    work(arr)
+                finally:
+                    pool.release(arr)
+
+            def mirrored(pool):
+                arr = pool.acquire((4, 4))
+                try:
+                    work(arr)
+                except Exception:
+                    pool.release(arr)
+                    raise
+                upload(arr)
+                pool.release(arr)
+
+            def handed_off(pool, sink):
+                arr = pool.acquire((4, 4))
+                sink.slot = arr
+        """)
+        assert fs == []
+
+    def test_file_handles(self):
+        fs = self._lint("""
+            def leak(env):
+                f = env.open_append("x")
+                f.append(b"d")
+                f.close()
+
+            def ok(env):
+                f = env.open_append("x")
+                try:
+                    f.append(b"d")
+                finally:
+                    f.close()
+
+            def ok_with(path):
+                with open(path) as f:
+                    return f.read()
+        """)
+        assert _codes(fs) == ["leak-on-exception"]
+        assert fs[0].symbol == "leak"
+
+    def test_raw_lock_acquire(self):
+        fs = self._lint("""
+            def raw(self):
+                self._lock.acquire()
+                do()
+                self._lock.release()
+
+            def raw_ok(self):
+                self._lock.acquire()
+                try:
+                    do()
+                finally:
+                    self._lock.release()
+        """)
+        assert _codes(fs) == ["raw-lock-acquire"]
+        assert fs[0].symbol == "raw"
+
+    def test_suppression(self):
+        fs = self._lint("""
+            def transfer(pool):
+                arr = pool.acquire((4, 4))  # yblint: disable=resource-lifetime
+                work(arr)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# wire drift
+# ---------------------------------------------------------------------------
+
+WIRE_SERVER = """
+    SVC = "fakesvc"
+
+    class Handler:
+        def ping(self, token, extra=None):
+            return {"ok": True, "token": token}
+
+        def opaque(self, token):
+            return make_response(token)
+
+    def setup(messenger):
+        h = Handler()
+        messenger.register_service(SVC, h)
+"""
+
+
+class TestWireDrift:
+    PASS = [WireDriftPass()]
+
+    def _lint(self, client_src, server_src=WIRE_SERVER):
+        return _lint_idx(
+            {"yugabyte_tpu/fake/server.py": server_src,
+             "yugabyte_tpu/fake/client.py": client_src},
+            self.PASS, only="yugabyte_tpu/fake/client.py")
+
+    def test_clean_site(self):
+        assert self._lint("""
+            from yugabyte_tpu.fake.server import SVC
+
+            def good(messenger, addr):
+                resp = messenger.call(addr, SVC, "ping", token=1)
+                return resp["ok"], resp.get("token")
+        """) == []
+
+    def test_request_field_drift(self):
+        fs = self._lint("""
+            from yugabyte_tpu.fake.server import SVC
+
+            def bad(messenger, addr):
+                return messenger.call(addr, SVC, "ping", tok=1)
+        """)
+        assert _codes(fs) == ["missing-request-field",
+                              "unknown-request-field"]
+
+    def test_unknown_method_and_drifted_response(self):
+        fs = self._lint("""
+            from yugabyte_tpu.fake.server import SVC
+
+            def bad_method(messenger, addr):
+                return messenger.call(addr, SVC, "nope")
+
+            def bad_resp(messenger, addr):
+                resp = messenger.call(addr, SVC, "ping", token=1)
+                return resp["okk"]
+
+            def opaque_resp_not_checked(messenger, addr):
+                resp = messenger.call(addr, SVC, "opaque", token=1)
+                return resp["whatever"]
+        """)
+        assert _codes(fs) == ["drifted-response-field", "unknown-method"]
+
+    def test_wrapper_dispatch(self):
+        fs = self._lint("""
+            from yugabyte_tpu.fake.server import SVC
+
+            class Client:
+                def _rpc(self, mth, **kw):
+                    return self._messenger.call("a", SVC, mth, **kw)
+
+                def do(self):
+                    return self._rpc("ping", token=1, bogus=2)
+        """)
+        assert _codes(fs) == ["unknown-request-field"]
+
+    def test_codec_pair_drift(self):
+        fs = _lint_idx({"yugabyte_tpu/fake/wire.py": """
+            def thing_to_wire(t):
+                return {"a": t.a, "b": t.b}
+
+            def thing_from_wire(w):
+                return Thing(a=w["a"], c=w["c"])
+
+            def ok_to_wire(t):
+                w = {"x": t.x}
+                if t.y:
+                    w["y"] = t.y
+                return w
+
+            def ok_from_wire(w):
+                return Thing(x=w["x"], y=w.get("y"))
+        """}, self.PASS)
+        assert _codes(fs) == ["wire-field-never-read",
+                              "wire-field-never-written"]
+
+    def test_declared_pair(self):
+        fs = _lint_idx(
+            {"yugabyte_tpu/fake/prod.py": """
+                def make(self):  # yblint: wire-pair(tp, writes)
+                    return [{"x": 1, "y": 2}]
+             """,
+             "yugabyte_tpu/fake/cons.py": """
+                def take(self, report):  # yblint: wire-pair(tp, reads)
+                    return [r["x"] for r in report]
+             """},
+            self.PASS, only="yugabyte_tpu/fake/prod.py")
+        assert _codes(fs) == ["wire-field-never-read"]
+        assert "'y'" in fs[0].message
 
 
 # ---------------------------------------------------------------------------

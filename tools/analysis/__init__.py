@@ -1,15 +1,20 @@
 """yblint: the project's unified AST analysis framework.
 
-One parse + one walk per file, shared by every registered pass; per-file
-parallel execution; a committed baseline for justified suppressions; JSON
-and human output. Run as `python -m tools.analysis` (see __main__.py) or
+One parse + one walk per file, shared by every registered pass; a
+whole-program ProjectIndex (symbol table, import aliases, class-attr
+types, call graph) built exactly once per run for the cross-file passes;
+per-file parallel execution; a committed per-pass-sectioned baseline for
+justified suppressions; JSON and human output. Run as
+`python -m tools.analysis` (see __main__.py), via `tools/check.sh`, or
 from CI via `run_analysis()` / the tier-1 test in tests/test_yblint.py.
 
 Adding a pass: subclass tools.analysis.core.AnalysisPass, implement
-`run(ctx)` returning Findings, and append an instance to
-tools.analysis.passes.ALL_PASSES. See tools/analysis/passes/ for the four
-shipped passes (jit trace-safety, lock discipline, blocking-call-in-
-reactor, swallowed errors) plus metric naming.
+`run(ctx)` returning Findings (set `needs_index = True` for
+`run(ctx, index)` whole-program passes), and append an instance to
+tools.analysis.passes.ALL_PASSES. See tools/analysis/passes/ for the
+nine shipped passes: jit trace-safety, lock discipline, blocking-call-
+in-reactor, swallowed errors, metric naming, donation safety, error
+propagation, resource lifetime and wire drift.
 """
 
 from tools.analysis.core import (AnalysisPass, Baseline, FileContext,

@@ -1,12 +1,14 @@
 """yblint CLI: `python -m tools.analysis [targets...]`.
 
 Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
-2 = usage error. See README "Static analysis" for the workflow.
+2 = usage error / refused baseline update. See README "Static analysis"
+for the workflow.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 
 from tools.analysis.core import (DEFAULT_BASELINE, DEFAULT_TARGETS,
@@ -14,12 +16,31 @@ from tools.analysis.core import (DEFAULT_BASELINE, DEFAULT_TARGETS,
                                  format_json, run_analysis)
 
 
+def _changed_files() -> list:
+    """Repo-relative .py paths touched vs HEAD (staged, unstaged and
+    untracked) — the pre-commit file set."""
+    out = set()
+    for args in (["git", "diff", "--name-only", "HEAD", "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(args, cwd=REPO_ROOT, capture_output=True,
+                                  text=True, check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"--changed: git failed: {e}", file=sys.stderr)
+            return []
+        out.update(ln.strip() for ln in proc.stdout.splitlines()
+                   if ln.strip().endswith(".py"))
+    return sorted(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.analysis",
-        description="yblint: project-specific AST analysis "
+        description="yblint: project-specific whole-program AST analysis "
                     "(jit trace-safety, lock discipline, reactor "
-                    "blocking, swallowed errors, metric names)")
+                    "blocking, swallowed errors, metric names, donation "
+                    "safety, error propagation, resource lifetime, "
+                    "wire drift)")
     ap.add_argument("targets", nargs="*", default=list(DEFAULT_TARGETS),
                     help="files or directories relative to the repo root "
                          f"(default: {' '.join(DEFAULT_TARGETS)})")
@@ -30,9 +51,22 @@ def main(argv=None) -> int:
                          "baseline.txt)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignoring the baseline")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in files changed vs HEAD "
+                         "(incl. staged/untracked); the whole-program "
+                         "index is still built over the full targets, so "
+                         "cross-file passes stay sound — this is the "
+                         "seconds-fast pre-commit mode")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the baseline (sectioned per pass) "
+                         "from the current findings; REFUSES entries "
+                         "lacking a `  # justification` — append one to "
+                         "each listed fingerprint in the baseline file, "
+                         "then rerun")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept the current findings into the baseline "
-                         "and exit 0")
+                         "unconditionally and exit 0 (bootstrap only; "
+                         "prefer --update-baseline)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated subset of passes to run")
     ap.add_argument("--jobs", type=int, default=None,
@@ -52,10 +86,30 @@ def main(argv=None) -> int:
             print(e.args[0], file=sys.stderr)
             return 2
 
+    report_only = None
+    if args.changed:
+        report_only = _changed_files()
+        if not report_only:
+            print("yblint: no changed python files")
+            return 0
+
     baseline_path = None if args.no_baseline else args.baseline
     result = run_analysis(root=REPO_ROOT, targets=args.targets,
                           passes=passes, baseline_path=baseline_path,
-                          jobs=args.jobs)
+                          jobs=args.jobs, report_only=report_only)
+    if args.update_baseline:
+        bl = Baseline.load(args.baseline)
+        unjustified = bl.update(args.baseline, result.findings)
+        if unjustified:
+            print("refusing to baseline entries without a justification "
+                  "— append `  # <why this is acceptable>` to each in "
+                  f"{args.baseline}:", file=sys.stderr)
+            for fp in unjustified:
+                print(f"  {fp}", file=sys.stderr)
+            return 2
+        print(f"wrote {len(result.findings)} justified fingerprint(s) "
+              f"to {args.baseline}")
+        return 0
     if args.write_baseline:
         bl = Baseline.load(args.baseline)
         bl.save(args.baseline, result.findings)

@@ -143,14 +143,20 @@ class FileContext:
 
 
 class AnalysisPass:
-    """Plugin pass API: subclass, set `name`, implement run(ctx)."""
+    """Plugin pass API: subclass, set `name`, implement run(ctx).
+
+    A whole-program pass sets `needs_index = True` and implements
+    `run(ctx, index)` — the runner then hands it the ProjectIndex built
+    once per run (passes invoked standalone, e.g. from test fixtures,
+    get a single-file index synthesized on the spot)."""
 
     name = "base"
+    needs_index = False
 
     def applies_to(self, relpath: str) -> bool:
         return True
 
-    def run(self, ctx: FileContext) -> List[Finding]:
+    def run(self, ctx: FileContext, index=None) -> List[Finding]:
         raise NotImplementedError
 
 
@@ -162,23 +168,42 @@ def _is_suppressed(ctx: FileContext, f: Finding) -> bool:
     return f.pass_name in names or "all" in names
 
 
-def analyze_file(path: str, relpath: str,
-                 passes: Sequence[AnalysisPass]) -> List[Finding]:
+def _parse_context(path: str, relpath: str):
+    """(ctx, findings): a FileContext, or parse-stage findings."""
     try:
         with open(path, encoding="utf-8") as fh:
             src = fh.read()
-        ctx = FileContext(path, relpath, src)
+        return FileContext(path, relpath, src), []
     except SyntaxError as e:
-        return [Finding(relpath, e.lineno or 0, "parse", "syntax-error",
-                        f"unparseable: {e.msg}")]
+        return None, [Finding(relpath, e.lineno or 0, "parse",
+                              "syntax-error", f"unparseable: {e.msg}")]
     except OSError as e:
-        return [Finding(relpath, 0, "parse", "io-error", str(e))]
+        return None, [Finding(relpath, 0, "parse", "io-error", str(e))]
+
+
+def _run_passes(ctx: FileContext, passes: Sequence[AnalysisPass],
+                index) -> List[Finding]:
     out: List[Finding] = []
     for p in passes:
-        if not p.applies_to(relpath):
+        if not p.applies_to(ctx.relpath):
             continue
-        out.extend(f for f in p.run(ctx) if not _is_suppressed(ctx, f))
+        fs = p.run(ctx, index) if p.needs_index else p.run(ctx)
+        out.extend(f for f in fs if not _is_suppressed(ctx, f))
     return out
+
+
+def analyze_file(path: str, relpath: str,
+                 passes: Sequence[AnalysisPass]) -> List[Finding]:
+    """Standalone single-file entry point (whole-program passes see a
+    one-file index); the batch runner below shares one index instead."""
+    ctx, errs = _parse_context(path, relpath)
+    if ctx is None:
+        return errs
+    index = None
+    if any(p.needs_index for p in passes):
+        from tools.analysis.project_index import ProjectIndex
+        index = ProjectIndex([ctx])
+    return _run_passes(ctx, passes, index)
 
 
 def _collect_files(root: str, targets: Sequence[str]) -> List[Tuple[str, str]]:
@@ -207,23 +232,51 @@ def _collect_files(root: str, targets: Sequence[str]) -> List[Tuple[str, str]]:
 def analyze_paths(root: str = REPO_ROOT,
                   targets: Sequence[str] = DEFAULT_TARGETS,
                   passes: Optional[Sequence[AnalysisPass]] = None,
-                  jobs: Optional[int] = None) -> List[Finding]:
-    """Run the passes over every .py file under the targets, one file per
-    worker (per-file parallelism: contexts are independent)."""
+                  jobs: Optional[int] = None,
+                  report_only: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    """Run the passes over every .py file under the targets.
+
+    Two phases: (1) parse every file into a FileContext (parallel, one
+    parse per file) and build the whole-program ProjectIndex EXACTLY ONCE
+    over all of them; (2) run the passes per file (parallel — contexts
+    are independent, the index is shared read-only).
+
+    report_only: when given (the `--changed` pre-commit path), findings
+    are only emitted for those relpaths — but the index still covers the
+    full target set, so cross-file passes see the whole program."""
     if passes is None:
         from tools.analysis.passes import ALL_PASSES
         passes = ALL_PASSES
     files = _collect_files(root, targets)
     jobs = jobs or min(8, (os.cpu_count() or 2))
     findings: List[Finding] = []
+    ctxs: List[FileContext] = []
     if jobs <= 1 or len(files) <= 1:
-        for path, rel in files:
-            findings.extend(analyze_file(path, rel, passes))
+        parsed = [_parse_context(p, r) for p, r in files]
     else:
         with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
-            for fs in ex.map(lambda a: analyze_file(a[0], a[1], passes),
-                             files):
+            parsed = list(ex.map(lambda a: _parse_context(a[0], a[1]),
+                                 files))
+    for ctx, errs in parsed:
+        findings.extend(errs)
+        if ctx is not None:
+            ctxs.append(ctx)
+    index = None
+    if any(p.needs_index for p in passes):
+        from tools.analysis.project_index import ProjectIndex
+        index = ProjectIndex(ctxs)
+    if jobs <= 1 or len(ctxs) <= 1:
+        for ctx in ctxs:
+            findings.extend(_run_passes(ctx, passes, index))
+    else:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
+            for fs in ex.map(lambda c: _run_passes(c, passes, index),
+                             ctxs):
                 findings.extend(fs)
+    if report_only is not None:
+        keep = set(report_only)
+        findings = [f for f in findings if f.path in keep]
     findings.sort(key=lambda f: (f.path, f.line, f.pass_name, f.code))
     return findings
 
@@ -261,17 +314,38 @@ class Baseline:
         return cls(entries, notes)
 
     def save(self, path: str, findings: Sequence[Finding]) -> None:
-        fps = sorted(f.fingerprint for f in findings)
+        """Rewrite the baseline from `findings`, sectioned per pass so
+        suppressions are auditable pass by pass; notes survive for
+        unchanged fingerprints."""
+        by_pass: Dict[str, List[Finding]] = {}
+        for f in findings:
+            by_pass.setdefault(f.pass_name, []).append(f)
         with open(path, "w", encoding="utf-8") as fh:
             fh.write("# yblint baseline: justified findings, one "
-                     "fingerprint per line.\n"
+                     "fingerprint per line, sectioned per pass.\n"
                      "# Regenerate with `python -m tools.analysis "
-                     "--write-baseline`; append a justification\n"
-                     "# as `  # why this is acceptable` — it survives "
-                     "regeneration for unchanged entries.\n")
-            for fp in fps:
-                note = self.notes.get(fp)
-                fh.write(f"{fp}  # {note}\n" if note else fp + "\n")
+                     "--update-baseline`; every entry must carry a\n"
+                     "# justification as `  # why this is acceptable` — "
+                     "it survives regeneration for unchanged entries.\n")
+            for pass_name in sorted(by_pass):
+                fh.write(f"\n# --- pass: {pass_name} ---\n")
+                for fp in sorted(f.fingerprint for f in by_pass[pass_name]):
+                    note = self.notes.get(fp)
+                    fh.write(f"{fp}  # {note}\n" if note else fp + "\n")
+
+    def update(self, path: str,
+               findings: Sequence[Finding]) -> List[str]:
+        """`--update-baseline`: regenerate from the current findings, but
+        REFUSE to add entries lacking a `#` justification. Returns the
+        unjustified fingerprints — empty means the file was written;
+        non-empty means nothing was touched (add a justification for each
+        listed fingerprint, or fix the finding)."""
+        unjustified = sorted({f.fingerprint for f in findings
+                              if not self.notes.get(f.fingerprint)})
+        if unjustified:
+            return unjustified
+        self.save(path, findings)
+        return []
 
     def split(self, findings: Sequence[Finding]
               ) -> Tuple[List[Finding], List[Finding], List[str]]:
@@ -315,12 +389,19 @@ def run_analysis(root: str = REPO_ROOT,
                  targets: Sequence[str] = DEFAULT_TARGETS,
                  passes: Optional[Sequence[AnalysisPass]] = None,
                  baseline_path: Optional[str] = DEFAULT_BASELINE,
-                 jobs: Optional[int] = None) -> AnalysisResult:
-    findings = analyze_paths(root, targets, passes, jobs)
+                 jobs: Optional[int] = None,
+                 report_only: Optional[Sequence[str]] = None
+                 ) -> AnalysisResult:
+    findings = analyze_paths(root, targets, passes, jobs,
+                             report_only=report_only)
     if baseline_path is None:
         return AnalysisResult(findings, list(findings), [], [])
     bl = Baseline.load(baseline_path)
     new, known, stale = bl.split(findings)
+    if report_only is not None:
+        # a scoped run can't see findings outside the file set, so
+        # baseline entries it didn't match are not evidence of staleness
+        stale = []
     return AnalysisResult(findings, new, known, stale)
 
 

@@ -1,14 +1,20 @@
 """yblint pass registry: one instance of each shipped pass.
 
 A new pass registers by appending an instance here; `python -m
-tools.analysis --passes a,b` selects by name.
+tools.analysis --passes a,b` selects by name. Passes with
+`needs_index = True` receive the whole-program ProjectIndex (built once
+per run) alongside their per-file context.
 """
 
 from tools.analysis.passes.blocking_reactor import BlockingReactorPass
+from tools.analysis.passes.donation_safety import DonationSafetyPass
+from tools.analysis.passes.error_propagation import ErrorPropagationPass
 from tools.analysis.passes.jit_trace_safety import JitTraceSafetyPass
 from tools.analysis.passes.lock_discipline import LockDisciplinePass
 from tools.analysis.passes.metric_names import MetricNamesPass
+from tools.analysis.passes.resource_lifetime import ResourceLifetimePass
 from tools.analysis.passes.swallowed_errors import SwallowedErrorsPass
+from tools.analysis.passes.wire_drift import WireDriftPass
 
 ALL_PASSES = (
     JitTraceSafetyPass(),
@@ -16,6 +22,10 @@ ALL_PASSES = (
     BlockingReactorPass(),
     SwallowedErrorsPass(),
     MetricNamesPass(),
+    DonationSafetyPass(),
+    ErrorPropagationPass(),
+    ResourceLifetimePass(),
+    WireDriftPass(),
 )
 
 

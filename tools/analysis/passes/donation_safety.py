@@ -1,0 +1,398 @@
+"""donation-safety: no reads of a buffer after it was donated to XLA.
+
+PR 3's offload pipeline passes carved chunk matrices (and per-chunk
+uploads) through `donate_argnums` jit programs so XLA reuses their HBM in
+place. Donation invalidates the caller's array: any later read returns
+garbage (or raises on some backends), and re-dispatching the same buffer
+double-frees its HBM. Python makes this silent — the binding still looks
+alive — so the invariant is enforced statically:
+
+- donated callables are found WHOLE-PROGRAM (project index): functions
+  decorated `@partial(jax.jit, donate_argnums=...)` (or
+  `donate_argnames=`), and wrapper assignments
+  `w = functools.partial(jax.jit, donate_argnums=(0,))(f)` /
+  `w = jax.jit(f, donate_argnums=...)` — the same jit roots the
+  trace-safety pass resolves, filtered to the donating ones. A local
+  alias choosing between variants (`fn = donated if d else plain`) is
+  treated as may-donate.
+- one level of helper propagation: a function that forwards its own
+  parameter (or an attribute of it, e.g. `staged.cols_dev`) into a
+  donated position itself donates that parameter — its call sites are
+  checked the same way (`ops/run_merge.launch_merge_gc` is the
+  motivating case).
+- after a donated call, within the enclosing function:
+  - a Load of the exact donated expression        -> use-after-donate
+  - the donated expression passed to another call -> (same; the worst
+    case is a re-dispatch that double-frees the HBM)
+  - the ROOT object escaping whole (stored, returned, passed on) while
+    its donated attribute is still reachable      -> escape-after-donate
+    (a later `handle._staged.cols_dev` read cannot be checked
+    statically, so the escape itself is the hazard)
+  Rebinding the root name (or the attribute) clears the taint; loop
+  bodies are scanned twice so a donation on iteration i is checked
+  against reads early in iteration i+1.
+
+Reads of OTHER attributes of the root (`staged.n`, `staged.run_ns`) stay
+legal — donation consumes the array, not its metadata container.
+Waive with `# yblint: disable=donation-safety`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+from tools.analysis.project_index import ProjectIndex, dotted_name
+
+PASS_NAME = "donation-safety"
+
+
+def _is_jit(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+def _jit_partial(node: ast.AST) -> Optional[ast.Call]:
+    if (isinstance(node, ast.Call)
+            and dotted_name(node.func) in ("functools.partial", "partial")
+            and node.args and _is_jit(node.args[0])):
+        return node
+    return None
+
+
+def _donation_spec(call: ast.Call) -> Tuple[Tuple[int, ...],
+                                            Tuple[str, ...]]:
+    """(donated positions, donated names) from a jit(...) /
+    partial(jax.jit, ...) call's keywords."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                    nums.append(c.value)
+        elif kw.arg == "donate_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    names.append(c.value)
+    return tuple(nums), tuple(names)
+
+
+class _Donated:
+    """One donating callable: positions/names + params for pos->name."""
+
+    __slots__ = ("fq", "positions", "names", "params", "via")
+
+    def __init__(self, fq: str, positions: Tuple[int, ...],
+                 names: Tuple[str, ...], params: Sequence[str],
+                 via: str = ""):
+        self.fq = fq
+        self.positions = positions
+        self.names = names
+        self.params = list(params)
+        self.via = via  # helper propagation: ".attr" suffix on the arg
+
+    def donated_arg_exprs(self, call: ast.Call) -> List[ast.AST]:
+        out = []
+        name_set = set(self.names)
+        for i, p in enumerate(self.positions):
+            if p < len(self.params):
+                name_set.add(self.params[p])
+        for i, a in enumerate(call.args):
+            if i in self.positions:
+                out.append(a)
+        for kw in call.keywords:
+            if kw.arg and kw.arg in name_set:
+                out.append(kw.value)
+        return out
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+def _build_registry(index: ProjectIndex) -> Dict[str, _Donated]:
+    """fq callable name -> donation spec, across every indexed module."""
+    reg: Dict[str, _Donated] = {}
+    for mi in index.modules.values():
+        ctx = mi.ctx
+        for node in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            for dec in node.decorator_list:
+                call = dec if isinstance(dec, ast.Call) else None
+                call = call if call is not None and _is_jit(call.func) \
+                    else _jit_partial(dec)
+                if call is None:
+                    continue
+                nums, names = _donation_spec(call)
+                if nums or names:
+                    fq = mi.modname + "." + ctx.qualname(node)
+                    reg[fq] = _Donated(fq, nums, names, _param_names(node))
+        for asn in ctx.nodes_of(ast.Assign):
+            v = asn.value
+            call = None
+            target_fn = None
+            if isinstance(v, ast.Call) and _is_jit(v.func) and v.args \
+                    and isinstance(v.args[0], ast.Name):
+                call, target_fn = v, v.args[0].id
+            elif isinstance(v, ast.Call) \
+                    and _jit_partial(v.func) is not None and v.args \
+                    and isinstance(v.args[0], ast.Name):
+                call, target_fn = _jit_partial(v.func), v.args[0].id
+            if call is None:
+                continue
+            nums, names = _donation_spec(call)
+            if not (nums or names):
+                continue
+            fi = index.lookup_function(index.resolve(mi, target_fn))
+            params = _param_names(fi.node) if fi is not None else []
+            for t in asn.targets:
+                if isinstance(t, ast.Name):
+                    fq = mi.modname + "." + t.id
+                    reg[fq] = _Donated(fq, nums, names, params)
+    _propagate_helpers(index, reg)
+    return reg
+
+
+def _propagate_helpers(index: ProjectIndex,
+                       reg: Dict[str, _Donated]) -> None:
+    """One level: a function forwarding its own param (or `param.attr`)
+    into a donated position becomes a donating callable itself."""
+    direct = dict(reg)
+    for fi in index.functions.values():
+        if fi.key in direct:
+            continue
+        mi = index.modules[fi.modname]
+        params = _param_names(fi.node)
+        local = _local_donated_names(index, mi, fi.node, direct)
+        donated_params: List[Tuple[int, str]] = []
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            spec = _resolve_donated_callee(index, mi, call.func, local,
+                                           direct)
+            if spec is None:
+                continue
+            for arg in spec.donated_arg_exprs(call):
+                root, suffix = _root_and_suffix(arg)
+                if root in params:
+                    donated_params.append((params.index(root), suffix))
+        if donated_params:
+            pos, suffix = donated_params[0]
+            reg[fi.key] = _Donated(fi.key, (pos,), (), params, via=suffix)
+
+
+def _root_and_suffix(expr: ast.AST) -> Tuple[Optional[str], str]:
+    """`staged.cols_dev` -> ('staged', '.cols_dev'); `x` -> ('x', '')."""
+    d = dotted_name(expr)
+    if not d:
+        return None, ""
+    root, _, rest = d.partition(".")
+    return root, ("." + rest if rest else "")
+
+
+def _local_donated_names(index: ProjectIndex, mi, fn_node: ast.AST,
+                         reg: Dict[str, _Donated]) -> Dict[str, _Donated]:
+    """Local aliases of donated callables inside one function, including
+    the may-donate conditional pick `fn = donated if c else plain`."""
+    out: Dict[str, _Donated] = {}
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        v = node.value
+        cands = [v.body, v.orelse] if isinstance(v, ast.IfExp) else [v]
+        for c in cands:
+            fq = index.resolve(mi, dotted_name(c))
+            if fq in reg:
+                out[node.targets[0].id] = reg[fq]
+                break
+    return out
+
+
+def _resolve_donated_callee(index: ProjectIndex, mi, func: ast.AST,
+                            local: Dict[str, _Donated],
+                            reg: Dict[str, _Donated]
+                            ) -> Optional[_Donated]:
+    if isinstance(func, ast.Name) and func.id in local:
+        return local[func.id]
+    fq = index.resolve(mi, dotted_name(func))
+    return reg.get(fq) if fq else None
+
+
+class DonationSafetyPass(AnalysisPass):
+    name = PASS_NAME
+    needs_index = True
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.endswith(".py")
+
+    def run(self, ctx: FileContext, index: Optional[ProjectIndex] = None
+            ) -> List[Finding]:
+        if index is None:
+            index = ProjectIndex([ctx])
+        mi = index.by_relpath.get(ctx.relpath)
+        if mi is None:
+            return []
+        reg: Dict[str, _Donated] = index.memo(
+            "donation.registry", lambda: _build_registry(index))
+        if not reg:
+            return []
+        findings: List[Finding] = []
+        for node in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            local = _local_donated_names(index, mi, node, reg)
+            findings.extend(self._scan_function(ctx, index, mi, node,
+                                                local, reg))
+        return findings
+
+    # ------------------------------------------------------------- scanning
+    def _scan_function(self, ctx: FileContext, index: ProjectIndex, mi,
+                       fn: ast.AST, local: Dict[str, _Donated],
+                       reg: Dict[str, _Donated]) -> List[Finding]:
+        findings: List[Finding] = []
+        # consumed: dotted expr -> (callable fq, call lineno)
+        self._scan_block(ctx, index, mi, fn.body, {}, local, reg, findings)
+        return findings
+
+    def _scan_block(self, ctx, index, mi, stmts, consumed, local, reg,
+                    findings) -> Dict[str, Tuple[str, int]]:
+        for stmt in stmts:
+            consumed = self._scan_stmt(ctx, index, mi, stmt, consumed,
+                                       local, reg, findings)
+        return consumed
+
+    def _scan_stmt(self, ctx, index, mi, stmt, consumed, local, reg,
+                   findings) -> Dict[str, Tuple[str, int]]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return consumed  # nested defs: separate dynamic extent
+        if isinstance(stmt, (ast.If,)):
+            self._check_expr_uses(ctx, stmt.test, consumed, findings)
+            a = self._scan_block(ctx, index, mi, stmt.body, dict(consumed),
+                                 local, reg, findings)
+            b = self._scan_block(ctx, index, mi, stmt.orelse,
+                                 dict(consumed), local, reg, findings)
+            # optimistic merge: a branch that rebinds/poisons the root
+            # clears the taint (the no-FP bias: a donation guarded by
+            # `if use_donate:` is legitimately undone by a poison guarded
+            # the same way). New donations still merge in from either.
+            out = {}
+            for k in set(a) | set(b):
+                if k in a and k in b:
+                    out[k] = a[k]
+                elif k not in consumed:
+                    out[k] = a.get(k, b.get(k))
+            return out
+        if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+            # two passes over the body: catch iteration-crossing reads
+            once = self._scan_block(ctx, index, mi, stmt.body,
+                                    dict(consumed), local, reg, findings)
+            self._scan_block(ctx, index, mi, stmt.body, dict(once),
+                             local, reg, findings)
+            self._scan_block(ctx, index, mi, stmt.orelse, dict(once),
+                             local, reg, findings)
+            out = dict(consumed)
+            out.update(once)
+            return out
+        if isinstance(stmt, (ast.Try,)):
+            out = self._scan_block(ctx, index, mi, stmt.body,
+                                   dict(consumed), local, reg, findings)
+            for h in stmt.handlers:
+                self._scan_block(ctx, index, mi, h.body, dict(out),
+                                 local, reg, findings)
+            out = self._scan_block(ctx, index, mi, stmt.orelse, out,
+                                   local, reg, findings)
+            return self._scan_block(ctx, index, mi, stmt.finalbody, out,
+                                    local, reg, findings)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr_uses(ctx, item.context_expr, consumed,
+                                      findings)
+            return self._scan_block(ctx, index, mi, stmt.body, consumed,
+                                    local, reg, findings)
+
+        # --- flat statement: check uses, then record donations/rebinds ----
+        rebound: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            rebound = {t.id for t in stmt.targets
+                       if isinstance(t, ast.Name)}
+        # `x = replace(x, donated_field=...)` is consume-and-replace, not
+        # an escape: the rebind below clears the taint in the same step
+        self._check_stmt_uses(ctx, stmt, consumed, findings,
+                              exempt_roots=rebound)
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            spec = _resolve_donated_callee(index, mi, call.func, local, reg)
+            if spec is None:
+                continue
+            for arg in spec.donated_arg_exprs(call):
+                d = dotted_name(arg)
+                if d:
+                    consumed = dict(consumed)
+                    consumed[d + spec.via] = (spec.fq, call.lineno)
+        # rebinding the root (or the exact expr) clears the taint
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            for leaf in ast.walk(t):
+                d = dotted_name(leaf)
+                if not d:
+                    continue
+                for expr in [k for k in consumed
+                             if k == d or k.startswith(d + ".")]:
+                    consumed = dict(consumed)
+                    del consumed[expr]
+        return consumed
+
+    # ------------------------------------------------------------ use check
+    def _check_stmt_uses(self, ctx, stmt, consumed, findings,
+                         exempt_roots: Set[str] = frozenset()) -> None:
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._check_expr_uses(ctx, child, consumed, findings,
+                                      exempt_roots)
+
+    def _check_expr_uses(self, ctx, expr, consumed, findings,
+                         exempt_roots: Set[str] = frozenset()) -> None:
+        if not consumed:
+            return
+        roots = {k.split(".")[0]: k for k in consumed}
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in roots):
+                continue
+            full = roots[node.id]
+            fq, lineno = consumed[full]
+            parent = ctx.parent(node)
+            # climb the attribute chain this Name anchors
+            chain = node
+            while isinstance(parent, ast.Attribute) \
+                    and parent.value is chain:
+                chain = parent
+                parent = ctx.parent(chain)
+            d = dotted_name(chain)
+            if d == full or d.startswith(full + ".") \
+                    or full.startswith(d + "."):
+                if d == full or d.startswith(full + "."):
+                    findings.append(ctx.finding(
+                        self.name, "use-after-donate", chain,
+                        f"{full!r} was donated to {fq.rpartition('.')[2]} "
+                        f"(line {lineno}) — XLA reuses its buffer; this "
+                        "read returns garbage (or re-dispatch double-"
+                        "frees the HBM)"))
+                elif isinstance(chain, ast.Name) \
+                        and node.id not in exempt_roots:
+                    # bare root escaping whole while .attr is donated
+                    findings.append(ctx.finding(
+                        self.name, "escape-after-donate", chain,
+                        f"{node.id!r} escapes after its {full!r} was "
+                        f"donated to {fq.rpartition('.')[2]} (line "
+                        f"{lineno}) — a later read of the donated buffer "
+                        "through this alias cannot be checked; rebind or "
+                        "poison the donated field first"))

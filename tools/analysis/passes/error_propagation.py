@@ -1,0 +1,135 @@
+"""error-propagation: except handlers on durability-critical paths must
+route the error, re-raise, or carry an explicit containment marker.
+
+The PR 1 containment contract: a background I/O failure on a flush,
+compaction or WAL path must surface — to the DB background-error slot
+(storage/db.py), the WAL seal (consensus/log.py `_fail`), a tablet
+FAILED transition, or at minimum a raise that the maintenance machinery
+sees. The swallowed-errors pass catches the blatant form (broad except,
+body discards); this pass is the strict, whole-program form: ANY
+`except` handler — broad or narrow — lexically inside a function
+reachable from a flush/compaction/WAL seed must
+
+  - re-raise (any `raise` in the handler), or
+  - route the error (TRACE(...) / background_error / mark_failed /
+    `_fail` / set_background_error — the swallowed-errors routing set),
+    or
+  - carry `# yblint: contained(<reason>)` on the except line, declaring
+    the degradation deliberate and explaining why it is safe.
+
+Seeds (whole-program call graph, so a helper three modules away is still
+on the path):
+  - every function whose name contains `flush` or `compact`;
+  - every function of the WAL module (consensus/log.py);
+  - any function marked `# yblint: durability-path` on its def line.
+Reachability includes weak callback edges (`Thread(target=f)`), so the
+pipeline's ingest/decode worker closures are covered.
+
+Findings are reported only for files under storage/, consensus/ and
+tablet/ — the layers whose silent degradation loses data. `__del__`
+bodies are exempt (teardown is unroutable).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+from tools.analysis.project_index import ProjectIndex
+
+PASS_NAME = "error-propagation"
+
+DEFAULT_DIRS = ("yugabyte_tpu/storage", "yugabyte_tpu/consensus",
+                "yugabyte_tpu/tablet")
+_SEED_NAME_RE = re.compile(r"flush|compact", re.IGNORECASE)
+_WAL_MODULE_SUFFIX = ".consensus.log"
+_MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
+_DEF_MARKER = "# yblint: durability-path"
+_ROUTING_NAMES = ("TRACE", "trace")
+_ROUTING_ATTRS = ("background_error", "set_background_error",
+                  "mark_failed", "_fail")
+
+
+def _routes_error(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name in _ROUTING_NAMES or any(a in name
+                                             for a in _ROUTING_ATTRS):
+                return True
+    return False
+
+
+def _seeds(index: ProjectIndex) -> Set[str]:
+    out: Set[str] = set()
+    for fi in index.functions.values():
+        if _SEED_NAME_RE.search(fi.node.name):
+            out.add(fi.key)
+        elif fi.modname.endswith(_WAL_MODULE_SUFFIX):
+            out.add(fi.key)
+        else:
+            mi = index.modules.get(fi.modname)
+            if mi is not None and _DEF_MARKER in \
+                    mi.ctx.line_text(fi.node.lineno):
+                out.add(fi.key)
+    return out
+
+
+class ErrorPropagationPass(AnalysisPass):
+    name = PASS_NAME
+    needs_index = True
+
+    def __init__(self, dirs=DEFAULT_DIRS):
+        self.dirs = tuple(d.rstrip("/") + "/" for d in dirs)
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.dirs)
+
+    def run(self, ctx: FileContext, index: Optional[ProjectIndex] = None
+            ) -> List[Finding]:
+        if index is None:
+            index = ProjectIndex([ctx])
+        reachable: Set[str] = index.memo(
+            "error_propagation.reachable",
+            lambda: index.reachable(sorted(_seeds(index))))
+        if not reachable:
+            return []
+        out: List[Finding] = []
+        for node in ctx.nodes_of(ast.ExceptHandler):
+            fn = ctx.enclosing_function(node)
+            if fn is None or fn.name == "__del__":
+                continue
+            if not self._on_critical_path(ctx, index, fn, reachable):
+                continue
+            if _routes_error(node):
+                continue
+            if _MARKER_RE.search(ctx.line_text(node.lineno)):
+                continue
+            if "lint: swallow-ok" in ctx.line_text(node.lineno):
+                continue  # legacy waiver (swallowed-errors era)
+            out.append(ctx.finding(
+                self.name, "unrouted-except", node,
+                f"except on a durability path ({fn.name}) neither "
+                "re-raises nor routes the error — raise, route to the "
+                "background-error slot / TRACE, or mark the line "
+                "`# yblint: contained(<why this is safe>)`"))
+        return out
+
+    def _on_critical_path(self, ctx: FileContext, index: ProjectIndex,
+                          fn: ast.AST, reachable: Set[str]) -> bool:
+        """The handler's function — or any enclosing function (a nested
+        worker closure runs in its parent's dynamic extent) — is
+        reachable from a seed."""
+        cur: Optional[ast.AST] = fn
+        while cur is not None:
+            key = index.key_of(cur)
+            if key is not None and key in reachable:
+                return True
+            cur = ctx.enclosing_function(cur)
+        return False

@@ -1,0 +1,273 @@
+"""resource-lifetime: acquire/release pairing must be exception-safe.
+
+Three resource families, all hot-path and all leak-prone under the PR 3
+threading model (exceptions unwind through pipeline stages on worker
+threads, so "the happy path releases it" is not a lifetime story):
+
+- HostStagingPool leases: `arr = pool.acquire(shape)` must reach
+  `pool.release(arr)` on every path that never handed the buffer to a
+  retaining H2D copy — at minimum, an exception between acquire and the
+  upload must release (or the pool's warm pinned pages degrade to
+  one-shot allocations);
+- file/SST handles: `f = get_env().open_append(...)` / `open(...)`
+  bound to a local must be closed via `with`, or `close()` from a
+  `finally` — an exception path that drops the handle leaks the fd and,
+  through FaultInjectionEnv, keeps a torn file undetected;
+- tracked locks: a raw `lock.acquire()` statement (outside `with`) must
+  be followed by a try/finally whose finalbody releases it.
+
+Rules (lexical, per function):
+- binding escapes (stored to an attribute/subscript, returned, yielded,
+  or — for handles — passed as an argument to another call): ownership
+  transferred, not checked here;
+- `with ...` acquisition is safe by construction;
+- otherwise: no release at all               -> `unreleased`
+             release exists, but no release sits in a `finally` (and
+             there is no except-path release mirroring the normal-path
+             one)                            -> `leak-on-exception`
+- raw lock acquire without try/finally       -> `raw-lock-acquire`
+
+Receiver recognition is name-based (contains 'pool'/'staging' for
+leases, 'lock'/'mutex'/'_mu' for locks) plus index-typed locals whose
+class resolves to HostStagingPool. Waive deliberate transfers with
+`# yblint: disable=resource-lifetime`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+from tools.analysis.project_index import ProjectIndex, dotted_name
+
+PASS_NAME = "resource-lifetime"
+
+DEFAULT_DIRS = ("yugabyte_tpu",)
+_POOL_HINTS = ("pool", "staging")
+_LOCK_HINTS = ("lock", "mutex", "_mu")
+_OPEN_METHODS = ("open_append", "open_random", "open_write",
+                 "open_sequential")
+_POOL_CLASS_SUFFIX = ".HostStagingPool"
+
+
+def _receiver_leaf(func: ast.AST) -> str:
+    """'pool' from pool.acquire / self._pool.acquire; '' otherwise."""
+    if not isinstance(func, ast.Attribute):
+        return ""
+    base = func.value
+    if isinstance(base, ast.Name):
+        return base.id.lower()
+    if isinstance(base, ast.Attribute):
+        return base.attr.lower()
+    return ""
+
+
+class _Acquisition:
+    __slots__ = ("binding", "kind", "node", "recv")
+
+    def __init__(self, binding: str, kind: str, node: ast.AST, recv: str):
+        self.binding = binding   # local name holding the resource
+        self.kind = kind         # "lease" | "file"
+        self.node = node
+        self.recv = recv         # receiver dotted expr ('' for open())
+
+
+class ResourceLifetimePass(AnalysisPass):
+    name = PASS_NAME
+    needs_index = True
+
+    def __init__(self, dirs=DEFAULT_DIRS):
+        self.dirs = tuple(d.rstrip("/") + "/" for d in dirs)
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.dirs)
+
+    def run(self, ctx: FileContext, index: Optional[ProjectIndex] = None
+            ) -> List[Finding]:
+        if index is None:
+            index = ProjectIndex([ctx])
+        out: List[Finding] = []
+        for fn in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            out.extend(self._check_function(ctx, index, fn))
+        return out
+
+    # ---------------------------------------------------------- collection
+    def _is_pool_typed(self, ctx, index: ProjectIndex, fn: ast.AST,
+                       recv_root: str) -> bool:
+        key = index.key_of(fn)
+        fi = index.lookup_function(key)
+        if fi is None:
+            return False
+        t = index.local_types(fi).get(recv_root, "")
+        return t.endswith(_POOL_CLASS_SUFFIX)
+
+    def _classify_value(self, ctx, index, fn,
+                        value: ast.AST) -> Optional[Tuple[str, str]]:
+        """(kind, recv) when `value` acquires a tracked resource."""
+        if not isinstance(value, ast.Call):
+            return None
+        f = value.func
+        d = dotted_name(f)
+        if d in ("open", "io.open"):
+            return ("file", "")
+        if isinstance(f, ast.Attribute) and f.attr in _OPEN_METHODS:
+            return ("file", dotted_name(f.value))
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            recv = _receiver_leaf(f)
+            root = dotted_name(f.value).split(".")[0]
+            if any(h in recv for h in _POOL_HINTS) \
+                    or self._is_pool_typed(ctx, index, fn, root):
+                return ("lease", dotted_name(f.value))
+        return None
+
+    def _direct_nodes(self, fn: ast.AST) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    # -------------------------------------------------------------- checks
+    def _check_function(self, ctx, index, fn) -> List[Finding]:
+        nodes = self._direct_nodes(fn)
+        findings: List[Finding] = []
+        acquisitions: List[_Acquisition] = []
+        for n in nodes:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                cls = self._classify_value(ctx, index, fn, n.value)
+                if cls is not None:
+                    acquisitions.append(_Acquisition(
+                        n.targets[0].id, cls[0], n, cls[1]))
+            elif isinstance(n, ast.Expr) and isinstance(n.value, ast.Call):
+                findings.extend(self._check_raw_lock(ctx, fn, n.value))
+        for acq in acquisitions:
+            f = self._check_acquisition(ctx, fn, nodes, acq)
+            if f is not None:
+                findings.append(f)
+        return findings
+
+    def _check_raw_lock(self, ctx, fn, call: ast.Call) -> List[Finding]:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "acquire"
+                and not call.args and not call.keywords):
+            return []
+        recv = _receiver_leaf(f)
+        if not any(h in recv for h in _LOCK_HINTS):
+            return []
+        # exception-safe iff some enclosing-or-following Try has a
+        # matching .release() in its finalbody
+        recv_d = dotted_name(f.value)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Try):
+                for fin in n.finalbody:
+                    for c in ast.walk(fin):
+                        if isinstance(c, ast.Call) \
+                                and isinstance(c.func, ast.Attribute) \
+                                and c.func.attr == "release" \
+                                and dotted_name(c.func.value) == recv_d:
+                            return []
+        return [ctx.finding(
+            self.name, "raw-lock-acquire", call,
+            f"raw {recv_d}.acquire() without a try/finally release — "
+            f"use `with {recv_d}:` (exception-safe, and what the "
+            "lock-rank tracker instruments)")]
+
+    def _check_acquisition(self, ctx, fn, nodes,
+                           acq: _Acquisition) -> Optional[Finding]:
+        releases: List[ast.AST] = []
+        escaped = False
+        for n in nodes:
+            if getattr(n, "lineno", 0) < acq.node.lineno:
+                continue
+            if self._is_release(n, acq):
+                releases.append(n)
+                continue
+            if self._escapes(ctx, n, acq):
+                escaped = True
+                break
+        if escaped:
+            return None
+        if not releases:
+            return ctx.finding(
+                self.name, "unreleased", acq.node,
+                f"{acq.binding!r} ({acq.kind}) acquired but never "
+                f"released/closed in {fn.name} and never handed off — "
+                "leaks on every path")
+        in_finally = any(self._inside_finally(ctx, r, fn)
+                         for r in releases)
+        in_except = any(self._inside_except(ctx, r, fn)
+                        for r in releases)
+        on_normal = any(not self._inside_except(ctx, r, fn)
+                        for r in releases)
+        if in_finally or (in_except and on_normal):
+            return None
+        return ctx.finding(
+            self.name, "leak-on-exception", acq.node,
+            f"{acq.binding!r} ({acq.kind}) release is not exception-"
+            f"safe in {fn.name}: put it in a `finally` (or mirror it on "
+            "the except path) so an unwind between acquire and release "
+            "cannot leak it")
+
+    def _is_release(self, n: ast.AST, acq: _Acquisition) -> bool:
+        for c in ast.walk(n):
+            if not isinstance(c, ast.Call) \
+                    or not isinstance(c.func, ast.Attribute):
+                continue
+            if acq.kind == "lease" and c.func.attr == "release" \
+                    and c.args and isinstance(c.args[0], ast.Name) \
+                    and c.args[0].id == acq.binding:
+                return True
+            if acq.kind == "file" and c.func.attr == "close" \
+                    and isinstance(c.func.value, ast.Name) \
+                    and c.func.value.id == acq.binding:
+                return True
+        return False
+
+    def _escapes(self, ctx, n: ast.AST, acq: _Acquisition) -> bool:
+        for c in ast.walk(n):
+            if not (isinstance(c, ast.Name) and c.id == acq.binding
+                    and isinstance(c.ctx, ast.Load)):
+                continue
+            parent = ctx.parent(c)
+            # returned / yielded (possibly inside a tuple)
+            anc = parent
+            while isinstance(anc, (ast.Tuple, ast.List)):
+                anc = ctx.parent(anc)
+            if isinstance(anc, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return True
+            # stored through an attribute or container
+            if isinstance(anc, ast.Assign) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in anc.targets):
+                return True
+            # handles passed as an argument transfer ownership
+            if acq.kind == "file" and isinstance(parent, ast.Call) \
+                    and c in parent.args:
+                return True
+        return False
+
+    def _inside_finally(self, ctx, node: ast.AST, fn: ast.AST) -> bool:
+        for a in ctx.ancestors(node):
+            if a is fn:
+                return False
+            if isinstance(a, ast.Try):
+                for fin in a.finalbody:
+                    if node is fin or any(node is d
+                                          for d in ast.walk(fin)):
+                        return True
+        return False
+
+    def _inside_except(self, ctx, node: ast.AST, fn: ast.AST) -> bool:
+        for a in ctx.ancestors(node):
+            if a is fn:
+                return False
+            if isinstance(a, ast.ExceptHandler):
+                return True
+        return False

@@ -1,0 +1,618 @@
+"""wire-drift: client-side RPC calls must agree with the server-side
+handler — and to_wire/from_wire codec pairs must agree with each other.
+
+The RPC layer is stringly-typed on purpose (dict args over the codec's
+closed type set, handler methods invoked `method(**args)`), which means a
+renamed request field, a dropped response key, or a wire-dict field
+written on one side and never read on the other (the `trace_ctx` class
+of bug) survives until the one integration test that exercises that
+exact path — or until production. This pass closes the loop statically,
+whole-program:
+
+- services: every `register_service(<name>, <handler>)` is resolved —
+  the name through string constants (cross-module via import aliases),
+  the handler through the index's class-attribute types
+  (`self.service = TabletServiceImpl(...)`) or a direct constructor —
+  giving service name -> handler class (methods incl. base classes).
+- client sites: `<anything>.call(addr, SVC, "method", k=v, ...)` where
+  SVC resolves to a registered service; plus dispatch WRAPPERS,
+  discovered to a fixpoint: any function that forwards one of its own
+  parameters into the method slot of a known dispatcher is itself a
+  dispatcher (this resolves `_master_call` -> `_master_call_traced` ->
+  `messenger.call`, including the `**args` kwargs relay). Wrapper call
+  sites with a literal method name are checked like direct ones.
+- request checks: a kwarg the handler does not accept ->
+  `unknown-request-field`; a required handler parameter the client
+  never sends (and no `**` expansion in sight) ->
+  `missing-request-field`; a method the handler class lacks ->
+  `unknown-method`. `timeout_s` and `_underscore` control kwargs belong
+  to the transport, not the wire.
+- response checks: when the call result is bound to a single local and
+  EVERY return of the handler is a literal dict, client subscripts /
+  `.get()`s of keys outside the union of returned keys ->
+  `drifted-response-field`.
+- codec pairs: same-module `X_to_wire` / `X_from_wire` functions —
+  a key the writer emits but the reader never touches ->
+  `wire-field-never-read`; a key the reader requires (subscript, not
+  `.get`) but the writer never emits -> `wire-field-never-written`.
+- declared piggyback pairs: hand-rolled wire structures that ride
+  INSIDE a request/response field (the heartbeat tablet report, the
+  replication poller specs) drift below kwarg granularity, so their
+  producer and consumers declare themselves:
+
+      def generate_report(self):   # yblint: wire-pair(tablet_report, writes)
+      def process_heartbeat(...):  # yblint: wire-pair(tablet_report, reads)
+
+  The pass then unions the writer side's literal dict keys against
+  every reader's key reads (cross-module) and flags keys written but
+  never read anywhere -> `wire-field-never-read`. (Only that direction:
+  readers also touch unrelated dicts, so the reverse would guess.)
+
+Waive with `# yblint: disable=wire-drift`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+from tools.analysis.project_index import (ClassInfo, FuncInfo,
+                                          ProjectIndex, dotted_name)
+
+PASS_NAME = "wire-drift"
+
+_CONTROL_KWARGS = {"timeout_s"}
+_WIRE_PAIR_RE = re.compile(
+    r"#\s*yblint:\s*wire-pair\((\w+),\s*(writes|reads)\)")
+
+
+class _Dispatcher:
+    """A callable that sends an RPC: the ground `*.call(addr, svc, mth,
+    **kw)` form, or a wrapper forwarding into one."""
+
+    __slots__ = ("service", "method_param", "star_param", "fixed_kwargs",
+                 "params", "defaults")
+
+    def __init__(self, service: str, method_param: str,
+                 star_param: Optional[str], fixed_kwargs: Set[str],
+                 params: Sequence[str], defaults: int):
+        self.service = service
+        self.method_param = method_param
+        self.star_param = star_param    # param **-expanded into the wire
+        self.fixed_kwargs = fixed_kwargs
+        self.params = list(params)      # excluding self
+        self.defaults = defaults        # count of defaulted tail params
+
+
+class _Services:
+    def __init__(self) -> None:
+        self.handlers: Dict[str, ClassInfo] = {}
+        self.dispatchers: Dict[str, _Dispatcher] = {}  # func key -> spec
+
+
+def _handler_params(fi: FuncInfo) -> Tuple[Set[str], Set[str], bool]:
+    """(accepted, required, has_kwargs) of a handler method."""
+    a = fi.node.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    accepted = set(names) | {p.arg for p in a.kwonlyargs}
+    n_def = len(a.defaults)
+    required = set(names[: len(names) - n_def] if n_def else names)
+    required |= {p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                 if d is None}
+    return accepted, required, a.kwarg is not None
+
+
+def _params_wo_self(fn: ast.AST) -> Tuple[List[str], int]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names, len(a.defaults)
+
+
+def _bind(params: List[str], n_defaults: int,
+          call: ast.Call, skip_first: int = 0
+          ) -> Dict[str, ast.AST]:
+    """Map a call's args onto `params` (bound-method style: the call's
+    receiver is implicit). Unmatchable calls return what did match."""
+    out: Dict[str, ast.AST] = {}
+    for i, a in enumerate(call.args[skip_first:]):
+        if i < len(params):
+            out[params[i]] = a
+    for kw in call.keywords:
+        if kw.arg and kw.arg in params:
+            out[kw.arg] = kw.value
+    return out
+
+
+def _build_services(index: ProjectIndex) -> _Services:
+    sv = _Services()
+    # ---- pass 1: register_service(name, handler) ----------------------
+    for mi in index.modules.values():
+        for call in mi.ctx.nodes_of(ast.Call):
+            f = call.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr == "register_service"
+                    and len(call.args) >= 2):
+                continue
+            name = index.resolve_str_const(mi, call.args[0])
+            if not name:
+                continue
+            ci = _handler_class(index, mi, call.args[1], call)
+            if ci is not None:
+                sv.handlers[name] = ci
+    # ---- pass 2: ground dispatchers + wrapper fixpoint -----------------
+    for fi in index.functions.values():
+        mi = index.modules[fi.modname]
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "call"
+                    and len(call.args) >= 2):
+                continue
+            svc = index.resolve_str_const(mi, call.args[1])
+            if svc not in sv.handlers:
+                continue
+            mth = call.args[2] if len(call.args) >= 3 else None
+            if isinstance(mth, ast.Name):
+                params, n_def = _params_wo_self(fi.node)
+                if mth.id in params:
+                    star = next((dotted_name(kw.value)
+                                 for kw in call.keywords if kw.arg is None
+                                 and isinstance(kw.value, ast.Name)), None)
+                    fixed = {kw.arg for kw in call.keywords
+                             if kw.arg and kw.arg not in _CONTROL_KWARGS}
+                    sv.dispatchers[fi.key] = _Dispatcher(
+                        svc, mth.id, star, fixed, params, n_def)
+    for _ in range(4):  # wrapper-of-wrapper fixpoint (chains are short)
+        changed = False
+        for fi in index.functions.values():
+            if fi.key in sv.dispatchers:
+                continue
+            mi = index.modules[fi.modname]
+            spec = _wrapper_spec(index, mi, fi, sv)
+            if spec is not None:
+                sv.dispatchers[fi.key] = spec
+                changed = True
+        if not changed:
+            break
+    return sv
+
+
+def _handler_class(index: ProjectIndex, mi, expr: ast.AST,
+                   call: ast.Call) -> Optional[ClassInfo]:
+    # direct constructor: register_service(NAME, Handler(...))
+    if isinstance(expr, ast.Call):
+        return index.lookup_class(index.resolve(mi,
+                                                dotted_name(expr.func)))
+    d = dotted_name(expr)
+    if d.startswith("self."):
+        for a in mi.ctx.ancestors(call):
+            if isinstance(a, ast.ClassDef):
+                ci = index.lookup_class(mi.modname + "." + a.name)
+                if ci is not None:
+                    t = ci.attr_types.get(d.split(".", 1)[1])
+                    return index.lookup_class(t)
+        return None
+    # plain local: svc = Handler(...); register_service(NAME, svc)
+    fn = mi.ctx.enclosing_function(call)
+    if fn is not None and isinstance(expr, ast.Name):
+        fi = index.lookup_function(index.key_of(fn))
+        if fi is not None:
+            return index.lookup_class(index.local_types(fi).get(expr.id))
+    return None
+
+
+def _wrapper_spec(index: ProjectIndex, mi, fi: FuncInfo,
+                  sv: _Services) -> Optional[_Dispatcher]:
+    params, n_def = _params_wo_self(fi.node)
+    star_name = fi.node.args.kwarg.arg if fi.node.args.kwarg else None
+    for call in ast.walk(fi.node):
+        if not isinstance(call, ast.Call):
+            continue
+        inner = _dispatcher_of_call(index, mi, fi, call, sv)
+        if inner is None:
+            continue
+        bound = _bind(inner.params, inner.defaults, call)
+        mval = bound.get(inner.method_param)
+        if not (isinstance(mval, ast.Name) and mval.id in params):
+            continue
+        # does our **kwargs (or a dict param) reach the wire?
+        star: Optional[str] = None
+        if inner.star_param is not None:
+            sval = bound.get(inner.star_param)
+            if isinstance(sval, ast.Name):
+                star = sval.id
+        for kw in call.keywords:
+            if kw.arg is None and isinstance(kw.value, ast.Name):
+                star = kw.value.id
+        fixed = set(inner.fixed_kwargs)
+        if inner.star_param is not None:
+            # keywords that are NOT inner params land in its **kwargs
+            # relay and therefore reach the wire as request fields
+            fixed |= {kw.arg for kw in call.keywords
+                      if kw.arg and kw.arg not in inner.params}
+        star_ok = star if (star == star_name or star in params) else None
+        return _Dispatcher(inner.service, mval.id, star_ok,
+                           {k for k in fixed if k not in _CONTROL_KWARGS},
+                           params, n_def)
+    return None
+
+
+def _dispatcher_of_call(index: ProjectIndex, mi, fi: FuncInfo,
+                        call: ast.Call,
+                        sv: _Services) -> Optional[_Dispatcher]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        key = index.resolve(mi, f.id)
+        return sv.dispatchers.get(key) if key else None
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Name) and recv.id in ("self", "cls") \
+            and fi.cls is not None:
+        target = index.find_method(fi.cls, f.attr)
+        if target is not None:
+            return sv.dispatchers.get(target.key)
+    # self.<attr>.<wrapper>() through the attr's inferred type
+    if isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name) \
+            and recv.value.id == "self" and fi.cls is not None:
+        t = fi.cls.attr_types.get(recv.attr)
+        target = index.find_method(index.lookup_class(t), f.attr) \
+            if t else None
+        if target is not None:
+            return sv.dispatchers.get(target.key)
+    return None
+
+
+class WireDriftPass(AnalysisPass):
+    name = PASS_NAME
+    needs_index = True
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.endswith(".py")
+
+    def run(self, ctx: FileContext, index: Optional[ProjectIndex] = None
+            ) -> List[Finding]:
+        if index is None:
+            index = ProjectIndex([ctx])
+        mi = index.by_relpath.get(ctx.relpath)
+        if mi is None:
+            return []
+        sv: _Services = index.memo("wire.services",
+                                   lambda: _build_services(index))
+        out: List[Finding] = []
+        if sv.handlers:
+            for fn in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+                out.extend(self._check_function(ctx, index, mi, fn, sv))
+        out.extend(self._check_codec_pairs(ctx, mi))
+        out.extend(self._check_declared_pairs(ctx, index))
+        return out
+
+    # ------------------------------------------------------- client sites
+    def _site(self, index, mi, fi: Optional[FuncInfo], call: ast.Call,
+              sv: _Services
+              ) -> Optional[Tuple[str, str, Set[str], bool]]:
+        """(service, method, fields, open) for a checkable client call."""
+        f = call.func
+        # direct `<x>.call(addr, SVC, "mth", ...)`
+        if isinstance(f, ast.Attribute) and f.attr == "call" \
+                and len(call.args) >= 3:
+            svc = index.resolve_str_const(mi, call.args[1])
+            mth = call.args[2]
+            if svc in sv.handlers and isinstance(mth, ast.Constant) \
+                    and isinstance(mth.value, str):
+                fields = {kw.arg for kw in call.keywords
+                          if kw.arg and kw.arg not in _CONTROL_KWARGS
+                          and not kw.arg.startswith("_")}
+                is_open = any(kw.arg is None for kw in call.keywords)
+                return svc, mth.value, fields, is_open
+            return None
+        # wrapper call with a literal method
+        disp = None
+        if fi is not None:
+            disp = _dispatcher_of_call(index, mi, fi, call, sv)
+        if disp is None and isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name):
+            # obj.wrapper(...) through a typed local/param
+            if fi is not None:
+                t = index.local_types(fi).get(f.value.id)
+                target = index.find_method(index.lookup_class(t), f.attr) \
+                    if t else None
+                if target is not None:
+                    disp = sv.dispatchers.get(target.key)
+        if disp is None:
+            return None
+        bound = _bind(disp.params, disp.defaults, call)
+        mval = bound.get(disp.method_param)
+        if not (isinstance(mval, ast.Constant)
+                and isinstance(mval.value, str)):
+            return None
+        fields = set(disp.fixed_kwargs)
+        fields |= {kw.arg for kw in call.keywords
+                   if kw.arg and kw.arg not in disp.params
+                   and kw.arg not in _CONTROL_KWARGS
+                   and not kw.arg.startswith("_")}
+        is_open = any(kw.arg is None for kw in call.keywords)
+        return disp.service, mval.value, fields, is_open
+
+    def _check_function(self, ctx, index, mi, fn, sv) -> List[Finding]:
+        fi = index.lookup_function(index.key_of(fn))
+        out: List[Finding] = []
+        bind_counts: Dict[str, int] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        bind_counts[t.id] = bind_counts.get(t.id, 0) + 1
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            site = self._site(index, mi, fi, node, sv)
+            if site is None:
+                continue
+            svc, mth, fields, is_open = site
+            handler = sv.handlers[svc]
+            method = index.find_method(handler, mth)
+            if method is None or mth.startswith("_"):
+                out.append(ctx.finding(
+                    self.name, "unknown-method", node,
+                    f"client calls {svc}.{mth} but handler "
+                    f"{handler.name} has no such method"))
+                continue
+            accepted, required, has_kwargs = _handler_params(method)
+            if not has_kwargs:
+                for extra in sorted(fields - accepted):
+                    out.append(ctx.finding(
+                        self.name, "unknown-request-field", node,
+                        f"request field {extra!r} of {svc}.{mth} is not "
+                        f"accepted by {handler.name}.{mth} — it would "
+                        "TypeError server-side (or silently drift)"))
+            if not is_open:
+                for missing in sorted(required - fields):
+                    out.append(ctx.finding(
+                        self.name, "missing-request-field", node,
+                        f"required field {missing!r} of "
+                        f"{handler.name}.{mth} is never sent by this "
+                        f"{svc}.{mth} call"))
+            out.extend(self._check_response(ctx, fn, node, svc, mth,
+                                            method, bind_counts))
+        return out
+
+    # ---------------------------------------------------------- responses
+    def _direct_walk(self, fn: ast.AST):
+        """Descendants of fn excluding nested def bodies."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _handler_return_keys(self, method: FuncInfo) -> Optional[Set[str]]:
+        keys: Set[str] = set()
+        saw = False
+        for n in self._direct_walk(method.node):
+            if not isinstance(n, ast.Return) or n.value is None:
+                continue
+            saw = True
+            if not isinstance(n.value, ast.Dict):
+                return None
+            for k in n.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+                else:
+                    return None
+        return keys if saw else None
+
+    def _check_response(self, ctx, fn, call, svc, mth, method,
+                        bind_counts) -> List[Finding]:
+        parent = ctx.parent(call)
+        if not (isinstance(parent, ast.Assign) and len(parent.targets) == 1
+                and isinstance(parent.targets[0], ast.Name)
+                and parent.value is call):
+            return []
+        var = parent.targets[0].id
+        if bind_counts.get(var, 0) != 1:
+            return []  # rebound elsewhere: reads are ambiguous
+        keys = self._handler_return_keys(method)
+        if keys is None:
+            return []
+        out: List[Finding] = []
+        for n in ast.walk(fn):
+            read: Optional[str] = None
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.value, ast.Name) and n.value.id == var \
+                    and isinstance(n.ctx, ast.Load) \
+                    and isinstance(n.slice, ast.Constant) \
+                    and isinstance(n.slice.value, str):
+                read = n.slice.value
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "get" \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id == var and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                read = n.args[0].value
+            if read is not None and read not in keys:
+                out.append(ctx.finding(
+                    self.name, "drifted-response-field", n,
+                    f"client reads response field {read!r} of {svc}.{mth} "
+                    f"but the handler only returns "
+                    f"{{{', '.join(sorted(keys))}}}"))
+        return out
+
+    # -------------------------------------------------------- codec pairs
+    def _check_codec_pairs(self, ctx, mi) -> List[Finding]:
+        out: List[Finding] = []
+        fns = {n.name: n for n in ctx.nodes_of(ast.FunctionDef)}
+        for name, to_fn in fns.items():
+            if not name.endswith("_to_wire"):
+                continue
+            from_fn = fns.get(name[: -len("_to_wire")] + "_from_wire")
+            if from_fn is None:
+                continue
+            written = self._written_keys(to_fn)
+            req, opt = self._read_keys(from_fn)
+            if written is None or (not req and not opt):
+                continue
+            for k in sorted(req - written):
+                out.append(ctx.finding(
+                    self.name, "wire-field-never-written", from_fn,
+                    f"{from_fn.name} requires wire field {k!r} that "
+                    f"{name} never writes"))
+            for k in sorted(written - req - opt):
+                out.append(ctx.finding(
+                    self.name, "wire-field-never-read", to_fn,
+                    f"{name} writes wire field {k!r} that "
+                    f"{from_fn.name} never reads — dropped on the wire"))
+        return out
+
+    def _written_keys(self, fn: ast.AST) -> Optional[Set[str]]:
+        ret_names: Set[str] = set()
+        keys: Set[str] = set()
+        analyzable = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Return) and n.value is not None:
+                if isinstance(n.value, ast.Name):
+                    ret_names.add(n.value.id)
+                elif isinstance(n.value, ast.Dict):
+                    analyzable = True
+                    for k in n.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            keys.add(k.value)
+                        else:
+                            return None
+                else:
+                    return None
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                t = n.targets[0]
+                if isinstance(t, ast.Name) and t.id in ret_names \
+                        and isinstance(n.value, ast.Dict):
+                    analyzable = True
+                    for k in n.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            keys.add(k.value)
+                        else:
+                            return None
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in ret_names \
+                        and isinstance(t.slice, ast.Constant) \
+                        and isinstance(t.slice.value, str):
+                    keys.add(t.slice.value)
+        return keys if analyzable else None
+
+    # --------------------------------------------- declared piggyback pairs
+    def _declared_pairs(self, index: ProjectIndex) -> Dict[str, dict]:
+        """pair name -> {'writes': [FuncInfo], 'reads': [FuncInfo]}."""
+        pairs: Dict[str, dict] = {}
+        for fi in index.functions.values():
+            mi = index.modules[fi.modname]
+            m = _WIRE_PAIR_RE.search(mi.ctx.line_text(fi.node.lineno))
+            if m is None:
+                # the annotation may sit on any line of a multi-line
+                # signature
+                for ln in range(fi.node.lineno,
+                                fi.node.body[0].lineno):
+                    m = _WIRE_PAIR_RE.search(mi.ctx.line_text(ln))
+                    if m:
+                        break
+            if m is None:
+                continue
+            rec = pairs.setdefault(m.group(1), {"writes": [], "reads": []})
+            rec[m.group(2)].append(fi)
+        return pairs
+
+    def _coarse_written(self, fn: ast.AST) -> Set[str]:
+        keys: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Dict):
+                for k in n.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        keys.add(k.value)
+            elif isinstance(n, ast.Subscript) \
+                    and isinstance(n.ctx, ast.Store) \
+                    and isinstance(n.slice, ast.Constant) \
+                    and isinstance(n.slice.value, str):
+                keys.add(n.slice.value)
+        return keys
+
+    def _coarse_read(self, fn: ast.AST) -> Set[str]:
+        keys: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.ctx, ast.Load) \
+                    and isinstance(n.slice, ast.Constant) \
+                    and isinstance(n.slice.value, str):
+                keys.add(n.slice.value)
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "get" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                keys.add(n.args[0].value)
+            elif isinstance(n, ast.Compare) and isinstance(
+                    n.left, ast.Constant) and isinstance(n.left.value, str) \
+                    and any(isinstance(op, (ast.In, ast.NotIn))
+                            for op in n.ops):
+                keys.add(n.left.value)
+        return keys
+
+    def _check_declared_pairs(self, ctx, index: ProjectIndex
+                              ) -> List[Finding]:
+        pairs: Dict[str, dict] = index.memo(
+            "wire.declared_pairs", lambda: self._declared_pairs(index))
+        out: List[Finding] = []
+        for name, rec in pairs.items():
+            if not rec["reads"]:
+                continue
+            read: Set[str] = set()
+            for fi in rec["reads"]:
+                read |= self._coarse_read(fi.node)
+            for fi in rec["writes"]:
+                if index.modules[fi.modname].relpath != ctx.relpath:
+                    continue  # report on the writer, in its own file
+                for k in sorted(self._coarse_written(fi.node) - read):
+                    out.append(ctx.finding(
+                        self.name, "wire-field-never-read", fi.node,
+                        f"wire-pair {name!r}: {fi.node.name} writes "
+                        f"field {k!r} that no declared reader ever "
+                        "consumes — dead wire weight (or a renamed "
+                        "consumer-side key)"))
+        return out
+
+    def _read_keys(self, fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+        params, _ = _params_wo_self(fn)
+        if not params:
+            return set(), set()
+        w = params[0]
+        req: Set[str] = set()
+        opt: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.value, ast.Name) and n.value.id == w \
+                    and isinstance(n.slice, ast.Constant) \
+                    and isinstance(n.slice.value, str):
+                req.add(n.slice.value)
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "get" \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id == w and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                opt.add(n.args[0].value)
+        return req, opt

@@ -1,0 +1,468 @@
+"""yblint whole-program index: the one-shot substrate the v2 passes share.
+
+Built EXACTLY ONCE per run from the same `FileContext`s the per-file
+passes walk (one parse per file stays the invariant — the index adds one
+extra linear walk per module, no re-parse). It provides:
+
+- a module/symbol table: per module, its import-alias map (including
+  relative imports), module-level literal constants, top-level functions
+  and classes;
+- class-attribute types, inferred from annotations (`self.x: Foo`,
+  class-body `x: Foo`) and `__init__`-style assignments
+  (`self.x = Foo(...)`, `self.x = param` with an annotated param);
+- a call graph over fully-qualified function keys
+  (`pkg.mod.func` / `pkg.mod.Class.method`), with bare-name, import-alias,
+  `self.method`, `self.attr.method` (through attr types), annotated-param
+  and local-constructor receiver resolution — plus weak "reference" edges
+  for functions passed as callbacks (`Thread(target=f)`), so reachability
+  analyses see work handed to helper threads;
+- `reachable(seeds)` BFS and `key_of(node)` so a per-file pass can map
+  its AST nodes back into the global graph.
+
+Resolution is conservative: an unresolvable name simply contributes no
+edge/type (missed edges, never invented ones), matching the rest of
+yblint's no-false-positive bias.
+
+Passes opt in with `needs_index = True`; their `run(ctx, index)` then
+receives the shared index (or a single-file index when run standalone,
+e.g. from unit-test fixtures).
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains; '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def modname_of(relpath: str) -> str:
+    """'yugabyte_tpu/storage/db.py' -> 'yugabyte_tpu.storage.db';
+    a package __init__.py maps to the package name itself."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class ClassInfo:
+    __slots__ = ("name", "fq", "modname", "node", "base_exprs", "bases",
+                 "methods", "attr_types")
+
+    def __init__(self, name: str, fq: str, modname: str, node: ast.ClassDef):
+        self.name = name
+        self.fq = fq
+        self.modname = modname
+        self.node = node
+        self.base_exprs: List[ast.AST] = list(node.bases)
+        self.bases: List[str] = []           # resolved fq class names
+        self.methods: Dict[str, "FuncInfo"] = {}
+        self.attr_types: Dict[str, str] = {}  # attr -> fq class name
+
+
+class FuncInfo:
+    __slots__ = ("key", "modname", "qualname", "node", "cls")
+
+    def __init__(self, key: str, modname: str, qualname: str,
+                 node: ast.AST, cls: Optional[ClassInfo]):
+        self.key = key
+        self.modname = modname
+        self.qualname = qualname
+        self.node = node
+        self.cls = cls
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+                + [p.arg for p in a.kwonlyargs])
+
+
+class ModuleInfo:
+    __slots__ = ("relpath", "modname", "ctx", "imports", "constants",
+                 "functions", "classes", "assigned")
+
+    def __init__(self, ctx) -> None:
+        self.relpath = ctx.relpath
+        self.modname = modname_of(ctx.relpath)
+        self.ctx = ctx
+        self.imports: Dict[str, str] = {}     # local alias -> fq target
+        self.constants: Dict[str, object] = {}
+        self.functions: Dict[str, FuncInfo] = {}   # top-level only
+        self.classes: Dict[str, ClassInfo] = {}
+        self.assigned: set = set()   # every top-level assigned name
+
+
+class ProjectIndex:
+    """See module docstring. Constructed from the run's FileContexts."""
+
+    def __init__(self, ctxs: Sequence) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_relpath: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}   # every def, incl nested
+        self.call_graph: Dict[str, Set[str]] = {}
+        self._key_of_node: Dict[int, str] = {}
+        self._memo: Dict[str, object] = {}
+        self._memo_lock = threading.Lock()
+        for ctx in ctxs:
+            self._collect_module(ctx)
+        for ci in self.classes.values():
+            self._resolve_bases(ci)
+        for ci in self.classes.values():
+            self._infer_attr_types(ci)
+        for fi in list(self.functions.values()):
+            self.call_graph[fi.key] = self._edges(fi)
+
+    # ------------------------------------------------------------ memoizing
+    def memo(self, key: str, builder: Callable[[], object]) -> object:
+        """Compute-once cache for whole-program facts a pass derives from
+        the index (thread-safe: pass workers share one index)."""
+        with self._memo_lock:
+            if key not in self._memo:
+                self._memo[key] = builder()
+            return self._memo[key]
+
+    # ----------------------------------------------------------- collection
+    def _collect_module(self, ctx) -> None:
+        mi = ModuleInfo(ctx)
+        self.modules[mi.modname] = mi
+        self.by_relpath[mi.relpath] = mi
+        pkg_parts = mi.modname.split(".")
+        for node in ctx.nodes_of(ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                mi.imports[local] = (alias.name if alias.asname
+                                     else alias.name.split(".")[0])
+        for node in ctx.nodes_of(ast.ImportFrom):
+            if node.level:
+                # relative: level 1 = this module's package
+                base_parts = pkg_parts[: len(pkg_parts) - node.level]
+                base = ".".join(base_parts)
+            else:
+                base = ""
+            src = node.module or ""
+            prefix = ".".join(p for p in (base, src) if p)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                mi.imports[local] = (prefix + "." + alias.name
+                                     if prefix else alias.name)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                mi.assigned.add(stmt.targets[0].id)
+                val = _literal_inner(stmt.value)
+                if val is not _NOT_LITERAL:
+                    mi.constants[stmt.targets[0].id] = val
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                mi.assigned.add(stmt.target.id)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mi, ctx, stmt, cls=None)
+            elif isinstance(stmt, ast.ClassDef):
+                self._add_class(mi, ctx, stmt)
+        # nested defs (inside functions) still get keys + graph nodes so
+        # reachability sees closures handed to threads/callbacks
+        for node in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            if id(node) not in self._key_of_node:
+                key = mi.modname + "." + ctx.qualname(node)
+                owner = self._owning_class_info(mi, ctx, node)
+                fi = FuncInfo(key, mi.modname, ctx.qualname(node), node,
+                              owner)
+                self.functions.setdefault(key, fi)
+                self._key_of_node[id(node)] = key
+
+    def _owning_class_info(self, mi: ModuleInfo, ctx,
+                           node: ast.AST) -> Optional[ClassInfo]:
+        for a in ctx.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return self.classes.get(mi.modname + "." + a.name)
+        return None
+
+    def _add_function(self, mi: ModuleInfo, ctx, node: ast.AST,
+                      cls: Optional[ClassInfo]) -> None:
+        qual = ctx.qualname(node)
+        key = mi.modname + "." + qual
+        fi = FuncInfo(key, mi.modname, qual, node, cls)
+        self.functions[key] = fi
+        self._key_of_node[id(node)] = key
+        if cls is None:
+            mi.functions[node.name] = fi
+        else:
+            cls.methods[node.name] = fi
+
+    def _add_class(self, mi: ModuleInfo, ctx, node: ast.ClassDef) -> None:
+        fq = mi.modname + "." + node.name
+        ci = ClassInfo(node.name, fq, mi.modname, node)
+        mi.classes[node.name] = ci
+        self.classes[fq] = ci
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mi, ctx, stmt, cls=ci)
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                t = self._resolve_type_expr(mi, stmt.annotation)
+                if t:
+                    ci.attr_types.setdefault(stmt.target.id, t)
+
+    # ----------------------------------------------------------- resolution
+    def resolve(self, mi: ModuleInfo, dotted: str) -> Optional[str]:
+        """Local dotted name -> fully-qualified name, through the module's
+        import aliases or its own top-level symbols. None if unknown."""
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        head = parts[0]
+        if head in mi.imports:
+            return ".".join([mi.imports[head]] + parts[1:])
+        if head in mi.functions or head in mi.classes \
+                or head in mi.constants or head in mi.assigned:
+            return mi.modname + "." + dotted
+        return None
+
+    def lookup_function(self, fq: Optional[str]) -> Optional[FuncInfo]:
+        return self.functions.get(fq) if fq else None
+
+    def lookup_class(self, fq: Optional[str]) -> Optional[ClassInfo]:
+        return self.classes.get(fq) if fq else None
+
+    def resolve_str_const(self, mi: ModuleInfo,
+                          expr: ast.AST) -> Optional[str]:
+        """String literal, or a Name/Attribute resolving to a module-level
+        string constant (cross-module through import aliases)."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        fq = self.resolve(mi, dotted_name(expr))
+        if fq is None:
+            return None
+        mod, _, name = fq.rpartition(".")
+        owner = self.modules.get(mod)
+        if owner is not None:
+            v = owner.constants.get(name)
+            if isinstance(v, str):
+                return v
+        return None
+
+    def find_method(self, ci: Optional[ClassInfo],
+                    name: str) -> Optional[FuncInfo]:
+        """Method resolution through the (index-visible) base chain."""
+        seen: Set[str] = set()
+        stack = [ci] if ci else []
+        while stack:
+            c = stack.pop(0)
+            if c is None or c.fq in seen:
+                continue
+            seen.add(c.fq)
+            if name in c.methods:
+                return c.methods[name]
+            stack.extend(self.classes.get(b) for b in c.bases)
+        return None
+
+    def key_of(self, node: ast.AST) -> Optional[str]:
+        """Graph key of a def node from one of the indexed contexts."""
+        return self._key_of_node.get(id(node))
+
+    def reachable(self, seeds: Sequence[str]) -> Set[str]:
+        out = set(k for k in seeds if k in self.call_graph)
+        frontier = list(out)
+        while frontier:
+            cur = frontier.pop()
+            for nxt in self.call_graph.get(cur, ()):
+                if nxt not in out:
+                    out.add(nxt)
+                    frontier.append(nxt)
+        return out
+
+    # ------------------------------------------------------- type inference
+    def _resolve_bases(self, ci: ClassInfo) -> None:
+        mi = self.modules[ci.modname]
+        for b in ci.base_exprs:
+            fq = self.resolve(mi, dotted_name(b))
+            if fq in self.classes:
+                ci.bases.append(fq)
+
+    def _resolve_type_expr(self, mi: ModuleInfo,
+                           ann: ast.AST) -> Optional[str]:
+        """Annotation -> fq class name ('Foo', 'mod.Foo', Optional[Foo],
+        'Foo' as a string literal). None when not an index-known class."""
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):
+            base = dotted_name(ann.value)
+            if base.rpartition(".")[2] == "Optional":
+                return self._resolve_type_expr(mi, ann.slice)
+            return None
+        fq = self.resolve(mi, dotted_name(ann))
+        return fq if fq in self.classes else None
+
+    def _infer_attr_types(self, ci: ClassInfo) -> None:
+        mi = self.modules[ci.modname]
+        ordered = sorted(ci.methods.values(),
+                         key=lambda f: f.name != "__init__")
+        for fi in ordered:
+            ann_of: Dict[str, Optional[str]] = {}
+            args = fi.node.args
+            for p in args.posonlyargs + args.args + args.kwonlyargs:
+                if p.annotation is not None:
+                    ann_of[p.arg] = self._resolve_type_expr(
+                        mi, p.annotation)
+            for node in ast.walk(fi.node):
+                target = None
+                value = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target = node.target
+                    t = self._resolve_type_expr(mi, node.annotation)
+                    if t and isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id == "self":
+                        ci.attr_types.setdefault(target.attr, t)
+                    continue
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                t = self._value_type(mi, value, ann_of)
+                if t:
+                    ci.attr_types.setdefault(target.attr, t)
+
+    def _value_type(self, mi: ModuleInfo, value: Optional[ast.AST],
+                    ann_of: Dict[str, Optional[str]]) -> Optional[str]:
+        """Type of an assigned value: Ctor(...) of a known class, an
+        annotated parameter, or a call to a function whose return
+        annotation is a known class."""
+        if value is None:
+            return None
+        if isinstance(value, ast.Name):
+            return ann_of.get(value.id)
+        if isinstance(value, ast.Call):
+            fq = self.resolve(mi, dotted_name(value.func))
+            if fq in self.classes:
+                return fq
+            fi = self.lookup_function(fq)
+            if fi is not None and fi.node.returns is not None:
+                owner = self.modules[fi.modname]
+                return self._resolve_type_expr(owner, fi.node.returns)
+        return None
+
+    # ------------------------------------------------------------ call graph
+    def local_types(self, fi: FuncInfo) -> Dict[str, str]:
+        """name -> fq class for a function's params (annotations) and
+        simple locals (constructor / annotated-return-call assignments)."""
+        mi = self.modules[fi.modname]
+        ann_of: Dict[str, Optional[str]] = {}
+        args = fi.node.args
+        for p in args.posonlyargs + args.args + args.kwonlyargs:
+            if p.annotation is not None:
+                ann_of[p.arg] = self._resolve_type_expr(mi, p.annotation)
+        env: Dict[str, str] = {k: v for k, v in ann_of.items() if v}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = self._value_type(mi, node.value, ann_of)
+                if t:
+                    env.setdefault(node.targets[0].id, t)
+        return env
+
+    def _nested_defs(self, fi: FuncInfo) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fi.node:
+                key = self._key_of_node.get(id(node))
+                if key:
+                    out[node.name] = key
+        return out
+
+    def _edges(self, fi: FuncInfo) -> Set[str]:
+        mi = self.modules[fi.modname]
+        env = self.local_types(fi)
+        nested = self._nested_defs(fi)
+        edges: Set[str] = set()
+
+        def add_callable(fq: Optional[str]) -> None:
+            if fq is None:
+                return
+            if fq in self.functions:
+                edges.add(fq)
+            elif fq in self.classes:
+                init = self.find_method(self.classes[fq], "__init__")
+                if init is not None:
+                    edges.add(init.key)
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name):
+                    if f.id in nested:
+                        edges.add(nested[f.id])
+                    else:
+                        add_callable(self.resolve(mi, f.id))
+                elif isinstance(f, ast.Attribute):
+                    recv = f.value
+                    target: Optional[FuncInfo] = None
+                    if isinstance(recv, ast.Name) and recv.id in ("self",
+                                                                  "cls"):
+                        target = self.find_method(fi.cls, f.attr)
+                    elif isinstance(recv, ast.Name) and recv.id in env:
+                        target = self.find_method(
+                            self.classes.get(env[recv.id]), f.attr)
+                    elif (isinstance(recv, ast.Attribute)
+                          and isinstance(recv.value, ast.Name)
+                          and recv.value.id == "self" and fi.cls is not None
+                          and recv.attr in fi.cls.attr_types):
+                        target = self.find_method(
+                            self.classes.get(fi.cls.attr_types[recv.attr]),
+                            f.attr)
+                    else:
+                        add_callable(self.resolve(mi, dotted_name(f)))
+                    if target is not None:
+                        edges.add(target.key)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                # weak callback-reference edge (Thread(target=f), map(f, ..))
+                if node.id in nested:
+                    edges.add(nested[node.id])
+                elif node.id in mi.functions:
+                    edges.add(mi.functions[node.id].key)
+        edges.discard(fi.key)
+        return edges
+
+
+class _NotLiteral:
+    pass
+
+
+_NOT_LITERAL = _NotLiteral()
+
+
+def _literal_inner(node: ast.AST):
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_literal_inner(e) for e in node.elts]
+        if any(v is _NOT_LITERAL for v in vals):
+            return _NOT_LITERAL
+        return tuple(vals)
+    return _NOT_LITERAL

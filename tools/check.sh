@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# tools/check.sh — the one tier-1 static-analysis entry point.
+#
+#   tools/check.sh            yblint (all nine passes, repo-clean vs the
+#                             committed baseline, incl. the metric-name
+#                             lint) + the yblint framework suite, which
+#                             carries the lock-rank acyclicity gate and
+#                             the empty-baseline/justification gates
+#   tools/check.sh --changed  same, but yblint reports only files changed
+#                             vs HEAD (index still whole-program) — the
+#                             seconds-fast pre-commit form
+#   tools/check.sh --full     all of the above, then the full tier-1
+#                             pytest suite (tests/ -m 'not slow')
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+YBLINT_ARGS=()
+RUN_FULL=0
+for a in "$@"; do
+    case "$a" in
+        --changed) YBLINT_ARGS+=(--changed) ;;
+        --full)    RUN_FULL=1 ;;
+        *) echo "usage: tools/check.sh [--changed] [--full]" >&2; exit 2 ;;
+    esac
+done
+
+echo "== yblint (all passes) =="
+python -m tools.analysis "${YBLINT_ARGS[@]+"${YBLINT_ARGS[@]}"}"
+
+echo "== yblint framework + lock-rank acyclicity + baseline gates =="
+python -m pytest tests/test_yblint.py -q
+
+if [ "$RUN_FULL" = 1 ]; then
+    echo "== tier-1 =="
+    python -m pytest tests/ -m 'not slow' -q
+fi
+echo "check.sh: OK"

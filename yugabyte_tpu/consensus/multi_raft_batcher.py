@@ -138,7 +138,7 @@ class MultiRaftBatcher:
                 raise PeerUnreachable(
                     f"{addr}: batched response arity mismatch "
                     f"({len(resps)} != {len(batch)})")
-        except Exception as e:  # noqa: BLE001 — fan the failure out
+        except Exception as e:  # noqa: BLE001  # yblint: contained(failure fanned out to every waiter slot below)
             for _d, _r, slot in batch:
                 slot.err = e if isinstance(e, PeerUnreachable) \
                     else PeerUnreachable(f"{addr}: {e}")

@@ -129,6 +129,37 @@ class MiniCluster:
         raise StatusError(Status.TimedOut(
             f"replicas of {table_id} not all running"))
 
+    def wait_for_table_leaders(self, namespace: str, name: str,
+                               timeout_s: float = 30.0) -> List[str]:
+        """Deadline-poll until EVERY tablet of `namespace.name` has a
+        READY leader; returns the tablet ids.
+
+        The table-level form of wait_for_tablet_leader — the deflake
+        primitive for tests that CREATE TABLE (possibly via a query
+        layer) and immediately write: on a loaded single-core runner a
+        fresh tablet's first election can outlast the client retry
+        budget, so the write races the election (the known tier-1
+        leadership-timing flake)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                cat = self.leader_master().catalog
+                table = next(
+                    t for t in cat.tables.values()
+                    if t["namespace"] == namespace and t["name"] == name)
+                tablet_ids = list(table["tablet_ids"])
+                break
+            except (StatusError, StopIteration):
+                if time.monotonic() > deadline:
+                    raise StatusError(Status.TimedOut(
+                        f"table {namespace}.{name} not in catalog within "
+                        f"{timeout_s}s"))
+                time.sleep(0.02)
+        for tid in tablet_ids:
+            self.wait_for_tablet_leader(
+                tid, timeout_s=max(0.1, deadline - time.monotonic()))
+        return tablet_ids
+
     def wait_for_tablet_leader(self, tablet_id: str,
                                timeout_s: float = 30.0,
                                exclude: Optional[set] = None) -> str:

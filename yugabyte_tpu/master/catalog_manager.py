@@ -81,7 +81,7 @@ class TSManager:
         self._lock = threading.Lock()
 
     def heartbeat(self, server_id: str, addr: str,
-                  report: List[dict]) -> TSDescriptor:
+                  report: List[dict]) -> TSDescriptor:  # yblint: wire-pair(tablet_report, reads)
         with self._lock:
             desc = self._descs.get(server_id)
             if desc is None or desc.addr != addr:
@@ -419,7 +419,7 @@ class CatalogManager:
                          name="alter-push").start()
         return table
 
-    def _schema_updates_for(self, report: List[dict]) -> List[dict]:
+    def _schema_updates_for(self, report: List[dict]) -> List[dict]:  # yblint: wire-pair(tablet_report, reads)
         """Heartbeat piggyback: alter orders for reported tablets whose
         schema version lags the catalog's (the reconciliation half of
         alter_table — a replica that missed the direct push, or was
@@ -609,7 +609,7 @@ class CatalogManager:
 
     # ------------------------------------------------------------ heartbeats
     def process_heartbeat(self, server_id: str, addr: str,
-                          report: List[dict]) -> dict:
+                          report: List[dict]) -> dict:  # yblint: wire-pair(tablet_report, reads)
         desc = self.ts_manager.heartbeat(server_id, addr, report)
         to_delete = []
         reported_ids = {t["tablet_id"] for t in report}
@@ -727,7 +727,7 @@ class CatalogManager:
                     out[tablet_id] = per_table[tm["table_id"]]
         return out
 
-    def _adopt_split_child_locked(self, t: dict) -> None:
+    def _adopt_split_child_locked(self, t: dict) -> None:  # yblint: wire-pair(tablet_report, reads)
         parent_id = t["split_parent"]
         parent_tm = self.tablets[parent_id]
         child_id = t["tablet_id"]

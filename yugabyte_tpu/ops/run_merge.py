@@ -199,6 +199,27 @@ _merge_gc_runs_fused_donated = functools.partial(
     donate_argnums=(0,))(_merge_gc_runs_impl)
 
 
+class _DonatedBuffer:
+    """Poison placeholder installed over StagedRuns.cols_dev once the
+    buffer was donated to XLA: any later touch (the write-through gather
+    in gather_staged_outputs, a re-dispatch) raises with the launch that
+    consumed it instead of silently reading reused HBM."""
+
+    __slots__ = ("_what",)
+
+    def __init__(self, what: str):
+        self._what = what
+
+    def _die(self, *_a, **_k):
+        raise RuntimeError(
+            f"cols_dev was donated to {self._what}: XLA reuses its HBM "
+            "in place, so this buffer no longer holds the staged "
+            "columns. Launch without donate=True if anything (e.g. "
+            "device write-through staging) must read it afterwards.")
+
+    __getattr__ = __getitem__ = __array__ = _die
+
+
 def _donation_supported() -> bool:
     """Buffer donation is a no-op (with a per-call warning) on the CPU
     backend — only donate where the runtime honors it. Doubles as the
@@ -516,13 +537,22 @@ def stage_runs_from_slabs(slabs: Sequence[KVSlab], device=None,
     r = _ROW_WORDS + w
     pool = host_staging_pool()
     cols = pool.acquire((r, k_pad * m))
-    cols[:] = pad_template(r)[:, None]
-    stats = []
-    for i, s in enumerate(live):
-        sub, n_s, _, _ = pack_cols(s, n_pad_override=s.n, w_pad_override=w)
-        cols[:, i * m: i * m + n_s] = sub
-        stats.append(column_stats(sub, n_s))
-    cmp_rows, n_cmp = _cmp_schedule(w, _merge_const_stats(stats, r))
+    try:
+        cols[:] = pad_template(r)[:, None]
+        stats = []
+        for i, s in enumerate(live):
+            sub, n_s, _, _ = pack_cols(s, n_pad_override=s.n,
+                                       w_pad_override=w)
+            cols[:, i * m: i * m + n_s] = sub
+            stats.append(column_stats(sub, n_s))
+        cmp_rows, n_cmp = _cmp_schedule(w, _merge_const_stats(stats, r))
+    except BaseException:
+        # the upload below never started, so no device buffer can alias
+        # these pages on ANY backend — recycle instead of leaking the
+        # lease (an unwinding pipeline stage would otherwise degrade the
+        # pool to one-shot allocations)
+        pool.release(cols)
+        raise
     cols_dev = (jax.device_put(cols, device) if device is not None
                 else jnp.asarray(cols))
     if _donation_supported():
@@ -1290,6 +1320,15 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
         is_major=params.is_major_compaction,
         retain_deletes=params.retain_deletes, snapshot=snapshot,
         lexsort=lexsort)
+    if use_donate:
+        # the dispatch above consumed cols_dev (XLA reuses its HBM);
+        # poison it in the handle's staged copy so a later read — e.g.
+        # gather_staged_outputs write-through on a handle that was
+        # wrongly launched donated — fails loudly instead of staging
+        # garbage into the slab cache. Decode only needs the metadata.
+        import dataclasses as _dc
+        staged = _dc.replace(
+            staged, cols_dev=_DonatedBuffer("_merge_gc_runs_fused_donated"))
     return MergeGCHandle(packed, staged, perm, keep, mk,
                          host_async=host_async)
 

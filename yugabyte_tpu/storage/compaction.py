@@ -581,7 +581,7 @@ def run_compaction_job_device_native(
                         for rid in cached_ids:
                             job.add_cached(rid)
                         pinned = True
-                    except KeyError:
+                    except KeyError:  # yblint: contained(run-cache entry evicted since the probe — job falls back to the file path)
                         pinned = False
                 if pinned:
                     ingest["rows_in"] = job.prepare_cached()
@@ -590,7 +590,7 @@ def run_compaction_job_device_native(
                         with open(r.data_path, "rb") as f:
                             job.add_input(f.read(), r.block_handles)
                     ingest["rows_in"] = job.prepare()
-            except BaseException as e:  # noqa: BLE001 — re-raised on join
+            except BaseException as e:  # noqa: BLE001  # yblint: contained(parked in ingest['err'], re-raised on the join path)
                 ingest["err"] = e
             finally:
                 record_pipeline_stage(
@@ -620,7 +620,17 @@ def run_compaction_job_device_native(
                 # (read_all is numpy + file I/O, GIL-light); uploads stay
                 # serial below — device_put ordering is the staging order
                 def _read(i):
-                    slabs_by_idx[i] = inputs[i].read_all()
+                    try:
+                        slabs_by_idx[i] = inputs[i].read_all()
+                    except Exception as e:  # noqa: BLE001  # yblint: contained(decode retried serially below; a persistent fault raises there)
+                        # a dead reader thread must not take the whole
+                        # job down with a bare stderr traceback — the
+                        # serial fallback re-reads this input and is the
+                        # path that surfaces a real disk fault
+                        from yugabyte_tpu.utils.trace import TRACE
+                        TRACE("compaction: cold-miss decode of %s failed "
+                              "on the reader thread (%s); serial path "
+                              "will retry", inputs[i].data_path, e)
                 readers = [threading.Thread(target=_read, args=(i,),
                                             daemon=True) for i in misses]
                 for t in readers:

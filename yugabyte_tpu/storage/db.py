@@ -285,7 +285,11 @@ class DB:
         flush/compaction (ref: PurgeObsoleteFiles after a failed job)."""
         try:
             names = os.listdir(self.db_dir)
-        except OSError:
+        except OSError as e:
+            # sweep runs again next retry cycle, but a silent skip hid
+            # e.g. a permissions regression — surface it
+            TRACE("db %s: orphan sweep cannot list dir: %s",
+                  self.db_dir, e)
             return
         live = set(self.versions.files)
         writing = {os.path.basename(p) for p in self._writing}
@@ -299,8 +303,11 @@ class DB:
                 continue
             try:
                 os.remove(os.path.join(self.db_dir, name))
-            except OSError:
-                pass
+            except OSError as e:
+                # an orphan that cannot be removed leaks disk until some
+                # later sweep succeeds — keep trying, but say so
+                TRACE("db %s: orphan sweep cannot remove %s: %s",
+                      self.db_dir, name, e)
 
     # ------------------------------------------------------------------ write
     def _post_write_locked(self, op_id: Tuple[int, int]) -> bool:
@@ -981,5 +988,5 @@ def _delete_sst_files(base_path: str) -> None:
     for p in (base_path, data_file_name(base_path)):
         try:
             os.remove(p)
-        except FileNotFoundError:
+        except FileNotFoundError:  # yblint: contained(idempotent delete — both halves may already be gone)
             pass

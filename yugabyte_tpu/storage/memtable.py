@@ -224,7 +224,7 @@ def native_memtable_available() -> bool:
     try:
         _load_mt_lib()
         return True
-    except Exception:  # noqa: BLE001 — no toolchain: Python memtable
+    except Exception:  # noqa: BLE001  # yblint: contained(feature probe — no toolchain means the Python memtable)
         return False
 
 
@@ -423,7 +423,7 @@ def new_memtable():
     from yugabyte_tpu.utils import flags as _flags
     try:
         use_native = _flags.get_flag("memtable_native")
-    except KeyError:
+    except KeyError:  # yblint: contained(flag not registered in this process — default native)
         use_native = True
     if use_native and native_memtable_available():
         return NativeMemTable()

@@ -124,7 +124,7 @@ def available() -> bool:
         try:
             _load()
             _available = True
-        except Exception:
+        except Exception:  # yblint: contained(build probe — cached False routes every job to the Python shell)
             _available = False
     return _available
 
