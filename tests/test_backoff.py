@@ -69,6 +69,58 @@ class TestRetrySchedule:
             assert time.monotonic() < deadline
             time.sleep(0.005)
 
+    def test_deadline_clamps_and_expires(self):
+        """Per-op budget: delays clamp to the remaining budget and the
+        schedule stops offering attempts once it is spent."""
+        s = RetrySchedule(initial_s=100.0, max_s=200.0, deadline_s=0.05)
+        d = s.record_failure()
+        assert d <= 0.05, "delay must clamp to the remaining budget"
+        assert s.remaining_s() is not None
+        time.sleep(0.07)
+        assert s.expired
+        assert not s.ready(), "expired schedule must not offer attempts"
+
+    def test_unbounded_schedule_never_expires(self):
+        s = RetrySchedule(initial_s=0.01)
+        assert s.remaining_s() is None
+        for _ in range(5):
+            s.record_failure()
+        assert not s.expired
+
+
+class TestClientOpDeadline:
+    """Satellite: client retries honor an overall per-op deadline and
+    surface DeadlineExceeded instead of retrying past it."""
+
+    def test_backoff_remaining_clamps(self):
+        b = Backoff(base_s=0.01, cap_s=0.02, deadline_s=0.5)
+        r = b.remaining_s()
+        assert r is not None and 0 < r <= 0.5
+        assert Backoff(base_s=0.01).remaining_s() is None
+
+    def test_master_call_surfaces_deadline_exceeded(self):
+        """A client hammering an unreachable master stops at the per-op
+        deadline with TIMED_OUT, not after burning all retry rounds."""
+        from yugabyte_tpu.client.client import YBClient
+        from yugabyte_tpu.utils import flags
+        from yugabyte_tpu.utils.status import Code, StatusError
+        old = flags.get_flag("client_op_timeout_s")
+        flags.set_flag("client_op_timeout_s", 0.3)
+        client = YBClient(["127.0.0.1:1"])  # nothing listens there
+        try:
+            t0 = time.monotonic()
+            try:
+                client.list_namespaces()
+                raise AssertionError("expected a deadline failure")
+            except StatusError as e:
+                assert e.status.code == Code.TIMED_OUT, e.status
+                assert "deadline" in e.status.message
+            # far below the 12-round full-backoff walk
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            flags.set_flag("client_op_timeout_s", old)
+            client.close()
+
 
 def test_no_swallowed_errors_in_storage_layers():
     """CI wiring for tools/lint_swallowed_errors.py: storage/, consensus/

@@ -75,6 +75,10 @@ def test_ysck_healthy_cluster(tmp_path):
         schema = Schema([ColumnSchema("k", DataType.STRING),
                          ColumnSchema("v", DataType.INT64)], 1, 0)
         t = client.create_table("ck", "t", schema, num_tablets=2)
+        # deadline-poll the fresh tablets' leadership instead of racing
+        # the first election against the client retry budget (the known
+        # tier-1 leadership-timing flake on loaded single-core CI)
+        mc.wait_for_table_leaders("ck", "t")
         for i in range(30):
             client.write(t, [QLWriteOp(
                 WriteOpKind.INSERT, DocKey(hash_components=(f"k{i}",)),

@@ -60,6 +60,11 @@ def test_xcluster_replicates_writes_deletes_and_txns(clusters):
                                     num_tablets=2)
     d_table = d_client.create_table("app", "orders", _schema(),
                                     num_tablets=2)
+    # deadline-poll leadership on both universes instead of racing the
+    # fresh tablets' first election against the client retry budget
+    # (the known tier-1 leadership-timing flake under CI load)
+    src.wait_for_table_leaders("app", "orders")
+    dst.wait_for_table_leaders("app", "orders")
     for i in range(20):
         s_client.write(s_table, [_op(f"o{i:03d}", i)])
 

@@ -792,6 +792,39 @@ class TestErrorPropagation:
         """, relpath="yugabyte_tpu/client/fake.py")
         assert fs == []
 
+    def test_nemesis_and_cancel_paths_are_seeded(self):
+        """PR 6 seed extension: chaos/nemesis fault-injection and
+        pipeline-cancellation paths must route or justify containment —
+        a swallowed error in fault injection makes chaos tests pass
+        vacuously."""
+        fs = self._lint("""
+            def apply_nemesis_window():
+                try:
+                    inject()
+                except OSError:
+                    fallback()
+
+            def cancel_background_work():
+                try:
+                    abort()
+                except ValueError:
+                    fallback()
+        """, relpath="yugabyte_tpu/rpc/fake.py")
+        assert _codes(fs) == ["unrouted-except", "unrouted-except"]
+        assert sorted(f.symbol for f in fs) == [
+            "apply_nemesis_window", "cancel_background_work"]
+
+    def test_nemesis_module_functions_all_seeded(self):
+        """Every function of rpc/nemesis.py (and integration/chaos.py)
+        is a seed, mirroring the WAL-module rule."""
+        fs = _lint_idx({"yugabyte_tpu/rpc/nemesis.py": (
+            "def check_link(src, dst):\n"
+            "    try:\n"
+            "        fire()\n"
+            "    except OSError:\n"
+            "        fallback()\n")}, self.PASS)
+        assert _codes(fs) == ["unrouted-except"]
+
 
 # ---------------------------------------------------------------------------
 # resource lifetime

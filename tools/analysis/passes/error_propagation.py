@@ -20,14 +20,22 @@ reachable from a flush/compaction/WAL seed must
 Seeds (whole-program call graph, so a helper three modules away is still
 on the path):
   - every function whose name contains `flush` or `compact`;
-  - every function of the WAL module (consensus/log.py);
+  - every function whose name contains `nemesis`, `chaos` or `cancel`
+    (PR 6: the chaos layer and the pipeline-cancellation paths — a
+    swallowed error in fault injection makes chaos tests pass
+    vacuously, and one in a cancellation path turns clean aborts into
+    hangs or leaks);
+  - every function of the WAL module (consensus/log.py), the nemesis
+    rule engine (rpc/nemesis.py) and the chaos controller
+    (integration/chaos.py);
   - any function marked `# yblint: durability-path` on its def line.
 Reachability includes weak callback edges (`Thread(target=f)`), so the
 pipeline's ingest/decode worker closures are covered.
 
-Findings are reported only for files under storage/, consensus/ and
-tablet/ — the layers whose silent degradation loses data. `__del__`
-bodies are exempt (teardown is unroutable).
+Findings are reported for files under storage/, consensus/, tablet/,
+rpc/, integration/ and ops/ — the layers whose silent degradation loses
+data or silently un-injects faults. `__del__` bodies are exempt
+(teardown is unroutable).
 """
 
 from __future__ import annotations
@@ -42,9 +50,13 @@ from tools.analysis.project_index import ProjectIndex
 PASS_NAME = "error-propagation"
 
 DEFAULT_DIRS = ("yugabyte_tpu/storage", "yugabyte_tpu/consensus",
-                "yugabyte_tpu/tablet")
-_SEED_NAME_RE = re.compile(r"flush|compact", re.IGNORECASE)
+                "yugabyte_tpu/tablet", "yugabyte_tpu/rpc",
+                "yugabyte_tpu/integration", "yugabyte_tpu/ops")
+_SEED_NAME_RE = re.compile(r"flush|compact|nemesis|chaos|cancel",
+                           re.IGNORECASE)
 _WAL_MODULE_SUFFIX = ".consensus.log"
+_SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
+                         ".integration.chaos")
 _MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
 _DEF_MARKER = "# yblint: durability-path"
 _ROUTING_NAMES = ("TRACE", "trace")
@@ -71,7 +83,7 @@ def _seeds(index: ProjectIndex) -> Set[str]:
     for fi in index.functions.values():
         if _SEED_NAME_RE.search(fi.node.name):
             out.add(fi.key)
-        elif fi.modname.endswith(_WAL_MODULE_SUFFIX):
+        elif fi.modname.endswith(_SEED_MODULE_SUFFIXES):
             out.add(fi.key)
         else:
             mi = index.modules.get(fi.modname)

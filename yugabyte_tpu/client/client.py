@@ -30,6 +30,26 @@ from yugabyte_tpu.utils.trace import TRACE, Trace
 
 flags.define_flag("client_rpc_retries", 12,
                   "per-operation retry budget (leader changes, restarts)")
+flags.define_flag("client_op_timeout_s", 60.0,
+                  "overall per-operation deadline across ALL retries "
+                  "(ref client.h default_admin_operation_timeout): the "
+                  "retry walk clamps its backoff sleeps and per-attempt "
+                  "RPC timeouts to the remaining budget and surfaces "
+                  "DeadlineExceeded instead of retrying past it; "
+                  "<= 0 disables the bound")
+
+
+def _op_deadline_s() -> Optional[float]:
+    t = flags.get_flag("client_op_timeout_s")
+    return t if t and t > 0 else None
+
+
+def _deadline_exceeded(what: str, backoff: Backoff,
+                       last_err) -> StatusError:
+    return StatusError(Status.TimedOut(
+        f"{what}: per-op deadline "
+        f"({flags.get_flag('client_op_timeout_s')}s) exceeded after "
+        f"{backoff.attempts} retry rounds (last: {last_err})"))
 
 MASTER_SERVICE = "master"
 TABLET_SERVICE = "tserver"
@@ -94,7 +114,8 @@ class YBClient:
         addrs = ([self._master_leader] if self._master_leader else []) + [
             a for a in self._master_addrs if a != self._master_leader]
         last_err: Optional[Exception] = None
-        backoff = Backoff(base_s=0.1, cap_s=1.0)
+        backoff = Backoff(base_s=0.1, cap_s=1.0,
+                          deadline_s=_op_deadline_s())
         with Trace(f"client.master.{mth}"):
             return self._master_call_traced(mth, _retry_ctx, _timeout_s,
                                             addrs, last_err, backoff, args)
@@ -105,8 +126,16 @@ class YBClient:
             for addr in list(addrs):
                 try:
                     TRACE("client: master %s at %s", mth, addr)
+                    rem = backoff.remaining_s()
+                    att_timeout = _timeout_s
+                    if rem is not None:
+                        # one slow attempt must not blow the whole op
+                        # budget: clamp this attempt to what is left
+                        att_timeout = min(att_timeout, rem) \
+                            if att_timeout is not None else rem
                     ret = self._messenger.call(addr, MASTER_SERVICE, mth,
-                                               timeout_s=_timeout_s, **args)
+                                               timeout_s=att_timeout,
+                                               **args)
                     self._master_leader = addr
                     return ret
                 except RemoteError as e:
@@ -127,7 +156,11 @@ class YBClient:
                     last_err = e
                     continue
             self._master_leader = None
-            time.sleep(backoff.next_delay())  # jittered, not lockstep
+            if not backoff.sleep():  # jittered, not lockstep
+                # overall per-op deadline spent: surface instead of
+                # burning the remaining retry rounds against a wall
+                raise _deadline_exceeded(f"master.{mth}", backoff,
+                                         last_err)
         raise StatusError(Status.ServiceUnavailable(
             f"no reachable master leader for {mth} (last: {last_err})"))
 
@@ -311,7 +344,8 @@ class YBClient:
         if refresh_key is None:
             refresh_key = tablet.partition.start
         last_err: Optional[Exception] = None
-        backoff = Backoff(base_s=0.05, cap_s=1.0)
+        backoff = Backoff(base_s=0.05, cap_s=1.0,
+                          deadline_s=_op_deadline_s())
         # Root span of the distributed trace: the messenger stamps this
         # span's context on every attempt's wire header, so the tserver
         # handler (and the raft fan-out under it) stitches to one
@@ -328,8 +362,11 @@ class YBClient:
                 try:
                     TRACE("client: %s tablet %s at %s (attempt %d)",
                           mth, tablet.tablet_id, addr, attempt)
+                    rem = backoff.remaining_s()
+                    att_timeout = None if rem is None else min(
+                        rem, flags.get_flag("rpc_default_timeout_s"))
                     return self._messenger.call(
-                        addr, TABLET_SERVICE, mth,
+                        addr, TABLET_SERVICE, mth, timeout_s=att_timeout,
                         tablet_id=tablet.tablet_id, **args)
                 except RemoteError as e:
                     if e.extra.get("tablet_split") or \
@@ -371,7 +408,10 @@ class YBClient:
                     continue
             # All replicas failed: refresh locations and back off
             # (decorrelated jitter — concurrent clients desynchronize).
-            time.sleep(backoff.next_delay())
+            if not backoff.sleep():
+                raise _deadline_exceeded(
+                    f"{mth} on tablet {tablet.tablet_id}", backoff,
+                    last_err)
             tablet = self.meta_cache.lookup_tablet(
                 table.table_id, refresh_key, refresh=True)
         raise StatusError(Status.ServiceUnavailable(

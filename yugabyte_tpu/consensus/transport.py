@@ -7,15 +7,22 @@ The reference sends consensus traffic through its custom RPC framework
 
 - `LocalTransport`: in-process dispatch between peers in one interpreter
   (the MiniCluster path, ref rpc/local_call.h bypass), with fault injection
-  (partitions, drops) for failure tests, and
+  for failure tests, and
 - the host RPC layer (yugabyte_tpu/rpc) for real multi-process clusters.
+
+Fault semantics are shared with the RPC layer: LocalTransport delegates
+to the same `NemesisRules` engine (rpc/nemesis.py) the messenger
+consults, so chaos tests express symmetric/one-way partitions, drops,
+latency and duplicate delivery identically over both fabrics.
 """
 
 from __future__ import annotations
 
-import random
 import threading
-from typing import Callable, Dict, Optional, Set, Tuple
+from typing import Dict, Optional, Tuple
+
+from yugabyte_tpu.rpc.nemesis import (LinkBlocked, LinkDropped, LinkRule,
+                                      NemesisRules)
 
 
 class PeerUnreachable(Exception):
@@ -30,10 +37,11 @@ class LocalTransport:
         self._peers: Dict[str, object] = {}        # guarded-by: _lock
         self._lock = lock_rank.tracked(threading.Lock(),
                                        "local_transport._lock")
-        self._partitions: Set[Tuple[str, str]] = set()  # guarded-by: _lock
-        self._down: Set[str] = set()               # guarded-by: _lock
-        self._drop_probability = 0.0               # guarded-by: _lock
-        self._rng = random.Random(seed)            # guarded-by: _lock
+        # the shared fault-rule engine (same semantics as the messenger's
+        # nemesis hook); the drop-probability convenience keeps a handle
+        # to its rule so re-setting replaces instead of stacking
+        self.rules = NemesisRules(seed=seed)
+        self._drop_rule: Optional[LinkRule] = None  # guarded-by: _lock
 
     def register(self, peer_id: str, consensus: object) -> None:
         with self._lock:
@@ -48,65 +56,70 @@ class LocalTransport:
         return name in self._peers or \
             any(p.startswith(name + "/") for p in self._peers)
 
-    def partition(self, a: str, b: str) -> None:
+    def _require_known(self, what: str, *names: str) -> None:
         with self._lock:
-            # a silent no-op partition (name not matching any registered
-            # peer id) makes fault tests pass vacuously — fail loudly
-            for name in (a, b):
+            # a silent no-op fault (name not matching any registered peer
+            # id) makes fault tests pass vacuously — fail loudly
+            for name in names:
                 if self._peers and not self._known(name):
                     raise ValueError(
-                        f"partition({name!r}): no such peer; registered: "
+                        f"{what}({name!r}): no such peer; registered: "
                         f"{sorted(self._peers)}")
-            self._partitions.add((a, b))
-            self._partitions.add((b, a))
+
+    def partition(self, a: str, b: str, one_way: bool = False) -> None:
+        """Cut the a<->b link (or only a->b when one_way): faults match
+        the full consensus id ("ts0/t1") OR the server part ("ts0") — a
+        network partition cuts SERVERS, so tests express it per-server
+        and it applies to every tablet channel between them."""
+        self._require_known("partition", a, b)
+        self.rules.partition(a, b, one_way=one_way)
 
     def isolate(self, peer_id: str) -> None:
         """Cut peer_id off from everyone (crash-failure emulation)."""
-        with self._lock:
-            if self._peers and not self._known(peer_id):
-                raise ValueError(
-                    f"isolate({peer_id!r}): no such peer; registered: "
-                    f"{sorted(self._peers)}")
-            self._down.add(peer_id)
+        self._require_known("isolate", peer_id)
+        self.rules.isolate(peer_id)
 
     def heal(self) -> None:
         with self._lock:
-            self._partitions.clear()
-            self._down.clear()
+            self._drop_rule = None
+        self.rules.heal()
 
     def set_drop_probability(self, p: float) -> None:
+        """Drop every link's requests with probability p (0 clears)."""
         with self._lock:
-            self._drop_probability = p
+            old = self._drop_rule
+            self._drop_rule = None
+        if old is not None:
+            self.rules.remove_rule(old)
+        if p > 0:
+            rule = self.rules.add_rule(LinkRule("*", "*", drop_prob=p))
+            with self._lock:
+                self._drop_rule = rule
 
-    def _check_link(self, src: str, dst: str) -> object:
-        # Faults match the full consensus id ("ts0/t1") OR the server part
-        # ("ts0"): a network partition cuts SERVERS, so tests express it
-        # per-server and it applies to every tablet channel between them.
-        src_srv = src.split("/", 1)[0]
-        dst_srv = dst.split("/", 1)[0]
+    def set_latency(self, src: str, dst: str, delay_s: float,
+                    jitter_s: float = 0.0) -> None:
+        self._require_known("set_latency", src, dst)
+        self.rules.latency(src, dst, delay_s, jitter_s=jitter_s)
+
+    def set_duplicate_probability(self, src: str, dst: str,
+                                  p: float) -> None:
+        self._require_known("set_duplicate", src, dst)
+        self.rules.duplicate(src, dst, p)
+
+    def _check_link(self, src: str, dst: str) -> Tuple[object, object]:
+        try:
+            verdict = self.rules.check_link(src, dst)
+        except (LinkBlocked, LinkDropped) as e:
+            raise PeerUnreachable(f"{src}->{dst}: {e}") from e
         with self._lock:
-            down = self._down
-            if (src in down or dst in down
-                    or src_srv in down or dst_srv in down):
-                raise PeerUnreachable(f"{src}->{dst}: peer down")
-            parts = self._partitions
-            if ((src, dst) in parts or (src_srv, dst_srv) in parts
-                    or (src, dst_srv) in parts or (src_srv, dst) in parts):
-                # mixed-form entries (one bare server, one full id) match
-                # too — a stored pair that can never fire would silently
-                # un-partition the link
-                raise PeerUnreachable(f"{src}->{dst}: partitioned")
-            if self._drop_probability and \
-                    self._rng.random() < self._drop_probability:
-                raise PeerUnreachable(f"{src}->{dst}: dropped")
             peer = self._peers.get(dst)
         if peer is None:
             raise PeerUnreachable(f"{src}->{dst}: unknown peer")
-        return peer
+        return peer, verdict
 
     # ------------------------------------------------------------ dispatch
     def update_consensus(self, src: str, dst: str, request):
-        peer = self._check_link(src, dst)
+        peer, verdict = self._check_link(src, dst)
         ctx = getattr(request, "trace_ctx", None)
         if ctx is not None:
             # mirror the RPC path's inbound adoption: the in-process hop
@@ -114,8 +127,22 @@ class LocalTransport:
             # trace_id, so LocalTransport clusters trace like real ones
             from yugabyte_tpu.utils.trace import Trace
             with Trace.from_wire_context(ctx, f"consensus.update:{dst}"):
-                return peer.handle_update(request)
-        return peer.handle_update(request)
+                resp = peer.handle_update(request)
+        else:
+            resp = peer.handle_update(request)
+        if verdict.duplicate:
+            peer.handle_update(request)  # second delivery; resp discarded
+        if verdict.drop_response:
+            raise PeerUnreachable(f"{src}->{dst}: response dropped "
+                                  "(nemesis)")
+        return resp
 
     def request_vote(self, src: str, dst: str, request):
-        return self._check_link(src, dst).handle_vote_request(request)
+        peer, verdict = self._check_link(src, dst)
+        resp = peer.handle_vote_request(request)
+        if verdict.duplicate:
+            peer.handle_vote_request(request)
+        if verdict.drop_response:
+            raise PeerUnreachable(f"{src}->{dst}: response dropped "
+                                  "(nemesis)")
+        return resp

@@ -1,0 +1,153 @@
+"""Device-fault injection + classification for the kernel offload path.
+
+The storage-layer twin of utils/env.FaultInjectionEnv (PR 1): where that
+injects disk faults under the byte stack, this injects ACCELERATOR
+faults under the stage-B kernel path of the compaction pipeline —
+XLA compile errors, RESOURCE_EXHAUSTED (HBM OOM), and runtime dispatch
+faults — so tests can prove a mid-job device failure is contained
+(per-chunk retry, then a byte-identical native fallback + shape-bucket
+quarantine) instead of corrupting the writer.
+
+Sites:
+  - "dispatch": fired inside ops/run_merge.launch_merge_gc before the
+    fused program runs (where a real XLA compile error surfaces);
+  - "result":   fired when decisions are downloaded/decoded
+    (MergeGCHandle.result / the chunked handle's download paths) —
+    where an async runtime fault or OOM actually materializes, because
+    JAX dispatch is asynchronous and errors ride the value.
+
+Arming is programmatic (`arm()`) or via the environment for child
+processes: YBTPU_INJECT_DEVICE_FAULT="<kind>:<site>:<count>", e.g.
+"oom:result:1". Counts decrement per fire; count <= 0 disarms.
+
+`is_device_fault()` classifies BOTH injected and real device failures
+(jaxlib XlaRuntimeError, RESOURCE_EXHAUSTED messages) so the
+containment code in storage/compaction.py treats them uniformly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+__all__ = ["InjectedDeviceFault", "InjectedCompileError",
+           "InjectedResourceExhausted", "InjectedDispatchFault",
+           "arm", "disarm_all", "maybe_fault", "is_device_fault",
+           "armed_count"]
+
+
+class InjectedDeviceFault(Exception):
+    """Base for injected accelerator faults."""
+
+
+class InjectedCompileError(InjectedDeviceFault):
+    """Mimics an XLA lowering/compile failure of the fused program."""
+
+
+class InjectedResourceExhausted(InjectedDeviceFault):
+    """Mimics RESOURCE_EXHAUSTED: HBM allocation failure at dispatch."""
+
+
+class InjectedDispatchFault(InjectedDeviceFault):
+    """Mimics an asynchronous runtime fault surfacing on the value."""
+
+
+_KINDS = {
+    "compile": (InjectedCompileError,
+                "injected XLA compile failure (nemesis)"),
+    "oom": (InjectedResourceExhausted,
+            "RESOURCE_EXHAUSTED: injected HBM OOM (nemesis)"),
+    "runtime": (InjectedDispatchFault,
+                "injected device dispatch fault (nemesis)"),
+}
+
+_lock = threading.Lock()
+_armed: List[dict] = []   # guarded-by: _lock
+_env_loaded = False       # guarded-by: _lock
+
+
+def arm(kind: str, site: str = "dispatch", count: int = 1) -> None:
+    """Arm `count` faults of `kind` ('compile'|'oom'|'runtime') at `site`
+    ('dispatch'|'result'). Several armings stack."""
+    assert kind in _KINDS, kind
+    assert site in ("dispatch", "result"), site
+    with _lock:
+        _armed.append({"kind": kind, "site": site, "count": count})
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
+
+
+def armed_count() -> int:
+    with _lock:
+        return sum(max(0, a["count"]) for a in _armed)
+
+
+def _load_env_locked() -> None:  # guarded-by: _lock
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get("YBTPU_INJECT_DEVICE_FAULT", "")
+    if not spec:
+        return
+    for part in spec.split(","):
+        bits = part.strip().split(":")
+        if len(bits) >= 1 and bits[0] in _KINDS:
+            site = bits[1] if len(bits) > 1 else "dispatch"
+            try:
+                count = int(bits[2]) if len(bits) > 2 else 1
+            except ValueError:  # yblint: contained(malformed env count defaults to 1 — arming still happens)
+                count = 1
+            if site in ("dispatch", "result"):
+                _armed.append({"kind": bits[0], "site": site,
+                               "count": count})
+
+
+def maybe_fault(site: str) -> None:
+    """Raise the next armed fault for `site`, if any (decrements its
+    count). Called from the kernel launch/download hot points; a single
+    locked list check when nothing is armed."""
+    with _lock:
+        _load_env_locked()
+        if not _armed:
+            return
+        for a in _armed:
+            if a["site"] == site and a["count"] > 0:
+                a["count"] -= 1
+                if a["count"] <= 0:
+                    _armed.remove(a)
+                exc_type, msg = _KINDS[a["kind"]]
+                break
+        else:
+            return
+    _fault_counter(a["kind"]).increment()
+    raise exc_type(msg)
+
+
+def _fault_counter(kind: str):
+    from yugabyte_tpu.utils.metrics import kernel_metrics
+    return kernel_metrics().counter(
+        f"kernel_injected_fault_{kind}_total",
+        f"injected device faults of kind {kind}")
+
+
+def is_device_fault(exc: BaseException) -> bool:
+    """True for failures of the DEVICE path — injected or real — that the
+    compaction containment may survive via the native fallback. Cancel-
+    lation and ordinary host-side errors (OSError from the byte shell)
+    are NOT device faults: those take their own paths."""
+    if isinstance(exc, InjectedDeviceFault):
+        return True
+    from yugabyte_tpu.utils.cancellation import OperationCancelled
+    if isinstance(exc, OperationCancelled):
+        return False
+    name = type(exc).__name__
+    if name in ("XlaRuntimeError", "JaxRuntimeError"):
+        return True
+    msg = str(exc)
+    return ("RESOURCE_EXHAUSTED" in msg or "Mosaic" in msg
+            or "xla" in name.lower())

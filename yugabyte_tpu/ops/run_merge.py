@@ -559,9 +559,13 @@ def stage_runs_from_slabs(slabs: Sequence[KVSlab], device=None,
         # the accelerator H2D copy owns its bytes once the put completes;
         # block for it, then recycle the staging array (the next chunk's
         # stage-A pack reuses these pages instead of allocating). The CPU
-        # backend may alias host memory, so there the array just drops.
+        # backend may alias host memory, so there the array just drops —
+        # forget() ends the lease without recycling, so the outstanding-
+        # lease gauge (the chaos soak's leak detector) still drains.
         jax.block_until_ready(cols_dev)
         pool.release(cols)
+    else:
+        pool.forget(cols)
     return StagedRuns(cols_dev, m, k_pad, w, [s.n for s in live],
                       cmp_rows, n_cmp, run_maps=run_maps)
 
@@ -606,6 +610,28 @@ def stage_runs_from_staged(staged_list: Sequence[StagedCols]) -> StagedRuns:
     return StagedRuns(cat, m, k_pad, w, [s.n for s in live], cmp_rows, n_cmp)
 
 
+class DeviceFaultError(Exception):
+    """A device-path failure that survived its retry: the kernel path of
+    this job is broken (XLA compile error, HBM OOM, runtime dispatch
+    fault). Carries the shape-bucket key so the containment layer
+    (storage/compaction.py) can quarantine the bucket before taking the
+    byte-identical native fallback."""
+
+    def __init__(self, bucket: Tuple[int, int], cause: BaseException):
+        super().__init__(f"device merge failed after retry "
+                         f"(bucket k_pad={bucket[0]} m={bucket[1]}): "
+                         f"{cause!r}")
+        self.bucket = bucket
+        self.cause = cause
+
+
+def _chunk_retry_counter():
+    from yugabyte_tpu.utils.metrics import kernel_metrics
+    return kernel_metrics().counter(
+        "kernel_chunk_retry_total",
+        "per-chunk kernel retries after a device fault")
+
+
 class MergeGCHandle:
     """In-flight merge+GC launch: packed decisions transferring async.
 
@@ -616,7 +642,7 @@ class MergeGCHandle:
 
     def __init__(self, packed_dev, staged: StagedRuns,
                  perm_dev=None, keep_dev=None, mk_dev=None,
-                 host_async: bool = True):
+                 host_async: bool = True, relaunch=None):
         self._packed_dev = packed_dev
         self._staged = staged
         self._result = None
@@ -624,14 +650,30 @@ class MergeGCHandle:
         self._perm_dev = perm_dev
         self._keep_dev = keep_dev
         self._mk_dev = mk_dev
+        # retry-once hook: a closure re-dispatching the SAME launch (only
+        # set when the input buffer was not donated, so re-reading it is
+        # legal) — a transient device fault at download time gets one
+        # more attempt before the caller's native fallback
+        self._relaunch = relaunch
         if host_async:
             try:
                 packed_dev.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass  # no async D2H; result() falls back to sync
+            except (AttributeError, NotImplementedError):  # yblint: contained(backend lacks async D2H; result() falls back to the sync download)
+                pass
         # (a chunked parent fuses every chunk's packed buffer into ONE
         # device concat + download instead of calling result() per chunk —
         # each separate np.asarray pays a full tunnel round-trip)
+
+    def _download(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        from yugabyte_tpu.utils.metrics import record_pipeline_stage
+        import time as _time
+        t0 = _time.monotonic()
+        packed = np.asarray(self._packed_dev)  # [n_pad//32, 2+b]
+        t1 = _time.monotonic()
+        out = _decode_packed(packed, self._staged)
+        record_pipeline_stage("device", (t1 - t0) * 1e3)
+        record_pipeline_stage("host", (_time.monotonic() - t1) * 1e3)
+        return out
 
     def result(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(perm, keep, make_tombstone) host arrays over the merged order.
@@ -640,15 +682,26 @@ class MergeGCHandle:
         (padding excluded): merged position i came from input row perm[i].
         Arrays cover exactly the real rows (length n = sum(run_ns)).
         """
-        if self._result is None:
-            from yugabyte_tpu.utils.metrics import record_pipeline_stage
-            import time as _time
-            t0 = _time.monotonic()
-            packed = np.asarray(self._packed_dev)  # [n_pad//32, 2+b]
-            t1 = _time.monotonic()
-            self._result = _decode_packed(packed, self._staged)
-            record_pipeline_stage("device", (t1 - t0) * 1e3)
-            record_pipeline_stage("host", (_time.monotonic() - t1) * 1e3)
+        if self._result is not None:
+            return self._result
+        from yugabyte_tpu.ops import device_faults
+        try:
+            device_faults.maybe_fault("result")
+            self._result = self._download()
+        except Exception as e:  # noqa: BLE001 — device-fault containment
+            if self._relaunch is None or not device_faults.is_device_fault(e):
+                raise
+            # one retry of the same launch (jit-cached: re-dispatch is
+            # cheap); a second failure surfaces to the caller, which
+            # quarantines the bucket and falls back to the native merge
+            _chunk_retry_counter().increment()
+            from yugabyte_tpu.utils.trace import TRACE
+            TRACE("run_merge: device fault at download (%r) — retrying "
+                  "the launch once", e)
+            self._packed_dev, self._perm_dev, self._keep_dev, \
+                self._mk_dev = self._relaunch()
+            device_faults.maybe_fault("result")
+            self._result = self._download()
         return self._result
 
     def result_iter(self):
@@ -811,7 +864,7 @@ def _chunk_target_rows() -> int:
         return (1 << 20) if jax.default_backend() == "tpu" else 0
     try:
         t = int(env)
-    except ValueError:
+    except ValueError:  # yblint: contained(malformed env override falls back to the platform default target)
         return (1 << 20) if jax.default_backend() == "tpu" else 0
     return t if t >= 1024 else 0
 
@@ -909,7 +962,8 @@ class _ChunkedMergeGCHandle:
     ~130 MB output-column re-upload that skipping write-through would
     cost every subsequent compaction."""
 
-    def __init__(self, handles, metas, staged: StagedRuns):
+    def __init__(self, handles, metas, staged: StagedRuns,
+                 params=None, snapshot: bool = False, carve=None):
         self._handles = handles          # one per chunk, dispatch order
         self._metas = metas              # (starts[k_live], lens[k_live])
         self._staged = staged
@@ -917,6 +971,49 @@ class _ChunkedMergeGCHandle:
         self._perm_dev = None
         self._keep_dev = None
         self._mk_dev = None
+        # re-carve info for per-chunk device-fault retry: the chunk
+        # buffers themselves are donated (their HBM is gone after the
+        # launch), but the PARENT matrix is intact, so a failed chunk is
+        # re-carved from it and re-dispatched once
+        self._params = params
+        self._snapshot = snapshot
+        self._carve = carve              # (starts_full, lens_full, m_c)
+
+    def _result_with_retry(self, i: int):
+        """Chunk i's (perm, keep, mk) with ONE device-fault retry: re-carve
+        the chunk from the intact parent matrix and re-dispatch. A second
+        failure raises DeviceFaultError so the compaction layer can
+        quarantine the shape bucket and fall back to the native merge."""
+        from yugabyte_tpu.ops import device_faults
+        h = self._handles[i]
+        try:
+            return h.result()
+        except Exception as e:  # noqa: BLE001 — device-fault containment
+            if self._carve is None or not device_faults.is_device_fault(e):
+                raise
+            _chunk_retry_counter().increment()
+            from yugabyte_tpu.utils.trace import TRACE
+            TRACE("run_merge: chunk %d device fault (%r) — re-carving "
+                  "and retrying once", i, e)
+            staged = self._staged
+            starts, lens, m_c = self._carve[i]
+            k_live = len(staged.run_ns)
+            try:
+                carved = _carve_chunk(
+                    staged.cols_dev, jnp.asarray(starts),
+                    jnp.asarray(lens), staged.m, m_c, staged.k_pad)
+                sub = StagedRuns(carved, m_c, staged.k_pad, staged.w,
+                                 [int(x) for x in lens[:k_live]],
+                                 staged.cmp_rows, staged.n_cmp)
+                h2 = launch_merge_gc(sub, self._params,
+                                     snapshot=self._snapshot,
+                                     host_async=False, donate=True)
+                out = h2.result()
+            except Exception as e2:  # noqa: BLE001 — retry exhausted
+                raise DeviceFaultError(
+                    (staged.k_pad, staged.m), e2) from e2
+            self._handles[i] = h2   # memoized passes reuse the good run
+            return out
 
     def _chunk_results(self):
         """Per-chunk (perm, keep, mk) host tuples — via ONE fused device
@@ -926,8 +1023,13 @@ class _ChunkedMergeGCHandle:
         degrades to the per-chunk path, which preserves the pallas ->
         network fallback semantics."""
         hs = self._handles
-        if os.environ.get("YBTPU_FUSED_DOWNLOAD", "1") == "0":
-            return [h.result() for h in hs]
+        from yugabyte_tpu.ops import device_faults
+        if os.environ.get("YBTPU_FUSED_DOWNLOAD", "1") == "0" \
+                or device_faults.armed_count():
+            # armed fault injection takes the per-chunk path, where the
+            # injection sites and the re-carve retry live — the fused
+            # concat would bypass both
+            return [self._result_with_retry(i) for i in range(len(hs))]
         try:
             import time as _time
             from yugabyte_tpu.utils.metrics import record_pipeline_stage
@@ -949,7 +1051,7 @@ class _ChunkedMergeGCHandle:
             import sys as _sys
             print(f"[run_merge] fused chunk download failed — using the "
                   f"per-chunk path: {e!r}", file=_sys.stderr, flush=True)
-        return [h.result() for h in hs]
+        return [self._result_with_retry(i) for i in range(len(hs))]
 
     def _remap_perm(self, p: np.ndarray, starts: np.ndarray,
                     lens: np.ndarray) -> np.ndarray:
@@ -1004,8 +1106,8 @@ class _ChunkedMergeGCHandle:
                 except (AttributeError, NotImplementedError):
                     pass
         perms, keeps, mks = [], [], []
-        for h, (starts, lens) in zip(self._handles, self._metas):
-            p, keep, mk = h.result()
+        for i, (starts, lens) in enumerate(self._metas):
+            p, keep, mk = self._result_with_retry(i)
             perm_g = self._remap_perm(p, starts, lens)
             perms.append(perm_g)
             keeps.append(keep)
@@ -1088,7 +1190,7 @@ def _launch_chunked(staged: StagedRuns, params: GCParams, snapshot: bool,
     m_c = run_bucket(int(lens_all.max()))
     if m_c >= m:
         return None                                  # no shape win: skew
-    handles, metas = [], []
+    handles, metas, carve = [], [], []
     for c in range(nc):
         starts = bounds[:, c].astype(np.int32)
         lens = lens_all[:, c].astype(np.int32)
@@ -1109,9 +1211,12 @@ def _launch_chunked(staged: StagedRuns, params: GCParams, snapshot: bool,
                                        host_async=False, donate=True))
         metas.append((starts[:k_live].astype(np.int64),
                       lens[:k_live].astype(np.int64)))
+        carve.append((starts, lens, m_c))
     if not handles:
         return None
-    return _ChunkedMergeGCHandle(handles, metas, staged)
+    return _ChunkedMergeGCHandle(handles, metas, staged,
+                                 params=params, snapshot=snapshot,
+                                 carve=carve)
 
 
 _probe_winners = None  # guarded-by: _probe_lock
@@ -1145,7 +1250,7 @@ def _load_probe_winners() -> dict:
                         if net:
                             winners[lg] = \
                                 "pallas" if v > net else "network"
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, KeyError):  # yblint: contained(absent/corrupt probe artifact means no measured winners — auto impl choice falls back to its default)
             pass
         _probe_winners = winners
         return _probe_winners
@@ -1269,6 +1374,11 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
                 "merge jobs split into route-partitioned chunk "
                 "launches").increment()
             return h
+    # device-fault injection site "dispatch" (ops/device_faults.py): a
+    # real XLA compile failure surfaces here, synchronously, per leaf
+    # launch (each chunk of a chunked job passes through this point)
+    from yugabyte_tpu.ops import device_faults
+    device_faults.maybe_fault("dispatch")
     explicit = os.environ.get("YBTPU_MERGE_IMPL", "auto") == "pallas"
     if (not _pallas_broken or explicit) and _pick_impl(staged) == "pallas":
         from yugabyte_tpu.ops import pallas_merge
@@ -1311,15 +1421,20 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
                     snapshot, use_donate))
     # runtime iota operand: see merge_network's pos docstring (compile-
     # time constant folding of per-stage parity masks)
-    pos = jnp.arange(staged.n_pad, dtype=jnp.int32)
-    packed, perm, keep, mk = fn(
-        staged.cols_dev, jnp.asarray(staged.cmp_rows), pos,
-        jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
-        jnp.uint32(cutoff_phys >> 20), jnp.uint32(cutoff_phys & 0xFFFFF),
-        k_pad=staged.k_pad, m=staged.m, w=staged.w, n_cmp=staged.n_cmp,
-        is_major=params.is_major_compaction,
-        retain_deletes=params.retain_deletes, snapshot=snapshot,
-        lexsort=lexsort)
+    def _dispatch():
+        pos = jnp.arange(staged.n_pad, dtype=jnp.int32)
+        return fn(
+            staged.cols_dev, jnp.asarray(staged.cmp_rows), pos,
+            jnp.uint32(cutoff >> 32), jnp.uint32(cutoff & 0xFFFFFFFF),
+            jnp.uint32(cutoff_phys >> 20),
+            jnp.uint32(cutoff_phys & 0xFFFFF),
+            k_pad=staged.k_pad, m=staged.m, w=staged.w,
+            n_cmp=staged.n_cmp,
+            is_major=params.is_major_compaction,
+            retain_deletes=params.retain_deletes, snapshot=snapshot,
+            lexsort=lexsort)
+
+    packed, perm, keep, mk = _dispatch()
     if use_donate:
         # the dispatch above consumed cols_dev (XLA reuses its HBM);
         # poison it in the handle's staged copy so a later read — e.g.
@@ -1329,8 +1444,13 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
         import dataclasses as _dc
         staged = _dc.replace(
             staged, cols_dev=_DonatedBuffer("_merge_gc_runs_fused_donated"))
+    # non-donated launches keep a relaunch closure: the input buffer is
+    # intact, so a device fault at download time gets one re-dispatch
+    # before the caller's native fallback (chunked jobs instead re-carve
+    # from the parent in _ChunkedMergeGCHandle._result_with_retry)
     return MergeGCHandle(packed, staged, perm, keep, mk,
-                         host_async=host_async)
+                         host_async=host_async,
+                         relaunch=None if use_donate else _dispatch)
 
 
 def merge_and_gc_runs(slabs: Sequence[KVSlab], params: GCParams, device=None,

@@ -423,7 +423,7 @@ class _ClientConnection:
             w["event"].set()
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
+        except OSError:  # yblint: contained(socket already dead — close() below still releases the fd)
             pass
         self.sock.close()
 
@@ -479,6 +479,13 @@ class Messenger:
         self._rpcz_inflight: Dict[int, dict] = {}  # guarded-by: _rpcz_lock
         from collections import deque
         self._rpcz_recent: deque = deque(maxlen=100)  # guarded-by: _rpcz_lock
+        # responses undeliverable because the caller disconnected first
+        # (op fate unknown at the caller — the retryable-request dedup
+        # window); counted so chaos soaks can assert the path is exercised
+        self._responses_dropped = self._metrics.entity(
+            "server", f"messenger.{name}").counter(
+            "rpc_responses_dropped_total",
+            "inbound-call responses dropped because the caller was gone")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=f"rpc-accept-{name}")
         self._accept_thread.start()
@@ -564,8 +571,15 @@ class Messenger:
         resp["id"] = req["id"]
         try:
             _send_message(conn, write_lock, resp)
-        except OSError:
-            pass  # caller gone; response dropped like an expired call
+        except OSError as e:
+            # Caller gone (closed its connection / died mid-call): the
+            # response is dropped like an expired call. NOT silent — the
+            # caller will retry as op-fate-unknown, so chaos runs need to
+            # see how often this ambiguity window actually opens.
+            self._responses_dropped.increment()
+            TRACE("rpc %s: response to %s.%s call %s dropped, caller "
+                  "gone: %s", self.name, req.get("svc"), req.get("mth"),
+                  req.get("id"), e)
 
     def _method_histogram(self, svc: str, mth: str):
         key = (svc, mth)
@@ -643,7 +657,7 @@ class Messenger:
         try:
             ret = method(**args)
             return {"code": Code.OK.value, "err": "", "ret": ret, "extra": {}}
-        except StatusError as e:
+        except StatusError as e:  # yblint: contained(routed over the wire — the status code + message cross to the caller, which raises RemoteError)
             return {"code": e.status.code.value, "err": e.status.message,
                     "ret": None, "extra": getattr(e, "extra", {}) or {}}
         except Exception as e:  # noqa: BLE001 — remote errors cross the wire
@@ -671,15 +685,51 @@ class Messenger:
             with child:
                 resp = self._invoke_inner(svc, mth, args)
         else:
+            # Network nemesis (rpc/nemesis.py): an installed fault-rule
+            # table may partition/drop/delay/duplicate this call. The
+            # check is a single None test when no chaos run is active.
+            from yugabyte_tpu.rpc import nemesis as _nemesis
+            nem = _nemesis.active()
+            verdict = None
+            if nem is not None:
+                try:
+                    verdict = nem.check_link(self.name, addr)
+                except _nemesis.LinkBlocked as e:
+                    raise ServiceUnavailable(str(e)) from e
+                except _nemesis.LinkDropped as e:
+                    # request lost in flight: the op's fate is unknown to
+                    # the caller, exactly like a real timeout (fast-
+                    # forwarded — see nemesis module docstring)
+                    raise RpcTimeout(f"{svc}.{mth} to {addr}: {e}") from e
             host, port_s = addr.rsplit(":", 1)
             conn = self._get_conn((host, int(port_s)))
             try:
                 resp = conn.call(svc, mth, args, timeout_s,
                                  trace_ctx=trace_to_wire(
                                      current_trace_context()))
+                if verdict is not None and verdict.duplicate:
+                    # duplicate delivery: the remote executes twice; the
+                    # first response is the one the caller consumes (the
+                    # retryable-request layer must dedup the second
+                    # apply). A failure of the DUPLICATE must not fail
+                    # the original call — real networks drop duplicates.
+                    try:
+                        conn.call(svc, mth, args, timeout_s,
+                                  trace_ctx=trace_to_wire(
+                                      current_trace_context()))
+                    except (RpcTimeout, ServiceUnavailable,
+                            RemoteError) as e:
+                        TRACE("nemesis: duplicate delivery of %s.%s "
+                              "failed (%s); original response stands",
+                              svc, mth, e)
             except ServiceUnavailable:
                 self._drop_conn(conn)
                 raise
+            if verdict is not None and verdict.drop_response:
+                # delivered + executed, response lost: surface the same
+                # ambiguity a real lost response produces
+                raise RpcTimeout(f"{svc}.{mth} to {addr}: response "
+                                 "dropped (nemesis)")
         code = Code(resp["code"])
         if code != Code.OK:
             raise RemoteError(Status(code, resp["err"]),

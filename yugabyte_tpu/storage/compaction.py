@@ -177,7 +177,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                        block_entries: Optional[int] = None, device_cache=None,
                        input_ids: Optional[Sequence[int]] = None,
                        mesh=None, offload_policy=None, run_cache=None,
-                       _no_combined: bool = False) -> CompactionResult:
+                       _no_combined: bool = False,
+                       cancel=None) -> CompactionResult:
     """The compaction job (ref: CompactionJob::Run, compaction_job.cc:442).
 
     new_file_id: callable returning the next file id (VersionSet.new_file_id).
@@ -188,7 +189,13 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     distributed_compaction_min_rows fan their subcompactions across it
     (parallel/dist_compact.py), the mesh analog of the reference's
     subcompaction threads (compaction_job.cc:456-468).
+    cancel: a utils/cancellation.CancellationToken — DB shutdown or a
+    tablet-FAILED transition aborts the job at the next stage boundary
+    (OperationCancelled; partial outputs are cleaned up, nothing is
+    installed).
     """
+    if cancel is not None:
+        cancel.check()
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None
     if (offload_policy is not None and device is not None
@@ -226,7 +233,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
                 all_inputs, out_dir, new_file_id, history_cutoff_ht,
                 is_major, retain_deletes, device=device,
                 block_entries=block_entries, device_cache=device_cache,
-                input_ids=orig_input_ids, run_cache=run_cache)
+                input_ids=orig_input_ids, run_cache=run_cache,
+                cancel=cancel)
     inputs, dropped = filter_expired_inputs(
         inputs, history_cutoff_ht, is_major, retain_deletes)
     dropped_rows = sum(r.props.n_entries for r in dropped)
@@ -247,7 +255,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
             result = _run_native_job(inputs, out_dir, new_file_id,
                                      history_cutoff_ht, is_major,
                                      retain_deletes, block_entries,
-                                     frontier_inputs=all_inputs)
+                                     frontier_inputs=all_inputs,
+                                     cancel=cancel)
             result.rows_in += dropped_rows
             return result
     slabs = [r.read_all() for r in inputs]
@@ -334,6 +343,8 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
     tombstone_value = Value.tombstone().encode()
     for start in range(0, rows_out, max_rows):
+        if cancel is not None:
+            cancel.check()
         end = min(start + max_rows, rows_out)
         sel = surv[start:end]
         out_slab = _gather_slab(merged, sel, tomb_flags[start:end], tombstone_value)
@@ -370,12 +381,14 @@ class _StreamingNativeWriter:
     through finish(), which never pace-sleeps after the last file."""
 
     def __init__(self, job, out_dir: str, new_file_id, fr,
-                 block_entries: Optional[int], has_deep: bool = False):
+                 block_entries: Optional[int], has_deep: bool = False,
+                 cancel=None):
         self._job = job
         self._out_dir = out_dir
         self._new_file_id = new_file_id
         self._fr = fr
         self._has_deep = has_deep
+        self._cancel = cancel
         self._block_entries = (block_entries if block_entries is not None
                                else flags.get_flag("sst_block_entries"))
         self._max_rows = flags.get_flag(
@@ -390,6 +403,10 @@ class _StreamingNativeWriter:
         import time as _time
         from yugabyte_tpu.storage.sst import data_file_name, write_base_file
         from yugabyte_tpu.utils.metrics import record_pipeline_stage
+        if self._cancel is not None:
+            # file-split boundary: the clean abort point of stage C —
+            # already-written files are swept by the caller's unwind
+            self._cancel.check()
         t0 = _time.monotonic()
         fid = self._new_file_id()
         base_path = os.path.join(self._out_dir, f"{fid:06d}.sst")
@@ -432,7 +449,7 @@ class _StreamingNativeWriter:
 
 def _write_native_outputs(job, out_dir: str, new_file_id, fr,
                           block_entries: Optional[int],
-                          has_deep: bool = False
+                          has_deep: bool = False, cancel=None
                           ) -> Tuple[List[Tuple[int, str, SSTProps]],
                                      List[Tuple[int, int]]]:
     """Write the native job's survivors as (possibly split) output SSTs,
@@ -446,15 +463,16 @@ def _write_native_outputs(job, out_dir: str, new_file_id, fr,
     device write-through gathers exactly these spans; re-deriving them
     from the flag would silently desync if the flag changes mid-job)."""
     writer = _StreamingNativeWriter(job, out_dir, new_file_id, fr,
-                                    block_entries, has_deep=has_deep)
+                                    block_entries, has_deep=has_deep,
+                                    cancel=cancel)
     return writer.finish(job.n_survivors)
 
 
 def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
                     history_cutoff_ht: int, is_major: bool,
                     retain_deletes: bool, block_entries: Optional[int],
-                    frontier_inputs: Optional[Sequence[SSTReader]] = None
-                    ) -> CompactionResult:
+                    frontier_inputs: Optional[Sequence[SSTReader]] = None,
+                    cancel=None) -> CompactionResult:
     """Full-native compaction: the byte path (decode/merge/encode) runs in
     C++ (native/compaction_engine.cc); Python assembles base files and
     frontiers. Same outputs as the Python shell, ~10x less wall."""
@@ -462,6 +480,8 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
 
     with native_engine.NativeCompactionJob() as job:
         for r in inputs:
+            if cancel is not None:
+                cancel.check()
             with open(r.data_path, "rb") as f:
                 job.add_input(f.read(), r.block_handles)
         rows_in = job.prepare()
@@ -471,7 +491,8 @@ def _run_native_job(inputs: Sequence[SSTReader], out_dir: str, new_file_id,
             history_cutoff_ht)
         outputs, _ranges = _write_native_outputs(
             job, out_dir, new_file_id, fr, block_entries,
-            has_deep=any(r.props.has_deep for r in inputs))
+            has_deep=any(r.props.has_deep for r in inputs),
+            cancel=cancel)
     return CompactionResult(outputs, rows_in, rows_out)
 
 
@@ -481,7 +502,7 @@ def run_compaction_job_device_native(
         retain_deletes: bool = False, device=None,
         block_entries: Optional[int] = None, device_cache=None,
         input_ids: Optional[Sequence[int]] = None,
-        run_cache=None) -> CompactionResult:
+        run_cache=None, cancel=None) -> CompactionResult:
     """The production hot path: TPU decisions + native byte shell.
 
     The device kernel (ops/run_merge.py) computes merge+GC decisions from
@@ -509,7 +530,7 @@ def run_compaction_job_device_native(
                                   block_entries=block_entries,
                                   device_cache=device_cache,
                                   input_ids=input_ids,
-                                  _no_combined=True)
+                                  _no_combined=True, cancel=cancel)
 
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None
@@ -535,12 +556,76 @@ def run_compaction_job_device_native(
                                   block_entries=block_entries,
                                   device_cache=device_cache,
                                   input_ids=orig_input_ids,
-                                  _no_combined=True)
+                                  _no_combined=True, cancel=cancel)
 
-    import threading
-    import time as _time
-    from yugabyte_tpu.utils.metrics import record_pipeline_stage
+    from yugabyte_tpu.storage import offload_policy as offload_policy_mod
+    from yugabyte_tpu.utils.trace import TRACE
+    qkey = offload_policy_mod.bucket_key(
+        run_merge.packed_run_ns([r.props.n_entries for r in inputs]))
+    if offload_policy_mod.bucket_quarantine().is_quarantined(qkey):
+        # this shape bucket's kernel path faulted recently: native-only
+        # until the quarantine window decays (surfaced on /compactionz)
+        TRACE("compaction: shape bucket k_pad=%d m=%d is quarantined "
+              "after a device fault — routing native", *qkey)
+        return run_compaction_job(all_inputs, out_dir, new_file_id,
+                                  history_cutoff_ht, is_major,
+                                  retain_deletes, device="native",
+                                  block_entries=block_entries,
+                                  input_ids=orig_input_ids,
+                                  _no_combined=True, cancel=cancel)
 
+    try:
+        return _device_native_attempt(
+            inputs, all_inputs, input_ids, dropped_rows, out_dir,
+            new_file_id, history_cutoff_ht, is_major, retain_deletes,
+            device, block_entries, device_cache, run_cache, cancel)
+    except Exception as e:  # noqa: BLE001 — device-fault containment
+        from yugabyte_tpu.ops import device_faults
+        from yugabyte_tpu.ops.run_merge import DeviceFaultError
+        if not (isinstance(e, DeviceFaultError)
+                or device_faults.is_device_fault(e)):
+            # host-side failures (disk faults, cancellation) take their
+            # own containment paths — only KERNEL-path faults may fall
+            # back to the native merge
+            raise
+        cause = e.cause if isinstance(e, DeviceFaultError) else e
+        offload_policy_mod.bucket_quarantine().quarantine(
+            qkey, reason=f"{type(cause).__name__}: {cause}")
+        _storage_fallback_counter().increment()
+        TRACE("compaction: device fault mid-job (%r) — shape bucket "
+              "k_pad=%d m=%d quarantined; completing via the native "
+              "merge", cause, *qkey)
+        # Byte-identical completion: the attempt unwound cleanly (its
+        # partial outputs deleted, staging leases released), so the
+        # whole job re-runs on the native path over the SAME filtered
+        # inputs — the differential-tested twin of the kernel path.
+        result = _run_native_job(inputs, out_dir, new_file_id,
+                                 history_cutoff_ht, is_major,
+                                 retain_deletes, block_entries,
+                                 frontier_inputs=all_inputs,
+                                 cancel=cancel)
+        result.rows_in += dropped_rows
+        return result
+
+def _storage_fallback_counter():
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    return ROOT_REGISTRY.entity("server", "offload_policy").counter(
+        "compaction_device_fallback_total",
+        "compactions completed via the native merge after a mid-job "
+        "device fault")
+
+
+def _device_native_attempt(
+        inputs, all_inputs, input_ids, dropped_rows: int, out_dir: str,
+        new_file_id, history_cutoff_ht: int, is_major: bool,
+        retain_deletes: bool, device, block_entries, device_cache,
+        run_cache, cancel) -> CompactionResult:
+    """One attempt of the pipelined device+native job (the body of
+    run_compaction_job_device_native). UNWINDS CLEANLY on any failure or
+    cancellation: every output file it wrote is deleted before the
+    exception propagates, so the caller can fall back to the native
+    merge (device fault) or abort (shutdown) without leaking partial
+    SSTs into the version set's directory."""
     pipeline = os.environ.get("YBTPU_PIPELINE", "1").lower() \
         not in ("0", "false", "off")
 
@@ -559,6 +644,44 @@ def run_compaction_job_device_native(
             cached_ids = ids
 
     tombstone_value = Value.tombstone().encode()
+    state = {"writer": None}
+    try:
+        return _device_native_body(
+            inputs, all_inputs, input_ids, dropped_rows, out_dir,
+            new_file_id, history_cutoff_ht, is_major, retain_deletes,
+            device, block_entries, device_cache, run_cache, cancel,
+            pipeline, cached_ids, tombstone_value, state)
+    except BaseException:
+        # clean unwind: delete every output file this attempt wrote, so
+        # a device-fault fallback or a cancellation leaves no partial
+        # SSTs behind (staging-pool leases were already released by
+        # stage_runs_from_slabs' own unwind)
+        w = state["writer"]
+        if w is not None:
+            from yugabyte_tpu.storage.sst import data_file_name
+            for _fid, base_path, _props in w.outputs:
+                for p in (base_path, data_file_name(base_path)):
+                    try:
+                        os.remove(p)
+                    except OSError:  # yblint: contained(unwind cleanup of partial outputs; the file may not exist yet)
+                        pass
+        raise
+
+
+def _device_native_body(
+        inputs, all_inputs, input_ids, dropped_rows: int, out_dir: str,
+        new_file_id, history_cutoff_ht: int, is_major: bool,
+        retain_deletes: bool, device, block_entries, device_cache,
+        run_cache, cancel, pipeline: bool, cached_ids,
+        tombstone_value: bytes, state: dict) -> CompactionResult:
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.ops.merge_gc import stage_slab
+    from yugabyte_tpu.storage import native_engine
+
+    import threading
+    import time as _time
+    from yugabyte_tpu.utils.metrics import record_pipeline_stage
+
     with native_engine.NativeCompactionJob() as job:
         # -- stage A (host): the native shell ingests the input bytes on
         # its own thread — file reads, block decode and CRC all release
@@ -587,6 +710,10 @@ def run_compaction_job_device_native(
                     ingest["rows_in"] = job.prepare_cached()
                 else:
                     for r in inputs:
+                        if cancel is not None:
+                            # input boundary: shutdown aborts the ingest
+                            # before paying for the next file read
+                            cancel.check()
                         with open(r.data_path, "rb") as f:
                             job.add_input(f.read(), r.block_handles)
                     ingest["rows_in"] = job.prepare()
@@ -640,6 +767,8 @@ def run_compaction_job_device_native(
             staged_list = []
             for i, (r, fid) in enumerate(
                     zip(inputs, input_ids or [None] * len(inputs))):
+                if cancel is not None:
+                    cancel.check()  # before each per-input device upload
                 st = device_cache.get(fid) if (device_cache is not None
                                                and fid is not None) else None
                 if st is None:
@@ -674,11 +803,14 @@ def run_compaction_job_device_native(
                               history_cutoff_ht)
         has_deep = any(r.props.has_deep for r in inputs)
         tombstones_written = 0
+        writer = _StreamingNativeWriter(job, out_dir, new_file_id, fr,
+                                        block_entries, has_deep=has_deep,
+                                        cancel=cancel)
+        state["writer"] = writer   # the attempt's unwind sweeps .outputs
         if pipeline:
-            writer = _StreamingNativeWriter(job, out_dir, new_file_id, fr,
-                                            block_entries,
-                                            has_deep=has_deep)
             for perm_c, keep_c, mk_c in handle.result_iter():
+                if cancel is not None:
+                    cancel.check()  # chunk boundary: abort in-flight job
                 surv = perm_c[keep_c]
                 mk_surv = mk_c[keep_c]
                 tombstones_written += int(np.count_nonzero(mk_surv))
@@ -691,9 +823,7 @@ def run_compaction_job_device_native(
             tombstones_written = int(np.count_nonzero(mk[keep]))
             job.set_survivors(perm[keep], mk[keep])
             rows_out = job.n_survivors
-            outputs, ranges = _write_native_outputs(
-                job, out_dir, new_file_id, fr, block_entries,
-                has_deep=has_deep)
+            outputs, ranges = writer.finish(job.n_survivors)
         if run_cache is not None:
             # run-cache write-through: exported survivors are
             # byte-equivalent to re-decoding the files just written, so

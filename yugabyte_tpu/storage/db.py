@@ -192,6 +192,14 @@ class DB:
         self._lock = threading.RLock()
         self._compacting = False
         self._closed = False
+        # Cancellation seam for in-flight background work: close() and a
+        # tablet-FAILED transition (cancel_background_work) flip it, and
+        # the compaction pipeline checks it at every stage boundary — an
+        # in-flight offloaded job aborts cleanly (partial outputs swept,
+        # staging leases released, nothing installed) instead of racing
+        # shutdown to the filesystem.
+        from yugabyte_tpu.utils.cancellation import CancellationToken
+        self._cancel = CancellationToken(f"compaction@{db_dir}")
         self._pins: dict = {}       # file_id -> active scan count
         self._obsolete: dict = {}   # file_id -> reader awaiting unpin+delete
         # Runs after the memtable swap, before this DB's SST installs. The
@@ -261,12 +269,27 @@ class DB:
         if cb is not None:
             cb(st)
 
+    def cancel_background_work(self, reason: str = "shutdown") -> None:
+        """Abort in-flight background compactions at their next stage
+        boundary (tablet-FAILED transition, shutdown). One-way until
+        retry_background_work re-arms a fresh token."""
+        self._cancel.cancel(reason)
+
     def retry_background_work(self) -> bool:
         """Clear the parked error and retry the failed work (the
         maintenance manager drives this with capped backoff, ref
         DBImpl::Resume). Returns True when the DB is healthy again; a
         failing retry re-parks it."""
         with self._lock:
+            if self._cancel.cancelled and not self._closed:
+                # recovery re-arms the cancellation seam for the retried
+                # background work (the old token is permanently tripped;
+                # re-armed even without a parked error — a tablet-FAILED
+                # cancel may have fired without this DB itself erroring)
+                from yugabyte_tpu.utils.cancellation import (
+                    CancellationToken)
+                self._cancel = CancellationToken(
+                    f"compaction@{self.db_dir}")
             if self._bg_error is None:
                 return True
             self._bg_error = None
@@ -797,7 +820,17 @@ class DB:
         try:
             self._run_compaction_inner(pick)
         except BaseException as e:
+            from yugabyte_tpu.utils.cancellation import OperationCancelled
             from yugabyte_tpu.utils.status import StatusError
+            if isinstance(e, OperationCancelled):
+                # CLEAN abort (shutdown / tablet-FAILED): nothing was
+                # installed and the job unwound its own partials; sweep
+                # any stragglers but do NOT park the DB — this is not a
+                # storage fault.
+                with self._lock:
+                    self._sweep_orphan_outputs_unlocked()
+                TRACE("db %s: compaction aborted: %s", self.db_dir, e)
+                return
             if not isinstance(e, (OSError, StatusError)):
                 raise
             # Contained like a failed flush: the version set still points
@@ -819,7 +852,8 @@ class DB:
                 input_ids=[fm.file_id for fm in pick.inputs],
                 mesh=self.opts.mesh,
                 offload_policy=self.opts.offload_policy,
-                run_cache=self._run_cache)
+                run_cache=self._run_cache,
+                cancel=self._cancel)
             from yugabyte_tpu.utils import sync_point
             sync_point.hit("db.compaction:before_install")
             with self._lock:
@@ -902,6 +936,10 @@ class DB:
                             os.path.join(out_dir, "MANIFEST"))
 
     def close(self) -> None:
+        # trip the cancellation seam FIRST: an in-flight pipelined
+        # compaction aborts at its next stage boundary instead of writing
+        # into a directory whose readers we are about to close
+        self._cancel.cancel("db closed")
         with self._lock:
             self._closed = True
             # native handles free via refcount (in-flight scans may still

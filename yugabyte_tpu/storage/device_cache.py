@@ -164,6 +164,10 @@ class HostStagingPool:
         from yugabyte_tpu.utils import lock_rank
         self._free: dict = {}              # guarded-by: _lock
         self._bytes = 0                    # guarded-by: _lock
+        # ids of arrays acquired and not yet released/forgotten — the
+        # chaos harness's leak detector: after every job (including a
+        # cancelled or device-faulted one) this must drain back to 0
+        self._leases: set = set()          # guarded-by: _lock
         self._max_per_shape = max_per_shape
         self._max_bytes = max_bytes
         self._lock = lock_rank.tracked(threading.Lock(),
@@ -176,6 +180,9 @@ class HostStagingPool:
         self._c_alloc = e.counter(
             "staging_pool_alloc_total",
             "stage-A packings that allocated a fresh host array")
+        self._g_leases = e.gauge(
+            "staging_pool_outstanding_lease_count",
+            "staging arrays acquired and not yet released")
 
     def acquire(self, shape: Tuple[int, int], dtype=np.uint32) -> np.ndarray:
         key = (tuple(shape), np.dtype(dtype).str)
@@ -184,19 +191,42 @@ class HostStagingPool:
             if bucket:
                 arr = bucket.pop()
                 self._bytes -= arr.nbytes
+                self._leases.add(id(arr))
+                self._g_leases.set(len(self._leases))
                 self._c_reuse.increment()
                 return arr
+        arr = np.empty(shape, dtype=dtype)
+        with self._lock:
+            self._leases.add(id(arr))
+            self._g_leases.set(len(self._leases))
         self._c_alloc.increment()
-        return np.empty(shape, dtype=dtype)
+        return arr
 
     def release(self, arr: np.ndarray) -> None:
         key = (arr.shape, arr.dtype.str)
         with self._lock:
+            self._leases.discard(id(arr))
+            self._g_leases.set(len(self._leases))
             bucket = self._free.setdefault(key, [])
             if (len(bucket) < self._max_per_shape
                     and self._bytes + arr.nbytes <= self._max_bytes):
                 bucket.append(arr)
                 self._bytes += arr.nbytes
+
+    def forget(self, arr: np.ndarray) -> None:
+        """End a lease WITHOUT recycling the pages: the CPU backend may
+        alias the array's memory into the device buffer, so the caller
+        hands the array off for garbage collection instead of release().
+        Not a leak — the lease is accounted done."""
+        with self._lock:
+            self._leases.discard(id(arr))
+            self._g_leases.set(len(self._leases))
+
+    def outstanding(self) -> int:
+        """Leases neither released nor forgotten — the chaos soak asserts
+        this returns to zero after fault windows heal."""
+        with self._lock:
+            return len(self._leases)
 
 
 _staging_pool: Optional[HostStagingPool] = None  # guarded-by: _staging_pool_lock

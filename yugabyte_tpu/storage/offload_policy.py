@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from yugabyte_tpu.utils import flags
 
@@ -35,6 +37,10 @@ flags.define_flag("offload_calibration_path", "",
                   "conservative policy")
 flags.define_flag("device_offload_mode", "auto",
                   "auto = measured policy; device/native = force")
+flags.define_flag("device_fault_quarantine_s", 300.0,
+                  "how long a shape bucket stays native-only after a "
+                  "device fault in its kernel path (timed decay; the "
+                  "next job after expiry re-proves the bucket)")
 
 DEFAULT_CALIBRATION_FILE = "offload_calibration.json"
 
@@ -168,3 +174,119 @@ class OffloadPolicy:
                 "device_rows_per_sec": round(device_rate, 1),
                 "native_rows_per_sec": round(native_rate, 1),
                 "platform": platform}) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# Shape-bucket quarantine: device-fault containment's memory. When the
+# kernel path of a compaction fails (XLA compile error, HBM OOM, runtime
+# dispatch fault) the job completes via the native fallback — and the
+# failing SHAPE BUCKET is parked native-only for a decay window, so every
+# subsequent job that would compile/launch the same poisoned executable
+# routes straight to native instead of re-failing (the RESYSTANCE lesson
+# applied to faults: observe where the device path breaks and steer work
+# around it). The bucket key is the padded run layout (k_pad, m) — the
+# dominant part of the fused program's compile key.
+
+class BucketQuarantine:
+    """Timed native-only quarantine of kernel shape buckets."""
+
+    def __init__(self):
+        from yugabyte_tpu.utils import lock_rank
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "offload_policy.quarantine_lock")
+        # bucket -> {"until": monotonic, "reason": str, "faults": int,
+        #            "since": wall}  # guarded-by: _lock
+        self._entries: dict = {}
+
+    def quarantine(self, bucket: Tuple[int, ...], reason: str,
+                   ttl_s: Optional[float] = None) -> None:
+        ttl = ttl_s if ttl_s is not None else \
+            flags.get_flag("device_fault_quarantine_s")
+        with self._lock:
+            e = self._entries.get(bucket)
+            self._entries[bucket] = {
+                "until": time.monotonic() + ttl,
+                "reason": reason,
+                "faults": (e["faults"] + 1) if e else 1,
+                "since": time.time(),
+            }
+        _quarantine_counter("added").increment()
+
+    def is_quarantined(self, bucket: Tuple[int, ...]) -> bool:
+        """True while the bucket's window is open; expired entries decay
+        (are dropped) on the first check past their deadline."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._entries.get(bucket)
+            if e is None:
+                return False
+            if now >= e["until"]:
+                del self._entries[bucket]   # timed decay: re-prove it
+                decayed = True
+            else:
+                decayed = False
+        if decayed:
+            _quarantine_counter("decayed").increment()
+            return False
+        _quarantine_counter("hits").increment()
+        return True
+
+    def snapshot(self) -> List[dict]:
+        """Open quarantine windows for /compactionz (expired entries are
+        pruned here too, so the page never shows a decayed bucket)."""
+        now = time.monotonic()
+        with self._lock:
+            for b in [b for b, e in self._entries.items()
+                      if now >= e["until"]]:
+                del self._entries[b]
+            return [{"bucket": list(b), "reason": e["reason"],
+                     "faults": e["faults"],
+                     "remaining_s": round(e["until"] - now, 1),
+                     "since": e["since"]}
+                    for b, e in sorted(self._entries.items())]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+def _quarantine_counter(what: str):
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    helps = {"added": "shape buckets parked native-only after a device "
+                      "fault",
+             "hits": "compactions routed native because their shape "
+                     "bucket is quarantined",
+             "decayed": "quarantine windows that expired (bucket "
+                        "eligible for the device path again)"}
+    return ROOT_REGISTRY.entity("server", "offload_policy").counter(
+        f"offload_quarantine_{what}_total", helps[what])
+
+
+def bucket_key(run_ns) -> Tuple[int, int]:
+    """The quarantine key for a job with (packed) run lengths run_ns:
+    (k_pad, m) of the run-major layout — computed the same way
+    ops/run_merge.stage_runs_from_slabs lays the matrix out, WITHOUT
+    staging anything, so the pre-dispatch check and the fault-time
+    quarantine agree on the key."""
+    from yugabyte_tpu.ops.run_merge import run_bucket
+    live = [n for n in run_ns if n]
+    if not live:
+        return (0, 0)
+    k = len(live)
+    k_pad = 1 << max(0, (k - 1).bit_length()) if k > 1 else 1
+    m = max(run_bucket(n) for n in live)
+    return (k_pad, m)
+
+
+_quarantine: Optional[BucketQuarantine] = None  # guarded-by: _quarantine_lock
+_quarantine_lock = threading.Lock()
+
+
+def bucket_quarantine() -> BucketQuarantine:
+    """Process-wide quarantine registry (one per process, like the slab
+    cache — a bucket poisoned under one tablet is poisoned for all)."""
+    global _quarantine
+    with _quarantine_lock:
+        if _quarantine is None:
+            _quarantine = BucketQuarantine()
+        return _quarantine

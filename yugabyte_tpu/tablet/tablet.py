@@ -695,6 +695,14 @@ class Tablet:
             return None
         return docs[len(docs) // 2]
 
+    def cancel_background_work(self, reason: str = "tablet failed") -> None:
+        """Abort in-flight background compactions of both DBs at their
+        next pipeline-stage boundary (tablet-FAILED / shutdown): a dying
+        tablet must not keep a device-offload job running against
+        storage that is about to be torn down or re-bootstrapped."""
+        self.regular_db.cancel_background_work(reason)
+        self.intents_db.cancel_background_work(reason)
+
     def close(self) -> None:
         self.regular_db.close()
         self.intents_db.close()

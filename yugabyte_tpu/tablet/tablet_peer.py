@@ -266,11 +266,15 @@ class TabletPeer:
 
     def mark_failed(self, status: Status) -> None:
         """Transition to FAILED: writes reject retryably, reads drain, the
-        next heartbeat reports the state so the master can re-replicate."""
+        next heartbeat reports the state so the master can re-replicate.
+        In-flight background compactions (including the device-offload
+        pipeline) are cancelled at their next stage boundary."""
         if self.state == STATE_FAILED:
             return
         self.state = STATE_FAILED
         self.failed_status = status
+        self.tablet.cancel_background_work(
+            f"tablet {self.tablet_id} FAILED: {status}")
         TRACE("tablet %s FAILED: %s", self.tablet_id, status)
 
     def _check_not_failed(self) -> None:

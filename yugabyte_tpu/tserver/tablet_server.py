@@ -177,8 +177,22 @@ class TabletServer:
             "kernel_compile_bucket_misses_total",
             "first launches of a shape bucket (compile or persistent-"
             "cache load)").value()
+        # device-fault containment: shape buckets parked native-only
+        # after a kernel-path fault (timed decay), plus how often the
+        # mid-job native fallback and the per-chunk retry actually fired
+        from yugabyte_tpu.storage.compaction import (
+            _storage_fallback_counter)
+        from yugabyte_tpu.storage.offload_policy import bucket_quarantine
+        device_faults = {
+            "quarantined_buckets": bucket_quarantine().snapshot(),
+            "native_fallbacks": _storage_fallback_counter().value(),
+            "chunk_retries": ke.counter(
+                "kernel_chunk_retry_total",
+                "per-chunk kernel retries after a device fault").value(),
+        }
         return {"server_id": self.server_id, "totals": totals,
-                "pipeline": pipeline, "tablets": tablets}
+                "pipeline": pipeline, "device_faults": device_faults,
+                "tablets": tablets}
 
     def _status_page(self) -> dict:
         if self.exec_context is not None:

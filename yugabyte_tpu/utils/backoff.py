@@ -50,6 +50,14 @@ class Backoff:
         return (self._deadline is not None
                 and time.monotonic() >= self._deadline)
 
+    def remaining_s(self) -> Optional[float]:
+        """Seconds left until the deadline; None when unbounded. Callers
+        clamp per-attempt RPC timeouts to this so one slow attempt
+        cannot blow the whole op budget."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
     def next_delay(self) -> float:
         """Draw the next delay (decorrelated jitter), deadline-clamped."""
         self.attempts += 1
@@ -73,25 +81,50 @@ class RetrySchedule:
 
     Unlike Backoff (one bounded loop), this survives across scheduler
     polls: the maintenance manager asks ready() each round, performs the
-    recovery attempt when it fires, and records the outcome."""
+    recovery attempt when it fires, and records the outcome.
+
+    deadline_s bounds the WHOLE schedule to an overall per-op budget:
+    record_failure clamps each delay to the remaining budget (never
+    scheduling an attempt past the deadline), and once the budget is
+    spent `expired` turns True / ready() turns False — the owner must
+    surface DeadlineExceeded instead of retrying forever."""
 
     def __init__(self, initial_s: float = 0.5, max_s: float = 30.0,
-                 rng=None):
+                 deadline_s: Optional[float] = None, rng=None):
         self.initial_s = initial_s
         self.max_s = max_s
+        self._deadline = (None if deadline_s is None
+                          else time.monotonic() + deadline_s)
         self._rng = rng if rng is not None else random
         self.failures = 0
         self._next_attempt = 0.0  # monotonic time; 0 = immediately ready
 
+    @property
+    def expired(self) -> bool:
+        return (self._deadline is not None
+                and time.monotonic() >= self._deadline)
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds left in the overall budget; None when unbounded."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
     def ready(self) -> bool:
+        if self.expired:
+            return False  # budget spent: surface, don't retry
         return time.monotonic() >= self._next_attempt
 
     def record_failure(self) -> float:
         """Push the next attempt out by initial * 2^n (capped), with a
-        +-25% jitter so many parked tablets don't retry in lockstep.
-        Returns the chosen delay."""
+        +-25% jitter so many parked tablets don't retry in lockstep;
+        clamped to the remaining per-op budget so the schedule never
+        waits past its deadline. Returns the chosen delay."""
         delay = min(self.max_s, self.initial_s * (2 ** self.failures))
         delay *= self._rng.uniform(0.75, 1.25)
+        rem = self.remaining_s()
+        if rem is not None:
+            delay = min(delay, rem)
         self.failures += 1
         self._next_attempt = time.monotonic() + delay
         return delay
