@@ -37,6 +37,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
+def _surface_counts_for_report():
+    """Per-family declared compile-surface executable counts from the
+    committed kernel manifest — reported next to compile_bucket_* so a
+    run proves the warm cache covers exactly the reviewed surface."""
+    from yugabyte_tpu.storage.offload_policy import declared_surface_counts
+    return declared_surface_counts()
+
+
 def log(msg):
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
@@ -484,11 +492,22 @@ def run_device_child(platform: str, workload_path: str,
                 "kernel_compile_bucket_hits_total", "").value()
             bucket_misses = ke.counter(
                 "kernel_compile_bucket_misses_total", "").value()
+            # declared compile surface (committed kernel manifest) next
+            # to the hit/miss counters: a warm run's misses must stay
+            # within the manifest's executable count, proving the cache
+            # covers exactly the reviewed surface
+            from yugabyte_tpu.storage.offload_policy import (
+                declared_surface_counts)
+            from yugabyte_tpu.utils.metrics import publish_compile_surface
+            surface_counts = declared_surface_counts()
+            publish_compile_surface(surface_counts)
+            surface_total = sum(surface_counts.values())
             log(f"  pipeline stages over steady jobs: "
                 f"host {stage_ms.get('host', 0):.0f}ms / device "
                 f"{stage_ms.get('device', 0):.0f}ms / write "
                 f"{stage_ms.get('write', 0):.0f}ms; compile buckets "
-                f"{bucket_hits} hits / {bucket_misses} misses")
+                f"{bucket_hits} hits / {bucket_misses} misses "
+                f"(manifest surface: {surface_total} executables)")
             stages.put(stage="e2e_steady", e2e_steady=e2e_steady,
                        e2e_steady2=e2e_steady2,
                        e2e_rows=e2e_rows, e2e_n=e2e_n,
@@ -496,7 +515,8 @@ def run_device_child(platform: str, workload_path: str,
                        stage_device_ms=stage_ms.get("device", 0.0),
                        stage_write_ms=stage_ms.get("write", 0.0),
                        compile_bucket_hits=bucket_hits,
-                       compile_bucket_misses=bucket_misses)
+                       compile_bucket_misses=bucket_misses,
+                       compile_surface_buckets=surface_total)
             e2e_cold, _ = run_dn("cold", False)
             log(f"  e2e cold ({platform}+native shell): "
                 f"{e2e_cold/1e6:.2f}M rows/s")
@@ -575,6 +595,9 @@ def run_device_child(platform: str, workload_path: str,
         "stage_write_ms": stage_ms.get("write", 0.0),
         "compile_bucket_hits": bucket_hits,
         "compile_bucket_misses": bucket_misses,
+        # per-family declared compile-surface counts (committed kernel
+        # manifest; also exported as kernel_compile_surface gauges)
+        "compile_surface_buckets": _surface_counts_for_report(),
         "e2e_n_rows": e2e_n,
         "n_rows": n_total,
     }), flush=True)
@@ -959,7 +982,8 @@ def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
             recs["e2e_steady"].get("e2e_steady2", 0.0), 1)
         out["e2e_n_rows"] = recs["e2e_steady"]["e2e_n"]
         for k in ("stage_host_ms", "stage_device_ms", "stage_write_ms",
-                  "compile_bucket_hits", "compile_bucket_misses"):
+                  "compile_bucket_hits", "compile_bucket_misses",
+                  "compile_surface_buckets"):
             if k in recs["e2e_steady"]:
                 out[k] = recs["e2e_steady"][k]
         out["value"] = max(out["e2e_steady_rows_per_sec"],

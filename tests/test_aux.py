@@ -44,6 +44,10 @@ def table(cluster):
     client.create_namespace("db")
     t = client.create_table("db", "t", SCHEMA, num_tablets=2)
     cluster.wait_all_replicas_running(t.table_id)
+    # deadline-poll READY raft leaders (master's replica view can lead
+    # the tservers' election state): the first writes below must not
+    # race the elections against the client retry budget
+    cluster.wait_for_table_leaders("db", "t")
     for i in range(50):
         client.write(t, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i:03d}"),
                                    {"v": f"v{i}", "n": i})])

@@ -37,13 +37,17 @@ def server(cluster):
 
 
 @pytest.fixture(scope="module")
-def schema_ready(server):
+def schema_ready(cluster, server):
     c = CqlWireClient(server.host, server.port)
     c.execute("CREATE KEYSPACE IF NOT EXISTS wire_ks")
     c.execute("USE wire_ks")
     c.execute("CREATE TABLE IF NOT EXISTS t1 (id INT PRIMARY KEY, "
               "name TEXT, score DOUBLE) WITH tablets = 2")
     c.close()
+    # deadline-poll READY raft leaders before the first INSERTs: on a
+    # loaded single-core runner a fresh tablet's election can outlast
+    # the client retry budget (the known leadership-timing flake)
+    cluster.wait_for_table_leaders("wire_ks", "t1")
     return True
 
 

@@ -172,6 +172,7 @@ def test_cluster_online_encryption_enablement(tmp_path):
         schema = Schema([ColumnSchema("k", DataType.STRING),
                          ColumnSchema("v", DataType.STRING)], 1, 0)
         t = client.create_table("e", "t", schema, num_tablets=1)
+        mc.wait_for_table_leaders("e", "t")  # don't race the election
         client.write(t, [QLWriteOp(WriteOpKind.INSERT,
                                    DocKey(hash_components=("before",)),
                                    {"v": "plaintext-era"})])
@@ -180,6 +181,7 @@ def test_cluster_online_encryption_enablement(tmp_path):
         # a tablet created AFTER enablement writes encrypted WAL segments
         # (already-open plaintext segments keep appending until they roll)
         t2 = client.create_table("e", "t2", schema, num_tablets=1)
+        mc.wait_for_table_leaders("e", "t2")  # don't race the election
         marker = "POSTENCRYPTIONSECRET"
         for i in range(30):
             client.write(t2, [QLWriteOp(

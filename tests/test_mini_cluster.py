@@ -43,6 +43,8 @@ def table(cluster):
     client.create_namespace("db")
     table = client.create_table("db", "kv", SCHEMA, num_tablets=4)
     cluster.wait_all_replicas_running(table.table_id)
+    # READY-leader deadline poll: module tests write immediately
+    cluster.wait_for_table_leaders("db", "kv")
     return table
 
 

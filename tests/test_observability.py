@@ -398,6 +398,7 @@ def test_write_trace_stitches_across_cluster(tmp_path):
         client.create_namespace("tr")
         t = client.create_table("tr", "t", SCHEMA, num_tablets=1)
         mc.wait_all_replicas_running(t.table_id)
+        mc.wait_for_table_leaders("tr", "t")  # don't race the election
         with Trace("test-write-root") as root:
             client.write(t, [QLWriteOp(
                 WriteOpKind.INSERT, DocKey(hash_components=("kx",)),

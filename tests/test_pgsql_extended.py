@@ -37,10 +37,14 @@ def server(cluster):
 
 
 @pytest.fixture(scope="module")
-def seeded(server):
+def seeded(cluster, server):
     c = PgWireClient("127.0.0.1", server.port)
     c.query("CREATE TABLE sales (id INT PRIMARY KEY, region TEXT, "
             "amount INT)")
+    # READY-leader deadline poll before the INSERT burst (the known
+    # leadership-timing flake shape: CREATE via a query layer, then
+    # immediate writes racing the first election)
+    cluster.wait_for_table_leaders("postgres", "sales")
     for i in range(20):
         c.query(f"INSERT INTO sales (id, region, amount) VALUES "
                 f"({i}, 'r{i % 3}', {i * 10})")

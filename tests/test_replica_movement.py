@@ -49,6 +49,7 @@ def test_manual_remote_bootstrap_and_config_change(cluster):
     client.create_namespace("db")
     table = client.create_table("db", "t", SCHEMA, num_tablets=1)
     cluster.wait_all_replicas_running(table.table_id)
+    cluster.wait_for_table_leaders("db", "t")  # don't race the election
     for i in range(30):
         client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i}"),
                                        {"v": f"v{i}"})])
@@ -92,6 +93,7 @@ def test_load_balancer_repairs_dead_tserver(cluster):
     client.create_namespace("db2")
     table = client.create_table("db2", "t", SCHEMA, num_tablets=2)
     cluster.wait_all_replicas_running(table.table_id)
+    cluster.wait_for_table_leaders("db2", "t")  # don't race the election
     for i in range(20):
         client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i}"),
                                        {"v": f"v{i}"})])

@@ -41,9 +41,12 @@ def cql(cluster):
     return proc
 
 
-def test_cql_index_lifecycle(cql):
+def test_cql_index_lifecycle(cql, cluster):
     cql.execute("CREATE TABLE users (id INT PRIMARY KEY, city TEXT, "
                 "age INT) WITH tablets = 2")
+    # READY-leader deadline poll before the INSERT burst (leadership-
+    # timing flake shape: CREATE via the query layer, immediate writes)
+    cluster.wait_for_table_leaders("idx_ks", "users")
     for i in range(40):
         cql.execute(f"INSERT INTO users (id, city, age) "
                     f"VALUES ({i}, 'c{i % 4}', {20 + i})")

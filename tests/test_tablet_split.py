@@ -48,6 +48,7 @@ def test_split_end_to_end(cluster):
     client.create_namespace("db")
     table = client.create_table("db", "t", SCHEMA, num_tablets=1)
     cluster.wait_all_replicas_running(table.table_id)
+    cluster.wait_for_table_leaders("db", "t")  # don't race the election
     for i in range(N_ROWS):
         client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(f"k{i:03d}"),
                                        {"v": f"v{i}"})])
@@ -103,6 +104,7 @@ def test_write_during_split_is_rerouted(cluster):
     client.create_namespace("db2")
     table = client.create_table("db2", "t", SCHEMA, num_tablets=1)
     cluster.wait_all_replicas_running(table.table_id)
+    cluster.wait_for_table_leaders("db2", "t")  # don't race the election
     session_keys = [f"a{i:03d}" for i in range(40)]
     for k in session_keys:
         client.write(table, [QLWriteOp(WriteOpKind.INSERT, dk(k),

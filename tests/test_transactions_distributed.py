@@ -53,6 +53,7 @@ def env(cluster):
     client.create_namespace("bank")
     table = client.create_table("bank", "accounts", SCHEMA, num_tablets=4)
     cluster.wait_all_replicas_running(table.table_id)
+    cluster.wait_for_table_leaders("bank", "accounts")  # no election race
     manager = TransactionManager(client)
     manager.status_table()  # force creation up front
     return cluster, client, table, manager

@@ -40,7 +40,7 @@ def main(argv=None) -> int:
                     "(jit trace-safety, lock discipline, reactor "
                     "blocking, swallowed errors, metric names, donation "
                     "safety, error propagation, resource lifetime, "
-                    "wire drift)")
+                    "wire drift, kernel contracts)")
     ap.add_argument("targets", nargs="*", default=list(DEFAULT_TARGETS),
                     help="files or directories relative to the repo root "
                          f"(default: {' '.join(DEFAULT_TARGETS)})")

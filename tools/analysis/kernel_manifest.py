@@ -1,0 +1,800 @@
+"""Kernel compile-surface manifest: the statically-checked contract for
+every jitted kernel family.
+
+ROADMAP item 5 demands that "every new kernel family must land inside the
+bucket/prewarm/cache discipline" — this module turns that discipline from
+tribal knowledge into a committed artifact plus two checks:
+
+- `generate()` (device-free; run under JAX_PLATFORMS=cpu) enumerates the
+  declared bucket lattice of every kernel family — the shapes
+  `prewarm_buckets` warms, the chunk buckets `_chunk_target_rows` re-lands
+  big jobs on, the radix/scan/gather side families — and
+  `jax.eval_shape`/`.lower()`s each (kernel, bucket) pair.  NO device
+  execution, no compilation: only abstract evaluation and StableHLO
+  emission.  The result — input/output avals, static-arg signature,
+  donation aliasing, a lowering fingerprint, prewarm coverage and the
+  offload-policy quarantine key — is committed as
+  `tools/analysis/kernel_manifest.json`.
+
+- `check_manifest()` (pure stdlib, no jax import, sub-second) recomputes
+  per-family SOURCE fingerprints over the AST of the symbols that define
+  each family's compile surface and compares them (plus the budgets and
+  the lattice invariants) against the committed JSON.  Any kernel change
+  that could move the compile surface therefore fails tier-1 until the
+  manifest is regenerated — making surface growth a reviewed decision
+  (the diff of kernel_manifest.json) instead of an accident.
+
+The compile-surface BUDGET is the distinct-executable count per family
+(entries x their boolean/impl variant axes).  Exceeding it fails both
+regeneration and the committed-JSON check; raising a budget is a one-line
+reviewed edit here.
+
+CLI:  python -m tools.analysis.kernel_manifest --check   (fast, no jax)
+                                               --verify  (regen+compare)
+                                               --write   (regenerate)
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+MANIFEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "kernel_manifest.json")
+MANIFEST_RELPATH = "tools/analysis/kernel_manifest.json"
+
+_RUN_MERGE = "yugabyte_tpu/ops/run_merge.py"
+_MERGE_GC = "yugabyte_tpu/ops/merge_gc.py"
+_SCAN = "yugabyte_tpu/ops/scan.py"
+_PALLAS = "yugabyte_tpu/ops/pallas_merge.py"
+_DIST = "yugabyte_tpu/parallel/dist_compact.py"
+_POLICY = "yugabyte_tpu/storage/offload_policy.py"
+
+# Per-family compile-surface definition: which source symbols shape the
+# lowered program (fingerprinted for the fast drift gate), the budget
+# (max distinct executables the declared lattice may mint), and where a
+# drift finding anchors.  gc_over_sorted is shared by every merge family:
+# editing the GC half re-fingerprints all of them, which is exactly right.
+FAMILIES: Dict[str, dict] = {
+    "run_merge_fused": {
+        "budget": 36,
+        "anchor": _RUN_MERGE,
+        "symbols": {
+            _RUN_MERGE: [
+                "_merge_gc_runs_impl", "merge_network", "_lex_gt",
+                "_FUSED_STATICS", "_merge_gc_runs_fused",
+                "_merge_gc_runs_fused_donated", "quantize_width",
+                "_quantize_cmp", "_CMP_LATTICE", "_cmp_schedule",
+                "_PREWARM_SHAPES", "prewarm_buckets", "run_bucket",
+                "_chunk_target_rows",
+            ],
+            _MERGE_GC: ["gc_over_sorted", "pack_bits_u32", "pad_template"],
+        },
+    },
+    "merge_gc_fused": {
+        "budget": 8,
+        "anchor": _MERGE_GC,
+        "symbols": {
+            _MERGE_GC: [
+                "_merge_gc_fused", "sort_and_gc", "gc_over_sorted",
+                "bucket_size", "build_sort_schedule", "full_sort_sequence",
+            ],
+        },
+    },
+    "scan_fused": {
+        "budget": 16,
+        "anchor": _SCAN,
+        "symbols": {
+            _SCAN: ["_scan_fused", "_pack_bound"],
+            _MERGE_GC: ["sort_and_gc", "gc_over_sorted", "bucket_size"],
+        },
+    },
+    "gather_staged": {
+        "budget": 8,
+        "anchor": _RUN_MERGE,
+        "symbols": {
+            _RUN_MERGE: ["_survivor_positions", "_gather_staged_output"],
+            _MERGE_GC: ["bucket_size", "pad_template"],
+        },
+    },
+    "pallas_merge": {
+        "budget": 12,
+        "anchor": _PALLAS,
+        "symbols": {
+            _PALLAS: [
+                "_pallas_merge_gc_fused", "_merge_level",
+                "_make_tile_kernel", "_compute_splits", "default_tile",
+                "supported",
+            ],
+            _MERGE_GC: ["gc_over_sorted"],
+        },
+    },
+    "chunk_carve": {
+        "budget": 8,
+        "anchor": _RUN_MERGE,
+        "symbols": {
+            _RUN_MERGE: ["_chunk_split_search", "_carve_chunk",
+                         "_W_ROUTE_CHUNK", "_chunk_target_rows"],
+            _MERGE_GC: ["route_word_mask", "pad_template"],
+        },
+    },
+    "dist_compact": {
+        # mesh-dependent: the shard_map program cannot be abstractly
+        # evaluated without a real device mesh, so this family is
+        # fingerprint-only — its compile-key lattice (capacity quantized
+        # to powers of two, n_shards from the mesh) is declared, not
+        # enumerated, and drift is caught at the source level.
+        "budget": None,
+        "anchor": _DIST,
+        "symbols": {
+            _DIST: ["dist_compact_fn", "distributed_compact", "_W_ROUTE",
+                    "_SAMPLES_PER_SHARD"],
+            _MERGE_GC: ["sort_and_gc", "gc_over_sorted",
+                        "route_word_mask"],
+        },
+    },
+}
+
+# the row layout constant (ops/merge_gc.py): 8 metadata rows + key words
+_ROW_WORDS = 8
+_CMP_LATTICE = (2, 4, 6, 8, 12, 16, 24, 32)
+
+
+# ---------------------------------------------------------------------------
+# Source fingerprints (pure stdlib — the fast tier-1 gate must not pay a
+# jax import, let alone a trace)
+# ---------------------------------------------------------------------------
+
+def _strip_docstrings(node: ast.AST) -> ast.AST:
+    """Remove docstring Exprs so comment-grade edits don't trip the gate
+    (the fingerprint must move only when the lowered program could)."""
+    for n in ast.walk(node):
+        body = getattr(n, "body", None)
+        if isinstance(body, list) and body \
+                and isinstance(body[0], ast.Expr) \
+                and isinstance(body[0].value, ast.Constant) \
+                and isinstance(body[0].value.value, str):
+            del body[0]
+            if not body:
+                body.append(ast.Pass())
+    return node
+
+
+def _module_symbols(source: str) -> Dict[str, ast.AST]:
+    """Top-level name -> def/assign node of one module."""
+    out: Dict[str, ast.AST] = {}
+    tree = ast.parse(source)
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out[stmt.name] = stmt
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name):
+            out[stmt.target.id] = stmt
+    return out
+
+
+def source_fingerprint(family: str, root: str = REPO_ROOT,
+                       source_overrides: Optional[Dict[str, str]] = None
+                       ) -> str:
+    """sha256 over the (docstring-stripped, position-free) AST dumps of
+    the family's surface-defining symbols.  `source_overrides` maps a
+    relpath to replacement source text (synthetic-drift tests)."""
+    h = hashlib.sha256()
+    spec = FAMILIES[family]["symbols"]
+    for relpath in sorted(spec):
+        if source_overrides and relpath in source_overrides:
+            src = source_overrides[relpath]
+        else:
+            with open(os.path.join(root, relpath), encoding="utf-8") as fh:
+                src = fh.read()
+        symbols = _module_symbols(src)
+        for name in sorted(spec[relpath]):
+            node = symbols.get(name)
+            dump = ("<missing>" if node is None else
+                    ast.dump(_strip_docstrings(node),
+                             include_attributes=False))
+            h.update(f"{relpath}:{name}={dump}\n".encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Lattice invariants (pure): a declared/warmed bucket must sit ON the
+# quantization lattice — a shape off it warms (or budgets) nothing real.
+# ---------------------------------------------------------------------------
+
+def _is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def bucket_lattice_errors(bucket: Dict[str, int]) -> List[str]:
+    """Violations of the (k_pad, m, w, n_cmp) lattice for a run-merge
+    shaped bucket; empty means the bucket is a valid lattice point."""
+    errs: List[str] = []
+    k_pad = bucket.get("k_pad")
+    m = bucket.get("m")
+    w = bucket.get("w")
+    n_cmp = bucket.get("n_cmp")
+    if k_pad is not None and not _is_pow2(int(k_pad)):
+        errs.append(f"k_pad={k_pad} is not a power of two")
+    if m is not None and (not _is_pow2(int(m)) or int(m) < 256):
+        errs.append(f"m={m} is not a power-of-two run bucket >= 256")
+    if w is not None and (not _is_pow2(int(w)) or int(w) < 4):
+        errs.append(f"w={w} is not a quantize_width point (pow2 >= 4)")
+    if n_cmp is not None and int(n_cmp) not in _CMP_LATTICE:
+        errs.append(f"n_cmp={n_cmp} is not on the _CMP_LATTICE "
+                    f"{_CMP_LATTICE}")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# The fast committed-JSON check (tier-1; < 5s because it never imports jax)
+# ---------------------------------------------------------------------------
+
+def load_manifest(path: str = MANIFEST_PATH) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+_UNSET = object()
+
+
+def check_manifest(manifest=_UNSET,
+                   root: str = REPO_ROOT,
+                   source_overrides: Optional[Dict[str, str]] = None
+                   ) -> List[Tuple[str, str, str]]:
+    """(family, code, message) problems with the committed manifest vs the
+    current sources.  Codes: manifest-missing, manifest-drift,
+    budget-exceeded, budget-drift, off-lattice-bucket, family-missing.
+    Omit `manifest` to check the committed JSON; an explicit None means
+    "the manifest file is missing"."""
+    if manifest is _UNSET:
+        manifest = load_manifest()
+    problems: List[Tuple[str, str, str]] = []
+    if manifest is None:
+        return [("run_merge_fused", "manifest-missing",
+                 f"{MANIFEST_RELPATH} is missing or unparseable — "
+                 "regenerate with `python -m tools.analysis."
+                 "kernel_manifest --write`")]
+    fams = manifest.get("families", {})
+    for name, spec in FAMILIES.items():
+        rec = fams.get(name)
+        if rec is None:
+            problems.append((name, "family-missing",
+                             f"kernel family {name!r} has no manifest "
+                             "record — regenerate the manifest"))
+            continue
+        fp = source_fingerprint(name, root, source_overrides)
+        if rec.get("source_fingerprint") != fp:
+            problems.append((
+                name, "manifest-drift",
+                f"compile surface of {name!r} changed (source "
+                "fingerprint mismatch) without regenerating "
+                f"{MANIFEST_RELPATH} — run `python -m tools.analysis."
+                "kernel_manifest --write`, review the surface diff, and "
+                "commit it"))
+        if rec.get("budget") != spec["budget"]:
+            problems.append((
+                name, "budget-drift",
+                f"{name!r} budget in the manifest ({rec.get('budget')}) "
+                f"disagrees with the declared budget ({spec['budget']}) "
+                "— regenerate the manifest"))
+        n_exec = rec.get("distinct_executables")
+        if spec["budget"] is not None and n_exec is not None \
+                and n_exec > spec["budget"]:
+            problems.append((
+                name, "budget-exceeded",
+                f"{name!r} declares {n_exec} distinct executables, over "
+                f"its compile-surface budget of {spec['budget']} — "
+                "shrink the lattice or raise the budget (a reviewed "
+                "decision) in tools/analysis/kernel_manifest.py"))
+        for entry in rec.get("entries", ()):
+            errs = bucket_lattice_errors(entry.get("bucket", {}))
+            for e in errs:
+                problems.append((name, "off-lattice-bucket",
+                                 f"{name} bucket {entry.get('key')}: {e}"))
+    return problems
+
+
+def entry_key(bucket: Dict[str, int], impl: str = "") -> str:
+    parts = [f"{k}={bucket[k]}" for k in sorted(bucket)]
+    if impl:
+        parts.append(f"impl={impl}")
+    return " ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Generation (device-free: eval_shape + lower only; run with
+# JAX_PLATFORMS=cpu — the CLI below forces it before importing jax)
+# ---------------------------------------------------------------------------
+
+def _aval_str(x) -> str:
+    shape = "x".join(str(d) for d in x.shape)
+    return f"{x.dtype.name}[{shape}]" if shape else f"{x.dtype.name}[]"
+
+
+def _lowering_sha256(lowered_text: str) -> str:
+    return hashlib.sha256(lowered_text.encode()).hexdigest()
+
+
+def _full_cmp_rows(w: int) -> List[int]:
+    """The unpruned compare schedule for key width w, quantized onto the
+    n_cmp lattice — the schedule prewarm and the manifest share."""
+    import numpy as np
+    from yugabyte_tpu.ops.run_merge import _cmp_schedule
+    rows, _n_cmp = _cmp_schedule(w, np.zeros(_ROW_WORDS + w, dtype=bool))
+    return [int(r) for r in rows]
+
+
+def _gen_run_merge_fused() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    for (k_pad, m, w, n_cmp) in sorted(run_merge._PREWARM_SHAPES):
+        r = _ROW_WORDS + w
+        n = k_pad * m
+        u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+        args = (jax.ShapeDtypeStruct((r, n), jnp.uint32),
+                jax.ShapeDtypeStruct((n_cmp,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                u32, u32, u32, u32)
+        for impl in ("lexsort", "network"):
+            statics = dict(k_pad=k_pad, m=m, w=w, n_cmp=n_cmp,
+                           is_major=True, retain_deletes=False,
+                           snapshot=False, lexsort=(impl == "lexsort"))
+            out = jax.eval_shape(
+                lambda *a: run_merge._merge_gc_runs_fused(*a, **statics),
+                *args)
+            text = lowering_text(run_merge._merge_gc_runs_fused, args,
+                                 statics)
+            bucket = {"k_pad": k_pad, "m": m, "w": w, "n_cmp": n_cmp}
+            entries.append({
+                "key": entry_key(bucket, impl),
+                "bucket": bucket,
+                "impl": impl,
+                "static_args": statics,
+                "in_avals": [_aval_str(a) for a in args],
+                "out_avals": [_aval_str(o) for o in
+                              jax.tree_util.tree_leaves(out)],
+                # the donated twin aliases arg 0 (carved chunk buffers);
+                # both variants exist per bucket, as does is_major
+                "donation": {"donate_argnums": [0], "variants": 2},
+                "variant_axes": {"is_major": 2, "donate": 2},
+                "executables": 4,
+                "prewarmed": True,
+                "quarantine_key": [k_pad, m],
+                "lowering_sha256": _lowering_sha256(text),
+            })
+    return {"entries": entries}
+
+
+def _gen_merge_gc_fused() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import merge_gc
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    w = 4
+    r = _ROW_WORDS + w
+    for n_pad in (1 << 16, 1 << 20):
+        u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+        args = (jax.ShapeDtypeStruct((r, n_pad), jnp.uint32),
+                jax.ShapeDtypeStruct((4 + w,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                u32, u32, u32, u32)
+        statics = dict(w=w, is_major=True, retain_deletes=False)
+        out = jax.eval_shape(
+            lambda *a: merge_gc._merge_gc_fused(*a, **statics), *args)
+        text = lowering_text(merge_gc._merge_gc_fused, args, statics)
+        bucket = {"n_pad": n_pad, "w": w}
+        entries.append({
+            "key": entry_key(bucket),
+            "bucket": bucket,
+            "static_args": statics,
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            # the pruned radix schedule rides as OPERANDS (sort_rows,
+            # n_sort), so one executable covers every pruning — the
+            # compile key is the shape bucket alone
+            "donation": None,
+            "variant_axes": {"is_major": 2},
+            "executables": 2,
+            "prewarmed": False,
+            "quarantine_key": [1, n_pad],
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    return {"entries": entries}
+
+
+def _gen_scan_fused() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import scan as scan_mod
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    w = 4
+    r = _ROW_WORDS + w
+    for n_pad in (1 << 16, 1 << 20):
+        u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        args = (jax.ShapeDtypeStruct((r, n_pad), jnp.uint32),
+                jax.ShapeDtypeStruct((4 + w,), jnp.int32), i32,
+                u32, u32, u32, u32,
+                jax.ShapeDtypeStruct((w,), jnp.uint32), i32,
+                jax.ShapeDtypeStruct((w,), jnp.uint32), i32)
+        statics = dict(w=w, has_lower=True, has_upper=True,
+                       upper_truncated=False)
+        out = jax.eval_shape(
+            lambda *a: scan_mod._scan_fused(*a, **statics), *args)
+        text = lowering_text(scan_mod._scan_fused, args, statics)
+        bucket = {"n_pad": n_pad, "w": w}
+        entries.append({
+            "key": entry_key(bucket),
+            "bucket": bucket,
+            "static_args": statics,
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            "donation": None,
+            # reachable bound combos: none/lower/upper/both x the
+            # truncated-upper refinement (truncation only with an upper)
+            "variant_axes": {"bounds": 6},
+            "executables": 6,
+            "prewarmed": False,
+            "quarantine_key": [1, n_pad],
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    return {"entries": entries}
+
+
+def _gen_gather_staged() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    w = 4
+    r = _ROW_WORDS + w
+    for n_pad in (1 << 16, 1 << 18, 1 << 20):
+        args = (jax.ShapeDtypeStruct((n_pad,), jnp.bool_),)
+        out = jax.eval_shape(run_merge._survivor_positions, *args)
+        text = lowering_text(run_merge._survivor_positions, args, {})
+        bucket = {"n_pad": n_pad}
+        entries.append({
+            "key": "survivor_positions " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": {},
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            "donation": None,
+            "variant_axes": {},
+            "executables": 1,
+            "prewarmed": False,
+            "quarantine_key": None,
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    for n_out_pad in (1 << 16, 1 << 18):
+        n_pad = 1 << 18
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        args = (jax.ShapeDtypeStruct((r, n_pad), jnp.uint32),
+                jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+                jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+                jax.ShapeDtypeStruct((n_pad,), jnp.bool_),
+                i32, i32)
+        statics = dict(n_out_pad=n_out_pad)
+        out = jax.eval_shape(
+            lambda *a: run_merge._gather_staged_output(*a, **statics),
+            *args)
+        text = lowering_text(run_merge._gather_staged_output, args,
+                             statics)
+        bucket = {"n_out_pad": n_out_pad, "n_pad": n_pad, "w": w}
+        entries.append({
+            "key": "gather_staged_output " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": statics,
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            "donation": None,
+            "variant_axes": {},
+            "executables": 1,
+            "prewarmed": False,
+            "quarantine_key": None,
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    return {"entries": entries}
+
+
+def _gen_pallas_merge() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import pallas_merge, run_merge
+
+    entries = []
+    for (k_pad, m, w, n_cmp) in sorted(run_merge._PREWARM_SHAPES):
+        r = _ROW_WORDS + w
+        n = k_pad * m
+        rp = ((r + 1 + 7) // 8) * 8
+        tile = min(pallas_merge.default_tile(rp), m)
+        cmp_rows = tuple(_full_cmp_rows(w))
+        u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+        args = (jax.ShapeDtypeStruct((r, n), jnp.uint32),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                u32, u32, u32, u32)
+        statics = dict(k_pad=k_pad, m=m, w=w, cmp_rows_t=cmp_rows,
+                       tile=tile, is_major=True, retain_deletes=False,
+                       snapshot=False, interpret=True)
+        out = jax.eval_shape(
+            lambda *a: pallas_merge._pallas_merge_gc_fused(*a, **statics),
+            *args)
+        bucket = {"k_pad": k_pad, "m": m, "n_cmp": n_cmp, "w": w}
+        entries.append({
+            "key": entry_key(bucket, "pallas"),
+            "bucket": bucket,
+            "impl": "pallas",
+            "static_args": {k: (list(v) if isinstance(v, tuple) else v)
+                            for k, v in statics.items()},
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            "donation": None,
+            # Mosaic lowering needs a real TPU target, so the manifest
+            # records abstract eval only; the cmp_rows_t static means the
+            # PRUNED schedule widens this family beyond the full-schedule
+            # point warmed here (bounded in practice: schedules are
+            # prefix-stable and the miss counters watch the tail)
+            "variant_axes": {"is_major": 2},
+            "executables": 2,
+            "prewarmed": True,
+            "quarantine_key": [k_pad, m],
+            "lowering_sha256": None,
+        })
+    return {"entries": entries}
+
+
+def _gen_chunk_carve() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    w = 4
+    r = _ROW_WORDS + w
+    m, m_c, w_route = 1 << 20, 1 << 18, 4
+    for k_pad in (2, 4):
+        n_iters = int(m).bit_length() + 1
+        args = (jax.ShapeDtypeStruct((r, k_pad * m), jnp.uint32),
+                jax.ShapeDtypeStruct((k_pad,), jnp.int32),
+                jax.ShapeDtypeStruct((7, w_route), jnp.uint32))
+        statics = dict(k_pad=k_pad, m=m, w_route=w_route, n_iters=n_iters)
+        out = jax.eval_shape(
+            lambda *a: run_merge._chunk_split_search(*a, **statics), *args)
+        text = lowering_text(run_merge._chunk_split_search, args, statics)
+        bucket = {"k_pad": k_pad, "m": m, "n_iters": n_iters,
+                  "w_route": w_route}
+        entries.append({
+            "key": "chunk_split_search " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": statics,
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            "donation": None,
+            "variant_axes": {},
+            "executables": 1,
+            "prewarmed": False,
+            "quarantine_key": [k_pad, m],
+            "lowering_sha256": _lowering_sha256(text),
+        })
+        cargs = (jax.ShapeDtypeStruct((r, k_pad * m), jnp.uint32),
+                 jax.ShapeDtypeStruct((k_pad,), jnp.int32),
+                 jax.ShapeDtypeStruct((k_pad,), jnp.int32))
+        cstatics = dict(m=m, m_c=m_c, k_pad=k_pad)
+        out = jax.eval_shape(
+            lambda *a: run_merge._carve_chunk(*a, **cstatics), *cargs)
+        text = lowering_text(run_merge._carve_chunk, cargs, cstatics)
+        bucket = {"k_pad": k_pad, "m": m, "m_c": m_c}
+        entries.append({
+            "key": "carve_chunk " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": cstatics,
+            "in_avals": [_aval_str(a) for a in cargs],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            "donation": None,
+            "variant_axes": {},
+            "executables": 1,
+            "prewarmed": False,
+            "quarantine_key": [k_pad, m],
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    return {"entries": entries}
+
+
+def _gen_dist_compact() -> dict:
+    # shard_map needs a real mesh; the declared compile-key lattice is
+    # recorded instead (enforced in code: distributed_compact quantizes
+    # capacity to a power of two before keying dist_compact_fn's
+    # lru_cache), and drift is caught by the source fingerprint.
+    return {
+        "entries": [],
+        "compile_keys": {
+            "capacity": "power-of-two >= 64 (quantized in "
+                        "distributed_compact before the lru_cache key)",
+            "n_shards": "mesh-determined (8-device bench mesh)",
+            "is_major": [True, False],
+            "retain_deletes": [False],
+        },
+    }
+
+
+_GENERATORS = {
+    "run_merge_fused": _gen_run_merge_fused,
+    "merge_gc_fused": _gen_merge_gc_fused,
+    "scan_fused": _gen_scan_fused,
+    "gather_staged": _gen_gather_staged,
+    "pallas_merge": _gen_pallas_merge,
+    "chunk_carve": _gen_chunk_carve,
+    "dist_compact": _gen_dist_compact,
+}
+
+
+def generate(root: str = REPO_ROOT) -> dict:
+    """Regenerate the full manifest (imports jax; run under
+    JAX_PLATFORMS=cpu — eval_shape/lower only, nothing executes)."""
+    import jax
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            "kernel_manifest.generate must run device-free: set "
+            "JAX_PLATFORMS=cpu (the committed fingerprints are the CPU "
+            f"lowering), got backend {jax.default_backend()!r}")
+    families = {}
+    for name, spec in FAMILIES.items():
+        rec = _GENERATORS[name]()
+        entries = rec.get("entries", [])
+        rec.update({
+            "source_fingerprint": source_fingerprint(name, root),
+            "budget": spec["budget"],
+            "distinct_executables": (
+                sum(e["executables"] for e in entries)
+                if entries else None),
+        })
+        for e in entries:
+            errs = bucket_lattice_errors(e.get("bucket", {}))
+            if errs:
+                raise RuntimeError(
+                    f"declared bucket off the lattice in {name}: "
+                    f"{e['key']}: {'; '.join(errs)}")
+        n = rec["distinct_executables"]
+        if spec["budget"] is not None and n is not None \
+                and n > spec["budget"]:
+            raise RuntimeError(
+                f"compile-surface budget exceeded for {name}: {n} "
+                f"declared executables > budget {spec['budget']} — "
+                "shrink the lattice or raise the budget in "
+                "tools/analysis/kernel_manifest.py (a reviewed decision)")
+        families[name] = rec
+    return {
+        "version": 1,
+        "platform": "cpu",
+        "jax_version": jax.__version__,
+        "families": families,
+    }
+
+
+def manifest_bytes(manifest: dict) -> bytes:
+    return (json.dumps(manifest, indent=1, sort_keys=True) + "\n").encode()
+
+
+def surface_counts(manifest: Optional[dict] = None) -> Dict[str, int]:
+    """family -> distinct-executable count from the committed manifest
+    (0 for fingerprint-only families); used by the bench report and the
+    kernel_compile_surface gauges."""
+    if manifest is None:
+        manifest = load_manifest()
+    out: Dict[str, int] = {}
+    if not manifest:
+        return out
+    for name, rec in sorted(manifest.get("families", {}).items()):
+        out[name] = int(rec.get("distinct_executables") or 0)
+    return out
+
+
+def quarantine_surface_keys(manifest: Optional[dict] = None
+                            ) -> List[Tuple[int, int]]:
+    """The (k_pad, m) offload-policy quarantine keys of every declared
+    bucket — the shape vocabulary storage/offload_policy.py speaks."""
+    if manifest is None:
+        manifest = load_manifest()
+    keys = set()
+    if manifest:
+        for rec in manifest.get("families", {}).values():
+            for e in rec.get("entries", ()):
+                qk = e.get("quarantine_key")
+                if qk:
+                    keys.add((int(qk[0]), int(qk[1])))
+    return sorted(keys)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import sys
+    import time
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis.kernel_manifest",
+        description="kernel compile-surface manifest: fast drift check / "
+                    "device-free regeneration")
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--check", action="store_true",
+                   help="fast source-fingerprint + budget check against "
+                        "the committed JSON (no jax import; < 5s)")
+    g.add_argument("--verify", action="store_true",
+                   help="regenerate in memory (JAX_PLATFORMS=cpu, "
+                        "eval_shape/lower only) and byte-compare with "
+                        "the committed JSON")
+    g.add_argument("--write", action="store_true",
+                   help="regenerate and write the committed JSON")
+    ap.add_argument("--path", default=MANIFEST_PATH)
+    args = ap.parse_args(argv)
+
+    if args.check:
+        t0 = time.monotonic()
+        problems = check_manifest(load_manifest(args.path))
+        for fam, code, msg in problems:
+            print(f"[{fam}/{code}] {msg}", file=sys.stderr)
+        dt = time.monotonic() - t0
+        print(f"kernel_manifest --check: {len(problems)} problem(s) "
+              f"in {dt:.2f}s")
+        return 1 if problems else 0
+
+    # --verify / --write import jax: force the device-free CPU backend
+    # BEFORE the first jax import so nothing touches an accelerator
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = __import__("time").monotonic()
+    manifest = generate()
+    data = manifest_bytes(manifest)
+    dt = __import__("time").monotonic() - t0
+    if args.write:
+        with open(args.path, "wb") as fh:
+            fh.write(data)
+        print(f"wrote {args.path} ({len(data)} bytes) in {dt:.1f}s")
+        return 0
+    try:
+        with open(args.path, "rb") as fh:
+            committed = fh.read()
+    except OSError:
+        committed = b""
+    if committed != data:
+        print("kernel_manifest --verify: regenerated manifest differs "
+              f"from {args.path} — run --write, review the surface "
+              "diff, and commit it", file=sys.stderr)
+        return 1
+    print(f"kernel_manifest --verify: byte-identical ({dt:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -10,6 +10,7 @@ from tools.analysis.passes.blocking_reactor import BlockingReactorPass
 from tools.analysis.passes.donation_safety import DonationSafetyPass
 from tools.analysis.passes.error_propagation import ErrorPropagationPass
 from tools.analysis.passes.jit_trace_safety import JitTraceSafetyPass
+from tools.analysis.passes.kernel_contracts import KernelContractsPass
 from tools.analysis.passes.lock_discipline import LockDisciplinePass
 from tools.analysis.passes.metric_names import MetricNamesPass
 from tools.analysis.passes.resource_lifetime import ResourceLifetimePass
@@ -26,6 +27,7 @@ ALL_PASSES = (
     ErrorPropagationPass(),
     ResourceLifetimePass(),
     WireDriftPass(),
+    KernelContractsPass(),
 )
 
 

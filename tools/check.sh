@@ -1,24 +1,37 @@
 #!/usr/bin/env bash
 # tools/check.sh — the one tier-1 static-analysis entry point.
 #
-#   tools/check.sh            yblint (all nine passes, repo-clean vs the
+#   tools/check.sh            yblint (all ten passes, repo-clean vs the
 #                             committed baseline, incl. the metric-name
-#                             lint) + the yblint framework suite, which
-#                             carries the lock-rank acyclicity gate and
-#                             the empty-baseline/justification gates
+#                             lint and the kernel-contracts pass) + the
+#                             kernel-manifest drift check (committed
+#                             JSON vs source fingerprints; seconds, no
+#                             jax import) + the yblint framework suite,
+#                             which carries the lock-rank acyclicity
+#                             gate and the baseline/justification gates
 #   tools/check.sh --changed  same, but yblint reports only files changed
-#                             vs HEAD (index still whole-program) — the
-#                             seconds-fast pre-commit form
-#   tools/check.sh --full     all of the above, then the full tier-1
+#                             vs HEAD (index still whole-program), and
+#                             the manifest is only REGENERATED (verified
+#                             byte-identical; ~10s of device-free
+#                             eval_shape/lower under JAX_PLATFORMS=cpu)
+#                             when the change set touches the kernel
+#                             surface: yugabyte_tpu/ops/,
+#                             yugabyte_tpu/parallel/, or
+#                             storage/offload_policy.py. The drift gate
+#                             itself always runs and always reads the
+#                             committed JSON.
+#   tools/check.sh --full     all of the above, the manifest
+#                             regeneration verify, then the full tier-1
 #                             pytest suite (tests/ -m 'not slow')
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 YBLINT_ARGS=()
 RUN_FULL=0
+CHANGED=0
 for a in "$@"; do
     case "$a" in
-        --changed) YBLINT_ARGS+=(--changed) ;;
+        --changed) YBLINT_ARGS+=(--changed); CHANGED=1 ;;
         --full)    RUN_FULL=1 ;;
         *) echo "usage: tools/check.sh [--changed] [--full]" >&2; exit 2 ;;
     esac
@@ -26,6 +39,29 @@ done
 
 echo "== yblint (all passes) =="
 python -m tools.analysis "${YBLINT_ARGS[@]+"${YBLINT_ARGS[@]}"}"
+
+echo "== kernel-manifest drift check (committed JSON) =="
+python -m tools.analysis.kernel_manifest --check
+
+REGEN=0
+if [ "$RUN_FULL" = 1 ]; then
+    REGEN=1
+elif [ "$CHANGED" = 1 ]; then
+    # regenerate only when the change set touches the kernel compile
+    # surface; everything else keeps the --changed path seconds-fast.
+    # (buffered into a variable: `git | grep -q` would SIGPIPE git on
+    # the first match, which pipefail turns into a false condition)
+    CHANGED_FILES=$( { git diff --name-only HEAD --; \
+                       git ls-files --others --exclude-standard; } || true )
+    if grep -qE '^yugabyte_tpu/(ops|parallel)/|^yugabyte_tpu/storage/offload_policy\.py$' \
+            <<<"$CHANGED_FILES"; then
+        REGEN=1
+    fi
+fi
+if [ "$REGEN" = 1 ]; then
+    echo "== kernel-manifest regeneration verify (device-free) =="
+    JAX_PLATFORMS=cpu python -m tools.analysis.kernel_manifest --verify
+fi
 
 echo "== yblint framework + lock-rank acyclicity + baseline gates =="
 python -m pytest tests/test_yblint.py -q

@@ -316,33 +316,76 @@ def prewarm_buckets(shapes: Optional[Sequence[Tuple[int, int, int, int]]]
     compaction of each bucket loads a cached executable instead of paying
     the full XLA compile (107s measured on the tunnel TPU). Run by the
     tserver maintenance manager at startup (flag-gated); returns how many
-    buckets compiled."""
+    executables compiled.
+
+    Coverage matches the committed compile-surface manifest
+    (tools/analysis/kernel_manifest.json): BOTH is_major variants per
+    shape (minor compactions are the common case — warming only the
+    major twin left half the steady surface cold), and on TPU the pallas
+    tournament kernel too, with the full unpruned compare schedule —
+    auto impl routing launches pallas there, so warming only the jnp
+    program cached an executable the TPU path never runs."""
     shapes = tuple(shapes) if shapes is not None else _PREWARM_SHAPES
     lexsort = _use_lexsort()
     donate = _donation_supported()
     fn = _merge_gc_runs_fused_donated if donate else _merge_gc_runs_fused
+    on_tpu = jax.default_backend() == "tpu"
     compiled = 0
+
+    def _warm(what: str, lower_fn) -> int:
+        try:
+            lower_fn().compile()
+            return 1
+        except Exception as e:  # noqa: BLE001 — prewarm must never block
+            import sys as _sys                       # server startup
+            print(f"[run_merge] prewarm of {what} failed: {e!r}",
+                  file=_sys.stderr, flush=True)
+            return 0
+
     for (k_pad, m, w, n_cmp) in shapes:
         r = _ROW_WORDS + w
         n = k_pad * m
         u32 = jax.ShapeDtypeStruct((), jnp.uint32)
-        try:
-            fn.lower(
-                jax.ShapeDtypeStruct((r, n), jnp.uint32),
-                jax.ShapeDtypeStruct((n_cmp,), jnp.int32),
-                jax.ShapeDtypeStruct((n,), jnp.int32),
-                u32, u32, u32, u32,
-                k_pad=k_pad, m=m, w=w, n_cmp=n_cmp,
-                is_major=True, retain_deletes=False, snapshot=False,
-                lexsort=lexsort).compile()
-            _record_bucket(("lexsort" if lexsort else "network", k_pad, m,
-                            w, n_cmp, True, False, False, donate))
-            compiled += 1
-        except Exception as e:  # noqa: BLE001 — prewarm must never block
-            import sys as _sys                       # server startup
-            print(f"[run_merge] prewarm of bucket (k_pad={k_pad} m={m} "
-                  f"w={w} n_cmp={n_cmp}) failed: {e!r}",
-                  file=_sys.stderr, flush=True)
+        fused_args = (
+            jax.ShapeDtypeStruct((r, n), jnp.uint32),
+            jax.ShapeDtypeStruct((n_cmp,), jnp.int32),
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            u32, u32, u32, u32)
+        for is_major in (True, False):
+            got = _warm(
+                f"bucket (k_pad={k_pad} m={m} w={w} n_cmp={n_cmp} "
+                f"is_major={is_major})",
+                lambda: fn.lower(
+                    *fused_args, k_pad=k_pad, m=m, w=w, n_cmp=n_cmp,
+                    is_major=is_major, retain_deletes=False,
+                    snapshot=False, lexsort=lexsort))
+            if got:
+                _record_bucket(("lexsort" if lexsort else "network",
+                                k_pad, m, w, n_cmp, is_major, False,
+                                False, donate))
+            compiled += got
+        if not on_tpu:
+            continue
+        from yugabyte_tpu.ops import pallas_merge
+        cmp_rows, n_cmp_full = _cmp_schedule(w, np.zeros(r, dtype=bool))
+        cmp_rows_t = tuple(int(x) for x in cmp_rows)
+        rp = ((r + 1 + 7) // 8) * 8
+        tile = min(pallas_merge.default_tile(rp), m)
+        for is_major in (True, False):
+            got = _warm(
+                f"pallas bucket (k_pad={k_pad} m={m} w={w} "
+                f"is_major={is_major})",
+                lambda: pallas_merge._pallas_merge_gc_fused.lower(
+                    jax.ShapeDtypeStruct((r, n), jnp.uint32),
+                    jax.ShapeDtypeStruct((n,), jnp.int32),
+                    u32, u32, u32, u32,
+                    k_pad=k_pad, m=m, w=w, cmp_rows_t=cmp_rows_t,
+                    tile=tile, is_major=is_major, retain_deletes=False,
+                    snapshot=False, interpret=False))
+            if got:
+                _record_bucket(("pallas", k_pad, m, w, n_cmp_full,
+                                is_major, False, False))
+            compiled += got
     return compiled
 
 
@@ -577,7 +620,10 @@ def stage_runs_from_staged(staged_list: Sequence[StagedCols]) -> StagedRuns:
     k = len(live)
     k_pad = 1 << max(0, (k - 1).bit_length()) if k > 1 else 1
     m = max(run_bucket(s.n) for s in live)
-    w = max(s.w for s in live)
+    # staged widths are already pack_cols-quantized; the explicit
+    # quantize_width keeps this layout on the lattice even if a caller
+    # ever stages an odd width (idempotent on lattice points)
+    w = quantize_width(max(s.w for s in live))
     r = _ROW_WORDS + w
     pad_col = jnp.asarray(pad_template(r))
     parts = []

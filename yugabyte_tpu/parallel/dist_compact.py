@@ -183,8 +183,13 @@ def distributed_compact(slab, params: GCParams, mesh: Mesh, axis: str = "shard",
         cols = np.concatenate([cols, pad_block], axis=1)
     n_local = cols.shape[1] // n_shards
     # each source sends ~n_local/n_shards rows to each destination; the
-    # factor absorbs skew, with the overflow retry as the hard guard
-    capacity = max(64, int(n_local / n_shards * capacity_factor))
+    # factor absorbs skew, with the overflow retry as the hard guard.
+    # capacity is part of dist_compact_fn's lru_cache compile key, so it
+    # is quantized onto the power-of-two lattice: the raw
+    # rows-per-destination value varies per job and would mint a fresh
+    # shard_map executable per size (a doubling retry stays on-lattice)
+    cap_raw = max(64, int(n_local / n_shards * capacity_factor))
+    capacity = 1 << (cap_raw - 1).bit_length()
     cutoff = params.history_cutoff_ht
     cutoff_phys = cutoff >> 12
     fn = dist_compact_fn(mesh, capacity, params.is_major_compaction,

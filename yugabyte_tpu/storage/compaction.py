@@ -562,6 +562,18 @@ def run_compaction_job_device_native(
     from yugabyte_tpu.utils.trace import TRACE
     qkey = offload_policy_mod.bucket_key(
         run_merge.packed_run_ns([r.props.n_entries for r in inputs]))
+    surface = offload_policy_mod.declared_surface_keys()
+    if surface and qkey not in surface:
+        # reachable shape the committed manifest never declared: count it
+        # (the compile-surface budget reviews growth; this is the live
+        # signal that the lattice and reality have diverged)
+        from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+        ROOT_REGISTRY.entity("server", "offload_policy").counter(
+            "compaction_offsurface_bucket_total",
+            "device-native compactions whose shape bucket is outside "
+            "the declared kernel compile surface").increment()
+        TRACE("compaction: bucket k_pad=%d m=%d is outside the declared "
+              "compile surface", *qkey)
     if offload_policy_mod.bucket_quarantine().is_quarantined(qkey):
         # this shape bucket's kernel path faulted recently: native-only
         # until the quarantine window decays (surfaced on /compactionz)

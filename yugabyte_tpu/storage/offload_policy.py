@@ -200,6 +200,15 @@ class BucketQuarantine:
 
     def quarantine(self, bucket: Tuple[int, ...], reason: str,
                    ttl_s: Optional[float] = None) -> None:
+        surface = declared_surface_keys()
+        if surface and tuple(bucket) not in surface:
+            # a fault on a shape the manifest never declared: the
+            # compile-surface lattice leaked before the device did
+            from yugabyte_tpu.utils.trace import TRACE
+            TRACE("offload_policy: quarantining bucket k_pad=%s m=%s "
+                  "OUTSIDE the declared compile surface (%d keys) — "
+                  "regenerate/review tools/analysis/kernel_manifest.json",
+                  bucket[0], bucket[1], len(surface))
         ttl = ttl_s if ttl_s is not None else \
             flags.get_flag("device_fault_quarantine_s")
         with self._lock:
@@ -260,6 +269,66 @@ def _quarantine_counter(what: str):
                         "eligible for the device path again)"}
     return ROOT_REGISTRY.entity("server", "offload_policy").counter(
         f"offload_quarantine_{what}_total", helps[what])
+
+
+# ---------------------------------------------------------------------------
+# Declared compile surface: the committed kernel manifest
+# (tools/analysis/kernel_manifest.json, regenerated by
+# `python -m tools.analysis.kernel_manifest --write` and drift-gated in
+# tier-1) enumerates every (k_pad, m) shape bucket the kernel families
+# are declared reachable with. The policy layer uses it as the shape
+# vocabulary: a quarantine (or a device-native launch) on a key OUTSIDE
+# the surface is the earliest signal that the bucket lattice has sprung
+# a leak — some code path is minting executables the prewarm/budget
+# discipline never reviewed.
+
+_surface_keys: Optional[frozenset] = None  # guarded-by: _surface_lock
+_surface_counts: Optional[dict] = None     # guarded-by: _surface_lock
+_surface_lock = threading.Lock()
+
+
+def _manifest_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "tools", "analysis", "kernel_manifest.json")
+
+
+def _load_surface_unlocked() -> None:
+    global _surface_keys, _surface_counts
+    keys = set()
+    counts: dict = {}
+    try:
+        with open(_manifest_path()) as f:
+            manifest = json.load(f)
+        for name, rec in manifest.get("families", {}).items():
+            counts[name] = int(rec.get("distinct_executables") or 0)
+            for e in rec.get("entries", ()):
+                qk = e.get("quarantine_key")
+                if qk:
+                    keys.add((int(qk[0]), int(qk[1])))
+    except (OSError, ValueError):  # yblint: contained(absent/corrupt manifest means no declared surface — the off-surface telemetry simply stays quiet)
+        pass
+    _surface_keys = frozenset(keys)
+    _surface_counts = counts
+
+
+def declared_surface_keys() -> frozenset:
+    """(k_pad, m) quarantine keys of every declared manifest bucket;
+    empty when no manifest is committed (telemetry-only consumer)."""
+    with _surface_lock:
+        if _surface_keys is None:
+            _load_surface_unlocked()
+        return _surface_keys
+
+
+def declared_surface_counts() -> dict:
+    """family -> declared distinct-executable count from the manifest
+    (feeds the kernel_compile_surface gauges)."""
+    with _surface_lock:
+        if _surface_counts is None:
+            _load_surface_unlocked()
+        return dict(_surface_counts)
 
 
 def bucket_key(run_ns) -> Tuple[int, int]:

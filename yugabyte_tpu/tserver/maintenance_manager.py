@@ -163,9 +163,16 @@ class PrewarmKernelsOp(MaintenanceOp):
 
     def perform(self) -> None:
         from yugabyte_tpu.ops import run_merge
+        from yugabyte_tpu.storage import offload_policy
+        from yugabyte_tpu.utils.metrics import publish_compile_surface
         n = run_merge.prewarm_buckets(self._shapes)
+        # expose the declared compile surface (committed kernel
+        # manifest) next to the bucket hit/miss counters: the warm cache
+        # must cover exactly this many executables
+        publish_compile_surface(offload_policy.declared_surface_counts())
         self.done = True
-        TRACE("maintenance: prewarmed %d compaction kernel buckets", n)
+        TRACE("maintenance: prewarmed %d compaction kernel executables",
+              n)
 
 
 class _RecoverOp(MaintenanceOp):

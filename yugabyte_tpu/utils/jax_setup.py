@@ -20,3 +20,13 @@ try:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 except Exception:  # cache is an optimization; never fail import over it
     pass
+
+
+def lowering_text(jitted, args, statics) -> str:
+    """StableHLO text of a jitted callable lowered against abstract args
+    (ShapeDtypeStructs) — no device execution, no compilation.  The
+    kernel compile-surface manifest (tools/analysis/kernel_manifest.py)
+    fingerprints this text per (kernel, bucket) pair; the default
+    StableHLO printing carries no source positions, so pure line drift
+    cannot move the fingerprint."""
+    return jitted.lower(*args, **statics).as_text()

@@ -299,6 +299,26 @@ def kernel_metrics() -> MetricEntity:
     return ROOT_REGISTRY.entity("server", "kernels")
 
 
+def publish_compile_surface(counts: Dict[str, int]) -> None:
+    """Per-kernel-family compile-surface gauges from the committed
+    manifest (tools/analysis/kernel_manifest.json): how many distinct
+    executables each family's declared bucket lattice mints. Reported
+    next to the compile_bucket hit/miss counters so a bench run (or
+    /metrics scrape) can prove the warm cache covers exactly the
+    manifest surface — misses beyond the surface mean the lattice has
+    sprung a leak."""
+    e = kernel_metrics()
+    total = 0
+    for family, n in sorted(counts.items()):
+        e.gauge(f"kernel_compile_surface_{family}_buckets_count",
+                f"declared compile-surface executables of the {family} "
+                "kernel family (committed manifest)").set(n)
+        total += n
+    e.gauge("kernel_compile_surface_buckets_count",
+            "declared compile-surface executables across all kernel "
+            "families (committed manifest)").set(total)
+
+
 _PIPELINE_STAGES = ("host", "device", "write")
 
 
