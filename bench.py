@@ -45,6 +45,21 @@ def _surface_counts_for_report():
     return declared_surface_counts()
 
 
+def _shadow_sample_for_report():
+    from yugabyte_tpu.storage.integrity import shadow_snapshot
+    return shadow_snapshot()["sample"]
+
+
+def _shadow_jobs_for_report():
+    from yugabyte_tpu.storage.integrity import shadow_snapshot
+    return shadow_snapshot()["jobs_verified"]
+
+
+def _shadow_mismatches_for_report():
+    from yugabyte_tpu.storage.integrity import shadow_snapshot
+    return shadow_snapshot()["mismatches"]
+
+
 def log(msg):
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
@@ -502,21 +517,34 @@ def run_device_child(platform: str, workload_path: str,
             surface_counts = declared_surface_counts()
             publish_compile_surface(surface_counts)
             surface_total = sum(surface_counts.values())
+            # shadow verification rode the steady jobs at the DEFAULT
+            # sampling rate (acceptance: <=5% steady regression): report
+            # its cost + coverage next to the stage timings
+            from yugabyte_tpu.storage.integrity import shadow_snapshot
+            shadow = shadow_snapshot()
             log(f"  pipeline stages over steady jobs: "
                 f"host {stage_ms.get('host', 0):.0f}ms / device "
                 f"{stage_ms.get('device', 0):.0f}ms / write "
-                f"{stage_ms.get('write', 0):.0f}ms; compile buckets "
+                f"{stage_ms.get('write', 0):.0f}ms / shadow "
+                f"{stage_ms.get('shadow', 0):.0f}ms; compile buckets "
                 f"{bucket_hits} hits / {bucket_misses} misses "
-                f"(manifest surface: {surface_total} executables)")
+                f"(manifest surface: {surface_total} executables); "
+                f"shadow verify sample={shadow['sample']} "
+                f"jobs={shadow['jobs_verified']} "
+                f"mismatches={shadow['mismatches']}")
             stages.put(stage="e2e_steady", e2e_steady=e2e_steady,
                        e2e_steady2=e2e_steady2,
                        e2e_rows=e2e_rows, e2e_n=e2e_n,
                        stage_host_ms=stage_ms.get("host", 0.0),
                        stage_device_ms=stage_ms.get("device", 0.0),
                        stage_write_ms=stage_ms.get("write", 0.0),
+                       stage_shadow_ms=stage_ms.get("shadow", 0.0),
                        compile_bucket_hits=bucket_hits,
                        compile_bucket_misses=bucket_misses,
-                       compile_surface_buckets=surface_total)
+                       compile_surface_buckets=surface_total,
+                       shadow_verify_sample=shadow["sample"],
+                       shadow_verify_jobs=shadow["jobs_verified"],
+                       shadow_verify_mismatches=shadow["mismatches"])
             e2e_cold, _ = run_dn("cold", False)
             log(f"  e2e cold ({platform}+native shell): "
                 f"{e2e_cold/1e6:.2f}M rows/s")
@@ -593,6 +621,13 @@ def run_device_child(platform: str, workload_path: str,
         "stage_host_ms": stage_ms.get("host", 0.0),
         "stage_device_ms": stage_ms.get("device", 0.0),
         "stage_write_ms": stage_ms.get("write", 0.0),
+        # shadow verification cost + coverage over the steady jobs at
+        # the DEFAULT --shadow_verify_sample (acceptance: <=5% steady
+        # regression with sampling on)
+        "stage_shadow_ms": stage_ms.get("shadow", 0.0),
+        "shadow_verify_sample": _shadow_sample_for_report(),
+        "shadow_verify_jobs": _shadow_jobs_for_report(),
+        "shadow_verify_mismatches": _shadow_mismatches_for_report(),
         "compile_bucket_hits": bucket_hits,
         "compile_bucket_misses": bucket_misses,
         # per-family declared compile-surface counts (committed kernel
@@ -982,8 +1017,10 @@ def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
             recs["e2e_steady"].get("e2e_steady2", 0.0), 1)
         out["e2e_n_rows"] = recs["e2e_steady"]["e2e_n"]
         for k in ("stage_host_ms", "stage_device_ms", "stage_write_ms",
-                  "compile_bucket_hits", "compile_bucket_misses",
-                  "compile_surface_buckets"):
+                  "stage_shadow_ms", "compile_bucket_hits",
+                  "compile_bucket_misses", "compile_surface_buckets",
+                  "shadow_verify_sample", "shadow_verify_jobs",
+                  "shadow_verify_mismatches"):
             if k in recs["e2e_steady"]:
                 out[k] = recs["e2e_steady"][k]
         out["value"] = max(out["e2e_steady_rows_per_sec"],

@@ -2,7 +2,7 @@
 PR 1's disk-fault harness.
 
 A write workload runs against an RF3 MiniCluster while the nemesis
-drives three consecutive fault cycles:
+drives four consecutive fault cycles:
 
   1. tserver crash-stop mid-load + restart (WAL replay / catch-up),
   2. raft leader partition (a new leader must emerge in the connected
@@ -10,12 +10,17 @@ drives three consecutive fault cycles:
   3. injected ENOSPC on SST writes + device faults in the stage-B
      kernel path while compactions run under device_offload_mode=device
      (background-error containment + mid-job native fallback +
-     shape-bucket quarantine underneath).
+     shape-bucket quarantine underneath),
+  4. at-rest corruption nemesis: bit-flips in a follower's written SST
+     bytes, detected by one scrub cycle -> replica FAILED (corrupt) ->
+     master rebuilds it in place from a healthy peer.
 
 Invariants asserted after the cycles heal:
   - every ACKNOWLEDGED write is readable with its last-acked value,
   - raft terms never regress across any cycle,
   - all tablets converge RUNNING with ready leaders,
+  - zero UNDETECTED mismatches: cross-replica digests agree on every
+    tablet after the corruption cycle heals,
   - the host staging pool has zero leaked leases.
 
 Slow-marked (tier-2): run with
@@ -154,6 +159,57 @@ def test_chaos_soak_three_nemesis_cycles(tmp_path):
         nem.wait_all_healthy(table.table_id, timeout_s=120)
         nem.check_terms_monotonic(terms, nem.capture_terms())
 
+        # ---- cycle 4: at-rest corruption nemesis --------------------
+        # bit-flip a FOLLOWER replica's written SST bytes, then force a
+        # scrub cycle: detection must fail the replica (sticky corrupt)
+        # and the master must rebuild it from a healthy peer.
+        terms = nem.capture_terms()
+        follower_ts = follower_peer = None
+        for ts in cluster.tservers:
+            peer = ts.tablet_manager.get_tablet(tablet_id)
+            if not peer.raft.is_leader():
+                follower_ts, follower_peer = ts, peer
+                break
+        assert follower_ts is not None
+        follower_peer.tablet.flush()   # ensure at-rest bytes exist
+        import glob as _glob
+        data_files = sorted(_glob.glob(os.path.join(
+            follower_peer.tablet.regular_db.db_dir, "*.sblock.0")))
+        assert data_files, "follower flush produced no SST to corrupt"
+        for path in reversed(data_files):  # newest first: a concurrent
+            try:                           # compaction may eat the old
+                fi_env.corrupt_range(path, length=64, nbits=3)
+                break
+            except OSError:
+                continue
+        old_scrub = flags.get_flag("scrub_interval_s")
+        flags.set_flag("scrub_interval_s", 0.01)
+        try:
+            time.sleep(0.02)
+            deadline = time.monotonic() + 30
+            while follower_peer.state != "FAILED" \
+                    and time.monotonic() < deadline:
+                follower_ts.scrub_op.perform()
+                time.sleep(0.1)
+        finally:
+            flags.set_flag("scrub_interval_s", old_scrub)
+        assert follower_peer.state == "FAILED" \
+            and follower_peer.failed_corrupt, \
+            "scrub cycle must detect the corrupted SST"
+        # master rebuild loop: the replica comes back RUNNING on a NEW
+        # peer object with the corruption gone
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                p = follower_ts.tablet_manager.get_tablet(tablet_id)
+                if p is not follower_peer and p.state == "RUNNING":
+                    break
+            except Exception:
+                pass  # mid-rebuild
+            time.sleep(0.2)
+        nem.wait_all_healthy(table.table_id, timeout_s=120)
+        nem.check_terms_monotonic(terms, nem.capture_terms())
+
         # ---- verification -------------------------------------------
         acked = workload.stop()
         workload = None
@@ -170,6 +226,30 @@ def test_chaos_soak_three_nemesis_cycles(tmp_path):
                 missing.append((key, want, got))
         assert not missing, \
             f"acknowledged writes lost after heal: {missing[:10]}"
+        # zero UNDETECTED mismatches: after the corruption cycle healed,
+        # every tablet's replicas agree digest-for-digest at one pinned
+        # read time (divergence the loop failed to repair would show
+        # here)
+        from yugabyte_tpu.utils.status import StatusError
+        for tid in client.meta_cache.tablets(table.table_id):
+            read_ht = None
+            for ts in cluster.tservers:  # pin one read time (leader-only)
+                try:
+                    read_ht = client._messenger.call(
+                        ts.address, "tserver", "scan",
+                        tablet_id=tid.tablet_id, limit=1)["read_ht"]
+                    break
+                except StatusError:
+                    continue
+            assert read_ht is not None, f"no leader for {tid.tablet_id}"
+            sums = set()
+            for ts in cluster.tservers:
+                sums.add(client._messenger.call(
+                    ts.address, "tserver", "checksum_tablet",
+                    timeout_s=60.0, tablet_id=tid.tablet_id,
+                    read_ht=read_ht)["checksum"])
+            assert len(sums) == 1, \
+                f"undetected replica divergence on {tid.tablet_id}: {sums}"
         assert host_staging_pool().outstanding() == 0, \
             "staging-pool leases leaked during the chaos run"
     finally:

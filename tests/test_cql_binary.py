@@ -157,10 +157,11 @@ def test_error_surfaces_as_cql_error(conn):
     assert rows.rows == [[1]]
 
 
-def test_index_through_binary_protocol(conn):
+def test_index_through_binary_protocol(conn, cluster):
     conn.execute("USE wire_ks")
     conn.execute("CREATE TABLE bt (id INT PRIMARY KEY, tag TEXT) "
                  "WITH tablets = 2")
+    cluster.wait_for_table_leaders("wire_ks", "bt")
     for i in range(12):
         conn.execute("INSERT INTO bt (id, tag) VALUES (?, ?)",
                      [(i, DataType.INT32), (f"g{i % 2}", DataType.STRING)])

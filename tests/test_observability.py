@@ -349,9 +349,18 @@ def test_endpoint_smoke_and_compactionz(tmp_path):
         addr = ts.webserver.address
         assert _get(addr, "/healthz").decode().strip() == "ok"
         for path in ("/metrics", "/rpcz", "/tracez", "/threadz",
-                     "/compactionz"):
+                     "/compactionz", "/integrityz"):
             payload = json.loads(_get(addr, path))
             assert payload is not None, path
+
+        iz = json.loads(_get(addr, "/integrityz"))
+        assert iz["shadow_verify"]["sample"] == flags.get_flag(
+            "shadow_verify_sample")
+        assert iz["scrub"]["interval_s"] == flags.get_flag(
+            "scrub_interval_s")
+        assert isinstance(iz["quarantined_files"], list)
+        assert all("scrub" in t and "failed_corrupt" in t
+                   for t in iz["tablets"])
 
         cz = json.loads(_get(addr, "/compactionz"))
         totals = cz["totals"]

@@ -129,8 +129,9 @@ def test_prepared_statement_reuse(conn):
     assert r.rows == [["3"]]
 
 
-def test_null_parameter(conn):
+def test_null_parameter(conn, cluster):
     conn.query("CREATE TABLE nt (id INT PRIMARY KEY, v TEXT)")
+    cluster.wait_for_table_leaders("postgres", "nt")
     r = conn.extended_query("INSERT INTO nt (id, v) VALUES ($1, $2)",
                             ["1", None])
     assert r.tag == "INSERT 0 1"
@@ -192,8 +193,9 @@ def test_group_by_without_aggregate_is_distinct(conn):
     assert sorted(x[0] for x in r.rows) == ["r0", "r1", "r2"]
 
 
-def test_positional_params_multirow_insert(conn):
+def test_positional_params_multirow_insert(conn, cluster):
     conn.query("CREATE TABLE pp (id INT PRIMARY KEY, n INT)")
+    cluster.wait_for_table_leaders("postgres", "pp")
     r = conn.extended_query("INSERT INTO pp VALUES ($1, $2), ($3, $4)",
                             ["1", "10", "2", "20"])
     assert r.tag == "INSERT 0 2"

@@ -77,6 +77,7 @@ def test_cql_index_lifecycle(cql, cluster):
 def test_cql_index_backfill_under_concurrent_writes(cql, cluster):
     cql.execute("CREATE TABLE events (id INT PRIMARY KEY, kind TEXT) "
                 "WITH tablets = 2")
+    cluster.wait_for_table_leaders("idx_ks", "events")
     for i in range(60):
         cql.execute(f"INSERT INTO events (id, kind) VALUES ({i}, "
                     f"'k{i % 3}')")
@@ -121,9 +122,10 @@ def test_cql_index_backfill_under_concurrent_writes(cql, cluster):
     assert got == expect, (sorted(expect - got), sorted(got - expect))
 
 
-def test_cql_index_inside_explicit_transaction(cql):
+def test_cql_index_inside_explicit_transaction(cql, cluster):
     cql.execute("CREATE TABLE accts (id INT PRIMARY KEY, owner TEXT) "
                 "WITH tablets = 2")
+    cluster.wait_for_table_leaders("idx_ks", "accts")
     cql.execute("CREATE INDEX accts_owner ON accts (owner)")
     cql.execute(
         "BEGIN TRANSACTION "
@@ -148,6 +150,7 @@ def test_pg_index_lifecycle(cluster):
     sess = _pg_session(cluster)
     sess.execute("CREATE TABLE items (id INT PRIMARY KEY, cat TEXT, "
                  "price INT)")
+    cluster.wait_for_table_leaders("idx_pg", "items")
     for i in range(30):
         sess.execute(f"INSERT INTO items (id, cat, price) VALUES "
                      f"({i}, 'g{i % 3}', {i * 10})")
@@ -175,6 +178,7 @@ def test_pg_multirow_update_statement_atomicity(cluster):
     not be clobbered (round-2 Weak #5: lost update)."""
     sess = _pg_session(cluster)
     sess.execute("CREATE TABLE counters (id INT PRIMARY KEY, v INT)")
+    cluster.wait_for_table_leaders("idx_pg", "counters")
     for i in range(10):
         sess.execute(f"INSERT INTO counters (id, v) VALUES ({i}, 0)")
 

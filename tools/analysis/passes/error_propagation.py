@@ -25,17 +25,23 @@ on the path):
     swallowed error in fault injection makes chaos tests pass
     vacuously, and one in a cancellation path turns clean aborts into
     hangs or leaks);
+  - every function whose name contains `scrub`, `integrity`, `shadow` or
+    `corrupt` (PR 8: the data-integrity loop — a swallowed error in the
+    scrubber or shadow verifier means corruption detected but never
+    routed to repair, the exact dead end this code exists to close);
   - every function of the WAL module (consensus/log.py), the nemesis
-    rule engine (rpc/nemesis.py) and the chaos controller
-    (integration/chaos.py);
+    rule engine (rpc/nemesis.py), the chaos controller
+    (integration/chaos.py) and the integrity core
+    (storage/integrity.py);
   - any function marked `# yblint: durability-path` on its def line.
 Reachability includes weak callback edges (`Thread(target=f)`), so the
 pipeline's ingest/decode worker closures are covered.
 
 Findings are reported for files under storage/, consensus/, tablet/,
-rpc/, integration/ and ops/ — the layers whose silent degradation loses
-data or silently un-injects faults. `__del__` bodies are exempt
-(teardown is unroutable).
+rpc/, integration/, ops/ and tserver/ — the layers whose silent
+degradation loses data or silently un-injects faults (tserver/ joined
+with the scrubber: its maintenance/digest paths route corruption).
+`__del__` bodies are exempt (teardown is unroutable).
 """
 
 from __future__ import annotations
@@ -51,12 +57,14 @@ PASS_NAME = "error-propagation"
 
 DEFAULT_DIRS = ("yugabyte_tpu/storage", "yugabyte_tpu/consensus",
                 "yugabyte_tpu/tablet", "yugabyte_tpu/rpc",
-                "yugabyte_tpu/integration", "yugabyte_tpu/ops")
-_SEED_NAME_RE = re.compile(r"flush|compact|nemesis|chaos|cancel",
-                           re.IGNORECASE)
+                "yugabyte_tpu/integration", "yugabyte_tpu/ops",
+                "yugabyte_tpu/tserver")
+_SEED_NAME_RE = re.compile(
+    r"flush|compact|nemesis|chaos|cancel|scrub|integrity|shadow|corrupt",
+    re.IGNORECASE)
 _WAL_MODULE_SUFFIX = ".consensus.log"
 _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
-                         ".integration.chaos")
+                         ".integration.chaos", ".storage.integrity")
 _MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
 _DEF_MARKER = "# yblint: durability-path"
 _ROUTING_NAMES = ("TRACE", "trace")

@@ -67,6 +67,10 @@ class TSDescriptor:
         # error): the load balancer re-replicates them without waiting for
         # the whole server to go silent
         self.failed_tablets: Set[str] = set()
+        # the corruption subset of failed_tablets (scrub / read-path CRC /
+        # digest divergence): rebuilt IN PLACE from a healthy peer — the
+        # server is fine, the replica's data is not
+        self.corrupt_tablets: Set[str] = set()
 
     def alive(self) -> bool:
         timeout = flags.get_flag("tserver_unresponsive_timeout_ms") / 1000.0
@@ -92,6 +96,9 @@ class TSManager:
             desc.reported_tablets = {t["tablet_id"] for t in report}
             desc.failed_tablets = {t["tablet_id"] for t in report
                                    if t.get("state") == "FAILED"}
+            desc.corrupt_tablets = {t["tablet_id"] for t in report
+                                    if t.get("state") == "FAILED"
+                                    and t.get("failed_corrupt")}
             return desc
 
     def live_descriptors(self) -> List[TSDescriptor]:

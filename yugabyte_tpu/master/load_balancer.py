@@ -53,6 +53,24 @@ class ClusterLoadBalancer:
         for tablet_id, tm in list(cm.tablets.items()):
             if moves >= max_moves:
                 break
+            leader = cm.tablet_leaders.get(tablet_id)
+            # Corruption-reported replicas (scrub / read-path CRC /
+            # digest divergence) are rebuilt IN PLACE from the leader:
+            # the server is alive and its disk works — only this
+            # replica's data is bad — so no spare is needed (which also
+            # makes repair possible when RF == cluster size).
+            corrupt = [s for s in tm["replicas"]
+                       if s in live
+                       and self._reported_corrupt(s, tablet_id)]
+            if corrupt:
+                if leader is None or leader[0] not in live \
+                        or leader[0] == corrupt[0]:
+                    continue  # need a healthy live leader as the source
+                if self._rebuild_replica(tablet_id,
+                                         addr_map[leader[0]],
+                                         corrupt[0], addr_map):
+                    moves += 1
+                continue
             # A replica is repair-worthy when its server has gone silent
             # past the grace period OR the server itself reports the
             # replica FAILED (background storage error) — an explicit
@@ -63,7 +81,6 @@ class ClusterLoadBalancer:
                     or self._reported_failed(s, tablet_id)]
             if not dead:
                 continue
-            leader = cm.tablet_leaders.get(tablet_id)
             if leader is None or leader[0] not in live:
                 continue  # no live leader to drive the change through
             spare = self._pick_spare(live, tm["replicas"])
@@ -81,6 +98,10 @@ class ClusterLoadBalancer:
         desc = self.catalog.ts_manager.get(server_id)
         return desc is not None and tablet_id in desc.failed_tablets
 
+    def _reported_corrupt(self, server_id: str, tablet_id: str) -> bool:
+        desc = self.catalog.ts_manager.get(server_id)
+        return desc is not None and tablet_id in desc.corrupt_tablets
+
     def _dead_for(self, server_id: str) -> float:
         desc = self.catalog.ts_manager.get(server_id)
         if desc is None:
@@ -96,6 +117,30 @@ class ClusterLoadBalancer:
             return None
         return min(candidates,
                    key=lambda d: (d.num_tablets, d.server_id)).server_id
+
+    # ------------------------------------------------------------- rebuild
+    def _rebuild_replica(self, tablet_id: str, leader_addr: str,
+                         server_id: str, addr_map) -> bool:
+        """In-place repair of a corruption-failed replica: tell ITS OWN
+        server to remote-bootstrap the tablet from the healthy leader.
+        The tserver tears the corrupt copy down first (the sticky
+        Corruption error guarantees nothing else will un-park it); the
+        Raft config is unchanged, so a crash mid-rebuild is simply
+        retried by a later pass."""
+        addr = addr_map.get(server_id)
+        if addr is None:
+            return False
+        TRACE("lb: rebuilding corrupt replica %s of %s in place from %s",
+              server_id, tablet_id, leader_addr)
+        try:
+            self.messenger.call(addr, "tserver", "start_remote_bootstrap",
+                                timeout_s=60.0, tablet_id=tablet_id,
+                                source_addr=leader_addr)
+        except StatusError as e:
+            TRACE("lb: rebuild of %s on %s failed (retried next pass): %s",
+                  tablet_id, server_id, e)
+            return False
+        return True
 
     # ---------------------------------------------------------------- move
     def _move_replica(self, tablet_id: str, leader_addr: str,

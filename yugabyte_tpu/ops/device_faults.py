@@ -16,9 +16,16 @@ Sites:
     where an async runtime fault or OOM actually materializes, because
     JAX dispatch is asynchronous and errors ride the value.
 
+  - "survivor": the SILENT kind — `maybe_flip_survivors` corrupts one
+    downloaded survivor decision in place (kind "bitflip", no
+    exception), modeling an HBM bit flip / donation bug / miscompile
+    that loud-fault containment cannot see. Shadow verification
+    (storage/integrity.py) is the defense it tests.
+
 Arming is programmatic (`arm()`) or via the environment for child
 processes: YBTPU_INJECT_DEVICE_FAULT="<kind>:<site>:<count>", e.g.
-"oom:result:1". Counts decrement per fire; count <= 0 disarms.
+"oom:result:1" or "bitflip:survivor:1". Counts decrement per fire;
+count <= 0 disarms.
 
 `is_device_fault()` classifies BOTH injected and real device failures
 (jaxlib XlaRuntimeError, RESOURCE_EXHAUSTED messages) so the
@@ -33,8 +40,8 @@ from typing import List, Optional
 
 __all__ = ["InjectedDeviceFault", "InjectedCompileError",
            "InjectedResourceExhausted", "InjectedDispatchFault",
-           "arm", "disarm_all", "maybe_fault", "is_device_fault",
-           "armed_count"]
+           "arm", "disarm_all", "maybe_fault", "maybe_flip_survivors",
+           "is_device_fault", "armed_count"]
 
 
 class InjectedDeviceFault(Exception):
@@ -62,16 +69,25 @@ _KINDS = {
                 "injected device dispatch fault (nemesis)"),
 }
 
+# Silent-corruption model (no exception — the HBM-bit-flip class that
+# shadow verification exists to catch): armed like the loud kinds but
+# consumed by maybe_flip_survivors, which MUTATES a downloaded survivor
+# decision instead of raising.
+_BITFLIP = "bitflip"
+_SITES = ("dispatch", "result", "survivor")
+
 _lock = threading.Lock()
 _armed: List[dict] = []   # guarded-by: _lock
 _env_loaded = False       # guarded-by: _lock
 
 
 def arm(kind: str, site: str = "dispatch", count: int = 1) -> None:
-    """Arm `count` faults of `kind` ('compile'|'oom'|'runtime') at `site`
-    ('dispatch'|'result'). Several armings stack."""
-    assert kind in _KINDS, kind
-    assert site in ("dispatch", "result"), site
+    """Arm `count` faults of `kind` ('compile'|'oom'|'runtime'|'bitflip')
+    at `site` ('dispatch'|'result'|'survivor'). Several armings stack;
+    'bitflip' only fires at the 'survivor' site (silent corruption of a
+    downloaded decision buffer, no exception)."""
+    assert kind in _KINDS or kind == _BITFLIP, kind
+    assert site in _SITES, site
     with _lock:
         _armed.append({"kind": kind, "site": site, "count": count})
 
@@ -96,13 +112,14 @@ def _load_env_locked() -> None:  # guarded-by: _lock
         return
     for part in spec.split(","):
         bits = part.strip().split(":")
-        if len(bits) >= 1 and bits[0] in _KINDS:
-            site = bits[1] if len(bits) > 1 else "dispatch"
+        if len(bits) >= 1 and (bits[0] in _KINDS or bits[0] == _BITFLIP):
+            site = bits[1] if len(bits) > 1 else (
+                "survivor" if bits[0] == _BITFLIP else "dispatch")
             try:
                 count = int(bits[2]) if len(bits) > 2 else 1
             except ValueError:  # yblint: contained(malformed env count defaults to 1 — arming still happens)
                 count = 1
-            if site in ("dispatch", "result"):
+            if site in _SITES:
                 _armed.append({"kind": bits[0], "site": site,
                                "count": count})
 
@@ -126,6 +143,41 @@ def maybe_fault(site: str) -> None:
             return
     _fault_counter(a["kind"]).increment()
     raise exc_type(msg)
+
+
+def maybe_flip_survivors(surv, make_tomb) -> bool:
+    """Consume one armed 'bitflip' fault by SILENTLY corrupting a
+    downloaded survivor decision in place — the HBM-bit-flip /
+    miscompile model the shadow verifier exists to catch. Flips the low
+    bit of an odd survivor index (stays in range: the write path would
+    gather a duplicate row, not crash), falling back to a tombstone-flag
+    flip when every index is even. Returns True when a flip fired."""
+    with _lock:
+        _load_env_locked()
+        hit = None
+        for a in _armed:
+            if a["kind"] == _BITFLIP and a["count"] > 0:
+                a["count"] -= 1
+                if a["count"] <= 0:
+                    _armed.remove(a)
+                hit = a
+                break
+        if hit is None:
+            return False
+    flipped = False
+    if len(surv):
+        odd = [i for i in range(len(surv)) if int(surv[i]) & 1]
+        if odd:
+            i = odd[len(odd) // 2]
+            surv[i] = int(surv[i]) ^ 1
+            flipped = True
+    if not flipped and len(make_tomb):
+        i = len(make_tomb) // 2
+        make_tomb[i] = not bool(make_tomb[i])
+        flipped = True
+    if flipped:
+        _fault_counter(_BITFLIP).increment()
+    return flipped
 
 
 def _fault_counter(kind: str):

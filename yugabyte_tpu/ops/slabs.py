@@ -330,7 +330,7 @@ def _doc_key_len(key_prefix: bytes) -> int:
     from yugabyte_tpu.docdb.doc_key import DocKey
     try:
         _, pos = DocKey.decode(key_prefix, 0)
-    except (ValueError, IndexError, struct.error):
+    except (ValueError, IndexError, struct.error):  # yblint: contained(non-doc system keys are by definition undecodable — whole key is the document, no error to route)
         return len(key_prefix)
     return pos
 

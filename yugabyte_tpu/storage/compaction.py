@@ -594,7 +594,10 @@ def run_compaction_job_device_native(
     except Exception as e:  # noqa: BLE001 — device-fault containment
         from yugabyte_tpu.ops import device_faults
         from yugabyte_tpu.ops.run_merge import DeviceFaultError
-        if not (isinstance(e, DeviceFaultError)
+        from yugabyte_tpu.storage.integrity import (ShadowMismatch,
+                                                    shadow_mismatch_counter)
+        shadow_mm = isinstance(e, ShadowMismatch)
+        if not (shadow_mm or isinstance(e, DeviceFaultError)
                 or device_faults.is_device_fault(e)):
             # host-side failures (disk faults, cancellation) take their
             # own containment paths — only KERNEL-path faults may fall
@@ -604,9 +607,19 @@ def run_compaction_job_device_native(
         offload_policy_mod.bucket_quarantine().quarantine(
             qkey, reason=f"{type(cause).__name__}: {cause}")
         _storage_fallback_counter().increment()
-        TRACE("compaction: device fault mid-job (%r) — shape bucket "
-              "k_pad=%d m=%d quarantined; completing via the native "
-              "merge", cause, *qkey)
+        if shadow_mm:
+            # the alarm: device decisions diverged from the native
+            # oracle — a SILENT-corruption event (bit flip / donation
+            # bug / miscompile), never an expected fault
+            shadow_mismatch_counter().increment()
+            TRACE("compaction: SHADOW VERIFY MISMATCH (%s) — partial "
+                  "outputs deleted, shape bucket k_pad=%d m=%d "
+                  "quarantined; re-running the job natively", cause,
+                  *qkey)
+        else:
+            TRACE("compaction: device fault mid-job (%r) — shape bucket "
+                  "k_pad=%d m=%d quarantined; completing via the native "
+                  "merge", cause, *qkey)
         # Byte-identical completion: the attempt unwound cleanly (its
         # partial outputs deleted, staging leases released), so the
         # whole job re-runs on the native path over the SAME filtered
@@ -686,13 +699,21 @@ def _device_native_body(
         retain_deletes: bool, device, block_entries, device_cache,
         run_cache, cancel, pipeline: bool, cached_ids,
         tombstone_value: bytes, state: dict) -> CompactionResult:
-    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.ops import device_faults, run_merge
     from yugabyte_tpu.ops.merge_gc import stage_slab
-    from yugabyte_tpu.storage import native_engine
+    from yugabyte_tpu.storage import integrity, native_engine
 
     import threading
     import time as _time
     from yugabyte_tpu.utils.metrics import record_pipeline_stage
+
+    # Online shadow verification (sampled): the native heap-merge oracle
+    # re-derives this job's survivor decisions on its own thread
+    # (overlapping the device work below); every decision chunk is
+    # compared before its bytes can install. A mismatch unwinds the
+    # attempt, quarantines the bucket and re-runs the job natively.
+    shadow = integrity.maybe_shadow_verifier(
+        inputs, history_cutoff_ht, is_major, retain_deletes)
 
     with native_engine.NativeCompactionJob() as job:
         # -- stage A (host): the native shell ingests the input bytes on
@@ -825,16 +846,30 @@ def _device_native_body(
                     cancel.check()  # chunk boundary: abort in-flight job
                 surv = perm_c[keep_c]
                 mk_surv = mk_c[keep_c]
+                # silent-corruption injection point (tests): a flipped
+                # decision lands in the SST unless shadow verify is on
+                device_faults.maybe_flip_survivors(surv, mk_surv)
+                if shadow is not None:
+                    shadow.check_chunk(surv, mk_surv)
                 tombstones_written += int(np.count_nonzero(mk_surv))
                 job.append_survivors(surv, mk_surv)
                 writer.feed(job.n_survivors)
             rows_out = job.n_survivors
+            if shadow is not None:
+                shadow.finish(rows_out)  # before the tail files write
             outputs, ranges = writer.finish(rows_out)
         else:
             perm, keep, mk = handle.result()
-            tombstones_written = int(np.count_nonzero(mk[keep]))
-            job.set_survivors(perm[keep], mk[keep])
+            surv = perm[keep]
+            mk_surv = mk[keep]
+            device_faults.maybe_flip_survivors(surv, mk_surv)
+            if shadow is not None:
+                shadow.check_chunk(surv, mk_surv)
+            tombstones_written = int(np.count_nonzero(mk_surv))
+            job.set_survivors(surv, mk_surv)
             rows_out = job.n_survivors
+            if shadow is not None:
+                shadow.finish(rows_out)
             outputs, ranges = writer.finish(job.n_survivors)
         if run_cache is not None:
             # run-cache write-through: exported survivors are

@@ -28,6 +28,7 @@ from yugabyte_tpu.storage.sst import (
     BlockCache, Frontier, SSTReader, SSTWriter, data_file_name)
 from yugabyte_tpu.storage.version_set import VersionSet
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.status import StatusError
 from yugabyte_tpu.utils.threadpool import PriorityThreadPool
 from yugabyte_tpu.utils.trace import TRACE
 
@@ -257,12 +258,22 @@ class DB:
                 f"DB {self.db_dir} is read-only after a background error "
                 f"({err}); retry later"))
 
-    def _set_background_error(self, where: str, exc: BaseException) -> None:
-        from yugabyte_tpu.utils.status import Status
-        st = Status.IoError(f"{where} failed in {self.db_dir}: {exc}")
+    def _set_background_error(self, where: str, exc: BaseException,
+                              corruption: bool = False) -> None:
+        from yugabyte_tpu.utils.status import Code, Status
+        if corruption:
+            st = Status.Corruption(
+                f"{where} detected corrupt data in {self.db_dir}: {exc}")
+        else:
+            st = Status.IoError(f"{where} failed in {self.db_dir}: {exc}")
         with self._lock:
             if self._bg_error is not None:
-                return  # first error wins; recovery clears it
+                # first error wins — except corruption, which UPGRADES a
+                # retryable I/O park: lost bytes need a rebuild, and the
+                # sticky corruption code is what blocks in-place retry
+                if not corruption or \
+                        self._bg_error.code == Code.CORRUPTION:
+                    return
             self._bg_error = st
         TRACE("db %s: background error (%s): %s", self.db_dir, where, exc)
         cb = self.on_background_error
@@ -279,8 +290,14 @@ class DB:
         """Clear the parked error and retry the failed work (the
         maintenance manager drives this with capped backoff, ref
         DBImpl::Resume). Returns True when the DB is healthy again; a
-        failing retry re-parks it."""
+        failing retry re-parks it. A CORRUPTION error is STICKY: lost
+        bytes cannot be retried back into existence — the replica must
+        be rebuilt from a healthy peer (remote bootstrap)."""
+        from yugabyte_tpu.utils.status import Code
         with self._lock:
+            if self._bg_error is not None \
+                    and self._bg_error.code == Code.CORRUPTION:
+                return False
             if self._cancel.cancelled and not self._closed:
                 # recovery re-arms the cancellation seam for the retried
                 # background work (the old token is permanently tripped;
@@ -535,9 +552,27 @@ class DB:
         t0 = _time.monotonic()
         try:
             return self._get_inner(key_prefix, read_ht)
+        except StatusError as e:
+            self._route_read_corruption(e)
+            raise
         finally:
             _storage_metrics()[0].increment(
                 (_time.monotonic() - t0) * 1e3)
+
+    def _route_read_corruption(self, e: "StatusError") -> None:
+        """A read that hit corrupt SST bytes (block CRC / footer
+        mismatch) must not surface as a raw Corruption to the client:
+        route it to the background-error slot — parking the DB and
+        failing the tablet so the master rebuilds the replica — and
+        re-raise RETRYABLY so the client walks to a healthy replica."""
+        from yugabyte_tpu.utils.status import Code, Status
+        if e.status.code != Code.CORRUPTION:
+            return
+        self._set_background_error("read", e, corruption=True)
+        raise StatusError(Status.ServiceUnavailable(
+            f"read hit corrupt SST data in {self.db_dir} "
+            f"({e.status.message}); replica is being repaired — retry "
+            f"another replica")) from e
 
     def _get_inner(self, key_prefix: bytes,
                    read_ht: Optional[HybridTime] = None
@@ -671,7 +706,13 @@ class DB:
         try:
             staged = [None] * len(slabs)
             for fid, r in readers:
-                sl = r.read_all()
+                try:
+                    sl = r.read_all()
+                except StatusError as e:
+                    # corrupt block under a scan: park + fail retryably
+                    # (the client walks replicas), never a raw Corruption
+                    self._route_read_corruption(e)
+                    raise
                 slabs.append(sl)
                 if self._device_cache is not None:
                     st = self._device_cache.get(fid)
@@ -835,10 +876,18 @@ class DB:
                 raise
             # Contained like a failed flush: the version set still points
             # at the inputs (nothing installed), partial outputs are swept,
-            # and the DB parks read-only for the backoff retry.
+            # and the DB parks read-only for the backoff retry. A
+            # CORRUPTION status (corrupt input block tripped the decode —
+            # Python or native shell) parks STICKY instead: retrying into
+            # the same bad bytes can never succeed; the replica must be
+            # rebuilt from a healthy peer.
+            from yugabyte_tpu.utils.status import Code
             with self._lock:
                 self._sweep_orphan_outputs_unlocked()
-            self._set_background_error("compaction", e)
+            self._set_background_error(
+                "compaction", e,
+                corruption=isinstance(e, StatusError)
+                and e.status.code == Code.CORRUPTION)
 
     def _run_compaction_inner(self, pick) -> None:
         try:
@@ -921,6 +970,56 @@ class DB:
             r = self._obsolete.pop(fid)
             r.close()
             _delete_sst_files(r.base_path)
+
+    # ------------------------------------------------------------------ scrub
+    def scrub(self, limiter=None, cancel=None) -> dict:
+        """At-rest integrity scrub: deep-verify every live SST (block
+        CRCs, footer, index/bloom consistency — storage/integrity.py) at
+        a throttled byte rate. Files are PINNED while verified so a
+        concurrent compaction cannot delete them mid-read. A corrupt
+        file is quarantined (renamed ``*.corrupt``) and the DB parks
+        with a STICKY Corruption background error — the owner tablet
+        goes FAILED (``failed_corrupt``) and must be rebuilt from a
+        healthy peer; in-place retry is refused."""
+        from yugabyte_tpu.storage import integrity
+        from yugabyte_tpu.utils.status import Status
+        with self._lock:
+            targets = [(fid, r.base_path)
+                       for fid, r in self._readers.items()]
+            for fid, _ in targets:
+                self._pins[fid] = self._pins.get(fid, 0) + 1
+        report = {"files": 0, "blocks": 0, "entries": 0, "bytes": 0,
+                  "corrupt": []}
+        try:
+            for fid, base_path in targets:
+                if cancel is not None:
+                    cancel.check()
+                rep = integrity.verify_sst(base_path, limiter=limiter,
+                                           cancel=cancel)
+                report["files"] += 1
+                report["blocks"] += rep.n_blocks
+                report["entries"] += rep.n_entries
+                report["bytes"] += rep.bytes_verified
+                if rep.errors:
+                    report["corrupt"].append(
+                        {"path": base_path, "errors": rep.errors[:4]})
+                    integrity.quarantine_sst(base_path,
+                                             reason=rep.errors[0])
+                    self._set_background_error(
+                        "scrub",
+                        StatusError(Status.Corruption(
+                            f"{base_path}: {rep.errors[0]}")),
+                        corruption=True)
+        finally:
+            with self._lock:
+                for fid, _ in targets:
+                    self._pins[fid] -= 1
+                    if not self._pins[fid]:
+                        del self._pins[fid]
+                self._purge_obsolete_unlocked()
+        integrity.record_scrub(report["files"], report["blocks"],
+                               report["bytes"], len(report["corrupt"]))
+        return report
 
     # ------------------------------------------------------------ checkpoint
     def checkpoint(self, out_dir: str) -> None:

@@ -205,7 +205,13 @@ class NativeCompactionJob:
     def prepare(self) -> int:
         n = int(self._lib.ce_job_prepare(self._job))
         if n < 0:
-            raise RuntimeError(f"native compaction prepare: {self._err()}")
+            # prepare fails only in block decode (magic/CRC/size checks,
+            # native/compaction_engine.cc): the input bytes are corrupt.
+            # Typed as Corruption so the DB parks STICKY and the replica
+            # is rebuilt instead of retrying into the same bad bytes.
+            from yugabyte_tpu.utils.status import Status, StatusError
+            raise StatusError(Status.Corruption(
+                f"native compaction prepare: {self._err()}"))
         self.rows_in = n
         return n
 

@@ -628,6 +628,21 @@ class Tablet:
     def compact(self) -> None:
         self.regular_db.compact_all()
 
+    def scrub(self, limiter=None, cancel=None) -> dict:
+        """At-rest integrity scrub of both DBs (block CRCs + footer +
+        index/bloom consistency, throttled; storage/integrity.py). A
+        corrupt file parks its DB with a sticky Corruption error, which
+        fails this tablet for rebuild-from-peer. Returns the merged
+        report."""
+        merged = {"files": 0, "blocks": 0, "entries": 0, "bytes": 0,
+                  "corrupt": []}
+        for db in (self.regular_db, self.intents_db):
+            rep = db.scrub(limiter=limiter, cancel=cancel)
+            for k in ("files", "blocks", "entries", "bytes"):
+                merged[k] += rep[k]
+            merged["corrupt"].extend(rep["corrupt"])
+        return merged
+
     def checkpoint(self, out_dir: str) -> None:
         """Hard-link snapshot of both DBs (remote bootstrap / backup input)."""
         self.flush()

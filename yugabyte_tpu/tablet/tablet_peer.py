@@ -194,6 +194,14 @@ class TabletPeer:
         # tablet.cc MarkTabletFailed).
         self.state = STATE_RUNNING
         self.failed_status: Optional[Status] = None
+        # data-corruption failure (scrub / read-path CRC mismatch /
+        # digest divergence): in-place recovery is impossible — the
+        # heartbeat reports it and the master rebuilds this replica from
+        # a healthy peer (remote bootstrap in place)
+        self.failed_corrupt = False
+        # last at-rest scrub of this replica (wall ts + totals), set by
+        # the ScrubTabletsOp; {} until the first scrub
+        self.scrub_state: dict = {}
         for db in (self.tablet.regular_db, self.tablet.intents_db):
             db.on_background_error = self._on_storage_error
         self.log.on_io_error = self._on_log_error
@@ -268,7 +276,15 @@ class TabletPeer:
         """Transition to FAILED: writes reject retryably, reads drain, the
         next heartbeat reports the state so the master can re-replicate.
         In-flight background compactions (including the device-offload
-        pipeline) are cancelled at their next stage boundary."""
+        pipeline) are cancelled at their next stage boundary. A
+        CORRUPTION status additionally marks the replica
+        ``failed_corrupt``: its data is bad, so recovery is a rebuild
+        from a healthy peer, never an in-place retry."""
+        from yugabyte_tpu.utils.status import Code
+        if status.code == Code.CORRUPTION:
+            # set even when already FAILED: corruption discovered under
+            # an I/O park upgrades the required recovery to a rebuild
+            self.failed_corrupt = True
         if self.state == STATE_FAILED:
             return
         self.state = STATE_FAILED
@@ -293,6 +309,11 @@ class TabletPeer:
         Returns True when the peer is RUNNING again."""
         if self.state != STATE_FAILED:
             return True
+        if self.failed_corrupt:
+            # lost/diverged bytes cannot be retried back into existence:
+            # stay parked until the master rebuilds this replica from a
+            # healthy peer (load_balancer in-place remote bootstrap)
+            return False
         if self.log.io_error is not None:
             return False
         for db in (self.tablet.regular_db, self.tablet.intents_db):

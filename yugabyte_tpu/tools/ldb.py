@@ -3,9 +3,13 @@
     python -m yugabyte_tpu.tools.ldb scan     --db <dir> [--limit N]
     python -m yugabyte_tpu.tools.ldb get      --db <dir> --key <hex>
     python -m yugabyte_tpu.tools.ldb manifest --db <dir>
+    python -m yugabyte_tpu.tools.ldb verify   --db <dir>
 
 Read-only: opens the manifest + SSTs in place (a live DB's files are
 immutable once written, so inspecting a running tablet's dir is safe).
+`verify` deep-checks every live SST (block CRCs + footer + index/bloom
+consistency — the background scrubber's core) and exits non-zero on
+corruption.
 """
 
 from __future__ import annotations
@@ -96,10 +100,36 @@ def cmd_get(db_dir: str, key_hex: str, out) -> int:
             r.close()
 
 
+def cmd_verify(db_dir: str, out) -> int:
+    """Deep-check every live SST of the DB; exit 1 on any corruption."""
+    import os
+
+    from yugabyte_tpu.storage.integrity import verify_sst
+    from yugabyte_tpu.storage.version_set import VersionSet
+    versions = VersionSet(db_dir)
+    versions.recover()
+    bad = 0
+    files = 0
+    for fm in versions.live_files():
+        path = os.path.join(db_dir, f"{fm.file_id:06d}.sst")
+        rep = verify_sst(path)
+        files += 1
+        status = "OK" if rep.ok else f"{len(rep.errors)} error(s)"
+        print(f"  {fm.file_id:06d}.sst blocks={rep.n_blocks} "
+              f"bytes={rep.bytes_verified}: {status}", file=out)
+        for err in rep.errors:
+            print(f"    CORRUPT: {err}", file=out)
+        if not rep.ok:
+            bad += 1
+    print(f"verify: {files} file(s), "
+          + ("all OK" if bad == 0 else f"{bad} corrupt"), file=out)
+    return 0 if bad == 0 else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="ldb")
     sub = ap.add_subparsers(dest="cmd", required=True)
-    for name in ("scan", "get", "manifest"):
+    for name in ("scan", "get", "manifest", "verify"):
         p = sub.add_parser(name)
         p.add_argument("--db", required=True)
         if name == "scan":
@@ -111,6 +141,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_manifest(args.db, sys.stdout)
     if args.cmd == "scan":
         return cmd_scan(args.db, args.limit, sys.stdout)
+    if args.cmd == "verify":
+        return cmd_verify(args.db, sys.stdout)
     return cmd_get(args.db, args.key, sys.stdout)
 
 

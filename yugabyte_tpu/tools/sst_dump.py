@@ -1,9 +1,13 @@
 """sst_dump: inspect one SST file (ref: rocksdb/tools/sst_dump_tool.cc).
 
     python -m yugabyte_tpu.tools.sst_dump <base.sst> [--entries N] [--blocks]
+    python -m yugabyte_tpu.tools.sst_dump <base.sst> --verify
 
 Prints props + frontier (+ block index and sample entries), decoding DocDB
-keys into doc-key / subkey / hybrid-time components.
+keys into doc-key / subkey / hybrid-time components. --verify runs the
+deep integrity check (every block CRC + footer + index/bloom
+consistency — the same storage/integrity.py core the background scrubber
+uses) and exits non-zero on corruption.
 """
 
 from __future__ import annotations
@@ -75,12 +79,34 @@ def dump(base_path: str, entries: int = 10, blocks: bool = False,
         r.close()
 
 
+def verify(base_path: str, out=None) -> int:
+    """Deep integrity check of one SST; exit 0 = clean, 1 = corrupt."""
+    from yugabyte_tpu.storage.integrity import verify_sst
+    out = out or sys.stdout
+    rep = verify_sst(base_path)
+    print(f"file:     {base_path}", file=out)
+    print(f"blocks:   {rep.n_blocks} verified "
+          f"({rep.bytes_verified} bytes, {rep.n_entries} entries)",
+          file=out)
+    for err in rep.errors:
+        print(f"  CORRUPT: {err}", file=out)
+    print("verify: " + ("OK" if rep.ok
+                        else f"{len(rep.errors)} error(s)"), file=out)
+    return 0 if rep.ok else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="sst_dump")
     ap.add_argument("base_path")
     ap.add_argument("--entries", type=int, default=10)
     ap.add_argument("--blocks", action="store_true")
+    ap.add_argument("--verify", action="store_true",
+                    help="deep-check every block CRC + footer + "
+                         "index/bloom consistency; non-zero exit on "
+                         "corruption")
     args = ap.parse_args(argv)
+    if args.verify:
+        return verify(args.base_path)
     return dump(args.base_path, args.entries, args.blocks)
 
 

@@ -3,10 +3,12 @@ cluster_verifier.h).
 
     python -m yugabyte_tpu.tools.ysck --masters host:port[,host:port]
 
-Walks every table: checks tserver liveness, per-tablet leadership, and
+Walks every table: checks tserver liveness, per-tablet leadership,
 cross-replica checksums at one read time per tablet (the same
-visibility-resolved digest the crash-fault harness asserts on). Exit 0 =
-healthy, 1 = problems found.
+visibility-resolved digest the crash-fault harness asserts on), and each
+replica's integrity state (at-rest scrub timestamp/totals, corruption
+flags, digest-mismatch counts — the scrub_status RPC). Exit 0 = healthy,
+1 = problems found (divergence, detected corruption, repairs in flight).
 """
 
 from __future__ import annotations
@@ -66,6 +68,33 @@ def check_cluster(master_addrs: List[str], out=None) -> int:
                           f"DIVERGENCE {sums}", file=out)
                 elif sums:
                     total_rows += next(iter(sums.values()))[1]
+                # per-replica integrity state: scrub recency + detected
+                # corruption (a corrupt replica is being rebuilt — count
+                # it as a problem so operators see the repair in flight)
+                for addr in addrs:
+                    try:
+                        st = client._messenger.call(
+                            addr, "tserver", "scrub_status",
+                            timeout_s=10.0, tablet_id=loc["tablet_id"])
+                    except StatusError:
+                        continue  # replica mid-rebuild / older server
+                    scrub = st.get("scrub") or {}
+                    corrupt = scrub.get("corrupt", 0)
+                    mism = scrub.get("replica_mismatches", 0)
+                    last = scrub.get("last_scrub_ts")
+                    if st.get("failed_corrupt") or corrupt:
+                        problems += 1
+                        bad += 1
+                        print(f"  {name}/{loc['tablet_id']}@{addr}: "
+                              f"CORRUPT replica (scrub errors={corrupt},"
+                              f" rebuilding)", file=out)
+                    elif last or mism:
+                        import time as _time
+                        age = (f"{_time.time() - last:.0f}s ago"
+                               if last else "never")
+                        print(f"  {name}/{loc['tablet_id']}@{addr}: "
+                              f"scrub {age}, digest mismatches={mism}",
+                              file=out)
             status = "OK" if bad == 0 else f"{bad} bad tablets"
             print(f"table {name}: {len(locs)} tablets, ~{total_rows} "
                   f"rows: {status}", file=out)

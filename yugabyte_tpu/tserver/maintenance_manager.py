@@ -19,7 +19,9 @@ tablets that went idle mid-backlog), and RecoverOp — the capped-
 exponential-backoff retry that un-parks tablets in FAILED state after a
 background storage error (ref DBImpl::Resume driven by
 ErrorHandler::RecoverFromBGError). External subsystems can register
-custom MaintenanceOps through register_op().
+custom MaintenanceOps through register_op() — the TabletServer registers
+PrewarmKernelsOp (startup kernel compile) and ScrubTabletsOp (at-rest
+integrity scrub + cross-replica digest exchange) this way.
 """
 
 from __future__ import annotations
@@ -173,6 +175,90 @@ class PrewarmKernelsOp(MaintenanceOp):
         self.done = True
         TRACE("maintenance: prewarmed %d compaction kernel executables",
               n)
+
+
+class ScrubTabletsOp(MaintenanceOp):
+    """Background at-rest integrity scrubber: deep-verifies each RUNNING
+    tablet's SSTs (block CRCs + footer + index/bloom consistency) on a
+    ``--scrub_interval_s`` cadence, reads throttled through the
+    process-wide ``--scrub_bytes_per_sec`` token bucket, one tablet per
+    perform() so the scheduler stays responsive. When the scrubbed
+    tablet is a Raft leader, a cross-replica digest exchange (the
+    ``checksum_tablet`` RPC, via the server-provided ``digest_check``
+    hook) follows the local scrub — the detector for divergence that
+    byte-level CRCs cannot see.
+
+    Scored just above zero: scrubbing is strictly idle-time work — any
+    flush/compaction/recovery debt outranks it (the reference's
+    VerifyChecksum sweeps are likewise background-priority)."""
+
+    SCRUB_SCORE = 0.05
+
+    def __init__(self, peers_fn: Callable[[], List],
+                 digest_check: Optional[Callable[[object], int]] = None):
+        super().__init__("scrub_tablets")
+        self._peers_fn = peers_fn
+        self._digest_check = digest_check
+        # tablet_id -> monotonic ts of its last scrub; tablets never
+        # scrubbed age from op construction (a fresh server's files were
+        # just written/bootstrapped — scrubbing them immediately would
+        # burn startup I/O for nothing)
+        self._last: Dict[str, float] = {}
+        self._t0 = time.monotonic()
+
+    def _due_peer(self):
+        """Most-overdue RUNNING tablet at or past the interval, else
+        None."""
+        from yugabyte_tpu.tablet.tablet_peer import STATE_RUNNING
+        from yugabyte_tpu.storage import integrity  # noqa: F401 (flags)
+        interval = float(flags.get_flag("scrub_interval_s"))
+        if interval <= 0:
+            return None
+        now = time.monotonic()
+        best, best_age = None, interval
+        live = set()
+        for peer in self._peers_fn():
+            live.add(peer.tablet_id)
+            if peer.state != STATE_RUNNING:
+                continue
+            age = now - self._last.get(peer.tablet_id, self._t0)
+            if age >= best_age:
+                best, best_age = peer, age
+        for tid in [t for t in self._last if t not in live]:
+            del self._last[tid]  # deleted/moved tablets drop tracking
+        return best
+
+    def update_stats(self, stats: MaintenanceOpStats) -> None:
+        stats.runnable = self._due_peer() is not None
+        stats.perf_improvement = self.SCRUB_SCORE
+
+    def perform(self) -> None:
+        from yugabyte_tpu.storage import integrity
+        peer = self._due_peer()
+        if peer is None:
+            return
+        self._last[peer.tablet_id] = time.monotonic()
+        report = peer.tablet.scrub(limiter=integrity.scrub_rate_limiter())
+        mismatches = 0
+        if self._digest_check is not None and not report["corrupt"] \
+                and peer.raft.is_leader():
+            mismatches = self._digest_check(peer)
+        prev = peer.scrub_state or {}
+        peer.scrub_state = {
+            "last_scrub_ts": time.time(),
+            "files": report["files"], "bytes": report["bytes"],
+            "corrupt": prev.get("corrupt", 0) + len(report["corrupt"]),
+            "replica_mismatches": prev.get("replica_mismatches", 0)
+            + mismatches,
+        }
+        if report["corrupt"]:
+            TRACE("scrub: tablet %s has %d corrupt SST(s) — quarantined "
+                  "and parked for rebuild: %s", peer.tablet_id,
+                  len(report["corrupt"]), report["corrupt"])
+        else:
+            TRACE("scrub: tablet %s clean (%d files, %d bytes, %d "
+                  "replica digest mismatches)", peer.tablet_id,
+                  report["files"], report["bytes"], mismatches)
 
 
 class _RecoverOp(MaintenanceOp):

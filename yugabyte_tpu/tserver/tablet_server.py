@@ -112,6 +112,15 @@ class TabletServer:
             prewarm = self.exec_context.prewarm_op()
             if prewarm is not None:
                 self.maintenance_manager.register_op(prewarm)
+        # at-rest integrity scrubber (interval-gated; leader tablets also
+        # run the cross-replica digest exchange after a clean local scrub)
+        from yugabyte_tpu.tserver.maintenance_manager import ScrubTabletsOp
+        self._digest_strikes: Dict = {}  # (tablet, server) -> consecutive
+        #                                  mismatches; _addr_lock guards
+        self.scrub_op = ScrubTabletsOp(
+            peers_fn=self._tablet_peers,
+            digest_check=self._scrub_digest_check)
+        self.maintenance_manager.register_op(self.scrub_op)
         self.webserver = None
         if opts.webserver_port is not None:
             from yugabyte_tpu.server.webserver import Webserver
@@ -133,6 +142,9 @@ class TabletServer:
             # write amplification (the GetProperty("rocksdb.stats")
             # analogue, ref rocksdb/db/internal_stats.cc)
             self.webserver.register_json("/compactionz", self.compactionz)
+            # /integrityz: shadow-verification + scrub + quarantine state
+            # (the data-integrity loop's single pane of glass)
+            self.webserver.register_json("/integrityz", self.integrityz)
 
     def _tablet_peers(self):
         return self.tablet_manager.peers()
@@ -193,6 +205,100 @@ class TabletServer:
         return {"server_id": self.server_id, "totals": totals,
                 "pipeline": pipeline, "device_faults": device_faults,
                 "tablets": tablets}
+
+    def integrityz(self) -> dict:
+        """Data-integrity state: shadow-verify sampling + mismatch
+        counters, scrubber totals, quarantined files, and per-tablet
+        scrub timestamps / corruption flags."""
+        from yugabyte_tpu.storage import integrity
+        tablets = []
+        for peer in self.tablet_manager.peers():
+            tablets.append({
+                "tablet_id": peer.tablet_id,
+                "state": peer.state,
+                "failed_corrupt": bool(getattr(peer, "failed_corrupt",
+                                               False)),
+                "scrub": dict(getattr(peer, "scrub_state", None) or {}),
+            })
+        return {"server_id": self.server_id,
+                "shadow_verify": integrity.shadow_snapshot(),
+                "scrub": integrity.scrub_snapshot(),
+                "quarantined_files": integrity.quarantined_files(),
+                "tablets": tablets}
+
+    def _scrub_digest_check(self, peer) -> int:
+        """Leader-driven cross-replica digest exchange for one tablet
+        (reuses the checksum_tablet RPC): every follower's visibility-
+        resolved digest at one pinned read time must match the leader's.
+        A follower that mismatches ``--scrub_replica_fail_after``
+        CONSECUTIVE rounds is marked FAILED+corrupt through
+        mark_tablet_failed, and the master rebuilds it from a healthy
+        peer — the repair arm for divergence that byte-level CRCs cannot
+        see. Returns the mismatches seen this round."""
+        from yugabyte_tpu.storage.integrity import (
+            replica_mismatch_counter)
+        from yugabyte_tpu.utils import flags as _flags
+        from yugabyte_tpu.utils.trace import TRACE
+        tablet_id = peer.tablet_id
+        if not peer.raft.is_leader():
+            return 0
+        read_ht = peer.tablet.read_time(None).value
+        try:
+            local = self.service.checksum_tablet(tablet_id, read_ht)
+        except StatusError as e:
+            TRACE("scrub digest: local checksum of %s failed: %s",
+                  tablet_id, e)
+            return 0
+        mismatches = 0
+        fail_after = int(_flags.get_flag("scrub_replica_fail_after"))
+        for pid in peer.raft.config.peer_ids:
+            sid = pid.split("/", 1)[0]
+            if sid == self.server_id:
+                continue
+            addr = self._resolve_peer(pid)
+            if addr is None:
+                continue
+            key = (tablet_id, sid)
+            try:
+                remote = self.messenger.call(
+                    addr, "tserver", "checksum_tablet", timeout_s=30.0,
+                    tablet_id=tablet_id, read_ht=read_ht)
+            except StatusError as e:
+                # unreachable / mid-repair follower: not divergence
+                # evidence — reset its strike count and move on
+                TRACE("scrub digest: checksum of %s on %s failed: %s",
+                      tablet_id, sid, e)
+                with self._addr_lock:
+                    self._digest_strikes.pop(key, None)
+                continue
+            if remote["checksum"] == local["checksum"]:
+                with self._addr_lock:
+                    self._digest_strikes.pop(key, None)
+                continue
+            mismatches += 1
+            replica_mismatch_counter().increment()
+            with self._addr_lock:
+                strikes = self._digest_strikes.get(key, 0) + 1
+                self._digest_strikes[key] = strikes
+            TRACE("scrub digest: %s on %s diverges from leader "
+                  "(%#x != %#x; strike %d/%d)", tablet_id, sid,
+                  remote["checksum"], local["checksum"], strikes,
+                  fail_after)
+            if strikes >= fail_after:
+                with self._addr_lock:
+                    self._digest_strikes.pop(key, None)
+                try:
+                    self.messenger.call(
+                        addr, "tserver", "mark_tablet_failed",
+                        timeout_s=10.0, tablet_id=tablet_id,
+                        reason=(f"scrub digest divergence from leader "
+                                f"{self.server_id} at read_ht={read_ht}"),
+                        corrupt=True)
+                except StatusError as e:
+                    TRACE("scrub digest: failing %s on %s failed "
+                          "(retried next scrub round): %s", tablet_id,
+                          sid, e)
+        return mismatches
 
     def _status_page(self) -> dict:
         if self.exec_context is not None:

@@ -506,6 +506,36 @@ class TabletServiceImpl:
         self._tablets.get_tablet(tablet_id).tablet.flush()
         return True
 
+    # ------------------------------------------------------ data integrity
+    def scrub_status(self, tablet_id: str) -> dict:
+        """Per-replica integrity state: at-rest scrub timestamp/totals +
+        corruption flags (ysck surfaces these per tablet)."""
+        peer = self._tablets.get_tablet(tablet_id)
+        return {"tablet_id": tablet_id, "state": peer.state,
+                "failed_corrupt": bool(getattr(peer, "failed_corrupt",
+                                               False)),
+                "scrub": dict(getattr(peer, "scrub_state", None) or {})}
+
+    def scrub_tablet(self, tablet_id: str) -> dict:
+        """On-demand at-rest scrub of one replica (operator/ysck hook;
+        the background ScrubTabletsOp drives the same path on its
+        interval)."""
+        from yugabyte_tpu.storage import integrity
+        peer = self._tablets.get_tablet(tablet_id)
+        return peer.tablet.scrub(limiter=integrity.scrub_rate_limiter())
+
+    def mark_tablet_failed(self, tablet_id: str, reason: str,
+                           corrupt: bool = False) -> bool:
+        """Externally-driven FAILED transition: the scrub digest
+        exchange fails a diverged follower through this (corrupt=True,
+        so the master rebuilds it from a healthy peer rather than
+        retrying in place)."""
+        peer = self._tablets.get_tablet(tablet_id)
+        st = (Status.Corruption(reason) if corrupt
+              else Status.IoError(reason))
+        peer.mark_failed(st)
+        return True
+
     def compact_tablet(self, tablet_id: str) -> bool:
         self._tablets.get_tablet(tablet_id).tablet.compact()
         return True

@@ -261,11 +261,23 @@ class TSTabletManager:
         """Destination path: download a snapshot from source_addr and open
         the replica (ref remote_bootstrap_client.cc). Idempotent: a replica
         that already exists locally is left alone."""
+        from yugabyte_tpu.tablet.tablet_peer import STATE_FAILED
         from yugabyte_tpu.tserver.remote_bootstrap import download_tablet
         with self._create_lock:
             with self._lock:
-                if tablet_id in self._tablets:
+                cur = self._tablets.get(tablet_id)
+            if cur is not None:
+                if not (cur.state == STATE_FAILED
+                        and getattr(cur, "failed_corrupt", False)):
                     return
+                # Corruption-failed replica: its on-disk data is bad and
+                # sticky (retry refuses to clear it) — tear it down and
+                # rebuild in place from the healthy source the master
+                # pointed us at. Never done to a healthy replica: the
+                # idempotent-skip above protects those.
+                TRACE("ts %s: rebuilding corrupt replica %s from %s",
+                      self.server_id, tablet_id, source_addr)
+                self.delete_tablet(tablet_id)
             tdir = self._tablet_dir(tablet_id)
             if os.path.exists(os.path.join(tdir, "meta.json")):
                 self._open_tablet(
@@ -453,6 +465,12 @@ class TSTabletManager:
                 # server to go silent (ref tablet reports carrying
                 # RaftGroupStatePB / tablet data state).
                 "state": peer.state,
+                # corruption-failed replicas (scrub / read-path CRC /
+                # digest divergence) are rebuilt IN PLACE from a healthy
+                # peer — the disk is fine, the data is not, so no spare
+                # server is needed
+                "failed_corrupt": bool(getattr(peer, "failed_corrupt",
+                                               False)),
                 "term": peer.raft.current_term,
                 "leader_ready": peer.raft.is_leader() and
                 peer.raft.leader_ready(),

@@ -292,6 +292,13 @@ class FaultInjectionEnv(Env):
     path_filter is a substring match on the path ("" = every file); count
     bounds how many times the fault fires (None = until cleared).
 
+    At-rest corruption (corrupt_range): bit-flips in ALREADY-WRITTEN
+    bytes of an SST/WAL file — the silent bit-rot model the background
+    scrubber and read-path CRC containment are tested against. Applied
+    to the PHYSICAL bytes (below any encryption layer), exactly like a
+    decaying disk; nothing raises at flip time — detection is the
+    storage layer's job.
+
     Dropped fsyncs (set_drop_fsyncs): flush(fsync=True) silently succeeds
     without durability — the lying-disk model. simulate_crash() then
     applies the loss: append files are truncated to their last truly
@@ -313,6 +320,17 @@ class FaultInjectionEnv(Env):
         # (None = file did not exist)
         self._whole: Dict[str, Optional[bytes]] = {}
         self.faults_injected = 0
+        self.corruptions_injected = 0
+
+    # -------------------------------------------------- at-rest corruption
+    def corrupt_range(self, path: str, offset: Optional[int] = None,
+                      length: int = 1, nbits: int = 1) -> List[int]:
+        """Flip ``nbits`` bits spread over ``[offset, offset+length)`` of
+        the file's PHYSICAL bytes in place (read-modify-write below any
+        Env layering) — silent at-rest bit rot. offset=None targets the
+        middle of the file. Returns the byte offsets flipped."""
+        self.corruptions_injected += 1
+        return corrupt_file_range(path, offset, length, nbits)
 
     @property
     def encrypted(self) -> bool:  # type: ignore[override]
@@ -489,6 +507,38 @@ class _FaultAppendFile:
 
     def close(self) -> None:
         self._raw.close()
+
+
+def corrupt_file_range(path: str, offset: Optional[int] = None,
+                       length: int = 1, nbits: int = 1) -> List[int]:
+    """Flip ``nbits`` bits over ``[offset, offset+length)`` of ``path``'s
+    physical bytes (see FaultInjectionEnv.corrupt_range, which delegates
+    here so tests without a fault env can corrupt too). Deterministic:
+    flipped offsets are evenly spread over the range, one bit (cycling
+    bit position by index) per byte."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot corrupt empty file {path}")
+    if offset is None:
+        offset = size // 2
+    offset = max(0, min(offset, size - 1))
+    length = max(1, min(length, size - offset))
+    nbits = max(1, nbits)
+    step = max(1, length // nbits)
+    flipped: List[int] = []
+    with open(path, "r+b") as f:
+        for i in range(nbits):
+            off = offset + min(i * step, length - 1)
+            if off >= size:
+                break
+            f.seek(off)
+            (b,) = f.read(1)
+            f.seek(off)
+            f.write(bytes([b ^ (1 << (i % 8))]))
+            flipped.append(off)
+        f.flush()
+        os.fsync(f.fileno())
+    return flipped
 
 
 # ------------------------------------------------------------ process env
