@@ -419,6 +419,8 @@ def run_device_child(platform: str, workload_path: str,
     _attach_values(e2e_slab, 64)
     workdir = tempfile.mkdtemp(prefix="ybtpu-bench-")
     e2e_steady = e2e_steady2 = e2e_cold = 0.0
+    resident_chain = 0.0
+    cache_hit_ratio = 0.0
     e2e_rows = -1
     stage_ms = {}
     bucket_hits = bucket_misses = 0
@@ -447,23 +449,26 @@ def run_device_child(platform: str, workload_path: str,
             for fid, r in zip(input_ids, readers):
                 export_reader(rc, fid, r)
 
-            def run_dn(out_name, use_cache):
+            def run_dn(out_name, use_cache, job_readers=None,
+                       job_ids=None, n_rows=None):
                 out = os.path.join(workdir, out_name)
                 os.makedirs(out, exist_ok=True)
                 t0 = time.time()
                 res = compaction_mod.run_compaction_job_device_native(
-                    readers, out, lambda: next(ids), cutoff, True,
-                    device=dev,
+                    job_readers or readers, out, lambda: next(ids),
+                    cutoff, True, device=dev,
                     device_cache=cache if use_cache else None,
-                    input_ids=input_ids if use_cache else None,
+                    input_ids=(job_ids or input_ids) if use_cache
+                    else None,
                     run_cache=rc if use_cache else None)
-                return e2e_n / (time.time() - t0), res.rows_out
+                return (n_rows or e2e_n) / (time.time() - t0), res
 
             run_dn("warm", True)  # compile/warm
             from yugabyte_tpu.utils.metrics import (kernel_metrics,
                                                     pipeline_stage_totals)
             stage_before = pipeline_stage_totals()
-            e2e_steady, e2e_rows = run_dn("steady", True)
+            e2e_steady, _res_steady = run_dn("steady", True)
+            e2e_rows = _res_steady.rows_out
             log(f"  e2e steady ({platform}+native shell): "
                 f"{e2e_steady/1e6:.2f}M rows/s ({e2e_rows} rows out)")
             # 2-worker compaction stream: job i+1's device merge overlaps
@@ -545,6 +550,30 @@ def run_device_child(platform: str, workload_path: str,
                        shadow_verify_sample=shadow["sample"],
                        shadow_verify_jobs=shadow["jobs_verified"],
                        shadow_verify_mismatches=shadow["mismatches"])
+            # chained L0->L1->L2: two L0->L1 jobs' outputs stay resident
+            # (per-span write-through) and feed an L1->L2 job whose
+            # inputs never leave HBM — the ROADMAP item-1 configuration
+            _, res_c1 = run_dn("c1", True)
+            _, res_c2 = run_dn("c2", True)
+            chain_outs = res_c1.outputs + res_c2.outputs
+            chain_readers = [SSTReader(p) for _f, p, _pr in chain_outs]
+            chain_ids = [fid for fid, _p, _pr in chain_outs]
+            chain_rows = sum(pr.n_entries for _f, _p, pr in chain_outs)
+            resident_chain, _res_l2 = run_dn(
+                "l2chain", True, job_readers=chain_readers,
+                job_ids=chain_ids, n_rows=chain_rows)
+            for r in chain_readers:
+                r.close()
+            cache_hit_ratio = cache.hits / max(1, cache.hits
+                                               + cache.misses)
+            log(f"  resident chain (L1->L2 from HBM, {chain_rows} rows): "
+                f"{resident_chain/1e6:.2f}M rows/s; device-cache hit "
+                f"ratio {cache_hit_ratio:.3f} "
+                f"({cache.hits}h/{cache.misses}m)")
+            stages.put(stage="resident_chain",
+                       resident_chain=resident_chain,
+                       chain_rows=chain_rows,
+                       cache_hit_ratio=cache_hit_ratio)
             e2e_cold, _ = run_dn("cold", False)
             log(f"  e2e cold ({platform}+native shell): "
                 f"{e2e_cold/1e6:.2f}M rows/s")
@@ -611,6 +640,11 @@ def run_device_child(platform: str, workload_path: str,
         "scan_rows_per_sec": round(scan_n / scan_s, 1),
         "e2e_steady_rows_per_sec": round(e2e_steady, 1),
         "e2e_steady2_rows_per_sec": round(e2e_steady2, 1),
+        # chained L0->L1->L2: an L1->L2 job whose inputs are the prior
+        # jobs' write-through-resident outputs (zero re-decode), next to
+        # the overall HBM slab-cache hit ratio of the steady stream
+        "resident_chain_rows_per_sec": round(resident_chain, 1),
+        "device_cache_hit_ratio": round(cache_hit_ratio, 4),
         "e2e_cold_rows_per_sec": round(e2e_cold, 1),
         "e2e_native_rows_per_sec": 0.0,   # parent overwrites (JAX-free)
         "compile_s": round(compile_s, 1),
@@ -1010,6 +1044,11 @@ def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
     if "scan" in recs:
         out["scan_rows_per_sec"] = round(
             recs["scan"].get("scan_n", n_total) / recs["scan"]["scan_s"], 1)
+    if "resident_chain" in recs:
+        out["resident_chain_rows_per_sec"] = round(
+            recs["resident_chain"]["resident_chain"], 1)
+        out["device_cache_hit_ratio"] = round(
+            recs["resident_chain"].get("cache_hit_ratio", 0.0), 4)
     if "e2e_steady" in recs:
         out["e2e_steady_rows_per_sec"] = round(
             recs["e2e_steady"]["e2e_steady"], 1)

@@ -281,7 +281,9 @@ def test_prewarm_buckets_compiles_and_marks_seen():
     # bucket's second sighting, i.e. a hit
     n = run_merge.prewarm_buckets(
         [(staged.k_pad, staged.m, staged.w, staged.n_cmp)])
-    assert n == 2  # both is_major variants of the one shape
+    # both is_major variants of the one merge shape, plus the chained
+    # write-through programs (survivor scan, span gather, restage concat)
+    assert n == 5
     before = hits.value()
     run_merge.merge_and_gc_runs(runs, GCParams(CUTOFF, True, False),
                                 staged=staged)

@@ -55,6 +55,19 @@ class TestConcatStaged:
         assert cache.get(1) is None  # evicted
         assert cache.get(2) is not None  # most recent stays
 
+    def test_namespaced_levels_and_pins(self):
+        from yugabyte_tpu.storage.device_cache import NamespacedSlabCache
+        shared = DeviceSlabCache()
+        ns = NamespacedSlabCache(shared, "db1")
+        ns.stage(7, make_slab(50), level=2)
+        assert ns.level_of(7) == 2
+        assert shared.level_of(("db1", 7)) == 2
+        assert ns.pin(7) and ns.pinned_count() == 1
+        ns.unpin(7)
+        assert ns.pinned_count() == 0
+        ns.drop_all()
+        assert ns.level_of(7) is None
+
 
 class TestDBWithDeviceCache:
     def test_compaction_uses_cache(self, tmp_path):

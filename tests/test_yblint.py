@@ -710,6 +710,35 @@ class TestDonationSafety:
         """)
         assert fs == []
 
+    def test_chained_buffer_handoff_read_fires(self):
+        """The device-resident chain's handoff shape: a handle's keep
+        mask donated to the survivor scan must never be read again —
+        a later gather through the same attribute sees reused HBM."""
+        fs = self._lint("""
+            def bad(handle):
+                pos = fused(handle.keep, 4)
+                return pos, handle.keep
+        """)
+        assert _codes(fs) == ["use-after-donate"]
+        assert fs[0].symbol == "bad"
+
+    def test_chained_buffer_handoff_poison_clears(self):
+        """The production pattern (run_merge.survivor_positions): donate
+        under a capability guard, then poison the handle's attribute so
+        late readers fail loudly — the attribute rebind clears the taint
+        and the conditional donation merges clean."""
+        fs = self._lint("""
+            def good(handle, donate):
+                keep = handle.keep
+                if donate:
+                    pos = fused(keep, 4)
+                    handle.keep = None   # poison: late readers fail loudly
+                else:
+                    pos = _impl(keep, 4)
+                return pos
+        """)
+        assert fs == []
+
 
 # ---------------------------------------------------------------------------
 # error propagation

@@ -54,6 +54,7 @@ _SCAN = "yugabyte_tpu/ops/scan.py"
 _PALLAS = "yugabyte_tpu/ops/pallas_merge.py"
 _DIST = "yugabyte_tpu/parallel/dist_compact.py"
 _POLICY = "yugabyte_tpu/storage/offload_policy.py"
+_DEVICE_CACHE = "yugabyte_tpu/storage/device_cache.py"
 
 # Per-family compile-surface definition: which source symbols shape the
 # lowered program (fingerprinted for the fast drift gate), the budget
@@ -95,10 +96,29 @@ FAMILIES: Dict[str, dict] = {
         },
     },
     "gather_staged": {
+        "budget": 12,
+        "anchor": _RUN_MERGE,
+        "symbols": {
+            _RUN_MERGE: ["_survivor_positions_impl", "_survivor_positions",
+                         "_survivor_positions_donated",
+                         "survivor_positions", "_gather_staged_output",
+                         "gather_staged_output_span",
+                         "gather_staged_outputs"],
+            _MERGE_GC: ["bucket_size", "pad_template"],
+        },
+    },
+    "restage_concat": {
+        # device-side re-staging of cache-resident per-SST cols into the
+        # merge layouts (run-major for the bitonic/lexsort path, one
+        # contiguous padded matrix for the radix path) — the chained
+        # L0->L1->L2 hot path launches the run-major form before every
+        # merge over resident inputs
         "budget": 8,
         "anchor": _RUN_MERGE,
         "symbols": {
-            _RUN_MERGE: ["_survivor_positions", "_gather_staged_output"],
+            _RUN_MERGE: ["_restage_concat", "_concat_staged_fused",
+                         "stage_runs_from_staged"],
+            _DEVICE_CACHE: ["concat_staged", "merged_column_stats"],
             _MERGE_GC: ["bucket_size", "pad_template"],
         },
     },
@@ -466,6 +486,10 @@ def _gen_scan_fused() -> dict:
 
 
 def _gen_gather_staged() -> dict:
+    """Write-through gather lattice, derived from _PREWARM_SHAPES: every
+    prewarm bucket's merge is immediately followed by one survivor scan
+    over its n_pad = k_pad*m keep mask and per-span output gathers whose
+    top n_out_pad bucket is m (prewarm_buckets warms exactly these)."""
     import jax
     import jax.numpy as jnp
     from yugabyte_tpu.ops import run_merge
@@ -474,7 +498,9 @@ def _gen_gather_staged() -> dict:
     entries = []
     w = 4
     r = _ROW_WORDS + w
-    for n_pad in (1 << 16, 1 << 18, 1 << 20):
+    pos_pads = sorted({k_pad * m for (k_pad, m, _w, _c)
+                       in run_merge._PREWARM_SHAPES})
+    for n_pad in pos_pads:
         args = (jax.ShapeDtypeStruct((n_pad,), jnp.bool_),)
         out = jax.eval_shape(run_merge._survivor_positions, *args)
         text = lowering_text(run_merge._survivor_positions, args, {})
@@ -486,15 +512,19 @@ def _gen_gather_staged() -> dict:
             "in_avals": [_aval_str(a) for a in args],
             "out_avals": [_aval_str(o) for o in
                           jax.tree_util.tree_leaves(out)],
-            "donation": None,
-            "variant_axes": {},
-            "executables": 1,
-            "prewarmed": False,
+            # the keep mask is the CHAINED buffer: dead after this scan,
+            # so the donated twin reuses its HBM in place (the handle's
+            # copy is poisoned — ops/run_merge.survivor_positions)
+            "donation": {"donate_argnums": [0], "variants": 2},
+            "variant_axes": {"donate": 2},
+            "executables": 2,
+            "prewarmed": True,
             "quarantine_key": None,
             "lowering_sha256": _lowering_sha256(text),
         })
-    for n_out_pad in (1 << 16, 1 << 18):
-        n_pad = 1 << 18
+    span_buckets = sorted({(k_pad * m, m) for (k_pad, m, _w, _c)
+                           in run_merge._PREWARM_SHAPES})
+    for n_pad, n_out_pad in span_buckets:
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         args = (jax.ShapeDtypeStruct((r, n_pad), jnp.uint32),
                 jax.ShapeDtypeStruct((n_pad,), jnp.int32),
@@ -518,10 +548,76 @@ def _gen_gather_staged() -> dict:
             "donation": None,
             "variant_axes": {},
             "executables": 1,
-            "prewarmed": False,
+            "prewarmed": True,
             "quarantine_key": None,
             "lowering_sha256": _lowering_sha256(text),
         })
+    return {"entries": entries}
+
+
+def _gen_restage_concat() -> dict:
+    """Device-side re-staging of cache-resident cols: the run-major form
+    (_restage_concat) per prewarm bucket — warmed, it fronts every merge
+    of the chained path — plus one representative of the radix-path
+    concat (_concat_staged_fused), which only the skew fallback uses."""
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    w = 4
+    r = _ROW_WORDS + w
+    for (k_pad, m, _w, _c) in sorted(set(run_merge._PREWARM_SHAPES)):
+        parts = tuple(jax.ShapeDtypeStruct((r, m), jnp.uint32)
+                      for _ in range(k_pad))
+        args = (parts, jax.ShapeDtypeStruct((k_pad,), jnp.int32))
+        statics = dict(w=w, m=m, k_pad=k_pad)
+        out = jax.eval_shape(
+            lambda *a: run_merge._restage_concat(*a, **statics), *args)
+        text = lowering_text(run_merge._restage_concat, args, statics)
+        bucket = {"k_pad": k_pad, "m": m, "w": w}
+        entries.append({
+            "key": "restage_concat " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": statics,
+            "in_avals": [_aval_str(a) for a in
+                         jax.tree_util.tree_leaves(args)],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            # inputs are LIVE slab-cache entries — donation is forbidden
+            # here by design (the cache must survive the merge)
+            "donation": None,
+            "variant_axes": {},
+            "executables": 1,
+            "prewarmed": True,
+            "quarantine_key": [k_pad, m],
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    n_in, k, n_pad = 1 << 16, 4, 1 << 18
+    parts = tuple(jax.ShapeDtypeStruct((r, n_in), jnp.uint32)
+                  for _ in range(k))
+    args = (parts, jax.ShapeDtypeStruct((k,), jnp.int32))
+    statics = dict(w=w, n_pad=n_pad)
+    out = jax.eval_shape(
+        lambda *a: run_merge._concat_staged_fused(*a, **statics), *args)
+    text = lowering_text(run_merge._concat_staged_fused, args, statics)
+    bucket = {"n_pad": n_pad, "w": w}
+    entries.append({
+        "key": "concat_staged_fused " + entry_key(bucket),
+        "bucket": bucket,
+        "static_args": statics,
+        "in_avals": [_aval_str(a) for a in
+                     jax.tree_util.tree_leaves(args)],
+        "out_avals": [_aval_str(o) for o in
+                      jax.tree_util.tree_leaves(out)],
+        "donation": None,
+        "variant_axes": {},
+        "executables": 1,
+        "prewarmed": False,
+        "quarantine_key": None,
+        "lowering_sha256": _lowering_sha256(text),
+    })
     return {"entries": entries}
 
 
@@ -654,6 +750,7 @@ _GENERATORS = {
     "merge_gc_fused": _gen_merge_gc_fused,
     "scan_fused": _gen_scan_fused,
     "gather_staged": _gen_gather_staged,
+    "restage_concat": _gen_restage_concat,
     "pallas_merge": _gen_pallas_merge,
     "chunk_carve": _gen_chunk_carve,
     "dist_compact": _gen_dist_compact,

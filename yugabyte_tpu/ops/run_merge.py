@@ -364,6 +364,31 @@ def prewarm_buckets(shapes: Optional[Sequence[Tuple[int, int, int, int]]]
                                 k_pad, m, w, n_cmp, is_major, False,
                                 False, donate))
             compiled += got
+        # the chained-compaction write-through programs launch right after
+        # every merge of this bucket (restage of cache-resident inputs,
+        # survivor scan, per-span output gather) — tiny compiles, warmed
+        # so the first chained L0->L1->L2 job is entirely cache-hot
+        pos_fn = (_survivor_positions_donated if donate
+                  else _survivor_positions)
+        compiled += _warm(
+            f"survivor_positions (n_pad={n})",
+            lambda: pos_fn.lower(jax.ShapeDtypeStruct((n,), jnp.bool_)))
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        compiled += _warm(
+            f"gather_staged_output (n_pad={n} n_out_pad={m})",
+            lambda: _gather_staged_output.lower(
+                jax.ShapeDtypeStruct((r, n), jnp.uint32),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.bool_),
+                i32, i32, n_out_pad=m))
+        compiled += _warm(
+            f"restage_concat (k_pad={k_pad} m={m} w={w})",
+            lambda: _restage_concat.lower(
+                tuple(jax.ShapeDtypeStruct((r, m), jnp.uint32)
+                      for _ in range(k_pad)),
+                jax.ShapeDtypeStruct((k_pad,), jnp.int32),
+                w=w, m=m, k_pad=k_pad))
         if not on_tpu:
             continue
         from yugabyte_tpu.ops import pallas_merge
@@ -422,18 +447,12 @@ def _merge_const_stats(per_run: Sequence[Tuple[np.ndarray, np.ndarray]],
     """Merge per-run (is_const, first_val) column stats into the cross-run
     is_const vector: a row is prunable from the comparator only if it is
     constant WITH THE SAME VALUE across every input — constant-per-run with
-    differing values still orders the merge."""
-    is_const = np.ones(r, dtype=bool)
-    first_vals: List[Optional[int]] = [None] * r
-    for c_i, f_i in per_run:
-        for row in range(r):
-            if not c_i[row]:
-                is_const[row] = False
-            elif first_vals[row] is None:
-                first_vals[row] = int(f_i[row])
-            elif first_vals[row] != int(f_i[row]):
-                is_const[row] = False
-    return is_const
+    differing values still orders the merge. Vectorized: first values of
+    non-constant runs never matter (the all-const mask already excludes
+    their rows)."""
+    consts = np.stack([c for c, _f in per_run]).astype(bool)
+    firsts = np.stack([f for _c, f in per_run]).astype(np.uint32)
+    return consts.all(axis=0) & (firsts == firsts[0:1]).all(axis=0)
 
 
 def _cmp_schedule(w: int, is_const: np.ndarray) -> Tuple[np.ndarray, int]:
@@ -613,9 +632,71 @@ def stage_runs_from_slabs(slabs: Sequence[KVSlab], device=None,
                       cmp_rows, n_cmp, run_maps=run_maps)
 
 
+# --------------------------------------------------------------------------
+# Device-side re-staging (the restage_concat kernel family): cache-resident
+# per-SST cols re-laid into merge inputs with ONE cached jitted program per
+# shape bucket, instead of a stream of small un-jitted slice/pad/concat ops
+# per input per job. Both layouts appear in the compile-surface manifest;
+# all inputs are LIVE slab-cache entries, so nothing here may donate.
+
+@functools.partial(jax.jit, static_argnames=("w", "m", "k_pad"))
+def _restage_concat(parts, ns, w: int, m: int, k_pad: int):
+    """Per-SST staged cols -> the run-major [8+w, k_pad*m] merge layout.
+
+    parts: tuple of device cols matrices [r_i, n_pad_i] (r_i <= 8+w,
+    n_pad_i <= m — both lattice-quantized, so the compile key is bounded);
+    ns[i] is the real row count of part i. Real rows land at the head of
+    slot i, narrow inputs expose their extra word rows as zero, and every
+    padding lane (slot tails + the k_pad-k empty slots) carries the pad
+    template so it sorts to the tail."""
+    r = _ROW_WORDS + w
+    pad_col = jnp.asarray(pad_template(r))
+    lane = jnp.arange(m, dtype=jnp.int32)
+    outs = []
+    for i in range(k_pad):
+        if i < len(parts):
+            cols = parts[i]
+            sub = cols[:, jnp.clip(lane, 0, cols.shape[1] - 1)]
+            if cols.shape[0] < r:
+                sub = jnp.concatenate(
+                    [sub, jnp.zeros((r - cols.shape[0], m), jnp.uint32)],
+                    axis=0)
+            outs.append(jnp.where((lane < ns[i])[None, :], sub,
+                                  pad_col[:, None]))
+        else:
+            outs.append(jnp.broadcast_to(pad_col[:, None], (r, m)))
+    return jnp.concatenate(outs, axis=1) if k_pad > 1 else outs[0]
+
+
+@functools.partial(jax.jit, static_argnames=("w", "n_pad"))
+def _concat_staged_fused(parts, ns, w: int, n_pad: int):
+    """Per-SST staged cols -> ONE contiguous padded cols matrix [8+w,
+    n_pad] (the radix kernel's input layout, storage/device_cache.py
+    concat_staged): real rows of every input laid out back to back, tail
+    padded with the template."""
+    r = _ROW_WORDS + w
+    pad_col = jnp.asarray(pad_template(r))
+    out = jnp.broadcast_to(pad_col[:, None], (r, n_pad))
+    lane = jnp.arange(n_pad, dtype=jnp.int32)
+    off = jnp.int32(0)
+    for i, cols in enumerate(parts):
+        idx = lane - off
+        sub = cols[:, jnp.clip(idx, 0, cols.shape[1] - 1)]
+        if cols.shape[0] < r:
+            sub = jnp.concatenate(
+                [sub, jnp.zeros((r - cols.shape[0], n_pad), jnp.uint32)],
+                axis=0)
+        valid = (idx >= 0) & (idx < ns[i])
+        out = jnp.where(valid[None, :], sub, out)
+        off = off + ns[i]
+    return out
+
+
 def stage_runs_from_staged(staged_list: Sequence[StagedCols]) -> StagedRuns:
     """Device-side re-layout of per-SST staged cols (HBM slab cache hits)
-    into the run-major matrix — no host->device transfer at all."""
+    into the run-major matrix — no host->device transfer at all, and one
+    jitted dispatch (_restage_concat) instead of per-input slice/pad/concat
+    chains."""
     live = [s for s in staged_list if s.n]
     k = len(live)
     k_pad = 1 << max(0, (k - 1).bit_length()) if k > 1 else 1
@@ -625,32 +706,18 @@ def stage_runs_from_staged(staged_list: Sequence[StagedCols]) -> StagedRuns:
     # ever stages an odd width (idempotent on lattice points)
     w = quantize_width(max(s.w for s in live))
     r = _ROW_WORDS + w
-    pad_col = jnp.asarray(pad_template(r))
-    parts = []
-    for s in live:
-        cols = s.cols_dev[:, :s.n]
-        if s.w < w:
-            cols = jnp.concatenate(
-                [cols, jnp.zeros((w - s.w, s.n), jnp.uint32)], axis=0)
-        tail = m - s.n
-        if tail:
-            parts.append(jnp.concatenate(
-                [cols, jnp.tile(pad_col[:, None], (1, tail))], axis=1))
-        else:
-            parts.append(cols)
-    for _ in range(k_pad - k):
-        parts.append(jnp.tile(pad_col[:, None], (1, m)))
-    cat = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    cat = _restage_concat(tuple(s.cols_dev for s in live),
+                          jnp.asarray([s.n for s in live], dtype=jnp.int32),
+                          w=w, m=m, k_pad=k_pad)
     stats = []
     for s in live:
         c_i = np.zeros(r, dtype=bool)
         f_i = np.zeros(r, dtype=np.uint32)
-        for row in range(r):
-            if row >= _ROW_WORDS + s.w:
-                c_i[row] = True          # implicit zero-pad word rows
-            elif s.col_const is not None:
-                c_i[row] = bool(s.col_const[row])
-                f_i[row] = np.uint32(s.col_first[row])
+        rs = min(_ROW_WORDS + s.w, r)
+        c_i[rs:] = True                  # implicit zero-pad word rows
+        if s.col_const is not None:
+            c_i[:rs] = s.col_const[:rs]
+            f_i[:rs] = s.col_first[:rs]
         stats.append((c_i, f_i))
     cmp_rows, n_cmp = _cmp_schedule(w, _merge_const_stats(stats, r))
     return StagedRuns(cat, m, k_pad, w, [s.n for s in live], cmp_rows, n_cmp)
@@ -796,14 +863,38 @@ def _unpack_words(words: np.ndarray, n: int) -> np.ndarray:
     return _unpack_bits(np.ascontiguousarray(words), n)
 
 
-@jax.jit
-def _survivor_positions(keep):
+def _survivor_positions_impl(keep):
     """Merged positions of all survivors, padded with n_pad-1 (a padding
     row: padding sorts to the tail and is never kept, so n_pad-1 is only a
     real row when NOTHING was padded AND it survived — in which case it is
     a valid filler that sits beyond every real survivor index anyway)."""
     n_pad = keep.shape[0]
     return jnp.nonzero(keep, size=n_pad, fill_value=n_pad - 1)[0]
+
+
+_survivor_positions = jax.jit(_survivor_positions_impl)
+
+# Donated variant for the CHAINED-buffer handoff: the keep mask is dead
+# once its survivor positions are scanned (the span gathers below read
+# only perm/mk/pos), so on backends that honor donation XLA reuses its
+# HBM in place. The caller (survivor_positions) poisons the handle's
+# _keep_dev afterwards so any late reader fails loudly instead of seeing
+# reused memory.
+_survivor_positions_donated = functools.partial(
+    jax.jit, donate_argnums=(0,))(_survivor_positions_impl)
+
+
+def survivor_positions(handle: "MergeGCHandle"):
+    """Device survivor-position scan over a finished merge's keep mask —
+    the first half of write-through staging. Donates the keep mask where
+    the backend honors donation (it is the last reader)."""
+    keep = handle._keep_dev
+    if _donation_supported():
+        pos = _survivor_positions_donated(keep)
+        handle._keep_dev = _DonatedBuffer("_survivor_positions_donated")
+    else:
+        pos = _survivor_positions(keep)
+    return pos
 
 
 @functools.partial(jax.jit, static_argnames=("n_out_pad",))
@@ -840,6 +931,30 @@ def _gather_staged_output(cols, perm, pos_all, mk, start, end,
     return jnp.where(valid[None, :], sub, pad_col[:, None])
 
 
+def gather_staged_output_span(handle: MergeGCHandle, pos_all,
+                              start: int, end: int) -> StagedCols:
+    """Stage ONE output file's [start, end) survivor span directly from
+    HBM — the per-span half of write-through: called as each
+    _StreamingNativeWriter span completes, so the cache entry installs
+    under the output file id the moment its SST exists on disk.
+
+    pos_all: the survivor-position scan from survivor_positions(handle),
+    computed once per job. Column stats are conservatively absent (every
+    column treated as non-constant) to avoid any device->host fetch."""
+    from yugabyte_tpu.ops.merge_gc import (bucket_size as _bucket,
+                                           build_sort_schedule)
+    staged = handle._staged
+    r = _ROW_WORDS + staged.w
+    n_out = end - start
+    n_out_pad = _bucket(n_out)
+    sort_rows, n_sort = build_sort_schedule(staged.w, np.zeros(r, dtype=bool))
+    cols_out = _gather_staged_output(
+        staged.cols_dev, handle._perm_dev, pos_all,
+        handle._mk_dev, jnp.int32(start), jnp.int32(end), n_out_pad)
+    return StagedCols(cols_out, sort_rows, n_sort, n_out,
+                      n_out_pad, staged.w, None, None)
+
+
 def gather_staged_outputs(handle: MergeGCHandle,
                           ranges: Sequence[Tuple[int, int]]
                           ) -> List[StagedCols]:
@@ -849,29 +964,15 @@ def gather_staged_outputs(handle: MergeGCHandle,
     exactly the spans the byte shell wrote (returned by
     storage/compaction.py _write_native_outputs). Returns one StagedCols
     per file, device-resident, suitable for DeviceSlabCache.put. The
-    survivor-position scan and sort schedule are computed once for all
-    files. Column stats are conservatively absent (every column treated
-    as non-constant) to avoid any device->host fetch.
+    survivor-position scan (which consumes — donates — the keep mask on
+    capable backends) runs once for all files.
     """
-    from yugabyte_tpu.ops.merge_gc import (bucket_size as _bucket,
-                                           build_sort_schedule)
     if getattr(handle, "_perm_dev", None) is None \
             and hasattr(handle, "to_parent_products"):
         handle.to_parent_products()   # chunked: rebuild parent-domain arrays
-    staged = handle._staged
-    outs: List[StagedCols] = []
-    r = _ROW_WORDS + staged.w
-    pos_all = _survivor_positions(handle._keep_dev)
-    sort_rows, n_sort = build_sort_schedule(staged.w, np.zeros(r, dtype=bool))
-    for start, end in ranges:
-        n_out = end - start
-        n_out_pad = _bucket(n_out)
-        cols_out = _gather_staged_output(
-            staged.cols_dev, handle._perm_dev, pos_all,
-            handle._mk_dev, jnp.int32(start), jnp.int32(end), n_out_pad)
-        outs.append(StagedCols(cols_out, sort_rows, n_sort, n_out,
-                               n_out_pad, staged.w, None, None))
-    return outs
+    pos_all = survivor_positions(handle)
+    return [gather_staged_output_span(handle, pos_all, start, end)
+            for start, end in ranges]
 
 
 # --------------------------------------------------------------------------

@@ -123,42 +123,91 @@ def scan_visible(staged: StagedCols, read_ht_value: int,
     return perm, keep
 
 
-def visible_entries(slabs: Sequence[KVSlab], read_ht_value: int,
-                    lower_key: Optional[bytes] = None,
-                    upper_key: Optional[bytes] = None,
-                    device=None,
-                    staged_inputs: Optional[Sequence[StagedCols]] = None,
-                    ) -> Iterator[Tuple[bytes, bytes, int]]:
-    """Yield (key_prefix, value_bytes, ht_value) for every entry visible at
-    read_ht in [lower_key, upper_key), in key order — the merged+resolved
-    scan stream.
+class SlabSource:
+    """Scan input backed by a decoded host slab (memtables, cache-miss
+    SSTs): keys/values come straight from the slab arrays."""
 
-    slabs: the host-side runs (for key/value materialization).
-    staged_inputs: matching pre-staged device cols, one per slab, if the
-    caller holds them in the HBM slab cache; missing ones are staged here.
-    """
+    def __init__(self, slab: KVSlab, staged: Optional[StagedCols] = None):
+        self.slab = slab
+        self.staged = staged
+        self.n = slab.n
+
+    def to_slab(self) -> KVSlab:
+        return self.slab
+
+    def entry(self, i: int) -> Tuple[bytes, bytes, int]:
+        sl = self.slab
+        ht = (int(sl.ht_hi[i]) << 32) | int(sl.ht_lo[i])
+        return sl.key_bytes(i), sl.values[int(sl.value_idx[i])], ht
+
+
+class ResidentSource:
+    """Scan input served from the HBM slab cache: the device filter runs
+    over the RESIDENT column matrix — no host block decode to stage the
+    scan — and keys/values of SURVIVORS are fetched lazily from the SST
+    reader's blocks, so decode happens only for blocks that actually
+    hold visible entries (a narrow range scan touches one block of a
+    fully resident file instead of all of them).
+
+    Caller contract: the file must not hold deep documents (the resident
+    kernel path is depth-2 only — check reader.props.has_deep)."""
+
+    def __init__(self, reader, staged: StagedCols):
+        self.slab = None
+        self.reader = reader
+        self.staged = staged
+        self.n = staged.n
+        # per-block first-row offsets: block handles record their entry
+        # counts (storage/sst.py index format)
+        self._row_offs = np.concatenate(
+            ([0], np.cumsum([h[2] for h in reader.block_handles])))
+        self._blk_idx = -1
+        self._blk = None
+
+    def to_slab(self) -> KVSlab:
+        return self.reader.read_all()
+
+    def entry(self, i: int) -> Tuple[bytes, bytes, int]:
+        b = int(np.searchsorted(self._row_offs, i, side="right") - 1)
+        if b != self._blk_idx:
+            self._blk = self.reader.read_block(b)
+            self._blk_idx = b
+        sl = self._blk
+        j = i - int(self._row_offs[b])
+        ht = (int(sl.ht_hi[j]) << 32) | int(sl.ht_lo[j])
+        return sl.key_bytes(j), sl.values[int(sl.value_idx[j])], ht
+
+
+def visible_entries_sources(sources, read_ht_value: int,
+                            lower_key: Optional[bytes] = None,
+                            upper_key: Optional[bytes] = None,
+                            device=None
+                            ) -> Iterator[Tuple[bytes, bytes, int]]:
+    """Yield (key_prefix, value_bytes, ht_value) for every entry visible
+    at read_ht in [lower_key, upper_key), in key order, over a mixed list
+    of SlabSource / ResidentSource inputs — the merged+resolved scan
+    stream, with resident inputs never decoded to stage the filter."""
     from yugabyte_tpu.ops.merge_gc import stage_slab
     from yugabyte_tpu.ops.slabs import FLAG_DEEP
     from yugabyte_tpu.storage.device_cache import concat_staged
 
-    live = [s for s in slabs if s.n]
-    if any(bool((s.flags & FLAG_DEEP).any()) for s in live):
+    live = [s for s in sources if s.n]
+    if not live:
+        return
+    if any(s.slab is not None and bool((s.slab.flags & FLAG_DEEP).any())
+           for s in live):
         # Deep documents: the kernel's snapshot mode is depth-2 only —
         # resolve visibility on the host with the full overwrite stack.
-        yield from _visible_entries_host(live, read_ht_value, lower_key,
+        # (Resident sources only reach here for depth-2 files, but the
+        # host path needs every input as a slab.)
+        yield from _visible_entries_host([s.to_slab() for s in live],
+                                         read_ht_value, lower_key,
                                          upper_key)
         return
-    if staged_inputs is not None:
-        pairs = [(sl, st) for sl, st in zip(slabs, staged_inputs) if sl.n]
-        slabs = [sl for sl, _ in pairs]
-        staged_list = [st if st is not None else stage_slab(sl, device)
-                       for sl, st in pairs]
-    else:
-        slabs = live
-        staged_list = [stage_slab(sl, device) for sl in slabs]
-    if not slabs:
-        return
-    staged = staged_list[0] if len(staged_list) == 1 else concat_staged(staged_list)
+    staged_list = [s.staged if s.staged is not None
+                   else stage_slab(s.slab, device) for s in live]
+    staged = (staged_list[0] if len(staged_list) == 1
+              else concat_staged(staged_list))
     # the device compare sees only the first w*4 key bytes; longer bounds are
     # truncated there and enforced exactly on the host below
     stride = staged.w * 4
@@ -168,21 +217,33 @@ def visible_entries(slabs: Sequence[KVSlab], read_ht_value: int,
                               lower_key[:stride] if lower_key else None,
                               upper_key[:stride] if upper_key else None,
                               upper_truncated=hi_exact is not None)
-    # map merged indices back to (slab, local index)
-    offsets = np.cumsum([0] + [s.n for s in slabs])
+    # map merged indices back to (source, local index)
+    offsets = np.cumsum([0] + [s.n for s in live])
     sel = perm[keep]
-    slab_idx = np.searchsorted(offsets, sel, side="right") - 1
-    local_idx = sel - offsets[slab_idx]
-    for j, li in zip(slab_idx, local_idx):
-        sl = slabs[int(j)]
-        i = int(li)
-        key = sl.key_bytes(i)
+    src_idx = np.searchsorted(offsets, sel, side="right") - 1
+    local_idx = sel - offsets[src_idx]
+    for j, li in zip(src_idx, local_idx):
+        key, value, ht = live[int(j)].entry(int(li))
         if lo_exact is not None and key < lo_exact:
             continue
         if hi_exact is not None and key >= hi_exact:
             continue
-        ht = (int(sl.ht_hi[i]) << 32) | int(sl.ht_lo[i])
-        yield key, sl.values[int(sl.value_idx[i])], ht
+        yield key, value, ht
+
+
+def visible_entries(slabs: Sequence[KVSlab], read_ht_value: int,
+                    lower_key: Optional[bytes] = None,
+                    upper_key: Optional[bytes] = None,
+                    device=None,
+                    staged_inputs: Optional[Sequence[StagedCols]] = None,
+                    ) -> Iterator[Tuple[bytes, bytes, int]]:
+    """Slab-list form of visible_entries_sources (every input decoded on
+    the host; staged_inputs, when given, skip the per-slab upload)."""
+    staged_inputs = (list(staged_inputs) if staged_inputs is not None
+                     else [None] * len(slabs))
+    sources = [SlabSource(sl, st) for sl, st in zip(slabs, staged_inputs)]
+    yield from visible_entries_sources(sources, read_ht_value, lower_key,
+                                       upper_key, device=device)
 
 
 def _visible_entries_host(slabs: Sequence[KVSlab], read_ht_value: int,

@@ -296,7 +296,7 @@ def subkey_depth(key_prefix: bytes, doc_key_len: int) -> int:
         while pos < n:
             _, pos = PrimitiveValue.decode(key_prefix, pos)
             depth += 1
-    except (ValueError, IndexError, struct.error):
+    except (ValueError, IndexError, struct.error):  # yblint: contained(undecodable subkey tail is classified as deep — a conservative routing answer, not a swallowed durability error)
         return depth + 1  # undecodable tail: treat as deep (conservative)
     return depth
 

@@ -342,6 +342,12 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
     outputs: List[Tuple[int, str, SSTProps]] = []
     max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
     tombstone_value = Value.tombstone().encode()
+    out_level = 0
+    if device_cache is not None:
+        in_levels = [device_cache.level_of(fid)
+                     for fid in (input_ids or []) if fid is not None]
+        out_level = 1 + max([lv for lv in in_levels if lv is not None],
+                            default=0)
     for start in range(0, rows_out, max_rows):
         if cancel is not None:
             cancel.check()
@@ -357,7 +363,9 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
             # would only delay install while writing nothing)
             limiter.acquire(props.data_size + props.base_size)
         if device_cache is not None:
-            device_cache.stage(fid, out_slab)  # write-through for the next pick
+            # write-through for the next pick, one level below the
+            # deepest input (multi-level eviction priority)
+            device_cache.stage(fid, out_slab, level=out_level)
     return CompactionResult(outputs, merged.n + dropped_rows, rows_out,
                             tombstones_written=int(
                                 np.count_nonzero(tomb_flags)))
@@ -382,13 +390,18 @@ class _StreamingNativeWriter:
 
     def __init__(self, job, out_dir: str, new_file_id, fr,
                  block_entries: Optional[int], has_deep: bool = False,
-                 cancel=None):
+                 cancel=None, on_span=None):
         self._job = job
         self._out_dir = out_dir
         self._new_file_id = new_file_id
         self._fr = fr
         self._has_deep = has_deep
         self._cancel = cancel
+        # called as (fid, base_path, start, end) after each span's SST
+        # exists on disk — the device write-through installer hooks here
+        # so cache entries land under the output ids AS the spans
+        # complete, not after the whole job
+        self._on_span = on_span
         self._block_entries = (block_entries if block_entries is not None
                                else flags.get_flag("sst_block_entries"))
         self._max_rows = flags.get_flag(
@@ -420,6 +433,8 @@ class _StreamingNativeWriter:
         self.outputs.append((fid, base_path, props))
         self.ranges.append((start, end))
         record_pipeline_stage("write", (_time.monotonic() - t0) * 1e3)
+        if self._on_span is not None:
+            self._on_span(fid, base_path, start, end)
         if self._limiter is not None and more_coming:
             # pace between files; no debt-sleep after the last one (it
             # would only delay install while writing nothing)
@@ -640,6 +655,94 @@ def _storage_fallback_counter():
         "device fault")
 
 
+def _ingest_decode_counter():
+    """The warm resident chain's honesty meter: zero increments across a
+    chained L0->L1->L2 sequence proves the shell ingested every input
+    from the packed-run cache without re-reading or re-decoding SST
+    bytes (the acceptance criterion's flat decode counter)."""
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    return ROOT_REGISTRY.entity("server", "storage").counter(
+        "compaction_ingest_decode_total",
+        "compaction inputs the native shell read and decoded from SST "
+        "files (run-cache hits ingest without touching the bytes)")
+
+
+class _ResidentSpanInstaller:
+    """Write-through installer for the device-resident chain: as each
+    _StreamingNativeWriter span completes, the matching survivor span is
+    gathered ON DEVICE from the input staged columns (ops/run_merge.
+    gather_staged_output_span — key columns never leave HBM) and
+    installed into the slab cache under the OUTPUT file id, so the cache
+    entry provably corresponds to the SST that just hit disk. A sampled
+    digest check (storage/integrity.py) re-derives the entry from the
+    decoded bytes; a divergent entry is dropped, never installed.
+
+    Chunked handles cannot expose parent-domain device arrays mid-stream
+    (the decisions are still riding the link), so their spans buffer and
+    install together in finish() — the same point the pre-span-install
+    code staged everything."""
+
+    def __init__(self, device_cache, level: int):
+        self.device_cache = device_cache
+        self.level = level
+        self.handle = None          # set once the merge is launched
+        self.installed: List[int] = []
+        self._pending: List[Tuple[int, str, int, int]] = []
+        self._pos_all = None
+
+    def on_span(self, fid: int, base_path: str, start: int, end: int
+                ) -> None:
+        h = self.handle
+        if h is None:
+            return
+        if getattr(h, "_perm_dev", None) is None:
+            if hasattr(h, "to_parent_products") \
+                    and getattr(h, "_result", None) is not None:
+                h.to_parent_products()  # chunked stream fully drained
+            else:
+                self._pending.append((fid, base_path, start, end))
+                return
+        self._install(fid, base_path, start, end)
+
+    def _install(self, fid: int, base_path: str, start: int, end: int
+                 ) -> None:
+        from yugabyte_tpu.ops import run_merge
+        from yugabyte_tpu.storage import integrity
+        h = self.handle
+        if self._pos_all is None:
+            # one survivor-position scan per job; consumes (donates) the
+            # keep mask on backends that honor donation
+            self._pos_all = run_merge.survivor_positions(h)
+        st = run_merge.gather_staged_output_span(h, self._pos_all,
+                                                 start, end)
+        if not integrity.maybe_verify_resident_entry(st, base_path):
+            return  # digest mismatch: the next reader re-stages from bytes
+        self.device_cache.put(fid, st, level=self.level)
+        self.installed.append(fid)
+
+    def finish(self) -> None:
+        """Install the spans a chunked stream had to defer."""
+        h = self.handle
+        if h is None or not self._pending:
+            return
+        if getattr(h, "_perm_dev", None) is None \
+                and hasattr(h, "to_parent_products"):
+            h.to_parent_products()
+        if getattr(h, "_perm_dev", None) is None:
+            return
+        pending, self._pending = self._pending, []
+        for fid, base_path, start, end in pending:
+            self._install(fid, base_path, start, end)
+
+    def unwind(self) -> None:
+        """Fault/cancellation unwind: every entry this attempt installed
+        describes a file the unwind just deleted — drop them so the
+        cache never outlives its SSTs."""
+        for fid in self.installed:
+            self.device_cache.drop(fid)
+        self.installed = []
+
+
 def _device_native_attempt(
         inputs, all_inputs, input_ids, dropped_rows: int, out_dir: str,
         new_file_id, history_cutoff_ht: int, is_major: bool,
@@ -669,7 +772,7 @@ def _device_native_attempt(
             cached_ids = ids
 
     tombstone_value = Value.tombstone().encode()
-    state = {"writer": None}
+    state = {"writer": None, "installer": None, "pins": []}
     try:
         return _device_native_body(
             inputs, all_inputs, input_ids, dropped_rows, out_dir,
@@ -690,7 +793,18 @@ def _device_native_attempt(
                         os.remove(p)
                     except OSError:  # yblint: contained(unwind cleanup of partial outputs; the file may not exist yet)
                         pass
+        inst = state["installer"]
+        if inst is not None:
+            # cache coherence under the unwind: the deleted partial
+            # outputs must not stay resident
+            inst.unwind()
         raise
+    finally:
+        if device_cache is not None:
+            # zero leaked pins, fault or no fault: the inputs this job
+            # pinned against eviction are released on EVERY exit path
+            for fid in state["pins"]:
+                device_cache.unpin(fid)
 
 
 def _device_native_body(
@@ -749,6 +863,7 @@ def _device_native_body(
                             cancel.check()
                         with open(r.data_path, "rb") as f:
                             job.add_input(f.read(), r.block_handles)
+                        _ingest_decode_counter().increment()
                     ingest["rows_in"] = job.prepare()
             except BaseException as e:  # noqa: BLE001  # yblint: contained(parked in ingest['err'], re-raised on the join path)
                 ingest["err"] = e
@@ -811,6 +926,12 @@ def _device_native_body(
                     st = (device_cache.stage(fid, slab)
                           if device_cache is not None and fid is not None
                           else stage_slab(slab, device))
+                if device_cache is not None and fid is not None \
+                        and device_cache.pin(fid):
+                    # pinned for the whole attempt (released in the
+                    # attempt's finally): capacity eviction can never
+                    # race this running merge off its inputs
+                    state["pins"].append(fid)
                 staged_list.append(st)
             staged_runs = run_merge.stage_runs_from_staged(staged_list)
             params = GCParams(history_cutoff_ht, is_major, retain_deletes)
@@ -836,9 +957,22 @@ def _device_native_body(
                               history_cutoff_ht)
         has_deep = any(r.props.has_deep for r in inputs)
         tombstones_written = 0
-        writer = _StreamingNativeWriter(job, out_dir, new_file_id, fr,
-                                        block_entries, has_deep=has_deep,
-                                        cancel=cancel)
+        installer = None
+        if device_cache is not None:
+            # output residency level: one below the deepest input — the
+            # chained L0->L1->L2 eviction policy keeps deep (expensive to
+            # re-stage) outputs resident over shallow short-lived ones
+            in_levels = [device_cache.level_of(fid)
+                         for fid in (input_ids or []) if fid is not None]
+            out_level = 1 + max([lv for lv in in_levels if lv is not None],
+                                default=0)
+            installer = _ResidentSpanInstaller(device_cache, out_level)
+            installer.handle = handle
+            state["installer"] = installer
+        writer = _StreamingNativeWriter(
+            job, out_dir, new_file_id, fr, block_entries,
+            has_deep=has_deep, cancel=cancel,
+            on_span=installer.on_span if installer is not None else None)
         state["writer"] = writer   # the attempt's unwind sweeps .outputs
         if pipeline:
             for perm_c, keep_c, mk_c in handle.result_iter():
@@ -879,19 +1013,15 @@ def _device_native_body(
                 rid = job.export_run(start, end, tombstone_value)
                 run_cache.put(fid, rid,
                               native_engine.runcache_entry_bytes(rid))
-    if (device_cache is not None and outputs
-            and (getattr(handle, "_perm_dev", None) is not None
-                 or hasattr(handle, "to_parent_products"))):
-        # chunked handles rebuild parent-domain device arrays on demand
-        # (run_merge._ChunkedMergeGCHandle.to_parent_products)
-        # write-through: the outputs are the next compaction's inputs.
-        # Staged ON DEVICE by gathering the surviving columns in HBM —
-        # zero host->device transfer (re-uploading the packed output
-        # columns through the ~14 MB/s tunnel costs more than the whole
-        # byte shell). `ranges` are the spans the shell actually wrote.
-        staged_outs = run_merge.gather_staged_outputs(handle, ranges)
-        for (fid, _base, _props), st in zip(outputs, staged_outs):
-            device_cache.put(fid, st)
+    if installer is not None:
+        # spans a chunked stream deferred (parent-domain device arrays
+        # only exist once every chunk's decisions landed) install here;
+        # non-chunked jobs already installed per span as each SST hit
+        # disk. Either way the entries were gathered ON DEVICE — zero
+        # host->device transfer (re-uploading the packed output columns
+        # through the ~14 MB/s tunnel costs more than the whole byte
+        # shell), and `ranges` are the spans the shell actually wrote.
+        installer.finish()
     return CompactionResult(outputs, rows_in + dropped_rows, rows_out,
                             tombstones_written=tombstones_written)
 

@@ -689,11 +689,16 @@ class DB:
         One fused device program resolves merge + MVCC visibility + range
         filter for the whole range (ops/scan.py), instead of the per-step
         Python heap merge of iter_from. SST key columns come from the HBM
-        slab cache (write-through on miss); input SSTs are PINNED for the
-        scan's lifetime so a concurrent compaction cannot delete them
-        (the reference's Version refcounting, ref: db/version_set.cc).
+        slab cache (write-through on miss) — a RESIDENT file is never
+        block-decoded to stage the filter: the kernel runs over the
+        cached matrix and only the blocks holding surviving entries are
+        decoded for their keys/values (ops/scan.ResidentSource). Input
+        SSTs are PINNED for the scan's lifetime so a concurrent
+        compaction cannot delete them (the reference's Version
+        refcounting, ref: db/version_set.cc).
         """
-        from yugabyte_tpu.ops.scan import visible_entries
+        from yugabyte_tpu.ops.scan import (ResidentSource, SlabSource,
+                                           visible_entries_sources)
         import time as _time
         t0 = _time.monotonic()
         with self._lock:
@@ -704,8 +709,14 @@ class DB:
             for fid, _ in readers:
                 self._pins[fid] = self._pins.get(fid, 0) + 1
         try:
-            staged = [None] * len(slabs)
+            sources = [SlabSource(sl) for sl in slabs]
             for fid, r in readers:
+                st = (self._device_cache.get(fid)
+                      if self._device_cache is not None else None)
+                if st is not None and not r.props.has_deep:
+                    # resident fast path: zero host block decode to stage
+                    sources.append(ResidentSource(r, st))
+                    continue
                 try:
                     sl = r.read_all()
                 except StatusError as e:
@@ -713,17 +724,21 @@ class DB:
                     # (the client walks replicas), never a raw Corruption
                     self._route_read_corruption(e)
                     raise
-                slabs.append(sl)
-                if self._device_cache is not None:
-                    st = self._device_cache.get(fid)
-                    if st is None:
-                        st = self._device_cache.stage(fid, sl)  # write-through
-                    staged.append(st)
+                if self._device_cache is not None and not r.props.has_deep:
+                    st = self._device_cache.stage(fid, sl)  # write-through
+                    sources.append(SlabSource(sl, st))
                 else:
-                    staged.append(None)
-            yield from visible_entries(slabs, read_ht_value, lower_key,
-                                       upper_key, device=self.opts.device,
-                                       staged_inputs=staged)
+                    sources.append(SlabSource(sl))
+            try:
+                yield from visible_entries_sources(
+                    sources, read_ht_value, lower_key, upper_key,
+                    device=self.opts.device)
+            except StatusError as e:
+                # a resident source decodes survivor blocks lazily — a
+                # corrupt block surfacing mid-stream takes the same
+                # containment path as the eager decode above
+                self._route_read_corruption(e)
+                raise
         finally:
             _storage_metrics()[1].increment(
                 (_time.monotonic() - t0) * 1e3)

@@ -8,6 +8,14 @@ a transfer-limited interconnect. Flush and compaction write-through: every
 new SST's key columns are staged once, so steady-state compaction finds all
 inputs already resident and only ships back the (bit-packed) keep masks.
 
+Residency is a real multi-level set, not a flat LRU: entries carry the LSM
+level of the file they stage (flush outputs are level 0; a compaction output
+is one above its deepest input), and capacity eviction prefers the SHALLOW
+levels — an L0 slab is small, short-lived (the next pick consumes and drops
+it) and cheap to re-stage, while an L2 base run is the expensive thing the
+chained L0->L1->L2 path exists to keep in HBM. Entries referenced by an
+in-flight compaction are PINNED so eviction can never race a running merge.
+
 Values stay host-side: merge+GC only permutes and drops entries, so value
 bytes never need to cross to the device at all (the original sidecar
 insight, SURVEY.md section 2.7).
@@ -17,7 +25,8 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,8 +34,22 @@ from yugabyte_tpu.ops.merge_gc import (
     _ROW_WORDS, StagedCols, bucket_size, build_sort_schedule,
     pad_template, stage_slab)
 from yugabyte_tpu.ops.slabs import KVSlab
+from yugabyte_tpu.utils import flags
+
+flags.define_flag("device_cache_capacity_bytes", 4 << 30,
+                  "HBM budget for the device-resident slab cache "
+                  "(staged SST key columns); eviction prefers shallow "
+                  "levels and never touches pinned entries")
 
 CacheKey = Tuple[str, int]  # (namespace, file_id) — file ids are per-DB
+
+
+@dataclass
+class _Resident:
+    """One cache entry: the staged columns plus residency metadata."""
+    staged: StagedCols
+    level: int = 0      # LSM level of the staged file (0 = flush output)
+    pins: int = 0       # in-flight compactions reading this entry
 
 
 class DeviceSlabCache:
@@ -34,76 +57,170 @@ class DeviceSlabCache:
     ids are only unique within one DB (like the reference's per-DB file
     numbers under a shared block cache)."""
 
-    def __init__(self, device=None, capacity_bytes: int = 4 << 30):
+    def __init__(self, device=None, capacity_bytes: Optional[int] = None):
         from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
         from yugabyte_tpu.utils import lock_rank
         self.device = device
-        self.capacity = capacity_bytes
+        self.capacity = (capacity_bytes if capacity_bytes is not None
+                         else flags.get_flag("device_cache_capacity_bytes"))
         self._lock = lock_rank.tracked(threading.Lock(),
                                        "device_cache.slab_lock")
-        self._map: "OrderedDict[CacheKey, StagedCols]" = \
+        self._map: "OrderedDict[CacheKey, _Resident]" = \
             OrderedDict()                  # guarded-by: _lock
         self._used = 0                     # guarded-by: _lock
         # per-instance ints (tests diff fresh caches) + process-wide
         # registry counters so the hit ratio is scrapeable
         self.hits = 0                      # guarded-by: _lock
         self.misses = 0                    # guarded-by: _lock
+        self.evictions = 0                 # guarded-by: _lock
         e = ROOT_REGISTRY.entity("server", "device_cache")
         self._c_hits = e.counter("device_cache_hits_total",
                                  "HBM slab cache hits")
         self._c_misses = e.counter("device_cache_misses_total",
                                    "HBM slab cache misses")
+        self._c_evict = e.counter("device_cache_evictions_total",
+                                  "entries evicted under HBM pressure")
         self._g_used = e.gauge("device_cache_used_bytes",
                                "HBM bytes resident in the slab cache")
+        self._g_pinned = e.gauge("device_cache_pinned_count",
+                                 "entries pinned by in-flight compactions")
 
     def get(self, key: CacheKey) -> Optional[StagedCols]:
         with self._lock:
-            staged = self._map.get(key)
-            if staged is None:
+            ent = self._map.get(key)
+            if ent is None:
                 self.misses += 1
                 self._c_misses.increment()
                 return None
             self._map.move_to_end(key)
             self.hits += 1
             self._c_hits.increment()
-            return staged
+            return ent.staged
 
     def contains(self, key: CacheKey) -> bool:
         """Metrics-neutral probe (offload policy peeks without counting)."""
         with self._lock:
             return key in self._map
 
-    def put(self, key: CacheKey, staged: StagedCols) -> None:
+    def level_of(self, key: CacheKey) -> Optional[int]:
+        """Resident entry's LSM level, or None when absent (metrics-neutral:
+        compaction derives its output level from the input levels)."""
+        with self._lock:
+            ent = self._map.get(key)
+            return None if ent is None else ent.level
+
+    # ------------------------------------------------------------- pinning
+    def pin(self, key: CacheKey) -> bool:
+        """Pin an entry for an in-flight job: capacity eviction skips it.
+        Returns False when the key is not resident (nothing to pin)."""
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is None:
+                return False
+            ent.pins += 1
+            self._g_pinned.set(self._pinned_unlocked())
+            return True
+
+    def unpin(self, key: CacheKey) -> None:
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is not None and ent.pins > 0:
+                ent.pins -= 1
+            self._g_pinned.set(self._pinned_unlocked())
+
+    def pinned_count(self) -> int:
+        """Entries with at least one pin — the chaos/fault tests assert
+        this drains to zero after every job, including faulted ones."""
+        with self._lock:
+            return self._pinned_unlocked()
+
+    def _pinned_unlocked(self) -> int:
+        return sum(1 for e in self._map.values() if e.pins > 0)
+
+    # ----------------------------------------------------------- mutation
+    def put(self, key: CacheKey, staged: StagedCols, level: int = 0) -> None:
         with self._lock:
             prior = self._map.pop(key, None)
+            pins = 0
             if prior is not None:
                 # replace, not refuse: a stale entry under a reused id must
                 # never shadow fresh data (correctness, not just freshness)
-                self._used -= prior.nbytes
-            self._map[key] = staged
+                self._used -= prior.staged.nbytes
+                pins = prior.pins
+            self._map[key] = _Resident(staged, level=level, pins=pins)
             self._used += staged.nbytes
-            while self._used > self.capacity and len(self._map) > 1:
-                _, old = self._map.popitem(last=False)
-                self._used -= old.nbytes
+            self._evict_unlocked(protect=key)
             self._g_used.set(self._used)
+
+    def _evict_unlocked(self, protect: Optional[CacheKey] = None) -> None:
+        """Capacity eviction, shallow levels first (L0 slabs are cheap to
+        re-stage and about to be consumed anyway), LRU within a level.
+        Pinned entries — inputs of a running merge — are never touched;
+        if only pinned entries remain over budget, residency temporarily
+        exceeds capacity rather than racing the job."""
+        while self._used > self.capacity:
+            victim = None
+            best = None
+            for age, (k, ent) in enumerate(self._map.items()):
+                if ent.pins > 0 or k == protect:
+                    continue
+                rank = (ent.level, age)
+                if best is None or rank < best:
+                    best = rank
+                    victim = k
+            if victim is None:
+                break
+            self._used -= self._map.pop(victim).staged.nbytes
+            self.evictions += 1
+            self._c_evict.increment()
 
     def drop(self, key: CacheKey) -> None:
         with self._lock:
-            staged = self._map.pop(key, None)
-            if staged is not None:
-                self._used -= staged.nbytes
+            ent = self._map.pop(key, None)
+            if ent is not None:
+                self._used -= ent.staged.nbytes
+                self._g_used.set(self._used)
+                self._g_pinned.set(self._pinned_unlocked())
 
     def drop_namespace(self, namespace: str) -> None:
         """Evict everything a closed DB staged, freeing its HBM residency."""
         with self._lock:
             dead = [k for k in self._map if k[0] == namespace]
             for k in dead:
-                self._used -= self._map.pop(k).nbytes
+                self._used -= self._map.pop(k).staged.nbytes
+            if dead:
+                self._g_used.set(self._used)
+                self._g_pinned.set(self._pinned_unlocked())
 
-    def stage(self, key: CacheKey, slab: KVSlab) -> StagedCols:
+    def stage(self, key: CacheKey, slab: KVSlab,
+              level: int = 0) -> StagedCols:
         staged = stage_slab(slab, self.device)
-        self.put(key, staged)
+        self.put(key, staged, level=level)
         return staged
+
+    def snapshot(self) -> dict:
+        """Residency block for /compactionz: totals plus the per-level
+        breakdown the multi-level eviction policy acts on."""
+        with self._lock:
+            levels: Dict[int, dict] = {}
+            for ent in self._map.values():
+                lv = levels.setdefault(ent.level,
+                                       {"entries": 0, "bytes": 0,
+                                        "pinned": 0})
+                lv["entries"] += 1
+                lv["bytes"] += ent.staged.nbytes
+                if ent.pins > 0:
+                    lv["pinned"] += 1
+            return {
+                "capacity_bytes": self.capacity,
+                "used_bytes": self._used,
+                "entries": len(self._map),
+                "pinned": self._pinned_unlocked(),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "levels": {f"L{k}": v for k, v in sorted(levels.items())},
+            }
 
 
 class NamespacedSlabCache:
@@ -131,8 +248,20 @@ class NamespacedSlabCache:
     def contains(self, file_id: int) -> bool:
         return self._shared.contains((self.namespace, file_id))
 
-    def put(self, file_id: int, staged: StagedCols) -> None:
-        self._shared.put((self.namespace, file_id), staged)
+    def level_of(self, file_id: int) -> Optional[int]:
+        return self._shared.level_of((self.namespace, file_id))
+
+    def pin(self, file_id: int) -> bool:
+        return self._shared.pin((self.namespace, file_id))
+
+    def unpin(self, file_id: int) -> None:
+        self._shared.unpin((self.namespace, file_id))
+
+    def pinned_count(self) -> int:
+        return self._shared.pinned_count()
+
+    def put(self, file_id: int, staged: StagedCols, level: int = 0) -> None:
+        self._shared.put((self.namespace, file_id), staged, level=level)
 
     def drop(self, file_id: int) -> None:
         self._shared.drop((self.namespace, file_id))
@@ -140,8 +269,10 @@ class NamespacedSlabCache:
     def drop_all(self) -> None:
         self._shared.drop_namespace(self.namespace)
 
-    def stage(self, file_id: int, slab: KVSlab) -> StagedCols:
-        return self._shared.stage((self.namespace, file_id), slab)
+    def stage(self, file_id: int, slab: KVSlab,
+              level: int = 0) -> StagedCols:
+        return self._shared.stage((self.namespace, file_id), slab,
+                                  level=level)
 
 
 class HostStagingPool:
@@ -242,49 +373,47 @@ def host_staging_pool() -> HostStagingPool:
         return _staging_pool
 
 
+def merged_column_stats(staged_list: Sequence[StagedCols], w: int
+                        ) -> np.ndarray:
+    """Cross-input is_const vector over staged inputs, vectorized: a row
+    prunes from the sort/compare schedule only when it is constant WITH
+    THE SAME VALUE across every input (constant-per-input with differing
+    values still orders the merge). Inputs narrower than w expose their
+    extra word rows as constant zero; inputs without column stats (device
+    write-through gathers skip the host fetch) poison every row they
+    cover as non-constant."""
+    r_total = _ROW_WORDS + w
+    k = len(staged_list)
+    consts = np.zeros((k, r_total), dtype=bool)
+    firsts = np.zeros((k, r_total), dtype=np.uint32)
+    for i, s in enumerate(staged_list):
+        rs = min(_ROW_WORDS + s.w, r_total)
+        consts[i, rs:] = True              # implicit zero-pad word rows
+        if s.col_const is not None:
+            consts[i, :rs] = s.col_const[:rs]
+            firsts[i, :rs] = s.col_first[:rs]
+    return consts.all(axis=0) & (firsts == firsts[0:1]).all(axis=0)
+
+
 def concat_staged(staged_list: Sequence[StagedCols]) -> StagedCols:
     """Concatenate staged inputs ON DEVICE into one padded cols matrix.
 
-    All transfers avoided: pad each input's width to the max, concatenate
-    along entries, pad entry count to the bucket size — all jnp ops on the
-    cached arrays' device (placement follows the cache's device).
+    All transfers avoided: ONE cached jitted program (_concat_staged_fused,
+    ops/run_merge.py — part of the restage_concat kernel family in the
+    compile-surface manifest) pads each input's width to the max, lays the
+    real rows out contiguously and pads the tail to the bucket size, all
+    in HBM. The merged sort schedule prunes rows via the vectorized
+    cross-input column stats (merged_column_stats).
     """
     import jax.numpy as jnp
+    from yugabyte_tpu.ops.run_merge import _concat_staged_fused
 
     w = max(s.w for s in staged_list)
     n = sum(s.n for s in staged_list)
     n_pad = bucket_size(n)
-    parts = []
-    for s in staged_list:
-        cols = s.cols_dev[:, :s.n]  # strip per-input padding
-        if s.w < w:
-            pad_words = jnp.zeros((w - s.w, s.n), dtype=jnp.uint32)
-            cols = jnp.concatenate([cols, pad_words], axis=0)
-        parts.append(cols)
-    cat = jnp.concatenate(parts, axis=1)
-    tail = n_pad - n
-    if tail:
-        pad = jnp.asarray(pad_template(cat.shape[0]))[:, None]
-        cat = jnp.concatenate([cat, jnp.tile(pad, (1, tail))], axis=1)
-    # Merged schedule: a column is skippable only if CONSTANT WITH THE SAME
-    # VALUE across every input (constant-per-input with differing values
-    # still orders the merge). Inputs narrower than w expose the extra word
-    # rows as constant zero.
-    r_total = _ROW_WORDS + w
-    is_const = np.ones(r_total, bool)
-    first_vals: List[Optional[int]] = [None] * r_total
-    for s in staged_list:
-        for row in range(r_total):
-            if row >= _ROW_WORDS + s.w:
-                c, v = True, 0  # implicit zero-pad word rows
-            else:
-                c = bool(s.col_const[row]) if s.col_const is not None else False
-                v = int(s.col_first[row]) if s.col_first is not None else 0
-            if not c:
-                is_const[row] = False
-            elif first_vals[row] is None:
-                first_vals[row] = v
-            elif first_vals[row] != v:
-                is_const[row] = False
+    parts = tuple(s.cols_dev for s in staged_list)
+    ns = jnp.asarray([s.n for s in staged_list], dtype=jnp.int32)
+    cat = _concat_staged_fused(parts, ns, w=w, n_pad=n_pad)
+    is_const = merged_column_stats(staged_list, w)
     sort_rows, n_sort = build_sort_schedule(w, is_const)
     return StagedCols(cat, sort_rows, n_sort, n, n_pad, w)

@@ -231,6 +231,96 @@ def shadow_snapshot() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Resident-slab digest verification: the device-gathered cache entry vs
+# the SST bytes the shell actually wrote. The chained L0->L1->L2 path
+# FEEDS the next compaction from these entries without ever re-decoding
+# the file, so a wrong entry would silently poison every downstream
+# merge — this sampled check keeps the write-through honest against the
+# host truth (the installed, CRC-covered SST), exactly the posture the
+# shadow verifier holds over the survivor decisions.
+
+
+flags.define_flag("resident_digest_sample", 0.02,
+                  "fraction of device write-through cache installs whose "
+                  "staged columns are re-derived from the written SST "
+                  "bytes and compared (0 disables; a mismatched entry is "
+                  "dropped, never installed)")
+
+
+def resident_digest_mismatch_counter():
+    return _counter("resident_digest_mismatch_total",
+                    "device write-through cache entries that diverged "
+                    "from a host re-stage of the installed SST bytes "
+                    "(entry dropped before any chained merge could read "
+                    "it)")
+
+
+def verify_resident_entry(staged, base_path: str) -> List[str]:
+    """Full check of one write-through cache entry against the decoded
+    bytes of its installed SST. Costs a D2H fetch of the staged columns
+    plus a host decode+pack — hence the sampling gate around it.
+    Returns the (possibly empty) list of divergences."""
+    from yugabyte_tpu.ops.merge_gc import pack_cols
+    from yugabyte_tpu.storage.sst import SSTReader
+    errors: List[str] = []
+    reader = SSTReader(base_path)
+    try:
+        slab = reader.read_all()
+    finally:
+        reader.close()
+    host_cols, n, _n_pad, _w = pack_cols(slab)
+    if staged.n != n:
+        return [f"row count: staged {staged.n} != decoded {n}"]
+    dev_cols = np.asarray(staged.cols_dev)
+    r_common = min(dev_cols.shape[0], host_cols.shape[0])
+    if not np.array_equal(dev_cols[:r_common, :n], host_cols[:r_common, :n]):
+        bad = np.nonzero(dev_cols[:r_common, :n]
+                         != host_cols[:r_common, :n])
+        errors.append(f"column words diverge at (row {int(bad[0][0])}, "
+                      f"entry {int(bad[1][0])})")
+    if dev_cols.shape[0] > r_common \
+            and not (dev_cols[r_common:, :n] == 0).all():
+        errors.append("staged width padding rows are not zero")
+    return errors
+
+
+def maybe_verify_resident_entry(staged, base_path: str) -> bool:
+    """Sampling gate for the write-through install path: True when the
+    entry may install (clean, or unsampled), False when the digest check
+    found a divergence (counted; the caller drops the entry and lets the
+    next reader re-stage from the file bytes)."""
+    sample = float(flags.get_flag("resident_digest_sample"))
+    if sample <= 0:
+        return True
+    if sample < 1.0:
+        import random
+        if random.random() >= sample:
+            return True
+    errors = verify_resident_entry(staged, base_path)
+    _counter("resident_digest_checked_total",
+             "device write-through cache installs digest-checked "
+             "against the installed SST bytes").increment()
+    if not errors:
+        return True
+    from yugabyte_tpu.utils.trace import TRACE
+    resident_digest_mismatch_counter().increment()
+    TRACE("resident digest: device-staged entry for %s diverges from the "
+          "installed bytes (%s) — entry dropped, not installed",
+          base_path, errors[0])
+    return False
+
+
+def resident_digest_snapshot() -> dict:
+    """Write-through digest-check state for /integrityz."""
+    e = integrity_metrics()
+    return {
+        "sample": float(flags.get_flag("resident_digest_sample")),
+        "checked": e.counter("resident_digest_checked_total", "").value(),
+        "mismatches": resident_digest_mismatch_counter().value(),
+    }
+
+
+# ---------------------------------------------------------------------------
 # At-rest SST verification (the scrub + sst_dump/ldb --verify core)
 
 

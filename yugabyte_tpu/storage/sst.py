@@ -58,6 +58,18 @@ SST_MAGIC = 0x59425453535431  # "YBTSST1"
 _FOOTER = struct.Struct("<QIQIQIQIQ")
 
 
+def _block_decode_counter():
+    """Decode-flatness meter for the device-resident chain: resident-slab
+    scans and run-cache-fed compactions must leave this flat — any
+    increment on the warm path means host bytes were re-decoded that the
+    HBM/run caches were supposed to make unnecessary."""
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    return ROOT_REGISTRY.entity("server", "storage").counter(
+        "sst_block_decode_total",
+        "SST blocks decoded from file bytes (block-cache hits and "
+        "resident-slab scans skip this)")
+
+
 def data_file_name(base_path: str) -> str:
     """ref: TableBaseToDataFileName (db/filename.h:92)."""
     return base_path + ".sblock.0"
@@ -348,6 +360,7 @@ class SSTReader:
                 return cached
         off, size, _ = self.block_handles[block_idx]
         slab = block_format.decode_block(self._data.pread(size, off))
+        _block_decode_counter().increment()
         if self.block_cache is not None:
             self.block_cache.put((self.base_path, block_idx), slab, size)
         return slab

@@ -142,7 +142,11 @@ class PrewarmKernelsOp(MaintenanceOp):
     + the persistent compilation cache, every bucket a tablet's lifetime
     of compactions needs is a one-time cost — paid HERE, before traffic,
     instead of stalling the first real compaction of each shape for the
-    full XLA compile (107s measured on the tunnel TPU).
+    full XLA compile (107s measured on the tunnel TPU). Each bucket's
+    warm covers the whole chained-compaction surface: both is_major merge
+    variants, the device-resident restage/survivor-scan/span-gather
+    programs (the L0->L1->L2 write-through path), and on TPU the pallas
+    tournament kernel.
 
     Scored just below recovery (warm kernels beat compaction debt: every
     queued compaction stalls on a cold bucket) and unrunnable after the

@@ -40,8 +40,6 @@ flags.define_flag("tserver_device", "auto",
 flags.define_flag("device_init_timeout_s", 30,
                   "give up on JAX backend initialization after this long "
                   "and fall back to the native C++ compaction path")
-flags.define_flag("device_slab_cache_bytes", 4 << 30,
-                  "HBM budget for the server-wide staged-slab cache")
 flags.define_flag("block_cache_bytes", 256 << 20,
                   "host RAM budget for the shared decoded-block cache "
                   "(ref block cache sizing, docdb_rocksdb_util.cc)")
@@ -107,9 +105,9 @@ class ServerExecutionContext:
                 flags.get_flag("device_init_timeout_s"))
         self.device_cache = None
         if self.device != "native":
-            self.device_cache = DeviceSlabCache(
-                self.device,
-                capacity_bytes=flags.get_flag("device_slab_cache_bytes"))
+            # capacity rides --device_cache_capacity_bytes (defined by
+            # storage/device_cache.py, the flag's single owner)
+            self.device_cache = DeviceSlabCache(self.device)
         self.block_cache = BlockCache(flags.get_flag("block_cache_bytes"))
         from yugabyte_tpu.storage.offload_policy import OffloadPolicy
         self.offload_policy = OffloadPolicy.load(

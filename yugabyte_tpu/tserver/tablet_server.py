@@ -202,9 +202,16 @@ class TabletServer:
                 "kernel_chunk_retry_total",
                 "per-chunk kernel retries after a device fault").value(),
         }
-        return {"server_id": self.server_id, "totals": totals,
-                "pipeline": pipeline, "device_faults": device_faults,
-                "tablets": tablets}
+        out = {"server_id": self.server_id, "totals": totals,
+               "pipeline": pipeline, "device_faults": device_faults,
+               "tablets": tablets}
+        # HBM residency: the multi-level resident set behind the chained
+        # L0->L1->L2 compaction path — per-level entries/bytes, pins and
+        # eviction pressure (storage/device_cache.py snapshot)
+        ctx = self.exec_context
+        if ctx is not None and ctx.device_cache is not None:
+            out["device_cache"] = ctx.device_cache.snapshot()
+        return out
 
     def integrityz(self) -> dict:
         """Data-integrity state: shadow-verify sampling + mismatch
@@ -222,6 +229,7 @@ class TabletServer:
             })
         return {"server_id": self.server_id,
                 "shadow_verify": integrity.shadow_snapshot(),
+                "resident_digest": integrity.resident_digest_snapshot(),
                 "scrub": integrity.scrub_snapshot(),
                 "quarantined_files": integrity.quarantined_files(),
                 "tablets": tablets}
