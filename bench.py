@@ -275,6 +275,90 @@ def run_warm_child(platform: str, workload_path: str) -> None:
                       "compile_s": round(compile_s, 2)}), flush=True)
 
 
+def run_points_child(platform: str, db_dir: str, n_str: str) -> None:
+    """Batched point-read rung (ROADMAP item 4): multi_get through the
+    device bloom/locate/gather kernels over the scan-stage DB, batch
+    sizes 64/1024, hit + bloom-rejected miss mixes, with learned-index
+    hit/fallback counters. Runs as a child so the platform choice (TPU
+    when the tunnel is up, else the CPU fallback) never hangs the
+    parent."""
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    if platform == "tpu" and dev.platform == "cpu":
+        sys.exit(3)
+    n = int(n_str)
+    from yugabyte_tpu.ops.point_read import point_read_metrics
+    from yugabyte_tpu.ops.slabs import _doc_key_len
+    from yugabyte_tpu.storage.db import DB, DBOptions
+    from yugabyte_tpu.storage.device_cache import DeviceSlabCache
+    from yugabyte_tpu.storage.sst import BlockCache
+
+    rng = np.random.default_rng(17)
+    db = DB(db_dir, DBOptions(device=dev,
+                              device_cache=DeviceSlabCache(device=dev),
+                              auto_compact=False,
+                              block_cache=BlockCache(256 << 20)))
+    out = {"points_device": str(dev)}
+
+    def key_of(i: int) -> bytes:
+        return b"Suser%08d\x00\x00!" % i
+
+    try:
+        dkl = _doc_key_len(key_of(0))
+        m = point_read_metrics()
+        lh0 = m["learned_hits"].value()
+        lf0 = m["learned_fallbacks"].value()
+        sk0 = m["bloom_skips"].value()
+        # correctness gate before any rate ships: batched == sequential
+        spot = [key_of(int(i)) for i in rng.integers(0, n + 64, size=256)]
+        assert db.multi_get(spot, doc_key_lens=[dkl] * len(spot)) == \
+            [db.get(k) for k in spot], "multi_get != sequential gets"
+        for bs in (64, 1024):
+            mq = 40_960 if bs == 1024 else 8_192
+            hit_keys = [key_of(int(i))
+                        for i in rng.integers(0, n, size=mq)]
+            db.multi_get(hit_keys[:bs], doc_key_lens=[dkl] * bs)  # warm
+            t0 = time.time()
+            found = 0
+            for s in range(0, mq, bs):
+                chunk = hit_keys[s: s + bs]
+                res = db.multi_get(chunk,
+                                   doc_key_lens=[dkl] * len(chunk))
+                found += sum(r is not None for r in res)
+            dt = time.time() - t0
+            assert found == mq, f"batched hits: {found}/{mq}"
+            out[f"point_reads_batched_b{bs}_per_sec"] = round(mq / dt, 1)
+            # bloom-rejected misses: keys outside the loaded range
+            miss_keys = [key_of(n + 10 + i) for i in range(mq)]
+            t0 = time.time()
+            for s in range(0, mq, bs):
+                chunk = miss_keys[s: s + bs]
+                if any(r is not None for r in db.multi_get(
+                        chunk, doc_key_lens=[dkl] * len(chunk))):
+                    raise AssertionError("phantom batched read")
+            out[f"point_miss_batched_b{bs}_per_sec"] = round(
+                mq / (time.time() - t0), 1)
+            log(f"  batched point reads (B={bs}): "
+                f"{out[f'point_reads_batched_b{bs}_per_sec']:.0f}/s hit, "
+                f"{out[f'point_miss_batched_b{bs}_per_sec']:.0f}/s miss")
+        out["point_reads_batched_per_sec"] = \
+            out["point_reads_batched_b1024_per_sec"]
+        out["point_miss_batched_per_sec"] = \
+            out["point_miss_batched_b1024_per_sec"]
+        m = point_read_metrics()
+        out["point_read_learned_hits"] = int(m["learned_hits"].value()
+                                             - lh0)
+        out["point_read_learned_fallbacks"] = int(
+            m["learned_fallbacks"].value() - lf0)
+        out["point_read_bloom_skipped_ssts"] = int(
+            m["bloom_skips"].value() - sk0)
+    finally:
+        db.close()
+    print(json.dumps(out), flush=True)
+
+
 class StageLog:
     """Per-stage checkpoint file: the parent assembles a partial result if
     the child dies late (VERDICT r3: a 480s all-or-nothing budget threw away
@@ -750,7 +834,7 @@ def _native_e2e_rate(n_rows: int, cutoff: int, n_runs: int = 3):
         shutil.rmtree(nat_dir, ignore_errors=True)
 
 
-def _scan_point_stages(n_rows: int) -> dict:
+def _scan_point_stages(n_rows: int, tpu_ok: bool = False) -> dict:
     """BASELINE configs 3-4 (VERDICT r3 #7 / r4 next #2+#5): full-tablet
     seq-scan MB/s, bloom-gated point reads, and the write/ingest path —
     all through the PRODUCTION serving paths (native read engine + native
@@ -898,7 +982,9 @@ def _scan_point_stages(n_rows: int) -> dict:
                 raise AssertionError("phantom point read")
         dt = time.time() - t0
         out["point_miss_per_sec"] = round(m / dt, 1)
-        # baseline column: the Python heap-merge get over the same DB
+        # baseline column: the Python heap-merge get over the same DB —
+        # both mixes, so the batched-vs-python comparison covers the
+        # bloom-rejected miss path too (not just hit-path reads)
         prior_native = _flags.get_flag("read_native")
         _flags.set_flag("read_native", False)
         try:
@@ -907,12 +993,39 @@ def _scan_point_stages(n_rows: int) -> dict:
             for i in hit_ids[:mp]:
                 assert db.get(b"Suser%08d\x00\x00!" % i) is not None
             out["point_reads_py_per_sec"] = round(mp / (time.time() - t0), 1)
+            t0 = time.time()
+            for i in range(mp):
+                if db.get(b"Suser%08d\x00\x00!" % (n + 10 + i)) is not None:
+                    raise AssertionError("phantom python point read")
+            out["point_miss_py_per_sec"] = round(mp / (time.time() - t0), 1)
         finally:
             _flags.set_flag("read_native", prior_native)
         log(f"  point reads: {out['point_reads_per_sec']:.0f}/s hit "
             f"(python baseline {out['point_reads_py_per_sec']:.0f}/s), "
-            f"{out['point_miss_per_sec']:.0f}/s bloom-gated miss")
+            f"{out['point_miss_per_sec']:.0f}/s bloom-gated miss "
+            f"(python {out['point_miss_py_per_sec']:.0f}/s)")
         db.close()
+
+        # ---- batched point reads (ROADMAP item 4): multi_get through
+        # the device bloom/locate/gather kernels + learned index, in a
+        # child so a downed TPU tunnel degrades to the CPU fallback
+        # instead of hanging the parent's jax runtime
+        plat = "tpu" if tpu_ok else "cpu"
+        pts = _spawn_child(plat, 600, os.path.join(workdir, "db"),
+                           str(n), mode="--points")
+        if pts is None and plat == "tpu":
+            log("  TPU points child failed — retrying on the CPU fallback")
+            pts = _spawn_child("cpu", 600, os.path.join(workdir, "db"),
+                               str(n), mode="--points")
+        if pts:
+            out.update(pts)
+            batched = pts.get("point_reads_batched_per_sec", 0)
+            if batched and out.get("point_reads_py_per_sec"):
+                out["point_batched_vs_py"] = round(
+                    batched / out["point_reads_py_per_sec"], 1)
+            if batched and out.get("point_reads_per_sec"):
+                out["point_batched_vs_per_call"] = round(
+                    batched / out["point_reads_per_sec"], 2)
     except Exception as e:  # noqa: BLE001 — stage is best-effort
         log(f"scan/point stage failed: {e}")
     finally:
@@ -1187,6 +1300,9 @@ def main():
     if len(sys.argv) >= 4 and sys.argv[1] == "--warm":
         run_warm_child(sys.argv[2], sys.argv[3])
         return
+    if len(sys.argv) >= 5 and sys.argv[1] == "--points":
+        run_points_child(sys.argv[2], sys.argv[3], sys.argv[4])
+        return
     if len(sys.argv) >= 4 and sys.argv[1] == "--child":
         run_device_child(sys.argv[2], sys.argv[3],
                          sys.argv[4] if len(sys.argv) > 4 else None)
@@ -1269,7 +1385,8 @@ def main():
     # scan-path stages (BASELINE configs 3-4): storage-level CPU numbers,
     # independent of the device child's fate
     result.update(_scan_point_stages(
-        int(result.get("n_rows") or n_top)))
+        int(result.get("n_rows") or n_top),
+        tpu_ok=result.get("platform") == "tpu"))
     # BASELINE config 5: the 3-node RF=3 cluster soak with churn
     if os.environ.get("YBTPU_BENCH_SKIP_SOAK", "") != "1":
         result.update(_cluster_soak_stage())

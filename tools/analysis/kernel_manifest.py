@@ -55,6 +55,9 @@ _PALLAS = "yugabyte_tpu/ops/pallas_merge.py"
 _DIST = "yugabyte_tpu/parallel/dist_compact.py"
 _POLICY = "yugabyte_tpu/storage/offload_policy.py"
 _DEVICE_CACHE = "yugabyte_tpu/storage/device_cache.py"
+_POINT_READ = "yugabyte_tpu/ops/point_read.py"
+_BLOOM = "yugabyte_tpu/storage/bloom.py"
+_LEARNED = "yugabyte_tpu/storage/learned_index.py"
 
 # Per-family compile-surface definition: which source symbols shape the
 # lowered program (fingerprinted for the fast drift gate), the budget
@@ -141,6 +144,58 @@ FAMILIES: Dict[str, dict] = {
             _RUN_MERGE: ["_chunk_split_search", "_carve_chunk",
                          "_W_ROUTE_CHUNK", "_chunk_target_rows"],
             _MERGE_GC: ["route_word_mask", "pad_template"],
+        },
+    },
+    "point_read_probe": {
+        # batched serve-path bloom gate: the device FNV hash over the
+        # doc-key prefixes (one dispatch per multi_get chunk) + the
+        # per-SST bit probe. storage/bloom.py is the CPU twin — its
+        # builder arithmetic DEFINES the bit positions, so it is part of
+        # this family's compile surface.
+        "budget": 8,
+        "anchor": _POINT_READ,
+        "symbols": {
+            _POINT_READ: ["_fnv64_fused", "_mul64_by_prime",
+                          "_bloom_probe_fused", "bloom_device_words",
+                          "pack_query_batch", "batch_bucket",
+                          "BATCH_BUCKETS", "_PREWARM_MWORDS",
+                          "_PREWARM_WIDTHS", "_K_MAX",
+                          "BLOOM_PROBE_MAX_BITS"],
+            _BLOOM: ["fnv64_masked", "BloomFilterBuilder", "BloomFilter"],
+        },
+    },
+    "point_read_locate": {
+        # vectorized point locate + survivor gather over resident slab
+        # matrices, optionally seeded by the learned per-SST index
+        # (ROADMAP item 4's serve-path kernel)
+        "budget": 16,
+        "anchor": _POINT_READ,
+        "symbols": {
+            _POINT_READ: ["_locate_gather_fused", "_seek_pred",
+                          "_predict_pos", "_x_words", "_sub64",
+                          "_f64ish", "_ge64", "_LG_WINDOW",
+                          "batch_bucket", "BATCH_BUCKETS",
+                          "_PREWARM_NPADS", "_PREWARM_WIDTHS"],
+            _MERGE_GC: ["bucket_size", "pad_template"],
+            _LEARNED: ["LINDEX_SEGMENTS", "LINDEX_MAX_ERR",
+                       "model_operands", "_anchor_positions"],
+        },
+    },
+    "index_fit": {
+        # learned-index fit over staged (sorted) cols — runs at
+        # flush/compaction write-through while the keys are in HBM for
+        # free; the numpy twin in storage/learned_index.py shares the
+        # inference arithmetic and is fingerprinted with it
+        "budget": 4,
+        "anchor": _POINT_READ,
+        "symbols": {
+            _POINT_READ: ["_index_fit_fused", "_predict_pos", "_x_words",
+                          "_sub64", "_f64ish", "_ge64",
+                          "fit_learned_index_device"],
+            _LEARNED: ["fit_from_sorted_words", "fit_from_packed_keys",
+                       "fit_from_slab", "finish_model", "_predict_host",
+                       "_anchor_positions", "LINDEX_SEGMENTS",
+                       "LINDEX_MIN_ENTRIES"],
         },
     },
     "dist_compact": {
@@ -728,6 +783,148 @@ def _gen_chunk_carve() -> dict:
     return {"entries": entries}
 
 
+def _gen_point_read_probe() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import point_read
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    sdt = jax.ShapeDtypeStruct
+    i32 = sdt((), jnp.int32)
+    u32 = sdt((), jnp.uint32)
+    for b in point_read.BATCH_BUCKETS:
+        for w in point_read._PREWARM_WIDTHS:
+            args = (sdt((b, w), jnp.uint32), sdt((b,), jnp.int32))
+            statics = dict(w=w)
+            out = jax.eval_shape(
+                lambda *a: point_read._fnv64_fused(*a, **statics), *args)
+            text = lowering_text(point_read._fnv64_fused, args, statics)
+            bucket = {"b": b, "w": w}
+            entries.append({
+                "key": "fnv64 " + entry_key(bucket),
+                "bucket": bucket,
+                "static_args": statics,
+                "in_avals": [_aval_str(a) for a in args],
+                "out_avals": [_aval_str(o) for o in
+                              jax.tree_util.tree_leaves(out)],
+                "donation": None,
+                "variant_axes": {},
+                "executables": 1,
+                "prewarmed": True,
+                "quarantine_key": None,
+                "lowering_sha256": _lowering_sha256(text),
+            })
+        for mw in point_read._PREWARM_MWORDS:
+            args = (sdt((b,), jnp.uint32), sdt((b,), jnp.uint32),
+                    sdt((mw,), jnp.uint32), u32, i32)
+            out = jax.eval_shape(point_read._bloom_probe_fused, *args)
+            text = lowering_text(point_read._bloom_probe_fused, args, {})
+            bucket = {"b": b, "m_words": mw}
+            entries.append({
+                "key": "bloom_probe " + entry_key(bucket),
+                "bucket": bucket,
+                "static_args": {},
+                "in_avals": [_aval_str(a) for a in args],
+                "out_avals": [_aval_str(o) for o in
+                              jax.tree_util.tree_leaves(out)],
+                "donation": None,
+                "variant_axes": {},
+                "executables": 1,
+                "prewarmed": True,
+                "quarantine_key": None,
+                "lowering_sha256": _lowering_sha256(text),
+            })
+    return {"entries": entries}
+
+
+def _gen_point_read_locate() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import point_read
+    from yugabyte_tpu.storage.learned_index import LINDEX_SEGMENTS
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    sdt = jax.ShapeDtypeStruct
+    i32 = sdt((), jnp.int32)
+    u32 = sdt((), jnp.uint32)
+    for b in point_read.BATCH_BUCKETS:
+        for w in point_read._PREWARM_WIDTHS:
+            for n_pad in point_read._PREWARM_NPADS:
+                for use_model in (False, True):
+                    args = (sdt((8 + w, n_pad), jnp.uint32), i32,
+                            sdt((b, w), jnp.uint32), sdt((b,), jnp.int32),
+                            u32, u32,
+                            sdt((LINDEX_SEGMENTS + 1,), jnp.uint32),
+                            sdt((LINDEX_SEGMENTS + 1,), jnp.uint32),
+                            sdt((LINDEX_SEGMENTS + 1,), jnp.int32),
+                            i32, i32)
+                    statics = dict(w=w, use_model=use_model)
+                    out = jax.eval_shape(
+                        lambda *a: point_read._locate_gather_fused(
+                            *a, **statics), *args)
+                    text = lowering_text(point_read._locate_gather_fused,
+                                         args, statics)
+                    bucket = {"b": b, "n_pad": n_pad, "w": w}
+                    impl = "model" if use_model else "exact"
+                    entries.append({
+                        "key": "locate_gather " + entry_key(bucket, impl),
+                        "bucket": bucket,
+                        "impl": impl,
+                        "static_args": statics,
+                        "in_avals": [_aval_str(a) for a in args],
+                        "out_avals": [_aval_str(o) for o in
+                                      jax.tree_util.tree_leaves(out)],
+                        # inputs are LIVE slab-cache entries: donation is
+                        # forbidden by design (the cache must survive)
+                        "donation": None,
+                        "variant_axes": {},
+                        "executables": 1,
+                        "prewarmed": True,
+                        "quarantine_key": [1, n_pad],
+                        "lowering_sha256": _lowering_sha256(text),
+                    })
+    return {"entries": entries}
+
+
+def _gen_index_fit() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import point_read
+    from yugabyte_tpu.storage.learned_index import LINDEX_SEGMENTS
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    sdt = jax.ShapeDtypeStruct
+    i32 = sdt((), jnp.int32)
+    for w in point_read._PREWARM_WIDTHS:
+        for n_pad in point_read._PREWARM_NPADS:
+            args = (sdt((8 + w, n_pad), jnp.uint32), i32)
+            statics = dict(n_segments=LINDEX_SEGMENTS, w=w)
+            out = jax.eval_shape(
+                lambda *a: point_read._index_fit_fused(*a, **statics),
+                *args)
+            text = lowering_text(point_read._index_fit_fused, args,
+                                 statics)
+            bucket = {"n_pad": n_pad, "w": w}
+            entries.append({
+                "key": "index_fit " + entry_key(bucket),
+                "bucket": bucket,
+                "static_args": statics,
+                "in_avals": [_aval_str(a) for a in args],
+                "out_avals": [_aval_str(o) for o in
+                              jax.tree_util.tree_leaves(out)],
+                "donation": None,
+                "variant_axes": {},
+                "executables": 1,
+                "prewarmed": True,
+                "quarantine_key": None,
+                "lowering_sha256": _lowering_sha256(text),
+            })
+    return {"entries": entries}
+
+
 def _gen_dist_compact() -> dict:
     # shard_map needs a real mesh; the declared compile-key lattice is
     # recorded instead (enforced in code: distributed_compact quantizes
@@ -753,6 +950,9 @@ _GENERATORS = {
     "restage_concat": _gen_restage_concat,
     "pallas_merge": _gen_pallas_merge,
     "chunk_carve": _gen_chunk_carve,
+    "point_read_probe": _gen_point_read_probe,
+    "point_read_locate": _gen_point_read_locate,
+    "index_fit": _gen_index_fit,
     "dist_compact": _gen_dist_compact,
 }
 

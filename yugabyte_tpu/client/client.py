@@ -472,6 +472,32 @@ class YBClient:
             schema_version=table.schema_version)
         return row_from_wire(w)
 
+    def multi_read(self, table: YBTable, doc_keys: Sequence[DocKey],
+                   read_ht: Optional[HybridTime] = None,
+                   projection: Optional[Sequence[str]] = None):
+        """Batched point-row reads: keys group per tablet and each group
+        rides ONE multi_read RPC (one leader-lease check + read-point
+        resolution server-side, and the batched device point-read path
+        under it), instead of a read_row round trip per key. Returns
+        rows aligned with doc_keys (None = absent)."""
+        groups: Dict[str, Tuple[RemoteTablet, bytes, List[int]]] = {}
+        for i, dk in enumerate(doc_keys):
+            pk = table.partition_key_for(dk)
+            tablet = self.meta_cache.lookup_tablet(table.table_id, pk)
+            groups.setdefault(tablet.tablet_id,
+                              (tablet, pk, []))[2].append(i)
+        out: List = [None] * len(doc_keys)
+        for tablet, pk, idxs in groups.values():
+            resp = self._tablet_call(
+                table, tablet, "multi_read", refresh_key=pk,
+                doc_keys=[doc_key_to_wire(doc_keys[i]) for i in idxs],
+                read_ht=read_ht.value if read_ht else None,
+                projection=list(projection) if projection else None,
+                schema_version=table.schema_version)
+            for i, w in zip(idxs, resp["rows"]):
+                out[i] = None if w is None else row_from_wire(w)
+        return out
+
     def scan(self, table: YBTable, read_ht: Optional[HybridTime] = None,
              projection: Optional[Sequence[str]] = None,
              page_size: int = 4096,

@@ -8,9 +8,12 @@ DocKey prefix (doc_key_len), which is strictly more selective for point gets
 and equally computable from slabs (doc_key_len is a slab column).
 
 Build is vectorized over entries (byte-position loop is bounded by the key
-stride); probes use FNV-64 split into two 32-bit halves, double-hashed —
-the same arithmetic is trivially expressible in JAX for the TPU batched-probe
-kernel (ops/scan.py).
+stride); probes use FNV-64 split into two 32-bit halves, double-hashed.
+This module is the CPU path of the batched device probe: the TPU twin
+(ops/point_read.py `_fnv64_fused` + `_bloom_probe_fused`) reproduces the
+same uint64 arithmetic in two uint32 limbs and probes one SST's bit words
+for a whole key batch in one dispatch — the two paths must stay
+bit-identical (differential-tested in tests/test_point_read_batch.py).
 """
 
 from __future__ import annotations
@@ -87,7 +90,8 @@ class BloomFilter:
         return self.may_contain_hash(h)
 
     def may_contain_batch(self, h: np.ndarray) -> np.ndarray:
-        """Vectorized probe for a batch of hashes (CPU path of the TPU kernel)."""
+        """Vectorized probe for a batch of hashes — the CPU path of
+        ops/point_read._bloom_probe_fused (bit-identical positions)."""
         h1 = (h & np.uint64(0xFFFFFFFF)).astype(np.uint64)
         h2 = (h >> np.uint64(32)).astype(np.uint64) | np.uint64(1)
         ok = np.ones(h.shape[0], dtype=bool)

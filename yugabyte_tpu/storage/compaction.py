@@ -356,7 +356,12 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         out_slab = _gather_slab(merged, sel, tomb_flags[start:end], tombstone_value)
         fid = new_file_id()
         base_path = os.path.join(out_dir, f"{fid:06d}.sst")
-        props = SSTWriter(base_path, block_entries=block_entries).write(out_slab, fr)
+        # fit_lindex=False: python compaction outputs stay byte-identical
+        # to the native writer's (which cannot fit); compaction-output
+        # models come from the device-native span hook, where the sorted
+        # keys are in HBM for free
+        props = SSTWriter(base_path, block_entries=block_entries,
+                          fit_lindex=False).write(out_slab, fr)
         outputs.append((fid, base_path, props))
         if limiter is not None and end < rows_out:
             # pace between files; no debt-sleep after the last one (it
@@ -390,7 +395,7 @@ class _StreamingNativeWriter:
 
     def __init__(self, job, out_dir: str, new_file_id, fr,
                  block_entries: Optional[int], has_deep: bool = False,
-                 cancel=None, on_span=None):
+                 cancel=None, on_span=None, lindex_for_span=None):
         self._job = job
         self._out_dir = out_dir
         self._new_file_id = new_file_id
@@ -402,6 +407,12 @@ class _StreamingNativeWriter:
         # so cache entries land under the output ids AS the spans
         # complete, not after the whole job
         self._on_span = on_span
+        # optional (start, end) -> Optional[lindex dict] hook, called
+        # BEFORE the span's base file is assembled: the device-native
+        # path fits the learned per-SST index over the survivor span's
+        # staged columns while they are still in HBM (for free — the
+        # sorted keys are already there; storage/learned_index.py)
+        self._lindex_for_span = lindex_for_span
         self._block_entries = (block_entries if block_entries is not None
                                else flags.get_flag("sst_block_entries"))
         self._max_rows = flags.get_flag(
@@ -427,9 +438,11 @@ class _StreamingNativeWriter:
             start, end, data_file_name(base_path), self._block_entries,
             compress=sst_compression_enabled(),
             tombstone_value=self._tombstone_value)
+        lindex = (self._lindex_for_span(start, end)
+                  if self._lindex_for_span is not None else None)
         props = write_base_file(base_path, index, end - start, hashes,
                                 fk, lk, self._fr, size,
-                                has_deep=self._has_deep)
+                                has_deep=self._has_deep, lindex=lindex)
         self.outputs.append((fid, base_path, props))
         self.ranges.append((start, end))
         record_pipeline_stage("write", (_time.monotonic() - t0) * 1e3)
@@ -689,32 +702,63 @@ class _ResidentSpanInstaller:
         self.installed: List[int] = []
         self._pending: List[Tuple[int, str, int, int]] = []
         self._pos_all = None
+        self._span_cache: dict = {}   # (start, end) -> StagedCols
+
+    def _ready(self) -> bool:
+        """True once the handle exposes parent-domain device arrays
+        (rebuilding them from a fully-drained chunked stream if needed)."""
+        h = self.handle
+        if h is None:
+            return False
+        if getattr(h, "_perm_dev", None) is not None:
+            return True
+        if hasattr(h, "to_parent_products") \
+                and getattr(h, "_result", None) is not None:
+            h.to_parent_products()  # chunked stream fully drained
+            return getattr(h, "_perm_dev", None) is not None
+        return False
+
+    def _gather_span(self, start: int, end: int):
+        from yugabyte_tpu.ops import run_merge
+        st = self._span_cache.pop((start, end), None)
+        if st is not None:
+            return st
+        if self._pos_all is None:
+            # one survivor-position scan per job; consumes (donates) the
+            # keep mask on backends that honor donation
+            self._pos_all = run_merge.survivor_positions(self.handle)
+        return run_merge.gather_staged_output_span(
+            self.handle, self._pos_all, start, end)
+
+    def lindex_for_span(self, start: int, end: int):
+        """Learned-index fit over the survivor span's staged columns —
+        run while the sorted keys are still in HBM (the 'for free' half
+        of the pragmatic-learned-index recipe); the gathered span is
+        cached so the install that follows never re-gathers. None when
+        the handle is mid-stream (chunked spans write before their
+        decisions finish riding the link) — those files simply carry no
+        model (it is advisory)."""
+        from yugabyte_tpu.ops import point_read
+        from yugabyte_tpu.utils import flags as _flags
+        if not _flags.get_flag("sst_learned_index") or not self._ready():
+            return None
+        st = self._gather_span(start, end)
+        self._span_cache[(start, end)] = st
+        return point_read.fit_learned_index_device(st)
 
     def on_span(self, fid: int, base_path: str, start: int, end: int
                 ) -> None:
-        h = self.handle
-        if h is None:
+        if self.handle is None:
             return
-        if getattr(h, "_perm_dev", None) is None:
-            if hasattr(h, "to_parent_products") \
-                    and getattr(h, "_result", None) is not None:
-                h.to_parent_products()  # chunked stream fully drained
-            else:
-                self._pending.append((fid, base_path, start, end))
-                return
+        if not self._ready():
+            self._pending.append((fid, base_path, start, end))
+            return
         self._install(fid, base_path, start, end)
 
     def _install(self, fid: int, base_path: str, start: int, end: int
                  ) -> None:
-        from yugabyte_tpu.ops import run_merge
         from yugabyte_tpu.storage import integrity
-        h = self.handle
-        if self._pos_all is None:
-            # one survivor-position scan per job; consumes (donates) the
-            # keep mask on backends that honor donation
-            self._pos_all = run_merge.survivor_positions(h)
-        st = run_merge.gather_staged_output_span(h, self._pos_all,
-                                                 start, end)
+        st = self._gather_span(start, end)
         if not integrity.maybe_verify_resident_entry(st, base_path):
             return  # digest mismatch: the next reader re-stages from bytes
         self.device_cache.put(fid, st, level=self.level)
@@ -972,7 +1016,9 @@ def _device_native_body(
         writer = _StreamingNativeWriter(
             job, out_dir, new_file_id, fr, block_entries,
             has_deep=has_deep, cancel=cancel,
-            on_span=installer.on_span if installer is not None else None)
+            on_span=installer.on_span if installer is not None else None,
+            lindex_for_span=(installer.lindex_for_span
+                             if installer is not None else None))
         state["writer"] = writer   # the attempt's unwind sweeps .outputs
         if pipeline:
             for perm_c, keep_c, mk_c in handle.result_iter():

@@ -42,6 +42,15 @@ flags.define_flag("read_native", True,
                   "engine (native/read_engine.cc) when it builds; the "
                   "Python merge path remains the fallback (ref: "
                   "block_based_table_reader.cc:1144-1286)")
+flags.define_flag("point_read_batched", True,
+                  "resolve DB.multi_get through the batched device "
+                  "kernels (ops/point_read.py) when a device + slab "
+                  "cache are configured; the native per-key path is the "
+                  "byte-identical fallback")
+flags.define_flag("point_read_learned_index", True,
+                  "seed the batched locate kernel with persisted "
+                  "learned per-SST indexes (advisory; mispredictions "
+                  "fall back to the exact seek)")
 
 
 def _storage_metrics():
@@ -52,7 +61,9 @@ def _storage_metrics():
     return (e.histogram("db_get_duration_ms",
                         "point-read latency through DB.get"),
             e.histogram("db_scan_duration_ms",
-                        "full device-scan latency through DB.scan_visible"))
+                        "full device-scan latency through DB.scan_visible"),
+            e.histogram("db_multi_get_duration_ms",
+                        "batched point-read latency through DB.multi_get"))
 
 
 class CompactionStats:
@@ -243,6 +254,30 @@ class DB:
             if self.mem.approximate_bytes or self._imm is not None:
                 return 1
             return len(self._readers)
+
+    def has_deep_files(self) -> bool:
+        """Any live SST holding documents deeper than row+column — the
+        tablet's gate for the flat batched row-read fast path (deep rows
+        cannot be reconstructed from enumerated column probes)."""
+        with self._lock:
+            return any(r.props.has_deep for r in self._readers.values())
+
+    def mem_entries_range(self, lower: bytes, upper: bytes
+                          ) -> List[Tuple[bytes, bytes]]:
+        """Memtable(+imm) entries with lower <= internal_key < upper —
+        the host-side row probe of the tablet's batched read (catches
+        recent deep/unknown-subkey writes that exact-key probes of the
+        enumerated schema columns would miss)."""
+        with self._lock:
+            mems = [self.mem] + ([self._imm] if self._imm is not None
+                                 else [])
+        out: List[Tuple[bytes, bytes]] = []
+        for m in mems:
+            for ikey, v in m.iter_from(lower):
+                if ikey >= upper:
+                    break
+                out.append((ikey, v))
+        return out
 
     # ------------------------------------------------------- background error
     @property
@@ -624,6 +659,279 @@ class DB:
             return None
         return None
 
+    # ------------------------------------------------------- batched read
+    def multi_get(self, keys: List[bytes],
+                  read_ht: Optional[HybridTime] = None,
+                  doc_key_lens: Optional[List[int]] = None
+                  ) -> List[Optional[Tuple[DocHybridTime, bytes]]]:
+        """Batched point reads: BYTE-IDENTICAL to
+        ``[self.get(k, read_ht) for k in keys]`` (per-key MVCC at the
+        shared read_ht), but the SST layer resolves the whole batch in
+        vectorized device kernels over the HBM-resident slab matrices
+        (ops/point_read.py: bloom probe -> block locate -> survivor
+        gather) while the memtable probes stay host-side. Falls back —
+        byte-identically — to the native per-key path when no device is
+        configured, the batch's shape bucket is quarantined after a
+        device fault, or a kernel dispatch faults mid-batch.
+
+        doc_key_lens: optional per-key DocKey prefix lengths (the bloom
+        probe's filter keys); callers that built the keys (tablet
+        multi_read) pass them to skip per-key host parsing."""
+        import time as _time
+        t0 = _time.monotonic()
+        try:
+            return self._multi_get_inner(list(keys), read_ht,
+                                         doc_key_lens)
+        except StatusError as e:
+            self._route_read_corruption(e)
+            raise
+        finally:
+            _storage_metrics()[2].increment(
+                (_time.monotonic() - t0) * 1e3)
+
+    def _multi_get_inner(self, keys, read_ht, doc_key_lens=None):
+        read_ht = read_ht or HybridTime.kMax
+        if not keys:
+            return []
+        if flags.get_flag("point_read_batched") \
+                and self._device_cache is not None \
+                and self.opts.device not in (None, "native"):
+            res = self._multi_get_device(keys, read_ht, doc_key_lens)
+            if res is not None:
+                return res
+        return self._multi_get_native(keys, read_ht)
+
+    def _multi_get_native(self, keys, read_ht):
+        """The CPU fallback: one native multi_get per key over a single
+        reader-set snapshot (storage/native_read.py), memtable probes in
+        Python — the loop body of _get_inner without the per-call
+        snapshot/metric overhead. Byte-identical to sequential gets."""
+        # memtable snapshot BEFORE the reader set (see get())
+        with self._lock:
+            mems = [self.mem] + ([self._imm] if self._imm is not None
+                                 else [])
+        rset = self._native_rset()
+        if rset is None:
+            return [self._get_inner(k, read_ht) for k in keys]
+        mems = [m for m in mems if not m.empty]
+        sst_hits = (rset.multi_get_many(keys, read_ht.value)
+                    if rset.n else [None] * len(keys))
+        out = []
+        for k, sh in zip(keys, sst_hits):
+            best = None  # (ht_value, wid, value)
+            if mems:
+                seek = make_internal_key(
+                    k, DocHybridTime(read_ht, 0xFFFFFFFF))
+                boundary = k + bytes([ValueType.kHybridTime])
+                for mem in mems:
+                    hit = mem.point_get(seek, boundary)
+                    if hit is not None:
+                        _, dht = split_key_and_ht(hit[0])
+                        cand = (dht.ht.value, dht.write_id, hit[1])
+                        if best is None or cand[:2] > best[:2]:
+                            best = cand
+            if sh is not None:
+                ht_v, wid, _fl, val = sh
+                if best is None or (ht_v, wid) > best[:2]:
+                    best = (ht_v, wid, val)
+            out.append(None if best is None else
+                       (DocHybridTime(HybridTime(best[0]), best[1]),
+                        best[2]))
+        return out
+
+    def _multi_get_device(self, keys, read_ht, doc_key_lens=None):
+        """The batched device path, or None when this batch must take
+        the native fallback (unstageable residency, quarantined shape
+        bucket, or a mid-batch device fault — all byte-identical)."""
+        from yugabyte_tpu.ops import device_faults, point_read
+        from yugabyte_tpu.storage import offload_policy
+        # memtable snapshot BEFORE the reader set (see get())
+        with self._lock:
+            mems = [self.mem] + ([self._imm] if self._imm is not None
+                                 else [])
+            readers = list(self._readers.items())
+            for fid, _ in readers:
+                self._pins[fid] = self._pins.get(fid, 0) + 1
+        try:
+            staged_by = []
+            for fid, r in readers:
+                if r.props.n_entries == 0:
+                    continue
+                st = self._device_cache.get(fid)
+                if st is None:
+                    # write-through on miss, like scan_visible: the next
+                    # batch over this file finds it resident
+                    try:
+                        st = self._device_cache.stage(fid, r.read_all(),
+                                                      for_read=True)
+                    except StatusError:
+                        raise  # corrupt block: multi_get routes + re-raises
+                if st.n != r.props.n_entries:
+                    return None  # stale residency: let native serve
+                staged_by.append((fid, r, st))
+            q = offload_policy.bucket_quarantine()
+            if any(q.is_quarantined(
+                    offload_policy.point_read_bucket_key(st.n_pad))
+                   for _fid, _r, st in staged_by):
+                return None
+            results: List = [None] * len(keys)
+            cur = {"n_pad": staged_by[0][2].n_pad if staged_by else 0}
+            try:
+                self._multi_get_device_batches(
+                    keys, read_ht, mems, staged_by, results,
+                    doc_key_lens, cur)
+            except Exception as e:  # noqa: BLE001 — device-fault containment
+                if not device_faults.is_device_fault(e):
+                    raise
+                # fault containment: park the shape bucket and serve this
+                # batch (and the quarantine window) via the native path,
+                # byte-identically — mirrors the compaction fallback
+                q.quarantine(
+                    offload_policy.point_read_bucket_key(cur["n_pad"]),
+                    reason=f"point-read {type(e).__name__}: {e}")
+                point_read.point_read_metrics()[
+                    "device_fallbacks"].increment()
+                TRACE("multi_get: device fault mid-batch (%r) — shape "
+                      "bucket (1, %d) quarantined; serving natively",
+                      e, cur["n_pad"])
+                return None
+            return results
+        finally:
+            with self._lock:
+                for fid, _ in readers:
+                    self._pins[fid] -= 1
+                    if not self._pins[fid]:
+                        del self._pins[fid]
+                self._purge_obsolete_unlocked()
+
+    def _multi_get_device_batches(self, keys, read_ht, mems, staged_by,
+                                  results, doc_key_lens, cur):
+        import numpy as np
+        from yugabyte_tpu.ops import point_read
+        from yugabyte_tpu.ops.slabs import _doc_key_len
+        from yugabyte_tpu.storage import learned_index
+        metrics = point_read.point_read_metrics()
+        mems = [m for m in mems if not m.empty]
+        use_model = flags.get_flag("point_read_learned_index")
+        for start in range(0, len(keys), 1024):
+            chunk = keys[start: start + 1024]
+            b = len(chunk)
+            b_pad = point_read.batch_bucket(b)
+            metrics["batches"].increment()
+            metrics["keys"].increment(b)
+            metrics["batch_rows"].increment(b)
+            # bloom hashes over the DocKey prefixes — one device FNV
+            # dispatch per chunk (storage/bloom.py is the CPU twin)
+            if doc_key_lens is not None:
+                dkls = doc_key_lens[start: start + 1024]
+            else:
+                dkls = [_doc_key_len(k) for k in chunk]
+            max_dkl = max(dkls) if dkls else 1
+            from yugabyte_tpu.ops.run_merge import quantize_width
+            w_hash = quantize_width(max(1, -(-max_dkl // 4)))
+            hw, _hl = point_read.pack_query_batch(chunk, w_hash)
+            dk_pad = np.zeros(b_pad, dtype=np.int32)
+            dk_pad[:b] = dkls
+            h1, h2 = point_read.hash_batch(hw, dk_pad)
+            packs = {}
+            exact_fallback = set()
+            best = None  # (ht u64, wid, row, file-index, valid) arrays
+            for fi, (fid, r, st) in enumerate(staged_by):
+                cur["n_pad"] = st.n_pad
+                maybe = point_read.probe_bloom(
+                    r, h1, h2, device=self._device_cache.device)
+                if maybe is not None and not maybe[:b].any():
+                    metrics["bloom_skips"].increment()
+                    continue
+                if st.w not in packs:
+                    packs[st.w] = point_read.pack_query_batch(chunk,
+                                                              st.w)
+                qw, ql = packs[st.w]
+                model = (learned_index.model_operands(r.props.lindex,
+                                                      st.n)
+                         if use_model else None)
+                _idx, hit, hhi, hlo, wid, miss = point_read.locate_batch(
+                    st, qw, ql, read_ht.value, model)
+                if model is not None:
+                    metrics["learned_hits"].increment()
+                    n_miss = int(miss[:b].sum())
+                    if n_miss:
+                        metrics["learned_fallbacks"].increment(n_miss)
+                        for i in np.nonzero(miss[:b])[0]:
+                            exact_fallback.add(int(i))
+                ht = (hhi.astype(np.uint64) << np.uint64(32)) \
+                    | hlo.astype(np.uint64)
+                if best is None:
+                    best = [np.zeros(b_pad, np.uint64),
+                            np.zeros(b_pad, np.uint32),
+                            np.zeros(b_pad, np.int64),
+                            np.zeros(b_pad, np.int64),
+                            np.zeros(b_pad, bool)]
+                upd = hit & (~best[4] | (ht > best[0])
+                             | ((ht == best[0]) & (wid > best[1])))
+                best[0] = np.where(upd, ht, best[0])
+                best[1] = np.where(upd, wid, best[1])
+                best[2] = np.where(upd, _idx.astype(np.int64), best[2])
+                best[3] = np.where(upd, fi, best[3])
+                best[4] = best[4] | hit
+            self._combine_device_chunk(chunk, start, read_ht, mems,
+                                       staged_by, best, exact_fallback,
+                                       results)
+
+    def _combine_device_chunk(self, chunk, start, read_ht, mems,
+                              staged_by, best, exact_fallback, results):
+        """Merge device SST winners with host memtable probes per key —
+        newest (ht, wid) wins, exactly get()'s compare."""
+        for i, k in enumerate(chunk):
+            if i in exact_fallback:
+                # learned-index misprediction beyond its bound: the
+                # binary-search invariant caught it — resolve this key
+                # exactly (correctness never rides the model)
+                results[start + i] = self._get_inner(k, read_ht)
+                continue
+            mem_best = None
+            if mems:
+                seek = make_internal_key(
+                    k, DocHybridTime(read_ht, 0xFFFFFFFF))
+                boundary = k + bytes([ValueType.kHybridTime])
+                for mem in mems:
+                    hit = mem.point_get(seek, boundary)
+                    if hit is not None:
+                        _, dht = split_key_and_ht(hit[0])
+                        cand = (dht.ht.value, dht.write_id, hit[1])
+                        if mem_best is None or cand[:2] > mem_best[:2]:
+                            mem_best = cand
+            if best is not None and best[4][i]:
+                ht_v = int(best[0][i])
+                wid_v = int(best[1][i])
+                if mem_best is None or (ht_v, wid_v) > mem_best[:2]:
+                    value = self._fetch_staged_value(
+                        staged_by[int(best[3][i])], int(best[2][i]))
+                    results[start + i] = (
+                        DocHybridTime(HybridTime(ht_v), wid_v), value)
+                    continue
+            results[start + i] = (
+                None if mem_best is None else
+                (DocHybridTime(HybridTime(mem_best[0]), mem_best[1]),
+                 mem_best[2]))
+
+    @staticmethod
+    def _fetch_staged_value(entry, row: int) -> bytes:
+        """Value bytes of staged entry `row` (sorted order): decode only
+        the winner's block — the survivor-gather half of the batched
+        read (values never live in HBM; ops/slabs.py)."""
+        import numpy as np
+        _fid, r, _st = entry
+        offs = getattr(r, "_row_offs_pr", None)
+        if offs is None:
+            offs = np.concatenate(
+                ([0], np.cumsum([h[2] for h in r.block_handles])))
+            r._row_offs_pr = offs
+        blk = int(np.searchsorted(offs, row, side="right") - 1)
+        slab = r.read_block(blk)
+        j = row - int(offs[blk])
+        return slab.values[int(slab.value_idx[j])]
+
     def iter_from(self, seek_internal_key: bytes = b"",
                   check_bloom_doc: Optional[bytes] = None
                   ) -> Iterator[Tuple[bytes, bytes]]:
@@ -725,7 +1033,7 @@ class DB:
                     self._route_read_corruption(e)
                     raise
                 if self._device_cache is not None and not r.props.has_deep:
-                    st = self._device_cache.stage(fid, sl)  # write-through
+                    st = self._device_cache.stage(fid, sl, for_read=True)
                     sources.append(SlabSource(sl, st))
                 else:
                     sources.append(SlabSource(sl))

@@ -80,6 +80,11 @@ class DeviceSlabCache:
                                    "HBM slab cache misses")
         self._c_evict = e.counter("device_cache_evictions_total",
                                   "entries evicted under HBM pressure")
+        self._c_read_stage = e.counter(
+            "device_cache_read_stage_total",
+            "entries staged by the SERVE path (batched point reads / "
+            "scans) on a residency miss — write-through from flush and "
+            "compaction should keep this near zero in steady state")
         self._g_used = e.gauge("device_cache_used_bytes",
                                "HBM bytes resident in the slab cache")
         self._g_pinned = e.gauge("device_cache_pinned_count",
@@ -193,9 +198,14 @@ class DeviceSlabCache:
                 self._g_pinned.set(self._pinned_unlocked())
 
     def stage(self, key: CacheKey, slab: KVSlab,
-              level: int = 0) -> StagedCols:
+              level: int = 0, for_read: bool = False) -> StagedCols:
         staged = stage_slab(slab, self.device)
         self.put(key, staged, level=level)
+        if for_read:
+            # a read had to decode+upload what write-through was
+            # supposed to have left resident — the residency-health
+            # signal for the batched point-read path
+            self._c_read_stage.increment()
         return staged
 
     def snapshot(self) -> dict:
@@ -270,9 +280,9 @@ class NamespacedSlabCache:
         self._shared.drop_namespace(self.namespace)
 
     def stage(self, file_id: int, slab: KVSlab,
-              level: int = 0) -> StagedCols:
+              level: int = 0, for_read: bool = False) -> StagedCols:
         return self._shared.stage((self.namespace, file_id), slab,
-                                  level=level)
+                                  level=level, for_read=for_read)
 
 
 class HostStagingPool:

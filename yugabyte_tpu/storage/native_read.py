@@ -224,6 +224,32 @@ class ReaderSet:
         return b.ht.value, b.wid.value, b.fl.value, \
             ctypes.string_at(b.val, n)
 
+    def multi_get_many(self, keys: Sequence[bytes], read_ht: int
+                       ) -> List[Optional[Tuple[int, int, int, bytes]]]:
+        """The batched CPU fallback of DB.multi_get: one native lookup
+        per key over this frozen snapshot, amortizing the per-call
+        Python (buffer setup, attribute walks) across the batch. Each
+        element mirrors multi_get()'s (ht, wid, flags, value) or None —
+        byte-identical to per-key calls by construction."""
+        mg = self._mg
+        arr, n_readers = self._arr, self.n
+        b = _get_bufs
+        out: List[Optional[Tuple[int, int, int, bytes]]] = []
+        for key in keys:
+            n = mg(arr, n_readers, key, len(key), -1, read_ht,
+                   b.vptr, b.cap, b.ht_ref, b.wid_ref, b.fl_ref)
+            if n > b.cap or n == -2:
+                # oversized value / corruption: the slow path has the
+                # grow-retry + error plumbing — stay byte-identical
+                out.append(self.multi_get(key, -1, read_ht))
+                continue
+            if n < 0:
+                out.append(None)
+                continue
+            out.append((b.ht.value, b.wid.value, b.fl.value,
+                        ctypes.string_at(b.val, n)))
+        return out
+
     def errors(self) -> List[str]:
         out = []
         for r in self.readers:

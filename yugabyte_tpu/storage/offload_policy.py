@@ -347,6 +347,14 @@ def bucket_key(run_ns) -> Tuple[int, int]:
     return (k_pad, m)
 
 
+def point_read_bucket_key(n_pad: int) -> Tuple[int, int]:
+    """Quarantine key for the batched point-read kernels over a staged
+    matrix padded to n_pad: the single-run layout (k_pad=1, m=n_pad) —
+    the same vocabulary scan_fused declares, so a locate-kernel fault
+    parks exactly the declared bucket (ops/point_read.py)."""
+    return (1, n_pad)
+
+
 _quarantine: Optional[BucketQuarantine] = None  # guarded-by: _quarantine_lock
 _quarantine_lock = threading.Lock()
 

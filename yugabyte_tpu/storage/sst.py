@@ -47,6 +47,11 @@ _sst_flags.define_flag("sst_compression", "none",
 _sst_flags.define_flag("sst_bloom_bits_per_key", 10,
                        "doc-key bloom filter density (ref "
                        "BlockBasedTableOptions::filter_policy)")
+_sst_flags.define_flag("sst_learned_index", True,
+                       "fit a learned per-SST index at write time "
+                       "(storage/learned_index.py) and persist it in the "
+                       "properties block; ADVISORY ONLY — readers verify "
+                       "predictions and fall back to the exact seek")
 
 
 def sst_compression_enabled() -> bool:
@@ -111,13 +116,20 @@ class SSTProps:
     # lets the compaction dispatcher decide device routing WITHOUT
     # decoding the file (the fused kernel handles depth-2 only)
     has_deep: bool = False
+    # learned per-SST index (storage/learned_index.py) — OPTIONAL and
+    # advisory: absent in pre-model files (reads fall back to the exact
+    # binary seek), ignored as an unknown JSON key by pre-model readers
+    lindex: Optional[dict] = None
 
     def to_json(self) -> dict:
-        return {"n_entries": self.n_entries, "first_key": self.first_key.hex(),
-                "last_key": self.last_key.hex(), "frontier": self.frontier.to_json(),
-                "data_size": self.data_size, "base_size": self.base_size,
-                "max_expire_us": self.max_expire_us,
-                "has_deep": self.has_deep}
+        d = {"n_entries": self.n_entries, "first_key": self.first_key.hex(),
+             "last_key": self.last_key.hex(), "frontier": self.frontier.to_json(),
+             "data_size": self.data_size, "base_size": self.base_size,
+             "max_expire_us": self.max_expire_us,
+             "has_deep": self.has_deep}
+        if self.lindex is not None:
+            d["lindex"] = self.lindex
+        return d
 
     @staticmethod
     def from_json(d: dict) -> "SSTProps":
@@ -127,7 +139,8 @@ class SSTProps:
                         d.get("max_expire_us", 0),
                         # files from before this field conservatively count
                         # as deep (native routing is always correct)
-                        bool(d.get("has_deep", True)))
+                        bool(d.get("has_deep", True)),
+                        d.get("lindex"))
 
 
 class SSTWriter:
@@ -140,7 +153,8 @@ class SSTWriter:
 
     def __init__(self, base_path: str, block_entries: Optional[int] = None,
                  compress: Optional[bool] = None,
-                 bits_per_key: Optional[int] = None):
+                 bits_per_key: Optional[int] = None,
+                 fit_lindex: bool = True):
         self.base_path = base_path
         # None = take the server-wide tuning flags (the reference's LSM
         # option surface, docdb_rocksdb_util.cc:62-140)
@@ -151,6 +165,10 @@ class SSTWriter:
         self.bits_per_key = (bits_per_key if bits_per_key is not None
                              else _sst_flags.get_flag(
                                  "sst_bloom_bits_per_key"))
+        # compaction output writers pass False: models on compaction
+        # outputs come only from the device-native fit hook, so the
+        # python/native/device output paths stay byte-identical
+        self.fit_lindex = fit_lindex
 
     def write(self, slab: KVSlab, frontier: Optional[Frontier] = None) -> SSTProps:
         n = slab.n
@@ -192,12 +210,17 @@ class SSTWriter:
             max_expire_us = int(
                 (ht_phys + slab.ttl_ms.astype(np.uint64) * 1000).max())
         from yugabyte_tpu.ops.slabs import FLAG_DEEP
+        lindex = None
+        if self.fit_lindex and _sst_flags.get_flag("sst_learned_index"):
+            from yugabyte_tpu.storage import learned_index
+            lindex = learned_index.fit_from_slab(slab)
         return write_base_file(
             self.base_path, index_items, n, hashes,
             key_at(0) if n else b"", key_at(n - 1) if n else b"",
             frontier, data_off, self.bits_per_key,
             max_expire_us=max_expire_us,
-            has_deep=bool(n) and bool(((slab.flags & FLAG_DEEP) != 0).any()))
+            has_deep=bool(n) and bool(((slab.flags & FLAG_DEEP) != 0).any()),
+            lindex=lindex)
 
 
 def write_sst_from_packed(base_path: str, keys_blob: bytes, key_offs,
@@ -241,9 +264,16 @@ def write_sst_from_packed(base_path: str, keys_blob: bytes, key_offs,
     if n and fr.ht_min == 0 and fr.ht_max == 0:
         fr.ht_min = int(ht_arr.min())
         fr.ht_max = int(ht_arr.max())
+    lindex = None
+    if _sst_flags.get_flag("sst_learned_index"):
+        # the packed run may arrive unsorted (bulk ingest) — the fit's
+        # key coordinate is a monotone transform of memcmp order, so
+        # sorting the coordinates reproduces the written-order sequence
+        from yugabyte_tpu.storage import learned_index
+        lindex = learned_index.fit_from_packed_keys(keys_blob, key_offs)
     return write_base_file(base_path, index, n, hashes, first_key, last_key,
                            fr, size, max_expire_us=max_expire_us,
-                           has_deep=has_deep)
+                           has_deep=has_deep, lindex=lindex)
 
 
 def write_base_file(base_path: str,
@@ -253,7 +283,8 @@ def write_base_file(base_path: str,
                     frontier: Optional[Frontier], data_size: int,
                     bits_per_key: Optional[int] = None,
                     max_expire_us: int = 0,
-                    has_deep: bool = False) -> SSTProps:
+                    has_deep: bool = False,
+                    lindex: Optional[dict] = None) -> SSTProps:
     """Assemble the base (metadata) file from precomputed parts.
 
     index_items: (last_key, data_offset, block_size, n_entries) per data
@@ -277,6 +308,7 @@ def write_base_file(base_path: str,
         data_size=data_size,
         max_expire_us=max_expire_us,
         has_deep=has_deep,
+        lindex=lindex,
     )
     props_bytes = json.dumps(props.to_json()).encode()
     from yugabyte_tpu.utils.env import get_env

@@ -531,6 +531,106 @@ class Tablet:
         return read_row(self.regular_db, self.schema, doc_key, ht,
                         projection=projection, entry_stream=stream)
 
+    def multi_read(self, doc_keys, read_ht: Optional[HybridTime] = None,
+                   projection=None, txn_id: Optional[bytes] = None):
+        """Batched point-row reads: one result per doc key, aligned with
+        the input (None = row absent). Semantically N read_row calls at
+        one shared read point, but the SST probes of every FLAT row go
+        through ONE DB.multi_get batch (the device point-read kernels,
+        ops/point_read.py) instead of a per-row iterator walk.
+
+        Fast-path preconditions — any row outside them resolves through
+        the exact read_row path: no transaction context, an empty intent
+        overlay, no live SST holding deep documents (regular_db
+        has_deep_files), and no memtable entry of the row off the
+        enumerated (liveness + schema value columns) key set. Rows whose
+        only surviving data lives at non-schema column ids inside SSTs
+        (dropped columns) are the one documented divergence — they need
+        the full iterator to prove existence."""
+        ht = self.read_time(read_ht)
+        if txn_id is not None \
+                or self.intents_db.approx_entry_count() != 0 \
+                or self.regular_db.has_deep_files():
+            return [self.read_row(dk, ht, projection, txn_id=txn_id)
+                    for dk in doc_keys]
+        from yugabyte_tpu.docdb.doc_key import SubDocKey
+        from yugabyte_tpu.docdb.doc_operations import kLivenessColumnId
+        schema = self.schema
+        cids = [kLivenessColumnId] + [schema.column_id(c.name)
+                                      for c in schema.value_columns]
+        keys: list = []
+        dkls: list = []
+        spans = []          # per doc key: (start, count) into keys
+        row_keys_by = []
+        fallback = set()    # row indexes that need the exact path
+        encs = []
+        for ri, dk in enumerate(doc_keys):
+            self.metric_reads.increment()
+            enc = dk.encode()
+            encs.append(enc)
+            upper = enc + bytes([ValueType.kMaxByte])
+            enumerated = sorted(
+                [enc] + [SubDocKey(dk, (("col", cid),)).encode(
+                    include_ht=False) for cid in cids])
+            enum_set = set(enumerated)
+            # memtable probe: recent writes at non-enumerated subkeys
+            # (deep documents, unknown cids) make this row non-flat
+            from yugabyte_tpu.docdb.doc_key import split_key_and_ht
+            for ikey, _v in self.regular_db.mem_entries_range(enc, upper):
+                prefix, dht = split_key_and_ht(ikey)
+                if dht is None or prefix not in enum_set:
+                    fallback.add(ri)
+                    break
+            row_keys_by.append(enumerated)
+            spans.append((len(keys), len(enumerated)))
+            keys.extend(enumerated)
+            dkls.extend([len(enc)] * len(enumerated))
+        results = self.regular_db.multi_get(keys, ht, doc_key_lens=dkls)
+        rows = []
+        for ri, dk in enumerate(doc_keys):
+            if ri in fallback:
+                rows.append(self.read_row(dk, ht, projection))
+                continue
+            start, count = spans[ri]
+            rows.append(self._assemble_flat_row(
+                dk, encs[ri], row_keys_by[ri],
+                results[start: start + count], ht, projection))
+        return rows
+
+    def _assemble_flat_row(self, doc_key, enc: bytes, row_keys,
+                           row_results, ht: HybridTime, projection):
+        """RESOLVE + ASSEMBLE one flat row from exact-key probe results,
+        mirroring DocRowwiseIterator._resolve_visible for depth <= 1:
+        the newest visible version per path is already in hand (multi_get
+        semantics); drop tombstones/expired values, apply the bare-DocKey
+        overwrite point, and feed the survivors to the shared
+        VisibleEntryRowAssembler."""
+        from yugabyte_tpu.docdb.doc_rowwise_iterator import (
+            VisibleEntryRowAssembler, _is_expired)
+        from yugabyte_tpu.docdb.value import Value as DocValue
+        bare_dht = None
+        for k, res in zip(row_keys, row_results):
+            if res is not None and k == enc:
+                bare_dht = res[0]
+        survivors = []
+        for k, res in zip(row_keys, row_results):
+            if res is None:
+                continue
+            dht, raw = res
+            value = DocValue.decode(raw)
+            dead = (value.is_tombstone or _is_expired(value, dht, ht)
+                    or (k != enc and bare_dht is not None
+                        and dht < bare_dht))
+            if not dead:
+                survivors.append((k, raw, dht.ht.value))
+        if not survivors:
+            return None
+        asm = VisibleEntryRowAssembler(iter(survivors), self.schema,
+                                       projection=projection)
+        for row in asm.rows():
+            return row
+        return None
+
     def _entry_stream(self, ht: HybridTime, lower: bytes,
                       upper: Optional[bytes], txn_id: Optional[bytes]):
         """Intent-aware merged stream, or None for the plain fast path when

@@ -476,6 +476,27 @@ class TabletPeer:
         return read_row(self.tablet.regular_db, self.tablet.schema, doc_key,
                         ht, projection=projection)
 
+    def multi_read(self, doc_keys, read_ht: Optional[HybridTime] = None,
+                   projection=None, allow_follower: bool = False,
+                   txn_id: Optional[bytes] = None):
+        """Batched point-row reads: read_row's lease/follower rules paid
+        ONCE for the whole batch, rows resolved through the tablet's
+        batched path (Tablet.multi_read -> DB.multi_get)."""
+        if self.raft.is_leader():
+            self.check_leader_lease()
+            return self.tablet.multi_read(doc_keys, read_ht, projection,
+                                          txn_id=txn_id)
+        if not allow_follower:
+            raise NotLeader(self.raft.leader_hint())
+        if read_ht is not None:
+            # same repeatable-read guarantee as the follower read_row:
+            # wait until the propagated safe time covers the read point
+            self.tablet.mvcc.safe_time(min_allowed=read_ht)
+            ht = read_ht
+        else:
+            ht = self.tablet.mvcc.safe_time_for_follower()
+        return self.tablet.multi_read(doc_keys, ht, projection)
+
     def write(self, ops, timeout_s: float = 30.0,
               request=None) -> HybridTime:
         self._check_not_failed()

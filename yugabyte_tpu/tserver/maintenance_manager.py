@@ -168,10 +168,14 @@ class PrewarmKernelsOp(MaintenanceOp):
         stats.perf_improvement = self.PREWARM_SCORE
 
     def perform(self) -> None:
-        from yugabyte_tpu.ops import run_merge
+        from yugabyte_tpu.ops import point_read, run_merge
         from yugabyte_tpu.storage import offload_policy
         from yugabyte_tpu.utils.metrics import publish_compile_surface
         n = run_merge.prewarm_buckets(self._shapes)
+        # the batched point-read families (serve-path kernels) warm in
+        # the same pass — their first real multi_get batch must load a
+        # cached executable, not stall a read on an XLA compile
+        n += point_read.prewarm_point_read()
         # expose the declared compile surface (committed kernel
         # manifest) next to the bucket hit/miss counters: the warm cache
         # must cover exactly this many executables

@@ -202,8 +202,12 @@ class TabletServer:
                 "kernel_chunk_retry_total",
                 "per-chunk kernel retries after a device fault").value(),
         }
+        # batched point reads: batch/bloom-skip/learned-index/fallback
+        # counters for the device serve path (ops/point_read.py)
+        from yugabyte_tpu.ops.point_read import point_read_snapshot
         out = {"server_id": self.server_id, "totals": totals,
                "pipeline": pipeline, "device_faults": device_faults,
+               "point_reads": point_read_snapshot(),
                "tablets": tablets}
         # HBM residency: the multi-level resident set behind the chained
         # L0->L1->L2 compaction path — per-level entries/bytes, pins and

@@ -160,6 +160,28 @@ class TabletServiceImpl:
             raise NotLeaderError(_leader_server_hint(e)) from e
         return None if row is None else row_to_wire(row)
 
+    def multi_read(self, tablet_id: str, doc_keys: List[dict],
+                   read_ht: Optional[int] = None,
+                   projection: Optional[List[str]] = None,
+                   allow_follower: bool = False,
+                   schema_version: Optional[int] = None) -> dict:
+        """Multi-key point-row read: one RPC, one lease check and one
+        read-point resolution for the whole batch; the SST layer resolves
+        the flat rows through the batched device kernels (DB.multi_get).
+        Response rows align with the request keys (None = absent)."""
+        self._check_schema_version(tablet_id, schema_version)
+        peer = self._tablets.get_tablet(tablet_id)
+        try:
+            rows = peer.multi_read(
+                [doc_key_from_wire(d) for d in doc_keys],
+                HybridTime(read_ht) if read_ht else None,
+                projection=tuple(projection) if projection else None,
+                allow_follower=allow_follower)
+        except NotLeader as e:
+            raise NotLeaderError(_leader_server_hint(e)) from e
+        return {"rows": [None if r is None else row_to_wire(r)
+                         for r in rows]}
+
     def scan(self, tablet_id: str, lower_doc_key: bytes = b"",
              upper_doc_key: Optional[bytes] = None,
              read_ht: Optional[int] = None,
