@@ -1064,6 +1064,8 @@ def _cluster_soak_stage() -> dict:
         client.create_namespace("soak")
         table = client.create_table("soak", "ycsb", YCSB_SCHEMA,
                                     num_tablets=4)
+        # workload must not race the fresh tablets' first elections
+        c.wait_table_leaders(client, table.table_id)
         gen = YcsbALoadGenerator(client, table, n_threads=8).start()
         third = seconds / 3.0
         time.sleep(third)
@@ -1095,6 +1097,98 @@ def _cluster_soak_stage() -> dict:
         # stop workers BEFORE tearing the cluster down — leaked unpaced
         # threads would hammer dead sockets through retry backoff for the
         # rest of the process (and destabilize later pytest stages)
+        if gen is not None:
+            try:
+                gen.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if client is not None:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if c is not None:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def _ycsb_stage() -> dict:
+    """Serve-path rung (ROADMAP item 1): batched YCSB mixes A-F on the
+    SAME 3-process RF3 external cluster shape as the soak baseline, but
+    riding the PR-11 serve path — multi_read batches for reads, the
+    session batcher's per-tablet group commits for writes, the scan RPC
+    page path for E. Per-op completion latency is its batch's wall time
+    (op-weighted percentiles).
+
+    Tserver flags: native offload + relaxed election timing — on a
+    CPU-only (often single-core) bench host the serve rung measures the
+    RPC/raft/storage batching, not jax-CPU kernel compile stalls; the
+    device read path's own numbers are the --points rung and the TPU
+    re-measure."""
+    import shutil
+    import tempfile
+
+    from yugabyte_tpu.integration.external_mini_cluster import (
+        ExternalMiniCluster)
+    from yugabyte_tpu.integration.load_generator import (
+        YCSB_SCHEMA, YcsbLoadGenerator)
+
+    seconds = float(os.environ.get("YBTPU_BENCH_YCSB_SECONDS", 15))
+    mixes = os.environ.get("YBTPU_BENCH_YCSB_MIXES", "abcdef")
+    key_space = int(os.environ.get("YBTPU_BENCH_YCSB_KEYS", 10_000))
+    root = tempfile.mkdtemp(prefix="ybtpu-bench-ycsb-")
+    out: dict = {}
+    c = None
+    client = None
+    gen = None
+    try:
+        c = ExternalMiniCluster(
+            os.path.join(root, "cluster"), num_tservers=3, rf=3,
+            default_flags={
+                "device_offload_mode": "native",
+                "point_read_batched": False,
+                "raft_heartbeat_interval_ms": 100,
+                "leader_failure_max_missed_heartbeat_periods": 20,
+            }).start()
+        c.wait_tservers_alive(3)
+        client = c.new_client()
+        client.create_namespace("ycsb")
+        table = client.create_table("ycsb", "usertable", YCSB_SCHEMA,
+                                    num_tablets=6)
+        c.wait_table_leaders(client, table.table_id)
+        t0 = time.time()
+        YcsbLoadGenerator(client, table, key_space=key_space).load()
+        out["ycsb_load_rows_per_sec"] = round(
+            key_space / (time.time() - t0), 1)
+        for mix in mixes:
+            batch = 128 if mix == "e" else 1024
+            gen = YcsbLoadGenerator(client, table, mix=mix, n_threads=2,
+                                    key_space=key_space,
+                                    batch_size=batch).start()
+            time.sleep(seconds)
+            rep = gen.stop()
+            gen = None
+            out[f"ycsb_{mix}_ops_per_sec"] = rep.ops_per_sec
+            out[f"ycsb_{mix}_p50_ms"] = rep.p50_ms
+            out[f"ycsb_{mix}_p99_ms"] = rep.p99_ms
+            out[f"ycsb_{mix}_errors"] = rep.errors
+            if mix == "e":
+                out["ycsb_e_scan_rows_per_sec"] = round(
+                    rep.scan_rows / rep.seconds, 1) if rep.seconds else 0
+            log(f"  ycsb-{mix}: {rep.ops_per_sec:.0f} ops/s over "
+                f"{rep.seconds:.0f}s, p50 {rep.p50_ms}ms "
+                f"p99 {rep.p99_ms}ms, {rep.errors} errors")
+        # headline keys: the read-heavy B mix (the acceptance rung)
+        if "ycsb_b_ops_per_sec" in out:
+            out["ycsb_p50_ms"] = out["ycsb_b_p50_ms"]
+            out["ycsb_p99_ms"] = out["ycsb_b_p99_ms"]
+    except Exception as e:  # noqa: BLE001 — stage is best-effort
+        log(f"ycsb stage failed: {e}")
+    finally:
         if gen is not None:
             try:
                 gen.stop()
@@ -1390,6 +1484,15 @@ def main():
     # BASELINE config 5: the 3-node RF=3 cluster soak with churn
     if os.environ.get("YBTPU_BENCH_SKIP_SOAK", "") != "1":
         result.update(_cluster_soak_stage())
+    # serve-path rung (ROADMAP item 1): batched YCSB A-F on the same
+    # RF3 cluster shape, riding the PR-11 batcher + multi_read path
+    if os.environ.get("YBTPU_BENCH_SKIP_YCSB", "") != "1":
+        result.update(_ycsb_stage())
+        b = result.get("ycsb_b_ops_per_sec")
+        soak = result.get("cluster_ops_per_sec")
+        if b and soak:
+            # batched serve path vs the per-op soak on the same cluster
+            result["ycsb_b_vs_cluster_soak"] = round(b / soak, 1)
 
     if native_rate:
         result["e2e_native_rows_per_sec"] = round(native_rate, 1)

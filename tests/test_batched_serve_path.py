@@ -1,0 +1,399 @@
+"""PR 11 — batched serve path: client batcher semantics, group-commit
+writes, follower-read vouching, and the chaos window.
+
+Covers the serve-path contracts:
+  - the YBSession batcher coalesces per tablet, fans out concurrently,
+    auto-flushes full groups in the background, and demuxes errors
+    per op instead of first-error-wins;
+  - a multi-op batch replicates as ONE raft entry (group commit) and is
+    observable on the serve-path metrics + /servez;
+  - batched writes produce results identical to the same ops applied
+    sequentially, under MVCC overwrites, column deletes, row
+    tombstones and TTL expiry;
+  - a leader failover mid-batched-load loses zero acked writes;
+  - follower reads refuse replicas without a live digest vouch
+    (retryable, so the client's replica walk falls through to the
+    leader), serve correctly once the digest exchange vouches them, and
+    NEVER surface a raw Corruption.
+"""
+
+import threading
+import time
+
+import pytest
+
+from yugabyte_tpu.client.session import SessionFlushError, YBSession
+from yugabyte_tpu.common.hybrid_time import HybridClock, HybridTime
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.integration.mini_cluster import (MiniCluster,
+                                                   MiniClusterOptions)
+from yugabyte_tpu.tablet.tablet import Tablet
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.metrics import serve_path_metrics
+from yugabyte_tpu.utils.status import Code, StatusError
+
+SCHEMA = Schema(
+    columns=[ColumnSchema("k", DataType.STRING),
+             ColumnSchema("v", DataType.STRING),
+             ColumnSchema("n", DataType.INT64)],
+    num_hash_key_columns=1)
+
+
+def dk(k: str) -> DocKey:
+    return DocKey(hash_components=(k,))
+
+
+def ins(k: str, v: str, n=None, ttl_ms=None) -> QLWriteOp:
+    vals = {"v": v}
+    if n is not None:
+        vals["n"] = n
+    return QLWriteOp(WriteOpKind.INSERT, dk(k), vals, ttl_ms=ttl_ms)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = MiniCluster(MiniClusterOptions(
+        num_tservers=3, fs_root=str(tmp_path / "cluster"))).start()
+    yield c
+    c.shutdown()
+
+
+def _make_table(cluster, name, num_tablets=2):
+    client = cluster.new_client()
+    client.create_namespace("sp")
+    table = client.create_table("sp", name, SCHEMA,
+                                num_tablets=num_tablets)
+    cluster.wait_for_table_leaders("sp", name)
+    return client, table
+
+
+def _leader_peer(cluster, tablet_id):
+    for ts in cluster.tservers:
+        if tablet_id in ts.tablet_manager.tablet_ids():
+            peer = ts.tablet_manager.get_tablet(tablet_id)
+            if peer.raft.is_leader():
+                return ts, peer
+    return None, None
+
+
+def _follower_peer(cluster, tablet_id):
+    for ts in cluster.tservers:
+        if tablet_id in ts.tablet_manager.tablet_ids():
+            peer = ts.tablet_manager.get_tablet(tablet_id)
+            if not peer.raft.is_leader():
+                return ts, peer
+    return None, None
+
+
+# ------------------------------------------------------------- batcher
+class TestBatcher:
+    def test_flush_coalesces_per_tablet_and_reads_back(self, cluster):
+        client, table = _make_table(cluster, "t1")
+        s = YBSession(client)
+        for i in range(40):
+            s.apply(table, ins(f"k{i:03d}", f"v{i}"))
+        assert s.flush() == 40
+        rows = client.multi_read(table, [dk(f"k{i:03d}")
+                                         for i in range(40)])
+        assert [r.to_dict(SCHEMA)["v"] for r in rows] == \
+            [f"v{i}" for i in range(40)]
+
+    def test_max_batch_background_flush(self, cluster):
+        client, table = _make_table(cluster, "t2")
+        s = YBSession(client, max_batch_ops=8)
+        for i in range(30):
+            s.apply(table, ins(f"b{i:03d}", f"v{i}"))
+        # full groups went out in the background; flush settles the rest
+        s.flush()
+        assert not s.has_pending_operations()
+        rows = client.multi_read(table, [dk(f"b{i:03d}")
+                                         for i in range(30)])
+        assert all(r is not None for r in rows)
+
+    def test_per_op_error_demux(self, cluster):
+        client, table = _make_table(cluster, "t3", num_tablets=4)
+        s = YBSession(client)
+        good = [ins(f"g{i}", "ok") for i in range(6)]
+        # unknown column: the server rejects this op's GROUP
+        # deterministically (schema.column_id KeyError — not retryable)
+        bad = QLWriteOp(WriteOpKind.INSERT, dk("g0"), {"nope": 1})
+        for op in good:
+            s.apply(table, op)
+        s.apply(table, bad)
+        with pytest.raises(SessionFlushError) as ei:
+            s.flush()
+        failed_ops = [op for _t, op, _e in ei.value.per_op]
+        assert bad in failed_ops
+        # only the bad op's tablet group failed — ops routed to OTHER
+        # tablets landed (per-op demux, not first-error-wins)
+        failed_keys = {op.doc_key for op in failed_ops}
+        landed = [op for op in good if op.doc_key not in failed_keys]
+        assert landed, "expected at least one group to land"
+        rows = client.multi_read(table, [op.doc_key for op in landed])
+        assert all(r is not None for r in rows)
+
+    def test_flush_window_timer(self, cluster):
+        client, table = _make_table(cluster, "t4")
+        s = YBSession(client, flush_interval_s=0.1)
+        s.apply(table, ins("w0", "v"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.read_row(table, dk("w0")) is not None \
+                    and not s.has_pending_operations():
+                break
+            time.sleep(0.05)
+        assert client.read_row(table, dk("w0")) is not None
+        s.close()
+
+
+# -------------------------------------------------------- group commit
+class TestGroupCommit:
+    def test_multi_op_batch_is_one_raft_replicate(self, cluster):
+        client, table = _make_table(cluster, "gc1", num_tablets=1)
+        tablet_id = client.meta_cache.tablets(table.table_id)[0].tablet_id
+        _ts, peer = _leader_peer(cluster, tablet_id)
+        assert peer is not None
+        before_idx = peer.raft.last_op_id[1]
+        m = serve_path_metrics()
+        before_gc = m.counter("write_group_commit_total").value()
+        before_ops = m.counter("write_batch_coalesced_ops_total").value()
+        s = YBSession(client)
+        for i in range(16):
+            s.apply(table, ins(f"gc{i:02d}", "v"))
+        s.flush()
+        # 16 rows, ONE raft entry appended (one WAL append, one apply)
+        assert peer.raft.last_op_id[1] == before_idx + 1
+        assert m.counter("write_group_commit_total").value() \
+            >= before_gc + 1
+        assert m.counter("write_batch_coalesced_ops_total").value() \
+            >= before_ops + 16
+
+    def test_servez_endpoint(self, cluster):
+        client, table = _make_table(cluster, "gc2")
+        s = YBSession(client)
+        for i in range(8):
+            s.apply(table, ins(f"z{i}", "v"))
+        s.flush()
+        ts = cluster.tservers[0]
+        page = ts.servez()
+        assert page["server_id"] == ts.server_id
+        assert page["serve_path"]["write_group_commit_total"] >= 1
+        assert "write_batch_rows" in page["serve_path"]
+        assert all("vouched" in t for t in page["tablets"])
+
+    def test_batched_results_match_sequential(self, tmp_path):
+        """The same logical op sequence applied (a) as batches and (b)
+        one op per write produces identical resolved rows — under
+        overwrites, column deletes, row tombstones and TTL expiry."""
+        def script():
+            yield [ins(f"s{i}", f"v{i}", n=i) for i in range(8)]
+            yield [QLWriteOp(WriteOpKind.UPDATE, dk("s1"), {"v": "v1b"}),
+                   QLWriteOp(WriteOpKind.UPDATE, dk("s2"), {"n": 42}),
+                   ins("s8", "late")]
+            yield [QLWriteOp(WriteOpKind.DELETE_COLS, dk("s3"),
+                             columns_to_delete=("v",)),
+                   QLWriteOp(WriteOpKind.DELETE_ROW, dk("s4")),
+                   QLWriteOp(WriteOpKind.UPDATE, dk("s5"), {"v": None})]
+            yield [ins("s4", "reborn"),           # reinsert over tombstone
+                   ins("s9", "gone", ttl_ms=1)]   # expires immediately
+
+        clock = HybridClock()
+        ta = Tablet("ta", str(tmp_path / "a"), SCHEMA, clock=clock)
+        tb = Tablet("tb", str(tmp_path / "b"), SCHEMA, clock=clock)
+        for batch in script():
+            ta.write(batch)           # ONE write = one group commit
+            for op in batch:
+                tb.write([op])        # sequential twin
+        time.sleep(0.01)  # let the 1ms TTL lapse
+        keys = [dk(f"s{i}") for i in range(10)]
+        read_ht = clock.now()
+        rows_a = ta.multi_read(keys, read_ht)
+        rows_b = tb.multi_read(keys, read_ht)
+
+        def norm(rows):
+            return [None if r is None
+                    else (r.doc_key.encode(), sorted(r.columns.items()))
+                    for r in rows]
+
+        assert norm(rows_a) == norm(rows_b)
+        # and batched read == sequential reads on the same tablet
+        seq = [ta.read_row(k, read_ht) for k in keys]
+        assert norm(rows_a) == norm(seq)
+        ta.close()
+        tb.close()
+
+    def test_leader_failover_mid_batch_zero_acked_loss(self, cluster):
+        """The chaos window: batched writers keep flushing while the
+        leader tserver restarts; every op whose flush was ACKED must be
+        readable afterwards (group commit must not widen the loss
+        window)."""
+        client, table = _make_table(cluster, "gc3", num_tablets=2)
+        acked = {}
+        errors = [0]
+        stop = threading.Event()
+
+        def writer():
+            s = YBSession(client)
+            i = 0
+            while not stop.is_set():
+                batch = {f"f{i + j:05d}": f"v{i + j}" for j in range(10)}
+                for k, v in batch.items():
+                    s.apply(table, ins(k, v))
+                try:
+                    s.flush()
+                    acked.update(batch)
+                except StatusError:
+                    errors[0] += 1  # unacked: may or may not have landed
+                    time.sleep(0.05)
+                i += 10
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        time.sleep(1.5)
+        # find and restart the leader of the first tablet (WAL replay +
+        # catch-up on the way back)
+        tablet_id = client.meta_cache.tablets(table.table_id)[0].tablet_id
+        leader_ts, _peer = _leader_peer(cluster, tablet_id)
+        assert leader_ts is not None
+        idx = cluster.tservers.index(leader_ts)
+        cluster.restart_tablet_server(idx)
+        time.sleep(2.0)
+        stop.set()
+        t.join(timeout=30)
+        assert len(acked) > 50, "writer made no progress"
+        # verify from a FRESH client: every acked write is present with
+        # its last-acked value
+        fresh = cluster.new_client()
+        tbl = fresh.open_table("sp", "gc3")
+        keys = sorted(acked)
+        rows = fresh.multi_read(tbl, [dk(k) for k in keys])
+        missing = [k for k, r in zip(keys, rows) if r is None]
+        assert not missing, f"LOST acked rows: {missing[:10]}"
+        wrong = [k for k, r in zip(keys, rows)
+                 if r.to_dict(SCHEMA)["v"] != acked[k]]
+        assert not wrong, f"acked rows with stale values: {wrong[:10]}"
+
+
+# ------------------------------------------------------ follower reads
+class TestFollowerReads:
+    def test_unvouched_follower_refuses_retryably(self, cluster):
+        client, table = _make_table(cluster, "fr1", num_tablets=1)
+        s = YBSession(client)
+        for i in range(10):
+            s.apply(table, ins(f"r{i}", f"v{i}"))
+        s.flush()
+        tablet_id = client.meta_cache.tablets(table.table_id)[0].tablet_id
+        _ts, follower = _follower_peer(cluster, tablet_id)
+        assert follower is not None and not follower.is_vouched()
+        before = serve_path_metrics().counter(
+            "follower_read_unvouched_rejects_total").value()
+        with pytest.raises(StatusError) as ei:
+            follower.multi_read([dk("r0")], allow_follower=True)
+        assert ei.value.status.code == Code.SERVICE_UNAVAILABLE
+        assert ei.value.extra.get("follower_unvouched")
+        assert serve_path_metrics().counter(
+            "follower_read_unvouched_rejects_total").value() == before + 1
+        # the CLIENT path still answers (replica walk falls through to
+        # the leader when every follower refuses); wait out the
+        # staleness bound so the read point covers the write
+        time.sleep(
+            flags.get_flag("follower_read_staleness_ms") / 1000 + 0.1)
+        row = client.read_row(table, dk("r0"), follower_read=True)
+        assert row.to_dict(SCHEMA)["v"] == "v0"
+
+    def test_digest_exchange_vouches_then_follower_serves(self, cluster):
+        client, table = _make_table(cluster, "fr2", num_tablets=1)
+        s = YBSession(client)
+        for i in range(10):
+            s.apply(table, ins(f"d{i}", f"v{i}"))
+        s.flush()
+        tablet_id = client.meta_cache.tablets(table.table_id)[0].tablet_id
+        leader_ts, leader = _leader_peer(cluster, tablet_id)
+        _fts, follower = _follower_peer(cluster, tablet_id)
+        # leader-driven digest exchange: matching followers get vouched
+        mismatches = leader_ts._scrub_digest_check(leader)
+        assert mismatches == 0
+        deadline = time.monotonic() + 10
+        while not follower.is_vouched() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert follower.is_vouched()
+        # bounded-staleness read point the follower's safe time covers
+        read_ht = HybridTime(leader.tablet.mvcc.peek_safe_time().value)
+        rows = follower.multi_read([dk(f"d{i}") for i in range(10)],
+                                   read_ht, allow_follower=True)
+        assert [r.to_dict(SCHEMA)["v"] for r in rows] == \
+            [f"v{i}" for i in range(10)]
+        # whole-path client follower read agrees (wait out the
+        # staleness bound so the read point covers the writes)
+        time.sleep(
+            flags.get_flag("follower_read_staleness_ms") / 1000 + 0.1)
+        got = client.multi_read(table, [dk("d3")], follower_read=True)
+        assert got[0].to_dict(SCHEMA)["v"] == "v3"
+
+    def test_vouch_revoked_on_failure_and_ttl(self, cluster):
+        client, table = _make_table(cluster, "fr3", num_tablets=1)
+        tablet_id = client.meta_cache.tablets(table.table_id)[0].tablet_id
+        _ts, follower = _follower_peer(cluster, tablet_id)
+        follower.grant_vouch(0)
+        assert follower.is_vouched()
+        from yugabyte_tpu.utils.status import Status
+        follower.mark_failed(Status.IoError("test park"))
+        assert not follower.is_vouched()
+
+    def test_follower_read_never_surfaces_raw_corruption(self, cluster):
+        """A vouched-but-corrupt follower must answer with a retryable
+        ServiceUnavailable (read-path corruption containment), never a
+        raw Corruption."""
+        import glob
+        import os
+
+        from yugabyte_tpu.utils import env as env_mod
+        client, table = _make_table(cluster, "fr4", num_tablets=1)
+        s = YBSession(client)
+        for i in range(50):
+            s.apply(table, ins(f"c{i:03d}", "x" * 64))
+        s.flush()
+        tablet_id = client.meta_cache.tablets(table.table_id)[0].tablet_id
+        _ts, follower = _follower_peer(cluster, tablet_id)
+        # wait for the follower's apply loop to catch up before flushing
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if follower.tablet.regular_db.approx_entry_count() >= 100:
+                break
+            time.sleep(0.05)
+        follower.tablet.flush()
+        # data blocks live in the .sblock sidecar — corrupt THOSE (a
+        # corrupt base file fails open loudly at bootstrap, which is its
+        # own containment; the read-path case is a bad data block)
+        sblocks = glob.glob(os.path.join(
+            follower.tablet.regular_db.db_dir, "*.sblock*"))
+        assert sblocks
+        fts = _ts
+        for sb in sblocks:
+            env_mod.corrupt_file_range(sb, offset=0, length=1 << 20,
+                                       nbits=256)
+        # restart the follower's tserver: block/device caches drop, so
+        # the next read touches the corrupt bytes physically
+        idx = cluster.tservers.index(fts)
+        cluster.restart_tablet_server(idx)
+        deadline = time.monotonic() + 30
+        follower = None
+        while time.monotonic() < deadline and follower is None:
+            try:
+                peer = cluster.tservers[idx].tablet_manager.get_tablet(
+                    tablet_id)
+                if not peer.raft.is_leader():
+                    follower = peer
+            except StatusError:
+                time.sleep(0.1)
+        assert follower is not None
+        follower.grant_vouch(0)  # corrupt AND vouched: worst case
+        read_ht = HybridTime(
+            follower.tablet.mvcc.peek_safe_time().value)
+        with pytest.raises(StatusError) as ei:
+            follower.multi_read([dk(f"c{i:03d}") for i in range(50)],
+                                read_ht, allow_follower=True)
+        # contained: retryable, never Code.CORRUPTION
+        assert ei.value.status.code == Code.SERVICE_UNAVAILABLE

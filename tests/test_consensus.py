@@ -330,7 +330,10 @@ class TestTabletPeer:
             assert row.to_dict(h.schema)["v"] == 3
 
             # Followers hold identical data, readable at propagated safe time
+            # (vouch the replica first: PR-11 gates follower serving on
+            # the digest exchange, which this bare harness doesn't run)
             follower = h.peers["ts1"]
+            follower.grant_vouch(0)
             wait_for(lambda: follower.tablet.mvcc.safe_time_for_follower()
                      .value > 0, msg="propagated safe time")
             wait_for(lambda: (follower.read_row(
@@ -431,6 +434,9 @@ class TestTabletPeer:
             new.write([write_op(h.schema, "after-failover", 2)])
             h.transport.heal()
             old = h.peers["ts0"]
+            # PR-11 follower-read gate: vouch the rejoining replica (no
+            # digest exchange runs in this harness)
+            old.grant_vouch(0)
             wait_for(lambda: (old.read_row(
                 DocKey(range_components=("after-failover",)),
                 allow_follower=True) or None) is not None,

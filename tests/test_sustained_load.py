@@ -35,6 +35,9 @@ def test_linked_list_under_churn(tmp_path):
         # flushes still occur from the volume of writes over the run
         table = client.create_table("load", "chains", LINKED_LIST_SCHEMA,
                                     num_tablets=4)
+        # deflake: writers must not race the fresh tablets' first
+        # elections (the known create-then-write leadership flake)
+        c.wait_table_leaders(client, table.table_id)
 
         gen = LinkedListLoadGenerator(client, table, n_chains=4,
                                       ops_per_sec=120.0).start()

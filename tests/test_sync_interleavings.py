@@ -145,7 +145,12 @@ def test_leader_change_during_in_flight_write(tmp_path):
                 answers = []
                 for s in ("ts0", "ts1", "ts2"):
                     try:
-                        row = h.peers[s].read_row(
+                        peer = h.peers[s]
+                        if s != "ts1":
+                            # PR-11 follower-read gate (no digest
+                            # exchange in this harness)
+                            peer.grant_vouch(0)
+                        row = peer.read_row(
                             DocKey(range_components=("inflight",)),
                             allow_follower=(s != "ts1"))
                     except NotLeader:
@@ -173,6 +178,9 @@ def test_partitioned_follower_reads_stay_consistent_then_converge(tmp_path):
         leader = h.elect("ts0")
         leader.write([write_op(h.schema, f"pre{i}", i) for i in range(5)])
         follower = h.peers["ts2"]
+        # PR-11 follower-read gate: vouch the replica (no digest
+        # exchange runs in this harness)
+        follower.grant_vouch(0)
         wait_for(lambda: follower.read_row(
             DocKey(range_components=("pre4",)), allow_follower=True)
             is not None, msg="follower caught up")

@@ -819,8 +819,21 @@ class TestErrorPropagation:
                     io()
                 except OSError:
                     fallback()
-        """, relpath="yugabyte_tpu/client/fake.py")
+        """, relpath="yugabyte_tpu/yql/fake.py")
         assert fs == []
+
+    def test_client_dir_is_reported(self):
+        """PR 11 seed extension: the client batcher joined the report
+        set — a swallowed send error in flush turns an unacked batch
+        into a silently 'acked' one."""
+        fs = self._lint("""
+            def flush_units():
+                try:
+                    io()
+                except OSError:
+                    fallback()
+        """, relpath="yugabyte_tpu/client/fake.py")
+        assert len(fs) == 1
 
     def test_nemesis_and_cancel_paths_are_seeded(self):
         """PR 6 seed extension: chaos/nemesis fault-injection and

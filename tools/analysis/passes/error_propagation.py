@@ -29,6 +29,11 @@ on the path):
     `corrupt` (PR 8: the data-integrity loop — a swallowed error in the
     scrubber or shadow verifier means corruption detected but never
     routed to repair, the exact dead end this code exists to close);
+  - every function whose name contains `vouch` or `follower_read`
+    (PR 11: the follower-read gate — a swallowed error here lets an
+    unvetted replica serve reads), and every function of the client
+    batcher (client/session.py: a swallowed send error in flush turns
+    an unacked batch into a silently "acked" one);
   - every function of the WAL module (consensus/log.py), the nemesis
     rule engine (rpc/nemesis.py), the chaos controller
     (integration/chaos.py) and the integrity core
@@ -58,13 +63,18 @@ PASS_NAME = "error-propagation"
 DEFAULT_DIRS = ("yugabyte_tpu/storage", "yugabyte_tpu/consensus",
                 "yugabyte_tpu/tablet", "yugabyte_tpu/rpc",
                 "yugabyte_tpu/integration", "yugabyte_tpu/ops",
-                "yugabyte_tpu/tserver")
+                "yugabyte_tpu/tserver", "yugabyte_tpu/client")
 _SEED_NAME_RE = re.compile(
-    r"flush|compact|nemesis|chaos|cancel|scrub|integrity|shadow|corrupt",
+    r"flush|compact|nemesis|chaos|cancel|scrub|integrity|shadow|corrupt"
+    r"|vouch|follower_read",
     re.IGNORECASE)
 _WAL_MODULE_SUFFIX = ".consensus.log"
 _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
-                         ".integration.chaos", ".storage.integrity")
+                         ".integration.chaos", ".storage.integrity",
+                         # PR 11: the client batcher — a swallowed send
+                         # error in flush turns an unacked batch into a
+                         # silently "acked" one
+                         ".client.session")
 _MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
 _DEF_MARKER = "# yblint: durability-path"
 _ROUTING_NAMES = ("TRACE", "trace")

@@ -37,6 +37,19 @@ flags.define_flag("client_op_timeout_s", 60.0,
                   "RPC timeouts to the remaining budget and surfaces "
                   "DeadlineExceeded instead of retrying past it; "
                   "<= 0 disables the bound")
+flags.define_flag("follower_read_staleness_ms", 500.0,
+                  "bounded-staleness follower reads resolve at "
+                  "now - this (ref yb_follower_read_staleness_ms): far "
+                  "enough behind that a healthy follower's propagated "
+                  "safe time already covers the read point, so the read "
+                  "never blocks on the leader")
+
+
+def follower_read_ht() -> HybridTime:
+    """The bounded-staleness read point for follower reads."""
+    stale_us = int(flags.get_flag("follower_read_staleness_ms") * 1000)
+    return HybridTime.from_micros(
+        max(0, int(time.time() * 1e6) - stale_us))
 
 
 def _op_deadline_s() -> Optional[float]:
@@ -146,13 +159,13 @@ class YBClient:
                         last_err = e
                         continue
                     raise
-                except RpcTimeout as e:
+                except RpcTimeout as e:  # yblint: contained(retry walk: last_err re-raised on deadline/retry exhaustion below)
                     # The request may have been executing when we gave up.
                     if _retry_ctx is not None:
                         _retry_ctx["maybe_applied"] = True
                     last_err = e
                     continue
-                except ServiceUnavailable as e:
+                except ServiceUnavailable as e:  # yblint: contained(retry walk: last_err re-raised on deadline/retry exhaustion below)
                     last_err = e
                     continue
             self._master_leader = None
@@ -336,11 +349,17 @@ class YBClient:
 
     # ------------------------------------------------------- tablet-side ops
     def _tablet_call(self, table: YBTable, tablet: RemoteTablet, mth: str,
-                     refresh_key: Optional[bytes] = None, **args):
+                     refresh_key: Optional[bytes] = None,
+                     spread_replicas: bool = False, **args):
         """Call a tablet's leader, retrying through replicas and refreshing
         locations on failure (ref batcher.cc + meta_cache.cc retry logic).
         Split markers propagate up immediately — the caller must re-route
-        by key (a split parent's replacement differs per key)."""
+        by key (a split parent's replacement differs per key).
+
+        spread_replicas: follower-read mode — start the replica walk at a
+        random replica instead of leader-first so read load spreads
+        across the raft group; an unvouched/lagging replica answers
+        retryably and the walk moves on."""
         if refresh_key is None:
             refresh_key = tablet.partition.start
         last_err: Optional[Exception] = None
@@ -353,12 +372,24 @@ class YBClient:
         with Trace(f"client.{mth}"):
             return self._tablet_call_traced(table, tablet, mth,
                                             refresh_key, last_err,
-                                            backoff, args)
+                                            backoff, args,
+                                            spread_replicas)
 
     def _tablet_call_traced(self, table, tablet, mth, refresh_key,
-                            last_err, backoff, args):
+                            last_err, backoff, args,
+                            spread_replicas=False):
+        import random as _random
         for attempt in range(flags.get_flag("client_rpc_retries")):
-            for addr in tablet.candidate_addrs():
+            addrs = tablet.candidate_addrs()
+            if spread_replicas and len(addrs) > 1:
+                # followers first in random order, leader last: load
+                # spreads across vouched replicas, and the leader stays
+                # in the walk as the deterministic fallback when every
+                # follower refuses (unvouched / safe time behind)
+                rest = addrs[1:]
+                _random.shuffle(rest)
+                addrs = rest + addrs[:1]
+            for addr in addrs:
                 try:
                     TRACE("client: %s tablet %s at %s (attempt %d)",
                           mth, tablet.tablet_id, addr, attempt)
@@ -403,7 +434,7 @@ class YBClient:
                         last_err = e
                         continue
                     raise
-                except (RpcTimeout, ServiceUnavailable) as e:
+                except (RpcTimeout, ServiceUnavailable) as e:  # yblint: contained(replica walk: last_err re-raised on deadline/retry exhaustion below)
                     last_err = e
                     continue
             # All replicas failed: refresh locations and back off
@@ -461,41 +492,81 @@ class YBClient:
 
     def read_row(self, table: YBTable, doc_key: DocKey,
                  read_ht: Optional[HybridTime] = None,
-                 projection: Optional[Sequence[str]] = None):
+                 projection: Optional[Sequence[str]] = None,
+                 follower_read: bool = False):
+        """follower_read: bounded-staleness read (read point defaults to
+        now - follower_read_staleness_ms) that any VOUCHED replica may
+        serve — the replica walk starts at a random replica to spread
+        load, and unvouched replicas refuse retryably so the walk falls
+        through to the leader."""
         pk = table.partition_key_for(doc_key)
         tablet = self.meta_cache.lookup_tablet(table.table_id, pk)
+        if follower_read and read_ht is None:
+            read_ht = follower_read_ht()
         w = self._tablet_call(
             table, tablet, "read_row", refresh_key=pk,
+            spread_replicas=follower_read,
             doc_key=doc_key_to_wire(doc_key),
             read_ht=read_ht.value if read_ht else None,
             projection=list(projection) if projection else None,
+            allow_follower=follower_read,
             schema_version=table.schema_version)
         return row_from_wire(w)
 
     def multi_read(self, table: YBTable, doc_keys: Sequence[DocKey],
                    read_ht: Optional[HybridTime] = None,
-                   projection: Optional[Sequence[str]] = None):
+                   projection: Optional[Sequence[str]] = None,
+                   follower_read: bool = False):
         """Batched point-row reads: keys group per tablet and each group
         rides ONE multi_read RPC (one leader-lease check + read-point
         resolution server-side, and the batched device point-read path
         under it), instead of a read_row round trip per key. Returns
-        rows aligned with doc_keys (None = absent)."""
+        rows aligned with doc_keys (None = absent).
+
+        follower_read: see read_row — bounded-staleness batch served by
+        any vouched replica, spreading read load across the raft group."""
         groups: Dict[str, Tuple[RemoteTablet, bytes, List[int]]] = {}
         for i, dk in enumerate(doc_keys):
             pk = table.partition_key_for(dk)
             tablet = self.meta_cache.lookup_tablet(table.table_id, pk)
             groups.setdefault(tablet.tablet_id,
                               (tablet, pk, []))[2].append(i)
+        if follower_read and read_ht is None:
+            read_ht = follower_read_ht()
         out: List = [None] * len(doc_keys)
-        for tablet, pk, idxs in groups.values():
-            resp = self._tablet_call(
-                table, tablet, "multi_read", refresh_key=pk,
-                doc_keys=[doc_key_to_wire(doc_keys[i]) for i in idxs],
-                read_ht=read_ht.value if read_ht else None,
-                projection=list(projection) if projection else None,
-                schema_version=table.schema_version)
+        errors: List[Exception] = []
+
+        def fetch(tablet, pk, idxs) -> None:
+            try:
+                resp = self._tablet_call(
+                    table, tablet, "multi_read", refresh_key=pk,
+                    spread_replicas=follower_read,
+                    doc_keys=[doc_key_to_wire(doc_keys[i]) for i in idxs],
+                    read_ht=read_ht.value if read_ht else None,
+                    projection=list(projection) if projection else None,
+                    allow_follower=follower_read,
+                    schema_version=table.schema_version)
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+                return
             for i, w in zip(idxs, resp["rows"]):
                 out[i] = None if w is None else row_from_wire(w)
+
+        grps = list(groups.values())
+        if len(grps) == 1:
+            fetch(*grps[0])
+        else:
+            # per-tablet fan-out: the batch's wall time is the slowest
+            # tablet's RPC, not the sum (mirrors the session batcher)
+            import threading as _threading
+            threads = [_threading.Thread(target=fetch, args=g, daemon=True)
+                       for g in grps]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errors:
+            raise errors[0]
         return out
 
     def scan(self, table: YBTable, read_ht: Optional[HybridTime] = None,

@@ -1,62 +1,201 @@
-"""YBSession + Batcher: buffered writes grouped per tablet.
+"""YBSession + Batcher: buffered writes coalesced per tablet.
 
 Capability parity with the reference (ref: src/yb/client/session.h:96 —
 Apply buffers ops, Flush groups them per tablet and sends one WriteRpc per
-tablet in parallel; batcher.h:148). Parallelism here is a thread per tablet
-batch — the control-plane RPC layer is threaded end to end.
+tablet in parallel; batcher.h:148 Batcher states, batcher.cc error
+collection). The session is a real batcher now:
+
+- per-tablet coalescing: apply() resolves the destination tablet ONCE and
+  buffers the op under it, so flush has its groups in hand;
+- flush window + max batch: a tablet group reaching
+  ``ybsession_max_batch_ops`` flushes itself in the background without
+  waiting for the explicit flush() (AUTO_FLUSH_BACKGROUND, ref
+  session.h FlushMode), and an optional time window
+  (``flush_interval_s``) sweeps stragglers;
+- parallel fan-out: per-tablet groups go out concurrently (one sender
+  thread per group; a single group sends on the caller thread);
+- per-op status demux: a failed group maps its error back onto each of
+  its ops; flush() raises SessionFlushError carrying the per-op
+  (table, op, error) list instead of first-error-wins (ref
+  batcher.cc CollectedErrors);
+- retry/dedup rides below: each per-tablet write RPC carries one
+  (client_id, request_id) retryable-request id (client.write), so a
+  retried batch can never double-apply.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from yugabyte_tpu.client.client import YBClient, YBTable
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp
+from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Status, StatusError
+
+flags.define_flag("ybsession_max_batch_ops", 512,
+                  "a per-tablet group reaching this many buffered ops "
+                  "flushes itself in the background (ref "
+                  "YB_CLIENT_MAX_BATCH_SIZE / batcher max buffer)")
+
+
+class SessionFlushError(StatusError):
+    """One or more per-tablet groups failed. ``per_op`` lists every op
+    that did NOT land as (table, op, error); ops absent from the list
+    were acknowledged (per-op demux, ref batcher.cc CollectedErrors)."""
+
+    def __init__(self, per_op: List[Tuple[YBTable, QLWriteOp, Exception]]):
+        first = per_op[0][2]
+        st = first.status if isinstance(first, StatusError) else \
+            Status.IoError(str(first))
+        super().__init__(st)
+        self.per_op = per_op
+        self.extra = getattr(first, "extra", {})
+
+    def __str__(self) -> str:
+        return (f"{len(self.per_op)} op(s) failed; first: "
+                f"{self.per_op[0][2]}")
+
+
+class _TabletGroup:
+    __slots__ = ("table", "tablet", "ops")
+
+    def __init__(self, table: YBTable, tablet):
+        self.table = table
+        self.tablet = tablet
+        self.ops: List[QLWriteOp] = []
 
 
 class YBSession:
-    def __init__(self, client: YBClient):
+    def __init__(self, client: YBClient,
+                 flush_interval_s: Optional[float] = None,
+                 max_batch_ops: Optional[int] = None):
         self._client = client
-        self._pending: List[Tuple[YBTable, QLWriteOp]] = []
+        self._groups: Dict[str, _TabletGroup] = {}
+        self._n_pending = 0
         self._lock = threading.Lock()
+        self._flush_interval_s = flush_interval_s
+        self._max_batch_ops = max_batch_ops
+        # errors from background (max-batch / timer) flushes surface at
+        # the NEXT explicit flush() — an acked-looking apply must not
+        # silently lose its batch (ref session.h deferred flush status)
+        self._async_errors: List[Tuple[YBTable, QLWriteOp, Exception]] = []
+        self._inflight = 0            # background flushes not yet settled
+        self._inflight_cv = threading.Condition(self._lock)
+        self._closed = False
+        self._timer: Optional[threading.Thread] = None
+        if flush_interval_s:
+            self._timer = threading.Thread(
+                target=self._timer_loop, daemon=True,
+                name="ybsession-flush-timer")
+            self._timer.start()
 
+    # ------------------------------------------------------------- buffering
     def apply(self, table: YBTable, op: QLWriteOp) -> None:
+        """Buffer one op under its destination tablet. A group hitting the
+        max-batch size is handed to a background sender immediately —
+        the caller keeps applying while the batch replicates."""
+        pk = table.partition_key_for(op.doc_key)
+        tablet = self._client.meta_cache.lookup_tablet(table.table_id, pk)
+        limit = (self._max_batch_ops
+                 if self._max_batch_ops is not None
+                 else flags.get_flag("ybsession_max_batch_ops"))
+        full: Optional[_TabletGroup] = None
         with self._lock:
-            self._pending.append((table, op))
+            key = f"{table.table_id}/{tablet.tablet_id}"
+            group = self._groups.get(key)
+            if group is None:
+                group = self._groups[key] = _TabletGroup(table, tablet)
+            group.ops.append(op)
+            self._n_pending += 1
+            if limit and len(group.ops) >= limit:
+                del self._groups[key]
+                self._n_pending -= len(group.ops)
+                self._inflight += 1
+                full = group
+        if full is not None:
+            self._spawn_send(full)
+
+    def has_pending_operations(self) -> bool:
+        with self._lock:
+            return bool(self._n_pending or self._inflight)
+
+    # --------------------------------------------------------------- sending
+    def _send_group(self, group: _TabletGroup,
+                    errors: List[Tuple[YBTable, QLWriteOp, Exception]],
+                    errors_lock: threading.Lock) -> None:
+        try:
+            self._client.write(group.table, group.ops, tablet=group.tablet)
+        except Exception as e:  # noqa: BLE001  # yblint: contained(demuxed onto every op of the group; flush re-raises them as SessionFlushError)
+            with errors_lock:
+                errors.extend((group.table, op, e) for op in group.ops)
+
+    def _spawn_send(self, group: _TabletGroup) -> None:
+        def run():
+            try:
+                self._send_group(group, self._async_errors, self._lock)
+            finally:
+                with self._inflight_cv:
+                    self._inflight -= 1
+                    self._inflight_cv.notify_all()
+        threading.Thread(target=run, daemon=True,
+                         name="ybsession-bg-flush").start()
+
+    def _timer_loop(self) -> None:
+        period = self._flush_interval_s
+        while True:
+            time.sleep(period)
+            with self._lock:
+                if self._closed:
+                    return
+                groups = list(self._groups.values())
+                self._groups.clear()
+                self._n_pending = 0
+                self._inflight += len(groups)
+            for g in groups:
+                self._spawn_send(g)
 
     def flush(self) -> int:
-        """Send all buffered ops, one write RPC per destination tablet, in
-        parallel. Returns ops flushed; raises the first error after all
-        batches settle (ref batcher.cc CheckForFinishedFlush)."""
+        """Send all buffered ops, one write RPC per destination tablet,
+        fanned out concurrently, then wait for any background flushes to
+        settle. Returns the number of ops this call flushed; raises
+        SessionFlushError listing every failed op (per-op demux) if any
+        group — foreground or background — failed since the last
+        flush."""
         with self._lock:
-            pending, self._pending = self._pending, []
-        if not pending:
-            return 0
-        # group by (table_id, tablet_id)
-        groups: Dict[str, Tuple[YBTable, object, List[QLWriteOp]]] = {}
-        for table, op in pending:
-            pk = table.partition_key_for(op.doc_key)
-            tablet = self._client.meta_cache.lookup_tablet(table.table_id, pk)
-            key = f"{table.table_id}/{tablet.tablet_id}"
-            if key not in groups:
-                groups[key] = (table, tablet, [])
-            groups[key][2].append(op)
-        errors: List[Exception] = []
-
-        def send(table: YBTable, tablet, ops: List[QLWriteOp]) -> None:
-            try:
-                self._client.write(table, ops, tablet=tablet)
-            except Exception as e:  # noqa: BLE001 — collected below
-                errors.append(e)
-
-        threads = [threading.Thread(target=send, args=g, daemon=True)
-                   for g in groups.values()]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+            groups = list(self._groups.values())
+            self._groups.clear()
+            self._n_pending = 0
+        n_ops = sum(len(g.ops) for g in groups)
+        errors: List[Tuple[YBTable, QLWriteOp, Exception]] = []
+        errors_lock = threading.Lock()
+        if len(groups) == 1:
+            # single-tablet batch (the overwhelmingly common case under
+            # key-grouped load): skip the thread spawn
+            self._send_group(groups[0], errors, errors_lock)
+        elif groups:
+            threads = [threading.Thread(
+                target=self._send_group, args=(g, errors, errors_lock),
+                daemon=True) for g in groups]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        # settle background flushes so their errors surface HERE, not on
+        # some later unrelated flush
+        with self._inflight_cv:
+            while self._inflight:
+                self._inflight_cv.wait()
+            if self._async_errors:
+                errors.extend(self._async_errors)
+                self._async_errors = []
         if errors:
-            raise errors[0]
-        return len(pending)
+            raise SessionFlushError(errors)
+        return n_ops
+
+    def close(self) -> None:
+        """Flush remaining ops and stop the background timer."""
+        with self._lock:
+            self._closed = True
+        self.flush()

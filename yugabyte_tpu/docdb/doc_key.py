@@ -150,36 +150,54 @@ class PrimitiveValue:
 
 @dataclass(frozen=True)
 class DocKey:
-    """Primary key of one document: hashed group + range group."""
+    """Primary key of one document: hashed group + range group.
+
+    encode()/hash_code memoize on first use (the instance is frozen, so
+    the encoding can never change): the client encodes every key once
+    for partition routing and again for the wire/read path, and the
+    hash-compound pass was the single hottest client-side line under
+    batched load."""
 
     hash_components: Tuple[PrimitiveType, ...] = ()
     range_components: Tuple[PrimitiveType, ...] = ()
     use_hash: Optional[bool] = None  # default: hash iff hash_components present
 
     def encode(self) -> bytes:
+        cached = self.__dict__.get("_enc")
+        if cached is not None:
+            return cached
         buf = bytearray()
         use_hash = self.use_hash if self.use_hash is not None else bool(self.hash_components)
         if use_hash:
             hbuf = bytearray()
             for c in self.hash_components:
                 PrimitiveValue.encode(c, hbuf)
+            hc = hash_column_compound_value(bytes(hbuf))
+            object.__setattr__(self, "_hash_code", hc)
             buf.append(ValueType.kUInt16Hash)
-            buf += struct.pack(">H", hash_column_compound_value(bytes(hbuf)))
+            buf += struct.pack(">H", hc)
             buf += hbuf
             buf.append(ValueType.kGroupEnd)
         for c in self.range_components:
             PrimitiveValue.encode(c, buf)
         buf.append(ValueType.kGroupEnd)
-        return bytes(buf)
+        out = bytes(buf)
+        object.__setattr__(self, "_enc", out)
+        return out
 
     @property
     def hash_code(self) -> Optional[int]:
         if not self.hash_components:
             return None
+        cached = self.__dict__.get("_hash_code")
+        if cached is not None:
+            return cached
         hbuf = bytearray()
         for c in self.hash_components:
             PrimitiveValue.encode(c, hbuf)
-        return hash_column_compound_value(bytes(hbuf))
+        hc = hash_column_compound_value(bytes(hbuf))
+        object.__setattr__(self, "_hash_code", hc)
+        return hc
 
     @staticmethod
     def decode(data: bytes, pos: int = 0) -> Tuple["DocKey", int]:

@@ -15,13 +15,28 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from yugabyte_tpu.common.schema import Schema
-from yugabyte_tpu.docdb.doc_key import DocKey, PrimitiveType, SubDocKey
+from yugabyte_tpu.docdb.doc_key import (DocKey, PrimitiveType,
+                                        PrimitiveValue, SubDocKey)
 from yugabyte_tpu.docdb.lock_manager import (
     IntentType, LockBatch, doc_path_lock_entries)
 from yugabyte_tpu.docdb.value import Value
+
+
+@lru_cache(maxsize=8192)
+def column_key_suffix(cid: int) -> bytes:
+    """Encoded column-id subkey (what SubDocKey appends after the doc
+    key). Column ids repeat across every row of a table, so the batched
+    encode path concatenates ``doc_key.encode() + column_key_suffix(cid)``
+    — byte-identical to SubDocKey(dk, (("col", cid),)).encode(
+    include_ht=False) without re-encoding (and re-hashing) the doc key
+    once per column."""
+    buf = bytearray()
+    PrimitiveValue.encode_column_id(cid, buf)
+    return bytes(buf)
 
 # System column marking row liveness (ref: common/ql_value / SystemColumnIds::
 # kLivenessColumn). Encoded with kSystemColumnId, so it sorts before all
@@ -67,14 +82,19 @@ class QLWriteOp:
         """Flattened (subdoc_key_without_ht, encoded_value) pairs, in the
         order they receive intra-batch write ids."""
         dk = self.doc_key
+        # Encode the doc key ONCE per op (it includes the partition-hash
+        # computation); every column key is a pure byte concat from it.
+        # Byte-identical to the per-column SubDocKey encode — the batched
+        # write path leans on this (one hash + one component encode per
+        # ROW, not per KV).
+        dk_enc = dk.encode()
         out: List[Tuple[bytes, bytes]] = []
 
         def col_key(cid: int) -> bytes:
-            return SubDocKey(dk, (("col", cid),)).encode(include_ht=False)
+            return dk_enc + column_key_suffix(cid)
 
         if self.kind == WriteOpKind.DELETE_ROW:
-            out.append((SubDocKey(dk).encode(include_ht=False),
-                        Value.tombstone().encode()))
+            out.append((dk_enc, Value.tombstone().encode()))
             return out
         if self.kind == WriteOpKind.DELETE_COLS:
             for name in self.columns_to_delete:

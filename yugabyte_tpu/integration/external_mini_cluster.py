@@ -95,9 +95,14 @@ class _Node:
 
 
 class ExternalMiniCluster:
-    def __init__(self, fs_root: str, num_tservers: int = 3, rf: int = 3):
+    def __init__(self, fs_root: str, num_tservers: int = 3, rf: int = 3,
+                 default_flags: Optional[Dict[str, object]] = None):
+        """default_flags: flag overrides applied to EVERY node at start
+        and restart (e.g. relaxed raft election timing for a soak on an
+        oversubscribed CI core)."""
         self.fs_root = fs_root
         self.rf = rf
+        self.default_flags = dict(default_flags or {})
         os.makedirs(fs_root, exist_ok=True)
         mport = _free_port()
         self.master = _Node("master", "m0",
@@ -108,9 +113,9 @@ class ExternalMiniCluster:
             for i in range(num_tservers)]
 
     def start(self) -> "ExternalMiniCluster":
-        self.master.start()
+        self.master.start(extra_flags=self.default_flags or None)
         for ts in self.tservers:
-            ts.start()
+            ts.start(extra_flags=self.default_flags or None)
         return self
 
     def new_client(self) -> YBClient:
@@ -137,12 +142,36 @@ class ExternalMiniCluster:
         finally:
             client.close()
 
+    def wait_table_leaders(self, client: YBClient, table_id: str,
+                           timeout_s: float = 60.0) -> None:
+        """Deadline-poll the master's location map until EVERY tablet of
+        the table reports a leader (the external-cluster twin of
+        MiniCluster.wait_for_table_leaders — the deflake primitive for
+        create-then-write: a fresh tablet's first election can outlast a
+        writer's retry budget)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                locs = client._master_call("get_table_locations",
+                                           table_id=table_id)
+                if locs and all(loc.get("leader") for loc in locs):
+                    return
+            except Exception:  # noqa: BLE001 — tablets still registering
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"leaders of table {table_id} not elected in "
+                    f"{timeout_s}s")
+            time.sleep(0.3)
+
     def restart_tserver(self, i: int, crash_point: Optional[str] = None,
                         extra_flags: Optional[Dict[str, object]] = None
                         ) -> None:
         self.tservers[i].kill9()
+        merged = dict(self.default_flags)
+        merged.update(extra_flags or {})
         self.tservers[i].start(crash_point=crash_point,
-                               extra_flags=extra_flags)
+                               extra_flags=merged or None)
 
     def shutdown(self) -> None:
         for ts in self.tservers:

@@ -178,6 +178,9 @@ class YcsbReport:
     errors: int
     reads: int
     writes: int
+    mix: str = "a"
+    scans: int = 0
+    scan_rows: int = 0
 
 
 class YcsbALoadGenerator:
@@ -276,3 +279,268 @@ class YcsbALoadGenerator:
             errors=sum(c[1] for c in self._counts),
             reads=sum(c[2] for c in self._counts),
             writes=sum(c[3] for c in self._counts))
+
+
+# YCSB core-workload mixes (ref: the YCSB core package definitions;
+# yb-perf harness runs A/B/C on the 3-node RF=3 cluster). Probabilities
+# per op category; absent categories are 0.
+YCSB_MIXES = {
+    "a": {"read": 0.50, "update": 0.50},   # update-heavy
+    "b": {"read": 0.95, "update": 0.05},   # read-heavy
+    "c": {"read": 1.00},                   # read-only
+    "d": {"read": 0.95, "insert": 0.05},   # read-latest
+    "e": {"scan": 0.95, "insert": 0.05},   # short-range scans
+    "f": {"read": 0.50, "rmw": 0.50},      # read-modify-write
+}
+
+
+class YcsbLoadGenerator:
+    """Batched YCSB driver riding the PR-11 serve path: reads go through
+    the batched `multi_read` RPC (the PR-10 device point-read path under
+    it), writes coalesce through the YBSession batcher into per-tablet
+    group commits, scans ride the scan RPC page path (resident-slab scans
+    under it when the device cache is live), and F does read-modify-write
+    through the batcher. Unpaced like YcsbALoadGenerator: the measured
+    rate IS the sustainable throughput at this concurrency.
+
+    Latency accounting is per BATCH phase: every op in a batch completed
+    when its batch RPC(s) settled, so each phase contributes one
+    (latency, n_ops) sample and percentiles weight by op count — p99 is
+    the latency an op (not a batch) experiences at the 99th percentile.
+    """
+
+    def __init__(self, client: YBClient, table, mix: str = "b",
+                 n_threads: int = 4, key_space: int = 10_000,
+                 value_bytes: int = 64, batch_size: int = 512,
+                 scan_len: int = 50, follower_reads: bool = False):
+        if mix not in YCSB_MIXES:
+            raise ValueError(f"unknown YCSB mix {mix!r}")
+        self._client = client
+        self._table = table
+        self.mix = mix
+        self._probs = YCSB_MIXES[mix]
+        self._n_threads = n_threads
+        self._key_space = key_space
+        self._value = "v" * value_bytes
+        self._batch = batch_size
+        self._scan_len = scan_len
+        self._follower = follower_reads
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # weighted latency samples: (batch_ms, n_ops) per phase
+        self._samples: List[List[tuple]] = []
+        # [_, errors, reads, writes, scans, scan_rows, rmws] — phase
+        # helpers touch DISJOINT slots so the write flush can overlap
+        # the read batch on a side thread without racy counters
+        self._counts: List[List[int]] = []
+        self._insert_high = key_space  # D-mix "latest" insert cursor
+        self._insert_lock = threading.Lock()
+        self._t0 = 0.0
+        self._t1 = 0.0
+
+    @staticmethod
+    def _key(kid: int) -> str:
+        return f"u{kid:08d}"
+
+    def _sample_kid(self, rng) -> int:
+        if self.mix == "d":
+            # read-latest: prefer the most recently inserted tail
+            with self._insert_lock:
+                high = self._insert_high
+            if rng.random() < 0.8:
+                return high - 1 - rng.randrange(
+                    max(1, min(high, self._key_space // 5)))
+            return rng.randrange(high)
+        # hot-set skew: 80% of ops hit 20% of the key space
+        if rng.random() < 0.8:
+            return rng.randrange(max(1, self._key_space // 5))
+        return rng.randrange(self._key_space)
+
+    # -------------------------------------------------------------- preload
+    def load(self, n_keys: Optional[int] = None,
+             batch_size: int = 1024, retries: int = 5) -> int:
+        """Preload the key space through the batcher (the YCSB load
+        phase); returns rows written. Failed ops retry per the batcher's
+        per-op demux — a fresh cluster's election tail fails only the
+        groups that raced it, and only those ops are re-sent."""
+        from yugabyte_tpu.client.session import SessionFlushError
+        n = n_keys if n_keys is not None else self._key_space
+        session = YBSession(self._client, max_batch_ops=batch_size)
+        pending = [QLWriteOp(WriteOpKind.INSERT,
+                             DocKey(hash_components=(self._key(kid),)),
+                             {"v": self._value})
+                   for kid in range(n)]
+        for attempt in range(retries + 1):
+            for op in pending:
+                session.apply(self._table, op)
+            try:
+                session.flush()
+                return n
+            except SessionFlushError as e:
+                if attempt >= retries:
+                    raise
+                pending = [op for _t, op, _e in e.per_op]
+                time.sleep(0.5 * (attempt + 1))
+        return n
+
+    # -------------------------------------------------------------- workers
+    def _worker(self, wid: int) -> None:
+        import random
+        rng = random.Random(2000 + wid)
+        # the write phase runs on a side thread: give it its own rng and
+        # session so the read phase never shares either mid-tick
+        wrng = random.Random(3000 + wid)
+        session = YBSession(self._client)
+        samples = self._samples[wid]
+        cnt = self._counts[wid]
+        probs = self._probs
+        while not self._stop.is_set():
+            # draw this tick's batch composition from the mix
+            n_read = n_write = n_rmw = n_scan = 0
+            for _ in range(self._batch):
+                r = rng.random()
+                acc = 0.0
+                for kind, p in probs.items():
+                    acc += p
+                    if r < acc:
+                        break
+                if kind == "read":
+                    n_read += 1
+                elif kind == "rmw":
+                    n_rmw += 1
+                elif kind == "scan":
+                    # scans are RPC-bound per op: cap the per-tick count
+                    # so one tick stays responsive to stop()
+                    n_scan += 1
+                else:
+                    n_write += 1
+            writer = None
+            if n_write:
+                # overlap the write flush (raft replicate wall) with the
+                # read batch: the tick's wall time is max(write, read),
+                # not the sum
+                def _w(n=n_write):
+                    try:
+                        self._do_writes(wrng, session, n, samples, cnt)
+                    except StatusError:
+                        cnt[1] += 1
+                writer = threading.Thread(target=_w, daemon=True)
+                writer.start()
+            try:
+                if n_read:
+                    self._do_reads(rng, n_read, samples, cnt)
+                if n_rmw:
+                    self._do_rmw(rng, n_rmw, samples, cnt)
+                for _ in range(min(n_scan, 32)):
+                    self._do_scan(rng, samples, cnt)
+                    if self._stop.is_set():
+                        break
+            except StatusError:
+                cnt[1] += 1
+                time.sleep(0.05)
+            if writer is not None:
+                writer.join()
+
+    def _do_writes(self, rng, session, n: int, samples, cnt) -> None:
+        insert = "insert" in self._probs
+        t0 = time.monotonic()
+        for _ in range(n):
+            if insert:
+                with self._insert_lock:
+                    kid = self._insert_high
+                    self._insert_high += 1
+            else:
+                kid = self._sample_kid(rng)
+            session.apply(self._table, QLWriteOp(
+                WriteOpKind.INSERT,
+                DocKey(hash_components=(self._key(kid),)),
+                {"v": self._value}))
+        session.flush()
+        samples.append(((time.monotonic() - t0) * 1000.0, n))
+        cnt[3] += n
+
+    def _do_reads(self, rng, n: int, samples, cnt) -> None:
+        keys = [DocKey(hash_components=(self._key(self._sample_kid(rng)),))
+                for _ in range(n)]
+        t0 = time.monotonic()
+        self._client.multi_read(self._table, keys,
+                                follower_read=self._follower)
+        samples.append(((time.monotonic() - t0) * 1000.0, n))
+        cnt[2] += n
+
+    def _do_rmw(self, rng, n: int, samples, cnt) -> None:
+        session = YBSession(self._client)
+        keys = [DocKey(hash_components=(self._key(self._sample_kid(rng)),))
+                for _ in range(n)]
+        t0 = time.monotonic()
+        rows = self._client.multi_read(self._table, keys)
+        for dk_, row in zip(keys, rows):
+            prior = ""
+            if row is not None:
+                prior = row.to_dict(self._table.schema).get("v") or ""
+            session.apply(self._table, QLWriteOp(
+                WriteOpKind.INSERT, dk_,
+                {"v": (prior + "m")[-len(self._value):] or "m"}))
+        session.flush()
+        samples.append(((time.monotonic() - t0) * 1000.0, n))
+        cnt[6] += n
+
+    def _do_scan(self, rng, samples, cnt) -> None:
+        import itertools
+        t0 = time.monotonic()
+        rows = list(itertools.islice(
+            self._client.scan(self._table, page_size=self._scan_len),
+            self._scan_len))
+        samples.append(((time.monotonic() - t0) * 1000.0, 1))
+        cnt[4] += 1
+        cnt[5] += len(rows)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "YcsbLoadGenerator":
+        self._t0 = time.monotonic()
+        for i in range(self._n_threads):
+            self._samples.append([])
+            self._counts.append([0] * 7)
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True, name=f"ycsb-{self.mix}-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> YcsbReport:
+        self._t1 = time.monotonic()
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=60)
+        samples = sorted(s for ws in self._samples for s in ws)
+        reads = sum(c[2] for c in self._counts)
+        writes = sum(c[3] for c in self._counts)
+        scans = sum(c[4] for c in self._counts)
+        rmws = sum(c[6] for c in self._counts)
+        ops = reads + writes + scans + rmws  # an RMW is ONE logical op
+        secs = self._t1 - self._t0
+        total_w = sum(w for _ms, w in samples)
+
+        def pct(p: float) -> float:
+            """Op-weighted percentile over batch latencies: every op in
+            a batch experienced that batch's latency."""
+            if not samples:
+                return 0.0
+            target = p * total_w
+            seen = 0
+            for ms, w in samples:
+                seen += w
+                if seen >= target:
+                    return ms
+            return samples[-1][0]
+
+        return YcsbReport(
+            ops=ops, seconds=round(secs, 1),
+            ops_per_sec=round(ops / secs, 1) if secs else 0.0,
+            p50_ms=round(pct(0.50), 2), p99_ms=round(pct(0.99), 2),
+            errors=sum(c[1] for c in self._counts),
+            reads=reads + rmws,
+            writes=writes + rmws,
+            mix=self.mix,
+            scans=scans,
+            scan_rows=sum(c[5] for c in self._counts))

@@ -40,7 +40,9 @@ def main(argv=None) -> int:
     flags.set_flag("replication_factor", args.rf)
     # force flag registration before overriding (db/server modules define
     # their flags at import)
+    import yugabyte_tpu.consensus.raft  # noqa: F401
     import yugabyte_tpu.storage.db  # noqa: F401
+    import yugabyte_tpu.storage.offload_policy  # noqa: F401
     import yugabyte_tpu.tserver.server_context  # noqa: F401
     for kv in args.flag:
         name, _, value = kv.partition("=")

@@ -273,10 +273,7 @@ class DB:
                                  else [])
         out: List[Tuple[bytes, bytes]] = []
         for m in mems:
-            for ikey, v in m.iter_from(lower):
-                if ikey >= upper:
-                    break
-                out.append((ikey, v))
+            out.extend(m.entries_range(lower, upper))
         return out
 
     # ------------------------------------------------------- background error
@@ -716,20 +713,9 @@ class DB:
         mems = [m for m in mems if not m.empty]
         sst_hits = (rset.multi_get_many(keys, read_ht.value)
                     if rset.n else [None] * len(keys))
+        mem_hits = self._mem_probe_many(mems, keys, read_ht)
         out = []
-        for k, sh in zip(keys, sst_hits):
-            best = None  # (ht_value, wid, value)
-            if mems:
-                seek = make_internal_key(
-                    k, DocHybridTime(read_ht, 0xFFFFFFFF))
-                boundary = k + bytes([ValueType.kHybridTime])
-                for mem in mems:
-                    hit = mem.point_get(seek, boundary)
-                    if hit is not None:
-                        _, dht = split_key_and_ht(hit[0])
-                        cand = (dht.ht.value, dht.write_id, hit[1])
-                        if best is None or cand[:2] > best[:2]:
-                            best = cand
+        for sh, best in zip(sst_hits, mem_hits):
             if sh is not None:
                 ht_v, wid, _fl, val = sh
                 if best is None or (ht_v, wid) > best[:2]:
@@ -738,6 +724,27 @@ class DB:
                        (DocHybridTime(HybridTime(best[0]), best[1]),
                         best[2]))
         return out
+
+    @staticmethod
+    def _mem_probe_many(mems, keys, read_ht):
+        """Newest memtable candidate per key as (ht_value, wid, value),
+        via each memtable's BATCHED probe (one lock acquisition per
+        memtable instead of one per key — the per-key locking dominated
+        batched reads of memtable-resident rows)."""
+        if not mems:
+            return [None] * len(keys)
+        probes = [(make_internal_key(k, DocHybridTime(read_ht, 0xFFFFFFFF)),
+                   k + bytes([ValueType.kHybridTime])) for k in keys]
+        best = [None] * len(keys)
+        for mem in mems:
+            for i, hit in enumerate(mem.point_get_many(probes)):
+                if hit is None:
+                    continue
+                _, dht = split_key_and_ht(hit[0])
+                cand = (dht.ht.value, dht.write_id, hit[1])
+                if best[i] is None or cand[:2] > best[i][:2]:
+                    best[i] = cand
+        return best
 
     def _multi_get_device(self, keys, read_ht, doc_key_lens=None):
         """The batched device path, or None when this batch must take
@@ -882,6 +889,8 @@ class DB:
                               staged_by, best, exact_fallback, results):
         """Merge device SST winners with host memtable probes per key —
         newest (ht, wid) wins, exactly get()'s compare."""
+        live_mems = [m for m in mems if not m.empty]
+        mem_hits = self._mem_probe_many(live_mems, chunk, read_ht)
         for i, k in enumerate(chunk):
             if i in exact_fallback:
                 # learned-index misprediction beyond its bound: the
@@ -889,18 +898,7 @@ class DB:
                 # exactly (correctness never rides the model)
                 results[start + i] = self._get_inner(k, read_ht)
                 continue
-            mem_best = None
-            if mems:
-                seek = make_internal_key(
-                    k, DocHybridTime(read_ht, 0xFFFFFFFF))
-                boundary = k + bytes([ValueType.kHybridTime])
-                for mem in mems:
-                    hit = mem.point_get(seek, boundary)
-                    if hit is not None:
-                        _, dht = split_key_and_ht(hit[0])
-                        cand = (dht.ht.value, dht.write_id, hit[1])
-                        if mem_best is None or cand[:2] > mem_best[:2]:
-                            mem_best = cand
+            mem_best = mem_hits[i]
             if best is not None and best[4][i]:
                 ht_v = int(best[0][i])
                 wid_v = int(best[1][i])

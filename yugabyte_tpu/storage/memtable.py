@@ -83,6 +83,31 @@ class MemTable:
                     return k, self._data[k]
         return None
 
+    def entries_range(self, lower: bytes,
+                      upper: bytes) -> List[Tuple[bytes, bytes]]:
+        """(internal_key, value) with lower <= key < upper (the bounded
+        per-row probe of the batched read path; same contract as
+        NativeMemTable.entries_range)."""
+        with self._lock:
+            self._ensure_sorted_locked()
+            lo = bisect.bisect_left(self._keys, lower)
+            hi = bisect.bisect_left(self._keys, upper)
+            return [(k, self._data[k]) for k in self._keys[lo:hi]]
+
+    def point_get_many(self, probes) -> List[Optional[Tuple[bytes, bytes]]]:
+        """Batched point_get over [(seek, boundary), ...]: one lock/sort
+        for the whole probe list (the batched read path's per-key probe)."""
+        out: List[Optional[Tuple[bytes, bytes]]] = [None] * len(probes)
+        with self._lock:
+            self._ensure_sorted_locked()
+            keys = self._keys
+            n = len(keys)
+            for j, (seek, boundary) in enumerate(probes):
+                idx = bisect.bisect_left(keys, seek)
+                if idx < n and keys[idx].startswith(boundary):
+                    out[j] = (keys[idx], self._data[keys[idx]])
+        return out
+
     def _ensure_sorted_locked(self) -> None:
         if self._sorted_upto != len(self._keys):
             # add_batch defers duplicate-key suppression to here: one
@@ -251,6 +276,10 @@ class NativeMemTable:
         self._lock = threading.Lock()
         self.version = 0
         self._first_write_s: Optional[float] = None
+        # reusable export buffers + pre-cast pointers for the batched
+        # point-probe path (per-call numpy allocation + ctypes casts
+        # dominated multi-row reads); guarded-by: _lock
+        self._scratch = None
 
     def __del__(self):
         try:
@@ -327,18 +356,79 @@ class NativeMemTable:
             voffs.ctypes.data_as(_i64p))
         return keys, koffs, ht, wid, vals, voffs
 
+    def _export_one_locked(self, idx: int) -> Tuple[bytes, bytes]:
+        """Single-entry export through the reusable scratch buffers;
+        caller holds _lock. Returns (internal_key, value) copies."""
+        kb = _ct.c_int64()
+        vb = _ct.c_int64()
+        self._lib.mt_range_sizes(self._h, idx, idx + 1, _ct.c_int32(1),
+                                 _ct.byref(kb), _ct.byref(vb))
+        sc = self._scratch
+        if sc is None or sc[0].size < kb.value or sc[2].size < vb.value:
+            keys = _np.empty(max(4096, kb.value * 2), dtype=_np.uint8)
+            koffs = _np.zeros(2, dtype=_np.int64)
+            vals = _np.empty(max(65536, vb.value * 2), dtype=_np.uint8)
+            voffs = _np.zeros(2, dtype=_np.int64)
+            ht = _np.empty(1, dtype=_np.uint64)
+            wid = _np.empty(1, dtype=_np.uint32)
+            sc = self._scratch = (
+                keys, koffs, vals, voffs, ht, wid,
+                (keys.ctypes.data_as(_u8p), koffs.ctypes.data_as(_i64p),
+                 ht.ctypes.data_as(_u64p), wid.ctypes.data_as(_u32p),
+                 vals.ctypes.data_as(_u8p), voffs.ctypes.data_as(_i64p)))
+        kp, kop, htp, widp, vp, vop = sc[6]
+        self._lib.mt_export_range(self._h, idx, idx + 1, _ct.c_int32(1),
+                                  kp, kop, htp, widp, vp, vop)
+        return (sc[0][: sc[1][1]].tobytes(), sc[2][: sc[3][1]].tobytes())
+
     def point_get(self, seek: bytes, boundary: bytes
                   ) -> Optional[Tuple[bytes, bytes]]:
         with self._lock:
             idx = int(self._lib.mt_lower_bound(self._h, seek, len(seek)))
             if idx >= int(self._lib.mt_n(self._h)):
                 return None
-            keys, koffs, _ht, _wid, vals, voffs = \
-                self._export(idx, idx + 1, True)
-        ikey = keys[: koffs[1]].tobytes()
+            ikey, val = self._export_one_locked(idx)
         if not ikey.startswith(boundary):
             return None
-        return ikey, vals[: voffs[1]].tobytes()
+        return ikey, val
+
+    def point_get_many(self, probes) -> List[Optional[Tuple[bytes, bytes]]]:
+        """Batched point_get over [(seek, boundary), ...]: ONE lock
+        acquisition and scratch-buffer exports for the whole probe list
+        (the batched row read probes the memtable once per enumerated
+        key; per-call locking + allocation dominated it)."""
+        out: List[Optional[Tuple[bytes, bytes]]] = [None] * len(probes)
+        with self._lock:
+            total = int(self._lib.mt_n(self._h))
+            if total == 0:
+                return out
+            for j, (seek, boundary) in enumerate(probes):
+                idx = int(self._lib.mt_lower_bound(self._h, seek,
+                                                   len(seek)))
+                if idx >= total:
+                    continue
+                ikey, val = self._export_one_locked(idx)
+                if ikey.startswith(boundary):
+                    out[j] = (ikey, val)
+        return out
+
+    def entries_range(self, lower: bytes,
+                      upper: bytes) -> List[Tuple[bytes, bytes]]:
+        """(internal_key, value) with lower <= key < upper in ONE bounded
+        export. The batched row probe calls this once per row; iter_from
+        would export a full 4096-entry batch to answer a range that holds
+        a handful of entries, which dominated the multi-row read wall
+        time."""
+        with self._lock:
+            lo = int(self._lib.mt_lower_bound(self._h, lower, len(lower)))
+            hi = int(self._lib.mt_lower_bound(self._h, upper, len(upper)))
+            if lo >= hi:
+                return []
+            keys, koffs, _ht, _wid, vals, voffs = \
+                self._export(lo, hi, True)
+        return [(keys[koffs[i]: koffs[i + 1]].tobytes(),
+                 vals[voffs[i]: voffs[i + 1]].tobytes())
+                for i in range(hi - lo)]
 
     def iter_from(self, seek_key: bytes = b""
                   ) -> Iterator[Tuple[bytes, bytes]]:

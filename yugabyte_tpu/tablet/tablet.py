@@ -333,6 +333,19 @@ class Tablet:
             lock_batch.release()
         self.metric_rows_inserted.increment(len(ops))
         self.metric_write_latency.increment((time.monotonic() - t0) * 1e6)
+        # group-commit accounting: this batch rode ONE raft replicate /
+        # WAL append / apply_write_batch regardless of its op count
+        from yugabyte_tpu.utils.metrics import serve_path_metrics
+        m = serve_path_metrics()
+        m.counter("write_group_commit_total",
+                  "write batches replicated as ONE raft entry").increment()
+        m.histogram("write_batch_rows",
+                    "rows per group-committed write batch").increment(
+            len(ops))
+        if len(ops) > 1:
+            m.counter("write_batch_coalesced_ops_total",
+                      "ops that rode a multi-op group commit").increment(
+                len(ops))
         return ht
 
     def apply_external_batch(self, kvs: Sequence[Sequence],
@@ -553,11 +566,24 @@ class Tablet:
                 or self.regular_db.has_deep_files():
             return [self.read_row(dk, ht, projection, txn_id=txn_id)
                     for dk in doc_keys]
-        from yugabyte_tpu.docdb.doc_key import SubDocKey
-        from yugabyte_tpu.docdb.doc_operations import kLivenessColumnId
+        from yugabyte_tpu.docdb.doc_operations import (column_key_suffix,
+                                                       kLivenessColumnId)
         schema = self.schema
         cids = [kLivenessColumnId] + [schema.column_id(c.name)
                                       for c in schema.value_columns]
+        suffixes = [column_key_suffix(cid) for cid in cids]
+        cid_by_suffix = dict(zip(suffixes, cids))
+        # projection names -> ids ONCE per batch (mirrors
+        # VisibleEntryRowAssembler: unknown names never match)
+        proj_ids = None
+        if projection is not None:
+            proj_ids = set()
+            for cname in projection:
+                try:
+                    proj_ids.add(cname if isinstance(cname, int)
+                                 else schema.column_id(cname))
+                except KeyError:
+                    pass
         keys: list = []
         dkls: list = []
         spans = []          # per doc key: (start, count) into keys
@@ -569,9 +595,7 @@ class Tablet:
             enc = dk.encode()
             encs.append(enc)
             upper = enc + bytes([ValueType.kMaxByte])
-            enumerated = sorted(
-                [enc] + [SubDocKey(dk, (("col", cid),)).encode(
-                    include_ht=False) for cid in cids])
+            enumerated = sorted([enc] + [enc + s for s in suffixes])
             enum_set = set(enumerated)
             # memtable probe: recent writes at non-enumerated subkeys
             # (deep documents, unknown cids) make this row non-flat
@@ -594,42 +618,57 @@ class Tablet:
             start, count = spans[ri]
             rows.append(self._assemble_flat_row(
                 dk, encs[ri], row_keys_by[ri],
-                results[start: start + count], ht, projection))
+                results[start: start + count], ht, proj_ids,
+                cid_by_suffix))
         return rows
 
     def _assemble_flat_row(self, doc_key, enc: bytes, row_keys,
-                           row_results, ht: HybridTime, projection):
+                           row_results, ht: HybridTime, proj_ids,
+                           cid_by_suffix):
         """RESOLVE + ASSEMBLE one flat row from exact-key probe results,
-        mirroring DocRowwiseIterator._resolve_visible for depth <= 1:
-        the newest visible version per path is already in hand (multi_get
-        semantics); drop tombstones/expired values, apply the bare-DocKey
-        overwrite point, and feed the survivors to the shared
-        VisibleEntryRowAssembler."""
-        from yugabyte_tpu.docdb.doc_rowwise_iterator import (
-            VisibleEntryRowAssembler, _is_expired)
+        mirroring DocRowwiseIterator._resolve_visible +
+        VisibleEntryRowAssembler for depth <= 1: the newest visible
+        version per path is already in hand (multi_get semantics); drop
+        tombstones/expired values, apply the bare-DocKey overwrite
+        point, then build the Row DIRECTLY — every probe key came from
+        our own enumeration, so its column id is the suffix we appended
+        (no SubDocKey re-decode per entry)."""
+        from yugabyte_tpu.docdb.doc_operations import kLivenessColumnId
+        from yugabyte_tpu.docdb.doc_rowwise_iterator import Row, _is_expired
         from yugabyte_tpu.docdb.value import Value as DocValue
         bare_dht = None
         for k, res in zip(row_keys, row_results):
             if res is not None and k == enc:
                 bare_dht = res[0]
-        survivors = []
+        columns = {}
+        liveness = False
+        max_ht = 0
+        n_enc = len(enc)
         for k, res in zip(row_keys, row_results):
             if res is None:
                 continue
             dht, raw = res
             value = DocValue.decode(raw)
-            dead = (value.is_tombstone or _is_expired(value, dht, ht)
+            if (value.is_tombstone or _is_expired(value, dht, ht)
                     or (k != enc and bare_dht is not None
-                        and dht < bare_dht))
-            if not dead:
-                survivors.append((k, raw, dht.ht.value))
-        if not survivors:
+                        and dht < bare_dht)):
+                continue
+            ht_value = dht.ht.value
+            if ht_value > max_ht:
+                max_ht = ht_value
+            if k == enc:
+                liveness = True  # visible init marker
+                continue
+            cid = cid_by_suffix[k[n_enc:]]
+            liveness = True  # any visible column proves the row exists
+            if cid == kLivenessColumnId:
+                continue
+            if proj_ids is not None and cid not in proj_ids:
+                continue
+            columns[cid] = {} if value.is_object else value.primitive
+        if not liveness:
             return None
-        asm = VisibleEntryRowAssembler(iter(survivors), self.schema,
-                                       projection=projection)
-        for row in asm.rows():
-            return row
-        return None
+        return Row(doc_key, columns, HybridTime(max_ht))
 
     def _entry_stream(self, ht: HybridTime, lower: bytes,
                       upper: Optional[bytes], txn_id: Optional[bytes]):

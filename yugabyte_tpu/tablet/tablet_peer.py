@@ -35,9 +35,17 @@ from yugabyte_tpu.consensus.raft import (
     OP_SNAPSHOT, OP_SPLIT, OP_UPDATE_TXN, OP_WRITE, NotLeader,
     OperationOutcomeUnknown, RaftConfig, RaftConsensus, ReplicateMsg,
     ReplicationTimedOut, Role)
+from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+
+flags.define_flag(
+    "follower_read_vouch_ttl_s", 900.0,
+    "a digest-exchange vouch lets this replica serve follower reads for "
+    "this long; must outlast the exchange cadence (scrub_interval_s) so "
+    "a healthy follower stays continuously vouched, while a replica the "
+    "exchange stops vouching for ages out")
 
 # Tablet peer states (the reference's RaftGroupStatePB subset that matters
 # for failure containment, ref tablet/metadata.proto + tablet_peer.cc
@@ -202,6 +210,13 @@ class TabletPeer:
         # last at-rest scrub of this replica (wall ts + totals), set by
         # the ScrubTabletsOp; {} until the first scrub
         self.scrub_state: dict = {}
+        # Follower-read gate (ROADMAP item 1 safety rail): a follower may
+        # serve bounded-staleness reads ONLY while it holds a live vouch
+        # from the leader's cross-replica digest exchange (PR 8) — the
+        # exchange proved this replica's resolved rows match the
+        # leader's. 0.0 = never vouched. monotonic deadline.
+        self._vouched_until = 0.0
+        self._vouch_read_ht = 0  # read_ht the vouching digest was taken at
         for db in (self.tablet.regular_db, self.tablet.intents_db):
             db.on_background_error = self._on_storage_error
         self.log.on_io_error = self._on_log_error
@@ -289,6 +304,9 @@ class TabletPeer:
             return
         self.state = STATE_FAILED
         self.failed_status = status
+        # a parked replica's data is suspect by definition: drop any
+        # follower-read license it still holds
+        self._vouched_until = 0.0
         self.tablet.cancel_background_work(
             f"tablet {self.tablet_id} FAILED: {status}")
         TRACE("tablet %s FAILED: %s", self.tablet_id, status)
@@ -456,6 +474,64 @@ class TabletPeer:
                 raise NotLeader(self.raft.leader_hint())
             time.sleep(0.002)
 
+    # ----------------------------------------------- follower-read vouching
+    def grant_vouch(self, read_ht_value: int = 0) -> None:
+        """The leader's digest exchange verified this replica's resolved
+        rows match its own: license follower reads for the vouch TTL
+        (re-granted every clean exchange round, so a replica that starts
+        diverging ages out even before the strike path FAILs it)."""
+        from yugabyte_tpu.utils.metrics import serve_path_metrics
+        self._vouched_until = time.monotonic() + flags.get_flag(
+            "follower_read_vouch_ttl_s")
+        self._vouch_read_ht = max(self._vouch_read_ht, read_ht_value)
+        serve_path_metrics().counter(
+            "follower_read_vouches_total",
+            "digest-exchange vouches granted to this server's "
+            "replicas").increment()
+
+    def revoke_vouch(self) -> None:
+        self._vouched_until = 0.0
+
+    def is_vouched(self) -> bool:
+        return time.monotonic() < self._vouched_until
+
+    def _check_follower_read_allowed(self) -> None:
+        """A follower without a live digest vouch must NOT serve reads —
+        push the client to another replica (retryably) instead of
+        answering from state nobody has cross-checked. A FAILED replica
+        never serves regardless of any vouch it still holds."""
+        from yugabyte_tpu.utils.metrics import serve_path_metrics
+        self._check_not_failed()
+        m = serve_path_metrics()
+        if not self.is_vouched():
+            m.counter(
+                "follower_read_unvouched_rejects_total",
+                "follower reads refused because the replica holds no "
+                "live digest vouch").increment()
+            err = StatusError(Status.ServiceUnavailable(
+                f"replica {self.server_id}/{self.tablet_id} holds no "
+                f"live digest vouch; read from another replica"))
+            err.extra = {"follower_unvouched": True}
+            raise err
+        m.counter("follower_reads_total",
+                  "reads served by a vouched follower replica").increment()
+
+    def _follower_wait_safe_time(self, read_ht: HybridTime,
+                                 timeout_s: float = 1.0) -> None:
+        """Same repeatable-read guarantee as the leader path — but bounded
+        SHORT: a follower whose propagated safe time lags the (already
+        stale) read point answers retryably so the client's replica walk
+        moves on, instead of pinning the RPC on a 10s MVCC wait."""
+        try:
+            self.tablet.mvcc.safe_time(min_allowed=read_ht,
+                                       timeout_s=timeout_s)
+        except TimeoutError as e:
+            err = StatusError(Status.ServiceUnavailable(
+                f"replica {self.server_id}/{self.tablet_id} safe time "
+                f"behind read point; read from another replica"))
+            err.extra = {"follower_lagging": True}
+            raise err from e
+
     def read_row(self, doc_key, read_ht: Optional[HybridTime] = None,
                  projection=None, allow_follower: bool = False,
                  txn_id: Optional[bytes] = None):
@@ -465,10 +541,12 @@ class TabletPeer:
                                         txn_id=txn_id)
         if not allow_follower:
             raise NotLeader(self.raft.leader_hint())
+        self._check_follower_read_allowed()
         if read_ht is not None:
-            # Wait until the propagated safe time covers the requested read
-            # point — same repeatable-read guarantee as the leader path.
-            self.tablet.mvcc.safe_time(min_allowed=read_ht)
+            # Wait (briefly) until the propagated safe time covers the
+            # requested read point — same repeatable-read guarantee as
+            # the leader path, minus the long stall.
+            self._follower_wait_safe_time(read_ht)
             ht = read_ht
         else:
             ht = self.tablet.mvcc.safe_time_for_follower()
@@ -488,10 +566,11 @@ class TabletPeer:
                                           txn_id=txn_id)
         if not allow_follower:
             raise NotLeader(self.raft.leader_hint())
+        self._check_follower_read_allowed()
         if read_ht is not None:
             # same repeatable-read guarantee as the follower read_row:
-            # wait until the propagated safe time covers the read point
-            self.tablet.mvcc.safe_time(min_allowed=read_ht)
+            # bounded wait for propagated safe time to cover the point
+            self._follower_wait_safe_time(read_ht)
             ht = read_ht
         else:
             ht = self.tablet.mvcc.safe_time_for_follower()

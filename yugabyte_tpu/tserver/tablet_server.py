@@ -145,6 +145,10 @@ class TabletServer:
             # /integrityz: shadow-verification + scrub + quarantine state
             # (the data-integrity loop's single pane of glass)
             self.webserver.register_json("/integrityz", self.integrityz)
+            # /servez: the batched serve path — group-commit write
+            # batching, client-batch coalescing and follower-read
+            # vouch accounting (ROADMAP item 1)
+            self.webserver.register_json("/servez", self.servez)
 
     def _tablet_peers(self):
         return self.tablet_manager.peers()
@@ -217,6 +221,25 @@ class TabletServer:
             out["device_cache"] = ctx.device_cache.snapshot()
         return out
 
+    def servez(self) -> dict:
+        """Serve-path state: group-commit write batching (one raft
+        replicate / WAL fsync per batch), batched point-read counters,
+        and per-replica follower-read vouch status."""
+        from yugabyte_tpu.ops.point_read import point_read_snapshot
+        from yugabyte_tpu.utils.metrics import serve_path_snapshot
+        tablets = []
+        for peer in self.tablet_manager.peers():
+            tablets.append({
+                "tablet_id": peer.tablet_id,
+                "role": peer.raft.role.value,
+                "vouched": peer.is_vouched(),
+                "vouch_read_ht": peer._vouch_read_ht,
+            })
+        return {"server_id": self.server_id,
+                "serve_path": serve_path_snapshot(),
+                "point_reads": point_read_snapshot(),
+                "tablets": tablets}
+
     def integrityz(self) -> dict:
         """Data-integrity state: shadow-verify sampling + mismatch
         counters, scrubber totals, quarantined files, and per-tablet
@@ -286,6 +309,20 @@ class TabletServer:
             if remote["checksum"] == local["checksum"]:
                 with self._addr_lock:
                     self._digest_strikes.pop(key, None)
+                # matching digest = follower-read license: the replica's
+                # resolved rows provably agree with the leader's at
+                # read_ht, so bounded-staleness reads may land there
+                # until the vouch TTL lapses (ROADMAP item 1 safety rail)
+                try:
+                    self.messenger.call(
+                        addr, "tserver", "vouch_tablet", timeout_s=10.0,
+                        tablet_id=tablet_id, read_ht=read_ht)
+                except StatusError as e:
+                    # vouch is an optimization, never correctness: an
+                    # unreachable follower just stays unvouched and keeps
+                    # refusing follower reads until the next clean round
+                    TRACE("scrub digest: vouch of %s on %s failed: %s",
+                          tablet_id, sid, e)
                 continue
             mismatches += 1
             replica_mismatch_counter().increment()

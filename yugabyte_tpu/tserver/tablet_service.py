@@ -546,6 +546,15 @@ class TabletServiceImpl:
         peer = self._tablets.get_tablet(tablet_id)
         return peer.tablet.scrub(limiter=integrity.scrub_rate_limiter())
 
+    def vouch_tablet(self, tablet_id: str, read_ht: int = 0) -> bool:
+        """Leader-driven follower-read license: the caller (the digest
+        exchange on the tablet's leader, tablet_server.py
+        _scrub_digest_check) verified this replica's resolved rows match
+        the leader's at read_ht. Valid for follower_read_vouch_ttl_s;
+        re-granted every clean exchange round."""
+        self._tablets.get_tablet(tablet_id).grant_vouch(read_ht)
+        return True
+
     def mark_tablet_failed(self, tablet_id: str, reason: str,
                            corrupt: bool = False) -> bool:
         """Externally-driven FAILED transition: the scrub digest

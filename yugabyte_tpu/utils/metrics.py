@@ -299,6 +299,47 @@ def kernel_metrics() -> MetricEntity:
     return ROOT_REGISTRY.entity("server", "kernels")
 
 
+def serve_path_metrics() -> MetricEntity:
+    """The process-wide entity of the batched serve path: group-commit
+    writes (tablet/tablet.py), client-batcher coalescing, and
+    follower-read gating (tablet/tablet_peer.py). Surfaced as the
+    serve-path block on /servez."""
+    return ROOT_REGISTRY.entity("server", "serve_path")
+
+
+def serve_path_snapshot() -> Dict[str, object]:
+    """JSON-ready snapshot of the serve-path counters/histograms for
+    /servez: group-commit totals + batch-size distribution + follower-
+    read accept/reject accounting."""
+    e = serve_path_metrics()
+    batch = e.histogram("write_batch_rows",
+                        "rows per group-committed write batch")
+    return {
+        "write_group_commit_total": e.counter(
+            "write_group_commit_total",
+            "write batches replicated as ONE raft entry").value(),
+        "write_batch_coalesced_ops_total": e.counter(
+            "write_batch_coalesced_ops_total",
+            "ops that rode a multi-op group commit").value(),
+        "write_batch_rows": {
+            "count": batch.count(), "mean": round(batch.mean(), 2),
+            "max": batch.max(),
+            "p50": round(batch.percentile(50), 1),
+            "p99": round(batch.percentile(99), 1)},
+        "follower_reads_total": e.counter(
+            "follower_reads_total",
+            "reads served by a vouched follower replica").value(),
+        "follower_read_unvouched_rejects_total": e.counter(
+            "follower_read_unvouched_rejects_total",
+            "follower reads refused because the replica holds no live "
+            "digest vouch").value(),
+        "follower_read_vouches_total": e.counter(
+            "follower_read_vouches_total",
+            "digest-exchange vouches granted to this server's "
+            "replicas").value(),
+    }
+
+
 def publish_compile_surface(counts: Dict[str, int]) -> None:
     """Per-kernel-family compile-surface gauges from the committed
     manifest (tools/analysis/kernel_manifest.json): how many distinct
