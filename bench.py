@@ -1153,6 +1153,14 @@ def _ycsb_stage() -> dict:
                 "point_read_batched": False,
                 "raft_heartbeat_interval_ms": 100,
                 "leader_failure_max_missed_heartbeat_periods": 20,
+                # overload-protection knobs (PR 12) pinned explicitly so
+                # the trajectory measures a known shedding config: the
+                # bounded RPC queue and write-pressure limits are ACTIVE
+                # during the mixes and their counters are recorded below
+                "rpc_service_queue_depth": 512,
+                "wal_backlog_soft_entries": 512,
+                "wal_backlog_hard_entries": 4096,
+                "memstore_reject_fraction": 0.95,
             }).start()
         c.wait_tservers_alive(3)
         client = c.new_client()
@@ -1186,6 +1194,36 @@ def _ycsb_stage() -> dict:
         if "ycsb_b_ops_per_sec" in out:
             out["ycsb_p50_ms"] = out["ycsb_b_p50_ms"]
             out["ycsb_p99_ms"] = out["ycsb_b_p99_ms"]
+        # overload counters (PR 12): scrape every tserver's /servez
+        # overload block over the overload_status RPC and record the
+        # shedding totals, so throttling is VISIBLE in the trajectory —
+        # a future rung whose ops/s rises while rejections explode is
+        # shedding its way to the number, not serving it
+        shed = {"write_throttle_rejections_total": 0,
+                "rpc_queue_overflow_total": 0,
+                "rpc_calls_expired_in_queue_total": 0}
+        for ts in c.tservers:
+            try:
+                ov = client._messenger.call(
+                    ts.address, "tserver", "overload_status",
+                    timeout_s=10.0)["overload"]
+            except Exception as e:  # noqa: BLE001 — scrape is best-effort
+                log(f"  overload scrape of {ts.address} failed: {e}")
+                continue
+            shed["write_throttle_rejections_total"] += ov.get(
+                "write_throttle_rejections_total", 0)
+            rpc = ov.get("rpc", {})
+            shed["rpc_queue_overflow_total"] += rpc.get(
+                "rpc_queue_overflow_total", 0)
+            shed["rpc_calls_expired_in_queue_total"] += rpc.get(
+                "rpc_calls_expired_in_queue_total", 0)
+        for k, v in shed.items():
+            out[f"ycsb_{k}"] = v
+        out["ycsb_retry_budget_exhaustions_total"] = \
+            client.retry_budget.exhausted_total
+        out["ycsb_retries_spent_total"] = client.retry_budget.spent_total
+        log(f"  overload: {shed}, retry_budget_exhaustions="
+            f"{client.retry_budget.exhausted_total}")
     except Exception as e:  # noqa: BLE001 — stage is best-effort
         log(f"ycsb stage failed: {e}")
     finally:

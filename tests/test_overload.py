@@ -1,0 +1,583 @@
+"""Overload-protection unit tests (PR 12): bounded RPC service queue
+(overflow -> typed retryable Overloaded + measured retry_after_ms hint,
+deadline-expired queued calls dropped unexecuted, shutdown fails queued
+calls immediately), Backoff honoring server retry_after hints, the
+per-client retry-budget token bucket, YBSession's buffered-bytes
+admission cap, and the unified write-pressure state machine."""
+
+import threading
+import time
+
+import pytest
+
+from yugabyte_tpu.rpc.messenger import (Messenger, Overloaded,
+                                        RemoteError, RpcTimeout,
+                                        ServiceUnavailable,
+                                        is_overloaded_error)
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.backoff import (Backoff, RetryBudget,
+                                        RetryBudgetExhausted)
+from yugabyte_tpu.utils.status import Code, Status, StatusError
+
+
+class _FlagScope:
+    def __init__(self, **kv):
+        self.kv = kv
+        self.old = {}
+
+    def __enter__(self):
+        for k, v in self.kv.items():
+            self.old[k] = flags.get_flag(k)
+            flags.set_flag(k, v)
+        return self
+
+    def __exit__(self, *a):
+        for k, v in self.old.items():
+            flags.set_flag(k, v)
+
+
+class _GatedService:
+    """Handlers park on an event so tests can clog the service pool
+    deterministically and observe what queued calls do."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.ran = []          # mth args that actually EXECUTED
+        self.lock = threading.Lock()
+
+    def blocked(self, tag):
+        with self.lock:
+            self.ran.append(tag)
+        self.gate.wait(timeout=30)
+        return tag
+
+    def quick(self, tag):
+        with self.lock:
+            self.ran.append(tag)
+        return tag
+
+    def overloaded_once(self, state={"n": 0}):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise Overloaded("write-pressure hard limit; retry later",
+                             retry_after_ms=123, throttle="memstore")
+        return state["n"]
+
+
+# --------------------------------------------------------------- RPC queue
+def test_queue_overflow_returns_typed_overloaded_with_hint():
+    with _FlagScope(rpc_service_pool_threads=1,
+                    rpc_service_queue_depth=1):
+        server = Messenger("ovf-server")
+        svc = _GatedService()
+        server.register_service("gated", svc)
+        client = Messenger("ovf-client")
+        try:
+            errs = []
+
+            def bg(tag):
+                try:
+                    client.call(server.address, "gated", "blocked",
+                                timeout_s=30, tag=tag)
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    errs.append(e)
+
+            # call 1 occupies the single worker; call 2 fills the queue
+            t1 = threading.Thread(target=bg, args=("a",), daemon=True)
+            t1.start()
+            deadline = time.monotonic() + 5
+            while not svc.ran and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert svc.ran == ["a"]
+            t2 = threading.Thread(target=bg, args=("b",), daemon=True)
+            t2.start()
+            deadline = time.monotonic() + 5
+            while server._service_pool.queue_len() < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # call 3 overflows: typed retryable Overloaded NOW, not a
+            # 30s queue-wait
+            t0 = time.monotonic()
+            with pytest.raises(RemoteError) as ei:
+                client.call(server.address, "gated", "blocked",
+                            timeout_s=30, tag="c")
+            assert time.monotonic() - t0 < 5
+            e = ei.value
+            assert e.status.code == Code.BUSY
+            assert e.extra.get("overloaded") is True
+            assert e.extra.get("retry_after_ms") >= 10
+            assert is_overloaded_error(e)
+            assert server._c_queue_overflow.value() == 1
+            # the overflowed call never executed
+            svc.gate.set()
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+            assert not errs
+            assert sorted(svc.ran) == ["a", "b"]
+        finally:
+            svc.gate.set()
+            client.shutdown()
+            server.shutdown()
+
+
+def test_deadline_expired_queued_calls_never_execute():
+    with _FlagScope(rpc_service_pool_threads=1,
+                    rpc_service_queue_depth=64):
+        server = Messenger("exp-server")
+        svc = _GatedService()
+        server.register_service("gated", svc)
+        client = Messenger("exp-client")
+        try:
+            t1 = threading.Thread(
+                target=lambda: client.call(server.address, "gated",
+                                           "blocked", timeout_s=30,
+                                           tag="clog"),
+                daemon=True)
+            t1.start()
+            deadline = time.monotonic() + 5
+            while not svc.ran and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # short-deadline call lands in the queue behind the clog and
+            # times out CLIENT-side while still queued
+            with pytest.raises(RpcTimeout):
+                client.call(server.address, "gated", "quick",
+                            timeout_s=0.3, tag="expired")
+            time.sleep(0.1)   # let the expiry fully lapse server-side
+            svc.gate.set()    # unclog: the worker now drains the queue
+            t1.join(timeout=10)
+            deadline = time.monotonic() + 5
+            while server._c_expired_in_queue.value() < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # counted, and provably never executed
+            assert server._c_expired_in_queue.value() == 1
+            assert "expired" not in svc.ran
+            # queue-time histogram recorded next to the duration one
+            qh = server._method_histogram("gated", "quick", kind="queue")
+            assert qh.count() >= 1
+        finally:
+            svc.gate.set()
+            client.shutdown()
+            server.shutdown()
+
+
+def test_shutdown_fails_queued_inbound_calls_immediately():
+    """Satellite regression (inbound mirror of the PR-1 outbound close
+    fix): Messenger.shutdown() must answer queued-but-not-executing
+    inbound calls NOW instead of executing them against torn-down
+    services or silently dropping them into a full client timeout."""
+    with _FlagScope(rpc_service_pool_threads=1,
+                    rpc_service_queue_depth=64):
+        server = Messenger("shut-server")
+        svc = _GatedService()
+        server.register_service("gated", svc)
+        client = Messenger("shut-client")
+        out = {}
+        try:
+            def clog():
+                try:
+                    client.call(server.address, "gated", "blocked",
+                                timeout_s=30, tag="clog")
+                except (RemoteError, ServiceUnavailable, RpcTimeout):
+                    pass   # in-flight call torn down by shutdown: fine
+
+            t1 = threading.Thread(target=clog, daemon=True)
+            t1.start()
+            deadline = time.monotonic() + 5
+            while not svc.ran and time.monotonic() < deadline:
+                time.sleep(0.01)
+
+            def bg_queued():
+                t0 = time.monotonic()
+                try:
+                    client.call(server.address, "gated", "quick",
+                                timeout_s=30, tag="queued")
+                    out["result"] = "ok"
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    out["err"] = e
+                out["elapsed"] = time.monotonic() - t0
+
+            t2 = threading.Thread(target=bg_queued, daemon=True)
+            t2.start()
+            deadline = time.monotonic() + 5
+            while server._service_pool.queue_len() < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            server.shutdown()
+            t2.join(timeout=10)
+            assert not t2.is_alive(), "queued caller still waiting"
+            # failed immediately (not its 30s timeout), never executed
+            assert out.get("err") is not None, out
+            assert out["elapsed"] < 10
+            assert isinstance(out["err"],
+                              (RemoteError, ServiceUnavailable))
+            if isinstance(out["err"], RemoteError):
+                assert out["err"].extra.get("shutting_down") is True
+                assert out["err"].status.code == Code.SERVICE_UNAVAILABLE
+            assert "queued" not in svc.ran
+            assert server._c_shed_at_shutdown.value() == 1
+        finally:
+            svc.gate.set()
+            client.shutdown()
+
+
+def test_overloaded_error_crosses_wire_with_extras():
+    server = Messenger("ow-server")
+    server.register_service("gated", _GatedService())
+    client = Messenger("ow-client")
+    try:
+        with pytest.raises(RemoteError) as ei:
+            client.call(server.address, "gated", "overloaded_once")
+        assert ei.value.status.code == Code.BUSY
+        assert ei.value.extra["overloaded"] is True
+        assert ei.value.extra["retry_after_ms"] == 123
+        assert ei.value.extra["throttle"] == "memstore"
+        # second call: pressure relieved
+        assert client.call(server.address, "gated",
+                           "overloaded_once") == 2
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+# ------------------------------------------------------------ Backoff hints
+def test_backoff_honors_retry_after_hint():
+    b = Backoff(base_s=0.01, cap_s=0.05, rng=None)
+    b.note_server_hint(700)
+    d = b.next_delay()
+    assert d >= 0.7            # hint floors the delay, even past cap_s
+    assert b.next_delay() <= 0.05   # consumed: back to jittered/capped
+
+
+def test_backoff_hint_clamped_to_deadline():
+    b = Backoff(base_s=0.01, cap_s=0.05, deadline_s=0.2)
+    b.note_server_hint(5000)
+    assert b.next_delay() <= 0.2 + 1e-6
+
+
+def test_backoff_hint_takes_max_of_hints():
+    b = Backoff(base_s=0.01, cap_s=0.05)
+    b.note_server_hint(100)
+    b.note_server_hint(400)
+    b.note_server_hint(200)
+    assert 0.4 <= b.next_delay() < 0.5
+
+
+# ------------------------------------------------------------- retry budget
+def test_retry_budget_exhaustion_is_typed():
+    rb = RetryBudget(capacity=2, refill_per_s=0.0)
+    assert rb.try_spend() and rb.try_spend()
+    with pytest.raises(RetryBudgetExhausted) as ei:
+        rb.spend_or_raise("write tablet t1", last_err="NOT_LEADER")
+    e = ei.value
+    assert isinstance(e, StatusError)
+    assert e.status.code == Code.BUSY
+    assert e.extra["overloaded"] and e.extra["retry_budget_exhausted"]
+    assert "NOT_LEADER" in str(e)
+    assert rb.exhausted_total == 1 and rb.spent_total == 2
+
+
+def test_retry_budget_refills_over_time():
+    rb = RetryBudget(capacity=1, refill_per_s=50.0)
+    assert rb.try_spend()
+    assert not rb.try_spend()
+    time.sleep(0.05)
+    assert rb.try_spend()   # ~2.5 tokens refilled, capped at 1
+
+
+def test_client_walk_draws_from_budget_and_honors_hint():
+    """_tablet_call through a stub messenger: an overloaded rejection is
+    retried AFTER at least the server's retry_after hint, and once the
+    budget is dry the walk surfaces RetryBudgetExhausted instead of
+    burning all retry rounds."""
+    from yugabyte_tpu.client.client import YBClient
+
+    class _StubTablet:
+        tablet_id = "t1"
+
+        class partition:
+            start = b""
+
+        @staticmethod
+        def candidate_addrs():
+            return ["127.0.0.1:1"]
+
+        @staticmethod
+        def mark_leader(addr):
+            pass
+
+    class _StubTable:
+        table_id = "tbl"
+        name = "tbl"
+
+    class _StubMessenger:
+        def __init__(self, fail_n, retry_after_ms):
+            self.calls = []
+            self.fail_n = fail_n
+            self.retry_after_ms = retry_after_ms
+
+        def call(self, addr, svc, mth, timeout_s=None, **args):
+            self.calls.append(time.monotonic())
+            if len(self.calls) <= self.fail_n:
+                raise RemoteError(
+                    Status(Code.BUSY, "queue full; retry later"),
+                    extra={"overloaded": True,
+                           "retry_after_ms": self.retry_after_ms})
+            return {"ok": True}
+
+        def shutdown(self):
+            pass
+
+    class _StubMeta:
+        @staticmethod
+        def lookup_tablet(table_id, pk, refresh=False):
+            return _StubTablet()
+
+    # hint honored: one rejection, then success after >= 400ms
+    stub = _StubMessenger(fail_n=1, retry_after_ms=400)
+    client = YBClient([], messenger=stub)
+    client.meta_cache = _StubMeta()
+    t0 = time.monotonic()
+    ret = client._tablet_call(_StubTable(), _StubTablet(), "write",
+                              refresh_key=b"")
+    assert ret == {"ok": True} and len(stub.calls) == 2
+    assert time.monotonic() - t0 >= 0.4
+
+    # budget exhaustion surfaces typed, before the 12 retry rounds
+    with _FlagScope(client_retry_budget_tokens=2,
+                    client_retry_budget_refill_per_s=0.0):
+        stub = _StubMessenger(fail_n=99, retry_after_ms=10)
+        client = YBClient([], messenger=stub)
+        client.meta_cache = _StubMeta()
+        with pytest.raises(RetryBudgetExhausted):
+            client._tablet_call(_StubTable(), _StubTablet(), "write",
+                                refresh_key=b"")
+        assert len(stub.calls) == 3   # first attempt free + 2 budgeted
+
+
+# ------------------------------------------------------------- session cap
+class _FakePartition:
+    start = b""
+
+
+class _FakeTablet:
+    tablet_id = "ft1"
+    partition = _FakePartition()
+
+
+class _FakeMetaCache:
+    def lookup_tablet(self, table_id, pk, refresh=False):
+        return _FakeTablet()
+
+
+class _FakeTable:
+    table_id = "ftbl"
+    name = "ftbl"
+
+    @staticmethod
+    def partition_key_for(dk):
+        return b"pk"
+
+
+class _FakeClient:
+    def __init__(self):
+        self.meta_cache = _FakeMetaCache()
+        self.written = []
+        self.gate = threading.Event()
+        self.gate.set()
+        self._lock = threading.Lock()
+
+    def write(self, table, ops, tablet=None):
+        self.gate.wait(timeout=30)
+        with self._lock:
+            self.written.extend(ops)
+
+
+def _mk_op(i, nbytes=100):
+    from yugabyte_tpu.docdb.doc_key import DocKey
+    from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+    return QLWriteOp(WriteOpKind.INSERT,
+                     DocKey(range_components=(f"k{i:04d}",)),
+                     {"v": "x" * nbytes})
+
+
+def test_session_buffer_cap_raises_typed_when_nonblocking():
+    from yugabyte_tpu.client.session import SessionBufferFull, YBSession
+    from yugabyte_tpu.client.session import _op_bytes
+    sz = _op_bytes(_mk_op(0))
+    with _FlagScope(ybsession_max_buffered_bytes=2 * sz + 10):
+        fc = _FakeClient()
+        fc.gate.clear()   # sends (if any) would hang: cap is the gate
+        s = YBSession(fc)
+        s.apply(_FakeTable(), _mk_op(1))
+        s.apply(_FakeTable(), _mk_op(2))
+        with pytest.raises(SessionBufferFull) as ei:
+            s.apply(_FakeTable(), _mk_op(3), block=False)
+        assert ei.value.extra["overloaded"]
+        assert ei.value.extra["session_buffer_full"]
+        assert ei.value.status.code == Code.BUSY
+        fc.gate.set()
+        s.flush()
+        assert len(fc.written) == 2
+
+
+def test_session_buffer_cap_blocks_then_drains():
+    from yugabyte_tpu.client.session import YBSession, _op_bytes
+    sz = _op_bytes(_mk_op(0))
+    with _FlagScope(ybsession_max_buffered_bytes=2 * sz + 10):
+        fc = _FakeClient()
+        fc.gate.clear()
+        s = YBSession(fc)
+        s.apply(_FakeTable(), _mk_op(1))
+        s.apply(_FakeTable(), _mk_op(2))
+        done = threading.Event()
+
+        def blocked_apply():
+            # over the cap: blocks, self-flushes the buffer in the
+            # background, and completes once a send drains
+            s.apply(_FakeTable(), _mk_op(3))
+            done.set()
+
+        t = threading.Thread(target=blocked_apply, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert not done.is_set(), "apply() did not block at the cap"
+        fc.gate.set()   # sends drain -> cap frees -> apply completes
+        assert done.wait(timeout=10), "apply() never unblocked"
+        assert s.buffer_full_waits_total >= 1
+        s.flush()
+        assert len(fc.written) == 3
+        assert s.outstanding_bytes() == 0
+
+
+def test_session_admits_oversized_op_into_empty_buffer():
+    from yugabyte_tpu.client.session import YBSession
+    with _FlagScope(ybsession_max_buffered_bytes=64):
+        fc = _FakeClient()
+        s = YBSession(fc)
+        s.apply(_FakeTable(), _mk_op(1, nbytes=4096))  # must not wedge
+        s.flush()
+        assert len(fc.written) == 1
+
+
+# -------------------------------------------------------- write admission
+def _mk_tablet(tmp_path, tid="adm"):
+    from yugabyte_tpu.common.schema import (ColumnSchema, DataType,
+                                            Schema)
+    from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+    schema = Schema([ColumnSchema("k", DataType.STRING),
+                     ColumnSchema("v", DataType.INT64)],
+                    num_hash_key_columns=0, num_range_key_columns=1)
+    return Tablet(tid, str(tmp_path / tid), schema,
+                  options=TabletOptions(auto_compact=False)), schema
+
+
+def _mk_write(k):
+    from yugabyte_tpu.docdb.doc_key import DocKey
+    from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+    return QLWriteOp(WriteOpKind.INSERT, DocKey(range_components=(k,)),
+                     {"v": 1})
+
+
+def test_admission_memstore_hard_rejects_with_throttle_extras(tmp_path):
+    from yugabyte_tpu.utils.mem_tracker import MemTracker
+    t, _ = _mk_tablet(tmp_path)
+    try:
+        used = {"n": 0}
+        tracker = MemTracker(1000, "memstore-test",
+                             consumption_fn=lambda: used["n"])
+        t.admission.bind_memstore(tracker)
+        t.write([_mk_write("ok")])          # healthy: admits
+        used["n"] = 2000                    # way past the reject line
+        with pytest.raises(Overloaded) as ei:
+            t.write([_mk_write("shed")])
+        e = ei.value
+        assert e.status.code == Code.BUSY
+        assert e.extra["overloaded"] and e.extra["throttle"] == "memstore"
+        assert e.extra["retry_after_ms"] >= 50
+        assert "retry later" in str(e)
+        assert t.metric_write_rejections.value() == 1
+        snap = t.admission.snapshot()
+        assert snap["state"] == "hard" and snap["signal"] == "memstore"
+        assert snap["rejections_by_signal"] == {"memstore": 1}
+        used["n"] = 0                       # flush caught up: admits again
+        t.write([_mk_write("again")])
+        assert t.admission.snapshot()["state"] == "healthy"
+    finally:
+        t.close()
+
+
+def test_admission_memstore_soft_delays(tmp_path):
+    from yugabyte_tpu.utils.mem_tracker import MemTracker
+    t, _ = _mk_tablet(tmp_path, "adm2")
+    try:
+        used = {"n": 0}
+        t.admission.bind_memstore(MemTracker(
+            1000, "memstore-test2", consumption_fn=lambda: used["n"]))
+        with _FlagScope(write_backpressure_max_delay_ms=150):
+            used["n"] = 900   # between soft (85%) and reject (95%)
+            t0 = time.monotonic()
+            t.write([_mk_write("slow")])
+            assert time.monotonic() - t0 >= 0.04
+            assert t.admission.snapshot()["state"] == "soft"
+            assert t.admission.delays_total >= 1
+    finally:
+        t.close()
+
+
+def test_admission_wal_backlog_rejects(tmp_path):
+    t, _ = _mk_tablet(tmp_path, "adm3")
+    try:
+        backlog = {"n": 0}
+        t.admission.bind_wal(lambda: backlog["n"])
+        with _FlagScope(wal_backlog_soft_entries=10,
+                        wal_backlog_hard_entries=20):
+            t.write([_mk_write("a")])
+            backlog["n"] = 25
+            with pytest.raises(Overloaded) as ei:
+                t.write([_mk_write("b")])
+            assert ei.value.extra["throttle"] == "wal"
+            backlog["n"] = 0
+            t.write([_mk_write("c")])
+    finally:
+        t.close()
+
+
+def test_admission_sst_signal_keeps_legacy_behavior(tmp_path):
+    """The SST arm must keep the pre-unification contract: retryable
+    'retry later' rejection at the hard limit + the tablet counter
+    (test_backpressure asserts the same from the outside)."""
+    t, _ = _mk_tablet(tmp_path, "adm4")
+    try:
+        with _FlagScope(sst_files_soft_limit=1, sst_files_hard_limit=2):
+            t.write([_mk_write("a")])
+            t.regular_db.flush()
+            t.write([_mk_write("b")])
+            t.regular_db.flush()
+            assert t.regular_db.n_live_files >= 2
+            with pytest.raises(StatusError) as ei:
+                t.write([_mk_write("c")])
+            assert "retry later" in str(ei.value)
+            assert ei.value.extra["throttle"] == "sst"
+            assert t.metric_write_rejections.value() >= 1
+    finally:
+        t.close()
+
+
+def test_wal_backlog_counts_queued_entries(tmp_path):
+    from yugabyte_tpu.consensus.log import Log, LogEntry
+    log = Log(str(tmp_path / "wal"))
+    try:
+        assert log.backlog() == 0
+        done = threading.Event()
+        log.append_async([LogEntry(1, i + 1, b"x") for i in range(3)],
+                         callback=lambda err: done.set())
+        # the appender may already have drained it; only assert the
+        # probe returns and lands at zero once the queue settles
+        assert done.wait(timeout=10)
+        deadline = time.monotonic() + 5
+        while log.backlog() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert log.backlog() == 0
+    finally:
+        log.close()

@@ -234,9 +234,12 @@ class TestMultiColumnIndex:
     def pg(self, cluster):
         return _pg_session(cluster, db="idx_mc")
 
-    def test_multicol_create_backfill_lookup(self, pg):
+    def test_multicol_create_backfill_lookup(self, pg, cluster):
         pg.execute("CREATE TABLE ev (id INT PRIMARY KEY, city TEXT, "
                    "kind TEXT, amt INT)")
+        # READY-leader poll before the write burst (leadership-timing
+        # flake shape: CREATE via the query layer, immediate writes)
+        cluster.wait_for_table_leaders("idx_mc", "ev")
         pg.execute("INSERT INTO ev VALUES "
                    "(1,'rome','click',5), (2,'rome','view',6), "
                    "(3,'oslo','click',7), (4,'rome','click',8)")
@@ -253,9 +256,13 @@ class TestMultiColumnIndex:
                           "kind = 'click' AND amt > 5")[-1].rows
         assert [r[0] for r in rows] == [4]
 
-    def test_multicol_maintenance(self, pg):
+    def test_multicol_maintenance(self, pg, cluster):
         pg.execute("CREATE TABLE mv (id INT PRIMARY KEY, a TEXT, b TEXT)")
         pg.execute("CREATE INDEX ab ON mv (a, b)")
+        # transactional index maintenance spans base + index tablets:
+        # both need READY leaders before the first write
+        cluster.wait_for_table_leaders("idx_mc", "mv")
+        cluster.wait_for_table_leaders("idx_mc", "ab")
         pg.execute("INSERT INTO mv VALUES (1, 'x', 'y')")
         assert [r[0] for r in pg.execute(
             "SELECT id FROM mv WHERE a = 'x' AND b = 'y'")[-1].rows] == [1]
@@ -296,6 +303,8 @@ def test_projected_point_read_returns_values(cluster):
     from yugabyte_tpu.docdb.doc_key import DocKey
     sess = _pg_session(cluster, db="proj_db")
     sess.execute("CREATE TABLE pr (id INT PRIMARY KEY, a TEXT, b TEXT)")
+    # READY-leader poll before the write (leadership-timing flake shape)
+    cluster.wait_for_table_leaders("proj_db", "pr")
     sess.execute("INSERT INTO pr VALUES (1, 'va', 'vb')")
     t = sess._table("pr")
     cl = cluster.new_client()
@@ -317,6 +326,12 @@ def test_index_update_removes_stale_entry(cluster):
     sess = _pg_session(cluster, db="stale_db")
     sess.execute("CREATE TABLE st (id INT PRIMARY KEY, tag TEXT)")
     sess.execute("CREATE INDEX stag ON st (tag)")
+    # READY-leader deadline polls before the writes (the known
+    # leadership-timing flake: CREATE via the query layer, then
+    # immediate transactional writes spanning base AND index tablets —
+    # this test was the one-flake-per-run in the PR-12 baseline)
+    cluster.wait_for_table_leaders("stale_db", "st")
+    cluster.wait_for_table_leaders("stale_db", "stag")
     sess.execute("INSERT INTO st VALUES (1, 'old')")
     sess.execute("UPDATE st SET tag = 'new' WHERE id = 1")
     cl = cluster.new_client()

@@ -66,7 +66,13 @@ DEFAULT_DIRS = ("yugabyte_tpu/storage", "yugabyte_tpu/consensus",
                 "yugabyte_tpu/tserver", "yugabyte_tpu/client")
 _SEED_NAME_RE = re.compile(
     r"flush|compact|nemesis|chaos|cancel|scrub|integrity|shadow|corrupt"
-    r"|vouch|follower_read",
+    r"|vouch|follower_read"
+    # PR 12 overload protection: a swallowed error anywhere in the
+    # shedding machinery silently converts "reject retryably" into
+    # "drop on the floor" — the exact failure the soak's
+    # zero-acked-loss invariant exists to catch. (\b guards keep
+    # 'shed' from seeding every 'flushed'/'pushed'/'finished'.)
+    r"|throttle|overload|admission|\bshed|_shed\b",
     re.IGNORECASE)
 _WAL_MODULE_SUFFIX = ".consensus.log"
 _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
@@ -74,7 +80,12 @@ _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
                          # PR 11: the client batcher — a swallowed send
                          # error in flush turns an unacked batch into a
                          # silently "acked" one
-                         ".client.session")
+                         ".client.session",
+                         # PR 12: the write-admission state machine —
+                         # a contained signal-read error would silently
+                         # disable a shedding arm under the exact load
+                         # that needs it
+                         ".tablet.admission")
 _MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
 _DEF_MARKER = "# yblint: durability-path"
 _ROUTING_NAMES = ("TRACE", "trace")

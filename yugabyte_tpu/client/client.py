@@ -24,7 +24,7 @@ from yugabyte_tpu.docdb.doc_operations import QLWriteOp
 from yugabyte_tpu.rpc.messenger import (
     Messenger, RemoteError, RpcTimeout, ServiceUnavailable)
 from yugabyte_tpu.utils import flags
-from yugabyte_tpu.utils.backoff import Backoff
+from yugabyte_tpu.utils.backoff import Backoff, RetryBudget
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE, Trace
 
@@ -110,6 +110,13 @@ class YBClient:
         self.client_id = uuid.uuid4().bytes
         self._request_counter = 0
         self._request_lock = threading.Lock()
+        # One token-bucket retry budget shared by EVERY retry loop of
+        # this client (master hunts, replica walks, scans, sessions):
+        # retries beyond the budget surface a typed RetryBudgetExhausted
+        # instead of multiplying offered load against an already
+        # saturated cluster (ref rpc retrier budgets; first attempts are
+        # never charged).
+        self.retry_budget = RetryBudget()
 
     def _next_request_id(self) -> int:
         with self._request_lock:
@@ -157,6 +164,18 @@ class YBClient:
                         if hint and hint not in addrs:
                             addrs.append(hint)
                         last_err = e
+                        self.retry_budget.spend_or_raise(
+                            f"master.{mth}", last_err=e)
+                        continue
+                    if e.extra.get("overloaded"):
+                        # typed shedding rejection (bounded RPC queue /
+                        # write admission): retry, honoring the server's
+                        # measured retry_after hint at the round sleep
+                        backoff.note_server_hint(
+                            e.extra.get("retry_after_ms"))
+                        last_err = e
+                        self.retry_budget.spend_or_raise(
+                            f"master.{mth}", last_err=e)
                         continue
                     raise
                 except RpcTimeout as e:  # yblint: contained(retry walk: last_err re-raised on deadline/retry exhaustion below)
@@ -164,9 +183,13 @@ class YBClient:
                     if _retry_ctx is not None:
                         _retry_ctx["maybe_applied"] = True
                     last_err = e
+                    self.retry_budget.spend_or_raise(
+                        f"master.{mth}", last_err=e)
                     continue
                 except ServiceUnavailable as e:  # yblint: contained(retry walk: last_err re-raised on deadline/retry exhaustion below)
                     last_err = e
+                    self.retry_budget.spend_or_raise(
+                        f"master.{mth}", last_err=e)
                     continue
             self._master_leader = None
             if not backoff.sleep():  # jittered, not lockstep
@@ -410,12 +433,31 @@ class YBClient:
                         # a new leader emerges while we retry.
                         tablet.mark_leader(None)
                         last_err = e
+                        self.retry_budget.spend_or_raise(
+                            f"{mth} tablet {tablet.tablet_id}",
+                            last_err=e)
                         continue
                     if e.extra.get("not_leader"):
                         hint = e.extra.get("leader_hint")
                         if hint:
                             tablet.mark_leader(hint)
                         last_err = e
+                        self.retry_budget.spend_or_raise(
+                            f"{mth} tablet {tablet.tablet_id}",
+                            last_err=e)
+                        continue
+                    if e.extra.get("overloaded"):
+                        # typed shedding rejection (bounded RPC queue /
+                        # write-pressure hard limit): retryable — the
+                        # server's measured retry_after_ms floors the
+                        # round's backoff sleep so this client cannot
+                        # come back before the queue/flush drains
+                        backoff.note_server_hint(
+                            e.extra.get("retry_after_ms"))
+                        last_err = e
+                        self.retry_budget.spend_or_raise(
+                            f"{mth} tablet {tablet.tablet_id}",
+                            last_err=e)
                         continue
                     if (e.status.code in (Code.NOT_FOUND,
                                           Code.SERVICE_UNAVAILABLE,
@@ -432,10 +474,15 @@ class YBClient:
                         # retried — it is also the terminal answer for an
                         # aborted TRANSACTION, which must surface.)
                         last_err = e
+                        self.retry_budget.spend_or_raise(
+                            f"{mth} tablet {tablet.tablet_id}",
+                            last_err=e)
                         continue
                     raise
                 except (RpcTimeout, ServiceUnavailable) as e:  # yblint: contained(replica walk: last_err re-raised on deadline/retry exhaustion below)
                     last_err = e
+                    self.retry_budget.spend_or_raise(
+                        f"{mth} tablet {tablet.tablet_id}", last_err=e)
                     continue
             # All replicas failed: refresh locations and back off
             # (decorrelated jitter — concurrent clients desynchronize).
@@ -605,14 +652,20 @@ class YBClient:
                     filters=[list(f) for f in filters] if filters else None,
                     txn_id=txn_id)
             except RemoteError as e:
-                # Only split/moved/not-found are worth re-routing; other
-                # errors are deterministic and must surface immediately.
+                # Only split/moved/not-found/overloaded are worth
+                # re-routing; other errors are deterministic and must
+                # surface immediately.
                 retryable = (e.extra.get("tablet_split")
                              or e.extra.get("wrong_tablet")
+                             or e.extra.get("overloaded")
                              or e.status.code == Code.NOT_FOUND)
                 failures += 1
                 if not retryable or failures > 8:
                     raise
+                if e.extra.get("overloaded"):
+                    backoff.note_server_hint(e.extra.get("retry_after_ms"))
+                self.retry_budget.spend_or_raise(
+                    f"scan {table.name}", last_err=e)
                 time.sleep(backoff.next_delay())
                 self.meta_cache.invalidate(table.table_id)
                 continue
@@ -656,14 +709,19 @@ class YBClient:
                     lower_doc_key=lower, upper_doc_key=upper_doc_key,
                     read_ht=pinned, limit=page_size)
             except RemoteError as e:
-                # Same split/moved re-route as scan(): resume from the
-                # current doc-key bound after a refresh.
+                # Same split/moved/overload re-route as scan(): resume
+                # from the current doc-key bound after a refresh.
                 retryable = (e.extra.get("tablet_split")
                              or e.extra.get("wrong_tablet")
+                             or e.extra.get("overloaded")
                              or e.status.code == Code.NOT_FOUND)
                 failures += 1
                 if not retryable or failures > 8:
                     raise
+                if e.extra.get("overloaded"):
+                    backoff.note_server_hint(e.extra.get("retry_after_ms"))
+                self.retry_budget.spend_or_raise(
+                    f"scan_key_range {table.name}", last_err=e)
                 time.sleep(backoff.next_delay())
                 self.meta_cache.invalidate(table.table_id)
                 continue

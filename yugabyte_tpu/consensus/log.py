@@ -207,6 +207,16 @@ class Log:
         with self._lock:
             return self._io_error
 
+    def backlog(self) -> int:
+        """Entries queued for the appender but not yet fsynced — the
+        WAL-pressure signal of the write-admission state machine
+        (tablet/admission.py): a deep backlog means appends are arriving
+        faster than the disk syncs them, so new writes should be delayed
+        or shed before the queue's memory and latency grow unbounded."""
+        with self._lock:
+            n = sum(len(entries) for entries, _cb in self._queue)
+            return n + (1 if self._inflight else 0)
+
     def append_async(self, entries: Sequence[LogEntry],
                      callback: Optional[Callable] = None) -> None:
         """Queue entries for the appender thread (ref log.cc:739

@@ -43,6 +43,7 @@ def main(argv=None) -> int:
     import yugabyte_tpu.consensus.raft  # noqa: F401
     import yugabyte_tpu.storage.db  # noqa: F401
     import yugabyte_tpu.storage.offload_policy  # noqa: F401
+    import yugabyte_tpu.tablet.admission  # noqa: F401 — overload knobs
     import yugabyte_tpu.tserver.server_context  # noqa: F401
     for kv in args.flag:
         name, _, value = kv.partition("=")

@@ -48,6 +48,11 @@ flags.define_flag("rpc_service_pool_threads", 64,
                   "rpc/service_pool.cc); bounded to cap runaway "
                   "concurrency, large enough that blocking handlers "
                   "(consensus waits, scans) do not starve the pool")
+flags.define_flag("rpc_service_queue_depth", 512,
+                  "max inbound calls queued behind the service pool (ref "
+                  "svc_queue_length / ServicePool::QueueInboundCall); "
+                  "overflow is rejected with a retryable Overloaded error "
+                  "carrying a measured retry_after_ms hint; 0 = unbounded")
 flags.define_flag("rpc_default_timeout_s", 15.0,
                   "default outbound call deadline")
 flags.define_flag("rpc_compression_min_bytes", 32 << 10,
@@ -84,6 +89,34 @@ class RemoteError(StatusError):
     def __init__(self, status: Status, extra: Optional[dict] = None):
         super().__init__(status)
         self.extra = extra or {}
+
+
+class Overloaded(StatusError):
+    """Typed retryable shedding rejection (ref: the reference's
+    ServiceUnavailable queue-overflow + memory-pressure rejections,
+    rpc/service_pool.cc Overflow / tablet_service.cc write throttling).
+
+    Raised server-side by the bounded RPC queue and the write-admission
+    state machine; crosses the wire as Code.BUSY with
+    extra={"overloaded": True, "retry_after_ms": <measured hint>} so
+    client retry loops classify it retryable and floor their backoff at
+    the server's own drain estimate."""
+
+    def __init__(self, msg: str, retry_after_ms: Optional[float] = None,
+                 **extra_kv):
+        super().__init__(Status(Code.BUSY, msg))
+        self.extra = {"overloaded": True}
+        if retry_after_ms is not None:
+            self.extra["retry_after_ms"] = int(retry_after_ms)
+        self.extra.update(extra_kv)
+
+
+def is_overloaded_error(exc: Exception) -> bool:
+    """True for any typed overload rejection — local Overloaded, a
+    RemoteError carrying the overloaded extra, or a client retry-budget
+    denial (which reuses the same extra shape)."""
+    return bool(getattr(exc, "extra", None)
+                and exc.extra.get("overloaded"))
 
 
 def _tls_contexts():
@@ -428,6 +461,105 @@ class _ClientConnection:
         self.sock.close()
 
 
+class _InboundCall:
+    """One parsed inbound request parked in the service queue. Carries
+    everything a worker needs to run it, plus the timing the shedding
+    decisions key on: enqueue time (queue-wait histograms + drain-rate
+    EWMA) and the absolute deadline propagated from the caller's
+    timeout (expired calls are dropped before execution — the caller
+    stopped waiting, so running the handler is pure wasted work)."""
+
+    __slots__ = ("conn", "write_lock", "req", "peer", "enqueued",
+                 "deadline")
+
+    def __init__(self, conn, write_lock, req, peer):
+        self.conn = conn
+        self.write_lock = write_lock
+        self.req = req
+        self.peer = peer
+        self.enqueued = time.monotonic()
+        d = req.get("deadline_s")
+        self.deadline = (self.enqueued + d) if d else None
+
+
+class _ServicePool:
+    """Bounded inbound-call queue + reused worker threads (ref
+    rpc/service_pool.cc ServicePool). Replaces the unbounded
+    ThreadPoolExecutor the messenger used to queue into: under overload
+    an unbounded queue converts excess offered load into ever-growing
+    latency and memory until every queued caller has timed out — this
+    pool sheds instead (callers get a typed, retryable answer NOW).
+
+    submit() returns False on overflow (the serving thread replies
+    Overloaded); drain() hands back every still-queued call at shutdown
+    so the messenger can fail them immediately rather than execute them
+    against torn-down services (the inbound mirror of the PR-1
+    in-flight-outbound close fix). Workers spawn lazily up to the
+    configured thread cap and park on the condition when idle."""
+
+    def __init__(self, messenger: "Messenger", max_threads: int,
+                 name: str):
+        from collections import deque
+        from yugabyte_tpu.utils import lock_rank
+        self._messenger = messenger
+        self._max_threads = max_threads
+        self._name = name
+        self._cv = threading.Condition(lock_rank.tracked(
+            threading.Lock(), "messenger.service_pool.lock"))
+        self._queue: "deque[_InboundCall]" = deque()  # guarded-by: _cv
+        self._n_threads = 0   # guarded-by: _cv
+        self._n_idle = 0      # guarded-by: _cv
+        self._shutdown = False  # guarded-by: _cv
+
+    def submit(self, call: _InboundCall) -> bool:
+        """Queue one call; False = queue full (caller sheds)."""
+        depth = flags.get_flag("rpc_service_queue_depth")
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("service pool is shut down")
+            if depth and len(self._queue) >= depth:
+                return False
+            self._queue.append(call)
+            if self._n_idle == 0 and self._n_threads < self._max_threads:
+                self._n_threads += 1
+                threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"rpc-worker-{self._name}-{self._n_threads}"
+                ).start()
+            else:
+                self._cv.notify()
+        return True
+
+    def queue_len(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._shutdown:
+                    self._n_idle += 1
+                    self._cv.wait()
+                    self._n_idle -= 1
+                if self._shutdown and not self._queue:
+                    self._n_threads -= 1
+                    return
+                call = self._queue.popleft()
+            self._messenger._run_inbound(call)
+
+    def drain(self) -> list:
+        """Begin shutdown: returns every queued-but-not-started call for
+        the messenger to fail; workers exit once idle (in-flight
+        handlers run to completion, like the executor's
+        cancel_futures=True shutdown did)."""
+        with self._cv:
+            self._shutdown = True
+            queued = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        return queued
+
+
 class Messenger:
     """Owns the listening socket, inbound dispatch, and the outbound
     connection cache. One per server process (and one per pure client)."""
@@ -462,13 +594,32 @@ class Messenger:
         # shutdown; the accept loop's bare read only risks one extra
         # accept, which shutdown() handles by closing late arrivals
         self._shutdown = False
-        # persistent service pool (ref rpc/service_pool.cc): handlers run
-        # on reused workers — a fresh thread per request cost ~0.4ms of
-        # the YCSB-C point-read path (profiled round 3)
-        from concurrent.futures import ThreadPoolExecutor
-        self._service_pool = ThreadPoolExecutor(
-            max_workers=flags.get_flag("rpc_service_pool_threads"),
-            thread_name_prefix=f"rpc-worker-{name}")
+        # persistent BOUNDED service pool (ref rpc/service_pool.cc):
+        # handlers run on reused workers — a fresh thread per request
+        # cost ~0.4ms of the YCSB-C point-read path (profiled round 3);
+        # the queue behind the workers is bounded (rpc_service_queue_depth)
+        # and sheds with typed Overloaded + a measured retry_after hint
+        self._service_pool = _ServicePool(
+            self, flags.get_flag("rpc_service_pool_threads"), name)
+        ent = self._metrics.entity("server", f"messenger.{name}")
+        self._c_queue_overflow = ent.counter(
+            "rpc_queue_overflow_total",
+            "inbound calls rejected because the service queue was full")
+        self._c_expired_in_queue = ent.counter(
+            "rpc_calls_expired_in_queue_total",
+            "queued inbound calls dropped unexecuted because their "
+            "propagated deadline expired while waiting")
+        self._c_shed_at_shutdown = ent.counter(
+            "rpc_calls_failed_at_shutdown_total",
+            "queued inbound calls failed immediately by messenger "
+            "shutdown instead of executing against torn-down services")
+        # drain-rate EWMAs feeding the retry_after_ms hint: observed
+        # per-call handler time + queue wait (RESYSTANCE spirit — the
+        # hint is measured from this messenger's own recent behavior,
+        # not a static guess)
+        self._ewma_lock = threading.Lock()
+        self._svc_ms_ewma = 1.0    # guarded-by: _ewma_lock
+        self._queue_ms_ewma = 0.0  # guarded-by: _ewma_lock
         # TLS contexts resolved once per messenger (flag + cert flags)
         self._tls_server_ctx, self._tls_client_ctx = _tls_contexts()
         # /rpcz bookkeeping (ref rpc/rpcz_store.cc): in-flight inbound
@@ -552,16 +703,91 @@ class Messenger:
                 req = _recv_message(conn)
                 # Handlers run off-connection so one slow handler does not
                 # head-of-line-block the connection; the pool reuses
-                # workers (the reference's ServicePool).
+                # workers (the reference's ServicePool). The queue behind
+                # them is BOUNDED: overflow answers NOW with a typed
+                # retryable Overloaded + a measured retry_after hint,
+                # instead of parking the caller in an invisible line.
+                call = _InboundCall(conn, write_lock, req, peer)
                 try:
-                    self._service_pool.submit(self._dispatch, conn,
-                                              write_lock, req, peer)
+                    accepted = self._service_pool.submit(call)
                 except RuntimeError:
                     return  # pool shut down: messenger is closing
+                if not accepted:
+                    self._c_queue_overflow.increment()
+                    self._reply_overloaded(
+                        call, f"rpc {self.name}: service queue full "
+                        f"({flags.get_flag('rpc_service_queue_depth')} "
+                        f"calls); retry later")
         except (ConnectionError, OSError):
             pass
         finally:
             conn.close()
+
+    def retry_after_hint_ms(self) -> int:
+        """Measured drain estimate shipped with shedding rejections: the
+        time the current queue takes to clear at the recently observed
+        per-call service rate, floored by the recent queue wait. Clamped
+        to [10ms, 2s] so a cold EWMA can neither spam retries nor park
+        clients for minutes."""
+        with self._ewma_lock:
+            svc_ms, queue_ms = self._svc_ms_ewma, self._queue_ms_ewma
+        n_workers = max(1, flags.get_flag("rpc_service_pool_threads"))
+        drain_ms = self._service_pool.queue_len() * svc_ms / n_workers
+        return int(min(2000.0, max(10.0, drain_ms, queue_ms)))
+
+    def _note_timing(self, queue_ms: float,
+                     svc_ms: Optional[float] = None) -> None:
+        with self._ewma_lock:
+            self._queue_ms_ewma = (0.8 * self._queue_ms_ewma
+                                   + 0.2 * queue_ms)
+            if svc_ms is not None:
+                self._svc_ms_ewma = 0.8 * self._svc_ms_ewma + 0.2 * svc_ms
+
+    def _reply_overloaded(self, call: _InboundCall, msg: str,
+                          code: Code = Code.BUSY,
+                          extra: Optional[dict] = None) -> None:
+        """Synthesize a typed shedding response without running any
+        handler (queue overflow / shutdown). Send failures mean the
+        caller is already gone — counted like any dropped response."""
+        resp = {"id": call.req.get("id"), "code": code.value, "err": msg,
+                "ret": None,
+                "extra": dict({"overloaded": True,
+                               "retry_after_ms": self.retry_after_hint_ms()},
+                              **(extra or {}))}
+        try:
+            _send_message(call.conn, call.write_lock, resp)
+        except OSError as e:
+            self._responses_dropped.increment()
+            TRACE("rpc %s: overload reply to %s.%s call %s dropped: %s",
+                  self.name, call.req.get("svc"), call.req.get("mth"),
+                  call.req.get("id"), e)
+
+    def _run_inbound(self, call: _InboundCall) -> None:
+        """Worker-side entry: account queue time, shed expired calls
+        (counted, provably never executed), then dispatch."""
+        now = time.monotonic()
+        queue_ms = (now - call.enqueued) * 1e3
+        req = call.req
+        self._method_histogram(req["svc"], req["mth"],
+                               kind="queue").increment(queue_ms)
+        if call.deadline is not None and now >= call.deadline:
+            # Nobody is waiting for this answer anymore (the caller's
+            # timeout elapsed while the call sat in the queue): running
+            # the handler would spend pool time on dead work and delay
+            # calls that CAN still be answered. Drop without executing
+            # and without a response (the caller already moved on).
+            self._c_expired_in_queue.increment()
+            self._note_timing(queue_ms)
+            TRACE("rpc %s: %s.%s call %s expired in queue "
+                  "(waited %.1fms past a %.1fs deadline); dropped "
+                  "unexecuted", self.name, req.get("svc"), req.get("mth"),
+                  req.get("id"), queue_ms, req.get("deadline_s"))
+            return
+        t0 = time.monotonic()
+        try:
+            self._dispatch(call.conn, call.write_lock, req, call.peer)
+        finally:
+            self._note_timing(queue_ms, (time.monotonic() - t0) * 1e3)
 
     def _dispatch(self, conn: socket.socket, write_lock: threading.Lock,
                   req: dict, peer=None) -> None:
@@ -581,8 +807,17 @@ class Messenger:
                   "gone: %s", self.name, req.get("svc"), req.get("mth"),
                   req.get("id"), e)
 
-    def _method_histogram(self, svc: str, mth: str):
-        key = (svc, mth)
+    _HIST_KINDS = {
+        "duration": ("rpc_inbound_call_duration_ms",
+                     "inbound RPC handler latency per service.method"),
+        "queue": ("rpc_inbound_call_queue_time_ms",
+                  "time inbound calls spent queued behind the service "
+                  "pool per service.method"),
+    }
+
+    def _method_histogram(self, svc: str, mth: str,
+                          kind: str = "duration"):
+        key = (svc, mth, kind)
         # benign racy fast path on the per-RPC hot loop: dict reads are
         # atomic under the GIL and every WRITE happens under the lock
         # below, so the worst case is taking the slow path once
@@ -591,11 +826,11 @@ class Messenger:
             with self._method_hists_lock:
                 h = self._method_hists.get(key)
                 if h is None:
+                    name, help_text = self._HIST_KINDS[kind]
                     h = self._metrics.entity(
                         "service", f"{svc}.{mth}",
                         {"service": svc, "method": mth}).histogram(
-                        "rpc_inbound_call_duration_ms",
-                        "inbound RPC handler latency per service.method")
+                        name, help_text)
                     self._method_hists[key] = h
         return h
 
@@ -760,6 +995,25 @@ class Messenger:
                 del self._conns[conn.addr]
         conn.close()
 
+    def overload_snapshot(self) -> dict:
+        """The RPC arm of the /servez overload block: queue depth/bound,
+        shed counters, and the measured hint state."""
+        with self._ewma_lock:
+            svc_ms, queue_ms = self._svc_ms_ewma, self._queue_ms_ewma
+        return {
+            "service_queue_len": self._service_pool.queue_len(),
+            "service_queue_depth": flags.get_flag(
+                "rpc_service_queue_depth"),
+            "rpc_queue_overflow_total": self._c_queue_overflow.value(),
+            "rpc_calls_expired_in_queue_total":
+                self._c_expired_in_queue.value(),
+            "rpc_calls_failed_at_shutdown_total":
+                self._c_shed_at_shutdown.value(),
+            "retry_after_hint_ms": self.retry_after_hint_ms(),
+            "svc_ms_ewma": round(svc_ms, 2),
+            "queue_ms_ewma": round(queue_ms, 2),
+        }
+
     def shutdown(self) -> None:
         self._shutdown = True
         try:
@@ -767,7 +1021,18 @@ class Messenger:
         except OSError:
             pass
         self._listener.close()
-        self._service_pool.shutdown(wait=False, cancel_futures=True)
+        # Fail QUEUED (not yet executing) inbound calls NOW, before the
+        # services behind them are torn down — the inbound mirror of the
+        # outbound close fix in _ClientConnection.close(): a queued
+        # caller gets a typed retryable answer immediately instead of
+        # its call executing against half-shut-down services (or being
+        # silently cancelled into a full client-side timeout).
+        for call in self._service_pool.drain():
+            self._c_shed_at_shutdown.increment()
+            self._reply_overloaded(
+                call, f"rpc {self.name}: messenger shutting down; "
+                f"retry another replica", code=Code.SERVICE_UNAVAILABLE,
+                extra={"shutting_down": True})
         with self._conns_lock:
             conns = list(self._conns.values())
             self._conns.clear()

@@ -199,7 +199,16 @@ class Tablet:
                                            "row reads served")
         self.metric_write_rejections = entity.counter(
             "write_rejections_total",
-            "writes rejected by SST-file backpressure")
+            "writes rejected retryably by write-pressure backpressure "
+            "(SST files / memstore tracker / WAL backlog)")
+        # Unified write-pressure state machine (tablet/admission.py):
+        # SST-file pressure is bound here; TabletPeer binds the WAL
+        # appender backlog and TabletMemoryManager binds the server-wide
+        # memstore MemTracker. Evaluated at every write entry point.
+        from yugabyte_tpu.tablet.admission import WriteAdmission
+        self.admission = WriteAdmission(
+            tablet_id, lambda: self.regular_db.n_live_files,
+            rejection_counter=self.metric_write_rejections)
 
     def _pre_intents_flush(self) -> None:
         """Intents pre-flush hook. The regular flush contains I/O errors
@@ -262,27 +271,16 @@ class Tablet:
                 self._write_gate.notify_all()
 
     def _check_write_backpressure(self) -> None:
-        """Score-based write throttling on SST-file pressure (ref:
+        """Unified score-based write throttling (ref:
         tserver/tablet_service.cc:1510 write-rejection score +
-        sst_files_soft/hard_limit): between the soft and hard limits each
-        write is delayed proportionally, giving compactions bandwidth to
-        catch up; at the hard limit writes are rejected retryably."""
-        from yugabyte_tpu.utils import flags as _flags
-        soft = _flags.get_flag("sst_files_soft_limit")
-        hard = _flags.get_flag("sst_files_hard_limit")
-        files = self.regular_db.n_live_files
-        if files < soft:
-            return
-        if files >= hard:
-            from yugabyte_tpu.utils.status import Status, StatusError
-            self.metric_write_rejections.increment()
-            raise StatusError(Status.ServiceUnavailable(
-                f"too many SST files ({files} >= {hard}); retry later"))
-        score = (files - soft + 1) / max(1, hard - soft)
-        delay = score * _flags.get_flag(
-            "write_backpressure_max_delay_ms") / 1000.0
-        if delay > 0:
-            time.sleep(delay)
+        sst_files_soft/hard_limit, plus the reference's memstore
+        soft-limit rejection): the admission state machine
+        (tablet/admission.py) scores SST-file, memstore-tracker and
+        WAL-backlog pressure — between soft and hard each write is
+        delayed proportionally, giving flushes/compactions bandwidth to
+        catch up; at a hard limit writes are rejected retryably with
+        typed Overloaded throttle extras."""
+        self.admission.admit()
 
     def block_writes(self) -> None:
         """Reject new writes and drain in-flight ones (split prelude)."""

@@ -174,6 +174,9 @@ class TabletPeer:
         self.tablet = Tablet(tablet_id, data_dir, schema, clock=self.clock,
                              options=options, metrics=metrics)
         self.log = Log(os.path.join(data_dir, "wal"))
+        # WAL-backlog arm of the write-pressure state machine: appends
+        # queued faster than fsync drains them delay, then shed, writes
+        self.tablet.admission.bind_wal(self.log.backlog)
         config = RaftConfig(
             peer_id=peer_address(server_id, tablet_id),
             peer_ids=tuple(peer_address(s, tablet_id) for s in server_ids))

@@ -97,9 +97,22 @@ class TabletMemoryManager:
 
     # ------------------------------------------------------------- lifecycle
     def init(self) -> None:
+        self.bind_admission()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="memstore-arbiter")
         self._thread.start()
+
+    def bind_admission(self) -> None:
+        """Hand the server-wide memstore tracker to every hosted
+        tablet's write-admission state machine (tablet/admission.py) so
+        write entry points shed on memstore pressure. Idempotent;
+        re-applied every arbiter round so tablets created after init()
+        get bound within one arbitration interval."""
+        for peer in self._peers_fn():
+            tablet = getattr(peer, "tablet", peer)
+            admission = getattr(tablet, "admission", None)
+            if admission is not None:
+                admission.bind_memstore(self.memstore_tracker)
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -115,6 +128,7 @@ class TabletMemoryManager:
         period = flags.get_flag("memstore_arbitration_interval_s")
         while not self._stop.wait(period):
             try:
+                self.bind_admission()
                 self.flush_tablet_if_limit_exceeded()
                 # process-level pressure check: RSS over the root limit
                 # sheds cache memory via the registered GC hooks

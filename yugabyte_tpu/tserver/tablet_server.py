@@ -77,7 +77,9 @@ class TabletServer:
         self.service = TabletServiceImpl(self.tablet_manager,
                                          addr_updater=self.update_addr_map,
                                          coordinator=self.coordinator,
-                                         client_provider=self.local_client)
+                                         client_provider=self.local_client,
+                                         overload_provider=lambda:
+                                         self.overloadz())
         self.messenger.register_service(TABLET_SERVICE, self.service)
         self.heartbeater = Heartbeater(
             self.messenger, opts.master_addrs, opts.server_id, self.address,
@@ -224,7 +226,8 @@ class TabletServer:
     def servez(self) -> dict:
         """Serve-path state: group-commit write batching (one raft
         replicate / WAL fsync per batch), batched point-read counters,
-        and per-replica follower-read vouch status."""
+        per-replica follower-read vouch status, and the overload block
+        (bounded RPC queue + per-tablet write-pressure state)."""
         from yugabyte_tpu.ops.point_read import point_read_snapshot
         from yugabyte_tpu.utils.metrics import serve_path_snapshot
         tablets = []
@@ -238,7 +241,41 @@ class TabletServer:
         return {"server_id": self.server_id,
                 "serve_path": serve_path_snapshot(),
                 "point_reads": point_read_snapshot(),
+                "overload": self.overloadz(),
                 "tablets": tablets}
+
+    def overloadz(self) -> dict:
+        """The overload block: every shedding layer's live state — the
+        messenger's bounded service queue (depth, overflow/expired
+        counters, measured retry_after hint), the server-wide memstore
+        tracker, and each hosted tablet's write-pressure state machine
+        (tablet/admission.py). Served inside /servez and over the
+        `overload_status` RPC (bench scraping on external clusters)."""
+        from yugabyte_tpu.utils import flags as _flags
+        from yugabyte_tpu.utils.metrics import serve_path_metrics
+        mm = self.memory_manager
+        tracker = mm.memstore_tracker
+        m = serve_path_metrics()
+        pressure = []
+        for peer in self.tablet_manager.peers():
+            admission = getattr(getattr(peer, "tablet", None),
+                                "admission", None)
+            if admission is not None:
+                pressure.append(admission.snapshot())
+        return {
+            "rpc": self.messenger.overload_snapshot(),
+            "memstore": {
+                "consumption_bytes": tracker.consumption(),
+                "limit_bytes": tracker.limit,
+                "reject_fraction": _flags.get_flag(
+                    "memstore_reject_fraction"),
+            },
+            "write_throttle_rejections_total": m.counter(
+                "write_throttle_rejections_total",
+                "writes rejected retryably by the write-pressure "
+                "state machine").value(),
+            "write_pressure": pressure,
+        }
 
     def integrityz(self) -> dict:
         """Data-integrity state: shadow-verify sampling + mismatch

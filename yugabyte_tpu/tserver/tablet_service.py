@@ -43,11 +43,13 @@ def _row_matches(row_dict: dict, filters: List[List]) -> bool:
 
 class TabletServiceImpl:
     def __init__(self, tablet_manager: TSTabletManager, addr_updater=None,
-                 coordinator=None, client_provider=None):
+                 coordinator=None, client_provider=None,
+                 overload_provider=None):
         self._tablets = tablet_manager
         self._addr_updater = addr_updater or (lambda m: None)
         self.coordinator = coordinator
         self._client_provider = client_provider or (lambda: None)
+        self._overload_provider = overload_provider or (lambda: {})
 
     def _leader_peer(self, tablet_id: str):
         peer = self._tablets.get_tablet(tablet_id)
@@ -577,3 +579,11 @@ class TabletServiceImpl:
     def status(self) -> dict:
         return {"server_id": self._tablets.server_id,
                 "tablets": self._tablets.generate_report()}
+
+    def overload_status(self) -> dict:
+        """The /servez overload block over RPC: bounded-queue + shed
+        counters + per-tablet write-pressure state. External-cluster
+        benches and the overload soak scrape this per node (their
+        tservers run webserver-less, so the RPC is the only window)."""
+        return {"server_id": self._tablets.server_id,
+                "overload": self._overload_provider()}

@@ -12,19 +12,41 @@ Two shapes:
 - `Backoff`: an iterator of delays for one bounded retry *attempt*
   (deadline-aware; decorrelated jitter so a thundering herd of retriers
   de-synchronizes: delay_n = uniform(base, prev * 3), clamped to cap).
+  A server-provided `retry_after_ms` hint (the overload-shedding
+  response extra) floors the next delay — the server measured its own
+  queue drain, so the client must not return before that.
 - `RetrySchedule`: open-ended pacing for a long-lived background retrier
   (the maintenance manager's flush-recovery op): `ready()` gates the next
   attempt, `record_failure()` doubles the spacing up to a cap,
   `reset()` re-arms after success.
+- `RetryBudget`: a per-client token bucket every retry loop draws from
+  (ref: rpc/rpc.cc RpcRetrier + the reference's server-side call budget):
+  first attempts are free, each RETRY spends one token, tokens refill at
+  a bounded rate — so a saturated cluster's rejections can never make
+  the client multiply its own offered load unboundedly (the retry-storm
+  amplifier the overload-protection design exists to break).
 """
 
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Optional
 
-__all__ = ["Backoff", "RetrySchedule"]
+from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.status import Code, Status, StatusError
+
+flags.define_flag("client_retry_budget_tokens", 120,
+                  "burst capacity of the per-client retry token bucket; "
+                  "every retry (never a first attempt) spends one token")
+flags.define_flag("client_retry_budget_refill_per_s", 30.0,
+                  "sustained retry rate the per-client budget allows; "
+                  "caps retry amplification under overload at roughly "
+                  "this many extra attempts per second per client")
+
+__all__ = ["Backoff", "RetrySchedule", "RetryBudget",
+           "RetryBudgetExhausted"]
 
 
 class Backoff:
@@ -44,11 +66,22 @@ class Backoff:
                           else time.monotonic() + deadline_s)
         self._rng = rng if rng is not None else random
         self.attempts = 0
+        self._hint_s = 0.0  # pending server retry_after floor
 
     @property
     def expired(self) -> bool:
         return (self._deadline is not None
                 and time.monotonic() >= self._deadline)
+
+    def note_server_hint(self, retry_after_ms) -> None:
+        """Record a server-sent `retry_after_ms` overload hint: the NEXT
+        delay will be at least this long (the server measured its own
+        queue drain; coming back sooner is a wasted, load-amplifying
+        attempt). Consumed by one next_delay(); the hint may exceed
+        cap_s — the server's measurement wins — but never the
+        deadline."""
+        if retry_after_ms:
+            self._hint_s = max(self._hint_s, float(retry_after_ms) / 1e3)
 
     def remaining_s(self) -> Optional[float]:
         """Seconds left until the deadline; None when unbounded. Callers
@@ -59,10 +92,14 @@ class Backoff:
         return max(0.0, self._deadline - time.monotonic())
 
     def next_delay(self) -> float:
-        """Draw the next delay (decorrelated jitter), deadline-clamped."""
+        """Draw the next delay (decorrelated jitter), floored by any
+        pending server retry_after hint, deadline-clamped."""
         self.attempts += 1
         d = min(self.cap_s, self._rng.uniform(self.base_s, self._prev * 3))
         self._prev = d
+        if self._hint_s:
+            d = max(d, self._hint_s)
+            self._hint_s = 0.0
         if self._deadline is not None:
             d = min(d, max(0.0, self._deadline - time.monotonic()))
         return d
@@ -132,3 +169,68 @@ class RetrySchedule:
     def reset(self) -> None:
         self.failures = 0
         self._next_attempt = 0.0
+
+
+class RetryBudgetExhausted(StatusError):
+    """The per-client retry budget ran dry: surfacing (typed, with the
+    last underlying error in the message) instead of retrying is what
+    keeps a saturated cluster's retries from amplifying its own
+    collapse. Carries the same `overloaded` extra shape as server-side
+    shedding so callers classify both identically."""
+
+    def __init__(self, msg: str):
+        super().__init__(Status(Code.BUSY, msg))
+        self.extra = {"overloaded": True, "retry_budget_exhausted": True}
+
+
+class RetryBudget:
+    """Token bucket bounding a client's RETRY rate (first attempts are
+    free). Thread-safe: one instance is shared by every retry loop of a
+    client, so concurrent sessions draw from one budget.
+
+    spend() refills by elapsed-time * refill rate (capped at the burst
+    capacity), then takes one token; an empty bucket means the caller
+    must surface its last error instead of retrying."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 refill_per_s: Optional[float] = None):
+        self.capacity = float(capacity if capacity is not None
+                              else flags.get_flag(
+                                  "client_retry_budget_tokens"))
+        self.refill_per_s = float(
+            refill_per_s if refill_per_s is not None
+            else flags.get_flag("client_retry_budget_refill_per_s"))
+        self._tokens = self.capacity
+        self._last_refill = time.monotonic()
+        self._lock = threading.Lock()
+        self.exhausted_total = 0  # budget denials (observability)
+        self.spent_total = 0      # retries the budget admitted
+
+    def _refill_locked(self, now: float) -> None:
+        self._tokens = min(self.capacity, self._tokens
+                           + (now - self._last_refill) * self.refill_per_s)
+        self._last_refill = now
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent_total += 1
+                return True
+            self.exhausted_total += 1
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            return self._tokens
+
+    def spend_or_raise(self, what: str, last_err=None) -> None:
+        """Charge one retry; raises the typed RetryBudgetExhausted —
+        carrying the last underlying error — when the bucket is dry."""
+        if not self.try_spend():
+            raise RetryBudgetExhausted(
+                f"{what}: client retry budget exhausted "
+                f"({self.capacity:.0f} tokens, "
+                f"{self.refill_per_s}/s refill); last error: {last_err}")
