@@ -359,6 +359,180 @@ def run_points_child(platform: str, db_dir: str, n_str: str) -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_analytics_child(platform: str, n_str: str) -> None:
+    """Analytics rung (ROADMAP item 5): fused filtered/aggregating scans
+    vs the per-row host path, over one tablet's resident slabs.
+
+    The host baseline is the exact work the query layer does without
+    pushdown — assemble every row, evaluate the predicate in Python,
+    aggregate in Python. The fused numbers ride tablet.scan_pushdown /
+    tablet.scan_aggregate (one device dispatch + winner-block decode /
+    scalar download). Correctness gates run before any rate ships:
+    fused results must equal the host results exactly."""
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    if platform == "tpu" and dev.platform == "cpu":
+        sys.exit(3)
+    import shutil
+    import tempfile
+
+    from yugabyte_tpu.common.hybrid_time import HybridTime
+    from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+    from yugabyte_tpu.docdb import scan_spec as SS
+    from yugabyte_tpu.docdb.doc_key import DocKey
+    from yugabyte_tpu.docdb.doc_operations import column_key_suffix
+    from yugabyte_tpu.docdb.value import Value
+    from yugabyte_tpu.ops.scan import pushdown_snapshot
+    from yugabyte_tpu.storage.device_cache import DeviceSlabCache
+    from yugabyte_tpu.storage.sst import BlockCache
+    from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+    from yugabyte_tpu.utils import flags as _flags
+
+    schema = Schema(columns=[ColumnSchema("k", DataType.INT64),
+                             ColumnSchema("v", DataType.INT64),
+                             ColumnSchema("b", DataType.BOOL)],
+                    num_hash_key_columns=1)
+    n = int(n_str)
+    _flags.set_flag("scan_pushdown_min_rows", 0)
+    rng = np.random.default_rng(23)
+    root = tempfile.mkdtemp(prefix="ybtpu-bench-analytics-")
+    out = {"analytics_device": str(dev), "analytics_rows": n}
+    t = Tablet("t-analytics", root, schema,
+               options=TabletOptions(
+                   auto_compact=False, device=dev,
+                   device_cache=DeviceSlabCache(device=dev),
+                   block_cache=BlockCache(256 << 20)))
+    try:
+        vcid = schema.column_id("v")
+        bcid = schema.column_id("b")
+        vsuf = column_key_suffix(vcid)
+        bsuf = column_key_suffix(bcid)
+        lsuf = column_key_suffix(-1)
+        vals = rng.integers(0, 10_000, size=n)
+        bools = rng.random(n) < 0.5
+        t0 = time.time()
+        per_flush = n // 2
+        for f in range(2):
+            keys = []
+            values = []
+            for i in range(f * per_flush, (f + 1) * per_flush):
+                dk_enc = DocKey(hash_components=(int(i),)).encode()
+                keys.append(dk_enc + lsuf)
+                values.append(Value(primitive=None).encode())
+                keys.append(dk_enc + vsuf)
+                values.append(Value(primitive=int(vals[i])).encode())
+                keys.append(dk_enc + bsuf)
+                values.append(Value(primitive=bool(bools[i])).encode())
+            m = len(keys)
+            ht = ((np.arange(m, dtype=np.uint64) // 3
+                   + np.uint64(1000 + f * per_flush)) << np.uint64(12))
+            wid = (np.arange(m, dtype=np.uint32) % 3)
+            t.regular_db.write_batch_columns(keys, ht, wid, values,
+                                             op_id=(1, f + 1))
+            t.regular_db.flush()
+        # compact to ONE sorted SST: the analytics steady state — a
+        # single resident source rides the presorted kernel variant
+        # (no merge sort, no permutation gather)
+        t.regular_db.compact_all()
+        log(f"  analytics load: {n} rows ({3 * n} entries) in "
+            f"{time.time() - t0:.1f}s "
+            f"({len(t.regular_db.versions.live_files())} SSTs)")
+
+        threshold = 100   # ~1% selectivity — the analytics WHERE shape
+        pred = SS.compile_predicate(schema, "v", "<", threshold)
+        spec_f = SS.ScanSpec(predicates=(pred,))
+        spec_a = SS.ScanSpec(
+            predicates=(pred,),
+            aggregates=(SS.compile_aggregate(schema, "count", None),
+                        SS.compile_aggregate(schema, "sum", "v"),
+                        SS.compile_aggregate(schema, "min", "v"),
+                        SS.compile_aggregate(schema, "max", "v")))
+        read_ht = t.clock.now()
+
+        def host_filtered():
+            got = []
+            for row in t.scan(read_ht, use_device=False):
+                d = row.to_dict(schema)
+                hv = d.get("v")
+                if hv is not None and hv < threshold:
+                    got.append((d["k"], hv, d["b"]))
+            return got
+
+        def fused_filtered():
+            it = t.scan_pushdown(read_ht, spec=spec_f)
+            assert it is not None, "pushdown fell back"
+            got = []
+            for row in it:
+                d = row.to_dict(schema)
+                got.append((d["k"], d["v"], d["b"]))
+            return got
+
+        # warm (compile) + correctness gate, then measure
+        want = host_filtered()
+        assert sorted(fused_filtered()) == sorted(want), \
+            "fused filtered != host"
+        t0 = time.time()
+        got = fused_filtered()
+        fused_s = time.time() - t0
+        t0 = time.time()
+        host_filtered()
+        host_s = time.time() - t0
+        out["filtered_scan_rows_per_sec"] = round(n / fused_s, 1)
+        out["filtered_scan_host_rows_per_sec"] = round(n / host_s, 1)
+        out["filtered_scan_vs_host"] = round(host_s / fused_s, 1)
+        out["filtered_scan_survivors"] = len(got)
+        log(f"  filtered scan (v < {threshold}, {len(got)} survivors): "
+            f"fused {n/fused_s/1e3:.0f}K rows/s vs host "
+            f"{n/host_s/1e3:.0f}K rows/s = {host_s/fused_s:.1f}x")
+
+        def host_agg():
+            cnt = 0
+            sv = 0
+            mn = None
+            mx = None
+            for row in t.scan(read_ht, use_device=False):
+                d = row.to_dict(schema)
+                hv = d.get("v")
+                if hv is None or hv >= threshold:
+                    continue
+                cnt += 1
+                sv += hv
+                mn = hv if mn is None else min(mn, hv)
+                mx = hv if mx is None else max(mx, hv)
+            return cnt, sv, mn, mx
+
+        def fused_agg():
+            p = t.scan_aggregate(read_ht, spec=spec_a)
+            assert p is not None, "aggregate pushdown fell back"
+            st = p["cols"][vcid]
+            return p["rows"], st["sum"], st["min"], st["max"]
+
+        want = host_agg()
+        assert fused_agg() == want, "fused aggregate != host"
+        t0 = time.time()
+        fused_agg()
+        fused_s = time.time() - t0
+        t0 = time.time()
+        host_agg()
+        host_s = time.time() - t0
+        out["agg_scan_rows_per_sec"] = round(n / fused_s, 1)
+        out["agg_scan_host_rows_per_sec"] = round(n / host_s, 1)
+        out["agg_scan_vs_host"] = round(host_s / fused_s, 1)
+        log(f"  aggregate scan (count/sum/min/max WHERE): fused "
+            f"{n/fused_s/1e3:.0f}K rows/s vs host {n/host_s/1e3:.0f}K "
+            f"rows/s = {host_s/fused_s:.1f}x")
+        snap = pushdown_snapshot()
+        out["analytics_pushdown_fallbacks"] = snap["fallbacks"]
+        out["analytics_blocks_decoded_p50"] = \
+            snap["blocks_decoded_per_scan"]["p50"]
+    finally:
+        t.close()
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(out), flush=True)
+
+
 class StageLog:
     """Per-stage checkpoint file: the parent assembles a partial result if
     the child dies late (VERDICT r3: a 480s all-or-nothing budget threw away
@@ -1161,6 +1335,13 @@ def _ycsb_stage() -> dict:
                 "wal_backlog_soft_entries": 512,
                 "wal_backlog_hard_entries": 4096,
                 "memstore_reject_fraction": 0.95,
+                # query-pushdown routing for the E mix (ROADMAP item 5):
+                # predicate-free scan pages ride the fused device scan
+                # over resident slabs once a tablet is big enough; the
+                # ratio served that way is recorded below
+                "scan_pushdown_pages": os.environ.get(
+                    "YBTPU_BENCH_E_PUSHDOWN", "1") == "1",
+                "scan_pushdown_min_rows": 1024,
             }).start()
         c.wait_tservers_alive(3)
         client = c.new_client()
@@ -1187,6 +1368,27 @@ def _ycsb_stage() -> dict:
             if mix == "e":
                 out["ycsb_e_scan_rows_per_sec"] = round(
                     rep.scan_rows / rep.seconds, 1) if rep.seconds else 0
+                # scan-page routing: what fraction of E's pages the
+                # fused filtered path actually served (per-tserver
+                # scan_pushdown_status scrape; cumulative counters, but
+                # only the E mix issues scan RPCs)
+                pages = pushed = 0
+                for ts in c.tservers:
+                    try:
+                        sc = client._messenger.call(
+                            ts.address, "tserver", "scan_pushdown_status",
+                            timeout_s=10.0)["scans"]
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        log(f"  pushdown scrape of {ts.address} "
+                            f"failed: {e}")
+                        continue
+                    pages += sc.get("scan_rpc_pages_total", 0)
+                    pushed += sc.get("scan_rpc_pages_pushdown_total", 0)
+                out["ycsb_e_pushdown_ratio"] = round(
+                    pushed / pages, 3) if pages else 0.0
+                log(f"  ycsb-e pushdown ratio: "
+                    f"{out['ycsb_e_pushdown_ratio']} "
+                    f"({pushed}/{pages} pages)")
             log(f"  ycsb-{mix}: {rep.ops_per_sec:.0f} ops/s over "
                 f"{rep.seconds:.0f}s, p50 {rep.p50_ms}ms "
                 f"p99 {rep.p99_ms}ms, {rep.errors} errors")
@@ -1435,6 +1637,9 @@ def main():
     if len(sys.argv) >= 5 and sys.argv[1] == "--points":
         run_points_child(sys.argv[2], sys.argv[3], sys.argv[4])
         return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--analytics":
+        run_analytics_child(sys.argv[2], sys.argv[3])
+        return
     if len(sys.argv) >= 4 and sys.argv[1] == "--child":
         run_device_child(sys.argv[2], sys.argv[3],
                          sys.argv[4] if len(sys.argv) > 4 else None)
@@ -1519,6 +1724,18 @@ def main():
     result.update(_scan_point_stages(
         int(result.get("n_rows") or n_top),
         tpu_ok=result.get("platform") == "tpu"))
+    # analytics rung (ROADMAP item 5): fused filtered/aggregating scans
+    # vs the per-row host query path (TPU when the tunnel is up, else
+    # CPU-labeled — same child-watchdog discipline as --points)
+    if os.environ.get("YBTPU_BENCH_SKIP_ANALYTICS", "") != "1":
+        plat = "tpu" if result.get("platform") == "tpu" else "cpu"
+        n_an = str(min(int(result.get("n_rows") or n_top), 1 << 18))
+        ana = _spawn_child(plat, 600, n_an, mode="--analytics")
+        if ana is None and plat == "tpu":
+            log("TPU analytics child failed — retrying on CPU fallback")
+            ana = _spawn_child("cpu", 600, n_an, mode="--analytics")
+        if ana:
+            result.update(ana)
     # BASELINE config 5: the 3-node RF=3 cluster soak with churn
     if os.environ.get("YBTPU_BENCH_SKIP_SOAK", "") != "1":
         result.update(_cluster_soak_stage())

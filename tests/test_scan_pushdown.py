@@ -1,0 +1,436 @@
+"""Query pushdown differential suite (ROADMAP item 5).
+
+The fused filtered/aggregating scan kernels (ops/scan.py) must produce
+EXACTLY what the per-row host path produces — across MVCC snapshots,
+tombstones, TTL, overlay writes, NULLs, projection, range bounds, mixed
+memtable/SST/resident sources — and every storage-side blocker (deep
+documents, intents, device faults) must fall back to the host path with
+identical results, a quarantined bucket, counted reasons, and zero
+leaked pins.
+"""
+
+import operator
+import random
+
+import pytest
+
+from yugabyte_tpu.common.hybrid_time import HybridTime
+from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
+from yugabyte_tpu.docdb import scan_spec as SS
+from yugabyte_tpu.docdb.doc_key import DocKey
+from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
+from yugabyte_tpu.ops import device_faults
+from yugabyte_tpu.storage import offload_policy
+from yugabyte_tpu.storage.device_cache import DeviceSlabCache
+from yugabyte_tpu.tablet.tablet import Tablet, TabletOptions
+
+SCHEMA = Schema(
+    columns=[
+        ColumnSchema("h", DataType.STRING),
+        ColumnSchema("r", DataType.INT64),
+        ColumnSchema("v", DataType.INT64),
+        ColumnSchema("w", DataType.INT32),
+        ColumnSchema("b", DataType.BOOL),
+        ColumnSchema("s", DataType.STRING),
+    ],
+    num_hash_key_columns=1,
+    num_range_key_columns=1,
+)
+
+_OPS = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
+        ">": operator.gt, "<=": operator.le, ">=": operator.ge}
+
+
+def dk(h, r):
+    return DocKey(hash_components=(h,), range_components=(r,))
+
+
+def wire_match(d, preds):
+    """The ROW-SCAN filter contract (common/wire.FILTER_OPS): what the
+    tserver's host fallback evaluates — NULL fails everything EXCEPT
+    `!=`, which it passes. tablet.scan_pushdown must match this."""
+    from yugabyte_tpu.common.wire import row_matches
+    return row_matches(d, [list(p) for p in preds])
+
+
+def host_match(d, preds):
+    """The CQL executor's _match semantics: NULL fails every operator —
+    the AGGREGATE-mode contract (no per-row re-check exists there)."""
+    for c, op, val in preds:
+        have = d.get(c)
+        if have is None or not _OPS[op](have, val):
+            return False
+    return True
+
+
+def host_rows(tablet, preds, read_ht=None, lower=b"", upper=None,
+              projection=None):
+    it = tablet.scan(read_ht, lower_doc_key=lower, upper_doc_key=upper,
+                     projection=projection, use_device=False)
+    out = []
+    for row in it:
+        d = row.to_dict(SCHEMA)
+        if wire_match(d, preds):
+            out.append((row.doc_key.encode(), sorted(row.columns.items())))
+    return out
+
+
+def pushed_rows(tablet, preds, read_ht=None, lower=b"", upper=None,
+                projection=None):
+    spec = mkspec(preds)
+    it = tablet.scan_pushdown(read_ht, lower_doc_key=lower,
+                              upper_doc_key=upper, projection=projection,
+                              spec=spec)
+    assert it is not None, "pushdown unexpectedly fell back"
+    return [(row.doc_key.encode(), sorted(row.columns.items()))
+            for row in it]
+
+
+def mkspec(preds=(), aggs=()):
+    ps = []
+    for c, op, val in preds:
+        p = SS.compile_predicate(SCHEMA, c, op, val)
+        assert p is not None, (c, op, val)
+        ps.append(p)
+    ags = []
+    for f, c in aggs:
+        a = SS.compile_aggregate(SCHEMA, f, c)
+        assert a is not None, (f, c)
+        ags.append(a)
+    return SS.ScanSpec(tuple(ps), tuple(ags))
+
+
+def host_agg(tablet, preds, aggs, read_ht=None):
+    dicts = [d for d in (r.to_dict(SCHEMA) for r in
+                         tablet.scan(read_ht, use_device=False))
+             if host_match(d, preds)]
+    out = {"rows": len(dicts), "cols": {}}
+    for _f, c in aggs:
+        if c is None or c in out["cols"]:
+            continue
+        vals = [d[c] for d in dicts if d.get(c) is not None]
+        out["cols"][c] = {
+            "nonnull": len(vals),
+            "sum": sum(vals) if vals and not isinstance(vals[0], bool)
+            else 0,
+            "min": min(vals) if vals else None,
+            "max": max(vals) if vals else None,
+        }
+    return out
+
+
+def _device():
+    import jax
+    return jax.devices()[0]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    from yugabyte_tpu.utils import flags
+    device_faults.disarm_all()
+    offload_policy.bucket_quarantine().clear()
+    prior = flags.get_flag("scan_pushdown_min_rows")
+    flags.set_flag("scan_pushdown_min_rows", 0)
+    yield
+    flags.set_flag("scan_pushdown_min_rows", prior)
+    device_faults.disarm_all()
+    offload_policy.bucket_quarantine().clear()
+
+
+@pytest.fixture
+def tablet(tmp_path):
+    cache = DeviceSlabCache(device=_device())
+    t = Tablet("t-pushdown", str(tmp_path), SCHEMA,
+               options=TabletOptions(auto_compact=False, device=_device(),
+                                     device_cache=cache, block_entries=32))
+    t.device_cache = cache
+    yield t
+    t.close()
+
+
+def workload(t, seed, n_ops=240, n_flushes=3):
+    """Inserts/updates/row+column deletes/TTL/NULLs across flushes;
+    returns one captured snapshot HT per phase."""
+    rng = random.Random(seed)
+    snapshots = []
+    for _phase in range(n_flushes):
+        for _ in range(n_ops // n_flushes):
+            h = f"h{rng.randint(0, 4)}"
+            r = rng.randint(0, 24)
+            roll = rng.random()
+            if roll < 0.55:
+                t.write([QLWriteOp(
+                    WriteOpKind.INSERT, dk(h, r),
+                    {"v": rng.randint(-500, 500),
+                     "w": rng.randint(-99, 99),
+                     "b": rng.random() < 0.5,
+                     "s": rng.choice([None, f"s{rng.randint(0, 9)}"])},
+                    ttl_ms=rng.choice([None] * 8 + [0, 10 ** 9]))])
+            elif roll < 0.78:
+                vals = {}
+                if rng.random() < 0.7:
+                    vals["v"] = rng.choice([None, rng.randint(-500, 500)])
+                if rng.random() < 0.5:
+                    vals["b"] = rng.random() < 0.5
+                if vals:
+                    t.write([QLWriteOp(WriteOpKind.UPDATE, dk(h, r),
+                                       vals)])
+            elif roll < 0.92:
+                t.write([QLWriteOp(WriteOpKind.DELETE_ROW, dk(h, r))])
+            else:
+                t.write([QLWriteOp(WriteOpKind.DELETE_COLS, dk(h, r),
+                                   columns_to_delete=("v",))])
+        snapshots.append(t.clock.now())
+        t.flush()
+    return snapshots
+
+
+PRED_SETS = [
+    [("v", "<", 100)],
+    [("v", ">=", -50), ("v", "<", 250)],
+    [("b", "=", True)],
+    [("v", "!=", 0), ("b", "=", False)],
+    [("w", ">", 0)],
+]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_filtered_matches_host_across_snapshots(tablet, seed):
+    snapshots = workload(tablet, seed)
+    for preds in PRED_SETS:
+        for ht in [None] + snapshots:
+            assert pushed_rows(tablet, preds, read_ht=ht) \
+                == host_rows(tablet, preds, read_ht=ht), (preds, ht)
+
+
+def test_filtered_projection_and_bounds(tablet):
+    workload(tablet, 7)
+    preds = [("v", "<", 200)]
+    lo = dk("h1", 0).encode()
+    hi = dk("h3", 0).encode()
+    assert pushed_rows(tablet, preds, lower=lo, upper=hi) \
+        == host_rows(tablet, preds, lower=lo, upper=hi)
+    assert pushed_rows(tablet, preds, projection=("v", "b")) \
+        == host_rows(tablet, preds, projection=("v", "b"))
+
+
+AGG_SETS = [
+    [("count", None)],
+    [("count", None), ("count", "v"), ("count", "b")],
+    [("sum", "v"), ("min", "v"), ("max", "v")],
+    [("sum", "w"), ("min", "w"), ("max", "w"), ("count", None)],
+]
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_aggregate_matches_host(tablet, seed):
+    snapshots = workload(tablet, seed)
+    for aggs in AGG_SETS:
+        for preds in ([], [("v", "<", 100)], [("b", "=", True)]):
+            spec = mkspec(preds, aggs)
+            if not spec.aggregates:
+                continue
+            for ht in [None, snapshots[-1]]:
+                got = tablet.scan_aggregate(ht, spec=spec)
+                assert got is not None
+                want = host_agg(tablet, preds, aggs, read_ht=ht)
+                assert got["rows"] == want["rows"], (aggs, preds)
+                for _f, c in aggs:
+                    if c is None:
+                        continue
+                    cid = SCHEMA.column_id(c)
+                    g = got["cols"][cid]
+                    w = want["cols"][c]
+                    assert g["nonnull"] == w["nonnull"], (aggs, preds, c)
+                    if c in ("v", "w"):  # int columns: sums/extremes
+                        assert g["sum"] == w["sum"], (aggs, preds, c)
+                        assert g["min"] == w["min"], (aggs, preds, c)
+                        assert g["max"] == w["max"], (aggs, preds, c)
+
+
+def test_null_and_type_subset():
+    # NULL fails every operator including != (the executor rule); a
+    # predicate on strings/floats/collections must refuse to compile
+    assert SS.compile_predicate(SCHEMA, "s", "=", "x") is None
+    assert SS.compile_predicate(SCHEMA, "v", "<", 1.5) is None
+    assert SS.compile_predicate(SCHEMA, "v", "=", True) is None
+    assert SS.compile_predicate(SCHEMA, "v", "=", None) is None
+    assert SS.compile_predicate(SCHEMA, "h", "=", "k") is None  # key col
+    assert SS.compile_aggregate(SCHEMA, "sum", "s") is None
+    assert SS.compile_aggregate(SCHEMA, "sum", "b") is None
+    assert SS.compile_aggregate(SCHEMA, "count", "r") is None  # key col
+    assert SS.compile_aggregate(SCHEMA, "count", "b") is not None
+
+
+def test_null_semantics_match_wire_contract(tablet):
+    """NULL/absent columns: every operator except != excludes them, and
+    != INCLUDES them — exactly common/wire.FILTER_OPS (the pgsql
+    pushdown contract; the CQL executor re-applies its stricter _match
+    client-side)."""
+    t = tablet
+    t.write([QLWriteOp(WriteOpKind.INSERT, dk("ha", 1), {"v": 5})])
+    t.write([QLWriteOp(WriteOpKind.INSERT, dk("ha", 2), {"v": None})])
+    t.write([QLWriteOp(WriteOpKind.UPDATE, dk("ha", 3), {"v": 7})])
+    t.write([QLWriteOp(WriteOpKind.UPDATE, dk("ha", 3), {"v": None})])
+    t.flush()
+    for preds in ([("v", "!=", 5)], [("v", "=", 5)], [("v", "<", 100)],
+                  [("v", ">=", -100)]):
+        assert pushed_rows(t, preds) == host_rows(t, preds), preds
+
+
+def test_resident_scan_attaches_vals_once(tablet):
+    workload(tablet, 11)
+    preds = [("v", "<", 100)]
+    base = tablet.device_cache.snapshot()
+    first = pushed_rows(tablet, preds)
+    m0 = _fallback_value("vals")  # unrelated counter must not move
+    again = pushed_rows(tablet, preds)
+    assert first == again == host_rows(tablet, preds)
+    assert _fallback_value("vals") == m0
+    snap = tablet.device_cache.snapshot()
+    assert snap["entries"] >= base["entries"]
+    # zero pins leaked by the scans
+    assert tablet.device_cache.pinned_count() == 0
+
+
+def _fallback_value(reason) -> int:
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    e = ROOT_REGISTRY.entity("server", "scan_pushdown")
+    return e.counter(f"scan_pushdown_fallback_{reason}_total").value()
+
+
+def test_deep_documents_fall_back(tablet):
+    tablet.write([QLWriteOp(WriteOpKind.INSERT, dk("hd", 1), {"v": 1})])
+    tablet.write_subdocument(dk("hd", 1), ("doc", "a"), {"x": 1})
+    tablet.flush()
+    before = _fallback_value("deep")
+    spec = mkspec([("v", "=", 1)])
+    assert tablet.scan_pushdown(spec=spec) is None
+    assert _fallback_value("deep") == before + 1
+    assert tablet.scan_aggregate(
+        spec=mkspec((), [("count", None)])) is None
+    # the host path still answers the query
+    assert host_rows(tablet, [("v", "=", 1)])
+
+
+@pytest.mark.parametrize("site", ["dispatch", "result"])
+@pytest.mark.parametrize("kind", ["compile", "oom"])
+def test_device_fault_falls_back_and_quarantines(tablet, site, kind):
+    workload(tablet, 5, n_ops=90, n_flushes=1)
+    preds = [("v", "<", 100)]
+    want = host_rows(tablet, preds)
+    spec = mkspec(preds)
+    fb0 = _fallback_value("fault")
+    device_faults.arm(kind, site=site, count=1)
+    assert tablet.scan_pushdown(spec=spec) is None
+    assert device_faults.armed_count() == 0, "fault must have fired"
+    assert _fallback_value("fault") == fb0 + 1
+    # bucket parked: the NEXT attempt refuses pre-dispatch (no re-fault)
+    q0 = _fallback_value("quarantined")
+    assert tablet.scan_pushdown(spec=spec) is None
+    assert _fallback_value("quarantined") == q0 + 1
+    # host path serves the identical result; zero pins leaked
+    assert host_rows(tablet, preds) == want
+    assert tablet.device_cache.pinned_count() == 0
+    offload_policy.bucket_quarantine().clear()
+    assert pushed_rows(tablet, preds) == want
+
+
+def test_agg_device_fault_falls_back(tablet):
+    workload(tablet, 6, n_ops=90, n_flushes=1)
+    spec = mkspec([("v", "<", 100)], [("count", None), ("sum", "v")])
+    device_faults.arm("runtime", site="result", count=1)
+    assert tablet.scan_aggregate(spec=spec) is None
+    assert tablet.device_cache.pinned_count() == 0
+    got = tablet.scan_aggregate(spec=spec)
+    # quarantined from the fault above -> still None until decay/clear
+    assert got is None
+    offload_policy.bucket_quarantine().clear()
+    got = tablet.scan_aggregate(spec=spec)
+    want = host_agg(tablet, [("v", "<", 100)],
+                    [("count", None), ("sum", "v")])
+    assert got["rows"] == want["rows"]
+    assert got["cols"][SCHEMA.column_id("v")]["sum"] \
+        == want["cols"]["v"]["sum"]
+
+
+def test_pushdown_disabled_flag(tablet):
+    from yugabyte_tpu.utils import flags
+    tablet.write([QLWriteOp(WriteOpKind.INSERT, dk("hf", 1), {"v": 1})])
+    spec = mkspec([("v", "=", 1)])
+    flags.set_flag("scan_pushdown", False)
+    try:
+        before = _fallback_value("disabled")
+        assert tablet.scan_pushdown(spec=spec) is None
+        assert _fallback_value("disabled") == before + 1
+    finally:
+        flags.set_flag("scan_pushdown", True)
+    assert tablet.scan_pushdown(spec=spec) is not None
+
+
+# ------------------------------------------------------------ end-to-end
+# Executor-level pushdown over a MiniCluster: SELECT count(*)/sum(...)
+# WHERE rides the aggregate scan RPC (dispatch + result sites live), and
+# the filtered row path returns exactly the host path's rows.
+
+@pytest.fixture(scope="module")
+def ql_cluster(tmp_path_factory):
+    from yugabyte_tpu.integration.mini_cluster import (
+        MiniCluster, MiniClusterOptions)
+    from yugabyte_tpu.utils import flags
+    from yugabyte_tpu.yql.cql.executor import QLProcessor
+    flags.set_flag("replication_factor", 1)
+    flags.set_flag("scan_pushdown_min_rows", 0)
+    c = MiniCluster(MiniClusterOptions(
+        num_masters=1, num_tservers=1,
+        fs_root=str(tmp_path_factory.mktemp("pushdown-cluster")))).start()
+    ql = QLProcessor(c.new_client())
+    ql.execute("CREATE KEYSPACE pd")
+    ql.execute("USE pd")
+    ql.execute("CREATE TABLE t (k INT, v BIGINT, b BOOLEAN, s TEXT, "
+               "PRIMARY KEY ((k)))")
+    c.wait_for_table_leaders("pd", "t")
+    for i in range(60):
+        ql.execute("INSERT INTO t (k, v, b, s) VALUES (?, ?, ?, ?)",
+                   [i, (i * 7) - 100, i % 3 == 0,
+                    None if i % 5 == 0 else f"s{i}"])
+    yield c, ql
+    flags.set_flag("scan_pushdown_min_rows", 4096)
+    c.shutdown()
+
+
+def _agg_counter() -> int:
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    e = ROOT_REGISTRY.entity("server", "scan_pushdown")
+    return e.counter("scan_pushdown_agg_total").value()
+
+
+def test_executor_aggregate_pushdown_end_to_end(ql_cluster):
+    _c, ql = ql_cluster
+    before = _agg_counter()
+    rs = ql.execute("SELECT COUNT(*), SUM(v), MIN(v), MAX(v), AVG(v) "
+                    "FROM t WHERE v >= 0 AND v < 250")
+    ks = [i for i in range(60) if 0 <= (i * 7) - 100 < 250]
+    vals = [(i * 7) - 100 for i in ks]
+    assert rs.rows[0] == [len(ks), sum(vals), min(vals), max(vals),
+                          sum(vals) // len(vals)]
+    assert _agg_counter() > before, "aggregate did not ride the device"
+    # COUNT(col) excludes NULLs; bool predicate composes
+    rs = ql.execute("SELECT COUNT(s) FROM t WHERE b = true")
+    want = sum(1 for i in range(60) if i % 3 == 0 and i % 5 != 0)
+    assert rs.rows[0] == [want]
+
+
+def test_executor_filtered_pushdown_matches_host(ql_cluster):
+    _c, ql = ql_cluster
+    from yugabyte_tpu.utils import flags
+    q = "SELECT k, v FROM t WHERE v > -40 AND v <= 120"
+    pushed = sorted(map(tuple, ql.execute(q).rows))
+    flags.set_flag("scan_pushdown", False)
+    try:
+        host = sorted(map(tuple, ql.execute(q).rows))
+    finally:
+        flags.set_flag("scan_pushdown", True)
+    assert pushed == host
+    assert pushed == sorted((i, (i * 7) - 100) for i in range(60)
+                            if -40 < (i * 7) - 100 <= 120)

@@ -1335,6 +1335,51 @@ class TestKernelContracts:
             prewarmed=True, qkey=[4, 512]))
         assert [c for c, _, _ in probs] == ["policy-key-mismatch"]
 
+    # ------------------------------- pushdown-family (PR 13) fixtures
+    def _scan_pushdown_manifest(self, prewarmed=True, qkey=None):
+        return {"families": {"scan_agg": {"entries": [{
+            "key": "scan_agg c_pad=1 n_pad=65536 p_pad=1 w=4 "
+                   "impl=vals-presorted",
+            "bucket": {"c_pad": 1, "n_pad": 65536, "p_pad": 1, "w": 4},
+            "prewarmed": prewarmed,
+            "quarantine_key": qkey if qkey is not None else [1, 65536],
+        }]}}}
+
+    def test_scan_pushdown_unwarmed_fixture(self):
+        from tools.analysis.passes.kernel_contracts import (
+            coverage_problems)
+        probs = coverage_problems(
+            self._scan_pushdown_manifest(prewarmed=False))
+        codes = [c for c, _, _ in probs]
+        assert codes == ["unwarmed-bucket"]
+        assert probs[0][1].startswith("scan_agg ")
+
+    def test_scan_pushdown_clean_fixture(self):
+        from tools.analysis.passes.kernel_contracts import (
+            coverage_problems)
+        assert coverage_problems(self._scan_pushdown_manifest()) == []
+
+    def test_committed_manifest_declares_pushdown_families(self):
+        """The committed manifest carries the scan_filtered/scan_agg
+        lattices with prewarmed entries whose quarantine keys speak the
+        (1, n_pad) vocabulary of offload_policy.point_read_bucket_key —
+        the same keys the runtime fault containment parks."""
+        from tools.analysis.kernel_manifest import load_manifest
+        from yugabyte_tpu.storage.offload_policy import (
+            point_read_bucket_key)
+        m = load_manifest()
+        assert m is not None
+        for fam in ("scan_filtered", "scan_agg"):
+            rec = m["families"][fam]
+            entries = [e for e in rec["entries"]
+                       if e.get("quarantine_key")]
+            assert entries, fam
+            assert any(e["prewarmed"] for e in entries), fam
+            for e in entries:
+                n_pad = e["bucket"]["n_pad"]
+                assert tuple(e["quarantine_key"]) \
+                    == point_read_bucket_key(n_pad), e["key"]
+
     def test_manifest_drift_reported_as_finding(self, tmp_path):
         """The pass turns a committed-JSON drift into a finding anchored
         at ops/run_merge.py (the tier-1 gate path)."""

@@ -67,6 +67,9 @@ def ql(cluster):
     p.execute("USE store")
     p.execute("CREATE TABLE items (cat TEXT, sku TEXT, price BIGINT, "
               "name TEXT, PRIMARY KEY ((cat), sku)) WITH tablets = 2")
+    # READY-leader poll before the first write (the known RF3 create-
+    # then-write election flake; PR-7 deflake pattern)
+    cluster.wait_for_table_leaders("store", "items")
     return p
 
 
@@ -106,8 +109,9 @@ def test_cql_update_bind_order(ql):
     assert rs.rows == [[777]]
 
 
-def test_cql_blob_literal(ql):
+def test_cql_blob_literal(ql, cluster):
     ql.execute("CREATE TABLE blobs (k TEXT PRIMARY KEY, data BLOB)")
+    cluster.wait_for_table_leaders("store", "blobs")
     ql.execute("INSERT INTO blobs (k, data) VALUES ('b', 0xDEADBEEF)")
     rs = ql.execute("SELECT data FROM blobs WHERE k = 'b'")
     assert rs.rows == [[bytes.fromhex("deadbeef")]]
@@ -395,6 +399,7 @@ def test_cql_alter_table(cluster):
     ql.execute("CREATE KEYSPACE altks")
     ql.execute("USE altks")
     ql.execute("CREATE TABLE at (k text, v text, PRIMARY KEY ((k)))")
+    cluster.wait_for_table_leaders("altks", "at")
     ql.execute("INSERT INTO at (k, v) VALUES ('a', '1')")
     ql.execute("ALTER TABLE at ADD extra int")
     ql.execute("INSERT INTO at (k, v, extra) VALUES ('b', '2', 42)")
@@ -550,9 +555,10 @@ def test_redis_rename_dual_representation(redis):
     assert redis.cmd("EXISTS", "dual") == 0
 
 
-def test_cql_aggregates(ql):
+def test_cql_aggregates(ql, cluster):
     ql.execute("CREATE TABLE agg (k TEXT, r INT, price BIGINT, "
                "name TEXT, PRIMARY KEY ((k), r)) WITH tablets = 2")
+    cluster.wait_for_table_leaders("store", "agg")
     for i in range(6):
         ql.execute("INSERT INTO agg (k, r, price, name) VALUES "
                    "('p', %d, %d, '%s')"
@@ -582,11 +588,12 @@ def test_cql_aggregates(ql):
     assert rs.rows == [[0, 0, None]]
 
 
-def test_cql_count_limit_counts_all_rows(ql):
+def test_cql_count_limit_counts_all_rows(ql, cluster):
     """LIMIT on an aggregate applies to the one-row RESULT, not to the
     scan feeding it (ADVICE r5: `SELECT COUNT(*) ... LIMIT 1` truncated
     the scan to 1 row and returned count=1)."""
     ql.execute("CREATE TABLE cntl (k TEXT, r INT, PRIMARY KEY ((k), r))")
+    cluster.wait_for_table_leaders("store", "cntl")
     for i in range(9):
         ql.execute("INSERT INTO cntl (k, r) VALUES ('p', %d)" % i)
     rs = ql.execute("SELECT COUNT(*) FROM cntl WHERE k = 'p' LIMIT 1")
@@ -595,8 +602,9 @@ def test_cql_count_limit_counts_all_rows(ql):
     assert rs.rows == [[9]]
 
 
-def test_cql_aggregate_edges(ql):
+def test_cql_aggregate_edges(ql, cluster):
     ql.execute("CREATE TABLE aggm (k TEXT PRIMARY KEY, m MAP<TEXT,INT>)")
+    cluster.wait_for_table_leaders("store", "aggm")
     ql.execute("INSERT INTO aggm (k, m) VALUES ('a', {'x': 1})")
     ql.execute("INSERT INTO aggm (k, m) VALUES ('b', {'y': 2})")
     with pytest.raises(Exception, match="comparable"):
@@ -605,8 +613,9 @@ def test_cql_aggregate_edges(ql):
         ql.execute("SELECT COUNT(*) FROM system.peers")
 
 
-def test_cql_sum_int32_widens(ql):
+def test_cql_sum_int32_widens(ql, cluster):
     ql.execute("CREATE TABLE s32 (k TEXT PRIMARY KEY, v INT)")
+    cluster.wait_for_table_leaders("store", "s32")
     ql.execute("INSERT INTO s32 (k, v) VALUES ('a', 2000000000)")
     ql.execute("INSERT INTO s32 (k, v) VALUES ('b', 2000000000)")
     rs = ql.execute("SELECT SUM(v) FROM s32")

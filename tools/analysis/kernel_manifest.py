@@ -98,6 +98,43 @@ FAMILIES: Dict[str, dict] = {
             _MERGE_GC: ["sort_and_gc", "gc_over_sorted", "bucket_size"],
         },
     },
+    "scan_filtered": {
+        # query pushdown (ROADMAP item 5): snapshot scan + row-level
+        # predicate filter in one program. Predicates/bounds ride as
+        # OPERAND DATA padded to the PRED_SLOTS lattice, so the compile
+        # key is (n_pad, w, p_pad) x the presorted axis (a single SST
+        # source skips the merge sort + gather — the CPU fast path).
+        "budget": 16,
+        "anchor": _SCAN,
+        "symbols": {
+            _SCAN: ["_scan_filtered_fused", "_pushdown_base", "_row_pass",
+                    "_segment_any", "_seg_or_combine", "_doc_segments",
+                    "_key_byte_at", "_cmp_words", "_pack_bound",
+                    "_concat_vals_fused", "pack_vals", "VAL_WORDS",
+                    "_VAL_ROWS", "PRED_SLOTS", "pred_slot_bucket",
+                    "_PREWARM_NPADS", "_PREWARM_W"],
+            _MERGE_GC: ["sort_and_gc", "gc_over_sorted", "bucket_size",
+                        "pack_bits_u32"],
+        },
+    },
+    "scan_agg": {
+        # fused aggregating scan: COUNT/SUM/MIN/MAX via segment-reduce
+        # over the filtered row set — one dispatch per (tablet, query),
+        # scalars only cross back. Aggregate column selectors are data
+        # (AGG_SLOTS lattice); has_vals covers the COUNT(*)-only shape;
+        # the presorted axis mirrors scan_filtered.
+        "budget": 32,
+        "anchor": _SCAN,
+        "symbols": {
+            _SCAN: ["_scan_agg_fused", "_pushdown_base", "_row_pass",
+                    "_segment_any", "_seg_or_combine", "_doc_segments",
+                    "_key_byte_at", "_cmp_words", "_pack_bound",
+                    "VAL_WORDS", "_VAL_ROWS", "PRED_SLOTS", "AGG_SLOTS",
+                    "pred_slot_bucket", "agg_slot_bucket",
+                    "_PREWARM_NPADS", "_PREWARM_W"],
+            _MERGE_GC: ["sort_and_gc", "gc_over_sorted", "bucket_size"],
+        },
+    },
     "gather_staged": {
         "budget": 12,
         "anchor": _RUN_MERGE,
@@ -540,6 +577,138 @@ def _gen_scan_fused() -> dict:
     return {"entries": entries}
 
 
+def _scan_pushdown_args(jax, jnp, n_pad: int, w: int, p_pad: int,
+                        has_vals: bool):
+    sdt = jax.ShapeDtypeStruct
+    i32 = sdt((), jnp.int32)
+    u32 = sdt((), jnp.uint32)
+    b1 = sdt((), jnp.bool_)
+    from yugabyte_tpu.ops.scan import _VAL_ROWS, VAL_WORDS
+    return (sdt((_ROW_WORDS + w, n_pad), jnp.uint32),
+            sdt((_VAL_ROWS, n_pad if has_vals else 1), jnp.uint32),
+            sdt((4 + w,), jnp.int32), i32, u32, u32, u32, u32,
+            sdt((w,), jnp.uint32), i32, sdt((w,), jnp.uint32), i32,
+            b1, b1,
+            sdt((p_pad,), jnp.uint32), sdt((p_pad,), jnp.int32),
+            sdt((p_pad,), jnp.int32),
+            sdt((p_pad,), jnp.uint32), sdt((p_pad,), jnp.uint32),
+            sdt((p_pad, VAL_WORDS), jnp.uint32), sdt((p_pad,), jnp.int32))
+
+
+def _gen_scan_filtered() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import scan as scan_mod
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    w = scan_mod._PREWARM_W
+    for n_pad in scan_mod._PREWARM_NPADS:
+        for p_pad in scan_mod.PRED_SLOTS:
+          for presorted in (False, True):
+            args = _scan_pushdown_args(jax, jnp, n_pad, w, p_pad, True)
+            statics = dict(w=w, p_pad=p_pad, presorted=presorted)
+            out = jax.eval_shape(
+                lambda *a: scan_mod._scan_filtered_fused(*a, **statics),
+                *args)
+            text = lowering_text(scan_mod._scan_filtered_fused, args,
+                                 statics)
+            bucket = {"n_pad": n_pad, "p_pad": p_pad, "w": w}
+            entries.append({
+                "key": "scan_filtered " + entry_key(
+                    bucket, "presorted" if presorted else "merge"),
+                "bucket": bucket,
+                "impl": "presorted" if presorted else "merge",
+                "static_args": statics,
+                "in_avals": [_aval_str(a) for a in args],
+                "out_avals": [_aval_str(o) for o in
+                              jax.tree_util.tree_leaves(out)],
+                # inputs are LIVE slab-cache entries (cols + vals):
+                # donation is forbidden by design
+                "donation": None,
+                "variant_axes": {},
+                "executables": 1,
+                "prewarmed": True,
+                "quarantine_key": [1, n_pad],
+                "lowering_sha256": _lowering_sha256(text),
+            })
+    # the per-source vals concat (row-aligned twin of concat_staged):
+    # one representative — real k varies with the source count, like
+    # concat_staged_fused in the restage_concat family
+    n_in, k, n_pad = 1 << 16, 4, 1 << 18
+    from yugabyte_tpu.ops.scan import _VAL_ROWS
+    parts = tuple(jax.ShapeDtypeStruct((_VAL_ROWS, n_in), jnp.uint32)
+                  for _ in range(k))
+    args = (parts, jax.ShapeDtypeStruct((k,), jnp.int32))
+    statics = dict(n_pad=n_pad)
+    out = jax.eval_shape(
+        lambda *a: scan_mod._concat_vals_fused(*a, **statics), *args)
+    text = lowering_text(scan_mod._concat_vals_fused, args, statics)
+    bucket = {"n_pad": n_pad}
+    entries.append({
+        "key": "concat_vals " + entry_key(bucket),
+        "bucket": bucket,
+        "static_args": statics,
+        "in_avals": [_aval_str(a) for a in
+                     jax.tree_util.tree_leaves(args)],
+        "out_avals": [_aval_str(o) for o in
+                      jax.tree_util.tree_leaves(out)],
+        "donation": None,
+        "variant_axes": {},
+        "executables": 1,
+        "prewarmed": False,
+        "quarantine_key": None,
+        "lowering_sha256": _lowering_sha256(text),
+    })
+    return {"entries": entries}
+
+
+def _gen_scan_agg() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import scan as scan_mod
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    w = scan_mod._PREWARM_W
+    for n_pad in scan_mod._PREWARM_NPADS:
+        combos = [(p, c, True) for p in scan_mod.PRED_SLOTS
+                  for c in scan_mod.AGG_SLOTS] + [(1, 1, False)]
+        for p_pad, c_pad, has_vals in combos:
+          for presorted in (False, True):
+            sdt = jax.ShapeDtypeStruct
+            args = _scan_pushdown_args(jax, jnp, n_pad, w, p_pad,
+                                       has_vals) + (
+                sdt((c_pad,), jnp.uint32), sdt((c_pad,), jnp.uint32),
+                sdt((c_pad,), jnp.uint32))
+            statics = dict(w=w, p_pad=p_pad, c_pad=c_pad,
+                           has_vals=has_vals, presorted=presorted)
+            out = jax.eval_shape(
+                lambda *a: scan_mod._scan_agg_fused(*a, **statics),
+                *args)
+            text = lowering_text(scan_mod._scan_agg_fused, args, statics)
+            bucket = {"c_pad": c_pad, "n_pad": n_pad, "p_pad": p_pad,
+                      "w": w}
+            impl = ("vals" if has_vals else "novals") + (
+                "-presorted" if presorted else "-merge")
+            entries.append({
+                "key": "scan_agg " + entry_key(bucket, impl),
+                "bucket": bucket,
+                "impl": impl,
+                "static_args": statics,
+                "in_avals": [_aval_str(a) for a in args],
+                "out_avals": [_aval_str(o) for o in
+                              jax.tree_util.tree_leaves(out)],
+                "donation": None,
+                "variant_axes": {},
+                "executables": 1,
+                "prewarmed": True,
+                "quarantine_key": [1, n_pad],
+                "lowering_sha256": _lowering_sha256(text),
+            })
+    return {"entries": entries}
+
+
 def _gen_gather_staged() -> dict:
     """Write-through gather lattice, derived from _PREWARM_SHAPES: every
     prewarm bucket's merge is immediately followed by one survivor scan
@@ -946,6 +1115,8 @@ _GENERATORS = {
     "run_merge_fused": _gen_run_merge_fused,
     "merge_gc_fused": _gen_merge_gc_fused,
     "scan_fused": _gen_scan_fused,
+    "scan_filtered": _gen_scan_filtered,
+    "scan_agg": _gen_scan_agg,
     "gather_staged": _gen_gather_staged,
     "restage_concat": _gen_restage_concat,
     "pallas_merge": _gen_pallas_merge,

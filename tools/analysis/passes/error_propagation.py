@@ -72,7 +72,11 @@ _SEED_NAME_RE = re.compile(
     # "drop on the floor" — the exact failure the soak's
     # zero-acked-loss invariant exists to catch. (\b guards keep
     # 'shed' from seeding every 'flushed'/'pushed'/'finished'.)
-    r"|throttle|overload|admission|\bshed|_shed\b",
+    r"|throttle|overload|admission|\bshed|_shed\b"
+    # PR 13 query pushdown: a swallowed error in the fused-scan fallback
+    # machinery would silently serve WRONG RESULTS instead of routing
+    # the query back to the byte-identical host path
+    r"|pushdown|scan_spec|scan_filtered|scan_aggregate",
     re.IGNORECASE)
 _WAL_MODULE_SUFFIX = ".consensus.log"
 _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
@@ -85,7 +89,11 @@ _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
                          # a contained signal-read error would silently
                          # disable a shedding arm under the exact load
                          # that needs it
-                         ".tablet.admission")
+                         ".tablet.admission",
+                         # PR 13: the pushdown compile-subset classifier
+                         # — a swallowed classification error turns
+                         # "fall back host-side" into a wrong answer
+                         ".docdb.scan_spec")
 _MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
 _DEF_MARKER = "# yblint: durability-path"
 _ROUTING_NAMES = ("TRACE", "trace")

@@ -32,7 +32,7 @@ PASS_NAME = "metric-names"
 DEFAULT_DIRS = ("yugabyte_tpu",)
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
-_UNIT = ("_ms", "_us", "_bytes", "_rows")
+_UNIT = ("_ms", "_us", "_bytes", "_rows", "_blocks")
 _SUFFIXES = {
     "counter": ("_total",),
     "histogram": _UNIT,

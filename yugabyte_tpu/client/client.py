@@ -684,18 +684,98 @@ class YBClient:
                 return
             cursor = tablet.partition.end
 
+    def scan_aggregate(self, table: YBTable, aggregates: Sequence[Sequence],
+                       filters: Optional[Sequence[Sequence]] = None,
+                       read_ht: Optional[HybridTime] = None,
+                       partition_key: Optional[bytes] = None,
+                       lower_doc_key: bytes = b"",
+                       upper_doc_key: Optional[bytes] = None,
+                       row_cb=None, page_size: int = 4096):
+        """Aggregate pushdown walk (ROADMAP item 5): per tablet, ask the
+        scan RPC to compute [[fn, col], ...] over the filtered row set in
+        ONE fused device dispatch. Tablets that cannot push (intents,
+        uncompilable spec, device fault/quarantine, no device) return
+        ROWS instead; those stream to `row_cb` and the caller folds them
+        into its own accumulator — per-tablet row sets are disjoint, so
+        device partials and host partials combine exactly.
+
+        partition_key pins the walk to one tablet (the partition-prefix
+        scan shape); otherwise every tablet of the table is visited at
+        one pinned snapshot. Returns (combined_partial_or_None, read_ht)
+        — None when NO tablet answered with a device partial."""
+        from yugabyte_tpu.docdb.scan_spec import combine_agg_partials
+        pinned = read_ht.value if read_ht else None
+        cursor = partition_key if partition_key is not None else b""
+        partials: List[dict] = []
+        failures = 0
+        backoff = Backoff(base_s=0.1, cap_s=1.0)
+        aggs = [list(a) for a in aggregates]
+        flts = [list(f) for f in filters] if filters else None
+        lower = lower_doc_key
+        ask_agg = True   # first page per tablet tries the fused path
+        while True:
+            tablet = self.meta_cache.lookup_tablet(table.table_id, cursor)
+            try:
+                resp = self._tablet_call(
+                    table, tablet, "scan", refresh_key=cursor,
+                    lower_doc_key=lower, upper_doc_key=upper_doc_key,
+                    read_ht=pinned, limit=page_size, filters=flts,
+                    aggregates=aggs if ask_agg else None)
+            except RemoteError as e:
+                retryable = (e.extra.get("tablet_split")
+                             or e.extra.get("wrong_tablet")
+                             or e.extra.get("overloaded")
+                             or e.status.code == Code.NOT_FOUND)
+                failures += 1
+                if not retryable or failures > 8:
+                    raise
+                if e.extra.get("overloaded"):
+                    backoff.note_server_hint(e.extra.get("retry_after_ms"))
+                self.retry_budget.spend_or_raise(
+                    f"scan_aggregate {table.name}", last_err=e)
+                time.sleep(backoff.next_delay())
+                self.meta_cache.invalidate(table.table_id)
+                continue
+            failures = 0
+            backoff = Backoff(base_s=0.1, cap_s=1.0)
+            if pinned is None:
+                pinned = resp.get("read_ht")
+            if "agg" in resp and resp["agg"] is not None:
+                partials.append(resp["agg"])
+            else:
+                for w in resp["rows"]:
+                    if row_cb is not None:
+                        row_cb(row_from_wire(w))
+                if resp.get("resume_key"):
+                    # this tablet fell back to rows: page through it
+                    # without re-attempting the fused path mid-tablet
+                    lower = resp["resume_key"]
+                    ask_agg = False
+                    continue
+            ask_agg = True
+            lower = lower_doc_key
+            if partition_key is not None or not tablet.partition.end:
+                break
+            cursor = tablet.partition.end
+        combined = combine_agg_partials(partials) if partials else None
+        return combined, pinned
+
     def scan_key_range(self, table: YBTable, partition_key: bytes,
                        lower_doc_key: bytes,
                        upper_doc_key: Optional[bytes] = None,
                        read_ht: Optional[HybridTime] = None,
                        page_size: int = 4096,
+                       filters: Optional[Sequence[Sequence]] = None,
                        scan_state: Optional[dict] = None):
         """Paged scan of one doc-key range within the tablet owning
         partition_key (prefix reads: all fields of one document family,
         e.g. a redis hash's subkeys).
 
-        scan_state, when given, receives the pinned {'read_ht': ...} for
-        query-layer paging-state continuation tokens."""
+        filters: pushed-down [[col, op, value], ...] conjunction — the
+        tserver evaluates it (fused device kernel where compilable)
+        before rows cross the wire. scan_state, when given, receives the
+        pinned {'read_ht': ...} for query-layer paging-state
+        continuation tokens."""
         pinned = read_ht.value if read_ht else None
         lower = lower_doc_key
         failures = 0
@@ -707,7 +787,9 @@ class YBClient:
                 resp = self._tablet_call(
                     table, tablet, "scan", refresh_key=partition_key,
                     lower_doc_key=lower, upper_doc_key=upper_doc_key,
-                    read_ht=pinned, limit=page_size)
+                    read_ht=pinned, limit=page_size,
+                    filters=[list(f) for f in filters] if filters
+                    else None)
             except RemoteError as e:
                 # Same split/moved/overload re-route as scan(): resume
                 # from the current doc-key bound after a refresh.

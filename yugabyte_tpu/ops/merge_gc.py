@@ -339,10 +339,17 @@ class StagedCols:
     w: int
     col_const: Optional[np.ndarray] = None   # is_const per row (real rows)
     col_first: Optional[np.ndarray] = None   # first value per row
+    # value-payload words [1 + VAL_WORDS, n_pad] for the pushdown scan
+    # kernels (ops/scan.py); staged lazily on the first filtered/
+    # aggregating scan that needs column values, then resident
+    vals_dev: object = None
 
     @property
     def nbytes(self) -> int:
-        return int(self.cols_dev.size) * 4
+        n = int(self.cols_dev.size) * 4
+        if self.vals_dev is not None:
+            n += int(self.vals_dev.size) * 4
+        return n
 
 
 def stage_slab(slab: KVSlab, device=None) -> StagedCols:

@@ -255,6 +255,19 @@ class DB:
                 return 1
             return len(self._readers)
 
+    def approx_row_entries(self) -> int:
+        """Rough live-entry count (SST props + a memtable byte-derived
+        guess) — the pushdown size gate's input: a fused dispatch only
+        beats the per-row host path once the scan is big enough to
+        amortize dispatch + (first-time) compile cost."""
+        with self._lock:
+            n = sum(r.props.n_entries for r in self._readers.values())
+            # ~32 bytes/entry is the right order for the gate's purpose
+            n += self.mem.approximate_bytes // 32
+            if self._imm is not None:
+                n += self._imm.approximate_bytes // 32
+            return n
+
     def has_deep_files(self) -> bool:
         """Any live SST holding documents deeper than row+column — the
         tablet's gate for the flat batched row-read fast path (deep rows
@@ -1054,6 +1067,127 @@ class DB:
                     if not self._pins[fid]:
                         del self._pins[fid]
                 self._purge_obsolete_unlocked()
+
+    # ----------------------------------------------------- query pushdown
+    def _pushdown_sources(self, spec):
+        """Build the fused-scan source list with pins held + value words
+        staged (ROADMAP item 5). Returns (sources, readers) — the caller
+        owns unpinning via _release_scan_pins. Raises
+        PushdownUnsupported("deep") on deep-document files (the kernels
+        are depth-2 only) so callers fall back host-side, counted."""
+        from yugabyte_tpu.docdb.scan_spec import PushdownUnsupported
+        from yugabyte_tpu.ops.scan import (ResidentSource, SlabSource,
+                                           pack_vals, pushdown_metrics)
+        with self._lock:
+            slabs = [self.mem.to_slab()]
+            if self._imm is not None:
+                slabs.append(self._imm.to_slab())
+            readers = list(self._readers.items())
+            for fid, _ in readers:
+                self._pins[fid] = self._pins.get(fid, 0) + 1
+        try:
+            sources = [SlabSource(sl) for sl in slabs]
+            for fid, r in readers:
+                if r.props.has_deep:
+                    raise PushdownUnsupported("deep")
+                st = (self._device_cache.get(fid)
+                      if self._device_cache is not None else None)
+                if st is None:
+                    sl = self._read_all_contained(r)
+                    if self._device_cache is not None:
+                        st = self._device_cache.stage(
+                            fid, sl, for_read=True,
+                            include_vals=spec.needs_vals)
+                        sources.append(ResidentSource(r, st))
+                    else:
+                        sources.append(SlabSource(sl, sorted_source=True))
+                    continue
+                if spec.needs_vals and st.vals_dev is None:
+                    # resident cols without value words: decode once,
+                    # attach, and every later pushdown scan is resident
+                    import jax
+                    import jax.numpy as jnp
+                    sl = self._read_all_contained(r)
+                    packed = pack_vals(sl, st.n_pad)
+                    dev = self._device_cache.device
+                    vals_dev = (jax.device_put(packed, dev)
+                                if dev is not None
+                                else jnp.asarray(packed))
+                    self._device_cache.attach_vals(fid, vals_dev)
+                    pushdown_metrics()["vals_staged"].increment()
+                sources.append(ResidentSource(r, st))
+            return sources, readers
+        except BaseException:
+            self._release_scan_pins(readers)
+            raise
+
+    def _read_all_contained(self, r):
+        try:
+            return r.read_all()
+        except StatusError as e:
+            self._route_read_corruption(e)
+            raise
+
+    def _release_scan_pins(self, readers) -> None:
+        with self._lock:
+            for fid, _ in readers:
+                self._pins[fid] -= 1
+                if not self._pins[fid]:
+                    del self._pins[fid]
+            self._purge_obsolete_unlocked()
+
+    def scan_filtered(self, read_ht_value: int, spec,
+                      lower_key: Optional[bytes] = None,
+                      upper_key: Optional[bytes] = None):
+        """Fused filtered scan: yields the visible entries of exactly
+        the rows satisfying spec.predicates, resolved in one device
+        dispatch over the resident slab matrices. The dispatch runs
+        EAGERLY — device faults surface here (as PushdownUnsupported,
+        bucket quarantined) with zero rows emitted and zero pins leaked,
+        so the caller can serve the same query through the host path."""
+        from yugabyte_tpu.ops.scan import (ResidentSource,
+                                           filtered_entries_sources,
+                                           pushdown_metrics)
+        sources, readers = self._pushdown_sources(spec)
+        try:
+            it = filtered_entries_sources(
+                sources, read_ht_value, spec, lower_key, upper_key,
+                device=self.opts.device)
+        except BaseException:
+            self._release_scan_pins(readers)
+            raise
+
+        def entries():
+            try:
+                yield from it
+            except StatusError as e:
+                # corrupt winner block mid-stream: same containment as
+                # the plain scan path (park + retryable to the client)
+                self._route_read_corruption(e)
+                raise
+            finally:
+                blocks = sum(s.decoded_blocks for s in sources
+                             if isinstance(s, ResidentSource))
+                pushdown_metrics()["blocks"].increment(max(blocks, 0))
+                self._release_scan_pins(readers)
+
+        return entries()
+
+    def scan_aggregate(self, read_ht_value: int, spec,
+                       lower_key: Optional[bytes] = None,
+                       upper_key: Optional[bytes] = None) -> dict:
+        """Fused aggregating scan: one dispatch returns the aggregate
+        partial for this DB's whole source set ({"rows", "cols"}), with
+        exact MVCC visibility across memtables and SSTs. Scalars only —
+        host memory is touched once per RESULT, not once per row."""
+        from yugabyte_tpu.ops.scan import aggregate_sources
+        sources, readers = self._pushdown_sources(spec)
+        try:
+            return aggregate_sources(sources, read_ht_value, spec,
+                                     lower_key, upper_key,
+                                     device=self.opts.device)
+        finally:
+            self._release_scan_pins(readers)
 
     # ----------------------------------------------------------------- flush
     def flush(self) -> Optional[int]:

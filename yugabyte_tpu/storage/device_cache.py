@@ -50,6 +50,9 @@ class _Resident:
     staged: StagedCols
     level: int = 0      # LSM level of the staged file (0 = flush output)
     pins: int = 0       # in-flight compactions reading this entry
+    bytes: int = 0      # nbytes RECORDED in _used (vals staging grows
+    #                     an entry in place; eviction must subtract what
+    #                     was added, not what is there now)
 
 
 class DeviceSlabCache:
@@ -150,10 +153,26 @@ class DeviceSlabCache:
             if prior is not None:
                 # replace, not refuse: a stale entry under a reused id must
                 # never shadow fresh data (correctness, not just freshness)
-                self._used -= prior.staged.nbytes
+                self._used -= prior.bytes
                 pins = prior.pins
-            self._map[key] = _Resident(staged, level=level, pins=pins)
+            self._map[key] = _Resident(staged, level=level, pins=pins,
+                                       bytes=staged.nbytes)
             self._used += staged.nbytes
+            self._evict_unlocked(protect=key)
+            self._g_used.set(self._used)
+
+    def attach_vals(self, key: CacheKey, vals_dev) -> None:
+        """Attach staged value words to a resident entry (pushdown-scan
+        write-through): the entry grows in place and the growth is
+        accounted so eviction stays balanced."""
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is None:
+                return
+            ent.staged.vals_dev = vals_dev
+            delta = ent.staged.nbytes - ent.bytes
+            ent.bytes += delta
+            self._used += delta
             self._evict_unlocked(protect=key)
             self._g_used.set(self._used)
 
@@ -175,7 +194,7 @@ class DeviceSlabCache:
                     victim = k
             if victim is None:
                 break
-            self._used -= self._map.pop(victim).staged.nbytes
+            self._used -= self._map.pop(victim).bytes
             self.evictions += 1
             self._c_evict.increment()
 
@@ -183,7 +202,7 @@ class DeviceSlabCache:
         with self._lock:
             ent = self._map.pop(key, None)
             if ent is not None:
-                self._used -= ent.staged.nbytes
+                self._used -= ent.bytes
                 self._g_used.set(self._used)
                 self._g_pinned.set(self._pinned_unlocked())
 
@@ -192,14 +211,26 @@ class DeviceSlabCache:
         with self._lock:
             dead = [k for k in self._map if k[0] == namespace]
             for k in dead:
-                self._used -= self._map.pop(k).staged.nbytes
+                self._used -= self._map.pop(k).bytes
             if dead:
                 self._g_used.set(self._used)
                 self._g_pinned.set(self._pinned_unlocked())
 
     def stage(self, key: CacheKey, slab: KVSlab,
-              level: int = 0, for_read: bool = False) -> StagedCols:
+              level: int = 0, for_read: bool = False,
+              include_vals: bool = False) -> StagedCols:
         staged = stage_slab(slab, self.device)
+        if include_vals:
+            # pushdown-scan write-through: the value words ride along so
+            # the NEXT filtered/aggregating scan is fully resident
+            import jax
+            import jax.numpy as jnp
+            from yugabyte_tpu.ops.scan import pack_vals, pushdown_metrics
+            packed = pack_vals(slab, staged.n_pad)
+            staged.vals_dev = (jax.device_put(packed, self.device)
+                               if self.device is not None
+                               else jnp.asarray(packed))
+            pushdown_metrics()["vals_staged"].increment()
         self.put(key, staged, level=level)
         if for_read:
             # a read had to decode+upload what write-through was
@@ -273,6 +304,9 @@ class NamespacedSlabCache:
     def put(self, file_id: int, staged: StagedCols, level: int = 0) -> None:
         self._shared.put((self.namespace, file_id), staged, level=level)
 
+    def attach_vals(self, file_id: int, vals_dev) -> None:
+        self._shared.attach_vals((self.namespace, file_id), vals_dev)
+
     def drop(self, file_id: int) -> None:
         self._shared.drop((self.namespace, file_id))
 
@@ -280,9 +314,11 @@ class NamespacedSlabCache:
         self._shared.drop_namespace(self.namespace)
 
     def stage(self, file_id: int, slab: KVSlab,
-              level: int = 0, for_read: bool = False) -> StagedCols:
+              level: int = 0, for_read: bool = False,
+              include_vals: bool = False) -> StagedCols:
         return self._shared.stage((self.namespace, file_id), slab,
-                                  level=level, for_read=for_read)
+                                  level=level, for_read=for_read,
+                                  include_vals=include_vals)
 
 
 class HostStagingPool:

@@ -51,6 +51,18 @@ flags.define_flag("sst_files_hard_limit", 48,
 flags.define_flag("write_backpressure_max_delay_ms", 100,
                   "max per-write delay as file pressure approaches the "
                   "hard limit (ref tablet_service.cc:1510 rejection score)")
+flags.define_flag("scan_pushdown", True,
+                  "compile simple predicates + aggregates into the fused "
+                  "scan kernels (ROADMAP item 5); off = every query takes "
+                  "the per-row host path (results are identical either "
+                  "way — the device subset is exact by construction)")
+flags.define_flag("scan_pushdown_min_rows", 4096,
+                  "minimum approximate entry count before a query rides "
+                  "the fused pushdown kernels: below it the per-row host "
+                  "path wins (a device dispatch — and its first-time XLA "
+                  "compile — must never stall a tiny scan inside an RPC "
+                  "deadline); same size-class philosophy as the "
+                  "compaction offload policy")
 
 
 class TabletRetentionPolicy:
@@ -720,6 +732,93 @@ class Tablet:
                                   upper_doc_key=upper_doc_key,
                                   projection=projection,
                                   entry_stream=stream)
+
+    # ------------------------------------------------------ query pushdown
+    def _pushdown_gate(self, ht: HybridTime, lower: bytes,
+                       upper: Optional[bytes],
+                       txn_id: Optional[bytes]) -> Optional[str]:
+        """Why THIS scan cannot ride the fused pushdown kernels, or None
+        when it can (flag off, no device, or provisional records that
+        need the intent-aware host merge)."""
+        if not flags.get_flag("scan_pushdown"):
+            return "disabled"
+        if self.opts.device is None or self.opts.device == "native":
+            return "device"
+        if self.regular_db.approx_row_entries() \
+                < flags.get_flag("scan_pushdown_min_rows"):
+            return "small"
+        if self._entry_stream(ht, lower, upper, txn_id) is not None:
+            return "intents"
+        return None
+
+    def _clamp_scan_bounds(self, lower_doc_key: bytes,
+                           upper_doc_key: Optional[bytes]):
+        if self.opts.lower_bound_key:
+            lower_doc_key = max(lower_doc_key, self.opts.lower_bound_key)
+        if self.opts.upper_bound_key is not None:
+            upper_doc_key = (self.opts.upper_bound_key
+                             if upper_doc_key is None
+                             else min(upper_doc_key,
+                                      self.opts.upper_bound_key))
+        return lower_doc_key, upper_doc_key
+
+    def scan_pushdown(self, read_ht: Optional[HybridTime] = None,
+                      lower_doc_key: bytes = b"",
+                      upper_doc_key: Optional[bytes] = None,
+                      projection=None, spec=None,
+                      txn_id: Optional[bytes] = None):
+        """Fused filtered scan (ROADMAP item 5): rows satisfying
+        spec.predicates assembled from one device dispatch, or None when
+        this scan must fall back to the host path (reason counted in
+        scan_pushdown_fallback_*_total; results identical either way)."""
+        from yugabyte_tpu.docdb.scan_spec import PushdownUnsupported
+        from yugabyte_tpu.ops.scan import count_pushdown_fallback
+        if spec is None or not spec.predicates:
+            return None
+        ht = self.read_time(read_ht)
+        lower_doc_key, upper_doc_key = self._clamp_scan_bounds(
+            lower_doc_key, upper_doc_key)
+        reason = self._pushdown_gate(ht, lower_doc_key, upper_doc_key,
+                                     txn_id)
+        if reason is not None:
+            count_pushdown_fallback(reason)
+            return None
+        try:
+            entries = self.regular_db.scan_filtered(
+                ht.value, spec, lower_doc_key or None, upper_doc_key)
+        except PushdownUnsupported as e:  # yblint: contained(typed refusal, not an error: the caller serves the SAME query through the byte-identical host path; the reason is counted for the offload policy)
+            count_pushdown_fallback(e.reason)
+            return None
+        return VisibleEntryRowAssembler(entries, self.schema,
+                                        projection=projection)
+
+    def scan_aggregate(self, read_ht: Optional[HybridTime] = None,
+                       lower_doc_key: bytes = b"",
+                       upper_doc_key: Optional[bytes] = None,
+                       spec=None,
+                       txn_id: Optional[bytes] = None) -> Optional[dict]:
+        """Fused aggregating scan: the aggregate partial for this
+        tablet's row range ({"rows", "cols"}), or None when the query
+        must fall back to the row path (the caller re-aggregates rows
+        host-side — byte/result-identical by construction)."""
+        from yugabyte_tpu.docdb.scan_spec import PushdownUnsupported
+        from yugabyte_tpu.ops.scan import count_pushdown_fallback
+        if spec is None or not spec.aggregates:
+            return None
+        ht = self.read_time(read_ht)
+        lower_doc_key, upper_doc_key = self._clamp_scan_bounds(
+            lower_doc_key, upper_doc_key)
+        reason = self._pushdown_gate(ht, lower_doc_key, upper_doc_key,
+                                     txn_id)
+        if reason is not None:
+            count_pushdown_fallback(reason)
+            return None
+        try:
+            return self.regular_db.scan_aggregate(
+                ht.value, spec, lower_doc_key or None, upper_doc_key)
+        except PushdownUnsupported as e:  # yblint: contained(typed refusal: caller re-aggregates rows host-side, result-identical; reason counted)
+            count_pushdown_fallback(e.reason)
+            return None
 
     # ------------------------------------------------------------ maintenance
     def write_subdocument(self, doc_key: DocKey, path, doc,

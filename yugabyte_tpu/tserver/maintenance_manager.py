@@ -168,7 +168,7 @@ class PrewarmKernelsOp(MaintenanceOp):
         stats.perf_improvement = self.PREWARM_SCORE
 
     def perform(self) -> None:
-        from yugabyte_tpu.ops import point_read, run_merge
+        from yugabyte_tpu.ops import point_read, run_merge, scan
         from yugabyte_tpu.storage import offload_policy
         from yugabyte_tpu.utils.metrics import publish_compile_surface
         n = run_merge.prewarm_buckets(self._shapes)
@@ -176,6 +176,13 @@ class PrewarmKernelsOp(MaintenanceOp):
         # the same pass — their first real multi_get batch must load a
         # cached executable, not stall a read on an XLA compile
         n += point_read.prewarm_point_read()
+        # query-pushdown families (fused filtered/aggregating scans):
+        # the first SELECT count(*) ... WHERE must not pay the compile.
+        # Only in FULL prewarm mode (shapes=None): a bounded-shapes op —
+        # the unit-test lifecycle mode — must not spend ~10s/executable
+        # on the 40-program pushdown lattice.
+        if self._shapes is None:
+            n += scan.prewarm_scan_pushdown()
         # expose the declared compile surface (committed kernel
         # manifest) next to the bucket hit/miss counters: the warm cache
         # must cover exactly this many executables

@@ -211,9 +211,14 @@ class TabletServer:
         # batched point reads: batch/bloom-skip/learned-index/fallback
         # counters for the device serve path (ops/point_read.py)
         from yugabyte_tpu.ops.point_read import point_read_snapshot
+        # query pushdown: fused filtered/aggregating scan counters —
+        # hits and per-reason fallbacks, per-bucket dispatches, and the
+        # blocks-decoded-per-scan histogram (ops/scan.pushdown_snapshot)
+        from yugabyte_tpu.ops.scan import pushdown_snapshot
         out = {"server_id": self.server_id, "totals": totals,
                "pipeline": pipeline, "device_faults": device_faults,
                "point_reads": point_read_snapshot(),
+               "scans": pushdown_snapshot(),
                "tablets": tablets}
         # HBM residency: the multi-level resident set behind the chained
         # L0->L1->L2 compaction path — per-level entries/bytes, pins and

@@ -17,7 +17,28 @@ from yugabyte_tpu.common.wire import (
 from yugabyte_tpu.consensus.raft import (NotLeader, OperationOutcomeUnknown,
                                          ReplicationAborted)
 from yugabyte_tpu.tserver.ts_tablet_manager import TSTabletManager
+from yugabyte_tpu.utils import flags as _flags
 from yugabyte_tpu.utils.status import Code, Status, StatusError
+
+_flags.define_flag(
+    "scan_pushdown_pages", False,
+    "route predicate-free scan RPC pages (the YCSB-E shape) through the "
+    "fused device scan over resident slabs; default off — the per-page "
+    "dispatch only wins once the working set is resident (bench.py "
+    "enables it for the analytics/YCSB-E rungs)")
+
+
+def _scan_page_counters(pushed: bool) -> None:
+    """scan-RPC page accounting: total vs device-served — the numerator/
+    denominator of the bench's ycsb_e_pushdown_ratio."""
+    from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+    e = ROOT_REGISTRY.entity("server", "scan_pushdown")
+    e.counter("scan_rpc_pages_total",
+              "scan RPC pages served").increment()
+    if pushed:
+        e.counter("scan_rpc_pages_pushdown_total",
+                  "scan RPC pages served through the fused device scan "
+                  "path").increment()
 
 
 class NotLeaderError(StatusError):
@@ -190,15 +211,27 @@ class TabletServiceImpl:
              projection: Optional[List[str]] = None,
              limit: int = 10_000,
              filters: Optional[List[List]] = None,
-             txn_id: Optional[bytes] = None) -> dict:
+             txn_id: Optional[bytes] = None,
+             aggregates: Optional[List[List]] = None) -> dict:
         """Bounded range scan; returns rows + a resume key when `limit` is
         hit (the reference pages exactly this way, ref
         pgsql_operation.cc:1040 paging state).
 
         filters: optional [[col, op, value], ...] conjunction evaluated
-        HERE, before rows cross the wire — the pushed-down WHERE clause
-        (ref: ybgate expression pushdown, pgsql_operation.cc:1088
-        per-row filter eval on the tserver)."""
+        before rows cross the wire — the pushed-down WHERE clause (ref:
+        ybgate expression pushdown, pgsql_operation.cc:1088). Triples in
+        the device-compilable subset (docdb/scan_spec.py) run inside the
+        fused filtered kernel over the resident slab matrices; the rest
+        evaluate host-side here. Results are identical either way.
+
+        aggregates: optional [[fn, col_or_None], ...] — when the whole
+        (filters, aggregates) pair is compilable, the response is
+        {"agg": {rows, cols}, "read_ht"} computed by ONE fused device
+        dispatch; otherwise rows return as usual and the caller
+        aggregates them (the byte/result-identical fallback, counted by
+        reason in scan_pushdown_fallback_*_total)."""
+        from yugabyte_tpu.docdb import scan_spec as SS
+        from yugabyte_tpu.ops.scan import count_pushdown_fallback
         peer = self._tablets.get_tablet(tablet_id)
         if not peer.raft.is_leader():
             raise NotLeaderError(_leader_server_hint(
@@ -212,17 +245,58 @@ class TabletServiceImpl:
         # multi-page scan is torn across concurrent writes (the reference
         # pins used_read_time in the paging state).
         ht = peer.tablet.read_time(HybridTime(read_ht) if read_ht else None)
-        it = peer.tablet.scan(
-            ht, lower_doc_key=lower_doc_key, upper_doc_key=upper_doc_key,
-            projection=tuple(projection) if projection else None,
-            use_device=False, txn_id=txn_id)
         schema = peer.tablet.schema
+        proj = tuple(projection) if projection else None
+        spec = None
+        host_filters = filters
+        if filters or aggregates:
+            spec, leftover, reason = SS.compile_filters(
+                schema, filters, aggregates)
+            if spec is None:
+                count_pushdown_fallback(reason)
+        if aggregates and spec is not None:
+            partial = peer.tablet.scan_aggregate(
+                ht, lower_doc_key=lower_doc_key,
+                upper_doc_key=upper_doc_key, spec=spec, txn_id=txn_id)
+            if partial is not None:
+                return {"agg": partial, "read_ht": ht.value}
+            spec = None  # rows-mode fallback: the caller aggregates
+        it = None
+        pushed = False
+        if spec is not None and spec.predicates:
+            it = peer.tablet.scan_pushdown(
+                ht, lower_doc_key=lower_doc_key,
+                upper_doc_key=upper_doc_key, projection=proj, spec=spec,
+                txn_id=txn_id)
+            if it is not None:
+                pushed = True
+                host_filters = leftover
+        if it is None and not filters and not aggregates \
+                and _flags.get_flag("scan_pushdown_pages") \
+                and peer.tablet.regular_db.approx_row_entries() \
+                >= _flags.get_flag("scan_pushdown_min_rows"):
+            # predicate-free pages (the YCSB-E shape) ride the fused
+            # scan kernel over resident slabs when eligible; the CPU
+            # iterator stays the default (flag-gated: a per-page device
+            # dispatch only wins once the working set is resident)
+            it = peer.tablet.scan(
+                ht, lower_doc_key=lower_doc_key,
+                upper_doc_key=upper_doc_key, projection=proj,
+                use_device=True, txn_id=txn_id)
+            pushed = True
+        if it is None:
+            it = peer.tablet.scan(
+                ht, lower_doc_key=lower_doc_key,
+                upper_doc_key=upper_doc_key, projection=proj,
+                use_device=False, txn_id=txn_id)
+        _scan_page_counters(pushed)
         rows = []
         resume_key = None
         scanned = 0
         for row in it:
             scanned += 1
-            if filters and not _row_matches(row.to_dict(schema), filters):
+            if host_filters and not _row_matches(row.to_dict(schema),
+                                                 host_filters):
                 # a filtered-out row still advances the paging cursor so a
                 # highly-selective predicate can't pin the scan in place
                 if scanned >= limit * 4:
@@ -233,7 +307,8 @@ class TabletServiceImpl:
             if len(rows) >= limit:
                 resume_key = row.doc_key.encode() + b"\xff"
                 break
-        return {"rows": rows, "resume_key": resume_key, "read_ht": ht.value}
+        return {"rows": rows, "resume_key": resume_key, "read_ht": ht.value,
+                "pushdown": pushed}
 
     def dump_tablet(self, tablet_id: str, read_ht: int,
                     limit: int = 100_000) -> dict:
@@ -579,6 +654,23 @@ class TabletServiceImpl:
     def status(self) -> dict:
         return {"server_id": self._tablets.server_id,
                 "tablets": self._tablets.generate_report()}
+
+    def scan_pushdown_status(self) -> dict:
+        """The /compactionz "scans" block over RPC (webserver-less
+        external nodes): pushdown hit/fallback counters by reason,
+        per-bucket dispatches, blocks-decoded histogram, and the scan-
+        page routing counters the bench's ycsb_e_pushdown_ratio reads."""
+        from yugabyte_tpu.ops.scan import pushdown_snapshot
+        from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
+        e = ROOT_REGISTRY.entity("server", "scan_pushdown")
+        snap = pushdown_snapshot()
+        snap["scan_rpc_pages_total"] = e.counter(
+            "scan_rpc_pages_total", "scan RPC pages served").value()
+        snap["scan_rpc_pages_pushdown_total"] = e.counter(
+            "scan_rpc_pages_pushdown_total",
+            "scan RPC pages served through the fused device scan "
+            "path").value()
+        return {"server_id": self._tablets.server_id, "scans": snap}
 
     def overload_status(self) -> dict:
         """The /servez overload block over RPC: bounded-queue + shed

@@ -153,6 +153,12 @@ class MetricEntity:
                 self._metrics[name] = factory()
             return self._metrics[name]
 
+    def metrics_snapshot(self) -> Dict[str, object]:
+        """Point-in-time copy of the entity's metric map (observability
+        pages that enumerate dynamically-named counters)."""
+        with self._lock:
+            return dict(self._metrics)
+
 
 def _escape_label_value(v: str) -> str:
     """Prometheus text-format label-value escaping: backslash, double

@@ -390,6 +390,42 @@ class QLProcessor:
         return DocKey(hash_components=tuple(hash_vals),
                       range_components=tuple(range_vals)), residual
 
+    _WIRE_LITERALS = (int, float, str, bytes, bool, type(None))
+
+    @classmethod
+    def _wire_filters(cls, schema, residual) -> Optional[List[List]]:
+        """The subset of residual predicates worth shipping to the
+        tserver scan (device-compilable triples run in the fused
+        filtered kernel there; the rest evaluate host-side server-side
+        before rows cross the wire). Safe by construction: for every
+        shipped op the server's FILTER_OPS semantics are a SUPERSET of
+        _match's (they differ only on NULLs, where the server may keep
+        a row _match drops), and _match re-checks the full residual
+        client-side — so pushdown can narrow the wire, never the
+        result."""
+        out = []
+        for c, op, v in residual:
+            if not isinstance(c, str) \
+                    or op not in ("=", "!=", "<", "<=", ">", ">=", "in"):
+                continue
+            try:
+                col = schema.column(c)
+            except KeyError:
+                continue
+            if col.collection is not None:
+                # server-side row dicts hold the STORAGE form of
+                # collections; only the executor converts to CQL shapes,
+                # so a collection comparison must stay client-side
+                continue
+            if op == "in":
+                if not isinstance(v, (list, tuple)) or not all(
+                        isinstance(x, cls._WIRE_LITERALS) for x in v):
+                    continue
+            elif not isinstance(v, cls._WIRE_LITERALS):
+                continue
+            out.append([c, op, list(v) if op == "in" else v])
+        return out or None
+
     @staticmethod
     def _match(row_dict: dict, residual: List[Tuple[str, str, object]]
                ) -> bool:
@@ -561,21 +597,55 @@ class QLProcessor:
         whole (filtered) result — YCQL has no GROUP BY, so the output is
         exactly one row (ref: the CQL aggregate surface in the
         reference's ql; Cassandra 2.2 aggregate semantics — AVG over an
-        int column is integer division)."""
+        int column is integer division).
+
+        When the whole (WHERE, aggregate-list) pair is inside the device
+        subset (docdb/scan_spec.py), the scalars come back from ONE
+        fused segment-reduce dispatch per tablet instead of every row
+        surfacing to this process (ROADMAP item 5); tablets that cannot
+        push return rows, which fold into the same accumulator with
+        identical semantics. The output row is assembled from the stats
+        by ONE shared code path either way."""
         table = self._table(stmt.keyspace, stmt.table)
-        cols_needed = sorted({c for _f, c in aggs if c is not None})
-        if not cols_needed:
-            # COUNT(*)-only: project one key column, not the whole row
-            cols_needed = [table.schema.hash_columns[0].name]
-        # LIMIT applies to the RESULT rows (exactly one for an aggregate),
-        # not to the scan feeding it: `SELECT COUNT(*) ... LIMIT 1` must
-        # count every matching row, so the inner scan is unlimited
-        inner = P.Select(stmt.keyspace, stmt.table,
-                         cols_needed, stmt.where, None,
-                         order_by=stmt.order_by)
-        rs = self._select(inner, params, cursor)
-        dicts = rs.dicts()
+        stats = None
+        if not stmt.order_by:
+            stats = self._try_pushdown_aggregate(stmt, aggs, params,
+                                                 cursor, table)
+        if stats is None:
+            cols_needed = sorted({c for _f, c in aggs if c is not None})
+            if not cols_needed:
+                # COUNT(*)-only: project one key column, not the whole row
+                cols_needed = [table.schema.hash_columns[0].name]
+            # LIMIT applies to the RESULT rows (exactly one for an
+            # aggregate), not to the scan feeding it: `SELECT COUNT(*)
+            # ... LIMIT 1` must count every matching row, so the inner
+            # scan is unlimited
+            inner = P.Select(stmt.keyspace, stmt.table,
+                             cols_needed, stmt.where, None,
+                             order_by=stmt.order_by)
+            rs = self._select(inner, params, cursor)
+            stats = self._agg_stats_from_dicts(aggs, rs.dicts())
+        return self._assemble_aggregate(aggs, table, stats)
+
+    @staticmethod
+    def _agg_stats_from_dicts(aggs, dicts) -> dict:
+        """Host-path accumulator: per aggregated column, the non-null
+        value list (assembly reduces it per requested function)."""
+        cols: Dict[str, dict] = {}
+        for _fname, col in aggs:
+            if col is None or col in cols:
+                continue
+            vals = [d.get(col) for d in dicts if d.get(col) is not None]
+            cols[col] = {"nonnull": len(vals), "vals": vals}
+        return {"rows": len(dicts), "cols": cols}
+
+    def _assemble_aggregate(self, aggs, table, stats) -> ResultSet:
+        """stats -> the single CQL aggregate output row. stats["cols"]
+        entries carry either a host value list ("vals") or the device
+        partial scalars ("sum"/"min"/"max") — reductions are exact ints
+        on the device path, so both shapes produce identical output."""
         known = {c.name: c.type for c in table.schema.columns}
+        empty = {"nonnull": 0, "vals": []}
         out_row: List[object] = []
         out_cols: List[str] = []
         out_types: List[Optional[DataType]] = []
@@ -583,14 +653,12 @@ class QLProcessor:
             label = f"{fname}({'*' if col is None else col})"
             out_cols.append(label)
             if fname == "count":
-                if col is None:
-                    out_row.append(len(dicts))
-                else:
-                    out_row.append(sum(1 for d in dicts
-                                       if d.get(col) is not None))
+                out_row.append(stats["rows"] if col is None
+                               else stats["cols"].get(col, empty)["nonnull"])
                 out_types.append(DataType.INT64)
                 continue
-            vals = [d.get(col) for d in dicts if d.get(col) is not None]
+            st = stats["cols"].get(col, empty)
+            nn = st["nonnull"]
             t = known.get(col)
             if fname in ("sum", "avg") and t not in (
                     DataType.INT32, DataType.INT64, DataType.FLOAT,
@@ -598,28 +666,149 @@ class QLProcessor:
                 raise StatusError(Status.InvalidArgument(
                     f"{fname}() requires a numeric column"))
             if fname == "sum":
-                out_row.append(sum(vals) if vals else 0)
+                total = sum(st["vals"]) if "vals" in st else st["sum"]
+                out_row.append(total if nn else 0)
                 # a sum of int32s overflows int32: widen on the wire
                 out_types.append(DataType.INT64
                                  if t == DataType.INT32 else t)
             elif fname == "avg":
-                if not vals:
+                total = sum(st["vals"]) if "vals" in st else st["sum"]
+                if not nn:
                     out_row.append(0)
                 elif t in (DataType.INT32, DataType.INT64):
-                    out_row.append(sum(vals) // len(vals))
+                    out_row.append(total // nn)
                 else:
-                    out_row.append(sum(vals) / len(vals))
+                    out_row.append(total / nn)
                 out_types.append(t)
             else:  # min / max
                 try:
-                    out_row.append((min if fname == "min" else max)(vals)
-                                   if vals else None)
+                    if "vals" in st:
+                        out_row.append(
+                            (min if fname == "min" else max)(st["vals"])
+                            if nn else None)
+                    else:
+                        out_row.append(st[fname])
                 except TypeError:
                     raise StatusError(Status.InvalidArgument(
                         f"{fname}() requires a comparable column type"))
                 out_types.append(t)
         return ResultSet(columns=out_cols, rows=[out_row],
-                         types=out_types, source=rs.source)
+                         types=out_types,
+                         source=(table.namespace, table.name))
+
+    def _try_pushdown_aggregate(self, stmt: P.Select, aggs, params,
+                                cursor, table) -> Optional[dict]:
+        """Attempt the fused-aggregate path. Returns the device-shaped
+        stats dict, or None when the statement is outside the pushdown
+        shape (the caller runs the unchanged host path; parameter
+        binding happens on a TRIAL cursor so a refusal consumes
+        nothing). Fallback-tablet rows are re-checked with the
+        executor's own _match before folding, so the combined stats
+        carry executor semantics exactly — including the
+        NULL-fails-every-operator rule."""
+        from yugabyte_tpu.docdb import scan_spec as SS
+        schema = table.schema
+        wire_aggs = []
+        for fname, col in aggs:
+            fn = "sum" if fname == "avg" else fname
+            if SS.compile_aggregate(schema, fn, col) is None:
+                return None
+            wire_aggs.append([fn, col])
+        trial = [cursor[0]]
+        where = self._bind_where(stmt.where, params, trial)
+        known = {c.name: c.type for c in schema.columns}
+        where = self._canon_jsonb_where(where, known)
+        for c, op, _v in where:
+            if not isinstance(c, str) or op == "in":
+                return None
+        dk, residual = self._doc_key_from_where(table, where)
+        if dk is not None and len(dk.range_components) \
+                == schema.num_range_key_columns:
+            return None   # full primary key: the point read is optimal
+        key_names = {c.name for c in schema.hash_columns} | \
+            {c.name for c in schema.range_columns}
+        partition_key = None
+        lo = b""
+        hi = None
+        if dk is not None:
+            prefix = DocKey(hash_components=dk.hash_components,
+                            range_components=dk.range_components).encode()
+            prefix = prefix[:-1]
+            lo, hi = self._range_scan_bounds(schema, dk, prefix, residual)
+            partition_key = table.partition_key_for(dk)
+            residual = [r for r in residual
+                        if not self._bound_enforces(schema, dk, r)]
+        preds = []
+        for c, op, v in residual:
+            if c in key_names:
+                # a key-component predicate the byte bounds don't fully
+                # enforce: outside the scalar-aggregate shape
+                return None
+            if SS.compile_predicate(schema, c, op, v) is None:
+                return None
+            preds.append([c, op, v])
+        cursor[0] = trial[0]
+        fb_dicts: List[dict] = []
+
+        def on_row(row):
+            d = self._row_dict(schema, row)
+            if self._match(d, residual):
+                fb_dicts.append(d)
+
+        partial, _read_ht = self._client.scan_aggregate(
+            table, wire_aggs, filters=preds,
+            partition_key=partition_key, lower_doc_key=lo,
+            upper_doc_key=hi, row_cb=on_row)
+        cid_to_name = {schema.column_id(c.name): c.name
+                       for c in schema.value_columns}
+        stats = {"rows": 0, "cols": {}}
+        if partial is not None:
+            stats["rows"] = partial["rows"]
+            for cid, st in partial["cols"].items():
+                name = cid_to_name.get(int(cid))
+                if name is not None:
+                    stats["cols"][name] = dict(st)
+        # fold the host-checked fallback rows (disjoint tablet sets, so
+        # adding counts/sums and reducing extremes is exact) — once per
+        # DISTINCT aggregated column, however many functions name it
+        stats["rows"] += len(fb_dicts)
+        for col in dict.fromkeys(c for _f, c in aggs if c is not None):
+            st = stats["cols"].setdefault(
+                col, {"nonnull": 0, "sum": 0, "min": None, "max": None})
+            vals = [d.get(col) for d in fb_dicts
+                    if d.get(col) is not None]
+            st["nonnull"] += len(vals)
+            if vals:
+                st["sum"] = st.get("sum", 0) + sum(vals)
+                st["min"] = min(vals) if st.get("min") is None \
+                    else min(st["min"], *vals)
+                st["max"] = max(vals) if st.get("max") is None \
+                    else max(st["max"], *vals)
+        return stats
+
+    @staticmethod
+    def _bound_enforces(schema, dk, pred) -> bool:
+        """True when _range_scan_bounds absorbed this residual predicate
+        into an EXACT byte bound: an inequality on the first unbound
+        clustering column with a correctly-typed literal. (Component
+        encoding is order-preserving and every longer key continues
+        with a tag byte < 0xff, so the prefix+encode(v) bounds include/
+        exclude exactly the predicate's rows — no edge slack.)"""
+        c, op, v = pred
+        bound_n = len(dk.range_components)
+        if bound_n >= len(schema.range_columns):
+            return False
+        nxt_col = schema.range_columns[bound_n]
+        if c != nxt_col.name or op not in ("<", "<=", ">", ">="):
+            return False
+        if not QLProcessor._bound_type_ok(nxt_col.type, v):
+            return False
+        from yugabyte_tpu.docdb.doc_key import PrimitiveValue
+        try:
+            PrimitiveValue.encode(v, bytearray())
+        except TypeError:
+            return False
+        return True
 
     def _conditional_dml(self, stmt, params: List[object],
                          cursor: List[int]) -> ResultSet:
@@ -1099,6 +1288,7 @@ class QLProcessor:
             rows = self._client.scan_key_range(
                 table, table.partition_key_for(dk), lo, hi,
                 read_ht=HybridTime(ps[2]) if ps else None,
+                filters=self._wire_filters(schema, residual),
                 scan_state=scan_state)
             pageable = True
         else:
@@ -1118,6 +1308,7 @@ class QLProcessor:
             else:
                 rows = self._client.scan(
                     table, read_ht=HybridTime(ps[2]) if ps else None,
+                    filters=self._wire_filters(schema, residual),
                     start_cursor=ps[1] if ps else b"",
                     start_lower=ps[0] if ps else b"",
                     scan_state=scan_state)
