@@ -359,6 +359,174 @@ def run_points_child(platform: str, db_dir: str, n_str: str) -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_codec_child(platform: str, n_str: str) -> None:
+    """Block-codec micro rung (ROADMAP item 2): device block decode /
+    encode vs the host codec baselines over one n-row SST.
+
+    Decode: raw-byte parse + block_decode_fused into staged cols, vs the
+    host path (SSTReader.read_all + stage_slab: threaded decode_block +
+    pack_cols) and, when available, the native shell's threaded block
+    decode (add_input + prepare).  Encode: block_encode_fused + host
+    value splice + CRC + file write, vs SSTWriter's per-block
+    encode_block loop and the native shell's threaded write_output.
+    Correctness gates run before any rate ships: the device-decoded cols
+    must equal the host staging bit-for-bit and the device-encoded data
+    file must equal the host-encoded one byte-for-byte."""
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    if platform == "tpu" and dev.platform == "cpu":
+        sys.exit(3)
+    import shutil
+    import tempfile
+
+    import numpy as _np
+
+    from yugabyte_tpu.ops import block_codec
+    from yugabyte_tpu.ops.merge_gc import stage_slab
+    from yugabyte_tpu.storage import native_engine
+    from yugabyte_tpu.storage.sst import (Frontier, SSTReader, SSTWriter,
+                                          data_file_name, write_base_file)
+    from yugabyte_tpu.utils.env import get_env
+
+    n = int(n_str)
+    slab, _offsets = synth_ycsb_runs(n, 1, max(1, n // 2))
+    root = tempfile.mkdtemp(prefix="ybtpu-bench-codec-")
+    out = {"codec_device": str(dev), "codec_rows": n}
+    try:
+        path = os.path.join(root, "in.sst")
+        SSTWriter(path, fit_lindex=False).write(slab, Frontier())
+        r = SSTReader(path)
+
+        # ---- decode: host / native / device --------------------------
+        def host_decode():
+            return stage_slab(r.read_all(), dev)
+
+        def best_of_pair(fa, fb, reps=5):
+            """Interleaved best-of-N for two contenders: alternating the
+            measurements cancels background drift on a shared box (a
+            sequential pair hands whichever runs second the noisier
+            machine)."""
+            ta, tb = [], []
+            for _ in range(reps):
+                t0 = time.time()
+                fa()
+                ta.append(time.time() - t0)
+                t0 = time.time()
+                fb()
+                tb.append(time.time() - t0)
+            return min(ta), min(tb)
+
+        ref = host_decode()   # warm + reference
+        rfb = block_codec.parse_raw_file(r.read_raw(), r.block_handles)
+        st = block_codec.decode_file_to_staged(rfb, dev)   # compile
+        assert _np.array_equal(_np.asarray(st.cols_dev),
+                               _np.asarray(ref.cols_dev)), \
+            "device decode != host staging"
+        import jax as _jax
+
+        def device_decode():
+            nonlocal rfb, st
+            rfb = block_codec.parse_raw_file(r.read_raw(), r.block_handles)
+            st = block_codec.decode_file_to_staged(rfb, dev)
+            _jax.block_until_ready(st.cols_dev)
+
+        host_s, dev_s = best_of_pair(host_decode, device_decode)
+        dec_host_s, dec_dev_s = host_s, dev_s
+        out["block_decode_rows_per_sec"] = round(n / dev_s, 1)
+        out["block_decode_host_rows_per_sec"] = round(n / host_s, 1)
+        out["block_decode_vs_host"] = round(host_s / dev_s, 2)
+        log(f"  block decode: device {n/dev_s/1e6:.2f}M rows/s vs host "
+            f"{n/host_s/1e6:.2f}M rows/s = {host_s/dev_s:.1f}x")
+        if native_engine.available():
+            with open(r.data_path, "rb") as f:
+                raw = f.read()
+            def native_decode():
+                with native_engine.NativeCompactionJob() as job:
+                    job.add_input(raw, r.block_handles)
+                    job.prepare()
+
+            native_decode()   # warm the threads
+            nat_s, _ = best_of_pair(native_decode, lambda: None, reps=3)
+            out["block_decode_native_rows_per_sec"] = round(n / nat_s, 1)
+            log(f"  block decode (native shell): {n/nat_s/1e6:.2f}M rows/s")
+
+        # ---- encode: host / native / device --------------------------
+        def host_encode(tag):
+            p = os.path.join(root, f"host-{tag}.sst")
+            SSTWriter(p, fit_lindex=False).write(slab, Frontier())
+            return p
+
+        def device_encode(tag):
+            p = os.path.join(root, f"dev-{tag}.sst")
+            blocks, index, hashes, fk, lk = block_codec.encode_span(
+                st, n, rfb.w, rfb.values, r.block_handles[0][2]
+                if r.block_handles else 4096, compress=False)
+            dp = data_file_name(p)
+            df = get_env().open_append(dp)
+            try:
+                size = 0
+                for blk in blocks:
+                    df.append(blk)
+                    size += len(blk)
+                df.flush(fsync=True)
+            finally:
+                df.close()
+            write_base_file(p, index, n, hashes, fk, lk, Frontier(), size)
+            return p
+
+        hp = host_encode("warm")
+        dp = device_encode("warm")
+        with open(data_file_name(hp), "rb") as f1, \
+                open(data_file_name(dp), "rb") as f2:
+            assert f1.read() == f2.read(), "device encode != host encode"
+        host_s, dev_s = best_of_pair(lambda: host_encode("t"),
+                                     lambda: device_encode("t"))
+        out["block_encode_rows_per_sec"] = round(n / dev_s, 1)
+        out["block_encode_host_rows_per_sec"] = round(n / host_s, 1)
+        out["block_encode_vs_host"] = round(host_s / dev_s, 2)
+        log(f"  block encode: device {n/dev_s/1e6:.2f}M rows/s vs host "
+            f"{n/host_s/1e6:.2f}M rows/s = {host_s/dev_s:.1f}x")
+        # the codec as a whole (the stage-A + stage-C byte shell one
+        # compaction pays): decode + encode round trip vs the host codec
+        out["block_codec_rows_per_sec"] = round(
+            n / (dec_dev_s + dev_s), 1)
+        out["block_codec_host_rows_per_sec"] = round(
+            n / (dec_host_s + host_s), 1)
+        out["block_codec_vs_host"] = round(
+            (dec_host_s + host_s) / (dec_dev_s + dev_s), 2)
+        log(f"  block codec (decode+encode): device "
+            f"{n/(dec_dev_s+dev_s)/1e6:.2f}M rows/s vs host "
+            f"{n/(dec_host_s+host_s)/1e6:.2f}M rows/s = "
+            f"{(dec_host_s+host_s)/(dec_dev_s+dev_s):.2f}x")
+        if native_engine.available():
+            tomb = b"X"
+
+            def native_encode(tag):
+                p = os.path.join(root, f"nat-{tag}.dat")
+                with native_engine.NativeCompactionJob() as job:
+                    job.add_input(raw, r.block_handles)
+                    job.prepare()
+                    surv = _np.arange(n, dtype=_np.int64)
+                    job.set_survivors(surv, _np.zeros(n, dtype=_np.uint8))
+                    job.write_output(0, n, p,
+                                     r.block_handles[0][2]
+                                     if r.block_handles else 4096,
+                                     compress=False, tombstone_value=tomb)
+                return p
+
+            native_encode("warm")
+            nat_s, _ = best_of_pair(lambda: native_encode("t"),
+                                    lambda: None, reps=3)
+            out["block_encode_native_rows_per_sec"] = round(n / nat_s, 1)
+            log(f"  block encode (native shell, incl. threaded decode "
+                f"ingest): {n/nat_s/1e6:.2f}M rows/s")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(out), flush=True)
+
+
 def run_analytics_child(platform: str, n_str: str) -> None:
     """Analytics rung (ROADMAP item 5): fused filtered/aggregating scans
     vs the per-row host path, over one tablet's resident slabs.
@@ -802,6 +970,8 @@ def run_device_child(platform: str, workload_path: str,
                        stage_device_ms=stage_ms.get("device", 0.0),
                        stage_write_ms=stage_ms.get("write", 0.0),
                        stage_shadow_ms=stage_ms.get("shadow", 0.0),
+                       stage_decode_ms=stage_ms.get("decode", 0.0),
+                       stage_encode_ms=stage_ms.get("encode", 0.0),
                        compile_bucket_hits=bucket_hits,
                        compile_bucket_misses=bucket_misses,
                        compile_surface_buckets=surface_total,
@@ -917,6 +1087,10 @@ def run_device_child(platform: str, workload_path: str,
         # the DEFAULT --shadow_verify_sample (acceptance: <=5% steady
         # regression with sampling on)
         "stage_shadow_ms": stage_ms.get("shadow", 0.0),
+        # device block-codec stages (ops/block_codec.py): raw-word upload
+        # + decode dispatch (stage A) and span encode + download (stage C)
+        "stage_decode_ms": stage_ms.get("decode", 0.0),
+        "stage_encode_ms": stage_ms.get("encode", 0.0),
         "shadow_verify_sample": _shadow_sample_for_report(),
         "shadow_verify_jobs": _shadow_jobs_for_report(),
         "shadow_verify_mismatches": _shadow_mismatches_for_report(),
@@ -1503,7 +1677,8 @@ def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
             recs["e2e_steady"].get("e2e_steady2", 0.0), 1)
         out["e2e_n_rows"] = recs["e2e_steady"]["e2e_n"]
         for k in ("stage_host_ms", "stage_device_ms", "stage_write_ms",
-                  "stage_shadow_ms", "compile_bucket_hits",
+                  "stage_shadow_ms", "stage_decode_ms", "stage_encode_ms",
+                  "compile_bucket_hits",
                   "compile_bucket_misses", "compile_surface_buckets",
                   "shadow_verify_sample", "shadow_verify_jobs",
                   "shadow_verify_mismatches"):
@@ -1640,6 +1815,9 @@ def main():
     if len(sys.argv) >= 4 and sys.argv[1] == "--analytics":
         run_analytics_child(sys.argv[2], sys.argv[3])
         return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--codec":
+        run_codec_child(sys.argv[2], sys.argv[3])
+        return
     if len(sys.argv) >= 4 and sys.argv[1] == "--child":
         run_device_child(sys.argv[2], sys.argv[3],
                          sys.argv[4] if len(sys.argv) > 4 else None)
@@ -1736,6 +1914,17 @@ def main():
             ana = _spawn_child("cpu", 600, n_an, mode="--analytics")
         if ana:
             result.update(ana)
+    # block-codec micro rung (ROADMAP item 2): device block decode/encode
+    # vs the host and native-shell codecs over one SST
+    if os.environ.get("YBTPU_BENCH_SKIP_CODEC", "") != "1":
+        plat = "tpu" if result.get("platform") == "tpu" else "cpu"
+        n_c = str(min(int(result.get("n_rows") or n_top), 1 << 18))
+        cod = _spawn_child(plat, 600, n_c, mode="--codec")
+        if cod is None and plat == "tpu":
+            log("TPU codec child failed — retrying on CPU fallback")
+            cod = _spawn_child("cpu", 600, n_c, mode="--codec")
+        if cod:
+            result.update(cod)
     # BASELINE config 5: the 3-node RF=3 cluster soak with churn
     if os.environ.get("YBTPU_BENCH_SKIP_SOAK", "") != "1":
         result.update(_cluster_soak_stage())

@@ -128,6 +128,9 @@ def test_streaming_writer_overlaps_chunks(tmp_path, monkeypatch):
     rng = np.random.default_rng(22)
     runs = [_mk_run(rng, 1500, 8000) for _ in range(4)]
     readers = _write_runs(str(tmp_path), runs)
+    # this test observes the SHELL's streaming stage C specifically; the
+    # device codec writes outputs through its own writer, so pin it off
+    monkeypatch.setenv("YBTPU_DEVICE_CODEC", "0")
     monkeypatch.setenv("YBTPU_MERGE_CHUNK_ROWS", "2048")
     old = flags.get_flag("compaction_max_output_entries_per_sst")
     flags.set_flag("compaction_max_output_entries_per_sst", 700)

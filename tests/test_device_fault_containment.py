@@ -197,6 +197,10 @@ def test_cancellation_aborts_pipeline_cleanly(tmp_path):
 def test_cancellation_mid_stage_c(tmp_path, monkeypatch):
     """Cancel DURING stage C (between chunk feeds): the already-written
     span files are swept by the attempt's unwind."""
+    # trips the token from inside the SHELL's streaming writer — pin the
+    # device codec off (its own mid-stage-C sweep is covered by
+    # tests/test_block_codec.py)
+    monkeypatch.setenv("YBTPU_DEVICE_CODEC", "0")
     monkeypatch.setenv("YBTPU_MERGE_CHUNK_ROWS", "2048")
     old = flags.get_flag("compaction_max_output_entries_per_sst")
     flags.set_flag("compaction_max_output_entries_per_sst", 800)

@@ -125,16 +125,24 @@ def test_chained_l0_l1_l2_byte_identical_zero_decode(tmp_path):
     blocks0 = _block_decode_counter().value()
     ingest0 = _ingest_counter().value()
 
-    # L0 -> L1 (two jobs), chained straight into L1 -> L2
-    res_a = _run_chain_job(readers_a, str(tmp_path / "oa"), cache, [0, 1],
-                           run_cache=rc, first_id=100)
-    res_b = _run_chain_job(readers_b, str(tmp_path / "ob"), cache, [2, 3],
-                           run_cache=rc, first_id=200)
-    l1_outputs = res_a.outputs + res_b.outputs
-    l1_readers = [SSTReader(p) for _, p, _ in l1_outputs]
-    l1_ids = [fid for fid, _, _ in l1_outputs]
-    res_l2 = _run_chain_job(l1_readers, str(tmp_path / "l2"), cache,
-                            l1_ids, run_cache=rc, first_id=300)
+    # deflake: the SAMPLED shadow verifier's oracle legitimately decodes
+    # the inputs when a job is drawn (default 2%/job) — pin sampling off
+    # so the flat-counter assertion only sees real leaks
+    old_shadow = flags.get_flag("shadow_verify_sample")
+    flags.set_flag("shadow_verify_sample", 0.0)
+    try:
+        # L0 -> L1 (two jobs), chained straight into L1 -> L2
+        res_a = _run_chain_job(readers_a, str(tmp_path / "oa"), cache,
+                               [0, 1], run_cache=rc, first_id=100)
+        res_b = _run_chain_job(readers_b, str(tmp_path / "ob"), cache,
+                               [2, 3], run_cache=rc, first_id=200)
+        l1_outputs = res_a.outputs + res_b.outputs
+        l1_readers = [SSTReader(p) for _, p, _ in l1_outputs]
+        l1_ids = [fid for fid, _, _ in l1_outputs]
+        res_l2 = _run_chain_job(l1_readers, str(tmp_path / "l2"), cache,
+                                l1_ids, run_cache=rc, first_id=300)
+    finally:
+        flags.set_flag("shadow_verify_sample", old_shadow)
 
     # zero re-decode across the whole warm chain: every input came from
     # the HBM slab cache (decisions) + the packed-run cache (bytes)
@@ -255,6 +263,80 @@ def test_digest_check_passes_clean_entries(tmp_path):
     snap = integrity.resident_digest_snapshot()
     assert snap["checked"] > checked0
     assert snap["mismatches"] == mm0
+
+
+def test_cold_chain_flat_decode_counters_with_device_codec(tmp_path):
+    """A COLD L0->L1->L2 chain (empty device cache, empty run cache)
+    with the device codec enabled: neither sst_block_decode_total nor
+    compaction_ingest_decode_total moves at any point — the initial
+    ingest is a raw-byte upload + device decode (block_decode_fused),
+    not a host decode — and the L2 output is byte-identical to the
+    sequential native path (the ISSUE-14 acceptance criterion; the warm
+    test above proves the run-cache/shell flavor)."""
+    assert os.environ.get("YBTPU_DEVICE_CODEC", "1") not in ("0", "false")
+    rng = np.random.default_rng(26)
+    runs_a = [_mk_run(rng, 700, 450) for _ in range(2)]
+    runs_b = [_mk_run(rng, 700, 450) for _ in range(2)]
+    cache = DeviceSlabCache(device=_device())   # EMPTY: nothing pre-staged
+    os.makedirs(str(tmp_path / "a"))
+    os.makedirs(str(tmp_path / "b"))
+    readers_a = _write_runs(str(tmp_path / "a"), runs_a)
+    readers_b = _write_runs(str(tmp_path / "b"), runs_b)
+
+    # determinism: the SAMPLED shadow/digest checks legitimately decode
+    # host blocks when they fire — pin them off so any counter movement
+    # is a real codec leak
+    old_shadow = flags.get_flag("shadow_verify_sample")
+    old_digest = flags.get_flag("resident_digest_sample")
+    flags.set_flag("shadow_verify_sample", 0.0)
+    flags.set_flag("resident_digest_sample", 0.0)
+    blocks0 = _block_decode_counter().value()
+    ingest0 = _ingest_counter().value()
+    from yugabyte_tpu.ops.block_codec import codec_metrics
+    dev_decode0 = codec_metrics()["decode_blocks"].value()
+    dev_encode0 = codec_metrics()["encode_blocks"].value()
+    try:
+        res_a = _run_chain_job(readers_a, str(tmp_path / "oa"), cache,
+                               [0, 1], first_id=100)
+        res_b = _run_chain_job(readers_b, str(tmp_path / "ob"), cache,
+                               [2, 3], first_id=200)
+        l1_outputs = res_a.outputs + res_b.outputs
+        l1_readers = [SSTReader(p) for _, p, _ in l1_outputs]
+        l1_ids = [fid for fid, _, _ in l1_outputs]
+        res_l2 = _run_chain_job(l1_readers, str(tmp_path / "l2"), cache,
+                                l1_ids, first_id=300)
+    finally:
+        flags.set_flag("shadow_verify_sample", old_shadow)
+        flags.set_flag("resident_digest_sample", old_digest)
+
+    # flat across the WHOLE cold chain, including the initial raw-byte
+    # upload: the device codec never routes bytes through decode_block
+    # or the native shell ingest
+    assert _block_decode_counter().value() == blocks0, \
+        "cold chained compaction decoded SST blocks on the host"
+    assert _ingest_counter().value() == ingest0, \
+        "cold chained compaction ingested through the native shell"
+    # the L0 ingest ran on the decode family; outputs on the encode one
+    assert codec_metrics()["decode_blocks"].value() > dev_decode0
+    assert codec_metrics()["encode_blocks"].value() > dev_encode0
+    # the L1->L2 job found its inputs resident (write-through): only the
+    # four L0 files ever paid a decode dispatch
+    assert codec_metrics()["decode_blocks"].value() - dev_decode0 == 4
+
+    for fid in l1_ids:
+        assert cache.level_of(fid) == 1
+    for fid, _p, _props in res_l2.outputs:
+        assert cache.level_of(fid) == 2
+
+    os.makedirs(str(tmp_path / "ref"))
+    ids = iter(range(400, 500))
+    ref = compaction_mod.run_compaction_job(
+        l1_readers, str(tmp_path / "ref"), lambda: next(ids), CUTOFF,
+        True, device="native")
+    assert res_l2.rows_out == ref.rows_out
+    assert _sst_bytes(res_l2.outputs) == _sst_bytes(ref.outputs)
+    for r in l1_readers + readers_a + readers_b:
+        r.close()
 
 
 # ---------------------------------------------------------------------------

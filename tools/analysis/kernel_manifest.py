@@ -58,6 +58,8 @@ _DEVICE_CACHE = "yugabyte_tpu/storage/device_cache.py"
 _POINT_READ = "yugabyte_tpu/ops/point_read.py"
 _BLOOM = "yugabyte_tpu/storage/bloom.py"
 _LEARNED = "yugabyte_tpu/storage/learned_index.py"
+_BLOCK_CODEC = "yugabyte_tpu/ops/block_codec.py"
+_BLOCK_FORMAT = "yugabyte_tpu/storage/block_format.py"
 
 # Per-family compile-surface definition: which source symbols shape the
 # lowered program (fingerprinted for the fast drift gate), the budget
@@ -233,6 +235,46 @@ FAMILIES: Dict[str, dict] = {
                        "fit_from_slab", "finish_model", "_predict_host",
                        "_anchor_positions", "LINDEX_SEGMENTS",
                        "LINDEX_MIN_ENTRIES"],
+        },
+    },
+    "block_decode": {
+        # device SST block decode (ROADMAP item 2): raw block bodies ->
+        # staged cols without host decode_block. The on-disk layout
+        # (block_format.py) IS this family's compile surface: editing
+        # encode_block/decode_block re-fingerprints both codec families.
+        "budget": 8,
+        "anchor": _BLOCK_CODEC,
+        "symbols": {
+            _BLOCK_CODEC: ["_block_decode_impl", "_block_decode_fused",
+                           "_block_decode_fused_donated", "_bswap32",
+                           "_quantize_width", "_PREWARM_DECODE",
+                           "decode_avals", "prewarm_block_codec"],
+            _BLOCK_FORMAT: ["encode_block", "decode_block",
+                            "split_raw_block", "fixed_region_bytes",
+                            "META_BYTES_PER_ROW"],
+            _MERGE_GC: ["bucket_size", "pad_template"],
+        },
+    },
+    "block_encode": {
+        # device SST block encode: gathered survivor-span cols -> the
+        # exact on-disk column encodings (host splices values + CRC).
+        # Jit-keyed on shapes only (no static args), so the lattice is
+        # the (n_out_pad, w_pad) span-gather vocabulary.
+        "budget": 4,
+        "anchor": _BLOCK_CODEC,
+        "symbols": {
+            _BLOCK_CODEC: ["_block_encode_impl", "_block_encode_fused",
+                           "_bswap32", "encode_span", "_PREWARM_DECODE",
+                           "prewarm_block_codec"],
+            _BLOCK_FORMAT: ["encode_block", "split_raw_block",
+                            "fixed_region_bytes", "META_BYTES_PER_ROW"],
+            # the in-kernel bloom hash shares the point-read FNV limb
+            # arithmetic; the numpy twin in storage/bloom.py DEFINES the
+            # bit positions, so both are part of this compile surface
+            _POINT_READ: ["_mul64_by_prime", "_FNV_OFFSET_HI",
+                          "_FNV_OFFSET_LO", "_FNV_PRIME_LOW"],
+            _BLOOM: ["fnv64_masked"],
+            _MERGE_GC: ["bucket_size", "pad_template"],
         },
     },
     "dist_compact": {
@@ -1094,6 +1136,74 @@ def _gen_index_fit() -> dict:
     return {"entries": entries}
 
 
+def _gen_block_decode() -> dict:
+    """Device block-codec decode lattice: the _PREWARM_DECODE (n_pad,
+    w_pad) points.  Shapes-only compile keys (no static args — the
+    gather-free program is keyed by its padded column shapes alone)."""
+    import jax
+    from yugabyte_tpu.ops import block_codec
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    for n_pad, w_pad in sorted(block_codec._PREWARM_DECODE):
+        args = block_codec.decode_avals(n_pad, w_pad)
+        out = jax.eval_shape(block_codec._block_decode_fused, *args)
+        text = lowering_text(block_codec._block_decode_fused, args, {})
+        bucket = {"n_pad": n_pad, "w": w_pad}
+        entries.append({
+            "key": "block_decode " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": {},
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            # the raw-word upload is TRANSIENT (values were sliced host-
+            # side before the upload), so the donated twin reuses its HBM
+            # for the cols output on capable backends
+            "donation": {"donate_argnums": [0], "variants": 2},
+            "variant_axes": {"donate": 2},
+            "executables": 2,
+            "prewarmed": True,
+            "quarantine_key": [1, n_pad],
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    return {"entries": entries}
+
+
+def _gen_block_encode() -> dict:
+    """Device block-codec encode lattice: one shapes-only program per
+    span-gather bucket (_PREWARM_DECODE mirrors the span n_out_pad
+    vocabulary); NEVER donated — the same span cols install into the
+    slab cache after the SST hits disk."""
+    import jax
+    import jax.numpy as jnp
+    from yugabyte_tpu.ops import block_codec
+    from yugabyte_tpu.utils.jax_setup import lowering_text
+
+    entries = []
+    sdt = jax.ShapeDtypeStruct
+    for n_pad, w_pad in sorted(block_codec._PREWARM_DECODE):
+        args = (sdt((_ROW_WORDS + w_pad, n_pad), jnp.uint32),)
+        out = jax.eval_shape(block_codec._block_encode_fused, *args)
+        text = lowering_text(block_codec._block_encode_fused, args, {})
+        bucket = {"n_pad": n_pad, "w": w_pad}
+        entries.append({
+            "key": "block_encode " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": {},
+            "in_avals": [_aval_str(a) for a in args],
+            "out_avals": [_aval_str(o) for o in
+                          jax.tree_util.tree_leaves(out)],
+            "donation": None,
+            "variant_axes": {},
+            "executables": 1,
+            "prewarmed": True,
+            "quarantine_key": [1, n_pad],
+            "lowering_sha256": _lowering_sha256(text),
+        })
+    return {"entries": entries}
+
+
 def _gen_dist_compact() -> dict:
     # shard_map needs a real mesh; the declared compile-key lattice is
     # recorded instead (enforced in code: distributed_compact quantizes
@@ -1124,6 +1234,8 @@ _GENERATORS = {
     "point_read_probe": _gen_point_read_probe,
     "point_read_locate": _gen_point_read_locate,
     "index_fit": _gen_index_fit,
+    "block_decode": _gen_block_decode,
+    "block_encode": _gen_block_encode,
     "dist_compact": _gen_dist_compact,
 }
 

@@ -42,6 +42,61 @@ from yugabyte_tpu.utils.status import Status, StatusError
 BLOCK_MAGIC = 0x53425459
 _HEADER = struct.Struct("<IIIIII")
 
+# Fixed-width column bytes per row in the body, AFTER the key slab:
+# key_len(2) + doc_key_len(2) + ht_hi(4) + ht_lo(4) + write_id(4) +
+# entry_flags(1) + ttl_ms(8).  The device block codec (ops/block_codec.py)
+# computes its gather/scatter offsets from this constant and the region
+# order above — any layout change here MUST move the codec kernels too
+# (both are fingerprinted together in the kernel manifest).
+META_BYTES_PER_ROW = 25
+HEADER_BYTES = _HEADER.size          # 24
+TRAILER_BYTES = 4                    # u32 crc32
+
+
+def fixed_region_bytes(n: int, stride: int) -> int:
+    """Bytes of the body's fixed-width columns (key slab + metadata
+    arrays) — everything before val_offsets."""
+    return n * stride + META_BYTES_PER_ROW * n
+
+
+def split_raw_block(data) -> Tuple[int, int, bytes]:
+    """Parse + integrity-check one raw block WITHOUT decoding columns:
+    returns (n_entries, key_stride, uncompressed body bytes).
+
+    The device-codec ingest path: magic/CRC/size checks are identical to
+    decode_block (typed Status.Corruption, never wrong bytes), but the
+    column transforms stay undone — the body ships to the device as raw
+    words and value rows are zero-copy slices of it.  `data` may be a
+    memoryview over the whole data file (zero-copy slicing; the CRC runs
+    incrementally over the buffer)."""
+    if len(data) < _HEADER.size + TRAILER_BYTES:
+        raise StatusError(Status.Corruption("block too small"))
+    magic, n, stride, flags, body_len, raw_len = _HEADER.unpack_from(data, 0)
+    if magic != BLOCK_MAGIC:
+        raise StatusError(Status.Corruption("bad block magic"))
+    off = _HEADER.size
+    if len(data) < off + body_len + TRAILER_BYTES:
+        raise StatusError(Status.Corruption("block truncated"))
+    stored = data[off: off + body_len]
+    (crc,) = struct.unpack_from("<I", data, off + body_len)
+    if crc != zlib.crc32(stored, zlib.crc32(data[4: off])):
+        raise StatusError(Status.Corruption("block checksum mismatch"))
+    body = zlib.decompress(stored) if (flags & 1) else stored
+    if len(body) != raw_len:
+        raise StatusError(Status.Corruption("block size mismatch"))
+    if stride % 4 or fixed_region_bytes(n, stride) + 4 * (n + 1) > raw_len:
+        raise StatusError(Status.Corruption("block geometry mismatch"))
+    return n, stride, body
+
+
+def raw_block_values(n: int, stride: int, body: bytes):
+    """Zero-copy value rows of one uncompressed block body (the on-disk
+    layout IS blob + offsets; no column decode happens)."""
+    from yugabyte_tpu.ops.slabs import ValueArray
+    p = fixed_region_bytes(n, stride)
+    val_offsets = np.frombuffer(body, dtype="<u4", count=n + 1, offset=p)
+    return ValueArray.from_blob(body[p + 4 * (n + 1):], val_offsets)
+
 
 def encode_block(slab: KVSlab, start: int, end: int, compress: bool = False) -> bytes:
     """Serialize slab rows [start, end) into one block."""

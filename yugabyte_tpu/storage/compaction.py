@@ -614,7 +614,29 @@ def run_compaction_job_device_native(
                                   input_ids=orig_input_ids,
                                   _no_combined=True, cancel=cancel)
 
+    from yugabyte_tpu.ops import block_codec as block_codec_mod
+    # The device codec rides the COLD byte path: when every input is
+    # already in the native run cache the shell ingests with zero decode
+    # anyway (and its export keeps the chain warm), so the shell keeps
+    # those jobs; everything else decodes and encodes on device.
+    all_run_cached = bool(
+        run_cache is not None and input_ids is not None
+        and all(run_cache.contains(fid) for fid in input_ids))
     try:
+        if block_codec_mod.codec_enabled() and not all_run_cached:
+            try:
+                return _device_codec_attempt(
+                    inputs, all_inputs, input_ids, dropped_rows, out_dir,
+                    new_file_id, history_cutoff_ht, is_major,
+                    retain_deletes, device, block_entries, device_cache,
+                    cancel)
+            except block_codec_mod.BlockCodecUnsupported as e:
+                block_codec_mod.codec_metrics()[
+                    "encode_fallbacks"].increment()
+                TRACE("compaction: device codec unsupported for this "
+                      "job (%s) — taking the native byte shell", e)
+        else:
+            block_codec_mod.codec_metrics()["encode_fallbacks"].increment()
         return _device_native_attempt(
             inputs, all_inputs, input_ids, dropped_rows, out_dir,
             new_file_id, history_cutoff_ht, is_major, retain_deletes,
@@ -635,6 +657,8 @@ def run_compaction_job_device_native(
         offload_policy_mod.bucket_quarantine().quarantine(
             qkey, reason=f"{type(cause).__name__}: {cause}")
         _storage_fallback_counter().increment()
+        # the native re-run below writes through the shell encode
+        block_codec_mod.codec_metrics()["encode_fallbacks"].increment()
         if shadow_mm:
             # the alarm: device decisions diverged from the native
             # oracle — a SILENT-corruption event (bit flip / donation
@@ -1070,6 +1094,249 @@ def _device_native_body(
         installer.finish()
     return CompactionResult(outputs, rows_in + dropped_rows, rows_out,
                             tombstones_written=tombstones_written)
+
+
+class _DeviceCodecWriter:
+    """Stage C of the device-codec job: write output SSTs whose block
+    bytes were assembled by `block_encode_fused` (ops/block_codec.py) —
+    the shell-free twin of _StreamingNativeWriter.
+
+    File splits, pacing, tombstone and base-assembly rules are exactly
+    those of _StreamingNativeWriter, so codec and shell jobs produce
+    byte-identical files over identical survivor ranges.  Each span's
+    cols are gathered ON DEVICE once and shared three ways: the encode
+    dispatch, the learned-index fit and the write-through install."""
+
+    def __init__(self, handle, values, w_out: int, out_dir: str,
+                 new_file_id, fr, block_entries: Optional[int],
+                 has_deep: bool = False, cancel=None, installer=None):
+        self._handle = handle
+        self._values = values          # global ValueArray, input order
+        self._w_out = w_out
+        self._out_dir = out_dir
+        self._new_file_id = new_file_id
+        self._fr = fr
+        self._has_deep = has_deep
+        self._cancel = cancel
+        self._installer = installer
+        self._block_entries = (block_entries if block_entries is not None
+                               else flags.get_flag("sst_block_entries"))
+        self._max_rows = flags.get_flag(
+            "compaction_max_output_entries_per_sst")
+        self._limiter = compaction_rate_limiter()
+        self._tombstone_value = Value.tombstone().encode()
+        self._pos_all = None
+        self.outputs: List[Tuple[int, str, SSTProps]] = []
+        self.ranges: List[Tuple[int, int]] = []
+
+    def _gather_span(self, start: int, end: int):
+        from yugabyte_tpu.ops import run_merge
+        h = self._handle
+        if getattr(h, "_perm_dev", None) is None \
+                and hasattr(h, "to_parent_products"):
+            # chunked stream: decisions fully drained before stage C, so
+            # the parent-domain device arrays can rebuild here
+            h.to_parent_products()
+        inst = self._installer
+        if inst is not None:
+            st = inst._gather_span(start, end)
+            # prefill the installer's span cache: the lindex fit and the
+            # post-write install reuse this gather instead of repeating it
+            inst._span_cache[(start, end)] = st
+            return st, inst.lindex_for_span(start, end)
+        if self._pos_all is None:
+            self._pos_all = run_merge.survivor_positions(h)
+        return run_merge.gather_staged_output_span(
+            h, self._pos_all, start, end), None
+
+    def _write_span(self, surv: np.ndarray, mk: np.ndarray,
+                    start: int, end: int, more_coming: bool) -> None:
+        import time as _time
+        from yugabyte_tpu.ops import block_codec
+        from yugabyte_tpu.storage.sst import (data_file_name, write_base_file,
+                                              sst_compression_enabled)
+        from yugabyte_tpu.utils.env import get_env
+        from yugabyte_tpu.utils.metrics import record_pipeline_stage
+        if self._cancel is not None:
+            self._cancel.check()   # file-split boundary: clean abort point
+        st, lindex = self._gather_span(start, end)
+        vals = self._values.gather(surv[start:end],
+                                   replace_mask=mk[start:end],
+                                   replacement=self._tombstone_value)
+        blocks, index, hashes, fk, lk = block_codec.encode_span(
+            st, end - start, self._w_out, vals, self._block_entries,
+            compress=sst_compression_enabled())
+        t0 = _time.monotonic()
+        fid = self._new_file_id()
+        base_path = os.path.join(self._out_dir, f"{fid:06d}.sst")
+        data_path = data_file_name(base_path)
+        if os.path.exists(data_path):
+            os.remove(data_path)   # never append to a stale data file
+        df = get_env().open_append(data_path)
+        try:
+            size = 0
+            for blk in blocks:
+                df.append(blk)
+                size += len(blk)
+            df.flush(fsync=True)
+        finally:
+            df.close()
+        props = write_base_file(base_path, index, end - start, hashes,
+                                fk, lk, self._fr, size,
+                                has_deep=self._has_deep, lindex=lindex)
+        self.outputs.append((fid, base_path, props))
+        self.ranges.append((start, end))
+        record_pipeline_stage("write", (_time.monotonic() - t0) * 1e3)
+        if self._installer is not None:
+            self._installer.on_span(fid, base_path, start, end)
+        if self._limiter is not None and more_coming:
+            self._limiter.acquire(props.data_size + props.base_size)
+
+    def write_all(self, surv: np.ndarray, mk: np.ndarray, rows_out: int
+                  ) -> Tuple[List[Tuple[int, str, SSTProps]],
+                             List[Tuple[int, int]]]:
+        start = 0
+        while start < rows_out:
+            end = min(start + self._max_rows, rows_out)
+            self._write_span(surv, mk, start, end,
+                             more_coming=end < rows_out)
+            start = end
+        return self.outputs, self.ranges
+
+
+def _device_codec_attempt(
+        inputs, all_inputs, input_ids, dropped_rows: int, out_dir: str,
+        new_file_id, history_cutoff_ht: int, is_major: bool,
+        retain_deletes: bool, device, block_entries, device_cache,
+        cancel) -> CompactionResult:
+    """One attempt of the shell-free device-codec job (decode, merge and
+    encode all on device; the host only CRC-checks raw bytes, splices
+    values and writes files).  Unwinds exactly like
+    _device_native_attempt: partial outputs deleted, installed cache
+    entries dropped, zero leaked pins — so the caller's containment can
+    quarantine + re-run natively after any device fault."""
+    state = {"writer": None, "installer": None, "pins": []}
+    try:
+        return _device_codec_body(
+            inputs, all_inputs, input_ids, dropped_rows, out_dir,
+            new_file_id, history_cutoff_ht, is_major, retain_deletes,
+            device, block_entries, device_cache, cancel, state)
+    except BaseException:
+        w = state["writer"]
+        if w is not None:
+            from yugabyte_tpu.storage.sst import data_file_name
+            for _fid, base_path, _props in w.outputs:
+                for p in (base_path, data_file_name(base_path)):
+                    try:
+                        os.remove(p)
+                    except OSError:  # yblint: contained(unwind cleanup of partial outputs; the file may not exist yet)
+                        pass
+        inst = state["installer"]
+        if inst is not None:
+            inst.unwind()
+        raise
+    finally:
+        if device_cache is not None:
+            for fid in state["pins"]:
+                device_cache.unpin(fid)
+
+
+def _device_codec_body(
+        inputs, all_inputs, input_ids, dropped_rows: int, out_dir: str,
+        new_file_id, history_cutoff_ht: int, is_major: bool,
+        retain_deletes: bool, device, block_entries, device_cache,
+        cancel, state: dict) -> CompactionResult:
+    import time as _time
+    from yugabyte_tpu.ops import block_codec, device_faults, run_merge
+    from yugabyte_tpu.ops.slabs import ValueArray
+    from yugabyte_tpu.storage import integrity
+    from yugabyte_tpu.utils.metrics import record_pipeline_stage
+
+    shadow = integrity.maybe_shadow_verifier(
+        inputs, history_cutoff_ht, is_major, retain_deletes)
+
+    # -- stage A: raw-byte ingest. One file read + per-block CRC check +
+    # zero-copy value slicing per input (block_format.split_raw_block);
+    # key columns decode ON DEVICE (block_decode_fused) unless the slab
+    # cache already holds them — either way no host decode_block runs, so
+    # sst_block_decode_total and compaction_ingest_decode_total stay flat
+    # even on a COLD chain.
+    t0 = _time.monotonic()
+    staged_list = []
+    values_parts = []
+    rows_in = 0
+    w_out = 1
+    for r, fid in zip(inputs, input_ids or [None] * len(inputs)):
+        if cancel is not None:
+            cancel.check()   # input boundary, like the shell ingest
+        rfb = block_codec.parse_raw_file(r.read_raw(), r.block_handles)
+        values_parts.extend(rfb.value_parts)
+        rows_in += rfb.n
+        w_out = max(w_out, rfb.w)
+        st = device_cache.get(fid) if (device_cache is not None
+                                       and fid is not None) else None
+        if st is None:
+            st = (device_cache.stage_from_raw(fid, rfb)
+                  if device_cache is not None and fid is not None
+                  else block_codec.decode_file_to_staged(rfb, device))
+        if device_cache is not None and fid is not None \
+                and device_cache.pin(fid):
+            state["pins"].append(fid)
+        staged_list.append(st)
+    values = ValueArray.concat(values_parts)
+    record_pipeline_stage("host", (_time.monotonic() - t0) * 1e3)
+
+    # -- stage B: the same fused merge+GC launch as the shell path
+    t0 = _time.monotonic()
+    staged_runs = run_merge.stage_runs_from_staged(staged_list)
+    params = GCParams(history_cutoff_ht, is_major, retain_deletes)
+    handle = run_merge.launch_merge_gc(staged_runs, params)
+    record_pipeline_stage("host", (_time.monotonic() - t0) * 1e3)
+
+    # decisions drain fully before stage C: the survivor indices drive
+    # the host value gather, and span gathers need the parent-domain
+    # device arrays (chunked streams only expose them post-drain)
+    surv_parts, mk_parts = [], []
+    for perm_c, keep_c, mk_c in handle.result_iter():
+        if cancel is not None:
+            cancel.check()
+        surv_c = perm_c[keep_c]
+        mk_surv = mk_c[keep_c]
+        device_faults.maybe_flip_survivors(surv_c, mk_surv)
+        if shadow is not None:
+            shadow.check_chunk(surv_c, mk_surv)
+        surv_parts.append(surv_c)
+        mk_parts.append(mk_surv)
+    surv = (np.concatenate(surv_parts) if surv_parts
+            else np.zeros(0, dtype=np.int64))
+    mk = (np.concatenate(mk_parts) if mk_parts
+          else np.zeros(0, dtype=bool))
+    rows_out = int(surv.shape[0])
+    if shadow is not None:
+        shadow.finish(rows_out)
+
+    # -- stage C: device block encode + host value splice per span
+    fr = _merge_frontiers([r.props.frontier for r in all_inputs],
+                          history_cutoff_ht)
+    has_deep = any(r.props.has_deep for r in inputs)
+    installer = None
+    if device_cache is not None:
+        in_levels = [device_cache.level_of(fid)
+                     for fid in (input_ids or []) if fid is not None]
+        out_level = 1 + max([lv for lv in in_levels if lv is not None],
+                            default=0)
+        installer = _ResidentSpanInstaller(device_cache, out_level)
+        installer.handle = handle
+        state["installer"] = installer
+    writer = _DeviceCodecWriter(
+        handle, values, w_out, out_dir, new_file_id, fr, block_entries,
+        has_deep=has_deep, cancel=cancel, installer=installer)
+    state["writer"] = writer
+    outputs, _ranges = writer.write_all(surv, mk, rows_out)
+    if installer is not None:
+        installer.finish()
+    return CompactionResult(outputs, rows_in + dropped_rows, rows_out,
+                            tombstones_written=int(np.count_nonzero(mk)))
 
 
 def _gather_slab(slab: KVSlab, sel: np.ndarray, make_tomb: np.ndarray,

@@ -216,6 +216,18 @@ class DeviceSlabCache:
                 self._g_used.set(self._used)
                 self._g_pinned.set(self._pinned_unlocked())
 
+    def stage_from_raw(self, key: CacheKey, rfb,
+                       level: int = 0) -> StagedCols:
+        """Raw-block staging (the device codec's cache miss path): decode
+        one parsed file's raw block regions ON DEVICE
+        (ops/block_codec.decode_file_to_staged) and install the resulting
+        cols — no host decode_block runs, so sst_block_decode_total stays
+        flat even when the chain starts cold."""
+        from yugabyte_tpu.ops.block_codec import decode_file_to_staged
+        staged = decode_file_to_staged(rfb, self.device)
+        self.put(key, staged, level=level)
+        return staged
+
     def stage(self, key: CacheKey, slab: KVSlab,
               level: int = 0, for_read: bool = False,
               include_vals: bool = False) -> StagedCols:
@@ -319,6 +331,11 @@ class NamespacedSlabCache:
         return self._shared.stage((self.namespace, file_id), slab,
                                   level=level, for_read=for_read,
                                   include_vals=include_vals)
+
+    def stage_from_raw(self, file_id: int, rfb, level: int = 0
+                       ) -> StagedCols:
+        return self._shared.stage_from_raw((self.namespace, file_id), rfb,
+                                           level=level)
 
 
 class HostStagingPool:

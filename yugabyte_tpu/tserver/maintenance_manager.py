@@ -168,7 +168,7 @@ class PrewarmKernelsOp(MaintenanceOp):
         stats.perf_improvement = self.PREWARM_SCORE
 
     def perform(self) -> None:
-        from yugabyte_tpu.ops import point_read, run_merge, scan
+        from yugabyte_tpu.ops import block_codec, point_read, run_merge, scan
         from yugabyte_tpu.storage import offload_policy
         from yugabyte_tpu.utils.metrics import publish_compile_surface
         n = run_merge.prewarm_buckets(self._shapes)
@@ -183,6 +183,9 @@ class PrewarmKernelsOp(MaintenanceOp):
         # on the 40-program pushdown lattice.
         if self._shapes is None:
             n += scan.prewarm_scan_pushdown()
+            # device block codec (stage A decode / stage C encode): the
+            # first cold compaction chain must not stall on its compile
+            n += block_codec.prewarm_block_codec()
         # expose the declared compile surface (committed kernel
         # manifest) next to the bucket hit/miss counters: the warm cache
         # must cover exactly this many executables

@@ -195,6 +195,16 @@ class TabletServer:
             "kernel_compile_bucket_misses_total",
             "first launches of a shape bucket (compile or persistent-"
             "cache load)").value()
+        # device block codec (ops/block_codec.py): blocks decoded/encoded
+        # on device vs jobs that wrote through the native shell encode
+        from yugabyte_tpu.ops.block_codec import codec_metrics
+        cm = codec_metrics()
+        pipeline["compaction_block_decode_device_total"] = \
+            cm["decode_blocks"].value()
+        pipeline["compaction_block_encode_device_total"] = \
+            cm["encode_blocks"].value()
+        pipeline["compaction_block_encode_fallback_total"] = \
+            cm["encode_fallbacks"].value()
         # device-fault containment: shape buckets parked native-only
         # after a kernel-path fault (timed decay), plus how often the
         # mid-job native fallback and the per-chunk retry actually fired

@@ -366,14 +366,18 @@ def publish_compile_surface(counts: Dict[str, int]) -> None:
             "families (committed manifest)").set(total)
 
 
-_PIPELINE_STAGES = ("host", "device", "write")
+_PIPELINE_STAGES = ("host", "device", "write", "shadow", "decode",
+                    "encode")
 
 
 def record_pipeline_stage(stage: str, ms: float) -> None:
     """One slice of compaction-pipeline wall time: `stage` is where the
-    time went — 'host' (SST block decode + column packing + decision
-    decode), 'device' (kernel compute + H2D/D2H transfer waits) or
-    'write' (native byte-shell SST output I/O). Per-stage histograms plus
+    time went — 'host' (raw-byte ingest + column packing + decision
+    decode), 'device' (kernel compute + H2D/D2H transfer waits),
+    'write' (SST output I/O), 'shadow' (sampled oracle verification),
+    'decode' (device block-codec ingest: raw-word upload + decode
+    dispatch) or 'encode' (device block-codec output: span encode
+    dispatch + download + block assembly). Per-stage histograms plus
     a cumulative-ms gauge feed /compactionz and bench.py's stage report,
     so a stalled pipeline shows WHICH stage is the bottleneck."""
     e = kernel_metrics()
