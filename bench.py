@@ -1104,6 +1104,237 @@ def run_device_child(platform: str, workload_path: str,
     }), flush=True)
 
 
+def run_pool_child(platform: str, mesh_n_str: str) -> None:
+    """One rung of the compaction-pool ladder: aggregate multi-tablet
+    merge+GC decision throughput at one mesh size (ROADMAP item 3 — the
+    headline is aggregate rows/s across N concurrent tablet jobs, not
+    single-job latency).
+
+    Mesh size 1 measures the INLINE single-device dispatch
+    (ops/run_merge.merge_and_gc_runs per job) because that is what the
+    system actually runs there — the server only builds a CompactionPool
+    over a >1-device mesh. Mesh sizes >= 2 measure the pool's batch-slot
+    waves (parallel/dist_compact.pooled_merge_gc) over the same jobs.
+    Inputs are pre-staged (the steady-state regime: flush/compaction
+    write-through keeps them resident); SST I/O is excluded here and
+    covered by the identity phase, which runs FULL pooled jobs through
+    tserver/compaction_pool.CompactionPool and proves the outputs
+    byte-identical to sequential runs with zero leaked pins/leases."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # pre-0.4.38: callers set xla_force_host_platform_device_count
+    mesh_n = int(mesh_n_str)
+    assert len(jax.devices()) >= mesh_n, (len(jax.devices()), mesh_n)
+
+    from yugabyte_tpu.ops import run_merge
+    from yugabyte_tpu.ops.merge_gc import GCParams
+    from yugabyte_tpu.parallel import dist_compact as dist_mod
+    from yugabyte_tpu.parallel.mesh import make_mesh
+
+    cutoff = 10_000_000 << 12
+    params = GCParams(cutoff, True)
+    out = {"pool_mesh_devices": mesh_n,
+           "pool_platform": jax.devices()[0].platform}
+
+    def _jobs(n_jobs, rows, k):
+        jobs = []
+        for j in range(n_jobs):
+            slab, offsets = synth_ycsb_runs(rows, k, max(2, rows // 2),
+                                            seed=j)
+            jobs.append(_split_runs(slab, offsets))
+        return jobs
+
+    def _measure(jobs, mesh):
+        staged = []
+        for runs in jobs:
+            b = dist_mod.pool_slot_bucket(runs)
+            staged.append(dist_mod.stage_pool_slot(runs, *b))
+        if mesh is None:
+            run_merge.merge_and_gc_runs(jobs[0], params)   # warm/compile
+            t0 = time.time()
+            done = 0
+            for runs, st in zip(jobs, staged):
+                run_merge.merge_and_gc_runs(runs, params)
+                done += st.n
+            return done / max(time.time() - t0, 1e-9), 0
+        dist_mod.pooled_merge_gc(mesh, [(staged[0], params)])  # warm
+        t0 = time.time()
+        done = waves = 0
+        i = 0
+        n_slots = mesh.devices.size
+        while i < len(staged):
+            wave = [(s, params) for s in staged[i:i + n_slots]]
+            dist_mod.pooled_merge_gc(mesh, wave)
+            done += sum(s.n for s, _p in wave)
+            waves += 1
+            i += n_slots
+        return done / max(time.time() - t0, 1e-9), waves
+
+    # headline series: small multi-tablet jobs (the overhead-dominated
+    # regime where pooling matters most on a 1-core CPU mesh; a TPU
+    # round adds real per-slot device parallelism on top)
+    small = _jobs(96, 256, 2)
+    mesh = make_mesh(mesh_n) if mesh_n > 1 else None
+    rate, waves = _measure(small, mesh)
+    out["pool_rows_per_sec"] = round(rate, 1)
+    out["pool_jobs"] = len(small)
+    out["pool_job_rows"] = 256
+    out["pool_waves"] = waves
+    # context series: mid-size jobs (compute-dominated on CPU — shows
+    # the amortization win shrinking as compute takes over)
+    mid = _jobs(32, 4096, 4)
+    rate_mid, _w = _measure(mid, mesh)
+    out["pool_mid_rows_per_sec"] = round(rate_mid, 1)
+    out["pool_mid_job_rows"] = 4096
+
+    if mesh_n == len(jax.devices()):
+        out.update(_pool_identity_phase(cutoff))
+    print(json.dumps(out), flush=True)
+
+
+def _pool_identity_phase(cutoff: int) -> dict:
+    """Full pooled compaction jobs through the REAL scheduler vs
+    sequential single-device runs: byte-identical outputs, zero leaked
+    pins, zero leaked staging leases."""
+    import shutil
+    import tempfile as _tf
+
+    import jax
+    from yugabyte_tpu.parallel.mesh import make_mesh
+    from yugabyte_tpu.storage.compaction import run_compaction_job
+    from yugabyte_tpu.storage.device_cache import (DeviceSlabCache,
+                                                   host_staging_pool)
+    from yugabyte_tpu.storage.sst import (Frontier, SSTReader, SSTWriter,
+                                          data_file_name)
+    from yugabyte_tpu.tserver.compaction_pool import (CompactionPool,
+                                                      PoolRequest)
+
+    root = _tf.mkdtemp(prefix="ybtpu-bench-pool-")
+    pool = CompactionPool(make_mesh(8))
+    shared = DeviceSlabCache(jax.devices()[0], capacity_bytes=1 << 30)
+    identical = True
+    try:
+        tablets = {}
+        for t in range(4):
+            n = 20000
+            slab, offsets = synth_ycsb_runs(n, 4, n // 2, seed=50 + t)
+            _attach_values(slab, 16)
+            runs = _split_runs(slab, offsets)
+            d = os.path.join(root, f"in{t}")
+            os.makedirs(d)
+            paths = []
+            for i, sub in enumerate(runs):
+                p = os.path.join(d, f"{i:06d}.sst")
+                SSTWriter(p).write(sub, Frontier())
+                paths.append(p)
+            tablets[f"t{t}"] = paths
+        handles = {}
+        for tid, paths in tablets.items():
+            readers = [SSTReader(p) for p in paths]
+            cache = pool.partition_for(shared, f"db-{tid}", tid)
+            for fid, r in enumerate(readers):
+                cache.stage(fid, r.read_all())
+            outd = os.path.join(root, f"pool_out_{tid}")
+            os.makedirs(outd)
+            ids = iter(range(100, 10_000))
+            handles[tid] = (pool.submit(tid, PoolRequest(
+                inputs=readers, out_dir=outd,
+                new_file_id=lambda it=ids: next(it),
+                history_cutoff_ht=cutoff, is_major=True,
+                input_ids=list(range(len(readers))),
+                device_cache=cache)), readers)
+        results = {}
+        for tid, (h, readers) in handles.items():
+            results[tid] = h.result(timeout=300)
+            for r in readers:
+                r.close()
+        for tid, paths in tablets.items():
+            readers = [SSTReader(p) for p in paths]
+            outd = os.path.join(root, f"seq_out_{tid}")
+            os.makedirs(outd)
+            ids = iter(range(100, 10_000))
+            res = run_compaction_job(readers, outd,
+                                     lambda it=ids: next(it), cutoff,
+                                     True, device=jax.devices()[0])
+            for r in readers:
+                r.close()
+            for (f1, p1, _a), (f2, p2, _b) in zip(res.outputs,
+                                                  results[tid].outputs):
+                for fn in (lambda p: p, data_file_name):
+                    with open(fn(p1), "rb") as fa, open(fn(p2), "rb") as fb:
+                        if fa.read() != fb.read():
+                            identical = False
+        return {
+            "pool_identical_to_sequential": identical,
+            "pool_leaked_pins": shared.pinned_count(),
+            "pool_leaked_leases": host_staging_pool().outstanding(),
+        }
+    finally:
+        pool.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def run_pool_parent() -> None:
+    """`bench.py --compaction_pool`: the MULTICHIP pool ladder — one
+    child per mesh size {1, 2, 4, 8} (fresh process each, so the virtual
+    CPU mesh and the jit caches are per-rung), recorded as
+    MULTICHIP_r06.json with the scaling ratio and every knob."""
+    budget = float(os.environ.get("YBTPU_BENCH_POOL_TIMEOUT", 600))
+    mesh_sizes = [1, 2, 4, 8]
+    per_mesh = {}
+    for n in mesh_sizes:
+        child = _spawn_child("cpu", budget, str(n), mode="--pool_child")
+        if child is None:
+            log(f"pool child mesh={n} failed")
+            continue
+        per_mesh[str(n)] = child
+        log(f"pool mesh={n}: {child.get('pool_rows_per_sec'):,} rows/s "
+            f"aggregate")
+    result = {"rung": "compaction_pool", "mesh": per_mesh}
+    r1 = (per_mesh.get("1") or {}).get("pool_rows_per_sec")
+    r8 = (per_mesh.get("8") or {}).get("pool_rows_per_sec")
+    for k in mesh_sizes:
+        v = (per_mesh.get(str(k)) or {}).get("pool_rows_per_sec")
+        if v is not None:
+            result[f"pool_rows_per_sec_m{k}"] = v
+    if r1 and r8:
+        result["pool_scaling_8_over_1"] = round(r8 / r1, 2)
+    ident = per_mesh.get("8") or {}
+    for k in ("pool_identical_to_sequential", "pool_leaked_pins",
+              "pool_leaked_leases"):
+        if k in ident:
+            result[k] = ident[k]
+    result["platform"] = "cpu"
+    result["knobs"] = {
+        "devices": "virtual 8-device CPU mesh "
+                   "(xla_force_host_platform_device_count; TPU tunnel "
+                   "down — CPU-labeled, single core)",
+        "basis": "aggregate merge+GC decision-service rows/s across "
+                 "concurrent tablet jobs, inputs pre-staged (steady-"
+                 "state write-through regime); SST I/O measured "
+                 "separately by the identity phase",
+        "mesh_1_basis": "inline single-device dispatch per job — the "
+                        "server builds no pool over a 1-device mesh",
+        "pool_job_rows": 256,
+        "mechanism_note": "on one CPU core the scaling comes from wave "
+                          "batching amortizing per-job dispatch/"
+                          "transfer/host overhead (compute serializes); "
+                          "a real TPU mesh adds per-slot device "
+                          "parallelism on top — TPU re-measure pending "
+                          "tunnel",
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_r06.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    log(f"wrote {path}")
+    print(json.dumps(result), flush=True)
+
+
 def _spawn_child(platform: str, timeout_s: float, *args, mode="--child"):
     """Run `bench.py <mode> <platform> [args...]` under a hard watchdog.
 
@@ -1803,6 +2034,12 @@ def _last_tpu_keys() -> dict:
 
 
 def main():
+    if len(sys.argv) >= 2 and sys.argv[1] == "--compaction_pool":
+        run_pool_parent()
+        return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--pool_child":
+        run_pool_child(sys.argv[2], sys.argv[3])
+        return
     if len(sys.argv) >= 3 and sys.argv[1] == "--probe":
         run_probe_child(sys.argv[2])
         return

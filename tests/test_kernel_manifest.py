@@ -213,4 +213,7 @@ def test_regenerate_byte_identical_and_device_free():
             f"regenerated manifest differs from the committed JSON in "
             f"families {diff} — run `python -m tools.analysis."
             "kernel_manifest --write`, review the surface diff, commit")
-    assert dt < 60.0, f"manifest generation took {dt:.1f}s (budget 60s)"
+    # budget raised 60 -> 90 when the dist_compact family grew its
+    # declared mesh/pool lattice (PR 15): generation sat at ~58s on the
+    # 1-core CI box before, ~63s after — still a bounded one-file check
+    assert dt < 90.0, f"manifest generation took {dt:.1f}s (budget 90s)"

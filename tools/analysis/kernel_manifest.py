@@ -278,16 +278,30 @@ FAMILIES: Dict[str, dict] = {
         },
     },
     "dist_compact": {
-        # mesh-dependent: the shard_map program cannot be abstractly
-        # evaluated without a real device mesh, so this family is
-        # fingerprint-only — its compile-key lattice (capacity quantized
-        # to powers of two, n_shards from the mesh) is declared, not
-        # enumerated, and drift is caught at the source level.
-        "budget": None,
+        # mesh families: the key-range-sharded dist step (capacity
+        # quantized to powers of two, n_shards from the mesh, both
+        # is_major variants, a donated no-retry twin) and the
+        # multi-tablet pool wave program (one job per device; buckets
+        # shared with run_merge's lattice). shard_map cannot be lowered
+        # without a real mesh, so entries are declared against the
+        # 8-device bench mesh with no lowering fingerprint (like
+        # pallas_merge) — prewarm_dist_compact warms exactly this
+        # lattice on whatever mesh the server resolves.
+        "budget": 16,
         "anchor": _DIST,
         "symbols": {
-            _DIST: ["dist_compact_fn", "distributed_compact", "_W_ROUTE",
-                    "_SAMPLES_PER_SHARD"],
+            _DIST: ["dist_compact_fn", "distributed_compact",
+                    "distributed_compact_with_outputs",
+                    "_distributed_compact_impl", "stage_sharded_cols",
+                    "_dist_gather_span", "_quantized_capacity",
+                    "_CAPACITY_MIN", "_MAX_CAPACITY_FACTOR",
+                    "pool_wave_fn", "pooled_merge_gc", "stage_pool_slot",
+                    "pool_slot_bucket", "prewarm_dist_compact",
+                    "_PREWARM_CAPACITIES", "_PREWARM_POOL_SHAPES",
+                    "_W_ROUTE", "_SAMPLES_PER_SHARD"],
+            _RUN_MERGE: ["_merge_gc_runs_impl", "_cmp_schedule",
+                         "quantize_width", "run_bucket",
+                         "packed_run_ns"],
             _MERGE_GC: ["sort_and_gc", "gc_over_sorted",
                         "route_word_mask"],
         },
@@ -387,6 +401,17 @@ def bucket_lattice_errors(bucket: Dict[str, int]) -> List[str]:
     if n_cmp is not None and int(n_cmp) not in _CMP_LATTICE:
         errs.append(f"n_cmp={n_cmp} is not on the _CMP_LATTICE "
                     f"{_CMP_LATTICE}")
+    n_shards = bucket.get("n_shards")
+    slots = bucket.get("slots")
+    capacity = bucket.get("capacity")
+    if n_shards is not None and not _is_pow2(int(n_shards)):
+        errs.append(f"n_shards={n_shards} is not a power of two")
+    if slots is not None and not _is_pow2(int(slots)):
+        errs.append(f"slots={slots} is not a power of two")
+    if capacity is not None and (not _is_pow2(int(capacity))
+                                 or int(capacity) < 64):
+        errs.append(f"capacity={capacity} is not a quantized exchange "
+                    "capacity (pow2 >= 64)")
     return errs
 
 
@@ -1205,16 +1230,59 @@ def _gen_block_encode() -> dict:
 
 
 def _gen_dist_compact() -> dict:
-    # shard_map needs a real mesh; the declared compile-key lattice is
-    # recorded instead (enforced in code: distributed_compact quantizes
-    # capacity to a power of two before keying dist_compact_fn's
-    # lru_cache), and drift is caught by the source fingerprint.
+    # shard_map needs a real mesh, so these entries are declared (no
+    # lowering fingerprint, like pallas_merge) against the 8-device
+    # bench mesh: capacity is quantized to a power of two in
+    # distributed_compact before the lru_cache key, and
+    # prewarm_dist_compact warms exactly this lattice on the server's
+    # actual mesh. Drift is caught by the source fingerprint.
+    from yugabyte_tpu.parallel import dist_compact as dist_mod
+
+    n_shards = 8
+    entries = []
+    for capacity in sorted(dist_mod._PREWARM_CAPACITIES):
+        bucket = {"capacity": capacity, "n_shards": n_shards}
+        entries.append({
+            "key": "dist_compact " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": {"capacity": capacity,
+                            "retain_deletes": False},
+            "in_avals": None,   # mesh-dependent; see compile_keys
+            "out_avals": None,
+            # the no-retry twin donates the sharded input cols so XLA
+            # reuses their HBM for the exchange scratch
+            "donation": {"donate_argnums": [0], "variants": 2},
+            "variant_axes": {"is_major": 2, "donate": 2},
+            "executables": 4,
+            "prewarmed": True,
+            "quarantine_key": [n_shards, capacity],
+            "lowering_sha256": None,
+        })
+    for (k_pad, m, w, n_cmp) in sorted(dist_mod._PREWARM_POOL_SHAPES):
+        bucket = {"k_pad": k_pad, "m": m, "n_cmp": n_cmp,
+                  "slots": n_shards, "w": w}
+        entries.append({
+            "key": "pool_wave " + entry_key(bucket),
+            "bucket": bucket,
+            "static_args": {"k_pad": k_pad, "m": m, "w": w,
+                            "n_cmp": n_cmp, "retain_deletes": False},
+            "in_avals": None,
+            "out_avals": None,
+            # wave inputs may be live cache-partition entries: the wave
+            # program never donates
+            "donation": None,
+            "variant_axes": {"is_major": 2},
+            "executables": 2,
+            "prewarmed": True,
+            "quarantine_key": [k_pad, m],
+            "lowering_sha256": None,
+        })
     return {
-        "entries": [],
+        "entries": entries,
         "compile_keys": {
             "capacity": "power-of-two >= 64 (quantized in "
                         "distributed_compact before the lru_cache key)",
-            "n_shards": "mesh-determined (8-device bench mesh)",
+            "n_shards": "mesh-determined (8-device bench mesh declared)",
             "is_major": [True, False],
             "retain_deletes": [False],
         },

@@ -66,6 +66,15 @@ fi
 echo "== yblint framework + lock-rank acyclicity + baseline gates =="
 python -m pytest tests/test_yblint.py -q
 
+echo "== 8-host-device mesh smoke lane (compaction pool differential) =="
+# mesh regressions must surface in tier-1, not only on TPU rounds: the
+# pool differential test runs on an 8-virtual-device CPU mesh and
+# asserts pooled outputs are byte-identical to sequential runs
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_compaction_pool.py::test_pool_differential_byte_identical \
+    -q -p no:cacheprovider
+
 if [ "$RUN_FULL" = 1 ]; then
     echo "== tier-1 =="
     python -m pytest tests/ -m 'not slow' -q

@@ -227,8 +227,17 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         wants_dist = _wants_distributed(
             mesh, sum(r.props.n_entries for r in all_inputs))
         if (native_engine.available() and not get_env().encrypted
-                and not force_radix and not wants_dist
+                and not force_radix
                 and not any(r.props.has_deep for r in all_inputs)):
+            if wants_dist:
+                # mesh-sized job: distributed decisions + the SAME native
+                # byte shell / streaming writer as the single-device path,
+                # so sharded outputs stay byte-identical
+                return run_compaction_job_dist_native(
+                    all_inputs, out_dir, new_file_id, history_cutoff_ht,
+                    is_major, retain_deletes, device=device,
+                    block_entries=block_entries, device_cache=device_cache,
+                    input_ids=orig_input_ids, mesh=mesh, cancel=cancel)
             return run_compaction_job_device_native(
                 all_inputs, out_dir, new_file_id, history_cutoff_ht,
                 is_major, retain_deletes, device=device,
@@ -1337,6 +1346,290 @@ def _device_codec_body(
         installer.finish()
     return CompactionResult(outputs, rows_in + dropped_rows, rows_out,
                             tombstones_written=int(np.count_nonzero(mk)))
+
+
+class _DistResidentInstaller:
+    """Write-through installer for the dist-native path: as each output
+    span's SST hits disk, the matching survivor span is gathered from the
+    SHARDED device outputs (parallel/dist_compact.DistOutputs.gather_span
+    — the merged cols never return to the host) and installed under the
+    output file id, digest-sampled like the single-device installer."""
+
+    def __init__(self, device_cache, level: int, outputs_dev):
+        self.device_cache = device_cache
+        self.level = level
+        self._outputs = outputs_dev
+        self.installed: List[int] = []
+
+    def on_span(self, fid: int, base_path: str, start: int, end: int
+                ) -> None:
+        from yugabyte_tpu.storage import integrity
+        st = self._outputs.gather_span(start, end)
+        if not integrity.maybe_verify_resident_entry(st, base_path):
+            return  # digest mismatch: the next reader re-stages from bytes
+        self.device_cache.put(fid, st, level=self.level)
+        self.installed.append(fid)
+
+    def unwind(self) -> None:
+        for fid in self.installed:
+            self.device_cache.drop(fid)
+        self.installed = []
+
+
+def run_compaction_job_dist_native(
+        inputs: Sequence[SSTReader], out_dir: str, new_file_id,
+        history_cutoff_ht: int, is_major: bool,
+        retain_deletes: bool = False, device=None,
+        block_entries: Optional[int] = None, device_cache=None,
+        input_ids: Optional[Sequence[int]] = None, mesh=None,
+        cancel=None) -> CompactionResult:
+    """The mesh production path: key-range-sharded merge+GC decisions
+    (parallel/dist_compact.py) + the native byte shell + device-resident
+    span write-through.
+
+    Stage A ingests the input bytes into the C++ shell on its own thread
+    (overlapping the pack/upload/exchange below, exactly like the
+    single-device device-native job); the distributed step returns only
+    the decision-sized arrays (keep/mk/src_idx) while the merged output
+    cols stay SHARDED on the mesh, where the resident-span installer
+    gathers each output file's survivors for the HBM cache. Outputs are
+    byte-identical to the sequential native path (same survivors, same
+    _StreamingNativeWriter split/pacing/tombstone rules).
+
+    Fault containment mirrors run_compaction_job_device_native: any
+    kernel-path fault (or shadow mismatch) unwinds cleanly — partial
+    outputs deleted, installed entries dropped — quarantines the
+    (n_shards, capacity) bucket and completes the job via the native
+    merge, byte-identically."""
+    import threading
+    import time as _time
+    from yugabyte_tpu.ops import device_faults
+    from yugabyte_tpu.ops.merge_gc import bucket_size
+    from yugabyte_tpu.parallel.dist_compact import (
+        _quantized_capacity, distributed_compact_with_outputs)
+    from yugabyte_tpu.storage import integrity, native_engine
+    from yugabyte_tpu.utils.metrics import record_pipeline_stage
+
+    all_inputs = list(inputs)
+    id_of = ({id(r): fid for r, fid in zip(all_inputs, input_ids)}
+             if input_ids is not None else None)
+    inputs, dropped = filter_expired_inputs(
+        inputs, history_cutoff_ht, is_major, retain_deletes)
+    dropped_rows = sum(r.props.n_entries for r in dropped)
+    inputs = [r for r in inputs if r.props.n_entries]
+    if not inputs:
+        return CompactionResult([], dropped_rows, 0)
+    input_ids = ([id_of[id(r)] for r in inputs]
+                 if id_of is not None else None)
+
+    n_shards = mesh.devices.size
+    bucket = (n_shards, 0)   # refined once the step picks its capacity
+    shadow = integrity.maybe_shadow_verifier(
+        inputs, history_cutoff_ht, is_major, retain_deletes)
+    params = GCParams(history_cutoff_ht, is_major, retain_deletes)
+    state = {"writer": None, "installer": None}
+    try:
+        with native_engine.NativeCompactionJob() as job:
+            ingest = {"rows_in": None, "err": None}
+
+            def _ingest_inputs():
+                t0 = _time.monotonic()
+                try:
+                    for r in inputs:
+                        if cancel is not None:
+                            cancel.check()
+                        with open(r.data_path, "rb") as f:
+                            job.add_input(f.read(), r.block_handles)
+                        _ingest_decode_counter().increment()
+                    ingest["rows_in"] = job.prepare()
+                except BaseException as e:  # noqa: BLE001  # yblint: contained(parked in ingest['err'], re-raised on the join path)
+                    ingest["err"] = e
+                finally:
+                    record_pipeline_stage(
+                        "host", (_time.monotonic() - t0) * 1e3)
+
+            ingest_thread = threading.Thread(
+                target=_ingest_inputs, name="dist-compaction-ingest",
+                daemon=True)
+            ingest_thread.start()
+            try:
+                slabs = [r.read_all() for r in inputs]
+                merged = concat_slabs([s for s in slabs if s.n])
+                bucket = (n_shards, _quantized_capacity(
+                    bucket_size(merged.n) // n_shards, n_shards, 2.0))
+                keep, mk, src_idx, outputs_dev = \
+                    distributed_compact_with_outputs(merged, params, mesh)
+                bucket = outputs_dev.bucket_key()
+            finally:
+                # the thread calls into the C++ job; it MUST finish
+                # before any unwind can free the job
+                ingest_thread.join()
+            if ingest["err"] is not None:
+                raise ingest["err"]
+            rows_in = ingest["rows_in"]
+            surv = src_idx[keep]
+            mk_surv = mk[keep]
+            device_faults.maybe_flip_survivors(surv, mk_surv)
+            if shadow is not None:
+                shadow.check_chunk(surv, mk_surv)
+            rows_out = int(surv.shape[0])
+            if shadow is not None:
+                shadow.finish(rows_out)
+            fr = _merge_frontiers([r.props.frontier for r in all_inputs],
+                                  history_cutoff_ht)
+            installer = None
+            if device_cache is not None:
+                in_levels = [device_cache.level_of(fid)
+                             for fid in (input_ids or [])
+                             if fid is not None]
+                out_level = 1 + max([lv for lv in in_levels
+                                     if lv is not None], default=0)
+                installer = _DistResidentInstaller(device_cache, out_level,
+                                                   outputs_dev)
+                state["installer"] = installer
+            writer = _StreamingNativeWriter(
+                job, out_dir, new_file_id, fr, block_entries,
+                has_deep=False, cancel=cancel,
+                on_span=installer.on_span if installer is not None
+                else None)
+            state["writer"] = writer
+            if cancel is not None:
+                cancel.check()
+            job.set_survivors(surv, mk_surv)
+            outputs, _ranges = writer.finish(job.n_survivors)
+        return CompactionResult(outputs, rows_in + dropped_rows, rows_out,
+                                tombstones_written=int(
+                                    np.count_nonzero(mk_surv)))
+    except Exception as e:  # noqa: BLE001 — device-fault containment
+        from yugabyte_tpu.ops.run_merge import DeviceFaultError
+        from yugabyte_tpu.storage import offload_policy as \
+            offload_policy_mod
+        from yugabyte_tpu.storage.integrity import (ShadowMismatch,
+                                                    shadow_mismatch_counter)
+        from yugabyte_tpu.storage.sst import data_file_name
+        from yugabyte_tpu.utils.trace import TRACE
+        w = state["writer"]
+        if w is not None:
+            for _fid, base_path, _props in w.outputs:
+                for p in (base_path, data_file_name(base_path)):
+                    try:
+                        os.remove(p)
+                    except OSError:  # yblint: contained(unwind cleanup of partial outputs; the file may not exist yet)
+                        pass
+        inst = state["installer"]
+        if inst is not None:
+            inst.unwind()
+        shadow_mm = isinstance(e, ShadowMismatch)
+        if not (shadow_mm or isinstance(e, DeviceFaultError)
+                or device_faults.is_device_fault(e)):
+            raise
+        offload_policy_mod.bucket_quarantine().quarantine(
+            bucket, reason=f"{type(e).__name__}: {e}")
+        _storage_fallback_counter().increment()
+        if shadow_mm:
+            shadow_mismatch_counter().increment()
+        TRACE("compaction: dist-native job failed (%r) — bucket "
+              "n_shards=%d capacity=%d quarantined; completing via the "
+              "native merge", e, *bucket)
+        result = _run_native_job(inputs, out_dir, new_file_id,
+                                 history_cutoff_ht, is_major,
+                                 retain_deletes, block_entries,
+                                 frontier_inputs=all_inputs,
+                                 cancel=cancel)
+        result.rows_in += dropped_rows
+        return result
+
+
+def run_compaction_job_with_decisions(
+        inputs: Sequence[SSTReader], slabs: Sequence[KVSlab], out_dir: str,
+        new_file_id, history_cutoff_ht: int, is_major: bool,
+        retain_deletes: bool, block_entries: Optional[int],
+        surv: np.ndarray, mk_surv: np.ndarray, rows_in: int,
+        frontier_inputs: Optional[Sequence[SSTReader]] = None,
+        cancel=None, on_span=None) -> CompactionResult:
+    """Write a compaction job's outputs from externally computed survivor
+    decisions — the compaction pool's wave path (the device stage ran as
+    one slot of a pooled mesh dispatch; this is stage C).
+
+    The byte path is EXACTLY the sequential writer's: the native shell +
+    _StreamingNativeWriter where the shell can run the bytes, else the
+    python gather+SSTWriter loop — so pooled outputs are byte-identical
+    to a sequential job over the same inputs.
+
+    inputs: the FILTERED reader list (whole-file-expired inputs already
+    dropped by the caller); slabs: their read_all() slabs (reused by the
+    python fallback so bytes are not read twice); surv indexes the
+    concatenation of the live slabs in input order, in merged order."""
+    from yugabyte_tpu.storage import native_engine
+    from yugabyte_tpu.utils.env import get_env
+    from yugabyte_tpu.storage.sst import data_file_name
+
+    fr = _merge_frontiers(
+        [r.props.frontier for r in (frontier_inputs or inputs)],
+        history_cutoff_ht)
+    has_deep = any(r.props.has_deep for r in inputs)
+    rows_out = int(surv.shape[0])
+    tombstones = int(np.count_nonzero(mk_surv))
+    if native_engine.available() and not get_env().encrypted \
+            and not has_deep:
+        with native_engine.NativeCompactionJob() as job:
+            for r in inputs:
+                if cancel is not None:
+                    cancel.check()
+                with open(r.data_path, "rb") as f:
+                    job.add_input(f.read(), r.block_handles)
+                _ingest_decode_counter().increment()
+            job.prepare()
+            job.set_survivors(surv, mk_surv)
+            writer = _StreamingNativeWriter(
+                job, out_dir, new_file_id, fr, block_entries,
+                has_deep=has_deep, cancel=cancel, on_span=on_span)
+            try:
+                outputs, _ranges = writer.finish(job.n_survivors)
+            except BaseException:
+                for _fid, base_path, _props in writer.outputs:
+                    for p in (base_path, data_file_name(base_path)):
+                        try:
+                            os.remove(p)
+                        except OSError:  # yblint: contained(unwind cleanup of partial outputs; the file may not exist yet)
+                            pass
+                raise
+        return CompactionResult(outputs, rows_in, rows_out,
+                                tombstones_written=tombstones)
+    # python writer (byte-identical to run_compaction_job's python path
+    # over the same decisions; the Env-aware route under encryption)
+    merged = concat_slabs([s for s in slabs if s.n])
+    limiter = compaction_rate_limiter()
+    outputs: List[Tuple[int, str, SSTProps]] = []
+    max_rows = flags.get_flag("compaction_max_output_entries_per_sst")
+    tombstone_value = Value.tombstone().encode()
+    try:
+        for start in range(0, rows_out, max_rows):
+            if cancel is not None:
+                cancel.check()
+            end = min(start + max_rows, rows_out)
+            sel = surv[start:end]
+            out_slab = _gather_slab(merged, sel, mk_surv[start:end],
+                                    tombstone_value)
+            fid = new_file_id()
+            base_path = os.path.join(out_dir, f"{fid:06d}.sst")
+            props = SSTWriter(base_path, block_entries=block_entries,
+                              fit_lindex=False).write(out_slab, fr)
+            outputs.append((fid, base_path, props))
+            if on_span is not None:
+                on_span(fid, base_path, start, end)
+            if limiter is not None and end < rows_out:
+                limiter.acquire(props.data_size + props.base_size)
+    except BaseException:
+        for _fid, base_path, _props in outputs:
+            for p in (base_path, data_file_name(base_path)):
+                try:
+                    os.remove(p)
+                except OSError:  # yblint: contained(unwind cleanup of partial outputs; the file may not exist yet)
+                    pass
+        raise
+    return CompactionResult(outputs, rows_in, rows_out,
+                            tombstones_written=tombstones)
 
 
 def _gather_slab(slab: KVSlab, sel: np.ndarray, make_tomb: np.ndarray,

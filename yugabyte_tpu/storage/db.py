@@ -141,6 +141,11 @@ class DBOptions:
     # subcompactions across it (parallel/dist_compact.py); None = single
     # device (ref: subcompaction threads, compaction_job.cc:456-468)
     mesh: object = None
+    # tserver/compaction_pool.CompactionPool: when set, device-routed
+    # compactions are scheduled through the mesh-sharded multi-tablet
+    # pool (batch-slot waves / whole-mesh dist jobs) instead of running
+    # the device stage inline on this DB's compaction thread
+    mesh_pool: object = None
     # measured device-vs-native router (storage/offload_policy.py)
     offload_policy: object = None
     # HBM-resident slab cache (storage/device_cache.py); shared across
@@ -1348,16 +1353,7 @@ class DB:
         try:
             inputs = [self._readers[fm.file_id] for fm in pick.inputs]
             cutoff = self.opts.retention_policy()
-            result = compaction_mod.run_compaction_job(
-                inputs, self.db_dir, self.versions.new_file_id, cutoff,
-                pick.is_major, device=self.opts.device,
-                block_entries=self.opts.block_entries,
-                device_cache=self._device_cache,
-                input_ids=[fm.file_id for fm in pick.inputs],
-                mesh=self.opts.mesh,
-                offload_policy=self.opts.offload_policy,
-                run_cache=self._run_cache,
-                cancel=self._cancel)
+            result = self._dispatch_compaction(pick, inputs, cutoff)
             from yugabyte_tpu.utils import sync_point
             sync_point.hit("db.compaction:before_install")
             with self._lock:
@@ -1407,6 +1403,42 @@ class DB:
         # cascade if still over trigger
         if self.opts.auto_compact:
             self.maybe_schedule_compaction()
+
+    def _dispatch_compaction(self, pick, inputs, cutoff):
+        """Route one picked compaction: through the mesh-sharded
+        multi-tablet pool when this server has one AND the job would take
+        the device path anyway (the same measured offload decision the
+        inline path makes — the pool is a scheduling win, never a routing
+        override), else the inline run_compaction_job."""
+        pool = self.opts.mesh_pool
+        if pool is not None and self.opts.device not in (None, "native"):
+            est = sum(r.props.n_entries for r in inputs)
+            has_deep = any(r.props.has_deep for r in inputs)
+            pol = self.opts.offload_policy
+            cached = bool(self._device_cache is not None and all(
+                self._device_cache.contains(fm.file_id)
+                for fm in pick.inputs))
+            if not has_deep and (pol is None
+                                 or pol.use_device(est, cached)):
+                handle = pool.submit_compaction(
+                    self.db_dir, inputs=inputs, out_dir=self.db_dir,
+                    new_file_id=self.versions.new_file_id,
+                    history_cutoff_ht=cutoff, is_major=pick.is_major,
+                    block_entries=self.opts.block_entries,
+                    input_ids=[fm.file_id for fm in pick.inputs],
+                    device_cache=self._device_cache, est_rows=est,
+                    cancel=self._cancel)
+                return handle.result()
+        return compaction_mod.run_compaction_job(
+            inputs, self.db_dir, self.versions.new_file_id, cutoff,
+            pick.is_major, device=self.opts.device,
+            block_entries=self.opts.block_entries,
+            device_cache=self._device_cache,
+            input_ids=[fm.file_id for fm in pick.inputs],
+            mesh=self.opts.mesh,
+            offload_policy=self.opts.offload_policy,
+            run_cache=self._run_cache,
+            cancel=self._cancel)
 
     def compact_all(self) -> None:
         """Force a full (major) compaction of all live files."""

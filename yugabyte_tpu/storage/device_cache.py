@@ -230,8 +230,9 @@ class DeviceSlabCache:
 
     def stage(self, key: CacheKey, slab: KVSlab,
               level: int = 0, for_read: bool = False,
-              include_vals: bool = False) -> StagedCols:
-        staged = stage_slab(slab, self.device)
+              include_vals: bool = False, device=None) -> StagedCols:
+        staged = stage_slab(slab, device if device is not None
+                            else self.device)
         if include_vals:
             # pushdown-scan write-through: the value words ride along so
             # the NEXT filtered/aggregating scan is fully resident
@@ -264,7 +265,21 @@ class DeviceSlabCache:
                 lv["bytes"] += ent.staged.nbytes
                 if ent.pins > 0:
                     lv["pinned"] += 1
-            return {
+            shards: Dict[str, dict] = {}
+            for key, ent in self._map.items():
+                # direct-keyed caches (tests) use bare ids, not
+                # (namespace, file_id) tuples — they have no shard view
+                ns = key[0] if isinstance(key, tuple) and key else None
+                if not isinstance(ns, str) or "/shard" not in ns:
+                    continue
+                sh = shards.setdefault(
+                    "shard" + ns.rsplit("/shard", 1)[1],
+                    {"entries": 0, "bytes": 0, "pinned": 0})
+                sh["entries"] += 1
+                sh["bytes"] += ent.staged.nbytes
+                if ent.pins > 0:
+                    sh["pinned"] += 1
+            out = {
                 "capacity_bytes": self.capacity,
                 "used_bytes": self._used,
                 "entries": len(self._map),
@@ -274,6 +289,11 @@ class DeviceSlabCache:
                 "evictions": self.evictions,
                 "levels": {f"L{k}": v for k, v in sorted(levels.items())},
             }
+            if shards:
+                # per-mesh-shard residency (the compaction pool's
+                # partitioned namespaces — storage survives sharding)
+                out["shards"] = dict(sorted(shards.items()))
+            return out
 
 
 class NamespacedSlabCache:
@@ -336,6 +356,35 @@ class NamespacedSlabCache:
                        ) -> StagedCols:
         return self._shared.stage_from_raw((self.namespace, file_id), rfb,
                                            level=level)
+
+
+class ShardPartition(NamespacedSlabCache):
+    """Per-mesh-shard partition of the shared cache: keys carry the shard
+    in the namespace (``<ns>/shard<i>``) and staging commits to that
+    shard's DEVICE — so a pooled tablet's resident L0->L1->L2 chain lives
+    in the HBM of the mesh slot that compacts it (the compaction pool
+    gives each tablet a sticky home shard for exactly this affinity).
+    Pins, eviction, levels and metrics are the shared cache's; only key
+    spelling and device placement change."""
+
+    def __init__(self, shared: DeviceSlabCache, namespace: str,
+                 shard: int, device=None):
+        super().__init__(shared, f"{namespace}/shard{shard}")
+        self.shard = shard
+        self._device = device
+
+    @property
+    def device(self):
+        return self._device if self._device is not None \
+            else self._shared.device
+
+    def stage(self, file_id: int, slab: KVSlab,
+              level: int = 0, for_read: bool = False,
+              include_vals: bool = False) -> StagedCols:
+        return self._shared.stage((self.namespace, file_id), slab,
+                                  level=level, for_read=for_read,
+                                  include_vals=include_vals,
+                                  device=self._device)
 
 
 class HostStagingPool:

@@ -134,6 +134,9 @@ class TabletOptions:
     offload_policy: object = None   # measured device-vs-native router
     device_cache: object = None
     compaction_pool: object = None
+    # tserver/compaction_pool.CompactionPool: the mesh-sharded multi-
+    # tablet scheduler; device-routed compactions ride its batch slots
+    mesh_pool: object = None
     # shared decoded-block cache (ref: db/table_cache.cc — one per server)
     block_cache: object = None
     auto_compact: bool = True
@@ -164,6 +167,7 @@ class Tablet:
             offload_policy=self.opts.offload_policy,
             device_cache=self.opts.device_cache,
             compaction_pool=self.opts.compaction_pool,
+            mesh_pool=self.opts.mesh_pool,
             block_cache=self.opts.block_cache,
             retention_policy=self.retention_policy.history_cutoff,
             memstore_size_bytes=self.opts.memstore_size_bytes,

@@ -156,9 +156,10 @@ class PrewarmKernelsOp(MaintenanceOp):
 
     PREWARM_SCORE = 1e8
 
-    def __init__(self, shapes=None, enabled_fn=None):
+    def __init__(self, shapes=None, enabled_fn=None, mesh=None):
         super().__init__("prewarm_kernels")
         self._shapes = shapes
+        self._mesh = mesh
         self._enabled_fn = enabled_fn or (
             lambda: bool(flags.get_flag("compaction_prewarm_kernels")))
         self.done = False
@@ -186,6 +187,15 @@ class PrewarmKernelsOp(MaintenanceOp):
             # device block codec (stage A decode / stage C encode): the
             # first cold compaction chain must not stall on its compile
             n += block_codec.prewarm_block_codec()
+            if self._mesh is not None \
+                    and getattr(self._mesh, "devices", None) is not None \
+                    and self._mesh.devices.size > 1:
+                # mesh families: the key-range-sharded dist step and the
+                # multi-tablet pool wave program — a pooled tablet's
+                # first wave must load a cached executable too
+                from yugabyte_tpu.parallel.dist_compact import (
+                    prewarm_dist_compact)
+                n += prewarm_dist_compact(self._mesh)
         # expose the declared compile surface (committed kernel
         # manifest) next to the bucket hit/miss counters: the warm cache
         # must cover exactly this many executables

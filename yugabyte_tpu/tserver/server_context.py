@@ -43,6 +43,11 @@ flags.define_flag("device_init_timeout_s", 30,
 flags.define_flag("block_cache_bytes", 256 << 20,
                   "host RAM budget for the shared decoded-block cache "
                   "(ref block cache sizing, docdb_rocksdb_util.cc)")
+flags.define_flag("tserver_mesh_compaction_pool", 1,
+                  "schedule device-routed compactions through the "
+                  "mesh-sharded multi-tablet pool "
+                  "(tserver/compaction_pool.py) when a >1-device mesh "
+                  "is visible; 0 = inline per-tablet device dispatch")
 
 
 def resolve_device(mode: str, timeout_s: float):
@@ -108,6 +113,15 @@ class ServerExecutionContext:
             # capacity rides --device_cache_capacity_bytes (defined by
             # storage/device_cache.py, the flag's single owner)
             self.device_cache = DeviceSlabCache(self.device)
+        # mesh-sharded multi-tablet compaction pool (ROADMAP item 3):
+        # device-routed compactions from every hosted tablet share the
+        # mesh through batch-slot waves / whole-mesh dist jobs
+        self.compaction_pool = None
+        if self.mesh is not None \
+                and flags.get_flag("tserver_mesh_compaction_pool"):
+            from yugabyte_tpu.tserver.compaction_pool import CompactionPool
+            self.compaction_pool = CompactionPool(self.mesh,
+                                                  device=self.device)
         self.block_cache = BlockCache(flags.get_flag("block_cache_bytes"))
         from yugabyte_tpu.storage.offload_policy import OffloadPolicy
         self.offload_policy = OffloadPolicy.load(
@@ -134,7 +148,7 @@ class ServerExecutionContext:
             return None
         from yugabyte_tpu.tserver.maintenance_manager import (
             PrewarmKernelsOp)
-        return PrewarmKernelsOp()
+        return PrewarmKernelsOp(mesh=self.mesh)
 
     def tablet_options(self) -> TabletOptions:
         return TabletOptions(device=self.device,
@@ -142,6 +156,7 @@ class ServerExecutionContext:
                              offload_policy=self.offload_policy,
                              device_cache=self.device_cache,
                              compaction_pool=self.pool,
+                             mesh_pool=self.compaction_pool,
                              block_cache=self.block_cache)
 
     def refresh_metrics(self) -> None:
@@ -151,4 +166,6 @@ class ServerExecutionContext:
         self._g_active.set(self.pool.active_count())
 
     def shutdown(self) -> None:
+        if self.compaction_pool is not None:
+            self.compaction_pool.shutdown()
         self.pool.shutdown(wait=False)

@@ -236,6 +236,12 @@ class TabletServer:
         ctx = self.exec_context
         if ctx is not None and ctx.device_cache is not None:
             out["device_cache"] = ctx.device_cache.snapshot()
+        # mesh-sharded compaction pool: queue depth, per-tablet
+        # queued/running, packed-slot occupancy and the measured
+        # per-bucket aggregate rates the scheduler routes by
+        if ctx is not None and getattr(ctx, "compaction_pool", None) \
+                is not None:
+            out["pool"] = ctx.compaction_pool.snapshot()
         return out
 
     def servez(self) -> dict:
