@@ -60,6 +60,19 @@ def _shadow_mismatches_for_report():
     return shadow_snapshot()["mismatches"]
 
 
+def _bucket_health_for_report():
+    """Transition counters + per-state bucket counts from the live
+    bucket-health board — reported next to compile_bucket_* so a run
+    shows whether any shape bucket demoted/quarantined mid-bench (a
+    demotion silently shifts rows to the native path, which would
+    otherwise read as an unexplained device-rate regression)."""
+    from yugabyte_tpu.storage.bucket_health import health_board
+    snap = health_board().snapshot()
+    return ({f"bucket_health_{k}": v
+             for k, v in snap.get("counters", {}).items()},
+            dict(snap.get("states", {})))
+
+
 def log(msg):
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
@@ -953,6 +966,7 @@ def run_device_child(platform: str, workload_path: str,
             # its cost + coverage next to the stage timings
             from yugabyte_tpu.storage.integrity import shadow_snapshot
             shadow = shadow_snapshot()
+            bh_counters, bh_states = _bucket_health_for_report()
             log(f"  pipeline stages over steady jobs: "
                 f"host {stage_ms.get('host', 0):.0f}ms / device "
                 f"{stage_ms.get('device', 0):.0f}ms / write "
@@ -962,7 +976,11 @@ def run_device_child(platform: str, workload_path: str,
                 f"(manifest surface: {surface_total} executables); "
                 f"shadow verify sample={shadow['sample']} "
                 f"jobs={shadow['jobs_verified']} "
-                f"mismatches={shadow['mismatches']}")
+                f"mismatches={shadow['mismatches']}; bucket health "
+                f"states={bh_states or 'none'} "
+                f"demotions={bh_counters.get('bucket_health_demotions', 0)} "
+                f"promotions="
+                f"{bh_counters.get('bucket_health_promotions', 0)}")
             stages.put(stage="e2e_steady", e2e_steady=e2e_steady,
                        e2e_steady2=e2e_steady2,
                        e2e_rows=e2e_rows, e2e_n=e2e_n,
@@ -977,7 +995,9 @@ def run_device_child(platform: str, workload_path: str,
                        compile_surface_buckets=surface_total,
                        shadow_verify_sample=shadow["sample"],
                        shadow_verify_jobs=shadow["jobs_verified"],
-                       shadow_verify_mismatches=shadow["mismatches"])
+                       shadow_verify_mismatches=shadow["mismatches"],
+                       bucket_health_states=bh_states,
+                       **bh_counters)
             # chained L0->L1->L2: two L0->L1 jobs' outputs stay resident
             # (per-span write-through) and feed an L1->L2 job whose
             # inputs never leave HBM — the ROADMAP item-1 configuration
@@ -1037,6 +1057,7 @@ def run_device_child(platform: str, workload_path: str,
     stages.put(stage="scan", scan_s=scan_s, scan_n=scan_n)
 
     headline = max(e2e_steady2, e2e_steady) or n_total / res_s
+    bh_counters, bh_states = _bucket_health_for_report()
     print(json.dumps({
         "metric": "l0_compaction_merge_gc_rows_per_sec",
         "value": round(headline, 1),
@@ -1099,6 +1120,11 @@ def run_device_child(platform: str, workload_path: str,
         # per-family declared compile-surface counts (committed kernel
         # manifest; also exported as kernel_compile_surface gauges)
         "compile_surface_buckets": _surface_counts_for_report(),
+        # live routing-authority telemetry (storage/bucket_health.py):
+        # lifetime transition counters + the end-of-run state histogram
+        # — a mid-bench demotion explains a device-rate dip honestly
+        **bh_counters,
+        "bucket_health_states": bh_states,
         "e2e_n_rows": e2e_n,
         "n_rows": n_total,
     }), flush=True)
@@ -1192,6 +1218,17 @@ def run_pool_child(platform: str, mesh_n_str: str) -> None:
 
     if mesh_n == len(jax.devices()):
         out.update(_pool_identity_phase(cutoff))
+    # routing-authority events over this rung: a wave-fault demotion or
+    # a probe re-promotion mid-ladder changes what the rows/s above
+    # actually measured (devices vs the native completion path)
+    bh_counters, bh_states = _bucket_health_for_report()
+    out["pool_bucket_demotions"] = \
+        bh_counters.get("bucket_health_demotions", 0)
+    out["pool_bucket_repromotions"] = \
+        bh_counters.get("bucket_health_promotions", 0)
+    out["pool_bucket_quarantines"] = \
+        bh_counters.get("bucket_health_quarantines", 0)
+    out["pool_bucket_states"] = bh_states
     print(json.dumps(out), flush=True)
 
 
@@ -1304,7 +1341,9 @@ def run_pool_parent() -> None:
         result["pool_scaling_8_over_1"] = round(r8 / r1, 2)
     ident = per_mesh.get("8") or {}
     for k in ("pool_identical_to_sequential", "pool_leaked_pins",
-              "pool_leaked_leases"):
+              "pool_leaked_leases", "pool_bucket_demotions",
+              "pool_bucket_repromotions", "pool_bucket_quarantines",
+              "pool_bucket_states"):
         if k in ident:
             result[k] = ident[k]
     result["platform"] = "cpu"
@@ -1912,7 +1951,11 @@ def _partial_from_stages(stages_path: str, n_total: int, cpu_rate: float):
                   "compile_bucket_hits",
                   "compile_bucket_misses", "compile_surface_buckets",
                   "shadow_verify_sample", "shadow_verify_jobs",
-                  "shadow_verify_mismatches"):
+                  "shadow_verify_mismatches", "bucket_health_states",
+                  "bucket_health_promotions", "bucket_health_demotions",
+                  "bucket_health_quarantines", "bucket_health_probes",
+                  "bucket_health_probe_failures",
+                  "bucket_health_mismatch"):
             if k in recs["e2e_steady"]:
                 out[k] = recs["e2e_steady"][k]
         out["value"] = max(out["e2e_steady_rows_per_sec"],
@@ -2179,26 +2222,9 @@ def main():
         result["e2e_native_rows_per_sec"] = round(native_rate, 1)
         result["e2e_native_runs"] = rung.native_runs if rung else []
         steady = result.get("e2e_steady_rows_per_sec") or 0
-        # calibration for the server's offload policy: the measured
-        # device-vs-native crossover gates production auto-offload
-        # (storage/offload_policy.py; VERDICT r3 #2)
-        try:
-            from yugabyte_tpu.storage.offload_policy import (
-                DEFAULT_CALIBRATION_FILE, OffloadPolicy)
-            cal = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               DEFAULT_CALIBRATION_FILE)
-            n_cal = int(result.get("e2e_n_rows") or result.get("n_rows")
-                        or n_top)
-            plat = result.get("platform", "")
-            if steady:
-                OffloadPolicy.append_calibration(
-                    cal, n_cal, True, steady, native_rate, plat)
-            cold = result.get("e2e_cold_rows_per_sec") or 0
-            if cold:
-                OffloadPolicy.append_calibration(
-                    cal, n_cal, False, cold, native_rate, plat)
-        except Exception as e:  # noqa: BLE001 — calibration is best-effort
-            log(f"calibration write failed: {e}")
+        # (the static offload-calibration artifact is gone: production
+        # device-vs-native routing is the live bucket-health board's
+        # measured EWMA rate race — storage/bucket_health.py, PR 16)
         if steady:
             result["e2e_vs_native"] = round(steady / native_rate, 3)
             # the headline comparison: OUR full job vs the stock-CPU-

@@ -31,6 +31,22 @@ except AttributeError:
     pass  # pre-0.4.34 jax: the XLA_FLAGS fallback above applies
 
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_bucket_health_board():
+    """The bucket-health board is process-global by design (one routing
+    memory per server). Between TESTS that memory is leakage: a cluster
+    test that organically demotes a merge bucket (CPU device paths
+    measure slower than native) would silently park the next test's
+    device dispatches. Every test starts with a cold board."""
+    from yugabyte_tpu.storage.bucket_health import health_board
+    health_board().reset()
+    yield
+    health_board().reset()
+
+
 def pytest_collection_modifyitems(config, items):
     """Run the sync-point interleaving schedules FIRST: they pin exact
     thread timings, and by the end of a full-suite run hundreds of

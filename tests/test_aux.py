@@ -144,8 +144,10 @@ def test_delete_snapshot(cluster, table):
     master.catalog.delete_snapshot(sid)
     assert not any(s["snapshot_id"] == sid
                    for s in master.catalog.list_snapshots())
-    # tserver-side deletion propagates asynchronously: poll, don't race
-    deadline = time.monotonic() + 20
+    # tserver-side deletion propagates asynchronously: poll, don't race.
+    # Generous deadline: under a full-suite run on a 1-core box the
+    # heartbeat that carries the deletion can be starved well past 20s.
+    deadline = time.monotonic() + 60
 
     def _gone():
         return all(sid not in ts.tablet_manager.get_tablet(tid)

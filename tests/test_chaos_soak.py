@@ -2,7 +2,7 @@
 PR 1's disk-fault harness.
 
 A write workload runs against an RF3 MiniCluster while the nemesis
-drives four consecutive fault cycles:
+drives five consecutive fault cycles:
 
   1. tserver crash-stop mid-load + restart (WAL replay / catch-up),
   2. raft leader partition (a new leader must emerge in the connected
@@ -13,7 +13,12 @@ drives four consecutive fault cycles:
      shape-bucket quarantine underneath),
   4. at-rest corruption nemesis: bit-flips in a follower's written SST
      bytes, detected by one scrub cycle -> replica FAILED (corrupt) ->
-     master rebuilds it in place from a healthy peer.
+     master rebuilds it in place from a healthy peer,
+  5. slow-bucket nemesis: the 'slow' fault kind throttles the device
+     dispatch path (latency only, no exception) with measured routing
+     live — the bucket-health board must demote the slowed merge
+     buckets, park their jobs on the native path, and re-promote them
+     via a winning sampled probe once the slowness clears.
 
 Invariants asserted after the cycles heal:
   - every ACKNOWLEDGED write is readable with its last-acked value,
@@ -42,6 +47,7 @@ from yugabyte_tpu.integration.mini_cluster import (MiniCluster,
                                                    MiniClusterOptions)
 from yugabyte_tpu.ops import device_faults
 from yugabyte_tpu.storage import native_engine, offload_policy
+from yugabyte_tpu.storage.bucket_health import health_board
 from yugabyte_tpu.storage.device_cache import host_staging_pool
 from yugabyte_tpu.utils import env as env_mod
 from yugabyte_tpu.utils import flags
@@ -104,7 +110,7 @@ def test_chaos_soak_three_nemesis_cycles(tmp_path):
     hold = float(os.environ.get("YBTPU_SOAK_SECONDS", 3))
     old_flags = {f: flags.get_flag(f) for f in
                  ("replication_factor", "memstore_size_bytes",
-                  "device_offload_mode")}
+                  "device_offload_mode", "bucket_health_probe_interval_s")}
     flags.set_flag("replication_factor", 3)
     flags.set_flag("memstore_size_bytes", 16384)  # force flush/compaction
     flags.set_flag("device_offload_mode", "device")  # kernel path live
@@ -209,6 +215,104 @@ def test_chaos_soak_three_nemesis_cycles(tmp_path):
             time.sleep(0.2)
         nem.wait_all_healthy(table.table_id, timeout_s=120)
         nem.check_terms_monotonic(terms, nem.capture_terms())
+
+        # ---- cycle 5: slow-bucket nemesis ---------------------------
+        # Flip to MEASURED routing (the forced-device mode above was
+        # cycle 3's kernel-path coverage) and throttle the device
+        # dispatch with latency only: the health board must demote the
+        # slowed merge buckets on the rate crossover, complete their
+        # parked jobs natively (observable: record_native fires on the
+        # degraded keys), then re-promote via a winning probe once the
+        # slowness clears. Byte correctness of the parked completions
+        # rides the verification below — acked reads plus the
+        # cross-replica digest agreement cover every SST written here.
+        board = health_board()
+        flags.set_flag("device_offload_mode", "auto")
+        # cycles 1-4 may have parked merge buckets behind a 300s fault
+        # quarantine — that memory is THEIR proof, not this cycle's
+        # subject: wipe the board so measured routing restarts live
+        offload_policy.bucket_quarantine().clear()
+
+        def _merge_keys(snap):
+            return [k for k in snap["keys"]
+                    if k["family"] == "run_merge_fused"]
+
+        def _degraded(snap):
+            return [k for k in snap["keys"]
+                    if k["family"] == "run_merge_fused"
+                    and k["state"] == "degraded"]
+
+        deadline = time.monotonic() + 90
+        while not _merge_keys(board.snapshot()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+        snap = board.snapshot()
+        assert _merge_keys(snap), \
+            "soak produced no merge-bucket traffic to throttle"
+        # Seed each observed bucket barely-HEALTHY: native EWMA at its
+        # live value (or a high floor), device just above it. The next
+        # throttled completion folds ~0.7x into the device EWMA and
+        # crosses below native — so demotion fires on a REAL measured
+        # device completion, not on synthetic numbers.
+        warm = int(flags.get_flag("bucket_health_warmup_obs"))
+        for k in _merge_keys(snap):
+            b = tuple(k["bucket"])
+            rate = float(k["native_rows_per_sec"])
+            if rate <= 0:
+                board.record_native("run_merge_fused", b, 10**6, 1.0)
+                rate = 1e6
+            for _ in range(warm):
+                board.record_device("run_merge_fused", b,
+                                    int(rate * 1.05) + 1, 1.0)
+        snap = board.snapshot()
+        base = {tuple(k["bucket"]): k["native_obs"]
+                for k in _merge_keys(snap)}
+        demo0 = snap["counters"]["demotions"]
+        promo0 = snap["counters"]["promotions"]
+        device_faults.arm("slow", "dispatch", count=10**6, delay_s=0.05)
+        deadline = time.monotonic() + 120
+        while board.snapshot()["counters"]["demotions"] == demo0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+        snap = board.snapshot()
+        assert snap["counters"]["demotions"] > demo0, \
+            "slow nemesis did not demote any merge bucket"
+        assert _degraded(snap)
+        # parked jobs complete NATIVELY and the board measures them:
+        # native_obs on a degraded bucket growing past its seed proves
+        # a real native completion (no faults armed, no other recorder)
+        deadline = time.monotonic() + 120
+        parked = False
+        while not parked and time.monotonic() < deadline:
+            snap = board.snapshot()
+            parked = any(k["native_obs"] > base[tuple(k["bucket"])]
+                         for k in _degraded(snap)
+                         if tuple(k["bucket"]) in base)
+            if not parked:
+                time.sleep(0.2)
+        assert parked, \
+            "no parked native completion observed on a degraded bucket"
+
+        # the device recovers: clear the slowness, drag the seeded
+        # native EWMAs back down, and let a sampled probe win (the
+        # promotion event only fires from a REAL job's device result)
+        device_faults.disarm_all()
+        flags.set_flag("bucket_health_probe_interval_s", 0.0)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            snap = board.snapshot()
+            if snap["counters"]["promotions"] > promo0:
+                break
+            for k in _degraded(snap):
+                board.record_native("run_merge_fused", tuple(k["bucket"]),
+                                    1, 1000.0)
+            time.sleep(0.05)
+        snap = board.snapshot()
+        assert snap["counters"]["promotions"] > promo0, \
+            "cleared bucket did not re-promote via a winning probe: " \
+            f"counters={snap['counters']} states={snap['states']} " \
+            f"merge_keys={_merge_keys(snap)!r}"
+        nem.wait_all_healthy(table.table_id, timeout_s=90)
 
         # ---- verification -------------------------------------------
         acked = workload.stop()

@@ -273,21 +273,30 @@ def test_pool_wave_fault_quarantines_without_collateral(tmp_path, pool):
 
 
 def test_pool_bucket_demotion_routes_native(pool):
-    """RESYSTANCE-style measured routing: once the measured device rate
-    of a bucket falls under its native rate, later jobs of that bucket
-    run natively (and the snapshot says so)."""
+    """RESYSTANCE-style measured routing through the health board: once
+    the measured device rate of a bucket falls under its native rate,
+    later jobs of that bucket run natively (and the snapshot says so)."""
+    from yugabyte_tpu.storage.bucket_health import health_board
+    board = health_board()
+    board.reset()
     jobs = _merge_jobs(2, n=8000)
     h = pool.submit("warm", PoolRequest(
         inputs=[], out_dir="", new_file_id=None,
         history_cutoff_ht=CUTOFF, is_major=True, slabs=jobs[0]))
     h.result(timeout=300)
-    st_bucket = None
-    with pool._lock:
-        assert pool._rates, "wave must record a device rate"
-        st_bucket = next(iter(pool._rates))
-        # force the demotion crossover: native measured faster
-        pool._rates[st_bucket]["device"] = 1.0
-        pool._rates[st_bucket]["native"] = 1e9
+    snap_keys = [tuple(rec["bucket"])
+                 for rec in board.snapshot()["keys"]
+                 if rec["family"] == "run_merge_fused"
+                 and rec["device_obs"] > 0]
+    assert snap_keys, "wave must record a device rate on the board"
+    bucket = snap_keys[0]
+    # force the demotion crossover with board observations: native
+    # measured far faster, then enough slow device results to clear the
+    # warmup guard (one cold-compile sample must not demote alone)
+    board.record_native("run_merge_fused", bucket, 10**9, 1.0)
+    for _ in range(int(flags.get_flag("bucket_health_warmup_obs"))):
+        board.record_device("run_merge_fused", bucket, 1, 1.0)
+    assert board.state("run_merge_fused", bucket) == "degraded"
     before = pool.snapshot()["native_completions"]
     h2 = pool.submit("warm", PoolRequest(
         inputs=[], out_dir="", new_file_id=None,
@@ -295,10 +304,11 @@ def test_pool_bucket_demotion_routes_native(pool):
     surv, mk_surv = h2.result(timeout=300)
     assert pool.snapshot()["native_completions"] == before + 1
     assert pool.snapshot()["bucket_rates"][
-        f"k{st_bucket[0]}_m{st_bucket[1]}_w{st_bucket[2]}"]["demoted"]
+        f"k{bucket[0]}_m{bucket[1]}"]["demoted"]
     # native completion computes identical decisions
     from yugabyte_tpu.ops import run_merge
     perm, keep, mk = run_merge.merge_and_gc_runs(
         jobs[1], GCParams(CUTOFF, True))
     assert np.array_equal(surv, perm[keep])
     assert np.array_equal(mk_surv, mk[keep])
+    board.reset()

@@ -347,7 +347,15 @@ def test_endpoint_smoke_and_compactionz(tmp_path):
             ts.tablet_manager.get_tablet(tid).tablet.compact()
 
         addr = ts.webserver.address
-        assert _get(addr, "/healthz").decode().strip() == "ok"
+        # tserver /healthz: liveness status + the bucket-health board
+        hz = json.loads(_get(addr, "/healthz"))
+        assert hz["status"] == "ok"
+        bh = hz["bucket_health"]
+        assert set(bh["states"]) == {"cold", "warming", "healthy",
+                                     "degraded", "quarantined",
+                                     "probation"}
+        assert isinstance(bh["keys"], list)
+        assert isinstance(bh["quarantine"], list)
         for path in ("/metrics", "/rpcz", "/tracez", "/threadz",
                      "/compactionz", "/integrityz"):
             payload = json.loads(_get(addr, path))

@@ -76,7 +76,12 @@ _SEED_NAME_RE = re.compile(
     # PR 13 query pushdown: a swallowed error in the fused-scan fallback
     # machinery would silently serve WRONG RESULTS instead of routing
     # the query back to the byte-identical host path
-    r"|pushdown|scan_spec|scan_filtered|scan_aggregate",
+    r"|pushdown|scan_spec|scan_filtered|scan_aggregate"
+    # PR 16 bucket health: a swallowed error in the routing state
+    # machine silently freezes a bucket in the wrong state — a parked
+    # bucket never re-promotes (perf rots) or a failing one never
+    # demotes (faults keep burning retries)
+    r"|health|probe|promote|demote",
     re.IGNORECASE)
 _WAL_MODULE_SUFFIX = ".consensus.log"
 _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
@@ -93,7 +98,12 @@ _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
                          # PR 13: the pushdown compile-subset classifier
                          # — a swallowed classification error turns
                          # "fall back host-side" into a wrong answer
-                         ".docdb.scan_spec")
+                         ".docdb.scan_spec",
+                         # PR 16: the bucket-health board — every device
+                         # dispatch site routes through it, so a
+                         # swallowed error here mis-routes ALL kernel
+                         # families at once
+                         ".storage.bucket_health")
 _MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
 _DEF_MARKER = "# yblint: durability-path"
 _ROUTING_NAMES = ("TRACE", "trace")

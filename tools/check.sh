@@ -40,6 +40,19 @@ done
 echo "== yblint (all passes) =="
 python -m tools.analysis "${YBLINT_ARGS[@]+"${YBLINT_ARGS[@]}"}"
 
+echo "== no offload_calibration references (PR 16 deleted the file) =="
+# the static calibration loader is gone — the bucket-health board
+# (storage/bucket_health.py) is the only device-vs-native authority;
+# any source reference means a dispatch site regressed to the dead API
+if grep -rn --include='*.py' --include='*.sh' --include='*.md' \
+        -l 'offload_calibration' \
+        yugabyte_tpu/ tools/ tests/ bench.py README.md 2>/dev/null \
+        | grep -v '^tools/check.sh$'; then
+    echo "check.sh: FAIL — offload_calibration is deleted; route through" \
+         "the bucket-health board (storage/bucket_health.py)" >&2
+    exit 1
+fi
+
 echo "== kernel-manifest drift check (committed JSON) =="
 python -m tools.analysis.kernel_manifest --check
 

@@ -234,17 +234,9 @@ def _probe(args):
                 state[f"{tag}_network_vs_native"] = round(
                     state[f"{tag}_network_rows_per_sec"] / nat, 3)
             save()
-            # feed the offload policy a same-platform record (VERDICT r4
-            # next-round #4: the TPU probe appends TPU calibration): the
-            # device rate is whichever merge impl measured faster, the
-            # native rate the single-core in-memory C++ merge+GC
-            if nat > 0 and platform == "tpu":
-                from yugabyte_tpu.storage.offload_policy import OffloadPolicy
-                dev_rate = max(state[f"{tag}_pallas_rows_per_sec"],
-                               state[f"{tag}_network_rows_per_sec"])
-                OffloadPolicy.append_calibration(
-                    OffloadPolicy.default_path(), n, True,
-                    dev_rate, nat, platform)
+            # (no calibration append: production routing learns its own
+            # device-vs-native rates live on the bucket-health board —
+            # storage/bucket_health.py — so the probe only reports)
         except Exception as e:  # noqa: BLE001
             import traceback
             state[f"{tag}_error"] = repr(e)[:500]

@@ -290,6 +290,13 @@ def decode_file_to_staged(rfb: RawFileBlocks, device=None) -> StagedCols:
         raise BlockCodecUnsupported("empty file has nothing to stage")
     t0 = _time.monotonic()
     n_pad = bucket_size(n)
+    from yugabyte_tpu.storage.bucket_health import health_board
+    _board = health_board()
+    if not _board.allow_device("block_decode", (1, n_pad)):
+        # parked bucket (recent fault / sticky mismatch): the caller's
+        # BlockCodecUnsupported handling takes the native byte shell
+        raise BlockCodecUnsupported("decode bucket parked by the "
+                                    "health board")
     w_pad = _quantize_width(rfb.w)
     # Per-block CONTIGUOUS region slices laid straight into ONE buffer
     # in the cols layout.  All memcpy-class (vectorized widening of the
@@ -354,14 +361,25 @@ def decode_file_to_staged(rfb: RawFileBlocks, device=None) -> StagedCols:
         _chunk_retry_counter().increment()
         TRACE("block_codec: device fault at decode download (%r) — "
               "retrying the launch once", e)
-        cols, is_const_d, first_d = _dispatch()
-        device_faults.maybe_fault("result")
-        is_const = np.asarray(is_const_d)
-        first = np.asarray(first_d)
+        try:
+            cols, is_const_d, first_d = _dispatch()
+            device_faults.maybe_fault("result")
+            is_const = np.asarray(is_const_d)
+            first = np.asarray(first_d)
+        except Exception as e2:  # noqa: BLE001 — post-retry containment
+            if device_faults.is_device_fault(e2):
+                # retry exhausted: park the decode bucket before the
+                # fault unwinds to the job-level native fallback
+                _board.record_fault(
+                    "block_decode", (1, n_pad),
+                    reason=f"decode {type(e2).__name__}: {e2}")
+            raise
     sort_rows, n_sort = build_sort_schedule(w_pad, is_const)
     record_kernel_dispatch("kernel_block_decode", n, n_pad,
                            (_time.monotonic() - t0) * 1e3)
     record_pipeline_stage("decode", (_time.monotonic() - t0) * 1e3)
+    _board.record_device("block_decode", (1, n_pad), n,
+                         _time.monotonic() - t0)
     codec_metrics()["decode_blocks"].increment(len(rfb.bodies))
     return StagedCols(cols, sort_rows, n_sort, n, n_pad, w_pad,
                       is_const, first)
@@ -380,8 +398,15 @@ def encode_span(st: StagedCols, n_rows: int, w_out: int, values,
     import time as _time
     import zlib as _zlib
     from yugabyte_tpu.ops import device_faults
+    from yugabyte_tpu.storage.bucket_health import health_board
     from yugabyte_tpu.utils.metrics import (record_kernel_dispatch,
                                             record_pipeline_stage)
+    _board = health_board()
+    if not _board.allow_device("block_encode", (1, st.n_pad)):
+        # parked encode bucket: the job unwinds its partial outputs and
+        # re-runs through the native byte shell, byte-identically
+        raise BlockCodecUnsupported("encode bucket parked by the "
+                                    "health board")
     t0 = _time.monotonic()
     device_faults.maybe_fault("dispatch")
 
@@ -414,7 +439,14 @@ def encode_span(st: StagedCols, n_rows: int, w_out: int, values,
         _chunk_retry_counter().increment()
         TRACE("block_codec: device fault at encode download (%r) — "
               "retrying the launch once", e)
-        outs = _download()
+        try:
+            outs = _download()
+        except Exception as e2:  # noqa: BLE001 — post-retry containment
+            if device_faults.is_device_fault(e2):
+                _board.record_fault(
+                    "block_encode", (1, st.n_pad),
+                    reason=f"encode {type(e2).__name__}: {e2}")
+            raise
     keys, kl2, dkl2, ht_hi, ht_lo, wid, fl4, ttl, h_hi, h_lo = outs
     keys_u8 = keys.view(np.uint8).reshape(n_rows, w_out * 4)
     kl = kl2.view("<u2")[:n_rows]
@@ -467,6 +499,8 @@ def encode_span(st: StagedCols, n_rows: int, w_out: int, values,
     record_kernel_dispatch("kernel_block_encode", n_rows, st.n_pad,
                            (_time.monotonic() - t0) * 1e3)
     record_pipeline_stage("encode", (_time.monotonic() - t0) * 1e3)
+    _board.record_device("block_encode", (1, st.n_pad), n_rows,
+                         _time.monotonic() - t0)
     codec_metrics()["encode_blocks"].increment(len(blocks))
     return blocks, index_items, hashes, first_key, last_key
 

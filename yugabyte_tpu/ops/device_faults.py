@@ -22,10 +22,18 @@ Sites:
     that loud-fault containment cannot see. Shadow verification
     (storage/integrity.py) is the defense it tests.
 
+A fourth kind, "slow", raises nothing at all: it sleeps `delay_s` at
+the injection site, modeling a degraded-but-alive accelerator (thermal
+throttle, contended PCIe tunnel, a straggling mesh shard). Nothing in
+the loud-fault containment sees it — the bucket-health board's rate
+race (storage/bucket_health.py) is the defense it tests, and it can be
+pinned to one shape bucket via arm(..., bucket=...) so a nemesis can
+slow a single (k_pad, m) while its neighbours stay fast.
+
 Arming is programmatic (`arm()`) or via the environment for child
-processes: YBTPU_INJECT_DEVICE_FAULT="<kind>:<site>:<count>", e.g.
-"oom:result:1" or "bitflip:survivor:1". Counts decrement per fire;
-count <= 0 disarms.
+processes: YBTPU_INJECT_DEVICE_FAULT="<kind>:<site>:<count>[:delay_s]",
+e.g. "oom:result:1" or "slow:dispatch:4:0.05". Counts decrement per
+fire; count <= 0 disarms.
 
 `is_device_fault()` classifies BOTH injected and real device failures
 (jaxlib XlaRuntimeError, RESOURCE_EXHAUSTED messages) so the
@@ -36,6 +44,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import List, Optional
 
 __all__ = ["InjectedDeviceFault", "InjectedCompileError",
@@ -74,6 +83,10 @@ _KINDS = {
 # consumed by maybe_flip_survivors, which MUTATES a downloaded survivor
 # decision instead of raising.
 _BITFLIP = "bitflip"
+# Silent-slowness model (no exception — the degraded-accelerator class
+# the bucket-health rate race exists to catch): maybe_fault sleeps
+# delay_s instead of raising, optionally only for one shape bucket.
+_SLOW = "slow"
 _SITES = ("dispatch", "result", "survivor")
 
 _lock = threading.Lock()
@@ -81,15 +94,22 @@ _armed: List[dict] = []   # guarded-by: _lock
 _env_loaded = False       # guarded-by: _lock
 
 
-def arm(kind: str, site: str = "dispatch", count: int = 1) -> None:
-    """Arm `count` faults of `kind` ('compile'|'oom'|'runtime'|'bitflip')
-    at `site` ('dispatch'|'result'|'survivor'). Several armings stack;
-    'bitflip' only fires at the 'survivor' site (silent corruption of a
-    downloaded decision buffer, no exception)."""
-    assert kind in _KINDS or kind == _BITFLIP, kind
+def arm(kind: str, site: str = "dispatch", count: int = 1,
+        delay_s: float = 0.05, bucket=None) -> None:
+    """Arm `count` faults of `kind`
+    ('compile'|'oom'|'runtime'|'bitflip'|'slow') at `site`
+    ('dispatch'|'result'|'survivor'). Several armings stack; 'bitflip'
+    only fires at the 'survivor' site (silent corruption of a downloaded
+    decision buffer, no exception); 'slow' sleeps `delay_s` at the site
+    without raising, and when `bucket` is given it fires only at
+    bucket-aware sites dispatching that exact shape bucket."""
+    assert kind in _KINDS or kind in (_BITFLIP, _SLOW), kind
     assert site in _SITES, site
     with _lock:
-        _armed.append({"kind": kind, "site": site, "count": count})
+        _armed.append({"kind": kind, "site": site, "count": count,
+                       "delay_s": float(delay_s),
+                       "bucket": tuple(bucket) if bucket is not None
+                       else None})
 
 
 def disarm_all() -> None:
@@ -119,30 +139,54 @@ def _load_env_locked() -> None:  # guarded-by: _lock
                 count = int(bits[2]) if len(bits) > 2 else 1
             except ValueError:  # yblint: contained(malformed env count defaults to 1 — arming still happens)
                 count = 1
+            try:
+                delay_s = float(bits[3]) if len(bits) > 3 else 0.05
+            except ValueError:  # yblint: contained(malformed env delay defaults to 50ms — arming still happens)
+                delay_s = 0.05
             if site in _SITES:
                 _armed.append({"kind": bits[0], "site": site,
-                               "count": count})
+                               "count": count, "delay_s": delay_s,
+                               "bucket": None})
 
 
-def maybe_fault(site: str) -> None:
-    """Raise the next armed fault for `site`, if any (decrements its
-    count). Called from the kernel launch/download hot points; a single
-    locked list check when nothing is armed."""
+def maybe_fault(site: str, bucket=None) -> None:
+    """Fire the next armed fault for `site`, if any (decrements its
+    count). 'slow' entries SLEEP (outside the lock) instead of raising
+    and consume independently of the loud kinds; a loud entry still
+    raises on the same call after the sleep, so a slow-AND-faulty
+    device is expressible. `bucket` is the dispatching shape bucket at
+    bucket-aware sites; bucket-pinned slow entries fire only when it
+    matches. A single locked list check when nothing is armed."""
+    delay = 0.0
+    hit = None
     with _lock:
         _load_env_locked()
         if not _armed:
             return
-        for a in _armed:
-            if a["site"] == site and a["count"] > 0:
+        for a in list(_armed):
+            if a["site"] != site or a["count"] <= 0:
+                continue
+            if a["kind"] == _SLOW:
+                want = a.get("bucket")
+                if want is not None and (bucket is None
+                                         or tuple(bucket) != want):
+                    continue
                 a["count"] -= 1
                 if a["count"] <= 0:
                     _armed.remove(a)
-                exc_type, msg = _KINDS[a["kind"]]
-                break
-        else:
-            return
-    _fault_counter(a["kind"]).increment()
-    raise exc_type(msg)
+                delay = max(delay, float(a.get("delay_s", 0.05)))
+            elif hit is None and a["kind"] != _BITFLIP:
+                a["count"] -= 1
+                if a["count"] <= 0:
+                    _armed.remove(a)
+                hit = a
+    if delay > 0.0:
+        _fault_counter(_SLOW).increment()
+        time.sleep(delay)
+    if hit is not None:
+        exc_type, msg = _KINDS[hit["kind"]]
+        _fault_counter(hit["kind"]).increment()
+        raise exc_type(msg)
 
 
 def maybe_flip_survivors(surv, make_tomb) -> bool:

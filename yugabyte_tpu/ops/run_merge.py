@@ -1523,9 +1523,10 @@ def launch_merge_gc(staged: StagedRuns, params: GCParams,
             return h
     # device-fault injection site "dispatch" (ops/device_faults.py): a
     # real XLA compile failure surfaces here, synchronously, per leaf
-    # launch (each chunk of a chunked job passes through this point)
+    # launch (each chunk of a chunked job passes through this point);
+    # the bucket lets a "slow" nemesis throttle one shape bucket only
     from yugabyte_tpu.ops import device_faults
-    device_faults.maybe_fault("dispatch")
+    device_faults.maybe_fault("dispatch", bucket=(staged.k_pad, staged.m))
     explicit = os.environ.get("YBTPU_MERGE_IMPL", "auto") == "pallas"
     if (not _pallas_broken or explicit) and _pick_impl(staged) == "pallas":
         from yugabyte_tpu.ops import pallas_merge

@@ -697,31 +697,32 @@ def _scan_agg_fused(cols, vals, sort_rows, n_sort,
 
 # ----------------------------------------------------- host-side drivers
 
-def _check_pushdown_bucket(n_pad: int):
-    """Pre-dispatch quarantine gate: a shape bucket that faulted recently
-    routes straight to the host path (no re-fault). Returns the bucket
-    key for the fault-time quarantine. The (1, n_pad) vocabulary is the
-    same one scan_fused/merge_gc declare in the kernel manifest."""
+def _check_pushdown_bucket(n_pad: int, family: str):
+    """Pre-dispatch health gate: a shape bucket the board parked
+    (recent fault, sticky mismatch, measured demotion without a probe
+    slot) routes straight to the host path (no re-fault). Returns the
+    bucket key for the fault-time report. The (1, n_pad) vocabulary is
+    the same one scan_fused/merge_gc declare in the kernel manifest."""
     from yugabyte_tpu.docdb.scan_spec import PushdownUnsupported
-    from yugabyte_tpu.storage.offload_policy import (
-        bucket_quarantine, point_read_bucket_key)
+    from yugabyte_tpu.storage.bucket_health import health_board
+    from yugabyte_tpu.storage.offload_policy import point_read_bucket_key
     bkey = point_read_bucket_key(n_pad)
-    if bucket_quarantine().is_quarantined(bkey):
+    if not health_board().allow_device(family, bkey):
         raise PushdownUnsupported("quarantined")
     return bkey
 
 
-def _contain_pushdown_fault(e: BaseException, bkey) -> None:
+def _contain_pushdown_fault(e: BaseException, bkey, family: str) -> None:
     """Fault-time half of the compaction containment mirror: a device
-    fault parks the shape bucket and converts to PushdownUnsupported so
-    the caller serves the SAME query through the host path; anything
-    else propagates unchanged."""
+    fault parks the shape bucket on the health board and converts to
+    PushdownUnsupported so the caller serves the SAME query through the
+    host path; anything else propagates unchanged."""
     from yugabyte_tpu.docdb.scan_spec import PushdownUnsupported
     from yugabyte_tpu.ops.device_faults import is_device_fault
-    from yugabyte_tpu.storage.offload_policy import bucket_quarantine
+    from yugabyte_tpu.storage.bucket_health import health_board
     if is_device_fault(e):
-        bucket_quarantine().quarantine(
-            bkey, f"scan_pushdown:{e.__class__.__name__}")
+        health_board().record_fault(
+            family, bkey, f"scan_pushdown:{e.__class__.__name__}")
         raise PushdownUnsupported("fault") from e
 
 
@@ -876,7 +877,7 @@ def filtered_entries_sources(sources, read_ht_value: int, spec,
     p_ops = _pack_predicate_operands(spec, p_pad, wire_ne_semantics=True)
     (lo_w, lo_l, hi_w, hi_l, up_inf, up_trunc,
      lo_exact, hi_exact) = _bound_operands(staged, lower_key, upper_key)
-    bkey = _check_pushdown_bucket(staged.n_pad)
+    bkey = _check_pushdown_bucket(staged.n_pad, "scan_filtered")
     t0 = _time.monotonic()
     try:
         device_faults.maybe_fault("dispatch")
@@ -890,7 +891,7 @@ def filtered_entries_sources(sources, read_ht_value: int, spec,
         perm = np.asarray(perm)
         keep_p = np.asarray(keep_p)
     except Exception as e:  # noqa: BLE001 — classified below
-        _contain_pushdown_fault(e, bkey)
+        _contain_pushdown_fault(e, bkey, "scan_filtered")
         raise
     keep = merge_gc._unpack_bits(keep_p, staged.n_pad)
     keep = keep & (perm < staged.n)
@@ -953,7 +954,7 @@ def aggregate_sources(sources, read_ht_value: int, spec,
         vals = jnp.zeros((_VAL_ROWS, 1), jnp.uint32)
     (lo_w, lo_l, hi_w, hi_l, up_inf, up_trunc,
      _lo_exact, _hi_exact) = _bound_operands(staged, lower_key, upper_key)
-    bkey = _check_pushdown_bucket(staged.n_pad)
+    bkey = _check_pushdown_bucket(staged.n_pad, "scan_agg")
     t0 = _time.monotonic()
     try:
         device_faults.maybe_fault("dispatch")
@@ -969,7 +970,7 @@ def aggregate_sources(sources, read_ht_value: int, spec,
         rows_count, nonnull, sums, min_hi, min_lo, max_hi, max_lo = \
             (np.asarray(x) for x in out)
     except Exception as e:  # noqa: BLE001 — classified below
-        _contain_pushdown_fault(e, bkey)
+        _contain_pushdown_fault(e, bkey, "scan_agg")
         raise
     record_kernel_dispatch("kernel_scan_agg", staged.n, staged.n_pad,
                            (_time.monotonic() - t0) * 1e3)

@@ -623,9 +623,10 @@ def pooled_merge_gc(mesh: Mesh, jobs: Sequence[Tuple[object, GCParams]],
     run_merge._record_bucket(("pool_wave", n_slots, k_pad, m, w, n_cmp,
                               p0.is_major_compaction, p0.retain_deletes,
                               lexsort))
-    # fault-injection sites: the wave's containment (the pool quarantines
-    # the bucket and completes every wave job natively) hooks here
-    device_faults.maybe_fault("dispatch")
+    # fault-injection sites: the wave's containment (the pool demotes the
+    # bucket on the health board and completes every wave job natively)
+    # hooks here; the bucket lets a "slow" nemesis throttle one (k, m)
+    device_faults.maybe_fault("dispatch", bucket=(k_pad, m))
     packed, perm, keep, mk = fn(cols_dev, cmp_dev, pos, cut_dev)
     try:
         packed.copy_to_host_async()

@@ -198,18 +198,36 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         cancel.check()
     all_inputs = list(inputs)
     orig_input_ids = list(input_ids) if input_ids is not None else None
+    board_key = None  # (board, family, qkey) when the board gated native
     if (offload_policy is not None and device is not None
             and device != "native" and not _no_combined):
         # Measured device-vs-native routing (VERDICT r3 #2): auto-offload
-        # only where calibration says the device path wins. Distributed
-        # (mesh) jobs are gated separately by their own size threshold.
+        # only where the live bucket-health board says the device path
+        # wins — `offload_policy` IS the BucketHealthBoard
+        # (storage/bucket_health.py). Distributed (mesh) jobs are gated
+        # on their own (n_shards, capacity) key below.
         est_rows = sum(r.props.n_entries for r in all_inputs)
         cached = bool(device_cache is not None and input_ids is not None
                       and all(device_cache.contains(fid)
                               for fid in input_ids))
-        if not _wants_distributed(mesh, est_rows) \
-                and not offload_policy.use_device(est_rows, cached):
-            device = "native"
+        if not _wants_distributed(mesh, est_rows):
+            from yugabyte_tpu.ops import run_merge
+            from yugabyte_tpu.storage import offload_policy as _pol
+            qkey = _pol.bucket_key(run_merge.packed_run_ns(
+                [r.props.n_entries for r in all_inputs if
+                 r.props.n_entries]))
+            # probe=False: this is a routing DECISION — the probe slot
+            # for a DEGRADED bucket is claimed at the device-native
+            # path's own allow_device(), immediately before dispatch,
+            # so a fall-through (deep inputs, radix override) can never
+            # wedge a claimed probe with no recorder behind it
+            if not offload_policy.use_device("run_merge_fused", qkey,
+                                             est_rows=est_rows,
+                                             cached=cached, probe=False):
+                device = "native"
+                # time the native completion so the board's native EWMA
+                # is live measurement, not a calibration-file fossil
+                board_key = (offload_policy, "run_merge_fused", qkey)
     if device is not None and device != "native" and not _no_combined:
         # The flagship production path: device merge+GC decisions + the
         # C++ byte shell + device-side write-through (the configuration
@@ -261,12 +279,18 @@ def run_compaction_job(inputs: Sequence[SSTReader], out_dir: str,
         if native_engine.available() and not get_env().encrypted:
             # the C++ shell reads/writes raw files; under encryption at
             # rest the Python shell (which goes through the Env) runs
+            import time as _time
+            t0 = _time.monotonic()
             result = _run_native_job(inputs, out_dir, new_file_id,
                                      history_cutoff_ht, is_major,
                                      retain_deletes, block_entries,
                                      frontier_inputs=all_inputs,
                                      cancel=cancel)
             result.rows_in += dropped_rows
+            if board_key is not None:
+                board, family, qkey = board_key
+                board.record_native(family, qkey, result.rows_in,
+                                    _time.monotonic() - t0)
             return result
     slabs = [r.read_all() for r in inputs]
     keep_idx = [i for i, s in enumerate(slabs) if s.n]
@@ -611,17 +635,27 @@ def run_compaction_job_device_native(
             "the declared kernel compile surface").increment()
         TRACE("compaction: bucket k_pad=%d m=%d is outside the declared "
               "compile surface", *qkey)
-    if offload_policy_mod.bucket_quarantine().is_quarantined(qkey):
-        # this shape bucket's kernel path faulted recently: native-only
-        # until the quarantine window decays (surfaced on /compactionz)
-        TRACE("compaction: shape bucket k_pad=%d m=%d is quarantined "
-              "after a device fault — routing native", *qkey)
-        return run_compaction_job(all_inputs, out_dir, new_file_id,
-                                  history_cutoff_ht, is_major,
-                                  retain_deletes, device="native",
-                                  block_entries=block_entries,
-                                  input_ids=orig_input_ids,
-                                  _no_combined=True, cancel=cancel)
+    from yugabyte_tpu.storage.bucket_health import health_board
+    board = health_board()
+    if not board.allow_device("run_merge_fused", qkey):
+        # QUARANTINED (recent fault / sticky mismatch) or DEGRADED with
+        # no probe slot: native-only until the board re-opens the bucket
+        # (surfaced on /healthz and /compactionz)
+        TRACE("compaction: shape bucket k_pad=%d m=%d is parked by the "
+              "health board — routing native", *qkey)
+        import time as _time
+        t0 = _time.monotonic()
+        result = run_compaction_job(all_inputs, out_dir, new_file_id,
+                                    history_cutoff_ht, is_major,
+                                    retain_deletes, device="native",
+                                    block_entries=block_entries,
+                                    input_ids=orig_input_ids,
+                                    _no_combined=True, cancel=cancel)
+        # the parked completion is live native measurement too — it is
+        # what the probe's device rate has to beat to re-promote
+        board.record_native("run_merge_fused", qkey, result.rows_in,
+                            _time.monotonic() - t0)
+        return result
 
     from yugabyte_tpu.ops import block_codec as block_codec_mod
     # The device codec rides the COLD byte path: when every input is
@@ -631,14 +665,20 @@ def run_compaction_job_device_native(
     all_run_cached = bool(
         run_cache is not None and input_ids is not None
         and all(run_cache.contains(fid) for fid in input_ids))
+    import time as _time
+    t0 = _time.monotonic()
     try:
         if block_codec_mod.codec_enabled() and not all_run_cached:
             try:
-                return _device_codec_attempt(
+                result = _device_codec_attempt(
                     inputs, all_inputs, input_ids, dropped_rows, out_dir,
                     new_file_id, history_cutoff_ht, is_major,
                     retain_deletes, device, block_entries, device_cache,
                     cancel)
+                board.record_device("run_merge_fused", qkey,
+                                    result.rows_in,
+                                    _time.monotonic() - t0)
+                return result
             except block_codec_mod.BlockCodecUnsupported as e:
                 block_codec_mod.codec_metrics()[
                     "encode_fallbacks"].increment()
@@ -646,10 +686,13 @@ def run_compaction_job_device_native(
                       "job (%s) — taking the native byte shell", e)
         else:
             block_codec_mod.codec_metrics()["encode_fallbacks"].increment()
-        return _device_native_attempt(
+        result = _device_native_attempt(
             inputs, all_inputs, input_ids, dropped_rows, out_dir,
             new_file_id, history_cutoff_ht, is_major, retain_deletes,
             device, block_entries, device_cache, run_cache, cancel)
+        board.record_device("run_merge_fused", qkey, result.rows_in,
+                            _time.monotonic() - t0)
+        return result
     except Exception as e:  # noqa: BLE001 — device-fault containment
         from yugabyte_tpu.ops import device_faults
         from yugabyte_tpu.ops.run_merge import DeviceFaultError
@@ -663,8 +706,16 @@ def run_compaction_job_device_native(
             # back to the native merge
             raise
         cause = e.cause if isinstance(e, DeviceFaultError) else e
-        offload_policy_mod.bucket_quarantine().quarantine(
-            qkey, reason=f"{type(cause).__name__}: {cause}")
+        if shadow_mm:
+            # STICKY: wrong bytes out-rank any fault — only an operator
+            # clear (board.clear_mismatch) re-opens the bucket
+            board.record_mismatch(
+                "run_merge_fused", qkey,
+                reason=f"{type(cause).__name__}: {cause}")
+        else:
+            board.record_fault(
+                "run_merge_fused", qkey,
+                reason=f"{type(cause).__name__}: {cause}")
         _storage_fallback_counter().increment()
         # the native re-run below writes through the shell encode
         block_codec_mod.codec_metrics()["encode_fallbacks"].increment()
@@ -685,12 +736,15 @@ def run_compaction_job_device_native(
         # partial outputs deleted, staging leases released), so the
         # whole job re-runs on the native path over the SAME filtered
         # inputs — the differential-tested twin of the kernel path.
+        t1 = _time.monotonic()
         result = _run_native_job(inputs, out_dir, new_file_id,
                                  history_cutoff_ht, is_major,
                                  retain_deletes, block_entries,
                                  frontier_inputs=all_inputs,
                                  cancel=cancel)
         result.rows_in += dropped_rows
+        board.record_native("run_merge_fused", qkey, result.rows_in,
+                            _time.monotonic() - t1)
         return result
 
 def _storage_fallback_counter():
@@ -1423,7 +1477,29 @@ def run_compaction_job_dist_native(
                  if id_of is not None else None)
 
     n_shards = mesh.devices.size
-    bucket = (n_shards, 0)   # refined once the step picks its capacity
+    est_rows = sum(r.props.n_entries for r in inputs)
+    bucket = (n_shards, _quantized_capacity(
+        bucket_size(est_rows) // n_shards, n_shards, 2.0))
+    from yugabyte_tpu.storage.bucket_health import health_board
+    board = health_board()
+    if not board.allow_device("dist_compact", bucket):
+        # the (n_shards, capacity) bucket is parked (fault quarantine /
+        # sticky mismatch / degraded without a probe slot): complete via
+        # the sequential native merge, byte-identically
+        from yugabyte_tpu.utils.trace import TRACE
+        TRACE("compaction: dist bucket n_shards=%d capacity=%d is "
+              "parked by the health board — routing native", *bucket)
+        t0 = _time.monotonic()
+        result = _run_native_job(inputs, out_dir, new_file_id,
+                                 history_cutoff_ht, is_major,
+                                 retain_deletes, block_entries,
+                                 frontier_inputs=all_inputs,
+                                 cancel=cancel)
+        result.rows_in += dropped_rows
+        board.record_native("dist_compact", bucket, result.rows_in,
+                            _time.monotonic() - t0)
+        return result
+    t_job = _time.monotonic()
     shadow = integrity.maybe_shadow_verifier(
         inputs, history_cutoff_ht, is_major, retain_deletes)
     params = GCParams(history_cutoff_ht, is_major, retain_deletes)
@@ -1497,13 +1573,13 @@ def run_compaction_job_dist_native(
                 cancel.check()
             job.set_survivors(surv, mk_surv)
             outputs, _ranges = writer.finish(job.n_survivors)
+        board.record_device("dist_compact", bucket, rows_in + dropped_rows,
+                            _time.monotonic() - t_job)
         return CompactionResult(outputs, rows_in + dropped_rows, rows_out,
                                 tombstones_written=int(
                                     np.count_nonzero(mk_surv)))
     except Exception as e:  # noqa: BLE001 — device-fault containment
         from yugabyte_tpu.ops.run_merge import DeviceFaultError
-        from yugabyte_tpu.storage import offload_policy as \
-            offload_policy_mod
         from yugabyte_tpu.storage.integrity import (ShadowMismatch,
                                                     shadow_mismatch_counter)
         from yugabyte_tpu.storage.sst import data_file_name
@@ -1523,20 +1599,27 @@ def run_compaction_job_dist_native(
         if not (shadow_mm or isinstance(e, DeviceFaultError)
                 or device_faults.is_device_fault(e)):
             raise
-        offload_policy_mod.bucket_quarantine().quarantine(
-            bucket, reason=f"{type(e).__name__}: {e}")
+        if shadow_mm:
+            board.record_mismatch("dist_compact", bucket,
+                                  reason=f"{type(e).__name__}: {e}")
+        else:
+            board.record_fault("dist_compact", bucket,
+                               reason=f"{type(e).__name__}: {e}")
         _storage_fallback_counter().increment()
         if shadow_mm:
             shadow_mismatch_counter().increment()
         TRACE("compaction: dist-native job failed (%r) — bucket "
               "n_shards=%d capacity=%d quarantined; completing via the "
               "native merge", e, *bucket)
+        t1 = _time.monotonic()
         result = _run_native_job(inputs, out_dir, new_file_id,
                                  history_cutoff_ht, is_major,
                                  retain_deletes, block_entries,
                                  frontier_inputs=all_inputs,
                                  cancel=cancel)
         result.rows_in += dropped_rows
+        board.record_native("dist_compact", bucket, result.rows_in,
+                            _time.monotonic() - t1)
         return result
 
 

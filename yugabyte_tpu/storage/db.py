@@ -794,24 +794,35 @@ class DB:
                 if st.n != r.props.n_entries:
                     return None  # stale residency: let native serve
                 staged_by.append((fid, r, st))
-            q = offload_policy.bucket_quarantine()
-            if any(q.is_quarantined(
+            from yugabyte_tpu.storage.bucket_health import health_board
+            board = health_board()
+            if any(not board.allow_device(
+                    "point_read_locate",
                     offload_policy.point_read_bucket_key(st.n_pad))
                    for _fid, _r, st in staged_by):
                 return None
             results: List = [None] * len(keys)
             cur = {"n_pad": staged_by[0][2].n_pad if staged_by else 0}
+            import time as _time
+            t0 = _time.monotonic()
             try:
                 self._multi_get_device_batches(
                     keys, read_ht, mems, staged_by, results,
                     doc_key_lens, cur)
+                if staged_by:
+                    board.record_device(
+                        "point_read_locate",
+                        offload_policy.point_read_bucket_key(
+                            cur["n_pad"]),
+                        len(keys), _time.monotonic() - t0)
             except Exception as e:  # noqa: BLE001 — device-fault containment
                 if not device_faults.is_device_fault(e):
                     raise
                 # fault containment: park the shape bucket and serve this
                 # batch (and the quarantine window) via the native path,
                 # byte-identically — mirrors the compaction fallback
-                q.quarantine(
+                board.record_fault(
+                    "point_read_locate",
                     offload_policy.point_read_bucket_key(cur["n_pad"]),
                     reason=f"point-read {type(e).__name__}: {e}")
                 point_read.point_read_metrics()[
@@ -1414,12 +1425,23 @@ class DB:
         if pool is not None and self.opts.device not in (None, "native"):
             est = sum(r.props.n_entries for r in inputs)
             has_deep = any(r.props.has_deep for r in inputs)
-            pol = self.opts.offload_policy
+            board = self.opts.offload_policy
             cached = bool(self._device_cache is not None and all(
                 self._device_cache.contains(fm.file_id)
                 for fm in pick.inputs))
-            if not has_deep and (pol is None
-                                 or pol.use_device(est, cached)):
+            use = True
+            if board is not None:
+                from yugabyte_tpu.ops.run_merge import packed_run_ns
+                from yugabyte_tpu.storage.offload_policy import bucket_key
+                qkey = bucket_key(packed_run_ns(
+                    [r.props.n_entries for r in inputs
+                     if r.props.n_entries]))
+                # probe=False: this thread only SUBMITS — the pool
+                # worker that dispatches claims any probe slot itself
+                use = board.use_device("run_merge_fused", qkey,
+                                       est_rows=est, cached=cached,
+                                       probe=False)
+            if not has_deep and use:
                 handle = pool.submit_compaction(
                     self.db_dir, inputs=inputs, out_dir=self.db_dir,
                     new_file_id=self.versions.new_file_id,

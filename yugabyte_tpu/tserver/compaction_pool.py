@@ -50,11 +50,6 @@ from yugabyte_tpu.utils.cancellation import (CancellationToken,
                                              OperationCancelled)
 from yugabyte_tpu.utils.trace import TRACE
 
-flags.define_flag("compaction_pool_rate_ewma_alpha", 0.3,
-                  "weight of the newest wave in the pool's measured "
-                  "per-bucket rate estimates")
-
-
 @dataclass
 class PoolRequest:
     """One tablet compaction job as the pool schedules it."""
@@ -120,8 +115,8 @@ class _Job:
     pins: List[int] = field(default_factory=list)
 
 
-def _bucket_name(bucket: Tuple[int, int, int]) -> str:
-    return f"k{bucket[0]}_m{bucket[1]}_w{bucket[2]}"
+def _bucket_name(bucket: Tuple[int, int]) -> str:
+    return f"k{bucket[0]}_m{bucket[1]}"
 
 
 class CompactionPool:
@@ -143,8 +138,6 @@ class CompactionPool:
         self._credits: Dict[str, float] = {}      # rows served; _lock
         self._running: Dict[str, int] = {}        # guarded-by: _lock
         self._shutdown = False                    # guarded-by: _lock
-        # bucket -> {"device": rows/s EWMA, "native": rows/s EWMA}
-        self._rates: Dict[Tuple[int, int, int], Dict[str, float]] = {}
         self._last_fill = 0.0                     # guarded-by: _lock
         e = ROOT_REGISTRY.entity("server", "compaction_pool")
         self._c_jobs = e.counter(
@@ -257,8 +250,19 @@ class CompactionPool:
 
     def snapshot(self) -> dict:
         """The /compactionz "pool" block: queue depth, per-tablet
-        queued/running, packed-slot occupancy and the measured per-bucket
-        aggregate rates the scheduler routes by."""
+        queued/running, packed-slot occupancy and the health board's
+        measured per-bucket rates the scheduler routes by."""
+        from yugabyte_tpu.storage.bucket_health import health_board
+        rates = {}
+        for rec in health_board().snapshot()["keys"]:
+            if rec["family"] != "run_merge_fused":
+                continue
+            rates[_bucket_name(tuple(rec["bucket"]))] = {
+                "device_rows_per_sec": rec["device_rows_per_sec"],
+                "native_rows_per_sec": rec["native_rows_per_sec"],
+                "state": rec["state"],
+                "demoted": rec["state"] in ("degraded", "quarantined"),
+            }
         with self._lock:
             tablets = {}
             for tid, q in self._queues.items():
@@ -268,13 +272,6 @@ class CompactionPool:
             for tid, r in self._running.items():
                 if r and tid not in tablets:
                     tablets[tid] = {"queued": 0, "running": r}
-            rates = {
-                _bucket_name(b): {
-                    "device_rows_per_sec": round(v.get("device", 0.0), 1),
-                    "native_rows_per_sec": round(v.get("native", 0.0), 1),
-                    "demoted": self._demoted_unlocked(b),
-                }
-                for b, v in sorted(self._rates.items())}
             return {
                 "mesh_slots": self.n_slots,
                 "queue_depth": self._queue_depth_unlocked(),
@@ -287,28 +284,6 @@ class CompactionPool:
                 "wave_faults": self._c_faults.value(),
                 "cancelled": self._c_cancelled.value(),
             }
-
-    # ---------------------------------------------------------- rate tracking
-    def _record_rate(self, bucket: Tuple[int, int, int], kind: str,
-                     rows: int, seconds: float) -> None:
-        if rows <= 0 or seconds <= 0:
-            return
-        rate = rows / seconds
-        alpha = float(flags.get_flag("compaction_pool_rate_ewma_alpha"))
-        with self._lock:
-            ent = self._rates.setdefault(bucket, {})
-            prev = ent.get(kind)
-            ent[kind] = rate if prev is None else \
-                alpha * rate + (1 - alpha) * prev
-
-    def _demoted_unlocked(self, bucket: Tuple[int, int, int]) -> bool:
-        ent = self._rates.get(bucket, {})
-        dev, nat = ent.get("device"), ent.get("native")
-        return dev is not None and nat is not None and dev < nat
-
-    def _bucket_demoted(self, bucket: Tuple[int, int, int]) -> bool:
-        with self._lock:
-            return self._demoted_unlocked(bucket)
 
     # ------------------------------------------------------------- scheduling
     def _queue_depth_unlocked(self) -> int:
@@ -416,15 +391,15 @@ class CompactionPool:
             key = (st.k_pad, st.m, st.w, job.request.is_major,
                    job.request.retain_deletes)
             groups.setdefault(key, []).append(job)
-        from yugabyte_tpu.storage import offload_policy as policy_mod
+        from yugabyte_tpu.storage.bucket_health import health_board
+        board = health_board()
         for key, group in groups.items():
             bucket = key[:3]
-            if self._bucket_demoted(bucket) or \
-                    policy_mod.bucket_quarantine().is_quarantined(
-                        (bucket[0], bucket[1])):
-                # measured demotion (device rate under native) or an open
-                # fault-quarantine window: run these natively until the
-                # measurements / the decay say otherwise
+            if not board.allow_device("run_merge_fused",
+                                      (bucket[0], bucket[1])):
+                # the health board parked the bucket (measured demotion,
+                # open fault-quarantine window, or sticky mismatch): run
+                # these natively until a probe / the decay re-opens it
                 for job in group:
                     self._complete_natively(job, record_rate=True)
                 continue
@@ -500,7 +475,8 @@ class CompactionPool:
         from yugabyte_tpu.ops import device_faults
         from yugabyte_tpu.ops.merge_gc import GCParams
         from yugabyte_tpu.parallel.dist_compact import pooled_merge_gc
-        from yugabyte_tpu.storage import offload_policy as policy_mod
+        from yugabyte_tpu.storage.bucket_health import health_board
+        board = health_board()
 
         # waves are mesh-slot sized; a larger group runs in several
         waves = [group[i:i + self.n_slots]
@@ -527,8 +503,8 @@ class CompactionPool:
                 # every wave job natively — co-scheduled tablets' jobs
                 # finish byte-identically instead of aborting
                 self._c_faults.increment()
-                policy_mod.bucket_quarantine().quarantine(
-                    (bucket[0], bucket[1]),
+                board.record_fault(
+                    "run_merge_fused", (bucket[0], bucket[1]),
                     reason=f"pool wave fault: {type(e).__name__}: {e}")
                 TRACE("compaction pool: wave device fault (%r) — bucket "
                       "k_pad=%d m=%d quarantined; completing %d job(s) "
@@ -539,7 +515,8 @@ class CompactionPool:
             self._c_waves.increment()
             wall = max(time.monotonic() - t0, 1e-9)
             rows = sum(job.staged.n for job in wave)
-            self._record_rate(bucket, "device", rows, wall)
+            board.record_device("run_merge_fused",
+                                (bucket[0], bucket[1]), rows, wall)
             for slot, job in enumerate(wave):
                 self._c_wave_jobs.increment()
                 try:
@@ -630,9 +607,10 @@ class CompactionPool:
                 rows = result.rows_in
             self._c_native.increment()
             if record_rate and job.staged is not None:
-                self._record_rate(
-                    (job.staged.k_pad, job.staged.m, job.staged.w),
-                    "native", rows, max(time.monotonic() - t0, 1e-9))
+                from yugabyte_tpu.storage.bucket_health import health_board
+                health_board().record_native(
+                    "run_merge_fused", (job.staged.k_pad, job.staged.m),
+                    rows, max(time.monotonic() - t0, 1e-9))
             self._finish(job, result=result)
         except BaseException as e:  # noqa: BLE001 — per-job containment
             self._finish(job, exc=e)

@@ -171,8 +171,24 @@ class PrewarmKernelsOp(MaintenanceOp):
     def perform(self) -> None:
         from yugabyte_tpu.ops import block_codec, point_read, run_merge, scan
         from yugabyte_tpu.storage import offload_policy
+        from yugabyte_tpu.storage.bucket_health import health_board
         from yugabyte_tpu.utils.metrics import publish_compile_surface
-        n = run_merge.prewarm_buckets(self._shapes)
+        board = health_board()
+        shapes = list(self._shapes if self._shapes is not None
+                      else run_merge._PREWARM_SHAPES)
+        # AOT priority from the health board: the highest-traffic COLD
+        # buckets (jobs the policy routed native while unamortized)
+        # compile first, so the order traffic arrives in is the order
+        # the compile budget is spent in
+        prio = {key[1]: i for i, key in enumerate(
+            k for k in board.prewarm_priorities()
+            if k[0] == "run_merge_fused")}
+        shapes.sort(key=lambda s: prio.get((s[0], s[1]), len(prio)))
+        n = run_merge.prewarm_buckets(shapes)
+        for s in shapes:
+            # the compile cost is paid: COLD -> WARMING, so the policy
+            # gate stops routing these buckets native
+            board.record_prewarmed("run_merge_fused", (s[0], s[1]))
         # the batched point-read families (serve-path kernels) warm in
         # the same pass — their first real multi_get batch must load a
         # cached executable, not stall a read on an XLA compile

@@ -67,7 +67,7 @@ def resolve_device(mode: str, timeout_s: float):
         try:
             import jax
             result["devices"] = jax.devices()
-        except Exception as e:  # backend init failure
+        except Exception as e:  # yblint: contained(backend-init failure parked in result['error']; the join-side caller routes it to TRACE and falls back native)
             result["error"] = e
 
     t = threading.Thread(target=probe, daemon=True, name="device-init")
@@ -123,10 +123,12 @@ class ServerExecutionContext:
             self.compaction_pool = CompactionPool(self.mesh,
                                                   device=self.device)
         self.block_cache = BlockCache(flags.get_flag("block_cache_bytes"))
-        from yugabyte_tpu.storage.offload_policy import OffloadPolicy
-        self.offload_policy = OffloadPolicy.load(
-            platform=(getattr(self.device, "platform", "")
-                      if self.device != "native" else ""))
+        # the live device-vs-native routing authority (PR 16): one
+        # process-wide health record per (kernel family, shape bucket),
+        # replacing the old static calibration-file loader
+        from yugabyte_tpu.storage.bucket_health import health_board
+        self.health_board = health_board()
+        self.offload_policy = self.health_board
         self._entity = None
         if metrics is not None:
             e = metrics.entity("server", "execution")

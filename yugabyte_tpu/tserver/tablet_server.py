@@ -151,9 +151,27 @@ class TabletServer:
             # batching, client-batch coalescing and follower-read
             # vouch accounting (ROADMAP item 1)
             self.webserver.register_json("/servez", self.servez)
+            # /healthz: the bucket-health board — per-(kernel family,
+            # bucket) state, measured rates, probe history and the
+            # transition log (storage/bucket_health.py)
+            self.webserver.register_json("/healthz", self.healthz)
 
     def _tablet_peers(self):
         return self.tablet_manager.peers()
+
+    def healthz(self) -> dict:
+        """Liveness (`status: ok`, what probes key on) plus the
+        bucket-health board's single pane of glass: per-key state +
+        rates + probe history, the state histogram, open quarantine
+        windows and the recent transition log."""
+        from yugabyte_tpu.storage.bucket_health import health_board
+        return {"status": "ok", "server_id": self.server_id,
+                "bucket_health": health_board().snapshot()}
+
+    def _health_board_path(self) -> str:
+        from yugabyte_tpu.utils import flags as _flags
+        return _flags.get_flag("bucket_health_path") or os.path.join(
+            self.opts.fs_root, "bucket_health.json")
 
     def compactionz(self) -> dict:
         """Flush/compaction stats per hosted tablet DB + server totals."""
@@ -566,6 +584,11 @@ class TabletServer:
         # master first (unavailable masters: proceed; heartbeats retrofit
         # the keys, and encrypted tablets simply cannot serve until then).
         self._fetch_universe_keys()
+        # restore the bucket-health board before any tablet opens: open
+        # quarantine windows and sticky mismatch marks must gate the very
+        # first post-restart compaction (rates re-learn from scratch)
+        from yugabyte_tpu.storage.bucket_health import health_board
+        health_board().load(self._health_board_path())
         self.tablet_manager.open_existing()
         self.memory_manager.init()
         self.maintenance_manager.init()
@@ -629,6 +652,11 @@ class TabletServer:
         if self.webserver is not None:
             self.webserver.shutdown()
         self.tablet_manager.shutdown()
+        # persist the bucket-health board after the last compaction has
+        # drained (durable facts only: states, faults, quarantine
+        # windows, mismatch reasons — rates restart as WARMING)
+        from yugabyte_tpu.storage.bucket_health import health_board
+        health_board().save(self._health_board_path())
         if self.exec_context is not None:
             self.exec_context.shutdown()
         self.messenger.shutdown()
