@@ -60,6 +60,38 @@ def _shadow_mismatches_for_report():
     return shadow_snapshot()["mismatches"]
 
 
+def _round_meta(backend: str, round_label: str = "") -> dict:
+    """The round identity stamp every bench JSON carries: what backend
+    produced the numbers, on how many devices / host cores, from which
+    source revision — the keys tools/bench_compare.py refuses to diff
+    across (CPU-vs-TPU rounds are different experiments, not
+    regressions)."""
+    meta = {
+        "backend": backend,
+        "device_count": 0,
+        "host_cores": os.cpu_count() or 0,
+        "git_rev": "",
+        "round_label": round_label
+        or os.environ.get("YBTPU_BENCH_ROUND_LABEL", ""),
+    }
+    try:
+        meta["git_rev"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001 — identity stamp, never fatal
+        pass
+    try:
+        # parent-safe: only count devices if a backend is ALREADY up in
+        # this process (children measure; the parent must not init one)
+        if "jax" in sys.modules:
+            meta["device_count"] = len(sys.modules["jax"].devices())
+    except Exception:  # noqa: BLE001 — identity stamp, never fatal
+        pass
+    return meta
+
+
 def _bucket_health_for_report():
     """Transition counters + per-state bucket counts from the live
     bucket-health board — reported next to compile_bucket_* so a run
@@ -1347,6 +1379,7 @@ def run_pool_parent() -> None:
         if k in ident:
             result[k] = ident[k]
     result["platform"] = "cpu"
+    result["meta"] = _round_meta("cpu", round_label="compaction_pool")
     result["knobs"] = {
         "devices": "virtual 8-device CPU mesh "
                    "(xla_force_host_platform_device_count; TPU tunnel "
@@ -2103,6 +2136,13 @@ def main():
                          sys.argv[4] if len(sys.argv) > 4 else None)
         return
 
+    # telemetry timebase: sample the parent process (the cluster-soak
+    # and YCSB stages run in-parent) through the round so the emitted
+    # JSON carries rate history, not just end-state counters
+    from yugabyte_tpu.utils.timeseries import timeseries_store
+    _ts = timeseries_store()
+    _ts.start(interval_s=1.0)
+
     # Budgets are per-phase (VERDICT r3: one all-or-nothing 480s budget for
     # init+compile+run produced no TPU datapoint at all).  On timeout the
     # ladder degrades SHAPE (4M -> 1M -> 256K), never platform.
@@ -2233,6 +2273,10 @@ def main():
             # stock CPU CompactionJob" — which also pays disk I/O)
             result["vs_baseline"] = round(steady / native_rate, 3)
             result["vs_baseline_basis"] = _BASIS
+    result["meta"] = _round_meta(str(result.get("platform") or "cpu"))
+    _ts.sample_once()  # final tick so short stages land in the window
+    _ts.stop()
+    result["timeseries"] = _ts.bench_snapshot()
     print(json.dumps(result), flush=True)
 
 

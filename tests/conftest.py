@@ -47,6 +47,18 @@ def _fresh_bucket_health_board():
     health_board().reset()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_timeseries_store():
+    """The telemetry timebase is process-global too: a sampler thread
+    left running by one test would scrape (and pin sources of) servers
+    the next test already tore down. Every test starts storeless; the
+    teardown stop also joins any sampler the test leaked."""
+    from yugabyte_tpu.utils.timeseries import reset_timeseries_store
+    reset_timeseries_store()
+    yield
+    reset_timeseries_store()
+
+
 def pytest_collection_modifyitems(config, items):
     """Run the sync-point interleaving schedules FIRST: they pin exact
     thread timings, and by the end of a full-suite run hundreds of

@@ -103,7 +103,12 @@ _SEED_MODULE_SUFFIXES = (_WAL_MODULE_SUFFIX, ".rpc.nemesis",
                          # dispatch site routes through it, so a
                          # swallowed error here mis-routes ALL kernel
                          # families at once
-                         ".storage.bucket_health")
+                         ".storage.bucket_health",
+                         # PR 17: the telemetry timebase — a silently
+                         # dead scrape source leaves flat-lined series
+                         # that read as "healthy and idle" during the
+                         # exact incident the history exists to explain
+                         ".utils.timeseries")
 _MARKER_RE = re.compile(r"#\s*yblint:\s*contained\(")
 _DEF_MARKER = "# yblint: durability-path"
 _ROUTING_NAMES = ("TRACE", "trace")

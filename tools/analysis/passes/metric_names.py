@@ -17,6 +17,13 @@ helper sites that use them (utils/metrics.record_kernel_dispatch,
 mem_tracker per-tracker gauges) append conforming suffixes to a fixed
 family prefix. Waive a line with `# lint: metric-name-ok` (legacy) or
 `# yblint: disable=metric-names`.
+
+Name-table coverage: a module-level `*_HISTOGRAMS` constant (the
+serve-path attribution tables in utils/latency.py) declares histogram
+names that reach `.histogram(...)` through a variable, which the
+call-site rule above cannot see. Every literal string VALUE in such a
+dict (or the dict's values when keyed by stage) is checked against the
+histogram rules at its declaration site instead.
 """
 
 from __future__ import annotations
@@ -52,6 +59,7 @@ class MetricNamesPass(AnalysisPass):
 
     def run(self, ctx: FileContext) -> List[Finding]:
         out: List[Finding] = []
+        self._check_name_tables(ctx, out)
         for node in ctx.nodes_of(ast.Call):
             f_ = node.func
             kind = f_.attr if isinstance(f_, ast.Attribute) else None
@@ -76,3 +84,30 @@ class MetricNamesPass(AnalysisPass):
                     f"{kind} {name!r}: missing unit suffix "
                     f"(one of {', '.join(suffixes)})"))
         return out
+
+    def _check_name_tables(self, ctx: FileContext, out: List[Finding]) -> None:
+        """Histogram name tables: module-level `X_HISTOGRAMS = {...}`
+        dicts whose literal string values are histogram names consumed
+        through a variable (see module docstring)."""
+        for node in ctx.nodes_of(ast.Assign):
+            if len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name) \
+                    or not node.targets[0].id.endswith("_HISTOGRAMS") \
+                    or not isinstance(node.value, ast.Dict):
+                continue
+            for v in node.value.values:
+                if not (isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    continue
+                name = v.value
+                if ctx.line_comment_has(v.lineno, _WAIVER):
+                    continue
+                if not _SNAKE.match(name):
+                    out.append(ctx.finding(
+                        self.name, "not-snake-case", v,
+                        f"histogram table entry {name!r}: not snake_case"))
+                elif not name.endswith(_SUFFIXES["histogram"]):
+                    out.append(ctx.finding(
+                        self.name, "missing-unit-suffix", v,
+                        f"histogram table entry {name!r}: missing unit "
+                        f"suffix (one of {', '.join(_SUFFIXES['histogram'])})"))

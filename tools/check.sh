@@ -56,6 +56,24 @@ fi
 echo "== kernel-manifest drift check (committed JSON) =="
 python -m tools.analysis.kernel_manifest --check
 
+echo "== bench regression gate (tools/bench_compare.py) =="
+# the comparator itself must work on real committed rounds (same
+# backend label -> plain diff exits 0; disjoint-key rounds are fine)...
+python tools/bench_compare.py BENCH_SELF_r09.json BENCH_SELF_r10.json \
+    > /dev/null
+# ...and the gate must actually GATE: the committed synthetic-
+# regression fixture pair has to fail --check. If it passes, the
+# tolerance file or the direction inference silently broke.
+if python tools/bench_compare.py tools/bench_fixtures/base.json \
+        tools/bench_fixtures/regressed.json --check > /dev/null 2>&1; then
+    echo "check.sh: FAIL — bench_compare --check passed the synthetic" \
+         "regression fixture (the gate no longer gates)" >&2
+    exit 1
+fi
+# a round compared against itself must be clean
+python tools/bench_compare.py tools/bench_fixtures/base.json \
+    tools/bench_fixtures/base.json --check > /dev/null
+
 REGEN=0
 if [ "$RUN_FULL" = 1 ]; then
     REGEN=1

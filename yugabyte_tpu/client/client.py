@@ -24,6 +24,7 @@ from yugabyte_tpu.docdb.doc_operations import QLWriteOp
 from yugabyte_tpu.rpc.messenger import (
     Messenger, RemoteError, RpcTimeout, ServiceUnavailable)
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import latency
 from yugabyte_tpu.utils.backoff import Backoff, RetryBudget
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE, Trace
@@ -585,14 +586,20 @@ class YBClient:
 
         def fetch(tablet, pk, idxs) -> None:
             try:
-                resp = self._tablet_call(
-                    table, tablet, "multi_read", refresh_key=pk,
-                    spread_replicas=follower_read,
-                    doc_keys=[doc_key_to_wire(doc_keys[i]) for i in idxs],
-                    read_ht=read_ht.value if read_ht else None,
-                    projection=list(projection) if projection else None,
-                    allow_follower=follower_read,
-                    schema_version=table.schema_version)
+                # serve-path attribution: one budget per tablet group —
+                # each group is one RPC, so the per-group e2e decomposes
+                # cleanly into its own server's stage map (a fan-out
+                # batch records one attribution sample per tablet)
+                with latency.budget_scope(latency.OP_MULTI_READ):
+                    resp = self._tablet_call(
+                        table, tablet, "multi_read", refresh_key=pk,
+                        spread_replicas=follower_read,
+                        doc_keys=[doc_key_to_wire(doc_keys[i])
+                                  for i in idxs],
+                        read_ht=read_ht.value if read_ht else None,
+                        projection=list(projection) if projection else None,
+                        allow_follower=follower_read,
+                        schema_version=table.schema_version)
             except Exception as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
                 return

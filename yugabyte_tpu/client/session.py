@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 from yugabyte_tpu.client.client import YBClient, YBTable
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import latency
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 
 flags.define_flag("ybsession_max_batch_ops", 512,
@@ -89,13 +90,17 @@ class SessionFlushError(StatusError):
 
 
 class _TabletGroup:
-    __slots__ = ("table", "tablet", "ops", "bytes")
+    __slots__ = ("table", "tablet", "ops", "bytes", "created")
 
     def __init__(self, table: YBTable, tablet):
         self.table = table
         self.tablet = tablet
         self.ops: List[QLWriteOp] = []
         self.bytes = 0
+        # when the group's first op buffered — the send opens the op's
+        # LatencyBudget at this instant, so the e2e decomposition
+        # includes the batcher queue wait as the client_queue stage
+        self.created = time.monotonic()
 
 
 class YBSession:
@@ -226,7 +231,18 @@ class YBSession:
                     errors: List[Tuple[YBTable, QLWriteOp, Exception]],
                     errors_lock: threading.Lock) -> None:
         try:
-            self._client.write(group.table, group.ops, tablet=group.tablet)
+            # serve-path attribution: the budget's clock starts when the
+            # group's first op buffered, so the time the batch waited in
+            # the batcher is the client_queue stage; every later layer
+            # (wire encode, service queue, raft, WAL, apply) records its
+            # slice into the same ambient budget, and on success the
+            # scope exit feeds the serve_path histograms
+            with latency.budget_scope(latency.OP_WRITE,
+                                      t0=group.created) as budget:
+                budget.record(latency.STAGE_CLIENT_QUEUE,
+                              (time.monotonic() - group.created) * 1e3)
+                self._client.write(group.table, group.ops,
+                                   tablet=group.tablet)
         except Exception as e:  # noqa: BLE001  # yblint: contained(demuxed onto every op of the group; flush re-raises them as SessionFlushError)
             with errors_lock:
                 errors.extend((group.table, op, e) for op in group.ops)

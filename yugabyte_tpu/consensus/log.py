@@ -31,6 +31,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils.latency import STAGE_WAL_FSYNC
 from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
 from yugabyte_tpu.utils.trace import TRACE, LongOperationTracker
 
@@ -214,16 +215,22 @@ class Log:
         faster than the disk syncs them, so new writes should be delayed
         or shed before the queue's memory and latency grow unbounded."""
         with self._lock:
-            n = sum(len(entries) for entries, _cb in self._queue)
+            n = sum(len(entries) for entries, _cb, _b in self._queue)
             return n + (1 if self._inflight else 0)
 
     def append_async(self, entries: Sequence[LogEntry],
-                     callback: Optional[Callable] = None) -> None:
+                     callback: Optional[Callable] = None,
+                     budget=None) -> None:
         """Queue entries for the appender thread (ref log.cc:739
         AsyncAppendReplicates). The callback fires after fsync as
         callback(err): err is None on durable success, the I/O error
         otherwise — claiming success on a failed append would count a
-        non-durable replica toward the commit majority."""
+        non-durable replica toward the commit majority.
+
+        budget, when given, is the originating op's LatencyBudget
+        (utils/latency.py): the appender thread records the group
+        fsync wall into it — the caller thread is already parked on
+        the commit cv by then, so the contextvar can't carry it."""
         if not entries:
             if callback:
                 callback(None)
@@ -234,7 +241,7 @@ class Log:
             if self._io_error is not None:
                 err = self._io_error
             else:
-                self._queue.append((list(entries), callback))
+                self._queue.append((list(entries), callback, budget))
                 self._cv.notify()
                 return
         if callback:
@@ -278,7 +285,7 @@ class Log:
                 t0 = _time.monotonic()
                 files_to_sync = set()
                 last_op_id = None
-                for entries, _cb in batch:
+                for entries, _cb, _budget in batch:
                     for e in entries:
                         self._ensure_segment(e.index)
                         rec = _encode_entry(e)
@@ -301,12 +308,19 @@ class Log:
                     for f in files_to_sync:
                         f.flush(fsync=bool(
                             flags.get_flag("durable_wal_write")))
-                h_fsync.increment((_time.monotonic() - t1) * 1e3)
+                fsync_ms = (_time.monotonic() - t1) * 1e3
+                h_fsync.increment(fsync_ms)
                 c_commits.increment()
+                # Attribute the group fsync to every op in the batch:
+                # each waited for this one sync (group commit), so each
+                # op's durability cost IS the group's wall time.
+                for _entries, _cb, b in batch:
+                    if b is not None:
+                        b.record(STAGE_WAL_FSYNC, fsync_ms)
             except OSError as exc:
                 err = exc
                 self._fail(exc)
-        for _entries, cb in batch:
+        for _entries, cb, _budget in batch:
             if cb:
                 # err != None also for batches whose bytes landed before
                 # the failure: their fsync never ran, so durability is

@@ -38,6 +38,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from yugabyte_tpu.consensus.log import Log, LogEntry
 from yugabyte_tpu.consensus.transport import PeerUnreachable
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import latency as _latency
 from yugabyte_tpu.utils.metrics import ROOT_REGISTRY
 from yugabyte_tpu.utils.trace import (TRACE, LongOperationTracker, Trace,
                                       current_trace_context)
@@ -317,6 +318,13 @@ class RaftConsensus:
         # the common case) — a missing ctx only drops propagation, never
         # correctness
         self._trace_ctx_by_index: Dict[int, dict] = {}  # guarded-by: _lock
+        # index -> the originating write's LatencyBudget, so the commit
+        # worker can attribute the apply slice to the op that asked for
+        # it (the replicate caller blocks on _commit_cv, so the budget
+        # contextvar is unreachable from the applying thread). Same
+        # lifecycle as _trace_ctx_by_index: trimmed with it, dropped on
+        # truncation, advisory-only.
+        self._budget_by_index: Dict[int, object] = {}  # guarded-by: _lock
         self._last_index = 0           # guarded-by: _lock
         self._last_term = 0            # guarded-by: _lock
         self._local_durable_index = 0  # guarded-by: _lock
@@ -713,6 +721,11 @@ class RaftConsensus:
         """Leader: append + replicate + wait for commit AND local apply
         (ref raft_consensus.cc:1140 ReplicateBatch)."""
         t0 = time.monotonic()
+        budget = _latency.current_budget()
+        fs0 = ap0 = 0.0
+        if budget is not None:
+            fs0 = budget.stages.get(_latency.STAGE_WAL_FSYNC, 0.0)
+            ap0 = budget.stages.get(_latency.STAGE_APPLY, 0.0)
         try:
             with LongOperationTracker(
                     "raft.replicate",
@@ -720,18 +733,32 @@ class RaftConsensus:
                 return self._replicate_inner(op_type, ht_value, payload,
                                              timeout_s)
         finally:
-            _consensus_metrics()[0].increment(
-                (time.monotonic() - t0) * 1e3)
+            wall_ms = (time.monotonic() - t0) * 1e3
+            _consensus_metrics()[0].increment(wall_ms)
+            if budget is not None:
+                # attribution: the replicate wall MINUS the fsync/apply
+                # slices other threads recorded into this budget during
+                # the call — the three stages stay disjoint, so the
+                # decomposition telescopes instead of double-counting
+                inner = ((budget.stages.get(_latency.STAGE_WAL_FSYNC, 0.0)
+                          - fs0)
+                         + (budget.stages.get(_latency.STAGE_APPLY, 0.0)
+                            - ap0))
+                budget.record(_latency.STAGE_RAFT_REPLICATE,
+                              wall_ms - inner)
 
     def _replicate_inner(self, op_type: int, ht_value: int, payload: bytes,
                          timeout_s: float) -> OpId:
         ctx = current_trace_context()
+        budget = _latency.current_budget()
         with self._lock:
             if self.role != Role.LEADER:
                 raise NotLeader(self.leader_id)
             msg = self._append_unlocked(op_type, ht_value, payload)
             if ctx is not None:
                 self._trace_ctx_by_index[msg.index] = ctx
+            if budget is not None:
+                self._budget_by_index[msg.index] = budget
         TRACE("raft %s: replicating op %s (%d bytes)",
               self.config.peer_id, msg.op_id, len(payload))
         from yugabyte_tpu.utils import sync_point
@@ -789,7 +816,8 @@ class RaftConsensus:
             self.on_append_cb(msg)
         self.log.append_async(
             [msg.to_log_entry()],
-            callback=lambda err=None: self._on_local_durable(index, err))
+            callback=lambda err=None: self._on_local_durable(index, err),
+            budget=_latency.current_budget())
         return msg
 
     def _on_local_durable(self, index: int, err=None) -> None:
@@ -870,6 +898,12 @@ class RaftConsensus:
             for i in list(self._trace_ctx_by_index):
                 if i <= self.last_applied:
                     del self._trace_ctx_by_index[i]
+        if len(self._budget_by_index) > 512:
+            # same lifecycle: an applied entry's budget has already had
+            # its apply slice recorded (attribution is advisory)
+            for i in list(self._budget_by_index):
+                if i <= self.last_applied:
+                    del self._budget_by_index[i]
         if len(self._entries) <= self._CACHE_HIGH_WATER:
             return
         floor = self.last_applied - self._CACHE_TAIL
@@ -1144,6 +1178,7 @@ class RaftConsensus:
                         return
                     idx = self.last_applied + 1
                     msg = self._entries.get(idx)
+                    budget = self._budget_by_index.pop(idx, None)
                 if msg is None:
                     with self._lock:
                         msg = self._reload_from_wal_unlocked(idx)
@@ -1151,6 +1186,7 @@ class RaftConsensus:
                     # Consensus-internal; committed config may remove us.
                     self._on_config_committed(msg)
                 elif msg.op_type != OP_NOOP:
+                    apply_t0 = time.monotonic()
                     try:
                         self.apply_cb(msg)
                     except Exception as e:  # noqa: BLE001 — contained
@@ -1159,9 +1195,15 @@ class RaftConsensus:
                         # unapplied entry; stop here and let the commit
                         # worker's next round retry — applies resume once
                         # the DB recovers (ref: tablet FAILED containment).
+                        # (The popped budget is dropped: a deferred apply
+                        # loses its attribution slice — advisory only.)
                         TRACE("raft %s: apply of op %s deferred: %s",
                               self.config.peer_id, msg.op_id, e)
                         return
+                    if budget is not None:
+                        budget.record(
+                            _latency.STAGE_APPLY,
+                            (time.monotonic() - apply_t0) * 1e3)
                 with self._lock:
                     self.last_applied = idx
                     self._commit_cv.notify_all()
@@ -1216,6 +1258,7 @@ class RaftConsensus:
                         self._entries.pop(i, None)
                         self._ht_by_index.pop(i, None)
                         self._trace_ctx_by_index.pop(i, None)
+                        self._budget_by_index.pop(i, None)
                     self.log.truncate_after(msg.index - 1)
                     self._last_index = msg.index - 1
                     self._last_term = self._term_at_unlocked(self._last_index)

@@ -162,6 +162,34 @@ def trace_from_wire(wire: Any) -> Any:
             "sampled": bool(wire.get("sampled", True))}
 
 
+# ------------------------------------------------- latency-budget header
+# Serve-path latency attribution (utils/latency.py) rides next to the
+# trace header: a request whose caller carries a LatencyBudget marks the
+# op with LAT_HEADER_KEY={"op": <op>}; the server opens a matching
+# budget for the handler and returns its stage map under the same key in
+# the response, which the caller merges into its own budget. Absent
+# header = unattributed caller; both directions tolerate it, so the wire
+# stays backward compatible exactly like the trace header.
+
+LAT_HEADER_KEY = "lat"
+
+
+def lat_to_wire(budget: Any) -> Any:
+    """Request-side marker for an attribution-carrying op; None when the
+    caller holds no budget."""
+    if budget is None or not getattr(budget, "op", None):
+        return None
+    return {"op": str(budget.op)}
+
+
+def lat_op_from_wire(wire: Any) -> Any:
+    """The op name of a request's latency header; None when absent or
+    malformed (old client)."""
+    if not isinstance(wire, dict) or not wire.get("op"):
+        return None
+    return str(wire["op"])
+
+
 # ---------------------------------------------------------------- sidecars
 # Bulk bytes values ride OUTSIDE the tagged payload as separate segments —
 # the reference's RPC sidecars (ref: src/yb/rpc/rpc_context.h AddRpcSidecar,

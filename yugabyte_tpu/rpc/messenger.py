@@ -27,9 +27,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from yugabyte_tpu.rpc.codec import (TRACE_HEADER_KEY, dumps, loads,
+from yugabyte_tpu.rpc.codec import (LAT_HEADER_KEY, TRACE_HEADER_KEY, dumps,
+                                    lat_op_from_wire, lat_to_wire, loads,
                                     trace_from_wire, trace_to_wire)
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import latency as _latency
 from yugabyte_tpu.utils.metrics import ROOT_REGISTRY, MetricRegistry
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE, Trace, current_trace_context
@@ -424,12 +426,26 @@ class _ClientConnection:
             # cross-node trace propagation: the receiver adopts this span
             # context so multi-hop requests stitch under one trace_id
             req_msg[TRACE_HEADER_KEY] = trace_ctx
+        budget = _latency.current_budget()
+        if budget is not None:
+            # latency attribution rides next to the trace header: mark
+            # the op so the server opens a matching budget, and stamp
+            # the budget's exemplar trace id while the context is live
+            lat_hdr = lat_to_wire(budget)
+            if lat_hdr is not None:
+                req_msg[LAT_HEADER_KEY] = lat_hdr
+            if budget.trace_id is None and trace_ctx is not None:
+                budget.trace_id = trace_ctx.get("trace_id")
+        t_enc = time.monotonic()
         try:
             _send_message(self.sock, self.write_lock, req_msg)
         except OSError as e:
             with self.lock:
                 self.pending.pop(call_id, None)
             raise ServiceUnavailable(f"{self.addr}: {e}") from e
+        if budget is not None:
+            budget.record(_latency.STAGE_WIRE_ENCODE,
+                          (time.monotonic() - t_enc) * 1e3)
         if not waiter["event"].wait(timeout=timeout_s):
             with self.lock:
                 self.pending.pop(call_id, None)
@@ -785,15 +801,19 @@ class Messenger:
             return
         t0 = time.monotonic()
         try:
-            self._dispatch(call.conn, call.write_lock, req, call.peer)
+            self._dispatch(call.conn, call.write_lock, req, call.peer,
+                           queue_ms=queue_ms)
         finally:
             self._note_timing(queue_ms, (time.monotonic() - t0) * 1e3)
 
     def _dispatch(self, conn: socket.socket, write_lock: threading.Lock,
-                  req: dict, peer=None) -> None:
+                  req: dict, peer=None, queue_ms: float = 0.0) -> None:
         resp = self._invoke(req["svc"], req["mth"], req["args"], peer=peer,
                             trace_ctx=trace_from_wire(
-                                req.get(TRACE_HEADER_KEY)))
+                                req.get(TRACE_HEADER_KEY)),
+                            lat_op=lat_op_from_wire(
+                                req.get(LAT_HEADER_KEY)),
+                            queue_ms=queue_ms)
         resp["id"] = req["id"]
         try:
             _send_message(conn, write_lock, resp)
@@ -835,13 +855,24 @@ class Messenger:
         return h
 
     def _invoke(self, svc: str, mth: str, args: dict, peer=None,
-                trace_ctx: Optional[dict] = None) -> dict:
+                trace_ctx: Optional[dict] = None,
+                lat_op: Optional[str] = None,
+                queue_ms: float = 0.0) -> dict:
         entry = {"svc": svc, "mth": mth, "start": time.time(),
                  "peer": f"{peer[0]}:{peer[1]}" if peer else "local"}
         with self._rpcz_lock:
             self._rpcz_seq += 1
             rid = self._rpcz_seq
             self._rpcz_inflight[rid] = entry
+        # Attribution-carrying request: open a server-side budget seeded
+        # with the service-queue wait. Handler-path stage sites (raft,
+        # WAL, storage) record into it via the contextvar, and the stage
+        # map rides the response's `lat` key back to the owning client.
+        budget = token = None
+        if lat_op is not None:
+            budget = _latency.LatencyBudget(lat_op)
+            budget.record(_latency.STAGE_RPC_QUEUE, queue_ms)
+            token = _latency.use_budget(budget)
         resp = None
         t0 = time.monotonic()
         try:
@@ -853,8 +884,19 @@ class Messenger:
                 entry["trace_id"] = span.trace_id
                 resp = self._invoke_inner(svc, mth, args)
         finally:
-            self._method_histogram(svc, mth).increment(
-                (time.monotonic() - t0) * 1e3)
+            wall_ms = (time.monotonic() - t0) * 1e3
+            if token is not None:
+                _latency.clear_budget(token)
+            if budget is not None and resp is not None:
+                # telescope the handler wall closed: whatever the stage
+                # sites did not claim is server_other, so the server map
+                # always sums to queue wait + handler wall
+                in_handler = budget.measured_ms() - budget.stages.get(
+                    _latency.STAGE_RPC_QUEUE, 0.0)
+                budget.record(_latency.STAGE_SERVER_OTHER,
+                              wall_ms - in_handler)
+                resp[LAT_HEADER_KEY] = budget.to_wire()
+            self._method_histogram(svc, mth).increment(wall_ms)
             # entry is fully populated BEFORE it is published — rpcz()
             # hands out references, so late mutation would race the
             # webserver's serialization
@@ -965,6 +1007,13 @@ class Messenger:
                 # ambiguity a real lost response produces
                 raise RpcTimeout(f"{svc}.{mth} to {addr}: response "
                                  "dropped (nemesis)")
+        lat = resp.get(LAT_HEADER_KEY)
+        if lat:
+            # fold the server's stage map into the caller's budget: the
+            # client e2e histogram decomposes into server-side stages
+            b = _latency.current_budget()
+            if b is not None:
+                b.merge(lat)
         code = Code(resp["code"])
         if code != Code.OK:
             raise RemoteError(Status(code, resp["err"]),

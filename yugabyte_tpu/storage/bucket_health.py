@@ -128,7 +128,7 @@ class _Rec:
                  "mismatch", "mismatch_reason", "quar_mark",
                  "probe_pending", "probe_started", "probe_tid",
                  "last_probe_t", "probe_backoff", "needs_native_gap",
-                 "probation_ok", "probes", "since")
+                 "probation_ok", "probes", "since", "last_change_wall")
 
     def __init__(self, now: float):
         self.state = COLD
@@ -153,7 +153,11 @@ class _Rec:
         self.probation_ok = 0
         self.probes: collections.deque = collections.deque(
             maxlen=_PROBE_HISTORY)
+        # `since` runs on the board clock (monotonic; durations);
+        # `last_change_wall` is the wall-clock transition timestamp the
+        # /healthz page shows (comparable across processes)
         self.since = now
+        self.last_change_wall = time.time()
 
 
 class _BoardQuarantine(_policy.BucketQuarantine):
@@ -212,6 +216,8 @@ class BucketHealthBoard:
         if frm == to:
             return
         r.state = to
+        r.since = self._clock()
+        r.last_change_wall = time.time()
         self._transitions.append({
             "t": time.time(), "family": key[0], "bucket": list(key[1]),
             "from": frm, "to": to, "why": why})
@@ -535,6 +541,7 @@ class BucketHealthBoard:
         state histogram, the open quarantine windows, the transition
         log, and the lifetime transition tally."""
         quar = self._registry.snapshot()  # outside the board lock
+        now = self._clock()
         with self._lock:
             keys = []
             hist = {s: 0 for s in STATES}
@@ -542,6 +549,9 @@ class BucketHealthBoard:
                 hist[r.state] += 1
                 rec = {"family": key[0], "bucket": list(key[1]),
                        "state": r.state,
+                       "time_in_state_s": round(max(0.0, now - r.since),
+                                                3),
+                       "last_transition_at": r.last_change_wall,
                        "device_rows_per_sec": round(r.device_rate, 1),
                        "native_rows_per_sec": round(r.native_rate, 1),
                        "device_obs": r.device_obs,

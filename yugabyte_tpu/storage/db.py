@@ -705,16 +705,25 @@ class DB:
                 (_time.monotonic() - t0) * 1e3)
 
     def _multi_get_inner(self, keys, read_ht, doc_key_lens=None):
+        import time as _time
+        from yugabyte_tpu.utils import latency as _latency
         read_ht = read_ht or HybridTime.kMax
         if not keys:
             return []
         if flags.get_flag("point_read_batched") \
                 and self._device_cache is not None \
                 and self.opts.device not in (None, "native"):
+            t0 = _time.monotonic()
             res = self._multi_get_device(keys, read_ht, doc_key_lens)
+            _latency.record_stage(_latency.STAGE_DEVICE_DISPATCH,
+                                  (_time.monotonic() - t0) * 1e3)
             if res is not None:
                 return res
-        return self._multi_get_native(keys, read_ht)
+        t0 = _time.monotonic()
+        res = self._multi_get_native(keys, read_ht)
+        _latency.record_stage(_latency.STAGE_HOST_FALLBACK,
+                              (_time.monotonic() - t0) * 1e3)
+        return res
 
     def _multi_get_native(self, keys, read_ht):
         """The CPU fallback: one native multi_get per key over a single

@@ -624,16 +624,24 @@ class Tablet:
             keys.extend(enumerated)
             dkls.extend([len(enc)] * len(enumerated))
         results = self.regular_db.multi_get(keys, ht, doc_key_lens=dkls)
+        from yugabyte_tpu.utils import latency as _latency
         rows = []
+        asm_s = fb_s = 0.0
         for ri, dk in enumerate(doc_keys):
             if ri in fallback:
+                t0 = time.monotonic()
                 rows.append(self.read_row(dk, ht, projection))
+                fb_s += time.monotonic() - t0
                 continue
             start, count = spans[ri]
+            t0 = time.monotonic()
             rows.append(self._assemble_flat_row(
                 dk, encs[ri], row_keys_by[ri],
                 results[start: start + count], ht, proj_ids,
                 cid_by_suffix))
+            asm_s += time.monotonic() - t0
+        _latency.record_stage(_latency.STAGE_ROW_ASSEMBLY, asm_s * 1e3)
+        _latency.record_stage(_latency.STAGE_HOST_FALLBACK, fb_s * 1e3)
         return rows
 
     def _assemble_flat_row(self, doc_key, enc: bytes, row_keys,

@@ -155,6 +155,10 @@ class TabletServer:
             # bucket) state, measured rates, probe history and the
             # transition log (storage/bucket_health.py)
             self.webserver.register_json("/healthz", self.healthz)
+            # /timeseriesz: the telemetry timebase — per-metric ring-
+            # buffer history with rates + sparklines, self-scraped by
+            # the in-process sampler (utils/timeseries.py)
+            self.webserver.register_json("/timeseriesz", self.timeseriesz)
 
     def _tablet_peers(self):
         return self.tablet_manager.peers()
@@ -167,6 +171,15 @@ class TabletServer:
         from yugabyte_tpu.storage.bucket_health import health_board
         return {"status": "ok", "server_id": self.server_id,
                 "bucket_health": health_board().snapshot()}
+
+    def timeseriesz(self) -> dict:
+        """The in-process time-series store: per-metric raw window,
+        rate-over-window and sparkline downsample, plus the store's
+        meta block (memory bound, sampler overhead, drop counts)."""
+        from yugabyte_tpu.utils.timeseries import timeseries_store
+        page = timeseries_store().page()
+        page["server_id"] = self.server_id
+        return page
 
     def _health_board_path(self) -> str:
         from yugabyte_tpu.utils import flags as _flags
@@ -268,6 +281,7 @@ class TabletServer:
         per-replica follower-read vouch status, and the overload block
         (bounded RPC queue + per-tablet write-pressure state)."""
         from yugabyte_tpu.ops.point_read import point_read_snapshot
+        from yugabyte_tpu.utils.latency import serve_path_attribution_page
         from yugabyte_tpu.utils.metrics import serve_path_snapshot
         tablets = []
         for peer in self.tablet_manager.peers():
@@ -279,6 +293,10 @@ class TabletServer:
             })
         return {"server_id": self.server_id,
                 "serve_path": serve_path_snapshot(),
+                # per-stage latency attribution: where a batched write /
+                # multi_read spends its end-to-end wall, as percentages
+                # of the e2e histogram (utils/latency.py)
+                "attribution": serve_path_attribution_page(),
                 "point_reads": point_read_snapshot(),
                 "overload": self.overloadz(),
                 "tablets": tablets}
@@ -592,6 +610,19 @@ class TabletServer:
         self.tablet_manager.open_existing()
         self.memory_manager.init()
         self.maintenance_manager.init()
+        # telemetry timebase: register this server's scrape sources on
+        # the process store and ref-count the sampler thread up. The
+        # sources take their own snapshots — the serve path never sees
+        # the store's lock.
+        from yugabyte_tpu.utils.timeseries import timeseries_store
+        ts = timeseries_store()
+        ts.register_registry(f"server.{self.server_id}", self.metrics)
+        ts.register_source(f"overload.{self.server_id}",
+                           self._overload_series)
+        ts.register_source(f"context.{self.server_id}",
+                           self._context_series)
+        ts.start()
+        self._timeseries_started = True
         if self.opts.master_addrs:
             # Register before serving so the master knows our address by the
             # time it places tablets here.
@@ -639,7 +670,46 @@ class TabletServer:
                         return True
         return False
 
+    def _overload_series(self) -> dict:
+        """Flat numeric series of the overload block (queue depth,
+        shed counters, memstore consumption) for the time-series
+        sampler."""
+        snap = self.overloadz()
+        out = {}
+        for k, v in (snap.get("rpc") or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"rpc.{k}"] = float(v)
+        mem = snap.get("memstore") or {}
+        out["memstore.consumption_bytes"] = float(
+            mem.get("consumption_bytes") or 0)
+        out["memstore.limit_bytes"] = float(mem.get("limit_bytes") or 0)
+        out["write_throttle_rejections.total"] = float(
+            snap.get("write_throttle_rejections_total") or 0)
+        return out
+
+    def _context_series(self) -> dict:
+        """Flat numeric series of the shared execution context: HBM
+        device-cache residency and compaction-pool queue state."""
+        ctx = self.exec_context
+        out = {}
+        if ctx is None:
+            return out
+        if ctx.device_cache is not None:
+            for k, v in ctx.device_cache.snapshot().items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"device_cache.{k}"] = float(v)
+        pool = getattr(ctx, "compaction_pool", None)
+        if pool is not None:
+            for k, v in pool.snapshot().items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"pool.{k}"] = float(v)
+        return out
+
     def shutdown(self) -> None:
+        if getattr(self, "_timeseries_started", False):
+            self._timeseries_started = False
+            from yugabyte_tpu.utils.timeseries import timeseries_store
+            timeseries_store().stop()
         with self._addr_lock:
             self._shutting_down = True
             pollers = list(getattr(self, "_pollers", {}).values())

@@ -63,10 +63,23 @@ class Gauge:
 
 
 class Histogram:
-    """Log-bucketed histogram (2% default precision), like the reference's HdrHistogram."""
+    """Log-bucketed histogram (2% default precision), like the reference's HdrHistogram.
+
+    Observations may carry an *exemplar* — an opaque reference (here: a
+    trace id) tying a recorded value back to its origin. Exemplar storage
+    is bounded: the `_EXEMPLAR_KEEP` most recent plus the one attached to
+    the largest observation so far, so a p99 outlier on /servez stays
+    click-through to /tracez no matter how much traffic followed it.
+    Exemplars surface ONLY in the JSON exposition: the classic Prometheus
+    text format 0.0.4 has no exemplar syntax, so keeping them out of
+    `to_prometheus` is what keeps exemplar-bearing histograms
+    grammar-valid there.
+    """
+
+    _EXEMPLAR_KEEP = 5
 
     __slots__ = ("name", "help", "_counts", "_lock", "_total_sum", "_total_count",
-                 "_min", "_max", "_growth")
+                 "_min", "_max", "_growth", "_exemplars", "_max_exemplar")
 
     def __init__(self, name: str, help: str = "", growth: float = 1.02):
         self.name = name
@@ -78,13 +91,15 @@ class Histogram:
         self._total_count = 0
         self._min = math.inf
         self._max = -math.inf
+        self._exemplars: List[Dict[str, object]] = []
+        self._max_exemplar: Optional[Dict[str, object]] = None
 
     def _bucket(self, v: float) -> int:
         if v <= 0:
             return -1
         return int(math.log(v) / self._growth)
 
-    def increment(self, v: float) -> None:
+    def increment(self, v: float, exemplar: Optional[str] = None) -> None:
         b = self._bucket(v)
         with self._lock:
             self._counts[b] = self._counts.get(b, 0) + 1
@@ -92,6 +107,22 @@ class Histogram:
             self._total_count += 1
             self._min = min(self._min, v)
             self._max = max(self._max, v)
+            if exemplar is not None:
+                ex = {"value": v, "trace_id": exemplar}
+                self._exemplars.append(ex)
+                if len(self._exemplars) > self._EXEMPLAR_KEEP:
+                    del self._exemplars[0]
+                if self._max_exemplar is None or v >= self._max_exemplar["value"]:
+                    self._max_exemplar = ex
+
+    def exemplars(self) -> List[Dict[str, object]]:
+        """Bounded exemplar snapshot: recent observations first, the
+        max-valued one guaranteed present (it may also be recent)."""
+        with self._lock:
+            out = list(self._exemplars)
+            if self._max_exemplar is not None and self._max_exemplar not in out:
+                out.append(self._max_exemplar)
+            return out
 
     def percentile(self, p: float) -> float:
         with self._lock:
@@ -107,6 +138,22 @@ class Histogram:
 
     def mean(self) -> float:
         return self._total_sum / self._total_count if self._total_count else 0.0
+
+    def snapshot_dict(self) -> Dict[str, object]:
+        """JSON-ready point-in-time summary (observability pages that
+        render one histogram inline rather than a whole registry)."""
+        out = {
+            "count": self.count(), "sum": round(self._total_sum, 3),
+            "mean": round(self.mean(), 3), "min": self.min(),
+            "max": self.max(),
+            "p50": round(self.percentile(50), 3),
+            "p95": round(self.percentile(95), 3),
+            "p99": round(self.percentile(99), 3),
+        }
+        ex = self.exemplars()
+        if ex:
+            out["exemplars"] = ex
+        return out
 
     def count(self) -> int:
         return self._total_count
@@ -218,11 +265,16 @@ def registries_to_json_obj(registries: Iterable[MetricRegistry]) -> list:
             metrics = []
             for m in ent_metrics:
                 if isinstance(m, Histogram):
-                    metrics.append({
+                    entry = {
                         "name": m.name, "total_count": m.count(), "mean": m.mean(),
                         "min": m.min(), "max": m.max(),
+                        "percentile_50": m.percentile(50),
                         "percentile_95": m.percentile(95), "percentile_99": m.percentile(99),
-                    })
+                    }
+                    ex = m.exemplars()
+                    if ex:
+                        entry["exemplars"] = ex
+                    metrics.append(entry)
                 else:
                     metrics.append({"name": m.name, "value": m.value()})
             out.append({"type": ent.entity_type, "id": ent.entity_id,
@@ -241,7 +293,12 @@ def registries_to_prometheus(registries: Iterable[MetricRegistry]) -> str:
       - label values are escaped (quotes, backslashes, newlines);
       - histograms expose as summaries (quantile samples + _sum/_count)
         plus separate `<name>_min`/`<name>_max` gauge families (a summary
-        family itself may only carry the quantile/_sum/_count samples).
+        family itself may only carry the quantile/_sum/_count samples);
+      - histogram exemplars are NOT emitted here: text format 0.0.4 has
+        no exemplar syntax (`# {...}` trailers are an OpenMetrics-only
+        extension), so exemplar-bearing histograms expose exactly like
+        plain ones and the output stays grammar-valid. Exemplars ride
+        the JSON exposition (`registries_to_json_obj`) instead.
     """
     # family name -> (type, help, [sample lines])
     families: "Dict[str, Tuple[str, str, List[str]]]" = {}
