@@ -34,6 +34,32 @@ except AttributeError:
 import pytest
 
 
+def pytest_configure(config):
+    """Arm the race sanitizer when the environment asks (`YBSAN=1
+    pytest ...`): the vector-clock detector patches the sync vocabulary
+    and every guarded-by / @ybsan.shadow class before any test runs."""
+    from yugabyte_tpu.utils import ybsan as _shim
+    if _shim.enabled():
+        import tools.sanitizer
+        tools.sanitizer.arm()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The armed gate: any race report whose fingerprint is not
+    justified in tools/analysis/baseline.txt fails the whole session
+    (wrap_session returns session.exitstatus after this hook)."""
+    from yugabyte_tpu.utils import ybsan as _shim
+    if not _shim.armed():
+        return
+    import tools.sanitizer
+    failures = tools.sanitizer.session_gate()
+    if failures:
+        print("\n=== ybsan: unbaselined race reports ===", file=sys.stderr)
+        for f in failures:
+            print(f, file=sys.stderr)
+        session.exitstatus = 1
+
+
 @pytest.fixture(autouse=True)
 def _fresh_bucket_health_board():
     """The bucket-health board is process-global by design (one routing

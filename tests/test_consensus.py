@@ -427,8 +427,10 @@ class TestTabletPeer:
             leader.write([write_op(h.schema, "stable", 1)])
             h.transport.isolate("ts0/t1")
             new = h.elect("ts1")
-            wait_for(lambda: new.raft.last_applied >= new.raft.commit_index
-                     and new.raft.commit_index >= 1, msg="new leader caught up")
+            def _caught_up():
+                ci, la = new.raft.commit_progress()
+                return la >= ci and ci >= 1
+            wait_for(_caught_up, msg="new leader caught up")
             row = new.read_row(DocKey(range_components=("stable",)))
             assert row is not None and row.to_dict(h.schema)["v"] == 1
             new.write([write_op(h.schema, "after-failover", 2)])

@@ -103,11 +103,13 @@ def test_heartbeat_messages_scale_with_peers_not_tablets(tmp_path):
         time.sleep(0.5)   # settle into heartbeat-only steady state
         b0 = c.tservers[0].transport.batcher
         b1 = c.tservers[1].transport.batcher
-        hb0, ba0 = b0.heartbeats_in, b0.batches_out
-        hb1, ba1 = b1.heartbeats_in, b1.batches_out
+        hb0, ba0 = b0.counters()
+        hb1, ba1 = b1.counters()
         time.sleep(2.0)
-        hbs = (b0.heartbeats_in - hb0) + (b1.heartbeats_in - hb1)
-        rpcs = (b0.batches_out - ba0) + (b1.batches_out - ba1)
+        hb0b, ba0b = b0.counters()
+        hb1b, ba1b = b1.counters()
+        hbs = (hb0b - hb0) + (hb1b - hb1)
+        rpcs = (ba0b - ba0) + (ba1b - ba1)
         assert hbs > 50, "expected a steady heartbeat stream"
         # O(tablets) heartbeats collapsed into far fewer wire messages;
         # with a 3ms window and 50ms interval the floor is ~2 RPCs per

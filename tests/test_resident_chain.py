@@ -14,6 +14,7 @@ coherent and zero leaked pins.
 
 import os
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -24,6 +25,9 @@ from test_run_merge import _make_run  # noqa: E402
 from yugabyte_tpu.ops import device_faults  # noqa: E402
 from yugabyte_tpu.ops.slabs import ValueArray  # noqa: E402
 from yugabyte_tpu.storage import compaction as compaction_mod  # noqa: E402
+from yugabyte_tpu.storage import integrity  # noqa: F401,E402 (registers
+#   shadow_verify_sample — without it the file only passes when another
+#   test module imported integrity first)
 from yugabyte_tpu.storage import native_engine  # noqa: E402
 from yugabyte_tpu.storage import offload_policy  # noqa: E402
 from yugabyte_tpu.storage.device_cache import DeviceSlabCache  # noqa: E402
@@ -122,8 +126,19 @@ def test_chained_l0_l1_l2_byte_identical_zero_decode(tmp_path):
     for fid, r in zip((2, 3), readers_b):
         export_reader(rc, fid, r)
 
+    # the decode/ingest counters are PROCESS-global: daemon threads a
+    # prior suite leaked (remote-bootstrap readers, CDC pollers winding
+    # down) can still be decoding blocks when this test starts. Open the
+    # flat-counter window only after one quiet 250ms interval.
+    deadline = time.monotonic() + 10.0
     blocks0 = _block_decode_counter().value()
     ingest0 = _ingest_counter().value()
+    while time.monotonic() < deadline:
+        time.sleep(0.25)
+        cur = (_block_decode_counter().value(), _ingest_counter().value())
+        if cur == (blocks0, ingest0):
+            break
+        blocks0, ingest0 = cur
 
     # deflake: the SAMPLED shadow verifier's oracle legitimately decodes
     # the inputs when a job is drawn (default 2%/job) — pin sampling off

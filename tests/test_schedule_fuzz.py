@@ -1,0 +1,46 @@
+"""Tier-2 schedule-perturbation harness: re-run the most concurrent
+tier-1 suites under hostile interleavings with ybsan armed.
+
+Each seed runs a subprocess pytest with `YBSAN=1 YBSAN_PERTURB=1`:
+sync_point.hit() injects seeded preemption sleeps and the switch
+interval shrinks to 10us, so thread schedules that CI timing would
+never produce get exercised. Exit code 0 requires BOTH every suite's
+own assertions (acked writes stay durable, failovers converge) AND the
+armed session gate (zero unbaselined race reports).
+
+tests/test_ybsan.py is deliberately absent from the suite list — its
+positive fixtures are races by construction.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SUITES = [
+    "tests/test_bucket_health.py",
+    "tests/test_compaction_pool.py",
+    "tests/test_multi_raft_and_compression.py",
+    "tests/test_consensus.py",
+]
+
+_SEEDS = [1, 2, 3]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _SEEDS)
+def test_schedule_fuzz_seed(seed):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               YBSAN="1",
+               YBSAN_PERTURB="1",
+               YBSAN_PERTURB_SEED=str(seed))
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", *_SUITES, "-q", "-m", "not slow",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        env=env, capture_output=True, text=True, timeout=540,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, (
+        f"seed {seed}: perturbed armed run failed (rc={r.returncode})\n"
+        + r.stdout[-4000:] + "\n" + r.stderr[-4000:])

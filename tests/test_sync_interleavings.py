@@ -58,7 +58,7 @@ def test_leader_change_during_in_flight_write(tmp_path):
         # diagnosed from the elect() dump: no starved threads, CANDIDATE
         # with completed-but-denied solicitations).
         wait_for(lambda: all(
-            h.peers[s].raft._last_index == leader.raft._last_index
+            h.peers[s].raft.last_op_id[1] == leader.raft.last_op_id[1]
             for s in ("ts1", "ts2")), timeout=60.0,
             msg="followers hold the full pre-partition log")
 

@@ -63,7 +63,7 @@ def test_split_end_to_end(cluster):
         ids = [l["tablet_id"] for l in locs]
         return (sorted(ids) == sorted(children)
                 and all(l["leader"] for l in locs)
-                and parent.tablet_id not in master.catalog.tablets)
+                and not master.catalog.has_tablet(parent.tablet_id))
 
     wait_for(split_settled, msg="children adopted + parent retired")
 

@@ -466,7 +466,7 @@ class TestFramework:
 
     def test_all_passes_have_unique_names(self):
         names = [p.name for p in ALL_PASSES]
-        assert len(names) == len(set(names)) == 10
+        assert len(names) == len(set(names)) == 11
 
     def test_update_baseline_refuses_unjustified(self, tmp_path):
         target = tmp_path / "mod.py"
@@ -1428,3 +1428,86 @@ def test_no_lock_order_cycles_observed():
     this pytest process runs through utils/lock_rank.py; by the time this
     module executes, the recorded acquisition graph must be acyclic."""
     lock_rank.assert_no_cycles()
+
+
+# ---------------------------------------------------------------------------
+# ybsan-coverage
+# ---------------------------------------------------------------------------
+
+class TestYbsanCoverage:
+    def PASS(self):
+        from tools.analysis.passes.ybsan_coverage import YbsanCoveragePass
+        return [YbsanCoveragePass()]
+
+    def _run(self, src):
+        return _lint(src, self.PASS(), relpath="yugabyte_tpu/fixture.py")
+
+    def test_thread_spawner_without_optin_flagged(self):
+        out = self._run("""
+            import threading
+
+            class Spawner:
+                def __init__(self):
+                    self.state = {}
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+        """)
+        assert _codes(out) == ["unsanitized-shared-state"]
+
+    def test_pool_submit_without_optin_flagged(self):
+        out = self._run("""
+            class Submitter:
+                def __init__(self, pool):
+                    self.jobs = []
+                    pool.submit(self._work)
+        """)
+        assert _codes(out) == ["unsanitized-shared-state"]
+
+    def test_guarded_by_annotation_satisfies(self):
+        out = self._run("""
+            import threading
+
+            class Guarded:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = {}   # guarded-by: _lock
+                    self._t = threading.Thread(target=self._run)
+        """)
+        assert out == []
+
+    def test_shadow_decorator_satisfies(self):
+        out = self._run("""
+            import threading
+            from yugabyte_tpu.utils import ybsan
+
+            @ybsan.shadow(state=ybsan.SINGLE_WRITER)
+            class Shadowed:
+                def __init__(self):
+                    self.state = 0
+                    self._t = threading.Thread(target=self._run)
+        """)
+        assert out == []
+
+    def test_class_line_suppression(self):
+        out = self._run("""
+            import threading
+
+            class Confined:  # yblint: disable=ybsan-coverage — immutable payload handoff only
+                def __init__(self):
+                    self._t = threading.Thread(target=print)
+        """)
+        assert out == []
+
+    def test_non_concurrent_class_clean(self):
+        out = self._run("""
+            class Plain:
+                def __init__(self):
+                    self.state = {}
+        """)
+        assert out == []
+
+    def test_outside_package_not_applicable(self):
+        p = self.PASS()[0]
+        assert p.applies_to("yugabyte_tpu/storage/db.py")
+        assert not p.applies_to("tools/fixture.py")
+        assert not p.applies_to("tests/test_storage.py")

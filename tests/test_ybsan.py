@@ -1,0 +1,296 @@
+"""ybsan self-tests: seeded positive fixtures MUST be flagged, ordered
+negative fixtures MUST stay clean, the baseline round-trips, and the
+armed overhead stays bounded.
+
+This module arms/disarms the sanitizer per test, so it is EXCLUDED from
+the env-armed lanes (`YBSAN=1` runs, tools/check.sh --sanitize): its
+deliberate races would poison the session gate. The skipif below makes
+that exclusion self-enforcing.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import tools.sanitizer as san
+from tools.sanitizer import report as san_report
+from tools.sanitizer.detector import (CODE_GUARD_NOT_HELD,
+                                      CODE_READ_WRITE,
+                                      CODE_SINGLE_WRITER,
+                                      CODE_WRITE_WRITE)
+from yugabyte_tpu.utils import lock_rank
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("YBSAN", "") not in ("", "0", "false", "off"),
+    reason="positive fixtures would poison the armed session gate")
+
+
+@pytest.fixture
+def det():
+    """A fresh detector per test: arm, hand it out, disarm."""
+    d = san.arm()
+    yield d
+    san.disarm()
+
+
+def _codes(d):
+    return {r.code for r in d.reports()}
+
+
+def _spin(fn, name):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    return t
+
+
+class _Guarded:
+    """Fixture with a declared guard, instrumented manually."""
+
+    def __init__(self):
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "ybsan.test.guarded")
+        self.v = 0
+
+
+class _Bare:
+    """Fixture for stated lock-free disciplines."""
+
+    def __init__(self):
+        self.x = 0
+
+
+# --------------------------------------------------------------- positives
+# Completion signalling in positives uses an UNTRACKED Event: a patched
+# Thread.join would hand the main thread a happens-before edge and
+# legitimately hide the race.
+
+def test_positive_write_write(det):
+    san.patch_class(_Guarded, guards={"v": "_lock"})
+    obj = _Guarded()
+    done = [threading.Event(), threading.Event()]
+
+    def w(i):
+        obj.v = i          # no lock, no ordering between the writers
+        done[i].set()
+
+    _spin(lambda: w(0), "ybsan-w0")
+    _spin(lambda: w(1), "ybsan-w1")
+    for e in done:
+        assert e.wait(5.0)
+    time.sleep(0.05)
+    assert CODE_WRITE_WRITE in _codes(det)
+
+
+def test_positive_read_write(det):
+    san.patch_class(_Guarded, guards={"v": "_lock"})
+    obj = _Guarded()
+    done = threading.Event()
+
+    def w():
+        obj.v = 7
+        done.set()
+
+    _spin(w, "ybsan-w")
+    assert done.wait(5.0)   # untracked: no HB edge back to this thread
+    _ = obj.v
+    assert CODE_READ_WRITE in _codes(det)
+
+
+def test_positive_guarded_attr_without_lock(det):
+    san.patch_class(_Guarded, guards={"v": "_lock"})
+    obj = _Guarded()
+
+    def w():
+        with obj._lock:
+            obj.v += 1
+
+    ts = [_spin(w, f"ybsan-g{i}") for i in range(2)]
+    for t in ts:
+        t.join()            # HB edge: the bare read below cannot race
+    _ = obj.v               # ...but it drops the declared guard
+    assert CODE_GUARD_NOT_HELD in _codes(det)
+    assert CODE_READ_WRITE not in _codes(det)
+
+
+def test_positive_shadow_single_writer(det):
+    san.patch_class(_Bare, shadow_spec={"x": san.SINGLE_WRITER})
+    obj = _Bare()
+    done = [threading.Event(), threading.Event()]
+
+    def w(i):
+        obj.x = i
+        done[i].set()
+
+    _spin(lambda: w(0), "ybsan-s0")
+    _spin(lambda: w(1), "ybsan-s1")
+    for e in done:
+        assert e.wait(5.0)
+    time.sleep(0.05)
+    assert CODE_SINGLE_WRITER in _codes(det)
+
+
+# --------------------------------------------------------------- negatives
+
+def test_negative_hb_via_start_join(det):
+    san.patch_class(_Bare, shadow_spec={"x": san.SINGLE_WRITER})
+    obj = _Bare()
+    obj.x = 1
+
+    def w():
+        obj.x = 2
+
+    t = _spin(w, "ybsan-join")
+    t.join()
+    obj.x = 3               # ordered: start -> child -> join
+    assert not det.reports()
+
+
+def test_negative_hb_via_queue(det):
+    import queue
+    san.patch_class(_Bare, shadow_spec={"x": san.SINGLE_WRITER})
+    obj = _Bare()
+    q = queue.Queue()
+
+    def producer():
+        obj.x = 10
+        q.put("token")
+
+    def consumer():
+        q.get()
+        obj.x = 11          # ordered through the channel
+
+    t1 = _spin(producer, "ybsan-prod")
+    t2 = _spin(consumer, "ybsan-cons")
+    t1.join()
+    t2.join()
+    assert not det.reports()
+
+
+def test_negative_hb_via_tracked_lock(det):
+    san.patch_class(_Guarded, guards={"v": "_lock"})
+    obj = _Guarded()
+
+    def w():
+        for _ in range(20):
+            with obj._lock:
+                obj.v += 1
+
+    ts = [_spin(w, f"ybsan-l{i}") for i in range(3)]
+    for t in ts:
+        t.join()
+    with obj._lock:
+        assert obj.v == 60
+    assert not det.reports()
+
+
+def test_negative_hb_via_condition(det):
+    """Condition HB flows through its tracked inner lock."""
+    san.patch_class(_Bare, shadow_spec={"x": san.SINGLE_WRITER})
+    obj = _Bare()
+    cond = threading.Condition(
+        lock_rank.tracked(threading.Lock(), "ybsan.test.cond"))
+    ready = [False]
+
+    def producer():
+        with cond:
+            obj.x = 1
+            ready[0] = True
+            cond.notify()
+
+    def consumer():
+        with cond:
+            while not ready[0]:
+                cond.wait(5.0)
+            obj.x = 2       # ordered: notify released, wait re-acquired
+
+    t2 = _spin(consumer, "ybsan-cwait")
+    t1 = _spin(producer, "ybsan-cnotify")
+    t1.join()
+    t2.join()
+    assert not det.reports()
+
+
+# ------------------------------------------------------ baseline round-trip
+
+def test_baseline_round_trip(det, tmp_path):
+    """A justified fingerprint moves a report from `new` to `known`."""
+    san.patch_class(_Bare, shadow_spec={"x": san.SINGLE_WRITER})
+    obj = _Bare()
+    done = [threading.Event(), threading.Event()]
+
+    def w(i):
+        obj.x = i
+        done[i].set()
+
+    _spin(lambda: w(0), "ybsan-b0")
+    _spin(lambda: w(1), "ybsan-b1")
+    for e in done:
+        assert e.wait(5.0)
+    time.sleep(0.05)
+    reps = det.reports()
+    assert reps
+    new, known = san_report.split_reports(reps, None)
+    assert new and not known
+
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("# --- pass: ybsan ---\n" + "\n".join(
+        san_report.to_finding(r).fingerprint
+        + "  # test fixture: deliberately racy"
+        for r in reps) + "\n")
+    new, known = san_report.split_reports(reps, str(bl))
+    assert not new and len(known) == len(reps)
+
+
+def test_race_reports_merge_into_lock_rank(det):
+    """Latched races surface through the merged lock_rank violation
+    report alongside lock-order cycles."""
+    before = len(lock_rank.race_violations())
+    san.patch_class(_Bare, shadow_spec={"x": san.SINGLE_WRITER})
+    obj = _Bare()
+    done = [threading.Event(), threading.Event()]
+
+    def w(i):
+        obj.x = i
+        done[i].set()
+
+    _spin(lambda: w(0), "ybsan-m0")
+    _spin(lambda: w(1), "ybsan-m1")
+    for e in done:
+        assert e.wait(5.0)
+    time.sleep(0.05)
+    assert det.reports()
+    races = lock_rank.race_violations()
+    assert len(races) > before
+    assert any("[ybsan/" in r for r in races[before:])
+    assert races[-1] in lock_rank.violations()
+
+
+# ------------------------------------------------------------ overhead bound
+
+@pytest.mark.slow
+def test_armed_overhead_bound(tmp_path):
+    """Arming must cost <= 2.5x wall on a concurrency-heavy subset."""
+    suites = ["tests/test_txn_coordinator.py", "tests/test_backoff.py"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("YBSAN", None)
+
+    def run(extra_env):
+        t0 = time.monotonic()
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", *suites, "-q",
+             "-p", "no:cacheprovider", "-p", "no:randomly"],
+            env=dict(env, **extra_env), capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stdout + r.stderr
+        return time.monotonic() - t0
+
+    cold = run({})          # warm caches so the armed run isn't penalized
+    base = run({})
+    armed = run({"YBSAN": "1"})
+    del cold
+    assert armed <= 2.5 * base, (
+        f"armed {armed:.2f}s vs unarmed {base:.2f}s exceeds 2.5x")

@@ -402,6 +402,17 @@ def run_analysis(root: str = REPO_ROOT,
         # a scoped run can't see findings outside the file set, so
         # baseline entries it didn't match are not evidence of staleness
         stale = []
+    else:
+        # staleness is per-pass evidence: entries for passes this run
+        # did not execute — a `--passes` subset, or dynamic-only passes
+        # like ybsan whose findings exist only in armed pytest runs —
+        # cannot be judged by it
+        if passes is None:
+            from tools.analysis.passes import ALL_PASSES
+            passes = ALL_PASSES
+        ran = {p.name for p in passes}
+        stale = [fp for fp in stale
+                 if len(parts := fp.split("|", 2)) > 1 and parts[1] in ran]
     return AnalysisResult(findings, new, known, stale)
 
 

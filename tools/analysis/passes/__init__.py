@@ -16,6 +16,7 @@ from tools.analysis.passes.metric_names import MetricNamesPass
 from tools.analysis.passes.resource_lifetime import ResourceLifetimePass
 from tools.analysis.passes.swallowed_errors import SwallowedErrorsPass
 from tools.analysis.passes.wire_drift import WireDriftPass
+from tools.analysis.passes.ybsan_coverage import YbsanCoveragePass
 
 ALL_PASSES = (
     JitTraceSafetyPass(),
@@ -28,6 +29,7 @@ ALL_PASSES = (
     ResourceLifetimePass(),
     WireDriftPass(),
     KernelContractsPass(),
+    YbsanCoveragePass(),
 )
 
 

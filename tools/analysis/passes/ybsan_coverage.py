@@ -1,0 +1,113 @@
+"""ybsan-coverage: every concurrent class opts into the sanitizer.
+
+The race detector (tools/sanitizer) can only check state it knows
+about: attributes named by a `# guarded-by:` annotation (auto-patched
+at arm time) or declared via `@ybsan.shadow(...)` (stated lock-free
+discipline). A class that spawns threads or shares threadpool state
+with NEITHER is invisible to the armed run — its races simply cannot
+be caught, which is exactly the gap this pass closes.
+
+A ClassDef is flagged (`unsanitized-shared-state`) when its body:
+
+  - constructs a thread        (`threading.Thread(...)` / `Thread(...)`),
+  - constructs a shared pool   (`PriorityThreadPool(...)`), or
+  - submits work to a pool     (`<x>.submit(...)`),
+
+and the class carries neither a `# guarded-by:` annotation anywhere in
+its body nor an `@ybsan.shadow(...)` decorator.
+
+Satisfying the pass is a real commitment, not a checkbox: a new
+`# guarded-by:` annotation is immediately enforced lexically by the
+lock-discipline pass AND dynamically by ybsan; a new `@ybsan.shadow`
+discipline is enforced on every armed run. A class whose shared state
+is genuinely out of scope (e.g. it only hands off immutable payloads)
+suppresses with `# yblint: disable=ybsan-coverage` on the class line
+plus a trailing justification, or a justified baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analysis.core import AnalysisPass, FileContext, Finding
+from tools.analysis.passes.lock_discipline import _GUARDED_RE
+
+PASS_NAME = "ybsan-coverage"
+
+DEFAULT_DIRS = ("yugabyte_tpu",)
+
+_THREAD_CTORS = {"Thread", "Timer"}
+_POOL_CTORS = {"PriorityThreadPool", "ThreadPoolExecutor"}
+
+
+def _call_trigger(node: ast.Call) -> Optional[str]:
+    """Why this call makes the enclosing class concurrent, or None."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id in _THREAD_CTORS:
+            return f"spawns a thread ({f.id}(...))"
+        if f.id in _POOL_CTORS:
+            return f"owns a thread pool ({f.id}(...))"
+    elif isinstance(f, ast.Attribute):
+        if f.attr in _THREAD_CTORS and isinstance(f.value, ast.Name) \
+                and f.value.id == "threading":
+            return f"spawns a thread (threading.{f.attr}(...))"
+        if f.attr in _POOL_CTORS:
+            return f"owns a thread pool ({f.attr}(...))"
+        if f.attr == "submit":
+            return "shares threadpool state (.submit(...))"
+    return None
+
+
+class YbsanCoveragePass(AnalysisPass):
+    name = PASS_NAME
+
+    def __init__(self, dirs=DEFAULT_DIRS):
+        self.dirs = tuple(d.rstrip("/") + "/" for d in dirs)
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.dirs)
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        # innermost enclosing class per concurrent call site
+        triggers: dict = {}  # id(ClassDef) -> (ClassDef, trigger, line)
+        for node in ctx.nodes_of(ast.Call):
+            why = _call_trigger(node)
+            if why is None:
+                continue
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    triggers.setdefault(id(anc), (anc, why, node.lineno))
+                    break
+        for cls, why, line in triggers.values():
+            if self._has_shadow_decorator(cls):
+                continue
+            if self._has_guard_annotation(ctx, cls):
+                continue
+            out.append(ctx.finding(
+                self.name, "unsanitized-shared-state", cls,
+                f"class {cls.name} {why} at line {line} but declares no "
+                f"`# guarded-by:` attribute and no @ybsan.shadow "
+                f"discipline — its shared state is invisible to the "
+                f"armed race sanitizer"))
+        return out
+
+    @staticmethod
+    def _has_shadow_decorator(cls: ast.ClassDef) -> bool:
+        for dec in cls.decorator_list:
+            f = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(f, ast.Attribute) and f.attr == "shadow":
+                return True
+            if isinstance(f, ast.Name) and f.id == "shadow":
+                return True
+        return False
+
+    @staticmethod
+    def _has_guard_annotation(ctx: FileContext, cls: ast.ClassDef) -> bool:
+        end = getattr(cls, "end_lineno", None) or cls.lineno
+        for lineno in range(cls.lineno, end + 1):
+            if _GUARDED_RE.search(ctx.line_text(lineno)):
+                return True
+        return False

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # tools/check.sh — the one tier-1 static-analysis entry point.
 #
-#   tools/check.sh            yblint (all ten passes, repo-clean vs the
+#   tools/check.sh            yblint (all eleven passes, repo-clean vs the
 #                             committed baseline, incl. the metric-name
 #                             lint and the kernel-contracts pass) + the
 #                             kernel-manifest drift check (committed
@@ -20,20 +20,29 @@
 #                             storage/offload_policy.py. The drift gate
 #                             itself always runs and always reads the
 #                             committed JSON.
-#   tools/check.sh --full     all of the above, the manifest
-#                             regeneration verify, then the full tier-1
-#                             pytest suite (tests/ -m 'not slow')
+#   tools/check.sh --sanitize the ybsan lane: re-run the concurrency-
+#                             heavy tier-1 suites with the race
+#                             sanitizer armed (YBSAN=1); any race
+#                             report not justified in
+#                             tools/analysis/baseline.txt exits 1
+#   tools/check.sh --full     all of the above (including --sanitize),
+#                             the manifest regeneration verify, then
+#                             the full tier-1 pytest suite
+#                             (tests/ -m 'not slow')
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 YBLINT_ARGS=()
 RUN_FULL=0
+RUN_SANITIZE=0
 CHANGED=0
 for a in "$@"; do
     case "$a" in
-        --changed) YBLINT_ARGS+=(--changed); CHANGED=1 ;;
-        --full)    RUN_FULL=1 ;;
-        *) echo "usage: tools/check.sh [--changed] [--full]" >&2; exit 2 ;;
+        --changed)  YBLINT_ARGS+=(--changed); CHANGED=1 ;;
+        --sanitize) RUN_SANITIZE=1 ;;
+        --full)     RUN_FULL=1; RUN_SANITIZE=1 ;;
+        *) echo "usage: tools/check.sh [--changed] [--sanitize] [--full]" >&2
+           exit 2 ;;
     esac
 done
 
@@ -105,6 +114,29 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_compaction_pool.py::test_pool_differential_byte_identical \
     -q -p no:cacheprovider
+
+if [ "$RUN_SANITIZE" = 1 ]; then
+    echo "== ybsan race-sanitizer lane (concurrency-heavy suites, armed) =="
+    # The session gate in tests/conftest.py flips the exit code to 1 on
+    # any race report whose fingerprint is not baseline-justified.
+    # test_ybsan.py is excluded by design: its positive fixtures are
+    # races by construction (its own skipif also enforces this). Two
+    # invocations: the cluster-heavy batch runs apart from the rest so
+    # leftover daemon threads don't compound the armed slowdown into
+    # election-timing flakes on a 1-core runner.
+    JAX_PLATFORMS=cpu YBSAN=1 python -m pytest \
+        tests/test_bucket_health.py tests/test_compaction_pool.py \
+        tests/test_multi_raft_and_compression.py tests/test_consensus.py \
+        tests/test_txn_coordinator.py tests/test_sync_interleavings.py \
+        tests/test_observability.py tests/test_telemetry.py \
+        -q -m 'not slow' -p no:cacheprovider -p no:randomly
+    # xcluster runs FIRST: its two-cluster election timing is the most
+    # sensitive to accumulated daemon threads under armed overhead
+    JAX_PLATFORMS=cpu YBSAN=1 python -m pytest \
+        tests/test_xcluster.py tests/test_mini_cluster.py \
+        tests/test_tablet_split.py tests/test_replica_movement.py \
+        -q -m 'not slow' -p no:cacheprovider -p no:randomly
+fi
 
 if [ "$RUN_FULL" = 1 ]; then
     echo "== tier-1 =="

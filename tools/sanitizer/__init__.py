@@ -1,0 +1,121 @@
+"""ybsan — happens-before race sanitizer for the yugabyte_tpu tree.
+
+Arming (`YBSAN=1 pytest ...`, or `arm()` from a test) installs a
+vector-clock happens-before detector behind the instrumentation shim
+(yugabyte_tpu/utils/ybsan.py) and patches:
+
+- threading.Thread start/join and queue.Queue put/get (HB edges);
+- every class the `# guarded-by` annotation index names (shadow cells
+  + lock-possession checks, auto-discovered with the lock-discipline
+  pass's own collection logic);
+- every `@ybsan.shadow` opt-in class (stated-discipline checks).
+
+TrackedLock acquire/release and threadpool submit/execute report
+through the shim from inside the package — no patching needed.
+
+The armed gate: tests/conftest.py calls `session_gate()` at pytest
+session finish; any race report whose fingerprint is not justified in
+tools/analysis/baseline.txt fails the run. See README "Concurrency
+sanitizer".
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+from tools.sanitizer.detector import Detector, RaceReport
+from tools.sanitizer.instrument import Instrumenter
+from yugabyte_tpu.utils import ybsan as _shim
+
+# re-exported discipline vocabulary
+SINGLE_WRITER = _shim.SINGLE_WRITER
+SINGLE_WRITER_PER_KEY = _shim.SINGLE_WRITER_PER_KEY
+PUBLISHER_CONSUMER = _shim.PUBLISHER_CONSUMER
+shadow = _shim.shadow
+
+_detector: Optional[Detector] = None
+_instrumenter: Optional[Instrumenter] = None
+
+
+class _Hooks:
+    """The table installed into the shim: detector edges + shadow
+    patching for classes decorated after arming."""
+
+    def __init__(self, det: Detector, ins: Instrumenter) -> None:
+        self.lock_acquired = det.lock_acquired
+        self.lock_releasing = det.lock_releasing
+        self.bind_task = det.bind_task
+        self.patch_shadow = ins.patch_shadow
+
+
+def armed() -> bool:
+    return _detector is not None
+
+
+def enabled() -> bool:
+    return _shim.enabled()
+
+
+def arm() -> Detector:
+    """Idempotent: install the detector and apply every patch family."""
+    global _detector, _instrumenter
+    if _detector is not None:
+        return _detector
+    det = Detector()
+    ins = Instrumenter(det)
+    pre_registered = _shim.install(_Hooks(det, ins))
+    ins.patch_globals()
+    missed = ins.patch_annotated()
+    for cls, spec in pre_registered:
+        ins.patch_shadow(cls, spec)
+    if missed:
+        print("ybsan: arm() could not instrument: "
+              + ", ".join(missed), file=sys.stderr)
+    _detector, _instrumenter = det, ins
+    return det
+
+
+def disarm() -> None:
+    global _detector, _instrumenter
+    if _instrumenter is not None:
+        _instrumenter.unpatch_all()
+    _shim.install(None)
+    _detector = _instrumenter = None
+
+
+def detector() -> Optional[Detector]:
+    return _detector
+
+
+def reports() -> List[RaceReport]:
+    return _detector.reports() if _detector is not None else []
+
+
+def reset() -> None:
+    if _detector is not None:
+        _detector.reset()
+
+
+def patch_class(cls: type, guards: Optional[Dict[str, str]] = None,
+                shadow_spec: Optional[Dict[str, str]] = None) -> None:
+    """Manual instrumentation for test fixtures (classes outside the
+    yugabyte_tpu annotation index)."""
+    if _instrumenter is None:
+        raise RuntimeError("ybsan is not armed")
+    _instrumenter.patch_class(cls, guards=guards, shadow=shadow_spec)
+
+
+def session_gate(baseline_path: Optional[str] = None) -> List[str]:
+    """The armed-run gate: returns human-readable failures — race
+    reports not justified in the committed baseline (plus any detector
+    internal errors). Empty list = race-clean."""
+    from tools.analysis.core import DEFAULT_BASELINE
+    from tools.sanitizer import report as _report
+    if _detector is None:
+        return []
+    new, known = _report.split_reports(
+        reports(), baseline_path or DEFAULT_BASELINE)
+    if not new:
+        return []
+    return [_report.render_summary(new, known)]

@@ -1,0 +1,482 @@
+"""ybsan detector: vector-clock happens-before race detection.
+
+The model is the classic VC detector (FastTrack's epoch optimisation on
+the write side), sized for a CPython test process:
+
+- Every thread owns a vector clock (dict ybsan-tid -> logical clock).
+- Every synchronization object carries a clock the instrumentation
+  joins through: TrackedLock release publishes the holder's clock into
+  the lock, acquire joins it back (utils/lock_rank.py calls the shim);
+  Thread.start stamps the child, Thread.join joins the child's final
+  clock; queue.Queue put/get flow clocks through the channel;
+  threadpool submit/execute flows through `bind_task`. Condition
+  wait/notify orders through the condition's (tracked) inner lock: the
+  waiter re-acquires only after the notifier released, which is exactly
+  the edge the lock instrumentation records.
+- Every watched attribute owns a shadow cell: last-write epoch
+  (tid, clock, stack) plus a per-thread read map. An access that is
+  not HB-ordered after the conflicting epoch is a race; the report
+  carries BOTH stacks, the attribute, and the missing HB edge.
+
+Watched attributes come from two sources (tools/sanitizer/instrument.py
+wires both):
+- auto-discovery: every class attribute carrying a `# guarded-by`
+  annotation (the lock-discipline pass's own collection logic builds
+  the index) — these additionally check lock POSSESSION once the object
+  is observed shared;
+- `@ybsan.shadow` opt-in for deliberately lock-free structures — these
+  check the STATED discipline (single-writer[-per-key],
+  publisher/consumer) and never possession.
+
+False-positive posture: unknown is silent. A guard that is not a
+TrackedLock (so neither possession nor HB through it can be observed)
+suppresses checking of its attribute entirely; objects only ever
+touched by one thread never report; pre-sharing (__init__/publication)
+writes never report. Reports are latched and deduplicated by baseline
+fingerprint — tools/sanitizer/report.py turns them into yblint
+Findings against tools/analysis/baseline.txt.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from yugabyte_tpu.utils import ybsan as _shim  # noqa: E402
+
+CODE_WRITE_WRITE = "write-write"
+CODE_READ_WRITE = "read-write"
+CODE_GUARD_NOT_HELD = "guarded-by-without-lock"
+CODE_SINGLE_WRITER = "shadow-single-writer"
+CODE_ORDER = "shadow-order"
+CODE_INTERNAL = "ybsan-internal-error"
+
+_MAX_OBJECTS = 8192      # shadow-cell registry cap (FIFO eviction)
+_MAX_REPORTS = 400
+
+# the shared stack vocabulary lives in the shim so utils/lock_rank.py
+# renders its cycle reports identically without importing tools/
+_capture_stack = _shim.capture_stack
+format_stack = _shim.format_stack
+
+
+class RaceReport:
+    """One latched finding. `site` is the innermost in-repo frame of the
+    CURRENT access — the stable anchor report.py fingerprints on."""
+
+    __slots__ = ("code", "cls_name", "attr", "key", "detail",
+                 "cur_tid", "cur_thread", "cur_stack",
+                 "prev_tid", "prev_thread", "prev_stack")
+
+    def __init__(self, code: str, cls_name: str, attr: str,
+                 key: Optional[str], detail: str,
+                 cur_tid: int, cur_thread: str, cur_stack,
+                 prev_tid: Optional[int], prev_thread: Optional[str],
+                 prev_stack) -> None:
+        self.code = code
+        self.cls_name = cls_name
+        self.attr = attr
+        self.key = key
+        self.detail = detail
+        self.cur_tid = cur_tid
+        self.cur_thread = cur_thread
+        self.cur_stack = cur_stack or ()
+        self.prev_tid = prev_tid
+        self.prev_thread = prev_thread
+        self.prev_stack = prev_stack or ()
+
+    @property
+    def attr_label(self) -> str:
+        a = f"{self.cls_name}.{self.attr}"
+        return f"{a}[{self.key!r}]" if self.key is not None else a
+
+    def site(self) -> Tuple[str, int, str]:
+        """(relpath, line, func) of the innermost repo frame of the
+        current access (preferring non-test frames so the fingerprint
+        anchors on the racing production code, not the test driver)."""
+        best = None
+        for fn, lineno, func in self.cur_stack:
+            if not fn.startswith(REPO_ROOT):
+                continue
+            rel = os.path.relpath(fn, REPO_ROOT).replace(os.sep, "/")
+            if best is None:
+                best = (rel, lineno, func)
+            if not rel.startswith("tests/"):
+                return (rel, lineno, func)
+        return best or ("<unknown>", 0, "<unknown>")
+
+    def render(self) -> str:
+        head = (f"[ybsan/{self.code}] {self.attr_label}: {self.detail}\n"
+                f"  current access: thread {self.cur_thread!r} "
+                f"(ybsan tid {self.cur_tid})\n"
+                + format_stack(self.cur_stack))
+        if self.prev_stack or self.prev_tid is not None:
+            head += (f"\n  conflicting access: thread "
+                     f"{self.prev_thread!r} (ybsan tid {self.prev_tid})\n"
+                     + format_stack(self.prev_stack))
+        return head
+
+
+class _Cell:
+    """Shadow cell of one watched attribute (one dict key for per-key
+    disciplines): FastTrack-ish last-write epoch + read map."""
+
+    __slots__ = ("w_tid", "w_clock", "w_stack", "w_thread",
+                 "reads", "threads", "shared")
+
+    def __init__(self) -> None:
+        self.w_tid = -1
+        self.w_clock = 0
+        self.w_stack = ()
+        self.w_thread = ""
+        # reader tid -> (clock, stack, thread name)
+        self.reads: Dict[int, Tuple[int, tuple, str]] = {}
+        self.threads: set = set()
+        self.shared = False
+
+
+class _ThreadState:
+    __slots__ = ("tid", "vc", "held", "busy", "name")
+
+    def __init__(self, tid: int, name: str) -> None:
+        self.tid = tid
+        self.vc: Dict[int, int] = {tid: 1}
+        self.held: Dict[int, int] = {}   # id(TrackedLock) -> depth
+        self.busy = False
+        self.name = name
+
+    def tick(self) -> None:
+        self.vc[self.tid] = self.vc.get(self.tid, 0) + 1
+
+
+def _join(dst: Dict[int, int], src: Dict[int, int]) -> None:
+    for t, c in src.items():
+        if dst.get(t, 0) < c:
+            dst[t] = c
+
+
+class Detector:
+    """The process race detector. One instance is installed into the
+    yugabyte_tpu.utils.ybsan shim by tools.sanitizer.arm()."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()   # leaf lock: no callouts under it
+        self._tids = itertools.count(1)
+        self._tls = threading.local()
+        self._reports: List[RaceReport] = []
+        self._seen: set = set()         # dedupe key per latched report
+        # id(obj) -> (type, {(attr, key): _Cell}) — FIFO-capped
+        self._cells: Dict[int, Tuple[type, Dict[Tuple[str, Optional[str]],
+                                                _Cell]]] = {}
+        self._dead_keys: List[int] = []   # finalize-queue; GIL-atomic ops
+        self._internal_errors = 0
+
+    # ------------------------------------------------------ thread state
+    def state(self) -> _ThreadState:
+        st = getattr(self._tls, "st", None)
+        if st is None:
+            with self._lock:
+                tid = next(self._tids)
+            st = _ThreadState(tid, threading.current_thread().name)
+            self._tls.st = st
+        return st
+
+    # ------------------------------------------------- sync-object edges
+    def lock_acquired(self, lock) -> None:
+        st = self.state()
+        vc = getattr(lock, "ybsan_vc", None)
+        if vc:
+            _join(st.vc, vc)
+        st.held[id(lock)] = st.held.get(id(lock), 0) + 1
+
+    def lock_releasing(self, lock) -> None:
+        st = self.state()
+        vc = getattr(lock, "ybsan_vc", None)
+        if vc is None:
+            vc = {}
+            try:
+                lock.ybsan_vc = vc
+            except AttributeError:
+                return  # untracked duck type without the slot
+        _join(vc, st.vc)
+        st.tick()
+        n = st.held.get(id(lock), 0)
+        if n <= 1:
+            st.held.pop(id(lock), None)
+        else:
+            st.held[id(lock)] = n - 1
+
+    def thread_started(self, thread) -> None:
+        """Caller (the starter) stamps the child and advances."""
+        st = self.state()
+        thread._ybsan_parent_vc = dict(st.vc)
+        st.tick()
+
+    def thread_run_begin(self, thread) -> None:
+        st = self.state()
+        pvc = getattr(thread, "_ybsan_parent_vc", None)
+        if pvc:
+            _join(st.vc, pvc)
+
+    def thread_run_end(self, thread) -> None:
+        st = self.state()
+        thread._ybsan_end_vc = dict(st.vc)
+        st.tick()
+
+    def thread_joined(self, thread) -> None:
+        evc = getattr(thread, "_ybsan_end_vc", None)
+        if evc:
+            _join(self.state().vc, evc)
+
+    def channel_send(self, chan) -> None:
+        st = self.state()
+        with self._lock:
+            vc = getattr(chan, "_ybsan_vc", None)
+            if vc is None:
+                vc = {}
+                try:
+                    chan._ybsan_vc = vc
+                except AttributeError:
+                    return
+            _join(vc, st.vc)
+        st.tick()
+
+    def channel_recv(self, chan) -> None:
+        vc = getattr(chan, "_ybsan_vc", None)
+        if vc:
+            st = self.state()
+            with self._lock:
+                _join(st.vc, vc)
+
+    def bind_task(self, fn):
+        """Threadpool submit -> execute HB edge: the returned wrapper
+        joins the submitter's clock snapshot before running `fn`."""
+        st = self.state()
+        snap = dict(st.vc)
+        st.tick()
+
+        def _ybsan_task():
+            rst = self.state()
+            _join(rst.vc, snap)
+            return fn()
+
+        return _ybsan_task
+
+    # ------------------------------------------------------ shadow cells
+    def _cells_for(self, obj) -> Dict[Tuple[str, Optional[str]], _Cell]:
+        while self._dead_keys:
+            try:
+                dead = self._dead_keys.pop()
+            except IndexError:
+                break
+            self._cells.pop(dead, None)
+        key = id(obj)
+        ent = self._cells.get(key)
+        if ent is not None and ent[0] is type(obj):
+            return ent[1]
+        # new object (or id reuse by a different type): fresh cell map
+        cells: Dict[Tuple[str, Optional[str]], _Cell] = {}
+        self._cells[key] = (type(obj), cells)
+        # id() is an address: a dead object's id gets recycled, and a new
+        # SAME-type object at that address would inherit the corpse's
+        # cells and report false conflicts (observed on rpc client-conn
+        # churn). Queue eviction at collection time — the callback must
+        # NOT take the detector lock (gc can fire it mid-_access on the
+        # thread already holding it), so it only appends to a list and
+        # the next _cells_for drains it under the lock.
+        try:
+            weakref.finalize(obj, self._dead_keys.append, key)
+        except TypeError:
+            pass  # not weakref-able: FIFO cap + type check still apply
+        if len(self._cells) > _MAX_OBJECTS:
+            # FIFO eviction: dict preserves insertion order; losing old
+            # cells only loses history (false negatives, never noise)
+            self._cells.pop(next(iter(self._cells)))
+        return cells
+
+    def _holds_guard(self, st: _ThreadState, obj,
+                     guard: str) -> Optional[bool]:
+        """True/False = the current thread does/does not hold the
+        declared guard; None = possession is unobservable (skip)."""
+        try:
+            g = object.__getattribute__(obj, guard)
+        except AttributeError:
+            return None
+        if isinstance(g, threading.Condition):
+            g = getattr(g, "_lock", None)
+        # TrackedLock duck-typing (utils/lock_rank.py): the only lock
+        # kind whose possession the instrumentation can see
+        if g is not None and hasattr(g, "ybsan_vc") \
+                and hasattr(g, "name"):
+            return id(g) in st.held
+        return None
+
+    def _latch(self, rep: RaceReport) -> None:
+        site = rep.site()
+        dedupe = (rep.code, rep.cls_name, rep.attr, rep.key,
+                  site[0], site[2])
+        with self._lock:
+            if dedupe in self._seen or len(self._reports) >= _MAX_REPORTS:
+                return
+            self._seen.add(dedupe)
+            self._reports.append(rep)
+        # satellite: the merged lock_rank violation report + counters
+        from yugabyte_tpu.utils import lock_rank
+        lock_rank.record_race(rep.render())
+
+    def _hb_after(self, st: _ThreadState, tid: int, clock: int) -> bool:
+        return st.vc.get(tid, 0) >= clock
+
+    def access(self, obj, attr: str, is_write: bool,
+               guard: Optional[str] = None,
+               discipline: Optional[str] = None,
+               key: Optional[str] = None) -> None:
+        """One watched attribute access. Exactly one of guard/discipline
+        describes the declared protocol."""
+        st = self.state()
+        if st.busy:
+            return
+        st.busy = True
+        try:
+            self._access(st, obj, attr, is_write, guard, discipline, key)
+        except Exception as e:   # a sanitizer bug must not take the
+            # app down mid-test, but it must FAIL the run: latch it as
+            # its own loud report (never silently swallowed)
+            with self._lock:
+                self._internal_errors += 1
+                if CODE_INTERNAL not in self._seen:
+                    self._seen.add(CODE_INTERNAL)
+                    self._reports.append(RaceReport(
+                        CODE_INTERNAL, type(obj).__name__, attr, key,
+                        f"detector raised {type(e).__name__}: {e}",
+                        st.tid, st.name, _capture_stack(),
+                        None, None, ()))
+        finally:
+            st.busy = False
+
+    def _access(self, st: _ThreadState, obj, attr: str, is_write: bool,
+                guard: Optional[str], discipline: Optional[str],
+                key: Optional[str]) -> None:
+        if guard is not None:
+            held = self._holds_guard(st, obj, guard)
+            if held is None:
+                return   # unobservable guard: unknown is silent
+        else:
+            held = None
+        cls_name = type(obj).__name__
+        with self._lock:
+            cells = self._cells_for(obj)
+            cell = cells.get((attr, key))
+            if cell is None:
+                cell = cells[(attr, key)] = _Cell()
+            cell.threads.add(st.tid)
+            if len(cell.threads) > 1:
+                cell.shared = True
+            shared = cell.shared
+            w_tid, w_clock = cell.w_tid, cell.w_clock
+            w_stack, w_thread = cell.w_stack, cell.w_thread
+            readers = list(cell.reads.items()) if is_write else ()
+            clock_now = st.vc.get(st.tid, 0)
+            # Stack capture dominates armed overhead; the clock only
+            # advances at sync operations, so a same-epoch repeat access
+            # by the same thread reuses the first capture (the report
+            # shows the epoch's first site — epochs, not stacks, decide
+            # whether a conflict exists).
+            if is_write:
+                if cell.w_tid == st.tid and cell.w_clock == clock_now \
+                        and cell.w_stack:
+                    stack = cell.w_stack
+                else:
+                    stack = _capture_stack()
+                cell.w_tid, cell.w_clock = st.tid, clock_now
+                cell.w_stack, cell.w_thread = stack, st.name
+                cell.reads.clear()
+            else:
+                # read epochs only matter for write conflicts later;
+                # capture the stack so THAT report can show this side
+                prev = cell.reads.get(st.tid)
+                if prev is not None and prev[0] == clock_now:
+                    stack = prev[1]
+                else:
+                    stack = _capture_stack()
+                    cell.reads[st.tid] = (clock_now, stack, st.name)
+
+        # conflict checks outside the detector lock (latching re-takes it)
+        check_reads = discipline != _shim.SINGLE_WRITER and \
+            discipline != _shim.SINGLE_WRITER_PER_KEY
+        if w_tid >= 0 and w_tid != st.tid \
+                and not self._hb_after(st, w_tid, w_clock):
+            kind = CODE_WRITE_WRITE if is_write else CODE_READ_WRITE
+            if discipline in (_shim.SINGLE_WRITER,
+                              _shim.SINGLE_WRITER_PER_KEY):
+                if not is_write:
+                    kind = None   # racy reads tolerated by declaration
+                else:
+                    kind = CODE_SINGLE_WRITER
+            elif discipline == _shim.PUBLISHER_CONSUMER:
+                kind = CODE_SINGLE_WRITER if is_write else CODE_ORDER
+            if kind is not None:
+                self._latch(RaceReport(
+                    kind, cls_name, attr, key,
+                    self._edge_detail(st, w_tid, w_clock, guard,
+                                      discipline, "write"),
+                    st.tid, st.name, stack, w_tid, w_thread, w_stack))
+        if is_write and check_reads:
+            for r_tid, (r_clock, r_stack, r_thread) in readers:
+                if r_tid != st.tid \
+                        and not self._hb_after(st, r_tid, r_clock):
+                    self._latch(RaceReport(
+                        CODE_READ_WRITE, cls_name, attr, key,
+                        self._edge_detail(st, r_tid, r_clock, guard,
+                                          discipline, "read"),
+                        st.tid, st.name, stack, r_tid, r_thread,
+                        r_stack))
+                    break
+        # possession: only for guarded-by attrs, only once shared
+        if guard is not None and shared and held is False:
+            self._latch(RaceReport(
+                CODE_GUARD_NOT_HELD, cls_name, attr, key,
+                f"{'write' if is_write else 'read'} without holding the "
+                f"declared guard `{guard}` on a shared object "
+                f"(annotated `# guarded-by: {guard}`)",
+                st.tid, st.name, stack or _capture_stack(),
+                None, None, ()))
+
+    def _edge_detail(self, st: _ThreadState, o_tid: int, o_clock: int,
+                     guard: Optional[str], discipline: Optional[str],
+                     o_kind: str) -> str:
+        have = st.vc.get(o_tid, 0)
+        fix = (f"both sides must hold the declared guard `{guard}`"
+               if guard is not None else
+               f"declared discipline `{discipline}` requires an ordering "
+               f"edge (lock release/acquire, queue put/get, thread "
+               f"start/join)")
+        return (f"no happens-before edge from the conflicting {o_kind} "
+                f"(tid {o_tid} @ clock {o_clock}; this thread has only "
+                f"observed tid {o_tid} up to clock {have}) — {fix}")
+
+    # -------------------------------------------------------- inspection
+    def reports(self) -> List[RaceReport]:
+        with self._lock:
+            return list(self._reports)
+
+    def race_count(self) -> int:
+        with self._lock:
+            return len(self._reports)
+
+    def internal_errors(self) -> int:
+        with self._lock:
+            return self._internal_errors
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reports.clear()
+            self._seen.clear()
+            self._cells.clear()
+            self._internal_errors = 0

@@ -1,0 +1,46 @@
+"""Guarded-by annotation index: auto-discovery for ybsan.
+
+Reuses the lock-discipline pass's OWN collection logic (same regexes,
+same alias handling, same multi-line-assignment tolerance) over the
+yugabyte_tpu tree, so the set of attributes the static pass enforces
+lexically is exactly the set the runtime detector shadows — the two
+checkers can never drift apart on what "annotated" means.
+
+Output: [(module_name, class_qualname, {attr: guard})] for every class
+that declares at least one `# guarded-by:` attribute. Module-level
+guarded globals are excluded: CPython offers no attribute interception
+on modules without replacing the module type, and every module-level
+guard in the repo fronts a process singleton whose class is annotated
+anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from tools.analysis.core import (DEFAULT_TARGETS, REPO_ROOT,
+                                 _collect_files, _parse_context)
+from tools.analysis.passes.lock_discipline import (LockDisciplinePass,
+                                                   _Scope)
+
+
+def annotation_index(root: str = REPO_ROOT,
+                     targets=DEFAULT_TARGETS
+                     ) -> List[Tuple[str, str, Dict[str, str]]]:
+    out: List[Tuple[str, str, Dict[str, str]]] = []
+    lp = LockDisciplinePass()
+    for path, rel in sorted(_collect_files(root, targets)):
+        ctx, _errs = _parse_context(path, rel)
+        if ctx is None:
+            continue
+        class_scopes: Dict[ast.ClassDef, _Scope] = {}
+        module_scope = _Scope()
+        lp._collect(ctx, class_scopes, module_scope)
+        if not any(s.guards for s in class_scopes.values()):
+            continue
+        mod = rel[:-3].replace("/", ".")
+        for cls, scope in class_scopes.items():
+            if scope.guards:
+                out.append((mod, ctx.qualname(cls), dict(scope.guards)))
+    return out

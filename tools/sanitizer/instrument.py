@@ -1,0 +1,222 @@
+"""ybsan instrumentation: arm-time patches that feed the detector.
+
+Three patch families, all reversible (disarm() restores originals):
+
+1. Global sync vocabulary — threading.Thread start/join and
+   queue.Queue put/get are wrapped process-wide so thread lifecycle and
+   channel handoffs establish HB edges. (TrackedLock acquire/release
+   and threadpool submit/execute call the shim directly from
+   yugabyte_tpu/utils — no patching needed there.)
+
+2. Guarded-by classes — every class the annotation index names gets an
+   instrumented __setattr__/__getattribute__ pair that routes accesses
+   of its annotated attributes through detector.access() with the
+   declared guard.
+
+3. @ybsan.shadow classes — same interception, but carrying the stated
+   lock-free discipline instead of a guard; dict-valued attrs declared
+   SINGLE_WRITER_PER_KEY are wrapped in a ShadowDict so per-key writes
+   shadow individually.
+"""
+
+from __future__ import annotations
+
+import importlib
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tools.sanitizer import guard_index as _annotations
+from tools.sanitizer.detector import Detector
+from yugabyte_tpu.utils import ybsan as _shim
+
+_PER_KEY = _shim.SINGLE_WRITER_PER_KEY
+
+
+class ShadowDict(dict):
+    """Dict whose item accesses shadow per key (stages maps etc.)."""
+
+    __slots__ = ("_ybsan_owner", "_ybsan_attr", "_ybsan_disc",
+                 "_ybsan_det")
+
+    def __init__(self, data, owner, attr: str, disc: str,
+                 det: Detector) -> None:
+        super().__init__(data)
+        self._ybsan_owner = owner
+        self._ybsan_attr = attr
+        self._ybsan_disc = disc
+        self._ybsan_det = det
+
+    def _touch(self, key, is_write: bool) -> None:
+        if isinstance(key, str):
+            self._ybsan_det.access(self._ybsan_owner, self._ybsan_attr,
+                                   is_write,
+                                   discipline=_shim.SINGLE_WRITER,
+                                   key=key)
+
+    def __setitem__(self, key, value) -> None:
+        super().__setitem__(key, value)
+        self._touch(key, True)
+
+    def __getitem__(self, key):
+        v = super().__getitem__(key)
+        self._touch(key, False)
+        return v
+
+    def get(self, key, default=None):
+        v = super().get(key, default)
+        self._touch(key, False)
+        return v
+
+    def pop(self, key, *a):
+        v = super().pop(key, *a)
+        self._touch(key, True)
+        return v
+
+
+class Instrumenter:
+    """Owns every applied patch; arm() applies, disarm() reverts."""
+
+    def __init__(self, det: Detector) -> None:
+        self.det = det
+        self._patched: List[Tuple[type, Dict[str, object]]] = []
+        self._globals: List[Tuple[object, str, object]] = []
+
+    # ----------------------------------------------- global sync objects
+    def patch_globals(self) -> None:
+        det = self.det
+
+        orig_start = threading.Thread.start
+        orig_join = threading.Thread.join
+        orig_put = queue.Queue.put
+        orig_get = queue.Queue.get
+
+        def start(self):
+            det.thread_started(self)
+            orig_run = self.run
+
+            def _ybsan_run():
+                det.thread_run_begin(self)
+                try:
+                    orig_run()
+                finally:
+                    det.thread_run_end(self)
+
+            self.run = _ybsan_run
+            return orig_start(self)
+
+        def join(self, timeout=None):
+            orig_join(self, timeout)
+            if not self.is_alive():
+                det.thread_joined(self)
+
+        def put(self, item, block=True, timeout=None):
+            det.channel_send(self)
+            return orig_put(self, item, block, timeout)
+
+        def get(self, block=True, timeout=None):
+            item = orig_get(self, block, timeout)
+            det.channel_recv(self)
+            return item
+
+        for owner, name, fn in ((threading.Thread, "start", start),
+                                (threading.Thread, "join", join),
+                                (queue.Queue, "put", put),
+                                (queue.Queue, "get", get)):
+            self._globals.append((owner, name, owner.__dict__[name]))
+            setattr(owner, name, fn)
+
+    # -------------------------------------------------- class patching
+    def patch_class(self, cls: type,
+                    guards: Optional[Dict[str, str]] = None,
+                    shadow: Optional[Dict[str, str]] = None) -> None:
+        """Idempotent: a class already patched gets its spec merged, so
+        guarded-by auto-discovery and @ybsan.shadow compose."""
+        spec = getattr(cls, "_ybsan_spec", None)
+        if spec is not None and "_ybsan_spec" in cls.__dict__:
+            # in-place: the patched methods close over these exact
+            # containers, so a wholesale replacement would detach them
+            spec["guards"].update(guards or {})
+            spec["shadow"].update(shadow or {})
+            spec["watched"].update(set(spec["guards"])
+                                   | set(spec["shadow"]))
+            return
+        spec = {"guards": dict(guards or {}),
+                "shadow": dict(shadow or {})}
+        spec["watched"] = set(spec["guards"]) | set(spec["shadow"])
+        det = self.det
+        # every attribute access on the class pays for these lookups —
+        # close over locals, not spec[...] indirection
+        guard_map, shadow_map, watched = (spec["guards"], spec["shadow"],
+                                          spec["watched"])
+        access = det.access
+        orig_setattr = cls.__setattr__
+        orig_getattribute = cls.__getattribute__
+
+        def __setattr__(self, name, value):
+            if name in watched:
+                disc = shadow_map.get(name)
+                if disc == _PER_KEY and type(value) is dict:
+                    value = ShadowDict(value, self, name, disc, det)
+                orig_setattr(self, name, value)
+                access(self, name, True, guard=guard_map.get(name),
+                       discipline=disc)
+            else:
+                orig_setattr(self, name, value)
+
+        def __getattribute__(self, name):
+            value = orig_getattribute(self, name)
+            if name in watched:
+                disc = shadow_map.get(name)
+                if disc != _PER_KEY:   # per-key attrs shadow item-wise
+                    access(self, name, False, guard=guard_map.get(name),
+                           discipline=disc)
+            return value
+
+        saved = {"__setattr__": cls.__dict__.get("__setattr__"),
+                 "__getattribute__": cls.__dict__.get("__getattribute__"),
+                 "_ybsan_spec": None}
+        cls.__setattr__ = __setattr__
+        cls.__getattribute__ = __getattribute__
+        cls._ybsan_spec = spec
+        self._patched.append((cls, saved))
+
+    def patch_annotated(self) -> List[str]:
+        """Auto-discovery: patch every class carrying guarded-by
+        annotations. Returns 'module.Class' labels that could not be
+        patched (import failure / nested class), for the arm report."""
+        missed: List[str] = []
+        for mod_name, cls_name, guards in _annotations.annotation_index():
+            if "." in cls_name:
+                missed.append(f"{mod_name}.{cls_name} (nested)")
+                continue
+            try:
+                mod = importlib.import_module(mod_name)
+                cls = getattr(mod, cls_name)
+            except Exception as e:
+                missed.append(f"{mod_name}.{cls_name} ({e})")
+                continue
+            if isinstance(cls, type):
+                self.patch_class(cls, guards=guards)
+            else:
+                missed.append(f"{mod_name}.{cls_name} (not a class)")
+        return missed
+
+    def patch_shadow(self, cls: type, spec: Dict[str, str]) -> None:
+        self.patch_class(cls, shadow=spec)
+
+    # ------------------------------------------------------------ revert
+    def unpatch_all(self) -> None:
+        for owner, name, orig in reversed(self._globals):
+            setattr(owner, name, orig)
+        self._globals.clear()
+        for cls, saved in reversed(self._patched):
+            for name, orig in saved.items():
+                if orig is None:
+                    try:
+                        delattr(cls, name)
+                    except AttributeError:
+                        pass
+                else:
+                    setattr(cls, name, orig)
+        self._patched.clear()

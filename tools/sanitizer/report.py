@@ -1,0 +1,69 @@
+"""ybsan reporting: race reports -> yblint Findings -> baseline gate.
+
+A latched RaceReport becomes a `tools.analysis.core.Finding` with
+pass_name "ybsan", anchored at the innermost in-repo frame of the
+racing access — so its fingerprint (path | pass | code | symbol |
+normalized source line) rides the SAME committed baseline file the
+static passes use (tools/analysis/baseline.txt), with the same
+per-line justification contract. A deliberate benign race is baselined
+once, with a reason; everything else fails the armed run.
+"""
+
+from __future__ import annotations
+
+import linecache
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from tools.analysis.core import (DEFAULT_BASELINE, REPO_ROOT, Baseline,
+                                 Finding)
+from tools.sanitizer.detector import RaceReport
+
+PASS_NAME = "ybsan"
+
+
+def to_finding(rep: RaceReport) -> Finding:
+    rel, line, func = rep.site()
+    src = ""
+    if rel != "<unknown>":
+        src = linecache.getline(os.path.join(REPO_ROOT, rel), line).strip()
+    return Finding(path=rel, line=line, pass_name=PASS_NAME,
+                   code=rep.code,
+                   message=f"{rep.attr_label}: {rep.detail}",
+                   symbol=func, src=src)
+
+
+def findings(reports: Sequence[RaceReport]) -> List[Finding]:
+    return [to_finding(r) for r in reports]
+
+
+def split_reports(reports: Sequence[RaceReport],
+                  baseline_path: Optional[str] = DEFAULT_BASELINE
+                  ) -> Tuple[List[RaceReport], List[RaceReport]]:
+    """(new, baselined): reports whose fingerprint the committed
+    baseline does not / does justify."""
+    if baseline_path is None:
+        return list(reports), []
+    bl = Baseline.load(baseline_path)
+    new, known = [], []
+    budget = dict(bl.entries)
+    for rep in reports:
+        fp = to_finding(rep).fingerprint
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            known.append(rep)
+        else:
+            new.append(rep)
+    return new, known
+
+
+def render_summary(new: Sequence[RaceReport],
+                   known: Sequence[RaceReport]) -> str:
+    out: List[str] = []
+    for rep in new:
+        f = to_finding(rep)
+        out.append(f"{f.path}:{f.line}: " + rep.render())
+        out.append(f"  fingerprint: {f.fingerprint}")
+    out.append(f"ybsan: {len(new)} unbaselined race report(s), "
+               f"{len(known)} baseline-justified")
+    return "\n".join(out)

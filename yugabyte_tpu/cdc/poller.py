@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import StatusError
 from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils import ybsan
 
 flags.define_flag("xcluster_poll_interval_ms", 100,
                   "poll period of an idle xCluster consumer "
@@ -27,6 +28,9 @@ flags.define_flag("xcluster_poll_interval_ms", 100,
 flags.define_flag("xcluster_max_records_per_poll", 1024, "")
 
 
+@ybsan.shadow(_applied_through=ybsan.SINGLE_WRITER,
+              _source_tablet_id=ybsan.SINGLE_WRITER,
+              _source_replicas=ybsan.SINGLE_WRITER)
 class XClusterPoller:
     """One replicated target tablet's consumer loop."""
 

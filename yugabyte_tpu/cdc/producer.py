@@ -45,7 +45,8 @@ def get_changes(peer, from_index: int, max_records: int = 1000,
     """
     if emit_after is None:
         emit_after = from_index
-    committed = min(peer.raft.last_applied, peer.raft.commit_index)
+    ci, la = peer.raft.commit_progress()
+    committed = min(la, ci)
     records: List[dict] = []
     # pending transactional intents seen this scan: txn -> [(idx, key, val, wid)]
     pending: Dict[bytes, List[Tuple[int, bytes, bytes, int]]] = {}

@@ -32,6 +32,7 @@ from yugabyte_tpu.rpc.messenger import RemoteError
 from yugabyte_tpu.tserver.transaction_coordinator import (
     SYSTEM_NAMESPACE, TRANSACTIONS_TABLE, TXN_STATUS_SCHEMA)
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import lock_rank
 from yugabyte_tpu.utils.status import Code, Status, StatusError
 
 flags.define_flag("txn_client_heartbeat_ms", 2000,
@@ -95,9 +96,10 @@ class YBTransaction:
         resp = self._status_call("txn_create")
         self.read_ht: int = resp["read_ht"]
         self._participants: Dict[str, str] = {}  # tablet_id -> addr hint
-        self._state = "pending"
-        self._stmt_seq = 0  # IntraTxnWriteId statement slots (see write())
-        self._lock = threading.Lock()
+        self._state = "pending"  # guarded-by: _lock
+        self._stmt_seq = 0  # guarded-by: _lock; IntraTxnWriteId statement slots (see write())
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "client.txn._lock")
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,

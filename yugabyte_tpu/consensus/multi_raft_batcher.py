@@ -72,6 +72,12 @@ class MultiRaftBatcher:
             "multi_raft_batches_total",
             "batched multi_update_consensus RPCs sent")
 
+    def counters(self) -> Tuple[int, int]:
+        """Locked (heartbeats_in, batches_out) snapshot for observers;
+        the fields themselves must only be touched under `_lock`."""
+        with self._lock:
+            return self.heartbeats_in, self.batches_out
+
     def stop(self) -> None:
         with self._lock:
             self._stopped = True

@@ -462,6 +462,19 @@ class RaftConsensus:
                     time.sleep(0.2)
                 timeout = self._election_timeout_s()
 
+    def observed_state(self) -> Tuple["Role", int]:
+        """Locked (role, commit_index) snapshot for off-raft observers —
+        tablet reports, WAL anchoring — which must not read the guarded
+        fields bare."""
+        with self._lock:
+            return self.role, self.commit_index
+
+    def commit_progress(self) -> Tuple[int, int]:
+        """Locked (commit_index, last_applied) snapshot — catch-up
+        polling must not read the guarded fields bare."""
+        with self._lock:
+            return self.commit_index, self.last_applied
+
     def start_election(self, ignore_lease: bool = False) -> None:
         """Become candidate, solicit votes (ref raft_consensus.cc:546)."""
         with self._lock:

@@ -27,6 +27,8 @@ from yugabyte_tpu.common.schema import ColumnSchema, DataType, Schema
 from yugabyte_tpu.docdb.doc_key import DocKey
 from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 from yugabyte_tpu.utils.status import StatusError
+from yugabyte_tpu.utils import ybsan
+from yugabyte_tpu.utils import lock_rank
 
 LINKED_LIST_SCHEMA = Schema(
     columns=[ColumnSchema("k", DataType.STRING),
@@ -54,7 +56,7 @@ class LoadReport:
     errors: int
 
 
-class LinkedListLoadGenerator:
+class LinkedListLoadGenerator:  # yblint: disable=ybsan-coverage (each writer thread owns its disjoint ChainState slot; `errors` is a best-effort harness counter; reports are built after join, so results are HB-ordered)
     """N writer threads, one chain each, paced to ops_per_sec total."""
 
     def __init__(self, client: YBClient, table, n_chains: int = 4,
@@ -183,7 +185,7 @@ class YcsbReport:
     scan_rows: int = 0
 
 
-class YcsbALoadGenerator:
+class YcsbALoadGenerator:  # yblint: disable=ybsan-coverage (workers write only their own _lat_ms/_counts slot — disjoint by worker id — and report() runs after join)
     """Max-rate YCSB-A (50/50 read-update over a Zipf-ish hot set) —
     the reference's perf harness workload (ref: yb-perf v1.0.7 YCSB-A on
     a 3-node RF=3 cluster; src/yb/util/load_generator.h's multi-threaded
@@ -333,8 +335,9 @@ class YcsbLoadGenerator:
         # helpers touch DISJOINT slots so the write flush can overlap
         # the read batch on a side thread without racy counters
         self._counts: List[List[int]] = []
-        self._insert_high = key_space  # D-mix "latest" insert cursor
-        self._insert_lock = threading.Lock()
+        self._insert_high = key_space  # guarded-by: _insert_lock; D-mix "latest" insert cursor
+        self._insert_lock = lock_rank.tracked(
+            threading.Lock(), "ycsb._insert_lock")
         self._t0 = 0.0
         self._t1 = 0.0
 

@@ -144,9 +144,7 @@ class MiniCluster:
         while True:
             try:
                 cat = self.leader_master().catalog
-                table = next(
-                    t for t in cat.tables.values()
-                    if t["namespace"] == namespace and t["name"] == name)
+                table = cat.get_table(namespace, name)
                 tablet_ids = list(table["tablet_ids"])
                 break
             except (StatusError, StopIteration):

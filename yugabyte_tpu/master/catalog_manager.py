@@ -26,6 +26,7 @@ from yugabyte_tpu.master.sys_catalog import SysCatalog
 from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils import lock_rank
 
 flags.define_flag("tserver_unresponsive_timeout_ms", 3000,
                   "a tserver missing heartbeats this long is treated as dead "
@@ -123,15 +124,16 @@ class CatalogManager:
         self.sys = sys_catalog
         self.messenger = messenger
         self.ts_manager = TSManager()
-        self._lock = threading.RLock()
-        self._loaded_term = -1
-        self.namespaces: Dict[str, dict] = {}
-        self.tables: Dict[str, dict] = {}
-        self.tablets: Dict[str, dict] = {}
+        self._lock = lock_rank.tracked(threading.RLock(),
+                                       "catalog._lock")
+        self._loaded_term = -1  # guarded-by: _lock
+        self.namespaces: Dict[str, dict] = {}  # guarded-by: _lock
+        self.tables: Dict[str, dict] = {}  # guarded-by: _lock
+        self.tablets: Dict[str, dict] = {}  # guarded-by: _lock
         self.sequences: Dict[str, dict] = {}  # "ns.name" -> {next, ...}
         self.views: Dict[str, dict] = {}      # "ns.name" -> {sql, ...}
         # volatile: tablet_id -> (leader server_id, term); replica acks
-        self.tablet_leaders: Dict[str, Tuple[str, int]] = {}
+        self.tablet_leaders: Dict[str, Tuple[str, int]] = {}  # guarded-by: _lock
         self._confirmed: Set[Tuple[str, str]] = set()  # (tablet_id, server)
         # volatile: authoritative Raft config index per tablet (from leader
         # reports); used to recognize evicted stale replicas.
@@ -287,9 +289,10 @@ class CatalogManager:
                           key=lambda m: m["name"])
 
     def _find_table(self, namespace: str, name: str) -> Optional[str]:
-        for tid, t in self.tables.items():
-            if t["namespace"] == namespace and t["name"] == name:
-                return tid
+        with self._lock:
+            for tid, t in self.tables.items():
+                if t["namespace"] == namespace and t["name"] == name:
+                    return tid
         return None
 
     def create_table(self, namespace: str, name: str, schema_wire: dict,
@@ -592,6 +595,23 @@ class CatalogManager:
             return [dict(t) for t in self.tables.values()
                     if namespace is None or t["namespace"] == namespace]
 
+    def balancer_snapshot(self) -> Tuple[Dict[str, dict],
+                                         Dict[str, tuple]]:
+        """Locked (tablets, tablet_leaders) shallow snapshot for the load
+        balancer's read-only scan — it runs off the heartbeat threads and
+        must not iterate the live guarded dicts bare."""
+        with self._lock:
+            return ({tid: dict(tm) for tid, tm in self.tablets.items()},
+                    dict(self.tablet_leaders))
+
+    def tablet_replicas(self, tablet_id: str) -> List[str]:
+        with self._lock:
+            return list(self.tablets[tablet_id]["replicas"])
+
+    def has_tablet(self, tablet_id: str) -> bool:
+        with self._lock:
+            return tablet_id in self.tablets
+
     def get_table_locations(self, table_id: str) -> List[dict]:
         addr_map = self.ts_manager.addr_map()
         with self._lock:
@@ -754,8 +774,9 @@ class CatalogManager:
         TRACE("catalog: adopted split child %s of %s", child_id, parent_id)
 
     def _split_children_in_catalog(self, tablet_id: str) -> List[str]:
-        return [c for c in (f"{tablet_id}.s0", f"{tablet_id}.s1")
-                if c in self.tablets]
+        with self._lock:
+            return [c for c in (f"{tablet_id}.s0", f"{tablet_id}.s1")
+                    if c in self.tablets]
 
     def retire_split_parents(self) -> int:
         """Drop split parents whose children are adopted and fully
@@ -897,7 +918,8 @@ class CatalogManager:
         out = []
         for meta in self._replications():
             for t in meta["tables"]:
-                table = self.tables.get(t["dst_table_id"])
+                with self._lock:
+                    table = self.tables.get(t["dst_table_id"])
                 if table is None:
                     continue
                 for tablet_id in table["tablet_ids"]:

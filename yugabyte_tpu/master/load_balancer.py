@@ -50,10 +50,11 @@ class ClusterLoadBalancer:
         addr_map = cm.ts_manager.addr_map()
         moves = 0
         max_moves = flags.get_flag("load_balancer_max_moves_per_pass")
-        for tablet_id, tm in list(cm.tablets.items()):
+        tablets_snap, leaders_snap = cm.balancer_snapshot()
+        for tablet_id, tm in tablets_snap.items():
             if moves >= max_moves:
                 break
-            leader = cm.tablet_leaders.get(tablet_id)
+            leader = leaders_snap.get(tablet_id)
             # Corruption-reported replicas (scrub / read-path CRC /
             # digest divergence) are rebuilt IN PLACE from the leader:
             # the server is alive and its disk works — only this
@@ -173,5 +174,5 @@ class ClusterLoadBalancer:
         cm.update_tablet_replicas(
             tablet_id,
             [new_server if s == dead_server else s
-             for s in cm.tablets[tablet_id]["replicas"]])
+             for s in cm.tablet_replicas(tablet_id)])
         return True

@@ -22,6 +22,7 @@ from yugabyte_tpu.rpc.consensus_service import RpcTransport
 from yugabyte_tpu.rpc.messenger import Messenger
 from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Code, Status, StatusError
+from yugabyte_tpu.utils import lock_rank
 
 flags.define_flag("catalog_reconcile_interval_ms", 500,
                   "master background loop period for re-driving unacked "
@@ -223,9 +224,10 @@ class Master:
         self.clock = HybridClock()
         self.messenger = Messenger(f"master-{opts.master_id}",
                                    bind_host=opts.bind_host, port=opts.port)
-        self._master_addr_map: Dict[str, str] = {
+        self._master_addr_map: Dict[str, str] = {  # guarded-by: _addr_lock
             opts.master_id: self.messenger.address}
-        self._addr_lock = threading.Lock()
+        self._addr_lock = lock_rank.tracked(threading.Lock(),
+                                            "master._addr_lock")
         self.transport = RpcTransport(self.messenger, self._resolve_peer)
         master_ids = opts.master_ids or [opts.master_id]
         self.sys_catalog = SysCatalog(

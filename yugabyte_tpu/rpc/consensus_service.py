@@ -113,7 +113,7 @@ class ConsensusService:
         return {"resps": out}
 
 
-class RpcTransport:
+class RpcTransport:  # yblint: disable=ybsan-coverage (stateless dispatch seam: every attr is set once in __init__ and read-only after; the .submit goes to MultiRaftBatcher, whose shared state carries its own guarded-by annotations)
     """Client-side consensus transport seam over the Messenger.
 
     resolver(peer_address) -> 'host:port' of the server hosting that peer,

@@ -458,6 +458,13 @@ class _ClientConnection:
                                      f"({dead})")
         return waiter["resp"]
 
+    def alive(self) -> bool:
+        """Locked liveness probe for the messenger's conn-cache paths.
+        `dead` transitions once (None -> Exception) under `lock`; callers
+        must not read it bare."""
+        with self.lock:
+            return self.dead is None
+
     def close(self) -> None:
         # Fail in-flight calls NOW rather than waiting for the reader
         # thread to observe the closed socket: a caller parked in
@@ -1023,7 +1030,7 @@ class Messenger:
     def _get_conn(self, addr: Tuple[str, int]) -> _ClientConnection:
         with self._conns_lock:
             conn = self._conns.get(addr)
-            if conn is not None and conn.dead is None:
+            if conn is not None and conn.alive():
                 return conn
         # Connect outside the lock; racing creators keep the one registered.
         try:
@@ -1032,7 +1039,7 @@ class Messenger:
             raise ServiceUnavailable(f"{addr}: {e}") from e
         with self._conns_lock:
             cur = self._conns.get(addr)
-            if cur is not None and cur.dead is None:
+            if cur is not None and cur.alive():
                 fresh.close()
                 return cur
             self._conns[addr] = fresh

@@ -17,6 +17,7 @@ from typing import Callable, Dict, Tuple
 from yugabyte_tpu.utils.metrics import (ROOT_REGISTRY, MetricRegistry,
                                         registries_to_json_obj,
                                         registries_to_prometheus)
+from yugabyte_tpu.utils import ybsan
 
 Handler = Callable[[], Tuple[str, str]]
 
@@ -27,6 +28,7 @@ class _NoHandler(KeyError):
     surface as a 500, not be misreported as a missing route."""
 
 
+@ybsan.shadow(_handlers=ybsan.SINGLE_WRITER)
 class Webserver:
     def __init__(self, metrics: MetricRegistry,
                  bind_host: str = "127.0.0.1", port: int = 0):

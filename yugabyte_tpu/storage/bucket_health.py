@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Tuple
 
 from yugabyte_tpu.storage import offload_policy as _policy
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import ybsan
 from yugabyte_tpu.utils.trace import TRACE
 
 flags.define_flag("bucket_health_ewma_alpha", 0.3,
@@ -120,8 +121,18 @@ def _health_counter(what: str):
         f"bucket_health_{what}_total", helps[what])
 
 
+@ybsan.shadow(probe_pending=ybsan.PUBLISHER_CONSUMER,
+              probe_started=ybsan.PUBLISHER_CONSUMER,
+              probe_tid=ybsan.PUBLISHER_CONSUMER)
 class _Rec:
-    """One (family, bucket) health record. guarded-by: board._lock"""
+    """One (family, bucket) health record. guarded-by: board._lock
+
+    The probe-claim triple (shadowed above) carries an extra protocol
+    on top of the lock: the board publishes a claim in `_probe_gate`
+    and the claiming thread is the only one allowed to pass the gate
+    until the claim clears — every consumer of the triple must be
+    HB-after the publishing write (they are — all sites hold the
+    board's tracked lock, which is exactly what the shadow verifies)."""
 
     __slots__ = ("state", "device_rate", "native_rate", "device_obs",
                  "native_obs", "faults", "traffic", "prewarmed",

@@ -31,6 +31,7 @@ from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import StatusError
 from yugabyte_tpu.utils.threadpool import PriorityThreadPool
 from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils import lock_rank
 
 flags.define_flag("memstore_size_bytes", 128 * 1024 * 1024,
                   "flush memtable at this size (ref docdb_rocksdb_util.cc:113)")
@@ -204,11 +205,11 @@ class DB:
         self.versions = VersionSet(db_dir)
         self.versions.recover()
         self.mem = new_memtable()
-        self._imm: Optional[MemTable] = None   # memtable being flushed
+        self._imm: Optional[MemTable] = None   # guarded-by: _lock; memtable being flushed
         self._readers: dict = {}
-        self._lock = threading.RLock()
-        self._compacting = False
-        self._closed = False
+        self._lock = lock_rank.tracked(threading.RLock(), "db._lock")
+        self._compacting = False  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         # Cancellation seam for in-flight background work: close() and a
         # tablet-FAILED transition (cancel_background_work) flip it, and
         # the compaction pipeline checks it at every stage boundary — an

@@ -45,6 +45,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import ybsan
 
 flags.define_flag("shadow_verify_sample", 0.02,
                   "fraction of device-native compaction jobs whose "
@@ -106,6 +107,10 @@ def maybe_shadow_verifier(inputs, history_cutoff_ht: int, is_major: bool,
                           retain_deletes)
 
 
+@ybsan.shadow(_surv=ybsan.PUBLISHER_CONSUMER,
+              _mk=ybsan.PUBLISHER_CONSUMER,
+              _oracle_err=ybsan.PUBLISHER_CONSUMER,
+              _ms=ybsan.PUBLISHER_CONSUMER)
 class ShadowVerifier:
     """Re-derives one compaction job's survivor decisions through the
     native heap-merge oracle and compares the device decisions against

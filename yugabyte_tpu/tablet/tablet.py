@@ -148,7 +148,7 @@ class TabletOptions:
     upper_bound_key: Optional[bytes] = None
 
 
-class Tablet:
+class Tablet:  # yblint: disable=ybsan-coverage (composition root: the .submit goes to the consensus seam, and all cross-thread mutable state lives in DB/RaftConsensus/ admission, each covered by its own guarded-by annotations)
     def __init__(self, tablet_id: str, data_dir: str, schema: Schema,
                  clock: Optional[HybridClock] = None,
                  options: Optional[TabletOptions] = None,

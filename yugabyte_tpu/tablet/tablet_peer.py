@@ -626,7 +626,7 @@ class TabletPeer:
         flushed-frontier component (a flush advances it) but KEEP the
         raft/CDC pins, which a flush cannot move."""
         if assume_flushed:
-            anchor = self.raft.commit_index + 1
+            anchor = self.raft.observed_state()[1] + 1
         else:
             frontiers = [db.versions.flushed_frontier.op_id_max[1]
                          for db in (self.tablet.regular_db,

@@ -16,6 +16,7 @@ from typing import Callable, List, Optional
 from yugabyte_tpu.rpc.messenger import (
     Messenger, RemoteError, RpcTimeout, ServiceUnavailable)
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import ybsan
 from yugabyte_tpu.utils.backoff import RetrySchedule
 from yugabyte_tpu.utils.trace import TRACE
 
@@ -27,6 +28,7 @@ flags.define_flag("heartbeat_interval_ms", 200,
 MASTER_SERVICE = "master"
 
 
+@ybsan.shadow(_leader_addr=ybsan.SINGLE_WRITER)
 class Heartbeater:
     def __init__(self, messenger: Messenger, master_addrs: List[str],
                  server_id: str, server_addr: str,

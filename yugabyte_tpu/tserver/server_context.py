@@ -92,7 +92,7 @@ def resolve_device(mode: str, timeout_s: float):
     return "native", None
 
 
-class ServerExecutionContext:
+class ServerExecutionContext:  # yblint: disable=ybsan-coverage (set-once-in-__init__ config holder, read-only after construction; the pools/caches it owns carry their own guarded-by annotations)
     """One per TabletServer process; every hosted tablet's TabletOptions
     come from here so compaction pool, device, HBM slab cache and block
     cache are shared server-wide."""

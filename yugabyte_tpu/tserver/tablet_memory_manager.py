@@ -47,7 +47,7 @@ def _global_memstore_limit(root_limit: int) -> int:
     return min(derived, 2 << 30) if derived > 0 else 2 << 30
 
 
-class TabletMemoryManager:
+class TabletMemoryManager:  # yblint: disable=ybsan-coverage (trackers/config are frozen before the arbiter thread starts — HB via Thread.start — and mutable accounting lives in MemTracker, which locks internally)
     """One per TabletServer. peers_fn returns the live TabletPeer list."""
 
     def __init__(self, peers_fn: Callable[[], List],

@@ -287,7 +287,7 @@ class TabletServer:
         for peer in self.tablet_manager.peers():
             tablets.append({
                 "tablet_id": peer.tablet_id,
-                "role": peer.raft.role.value,
+                "role": peer.raft.observed_state()[0].value,
                 "vouched": peer.is_vouched(),
                 "vouch_read_ht": peer._vouch_read_ht,
             })

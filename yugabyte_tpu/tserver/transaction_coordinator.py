@@ -26,6 +26,7 @@ from yugabyte_tpu.docdb.doc_operations import QLWriteOp, WriteOpKind
 from yugabyte_tpu.utils import flags
 from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
+from yugabyte_tpu.utils import lock_rank
 
 flags.define_flag("transaction_timeout_ms", 10_000,
                   "a pending transaction whose last heartbeat is older than "
@@ -65,8 +66,9 @@ class TransactionCoordinator:
         # leader_resolver(tablet_id) -> addr for participant notification
         self._leader_resolver = leader_resolver or (lambda tid: None)
         self._messenger = messenger
-        self._mutexes: Dict[bytes, threading.Lock] = {}
-        self._mutexes_lock = threading.Lock()
+        self._mutexes: Dict[bytes, threading.Lock] = {}  # guarded-by: _mutexes_lock
+        self._mutexes_lock = lock_rank.tracked(
+            threading.Lock(), "txn_coordinator._mutexes_lock")
 
     def _txn_mutex(self, txn_id: bytes) -> threading.Lock:
         with self._mutexes_lock:

@@ -457,9 +457,10 @@ class TSTabletManager:
             peers = list(self._tablets.items())
         report = []
         for tablet_id, peer in peers:
+            role, _commit = peer.raft.observed_state()
             entry = {
                 "tablet_id": tablet_id,
-                "role": peer.raft.role.value,
+                "role": role.value,
                 # FAILED replicas are reported so the master's load
                 # balancer can re-replicate without waiting for the whole
                 # server to go silent (ref tablet reports carrying

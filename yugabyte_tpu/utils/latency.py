@@ -45,6 +45,7 @@ import time
 from typing import Dict, Optional
 
 from yugabyte_tpu.utils import metrics as _metrics
+from yugabyte_tpu.utils import ybsan
 
 OP_WRITE = "write"
 OP_MULTI_READ = "multi_read"
@@ -94,6 +95,7 @@ _STAGE_TABLES = {
 }
 
 
+@ybsan.shadow(stages=ybsan.SINGLE_WRITER_PER_KEY)
 class LatencyBudget:
     """One op's wall clock, split into named disjoint stage slices.
 

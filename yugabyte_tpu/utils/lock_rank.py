@@ -34,12 +34,26 @@ import sys
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
+from yugabyte_tpu.utils import ybsan as _ybsan
+
 _edges_lock = threading.Lock()
 _edges: Dict[str, Set[str]] = {}          # name -> set of names acquired
                                           # while `name` was held
 _edge_sites: Dict[Tuple[str, str], str] = {}
 _violations: List[str] = []
+_races: List[str] = []                    # latched ybsan race reports
 _held = threading.local()
+
+
+def _count_violation(counter_name: str) -> None:
+    """Export the latched-violation counters to ROOT_REGISTRY so soaks
+    can assert zero (`lock_rank_violations_total`, `ybsan_races_total`).
+    Lazy import: lock_rank must stay importable before metrics."""
+    from yugabyte_tpu.utils import metrics
+    metrics.ROOT_REGISTRY.entity("server", "sanitizer").counter(
+        counter_name,
+        "latched concurrency-violation reports (lock-order cycles / "
+        "ybsan races) observed by this process").increment()
 
 
 def enabled() -> bool:
@@ -62,21 +76,24 @@ class TrackedLock:
     probe acquires (Condition._is_owned's `acquire(False)`) that fail do
     not record edges or held state."""
 
-    __slots__ = ("_lock", "name")
+    __slots__ = ("_lock", "name", "ybsan_vc")
 
     def __init__(self, lock, name: str):
         self._lock = lock
         self.name = name
+        self.ybsan_vc = None   # per-instance vector clock (ybsan armed)
 
     # -------------------------------------------------- lock protocol
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         got = self._lock.acquire(blocking, timeout)
         if got:
             _record_acquire(self.name)
+            _ybsan.lock_acquired(self)
         return got
 
     def release(self) -> None:
         _record_release(self.name)
+        _ybsan.lock_releasing(self)   # publish BEFORE the lock drops
         self._lock.release()
 
     def locked(self) -> bool:
@@ -121,9 +138,12 @@ def _record_acquire(name: str) -> None:
             cycle = _find_cycle_unlocked()
             if cycle is not None:
                 _violations.append(
-                    "lock-order cycle: " + " -> ".join(cycle)
-                    + f" (closing edge {holder} -> {name} on thread "
-                    + threading.current_thread().name + ")")
+                    "[lock-rank/lock-order-cycle] "
+                    + " -> ".join(cycle)
+                    + f"\n  closing edge {holder} -> {name} on thread "
+                    + threading.current_thread().name + "\n"
+                    + _ybsan.format_stack(_ybsan.capture_stack(skip=2)))
+                _count_violation("lock_rank_violations_total")
     stack.append(name)
 
 
@@ -183,19 +203,45 @@ def find_cycle() -> Optional[List[str]]:
         return _find_cycle_unlocked()
 
 
-def violations() -> List[str]:
+def record_race(report: str) -> None:
+    """Latch a ybsan race report into the merged violation list (called
+    by tools/sanitizer when armed). Same stack format as the cycle
+    reports — `violations()` is ONE vocabulary for both failure kinds,
+    and `ybsan_races_total` lets soaks assert zero without parsing."""
+    with _edges_lock:
+        _races.append(report)
+    _count_violation("ybsan_races_total")
+
+
+def cycle_violations() -> List[str]:
     with _edges_lock:
         return list(_violations)
 
 
+def race_violations() -> List[str]:
+    with _edges_lock:
+        return list(_races)
+
+
+def violations() -> List[str]:
+    """The merged latched violation report: lock-order cycles AND ybsan
+    race reports, in one shared `[pass/code] headline + indented stack`
+    format."""
+    with _edges_lock:
+        return list(_violations) + list(_races)
+
+
 def assert_no_cycles() -> None:
-    """Fail (AssertionError) if any acquisition-order cycle was ever
-    observed in this process — wired into tier-1 via tests/test_yblint.py."""
+    """Fail (AssertionError) if any acquisition-order CYCLE was ever
+    observed in this process — wired into tier-1 via tests/test_yblint.py.
+    (Race reports gate separately through the ybsan session gate, which
+    is baseline-aware; a justified benign race must not fail tier-1.)"""
     with _edges_lock:
         problems = list(_violations)
         cycle = _find_cycle_unlocked()
     if cycle is not None and not problems:
-        problems.append("lock-order cycle: " + " -> ".join(cycle))
+        problems.append("[lock-rank/lock-order-cycle] "
+                        + " -> ".join(cycle))
     assert not problems, "\n".join(problems)
 
 
@@ -205,6 +251,7 @@ def reset() -> None:
         _edges.clear()
         _edge_sites.clear()
         _violations.clear()
+        _races.clear()
     # thread-local caches of other threads expire naturally: a stale
     # `seen` entry only suppresses re-recording an edge that reset()
     # just dropped, so tests use fresh lock names instead

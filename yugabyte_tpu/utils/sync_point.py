@@ -13,12 +13,22 @@ callbacks; yb_test_util fault flags). Two arming modes:
 
 Points are free in production: one dict lookup on an (almost always)
 empty dict, and the env mode only activates when the variable is set.
+
+A third arming mode serves the schedule-perturbation harness
+(tests/test_schedule_fuzz.py): `YBSAN_PERTURB=1` (optionally with
+`YBSAN_PERTURB_SEED` / `YBSAN_PERTURB_P`) turns every sync point into a
+probabilistic preemption site — a seeded sub-millisecond sleep — and
+shrinks the interpreter switch interval, so the hostile interleavings
+that expose races become reachable deterministically per seed.
 """
 
 from __future__ import annotations
 
 import os
+import random
+import sys
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 _arms: Dict[str, Callable[[], None]] = {}
@@ -26,6 +36,9 @@ _lock = threading.Lock()
 _env_point: Optional[str] = None
 _env_hits = 1
 _env_count = 0
+_perturb_rng: Optional[random.Random] = None
+_perturb_p = 0.0
+_prev_switch_interval: Optional[float] = None
 
 def arm_crash(spec: str) -> None:
     """Arm the crash-exit point from a "<point>" or "<point>@<hits>" spec.
@@ -41,14 +54,53 @@ def arm_crash(spec: str) -> None:
         _env_count = 0
 
 
+def arm_perturb(seed: int, p: float = 0.05,
+                switch_interval: float = 1e-5) -> None:
+    """Arm schedule perturbation: every `hit()` becomes a preemption
+    site with probability `p` (seeded — same seed, same schedule
+    pressure), and the GIL switch interval shrinks so threads actually
+    interleave inside the windows the sleeps open."""
+    global _perturb_rng, _perturb_p, _prev_switch_interval
+    with _lock:
+        _perturb_rng = random.Random(seed)
+        _perturb_p = p
+        if _prev_switch_interval is None:
+            _prev_switch_interval = sys.getswitchinterval()
+        sys.setswitchinterval(switch_interval)
+
+
+def disarm_perturb() -> None:
+    global _perturb_rng, _perturb_p, _prev_switch_interval
+    with _lock:
+        _perturb_rng = None
+        _perturb_p = 0.0
+        if _prev_switch_interval is not None:
+            sys.setswitchinterval(_prev_switch_interval)
+            _prev_switch_interval = None
+
+
 _spec = os.environ.get("YBTPU_CRASH_POINT")
 if _spec:
     arm_crash(_spec)
+
+_penv = os.environ.get("YBSAN_PERTURB")
+if _penv and _penv not in ("0", "false", "off"):
+    arm_perturb(int(os.environ.get("YBSAN_PERTURB_SEED", "0")),
+                p=float(os.environ.get("YBSAN_PERTURB_P", "0.05")))
 
 
 def hit(name: str) -> None:
     """Mark reaching a named point; fires any armed action."""
     global _env_count
+    rng = _perturb_rng
+    if rng is not None:
+        # seeded preemption: yield the GIL inside the protocol window
+        # this point marks, letting contending threads interleave here
+        with _lock:
+            fire = rng.random() < _perturb_p
+            delay = rng.random() * 0.002 if fire else 0.0
+        if fire:
+            time.sleep(delay)
     if _env_point is not None and name == _env_point:
         with _lock:
             _env_count += 1

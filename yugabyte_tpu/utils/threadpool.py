@@ -33,6 +33,8 @@ class PriorityThreadPool:
             t.start()
 
     def submit(self, fn: Callable[[], None], priority: int = 0) -> None:
+        from yugabyte_tpu.utils import ybsan
+        fn = ybsan.bind_task(fn)  # HB edge submitter -> executing worker
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("pool shut down")

@@ -36,6 +36,8 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from yugabyte_tpu.utils import flags
+from yugabyte_tpu.utils import lock_rank
+from yugabyte_tpu.utils import ybsan
 from yugabyte_tpu.utils.metrics import (ROOT_REGISTRY, MetricRegistry,
                                         registries_to_json_obj)
 from yugabyte_tpu.utils.trace import TRACE
@@ -52,9 +54,13 @@ flags.define_flag("timeseries_max_metrics", 1024,
                   "memory stays bounded at capacity x max_metrics")
 
 
+@ybsan.shadow(_n=ybsan.PUBLISHER_CONSUMER, _i=ybsan.PUBLISHER_CONSUMER)
 class _Ring:
     """Fixed-capacity (ts, value) ring. Preallocated lists, so a ring's
-    memory is its capacity regardless of how long the sampler runs."""
+    memory is its capacity regardless of how long the sampler runs.
+    Cursor discipline (shadowed above): the sampler thread publishes
+    `_i`/`_n` under the store lock; every reader must be HB-after the
+    publishing write (it is — readers take the same tracked lock)."""
 
     __slots__ = ("cap", "_ts", "_vals", "_n", "_i")
 
@@ -110,7 +116,8 @@ class TimeSeriesStore:
         self.max_metrics = int(
             max_metrics if max_metrics is not None
             else flags.get_flag("timeseries_max_metrics"))
-        self._lock = threading.Lock()
+        self._lock = lock_rank.tracked(threading.Lock(),
+                                       "timeseries._lock")
         self._rings: Dict[str, _Ring] = {}      # guarded-by: _lock
         self._sources: List[Tuple[str, Callable[[], Dict[str, float]]]] = []  # guarded-by: _lock
         self._samples = 0                       # guarded-by: _lock

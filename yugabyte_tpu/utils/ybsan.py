@@ -1,0 +1,135 @@
+"""ybsan shim: the package-side face of the happens-before sanitizer.
+
+The real detector lives in `tools/sanitizer/` (vector clocks, shadow
+cells, race reports) and only exists in checkouts that carry the tools
+tree. Production code must not import it — so every instrumentation
+site inside yugabyte_tpu (utils/lock_rank.py acquire/release,
+utils/threadpool.py submit/execute, the `@ybsan.shadow` opt-in classes)
+talks to THIS module instead, and `tools.sanitizer.arm()` installs its
+hook table here at arming time.
+
+Disarmed cost (the production and plain-pytest case): every forwarder
+is one module-global read plus an is-None check; `shadow(...)` returns
+the class untouched and records the declaration for a later arm.
+
+Arming is explicit: `YBSAN=1 pytest ...` (tests/conftest.py arms at
+session start) or `tools.sanitizer.arm()` from a test body. The shim
+never auto-imports tools — a checkout without tools/ simply can never
+arm, and `enabled()` says whether the environment ASKS for arming.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# Declared shadow disciplines (see README "Concurrency sanitizer"):
+# the detector checks the STATED protocol of a deliberately lock-free
+# structure instead of lock possession.
+SINGLE_WRITER = "single-writer"            # un-HB'd 2nd writer = race
+SINGLE_WRITER_PER_KEY = "single-writer-per-key"  # per dict key
+PUBLISHER_CONSUMER = "publisher-consumer"  # reads must be HB-after writes
+
+_hooks: Optional[Any] = None
+
+# Shadow declarations made before arming: [(cls, {attr: discipline})].
+# tools/sanitizer replays these when it installs its hooks.
+_shadow_registry: List[Tuple[type, Dict[str, str]]] = []
+
+
+def enabled() -> bool:
+    """Does the environment ask for the sanitizer? (YBSAN=1)"""
+    env = os.environ.get("YBSAN")
+    return env is not None and env not in ("", "0", "false", "off")
+
+
+def armed() -> bool:
+    return _hooks is not None
+
+
+def install(hooks: Optional[Any]) -> List[Tuple[type, Dict[str, str]]]:
+    """Install (or, with None, remove) the detector hook table. Called
+    only by tools/sanitizer. Returns the pre-arm shadow declarations so
+    the detector can patch them."""
+    global _hooks
+    _hooks = hooks
+    return list(_shadow_registry)
+
+
+# -------------------------------------------------- shared stack format
+# One stack vocabulary for every sanitizer surface: ybsan race reports
+# AND lock_rank's lock-order-cycle reports render through these, so the
+# merged violation report reads uniformly.
+
+def capture_stack(skip: int = 1,
+                  depth: int = 10) -> Tuple[Tuple[str, int, str], ...]:
+    """Cheap stack summary [(path, line, func)], innermost first,
+    sanitizer frames elided."""
+    out: List[Tuple[str, int, str]] = []
+    f = sys._getframe(skip)
+    while f is not None and len(out) < depth:
+        co = f.f_code
+        fn = co.co_filename
+        if "sanitizer" not in fn and not fn.endswith(
+                ("ybsan.py", "lock_rank.py")):
+            out.append((fn, f.f_lineno, co.co_name))
+        f = f.f_back
+    return tuple(out)
+
+
+def format_stack(stack, indent: str = "    ") -> str:
+    """`at func (path:line)` per frame, innermost first."""
+    lines = []
+    for fn, lineno, func in stack:
+        rel = os.path.relpath(fn, _REPO_ROOT) if fn.startswith(_REPO_ROOT) \
+            else fn
+        lines.append(f"{indent}at {func} ({rel}:{lineno})")
+    return "\n".join(lines) if lines else f"{indent}<no frames>"
+
+
+# ----------------------------------------------------------- forwarders
+def lock_acquired(lock) -> None:
+    h = _hooks
+    if h is not None:
+        h.lock_acquired(lock)
+
+
+def lock_releasing(lock) -> None:
+    h = _hooks
+    if h is not None:
+        h.lock_releasing(lock)
+
+
+def bind_task(fn):
+    """HB edge submitter -> executor: wrap a work item at submit time so
+    running it joins the submitter's clock (utils/threadpool.py)."""
+    h = _hooks
+    if h is None:
+        return fn
+    return h.bind_task(fn)
+
+
+def shadow(**attrs: str):
+    """Class decorator declaring per-attribute lock-free disciplines:
+
+        @ybsan.shadow(stages=ybsan.SINGLE_WRITER_PER_KEY)
+        class LatencyBudget: ...
+
+    Disarmed: returns the class unchanged (zero production cost) and
+    records the declaration; arming replays the registry and patches
+    the class with shadow cells that enforce the stated discipline.
+    """
+    spec = dict(attrs)
+
+    def deco(cls: type) -> type:
+        _shadow_registry.append((cls, spec))
+        h = _hooks
+        if h is not None:
+            h.patch_shadow(cls, spec)
+        return cls
+
+    return deco

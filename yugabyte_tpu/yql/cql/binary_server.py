@@ -29,6 +29,7 @@ from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.yql.cql import parser as P
 from yugabyte_tpu.yql.cql import wire as W
 from yugabyte_tpu.yql.cql.executor import QLProcessor, ResultSet
+from yugabyte_tpu.utils import ybsan
 
 
 def infer_marker_types(stmt, processor: QLProcessor) -> List[DataType]:
@@ -408,6 +409,7 @@ def _err_code(e: StatusError) -> int:
     return W.ERR_SERVER
 
 
+@ybsan.shadow(_shutdown=ybsan.SINGLE_WRITER)
 class CQLBinaryServer:
     """Thread-per-connection CQL v4 endpoint (default port 9042 in the
     reference; ephemeral here unless given)."""

@@ -27,6 +27,7 @@ from yugabyte_tpu.utils.status import Status, StatusError
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.yql.pgsql.executor import (PgError, PgResult, PgSession,
                                              _pg_error, pg_micros_text)
+from yugabyte_tpu.utils import ybsan
 
 PROTOCOL_V3 = 196608          # 3.0
 SSL_REQUEST_CODE = 80877103
@@ -469,6 +470,7 @@ class _Conn:
         self._send_ready()
 
 
+@ybsan.shadow(_shutdown=ybsan.SINGLE_WRITER)
 class PgServer:
     """Listens for PG-protocol connections, thread per connection (the
     reference runs one postgres backend process per connection;

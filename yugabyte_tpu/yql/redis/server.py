@@ -32,6 +32,7 @@ from yugabyte_tpu.rpc.messenger import RemoteError
 from yugabyte_tpu.utils.status import Code, StatusError
 from yugabyte_tpu.utils.trace import TRACE
 from yugabyte_tpu.yql.redis import resp
+from yugabyte_tpu.utils import ybsan
 
 REDIS_KEYSPACE = "redis"
 
@@ -47,6 +48,8 @@ HASH_SCHEMA = Schema(
     num_hash_key_columns=1, num_range_key_columns=1)
 
 
+@ybsan.shadow(_shutdown=ybsan.SINGLE_WRITER,
+              _conns=ybsan.SINGLE_WRITER)
 class RedisServer:
     def __init__(self, client: YBClient, bind_host: str = "127.0.0.1",
                  port: int = 0, num_tablets: int = 4):
